@@ -1,0 +1,20 @@
+"""deeplearning4j_tpu — a TPU-native deep learning framework.
+
+A ground-up rebuild of the capabilities of Deeplearning4j (reference:
+ltxz2008/deeplearning4j, a fork of eclipse/deeplearning4j) designed for
+TPU hardware: eager NDArray tensor API over jax.Array, whole-step XLA
+compilation instead of per-op JNI dispatch, a SameDiff-equivalent graph
+autodiff engine, layer/config-driven networks (MultiLayerNetwork /
+ComputationGraph equivalents), ETL, evaluation, checkpointing, and
+data/tensor-parallel training over ``jax.sharding.Mesh`` where the
+reference's Aeron parameter server collapses into XLA collectives.
+
+Reference architecture map: see SURVEY.md at the repo root.
+"""
+
+__version__ = "0.1.0"
+
+from deeplearning4j_tpu.ndarray.factory import Nd4j
+from deeplearning4j_tpu.ndarray.ndarray import NDArray
+
+__all__ = ["Nd4j", "NDArray", "__version__"]

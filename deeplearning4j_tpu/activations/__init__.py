@@ -1,0 +1,57 @@
+"""Activation functions (reference: org/nd4j/linalg/activations/** —
+Activation enum + IActivation impls, SURVEY.md §2.17).
+
+Each activation is a named pure-jax fn from the op registry; `Activation`
+mirrors the reference enum and resolves to the fn. Used by layer configs
+via string or enum (JSON stores the string).
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Callable
+
+from deeplearning4j_tpu.ops.registry import get_op
+
+
+class Activation(enum.Enum):
+    """Reference: org.nd4j.linalg.activations.Activation."""
+
+    IDENTITY = "identity"
+    SIGMOID = "sigmoid"
+    TANH = "tanh"
+    RELU = "relu"
+    RELU6 = "relu6"
+    LEAKYRELU = "leakyrelu"
+    ELU = "elu"
+    SELU = "selu"
+    GELU = "gelu"
+    SOFTMAX = "softmax"
+    SOFTPLUS = "softplus"
+    SOFTSIGN = "softsign"
+    SWISH = "swish"
+    MISH = "mish"
+    HARDSIGMOID = "hardsigmoid"
+    HARDTANH = "hardtanh"
+    CUBE = "cube"
+    RATIONALTANH = "rationaltanh"
+    RECTIFIEDTANH = "recttanh"
+    THRESHOLDEDRELU = "thresholdedrelu"
+
+    @property
+    def fn(self) -> Callable:
+        if self is Activation.IDENTITY:
+            return lambda x: x
+        return get_op(self.value)
+
+    @staticmethod
+    def resolve(a) -> "Activation":
+        if isinstance(a, Activation):
+            return a
+        if isinstance(a, str):
+            return Activation[a.upper()] if a.upper() in Activation.__members__ \
+                else Activation(a.lower())
+        raise ValueError(f"Cannot resolve activation: {a!r}")
+
+
+__all__ = ["Activation"]

@@ -1,0 +1,1 @@
+"""Shared infrastructure: config serde, environment flags."""

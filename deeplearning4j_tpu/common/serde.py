@@ -1,0 +1,77 @@
+"""Config JSON serde (reference: Jackson round-trip on every config —
+MultiLayerConfiguration#toJson/fromJson, updater/layer polymorphic
+(de)serializers, SURVEY.md §2.18, §5 config system).
+
+Every serializable config is a dataclass registered here; polymorphism
+is encoded as {"@class": <registered name>, ...fields}, mirroring the
+reference's Jackson type info. Round-trip is a hard API contract:
+`from_json(to_json(cfg)) == cfg` for every config in the framework.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, Dict, Type
+
+_CLASSES: Dict[str, type] = {}
+
+
+def serializable(cls=None):
+    """Class decorator: register a dataclass for polymorphic JSON serde."""
+
+    def wrap(c):
+        if not dataclasses.is_dataclass(c):
+            raise TypeError(f"@serializable requires a dataclass: {c}")
+        _CLASSES[c.__name__] = c
+        return c
+
+    return wrap(cls) if cls is not None else wrap
+
+
+def to_dict(obj: Any) -> Any:
+    """Recursively convert registered dataclasses to tagged dicts."""
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        d = {"@class": type(obj).__name__}
+        for f in dataclasses.fields(obj):
+            d[f.name] = to_dict(getattr(obj, f.name))
+        return d
+    if isinstance(obj, dict):
+        return {k: to_dict(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [to_dict(v) for v in obj]
+    return obj
+
+
+def from_dict(d: Any) -> Any:
+    """Inverse of to_dict: rebuild registered dataclasses from tags."""
+    if isinstance(d, dict):
+        if "@class" in d:
+            name = d["@class"]
+            if name not in _CLASSES:
+                raise KeyError(f"Unknown serialized class: {name}")
+            cls = _CLASSES[name]
+            kwargs = {k: from_dict(v) for k, v in d.items() if k != "@class"}
+            field_names = {f.name for f in dataclasses.fields(cls)}
+            # tolerate forward-compatible extra keys, like the reference's
+            # legacy-format deserializers do
+            kwargs = {k: v for k, v in kwargs.items() if k in field_names}
+            obj = cls(**kwargs)
+            return obj
+        return {k: from_dict(v) for k, v in d.items()}
+    if isinstance(d, list):
+        return [from_dict(v) for v in d]
+    return d
+
+
+def to_json(obj: Any, indent: int | None = 2) -> str:
+    return json.dumps(to_dict(obj), indent=indent)
+
+
+def from_json(s: str) -> Any:
+    return from_dict(json.loads(s))
+
+
+def _tuplify(v):
+    """JSON turns tuples into lists; configs that need tuples call this."""
+    return tuple(v) if isinstance(v, list) else v

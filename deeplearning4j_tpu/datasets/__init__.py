@@ -1,0 +1,10 @@
+"""Datasets and iterators (reference: org/nd4j/linalg/dataset/** and
+deeplearning4j-datasets, SURVEY.md §2.27)."""
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterator import (
+    DataSetIterator, ListDataSetIterator, ArrayDataSetIterator,
+)
+
+__all__ = ["DataSet", "DataSetIterator", "ListDataSetIterator",
+           "ArrayDataSetIterator"]

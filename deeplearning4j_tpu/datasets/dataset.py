@@ -1,0 +1,53 @@
+"""DataSet container (reference: org/nd4j/linalg/dataset/DataSet.java —
+features + labels + optional masks)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.ndarray.ndarray import NDArray, _unwrap
+
+
+class DataSet:
+    """features/labels (+ masks) minibatch container."""
+
+    def __init__(self, features, labels, features_mask=None, labels_mask=None):
+        self.features = _unwrap(features)
+        self.labels = _unwrap(labels)
+        self.features_mask = _unwrap(features_mask) if features_mask is not None else None
+        self.labels_mask = _unwrap(labels_mask) if labels_mask is not None else None
+
+    # reference getters
+    def getFeatures(self) -> NDArray:
+        return NDArray(self.features)
+
+    def getLabels(self) -> NDArray:
+        return NDArray(self.labels)
+
+    def numExamples(self) -> int:
+        return int(self.features.shape[0])
+
+    def sample(self, n: int, rng=None) -> "DataSet":
+        idx = (np.random.default_rng(rng).permutation(self.numExamples())[:n])
+        return DataSet(self.features[idx], self.labels[idx])
+
+    def splitTestAndTrain(self, n_train: int):
+        return (DataSet(self.features[:n_train], self.labels[:n_train]),
+                DataSet(self.features[n_train:], self.labels[n_train:]))
+
+    def shuffle(self, seed: int = 0) -> "DataSet":
+        idx = np.random.default_rng(seed).permutation(self.numExamples())
+        self.features = jnp.asarray(np.asarray(self.features)[idx])
+        self.labels = jnp.asarray(np.asarray(self.labels)[idx])
+        return self
+
+    def asList(self):
+        return [DataSet(self.features[i:i + 1], self.labels[i:i + 1])
+                for i in range(self.numExamples())]
+
+    def __repr__(self):
+        return (f"DataSet(features={tuple(self.features.shape)}, "
+                f"labels={tuple(self.labels.shape)})")

@@ -1,0 +1,112 @@
+"""DataSet iterators (reference: org/nd4j/linalg/dataset/api/iterator/
+DataSetIterator + impls; SURVEY.md §2.27). Python-iterable plus the
+reference's reset/hasNext surface so both idioms work."""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+
+
+class DataSetIterator:
+    """Base iterator protocol (reference interface)."""
+
+    def reset(self):
+        raise NotImplementedError
+
+    def hasNext(self) -> bool:
+        raise NotImplementedError
+
+    def next(self) -> DataSet:
+        raise NotImplementedError
+
+    def batch(self) -> int:
+        raise NotImplementedError
+
+    def resetSupported(self) -> bool:
+        return True
+
+    def asyncSupported(self) -> bool:
+        return True
+
+    def __iter__(self) -> Iterator[DataSet]:
+        self.reset()
+        while self.hasNext():
+            yield self.next()
+
+
+class ListDataSetIterator(DataSetIterator):
+    """Iterate a list of pre-built DataSets (reference:
+    ListDataSetIterator)."""
+
+    def __init__(self, datasets: Sequence[DataSet], batch_size: Optional[int] = None):
+        self._ds = list(datasets)
+        self._i = 0
+        self._batch = batch_size or (self._ds[0].numExamples() if self._ds else 0)
+
+    def reset(self):
+        self._i = 0
+
+    def hasNext(self) -> bool:
+        return self._i < len(self._ds)
+
+    def next(self) -> DataSet:
+        ds = self._ds[self._i]
+        self._i += 1
+        return ds
+
+    def batch(self) -> int:
+        return self._batch
+
+
+class ArrayDataSetIterator(DataSetIterator):
+    """Minibatch over in-memory arrays with optional shuffling.
+
+    The workhorse for tests/benchmarks (reference analog:
+    IteratorDataSetIterator over an INDArray-backed DataSet).
+    """
+
+    def __init__(self, features, labels, batch_size: int,
+                 shuffle: bool = False, seed: int = 123, drop_last: bool = False):
+        self._x = np.asarray(features)
+        self._y = np.asarray(labels)
+        assert self._x.shape[0] == self._y.shape[0]
+        self._bs = batch_size
+        self._shuffle = shuffle
+        self._seed = seed
+        self._epoch = 0
+        self._drop_last = drop_last
+        self._order = np.arange(self._x.shape[0])
+        self._i = 0
+        self._maybe_shuffle()
+
+    def _maybe_shuffle(self):
+        if self._shuffle:
+            rng = np.random.default_rng(self._seed + self._epoch)
+            self._order = rng.permutation(self._x.shape[0])
+
+    def reset(self):
+        self._i = 0
+        self._epoch += 1
+        self._maybe_shuffle()
+
+    def hasNext(self) -> bool:
+        remaining = self._x.shape[0] - self._i
+        if self._drop_last:
+            return remaining >= self._bs
+        return remaining > 0
+
+    def next(self) -> DataSet:
+        j = min(self._i + self._bs, self._x.shape[0])
+        idx = self._order[self._i:j]
+        self._i = j
+        return DataSet(self._x[idx], self._y[idx])
+
+    def batch(self) -> int:
+        return self._bs
+
+    def totalExamples(self) -> int:
+        return int(self._x.shape[0])

@@ -1,0 +1,137 @@
+"""Data normalizers (reference: org/nd4j/linalg/dataset/api/preprocessor/**
+— NormalizerStandardize, NormalizerMinMaxScaler, ImagePreProcessingScaler.
+SURVEY.md §2.27). fit(iterator) accumulates stats; transform mutates
+DataSets in place (reference contract); serializable with the model."""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+
+
+class DataNormalization:
+    def fit(self, data):
+        raise NotImplementedError
+
+    def transform(self, ds: DataSet) -> DataSet:
+        raise NotImplementedError
+
+    def preProcess(self, ds: DataSet) -> DataSet:
+        return self.transform(ds)
+
+    def state_dict(self) -> dict:
+        raise NotImplementedError
+
+    def load_state_dict(self, d: dict):
+        raise NotImplementedError
+
+
+class NormalizerStandardize(DataNormalization):
+    """Zero-mean/unit-variance per feature."""
+
+    def __init__(self):
+        self.mean = None
+        self.std = None
+
+    def fit(self, data):
+        """data: DataSetIterator or DataSet."""
+        if isinstance(data, DataSet):
+            x = np.asarray(data.features)
+            feats = x.reshape(-1, x.shape[-1]) if x.ndim > 1 else x[:, None]
+            self.mean = feats.mean(0)
+            self.std = feats.std(0) + 1e-8
+            return
+        # streaming accumulation over an iterator
+        n, s, s2 = 0, None, None
+        for ds in data:
+            x = np.asarray(ds.features)
+            feats = x.reshape(-1, x.shape[-1])
+            if s is None:
+                s = feats.sum(0)
+                s2 = (feats ** 2).sum(0)
+            else:
+                s += feats.sum(0)
+                s2 += (feats ** 2).sum(0)
+            n += feats.shape[0]
+        self.mean = s / n
+        self.std = np.sqrt(np.maximum(s2 / n - self.mean ** 2, 0)) + 1e-8
+
+    def transform(self, ds: DataSet) -> DataSet:
+        ds.features = (jnp.asarray(ds.features) - self.mean) / self.std
+        return ds
+
+    def revert(self, ds: DataSet) -> DataSet:
+        ds.features = jnp.asarray(ds.features) * self.std + self.mean
+        return ds
+
+    def state_dict(self):
+        return {"mean": self.mean, "std": self.std}
+
+    def load_state_dict(self, d):
+        self.mean = np.asarray(d["mean"])
+        self.std = np.asarray(d["std"])
+
+
+class NormalizerMinMaxScaler(DataNormalization):
+    """Scale features to [min_range, max_range] (default [0,1])."""
+
+    def __init__(self, min_range: float = 0.0, max_range: float = 1.0):
+        self.min_range = min_range
+        self.max_range = max_range
+        self.data_min = None
+        self.data_max = None
+
+    def fit(self, data):
+        if isinstance(data, DataSet):
+            x = np.asarray(data.features).reshape(-1, np.asarray(data.features).shape[-1])
+            self.data_min = x.min(0)
+            self.data_max = x.max(0)
+            return
+        mn, mx = None, None
+        for ds in data:
+            x = np.asarray(ds.features).reshape(-1, np.asarray(ds.features).shape[-1])
+            bmn, bmx = x.min(0), x.max(0)
+            mn = bmn if mn is None else np.minimum(mn, bmn)
+            mx = bmx if mx is None else np.maximum(mx, bmx)
+        self.data_min, self.data_max = mn, mx
+
+    def transform(self, ds: DataSet) -> DataSet:
+        rng = np.maximum(self.data_max - self.data_min, 1e-8)
+        scaled = (jnp.asarray(ds.features) - self.data_min) / rng
+        ds.features = scaled * (self.max_range - self.min_range) + self.min_range
+        return ds
+
+    def state_dict(self):
+        return {"data_min": self.data_min, "data_max": self.data_max,
+                "range": np.asarray([self.min_range, self.max_range])}
+
+    def load_state_dict(self, d):
+        self.data_min = np.asarray(d["data_min"])
+        self.data_max = np.asarray(d["data_max"])
+        self.min_range, self.max_range = (float(v) for v in d["range"])
+
+
+class ImagePreProcessingScaler(DataNormalization):
+    """uint8 pixels -> [min,max] (reference: ImagePreProcessingScaler)."""
+
+    def __init__(self, min_range: float = 0.0, max_range: float = 1.0,
+                 max_pixel: float = 255.0):
+        self.min_range = min_range
+        self.max_range = max_range
+        self.max_pixel = max_pixel
+
+    def fit(self, data):
+        pass  # stateless
+
+    def transform(self, ds: DataSet) -> DataSet:
+        x = jnp.asarray(ds.features, jnp.float32) / self.max_pixel
+        ds.features = x * (self.max_range - self.min_range) + self.min_range
+        return ds
+
+    def state_dict(self):
+        return {"range": np.asarray([self.min_range, self.max_range, self.max_pixel])}
+
+    def load_state_dict(self, d):
+        self.min_range, self.max_range, self.max_pixel = (float(v) for v in d["range"])

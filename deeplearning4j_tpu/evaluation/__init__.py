@@ -1,0 +1,253 @@
+"""Evaluation (reference: org/nd4j/evaluation/classification/Evaluation,
+EvaluationBinary, ROC, regression/RegressionEvaluation — SURVEY.md §2.16).
+
+Accumulator-style: `eval(labels, predictions)` per batch on host numpy
+(evaluation is not a TPU hot path; predictions already came off-device),
+stats on demand. API names mirror the reference.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+
+def _to_np(a):
+    return np.asarray(a)
+
+
+class Evaluation:
+    """Multi-class classification evaluation with confusion matrix."""
+
+    def __init__(self, num_classes: Optional[int] = None, labels_list=None):
+        self._n = num_classes
+        self._conf: Optional[np.ndarray] = None
+        self._labels_list = labels_list
+
+    def _ensure(self, n):
+        if self._conf is None:
+            self._n = self._n or n
+            self._conf = np.zeros((self._n, self._n), dtype=np.int64)
+        elif n > self._n:
+            # integer-label stream revealed a higher class id: grow
+            grown = np.zeros((n, n), dtype=np.int64)
+            grown[:self._n, :self._n] = self._conf
+            self._conf, self._n = grown, n
+
+    def eval(self, labels, predictions, mask=None):
+        y = _to_np(labels)
+        p = _to_np(predictions)
+        if y.ndim == 3:  # [N,T,C] time series -> flatten time
+            y = y.reshape(-1, y.shape[-1])
+            p = p.reshape(-1, p.shape[-1])
+            if mask is not None:
+                mask = _to_np(mask).reshape(-1)
+        yi = y.argmax(-1) if y.ndim > 1 else y.astype(int)
+        if p.ndim > 1:
+            pi = p.argmax(-1)
+        elif np.issubdtype(p.dtype, np.integer):
+            pi = p.astype(int)          # already class ids
+        else:
+            pi = (p > 0.5).astype(int)  # binary probabilities
+        n = y.shape[-1] if y.ndim > 1 else max(int(yi.max(initial=1)), int(pi.max(initial=1))) + 1
+        self._ensure(n)
+        if mask is not None:
+            keep = _to_np(mask).astype(bool).ravel()
+            yi, pi = yi[keep], pi[keep]
+        np.add.at(self._conf, (yi, pi), 1)
+
+    # -- metrics (reference method names) ------------------------------
+    def accuracy(self) -> float:
+        c = self._conf
+        return float(np.trace(c) / max(c.sum(), 1))
+
+    def _tp(self):
+        return np.diag(self._conf).astype(np.float64)
+
+    def precision(self, cls: Optional[int] = None) -> float:
+        c = self._conf
+        col = c.sum(0).astype(np.float64)
+        with np.errstate(divide="ignore", invalid="ignore"):
+            per = np.where(col > 0, self._tp() / col, np.nan)
+        if cls is not None:
+            return float(per[cls])
+        return float(np.nanmean(per))
+
+    def recall(self, cls: Optional[int] = None) -> float:
+        c = self._conf
+        row = c.sum(1).astype(np.float64)
+        with np.errstate(divide="ignore", invalid="ignore"):
+            per = np.where(row > 0, self._tp() / row, np.nan)
+        if cls is not None:
+            return float(per[cls])
+        return float(np.nanmean(per))
+
+    def f1(self, cls: Optional[int] = None) -> float:
+        p = self.precision(cls)
+        r = self.recall(cls)
+        return 0.0 if p + r == 0 else 2 * p * r / (p + r)
+
+    def falsePositiveRate(self, cls: int) -> float:
+        c = self._conf
+        fp = c[:, cls].sum() - c[cls, cls]
+        tn = c.sum() - c[cls, :].sum() - c[:, cls].sum() + c[cls, cls]
+        return float(fp / max(fp + tn, 1))
+
+    def confusionMatrix(self) -> np.ndarray:
+        return self._conf.copy()
+
+    def getNumRowCounter(self) -> int:
+        return int(self._conf.sum()) if self._conf is not None else 0
+
+    def stats(self) -> str:
+        if self._conf is None:
+            return "Evaluation: no data"
+        lines = [
+            "========================Evaluation Metrics========================",
+            f" # of classes:    {self._n}",
+            f" Accuracy:        {self.accuracy():.4f}",
+            f" Precision:       {self.precision():.4f}",
+            f" Recall:          {self.recall():.4f}",
+            f" F1 Score:        {self.f1():.4f}",
+            "=========================Confusion Matrix=========================",
+            str(self._conf),
+        ]
+        return "\n".join(lines)
+
+
+class EvaluationBinary:
+    """Per-output binary evaluation (reference: EvaluationBinary —
+    independent binary classification per output column)."""
+
+    def __init__(self, threshold: float = 0.5):
+        self._t = threshold
+        self._tp = self._fp = self._tn = self._fn = None
+
+    def eval(self, labels, predictions, mask=None):
+        y = _to_np(labels).astype(bool)
+        p = _to_np(predictions) >= self._t
+        if self._tp is None:
+            n = y.shape[-1]
+            self._tp = np.zeros(n, np.int64)
+            self._fp = np.zeros(n, np.int64)
+            self._tn = np.zeros(n, np.int64)
+            self._fn = np.zeros(n, np.int64)
+        y2 = y.reshape(-1, y.shape[-1])
+        p2 = p.reshape(-1, p.shape[-1])
+        if mask is not None:
+            keep = _to_np(mask).astype(bool).ravel()
+            y2, p2 = y2[keep], p2[keep]
+        self._tp += (y2 & p2).sum(0)
+        self._fp += (~y2 & p2).sum(0)
+        self._tn += (~y2 & ~p2).sum(0)
+        self._fn += (y2 & ~p2).sum(0)
+
+    def accuracy(self, i: int) -> float:
+        tot = self._tp[i] + self._fp[i] + self._tn[i] + self._fn[i]
+        return float((self._tp[i] + self._tn[i]) / max(tot, 1))
+
+    def precision(self, i: int) -> float:
+        return float(self._tp[i] / max(self._tp[i] + self._fp[i], 1))
+
+    def recall(self, i: int) -> float:
+        return float(self._tp[i] / max(self._tp[i] + self._fn[i], 1))
+
+    def f1(self, i: int) -> float:
+        p, r = self.precision(i), self.recall(i)
+        return 0.0 if p + r == 0 else 2 * p * r / (p + r)
+
+    def stats(self) -> str:
+        n = len(self._tp) if self._tp is not None else 0
+        rows = [f"out {i}: acc={self.accuracy(i):.4f} prec={self.precision(i):.4f} "
+                f"rec={self.recall(i):.4f} f1={self.f1(i):.4f}" for i in range(n)]
+        return "\n".join(["EvaluationBinary:"] + rows)
+
+
+class ROC:
+    """Binary ROC/AUC via exact threshold sweep (reference: org/nd4j/
+    evaluation/classification/ROC with thresholdSteps=0 exact mode)."""
+
+    def __init__(self):
+        self._scores = []
+        self._labels = []
+
+    def eval(self, labels, predictions, mask=None):
+        y = _to_np(labels).ravel() if _to_np(labels).ndim == 1 or _to_np(labels).shape[-1] == 1 \
+            else _to_np(labels)[..., -1].ravel()
+        p = _to_np(predictions)
+        p = p.ravel() if p.ndim == 1 or p.shape[-1] == 1 else p[..., -1].ravel()
+        self._labels.append(y)
+        self._scores.append(p)
+
+    def calculateAUC(self) -> float:
+        y = np.concatenate(self._labels)
+        s = np.concatenate(self._scores)
+        order = np.argsort(-s, kind="stable")
+        y = y[order]
+        tps = np.cumsum(y)
+        fps = np.cumsum(1 - y)
+        P = max(y.sum(), 1e-12)
+        N = max((1 - y).sum(), 1e-12)
+        tpr = np.concatenate([[0.0], tps / P])
+        fpr = np.concatenate([[0.0], fps / N])
+        return float(np.trapezoid(tpr, fpr))
+
+    def calculateAUCPR(self) -> float:
+        y = np.concatenate(self._labels)
+        s = np.concatenate(self._scores)
+        order = np.argsort(-s, kind="stable")
+        y = y[order]
+        tps = np.cumsum(y)
+        prec = tps / (np.arange(len(y)) + 1)
+        rec = tps / max(y.sum(), 1e-12)
+        return float(np.trapezoid(prec, rec))
+
+
+class RegressionEvaluation:
+    """Regression metrics per output column (reference:
+    org/nd4j/evaluation/regression/RegressionEvaluation)."""
+
+    def __init__(self):
+        self._ys = []
+        self._ps = []
+
+    def eval(self, labels, predictions, mask=None):
+        self._ys.append(_to_np(labels).reshape(-1, _to_np(labels).shape[-1]))
+        self._ps.append(_to_np(predictions).reshape(-1, _to_np(predictions).shape[-1]))
+
+    def _cat(self):
+        return np.concatenate(self._ys), np.concatenate(self._ps)
+
+    def meanSquaredError(self, col: int = 0) -> float:
+        y, p = self._cat()
+        return float(np.mean((y[:, col] - p[:, col]) ** 2))
+
+    def meanAbsoluteError(self, col: int = 0) -> float:
+        y, p = self._cat()
+        return float(np.mean(np.abs(y[:, col] - p[:, col])))
+
+    def rootMeanSquaredError(self, col: int = 0) -> float:
+        return float(np.sqrt(self.meanSquaredError(col)))
+
+    def rSquared(self, col: int = 0) -> float:
+        y, p = self._cat()
+        ss_res = np.sum((y[:, col] - p[:, col]) ** 2)
+        ss_tot = np.sum((y[:, col] - y[:, col].mean()) ** 2)
+        return float(1.0 - ss_res / max(ss_tot, 1e-12))
+
+    def pearsonCorrelation(self, col: int = 0) -> float:
+        y, p = self._cat()
+        return float(np.corrcoef(y[:, col], p[:, col])[0, 1])
+
+    def stats(self) -> str:
+        y, p = self._cat()
+        n = y.shape[1]
+        rows = [f"col {i}: MSE={self.meanSquaredError(i):.6f} "
+                f"MAE={self.meanAbsoluteError(i):.6f} "
+                f"RMSE={self.rootMeanSquaredError(i):.6f} "
+                f"R^2={self.rSquared(i):.4f}" for i in range(n)]
+        return "\n".join(["RegressionEvaluation:"] + rows)
+
+
+__all__ = ["Evaluation", "EvaluationBinary", "ROC", "RegressionEvaluation"]

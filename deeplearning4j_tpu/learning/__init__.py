@@ -1,0 +1,20 @@
+"""Updaters and LR schedules (reference: org/nd4j/linalg/learning/**,
+org/nd4j/linalg/schedule/**, SURVEY.md §2.15)."""
+
+from deeplearning4j_tpu.learning.schedules import (
+    ISchedule, ExponentialSchedule, InverseSchedule, MapSchedule,
+    PolySchedule, SigmoidSchedule, StepSchedule, CosineSchedule,
+    WarmupSchedule, ScheduleType,
+)
+from deeplearning4j_tpu.learning.updaters import (
+    IUpdater, Sgd, Adam, AdamW, AdaMax, Nadam, AMSGrad, Nesterovs,
+    AdaGrad, AdaDelta, RmsProp, NoOp,
+)
+
+__all__ = [
+    "ISchedule", "ExponentialSchedule", "InverseSchedule", "MapSchedule",
+    "PolySchedule", "SigmoidSchedule", "StepSchedule", "CosineSchedule",
+    "WarmupSchedule", "ScheduleType",
+    "IUpdater", "Sgd", "Adam", "AdamW", "AdaMax", "Nadam", "AMSGrad",
+    "Nesterovs", "AdaGrad", "AdaDelta", "RmsProp", "NoOp",
+]

@@ -1,0 +1,158 @@
+"""Learning-rate schedules (reference: org/nd4j/linalg/schedule/* —
+ISchedule and impls ExponentialSchedule, InverseSchedule, MapSchedule,
+PolySchedule, SigmoidSchedule, StepSchedule, CycleSchedule).
+
+`value_at(step)` is jit-traceable: `step` may be a traced int32 scalar,
+so implementations use jnp math and no Python control flow on it. The
+reference's per-iteration/per-epoch distinction is carried by
+ScheduleType; the trainer passes the matching counter.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Dict
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.common.serde import serializable
+
+
+class ScheduleType(enum.Enum):
+    ITERATION = "iteration"
+    EPOCH = "epoch"
+
+
+@dataclasses.dataclass
+class ISchedule:
+    """Base schedule. Subclasses implement value_at(step)->f32 scalar."""
+
+    def value_at(self, step):
+        raise NotImplementedError
+
+    @property
+    def schedule_type(self) -> ScheduleType:
+        return ScheduleType(getattr(self, "type", "iteration"))
+
+
+@serializable
+@dataclasses.dataclass
+class ExponentialSchedule(ISchedule):
+    initial_value: float = 0.1
+    gamma: float = 0.99
+    type: str = "iteration"
+
+    def value_at(self, step):
+        return self.initial_value * jnp.power(self.gamma, step)
+
+
+@serializable
+@dataclasses.dataclass
+class InverseSchedule(ISchedule):
+    initial_value: float = 0.1
+    gamma: float = 0.01
+    power: float = 1.0
+    type: str = "iteration"
+
+    def value_at(self, step):
+        return self.initial_value / jnp.power(1.0 + self.gamma * step, self.power)
+
+
+@serializable
+@dataclasses.dataclass
+class StepSchedule(ISchedule):
+    initial_value: float = 0.1
+    decay_rate: float = 0.1
+    step: float = 100.0
+    type: str = "iteration"
+
+    def value_at(self, step):
+        return self.initial_value * jnp.power(self.decay_rate, jnp.floor(step / self.step))
+
+
+@serializable
+@dataclasses.dataclass
+class PolySchedule(ISchedule):
+    initial_value: float = 0.1
+    power: float = 1.0
+    max_iter: int = 1000
+    type: str = "iteration"
+
+    def value_at(self, step):
+        frac = jnp.minimum(step / self.max_iter, 1.0)
+        return self.initial_value * jnp.power(1.0 - frac, self.power)
+
+
+@serializable
+@dataclasses.dataclass
+class SigmoidSchedule(ISchedule):
+    initial_value: float = 0.1
+    gamma: float = 0.1
+    step_size: int = 100
+    type: str = "iteration"
+
+    def value_at(self, step):
+        return self.initial_value / (1.0 + jnp.exp(self.gamma * (step - self.step_size)))
+
+
+@serializable
+@dataclasses.dataclass
+class MapSchedule(ISchedule):
+    """Piecewise-constant from {step: value} (reference: MapSchedule).
+
+    JSON keys are strings; normalized to int at construction.
+    """
+
+    values: Dict = dataclasses.field(default_factory=dict)
+    type: str = "iteration"
+
+    def __post_init__(self):
+        self.values = {int(k): float(v) for k, v in self.values.items()}
+        if 0 not in self.values:
+            raise ValueError("MapSchedule requires a value for step 0")
+
+    def value_at(self, step):
+        keys = sorted(self.values)
+        out = jnp.asarray(self.values[keys[0]], jnp.float32)
+        for k in keys[1:]:
+            out = jnp.where(step >= k, self.values[k], out)
+        return out
+
+
+@serializable
+@dataclasses.dataclass
+class CosineSchedule(ISchedule):
+    """Cosine decay (TPU-era addition; not in reference but standard)."""
+
+    initial_value: float = 0.1
+    max_iter: int = 1000
+    final_value: float = 0.0
+    type: str = "iteration"
+
+    def value_at(self, step):
+        frac = jnp.minimum(step / self.max_iter, 1.0)
+        cos = 0.5 * (1.0 + jnp.cos(jnp.pi * frac))
+        return self.final_value + (self.initial_value - self.final_value) * cos
+
+
+@serializable
+@dataclasses.dataclass
+class WarmupSchedule(ISchedule):
+    """Linear warmup wrapping another schedule (transformer training)."""
+
+    warmup_steps: int = 100
+    base: object = None
+
+    def value_at(self, step):
+        warm = max(self.warmup_steps, 1)
+        base_v = self.base.value_at(jnp.maximum(step - warm, 0))
+        warm_frac = jnp.minimum((step + 1) / warm, 1.0)
+        return base_v * warm_frac
+
+
+def resolve_lr(lr_or_schedule, step):
+    """Float passthrough or schedule evaluation; jit-safe."""
+    if isinstance(lr_or_schedule, ISchedule):
+        return lr_or_schedule.value_at(step)
+    return jnp.asarray(lr_or_schedule, jnp.float32)

@@ -1,0 +1,264 @@
+"""Updaters (reference: org/nd4j/linalg/learning/config/* IUpdater
+configs + org/nd4j/linalg/learning/* GradientUpdater impls —
+Sgd, Adam, AdamW, AdaMax, Nadam, AMSGrad, Nesterovs, AdaGrad, AdaDelta,
+RmsProp, NoOp. SURVEY.md §2.15).
+
+Reference semantics: `GradientUpdater#applyUpdater(gradientView, step)`
+transforms the gradient **in place** into the update; the optimizer then
+does `params -= update`. Here the same contract is functional:
+``apply(state, grads, step) -> (updates, new_state)`` over arbitrary
+pytrees, and the caller subtracts. State lives in a pytree whose leaves
+parallel the param leaves (the reference keeps one flat state array per
+updater block; our checkpoint format stores the state pytree — exact
+resume is preserved, layout is pytree-native rather than flat-buffer).
+
+All math is jnp on leaves — jit-traceable with `step` a traced scalar,
+so the whole update fuses into the compiled training step (the
+reference runs this as separate eager ops per layer block).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.common.serde import serializable
+from deeplearning4j_tpu.learning.schedules import ISchedule, resolve_lr
+
+
+def _tmap(f, *trees):
+    return jax.tree_util.tree_map(f, *trees)
+
+
+@dataclasses.dataclass
+class IUpdater:
+    """Base updater config. Stateless by default."""
+
+    def init_state(self, params) -> Any:
+        return ()
+
+    def apply(self, state, grads, step):
+        """Return (updates, new_state); caller applies params -= updates."""
+        raise NotImplementedError
+
+    def has_state(self) -> bool:
+        return False
+
+    def _lr(self, step):
+        return resolve_lr(self.learning_rate, step)
+
+
+@serializable
+@dataclasses.dataclass
+class NoOp(IUpdater):
+    """Gradient passthrough disabled — update is zero (reference: NoOp,
+    used for frozen layers)."""
+
+    def apply(self, state, grads, step):
+        return _tmap(jnp.zeros_like, grads), state
+
+
+@serializable
+@dataclasses.dataclass
+class Sgd(IUpdater):
+    learning_rate: Any = 0.1
+
+    def apply(self, state, grads, step):
+        lr = self._lr(step)
+        return _tmap(lambda g: lr * g, grads), state
+
+
+@serializable
+@dataclasses.dataclass
+class Nesterovs(IUpdater):
+    """SGD with Nesterov momentum (reference default momentum 0.9).
+
+    Matches the reference formulation: v' = mu*v - lr*g;
+    update = -(mu*v' - lr*g)  (i.e. params += mu*v' - lr*g).
+    """
+
+    learning_rate: Any = 0.1
+    momentum: float = 0.9
+
+    def has_state(self):
+        return True
+
+    def init_state(self, params):
+        return {"v": _tmap(jnp.zeros_like, params)}
+
+    def apply(self, state, grads, step):
+        lr = self._lr(step)
+        mu = self.momentum
+        v_new = _tmap(lambda v, g: mu * v - lr * g, state["v"], grads)
+        updates = _tmap(lambda vn, g: -(mu * vn - lr * g), v_new, grads)
+        return updates, {"v": v_new}
+
+
+@serializable
+@dataclasses.dataclass
+class Adam(IUpdater):
+    learning_rate: Any = 1e-3
+    beta1: float = 0.9
+    beta2: float = 0.999
+    epsilon: float = 1e-8
+
+    def has_state(self):
+        return True
+
+    def init_state(self, params):
+        z = _tmap(jnp.zeros_like, params)
+        return {"m": z, "v": _tmap(jnp.zeros_like, params)}
+
+    def _moments(self, state, grads):
+        m = _tmap(lambda m, g: self.beta1 * m + (1 - self.beta1) * g, state["m"], grads)
+        v = _tmap(lambda v, g: self.beta2 * v + (1 - self.beta2) * g * g, state["v"], grads)
+        return m, v
+
+    def apply(self, state, grads, step):
+        lr = self._lr(step)
+        t = step + 1
+        m, v = self._moments(state, grads)
+        bc1 = 1 - jnp.power(self.beta1, t.astype(jnp.float32) if hasattr(t, "astype") else float(t))
+        bc2 = 1 - jnp.power(self.beta2, t.astype(jnp.float32) if hasattr(t, "astype") else float(t))
+        alpha = lr * jnp.sqrt(bc2) / bc1
+        updates = _tmap(lambda m_, v_: alpha * m_ / (jnp.sqrt(v_) + self.epsilon), m, v)
+        return updates, {"m": m, "v": v}
+
+
+@serializable
+@dataclasses.dataclass
+class AdamW(Adam):
+    """Adam with decoupled weight decay. Needs params; routed via
+    apply_with_params (the trainer calls this variant when available)."""
+
+    weight_decay: float = 0.01
+
+    def apply_with_params(self, state, grads, params, step):
+        updates, new_state = Adam.apply(self, state, grads, step)
+        lr = self._lr(step)
+        updates = _tmap(lambda u, p: u + lr * self.weight_decay * p, updates, params)
+        return updates, new_state
+
+
+@serializable
+@dataclasses.dataclass
+class AdaMax(Adam):
+    def apply(self, state, grads, step):
+        lr = self._lr(step)
+        t = step + 1
+        m = _tmap(lambda m, g: self.beta1 * m + (1 - self.beta1) * g, state["m"], grads)
+        u = _tmap(lambda v, g: jnp.maximum(self.beta2 * v, jnp.abs(g)), state["v"], grads)
+        bc1 = 1 - jnp.power(self.beta1, t.astype(jnp.float32) if hasattr(t, "astype") else float(t))
+        updates = _tmap(lambda m_, u_: (lr / bc1) * m_ / (u_ + self.epsilon), m, u)
+        return updates, {"m": m, "v": u}
+
+
+@serializable
+@dataclasses.dataclass
+class Nadam(Adam):
+    def apply(self, state, grads, step):
+        lr = self._lr(step)
+        t = step + 1
+        tf = t.astype(jnp.float32) if hasattr(t, "astype") else float(t)
+        m, v = self._moments(state, grads)
+        bc1 = 1 - jnp.power(self.beta1, tf)
+        bc2 = 1 - jnp.power(self.beta2, tf)
+        updates = _tmap(
+            lambda m_, v_, g: lr / bc1 * (self.beta1 * m_ + (1 - self.beta1) * g)
+            / (jnp.sqrt(v_ / bc2) + self.epsilon),
+            m, v, grads)
+        return updates, {"m": m, "v": v}
+
+
+@serializable
+@dataclasses.dataclass
+class AMSGrad(Adam):
+    def init_state(self, params):
+        z = _tmap(jnp.zeros_like, params)
+        return {"m": z, "v": _tmap(jnp.zeros_like, params),
+                "vhat": _tmap(jnp.zeros_like, params)}
+
+    def apply(self, state, grads, step):
+        lr = self._lr(step)
+        t = step + 1
+        tf = t.astype(jnp.float32) if hasattr(t, "astype") else float(t)
+        m, v = self._moments(state, grads)
+        vhat = _tmap(jnp.maximum, state["vhat"], v)
+        bc1 = 1 - jnp.power(self.beta1, tf)
+        bc2 = 1 - jnp.power(self.beta2, tf)
+        alpha = lr * jnp.sqrt(bc2) / bc1
+        updates = _tmap(lambda m_, vh: alpha * m_ / (jnp.sqrt(vh) + self.epsilon), m, vhat)
+        return updates, {"m": m, "v": v, "vhat": vhat}
+
+
+@serializable
+@dataclasses.dataclass
+class AdaGrad(IUpdater):
+    learning_rate: Any = 0.1
+    epsilon: float = 1e-6
+
+    def has_state(self):
+        return True
+
+    def init_state(self, params):
+        return {"h": _tmap(jnp.zeros_like, params)}
+
+    def apply(self, state, grads, step):
+        lr = self._lr(step)
+        h = _tmap(lambda h, g: h + g * g, state["h"], grads)
+        updates = _tmap(lambda g, h_: lr * g / (jnp.sqrt(h_) + self.epsilon), grads, h)
+        return updates, {"h": h}
+
+
+@serializable
+@dataclasses.dataclass
+class AdaDelta(IUpdater):
+    rho: float = 0.95
+    epsilon: float = 1e-6
+
+    def has_state(self):
+        return True
+
+    def init_state(self, params):
+        return {"msg": _tmap(jnp.zeros_like, params),
+                "msdx": _tmap(jnp.zeros_like, params)}
+
+    def apply(self, state, grads, step):
+        rho, eps = self.rho, self.epsilon
+        msg = _tmap(lambda a, g: rho * a + (1 - rho) * g * g, state["msg"], grads)
+        updates = _tmap(
+            lambda g, msg_, msdx_: g * jnp.sqrt(msdx_ + eps) / jnp.sqrt(msg_ + eps),
+            grads, msg, state["msdx"])
+        msdx = _tmap(lambda a, u: rho * a + (1 - rho) * u * u, state["msdx"], updates)
+        return updates, {"msg": msg, "msdx": msdx}
+
+
+@serializable
+@dataclasses.dataclass
+class RmsProp(IUpdater):
+    learning_rate: Any = 0.1
+    rms_decay: float = 0.95
+    epsilon: float = 1e-8
+
+    def has_state(self):
+        return True
+
+    def init_state(self, params):
+        return {"g2": _tmap(jnp.zeros_like, params)}
+
+    def apply(self, state, grads, step):
+        lr = self._lr(step)
+        d = self.rms_decay
+        g2 = _tmap(lambda a, g: d * a + (1 - d) * g * g, state["g2"], grads)
+        updates = _tmap(lambda g, a: lr * g / (jnp.sqrt(a) + self.epsilon), grads, g2)
+        return updates, {"g2": g2}
+
+
+def apply_updater(updater: IUpdater, state, grads, params, step):
+    """Uniform entry point: dispatches AdamW-style param-aware updaters."""
+    if hasattr(updater, "apply_with_params"):
+        return updater.apply_with_params(state, grads, params, step)
+    return updater.apply(state, grads, step)

@@ -1,0 +1,196 @@
+"""Loss functions (reference: org/nd4j/linalg/lossfunctions/** —
+LossFunctions.LossFunction enum + ILossFunction impls, SURVEY.md §2.17).
+
+Contract mirrors the reference's ILossFunction: given (labels,
+preOutput, activation, mask) produce per-example scores and the overall
+mean; gradient flows through jax.grad rather than hand-written
+computeGradient methods (the reference hand-derives each — here autodiff
+is the engine, and correctness is checked against finite differences).
+
+All fns: (labels, output) -> per-example loss [N]; `mask` optional
+broadcastable weights. Reductions happen in the trainer.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def _mean_per_example(loss, axis):
+    """Reduce feature axes, keep example axis."""
+    if loss.ndim <= 1:
+        return loss
+    return jnp.sum(loss, axis=axis)
+
+
+def mse(labels, output):
+    """Per-example sum of squared errors / n_outputs (reference: LossMSE)."""
+    d = output - labels
+    return jnp.mean(d * d, axis=tuple(range(1, output.ndim)))
+
+
+def l2(labels, output):
+    d = output - labels
+    return jnp.sum(d * d, axis=tuple(range(1, output.ndim)))
+
+
+def l1(labels, output):
+    return jnp.sum(jnp.abs(output - labels), axis=tuple(range(1, output.ndim)))
+
+
+def mae(labels, output):
+    return jnp.mean(jnp.abs(output - labels), axis=tuple(range(1, output.ndim)))
+
+
+def mcxent(labels, probs, eps=1e-7):
+    """Multi-class cross-entropy on probabilities (post-softmax),
+    matching reference LossMCXENT applied after softmax activation."""
+    p = jnp.clip(probs, eps, 1.0)
+    return -jnp.sum(labels * jnp.log(p), axis=tuple(range(1, probs.ndim)))
+
+
+def softmax_xent_logits(labels, logits):
+    """Fused, numerically-stable CE on logits — the path the compiled
+    trainer actually uses when the output activation is softmax."""
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.sum(labels * logp, axis=tuple(range(1, logits.ndim)))
+
+
+def xent_binary(labels, probs, eps=1e-7):
+    p = jnp.clip(probs, eps, 1 - eps)
+    loss = -(labels * jnp.log(p) + (1 - labels) * jnp.log(1 - p))
+    return jnp.sum(loss, axis=tuple(range(1, probs.ndim)))
+
+
+def sigmoid_xent_logits(labels, logits):
+    loss = jnp.maximum(logits, 0) - logits * labels + jnp.log1p(jnp.exp(-jnp.abs(logits)))
+    return jnp.sum(loss, axis=tuple(range(1, logits.ndim)))
+
+
+def hinge(labels, output):
+    """labels in {-1,1} (reference: LossHinge)."""
+    return jnp.sum(jnp.maximum(0.0, 1.0 - labels * output),
+                   axis=tuple(range(1, output.ndim)))
+
+
+def squared_hinge(labels, output):
+    return jnp.sum(jnp.maximum(0.0, 1.0 - labels * output) ** 2,
+                   axis=tuple(range(1, output.ndim)))
+
+
+def kl_divergence(labels, probs, eps=1e-7):
+    p = jnp.clip(probs, eps, 1.0)
+    l = jnp.clip(labels, eps, 1.0)
+    return jnp.sum(labels * (jnp.log(l) - jnp.log(p)),
+                   axis=tuple(range(1, probs.ndim)))
+
+
+def poisson(labels, output, eps=1e-7):
+    return jnp.sum(output - labels * jnp.log(output + eps),
+                   axis=tuple(range(1, output.ndim)))
+
+
+def cosine_proximity(labels, output, eps=1e-8):
+    ln = labels / (jnp.linalg.norm(labels, axis=-1, keepdims=True) + eps)
+    on = output / (jnp.linalg.norm(output, axis=-1, keepdims=True) + eps)
+    return -jnp.sum(ln * on, axis=tuple(range(1, output.ndim)))
+
+
+def huber(labels, output, delta=1.0):
+    d = jnp.abs(output - labels)
+    quad = 0.5 * d * d
+    lin = delta * d - 0.5 * delta * delta
+    return jnp.sum(jnp.where(d <= delta, quad, lin),
+                   axis=tuple(range(1, output.ndim)))
+
+
+def mape(labels, output, eps=1e-7):
+    return jnp.mean(100.0 * jnp.abs((labels - output) / (jnp.abs(labels) + eps)),
+                    axis=tuple(range(1, output.ndim)))
+
+
+def msle(labels, output, eps=1e-7):
+    d = jnp.log1p(jnp.maximum(output, -1 + eps)) - jnp.log1p(jnp.maximum(labels, -1 + eps))
+    return jnp.mean(d * d, axis=tuple(range(1, output.ndim)))
+
+
+def sparse_mcxent(labels, logits):
+    """Integer labels variant (reference: LossSparseMCXENT)."""
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.take_along_axis(logp, labels[..., None].astype(jnp.int32), axis=-1)[..., 0]
+
+
+class LossFunction(enum.Enum):
+    """Reference: LossFunctions.LossFunction enum names."""
+
+    MSE = "mse"
+    L1 = "l1"
+    L2 = "l2"
+    MAE = "mae"
+    XENT = "xent"                 # binary cross entropy
+    MCXENT = "mcxent"             # multi-class cross entropy
+    SPARSE_MCXENT = "sparse_mcxent"
+    KL_DIVERGENCE = "kl_divergence"
+    POISSON = "poisson"
+    HINGE = "hinge"
+    SQUARED_HINGE = "squared_hinge"
+    COSINE_PROXIMITY = "cosine_proximity"
+    NEGATIVELOGLIKELIHOOD = "negativeloglikelihood"  # alias of MCXENT
+    MEAN_ABSOLUTE_PERCENTAGE_ERROR = "mape"
+    MEAN_SQUARED_LOGARITHMIC_ERROR = "msle"
+    HUBER = "huber"
+
+    @property
+    def fn(self) -> Callable:
+        return {
+            LossFunction.MSE: mse,
+            LossFunction.L1: l1,
+            LossFunction.L2: l2,
+            LossFunction.MAE: mae,
+            LossFunction.XENT: xent_binary,
+            LossFunction.MCXENT: mcxent,
+            LossFunction.SPARSE_MCXENT: sparse_mcxent,
+            LossFunction.KL_DIVERGENCE: kl_divergence,
+            LossFunction.POISSON: poisson,
+            LossFunction.HINGE: hinge,
+            LossFunction.SQUARED_HINGE: squared_hinge,
+            LossFunction.COSINE_PROXIMITY: cosine_proximity,
+            LossFunction.NEGATIVELOGLIKELIHOOD: mcxent,
+            LossFunction.MEAN_ABSOLUTE_PERCENTAGE_ERROR: mape,
+            LossFunction.MEAN_SQUARED_LOGARITHMIC_ERROR: msle,
+            LossFunction.HUBER: huber,
+        }[self]
+
+    @staticmethod
+    def resolve(l) -> "LossFunction":
+        if isinstance(l, LossFunction):
+            return l
+        if isinstance(l, str):
+            return (LossFunction[l.upper()] if l.upper() in LossFunction.__members__
+                    else LossFunction(l.lower()))
+        raise ValueError(f"Cannot resolve loss: {l!r}")
+
+
+def compute_loss(loss_fn: LossFunction, labels, preoutput, activation, mask=None):
+    """Activation-aware loss on pre-activations, with the reference's
+    fused special cases (softmax+MCXENT, sigmoid+XENT) for stability."""
+    from deeplearning4j_tpu.activations import Activation
+
+    act = Activation.resolve(activation)
+    if loss_fn in (LossFunction.MCXENT, LossFunction.NEGATIVELOGLIKELIHOOD) \
+            and act is Activation.SOFTMAX:
+        per_ex = softmax_xent_logits(labels, preoutput)
+    elif loss_fn is LossFunction.SPARSE_MCXENT and act is Activation.SOFTMAX:
+        per_ex = sparse_mcxent(labels, preoutput)
+    elif loss_fn is LossFunction.XENT and act is Activation.SIGMOID:
+        per_ex = sigmoid_xent_logits(labels, preoutput)
+    else:
+        per_ex = loss_fn.fn(labels, act.fn(preoutput))
+    if mask is not None:
+        per_ex = per_ex * mask.reshape(per_ex.shape)
+        return jnp.sum(per_ex) / jnp.maximum(jnp.sum(mask), 1.0)
+    return jnp.mean(per_ex)

@@ -1,0 +1,9 @@
+"""First-class model implementations (flagship: TransformerEncoder —
+the BERT-base-equivalent of the reference's SameDiff TF-import path,
+built TPU-first with explicit DP/TP/SP shardings)."""
+
+from deeplearning4j_tpu.models.transformer import (
+    TransformerConfig, TransformerEncoder,
+)
+
+__all__ = ["TransformerConfig", "TransformerEncoder"]

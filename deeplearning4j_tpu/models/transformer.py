@@ -1,0 +1,296 @@
+"""TransformerEncoder — the flagship distributed model.
+
+Reference parity target: BERT-base via SameDiff TF-import
+(BASELINE.md; SURVEY.md §3.4). The reference executes the imported
+graph node-by-node in a Java interpreter loop; here the model is a pure
+jax function whose whole training step compiles to one XLA program, and
+whose parallelism is declared as sharding specs over a
+('data', 'model') mesh:
+
+- DP: batch axis sharded over 'data'.
+- TP (Megatron-style): QKV and MLP-in projections column-sharded over
+  'model' (P(None, 'model')), attention-out and MLP-out row-sharded
+  (P('model', None)) — XLA GSPMD inserts the all-reduces on ICI.
+- SP (sequence parallelism): between blocks, activations are sharded
+  over the token axis on 'model' (P('data', 'model', None)) so
+  layernorm/residual/dropout work is divided rather than replicated —
+  the reshard to/from head-sharded attention is GSPMD's all-to-all.
+  This is what lets sequence length scale past one chip's HBM, the
+  capability the reference entirely lacks (SURVEY.md §5 long-context).
+
+The encoder trains masked-LM style (tied output head) or
+classification; both heads are provided.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deeplearning4j_tpu.common.serde import serializable
+
+
+@serializable
+@dataclasses.dataclass
+class TransformerConfig:
+    vocab_size: int = 30522          # BERT-base vocab
+    max_len: int = 512
+    d_model: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    d_ff: int = 3072
+    dropout: float = 0.1
+    type_vocab: int = 2
+    eps: float = 1e-12
+    dtype: str = "float32"           # params; compute may be bf16
+    compute_dtype: str = "bfloat16"  # MXU-native
+    seed: int = 0
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+
+def bert_base() -> TransformerConfig:
+    return TransformerConfig()
+
+
+def tiny_config(vocab=128, max_len=64, d_model=64, n_layers=2, n_heads=4,
+                d_ff=128) -> TransformerConfig:
+    return TransformerConfig(vocab_size=vocab, max_len=max_len,
+                             d_model=d_model, n_layers=n_layers,
+                             n_heads=n_heads, d_ff=d_ff,
+                             compute_dtype="float32")
+
+
+class TransformerEncoder:
+    def __init__(self, config: TransformerConfig):
+        self.cfg = config
+        self._pdtype = jnp.dtype(config.dtype)
+        self._cdtype = jnp.dtype(config.compute_dtype)
+
+    # ------------------------------------------------------------------
+    # parameters
+    # ------------------------------------------------------------------
+    def init_params(self, key=None) -> Dict[str, Any]:
+        cfg = self.cfg
+        key = key if key is not None else jax.random.key(cfg.seed)
+        d, f, v = cfg.d_model, cfg.d_ff, cfg.vocab_size
+        std = 0.02
+
+        def norm(k, shape):
+            return std * jax.random.normal(k, shape, self._pdtype)
+
+        keys = jax.random.split(key, 4 + cfg.n_layers)
+        params = {
+            "tok_emb": norm(keys[0], (v, d)),
+            "pos_emb": norm(keys[1], (cfg.max_len, d)),
+            "type_emb": norm(keys[2], (cfg.type_vocab, d)),
+            "emb_ln": {"gamma": jnp.ones((d,), self._pdtype),
+                       "beta": jnp.zeros((d,), self._pdtype)},
+            "layers": [],
+        }
+        for li in range(cfg.n_layers):
+            ks = jax.random.split(keys[4 + li], 6)
+            params["layers"].append({
+                "wqkv": norm(ks[0], (d, 3 * d)),
+                "bqkv": jnp.zeros((3 * d,), self._pdtype),
+                "wo": norm(ks[1], (d, d)),
+                "bo": jnp.zeros((d,), self._pdtype),
+                "ln1": {"gamma": jnp.ones((d,), self._pdtype),
+                        "beta": jnp.zeros((d,), self._pdtype)},
+                "w1": norm(ks[2], (d, f)),
+                "b1": jnp.zeros((f,), self._pdtype),
+                "w2": norm(ks[3], (f, d)),
+                "b2": jnp.zeros((d,), self._pdtype),
+                "ln2": {"gamma": jnp.ones((d,), self._pdtype),
+                        "beta": jnp.zeros((d,), self._pdtype)},
+            })
+        params["mlm_bias"] = jnp.zeros((v,), self._pdtype)
+        return params
+
+    # ------------------------------------------------------------------
+    # sharding specs (Megatron TP + SP between blocks)
+    # ------------------------------------------------------------------
+    def param_specs(self) -> Dict[str, Any]:
+        rep = P()
+        ln = {"gamma": rep, "beta": rep}
+        layer = {
+            "wqkv": P(None, "model"),   # column-parallel
+            "bqkv": P("model"),
+            "wo": P("model", None),     # row-parallel
+            "bo": rep,
+            "ln1": ln,
+            "w1": P(None, "model"),
+            "b1": P("model"),
+            "w2": P("model", None),
+            "b2": rep,
+            "ln2": ln,
+        }
+        return {
+            "tok_emb": P(None, "model"),
+            "pos_emb": rep,
+            "type_emb": rep,
+            "emb_ln": ln,
+            "layers": [dict(layer) for _ in range(self.cfg.n_layers)],
+            "mlm_bias": rep,
+        }
+
+    # ------------------------------------------------------------------
+    # forward
+    # ------------------------------------------------------------------
+    def _ln(self, x, p):
+        m = jnp.mean(x, -1, keepdims=True)
+        v = jnp.var(x, -1, keepdims=True)
+        return (x - m) * lax.rsqrt(v + self.cfg.eps) * p["gamma"] + p["beta"]
+
+    def _sp(self, x, sharded: bool):
+        """Sequence-parallel constraint between blocks (token axis on
+        'model'); no-op when running unsharded."""
+        if not sharded:
+            return x
+        return lax.with_sharding_constraint(x, P("data", "model", None))
+
+    def _attn_sp(self, x, sharded: bool):
+        if not sharded:
+            return x
+        return lax.with_sharding_constraint(x, P("data", None, "model"))
+
+    def encode(self, params, ids, type_ids=None, mask=None, train=False,
+               rng=None, sharded=False):
+        """ids: [N, T] int32 -> hidden [N, T, D]."""
+        cfg = self.cfg
+        n, t = ids.shape
+        cd = self._cdtype
+        x = params["tok_emb"].astype(cd)[ids]
+        x = x + params["pos_emb"].astype(cd)[None, :t]
+        if type_ids is not None:
+            x = x + params["type_emb"].astype(cd)[type_ids]
+        x = self._ln(x, {k: v.astype(cd) for k, v in params["emb_ln"].items()})
+        x = self._sp(x, sharded)
+
+        att_mask = None
+        if mask is not None:
+            att_mask = mask[:, None, None, :]  # [N,1,1,T] key padding
+
+        keys = (jax.random.split(rng, cfg.n_layers)
+                if (train and rng is not None) else [None] * cfg.n_layers)
+        for li, lp in enumerate(params["layers"]):
+            x = self._block(x, lp, att_mask, train, keys[li], sharded)
+        return x
+
+    def _block(self, x, lp, att_mask, train, rng, sharded):
+        cfg = self.cfg
+        cd = self._cdtype
+        n, t, d = x.shape
+        h, hd = cfg.n_heads, cfg.head_dim
+
+        # attention (post-LN like BERT: LN after residual)
+        qkv = x @ lp["wqkv"].astype(cd) + lp["bqkv"].astype(cd)
+        qkv = self._attn_sp(qkv, sharded)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        def heads(y):
+            return y.reshape(n, t, h, hd).transpose(0, 2, 1, 3)
+
+        q, k, v = heads(q), heads(k), heads(v)
+        scale = 1.0 / jnp.sqrt(jnp.asarray(hd, cd))
+        logits = jnp.einsum("nhqd,nhkd->nhqk", q, k) * scale
+        if att_mask is not None:
+            neg = jnp.asarray(jnp.finfo(logits.dtype).min, logits.dtype)
+            logits = jnp.where(att_mask.astype(bool), logits, neg)
+        w = jax.nn.softmax(logits, axis=-1)
+        ctx = jnp.einsum("nhqk,nhkd->nhqd", w, v)
+        ctx = ctx.transpose(0, 2, 1, 3).reshape(n, t, d)
+        att = ctx @ lp["wo"].astype(cd) + lp["bo"].astype(cd)
+        if train and rng is not None and cfg.dropout > 0:
+            rng, sub = jax.random.split(rng)
+            keep = 1.0 - cfg.dropout
+            att = att * jax.random.bernoulli(sub, keep, att.shape) / keep
+        x = self._sp(x + att, sharded)
+        x = self._ln(x, {k2: v2.astype(cd) for k2, v2 in lp["ln1"].items()})
+
+        # MLP
+        hmid = jax.nn.gelu(x @ lp["w1"].astype(cd) + lp["b1"].astype(cd))
+        out = hmid @ lp["w2"].astype(cd) + lp["b2"].astype(cd)
+        if train and rng is not None and cfg.dropout > 0:
+            rng, sub = jax.random.split(rng)
+            keep = 1.0 - cfg.dropout
+            out = out * jax.random.bernoulli(sub, keep, out.shape) / keep
+        x = self._sp(x + out, sharded)
+        x = self._ln(x, {k2: v2.astype(cd) for k2, v2 in lp["ln2"].items()})
+        return x
+
+    def mlm_logits(self, params, hidden):
+        """Tied-embedding MLM head: hidden @ tok_emb^T + bias."""
+        return (hidden @ params["tok_emb"].astype(hidden.dtype).T
+                + params["mlm_bias"].astype(hidden.dtype))
+
+    # ------------------------------------------------------------------
+    # losses / training step
+    # ------------------------------------------------------------------
+    def mlm_loss(self, params, ids, labels, mask_positions, train=True,
+                 rng=None, sharded=False):
+        """labels: [N,T] int32 with targets; mask_positions: [N,T] 1.0
+        where the token was masked (loss only there)."""
+        hidden = self.encode(params, ids, train=train, rng=rng,
+                             sharded=sharded)
+        logits = self.mlm_logits(params, hidden).astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        tok_lp = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+        denom = jnp.maximum(jnp.sum(mask_positions), 1.0)
+        return -jnp.sum(tok_lp * mask_positions) / denom
+
+    def make_train_step(self, updater, mesh: Optional[Mesh] = None):
+        """Build the compiled train step; with a mesh, params/opt are
+        sharded per param_specs and the batch over 'data'."""
+        sharded = mesh is not None
+
+        def step(params, opt_state, it_step, ids, labels, mask_pos, rng):
+            loss, grads = jax.value_and_grad(self.mlm_loss)(
+                params, ids, labels, mask_pos, True, rng, sharded)
+            from deeplearning4j_tpu.learning.updaters import apply_updater
+
+            updates, new_opt = apply_updater(updater, opt_state, grads,
+                                             params, it_step)
+            new_params = jax.tree_util.tree_map(lambda p, u: p - u,
+                                                params, updates)
+            return new_params, new_opt, loss
+
+        if not sharded:
+            return jax.jit(step, donate_argnums=(0, 1))
+
+        specs = self.param_specs()
+        pspec = jax.tree_util.tree_map(
+            lambda s: NamedSharding(mesh, s), specs,
+            is_leaf=lambda x: isinstance(x, P))
+
+        def opt_specs(params_spec):
+            # updater state leaves parallel the params
+            template = updater.init_state(self.init_params())
+            return jax.tree_util.tree_map(
+                lambda _: params_spec, template,
+                is_leaf=lambda x: False) if False else None
+
+        dp = NamedSharding(mesh, P("data", None))
+        rep = NamedSharding(mesh, P())
+        return jax.jit(
+            step,
+            in_shardings=(pspec, None, rep, dp, dp, dp, rep),
+            donate_argnums=(0, 1),
+        )
+
+    def shard_params(self, params, mesh: Mesh):
+        specs = self.param_specs()
+        return jax.tree_util.tree_map(
+            lambda a, s: jax.device_put(a, NamedSharding(mesh, s)),
+            params, specs,
+            is_leaf=lambda x: isinstance(x, (jax.Array,)) or isinstance(x, P))
+
+    def num_params(self, params) -> int:
+        return sum(int(l.size) for l in jax.tree_util.tree_leaves(params))

@@ -1,0 +1,15 @@
+"""Eager NDArray tensor core (reference: nd4j INDArray/BaseNDArray/Nd4j).
+
+The reference implements an eager tensor API in Java backed by a C++
+kernel library, with every op crossing JNI (SURVEY.md §2.1-2.7, §3.3).
+Here the eager API is a thin typed wrapper over ``jax.Array``: single
+eager ops dispatch through XLA's eager executor, and anything on a hot
+path is expected to be traced into a jit-compiled whole step instead
+(the reference has no such fusion — that is the core design delta).
+"""
+
+from deeplearning4j_tpu.ndarray.dtypes import DataType
+from deeplearning4j_tpu.ndarray.ndarray import NDArray
+from deeplearning4j_tpu.ndarray.factory import Nd4j
+
+__all__ = ["DataType", "NDArray", "Nd4j"]

@@ -1,0 +1,84 @@
+"""Data type calculus (reference: org.nd4j.linalg.api.buffer.DataType).
+
+The reference enumerates dtypes in Java and mirrors them across JNI into
+libnd4j's ``sd::DataType``. Here dtypes are jax/numpy dtypes with a thin
+enum veneer preserving the reference's names, plus the promotion rules
+the eager API needs. TPU note: bfloat16 is first-class (MXU-native);
+float16 exists for parity but bf16 is the preferred reduced precision.
+"""
+
+from __future__ import annotations
+
+import enum
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class DataType(enum.Enum):
+    """Mirror of nd4j's DataType enum, mapped onto jax dtypes."""
+
+    DOUBLE = "float64"
+    FLOAT = "float32"
+    HALF = "float16"
+    BFLOAT16 = "bfloat16"
+    LONG = "int64"
+    INT = "int32"
+    SHORT = "int16"
+    BYTE = "int8"
+    UBYTE = "uint8"
+    UINT16 = "uint16"
+    UINT32 = "uint32"
+    UINT64 = "uint64"
+    BOOL = "bool"
+
+    @property
+    def jax(self) -> jnp.dtype:
+        return jnp.dtype(self.value)
+
+    @property
+    def np(self) -> np.dtype:
+        # bfloat16 has no numpy builtin; jnp.dtype handles the ml_dtypes ext.
+        return jnp.dtype(self.value)
+
+    def is_float(self) -> bool:
+        return self in _FLOATS
+
+    def is_int(self) -> bool:
+        return self in _INTS
+
+    def is_signed(self) -> bool:
+        return self in _SIGNED
+
+    def width_bytes(self) -> int:
+        return jnp.dtype(self.value).itemsize
+
+    @staticmethod
+    def from_any(dtype) -> "DataType":
+        """Coerce a DataType / jax dtype / numpy dtype / string to DataType."""
+        if isinstance(dtype, DataType):
+            return dtype
+        name = jnp.dtype(dtype).name
+        for dt in DataType:
+            if dt.value == name:
+                return dt
+        raise ValueError(f"Unsupported dtype: {dtype!r}")
+
+
+_FLOATS = {DataType.DOUBLE, DataType.FLOAT, DataType.HALF, DataType.BFLOAT16}
+_INTS = {
+    DataType.LONG,
+    DataType.INT,
+    DataType.SHORT,
+    DataType.BYTE,
+    DataType.UBYTE,
+    DataType.UINT16,
+    DataType.UINT32,
+    DataType.UINT64,
+}
+_SIGNED = _FLOATS | {DataType.LONG, DataType.INT, DataType.SHORT, DataType.BYTE}
+
+#: Default floating point type. The reference defaults to FLOAT (float32);
+#: we keep that for eager/correctness paths. Training configs opt into
+#: bfloat16 compute where the MXU benefits (see nn/conf dtype policy).
+DEFAULT_FLOAT = DataType.FLOAT

@@ -1,0 +1,206 @@
+"""Nd4j — the static array factory (reference: org/nd4j/linalg/factory/Nd4j.java).
+
+The reference's factory routes through a pluggable backend (nd4j-native /
+nd4j-cuda) chosen at classload. Here there is exactly one backend — XLA —
+and device placement is jax's default-device semantics; `Nd4j` is a
+namespace of constructors plus RNG state (reference: Nd4j.getRandom(),
+a Philox generator in libnd4j — ours is jax's threefry key, split per
+call so eager random calls are reproducible from the seed).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.ndarray.dtypes import DataType, DEFAULT_FLOAT
+from deeplearning4j_tpu.ndarray.ndarray import NDArray, _unwrap
+
+
+def _dt(dtype) -> jnp.dtype:
+    if dtype is None:
+        return DEFAULT_FLOAT.jax
+    return DataType.from_any(dtype).jax
+
+
+class _RandomState:
+    """Counter-split PRNG (reference: NativeRandom/Philox RNG, §2.39)."""
+
+    def __init__(self, seed: int = 0):
+        self._key = jax.random.key(seed)
+
+    def setSeed(self, seed: int):
+        self._key = jax.random.key(seed)
+
+    def next_key(self):
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+
+class Nd4j:
+    """Static factory namespace. Reference: Nd4j.java."""
+
+    _random = _RandomState(seed=0)
+
+    # -- RNG ------------------------------------------------------------
+    @staticmethod
+    def getRandom() -> _RandomState:
+        return Nd4j._random
+
+    @staticmethod
+    def setSeed(seed: int):
+        Nd4j._random.setSeed(seed)
+
+    # -- constructors ---------------------------------------------------
+    @staticmethod
+    def create(data=None, shape=None, dtype=None) -> NDArray:
+        """Nd4j.create(data[, shape]) — from nested lists/numpy, or zeros."""
+        if data is None and shape is not None:
+            return Nd4j.zeros(*shape, dtype=dtype)
+        arr = jnp.asarray(_unwrap(data), dtype=_dt(dtype) if dtype or not hasattr(data, "dtype") else None)
+        if arr.dtype == jnp.float64:
+            arr = arr.astype(_dt(dtype))
+        if shape is not None:
+            arr = arr.reshape(shape)
+        return NDArray(arr)
+
+    @staticmethod
+    def zeros(*shape, dtype=None) -> NDArray:
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        return NDArray(jnp.zeros(shape, dtype=_dt(dtype)))
+
+    @staticmethod
+    def ones(*shape, dtype=None) -> NDArray:
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        return NDArray(jnp.ones(shape, dtype=_dt(dtype)))
+
+    @staticmethod
+    def zerosLike(a) -> NDArray:
+        return NDArray(jnp.zeros_like(_unwrap(a)))
+
+    @staticmethod
+    def onesLike(a) -> NDArray:
+        return NDArray(jnp.ones_like(_unwrap(a)))
+
+    @staticmethod
+    def valueArrayOf(shape, value, dtype=None) -> NDArray:
+        if isinstance(shape, int):
+            shape = (shape,)
+        return NDArray(jnp.full(tuple(shape), value, dtype=_dt(dtype)))
+
+    @staticmethod
+    def scalar(value, dtype=None) -> NDArray:
+        return NDArray(jnp.asarray(value, dtype=_dt(dtype) if dtype else None))
+
+    @staticmethod
+    def eye(n: int, dtype=None) -> NDArray:
+        return NDArray(jnp.eye(n, dtype=_dt(dtype)))
+
+    @staticmethod
+    def arange(*args, dtype=None) -> NDArray:
+        return NDArray(jnp.arange(*args, dtype=_dt(dtype)))
+
+    @staticmethod
+    def linspace(start, stop, num, dtype=None) -> NDArray:
+        return NDArray(jnp.linspace(start, stop, num, dtype=_dt(dtype)))
+
+    # -- random constructors -------------------------------------------
+    @staticmethod
+    def rand(*shape, dtype=None) -> NDArray:
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        return NDArray(jax.random.uniform(Nd4j._random.next_key(), shape, dtype=_dt(dtype)))
+
+    @staticmethod
+    def randn(*shape, dtype=None) -> NDArray:
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        return NDArray(jax.random.normal(Nd4j._random.next_key(), shape, dtype=_dt(dtype)))
+
+    @staticmethod
+    def randint(minval, maxval, shape, dtype=DataType.INT) -> NDArray:
+        return NDArray(
+            jax.random.randint(Nd4j._random.next_key(), tuple(shape), minval, maxval, dtype=_dt(dtype))
+        )
+
+    @staticmethod
+    def bernoulli(p, shape, dtype=None) -> NDArray:
+        return NDArray(
+            jax.random.bernoulli(Nd4j._random.next_key(), p, tuple(shape)).astype(_dt(dtype))
+        )
+
+    @staticmethod
+    def shuffle(a) -> NDArray:
+        """Permute rows in place (reference: Nd4j.shuffle)."""
+        perm = jax.random.permutation(Nd4j._random.next_key(), _unwrap(a).shape[0])
+        if isinstance(a, NDArray):
+            a._buf = a._buf[perm]
+            return a
+        return NDArray(_unwrap(a)[perm])
+
+    # -- combining ------------------------------------------------------
+    @staticmethod
+    def concat(axis: int, *arrays) -> NDArray:
+        return NDArray(jnp.concatenate([_unwrap(a) for a in arrays], axis=axis))
+
+    @staticmethod
+    def hstack(*arrays) -> NDArray:
+        return NDArray(jnp.hstack([_unwrap(a) for a in arrays]))
+
+    @staticmethod
+    def vstack(*arrays) -> NDArray:
+        return NDArray(jnp.vstack([_unwrap(a) for a in arrays]))
+
+    @staticmethod
+    def stack(axis: int, *arrays) -> NDArray:
+        return NDArray(jnp.stack([_unwrap(a) for a in arrays], axis=axis))
+
+    @staticmethod
+    def pile(*arrays) -> NDArray:
+        return Nd4j.stack(0, *arrays)
+
+    @staticmethod
+    def tile(a, *reps) -> NDArray:
+        if len(reps) == 1 and isinstance(reps[0], (tuple, list)):
+            reps = tuple(reps[0])
+        return NDArray(jnp.tile(_unwrap(a), reps))
+
+    @staticmethod
+    def where(cond, x, y) -> NDArray:
+        return NDArray(jnp.where(_unwrap(cond), _unwrap(x), _unwrap(y)))
+
+    # -- linalg ---------------------------------------------------------
+    @staticmethod
+    def gemm(a, b, transposeA: bool = False, transposeB: bool = False, alpha: float = 1.0) -> NDArray:
+        A = _unwrap(a).T if transposeA else _unwrap(a)
+        B = _unwrap(b).T if transposeB else _unwrap(b)
+        return NDArray(alpha * (A @ B))
+
+    @staticmethod
+    def matmul(a, b) -> NDArray:
+        return NDArray(_unwrap(a) @ _unwrap(b))
+
+    # -- misc -----------------------------------------------------------
+    @staticmethod
+    def sort(a, axis=-1, descending: bool = False) -> NDArray:
+        out = jnp.sort(_unwrap(a), axis=axis)
+        if descending:
+            out = jnp.flip(out, axis=axis)
+        return NDArray(out)
+
+    @staticmethod
+    def argsort(a, axis=-1) -> NDArray:
+        return NDArray(jnp.argsort(_unwrap(a), axis=axis))
+
+    @staticmethod
+    def exec(op_name: str, *args, **kwargs):
+        """Execute a registered op by name (reference: Nd4j.exec(CustomOp),
+        dispatching through OpRegistrator — SURVEY.md §3.3)."""
+        from deeplearning4j_tpu.ops.registry import get_op
+
+        return get_op(op_name)(*args, **kwargs)

@@ -1,0 +1,438 @@
+"""NDArray — the eager tensor type (reference: nd4j INDArray/BaseNDArray).
+
+Reference behavior (SURVEY.md §2.1, §3.3): a mutable, view-supporting
+eager array whose every op crosses JNI into libnd4j. The TPU-native
+design instead wraps an immutable ``jax.Array`` and implements the
+reference's *mutating* API (``addi``, ``assign``, ``putScalar``, flat
+views) as **rebinding**: an in-place op computes a new functional value
+and swaps the wrapper's buffer. This is the "versioned array" approach —
+it preserves the reference's API contract (callers observe the mutation
+through the same NDArray object) without fighting XLA's functional
+model. True aliasing views are deliberately NOT replicated; code that
+needs the reference's flat-param-view trick uses pytrees + donation at
+the jit boundary instead (see nn/multilayer).
+
+Every method is eager: fine for scripting/tests, but hot loops belong
+inside jit-compiled steps (nn/, autodiff/) where XLA fuses the graph —
+the whole-step-compile design this framework exists for.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.ndarray.dtypes import DataType
+
+
+def _unwrap(x):
+    return x._buf if isinstance(x, NDArray) else x
+
+
+class NDArray:
+    """Eager n-dimensional array over ``jax.Array``.
+
+    Reference: org/nd4j/linalg/api/ndarray/INDArray.java (interface),
+    BaseNDArray.java (impl). Mutating methods rebind ``_buf``.
+    """
+
+    __slots__ = ("_buf",)
+
+    def __init__(self, buf):
+        if isinstance(buf, NDArray):
+            buf = buf._buf
+        if not isinstance(buf, (jax.Array, np.ndarray)):
+            buf = jnp.asarray(buf)
+        if isinstance(buf, np.ndarray):
+            buf = jnp.asarray(buf)
+        self._buf = buf
+
+    # ------------------------------------------------------------------
+    # structure
+    # ------------------------------------------------------------------
+    @property
+    def jax(self) -> jax.Array:
+        """The underlying immutable jax.Array (escape hatch to raw jax)."""
+        return self._buf
+
+    def shape(self) -> tuple[int, ...]:
+        return tuple(self._buf.shape)
+
+    def rank(self) -> int:
+        return self._buf.ndim
+
+    def length(self) -> int:
+        return int(self._buf.size)
+
+    def size(self, dim: int) -> int:
+        return self._buf.shape[dim]
+
+    def dataType(self) -> DataType:
+        return DataType.from_any(self._buf.dtype)
+
+    def dtype(self):
+        return self._buf.dtype
+
+    def isVector(self) -> bool:
+        return self._buf.ndim == 1 or (
+            self._buf.ndim == 2 and 1 in self._buf.shape
+        )
+
+    def isMatrix(self) -> bool:
+        return self._buf.ndim == 2
+
+    def isScalar(self) -> bool:
+        return self._buf.ndim == 0 or self._buf.size == 1
+
+    def isEmpty(self) -> bool:
+        return self._buf.size == 0
+
+    def rows(self) -> int:
+        return self._buf.shape[0]
+
+    def columns(self) -> int:
+        return self._buf.shape[1]
+
+    # ------------------------------------------------------------------
+    # conversion / copies
+    # ------------------------------------------------------------------
+    def dup(self) -> "NDArray":
+        return NDArray(jnp.array(self._buf))
+
+    def castTo(self, dtype) -> "NDArray":
+        return NDArray(self._buf.astype(DataType.from_any(dtype).jax))
+
+    def toNumpy(self) -> np.ndarray:
+        return np.asarray(self._buf)
+
+    def ravel(self) -> "NDArray":
+        return NDArray(self._buf.ravel())
+
+    def flatten(self) -> "NDArray":
+        return NDArray(self._buf.ravel())
+
+    def reshape(self, *shape) -> "NDArray":
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        return NDArray(self._buf.reshape(shape))
+
+    def transpose(self, *axes) -> "NDArray":
+        if not axes:
+            return NDArray(self._buf.T)
+        if len(axes) == 1 and isinstance(axes[0], (tuple, list)):
+            axes = tuple(axes[0])
+        return NDArray(jnp.transpose(self._buf, axes))
+
+    def permute(self, *axes) -> "NDArray":
+        return self.transpose(*axes)
+
+    def broadcast(self, *shape) -> "NDArray":
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        return NDArray(jnp.broadcast_to(self._buf, shape))
+
+    def repeat(self, repeats, axis=None) -> "NDArray":
+        return NDArray(jnp.repeat(self._buf, repeats, axis=axis))
+
+    def swapAxes(self, a: int, b: int) -> "NDArray":
+        return NDArray(jnp.swapaxes(self._buf, a, b))
+
+    # ------------------------------------------------------------------
+    # mutation-by-rebind (reference: in-place INDArray ops)
+    # ------------------------------------------------------------------
+    def assign(self, other) -> "NDArray":
+        v = _unwrap(other)
+        self._buf = jnp.broadcast_to(jnp.asarray(v, dtype=self._buf.dtype), self._buf.shape)
+        return self
+
+    def putScalar(self, idx, value) -> "NDArray":
+        if isinstance(idx, int):
+            idx = np.unravel_index(idx, self._buf.shape)
+        self._buf = self._buf.at[tuple(idx)].set(value)
+        return self
+
+    def put(self, idx, value) -> "NDArray":
+        self._buf = self._buf.at[idx].set(_unwrap(value))
+        return self
+
+    def getDouble(self, *idx) -> float:
+        if len(idx) == 1 and isinstance(idx[0], int) and self._buf.ndim != 1:
+            idx = np.unravel_index(idx[0], self._buf.shape)
+        else:
+            idx = tuple(idx)
+        return float(self._buf[idx])
+
+    def getInt(self, *idx) -> int:
+        return int(self.getDouble(*idx))
+
+    # ------------------------------------------------------------------
+    # arithmetic — functional variants return new arrays; `i` variants
+    # rebind self (reference: add/addi, sub/subi, ... INDArray.java)
+    # ------------------------------------------------------------------
+    def add(self, other) -> "NDArray":
+        return NDArray(self._buf + _unwrap(other))
+
+    def addi(self, other) -> "NDArray":
+        self._buf = self._buf + _unwrap(other)
+        return self
+
+    def sub(self, other) -> "NDArray":
+        return NDArray(self._buf - _unwrap(other))
+
+    def subi(self, other) -> "NDArray":
+        self._buf = self._buf - _unwrap(other)
+        return self
+
+    def rsub(self, other) -> "NDArray":
+        return NDArray(_unwrap(other) - self._buf)
+
+    def rsubi(self, other) -> "NDArray":
+        self._buf = _unwrap(other) - self._buf
+        return self
+
+    def mul(self, other) -> "NDArray":
+        return NDArray(self._buf * _unwrap(other))
+
+    def muli(self, other) -> "NDArray":
+        self._buf = self._buf * _unwrap(other)
+        return self
+
+    def div(self, other) -> "NDArray":
+        return NDArray(self._buf / _unwrap(other))
+
+    def divi(self, other) -> "NDArray":
+        self._buf = self._buf / _unwrap(other)
+        return self
+
+    def rdiv(self, other) -> "NDArray":
+        return NDArray(_unwrap(other) / self._buf)
+
+    def rdivi(self, other) -> "NDArray":
+        self._buf = _unwrap(other) / self._buf
+        return self
+
+    def neg(self) -> "NDArray":
+        return NDArray(-self._buf)
+
+    def negi(self) -> "NDArray":
+        self._buf = -self._buf
+        return self
+
+    def fmod(self, other) -> "NDArray":
+        return NDArray(jnp.fmod(self._buf, _unwrap(other)))
+
+    # broadcast-along-dimension ops (reference: addRowVector etc.)
+    def addRowVector(self, row) -> "NDArray":
+        return NDArray(self._buf + _unwrap(row).reshape(1, -1))
+
+    def addColumnVector(self, col) -> "NDArray":
+        return NDArray(self._buf + _unwrap(col).reshape(-1, 1))
+
+    def mulRowVector(self, row) -> "NDArray":
+        return NDArray(self._buf * _unwrap(row).reshape(1, -1))
+
+    def mulColumnVector(self, col) -> "NDArray":
+        return NDArray(self._buf * _unwrap(col).reshape(-1, 1))
+
+    def subRowVector(self, row) -> "NDArray":
+        return NDArray(self._buf - _unwrap(row).reshape(1, -1))
+
+    def divRowVector(self, row) -> "NDArray":
+        return NDArray(self._buf / _unwrap(row).reshape(1, -1))
+
+    # ------------------------------------------------------------------
+    # linear algebra
+    # ------------------------------------------------------------------
+    def mmul(self, other) -> "NDArray":
+        """Matrix multiply (MXU path under jit; reference MmulHelper)."""
+        return NDArray(self._buf @ _unwrap(other))
+
+    def mmuli(self, other) -> "NDArray":
+        self._buf = self._buf @ _unwrap(other)
+        return self
+
+    def tensorMmul(self, other, axes) -> "NDArray":
+        return NDArray(jnp.tensordot(self._buf, _unwrap(other), axes=axes))
+
+    def dot(self, other) -> float:
+        return float(jnp.vdot(self._buf, _unwrap(other)))
+
+    # ------------------------------------------------------------------
+    # reductions (reference: execReduce* legacy loops in libnd4j)
+    # ------------------------------------------------------------------
+    def _reduce(self, fn, dims, keepdims=False):
+        axis = None if not dims else (dims if len(dims) > 1 else dims[0])
+        out = fn(self._buf, axis=axis, keepdims=keepdims)
+        return NDArray(out) if isinstance(out, jax.Array) and out.ndim > 0 else NDArray(jnp.asarray(out))
+
+    def sum(self, *dims, keepdims=False):
+        r = self._reduce(jnp.sum, dims, keepdims)
+        return r if dims or keepdims else r.item()
+
+    def mean(self, *dims, keepdims=False):
+        r = self._reduce(jnp.mean, dims, keepdims)
+        return r if dims or keepdims else r.item()
+
+    def max(self, *dims, keepdims=False):
+        r = self._reduce(jnp.max, dims, keepdims)
+        return r if dims or keepdims else r.item()
+
+    def min(self, *dims, keepdims=False):
+        r = self._reduce(jnp.min, dims, keepdims)
+        return r if dims or keepdims else r.item()
+
+    def prod(self, *dims, keepdims=False):
+        r = self._reduce(jnp.prod, dims, keepdims)
+        return r if dims or keepdims else r.item()
+
+    def std(self, *dims, ddof: int = 1):
+        out = jnp.std(self._buf, axis=(dims if dims else None), ddof=ddof)
+        return NDArray(out) if dims else float(out)
+
+    def var(self, *dims, ddof: int = 1):
+        out = jnp.var(self._buf, axis=(dims if dims else None), ddof=ddof)
+        return NDArray(out) if dims else float(out)
+
+    def argMax(self, *dims):
+        if not dims:
+            return int(jnp.argmax(self._buf))
+        return NDArray(jnp.argmax(self._buf, axis=dims[0]))
+
+    def argMin(self, *dims):
+        if not dims:
+            return int(jnp.argmin(self._buf))
+        return NDArray(jnp.argmin(self._buf, axis=dims[0]))
+
+    def cumsum(self, axis=None) -> "NDArray":
+        return NDArray(jnp.cumsum(self._buf, axis=axis))
+
+    def norm1(self, *dims):
+        r = self._reduce(lambda a, axis, keepdims: jnp.sum(jnp.abs(a), axis=axis, keepdims=keepdims), dims)
+        return r if dims else r.item()
+
+    def norm2(self, *dims):
+        r = self._reduce(lambda a, axis, keepdims: jnp.sqrt(jnp.sum(a * a, axis=axis, keepdims=keepdims)), dims)
+        return r if dims else r.item()
+
+    def normMax(self, *dims):
+        r = self._reduce(lambda a, axis, keepdims: jnp.max(jnp.abs(a), axis=axis, keepdims=keepdims), dims)
+        return r if dims else r.item()
+
+    def item(self):
+        v = self._buf
+        if v.dtype == jnp.bool_:
+            return bool(v)
+        if jnp.issubdtype(v.dtype, jnp.integer):
+            return int(v)
+        return float(v)
+
+    # ------------------------------------------------------------------
+    # comparisons (return NDArray of bool / used as masks)
+    # ------------------------------------------------------------------
+    def gt(self, other) -> "NDArray":
+        return NDArray(self._buf > _unwrap(other))
+
+    def gte(self, other) -> "NDArray":
+        return NDArray(self._buf >= _unwrap(other))
+
+    def lt(self, other) -> "NDArray":
+        return NDArray(self._buf < _unwrap(other))
+
+    def lte(self, other) -> "NDArray":
+        return NDArray(self._buf <= _unwrap(other))
+
+    def eq(self, other) -> "NDArray":
+        return NDArray(self._buf == _unwrap(other))
+
+    def neq(self, other) -> "NDArray":
+        return NDArray(self._buf != _unwrap(other))
+
+    def equalsWithEps(self, other, eps: float = 1e-5) -> bool:
+        o = _unwrap(other)
+        if tuple(o.shape) != tuple(self._buf.shape):
+            return False
+        return bool(jnp.all(jnp.abs(self._buf - o) <= eps))
+
+    def equals(self, other) -> bool:
+        return self.equalsWithEps(other, 1e-5)
+
+    # ------------------------------------------------------------------
+    # python protocol
+    # ------------------------------------------------------------------
+    def __getitem__(self, idx) -> "NDArray":
+        if isinstance(idx, NDArray):
+            idx = idx._buf
+        return NDArray(self._buf[idx])
+
+    def __setitem__(self, idx, value):
+        if isinstance(idx, NDArray):
+            idx = idx._buf
+        self._buf = self._buf.at[idx].set(_unwrap(value))
+
+    def __add__(self, other):
+        return self.add(other)
+
+    __radd__ = __add__
+
+    def __sub__(self, other):
+        return self.sub(other)
+
+    def __rsub__(self, other):
+        return self.rsub(other)
+
+    def __mul__(self, other):
+        return self.mul(other)
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, other):
+        return self.div(other)
+
+    def __rtruediv__(self, other):
+        return self.rdiv(other)
+
+    def __matmul__(self, other):
+        return self.mmul(other)
+
+    def __neg__(self):
+        return self.neg()
+
+    def __pow__(self, p):
+        return NDArray(self._buf ** _unwrap(p))
+
+    def __len__(self):
+        return self._buf.shape[0]
+
+    def __iter__(self):
+        for i in range(self._buf.shape[0]):
+            yield NDArray(self._buf[i])
+
+    def __float__(self):
+        return float(self._buf)
+
+    def __int__(self):
+        return int(self._buf)
+
+    def __bool__(self):
+        return bool(self._buf)
+
+    def __array__(self, dtype=None):
+        a = np.asarray(self._buf)
+        return a.astype(dtype) if dtype is not None else a
+
+    def __repr__(self):
+        return f"NDArray{self.shape()}:{self._buf.dtype.name}\n{np.asarray(self._buf)!r}"
+
+    def __jax_array__(self):
+        return self._buf
+
+
+# Register NDArray as a pytree so it can flow through jit/grad transparently.
+jax.tree_util.register_pytree_node(
+    NDArray,
+    lambda a: ((a._buf,), None),
+    lambda _, children: NDArray(children[0]),
+)

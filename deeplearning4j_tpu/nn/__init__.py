@@ -1,0 +1,2 @@
+"""Neural network framework (reference: deeplearning4j/deeplearning4j-nn —
+config system, layers, MultiLayerNetwork, ComputationGraph)."""

@@ -1,0 +1,27 @@
+"""Network configuration system (reference: org/deeplearning4j/nn/conf/**
+— NeuralNetConfiguration, MultiLayerConfiguration, layer confs, input
+types/preprocessors, with guaranteed JSON round-trip. SURVEY.md §2.18).
+"""
+
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import (
+    ActivationLayer, BatchNormalization, ConvolutionLayer, DenseLayer,
+    DropoutLayer, EmbeddingLayer, GlobalPoolingLayer, GravesLSTM, LSTM,
+    Layer, LossLayer, OutputLayer, PoolingType, RnnOutputLayer,
+    SubsamplingLayer, SeparableConvolution2D, Upsampling2D, ZeroPaddingLayer,
+    LayerNormalization, SelfAttentionLayer, LocalResponseNormalization,
+)
+from deeplearning4j_tpu.nn.conf.builder import (
+    MultiLayerConfiguration, NeuralNetConfiguration,
+)
+
+__all__ = [
+    "InputType", "Layer", "DenseLayer", "ConvolutionLayer",
+    "SubsamplingLayer", "BatchNormalization", "OutputLayer", "LossLayer",
+    "DropoutLayer", "ActivationLayer", "EmbeddingLayer",
+    "GlobalPoolingLayer", "LSTM", "GravesLSTM", "RnnOutputLayer",
+    "PoolingType", "SeparableConvolution2D", "Upsampling2D",
+    "ZeroPaddingLayer", "LayerNormalization", "SelfAttentionLayer",
+    "LocalResponseNormalization",
+    "MultiLayerConfiguration", "NeuralNetConfiguration",
+]

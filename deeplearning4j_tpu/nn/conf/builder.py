@@ -1,0 +1,241 @@
+"""NeuralNetConfiguration / MultiLayerConfiguration builders.
+
+Reference: org/deeplearning4j/nn/conf/NeuralNetConfiguration.java
+(Builder + ListBuilder) and MultiLayerConfiguration.java — fluent
+builder, global defaults cloned into layers, `setInputType` driving nIn
+inference and automatic InputPreProcessor insertion, and a guaranteed
+JSON round-trip (SURVEY.md §2.18).
+
+Differences by design: preprocessors are tagged strings (pure reshapes
+resolved at trace time), and the canonical image layout is NHWC.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+from deeplearning4j_tpu.common import serde
+from deeplearning4j_tpu.common.serde import serializable
+from deeplearning4j_tpu.learning.updaters import IUpdater, Sgd
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import (
+    ConvolutionLayer, DenseLayer, EmbeddingLayer, Layer, LSTM, SimpleRnn,
+    SubsamplingLayer, SelfAttentionLayer, Upsampling2D, ZeroPaddingLayer,
+    LocalResponseNormalization, GravesLSTM, RnnOutputLayer,
+)
+
+
+@serializable
+@dataclasses.dataclass
+class MultiLayerConfiguration:
+    """Built, fully-resolved network config (all nIn known, preprocessors
+    placed). Reference: MultiLayerConfiguration.java."""
+
+    layers: List[Any] = dataclasses.field(default_factory=list)
+    seed: int = 12345
+    updater: Any = dataclasses.field(default_factory=lambda: Sgd())
+    weight_init: str = "xavier"
+    l1: float = 0.0
+    l2: float = 0.0
+    dtype: str = "float32"
+    input_type: Optional[InputType] = None
+    #: layer index -> preprocessor tag ("flatten" | "to_conv:H,W,C")
+    preprocessors: Dict = dataclasses.field(default_factory=dict)
+    gradient_normalization: Optional[str] = None
+    gradient_normalization_threshold: float = 1.0
+    tbptt_fwd_length: int = 0
+    tbptt_back_length: int = 0
+
+    def to_json(self) -> str:
+        return serde.to_json(self)
+
+    @staticmethod
+    def from_json(s: str) -> "MultiLayerConfiguration":
+        cfg = serde.from_json(s)
+        cfg.preprocessors = {int(k): v for k, v in cfg.preprocessors.items()}
+        return cfg
+
+    def __post_init__(self):
+        self.preprocessors = {int(k): v for k, v in self.preprocessors.items()}
+
+
+class NeuralNetConfiguration:
+    """Entry point: NeuralNetConfiguration.builder()... (reference API)."""
+
+    @staticmethod
+    def builder() -> "Builder":
+        return Builder()
+
+
+class Builder:
+    def __init__(self):
+        self._seed = 12345
+        self._updater: IUpdater = Sgd()
+        self._weight_init = "xavier"
+        self._l1 = 0.0
+        self._l2 = 0.0
+        self._dtype = "float32"
+        self._dropout = None
+        self._activation = None
+        self._grad_norm = None
+        self._grad_norm_threshold = 1.0
+
+    # fluent setters (reference naming kept, camelCase)
+    def seed(self, s: int) -> "Builder":
+        self._seed = int(s)
+        return self
+
+    def updater(self, u: IUpdater) -> "Builder":
+        self._updater = u
+        return self
+
+    def weightInit(self, w) -> "Builder":
+        self._weight_init = w.value if hasattr(w, "value") else str(w)
+        return self
+
+    def activation(self, a) -> "Builder":
+        self._activation = a.value if hasattr(a, "value") else str(a)
+        return self
+
+    def l1(self, v: float) -> "Builder":
+        self._l1 = float(v)
+        return self
+
+    def l2(self, v: float) -> "Builder":
+        self._l2 = float(v)
+        return self
+
+    def dataType(self, dt) -> "Builder":
+        self._dtype = dt.value if hasattr(dt, "value") else str(dt)
+        return self
+
+    def dropOut(self, keep: float) -> "Builder":
+        # reference semantics: dropOut(x) with x = retain probability.
+        # We store DROP rate to match our ops; convert here.
+        self._dropout = 1.0 - float(keep) if keep > 0 else None
+        return self
+
+    def gradientNormalization(self, mode: str, threshold: float = 1.0) -> "Builder":
+        self._grad_norm = mode
+        self._grad_norm_threshold = threshold
+        return self
+
+    def list(self) -> "ListBuilder":
+        return ListBuilder(self)
+
+
+class ListBuilder:
+    """reference: NeuralNetConfiguration.ListBuilder."""
+
+    def __init__(self, parent: Builder):
+        self._p = parent
+        self._layers: List[Layer] = []
+        self._input_type: Optional[InputType] = None
+
+    def layer(self, *args) -> "ListBuilder":
+        """layer(conf) or layer(index, conf) — both reference forms."""
+        conf = args[-1]
+        self._layers.append(conf)
+        return self
+
+    def setInputType(self, it: InputType) -> "ListBuilder":
+        self._input_type = it
+        return self
+
+    def inputType(self, it: InputType) -> "ListBuilder":
+        return self.setInputType(it)
+
+    def build(self) -> MultiLayerConfiguration:
+        """Resolve defaults, infer nIn per layer, insert preprocessors.
+
+        Mirrors MultiLayerConfiguration#build + setInputType logic:
+        walk layers tracking the current InputType; when a layer needs a
+        different representation, record a reshape preprocessor.
+        """
+        p = self._p
+        layers = self._layers
+        if not layers:
+            raise ValueError("No layers added")
+        preprocessors: Dict[int, str] = {}
+        it = self._input_type
+
+        for i, layer in enumerate(layers):
+            # inherit global defaults (reference: config cloning)
+            if layer.activation is None and p._activation is not None:
+                layer.activation = p._activation
+            if layer.weight_init is None:
+                layer.weight_init = p._weight_init
+            if layer.l1 is None:
+                layer.l1 = p._l1
+            if layer.l2 is None:
+                layer.l2 = p._l2
+            if layer.dropout is None and p._dropout is not None:
+                layer.dropout = p._dropout
+
+            if it is None:
+                continue  # no shape inference possible; user set n_in
+
+            # representation changes -> preprocessors
+            if isinstance(layer, (ConvolutionLayer, SubsamplingLayer,
+                                  Upsampling2D, ZeroPaddingLayer,
+                                  LocalResponseNormalization)) \
+                    and not isinstance(layer, DenseLayer):
+                if it.kind == "convolutionalFlat":
+                    preprocessors[i] = f"to_conv:{it.height},{it.width},{it.channels}"
+                    it = InputType.convolutional(it.height, it.width, it.channels)
+                elif it.kind != "convolutional":
+                    raise ValueError(
+                        f"Layer {i} ({type(layer).__name__}) needs image input, got {it.kind}")
+            elif isinstance(layer, (LSTM, SimpleRnn, SelfAttentionLayer, GravesLSTM)) \
+                    or isinstance(layer, RnnOutputLayer):
+                if it.kind not in ("recurrent",):
+                    raise ValueError(
+                        f"Layer {i} ({type(layer).__name__}) needs recurrent input, got {it.kind}")
+            elif isinstance(layer, DenseLayer):  # includes OutputLayer
+                if it.kind in ("convolutional",):
+                    preprocessors[i] = "flatten"
+                    it = InputType.feedForward(it.height * it.width * it.channels)
+                elif it.kind == "convolutionalFlat":
+                    it = InputType.feedForward(it.flat_size())
+
+            # nIn inference
+            if hasattr(layer, "n_in") and getattr(layer, "n_in", 0) in (0, None) \
+                    and not isinstance(layer, EmbeddingLayer):
+                if it.kind == "convolutional":
+                    layer.n_in = it.channels
+                elif it.kind == "recurrent":
+                    layer.n_in = it.size
+                else:
+                    layer.n_in = it.size
+            # attention n_out default
+            if isinstance(layer, SelfAttentionLayer) and layer.n_out == 0:
+                layer.n_out = layer.n_in
+
+            it = layer.output_type(it)
+
+        return MultiLayerConfiguration(
+            layers=layers,
+            seed=p._seed,
+            updater=p._updater,
+            weight_init=p._weight_init,
+            l1=p._l1,
+            l2=p._l2,
+            dtype=p._dtype,
+            input_type=self._input_type,
+            preprocessors=preprocessors,
+            gradient_normalization=p._grad_norm,
+            gradient_normalization_threshold=p._grad_norm_threshold,
+        )
+
+
+def apply_preprocessor(tag: str, x):
+    """Resolve a preprocessor tag to a reshape (trace-time, free on TPU)."""
+    if tag == "flatten":
+        return x.reshape(x.shape[0], -1)
+    if tag.startswith("to_conv:"):
+        h, w, c = (int(v) for v in tag.split(":", 1)[1].split(","))
+        return x.reshape(x.shape[0], h, w, c)
+    if tag == "to_rnn":
+        return x
+    raise ValueError(f"Unknown preprocessor: {tag}")

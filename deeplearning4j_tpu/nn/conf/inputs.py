@@ -1,0 +1,73 @@
+"""Input types (reference: org/deeplearning4j/nn/conf/inputs/InputType
+and InputPreProcessor machinery).
+
+The reference's `setInputType` walks the layer list, infers each layer's
+nIn, and inserts preprocessors (e.g. CnnToFeedForwardPreProcessor) at
+representation changes. We keep the same mechanism but the canonical
+image layout is **NHWC** (TPU/XLA-preferred; reference uses NCHW) —
+`convolutionalFlat` reshapes flat vectors to NHWC.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+from deeplearning4j_tpu.common.serde import serializable
+
+
+@serializable
+@dataclasses.dataclass
+class InputType:
+    """Tagged union: kind in {feedforward, recurrent, convolutional,
+    convolutionalFlat}. Shapes exclude the batch dimension."""
+
+    kind: str = "feedforward"
+    size: int = 0           # feedforward width / recurrent feature size
+    height: int = 0
+    width: int = 0
+    channels: int = 0
+    timeseries_length: int = -1  # -1 = variable
+
+    # -- constructors mirroring the reference's static methods ---------
+    @staticmethod
+    def feedForward(size: int) -> "InputType":
+        return InputType(kind="feedforward", size=size)
+
+    @staticmethod
+    def recurrent(size: int, timeseries_length: int = -1) -> "InputType":
+        return InputType(kind="recurrent", size=size,
+                         timeseries_length=timeseries_length)
+
+    @staticmethod
+    def convolutional(height: int, width: int, channels: int) -> "InputType":
+        return InputType(kind="convolutional", height=height, width=width,
+                         channels=channels)
+
+    @staticmethod
+    def convolutionalFlat(height: int, width: int, channels: int) -> "InputType":
+        return InputType(kind="convolutionalFlat", height=height, width=width,
+                         channels=channels)
+
+    # -- geometry -------------------------------------------------------
+    def arrayElementsPerExample(self) -> int:
+        if self.kind == "feedforward":
+            return self.size
+        if self.kind == "recurrent":
+            return self.size * max(self.timeseries_length, 1)
+        return self.height * self.width * self.channels
+
+    def example_shape(self) -> Tuple[int, ...]:
+        """Per-example array shape in canonical layout (NHWC images)."""
+        if self.kind == "feedforward":
+            return (self.size,)
+        if self.kind == "recurrent":
+            return (max(self.timeseries_length, 1), self.size)
+        if self.kind == "convolutional":
+            return (self.height, self.width, self.channels)
+        if self.kind == "convolutionalFlat":
+            return (self.height * self.width * self.channels,)
+        raise ValueError(self.kind)
+
+    def flat_size(self) -> int:
+        return self.arrayElementsPerExample()

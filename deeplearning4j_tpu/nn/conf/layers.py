@@ -1,0 +1,611 @@
+"""Layer configurations + functional implementations.
+
+Reference: org/deeplearning4j/nn/conf/layers/** (configs) and
+org/deeplearning4j/nn/layers/** (impls) — SURVEY.md §2.18/§2.20. The
+reference splits config (Jackson beans) from impl (stateful Layer
+objects holding INDArray params); the TPU-native design fuses them: a
+layer IS a serializable dataclass with pure functions
+
+    init_params(key, input_type, dtype)      -> param dict
+    init_state(input_type, dtype)            -> non-trainable state dict
+    apply(params, state, x, train, rng)      -> (out, new_state)
+
+so the whole network forward is a pure function jit-compiled as ONE XLA
+program per step (replacing the reference's per-layer, per-op JNI hot
+loop — SURVEY.md §3.1). Canonical layouts: images NHWC, sequences NTF.
+
+Param names follow the reference (W, b, gamma/beta/mean/var for BN,
+RW for recurrent weights) so checkpoints read naturally.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.activations import Activation
+from deeplearning4j_tpu.common.serde import serializable, _tuplify
+from deeplearning4j_tpu.loss import LossFunction, compute_loss
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.weights import WeightInit, init_weights
+from deeplearning4j_tpu.ops import nn as nnops
+
+
+class PoolingType(enum.Enum):
+    MAX = "max"
+    AVG = "avg"
+    SUM = "sum"
+    PNORM = "pnorm"
+
+
+def _act(a) -> Activation:
+    return Activation.resolve(a)
+
+
+@dataclasses.dataclass
+class Layer:
+    """Base layer config. Fields set to None inherit network defaults
+    (reference: NeuralNetConfiguration 'global config' cloning)."""
+
+    name: Optional[str] = None
+    activation: Optional[str] = None
+    weight_init: Optional[str] = None
+    updater: Optional[Any] = None        # per-layer updater override
+    l1: Optional[float] = None
+    l2: Optional[float] = None
+    dropout: Optional[float] = None      # input dropout for this layer
+
+    # -- to be overridden ----------------------------------------------
+    def output_type(self, input_type: InputType) -> InputType:
+        return input_type
+
+    def init_params(self, key, input_type: InputType, dtype) -> dict:
+        return {}
+
+    def init_state(self, input_type: InputType, dtype) -> dict:
+        return {}
+
+    def apply(self, params, state, x, train: bool, rng):
+        raise NotImplementedError
+
+    # -- shared helpers -------------------------------------------------
+    def _maybe_dropout(self, x, train, rng):
+        if train and self.dropout and rng is not None:
+            return nnops.dropout(x, self.dropout, rng)
+        return x
+
+    def has_params(self) -> bool:
+        return True
+
+
+# ----------------------------------------------------------------------
+# feed-forward layers
+# ----------------------------------------------------------------------
+@serializable
+@dataclasses.dataclass
+class DenseLayer(Layer):
+    """Fully connected (reference: conf/layers/DenseLayer + impl
+    BaseLayer#preOutput: z = xW + b). Applies over the last axis, so it
+    is time-distributed over [N,T,F] input automatically."""
+
+    n_in: int = 0
+    n_out: int = 0
+    has_bias: bool = True
+
+    def output_type(self, it: InputType) -> InputType:
+        if it.kind == "recurrent":
+            return InputType.recurrent(self.n_out, it.timeseries_length)
+        return InputType.feedForward(self.n_out)
+
+    def init_params(self, key, it: InputType, dtype) -> dict:
+        w = init_weights(self.weight_init or WeightInit.XAVIER, key,
+                         (self.n_in, self.n_out), self.n_in, self.n_out, dtype)
+        p = {"W": w}
+        if self.has_bias:
+            p["b"] = jnp.zeros((self.n_out,), dtype)
+        return p
+
+    def apply(self, params, state, x, train, rng):
+        x = self._maybe_dropout(x, train, rng)
+        z = x @ params["W"]
+        if self.has_bias:
+            z = z + params["b"]
+        return _act(self.activation or "identity").fn(z), state
+
+
+@serializable
+@dataclasses.dataclass
+class OutputLayer(DenseLayer):
+    """Dense + loss head (reference: conf/layers/OutputLayer).
+
+    `loss_value` computes the masked mean loss from PRE-activations so
+    the fused softmax+CE path is used (numerically stable on TPU)."""
+
+    loss: str = "mcxent"
+
+    def loss_value(self, params, state, x, labels, mask=None):
+        z = x @ params["W"]
+        if self.has_bias:
+            z = z + params["b"]
+        return compute_loss(LossFunction.resolve(self.loss), labels, z,
+                            self.activation or "softmax", mask)
+
+    def apply(self, params, state, x, train, rng):
+        x = self._maybe_dropout(x, train, rng)
+        z = x @ params["W"]
+        if self.has_bias:
+            z = z + params["b"]
+        return _act(self.activation or "softmax").fn(z), state
+
+
+@serializable
+@dataclasses.dataclass
+class LossLayer(Layer):
+    """Parameterless loss head (reference: conf/layers/LossLayer)."""
+
+    loss: str = "mse"
+
+    def has_params(self):
+        return False
+
+    def loss_value(self, params, state, x, labels, mask=None):
+        return compute_loss(LossFunction.resolve(self.loss), labels, x,
+                            self.activation or "identity", mask)
+
+    def apply(self, params, state, x, train, rng):
+        return _act(self.activation or "identity").fn(x), state
+
+
+@serializable
+@dataclasses.dataclass
+class ActivationLayer(Layer):
+    def has_params(self):
+        return False
+
+    def apply(self, params, state, x, train, rng):
+        return _act(self.activation or "identity").fn(x), state
+
+
+@serializable
+@dataclasses.dataclass
+class DropoutLayer(Layer):
+    """Standalone dropout (reference: conf/layers/DropoutLayer)."""
+
+    rate: float = 0.5
+
+    def has_params(self):
+        return False
+
+    def apply(self, params, state, x, train, rng):
+        if train and rng is not None:
+            return nnops.dropout(x, self.rate, rng), state
+        return x, state
+
+
+@serializable
+@dataclasses.dataclass
+class EmbeddingLayer(Layer):
+    """Index -> vector lookup (reference: EmbeddingLayer /
+    EmbeddingSequenceLayer; one-hot matmul in the reference, gather here).
+    Accepts [N] or [N,T] int input; emits [N,n_out] or [N,T,n_out]."""
+
+    n_in: int = 0     # vocab size
+    n_out: int = 0
+
+    def output_type(self, it: InputType) -> InputType:
+        if it.kind == "recurrent":
+            return InputType.recurrent(self.n_out, it.timeseries_length)
+        return InputType.feedForward(self.n_out)
+
+    def init_params(self, key, it, dtype) -> dict:
+        w = init_weights(self.weight_init or WeightInit.XAVIER, key,
+                         (self.n_in, self.n_out), self.n_in, self.n_out, dtype)
+        return {"W": w}
+
+    def apply(self, params, state, x, train, rng):
+        ids = x.astype(jnp.int32)
+        if ids.ndim > 1 and ids.shape[-1] == 1:
+            ids = ids[..., 0]
+        out = jnp.take(params["W"], ids, axis=0)
+        return _act(self.activation or "identity").fn(out), state
+
+
+# ----------------------------------------------------------------------
+# convolutional layers
+# ----------------------------------------------------------------------
+def _conv_out(size, k, s, mode, pad, dilation=1):
+    if mode == "Same":
+        return -(-size // s)
+    k_eff = (k - 1) * dilation + 1  # dilated (atrous) effective kernel
+    return (size - k_eff + 2 * pad) // s + 1
+
+
+@serializable
+@dataclasses.dataclass
+class ConvolutionLayer(Layer):
+    """2D conv (reference: conf/layers/ConvolutionLayer; impl dispatches
+    to CudnnConvolutionHelper — here XLA's MXU conv IS the fast path).
+
+    convolution_mode: 'Same' | 'Truncate' (reference ConvolutionMode;
+    Truncate = VALID with explicit padding)."""
+
+    n_in: int = 0
+    n_out: int = 0
+    kernel_size: Tuple[int, int] = (3, 3)
+    stride: Tuple[int, int] = (1, 1)
+    padding: Tuple[int, int] = (0, 0)
+    convolution_mode: str = "Truncate"
+    dilation: Tuple[int, int] = (1, 1)
+    has_bias: bool = True
+
+    def __post_init__(self):
+        self.kernel_size = _tuplify(self.kernel_size)
+        self.stride = _tuplify(self.stride)
+        self.padding = _tuplify(self.padding)
+        self.dilation = _tuplify(self.dilation)
+
+    def output_type(self, it: InputType) -> InputType:
+        h = _conv_out(it.height, self.kernel_size[0], self.stride[0],
+                      self.convolution_mode, self.padding[0], self.dilation[0])
+        w = _conv_out(it.width, self.kernel_size[1], self.stride[1],
+                      self.convolution_mode, self.padding[1], self.dilation[1])
+        return InputType.convolutional(h, w, self.n_out)
+
+    def _pad_arg(self):
+        if self.convolution_mode == "Same":
+            return "SAME"
+        return self.padding
+
+    def init_params(self, key, it, dtype) -> dict:
+        kh, kw = self.kernel_size
+        fan_in = kh * kw * self.n_in
+        fan_out = kh * kw * self.n_out
+        w = init_weights(self.weight_init or WeightInit.XAVIER, key,
+                         (kh, kw, self.n_in, self.n_out), fan_in, fan_out, dtype)
+        p = {"W": w}
+        if self.has_bias:
+            p["b"] = jnp.zeros((self.n_out,), dtype)
+        return p
+
+    def apply(self, params, state, x, train, rng):
+        x = self._maybe_dropout(x, train, rng)
+        out = nnops.conv2d(x, params["W"], params.get("b"),
+                           strides=self.stride, padding=self._pad_arg(),
+                           dilation=self.dilation)
+        return _act(self.activation or "identity").fn(out), state
+
+
+@serializable
+@dataclasses.dataclass
+class SeparableConvolution2D(ConvolutionLayer):
+    depth_multiplier: int = 1
+
+    def init_params(self, key, it, dtype) -> dict:
+        kh, kw = self.kernel_size
+        k1, k2 = jax.random.split(key)
+        dw = init_weights(self.weight_init or WeightInit.XAVIER, k1,
+                          (kh, kw, self.n_in, self.depth_multiplier),
+                          kh * kw * self.n_in, kh * kw * self.n_in, dtype)
+        pw = init_weights(self.weight_init or WeightInit.XAVIER, k2,
+                          (1, 1, self.n_in * self.depth_multiplier, self.n_out),
+                          self.n_in * self.depth_multiplier, self.n_out, dtype)
+        p = {"dW": dw, "pW": pw}
+        if self.has_bias:
+            p["b"] = jnp.zeros((self.n_out,), dtype)
+        return p
+
+    def apply(self, params, state, x, train, rng):
+        x = self._maybe_dropout(x, train, rng)
+        out = nnops.separable_conv2d(x, params["dW"], params["pW"],
+                                     params.get("b"), strides=self.stride,
+                                     padding=self._pad_arg() if self.convolution_mode == "Same" else self.padding)
+        return _act(self.activation or "identity").fn(out), state
+
+
+@serializable
+@dataclasses.dataclass
+class SubsamplingLayer(Layer):
+    """Pooling (reference: conf/layers/SubsamplingLayer)."""
+
+    pooling_type: str = "max"
+    kernel_size: Tuple[int, int] = (2, 2)
+    stride: Tuple[int, int] = (2, 2)
+    padding: Tuple[int, int] = (0, 0)
+    convolution_mode: str = "Truncate"
+    pnorm: int = 2
+
+    def __post_init__(self):
+        self.kernel_size = _tuplify(self.kernel_size)
+        self.stride = _tuplify(self.stride)
+        self.padding = _tuplify(self.padding)
+
+    def has_params(self):
+        return False
+
+    def output_type(self, it: InputType) -> InputType:
+        h = _conv_out(it.height, self.kernel_size[0], self.stride[0],
+                      self.convolution_mode, self.padding[0])
+        w = _conv_out(it.width, self.kernel_size[1], self.stride[1],
+                      self.convolution_mode, self.padding[1])
+        return InputType.convolutional(h, w, it.channels)
+
+    def apply(self, params, state, x, train, rng):
+        pad = "SAME" if self.convolution_mode == "Same" else (
+            "VALID" if self.padding == (0, 0) else self.padding)
+        pt = PoolingType(self.pooling_type)
+        if pt is PoolingType.MAX:
+            return nnops.maxpool2d(x, self.kernel_size, self.stride, pad), state
+        if pt is PoolingType.AVG:
+            return nnops.avgpool2d(x, self.kernel_size, self.stride, pad), state
+        if pt is PoolingType.PNORM:
+            return nnops.pnormpool2d(x, self.kernel_size, self.stride, pad, self.pnorm), state
+        return nnops.sumpool2d(x, self.kernel_size, self.stride, pad), state
+
+
+@serializable
+@dataclasses.dataclass
+class Upsampling2D(Layer):
+    size: int = 2
+
+    def has_params(self):
+        return False
+
+    def output_type(self, it: InputType) -> InputType:
+        return InputType.convolutional(it.height * self.size,
+                                       it.width * self.size, it.channels)
+
+    def apply(self, params, state, x, train, rng):
+        return nnops.upsampling2d(x, self.size), state
+
+
+@serializable
+@dataclasses.dataclass
+class ZeroPaddingLayer(Layer):
+    pad: Tuple[int, int] = (1, 1)
+
+    def __post_init__(self):
+        self.pad = _tuplify(self.pad)
+
+    def has_params(self):
+        return False
+
+    def output_type(self, it: InputType) -> InputType:
+        return InputType.convolutional(it.height + 2 * self.pad[0],
+                                       it.width + 2 * self.pad[1], it.channels)
+
+    def apply(self, params, state, x, train, rng):
+        p = self.pad
+        return jnp.pad(x, ((0, 0), (p[0], p[0]), (p[1], p[1]), (0, 0))), state
+
+
+@serializable
+@dataclasses.dataclass
+class GlobalPoolingLayer(Layer):
+    """Global pooling over spatial or time dims (reference:
+    conf/layers/GlobalPoolingLayer; collapses CNN/RNN to FF)."""
+
+    pooling_type: str = "max"
+
+    def has_params(self):
+        return False
+
+    def output_type(self, it: InputType) -> InputType:
+        if it.kind == "recurrent":
+            return InputType.feedForward(it.size)
+        return InputType.feedForward(it.channels)
+
+    def apply(self, params, state, x, train, rng):
+        axes = tuple(range(1, x.ndim - 1))
+        pt = PoolingType(self.pooling_type)
+        if pt is PoolingType.MAX:
+            return jnp.max(x, axis=axes), state
+        if pt is PoolingType.SUM:
+            return jnp.sum(x, axis=axes), state
+        if pt is PoolingType.PNORM:
+            return jnp.sum(jnp.abs(x) ** 2, axis=axes) ** 0.5, state
+        return jnp.mean(x, axis=axes), state
+
+
+# ----------------------------------------------------------------------
+# normalization layers
+# ----------------------------------------------------------------------
+@serializable
+@dataclasses.dataclass
+class BatchNormalization(Layer):
+    """Batch norm (reference: conf/layers/BatchNormalization + cuDNN
+    helper). Running stats live in layer STATE (functional update each
+    train step), matching the reference's global-mean/var arrays.
+
+    decay follows the reference: running = decay*running + (1-decay)*batch.
+    """
+
+    eps: float = 1e-5
+    decay: float = 0.9
+    use_log_std: bool = False  # parity knob with reference's config
+
+    def _nf(self, it: InputType) -> int:
+        return it.channels if it.kind == "convolutional" else it.size
+
+    def init_params(self, key, it, dtype) -> dict:
+        n = self._nf(it)
+        return {"gamma": jnp.ones((n,), dtype), "beta": jnp.zeros((n,), dtype)}
+
+    def init_state(self, it, dtype) -> dict:
+        n = self._nf(it)
+        return {"mean": jnp.zeros((n,), dtype), "var": jnp.ones((n,), dtype)}
+
+    def apply(self, params, state, x, train, rng):
+        if train:
+            y, m, v = nnops.batch_norm_train(x, params["gamma"], params["beta"],
+                                             self.eps)
+            d = self.decay
+            new_state = {"mean": d * state["mean"] + (1 - d) * m,
+                         "var": d * state["var"] + (1 - d) * v}
+            out = y
+        else:
+            out = nnops.batch_norm(x, params["gamma"], params["beta"],
+                                   state["mean"], state["var"], self.eps)
+            new_state = state
+        return _act(self.activation or "identity").fn(out), new_state
+
+
+@serializable
+@dataclasses.dataclass
+class LayerNormalization(Layer):
+    """Layer norm over the feature axis (transformer building block)."""
+
+    eps: float = 1e-5
+
+    def _nf(self, it: InputType) -> int:
+        return it.channels if it.kind == "convolutional" else it.size
+
+    def init_params(self, key, it, dtype) -> dict:
+        n = self._nf(it)
+        return {"gamma": jnp.ones((n,), dtype), "beta": jnp.zeros((n,), dtype)}
+
+    def apply(self, params, state, x, train, rng):
+        return nnops.layer_norm(x, params["gamma"], params["beta"],
+                                eps=self.eps), state
+
+
+@serializable
+@dataclasses.dataclass
+class LocalResponseNormalization(Layer):
+    """LRN (reference: conf/layers/LocalResponseNormalization)."""
+
+    n: int = 5
+    k: float = 2.0
+    alpha: float = 1e-4
+    beta: float = 0.75
+
+    def has_params(self):
+        return False
+
+    def apply(self, params, state, x, train, rng):
+        return nnops.local_response_normalization(
+            x, depth_radius=self.n // 2, bias=self.k, alpha=self.alpha,
+            beta=self.beta), state
+
+
+# ----------------------------------------------------------------------
+# recurrent layers
+# ----------------------------------------------------------------------
+@serializable
+@dataclasses.dataclass
+class LSTM(Layer):
+    """LSTM (reference: conf/layers/LSTM; impl layers/recurrent/LSTM with
+    CudnnLSTMHelper fast path). Single fused lax.scan, gate order IFGO.
+    Weight names follow the reference: W (input), RW (recurrent), b.
+
+    forget_gate_bias_init: the reference initializes the forget-gate bias
+    (commonly 1.0) to stabilize early training.
+    """
+
+    n_in: int = 0
+    n_out: int = 0
+    forget_gate_bias_init: float = 1.0
+
+    def output_type(self, it: InputType) -> InputType:
+        return InputType.recurrent(self.n_out, it.timeseries_length)
+
+    def init_params(self, key, it, dtype) -> dict:
+        k1, k2 = jax.random.split(key)
+        h = self.n_out
+        w = init_weights(self.weight_init or WeightInit.XAVIER, k1,
+                         (self.n_in, 4 * h), self.n_in, 4 * h, dtype)
+        rw = init_weights(self.weight_init or WeightInit.XAVIER, k2,
+                          (h, 4 * h), h, 4 * h, dtype)
+        b = jnp.zeros((4 * h,), dtype)
+        # gate order i,f,g,o — bias the forget gate
+        b = b.at[h:2 * h].set(self.forget_gate_bias_init)
+        return {"W": w, "RW": rw, "b": b}
+
+    def apply(self, params, state, x, train, rng):
+        x = self._maybe_dropout(x, train, rng)
+        ys, _ = nnops.lstm_layer(x, params["W"], params["RW"], params["b"])
+        act = self.activation
+        return (_act(act).fn(ys) if act and act != "tanh" else ys), state
+
+
+@serializable
+@dataclasses.dataclass
+class GravesLSTM(LSTM):
+    """Alias of LSTM (reference's GravesLSTM adds peephole connections;
+    the fused TPU path omits peepholes — documented deviation, the
+    reference itself deprecated GravesLSTM in favor of LSTM)."""
+
+
+@serializable
+@dataclasses.dataclass
+class SimpleRnn(Layer):
+    n_in: int = 0
+    n_out: int = 0
+
+    def output_type(self, it: InputType) -> InputType:
+        return InputType.recurrent(self.n_out, it.timeseries_length)
+
+    def init_params(self, key, it, dtype) -> dict:
+        k1, k2 = jax.random.split(key)
+        w = init_weights(self.weight_init or WeightInit.XAVIER, k1,
+                         (self.n_in, self.n_out), self.n_in, self.n_out, dtype)
+        rw = init_weights(self.weight_init or WeightInit.XAVIER, k2,
+                          (self.n_out, self.n_out), self.n_out, self.n_out, dtype)
+        return {"W": w, "RW": rw, "b": jnp.zeros((self.n_out,), dtype)}
+
+    def apply(self, params, state, x, train, rng):
+        ys, _ = nnops.simple_rnn_layer(x, params["W"], params["RW"], params["b"])
+        return ys, state
+
+
+@serializable
+@dataclasses.dataclass
+class RnnOutputLayer(OutputLayer):
+    """Per-timestep output head (reference: conf/layers/RnnOutputLayer).
+    DenseLayer applies over the last axis so the same math works on
+    [N,T,F]; loss averages over time (mask-aware)."""
+
+    def output_type(self, it: InputType) -> InputType:
+        return InputType.recurrent(self.n_out, it.timeseries_length)
+
+
+# ----------------------------------------------------------------------
+# attention
+# ----------------------------------------------------------------------
+@serializable
+@dataclasses.dataclass
+class SelfAttentionLayer(Layer):
+    """Multi-head self-attention (reference: conf/layers/SelfAttentionLayer
+    backed by the multiHeadDotProductAttention op)."""
+
+    n_in: int = 0
+    n_out: int = 0
+    n_heads: int = 1
+    head_size: int = 0
+
+    def __post_init__(self):
+        if not self.head_size and self.n_heads:
+            self.head_size = (self.n_out or self.n_in) // self.n_heads
+
+    def output_type(self, it: InputType) -> InputType:
+        return InputType.recurrent(self.n_out, it.timeseries_length)
+
+    def init_params(self, key, it, dtype) -> dict:
+        proj = self.n_heads * self.head_size
+        ks = jax.random.split(key, 4)
+        wi = self.weight_init or WeightInit.XAVIER
+        return {
+            "Wq": init_weights(wi, ks[0], (self.n_in, proj), self.n_in, proj, dtype),
+            "Wk": init_weights(wi, ks[1], (self.n_in, proj), self.n_in, proj, dtype),
+            "Wv": init_weights(wi, ks[2], (self.n_in, proj), self.n_in, proj, dtype),
+            "Wo": init_weights(wi, ks[3], (proj, self.n_out), proj, self.n_out, dtype),
+        }
+
+    def apply(self, params, state, x, train, rng):
+        out = nnops.multi_head_dot_product_attention(
+            x, x, params["Wq"], params["Wk"], params["Wv"], params["Wo"],
+            num_heads=self.n_heads)
+        return _act(self.activation or "identity").fn(out), state

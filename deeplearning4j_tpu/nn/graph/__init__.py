@@ -1,0 +1,12 @@
+from deeplearning4j_tpu.nn.graph.vertices import (
+    ElementWiseVertex, GraphVertex, LayerVertex, MergeVertex, ScaleVertex,
+    SubsetVertex, PreprocessorVertex,
+)
+from deeplearning4j_tpu.nn.graph.config import ComputationGraphConfiguration
+from deeplearning4j_tpu.nn.graph.graph import ComputationGraph
+
+__all__ = [
+    "ComputationGraph", "ComputationGraphConfiguration", "GraphVertex",
+    "LayerVertex", "MergeVertex", "ElementWiseVertex", "ScaleVertex",
+    "SubsetVertex", "PreprocessorVertex",
+]

@@ -1,0 +1,318 @@
+"""ComputationGraph — DAG network front-end (reference:
+org/deeplearning4j/nn/graph/ComputationGraph.java, ~4k LoC; topo-sorted
+vertex loop in §3.2). Like MultiLayerNetwork, the whole training
+iteration compiles to ONE XLA executable; the topo-sorted Python loop
+unrolls at trace time, so merge/residual structure costs nothing at
+runtime (XLA sees one dataflow graph).
+
+Supports multiple inputs and multiple outputs/losses (summed, as the
+reference does for multi-output training).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterator import DataSetIterator
+from deeplearning4j_tpu.learning.updaters import apply_updater
+from deeplearning4j_tpu.ndarray.dtypes import DataType
+from deeplearning4j_tpu.ndarray.ndarray import NDArray, _unwrap
+from deeplearning4j_tpu.nn.graph.config import ComputationGraphConfiguration
+from deeplearning4j_tpu.nn.graph.vertices import LayerVertex
+from deeplearning4j_tpu.nn.conf.layers import LossLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer.network import (
+    _REGULARIZED_KEYS, _uses_epoch_schedule,
+)
+
+
+class ComputationGraph:
+    def __init__(self, conf: ComputationGraphConfiguration):
+        self.conf = conf
+        self.params_map: Optional[Dict[str, dict]] = None
+        self.states_map: Optional[Dict[str, dict]] = None
+        self.opt_states: Optional[Dict[str, Any]] = None
+        self._updaters: Dict[str, Any] = {}
+        self._iteration = 0
+        self._epoch = 0
+        self._score = float("nan")
+        self._listeners: List[Any] = []
+        self._rng_key = None
+        self._step_cache = {}
+        self._fwd = None
+        self._dtype = DataType.from_any(conf.dtype).jax
+
+    # ------------------------------------------------------------------
+    def init(self) -> "ComputationGraph":
+        conf = self.conf
+        if not conf.input_types:
+            raise ValueError("setInputTypes(...) required before init()")
+        key = jax.random.key(conf.seed)
+        types = {n: it for n, it in zip(conf.network_inputs, conf.input_types)}
+        self.params_map, self.states_map, self.opt_states = {}, {}, {}
+        for node in conf.nodes:
+            in_types = [types[s] for s in node.inputs]
+            key, sub = jax.random.split(key)
+            p = node.vertex.init_params(sub, in_types, self._dtype)
+            s = node.vertex.init_state(in_types, self._dtype)
+            self.params_map[node.name] = p
+            self.states_map[node.name] = s
+            upd = conf.updater
+            if isinstance(node.vertex, LayerVertex) and node.vertex.layer.updater is not None:
+                upd = node.vertex.layer.updater
+            self._updaters[node.name] = upd
+            self.opt_states[node.name] = upd.init_state(p)
+            types[node.name] = node.vertex.output_type(in_types)
+        self._types = types
+        self._rng_key = jax.random.key(conf.seed + 7919)
+        return self
+
+    def _check_init(self):
+        if self.params_map is None:
+            raise RuntimeError("Call init() first")
+
+    # ------------------------------------------------------------------
+    def _forward_all(self, params_map, states_map, inputs: dict, train, rng):
+        conf = self.conf
+        acts: Dict[str, Any] = dict(inputs)
+        new_states: Dict[str, dict] = {}
+        keys = (jax.random.split(rng, len(conf.nodes))
+                if rng is not None else [None] * len(conf.nodes))
+        for i, node in enumerate(conf.nodes):
+            xs = [acts[s] for s in node.inputs]
+            out, ns = node.vertex.apply(params_map[node.name],
+                                        states_map[node.name], xs, train,
+                                        keys[i])
+            acts[node.name] = out
+            new_states[node.name] = ns
+        return acts, new_states
+
+    def _loss(self, params_map, states_map, inputs, labels_map, rng):
+        conf = self.conf
+        acts: Dict[str, Any] = dict(inputs)
+        new_states: Dict[str, dict] = {}
+        keys = (jax.random.split(rng, len(conf.nodes))
+                if rng is not None else [None] * len(conf.nodes))
+        total = jnp.asarray(0.0, jnp.float32)
+        for i, node in enumerate(conf.nodes):
+            xs = [acts[s] for s in node.inputs]
+            v = node.vertex
+            if node.name in conf.network_outputs and isinstance(v, LayerVertex) \
+                    and isinstance(v.layer, (OutputLayer, LossLayer)):
+                total = total + v.layer.loss_value(
+                    params_map[node.name], states_map[node.name], xs[0],
+                    labels_map[node.name], None)
+                new_states[node.name] = states_map[node.name]
+                acts[node.name] = xs[0]
+            else:
+                out, ns = v.apply(params_map[node.name], states_map[node.name],
+                                  xs, True, keys[i])
+                acts[node.name] = out
+                new_states[node.name] = ns
+        data_loss = total
+        # regularization
+        reg = jnp.asarray(0.0, jnp.float32)
+        for node in conf.nodes:
+            if not isinstance(node.vertex, LayerVertex):
+                continue
+            layer = node.vertex.layer
+            l1 = layer.l1 or 0.0
+            l2 = layer.l2 or 0.0
+            if l1 == 0.0 and l2 == 0.0:
+                continue
+            for k, val in params_map[node.name].items():
+                if k in _REGULARIZED_KEYS:
+                    if l1:
+                        reg = reg + l1 * jnp.sum(jnp.abs(val))
+                    if l2:
+                        reg = reg + 0.5 * l2 * jnp.sum(val * val)
+        return data_loss + reg, (new_states, data_loss)
+
+    def _clip(self, grads):
+        mode = self.conf.gradient_normalization
+        if not mode:
+            return grads
+        t = self.conf.gradient_normalization_threshold
+        if mode == "ClipElementWiseAbsoluteValue":
+            return jax.tree_util.tree_map(lambda g: jnp.clip(g, -t, t), grads)
+        if mode == "ClipL2PerLayer":
+            out = {}
+            for name, g in grads.items():
+                leaves = jax.tree_util.tree_leaves(g)
+                if not leaves:
+                    out[name] = g
+                    continue
+                norm = jnp.sqrt(sum(jnp.sum(l * l) for l in leaves) + 1e-12)
+                scale = jnp.minimum(1.0, t / norm)
+                out[name] = jax.tree_util.tree_map(lambda l: l * scale, g)
+            return out
+        if mode == "RenormalizeL2PerLayer":
+            out = {}
+            for name, g in grads.items():
+                leaves = jax.tree_util.tree_leaves(g)
+                if not leaves:
+                    out[name] = g
+                    continue
+                norm = jnp.sqrt(sum(jnp.sum(l * l) for l in leaves) + 1e-12)
+                out[name] = jax.tree_util.tree_map(lambda l: l / norm, g)
+            return out
+        raise ValueError(f"Unknown gradient normalization: {mode}")
+
+    def _get_train_step(self):
+        if "step" in self._step_cache:
+            return self._step_cache["step"]
+
+        def step_fn(params_map, states_map, opt_states, it_step, ep_step,
+                    inputs, labels_map, rng):
+            loss_fn = lambda pm: self._loss(pm, states_map, inputs,
+                                            labels_map, rng)
+            (loss, (new_states, data_loss)), grads = \
+                jax.value_and_grad(loss_fn, has_aux=True)(params_map)
+            grads = self._clip(grads)
+            new_params, new_opt = {}, {}
+            for name in params_map:
+                step = (ep_step if _uses_epoch_schedule(self._updaters[name])
+                        else it_step)
+                updates, no = apply_updater(self._updaters[name],
+                                            opt_states[name], grads[name],
+                                            params_map[name], step)
+                new_params[name] = jax.tree_util.tree_map(
+                    lambda p, u: p - u, params_map[name], updates)
+                new_opt[name] = no
+            return new_params, new_states, new_opt, data_loss
+
+        jitted = jax.jit(step_fn, donate_argnums=(0, 1, 2))
+        self._step_cache["step"] = jitted
+        return jitted
+
+    # ------------------------------------------------------------------
+    def fit(self, data, labels=None, epochs: int = 1):
+        self._check_init()
+        if isinstance(data, DataSetIterator):
+            for _ in range(epochs):
+                for ds in data:
+                    self._fit_batch([ds.features], [ds.labels])
+                self._epoch += 1
+            return self
+        if isinstance(data, DataSet):
+            for _ in range(epochs):
+                self._fit_batch([data.features], [data.labels])
+            return self
+        if labels is None:
+            raise ValueError("fit(inputs, labels) requires labels")
+        if not isinstance(data, (list, tuple)):
+            data = [data]
+        if not isinstance(labels, (list, tuple)):
+            labels = [labels]
+        for _ in range(epochs):
+            self._fit_batch([_unwrap(d) for d in data],
+                            [_unwrap(l) for l in labels])
+        return self
+
+    def _fit_batch(self, xs: Sequence, ys: Sequence):
+        conf = self.conf
+        inputs = {n: jnp.asarray(_unwrap(x), self._dtype)
+                  for n, x in zip(conf.network_inputs, xs)}
+        labels = {n: jnp.asarray(_unwrap(y))
+                  for n, y in zip(conf.network_outputs, ys)}
+        self._rng_key, sub = jax.random.split(self._rng_key)
+        step = self._get_train_step()
+        (self.params_map, self.states_map, self.opt_states, loss) = step(
+            self.params_map, self.states_map, self.opt_states,
+            jnp.asarray(self._iteration), jnp.asarray(self._epoch),
+            inputs, labels, sub)
+        self._score = float(loss)
+        self._iteration += 1
+        for l in self._listeners:
+            l.iterationDone(self, self._iteration, self._epoch)
+
+    # ------------------------------------------------------------------
+    def output(self, *xs) -> List[NDArray]:
+        """Reference: ComputationGraph#output — returns list of outputs."""
+        self._check_init()
+        conf = self.conf
+        if self._fwd is None:
+            self._fwd = jax.jit(
+                lambda pm, sm, inp: tuple(
+                    self._forward_all(pm, sm, inp, False, None)[0][o]
+                    for o in conf.network_outputs))
+        inputs = {n: jnp.asarray(_unwrap(x), self._dtype)
+                  for n, x in zip(conf.network_inputs, xs)}
+        outs = self._fwd(self.params_map, self.states_map, inputs)
+        return [NDArray(o) for o in outs]
+
+    def outputSingle(self, *xs) -> NDArray:
+        return self.output(*xs)[0]
+
+    def score(self, dataset: Optional[DataSet] = None) -> float:
+        if dataset is None:
+            return self._score
+        self._check_init()
+        inputs = {self.conf.network_inputs[0]: jnp.asarray(dataset.features, self._dtype)}
+        labels = {self.conf.network_outputs[0]: jnp.asarray(dataset.labels)}
+        loss, _ = self._loss(self.params_map, self.states_map, inputs, labels, None)
+        return float(loss)
+
+    def evaluate(self, iterator: DataSetIterator):
+        from deeplearning4j_tpu.evaluation import Evaluation
+
+        ev = Evaluation()
+        for ds in iterator:
+            out = self.outputSingle(ds.features)
+            ev.eval(ds.labels, out.jax)
+        return ev
+
+    # ------------------------------------------------------------------
+    def numParams(self) -> int:
+        self._check_init()
+        return sum(int(l.size) for p in self.params_map.values()
+                   for l in jax.tree_util.tree_leaves(p))
+
+    def params(self) -> NDArray:
+        self._check_init()
+        parts = []
+        for node in self.conf.nodes:
+            p = self.params_map[node.name]
+            for k in sorted(p):
+                parts.append(p[k].ravel())
+        return NDArray(jnp.concatenate(parts)) if parts else NDArray(jnp.zeros(0))
+
+    def setParams(self, flat):
+        self._check_init()
+        v = _unwrap(flat)
+        off = 0
+        for node in self.conf.nodes:
+            p = self.params_map[node.name]
+            for k in sorted(p):
+                n = p[k].size
+                p[k] = v[off:off + n].reshape(p[k].shape).astype(p[k].dtype)
+                off += n
+
+    def setListeners(self, *ls):
+        self._listeners = list(ls)
+        return self
+
+    def getIterationCount(self):
+        return self._iteration
+
+    def getEpochCount(self):
+        return self._epoch
+
+    def summary(self) -> str:
+        self._check_init()
+        lines = [f"{'name':<24}{'vertex':<26}{'params':>12}  inputs"]
+        total = 0
+        for node in self.conf.nodes:
+            n = sum(int(l.size) for l in
+                    jax.tree_util.tree_leaves(self.params_map[node.name]))
+            total += n
+            vname = (type(node.vertex.layer).__name__
+                     if isinstance(node.vertex, LayerVertex)
+                     else type(node.vertex).__name__)
+            lines.append(f"{node.name:<24}{vname:<26}{n:>12,}  {node.inputs}")
+        lines.append(f"Total params: {total:,}")
+        return "\n".join(lines)

@@ -1,0 +1,155 @@
+"""Graph vertices (reference: org/deeplearning4j/nn/graph/vertex/impl/**
+— MergeVertex, ElementWiseVertex (residual connections for ResNet50),
+SubsetVertex, ScaleVertex, PreprocessorVertex. SURVEY.md §2.21).
+
+A vertex is a (possibly parameterless) node taking >=1 input arrays.
+LayerVertex wraps a layer config — the common case.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.common.serde import serializable
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+
+
+@dataclasses.dataclass
+class GraphVertex:
+    def output_type(self, input_types: List[InputType]) -> InputType:
+        return input_types[0]
+
+    def init_params(self, key, input_types, dtype) -> dict:
+        return {}
+
+    def init_state(self, input_types, dtype) -> dict:
+        return {}
+
+    def apply(self, params, state, inputs: list, train: bool, rng):
+        raise NotImplementedError
+
+
+@serializable
+@dataclasses.dataclass
+class LayerVertex(GraphVertex):
+    """Wraps a layer config as a single-input vertex."""
+
+    layer: object = None
+
+    def output_type(self, input_types):
+        return self.layer.output_type(input_types[0])
+
+    def init_params(self, key, input_types, dtype):
+        return self.layer.init_params(key, input_types[0], dtype)
+
+    def init_state(self, input_types, dtype):
+        return self.layer.init_state(input_types[0], dtype)
+
+    def apply(self, params, state, inputs, train, rng):
+        return self.layer.apply(params, state, inputs[0], train, rng)
+
+
+@serializable
+@dataclasses.dataclass
+class MergeVertex(GraphVertex):
+    """Concatenate along the feature (last) axis (reference: MergeVertex
+    concatenates along dim 1 in NCHW — here last axis in NHWC/NTF)."""
+
+    def output_type(self, its):
+        it = its[0]
+        if it.kind == "convolutional":
+            return InputType.convolutional(it.height, it.width,
+                                           sum(i.channels for i in its))
+        if it.kind == "recurrent":
+            return InputType.recurrent(sum(i.size for i in its),
+                                       it.timeseries_length)
+        return InputType.feedForward(sum(i.size for i in its))
+
+    def apply(self, params, state, inputs, train, rng):
+        return jnp.concatenate(inputs, axis=-1), state
+
+
+@serializable
+@dataclasses.dataclass
+class ElementWiseVertex(GraphVertex):
+    """Elementwise combine (reference ops: Add, Subtract, Product,
+    Average, Max) — the residual-sum vertex in ResNet."""
+
+    op: str = "Add"
+
+    def apply(self, params, state, inputs, train, rng):
+        op = self.op.lower()
+        out = inputs[0]
+        if op == "add":
+            for x in inputs[1:]:
+                out = out + x
+        elif op == "subtract":
+            out = inputs[0] - inputs[1]
+        elif op == "product":
+            for x in inputs[1:]:
+                out = out * x
+        elif op == "average":
+            out = sum(inputs) / len(inputs)
+        elif op == "max":
+            for x in inputs[1:]:
+                out = jnp.maximum(out, x)
+        else:
+            raise ValueError(f"Unknown elementwise op: {self.op}")
+        return out, state
+
+
+@serializable
+@dataclasses.dataclass
+class ScaleVertex(GraphVertex):
+    scale: float = 1.0
+
+    def apply(self, params, state, inputs, train, rng):
+        return inputs[0] * self.scale, state
+
+
+@serializable
+@dataclasses.dataclass
+class SubsetVertex(GraphVertex):
+    """Feature-axis slice [from, to] inclusive (reference: SubsetVertex)."""
+
+    frm: int = 0
+    to: int = 0
+
+    def output_type(self, its):
+        it = its[0]
+        n = self.to - self.frm + 1
+        if it.kind == "recurrent":
+            return InputType.recurrent(n, it.timeseries_length)
+        if it.kind == "convolutional":
+            return InputType.convolutional(it.height, it.width, n)
+        return InputType.feedForward(n)
+
+    def apply(self, params, state, inputs, train, rng):
+        return inputs[0][..., self.frm:self.to + 1], state
+
+
+@serializable
+@dataclasses.dataclass
+class PreprocessorVertex(GraphVertex):
+    """Standalone reshape vertex carrying a preprocessor tag."""
+
+    tag: str = "flatten"
+
+    def output_type(self, its):
+        it = its[0]
+        if self.tag == "flatten":
+            return InputType.feedForward(it.flat_size()
+                                         if it.kind != "convolutional"
+                                         else it.height * it.width * it.channels)
+        if self.tag.startswith("to_conv:"):
+            h, w, c = (int(v) for v in self.tag.split(":", 1)[1].split(","))
+            return InputType.convolutional(h, w, c)
+        return it
+
+    def apply(self, params, state, inputs, train, rng):
+        from deeplearning4j_tpu.nn.conf.builder import apply_preprocessor
+
+        return apply_preprocessor(self.tag, inputs[0]), state

@@ -1,0 +1,3 @@
+from deeplearning4j_tpu.nn.multilayer.network import MultiLayerNetwork
+
+__all__ = ["MultiLayerNetwork"]

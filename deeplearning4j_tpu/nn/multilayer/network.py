@@ -1,0 +1,440 @@
+"""MultiLayerNetwork — sequential network front-end.
+
+Reference: org/deeplearning4j/nn/multilayer/MultiLayerNetwork.java
+(~4k LoC) + the training driver stack (Solver, BaseOptimizer,
+StochasticGradientDescent, MultiLayerUpdater — SURVEY.md §2.19, §2.22,
+§3.1).
+
+The reference's fit() runs a per-layer, per-op eager loop crossing JNI
+thousands of times per iteration, with params/gradients living in flat
+mutable view arrays. The TPU-native design compiles the ENTIRE training
+iteration — forward, loss, backward, updater, param update — into ONE
+XLA executable (`jax.jit` with donated buffers), executed per minibatch.
+That single design decision replaces: LayerWorkspaceMgr arenas (XLA
+buffer assignment), the updater loop (fused into the step), gradient
+views (pytree + donation), and the flow-controller sync machinery
+(XLA's dataflow schedule).
+
+Parity surface kept from the reference: init()/fit()/output()/score()/
+params()/setParams()/numParams()/evaluate()/summary(), listener
+callbacks, per-layer updater overrides (incl. NoOp freezing),
+l1/l2 regularization, gradient clipping modes.
+"""
+
+from __future__ import annotations
+
+import time
+from functools import partial
+from typing import Any, Callable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterator import DataSetIterator
+from deeplearning4j_tpu.learning.schedules import ISchedule, ScheduleType
+from deeplearning4j_tpu.learning.updaters import IUpdater, apply_updater
+
+
+def _uses_epoch_schedule(upd) -> bool:
+    """True if the updater's LR schedule counts epochs, not iterations
+    (reference: ScheduleType.EPOCH resolved in BaseMultiLayerUpdater)."""
+    lr = getattr(upd, "learning_rate", None)
+    return isinstance(lr, ISchedule) and lr.schedule_type is ScheduleType.EPOCH
+from deeplearning4j_tpu.ndarray.dtypes import DataType
+from deeplearning4j_tpu.ndarray.ndarray import NDArray, _unwrap
+from deeplearning4j_tpu.nn.conf.builder import (
+    MultiLayerConfiguration, apply_preprocessor,
+)
+from deeplearning4j_tpu.nn.conf.layers import LossLayer, OutputLayer
+
+#: param keys subject to l1/l2 (weights, not biases/scales — reference
+#: regularizes weights by default, bias via separate l2Bias we omit)
+_REGULARIZED_KEYS = {"W", "RW", "dW", "pW", "Wq", "Wk", "Wv", "Wo"}
+
+
+class MultiLayerNetwork:
+    def __init__(self, conf: MultiLayerConfiguration):
+        self.conf = conf
+        self.params_list: Optional[List[dict]] = None   # per-layer param dicts
+        self.states_list: Optional[List[dict]] = None   # per-layer non-trainable state
+        self.opt_states: Optional[List[Any]] = None     # per-layer updater state
+        self._updaters: List[IUpdater] = []
+        self._iteration = 0
+        self._epoch = 0
+        self._score = float("nan")
+        self._listeners: List[Any] = []
+        self._rng_key = None
+        self._step_cache: dict = {}
+        self._fwd_cache: dict = {}
+        self._dtype = DataType.from_any(conf.dtype).jax
+
+    # ------------------------------------------------------------------
+    # initialization (reference: MultiLayerNetwork#init + ParamInitializer)
+    # ------------------------------------------------------------------
+    def init(self) -> "MultiLayerNetwork":
+        conf = self.conf
+        key = jax.random.key(conf.seed)
+        it = conf.input_type
+        if it is None:
+            # manual-n_in path (reference allows omitting setInputType when
+            # every layer's nIn is explicit); derive the input type from
+            # the first parameterized layer
+            it = self._infer_input_type()
+        self.params_list, self.states_list, self._updaters = [], [], []
+        self.opt_states = []
+        for i, layer in enumerate(conf.layers):
+            tag = conf.preprocessors.get(i)
+            if tag == "flatten":
+                from deeplearning4j_tpu.nn.conf.inputs import InputType
+                it = InputType.feedForward(it.flat_size())
+            elif tag and tag.startswith("to_conv:"):
+                from deeplearning4j_tpu.nn.conf.inputs import InputType
+                h, w, c = (int(v) for v in tag.split(":", 1)[1].split(","))
+                it = InputType.convolutional(h, w, c)
+            elif it.kind == "convolutionalFlat":
+                from deeplearning4j_tpu.nn.conf.inputs import InputType
+                it = InputType.feedForward(it.flat_size())
+            key, sub = jax.random.split(key)
+            p = layer.init_params(sub, it, self._dtype)
+            s = layer.init_state(it, self._dtype)
+            upd = layer.updater if layer.updater is not None else conf.updater
+            self.params_list.append(p)
+            self.states_list.append(s)
+            self._updaters.append(upd)
+            self.opt_states.append(upd.init_state(p))
+            it = layer.output_type(it)
+        self._output_type = it
+        self._rng_key = jax.random.key(conf.seed ^ 0x5EED)
+        return self
+
+    def _infer_input_type(self):
+        from deeplearning4j_tpu.nn.conf.inputs import InputType
+        from deeplearning4j_tpu.nn.conf.layers import (
+            ConvolutionLayer, LSTM, SimpleRnn, SubsamplingLayer,
+        )
+
+        first = self.conf.layers[0]
+        if isinstance(first, (ConvolutionLayer, SubsamplingLayer)):
+            raise ValueError(
+                "Image networks need setInputType(InputType.convolutional"
+                "(h, w, c)) — channel count alone does not fix the geometry")
+        n_in = getattr(first, "n_in", 0)
+        if not n_in:
+            raise ValueError(
+                "Without setInputType, the first layer must declare n_in")
+        if isinstance(first, (LSTM, SimpleRnn)):
+            return InputType.recurrent(n_in)
+        return InputType.feedForward(n_in)
+
+    def _check_init(self):
+        if self.params_list is None:
+            raise RuntimeError("Call init() first")
+
+    # ------------------------------------------------------------------
+    # forward (reference: feedForward / ffToLayerActivationsInWs)
+    # ------------------------------------------------------------------
+    def _forward(self, params_list, states_list, x, train: bool, rng):
+        """Pure forward through all layers. Returns (out, new_states)."""
+        conf = self.conf
+        a = x
+        new_states = []
+        keys = (jax.random.split(rng, len(conf.layers))
+                if rng is not None else [None] * len(conf.layers))
+        for i, layer in enumerate(conf.layers):
+            tag = conf.preprocessors.get(i)
+            if tag:
+                a = apply_preprocessor(tag, a)
+            a, ns = layer.apply(params_list[i], states_list[i], a, train, keys[i])
+            new_states.append(ns)
+        return a, new_states
+
+    def _loss(self, params_list, states_list, x, y, mask, rng):
+        """Forward to the loss head; fused stable loss on pre-activations."""
+        conf = self.conf
+        a = x
+        new_states = []
+        keys = (jax.random.split(rng, len(conf.layers))
+                if rng is not None else [None] * len(conf.layers))
+        for i, layer in enumerate(conf.layers[:-1]):
+            tag = conf.preprocessors.get(i)
+            if tag:
+                a = apply_preprocessor(tag, a)
+            a, ns = layer.apply(params_list[i], states_list[i], a, True, keys[i])
+            new_states.append(ns)
+        last = conf.layers[-1]
+        if not isinstance(last, (OutputLayer, LossLayer)):
+            raise ValueError("Last layer must be an OutputLayer/LossLayer to fit()")
+        tag = conf.preprocessors.get(len(conf.layers) - 1)
+        if tag:
+            a = apply_preprocessor(tag, a)
+        data_loss = last.loss_value(params_list[-1], states_list[-1], a, y, mask)
+        new_states.append(states_list[-1])
+
+        # l1/l2 regularization (reference: BaseLayer#calcRegularizationScore)
+        reg = jnp.asarray(0.0, data_loss.dtype)
+        for layer, p in zip(conf.layers, params_list):
+            l1 = layer.l1 or 0.0
+            l2 = layer.l2 or 0.0
+            if l1 == 0.0 and l2 == 0.0:
+                continue
+            for k, v in p.items():
+                if k in _REGULARIZED_KEYS:
+                    if l1:
+                        reg = reg + l1 * jnp.sum(jnp.abs(v))
+                    if l2:
+                        reg = reg + 0.5 * l2 * jnp.sum(v * v)
+        return data_loss + reg, (new_states, data_loss)
+
+    def _clip_grads(self, grads_list):
+        mode = self.conf.gradient_normalization
+        if not mode:
+            return grads_list
+        t = self.conf.gradient_normalization_threshold
+        if mode == "ClipElementWiseAbsoluteValue":
+            return jax.tree_util.tree_map(lambda g: jnp.clip(g, -t, t), grads_list)
+        if mode == "ClipL2PerLayer":
+            out = []
+            for g in grads_list:
+                leaves = jax.tree_util.tree_leaves(g)
+                norm = jnp.sqrt(sum(jnp.sum(l * l) for l in leaves) + 1e-12)
+                scale = jnp.minimum(1.0, t / norm)
+                out.append(jax.tree_util.tree_map(lambda l: l * scale, g))
+            return out
+        if mode == "RenormalizeL2PerLayer":
+            out = []
+            for g in grads_list:
+                leaves = jax.tree_util.tree_leaves(g)
+                norm = jnp.sqrt(sum(jnp.sum(l * l) for l in leaves) + 1e-12)
+                out.append(jax.tree_util.tree_map(lambda l: l / norm, g))
+            return out
+        raise ValueError(f"Unknown gradient normalization: {mode}")
+
+    # ------------------------------------------------------------------
+    # the compiled training step
+    # ------------------------------------------------------------------
+    def _get_train_step(self, has_mask: bool) -> Callable:
+        if has_mask in self._step_cache:
+            return self._step_cache[has_mask]
+
+        def step_fn(params_list, states_list, opt_states, it_step, ep_step,
+                    x, y, mask, rng):
+            loss_fn = lambda pl: self._loss(pl, states_list, x, y, mask, rng)
+            (loss, (new_states, data_loss)), grads = \
+                jax.value_and_grad(loss_fn, has_aux=True)(params_list)
+            grads = self._clip_grads(grads)
+            new_params, new_opt = [], []
+            for i in range(len(params_list)):
+                step = ep_step if _uses_epoch_schedule(self._updaters[i]) else it_step
+                updates, no = apply_updater(self._updaters[i], opt_states[i],
+                                            grads[i], params_list[i], step)
+                new_params.append(jax.tree_util.tree_map(
+                    lambda p, u: p - u, params_list[i], updates))
+                new_opt.append(no)
+            return new_params, new_states, new_opt, data_loss
+
+        jitted = jax.jit(step_fn, donate_argnums=(0, 1, 2))
+        self._step_cache[has_mask] = jitted
+        return jitted
+
+    def _get_forward(self, train: bool) -> Callable:
+        if train in self._fwd_cache:
+            return self._fwd_cache[train]
+        fn = jax.jit(
+            lambda pl, sl, x, rng: self._forward(pl, sl, x, train, rng)[0])
+        self._fwd_cache[train] = fn
+        return fn
+
+    # ------------------------------------------------------------------
+    # public training API (reference: fit(INDArray,INDArray) / fit(iter))
+    # ------------------------------------------------------------------
+    def fit(self, data, labels=None, epochs: int = 1):
+        self._check_init()
+        if isinstance(data, DataSetIterator):
+            for _ in range(epochs):
+                for ds in data:
+                    self._fit_batch(ds.features, ds.labels, ds.labels_mask)
+                self._epoch += 1
+                for l in self._listeners:
+                    if hasattr(l, "onEpochEnd"):
+                        l.onEpochEnd(self)
+            return self
+        if isinstance(data, DataSet):
+            for _ in range(epochs):
+                self._fit_batch(data.features, data.labels, data.labels_mask)
+            return self
+        if labels is None:
+            raise ValueError("fit(x, y) requires labels")
+        for _ in range(epochs):
+            self._fit_batch(_unwrap(data), _unwrap(labels), None)
+        return self
+
+    def _fit_batch(self, x, y, mask):
+        x = jnp.asarray(_unwrap(x), self._dtype)
+        y = jnp.asarray(_unwrap(y))
+        self._rng_key, sub = jax.random.split(self._rng_key)
+        step_fn = self._get_train_step(mask is not None)
+        m = jnp.asarray(mask) if mask is not None else None
+        (self.params_list, self.states_list, self.opt_states, loss) = step_fn(
+            self.params_list, self.states_list, self.opt_states,
+            jnp.asarray(self._iteration), jnp.asarray(self._epoch), x, y, m, sub)
+        self._score = float(loss)
+        self._iteration += 1
+        for l in self._listeners:
+            l.iterationDone(self, self._iteration, self._epoch)
+
+    # ------------------------------------------------------------------
+    # inference / scoring
+    # ------------------------------------------------------------------
+    def output(self, x, train: bool = False) -> NDArray:
+        """Reference: MultiLayerNetwork#output(INDArray, train). Compiled
+        forward; train=True uses batch statistics + dropout."""
+        self._check_init()
+        xj = jnp.asarray(_unwrap(x), self._dtype)
+        if train:
+            self._rng_key, sub = jax.random.split(self._rng_key)
+        else:
+            sub = None
+        out = self._get_forward(train)(self.params_list, self.states_list,
+                                       xj, sub)
+        return NDArray(out)
+
+    def feedForward(self, x) -> List[NDArray]:
+        """Per-layer activations (reference returns the full list)."""
+        self._check_init()
+        a = jnp.asarray(_unwrap(x), self._dtype)
+        acts = [NDArray(a)]
+        for i, layer in enumerate(self.conf.layers):
+            tag = self.conf.preprocessors.get(i)
+            if tag:
+                a = apply_preprocessor(tag, a)
+            a, _ = layer.apply(self.params_list[i], self.states_list[i], a,
+                               False, None)
+            acts.append(NDArray(a))
+        return acts
+
+    def score(self, dataset: Optional[DataSet] = None) -> float:
+        """Last minibatch loss, or loss on a provided DataSet."""
+        if dataset is None:
+            return self._score
+        self._check_init()
+        loss, _ = self._loss(self.params_list, self.states_list,
+                             jnp.asarray(dataset.features, self._dtype),
+                             jnp.asarray(dataset.labels),
+                             dataset.labels_mask, None)
+        return float(loss)
+
+    def computeGradientAndScore(self, x, y):
+        """(gradients, score) — the seam gradient-check tests use
+        (reference: MultiLayerNetwork#computeGradientAndScore)."""
+        self._check_init()
+        x = jnp.asarray(_unwrap(x), self._dtype)
+        y = jnp.asarray(_unwrap(y))
+        loss_fn = lambda pl: self._loss(pl, self.states_list, x, y, None, None)[0]
+        loss, grads = jax.value_and_grad(loss_fn)(self.params_list)
+        return grads, float(loss)
+
+    def evaluate(self, iterator: DataSetIterator, batch_output=None):
+        """Classification evaluation (reference: MultiLayerNetwork#evaluate)."""
+        from deeplearning4j_tpu.evaluation import Evaluation
+
+        ev = Evaluation()
+        for ds in iterator:
+            out = self.output(ds.features)
+            ev.eval(ds.labels, out.jax, mask=ds.labels_mask)
+        return ev
+
+    def evaluateRegression(self, iterator: DataSetIterator):
+        from deeplearning4j_tpu.evaluation import RegressionEvaluation
+
+        ev = RegressionEvaluation()
+        for ds in iterator:
+            out = self.output(ds.features)
+            ev.eval(ds.labels, out.jax)
+        return ev
+
+    # ------------------------------------------------------------------
+    # parameter access (reference: params()/setParams() flat views)
+    # ------------------------------------------------------------------
+    def _flat_order(self):
+        """Deterministic (layer, key) order for the flat param vector."""
+        order = []
+        for i, p in enumerate(self.params_list):
+            for k in sorted(p):
+                order.append((i, k))
+        return order
+
+    def params(self) -> NDArray:
+        """Single flat param vector (reference's flat view — here a copy;
+        mutation goes through setParams, not aliasing)."""
+        self._check_init()
+        parts = [self.params_list[i][k].ravel() for i, k in self._flat_order()]
+        return NDArray(jnp.concatenate(parts)) if parts else NDArray(jnp.zeros(0))
+
+    def setParams(self, flat) -> None:
+        self._check_init()
+        v = _unwrap(flat)
+        off = 0
+        for i, k in self._flat_order():
+            cur = self.params_list[i][k]
+            n = cur.size
+            self.params_list[i][k] = v[off:off + n].reshape(cur.shape).astype(cur.dtype)
+            off += n
+        if off != v.size:
+            raise ValueError(f"Param length mismatch: {off} vs {v.size}")
+
+    def numParams(self) -> int:
+        self._check_init()
+        return sum(int(l.size) for p in self.params_list
+                   for l in jax.tree_util.tree_leaves(p))
+
+    def paramTable(self) -> dict:
+        """{'0_W': array, ...} flat name map (reference: paramTable())."""
+        self._check_init()
+        return {f"{i}_{k}": NDArray(self.params_list[i][k])
+                for i, k in self._flat_order()}
+
+    # ------------------------------------------------------------------
+    # listeners / misc (reference: setListeners, summary)
+    # ------------------------------------------------------------------
+    def setListeners(self, *listeners):
+        self._listeners = list(listeners)
+        return self
+
+    def addListeners(self, *listeners):
+        self._listeners.extend(listeners)
+        return self
+
+    def getListeners(self):
+        return list(self._listeners)
+
+    def getIterationCount(self) -> int:
+        return self._iteration
+
+    def getEpochCount(self) -> int:
+        return self._epoch
+
+    def summary(self) -> str:
+        self._check_init()
+        lines = [f"{'idx':<4}{'layer':<28}{'params':>12}  out_type"]
+        it = self.conf.input_type
+        total = 0
+        for i, layer in enumerate(self.conf.layers):
+            n = sum(int(l.size) for l in jax.tree_util.tree_leaves(self.params_list[i]))
+            total += n
+            ot = layer.output_type(it) if it else None
+            lines.append(f"{i:<4}{type(layer).__name__:<28}{n:>12,}  "
+                         f"{(ot.kind + str(ot.example_shape())) if ot else '?'}")
+            it = ot
+        lines.append(f"Total params: {total:,}")
+        return "\n".join(lines)
+
+    def clone(self) -> "MultiLayerNetwork":
+        m = MultiLayerNetwork(self.conf)
+        if self.params_list is not None:
+            m.init()
+            m.params_list = jax.tree_util.tree_map(lambda a: a, self.params_list)
+            m.states_list = jax.tree_util.tree_map(lambda a: a, self.states_list)
+            m.opt_states = jax.tree_util.tree_map(lambda a: a, self.opt_states)
+        return m

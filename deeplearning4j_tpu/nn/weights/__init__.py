@@ -1,0 +1,101 @@
+"""Weight initialization (reference: org/deeplearning4j/nn/weights/** —
+WeightInit enum + IWeightInit impls, SURVEY.md §2.17).
+
+Fan-in/fan-out semantics follow the reference's WeightInitUtil: for
+dense [in, out] fanIn=in, fanOut=out; for convs fanIn=kh*kw*cin,
+fanOut=kh*kw*cout. All draws take an explicit jax PRNG key (the trainer
+splits keys deterministically at init, so init is reproducible from the
+model seed — matching the reference's seeded RNG contract).
+"""
+
+from __future__ import annotations
+
+import enum
+
+import jax
+import jax.numpy as jnp
+
+
+class WeightInit(enum.Enum):
+    """Reference: org.deeplearning4j.nn.weights.WeightInit."""
+
+    ZERO = "zero"
+    ONES = "ones"
+    CONSTANT = "constant"
+    NORMAL = "normal"
+    UNIFORM = "uniform"
+    XAVIER = "xavier"
+    XAVIER_UNIFORM = "xavier_uniform"
+    XAVIER_FAN_IN = "xavier_fan_in"
+    LECUN_NORMAL = "lecun_normal"
+    LECUN_UNIFORM = "lecun_uniform"
+    RELU = "relu"              # He normal
+    RELU_UNIFORM = "relu_uniform"
+    HE_NORMAL = "he_normal"
+    HE_UNIFORM = "he_uniform"
+    SIGMOID_UNIFORM = "sigmoid_uniform"
+    VAR_SCALING_NORMAL_FAN_IN = "var_scaling_normal_fan_in"
+    VAR_SCALING_NORMAL_FAN_OUT = "var_scaling_normal_fan_out"
+    VAR_SCALING_NORMAL_FAN_AVG = "var_scaling_normal_fan_avg"
+    IDENTITY = "identity"
+
+    @staticmethod
+    def resolve(w) -> "WeightInit":
+        if isinstance(w, WeightInit):
+            return w
+        if isinstance(w, str):
+            return (WeightInit[w.upper()] if w.upper() in WeightInit.__members__
+                    else WeightInit(w.lower()))
+        raise ValueError(f"Cannot resolve weight init: {w!r}")
+
+
+def init_weights(scheme, key, shape, fan_in: float, fan_out: float,
+                 dtype=jnp.float32, gain: float = 1.0):
+    """Draw a weight tensor per the scheme (reference: WeightInitUtil)."""
+    w = WeightInit.resolve(scheme)
+    if w is WeightInit.ZERO:
+        return jnp.zeros(shape, dtype)
+    if w is WeightInit.ONES:
+        return jnp.ones(shape, dtype)
+    if w is WeightInit.CONSTANT:
+        return jnp.full(shape, gain, dtype)
+    if w is WeightInit.NORMAL:
+        return jax.random.normal(key, shape, dtype) / jnp.sqrt(fan_in)
+    if w is WeightInit.UNIFORM:
+        a = jnp.sqrt(1.0 / fan_in)
+        return jax.random.uniform(key, shape, dtype, -a, a)
+    if w is WeightInit.XAVIER:
+        std = jnp.sqrt(2.0 / (fan_in + fan_out))
+        return std * jax.random.normal(key, shape, dtype)
+    if w is WeightInit.XAVIER_UNIFORM:
+        a = jnp.sqrt(6.0 / (fan_in + fan_out))
+        return jax.random.uniform(key, shape, dtype, -a, a)
+    if w is WeightInit.XAVIER_FAN_IN:
+        return jax.random.normal(key, shape, dtype) / jnp.sqrt(fan_in)
+    if w is WeightInit.LECUN_NORMAL:
+        return jnp.sqrt(1.0 / fan_in) * jax.random.normal(key, shape, dtype)
+    if w is WeightInit.LECUN_UNIFORM:
+        a = jnp.sqrt(3.0 / fan_in)
+        return jax.random.uniform(key, shape, dtype, -a, a)
+    if w in (WeightInit.RELU, WeightInit.HE_NORMAL):
+        return jnp.sqrt(2.0 / fan_in) * jax.random.normal(key, shape, dtype)
+    if w in (WeightInit.RELU_UNIFORM, WeightInit.HE_UNIFORM):
+        a = jnp.sqrt(6.0 / fan_in)
+        return jax.random.uniform(key, shape, dtype, -a, a)
+    if w is WeightInit.SIGMOID_UNIFORM:
+        a = 4.0 * jnp.sqrt(6.0 / (fan_in + fan_out))
+        return jax.random.uniform(key, shape, dtype, -a, a)
+    if w is WeightInit.VAR_SCALING_NORMAL_FAN_IN:
+        return jnp.sqrt(gain / fan_in) * jax.random.normal(key, shape, dtype)
+    if w is WeightInit.VAR_SCALING_NORMAL_FAN_OUT:
+        return jnp.sqrt(gain / fan_out) * jax.random.normal(key, shape, dtype)
+    if w is WeightInit.VAR_SCALING_NORMAL_FAN_AVG:
+        return jnp.sqrt(2.0 * gain / (fan_in + fan_out)) * jax.random.normal(key, shape, dtype)
+    if w is WeightInit.IDENTITY:
+        if len(shape) == 2 and shape[0] == shape[1]:
+            return jnp.eye(shape[0], dtype=dtype)
+        raise ValueError("IDENTITY init requires square 2D shape")
+    raise ValueError(f"Unhandled weight init: {w}")
+
+
+__all__ = ["WeightInit", "init_weights"]

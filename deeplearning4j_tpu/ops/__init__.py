@@ -1,0 +1,21 @@
+"""Op set (reference: nd4j op hierarchy + libnd4j declarable ops).
+
+The reference registers ~500 C++ ``DeclarableOp``s in an
+``OpRegistrator`` keyed by name/hash and dispatches each eagerly through
+JNI (SURVEY.md §2.2, §2.6, §3.3). Here ops are pure jax functions over
+``jax.Array`` registered by name; they compose freely under ``jit`` so
+XLA fuses them — the per-op dispatch stack the reference pays for every
+call exists here only at the eager API edge (``Nd4j.exec``).
+
+Modules:
+- registry: name -> fn registration and dispatch
+- transforms: elementwise/activation math (reference: Transforms.java)
+- nn: conv/pool/norm/rnn/attention ops (reference: ops/declarable/generic/nn)
+- random: distribution ops
+- compression: threshold gradient encode/decode (reference: encodeThreshold)
+"""
+
+from deeplearning4j_tpu.ops.registry import get_op, list_ops, register_op
+from deeplearning4j_tpu.ops import transforms, nn, random, compression  # noqa: F401 (register)
+
+__all__ = ["get_op", "list_ops", "register_op"]

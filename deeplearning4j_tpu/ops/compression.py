@@ -1,0 +1,74 @@
+"""Threshold gradient compression (reference: libnd4j encodeThreshold /
+decodeThreshold ops + EncodedGradientsAccumulator, SURVEY.md §2.29).
+
+The reference threshold-encodes gradients into sparse int indices for
+Aeron UDP broadcast, keeping the sub-threshold remainder as a residual.
+On TPU, intra-slice all-reduce rides ICI and needs no compression (the
+whole subsystem collapses into ``psum``); this module exists for the
+**DCN multi-slice path** where bandwidth can bind, and for capability
+parity. Two XLA-friendly encodings (both static-shape, jit-safe):
+
+- ternary: sign(g)*t where |g|>=t, stored as int8 — 4x smaller than f32,
+  exact equivalent of the reference's "threshold element" semantics.
+- topk: fixed-capacity sparse (indices, values) via lax.top_k — the
+  static-shape analog of the reference's variable-length index list.
+
+Both return the residual (g - encoded) exactly as the reference's
+residual post-processors do.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deeplearning4j_tpu.ops.registry import register_op
+
+
+@register_op("encode_threshold")
+def encode_threshold(grad, threshold):
+    """Ternary threshold encoding.
+
+    Returns (encoded_int8, residual). decode = encoded * threshold.
+    """
+    flat = grad
+    mask = jnp.abs(flat) >= threshold
+    enc = jnp.where(mask, jnp.sign(flat), 0.0)
+    residual = flat - enc * threshold
+    return enc.astype(jnp.int8), residual
+
+
+@register_op("decode_threshold")
+def decode_threshold(encoded, threshold, dtype=jnp.float32):
+    return encoded.astype(dtype) * threshold
+
+
+@register_op("encode_topk")
+def encode_topk(grad, k):
+    """Fixed-capacity sparse encoding: largest-|g| k elements.
+
+    grad: flat [D]. Returns (indices int32 [k], values [k], residual [D]).
+    """
+    absg = jnp.abs(grad)
+    _, idx = lax.top_k(absg, k)
+    vals = grad[idx]
+    residual = grad.at[idx].set(0.0)
+    return idx.astype(jnp.int32), vals, residual
+
+
+@register_op("decode_topk")
+def decode_topk(indices, values, size):
+    out = jnp.zeros((size,), values.dtype)
+    return out.at[indices].add(values)
+
+
+@register_op("adaptive_threshold")
+def adaptive_threshold(grad, target_sparsity=1e-3, current_threshold=1e-3,
+                       decay=0.95, min_threshold=1e-5):
+    """Adaptive threshold update (reference: AdaptiveThresholdAlgorithm —
+    adjusts threshold so encoded density tracks a target)."""
+    density = jnp.mean((jnp.abs(grad) >= current_threshold).astype(jnp.float32))
+    too_dense = density > target_sparsity
+    new_t = jnp.where(too_dense, current_threshold / decay, current_threshold * decay)
+    return jnp.maximum(new_t, min_threshold)

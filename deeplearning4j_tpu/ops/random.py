@@ -1,0 +1,51 @@
+"""Random/distribution ops (reference: libnd4j random loops + Philox
+RandomGenerator, SURVEY.md §2.39). Pure jax, explicit-key style: under
+jit the key is an argument, eager calls go through Nd4j.getRandom().
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.ops.registry import register_op
+
+
+@register_op("random_uniform")
+def random_uniform(rng, shape, minval=0.0, maxval=1.0, dtype=jnp.float32):
+    return jax.random.uniform(rng, shape, dtype=dtype, minval=minval, maxval=maxval)
+
+
+@register_op("random_normal")
+def random_normal(rng, shape, mean=0.0, std=1.0, dtype=jnp.float32):
+    return mean + std * jax.random.normal(rng, shape, dtype=dtype)
+
+
+@register_op("random_bernoulli")
+def random_bernoulli(rng, shape, p=0.5, dtype=jnp.float32):
+    return jax.random.bernoulli(rng, p, shape).astype(dtype)
+
+
+@register_op("random_exponential")
+def random_exponential(rng, shape, lam=1.0, dtype=jnp.float32):
+    return jax.random.exponential(rng, shape, dtype=dtype) / lam
+
+
+@register_op("truncated_normal")
+def truncated_normal(rng, shape, mean=0.0, std=1.0, dtype=jnp.float32):
+    return mean + std * jax.random.truncated_normal(rng, -2.0, 2.0, shape, dtype=dtype)
+
+
+@register_op("random_gamma")
+def random_gamma(rng, shape, alpha=1.0, dtype=jnp.float32):
+    return jax.random.gamma(rng, alpha, shape, dtype=dtype)
+
+
+@register_op("random_poisson")
+def random_poisson(rng, shape, lam=1.0, dtype=jnp.int32):
+    return jax.random.poisson(rng, lam, shape, dtype=dtype)
+
+
+@register_op("dropout_mask")
+def dropout_mask(rng, shape, keep_prob, dtype=jnp.float32):
+    return jax.random.bernoulli(rng, keep_prob, shape).astype(dtype) / keep_prob

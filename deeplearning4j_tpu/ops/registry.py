@@ -1,0 +1,41 @@
+"""Op registry (reference: libnd4j OpRegistrator + nd4j DynamicCustomOp).
+
+The reference hashes op names to C++ implementations and exposes
+``Nd4j.exec(CustomOp)``. Here registration is a decorator; lookup is by
+name. Registered ops are pure jax functions — safe to call inside jit.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+_REGISTRY: Dict[str, Callable] = {}
+
+
+def register_op(name: str):
+    """Register a pure-jax op under `name` (and return it unchanged)."""
+
+    def deco(fn: Callable) -> Callable:
+        if name in _REGISTRY:
+            raise ValueError(f"op already registered: {name}")
+        _REGISTRY[name] = fn
+        return fn
+
+    return deco
+
+
+def get_op(name: str) -> Callable:
+    try:
+        return _REGISTRY[name]
+    except KeyError:
+        raise KeyError(
+            f"Unknown op '{name}'. Registered: {sorted(_REGISTRY)[:20]}..."
+        ) from None
+
+
+def list_ops() -> list[str]:
+    return sorted(_REGISTRY)
+
+
+def has_op(name: str) -> bool:
+    return name in _REGISTRY

@@ -1,0 +1,326 @@
+"""Elementwise transform ops (reference: org/nd4j/linalg/ops/transforms/
+Transforms.java and libnd4j legacy transform loops, SURVEY.md §2.7).
+
+All functions are pure jax (VPU-mapped elementwise under XLA) and are
+registered in the op registry by their reference names. The `Transforms`
+class mirrors the reference's static API over NDArray for eager use.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.ndarray.ndarray import NDArray, _unwrap
+from deeplearning4j_tpu.ops.registry import register_op
+
+
+# -- raw jax ops (jit-friendly) ----------------------------------------
+@register_op("sigmoid")
+def sigmoid(x):
+    return jax.nn.sigmoid(x)
+
+
+@register_op("tanh")
+def tanh(x):
+    return jnp.tanh(x)
+
+
+@register_op("relu")
+def relu(x):
+    return jax.nn.relu(x)
+
+
+@register_op("relu6")
+def relu6(x):
+    return jax.nn.relu6(x)
+
+
+@register_op("leakyrelu")
+def leaky_relu(x, alpha=0.01):
+    return jax.nn.leaky_relu(x, alpha)
+
+
+@register_op("elu")
+def elu(x, alpha=1.0):
+    return jax.nn.elu(x, alpha)
+
+
+@register_op("selu")
+def selu(x):
+    return jax.nn.selu(x)
+
+
+@register_op("gelu")
+def gelu(x):
+    return jax.nn.gelu(x)
+
+
+@register_op("softplus")
+def softplus(x):
+    return jax.nn.softplus(x)
+
+
+@register_op("softsign")
+def softsign(x):
+    return jax.nn.soft_sign(x)
+
+
+@register_op("swish")
+def swish(x):
+    return jax.nn.swish(x)
+
+
+@register_op("mish")
+def mish(x):
+    return x * jnp.tanh(jax.nn.softplus(x))
+
+
+@register_op("hardsigmoid")
+def hard_sigmoid(x):
+    return jnp.clip(0.2 * x + 0.5, 0.0, 1.0)
+
+
+@register_op("hardtanh")
+def hard_tanh(x):
+    return jnp.clip(x, -1.0, 1.0)
+
+
+@register_op("softmax")
+def softmax(x, axis=-1):
+    return jax.nn.softmax(x, axis=axis)
+
+
+@register_op("log_softmax")
+def log_softmax(x, axis=-1):
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+@register_op("exp")
+def exp(x):
+    return jnp.exp(x)
+
+
+@register_op("log")
+def log(x):
+    return jnp.log(x)
+
+
+@register_op("log1p")
+def log1p(x):
+    return jnp.log1p(x)
+
+
+@register_op("sqrt")
+def sqrt(x):
+    return jnp.sqrt(x)
+
+
+@register_op("rsqrt")
+def rsqrt(x):
+    return jax.lax.rsqrt(x)
+
+
+@register_op("abs")
+def abs_(x):
+    return jnp.abs(x)
+
+
+@register_op("sign")
+def sign(x):
+    return jnp.sign(x)
+
+
+@register_op("floor")
+def floor(x):
+    return jnp.floor(x)
+
+
+@register_op("ceil")
+def ceil(x):
+    return jnp.ceil(x)
+
+
+@register_op("round")
+def round_(x):
+    return jnp.round(x)
+
+
+@register_op("pow")
+def pow_(x, p):
+    return jnp.power(x, p)
+
+
+@register_op("square")
+def square(x):
+    return jnp.square(x)
+
+
+@register_op("cube")
+def cube(x):
+    return x * x * x
+
+
+@register_op("reciprocal")
+def reciprocal(x):
+    return 1.0 / x
+
+
+@register_op("sin")
+def sin(x):
+    return jnp.sin(x)
+
+
+@register_op("cos")
+def cos(x):
+    return jnp.cos(x)
+
+
+@register_op("tan")
+def tan(x):
+    return jnp.tan(x)
+
+
+@register_op("asin")
+def asin(x):
+    return jnp.arcsin(x)
+
+
+@register_op("acos")
+def acos(x):
+    return jnp.arccos(x)
+
+
+@register_op("atan")
+def atan(x):
+    return jnp.arctan(x)
+
+
+@register_op("atan2")
+def atan2(y, x):
+    return jnp.arctan2(y, x)
+
+
+@register_op("sinh")
+def sinh(x):
+    return jnp.sinh(x)
+
+
+@register_op("cosh")
+def cosh(x):
+    return jnp.cosh(x)
+
+
+@register_op("erf")
+def erf(x):
+    return jax.scipy.special.erf(x)
+
+
+@register_op("clip_by_value")
+def clip_by_value(x, lo, hi):
+    return jnp.clip(x, lo, hi)
+
+
+@register_op("clip_by_norm")
+def clip_by_norm(x, clip_norm, axis=None):
+    n = jnp.sqrt(jnp.sum(x * x, axis=axis, keepdims=axis is not None))
+    scale = jnp.where(n > clip_norm, clip_norm / jnp.maximum(n, 1e-12), 1.0)
+    return x * scale
+
+
+@register_op("max_pairwise")
+def max_pairwise(x, y):
+    return jnp.maximum(x, y)
+
+
+@register_op("min_pairwise")
+def min_pairwise(x, y):
+    return jnp.minimum(x, y)
+
+
+@register_op("isnan")
+def isnan(x):
+    return jnp.isnan(x)
+
+
+@register_op("isinf")
+def isinf(x):
+    return jnp.isinf(x)
+
+
+@register_op("step")
+def step(x):
+    return (x > 0).astype(x.dtype)
+
+
+@register_op("rationaltanh")
+def rational_tanh(x):
+    # reference: RationalTanh op — tanh approximation f(x)=1.7159*tanh(2x/3)
+    return 1.7159 * jnp.tanh(2.0 * x / 3.0)
+
+
+@register_op("recttanh")
+def rectified_tanh(x):
+    return jnp.maximum(0.0, jnp.tanh(x))
+
+
+@register_op("thresholdedrelu")
+def thresholded_relu(x, theta=1.0):
+    return jnp.where(x > theta, x, 0.0)
+
+
+@register_op("prelu")
+def prelu(x, alpha):
+    return jnp.where(x >= 0, x, alpha * x)
+
+
+@register_op("standardize")
+def standardize(x, axis=-1, eps=1e-5):
+    m = jnp.mean(x, axis=axis, keepdims=True)
+    v = jnp.var(x, axis=axis, keepdims=True)
+    return (x - m) / jnp.sqrt(v + eps)
+
+
+class Transforms:
+    """Eager NDArray front-end mirroring Transforms.java's static API."""
+
+    @staticmethod
+    def _apply(fn, x, *args, **kwargs):
+        out = fn(_unwrap(x), *args, **kwargs)
+        return NDArray(out) if isinstance(x, NDArray) else out
+
+    sigmoid = staticmethod(lambda x: Transforms._apply(sigmoid, x))
+    tanh = staticmethod(lambda x: Transforms._apply(tanh, x))
+    relu = staticmethod(lambda x: Transforms._apply(relu, x))
+    leakyRelu = staticmethod(lambda x, a=0.01: Transforms._apply(leaky_relu, x, a))
+    elu = staticmethod(lambda x: Transforms._apply(elu, x))
+    softmax = staticmethod(lambda x: Transforms._apply(softmax, x))
+    exp = staticmethod(lambda x: Transforms._apply(exp, x))
+    log = staticmethod(lambda x: Transforms._apply(log, x))
+    sqrt = staticmethod(lambda x: Transforms._apply(sqrt, x))
+    abs = staticmethod(lambda x: Transforms._apply(abs_, x))
+    sign = staticmethod(lambda x: Transforms._apply(sign, x))
+    floor = staticmethod(lambda x: Transforms._apply(floor, x))
+    ceil = staticmethod(lambda x: Transforms._apply(ceil, x))
+    round = staticmethod(lambda x: Transforms._apply(round_, x))
+    pow = staticmethod(lambda x, p: Transforms._apply(pow_, x, p))
+    sin = staticmethod(lambda x: Transforms._apply(sin, x))
+    cos = staticmethod(lambda x: Transforms._apply(cos, x))
+    unitVec = staticmethod(lambda x: x.div(x.norm2()))
+    max = staticmethod(lambda x, y: Transforms._apply(max_pairwise, x, _unwrap(y)))
+    min = staticmethod(lambda x, y: Transforms._apply(min_pairwise, x, _unwrap(y)))
+
+    @staticmethod
+    def euclideanDistance(a, b) -> float:
+        d = _unwrap(a) - _unwrap(b)
+        return float(jnp.sqrt(jnp.sum(d * d)))
+
+    @staticmethod
+    def manhattanDistance(a, b) -> float:
+        return float(jnp.sum(jnp.abs(_unwrap(a) - _unwrap(b))))
+
+    @staticmethod
+    def cosineSim(a, b) -> float:
+        av, bv = _unwrap(a).ravel(), _unwrap(b).ravel()
+        denom = jnp.linalg.norm(av) * jnp.linalg.norm(bv)
+        return float(jnp.vdot(av, bv) / jnp.maximum(denom, 1e-12))

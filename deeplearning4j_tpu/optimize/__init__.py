@@ -1,0 +1,14 @@
+"""Training-loop support: listeners, early stopping (reference:
+org/deeplearning4j/optimize/**, SURVEY.md §2.22-2.23)."""
+
+from deeplearning4j_tpu.optimize.listeners import (
+    TrainingListener, ScoreIterationListener, PerformanceListener,
+    CheckpointListener, EvaluativeListener, TimeIterationListener,
+    CollectScoresListener,
+)
+
+__all__ = [
+    "TrainingListener", "ScoreIterationListener", "PerformanceListener",
+    "CheckpointListener", "EvaluativeListener", "TimeIterationListener",
+    "CollectScoresListener",
+]

@@ -1,0 +1,148 @@
+"""Training listeners (reference: org/deeplearning4j/optimize/listeners/**
+— ScoreIterationListener, PerformanceListener, CheckpointListener,
+EvaluativeListener, TimeIterationListener. SURVEY.md §2.23).
+
+Contract: `iterationDone(model, iteration, epoch)` after every step;
+optional `onEpochEnd(model)`. The model calls these synchronously on
+host — listener cost stays off the compiled step.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+from typing import Callable, List, Optional
+
+log = logging.getLogger("deeplearning4j_tpu")
+
+
+class TrainingListener:
+    def iterationDone(self, model, iteration: int, epoch: int):
+        pass
+
+    def onEpochEnd(self, model):
+        pass
+
+
+class ScoreIterationListener(TrainingListener):
+    """Log score every N iterations (reference default N=10)."""
+
+    def __init__(self, print_iterations: int = 10, printer: Callable = None):
+        self.n = max(1, print_iterations)
+        self._print = printer or (lambda s: log.info(s))
+
+    def iterationDone(self, model, iteration, epoch):
+        if iteration % self.n == 0:
+            self._print(
+                f"Score at iteration {iteration} is {model.score()}")
+
+
+class PerformanceListener(TrainingListener):
+    """Throughput tracking (reference: PerformanceListener — iters/sec,
+    examples/sec; ETL time is reported by the async iterator itself)."""
+
+    def __init__(self, frequency: int = 10, report_batch: bool = True,
+                 printer: Callable = None):
+        self.n = max(1, frequency)
+        self.report_batch = report_batch
+        self._print = printer or (lambda s: log.info(s))
+        self._last_time = None
+        self._last_iter = 0
+        self.samples_per_sec = float("nan")
+        self.batches_per_sec = float("nan")
+
+    def iterationDone(self, model, iteration, epoch):
+        now = time.perf_counter()
+        if self._last_time is None:
+            self._last_time = now
+            self._last_iter = iteration
+            return
+        if iteration - self._last_iter >= self.n:
+            dt = now - self._last_time
+            iters = iteration - self._last_iter
+            self.batches_per_sec = iters / dt
+            self._print(f"iteration {iteration}: {self.batches_per_sec:.2f} "
+                        f"batches/sec, score {model.score():.5f}")
+            self._last_time = now
+            self._last_iter = iteration
+
+
+class TimeIterationListener(TrainingListener):
+    """ETA estimation (reference: TimeIterationListener)."""
+
+    def __init__(self, total_iterations: int, printer: Callable = None):
+        self.total = total_iterations
+        self._start = None
+        self._print = printer or (lambda s: log.info(s))
+
+    def iterationDone(self, model, iteration, epoch):
+        if self._start is None:
+            self._start = time.perf_counter()
+            return
+        elapsed = time.perf_counter() - self._start
+        rate = iteration / max(elapsed, 1e-9)
+        remaining = (self.total - iteration) / max(rate, 1e-9)
+        if iteration % 100 == 0:
+            self._print(f"iteration {iteration}/{self.total}, "
+                        f"ETA {remaining:.0f}s")
+
+
+class CollectScoresListener(TrainingListener):
+    """Accumulate (iteration, score) pairs (reference:
+    CollectScoresIterationListener)."""
+
+    def __init__(self, frequency: int = 1):
+        self.n = max(1, frequency)
+        self.scores: List[tuple] = []
+
+    def iterationDone(self, model, iteration, epoch):
+        if iteration % self.n == 0:
+            self.scores.append((iteration, model.score()))
+
+
+class CheckpointListener(TrainingListener):
+    """Periodic checkpoints, keep-last-K (reference: CheckpointListener
+    builder: saveEveryNIterations / keepLast)."""
+
+    def __init__(self, directory: str, save_every_n_iterations: int = 1000,
+                 keep_last: int = 3, save_updater: bool = True):
+        self.dir = directory
+        self.every = save_every_n_iterations
+        self.keep = keep_last
+        self.save_updater = save_updater
+        os.makedirs(directory, exist_ok=True)
+        self._saved: List[str] = []
+
+    def iterationDone(self, model, iteration, epoch):
+        if iteration % self.every != 0:
+            return
+        from deeplearning4j_tpu.util.model_serializer import ModelSerializer
+
+        path = os.path.join(self.dir, f"checkpoint_iter_{iteration}.zip")
+        ModelSerializer.writeModel(model, path, self.save_updater)
+        self._saved.append(path)
+        while len(self._saved) > self.keep:
+            old = self._saved.pop(0)
+            if os.path.exists(old):
+                os.remove(old)
+
+    def lastCheckpoint(self) -> Optional[str]:
+        return self._saved[-1] if self._saved else None
+
+
+class EvaluativeListener(TrainingListener):
+    """Periodic held-out evaluation (reference: EvaluativeListener)."""
+
+    def __init__(self, iterator, frequency: int = 100, printer: Callable = None):
+        self.iterator = iterator
+        self.n = max(1, frequency)
+        self._print = printer or (lambda s: log.info(s))
+        self.history: List[tuple] = []
+
+    def iterationDone(self, model, iteration, epoch):
+        if iteration % self.n != 0:
+            return
+        ev = model.evaluate(self.iterator)
+        self.history.append((iteration, ev.accuracy()))
+        self._print(f"iteration {iteration}: eval accuracy {ev.accuracy():.4f}")

@@ -1,0 +1,22 @@
+"""Parallel training (reference: ParallelWrapper single-node DP,
+EncodedGradientsAccumulator gradient sharing, Aeron parameter server,
+Spark training masters — SURVEY.md §2.28-2.31).
+
+TPU-native design: the reference's entire distribution machinery
+(trainer threads, host accumulators, threshold encoding over UDP mesh)
+collapses into SPMD compilation over a ``jax.sharding.Mesh`` — the
+batch is sharded over the 'data' axis, params are replicated (or
+sharded over 'model' for TP), and XLA inserts the gradient all-reduce
+as an ICI collective fused into the step. ParallelWrapper keeps the
+reference's API shape; ShardedTrainer is the underlying engine;
+gradient compression survives as an *optional* DCN-path transform.
+"""
+
+from deeplearning4j_tpu.parallel.mesh import (
+    build_mesh, data_parallel_spec, replicated_spec,
+)
+from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
+from deeplearning4j_tpu.parallel.sharded import ShardedTrainer
+
+__all__ = ["ParallelWrapper", "ShardedTrainer", "build_mesh",
+           "data_parallel_spec", "replicated_spec"]

@@ -1,0 +1,52 @@
+"""Device mesh utilities (TPU-native replacement for the reference's
+CudaAffinityManager device assignment + MeshOrganizer topology,
+SURVEY.md §2.10, §2.30 — here the 'mesh' is jax.sharding.Mesh and the
+topology is XLA's problem).
+
+Axis convention (scaling-book style):
+- 'data'  — batch sharding (DP)
+- 'model' — tensor parallel (TP) sharding of weight matrices
+Sequence parallelism reuses 'model' for the token axis in attention
+blocks (Ulysses-style all-to-all is expressed as resharding).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def build_mesh(num_data: Optional[int] = None, num_model: int = 1,
+               devices: Optional[Sequence] = None) -> Mesh:
+    """Build a ('data', 'model') mesh over available devices.
+
+    Defaults: all devices on the data axis (pure DP) — the reference's
+    ParallelWrapper default of one worker per GPU.
+    """
+    devs = list(devices if devices is not None else jax.devices())
+    if num_data is None:
+        num_data = len(devs) // num_model
+    if num_data * num_model != len(devs):
+        raise ValueError(
+            f"mesh {num_data}x{num_model} != {len(devs)} devices")
+    arr = np.asarray(devs).reshape(num_data, num_model)
+    return Mesh(arr, axis_names=("data", "model"))
+
+
+def data_parallel_spec(mesh: Mesh, x) -> NamedSharding:
+    """Shard leading (batch) dim over 'data', replicate the rest."""
+    ndim = getattr(x, "ndim", None) or len(x.shape)
+    return NamedSharding(mesh, P("data", *([None] * (ndim - 1))))
+
+
+def replicated_spec(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_batch(mesh: Mesh, *arrays):
+    """Place host arrays sharded over the data axis."""
+    out = [jax.device_put(a, data_parallel_spec(mesh, a)) for a in arrays]
+    return out[0] if len(out) == 1 else out

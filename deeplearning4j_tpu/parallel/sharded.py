@@ -1,0 +1,305 @@
+"""SPMD training engine (reference: the ParallelWrapper trainer stack —
+DefaultTrainer/SymmetricTrainer threads + EncodedGradientsAccumulator +
+(multi-node) SharedTrainingMaster/Aeron mesh. SURVEY.md §2.28-2.31, §3.5).
+
+Three modes, mapping the reference's two distribution strategies onto
+TPU collectives (and keeping its compression semantics as an option):
+
+- 'sharing' (default): synchronous gradient all-reduce. One jit'd step;
+  batch sharded over 'data', params replicated; XLA GSPMD inserts the
+  psum on ICI. This is the reference's GradientSharing endpoint state —
+  except exact (no threshold) because ICI bandwidth makes compression
+  unnecessary intra-slice.
+- 'sharing_compressed': the reference's threshold encoding, faithfully:
+  each shard computes local grads, threshold-encodes (ternary int8),
+  all-reduces the *encoded* tensor, decodes, keeps residual locally
+  (EncodingHandler#broadcastUpdates semantics). Built with shard_map so
+  the collective operates on the compressed representation — the DCN
+  multi-slice path where bandwidth can actually bind.
+- 'averaging': the reference's ParameterAveragingTrainingMaster — each
+  shard trains independently (params diverge), every
+  `averaging_frequency` steps params+updater state are mesh-averaged.
+
+All modes produce ONE compiled executable; no host-side accumulator
+threads exist because no host hop exists.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterator import DataSetIterator
+from deeplearning4j_tpu.learning.updaters import apply_updater
+from deeplearning4j_tpu.nn.multilayer.network import _uses_epoch_schedule
+from deeplearning4j_tpu.ops import compression as comp
+from deeplearning4j_tpu.parallel.mesh import build_mesh
+
+
+def _tmap(f, *trees):
+    return jax.tree_util.tree_map(f, *trees)
+
+
+class ShardedTrainer:
+    def __init__(self, model, mesh: Optional[Mesh] = None,
+                 mode: str = "sharing",
+                 threshold: float = 1e-3,
+                 averaging_frequency: int = 5):
+        if mode not in ("sharing", "sharing_compressed", "averaging"):
+            raise ValueError(f"Unknown mode: {mode}")
+        self.model = model
+        self.mesh = mesh if mesh is not None else build_mesh()
+        self.mode = mode
+        self.threshold = threshold
+        self.averaging_frequency = averaging_frequency
+        self._step = None
+        self._residual = None
+        self._local = None  # per-shard replicas for averaging mode
+        self._n_data = self.mesh.shape["data"]
+
+    # ------------------------------------------------------------------
+    def _place_replicated(self):
+        """Replicate model params/opt/state across the mesh."""
+        spec = NamedSharding(self.mesh, P())
+        m = self.model
+        m.params_list = _tmap(lambda a: jax.device_put(a, spec), m.params_list)
+        m.states_list = _tmap(lambda a: jax.device_put(a, spec), m.states_list)
+        m.opt_states = _tmap(lambda a: jax.device_put(a, spec), m.opt_states)
+
+    def _shard_batch(self, x, y):
+        def spec(a):
+            return NamedSharding(self.mesh, P("data", *([None] * (a.ndim - 1))))
+
+        xj = jnp.asarray(x, self.model._dtype)
+        yj = jnp.asarray(y)
+        return jax.device_put(xj, spec(xj)), jax.device_put(yj, spec(yj))
+
+    # ------------------------------------------------------------------
+    # mode: sharing (GSPMD — compiler-inserted all-reduce)
+    # ------------------------------------------------------------------
+    def _build_sharing_step(self):
+        model = self.model
+
+        def step_fn(params, states, opt, it_step, ep_step, x, y, rng):
+            loss_fn = lambda pl: model._loss(pl, states, x, y, None, rng)
+            (loss, (new_states, data_loss)), grads = \
+                jax.value_and_grad(loss_fn, has_aux=True)(params)
+            grads = model._clip_grads(grads)
+            new_params, new_opt = [], []
+            for i in range(len(params)):
+                step = ep_step if _uses_epoch_schedule(model._updaters[i]) else it_step
+                updates, no = apply_updater(model._updaters[i], opt[i],
+                                            grads[i], params[i], step)
+                new_params.append(_tmap(lambda p, u: p - u, params[i], updates))
+                new_opt.append(no)
+            return new_params, new_states, new_opt, data_loss
+
+        return jax.jit(step_fn, donate_argnums=(0, 1, 2))
+
+    # ------------------------------------------------------------------
+    # mode: sharing_compressed (shard_map + threshold encoding)
+    # ------------------------------------------------------------------
+    def _build_compressed_step(self):
+        model = self.model
+        mesh = self.mesh
+        t = self.threshold
+        n = self._n_data
+
+        def per_device(params, states, opt, residual, it_step, ep_step,
+                       x, y, rng):
+            # decorrelate dropout across shards (reference: each trainer
+            # thread has its own RNG stream)
+            rng = jax.random.fold_in(rng, jax.lax.axis_index("data"))
+            loss_fn = lambda pl: model._loss(pl, states, x, y, None, rng)
+            (loss, (new_states, data_loss)), grads = \
+                jax.value_and_grad(loss_fn, has_aux=True)(params)
+
+            # threshold-encode local grads; all-reduce the ternary code
+            # (int8 -> f32 for the collective), decode; keep residual
+            def enc_dec(g, res):
+                code, new_res = comp.encode_threshold(g + res, t)
+                summed = jax.lax.psum(code.astype(jnp.float32), "data")
+                return summed * (t / n), new_res
+
+            flat_g, treedef = jax.tree_util.tree_flatten(grads)
+            flat_r = jax.tree_util.tree_leaves(residual)
+            decoded, new_res = [], []
+            for g, r in zip(flat_g, flat_r):
+                d, nr = enc_dec(g, r)
+                decoded.append(d)
+                new_res.append(nr)
+            grads = jax.tree_util.tree_unflatten(treedef, decoded)
+            residual = jax.tree_util.tree_unflatten(treedef, new_res)
+
+            grads = model._clip_grads(grads)
+            new_params, new_opt = [], []
+            for i in range(len(params)):
+                step = ep_step if _uses_epoch_schedule(model._updaters[i]) else it_step
+                updates, no = apply_updater(model._updaters[i], opt[i],
+                                            grads[i], params[i], step)
+                new_params.append(_tmap(lambda p, u: p - u, params[i], updates))
+                new_opt.append(no)
+            # states (BN running stats) averaged across shards
+            new_states = _tmap(lambda s: jax.lax.pmean(s, "data"), new_states)
+            loss_mean = jax.lax.pmean(data_loss, "data")
+            return new_params, new_states, new_opt, residual, loss_mean
+
+        rep = P()
+        dp = lambda a: P("data", *([None] * (a.ndim - 1)))
+
+        def step_fn(params, states, opt, residual, it_step, ep_step, x, y, rng):
+            in_specs = (
+                _tmap(lambda _: rep, params),
+                _tmap(lambda _: rep, states),
+                _tmap(lambda _: rep, opt),
+                _tmap(lambda _: rep, residual),
+                rep, rep,
+                dp(x), dp(y), rep,
+            )
+            out_specs = (
+                _tmap(lambda _: rep, params),
+                _tmap(lambda _: rep, states),
+                _tmap(lambda _: rep, opt),
+                _tmap(lambda _: rep, residual),
+                rep,
+            )
+            fn = shard_map(per_device, mesh=mesh, in_specs=in_specs,
+                           out_specs=out_specs, check_rep=False)
+            return fn(params, states, opt, residual, it_step, ep_step, x, y, rng)
+
+        return jax.jit(step_fn, donate_argnums=(0, 1, 2, 3))
+
+    # ------------------------------------------------------------------
+    # mode: averaging (independent local steps + periodic mesh average)
+    # ------------------------------------------------------------------
+    def _build_averaging_step(self):
+        model = self.model
+        mesh = self.mesh
+
+        def per_device(params, states, opt, it_step, ep_step, x, y, rng,
+                       do_avg):
+            rng = jax.random.fold_in(rng, jax.lax.axis_index("data"))
+            loss_fn = lambda pl: model._loss(pl, states, x, y, None, rng)
+            (loss, (new_states, data_loss)), grads = \
+                jax.value_and_grad(loss_fn, has_aux=True)(params)
+            grads = model._clip_grads(grads)
+            new_params, new_opt = [], []
+            for i in range(len(params)):
+                step = ep_step if _uses_epoch_schedule(model._updaters[i]) else it_step
+                updates, no = apply_updater(model._updaters[i], opt[i],
+                                            grads[i], params[i], step)
+                new_params.append(_tmap(lambda p, u: p - u, params[i], updates))
+                new_opt.append(no)
+            # periodic parameter + updater-state averaging (reference:
+            # ParameterAveragingTrainingMaster averages BOTH)
+            avg = lambda v: jnp.where(do_avg, jax.lax.pmean(v, "data"), v)
+            new_params = _tmap(avg, new_params)
+            new_opt = _tmap(avg, new_opt)
+            new_states = _tmap(lambda s: jax.lax.pmean(s, "data"), new_states)
+            return new_params, new_states, new_opt, jax.lax.pmean(data_loss, "data")
+
+        rep = P()
+        # params/opt per-shard DIVERGE between averaging points: they are
+        # stacked on a leading 'data' axis outside, split inside
+        pd = lambda _: P("data")
+        dp = lambda a: P("data", *([None] * (a.ndim - 1)))
+
+        def step_fn(params_stacked, states, opt_stacked, it_step, ep_step,
+                    x, y, rng, do_avg):
+            in_specs = (
+                _tmap(pd, params_stacked),
+                _tmap(lambda _: rep, states),
+                _tmap(pd, opt_stacked),
+                rep, rep, dp(x), dp(y), rep, rep,
+            )
+            out_specs = (
+                _tmap(pd, params_stacked),
+                _tmap(lambda _: rep, states),
+                _tmap(pd, opt_stacked),
+                rep,
+            )
+
+            def body(params_s, states_, opt_s, it_s, ep_s, x_, y_, rng_, da_):
+                # strip the leading per-device axis added by stacking
+                params = _tmap(lambda a: a[0], params_s)
+                opt = _tmap(lambda a: a[0], opt_s)
+                np_, ns_, no_, loss = per_device(params, states_, opt,
+                                                 it_s, ep_s, x_, y_, rng_, da_)
+                return (_tmap(lambda a: a[None], np_), ns_,
+                        _tmap(lambda a: a[None], no_), loss)
+
+            fn = shard_map(body, mesh=mesh, in_specs=in_specs,
+                           out_specs=out_specs, check_rep=False)
+            return fn(params_stacked, states, opt_stacked, it_step, ep_step,
+                      x, y, rng, do_avg)
+
+        return jax.jit(step_fn, donate_argnums=(0, 1, 2))
+
+    # ------------------------------------------------------------------
+    def fit(self, data, labels=None, epochs: int = 1):
+        model = self.model
+        if isinstance(data, DataSetIterator):
+            for _ in range(epochs):
+                for ds in data:
+                    self._fit_batch(ds.features, ds.labels)
+                model._epoch += 1
+            return model
+        if isinstance(data, DataSet):
+            for _ in range(epochs):
+                self._fit_batch(data.features, data.labels)
+            return model
+        for _ in range(epochs):
+            self._fit_batch(data, labels)
+        return model
+
+    def _fit_batch(self, x, y):
+        model = self.model
+        if self._step is None:
+            self._place_replicated()
+            if self.mode == "sharing":
+                self._step = self._build_sharing_step()
+            elif self.mode == "sharing_compressed":
+                self._step = self._build_compressed_step()
+                self._residual = _tmap(jnp.zeros_like, model.params_list)
+            else:
+                self._step = self._build_averaging_step()
+                stack = lambda a: jnp.broadcast_to(a[None], (self._n_data,) + a.shape)
+                self._local = (
+                    _tmap(stack, model.params_list),
+                    _tmap(stack, model.opt_states),
+                )
+        x, y = self._shard_batch(x, y)
+        model._rng_key, sub = jax.random.split(model._rng_key)
+        it_s = jnp.asarray(model._iteration)
+        ep_s = jnp.asarray(model._epoch)
+
+        if self.mode == "sharing":
+            (model.params_list, model.states_list, model.opt_states,
+             loss) = self._step(model.params_list, model.states_list,
+                                model.opt_states, it_s, ep_s, x, y, sub)
+        elif self.mode == "sharing_compressed":
+            (model.params_list, model.states_list, model.opt_states,
+             self._residual, loss) = self._step(
+                model.params_list, model.states_list, model.opt_states,
+                self._residual, it_s, ep_s, x, y, sub)
+        else:
+            do_avg = jnp.asarray(
+                (model._iteration + 1) % self.averaging_frequency == 0)
+            ps, opts = self._local
+            (ps, model.states_list, opts, loss) = self._step(
+                ps, model.states_list, opts, it_s, ep_s, x, y, sub, do_avg)
+            self._local = (ps, opts)
+            # the model's canonical params = shard 0 view
+            model.params_list = _tmap(lambda a: a[0], ps)
+            model.opt_states = _tmap(lambda a: a[0], opts)
+
+        model._score = float(loss)
+        model._iteration += 1
+        for l in model._listeners:
+            l.iterationDone(model, model._iteration, model._epoch)

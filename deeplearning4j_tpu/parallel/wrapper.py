@@ -1,0 +1,93 @@
+"""ParallelWrapper (reference: org/deeplearning4j/parallelism/
+ParallelWrapper.java — builder API, workers, trainingMode
+{AVERAGING, SHARED_GRADIENTS}, averagingFrequency. SURVEY.md §2.28).
+
+The reference spawns one trainer thread per GPU with a host-side
+gradient accumulator; here `workers` selects how many mesh devices the
+single compiled SPMD step spans. ParallelInference is the same idea for
+batched inference.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+from deeplearning4j_tpu.parallel.mesh import build_mesh
+from deeplearning4j_tpu.parallel.sharded import ShardedTrainer
+
+
+class ParallelWrapper:
+    """API-parity front-end over ShardedTrainer."""
+
+    AVERAGING = "averaging"
+    SHARED_GRADIENTS = "sharing"
+    SHARED_GRADIENTS_COMPRESSED = "sharing_compressed"
+
+    def __init__(self, model, workers: Optional[int] = None,
+                 training_mode: str = "sharing",
+                 averaging_frequency: int = 5,
+                 threshold: float = 1e-3):
+        devs = jax.devices()
+        workers = workers or len(devs)
+        if workers > len(devs):
+            raise ValueError(f"workers={workers} > devices={len(devs)}")
+        mesh = build_mesh(num_data=workers, num_model=1,
+                          devices=devs[:workers])
+        self.workers = workers
+        self._trainer = ShardedTrainer(
+            model, mesh=mesh, mode=training_mode,
+            averaging_frequency=averaging_frequency, threshold=threshold)
+
+    # reference: ParallelWrapper.Builder fluent API
+    class Builder:
+        def __init__(self, model):
+            self._model = model
+            self._workers = None
+            self._mode = "sharing"
+            self._freq = 5
+            self._threshold = 1e-3
+
+        def workers(self, n: int):
+            self._workers = n
+            return self
+
+        def trainingMode(self, mode: str):
+            self._mode = mode
+            return self
+
+        def averagingFrequency(self, k: int):
+            self._freq = k
+            return self
+
+        def thresholdAlgorithm(self, threshold: float):
+            self._threshold = threshold
+            return self
+
+        def prefetchBuffer(self, n: int):
+            return self  # async prefetch handled by AsyncDataSetIterator
+
+        def build(self) -> "ParallelWrapper":
+            return ParallelWrapper(self._model, self._workers, self._mode,
+                                   self._freq, self._threshold)
+
+    def fit(self, data, labels=None, epochs: int = 1):
+        return self._trainer.fit(data, labels, epochs=epochs)
+
+
+class ParallelInference:
+    """Sharded batch inference (reference: ParallelInference)."""
+
+    def __init__(self, model, workers: Optional[int] = None):
+        devs = jax.devices()
+        workers = workers or len(devs)
+        self.model = model
+        self.mesh = build_mesh(num_data=workers, num_model=1,
+                               devices=devs[:workers])
+
+    def output(self, x):
+        from deeplearning4j_tpu.parallel.mesh import shard_batch
+
+        xs = shard_batch(self.mesh, x)
+        return self.model.output(xs)

@@ -1,0 +1,5 @@
+"""Utilities: model serialization, crash reporting."""
+
+from deeplearning4j_tpu.util.model_serializer import ModelSerializer
+
+__all__ = ["ModelSerializer"]

@@ -1,0 +1,139 @@
+"""Model serialization (reference: org/deeplearning4j/util/
+ModelSerializer.java — zip of configuration.json + coefficients.bin +
+updaterState.bin + optional normalizer; exact resume including optimizer
+state. SURVEY.md §2.24, §5 checkpoint/resume).
+
+Same zip layout, TPU-native payloads:
+- configuration.json — the MultiLayerConfiguration JSON round-trip
+- coefficients.npz   — per-layer param arrays, keys "<idx>/<name>"
+- state.npz          — non-trainable layer state (BN running stats)
+- updaterState.npz   — updater state pytree, flattened with path keys
+- meta.json          — iteration/epoch counters, framework version
+
+Exact-resume contract: load → continue training with bit-identical
+updater behavior (tested in tests/test_serialization.py).
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import zipfile
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _flatten_with_paths(tree, prefix=""):
+    """Flatten a pytree of arrays to {path: array} with '/'-joined keys."""
+    out = {}
+    if isinstance(tree, dict):
+        for k in sorted(tree):
+            out.update(_flatten_with_paths(tree[k], f"{prefix}{k}/"))
+    elif isinstance(tree, (list, tuple)):
+        for i, v in enumerate(tree):
+            out.update(_flatten_with_paths(v, f"{prefix}{i}/"))
+    elif tree is None:
+        pass
+    else:
+        out[prefix[:-1]] = np.asarray(tree)
+    return out
+
+
+def _unflatten_into(template, flat, prefix=""):
+    """Rebuild arrays into the shape of `template` from {path: array}."""
+    if isinstance(template, dict):
+        return {k: _unflatten_into(template[k], flat, f"{prefix}{k}/")
+                for k in template}
+    if isinstance(template, tuple):
+        return tuple(_unflatten_into(v, flat, f"{prefix}{i}/")
+                     for i, v in enumerate(template))
+    if isinstance(template, list):
+        return [_unflatten_into(v, flat, f"{prefix}{i}/")
+                for i, v in enumerate(template)]
+    if template is None:
+        return None
+    key = prefix[:-1]
+    return jnp.asarray(flat[key])
+
+
+def _write_npz(zf: zipfile.ZipFile, name: str, arrays: dict):
+    buf = io.BytesIO()
+    np.savez(buf, **arrays)
+    zf.writestr(name, buf.getvalue())
+
+
+def _read_npz(zf: zipfile.ZipFile, name: str) -> dict:
+    with zf.open(name) as f:
+        data = np.load(io.BytesIO(f.read()))
+        return {k: data[k] for k in data.files}
+
+
+class ModelSerializer:
+    @staticmethod
+    def writeModel(model, path: str, save_updater: bool = True,
+                   normalizer=None) -> None:
+        """Reference: ModelSerializer.writeModel(model, file, saveUpdater)."""
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
+            zf.writestr("configuration.json", model.conf.to_json())
+            _write_npz(zf, "coefficients.npz",
+                       _flatten_with_paths(model.params_list))
+            _write_npz(zf, "state.npz", _flatten_with_paths(model.states_list))
+            if save_updater and model.opt_states is not None:
+                _write_npz(zf, "updaterState.npz",
+                           _flatten_with_paths(model.opt_states))
+            meta = {"iteration": model.getIterationCount(),
+                    "epoch": model.getEpochCount(),
+                    "format": "deeplearning4j_tpu-1",
+                    "model_type": type(model).__name__}
+            zf.writestr("meta.json", json.dumps(meta))
+            if normalizer is not None:
+                _write_npz(zf, "normalizer.npz",
+                           _flatten_with_paths(normalizer.state_dict()))
+                zf.writestr("normalizer.json", json.dumps(
+                    {"type": type(normalizer).__name__}))
+
+    @staticmethod
+    def restoreMultiLayerNetwork(path: str, load_updater: bool = True):
+        """Reference: ModelSerializer.restoreMultiLayerNetwork."""
+        from deeplearning4j_tpu.nn.conf.builder import MultiLayerConfiguration
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+        with zipfile.ZipFile(path) as zf:
+            conf = MultiLayerConfiguration.from_json(
+                zf.read("configuration.json").decode())
+            model = MultiLayerNetwork(conf).init()
+            coeff = _read_npz(zf, "coefficients.npz")
+            model.params_list = _unflatten_into(model.params_list, coeff)
+            states = _read_npz(zf, "state.npz")
+            if states:
+                model.states_list = _unflatten_into(model.states_list, states)
+            if load_updater and "updaterState.npz" in zf.namelist():
+                upd = _read_npz(zf, "updaterState.npz")
+                model.opt_states = _unflatten_into(model.opt_states, upd)
+            meta = json.loads(zf.read("meta.json").decode())
+            model._iteration = meta.get("iteration", 0)
+            model._epoch = meta.get("epoch", 0)
+        return model
+
+    @staticmethod
+    def restoreNormalizer(path: str):
+        from deeplearning4j_tpu.datasets.normalizers import (
+            ImagePreProcessingScaler, NormalizerMinMaxScaler,
+            NormalizerStandardize)
+
+        with zipfile.ZipFile(path) as zf:
+            if "normalizer.json" not in zf.namelist():
+                return None
+            info = json.loads(zf.read("normalizer.json").decode())
+            state = _read_npz(zf, "normalizer.npz")
+            cls = {"NormalizerStandardize": NormalizerStandardize,
+                   "NormalizerMinMaxScaler": NormalizerMinMaxScaler,
+                   "ImagePreProcessingScaler": ImagePreProcessingScaler}[info["type"]]
+            n = cls()
+            n.load_state_dict(state)
+            return n

@@ -1,0 +1,17 @@
+"""Model zoo (reference: deeplearning4j-zoo/.../zoo/model/** — LeNet,
+AlexNet, VGG16, ResNet50, TinyYOLO, UNet, Darknet19, ... SURVEY.md §2.33).
+
+Each zoo model mirrors the reference's ZooModel surface: a builder with
+numClasses/seed/updater knobs and `init()` returning a ready
+MultiLayerNetwork or ComputationGraph. `initPretrained()` exists but —
+with zero network egress in the build environment — raises with guidance
+unless a local weights path is supplied.
+"""
+
+from deeplearning4j_tpu.zoo.lenet import LeNet
+from deeplearning4j_tpu.zoo.alexnet import AlexNet
+from deeplearning4j_tpu.zoo.vgg16 import VGG16
+from deeplearning4j_tpu.zoo.resnet50 import ResNet50
+from deeplearning4j_tpu.zoo.simplecnn import SimpleCNN
+
+__all__ = ["LeNet", "AlexNet", "VGG16", "ResNet50", "SimpleCNN"]

@@ -1,0 +1,60 @@
+"""AlexNet (reference: zoo/model/AlexNet.java — the one-weird-trick
+variant with LRN layers)."""
+
+from __future__ import annotations
+
+from deeplearning4j_tpu.learning import Nesterovs
+from deeplearning4j_tpu.nn.conf import (
+    ConvolutionLayer, DenseLayer, DropoutLayer, InputType,
+    LocalResponseNormalization, NeuralNetConfiguration, OutputLayer,
+    SubsamplingLayer,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.zoo.base import ZooModel
+
+
+class AlexNet(ZooModel):
+    def __init__(self, num_classes: int = 1000, seed: int = 42,
+                 updater=None, in_shape=(224, 224, 3)):
+        self.num_classes = num_classes
+        self.seed = seed
+        self.updater = updater or Nesterovs(learning_rate=1e-2, momentum=0.9)
+        self.in_shape = in_shape
+
+    def conf(self):
+        h, w, c = self.in_shape
+        return (NeuralNetConfiguration.builder()
+                .seed(self.seed).updater(self.updater).weightInit("relu")
+                .l2(5e-4)
+                .list()
+                .layer(ConvolutionLayer(n_out=96, kernel_size=(11, 11),
+                                        stride=(4, 4), convolution_mode="Same",
+                                        activation="relu"))
+                .layer(LocalResponseNormalization())
+                .layer(SubsamplingLayer(kernel_size=(3, 3), stride=(2, 2)))
+                .layer(ConvolutionLayer(n_out=256, kernel_size=(5, 5),
+                                        convolution_mode="Same",
+                                        activation="relu"))
+                .layer(LocalResponseNormalization())
+                .layer(SubsamplingLayer(kernel_size=(3, 3), stride=(2, 2)))
+                .layer(ConvolutionLayer(n_out=384, kernel_size=(3, 3),
+                                        convolution_mode="Same",
+                                        activation="relu"))
+                .layer(ConvolutionLayer(n_out=384, kernel_size=(3, 3),
+                                        convolution_mode="Same",
+                                        activation="relu"))
+                .layer(ConvolutionLayer(n_out=256, kernel_size=(3, 3),
+                                        convolution_mode="Same",
+                                        activation="relu"))
+                .layer(SubsamplingLayer(kernel_size=(3, 3), stride=(2, 2)))
+                .layer(DenseLayer(n_out=4096, activation="relu"))
+                .layer(DropoutLayer(rate=0.5))
+                .layer(DenseLayer(n_out=4096, activation="relu"))
+                .layer(DropoutLayer(rate=0.5))
+                .layer(OutputLayer(n_out=self.num_classes,
+                                   activation="softmax", loss="mcxent"))
+                .setInputType(InputType.convolutional(h, w, c))
+                .build())
+
+    def init(self) -> MultiLayerNetwork:
+        return MultiLayerNetwork(self.conf()).init()

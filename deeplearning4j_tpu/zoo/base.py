@@ -1,0 +1,20 @@
+"""ZooModel base (reference: org/deeplearning4j/zoo/ZooModel.java)."""
+
+from __future__ import annotations
+
+
+class ZooModel:
+    def init(self):
+        """Build and init() the network."""
+        raise NotImplementedError
+
+    def initPretrained(self, weights_path: str | None = None):
+        """Reference downloads pretrained weights; this environment has
+        no egress, so a local checkpoint path is required."""
+        if weights_path is None:
+            raise RuntimeError(
+                f"{type(self).__name__}.initPretrained(): no network egress "
+                "available; pass weights_path to a local ModelSerializer zip")
+        from deeplearning4j_tpu.util import ModelSerializer
+
+        return ModelSerializer.restoreMultiLayerNetwork(weights_path)

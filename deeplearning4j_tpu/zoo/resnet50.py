@@ -1,0 +1,90 @@
+"""ResNet-50 (reference: zoo/model/ResNet50.java — ComputationGraph with
+identity/bottleneck residual blocks via ElementWiseVertex Add; the
+benchmark flagship for the MFU target in BASELINE.md).
+
+TPU notes: NHWC layout; BN after every conv; the residual add fuses into
+the XLA graph. The graph builder mirrors the reference's block naming
+(stage/block lettering a,b,c... as in the original Keras-style impl).
+"""
+
+from __future__ import annotations
+
+from deeplearning4j_tpu.learning import Nesterovs
+from deeplearning4j_tpu.nn.conf import (
+    ActivationLayer, BatchNormalization, ConvolutionLayer, DenseLayer,
+    GlobalPoolingLayer, InputType, OutputLayer, SubsamplingLayer,
+    ZeroPaddingLayer,
+)
+from deeplearning4j_tpu.nn.graph import (
+    ComputationGraph, ComputationGraphConfiguration, ElementWiseVertex,
+)
+from deeplearning4j_tpu.zoo.base import ZooModel
+
+
+class ResNet50(ZooModel):
+    def __init__(self, num_classes: int = 1000, seed: int = 42,
+                 updater=None, in_shape=(224, 224, 3)):
+        self.num_classes = num_classes
+        self.seed = seed
+        self.updater = updater or Nesterovs(learning_rate=1e-1, momentum=0.9)
+        self.in_shape = in_shape
+
+    # -- block builders (reference: ResNet50#convBlock / identityBlock) --
+    def _conv_bn(self, b, name, inp, n_out, kernel, stride=(1, 1),
+                 mode="Same", act="relu"):
+        b.addLayer(f"{name}_conv",
+                   ConvolutionLayer(n_out=n_out, kernel_size=kernel,
+                                    stride=stride, convolution_mode=mode,
+                                    activation="identity", has_bias=False),
+                   inp)
+        b.addLayer(f"{name}_bn",
+                   BatchNormalization(activation=act), f"{name}_conv")
+        return f"{name}_bn"
+
+    def _bottleneck(self, b, name, inp, filters, stride, downsample):
+        f1, f2, f3 = filters
+        x = self._conv_bn(b, f"{name}_2a", inp, f1, (1, 1), stride)
+        x = self._conv_bn(b, f"{name}_2b", x, f2, (3, 3))
+        x = self._conv_bn(b, f"{name}_2c", x, f3, (1, 1), act="identity")
+        if downsample:
+            short = self._conv_bn(b, f"{name}_1", inp, f3, (1, 1), stride,
+                                  act="identity")
+        else:
+            short = inp
+        b.addVertex(f"{name}_add", ElementWiseVertex(op="Add"), x, short)
+        b.addLayer(f"{name}_out", ActivationLayer(activation="relu"),
+                   f"{name}_add")
+        return f"{name}_out"
+
+    def conf(self) -> ComputationGraphConfiguration:
+        h, w, c = self.in_shape
+        b = (ComputationGraphConfiguration.graphBuilder()
+             .seed(self.seed).updater(self.updater).weightInit("relu")
+             .l2(1e-4)
+             .addInputs("input")
+             .setInputTypes(InputType.convolutional(h, w, c)))
+        # stem
+        x = self._conv_bn(b, "stem", "input", 64, (7, 7), (2, 2))
+        b.addLayer("stem_pool",
+                   SubsamplingLayer(kernel_size=(3, 3), stride=(2, 2),
+                                    convolution_mode="Same"), x)
+        x = "stem_pool"
+        # stages: (filters, blocks, first-stride)
+        stages = [((64, 64, 256), 3, (1, 1)),
+                  ((128, 128, 512), 4, (2, 2)),
+                  ((256, 256, 1024), 6, (2, 2)),
+                  ((512, 512, 2048), 3, (2, 2))]
+        for si, (filters, blocks, stride) in enumerate(stages, start=2):
+            for bi in range(blocks):
+                blk = f"res{si}{chr(ord('a') + bi)}"
+                x = self._bottleneck(b, blk, x, filters,
+                                     stride if bi == 0 else (1, 1),
+                                     downsample=(bi == 0))
+        b.addLayer("avg_pool", GlobalPoolingLayer(pooling_type="avg"), x)
+        b.addLayer("fc", OutputLayer(n_out=self.num_classes,
+                                     activation="softmax", loss="mcxent"),
+                   "avg_pool")
+        return b.setOutputs("fc").build()
+
+    def init(self) -> ComputationGraph:
+        return ComputationGraph(self.conf()).init()

@@ -1,0 +1,41 @@
+"""VGG16 (reference: zoo/model/VGG16.java)."""
+
+from __future__ import annotations
+
+from deeplearning4j_tpu.learning import Nesterovs
+from deeplearning4j_tpu.nn.conf import (
+    ConvolutionLayer, DenseLayer, InputType, NeuralNetConfiguration,
+    OutputLayer, SubsamplingLayer,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.zoo.base import ZooModel
+
+
+class VGG16(ZooModel):
+    def __init__(self, num_classes: int = 1000, seed: int = 42,
+                 updater=None, in_shape=(224, 224, 3)):
+        self.num_classes = num_classes
+        self.seed = seed
+        self.updater = updater or Nesterovs(learning_rate=1e-2, momentum=0.9)
+        self.in_shape = in_shape
+
+    def conf(self):
+        h, w, c = self.in_shape
+        b = (NeuralNetConfiguration.builder()
+             .seed(self.seed).updater(self.updater).weightInit("relu")
+             .list())
+        plan = [(64, 2), (128, 2), (256, 3), (512, 3), (512, 3)]
+        for n_out, reps in plan:
+            for _ in range(reps):
+                b.layer(ConvolutionLayer(n_out=n_out, kernel_size=(3, 3),
+                                         convolution_mode="Same",
+                                         activation="relu"))
+            b.layer(SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)))
+        b.layer(DenseLayer(n_out=4096, activation="relu"))
+        b.layer(DenseLayer(n_out=4096, activation="relu"))
+        b.layer(OutputLayer(n_out=self.num_classes, activation="softmax",
+                            loss="mcxent"))
+        return b.setInputType(InputType.convolutional(h, w, c)).build()
+
+    def init(self) -> MultiLayerNetwork:
+        return MultiLayerNetwork(self.conf()).init()

@@ -1,0 +1,11 @@
+#!/bin/bash
+# Canonical test entry point.
+#
+# PALLAS_AXON_POOL_IPS must be CLEARED before the interpreter starts:
+# /root/.axon_site/sitecustomize.py dials the TPU relay at *interpreter
+# startup* when it is set, which (a) serializes every python process
+# behind a single TPU grant and (b) deadlocks if a previous client died
+# holding the grant. Tests run on a virtual 8-device CPU mesh
+# (tests/conftest.py forces JAX_PLATFORMS=cpu + host device count).
+exec env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+    python -m pytest tests/ "$@"

@@ -1,0 +1,26 @@
+"""Test harness: run everything on a virtual 8-device CPU mesh.
+
+Mirrors the reference's "distributed-without-cluster" test philosophy
+(SURVEY.md §4): libnd4j/Spark/Aeron tests all run in one process; here
+multi-chip sharding logic runs against 8 virtual CPU devices so tests
+never need real TPU hardware. Must run before jax is imported anywhere.
+"""
+
+import os
+
+# Force CPU: the session env presets JAX_PLATFORMS=axon (the real TPU
+# tunnel, which also only admits ONE client process at a time) — tests
+# must never grab it, and must run on the virtual 8-device CPU mesh.
+os.environ["JAX_PLATFORMS"] = "cpu"
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_enable_x64", False)
+# Correctness tests pin full f32 accumulation; production configs choose
+# their own precision policy (bf16 on MXU) via nn/conf dtype settings.
+jax.config.update("jax_default_matmul_precision", "highest")

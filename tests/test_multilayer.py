@@ -1,0 +1,225 @@
+"""MultiLayerNetwork end-to-end tests — the LeNet-5 MNIST slice from
+SURVEY.md §7.3 (reference analog: MultiLayerTest, ConvolutionLayerTest,
+gradient-check suites)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets import ArrayDataSetIterator, DataSet
+from deeplearning4j_tpu.learning import Adam, Nesterovs, NoOp, Sgd
+from deeplearning4j_tpu.nn.conf import (
+    BatchNormalization, ConvolutionLayer, DenseLayer, DropoutLayer,
+    InputType, MultiLayerConfiguration, NeuralNetConfiguration, OutputLayer,
+    SubsamplingLayer,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+
+def make_blob_images(n=256, hw=28, seed=0):
+    """Synthetic MNIST-stand-in: class = quadrant containing the bright
+    blob (4 classes). No network egress in this environment, so MNIST
+    itself can't be downloaded; the learning task is equivalent in
+    structure (28x28x1 -> 4-way softmax)."""
+    rng = np.random.default_rng(seed)
+    x = rng.normal(0, 0.1, (n, hw, hw, 1)).astype(np.float32)
+    labels = rng.integers(0, 4, n)
+    half = hw // 2
+    for i, c in enumerate(labels):
+        r0 = 0 if c in (0, 1) else half
+        c0 = 0 if c in (0, 2) else half
+        x[i, r0 + 4:r0 + half - 4, c0 + 4:c0 + half - 4, 0] += 1.0
+    y = np.eye(4, dtype=np.float32)[labels]
+    return x, y
+
+
+def lenet_conf(n_classes=4, updater=None):
+    return (NeuralNetConfiguration.builder()
+            .seed(42)
+            .updater(updater or Adam(learning_rate=1e-3))
+            .weightInit("xavier")
+            .list()
+            .layer(ConvolutionLayer(n_out=8, kernel_size=(5, 5), stride=(1, 1),
+                                    activation="relu", convolution_mode="Same"))
+            .layer(SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)))
+            .layer(ConvolutionLayer(n_out=16, kernel_size=(5, 5),
+                                    activation="relu", convolution_mode="Same"))
+            .layer(SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)))
+            .layer(DenseLayer(n_out=32, activation="relu"))
+            .layer(OutputLayer(n_out=n_classes, activation="softmax",
+                               loss="mcxent"))
+            .setInputType(InputType.convolutional(28, 28, 1))
+            .build())
+
+
+class TestConfig:
+    def test_shape_inference(self):
+        conf = lenet_conf()
+        # conv1 n_in from channels; dense n_in from flattened conv output
+        assert conf.layers[0].n_in == 1
+        assert conf.layers[2].n_in == 8
+        assert conf.layers[4].n_in == 7 * 7 * 16
+        assert conf.layers[5].n_in == 32
+        assert conf.preprocessors.get(4) == "flatten"
+
+    def test_json_roundtrip(self):
+        conf = lenet_conf()
+        j = conf.to_json()
+        back = MultiLayerConfiguration.from_json(j)
+        assert back == conf
+        # and the rebuilt config trains identically (same init)
+        m1 = MultiLayerNetwork(conf).init()
+        m2 = MultiLayerNetwork(back).init()
+        assert float(jnp.sum(m1.params_list[0]["W"])) == \
+               float(jnp.sum(m2.params_list[0]["W"]))
+
+    def test_global_defaults_inherited(self):
+        conf = (NeuralNetConfiguration.builder().l2(1e-4).weightInit("relu")
+                .list()
+                .layer(DenseLayer(n_in=4, n_out=3, activation="relu"))
+                .layer(OutputLayer(n_in=3, n_out=2, loss="mcxent",
+                                   activation="softmax"))
+                .setInputType(InputType.feedForward(4))
+                .build())
+        assert conf.layers[0].l2 == 1e-4
+        assert conf.layers[0].weight_init == "relu"
+
+
+class TestMlpTraining:
+    def _toy(self, n=512, seed=0):
+        rng = np.random.default_rng(seed)
+        x = rng.normal(size=(n, 10)).astype(np.float32)
+        w = rng.normal(size=(10, 3)).astype(np.float32)
+        y_idx = (x @ w).argmax(-1)
+        return x, np.eye(3, dtype=np.float32)[y_idx]
+
+    def test_mlp_learns(self):
+        x, y = self._toy()
+        conf = (NeuralNetConfiguration.builder()
+                .seed(7).updater(Adam(learning_rate=0.01))
+                .list()
+                .layer(DenseLayer(n_out=32, activation="relu"))
+                .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+                .setInputType(InputType.feedForward(10))
+                .build())
+        model = MultiLayerNetwork(conf).init()
+        it = ArrayDataSetIterator(x, y, batch_size=64, shuffle=True)
+        first = None
+        model.fit(it, epochs=15)
+        ev = model.evaluate(ArrayDataSetIterator(x, y, batch_size=128))
+        assert ev.accuracy() > 0.9, ev.stats()
+        assert model.score() < 0.5
+
+    def test_score_decreases(self):
+        x, y = self._toy(n=128)
+        conf = (NeuralNetConfiguration.builder()
+                .seed(1).updater(Sgd(learning_rate=0.1))
+                .list()
+                .layer(DenseLayer(n_out=16, activation="tanh"))
+                .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+                .setInputType(InputType.feedForward(10))
+                .build())
+        model = MultiLayerNetwork(conf).init()
+        ds = DataSet(x, y)
+        s0 = model.score(ds)
+        model.fit(ds, epochs=30)
+        assert model.score(ds) < s0 * 0.8
+
+    def test_params_roundtrip(self):
+        conf = lenet_conf()
+        model = MultiLayerNetwork(conf).init()
+        flat = model.params()
+        assert flat.length() == model.numParams()
+        model2 = MultiLayerNetwork(conf).init()
+        model2.setParams(flat)
+        x = np.random.default_rng(0).normal(size=(2, 28, 28, 1)).astype(np.float32)
+        np.testing.assert_allclose(model.output(x).toNumpy(),
+                                   model2.output(x).toNumpy(), atol=1e-6)
+
+    def test_frozen_layer_noop_updater(self):
+        x, y = self._toy(n=64)
+        conf = (NeuralNetConfiguration.builder()
+                .seed(1).updater(Sgd(learning_rate=0.5))
+                .list()
+                .layer(DenseLayer(n_out=8, activation="tanh", updater=NoOp()))
+                .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+                .setInputType(InputType.feedForward(10))
+                .build())
+        model = MultiLayerNetwork(conf).init()
+        w0 = np.asarray(model.params_list[0]["W"])
+        model.fit(DataSet(x, y), epochs=3)
+        np.testing.assert_array_equal(w0, np.asarray(model.params_list[0]["W"]))
+        # output layer DID move
+        assert model.score() > 0
+
+    def test_gradient_check_mlp(self):
+        """Finite-difference gradient check through the full network
+        (the reference's GradientCheckUtil mechanism, SURVEY.md §4)."""
+        x, y = self._toy(n=8)
+        conf = (NeuralNetConfiguration.builder()
+                .seed(3).updater(Sgd(learning_rate=0.1))
+                .list()
+                .layer(DenseLayer(n_out=5, activation="tanh"))
+                .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+                .setInputType(InputType.feedForward(10))
+                .build())
+        model = MultiLayerNetwork(conf).init()
+        grads, score = model.computeGradientAndScore(x, y)
+        eps = 1e-3
+        w = model.params_list[0]["W"]
+        for idx in [(0, 0), (3, 2), (9, 4)]:
+            model.params_list[0]["W"] = w.at[idx].add(eps)
+            sp = model.score(DataSet(x, y))
+            model.params_list[0]["W"] = w.at[idx].add(-eps)
+            sm = model.score(DataSet(x, y))
+            model.params_list[0]["W"] = w
+            fd = (sp - sm) / (2 * eps)
+            assert abs(fd - float(grads[0]["W"][idx])) < 1e-2
+
+
+class TestLeNetEndToEnd:
+    def test_lenet_trains_on_images(self):
+        x, y = make_blob_images(n=256)
+        conf = lenet_conf()
+        model = MultiLayerNetwork(conf).init()
+        it = ArrayDataSetIterator(x, y, batch_size=64, shuffle=True)
+        model.fit(it, epochs=8)
+        ev = model.evaluate(ArrayDataSetIterator(x, y, batch_size=128))
+        assert ev.accuracy() > 0.9, ev.stats()
+
+    def test_summary(self):
+        model = MultiLayerNetwork(lenet_conf()).init()
+        s = model.summary()
+        assert "ConvolutionLayer" in s and "Total params" in s
+
+    def test_output_shape(self):
+        model = MultiLayerNetwork(lenet_conf()).init()
+        out = model.output(np.zeros((3, 28, 28, 1), np.float32))
+        assert out.shape() == (3, 4)
+        np.testing.assert_allclose(out.sum(1).toNumpy(), np.ones(3), rtol=1e-5)
+
+    def test_batchnorm_dropout_net(self):
+        x, y = make_blob_images(n=128)
+        conf = (NeuralNetConfiguration.builder()
+                .seed(5).updater(Adam(learning_rate=1e-3))
+                .list()
+                .layer(ConvolutionLayer(n_out=4, kernel_size=(3, 3),
+                                        convolution_mode="Same", activation="identity"))
+                .layer(BatchNormalization(activation="relu"))
+                .layer(SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)))
+                .layer(DenseLayer(n_out=16, activation="relu"))
+                .layer(DropoutLayer(rate=0.3))
+                .layer(OutputLayer(n_out=4, activation="softmax", loss="mcxent"))
+                .setInputType(InputType.convolutional(28, 28, 1))
+                .build())
+        model = MultiLayerNetwork(conf).init()
+        st0 = np.asarray(model.states_list[1]["mean"]).copy()
+        model.fit(DataSet(x, y), epochs=2)
+        # BN running stats must have moved (functional state threading)
+        assert not np.allclose(st0, np.asarray(model.states_list[1]["mean"]))
+        # inference deterministic despite dropout layer
+        x0 = x[:4]
+        o1 = model.output(x0).toNumpy()
+        o2 = model.output(x0).toNumpy()
+        np.testing.assert_array_equal(o1, o2)

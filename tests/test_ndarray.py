@@ -1,0 +1,189 @@
+"""Tensor core tests (reference analog: libnd4j NDArrayTests +
+nd4j NDArrayTestsFortran etc., SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import Nd4j, NDArray
+from deeplearning4j_tpu.ndarray.dtypes import DataType
+
+
+class TestFactory:
+    def test_create_from_list(self):
+        a = Nd4j.create([[1.0, 2.0], [3.0, 4.0]])
+        assert a.shape() == (2, 2)
+        assert a.dataType() == DataType.FLOAT
+
+    def test_zeros_ones(self):
+        z = Nd4j.zeros(3, 4)
+        o = Nd4j.ones(2, 5)
+        assert z.sum() == 0.0
+        assert o.sum() == 10.0
+        assert z.shape() == (3, 4)
+
+    def test_value_array_scalar_eye(self):
+        v = Nd4j.valueArrayOf((2, 3), 7.0)
+        assert v.getDouble(1, 2) == 7.0
+        assert Nd4j.scalar(3.0).item() == 3.0
+        e = Nd4j.eye(3)
+        assert e.getDouble(0, 0) == 1.0 and e.getDouble(0, 1) == 0.0
+
+    def test_arange_linspace(self):
+        a = Nd4j.arange(5)
+        np.testing.assert_allclose(a.toNumpy(), [0, 1, 2, 3, 4])
+        l = Nd4j.linspace(0, 1, 5)
+        np.testing.assert_allclose(l.toNumpy(), [0, 0.25, 0.5, 0.75, 1.0])
+
+    def test_rand_reproducible(self):
+        Nd4j.setSeed(42)
+        a = Nd4j.rand(3, 3)
+        Nd4j.setSeed(42)
+        b = Nd4j.rand(3, 3)
+        assert a.equals(b)
+
+    def test_concat_stack(self):
+        a, b = Nd4j.ones(2, 3), Nd4j.zeros(2, 3)
+        c = Nd4j.concat(0, a, b)
+        assert c.shape() == (4, 3)
+        s = Nd4j.stack(0, a, b)
+        assert s.shape() == (2, 2, 3)
+
+
+class TestArithmetic:
+    def test_add_sub_mul_div(self):
+        a = Nd4j.create([1.0, 2.0, 3.0])
+        b = Nd4j.create([4.0, 5.0, 6.0])
+        np.testing.assert_allclose((a + b).toNumpy(), [5, 7, 9])
+        np.testing.assert_allclose(a.sub(b).toNumpy(), [-3, -3, -3])
+        np.testing.assert_allclose(a.mul(2.0).toNumpy(), [2, 4, 6])
+        np.testing.assert_allclose(b.div(2.0).toNumpy(), [2, 2.5, 3])
+        np.testing.assert_allclose(a.rsub(10.0).toNumpy(), [9, 8, 7])
+        np.testing.assert_allclose(a.rdiv(6.0).toNumpy(), [6, 3, 2])
+
+    def test_inplace_rebind(self):
+        a = Nd4j.create([1.0, 2.0])
+        ret = a.addi(1.0)
+        assert ret is a
+        np.testing.assert_allclose(a.toNumpy(), [2, 3])
+        a.subi(1.0).muli(3.0).divi(2.0)
+        np.testing.assert_allclose(a.toNumpy(), [1.5, 3.0])
+
+    def test_assign(self):
+        a = Nd4j.zeros(2, 2)
+        a.assign(5.0)
+        assert a.sum() == 20.0
+
+    def test_mmul(self):
+        a = Nd4j.create([[1.0, 2.0], [3.0, 4.0]])
+        b = Nd4j.eye(2)
+        assert a.mmul(b).equals(a)
+        c = a @ a
+        np.testing.assert_allclose(c.toNumpy(), [[7, 10], [15, 22]])
+
+    def test_gemm_transpose(self):
+        a = Nd4j.create([[1.0, 2.0, 3.0]])  # 1x3
+        b = Nd4j.create([[4.0, 5.0, 6.0]])  # 1x3
+        out = Nd4j.gemm(a, b, transposeA=True)  # 3x1 @ 1x3 = 3x3
+        assert out.shape() == (3, 3)
+        assert out.getDouble(2, 2) == 18.0
+
+    def test_row_column_vector_ops(self):
+        m = Nd4j.zeros(2, 3)
+        r = m.addRowVector(Nd4j.create([1.0, 2.0, 3.0]))
+        np.testing.assert_allclose(r.toNumpy(), [[1, 2, 3], [1, 2, 3]])
+        c = m.addColumnVector(Nd4j.create([1.0, 2.0]))
+        np.testing.assert_allclose(c.toNumpy(), [[1, 1, 1], [2, 2, 2]])
+
+
+class TestReductions:
+    def test_global(self):
+        a = Nd4j.create([[1.0, 2.0], [3.0, 4.0]])
+        assert a.sum() == 10.0
+        assert a.mean() == 2.5
+        assert a.max() == 4.0
+        assert a.min() == 1.0
+        assert a.prod() == 24.0
+
+    def test_dimensional(self):
+        a = Nd4j.create([[1.0, 2.0], [3.0, 4.0]])
+        np.testing.assert_allclose(a.sum(0).toNumpy(), [4, 6])
+        np.testing.assert_allclose(a.mean(1).toNumpy(), [1.5, 3.5])
+
+    def test_argmax(self):
+        a = Nd4j.create([[1.0, 5.0], [7.0, 2.0]])
+        assert a.argMax() == 2
+        np.testing.assert_allclose(a.argMax(1).toNumpy(), [1, 0])
+
+    def test_norms(self):
+        a = Nd4j.create([3.0, -4.0])
+        assert a.norm1() == 7.0
+        assert a.norm2() == 5.0
+        assert a.normMax() == 4.0
+
+    def test_std_matches_reference_ddof1(self):
+        # reference nd4j std() is the sample std (Bessel corrected)
+        a = Nd4j.create([1.0, 2.0, 3.0, 4.0])
+        assert abs(a.std() - np.std([1, 2, 3, 4], ddof=1)) < 1e-6
+
+
+class TestStructure:
+    def test_reshape_transpose(self):
+        a = Nd4j.arange(6).reshape(2, 3)
+        assert a.transpose().shape() == (3, 2)
+        assert a.reshape(3, 2).shape() == (3, 2)
+        assert a.ravel().shape() == (6,)
+
+    def test_permute(self):
+        a = Nd4j.zeros(2, 3, 4)
+        assert a.permute(2, 0, 1).shape() == (4, 2, 3)
+
+    def test_indexing(self):
+        a = Nd4j.arange(12, dtype=DataType.FLOAT).reshape(3, 4)
+        row = a[1]
+        np.testing.assert_allclose(row.toNumpy(), [4, 5, 6, 7])
+        a[0, 0] = 99.0
+        assert a.getDouble(0, 0) == 99.0
+
+    def test_put_scalar_linear_index(self):
+        a = Nd4j.zeros(2, 2)
+        a.putScalar(3, 7.0)
+        assert a.getDouble(1, 1) == 7.0
+
+    def test_dup_independent(self):
+        a = Nd4j.ones(2, 2)
+        b = a.dup()
+        b.addi(1.0)
+        assert a.sum() == 4.0 and b.sum() == 8.0
+
+    def test_cast(self):
+        a = Nd4j.create([1.5, 2.5])
+        i = a.castTo(DataType.INT)
+        assert i.dataType() == DataType.INT
+
+    def test_comparisons(self):
+        a = Nd4j.create([1.0, 5.0, 3.0])
+        m = a.gt(2.0)
+        np.testing.assert_array_equal(m.toNumpy(), [False, True, True])
+
+    def test_broadcast(self):
+        a = Nd4j.create([1.0, 2.0])
+        b = a.broadcast(3, 2)
+        assert b.shape() == (3, 2)
+
+    def test_vector_matrix_predicates(self):
+        assert Nd4j.zeros(5).isVector()
+        assert Nd4j.zeros(2, 2).isMatrix()
+        assert Nd4j.scalar(1.0).isScalar()
+
+
+class TestPytree:
+    def test_ndarray_through_jit(self):
+        import jax
+
+        @jax.jit
+        def f(x: NDArray):
+            return x.add(1.0).mul(2.0)
+
+        out = f(Nd4j.create([1.0, 2.0]))
+        assert isinstance(out, NDArray)
+        np.testing.assert_allclose(out.toNumpy(), [4, 6])

@@ -1,0 +1,162 @@
+"""Checkpoint/resume tests (reference analog: ModelSerializerTest +
+CheckpointListener tests; exact-resume incl. updater state is the
+contract — SURVEY.md §2.24, §5)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets import ArrayDataSetIterator, DataSet
+from deeplearning4j_tpu.datasets.normalizers import (
+    ImagePreProcessingScaler, NormalizerMinMaxScaler, NormalizerStandardize,
+)
+from deeplearning4j_tpu.learning import Adam
+from deeplearning4j_tpu.nn.conf import (
+    DenseLayer, InputType, NeuralNetConfiguration, OutputLayer,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.optimize import (
+    CheckpointListener, CollectScoresListener, ScoreIterationListener,
+)
+from deeplearning4j_tpu.util import ModelSerializer
+
+
+def small_net(seed=9):
+    return MultiLayerNetwork(
+        (NeuralNetConfiguration.builder()
+         .seed(seed).updater(Adam(learning_rate=0.01))
+         .list()
+         .layer(DenseLayer(n_out=8, activation="tanh"))
+         .layer(OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+         .setInputType(InputType.feedForward(4))
+         .build())).init()
+
+
+def toy_data(n=64, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, 4)).astype(np.float32)
+    y_idx = (x.sum(1) > 0).astype(int)
+    return x, np.eye(2, dtype=np.float32)[y_idx]
+
+
+class TestModelSerializer:
+    def test_save_restore_outputs_identical(self, tmp_path):
+        model = small_net()
+        x, y = toy_data()
+        model.fit(DataSet(x, y), epochs=3)
+        p = str(tmp_path / "model.zip")
+        ModelSerializer.writeModel(model, p)
+        restored = ModelSerializer.restoreMultiLayerNetwork(p)
+        np.testing.assert_array_equal(model.output(x).toNumpy(),
+                                      restored.output(x).toNumpy())
+        assert restored.getIterationCount() == model.getIterationCount()
+
+    def test_exact_resume_with_updater_state(self, tmp_path):
+        """Train 3+3 with a save/load in the middle == train 6 straight.
+        This is the reference's exact-resume guarantee (updaterState.bin)."""
+        x, y = toy_data()
+        ds = DataSet(x, y)
+
+        m_straight = small_net()
+        m_straight.fit(ds, epochs=6)
+
+        m_half = small_net()
+        m_half.fit(ds, epochs=3)
+        p = str(tmp_path / "half.zip")
+        ModelSerializer.writeModel(m_half, p, save_updater=True)
+        m_resumed = ModelSerializer.restoreMultiLayerNetwork(p, load_updater=True)
+        m_resumed.fit(ds, epochs=3)
+
+        np.testing.assert_allclose(m_straight.params().toNumpy(),
+                                   m_resumed.params().toNumpy(), atol=1e-6)
+
+    def test_resume_without_updater_state_differs(self, tmp_path):
+        """Dropping updater state must change trajectory (Adam moments)."""
+        x, y = toy_data()
+        ds = DataSet(x, y)
+        m = small_net()
+        m.fit(ds, epochs=3)
+        p = str(tmp_path / "m.zip")
+        ModelSerializer.writeModel(m, p, save_updater=True)
+        with_upd = ModelSerializer.restoreMultiLayerNetwork(p, load_updater=True)
+        without_upd = ModelSerializer.restoreMultiLayerNetwork(p, load_updater=False)
+        with_upd.fit(ds, epochs=2)
+        without_upd.fit(ds, epochs=2)
+        assert not np.allclose(with_upd.params().toNumpy(),
+                               without_upd.params().toNumpy())
+
+    def test_normalizer_roundtrip(self, tmp_path):
+        model = small_net()
+        x, y = toy_data()
+        norm = NormalizerStandardize()
+        norm.fit(DataSet(x, y))
+        p = str(tmp_path / "m.zip")
+        ModelSerializer.writeModel(model, p, normalizer=norm)
+        n2 = ModelSerializer.restoreNormalizer(p)
+        np.testing.assert_allclose(norm.mean, n2.mean)
+        np.testing.assert_allclose(norm.std, n2.std)
+
+
+class TestNormalizers:
+    def test_standardize(self):
+        x = np.random.default_rng(0).normal(5, 3, (100, 4)).astype(np.float32)
+        y = np.zeros((100, 1), np.float32)
+        norm = NormalizerStandardize()
+        norm.fit(DataSet(x, y))
+        ds = norm.transform(DataSet(x, y))
+        f = np.asarray(ds.features)
+        np.testing.assert_allclose(f.mean(0), 0, atol=1e-4)
+        np.testing.assert_allclose(f.std(0), 1, atol=1e-2)
+
+    def test_standardize_streaming_matches_batch(self):
+        x = np.random.default_rng(1).normal(2, 4, (128, 3)).astype(np.float32)
+        y = np.zeros((128, 1), np.float32)
+        batch = NormalizerStandardize()
+        batch.fit(DataSet(x, y))
+        stream = NormalizerStandardize()
+        stream.fit(ArrayDataSetIterator(x, y, batch_size=32))
+        np.testing.assert_allclose(batch.mean, stream.mean, rtol=1e-4)
+        np.testing.assert_allclose(batch.std, stream.std, rtol=1e-3)
+
+    def test_minmax(self):
+        x = np.random.default_rng(2).uniform(-5, 10, (50, 2)).astype(np.float32)
+        y = np.zeros((50, 1), np.float32)
+        norm = NormalizerMinMaxScaler()
+        norm.fit(DataSet(x, y))
+        f = np.asarray(norm.transform(DataSet(x, y)).features)
+        assert f.min() >= -1e-6 and f.max() <= 1 + 1e-6
+
+    def test_image_scaler(self):
+        x = (np.arange(12).reshape(1, 12) * 20).astype(np.float32)
+        ds = ImagePreProcessingScaler().transform(DataSet(x, np.zeros((1, 1))))
+        assert float(np.asarray(ds.features).max()) <= 1.0
+
+
+class TestListeners:
+    def test_score_listener_fires(self):
+        msgs = []
+        model = small_net()
+        model.setListeners(ScoreIterationListener(1, printer=msgs.append))
+        x, y = toy_data(32)
+        model.fit(DataSet(x, y), epochs=3)
+        assert len(msgs) == 3
+
+    def test_collect_scores(self):
+        c = CollectScoresListener()
+        model = small_net().setListeners(c)
+        x, y = toy_data(32)
+        model.fit(DataSet(x, y), epochs=5)
+        assert len(c.scores) == 5
+        assert c.scores[-1][1] <= c.scores[0][1] * 1.5  # roughly non-exploding
+
+    def test_checkpoint_listener_keeps_last_k(self, tmp_path):
+        cl = CheckpointListener(str(tmp_path), save_every_n_iterations=1,
+                                keep_last=2)
+        model = small_net().setListeners(cl)
+        x, y = toy_data(32)
+        model.fit(DataSet(x, y), epochs=5)
+        zips = list(tmp_path.glob("checkpoint_iter_*.zip"))
+        assert len(zips) == 2
+        restored = ModelSerializer.restoreMultiLayerNetwork(cl.lastCheckpoint())
+        assert restored.numParams() == model.numParams()
