@@ -1,0 +1,95 @@
+"""Benchmark: BERT-base MLM training throughput, tokens/sec/chip.
+
+Driver contract: print ONE JSON line
+``{"metric": ..., "value": N, "unit": ..., "vs_baseline": N}``.
+
+The reference publishes no first-party numbers (BASELINE.md) — its
+BERT-base path is a SameDiff TF-import executed op-by-op in a Java
+interpreter (SURVEY.md §3.4). Here the whole train step (fwd + bwd +
+Adam) is one XLA executable in bf16 on the MXU. ``vs_baseline`` is
+reported against the self-baseline recorded in BENCH_BASELINE.json at
+the repo root (first run writes it; later runs compare), since no
+reference number exists to compare against.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+def main() -> None:
+    from deeplearning4j_tpu.learning.updaters import Adam
+    from deeplearning4j_tpu.models.transformer import (
+        TransformerEncoder, bert_base, tiny_config,
+    )
+
+    platform = jax.devices()[0].platform
+    on_accel = platform in ("tpu", "gpu")
+    if on_accel:
+        cfg = bert_base()
+        batch, seqlen, steps = 32, 128, 20
+    else:
+        # CPU fallback so the bench always produces a line
+        cfg = tiny_config(vocab=1024, max_len=128, d_model=128, n_layers=2,
+                          n_heads=4, d_ff=512)
+        batch, seqlen, steps = 8, 128, 3
+
+    model = TransformerEncoder(cfg)
+    updater = Adam(learning_rate=1e-4)
+    step = model.make_train_step(updater)
+
+    rng = jax.random.key(0)
+    params = model.init_params(rng)
+    opt_state = updater.init_state(params)
+    ids = jax.random.randint(rng, (batch, seqlen), 0, cfg.vocab_size)
+    labels = jax.random.randint(rng, (batch, seqlen), 0, cfg.vocab_size)
+    mask_pos = (jax.random.uniform(rng, (batch, seqlen)) < 0.15).astype(
+        jnp.float32)
+
+    # warmup / compile
+    params, opt_state, loss = step(params, opt_state, jnp.asarray(0),
+                                   ids, labels, mask_pos, rng)
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for i in range(steps):
+        params, opt_state, loss = step(params, opt_state, jnp.asarray(i + 1),
+                                       ids, labels, mask_pos, rng)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = batch * seqlen * steps / dt
+
+    base_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "BENCH_BASELINE.json")
+    vs_baseline = 1.0
+    try:
+        base = {}
+        if os.path.exists(base_path):
+            with open(base_path) as f:
+                base = json.load(f)
+        if platform in base and base[platform].get("value"):
+            vs_baseline = tokens_per_sec / float(base[platform]["value"])
+        else:
+            base[platform] = {"value": tokens_per_sec,
+                              "unit": "tokens/sec/chip"}
+            with open(base_path, "w") as f:
+                json.dump(base, f)
+    except (OSError, ValueError):
+        pass
+
+    print(json.dumps({
+        "metric": f"bert_{'base' if on_accel else 'tiny_cpu'}_mlm_train",
+        "value": round(tokens_per_sec, 2),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": round(vs_baseline, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,24 @@
+"""SameDiff-equivalent graph autodiff engine (SURVEY.md §2.12-2.13).
+
+Reference: org/nd4j/autodiff/samediff/SameDiff.java (~10k LoC) plus
+internal/{AbstractSession,InferenceSession,TrainingSession}. The
+reference executes graphs op-by-op in a Java interpreter loop with a
+dependency tracker; autodiff is per-op `doDiff` emitting a grad
+subgraph.
+
+TPU-native redesign: the graph IS a pure function. Declaring ops
+appends registry-named nodes in topological (construction) order;
+execution traces the whole graph into ONE jit-compiled XLA executable
+(the interpreter loop disappears — SURVEY.md §3.4's stated analog).
+Autodiff is `jax.grad` of that traced function — no per-op doDiff code
+to maintain, and the grad graph compiles into the same executable as
+the forward pass.
+"""
+
+from deeplearning4j_tpu.autodiff import ops_math  # noqa: F401 (registers ops)
+from deeplearning4j_tpu.autodiff.samediff import SameDiff, SDVariable, VariableType
+from deeplearning4j_tpu.autodiff.training import TrainingConfig, History
+
+__all__ = [
+    "SameDiff", "SDVariable", "VariableType", "TrainingConfig", "History",
+]

@@ -1,0 +1,485 @@
+"""Math/shape/reduce ops for the graph engine.
+
+Reference: the nd4j op classes under org/nd4j/linalg/api/ops/impl/
+{transforms/arithmetic, reduce, shape, indexaccum, broadcast} that
+SameDiff's SDMath/SDBaseOps namespaces emit. Each is a pure jax
+function registered by name so graphs serialize as name+attrs and
+execute inside one XLA compilation.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deeplearning4j_tpu.ops.registry import has_op, register_op
+
+
+def _reg(name):
+    """register_op that tolerates double-import."""
+    def deco(fn):
+        if not has_op(name):
+            register_op(name)(fn)
+        return fn
+    return deco
+
+
+# ---------------------------------------------------------------- binary
+@_reg("add")
+def add(x, y):
+    return jnp.add(x, y)
+
+
+@_reg("sub")
+def sub(x, y):
+    return jnp.subtract(x, y)
+
+
+@_reg("mul")
+def mul(x, y):
+    return jnp.multiply(x, y)
+
+
+@_reg("div")
+def div(x, y):
+    return jnp.divide(x, y)
+
+
+@_reg("rsub")
+def rsub(x, y):
+    return jnp.subtract(y, x)
+
+
+@_reg("rdiv")
+def rdiv(x, y):
+    return jnp.divide(y, x)
+
+
+@_reg("floordiv")
+def floordiv(x, y):
+    return jnp.floor_divide(x, y)
+
+
+@_reg("mod")
+def mod(x, y):
+    return jnp.mod(x, y)
+
+
+@_reg("pow_pairwise")
+def pow_pairwise(x, y):
+    return jnp.power(x, y)
+
+
+@_reg("squared_difference")
+def squared_difference(x, y):
+    d = jnp.subtract(x, y)
+    return d * d
+
+
+@_reg("matmul")
+def matmul(x, y, transpose_a=False, transpose_b=False):
+    if transpose_a:
+        x = jnp.swapaxes(x, -1, -2)
+    if transpose_b:
+        y = jnp.swapaxes(y, -1, -2)
+    return jnp.matmul(x, y)
+
+
+@_reg("tensordot")
+def tensordot(x, y, axes=2):
+    if isinstance(axes, (list, tuple)):
+        axes = tuple(tuple(a) for a in axes)
+    return jnp.tensordot(x, y, axes=axes)
+
+
+@_reg("batch_mmul")
+def batch_mmul(x, y):
+    return jnp.matmul(x, y)
+
+
+# ------------------------------------------------------------ comparisons
+@_reg("eq")
+def eq(x, y):
+    return jnp.equal(x, y)
+
+
+@_reg("neq")
+def neq(x, y):
+    return jnp.not_equal(x, y)
+
+
+@_reg("gt")
+def gt(x, y):
+    return jnp.greater(x, y)
+
+
+@_reg("gte")
+def gte(x, y):
+    return jnp.greater_equal(x, y)
+
+
+@_reg("lt")
+def lt(x, y):
+    return jnp.less(x, y)
+
+
+@_reg("lte")
+def lte(x, y):
+    return jnp.less_equal(x, y)
+
+
+@_reg("where")
+def where(cond, x, y):
+    return jnp.where(cond, x, y)
+
+
+@_reg("logical_and")
+def logical_and(x, y):
+    return jnp.logical_and(x, y)
+
+
+@_reg("logical_or")
+def logical_or(x, y):
+    return jnp.logical_or(x, y)
+
+
+@_reg("logical_not")
+def logical_not(x):
+    return jnp.logical_not(x)
+
+
+@_reg("logical_xor")
+def logical_xor(x, y):
+    return jnp.logical_xor(x, y)
+
+
+# ----------------------------------------------------------------- unary
+@_reg("neg")
+def neg(x):
+    return jnp.negative(x)
+
+
+@_reg("identity")
+def identity(x):
+    return x
+
+
+@_reg("cast")
+def cast(x, dtype):
+    return x.astype(jnp.dtype(dtype))
+
+
+@_reg("cumsum")
+def cumsum(x, axis=0, exclusive=False, reverse=False):
+    if reverse:
+        x = jnp.flip(x, axis)
+    out = jnp.cumsum(x, axis=axis)
+    if exclusive:
+        out = out - x
+    if reverse:
+        out = jnp.flip(out, axis)
+    return out
+
+
+@_reg("cumprod")
+def cumprod(x, axis=0):
+    return jnp.cumprod(x, axis=axis)
+
+
+# ---------------------------------------------------------------- reduce
+def _axes(dims):
+    if dims is None:
+        return None
+    if isinstance(dims, int):
+        return (dims,)
+    return tuple(dims)
+
+
+@_reg("reduce_sum")
+def reduce_sum(x, dimensions=None, keep_dims=False):
+    return jnp.sum(x, axis=_axes(dimensions), keepdims=keep_dims)
+
+
+@_reg("reduce_mean")
+def reduce_mean(x, dimensions=None, keep_dims=False):
+    return jnp.mean(x, axis=_axes(dimensions), keepdims=keep_dims)
+
+
+@_reg("reduce_max")
+def reduce_max(x, dimensions=None, keep_dims=False):
+    return jnp.max(x, axis=_axes(dimensions), keepdims=keep_dims)
+
+
+@_reg("reduce_min")
+def reduce_min(x, dimensions=None, keep_dims=False):
+    return jnp.min(x, axis=_axes(dimensions), keepdims=keep_dims)
+
+
+@_reg("reduce_prod")
+def reduce_prod(x, dimensions=None, keep_dims=False):
+    return jnp.prod(x, axis=_axes(dimensions), keepdims=keep_dims)
+
+
+@_reg("reduce_std")
+def reduce_std(x, dimensions=None, keep_dims=False, bias_corrected=True):
+    return jnp.std(x, axis=_axes(dimensions), keepdims=keep_dims,
+                   ddof=1 if bias_corrected else 0)
+
+
+@_reg("reduce_var")
+def reduce_var(x, dimensions=None, keep_dims=False, bias_corrected=True):
+    return jnp.var(x, axis=_axes(dimensions), keepdims=keep_dims,
+                   ddof=1 if bias_corrected else 0)
+
+
+@_reg("reduce_norm1")
+def reduce_norm1(x, dimensions=None, keep_dims=False):
+    return jnp.sum(jnp.abs(x), axis=_axes(dimensions), keepdims=keep_dims)
+
+
+@_reg("reduce_norm2")
+def reduce_norm2(x, dimensions=None, keep_dims=False):
+    return jnp.sqrt(jnp.sum(x * x, axis=_axes(dimensions),
+                            keepdims=keep_dims))
+
+
+@_reg("reduce_norm_max")
+def reduce_norm_max(x, dimensions=None, keep_dims=False):
+    return jnp.max(jnp.abs(x), axis=_axes(dimensions), keepdims=keep_dims)
+
+
+@_reg("reduce_logsumexp")
+def reduce_logsumexp(x, dimensions=None, keep_dims=False):
+    return jax.nn.logsumexp(x, axis=_axes(dimensions), keepdims=keep_dims)
+
+
+@_reg("reduce_any")
+def reduce_any(x, dimensions=None, keep_dims=False):
+    return jnp.any(x, axis=_axes(dimensions), keepdims=keep_dims)
+
+
+@_reg("reduce_all")
+def reduce_all(x, dimensions=None, keep_dims=False):
+    return jnp.all(x, axis=_axes(dimensions), keepdims=keep_dims)
+
+
+@_reg("count_nonzero")
+def count_nonzero(x, dimensions=None, keep_dims=False):
+    return jnp.sum((x != 0).astype(jnp.int32), axis=_axes(dimensions),
+                   keepdims=keep_dims)
+
+
+@_reg("argmax")
+def argmax(x, dimensions=0, keep_dims=False):
+    out = jnp.argmax(x, axis=dimensions)
+    if keep_dims:
+        out = jnp.expand_dims(out, dimensions)
+    return out
+
+
+@_reg("argmin")
+def argmin(x, dimensions=0, keep_dims=False):
+    out = jnp.argmin(x, axis=dimensions)
+    if keep_dims:
+        out = jnp.expand_dims(out, dimensions)
+    return out
+
+
+# ----------------------------------------------------------------- shape
+@_reg("reshape")
+def reshape(x, shape):
+    return jnp.reshape(x, tuple(shape))
+
+
+@_reg("transpose")
+def transpose(x, permute=None):
+    return jnp.transpose(x, tuple(permute) if permute is not None else None)
+
+
+@_reg("expand_dims")
+def expand_dims(x, axis=0):
+    return jnp.expand_dims(x, axis)
+
+
+@_reg("squeeze")
+def squeeze(x, axis=None):
+    return jnp.squeeze(x, axis=axis)
+
+
+@_reg("concat")
+def concat(*xs, axis=0):
+    return jnp.concatenate(xs, axis=axis)
+
+
+@_reg("stack")
+def stack(*xs, axis=0):
+    return jnp.stack(xs, axis=axis)
+
+
+@_reg("unstack")
+def unstack(x, axis=0, num=None):
+    n = num if num is not None else x.shape[axis]
+    return tuple(jnp.squeeze(s, axis)
+                 for s in jnp.split(x, n, axis=axis))
+
+
+@_reg("split")
+def split(x, num_splits, axis=0):
+    return tuple(jnp.split(x, num_splits, axis=axis))
+
+
+@_reg("tile")
+def tile(x, reps):
+    return jnp.tile(x, tuple(reps))
+
+
+@_reg("repeat")
+def repeat(x, repeats, axis=0):
+    return jnp.repeat(x, repeats, axis=axis)
+
+
+@_reg("reverse")
+def reverse(x, dimensions):
+    return jnp.flip(x, _axes(dimensions))
+
+
+@_reg("strided_slice")
+def strided_slice(x, begin, end, strides=None):
+    sl = tuple(slice(b, e, s) for b, e, s in zip(
+        begin, end, strides if strides is not None else [1] * len(begin)))
+    return x[sl]
+
+
+@_reg("gather")
+def gather(x, indices, axis=0):
+    return jnp.take(x, jnp.asarray(indices), axis=axis)
+
+
+@_reg("gather_nd")
+def gather_nd(x, indices):
+    idx = jnp.asarray(indices)
+    return x[tuple(jnp.moveaxis(idx, -1, 0))]
+
+
+@_reg("scatter_update")
+def scatter_update(x, indices, updates):
+    return x.at[jnp.asarray(indices)].set(updates)
+
+
+@_reg("scatter_add")
+def scatter_add(x, indices, updates):
+    return x.at[jnp.asarray(indices)].add(updates)
+
+
+@_reg("pad")
+def pad(x, paddings, mode="constant", constant_value=0.0):
+    return jnp.pad(x, tuple(tuple(p) for p in paddings), mode=mode.lower(),
+                   **({"constant_values": constant_value}
+                      if mode.lower() == "constant" else {}))
+
+
+@_reg("slice")
+def slice_(x, begin, size):
+    return lax.dynamic_slice(x, tuple(begin), tuple(size))
+
+
+@_reg("shape_of")
+def shape_of(x):
+    return jnp.asarray(x.shape, jnp.int32)
+
+
+@_reg("size_of")
+def size_of(x):
+    return jnp.asarray(x.size, jnp.int32)
+
+
+@_reg("rank_of")
+def rank_of(x):
+    return jnp.asarray(x.ndim, jnp.int32)
+
+
+@_reg("zeros_like")
+def zeros_like(x):
+    return jnp.zeros_like(x)
+
+
+@_reg("ones_like")
+def ones_like(x):
+    return jnp.ones_like(x)
+
+
+@_reg("fill_like")
+def fill_like(x, value):
+    return jnp.full_like(x, value)
+
+
+@_reg("linspace")
+def linspace(start, stop, num):
+    return jnp.linspace(start, stop, int(num))
+
+
+@_reg("range")
+def arange(start, stop, step=1, dtype="int32"):
+    return jnp.arange(start, stop, step, dtype=jnp.dtype(dtype))
+
+
+@_reg("eye")
+def eye(n, m=None, dtype="float32"):
+    return jnp.eye(int(n), int(m) if m is not None else None,
+                   dtype=jnp.dtype(dtype))
+
+
+@_reg("diag")
+def diag(x):
+    return jnp.diag(x)
+
+
+@_reg("trace")
+def trace(x):
+    return jnp.trace(x)
+
+
+# ------------------------------------------------------------ segment ops
+@_reg("segment_sum")
+def segment_sum(x, ids, num_segments):
+    return jax.ops.segment_sum(x, jnp.asarray(ids), int(num_segments))
+
+
+@_reg("segment_max")
+def segment_max(x, ids, num_segments):
+    return jax.ops.segment_max(x, jnp.asarray(ids), int(num_segments))
+
+
+@_reg("segment_min")
+def segment_min(x, ids, num_segments):
+    return jax.ops.segment_min(x, jnp.asarray(ids), int(num_segments))
+
+
+@_reg("segment_mean")
+def segment_mean(x, ids, num_segments):
+    ids = jnp.asarray(ids)
+    s = jax.ops.segment_sum(x, ids, int(num_segments))
+    c = jax.ops.segment_sum(jnp.ones_like(x), ids, int(num_segments))
+    return s / jnp.maximum(c, 1)
+
+
+# ------------------------------------------------------------------ misc
+@_reg("top_k")
+def top_k(x, k, sorted=True):  # noqa: A002
+    return lax.top_k(x, int(k))
+
+
+@_reg("is_finite")
+def is_finite(x):
+    return jnp.isfinite(x)
+
+
+@_reg("maximum")
+def maximum(x, y):
+    return jnp.maximum(x, y)
+
+
+@_reg("minimum")
+def minimum(x, y):
+    return jnp.minimum(x, y)

@@ -1,0 +1,99 @@
+"""SameDiff save/load (reference: SameDiff#save/asFlatBuffers —
+FlatBuffers graph + arrays + training config + updater state,
+SURVEY.md §2.13; exact-resume semantics incl. iteration counters).
+
+Format: one zip —
+- graph.json: variables (name/type/shape/dtype), ops (name+attrs in
+  topo order), loss variables, counters, training config
+- arrays.npz: VARIABLE + CONSTANT values
+- updater_state.npz: flattened updater-state leaves (exact resume)
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import zipfile
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.common import serde as cserde
+
+
+def _np_savez(d: dict) -> bytes:
+    buf = io.BytesIO()
+    np.savez(buf, **{k: np.asarray(v) for k, v in d.items()})
+    return buf.getvalue()
+
+
+def _np_loadz(raw: bytes) -> dict:
+    return dict(np.load(io.BytesIO(raw), allow_pickle=False))
+
+
+def save(sd, path, save_updater_state: bool = True) -> None:
+    from deeplearning4j_tpu.autodiff.samediff import VariableType
+
+    graph = {
+        "format_version": 1,
+        "variables": [
+            {"name": v.name, "type": v.vtype.value,
+             "shape": (list(v.shape) if v.shape is not None else None),
+             "dtype": v.dtype}
+            for v in sd._vars.values()],
+        "ops": [n.to_dict() for n in sd._ops],
+        "loss_variables": sd._loss_variables,
+        "iteration": sd._iteration,
+        "epoch": sd._epoch,
+        "training_config": (cserde.to_dict(sd.training_config)
+                            if sd.training_config is not None else None),
+    }
+    with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
+        zf.writestr("graph.json", json.dumps(graph, indent=2))
+        zf.writestr("arrays.npz", _np_savez(sd._arrays))
+        if save_updater_state and sd._updater_state is not None:
+            leaves, _ = jax.tree_util.tree_flatten(sd._updater_state)
+            zf.writestr("updater_state.npz", _np_savez(
+                {f"leaf_{i}": l for i, l in enumerate(leaves)}))
+
+
+def load(path, load_updater_state: bool = True):
+    from deeplearning4j_tpu.autodiff.samediff import (
+        OpNode, SameDiff, SDVariable, VariableType,
+    )
+
+    with zipfile.ZipFile(path) as zf:
+        graph = json.loads(zf.read("graph.json"))
+        arrays = _np_loadz(zf.read("arrays.npz"))
+        updater_raw = None
+        if load_updater_state and "updater_state.npz" in zf.namelist():
+            updater_raw = _np_loadz(zf.read("updater_state.npz"))
+
+    sd = SameDiff()
+    for vd in graph["variables"]:
+        v = SDVariable(sd, vd["name"], VariableType(vd["type"]),
+                       tuple(vd["shape"]) if vd["shape"] is not None else None,
+                       vd["dtype"])
+        sd._vars[v.name] = v
+    for od in graph["ops"]:
+        sd._ops.append(OpNode.from_dict(od))
+    for name, arr in arrays.items():
+        sd._arrays[name] = jnp.asarray(arr)
+    sd._loss_variables = list(graph.get("loss_variables", []))
+    sd._iteration = int(graph.get("iteration", 0))
+    sd._epoch = int(graph.get("epoch", 0))
+    if graph.get("training_config") is not None:
+        sd.training_config = cserde.from_dict(graph["training_config"])
+
+    if updater_raw is not None and sd.training_config is not None:
+        # rebuild state pytree structure from a fresh init, then fill
+        # leaves in order — exact resume of m/v/momentum buffers
+        wrt = {n: sd._arrays[n] for n in sd.trainable_names()}
+        template = sd.training_config.updater.init_state(wrt)
+        leaves, treedef = jax.tree_util.tree_flatten(template)
+        new_leaves = [jnp.asarray(updater_raw[f"leaf_{i}"])
+                      for i in range(len(leaves))]
+        sd._updater_state = jax.tree_util.tree_unflatten(treedef, new_leaves)
+    return sd

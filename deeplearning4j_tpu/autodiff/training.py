@@ -1,0 +1,149 @@
+"""SameDiff training (reference: TrainingConfig + TrainingSession +
+History/listeners — org/nd4j/autodiff/samediff/config/TrainingConfig,
+internal/TrainingSession, listeners/impl/HistoryListener).
+
+The reference's trainingIteration runs the interpreter loop then applies
+per-variable GradientUpdaters eagerly. Here one jit-compiled step does
+forward + backward + updater + param update with donated buffers.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.common.serde import serializable
+from deeplearning4j_tpu.learning.updaters import IUpdater, Sgd, apply_updater
+from deeplearning4j_tpu.ndarray.ndarray import _unwrap
+
+
+@serializable
+@dataclasses.dataclass
+class TrainingConfig:
+    """Reference: TrainingConfig.Builder — updater, data mappings,
+    regularization. dataSetFeatureMapping names the placeholders fed
+    from DataSet features/labels."""
+
+    updater: IUpdater = dataclasses.field(default_factory=lambda: Sgd(0.01))
+    data_set_feature_mapping: Sequence[str] = ()
+    data_set_label_mapping: Sequence[str] = ()
+    l1: float = 0.0
+    l2: float = 0.0
+    minimize: bool = True
+
+
+class History:
+    """Reference: org/nd4j/autodiff/listeners/records/History."""
+
+    def __init__(self):
+        self.loss_curve: List[float] = []
+        self.epoch_losses: List[float] = []
+
+    def lossCurve(self) -> List[float]:
+        return self.loss_curve
+
+    def finalTrainingLoss(self) -> float:
+        return self.loss_curve[-1] if self.loss_curve else float("nan")
+
+
+def _build_train_step(sd, cfg: TrainingConfig, feed_sig):
+    """One XLA executable: loss, grads, updater, param update."""
+    loss_name = sd._loss_name()
+    wrt_names = sd.trainable_names()
+    fwd = sd._build_fn(tuple(sd._loss_variables))
+    updater = cfg.updater
+
+    def step(wrt_arrays, other_arrays, opt_state, it_step, feeds):
+        def loss_fn(wa):
+            outs = fwd({**other_arrays, **wa}, feeds)
+            total = outs[loss_name]
+            for extra in sd._loss_variables[1:]:
+                total = total + outs[extra]
+            total = jnp.sum(total)
+            # sign-flip the score BEFORE penalties so maximization still
+            # penalizes (not rewards) large weights
+            if not cfg.minimize:
+                total = -total
+            if cfg.l1:
+                for v in wa.values():
+                    total = total + cfg.l1 * jnp.sum(jnp.abs(v))
+            if cfg.l2:
+                for v in wa.values():
+                    total = total + 0.5 * cfg.l2 * jnp.sum(v * v)
+            return total
+
+        loss, grads = jax.value_and_grad(loss_fn)(wrt_arrays)
+        updates, new_opt = apply_updater(updater, opt_state, grads,
+                                         wrt_arrays, it_step)
+        new_wrt = jax.tree_util.tree_map(lambda p, u: p - u,
+                                         wrt_arrays, updates)
+        return new_wrt, new_opt, loss, grads
+
+    return jax.jit(step, donate_argnums=(0, 2))
+
+
+def fit(sd, data, epochs: int = 1, validation_data=None,
+        listeners: Sequence[Any] = ()) -> History:
+    """Reference: SameDiff#fit(DataSetIterator, epochs)."""
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.datasets.iterator import DataSetIterator
+
+    cfg = sd.training_config
+    if cfg is None:
+        raise ValueError("Call setTrainingConfig() before fit()")
+    if not cfg.data_set_feature_mapping:
+        raise ValueError("TrainingConfig needs data_set_feature_mapping")
+
+    history = History()
+    if isinstance(data, DataSet):
+        batches = [data]
+        iterate = lambda: batches
+    elif isinstance(data, DataSetIterator):
+        iterate = lambda: data
+    else:
+        batches = list(data)
+        iterate = lambda: batches
+
+    if sd._updater_state is None:
+        wrt = {n: sd._arrays[n] for n in sd.trainable_names()}
+        sd._updater_state = cfg.updater.init_state(wrt)
+
+    step_cache: Dict[Any, Any] = {}
+    for _ in range(epochs):
+        epoch_loss, nb = 0.0, 0
+        for ds in iterate():
+            feeds = {}
+            feats = ds.features if isinstance(ds.features, (list, tuple)) \
+                else [ds.features]
+            labs = ds.labels if isinstance(ds.labels, (list, tuple)) \
+                else [ds.labels]
+            for name, arr in zip(cfg.data_set_feature_mapping, feats):
+                feeds[name] = jnp.asarray(_unwrap(arr))
+            for name, arr in zip(cfg.data_set_label_mapping, labs):
+                feeds[name] = jnp.asarray(_unwrap(arr))
+
+            sig = sd._feed_key(feeds)
+            if sig not in step_cache:
+                step_cache[sig] = _build_train_step(sd, cfg, sig)
+            wrt = {n: sd._arrays[n] for n in sd.trainable_names()}
+            other = {n: a for n, a in sd._arrays.items() if n not in wrt}
+            new_wrt, sd._updater_state, loss, grads = step_cache[sig](
+                wrt, other, sd._updater_state,
+                jnp.asarray(sd._iteration), feeds)
+            sd._arrays.update(new_wrt)
+            sd._last_grads = dict(grads)
+            lv = float(loss)
+            history.loss_curve.append(lv)
+            epoch_loss += lv
+            nb += 1
+            sd._iteration += 1
+            for lst in listeners:
+                if hasattr(lst, "iterationDone"):
+                    lst.iterationDone(sd, sd._iteration, sd._epoch)
+        sd._epoch += 1
+        history.epoch_losses.append(epoch_loss / max(nb, 1))
+    return history

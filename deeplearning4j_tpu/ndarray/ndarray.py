@@ -296,6 +296,28 @@ class NDArray:
         out = jnp.var(self._buf, axis=(dims if dims else None), ddof=ddof)
         return NDArray(out) if dims else float(out)
 
+    # *Number() scalar reductions (reference: INDArray#sumNumber etc.)
+    def sumNumber(self) -> float:
+        return float(jnp.sum(self._buf))
+
+    def meanNumber(self) -> float:
+        return float(jnp.mean(self._buf))
+
+    def maxNumber(self) -> float:
+        return float(jnp.max(self._buf))
+
+    def minNumber(self) -> float:
+        return float(jnp.min(self._buf))
+
+    def prodNumber(self) -> float:
+        return float(jnp.prod(self._buf))
+
+    def stdNumber(self, bias_corrected: bool = True) -> float:
+        return float(jnp.std(self._buf, ddof=1 if bias_corrected else 0))
+
+    def varNumber(self, bias_corrected: bool = True) -> float:
+        return float(jnp.var(self._buf, ddof=1 if bias_corrected else 0))
+
     def argMax(self, *dims):
         if not dims:
             return int(jnp.argmax(self._buf))

@@ -20,6 +20,18 @@ if "xla_force_host_platform_device_count" not in xla_flags:
 
 import jax  # noqa: E402
 
+# The image's sitecustomize registers the axon TPU plugin and sets
+# jax_platforms at the CONFIG level, which outranks the env var — force
+# cpu back at the same level (and drop any already-built backend so the
+# 8-device CPU client is what tests see).
+jax.config.update("jax_platforms", "cpu")
+if jax.devices()[0].platform != "cpu" or len(jax.devices()) < 8:
+    from jax.extend.backend import clear_backends
+
+    clear_backends()
+assert jax.devices()[0].platform == "cpu" and len(jax.devices()) == 8, \
+    f"test mesh wrong: {jax.devices()}"
+
 jax.config.update("jax_enable_x64", False)
 # Correctness tests pin full f32 accumulation; production configs choose
 # their own precision policy (bf16 on MXU) via nn/conf dtype settings.

@@ -147,7 +147,9 @@ class TestLosses:
         labels = jax.nn.one_hot(jnp.asarray([1, 3, 5, 7]), 10)
         fused = L.softmax_xent_logits(labels, logits)
         composed = L.mcxent(labels, jax.nn.softmax(logits))
-        np.testing.assert_allclose(np.asarray(fused), np.asarray(composed), rtol=1e-5)
+        # fused log-softmax vs composed softmax+log differ by f32 rounding
+        np.testing.assert_allclose(np.asarray(fused), np.asarray(composed),
+                                   rtol=3e-4, atol=1e-5)
 
     def test_sparse_matches_dense(self):
         logits = jax.random.normal(jax.random.key(1), (3, 5))

@@ -1,0 +1,215 @@
+"""SameDiff-equivalent engine tests (reference test strategy: SURVEY.md
+§4 — OpValidation numerical gradient checks + SameDiff training/serde
+round-trip tests)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from deeplearning4j_tpu.autodiff import SameDiff, TrainingConfig, VariableType
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.learning.updaters import Adam, Sgd
+
+
+class TestGraphBuild:
+    def test_eval_simple(self):
+        sd = SameDiff.create()
+        a = sd.constant("a", jnp.asarray([1.0, 2.0, 3.0]))
+        b = sd.constant("b", jnp.asarray([10.0, 20.0, 30.0]))
+        c = (a + b).rename("c")
+        np.testing.assert_allclose(np.asarray(c.eval()), [11.0, 22.0, 33.0])
+
+    def test_placeholder_feed(self):
+        sd = SameDiff.create()
+        x = sd.placeholder("x", shape=(None, 3))
+        w = sd.var("w", jnp.ones((3, 2)))
+        y = x.mmul(w).rename("y")
+        out = sd.output({"x": np.ones((4, 3), np.float32)}, ["y"])["y"]
+        np.testing.assert_allclose(np.asarray(out), 3.0 * np.ones((4, 2)))
+
+    def test_namespace_op_emission(self):
+        sd = SameDiff.create()
+        x = sd.placeholder("x", shape=(None, 4))
+        h = sd.math.sigmoid(x)
+        s = sd.math.reduce_sum(h, dimensions=[1])
+        out = sd.outputSingle({"x": np.zeros((2, 4), np.float32)}, s)
+        np.testing.assert_allclose(np.asarray(out), [2.0, 2.0])
+
+    def test_multi_output_op(self):
+        sd = SameDiff.create()
+        x = sd.constant("x", jnp.arange(6.0).reshape(2, 3))
+        a, b = sd.invoke_op("split", [x], n_out=2, num_splits=2, axis=0)
+        np.testing.assert_allclose(np.asarray(a.eval()), [[0, 1, 2]])
+        np.testing.assert_allclose(np.asarray(b.eval()), [[3, 4, 5]])
+
+    def test_pruning_skips_unneeded_ops(self):
+        sd = SameDiff.create()
+        x = sd.constant("x", jnp.ones((2, 2)))
+        used = (x * 2.0).rename("used")
+        _unused = sd.math.exp(x)
+        needed = sd._prune(("used",))
+        assert all(n.op_name != "exp" for n in needed)
+
+    def test_variable_types(self):
+        sd = SameDiff.create()
+        p = sd.placeholder("p", shape=(1,))
+        v = sd.var("v", jnp.zeros(3))
+        c = sd.constant("c", 1.0)
+        o = v + c
+        assert p.vtype is VariableType.PLACEHOLDER
+        assert v.vtype is VariableType.VARIABLE
+        assert c.vtype is VariableType.CONSTANT
+        assert o.vtype is VariableType.ARRAY
+        assert sd.trainable_names() == ["v"]
+
+
+class TestGradients:
+    def test_grad_matches_analytic(self):
+        # loss = sum((x*w)^2) -> dL/dw = 2*w*x^2 summed over batch
+        sd = SameDiff.create()
+        x = sd.placeholder("x", shape=(None,))
+        w = sd.var("w", jnp.asarray([3.0]))
+        y = sd.math.reduce_sum((x * w) * (x * w)).rename("loss")
+        sd.setLossVariables("loss")
+        xv = np.asarray([1.0, 2.0], np.float32)
+        grads = sd.calculateGradients({"x": xv}, ["w"])
+        np.testing.assert_allclose(np.asarray(grads["w"]),
+                                   [2.0 * 3.0 * (1.0 + 4.0)], rtol=1e-6)
+
+    def test_numerical_gradient_check(self):
+        # the reference's OpValidation/GradCheckUtil backbone: finite
+        # differences vs autodiff on a small composite graph
+        sd = SameDiff.create()
+        x = sd.placeholder("x", shape=(2, 3))
+        w = sd.var("w", jnp.asarray(np.random.RandomState(0)
+                                    .randn(3, 2).astype(np.float32)))
+        h = sd.math.tanh(x.mmul(w))
+        loss = sd.math.reduce_sum(h * h).rename("loss")
+        sd.setLossVariables("loss")
+        xv = np.random.RandomState(1).randn(2, 3).astype(np.float32)
+        g = np.asarray(sd.calculateGradients({"x": xv}, ["w"])["w"])
+
+        w0 = np.asarray(sd.getVariable("w").getArr())
+        eps = 1e-3
+        num = np.zeros_like(w0)
+        for i in range(w0.shape[0]):
+            for j in range(w0.shape[1]):
+                for s, sign in ((eps, 1), (-eps, -1)):
+                    wp = w0.copy()
+                    wp[i, j] += s
+                    sd.set_array("w", wp)
+                    lv = float(sd.outputSingle({"x": xv}, "loss"))
+                    num[i, j] += sign * lv
+                num[i, j] /= 2 * eps
+        sd.set_array("w", w0)
+        np.testing.assert_allclose(g, num, rtol=1e-2, atol=1e-3)
+
+    def test_gradient_stored_on_variable(self):
+        sd = SameDiff.create()
+        w = sd.var("w", jnp.asarray([2.0]))
+        loss = (w * w).sum().rename("loss")
+        sd.setLossVariables("loss")
+        sd.calculateGradients({})
+        np.testing.assert_allclose(np.asarray(sd.getVariable("w").gradient()),
+                                   [4.0])
+
+
+class TestTraining:
+    def _linreg_sd(self):
+        sd = SameDiff.create()
+        x = sd.placeholder("x", shape=(None, 2))
+        y = sd.placeholder("y", shape=(None, 1))
+        w = sd.var("w", jnp.zeros((2, 1)))
+        b = sd.var("b", jnp.zeros((1,)))
+        pred = x.mmul(w) + b
+        diff = pred - y
+        loss = sd.math.reduce_mean(diff * diff).rename("loss")
+        sd.setLossVariables("loss")
+        return sd
+
+    def test_fit_converges(self):
+        sd = self._linreg_sd()
+        sd.setTrainingConfig(TrainingConfig(
+            updater=Adam(learning_rate=0.1),
+            data_set_feature_mapping=["x"], data_set_label_mapping=["y"]))
+        rs = np.random.RandomState(0)
+        xv = rs.randn(64, 2).astype(np.float32)
+        yv = (xv @ np.asarray([[2.0], [-3.0]]) + 0.5).astype(np.float32)
+        hist = sd.fit(DataSet(xv, yv), epochs=150)
+        assert hist.finalTrainingLoss() < 1e-2
+        w = np.asarray(sd.getVariable("w").getArr()).ravel()
+        np.testing.assert_allclose(w, [2.0, -3.0], atol=0.1)
+
+    def test_history_records_losses(self):
+        sd = self._linreg_sd()
+        sd.setTrainingConfig(TrainingConfig(
+            updater=Sgd(0.01),
+            data_set_feature_mapping=["x"], data_set_label_mapping=["y"]))
+        xv = np.ones((4, 2), np.float32)
+        yv = np.ones((4, 1), np.float32)
+        hist = sd.fit(DataSet(xv, yv), epochs=3)
+        assert len(hist.loss_curve) == 3
+        assert len(hist.epoch_losses) == 3
+
+
+class TestSerde:
+    def test_save_load_roundtrip(self, tmp_path):
+        sd = SameDiff.create()
+        x = sd.placeholder("x", shape=(None, 2))
+        w = sd.var("w", jnp.asarray([[1.0], [2.0]]))
+        out = x.mmul(w).rename("out")
+        path = tmp_path / "model.sdz"
+        sd.save(path)
+
+        sd2 = SameDiff.load(path)
+        xv = np.asarray([[3.0, 4.0]], np.float32)
+        a = np.asarray(sd.outputSingle({"x": xv}, "out"))
+        b = np.asarray(sd2.outputSingle({"x": xv}, "out"))
+        np.testing.assert_allclose(a, b)
+
+    def test_exact_resume_with_updater_state(self, tmp_path):
+        def make():
+            sd = SameDiff.create()
+            x = sd.placeholder("x", shape=(None, 2))
+            y = sd.placeholder("y", shape=(None, 1))
+            w = sd.var("w", jnp.zeros((2, 1)))
+            loss = ((x.mmul(w) - y) * (x.mmul(w) - y)).mean().rename("loss")
+            sd.setLossVariables("loss")
+            sd.setTrainingConfig(TrainingConfig(
+                updater=Adam(learning_rate=0.05),
+                data_set_feature_mapping=["x"],
+                data_set_label_mapping=["y"]))
+            return sd
+
+        rs = np.random.RandomState(0)
+        xv = rs.randn(16, 2).astype(np.float32)
+        yv = rs.randn(16, 1).astype(np.float32)
+        ds = DataSet(xv, yv)
+
+        # continuous 10-epoch run
+        sd_full = make()
+        sd_full.fit(ds, epochs=10)
+        w_full = np.asarray(sd_full.getVariable("w").getArr())
+
+        # 5 epochs, save (incl. Adam m/v + iteration), load, 5 more
+        sd_a = make()
+        sd_a.fit(ds, epochs=5)
+        path = tmp_path / "ckpt.sdz"
+        sd_a.save(path)
+        sd_b = SameDiff.load(path)
+        assert sd_b._iteration == sd_a._iteration
+        sd_b.fit(ds, epochs=5)
+        w_resumed = np.asarray(sd_b.getVariable("w").getArr())
+        np.testing.assert_allclose(w_resumed, w_full, rtol=1e-5, atol=1e-6)
+
+
+class TestExport:
+    def test_stablehlo_lowering(self):
+        sd = SameDiff.create()
+        x = sd.placeholder("x", shape=(2, 2))
+        w = sd.var("w", jnp.ones((2, 2)))
+        y = sd.math.relu(x.mmul(w)).rename("y")
+        txt = sd.to_stablehlo({"x": np.ones((2, 2), np.float32)}, ["y"])
+        assert "stablehlo" in txt or "mhlo" in txt or "func.func" in txt
+        assert "dot_general" in txt
