@@ -5,6 +5,13 @@ from deeplearning4j_tpu.datasets.dataset import DataSet
 from deeplearning4j_tpu.datasets.iterator import (
     DataSetIterator, ListDataSetIterator, ArrayDataSetIterator,
 )
+from deeplearning4j_tpu.datasets.record_reader_iterator import (
+    AsyncDataSetIterator,
+    RecordReaderDataSetIterator,
+    SequenceRecordReaderDataSetIterator,
+)
 
 __all__ = ["DataSet", "DataSetIterator", "ListDataSetIterator",
-           "ArrayDataSetIterator"]
+           "ArrayDataSetIterator", "AsyncDataSetIterator",
+           "RecordReaderDataSetIterator",
+           "SequenceRecordReaderDataSetIterator"]

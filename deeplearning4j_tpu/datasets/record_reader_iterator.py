@@ -1,0 +1,209 @@
+"""RecordReader → DataSet iterators + async host prefetch.
+
+Reference: deeplearning4j-data RecordReaderDataSetIterator.java,
+SequenceRecordReaderDataSetIterator.java, and nd4j
+AsyncDataSetIterator.java (background ETL thread + bounded queue that
+keeps the device fed — SURVEY.md §2.27, §3.1). The TPU analog of the
+reference's workspace-backed prefetch thread is simply: decode/augment
+on host threads while the accelerator runs the previous jitted step.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterator import DataSetIterator
+from deeplearning4j_tpu.datavec.records import RecordReader
+
+
+def _one_hot(labels: np.ndarray, n: int) -> np.ndarray:
+    out = np.zeros((labels.shape[0], n), dtype=np.float32)
+    out[np.arange(labels.shape[0]), labels.astype(np.int64)] = 1.0
+    return out
+
+
+class RecordReaderDataSetIterator(DataSetIterator):
+    """Batch records into DataSets.
+
+    Classification: ``label_index`` + ``num_classes`` → one-hot labels.
+    Regression: ``regression=True`` with ``label_index`` (or
+    label_index_from/to range). Image readers (records of
+    [HWC array, label]) are detected automatically and stacked NHWC.
+    """
+
+    def __init__(self, reader: RecordReader, batch_size: int,
+                 label_index: Optional[int] = None,
+                 num_classes: Optional[int] = None,
+                 regression: bool = False,
+                 label_index_from: Optional[int] = None,
+                 label_index_to: Optional[int] = None):
+        self.reader = reader
+        self._bs = batch_size
+        self.label_index = label_index
+        self.num_classes = num_classes
+        self.regression = regression
+        self.label_from = label_index_from
+        self.label_to = label_index_to
+        self.reader.reset()
+
+    def reset(self):
+        self.reader.reset()
+
+    def hasNext(self) -> bool:
+        return self.reader.hasNext()
+
+    def batch(self) -> int:
+        return self._bs
+
+    def next(self) -> DataSet:
+        recs = []
+        while self.reader.hasNext() and len(recs) < self._bs:
+            recs.append(self.reader.next())
+        first = recs[0]
+        if len(first) == 2 and isinstance(first[0], np.ndarray) \
+                and first[0].ndim >= 2:
+            # image records: [HWC, label]
+            x = np.stack([r[0] for r in recs]).astype(np.float32)
+            y = np.asarray([r[1] for r in recs])
+            if self.num_classes:
+                y = _one_hot(y, self.num_classes)
+            return DataSet(x, y)
+        mat = np.asarray(recs, dtype=np.float32)
+        if self.label_from is not None and self.label_to is not None:
+            y = mat[:, self.label_from:self.label_to + 1]
+            x = np.delete(mat, range(self.label_from, self.label_to + 1),
+                          axis=1)
+            return DataSet(x, y)
+        if self.label_index is None:
+            return DataSet(mat, mat)
+        y_col = mat[:, self.label_index]
+        x = np.delete(mat, self.label_index, axis=1)
+        if self.regression:
+            return DataSet(x, y_col[:, None])
+        if self.num_classes is None:
+            raise ValueError("classification needs num_classes")
+        return DataSet(x, _one_hot(y_col, self.num_classes))
+
+
+class SequenceRecordReaderDataSetIterator(DataSetIterator):
+    """One sequence per record → NTF DataSet batches, right-padded with
+    masks (reference: SequenceRecordReaderDataSetIterator ALIGN_END).
+    """
+
+    def __init__(self, reader: RecordReader, batch_size: int,
+                 label_index: int, num_classes: Optional[int] = None,
+                 regression: bool = False):
+        self.reader = reader
+        self._bs = batch_size
+        self.label_index = label_index
+        self.num_classes = num_classes
+        self.regression = regression
+        self.reader.reset()
+
+    def reset(self):
+        self.reader.reset()
+
+    def hasNext(self) -> bool:
+        return self.reader.hasNext()
+
+    def batch(self) -> int:
+        return self._bs
+
+    def next(self) -> DataSet:
+        seqs = []
+        while self.reader.hasNext() and len(seqs) < self._bs:
+            seqs.append(self.reader.next())
+        lengths = [len(s) for s in seqs]
+        t_max = max(lengths)
+        n_in = len(seqs[0][0]) - 1
+        n_out = self.num_classes if self.num_classes else 1
+        x = np.zeros((len(seqs), t_max, n_in), np.float32)
+        y = np.zeros((len(seqs), t_max, n_out), np.float32)
+        mask = np.zeros((len(seqs), t_max), np.float32)
+        for i, seq in enumerate(seqs):
+            for t, step in enumerate(seq):
+                row = list(step)
+                label = row.pop(self.label_index)
+                x[i, t] = row
+                if self.regression or self.num_classes is None:
+                    y[i, t, 0] = label
+                else:
+                    y[i, t, int(label)] = 1.0
+                mask[i, t] = 1.0
+        return DataSet(x, y, features_mask=mask, labels_mask=mask)
+
+
+class AsyncDataSetIterator(DataSetIterator):
+    """Background-thread prefetch wrapper (reference:
+    AsyncDataSetIterator with queue size; the device never waits on
+    host ETL). Exceptions in the worker re-raise on next()."""
+
+    _SENTINEL = object()
+
+    def __init__(self, underlying: DataSetIterator, queue_size: int = 4):
+        self.underlying = underlying
+        self.queue_size = queue_size
+        self._q: "queue.Queue" = queue.Queue(maxsize=queue_size)
+        self._thread: Optional[threading.Thread] = None
+        self._error: Optional[BaseException] = None
+        self._peek = None
+        self._exhausted = False  # sentinel consumed; epoch over
+        self._start()
+
+    def _start(self):
+        self._error = None
+        self._exhausted = False
+        self._q = queue.Queue(maxsize=self.queue_size)
+
+        def worker():
+            try:
+                self.underlying.reset()
+                while self.underlying.hasNext():
+                    self._q.put(self.underlying.next())
+            except BaseException as e:  # propagate to consumer
+                self._error = e
+            finally:
+                self._q.put(self._SENTINEL)
+
+        self._thread = threading.Thread(target=worker, daemon=True)
+        self._thread.start()
+
+    def reset(self):
+        # Drain until the worker's sentinel (unless already consumed),
+        # then restart. Gate on _exhausted, not thread liveness: the
+        # worker may still be between put(SENTINEL) and exit.
+        if self._thread is not None and not self._exhausted:
+            while self._q.get() is not self._SENTINEL:
+                pass
+        if self._thread is not None:
+            self._thread.join()
+        self._peek = None
+        self._start()
+
+    def hasNext(self) -> bool:
+        if self._exhausted:
+            return False
+        if self._peek is None:
+            item = self._q.get()
+            if item is self._SENTINEL:
+                self._exhausted = True
+                if self._error is not None:
+                    err, self._error = self._error, None
+                    raise err
+                return False
+            self._peek = item
+        return True
+
+    def next(self) -> DataSet:
+        if not self.hasNext():
+            raise StopIteration
+        item, self._peek = self._peek, None
+        return item
+
+    def batch(self) -> int:
+        return self.underlying.batch()

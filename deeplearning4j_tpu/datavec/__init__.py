@@ -1,0 +1,36 @@
+"""DataVec-equivalent ETL layer (reference: datavec/ — SURVEY.md §2.25-2.26).
+
+The reference pipeline is record-at-a-time Java objects (Writable lists
+flowing RecordReader → TransformProcess → RecordReaderDataSetIterator).
+The TPU-native redesign is *column-vectorized*: readers parse whole
+files into numpy column arrays once, and a TransformProcess compiles to
+a chain of vectorized numpy column ops, because host-side ETL must keep
+an accelerator fed — per-record Python objects cannot. The public
+surface (Schema, TransformProcess builder verbs, RecordReader
+next/hasNext) mirrors the reference so pipelines translate 1:1.
+"""
+
+from deeplearning4j_tpu.datavec.schema import ColumnType, Schema
+from deeplearning4j_tpu.datavec.records import (
+    CollectionRecordReader,
+    CSVRecordReader,
+    CSVSequenceRecordReader,
+    FileSplit,
+    LineRecordReader,
+    NumberedFileInputSplit,
+    RecordReader,
+)
+from deeplearning4j_tpu.datavec.transform import TransformProcess
+from deeplearning4j_tpu.datavec.image import (
+    ImageRecordReader,
+    NativeImageLoader,
+    ParentPathLabelGenerator,
+)
+
+__all__ = [
+    "ColumnType", "Schema", "TransformProcess",
+    "RecordReader", "CSVRecordReader", "CSVSequenceRecordReader",
+    "LineRecordReader", "CollectionRecordReader",
+    "FileSplit", "NumberedFileInputSplit",
+    "ImageRecordReader", "NativeImageLoader", "ParentPathLabelGenerator",
+]

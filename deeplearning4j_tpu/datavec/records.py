@@ -1,0 +1,210 @@
+"""Record readers + input splits.
+
+Reference: org/datavec/api/records/reader/impl/** (CSVRecordReader,
+LineRecordReader, CSVSequenceRecordReader, CollectionRecordReader) and
+org/datavec/api/split/{FileSplit,NumberedFileInputSplit}.
+
+Readers keep the reference's initialize(split) / hasNext() / next()
+surface, but internally parse eagerly into Python lists (host ETL is
+not the TPU hot path; vectorization happens in TransformProcess and
+RecordReaderDataSetIterator, which batch-convert to numpy).
+"""
+
+from __future__ import annotations
+
+import csv
+import glob as _glob
+import io
+import os
+import random
+from typing import Iterator, List, Optional, Sequence, Union
+
+
+class InputSplit:
+    """Locations of raw input data (reference: org/datavec/api/split)."""
+
+    def locations(self) -> List[str]:
+        raise NotImplementedError
+
+
+class FileSplit(InputSplit):
+    """A file, or a directory scanned (recursively) for files with
+    allowed extensions; optional shuffle with seed (reference
+    FileSplit(File, String[], Random))."""
+
+    def __init__(self, path: str, allowed_extensions: Optional[Sequence[str]] = None,
+                 seed: Optional[int] = None):
+        self.path = path
+        self.allowed = tuple(e.lower().lstrip(".") for e in allowed_extensions) \
+            if allowed_extensions else None
+        self.seed = seed
+
+    def locations(self) -> List[str]:
+        if os.path.isfile(self.path):
+            return [self.path]
+        out = []
+        for root, _dirs, files in os.walk(self.path):
+            for f in sorted(files):
+                if self.allowed is None or \
+                        f.rsplit(".", 1)[-1].lower() in self.allowed:
+                    out.append(os.path.join(root, f))
+        out.sort()
+        if self.seed is not None:
+            random.Random(self.seed).shuffle(out)
+        return out
+
+
+class NumberedFileInputSplit(InputSplit):
+    """Pattern like ``/dir/file_%d.txt`` over an inclusive index range
+    (reference: NumberedFileInputSplit)."""
+
+    def __init__(self, pattern: str, min_idx: int, max_idx: int):
+        if "%d" not in pattern:
+            raise ValueError("pattern must contain %d")
+        self.pattern = pattern
+        self.min_idx = min_idx
+        self.max_idx = max_idx
+
+    def locations(self) -> List[str]:
+        return [self.pattern % i for i in range(self.min_idx, self.max_idx + 1)]
+
+
+def _as_split(split: Union[InputSplit, str]) -> InputSplit:
+    return FileSplit(split) if isinstance(split, str) else split
+
+
+class RecordReader:
+    """Base reader: initialize(split) then iterate records (lists of
+    values). Mirrors the reference interface incl. reset()."""
+
+    def initialize(self, split: Union[InputSplit, str]) -> "RecordReader":
+        raise NotImplementedError
+
+    def hasNext(self) -> bool:
+        return self._i < len(self._records)
+
+    def next(self) -> List:
+        r = self._records[self._i]
+        self._i += 1
+        return r
+
+    def reset(self) -> None:
+        self._i = 0
+
+    def totalRecords(self) -> int:
+        return len(self._records)
+
+    def allRecords(self) -> List[List]:
+        return list(self._records)
+
+    def __iter__(self) -> Iterator[List]:
+        self.reset()
+        while self.hasNext():
+            yield self.next()
+
+    # shared state
+    _records: List[List] = []
+    _i: int = 0
+
+
+def _parse_value(s: str):
+    """CSV field → int | float | str (reference keeps Writable subtypes;
+    here native types carry the same information)."""
+    try:
+        return int(s)
+    except ValueError:
+        pass
+    try:
+        return float(s)
+    except ValueError:
+        return s
+
+
+class CSVRecordReader(RecordReader):
+    """reference: CSVRecordReader(skipNumLines, delimiter)."""
+
+    def __init__(self, skip_num_lines: int = 0, delimiter: str = ","):
+        self.skip = skip_num_lines
+        self.delimiter = delimiter
+        self._records = []
+        self._i = 0
+
+    def initialize(self, split: Union[InputSplit, str]) -> "CSVRecordReader":
+        self._records = []
+        for loc in _as_split(split).locations():
+            with open(loc, newline="") as f:
+                rows = list(csv.reader(f, delimiter=self.delimiter))
+            for row in rows[self.skip:]:
+                if not row:
+                    continue
+                self._records.append([_parse_value(v.strip()) for v in row])
+        self._i = 0
+        return self
+
+    def initializeFromString(self, data: str) -> "CSVRecordReader":
+        rows = list(csv.reader(io.StringIO(data), delimiter=self.delimiter))
+        self._records = [[_parse_value(v.strip()) for v in row]
+                         for row in rows[self.skip:] if row]
+        self._i = 0
+        return self
+
+
+class LineRecordReader(RecordReader):
+    """One record per line, single string value (reference:
+    LineRecordReader)."""
+
+    def __init__(self):
+        self._records = []
+        self._i = 0
+
+    def initialize(self, split: Union[InputSplit, str]) -> "LineRecordReader":
+        self._records = []
+        for loc in _as_split(split).locations():
+            with open(loc) as f:
+                for line in f:
+                    self._records.append([line.rstrip("\n")])
+        self._i = 0
+        return self
+
+
+class CollectionRecordReader(RecordReader):
+    """Wrap an in-memory collection of records (reference:
+    CollectionRecordReader)."""
+
+    def __init__(self, records: Sequence[Sequence]):
+        self._records = [list(r) for r in records]
+        self._i = 0
+
+    def initialize(self, split=None) -> "CollectionRecordReader":
+        self._i = 0
+        return self
+
+
+class SequenceRecordReader(RecordReader):
+    """Base for readers producing sequences: each record is a list of
+    time steps, each time step a list of values."""
+
+    def nextSequence(self) -> List[List]:
+        return self.next()
+
+
+class CSVSequenceRecordReader(SequenceRecordReader):
+    """One CSV file per sequence (reference: CSVSequenceRecordReader —
+    used by the UCI sequence examples)."""
+
+    def __init__(self, skip_num_lines: int = 0, delimiter: str = ","):
+        self.skip = skip_num_lines
+        self.delimiter = delimiter
+        self._records = []
+        self._i = 0
+
+    def initialize(self, split: Union[InputSplit, str]) -> "CSVSequenceRecordReader":
+        self._records = []
+        for loc in _as_split(split).locations():
+            with open(loc, newline="") as f:
+                rows = list(csv.reader(f, delimiter=self.delimiter))
+            seq = [[_parse_value(v.strip()) for v in row]
+                   for row in rows[self.skip:] if row]
+            self._records.append(seq)
+        self._i = 0
+        return self

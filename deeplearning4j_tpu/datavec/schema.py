@@ -1,0 +1,155 @@
+"""Schema: typed column metadata for tabular records.
+
+Reference: org/datavec/api/transform/schema/Schema.java (builder with
+addColumnInteger/Double/Categorical/String/Time). JSON round-trip kept
+(reference guarantees Jackson round-trip for all transform configs).
+"""
+
+from __future__ import annotations
+
+import enum
+import json
+from typing import Any, Dict, List, Optional, Sequence
+
+
+class ColumnType(enum.Enum):
+    INTEGER = "Integer"
+    LONG = "Long"
+    DOUBLE = "Double"
+    FLOAT = "Float"
+    CATEGORICAL = "Categorical"
+    STRING = "String"
+    TIME = "Time"
+    BOOLEAN = "Boolean"
+
+    @property
+    def numeric(self) -> bool:
+        return self in (ColumnType.INTEGER, ColumnType.LONG,
+                        ColumnType.DOUBLE, ColumnType.FLOAT,
+                        ColumnType.BOOLEAN, ColumnType.TIME)
+
+
+class _ColumnMeta:
+    def __init__(self, name: str, ctype: ColumnType,
+                 categories: Optional[List[str]] = None,
+                 min_value=None, max_value=None):
+        self.name = name
+        self.type = ctype
+        self.categories = list(categories) if categories else None
+        self.min_value = min_value
+        self.max_value = max_value
+
+    def to_dict(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {"name": self.name, "type": self.type.value}
+        if self.categories is not None:
+            d["categories"] = self.categories
+        if self.min_value is not None:
+            d["min"] = self.min_value
+        if self.max_value is not None:
+            d["max"] = self.max_value
+        return d
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "_ColumnMeta":
+        return _ColumnMeta(d["name"], ColumnType(d["type"]),
+                           d.get("categories"), d.get("min"), d.get("max"))
+
+
+class Schema:
+    """Immutable-ish ordered column schema with a reference-style Builder."""
+
+    def __init__(self, columns: Sequence[_ColumnMeta] = ()):
+        self.columns: List[_ColumnMeta] = list(columns)
+
+    # ---- queries (reference API names) ----
+    def numColumns(self) -> int:
+        return len(self.columns)
+
+    def getColumnNames(self) -> List[str]:
+        return [c.name for c in self.columns]
+
+    def getColumnTypes(self) -> List[ColumnType]:
+        return [c.type for c in self.columns]
+
+    def getIndexOfColumn(self, name: str) -> int:
+        for i, c in enumerate(self.columns):
+            if c.name == name:
+                return i
+        raise KeyError(f"no column named {name!r}; have {self.getColumnNames()}")
+
+    def getColumnMeta(self, name: str) -> _ColumnMeta:
+        return self.columns[self.getIndexOfColumn(name)]
+
+    def hasColumn(self, name: str) -> bool:
+        return any(c.name == name for c in self.columns)
+
+    # ---- serde ----
+    def toJson(self) -> str:
+        return json.dumps({"columns": [c.to_dict() for c in self.columns]},
+                          indent=2)
+
+    @staticmethod
+    def fromJson(s: str) -> "Schema":
+        d = json.loads(s)
+        return Schema([_ColumnMeta.from_dict(c) for c in d["columns"]])
+
+    def __eq__(self, other):
+        return (isinstance(other, Schema)
+                and self.toJson() == other.toJson())
+
+    def __repr__(self):
+        cols = ", ".join(f"{c.name}:{c.type.value}" for c in self.columns)
+        return f"Schema({cols})"
+
+    # ---- builder ----
+    class Builder:
+        def __init__(self):
+            self._cols: List[_ColumnMeta] = []
+
+        def addColumnInteger(self, name: str, min_value=None, max_value=None):
+            self._cols.append(_ColumnMeta(name, ColumnType.INTEGER,
+                                          None, min_value, max_value))
+            return self
+
+        def addColumnLong(self, name: str):
+            self._cols.append(_ColumnMeta(name, ColumnType.LONG))
+            return self
+
+        def addColumnDouble(self, name: str, min_value=None, max_value=None):
+            self._cols.append(_ColumnMeta(name, ColumnType.DOUBLE,
+                                          None, min_value, max_value))
+            return self
+
+        def addColumnFloat(self, name: str):
+            self._cols.append(_ColumnMeta(name, ColumnType.FLOAT))
+            return self
+
+        def addColumnCategorical(self, name: str, *categories: str):
+            if len(categories) == 1 and isinstance(categories[0], (list, tuple)):
+                categories = tuple(categories[0])
+            self._cols.append(_ColumnMeta(name, ColumnType.CATEGORICAL,
+                                          list(categories)))
+            return self
+
+        def addColumnString(self, name: str):
+            self._cols.append(_ColumnMeta(name, ColumnType.STRING))
+            return self
+
+        def addColumnTime(self, name: str):
+            self._cols.append(_ColumnMeta(name, ColumnType.TIME))
+            return self
+
+        def addColumnBoolean(self, name: str):
+            self._cols.append(_ColumnMeta(name, ColumnType.BOOLEAN))
+            return self
+
+        def addColumnsDouble(self, *names: str):
+            for n in names:
+                self.addColumnDouble(n)
+            return self
+
+        def build(self) -> "Schema":
+            names = [c.name for c in self._cols]
+            if len(set(names)) != len(names):
+                raise ValueError(f"duplicate column names: {names}")
+            return Schema(self._cols)

@@ -1,0 +1,347 @@
+"""ETL/DataVec tests (reference test model: datavec-api transform tests
++ RecordReaderDataSetIterator tests — SURVEY.md §4)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets import (
+    AsyncDataSetIterator,
+    ArrayDataSetIterator,
+    RecordReaderDataSetIterator,
+    SequenceRecordReaderDataSetIterator,
+)
+from deeplearning4j_tpu.datavec import (
+    CollectionRecordReader,
+    CSVRecordReader,
+    CSVSequenceRecordReader,
+    FileSplit,
+    ImageRecordReader,
+    LineRecordReader,
+    NativeImageLoader,
+    NumberedFileInputSplit,
+    ParentPathLabelGenerator,
+    Schema,
+    TransformProcess,
+)
+from deeplearning4j_tpu.datavec.transform import Condition, ConditionOp
+
+
+IRIS_CSV = """5.1,3.5,1.4,0.2,setosa
+4.9,3.0,1.4,0.2,setosa
+7.0,3.2,4.7,1.4,versicolor
+6.3,3.3,6.0,2.5,virginica
+5.8,2.7,5.1,1.9,virginica
+"""
+
+
+def iris_schema():
+    return (Schema.Builder()
+            .addColumnsDouble("sepal_l", "sepal_w", "petal_l", "petal_w")
+            .addColumnCategorical("species",
+                                  "setosa", "versicolor", "virginica")
+            .build())
+
+
+class TestSchema:
+    def test_builder_and_queries(self):
+        s = iris_schema()
+        assert s.numColumns() == 5
+        assert s.getColumnNames()[0] == "sepal_l"
+        assert s.getIndexOfColumn("species") == 4
+        assert s.getColumnMeta("species").categories == [
+            "setosa", "versicolor", "virginica"]
+
+    def test_json_roundtrip(self):
+        s = iris_schema()
+        assert Schema.fromJson(s.toJson()) == s
+
+    def test_duplicate_rejected(self):
+        with pytest.raises(ValueError):
+            Schema.Builder().addColumnDouble("a").addColumnDouble("a").build()
+
+
+class TestRecordReaders:
+    def test_csv_from_string(self):
+        rr = CSVRecordReader().initializeFromString(IRIS_CSV)
+        assert rr.totalRecords() == 5
+        first = rr.next()
+        assert first == [5.1, 3.5, 1.4, 0.2, "setosa"]
+
+    def test_csv_file_and_reset(self, tmp_path):
+        p = tmp_path / "iris.csv"
+        p.write_text(IRIS_CSV)
+        rr = CSVRecordReader().initialize(str(p))
+        n = sum(1 for _ in rr)
+        rr.reset()
+        assert rr.hasNext() and n == 5
+
+    def test_csv_skip_lines(self, tmp_path):
+        p = tmp_path / "h.csv"
+        p.write_text("colA,colB\n1,2\n3,4\n")
+        rr = CSVRecordReader(skip_num_lines=1).initialize(str(p))
+        assert rr.allRecords() == [[1, 2], [3, 4]]
+
+    def test_line_reader(self, tmp_path):
+        p = tmp_path / "t.txt"
+        p.write_text("hello\nworld\n")
+        rr = LineRecordReader().initialize(str(p))
+        assert rr.allRecords() == [["hello"], ["world"]]
+
+    def test_collection_reader(self):
+        rr = CollectionRecordReader([[1, 2], [3, 4]]).initialize()
+        assert rr.next() == [1, 2]
+
+    def test_file_split_extensions_and_shuffle(self, tmp_path):
+        for name in ["a.csv", "b.csv", "c.txt"]:
+            (tmp_path / name).write_text("1\n")
+        fs = FileSplit(str(tmp_path), allowed_extensions=["csv"])
+        locs = fs.locations()
+        assert len(locs) == 2 and all(l.endswith(".csv") for l in locs)
+        fs2 = FileSplit(str(tmp_path), seed=42)
+        assert sorted(fs2.locations()) == sorted(FileSplit(str(tmp_path)).locations())
+
+    def test_numbered_split(self):
+        s = NumberedFileInputSplit("/d/f_%d.csv", 0, 2)
+        assert s.locations() == ["/d/f_0.csv", "/d/f_1.csv", "/d/f_2.csv"]
+
+    def test_csv_sequence_reader(self, tmp_path):
+        for i in range(2):
+            (tmp_path / f"seq_{i}.csv").write_text("1,2\n3,4\n5,6\n")
+        rr = CSVSequenceRecordReader().initialize(
+            NumberedFileInputSplit(str(tmp_path / "seq_%d.csv"), 0, 1))
+        seq = rr.next()
+        assert len(seq) == 3 and seq[0] == [1, 2]
+
+
+class TestTransformProcess:
+    def test_categorical_to_integer(self):
+        tp = (TransformProcess.Builder(iris_schema())
+              .categoricalToInteger("species")
+              .build())
+        rr = CSVRecordReader().initializeFromString(IRIS_CSV)
+        out = tp.execute(rr.allRecords())
+        assert [r[4] for r in out] == [0, 0, 1, 2, 2]
+        assert tp.final_schema.getColumnMeta("species").type.name == "INTEGER"
+
+    def test_one_hot(self):
+        tp = (TransformProcess.Builder(iris_schema())
+              .categoricalToOneHot("species")
+              .build())
+        out = tp.execute(CSVRecordReader()
+                         .initializeFromString(IRIS_CSV).allRecords())
+        assert tp.final_schema.numColumns() == 7
+        assert out[0][4:] == [1, 0, 0]
+        assert out[3][4:] == [0, 0, 1]
+
+    def test_remove_rename_math(self):
+        tp = (TransformProcess.Builder(iris_schema())
+              .removeColumns("species")
+              .renameColumn("sepal_l", "sl")
+              .doubleMathOp("sl", "Multiply", 2.0)
+              .doubleColumnsMathOp("area", "Multiply", "petal_l", "petal_w")
+              .build())
+        out = tp.execute(CSVRecordReader()
+                         .initializeFromString(IRIS_CSV).allRecords())
+        assert tp.final_schema.getColumnNames() == [
+            "sl", "sepal_w", "petal_l", "petal_w", "area"]
+        assert out[0][0] == pytest.approx(10.2)
+        assert out[0][4] == pytest.approx(1.4 * 0.2)
+
+    def test_filter_removes_matching(self):
+        tp = (TransformProcess.Builder(iris_schema())
+              .filter(ConditionOp.equal("species", "setosa"))
+              .build())
+        out = tp.execute(CSVRecordReader()
+                         .initializeFromString(IRIS_CSV).allRecords())
+        assert len(out) == 3
+
+    def test_conditional_replace(self):
+        tp = (TransformProcess.Builder(iris_schema())
+              .conditionalReplaceValueTransform(
+                  "sepal_l", 0.0, ConditionOp.lessThan("sepal_l", 5.5))
+              .build())
+        out = tp.execute(CSVRecordReader()
+                         .initializeFromString(IRIS_CSV).allRecords())
+        assert out[0][0] == 0.0 and out[2][0] == 7.0
+
+    def test_normalize_and_pack(self):
+        tp = (TransformProcess.Builder(iris_schema())
+              .categoricalToInteger("species")
+              .normalize("sepal_l", "Standardize")
+              .build())
+        arr = tp.executeToArray(CSVRecordReader()
+                                .initializeFromString(IRIS_CSV).allRecords())
+        assert arr.shape == (5, 5) and arr.dtype == np.float32
+        assert abs(arr[:, 0].mean()) < 1e-6
+
+    def test_pack_rejects_string(self):
+        tp = TransformProcess.Builder(iris_schema()).build()
+        with pytest.raises(TypeError):
+            tp.executeToArray(CSVRecordReader()
+                              .initializeFromString(IRIS_CSV).allRecords())
+
+    def test_json_roundtrip_execution(self):
+        tp = (TransformProcess.Builder(iris_schema())
+              .categoricalToInteger("species")
+              .doubleMathOp("sepal_w", "Add", 1.0)
+              .filter(ConditionOp.greaterThan("petal_l", 5.0))
+              .build())
+        tp2 = TransformProcess.fromJson(tp.toJson())
+        recs = CSVRecordReader().initializeFromString(IRIS_CSV).allRecords()
+        assert tp.execute(recs) == tp2.execute(recs)
+
+    def test_schema_error_surfaces(self):
+        with pytest.raises(KeyError):
+            (TransformProcess.Builder(iris_schema())
+             .removeColumns("nope").build())
+        with pytest.raises(KeyError):
+            (TransformProcess.Builder(iris_schema())
+             .removeAllColumnsExceptFor("sepal_l", "typo").build())
+
+    def test_tojson_rejects_custom_steps(self):
+        tp = (TransformProcess.Builder(iris_schema())
+              .transform(lambda t: t).build())
+        with pytest.raises(ValueError, match="custom"):
+            tp.toJson()
+
+
+class TestRecordReaderDataSetIterator:
+    def test_classification(self):
+        tp = (TransformProcess.Builder(iris_schema())
+              .categoricalToInteger("species").build())
+        recs = tp.execute(CSVRecordReader()
+                          .initializeFromString(IRIS_CSV).allRecords())
+        it = RecordReaderDataSetIterator(
+            CollectionRecordReader(recs), batch_size=3,
+            label_index=4, num_classes=3)
+        ds = it.next()
+        assert ds.features.shape == (3, 4)
+        assert ds.labels.shape == (3, 3)
+        assert float(np.asarray(ds.labels).sum()) == 3.0
+        ds2 = it.next()
+        assert ds2.features.shape == (2, 4)
+        assert not it.hasNext()
+
+    def test_regression(self):
+        recs = [[1.0, 2.0, 10.0], [3.0, 4.0, 20.0]]
+        it = RecordReaderDataSetIterator(
+            CollectionRecordReader(recs), batch_size=2,
+            label_index=2, regression=True)
+        ds = it.next()
+        assert ds.features.shape == (2, 2) and ds.labels.shape == (2, 1)
+        assert float(np.asarray(ds.labels)[1, 0]) == 20.0
+
+    def test_sequence_iterator_masks(self, tmp_path):
+        (tmp_path / "s_0.csv").write_text("1,2,0\n3,4,1\n")
+        (tmp_path / "s_1.csv").write_text("5,6,1\n")
+        rr = CSVSequenceRecordReader().initialize(
+            NumberedFileInputSplit(str(tmp_path / "s_%d.csv"), 0, 1))
+        it = SequenceRecordReaderDataSetIterator(
+            rr, batch_size=2, label_index=2, num_classes=2)
+        ds = it.next()
+        assert ds.features.shape == (2, 2, 2)
+        assert ds.labels.shape == (2, 2, 2)
+        assert np.asarray(ds.features_mask).tolist() == [[1, 1], [1, 0]]
+
+
+class TestImagePipeline:
+    def _make_tree(self, tmp_path):
+        from PIL import Image
+        rng = np.random.default_rng(0)
+        for label in ["cat", "dog"]:
+            d = tmp_path / label
+            d.mkdir()
+            for i in range(3):
+                arr = rng.integers(0, 255, (12, 10, 3), dtype=np.uint8)
+                Image.fromarray(arr).save(d / f"{i}.png")
+        return tmp_path
+
+    def test_loader_shapes(self, tmp_path):
+        tree = self._make_tree(tmp_path)
+        loader = NativeImageLoader(8, 8, 3)
+        img = loader.asMatrix(str(tree / "cat" / "0.png"))
+        assert img.shape == (8, 8, 3) and img.dtype == np.float32
+        gray = NativeImageLoader(8, 8, 1).asMatrix(str(tree / "cat" / "0.png"))
+        assert gray.shape == (8, 8, 1)
+
+    def test_image_record_reader(self, tmp_path):
+        tree = self._make_tree(tmp_path)
+        rr = ImageRecordReader(8, 8, 3, ParentPathLabelGenerator())
+        rr.initialize(FileSplit(str(tree), allowed_extensions=["png"]))
+        assert rr.getLabels() == ["cat", "dog"]
+        x, y = rr.loadAll()
+        assert x.shape == (6, 8, 8, 3)
+        assert sorted(y.tolist()).count(0) == 3
+
+    def test_image_to_dataset_iterator(self, tmp_path):
+        tree = self._make_tree(tmp_path)
+        rr = ImageRecordReader(8, 8, 3, ParentPathLabelGenerator())
+        rr.initialize(FileSplit(str(tree), allowed_extensions=["png"]))
+        it = RecordReaderDataSetIterator(rr, batch_size=4, num_classes=2)
+        ds = it.next()
+        assert ds.features.shape == (4, 8, 8, 3)
+        assert ds.labels.shape == (4, 2)
+
+    def test_transforms(self, tmp_path):
+        from deeplearning4j_tpu.datavec.image import (
+            FlipImageTransform, PipelineImageTransform, ResizeImageTransform)
+        rng = np.random.default_rng(0)
+        img = rng.integers(0, 255, (12, 10, 3)).astype(np.float32)
+        t = PipelineImageTransform(ResizeImageTransform(6, 6),
+                                   FlipImageTransform(p=1.0))
+        out = t(img, rng)
+        assert out.shape == (6, 6, 3)
+
+
+class TestAsyncIterator:
+    def test_matches_sync(self):
+        x = np.arange(40, dtype=np.float32).reshape(20, 2)
+        y = np.zeros((20, 1), np.float32)
+        sync = ArrayDataSetIterator(x, y, batch_size=6)
+        async_it = AsyncDataSetIterator(
+            ArrayDataSetIterator(x, y, batch_size=6), queue_size=2)
+        a = [np.asarray(d.features) for d in sync]
+        b = [np.asarray(d.features) for d in async_it]
+        assert len(a) == len(b)
+        for u, v in zip(a, b):
+            np.testing.assert_array_equal(u, v)
+
+    def test_reset_mid_epoch(self):
+        x = np.arange(40, dtype=np.float32).reshape(20, 2)
+        y = np.zeros((20, 1), np.float32)
+        it = AsyncDataSetIterator(
+            ArrayDataSetIterator(x, y, batch_size=5), queue_size=2)
+        it.next()
+        it.reset()
+        batches = list(it)
+        assert len(batches) == 4
+
+    def test_has_next_after_exhaustion_returns_false(self):
+        x = np.zeros((4, 2), np.float32)
+        it = AsyncDataSetIterator(
+            ArrayDataSetIterator(x, np.zeros((4, 1)), batch_size=2))
+        while it.hasNext():
+            it.next()
+        assert not it.hasNext()
+        assert not it.hasNext()  # must not block
+
+    def test_reset_after_exhaustion(self):
+        x = np.zeros((4, 2), np.float32)
+        it = AsyncDataSetIterator(
+            ArrayDataSetIterator(x, np.zeros((4, 1)), batch_size=2))
+        assert len(list(it)) == 2
+        it.reset()
+        assert len(list(it)) == 2
+
+    def test_error_propagates(self):
+        class Bad(ArrayDataSetIterator):
+            def next(self):
+                raise RuntimeError("boom")
+
+        it = AsyncDataSetIterator(
+            Bad(np.zeros((4, 2)), np.zeros((4, 1)), batch_size=2))
+        with pytest.raises(RuntimeError, match="boom"):
+            while it.hasNext():
+                it.next()
