@@ -16,9 +16,13 @@ the forward pass.
 """
 
 from deeplearning4j_tpu.autodiff import ops_math  # noqa: F401 (registers ops)
+from deeplearning4j_tpu.autodiff import control_flow  # noqa: F401 (registers ops)
 from deeplearning4j_tpu.autodiff.samediff import SameDiff, SDVariable, VariableType
 from deeplearning4j_tpu.autodiff.training import TrainingConfig, History
+from deeplearning4j_tpu.autodiff.validation import (GradCheckUtil,
+                                                    OpValidation, TestCase)
 
 __all__ = [
     "SameDiff", "SDVariable", "VariableType", "TrainingConfig", "History",
+    "GradCheckUtil", "OpValidation", "TestCase",
 ]

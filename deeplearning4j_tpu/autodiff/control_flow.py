@@ -1,0 +1,118 @@
+"""Control flow for SameDiff graphs: if/while as first-class graph ops.
+
+Reference: the reference executes If/While/Enter/Exit/Merge nodes with
+a dependency-tracked interpreter (org/nd4j/autodiff/samediff/internal/
+AbstractSession — SURVEY.md §3.4's control-flow handling). TPU-native,
+branches and loop bodies are *sub-graphs* stored in the op's attrs and
+lowered to ``lax.cond`` / ``lax.while_loop`` — XLA compiles the whole
+thing into one executable, so loops run on-device with no host
+round-trips (the interpreter's Enter/Exit frame machinery disappears).
+
+Sub-graphs serialize as plain dicts (variables/ops/outputs/arrays), so
+save/load round-trips control flow the way the reference's FlatBuffers
+scheme does.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from deeplearning4j_tpu.ops.registry import register_op
+
+ARG_PREFIX = "sg_in_"
+
+
+def subgraph_to_dict(sub, outputs: Sequence[str], n_in: int) -> Dict[str, Any]:
+    """Capture a traced sub-SameDiff as a dict. Arrays stay ndarrays
+    here (no tolist at build time); OpNode.to_dict JSON-ifies them only
+    when the graph is actually saved."""
+    return {
+        "n_in": n_in,
+        "outputs": list(outputs),
+        "variables": [
+            {"name": v.name, "type": v.vtype.value,
+             "shape": list(v.shape) if v.shape is not None else None,
+             "dtype": v.dtype}
+            for v in sub._vars.values()],
+        "ops": [n.to_dict() for n in sub._ops],
+        "arrays": {k: np.asarray(a) for k, a in sub._arrays.items()},
+    }
+
+
+def subgraph_fn(d: Dict[str, Any]) -> Callable[..., Tuple]:
+    """Rebuild a sub-graph dict into a pure fn(*args) -> tuple(outputs).
+
+    Called during whole-graph tracing, so its body is traced (and
+    compiled) inline with the parent graph.
+    """
+    from deeplearning4j_tpu.autodiff.samediff import (OpNode, SameDiff,
+                                                      SDVariable,
+                                                      VariableType)
+
+    sub = SameDiff()
+    for vd in d["variables"]:
+        v = SDVariable(
+            sub, vd["name"], VariableType(vd["type"]),
+            tuple(vd["shape"]) if vd["shape"] is not None else None,
+            vd["dtype"])
+        sub._vars[v.name] = v
+    for od in d["ops"]:
+        sub._ops.append(OpNode.from_dict(od))
+    for name, spec in d["arrays"].items():
+        if isinstance(spec, dict):  # JSON-loaded form
+            arr = np.asarray(spec["data"], dtype=np.dtype(spec["dtype"]))
+        else:  # in-memory ndarray form
+            arr = np.asarray(spec)
+        sub._arrays[name] = jnp.asarray(arr)
+
+    raw = sub._build_fn(tuple(d["outputs"]))
+    arrays = dict(sub._arrays)
+
+    def fn(*args):
+        feeds = {f"{ARG_PREFIX}{i}": a for i, a in enumerate(args)}
+        outs = raw(arrays, feeds)
+        return tuple(outs[o] for o in d["outputs"])
+
+    return fn
+
+
+@register_op("if_cond")
+def if_cond(pred, *operands, true_graph=None, false_graph=None):
+    """lax.cond over serialized branch sub-graphs. Both branches are
+    compiled; selection happens on-device (XLA semantics — matches the
+    jit-safety rule that data-dependent Python branching is impossible).
+    """
+    tf = subgraph_fn(true_graph)
+    ff = subgraph_fn(false_graph)
+    pred = jnp.reshape(jnp.asarray(pred), ()).astype(bool)
+    res = lax.cond(pred, lambda ops: tf(*ops), lambda ops: ff(*ops),
+                   tuple(operands))
+    return res[0] if len(res) == 1 else tuple(res)
+
+
+@register_op("while_loop")
+def while_loop(*init_vars, cond_graph=None, body_graph=None):
+    """lax.while_loop over serialized cond/body sub-graphs; loop state is
+    the tuple of loop vars (shapes/dtypes must be loop-invariant, the
+    price of on-device looping)."""
+    cf = subgraph_fn(cond_graph)
+    bf = subgraph_fn(body_graph)
+
+    def cond(vs):
+        return jnp.reshape(cf(*vs)[0], ()).astype(bool)
+
+    def body(vs):
+        out = bf(*vs)
+        if len(out) != len(vs):
+            raise ValueError(
+                f"while body returned {len(out)} vars, expected {len(vs)}")
+        return tuple(jnp.asarray(o).astype(v.dtype)
+                     for o, v in zip(out, vs))
+
+    out = lax.while_loop(cond, body, tuple(jnp.asarray(v)
+                                           for v in init_vars))
+    return out[0] if len(out) == 1 else tuple(out)

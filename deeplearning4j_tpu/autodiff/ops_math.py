@@ -165,6 +165,12 @@ def identity(x):
     return x
 
 
+@_reg("stop_gradient")
+def stop_gradient(x):
+    """reference: StopGradient op (TF-import surface)."""
+    return jax.lax.stop_gradient(x)
+
+
 @_reg("cast")
 def cast(x, dtype):
     return x.astype(jnp.dtype(dtype))

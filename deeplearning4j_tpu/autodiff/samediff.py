@@ -39,6 +39,30 @@ class VariableType(enum.Enum):
     PLACEHOLDER = "PLACEHOLDER"
 
 
+def _attrs_to_json(obj):
+    """Deep-convert op attrs to JSON-able form: ndarrays (e.g. control
+    flow sub-graph constants) become tagged dicts only at save time."""
+    if isinstance(obj, (np.ndarray, jax.Array)):
+        a = np.asarray(obj)
+        return {"__ndarray__": a.tolist(), "dtype": str(a.dtype)}
+    if isinstance(obj, dict):
+        return {k: _attrs_to_json(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_attrs_to_json(v) for v in obj]
+    return obj
+
+
+def _attrs_from_json(obj):
+    if isinstance(obj, dict):
+        if "__ndarray__" in obj:
+            return np.asarray(obj["__ndarray__"],
+                              dtype=np.dtype(obj["dtype"]))
+        return {k: _attrs_from_json(v) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [_attrs_from_json(v) for v in obj]
+    return obj
+
+
 class OpNode:
     """One graph node: a registry op + static attrs (reference:
     internal/SameDiffOp wrapping a DifferentialFunction)."""
@@ -54,12 +78,13 @@ class OpNode:
 
     def to_dict(self) -> dict:
         return {"op": self.op_name, "inputs": self.inputs,
-                "outputs": self.outputs, "attrs": self.attrs}
+                "outputs": self.outputs,
+                "attrs": _attrs_to_json(self.attrs)}
 
     @staticmethod
     def from_dict(d: dict) -> "OpNode":
         return OpNode(d["op"], list(d["inputs"]), list(d["outputs"]),
-                      dict(d["attrs"]))
+                      _attrs_from_json(dict(d["attrs"])))
 
 
 class SDVariable:
@@ -522,6 +547,68 @@ class SameDiff:
 
     def grad(self, var_name: str) -> Optional[jax.Array]:
         return self._last_grads.get(var_name)
+
+    # -------------------------------------------------------- control flow
+    def _trace_subgraph(self, build_fn: Callable,
+                        n_args: int) -> Tuple["SameDiff", List[str]]:
+        """Trace build_fn(sub, *placeholders) into a child graph."""
+        from deeplearning4j_tpu.autodiff.control_flow import ARG_PREFIX
+
+        sub = SameDiff()
+        phs = [sub.placeholder(f"{ARG_PREFIX}{i}") for i in range(n_args)]
+        outs = build_fn(sub, *phs)
+        if isinstance(outs, SDVariable):
+            outs = [outs]
+        return sub, [o.name for o in outs]
+
+    def ifCond(self, pred: "SDVariable", inputs: Sequence["SDVariable"],
+               true_fn: Callable, false_fn: Callable,
+               name: Optional[str] = None):
+        """Conditional over two sub-graphs (reference: SameDiff#ifCond).
+
+        ``true_fn``/``false_fn`` are ``lambda sub, *args: out(s)`` graph
+        builders over a child SameDiff; both lower into the parent trace
+        via lax.cond (both branches compiled, on-device select).
+        """
+        from deeplearning4j_tpu.autodiff.control_flow import subgraph_to_dict
+
+        inputs = list(inputs)
+        sub_t, t_outs = self._trace_subgraph(true_fn, len(inputs))
+        sub_f, f_outs = self._trace_subgraph(false_fn, len(inputs))
+        if len(t_outs) != len(f_outs):
+            raise ValueError(
+                f"branch arity mismatch: {len(t_outs)} vs {len(f_outs)}")
+        return self._op(
+            "if_cond", [pred.name] + [v.name for v in inputs],
+            n_out=len(t_outs), name=name or "ifCond",
+            true_graph=subgraph_to_dict(sub_t, t_outs, len(inputs)),
+            false_graph=subgraph_to_dict(sub_f, f_outs, len(inputs)))
+
+    def whileLoop(self, loop_vars: Sequence["SDVariable"],
+                  cond_fn: Callable, body_fn: Callable,
+                  name: Optional[str] = None):
+        """While loop over sub-graphs (reference: SameDiff#whileLoop).
+
+        cond_fn returns a scalar-bool variable; body_fn returns new loop
+        vars (loop-invariant shapes/dtypes). Lowered to lax.while_loop —
+        the whole loop runs on-device inside the one compiled step.
+        """
+        from deeplearning4j_tpu.autodiff.control_flow import subgraph_to_dict
+
+        loop_vars = list(loop_vars)
+        sub_c, c_outs = self._trace_subgraph(cond_fn, len(loop_vars))
+        if len(c_outs) != 1:
+            raise ValueError("while condition must produce one scalar")
+        sub_b, b_outs = self._trace_subgraph(body_fn, len(loop_vars))
+        if len(b_outs) != len(loop_vars):
+            raise ValueError(
+                f"while body returns {len(b_outs)} vars for "
+                f"{len(loop_vars)} loop vars")
+        return self._op(
+            "while_loop", [v.name for v in loop_vars],
+            n_out=len(loop_vars), name=name or "whileLoop",
+            cond_graph=subgraph_to_dict(sub_c, c_outs, len(loop_vars)),
+            body_graph=subgraph_to_dict(sub_b, b_outs, len(loop_vars)))
 
     # ------------------------------------------------------------ training
     def setTrainingConfig(self, cfg) -> None:

@@ -61,6 +61,8 @@ class RecordReaderDataSetIterator(DataSetIterator):
         return self._bs
 
     def next(self) -> DataSet:
+        if not self.reader.hasNext():
+            raise StopIteration("iterator exhausted — call reset()")
         recs = []
         while self.reader.hasNext() and len(recs) < self._bs:
             recs.append(self.reader.next())
@@ -115,13 +117,18 @@ class SequenceRecordReaderDataSetIterator(DataSetIterator):
         return self._bs
 
     def next(self) -> DataSet:
+        if not self.reader.hasNext():
+            raise StopIteration("iterator exhausted — call reset()")
         seqs = []
         while self.reader.hasNext() and len(seqs) < self._bs:
             seqs.append(self.reader.next())
         lengths = [len(s) for s in seqs]
         t_max = max(lengths)
         n_in = len(seqs[0][0]) - 1
-        n_out = self.num_classes if self.num_classes else 1
+        # regression always means one target channel, even if a caller
+        # passes num_classes out of reference-API habit
+        n_out = self.num_classes \
+            if (self.num_classes and not self.regression) else 1
         x = np.zeros((len(seqs), t_max, n_in), np.float32)
         y = np.zeros((len(seqs), t_max, n_out), np.float32)
         mask = np.zeros((len(seqs), t_max), np.float32)
@@ -153,34 +160,57 @@ class AsyncDataSetIterator(DataSetIterator):
         self._error: Optional[BaseException] = None
         self._peek = None
         self._exhausted = False  # sentinel consumed; epoch over
+        self._stop = threading.Event()
         self._start()
 
     def _start(self):
         self._error = None
         self._exhausted = False
+        self._stop = threading.Event()
         self._q = queue.Queue(maxsize=self.queue_size)
+        stop, q = self._stop, self._q
 
         def worker():
             try:
                 self.underlying.reset()
-                while self.underlying.hasNext():
-                    self._q.put(self.underlying.next())
+                while not stop.is_set() and self.underlying.hasNext():
+                    item = self.underlying.next()
+                    # put with a poll so a stop request can't wedge a
+                    # producer blocked on a full queue
+                    while not stop.is_set():
+                        try:
+                            q.put(item, timeout=0.1)
+                            break
+                        except queue.Full:
+                            continue
             except BaseException as e:  # propagate to consumer
                 self._error = e
             finally:
-                self._q.put(self._SENTINEL)
+                while True:
+                    try:
+                        q.put(self._SENTINEL, timeout=0.1)
+                        break
+                    except queue.Full:
+                        # consumer gone (reset drained); drop one stale
+                        # item to make room for the sentinel
+                        try:
+                            q.get_nowait()
+                        except queue.Empty:
+                            pass
 
         self._thread = threading.Thread(target=worker, daemon=True)
         self._thread.start()
 
     def reset(self):
-        # Drain until the worker's sentinel (unless already consumed),
-        # then restart. Gate on _exhausted, not thread liveness: the
-        # worker may still be between put(SENTINEL) and exit.
-        if self._thread is not None and not self._exhausted:
-            while self._q.get() is not self._SENTINEL:
-                pass
+        # Signal the worker to stop producing (don't decode a whole
+        # discarded epoch), drain until its sentinel, restart. Gate the
+        # drain on _exhausted, not thread liveness: the worker may still
+        # be between put(SENTINEL) and exit.
         if self._thread is not None:
+            self._stop.set()
+            if not self._exhausted:
+                while self._q.get() is not self._SENTINEL:
+                    pass
             self._thread.join()
         self._peek = None
         self._start()

@@ -143,6 +143,7 @@ class ImageRecordReader(RecordReader):
                  label_generator: Optional[PathLabelGenerator] = None,
                  transform: Optional[ImageTransform] = None,
                  seed: int = 0):
+        super().__init__()
         self.loader = NativeImageLoader(height, width, channels)
         self.label_gen = label_generator
         self.transform = transform

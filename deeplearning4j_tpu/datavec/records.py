@@ -77,6 +77,10 @@ class RecordReader:
     """Base reader: initialize(split) then iterate records (lists of
     values). Mirrors the reference interface incl. reset()."""
 
+    def __init__(self):
+        self._records: List[List] = []
+        self._i = 0
+
     def initialize(self, split: Union[InputSplit, str]) -> "RecordReader":
         raise NotImplementedError
 
@@ -101,10 +105,6 @@ class RecordReader:
         self.reset()
         while self.hasNext():
             yield self.next()
-
-    # shared state
-    _records: List[List] = []
-    _i: int = 0
 
 
 def _parse_value(s: str):

@@ -118,6 +118,8 @@ class _Step:
                     f"removeAllColumnsExceptFor: unknown {sorted(missing)}")
             return Schema([c for c in cols if c.name in keep])
         if k == "renameColumn":
+            if not s.hasColumn(p["old"]):
+                raise KeyError(f"renameColumn: unknown column {p['old']!r}")
             out = []
             for c in cols:
                 if c.name == p["old"]:
@@ -135,24 +137,24 @@ class _Step:
                 out.append(c)
             return Schema(out)
         if k == "categoricalToOneHot":
+            if not s.hasColumn(p["column"]):
+                raise KeyError(
+                    f"categoricalToOneHot: unknown column {p['column']!r}")
             out = []
             for c in cols:
                 if c.name == p["column"]:
+                    if c.type != ColumnType.CATEGORICAL:
+                        raise TypeError(f"{c.name} is {c.type}, "
+                                        "not CATEGORICAL")
                     for cat in c.categories:
                         out.append(_ColumnMeta(f"{c.name}[{cat}]",
                                                ColumnType.INTEGER))
                 else:
                     out.append(c)
             return Schema(out)
-        if k == "integerToCategorical":
-            out = []
-            for c in cols:
-                if c.name == p["column"]:
-                    c = _ColumnMeta(c.name, ColumnType.CATEGORICAL,
-                                    p["categories"])
-                out.append(c)
-            return Schema(out)
-        if k == "stringToCategorical":
+        if k in ("integerToCategorical", "stringToCategorical"):
+            if not s.hasColumn(p["column"]):
+                raise KeyError(f"{k}: unknown column {p['column']!r}")
             out = []
             for c in cols:
                 if c.name == p["column"]:
@@ -209,13 +211,15 @@ class _Step:
         if k == "doubleMathOp":
             name, op, v = p["column"], p["op"], p["value"]
             col = table[name].astype(np.float64)
-            fns = {"Add": col + v, "Subtract": col - v, "Multiply": col * v,
-                   "Divide": col / v, "Modulus": col % v,
-                   "ScalarMax": np.maximum(col, v),
-                   "ScalarMin": np.minimum(col, v),
-                   "ReverseSubtract": v - col, "ReverseDivide": v / col}
+            fns = {"Add": lambda: col + v, "Subtract": lambda: col - v,
+                   "Multiply": lambda: col * v, "Divide": lambda: col / v,
+                   "Modulus": lambda: col % v,
+                   "ScalarMax": lambda: np.maximum(col, v),
+                   "ScalarMin": lambda: np.minimum(col, v),
+                   "ReverseSubtract": lambda: v - col,
+                   "ReverseDivide": lambda: v / col}
             out = dict(table)
-            out[name] = fns[op]
+            out[name] = fns[op]()
             return out
         if k == "doubleColumnsMathOp":
             op = p["op"]

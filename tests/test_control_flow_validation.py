@@ -1,0 +1,173 @@
+"""SameDiff control flow + gradient-check validation tests
+(reference model: AbstractSession If/While tests and
+OpValidation/GradCheckUtil suites — SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.autodiff import (GradCheckUtil, OpValidation,
+                                         SameDiff, TrainingConfig)
+from deeplearning4j_tpu.autodiff import TestCase as OpTestCase
+from deeplearning4j_tpu.learning.updaters import Sgd
+
+
+class TestIfCond:
+    def test_branch_selection(self):
+        sd = SameDiff()
+        x = sd.placeholder("x", shape=(None,))
+        pred = sd.placeholder("p", shape=())
+        out = sd.ifCond(pred, [x],
+                        lambda sub, a: a * 2.0,
+                        lambda sub, a: a + 100.0)
+        r_true = sd.output({"x": jnp.ones(3), "p": jnp.asarray(True)},
+                           [out.name])[out.name]
+        r_false = sd.output({"x": jnp.ones(3), "p": jnp.asarray(False)},
+                            [out.name])[out.name]
+        np.testing.assert_allclose(np.asarray(r_true), 2.0)
+        np.testing.assert_allclose(np.asarray(r_false), 101.0)
+
+    def test_multi_output_branches(self):
+        sd = SameDiff()
+        x = sd.placeholder("x", shape=(2,))
+        pred = sd.placeholder("p", shape=())
+        a, b = sd.ifCond(pred, [x],
+                         lambda sub, v: [v + 1.0, v * 3.0],
+                         lambda sub, v: [v - 1.0, v / 2.0])
+        outs = sd.output({"x": jnp.full((2,), 4.0), "p": jnp.asarray(False)},
+                         [a.name, b.name])
+        np.testing.assert_allclose(np.asarray(outs[a.name]), 3.0)
+        np.testing.assert_allclose(np.asarray(outs[b.name]), 2.0)
+
+    def test_branch_arity_mismatch(self):
+        sd = SameDiff()
+        x = sd.placeholder("x", shape=(2,))
+        pred = sd.placeholder("p", shape=())
+        with pytest.raises(ValueError, match="arity"):
+            sd.ifCond(pred, [x],
+                      lambda sub, v: [v, v],
+                      lambda sub, v: v)
+
+    def test_grad_through_cond(self):
+        sd = SameDiff()
+        w = sd.var("w", jnp.asarray([2.0, 3.0]))
+        pred = sd.placeholder("p", shape=())
+        out = sd.ifCond(pred, [w],
+                        lambda sub, v: (v * v).sum(),
+                        lambda sub, v: v.sum())
+        sd.setLossVariables(out.name)
+        g = sd.calculateGradients({"p": jnp.asarray(True)})
+        np.testing.assert_allclose(np.asarray(g["w"]), [4.0, 6.0])
+        g2 = sd.calculateGradients({"p": jnp.asarray(False)})
+        np.testing.assert_allclose(np.asarray(g2["w"]), [1.0, 1.0])
+
+
+class TestWhileLoop:
+    def test_countdown_sum(self):
+        # while i < 5: acc += i; i += 1  → acc = 0+1+2+3+4 = 10
+        sd = SameDiff()
+        i0 = sd.placeholder("i0", shape=())
+        acc0 = sd.placeholder("acc0", shape=())
+        i_f, acc_f = sd.whileLoop(
+            [i0, acc0],
+            cond_fn=lambda sub, i, acc: i < 5.0,
+            body_fn=lambda sub, i, acc: [i + 1.0, acc + i])
+        outs = sd.output({"i0": jnp.asarray(0.0), "acc0": jnp.asarray(0.0)},
+                         [i_f.name, acc_f.name])
+        assert float(outs[i_f.name]) == 5.0
+        assert float(outs[acc_f.name]) == 10.0
+
+    def test_vector_state(self):
+        sd = SameDiff()
+        x = sd.placeholder("x", shape=(3,))
+        n = sd.placeholder("n", shape=())
+        n_f, x_f = sd.whileLoop(
+            [n, x],
+            cond_fn=lambda sub, k, v: k > 0.0,
+            body_fn=lambda sub, k, v: [k - 1.0, v * 2.0])
+        outs = sd.output({"x": jnp.ones(3), "n": jnp.asarray(3.0)},
+                         [x_f.name])
+        np.testing.assert_allclose(np.asarray(outs[x_f.name]), 8.0)
+
+    def test_body_arity_checked(self):
+        sd = SameDiff()
+        x = sd.placeholder("x", shape=())
+        with pytest.raises(ValueError, match="body"):
+            sd.whileLoop([x],
+                         cond_fn=lambda sub, v: v > 0.0,
+                         body_fn=lambda sub, v: [v, v])
+
+    def test_serde_roundtrip_control_flow(self, tmp_path):
+        sd = SameDiff()
+        i0 = sd.placeholder("i0", shape=())
+        acc0 = sd.placeholder("acc0", shape=())
+        _, acc_f = sd.whileLoop(
+            [i0, acc0],
+            cond_fn=lambda sub, i, acc: i < 4.0,
+            body_fn=lambda sub, i, acc: [i + 1.0, acc + i * i])
+        acc_f.rename("result")
+        p = str(tmp_path / "cf.sdz")
+        sd.save(p)
+        sd2 = SameDiff.load(p)
+        out = sd2.output({"i0": jnp.asarray(0.0), "acc0": jnp.asarray(0.0)},
+                         ["result"])["result"]
+        assert float(out) == 0 + 1 + 4 + 9
+
+
+class TestGradCheckUtil:
+    def test_passes_on_correct_graph(self):
+        sd = SameDiff()
+        x = sd.placeholder("x", shape=(4, 3))
+        w = sd.var("w", np.random.default_rng(0).normal(size=(3, 2)) * 0.5)
+        b = sd.var("b", np.zeros(2))
+        out = sd.nn.sigmoid(x @ w + b)
+        loss = (out * out).mean()
+        sd.setLossVariables(loss.name)
+        feeds = {"x": np.random.default_rng(1).normal(size=(4, 3))}
+        assert GradCheckUtil.checkGradients(sd, feeds, eps=1e-2,
+                                            max_rel_error=0.08)
+
+    def test_catches_wrong_gradient(self):
+        # stop_gradient makes the analytic grad 0 while numeric isn't
+        sd = SameDiff()
+        w = sd.var("w", jnp.asarray([1.0, 2.0]))
+        out = sd.math.stop_gradient(w * w).sum() \
+            if hasattr(sd.math, "stop_gradient") else None
+        if out is None:
+            pytest.skip("no stop_gradient op registered")
+        sd.setLossVariables(out.name)
+        assert not GradCheckUtil.checkGradients(
+            sd, {}, eps=1e-2, print_failures=False)
+
+
+class TestOpValidation:
+    def test_forward_and_grad(self):
+        rng = np.random.default_rng(0)
+        OpValidation.validate(OpTestCase(
+            "matmul",
+            args=[rng.normal(size=(3, 4)).astype(np.float32),
+                  rng.normal(size=(4, 2)).astype(np.float32)],
+            expected=lambda a, b: a @ b,
+            grad_eps=1e-2, grad_rtol=0.08))
+
+    def test_attrs_and_reduction(self):
+        rng = np.random.default_rng(0)
+        OpValidation.validate(OpTestCase(
+            "reduce_mean",
+            args=[rng.normal(size=(3, 4)).astype(np.float32)],
+            attrs={"dimensions": [1]},
+            expected=lambda a: a.mean(axis=1),
+            grad_eps=1e-2, grad_rtol=0.08))
+
+    def test_forward_mismatch_raises(self):
+        with pytest.raises(AssertionError):
+            OpValidation.validate(OpTestCase(
+                "add", args=[np.ones(2, np.float32), np.ones(2, np.float32)],
+                expected=lambda a, b: a * 5,
+                grad_check=False))
+
+    def test_coverage_report(self):
+        rep = OpValidation.coverage_report()
+        assert rep["total"] > 50
+        assert "matmul" in rep["validated"]

@@ -233,6 +233,33 @@ class TestRecordReaderDataSetIterator:
         assert ds.features.shape == (2, 2) and ds.labels.shape == (2, 1)
         assert float(np.asarray(ds.labels)[1, 0]) == 20.0
 
+    def test_regression_with_num_classes_gives_one_channel(self, tmp_path):
+        (tmp_path / "r_0.csv").write_text("1,2,0.5\n3,4,0.7\n")
+        rr = CSVSequenceRecordReader().initialize(
+            NumberedFileInputSplit(str(tmp_path / "r_%d.csv"), 0, 0))
+        it = SequenceRecordReaderDataSetIterator(
+            rr, batch_size=1, label_index=2, num_classes=3, regression=True)
+        ds = it.next()
+        assert ds.labels.shape == (1, 2, 1)
+        assert float(np.asarray(ds.labels)[0, 1, 0]) == pytest.approx(0.7)
+
+    def test_next_after_exhaustion_raises_stopiteration(self):
+        it = RecordReaderDataSetIterator(
+            CollectionRecordReader([[1.0, 2.0]]), batch_size=1,
+            label_index=1, regression=True)
+        it.next()
+        with pytest.raises(StopIteration):
+            it.next()
+
+    def test_unknown_column_raises_in_builders(self):
+        for build in [
+            lambda b: b.renameColumn("nope", "x"),
+            lambda b: b.categoricalToOneHot("nope"),
+            lambda b: b.integerToCategorical("nope", ["a"]),
+        ]:
+            with pytest.raises(KeyError):
+                build(TransformProcess.Builder(iris_schema())).build()
+
     def test_sequence_iterator_masks(self, tmp_path):
         (tmp_path / "s_0.csv").write_text("1,2,0\n3,4,1\n")
         (tmp_path / "s_1.csv").write_text("5,6,1\n")
