@@ -1,0 +1,16 @@
+"""Keras HDF5 model import.
+
+Reference: org/deeplearning4j/nn/modelimport/keras/{KerasModelImport,
+KerasModel,KerasSequentialModel,KerasLayer}.java + ~60 per-layer
+mappers (SURVEY.md §2.32). The reference reads HDF5 via JavaCPP; here
+h5py reads the same format, and the canonical NHWC layout means Keras
+weight tensors (HWIO convs, (in,out) dense kernels, IFCO LSTM gates)
+map to our parameter layouts with NO transposition — the reference
+needs NCHW permutes, we don't.
+"""
+
+from deeplearning4j_tpu.modelimport.keras.keras_import import (
+    KerasModelImport,
+)
+
+__all__ = ["KerasModelImport"]

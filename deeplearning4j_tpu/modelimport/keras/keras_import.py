@@ -1,0 +1,461 @@
+"""Keras HDF5 → MultiLayerNetwork / ComputationGraph.
+
+Reference: KerasModelImport.importKerasSequentialModelAndWeights /
+importKerasModelAndWeights (deeplearning4j-modelimport). Supports the
+Keras-3 HDF5 layout (``model_config`` JSON attr + ``model_weights``
+group with per-layer ``weight_names``), which is what tf.keras ≥2.16
+writes for ``model.save("*.h5")``.
+
+Layer coverage mirrors the reference's most-used mappers: Dense,
+Conv2D, SeparableConv2D, MaxPooling2D/AveragePooling2D, GlobalMax/
+AveragePooling2D, Flatten, Dropout, BatchNormalization, Activation,
+ReLU/Softmax/LeakyReLU, ZeroPadding2D, UpSampling2D, Embedding, LSTM,
+SimpleRNN, Add/Subtract/Multiply/Average/Maximum/Concatenate
+(functional graphs).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nn.conf.builder import (MultiLayerConfiguration,
+                                                NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import (ActivationLayer,
+                                               BatchNormalization,
+                                               ConvolutionLayer, DenseLayer,
+                                               DropoutLayer, EmbeddingLayer,
+                                               EmbeddingSequenceLayer,
+                                               FlattenLayer,
+                                               GlobalPoolingLayer,
+                                               LastTimeStep, LSTM,
+                                               OutputLayer,
+                                               SeparableConvolution2D,
+                                               SimpleRnn, SubsamplingLayer,
+                                               Upsampling2D, ZeroPaddingLayer)
+from deeplearning4j_tpu.nn.graph.config import ComputationGraphConfiguration
+from deeplearning4j_tpu.nn.graph.graph import ComputationGraph
+from deeplearning4j_tpu.nn.graph.vertices import (ElementWiseVertex,
+                                                  LayerVertex, MergeVertex,
+                                                  PreprocessorVertex)
+from deeplearning4j_tpu.nn.multilayer.network import MultiLayerNetwork
+
+
+class InvalidKerasConfigurationException(ValueError):
+    """reference: exceptions.InvalidKerasConfigurationException."""
+
+
+class UnsupportedKerasConfigurationException(ValueError):
+    """reference: exceptions.UnsupportedKerasConfigurationException."""
+
+
+_ACT_MAP = {
+    "linear": "identity", "relu": "relu", "sigmoid": "sigmoid",
+    "tanh": "tanh", "softmax": "softmax", "elu": "elu", "selu": "selu",
+    "softplus": "softplus", "softsign": "softsign", "swish": "swish",
+    "silu": "swish", "gelu": "gelu", "hard_sigmoid": "hardsigmoid",
+    "relu6": "relu6", "mish": "mish",
+}
+
+
+def _map_activation(name: Optional[str]) -> str:
+    if name is None:
+        return "identity"
+    if isinstance(name, dict):  # serialized Activation object
+        name = name.get("config", {}).get("name", "linear")
+    try:
+        return _ACT_MAP[name]
+    except KeyError:
+        raise UnsupportedKerasConfigurationException(
+            f"unsupported Keras activation {name!r}") from None
+
+
+def _pair(v) -> Tuple[int, int]:
+    if isinstance(v, int):
+        return (v, v)
+    return (int(v[0]), int(v[1]))
+
+
+def _conv_mode(padding: str) -> str:
+    return "Same" if padding == "same" else "Truncate"
+
+
+def _input_type_from_shape(shape) -> InputType:
+    dims = [d for d in shape[1:]]
+    if len(dims) == 1:
+        return InputType.feedForward(int(dims[0]))
+    if len(dims) == 2:
+        t = int(dims[0]) if dims[0] is not None else -1
+        return InputType.recurrent(int(dims[1]), t)
+    if len(dims) == 3:
+        return InputType.convolutional(int(dims[0]), int(dims[1]),
+                                       int(dims[2]))
+    raise UnsupportedKerasConfigurationException(
+        f"unsupported input shape {shape}")
+
+
+def _check_channels_last(cfg: dict, name: str) -> None:
+    df = cfg.get("data_format", "channels_last")
+    if df != "channels_last":
+        raise UnsupportedKerasConfigurationException(
+            f"layer {name!r}: data_format={df!r}; only channels_last "
+            "(NHWC — the TPU-native layout) is supported")
+
+
+def _map_layer(class_name: str, cfg: dict, is_last: bool):
+    """Keras layer config → (our Layer | 'flatten' | None).
+
+    None = structural no-op (InputLayer, Reshape handled elsewhere).
+    """
+    name = cfg.get("name", class_name)
+    if class_name == "InputLayer":
+        return None
+    if class_name == "Flatten":
+        return FlattenLayer(name=name)
+    if class_name == "Dense":
+        act = _map_activation(cfg.get("activation"))
+        if is_last:
+            loss = {"softmax": "mcxent", "sigmoid": "xent"}.get(act, "mse")
+            return OutputLayer(name=name, n_out=cfg["units"], activation=act,
+                               loss=loss, has_bias=cfg.get("use_bias", True))
+        return DenseLayer(name=name, n_out=cfg["units"], activation=act,
+                          has_bias=cfg.get("use_bias", True))
+    if class_name == "Conv2D":
+        _check_channels_last(cfg, name)
+        return ConvolutionLayer(
+            name=name, n_out=cfg["filters"],
+            kernel_size=_pair(cfg["kernel_size"]),
+            stride=_pair(cfg.get("strides", 1)),
+            convolution_mode=_conv_mode(cfg.get("padding", "valid")),
+            dilation=_pair(cfg.get("dilation_rate", 1)),
+            activation=_map_activation(cfg.get("activation")),
+            has_bias=cfg.get("use_bias", True))
+    if class_name == "SeparableConv2D":
+        _check_channels_last(cfg, name)
+        return SeparableConvolution2D(
+            name=name, n_out=cfg["filters"],
+            kernel_size=_pair(cfg["kernel_size"]),
+            stride=_pair(cfg.get("strides", 1)),
+            convolution_mode=_conv_mode(cfg.get("padding", "valid")),
+            depth_multiplier=cfg.get("depth_multiplier", 1),
+            activation=_map_activation(cfg.get("activation")),
+            has_bias=cfg.get("use_bias", True))
+    if class_name in ("MaxPooling2D", "AveragePooling2D"):
+        _check_channels_last(cfg, name)
+        return SubsamplingLayer(
+            name=name,
+            pooling_type="max" if class_name.startswith("Max") else "avg",
+            kernel_size=_pair(cfg.get("pool_size", 2)),
+            stride=_pair(cfg.get("strides") or cfg.get("pool_size", 2)),
+            convolution_mode=_conv_mode(cfg.get("padding", "valid")))
+    if class_name in ("GlobalMaxPooling2D", "GlobalAveragePooling2D",
+                      "GlobalMaxPooling1D", "GlobalAveragePooling1D"):
+        return GlobalPoolingLayer(
+            name=name,
+            pooling_type="max" if "Max" in class_name else "avg")
+    if class_name == "Dropout":
+        return DropoutLayer(name=name, rate=float(cfg.get("rate", 0.5)))
+    if class_name == "BatchNormalization":
+        return BatchNormalization(
+            name=name, eps=float(cfg.get("epsilon", 1e-3)),
+            decay=float(cfg.get("momentum", 0.99)))
+    if class_name == "Activation":
+        return ActivationLayer(
+            name=name, activation=_map_activation(cfg.get("activation")))
+    if class_name == "ReLU":
+        return ActivationLayer(name=name, activation="relu")
+    if class_name == "Softmax":
+        return ActivationLayer(name=name, activation="softmax")
+    if class_name == "LeakyReLU":
+        slope = cfg.get("negative_slope", cfg.get("alpha", 0.3))
+        return ActivationLayer(name=name, activation="leakyrelu",
+                               alpha=float(slope))
+    if class_name == "ZeroPadding2D":
+        pad = cfg.get("padding", 1)
+        if isinstance(pad, (list, tuple)) and isinstance(pad[0],
+                                                         (list, tuple)):
+            if pad[0][0] != pad[0][1] or pad[1][0] != pad[1][1]:
+                raise UnsupportedKerasConfigurationException(
+                    f"asymmetric ZeroPadding2D {pad} unsupported")
+            pad = (pad[0][0], pad[1][0])
+        return ZeroPaddingLayer(name=name, pad=_pair(pad))
+    if class_name == "UpSampling2D":
+        size = cfg.get("size", 2)
+        size = size[0] if isinstance(size, (list, tuple)) else size
+        return Upsampling2D(name=name, size=int(size))
+    if class_name == "Embedding":
+        return EmbeddingSequenceLayer(name=name, n_in=cfg["input_dim"],
+                                      n_out=cfg["output_dim"])
+    if class_name == "LSTM":
+        if _map_activation(cfg.get("activation", "tanh")) != "tanh" or \
+                _map_activation(cfg.get("recurrent_activation",
+                                        "sigmoid")) != "sigmoid":
+            raise UnsupportedKerasConfigurationException(
+                f"LSTM {name!r}: only tanh/sigmoid cell activations map "
+                "onto the fused cell")
+        lstm = LSTM(name=name, n_out=cfg["units"],
+                    forget_gate_bias_init=1.0
+                    if cfg.get("unit_forget_bias", True) else 0.0)
+        if not cfg.get("return_sequences", False):
+            return LastTimeStep(name=name, underlying=lstm)
+        return lstm
+    if class_name == "SimpleRNN":
+        rnn = SimpleRnn(name=name, n_out=cfg["units"])
+        if not cfg.get("return_sequences", False):
+            return LastTimeStep(name=name, underlying=rnn)
+        return rnn
+    raise UnsupportedKerasConfigurationException(
+        f"no mapper for Keras layer {class_name!r} "
+        "(reference parity: KerasLayer registry)")
+
+
+# --------------------------------------------------------------- weights
+def _read_layer_weights(mw, layer_name: str) -> Dict[str, np.ndarray]:
+    """{short_name: array} for one Keras layer from model_weights."""
+    if layer_name not in mw:
+        return {}
+    g = mw[layer_name]
+    out: Dict[str, np.ndarray] = {}
+    names = g.attrs.get("weight_names", [])
+    for n in names:
+        if isinstance(n, bytes):
+            n = n.decode()
+        short = n.split("/")[-1]
+        if short.endswith(":0"):
+            short = short[:-2]
+        out[short] = np.asarray(g[n])
+    return out
+
+
+def _assign_params(layer, params: dict, state: dict,
+                   kw: Dict[str, np.ndarray], lname: str) -> None:
+    """Copy Keras weights into our param/state dicts (shapes asserted)."""
+
+    def put(dst: dict, key: str, arr: np.ndarray):
+        if key not in dst:
+            raise InvalidKerasConfigurationException(
+                f"layer {lname!r}: no target param {key!r}")
+        if tuple(dst[key].shape) != tuple(arr.shape):
+            raise InvalidKerasConfigurationException(
+                f"layer {lname!r} param {key!r}: shape "
+                f"{arr.shape} vs expected {tuple(dst[key].shape)}")
+        dst[key] = jnp.asarray(arr, dtype=dst[key].dtype)
+
+    if isinstance(layer, LastTimeStep):
+        layer = layer.underlying
+    if isinstance(layer, SeparableConvolution2D):
+        if "depthwise_kernel" in kw:
+            put(params, "dW", kw["depthwise_kernel"])
+        if "pointwise_kernel" in kw:
+            put(params, "pW", kw["pointwise_kernel"])
+        if "bias" in kw:
+            put(params, "b", kw["bias"])
+        return
+    if isinstance(layer, BatchNormalization):
+        if "gamma" in kw:
+            put(params, "gamma", kw["gamma"])
+        if "beta" in kw:
+            put(params, "beta", kw["beta"])
+        if "moving_mean" in kw:
+            put(state, "mean", kw["moving_mean"])
+        if "moving_variance" in kw:
+            put(state, "var", kw["moving_variance"])
+        return
+    if isinstance(layer, (LSTM, SimpleRnn)):
+        # Keras LSTM kernel (in,4h) gate order i,f,c,o == our i,f,g,o
+        if "kernel" in kw:
+            put(params, "W", kw["kernel"])
+        if "recurrent_kernel" in kw:
+            put(params, "RW", kw["recurrent_kernel"])
+        if "bias" in kw:
+            put(params, "b", kw["bias"])
+        return
+    if isinstance(layer, EmbeddingLayer):
+        if "embeddings" in kw:
+            put(params, "W", kw["embeddings"])
+        return
+    # dense / conv (HWIO == our conv layout; (in,out) == our dense)
+    if "kernel" in kw:
+        put(params, "W", kw["kernel"])
+    if "bias" in kw:
+        put(params, "b", kw["bias"])
+
+
+class KerasModelImport:
+    """reference: KerasModelImport entry points."""
+
+    @staticmethod
+    def _open(path: str):
+        import h5py
+
+        f = h5py.File(path, "r")
+        if "model_config" not in f.attrs:
+            raise InvalidKerasConfigurationException(
+                f"{path}: no model_config attr (not a Keras HDF5 file)")
+        cfg = f.attrs["model_config"]
+        if isinstance(cfg, bytes):
+            cfg = cfg.decode()
+        return f, json.loads(cfg)
+
+    # ------------------------------------------------------- sequential
+    @staticmethod
+    def importKerasSequentialModelAndWeights(
+            path: str, enforce_training_config: bool = False
+    ) -> MultiLayerNetwork:
+        f, cfg = KerasModelImport._open(path)
+        try:
+            return KerasModelImport._import_sequential(f, cfg)
+        finally:
+            f.close()
+
+    @staticmethod
+    def _import_sequential(f, cfg) -> MultiLayerNetwork:
+        if cfg["class_name"] != "Sequential":
+            raise InvalidKerasConfigurationException(
+                f"model is {cfg['class_name']}, not Sequential — use "
+                "importKerasModelAndWeights")
+        klayers = cfg["config"]["layers"]
+        input_type = None
+        mapped: List[Tuple[Optional[str], Any]] = []  # (keras name, layer)
+        # find last weight-bearing/mappable layer index for is_last
+        last_idx = len(klayers) - 1
+        for i, kl in enumerate(klayers):
+            cname, lcfg = kl["class_name"], kl["config"]
+            if cname == "InputLayer":
+                input_type = _input_type_from_shape(lcfg["batch_shape"])
+                continue
+            m = _map_layer(cname, lcfg, is_last=(i == last_idx))
+            if m is None:
+                continue
+            mapped.append((lcfg.get("name"), m))
+        if input_type is None:
+            raise InvalidKerasConfigurationException(
+                "Sequential model without InputLayer/batch_shape")
+        if not mapped:
+            raise InvalidKerasConfigurationException("no layers mapped")
+
+        lb = NeuralNetConfiguration.builder().list()
+        for _, layer in mapped:
+            lb.layer(layer)
+        lb.setInputType(input_type)
+        net = MultiLayerNetwork(lb.build())
+        net.init()
+
+        mw = f["model_weights"] if "model_weights" in f else {}
+        for idx, (kname, layer) in enumerate(mapped):
+            kw = _read_layer_weights(mw, kname) if kname else {}
+            if kw:
+                _assign_params(layer, net.params_list[idx],
+                               net.states_list[idx], kw, kname)
+        return net
+
+    # ------------------------------------------------------- functional
+    @staticmethod
+    def importKerasModelAndWeights(
+            path: str, enforce_training_config: bool = False
+    ) -> ComputationGraph:
+        f, cfg = KerasModelImport._open(path)
+        try:
+            return KerasModelImport._import_functional(f, cfg)
+        finally:
+            f.close()
+
+    @staticmethod
+    def _import_functional(f, cfg) -> ComputationGraph:
+        if cfg["class_name"] == "Sequential":
+            raise InvalidKerasConfigurationException(
+                "Sequential model — use "
+                "importKerasSequentialModelAndWeights")
+        gc = cfg["config"]
+        klayers = gc["layers"]
+        out_spec = gc.get("output_layers")
+        # normalize [[name,0,0],...] vs [name,0,0]
+        if out_spec and not isinstance(out_spec[0], (list, tuple)):
+            out_spec = [out_spec]
+        output_names = [o[0] for o in out_spec]
+
+        builder = ComputationGraphConfiguration.graphBuilder()
+        input_types: List[InputType] = []
+        input_names: List[str] = []
+        mapped: Dict[str, Any] = {}
+
+        for kl in klayers:
+            cname, lcfg = kl["class_name"], kl["config"]
+            name = lcfg["name"]
+            srcs = _inbound_names(kl.get("inbound_nodes", []))
+            if cname == "InputLayer":
+                input_names.append(name)
+                input_types.append(
+                    _input_type_from_shape(lcfg["batch_shape"]))
+                continue
+            if cname == "Concatenate":
+                builder.addVertex(name, MergeVertex(), *srcs)
+                continue
+            if cname in ("Add", "Subtract", "Multiply", "Average",
+                         "Maximum"):
+                op = {"Add": "Add", "Subtract": "Subtract",
+                      "Multiply": "Product", "Average": "Average",
+                      "Maximum": "Max"}[cname]
+                builder.addVertex(name, ElementWiseVertex(op=op), *srcs)
+                continue
+            layer = _map_layer(cname, lcfg,
+                               is_last=(name in output_names))
+            if layer is None:
+                continue
+            mapped[name] = layer
+            builder.addLayer(name, layer, *srcs)
+
+        builder.addInputs(*input_names)
+        builder.setInputTypes(*input_types)
+        builder.setOutputs(*output_names)
+        graph = ComputationGraph(builder.build())
+        graph.init()
+
+        mw = f["model_weights"] if "model_weights" in f else {}
+        for name, layer in mapped.items():
+            kw = _read_layer_weights(mw, name)
+            if kw:
+                _assign_params(layer, graph.params_map[name],
+                               graph.states_map[name], kw, name)
+        return graph
+
+    # convenience dispatch (reference: importKerasModelAndWeights decides
+    # by config class)
+    @staticmethod
+    def importModel(path: str):
+        f, cfg = KerasModelImport._open(path)
+        try:
+            if cfg["class_name"] == "Sequential":
+                return KerasModelImport._import_sequential(f, cfg)
+            return KerasModelImport._import_functional(f, cfg)
+        finally:
+            f.close()
+
+
+def _inbound_names(inbound) -> List[str]:
+    """Parse Keras-3 (dict args / keras_history) and Keras-2 (nested
+    list) inbound_nodes into source layer names."""
+    names: List[str] = []
+
+    def from_tensor(t):
+        if isinstance(t, dict) and t.get("class_name") == "__keras_tensor__":
+            names.append(t["config"]["keras_history"][0])
+
+    for node in inbound:
+        if isinstance(node, dict):  # Keras 3
+            for arg in node.get("args", []):
+                if isinstance(arg, list):
+                    for t in arg:
+                        from_tensor(t)
+                else:
+                    from_tensor(arg)
+        elif isinstance(node, list):  # Keras 2: [[name, 0, 0, {}], ...]
+            for entry in node:
+                if isinstance(entry, list) and entry and \
+                        isinstance(entry[0], str):
+                    names.append(entry[0])
+    return names

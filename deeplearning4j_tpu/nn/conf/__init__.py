@@ -6,7 +6,7 @@ types/preprocessors, with guaranteed JSON round-trip. SURVEY.md §2.18).
 from deeplearning4j_tpu.nn.conf.inputs import InputType
 from deeplearning4j_tpu.nn.conf.layers import (
     ActivationLayer, BatchNormalization, ConvolutionLayer, DenseLayer,
-    DropoutLayer, EmbeddingLayer, GlobalPoolingLayer, GravesLSTM, LSTM,
+    DropoutLayer, EmbeddingLayer, EmbeddingSequenceLayer, GlobalPoolingLayer, GravesLSTM, LSTM,
     Layer, LossLayer, OutputLayer, PoolingType, RnnOutputLayer,
     SubsamplingLayer, SeparableConvolution2D, Upsampling2D, ZeroPaddingLayer,
     LayerNormalization, SelfAttentionLayer, LocalResponseNormalization,
@@ -19,6 +19,7 @@ __all__ = [
     "InputType", "Layer", "DenseLayer", "ConvolutionLayer",
     "SubsamplingLayer", "BatchNormalization", "OutputLayer", "LossLayer",
     "DropoutLayer", "ActivationLayer", "EmbeddingLayer",
+    "EmbeddingSequenceLayer",
     "GlobalPoolingLayer", "LSTM", "GravesLSTM", "RnnOutputLayer",
     "PoolingType", "SeparableConvolution2D", "Upsampling2D",
     "ZeroPaddingLayer", "LayerNormalization", "SelfAttentionLayer",

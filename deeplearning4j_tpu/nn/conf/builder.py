@@ -20,9 +20,9 @@ from deeplearning4j_tpu.common.serde import serializable
 from deeplearning4j_tpu.learning.updaters import IUpdater, Sgd
 from deeplearning4j_tpu.nn.conf.inputs import InputType
 from deeplearning4j_tpu.nn.conf.layers import (
-    ConvolutionLayer, DenseLayer, EmbeddingLayer, Layer, LSTM, SimpleRnn,
-    SubsamplingLayer, SelfAttentionLayer, Upsampling2D, ZeroPaddingLayer,
-    LocalResponseNormalization, GravesLSTM, RnnOutputLayer,
+    ConvolutionLayer, DenseLayer, EmbeddingLayer, Layer, LastTimeStep, LSTM,
+    SimpleRnn, SubsamplingLayer, SelfAttentionLayer, Upsampling2D,
+    ZeroPaddingLayer, LocalResponseNormalization, GravesLSTM, RnnOutputLayer,
 )
 
 
@@ -187,7 +187,8 @@ class ListBuilder:
                 elif it.kind != "convolutional":
                     raise ValueError(
                         f"Layer {i} ({type(layer).__name__}) needs image input, got {it.kind}")
-            elif isinstance(layer, (LSTM, SimpleRnn, SelfAttentionLayer, GravesLSTM)) \
+            elif isinstance(layer, (LSTM, SimpleRnn, SelfAttentionLayer,
+                                    GravesLSTM, LastTimeStep)) \
                     or isinstance(layer, RnnOutputLayer):
                 if it.kind not in ("recurrent",):
                     raise ValueError(
@@ -199,15 +200,16 @@ class ListBuilder:
                 elif it.kind == "convolutionalFlat":
                     it = InputType.feedForward(it.flat_size())
 
-            # nIn inference
-            if hasattr(layer, "n_in") and getattr(layer, "n_in", 0) in (0, None) \
-                    and not isinstance(layer, EmbeddingLayer):
+            # nIn inference (unwrap LastTimeStep to reach the recurrent
+            # layer that actually holds n_in)
+            target = layer.underlying if isinstance(layer, LastTimeStep) \
+                else layer
+            if hasattr(target, "n_in") and getattr(target, "n_in", 0) in (0, None) \
+                    and not isinstance(target, EmbeddingLayer):
                 if it.kind == "convolutional":
-                    layer.n_in = it.channels
-                elif it.kind == "recurrent":
-                    layer.n_in = it.size
+                    target.n_in = it.channels
                 else:
-                    layer.n_in = it.size
+                    target.n_in = it.size
             # attention n_out default
             if isinstance(layer, SelfAttentionLayer) and layer.n_out == 0:
                 layer.n_out = layer.n_in

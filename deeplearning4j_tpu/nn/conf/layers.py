@@ -163,11 +163,19 @@ class LossLayer(Layer):
 @serializable
 @dataclasses.dataclass
 class ActivationLayer(Layer):
+    #: parameter for parameterized activations (leakyrelu slope, elu α)
+    alpha: Optional[float] = None
+
     def has_params(self):
         return False
 
     def apply(self, params, state, x, train, rng):
-        return _act(self.activation or "identity").fn(x), state
+        a = _act(self.activation or "identity")
+        if self.alpha is not None and a in (Activation.LEAKYRELU,
+                                            Activation.ELU):
+            from deeplearning4j_tpu.ops.registry import get_op
+            return get_op(a.value)(x, self.alpha), state
+        return a.fn(x), state
 
 
 @serializable
@@ -212,6 +220,77 @@ class EmbeddingLayer(Layer):
             ids = ids[..., 0]
         out = jnp.take(params["W"], ids, axis=0)
         return _act(self.activation or "identity").fn(out), state
+
+
+@serializable
+@dataclasses.dataclass
+class EmbeddingSequenceLayer(EmbeddingLayer):
+    """Sequence lookup: [N,T] int ids -> [N,T,n_out] recurrent
+    (reference: conf/layers/EmbeddingSequenceLayer — the Keras
+    Embedding analog)."""
+
+    input_length: int = 0
+
+    def output_type(self, it: InputType) -> InputType:
+        t = it.timeseries_length if it.kind == "recurrent" else (
+            self.input_length or it.size or -1)
+        return InputType.recurrent(self.n_out, t)
+
+    def apply(self, params, state, x, train, rng):
+        ids = x.astype(jnp.int32)
+        if ids.ndim == 1:  # [N] -> length-1 sequence
+            ids = ids[:, None]
+        # NO trailing-dim collapse here: [N,1] means seq length 1 and
+        # must emit [N,1,n_out] (contrast EmbeddingLayer)
+        out = jnp.take(params["W"], ids, axis=0)
+        return _act(self.activation or "identity").fn(out), state
+
+
+@serializable
+@dataclasses.dataclass
+class FlattenLayer(Layer):
+    """Reshape any input to [N, flat] (reference analog: the
+    CnnToFeedForward / RnnToFeedForward preprocessors as an explicit
+    layer; used by Keras-import Flatten)."""
+
+    def has_params(self):
+        return False
+
+    def output_type(self, it: InputType) -> InputType:
+        if it.kind == "recurrent" and it.timeseries_length in (-1, None):
+            raise ValueError(
+                "FlattenLayer needs a fixed timeseries length")
+        return InputType.feedForward(it.flat_size())
+
+    def apply(self, params, state, x, train, rng):
+        return x.reshape(x.shape[0], -1), state
+
+
+@serializable
+@dataclasses.dataclass
+class LastTimeStep(Layer):
+    """Wrap a recurrent layer, emit only its final time step
+    (reference: conf/layers/recurrent/LastTimeStep — the Keras
+    return_sequences=False analog)."""
+
+    underlying: Optional[Layer] = None
+
+    def has_params(self):
+        return self.underlying.has_params()
+
+    def output_type(self, it: InputType) -> InputType:
+        ot = self.underlying.output_type(it)
+        return InputType.feedForward(ot.size)
+
+    def init_params(self, key, it, dtype) -> dict:
+        return self.underlying.init_params(key, it, dtype)
+
+    def init_state(self, it, dtype) -> dict:
+        return self.underlying.init_state(it, dtype)
+
+    def apply(self, params, state, x, train, rng):
+        out, st = self.underlying.apply(params, state, x, train, rng)
+        return out[:, -1, :], st
 
 
 # ----------------------------------------------------------------------

@@ -1,0 +1,190 @@
+"""Keras HDF5 import end-to-end tests (reference model:
+KerasModelEndToEndTest — import real saved models and compare layer
+outputs to the originals' predictions; SURVEY.md §4 golden tests)."""
+
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+keras = tf.keras
+
+from deeplearning4j_tpu.modelimport.keras import KerasModelImport
+from deeplearning4j_tpu.modelimport.keras.keras_import import (
+    UnsupportedKerasConfigurationException,
+)
+
+
+def _compare(keras_model, net, x, rtol=2e-4, atol=2e-5, graph=False):
+    ref = np.asarray(keras_model.predict(x, verbose=0))
+    if graph:
+        got = np.asarray(net.outputSingle(x))
+    else:
+        got = np.asarray(net.output(x))
+    np.testing.assert_allclose(got, ref, rtol=rtol, atol=atol)
+
+
+class TestSequentialImport:
+    def test_dense_softmax(self, tmp_path):
+        m = keras.Sequential([
+            keras.layers.Input((8,)),
+            keras.layers.Dense(16, activation="relu", name="d1"),
+            keras.layers.Dense(3, activation="softmax", name="sm"),
+        ])
+        p = str(tmp_path / "m.h5")
+        m.save(p)
+        net = KerasModelImport.importKerasSequentialModelAndWeights(p)
+        x = np.random.default_rng(0).normal(size=(5, 8)).astype(np.float32)
+        _compare(m, net, x)
+
+    def test_conv_bn_pool_flatten(self, tmp_path):
+        m = keras.Sequential([
+            keras.layers.Input((12, 12, 3)),
+            keras.layers.Conv2D(6, 3, strides=1, padding="same",
+                                activation="relu", name="c1"),
+            keras.layers.BatchNormalization(name="bn1"),
+            keras.layers.MaxPooling2D(2, name="p1"),
+            keras.layers.Conv2D(4, 3, padding="valid", name="c2"),
+            keras.layers.Flatten(name="fl"),
+            keras.layers.Dense(5, activation="softmax", name="out"),
+        ])
+        # non-trivial BN stats: run a training step
+        m.compile(optimizer="sgd", loss="categorical_crossentropy")
+        rng = np.random.default_rng(1)
+        xb = rng.normal(size=(16, 12, 12, 3)).astype(np.float32)
+        yb = np.eye(5, dtype=np.float32)[rng.integers(0, 5, 16)]
+        m.fit(xb, yb, epochs=1, verbose=0)
+        p = str(tmp_path / "conv.h5")
+        m.save(p)
+        net = KerasModelImport.importKerasSequentialModelAndWeights(p)
+        x = rng.normal(size=(4, 12, 12, 3)).astype(np.float32)
+        _compare(m, net, x, rtol=1e-3, atol=1e-4)
+
+    def test_embedding_lstm(self, tmp_path):
+        m = keras.Sequential([
+            keras.layers.Input((7,)),
+            keras.layers.Embedding(20, 8, name="emb"),
+            keras.layers.LSTM(6, return_sequences=True, name="lstm"),
+            keras.layers.Dense(4, activation="softmax", name="out"),
+        ])
+        p = str(tmp_path / "rnn.h5")
+        m.save(p)
+        net = KerasModelImport.importKerasSequentialModelAndWeights(p)
+        x = np.random.default_rng(2).integers(0, 20, (3, 7)).astype(np.int32)
+        _compare(m, net, x, rtol=1e-3, atol=1e-4)
+
+    def test_separable_conv_and_misc(self, tmp_path):
+        m = keras.Sequential([
+            keras.layers.Input((10, 10, 2)),
+            keras.layers.ZeroPadding2D(1, name="zp"),
+            keras.layers.SeparableConv2D(4, 3, padding="valid", name="sc"),
+            keras.layers.ReLU(name="r"),
+            keras.layers.UpSampling2D(2, name="up"),
+            keras.layers.GlobalAveragePooling2D(name="gap"),
+            keras.layers.Dense(3, name="fin"),
+        ])
+        p = str(tmp_path / "sep.h5")
+        m.save(p)
+        net = KerasModelImport.importKerasSequentialModelAndWeights(p)
+        x = np.random.default_rng(3).normal(size=(2, 10, 10, 2)) \
+            .astype(np.float32)
+        _compare(m, net, x, rtol=1e-3, atol=1e-4)
+
+    def test_lstm_return_sequences_false(self, tmp_path):
+        m = keras.Sequential([
+            keras.layers.Input((5,)),
+            keras.layers.Embedding(10, 4, name="e"),
+            keras.layers.LSTM(6, name="l"),   # return_sequences=False
+            keras.layers.Dense(3, activation="softmax", name="o"),
+        ])
+        p = str(tmp_path / "rs.h5")
+        m.save(p)
+        net = KerasModelImport.importKerasSequentialModelAndWeights(p)
+        x = np.random.default_rng(7).integers(0, 10, (4, 5)).astype(np.int32)
+        _compare(m, net, x, rtol=1e-3, atol=1e-4)
+
+    def test_flatten_after_embedding(self, tmp_path):
+        m = keras.Sequential([
+            keras.layers.Input((6,)),
+            keras.layers.Embedding(12, 3, name="e"),
+            keras.layers.Flatten(name="f"),
+            keras.layers.Dense(2, name="d"),
+        ])
+        p = str(tmp_path / "fe.h5")
+        m.save(p)
+        net = KerasModelImport.importKerasSequentialModelAndWeights(p)
+        x = np.random.default_rng(8).integers(0, 12, (3, 6)).astype(np.int32)
+        _compare(m, net, x, rtol=1e-3, atol=1e-4)
+
+    def test_leaky_relu_slope(self, tmp_path):
+        m = keras.Sequential([
+            keras.layers.Input((4,)),
+            keras.layers.Dense(4, name="d"),
+            keras.layers.LeakyReLU(negative_slope=0.3, name="lr"),
+            keras.layers.Dense(2, name="o"),
+        ])
+        p = str(tmp_path / "lr.h5")
+        m.save(p)
+        net = KerasModelImport.importKerasSequentialModelAndWeights(p)
+        x = np.random.default_rng(9).normal(size=(5, 4)).astype(np.float32)
+        _compare(m, net, x, rtol=1e-3, atol=1e-4)
+
+    def test_nontanh_lstm_rejected(self, tmp_path):
+        m = keras.Sequential([
+            keras.layers.Input((5, 3)),
+            keras.layers.LSTM(4, activation="relu", return_sequences=True),
+            keras.layers.Dense(2),
+        ])
+        p = str(tmp_path / "badlstm.h5")
+        m.save(p)
+        with pytest.raises(UnsupportedKerasConfigurationException):
+            KerasModelImport.importKerasSequentialModelAndWeights(p)
+
+    def test_unsupported_layer_raises(self, tmp_path):
+        m = keras.Sequential([
+            keras.layers.Input((4, 4, 1)),
+            keras.layers.Conv2DTranspose(2, 3, name="ct"),
+            keras.layers.Flatten(),
+            keras.layers.Dense(2),
+        ])
+        p = str(tmp_path / "bad.h5")
+        m.save(p)
+        with pytest.raises(UnsupportedKerasConfigurationException):
+            KerasModelImport.importKerasSequentialModelAndWeights(p)
+
+
+class TestFunctionalImport:
+    def test_residual_add(self, tmp_path):
+        inp = keras.Input((8,), name="in0")
+        h1 = keras.layers.Dense(8, activation="relu", name="g1")(inp)
+        h2 = keras.layers.Dense(8, name="g2")(h1)
+        s = keras.layers.Add(name="res")([h1, h2])
+        out = keras.layers.Dense(3, activation="softmax", name="head")(s)
+        m = keras.Model(inp, out)
+        p = str(tmp_path / "fun.h5")
+        m.save(p)
+        graph = KerasModelImport.importKerasModelAndWeights(p)
+        x = np.random.default_rng(4).normal(size=(6, 8)).astype(np.float32)
+        _compare(m, graph, x, graph=True)
+
+    def test_concat_branches(self, tmp_path):
+        inp = keras.Input((6,), name="in0")
+        a = keras.layers.Dense(4, activation="tanh", name="ba")(inp)
+        b = keras.layers.Dense(5, activation="relu", name="bb")(inp)
+        c = keras.layers.Concatenate(name="cat")([a, b])
+        out = keras.layers.Dense(2, activation="softmax", name="head")(c)
+        m = keras.Model(inp, out)
+        p = str(tmp_path / "cat.h5")
+        m.save(p)
+        graph = KerasModelImport.importKerasModelAndWeights(p)
+        x = np.random.default_rng(5).normal(size=(3, 6)).astype(np.float32)
+        _compare(m, graph, x, graph=True)
+
+    def test_dispatch(self, tmp_path):
+        inp = keras.Input((4,), name="i")
+        out = keras.layers.Dense(2, name="d")(inp)
+        m = keras.Model(inp, out)
+        p = str(tmp_path / "disp.h5")
+        m.save(p)
+        net = KerasModelImport.importModel(p)
+        from deeplearning4j_tpu.nn.graph.graph import ComputationGraph
+        assert isinstance(net, ComputationGraph)
