@@ -416,6 +416,29 @@ class SameDiff:
             raise ValueError(f"{name} is {v.vtype}; cannot hold an array")
         self._arrays[name] = jnp.asarray(_unwrap(arr))
 
+    def convertConstantsToVariables(self, *names) -> None:
+        """Promote CONSTANTs to trainable VARIABLEs (reference:
+        SameDiff#convertConstantsToVariables — the fine-tune-a-frozen-
+        import path).
+
+        Resets updater state: the trainable set changed, so optimizer
+        slots are re-initialized on the next fit().
+        """
+        resolved = []
+        for n in names:  # validate ALL before mutating ANY (atomicity)
+            n = n.name if isinstance(n, SDVariable) else n
+            if n not in self._vars:
+                raise KeyError(f"no variable named {n!r}")
+            v = self._vars[n]
+            if v.vtype is not VariableType.CONSTANT:
+                raise ValueError(f"{n} is {v.vtype.value}, not CONSTANT")
+            resolved.append(v)
+        for v in resolved:
+            v.vtype = VariableType.VARIABLE
+        self._trainable_order = None
+        self._fn_cache.clear()
+        self._updater_state = None  # slot shapes no longer match
+
     def setLossVariables(self, *names) -> None:
         """Reference: SameDiff#setLossVariables."""
         self._loss_variables = [

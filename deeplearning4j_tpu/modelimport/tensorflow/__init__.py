@@ -1,0 +1,9 @@
+"""TensorFlow frozen-graph import (reference: nd4j/samediff-import —
+ImportGraph + OpMappingRegistry + per-op mapping rules, and the legacy
+org/nd4j/imports/graphmapper/tf/TFGraphMapper. SURVEY.md §2.14)."""
+
+from deeplearning4j_tpu.modelimport.tensorflow.tf_import import (
+    OpMappingRegistry, TFGraphMapper,
+)
+
+__all__ = ["TFGraphMapper", "OpMappingRegistry"]

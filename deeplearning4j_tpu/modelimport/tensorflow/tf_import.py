@@ -1,0 +1,544 @@
+"""Frozen TF GraphDef → SameDiff.
+
+Reference: samediff-import-tensorflow ImportGraph#importGraph walks a
+frozen protobuf node-by-node through OpMappingRegistry rules into
+SameDiff ops (SURVEY.md §3.4 BERT path). Same architecture here:
+a registry of per-TF-op mappers emits nodes into a SameDiff graph,
+whose execution then whole-graph-compiles under XLA — the imported
+graph runs as ONE executable, not an op-at-a-time interpreter.
+
+Protobuf parsing uses the tensorflow package (host-side only — nothing
+of TF touches the accelerator); static operands (axes, shapes, perms)
+are resolved from Const nodes at import time, mirroring the
+reference's constant-resolution during mapping.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.autodiff.samediff import SameDiff, SDVariable
+
+
+class TFImportError(ValueError):
+    pass
+
+
+_DTYPE_MAP = {
+    1: "float32", 2: "float64", 3: "int32", 4: "uint8", 6: "int8",
+    9: "int64", 10: "bool", 14: "bfloat16", 19: "float16",
+}
+
+
+def _dtype_name(enum_val: int) -> str:
+    return _DTYPE_MAP.get(int(enum_val), "float32")
+
+
+class _Ctx:
+    """Everything a mapper needs for one node."""
+
+    def __init__(self, sd: SameDiff, node, inputs: List[SDVariable],
+                 static: List[Optional[np.ndarray]], attrs: Dict[str, Any]):
+        self.sd = sd
+        self.node = node
+        self.inputs = inputs
+        self._static = static
+        self.attrs = attrs
+
+    def static_np(self, i: int) -> np.ndarray:
+        """Constant value of input i (axes/shapes/perms must be static —
+        XLA static-shape discipline; the reference resolves these from
+        Const nodes the same way)."""
+        v = self._static[i]
+        if v is None:
+            raise TFImportError(
+                f"node {self.node.name} ({self.node.op}): input {i} must "
+                "be a constant (dynamic shapes/axes not importable)")
+        return v
+
+    def attr(self, name: str, default=None):
+        return self.attrs.get(name, default)
+
+    def op(self, op_name: str, inputs: Sequence[SDVariable], n_out: int = 1,
+           **attrs):
+        return self.sd._op(op_name, [v.name for v in inputs], n_out=n_out,
+                           name=self.node.name, **attrs)
+
+
+class OpMappingRegistry:
+    """TF op type → mapper fn(ctx) -> SDVariable | tuple (reference:
+    OpMappingRegistry + per-op MappingRule sets)."""
+
+    _mappers: Dict[str, Callable[[_Ctx], Any]] = {}
+
+    @classmethod
+    def register(cls, *tf_ops: str):
+        def deco(fn):
+            for name in tf_ops:
+                cls._mappers[name] = fn
+            return fn
+        return deco
+
+    @classmethod
+    def get(cls, tf_op: str) -> Callable[[_Ctx], Any]:
+        try:
+            return cls._mappers[tf_op]
+        except KeyError:
+            raise TFImportError(
+                f"no mapper for TF op {tf_op!r} "
+                f"(have {len(cls._mappers)}: add one via "
+                "OpMappingRegistry.register)") from None
+
+    @classmethod
+    def has(cls, tf_op: str) -> bool:
+        return tf_op in cls._mappers
+
+    @classmethod
+    def coverage(cls) -> List[str]:
+        return sorted(cls._mappers)
+
+
+# ------------------------------------------------------------------ attrs
+def _decode_attrs(node) -> Dict[str, Any]:
+    out: Dict[str, Any] = {}
+    for k, v in node.attr.items():
+        field = v.WhichOneof("value")
+        if field == "b":
+            out[k] = bool(v.b)
+        elif field == "i":
+            out[k] = int(v.i)
+        elif field == "f":
+            out[k] = float(v.f)
+        elif field == "s":
+            out[k] = v.s.decode(errors="replace")
+        elif field == "type":
+            out[k] = _dtype_name(v.type)
+        elif field == "shape":
+            out[k] = [d.size for d in v.shape.dim]
+        elif field == "tensor":
+            out[k] = v.tensor  # decoded lazily by Const mapper
+        elif field == "list":
+            lst = v.list
+            if lst.i:
+                out[k] = [int(x) for x in lst.i]
+            elif lst.f:
+                out[k] = [float(x) for x in lst.f]
+            elif lst.s:
+                out[k] = [x.decode(errors="replace") for x in lst.s]
+            elif lst.b:
+                out[k] = [bool(x) for x in lst.b]
+            else:
+                out[k] = []
+    return out
+
+
+# ---------------------------------------------------------------- mappers
+def _register_standard_mappers():
+    R = OpMappingRegistry.register
+
+    # elementwise binary
+    for tf_op, our in [("Add", "add"), ("AddV2", "add"), ("Sub", "sub"),
+                       ("Mul", "mul"), ("RealDiv", "div"), ("Div", "div"),
+                       ("FloorDiv", "floordiv"), ("Mod", "mod"),
+                       ("Pow", "pow_pairwise"), ("Maximum", "maximum"),
+                       ("Minimum", "minimum"),
+                       ("SquaredDifference", "squared_difference"),
+                       ("Equal", "eq"), ("NotEqual", "neq"),
+                       ("Greater", "gt"), ("GreaterEqual", "gte"),
+                       ("Less", "lt"), ("LessEqual", "lte"),
+                       ("LogicalAnd", "logical_and"),
+                       ("LogicalOr", "logical_or")]:
+        R(tf_op)(lambda ctx, _o=our: ctx.op(_o, ctx.inputs[:2]))
+
+    # elementwise unary
+    for tf_op, our in [("Neg", "neg"), ("Exp", "exp"), ("Log", "log"),
+                       ("Log1p", "log1p"), ("Sqrt", "sqrt"),
+                       ("Rsqrt", "rsqrt"), ("Square", "square"),
+                       ("Abs", "abs"), ("Sign", "sign"), ("Floor", "floor"),
+                       ("Ceil", "ceil"), ("Round", "round"),
+                       ("Relu", "relu"), ("Relu6", "relu6"),
+                       ("Sigmoid", "sigmoid"), ("Tanh", "tanh"),
+                       ("Softplus", "softplus"), ("Softsign", "softsign"),
+                       ("Elu", "elu"), ("Selu", "selu"), ("Erf", "erf"),
+                       ("Sin", "sin"), ("Cos", "cos"), ("Tan", "tan"),
+                       ("Sinh", "sinh"), ("Cosh", "cosh"),
+                       ("Reciprocal", "reciprocal"),
+                       ("LogicalNot", "logical_not"),
+                       ("IsNan", "isnan"), ("IsInf", "isinf"),
+                       ("StopGradient", "stop_gradient"),
+                       ("Identity", "identity"), ("Snapshot", "identity")]:
+        R(tf_op)(lambda ctx, _o=our: ctx.op(_o, ctx.inputs[:1]))
+
+    @R("LeakyRelu")
+    def _leaky(ctx):
+        return ctx.op("leakyrelu", ctx.inputs[:1],
+                      alpha=float(ctx.attr("alpha", 0.2)))
+
+    @R("Softmax")
+    def _softmax(ctx):
+        return ctx.op("softmax", ctx.inputs[:1])
+
+    @R("LogSoftmax")
+    def _log_softmax(ctx):
+        return ctx.op("log_softmax", ctx.inputs[:1])
+
+    @R("MatMul")
+    def _matmul(ctx):
+        return ctx.op("matmul", ctx.inputs[:2],
+                      transpose_a=bool(ctx.attr("transpose_a", False)),
+                      transpose_b=bool(ctx.attr("transpose_b", False)))
+
+    @R("BatchMatMul", "BatchMatMulV2", "BatchMatMulV3")
+    def _batch_matmul(ctx):
+        ta = bool(ctx.attr("adj_x", False))
+        tb = bool(ctx.attr("adj_y", False))
+        return ctx.op("matmul", ctx.inputs[:2],
+                      transpose_a=ta, transpose_b=tb)
+
+    @R("BiasAdd")
+    def _bias_add(ctx):
+        if ctx.attr("data_format", "NHWC") == "NCHW":
+            raise TFImportError("BiasAdd NCHW not supported (NHWC only)")
+        return ctx.op("add", ctx.inputs[:2])
+
+    @R("AddN")
+    def _addn(ctx):
+        out = ctx.inputs[0]
+        for v in ctx.inputs[1:]:
+            out = ctx.sd._op("add", [out.name, v.name])
+        return out
+
+    # reductions: axes come from a const input
+    for tf_op, our in [("Mean", "reduce_mean"), ("Sum", "reduce_sum"),
+                       ("Max", "reduce_max"), ("Min", "reduce_min"),
+                       ("Prod", "reduce_prod"), ("All", "reduce_all"),
+                       ("Any", "reduce_any")]:
+        def _red(ctx, _o=our):
+            axes = ctx.static_np(1)
+            dims = [int(a) for a in np.atleast_1d(axes)]
+            return ctx.op(_o, ctx.inputs[:1], dimensions=dims,
+                          keep_dims=bool(ctx.attr("keep_dims", False)))
+        R(tf_op)(_red)
+
+    @R("ArgMax")
+    def _argmax(ctx):
+        axis = int(ctx.static_np(1))
+        return ctx.op("argmax", ctx.inputs[:1], dimensions=axis)
+
+    # shape manipulation
+    @R("Reshape")
+    def _reshape(ctx):
+        shape = [int(s) for s in ctx.static_np(1)]
+        return ctx.op("reshape", ctx.inputs[:1], shape=shape)
+
+    @R("Transpose")
+    def _transpose(ctx):
+        perm = [int(p) for p in ctx.static_np(1)]
+        return ctx.op("transpose", ctx.inputs[:1], permute=perm)
+
+    @R("ExpandDims")
+    def _expand(ctx):
+        return ctx.op("expand_dims", ctx.inputs[:1],
+                      axis=int(ctx.static_np(1)))
+
+    @R("Squeeze")
+    def _squeeze(ctx):
+        dims = ctx.attr("squeeze_dims") or ctx.attr("axis") or None
+        axis = tuple(dims) if dims else None
+        return ctx.op("squeeze", ctx.inputs[:1], axis=axis)
+
+    @R("ConcatV2")
+    def _concat(ctx):
+        axis = int(ctx.static_np(len(ctx.inputs) - 1))
+        return ctx.op("concat", ctx.inputs[:-1], axis=axis)
+
+    @R("Pack")
+    def _pack(ctx):
+        return ctx.op("stack", ctx.inputs, axis=int(ctx.attr("axis", 0)))
+
+    @R("Unpack")
+    def _unpack(ctx):
+        n = int(ctx.attr("num"))
+        return ctx.op("unstack", ctx.inputs[:1], n_out=n,
+                      axis=int(ctx.attr("axis", 0)), num=n)
+
+    @R("Split")
+    def _split(ctx):
+        axis = int(ctx.static_np(0))
+        n = int(ctx.attr("num_split"))
+        return ctx.op("split", ctx.inputs[1:2], n_out=n,
+                      num_splits=n, axis=axis)
+
+    @R("Tile")
+    def _tile(ctx):
+        reps = [int(r) for r in ctx.static_np(1)]
+        return ctx.op("tile", ctx.inputs[:1], reps=reps)
+
+    @R("Pad", "PadV2")
+    def _pad(ctx):
+        pads = [[int(a), int(b)] for a, b in ctx.static_np(1)]
+        return ctx.op("pad", ctx.inputs[:1], paddings=pads)
+
+    @R("Slice")
+    def _slice(ctx):
+        begin = [int(b) for b in ctx.static_np(1)]
+        size = [int(s) for s in ctx.static_np(2)]
+        return ctx.op("slice", ctx.inputs[:1], begin=begin, size=size)
+
+    @R("StridedSlice")
+    def _strided_slice(ctx):
+        if ctx.attr("ellipsis_mask", 0) or ctx.attr("new_axis_mask", 0):
+            raise TFImportError(
+                f"{ctx.node.name}: StridedSlice ellipsis/new_axis masks "
+                "not supported")
+        begin = [int(b) for b in ctx.static_np(1)]
+        end = [int(e) for e in ctx.static_np(2)]
+        strides = [int(s) for s in ctx.static_np(3)]
+        bm = int(ctx.attr("begin_mask", 0))
+        em = int(ctx.attr("end_mask", 0))
+        sm = int(ctx.attr("shrink_axis_mask", 0))
+        return ctx.op("tf_strided_slice", ctx.inputs[:1], begin=begin,
+                      end=end, strides=strides, begin_mask=bm, end_mask=em,
+                      shrink_axis_mask=sm)
+
+    @R("GatherV2", "Gather")
+    def _gather(ctx):
+        axis = int(ctx.static_np(2)) if len(ctx.inputs) > 2 else 0
+        return ctx.op("gather", ctx.inputs[:2], axis=axis)
+
+    @R("OneHot")
+    def _one_hot(ctx):
+        depth = int(ctx.static_np(1))
+        return ctx.op("one_hot", ctx.inputs[:1], depth=depth)
+
+    @R("Cast")
+    def _cast(ctx):
+        return ctx.op("cast", ctx.inputs[:1], dtype=ctx.attr("DstT"))
+
+    @R("Shape")
+    def _shape(ctx):
+        return ctx.op("shape_of", ctx.inputs[:1])
+
+    @R("Fill")
+    def _fill(ctx):
+        dims = [int(d) for d in ctx.static_np(0)]
+        value = float(ctx.static_np(1))
+        return ctx.op("tf_fill", [], shape=dims, value=value)
+
+    @R("Range")
+    def _range(ctx):
+        start, limit, delta = (ctx.static_np(i) for i in range(3))
+        is_f = any(np.issubdtype(np.asarray(v).dtype, np.floating)
+                   for v in (start, limit, delta))
+        return ctx.op("range", [],
+                      start=float(start), stop=float(limit),
+                      step=float(delta),
+                      dtype="float32" if is_f else "int32")
+
+    @R("Select", "SelectV2")
+    def _select(ctx):
+        return ctx.op("where", ctx.inputs[:3])
+
+    # ---- NN ops ----
+    @R("Conv2D")
+    def _conv2d(ctx):
+        if ctx.attr("data_format", "NHWC") != "NHWC":
+            raise TFImportError("Conv2D: only NHWC supported")
+        strides = ctx.attr("strides", [1, 1, 1, 1])
+        dil = ctx.attr("dilations", [1, 1, 1, 1])
+        pad = ctx.attr("padding", "VALID")
+        padding = "SAME" if pad == "SAME" else (0, 0)
+        return ctx.op("conv2d", ctx.inputs[:2],
+                      strides=(int(strides[1]), int(strides[2])),
+                      padding=padding,
+                      dilation=(int(dil[1]), int(dil[2])))
+
+    @R("DepthwiseConv2dNative")
+    def _depthwise(ctx):
+        if ctx.attr("data_format", "NHWC") != "NHWC":
+            raise TFImportError("DepthwiseConv2d: only NHWC supported")
+        strides = ctx.attr("strides", [1, 1, 1, 1])
+        pad = ctx.attr("padding", "VALID")
+        padding = "SAME" if pad == "SAME" else (0, 0)
+        return ctx.op("depthwise_conv2d", ctx.inputs[:2],
+                      strides=(int(strides[1]), int(strides[2])),
+                      padding=padding)
+
+    @R("MaxPool")
+    def _maxpool(ctx):
+        ks = ctx.attr("ksize", [1, 2, 2, 1])
+        st = ctx.attr("strides", [1, 2, 2, 1])
+        pad = ctx.attr("padding", "VALID")
+        return ctx.op("maxpool2d", ctx.inputs[:1],
+                      kernel=(int(ks[1]), int(ks[2])),
+                      strides=(int(st[1]), int(st[2])),
+                      padding="SAME" if pad == "SAME" else "VALID")
+
+    @R("AvgPool")
+    def _avgpool(ctx):
+        ks = ctx.attr("ksize", [1, 2, 2, 1])
+        st = ctx.attr("strides", [1, 2, 2, 1])
+        pad = ctx.attr("padding", "VALID")
+        return ctx.op("avgpool2d", ctx.inputs[:1],
+                      kernel=(int(ks[1]), int(ks[2])),
+                      strides=(int(st[1]), int(st[2])),
+                      padding="SAME" if pad == "SAME" else "VALID")
+
+    @R("FusedBatchNorm", "FusedBatchNormV2", "FusedBatchNormV3")
+    def _fused_bn(ctx):
+        if ctx.attr("is_training", True):
+            raise TFImportError(
+                f"{ctx.node.name}: FusedBatchNorm with is_training=True — "
+                "freeze the graph for inference first")
+        if ctx.attr("data_format", "NHWC") != "NHWC":
+            raise TFImportError("FusedBatchNorm: only NHWC supported")
+        return ctx.op("batch_norm", ctx.inputs[:5],
+                      eps=float(ctx.attr("epsilon", 1e-3)))
+
+
+_register_standard_mappers()
+
+
+# ---- helper ops that exist only for TF-import semantics --------------
+from deeplearning4j_tpu.ops.registry import register_op  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+
+@register_op("tf_strided_slice")
+def tf_strided_slice(x, begin=None, end=None, strides=None, begin_mask=0,
+                     end_mask=0, shrink_axis_mask=0):
+    """TF StridedSlice subset: begin/end/shrink masks, no ellipsis."""
+    slices = []
+    shrink_axes = []
+    for i in range(len(begin)):
+        if shrink_axis_mask & (1 << i):
+            slices.append(slice(begin[i], begin[i] + 1, 1))
+            shrink_axes.append(i)
+            continue
+        b = None if begin_mask & (1 << i) else begin[i]
+        e = None if end_mask & (1 << i) else end[i]
+        slices.append(slice(b, e, strides[i]))
+    out = x[tuple(slices)]
+    if shrink_axes:
+        out = jnp.squeeze(out, axis=tuple(shrink_axes))
+    return out
+
+
+@register_op("tf_fill")
+def tf_fill(shape=None, value=0.0):
+    return jnp.full(tuple(shape), value)
+
+
+@register_op("erfc")
+def erfc(x):
+    import jax
+    return jax.scipy.special.erfc(x)
+
+
+OpMappingRegistry.register("Erfc")(
+    lambda ctx: ctx.op("erfc", ctx.inputs[:1]))
+
+
+# ----------------------------------------------------------------- import
+class TFGraphMapper:
+    """reference: TFGraphMapper#importGraph / ImportGraph.importGraph."""
+
+    @staticmethod
+    def importGraph(graph_def_or_path) -> SameDiff:
+        """Import a frozen GraphDef (proto object, serialized bytes, or
+        .pb path) into a SameDiff graph.
+
+        Placeholders become SameDiff placeholders; Consts become
+        constants (use SameDiff.convertConstantsToVariables to fine-tune
+        imported weights, as the reference does for frozen models).
+        """
+        gd = TFGraphMapper._as_graph_def(graph_def_or_path)
+        from tensorflow.python.framework import tensor_util
+
+        sd = SameDiff()
+        # tensor name ("node" / "node:k") -> SDVariable
+        tensors: Dict[str, SDVariable] = {}
+        const_vals: Dict[str, np.ndarray] = {}
+
+        def resolve(ref: str) -> Tuple[str, int]:
+            if ":" in ref:
+                name, idx = ref.rsplit(":", 1)
+                return name, int(idx)
+            return ref, 0
+
+        for node in gd.node:
+            attrs = _decode_attrs(node)
+            if node.op == "NoOp":
+                continue
+            if node.op == "Const":
+                val = tensor_util.MakeNdarray(node.attr["value"].tensor)
+                v = sd.constant(node.name, val)
+                if v.name != node.name:
+                    raise TFImportError(
+                        f"duplicate node name {node.name!r}")
+                tensors[node.name] = v
+                tensors[node.name + ":0"] = v
+                const_vals[node.name] = val
+                continue
+            if node.op in ("Placeholder", "PlaceholderWithDefault"):
+                shape = attrs.get("shape")
+                shape = [None if d in (-1, None) else int(d)
+                         for d in shape] if shape else None
+                v = sd.placeholder(node.name, shape=shape,
+                                   dtype=attrs.get("dtype", "float32"))
+                tensors[node.name] = v
+                tensors[node.name + ":0"] = v
+                continue
+
+            in_vars: List[SDVariable] = []
+            statics: List[Optional[np.ndarray]] = []
+            for ref in node.input:
+                if ref.startswith("^"):  # control edge: ordering only
+                    continue
+                src, idx = resolve(ref)
+                key = f"{src}:{idx}" if idx else src
+                if key not in tensors and f"{src}:{idx}" in tensors:
+                    key = f"{src}:{idx}"
+                if key not in tensors:
+                    raise TFImportError(
+                        f"node {node.name}: unresolved input {ref!r}")
+                in_vars.append(tensors[key])
+                statics.append(const_vals.get(src) if idx == 0 else None)
+
+            mapper = OpMappingRegistry.get(node.op)
+            ctx = _Ctx(sd, node, in_vars, statics, attrs)
+            out = mapper(ctx)
+            if isinstance(out, tuple):
+                for k, v in enumerate(out):
+                    tensors[f"{node.name}:{k}"] = v
+                tensors[node.name] = out[0]
+            else:
+                tensors[node.name] = out
+                tensors[node.name + ":0"] = out
+                # TF names the node's output after the node; align our
+                # variable name so sd.output(..., ["node_name"]) works
+                if out.name != node.name:
+                    out.rename(node.name)
+        return sd
+
+    @staticmethod
+    def _as_graph_def(src):
+        from tensorflow.core.framework import graph_pb2
+
+        if isinstance(src, graph_pb2.GraphDef):
+            return src
+        if isinstance(src, bytes):
+            gd = graph_pb2.GraphDef()
+            gd.ParseFromString(src)
+            return gd
+        if isinstance(src, str):
+            gd = graph_pb2.GraphDef()
+            with open(src, "rb") as f:
+                gd.ParseFromString(f.read())
+            return gd
+        # tf.Graph or function-like
+        if hasattr(src, "as_graph_def"):
+            return src.as_graph_def()
+        raise TFImportError(f"cannot interpret {type(src)} as a GraphDef")

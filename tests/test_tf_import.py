@@ -1,0 +1,238 @@
+"""TF frozen-graph import tests (reference model: TFGraphTestAllSameDiff
+— run frozen TF graphs through import+exec and compare against TF's own
+outputs; SURVEY.md §4 golden tests)."""
+
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+
+from deeplearning4j_tpu.modelimport.tensorflow import (OpMappingRegistry,
+                                                       TFGraphMapper)
+from deeplearning4j_tpu.modelimport.tensorflow.tf_import import TFImportError
+
+
+def _freeze(fn, *specs):
+    """tf.function → frozen GraphDef with variables folded to consts."""
+    from tensorflow.python.framework.convert_to_constants import (
+        convert_variables_to_constants_v2,
+    )
+
+    conc = tf.function(fn).get_concrete_function(*specs)
+    frozen = convert_variables_to_constants_v2(conc)
+    gd = frozen.graph.as_graph_def()
+    in_names = [t.name.split(":")[0] for t in frozen.inputs]
+    out_names = [t.name.split(":")[0] for t in frozen.outputs]
+    return gd, in_names, out_names, frozen
+
+
+def _run_both(fn, feeds_np, rtol=1e-4, atol=1e-5):
+    specs = [tf.TensorSpec(v.shape, tf.as_dtype(v.dtype)) for v in feeds_np]
+    gd, in_names, out_names, frozen = _freeze(fn, *specs)
+    ref = frozen(*[tf.constant(v) for v in feeds_np])
+    ref = [np.asarray(r) for r in (ref if isinstance(ref, (list, tuple))
+                                   else [ref])]
+    sd = TFGraphMapper.importGraph(gd)
+    feeds = dict(zip(in_names, feeds_np))
+    outs = sd.output(feeds, out_names)
+    got = [np.asarray(outs[n]) for n in out_names]
+    assert len(got) == len(ref)
+    for g, r in zip(got, ref):
+        np.testing.assert_allclose(g, r, rtol=rtol, atol=atol)
+    return sd
+
+
+class TestBasicGraphs:
+    def test_mlp(self):
+        w1 = tf.Variable(np.random.default_rng(0).normal(
+            size=(6, 8)).astype(np.float32))
+        b1 = tf.Variable(np.zeros(8, np.float32))
+        w2 = tf.Variable(np.random.default_rng(1).normal(
+            size=(8, 3)).astype(np.float32))
+
+        def mlp(x):
+            h = tf.nn.relu(tf.matmul(x, w1) + b1)
+            return tf.nn.softmax(tf.matmul(h, w2))
+
+        x = np.random.default_rng(2).normal(size=(4, 6)).astype(np.float32)
+        _run_both(mlp, [x])
+
+    def test_math_reductions_shapes(self):
+        def f(x):
+            y = tf.reshape(x, [-1, 6])
+            z = tf.transpose(y, [1, 0])
+            m = tf.reduce_mean(z, axis=1, keepdims=True)
+            v = tf.reduce_sum(tf.square(z - m), axis=[1])
+            return tf.sqrt(v + 1e-6)
+
+        x = np.random.default_rng(3).normal(size=(4, 3, 2)) \
+            .astype(np.float32)
+        _run_both(f, [x])
+
+    def test_concat_split_pad_slice(self):
+        def f(x):
+            a, b = tf.split(x, 2, axis=1)
+            c = tf.concat([b, a], axis=1)
+            p = tf.pad(c, [[0, 0], [1, 1]])
+            return tf.strided_slice(p, [0, 1], [4, 7], [1, 1])
+
+        x = np.random.default_rng(4).normal(size=(4, 6)).astype(np.float32)
+        _run_both(f, [x])
+
+    def test_conv_pool(self):
+        k = tf.Variable(np.random.default_rng(5).normal(
+            size=(3, 3, 2, 4)).astype(np.float32) * 0.3)
+
+        def f(x):
+            h = tf.nn.conv2d(x, k, strides=[1, 1, 1, 1], padding="SAME")
+            h = tf.nn.relu(h)
+            return tf.nn.max_pool2d(h, 2, 2, "VALID")
+
+        x = np.random.default_rng(6).normal(size=(2, 8, 8, 2)) \
+            .astype(np.float32)
+        _run_both(f, [x], rtol=1e-3, atol=1e-4)
+
+    def test_gather_onehot_argmax_cast(self):
+        table = tf.Variable(np.random.default_rng(7).normal(
+            size=(10, 4)).astype(np.float32))
+
+        def f(ids):
+            e = tf.gather(table, ids)
+            a = tf.argmax(e, axis=-1)
+            oh = tf.one_hot(a, 4)
+            return tf.cast(oh, tf.float32) + e
+
+        ids = np.random.default_rng(8).integers(0, 10, (3, 5)) \
+            .astype(np.int32)
+        _run_both(f, [ids])
+
+    def test_attention_block(self):
+        """The BERT-ish op set: batched matmul, softmax, transpose,
+        reshape, layer-norm decomposition."""
+        rng = np.random.default_rng(9)
+        d, h = 8, 2
+        wq = tf.Variable(rng.normal(size=(d, d)).astype(np.float32) * 0.3)
+        wk = tf.Variable(rng.normal(size=(d, d)).astype(np.float32) * 0.3)
+        wv = tf.Variable(rng.normal(size=(d, d)).astype(np.float32) * 0.3)
+        g = tf.Variable(np.ones(d, np.float32))
+        b = tf.Variable(np.zeros(d, np.float32))
+
+        def f(x):
+            n, t = tf.shape(x)[0], tf.shape(x)[1]
+            q = tf.reshape(x @ wq, [-1, 4, h, d // h])
+            kk = tf.reshape(x @ wk, [-1, 4, h, d // h])
+            v = tf.reshape(x @ wv, [-1, 4, h, d // h])
+            q = tf.transpose(q, [0, 2, 1, 3])
+            kk = tf.transpose(kk, [0, 2, 1, 3])
+            v = tf.transpose(v, [0, 2, 1, 3])
+            att = tf.nn.softmax(
+                tf.matmul(q, kk, transpose_b=True) / np.sqrt(d // h))
+            o = tf.transpose(tf.matmul(att, v), [0, 2, 1, 3])
+            o = tf.reshape(o, [-1, 4, d])
+            # layer norm decomposed
+            mu = tf.reduce_mean(o, axis=-1, keepdims=True)
+            var = tf.reduce_mean(tf.math.squared_difference(o, mu),
+                                 axis=-1, keepdims=True)
+            return (o - mu) * tf.math.rsqrt(var + 1e-6) * g + b
+
+        x = rng.normal(size=(2, 4, d)).astype(np.float32)
+        _run_both(f, [x], rtol=1e-3, atol=1e-4)
+
+    def test_keras_cnn_frozen(self):
+        keras = tf.keras
+        m = keras.Sequential([
+            keras.layers.Input((8, 8, 1)),
+            keras.layers.Conv2D(4, 3, padding="same", activation="relu"),
+            keras.layers.BatchNormalization(),
+            keras.layers.MaxPooling2D(2),
+            keras.layers.Flatten(),
+            keras.layers.Dense(3, activation="softmax"),
+        ])
+        x = np.random.default_rng(10).normal(size=(2, 8, 8, 1)) \
+            .astype(np.float32)
+        _run_both(lambda t: m(t, training=False), [x],
+                  rtol=1e-3, atol=1e-4)
+
+
+class TestImportSemantics:
+    def test_fine_tune_imported_graph(self):
+        w = tf.Variable(np.random.default_rng(0).normal(
+            size=(4, 2)).astype(np.float32))
+
+        def f(x):
+            return tf.matmul(x, w)
+
+        gd, in_names, out_names, _ = _freeze(
+            f, tf.TensorSpec([None, 4], tf.float32))
+        sd = TFGraphMapper.importGraph(gd)
+        # promote the frozen weight const to a trainable variable
+        consts = [v.name for v in sd.variables()
+                  if v.vtype.value == "CONSTANT"
+                  and sd._arrays[v.name].ndim == 2]
+        assert len(consts) == 1
+        sd.convertConstantsToVariables(consts[0])
+
+        import jax.numpy as jnp
+        out = sd.getVariable(out_names[0])
+        y = sd.placeholder("y_target", shape=(None, 2))
+        loss = ((out - y) * (out - y)).mean()
+        sd.setLossVariables(loss.name)
+        from deeplearning4j_tpu.autodiff import TrainingConfig
+        from deeplearning4j_tpu.learning.updaters import Sgd
+        sd.setTrainingConfig(TrainingConfig(
+            updater=Sgd(0.1), data_set_feature_mapping=[in_names[0]],
+            data_set_label_mapping=["y_target"]))
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        rng = np.random.default_rng(1)
+        xs = rng.normal(size=(32, 4)).astype(np.float32)
+        ys = np.zeros((32, 2), np.float32)
+        hist = sd.fit(DataSet(xs, ys), epochs=30)
+        assert hist.loss_curve[-1] < hist.loss_curve[0] * 0.1
+
+    def test_promote_after_fit_resets_updater_state(self):
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.autodiff import SameDiff, TrainingConfig
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        from deeplearning4j_tpu.learning.updaters import Adam
+
+        sd = SameDiff()
+        x = sd.placeholder("x", shape=(None, 3))
+        w = sd.var("w", np.zeros((3, 2), np.float32))
+        c = sd.constant("c", np.ones((2,), np.float32))
+        out = x @ w + c
+        y = sd.placeholder("y", shape=(None, 2))
+        loss = ((out - y) * (out - y)).mean()
+        sd.setLossVariables(loss.name)
+        sd.setTrainingConfig(TrainingConfig(
+            updater=Adam(0.01), data_set_feature_mapping=["x"],
+            data_set_label_mapping=["y"]))
+        ds = DataSet(np.ones((4, 3), np.float32), np.ones((4, 2), np.float32))
+        sd.fit(ds, epochs=1)
+        sd.convertConstantsToVariables("c")
+        sd.fit(ds, epochs=1)  # must not crash on stale updater slots
+        assert "c" in sd.trainable_names()
+
+    def test_promotion_is_atomic(self):
+        from deeplearning4j_tpu.autodiff import SameDiff
+
+        sd = SameDiff()
+        sd.constant("c", np.ones(2))
+        sd.placeholder("p", shape=(2,))
+        with pytest.raises(ValueError):
+            sd.convertConstantsToVariables("c", "p")
+        assert sd.getVariable("c").vtype.value == "CONSTANT"
+
+    def test_unknown_op_fails_loudly(self):
+        def f(x):
+            return tf.raw_ops.Betainc(a=x, b=x, x=x)
+
+        gd, *_ = _freeze(f, tf.TensorSpec([3], tf.float32))
+        with pytest.raises(TFImportError, match="no mapper"):
+            TFGraphMapper.importGraph(gd)
+
+    def test_coverage_listing(self):
+        cov = OpMappingRegistry.coverage()
+        assert len(cov) > 80
+        for op in ["MatMul", "Conv2D", "FusedBatchNormV3", "Softmax",
+                   "StridedSlice", "GatherV2"]:
+            assert op in cov
