@@ -205,6 +205,11 @@ def _register_standard_mappers():
 
     @R("AddN")
     def _addn(ctx):
+        if len(ctx.inputs) == 1:
+            # must emit a fresh variable: importGraph renames the mapper's
+            # output to the node name, and renaming the upstream input
+            # would corrupt the graph's name table
+            return ctx.op("identity", ctx.inputs[:1])
         out = ctx.inputs[0]
         for v in ctx.inputs[1:]:
             out = ctx.sd._op("add", [out.name, v.name])
@@ -279,7 +284,11 @@ def _register_standard_mappers():
     @R("Pad", "PadV2")
     def _pad(ctx):
         pads = [[int(a), int(b)] for a, b in ctx.static_np(1)]
-        return ctx.op("pad", ctx.inputs[:1], paddings=pads)
+        value = (float(ctx.static_np(2))
+                 if ctx.node.op == "PadV2" and len(ctx.node.input) > 2
+                 else 0.0)
+        return ctx.op("pad", ctx.inputs[:1], paddings=pads,
+                      constant_value=value)
 
     @R("Slice")
     def _slice(ctx):
@@ -311,7 +320,11 @@ def _register_standard_mappers():
     @R("OneHot")
     def _one_hot(ctx):
         depth = int(ctx.static_np(1))
-        return ctx.op("one_hot", ctx.inputs[:1], depth=depth)
+        on = float(ctx.static_np(2)) if len(ctx.node.input) > 2 else 1.0
+        off = float(ctx.static_np(3)) if len(ctx.node.input) > 3 else 0.0
+        axis = int(ctx.attr("axis", -1))
+        return ctx.op("one_hot", ctx.inputs[:1], depth=depth, on_value=on,
+                      off_value=off, axis=axis)
 
     @R("Cast")
     def _cast(ctx):
@@ -342,13 +355,23 @@ def _register_standard_mappers():
         return ctx.op("where", ctx.inputs[:3])
 
     # ---- NN ops ----
+    def _check_padding(ctx):
+        """SAME/VALID only — EXPLICIT (explicit_paddings) must not be
+        silently treated as VALID."""
+        pad = ctx.attr("padding", "VALID")
+        if pad not in ("SAME", "VALID"):
+            raise TFImportError(
+                f"{ctx.node.name}: padding={pad!r} not supported "
+                "(SAME/VALID only)")
+        return pad
+
     @R("Conv2D")
     def _conv2d(ctx):
         if ctx.attr("data_format", "NHWC") != "NHWC":
             raise TFImportError("Conv2D: only NHWC supported")
         strides = ctx.attr("strides", [1, 1, 1, 1])
         dil = ctx.attr("dilations", [1, 1, 1, 1])
-        pad = ctx.attr("padding", "VALID")
+        pad = _check_padding(ctx)
         padding = "SAME" if pad == "SAME" else (0, 0)
         return ctx.op("conv2d", ctx.inputs[:2],
                       strides=(int(strides[1]), int(strides[2])),
@@ -360,7 +383,7 @@ def _register_standard_mappers():
         if ctx.attr("data_format", "NHWC") != "NHWC":
             raise TFImportError("DepthwiseConv2d: only NHWC supported")
         strides = ctx.attr("strides", [1, 1, 1, 1])
-        pad = ctx.attr("padding", "VALID")
+        pad = _check_padding(ctx)
         padding = "SAME" if pad == "SAME" else (0, 0)
         return ctx.op("depthwise_conv2d", ctx.inputs[:2],
                       strides=(int(strides[1]), int(strides[2])),
@@ -370,7 +393,7 @@ def _register_standard_mappers():
     def _maxpool(ctx):
         ks = ctx.attr("ksize", [1, 2, 2, 1])
         st = ctx.attr("strides", [1, 2, 2, 1])
-        pad = ctx.attr("padding", "VALID")
+        pad = _check_padding(ctx)
         return ctx.op("maxpool2d", ctx.inputs[:1],
                       kernel=(int(ks[1]), int(ks[2])),
                       strides=(int(st[1]), int(st[2])),
@@ -380,7 +403,7 @@ def _register_standard_mappers():
     def _avgpool(ctx):
         ks = ctx.attr("ksize", [1, 2, 2, 1])
         st = ctx.attr("strides", [1, 2, 2, 1])
-        pad = ctx.attr("padding", "VALID")
+        pad = _check_padding(ctx)
         return ctx.op("avgpool2d", ctx.inputs[:1],
                       kernel=(int(ks[1]), int(ks[2])),
                       strides=(int(st[1]), int(st[2])),
@@ -414,7 +437,9 @@ def tf_strided_slice(x, begin=None, end=None, strides=None, begin_mask=0,
     shrink_axes = []
     for i in range(len(begin)):
         if shrink_axis_mask & (1 << i):
-            slices.append(slice(begin[i], begin[i] + 1, 1))
+            # begin=-1 means "last element": end must be None, not 0
+            e = begin[i] + 1 if begin[i] != -1 else None
+            slices.append(slice(begin[i], e, 1))
             shrink_axes.append(i)
             continue
         b = None if begin_mask & (1 << i) else begin[i]

@@ -318,8 +318,12 @@ def embedding_lookup(table, ids):
 
 
 @register_op("one_hot")
-def one_hot(ids, depth, dtype=jnp.float32):
-    return jax.nn.one_hot(ids, depth, dtype=dtype)
+def one_hot(ids, depth, dtype=jnp.float32, on_value=1.0, off_value=0.0,
+            axis=-1):
+    hot = jax.nn.one_hot(ids, depth, dtype=dtype, axis=axis)
+    if on_value == 1.0 and off_value == 0.0:
+        return hot
+    return off_value + hot * (on_value - off_value)
 
 
 # ----------------------------------------------------------------------

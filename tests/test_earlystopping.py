@@ -1,0 +1,192 @@
+"""Early stopping: conditions, calculators, savers, trainer end-to-end.
+
+Mirrors reference TestEarlyStopping (org/deeplearning4j/earlystopping).
+"""
+
+import math
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterator import ArrayDataSetIterator
+from deeplearning4j_tpu.earlystopping import (
+    BestScoreEpochTerminationCondition,
+    ClassificationScoreCalculator,
+    DataSetLossCalculator,
+    EarlyStoppingConfiguration,
+    EarlyStoppingTrainer,
+    InMemoryModelSaver,
+    InvalidScoreIterationTerminationCondition,
+    LocalFileModelSaver,
+    MaxEpochsTerminationCondition,
+    MaxScoreIterationTerminationCondition,
+    MaxTimeIterationTerminationCondition,
+    ScoreImprovementEpochTerminationCondition,
+    TerminationReason,
+)
+from deeplearning4j_tpu.nn.conf.builder import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer.network import MultiLayerNetwork
+
+
+def _toy_data(n=64, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, 4)).astype(np.float32)
+    y_idx = (x[:, 0] > 0).astype(int)
+    y = np.eye(2, dtype=np.float32)[y_idx]
+    return x, y
+
+
+def _net(seed=12345, lr=0.1):
+    from deeplearning4j_tpu.learning import Sgd
+
+    conf = (NeuralNetConfiguration.builder()
+            .seed(seed)
+            .updater(Sgd(learning_rate=lr))
+            .list()
+            .layer(DenseLayer(n_in=4, n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_in=8, n_out=2, activation="softmax",
+                               loss="mcxent"))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _iter(x, y, bs=16):
+    return ArrayDataSetIterator(x, y, batch_size=bs)
+
+
+class TestConditions:
+    def test_max_epochs(self):
+        c = MaxEpochsTerminationCondition(5)
+        assert not c.terminate(3, 0.1, True)
+        assert c.terminate(4, 0.1, True)
+
+    def test_score_improvement(self):
+        c = ScoreImprovementEpochTerminationCondition(2, min_improvement=0.01)
+        c.initialize()
+        assert not c.terminate(0, 1.0, True)
+        assert not c.terminate(1, 0.5, True)   # improved
+        assert not c.terminate(2, 0.5, True)   # no improvement (1)
+        assert c.terminate(3, 0.499, True)     # below min_improvement (2)
+
+    def test_best_score(self):
+        c = BestScoreEpochTerminationCondition(0.05)
+        assert not c.terminate(0, 0.2, True)
+        assert c.terminate(1, 0.01, True)
+        # maximize mode
+        assert c.terminate(1, 0.2, False)
+
+    def test_invalid_score(self):
+        c = InvalidScoreIterationTerminationCondition()
+        assert c.terminate(float("nan"))
+        assert c.terminate(float("inf"))
+        assert not c.terminate(1.0)
+
+    def test_max_score(self):
+        c = MaxScoreIterationTerminationCondition(10.0)
+        assert c.terminate(11.0)
+        assert not c.terminate(9.0)
+
+    def test_max_time(self):
+        c = MaxTimeIterationTerminationCondition(1e9)
+        c.initialize()
+        assert not c.terminate(0.0)
+        c2 = MaxTimeIterationTerminationCondition(-1.0)
+        c2.initialize()
+        assert c2.terminate(0.0)
+
+
+class TestTrainer:
+    def test_trains_and_stops_at_max_epochs(self):
+        x, y = _toy_data()
+        net = _net()
+        es = EarlyStoppingConfiguration(
+            score_calculator=DataSetLossCalculator(_iter(x, y)),
+            epoch_termination_conditions=[MaxEpochsTerminationCondition(4)],
+            iteration_termination_conditions=[
+                InvalidScoreIterationTerminationCondition()],
+        )
+        result = EarlyStoppingTrainer(es, net, _iter(x, y)).fit()
+        assert result.termination_reason == TerminationReason.EPOCH_TERMINATION
+        assert result.total_epochs == 4
+        assert len(result.score_vs_epoch) == 4
+        assert result.best_model is not None
+        # best model should actually classify the toy problem
+        ev = result.best_model.evaluate(_iter(x, y))
+        assert ev.accuracy() > 0.7
+
+    def test_score_improvement_stopping(self):
+        x, y = _toy_data()
+        # lr=0 → no learning → no improvement → stops after patience
+        net = _net(lr=0.0)
+        es = EarlyStoppingConfiguration(
+            score_calculator=DataSetLossCalculator(_iter(x, y)),
+            epoch_termination_conditions=[
+                ScoreImprovementEpochTerminationCondition(2),
+                MaxEpochsTerminationCondition(50)],
+        )
+        result = EarlyStoppingTrainer(es, net, _iter(x, y)).fit()
+        assert result.total_epochs < 50
+
+    def test_iteration_termination_max_score(self):
+        x, y = _toy_data()
+        net = _net(lr=0.0)
+        es = EarlyStoppingConfiguration(
+            score_calculator=DataSetLossCalculator(_iter(x, y)),
+            epoch_termination_conditions=[MaxEpochsTerminationCondition(10)],
+            iteration_termination_conditions=[
+                MaxScoreIterationTerminationCondition(1e-9)],
+        )
+        result = EarlyStoppingTrainer(es, net, _iter(x, y)).fit()
+        assert (result.termination_reason
+                == TerminationReason.ITERATION_TERMINATION)
+
+    def test_listeners_restored_after_fit(self):
+        x, y = _toy_data()
+        net = _net()
+        es = EarlyStoppingConfiguration(
+            score_calculator=DataSetLossCalculator(_iter(x, y)),
+            epoch_termination_conditions=[MaxEpochsTerminationCondition(1)],
+        )
+        EarlyStoppingTrainer(es, net, _iter(x, y)).fit()
+        assert net._listeners == []
+
+    def test_classification_score_calculator(self):
+        x, y = _toy_data()
+        net = _net()
+        calc = ClassificationScoreCalculator("accuracy", _iter(x, y))
+        es = EarlyStoppingConfiguration(
+            score_calculator=calc,
+            epoch_termination_conditions=[MaxEpochsTerminationCondition(3)],
+        )
+        result = EarlyStoppingTrainer(es, net, _iter(x, y)).fit()
+        assert not calc.minimize_score()
+        assert 0.0 <= result.best_model_score <= 1.0
+
+    def test_local_file_saver_roundtrip(self, tmp_path):
+        x, y = _toy_data()
+        net = _net()
+        saver = LocalFileModelSaver(str(tmp_path))
+        es = EarlyStoppingConfiguration(
+            score_calculator=DataSetLossCalculator(_iter(x, y)),
+            epoch_termination_conditions=[MaxEpochsTerminationCondition(2)],
+            model_saver=saver, save_last_model=True,
+        )
+        result = EarlyStoppingTrainer(es, net, _iter(x, y)).fit()
+        assert (tmp_path / "bestModel.bin").exists()
+        assert (tmp_path / "latestModel.bin").exists()
+        restored = saver.get_best_model()
+        out_a = np.asarray(restored.output(x).jax)
+        out_b = np.asarray(result.best_model.output(x).jax)
+        np.testing.assert_allclose(out_a, out_b, rtol=1e-5)
+
+    def test_in_memory_saver_isolated_from_training(self):
+        x, y = _toy_data()
+        net = _net()
+        saver = InMemoryModelSaver()
+        saver.save_best_model(net, 1.0)
+        before = np.asarray(saver.get_best_model().params().jax).copy()
+        net.fit(x, y, epochs=3)
+        after = np.asarray(saver.get_best_model().params().jax)
+        np.testing.assert_array_equal(before, after)

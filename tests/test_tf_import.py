@@ -236,3 +236,57 @@ class TestImportSemantics:
         for op in ["MatMul", "Conv2D", "FusedBatchNormV3", "Softmax",
                    "StridedSlice", "GatherV2"]:
             assert op in cov
+
+
+class TestReviewRegressions:
+    """Regressions for import-mapper bugs found in code review."""
+
+    def test_strided_slice_last_element(self):
+        # x[-1] / x[:, -1]: shrink_axis with begin=-1 must take the last
+        # element, not an empty slice
+        def f(x):
+            return x[-1] + x[:, -1][0]
+
+        x = np.random.default_rng(5).normal(size=(3, 4)).astype(np.float32)
+        _run_both(f, [x])
+
+    def test_padv2_constant_values(self):
+        def f(x):
+            return tf.pad(x, [[1, 1], [0, 2]], constant_values=-3.5)
+
+        x = np.random.default_rng(6).normal(size=(2, 3)).astype(np.float32)
+        _run_both(f, [x])
+
+    def test_one_hot_on_off_axis(self):
+        def f(x):
+            idx = tf.cast(tf.argmax(x, axis=-1), tf.int32)
+            a = tf.one_hot(idx, 5, on_value=2.0, off_value=-1.0)
+            b = tf.one_hot(idx, 5, axis=0)
+            return a + tf.transpose(b)
+
+        x = np.random.default_rng(7).normal(size=(4, 5)).astype(np.float32)
+        _run_both(f, [x])
+
+    def test_addn_single_input(self):
+        def f(x):
+            return tf.raw_ops.AddN(inputs=[x])
+
+        x = np.random.default_rng(8).normal(size=(3, 2)).astype(np.float32)
+        _run_both(f, [x])
+
+    def test_explicit_padding_rejected(self):
+        w = np.random.default_rng(9).normal(size=(3, 3, 1, 2)) \
+            .astype(np.float32)
+
+        def f(x):
+            return tf.raw_ops.Conv2D(
+                input=x, filter=tf.constant(w), strides=[1, 1, 1, 1],
+                padding="EXPLICIT",
+                explicit_paddings=[0, 0, 1, 1, 1, 1, 0, 0])
+
+        x = np.random.default_rng(10).normal(size=(1, 5, 5, 1)) \
+            .astype(np.float32)
+        specs = [tf.TensorSpec(x.shape, tf.float32)]
+        gd, _, _, _ = _freeze(f, *specs)
+        with pytest.raises(TFImportError, match="padding"):
+            TFGraphMapper.importGraph(gd)
