@@ -25,7 +25,13 @@ from typing import Any, Callable, List, Optional, Sequence
 # termination conditions
 # ----------------------------------------------------------------------
 class EpochTerminationCondition:
-    """Checked after each epoch (ref: EpochTerminationCondition)."""
+    """Checked after each epoch (ref: EpochTerminationCondition).
+
+    ``requires_score``: score-based conditions are only consulted on
+    epochs where the score calculator actually ran (otherwise a stale
+    score would, e.g., count phantom no-improvement epochs)."""
+
+    requires_score = True
 
     def initialize(self) -> None:
         pass
@@ -35,6 +41,8 @@ class EpochTerminationCondition:
 
 
 class MaxEpochsTerminationCondition(EpochTerminationCondition):
+    requires_score = False
+
     def __init__(self, max_epochs: int):
         self.max_epochs = max_epochs
 
@@ -246,8 +254,16 @@ class InMemoryModelSaver(EarlyStoppingModelSaver):
         import jax.numpy as jnp
 
         snap = model.clone()
-        snap.params_list = jax.tree_util.tree_map(jnp.copy, model.params_list)
-        snap.states_list = jax.tree_util.tree_map(jnp.copy, model.states_list)
+        if hasattr(model, "params_map"):       # ComputationGraph
+            snap.params_map = jax.tree_util.tree_map(
+                jnp.copy, model.params_map)
+            snap.states_map = jax.tree_util.tree_map(
+                jnp.copy, model.states_map)
+        else:                                   # MultiLayerNetwork
+            snap.params_list = jax.tree_util.tree_map(
+                jnp.copy, model.params_list)
+            snap.states_list = jax.tree_util.tree_map(
+                jnp.copy, model.states_list)
         snap.opt_states = jax.tree_util.tree_map(jnp.copy, model.opt_states)
         return snap
 
@@ -293,7 +309,9 @@ class LocalFileModelSaver(EarlyStoppingModelSaver):
     def _restore(self, path):
         from deeplearning4j_tpu.util.model_serializer import ModelSerializer
 
-        return (ModelSerializer.restoreMultiLayerNetwork(path)
+        # restore() dispatches on the saved model_type, so graphs saved
+        # by EarlyStoppingGraphTrainer come back as ComputationGraph
+        return (ModelSerializer.restore(path)
                 if os.path.exists(path) else None)
 
     def get_best_model(self):
@@ -403,7 +421,8 @@ class EarlyStoppingTrainer:
                     details = (f"{type(iter_listener.fired).__name__} fired at"
                                f" score {iter_listener.last_score}")
                     break
-                if (epoch % cfg.evaluate_every_n_epochs) == 0:
+                evaluated = (epoch % cfg.evaluate_every_n_epochs) == 0
+                if evaluated:
                     score = cfg.score_calculator.calculate_score(self.model)
                     score_vs_epoch[epoch] = score
                     last_score = score
@@ -414,10 +433,12 @@ class EarlyStoppingTrainer:
                         cfg.model_saver.save_best_model(self.model, score)
                 if cfg.save_last_model:
                     cfg.model_saver.save_latest_model(self.model, last_score)
-                # epoch conditions are checked EVERY epoch with the most
-                # recent score (ref: BaseEarlyStoppingTrainer#fit)
+                # score-free conditions (MaxEpochs) are checked every
+                # epoch; score-based ones only when a FRESH score exists
                 stop = False
                 for c in cfg.epoch_termination_conditions:
+                    if c.requires_score and not evaluated:
+                        continue
                     if c.terminate(epoch, last_score, minimize):
                         details = f"{c!r} fired at epoch {epoch}"
                         stop = True
@@ -425,6 +446,10 @@ class EarlyStoppingTrainer:
                 epoch += 1
                 if stop:
                     break
+        except Exception as e:                      # noqa: BLE001
+            # ref: BaseEarlyStoppingTrainer catches and reports Error
+            reason = TerminationReason.ERROR
+            details = f"{type(e).__name__}: {e}"
         finally:
             self.model._listeners = saved_listeners
         best_model = cfg.model_saver.get_best_model()

@@ -1,0 +1,33 @@
+"""NLP: Word2Vec / SequenceVectors / ParagraphVectors + tokenization.
+
+Reference: deeplearning4j-nlp-parent (SURVEY.md §2.35) —
+models/word2vec/Word2Vec.java, models/embeddings/** (in-memory lookup
+table, WordVectorSerializer), text/tokenization/**, documentiterator/**.
+
+TPU-native redesign: the reference trains word2vec with per-thread Java
+loops mutating a lookup table row-by-row (syn0/syn1neg HashMaps). Here
+the whole negative-sampling update for a minibatch of (center, context)
+pairs is ONE jit-compiled step — embedding gathers + batched dot
+products on the MXU, scatter-add updates via ``.at[].add`` — so the hot
+loop never leaves the device.
+"""
+
+from deeplearning4j_tpu.nlp.tokenization import (
+    CommonPreprocessor, DefaultTokenizer, DefaultTokenizerFactory,
+    NGramTokenizerFactory, Tokenizer, TokenizerFactory,
+)
+from deeplearning4j_tpu.nlp.sentence_iterator import (
+    BasicLineIterator, CollectionSentenceIterator, SentenceIterator,
+)
+from deeplearning4j_tpu.nlp.vocab import AbstractCache, VocabCache, VocabWord
+from deeplearning4j_tpu.nlp.word2vec import SequenceVectors, Word2Vec
+from deeplearning4j_tpu.nlp.paragraph_vectors import ParagraphVectors
+from deeplearning4j_tpu.nlp.serializer import WordVectorSerializer
+
+__all__ = [
+    "AbstractCache", "BasicLineIterator", "CollectionSentenceIterator",
+    "CommonPreprocessor", "DefaultTokenizer", "DefaultTokenizerFactory",
+    "NGramTokenizerFactory", "ParagraphVectors", "SentenceIterator",
+    "SequenceVectors", "Tokenizer", "TokenizerFactory", "VocabCache",
+    "VocabWord", "Word2Vec", "WordVectorSerializer",
+]

@@ -1,0 +1,155 @@
+"""ParagraphVectors (doc2vec) — PV-DBOW on the batched SGNS device step.
+
+Reference: org/deeplearning4j/models/paragraphvectors/
+ParagraphVectors.java (+ learning impl sequence/{DBOW,DM}.java).
+PV-DBOW: the document vector plays the role of the center word and
+predicts each word of the document via negative sampling — so training
+reuses the exact ``_sgns_step`` kernel with doc rows living in a
+separate table. ``inferVector`` gradient-descends a fresh doc row
+against frozen word tables, like the reference's inference pass.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nlp.word2vec import SequenceVectors, _sgns_step
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _infer_step(docvec, syn1neg, contexts, negatives, lr):
+    """SGD on a single doc vector with frozen output weights."""
+    o = syn1neg[contexts]                  # [B,D]
+    n = syn1neg[negatives]                 # [B,K,D]
+    pos_logit = o @ docvec
+    neg_logit = jnp.einsum("bkd,d->bk", n, docvec)
+    g_pos = jax.nn.sigmoid(pos_logit) - 1.0
+    g_neg = jax.nn.sigmoid(neg_logit)
+    grad = (g_pos[:, None] * o).sum(0) + jnp.einsum("bk,bkd->d", g_neg, n)
+    return docvec - lr * grad
+
+
+class LabelledDocument:
+    """Ref: LabelledDocument — content + label."""
+
+    def __init__(self, content: str, label: str):
+        self.content = content
+        self.label = label
+
+
+class ParagraphVectors(SequenceVectors):
+    def __init__(self, **kw):
+        # doc corpora are usually small; lower default min frequency
+        kw.setdefault("min_word_frequency", 1)
+        super().__init__(**kw)
+        self.doc_vectors: Optional[jnp.ndarray] = None    # [N,D]
+        self._labels: List[str] = []
+        self._label_index: Dict[str, int] = {}
+
+    # ------------------------------------------------------------------
+    def fit(self, documents: Sequence[Union[str, LabelledDocument,
+                                            Tuple[str, str]]]) -> "ParagraphVectors":
+        texts, labels = [], []
+        for i, d in enumerate(documents):
+            if isinstance(d, LabelledDocument):
+                texts.append(d.content)
+                labels.append(d.label)
+            elif isinstance(d, tuple):
+                labels.append(d[0])
+                texts.append(d[1])
+            else:
+                texts.append(d)
+                labels.append(f"DOC_{i}")
+        self._labels = labels
+        self._label_index = {l: i for i, l in enumerate(labels)}
+
+        seqs = self._build_vocab(texts)
+        if self.vocab.numWords() == 0:
+            raise ValueError("empty vocabulary — lower min_word_frequency?")
+        self._init_tables()
+        rng = np.random.default_rng(self.seed + 1)
+        self.doc_vectors = jnp.asarray(
+            (rng.random((len(texts), self.layer_size)) - 0.5)
+            / self.layer_size, jnp.float32)
+        prob = self._neg_table()
+
+        # PV-DBOW pairs: (doc_id, word) for every word of every doc
+        docs, words = [], []
+        for di, seq in enumerate(seqs):
+            for w in seq:
+                docs.append(di)
+                words.append(w)
+        docs = np.asarray(docs, np.int32)
+        words = np.asarray(words, np.int32)
+        n = len(docs)
+        B, K = self.batch_size, self.negative
+        for _ in range(self.epochs):
+            perm = self._np_rng.permutation(n)
+            dd, ww = docs[perm], words[perm]
+            for start in range(0, n, B):
+                d = dd[start:start + B]
+                w = ww[start:start + B]
+                negs = self._np_rng.choice(
+                    len(prob), size=(len(d), K), p=prob).astype(np.int32)
+                lr = self._lr_schedule(start, n)
+                # _sgns_step treats table0 rows as "centers" — pass the
+                # doc table in that slot
+                self.doc_vectors, self.syn1neg, self._last_loss = _sgns_step(
+                    self.doc_vectors, self.syn1neg, jnp.asarray(d),
+                    jnp.asarray(w), jnp.asarray(negs), jnp.float32(lr))
+        # also give words usable vectors: syn0 stays from init unless a
+        # joint word-training pass is requested via trainWordVectors
+        return self
+
+    # ------------------------------------------------------------------
+    def getVector(self, label: str) -> np.ndarray:
+        if self.doc_vectors is None:
+            raise RuntimeError("model not fitted — call fit() first")
+        return np.asarray(self.doc_vectors[self._label_index[label]])
+
+    def inferVector(self, text: str, steps: int = 20,
+                    learning_rate: Optional[float] = None) -> np.ndarray:
+        """Ref: ParagraphVectors#inferVector — fit a fresh doc vector
+        against the frozen trained tables."""
+        if self.doc_vectors is None:
+            raise RuntimeError("model not fitted — call fit() first")
+        lr = learning_rate or self.learning_rate
+        idxs = [self.vocab.indexOf(t) for t in self._tokenize(text)]
+        idxs = [i for i in idxs if i >= 0]
+        if not idxs:
+            return np.zeros(self.layer_size, np.float32)
+        rng = np.random.default_rng(self.seed + 2)
+        vec = jnp.asarray((rng.random(self.layer_size) - 0.5)
+                          / self.layer_size, jnp.float32)
+        words = np.asarray(idxs, np.int32)
+        prob = self._neg_table()
+        for s in range(steps):
+            negs = self._np_rng.choice(
+                len(prob), size=(len(words), self.negative),
+                p=prob).astype(np.int32)
+            step_lr = lr * (1.0 - s / steps)
+            vec = _infer_step(vec, self.syn1neg, jnp.asarray(words),
+                              jnp.asarray(negs), jnp.float32(step_lr))
+        return np.asarray(vec)
+
+    def similarityToLabel(self, text: str, label: str) -> float:
+        a = self.inferVector(text)
+        b = self.getVector(label)
+        na, nb = np.linalg.norm(a), np.linalg.norm(b)
+        if na == 0 or nb == 0:
+            return 0.0
+        return float(a @ b / (na * nb))
+
+    def nearestLabels(self, text: str, n: int = 5) -> List[str]:
+        a = self.inferVector(text)
+        mat = np.asarray(self.doc_vectors)
+        unit = mat / np.maximum(
+            np.linalg.norm(mat, axis=1, keepdims=True), 1e-12)
+        sims = unit @ (a / max(np.linalg.norm(a), 1e-12))
+        order = np.argsort(-sims)[:n]
+        return [self._labels[int(i)] for i in order]

@@ -1,0 +1,80 @@
+"""Sentence/document iterators (reference: deeplearning4j-nlp
+.../text/sentenceiterator/** — SentenceIterator, BasicLineIterator,
+CollectionSentenceIterator, SentencePreProcessor)."""
+
+from __future__ import annotations
+
+from typing import Callable, Iterable, List, Optional
+
+
+class SentenceIterator:
+    def nextSentence(self) -> str:
+        raise NotImplementedError
+
+    def hasNext(self) -> bool:
+        raise NotImplementedError
+
+    def reset(self) -> None:
+        raise NotImplementedError
+
+    def setPreProcessor(self, pre: Callable[[str], str]) -> None:
+        self._pre = pre
+
+    def _apply(self, s: str) -> str:
+        pre = getattr(self, "_pre", None)
+        return pre(s) if pre else s
+
+    def __iter__(self):
+        self.reset()
+        while self.hasNext():
+            yield self.nextSentence()
+
+
+class CollectionSentenceIterator(SentenceIterator):
+    def __init__(self, sentences: Iterable[str]):
+        self._sentences: List[str] = list(sentences)
+        self._i = 0
+
+    def nextSentence(self) -> str:
+        s = self._sentences[self._i]
+        self._i += 1
+        return self._apply(s)
+
+    def hasNext(self) -> bool:
+        return self._i < len(self._sentences)
+
+    def reset(self) -> None:
+        self._i = 0
+
+
+class BasicLineIterator(SentenceIterator):
+    """One sentence per line from a file (ref: BasicLineIterator)."""
+
+    def __init__(self, path: str):
+        self._path = path
+        self._fh = open(path, "r")
+        self._next: Optional[str] = None
+        self._advance()
+
+    def _advance(self) -> None:
+        line = self._fh.readline()
+        self._next = line.rstrip("\n") if line else None
+
+    def nextSentence(self) -> str:
+        s = self._next
+        self._advance()
+        return self._apply(s)
+
+    def hasNext(self) -> bool:
+        return self._next is not None
+
+    def reset(self) -> None:
+        self._fh.close()
+        self._fh = open(self._path, "r")
+        self._advance()
+
+    def close(self) -> None:
+        self._fh.close()
+
+
+LineSentenceIterator = BasicLineIterator

@@ -1,0 +1,110 @@
+"""Word-vector serialization (reference: org/deeplearning4j/models/
+embeddings/loader/WordVectorSerializer.java).
+
+Two formats, matching upstream's surface:
+- ``writeWordVectors``/``readWordVectors`` — word2vec C *text* format:
+  header line "V D", then one "word v1 .. vD" line per word.
+- ``writeWord2VecModel``/``readWord2VecModel`` — full model (both
+  tables + vocab counts + config) as an npz/json zip, the analog of the
+  reference's full-model zip (syn0 + syn1neg + frequencies).
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import zipfile
+from typing import TYPE_CHECKING
+
+import numpy as np
+
+if TYPE_CHECKING:
+    from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+
+
+class WordVectorSerializer:
+    @staticmethod
+    def writeWordVectors(model, path: str) -> None:
+        mat = model.getWordVectorMatrix()
+        with open(path, "w") as f:
+            f.write(f"{mat.shape[0]} {mat.shape[1]}\n")
+            for i in range(mat.shape[0]):
+                word = model.vocab.wordAtIndex(i)
+                vec = " ".join(f"{x:.6f}" for x in mat[i])
+                f.write(f"{word} {vec}\n")
+
+    @staticmethod
+    def readWordVectors(path: str):
+        """Returns a query-only Word2Vec (syn1neg absent, like loading
+        the C text format upstream)."""
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+
+        with open(path) as f:
+            v, d = (int(t) for t in f.readline().split())
+            model = Word2Vec(layer_size=d, min_word_frequency=1)
+            mat = np.zeros((v, d), np.float32)
+            words = []
+            for i in range(v):
+                parts = f.readline().rstrip("\n").split(" ")
+                words.append(parts[0])
+                mat[i] = [float(x) for x in parts[1:]]
+        # index order = file order (the file is already frequency-sorted)
+        for w in words:
+            model.vocab.addToken(w)
+        model.vocab.finalize_vocab(1)
+        for idx, w in enumerate(words):
+            model.vocab._words[w].index = idx
+        model.vocab._by_index = sorted(model.vocab._words.values(),
+                                       key=lambda vw: vw.index)
+        model.syn0 = jnp.asarray(mat)
+        return model
+
+    @staticmethod
+    def writeWord2VecModel(model, path: str) -> None:
+        with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
+            cfg = {
+                "layer_size": model.layer_size,
+                "window_size": model.window_size,
+                "min_word_frequency": model.min_word_frequency,
+                "negative": model.negative,
+                "use_cbow": model.use_cbow,
+                "words": model.vocab.words(),
+                "counts": model.vocab.counts().tolist(),
+            }
+            zf.writestr("config.json", json.dumps(cfg))
+            for name, arr in [("syn0", model.syn0),
+                              ("syn1neg", model.syn1neg)]:
+                if arr is None:
+                    continue
+                buf = io.BytesIO()
+                np.save(buf, np.asarray(arr))
+                zf.writestr(f"{name}.npy", buf.getvalue())
+
+    @staticmethod
+    def readWord2VecModel(path: str):
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+
+        with zipfile.ZipFile(path) as zf:
+            cfg = json.loads(zf.read("config.json"))
+            model = Word2Vec(
+                layer_size=cfg["layer_size"],
+                window_size=cfg["window_size"],
+                min_word_frequency=cfg["min_word_frequency"],
+                negative=cfg["negative"], use_cbow=cfg["use_cbow"])
+            for w, c in zip(cfg["words"], cfg["counts"]):
+                model.vocab.addToken(w, c)
+            model.vocab.finalize_vocab(1)
+            # restore exact index order from the saved word list
+            for idx, w in enumerate(cfg["words"]):
+                model.vocab._words[w].index = idx
+            model.vocab._by_index = sorted(
+                model.vocab._words.values(), key=lambda vw: vw.index)
+            for name in ("syn0", "syn1neg"):
+                if f"{name}.npy" in zf.namelist():
+                    arr = np.load(io.BytesIO(zf.read(f"{name}.npy")))
+                    setattr(model, name, jnp.asarray(arr))
+        return model

@@ -1,0 +1,108 @@
+"""Tokenizers (reference: deeplearning4j-nlp .../text/tokenization/
+tokenizer/** and tokenizerfactory/**)."""
+
+from __future__ import annotations
+
+import re
+from typing import Callable, List, Optional
+
+
+class TokenPreProcess:
+    """Per-token normalisation hook (ref: TokenPreProcess)."""
+
+    def pre_process(self, token: str) -> str:
+        raise NotImplementedError
+
+
+class CommonPreprocessor(TokenPreProcess):
+    """Lowercase + strip punctuation/digits (ref: CommonPreprocessor)."""
+
+    _PUNCT = re.compile(r"[\d\.:,\"'\(\)\[\]|/?!;]+")
+
+    def pre_process(self, token: str) -> str:
+        return self._PUNCT.sub("", token).lower()
+
+
+class LowCasePreProcessor(TokenPreProcess):
+    def pre_process(self, token: str) -> str:
+        return token.lower()
+
+
+class Tokenizer:
+    """Iterator over tokens of one sentence (ref: Tokenizer)."""
+
+    def __init__(self, tokens: List[str],
+                 preprocessor: Optional[TokenPreProcess] = None):
+        self._tokens = tokens
+        self._pre = preprocessor
+        self._i = 0
+
+    def setTokenPreProcessor(self, pre: TokenPreProcess) -> None:
+        self._pre = pre
+
+    def hasMoreTokens(self) -> bool:
+        return self._i < len(self._tokens)
+
+    def countTokens(self) -> int:
+        return len(self._tokens)
+
+    def nextToken(self) -> str:
+        t = self._tokens[self._i]
+        self._i += 1
+        return self._pre.pre_process(t) if self._pre else t
+
+    def getTokens(self) -> List[str]:
+        out = []
+        while self.hasMoreTokens():
+            t = self.nextToken()
+            if t:
+                out.append(t)
+        return out
+
+
+class DefaultTokenizer(Tokenizer):
+    """Whitespace tokenizer (ref: DefaultTokenizer via
+    DefaultTokenizerFactory)."""
+
+    def __init__(self, sentence: str,
+                 preprocessor: Optional[TokenPreProcess] = None):
+        super().__init__(sentence.split(), preprocessor)
+
+
+class NGramTokenizer(Tokenizer):
+    """Emits n-grams of the base tokens joined by spaces
+    (ref: NGramTokenizer — minN..maxN)."""
+
+    def __init__(self, sentence: str, min_n: int, max_n: int,
+                 preprocessor: Optional[TokenPreProcess] = None):
+        base = DefaultTokenizer(sentence, preprocessor).getTokens()
+        grams: List[str] = list(base)
+        for n in range(max(min_n, 2), max_n + 1):
+            grams.extend(" ".join(base[i:i + n])
+                         for i in range(len(base) - n + 1))
+        super().__init__(grams, None)
+
+
+class TokenizerFactory:
+    def create(self, sentence: str) -> Tokenizer:
+        raise NotImplementedError
+
+    def setTokenPreProcessor(self, pre: TokenPreProcess) -> None:
+        self._pre = pre
+
+
+class DefaultTokenizerFactory(TokenizerFactory):
+    def __init__(self):
+        self._pre: Optional[TokenPreProcess] = None
+
+    def create(self, sentence: str) -> Tokenizer:
+        return DefaultTokenizer(sentence, self._pre)
+
+
+class NGramTokenizerFactory(TokenizerFactory):
+    def __init__(self, min_n: int, max_n: int):
+        self._pre: Optional[TokenPreProcess] = None
+        self.min_n, self.max_n = min_n, max_n
+
+    def create(self, sentence: str) -> Tokenizer:
+        return NGramTokenizer(sentence, self.min_n, self.max_n, self._pre)

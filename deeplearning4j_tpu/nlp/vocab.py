@@ -1,0 +1,86 @@
+"""Vocabulary cache (reference: org/deeplearning4j/models/word2vec/
+wordstore/inmemory/AbstractCache.java + VocabWord)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+@dataclass
+class VocabWord:
+    """Ref: VocabWord — element frequency + index (huffman fields are
+    omitted: hierarchical softmax is replaced by negative sampling on
+    the batched device path)."""
+
+    word: str
+    count: float = 1.0
+    index: int = -1
+
+    def increment(self, by: float = 1.0) -> None:
+        self.count += by
+
+
+class AbstractCache:
+    """In-memory vocab store keyed by word and by index."""
+
+    def __init__(self):
+        self._words: Dict[str, VocabWord] = {}
+        self._by_index: List[VocabWord] = []
+        self.total_word_count: float = 0.0
+
+    # -- building ------------------------------------------------------
+    def addToken(self, word: str, by: float = 1.0) -> None:
+        vw = self._words.get(word)
+        if vw is None:
+            self._words[word] = VocabWord(word, by)
+        else:
+            vw.increment(by)
+        self.total_word_count += by
+
+    def finalize_vocab(self, min_word_frequency: int = 1) -> None:
+        """Drop rare words, sort by frequency desc, assign indices
+        (ref: VocabConstructor#buildJointVocabulary + truncateVocabulary)."""
+        kept = [vw for vw in self._words.values()
+                if vw.count >= min_word_frequency]
+        kept.sort(key=lambda v: (-v.count, v.word))
+        self._by_index = kept
+        self._words = {vw.word: vw for vw in kept}
+        for i, vw in enumerate(kept):
+            vw.index = i
+
+    # -- queries (ref: VocabCache interface) ---------------------------
+    def containsWord(self, word: str) -> bool:
+        return word in self._words
+
+    def wordFrequency(self, word: str) -> float:
+        vw = self._words.get(word)
+        return vw.count if vw else 0.0
+
+    def indexOf(self, word: str) -> int:
+        vw = self._words.get(word)
+        return vw.index if vw else -1
+
+    def wordAtIndex(self, index: int) -> Optional[str]:
+        if 0 <= index < len(self._by_index):
+            return self._by_index[index].word
+        return None
+
+    def numWords(self) -> int:
+        return len(self._by_index)
+
+    def words(self) -> List[str]:
+        return [vw.word for vw in self._by_index]
+
+    def vocabWords(self) -> List[VocabWord]:
+        return list(self._by_index)
+
+    def counts(self) -> np.ndarray:
+        return np.array([vw.count for vw in self._by_index], np.float64)
+
+
+# reference exposes the interface name VocabCache; AbstractCache is its
+# in-memory impl — alias both
+VocabCache = AbstractCache

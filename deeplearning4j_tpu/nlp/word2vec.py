@@ -1,0 +1,428 @@
+"""Word2Vec / SequenceVectors — batched SGNS on device.
+
+Reference: org/deeplearning4j/models/word2vec/Word2Vec.java (builder),
+models/sequencevectors/SequenceVectors.java, learning algorithms
+models/embeddings/learning/impl/elements/{SkipGram,CBOW}.java.
+
+TPU-native redesign (NOT a translation): the reference updates syn0/
+syn1neg row-by-row in Java threads. Here every minibatch of (center,
+context, K negatives) triples is one jit-compiled device step: gathers,
+a [B,K+1] batched dot-product block (MXU), and three scatter-adds. The
+exact word2vec SGD math is preserved — manual gradients, not autodiff,
+so the update touches only the gathered rows (no dense [V,D] gradient
+materialisation).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Iterable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nlp.sentence_iterator import (
+    CollectionSentenceIterator, SentenceIterator,
+)
+from deeplearning4j_tpu.nlp.tokenization import (
+    DefaultTokenizerFactory, TokenizerFactory,
+)
+from deeplearning4j_tpu.nlp.vocab import AbstractCache
+
+
+def _avg_scatter(table, idx, grads, lr):
+    """SGD step on the gathered rows with per-row gradient AVERAGING:
+    counts[i] = times row i appears in idx; each row moves by
+    lr * mean(its gradient contributions)."""
+    counts = jnp.zeros(table.shape[0], grads.dtype).at[idx].add(1.0)
+    scale = lr / jnp.maximum(counts[idx], 1.0)
+    return table.at[idx].add(-scale[:, None] * grads)
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def _sgns_step(syn0, syn1neg, centers, contexts, negatives, lr):
+    """One skip-gram negative-sampling SGD step for a batch of pairs.
+
+    centers: [B] int32, contexts: [B] int32, negatives: [B,K] int32.
+    Returns updated tables + mean loss.
+    """
+    c = syn0[centers]                      # [B,D]
+    o = syn1neg[contexts]                  # [B,D]
+    n = syn1neg[negatives]                 # [B,K,D]
+
+    pos_logit = jnp.einsum("bd,bd->b", c, o)
+    neg_logit = jnp.einsum("bd,bkd->bk", c, n)
+
+    g_pos = jax.nn.sigmoid(pos_logit) - 1.0          # [B]
+    g_neg = jax.nn.sigmoid(neg_logit)                # [B,K]
+
+    grad_c = g_pos[:, None] * o + jnp.einsum("bk,bkd->bd", g_neg, n)
+    grad_o = g_pos[:, None] * c
+    grad_n = g_neg[..., None] * c[:, None, :]
+
+    # batched-SGD stability: a row hit R times in one batch must take an
+    # AVERAGED step, not R summed steps (summing multiplies the
+    # effective lr by R and diverges for frequent words / small vocabs)
+    syn0 = _avg_scatter(syn0, centers, grad_c, lr)
+    syn1neg = _avg_scatter(syn1neg, contexts, grad_o, lr)
+    syn1neg = _avg_scatter(syn1neg, negatives.reshape(-1),
+                           grad_n.reshape(-1, grad_n.shape[-1]), lr)
+
+    loss = (-jax.nn.log_sigmoid(pos_logit)
+            - jax.nn.log_sigmoid(-neg_logit).sum(-1)).mean()
+    return syn0, syn1neg, loss
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def _cbow_step(syn0, syn1neg, window_ids, window_mask, centers, negatives,
+               lr):
+    """CBOW step: mean of context window predicts the center word.
+
+    window_ids: [B,W] int32 (padded), window_mask: [B,W] float32.
+    """
+    ctx = syn0[window_ids]                            # [B,W,D]
+    denom = jnp.maximum(window_mask.sum(-1, keepdims=True), 1.0)
+    h = (ctx * window_mask[..., None]).sum(1) / denom  # [B,D]
+    o = syn1neg[centers]                               # [B,D]
+    n = syn1neg[negatives]                             # [B,K,D]
+
+    pos_logit = jnp.einsum("bd,bd->b", h, o)
+    neg_logit = jnp.einsum("bd,bkd->bk", h, n)
+    g_pos = jax.nn.sigmoid(pos_logit) - 1.0
+    g_neg = jax.nn.sigmoid(neg_logit)
+
+    grad_h = g_pos[:, None] * o + jnp.einsum("bk,bkd->bd", g_neg, n)
+    # distribute mean-gradient back over the (masked) window rows
+    grad_ctx = (grad_h[:, None, :] * window_mask[..., None]) / denom[..., None]
+    grad_o = g_pos[:, None] * h
+    grad_n = g_neg[..., None] * h[:, None, :]
+
+    flat_ids = window_ids.reshape(-1)
+    flat_grad = grad_ctx.reshape(-1, grad_ctx.shape[-1])
+    # mask padded slots out of both the update and the count
+    flat_mask = window_mask.reshape(-1)
+    counts = jnp.zeros(syn0.shape[0], flat_grad.dtype) \
+        .at[flat_ids].add(flat_mask)
+    scale = (lr * flat_mask) / jnp.maximum(counts[flat_ids], 1.0)
+    syn0 = syn0.at[flat_ids].add(-scale[:, None] * flat_grad)
+    syn1neg = _avg_scatter(syn1neg, centers, grad_o, lr)
+    syn1neg = _avg_scatter(syn1neg, negatives.reshape(-1),
+                           grad_n.reshape(-1, grad_n.shape[-1]), lr)
+
+    loss = (-jax.nn.log_sigmoid(pos_logit)
+            - jax.nn.log_sigmoid(-neg_logit).sum(-1)).mean()
+    return syn0, syn1neg, loss
+
+
+class SequenceVectors:
+    """Generic distributed-representation trainer over element sequences
+    (ref: SequenceVectors — Word2Vec and ParagraphVectors extend it)."""
+
+    def __init__(self, layer_size: int = 100, window_size: int = 5,
+                 min_word_frequency: int = 5, epochs: int = 1,
+                 iterations: int = 1, learning_rate: float = 0.025,
+                 min_learning_rate: float = 1e-4, negative: int = 5,
+                 sampling: float = 0.0, batch_size: int = 512,
+                 seed: int = 42, use_cbow: bool = False,
+                 tokenizer_factory: Optional[TokenizerFactory] = None):
+        self.layer_size = layer_size
+        self.window_size = window_size
+        self.min_word_frequency = min_word_frequency
+        self.epochs = epochs
+        self.iterations = iterations
+        self.learning_rate = learning_rate
+        self.min_learning_rate = min_learning_rate
+        self.negative = negative
+        self.sampling = sampling
+        self.batch_size = batch_size
+        self.seed = seed
+        self.use_cbow = use_cbow
+        self.tokenizer_factory = tokenizer_factory or DefaultTokenizerFactory()
+
+        self.vocab = AbstractCache()
+        self.syn0: Optional[jnp.ndarray] = None      # lookup table [V,D]
+        self.syn1neg: Optional[jnp.ndarray] = None   # output weights [V,D]
+        self._np_rng = np.random.default_rng(seed)
+
+    # -- corpus → index sequences --------------------------------------
+    def _tokenize(self, sentence: str) -> List[str]:
+        return self.tokenizer_factory.create(sentence).getTokens()
+
+    def _build_vocab(self, sentences: Iterable[str]) -> List[List[int]]:
+        tokenized = [self._tokenize(s) for s in sentences]
+        for toks in tokenized:
+            for t in toks:
+                self.vocab.addToken(t)
+        self.vocab.finalize_vocab(self.min_word_frequency)
+        seqs = []
+        for toks in tokenized:
+            idxs = [self.vocab.indexOf(t) for t in toks]
+            seqs.append([i for i in idxs if i >= 0])
+        return seqs
+
+    def _init_tables(self) -> None:
+        v, d = self.vocab.numWords(), self.layer_size
+        rng = np.random.default_rng(self.seed)
+        # word2vec init: syn0 uniform in [-0.5/D, 0.5/D], syn1neg zeros
+        self.syn0 = jnp.asarray(
+            (rng.random((v, d)) - 0.5) / d, jnp.float32)
+        self.syn1neg = jnp.zeros((v, d), jnp.float32)
+
+    def _neg_table(self) -> np.ndarray:
+        """Unigram^0.75 sampling distribution (ref: negative-sampling
+        table in the C word2vec; here an explicit probability vector)."""
+        counts = self.vocab.counts() ** 0.75
+        return counts / counts.sum()
+
+    def _subsample(self, seq: List[int], total: float) -> List[int]:
+        """Frequent-word subsampling (ref: sampling threshold in
+        SkipGram#frameSequence)."""
+        if self.sampling <= 0:
+            return seq
+        counts = self.vocab.counts()
+        keep = []
+        t = self.sampling
+        for i in seq:
+            f = counts[i] / total
+            p = (np.sqrt(f / t) + 1) * (t / f) if f > 0 else 1.0
+            if p >= 1.0 or self._np_rng.random() < p:
+                keep.append(i)
+        return keep
+
+    def _skipgram_pairs(self, seqs: List[List[int]]):
+        """All (center, context) pairs with dynamic window shrink."""
+        total = self.vocab.total_word_count
+        centers, contexts = [], []
+        for seq in seqs:
+            seq = self._subsample(seq, total)
+            L = len(seq)
+            if L < 2:
+                continue
+            bs = self._np_rng.integers(1, self.window_size + 1, L)
+            for pos, (w, b) in enumerate(zip(seq, bs)):
+                lo, hi = max(0, pos - b), min(L, pos + b + 1)
+                for j in range(lo, hi):
+                    if j != pos:
+                        centers.append(w)
+                        contexts.append(seq[j])
+        return (np.asarray(centers, np.int32),
+                np.asarray(contexts, np.int32))
+
+    def _cbow_windows(self, seqs: List[List[int]]):
+        total = self.vocab.total_word_count
+        W = 2 * self.window_size
+        wins, masks, centers = [], [], []
+        for seq in seqs:
+            seq = self._subsample(seq, total)
+            L = len(seq)
+            if L < 2:
+                continue
+            bs = self._np_rng.integers(1, self.window_size + 1, L)
+            for pos, (w, b) in enumerate(zip(seq, bs)):
+                ctx = [seq[j] for j in range(max(0, pos - b),
+                                             min(L, pos + b + 1)) if j != pos]
+                if not ctx:
+                    continue
+                pad = W - len(ctx)
+                wins.append(ctx + [0] * pad)
+                masks.append([1.0] * len(ctx) + [0.0] * pad)
+                centers.append(w)
+        return (np.asarray(wins, np.int32), np.asarray(masks, np.float32),
+                np.asarray(centers, np.int32))
+
+    # -- training ------------------------------------------------------
+    def fit(self, sentences=None) -> "SequenceVectors":
+        sents = self._as_sentences(sentences)
+        seqs = self._build_vocab(sents)
+        if self.vocab.numWords() == 0:
+            raise ValueError("empty vocabulary — lower min_word_frequency?")
+        self._init_tables()
+        prob = self._neg_table()
+        for _ in range(self.epochs):
+            if self.use_cbow:
+                self._fit_epoch_cbow(seqs, prob)
+            else:
+                self._fit_epoch_skipgram(seqs, prob)
+        return self
+
+    def _lr_schedule(self, done: int, total: int) -> float:
+        frac = done / max(total, 1)
+        return max(self.min_learning_rate,
+                   self.learning_rate * (1.0 - frac))
+
+    def _fit_epoch_skipgram(self, seqs, prob) -> None:
+        centers, contexts = self._skipgram_pairs(seqs)
+        n = len(centers)
+        if n == 0:
+            return
+        perm = self._np_rng.permutation(n)
+        centers, contexts = centers[perm], contexts[perm]
+        B, K = self.batch_size, self.negative
+        for start in range(0, n, B):
+            c = centers[start:start + B]
+            o = contexts[start:start + B]
+            negs = self._np_rng.choice(len(prob), size=(len(c), K), p=prob) \
+                .astype(np.int32)
+            lr = self._lr_schedule(start, n)
+            for _ in range(self.iterations):
+                self.syn0, self.syn1neg, self._last_loss = _sgns_step(
+                    self.syn0, self.syn1neg, jnp.asarray(c), jnp.asarray(o),
+                    jnp.asarray(negs), jnp.float32(lr))
+
+    def _fit_epoch_cbow(self, seqs, prob) -> None:
+        wins, masks, centers = self._cbow_windows(seqs)
+        n = len(centers)
+        if n == 0:
+            return
+        perm = self._np_rng.permutation(n)
+        wins, masks, centers = wins[perm], masks[perm], centers[perm]
+        B, K = self.batch_size, self.negative
+        for start in range(0, n, B):
+            w = wins[start:start + B]
+            m = masks[start:start + B]
+            c = centers[start:start + B]
+            negs = self._np_rng.choice(len(prob), size=(len(c), K), p=prob) \
+                .astype(np.int32)
+            lr = self._lr_schedule(start, n)
+            for _ in range(self.iterations):
+                self.syn0, self.syn1neg, self._last_loss = _cbow_step(
+                    self.syn0, self.syn1neg, jnp.asarray(w), jnp.asarray(m),
+                    jnp.asarray(c), jnp.asarray(negs), jnp.float32(lr))
+
+    def _as_sentences(self, sentences) -> List[str]:
+        if sentences is None:
+            raise ValueError("fit() requires sentences (iterable or "
+                             "SentenceIterator)")
+        if isinstance(sentences, SentenceIterator):
+            return list(sentences)
+        return list(sentences)
+
+    # -- WordVectors query surface (ref: WordVectors interface) --------
+    def _check_fitted(self):
+        if self.syn0 is None:
+            raise RuntimeError("model not fitted — call fit() first")
+
+    def hasWord(self, word: str) -> bool:
+        return self.vocab.containsWord(word)
+
+    def getWordVector(self, word: str) -> np.ndarray:
+        self._check_fitted()
+        i = self.vocab.indexOf(word)
+        if i < 0:
+            raise KeyError(word)
+        return np.asarray(self.syn0[i])
+
+    def getWordVectorMatrix(self) -> np.ndarray:
+        self._check_fitted()
+        return np.asarray(self.syn0)
+
+    def similarity(self, w1: str, w2: str) -> float:
+        a, b = self.getWordVector(w1), self.getWordVector(w2)
+        na, nb = np.linalg.norm(a), np.linalg.norm(b)
+        if na == 0 or nb == 0:
+            return 0.0
+        return float(a @ b / (na * nb))
+
+    def wordsNearest(self, word: str, n: int = 10) -> List[str]:
+        """Top-n cosine neighbours (ref: WordVectors#wordsNearest)."""
+        self._check_fitted()
+        mat = np.asarray(self.syn0)
+        norms = np.linalg.norm(mat, axis=1, keepdims=True)
+        unit = mat / np.maximum(norms, 1e-12)
+        q = unit[self.vocab.indexOf(word)]
+        sims = unit @ q
+        order = np.argsort(-sims)
+        out = []
+        for i in order:
+            w = self.vocab.wordAtIndex(int(i))
+            if w != word:
+                out.append(w)
+            if len(out) >= n:
+                break
+        return out
+
+
+class Word2Vec(SequenceVectors):
+    """Ref: Word2Vec.Builder — same hyperparameter surface, builder
+    collapsed into keyword arguments. elementsLearningAlgorithm maps to
+    ``use_cbow`` (SkipGram default, as upstream)."""
+
+    class Builder:
+        """Fluent builder kept for API parity with the reference."""
+
+        def __init__(self):
+            self._kw = {}
+
+        def layerSize(self, n):
+            self._kw["layer_size"] = n
+            return self
+
+        def windowSize(self, n):
+            self._kw["window_size"] = n
+            return self
+
+        def minWordFrequency(self, n):
+            self._kw["min_word_frequency"] = n
+            return self
+
+        def epochs(self, n):
+            self._kw["epochs"] = n
+            return self
+
+        def iterations(self, n):
+            self._kw["iterations"] = n
+            return self
+
+        def learningRate(self, lr):
+            self._kw["learning_rate"] = lr
+            return self
+
+        def minLearningRate(self, lr):
+            self._kw["min_learning_rate"] = lr
+            return self
+
+        def negativeSample(self, k):
+            self._kw["negative"] = int(k)
+            return self
+
+        def sampling(self, s):
+            self._kw["sampling"] = s
+            return self
+
+        def batchSize(self, b):
+            self._kw["batch_size"] = b
+            return self
+
+        def seed(self, s):
+            self._kw["seed"] = s
+            return self
+
+        def elementsLearningAlgorithm(self, name: str):
+            self._kw["use_cbow"] = "cbow" in str(name).lower()
+            return self
+
+        def tokenizerFactory(self, tf):
+            self._kw["tokenizer_factory"] = tf
+            return self
+
+        def iterate(self, sentence_iterator):
+            self._iterate = sentence_iterator
+            return self
+
+        def build(self) -> "Word2Vec":
+            m = Word2Vec(**self._kw)
+            if getattr(self, "_iterate", None) is not None:
+                m._pending_iterator = self._iterate
+            return m
+
+    _pending_iterator = None
+
+    @staticmethod
+    def builder() -> "Word2Vec.Builder":
+        return Word2Vec.Builder()
+
+    def fit(self, sentences=None) -> "Word2Vec":
+        if sentences is None and self._pending_iterator is not None:
+            sentences = self._pending_iterator
+        return super().fit(sentences)

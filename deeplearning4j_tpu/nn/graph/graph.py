@@ -266,6 +266,15 @@ class ComputationGraph:
             ev.eval(ds.labels, out.jax)
         return ev
 
+    def evaluateRegression(self, iterator: DataSetIterator):
+        from deeplearning4j_tpu.evaluation import RegressionEvaluation
+
+        ev = RegressionEvaluation()
+        for ds in iterator:
+            out = self.outputSingle(ds.features)
+            ev.eval(ds.labels, out.jax)
+        return ev
+
     # ------------------------------------------------------------------
     def numParams(self) -> int:
         self._check_init()
@@ -295,6 +304,25 @@ class ComputationGraph:
     def setListeners(self, *ls):
         self._listeners = list(ls)
         return self
+
+    def addListeners(self, *ls):
+        self._listeners.extend(ls)
+        return self
+
+    def clone(self) -> "ComputationGraph":
+        """Structural copy sharing array references (reference:
+        ComputationGraph#clone). Callers that keep training the source
+        must copy buffers (the compiled step donates them)."""
+        m = ComputationGraph(self.conf)
+        if self.params_map is not None:
+            m.init()
+            m.params_map = jax.tree_util.tree_map(
+                lambda a: a, self.params_map)
+            m.states_map = jax.tree_util.tree_map(
+                lambda a: a, self.states_map)
+            m.opt_states = jax.tree_util.tree_map(
+                lambda a: a, self.opt_states)
+        return m
 
     def getIterationCount(self):
         return self._iteration

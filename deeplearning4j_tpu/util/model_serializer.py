@@ -78,11 +78,13 @@ class ModelSerializer:
                    normalizer=None) -> None:
         """Reference: ModelSerializer.writeModel(model, file, saveUpdater)."""
         os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        is_graph = hasattr(model, "params_map")
+        params = model.params_map if is_graph else model.params_list
+        states = model.states_map if is_graph else model.states_list
         with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
             zf.writestr("configuration.json", model.conf.to_json())
-            _write_npz(zf, "coefficients.npz",
-                       _flatten_with_paths(model.params_list))
-            _write_npz(zf, "state.npz", _flatten_with_paths(model.states_list))
+            _write_npz(zf, "coefficients.npz", _flatten_with_paths(params))
+            _write_npz(zf, "state.npz", _flatten_with_paths(states))
             if save_updater and model.opt_states is not None:
                 _write_npz(zf, "updaterState.npz",
                            _flatten_with_paths(model.opt_states))
@@ -119,6 +121,40 @@ class ModelSerializer:
             model._iteration = meta.get("iteration", 0)
             model._epoch = meta.get("epoch", 0)
         return model
+
+    @staticmethod
+    def restoreComputationGraph(path: str, load_updater: bool = True):
+        """Reference: ModelSerializer.restoreComputationGraph."""
+        from deeplearning4j_tpu.nn.graph.config import (
+            ComputationGraphConfiguration,
+        )
+        from deeplearning4j_tpu.nn.graph.graph import ComputationGraph
+
+        with zipfile.ZipFile(path) as zf:
+            conf = ComputationGraphConfiguration.from_json(
+                zf.read("configuration.json").decode())
+            model = ComputationGraph(conf).init()
+            coeff = _read_npz(zf, "coefficients.npz")
+            model.params_map = _unflatten_into(model.params_map, coeff)
+            states = _read_npz(zf, "state.npz")
+            if states:
+                model.states_map = _unflatten_into(model.states_map, states)
+            if load_updater and "updaterState.npz" in zf.namelist():
+                upd = _read_npz(zf, "updaterState.npz")
+                model.opt_states = _unflatten_into(model.opt_states, upd)
+            meta = json.loads(zf.read("meta.json").decode())
+            model._iteration = meta.get("iteration", 0)
+            model._epoch = meta.get("epoch", 0)
+        return model
+
+    @staticmethod
+    def restore(path: str, load_updater: bool = True):
+        """Dispatch on the saved model_type (meta.json)."""
+        with zipfile.ZipFile(path) as zf:
+            meta = json.loads(zf.read("meta.json").decode())
+        if meta.get("model_type") == "ComputationGraph":
+            return ModelSerializer.restoreComputationGraph(path, load_updater)
+        return ModelSerializer.restoreMultiLayerNetwork(path, load_updater)
 
     @staticmethod
     def restoreNormalizer(path: str):

@@ -190,3 +190,113 @@ class TestTrainer:
         net.fit(x, y, epochs=3)
         after = np.asarray(saver.get_best_model().params().jax)
         np.testing.assert_array_equal(before, after)
+
+
+def _graph(lr=0.1):
+    from deeplearning4j_tpu.learning import Sgd
+    from deeplearning4j_tpu.nn.conf import InputType
+    from deeplearning4j_tpu.nn.graph import (
+        ComputationGraphConfiguration, ComputationGraph,
+    )
+
+    conf = (ComputationGraphConfiguration.graphBuilder()
+            .seed(3)
+            .updater(Sgd(learning_rate=lr))
+            .addInputs("in")
+            .addLayer("h", DenseLayer(n_in=4, n_out=8, activation="tanh"),
+                      "in")
+            .addLayer("out", OutputLayer(n_in=8, n_out=2,
+                                         activation="softmax", loss="mcxent"),
+                      "h")
+            .setOutputs("out")
+            .setInputTypes(InputType.feedForward(4))
+            .build())
+    return ComputationGraph(conf).init()
+
+
+class TestReviewRegressions:
+    def test_evaluate_every_n_epochs_score_conditions_not_stale(self):
+        # With eval every 2 epochs and patience 2, stale-score checking
+        # would stop after ~2 epochs having evaluated only once; correct
+        # gating requires 2 further *evaluations* with no improvement.
+        x, y = _toy_data()
+        net = _net(lr=0.0)
+        calls = []
+        calc = DataSetLossCalculator(_iter(x, y))
+        orig = calc.calculate_score
+
+        def counted(model):
+            calls.append(1)
+            return orig(model)
+        calc.calculate_score = counted
+        es = EarlyStoppingConfiguration(
+            score_calculator=calc,
+            evaluate_every_n_epochs=2,
+            epoch_termination_conditions=[
+                ScoreImprovementEpochTerminationCondition(2),
+                MaxEpochsTerminationCondition(50)],
+        )
+        result = EarlyStoppingTrainer(es, net, _iter(x, y)).fit()
+        assert len(calls) >= 3           # initial + 2 no-improvement evals
+        assert result.total_epochs == 5  # evals at epochs 0,2,4
+
+    def test_max_epochs_exact_with_sparse_eval(self):
+        x, y = _toy_data()
+        net = _net()
+        es = EarlyStoppingConfiguration(
+            score_calculator=DataSetLossCalculator(_iter(x, y)),
+            evaluate_every_n_epochs=3,
+            epoch_termination_conditions=[MaxEpochsTerminationCondition(4)],
+        )
+        result = EarlyStoppingTrainer(es, net, _iter(x, y)).fit()
+        assert result.total_epochs == 4
+
+    def test_error_reason_captured(self):
+        x, y = _toy_data()
+        net = _net()
+
+        class Boom(DataSetLossCalculator):
+            def calculate_score(self, model):
+                raise RuntimeError("boom")
+
+        es = EarlyStoppingConfiguration(
+            score_calculator=Boom(_iter(x, y)),
+            epoch_termination_conditions=[MaxEpochsTerminationCondition(3)],
+        )
+        result = EarlyStoppingTrainer(es, net, _iter(x, y)).fit()
+        assert result.termination_reason == TerminationReason.ERROR
+        assert "boom" in result.termination_details
+
+    def test_graph_trainer_in_memory_saver(self):
+        from deeplearning4j_tpu.earlystopping import EarlyStoppingGraphTrainer
+
+        x, y = _toy_data()
+        g = _graph()
+        es = EarlyStoppingConfiguration(
+            score_calculator=DataSetLossCalculator(_iter(x, y)),
+            epoch_termination_conditions=[MaxEpochsTerminationCondition(3)],
+        )
+        result = EarlyStoppingGraphTrainer(es, g, _iter(x, y)).fit()
+        best = result.best_model
+        assert best is not None
+        ev = best.evaluate(_iter(x, y))
+        assert ev.accuracy() > 0.6
+
+    def test_graph_trainer_file_saver_roundtrip(self, tmp_path):
+        from deeplearning4j_tpu.earlystopping import EarlyStoppingGraphTrainer
+        from deeplearning4j_tpu.nn.graph.graph import ComputationGraph
+
+        x, y = _toy_data()
+        g = _graph()
+        saver = LocalFileModelSaver(str(tmp_path))
+        es = EarlyStoppingConfiguration(
+            score_calculator=DataSetLossCalculator(_iter(x, y)),
+            epoch_termination_conditions=[MaxEpochsTerminationCondition(2)],
+            model_saver=saver,
+        )
+        result = EarlyStoppingGraphTrainer(es, g, _iter(x, y)).fit()
+        restored = saver.get_best_model()
+        assert isinstance(restored, ComputationGraph)
+        a = np.asarray(restored.outputSingle(x[:4]).jax)
+        b = np.asarray(result.best_model.outputSingle(x[:4]).jax)
+        np.testing.assert_allclose(a, b, rtol=1e-5)

@@ -1,0 +1,195 @@
+"""NLP subsystem tests (reference analogs: Word2VecTests,
+ParagraphVectorsTest, TokenizerFactory tests, WordVectorSerializer
+tests in deeplearning4j-nlp)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nlp import (
+    BasicLineIterator, CollectionSentenceIterator, CommonPreprocessor,
+    DefaultTokenizerFactory, NGramTokenizerFactory, ParagraphVectors,
+    VocabCache, Word2Vec, WordVectorSerializer,
+)
+
+
+# ----------------------------------------------------------------------
+# synthetic corpus with learnable co-occurrence structure: two "topics"
+# whose words only ever appear together
+# ----------------------------------------------------------------------
+TOPIC_A = ["cat", "dog", "pet", "fur", "tail"]
+TOPIC_B = ["stock", "bond", "market", "trade", "price"]
+
+
+def make_corpus(n=300, seed=0):
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(n):
+        words = TOPIC_A if rng.random() < 0.5 else TOPIC_B
+        out.append(" ".join(rng.choice(words, size=6)))
+    return out
+
+
+class TestTokenization:
+    def test_default_tokenizer(self):
+        tf = DefaultTokenizerFactory()
+        toks = tf.create("the quick  brown fox").getTokens()
+        assert toks == ["the", "quick", "brown", "fox"]
+
+    def test_common_preprocessor(self):
+        tf = DefaultTokenizerFactory()
+        tf.setTokenPreProcessor(CommonPreprocessor())
+        toks = tf.create("Hello, World! 123 foo.bar").getTokens()
+        assert toks == ["hello", "world", "foobar"]
+
+    def test_ngram_tokenizer(self):
+        tf = NGramTokenizerFactory(1, 2)
+        toks = tf.create("a b c").getTokens()
+        assert toks == ["a", "b", "c", "a b", "b c"]
+
+
+class TestSentenceIterators:
+    def test_collection_iterator_reset(self):
+        it = CollectionSentenceIterator(["one", "two"])
+        assert list(it) == ["one", "two"]
+        assert list(it) == ["one", "two"]  # __iter__ resets
+
+    def test_line_iterator(self, tmp_path):
+        p = tmp_path / "corpus.txt"
+        p.write_text("line one\nline two\nline three\n")
+        it = BasicLineIterator(str(p))
+        assert list(it) == ["line one", "line two", "line three"]
+        it.reset()
+        assert it.hasNext()
+        assert it.nextSentence() == "line one"
+
+    def test_preprocessor_applied(self):
+        it = CollectionSentenceIterator(["ABC"])
+        it.setPreProcessor(str.lower)
+        assert list(it) == ["abc"]
+
+
+class TestVocab:
+    def test_build_and_query(self):
+        v = VocabCache()
+        for w in ["a", "a", "a", "b", "b", "c"]:
+            v.addToken(w)
+        v.finalize_vocab(min_word_frequency=2)
+        assert v.numWords() == 2
+        assert v.containsWord("a") and v.containsWord("b")
+        assert not v.containsWord("c")
+        assert v.indexOf("a") == 0  # most frequent first
+        assert v.wordFrequency("a") == 3
+        assert v.wordAtIndex(1) == "b"
+
+
+class TestWord2Vec:
+    def _fit(self, **kw):
+        kw.setdefault("layer_size", 16)
+        kw.setdefault("min_word_frequency", 1)
+        kw.setdefault("window_size", 3)
+        kw.setdefault("epochs", 15)
+        kw.setdefault("learning_rate", 0.05)
+        kw.setdefault("seed", 7)
+        model = Word2Vec(**kw)
+        model.fit(make_corpus())
+        return model
+
+    def test_topic_separation_skipgram(self):
+        m = self._fit()
+        within = m.similarity("cat", "dog")
+        across = m.similarity("cat", "stock")
+        assert within > across + 0.2, (within, across)
+
+    def test_topic_separation_cbow(self):
+        # CBOW cold-starts slower than skip-gram (syn1neg zeros + mean
+        # context): give it more passes over the tiny corpus
+        m = self._fit(use_cbow=True, epochs=50)
+        within = m.similarity("market", "trade")
+        across = m.similarity("market", "fur")
+        assert within > across + 0.2, (within, across)
+
+    def test_words_nearest(self):
+        m = self._fit()
+        near = m.wordsNearest("cat", 4)
+        assert set(near) == set(TOPIC_A) - {"cat"}
+
+    def test_vector_shape_and_vocab(self):
+        m = self._fit()
+        assert m.getWordVector("pet").shape == (16,)
+        assert m.getWordVectorMatrix().shape == (10, 16)
+        assert m.hasWord("bond")
+        with pytest.raises(KeyError):
+            m.getWordVector("zebra")
+
+    def test_builder_parity_surface(self):
+        m = (Word2Vec.builder()
+             .layerSize(8).windowSize(2).minWordFrequency(1)
+             .epochs(1).learningRate(0.05).negativeSample(3)
+             .seed(1)
+             .iterate(CollectionSentenceIterator(make_corpus(50)))
+             .build())
+        m.fit()
+        assert m.getWordVectorMatrix().shape[1] == 8
+
+    def test_min_word_frequency_filters(self):
+        m = Word2Vec(layer_size=8, min_word_frequency=1000)
+        with pytest.raises(ValueError, match="empty vocabulary"):
+            m.fit(make_corpus(10))
+
+
+class TestSerializer:
+    def test_text_roundtrip(self, tmp_path):
+        m = Word2Vec(layer_size=8, min_word_frequency=1, epochs=1, seed=3)
+        m.fit(make_corpus(50))
+        p = str(tmp_path / "vectors.txt")
+        WordVectorSerializer.writeWordVectors(m, p)
+        m2 = WordVectorSerializer.readWordVectors(p)
+        for w in TOPIC_A:
+            np.testing.assert_allclose(m2.getWordVector(w),
+                                       m.getWordVector(w), atol=1e-5)
+        assert m2.wordsNearest("cat", 2) == m.wordsNearest("cat", 2)
+
+    def test_full_model_roundtrip(self, tmp_path):
+        m = Word2Vec(layer_size=8, min_word_frequency=1, epochs=1, seed=3)
+        m.fit(make_corpus(50))
+        p = str(tmp_path / "model.zip")
+        WordVectorSerializer.writeWord2VecModel(m, p)
+        m2 = WordVectorSerializer.readWord2VecModel(p)
+        np.testing.assert_allclose(m2.getWordVectorMatrix(),
+                                   m.getWordVectorMatrix(), atol=1e-6)
+        np.testing.assert_allclose(np.asarray(m2.syn1neg),
+                                   np.asarray(m.syn1neg), atol=1e-6)
+        assert m2.vocab.words() == m.vocab.words()
+
+
+class TestParagraphVectors:
+    def test_doc_clustering_and_inference(self):
+        rng = np.random.default_rng(1)
+        docs = []
+        for i in range(40):
+            words = TOPIC_A if i % 2 == 0 else TOPIC_B
+            label = f"{'A' if i % 2 == 0 else 'B'}_{i}"
+            docs.append((label, " ".join(rng.choice(words, size=8))))
+        # 40 docs x 8 words = ONE batch per epoch — needs many epochs
+        pv = ParagraphVectors(layer_size=16, epochs=150, seed=5,
+                              learning_rate=0.05)
+        pv.fit(docs)
+        assert pv.getVector("A_0").shape == (16,)
+        # an unseen topic-A text should land nearer A docs than B docs
+        near = pv.nearestLabels("cat dog fur pet tail dog", n=6)
+        a_hits = sum(1 for l in near if l.startswith("A"))
+        assert a_hits >= 4, near
+
+    def test_infer_vector_deterministic_tables(self):
+        docs = [("D1", "cat dog pet"), ("D2", "stock bond market")]
+        pv = ParagraphVectors(layer_size=8, epochs=5, seed=5)
+        pv.fit(docs)
+        v = pv.inferVector("cat pet dog")
+        assert v.shape == (8,)
+        assert np.isfinite(v).all()
+
+    def test_unknown_words_give_zero_vector(self):
+        pv = ParagraphVectors(layer_size=8, epochs=1, seed=5)
+        pv.fit([("D1", "cat dog pet")])
+        v = pv.inferVector("zebra unicorn")
+        assert np.allclose(v, 0)
