@@ -124,6 +124,20 @@ def sparse_mcxent(labels, logits):
     return -jnp.take_along_axis(logp, labels[..., None].astype(jnp.int32), axis=-1)[..., 0]
 
 
+# Keras/common spellings users reach for first (the reference accepts
+# these through its Keras-import layer; accept them everywhere)
+_LOSS_ALIASES = {
+    "categorical_crossentropy": "MCXENT",
+    "sparse_categorical_crossentropy": "SPARSE_MCXENT",
+    "binary_crossentropy": "XENT",
+    "mean_squared_error": "MSE",
+    "mean_absolute_error": "MAE",
+    "kld": "KL_DIVERGENCE",
+    "kullback_leibler_divergence": "KL_DIVERGENCE",
+    "nll": "NEGATIVELOGLIKELIHOOD",
+}
+
+
 class LossFunction(enum.Enum):
     """Reference: LossFunctions.LossFunction enum names."""
 
@@ -170,8 +184,15 @@ class LossFunction(enum.Enum):
         if isinstance(l, LossFunction):
             return l
         if isinstance(l, str):
-            return (LossFunction[l.upper()] if l.upper() in LossFunction.__members__
-                    else LossFunction(l.lower()))
+            key = _LOSS_ALIASES.get(l.lower(), l)
+            if key.upper() in LossFunction.__members__:
+                return LossFunction[key.upper()]
+            try:
+                return LossFunction(key.lower())
+            except ValueError:
+                raise ValueError(
+                    f"Unknown loss {l!r}; valid: "
+                    f"{sorted(LossFunction.__members__)}") from None
         raise ValueError(f"Cannot resolve loss: {l!r}")
 
 

@@ -184,7 +184,7 @@ class TransformerEncoder:
             x = self._block(x, lp, att_mask, train, keys[li], sharded)
         return x
 
-    def _block(self, x, lp, att_mask, train, rng, sharded):
+    def _block(self, x, lp, att_mask, train, rng, sharded, attn_fn=None):
         cfg = self.cfg
         cd = self._cdtype
         n, t, d = x.shape
@@ -199,13 +199,19 @@ class TransformerEncoder:
             return y.reshape(n, t, h, hd).transpose(0, 2, 1, 3)
 
         q, k, v = heads(q), heads(k), heads(v)
-        scale = 1.0 / jnp.sqrt(jnp.asarray(hd, cd))
-        logits = jnp.einsum("nhqd,nhkd->nhqk", q, k) * scale
-        if att_mask is not None:
-            neg = jnp.asarray(jnp.finfo(logits.dtype).min, logits.dtype)
-            logits = jnp.where(att_mask.astype(bool), logits, neg)
-        w = jax.nn.softmax(logits, axis=-1)
-        ctx = jnp.einsum("nhqk,nhkd->nhqd", w, v)
+        if attn_fn is not None:
+            # pluggable attention: ring / ulysses / pallas flash.
+            # att_mask must go through the impl (which may need to
+            # rotate it around the ring) — never drop it silently.
+            ctx = attn_fn(q, k, v, att_mask)
+        else:
+            scale = 1.0 / jnp.sqrt(jnp.asarray(hd, cd))
+            logits = jnp.einsum("nhqd,nhkd->nhqk", q, k) * scale
+            if att_mask is not None:
+                neg = jnp.asarray(jnp.finfo(logits.dtype).min, logits.dtype)
+                logits = jnp.where(att_mask.astype(bool), logits, neg)
+            w = jax.nn.softmax(logits, axis=-1)
+            ctx = jnp.einsum("nhqk,nhkd->nhqd", w, v)
         ctx = ctx.transpose(0, 2, 1, 3).reshape(n, t, d)
         att = ctx @ lp["wo"].astype(cd) + lp["bo"].astype(cd)
         if train and rng is not None and cfg.dropout > 0:
@@ -246,6 +252,19 @@ class TransformerEncoder:
         denom = jnp.maximum(jnp.sum(mask_positions), 1.0)
         return -jnp.sum(tok_lp * mask_positions) / denom
 
+    @staticmethod
+    def _apply_updates(updater, params, opt_state, grads, it_step):
+        """Single definition of update application — shared by the
+        GSPMD and ring train steps so updater-policy changes can't
+        drift between them."""
+        from deeplearning4j_tpu.learning.updaters import apply_updater
+
+        updates, new_opt = apply_updater(updater, opt_state, grads,
+                                         params, it_step)
+        new_params = jax.tree_util.tree_map(lambda p, u: p - u,
+                                            params, updates)
+        return new_params, new_opt
+
     def make_train_step(self, updater, mesh: Optional[Mesh] = None):
         """Build the compiled train step; with a mesh, params/opt are
         sharded per param_specs and the batch over 'data'."""
@@ -254,12 +273,8 @@ class TransformerEncoder:
         def step(params, opt_state, it_step, ids, labels, mask_pos, rng):
             loss, grads = jax.value_and_grad(self.mlm_loss)(
                 params, ids, labels, mask_pos, True, rng, sharded)
-            from deeplearning4j_tpu.learning.updaters import apply_updater
-
-            updates, new_opt = apply_updater(updater, opt_state, grads,
-                                             params, it_step)
-            new_params = jax.tree_util.tree_map(lambda p, u: p - u,
-                                                params, updates)
+            new_params, new_opt = self._apply_updates(
+                updater, params, opt_state, grads, it_step)
             return new_params, new_opt, loss
 
         if not sharded:
@@ -284,6 +299,110 @@ class TransformerEncoder:
             in_shardings=(pspec, None, rep, dp, dp, dp, rep),
             donate_argnums=(0, 1),
         )
+
+    # ------------------------------------------------------------------
+    # context parallelism (ring / Ulysses) — DP x SP under shard_map
+    # ------------------------------------------------------------------
+    def _encode_local(self, params, ids, sp_axis, train, rng, attn,
+                      pad_mask=None):
+        """Per-shard encode for shard_map: ids is the LOCAL token shard
+        [Nl, Tl]; position embeddings are offset by this shard's ring
+        index; attention runs via ring/ulysses collectives over sp.
+        pad_mask: LOCAL [Nl, Tl], 1.0 = real token (travels around the
+        ring with its K/V block inside the attention impl)."""
+        from deeplearning4j_tpu.parallel.ring_attention import (
+            ring_attention, ulysses_attention,
+        )
+
+        cfg = self.cfg
+        cd = self._cdtype
+        n, t = ids.shape
+        n_sp = lax.axis_size(sp_axis)  # static inside shard_map
+        if t * n_sp > cfg.max_len:
+            raise ValueError(
+                f"global sequence {t}*{n_sp}={t * n_sp} exceeds "
+                f"max_len={cfg.max_len}; dynamic_slice would clamp and "
+                f"silently reuse positions")
+        sp = lax.axis_index(sp_axis)
+        x = params["tok_emb"].astype(cd)[ids]
+        pos = lax.dynamic_slice_in_dim(params["pos_emb"].astype(cd),
+                                       sp * t, t, axis=0)
+        x = x + pos[None]
+        x = self._ln(x, {k: v.astype(cd)
+                         for k, v in params["emb_ln"].items()})
+
+        base = (ring_attention if attn == "ring" else ulysses_attention)
+
+        def attn_fn(q, k, v, att_mask):
+            assert att_mask is None  # padding travels as kv_mask instead
+            return base(q, k, v, axis_name=sp_axis, kv_mask=pad_mask)
+        keys = (jax.random.split(rng, cfg.n_layers)
+                if (train and rng is not None) else [None] * cfg.n_layers)
+        for li, lp in enumerate(params["layers"]):
+            x = self._block(x, lp, None, train, keys[li], False,
+                            attn_fn=attn_fn)
+        return x
+
+    def make_ring_train_step(self, updater, mesh: Mesh, attn: str = "ring"):
+        """Compiled DP x SP (context-parallel) MLM train step.
+
+        mesh must have axes ('data', 'sp'). Params are replicated; the
+        batch is sharded over 'data' and the TOKEN axis over 'sp' —
+        each device holds [N/dp, T/sp] and attention streams K/V blocks
+        around the sp ring (or all-to-alls heads for attn='ulysses').
+        The reference has no such capability (SURVEY.md §5); this is the
+        long-context path. Gradients psum over both axes.
+        """
+        from deeplearning4j_tpu.parallel.mesh import shard_map
+
+        if attn not in ("ring", "ulysses"):
+            raise ValueError(f"attn must be ring|ulysses: {attn}")
+
+        def per_shard_grads(params, ids, labels, mask_pos, pad_mask, rng):
+            # distinct dropout streams per shard
+            rng = jax.random.fold_in(rng, lax.axis_index("data"))
+            rng = jax.random.fold_in(rng, lax.axis_index("sp"))
+
+            def local_loss(p):
+                hidden = self._encode_local(p, ids, "sp", True, rng, attn,
+                                            pad_mask=pad_mask)
+                logits = self.mlm_logits(p, hidden).astype(jnp.float32)
+                logp = jax.nn.log_softmax(logits, axis=-1)
+                tok_lp = jnp.take_along_axis(
+                    logp, labels[..., None], axis=-1)[..., 0]
+                num = lax.psum(jnp.sum(tok_lp * mask_pos), ("data", "sp"))
+                den = lax.psum(jnp.sum(mask_pos), ("data", "sp"))
+                return -num / jnp.maximum(den, 1.0)
+
+            loss, grads = jax.value_and_grad(local_loss)(params)
+            grads = lax.psum(grads, ("data", "sp"))
+            return loss, grads
+
+        dp_sp = P("data", "sp")
+        rep = P()
+
+        def step(params, opt_state, it_step, ids, labels, mask_pos, rng,
+                 pad_mask=None):
+            if pad_mask is None:  # static branch: None never traces
+                smapped = shard_map(
+                    lambda p, i, l, m, r: per_shard_grads(p, i, l, m,
+                                                          None, r),
+                    mesh=mesh,
+                    in_specs=(rep, dp_sp, dp_sp, dp_sp, rep),
+                    out_specs=(rep, rep), check_rep=False)
+                loss, grads = smapped(params, ids, labels, mask_pos, rng)
+            else:
+                smapped = shard_map(
+                    per_shard_grads, mesh=mesh,
+                    in_specs=(rep, dp_sp, dp_sp, dp_sp, dp_sp, rep),
+                    out_specs=(rep, rep), check_rep=False)
+                loss, grads = smapped(params, ids, labels, mask_pos,
+                                      pad_mask, rng)
+            new_params, new_opt = self._apply_updates(
+                updater, params, opt_state, grads, it_step)
+            return new_params, new_opt, loss
+
+        return jax.jit(step, donate_argnums=(0, 1))
 
     def shard_params(self, params, mesh: Mesh):
         specs = self.param_specs()

@@ -18,6 +18,17 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+try:  # canonical import point: jax.shard_map landed in 0.8
+    from jax import shard_map as _jax_shard_map
+
+    def shard_map(f, **kw):
+        # accept the older check_rep spelling everywhere in this codebase
+        if "check_rep" in kw:
+            kw["check_vma"] = kw.pop("check_rep")
+        return _jax_shard_map(f, **kw)
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map  # noqa: F401
+
 
 def build_mesh(num_data: Optional[int] = None, num_model: int = 1,
                devices: Optional[Sequence] = None) -> Mesh:

@@ -32,7 +32,7 @@ from typing import Any, Optional
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax.experimental.shard_map import shard_map
+from deeplearning4j_tpu.parallel.mesh import shard_map
 
 from deeplearning4j_tpu.datasets.dataset import DataSet
 from deeplearning4j_tpu.datasets.iterator import DataSetIterator
