@@ -11,6 +11,7 @@ reference's Jackson type info. Round-trip is a hard API contract:
 from __future__ import annotations
 
 import dataclasses
+import enum as _enum
 import json
 from typing import Any, Dict, Type
 
@@ -40,6 +41,10 @@ def to_dict(obj: Any) -> Any:
         return {k: to_dict(v) for k, v in obj.items()}
     if isinstance(obj, (list, tuple)):
         return [to_dict(v) for v in obj]
+    if isinstance(obj, _enum.Enum):
+        # enums serialize by value (reference: Jackson enum handling);
+        # configs resolve the value back (e.g. PoolingType("max"))
+        return obj.value
     return obj
 
 

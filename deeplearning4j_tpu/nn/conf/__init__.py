@@ -5,7 +5,8 @@ types/preprocessors, with guaranteed JSON round-trip. SURVEY.md §2.18).
 
 from deeplearning4j_tpu.nn.conf.inputs import InputType
 from deeplearning4j_tpu.nn.conf.layers import (
-    ActivationLayer, BatchNormalization, ConvolutionLayer, DenseLayer,
+    ActivationLayer, BatchNormalization, Bidirectional, ConvolutionLayer,
+    DenseLayer,
     DropoutLayer, EmbeddingLayer, EmbeddingSequenceLayer, GlobalPoolingLayer, GravesLSTM, LSTM,
     Layer, LossLayer, OutputLayer, PoolingType, RnnOutputLayer,
     SubsamplingLayer, SeparableConvolution2D, Upsampling2D, ZeroPaddingLayer,
@@ -16,7 +17,7 @@ from deeplearning4j_tpu.nn.conf.builder import (
 )
 
 __all__ = [
-    "InputType", "Layer", "DenseLayer", "ConvolutionLayer",
+    "InputType", "Layer", "Bidirectional", "DenseLayer", "ConvolutionLayer",
     "SubsamplingLayer", "BatchNormalization", "OutputLayer", "LossLayer",
     "DropoutLayer", "ActivationLayer", "EmbeddingLayer",
     "EmbeddingSequenceLayer",

@@ -20,7 +20,8 @@ from deeplearning4j_tpu.common.serde import serializable
 from deeplearning4j_tpu.learning.updaters import IUpdater, Sgd
 from deeplearning4j_tpu.nn.conf.inputs import InputType
 from deeplearning4j_tpu.nn.conf.layers import (
-    ConvolutionLayer, DenseLayer, EmbeddingLayer, Layer, LastTimeStep, LSTM,
+    Bidirectional, ConvolutionLayer, DenseLayer, EmbeddingLayer, Layer,
+    LastTimeStep, LSTM,
     SimpleRnn, SubsamplingLayer, SelfAttentionLayer, Upsampling2D,
     ZeroPaddingLayer, LocalResponseNormalization, GravesLSTM, RnnOutputLayer,
 )
@@ -132,6 +133,9 @@ class ListBuilder:
         self._p = parent
         self._layers: List[Layer] = []
         self._input_type: Optional[InputType] = None
+        self._backprop_type = None   # None = infer from tBPTT lengths
+        self._tbptt_fwd = 0
+        self._tbptt_back = 0
 
     def layer(self, *args) -> "ListBuilder":
         """layer(conf) or layer(index, conf) — both reference forms."""
@@ -142,6 +146,24 @@ class ListBuilder:
     def setInputType(self, it: InputType) -> "ListBuilder":
         self._input_type = it
         return self
+
+    # -- truncated BPTT (reference: ListBuilder#backpropType +
+    # tBPTTForwardLength/tBPTTBackwardLength, SURVEY.md §5) -------------
+    def backpropType(self, bp_type: str) -> "ListBuilder":
+        """'Standard' or 'TruncatedBPTT' (tBPTT needs tBPTTLength too)."""
+        self._backprop_type = str(bp_type)
+        return self
+
+    def tBPTTForwardLength(self, n: int) -> "ListBuilder":
+        self._tbptt_fwd = int(n)
+        return self
+
+    def tBPTTBackwardLength(self, n: int) -> "ListBuilder":
+        self._tbptt_back = int(n)
+        return self
+
+    def tBPTTLength(self, n: int) -> "ListBuilder":
+        return self.tBPTTForwardLength(n).tBPTTBackwardLength(n)
 
     def inputType(self, it: InputType) -> "ListBuilder":
         return self.setInputType(it)
@@ -161,17 +183,21 @@ class ListBuilder:
         it = self._input_type
 
         for i, layer in enumerate(layers):
-            # inherit global defaults (reference: config cloning)
-            if layer.activation is None and p._activation is not None:
-                layer.activation = p._activation
-            if layer.weight_init is None:
-                layer.weight_init = p._weight_init
-            if layer.l1 is None:
-                layer.l1 = p._l1
-            if layer.l2 is None:
-                layer.l2 = p._l2
-            if layer.dropout is None and p._dropout is not None:
-                layer.dropout = p._dropout
+            # inherit global defaults (reference: config cloning); for
+            # Bidirectional the wrapped layer holds the real conf
+            targets = [layer] + ([layer.layer]
+                                 if isinstance(layer, Bidirectional) else [])
+            for lt in targets:
+                if lt.activation is None and p._activation is not None:
+                    lt.activation = p._activation
+                if lt.weight_init is None:
+                    lt.weight_init = p._weight_init
+                if lt.l1 is None:
+                    lt.l1 = p._l1
+                if lt.l2 is None:
+                    lt.l2 = p._l2
+                if lt.dropout is None and p._dropout is not None:
+                    lt.dropout = p._dropout
 
             if it is None:
                 continue  # no shape inference possible; user set n_in
@@ -188,7 +214,7 @@ class ListBuilder:
                     raise ValueError(
                         f"Layer {i} ({type(layer).__name__}) needs image input, got {it.kind}")
             elif isinstance(layer, (LSTM, SimpleRnn, SelfAttentionLayer,
-                                    GravesLSTM, LastTimeStep)) \
+                                    GravesLSTM, LastTimeStep, Bidirectional)) \
                     or isinstance(layer, RnnOutputLayer):
                 if it.kind not in ("recurrent",):
                     raise ValueError(
@@ -200,10 +226,11 @@ class ListBuilder:
                 elif it.kind == "convolutionalFlat":
                     it = InputType.feedForward(it.flat_size())
 
-            # nIn inference (unwrap LastTimeStep to reach the recurrent
-            # layer that actually holds n_in)
+            # nIn inference (unwrap LastTimeStep/Bidirectional to reach
+            # the recurrent layer that actually holds n_in)
             target = layer.underlying if isinstance(layer, LastTimeStep) \
-                else layer
+                else (layer.layer if isinstance(layer, Bidirectional)
+                      else layer)
             if hasattr(target, "n_in") and getattr(target, "n_in", 0) in (0, None) \
                     and not isinstance(target, EmbeddingLayer):
                 if it.kind == "convolutional":
@@ -215,6 +242,24 @@ class ListBuilder:
                 layer.n_out = layer.n_in
 
             it = layer.output_type(it)
+
+        # tBPTT resolution: explicit backpropType wins; setting a length
+        # without backpropType implies TruncatedBPTT; TruncatedBPTT with
+        # no length uses the reference default of 20.
+        if self._backprop_type == "Standard":
+            tbptt_fwd = 0
+        elif self._backprop_type == "TruncatedBPTT":
+            tbptt_fwd = self._tbptt_fwd or 20
+        else:
+            tbptt_fwd = self._tbptt_fwd
+        tbptt_back = self._tbptt_back or tbptt_fwd
+        if tbptt_fwd and tbptt_back != tbptt_fwd:
+            import warnings
+            warnings.warn(
+                "tBPTTBackwardLength != tBPTTForwardLength is not supported "
+                "on the compiled tBPTT path (backward length follows the "
+                f"segment length {tbptt_fwd}); configured {tbptt_back} is "
+                "recorded but has no effect", stacklevel=2)
 
         return MultiLayerConfiguration(
             layers=layers,
@@ -228,6 +273,8 @@ class ListBuilder:
             preprocessors=preprocessors,
             gradient_normalization=p._grad_norm,
             gradient_normalization_threshold=p._grad_norm_threshold,
+            tbptt_fwd_length=tbptt_fwd,
+            tbptt_back_length=tbptt_back,
         )
 
 

@@ -72,6 +72,20 @@ class Layer:
     def apply(self, params, state, x, train: bool, rng):
         raise NotImplementedError
 
+    # -- recurrent-state API (reference: BaseRecurrentLayer#rnnTimeStep /
+    # rnnActivateUsingStoredState; non-recurrent layers are stateless) ---
+    is_recurrent = False  # class attr, not a field (keeps JSON serde clean)
+
+    def init_carry(self, batch: int, dtype):
+        """Initial hidden carry for stateful stepping / tBPTT."""
+        return None
+
+    def apply_with_carry(self, params, state, carry, x, train, rng):
+        """Like apply(), but threads the recurrent hidden state.
+        Returns (out, new_state, new_carry)."""
+        out, ns = self.apply(params, state, x, train, rng)
+        return out, ns, carry
+
     # -- shared helpers -------------------------------------------------
     def _maybe_dropout(self, x, train, rng):
         if train and self.dropout and rng is not None:
@@ -291,6 +305,18 @@ class LastTimeStep(Layer):
     def apply(self, params, state, x, train, rng):
         out, st = self.underlying.apply(params, state, x, train, rng)
         return out[:, -1, :], st
+
+    @property
+    def is_recurrent(self):
+        return self.underlying is not None and self.underlying.is_recurrent
+
+    def init_carry(self, batch, dtype):
+        return self.underlying.init_carry(batch, dtype)
+
+    def apply_with_carry(self, params, state, carry, x, train, rng):
+        out, st, c = self.underlying.apply_with_carry(
+            params, state, carry, x, train, rng)
+        return out[:, -1, :], st, c
 
 
 # ----------------------------------------------------------------------
@@ -603,11 +629,26 @@ class LSTM(Layer):
         b = b.at[h:2 * h].set(self.forget_gate_bias_init)
         return {"W": w, "RW": rw, "b": b}
 
+    is_recurrent = True
+
     def apply(self, params, state, x, train, rng):
         x = self._maybe_dropout(x, train, rng)
         ys, _ = nnops.lstm_layer(x, params["W"], params["RW"], params["b"])
         act = self.activation
         return (_act(act).fn(ys) if act and act != "tanh" else ys), state
+
+    def init_carry(self, batch, dtype):
+        h = self.n_out
+        return (jnp.zeros((batch, h), dtype), jnp.zeros((batch, h), dtype))
+
+    def apply_with_carry(self, params, state, carry, x, train, rng):
+        x = self._maybe_dropout(x, train, rng)
+        ys, new_carry = nnops.lstm_layer(
+            x, params["W"], params["RW"], params["b"],
+            h0=carry[0], c0=carry[1])
+        act = self.activation
+        ys = _act(act).fn(ys) if act and act != "tanh" else ys
+        return ys, state, new_carry
 
 
 @serializable
@@ -635,9 +676,85 @@ class SimpleRnn(Layer):
                           (self.n_out, self.n_out), self.n_out, self.n_out, dtype)
         return {"W": w, "RW": rw, "b": jnp.zeros((self.n_out,), dtype)}
 
+    is_recurrent = True
+
     def apply(self, params, state, x, train, rng):
         ys, _ = nnops.simple_rnn_layer(x, params["W"], params["RW"], params["b"])
         return ys, state
+
+    def init_carry(self, batch, dtype):
+        return jnp.zeros((batch, self.n_out), dtype)
+
+    def apply_with_carry(self, params, state, carry, x, train, rng):
+        ys, new_carry = nnops.simple_rnn_layer(
+            x, params["W"], params["RW"], params["b"], h0=carry)
+        return ys, state, new_carry
+
+
+@serializable
+@dataclasses.dataclass
+class Bidirectional(Layer):
+    """Bidirectional RNN wrapper (reference:
+    conf/layers/recurrent/Bidirectional.java — wraps any recurrent layer
+    with independent forward/backward copies, merged by Mode).
+
+    TPU design: both directions are independent lax.scans over the same
+    time-batched input projection; XLA schedules them concurrently. The
+    backward direction runs the wrapped layer on the time-reversed input
+    and un-reverses the output, so ANY recurrent layer conf works
+    unmodified. Stateful stepping (rnnTimeStep) is unsupported, matching
+    the reference (bidirectional needs the full sequence).
+    """
+
+    layer: Optional[Layer] = None
+    mode: str = "CONCAT"  # CONCAT | ADD | MUL | AVERAGE
+
+    is_recurrent = True
+
+    @property
+    def n_out(self):
+        n = self.layer.n_out
+        return 2 * n if self.mode.upper() == "CONCAT" else n
+
+    @property
+    def n_in(self):
+        return self.layer.n_in
+
+    @n_in.setter
+    def n_in(self, v):
+        self.layer.n_in = v
+
+    def output_type(self, it: InputType) -> InputType:
+        return InputType.recurrent(self.n_out, it.timeseries_length)
+
+    def init_params(self, key, it, dtype) -> dict:
+        k1, k2 = jax.random.split(key)
+        return {"fw": self.layer.init_params(k1, it, dtype),
+                "bw": self.layer.init_params(k2, it, dtype)}
+
+    def init_state(self, it, dtype) -> dict:
+        return {}
+
+    def apply(self, params, state, x, train, rng):
+        yf, _ = self.layer.apply(params["fw"], {}, x, train, rng)
+        yb, _ = self.layer.apply(params["bw"], {}, jnp.flip(x, axis=1),
+                                 train, rng)
+        yb = jnp.flip(yb, axis=1)
+        m = self.mode.upper()
+        if m == "CONCAT":
+            return jnp.concatenate([yf, yb], axis=-1), state
+        if m == "ADD":
+            return yf + yb, state
+        if m == "MUL":
+            return yf * yb, state
+        if m == "AVERAGE":
+            return 0.5 * (yf + yb), state
+        raise ValueError(f"Unknown Bidirectional mode: {self.mode}")
+
+    def init_carry(self, batch, dtype):
+        raise NotImplementedError(
+            "rnnTimeStep is not supported for Bidirectional layers "
+            "(reference behavior: requires the full sequence)")
 
 
 @serializable

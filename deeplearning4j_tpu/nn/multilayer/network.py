@@ -68,6 +68,8 @@ class MultiLayerNetwork:
         self._rng_key = None
         self._step_cache: dict = {}
         self._fwd_cache: dict = {}
+        self._rnn_carries = None    # stateful rnnTimeStep hidden state
+        self._rnn_batch = 0
         self._dtype = DataType.from_any(conf.dtype).jax
 
     # ------------------------------------------------------------------
@@ -152,17 +154,37 @@ class MultiLayerNetwork:
 
     def _loss(self, params_list, states_list, x, y, mask, rng):
         """Forward to the loss head; fused stable loss on pre-activations."""
+        loss, (new_states, data_loss, _) = self._loss_carries(
+            params_list, states_list, None, x, y, mask, rng)
+        return loss, (new_states, data_loss)
+
+    def _loss_carries(self, params_list, states_list, carries, x, y, mask,
+                      rng):
+        """Loss forward threading recurrent hidden state (tBPTT path:
+        reference MultiLayerNetwork#doTruncatedBPTT keeps each layer's
+        rnnTimeStep state across segments; gradient truncation falls out
+        of the carries entering the jitted segment step as inputs)."""
         conf = self.conf
         a = x
         new_states = []
+        new_carries = []
         keys = (jax.random.split(rng, len(conf.layers))
                 if rng is not None else [None] * len(conf.layers))
         for i, layer in enumerate(conf.layers[:-1]):
             tag = conf.preprocessors.get(i)
             if tag:
                 a = apply_preprocessor(tag, a)
-            a, ns = layer.apply(params_list[i], states_list[i], a, True, keys[i])
+            if carries is not None and layer.is_recurrent:
+                a, ns, c = layer.apply_with_carry(
+                    params_list[i], states_list[i], carries[i], a, True,
+                    keys[i])
+            else:
+                a, ns = layer.apply(params_list[i], states_list[i], a, True,
+                                    keys[i])
+                c = None
             new_states.append(ns)
+            new_carries.append(c)
+        new_carries.append(None)  # loss head is never recurrent
         last = conf.layers[-1]
         if not isinstance(last, (OutputLayer, LossLayer)):
             raise ValueError("Last layer must be an OutputLayer/LossLayer to fit()")
@@ -185,7 +207,7 @@ class MultiLayerNetwork:
                         reg = reg + l1 * jnp.sum(jnp.abs(v))
                     if l2:
                         reg = reg + 0.5 * l2 * jnp.sum(v * v)
-        return data_loss + reg, (new_states, data_loss)
+        return data_loss + reg, (new_states, data_loss, new_carries)
 
     def _clip_grads(self, grads_list):
         mode = self.conf.gradient_normalization
@@ -238,6 +260,37 @@ class MultiLayerNetwork:
         self._step_cache[has_mask] = jitted
         return jitted
 
+    def _get_tbptt_step(self, has_mask: bool) -> Callable:
+        """Compiled tBPTT segment step: one param update per segment,
+        recurrent state carried between segments (reference:
+        MultiLayerNetwork#doTruncatedBPTT). Gradients stop at segment
+        boundaries because carries enter the jitted step as plain inputs
+        (tbptt_back_length == tbptt_fwd_length by construction here)."""
+        key = ("tbptt", has_mask)
+        if key in self._step_cache:
+            return self._step_cache[key]
+
+        def step_fn(params_list, states_list, opt_states, carries, it_step,
+                    ep_step, x, y, mask, rng):
+            loss_fn = lambda pl: self._loss_carries(
+                pl, states_list, carries, x, y, mask, rng)
+            (loss, (new_states, data_loss, new_carries)), grads = \
+                jax.value_and_grad(loss_fn, has_aux=True)(params_list)
+            grads = self._clip_grads(grads)
+            new_params, new_opt = [], []
+            for i in range(len(params_list)):
+                step = ep_step if _uses_epoch_schedule(self._updaters[i]) else it_step
+                updates, no = apply_updater(self._updaters[i], opt_states[i],
+                                            grads[i], params_list[i], step)
+                new_params.append(jax.tree_util.tree_map(
+                    lambda p, u: p - u, params_list[i], updates))
+                new_opt.append(no)
+            return new_params, new_states, new_opt, new_carries, data_loss
+
+        jitted = jax.jit(step_fn, donate_argnums=(0, 1, 2, 3))
+        self._step_cache[key] = jitted
+        return jitted
+
     def _get_forward(self, train: bool) -> Callable:
         if train in self._fwd_cache:
             return self._fwd_cache[train]
@@ -273,9 +326,13 @@ class MultiLayerNetwork:
     def _fit_batch(self, x, y, mask):
         x = jnp.asarray(_unwrap(x), self._dtype)
         y = jnp.asarray(_unwrap(y))
+        m = jnp.asarray(mask) if mask is not None else None
+        k = self.conf.tbptt_fwd_length
+        if (k and x.ndim == 3 and x.shape[1] > k
+                and any(l.is_recurrent for l in self.conf.layers)):
+            return self._fit_tbptt(x, y, m, k)
         self._rng_key, sub = jax.random.split(self._rng_key)
         step_fn = self._get_train_step(mask is not None)
-        m = jnp.asarray(mask) if mask is not None else None
         (self.params_list, self.states_list, self.opt_states, loss) = step_fn(
             self.params_list, self.states_list, self.opt_states,
             jnp.asarray(self._iteration), jnp.asarray(self._epoch), x, y, m, sub)
@@ -283,6 +340,41 @@ class MultiLayerNetwork:
         self._iteration += 1
         for l in self._listeners:
             l.iterationDone(self, self._iteration, self._epoch)
+
+    def _fit_tbptt(self, x, y, mask, k: int):
+        """Truncated BPTT over the time axis (reference:
+        MultiLayerNetwork#doTruncatedBPTT — split [N,T,*] into length-k
+        segments, update params per segment, carry RNN state forward,
+        reset state at the start of each minibatch)."""
+        if y.ndim < 3:
+            raise ValueError(
+                "tBPTT requires per-timestep labels [N,T,C] "
+                "(use RnnOutputLayer)")
+        n, t = x.shape[0], x.shape[1]
+        try:
+            carries = [
+                (l.init_carry(n, self._dtype) if l.is_recurrent else None)
+                for l in self.conf.layers]
+        except NotImplementedError:
+            raise ValueError(
+                "Truncated BPTT is not supported with Bidirectional layers "
+                "(the backward direction needs the full sequence) — use "
+                "standard BPTT") from None
+        step_fn = self._get_tbptt_step(mask is not None)
+        for t0 in range(0, t, k):
+            xc = x[:, t0:t0 + k]
+            yc = y[:, t0:t0 + k]
+            mc = mask[:, t0:t0 + k] if mask is not None else None
+            self._rng_key, sub = jax.random.split(self._rng_key)
+            (self.params_list, self.states_list, self.opt_states, carries,
+             loss) = step_fn(
+                self.params_list, self.states_list, self.opt_states, carries,
+                jnp.asarray(self._iteration), jnp.asarray(self._epoch),
+                xc, yc, mc, sub)
+            self._score = float(loss)
+            self._iteration += 1
+            for l in self._listeners:
+                l.iterationDone(self, self._iteration, self._epoch)
 
     # ------------------------------------------------------------------
     # inference / scoring
@@ -313,6 +405,77 @@ class MultiLayerNetwork:
                                False, None)
             acts.append(NDArray(a))
         return acts
+
+    # ------------------------------------------------------------------
+    # stateful RNN stepping (reference: MultiLayerNetwork#rnnTimeStep,
+    # rnnClearPreviousState, rnnGetPreviousState — SURVEY.md §5)
+    # ------------------------------------------------------------------
+    def _rnn_step_forward(self, params_list, states_list, carries, x):
+        conf = self.conf
+        a = x
+        new_carries = []
+        for i, layer in enumerate(conf.layers):
+            tag = conf.preprocessors.get(i)
+            if tag:
+                a = apply_preprocessor(tag, a)
+            if layer.is_recurrent:
+                a, _, c = layer.apply_with_carry(
+                    params_list[i], states_list[i], carries[i], a, False,
+                    None)
+            else:
+                a, _ = layer.apply(params_list[i], states_list[i], a, False,
+                                   None)
+                c = None
+            new_carries.append(c)
+        return a, new_carries
+
+    def rnnTimeStep(self, x) -> NDArray:
+        """One (or more) timesteps of stateful inference: hidden state is
+        kept across calls so long sequences can be generated step by step
+        without re-running history. 2-D input [N,F] means a single step
+        and returns [N,out]; 3-D [N,T,F] steps T times, returns [N,T,out]."""
+        self._check_init()
+        xj = jnp.asarray(_unwrap(x), self._dtype)
+        single = xj.ndim == 2
+        if single:
+            xj = xj[:, None, :]
+        n = xj.shape[0]
+        if self._rnn_carries is not None and self._rnn_batch != n:
+            raise ValueError(
+                f"rnnTimeStep batch size changed ({self._rnn_batch} -> {n}) "
+                "with stored state — call rnnClearPreviousState() first "
+                "(reference behavior: mini-batch mismatch is an error)")
+        if self._rnn_carries is None:
+            self._rnn_carries = [
+                (l.init_carry(n, self._dtype) if l.is_recurrent else None)
+                for l in self.conf.layers]
+            self._rnn_batch = n
+        if "rnn_step" not in self._fwd_cache:
+            self._fwd_cache["rnn_step"] = jax.jit(self._rnn_step_forward)
+        out, self._rnn_carries = self._fwd_cache["rnn_step"](
+            self.params_list, self.states_list, self._rnn_carries, xj)
+        if single and out.ndim == 3:
+            out = out[:, 0]
+        return NDArray(out)
+
+    rnn_time_step = rnnTimeStep
+
+    def rnnClearPreviousState(self) -> None:
+        self._rnn_carries = None
+        self._rnn_batch = 0
+
+    def rnnGetPreviousState(self, layer_idx: int):
+        """Stored hidden state of one layer (LSTM: (h, c); SimpleRnn: h),
+        or None if stateless / no step taken yet."""
+        if self._rnn_carries is None:
+            return None
+        return self._rnn_carries[layer_idx]
+
+    def rnnSetPreviousState(self, layer_idx: int, state) -> None:
+        if self._rnn_carries is None:
+            raise RuntimeError("No rnnTimeStep state yet — step once or "
+                               "set all layers explicitly")
+        self._rnn_carries[layer_idx] = state
 
     def score(self, dataset: Optional[DataSet] = None) -> float:
         """Last minibatch loss, or loss on a provided DataSet."""

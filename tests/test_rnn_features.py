@@ -1,0 +1,196 @@
+"""RNN completeness: Bidirectional, rnnTimeStep stateful stepping, tBPTT.
+
+Reference behaviors mirrored (SURVEY.md §5 long-context):
+- conf/layers/recurrent/Bidirectional.java (CONCAT/ADD/MUL/AVERAGE)
+- MultiLayerNetwork#rnnTimeStep / rnnClearPreviousState
+- MultiLayerNetwork#doTruncatedBPTT (segment updates, carried state)
+"""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn.conf import (
+    Bidirectional, InputType, LSTM, MultiLayerConfiguration,
+    NeuralNetConfiguration, RnnOutputLayer,
+)
+from deeplearning4j_tpu.nn.conf.layers import SimpleRnn
+from deeplearning4j_tpu.nn.multilayer.network import MultiLayerNetwork
+from deeplearning4j_tpu.learning.updaters import Sgd
+
+
+def _rnn_net(layer, n_out=3, seed=7, **list_kwargs):
+    lb = (NeuralNetConfiguration.builder().seed(seed).updater(Sgd(0.1))
+          .list()
+          .layer(layer)
+          .layer(RnnOutputLayer(n_in=None, n_out=n_out, activation="softmax",
+                                loss="mcxent"))
+          .setInputType(InputType.recurrent(4)))
+    for k, v in list_kwargs.items():
+        getattr(lb, k)(v)
+    net = MultiLayerNetwork(lb.build()).init()
+    return net
+
+
+class TestBidirectional:
+    def test_concat_shape(self):
+        net = _rnn_net(Bidirectional(layer=LSTM(n_out=5)))
+        x = np.random.RandomState(0).randn(2, 6, 4).astype(np.float32)
+        out = net.output(x).toNumpy()
+        assert out.shape == (2, 6, 3)
+        # concat doubles the hidden width feeding the output layer
+        assert net.params_list[1]["W"].shape[0] == 10
+
+    @pytest.mark.parametrize("mode", ["ADD", "MUL", "AVERAGE"])
+    def test_elementwise_modes(self, mode):
+        net = _rnn_net(Bidirectional(layer=LSTM(n_out=5), mode=mode))
+        assert net.params_list[1]["W"].shape[0] == 5
+        x = np.random.RandomState(0).randn(2, 6, 4).astype(np.float32)
+        out = net.output(x).toNumpy()
+        assert out.shape == (2, 6, 3)
+        assert np.isfinite(out).all()
+
+    def test_forward_direction_matches_unidirectional(self):
+        """The fw half of a CONCAT bidirectional equals the plain LSTM
+        run with the same params."""
+        import jax.numpy as jnp
+        bi = Bidirectional(layer=LSTM(n_in=4, n_out=5, weight_init="xavier"))
+        import jax
+        params = bi.init_params(jax.random.key(0), InputType.recurrent(4),
+                                jnp.float32)
+        x = jnp.asarray(np.random.RandomState(1).randn(2, 6, 4),
+                        jnp.float32)
+        y_bi, _ = bi.apply(params, {}, x, False, None)
+        y_uni, _ = bi.layer.apply(params["fw"], {}, x, False, None)
+        np.testing.assert_allclose(np.asarray(y_bi[..., :5]),
+                                   np.asarray(y_uni), rtol=1e-5, atol=1e-6)
+
+    def test_json_roundtrip(self):
+        net = _rnn_net(Bidirectional(layer=LSTM(n_out=5), mode="ADD"))
+        js = net.conf.to_json()
+        cfg2 = MultiLayerConfiguration.from_json(js)
+        assert isinstance(cfg2.layers[0], Bidirectional)
+        assert cfg2.layers[0].mode == "ADD"
+        assert cfg2.layers[0].layer.n_out == 5
+
+    def test_rnn_time_step_rejected(self):
+        net = _rnn_net(Bidirectional(layer=LSTM(n_out=5)))
+        x = np.zeros((2, 4), np.float32)
+        with pytest.raises(NotImplementedError):
+            net.rnnTimeStep(x)
+
+
+class TestRnnTimeStep:
+    @pytest.mark.parametrize("layer", [LSTM(n_out=5), SimpleRnn(n_out=5)])
+    def test_stepwise_matches_full_sequence(self, layer):
+        net = _rnn_net(layer)
+        x = np.random.RandomState(3).randn(2, 5, 4).astype(np.float32)
+        full = net.output(x).toNumpy()              # [2, 5, 3]
+        steps = [net.rnnTimeStep(x[:, t]).toNumpy() for t in range(5)]
+        np.testing.assert_allclose(np.stack(steps, axis=1), full,
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_3d_chunked_stepping(self):
+        net = _rnn_net(LSTM(n_out=5))
+        x = np.random.RandomState(4).randn(2, 6, 4).astype(np.float32)
+        full = net.output(x).toNumpy()
+        a = net.rnnTimeStep(x[:, :2]).toNumpy()
+        b = net.rnnTimeStep(x[:, 2:]).toNumpy()
+        np.testing.assert_allclose(np.concatenate([a, b], axis=1), full,
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_clear_resets_state(self):
+        net = _rnn_net(LSTM(n_out=5))
+        x = np.random.RandomState(5).randn(2, 4).astype(np.float32)
+        first = net.rnnTimeStep(x).toNumpy()
+        second = net.rnnTimeStep(x).toNumpy()       # state carried → differs
+        assert not np.allclose(first, second)
+        net.rnnClearPreviousState()
+        again = net.rnnTimeStep(x).toNumpy()
+        np.testing.assert_allclose(again, first, rtol=1e-6)
+
+    def test_get_set_state(self):
+        net = _rnn_net(LSTM(n_out=5))
+        x = np.random.RandomState(6).randn(2, 4).astype(np.float32)
+        net.rnnTimeStep(x)
+        st = net.rnnGetPreviousState(0)
+        assert st is not None and len(st) == 2     # (h, c)
+        out_before = net.rnnTimeStep(x).toNumpy()
+        net.rnnSetPreviousState(0, st)
+        out_after = net.rnnTimeStep(x).toNumpy()
+        np.testing.assert_allclose(out_after, out_before, rtol=1e-6)
+
+
+class TestTbptt:
+    def _data(self, n=4, t=12, f=4, c=3, seed=0):
+        rs = np.random.RandomState(seed)
+        x = rs.randn(n, t, f).astype(np.float32)
+        y = np.eye(c, dtype=np.float32)[rs.randint(0, c, size=(n, t))]
+        return x, y
+
+    def test_segment_iteration_count(self):
+        net = _rnn_net(LSTM(n_out=5), tBPTTLength=4)
+        assert net.conf.tbptt_fwd_length == 4
+        x, y = self._data(t=12)
+        net.fit(x, y)
+        # 12 steps / 4 per segment = 3 updater applications
+        assert net.getIterationCount() == 3
+
+    def test_learning_happens(self):
+        net = _rnn_net(LSTM(n_out=8), tBPTTLength=4)
+        x, y = self._data(t=8)
+        losses = []
+        for _ in range(15):
+            net.fit(x, y)
+            losses.append(net.score())
+        assert losses[-1] < losses[0]
+        assert np.isfinite(losses).all()
+
+    def test_partial_last_segment(self):
+        net = _rnn_net(LSTM(n_out=5), tBPTTLength=5)
+        x, y = self._data(t=12)           # 5 + 5 + 2
+        net.fit(x, y)
+        assert net.getIterationCount() == 3
+
+    def test_matches_standard_bptt_when_t_below_k(self):
+        """T <= k must take the standard (untruncated) path."""
+        net = _rnn_net(LSTM(n_out=5), tBPTTLength=16)
+        x, y = self._data(t=8)
+        net.fit(x, y)
+        assert net.getIterationCount() == 1
+
+    def test_builder_backprop_type(self):
+        lb = (NeuralNetConfiguration.builder().list()
+              .layer(LSTM(n_in=4, n_out=5))
+              .layer(RnnOutputLayer(n_out=3, activation="softmax",
+                                    loss="mcxent"))
+              .setInputType(InputType.recurrent(4))
+              .backpropType("TruncatedBPTT").tBPTTForwardLength(6)
+              .tBPTTBackwardLength(6))
+        cfg = lb.build()
+        assert cfg.tbptt_fwd_length == 6
+        assert cfg.tbptt_back_length == 6
+
+    def test_backprop_type_standard_wins(self):
+        """Explicit Standard disables tBPTT even with a length set."""
+        net = _rnn_net(LSTM(n_out=5), tBPTTLength=4,
+                       backpropType="Standard")
+        assert net.conf.tbptt_fwd_length == 0
+
+    def test_truncated_default_length(self):
+        """TruncatedBPTT without a length uses the reference default 20."""
+        net = _rnn_net(LSTM(n_out=5), backpropType="TruncatedBPTT")
+        assert net.conf.tbptt_fwd_length == 20
+
+    def test_bidirectional_rejected(self):
+        net = _rnn_net(Bidirectional(layer=LSTM(n_out=5)), tBPTTLength=4)
+        x, y = self._data(t=12)
+        with pytest.raises(ValueError, match="Bidirectional"):
+            net.fit(x, y)
+
+    def test_batch_size_change_rejected(self):
+        net = _rnn_net(LSTM(n_out=5))
+        net.rnnTimeStep(np.zeros((4, 4), np.float32))
+        with pytest.raises(ValueError, match="batch size"):
+            net.rnnTimeStep(np.zeros((2, 4), np.float32))
+        net.rnnClearPreviousState()
+        net.rnnTimeStep(np.zeros((2, 4), np.float32))  # fine after clear
