@@ -20,10 +20,14 @@ def _to_np(a):
 class Evaluation:
     """Multi-class classification evaluation with confusion matrix."""
 
-    def __init__(self, num_classes: Optional[int] = None, labels_list=None):
+    def __init__(self, num_classes: Optional[int] = None, labels_list=None,
+                 top_n: int = 1):
         self._n = num_classes
         self._conf: Optional[np.ndarray] = None
         self._labels_list = labels_list
+        self._top_n = top_n
+        self._top_n_correct = 0
+        self._top_n_total = 0
 
     def _ensure(self, n):
         if self._conf is None:
@@ -55,7 +59,13 @@ class Evaluation:
         if mask is not None:
             keep = _to_np(mask).astype(bool).ravel()
             yi, pi = yi[keep], pi[keep]
+            if p.ndim > 1:
+                p = p.reshape(-1, p.shape[-1])[keep]
         np.add.at(self._conf, (yi, pi), 1)
+        if self._top_n > 1 and p.ndim > 1:
+            topk = np.argsort(-p, axis=-1)[:, :self._top_n]
+            self._top_n_correct += int((topk == yi[:, None]).any(1).sum())
+            self._top_n_total += len(yi)
 
     # -- metrics (reference method names) ------------------------------
     def accuracy(self) -> float:
@@ -93,6 +103,12 @@ class Evaluation:
         fp = c[:, cls].sum() - c[cls, cls]
         tn = c.sum() - c[cls, :].sum() - c[:, cls].sum() + c[cls, cls]
         return float(fp / max(fp + tn, 1))
+
+    def topNAccuracy(self) -> float:
+        """Top-N accuracy (reference: Evaluation(int topN) constructor)."""
+        if self._top_n <= 1:
+            return self.accuracy()
+        return float(self._top_n_correct / max(self._top_n_total, 1))
 
     def confusionMatrix(self) -> np.ndarray:
         return self._conf.copy()
@@ -250,4 +266,170 @@ class RegressionEvaluation:
         return "\n".join(["RegressionEvaluation:"] + rows)
 
 
-__all__ = ["Evaluation", "EvaluationBinary", "ROC", "RegressionEvaluation"]
+def _auc_from_scores(y: np.ndarray, s: np.ndarray) -> float:
+    order = np.argsort(-s, kind="stable")
+    y = y[order]
+    tps = np.cumsum(y)
+    fps = np.cumsum(1 - y)
+    P = max(y.sum(), 1e-12)
+    N = max((1 - y).sum(), 1e-12)
+    tpr = np.concatenate([[0.0], tps / P])
+    fpr = np.concatenate([[0.0], fps / N])
+    return float(np.trapezoid(tpr, fpr))
+
+
+class ROCBinary:
+    """Per-output-column ROC for multi-label binary outputs (reference:
+    org/nd4j/evaluation/classification/ROCBinary)."""
+
+    def __init__(self):
+        self._ys = []
+        self._ps = []
+
+    def eval(self, labels, predictions, mask=None):
+        y = _to_np(labels)
+        p = _to_np(predictions)
+        y = y.reshape(-1, y.shape[-1])
+        p = p.reshape(-1, p.shape[-1])
+        if mask is not None:
+            keep = _to_np(mask).astype(bool).ravel()
+            y, p = y[keep], p[keep]
+        self._ys.append(y)
+        self._ps.append(p)
+
+    def numLabels(self) -> int:
+        return self._ys[0].shape[1] if self._ys else 0
+
+    def calculateAUC(self, col: int) -> float:
+        y = np.concatenate(self._ys)[:, col]
+        s = np.concatenate(self._ps)[:, col]
+        return _auc_from_scores(y, s)
+
+    def calculateAverageAUC(self) -> float:
+        return float(np.mean([self.calculateAUC(i)
+                              for i in range(self.numLabels())]))
+
+    def stats(self) -> str:
+        rows = [f"out {i}: AUC={self.calculateAUC(i):.4f}"
+                for i in range(self.numLabels())]
+        return "\n".join(["ROCBinary:"] + rows)
+
+
+class ROCMultiClass:
+    """One-vs-all ROC per class for softmax outputs (reference:
+    org/nd4j/evaluation/classification/ROCMultiClass)."""
+
+    def __init__(self):
+        self._ys = []
+        self._ps = []
+
+    def eval(self, labels, predictions, mask=None):
+        y = _to_np(labels)
+        p = _to_np(predictions)
+        y = y.reshape(-1, y.shape[-1])
+        p = p.reshape(-1, p.shape[-1])
+        if mask is not None:
+            keep = _to_np(mask).astype(bool).ravel()
+            y, p = y[keep], p[keep]
+        self._ys.append(y)
+        self._ps.append(p)
+
+    def numClasses(self) -> int:
+        return self._ys[0].shape[1] if self._ys else 0
+
+    def calculateAUC(self, cls: int) -> float:
+        y = np.concatenate(self._ys)[:, cls]
+        s = np.concatenate(self._ps)[:, cls]
+        return _auc_from_scores(y, s)
+
+    def calculateAverageAUC(self) -> float:
+        return float(np.mean([self.calculateAUC(i)
+                              for i in range(self.numClasses())]))
+
+    def stats(self) -> str:
+        rows = [f"class {i}: AUC={self.calculateAUC(i):.4f}"
+                for i in range(self.numClasses())]
+        return "\n".join(["ROCMultiClass:"] + rows)
+
+
+class EvaluationCalibration:
+    """Probability-calibration accumulators (reference: org/nd4j/
+    evaluation/classification/EvaluationCalibration — reliability
+    diagram bins, label/prediction count histograms, residual plot
+    data)."""
+
+    def __init__(self, reliability_bins: int = 10, histogram_bins: int = 50):
+        self._rb = reliability_bins
+        self._hb = histogram_bins
+        self._counts = None      # [C, rb] predictions per bin
+        self._pos = None         # [C, rb] positives per bin
+        self._prob_sum = None    # [C, rb] sum of predicted prob per bin
+        self._label_counts = None
+        self._pred_counts = None
+        self._residual_hist = None
+
+    def _ensure(self, c):
+        if self._counts is None:
+            z = lambda *s: np.zeros(s, np.float64)
+            self._counts = z(c, self._rb)
+            self._pos = z(c, self._rb)
+            self._prob_sum = z(c, self._rb)
+            self._label_counts = np.zeros(c, np.int64)
+            self._pred_counts = np.zeros(c, np.int64)
+            self._residual_hist = np.zeros(self._hb, np.int64)
+
+    def eval(self, labels, predictions, mask=None):
+        y = _to_np(labels)
+        p = _to_np(predictions)
+        y = y.reshape(-1, y.shape[-1])
+        p = p.reshape(-1, p.shape[-1])
+        if mask is not None:
+            keep = _to_np(mask).astype(bool).ravel()
+            y, p = y[keep], p[keep]
+        c = y.shape[1]
+        self._ensure(c)
+        bins = np.clip((p * self._rb).astype(int), 0, self._rb - 1)
+        for cls in range(c):
+            np.add.at(self._counts[cls], bins[:, cls], 1.0)
+            np.add.at(self._pos[cls], bins[:, cls], y[:, cls])
+            np.add.at(self._prob_sum[cls], bins[:, cls], p[:, cls])
+        self._label_counts += y.astype(np.int64).sum(0)
+        np.add.at(self._pred_counts, p.argmax(1), 1)
+        resid = np.abs(y - p).ravel()
+        rb = np.clip((resid * self._hb).astype(int), 0, self._hb - 1)
+        np.add.at(self._residual_hist, rb, 1)
+
+    def getReliabilityInfo(self, cls: int):
+        """(mean predicted prob per bin, empirical accuracy per bin,
+        counts per bin) — the reliability-diagram curve."""
+        cnt = self._counts[cls]
+        with np.errstate(divide="ignore", invalid="ignore"):
+            mean_p = np.where(cnt > 0, self._prob_sum[cls] / cnt, np.nan)
+            frac_pos = np.where(cnt > 0, self._pos[cls] / cnt, np.nan)
+        return mean_p, frac_pos, cnt.astype(np.int64)
+
+    def expectedCalibrationError(self, cls: int) -> float:
+        mean_p, frac_pos, cnt = self.getReliabilityInfo(cls)
+        ok = cnt > 0
+        w = cnt[ok] / cnt.sum()
+        return float(np.sum(w * np.abs(mean_p[ok] - frac_pos[ok])))
+
+    def getLabelCountsEachClass(self) -> np.ndarray:
+        return self._label_counts.copy()
+
+    def getPredictionCountsEachClass(self) -> np.ndarray:
+        return self._pred_counts.copy()
+
+    def getResidualPlotAllClasses(self) -> np.ndarray:
+        return self._residual_hist.copy()
+
+    def stats(self) -> str:
+        c = len(self._label_counts) if self._label_counts is not None else 0
+        rows = [f"class {i}: ECE={self.expectedCalibrationError(i):.4f} "
+                f"labels={self._label_counts[i]} preds={self._pred_counts[i]}"
+                for i in range(c)]
+        return "\n".join(["EvaluationCalibration:"] + rows)
+
+
+__all__ = ["Evaluation", "EvaluationBinary", "ROC", "ROCBinary",
+           "ROCMultiClass", "RegressionEvaluation", "EvaluationCalibration"]

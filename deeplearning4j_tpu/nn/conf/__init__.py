@@ -11,6 +11,7 @@ from deeplearning4j_tpu.nn.conf.layers import (
     Layer, LossLayer, OutputLayer, PoolingType, RnnOutputLayer,
     SubsamplingLayer, SeparableConvolution2D, Upsampling2D, ZeroPaddingLayer,
     LayerNormalization, SelfAttentionLayer, LocalResponseNormalization,
+    LearnedSelfAttentionLayer, RecurrentAttentionLayer, LastTimeStep, SimpleRnn,
 )
 from deeplearning4j_tpu.nn.conf.builder import (
     MultiLayerConfiguration, NeuralNetConfiguration,
@@ -24,6 +25,7 @@ __all__ = [
     "GlobalPoolingLayer", "LSTM", "GravesLSTM", "RnnOutputLayer",
     "PoolingType", "SeparableConvolution2D", "Upsampling2D",
     "ZeroPaddingLayer", "LayerNormalization", "SelfAttentionLayer",
-    "LocalResponseNormalization",
+    "LocalResponseNormalization", "LearnedSelfAttentionLayer",
+    "RecurrentAttentionLayer", "LastTimeStep", "SimpleRnn",
     "MultiLayerConfiguration", "NeuralNetConfiguration",
 ]

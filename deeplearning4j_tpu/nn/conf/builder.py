@@ -21,7 +21,7 @@ from deeplearning4j_tpu.learning.updaters import IUpdater, Sgd
 from deeplearning4j_tpu.nn.conf.inputs import InputType
 from deeplearning4j_tpu.nn.conf.layers import (
     Bidirectional, ConvolutionLayer, DenseLayer, EmbeddingLayer, Layer,
-    LastTimeStep, LSTM,
+    LastTimeStep, LearnedSelfAttentionLayer, LSTM, RecurrentAttentionLayer,
     SimpleRnn, SubsamplingLayer, SelfAttentionLayer, Upsampling2D,
     ZeroPaddingLayer, LocalResponseNormalization, GravesLSTM, RnnOutputLayer,
 )
@@ -214,7 +214,9 @@ class ListBuilder:
                     raise ValueError(
                         f"Layer {i} ({type(layer).__name__}) needs image input, got {it.kind}")
             elif isinstance(layer, (LSTM, SimpleRnn, SelfAttentionLayer,
-                                    GravesLSTM, LastTimeStep, Bidirectional)) \
+                                    GravesLSTM, LastTimeStep, Bidirectional,
+                                    LearnedSelfAttentionLayer,
+                                    RecurrentAttentionLayer)) \
                     or isinstance(layer, RnnOutputLayer):
                 if it.kind not in ("recurrent",):
                     raise ValueError(
@@ -238,7 +240,10 @@ class ListBuilder:
                 else:
                     target.n_in = it.size
             # attention n_out default
-            if isinstance(layer, SelfAttentionLayer) and layer.n_out == 0:
+            if isinstance(layer, (SelfAttentionLayer,
+                                  LearnedSelfAttentionLayer,
+                                  RecurrentAttentionLayer)) \
+                    and layer.n_out == 0:
                 layer.n_out = layer.n_in
 
             it = layer.output_type(it)

@@ -805,3 +805,124 @@ class SelfAttentionLayer(Layer):
             x, x, params["Wq"], params["Wk"], params["Wv"], params["Wo"],
             num_heads=self.n_heads)
         return _act(self.activation or "identity").fn(out), state
+
+
+@serializable
+@dataclasses.dataclass
+class LearnedSelfAttentionLayer(Layer):
+    """Attention with nQueries LEARNED query vectors (reference:
+    conf/layers/LearnedSelfAttentionLayer — pools a variable-length
+    sequence into a fixed number of query slots). Output is recurrent
+    with timeseries length == n_queries.
+    """
+
+    n_in: int = 0
+    n_out: int = 0
+    n_heads: int = 1
+    head_size: int = 0
+    n_queries: int = 1
+
+    def __post_init__(self):
+        if not self.head_size and self.n_heads:
+            self.head_size = (self.n_out or self.n_in) // self.n_heads
+
+    def output_type(self, it: InputType) -> InputType:
+        return InputType.recurrent(self.n_out, self.n_queries)
+
+    def init_params(self, key, it, dtype) -> dict:
+        proj = self.n_heads * self.head_size
+        ks = jax.random.split(key, 4)
+        wi = self.weight_init or WeightInit.XAVIER
+        return {
+            # learned queries, already in projection space
+            "Q": init_weights(wi, ks[0], (self.n_queries, proj),
+                              self.n_queries, proj, dtype),
+            "Wk": init_weights(wi, ks[1], (self.n_in, proj), self.n_in, proj, dtype),
+            "Wv": init_weights(wi, ks[2], (self.n_in, proj), self.n_in, proj, dtype),
+            "Wo": init_weights(wi, ks[3], (proj, self.n_out), proj, self.n_out, dtype),
+        }
+
+    def apply(self, params, state, x, train, rng):
+        n = x.shape[0]
+        h, dh = self.n_heads, self.head_size
+        q = jnp.broadcast_to(params["Q"], (n,) + params["Q"].shape)
+        k = x @ params["Wk"]
+        v = x @ params["Wv"]
+        split = lambda a: a.reshape(a.shape[0], a.shape[1], h, dh).transpose(0, 2, 1, 3)
+        out = nnops.dot_product_attention(split(q), split(k), split(v))
+        out = out.transpose(0, 2, 1, 3).reshape(n, self.n_queries, h * dh)
+        out = out @ params["Wo"]
+        return _act(self.activation or "identity").fn(out), state
+
+
+@serializable
+@dataclasses.dataclass
+class RecurrentAttentionLayer(Layer):
+    """Recurrent cell attending over the full input sequence each step
+    (reference: conf/layers/RecurrentAttentionLayer — h_t depends on
+    x_t, h_{t-1}, and attention(query=h_{t-1}, keys/values=X)).
+
+    TPU design: K/V projections of the whole sequence are computed once
+    as big MXU matmuls outside the scan; the scan carries h and does the
+    O(T) attention read per step (O(T^2) total, like the reference).
+    """
+
+    n_in: int = 0
+    n_out: int = 0
+    n_heads: int = 1
+    head_size: int = 0
+
+    is_recurrent = True
+
+    def __post_init__(self):
+        if not self.head_size and self.n_heads:
+            self.head_size = (self.n_out or self.n_in) // self.n_heads
+
+    def output_type(self, it: InputType) -> InputType:
+        return InputType.recurrent(self.n_out, it.timeseries_length)
+
+    def init_params(self, key, it, dtype) -> dict:
+        proj = self.n_heads * self.head_size
+        ks = jax.random.split(key, 6)
+        wi = self.weight_init or WeightInit.XAVIER
+        return {
+            "W": init_weights(wi, ks[0], (self.n_in, self.n_out), self.n_in, self.n_out, dtype),
+            "RW": init_weights(wi, ks[1], (self.n_out, self.n_out), self.n_out, self.n_out, dtype),
+            "Wq": init_weights(wi, ks[2], (self.n_out, proj), self.n_out, proj, dtype),
+            "Wk": init_weights(wi, ks[3], (self.n_in, proj), self.n_in, proj, dtype),
+            "Wv": init_weights(wi, ks[4], (self.n_in, proj), self.n_in, proj, dtype),
+            "Wa": init_weights(wi, ks[5], (proj, self.n_out), proj, self.n_out, dtype),
+            "b": jnp.zeros((self.n_out,), dtype),
+        }
+
+    def apply(self, params, state, x, train, rng):
+        h0 = jnp.zeros((x.shape[0], self.n_out), x.dtype)
+        ys, _ = self._scan(params, h0, x)
+        return ys, state
+
+    def _scan(self, params, h0, x):
+        n, t, _ = x.shape
+        heads, dh = self.n_heads, self.head_size
+        # precompute K/V once: [N, heads, T, dh]
+        k = (x.reshape(n * t, -1) @ params["Wk"]).reshape(n, t, heads, dh).transpose(0, 2, 1, 3)
+        v = (x.reshape(n * t, -1) @ params["Wv"]).reshape(n, t, heads, dh).transpose(0, 2, 1, 3)
+        x_proj = (x.reshape(n * t, -1) @ params["W"] + params["b"]) \
+            .reshape(n, t, self.n_out).transpose(1, 0, 2)
+        act = _act(self.activation or "tanh").fn
+
+        def step(h, xp):
+            q = (h @ params["Wq"]).reshape(n, heads, 1, dh)
+            a = nnops.dot_product_attention(q, k, v)           # [N,heads,1,dh]
+            a = a.reshape(n, heads * dh) @ params["Wa"]
+            h2 = act(xp + h @ params["RW"] + a)
+            return h2, h2
+
+        hT, ys = jax.lax.scan(step, h0, x_proj)
+        return ys.transpose(1, 0, 2), hT
+
+    def init_carry(self, batch, dtype):
+        # stateful stepping is full-sequence-dependent; reference treats
+        # this layer as requiring complete sequences too
+        raise NotImplementedError(
+            "rnnTimeStep is not supported for RecurrentAttentionLayer "
+            "(attends over the full input sequence)")

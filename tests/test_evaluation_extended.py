@@ -1,0 +1,175 @@
+"""Extended evaluation (ROCBinary, ROCMultiClass, EvaluationCalibration,
+top-N) and the learned/recurrent attention layers.
+
+Reference: org/nd4j/evaluation/classification/{ROCBinary,ROCMultiClass,
+EvaluationCalibration}, Evaluation(topN); conf/layers/
+{LearnedSelfAttentionLayer,RecurrentAttentionLayer} (SURVEY.md §2.16, §2.20).
+"""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.evaluation import (
+    Evaluation, EvaluationCalibration, ROC, ROCBinary, ROCMultiClass,
+)
+
+
+class TestROCBinary:
+    def test_perfect_and_random(self):
+        rs = np.random.RandomState(0)
+        y = (rs.rand(200, 3) > 0.5).astype(np.float32)
+        perfect = y * 0.9 + 0.05
+        roc = ROCBinary()
+        roc.eval(y, perfect)
+        for i in range(3):
+            assert roc.calculateAUC(i) > 0.99
+        rand = ROCBinary()
+        rand.eval(y, rs.rand(200, 3).astype(np.float32))
+        assert 0.3 < rand.calculateAverageAUC() < 0.7
+
+    def test_batched_accumulation(self):
+        rs = np.random.RandomState(1)
+        y = (rs.rand(100, 2) > 0.5).astype(np.float32)
+        p = np.clip(y + rs.randn(100, 2) * 0.3, 0, 1)
+        whole = ROCBinary(); whole.eval(y, p)
+        batched = ROCBinary()
+        batched.eval(y[:50], p[:50]); batched.eval(y[50:], p[50:])
+        for i in range(2):
+            assert abs(whole.calculateAUC(i) - batched.calculateAUC(i)) < 1e-9
+
+
+class TestROCMultiClass:
+    def test_one_vs_all(self):
+        rs = np.random.RandomState(2)
+        cls = rs.randint(0, 4, 300)
+        y = np.eye(4, dtype=np.float32)[cls]
+        logits = y * 3 + rs.randn(300, 4)
+        p = np.exp(logits) / np.exp(logits).sum(-1, keepdims=True)
+        roc = ROCMultiClass()
+        roc.eval(y, p)
+        assert roc.numClasses() == 4
+        for i in range(4):
+            assert roc.calculateAUC(i) > 0.85
+        assert roc.calculateAverageAUC() > 0.85
+        assert "AUC" in roc.stats()
+
+    def test_matches_binary_roc_per_class(self):
+        rs = np.random.RandomState(3)
+        cls = rs.randint(0, 2, 100)
+        y = np.eye(2, dtype=np.float32)[cls]
+        p = rs.rand(100, 2).astype(np.float32)
+        mc = ROCMultiClass(); mc.eval(y, p)
+        r = ROC(); r.eval(y[:, 1], p[:, 1])
+        assert abs(mc.calculateAUC(1) - r.calculateAUC()) < 1e-9
+
+
+class TestEvaluationCalibration:
+    def test_well_calibrated(self):
+        rs = np.random.RandomState(4)
+        p1 = rs.rand(20000)
+        y1 = (rs.rand(20000) < p1).astype(np.float32)
+        y = np.stack([1 - y1, y1], -1)
+        p = np.stack([1 - p1, p1], -1)
+        ec = EvaluationCalibration(reliability_bins=10)
+        ec.eval(y, p)
+        # well-calibrated → low ECE
+        assert ec.expectedCalibrationError(1) < 0.03
+        mean_p, frac_pos, cnt = ec.getReliabilityInfo(1)
+        ok = cnt > 0
+        np.testing.assert_allclose(mean_p[ok], frac_pos[ok], atol=0.08)
+
+    def test_miscalibrated(self):
+        n = 5000
+        p1 = np.full(n, 0.9)
+        y1 = (np.random.RandomState(5).rand(n) < 0.5).astype(np.float32)
+        ec = EvaluationCalibration()
+        ec.eval(np.stack([1 - y1, y1], -1), np.stack([1 - p1, p1], -1))
+        assert ec.expectedCalibrationError(1) > 0.3
+
+    def test_count_histograms(self):
+        y = np.eye(3, dtype=np.float32)[[0, 1, 1, 2]]
+        p = np.full((4, 3), 1 / 3, np.float32)
+        p[:, 0] = 0.5
+        ec = EvaluationCalibration()
+        ec.eval(y, p)
+        np.testing.assert_array_equal(ec.getLabelCountsEachClass(), [1, 2, 1])
+        np.testing.assert_array_equal(ec.getPredictionCountsEachClass(), [4, 0, 0])
+        assert ec.getResidualPlotAllClasses().sum() == 12  # 4 rows * 3 cols
+        assert "ECE" in ec.stats()
+
+
+class TestTopN:
+    def test_top2(self):
+        y = np.eye(3, dtype=np.float32)[[0, 1, 2]]
+        p = np.array([[0.5, 0.4, 0.1],    # correct top1
+                      [0.5, 0.4, 0.1],    # class 1 is 2nd → top2 correct
+                      [0.5, 0.4, 0.1]],   # class 2 is 3rd → top2 wrong
+                     np.float32)
+        ev = Evaluation(top_n=2)
+        ev.eval(y, p)
+        assert abs(ev.accuracy() - 1 / 3) < 1e-9
+        assert abs(ev.topNAccuracy() - 2 / 3) < 1e-9
+
+
+class TestAttentionLayers:
+    def _seq_net(self, layer, t_out=None):
+        from deeplearning4j_tpu.nn.conf import (
+            InputType, NeuralNetConfiguration, RnnOutputLayer,
+        )
+        from deeplearning4j_tpu.nn.multilayer.network import MultiLayerNetwork
+        from deeplearning4j_tpu.learning.updaters import Adam
+        conf = (NeuralNetConfiguration.builder().seed(3).updater(Adam(1e-2))
+                .list()
+                .layer(layer)
+                .layer(RnnOutputLayer(n_out=2, activation="softmax",
+                                      loss="mcxent"))
+                .setInputType(InputType.recurrent(6))
+                .build())
+        return MultiLayerNetwork(conf).init()
+
+    def test_learned_self_attention_shapes(self):
+        from deeplearning4j_tpu.nn.conf import LearnedSelfAttentionLayer
+        net = self._seq_net(LearnedSelfAttentionLayer(
+            n_out=8, n_heads=2, n_queries=4))
+        x = np.random.RandomState(0).randn(3, 9, 6).astype(np.float32)
+        out = net.output(x).toNumpy()
+        assert out.shape == (3, 4, 2)  # n_queries defines output length
+
+    def test_learned_self_attention_trains(self):
+        from deeplearning4j_tpu.nn.conf import LearnedSelfAttentionLayer
+        net = self._seq_net(LearnedSelfAttentionLayer(
+            n_out=8, n_heads=2, n_queries=2))
+        rs = np.random.RandomState(1)
+        x = rs.randn(16, 7, 6).astype(np.float32)
+        lab = (x.mean((1, 2)) > 0).astype(int)
+        y = np.repeat(np.eye(2, dtype=np.float32)[lab][:, None, :], 2, axis=1)
+        first = None
+        for _ in range(40):
+            net.fit(x, y)
+            first = first or net.score()
+        assert net.score() < first
+
+    def test_recurrent_attention_shapes_and_training(self):
+        from deeplearning4j_tpu.nn.conf import RecurrentAttentionLayer
+        net = self._seq_net(RecurrentAttentionLayer(n_out=8, n_heads=2))
+        rs = np.random.RandomState(2)
+        x = rs.randn(8, 5, 6).astype(np.float32)
+        out = net.output(x).toNumpy()
+        assert out.shape == (8, 5, 2)
+        lab = (x.sum(-1) > 0).astype(int)
+        y = np.eye(2, dtype=np.float32)[lab]
+        first = None
+        for _ in range(30):
+            net.fit(x, y)
+            first = first or net.score()
+        assert net.score() < first
+
+    def test_json_roundtrip(self):
+        from deeplearning4j_tpu.nn.conf import (
+            LearnedSelfAttentionLayer, MultiLayerConfiguration,
+        )
+        net = self._seq_net(LearnedSelfAttentionLayer(
+            n_out=8, n_heads=2, n_queries=4))
+        cfg2 = MultiLayerConfiguration.from_json(net.conf.to_json())
+        assert isinstance(cfg2.layers[0], LearnedSelfAttentionLayer)
+        assert cfg2.layers[0].n_queries == 4
