@@ -12,6 +12,7 @@ from deeplearning4j_tpu.nn.conf.layers import (
     SubsamplingLayer, SeparableConvolution2D, Upsampling2D, ZeroPaddingLayer,
     LayerNormalization, SelfAttentionLayer, LocalResponseNormalization,
     LearnedSelfAttentionLayer, RecurrentAttentionLayer, LastTimeStep, SimpleRnn,
+    CnnLossLayer, RnnLossLayer,
 )
 from deeplearning4j_tpu.nn.conf.builder import (
     MultiLayerConfiguration, NeuralNetConfiguration,
@@ -27,5 +28,6 @@ __all__ = [
     "ZeroPaddingLayer", "LayerNormalization", "SelfAttentionLayer",
     "LocalResponseNormalization", "LearnedSelfAttentionLayer",
     "RecurrentAttentionLayer", "LastTimeStep", "SimpleRnn",
+    "CnnLossLayer", "RnnLossLayer",
     "MultiLayerConfiguration", "NeuralNetConfiguration",
 ]

@@ -176,6 +176,21 @@ class LossLayer(Layer):
 
 @serializable
 @dataclasses.dataclass
+class CnnLossLayer(LossLayer):
+    """Per-pixel loss head on [N,H,W,C] activations (reference:
+    conf/layers/CnnLossLayer — segmentation heads like UNet). The loss
+    math is elementwise, so LossLayer's fused paths apply unchanged."""
+
+
+@serializable
+@dataclasses.dataclass
+class RnnLossLayer(LossLayer):
+    """Per-timestep loss head on [N,T,C] activations (reference:
+    conf/layers/RnnLossLayer)."""
+
+
+@serializable
+@dataclasses.dataclass
 class ActivationLayer(Layer):
     #: parameter for parameterized activations (leakyrelu slope, elu α)
     alpha: Optional[float] = None

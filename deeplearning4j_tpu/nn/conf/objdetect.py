@@ -1,0 +1,139 @@
+"""YOLOv2 object-detection output layer.
+
+Reference: org/deeplearning4j/nn/conf/layers/objdetect/Yolo2OutputLayer
++ impl org/deeplearning4j/nn/layers/objdetect/Yolo2OutputLayer (used by
+TinyYOLO/YOLO2 in the zoo, SURVEY.md §2.33).
+
+Layout differences by design (TPU NHWC):
+- network activations: [N, H, W, B*(5+C)]  (reference: [mb, B*(5+C), H, W])
+- labels:              [N, H, W, 4+C]      (reference: [mb, 4+C, H, W]),
+  where the 4 are (x1, y1, x2, y2) in GRID units (0..W / 0..H) and the C
+  are the one-hot class of the cell's object (all-zero = no object).
+
+The whole loss is one fused XLA computation: sigmoid offsets, anchor-
+scaled sizes, per-anchor IoU responsibility (argmax -> stop_gradient
+one-hot, the standard differentiable-through-selection trick), the four
+YOLOv2 terms with lambda_coord / lambda_no_obj weighting.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.common.serde import serializable
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import LossLayer
+
+
+@serializable
+@dataclasses.dataclass
+class Yolo2OutputLayer(LossLayer):
+    """Parameterless YOLOv2 loss head (a LossLayer so both network
+    front-ends accept it as terminal). `anchors` are (w, h) pairs in
+    grid units; C is inferred from the label depth at loss time."""
+
+    anchors: Tuple = ()
+    lambda_coord: float = 5.0
+    lambda_no_obj: float = 0.5
+
+    def __post_init__(self):
+        self.anchors = tuple(tuple(a) for a in self.anchors)
+
+    def has_params(self):
+        return False
+
+    def output_type(self, it: InputType) -> InputType:
+        return it
+
+    def apply(self, params, state, x, train, rng):
+        return x, state
+
+    # -- decoding ------------------------------------------------------
+    def _decode(self, x, n_classes: int):
+        """[N,H,W,B*(5+C)] -> (xy [N,H,W,B,2] absolute grid coords,
+        wh [N,H,W,B,2] grid units, conf [N,H,W,B], class logits
+        [N,H,W,B,C])."""
+        n, h, w, _ = x.shape
+        b = len(self.anchors)
+        x = x.reshape(n, h, w, b, 5 + n_classes)
+        # cell top-left offsets
+        cy = jnp.arange(h, dtype=x.dtype).reshape(1, h, 1, 1)
+        cx = jnp.arange(w, dtype=x.dtype).reshape(1, 1, w, 1)
+        px = jax.nn.sigmoid(x[..., 0]) + cx
+        py = jax.nn.sigmoid(x[..., 1]) + cy
+        anchors = jnp.asarray(self.anchors, x.dtype)      # [B,2]
+        pw = jnp.exp(x[..., 2]) * anchors[:, 0]
+        ph = jnp.exp(x[..., 3]) * anchors[:, 1]
+        conf = jax.nn.sigmoid(x[..., 4])
+        cls_logits = x[..., 5:]
+        return (jnp.stack([px, py], -1), jnp.stack([pw, ph], -1), conf,
+                cls_logits)
+
+    @staticmethod
+    def _iou(xy1, wh1, xy2, wh2):
+        """IoU of center-format boxes; broadcasts."""
+        mins1, maxs1 = xy1 - wh1 / 2, xy1 + wh1 / 2
+        mins2, maxs2 = xy2 - wh2 / 2, xy2 + wh2 / 2
+        inter_min = jnp.maximum(mins1, mins2)
+        inter_max = jnp.minimum(maxs1, maxs2)
+        inter = jnp.prod(jnp.clip(inter_max - inter_min, 0.0, None), -1)
+        a1 = jnp.prod(wh1, -1)
+        a2 = jnp.prod(wh2, -1)
+        return inter / jnp.maximum(a1 + a2 - inter, 1e-9)
+
+    # -- the YOLOv2 loss ----------------------------------------------
+    def loss_value(self, params, state, x, labels, mask=None):
+        n, h, w, d = labels.shape
+        n_classes = d - 4
+        b = len(self.anchors)
+        if x.shape[-1] != b * (5 + n_classes):
+            raise ValueError(
+                f"Yolo2OutputLayer: activations depth {x.shape[-1]} != "
+                f"B*(5+C) = {b}*(5+{n_classes})")
+        pxy, pwh, pconf, pcls = self._decode(x, n_classes)
+
+        cls_1hot = labels[..., 4:]                         # [N,H,W,C]
+        obj = (jnp.sum(cls_1hot, -1) > 0).astype(x.dtype)  # [N,H,W]
+        x1, y1, x2, y2 = (labels[..., i] for i in range(4))
+        gxy = jnp.stack([(x1 + x2) / 2, (y1 + y2) / 2], -1)  # [N,H,W,2]
+        gwh = jnp.stack([jnp.maximum(x2 - x1, 1e-6),
+                         jnp.maximum(y2 - y1, 1e-6)], -1)
+
+        # anchor responsibility: IoU of anchor shapes vs gt shape
+        # (location-independent, as in the paper)
+        anchors = jnp.asarray(self.anchors, x.dtype)       # [B,2]
+        zeros = jnp.zeros_like(gwh)[..., None, :]          # [N,H,W,1,2]
+        a_iou = self._iou(zeros, jnp.broadcast_to(
+            anchors, gwh.shape[:-1] + (b, 2)), zeros, gwh[..., None, :])
+        resp = jax.nn.one_hot(jnp.argmax(a_iou, -1), b, dtype=x.dtype)
+        resp = jax.lax.stop_gradient(resp) * obj[..., None]  # [N,H,W,B]
+
+        # coord loss (sqrt on sizes, as in the paper)
+        dxy = jnp.sum((pxy - gxy[..., None, :]) ** 2, -1)
+        dwh = jnp.sum((jnp.sqrt(pwh) - jnp.sqrt(gwh[..., None, :])) ** 2, -1)
+        coord = self.lambda_coord * jnp.sum(resp * (dxy + dwh))
+
+        # confidence: responsible boxes match their live IoU; the rest 0
+        live_iou = jax.lax.stop_gradient(
+            self._iou(pxy, pwh, gxy[..., None, :],
+                      jnp.broadcast_to(gwh[..., None, :], pwh.shape)))
+        conf_obj = jnp.sum(resp * (pconf - live_iou) ** 2)
+        conf_noobj = self.lambda_no_obj * jnp.sum(
+            (1.0 - resp) * pconf ** 2)
+
+        # class loss: softmax CE on the responsible box
+        logp = jax.nn.log_softmax(pcls, -1)
+        ce = -jnp.sum(cls_1hot[..., None, :] * logp, -1)   # [N,H,W,B]
+        cls_loss = jnp.sum(resp * ce)
+
+        total = coord + conf_obj + conf_noobj + cls_loss
+        if mask is not None:
+            raise NotImplementedError("Yolo2OutputLayer does not use masks")
+        return total / n
+
+
+__all__ = ["Yolo2OutputLayer"]

@@ -13,5 +13,11 @@ from deeplearning4j_tpu.zoo.alexnet import AlexNet
 from deeplearning4j_tpu.zoo.vgg16 import VGG16
 from deeplearning4j_tpu.zoo.resnet50 import ResNet50
 from deeplearning4j_tpu.zoo.simplecnn import SimpleCNN
+from deeplearning4j_tpu.zoo.unet import UNet
+from deeplearning4j_tpu.zoo.tinyyolo import TinyYOLO
+from deeplearning4j_tpu.zoo.darknet19 import Darknet19
+from deeplearning4j_tpu.zoo.squeezenet import SqueezeNet
+from deeplearning4j_tpu.zoo.textgen_lstm import TextGenerationLSTM
 
-__all__ = ["LeNet", "AlexNet", "VGG16", "ResNet50", "SimpleCNN"]
+__all__ = ["LeNet", "AlexNet", "VGG16", "ResNet50", "SimpleCNN", "UNet",
+           "TinyYOLO", "Darknet19", "SqueezeNet", "TextGenerationLSTM"]

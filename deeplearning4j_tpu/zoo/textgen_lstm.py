@@ -1,0 +1,39 @@
+"""Character-level text-generation LSTM (reference:
+zoo/model/TextGenerationLSTM.java — 2x LSTM(256) + per-timestep softmax,
+trained with truncated BPTT; pairs with MultiLayerNetwork.rnnTimeStep
+for sampling)."""
+
+from __future__ import annotations
+
+from deeplearning4j_tpu.learning import Adam
+from deeplearning4j_tpu.nn.conf import (
+    InputType, LSTM, NeuralNetConfiguration, RnnOutputLayer,
+)
+from deeplearning4j_tpu.nn.multilayer.network import MultiLayerNetwork
+from deeplearning4j_tpu.zoo.base import ZooModel
+
+
+class TextGenerationLSTM(ZooModel):
+    def __init__(self, vocab_size: int = 77, hidden: int = 256,
+                 seed: int = 42, updater=None, tbptt_length: int = 50):
+        self.vocab_size = vocab_size
+        self.hidden = hidden
+        self.seed = seed
+        self.updater = updater or Adam(1e-3)
+        self.tbptt_length = tbptt_length
+
+    def conf(self):
+        lb = (NeuralNetConfiguration.builder().seed(self.seed)
+              .updater(self.updater).list()
+              .layer(LSTM(n_out=self.hidden))
+              .layer(LSTM(n_out=self.hidden))
+              .layer(RnnOutputLayer(n_out=self.vocab_size,
+                                    activation="softmax", loss="mcxent"))
+              .setInputType(InputType.recurrent(self.vocab_size)))
+        if self.tbptt_length:
+            lb = lb.backpropType("TruncatedBPTT").tBPTTLength(
+                self.tbptt_length)
+        return lb.build()
+
+    def init(self) -> MultiLayerNetwork:
+        return MultiLayerNetwork(self.conf()).init()

@@ -1,0 +1,137 @@
+"""Zoo breadth: UNet, TinyYOLO (+Yolo2OutputLayer loss), Darknet19,
+SqueezeNet, TextGenerationLSTM — tiny shapes, forward + one train step.
+
+Reference: zoo/model/{UNet,TinyYOLO,Darknet19,SqueezeNet,
+TextGenerationLSTM}.java and layers/objdetect/Yolo2OutputLayer
+(SURVEY.md §2.33).
+"""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.learning import Adam
+
+
+class TestUNet:
+    def test_forward_and_fit(self):
+        from deeplearning4j_tpu.zoo import UNet
+        net = UNet(in_shape=(32, 32, 3), base_filters=4, depth=2,
+                   updater=Adam(1e-3)).init()
+        rs = np.random.RandomState(0)
+        x = rs.rand(2, 32, 32, 3).astype(np.float32)
+        out = net.output(x)
+        out = (out[0] if isinstance(out, (list, tuple)) else out).toNumpy()
+        assert out.shape == (2, 32, 32, 1)
+        assert (out >= 0).all() and (out <= 1).all()   # sigmoid
+        y = (rs.rand(2, 32, 32, 1) > 0.5).astype(np.float32)
+        losses = []
+        for _ in range(5):
+            net.fit(x, y)
+            losses.append(net.score())
+        assert losses[-1] < losses[0]
+
+
+class TestYolo2Loss:
+    def _layer(self, anchors=((1.0, 1.5), (3.0, 2.0)), c=3):
+        from deeplearning4j_tpu.nn.conf.objdetect import Yolo2OutputLayer
+        return Yolo2OutputLayer(anchors=anchors), c
+
+    def _label(self, n=2, h=4, w=4, c=3, seed=0):
+        rs = np.random.RandomState(seed)
+        lab = np.zeros((n, h, w, 4 + c), np.float32)
+        # one object per image, centered in cell (1,2) with size ~anchors[1]
+        for i in range(n):
+            cx, cy = 2.5, 1.5
+            bw, bh = 2.8, 2.2
+            lab[i, 1, 2, :4] = [cx - bw / 2, cy - bh / 2,
+                                cx + bw / 2, cy + bh / 2]
+            lab[i, 1, 2, 4 + rs.randint(c)] = 1.0
+        return lab
+
+    def test_loss_differentiable_and_decreases(self):
+        import jax
+        import jax.numpy as jnp
+        layer, c = self._layer()
+        lab = jnp.asarray(self._label(c=c))
+        b = len(layer.anchors)
+        rs = np.random.RandomState(1)
+        x = jnp.asarray(rs.randn(2, 4, 4, b * (5 + c)).astype(np.float32))
+
+        f = jax.jit(lambda act: layer.loss_value({}, {}, act, lab))
+        l0 = float(f(x))
+        g = jax.jit(jax.grad(lambda act: layer.loss_value({}, {}, act, lab)))
+        for _ in range(300):
+            x = x - 0.1 * g(x)
+        assert float(f(x)) < 0.3 * l0
+        assert np.isfinite(float(f(x)))
+
+    def test_depth_mismatch_raises(self):
+        import jax.numpy as jnp
+        layer, c = self._layer()
+        lab = jnp.asarray(self._label(c=c))
+        bad = jnp.zeros((2, 4, 4, 7), jnp.float32)
+        with pytest.raises(ValueError, match="depth"):
+            layer.loss_value({}, {}, bad, lab)
+
+
+class TestTinyYOLO:
+    def test_forward_and_fit(self):
+        from deeplearning4j_tpu.zoo import TinyYOLO
+        anchors = ((1.0, 1.0), (2.0, 2.0))
+        net = TinyYOLO(num_classes=3, in_shape=(64, 64, 3), anchors=anchors,
+                       updater=Adam(1e-3)).init()
+        rs = np.random.RandomState(0)
+        x = rs.rand(2, 64, 64, 3).astype(np.float32)
+        out = net.output(x).toNumpy()
+        assert out.shape == (2, 2, 2, 2 * (5 + 3))   # 64/32 = 2x2 grid
+        lab = np.zeros((2, 2, 2, 7), np.float32)
+        lab[:, 0, 1, :4] = [1.2, 0.1, 1.9, 0.8]
+        lab[:, 0, 1, 5] = 1.0
+        net.fit(x, lab)
+        assert np.isfinite(net.score())
+
+
+class TestDarknet19:
+    def test_forward_shape(self):
+        from deeplearning4j_tpu.zoo import Darknet19
+        net = Darknet19(num_classes=5, in_shape=(32, 32, 3)).init()
+        x = np.random.RandomState(0).rand(2, 32, 32, 3).astype(np.float32)
+        out = net.output(x).toNumpy()
+        assert out.shape == (2, 5)
+        np.testing.assert_allclose(out.sum(-1), 1.0, rtol=1e-5)
+
+
+class TestSqueezeNet:
+    def test_forward_and_fit(self):
+        from deeplearning4j_tpu.zoo import SqueezeNet
+        net = SqueezeNet(num_classes=4, in_shape=(48, 48, 3),
+                         updater=Adam(1e-3)).init()
+        rs = np.random.RandomState(0)
+        x = rs.rand(2, 48, 48, 3).astype(np.float32)
+        out = net.output(x)
+        out = (out[0] if isinstance(out, (list, tuple)) else out).toNumpy()
+        assert out.shape == (2, 4)
+        np.testing.assert_allclose(out.sum(-1), 1.0, rtol=1e-5)
+        y = np.eye(4, dtype=np.float32)[[0, 1]]
+        net.fit(x, y)
+        assert np.isfinite(net.score())
+
+
+class TestTextGenerationLSTM:
+    def test_tbptt_training_and_sampling(self):
+        from deeplearning4j_tpu.zoo import TextGenerationLSTM
+        model = TextGenerationLSTM(vocab_size=8, hidden=16, tbptt_length=5,
+                                   updater=Adam(1e-2))
+        net = model.init()
+        assert net.conf.tbptt_fwd_length == 5
+        rs = np.random.RandomState(0)
+        ids = rs.randint(0, 8, (4, 15))
+        x = np.eye(8, dtype=np.float32)[ids]
+        y = np.eye(8, dtype=np.float32)[np.roll(ids, -1, axis=1)]
+        net.fit(x, y)
+        assert net.getIterationCount() == 3   # 15/5 segments
+        # stateful sampling via rnnTimeStep
+        net.rnnClearPreviousState()
+        probs = net.rnnTimeStep(x[:, 0]).toNumpy()
+        assert probs.shape == (4, 8)
+        np.testing.assert_allclose(probs.sum(-1), 1.0, rtol=1e-5)
