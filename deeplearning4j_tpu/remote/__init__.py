@@ -1,0 +1,6 @@
+"""Remote JSON inference (reference: deeplearning4j-remote —
+JsonModelServer / JsonRemoteInference, SURVEY.md §2.36)."""
+
+from deeplearning4j_tpu.remote.server import JsonModelServer, JsonRemoteInference
+
+__all__ = ["JsonModelServer", "JsonRemoteInference"]

@@ -1,0 +1,152 @@
+"""HTTP JSON inference server + client.
+
+Reference: deeplearning4j-remote — org/deeplearning4j/remote/
+JsonModelServer (serves MultiLayerNetwork / ComputationGraph / SameDiff
+over HTTP JSON with pluggable serializers) and JsonRemoteInference (the
+client), SURVEY.md §2.36.
+
+Endpoints (stdlib http.server, daemon thread):
+    POST /v1/serving/predict   {"features": <nested list>, ...}
+                               -> {"output": <nested list>}
+    GET  /v1/serving/info      -> model metadata
+
+Batching note: requests are served one-by-one; the TPU-side win comes
+from the jit-compiled forward reused across requests (first request
+pays compile). For throughput serving use ParallelInference, which
+micro-batches across callers.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+
+class JsonModelServer:
+    """Serve a model's `output()` over HTTP JSON.
+
+    `input_adapter` maps the decoded JSON payload to the model input
+    (default: np.asarray of `features`, float32); `output_adapter` maps
+    the model output to a JSON-serializable object (default: nested
+    lists) — mirroring the reference's InferenceAdapter/Serializer seam.
+    """
+
+    def __init__(self, model, port: int = 0,
+                 input_adapter: Optional[Callable[[dict], Any]] = None,
+                 output_adapter: Optional[Callable[[Any], Any]] = None):
+        self.model = model
+        self._requested_port = port
+        self.input_adapter = input_adapter or self._default_input
+        self.output_adapter = output_adapter or self._default_output
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self.port: Optional[int] = None
+        self._infer_lock = threading.Lock()
+
+    @staticmethod
+    def _default_input(payload: dict):
+        if "features" not in payload:
+            raise ValueError("payload must contain 'features'")
+        return np.asarray(payload["features"], np.float32)
+
+    @staticmethod
+    def _default_output(out):
+        if isinstance(out, (list, tuple)):
+            return [np.asarray(getattr(o, "jax", o)).tolist() for o in out]
+        return np.asarray(getattr(out, "jax", out)).tolist()
+
+    # -- lifecycle ------------------------------------------------------
+    def start(self) -> int:
+        if self._httpd is not None:
+            return self.port
+        server = ThreadingHTTPServer(("127.0.0.1", self._requested_port),
+                                     _InferenceHandler)
+        server.model_server = self  # type: ignore[attr-defined]
+        self._httpd = server
+        self.port = server.server_address[1]
+        self._thread = threading.Thread(target=server.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self.port
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+            self._thread = None
+
+    # -- inference ------------------------------------------------------
+    def predict(self, payload: dict):
+        x = self.input_adapter(payload)
+        with self._infer_lock:  # model output() mutates rng state
+            out = self.model.output(x)
+        return self.output_adapter(out)
+
+    def info(self) -> dict:
+        m = self.model
+        return {
+            "model_class": type(m).__name__,
+            "num_params": int(m.numParams()) if hasattr(m, "numParams")
+            else None,
+        }
+
+
+class _InferenceHandler(BaseHTTPRequestHandler):
+    server_version = "DL4JTPUModelServer/1.0"
+
+    def log_message(self, *args):
+        pass
+
+    def _json(self, obj, code=200):
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        ms: JsonModelServer = self.server.model_server  # type: ignore
+        if self.path.rstrip("/") == "/v1/serving/info":
+            return self._json(ms.info())
+        return self._json({"error": "not found"}, 404)
+
+    def do_POST(self):
+        ms: JsonModelServer = self.server.model_server  # type: ignore
+        if self.path.rstrip("/") != "/v1/serving/predict":
+            return self._json({"error": "not found"}, 404)
+        try:
+            n = int(self.headers.get("Content-Length", 0))
+            payload = json.loads(self.rfile.read(n) or b"{}")
+            return self._json({"output": ms.predict(payload)})
+        except Exception as e:  # bad payload -> 400 with reason
+            return self._json({"error": str(e)}, 400)
+
+
+class JsonRemoteInference:
+    """Client for JsonModelServer (reference: JsonRemoteInference)."""
+
+    def __init__(self, endpoint: str, timeout: float = 30.0):
+        self.endpoint = endpoint.rstrip("/")
+        self.timeout = timeout
+
+    def predict(self, features) -> np.ndarray:
+        body = json.dumps(
+            {"features": np.asarray(features).tolist()}).encode()
+        req = urllib.request.Request(
+            self.endpoint + "/v1/serving/predict", data=body,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=self.timeout) as r:
+            out = json.loads(r.read())
+        if "error" in out:
+            raise RuntimeError(out["error"])
+        return np.asarray(out["output"])
+
+
+__all__ = ["JsonModelServer", "JsonRemoteInference"]

@@ -1,0 +1,11 @@
+"""Training UI (reference: deeplearning4j-ui-parent — StatsListener,
+StatsStorage, VertxUIServer dashboard. SURVEY.md §2.34)."""
+
+from deeplearning4j_tpu.ui.stats import StatsListener
+from deeplearning4j_tpu.ui.storage import (
+    FileStatsStorage, InMemoryStatsStorage, StatsStorage,
+)
+from deeplearning4j_tpu.ui.server import UIServer
+
+__all__ = ["StatsListener", "StatsStorage", "InMemoryStatsStorage",
+           "FileStatsStorage", "UIServer"]

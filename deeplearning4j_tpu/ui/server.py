@@ -1,0 +1,209 @@
+"""Training UI web server.
+
+Reference: org/deeplearning4j/ui/VertxUIServer (older: Play framework) —
+`UIServer.getInstance().attach(statsStorage)` then browse
+http://localhost:9000/train (SURVEY.md §2.34).
+
+TPU-era design: a dependency-free stdlib `http.server` running in a
+daemon thread, serving JSON endpoints plus a single self-contained HTML
+dashboard (inline canvas charts — the build environment has zero egress,
+so no CDN scripts). Endpoints:
+
+    GET /train/sessions                     -> ["<sid>", ...]
+    GET /train/<sid>/overview               -> score curve, rates, memory
+    GET /train/<sid>/model                  -> static info + latest layer stats
+    GET /                                   -> dashboard HTML
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import List, Optional
+
+from deeplearning4j_tpu.ui.stats import TYPE_ID
+from deeplearning4j_tpu.ui.storage import StatsStorage
+
+_DASHBOARD_HTML = """<!doctype html>
+<html><head><meta charset="utf-8"><title>DL4J-TPU Training UI</title>
+<style>
+ body{font-family:sans-serif;margin:20px;background:#fafafa}
+ h1{font-size:20px} .card{background:#fff;border:1px solid #ddd;
+ border-radius:6px;padding:12px;margin:12px 0}
+ canvas{width:100%;height:220px} pre{overflow:auto}
+</style></head><body>
+<h1>DL4J-TPU Training UI</h1>
+<div class="card"><b>Session:</b> <select id="sess"></select>
+ <span id="meta"></span></div>
+<div class="card"><b>Score vs iteration</b><canvas id="score"
+ width="900" height="220"></canvas></div>
+<div class="card"><b>Layer parameter mean magnitudes</b>
+ <pre id="layers"></pre></div>
+<script>
+async function j(u){const r=await fetch(u);return r.json()}
+function draw(cv,xs,ys){const c=cv.getContext('2d');
+ c.clearRect(0,0,cv.width,cv.height);if(!xs.length)return;
+ const xmin=Math.min(...xs),xmax=Math.max(...xs)||1;
+ const ymin=Math.min(...ys),ymax=Math.max(...ys)||1;
+ c.strokeStyle='#2a6';c.beginPath();
+ xs.forEach((x,i)=>{const px=(x-xmin)/(xmax-xmin||1)*(cv.width-40)+30;
+  const py=cv.height-20-(ys[i]-ymin)/(ymax-ymin||1)*(cv.height-40);
+  i?c.lineTo(px,py):c.moveTo(px,py)});c.stroke();
+ c.fillStyle='#333';c.fillText(ymax.toPrecision(4),2,12);
+ c.fillText(ymin.toPrecision(4),2,cv.height-8)}
+async function refresh(){const sid=document.getElementById('sess').value;
+ if(!sid)return;const ov=await j('/train/'+sid+'/overview');
+ draw(document.getElementById('score'),ov.iterations,ov.scores);
+ const m=await j('/train/'+sid+'/model');
+ document.getElementById('meta').textContent=
+  ' params='+(m.static?m.static.num_params:'?')+
+  ' backend='+(m.static?m.static.jax_backend:'?');
+ const L=m.latest&&m.latest.param_stats?m.latest.param_stats:{};
+ document.getElementById('layers').textContent=Object.entries(L)
+  .map(([k,v])=>k+': mean|w|='+v.mean_mag.toPrecision(4)+
+   ' std='+v.std.toPrecision(4)).join('\\n')}
+async function init(){const ss=await j('/train/sessions');
+ const sel=document.getElementById('sess');sel.innerHTML='';
+ ss.forEach(s=>{const o=document.createElement('option');
+  o.value=o.textContent=s;sel.appendChild(o)});
+ sel.onchange=refresh;refresh();setInterval(refresh,2000)}
+init();
+</script></body></html>"""
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "DL4JTPUUIServer/1.0"
+
+    def log_message(self, *args):  # silence request logging
+        pass
+
+    def _json(self, obj, code=200):
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        ui: "UIServer" = self.server.ui_server  # type: ignore[attr-defined]
+        parts = [p for p in self.path.split("?")[0].split("/") if p]
+        if not parts:
+            body = _DASHBOARD_HTML.encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/html")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
+        if parts[0] != "train":
+            return self._json({"error": "not found"}, 404)
+        if len(parts) == 2 and parts[1] == "sessions":
+            return self._json(ui._sessions())
+        if len(parts) == 3:
+            sid, what = parts[1], parts[2]
+            if what == "overview":
+                return self._json(ui._overview(sid))
+            if what == "model":
+                return self._json(ui._model(sid))
+        return self._json({"error": "not found"}, 404)
+
+
+class UIServer:
+    """Singleton server; `attach` any number of StatsStorage instances
+    (reference: UIServer.getInstance().attach(storage))."""
+
+    _instance: Optional["UIServer"] = None
+
+    def __init__(self):
+        self._storages: List[StatsStorage] = []
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self._port: Optional[int] = None
+
+    @classmethod
+    def getInstance(cls) -> "UIServer":
+        if cls._instance is None:
+            cls._instance = UIServer()
+        return cls._instance
+
+    # -- storage management --------------------------------------------
+    def attach(self, storage: StatsStorage) -> None:
+        if storage not in self._storages:
+            self._storages.append(storage)
+
+    def detach(self, storage: StatsStorage) -> None:
+        if storage in self._storages:
+            self._storages.remove(storage)
+
+    # -- lifecycle ------------------------------------------------------
+    def start(self, port: int = 9000) -> int:
+        """Start serving; port=0 picks a free port. Returns the port."""
+        if self._httpd is not None:
+            return self._port  # already running
+        httpd = ThreadingHTTPServer(("127.0.0.1", port), _Handler)
+        httpd.ui_server = self  # type: ignore[attr-defined]
+        self._httpd = httpd
+        self._port = httpd.server_address[1]
+        self._thread = threading.Thread(target=httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self._port
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+            self._thread = None
+
+    @property
+    def port(self) -> Optional[int]:
+        return self._port
+
+    # -- data assembly for endpoints ------------------------------------
+    def _sessions(self) -> List[str]:
+        out = []
+        for st in self._storages:
+            out.extend(st.listSessionIDs())
+        return sorted(set(out))
+
+    def _find(self, sid: str):
+        for st in self._storages:
+            if sid in st.listSessionIDs():
+                return st
+        return None
+
+    def _overview(self, sid: str) -> dict:
+        st = self._find(sid)
+        if st is None:
+            return {"error": "unknown session"}
+        iters, scores, rates, mem = [], [], [], []
+        for wid in st.listWorkerIDsForSession(sid):
+            for u in st.getAllUpdatesAfter(sid, TYPE_ID, wid, 0.0):
+                iters.append(u.get("iteration"))
+                scores.append(u.get("score"))
+                rates.append(u.get("minibatches_per_sec"))
+                mem.append(u.get("memory", {}))
+        order = sorted(range(len(iters)), key=lambda i: iters[i] or 0)
+        return {
+            "iterations": [iters[i] for i in order],
+            "scores": [scores[i] for i in order],
+            "minibatches_per_sec": [rates[i] for i in order],
+            "memory": [mem[i] for i in order],
+        }
+
+    def _model(self, sid: str) -> dict:
+        st = self._find(sid)
+        if st is None:
+            return {"error": "unknown session"}
+        workers = st.listWorkerIDsForSession(sid)
+        static = latest = None
+        for wid in workers:
+            static = static or st.getStaticInfo(sid, TYPE_ID, wid)
+            latest = latest or st.getLatestUpdate(sid, TYPE_ID, wid)
+        return {"static": static, "latest": latest}
+
+
+__all__ = ["UIServer"]

@@ -1,0 +1,154 @@
+"""Stats storage backends for the training UI.
+
+Reference: deeplearning4j-ui-parent — org/deeplearning4j/ui/storage/
+InMemoryStatsStorage and FileStatsStorage (MapDB-backed), behind the
+org/deeplearning4j/api/storage/StatsStorage interface (SURVEY.md §2.34).
+
+Records are plain dicts (JSON-serializable), keyed by
+(session_id, type_id, worker_id); static infos and per-iteration updates
+are kept separately, mirroring the reference's Persistable split.
+FileStatsStorage is an append-only JSON-lines log (replayed on open) —
+the TPU-era stand-in for MapDB that stays human-debuggable.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+
+class StatsStorage:
+    """In-memory base implementation (reference: BaseCollectionStatsStorage)."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        # (session, type, worker) -> list of update dicts (time-ordered)
+        self._updates: Dict[Tuple[str, str, str], List[dict]] = {}
+        # (session, type, worker) -> static info dict
+        self._static: Dict[Tuple[str, str, str], dict] = {}
+        self._listeners: List[Callable[[dict], None]] = []
+
+    # -- write side (used by StatsListener) -----------------------------
+    def putStaticInfo(self, session_id: str, type_id: str, worker_id: str,
+                      info: dict) -> None:
+        with self._lock:
+            self._static[(session_id, type_id, worker_id)] = dict(info)
+        self._notify({"event": "static", "session": session_id})
+
+    def putUpdate(self, session_id: str, type_id: str, worker_id: str,
+                  update: dict) -> None:
+        rec = dict(update)
+        rec.setdefault("timestamp", time.time())
+        with self._lock:
+            self._updates.setdefault(
+                (session_id, type_id, worker_id), []).append(rec)
+        self._notify({"event": "update", "session": session_id})
+
+    # -- read side (used by the UI server) ------------------------------
+    def listSessionIDs(self) -> List[str]:
+        with self._lock:
+            keys = set(k[0] for k in self._updates) | \
+                set(k[0] for k in self._static)
+        return sorted(keys)
+
+    def listTypeIDsForSession(self, session_id: str) -> List[str]:
+        with self._lock:
+            return sorted({k[1] for k in (*self._updates, *self._static)
+                           if k[0] == session_id})
+
+    def listWorkerIDsForSession(self, session_id: str) -> List[str]:
+        with self._lock:
+            return sorted({k[2] for k in (*self._updates, *self._static)
+                           if k[0] == session_id})
+
+    def getStaticInfo(self, session_id: str, type_id: str,
+                      worker_id: str) -> Optional[dict]:
+        with self._lock:
+            return self._static.get((session_id, type_id, worker_id))
+
+    def getAllUpdatesAfter(self, session_id: str, type_id: str,
+                           worker_id: str, timestamp: float = 0.0
+                           ) -> List[dict]:
+        with self._lock:
+            ups = self._updates.get((session_id, type_id, worker_id), [])
+            return [u for u in ups if u["timestamp"] > timestamp]
+
+    def getLatestUpdate(self, session_id: str, type_id: str,
+                        worker_id: str) -> Optional[dict]:
+        with self._lock:
+            ups = self._updates.get((session_id, type_id, worker_id))
+            return ups[-1] if ups else None
+
+    # -- routing (reference: StatsStorageRouter/StatsStorageListener) ---
+    def registerStatsStorageListener(self, fn: Callable[[dict], None]) -> None:
+        self._listeners.append(fn)
+
+    def _notify(self, event: dict) -> None:
+        for fn in list(self._listeners):
+            try:
+                fn(event)
+            except Exception:
+                pass
+
+    def close(self) -> None:
+        pass
+
+
+class InMemoryStatsStorage(StatsStorage):
+    """Pure in-memory storage (reference: InMemoryStatsStorage)."""
+
+
+class FileStatsStorage(StatsStorage):
+    """Append-only JSON-lines file storage; replays the log on open so a
+    dashboard can inspect a finished/crashed run (reference:
+    FileStatsStorage on MapDB — same durability contract, simpler
+    format)."""
+
+    def __init__(self, path: str):
+        super().__init__()
+        self._path = path
+        self._file_lock = threading.Lock()
+        if os.path.exists(path):
+            self._replay()
+        self._fh = open(path, "a", encoding="utf-8")
+
+    def _replay(self) -> None:
+        with open(self._path, encoding="utf-8") as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                rec = json.loads(line)
+                key = (rec["session"], rec["type"], rec["worker"])
+                if rec["kind"] == "static":
+                    self._static[key] = rec["data"]
+                else:
+                    self._updates.setdefault(key, []).append(rec["data"])
+
+    def _append(self, kind: str, session: str, type_id: str, worker: str,
+                data: dict) -> None:
+        with self._file_lock:
+            self._fh.write(json.dumps(
+                {"kind": kind, "session": session, "type": type_id,
+                 "worker": worker, "data": data}) + "\n")
+            self._fh.flush()
+
+    def putStaticInfo(self, session_id, type_id, worker_id, info):
+        super().putStaticInfo(session_id, type_id, worker_id, info)
+        self._append("static", session_id, type_id, worker_id, dict(info))
+
+    def putUpdate(self, session_id, type_id, worker_id, update):
+        rec = dict(update)
+        rec.setdefault("timestamp", time.time())
+        super().putUpdate(session_id, type_id, worker_id, rec)
+        self._append("update", session_id, type_id, worker_id, rec)
+
+    def close(self) -> None:
+        with self._file_lock:
+            self._fh.close()
+
+
+__all__ = ["StatsStorage", "InMemoryStatsStorage", "FileStatsStorage"]

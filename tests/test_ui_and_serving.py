@@ -1,0 +1,144 @@
+"""Training UI (StatsListener/StatsStorage/UIServer) and the JSON
+inference server.
+
+Reference: deeplearning4j-ui-parent (SURVEY.md §2.34) and
+deeplearning4j-remote JsonModelServer (§2.36).
+"""
+
+import json
+import os
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.learning.updaters import Adam
+from deeplearning4j_tpu.nn.conf import (
+    DenseLayer, InputType, NeuralNetConfiguration, OutputLayer,
+)
+from deeplearning4j_tpu.nn.multilayer.network import MultiLayerNetwork
+from deeplearning4j_tpu.ui import (
+    FileStatsStorage, InMemoryStatsStorage, StatsListener, UIServer,
+)
+from deeplearning4j_tpu.ui.stats import TYPE_ID
+
+
+def _net():
+    conf = (NeuralNetConfiguration.builder().seed(1).updater(Adam(1e-2))
+            .list()
+            .layer(DenseLayer(n_out=8, activation="relu"))
+            .layer(OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+            .setInputType(InputType.feedForward(4))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _fit_some(net, listener, iters=5):
+    rs = np.random.RandomState(0)
+    x = rs.randn(16, 4).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[rs.randint(0, 2, 16)]
+    net.setListeners(listener)
+    for _ in range(iters):
+        net.fit(x, y)
+
+
+class TestStatsStorage:
+    def test_listener_collects(self):
+        st = InMemoryStatsStorage()
+        lst = StatsListener(st, session_id="s1", worker_id="w1")
+        net = _net()
+        _fit_some(net, lst, 4)
+        assert st.listSessionIDs() == ["s1"]
+        ups = st.getAllUpdatesAfter("s1", TYPE_ID, "w1", 0.0)
+        assert len(ups) == 4
+        assert all(np.isfinite(u["score"]) for u in ups)
+        assert "param_stats" in ups[-1]
+        assert "0_W" in ups[-1]["param_stats"]
+        info = st.getStaticInfo("s1", TYPE_ID, "w1")
+        assert info["num_params"] == net.numParams()
+
+    def test_frequency(self):
+        st = InMemoryStatsStorage()
+        lst = StatsListener(st, frequency=2, session_id="s2", worker_id="w")
+        _fit_some(_net(), lst, 6)
+        # iterations 2,4,6 report
+        assert len(st.getAllUpdatesAfter("s2", TYPE_ID, "w", 0.0)) == 3
+
+    def test_file_storage_replay(self, tmp_path):
+        path = str(tmp_path / "stats.jsonl")
+        st = FileStatsStorage(path)
+        lst = StatsListener(st, session_id="s3", worker_id="w")
+        _fit_some(_net(), lst, 3)
+        st.close()
+        st2 = FileStatsStorage(path)
+        assert st2.listSessionIDs() == ["s3"]
+        assert len(st2.getAllUpdatesAfter("s3", TYPE_ID, "w", 0.0)) == 3
+        st2.close()
+
+
+class TestUIServer:
+    def test_endpoints(self):
+        st = InMemoryStatsStorage()
+        lst = StatsListener(st, session_id="ui1", worker_id="w")
+        _fit_some(_net(), lst, 3)
+        ui = UIServer()   # fresh instance; do not pollute the singleton
+        ui.attach(st)
+        port = ui.start(0)
+        try:
+            base = f"http://127.0.0.1:{port}"
+            sessions = json.loads(urllib.request.urlopen(
+                base + "/train/sessions").read())
+            assert sessions == ["ui1"]
+            ov = json.loads(urllib.request.urlopen(
+                base + "/train/ui1/overview").read())
+            assert len(ov["iterations"]) == 3
+            assert all(np.isfinite(s) for s in ov["scores"])
+            model = json.loads(urllib.request.urlopen(
+                base + "/train/ui1/model").read())
+            assert model["static"]["model_class"] == "MultiLayerNetwork"
+            html = urllib.request.urlopen(base + "/").read().decode()
+            assert "Training UI" in html
+        finally:
+            ui.stop()
+
+    def test_singleton(self):
+        a = UIServer.getInstance()
+        b = UIServer.getInstance()
+        assert a is b
+
+
+class TestJsonModelServer:
+    def test_round_trip(self):
+        from deeplearning4j_tpu.remote import (
+            JsonModelServer, JsonRemoteInference,
+        )
+        net = _net()
+        server = JsonModelServer(net)
+        port = server.start()
+        try:
+            client = JsonRemoteInference(f"http://127.0.0.1:{port}")
+            x = np.random.RandomState(1).randn(3, 4).astype(np.float32)
+            remote = client.predict(x)
+            local = net.output(x).toNumpy()
+            np.testing.assert_allclose(remote, local, rtol=1e-5, atol=1e-6)
+            # info endpoint
+            info = json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/v1/serving/info").read())
+            assert info["num_params"] == net.numParams()
+        finally:
+            server.stop()
+
+    def test_bad_payload_400(self):
+        from deeplearning4j_tpu.remote import JsonModelServer
+        server = JsonModelServer(_net())
+        port = server.start()
+        try:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/v1/serving/predict",
+                data=b'{"wrong": 1}',
+                headers={"Content-Type": "application/json"})
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req)
+            assert ei.value.code == 400
+        finally:
+            server.stop()
