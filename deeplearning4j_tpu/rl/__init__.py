@@ -1,0 +1,24 @@
+"""Reinforcement learning (reference: rl4j/** — QLearning/DQN, A3C,
+policies, MDP abstraction, experience replay. SURVEY.md §2.41).
+
+TPU-first redesign notes:
+- The value/policy networks are jax pytrees with ONE jitted update step
+  (replay batch in, new params out) instead of rl4j's per-op eager path.
+- rl4j's A3C (async Hogwild workers) does not map to XLA's compilation
+  model; the equivalent here is synchronous vectorized A2C — the same
+  advantage-actor-critic math, batched over parallel env instances, one
+  compiled update per step (the standard accelerator-era replacement).
+"""
+
+from deeplearning4j_tpu.rl.mdp import MDP, GridWorldMDP, CorridorMDP
+from deeplearning4j_tpu.rl.replay import ExpReplay, Transition
+from deeplearning4j_tpu.rl.policy import (
+    DQNPolicy, EpsGreedy, Policy, ACPolicy,
+)
+from deeplearning4j_tpu.rl.qlearning import QLearningDiscreteDense, QLConfiguration
+from deeplearning4j_tpu.rl.a2c import A2CDiscreteDense, A2CConfiguration
+
+__all__ = ["MDP", "GridWorldMDP", "CorridorMDP", "ExpReplay", "Transition",
+           "Policy", "EpsGreedy", "DQNPolicy", "ACPolicy",
+           "QLearningDiscreteDense", "QLConfiguration",
+           "A2CDiscreteDense", "A2CConfiguration"]

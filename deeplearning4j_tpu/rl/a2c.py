@@ -1,0 +1,135 @@
+"""Synchronous advantage actor-critic (reference: rl4j A3CDiscreteDense —
+org/deeplearning4j/rl4j/learning/async/a3c/discrete/**).
+
+rl4j's A3C runs async Hogwild workers mutating shared nets — a CPU-era
+pattern that is hostile to XLA (per-worker eager updates, no batching).
+The TPU-idiomatic equivalent keeps the same math (n-step advantage
+policy gradient + value regression + entropy bonus) but runs K env
+copies in lockstep on the host and does ONE jitted update per rollout
+with the batched trajectories.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.learning.updaters import Adam, apply_updater
+from deeplearning4j_tpu.rl.mdp import MDP
+from deeplearning4j_tpu.rl.policy import ACPolicy
+from deeplearning4j_tpu.rl.qlearning import _init_mlp, _mlp
+
+
+@dataclasses.dataclass
+class A2CConfiguration:
+    seed: int = 0
+    gamma: float = 0.99
+    n_step: int = 8                  # rollout length (reference: nstep)
+    n_envs: int = 8                  # parallel env copies (replaces workers)
+    learning_rate: float = 7e-4
+    entropy_coef: float = 0.01
+    value_coef: float = 0.5
+    hidden: tuple = (64,)
+
+
+class A2CDiscreteDense:
+    def __init__(self, mdp_factory: Callable[[], MDP],
+                 conf: Optional[A2CConfiguration] = None):
+        self.conf = conf or A2CConfiguration()
+        c = self.conf
+        self.envs = [mdp_factory() for _ in range(c.n_envs)]
+        m = self.envs[0]
+        key = jax.random.key(c.seed)
+        k1, k2 = jax.random.split(key)
+        trunk = (m.obs_size,) + tuple(c.hidden)
+        self.actor = _init_mlp(k1, trunk + (m.n_actions,))
+        self.critic = _init_mlp(k2, trunk + (1,))
+        self._updater = Adam(learning_rate=c.learning_rate)
+        self._opt_state = self._updater.init_state(
+            {"actor": self.actor, "critic": self.critic})
+        self._probs = jax.jit(
+            lambda p, x: jax.nn.softmax(_mlp(p, x), -1))
+        self.episode_rewards: List[float] = []
+        gamma, ec, vc = c.gamma, c.entropy_coef, c.value_coef
+
+        def update(nets, opt_state, it, obs, act, ret):
+            def loss_fn(n):
+                logits = _mlp(n["actor"], obs)
+                logp = jax.nn.log_softmax(logits, -1)
+                p = jnp.exp(logp)
+                v = _mlp(n["critic"], obs)[:, 0]
+                adv = jax.lax.stop_gradient(ret - v)
+                sel = jnp.take_along_axis(logp,
+                                          act[:, None].astype(jnp.int32),
+                                          -1)[:, 0]
+                pg = -jnp.mean(sel * adv)
+                vloss = jnp.mean((ret - v) ** 2)
+                ent = -jnp.mean(jnp.sum(p * logp, -1))
+                return pg + vc * vloss - ec * ent
+
+            loss, grads = jax.value_and_grad(loss_fn)(nets)
+            updates, new_opt = apply_updater(self._updater, opt_state,
+                                             grads, nets, it)
+            new_nets = jax.tree_util.tree_map(lambda p, u: p - u, nets,
+                                              updates)
+            return new_nets, new_opt, loss
+
+        self._update = jax.jit(update, donate_argnums=(0, 1))
+
+    def getPolicy(self, greedy: bool = True) -> ACPolicy:
+        return ACPolicy(
+            lambda x: np.asarray(self._probs(self.actor, jnp.asarray(x))),
+            greedy=greedy, seed=self.conf.seed)
+
+    def train(self, updates: int = 200) -> List[float]:
+        c = self.conf
+        rng = np.random.RandomState(c.seed)
+        obs = [e.reset() for e in self.envs]
+        ep_r = [0.0] * c.n_envs
+        it = 0
+        for _ in range(updates):
+            traj_obs, traj_act, traj_rew, traj_done = [], [], [], []
+            for _ in range(c.n_step):
+                probs = np.asarray(self._probs(
+                    self.actor, jnp.asarray(np.stack(obs))))
+                acts = [int(rng.choice(len(p), p=p / p.sum()))
+                        for p in probs]
+                step_out = [e.step(a) for e, a in zip(self.envs, acts)]
+                traj_obs.append(np.stack(obs))
+                traj_act.append(np.asarray(acts, np.int32))
+                traj_rew.append(np.asarray([s[1] for s in step_out],
+                                           np.float32))
+                traj_done.append(np.asarray([s[2] for s in step_out],
+                                            np.float32))
+                for i, (o, r, d, _info) in enumerate(step_out):
+                    ep_r[i] += r
+                    if d:
+                        self.episode_rewards.append(ep_r[i])
+                        ep_r[i] = 0.0
+                        obs[i] = self.envs[i].reset()
+                    else:
+                        obs[i] = o
+            # n-step returns bootstrapped from the critic
+            last_v = np.asarray(_mlp(self.critic,
+                                     jnp.asarray(np.stack(obs))))[:, 0]
+            rets = np.zeros((c.n_step, c.n_envs), np.float32)
+            running = last_v
+            for t in reversed(range(c.n_step)):
+                running = traj_rew[t] + c.gamma * running * (1 - traj_done[t])
+                rets[t] = running
+            nets = {"actor": self.actor, "critic": self.critic}
+            nets, self._opt_state, _ = self._update(
+                nets, self._opt_state, jnp.asarray(it),
+                jnp.asarray(np.concatenate(traj_obs)),
+                jnp.asarray(np.concatenate(traj_act)),
+                jnp.asarray(rets.reshape(-1)))
+            self.actor, self.critic = nets["actor"], nets["critic"]
+            it += 1
+        return self.episode_rewards
+
+
+__all__ = ["A2CDiscreteDense", "A2CConfiguration"]

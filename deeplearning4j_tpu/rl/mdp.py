@@ -1,0 +1,112 @@
+"""MDP abstraction + in-repo test environments.
+
+Reference: rl4j-api org/deeplearning4j/rl4j/mdp/MDP (reset/step/close,
+getActionSpace/getObservationSpace) — gym-style. The test envs replace
+rl4j's gym-java-client dependency (no egress, no gym): small exact
+MDPs with known optimal returns, the same role BaseSparkTest plays for
+Spark (SURVEY.md §4 distributed-without-cluster philosophy).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+
+class MDP:
+    """reset() -> obs; step(a) -> (obs, reward, done, info)."""
+
+    @property
+    def obs_size(self) -> int:
+        raise NotImplementedError
+
+    @property
+    def n_actions(self) -> int:
+        raise NotImplementedError
+
+    def reset(self) -> np.ndarray:
+        raise NotImplementedError
+
+    def step(self, action: int) -> Tuple[np.ndarray, float, bool, dict]:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class CorridorMDP(MDP):
+    """1-D corridor: start left, +1 at the right end, -0.01 per step.
+    Optimal: always move right."""
+
+    def __init__(self, length: int = 8, max_steps: int = 40):
+        self.length = length
+        self.max_steps = max_steps
+        self._pos = 0
+        self._t = 0
+
+    @property
+    def obs_size(self) -> int:
+        return self.length
+
+    @property
+    def n_actions(self) -> int:
+        return 2  # left, right
+
+    def _obs(self) -> np.ndarray:
+        o = np.zeros(self.length, np.float32)
+        o[self._pos] = 1.0
+        return o
+
+    def reset(self) -> np.ndarray:
+        self._pos, self._t = 0, 0
+        return self._obs()
+
+    def step(self, action: int):
+        self._t += 1
+        self._pos = min(max(self._pos + (1 if action == 1 else -1), 0),
+                        self.length - 1)
+        done = self._pos == self.length - 1 or self._t >= self.max_steps
+        reward = 1.0 if self._pos == self.length - 1 else -0.01
+        return self._obs(), reward, done, {}
+
+
+class GridWorldMDP(MDP):
+    """n x n grid, start top-left, goal bottom-right (+1), step cost
+    -0.01, falling off walls = no-op. Actions: up/down/left/right."""
+
+    def __init__(self, n: int = 4, max_steps: int = 60):
+        self.n = n
+        self.max_steps = max_steps
+        self._pos = (0, 0)
+        self._t = 0
+
+    @property
+    def obs_size(self) -> int:
+        return self.n * self.n
+
+    @property
+    def n_actions(self) -> int:
+        return 4
+
+    def _obs(self) -> np.ndarray:
+        o = np.zeros(self.n * self.n, np.float32)
+        o[self._pos[0] * self.n + self._pos[1]] = 1.0
+        return o
+
+    def reset(self) -> np.ndarray:
+        self._pos, self._t = (0, 0), 0
+        return self._obs()
+
+    def step(self, action: int):
+        self._t += 1
+        r, c = self._pos
+        dr, dc = [(-1, 0), (1, 0), (0, -1), (0, 1)][action]
+        self._pos = (min(max(r + dr, 0), self.n - 1),
+                     min(max(c + dc, 0), self.n - 1))
+        at_goal = self._pos == (self.n - 1, self.n - 1)
+        done = at_goal or self._t >= self.max_steps
+        return self._obs(), (1.0 if at_goal else -0.01), done, {}
+
+
+__all__ = ["MDP", "CorridorMDP", "GridWorldMDP"]

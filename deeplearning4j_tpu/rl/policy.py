@@ -1,0 +1,88 @@
+"""Policies (reference: rl4j org/deeplearning4j/rl4j/policy/{Policy,
+DQNPolicy,EpsGreedy,ACPolicy})."""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import numpy as np
+
+
+class Policy:
+    def next_action(self, obs: np.ndarray) -> int:
+        raise NotImplementedError
+
+    # reference naming
+    def nextAction(self, obs: np.ndarray) -> int:
+        return self.next_action(obs)
+
+    def play(self, mdp, max_steps: int = 10_000) -> float:
+        """Run one episode greedily, return total reward (reference:
+        Policy#play)."""
+        obs = mdp.reset()
+        total = 0.0
+        for _ in range(max_steps):
+            obs, r, done, _ = mdp.step(self.next_action(obs))
+            total += r
+            if done:
+                break
+        return total
+
+
+class DQNPolicy(Policy):
+    """Greedy argmax over Q-values."""
+
+    def __init__(self, q_fn: Callable[[np.ndarray], np.ndarray]):
+        self.q_fn = q_fn
+
+    def next_action(self, obs: np.ndarray) -> int:
+        return int(np.argmax(self.q_fn(obs[None])[0]))
+
+
+class EpsGreedy(Policy):
+    """Annealed epsilon-greedy exploration wrapper (reference: EpsGreedy
+    with epsilonNbStep/minEpsilon)."""
+
+    def __init__(self, inner: Policy, n_actions: int, eps_start: float = 1.0,
+                 eps_min: float = 0.1, anneal_steps: int = 10_000,
+                 seed: int = 0):
+        self.inner = inner
+        self.n_actions = n_actions
+        self.eps_start = eps_start
+        self.eps_min = eps_min
+        self.anneal_steps = max(anneal_steps, 1)
+        self._step = 0
+        self._rng = np.random.RandomState(seed)
+
+    @property
+    def epsilon(self) -> float:
+        frac = min(self._step / self.anneal_steps, 1.0)
+        return self.eps_start + (self.eps_min - self.eps_start) * frac
+
+    def next_action(self, obs: np.ndarray) -> int:
+        eps = self.epsilon
+        self._step += 1
+        if self._rng.rand() < eps:
+            return int(self._rng.randint(self.n_actions))
+        return self.inner.next_action(obs)
+
+
+class ACPolicy(Policy):
+    """Samples from the actor's categorical distribution (reference:
+    ACPolicy); `greedy=True` takes the argmax instead."""
+
+    def __init__(self, prob_fn: Callable[[np.ndarray], np.ndarray],
+                 greedy: bool = False, seed: int = 0):
+        self.prob_fn = prob_fn
+        self.greedy = greedy
+        self._rng = np.random.RandomState(seed)
+
+    def next_action(self, obs: np.ndarray) -> int:
+        p = np.asarray(self.prob_fn(obs[None])[0], np.float64)
+        p = p / p.sum()
+        if self.greedy:
+            return int(np.argmax(p))
+        return int(self._rng.choice(len(p), p=p))
+
+
+__all__ = ["Policy", "DQNPolicy", "EpsGreedy", "ACPolicy"]

@@ -1,0 +1,151 @@
+"""Deep Q-learning (reference: rl4j org/deeplearning4j/rl4j/learning/
+sync/qlearning/discrete/QLearningDiscreteDense + QLearning.QLConfiguration
++ network factory DQNFactoryStdDense).
+
+TPU design: the Q-network is a plain pytree MLP; TD update (gather the
+taken action's Q, bootstrap from the target network, Huber-free MSE as
+in the reference, Adam) is ONE jitted function. Target-network sync is a
+pytree copy every `target_dqn_update_freq` steps. Double-DQN selects
+argmax with the online net and evaluates with the target net.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.learning.updaters import Adam, apply_updater
+from deeplearning4j_tpu.rl.mdp import MDP
+from deeplearning4j_tpu.rl.policy import DQNPolicy, EpsGreedy
+from deeplearning4j_tpu.rl.replay import ExpReplay, Transition
+
+
+@dataclasses.dataclass
+class QLConfiguration:
+    """Mirror of QLearning.QLConfiguration (reference fields kept)."""
+
+    seed: int = 0
+    max_step: int = 20_000
+    exp_replay_size: int = 10_000
+    batch_size: int = 32
+    target_dqn_update_freq: int = 100
+    update_start: int = 100           # warm-up transitions before learning
+    gamma: float = 0.99
+    eps_start: float = 1.0
+    min_epsilon: float = 0.05
+    epsilon_nb_step: int = 3000
+    double_dqn: bool = True
+    learning_rate: float = 1e-3
+    hidden: tuple = (64, 64)
+
+
+def _init_mlp(key, sizes, dtype=jnp.float32) -> List[dict]:
+    params = []
+    for i in range(len(sizes) - 1):
+        key, sub = jax.random.split(key)
+        scale = jnp.sqrt(2.0 / sizes[i])
+        params.append({
+            "W": jax.random.normal(sub, (sizes[i], sizes[i + 1]),
+                                   dtype) * scale,
+            "b": jnp.zeros((sizes[i + 1],), dtype),
+        })
+    return params
+
+
+def _mlp(params, x):
+    for i, p in enumerate(params):
+        x = x @ p["W"] + p["b"]
+        if i < len(params) - 1:
+            x = jax.nn.relu(x)
+    return x
+
+
+class QLearningDiscreteDense:
+    def __init__(self, mdp: MDP, conf: Optional[QLConfiguration] = None):
+        self.mdp = mdp
+        self.conf = conf or QLConfiguration()
+        c = self.conf
+        key = jax.random.key(c.seed)
+        sizes = (mdp.obs_size,) + tuple(c.hidden) + (mdp.n_actions,)
+        self.params = _init_mlp(key, sizes)
+        self.target_params = jax.tree_util.tree_map(lambda a: a, self.params)
+        self._updater = Adam(learning_rate=c.learning_rate)
+        self._opt_state = self._updater.init_state(self.params)
+        self.replay = ExpReplay(c.exp_replay_size, mdp.obs_size, seed=c.seed)
+        self._q = jax.jit(_mlp)
+        self._steps = 0
+        self.episode_rewards: List[float] = []
+
+        gamma, double = c.gamma, c.double_dqn
+
+        def td_step(params, target_params, opt_state, it, obs, act, rew,
+                    nobs, done):
+            if double:
+                sel = jnp.argmax(_mlp(params, nobs), -1)
+                qn = jnp.take_along_axis(_mlp(target_params, nobs),
+                                         sel[:, None], -1)[:, 0]
+            else:
+                qn = jnp.max(_mlp(target_params, nobs), -1)
+            target = rew + gamma * (1.0 - done) * qn
+            target = jax.lax.stop_gradient(target)
+
+            def loss_fn(p):
+                q = jnp.take_along_axis(_mlp(p, obs),
+                                        act[:, None].astype(jnp.int32),
+                                        -1)[:, 0]
+                return jnp.mean((q - target) ** 2)
+
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            updates, new_opt = apply_updater(self._updater, opt_state,
+                                             grads, params, it)
+            new_params = jax.tree_util.tree_map(lambda p, u: p - u, params,
+                                                updates)
+            return new_params, new_opt, loss
+
+        # NB: params cannot be donated here — target_params aliases the
+        # same buffers right after every sync (f(donate(a), a) is invalid)
+        self._td_step = jax.jit(td_step, donate_argnums=(2,))
+
+    # -- q-value access -------------------------------------------------
+    def q_values(self, obs: np.ndarray) -> np.ndarray:
+        return np.asarray(self._q(self.params, jnp.asarray(obs)))
+
+    def getPolicy(self) -> DQNPolicy:
+        return DQNPolicy(self.q_values)
+
+    # -- training loop (reference: QLearningDiscrete#trainStep) ---------
+    def train(self, max_steps: Optional[int] = None) -> List[float]:
+        c = self.conf
+        limit = max_steps or c.max_step
+        policy = EpsGreedy(self.getPolicy(), self.mdp.n_actions,
+                           eps_start=c.eps_start, eps_min=c.min_epsilon,
+                           anneal_steps=c.epsilon_nb_step, seed=c.seed)
+        obs = self.mdp.reset()
+        ep_reward = 0.0
+        while self._steps < limit:
+            a = policy.next_action(obs)
+            nobs, r, done, _ = self.mdp.step(a)
+            self.replay.store(Transition(obs, a, r, nobs, done))
+            ep_reward += r
+            obs = nobs
+            self._steps += 1
+            if done:
+                self.episode_rewards.append(ep_reward)
+                ep_reward = 0.0
+                obs = self.mdp.reset()
+            if len(self.replay) >= max(c.update_start, c.batch_size):
+                b = self.replay.sample(c.batch_size)
+                self.params, self._opt_state, _ = self._td_step(
+                    self.params, self.target_params, self._opt_state,
+                    jnp.asarray(self._steps), *map(jnp.asarray, b))
+                if self._steps % c.target_dqn_update_freq == 0:
+                    self.target_params = jax.tree_util.tree_map(
+                        lambda a: a, self.params)
+        return self.episode_rewards
+
+
+__all__ = ["QLearningDiscreteDense", "QLConfiguration"]

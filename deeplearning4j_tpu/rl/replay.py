@@ -1,0 +1,56 @@
+"""Experience replay (reference: rl4j org/deeplearning4j/rl4j/learning/
+sync/ExpReplay — circular store + uniform minibatch sampling)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class Transition:
+    obs: np.ndarray
+    action: int
+    reward: float
+    next_obs: np.ndarray
+    done: bool
+
+
+class ExpReplay:
+    """Preallocated circular buffer; sample() returns stacked arrays
+    ready for the jitted update (one host->device transfer per batch)."""
+
+    def __init__(self, max_size: int, obs_size: int,
+                 seed: int = 0):
+        self.max_size = max_size
+        self._obs = np.zeros((max_size, obs_size), np.float32)
+        self._act = np.zeros(max_size, np.int32)
+        self._rew = np.zeros(max_size, np.float32)
+        self._nobs = np.zeros((max_size, obs_size), np.float32)
+        self._done = np.zeros(max_size, np.float32)
+        self._n = 0
+        self._i = 0
+        self._rng = np.random.RandomState(seed)
+
+    def __len__(self) -> int:
+        return self._n
+
+    def store(self, t: Transition) -> None:
+        i = self._i
+        self._obs[i] = t.obs
+        self._act[i] = t.action
+        self._rew[i] = t.reward
+        self._nobs[i] = t.next_obs
+        self._done[i] = float(t.done)
+        self._i = (i + 1) % self.max_size
+        self._n = min(self._n + 1, self.max_size)
+
+    def sample(self, batch: int) -> Tuple[np.ndarray, ...]:
+        idx = self._rng.randint(0, self._n, size=batch)
+        return (self._obs[idx], self._act[idx], self._rew[idx],
+                self._nobs[idx], self._done[idx])
+
+
+__all__ = ["ExpReplay", "Transition"]

@@ -1,0 +1,93 @@
+"""RL subsystem: DQN solves small MDPs, A2C improves, policies behave.
+
+Reference: rl4j QLearningDiscreteDense / A3CDiscreteDense / policies
+(SURVEY.md §2.41).
+"""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.rl import (
+    A2CConfiguration, A2CDiscreteDense, CorridorMDP, DQNPolicy, EpsGreedy,
+    ExpReplay, GridWorldMDP, QLConfiguration, QLearningDiscreteDense,
+    Transition,
+)
+
+
+class TestEnvs:
+    def test_corridor_optimal(self):
+        env = CorridorMDP(length=5)
+        env.reset()
+        total, done = 0.0, False
+        while not done:
+            _, r, done, _ = env.step(1)
+            total += r
+        assert total == pytest.approx(1.0 - 0.01 * 3)
+
+    def test_gridworld_goal(self):
+        env = GridWorldMDP(n=3)
+        env.reset()
+        for a in [1, 1, 3, 3]:
+            obs, r, done, _ = env.step(a)
+        assert done and r == 1.0
+
+
+class TestReplay:
+    def test_circular_and_sample(self):
+        rp = ExpReplay(4, 2)
+        for i in range(6):
+            rp.store(Transition(np.full(2, i, np.float32), i % 2, float(i),
+                                np.zeros(2, np.float32), False))
+        assert len(rp) == 4
+        obs, act, rew, nobs, done = rp.sample(8)
+        assert obs.shape == (8, 2)
+        assert rew.min() >= 2.0  # oldest two evicted
+
+
+class TestEpsGreedy:
+    def test_anneal(self):
+        pol = EpsGreedy(DQNPolicy(lambda o: np.zeros((1, 2))), 2,
+                        eps_start=1.0, eps_min=0.1, anneal_steps=10)
+        assert pol.epsilon == 1.0
+        for _ in range(10):
+            pol.next_action(np.zeros(2, np.float32))
+        assert pol.epsilon == pytest.approx(0.1)
+
+
+class TestDQN:
+    def test_solves_corridor(self):
+        conf = QLConfiguration(
+            seed=3, max_step=3000, exp_replay_size=2000, batch_size=32,
+            target_dqn_update_freq=50, update_start=64, gamma=0.95,
+            epsilon_nb_step=1500, min_epsilon=0.05, hidden=(32,),
+            learning_rate=3e-3)
+        ql = QLearningDiscreteDense(CorridorMDP(length=6), conf)
+        ql.train()
+        # greedy policy must walk straight to the goal
+        ret = ql.getPolicy().play(CorridorMDP(length=6))
+        assert ret > 0.9   # optimal = 1 - 0.01*4 = 0.96
+
+    def test_double_dqn_flag(self):
+        for dd in (True, False):
+            conf = QLConfiguration(seed=0, max_step=200, update_start=32,
+                                   double_dqn=dd, hidden=(16,))
+            ql = QLearningDiscreteDense(CorridorMDP(length=4), conf)
+            ql.train()
+            q = ql.q_values(np.eye(4, dtype=np.float32))
+            assert q.shape == (4, 2) and np.isfinite(q).all()
+
+
+class TestA2C:
+    def test_improves_on_corridor(self):
+        conf = A2CConfiguration(seed=1, n_step=8, n_envs=8,
+                                learning_rate=3e-3, hidden=(32,))
+        a2c = A2CDiscreteDense(lambda: CorridorMDP(length=6), conf)
+        a2c.train(updates=150)
+        rewards = a2c.episode_rewards
+        assert len(rewards) > 10
+        early = np.mean(rewards[:10])
+        late = np.mean(rewards[-10:])
+        assert late > early
+        # greedy policy should reach the goal
+        ret = a2c.getPolicy(greedy=True).play(CorridorMDP(length=6))
+        assert ret > 0.5
