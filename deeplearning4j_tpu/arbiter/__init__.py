@@ -1,0 +1,30 @@
+"""Hyperparameter optimization (reference: arbiter/** — parameter
+spaces, grid/random candidate generators, LocalOptimizationRunner with
+termination conditions and result tracking. SURVEY.md §2.41).
+
+Design: a search space is a dict {name: ParameterSpace}; generators
+yield candidate dicts; the runner calls a user score function per
+candidate (which builds/trains/evaluates a model — same contract as
+arbiter's ModelEvaluator + score function split, without the reflection
+machinery the JVM needed).
+"""
+
+from deeplearning4j_tpu.arbiter.space import (
+    ContinuousParameterSpace, DiscreteParameterSpace, FixedValue,
+    IntegerParameterSpace, ParameterSpace,
+)
+from deeplearning4j_tpu.arbiter.generator import (
+    GridSearchCandidateGenerator, RandomSearchGenerator,
+)
+from deeplearning4j_tpu.arbiter.runner import (
+    CandidateResult, LocalOptimizationRunner, MaxCandidatesCondition,
+    MaxTimeCondition, OptimizationConfiguration,
+)
+
+__all__ = [
+    "ParameterSpace", "ContinuousParameterSpace", "DiscreteParameterSpace",
+    "IntegerParameterSpace", "FixedValue",
+    "GridSearchCandidateGenerator", "RandomSearchGenerator",
+    "OptimizationConfiguration", "LocalOptimizationRunner",
+    "CandidateResult", "MaxCandidatesCondition", "MaxTimeCondition",
+]

@@ -1,0 +1,60 @@
+"""Candidate generators (reference: arbiter org/deeplearning4j/arbiter/
+optimize/generator/{GridSearchCandidateGenerator,
+RandomSearchGenerator})."""
+
+from __future__ import annotations
+
+import itertools
+from typing import Dict, Iterator, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.arbiter.space import ParameterSpace
+
+
+class CandidateGenerator:
+    def candidates(self) -> Iterator[Dict]:
+        raise NotImplementedError
+
+
+class RandomSearchGenerator(CandidateGenerator):
+    def __init__(self, space: Dict[str, ParameterSpace], seed: int = 0,
+                 max_candidates: Optional[int] = None):
+        self.space = space
+        self.seed = seed
+        self.max_candidates = max_candidates
+
+    def candidates(self) -> Iterator[Dict]:
+        rng = np.random.RandomState(self.seed)
+        n = 0
+        while self.max_candidates is None or n < self.max_candidates:
+            yield {k: s.sample(float(rng.rand()))
+                   for k, s in self.space.items()}
+            n += 1
+
+
+class GridSearchCandidateGenerator(CandidateGenerator):
+    """Cartesian product over each space's grid points; `mode` 'Sequential'
+    walks in order, 'RandomOrder' shuffles (reference Mode enum)."""
+
+    def __init__(self, space: Dict[str, ParameterSpace],
+                 discretization_count: int = 5, mode: str = "Sequential",
+                 seed: int = 0):
+        self.space = space
+        self.discretization_count = discretization_count
+        self.mode = mode
+        self.seed = seed
+
+    def candidates(self) -> Iterator[Dict]:
+        keys = list(self.space)
+        axes = [self.space[k].grid_values(self.discretization_count)
+                for k in keys]
+        combos = list(itertools.product(*axes))
+        if self.mode == "RandomOrder":
+            np.random.RandomState(self.seed).shuffle(combos)
+        for combo in combos:
+            yield dict(zip(keys, combo))
+
+
+__all__ = ["CandidateGenerator", "RandomSearchGenerator",
+           "GridSearchCandidateGenerator"]

@@ -1,0 +1,95 @@
+"""Optimization runner (reference: arbiter org/deeplearning4j/arbiter/
+optimize/runner/LocalOptimizationRunner + api/termination/
+{MaxCandidatesCondition,MaxTimeCondition} + OptimizationResult)."""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+import traceback
+from typing import Any, Callable, Dict, List, Optional
+
+
+@dataclasses.dataclass
+class CandidateResult:
+    index: int
+    candidate: Dict
+    score: Optional[float]
+    duration_s: float
+    error: Optional[str] = None
+
+
+class TerminationCondition:
+    def terminate(self, runner: "LocalOptimizationRunner") -> bool:
+        raise NotImplementedError
+
+
+class MaxCandidatesCondition(TerminationCondition):
+    def __init__(self, n: int):
+        self.n = n
+
+    def terminate(self, runner):
+        return len(runner.results) >= self.n
+
+
+class MaxTimeCondition(TerminationCondition):
+    def __init__(self, seconds: float):
+        self.seconds = seconds
+
+    def terminate(self, runner):
+        return (time.time() - runner._start_time) >= self.seconds
+
+
+@dataclasses.dataclass
+class OptimizationConfiguration:
+    candidate_generator: Any
+    score_function: Callable[[Dict], float]
+    termination_conditions: List[TerminationCondition]
+    minimize: bool = True         # reference: score function declares this
+
+
+class LocalOptimizationRunner:
+    """Sequential local runner (reference runs candidates on an executor
+    pool; model training here already saturates the chip, so candidates
+    run one-at-a-time by design — parallel HP search across hosts is the
+    ShardedTrainer/multi-process layer's job)."""
+
+    def __init__(self, config: OptimizationConfiguration):
+        self.config = config
+        self.results: List[CandidateResult] = []
+        self._start_time = None
+
+    def execute(self) -> List[CandidateResult]:
+        self._start_time = time.time()
+        conds = self.config.termination_conditions
+        for i, cand in enumerate(self.config.candidate_generator.candidates()):
+            if any(c.terminate(self) for c in conds):
+                break
+            t0 = time.time()
+            try:
+                score = float(self.config.score_function(cand))
+                err = None
+            except Exception:
+                score, err = None, traceback.format_exc()
+            self.results.append(CandidateResult(
+                index=i, candidate=cand, score=score,
+                duration_s=time.time() - t0, error=err))
+        return self.results
+
+    def bestResult(self) -> Optional[CandidateResult]:
+        scored = [r for r in self.results if r.score is not None]
+        if not scored:
+            return None
+        key = (min if self.config.minimize else max)
+        return key(scored, key=lambda r: r.score)
+
+    def numCandidatesCompleted(self) -> int:
+        return len(self.results)
+
+    def numCandidatesFailed(self) -> int:
+        return sum(1 for r in self.results if r.error is not None)
+
+
+__all__ = ["CandidateResult", "OptimizationConfiguration",
+           "LocalOptimizationRunner", "MaxCandidatesCondition",
+           "MaxTimeCondition", "TerminationCondition"]

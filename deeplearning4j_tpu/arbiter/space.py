@@ -1,0 +1,82 @@
+"""Parameter spaces (reference: arbiter org/deeplearning4j/arbiter/
+optimize/parameter/{continuous/ContinuousParameterSpace,
+discrete/DiscreteParameterSpace,integer/IntegerParameterSpace,
+FixedValue})."""
+
+from __future__ import annotations
+
+import math
+from typing import Any, List, Sequence
+
+
+class ParameterSpace:
+    """Maps a uniform u in [0,1) to a concrete value; enumerable spaces
+    also expose grid points for grid search."""
+
+    def sample(self, u: float) -> Any:
+        raise NotImplementedError
+
+    def grid_values(self, resolution: int) -> List[Any]:
+        return [self.sample((i + 0.5) / resolution)
+                for i in range(resolution)]
+
+
+class ContinuousParameterSpace(ParameterSpace):
+    def __init__(self, min_value: float, max_value: float,
+                 log_scale: bool = False):
+        if log_scale and min_value <= 0:
+            raise ValueError("log_scale needs min_value > 0")
+        self.min = float(min_value)
+        self.max = float(max_value)
+        self.log_scale = log_scale
+
+    def sample(self, u: float) -> float:
+        if self.log_scale:
+            lo, hi = math.log(self.min), math.log(self.max)
+            return math.exp(lo + u * (hi - lo))
+        return self.min + u * (self.max - self.min)
+
+
+class IntegerParameterSpace(ParameterSpace):
+    def __init__(self, min_value: int, max_value: int):
+        self.min = int(min_value)
+        self.max = int(max_value)
+
+    def sample(self, u: float) -> int:
+        return min(self.min + int(u * (self.max - self.min + 1)), self.max)
+
+    def grid_values(self, resolution: int) -> List[int]:
+        n = self.max - self.min + 1
+        if resolution >= n:
+            return list(range(self.min, self.max + 1))
+        return sorted({self.sample((i + 0.5) / resolution)
+                       for i in range(resolution)})
+
+
+class DiscreteParameterSpace(ParameterSpace):
+    def __init__(self, values: Sequence[Any]):
+        if not values:
+            raise ValueError("empty value set")
+        self.values = list(values)
+
+    def sample(self, u: float) -> Any:
+        return self.values[min(int(u * len(self.values)),
+                               len(self.values) - 1)]
+
+    def grid_values(self, resolution: int) -> List[Any]:
+        return list(self.values)
+
+
+class FixedValue(ParameterSpace):
+    def __init__(self, value: Any):
+        self.value = value
+
+    def sample(self, u: float) -> Any:
+        return self.value
+
+    def grid_values(self, resolution: int) -> List[Any]:
+        return [self.value]
+
+
+__all__ = ["ParameterSpace", "ContinuousParameterSpace",
+           "IntegerParameterSpace", "DiscreteParameterSpace", "FixedValue"]

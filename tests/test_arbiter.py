@@ -1,0 +1,139 @@
+"""Hyperparameter search (reference: arbiter — spaces, grid/random
+generators, LocalOptimizationRunner, termination. SURVEY.md §2.41)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.arbiter import (
+    ContinuousParameterSpace, DiscreteParameterSpace, FixedValue,
+    GridSearchCandidateGenerator, IntegerParameterSpace,
+    LocalOptimizationRunner, MaxCandidatesCondition, MaxTimeCondition,
+    OptimizationConfiguration, RandomSearchGenerator,
+)
+
+
+class TestSpaces:
+    def test_continuous_bounds(self):
+        s = ContinuousParameterSpace(0.1, 0.9)
+        vals = [s.sample(u) for u in np.linspace(0, 0.999, 50)]
+        assert min(vals) >= 0.1 and max(vals) <= 0.9
+
+    def test_log_scale(self):
+        s = ContinuousParameterSpace(1e-4, 1e-1, log_scale=True)
+        assert s.sample(0.0) == pytest.approx(1e-4)
+        assert s.sample(1.0) == pytest.approx(1e-1)
+        # midpoint in log space is the geometric mean
+        assert s.sample(0.5) == pytest.approx(np.sqrt(1e-4 * 1e-1), rel=1e-6)
+
+    def test_integer_grid(self):
+        s = IntegerParameterSpace(2, 5)
+        assert s.grid_values(10) == [2, 3, 4, 5]
+        assert all(2 <= s.sample(u) <= 5 for u in np.linspace(0, 0.999, 20))
+
+    def test_discrete_and_fixed(self):
+        d = DiscreteParameterSpace(["a", "b", "c"])
+        assert d.grid_values(99) == ["a", "b", "c"]
+        assert d.sample(0.99) == "c"
+        assert FixedValue(7).sample(0.3) == 7
+
+
+class TestGenerators:
+    def test_grid_cartesian(self):
+        gen = GridSearchCandidateGenerator(
+            {"x": DiscreteParameterSpace([1, 2]),
+             "y": DiscreteParameterSpace(["p", "q"])})
+        combos = list(gen.candidates())
+        assert len(combos) == 4
+        assert {"x": 1, "y": "p"} in combos
+
+    def test_grid_random_order_same_set(self):
+        space = {"x": IntegerParameterSpace(0, 5)}
+        a = list(GridSearchCandidateGenerator(space, 10).candidates())
+        b = list(GridSearchCandidateGenerator(
+            space, 10, mode="RandomOrder", seed=1).candidates())
+        assert sorted(c["x"] for c in a) == sorted(c["x"] for c in b)
+
+    def test_random_reproducible(self):
+        space = {"lr": ContinuousParameterSpace(0, 1)}
+        g1 = RandomSearchGenerator(space, seed=5, max_candidates=5)
+        g2 = RandomSearchGenerator(space, seed=5, max_candidates=5)
+        assert [c["lr"] for c in g1.candidates()] == \
+               [c["lr"] for c in g2.candidates()]
+
+
+class TestRunner:
+    def test_finds_minimum(self):
+        space = {"x": ContinuousParameterSpace(-2.0, 2.0)}
+        conf = OptimizationConfiguration(
+            candidate_generator=RandomSearchGenerator(space, seed=0),
+            score_function=lambda c: (c["x"] - 0.7) ** 2,
+            termination_conditions=[MaxCandidatesCondition(60)])
+        runner = LocalOptimizationRunner(conf)
+        runner.execute()
+        best = runner.bestResult()
+        assert runner.numCandidatesCompleted() == 60
+        assert abs(best.candidate["x"] - 0.7) < 0.2
+
+    def test_failures_recorded(self):
+        def score(c):
+            if c["x"] > 0.5:
+                raise RuntimeError("boom")
+            return c["x"]
+        conf = OptimizationConfiguration(
+            candidate_generator=RandomSearchGenerator(
+                {"x": ContinuousParameterSpace(0, 1)}, seed=1),
+            score_function=score,
+            termination_conditions=[MaxCandidatesCondition(20)])
+        runner = LocalOptimizationRunner(conf)
+        runner.execute()
+        assert runner.numCandidatesFailed() > 0
+        assert runner.bestResult().score <= 0.5
+
+    def test_time_termination(self):
+        import time
+        conf = OptimizationConfiguration(
+            candidate_generator=RandomSearchGenerator(
+                {"x": ContinuousParameterSpace(0, 1)}, seed=2),
+            score_function=lambda c: time.sleep(0.05) or c["x"],
+            termination_conditions=[MaxTimeCondition(0.2)])
+        runner = LocalOptimizationRunner(conf)
+        runner.execute()
+        assert 1 <= runner.numCandidatesCompleted() <= 10
+
+    def test_model_search_end_to_end(self):
+        """Search lr/width on a tiny real training task."""
+        from deeplearning4j_tpu.learning.updaters import Adam
+        from deeplearning4j_tpu.nn.conf import (
+            DenseLayer, InputType, NeuralNetConfiguration, OutputLayer,
+        )
+        from deeplearning4j_tpu.nn.multilayer.network import MultiLayerNetwork
+
+        rs = np.random.RandomState(0)
+        x = rs.randn(64, 4).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[(x.sum(1) > 0).astype(int)]
+
+        def score(cand):
+            conf = (NeuralNetConfiguration.builder().seed(7)
+                    .updater(Adam(cand["lr"])).list()
+                    .layer(DenseLayer(n_out=cand["width"],
+                                      activation="relu"))
+                    .layer(OutputLayer(n_out=2, activation="softmax",
+                                       loss="mcxent"))
+                    .setInputType(InputType.feedForward(4)).build())
+            net = MultiLayerNetwork(conf).init()
+            for _ in range(12):
+                net.fit(x, y)
+            return net.score()
+
+        conf = OptimizationConfiguration(
+            candidate_generator=GridSearchCandidateGenerator(
+                {"lr": DiscreteParameterSpace([1e-4, 1e-2]),
+                 "width": DiscreteParameterSpace([4, 16])}),
+            score_function=score,
+            termination_conditions=[MaxCandidatesCondition(4)])
+        runner = LocalOptimizationRunner(conf)
+        runner.execute()
+        best = runner.bestResult()
+        assert best is not None
+        # the larger lr must beat 1e-4 after 12 iters
+        assert best.candidate["lr"] == pytest.approx(1e-2)
