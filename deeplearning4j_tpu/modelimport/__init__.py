@@ -1,2 +1,2 @@
 """Model import (reference: deeplearning4j-modelimport — SURVEY.md
-§2.32 Keras HDF5 import, §2.14 TF frozen-graph import)."""
+§2.32 Keras HDF5 import, §2.14 TF frozen-graph + ONNX import)."""

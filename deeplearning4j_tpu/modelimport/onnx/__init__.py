@@ -1,0 +1,11 @@
+"""ONNX import (reference: nd4j/samediff-import/samediff-import-onnx —
+SURVEY.md §2.14). No `onnx` package needed: the wire format is decoded
+directly (onnx_proto) and mapped into SameDiff (onnx_import)."""
+
+from deeplearning4j_tpu.modelimport.onnx.onnx_import import (
+    OnnxImport, OnnxImportError, OnnxOpMappingRegistry,
+)
+from deeplearning4j_tpu.modelimport.onnx.onnx_proto import decode_model
+
+__all__ = ["OnnxImport", "OnnxImportError", "OnnxOpMappingRegistry",
+           "decode_model"]

@@ -1,0 +1,618 @@
+"""ONNX ModelProto → SameDiff.
+
+Reference: nd4j/samediff-import/samediff-import-onnx — OnnxFrameworkImporter
+walking the ONNX graph through an OpMappingRegistry into SameDiff ops
+(SURVEY.md §2.14). Same architecture as our TF importer: per-op mappers
+emit into a SameDiff graph that whole-graph-compiles under XLA.
+
+Layout: ONNX is NCHW/OIHW. The importer keeps tensors in ONNX's NCHW
+layout end-to-end (so graph outputs match ONNX semantics exactly) and
+brackets each conv/pool with NCHW<->NHWC transposes into our NHWC TPU
+kernels — XLA's layout assignment cancels adjacent transposes between
+chained convs, so the compiled program stays in NHWC on the hot path.
+
+Initializers import as CONSTANTs; use
+`SameDiff.convertConstantsToVariables` to fine-tune an imported model
+(same contract as the reference).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from deeplearning4j_tpu.autodiff.samediff import SameDiff, SDVariable
+from deeplearning4j_tpu.modelimport.onnx.onnx_proto import (
+    GraphProto, ModelProto, NodeProto, decode_model,
+)
+
+
+class OnnxImportError(ValueError):
+    pass
+
+
+# -- ONNX-semantics helper ops (registered once; names are namespaced) --
+from deeplearning4j_tpu.ops.registry import has_op, register_op  # noqa: E402
+import jax.numpy as _jnp  # noqa: E402
+
+
+def _reg_once(name):
+    def deco(fn):
+        if not has_op(name):
+            register_op(name)(fn)
+        return fn
+    return deco
+
+
+@_reg_once("onnx_reshape")
+def _onnx_reshape(x, shape):
+    """ONNX Reshape: 0 copies the input dim, -1 infers."""
+    resolved = [x.shape[i] if s == 0 else int(s)
+                for i, s in enumerate(shape)] if 0 in list(shape) \
+        else [int(s) for s in shape]
+    return _jnp.reshape(x, tuple(resolved))
+
+
+@_reg_once("onnx_flatten")
+def _onnx_flatten(x, axis=1):
+    lead = 1
+    for d in x.shape[:axis]:
+        lead *= d
+    return _jnp.reshape(x, (lead, -1))
+
+
+@_reg_once("onnx_slice")
+def _onnx_slice(x, starts, ends, axes, steps):
+    idx = [slice(None)] * x.ndim
+    for st, en, ax, sp in zip(starts, ends, axes, steps):
+        n = x.shape[ax]
+        en = min(en, n) if en >= 0 else en
+        idx[ax] = slice(st, en, sp)
+    return x[tuple(idx)]
+
+
+@_reg_once("broadcast_to")
+def _broadcast_to(x, shape):
+    return _jnp.broadcast_to(x, tuple(int(s) for s in shape))
+
+
+class _Ctx:
+    def __init__(self, sd: SameDiff, node: NodeProto,
+                 inputs: List[Optional[SDVariable]],
+                 static: List[Optional[np.ndarray]]):
+        self.sd = sd
+        self.node = node
+        self.inputs = inputs
+        self._static = static
+
+    def attr(self, name: str, default=None):
+        return self.node.attributes.get(name, default)
+
+    def static_np(self, i: int) -> np.ndarray:
+        v = self._static[i] if i < len(self._static) else None
+        if v is None:
+            raise OnnxImportError(
+                f"node {self.node.name or self.node.op_type}: input {i} "
+                "must be a constant/initializer (XLA static-shape "
+                "discipline)")
+        return v
+
+    def maybe_static(self, i: int) -> Optional[np.ndarray]:
+        return self._static[i] if i < len(self._static) else None
+
+    def op(self, op_name: str, inputs: Sequence[SDVariable], n_out: int = 1,
+           **attrs):
+        return self.sd._op(op_name, [v.name for v in inputs], n_out=n_out,
+                           **attrs)
+
+    # NCHW <-> NHWC brackets for the conv/pool kernels
+    def to_nhwc(self, v: SDVariable) -> SDVariable:
+        return self.op("transpose", [v], permute=[0, 2, 3, 1])
+
+    def to_nchw(self, v: SDVariable) -> SDVariable:
+        return self.op("transpose", [v], permute=[0, 3, 1, 2])
+
+
+class OnnxOpMappingRegistry:
+    _mappers: Dict[str, Callable[[_Ctx], Any]] = {}
+
+    @classmethod
+    def register(cls, *op_types: str):
+        def deco(fn):
+            for name in op_types:
+                cls._mappers[name] = fn
+            return fn
+        return deco
+
+    @classmethod
+    def get(cls, op_type: str):
+        try:
+            return cls._mappers[op_type]
+        except KeyError:
+            raise OnnxImportError(
+                f"no mapper for ONNX op {op_type!r} (have "
+                f"{len(cls._mappers)}; add one via "
+                "OnnxOpMappingRegistry.register)") from None
+
+    @classmethod
+    def coverage(cls) -> List[str]:
+        return sorted(cls._mappers)
+
+
+R = OnnxOpMappingRegistry.register
+
+
+# ----------------------------------------------------------- elementwise
+_UNARY = {
+    "Relu": "relu", "Sigmoid": "sigmoid", "Tanh": "tanh", "Exp": "exp",
+    "Log": "log", "Sqrt": "sqrt", "Abs": "abs", "Erf": "erf",
+    "Floor": "floor", "Ceil": "ceil", "Round": "round", "Sign": "sign",
+    "Softplus": "softplus", "Softsign": "softsign", "Sin": "sin",
+    "Cos": "cos", "Tan": "tan", "Asin": "asin", "Acos": "acos",
+    "Atan": "atan", "Sinh": "sinh", "Cosh": "cosh", "Mish": "mish",
+    "Reciprocal": "reciprocal", "IsNaN": "isnan", "IsInf": "isinf",
+}
+for _onnx_name, _our in _UNARY.items():
+    @R(_onnx_name)
+    def _unary(ctx, _o=_our):
+        return ctx.op(_o, ctx.inputs[:1])
+
+_BINARY = {"Add": "add", "Sub": "sub", "Mul": "mul", "Div": "div",
+           "Pow": "pow_pairwise", "Min": "min_pairwise",
+           "Max": "max_pairwise", "Mod": "mod"}
+for _onnx_name, _our in _BINARY.items():
+    @R(_onnx_name)
+    def _binary(ctx, _o=_our):
+        return ctx.op(_o, ctx.inputs[:2])
+
+
+@R("Neg")
+def _neg(ctx):
+    return ctx.op("rsub", ctx.inputs[:1] + [ctx.sd.constant_like(0.0)])
+
+
+@R("Sum")
+def _sum_n(ctx):
+    out = ctx.inputs[0]
+    for v in ctx.inputs[1:]:
+        out = ctx.op("add", [out, v])
+    return out
+
+
+@R("LeakyRelu")
+def _leaky(ctx):
+    return ctx.op("leakyrelu", ctx.inputs[:1],
+                  alpha=float(ctx.attr("alpha", 0.01)))
+
+
+@R("Elu")
+def _elu(ctx):
+    return ctx.op("elu", ctx.inputs[:1], alpha=float(ctx.attr("alpha", 1.0)))
+
+
+@R("Selu")
+def _selu(ctx):
+    return ctx.op("selu", ctx.inputs[:1])
+
+
+@R("HardSigmoid")
+def _hardsigmoid(ctx):
+    return ctx.op("hardsigmoid", ctx.inputs[:1])
+
+
+@R("Gelu")
+def _gelu(ctx):
+    return ctx.op("gelu", ctx.inputs[:1])
+
+
+@R("ThresholdedRelu")
+def _thresholded(ctx):
+    return ctx.op("thresholdedrelu", ctx.inputs[:1],
+                  theta=float(ctx.attr("alpha", 1.0)))
+
+
+@R("Clip")
+def _clip(ctx):
+    lo = ctx.attr("min")
+    hi = ctx.attr("max")
+    if lo is None and len(ctx.inputs) > 1 and ctx.inputs[1] is not None:
+        lo = float(ctx.static_np(1))
+    if hi is None and len(ctx.inputs) > 2 and ctx.inputs[2] is not None:
+        hi = float(ctx.static_np(2))
+    return ctx.op("clip_by_value", ctx.inputs[:1],
+                  lo=float(lo if lo is not None else -np.inf),
+                  hi=float(hi if hi is not None else np.inf))
+
+
+@R("Softmax")
+def _softmax(ctx):
+    return ctx.op("softmax", ctx.inputs[:1],
+                  axis=int(ctx.attr("axis", -1)))
+
+
+@R("LogSoftmax")
+def _log_softmax(ctx):
+    return ctx.op("log_softmax", ctx.inputs[:1],
+                  axis=int(ctx.attr("axis", -1)))
+
+
+@R("PRelu")
+def _prelu(ctx):
+    return ctx.op("prelu", ctx.inputs[:2])
+
+
+# ------------------------------------------------------------- matmul/fc
+@R("MatMul")
+def _matmul(ctx):
+    return ctx.op("matmul", ctx.inputs[:2])
+
+
+@R("Gemm")
+def _gemm(ctx):
+    a, b = ctx.inputs[0], ctx.inputs[1]
+    alpha = float(ctx.attr("alpha", 1.0))
+    beta = float(ctx.attr("beta", 1.0))
+    out = ctx.op("matmul", [a, b],
+                 transpose_a=bool(ctx.attr("transA", 0)),
+                 transpose_b=bool(ctx.attr("transB", 0)))
+    if alpha != 1.0:
+        out = ctx.op("mul", [out, ctx.sd.constant_like(alpha)])
+    if len(ctx.inputs) > 2 and ctx.inputs[2] is not None:
+        c = ctx.inputs[2]
+        if beta != 1.0:
+            c = ctx.op("mul", [c, ctx.sd.constant_like(beta)])
+        out = ctx.op("add", [out, c])
+    return out
+
+
+# ----------------------------------------------------------------- shape
+@R("Identity")
+def _identity(ctx):
+    return ctx.op("add", [ctx.inputs[0], ctx.sd.constant_like(0.0)])
+
+
+@R("Dropout")
+def _dropout(ctx):
+    # inference import: dropout is identity (reference does the same)
+    return ctx.op("add", [ctx.inputs[0], ctx.sd.constant_like(0.0)])
+
+
+@R("Reshape")
+def _reshape(ctx):
+    shape = [int(s) for s in ctx.static_np(1)]
+    return ctx.op("onnx_reshape", ctx.inputs[:1], shape=shape)
+
+
+@R("Transpose")
+def _transpose(ctx):
+    perm = ctx.attr("perm")
+    if perm is None:
+        raise OnnxImportError("Transpose without perm unsupported")
+    return ctx.op("transpose", ctx.inputs[:1],
+                  permute=[int(p) for p in perm])
+
+
+@R("Flatten")
+def _flatten(ctx):
+    return ctx.op("onnx_flatten", ctx.inputs[:1],
+                  axis=int(ctx.attr("axis", 1)))
+
+
+@R("Concat")
+def _concat(ctx):
+    return ctx.op("concat", ctx.inputs, axis=int(ctx.attr("axis", 0)))
+
+
+@R("Squeeze")
+def _squeeze(ctx):
+    axes = ctx.attr("axes")
+    if axes is None and len(ctx.inputs) > 1:
+        axes = [int(a) for a in ctx.static_np(1)]
+    ax = tuple(int(a) for a in (axes or [])) or None
+    return ctx.op("squeeze", ctx.inputs[:1], axis=ax)
+
+
+@R("Unsqueeze")
+def _unsqueeze(ctx):
+    axes = ctx.attr("axes")
+    if axes is None and len(ctx.inputs) > 1:
+        axes = [int(a) for a in ctx.static_np(1)]
+    out = ctx.inputs[0]
+    for a in sorted(int(x) for x in axes):
+        out = ctx.op("expand_dims", [out], axis=a)
+    return out
+
+
+@R("Gather")
+def _gather(ctx):
+    idx = ctx.maybe_static(1)
+    if idx is not None:
+        indices = ctx.sd.constant(
+            f"{ctx.node.output[0]}_idx", idx.astype(np.int32))
+    else:
+        indices = ctx.inputs[1]
+    return ctx.op("gather", [ctx.inputs[0], indices],
+                  axis=int(ctx.attr("axis", 0)))
+
+
+@R("Slice")
+def _slice(ctx):
+    if ctx.attr("starts") is not None:  # opset < 10: attrs
+        starts = [int(v) for v in ctx.attr("starts")]
+        ends = [int(v) for v in ctx.attr("ends")]
+        axes = [int(v) for v in ctx.attr("axes",
+                                         list(range(len(starts))))]
+        steps = [1] * len(starts)
+    else:
+        starts = [int(v) for v in ctx.static_np(1)]
+        ends = [int(v) for v in ctx.static_np(2)]
+        axes = ([int(v) for v in ctx.static_np(3)]
+                if len(ctx.inputs) > 3 and ctx.maybe_static(3) is not None
+                else list(range(len(starts))))
+        steps = ([int(v) for v in ctx.static_np(4)]
+                 if len(ctx.inputs) > 4 and ctx.maybe_static(4) is not None
+                 else [1] * len(starts))
+    return ctx.op("onnx_slice", ctx.inputs[:1], starts=starts, ends=ends,
+                  axes=axes, steps=steps)
+
+
+@R("Tile")
+def _tile(ctx):
+    reps = [int(v) for v in ctx.static_np(1)]
+    return ctx.op("tile", ctx.inputs[:1], reps=reps)
+
+
+@R("Expand")
+def _expand(ctx):
+    shape = [int(v) for v in ctx.static_np(1)]
+    return ctx.op("broadcast_to", ctx.inputs[:1], shape=shape)
+
+
+@R("Pad")
+def _pad(ctx):
+    pads = ctx.attr("pads")
+    if pads is None:
+        pads = [int(v) for v in ctx.static_np(1)]
+    mode = ctx.attr("mode", "constant")
+    if mode != "constant":
+        raise OnnxImportError(f"Pad mode {mode!r} unsupported")
+    n = len(pads) // 2
+    pairs = [[int(pads[i]), int(pads[i + n])] for i in range(n)]
+    return ctx.op("pad", ctx.inputs[:1], paddings=pairs)
+
+
+@R("Cast")
+def _cast(ctx):
+    to = int(ctx.attr("to", 1))
+    from deeplearning4j_tpu.modelimport.onnx.onnx_proto import TensorProto
+    np_dt = TensorProto._DTYPES.get(to, np.float32)
+    return ctx.op("cast", ctx.inputs[:1], dtype=np.dtype(np_dt).name)
+
+
+@R("Shape")
+def _shape(ctx):
+    # static shapes only: materialize as a constant at import time
+    raise OnnxImportError(
+        "Shape op requires dynamic shapes; re-export with static shapes "
+        "(XLA compiles static programs)")
+
+
+@R("Constant")
+def _constant(ctx):
+    val = ctx.attr("value")
+    if val is None:
+        val = np.asarray(ctx.attr("value_float", 0.0), np.float32)
+    return ctx.sd.constant(ctx.node.output[0], np.asarray(val))
+
+
+@R("ConstantOfShape")
+def _constant_of_shape(ctx):
+    shape = [int(v) for v in ctx.static_np(0)]
+    val = ctx.attr("value")
+    fill = float(np.asarray(val).ravel()[0]) if val is not None else 0.0
+    return ctx.sd.constant(ctx.node.output[0],
+                           np.full(shape, fill, np.float32))
+
+
+@R("Where")
+def _where(ctx):
+    return ctx.op("where", ctx.inputs[:3])
+
+
+for _onnx_name, _our in {"Equal": "eq", "Greater": "gt", "Less": "lt",
+                         "GreaterOrEqual": "gte",
+                         "LessOrEqual": "lte"}.items():
+    @R(_onnx_name)
+    def _cmp(ctx, _o=_our):
+        return ctx.op(_o, ctx.inputs[:2])
+
+
+# ---------------------------------------------------------- reductions
+_REDUCE = {"ReduceSum": "reduce_sum", "ReduceMean": "reduce_mean",
+           "ReduceMax": "reduce_max", "ReduceMin": "reduce_min",
+           "ReduceProd": "reduce_prod"}
+for _onnx_name, _our in _REDUCE.items():
+    @R(_onnx_name)
+    def _reduce(ctx, _o=_our):
+        axes = ctx.attr("axes")
+        if axes is None and len(ctx.inputs) > 1:
+            axes = [int(a) for a in ctx.static_np(1)]
+        return ctx.op(_o, ctx.inputs[:1],
+                      dimensions=[int(a) for a in axes] if axes else None,
+                      keep_dims=bool(ctx.attr("keepdims", 1)))
+
+
+@R("ArgMax")
+def _argmax(ctx):
+    out = ctx.op("argmax", ctx.inputs[:1],
+                 dimensions=int(ctx.attr("axis", 0)))
+    if int(ctx.attr("keepdims", 1)):
+        out = ctx.op("expand_dims", [out], axis=int(ctx.attr("axis", 0)))
+    return out
+
+
+# -------------------------------------------------------------- conv/pool
+def _conv_padding_args(ctx, default_kernel=None):
+    auto = ctx.attr("auto_pad", "NOTSET")
+    pads = ctx.attr("pads")
+    if auto in ("SAME_UPPER", "SAME_LOWER"):
+        return "SAME", None
+    if pads is None or not any(pads):
+        return "VALID", None
+    n = len(pads) // 2
+    # [x1b, x2b, x1e, x2e] -> [(b,e), ...] per spatial dim
+    return None, [(int(pads[i]), int(pads[i + n])) for i in range(n)]
+
+
+def _explicit_pad_nhwc(ctx, v, spatial_pads):
+    pairs = [[0, 0]] + [list(p) for p in spatial_pads] + [[0, 0]]
+    return ctx.op("pad", [v], paddings=pairs)
+
+
+@R("Conv")
+def _conv(ctx):
+    x = ctx.to_nhwc(ctx.inputs[0])
+    w = ctx.inputs[1]                         # OIHW
+    w = ctx.op("transpose", [w], permute=[2, 3, 1, 0])  # -> HWIO
+    strides = [int(s) for s in ctx.attr("strides", [1, 1])]
+    dil = [int(d) for d in ctx.attr("dilations", [1, 1])]
+    group = int(ctx.attr("group", 1))
+    pad_mode, spatial = _conv_padding_args(ctx)
+    if spatial is not None:
+        x = _explicit_pad_nhwc(ctx, x, spatial)
+        pad_mode = "VALID"
+    if group == 1:
+        out = ctx.op("conv2d", [x, w], strides=strides, padding=pad_mode,
+                     dilation=dil)
+    else:
+        raise OnnxImportError("grouped Conv (group>1) not yet mapped")
+    if len(ctx.inputs) > 2 and ctx.inputs[2] is not None:
+        out = ctx.op("add", [out, ctx.inputs[2]])
+    return ctx.to_nchw(out)
+
+
+@R("MaxPool", "AveragePool")
+def _pool(ctx):
+    x = ctx.to_nhwc(ctx.inputs[0])
+    kernel = [int(k) for k in ctx.attr("kernel_shape")]
+    strides = [int(s) for s in ctx.attr("strides", kernel)]
+    pad_mode, spatial = _conv_padding_args(ctx)
+    if spatial is not None:
+        x = _explicit_pad_nhwc(ctx, x, spatial)
+        pad_mode = "VALID"
+    op = "maxpool2d" if ctx.node.op_type == "MaxPool" else "avgpool2d"
+    out = ctx.op(op, [x], kernel=kernel, strides=strides, padding=pad_mode)
+    return ctx.to_nchw(out)
+
+
+@R("GlobalAveragePool")
+def _gap(ctx):
+    out = ctx.op("reduce_mean", ctx.inputs[:1], dimensions=[2, 3],
+                 keep_dims=True)
+    return out
+
+
+@R("GlobalMaxPool")
+def _gmp(ctx):
+    return ctx.op("reduce_max", ctx.inputs[:1], dimensions=[2, 3],
+                  keep_dims=True)
+
+
+@R("BatchNormalization")
+def _bn(ctx):
+    x, scale, bias, mean, var = ctx.inputs[:5]
+    eps = float(ctx.attr("epsilon", 1e-5))
+    # params are [C]; x is NCHW -> reshape params to [C,1,1] to broadcast
+    def chan(v):
+        return ctx.op("reshape", [v], shape=[-1, 1, 1])
+    xm = ctx.op("sub", [x, chan(mean)])
+    inv = ctx.op("rsqrt", [ctx.op(
+        "add", [chan(var), ctx.sd.constant_like(eps)])])
+    return ctx.op("add", [ctx.op("mul", [ctx.op("mul", [xm, inv]),
+                                         chan(scale)]), chan(bias)])
+
+
+@R("LRN")
+def _lrn(ctx):
+    x = ctx.to_nhwc(ctx.inputs[0])
+    size = int(ctx.attr("size", 5))
+    out = ctx.op("lrn", [x], depth_radius=size // 2,
+                 bias=float(ctx.attr("bias", 1.0)),
+                 alpha=float(ctx.attr("alpha", 1e-4)) / size,
+                 beta=float(ctx.attr("beta", 0.75)))
+    return ctx.to_nchw(out)
+
+
+@R("LayerNormalization")
+def _layer_norm(ctx):
+    x, scale = ctx.inputs[0], ctx.inputs[1]
+    bias = ctx.inputs[2] if len(ctx.inputs) > 2 else None
+    eps = float(ctx.attr("epsilon", 1e-5))
+    ins = [x, scale] + ([bias] if bias is not None else [])
+    return ctx.op("layer_norm", ins, eps=eps)
+
+
+# ---------------------------------------------------------------- import
+class OnnxImport:
+    """Entry point (reference: OnnxFrameworkImporter#runImport)."""
+
+    @staticmethod
+    def importGraph(model_or_path) -> SameDiff:
+        model = OnnxImport._as_model(model_or_path)
+        g: GraphProto = model.graph
+        sd = SameDiff.create()
+        tensors: Dict[str, SDVariable] = {}
+        const_vals: Dict[str, np.ndarray] = {}
+
+        for init in g.initializers:
+            arr = init.to_numpy()
+            const_vals[init.name] = arr
+            tensors[init.name] = sd.constant(init.name, arr)
+        init_names = {i.name for i in g.initializers}
+        for vi in g.inputs:
+            if vi.name in init_names:
+                continue
+            shape = [d if d is not None else -1 for d in vi.shape]
+            tensors[vi.name] = sd.placeholder(vi.name, shape=shape or None)
+
+        for node in g.nodes:
+            ins: List[Optional[SDVariable]] = []
+            statics: List[Optional[np.ndarray]] = []
+            for ref in node.input:
+                if ref == "":
+                    ins.append(None)
+                    statics.append(None)
+                    continue
+                if ref not in tensors:
+                    raise OnnxImportError(
+                        f"node {node.name or node.op_type}: unresolved "
+                        f"input {ref!r}")
+                ins.append(tensors[ref])
+                statics.append(const_vals.get(ref))
+            mapper = OnnxOpMappingRegistry.get(node.op_type)
+            out = mapper(_Ctx(sd, node, ins, statics))
+            outs = out if isinstance(out, tuple) else (out,)
+            for name, v in zip(node.output, outs):
+                if v.name != name:
+                    v.rename(name)
+                tensors[name] = v
+                # track import-time-computable constants (Constant nodes)
+                if node.op_type == "Constant":
+                    const_vals[name] = np.asarray(
+                        node.attributes.get("value"))
+        return sd
+
+    @staticmethod
+    def _as_model(src) -> ModelProto:
+        if isinstance(src, ModelProto):
+            return src
+        if isinstance(src, bytes):
+            return decode_model(src)
+        if isinstance(src, str):
+            with open(src, "rb") as f:
+                return decode_model(f.read())
+        raise OnnxImportError(f"cannot interpret {type(src)} as ONNX model")
+
+
+__all__ = ["OnnxImport", "OnnxOpMappingRegistry", "OnnxImportError"]

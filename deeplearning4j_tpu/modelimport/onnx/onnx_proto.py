@@ -1,0 +1,328 @@
+"""Minimal ONNX protobuf wire-format decoder.
+
+Reference: samediff-import-onnx parses onnx.proto ModelProto via
+generated classes (SURVEY.md §2.14). The `onnx` package is not
+installed in this environment, so this module decodes the protobuf wire
+format directly — only the message fields the importer needs
+(ModelProto/GraphProto/NodeProto/AttributeProto/TensorProto/
+ValueInfoProto, field numbers from the public onnx.proto3 schema).
+
+Wire format refresher: each field is a varint key `(field_num << 3) |
+wire_type`; wire types: 0 varint, 1 fixed64, 2 length-delimited,
+5 fixed32. Packed repeated scalars arrive as one length-delimited blob.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import struct
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+
+class OnnxDecodeError(ValueError):
+    pass
+
+
+# ------------------------------------------------------------ wire level
+def _read_varint(buf: bytes, i: int) -> Tuple[int, int]:
+    result = 0
+    shift = 0
+    while True:
+        if i >= len(buf):
+            raise OnnxDecodeError("truncated varint")
+        b = buf[i]
+        i += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, i
+        shift += 7
+        if shift > 70:
+            raise OnnxDecodeError("varint too long")
+
+
+def _signed(v: int) -> int:
+    """Interpret a 64-bit varint as two's-complement int64."""
+    return v - (1 << 64) if v >= (1 << 63) else v
+
+
+def _fields(buf: bytes) -> Iterator[Tuple[int, int, Any]]:
+    """Yield (field_number, wire_type, raw_value)."""
+    i = 0
+    n = len(buf)
+    while i < n:
+        key, i = _read_varint(buf, i)
+        field, wt = key >> 3, key & 7
+        if wt == 0:
+            v, i = _read_varint(buf, i)
+        elif wt == 1:
+            v = buf[i:i + 8]
+            i += 8
+        elif wt == 2:
+            ln, i = _read_varint(buf, i)
+            v = buf[i:i + ln]
+            i += ln
+        elif wt == 5:
+            v = buf[i:i + 4]
+            i += 4
+        else:
+            raise OnnxDecodeError(f"unsupported wire type {wt}")
+        yield field, wt, v
+
+
+def _packed_varints(raw: bytes) -> List[int]:
+    out, i = [], 0
+    while i < len(raw):
+        v, i = _read_varint(raw, i)
+        out.append(_signed(v))
+    return out
+
+
+# --------------------------------------------------------- message types
+@dataclasses.dataclass
+class TensorProto:
+    name: str = ""
+    dims: List[int] = dataclasses.field(default_factory=list)
+    data_type: int = 1
+    _raw: bytes = b""
+    _float_data: List[float] = dataclasses.field(default_factory=list)
+    _int32_data: List[int] = dataclasses.field(default_factory=list)
+    _int64_data: List[int] = dataclasses.field(default_factory=list)
+    _double_data: List[float] = dataclasses.field(default_factory=list)
+
+    #: onnx TensorProto.DataType -> numpy
+    _DTYPES = {1: np.float32, 2: np.uint8, 3: np.int8, 4: np.uint16,
+               5: np.int16, 6: np.int32, 7: np.int64, 9: np.bool_,
+               10: np.float16, 11: np.float64, 12: np.uint32, 13: np.uint64}
+
+    def to_numpy(self) -> np.ndarray:
+        if self.data_type not in self._DTYPES:
+            raise OnnxDecodeError(
+                f"tensor {self.name!r}: unsupported data_type "
+                f"{self.data_type}")
+        dt = self._DTYPES[self.data_type]
+        if self._raw:
+            arr = np.frombuffer(self._raw, dtype=dt)
+        elif self._float_data:
+            arr = np.asarray(self._float_data, np.float32).astype(dt)
+        elif self._int64_data:
+            arr = np.asarray(self._int64_data, np.int64).astype(dt)
+        elif self._int32_data:
+            arr = np.asarray(self._int32_data, np.int32).astype(dt)
+        elif self._double_data:
+            arr = np.asarray(self._double_data, np.float64).astype(dt)
+        else:
+            arr = np.zeros(0, dt)
+        return arr.reshape(self.dims) if self.dims else arr.reshape(())
+
+
+@dataclasses.dataclass
+class AttributeProto:
+    name: str = ""
+    type: int = 0        # 1=FLOAT 2=INT 3=STRING 4=TENSOR 6=FLOATS 7=INTS 8=STRINGS
+    f: float = 0.0
+    i: int = 0
+    s: bytes = b""
+    t: Optional[TensorProto] = None
+    floats: List[float] = dataclasses.field(default_factory=list)
+    ints: List[int] = dataclasses.field(default_factory=list)
+    strings: List[bytes] = dataclasses.field(default_factory=list)
+
+    def value(self) -> Any:
+        if self.type == 1:
+            return self.f
+        if self.type == 2:
+            return self.i
+        if self.type == 3:
+            return self.s.decode(errors="replace")
+        if self.type == 4:
+            return self.t.to_numpy() if self.t is not None else None
+        if self.type == 6:
+            return list(self.floats)
+        if self.type == 7:
+            return list(self.ints)
+        if self.type == 8:
+            return [s.decode(errors="replace") for s in self.strings]
+        return None
+
+
+@dataclasses.dataclass
+class NodeProto:
+    name: str = ""
+    op_type: str = ""
+    domain: str = ""
+    input: List[str] = dataclasses.field(default_factory=list)
+    output: List[str] = dataclasses.field(default_factory=list)
+    attributes: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class ValueInfoProto:
+    name: str = ""
+    elem_type: int = 1
+    shape: List[Optional[int]] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class GraphProto:
+    name: str = ""
+    nodes: List[NodeProto] = dataclasses.field(default_factory=list)
+    initializers: List[TensorProto] = dataclasses.field(default_factory=list)
+    inputs: List[ValueInfoProto] = dataclasses.field(default_factory=list)
+    outputs: List[ValueInfoProto] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class ModelProto:
+    ir_version: int = 0
+    producer_name: str = ""
+    opset_version: int = 0
+    graph: Optional[GraphProto] = None
+
+
+# ----------------------------------------------------------- per message
+def _decode_tensor(buf: bytes) -> TensorProto:
+    t = TensorProto()
+    for field, wt, v in _fields(buf):
+        if field == 1:
+            if wt == 2:
+                t.dims.extend(_packed_varints(v))
+            else:
+                t.dims.append(_signed(v))
+        elif field == 2:
+            t.data_type = v
+        elif field == 4:
+            t._float_data.extend(
+                struct.unpack(f"<{len(v) // 4}f", v) if wt == 2
+                else (struct.unpack("<f", v)[0],))
+        elif field == 5:
+            t._int32_data.extend(_packed_varints(v) if wt == 2
+                                 else [_signed(v)])
+        elif field == 7:
+            t._int64_data.extend(_packed_varints(v) if wt == 2
+                                 else [_signed(v)])
+        elif field == 8:
+            t.name = v.decode()
+        elif field == 9:
+            t._raw = v
+        elif field == 10:
+            t._double_data.extend(
+                struct.unpack(f"<{len(v) // 8}d", v) if wt == 2
+                else (struct.unpack("<d", v)[0],))
+    return t
+
+
+def _decode_attribute(buf: bytes) -> AttributeProto:
+    a = AttributeProto()
+    for field, wt, v in _fields(buf):
+        if field == 1:
+            a.name = v.decode()
+        elif field == 2:
+            a.f = struct.unpack("<f", v)[0]
+        elif field == 3:
+            a.i = _signed(v)
+        elif field == 4:
+            a.s = v
+        elif field == 5:
+            a.t = _decode_tensor(v)
+        elif field == 7:
+            a.floats.extend(struct.unpack(f"<{len(v) // 4}f", v)
+                            if wt == 2 else (struct.unpack("<f", v)[0],))
+        elif field == 8:
+            a.ints.extend(_packed_varints(v) if wt == 2 else [_signed(v)])
+        elif field == 9:
+            a.strings.append(v)
+        elif field == 20:
+            a.type = v
+    if a.type == 0:
+        # producers may omit type; infer from populated field
+        if a.ints:
+            a.type = 7
+        elif a.floats:
+            a.type = 6
+        elif a.t is not None:
+            a.type = 4
+        elif a.s:
+            a.type = 3
+    return a
+
+
+def _decode_node(buf: bytes) -> NodeProto:
+    n = NodeProto()
+    for field, wt, v in _fields(buf):
+        if field == 1:
+            n.input.append(v.decode())
+        elif field == 2:
+            n.output.append(v.decode())
+        elif field == 3:
+            n.name = v.decode()
+        elif field == 4:
+            n.op_type = v.decode()
+        elif field == 5:
+            a = _decode_attribute(v)
+            n.attributes[a.name] = a.value()
+        elif field == 7:
+            n.domain = v.decode()
+    return n
+
+
+def _decode_value_info(buf: bytes) -> ValueInfoProto:
+    vi = ValueInfoProto()
+    for field, wt, v in _fields(buf):
+        if field == 1:
+            vi.name = v.decode()
+        elif field == 2:  # TypeProto
+            for f2, _w2, v2 in _fields(v):
+                if f2 == 1:  # tensor_type
+                    for f3, _w3, v3 in _fields(v2):
+                        if f3 == 1:
+                            vi.elem_type = v3
+                        elif f3 == 2:  # TensorShapeProto
+                            for f4, _w4, v4 in _fields(v3):
+                                if f4 == 1:  # Dimension
+                                    dim_val: Optional[int] = None
+                                    for f5, _w5, v5 in _fields(v4):
+                                        if f5 == 1:
+                                            dim_val = _signed(v5)
+                                    vi.shape.append(dim_val)
+    return vi
+
+
+def _decode_graph(buf: bytes) -> GraphProto:
+    g = GraphProto()
+    for field, wt, v in _fields(buf):
+        if field == 1:
+            g.nodes.append(_decode_node(v))
+        elif field == 2:
+            g.name = v.decode()
+        elif field == 5:
+            g.initializers.append(_decode_tensor(v))
+        elif field == 11:
+            g.inputs.append(_decode_value_info(v))
+        elif field == 12:
+            g.outputs.append(_decode_value_info(v))
+    return g
+
+
+def decode_model(data: bytes) -> ModelProto:
+    m = ModelProto()
+    for field, wt, v in _fields(data):
+        if field == 1:
+            m.ir_version = v
+        elif field == 2:
+            m.producer_name = v.decode()
+        elif field == 7:
+            m.graph = _decode_graph(v)
+        elif field == 8:  # OperatorSetIdProto
+            for f2, _w2, v2 in _fields(v):
+                if f2 == 2:
+                    m.opset_version = max(m.opset_version, _signed(v2))
+    if m.graph is None:
+        raise OnnxDecodeError("no GraphProto in model (not an ONNX file?)")
+    return m
+
+
+__all__ = ["decode_model", "ModelProto", "GraphProto", "NodeProto",
+           "TensorProto", "AttributeProto", "ValueInfoProto",
+           "OnnxDecodeError"]

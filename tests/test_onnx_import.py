@@ -1,0 +1,281 @@
+"""ONNX import: wire-format decode + graph import into SameDiff.
+
+Reference: samediff-import-onnx (SURVEY.md §2.14). The environment has
+no `onnx` package, so fixtures are built with a minimal protobuf wire
+ENCODER below (independent of the decoder under test — encoder bugs
+would produce decode failures, not silent agreement). Numerical ground
+truth comes from numpy/torch (CPU).
+"""
+
+import struct
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.modelimport.onnx.onnx_import import (
+    OnnxImport, OnnxImportError, OnnxOpMappingRegistry,
+)
+from deeplearning4j_tpu.modelimport.onnx.onnx_proto import decode_model
+
+
+# ------------------------------------------------------- tiny pb encoder
+def _varint(v: int) -> bytes:
+    out = b""
+    v &= (1 << 64) - 1
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out += bytes([b | 0x80])
+        else:
+            return out + bytes([b])
+
+
+def _tag(field: int, wt: int) -> bytes:
+    return _varint((field << 3) | wt)
+
+
+def _ld(field: int, payload: bytes) -> bytes:
+    return _tag(field, 2) + _varint(len(payload)) + payload
+
+
+def _str(field: int, s: str) -> bytes:
+    return _ld(field, s.encode())
+
+
+def _iv(field: int, v: int) -> bytes:
+    return _tag(field, 0) + _varint(v)
+
+
+def tensor(name: str, arr: np.ndarray) -> bytes:
+    dt = {np.dtype(np.float32): 1, np.dtype(np.int64): 7,
+          np.dtype(np.int32): 6}[arr.dtype]
+    out = b"".join(_iv(1, d) for d in arr.shape)
+    out += _iv(2, dt)
+    out += _str(8, name)
+    out += _ld(9, arr.tobytes())
+    return out
+
+
+def attr_int(name: str, v: int) -> bytes:
+    return _str(1, name) + _iv(3, v) + _iv(20, 2)
+
+
+def attr_float(name: str, v: float) -> bytes:
+    return _str(1, name) + _tag(2, 5) + struct.pack("<f", v) + _iv(20, 1)
+
+
+def attr_ints(name: str, vs) -> bytes:
+    packed = b"".join(_varint(v) for v in vs)
+    return _str(1, name) + _ld(8, packed) + _iv(20, 7)
+
+
+def attr_tensor(name: str, t: bytes) -> bytes:
+    return _str(1, name) + _ld(5, t) + _iv(20, 4)
+
+
+def node(op: str, inputs, outputs, name="", attrs=()) -> bytes:
+    out = b"".join(_str(1, i) for i in inputs)
+    out += b"".join(_str(2, o) for o in outputs)
+    out += _str(3, name or op.lower())
+    out += _str(4, op)
+    out += b"".join(_ld(5, a) for a in attrs)
+    return out
+
+
+def value_info(name: str, shape) -> bytes:
+    dims = b"".join(_ld(1, _iv(1, d)) for d in shape)
+    tensor_type = _iv(1, 1) + _ld(2, dims)
+    return _str(1, name) + _ld(2, _ld(1, tensor_type))
+
+
+def graph(nodes, initializers, inputs, outputs) -> bytes:
+    out = b"".join(_ld(1, n) for n in nodes)
+    out += _str(2, "g")
+    out += b"".join(_ld(5, t) for t in initializers)
+    out += b"".join(_ld(11, vi) for vi in inputs)
+    out += b"".join(_ld(12, vi) for vi in outputs)
+    return out
+
+
+def model(graph_bytes: bytes, opset: int = 13) -> bytes:
+    out = _iv(1, 8)                                   # ir_version
+    out += _str(2, "dl4j-tpu-test")                   # producer
+    out += _ld(7, graph_bytes)
+    out += _ld(8, _iv(2, opset))                      # opset_import
+    return out
+
+
+# ---------------------------------------------------------------- fixtures
+def _mlp_model(rs):
+    w1 = rs.randn(4, 8).astype(np.float32)
+    b1 = rs.randn(8).astype(np.float32)
+    w2 = rs.randn(8, 3).astype(np.float32)
+    b2 = rs.randn(3).astype(np.float32)
+    g = graph(
+        nodes=[
+            node("Gemm", ["x", "w1", "b1"], ["h"], "fc1"),
+            node("Relu", ["h"], ["hr"], "relu1"),
+            node("Gemm", ["hr", "w2", "b2"], ["logits"], "fc2"),
+            node("Softmax", ["logits"], ["probs"], "sm",
+                 attrs=[attr_int("axis", 1)]),
+        ],
+        initializers=[tensor("w1", w1), tensor("b1", b1),
+                      tensor("w2", w2), tensor("b2", b2)],
+        inputs=[value_info("x", [2, 4])],
+        outputs=[value_info("probs", [2, 3])],
+    )
+    return model(g), (w1, b1, w2, b2)
+
+
+class TestDecoder:
+    def test_model_fields(self):
+        rs = np.random.RandomState(0)
+        blob, _ = _mlp_model(rs)
+        m = decode_model(blob)
+        assert m.producer_name == "dl4j-tpu-test"
+        assert m.opset_version == 13
+        assert len(m.graph.nodes) == 4
+        assert [n.op_type for n in m.graph.nodes] == \
+            ["Gemm", "Relu", "Gemm", "Softmax"]
+        assert m.graph.nodes[3].attributes["axis"] == 1
+        assert m.graph.inputs[0].shape == [2, 4]
+
+    def test_tensor_raw_roundtrip(self):
+        arr = np.arange(12, dtype=np.float32).reshape(3, 4)
+        m = decode_model(model(graph([], [tensor("t", arr)], [], [])))
+        got = m.graph.initializers[0].to_numpy()
+        np.testing.assert_array_equal(got, arr)
+
+    def test_int64_tensor(self):
+        arr = np.asarray([2, -1, 7], np.int64)
+        m = decode_model(model(graph([], [tensor("t", arr)], [], [])))
+        np.testing.assert_array_equal(m.graph.initializers[0].to_numpy(), arr)
+
+    def test_garbage_rejected(self):
+        from deeplearning4j_tpu.modelimport.onnx.onnx_proto import (
+            OnnxDecodeError,
+        )
+        with pytest.raises(OnnxDecodeError):
+            decode_model(b"\x08\x01")  # no graph
+
+
+class TestMlpImport:
+    def test_matches_numpy(self):
+        rs = np.random.RandomState(1)
+        blob, (w1, b1, w2, b2) = _mlp_model(rs)
+        sd = OnnxImport.importGraph(blob)
+        x = rs.randn(2, 4).astype(np.float32)
+        got = np.asarray(sd.output({"x": x}, ["probs"])["probs"])
+        h = np.maximum(x @ w1 + b1, 0)
+        logits = h @ w2 + b2
+        e = np.exp(logits - logits.max(1, keepdims=True))
+        want = e / e.sum(1, keepdims=True)
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+    def test_unknown_op_error(self):
+        g = graph([node("NotARealOp", ["x"], ["y"])], [],
+                  [value_info("x", [1])], [value_info("y", [1])])
+        with pytest.raises(OnnxImportError, match="NotARealOp"):
+            OnnxImport.importGraph(model(g))
+
+    def test_coverage_listing(self):
+        cov = OnnxOpMappingRegistry.coverage()
+        assert len(cov) >= 60
+        for required in ("Conv", "Gemm", "MatMul", "BatchNormalization",
+                         "Softmax", "Reshape", "Transpose", "MaxPool"):
+            assert required in cov
+
+
+class TestConvImport:
+    def test_conv_pool_matches_torch(self):
+        torch = pytest.importorskip("torch")
+        rs = np.random.RandomState(2)
+        x = rs.randn(2, 3, 8, 8).astype(np.float32)       # NCHW
+        w = rs.randn(5, 3, 3, 3).astype(np.float32)       # OIHW
+        b = rs.randn(5).astype(np.float32)
+        g = graph(
+            nodes=[
+                node("Conv", ["x", "w", "b"], ["c"], "conv",
+                     attrs=[attr_ints("kernel_shape", [3, 3]),
+                            attr_ints("strides", [1, 1]),
+                            attr_ints("pads", [1, 1, 1, 1])]),
+                node("Relu", ["c"], ["cr"], "relu"),
+                node("MaxPool", ["cr"], ["p"], "pool",
+                     attrs=[attr_ints("kernel_shape", [2, 2]),
+                            attr_ints("strides", [2, 2])]),
+                node("Flatten", ["p"], ["f"], "flat",
+                     attrs=[attr_int("axis", 1)]),
+            ],
+            initializers=[tensor("w", w), tensor("b", b)],
+            inputs=[value_info("x", [2, 3, 8, 8])],
+            outputs=[value_info("f", [2, 80])],
+        )
+        sd = OnnxImport.importGraph(model(g))
+        got = np.asarray(sd.output({"x": x}, ["f"])["f"])
+
+        tx = torch.from_numpy(x)
+        tc = torch.nn.functional.conv2d(tx, torch.from_numpy(w),
+                                        torch.from_numpy(b), padding=1)
+        tp = torch.nn.functional.max_pool2d(torch.relu(tc), 2, 2)
+        want = tp.reshape(2, -1).numpy()
+        assert got.shape == want.shape
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    def test_batchnorm_gap(self):
+        torch = pytest.importorskip("torch")
+        rs = np.random.RandomState(3)
+        x = rs.randn(2, 4, 6, 6).astype(np.float32)
+        scale = rs.rand(4).astype(np.float32) + 0.5
+        bias = rs.randn(4).astype(np.float32)
+        mean = rs.randn(4).astype(np.float32)
+        var = rs.rand(4).astype(np.float32) + 0.5
+        g = graph(
+            nodes=[
+                node("BatchNormalization",
+                     ["x", "scale", "bias", "mean", "var"], ["bn"], "bn",
+                     attrs=[attr_float("epsilon", 1e-5)]),
+                node("GlobalAveragePool", ["bn"], ["gap"], "gap"),
+                node("Squeeze", ["gap"], ["out"], "sq",
+                     attrs=[attr_ints("axes", [2, 3])]),
+            ],
+            initializers=[tensor("scale", scale), tensor("bias", bias),
+                          tensor("mean", mean), tensor("var", var)],
+            inputs=[value_info("x", [2, 4, 6, 6])],
+            outputs=[value_info("out", [2, 4])],
+        )
+        sd = OnnxImport.importGraph(model(g))
+        got = np.asarray(sd.output({"x": x}, ["out"])["out"])
+        tb = torch.nn.functional.batch_norm(
+            torch.from_numpy(x), torch.from_numpy(mean),
+            torch.from_numpy(var), torch.from_numpy(scale),
+            torch.from_numpy(bias), training=False, eps=1e-5)
+        want = tb.mean(dim=(2, 3)).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+class TestOnnxShapeOps:
+    def test_reshape_transpose_concat_slice(self):
+        rs = np.random.RandomState(4)
+        x = rs.randn(2, 6).astype(np.float32)
+        g = graph(
+            nodes=[
+                node("Reshape", ["x", "shape"], ["r"], "rs"),
+                node("Transpose", ["r"], ["t"], "tp",
+                     attrs=[attr_ints("perm", [0, 2, 1])]),
+                node("Concat", ["t", "t"], ["cc"], "cc",
+                     attrs=[attr_int("axis", 2)]),
+                node("Slice", ["cc"], ["s"], "sl",
+                     attrs=[attr_ints("starts", [0]),
+                            attr_ints("ends", [2]),
+                            attr_ints("axes", [2])]),
+            ],
+            initializers=[tensor("shape", np.asarray([0, 2, 3], np.int64))],
+            inputs=[value_info("x", [2, 6])],
+            outputs=[value_info("s", [2, 3, 2])],
+        )
+        sd = OnnxImport.importGraph(model(g))
+        got = np.asarray(sd.output({"x": x}, ["s"])["s"])
+        r = x.reshape(2, 2, 3).transpose(0, 2, 1)
+        want = np.concatenate([r, r], 2)[:, :, :2]
+        np.testing.assert_allclose(got, want, rtol=1e-6)
