@@ -121,7 +121,13 @@ def _parse_value(s: str):
 
 
 class CSVRecordReader(RecordReader):
-    """reference: CSVRecordReader(skipNumLines, delimiter)."""
+    """reference: CSVRecordReader(skipNumLines, delimiter).
+
+    Numeric-only files take the native multithreaded parser
+    (native/csv_reader.cpp via nativeops — the datavec tokenizer's hot
+    path) and all values come back as float; files with any non-numeric
+    token fall back to Python csv with int/float/str typing preserved.
+    """
 
     def __init__(self, skip_num_lines: int = 0, delimiter: str = ","):
         self.skip = skip_num_lines
@@ -129,9 +135,32 @@ class CSVRecordReader(RecordReader):
         self._records = []
         self._i = 0
 
+    def _try_native(self, loc: str) -> bool:
+        from deeplearning4j_tpu import nativeops
+        if not nativeops.native_available():
+            return False
+        try:
+            with open(loc, "rb") as f:
+                data = f.read()
+            if self.skip:
+                pos = 0
+                for _ in range(self.skip):
+                    nxt = data.find(b"\n", pos)
+                    if nxt < 0:
+                        return False
+                    pos = nxt + 1
+                data = data[pos:]
+            arr = nativeops.csv_parse(data, self.delimiter)
+        except ValueError:
+            return False
+        self._records.extend([list(map(float, row)) for row in arr])
+        return True
+
     def initialize(self, split: Union[InputSplit, str]) -> "CSVRecordReader":
         self._records = []
         for loc in _as_split(split).locations():
+            if self._try_native(loc):
+                continue
             with open(loc, newline="") as f:
                 rows = list(csv.reader(f, delimiter=self.delimiter))
             for row in rows[self.skip:]:

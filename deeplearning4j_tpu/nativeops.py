@@ -1,0 +1,188 @@
+"""ctypes bindings for the native runtime library.
+
+Reference roles covered (SURVEY.md):
+- §2.29 threshold encode/decode — libnd4j's encodeThreshold/
+  decodeThreshold custom ops behind EncodingHandler (gradient
+  compression for the DCN/multi-slice path; ICI all-reduce doesn't
+  need it).
+- §2.25 CSV hot path — datavec CSVRecordReader's tokenizer, here a
+  multithreaded C++ pass feeding host ETL.
+- §2.38 threading runtime — the library parallelizes internally with
+  std::thread (samediff::Threads analog); no GIL involvement.
+
+Loading policy: use a prebuilt native/libdl4jtpu_native.so if present;
+else attempt ONE quiet `make -C native` (g++ is in the image); else
+fall back to numpy implementations with identical semantics. Every
+entry point works either way — `native_available()` reports which path
+is live. Set DL4J_TPU_DISABLE_NATIVE=1 to force the fallback.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional, Tuple
+
+import numpy as np
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libdl4jtpu_native.so")
+
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
+    c_f32p = ctypes.POINTER(ctypes.c_float)
+    c_i32p = ctypes.POINTER(ctypes.c_int32)
+    lib.dl4j_threshold_count.restype = ctypes.c_int64
+    lib.dl4j_threshold_count.argtypes = [c_f32p, ctypes.c_int64,
+                                         ctypes.c_float]
+    lib.dl4j_threshold_encode.restype = ctypes.c_int64
+    lib.dl4j_threshold_encode.argtypes = [c_f32p, ctypes.c_int64,
+                                          ctypes.c_float, c_i32p,
+                                          ctypes.c_int64]
+    lib.dl4j_threshold_decode.restype = None
+    lib.dl4j_threshold_decode.argtypes = [c_i32p, ctypes.c_int64,
+                                          ctypes.c_float, c_f32p,
+                                          ctypes.c_int64]
+    lib.dl4j_threshold_residual.restype = None
+    lib.dl4j_threshold_residual.argtypes = [c_f32p, ctypes.c_int64,
+                                            ctypes.c_float, c_i32p,
+                                            ctypes.c_int64]
+    lib.dl4j_csv_count_rows.restype = ctypes.c_int64
+    lib.dl4j_csv_count_rows.argtypes = [ctypes.c_char_p, ctypes.c_int64]
+    lib.dl4j_csv_count_cols.restype = ctypes.c_int64
+    lib.dl4j_csv_count_cols.argtypes = [ctypes.c_char_p, ctypes.c_int64,
+                                        ctypes.c_char]
+    lib.dl4j_csv_parse.restype = ctypes.c_int64
+    lib.dl4j_csv_parse.argtypes = [ctypes.c_char_p, ctypes.c_int64,
+                                   ctypes.c_char, ctypes.c_int64,
+                                   ctypes.c_int64, c_f32p]
+    return lib
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    _tried = True
+    if os.environ.get("DL4J_TPU_DISABLE_NATIVE"):
+        return None
+    if not os.path.exists(_LIB_PATH):
+        try:
+            subprocess.run(["make", "-C", _NATIVE_DIR],
+                           capture_output=True, timeout=120, check=True)
+        except Exception:
+            return None
+    try:
+        _lib = _configure(ctypes.CDLL(_LIB_PATH))
+    except OSError:
+        _lib = None
+    return _lib
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+def _f32p(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+def _i32p(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+
+
+# -------------------------------------------------------- threshold codec
+def threshold_count(grad: np.ndarray, threshold: float) -> int:
+    g = np.ascontiguousarray(grad, np.float32).ravel()
+    lib = _load()
+    if lib is not None:
+        return int(lib.dl4j_threshold_count(_f32p(g), g.size,
+                                            ctypes.c_float(threshold)))
+    return int(np.count_nonzero(np.abs(g) >= threshold))
+
+
+def threshold_encode(grad: np.ndarray, threshold: float) -> np.ndarray:
+    """Sign-encoded sparse indices: +/-(i+1) where |grad[i]| >= t."""
+    g = np.ascontiguousarray(grad, np.float32).ravel()
+    lib = _load()
+    if lib is not None:
+        out = np.empty(g.size, np.int32)
+        n = int(lib.dl4j_threshold_encode(_f32p(g), g.size,
+                                          ctypes.c_float(threshold),
+                                          _i32p(out), out.size))
+        if n < 0:
+            raise RuntimeError("encode buffer overflow (impossible: "
+                               "buffer is full-size)")
+        return out[:n].copy()
+    idx = np.nonzero(np.abs(g) >= threshold)[0]
+    return np.where(g[idx] >= 0, idx + 1, -(idx + 1)).astype(np.int32)
+
+
+def threshold_decode(encoded: np.ndarray, threshold: float, size: int,
+                     out: Optional[np.ndarray] = None) -> np.ndarray:
+    """Accumulate +/-threshold at encoded positions into `out`."""
+    e = np.ascontiguousarray(encoded, np.int32).ravel()
+    if out is None:
+        out = np.zeros(size, np.float32)
+    else:
+        out = np.ascontiguousarray(out, np.float32)
+    lib = _load()
+    if lib is not None:
+        lib.dl4j_threshold_decode(_i32p(e), e.size,
+                                  ctypes.c_float(threshold), _f32p(out),
+                                  out.size)
+        return out
+    idx = np.abs(e) - 1
+    np.add.at(out, idx, np.where(e > 0, threshold, -threshold))
+    return out
+
+
+def threshold_residual(grad: np.ndarray, encoded: np.ndarray,
+                       threshold: float) -> np.ndarray:
+    """grad - transmitted (in place on a copy); the residual the worker
+    keeps (reference: ResidualPostProcessor)."""
+    g = np.ascontiguousarray(grad, np.float32).ravel().copy()
+    e = np.ascontiguousarray(encoded, np.int32).ravel()
+    lib = _load()
+    if lib is not None:
+        lib.dl4j_threshold_residual(_f32p(g), g.size,
+                                    ctypes.c_float(threshold), _i32p(e),
+                                    e.size)
+        return g
+    idx = np.abs(e) - 1
+    g[idx] -= np.where(e > 0, threshold, -threshold).astype(np.float32)
+    return g
+
+
+# ------------------------------------------------------------------- CSV
+def csv_parse(data: bytes, delimiter: str = ",",
+              shape: Optional[Tuple[int, int]] = None) -> np.ndarray:
+    """Parse a numeric CSV byte buffer to a float32 [rows, cols] array."""
+    d = delimiter.encode()[:1]
+    lib = _load()
+    if lib is not None:
+        rows = (shape[0] if shape
+                else int(lib.dl4j_csv_count_rows(data, len(data))))
+        cols = (shape[1] if shape
+                else int(lib.dl4j_csv_count_cols(data, len(data), d)))
+        if rows == 0 or cols == 0:
+            return np.zeros((0, 0), np.float32)
+        out = np.empty((rows, cols), np.float32)
+        got = int(lib.dl4j_csv_parse(data, len(data), d, rows, cols,
+                                     _f32p(out)))
+        if got < 0:
+            raise ValueError("CSV column count mismatch")
+        return out[:got]
+    text = data.decode()
+    rows_list = [r for r in text.splitlines() if r.strip()]
+    return np.asarray([[float(tok) for tok in r.split(delimiter)]
+                       for r in rows_list], np.float32)
+
+
+__all__ = ["native_available", "threshold_count", "threshold_encode",
+           "threshold_decode", "threshold_residual", "csv_parse"]

@@ -1,0 +1,127 @@
+// Fast numeric CSV parser (host ETL hot path).
+//
+// Reference: datavec CSVRecordReader tokenizes line-by-line in Java
+// (SURVEY.md §2.25); on the TPU build the ETL host path feeds the
+// accelerator, so parsing must not become the bottleneck at high
+// batch rates. This parser does one multithreaded pass over the raw
+// byte buffer straight into a preallocated float matrix.
+//
+// Scope: numeric CSV (the training-data fast path). Quoted strings /
+// schema transforms stay in the Python TransformProcess (cold path).
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+namespace {
+
+int hardware_threads() {
+  unsigned n = std::thread::hardware_concurrency();
+  return n == 0 ? 4 : static_cast<int>(n);
+}
+
+// strtof on a bounded token (tokens are not NUL-terminated in the
+// buffer). Returns false when the token is not fully numeric, so the
+// caller can reject the file and let Python's typed parser handle it —
+// strtof alone would silently yield 0.0 for garbage.
+inline bool parse_token(const char* s, const char* e, float* out) {
+  while (s < e && (*s == ' ' || *s == '\t')) ++s;      // trim left
+  while (e > s && (e[-1] == ' ' || e[-1] == '\t')) --e;  // trim right
+  if (s == e) return false;                             // empty token
+  char tmp[64];
+  size_t len = static_cast<size_t>(e - s);
+  if (len >= sizeof(tmp)) return false;
+  std::memcpy(tmp, s, len);
+  tmp[len] = '\0';
+  char* end = nullptr;
+  *out = std::strtof(tmp, &end);
+  return end == tmp + len;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Number of data rows (non-empty lines).
+int64_t dl4j_csv_count_rows(const char* data, int64_t len) {
+  int64_t rows = 0;
+  bool in_line = false;
+  for (int64_t i = 0; i < len; ++i) {
+    if (data[i] == '\n') {
+      if (in_line) ++rows;
+      in_line = false;
+    } else if (data[i] != '\r') {
+      in_line = true;
+    }
+  }
+  if (in_line) ++rows;
+  return rows;
+}
+
+// Columns in the first non-empty line.
+int64_t dl4j_csv_count_cols(const char* data, int64_t len, char delim) {
+  int64_t i = 0;
+  while (i < len && (data[i] == '\n' || data[i] == '\r')) ++i;
+  if (i >= len) return 0;
+  int64_t cols = 1;
+  for (; i < len && data[i] != '\n'; ++i)
+    if (data[i] == delim) ++cols;
+  return cols;
+}
+
+// Parse `rows` x `cols` floats into out (row-major). Rows are located by
+// a serial newline scan (cheap), then parsed in parallel. Returns rows
+// parsed, or -1 on column-count mismatch.
+int64_t dl4j_csv_parse(const char* data, int64_t len, char delim,
+                       int64_t rows, int64_t cols, float* out) {
+  // index line starts
+  std::vector<std::pair<int64_t, int64_t>> lines;
+  lines.reserve(static_cast<size_t>(rows));
+  int64_t start = 0;
+  for (int64_t i = 0; i <= len; ++i) {
+    if (i == len || data[i] == '\n') {
+      int64_t end = i;
+      if (end > start && data[end - 1] == '\r') --end;
+      if (end > start) lines.emplace_back(start, end);
+      start = i + 1;
+    }
+  }
+  if (static_cast<int64_t>(lines.size()) < rows) rows = lines.size();
+
+  std::vector<int> bad(hardware_threads() > 16 ? 16 : hardware_threads(), 0);
+  int nt = static_cast<int>(bad.size());
+  int64_t chunk = (rows + nt - 1) / nt;
+  std::vector<std::thread> threads;
+  for (int t = 0; t < nt; ++t) {
+    int64_t lo = t * chunk;
+    int64_t hi = lo + chunk > rows ? rows : lo + chunk;
+    if (lo >= hi) break;
+    threads.emplace_back([&, t, lo, hi] {
+      for (int64_t r = lo; r < hi; ++r) {
+        const char* s = data + lines[r].first;
+        const char* line_end = data + lines[r].second;
+        int64_t c = 0;
+        const char* tok = s;
+        for (const char* p = s; p <= line_end; ++p) {
+          if (p == line_end || *p == delim) {
+            if (c >= cols || !parse_token(tok, p, &out[r * cols + c])) {
+              bad[t] = 1;
+              return;
+            }
+            ++c;
+            tok = p + 1;
+          }
+        }
+        if (c != cols) { bad[t] = 1; return; }
+      }
+    });
+  }
+  for (auto& th : threads) th.join();
+  for (int t = 0; t < nt; ++t)
+    if (bad[t]) return -1;
+  return rows;
+}
+
+}  // extern "C"

@@ -1,0 +1,145 @@
+// Threshold gradient codec (host-side, multithreaded).
+//
+// Reference: libnd4j's encodeThreshold/decodeThreshold custom ops backing
+// EncodedGradientsAccumulator / EncodingHandler (SURVEY.md §2.29): a
+// gradient vector is compressed to the sparse set of indices whose
+// |value| >= threshold, sign-encoded as +/-(index+1); the residual
+// (grad - decoded) stays on the worker and is added into the next step.
+//
+// TPU-era role: ICI all-reduce makes compression unnecessary intra-slice;
+// this codec is the optional DCN / multi-slice path and runs on HOST
+// gradients (after device->host of the psum'ed DCN shard), so it is
+// plain C++ + std::thread, not a device kernel.
+//
+// Encoding layout (matches the Python fallback in
+// deeplearning4j_tpu/ops/compression.py):
+//   out_idx[k] = (i + 1)  if grad[i] >=  threshold
+//             = -(i + 1)  if grad[i] <= -threshold
+// Decode writes +/-threshold at those positions.
+
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+namespace {
+
+int hardware_threads() {
+  unsigned n = std::thread::hardware_concurrency();
+  return n == 0 ? 4 : static_cast<int>(n);
+}
+
+template <typename F>
+void parallel_chunks(int64_t n, F fn) {
+  int nt = hardware_threads();
+  if (n < (1 << 16) || nt <= 1) {  // small arrays: threading overhead loses
+    fn(0, 0, n);
+    return;
+  }
+  if (nt > 16) nt = 16;
+  std::vector<std::thread> threads;
+  int64_t chunk = (n + nt - 1) / nt;
+  for (int t = 0; t < nt; ++t) {
+    int64_t lo = t * chunk;
+    int64_t hi = lo + chunk > n ? n : lo + chunk;
+    if (lo >= hi) break;
+    threads.emplace_back([=] { fn(t, lo, hi); });
+  }
+  for (auto& th : threads) th.join();
+}
+
+}  // namespace
+
+extern "C" {
+
+// Count of indices that WOULD be encoded (for buffer sizing / adaptive
+// threshold — reference: AdaptiveThresholdAlgorithm needs the density).
+int64_t dl4j_threshold_count(const float* grad, int64_t n, float threshold) {
+  std::atomic<int64_t> total{0};
+  parallel_chunks(n, [&](int, int64_t lo, int64_t hi) {
+    int64_t local = 0;
+    for (int64_t i = lo; i < hi; ++i) {
+      float v = grad[i];
+      if (v >= threshold || v <= -threshold) ++local;
+    }
+    total += local;
+  });
+  return total.load();
+}
+
+// Two-pass parallel encode: per-chunk count -> exclusive prefix -> fill.
+// Returns number of indices written, or -1 if max_out is too small.
+int64_t dl4j_threshold_encode(const float* grad, int64_t n, float threshold,
+                              int32_t* out_idx, int64_t max_out) {
+  int nt = hardware_threads();
+  if (nt > 16) nt = 16;
+  std::vector<int64_t> counts(nt + 1, 0);
+  std::vector<std::pair<int64_t, int64_t>> ranges(nt, {0, 0});
+  int64_t chunk = (n + nt - 1) / nt;
+  for (int t = 0; t < nt; ++t) {
+    int64_t lo = t * chunk;
+    int64_t hi = lo + chunk > n ? n : lo + chunk;
+    if (lo > hi) lo = hi;
+    ranges[t] = {lo, hi};
+  }
+  {
+    std::vector<std::thread> threads;
+    for (int t = 0; t < nt; ++t) {
+      threads.emplace_back([&, t] {
+        int64_t local = 0;
+        for (int64_t i = ranges[t].first; i < ranges[t].second; ++i) {
+          float v = grad[i];
+          if (v >= threshold || v <= -threshold) ++local;
+        }
+        counts[t + 1] = local;
+      });
+    }
+    for (auto& th : threads) th.join();
+  }
+  for (int t = 0; t < nt; ++t) counts[t + 1] += counts[t];
+  int64_t total = counts[nt];
+  if (total > max_out) return -1;
+  {
+    std::vector<std::thread> threads;
+    for (int t = 0; t < nt; ++t) {
+      threads.emplace_back([&, t] {
+        int64_t w = counts[t];
+        for (int64_t i = ranges[t].first; i < ranges[t].second; ++i) {
+          float v = grad[i];
+          if (v >= threshold)
+            out_idx[w++] = static_cast<int32_t>(i + 1);
+          else if (v <= -threshold)
+            out_idx[w++] = -static_cast<int32_t>(i + 1);
+        }
+      });
+    }
+    for (auto& th : threads) th.join();
+  }
+  return total;
+}
+
+// Decode into a zeroed (or accumulating) buffer: out[i] += +/-threshold.
+void dl4j_threshold_decode(const int32_t* idx, int64_t n_idx, float threshold,
+                           float* out, int64_t n) {
+  for (int64_t k = 0; k < n_idx; ++k) {
+    int32_t e = idx[k];
+    int64_t i = (e > 0 ? e : -e) - 1;
+    if (i < 0 || i >= n) continue;  // corrupt input: skip, don't crash
+    out[i] += e > 0 ? threshold : -threshold;
+  }
+}
+
+// Residual update in place: grad[i] -= decoded[i] for encoded positions
+// (reference: residual post-processor keeps grad - transmitted).
+void dl4j_threshold_residual(float* grad, int64_t n, float threshold,
+                             const int32_t* idx, int64_t n_idx) {
+  for (int64_t k = 0; k < n_idx; ++k) {
+    int32_t e = idx[k];
+    int64_t i = (e > 0 ? e : -e) - 1;
+    if (i < 0 || i >= n) continue;
+    grad[i] -= e > 0 ? threshold : -threshold;
+  }
+}
+
+}  // extern "C"

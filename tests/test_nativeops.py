@@ -1,0 +1,131 @@
+"""Native runtime components: build, codec parity, CSV parser.
+
+Reference: libnd4j encodeThreshold/decodeThreshold (SURVEY.md §2.29),
+datavec CSV tokenizer (§2.25). Tests run both the C++ path and the
+numpy fallback and require identical semantics.
+"""
+
+import os
+import subprocess
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import nativeops
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "native")
+
+
+@pytest.fixture(scope="module", autouse=True)
+def built_lib():
+    subprocess.run(["make", "-C", _NATIVE_DIR], capture_output=True,
+                   timeout=180, check=True)
+    # reset the loader so this module definitely tests the built lib
+    nativeops._lib = None
+    nativeops._tried = False
+    assert nativeops.native_available()
+    yield
+
+
+def _fallback(fn, *args, **kwargs):
+    """Run a nativeops function with the C++ path disabled."""
+    lib, tried = nativeops._lib, nativeops._tried
+    nativeops._lib, nativeops._tried = None, True
+    try:
+        return fn(*args, **kwargs)
+    finally:
+        nativeops._lib, nativeops._tried = lib, tried
+
+
+class TestThresholdCodec:
+    def test_encode_decode_roundtrip(self):
+        rs = np.random.RandomState(0)
+        g = rs.randn(1000).astype(np.float32) * 0.01
+        g[[3, 500, 999]] = [0.5, -0.7, 0.9]
+        t = 0.1
+        enc = nativeops.threshold_encode(g, t)
+        assert set(np.abs(enc) - 1) == {3, 500, 999}
+        assert (enc[np.abs(enc) - 1 == 500] < 0).all()
+        dec = nativeops.threshold_decode(enc, t, g.size)
+        assert dec[3] == pytest.approx(t)
+        assert dec[500] == pytest.approx(-t)
+        assert np.count_nonzero(dec) == 3
+
+    def test_count(self):
+        g = np.asarray([0.2, -0.3, 0.01, 0.0], np.float32)
+        assert nativeops.threshold_count(g, 0.1) == 2
+
+    def test_parity_with_fallback_large(self):
+        """> 2^16 elements exercises the multithreaded two-pass path."""
+        rs = np.random.RandomState(1)
+        g = rs.randn(200_000).astype(np.float32)
+        t = 1.5
+        enc_native = nativeops.threshold_encode(g, t)
+        enc_py = _fallback(nativeops.threshold_encode, g, t)
+        np.testing.assert_array_equal(enc_native, enc_py)
+        dec_native = nativeops.threshold_decode(enc_native, t, g.size)
+        dec_py = _fallback(nativeops.threshold_decode, enc_py, t, g.size)
+        np.testing.assert_allclose(dec_native, dec_py)
+
+    def test_residual(self):
+        g = np.asarray([0.5, -0.3, 0.05], np.float32)
+        t = 0.1
+        enc = nativeops.threshold_encode(g, t)
+        res = nativeops.threshold_residual(g, enc, t)
+        np.testing.assert_allclose(res, [0.4, -0.2, 0.05], atol=1e-6)
+        res_py = _fallback(nativeops.threshold_residual, g, enc, t)
+        np.testing.assert_allclose(res, res_py)
+
+    def test_decode_accumulates(self):
+        enc = nativeops.threshold_encode(
+            np.asarray([1.0, 0.0], np.float32), 0.5)
+        out = np.asarray([10.0, 20.0], np.float32)
+        got = nativeops.threshold_decode(enc, 0.5, 2, out=out)
+        np.testing.assert_allclose(got, [10.5, 20.0])
+
+
+class TestCsvParse:
+    def test_basic(self):
+        data = b"1.5,2,3\n4,5.25,-6\n"
+        out = nativeops.csv_parse(data)
+        np.testing.assert_allclose(
+            out, [[1.5, 2, 3], [4, 5.25, -6]], rtol=1e-6)
+
+    def test_crlf_and_trailing(self):
+        data = b"1,2\r\n3,4\r\n\r\n"
+        out = nativeops.csv_parse(data)
+        np.testing.assert_allclose(out, [[1, 2], [3, 4]])
+
+    def test_mismatch_raises(self):
+        with pytest.raises(ValueError):
+            nativeops.csv_parse(b"1,2,3\n4,5\n")
+
+    def test_parity_with_fallback(self):
+        rs = np.random.RandomState(2)
+        arr = rs.randn(500, 12).astype(np.float32)
+        data = "\n".join(",".join(f"{v:.6g}" for v in row)
+                         for row in arr).encode()
+        native = nativeops.csv_parse(data)
+        py = _fallback(nativeops.csv_parse, data)
+        assert native.shape == (500, 12)
+        np.testing.assert_allclose(native, py, rtol=1e-5)
+        np.testing.assert_allclose(native, arr, rtol=1e-4, atol=1e-5)
+
+    def test_semicolon_delimiter(self):
+        out = nativeops.csv_parse(b"1;2\n3;4\n", delimiter=";")
+        np.testing.assert_allclose(out, [[1, 2], [3, 4]])
+
+
+class TestJaxCompressionAgreement:
+    def test_matches_device_codec(self):
+        """The host codec and the jax encode_threshold op (§2.29 device
+        path) must agree on which indices survive."""
+        from deeplearning4j_tpu.ops.compression import encode_threshold
+        rs = np.random.RandomState(3)
+        g = rs.randn(512).astype(np.float32)
+        t = 1.0
+        host = set(np.abs(nativeops.threshold_encode(g, t)) - 1)
+        enc, _residual = encode_threshold(g, t)
+        dev_idx = set(np.nonzero(np.asarray(enc))[0])
+        assert host == dev_idx
