@@ -1,0 +1,244 @@
+"""Memory workspaces, device memory stats, crash reporting.
+
+Reference (SURVEY.md §2.10/§2.11/§5):
+- org/nd4j/linalg/api/memory/** — MemoryWorkspace arena allocator with
+  WorkspaceConfiguration policies; LayerWorkspaceMgr scoping per layer.
+- CUDA JITA AtomicAllocator device caches.
+- org/deeplearning4j/util/CrashReportingUtil — full memory/config dump
+  on OOM.
+
+TPU redesign — what exists and what deliberately doesn't:
+- The reference's arenas exist because every op allocates eagerly on
+  the JVM heap + device. Under jit, XLA's buffer assignment plans ALL
+  intermediate memory at compile time and donation recycles input
+  buffers — the arena's job is done by the compiler. So MemoryWorkspace
+  here is a SCOPING/ACCOUNTING tool (live scope tracking, device-memory
+  deltas, leak assertions for tests), not an allocator.
+- AtomicAllocator's host<->device coherency machinery is jax.Array's
+  job; `device_memory_stats()` exposes what the reference's
+  MemoryTracker reported.
+- CrashReportingUtil survives nearly unchanged: dump model config,
+  param counts, memory stats, workspace state on OOM.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import datetime
+import enum
+import os
+import threading
+from typing import Any, Dict, List, Optional
+
+import jax
+import numpy as np
+
+
+# ------------------------------------------------------------- stats
+def device_memory_stats(device=None) -> Dict[str, Any]:
+    """Per-device memory stats (reference: MemoryTracker / JITA device
+    cache counters). Empty dict when the backend doesn't report."""
+    d = device or jax.local_devices()[0]
+    try:
+        ms = d.memory_stats() or {}
+    except Exception:
+        ms = {}
+    return {
+        "device": str(d),
+        "platform": d.platform,
+        "bytes_in_use": ms.get("bytes_in_use"),
+        "peak_bytes_in_use": ms.get("peak_bytes_in_use"),
+        "bytes_limit": ms.get("bytes_limit"),
+    }
+
+
+def host_memory_stats() -> Dict[str, Any]:
+    try:
+        import resource
+        ru = resource.getrusage(resource.RUSAGE_SELF)
+        return {"max_rss_mb": ru.ru_maxrss / 1024.0}
+    except Exception:
+        return {}
+
+
+# --------------------------------------------------------- workspaces
+class DebugMode(enum.Enum):
+    DISABLED = "disabled"
+    SPILL_EVERYTHING = "spill_everything"   # kept for API parity
+    VALIDATE_SCOPES = "validate_scopes"
+
+
+@dataclasses.dataclass
+class WorkspaceConfiguration:
+    """Mirror of the reference's builder fields. Allocation policies are
+    recorded (and serialized with configs) but do not drive an
+    allocator — XLA buffer assignment owns memory planning under jit."""
+
+    initial_size: int = 0
+    max_size: int = 0
+    policy_allocation: str = "OVERALLOCATE"
+    policy_learning: str = "FIRST_LOOP"
+    policy_spill: str = "REALLOCATE"
+    debug_mode: DebugMode = DebugMode.DISABLED
+
+
+class MemoryWorkspace:
+    """Scoped accounting region (context manager).
+
+    Tracks scope nesting, tagged arrays, and device-memory delta across
+    the scope — the observability half of the reference workspace,
+    minus the arena (see module docstring).
+    """
+
+    def __init__(self, config: Optional[WorkspaceConfiguration] = None,
+                 workspace_id: str = "WS"):
+        self.config = config or WorkspaceConfiguration()
+        self.id = workspace_id
+        self._tracked: List[Any] = []
+        self._mem_before: Optional[int] = None
+        self.bytes_delta: Optional[int] = None
+
+    # -- scope protocol (reference: notifyScopeEntered/Left) -----------
+    def __enter__(self) -> "MemoryWorkspace":
+        _WorkspaceManager.instance()._push(self)
+        self._mem_before = device_memory_stats().get("bytes_in_use")
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        after = device_memory_stats().get("bytes_in_use")
+        if self._mem_before is not None and after is not None:
+            self.bytes_delta = after - self._mem_before
+        _WorkspaceManager.instance()._pop(self)
+        return False
+
+    def track(self, arr) -> Any:
+        """Tag an array as belonging to this scope (reference: arrays
+        allocated inside the workspace). `leverage` detaches."""
+        self._tracked.append(arr)
+        return arr
+
+    def leverage(self, arr) -> Any:
+        if arr in self._tracked:
+            self._tracked.remove(arr)
+        return arr
+
+    def tracked_count(self) -> int:
+        return len(self._tracked)
+
+
+class _WorkspaceManager:
+    _inst: Optional["_WorkspaceManager"] = None
+
+    def __init__(self):
+        self._local = threading.local()
+
+    @classmethod
+    def instance(cls) -> "_WorkspaceManager":
+        if cls._inst is None:
+            cls._inst = _WorkspaceManager()
+        return cls._inst
+
+    def _stack(self) -> List[MemoryWorkspace]:
+        if not hasattr(self._local, "stack"):
+            self._local.stack = []
+        return self._local.stack
+
+    def _push(self, ws: MemoryWorkspace) -> None:
+        self._stack().append(ws)
+
+    def _pop(self, ws: MemoryWorkspace) -> None:
+        stack = self._stack()
+        if not stack or stack[-1] is not ws:
+            raise RuntimeError(
+                f"workspace scope mismatch: closing {ws.id} but stack is "
+                f"{[w.id for w in stack]}")
+        stack.pop()
+
+    def open_workspaces(self) -> List[str]:
+        return [w.id for w in self._stack()]
+
+
+def getWorkspaceManager() -> _WorkspaceManager:
+    return _WorkspaceManager.instance()
+
+
+def assert_no_workspaces_open(msg: str = "") -> None:
+    """Reference: WorkspaceUtils.assertNoWorkspacesOpen — test/debug
+    guard against leaked scopes."""
+    open_ws = _WorkspaceManager.instance().open_workspaces()
+    if open_ws:
+        raise RuntimeError(
+            f"Workspaces still open: {open_ws}. {msg}".strip())
+
+
+# ----------------------------------------------------- crash reporting
+class CrashReportingUtil:
+    """Reference: org/deeplearning4j/util/CrashReportingUtil — dump a
+    full memory/config report when training OOMs."""
+
+    @staticmethod
+    def generate_report(model=None, extra: Optional[dict] = None) -> str:
+        lines = [
+            "==== DL4J-TPU crash / memory report ====",
+            f"time: {datetime.datetime.now().isoformat()}",
+            f"jax backend: {jax.default_backend()} "
+            f"({jax.device_count()} devices)",
+        ]
+        for d in jax.local_devices():
+            lines.append(f"device memory: {device_memory_stats(d)}")
+        lines.append(f"host memory: {host_memory_stats()}")
+        lines.append("open workspaces: "
+                     f"{_WorkspaceManager.instance().open_workspaces()}")
+        if model is not None:
+            try:
+                lines.append(f"model: {type(model).__name__}, params="
+                             f"{model.numParams():,}")
+            except Exception:
+                pass
+            conf = getattr(model, "conf", None)
+            if conf is not None and hasattr(conf, "to_json"):
+                lines.append("config:")
+                lines.append(conf.to_json())
+        for k, v in (extra or {}).items():
+            lines.append(f"{k}: {v}")
+        return "\n".join(lines)
+
+    @staticmethod
+    def writeMemoryCrashDump(model=None, path: Optional[str] = None,
+                             extra: Optional[dict] = None) -> str:
+        path = path or os.path.join(
+            os.getcwd(),
+            f"dl4j-tpu-crash-{datetime.datetime.now():%Y%m%d-%H%M%S}.txt")
+        with open(path, "w") as f:
+            f.write(CrashReportingUtil.generate_report(model, extra))
+        return path
+
+    @staticmethod
+    def wrap_oom(fn, model=None, dump_dir: Optional[str] = None):
+        """Wrap a train/step callable: on XLA RESOURCE_EXHAUSTED (or
+        host MemoryError), write the crash dump and re-raise."""
+
+        def guarded(*args, **kwargs):
+            try:
+                return fn(*args, **kwargs)
+            except (MemoryError, Exception) as e:  # XlaRuntimeError subclass
+                name = type(e).__name__
+                msg = str(e)
+                if isinstance(e, MemoryError) or \
+                        "RESOURCE_EXHAUSTED" in msg or "Out of memory" in msg:
+                    path = None
+                    if dump_dir:
+                        path = os.path.join(dump_dir, "oom-dump.txt")
+                    written = CrashReportingUtil.writeMemoryCrashDump(
+                        model, path, extra={"exception": f"{name}: {msg}"})
+                    raise type(e)(
+                        f"{msg}\n[crash dump written: {written}]") from e
+                raise
+
+        return guarded
+
+
+__all__ = ["MemoryWorkspace", "WorkspaceConfiguration", "DebugMode",
+           "getWorkspaceManager", "assert_no_workspaces_open",
+           "device_memory_stats", "host_memory_stats",
+           "CrashReportingUtil"]

@@ -338,8 +338,24 @@ class MultiLayerNetwork:
             jnp.asarray(self._iteration), jnp.asarray(self._epoch), x, y, m, sub)
         self._score = float(loss)
         self._iteration += 1
+        self._panic_check()
         for l in self._listeners:
             l.iterationDone(self, self._iteration, self._epoch)
+
+    def _panic_check(self):
+        """NaN/Inf panic hook (reference: OpProfiler NAN_PANIC et al. —
+        per-op there, per-step here since the step is one executable)."""
+        from deeplearning4j_tpu.profiler import (
+            OpProfiler, ProfilerMode, check_numerics,
+        )
+        cfg = OpProfiler.getInstance().config
+        if cfg.mode in (ProfilerMode.DISABLED, ProfilerMode.OPERATIONS):
+            return
+        check_numerics(self._score, cfg.mode,
+                       f"in score at iteration {self._iteration}")
+        if cfg.check_params:
+            check_numerics(self.params_list, cfg.mode,
+                           f"in params at iteration {self._iteration}")
 
     def _fit_tbptt(self, x, y, mask, k: int):
         """Truncated BPTT over the time axis (reference:
@@ -373,6 +389,7 @@ class MultiLayerNetwork:
                 xc, yc, mc, sub)
             self._score = float(loss)
             self._iteration += 1
+            self._panic_check()
             for l in self._listeners:
                 l.iterationDone(self, self._iteration, self._epoch)
 

@@ -1,0 +1,167 @@
+"""Profiling / numerics-panic instrumentation.
+
+Reference: org/nd4j/linalg/profiler/{OpProfiler,ProfilerConfig} — per-op
+timing histograms and NAN_PANIC/INF_PANIC/ANY_PANIC modes that assert-
+check every op output (SURVEY.md §5); libnd4j graph/profiling/
+{GraphProfile,NodeProfile}.
+
+TPU redesign: the reference times each op at its JNI dispatch; here the
+whole step is ONE XLA executable, so "per-op wall time" lives in the
+XLA profile, not Python. The split is:
+
+- `start_trace`/`stop_trace` — jax.profiler traces (XLA per-op/HLO
+  timing; open in TensorBoard/xprof). This is the NodeProfile analog.
+- `OpProfiler` — graph-dispatch census (how many times each registered
+  op is EMITTED/traced; invocation counts + host wall-clock of eager
+  registry calls) plus step-level timings via `ProfilerListener`.
+- panic modes — `check_numerics(tree)` host assertion used by the
+  training front-ends each iteration when enabled (the score is always
+  checked; ANY_PANIC also sweeps params — documented cost).
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import enum
+import time
+from typing import Dict, Optional
+
+import jax
+import numpy as np
+
+from deeplearning4j_tpu.ops import registry as _registry
+
+
+class ProfilerMode(enum.Enum):
+    DISABLED = "disabled"
+    OPERATIONS = "operations"     # count + time registry dispatches
+    NAN_PANIC = "nan_panic"
+    INF_PANIC = "inf_panic"
+    ANY_PANIC = "any_panic"       # NaN or Inf, sweep params too
+
+
+class ProfilerConfig:
+    def __init__(self, mode: ProfilerMode = ProfilerMode.DISABLED,
+                 check_params: bool = False):
+        self.mode = mode
+        self.check_params = check_params or mode is ProfilerMode.ANY_PANIC
+
+
+class OpProfiler:
+    """Singleton (reference: OpProfiler.getInstance())."""
+
+    _instance: Optional["OpProfiler"] = None
+
+    def __init__(self):
+        self.config = ProfilerConfig()
+        self.invocations: Dict[str, int] = collections.Counter()
+        self.total_time: Dict[str, float] = collections.defaultdict(float)
+        self._orig_get_op = None
+
+    @classmethod
+    def getInstance(cls) -> "OpProfiler":
+        if cls._instance is None:
+            cls._instance = OpProfiler()
+        return cls._instance
+
+    # -- registry instrumentation --------------------------------------
+    def applyConfig(self, config: ProfilerConfig) -> None:
+        self.config = config
+        if config.mode is ProfilerMode.OPERATIONS:
+            self._install()
+        else:
+            self._uninstall()
+
+    def _install(self) -> None:
+        if self._orig_get_op is not None:
+            return
+        self._orig_get_op = _registry.get_op
+        prof = self
+
+        def profiled_get_op(name):
+            fn = prof._orig_get_op(name)
+
+            def wrapped(*a, **k):
+                t0 = time.perf_counter()
+                out = fn(*a, **k)
+                prof.invocations[name] += 1
+                prof.total_time[name] += time.perf_counter() - t0
+                return out
+
+            return wrapped
+
+        _registry.get_op = profiled_get_op
+
+    def _uninstall(self) -> None:
+        if self._orig_get_op is not None:
+            _registry.get_op = self._orig_get_op
+            self._orig_get_op = None
+
+    def reset(self) -> None:
+        self.invocations.clear()
+        self.total_time.clear()
+
+    def printOutDashboard(self) -> str:
+        lines = ["OpProfiler (dispatch census — XLA fuses execution; "
+                 "use start_trace for device timing):"]
+        for name, n in sorted(self.invocations.items(),
+                              key=lambda kv: -self.total_time[kv[0]]):
+            lines.append(f"  {name:<30} calls={n:<8} "
+                         f"host_time={self.total_time[name]*1e3:.2f}ms")
+        return "\n".join(lines)
+
+
+# ---------------------------------------------------------------- panics
+class NumericsException(ArithmeticError):
+    pass
+
+
+def check_numerics(tree, mode: ProfilerMode, context: str = "") -> None:
+    """Host-side NaN/Inf assertion over a pytree (reference: the panic
+    modes' per-op output checks, applied per-step here)."""
+    if mode in (ProfilerMode.DISABLED, ProfilerMode.OPERATIONS):
+        return
+    for leaf in jax.tree_util.tree_leaves(tree):
+        a = np.asarray(leaf)
+        if not np.issubdtype(a.dtype, np.floating):
+            continue
+        if mode in (ProfilerMode.NAN_PANIC, ProfilerMode.ANY_PANIC) \
+                and np.isnan(a).any():
+            raise NumericsException(f"NaN detected {context}")
+        if mode in (ProfilerMode.INF_PANIC, ProfilerMode.ANY_PANIC) \
+                and np.isinf(a).any():
+            raise NumericsException(f"Inf detected {context}")
+
+
+# ------------------------------------------------------------ XLA traces
+_trace_active = False
+
+
+def start_trace(log_dir: str) -> None:
+    """Start a jax.profiler trace (per-op HLO timing — the reference's
+    NodeProfile equivalent, viewable in xprof/TensorBoard)."""
+    global _trace_active
+    jax.profiler.start_trace(log_dir)
+    _trace_active = True
+
+
+def stop_trace() -> None:
+    global _trace_active
+    if _trace_active:
+        jax.profiler.stop_trace()
+        _trace_active = False
+
+
+@contextlib.contextmanager
+def trace(log_dir: str):
+    start_trace(log_dir)
+    try:
+        yield
+    finally:
+        stop_trace()
+
+
+__all__ = ["OpProfiler", "ProfilerConfig", "ProfilerMode",
+           "NumericsException", "check_numerics", "start_trace",
+           "stop_trace", "trace"]

@@ -1,0 +1,163 @@
+"""Memory workspaces, device stats, crash reporting, profiler + panics.
+
+Reference: SURVEY.md §2.10/§2.11 (workspaces/allocator), §5 (OpProfiler
+panic modes, CrashReportingUtil).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.memory import (
+    CrashReportingUtil, DebugMode, MemoryWorkspace, WorkspaceConfiguration,
+    assert_no_workspaces_open, device_memory_stats, getWorkspaceManager,
+    host_memory_stats,
+)
+from deeplearning4j_tpu.profiler import (
+    NumericsException, OpProfiler, ProfilerConfig, ProfilerMode,
+    check_numerics,
+)
+
+
+class TestWorkspaces:
+    def test_scoping_and_nesting(self):
+        assert_no_workspaces_open()
+        with MemoryWorkspace(workspace_id="outer") as outer:
+            assert getWorkspaceManager().open_workspaces() == ["outer"]
+            with MemoryWorkspace(workspace_id="inner"):
+                assert getWorkspaceManager().open_workspaces() == \
+                    ["outer", "inner"]
+            outer.track(np.zeros(4))
+            assert outer.tracked_count() == 1
+        assert_no_workspaces_open()
+
+    def test_leak_detection(self):
+        ws = MemoryWorkspace(workspace_id="leaky")
+        ws.__enter__()
+        with pytest.raises(RuntimeError, match="leaky"):
+            assert_no_workspaces_open()
+        ws.__exit__(None, None, None)
+
+    def test_mismatched_close(self):
+        a = MemoryWorkspace(workspace_id="a")
+        b = MemoryWorkspace(workspace_id="b")
+        a.__enter__()
+        b.__enter__()
+        with pytest.raises(RuntimeError, match="mismatch"):
+            a.__exit__(None, None, None)
+        b.__exit__(None, None, None)
+        a.__exit__(None, None, None)
+
+    def test_config_fields(self):
+        cfg = WorkspaceConfiguration(initial_size=1 << 20,
+                                     debug_mode=DebugMode.VALIDATE_SCOPES)
+        assert cfg.policy_allocation == "OVERALLOCATE"
+
+    def test_memory_stats(self):
+        d = device_memory_stats()
+        assert "platform" in d
+        h = host_memory_stats()
+        assert h.get("max_rss_mb", 1) > 0
+
+
+class TestCrashReporting:
+    def _net(self):
+        from deeplearning4j_tpu.learning.updaters import Adam
+        from deeplearning4j_tpu.nn.conf import (
+            DenseLayer, InputType, NeuralNetConfiguration, OutputLayer,
+        )
+        from deeplearning4j_tpu.nn.multilayer.network import (
+            MultiLayerNetwork,
+        )
+        conf = (NeuralNetConfiguration.builder().updater(Adam(1e-2)).list()
+                .layer(DenseLayer(n_out=4, activation="relu"))
+                .layer(OutputLayer(n_out=2, activation="softmax",
+                                   loss="mcxent"))
+                .setInputType(InputType.feedForward(3)).build())
+        return MultiLayerNetwork(conf).init()
+
+    def test_report_contents(self):
+        net = self._net()
+        report = CrashReportingUtil.generate_report(net, extra={"k": "v"})
+        assert "MultiLayerNetwork" in report
+        assert "DenseLayer" in report       # config json included
+        assert "k: v" in report
+
+    def test_dump_written(self, tmp_path):
+        path = CrashReportingUtil.writeMemoryCrashDump(
+            None, str(tmp_path / "dump.txt"))
+        assert os.path.exists(path)
+        assert "crash / memory report" in open(path).read()
+
+    def test_wrap_oom(self, tmp_path):
+        def boom():
+            raise MemoryError("Out of memory allocating 1TB")
+
+        guarded = CrashReportingUtil.wrap_oom(boom, dump_dir=str(tmp_path))
+        with pytest.raises(MemoryError, match="crash dump written"):
+            guarded()
+        assert os.path.exists(tmp_path / "oom-dump.txt")
+
+    def test_wrap_passthrough(self):
+        guarded = CrashReportingUtil.wrap_oom(lambda: 42)
+        assert guarded() == 42
+        bad = CrashReportingUtil.wrap_oom(
+            lambda: (_ for _ in ()).throw(ValueError("not oom")))
+        with pytest.raises(ValueError, match="not oom"):
+            bad()
+
+
+class TestProfiler:
+    def test_operations_mode_counts(self):
+        from deeplearning4j_tpu.ops import registry
+        prof = OpProfiler.getInstance()
+        prof.reset()
+        prof.applyConfig(ProfilerConfig(ProfilerMode.OPERATIONS))
+        try:
+            fn = registry.get_op("relu")
+            fn(np.asarray([-1.0, 2.0], np.float32))
+            fn2 = registry.get_op("exp")
+            fn2(np.asarray([0.0], np.float32))
+            assert prof.invocations["relu"] == 1
+            assert prof.invocations["exp"] == 1
+            assert "relu" in prof.printOutDashboard()
+        finally:
+            prof.applyConfig(ProfilerConfig(ProfilerMode.DISABLED))
+
+    def test_check_numerics(self):
+        check_numerics([np.ones(3)], ProfilerMode.ANY_PANIC)  # clean: ok
+        with pytest.raises(NumericsException, match="NaN"):
+            check_numerics(np.asarray([np.nan]), ProfilerMode.NAN_PANIC)
+        with pytest.raises(NumericsException, match="Inf"):
+            check_numerics(np.asarray([np.inf]), ProfilerMode.INF_PANIC)
+        # NAN_PANIC ignores Inf
+        check_numerics(np.asarray([np.inf]), ProfilerMode.NAN_PANIC)
+
+    def test_training_panic_hook(self):
+        """A diverging net (huge lr on exp-ing loss) must raise under
+        NAN_PANIC instead of silently training on NaNs."""
+        from deeplearning4j_tpu.learning.updaters import Sgd
+        from deeplearning4j_tpu.nn.conf import (
+            DenseLayer, InputType, NeuralNetConfiguration, OutputLayer,
+        )
+        from deeplearning4j_tpu.nn.multilayer.network import (
+            MultiLayerNetwork,
+        )
+        conf = (NeuralNetConfiguration.builder().updater(Sgd(1e9)).list()
+                .layer(DenseLayer(n_out=8, activation="relu"))
+                .layer(OutputLayer(n_out=2, activation="softmax",
+                                   loss="mcxent"))
+                .setInputType(InputType.feedForward(4)).build())
+        net = MultiLayerNetwork(conf).init()
+        rs = np.random.RandomState(0)
+        x = rs.randn(8, 4).astype(np.float32) * 100
+        y = np.eye(2, dtype=np.float32)[rs.randint(0, 2, 8)]
+        prof = OpProfiler.getInstance()
+        prof.applyConfig(ProfilerConfig(ProfilerMode.NAN_PANIC))
+        try:
+            with pytest.raises(NumericsException):
+                for _ in range(50):
+                    net.fit(x, y)
+        finally:
+            prof.applyConfig(ProfilerConfig(ProfilerMode.DISABLED))
