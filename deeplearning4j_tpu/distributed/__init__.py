@@ -1,0 +1,291 @@
+"""Distributed training backend (multi-host orchestration + parameter-
+server API parity).
+
+Reference (SURVEY.md §2.30/§2.31, §3.5):
+- nd4j-parameter-server-parent v2: ModelParameterServer over Aeron UDP,
+  MeshOrganizer building a root/downstream node tree with heartbeats
+  and remapping on disconnect, threshold-encoded VoidChunk gradient
+  broadcast.
+- dl4j-spark: SharedTrainingMaster / ParameterAveragingTrainingMaster
+  orchestrating workers, SparkDl4jMultiLayer front-end.
+
+TPU-native redesign: the ENTIRE Aeron mesh + chunked message machinery
+collapses into XLA collectives — psum over ICI intra-slice, DCN
+collectives across slices — compiled into the training step (SURVEY.md
+§2 end-note). Spark's role (process orchestration, initial broadcast,
+final fetch) maps to `jax.distributed` multi-process runtime + GSPMD.
+What this module therefore provides:
+
+- DistributedBackend — jax.distributed lifecycle (the MediaDriver/
+  transport analog; coordinator address instead of Aeron channels).
+- MeshOrganizer — topology planning over (hosts x local devices) with
+  node bookkeeping, heartbeats, and mesh rebuild on node loss. The
+  reference remaps its overlay tree on failure; here "remap" =
+  rebuilding the jax Mesh over surviving hosts and re-lowering the
+  step (XLA owns routing, so there is no overlay to repair).
+- ModelParameterServer — API-parity facade (launch/shutdown/sendUpdate/
+  getParams/subscribe) whose transport is the compiled collective, with
+  an in-process loopback for tests (the reference tests over localhost
+  Aeron the same way, §4).
+- SharedTrainingMaster / ParameterAveragingTrainingMaster /
+  DistributedDl4jMultiLayer — the Spark-layer API over ShardedTrainer.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+import numpy as np
+
+from deeplearning4j_tpu.parallel.mesh import build_mesh
+from deeplearning4j_tpu.parallel.sharded import ShardedTrainer
+
+
+# ----------------------------------------------------------- backend
+class DistributedBackend:
+    """jax.distributed lifecycle (reference: VoidParameterServer's
+    embedded Aeron MediaDriver + transport setup)."""
+
+    _initialized = False
+
+    @classmethod
+    def initialize(cls, coordinator_address: Optional[str] = None,
+                   num_processes: int = 1, process_id: int = 0) -> None:
+        """Multi-process init. Single-process (the test/default case) is
+        a no-op: the local mesh already spans all addressable devices."""
+        if cls._initialized:
+            return
+        if num_processes > 1:
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes, process_id=process_id)
+        cls._initialized = True
+
+    @classmethod
+    def shutdown(cls) -> None:
+        if cls._initialized and jax.process_count() > 1:
+            jax.distributed.shutdown()
+        cls._initialized = False
+
+    @staticmethod
+    def process_count() -> int:
+        return jax.process_count()
+
+    @staticmethod
+    def process_index() -> int:
+        return jax.process_index()
+
+
+# ------------------------------------------------------ mesh organizer
+@dataclasses.dataclass
+class NodeInfo:
+    node_id: str
+    device_count: int
+    last_heartbeat: float
+    alive: bool = True
+
+
+class MeshOrganizer:
+    """Topology planner + node health bookkeeping.
+
+    Reference: v2/util/MeshOrganizer builds a root/downstream overlay
+    tree (max 8 downstreams per node), remaps children when a node
+    drops, and drives heartbeat timeouts. Here the data plane is XLA's,
+    so the organizer's real outputs are (a) the jax Mesh over healthy
+    nodes' devices and (b) the decision to rebuild when membership
+    changes.
+    """
+
+    HEARTBEAT_TIMEOUT_S = 30.0
+
+    def __init__(self):
+        self._nodes: Dict[str, NodeInfo] = {}
+        self._listeners: List[Callable[[str, str], None]] = []
+
+    # -- membership ----------------------------------------------------
+    def addNode(self, node_id: str, device_count: int) -> None:
+        self._nodes[node_id] = NodeInfo(node_id, device_count, time.time())
+        self._emit("added", node_id)
+
+    def removeNode(self, node_id: str) -> None:
+        if node_id in self._nodes:
+            self._nodes[node_id].alive = False
+            self._emit("removed", node_id)
+
+    def heartbeat(self, node_id: str) -> None:
+        n = self._nodes.get(node_id)
+        if n is not None:
+            n.last_heartbeat = time.time()
+            if not n.alive:
+                n.alive = True
+                self._emit("rejoined", node_id)
+
+    def sweep(self, now: Optional[float] = None) -> List[str]:
+        """Mark nodes with stale heartbeats dead; return newly-dead ids
+        (reference: heartbeat timeout -> remap)."""
+        now = now if now is not None else time.time()
+        dead = []
+        for n in self._nodes.values():
+            if n.alive and now - n.last_heartbeat > self.HEARTBEAT_TIMEOUT_S:
+                n.alive = False
+                dead.append(n.node_id)
+                self._emit("timeout", n.node_id)
+        return dead
+
+    def aliveNodes(self) -> List[NodeInfo]:
+        return [n for n in self._nodes.values() if n.alive]
+
+    def totalDevices(self) -> int:
+        return sum(n.device_count for n in self.aliveNodes())
+
+    def onMembershipChange(self, fn: Callable[[str, str], None]) -> None:
+        self._listeners.append(fn)
+
+    def _emit(self, event: str, node_id: str) -> None:
+        for fn in list(self._listeners):
+            fn(event, node_id)
+
+    # -- topology ------------------------------------------------------
+    def buildMesh(self, num_model: int = 1, devices=None):
+        """Mesh over the devices of alive nodes. Single-process: uses
+        the local device list (the organizer's accounting still drives
+        WHEN to rebuild)."""
+        devs = list(devices if devices is not None else jax.devices())
+        usable = min(len(devs), self.totalDevices() or len(devs))
+        # largest multiple of num_model that fits
+        num_data = max(usable // num_model, 1)
+        devs = devs[:num_data * num_model]
+        return build_mesh(num_data=num_data, num_model=num_model,
+                          devices=devs)
+
+
+# ---------------------------------------------- parameter server facade
+class ModelParameterServer:
+    """API-parity facade for the v2 parameter server.
+
+    Reference: distributed/v2/ModelParameterServer — launch(), shutdown(),
+    sendUpdate(INDArray), getParams(), update subscribers. The Aeron
+    transport is replaced by the compiled collective inside
+    ShardedTrainer; this facade exists for (a) API migration and (b) the
+    in-process loopback mode the reference's own tests use
+    (DelayedModelParameterServerTest over localhost, SURVEY.md §4):
+    updates sent here are accumulated and applied to the tracked params,
+    and subscribers observe them, all without a network.
+    """
+
+    def __init__(self, organizer: Optional[MeshOrganizer] = None,
+                 is_master: bool = True):
+        self.organizer = organizer or MeshOrganizer()
+        self.is_master = is_master
+        self._launched = False
+        self._params: Optional[np.ndarray] = None
+        self._subscribers: List[Callable[[np.ndarray], None]] = []
+
+    def launch(self) -> None:
+        self._launched = True
+
+    def shutdown(self) -> None:
+        self._launched = False
+
+    def isInitialized(self) -> bool:
+        return self._launched
+
+    # -- param plane ---------------------------------------------------
+    def setParams(self, params: np.ndarray) -> None:
+        self._params = np.asarray(params, np.float32).copy()
+
+    def getParams(self) -> np.ndarray:
+        if self._params is None:
+            raise RuntimeError("no params broadcast yet")
+        return self._params.copy()
+
+    def sendUpdate(self, update: np.ndarray) -> None:
+        """Apply an additive update (the decoded threshold gradient in
+        the reference) and notify subscribers."""
+        if not self._launched:
+            raise RuntimeError("sendUpdate before launch()")
+        if self._params is None:
+            raise RuntimeError("setParams before sendUpdate")
+        u = np.asarray(update, np.float32)
+        self._params += u
+        for fn in list(self._subscribers):
+            fn(u)
+
+    def addUpdatesSubscriber(self, fn: Callable[[np.ndarray], None]) -> None:
+        self._subscribers.append(fn)
+
+
+# ------------------------------------------------------ training masters
+class SharedTrainingMaster:
+    """Reference: spark/parameterserver/training/SharedTrainingMaster —
+    gradient-sharing distributed training with threshold compression.
+    Here: configuration holder mapping onto ShardedTrainer modes."""
+
+    def __init__(self, threshold: float = 1e-3, compressed: bool = False,
+                 num_model: int = 1):
+        self.threshold = threshold
+        self.compressed = compressed
+        self.num_model = num_model
+
+    def make_trainer(self, model, mesh=None) -> ShardedTrainer:
+        return ShardedTrainer(
+            model, mesh=mesh,
+            mode="sharing_compressed" if self.compressed else "sharing",
+            threshold=self.threshold)
+
+
+class ParameterAveragingTrainingMaster:
+    """Reference: spark/impl/paramavg/ParameterAveragingTrainingMaster."""
+
+    def __init__(self, averaging_frequency: int = 5):
+        self.averaging_frequency = averaging_frequency
+
+    def make_trainer(self, model, mesh=None) -> ShardedTrainer:
+        return ShardedTrainer(model, mesh=mesh, mode="averaging",
+                              averaging_frequency=self.averaging_frequency)
+
+
+class DistributedDl4jMultiLayer:
+    """Front-end (reference: SparkDl4jMultiLayer): a model + a training
+    master + an organizer-planned mesh; fit() runs the compiled SPMD
+    step over every healthy device and rebuilds the mesh when
+    membership changes."""
+
+    def __init__(self, model, training_master,
+                 organizer: Optional[MeshOrganizer] = None,
+                 num_model: int = 1):
+        self.model = model
+        self.master = training_master
+        self.organizer = organizer or MeshOrganizer()
+        self.num_model = num_model
+        self._trainer: Optional[ShardedTrainer] = None
+        self._membership_dirty = False
+        self.organizer.onMembershipChange(self._on_change)
+
+    def _on_change(self, event: str, node_id: str) -> None:
+        self._membership_dirty = True
+
+    def _ensure_trainer(self) -> ShardedTrainer:
+        if self._trainer is None or self._membership_dirty:
+            mesh = self.organizer.buildMesh(num_model=self.num_model) \
+                if self.organizer.aliveNodes() else None
+            self._trainer = self.master.make_trainer(self.model, mesh=mesh)
+            self._membership_dirty = False
+        return self._trainer
+
+    def fit(self, data, labels=None, epochs: int = 1):
+        trainer = self._ensure_trainer()
+        trainer.fit(data, labels, epochs=epochs)
+        return self.model
+
+    @property
+    def mesh(self):
+        return self._ensure_trainer().mesh
+
+
+__all__ = ["DistributedBackend", "MeshOrganizer", "NodeInfo",
+           "ModelParameterServer", "SharedTrainingMaster",
+           "ParameterAveragingTrainingMaster", "DistributedDl4jMultiLayer"]

@@ -1,0 +1,151 @@
+"""Distributed backend: mesh organizer, parameter-server facade,
+training masters over the virtual 8-device CPU mesh.
+
+Reference: nd4j-parameter-server v2 (MeshOrganizer, ModelParameterServer,
+heartbeats/remap) and dl4j-spark training masters (SURVEY.md §2.30/2.31),
+tested in-process exactly like the reference's localhost-Aeron tests (§4).
+"""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.distributed import (
+    DistributedBackend, DistributedDl4jMultiLayer, MeshOrganizer,
+    ModelParameterServer, ParameterAveragingTrainingMaster,
+    SharedTrainingMaster,
+)
+from deeplearning4j_tpu.learning.updaters import Adam
+from deeplearning4j_tpu.nn.conf import (
+    DenseLayer, InputType, NeuralNetConfiguration, OutputLayer,
+)
+from deeplearning4j_tpu.nn.multilayer.network import MultiLayerNetwork
+
+
+def _net(seed=1):
+    conf = (NeuralNetConfiguration.builder().seed(seed).updater(Adam(1e-2))
+            .list()
+            .layer(DenseLayer(n_out=16, activation="relu"))
+            .layer(OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+            .setInputType(InputType.feedForward(6))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _data(n=64, seed=0):
+    rs = np.random.RandomState(seed)
+    x = rs.randn(n, 6).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[(x.sum(1) > 0).astype(int)]
+    return x, y
+
+
+class TestBackend:
+    def test_single_process(self):
+        DistributedBackend.initialize()
+        assert DistributedBackend.process_count() == 1
+        assert DistributedBackend.process_index() == 0
+        DistributedBackend.shutdown()
+
+
+class TestMeshOrganizer:
+    def test_membership_and_heartbeats(self):
+        org = MeshOrganizer()
+        events = []
+        org.onMembershipChange(lambda e, n: events.append((e, n)))
+        org.addNode("host0", 8)
+        org.addNode("host1", 8)
+        assert org.totalDevices() == 16
+        org.removeNode("host1")
+        assert org.totalDevices() == 8
+        org.heartbeat("host1")          # rejoin
+        assert org.totalDevices() == 16
+        assert ("added", "host0") in events
+        assert ("removed", "host1") in events
+        assert ("rejoined", "host1") in events
+
+    def test_timeout_sweep(self):
+        org = MeshOrganizer()
+        org.addNode("host0", 8)
+        dead = org.sweep(now=org._nodes["host0"].last_heartbeat
+                         + MeshOrganizer.HEARTBEAT_TIMEOUT_S + 1)
+        assert dead == ["host0"]
+        assert org.totalDevices() == 0
+
+    def test_build_mesh_uses_alive_capacity(self):
+        org = MeshOrganizer()
+        org.addNode("host0", 4)         # fewer than the 8 local devices
+        mesh = org.buildMesh()
+        assert mesh.shape["data"] == 4
+        org.addNode("host1", 4)
+        assert org.buildMesh().shape["data"] == 8
+
+
+class TestParameterServerFacade:
+    def test_update_flow(self):
+        ps = ModelParameterServer()
+        ps.launch()
+        ps.setParams(np.zeros(4, np.float32))
+        seen = []
+        ps.addUpdatesSubscriber(lambda u: seen.append(u.copy()))
+        ps.sendUpdate(np.asarray([1, 0, 0, 0], np.float32))
+        ps.sendUpdate(np.asarray([0, 2, 0, 0], np.float32))
+        np.testing.assert_allclose(ps.getParams(), [1, 2, 0, 0])
+        assert len(seen) == 2
+        ps.shutdown()
+        assert not ps.isInitialized()
+
+    def test_errors(self):
+        ps = ModelParameterServer()
+        with pytest.raises(RuntimeError, match="launch"):
+            ps.sendUpdate(np.zeros(2, np.float32))
+        ps.launch()
+        with pytest.raises(RuntimeError, match="setParams"):
+            ps.sendUpdate(np.zeros(2, np.float32))
+
+
+class TestTrainingMasters:
+    def test_shared_training_end_to_end(self):
+        net = _net()
+        org = MeshOrganizer()
+        org.addNode("local", 8)
+        dist = DistributedDl4jMultiLayer(
+            net, SharedTrainingMaster(), organizer=org)
+        x, y = _data()
+        first = None
+        for _ in range(20):
+            dist.fit(x, y)
+            first = first if first is not None else net.score()
+        assert net.score() < first
+        assert dist.mesh.shape["data"] == 8
+
+    def test_compressed_master(self):
+        net = _net(seed=2)
+        dist = DistributedDl4jMultiLayer(
+            net, SharedTrainingMaster(compressed=True, threshold=1e-4))
+        x, y = _data(seed=3)
+        for _ in range(10):
+            dist.fit(x, y)
+        assert np.isfinite(net.score())
+
+    def test_averaging_master(self):
+        net = _net(seed=4)
+        dist = DistributedDl4jMultiLayer(
+            net, ParameterAveragingTrainingMaster(averaging_frequency=2))
+        x, y = _data(seed=5)
+        first = None
+        for _ in range(20):
+            dist.fit(x, y)
+            first = first if first is not None else net.score()
+        assert net.score() < first
+
+    def test_membership_change_rebuilds_mesh(self):
+        net = _net(seed=6)
+        org = MeshOrganizer()
+        org.addNode("h0", 4)
+        dist = DistributedDl4jMultiLayer(net, SharedTrainingMaster(),
+                                         organizer=org)
+        x, y = _data(seed=7)
+        dist.fit(x, y)
+        assert dist.mesh.shape["data"] == 4
+        org.addNode("h1", 4)            # capacity grows -> mesh rebuilt
+        dist.fit(x, y)
+        assert dist.mesh.shape["data"] == 8
