@@ -1,0 +1,181 @@
+"""Scoped Python execution with typed variable marshalling.
+
+Reference: python4j — org/nd4j/python4j/{PythonExecutioner,
+PythonVariables,PythonTypes,PythonContextManager} (SURVEY.md §2.40).
+The reference embeds CPython inside the JVM to let Java pipelines run
+user Python (datavec PythonTransform, Keras lambda layers); it manages
+the GIL, named interpreter contexts, and Java<->Python type
+marshalling.
+
+In the TPU rebuild the HOST language already is Python, so the
+embedding layer disappears — what remains (and is provided here) is
+the part users actually program against: named isolated execution
+contexts, typed variable containers with NDArray/numpy marshalling,
+and the PythonTransform bridge into datavec. Execution uses exec()
+with a per-context namespace; a threading lock mirrors the reference's
+GIL serialization of executioner calls.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.ndarray.ndarray import NDArray
+
+
+class PythonType:
+    """Marshalling table (reference: PythonTypes.{INT,FLOAT,STR,BOOL,
+    LIST,DICT,BYTES,NDARRAY})."""
+
+    SUPPORTED = (int, float, str, bool, bytes, list, dict, np.ndarray,
+                 NDArray, type(None))
+
+    @staticmethod
+    def to_python(v: Any) -> Any:
+        if isinstance(v, NDArray):
+            return v.toNumpy()
+        return v
+
+    @staticmethod
+    def from_python(v: Any) -> Any:
+        if isinstance(v, np.ndarray):
+            return v
+        if isinstance(v, PythonType.SUPPORTED):
+            return v
+        try:  # jax arrays & other array-likes -> numpy
+            return np.asarray(v)
+        except Exception:
+            raise TypeError(f"unmarshallable python value: {type(v)}")
+
+
+class PythonVariables:
+    """Typed in/out variable container (reference: PythonVariables)."""
+
+    def __init__(self):
+        self._vals: Dict[str, Any] = {}
+
+    def add(self, name: str, value: Any = None) -> "PythonVariables":
+        if value is not None and not isinstance(value,
+                                                PythonType.SUPPORTED):
+            value = PythonType.from_python(value)
+        self._vals[name] = PythonType.to_python(value)
+        return self
+
+    # reference-style typed adders
+    addInt = addFloat = addStr = addBool = addList = addDict = add
+
+    def addNDArray(self, name: str, arr) -> "PythonVariables":
+        self._vals[name] = np.asarray(
+            arr.toNumpy() if isinstance(arr, NDArray) else arr)
+        return self
+
+    def getValue(self, name: str) -> Any:
+        return self._vals[name]
+
+    def getNDArrayValue(self, name: str) -> NDArray:
+        return NDArray(np.asarray(self._vals[name]))
+
+    def names(self) -> List[str]:
+        return list(self._vals)
+
+    def as_dict(self) -> Dict[str, Any]:
+        return dict(self._vals)
+
+
+class PythonContextManager:
+    """Named isolated namespaces (reference: PythonContextManager —
+    each context is its own interpreter globals dict)."""
+
+    _contexts: Dict[str, Dict[str, Any]] = {}
+    _current = "main"
+
+    @classmethod
+    def getContext(cls, name: str) -> Dict[str, Any]:
+        if name not in cls._contexts:
+            cls._contexts[name] = {"__name__": f"python_exec::{name}"}
+        return cls._contexts[name]
+
+    @classmethod
+    def setContext(cls, name: str) -> None:
+        cls.getContext(name)
+        cls._current = name
+
+    @classmethod
+    def currentContext(cls) -> str:
+        return cls._current
+
+    @classmethod
+    def deleteContext(cls, name: str) -> None:
+        if name == "main":
+            raise ValueError("cannot delete the main context")
+        cls._contexts.pop(name, None)
+        if cls._current == name:
+            cls._current = "main"
+
+    @classmethod
+    def reset(cls) -> None:
+        cls._contexts.clear()
+        cls._current = "main"
+
+
+class PythonExecutioner:
+    """exec() with marshalled inputs/outputs in a named context
+    (reference: PythonExecutioner.exec(code, inputs, outputs)). The
+    lock mirrors the reference's GIL serialization."""
+
+    _lock = threading.Lock()
+
+    @staticmethod
+    def exec(code: str, inputs: Optional[PythonVariables] = None,
+             outputs: Optional[PythonVariables] = None,
+             context: Optional[str] = None) -> Optional[PythonVariables]:
+        ctx_name = context or PythonContextManager.currentContext()
+        ns = PythonContextManager.getContext(ctx_name)
+        with PythonExecutioner._lock:
+            if inputs is not None:
+                ns.update(inputs.as_dict())
+            exec(compile(code, f"<python_exec:{ctx_name}>", "exec"), ns)
+            if outputs is not None:
+                for name in outputs.names():
+                    if name not in ns:
+                        raise KeyError(
+                            f"output variable {name!r} not set by code")
+                    outputs.add(name, ns[name])
+        return outputs
+
+
+# ------------------------------------------------- datavec bridge
+class PythonTransform:
+    """User-code row transform for TransformProcess pipelines
+    (reference: datavec-python PythonTransform). The code sees each
+    input column as a variable named after the column and must assign
+    every output column name."""
+
+    def __init__(self, code: str, input_columns: List[str],
+                 output_columns: List[str], context: str = "transform"):
+        self.code = code
+        self.input_columns = list(input_columns)
+        self.output_columns = list(output_columns)
+        self.context = context
+
+    def apply_columnar(self, table: Dict[str, Any]) -> Dict[str, Any]:
+        """Columnar batch application (one exec per batch, not per row —
+        the vectorized hot path)."""
+        ins = PythonVariables()
+        for c in self.input_columns:
+            ins.add(c, np.asarray(table[c]))
+        outs = PythonVariables()
+        for c in self.output_columns:
+            outs.add(c)
+        PythonExecutioner.exec(self.code, ins, outs, context=self.context)
+        out_table = dict(table)
+        for c in self.output_columns:
+            out_table[c] = np.asarray(outs.getValue(c))
+        return out_table
+
+
+__all__ = ["PythonExecutioner", "PythonVariables", "PythonType",
+           "PythonContextManager", "PythonTransform"]

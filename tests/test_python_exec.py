@@ -1,0 +1,77 @@
+"""python4j-equivalent: scoped execution, variable marshalling,
+PythonTransform (SURVEY.md §2.40)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.ndarray.factory import Nd4j
+from deeplearning4j_tpu.python_exec import (
+    PythonContextManager, PythonExecutioner, PythonTransform,
+    PythonVariables,
+)
+
+
+@pytest.fixture(autouse=True)
+def fresh_contexts():
+    PythonContextManager.reset()
+    yield
+    PythonContextManager.reset()
+
+
+class TestExecutioner:
+    def test_basic_exec(self):
+        ins = PythonVariables().add("a", 2).add("b", 3)
+        outs = PythonVariables().add("c")
+        PythonExecutioner.exec("c = a * b + 1", ins, outs)
+        assert outs.getValue("c") == 7
+
+    def test_ndarray_marshalling(self):
+        x = Nd4j.create(np.asarray([[1.0, 2.0], [3.0, 4.0]], np.float32))
+        ins = PythonVariables().addNDArray("x", x)
+        outs = PythonVariables().add("y")
+        PythonExecutioner.exec("y = (x * 2).sum(axis=0)", ins, outs)
+        np.testing.assert_allclose(
+            outs.getNDArrayValue("y").toNumpy(), [8.0, 12.0])
+
+    def test_missing_output_raises(self):
+        outs = PythonVariables().add("never_set")
+        with pytest.raises(KeyError, match="never_set"):
+            PythonExecutioner.exec("pass", None, outs)
+
+    def test_context_isolation(self):
+        PythonExecutioner.exec("secret = 41", context="ctx_a")
+        with pytest.raises(NameError):
+            PythonExecutioner.exec("print(secret)", context="ctx_b")
+        outs = PythonVariables().add("v")
+        PythonExecutioner.exec("v = secret + 1", outputs=outs,
+                               context="ctx_a")
+        assert outs.getValue("v") == 42
+
+    def test_context_persistence(self):
+        PythonContextManager.setContext("persistent")
+        PythonExecutioner.exec("counter = 0")
+        PythonExecutioner.exec("counter += 1")
+        PythonExecutioner.exec("counter += 1")
+        outs = PythonVariables().add("counter")
+        PythonExecutioner.exec("", outputs=outs)
+        assert outs.getValue("counter") == 2
+
+    def test_delete_context(self):
+        PythonContextManager.setContext("tmp")
+        PythonExecutioner.exec("x = 1")
+        PythonContextManager.deleteContext("tmp")
+        assert PythonContextManager.currentContext() == "main"
+        with pytest.raises(ValueError):
+            PythonContextManager.deleteContext("main")
+
+
+class TestPythonTransform:
+    def test_columnar_transform(self):
+        t = PythonTransform(
+            code="z = x * 2 + y",
+            input_columns=["x", "y"], output_columns=["z"])
+        table = {"x": np.asarray([1.0, 2.0, 3.0]),
+                 "y": np.asarray([10.0, 20.0, 30.0])}
+        out = t.apply_columnar(table)
+        np.testing.assert_allclose(out["z"], [12.0, 24.0, 36.0])
+        np.testing.assert_allclose(out["x"], table["x"])  # inputs kept
