@@ -69,8 +69,12 @@ def tiny_config(vocab=128, max_len=64, d_model=64, n_layers=2, n_heads=4,
 
 
 class TransformerEncoder:
-    def __init__(self, config: TransformerConfig):
+    def __init__(self, config: TransformerConfig, attn_impl: str = "default"):
+        """attn_impl: 'default' (fused XLA softmax attention) or 'flash'
+        (ops.flash_attention dispatcher: Pallas kernels on TPU,
+        blockwise online-softmax elsewhere — O(T) memory)."""
         self.cfg = config
+        self.attn_impl = attn_impl
         self._pdtype = jnp.dtype(config.dtype)
         self._cdtype = jnp.dtype(config.compute_dtype)
 
@@ -178,10 +182,19 @@ class TransformerEncoder:
         if mask is not None:
             att_mask = mask[:, None, None, :]  # [N,1,1,T] key padding
 
+        attn_fn = None
+        if self.attn_impl == "flash":
+            from deeplearning4j_tpu.ops.flash_attention import attention
+
+            def attn_fn(q, k, v, m):
+                key_mask = None if m is None else m[:, 0, 0, :]
+                return attention(q, k, v, key_mask)
+
         keys = (jax.random.split(rng, cfg.n_layers)
                 if (train and rng is not None) else [None] * cfg.n_layers)
         for li, lp in enumerate(params["layers"]):
-            x = self._block(x, lp, att_mask, train, keys[li], sharded)
+            x = self._block(x, lp, att_mask, train, keys[li], sharded,
+                            attn_fn=attn_fn)
         return x
 
     def _block(self, x, lp, att_mask, train, rng, sharded, attn_fn=None):
@@ -241,16 +254,37 @@ class TransformerEncoder:
     # losses / training step
     # ------------------------------------------------------------------
     def mlm_loss(self, params, ids, labels, mask_positions, train=True,
-                 rng=None, sharded=False):
+                 rng=None, sharded=False, masked_capacity=None):
         """labels: [N,T] int32 with targets; mask_positions: [N,T] 1.0
-        where the token was masked (loss only there)."""
+        where the token was masked (loss only there).
+
+        Memory/FLOPs design: the [N,T,V] log-probability tensor is never
+        materialized — per-token CE is logit[label] - logsumexp(logits),
+        which XLA fuses into the vocab matmul's epilogue. With
+        `masked_capacity=K`, only the top-K masked positions per row are
+        projected to the vocab at all (hidden gather before the V-wide
+        matmul) — the standard MLM-head optimization: ~15% of positions
+        carry loss, so the 768x30522 matmul shrinks ~6.7x. Positions
+        beyond K are dropped from the loss (choose K >= max masked/row
+        for exactness).
+        """
         hidden = self.encode(params, ids, train=train, rng=rng,
                              sharded=sharded)
+        if masked_capacity is not None:
+            k = int(masked_capacity)
+            # indices of the K largest mask flags per row (masked first;
+            # ties among zeros harmless — they get weight 0)
+            w, idx = jax.lax.top_k(mask_positions, k)        # [N,K]
+            hidden = jnp.take_along_axis(
+                hidden, idx[..., None], axis=1)              # [N,K,D]
+            labels = jnp.take_along_axis(labels, idx, axis=1)
+            mask_positions = w
         logits = self.mlm_logits(params, hidden).astype(jnp.float32)
-        logp = jax.nn.log_softmax(logits, axis=-1)
-        tok_lp = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        tok = jnp.take_along_axis(logits, labels[..., None],
+                                  axis=-1)[..., 0]
         denom = jnp.maximum(jnp.sum(mask_positions), 1.0)
-        return -jnp.sum(tok_lp * mask_positions) / denom
+        return -jnp.sum((tok - lse) * mask_positions) / denom
 
     @staticmethod
     def _apply_updates(updater, params, opt_state, grads, it_step):
@@ -265,14 +299,17 @@ class TransformerEncoder:
                                             params, updates)
         return new_params, new_opt
 
-    def make_train_step(self, updater, mesh: Optional[Mesh] = None):
+    def make_train_step(self, updater, mesh: Optional[Mesh] = None,
+                        masked_capacity: Optional[int] = None):
         """Build the compiled train step; with a mesh, params/opt are
-        sharded per param_specs and the batch over 'data'."""
+        sharded per param_specs and the batch over 'data'.
+        masked_capacity: see mlm_loss (vocab-head gather optimization)."""
         sharded = mesh is not None
 
         def step(params, opt_state, it_step, ids, labels, mask_pos, rng):
             loss, grads = jax.value_and_grad(self.mlm_loss)(
-                params, ids, labels, mask_pos, True, rng, sharded)
+                params, ids, labels, mask_pos, True, rng, sharded,
+                masked_capacity)
             new_params, new_opt = self._apply_updates(
                 updater, params, opt_state, grads, it_step)
             return new_params, new_opt, loss

@@ -1,0 +1,143 @@
+"""Flash/memory-efficient attention: blockwise + in-repo Pallas kernel
+(interpret mode on CPU) vs the reference O(T^2) softmax attention.
+
+SURVEY.md §5: the reference only has vanilla attention; this is the
+TPU-native upgrade slotted under the same seam.
+"""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.ops.flash_attention import (
+    attention, blockwise_attention, pallas_flash_forward,
+)
+
+
+def _ref_attention(q, k, v, mask=None, causal=False):
+    dh = q.shape[-1]
+    s = jnp.einsum("nhqd,nhkd->nhqk", q, k) / jnp.sqrt(
+        jnp.asarray(dh, jnp.float32))
+    if mask is not None:
+        s = jnp.where(mask[:, None, None, :].astype(bool), s, -1e30)
+    if causal:
+        tq, tk = s.shape[-2:]
+        cm = jnp.tril(jnp.ones((tq, tk), bool))
+        s = jnp.where(cm, s, -1e30)
+    w = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("nhqk,nhkd->nhqd", w, v)
+
+
+def _qkv(n=2, h=3, t=64, dh=16, seed=0):
+    rs = np.random.RandomState(seed)
+    mk = lambda: jnp.asarray(rs.randn(n, h, t, dh).astype(np.float32))
+    return mk(), mk(), mk()
+
+
+class TestBlockwise:
+    def test_matches_reference(self):
+        q, k, v = _qkv()
+        out = blockwise_attention(q, k, v, block_k=16)
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.asarray(_ref_attention(q, k, v)),
+                                   rtol=2e-5, atol=2e-6)
+
+    def test_padding_mask(self):
+        q, k, v = _qkv(t=32)
+        mask = jnp.asarray(
+            np.random.RandomState(1).rand(2, 32) > 0.3).astype(jnp.float32)
+        out = blockwise_attention(q, k, v, mask, block_k=8)
+        ref = _ref_attention(q, k, v, mask)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-6)
+
+    def test_causal(self):
+        q, k, v = _qkv(t=48)
+        out = blockwise_attention(q, k, v, causal=True, block_k=16)
+        ref = _ref_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-6)
+
+    def test_non_divisible_block(self):
+        q, k, v = _qkv(t=50)          # 50 % 16 != 0 -> padding path
+        out = blockwise_attention(q, k, v, block_k=16)
+        ref = _ref_attention(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-6)
+
+    def test_gradients_match_reference(self):
+        q, k, v = _qkv(t=32, dh=8)
+
+        def loss_block(q, k, v):
+            return jnp.sum(blockwise_attention(q, k, v, block_k=8) ** 2)
+
+        def loss_ref(q, k, v):
+            return jnp.sum(_ref_attention(q, k, v) ** 2)
+
+        g1 = jax.grad(loss_block, argnums=(0, 1, 2))(q, k, v)
+        g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-5)
+
+
+class TestPallasKernel:
+    """interpret=True runs the actual kernel logic on CPU (SURVEY.md §4
+    backend-parity philosophy: same code, reference backend)."""
+
+    def test_matches_reference(self):
+        q, k, v = _qkv(t=128, dh=32)
+        out = pallas_flash_forward(q, k, v, block_q=64, block_k=64,
+                                   interpret=True)
+        ref = _ref_attention(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-6)
+
+    def test_padding_mask(self):
+        q, k, v = _qkv(t=128, dh=32, seed=3)
+        mask = jnp.asarray(
+            np.random.RandomState(2).rand(2, 128) > 0.25).astype(jnp.float32)
+        out = pallas_flash_forward(q, k, v, mask, block_q=64, block_k=64,
+                                   interpret=True)
+        ref = _ref_attention(q, k, v, mask)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-6)
+
+    def test_causal(self):
+        q, k, v = _qkv(t=128, dh=32, seed=4)
+        out = pallas_flash_forward(q, k, v, causal=True, block_q=64,
+                                   block_k=64, interpret=True)
+        ref = _ref_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-6)
+
+    def test_unaligned_rejected(self):
+        q, k, v = _qkv(t=100)
+        with pytest.raises(ValueError, match="block-aligned"):
+            pallas_flash_forward(q, k, v, interpret=True)
+
+
+class TestDispatcher:
+    def test_auto_on_cpu_is_blockwise(self):
+        q, k, v = _qkv(t=64)
+        out = attention(q, k, v)          # cpu -> blockwise
+        ref = _ref_attention(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-6)
+
+    def test_transformer_flash_impl(self):
+        from deeplearning4j_tpu.models.transformer import (
+            TransformerEncoder, tiny_config,
+        )
+        cfg = tiny_config(vocab=64, max_len=64, d_model=32, n_layers=2,
+                          n_heads=4, d_ff=64)
+        rng = jax.random.key(0)
+        ids = jax.random.randint(rng, (2, 64), 0, 64)
+        default = TransformerEncoder(cfg)
+        flash = TransformerEncoder(cfg, attn_impl="flash")
+        p = default.init_params(rng)
+        h1 = default.encode(p, ids, train=False)
+        h2 = flash.encode(p, ids, train=False)
+        np.testing.assert_allclose(np.asarray(h1), np.asarray(h2),
+                                   rtol=2e-4, atol=2e-5)
