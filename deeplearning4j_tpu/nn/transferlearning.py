@@ -1,0 +1,251 @@
+"""Transfer learning: fine-tune, freeze, surgery on trained networks.
+
+Reference: org/deeplearning4j/nn/transferlearning/{TransferLearning,
+FineTuneConfiguration,TransferLearningHelper} + conf/layers/misc/
+FrozenLayer (SURVEY.md §2.18/§2.20 surroundings — a headline DL4J
+user feature: take a zoo/imported model, freeze the feature extractor,
+replace and retrain the head).
+
+TPU notes: freezing = FrozenLayer wrapper (stop_gradient on params at
+trace time, NoOp updater) — XLA then DCEs the frozen layers' backward
+graph entirely, so a frozen feature extractor costs forward-only, like
+the reference's workspace-level skip. TransferLearningHelper's
+`featurize` precomputes frozen activations once per dataset — identical
+workflow to the reference.
+"""
+
+from __future__ import annotations
+
+import copy
+import dataclasses
+from typing import Any, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.common.serde import serializable
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.learning.updaters import IUpdater, NoOp
+from deeplearning4j_tpu.nn.conf.builder import MultiLayerConfiguration
+from deeplearning4j_tpu.nn.conf.layers import Layer
+from deeplearning4j_tpu.nn.multilayer.network import MultiLayerNetwork
+
+
+@serializable
+@dataclasses.dataclass
+class FrozenLayer(Layer):
+    """Wrap any layer so its params receive no gradient and no updates
+    (reference: conf/layers/misc/FrozenLayer)."""
+
+    layer: Optional[Layer] = None
+
+    def __post_init__(self):
+        # frozen params must never be updated
+        self.updater = NoOp()
+
+    @property
+    def is_recurrent(self):
+        return self.layer is not None and self.layer.is_recurrent
+
+    def has_params(self):
+        return self.layer.has_params()
+
+    def output_type(self, it):
+        return self.layer.output_type(it)
+
+    def init_params(self, key, it, dtype):
+        return self.layer.init_params(key, it, dtype)
+
+    def init_state(self, it, dtype):
+        return self.layer.init_state(it, dtype)
+
+    def apply(self, params, state, x, train, rng):
+        frozen = jax.tree_util.tree_map(jax.lax.stop_gradient, params)
+        # frozen layers run in inference mode (reference: FrozenLayer
+        # disables dropout/BN-updates inside)
+        return self.layer.apply(frozen, state, x, False, rng)
+
+    def init_carry(self, batch, dtype):
+        return self.layer.init_carry(batch, dtype)
+
+    def apply_with_carry(self, params, state, carry, x, train, rng):
+        frozen = jax.tree_util.tree_map(jax.lax.stop_gradient, params)
+        return self.layer.apply_with_carry(frozen, state, carry, x, False,
+                                           rng)
+
+
+@dataclasses.dataclass
+class FineTuneConfiguration:
+    """Global overrides applied when fine-tuning (reference:
+    FineTuneConfiguration.Builder — updater/lr, seed, regularization,
+    dropout, activation default)."""
+
+    updater: Optional[IUpdater] = None
+    seed: Optional[int] = None
+    l1: Optional[float] = None
+    l2: Optional[float] = None
+    dropout: Optional[float] = None
+
+
+class TransferLearning:
+    """Builder entry: TransferLearning.Builder(network)... (reference
+    API shape preserved)."""
+
+    class Builder:
+        def __init__(self, net: MultiLayerNetwork):
+            if net.params_list is None:
+                raise ValueError("network must be init()ed / trained")
+            self._net = net
+            self._ftc: Optional[FineTuneConfiguration] = None
+            self._freeze_up_to = -1          # inclusive layer index
+            self._removed_from_output = 0
+            self._added: List[Layer] = []
+            self._nout_replace = {}          # idx -> (n_out, weight_init)
+
+        # -- reference builder methods ---------------------------------
+        def fineTuneConfiguration(self, ftc: FineTuneConfiguration):
+            self._ftc = ftc
+            return self
+
+        def setFeatureExtractor(self, layer_idx: int):
+            """Freeze layers [0, layer_idx] (reference semantics)."""
+            self._freeze_up_to = int(layer_idx)
+            return self
+
+        def removeOutputLayer(self):
+            return self.removeLayersFromOutput(1)
+
+        def removeLayersFromOutput(self, n: int):
+            self._removed_from_output += int(n)
+            return self
+
+        def addLayer(self, layer: Layer):
+            self._added.append(layer)
+            return self
+
+        def nOutReplace(self, layer_idx: int, n_out: int,
+                        weight_init: str = "xavier"):
+            self._nout_replace[int(layer_idx)] = (int(n_out), weight_init)
+            return self
+
+        # -- build ------------------------------------------------------
+        def build(self) -> MultiLayerNetwork:
+            src = self._net
+            conf = src.conf
+            n_keep = len(conf.layers) - self._removed_from_output
+            if n_keep <= 0:
+                raise ValueError("removed every layer")
+
+            new_layers: List[Layer] = []
+            reinit: set = set()
+            for i in range(n_keep):
+                layer = copy.deepcopy(conf.layers[i])
+                if i in self._nout_replace:
+                    n_out, wi = self._nout_replace[i]
+                    layer.n_out = n_out
+                    layer.weight_init = wi
+                    reinit.add(i)
+                    # downstream layer consumes a new width
+                    if i + 1 < n_keep and hasattr(conf.layers[i + 1], "n_in"):
+                        reinit.add(i + 1)
+                new_layers.append(layer)
+            # fix n_in of the layer after an nOutReplace
+            for i, (n_out, _) in self._nout_replace.items():
+                if i + 1 < n_keep and hasattr(new_layers[i + 1], "n_in"):
+                    new_layers[i + 1].n_in = n_out
+            for extra in self._added:
+                new_layers.append(copy.deepcopy(extra))
+
+            # freeze
+            for i in range(min(self._freeze_up_to + 1, len(new_layers))):
+                if new_layers[i].has_params():
+                    new_layers[i] = FrozenLayer(layer=new_layers[i])
+
+            ftc = self._ftc or FineTuneConfiguration()
+            new_conf = dataclasses.replace(
+                conf,
+                layers=new_layers,
+                seed=ftc.seed if ftc.seed is not None else conf.seed,
+                updater=ftc.updater if ftc.updater is not None
+                else conf.updater,
+                l1=ftc.l1 if ftc.l1 is not None else conf.l1,
+                l2=ftc.l2 if ftc.l2 is not None else conf.l2,
+                preprocessors=dict(conf.preprocessors),
+            )
+            out = MultiLayerNetwork(new_conf).init()
+
+            # copy kept params (frozen and unfrozen both keep weights;
+            # reinit'd and newly-added layers keep their fresh init)
+            for i in range(n_keep):
+                if i in reinit:
+                    continue
+                out.params_list[i] = jax.tree_util.tree_map(
+                    lambda a: a, src.params_list[i])
+                out.states_list[i] = jax.tree_util.tree_map(
+                    lambda a: a, src.states_list[i])
+            return out
+
+
+class TransferLearningHelper:
+    """Featurize-once workflow (reference: TransferLearningHelper —
+    run the frozen part once per dataset, train only the head)."""
+
+    def __init__(self, net: MultiLayerNetwork,
+                 frozen_up_to: Optional[int] = None):
+        self.net = net
+        if frozen_up_to is None:
+            frozen_up_to = -1
+            for i, l in enumerate(net.conf.layers):
+                if isinstance(l, FrozenLayer):
+                    frozen_up_to = i
+        self.frozen_up_to = frozen_up_to
+        if frozen_up_to < 0:
+            raise ValueError("no frozen layers — use setFeatureExtractor "
+                             "or pass frozen_up_to")
+        # head-only network over the unfrozen tail
+        tail_layers = [copy.deepcopy(l)
+                       for l in net.conf.layers[frozen_up_to + 1:]]
+        tail_pre = {i - (frozen_up_to + 1): t
+                    for i, t in net.conf.preprocessors.items()
+                    if i > frozen_up_to}
+        tail_conf = dataclasses.replace(
+            net.conf, layers=tail_layers, input_type=None,
+            preprocessors=tail_pre)
+        self._tail = MultiLayerNetwork.__new__(MultiLayerNetwork)
+        self._tail.__init__(tail_conf)
+        self._tail.init()
+        for j in range(len(tail_layers)):
+            self._tail.params_list[j] = net.params_list[frozen_up_to + 1 + j]
+            self._tail.states_list[j] = net.states_list[frozen_up_to + 1 + j]
+
+    def featurize(self, ds: DataSet) -> DataSet:
+        """Forward through the frozen layers (reference: featurize)."""
+        a = jnp.asarray(ds.features, self.net._dtype)
+        for i in range(self.frozen_up_to + 1):
+            tag = self.net.conf.preprocessors.get(i)
+            if tag:
+                from deeplearning4j_tpu.nn.conf.builder import (
+                    apply_preprocessor,
+                )
+                a = apply_preprocessor(tag, a)
+            a, _ = self.net.conf.layers[i].apply(
+                self.net.params_list[i], self.net.states_list[i], a,
+                False, None)
+        return DataSet(a, ds.labels, labels_mask=ds.labels_mask)
+
+    def fitFeaturized(self, ds: DataSet, epochs: int = 1) -> None:
+        """Train the unfrozen head on featurized data, then write the
+        head's params back into the full network."""
+        self._tail.fit(ds.features, ds.labels, epochs=epochs)
+        for j in range(len(self._tail.conf.layers)):
+            self.net.params_list[self.frozen_up_to + 1 + j] = \
+                self._tail.params_list[j]
+            self.net.states_list[self.frozen_up_to + 1 + j] = \
+                self._tail.states_list[j]
+
+    def unfrozenMLN(self) -> MultiLayerNetwork:
+        return self._tail
+
+
+__all__ = ["TransferLearning", "FineTuneConfiguration", "FrozenLayer",
+           "TransferLearningHelper"]

@@ -1,0 +1,147 @@
+"""Transfer learning: freeze, fine-tune, head replacement, featurize.
+
+Reference: org/deeplearning4j/nn/transferlearning/** + FrozenLayer.
+"""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.learning.updaters import Adam, Sgd
+from deeplearning4j_tpu.nn.conf import (
+    DenseLayer, InputType, NeuralNetConfiguration, OutputLayer,
+)
+from deeplearning4j_tpu.nn.multilayer.network import MultiLayerNetwork
+from deeplearning4j_tpu.nn.transferlearning import (
+    FineTuneConfiguration, FrozenLayer, TransferLearning,
+    TransferLearningHelper,
+)
+
+
+def _base_net(seed=0, n_classes=3):
+    conf = (NeuralNetConfiguration.builder().seed(seed).updater(Adam(1e-2))
+            .list()
+            .layer(DenseLayer(n_out=16, activation="relu"))
+            .layer(DenseLayer(n_out=8, activation="relu"))
+            .layer(OutputLayer(n_out=n_classes, activation="softmax",
+                               loss="mcxent"))
+            .setInputType(InputType.feedForward(5))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _data(n=32, classes=3, seed=0):
+    rs = np.random.RandomState(seed)
+    x = rs.randn(n, 5).astype(np.float32)
+    y = np.eye(classes, dtype=np.float32)[rs.randint(0, classes, n)]
+    return x, y
+
+
+class TestFrozenLayers:
+    def test_frozen_params_unchanged(self):
+        net = _base_net()
+        x, y = _data()
+        net.fit(x, y)                   # pretrain a bit
+        tl = (TransferLearning.Builder(net)
+              .fineTuneConfiguration(FineTuneConfiguration(updater=Sgd(0.1)))
+              .setFeatureExtractor(1)   # freeze layers 0 and 1
+              .build())
+        assert isinstance(tl.conf.layers[0], FrozenLayer)
+        assert isinstance(tl.conf.layers[1], FrozenLayer)
+        frozen_before = [np.asarray(tl.params_list[i]["W"]).copy()
+                         for i in (0, 1)]
+        head_before = np.asarray(tl.params_list[2]["W"]).copy()
+        for _ in range(5):
+            tl.fit(x, y)
+        for i, before in zip((0, 1), frozen_before):
+            np.testing.assert_array_equal(
+                np.asarray(tl.params_list[i]["W"]), before)
+        assert not np.allclose(np.asarray(tl.params_list[2]["W"]),
+                               head_before)
+
+    def test_frozen_output_matches_source_features(self):
+        """Frozen layers carry over the trained weights."""
+        net = _base_net()
+        x, y = _data()
+        net.fit(x, y, epochs=3)
+        tl = (TransferLearning.Builder(net)
+              .setFeatureExtractor(0).build())
+        np.testing.assert_array_equal(
+            np.asarray(tl.params_list[0]["W"]),
+            np.asarray(net.params_list[0]["W"]))
+
+
+class TestSurgery:
+    def test_replace_output_layer(self):
+        net = _base_net(n_classes=3)
+        x, _ = _data()
+        tl = (TransferLearning.Builder(net)
+              .setFeatureExtractor(1)
+              .removeOutputLayer()
+              .addLayer(OutputLayer(n_in=8, n_out=5, activation="softmax",
+                                    loss="mcxent"))
+              .build())
+        out = tl.output(x).toNumpy()
+        assert out.shape == (32, 5)
+        y5 = np.eye(5, dtype=np.float32)[np.random.RandomState(1)
+                                         .randint(0, 5, 32)]
+        first = None
+        for _ in range(10):
+            tl.fit(x, y5)
+            first = first or tl.score()
+        assert tl.score() < first
+
+    def test_nout_replace(self):
+        net = _base_net()
+        tl = (TransferLearning.Builder(net)
+              .nOutReplace(1, 12, "xavier")
+              .build())
+        assert tl.params_list[1]["W"].shape == (16, 12)
+        assert tl.params_list[2]["W"].shape == (12, 3)
+        # layer 0 kept its weights
+        np.testing.assert_array_equal(np.asarray(tl.params_list[0]["W"]),
+                                      np.asarray(net.params_list[0]["W"]))
+        x, y = _data()
+        tl.fit(x, y)
+        assert np.isfinite(tl.score())
+
+    def test_remove_everything_rejected(self):
+        net = _base_net()
+        with pytest.raises(ValueError):
+            TransferLearning.Builder(net).removeLayersFromOutput(3).build()
+
+    def test_requires_init(self):
+        conf = (NeuralNetConfiguration.builder().list()
+                .layer(OutputLayer(n_in=4, n_out=2)).build())
+        with pytest.raises(ValueError, match="init"):
+            TransferLearning.Builder(MultiLayerNetwork(conf))
+
+
+class TestHelper:
+    def test_featurize_and_fit(self):
+        net = _base_net()
+        x, y = _data(64)
+        net.fit(x, y)
+        tl = (TransferLearning.Builder(net)
+              .setFeatureExtractor(1).build())
+        helper = TransferLearningHelper(tl)
+        feat = helper.featurize(DataSet(x, y))
+        assert feat.features.shape == (64, 8)      # layer-1 width
+        before = float(tl.score(DataSet(x, y)))
+        for _ in range(15):
+            helper.fitFeaturized(feat)
+        after = float(tl.score(DataSet(x, y)))
+        assert after < before
+
+    def test_no_frozen_rejected(self):
+        net = _base_net()
+        with pytest.raises(ValueError, match="frozen"):
+            TransferLearningHelper(net)
+
+    def test_json_roundtrip_frozen(self):
+        from deeplearning4j_tpu.nn.conf import MultiLayerConfiguration
+        net = _base_net()
+        tl = TransferLearning.Builder(net).setFeatureExtractor(0).build()
+        cfg2 = MultiLayerConfiguration.from_json(tl.conf.to_json())
+        assert isinstance(cfg2.layers[0], FrozenLayer)
+        assert isinstance(cfg2.layers[0].layer, DenseLayer)
