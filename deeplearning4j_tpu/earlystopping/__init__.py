@@ -365,6 +365,10 @@ class _IterationStopListener:
         self.last_score = float("nan")
 
     def iterationDone(self, model, iteration, epoch):
+        if not self.conditions:
+            # score() forces a device->host sync; don't pay it per step
+            # unless an iteration condition actually needs the value
+            return
         self.last_score = model.score()
         for c in self.conditions:
             if c.terminate(self.last_score):

@@ -225,7 +225,8 @@ class ComputationGraph:
             self.params_map, self.states_map, self.opt_states,
             jnp.asarray(self._iteration), jnp.asarray(self._epoch),
             inputs, labels, sub)
-        self._score = float(loss)
+        self._score = loss  # on-device; score() converts lazily (no
+        # per-step host sync — critical for dispatch pipelining)
         self._iteration += 1
         for l in self._listeners:
             l.iterationDone(self, self._iteration, self._epoch)
@@ -250,7 +251,7 @@ class ComputationGraph:
 
     def score(self, dataset: Optional[DataSet] = None) -> float:
         if dataset is None:
-            return self._score
+            return float(self._score)
         self._check_init()
         inputs = {self.conf.network_inputs[0]: jnp.asarray(dataset.features, self._dtype)}
         labels = {self.conf.network_outputs[0]: jnp.asarray(dataset.labels)}

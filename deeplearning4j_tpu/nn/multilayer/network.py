@@ -336,7 +336,10 @@ class MultiLayerNetwork:
         (self.params_list, self.states_list, self.opt_states, loss) = step_fn(
             self.params_list, self.states_list, self.opt_states,
             jnp.asarray(self._iteration), jnp.asarray(self._epoch), x, y, m, sub)
-        self._score = float(loss)
+        # keep the loss on-device: a float() here would force a host sync
+        # every step and stall the dispatch pipeline (very costly over a
+        # remote/tunneled accelerator); score() converts lazily
+        self._score = loss
         self._iteration += 1
         self._panic_check()
         for l in self._listeners:
@@ -387,7 +390,7 @@ class MultiLayerNetwork:
                 self.params_list, self.states_list, self.opt_states, carries,
                 jnp.asarray(self._iteration), jnp.asarray(self._epoch),
                 xc, yc, mc, sub)
-            self._score = float(loss)
+            self._score = loss
             self._iteration += 1
             self._panic_check()
             for l in self._listeners:
@@ -497,7 +500,7 @@ class MultiLayerNetwork:
     def score(self, dataset: Optional[DataSet] = None) -> float:
         """Last minibatch loss, or loss on a provided DataSet."""
         if dataset is None:
-            return self._score
+            return float(self._score)
         self._check_init()
         loss, _ = self._loss(self.params_list, self.states_list,
                              jnp.asarray(dataset.features, self._dtype),
