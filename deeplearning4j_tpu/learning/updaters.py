@@ -33,6 +33,15 @@ def _tmap(f, *trees):
     return jax.tree_util.tree_map(f, *trees)
 
 
+def _zeros_f32(p):
+    # optimizer accumulators are kept in at-least-float32 even for
+    # bf16/f16 params: update math stays full-precision and jit
+    # signatures are dtype-stable from step 1 (lr scalars are f32).
+    # f64 params (dataType("double") under x64) keep f64 accumulators.
+    return jnp.zeros(jnp.shape(p),
+                     jnp.promote_types(jnp.result_type(p), jnp.float32))
+
+
 @dataclasses.dataclass
 class IUpdater:
     """Base updater config. Stateless by default."""
@@ -87,7 +96,7 @@ class Nesterovs(IUpdater):
         return True
 
     def init_state(self, params):
-        return {"v": _tmap(jnp.zeros_like, params)}
+        return {"v": _tmap(_zeros_f32, params)}
 
     def apply(self, state, grads, step):
         lr = self._lr(step)
@@ -109,8 +118,10 @@ class Adam(IUpdater):
         return True
 
     def init_state(self, params):
-        z = _tmap(jnp.zeros_like, params)
-        return {"m": z, "v": _tmap(jnp.zeros_like, params)}
+        # m and v must be DISTINCT buffers: training steps donate the
+        # opt-state, and donating one buffer twice is a runtime error
+        return {"m": _tmap(_zeros_f32, params),
+                "v": _tmap(_zeros_f32, params)}
 
     def _moments(self, state, grads):
         m = _tmap(lambda m, g: self.beta1 * m + (1 - self.beta1) * g, state["m"], grads)
@@ -177,9 +188,10 @@ class Nadam(Adam):
 @dataclasses.dataclass
 class AMSGrad(Adam):
     def init_state(self, params):
-        z = _tmap(jnp.zeros_like, params)
-        return {"m": z, "v": _tmap(jnp.zeros_like, params),
-                "vhat": _tmap(jnp.zeros_like, params)}
+        # distinct buffers required — see Adam.init_state
+        return {"m": _tmap(_zeros_f32, params),
+                "v": _tmap(_zeros_f32, params),
+                "vhat": _tmap(_zeros_f32, params)}
 
     def apply(self, state, grads, step):
         lr = self._lr(step)
@@ -204,7 +216,7 @@ class AdaGrad(IUpdater):
         return True
 
     def init_state(self, params):
-        return {"h": _tmap(jnp.zeros_like, params)}
+        return {"h": _tmap(_zeros_f32, params)}
 
     def apply(self, state, grads, step):
         lr = self._lr(step)
@@ -223,8 +235,8 @@ class AdaDelta(IUpdater):
         return True
 
     def init_state(self, params):
-        return {"msg": _tmap(jnp.zeros_like, params),
-                "msdx": _tmap(jnp.zeros_like, params)}
+        return {"msg": _tmap(_zeros_f32, params),
+                "msdx": _tmap(_zeros_f32, params)}
 
     def apply(self, state, grads, step):
         rho, eps = self.rho, self.epsilon
@@ -247,7 +259,7 @@ class RmsProp(IUpdater):
         return True
 
     def init_state(self, params):
-        return {"g2": _tmap(jnp.zeros_like, params)}
+        return {"g2": _tmap(_zeros_f32, params)}
 
     def apply(self, state, grads, step):
         lr = self._lr(step)
@@ -258,7 +270,18 @@ class RmsProp(IUpdater):
 
 
 def apply_updater(updater: IUpdater, state, grads, params, step):
-    """Uniform entry point: dispatches AdamW-style param-aware updaters."""
+    """Uniform entry point: dispatches AdamW-style param-aware updaters.
+
+    Gradients are cast to f32 on the way in (f16/bf16 g*g underflows —
+    f16 flushes g^2 to zero for g < ~2.4e-4, starving second moments)
+    and updates cast to each param's dtype on the way out: updater math
+    runs fully in f32, while bf16/f16 params stay in their configured
+    dtype across steps."""
+    grads = _tmap(lambda g: g.astype(jnp.promote_types(g.dtype, jnp.float32)),
+                  grads)
     if hasattr(updater, "apply_with_params"):
-        return updater.apply_with_params(state, grads, params, step)
-    return updater.apply(state, grads, step)
+        updates, new_state = updater.apply_with_params(state, grads, params, step)
+    else:
+        updates, new_state = updater.apply(state, grads, step)
+    updates = _tmap(lambda u, p: u.astype(p.dtype), updates, params)
+    return updates, new_state

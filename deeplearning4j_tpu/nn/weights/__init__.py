@@ -12,6 +12,8 @@ from __future__ import annotations
 
 import enum
 
+import math
+
 import jax
 import jax.numpy as jnp
 
@@ -60,37 +62,37 @@ def init_weights(scheme, key, shape, fan_in: float, fan_out: float,
     if w is WeightInit.CONSTANT:
         return jnp.full(shape, gain, dtype)
     if w is WeightInit.NORMAL:
-        return jax.random.normal(key, shape, dtype) / jnp.sqrt(fan_in)
+        return jax.random.normal(key, shape, dtype) / math.sqrt(fan_in)
     if w is WeightInit.UNIFORM:
-        a = jnp.sqrt(1.0 / fan_in)
+        a = math.sqrt(1.0 / fan_in)
         return jax.random.uniform(key, shape, dtype, -a, a)
     if w is WeightInit.XAVIER:
-        std = jnp.sqrt(2.0 / (fan_in + fan_out))
+        std = math.sqrt(2.0 / (fan_in + fan_out))
         return std * jax.random.normal(key, shape, dtype)
     if w is WeightInit.XAVIER_UNIFORM:
-        a = jnp.sqrt(6.0 / (fan_in + fan_out))
+        a = math.sqrt(6.0 / (fan_in + fan_out))
         return jax.random.uniform(key, shape, dtype, -a, a)
     if w is WeightInit.XAVIER_FAN_IN:
-        return jax.random.normal(key, shape, dtype) / jnp.sqrt(fan_in)
+        return jax.random.normal(key, shape, dtype) / math.sqrt(fan_in)
     if w is WeightInit.LECUN_NORMAL:
-        return jnp.sqrt(1.0 / fan_in) * jax.random.normal(key, shape, dtype)
+        return math.sqrt(1.0 / fan_in) * jax.random.normal(key, shape, dtype)
     if w is WeightInit.LECUN_UNIFORM:
-        a = jnp.sqrt(3.0 / fan_in)
+        a = math.sqrt(3.0 / fan_in)
         return jax.random.uniform(key, shape, dtype, -a, a)
     if w in (WeightInit.RELU, WeightInit.HE_NORMAL):
-        return jnp.sqrt(2.0 / fan_in) * jax.random.normal(key, shape, dtype)
+        return math.sqrt(2.0 / fan_in) * jax.random.normal(key, shape, dtype)
     if w in (WeightInit.RELU_UNIFORM, WeightInit.HE_UNIFORM):
-        a = jnp.sqrt(6.0 / fan_in)
+        a = math.sqrt(6.0 / fan_in)
         return jax.random.uniform(key, shape, dtype, -a, a)
     if w is WeightInit.SIGMOID_UNIFORM:
-        a = 4.0 * jnp.sqrt(6.0 / (fan_in + fan_out))
+        a = 4.0 * math.sqrt(6.0 / (fan_in + fan_out))
         return jax.random.uniform(key, shape, dtype, -a, a)
     if w is WeightInit.VAR_SCALING_NORMAL_FAN_IN:
-        return jnp.sqrt(gain / fan_in) * jax.random.normal(key, shape, dtype)
+        return math.sqrt(gain / fan_in) * jax.random.normal(key, shape, dtype)
     if w is WeightInit.VAR_SCALING_NORMAL_FAN_OUT:
-        return jnp.sqrt(gain / fan_out) * jax.random.normal(key, shape, dtype)
+        return math.sqrt(gain / fan_out) * jax.random.normal(key, shape, dtype)
     if w is WeightInit.VAR_SCALING_NORMAL_FAN_AVG:
-        return jnp.sqrt(2.0 * gain / (fan_in + fan_out)) * jax.random.normal(key, shape, dtype)
+        return math.sqrt(2.0 * gain / (fan_in + fan_out)) * jax.random.normal(key, shape, dtype)
     if w is WeightInit.IDENTITY:
         if len(shape) == 2 and shape[0] == shape[1]:
             return jnp.eye(shape[0], dtype=dtype)

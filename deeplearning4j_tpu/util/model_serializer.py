@@ -60,16 +60,50 @@ def _unflatten_into(template, flat, prefix=""):
     return jnp.asarray(flat[key])
 
 
+_UINT_BY_SIZE = {1: np.uint8, 2: np.uint16, 4: np.uint32, 8: np.uint64}
+
+
 def _write_npz(zf: zipfile.ZipFile, name: str, arrays: dict):
+    # numpy's npz format can't round-trip ml_dtypes (bfloat16, float8_*):
+    # np.load hands back void '|V2' buffers. Store such arrays as a
+    # same-width uint view and append '__as__<dtype>' to the key.
+    enc = {}
+    for k, a in arrays.items():
+        if a.dtype.kind not in "biufc":
+            enc[f"{k}__as__{a.dtype.name}"] = a.view(
+                _UINT_BY_SIZE[a.dtype.itemsize])
+        else:
+            enc[k] = a
     buf = io.BytesIO()
-    np.savez(buf, **arrays)
+    np.savez(buf, **enc)
     zf.writestr(name, buf.getvalue())
+
+
+def _decode_dtype(name: str) -> np.dtype:
+    try:
+        import ml_dtypes
+        return np.dtype(getattr(ml_dtypes, name))
+    except (ImportError, AttributeError):
+        return np.dtype(name)
 
 
 def _read_npz(zf: zipfile.ZipFile, name: str) -> dict:
     with zf.open(name) as f:
         data = np.load(io.BytesIO(f.read()))
-        return {k: data[k] for k in data.files}
+        out = {}
+        for k in data.files:
+            if "__as__" in k:
+                # the suffix only marks OUR dtype tag; a user-chosen
+                # vertex name may legitimately contain '__as__', in
+                # which case the suffix won't decode as a dtype
+                key, dt = k.rsplit("__as__", 1)
+                try:
+                    out[key] = data[k].view(_decode_dtype(dt))
+                    continue
+                except TypeError:
+                    pass
+            out[k] = data[k]
+        return out
 
 
 class ModelSerializer:

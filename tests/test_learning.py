@@ -207,3 +207,42 @@ class TestWeightInit:
         w = init_weights(WeightInit.XAVIER_UNIFORM, jax.random.key(2), (100, 100), 100, 100)
         a = np.sqrt(6.0 / 200)
         assert float(jnp.max(jnp.abs(w))) <= a + 1e-6
+
+
+class TestLowPrecisionDtypeStability:
+    """bf16 regression: weight init must honor the requested dtype (a
+    strong-f32 scale constant used to promote every scaled scheme), and
+    params must STAY bf16 across update steps (f32 lr scalars used to
+    promote params via the updater output)."""
+
+    def test_all_weight_inits_honor_bf16(self):
+        for w in WeightInit:
+            try:
+                arr = init_weights(w, jax.random.key(0), (4, 4), 4, 4,
+                                   jnp.bfloat16)
+            except ValueError:
+                continue  # schemes needing extra args / square-only
+            assert arr.dtype == jnp.bfloat16, (w, arr.dtype)
+
+    def test_params_stay_bf16_across_steps(self):
+        from deeplearning4j_tpu.learning.updaters import (
+            Adam, AdamW, AMSGrad, AdaDelta, AdaGrad, AdaMax, Nadam,
+            Nesterovs, RmsProp, Sgd, apply_updater)
+        for upd in (Adam(1e-3), AdamW(1e-3), AMSGrad(1e-3), AdaDelta(),
+                    AdaGrad(0.1), AdaMax(1e-3), Nadam(1e-3),
+                    Nesterovs(0.1), RmsProp(0.1), Sgd(0.1)):
+            params = {"W": jnp.ones((4, 4), jnp.bfloat16)}
+            state = upd.init_state(params)
+            for step in range(2):
+                grads = {"W": jnp.full((4, 4), 0.1, jnp.bfloat16)}
+                updates, state = apply_updater(upd, state, grads, params,
+                                               jnp.asarray(step))
+                params = jax.tree_util.tree_map(lambda p, u: p - u,
+                                                params, updates)
+            assert params["W"].dtype == jnp.bfloat16, type(upd).__name__
+
+    def test_optimizer_state_is_f32_for_bf16_params(self):
+        from deeplearning4j_tpu.learning.updaters import Adam
+        params = {"W": jnp.ones((4, 4), jnp.bfloat16)}
+        state = Adam(1e-3).init_state(params)
+        assert state["m"]["W"].dtype == jnp.float32

@@ -160,3 +160,32 @@ class TestListeners:
         assert len(zips) == 2
         restored = ModelSerializer.restoreMultiLayerNetwork(cl.lastCheckpoint())
         assert restored.numParams() == model.numParams()
+
+
+class TestBf16Serialization:
+    """npz can't natively round-trip ml_dtypes: bfloat16 loads back as
+    void '|V2'. The serializer stores a uint16 view + dtype tag."""
+
+    def test_bf16_model_roundtrip(self, tmp_path):
+        import jax.numpy as jnp
+        net = MultiLayerNetwork(
+            (NeuralNetConfiguration.builder()
+             .seed(9).updater(Adam(learning_rate=0.01))
+             .dataType("bfloat16")
+             .list()
+             .layer(DenseLayer(n_out=8, activation="tanh"))
+             .layer(OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+             .setInputType(InputType.feedForward(4))
+             .build())).init()
+        x, y = toy_data()
+        net.fit(DataSet(x, y), epochs=2)
+        p = str(tmp_path / "model_bf16.zip")
+        ModelSerializer.writeModel(net, p, save_updater=True)
+        restored = ModelSerializer.restoreMultiLayerNetwork(p)
+        for a, b in zip(net.params_list, restored.params_list):
+            for k in (a or {}):
+                assert b[k].dtype == jnp.bfloat16
+                np.testing.assert_array_equal(
+                    np.asarray(a[k], np.float32), np.asarray(b[k], np.float32))
+        np.testing.assert_array_equal(net.output(x).toNumpy(),
+                                      restored.output(x).toNumpy())
