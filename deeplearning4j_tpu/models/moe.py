@@ -1,0 +1,128 @@
+"""Mixture-of-Experts FFN with expert parallelism.
+
+The reference has no MoE / expert parallelism at all (SURVEY.md §2
+parallelism list: data parallelism only) — this is a TPU-first
+extension in the GShard/Switch style:
+
+- Token-choice top-k routing with a STATIC per-expert capacity
+  ``C = ceil(tokens/E * capacity_factor * k)``: dispatch and combine are
+  dense one-hot einsum tensors, so the whole layer is fixed-shape XLA —
+  no sorts-with-dynamic-output, no ragged buffers.
+- Expert weights are stacked on a leading [E, ...] axis; under a mesh
+  the stack is sharded over the 'model' axis (expert parallelism rides
+  the tensor-parallel axis), and GSPMD lowers the dispatch/combine
+  einsums to the expert all-to-alls over ICI.
+- Switch-style load-balancing auxiliary loss: E * Σ_e (token fraction
+  routed to e) * (mean router prob of e); differentiable through the
+  router probs.
+
+Tokens that overflow an expert's capacity are dropped (contribute zero
+to the layer output — the residual connection carries them), standard
+Switch behavior.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+
+def capacity(n_tokens: int, n_experts: int, capacity_factor: float,
+             top_k: int) -> int:
+    return max(1, int(math.ceil(
+        n_tokens * capacity_factor * top_k / n_experts)))
+
+
+def router_dispatch(probs: jnp.ndarray, top_k: int, cap: int):
+    """probs: [S, E] router probabilities. Returns (combine [S, E, C]
+    float32, aux scalar). combine holds the (normalized) gate for each
+    token's kept expert/slot assignments; zero rows = dropped tokens."""
+    s, e = probs.shape
+    counts = jnp.zeros((e,), jnp.float32)       # slots used per expert
+    remaining = probs
+    combine = jnp.zeros((s, e, cap), jnp.float32)
+    first_choice = None
+    gate_sum = jnp.zeros((s,), jnp.float32)
+    for _ in range(top_k):
+        idx = jnp.argmax(remaining, axis=-1)            # [S]
+        onehot = jax.nn.one_hot(idx, e, dtype=jnp.float32)
+        gate = jnp.sum(probs * onehot, axis=-1)         # [S]
+        if first_choice is None:
+            first_choice = onehot
+        # position of each token within its chosen expert: tokens
+        # earlier in the batch fill slots first (deterministic)
+        pos = jnp.cumsum(onehot, axis=0) - onehot + counts
+        mypos = jnp.sum(pos * onehot, axis=-1)          # [S]
+        keep = (mypos < cap).astype(jnp.float32)
+        counts = counts + jnp.sum(onehot * keep[:, None], axis=0)
+        slot = jax.nn.one_hot(jnp.clip(mypos, 0, cap - 1).astype(jnp.int32),
+                              cap, dtype=jnp.float32)
+        combine = combine + (gate * keep)[:, None, None] \
+            * onehot[:, :, None] * slot[:, None, :]
+        gate_sum = gate_sum + gate * keep
+        remaining = remaining * (1.0 - onehot)
+    if top_k > 1:
+        # GShard top-k gate normalization. NOT applied for top-1:
+        # gate/gate == 1 would zero d(output)/d(router), leaving the
+        # router trainable only through the aux loss — Switch keeps the
+        # raw gate so the router learns from the task loss.
+        combine = combine / jnp.maximum(gate_sum, 1e-9)[:, None, None]
+    # Switch aux loss on the FIRST choice: fraction routed * mean prob
+    frac = jnp.mean(first_choice, axis=0)               # [E]
+    mean_prob = jnp.mean(probs, axis=0)                 # [E]
+    aux = e * jnp.sum(frac * mean_prob)
+    return combine, aux
+
+
+def moe_ffn(x, wr, we1, be1, we2, be2, *, top_k: int,
+            capacity_factor: float, sharded: bool = False,
+            activation=jax.nn.gelu, group_size: int | None = None):
+    """x: [S, D] tokens -> ([S, D], aux loss).
+
+    wr [D, E] router; we1 [E, D, F], be1 [E, F], we2 [E, F, D],
+    be2 [E, D] stacked expert FFNs.
+
+    group_size: dispatch within fixed-size token groups (GShard-style —
+    typically one sequence per group). The combine/dispatch tensors are
+    then [G, g, E, C_g] with C_g = ceil(g*cf*k/E), i.e. LINEAR in total
+    tokens; a single global group would be O(S^2) and OOM at
+    production sizes. None = one group (fine for small S).
+    """
+    s, d = x.shape
+    e = wr.shape[-1]
+    g = group_size or s
+    if s % g != 0:
+        raise ValueError(f"token count {s} not divisible by "
+                         f"group_size {g}")
+    n_groups = s // g
+    cap = capacity(g, e, capacity_factor, top_k)
+    xg = x.reshape(n_groups, g, d)
+    logits = (xg @ wr.astype(x.dtype)).astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)
+    combine, aux = jax.vmap(
+        lambda p: router_dispatch(p, top_k, cap))(probs)
+    aux = jnp.mean(aux)
+    combine = combine.astype(x.dtype)                   # [G, g, E, C]
+    dispatch = (combine > 0).astype(x.dtype)
+
+    def ep(t):
+        # expert-stacked intermediates: groups ride the 'data' axis,
+        # experts the 'model' axis (EP) — GSPMD turns the
+        # dispatch/combine einsums into the expert all-to-alls
+        if not sharded:
+            return t
+        return lax.with_sharding_constraint(
+            t, P("data", "model", *([None] * (t.ndim - 2))))
+
+    xe = ep(jnp.einsum("gsec,gsd->gecd", dispatch, xg))
+    he = activation(ep(jnp.einsum("gecd,edf->gecf", xe,
+                                  we1.astype(x.dtype))
+                       + be1.astype(x.dtype)[None, :, None, :]))
+    ye = ep(jnp.einsum("gecf,efd->gecd", he, we2.astype(x.dtype))
+            + be2.astype(x.dtype)[None, :, None, :])
+    y = jnp.einsum("gsec,gecd->gsd", combine, ye)
+    return y.reshape(s, d), aux

@@ -50,6 +50,15 @@ class TransformerConfig:
     dtype: str = "float32"           # params; compute may be bf16
     compute_dtype: str = "bfloat16"  # MXU-native
     seed: int = 0
+    # Mixture-of-Experts (0 = dense FFN). When set, EVERY layer's FFN is
+    # an expert-parallel MoE, sharded over the 'model' mesh axis
+    # (models/moe.py). Supported by make_train_step (GSPMD EP); the
+    # pipeline and ring engines currently REJECT MoE configs (aux-loss
+    # routing not wired there yet).
+    n_experts: int = 0
+    expert_top_k: int = 2
+    capacity_factor: float = 1.25
+    aux_loss_weight: float = 0.01
 
     @property
     def head_dim(self) -> int:
@@ -101,20 +110,33 @@ class TransformerEncoder:
         }
         for li in range(cfg.n_layers):
             ks = jax.random.split(keys[4 + li], 6)
-            params["layers"].append({
+            lp = {
                 "wqkv": norm(ks[0], (d, 3 * d)),
                 "bqkv": jnp.zeros((3 * d,), self._pdtype),
                 "wo": norm(ks[1], (d, d)),
                 "bo": jnp.zeros((d,), self._pdtype),
                 "ln1": {"gamma": jnp.ones((d,), self._pdtype),
                         "beta": jnp.zeros((d,), self._pdtype)},
-                "w1": norm(ks[2], (d, f)),
-                "b1": jnp.zeros((f,), self._pdtype),
-                "w2": norm(ks[3], (f, d)),
-                "b2": jnp.zeros((d,), self._pdtype),
                 "ln2": {"gamma": jnp.ones((d,), self._pdtype),
                         "beta": jnp.zeros((d,), self._pdtype)},
-            })
+            }
+            if cfg.n_experts:
+                e = cfg.n_experts
+                lp.update({
+                    "wr": norm(ks[4], (d, e)),
+                    "we1": norm(ks[2], (e, d, f)),
+                    "be1": jnp.zeros((e, f), self._pdtype),
+                    "we2": norm(ks[3], (e, f, d)),
+                    "be2": jnp.zeros((e, d), self._pdtype),
+                })
+            else:
+                lp.update({
+                    "w1": norm(ks[2], (d, f)),
+                    "b1": jnp.zeros((f,), self._pdtype),
+                    "w2": norm(ks[3], (f, d)),
+                    "b2": jnp.zeros((d,), self._pdtype),
+                })
+            params["layers"].append(lp)
         params["mlm_bias"] = jnp.zeros((v,), self._pdtype)
         return params
 
@@ -130,12 +152,24 @@ class TransformerEncoder:
             "wo": P("model", None),     # row-parallel
             "bo": rep,
             "ln1": ln,
-            "w1": P(None, "model"),
-            "b1": P("model"),
-            "w2": P("model", None),
-            "b2": rep,
             "ln2": ln,
         }
+        if self.cfg.n_experts:
+            layer.update({
+                # expert parallelism: expert stack over 'model'
+                "wr": rep,
+                "we1": P("model", None, None),
+                "be1": P("model", None),
+                "we2": P("model", None, None),
+                "be2": P("model", None),
+            })
+        else:
+            layer.update({
+                "w1": P(None, "model"),
+                "b1": P("model"),
+                "w2": P("model", None),
+                "b2": rep,
+            })
         return {
             "tok_emb": P(None, "model"),
             "pos_emb": rep,
@@ -166,7 +200,7 @@ class TransformerEncoder:
         return lax.with_sharding_constraint(x, P("data", None, "model"))
 
     def encode(self, params, ids, type_ids=None, mask=None, train=False,
-               rng=None, sharded=False):
+               rng=None, sharded=False, return_aux=False):
         """ids: [N, T] int32 -> hidden [N, T, D]."""
         cfg = self.cfg
         n, t = ids.shape
@@ -192,9 +226,13 @@ class TransformerEncoder:
 
         keys = (jax.random.split(rng, cfg.n_layers)
                 if (train and rng is not None) else [None] * cfg.n_layers)
+        aux_total = jnp.float32(0.0)
         for li, lp in enumerate(params["layers"]):
-            x = self._block(x, lp, att_mask, train, keys[li], sharded,
-                            attn_fn=attn_fn)
+            x, aux = self._block(x, lp, att_mask, train, keys[li], sharded,
+                                 attn_fn=attn_fn)
+            aux_total = aux_total + aux
+        if return_aux:
+            return x, aux_total
         return x
 
     def _block(self, x, lp, att_mask, train, rng, sharded, attn_fn=None):
@@ -234,16 +272,27 @@ class TransformerEncoder:
         x = self._sp(x + att, sharded)
         x = self._ln(x, {k2: v2.astype(cd) for k2, v2 in lp["ln1"].items()})
 
-        # MLP
-        hmid = jax.nn.gelu(x @ lp["w1"].astype(cd) + lp["b1"].astype(cd))
-        out = hmid @ lp["w2"].astype(cd) + lp["b2"].astype(cd)
+        # MLP — dense FFN or expert-parallel MoE (models/moe.py)
+        aux = jnp.float32(0.0)
+        if "we1" in lp:
+            from deeplearning4j_tpu.models.moe import moe_ffn
+
+            out, aux = moe_ffn(
+                x.reshape(n * t, d), lp["wr"], lp["we1"], lp["be1"],
+                lp["we2"], lp["be2"], top_k=cfg.expert_top_k,
+                capacity_factor=cfg.capacity_factor, sharded=sharded,
+                group_size=t)  # per-sequence dispatch groups
+            out = out.reshape(n, t, d)
+        else:
+            hmid = jax.nn.gelu(x @ lp["w1"].astype(cd) + lp["b1"].astype(cd))
+            out = hmid @ lp["w2"].astype(cd) + lp["b2"].astype(cd)
         if train and rng is not None and cfg.dropout > 0:
             rng, sub = jax.random.split(rng)
             keep = 1.0 - cfg.dropout
             out = out * jax.random.bernoulli(sub, keep, out.shape) / keep
         x = self._sp(x + out, sharded)
         x = self._ln(x, {k2: v2.astype(cd) for k2, v2 in lp["ln2"].items()})
-        return x
+        return x, aux
 
     def mlm_logits(self, params, hidden):
         """Tied-embedding MLM head: hidden @ tok_emb^T + bias."""
@@ -268,8 +317,8 @@ class TransformerEncoder:
         beyond K are dropped from the loss (choose K >= max masked/row
         for exactness).
         """
-        hidden = self.encode(params, ids, train=train, rng=rng,
-                             sharded=sharded)
+        hidden, aux = self.encode(params, ids, train=train, rng=rng,
+                                  sharded=sharded, return_aux=True)
         if masked_capacity is not None:
             k = int(masked_capacity)
             # indices of the K largest mask flags per row (masked first;
@@ -284,7 +333,10 @@ class TransformerEncoder:
         tok = jnp.take_along_axis(logits, labels[..., None],
                                   axis=-1)[..., 0]
         denom = jnp.maximum(jnp.sum(mask_positions), 1.0)
-        return -jnp.sum((tok - lse) * mask_positions) / denom
+        ce = -jnp.sum((tok - lse) * mask_positions) / denom
+        if self.cfg.n_experts:
+            ce = ce + self.cfg.aux_loss_weight * aux
+        return ce
 
     @staticmethod
     def _apply_updates(updater, params, opt_state, grads, it_step):
@@ -334,6 +386,11 @@ class TransformerEncoder:
         return jax.jit(
             step,
             in_shardings=(pspec, None, rep, dp, dp, dp, rep),
+            # pin the updated params to the SAME shardings as the input:
+            # without this GSPMD may emit them re-sharded (observed with
+            # MoE: pos_emb came back P('model')), and feeding them to the
+            # next step then fails the in_shardings check
+            out_shardings=(pspec, None, rep),
             donate_argnums=(0, 1),
         )
 
@@ -376,8 +433,9 @@ class TransformerEncoder:
         keys = (jax.random.split(rng, cfg.n_layers)
                 if (train and rng is not None) else [None] * cfg.n_layers)
         for li, lp in enumerate(params["layers"]):
-            x = self._block(x, lp, None, train, keys[li], False,
-                            attn_fn=attn_fn)
+            # aux dropped: make_ring_train_step rejects MoE configs
+            x, _ = self._block(x, lp, None, train, keys[li], False,
+                               attn_fn=attn_fn)
         return x
 
     def make_ring_train_step(self, updater, mesh: Mesh, attn: str = "ring"):
@@ -394,6 +452,10 @@ class TransformerEncoder:
 
         if attn not in ("ring", "ulysses"):
             raise ValueError(f"attn must be ring|ulysses: {attn}")
+        if self.cfg.n_experts:
+            raise NotImplementedError(
+                "context-parallel training does not yet route the MoE "
+                "aux loss; use make_train_step (GSPMD EP) for MoE")
 
         def per_shard_grads(params, ids, labels, mask_pos, pad_mask, rng):
             # distinct dropout streams per shard

@@ -50,6 +50,10 @@ class PipelinedTransformer:
             raise ValueError(
                 f"n_layers={cfg.n_layers} not divisible by "
                 f"n_stages={n_stages}")
+        if getattr(cfg, "n_experts", 0):
+            raise NotImplementedError(
+                "pipeline parallelism does not yet route the MoE aux "
+                "loss; use make_train_step (GSPMD EP) for MoE")
         self.enc = encoder
         self.n_stages = n_stages
         self.layers_per_stage = cfg.n_layers // n_stages
@@ -112,7 +116,8 @@ class PipelinedTransformer:
             key = (jax.random.fold_in(rng, stage_id * self.layers_per_stage
                                       + li)
                    if (train and rng is not None) else None)
-            y = enc._block(carry, lp, None, train, key, False)
+            # aux dropped: __init__ rejects MoE configs
+            y, _ = enc._block(carry, lp, None, train, key, False)
             return y, None
 
         lidx = jnp.arange(self.layers_per_stage)

@@ -1,0 +1,188 @@
+"""MoE + expert parallelism tests (8-device CPU mesh).
+
+The reference has no MoE (SURVEY.md §2: data parallelism only). Checks:
+router invariants (capacity, gate normalization, aux loss), exact
+equivalence of a 1-expert MoE with the dense FFN, training convergence,
+and sharded-vs-unsharded step equivalence (EP over the 'model' axis)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import Mesh
+
+from deeplearning4j_tpu.models.moe import capacity, moe_ffn, router_dispatch
+from deeplearning4j_tpu.models.transformer import (
+    TransformerEncoder, tiny_config,
+)
+
+
+def _moe_cfg(**kw):
+    cfg = tiny_config(vocab=47, max_len=8, d_model=16, n_layers=2,
+                      d_ff=32)
+    cfg.n_experts = kw.pop("n_experts", 4)
+    cfg.expert_top_k = kw.pop("top_k", 2)
+    cfg.capacity_factor = kw.pop("capacity_factor", 2.0)
+    for k, v in kw.items():
+        setattr(cfg, k, v)
+    return cfg
+
+
+class TestRouter:
+    def test_capacity_respected(self):
+        rs = np.random.RandomState(0)
+        probs = jax.nn.softmax(jnp.asarray(rs.rand(64, 4) * 5), -1)
+        cap = 4  # deliberately tight: 64 tokens * top1 / 4 experts = 16
+        combine, aux = router_dispatch(probs, top_k=1, cap=cap)
+        # no expert slot double-booked, no expert over capacity
+        per_slot = np.asarray(jnp.sum((combine > 0), axis=0))  # [E, C]
+        assert per_slot.max() <= 1
+        assert np.asarray(jnp.sum(combine > 0, axis=(0, 2))).max() <= cap
+        assert np.isfinite(float(aux))
+
+    def test_gates_normalized_top2(self):
+        rs = np.random.RandomState(1)
+        probs = jax.nn.softmax(jnp.asarray(rs.rand(32, 4)), -1)
+        cap = capacity(32, 4, 4.0, 2)  # generous: nothing dropped
+        combine, _ = router_dispatch(probs, top_k=2, cap=cap)
+        sums = np.asarray(jnp.sum(combine, axis=(1, 2)))
+        np.testing.assert_allclose(sums, 1.0, rtol=1e-5)
+
+    def test_aux_is_one_for_uniform_router(self):
+        probs = jnp.full((64, 8), 1.0 / 8)
+        _, aux = router_dispatch(probs, top_k=1, cap=64)
+        # E * sum_e (1/E * 1/E) * E... = 1 for a perfectly uniform router
+        assert abs(float(aux) - 1.0) < 1e-5
+
+
+class TestMoEFFN:
+    def test_single_expert_equals_dense(self):
+        rs = np.random.RandomState(2)
+        d, f, s = 8, 16, 12
+        x = jnp.asarray(rs.randn(s, d).astype(np.float32))
+        w1 = jnp.asarray(rs.randn(d, f).astype(np.float32) * 0.1)
+        w2 = jnp.asarray(rs.randn(f, d).astype(np.float32) * 0.1)
+        y, aux = moe_ffn(
+            x, jnp.zeros((d, 1)), w1[None], jnp.zeros((1, f)),
+            w2[None], jnp.zeros((1, d)), top_k=1, capacity_factor=1.0)
+        ref = jax.nn.gelu(x @ w1) @ w2
+        np.testing.assert_allclose(np.asarray(y), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_overflow_tokens_dropped_to_zero(self):
+        rs = np.random.RandomState(3)
+        d = 4
+        x = jnp.asarray(rs.randn(16, d).astype(np.float32))
+        wr = jnp.asarray(rs.randn(d, 2).astype(np.float32))
+        y, _ = moe_ffn(
+            x, wr, jnp.ones((2, d, d)) * 0.1, jnp.zeros((2, d)),
+            jnp.ones((2, d, d)) * 0.1, jnp.zeros((2, d)),
+            top_k=1, capacity_factor=0.25)
+        nz = np.asarray(jnp.any(y != 0, axis=-1))
+        cap = capacity(16, 2, 0.25, 1)
+        # at most cap tokens kept per expert; with 16 tokens over 2
+        # experts of capacity 2 most are dropped, and dropped tokens'
+        # outputs are exactly zero (the residual carries them)
+        assert 0 < nz.sum() <= 2 * cap
+        assert (~nz).sum() >= 16 - 2 * cap
+
+class TestMoETraining:
+    def test_loss_decreases(self):
+        cfg = _moe_cfg()
+        enc = TransformerEncoder(cfg)
+        params = enc.init_params()
+        from deeplearning4j_tpu.learning.updaters import Adam
+        upd = Adam(5e-3)
+        opt = upd.init_state(params)
+        step = enc.make_train_step(upd)
+        rs = np.random.RandomState(5)
+        ids = jnp.asarray(rs.randint(0, 47, (8, 8)).astype(np.int32))
+        mask = jnp.ones((8, 8), jnp.float32)
+        losses = []
+        for i in range(16):
+            params, opt, loss = step(params, opt, jnp.asarray(i), ids,
+                                     ids, mask, jax.random.key(i))
+            losses.append(float(loss))
+        assert losses[-1] < losses[0] * 0.8, losses
+
+    def test_sharded_step_matches_unsharded(self):
+        cfg = _moe_cfg(capacity_factor=4.0)
+        cfg.dropout = 0.0
+        enc = TransformerEncoder(cfg)
+        params = enc.init_params()
+        from deeplearning4j_tpu.learning.updaters import Sgd
+        rs = np.random.RandomState(6)
+        ids = jnp.asarray(rs.randint(0, 47, (8, 8)).astype(np.int32))
+        mask = jnp.ones((8, 8), jnp.float32)
+        rng = jax.random.key(0)
+
+        ref_step = enc.make_train_step(Sgd(0.2))
+        _, _, ref_loss = ref_step(
+            jax.tree_util.tree_map(jnp.copy, params),
+            Sgd(0.2).init_state(params), jnp.asarray(0), ids, ids, mask,
+            rng)
+
+        mesh = Mesh(np.asarray(jax.devices()).reshape(2, 4),
+                    ("data", "model"))
+        sp = enc.shard_params(params, mesh)
+        step = enc.make_train_step(Sgd(0.2), mesh)
+        with mesh:
+            _, _, loss = step(sp, Sgd(0.2).init_state(sp), jnp.asarray(0),
+                              ids, ids, mask, rng)
+        assert abs(float(loss) - float(ref_loss)) / abs(float(ref_loss)) \
+            < 1e-4, (float(loss), float(ref_loss))
+
+    def test_ring_step_rejects_moe(self):
+        cfg = _moe_cfg()
+        enc = TransformerEncoder(cfg)
+        mesh = Mesh(np.asarray(jax.devices()).reshape(4, 2),
+                    ("data", "sp"))
+        from deeplearning4j_tpu.learning.updaters import Adam
+        with pytest.raises(NotImplementedError, match="MoE"):
+            enc.make_ring_train_step(Adam(1e-3), mesh)
+
+    def test_pipeline_rejects_moe(self):
+        from deeplearning4j_tpu.parallel.pipeline import (
+            PipelinedTransformer,
+        )
+        enc = TransformerEncoder(_moe_cfg())
+        with pytest.raises(NotImplementedError, match="MoE"):
+            PipelinedTransformer(enc, n_stages=2)
+
+
+class TestReviewRegressions:
+    def test_top1_router_receives_task_gradient(self):
+        """Switch-style top-1 keeps the RAW gate: normalizing would make
+        the gate identically 1 and zero the router's task gradient."""
+        rs = np.random.RandomState(7)
+        d, f = 8, 16
+        x = jnp.asarray(rs.randn(12, d).astype(np.float32))
+        wr = jnp.asarray(rs.randn(d, 4).astype(np.float32))
+        we1 = jnp.asarray(rs.randn(4, d, f).astype(np.float32) * 0.1)
+        we2 = jnp.asarray(rs.randn(4, f, d).astype(np.float32) * 0.1)
+
+        def out_sum(wr_):
+            y, _ = moe_ffn(x, wr_, we1, jnp.zeros((4, f)), we2,
+                           jnp.zeros((4, d)), top_k=1,
+                           capacity_factor=4.0)
+            return jnp.sum(y * y)
+
+        g = jax.grad(out_sum)(wr)
+        assert float(jnp.max(jnp.abs(g))) > 0.0
+
+    def test_grouped_dispatch(self):
+        """Per-group dispatch (GShard): capacity applies within each
+        group, and an indivisible group size raises clearly."""
+        rs = np.random.RandomState(8)
+        d = 8
+        x = jnp.asarray(rs.randn(32, d).astype(np.float32))
+        wr = jnp.asarray(rs.randn(d, 2).astype(np.float32))
+        y, aux = moe_ffn(
+            x, wr, jnp.ones((2, d, d)) * 0.1, jnp.zeros((2, d)),
+            jnp.ones((2, d, d)) * 0.1, jnp.zeros((2, d)),
+            top_k=1, capacity_factor=1.0, group_size=8)
+        assert y.shape == (32, d) and np.isfinite(float(aux))
+        with pytest.raises(ValueError, match="divisible"):
+            moe_ffn(x, wr, jnp.ones((2, d, d)), jnp.zeros((2, d)),
+                    jnp.ones((2, d, d)), jnp.zeros((2, d)),
+                    top_k=1, capacity_factor=1.0, group_size=5)
