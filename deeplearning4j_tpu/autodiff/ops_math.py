@@ -189,8 +189,22 @@ def cumsum(x, axis=0, exclusive=False, reverse=False):
 
 
 @_reg("cumprod")
-def cumprod(x, axis=0):
-    return jnp.cumprod(x, axis=axis)
+def cumprod(x, axis=0, exclusive=False, reverse=False):
+    if reverse:
+        x = jnp.flip(x, axis)
+    if exclusive:
+        # shift-by-one, NOT cumprod/x: division poisons results with
+        # NaN when the input contains zeros
+        ones_shape = list(x.shape)
+        ones_shape[axis] = 1
+        x = jnp.concatenate(
+            [jnp.ones(ones_shape, x.dtype),
+             lax.slice_in_dim(x, 0, x.shape[axis] - 1, axis=axis)],
+            axis=axis)
+    out = jnp.cumprod(x, axis=axis)
+    if reverse:
+        out = jnp.flip(out, axis)
+    return out
 
 
 # ---------------------------------------------------------------- reduce

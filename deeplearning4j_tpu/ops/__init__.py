@@ -13,9 +13,23 @@ Modules:
 - nn: conv/pool/norm/rnn/attention ops (reference: ops/declarable/generic/nn)
 - random: distribution ops
 - compression: threshold gradient encode/decode (reference: encodeThreshold)
+- reduce: reductions / index & pairwise-distance reductions / segment ops
+- shape: shape manipulation, gather/scatter, pad, layout movement
+- linalg: matmul family + decompositions (reference: generic/blas+linalg)
+- image: resize/crop/NMS/colorspace (reference: generic/images)
+- bitwise: bit ops, comparisons, safe division
 """
 
 from deeplearning4j_tpu.ops.registry import get_op, list_ops, register_op
-from deeplearning4j_tpu.ops import transforms, nn, random, compression  # noqa: F401 (register)
+from deeplearning4j_tpu.ops import (  # noqa: F401 (register)
+    transforms, nn, random, compression, reduce, shape, linalg, image,
+    bitwise,
+)
+# The SameDiff math module owns the canonical registrations for the
+# graph-execution op names (reduce_sum with `dimensions=`, etc. — the
+# TF-import attr contract); importing it here makes the full op set
+# available from a bare `deeplearning4j_tpu.ops` import. Cycle-safe:
+# nothing in that chain imports the ops PACKAGE, only ops.registry.
+from deeplearning4j_tpu.autodiff import ops_math as _ops_math  # noqa: F401,E402
 
 __all__ = ["get_op", "list_ops", "register_op"]

@@ -1,0 +1,152 @@
+"""Bitwise / boolean / comparison ops.
+
+Reference: libnd4j ``ops/declarable/generic/bitwise/**`` (and, or, xor,
+shifts, cyclic shifts, toggle_bits) and the pairwise/boolean legacy
+loops (SURVEY.md §2.6, §2.7).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from deeplearning4j_tpu.ops.registry import register_op
+
+
+@register_op("bitwise_and")
+def bitwise_and(x, y):
+    return jnp.bitwise_and(x, y)
+
+
+@register_op("bitwise_or")
+def bitwise_or(x, y):
+    return jnp.bitwise_or(x, y)
+
+
+@register_op("bitwise_xor")
+def bitwise_xor(x, y):
+    return jnp.bitwise_xor(x, y)
+
+
+@register_op("bitwise_not")
+def bitwise_not(x):
+    return jnp.bitwise_not(x)
+
+
+@register_op("shift_left")
+def shift_left(x, n):
+    return jnp.left_shift(x, n)
+
+
+@register_op("shift_right")
+def shift_right(x, n):
+    return jnp.right_shift(x, n)
+
+
+_UNSIGNED = {1: jnp.uint8, 2: jnp.uint16, 4: jnp.uint32, 8: jnp.uint64}
+
+
+def _rotate(x, n, left):
+    # rotate on the UNSIGNED view: arithmetic right-shift on signed ints
+    # sign-extends with 1s and corrupts any rotation of a negative value;
+    # also guard n % bits == 0 (shift by full width is undefined)
+    x = jnp.asarray(x)
+    bits = x.dtype.itemsize * 8
+    n = n % bits
+    if n == 0:
+        return x
+    u = x.view(_UNSIGNED[x.dtype.itemsize])
+    if left:
+        r = jnp.bitwise_or(jnp.left_shift(u, n),
+                           jnp.right_shift(u, bits - n))
+    else:
+        r = jnp.bitwise_or(jnp.right_shift(u, n),
+                           jnp.left_shift(u, bits - n))
+    return r.view(x.dtype)
+
+
+@register_op("cyclic_shift_left")
+def cyclic_shift_left(x, n):
+    return _rotate(x, n, left=True)
+
+
+@register_op("cyclic_shift_right")
+def cyclic_shift_right(x, n):
+    return _rotate(x, n, left=False)
+
+
+@register_op("toggle_bits")
+def toggle_bits(x):
+    return jnp.bitwise_not(x)
+
+
+@register_op("bits_hamming_distance")
+def bits_hamming_distance(x, y):
+    return jnp.sum(lax.population_count(jnp.bitwise_xor(x, y)))
+
+
+@register_op("bitcast")
+def bitcast(x, dtype):
+    return lax.bitcast_convert_type(x, dtype)
+
+
+# -- comparisons -------------------------------------------------------
+@register_op("equals")
+def equals(x, y):
+    return x == y
+
+
+@register_op("not_equals")
+def not_equals(x, y):
+    return x != y
+
+
+@register_op("greater")
+def greater(x, y):
+    return x > y
+
+
+@register_op("greater_equal")
+def greater_equal(x, y):
+    return x >= y
+
+
+@register_op("less")
+def less(x, y):
+    return x < y
+
+
+@register_op("less_equal")
+def less_equal(x, y):
+    return x <= y
+
+
+@register_op("is_close")
+def is_close(x, y, rtol=1e-5, atol=1e-8):
+    return jnp.isclose(x, y, rtol=rtol, atol=atol)
+
+
+@register_op("floormod")
+def floormod(x, y):
+    return jnp.mod(x, y)
+
+
+@register_op("truncatediv")
+def truncatediv(x, y):
+    x = jnp.asarray(x)
+    y = jnp.asarray(y)
+    if jnp.issubdtype(jnp.result_type(x, y), jnp.integer):
+        # stay in integers: the float round-trip loses precision past
+        # the f32 mantissa (e.g. 16777217 // 1 != itself via float)
+        q = jnp.abs(x) // jnp.abs(y)
+        return (jnp.sign(x) * jnp.sign(y)).astype(q.dtype) * q
+    return jnp.trunc(x / y).astype(jnp.result_type(x, y))
+
+
+@register_op("divide_no_nan")
+def divide_no_nan(x, y):
+    # divide by a SAFE denominator before masking: where(y==0, 0, x/y)
+    # alone still differentiates the x/0 branch and NaNs the gradient
+    safe = jnp.where(y == 0, jnp.ones((), jnp.result_type(y)), y)
+    return jnp.where(y == 0, jnp.zeros((), jnp.result_type(x, y)),
+                     x / safe)

@@ -1,0 +1,233 @@
+"""Image ops (NHWC throughout — the TPU-native layout).
+
+Reference: libnd4j ``ops/declarable/generic/images/**`` (resize family,
+crop_and_resize, non_max_suppression, rgb/hsv/yuv conversions,
+extract_image_patches, image flips) — SURVEY.md §2.6. Resizes ride
+``jax.image``; NMS is a fixed-trip-count lax.fori_loop (XLA-safe
+formulation of the reference's data-dependent loop).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deeplearning4j_tpu.ops.registry import register_op
+
+
+def _resize(x, size, method):
+    shape = (x.shape[0], int(size[0]), int(size[1]), x.shape[3])
+    return jax.image.resize(x, shape, method=method)
+
+
+@register_op("resize_bilinear")
+def resize_bilinear(x, size):
+    return _resize(x, size, "bilinear")
+
+
+@register_op("resize_nearest_neighbor")
+def resize_nearest_neighbor(x, size):
+    return _resize(x, size, "nearest")
+
+
+@register_op("resize_bicubic")
+def resize_bicubic(x, size):
+    return _resize(x, size, "cubic")
+
+
+@register_op("resize_area")
+def resize_area(x, size):
+    # area resize == linear resize with antialiasing over boxes;
+    # jax.image 'linear' + antialias approximates TF's area kernel
+    shape = (x.shape[0], int(size[0]), int(size[1]), x.shape[3])
+    return jax.image.resize(x, shape, method="linear", antialias=True)
+
+
+@register_op("crop_and_resize")
+def crop_and_resize(image, boxes, box_indices, crop_size,
+                    method="bilinear"):
+    """image [N,H,W,C]; boxes [B,4] normalized (y1,x1,y2,x2);
+    box_indices [B] -> [B, ch, cw, C] (reference: crop_and_resize.cpp)."""
+    h, w = image.shape[1], image.shape[2]
+    ch, cw = crop_size
+
+    def one(box, bi):
+        y1, x1, y2, x2 = box
+        img = image[bi]
+        ys = y1 * (h - 1) + jnp.arange(ch) / max(ch - 1, 1) \
+            * (y2 - y1) * (h - 1)
+        xs = x1 * (w - 1) + jnp.arange(cw) / max(cw - 1, 1) \
+            * (x2 - x1) * (w - 1)
+        if method == "nearest":
+            yi = jnp.clip(jnp.round(ys), 0, h - 1).astype(jnp.int32)
+            xi = jnp.clip(jnp.round(xs), 0, w - 1).astype(jnp.int32)
+            return img[yi][:, xi]
+        y0 = jnp.clip(jnp.floor(ys), 0, h - 1).astype(jnp.int32)
+        y1i = jnp.clip(y0 + 1, 0, h - 1)
+        x0 = jnp.clip(jnp.floor(xs), 0, w - 1).astype(jnp.int32)
+        x1i = jnp.clip(x0 + 1, 0, w - 1)
+        wy = (ys - y0)[:, None, None]
+        wx = (xs - x0)[None, :, None]
+        tl = img[y0][:, x0]
+        tr = img[y0][:, x1i]
+        bl = img[y1i][:, x0]
+        br = img[y1i][:, x1i]
+        top = tl * (1 - wx) + tr * wx
+        bot = bl * (1 - wx) + br * wx
+        return top * (1 - wy) + bot * wy
+
+    return jax.vmap(one)(boxes, box_indices)
+
+
+@register_op("extract_image_patches")
+def extract_image_patches(x, ksizes, strides, rates=(1, 1),
+                          padding="VALID"):
+    """[N,H,W,C] -> [N,OH,OW,kh*kw*C] (reference:
+    extract_image_patches.cpp). Implemented as a depthwise identity
+    conv-style gather via conv_general_dilated_patches."""
+    kh, kw = ksizes
+    patches = lax.conv_general_dilated_patches(
+        x, (kh, kw), strides, padding,
+        rhs_dilation=rates, dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    # conv patches emits channel-major [C*kh*kw]; reference order is
+    # [kh*kw*C] — transpose the feature blocks
+    n, oh, ow, _ = patches.shape
+    c = x.shape[3]
+    patches = patches.reshape(n, oh, ow, c, kh * kw)
+    return patches.transpose(0, 1, 2, 4, 3).reshape(n, oh, ow,
+                                                    kh * kw * c)
+
+
+@register_op("image_flip_left_right")
+def flip_left_right(x):
+    return jnp.flip(x, axis=2)
+
+
+@register_op("image_flip_up_down")
+def flip_up_down(x):
+    return jnp.flip(x, axis=1)
+
+
+@register_op("adjust_brightness")
+def adjust_brightness(x, delta):
+    return x + delta
+
+
+@register_op("adjust_contrast")
+def adjust_contrast(x, factor):
+    mean = jnp.mean(x, axis=(1, 2), keepdims=True)
+    return (x - mean) * factor + mean
+
+
+@register_op("adjust_saturation")
+def adjust_saturation(x, factor):
+    h, s, v = _rgb_to_hsv_tuple(x)
+    return _hsv_to_rgb_tuple(h, jnp.clip(s * factor, 0.0, 1.0), v)
+
+
+@register_op("adjust_hue")
+def adjust_hue(x, delta):
+    h, s, v = _rgb_to_hsv_tuple(x)
+    return _hsv_to_rgb_tuple((h + delta) % 1.0, s, v)
+
+
+def _rgb_to_hsv_tuple(x):
+    r, g, b = x[..., 0], x[..., 1], x[..., 2]
+    mx = jnp.maximum(jnp.maximum(r, g), b)
+    mn = jnp.minimum(jnp.minimum(r, g), b)
+    d = mx - mn
+    safe = jnp.where(d == 0, 1.0, d)
+    h = jnp.where(
+        mx == r, ((g - b) / safe) % 6.0,
+        jnp.where(mx == g, (b - r) / safe + 2.0, (r - g) / safe + 4.0))
+    h = jnp.where(d == 0, 0.0, h) / 6.0
+    s = jnp.where(mx == 0, 0.0, d / jnp.where(mx == 0, 1.0, mx))
+    return h, s, mx
+
+
+def _hsv_to_rgb_tuple(h, s, v):
+    i = jnp.floor(h * 6.0)
+    f = h * 6.0 - i
+    p = v * (1 - s)
+    q = v * (1 - f * s)
+    t = v * (1 - (1 - f) * s)
+    i = i.astype(jnp.int32) % 6
+    r = jnp.select([i == 0, i == 1, i == 2, i == 3, i == 4, i == 5],
+                   [v, q, p, p, t, v])
+    g = jnp.select([i == 0, i == 1, i == 2, i == 3, i == 4, i == 5],
+                   [t, v, v, q, p, p])
+    b = jnp.select([i == 0, i == 1, i == 2, i == 3, i == 4, i == 5],
+                   [p, p, t, v, v, q])
+    return jnp.stack([r, g, b], axis=-1)
+
+
+@register_op("rgb_to_hsv")
+def rgb_to_hsv(x):
+    h, s, v = _rgb_to_hsv_tuple(x)
+    return jnp.stack([h, s, v], axis=-1)
+
+
+@register_op("hsv_to_rgb")
+def hsv_to_rgb(x):
+    return _hsv_to_rgb_tuple(x[..., 0], x[..., 1], x[..., 2])
+
+
+@register_op("rgb_to_grayscale")
+def rgb_to_grayscale(x):
+    w = jnp.asarray([0.2989, 0.5870, 0.1140], x.dtype)
+    return jnp.sum(x * w, axis=-1, keepdims=True)
+
+
+@register_op("rgb_to_yuv")
+def rgb_to_yuv(x):
+    m = jnp.asarray([[0.299, -0.14714119, 0.61497538],
+                     [0.587, -0.28886916, -0.51496512],
+                     [0.114, 0.43601035, -0.10001026]], x.dtype)
+    return x @ m
+
+
+@register_op("yuv_to_rgb")
+def yuv_to_rgb(x):
+    m = jnp.asarray([[1.0, 1.0, 1.0],
+                     [0.0, -0.394642334, 2.03206185],
+                     [1.13988303, -0.58062185, 0.0]], x.dtype)
+    return x @ m
+
+
+@register_op("non_max_suppression")
+def non_max_suppression(boxes, scores, max_output_size,
+                        iou_threshold=0.5, score_threshold=float("-inf")):
+    """Greedy NMS, fixed output size (XLA-safe). boxes [B,4]
+    (y1,x1,y2,x2); returns (selected_indices [K], valid_count).
+    Unused slots hold -1 (reference: non_max_suppression.cpp)."""
+    b = boxes.shape[0]
+    area = jnp.maximum(boxes[:, 2] - boxes[:, 0], 0) \
+        * jnp.maximum(boxes[:, 3] - boxes[:, 1], 0)
+
+    def iou(i, j):
+        y1 = jnp.maximum(boxes[i, 0], boxes[j, 0])
+        x1 = jnp.maximum(boxes[i, 1], boxes[j, 1])
+        y2 = jnp.minimum(boxes[i, 2], boxes[j, 2])
+        x2 = jnp.minimum(boxes[i, 3], boxes[j, 3])
+        inter = jnp.maximum(y2 - y1, 0) * jnp.maximum(x2 - x1, 0)
+        return inter / jnp.maximum(area[i] + area[j] - inter, 1e-9)
+
+    def body(k, state):
+        sel, count, live, sc = state
+        best = jnp.argmax(jnp.where(live, sc, -jnp.inf))
+        ok = jnp.logical_and(live[best], sc[best] > score_threshold)
+        sel = sel.at[k].set(jnp.where(ok, best, -1))
+        count = count + ok.astype(jnp.int32)
+        ious = jax.vmap(lambda j: iou(best, j))(jnp.arange(b))
+        live = live & (ious <= iou_threshold)
+        live = live.at[best].set(False)
+        live = jnp.where(ok, live, jnp.zeros_like(live))
+        return sel, count, live, sc
+
+    sel0 = jnp.full((max_output_size,), -1, jnp.int32)
+    live0 = jnp.ones((b,), bool)
+    sel, count, _, _ = lax.fori_loop(
+        0, max_output_size, body, (sel0, jnp.asarray(0, jnp.int32),
+                                   live0, scores.astype(jnp.float32)))
+    return sel, count

@@ -1,0 +1,149 @@
+"""Linear-algebra ops.
+
+Reference: libnd4j ``ops/declarable/generic/blas/**`` (matmul, batched
+gemm, tensormmul) and ``generic/linalg/**`` (cholesky, qr, svd, solve,
+triangular_solve, lup, matrix_inverse, matrix_determinant, ...) —
+SURVEY.md §2.6. The decompositions lower to XLA custom calls (LAPACK on
+CPU, specialized kernels on TPU); matmuls are the MXU path.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deeplearning4j_tpu.ops.registry import register_op
+
+
+@register_op("batched_gemm")
+def batched_gemm(a, b, alpha=1.0, beta=0.0, c=None):
+    out = alpha * (a @ b)
+    if c is not None and beta != 0.0:
+        out = out + beta * c
+    return out
+
+
+@register_op("tensormmul")
+def tensormmul(a, b, axes_a, axes_b):
+    """Tensor contraction (reference: tensormmul / TensorMmul)."""
+    return jnp.tensordot(a, b, axes=(axes_a, axes_b))
+
+
+@register_op("outer")
+def outer(a, b):
+    return jnp.outer(a, b)
+
+
+@register_op("cholesky")
+def cholesky(x):
+    return jnp.linalg.cholesky(x)
+
+
+@register_op("qr")
+def qr(x, full_matrices=False):
+    return jnp.linalg.qr(x, mode="complete" if full_matrices else
+                         "reduced")
+
+
+@register_op("svd")
+def svd(x, full_matrices=False, compute_uv=True):
+    return jnp.linalg.svd(x, full_matrices=full_matrices,
+                          compute_uv=compute_uv)
+
+
+@register_op("eigh")
+def eigh(x):
+    """Symmetric eigendecomposition (reference: self_adjoint_eig)."""
+    return jnp.linalg.eigh(x)
+
+
+@register_op("solve")
+def solve(a, b):
+    return jnp.linalg.solve(a, b)
+
+
+@register_op("triangular_solve")
+def triangular_solve(a, b, lower=True, adjoint=False):
+    return jax.scipy.linalg.solve_triangular(
+        a, b, lower=lower, trans="T" if adjoint else "N")
+
+
+@register_op("lstsq")
+def lstsq(a, b, rcond=None):
+    return jnp.linalg.lstsq(a, b, rcond=rcond)[0]
+
+
+@register_op("lu")
+def lu(x):
+    return jax.scipy.linalg.lu(x)
+
+
+@register_op("matrix_inverse")
+def matrix_inverse(x):
+    return jnp.linalg.inv(x)
+
+
+@register_op("pinv")
+def pinv(x):
+    return jnp.linalg.pinv(x)
+
+
+@register_op("matrix_determinant")
+def matrix_determinant(x):
+    return jnp.linalg.det(x)
+
+
+@register_op("log_matrix_determinant")
+def log_matrix_determinant(x):
+    sign, logdet = jnp.linalg.slogdet(x)
+    return sign, logdet
+
+
+@register_op("matrix_band_part")
+def matrix_band_part(x, num_lower, num_upper):
+    m, n = x.shape[-2], x.shape[-1]
+    i = jnp.arange(m)[:, None]
+    j = jnp.arange(n)[None, :]
+    keep = jnp.ones((m, n), bool)
+    if num_lower >= 0:
+        keep = keep & (i - j <= num_lower)
+    if num_upper >= 0:
+        keep = keep & (j - i <= num_upper)
+    return jnp.where(keep, x, jnp.zeros((), x.dtype))
+
+
+@register_op("tri")
+def tri(n, m=None, k=0, dtype=jnp.float32):
+    return jnp.tri(n, m, k, dtype=dtype)
+
+
+@register_op("triu")
+def triu(x, k=0):
+    return jnp.triu(x, k)
+
+
+@register_op("tril")
+def tril(x, k=0):
+    return jnp.tril(x, k)
+
+
+@register_op("cross")
+def cross(a, b, axis=-1):
+    return jnp.cross(a, b, axis=axis)
+
+
+@register_op("kron")
+def kron(a, b):
+    return jnp.kron(a, b)
+
+
+@register_op("norm_fro")
+def norm_fro(x):
+    return jnp.linalg.norm(x)
+
+
+@register_op("l2_normalize")
+def l2_normalize(x, axis=-1, eps=1e-12):
+    return x * lax.rsqrt(jnp.maximum(
+        jnp.sum(x * x, axis=axis, keepdims=True), eps))

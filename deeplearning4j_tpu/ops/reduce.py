@@ -1,0 +1,193 @@
+"""Reduction / index-reduction / accumulation ops.
+
+Reference: nd4j ``org/nd4j/linalg/api/ops/impl/reduce/**`` (ReduceOp
+hierarchy: same/float/long variants), ``indexaccum/**`` (IndexMax et
+al.), ``reduce3/**`` (pairwise distance reductions) and the libnd4j
+legacy reduce loops (SURVEY.md §2.2, §2.7). All pure jax: under jit
+these lower to single XLA reduce fusions — the reference pays one JNI
+dispatch + TAD pass per call.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deeplearning4j_tpu.ops.registry import register_op
+
+
+# -- plain reductions --------------------------------------------------
+@register_op("count_zero")
+def count_zero(x, axis=None, keepdims=False):
+    return jnp.sum((x == 0).astype(jnp.int32), axis=axis,
+                   keepdims=keepdims)
+
+
+# -- norms (reference: reduce/floating/Norm1,Norm2,NormMax,SquaredNorm)
+@register_op("norm1")
+def norm1(x, axis=None, keepdims=False):
+    return jnp.sum(jnp.abs(x), axis=axis, keepdims=keepdims)
+
+
+@register_op("norm2")
+def norm2(x, axis=None, keepdims=False):
+    return jnp.sqrt(jnp.sum(x * x, axis=axis, keepdims=keepdims))
+
+
+@register_op("normmax")
+def normmax(x, axis=None, keepdims=False):
+    return jnp.max(jnp.abs(x), axis=axis, keepdims=keepdims)
+
+
+@register_op("squared_norm")
+def squared_norm(x, axis=None, keepdims=False):
+    return jnp.sum(x * x, axis=axis, keepdims=keepdims)
+
+
+@register_op("std")
+def std(x, axis=None, keepdims=False, ddof=0):
+    return jnp.std(x, axis=axis, keepdims=keepdims, ddof=ddof)
+
+
+@register_op("variance")
+def variance(x, axis=None, keepdims=False, ddof=0):
+    return jnp.var(x, axis=axis, keepdims=keepdims, ddof=ddof)
+
+
+@register_op("moments")
+def moments(x, axis=None, keepdims=False):
+    """(mean, variance) in one pass (reference: moments op)."""
+    m = jnp.mean(x, axis=axis, keepdims=keepdims)
+    v = jnp.var(x, axis=axis, keepdims=keepdims)
+    return m, v
+
+
+@register_op("entropy")
+def entropy(x, axis=None):
+    """-sum(p * log(p)) (reference: reduce/floating/Entropy)."""
+    return -jnp.sum(x * jnp.log(x), axis=axis)
+
+
+@register_op("log_entropy")
+def log_entropy(x, axis=None):
+    return jnp.log(entropy(x, axis=axis))
+
+
+@register_op("shannon_entropy")
+def shannon_entropy(x, axis=None):
+    return -jnp.sum(x * jnp.log2(x), axis=axis)
+
+
+# -- index reductions (reference: indexaccum/{IMax,IMin,FirstIndex,...})
+@register_op("argamax")
+def argamax(x, axis=None):
+    """Index of max ABSOLUTE value (reference: IAMax)."""
+    return jnp.argmax(jnp.abs(x), axis=axis)
+
+
+@register_op("argamin")
+def argamin(x, axis=None):
+    return jnp.argmin(jnp.abs(x), axis=axis)
+
+
+# -- accumulations along an axis ---------------------------------------
+# -- reduce3: pairwise distance reductions (reference: reduce3/**) -----
+@register_op("dot")
+def dot(x, y, axis=None):
+    return jnp.sum(x * y, axis=axis)
+
+
+@register_op("cosine_similarity")
+def cosine_similarity(x, y, axis=None, eps=1e-12):
+    num = jnp.sum(x * y, axis=axis)
+    den = jnp.sqrt(jnp.sum(x * x, axis=axis)
+                   * jnp.sum(y * y, axis=axis))
+    return num / jnp.maximum(den, eps)
+
+
+@register_op("cosine_distance")
+def cosine_distance(x, y, axis=None):
+    return 1.0 - cosine_similarity(x, y, axis=axis)
+
+
+@register_op("euclidean_distance")
+def euclidean_distance(x, y, axis=None):
+    d = x - y
+    return jnp.sqrt(jnp.sum(d * d, axis=axis))
+
+
+@register_op("manhattan_distance")
+def manhattan_distance(x, y, axis=None):
+    return jnp.sum(jnp.abs(x - y), axis=axis)
+
+
+@register_op("hamming_distance")
+def hamming_distance(x, y, axis=None):
+    return jnp.sum((x != y).astype(jnp.float32), axis=axis)
+
+
+@register_op("jaccard_distance")
+def jaccard_distance(x, y, axis=None, eps=1e-12):
+    mn = jnp.sum(jnp.minimum(x, y), axis=axis)
+    mx = jnp.sum(jnp.maximum(x, y), axis=axis)
+    return 1.0 - mn / jnp.maximum(mx, eps)
+
+
+# -- segment reductions (reference: ops/declarable/generic/transforms/
+#    segment_*.cpp + unsorted variants; sum/mean/max/min registered by
+#    autodiff/ops_math — only the variants missing there live here) ----
+@register_op("segment_prod")
+def segment_prod(data, segment_ids, num_segments):
+    return jax.ops.segment_prod(data, segment_ids,
+                                num_segments=num_segments,
+                                indices_are_sorted=True)
+
+
+def segment_mean(data, segment_ids, num_segments, *, sorted=True):
+    s = jax.ops.segment_sum(data, segment_ids, num_segments=num_segments,
+                            indices_are_sorted=sorted)
+    n = jax.ops.segment_sum(jnp.ones_like(data), segment_ids,
+                            num_segments=num_segments,
+                            indices_are_sorted=sorted)
+    return s / jnp.maximum(n, 1)
+
+
+@register_op("unsorted_segment_sum")
+def unsorted_segment_sum(data, segment_ids, num_segments):
+    return jax.ops.segment_sum(data, segment_ids,
+                               num_segments=num_segments,
+                               indices_are_sorted=False)
+
+
+@register_op("unsorted_segment_mean")
+def unsorted_segment_mean(data, segment_ids, num_segments):
+    return segment_mean(data, segment_ids, num_segments, sorted=False)
+
+
+# -- top-k family ------------------------------------------------------
+@register_op("in_top_k")
+def in_top_k(predictions, targets, k):
+    """[N, C] predictions, [N] targets -> [N] bool (reference:
+    in_top_k.cpp)."""
+    _, idx = lax.top_k(predictions, k)
+    return jnp.any(idx == targets[:, None], axis=-1)
+
+
+@register_op("confusion_matrix")
+def confusion_matrix(labels, predictions, num_classes, weights=None):
+    flat = labels.astype(jnp.int32) * num_classes \
+        + predictions.astype(jnp.int32)
+    w = jnp.ones_like(flat, jnp.float32) if weights is None else weights
+    cm = jax.ops.segment_sum(w, flat, num_segments=num_classes ** 2)
+    return cm.reshape(num_classes, num_classes)
+
+
+@register_op("zero_fraction")
+def zero_fraction(x):
+    return jnp.mean((x == 0).astype(jnp.float32))
+
+
+@register_op("matrix_trace")
+def matrix_trace(x):
+    return jnp.trace(x, axis1=-2, axis2=-1)

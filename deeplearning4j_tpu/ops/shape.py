@@ -1,0 +1,225 @@
+"""Shape / gather-scatter / broadcast manipulation ops.
+
+Reference: nd4j ``org/nd4j/linalg/api/ops/impl/shape/**`` (Concat,
+Stack, Gather, ScatterUpdate, Tile, ...) and libnd4j
+``ops/declarable/generic/shape/**`` + ``transforms/**`` (SURVEY.md
+§2.2, §2.6). Pure jax; static shapes by design — ops whose output
+shape is data-dependent in the reference (``unique``, boolean
+``where``) take explicit size arguments or return masks, the XLA-
+compatible formulation.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deeplearning4j_tpu.ops.registry import register_op
+
+
+# -- pure shape --------------------------------------------------------
+@register_op("permute")
+def permute(x, axes):
+    return jnp.transpose(x, axes)
+
+
+@register_op("flatten_2d")
+def flatten_2d(x, axis=1):
+    """Collapse to 2D around `axis` (reference: Flatten2D)."""
+    lead = 1
+    for s in x.shape[:axis]:
+        lead *= s
+    return jnp.reshape(x, (lead, -1))
+
+
+@register_op("broadcast_to")
+def broadcast_to(x, shape):
+    return jnp.broadcast_to(x, shape)
+
+
+@register_op("rank")
+def rank(x):
+    return jnp.asarray(jnp.ndim(x), jnp.int32)
+
+
+# -- concat / split family ---------------------------------------------
+@register_op("split_v")
+def split_v(x, sizes, axis=0):
+    idx = []
+    acc = 0
+    for s in sizes[:-1]:
+        acc += s
+        idx.append(acc)
+    return jnp.split(x, idx, axis=axis)
+
+
+@register_op("reverse_sequence")
+def reverse_sequence(x, seq_lengths, seq_axis=1, batch_axis=0):
+    """Per-row partial reversal (reference: reverse_sequence.cpp)."""
+    t = x.shape[seq_axis]
+    idx = jnp.arange(t)
+
+    def per_row(row, n):
+        rev = jnp.where(idx < n, n - 1 - idx, idx)
+        return jnp.take(row, rev, axis=seq_axis - 1 if seq_axis > batch_axis
+                        else seq_axis)
+
+    return jax.vmap(per_row, in_axes=(batch_axis, 0),
+                    out_axes=batch_axis)(x, seq_lengths)
+
+
+# -- pad ---------------------------------------------------------------
+@register_op("mirror_pad")
+def mirror_pad(x, paddings, reflect=True):
+    return jnp.pad(x, paddings, mode="reflect" if reflect else "symmetric")
+
+
+# -- gather / scatter --------------------------------------------------
+@register_op("take_along_axis")
+def take_along_axis(x, indices, axis):
+    return jnp.take_along_axis(x, indices, axis=axis)
+
+
+@register_op("scatter_sub")
+def scatter_sub(ref, indices, updates):
+    return ref.at[indices].add(-updates)
+
+
+@register_op("scatter_mul")
+def scatter_mul(ref, indices, updates):
+    return ref.at[indices].multiply(updates)
+
+
+@register_op("scatter_div")
+def scatter_div(ref, indices, updates):
+    return ref.at[indices].divide(updates)
+
+
+@register_op("scatter_max")
+def scatter_max(ref, indices, updates):
+    return ref.at[indices].max(updates)
+
+
+@register_op("scatter_min")
+def scatter_min(ref, indices, updates):
+    return ref.at[indices].min(updates)
+
+
+@register_op("scatter_nd")
+def scatter_nd(indices, updates, shape):
+    """Build zeros(shape) scattered with updates (reference:
+    scatter_nd.cpp)."""
+    zeros = jnp.zeros(shape, updates.dtype)
+    idx = tuple(jnp.moveaxis(indices, -1, 0))
+    return zeros.at[idx].add(updates)
+
+
+# -- slicing -----------------------------------------------------------
+@register_op("dynamic_update_slice")
+def dynamic_update_slice(x, update, start_indices):
+    return lax.dynamic_update_slice(x, update, start_indices)
+
+
+# -- construction ------------------------------------------------------
+@register_op("fill")
+def fill(shape, value, dtype=None):
+    return jnp.full(shape, value, dtype)
+
+
+@register_op("meshgrid")
+def meshgrid(*arrays, indexing="xy"):
+    return jnp.meshgrid(*arrays, indexing=indexing)
+
+
+@register_op("diag_part")
+def diag_part(x):
+    return jnp.diagonal(x, axis1=-2, axis2=-1)
+
+
+@register_op("matrix_diag")
+def matrix_diag(x):
+    return jnp.zeros(x.shape + (x.shape[-1],), x.dtype) \
+        .at[..., jnp.arange(x.shape[-1]), jnp.arange(x.shape[-1])].set(x)
+
+
+@register_op("matrix_set_diag")
+def matrix_set_diag(x, diagonal):
+    n = min(x.shape[-2], x.shape[-1])
+    i = jnp.arange(n)
+    return x.at[..., i, i].set(diagonal[..., :n])
+
+
+# -- data movement / layout --------------------------------------------
+@register_op("space_to_depth")
+def space_to_depth(x, block_size):
+    """NHWC [N,H,W,C] -> [N,H/b,W/b,C*b*b]."""
+    n, h, w, c = x.shape
+    b = block_size
+    x = x.reshape(n, h // b, b, w // b, b, c)
+    return x.transpose(0, 1, 3, 2, 4, 5).reshape(n, h // b, w // b,
+                                                 c * b * b)
+
+
+@register_op("depth_to_space")
+def depth_to_space(x, block_size):
+    n, h, w, c = x.shape
+    b = block_size
+    x = x.reshape(n, h, w, b, b, c // (b * b))
+    return x.transpose(0, 1, 3, 2, 4, 5).reshape(n, h * b, w * b,
+                                                 c // (b * b))
+
+
+@register_op("batch_to_space")
+def batch_to_space(x, block_shape, crops):
+    b0, b1 = block_shape
+    n, h, w, c = x.shape
+    r = x.reshape(b0, b1, n // (b0 * b1), h, w, c)
+    r = r.transpose(2, 3, 0, 4, 1, 5).reshape(n // (b0 * b1), h * b0,
+                                              w * b1, c)
+    (ct0, cb0), (ct1, cb1) = crops
+    return r[:, ct0:h * b0 - cb0, ct1:w * b1 - cb1, :]
+
+
+@register_op("space_to_batch")
+def space_to_batch(x, block_shape, paddings):
+    b0, b1 = block_shape
+    (pt0, pb0), (pt1, pb1) = paddings
+    x = jnp.pad(x, ((0, 0), (pt0, pb0), (pt1, pb1), (0, 0)))
+    n, h, w, c = x.shape
+    x = x.reshape(n, h // b0, b0, w // b1, b1, c)
+    return x.transpose(2, 4, 0, 1, 3, 5).reshape(n * b0 * b1, h // b0,
+                                                 w // b1, c)
+
+
+# -- selection ---------------------------------------------------------
+@register_op("select")
+def select(condition, x, y):
+    return jnp.where(condition, x, y)
+
+
+@register_op("masked_fill")
+def masked_fill(x, mask, value):
+    return jnp.where(mask.astype(bool), jnp.asarray(value, x.dtype), x)
+
+
+@register_op("compress")
+def compress(x, mask, size, axis=0, fill_value=0):
+    """Static-size boolean selection: first `size` kept slices along
+    `axis`, surplus slots filled with fill_value (XLA formulation of
+    data-dependent-shape `compress`)."""
+    idx = jnp.nonzero(mask, size=size, fill_value=x.shape[axis])[0]
+    pad_shape = list(x.shape)
+    pad_shape[axis] = 1
+    padded = jnp.concatenate(
+        [x, jnp.full(pad_shape, fill_value, x.dtype)], axis=axis)
+    return jnp.take(padded, idx, axis=axis)
+
+
+@register_op("unique_with_counts")
+def unique_with_counts(x, size):
+    """Static-size unique (XLA-safe): returns (values, counts) with
+    `size` slots, surplus filled with the max value."""
+    vals, counts = jnp.unique(x, size=size, return_counts=True,
+                              fill_value=jnp.max(x))
+    return vals, counts
