@@ -1,6 +1,6 @@
 from deeplearning4j_tpu.nn.graph.vertices import (
-    ElementWiseVertex, GraphVertex, LayerVertex, MergeVertex, ScaleVertex,
-    SubsetVertex, PreprocessorVertex,
+    ElementWiseVertex, GraphVertex, L2NormalizeVertex, LayerVertex,
+    MergeVertex, ScaleVertex, SubsetVertex, PreprocessorVertex,
 )
 from deeplearning4j_tpu.nn.graph.config import ComputationGraphConfiguration
 from deeplearning4j_tpu.nn.graph.graph import ComputationGraph
@@ -8,5 +8,5 @@ from deeplearning4j_tpu.nn.graph.graph import ComputationGraph
 __all__ = [
     "ComputationGraph", "ComputationGraphConfiguration", "GraphVertex",
     "LayerVertex", "MergeVertex", "ElementWiseVertex", "ScaleVertex",
-    "SubsetVertex", "PreprocessorVertex",
+    "SubsetVertex", "PreprocessorVertex", "L2NormalizeVertex",
 ]

@@ -112,6 +112,24 @@ class ScaleVertex(GraphVertex):
 
 @serializable
 @dataclasses.dataclass
+class L2NormalizeVertex(GraphVertex):
+    """Unit-normalize each example (reference:
+    graph/vertex/impl/L2NormalizeVertex — the FaceNet embedding head).
+    Like the reference, rank>2 inputs normalize over ALL non-batch
+    dimensions jointly, not just the channel axis."""
+
+    eps: float = 1e-10
+
+    def apply(self, params, state, inputs, train, rng):
+        x = inputs[0]
+        axes = tuple(range(1, x.ndim))
+        n = jnp.sqrt(jnp.maximum(
+            jnp.sum(x * x, axis=axes, keepdims=True), self.eps))
+        return x / n, state
+
+
+@serializable
+@dataclasses.dataclass
 class SubsetVertex(GraphVertex):
     """Feature-axis slice [from, to] inclusive (reference: SubsetVertex)."""
 

@@ -18,6 +18,13 @@ from deeplearning4j_tpu.zoo.tinyyolo import TinyYOLO
 from deeplearning4j_tpu.zoo.darknet19 import Darknet19
 from deeplearning4j_tpu.zoo.squeezenet import SqueezeNet
 from deeplearning4j_tpu.zoo.textgen_lstm import TextGenerationLSTM
+from deeplearning4j_tpu.zoo.vgg19 import VGG19
+from deeplearning4j_tpu.zoo.xception import Xception
+from deeplearning4j_tpu.zoo.inception_resnet import (
+    FaceNetNN4Small2, InceptionResNetV1,
+)
 
-__all__ = ["LeNet", "AlexNet", "VGG16", "ResNet50", "SimpleCNN", "UNet",
-           "TinyYOLO", "Darknet19", "SqueezeNet", "TextGenerationLSTM"]
+__all__ = ["LeNet", "AlexNet", "VGG16", "VGG19", "ResNet50", "SimpleCNN",
+           "UNet", "TinyYOLO", "Darknet19", "SqueezeNet",
+           "TextGenerationLSTM", "Xception", "InceptionResNetV1",
+           "FaceNetNN4Small2"]

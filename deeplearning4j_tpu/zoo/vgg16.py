@@ -12,6 +12,9 @@ from deeplearning4j_tpu.zoo.base import ZooModel
 
 
 class VGG16(ZooModel):
+    # conv-stage plan [(width, repeats), ...]; VGG19 overrides this
+    plan = [(64, 2), (128, 2), (256, 3), (512, 3), (512, 3)]
+
     def __init__(self, num_classes: int = 1000, seed: int = 42,
                  updater=None, in_shape=(224, 224, 3)):
         self.num_classes = num_classes
@@ -24,8 +27,7 @@ class VGG16(ZooModel):
         b = (NeuralNetConfiguration.builder()
              .seed(self.seed).updater(self.updater).weightInit("relu")
              .list())
-        plan = [(64, 2), (128, 2), (256, 3), (512, 3), (512, 3)]
-        for n_out, reps in plan:
+        for n_out, reps in self.plan:
             for _ in range(reps):
                 b.layer(ConvolutionLayer(n_out=n_out, kernel_size=(3, 3),
                                          convolution_mode="Same",

@@ -1,0 +1,10 @@
+"""VGG19 (reference: zoo/model/VGG19.java — VGG16 with 4-conv stages in
+the last three blocks; everything else shared)."""
+
+from __future__ import annotations
+
+from deeplearning4j_tpu.zoo.vgg16 import VGG16
+
+
+class VGG19(VGG16):
+    plan = [(64, 2), (128, 2), (256, 4), (512, 4), (512, 4)]

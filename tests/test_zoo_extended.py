@@ -135,3 +135,45 @@ class TestTextGenerationLSTM:
         probs = net.rnnTimeStep(x[:, 0]).toNumpy()
         assert probs.shape == (4, 8)
         np.testing.assert_allclose(probs.sum(-1), 1.0, rtol=1e-5)
+
+
+class TestZooBreadthRound2:
+    """VGG19 / Xception / InceptionResNetV1 / FaceNetNN4Small2 — build,
+    forward on a tiny batch, output shapes + finiteness (the same smoke
+    contract the reference's TestModels uses)."""
+
+    def test_vgg19_builds_and_runs(self):
+        from deeplearning4j_tpu.zoo import VGG19
+        net = VGG19(num_classes=10, in_shape=(32, 32, 3)).init()
+        out = net.output(np.zeros((2, 32, 32, 3), np.float32)).toNumpy()
+        assert out.shape == (2, 10)
+        np.testing.assert_allclose(out.sum(1), 1.0, rtol=1e-4)
+
+    def test_xception_builds_and_runs(self):
+        from deeplearning4j_tpu.zoo import Xception
+        net = Xception(num_classes=7, in_shape=(71, 71, 3),
+                       middle_blocks=1).init()
+        out = net.outputSingle(np.zeros((1, 71, 71, 3), np.float32)).toNumpy()
+        assert out.shape == (1, 7)
+        assert np.isfinite(out).all()
+
+    def test_inception_resnet_v1_embeddings_unit_norm(self):
+        from deeplearning4j_tpu.zoo.inception_resnet import (
+            InceptionResNetV1,
+        )
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        m = InceptionResNetV1(num_classes=5, in_shape=(96, 96, 3),
+                              blocks35=1, blocks17=1, blocks8=1)
+        net = ComputationGraph(m.conf(classifier=False)).init()
+        emb = net.outputSingle(np.random.RandomState(0)
+                               .rand(2, 96, 96, 3).astype(np.float32)).toNumpy()
+        assert emb.shape == (2, 128)
+        np.testing.assert_allclose(np.linalg.norm(emb, axis=1), 1.0,
+                                   rtol=1e-4)
+
+    def test_facenet_small_classifier(self):
+        from deeplearning4j_tpu.zoo import FaceNetNN4Small2
+        net = FaceNetNN4Small2(num_classes=4, in_shape=(96, 96, 3)).init()
+        out = net.outputSingle(np.zeros((1, 96, 96, 3), np.float32)).toNumpy()
+        assert out.shape == (1, 4)
+        np.testing.assert_allclose(out.sum(1), 1.0, rtol=1e-4)
