@@ -11,13 +11,16 @@ reported against the self-baseline recorded in BENCH_BASELINE.json at
 the repo root (first run writes it; later runs compare), since no
 reference number exists to compare against.
 
-Methodology notes (v2 — supersedes the first recorded baseline):
+Methodology notes (v3 — supersedes v2; the baseline key is bumped
+whenever the WORKLOAD changes so vs_baseline never reports a workload
+tweak as a code speedup. v2->v3: batch 128->96, measured ~6% faster on
+the v5e chip in repeated A/B — better VMEM/HBM working-set fit):
 - SYNC: on the axon-tunneled TPU, jax.block_until_ready returns before
   device work completes, so v1 numbers measured dispatch rate (~20x
   optimistic). Every timing window now ends with a device->host
   transfer of the loss (float()), which cannot complete early.
 - Best-of-3 windows (the shared chip shows ~10% run-to-run noise).
-- Workload: batch 128 x seq 128, dropout 0.1 (real pretraining step),
+- Workload: batch 96 x seq 128, dropout 0.1 (real pretraining step),
   exactly 19 masked positions/row with masked_capacity=20 — the MLM
   head projects only masked positions to the 30522-wide vocab (same
   loss value as the full projection, ~6x fewer head FLOPs).
@@ -50,7 +53,10 @@ def main() -> None:
     on_accel = platform in ("tpu", "gpu")
     if on_accel:
         cfg = bert_base()
-        batch, seqlen, steps = 128, 128, 20
+        # batch 96 measures ~6% faster than 128 on the v5e chip (repeated
+        # A/B: 202-205k vs 188-191k tokens/s) — better fit to VMEM/HBM
+        # working set at this d_model; swept 64/96/128/256
+        batch, seqlen, steps = 96, 128, 20
     else:
         # CPU fallback so the bench always produces a line
         cfg = tiny_config(vocab=1024, max_len=128, d_model=128, n_layers=2,
@@ -97,7 +103,7 @@ def main() -> None:
         if os.path.exists(base_path):
             with open(base_path) as f:
                 base = json.load(f)
-        key = f"{platform}_v2"  # v2 methodology: honest sync (see docstring)
+        key = f"{platform}_v3"  # methodology version — see docstring
         if key in base and base[key].get("value"):
             vs_baseline = tokens_per_sec / float(base[key]["value"])
         else:
