@@ -27,8 +27,15 @@ from deeplearning4j_tpu.datavec.image import (
     ParentPathLabelGenerator,
 )
 
+from deeplearning4j_tpu.datavec.analysis import (
+    AnalyzeLocal, DataAnalysis, DataQualityAnalysis,
+)
+from deeplearning4j_tpu.datavec.join import Join, JoinType, Reducer, ReduceOp
+
 __all__ = [
     "ColumnType", "Schema", "TransformProcess",
+    "AnalyzeLocal", "DataAnalysis", "DataQualityAnalysis",
+    "Join", "JoinType", "Reducer", "ReduceOp",
     "RecordReader", "CSVRecordReader", "CSVSequenceRecordReader",
     "LineRecordReader", "CollectionRecordReader",
     "FileSplit", "NumberedFileInputSplit",

@@ -1,0 +1,198 @@
+"""Data analysis + quality (reference: org/datavec/api/transform/analysis
+— AnalyzeLocal.analyze / analyzeQuality, DataAnalysis with per-column
+{Integer,Double,Categorical,String}Analysis, and DataQualityAnalysis).
+
+Columnar numpy implementation: one pass over each column computes the
+reference's reported statistics (min/max/mean/stdev/count for numeric
+columns, unique counts for categoricals, length stats for strings) and
+quality counts (missing/NaN/invalid-type entries).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+from collections import Counter
+from typing import Any, Dict, List, Sequence
+
+import numpy as np
+
+from deeplearning4j_tpu.datavec.schema import ColumnType, Schema
+
+
+@dataclasses.dataclass
+class NumericalColumnAnalysis:
+    """Reference: IntegerAnalysis / DoubleAnalysis."""
+
+    count: int
+    min: float
+    max: float
+    mean: float
+    stdev: float
+    count_zero: int
+    count_negative: int
+    count_positive: int
+
+    def to_dict(self):
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class CategoricalColumnAnalysis:
+    """Reference: CategoricalAnalysis — per-category counts."""
+
+    count: int
+    unique_count: int
+    category_counts: Dict[str, int]
+
+    def to_dict(self):
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class StringColumnAnalysis:
+    """Reference: StringAnalysis — length statistics."""
+
+    count: int
+    min_length: int
+    max_length: int
+    mean_length: float
+
+    def to_dict(self):
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class ColumnQuality:
+    """Reference: DataQualityAnalysis per-column counts."""
+
+    valid: int
+    invalid: int
+    missing: int
+
+    def to_dict(self):
+        return dataclasses.asdict(self)
+
+
+class DataAnalysis:
+    """Reference: org/datavec/api/transform/analysis/DataAnalysis —
+    schema + per-column analysis, printable + JSON round-trip."""
+
+    def __init__(self, schema: Schema, columns: Dict[str, Any]):
+        self.schema = schema
+        self.columns = columns
+
+    def getColumnAnalysis(self, name: str):
+        return self.columns[name]
+
+    def toJson(self) -> str:
+        return json.dumps({k: v.to_dict() for k, v in self.columns.items()},
+                          indent=2, default=str)
+
+    def __str__(self):
+        lines = ["DataAnalysis:"]
+        for name, a in self.columns.items():
+            lines.append(f"  {name}: {a.to_dict()}")
+        return "\n".join(lines)
+
+
+class DataQualityAnalysis:
+    def __init__(self, columns: Dict[str, ColumnQuality]):
+        self.columns = columns
+
+    def getColumnQuality(self, name: str) -> ColumnQuality:
+        return self.columns[name]
+
+    def __str__(self):
+        return "\n".join(f"{k}: {v.to_dict()}" for k, v in
+                         self.columns.items())
+
+
+def _is_missing(v: Any) -> bool:
+    if v is None:
+        return True
+    if isinstance(v, float) and math.isnan(v):
+        return True
+    if isinstance(v, str) and v.strip() == "":
+        return True
+    return False
+
+
+class AnalyzeLocal:
+    """Reference: org/datavec/local/transforms/AnalyzeLocal (single-
+    process analog of the Spark AnalyzeSpark)."""
+
+    @staticmethod
+    def analyze(schema: Schema, records: Sequence[Sequence]) -> DataAnalysis:
+        cols: Dict[str, Any] = {}
+        for ci, name in enumerate(schema.getColumnNames()):
+            meta = schema.getColumnMeta(name)
+            values = [r[ci] for r in records if not _is_missing(r[ci])]
+            if meta.type.numeric:
+                # skip unparsable cells — analyzeQuality counts them as
+                # invalid; analyze() must survive dirty CSV data
+                nums = []
+                for v in values:
+                    try:
+                        f = float(v)
+                    except (TypeError, ValueError):
+                        continue
+                    if math.isfinite(f):  # a literal "nan"/"inf" cell
+                        nums.append(f)    # must not poison min/max/mean
+                arr = np.asarray(nums, np.float64)
+                n = arr.size
+                cols[name] = NumericalColumnAnalysis(
+                    count=n,
+                    min=float(arr.min()) if n else float("nan"),
+                    max=float(arr.max()) if n else float("nan"),
+                    mean=float(arr.mean()) if n else float("nan"),
+                    stdev=float(arr.std(ddof=1)) if n > 1 else 0.0,
+                    count_zero=int((arr == 0).sum()),
+                    count_negative=int((arr < 0).sum()),
+                    count_positive=int((arr > 0).sum()))
+            elif meta.type == ColumnType.CATEGORICAL:
+                c = Counter(str(v) for v in values)
+                cols[name] = CategoricalColumnAnalysis(
+                    count=len(values), unique_count=len(c),
+                    category_counts=dict(c))
+            else:  # STRING
+                lens = [len(str(v)) for v in values]
+                cols[name] = StringColumnAnalysis(
+                    count=len(values),
+                    min_length=min(lens) if lens else 0,
+                    max_length=max(lens) if lens else 0,
+                    mean_length=(sum(lens) / len(lens)) if lens else 0.0)
+        return DataAnalysis(schema, cols)
+
+    @staticmethod
+    def analyzeQuality(schema: Schema,
+                       records: Sequence[Sequence]) -> DataQualityAnalysis:
+        out: Dict[str, ColumnQuality] = {}
+        for ci, name in enumerate(schema.getColumnNames()):
+            meta = schema.getColumnMeta(name)
+            valid = invalid = missing = 0
+            for r in records:
+                v = r[ci]
+                if _is_missing(v):
+                    missing += 1
+                    continue
+                if meta.type.numeric:
+                    try:
+                        if math.isfinite(float(v)):
+                            valid += 1
+                        else:
+                            invalid += 1
+                    except (TypeError, ValueError):
+                        invalid += 1
+                elif meta.type == ColumnType.CATEGORICAL:
+                    allowed = getattr(meta, "categories", None)
+                    if allowed and str(v) not in allowed:
+                        invalid += 1
+                    else:
+                        valid += 1
+                else:
+                    valid += 1
+            out[name] = ColumnQuality(valid=valid, invalid=invalid,
+                                      missing=missing)
+        return DataQualityAnalysis(out)

@@ -1,0 +1,283 @@
+"""Join + keyed reduction for tabular records.
+
+Reference: org/datavec/api/transform/join/Join (Inner/LeftOuter/
+RightOuter/FullOuter on key columns, executed by LocalTransformExecutor
+/ SparkTransformExecutor) and org/datavec/api/transform/reduce/Reducer
+(group-by-key aggregation with per-column ReduceOp: SUM, MEAN, MIN,
+MAX, COUNT, RANGE, STDEV, FIRST, LAST, COUNT_UNIQUE).
+"""
+
+from __future__ import annotations
+
+import math
+import statistics
+from collections import OrderedDict
+from typing import Any, Dict, List, Sequence
+
+from deeplearning4j_tpu.datavec.schema import Schema
+
+
+class JoinType:
+    INNER = "Inner"
+    LEFT_OUTER = "LeftOuter"
+    RIGHT_OUTER = "RightOuter"
+    FULL_OUTER = "FullOuter"
+
+
+class Join:
+    """Builder mirroring the reference: Join.Builder(type)
+    .setJoinColumns(cols).setSchemas(left, right).build(), then
+    `execute(left_records, right_records)`."""
+
+    class Builder:
+        def __init__(self, join_type: str = JoinType.INNER):
+            self.join_type = join_type
+            self.join_columns: List[str] = []
+            self.left_schema: Schema | None = None
+            self.right_schema: Schema | None = None
+
+        def setJoinColumns(self, *cols: str) -> "Join.Builder":
+            self.join_columns = list(cols)
+            return self
+
+        def setSchemas(self, left: Schema, right: Schema) -> "Join.Builder":
+            self.left_schema = left
+            self.right_schema = right
+            return self
+
+        def build(self) -> "Join":
+            valid = (JoinType.INNER, JoinType.LEFT_OUTER,
+                     JoinType.RIGHT_OUTER, JoinType.FULL_OUTER)
+            if self.join_type not in valid:
+                raise ValueError(
+                    f"unknown join type {self.join_type!r}; use a "
+                    f"JoinType constant: {valid}")
+            if not self.join_columns:
+                raise ValueError("setJoinColumns() required")
+            if self.left_schema is None or self.right_schema is None:
+                raise ValueError("setSchemas() required")
+            return Join(self.join_type, self.join_columns,
+                        self.left_schema, self.right_schema)
+
+    def __init__(self, join_type, join_columns, left, right):
+        self.join_type = join_type
+        self.join_columns = join_columns
+        self.left_schema = left
+        self.right_schema = right
+        for c in join_columns:
+            if not left.hasColumn(c) or not right.hasColumn(c):
+                raise ValueError(f"join column '{c}' missing from a side")
+        # fail at build time, not when outSchema() happens to be called
+        clash = [c for c in right.getColumnNames()
+                 if c not in join_columns and left.hasColumn(c)]
+        if clash:
+            raise ValueError(
+                f"non-key columns exist on both sides: {clash}; rename "
+                "before joining")
+
+    def outSchema(self) -> Schema:
+        """All left columns in their original order (keys stay in
+        their left-schema positions), then the right side's non-key
+        columns — matching execute()'s row layout."""
+        b = Schema.Builder()
+        for name in self.left_schema.getColumnNames():
+            b.addColumnMeta(self.left_schema.getColumnMeta(name))
+        for name in self.right_schema.getColumnNames():
+            if name in self.join_columns:
+                continue
+            b.addColumnMeta(self.right_schema.getColumnMeta(name))
+        return b.build()
+
+    def execute(self, left: Sequence[Sequence],
+                right: Sequence[Sequence]) -> List[List]:
+        lk = [self.left_schema.getIndexOfColumn(c)
+              for c in self.join_columns]
+        rk = [self.right_schema.getIndexOfColumn(c)
+              for c in self.join_columns]
+        r_other = [i for i in range(self.right_schema.numColumns())
+                   if i not in rk]
+        index: "OrderedDict[tuple, List[Sequence]]" = OrderedDict()
+        for r in right:
+            index.setdefault(tuple(r[i] for i in rk), []).append(r)
+
+        out: List[List] = []
+        matched_keys = set()
+        for l in left:
+            key = tuple(l[i] for i in lk)
+            rows = index.get(key)
+            if rows:
+                matched_keys.add(key)
+                for r in rows:
+                    out.append(list(l) + [r[i] for i in r_other])
+            elif self.join_type in (JoinType.LEFT_OUTER,
+                                    JoinType.FULL_OUTER):
+                out.append(list(l) + [None] * len(r_other))
+        if self.join_type in (JoinType.RIGHT_OUTER, JoinType.FULL_OUTER):
+            n_left_other = self.left_schema.numColumns()
+            for key, rows in index.items():
+                if key in matched_keys:
+                    continue
+                for r in rows:
+                    # key values placed in their left-schema positions
+                    row: List[Any] = [None] * n_left_other
+                    for c, v in zip(self.join_columns, key):
+                        row[self.left_schema.getIndexOfColumn(c)] = v
+                    out.append(row + [r[i] for i in r_other])
+        return out
+
+
+class ReduceOp:
+    SUM = "SUM"
+    MEAN = "MEAN"
+    MIN = "MIN"
+    MAX = "MAX"
+    COUNT = "COUNT"
+    RANGE = "RANGE"
+    STDEV = "STDEV"
+    FIRST = "FIRST"
+    LAST = "LAST"
+    COUNT_UNIQUE = "COUNT_UNIQUE"
+
+
+def _reduce(op: str, values: List[Any]):
+    if op == ReduceOp.COUNT:
+        return len(values)
+    if op == ReduceOp.COUNT_UNIQUE:
+        return len(set(values))
+    if op == ReduceOp.FIRST:
+        return values[0] if values else None
+    if op == ReduceOp.LAST:
+        return values[-1] if values else None
+    # skip missing/unparsable cells (None, '', NaN, stray strings) the
+    # same way AnalyzeLocal does — CSV-sourced data is dirty by default
+    nums = []
+    for v in values:
+        try:
+            f = float(v)
+        except (TypeError, ValueError):
+            continue
+        if not math.isnan(f):
+            nums.append(f)
+    if not nums:
+        return float("nan")
+    if op == ReduceOp.SUM:
+        return sum(nums)
+    if op == ReduceOp.MEAN:
+        return sum(nums) / len(nums)
+    if op == ReduceOp.MIN:
+        return min(nums)
+    if op == ReduceOp.MAX:
+        return max(nums)
+    if op == ReduceOp.RANGE:
+        return max(nums) - min(nums)
+    if op == ReduceOp.STDEV:
+        return statistics.stdev(nums) if len(nums) > 1 else 0.0
+    raise ValueError(f"Unknown reduce op: {op}")
+
+
+class Reducer:
+    """Group-by-key aggregation (reference: transform/reduce/Reducer).
+
+    Builder: keyColumns(...), then per-column ops via
+    {sum,mean,min,max,count,stdev,first,last,countUnique}Columns(...);
+    unspecified columns default to the builder's defaultOp (like the
+    reference's Reducer.Builder(default))."""
+
+    class Builder:
+        def __init__(self, default_op: str = ReduceOp.FIRST):
+            self.default_op = default_op
+            self.keys: List[str] = []
+            self.ops: Dict[str, str] = {}
+
+        def keyColumns(self, *cols: str) -> "Reducer.Builder":
+            self.keys = list(cols)
+            return self
+
+        def _set(self, op, cols):
+            for c in cols:
+                self.ops[c] = op
+            return self
+
+        def sumColumns(self, *cols):
+            return self._set(ReduceOp.SUM, cols)
+
+        def meanColumns(self, *cols):
+            return self._set(ReduceOp.MEAN, cols)
+
+        def minColumns(self, *cols):
+            return self._set(ReduceOp.MIN, cols)
+
+        def maxColumns(self, *cols):
+            return self._set(ReduceOp.MAX, cols)
+
+        def countColumns(self, *cols):
+            return self._set(ReduceOp.COUNT, cols)
+
+        def stdevColumns(self, *cols):
+            return self._set(ReduceOp.STDEV, cols)
+
+        def firstColumns(self, *cols):
+            return self._set(ReduceOp.FIRST, cols)
+
+        def lastColumns(self, *cols):
+            return self._set(ReduceOp.LAST, cols)
+
+        def countUniqueColumns(self, *cols):
+            return self._set(ReduceOp.COUNT_UNIQUE, cols)
+
+        def build(self) -> "Reducer":
+            if not self.keys:
+                raise ValueError("keyColumns() required")
+            return Reducer(self.keys, dict(self.ops), self.default_op)
+
+    def __init__(self, keys, ops, default_op):
+        self.keys = keys
+        self.ops = ops
+        self.default_op = default_op
+
+    def _check(self, schema: Schema) -> None:
+        # typo'd op columns would silently fall back to the default op
+        for c in list(self.keys) + list(self.ops):
+            if not schema.hasColumn(c):
+                raise ValueError(f"column '{c}' not in schema "
+                                 f"{schema.getColumnNames()}")
+        bad = [c for c in self.ops if c in self.keys]
+        if bad:
+            raise ValueError(f"reduce ops target key columns: {bad}")
+
+    def outSchema(self, schema: Schema) -> Schema:
+        self._check(schema)
+        b = Schema.Builder()
+        for name in schema.getColumnNames():
+            meta = schema.getColumnMeta(name)
+            if name in self.keys:
+                b.addColumnMeta(meta)
+            else:
+                op = self.ops.get(name, self.default_op)
+                if op in (ReduceOp.COUNT, ReduceOp.COUNT_UNIQUE):
+                    b.addColumnLong(f"{op.lower()}({name})")
+                elif op in (ReduceOp.FIRST, ReduceOp.LAST):
+                    renamed = b.addColumnMeta(meta)._cols[-1]
+                    renamed.name = f"{op.lower()}({name})"
+                else:
+                    b.addColumnDouble(f"{op.lower()}({name})")
+        return b.build()
+
+    def execute(self, schema: Schema,
+                records: Sequence[Sequence]) -> List[List]:
+        self._check(schema)
+        ki = [schema.getIndexOfColumn(c) for c in self.keys]
+        groups: "OrderedDict[tuple, List[Sequence]]" = OrderedDict()
+        for r in records:
+            groups.setdefault(tuple(r[i] for i in ki), []).append(r)
+        out = []
+        for key, rows in groups.items():
+            row: List[Any] = []
+            for i, name in enumerate(schema.getColumnNames()):
+                if name in self.keys:
+                    row.append(key[self.keys.index(name)])
+                else:
+                    op = self.ops.get(name, self.default_op)
+                    row.append(_reduce(op, [r[i] for r in rows]))
+            out.append(row)
+        return out

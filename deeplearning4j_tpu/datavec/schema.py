@@ -106,6 +106,12 @@ class Schema:
         def __init__(self):
             self._cols: List[_ColumnMeta] = []
 
+        def addColumnMeta(self, meta: "_ColumnMeta") -> "Schema.Builder":
+            """Append a COPY of an existing column meta (never aliases
+            the source schema's mutable metadata)."""
+            self._cols.append(_ColumnMeta.from_dict(meta.to_dict()))
+            return self
+
         def addColumnInteger(self, name: str, min_value=None, max_value=None):
             self._cols.append(_ColumnMeta(name, ColumnType.INTEGER,
                                           None, min_value, max_value))

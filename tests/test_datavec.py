@@ -372,3 +372,108 @@ class TestAsyncIterator:
         with pytest.raises(RuntimeError, match="boom"):
             while it.hasNext():
                 it.next()
+
+
+class TestAnalysis:
+    """Reference: AnalyzeLocal.analyze/analyzeQuality."""
+
+    def _schema(self):
+        return (Schema.Builder()
+                .addColumnDouble("x")
+                .addColumnCategorical("cat", "a", "b")
+                .addColumnString("s")
+                .build())
+
+    def test_analyze_statistics(self):
+        from deeplearning4j_tpu.datavec import AnalyzeLocal
+        recs = [[1.0, "a", "hi"], [2.0, "b", "worlds"], [3.0, "a", "x"],
+                [-4.0, "a", "yo"]]
+        da = AnalyzeLocal.analyze(self._schema(), recs)
+        xa = da.getColumnAnalysis("x")
+        assert xa.count == 4 and xa.min == -4.0 and xa.max == 3.0
+        assert abs(xa.mean - 0.5) < 1e-9
+        assert xa.count_negative == 1 and xa.count_positive == 3
+        ca = da.getColumnAnalysis("cat")
+        assert ca.unique_count == 2 and ca.category_counts["a"] == 3
+        sa = da.getColumnAnalysis("s")
+        assert sa.min_length == 1 and sa.max_length == 6
+        assert "DataAnalysis" in str(da) and da.toJson()
+
+    def test_quality(self):
+        from deeplearning4j_tpu.datavec import AnalyzeLocal
+        recs = [[1.0, "a", "hi"], [None, "zzz", ""], [float("nan"), "b", "y"]]
+        dq = AnalyzeLocal.analyzeQuality(self._schema(), recs)
+        assert dq.getColumnQuality("x").missing == 2
+        assert dq.getColumnQuality("x").valid == 1
+        assert dq.getColumnQuality("cat").invalid == 1
+        assert dq.getColumnQuality("s").missing == 1
+
+
+class TestJoinReduce:
+    def test_inner_and_outer_joins(self):
+        from deeplearning4j_tpu.datavec import Join, JoinType
+        left_s = (Schema.Builder().addColumnInteger("id")
+                  .addColumnString("name").build())
+        right_s = (Schema.Builder().addColumnInteger("id")
+                   .addColumnDouble("score").build())
+        left = [[1, "a"], [2, "b"], [3, "c"]]
+        right = [[1, 0.5], [1, 0.7], [4, 0.9]]
+        inner = (Join.Builder(JoinType.INNER)
+                 .setJoinColumns("id").setSchemas(left_s, right_s)
+                 .build())
+        out = inner.execute(left, right)
+        assert out == [[1, "a", 0.5], [1, "a", 0.7]]
+        assert inner.outSchema().getColumnNames() == ["id", "name", "score"]
+        louter = (Join.Builder(JoinType.LEFT_OUTER)
+                  .setJoinColumns("id").setSchemas(left_s, right_s)
+                  .build()).execute(left, right)
+        assert [1, "a", 0.5] in louter and [2, "b", None] in louter
+        fouter = (Join.Builder(JoinType.FULL_OUTER)
+                  .setJoinColumns("id").setSchemas(left_s, right_s)
+                  .build()).execute(left, right)
+        assert [4, None, 0.9] in fouter and len(fouter) == 5
+
+    def test_reducer_group_by(self):
+        from deeplearning4j_tpu.datavec import Reducer
+        s = (Schema.Builder().addColumnCategorical("k", "p", "q")
+             .addColumnDouble("v").addColumnInteger("n").build())
+        recs = [["p", 1.0, 10], ["q", 2.0, 20], ["p", 3.0, 30]]
+        red = (Reducer.Builder()
+               .keyColumns("k").sumColumns("v").countColumns("n")
+               .build())
+        out = red.execute(s, recs)
+        assert out == [["p", 4.0, 2], ["q", 2.0, 1]]
+        names = red.outSchema(s).getColumnNames()
+        assert names == ["k", "sum(v)", "count(n)"]
+
+    def test_join_rejects_colliding_nonkey_columns_at_build(self):
+        from deeplearning4j_tpu.datavec import Join, JoinType
+        import pytest
+        ls = (Schema.Builder().addColumnInteger("id")
+              .addColumnString("name").build())
+        rs = (Schema.Builder().addColumnInteger("id")
+              .addColumnString("name").build())
+        with pytest.raises(ValueError, match="both sides"):
+            (Join.Builder(JoinType.INNER)
+             .setJoinColumns("id").setSchemas(ls, rs).build())
+
+    def test_outschema_does_not_alias_input_metas(self):
+        from deeplearning4j_tpu.datavec import Join, JoinType
+        ls = (Schema.Builder().addColumnInteger("id")
+              .addColumnString("nm").build())
+        rs = (Schema.Builder().addColumnInteger("id")
+              .addColumnDouble("v").build())
+        j = (Join.Builder(JoinType.INNER)
+             .setJoinColumns("id").setSchemas(ls, rs).build())
+        out = j.outSchema()
+        out.getColumnMeta("id").name = "MUTATED"
+        assert ls.getColumnNames()[0] == "id"
+
+
+class TestAnalysisDirtyData:
+    def test_analyze_survives_unparsable_numeric(self):
+        from deeplearning4j_tpu.datavec import AnalyzeLocal
+        s = Schema.Builder().addColumnDouble("x").build()
+        da = AnalyzeLocal.analyze(s, [["abc"], [1.0], [3.0]])
+        xa = da.getColumnAnalysis("x")
+        assert xa.count == 2 and xa.mean == 2.0
