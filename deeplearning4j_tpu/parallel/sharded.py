@@ -11,11 +11,14 @@ TPU collectives (and keeping its compression semantics as an option):
   except exact (no threshold) because ICI bandwidth makes compression
   unnecessary intra-slice.
 - 'sharing_compressed': the reference's threshold encoding, faithfully:
-  each shard computes local grads, threshold-encodes (ternary int8),
-  all-reduces the *encoded* tensor, decodes, keeps residual locally
-  (EncodingHandler#broadcastUpdates semantics). Built with shard_map so
-  the collective operates on the compressed representation — the DCN
-  multi-slice path where bandwidth can actually bind.
+  each shard runs its OWN updater on dense local grads, threshold-
+  encodes the resulting UPDATE (ternary int8), all-reduces the *encoded*
+  tensor, decodes, keeps the un-transmitted remainder as a local
+  residual (EncodingHandler#broadcastUpdates semantics — the reference
+  shares updates, not raw gradients). Per-leaf adaptive thresholds
+  (AdaptiveThresholdAlgorithm) track a target encode density. Built
+  with shard_map so the collective operates on the compressed
+  representation — the DCN multi-slice path where bandwidth can bind.
 - 'averaging': the reference's ParameterAveragingTrainingMaster — each
   shard trains independently (params diverge), every
   `averaging_frequency` steps params+updater state are mesh-averaged.
@@ -46,20 +49,100 @@ def _tmap(f, *trees):
     return jax.tree_util.tree_map(f, *trees)
 
 
+class _ModelFuncs:
+    """Uniform seam over the two front-ends: MultiLayerNetwork keeps
+    params as a per-layer LIST, ComputationGraph as a per-vertex DICT —
+    tree_map handles both, but loss signatures and attribute names
+    differ. Single-input/single-output graphs only (the DP trainer
+    shards ONE feature and ONE label array, like the reference's
+    ParallelWrapper)."""
+
+    def __init__(self, model):
+        self.model = model
+        self.is_graph = hasattr(model, "params_map")
+        if self.is_graph:
+            ins = model.conf.network_inputs
+            outs = model.conf.network_outputs
+            if len(ins) != 1 or len(outs) != 1:
+                raise ValueError(
+                    "ShardedTrainer supports single-input/single-output "
+                    f"graphs; got {len(ins)} inputs / {len(outs)} outputs")
+            self._in0, self._out0 = ins[0], outs[0]
+            self.clip = model._clip
+        else:
+            self.clip = model._clip_grads
+
+    @property
+    def updaters(self):
+        # resolved LIVE, not cached: MultiLayerNetwork.init() rebinds
+        # its _updaters list, so a trainer built before init() (or after
+        # re-init) must see the current one
+        return self.model._updaters  # list (MLN) or dict (CG)
+
+    def loss(self, params, states, x, y, rng):
+        if self.is_graph:
+            return self.model._loss(params, states, {self._in0: x},
+                                    {self._out0: y}, rng)
+        return self.model._loss(params, states, x, y, None, rng)
+
+    def keys(self, params):
+        return list(params) if isinstance(params, dict) \
+            else list(range(len(params)))
+
+    def compute_updates(self, params, grads, opt, it_step, ep_step):
+        """(updates, new_opt) per container key — caller applies p-u."""
+        pairs = {}
+        for k in self.keys(params):
+            upd = self.updaters[k]
+            step = ep_step if _uses_epoch_schedule(upd) else it_step
+            pairs[k] = apply_updater(upd, opt[k], grads[k], params[k],
+                                     step)
+        if isinstance(params, dict):
+            return ({k: u for k, (u, _) in pairs.items()},
+                    {k: no for k, (_, no) in pairs.items()})
+        return ([pairs[i][0] for i in range(len(params))],
+                [pairs[i][1] for i in range(len(params))])
+
+    def apply_updates(self, params, grads, opt, it_step, ep_step):
+        updates, new_opt = self.compute_updates(params, grads, opt,
+                                                it_step, ep_step)
+        new_params = _tmap(lambda p, u: p - u, params, updates)
+        return new_params, new_opt
+
+    def get_trees(self):
+        m = self.model
+        if self.is_graph:
+            return m.params_map, m.states_map, m.opt_states
+        return m.params_list, m.states_list, m.opt_states
+
+    def set_trees(self, params, states, opt):
+        m = self.model
+        if self.is_graph:
+            m.params_map, m.states_map, m.opt_states = params, states, opt
+        else:
+            m.params_list, m.states_list, m.opt_states = params, states, opt
+
+
 class ShardedTrainer:
     def __init__(self, model, mesh: Optional[Mesh] = None,
                  mode: str = "sharing",
                  threshold: float = 1e-3,
+                 adaptive_threshold: bool = True,
+                 target_density: float = 1e-2,
                  averaging_frequency: int = 5):
         if mode not in ("sharing", "sharing_compressed", "averaging"):
             raise ValueError(f"Unknown mode: {mode}")
         self.model = model
+        self.mf = _ModelFuncs(model)
         self.mesh = mesh if mesh is not None else build_mesh()
         self.mode = mode
         self.threshold = threshold
+        self.adaptive_threshold = adaptive_threshold
+        self.target_density = target_density
         self.averaging_frequency = averaging_frequency
         self._step = None
         self._residual = None
+        self._thresholds = None
         self._local = None  # per-shard replicas for averaging mode
         self._n_data = self.mesh.shape["data"]
 
@@ -67,10 +150,9 @@ class ShardedTrainer:
     def _place_replicated(self):
         """Replicate model params/opt/state across the mesh."""
         spec = NamedSharding(self.mesh, P())
-        m = self.model
-        m.params_list = _tmap(lambda a: jax.device_put(a, spec), m.params_list)
-        m.states_list = _tmap(lambda a: jax.device_put(a, spec), m.states_list)
-        m.opt_states = _tmap(lambda a: jax.device_put(a, spec), m.opt_states)
+        put = lambda t: _tmap(lambda a: jax.device_put(a, spec), t)
+        p_, s_, o_ = self.mf.get_trees()
+        self.mf.set_trees(put(p_), put(s_), put(o_))
 
     def _shard_batch(self, x, y):
         def spec(a):
@@ -84,20 +166,15 @@ class ShardedTrainer:
     # mode: sharing (GSPMD — compiler-inserted all-reduce)
     # ------------------------------------------------------------------
     def _build_sharing_step(self):
-        model = self.model
+        mf = self.mf
 
         def step_fn(params, states, opt, it_step, ep_step, x, y, rng):
-            loss_fn = lambda pl: model._loss(pl, states, x, y, None, rng)
+            loss_fn = lambda pl: mf.loss(pl, states, x, y, rng)
             (loss, (new_states, data_loss)), grads = \
                 jax.value_and_grad(loss_fn, has_aux=True)(params)
-            grads = model._clip_grads(grads)
-            new_params, new_opt = [], []
-            for i in range(len(params)):
-                step = ep_step if _uses_epoch_schedule(model._updaters[i]) else it_step
-                updates, no = apply_updater(model._updaters[i], opt[i],
-                                            grads[i], params[i], step)
-                new_params.append(_tmap(lambda p, u: p - u, params[i], updates))
-                new_opt.append(no)
+            grads = mf.clip(grads)
+            new_params, new_opt = mf.apply_updates(params, grads, opt,
+                                                   it_step, ep_step)
             return new_params, new_states, new_opt, data_loss
 
         return jax.jit(step_fn, donate_argnums=(0, 1, 2))
@@ -106,96 +183,120 @@ class ShardedTrainer:
     # mode: sharing_compressed (shard_map + threshold encoding)
     # ------------------------------------------------------------------
     def _build_compressed_step(self):
-        model = self.model
+        """Reference semantics (SURVEY.md §3.5): each worker runs its
+        OWN updater on dense local gradients, threshold-encodes the
+        resulting UPDATE (plus carried residual), and the ternary codes
+        are what crosses the wire. Params stay replicated because every
+        shard applies the same decoded mean update; updater state is
+        per-shard (each worker's moments track its local gradients, as
+        in the reference's per-worker trainers). Encoding the raw
+        gradient and feeding the sparse decode through Adam instead
+        diverges: second moments starve between rare spikes."""
+        mf = self.mf
         mesh = self.mesh
-        t = self.threshold
         n = self._n_data
+        adaptive = self.adaptive_threshold
+        density = self.target_density
 
-        def per_device(params, states, opt, residual, it_step, ep_step,
-                       x, y, rng):
+        def per_device(params, states, opt_s, residual_s, thresholds_s,
+                       it_step, ep_step, x, y, rng):
             # decorrelate dropout across shards (reference: each trainer
             # thread has its own RNG stream)
             rng = jax.random.fold_in(rng, jax.lax.axis_index("data"))
-            loss_fn = lambda pl: model._loss(pl, states, x, y, None, rng)
+            # per-shard state arrives stacked on a leading 'data' axis
+            opt = _tmap(lambda a: a[0], opt_s)
+            residual = _tmap(lambda a: a[0], residual_s)
+            thresholds = _tmap(lambda a: a[0], thresholds_s)
+            loss_fn = lambda pl: mf.loss(pl, states, x, y, rng)
             (loss, (new_states, data_loss)), grads = \
                 jax.value_and_grad(loss_fn, has_aux=True)(params)
+            grads = mf.clip(grads)
+            updates, new_opt = mf.compute_updates(params, grads, opt,
+                                                  it_step, ep_step)
 
-            # threshold-encode local grads; all-reduce the ternary code
-            # (int8 -> f32 for the collective), decode; keep residual
-            def enc_dec(g, res):
-                code, new_res = comp.encode_threshold(g + res, t)
+            def enc_dec(u, res, t):
+                code, new_res = comp.encode_threshold(u + res, t)
                 summed = jax.lax.psum(code.astype(jnp.float32), "data")
-                return summed * (t / n), new_res
+                if adaptive:
+                    # pmean keeps the threshold IDENTICAL across
+                    # shards: the summed ternary codes decode with one
+                    # shared t, so shards must never drift apart
+                    new_t = jax.lax.pmean(comp.adaptive_threshold(
+                        u + res, target_sparsity=density,
+                        current_threshold=t), "data")
+                else:
+                    new_t = t
+                return summed * (t / n), new_res, new_t
 
-            flat_g, treedef = jax.tree_util.tree_flatten(grads)
+            flat_u, treedef = jax.tree_util.tree_flatten(updates)
             flat_r = jax.tree_util.tree_leaves(residual)
-            decoded, new_res = [], []
-            for g, r in zip(flat_g, flat_r):
-                d, nr = enc_dec(g, r)
+            flat_t = jax.tree_util.tree_leaves(thresholds)
+            decoded, new_res, new_ts = [], [], []
+            for u, r, t in zip(flat_u, flat_r, flat_t):
+                d, nr, nt = enc_dec(u, r, t)
                 decoded.append(d)
                 new_res.append(nr)
-            grads = jax.tree_util.tree_unflatten(treedef, decoded)
+                new_ts.append(nt)
+            mean_update = jax.tree_util.tree_unflatten(treedef, decoded)
             residual = jax.tree_util.tree_unflatten(treedef, new_res)
+            thresholds = jax.tree_util.tree_unflatten(treedef, new_ts)
 
-            grads = model._clip_grads(grads)
-            new_params, new_opt = [], []
-            for i in range(len(params)):
-                step = ep_step if _uses_epoch_schedule(model._updaters[i]) else it_step
-                updates, no = apply_updater(model._updaters[i], opt[i],
-                                            grads[i], params[i], step)
-                new_params.append(_tmap(lambda p, u: p - u, params[i], updates))
-                new_opt.append(no)
+            new_params = _tmap(lambda p, u: p - u, params, mean_update)
             # states (BN running stats) averaged across shards
-            new_states = _tmap(lambda s: jax.lax.pmean(s, "data"), new_states)
+            new_states = _tmap(lambda s_: jax.lax.pmean(s_, "data"),
+                               new_states)
             loss_mean = jax.lax.pmean(data_loss, "data")
-            return new_params, new_states, new_opt, residual, loss_mean
+            return (new_params, new_states,
+                    _tmap(lambda a: a[None], new_opt),
+                    _tmap(lambda a: a[None], residual),
+                    _tmap(lambda a: a[None], thresholds), loss_mean)
 
         rep = P()
         dp = lambda a: P("data", *([None] * (a.ndim - 1)))
+        pd = lambda _: P("data")
 
-        def step_fn(params, states, opt, residual, it_step, ep_step, x, y, rng):
+        def step_fn(params, states, opt_s, residual, thresholds, it_step,
+                    ep_step, x, y, rng):
             in_specs = (
                 _tmap(lambda _: rep, params),
                 _tmap(lambda _: rep, states),
-                _tmap(lambda _: rep, opt),
-                _tmap(lambda _: rep, residual),
+                _tmap(pd, opt_s),
+                _tmap(pd, residual),
+                _tmap(pd, thresholds),
                 rep, rep,
                 dp(x), dp(y), rep,
             )
             out_specs = (
                 _tmap(lambda _: rep, params),
                 _tmap(lambda _: rep, states),
-                _tmap(lambda _: rep, opt),
-                _tmap(lambda _: rep, residual),
+                _tmap(pd, opt_s),
+                _tmap(pd, residual),
+                _tmap(pd, thresholds),
                 rep,
             )
             fn = shard_map(per_device, mesh=mesh, in_specs=in_specs,
                            out_specs=out_specs, check_rep=False)
-            return fn(params, states, opt, residual, it_step, ep_step, x, y, rng)
+            return fn(params, states, opt_s, residual, thresholds,
+                      it_step, ep_step, x, y, rng)
 
-        return jax.jit(step_fn, donate_argnums=(0, 1, 2, 3))
+        return jax.jit(step_fn, donate_argnums=(0, 1, 2, 3, 4))
 
     # ------------------------------------------------------------------
     # mode: averaging (independent local steps + periodic mesh average)
     # ------------------------------------------------------------------
     def _build_averaging_step(self):
-        model = self.model
+        mf = self.mf
         mesh = self.mesh
 
         def per_device(params, states, opt, it_step, ep_step, x, y, rng,
                        do_avg):
             rng = jax.random.fold_in(rng, jax.lax.axis_index("data"))
-            loss_fn = lambda pl: model._loss(pl, states, x, y, None, rng)
+            loss_fn = lambda pl: mf.loss(pl, states, x, y, rng)
             (loss, (new_states, data_loss)), grads = \
                 jax.value_and_grad(loss_fn, has_aux=True)(params)
-            grads = model._clip_grads(grads)
-            new_params, new_opt = [], []
-            for i in range(len(params)):
-                step = ep_step if _uses_epoch_schedule(model._updaters[i]) else it_step
-                updates, no = apply_updater(model._updaters[i], opt[i],
-                                            grads[i], params[i], step)
-                new_params.append(_tmap(lambda p, u: p - u, params[i], updates))
-                new_opt.append(no)
+            grads = mf.clip(grads)
+            new_params, new_opt = mf.apply_updates(params, grads, opt,
+                                                   it_step, ep_step)
             # periodic parameter + updater-state averaging (reference:
             # ParameterAveragingTrainingMaster averages BOTH)
             avg = lambda v: jnp.where(do_avg, jax.lax.pmean(v, "data"), v)
@@ -249,57 +350,86 @@ class ShardedTrainer:
                 for ds in data:
                     self._fit_batch(ds.features, ds.labels)
                 model._epoch += 1
-            return model
+            return self._finish()
         if isinstance(data, DataSet):
             for _ in range(epochs):
                 self._fit_batch(data.features, data.labels)
-            return model
+            return self._finish()
         for _ in range(epochs):
             self._fit_batch(data, labels)
+        return self._finish()
+
+    def _finish(self):
+        """Sync the model's canonical view of per-shard state (shard
+        0's updater moments, per the reference's per-worker trainers) —
+        done once per fit() call, not per step."""
+        model = self.model
+        if self.mode == "sharing_compressed" and self._local is not None:
+            p_, s_, _ = self.mf.get_trees()
+            self.mf.set_trees(p_, s_, _tmap(lambda a: a[0], self._local))
         return model
+
+    def _stack(self, tree):
+        return _tmap(lambda a: jnp.broadcast_to(
+            a[None], (self._n_data,) + a.shape), tree)
 
     def _fit_batch(self, x, y):
         model = self.model
+        mf = self.mf
         if self._step is None:
             self._place_replicated()
             if self.mode == "sharing":
                 self._step = self._build_sharing_step()
             elif self.mode == "sharing_compressed":
                 self._step = self._build_compressed_step()
-                self._residual = _tmap(jnp.zeros_like, model.params_list)
+                # per-shard residual + per-leaf thresholds + per-shard
+                # updater state, all stacked over the data axis
+                p_, _, o_ = mf.get_trees()
+                self._residual = _tmap(
+                    lambda a: jnp.zeros((self._n_data,) + a.shape, a.dtype),
+                    p_)
+                self._thresholds = _tmap(
+                    lambda a: jnp.full((self._n_data,), self.threshold,
+                                       jnp.float32), p_)
+                self._local = self._stack(o_)
             else:
                 self._step = self._build_averaging_step()
-                stack = lambda a: jnp.broadcast_to(a[None], (self._n_data,) + a.shape)
-                self._local = (
-                    _tmap(stack, model.params_list),
-                    _tmap(stack, model.opt_states),
-                )
+                p_, _, o_ = mf.get_trees()
+                self._local = (self._stack(p_), self._stack(o_))
         x, y = self._shard_batch(x, y)
         model._rng_key, sub = jax.random.split(model._rng_key)
         it_s = jnp.asarray(model._iteration)
         ep_s = jnp.asarray(model._epoch)
+        params, states, opt = mf.get_trees()
 
         if self.mode == "sharing":
-            (model.params_list, model.states_list, model.opt_states,
-             loss) = self._step(model.params_list, model.states_list,
-                                model.opt_states, it_s, ep_s, x, y, sub)
+            (params, states, opt, loss) = self._step(
+                params, states, opt, it_s, ep_s, x, y, sub)
+            mf.set_trees(params, states, opt)
         elif self.mode == "sharing_compressed":
-            (model.params_list, model.states_list, model.opt_states,
-             self._residual, loss) = self._step(
-                model.params_list, model.states_list, model.opt_states,
-                self._residual, it_s, ep_s, x, y, sub)
+            opt_s = self._local
+            (params, states, opt_s, self._residual, self._thresholds,
+             loss) = self._step(
+                params, states, opt_s, self._residual, self._thresholds,
+                it_s, ep_s, x, y, sub)
+            self._local = opt_s
+            # canonical opt (shard 0's) synced lazily at fit() exit —
+            # a per-step gather of the full optimizer state would undo
+            # the lazy-score optimization
+            mf.set_trees(params, states, opt)
         else:
             do_avg = jnp.asarray(
                 (model._iteration + 1) % self.averaging_frequency == 0)
             ps, opts = self._local
-            (ps, model.states_list, opts, loss) = self._step(
-                ps, model.states_list, opts, it_s, ep_s, x, y, sub, do_avg)
+            (ps, states, opts, loss) = self._step(
+                ps, states, opts, it_s, ep_s, x, y, sub, do_avg)
             self._local = (ps, opts)
             # the model's canonical params = shard 0 view
-            model.params_list = _tmap(lambda a: a[0], ps)
-            model.opt_states = _tmap(lambda a: a[0], opts)
+            mf.set_trees(_tmap(lambda a: a[0], ps), states,
+                         _tmap(lambda a: a[0], opts))
 
-        model._score = float(loss)
+        # on-device; score() converts lazily (no per-step host sync)
+        model._score = loss
         model._iteration += 1
         for l in model._listeners:
             l.iterationDone(model, model._iteration, model._epoch)

@@ -28,7 +28,8 @@ class ParallelWrapper:
     def __init__(self, model, workers: Optional[int] = None,
                  training_mode: str = "sharing",
                  averaging_frequency: int = 5,
-                 threshold: float = 1e-3):
+                 threshold: float = 1e-3,
+                 adaptive_threshold: bool = True):
         devs = jax.devices()
         workers = workers or len(devs)
         if workers > len(devs):
@@ -38,7 +39,8 @@ class ParallelWrapper:
         self.workers = workers
         self._trainer = ShardedTrainer(
             model, mesh=mesh, mode=training_mode,
-            averaging_frequency=averaging_frequency, threshold=threshold)
+            averaging_frequency=averaging_frequency, threshold=threshold,
+            adaptive_threshold=adaptive_threshold)
 
     # reference: ParallelWrapper.Builder fluent API
     class Builder:
