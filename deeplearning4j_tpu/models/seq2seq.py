@@ -1,0 +1,69 @@
+"""Seq2seq encoder-decoder LSTM (reference: the dl4j-examples
+AdditionRNN / seq2seq recipe built from ComputationGraph with
+graph/vertex/impl/rnn/{LastTimeStepVertex,DuplicateToTimeSeriesVertex} —
+BASELINE.md config 3's 'Char-RNN / seq2seq LSTM' family).
+
+Encoder LSTM reads the source sequence; its final hidden state (the
+"thought vector") is broadcast along the decoder's time axis and
+concatenated with the (teacher-forced) decoder input; a decoder LSTM +
+RnnOutputLayer emit the target sequence. The whole train step — both
+RNNs' lax.scans, the vertex plumbing, softmax CE — compiles into one
+XLA executable.
+"""
+
+from __future__ import annotations
+
+from deeplearning4j_tpu.learning import Adam
+from deeplearning4j_tpu.nn.conf import (
+    InputType, LSTM, RnnOutputLayer,
+)
+from deeplearning4j_tpu.nn.graph import (
+    ComputationGraph, ComputationGraphConfiguration,
+    DuplicateToTimeSeriesVertex, LastTimeStepVertex, MergeVertex,
+)
+
+
+class Seq2SeqLSTM:
+    """Builder for the encoder-decoder graph.
+
+    in_features/out_features: one-hot (or feature) widths of source and
+    target alphabets; decoder input is the shifted target (teacher
+    forcing), exactly like the reference example.
+    """
+
+    def __init__(self, in_features: int, out_features: int,
+                 hidden: int = 128, t_in: int = 12, t_out: int = 12,
+                 seed: int = 42, updater=None):
+        self.in_features = in_features
+        self.out_features = out_features
+        self.hidden = hidden
+        self.t_in = t_in
+        self.t_out = t_out
+        self.seed = seed
+        self.updater = updater or Adam(5e-3)
+
+    def conf(self) -> ComputationGraphConfiguration:
+        b = (ComputationGraphConfiguration.graphBuilder()
+             .seed(self.seed).updater(self.updater)
+             .addInputs("encoderInput", "decoderInput")
+             .setInputTypes(
+                 InputType.recurrent(self.in_features, self.t_in),
+                 InputType.recurrent(self.out_features, self.t_out)))
+        b.addLayer("encoder",
+                   LSTM(n_out=self.hidden, activation="tanh"),
+                   "encoderInput")
+        b.addVertex("thought", LastTimeStepVertex(), "encoder")
+        b.addVertex("dup", DuplicateToTimeSeriesVertex(),
+                    "thought", "decoderInput")
+        b.addVertex("decoderIn", MergeVertex(), "decoderInput", "dup")
+        b.addLayer("decoder",
+                   LSTM(n_out=self.hidden, activation="tanh"),
+                   "decoderIn")
+        b.addLayer("output",
+                   RnnOutputLayer(n_out=self.out_features,
+                                  activation="softmax", loss="mcxent"),
+                   "decoder")
+        return b.setOutputs("output").build()
+
+    def init(self) -> ComputationGraph:
+        return ComputationGraph(self.conf()).init()

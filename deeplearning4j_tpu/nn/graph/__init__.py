@@ -1,6 +1,8 @@
 from deeplearning4j_tpu.nn.graph.vertices import (
-    ElementWiseVertex, GraphVertex, L2NormalizeVertex, LayerVertex,
-    MergeVertex, ScaleVertex, SubsetVertex, PreprocessorVertex,
+    DuplicateToTimeSeriesVertex, ElementWiseVertex, GraphVertex,
+    L2NormalizeVertex, LastTimeStepVertex, LayerVertex, MergeVertex,
+    ReverseTimeSeriesVertex, ScaleVertex, StackVertex, SubsetVertex,
+    UnstackVertex, PreprocessorVertex,
 )
 from deeplearning4j_tpu.nn.graph.config import ComputationGraphConfiguration
 from deeplearning4j_tpu.nn.graph.graph import ComputationGraph
@@ -9,4 +11,6 @@ __all__ = [
     "ComputationGraph", "ComputationGraphConfiguration", "GraphVertex",
     "LayerVertex", "MergeVertex", "ElementWiseVertex", "ScaleVertex",
     "SubsetVertex", "PreprocessorVertex", "L2NormalizeVertex",
+    "LastTimeStepVertex", "DuplicateToTimeSeriesVertex",
+    "ReverseTimeSeriesVertex", "StackVertex", "UnstackVertex",
 ]

@@ -151,6 +151,101 @@ class SubsetVertex(GraphVertex):
 
 @serializable
 @dataclasses.dataclass
+class LastTimeStepVertex(GraphVertex):
+    """[N, T, F] -> [N, F] (reference:
+    graph/vertex/impl/rnn/LastTimeStepVertex — the seq2seq encoder
+    head). Wire a [N, T] mask as a SECOND input to select each row's
+    last real step; with one input the literal final step is taken."""
+
+    def output_type(self, its):
+        it = its[0]
+        return InputType.feedForward(it.size)
+
+    def apply(self, params, state, inputs, train, rng):
+        x = inputs[0]
+        if len(inputs) > 1 and inputs[1] is not None:
+            mask = inputs[1]  # [N, T] 1.0 = real step
+            t = x.shape[1]
+            # LAST NONZERO index, not sum-1: masks with interior gaps
+            # would otherwise select a masked-out step
+            rev_first = jnp.argmax(jnp.flip(mask.astype(jnp.int32),
+                                            axis=1), axis=1)
+            idx = jnp.maximum(t - 1 - rev_first, 0)
+            return jnp.take_along_axis(
+                x, idx[:, None, None], axis=1)[:, 0], state
+        return x[:, -1], state
+
+
+@serializable
+@dataclasses.dataclass
+class DuplicateToTimeSeriesVertex(GraphVertex):
+    """[N, F] -> [N, T, F], T taken from a reference recurrent input
+    (reference: graph/vertex/impl/rnn/DuplicateToTimeSeriesVertex —
+    broadcasts the encoder's thought vector along the decoder's time
+    axis in seq2seq)."""
+
+    def output_type(self, its):
+        feat, ref = its[0], its[1]
+        return InputType.recurrent(feat.size, ref.timeseries_length)
+
+    def apply(self, params, state, inputs, train, rng):
+        feat, ref = inputs[0], inputs[1]
+        t = ref.shape[1]
+        return jnp.broadcast_to(feat[:, None, :],
+                                (feat.shape[0], t, feat.shape[1])), state
+
+
+@serializable
+@dataclasses.dataclass
+class ReverseTimeSeriesVertex(GraphVertex):
+    """Reverse the time axis (reference:
+    graph/vertex/impl/rnn/ReverseTimeSeriesVertex)."""
+
+    def apply(self, params, state, inputs, train, rng):
+        return jnp.flip(inputs[0], axis=1), state
+
+
+@serializable
+@dataclasses.dataclass
+class StackVertex(GraphVertex):
+    """Concatenate along the BATCH axis (reference: StackVertex — used
+    for weight-shared multi-tower graphs)."""
+
+    def output_type(self, its):
+        return its[0]
+
+    def apply(self, params, state, inputs, train, rng):
+        return jnp.concatenate(inputs, axis=0), state
+
+
+@serializable
+@dataclasses.dataclass
+class UnstackVertex(GraphVertex):
+    """Slice one of `stack_size` equal batch segments (reference:
+    UnstackVertex, the inverse of StackVertex)."""
+
+    from_index: int = 0
+    stack_size: int = 1
+
+    def output_type(self, its):
+        return its[0]
+
+    def apply(self, params, state, inputs, train, rng):
+        x = inputs[0]
+        if not 0 <= self.from_index < self.stack_size:
+            raise ValueError(
+                f"from_index {self.from_index} not in [0, "
+                f"{self.stack_size})")
+        if x.shape[0] % self.stack_size != 0:
+            raise ValueError(
+                f"batch {x.shape[0]} not divisible by "
+                f"stack_size {self.stack_size}")
+        n = x.shape[0] // self.stack_size
+        return x[self.from_index * n:(self.from_index + 1) * n], state
+
+
+@serializable
+@dataclasses.dataclass
 class PreprocessorVertex(GraphVertex):
     """Standalone reshape vertex carrying a preprocessor tag."""
 

@@ -1,0 +1,104 @@
+"""Seq2seq encoder-decoder tests (reference: the dl4j-examples
+AdditionRNN recipe; vertices LastTimeStepVertex /
+DuplicateToTimeSeriesVertex / ReverseTimeSeriesVertex / Stack/Unstack)."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.models.seq2seq import Seq2SeqLSTM
+from deeplearning4j_tpu.nn.graph import (
+    DuplicateToTimeSeriesVertex, LastTimeStepVertex,
+    ReverseTimeSeriesVertex, StackVertex, UnstackVertex,
+)
+
+
+class TestRnnVertices:
+    def test_last_time_step_plain_and_masked(self):
+        x = jnp.asarray(np.arange(24, dtype=np.float32).reshape(2, 3, 4))
+        v = LastTimeStepVertex()
+        out, _ = v.apply(None, None, [x], False, None)
+        np.testing.assert_allclose(out, np.asarray(x)[:, -1])
+        mask = jnp.asarray([[1.0, 1.0, 0.0], [1.0, 0.0, 0.0]])
+        out, _ = v.apply(None, None, [x, mask], False, None)
+        np.testing.assert_allclose(out[0], np.asarray(x)[0, 1])
+        np.testing.assert_allclose(out[1], np.asarray(x)[1, 0])
+
+    def test_duplicate_to_timeseries(self):
+        feat = jnp.asarray([[1.0, 2.0]])
+        ref = jnp.zeros((1, 5, 3))
+        out, _ = DuplicateToTimeSeriesVertex().apply(
+            None, None, [feat, ref], False, None)
+        assert out.shape == (1, 5, 2)
+        np.testing.assert_allclose(out[0, 4], [1.0, 2.0])
+
+    def test_reverse_and_stack_unstack(self):
+        x = jnp.asarray(np.arange(6, dtype=np.float32).reshape(1, 3, 2))
+        rev, _ = ReverseTimeSeriesVertex().apply(None, None, [x], False,
+                                                 None)
+        np.testing.assert_allclose(rev[0, 0], np.asarray(x)[0, 2])
+        a = jnp.ones((2, 4))
+        b = jnp.zeros((2, 4))
+        st, _ = StackVertex().apply(None, None, [a, b], False, None)
+        assert st.shape == (4, 4)
+        back, _ = UnstackVertex(from_index=1, stack_size=2).apply(
+            None, None, [st], False, None)
+        np.testing.assert_allclose(back, b)
+
+
+class TestSeq2Seq:
+    def _reversal_data(self, n=64, t=6, k=8, seed=0):
+        """Task: output = input sequence reversed (one-hot alphabet k).
+        Decoder input is the shifted target (teacher forcing)."""
+        rs = np.random.RandomState(seed)
+        src = rs.randint(0, k, (n, t))
+        tgt = src[:, ::-1]
+        enc = np.eye(k, dtype=np.float32)[src]
+        dec_out = np.eye(k, dtype=np.float32)[tgt]
+        dec_in = np.zeros_like(dec_out)
+        dec_in[:, 1:] = dec_out[:, :-1]  # <go> = zeros, then shifted
+        return enc, dec_in, dec_out
+
+    def test_learns_reversal(self):
+        k, t = 8, 6
+        enc, dec_in, dec_out = self._reversal_data(t=t, k=k)
+        net = Seq2SeqLSTM(in_features=k, out_features=k, hidden=64,
+                          t_in=t, t_out=t).init()
+        first = last = None
+        for i in range(60):
+            net.fit([enc, dec_in], [dec_out])
+            if i == 0:
+                first = net.score()
+        last = net.score()
+        assert last < first * 0.5, (first, last)
+        pred = net.output(enc, dec_in)[0].toNumpy()
+        acc = (pred.argmax(-1) == dec_out.argmax(-1)).mean()
+        assert acc > 0.6, acc
+
+    def test_config_json_roundtrip(self):
+        from deeplearning4j_tpu.nn.graph import (
+            ComputationGraphConfiguration,
+        )
+        conf = Seq2SeqLSTM(in_features=5, out_features=7, hidden=16,
+                           t_in=4, t_out=4).conf()
+        js = conf.to_json()
+        rt = ComputationGraphConfiguration.from_json(js)
+        assert rt.to_json() == js
+
+
+class TestReviewRegressions:
+    def test_last_step_gap_mask_uses_last_nonzero(self):
+        x = jnp.asarray(np.arange(12, dtype=np.float32).reshape(1, 3, 4))
+        mask = jnp.asarray([[1.0, 0.0, 1.0]])  # interior gap
+        out, _ = LastTimeStepVertex().apply(None, None, [x, mask],
+                                            False, None)
+        np.testing.assert_allclose(out[0], np.asarray(x)[0, 2])
+
+    def test_unstack_validates(self):
+        import pytest
+        x = jnp.ones((10, 4))
+        with pytest.raises(ValueError, match="divisible"):
+            UnstackVertex(from_index=0, stack_size=3).apply(
+                None, None, [x], False, None)
+        with pytest.raises(ValueError, match="from_index"):
+            UnstackVertex(from_index=2, stack_size=2).apply(
+                None, None, [jnp.ones((4, 4))], False, None)
