@@ -23,10 +23,15 @@ from deeplearning4j_tpu.nlp.vocab import AbstractCache, VocabCache, VocabWord
 from deeplearning4j_tpu.nlp.word2vec import SequenceVectors, Word2Vec
 from deeplearning4j_tpu.nlp.paragraph_vectors import ParagraphVectors
 from deeplearning4j_tpu.nlp.serializer import WordVectorSerializer
+from deeplearning4j_tpu.nlp.glove import Glove
+from deeplearning4j_tpu.nlp.fasttext import FastText
+from deeplearning4j_tpu.nlp.tsne import BarnesHutTsne
 
 __all__ = [
-    "AbstractCache", "BasicLineIterator", "CollectionSentenceIterator",
+    "AbstractCache", "BarnesHutTsne", "BasicLineIterator",
+    "CollectionSentenceIterator",
     "CommonPreprocessor", "DefaultTokenizer", "DefaultTokenizerFactory",
+    "FastText", "Glove",
     "NGramTokenizerFactory", "ParagraphVectors", "SentenceIterator",
     "SequenceVectors", "Tokenizer", "TokenizerFactory", "VocabCache",
     "VocabWord", "Word2Vec", "WordVectorSerializer",

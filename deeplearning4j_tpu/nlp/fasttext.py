@@ -1,0 +1,227 @@
+"""FastText — subword-enriched skip-gram embeddings.
+
+Reference: deeplearning4j-nlp/.../models/fasttext/FastText.java (JNI
+wrapper around Facebook's native fastText; SURVEY.md §2.35). Since the
+reference's value is the *capability* (subword n-gram vectors, OOV
+inference), this is a native reimplementation of the fastText skip-gram
+model (Bojanowski et al. 2017): a word's vector is the mean of its
+hashed character-n-gram vectors plus its own word vector; training is
+SGNS where the center-side gradient is distributed over the n-gram rows.
+
+TPU design: each batch step is one jit executable — n-gram gathers
+(padded [B, G] with mask), mean-reduce, batched [B, K+1] dot products on
+the MXU, masked scatter-add updates. The n-gram hashing/bucketing is
+host-side (string work), cached per vocab word.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Iterable, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nlp.tokenization import DefaultTokenizerFactory
+from deeplearning4j_tpu.nlp.vocab import AbstractCache
+
+_FNV_PRIME = 16777619
+_FNV_OFFSET = 2166136261
+
+
+def _fnv1a(s: str) -> int:
+    """FNV-1a hash (the hash fastText uses for n-gram bucketing)."""
+    h = _FNV_OFFSET
+    for ch in s.encode("utf-8"):
+        h = ((h ^ ch) * _FNV_PRIME) & 0xFFFFFFFF
+    return h
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def _ft_step(grams_tab, syn1neg, gram_ids, gram_mask, contexts, negatives,
+             lr):
+    """One subword-SGNS step.
+
+    grams_tab: [BUCKETS+V, D] n-gram + word-id rows; gram_ids: [B,G]
+    (padded), gram_mask: [B,G] float; contexts: [B]; negatives: [B,K].
+    """
+    g = grams_tab[gram_ids]                       # [B,G,D]
+    denom = jnp.maximum(gram_mask.sum(-1, keepdims=True), 1.0)
+    c = (g * gram_mask[..., None]).sum(1) / denom  # [B,D] mean of grams
+    o = syn1neg[contexts]
+    n = syn1neg[negatives]
+
+    pos_logit = jnp.einsum("bd,bd->b", c, o)
+    neg_logit = jnp.einsum("bd,bkd->bk", c, n)
+    g_pos = jax.nn.sigmoid(pos_logit) - 1.0
+    g_neg = jax.nn.sigmoid(neg_logit)
+
+    grad_c = g_pos[:, None] * o + jnp.einsum("bk,bkd->bd", g_neg, n)
+    grad_c = grad_c / denom                       # distribute over grams
+    grad_o = g_pos[:, None] * c
+    grad_n = g_neg[..., None] * c[:, None, :]
+
+    flat_ids = gram_ids.reshape(-1)
+    flat_grads = (grad_c[:, None, :] * gram_mask[..., None]) \
+        .reshape(-1, grad_c.shape[-1])
+    grams_tab = grams_tab.at[flat_ids].add(-lr * flat_grads)
+    syn1neg = syn1neg.at[contexts].add(-lr * grad_o)
+    syn1neg = syn1neg.at[negatives.reshape(-1)].add(
+        -lr * grad_n.reshape(-1, grad_n.shape[-1]))
+
+    loss = (-jax.nn.log_sigmoid(pos_logit)
+            - jax.nn.log_sigmoid(-neg_logit).sum(-1)).mean()
+    return grams_tab, syn1neg, loss
+
+
+class FastText:
+    """reference: models/fasttext/FastText.java builder knobs
+    (dim/contextWindow/epochs/minCount/wordNgrams/skipgram)."""
+
+    def __init__(self, layer_size: int = 100, window_size: int = 5,
+                 min_word_frequency: int = 1, epochs: int = 5,
+                 learning_rate: float = 0.05, negative_sample: int = 5,
+                 min_n: int = 3, max_n: int = 6, buckets: int = 20000,
+                 batch_size: int = 512, seed: int = 123,
+                 tokenizer_factory=None):
+        self.layer_size = layer_size
+        self.window_size = window_size
+        self.min_word_frequency = min_word_frequency
+        self.epochs = epochs
+        self.learning_rate = learning_rate
+        self.negative = negative_sample
+        self.min_n = min_n
+        self.max_n = max_n
+        self.buckets = buckets
+        self.batch_size = batch_size
+        self.seed = seed
+        self.tokenizer_factory = tokenizer_factory or DefaultTokenizerFactory()
+        self.vocab = AbstractCache()
+        self.grams_tab: Optional[np.ndarray] = None
+        self._word_grams: List[np.ndarray] = []
+        self._max_grams = 0
+        self._word_matrix: Optional[np.ndarray] = None
+        self.loss_history: List[float] = []
+
+    # -- subword machinery ---------------------------------------------
+    def _ngrams(self, word: str) -> List[int]:
+        """Bucketed char n-gram ids + the word's own id row."""
+        w = f"<{word}>"
+        ids = []
+        for n in range(self.min_n, self.max_n + 1):
+            for i in range(len(w) - n + 1):
+                ids.append(_fnv1a(w[i:i + n]) % self.buckets)
+        wid = self.vocab.indexOf(word)
+        ids.append(self.buckets + wid)  # word-id row after the buckets
+        return ids
+
+    def _gram_matrix(self, indices: List[int]):
+        """Pad each word's gram list to the GLOBAL max gram count so the
+        jitted step sees one stable [B,G] shape (per-batch max would
+        recompile _ft_step for every new G)."""
+        g = self._max_grams
+        ids = np.zeros((len(indices), g), np.int32)
+        mask = np.zeros((len(indices), g), np.float32)
+        for r, i in enumerate(indices):
+            lst = self._word_grams[i]
+            ids[r, :len(lst)] = lst
+            mask[r, :len(lst)] = 1.0
+        return ids, mask
+
+    # -- training -------------------------------------------------------
+    def fit(self, sentences: Iterable[str]) -> "FastText":
+        tok = self.tokenizer_factory
+        tokenized = [tok.create(s).getTokens() for s in sentences]
+        for toks in tokenized:
+            for t in toks:
+                self.vocab.addToken(t)
+        self.vocab.finalize_vocab(self.min_word_frequency)
+        v = self.vocab.numWords()
+        self._word_grams = [np.asarray(self._ngrams(self.vocab.wordAtIndex(i)),
+                                       np.int32) for i in range(v)]
+        self._max_grams = max(len(g) for g in self._word_grams)
+        seqs = [[self.vocab.indexOf(t) for t in toks
+                 if self.vocab.containsWord(t)] for toks in tokenized]
+
+        rng = np.random.default_rng(self.seed)
+        d = self.layer_size
+        grams_tab = jnp.asarray(
+            rng.uniform(-0.5 / d, 0.5 / d, (self.buckets + v, d)), jnp.float32)
+        syn1neg = jnp.zeros((v, d), jnp.float32)
+
+        # unigram^0.75 negative table (same as word2vec)
+        counts = self.vocab.counts() ** 0.75
+        neg_prob = counts / counts.sum()
+
+        pairs = []
+        for seq in seqs:
+            for pos, wi in enumerate(seq):
+                lo, hi = max(0, pos - self.window_size), \
+                    min(len(seq), pos + self.window_size + 1)
+                for pos2 in range(lo, hi):
+                    if pos2 != pos:
+                        pairs.append((wi, seq[pos2]))
+        if not pairs:
+            raise ValueError("No training pairs (corpus too small?)")
+        pairs = np.asarray(pairs, np.int32)
+
+        bs = min(self.batch_size, len(pairs))
+        for _ in range(self.epochs):
+            order = rng.permutation(len(pairs))
+            ep_loss, nb = 0.0, 0
+            for s in range(0, len(pairs) - bs + 1, bs):
+                batch = pairs[order[s:s + bs]]
+                gids, gmask = self._gram_matrix(batch[:, 0].tolist())
+                negs = rng.choice(v, (bs, self.negative), p=neg_prob)
+                grams_tab, syn1neg, loss = _ft_step(
+                    grams_tab, syn1neg, jnp.asarray(gids),
+                    jnp.asarray(gmask), jnp.asarray(batch[:, 1]),
+                    jnp.asarray(negs, jnp.int32), self.learning_rate)
+                ep_loss += float(loss)
+                nb += 1
+            self.loss_history.append(ep_loss / max(nb, 1))
+        self.grams_tab = np.asarray(grams_tab)
+        # cache the static [V,D] word-vector matrix for lookups
+        self._word_matrix = np.stack([self.grams_tab[g].mean(0)
+                                      for g in self._word_grams])
+        return self
+
+    # -- lookup (incl. OOV via subwords — the fastText headline) --------
+    def hasWord(self, word: str) -> bool:
+        return self.vocab.containsWord(word)
+
+    def getWordVector(self, word: str) -> np.ndarray:
+        """In-vocab: mean of n-gram + word rows. OOV: n-gram rows only."""
+        if self.vocab.containsWord(word):
+            return self._word_matrix[self.vocab.indexOf(word)]
+        else:
+            w = f"<{word}>"
+            ids = np.asarray(
+                [_fnv1a(w[i:i + n]) % self.buckets
+                 for n in range(self.min_n, self.max_n + 1)
+                 for i in range(len(w) - n + 1)], np.int32)
+            if len(ids) == 0:
+                return np.zeros(self.layer_size, np.float32)
+        return self.grams_tab[ids].mean(0)
+
+    def similarity(self, w1: str, w2: str) -> float:
+        a, c = self.getWordVector(w1), self.getWordVector(w2)
+        na, nc = np.linalg.norm(a), np.linalg.norm(c)
+        if na == 0 or nc == 0:
+            return 0.0
+        return float(a @ c / (na * nc))
+
+    def wordsNearest(self, word: str, n: int = 10) -> List[str]:
+        v = self.getWordVector(word)
+        m = self._word_matrix
+        sims = m @ v / (np.linalg.norm(m, axis=1) * np.linalg.norm(v) + 1e-9)
+        order = np.argsort(-sims)
+        out = []
+        for i in order:
+            wrd = self.vocab.wordAtIndex(int(i))
+            if wrd != word:
+                out.append(wrd)
+            if len(out) >= n:
+                break
+        return out

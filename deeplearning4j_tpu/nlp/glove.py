@@ -1,0 +1,180 @@
+"""GloVe — global co-occurrence vector training.
+
+Reference: org/deeplearning4j/models/glove/{Glove,GloveWeightLookupTable,
+AbstractCoOccurrences}.java (SURVEY.md §2.35 NLP subsystem).
+
+TPU-native redesign: the reference builds co-occurrence counts in Java
+threads then runs per-pair AdaGrad updates row-by-row. Here the
+co-occurrence pass stays on host (string/window work, cheap), and
+training runs as jit-compiled minibatch AdaGrad steps over the sparse
+(i, j, X_ij) triples: gathers + fused weighted-least-squares gradient +
+scatter-adds, all on device. Loss: f(X)·(wᵢ·w̃ⱼ + bᵢ + b̃ⱼ − log X)²
+with f(x) = (x/x_max)^alpha clipped at 1 (Pennington et al. 2014, the
+same objective the reference implements).
+"""
+
+from __future__ import annotations
+
+import functools
+from collections import defaultdict
+from typing import Iterable, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nlp.tokenization import DefaultTokenizerFactory
+from deeplearning4j_tpu.nlp.vocab import AbstractCache
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1, 2, 3, 4, 5))
+def _glove_step(w, wc, b, bc, hw, hb, rows, cols, logx, fx, lr):
+    """One AdaGrad minibatch on the sparse triples.
+
+    w/wc: [V,D] main/context vectors; b/bc: [V] biases; hw/hb: AdaGrad
+    accumulators ([V,D] vector, [V] bias — shared between main and
+    context tables like the reference's single lookup table history).
+    """
+    wi, wj = w[rows], wc[cols]                       # [B,D]
+    diff = jnp.einsum("bd,bd->b", wi, wj) + b[rows] + bc[cols] - logx
+    fdiff = fx * diff                                # [B]
+
+    gw_i = fdiff[:, None] * wj                       # grad wrt w[rows]
+    gw_j = fdiff[:, None] * wi
+    gb = fdiff
+
+    # AdaGrad: accumulate squared grads, scale step (scatter on rows)
+    hw = hw.at[rows].add(gw_i * gw_i)
+    hw = hw.at[cols].add(gw_j * gw_j)
+    hb = hb.at[rows].add(gb * gb)
+    hb = hb.at[cols].add(gb * gb)
+
+    w = w.at[rows].add(-lr * gw_i / jnp.sqrt(hw[rows] + 1e-8))
+    wc = wc.at[cols].add(-lr * gw_j / jnp.sqrt(hw[cols] + 1e-8))
+    b = b.at[rows].add(-lr * gb / jnp.sqrt(hb[rows] + 1e-8))
+    bc = bc.at[cols].add(-lr * gb / jnp.sqrt(hb[cols] + 1e-8))
+
+    loss = 0.5 * jnp.mean(fx * diff * diff)
+    return w, wc, b, bc, hw, hb, loss
+
+
+class Glove:
+    """reference: models/glove/Glove.java builder surface."""
+
+    def __init__(self, layer_size: int = 100, window_size: int = 5,
+                 min_word_frequency: int = 1, epochs: int = 5,
+                 learning_rate: float = 0.05, x_max: float = 100.0,
+                 alpha: float = 0.75, batch_size: int = 1024,
+                 symmetric: bool = True, shuffle: bool = True,
+                 seed: int = 123, tokenizer_factory=None):
+        self.layer_size = layer_size
+        self.window_size = window_size
+        self.min_word_frequency = min_word_frequency
+        self.epochs = epochs
+        self.learning_rate = learning_rate
+        self.x_max = x_max
+        self.alpha = alpha
+        self.batch_size = batch_size
+        self.symmetric = symmetric
+        self.shuffle = shuffle
+        self.seed = seed
+        self.tokenizer_factory = tokenizer_factory or DefaultTokenizerFactory()
+        self.vocab = AbstractCache()
+        self.syn0: Optional[np.ndarray] = None
+        self.loss_history: List[float] = []
+
+    # -- co-occurrence pass (host; reference: AbstractCoOccurrences) ----
+    def _cooccurrences(self, seqs: List[List[int]]):
+        counts: dict = defaultdict(float)
+        for seq in seqs:
+            for pos, wi in enumerate(seq):
+                lo = max(0, pos - self.window_size)
+                for pos2 in range(lo, pos):
+                    wj = seq[pos2]
+                    incr = 1.0 / (pos - pos2)     # distance weighting
+                    counts[(wi, wj)] += incr
+                    if self.symmetric:
+                        counts[(wj, wi)] += incr
+        rows = np.fromiter((k[0] for k in counts), np.int32, len(counts))
+        cols = np.fromiter((k[1] for k in counts), np.int32, len(counts))
+        vals = np.fromiter(counts.values(), np.float32, len(counts))
+        return rows, cols, vals
+
+    def fit(self, sentences: Iterable[str]) -> "Glove":
+        tok = self.tokenizer_factory
+        tokenized = [tok.create(s).getTokens() for s in sentences]
+        for toks in tokenized:
+            for t in toks:
+                self.vocab.addToken(t)
+        self.vocab.finalize_vocab(self.min_word_frequency)
+        seqs = [[self.vocab.indexOf(t) for t in toks
+                 if self.vocab.containsWord(t)] for toks in tokenized]
+        rows, cols, vals = self._cooccurrences(seqs)
+        if len(rows) == 0:
+            raise ValueError("No co-occurrences (corpus too small?)")
+
+        v, d = self.vocab.numWords(), self.layer_size
+        rng = np.random.default_rng(self.seed)
+        scale = 0.5 / d
+        w = jnp.asarray(rng.uniform(-scale, scale, (v, d)), jnp.float32)
+        wc = jnp.asarray(rng.uniform(-scale, scale, (v, d)), jnp.float32)
+        b = jnp.zeros((v,), jnp.float32)
+        bc = jnp.zeros((v,), jnp.float32)
+        hw = jnp.zeros((v, d), jnp.float32)
+        hb = jnp.zeros((v,), jnp.float32)
+
+        logx = np.log(vals)
+        fx = np.minimum((vals / self.x_max) ** self.alpha, 1.0).astype(np.float32)
+        n = len(rows)
+        bs = min(self.batch_size, n)
+        for _ in range(self.epochs):
+            order = rng.permutation(n) if self.shuffle else np.arange(n)
+            ep_loss, nb = 0.0, 0
+            for s in range(0, n - bs + 1, bs):
+                idx = order[s:s + bs]
+                w, wc, b, bc, hw, hb, loss = _glove_step(
+                    w, wc, b, bc, hw, hb,
+                    jnp.asarray(rows[idx]), jnp.asarray(cols[idx]),
+                    jnp.asarray(logx[idx]), jnp.asarray(fx[idx]),
+                    self.learning_rate)
+                ep_loss += float(loss)
+                nb += 1
+            self.loss_history.append(ep_loss / max(nb, 1))
+        # final embedding = main + context (standard GloVe practice; the
+        # reference exposes the main table — both supported via syn0)
+        self.syn0 = np.asarray(w) + np.asarray(wc)
+        return self
+
+    # -- lookup surface (mirrors SequenceVectors') ----------------------
+    def hasWord(self, word: str) -> bool:
+        return self.vocab.containsWord(word)
+
+    def getWordVector(self, word: str) -> np.ndarray:
+        idx = self.vocab.indexOf(word)
+        if idx < 0:
+            raise KeyError(word)
+        return self.syn0[idx]
+
+    def getWordVectorMatrix(self) -> np.ndarray:
+        return self.syn0
+
+    def similarity(self, w1: str, w2: str) -> float:
+        a, c = self.getWordVector(w1), self.getWordVector(w2)
+        na, nc = np.linalg.norm(a), np.linalg.norm(c)
+        if na == 0 or nc == 0:
+            return 0.0
+        return float(a @ c / (na * nc))
+
+    def wordsNearest(self, word: str, n: int = 10) -> List[str]:
+        v = self.getWordVector(word)
+        m = self.syn0
+        sims = m @ v / (np.linalg.norm(m, axis=1) * np.linalg.norm(v) + 1e-9)
+        order = np.argsort(-sims)
+        out = []
+        for i in order:
+            wrd = self.vocab.wordAtIndex(int(i))
+            if wrd != word:
+                out.append(wrd)
+            if len(out) >= n:
+                break
+        return out

@@ -14,6 +14,15 @@ from deeplearning4j_tpu.nn.conf.layers import (
     LearnedSelfAttentionLayer, RecurrentAttentionLayer, LastTimeStep, SimpleRnn,
     CnnLossLayer, RnnLossLayer,
 )
+from deeplearning4j_tpu.nn.conf.layers_extra import (
+    CapsuleLayer, CapsuleStrengthLayer, CenterLossOutputLayer, Convolution1D,
+    Convolution3D, Cropping1D, Cropping2D, Cropping3D, Deconvolution2D,
+    DepthwiseConvolution2D, ElementWiseMultiplicationLayer, GRU,
+    LocallyConnected1D, LocallyConnected2D, MaskLayer, MaskZeroLayer,
+    PReLULayer, PrimaryCapsules, RepeatVector, SpaceToBatchLayer,
+    SpaceToDepthLayer, Subsampling1DLayer, Subsampling3DLayer, Upsampling1D,
+    Upsampling3D, ZeroPadding1DLayer, ZeroPadding3DLayer,
+)
 from deeplearning4j_tpu.nn.conf.builder import (
     MultiLayerConfiguration, NeuralNetConfiguration,
 )
@@ -29,5 +38,14 @@ __all__ = [
     "LocalResponseNormalization", "LearnedSelfAttentionLayer",
     "RecurrentAttentionLayer", "LastTimeStep", "SimpleRnn",
     "CnnLossLayer", "RnnLossLayer",
+    "CapsuleLayer", "CapsuleStrengthLayer", "CenterLossOutputLayer",
+    "Convolution1D", "Convolution3D", "Cropping1D", "Cropping2D",
+    "Cropping3D", "Deconvolution2D", "DepthwiseConvolution2D",
+    "ElementWiseMultiplicationLayer", "GRU", "LocallyConnected1D",
+    "LocallyConnected2D", "MaskLayer", "MaskZeroLayer", "PReLULayer",
+    "PrimaryCapsules", "RepeatVector", "SpaceToBatchLayer",
+    "SpaceToDepthLayer", "Subsampling1DLayer", "Subsampling3DLayer",
+    "Upsampling1D", "Upsampling3D", "ZeroPadding1DLayer",
+    "ZeroPadding3DLayer",
     "MultiLayerConfiguration", "NeuralNetConfiguration",
 ]

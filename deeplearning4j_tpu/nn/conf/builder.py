@@ -25,6 +25,29 @@ from deeplearning4j_tpu.nn.conf.layers import (
     SimpleRnn, SubsamplingLayer, SelfAttentionLayer, Upsampling2D,
     ZeroPaddingLayer, LocalResponseNormalization, GravesLSTM, RnnOutputLayer,
 )
+from deeplearning4j_tpu.nn.conf.layers_extra import (
+    CapsuleLayer, CapsuleStrengthLayer, Convolution1D, Convolution3D,
+    Cropping1D, Cropping2D, Cropping3D, GRU, LocallyConnected1D,
+    LocallyConnected2D, MaskZeroLayer, PrimaryCapsules, SpaceToBatchLayer,
+    SpaceToDepthLayer, Subsampling1DLayer, Subsampling3DLayer, Upsampling1D,
+    Upsampling3D, ZeroPadding1DLayer, ZeroPadding3DLayer,
+)
+
+#: layers that consume image [N,H,W,C] input
+_CNN2D_LAYERS = (ConvolutionLayer, SubsamplingLayer, Upsampling2D,
+                 ZeroPaddingLayer, LocalResponseNormalization, Cropping2D,
+                 SpaceToDepthLayer, SpaceToBatchLayer, LocallyConnected2D,
+                 PrimaryCapsules)
+#: layers that consume volumetric [N,D,H,W,C] input
+_CNN3D_LAYERS = (Convolution3D, Subsampling3DLayer, Upsampling3D,
+                 Cropping3D, ZeroPadding3DLayer)
+#: layers that consume sequence [N,T,F] input
+_RNN_LAYERS = (LSTM, SimpleRnn, GravesLSTM, GRU, SelfAttentionLayer,
+               LastTimeStep, Bidirectional, LearnedSelfAttentionLayer,
+               RecurrentAttentionLayer, RnnOutputLayer, Convolution1D,
+               Subsampling1DLayer, Upsampling1D, Cropping1D,
+               ZeroPadding1DLayer, LocallyConnected1D, MaskZeroLayer,
+               CapsuleLayer, CapsuleStrengthLayer)
 
 
 @serializable
@@ -203,9 +226,7 @@ class ListBuilder:
                 continue  # no shape inference possible; user set n_in
 
             # representation changes -> preprocessors
-            if isinstance(layer, (ConvolutionLayer, SubsamplingLayer,
-                                  Upsampling2D, ZeroPaddingLayer,
-                                  LocalResponseNormalization)) \
+            if isinstance(layer, _CNN2D_LAYERS) \
                     and not isinstance(layer, DenseLayer):
                 if it.kind == "convolutionalFlat":
                     preprocessors[i] = f"to_conv:{it.height},{it.width},{it.channels}"
@@ -213,29 +234,41 @@ class ListBuilder:
                 elif it.kind != "convolutional":
                     raise ValueError(
                         f"Layer {i} ({type(layer).__name__}) needs image input, got {it.kind}")
-            elif isinstance(layer, (LSTM, SimpleRnn, SelfAttentionLayer,
-                                    GravesLSTM, LastTimeStep, Bidirectional,
-                                    LearnedSelfAttentionLayer,
-                                    RecurrentAttentionLayer)) \
-                    or isinstance(layer, RnnOutputLayer):
+            elif isinstance(layer, _CNN3D_LAYERS):
+                if it.kind != "convolutional3d":
+                    raise ValueError(
+                        f"Layer {i} ({type(layer).__name__}) needs 3D image input, got {it.kind}")
+            elif isinstance(layer, _RNN_LAYERS):
                 if it.kind not in ("recurrent",):
                     raise ValueError(
                         f"Layer {i} ({type(layer).__name__}) needs recurrent input, got {it.kind}")
             elif isinstance(layer, DenseLayer):  # includes OutputLayer
-                if it.kind in ("convolutional",):
+                if it.kind in ("convolutional", "convolutional3d"):
                     preprocessors[i] = "flatten"
-                    it = InputType.feedForward(it.height * it.width * it.channels)
+                    it = InputType.feedForward(it.flat_size())
                 elif it.kind == "convolutionalFlat":
                     it = InputType.feedForward(it.flat_size())
 
             # nIn inference (unwrap LastTimeStep/Bidirectional to reach
             # the recurrent layer that actually holds n_in)
-            target = layer.underlying if isinstance(layer, LastTimeStep) \
-                else (layer.layer if isinstance(layer, Bidirectional)
-                      else layer)
+            target = layer
+            # unwrap wrapper layers (LastTimeStep/Bidirectional/MaskZero/
+            # Frozen*) to reach the layer that actually holds n_in
+            while True:
+                if isinstance(target, LastTimeStep):
+                    target = target.underlying
+                elif isinstance(target.__class__.__dict__.get("n_in"),
+                                property) or not hasattr(target, "n_in"):
+                    inner = getattr(target, "layer", None)
+                    if isinstance(inner, Layer) and hasattr(inner, "n_in"):
+                        target = inner
+                    else:
+                        break
+                else:
+                    break
             if hasattr(target, "n_in") and getattr(target, "n_in", 0) in (0, None) \
                     and not isinstance(target, EmbeddingLayer):
-                if it.kind == "convolutional":
+                if it.kind in ("convolutional", "convolutional3d"):
                     target.n_in = it.channels
                 else:
                     target.n_in = it.size

@@ -27,6 +27,7 @@ class InputType:
     height: int = 0
     width: int = 0
     channels: int = 0
+    depth: int = 0          # 3D convolutional only
     timeseries_length: int = -1  # -1 = variable
 
     # -- constructors mirroring the reference's static methods ---------
@@ -45,6 +46,13 @@ class InputType:
                          channels=channels)
 
     @staticmethod
+    def convolutional3D(depth: int, height: int, width: int,
+                        channels: int) -> "InputType":
+        """NDHWC volumetric input (reference: InputType.convolutional3D)."""
+        return InputType(kind="convolutional3d", depth=depth, height=height,
+                         width=width, channels=channels)
+
+    @staticmethod
     def convolutionalFlat(height: int, width: int, channels: int) -> "InputType":
         return InputType(kind="convolutionalFlat", height=height, width=width,
                          channels=channels)
@@ -55,6 +63,8 @@ class InputType:
             return self.size
         if self.kind == "recurrent":
             return self.size * max(self.timeseries_length, 1)
+        if self.kind == "convolutional3d":
+            return self.depth * self.height * self.width * self.channels
         return self.height * self.width * self.channels
 
     def example_shape(self) -> Tuple[int, ...]:
@@ -65,6 +75,8 @@ class InputType:
             return (max(self.timeseries_length, 1), self.size)
         if self.kind == "convolutional":
             return (self.height, self.width, self.channels)
+        if self.kind == "convolutional3d":
+            return (self.depth, self.height, self.width, self.channels)
         if self.kind == "convolutionalFlat":
             return (self.height * self.width * self.channels,)
         raise ValueError(self.kind)

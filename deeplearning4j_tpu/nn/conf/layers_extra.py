@@ -1,0 +1,884 @@
+"""Extended layer set — the rest of the reference's conf/layers/** tree.
+
+Reference (SURVEY.md §2.20): org/deeplearning4j/nn/conf/layers/
+{Convolution1DLayer, Convolution3D, Deconvolution2D,
+DepthwiseConvolution2D, Subsampling1DLayer, Subsampling3DLayer,
+Upsampling1D, Upsampling3D, Cropping1D/2D/3D (convolutional/),
+ZeroPadding1DLayer, ZeroPadding3DLayer, SpaceToDepthLayer,
+SpaceToBatchLayer, LocallyConnected1D, LocallyConnected2D, PReLULayer,
+misc/ElementWiseMultiplicationLayer, misc/RepeatVector,
+misc/FrozenLayerWithBackprop, util/MaskLayer, util/MaskZeroLayer,
+CenterLossOutputLayer, CapsuleLayer, PrimaryCapsules,
+CapsuleStrengthLayer, GRU (legacy conf)}.
+
+Same functional contract as layers.py: each layer is a serializable
+dataclass with pure init_params/apply, composed into ONE jit-compiled
+XLA step by the network front-ends. Layout conventions: images NHWC,
+volumes NDHWC, sequences NTF ([N,T,F] — the reference's 1D-CNN layers
+operate on RNN-format input too).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.common.serde import serializable, _tuplify
+from deeplearning4j_tpu.loss import LossFunction, compute_loss
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import (
+    ConvolutionLayer, Layer, OutputLayer, _act, _conv_out,
+)
+from deeplearning4j_tpu.nn.weights import WeightInit, init_weights
+from deeplearning4j_tpu.ops import nn as nnops
+from deeplearning4j_tpu.ops import shape as shapeops
+
+
+# ----------------------------------------------------------------------
+# recurrent: GRU
+# ----------------------------------------------------------------------
+@serializable
+@dataclasses.dataclass
+class GRU(Layer):
+    """GRU layer over the fused ``gru_layer`` scan (reference: the
+    legacy conf/layers/GRU; gate order r,z,n)."""
+
+    n_in: int = 0
+    n_out: int = 0
+
+    is_recurrent = True
+
+    def output_type(self, it: InputType) -> InputType:
+        return InputType.recurrent(self.n_out, it.timeseries_length)
+
+    def init_params(self, key, it, dtype) -> dict:
+        k1, k2 = jax.random.split(key)
+        h = self.n_out
+        w = init_weights(self.weight_init or WeightInit.XAVIER, k1,
+                         (self.n_in, 3 * h), self.n_in, 3 * h, dtype)
+        rw = init_weights(self.weight_init or WeightInit.XAVIER, k2,
+                          (h, 3 * h), h, 3 * h, dtype)
+        return {"W": w, "RW": rw, "b": jnp.zeros((3 * h,), dtype)}
+
+    def apply(self, params, state, x, train, rng):
+        x = self._maybe_dropout(x, train, rng)
+        ys, _ = nnops.gru_layer(x, params["W"], params["RW"], params["b"])
+        return ys, state
+
+    def init_carry(self, batch, dtype):
+        return jnp.zeros((batch, self.n_out), dtype)
+
+    def apply_with_carry(self, params, state, carry, x, train, rng):
+        ys, new_carry = nnops.gru_layer(
+            x, params["W"], params["RW"], params["b"], h0=carry)
+        return ys, state, new_carry
+
+
+# ----------------------------------------------------------------------
+# 1D convolution family (sequence input, NTF)
+# ----------------------------------------------------------------------
+@serializable
+@dataclasses.dataclass
+class Convolution1D(Layer):
+    """1D conv on [N,T,F] (reference: conf/layers/Convolution1DLayer —
+    operates on RNN-format input)."""
+
+    n_in: int = 0
+    n_out: int = 0
+    kernel_size: int = 3
+    stride: int = 1
+    padding: int = 0
+    convolution_mode: str = "Truncate"
+    dilation: int = 1
+    has_bias: bool = True
+
+    def output_type(self, it: InputType) -> InputType:
+        t = it.timeseries_length
+        if t and t > 0:
+            t = _conv_out(t, self.kernel_size, self.stride,
+                          self.convolution_mode, self.padding, self.dilation)
+        return InputType.recurrent(self.n_out, t)
+
+    def init_params(self, key, it, dtype) -> dict:
+        k = self.kernel_size
+        w = init_weights(self.weight_init or WeightInit.XAVIER, key,
+                         (k, self.n_in, self.n_out), k * self.n_in,
+                         k * self.n_out, dtype)
+        p = {"W": w}
+        if self.has_bias:
+            p["b"] = jnp.zeros((self.n_out,), dtype)
+        return p
+
+    def apply(self, params, state, x, train, rng):
+        x = self._maybe_dropout(x, train, rng)
+        pad = "SAME" if self.convolution_mode == "Same" else self.padding
+        out = nnops.conv1d(x, params["W"], params.get("b"),
+                           stride=self.stride, padding=pad,
+                           dilation=self.dilation)
+        return _act(self.activation or "identity").fn(out), state
+
+
+@serializable
+@dataclasses.dataclass
+class Subsampling1DLayer(Layer):
+    """1D pooling on [N,T,F] (reference: conf/layers/Subsampling1DLayer)."""
+
+    pooling_type: str = "max"
+    kernel_size: int = 2
+    stride: int = 2
+    padding: int = 0
+    convolution_mode: str = "Truncate"
+    pnorm: int = 2
+
+    def has_params(self):
+        return False
+
+    def output_type(self, it: InputType) -> InputType:
+        t = it.timeseries_length
+        if t and t > 0:
+            t = _conv_out(t, self.kernel_size, self.stride,
+                          self.convolution_mode, self.padding)
+        return InputType.recurrent(it.size, t)
+
+    def apply(self, params, state, x, train, rng):
+        pad = "SAME" if self.convolution_mode == "Same" else (
+            "VALID" if self.padding == 0 else self.padding)
+        pt = self.pooling_type.lower()
+        if pt == "max":
+            return nnops.maxpool1d(x, self.kernel_size, self.stride, pad), state
+        if pt == "avg":
+            return nnops.avgpool1d(x, self.kernel_size, self.stride, pad), state
+        if pt == "pnorm":
+            return nnops.pnormpool1d(x, self.kernel_size, self.stride, pad,
+                                     self.pnorm), state
+        return nnops.sumpool1d(x, self.kernel_size, self.stride, pad), state
+
+
+@serializable
+@dataclasses.dataclass
+class Upsampling1D(Layer):
+    """Repeat each timestep `size` times (reference: conf/layers/Upsampling1D)."""
+
+    size: int = 2
+
+    def has_params(self):
+        return False
+
+    def output_type(self, it: InputType) -> InputType:
+        t = it.timeseries_length
+        return InputType.recurrent(it.size, t * self.size if t and t > 0 else t)
+
+    def apply(self, params, state, x, train, rng):
+        return jnp.repeat(x, self.size, axis=1), state
+
+
+@serializable
+@dataclasses.dataclass
+class Cropping1D(Layer):
+    """Crop timesteps from both ends (reference: convolutional/Cropping1D)."""
+
+    crop: Tuple[int, int] = (0, 0)
+
+    def __post_init__(self):
+        c = self.crop
+        self.crop = (c, c) if isinstance(c, int) else _tuplify(c)
+
+    def has_params(self):
+        return False
+
+    def output_type(self, it: InputType) -> InputType:
+        t = it.timeseries_length
+        if t and t > 0:
+            t = t - self.crop[0] - self.crop[1]
+        return InputType.recurrent(it.size, t)
+
+    def apply(self, params, state, x, train, rng):
+        t = x.shape[1]
+        return x[:, self.crop[0]:t - self.crop[1], :], state
+
+
+@serializable
+@dataclasses.dataclass
+class ZeroPadding1DLayer(Layer):
+    """Pad timesteps (reference: conf/layers/ZeroPadding1DLayer)."""
+
+    pad: Tuple[int, int] = (1, 1)
+
+    def __post_init__(self):
+        p = self.pad
+        self.pad = (p, p) if isinstance(p, int) else _tuplify(p)
+
+    def has_params(self):
+        return False
+
+    def output_type(self, it: InputType) -> InputType:
+        t = it.timeseries_length
+        if t and t > 0:
+            t = t + self.pad[0] + self.pad[1]
+        return InputType.recurrent(it.size, t)
+
+    def apply(self, params, state, x, train, rng):
+        return jnp.pad(x, ((0, 0), self.pad, (0, 0))), state
+
+
+# ----------------------------------------------------------------------
+# 2D convolution family extensions
+# ----------------------------------------------------------------------
+@serializable
+@dataclasses.dataclass
+class Deconvolution2D(ConvolutionLayer):
+    """Transposed conv (reference: conf/layers/Deconvolution2D)."""
+
+    def output_type(self, it: InputType) -> InputType:
+        if self.convolution_mode == "Same":
+            h = it.height * self.stride[0]
+            w = it.width * self.stride[1]
+        else:
+            h = self.stride[0] * (it.height - 1) + self.kernel_size[0] \
+                - 2 * self.padding[0]
+            w = self.stride[1] * (it.width - 1) + self.kernel_size[1] \
+                - 2 * self.padding[1]
+        return InputType.convolutional(h, w, self.n_out)
+
+    def apply(self, params, state, x, train, rng):
+        x = self._maybe_dropout(x, train, rng)
+        pad = "SAME" if self.convolution_mode == "Same" else self.padding[0]
+        out = nnops.deconv2d(x, params["W"], params.get("b"),
+                             strides=self.stride, padding=pad)
+        return _act(self.activation or "identity").fn(out), state
+
+
+@serializable
+@dataclasses.dataclass
+class DepthwiseConvolution2D(ConvolutionLayer):
+    """Depthwise conv (reference: conf/layers/DepthwiseConvolution2D).
+    n_out = n_in * depth_multiplier."""
+
+    depth_multiplier: int = 1
+
+    def output_type(self, it: InputType) -> InputType:
+        base = super().output_type(it)
+        return InputType.convolutional(base.height, base.width,
+                                       self.n_in * self.depth_multiplier)
+
+    def init_params(self, key, it, dtype) -> dict:
+        kh, kw = self.kernel_size
+        fan = kh * kw * self.n_in
+        w = init_weights(self.weight_init or WeightInit.XAVIER, key,
+                         (kh, kw, self.n_in, self.depth_multiplier),
+                         fan, fan * self.depth_multiplier, dtype)
+        p = {"W": w}
+        if self.has_bias:
+            p["b"] = jnp.zeros((self.n_in * self.depth_multiplier,), dtype)
+        return p
+
+    def apply(self, params, state, x, train, rng):
+        x = self._maybe_dropout(x, train, rng)
+        out = nnops.depthwise_conv2d(x, params["W"], params.get("b"),
+                                     strides=self.stride,
+                                     padding=self._pad_arg(),
+                                     dilation=self.dilation)
+        return _act(self.activation or "identity").fn(out), state
+
+
+@serializable
+@dataclasses.dataclass
+class Cropping2D(Layer):
+    """Crop H/W (reference: convolutional/Cropping2D)."""
+
+    crop: Tuple[int, int, int, int] = (0, 0, 0, 0)  # top,bottom,left,right
+
+    def __post_init__(self):
+        c = _tuplify(self.crop)
+        if isinstance(c, int):
+            c = (c, c, c, c)
+        elif len(c) == 2:
+            c = (c[0], c[0], c[1], c[1])
+        self.crop = c
+
+    def has_params(self):
+        return False
+
+    def output_type(self, it: InputType) -> InputType:
+        t, b, l, r = self.crop
+        return InputType.convolutional(it.height - t - b, it.width - l - r,
+                                       it.channels)
+
+    def apply(self, params, state, x, train, rng):
+        t, b, l, r = self.crop
+        return x[:, t:x.shape[1] - b, l:x.shape[2] - r, :], state
+
+
+@serializable
+@dataclasses.dataclass
+class SpaceToDepthLayer(Layer):
+    """(reference: conf/layers/SpaceToDepthLayer)."""
+
+    block_size: int = 2
+
+    def has_params(self):
+        return False
+
+    def output_type(self, it: InputType) -> InputType:
+        bs = self.block_size
+        return InputType.convolutional(it.height // bs, it.width // bs,
+                                       it.channels * bs * bs)
+
+    def apply(self, params, state, x, train, rng):
+        return shapeops.space_to_depth(x, self.block_size), state
+
+
+@serializable
+@dataclasses.dataclass
+class SpaceToBatchLayer(Layer):
+    """(reference: conf/layers/SpaceToBatchLayer)."""
+
+    block_size: int = 2
+    padding: Tuple[Tuple[int, int], Tuple[int, int]] = ((0, 0), (0, 0))
+
+    def __post_init__(self):
+        p = _tuplify(self.padding)
+        self.padding = tuple(_tuplify(v) for v in p)
+
+    def has_params(self):
+        return False
+
+    def output_type(self, it: InputType) -> InputType:
+        bs = self.block_size
+        (pt, pb), (pl, pr) = self.padding
+        return InputType.convolutional((it.height + pt + pb) // bs,
+                                       (it.width + pl + pr) // bs,
+                                       it.channels)
+
+    def apply(self, params, state, x, train, rng):
+        return shapeops.space_to_batch(
+            x, (self.block_size, self.block_size), list(self.padding)), state
+
+
+# ----------------------------------------------------------------------
+# 3D convolution family (volumes, NDHWC)
+# ----------------------------------------------------------------------
+@serializable
+@dataclasses.dataclass
+class Convolution3D(Layer):
+    """3D conv on [N,D,H,W,C] (reference: conf/layers/Convolution3D;
+    reference layout NCDHW — here NDHWC, the TPU-preferred layout)."""
+
+    n_in: int = 0
+    n_out: int = 0
+    kernel_size: Tuple[int, int, int] = (3, 3, 3)
+    stride: Tuple[int, int, int] = (1, 1, 1)
+    padding: Tuple[int, int, int] = (0, 0, 0)
+    convolution_mode: str = "Truncate"
+    dilation: Tuple[int, int, int] = (1, 1, 1)
+    has_bias: bool = True
+
+    def __post_init__(self):
+        for f in ("kernel_size", "stride", "padding", "dilation"):
+            v = getattr(self, f)
+            setattr(self, f, (v, v, v) if isinstance(v, int) else _tuplify(v))
+
+    def output_type(self, it: InputType) -> InputType:
+        dims = [_conv_out(s, self.kernel_size[i], self.stride[i],
+                          self.convolution_mode, self.padding[i],
+                          self.dilation[i])
+                for i, s in enumerate((it.depth, it.height, it.width))]
+        return InputType.convolutional3D(dims[0], dims[1], dims[2], self.n_out)
+
+    def init_params(self, key, it, dtype) -> dict:
+        kd, kh, kw = self.kernel_size
+        fan_in = kd * kh * kw * self.n_in
+        fan_out = kd * kh * kw * self.n_out
+        w = init_weights(self.weight_init or WeightInit.XAVIER, key,
+                         (kd, kh, kw, self.n_in, self.n_out), fan_in, fan_out,
+                         dtype)
+        p = {"W": w}
+        if self.has_bias:
+            p["b"] = jnp.zeros((self.n_out,), dtype)
+        return p
+
+    def apply(self, params, state, x, train, rng):
+        x = self._maybe_dropout(x, train, rng)
+        pad = "SAME" if self.convolution_mode == "Same" else self.padding
+        out = nnops.conv3d(x, params["W"], params.get("b"),
+                           strides=self.stride, padding=pad,
+                           dilation=self.dilation)
+        return _act(self.activation or "identity").fn(out), state
+
+
+@serializable
+@dataclasses.dataclass
+class Subsampling3DLayer(Layer):
+    """3D pooling (reference: conf/layers/Subsampling3DLayer)."""
+
+    pooling_type: str = "max"
+    kernel_size: Tuple[int, int, int] = (2, 2, 2)
+    stride: Tuple[int, int, int] = (2, 2, 2)
+    padding: Tuple[int, int, int] = (0, 0, 0)
+    convolution_mode: str = "Truncate"
+
+    def __post_init__(self):
+        for f in ("kernel_size", "stride", "padding"):
+            v = getattr(self, f)
+            setattr(self, f, (v, v, v) if isinstance(v, int) else _tuplify(v))
+
+    def has_params(self):
+        return False
+
+    def output_type(self, it: InputType) -> InputType:
+        dims = [_conv_out(s, self.kernel_size[i], self.stride[i],
+                          self.convolution_mode, self.padding[i])
+                for i, s in enumerate((it.depth, it.height, it.width))]
+        return InputType.convolutional3D(dims[0], dims[1], dims[2], it.channels)
+
+    def apply(self, params, state, x, train, rng):
+        pad = "SAME" if self.convolution_mode == "Same" else (
+            "VALID" if self.padding == (0, 0, 0) else self.padding)
+        if self.pooling_type.lower() == "avg":
+            return nnops.avgpool3d(x, self.kernel_size, self.stride, pad), state
+        return nnops.maxpool3d(x, self.kernel_size, self.stride, pad), state
+
+
+@serializable
+@dataclasses.dataclass
+class Upsampling3D(Layer):
+    """(reference: conf/layers/Upsampling3D)."""
+
+    size: int = 2
+
+    def has_params(self):
+        return False
+
+    def output_type(self, it: InputType) -> InputType:
+        s = self.size
+        return InputType.convolutional3D(it.depth * s, it.height * s,
+                                         it.width * s, it.channels)
+
+    def apply(self, params, state, x, train, rng):
+        s = self.size
+        x = jnp.repeat(x, s, axis=1)
+        x = jnp.repeat(x, s, axis=2)
+        return jnp.repeat(x, s, axis=3), state
+
+
+@serializable
+@dataclasses.dataclass
+class Cropping3D(Layer):
+    """(reference: convolutional/Cropping3D)."""
+
+    crop: Tuple[int, int, int, int, int, int] = (0, 0, 0, 0, 0, 0)
+
+    def __post_init__(self):
+        c = _tuplify(self.crop)
+        if isinstance(c, int):
+            c = (c,) * 6
+        elif len(c) == 3:
+            c = (c[0], c[0], c[1], c[1], c[2], c[2])
+        self.crop = c
+
+    def has_params(self):
+        return False
+
+    def output_type(self, it: InputType) -> InputType:
+        c = self.crop
+        return InputType.convolutional3D(it.depth - c[0] - c[1],
+                                         it.height - c[2] - c[3],
+                                         it.width - c[4] - c[5], it.channels)
+
+    def apply(self, params, state, x, train, rng):
+        c = self.crop
+        return x[:, c[0]:x.shape[1] - c[1], c[2]:x.shape[2] - c[3],
+                 c[4]:x.shape[3] - c[5], :], state
+
+
+@serializable
+@dataclasses.dataclass
+class ZeroPadding3DLayer(Layer):
+    """(reference: conf/layers/ZeroPadding3DLayer)."""
+
+    pad: Tuple[int, int, int] = (1, 1, 1)
+
+    def __post_init__(self):
+        p = _tuplify(self.pad)
+        self.pad = (p, p, p) if isinstance(p, int) else p
+
+    def has_params(self):
+        return False
+
+    def output_type(self, it: InputType) -> InputType:
+        p = self.pad
+        return InputType.convolutional3D(it.depth + 2 * p[0],
+                                         it.height + 2 * p[1],
+                                         it.width + 2 * p[2], it.channels)
+
+    def apply(self, params, state, x, train, rng):
+        p = self.pad
+        return jnp.pad(x, ((0, 0), (p[0], p[0]), (p[1], p[1]),
+                           (p[2], p[2]), (0, 0))), state
+
+
+# ----------------------------------------------------------------------
+# locally connected (unshared weights)
+# ----------------------------------------------------------------------
+@serializable
+@dataclasses.dataclass
+class LocallyConnected2D(Layer):
+    """Unshared-weight 2D conv (reference: conf/layers/LocallyConnected2D,
+    a SameDiff layer in the reference — here a first-class layer whose
+    im2col+einsum stays on the MXU)."""
+
+    n_in: int = 0
+    n_out: int = 0
+    kernel_size: Tuple[int, int] = (2, 2)
+    stride: Tuple[int, int] = (1, 1)
+    has_bias: bool = True
+    #: resolved at init from the input type
+    input_size: Tuple[int, int] = (0, 0)
+
+    def __post_init__(self):
+        self.kernel_size = _tuplify(self.kernel_size)
+        self.stride = _tuplify(self.stride)
+        self.input_size = _tuplify(self.input_size)
+
+    def _out_hw(self, it: InputType):
+        h = _conv_out(it.height, self.kernel_size[0], self.stride[0],
+                      "Truncate", 0)
+        w = _conv_out(it.width, self.kernel_size[1], self.stride[1],
+                      "Truncate", 0)
+        return h, w
+
+    def output_type(self, it: InputType) -> InputType:
+        h, w = self._out_hw(it)
+        return InputType.convolutional(h, w, self.n_out)
+
+    def init_params(self, key, it, dtype) -> dict:
+        self.input_size = (it.height, it.width)
+        oh, ow = self._out_hw(it)
+        kh, kw = self.kernel_size
+        kc = kh * kw * self.n_in
+        w = init_weights(self.weight_init or WeightInit.XAVIER, key,
+                         (oh * ow, kc, self.n_out), kc, self.n_out, dtype)
+        p = {"W": w}
+        if self.has_bias:
+            p["b"] = jnp.zeros((self.n_out,), dtype)
+        return p
+
+    def apply(self, params, state, x, train, rng):
+        out = nnops.locally_connected2d(x, params["W"], params.get("b"),
+                                        self.kernel_size, self.stride, "VALID")
+        return _act(self.activation or "identity").fn(out), state
+
+
+@serializable
+@dataclasses.dataclass
+class LocallyConnected1D(Layer):
+    """Unshared-weight 1D conv (reference: conf/layers/LocallyConnected1D)."""
+
+    n_in: int = 0
+    n_out: int = 0
+    kernel_size: int = 2
+    stride: int = 1
+    has_bias: bool = True
+
+    def output_type(self, it: InputType) -> InputType:
+        t = it.timeseries_length
+        if t and t > 0:
+            t = _conv_out(t, self.kernel_size, self.stride, "Truncate", 0)
+        return InputType.recurrent(self.n_out, t)
+
+    def init_params(self, key, it, dtype) -> dict:
+        t = it.timeseries_length
+        if not t or t <= 0:
+            raise ValueError("LocallyConnected1D needs a fixed sequence length")
+        ot = _conv_out(t, self.kernel_size, self.stride, "Truncate", 0)
+        kc = self.kernel_size * self.n_in
+        w = init_weights(self.weight_init or WeightInit.XAVIER, key,
+                         (ot, kc, self.n_out), kc, self.n_out, dtype)
+        p = {"W": w}
+        if self.has_bias:
+            p["b"] = jnp.zeros((self.n_out,), dtype)
+        return p
+
+    def apply(self, params, state, x, train, rng):
+        out = nnops.locally_connected1d(x, params["W"], params.get("b"),
+                                        self.kernel_size, self.stride, "VALID")
+        return _act(self.activation or "identity").fn(out), state
+
+
+# ----------------------------------------------------------------------
+# misc parameterized layers
+# ----------------------------------------------------------------------
+@serializable
+@dataclasses.dataclass
+class PReLULayer(Layer):
+    """Learned per-feature leaky slope (reference: conf/layers/PReLULayer)."""
+
+    n_in: int = 0  # feature width (inferred)
+
+    def output_type(self, it: InputType) -> InputType:
+        return it
+
+    def init_params(self, key, it, dtype) -> dict:
+        n = it.channels if it.kind in ("convolutional", "convolutional3d") \
+            else it.size
+        self.n_in = self.n_in or n
+        return {"alpha": jnp.zeros((self.n_in,), dtype)}
+
+    def apply(self, params, state, x, train, rng):
+        from deeplearning4j_tpu.ops.transforms import prelu
+        return prelu(x, params["alpha"]), state
+
+
+@serializable
+@dataclasses.dataclass
+class ElementWiseMultiplicationLayer(Layer):
+    """out = act(x * w + b), learned elementwise scale (reference:
+    conf/layers/misc/ElementWiseMultiplicationLayer)."""
+
+    n_in: int = 0
+    n_out: int = 0  # == n_in; kept for config parity
+
+    def output_type(self, it: InputType) -> InputType:
+        return it
+
+    def init_params(self, key, it, dtype) -> dict:
+        n = self.n_in or it.size
+        self.n_in = self.n_out = n
+        return {"W": jnp.ones((n,), dtype), "b": jnp.zeros((n,), dtype)}
+
+    def apply(self, params, state, x, train, rng):
+        return _act(self.activation or "identity").fn(
+            x * params["W"] + params["b"]), state
+
+
+@serializable
+@dataclasses.dataclass
+class RepeatVector(Layer):
+    """[N,F] -> [N,n,F] (reference: conf/layers/misc/RepeatVector)."""
+
+    n: int = 1
+
+    def has_params(self):
+        return False
+
+    def output_type(self, it: InputType) -> InputType:
+        return InputType.recurrent(it.size, self.n)
+
+    def apply(self, params, state, x, train, rng):
+        return jnp.broadcast_to(x[:, None, :],
+                                (x.shape[0], self.n, x.shape[-1])), state
+
+
+@serializable
+@dataclasses.dataclass
+class MaskLayer(Layer):
+    """Pass-through (reference: conf/layers/util/MaskLayer — zeroes
+    activations at masked timesteps; in this framework masks are carried
+    alongside activations and applied in the loss, so forward is
+    identity. Kept for config/import parity)."""
+
+    def has_params(self):
+        return False
+
+    def apply(self, params, state, x, train, rng):
+        return x, state
+
+
+@serializable
+@dataclasses.dataclass
+class MaskZeroLayer(Layer):
+    """Wrap a recurrent layer; timesteps whose input features all equal
+    ``mask_value`` produce zero output (reference:
+    conf/layers/util/MaskZeroLayer)."""
+
+    layer: Optional[Layer] = None
+    mask_value: float = 0.0
+
+    @property
+    def is_recurrent(self):
+        return self.layer is not None and self.layer.is_recurrent
+
+    @property
+    def n_in(self):
+        return self.layer.n_in
+
+    @n_in.setter
+    def n_in(self, v):
+        self.layer.n_in = v
+
+    @property
+    def n_out(self):
+        return self.layer.n_out
+
+    def has_params(self):
+        return self.layer.has_params()
+
+    def output_type(self, it: InputType) -> InputType:
+        return self.layer.output_type(it)
+
+    def init_params(self, key, it, dtype) -> dict:
+        return self.layer.init_params(key, it, dtype)
+
+    def init_state(self, it, dtype) -> dict:
+        return self.layer.init_state(it, dtype)
+
+    def apply(self, params, state, x, train, rng):
+        mask = jnp.any(x != self.mask_value, axis=-1, keepdims=True)
+        out, st = self.layer.apply(params, state, x, train, rng)
+        return out * mask.astype(out.dtype), st
+
+
+# ----------------------------------------------------------------------
+# CenterLoss output head
+# ----------------------------------------------------------------------
+@serializable
+@dataclasses.dataclass
+class CenterLossOutputLayer(OutputLayer):
+    """Softmax head + center loss (reference:
+    conf/layers/CenterLossOutputLayer; Wen et al. 2016).
+
+    Loss = CE + (lambda/2)·||x − c_y||². Design deviation: the reference
+    updates centers with a dedicated alpha running average outside the
+    optimizer; here centers are parameters whose gradient
+    (lambda·(c_y − x)) flows through the shared updater — same fixed
+    point, one compiled step.
+    """
+
+    alpha: float = 0.05     # kept for config parity
+    lambda_: float = 2e-4
+
+    def init_params(self, key, it, dtype) -> dict:
+        p = super().init_params(key, it, dtype)
+        p["centers"] = jnp.zeros((self.n_out, self.n_in), dtype)
+        return p
+
+    def loss_value(self, params, state, x, labels, mask=None):
+        base = super().loss_value(params, state, x, labels, mask)
+        # labels one-hot [N, n_out] -> per-row center [N, n_in]
+        cy = labels @ params["centers"]
+        d = x - cy
+        center = jnp.mean(jnp.sum(d * d, axis=-1))
+        return base + 0.5 * self.lambda_ * center
+
+
+# ----------------------------------------------------------------------
+# Capsule network layers (reference: CapsuleLayer, PrimaryCapsules,
+# CapsuleStrengthLayer — Sabour et al. 2017 dynamic routing)
+# ----------------------------------------------------------------------
+def _squash(s, axis=-1, eps=1e-8):
+    n2 = jnp.sum(s * s, axis=axis, keepdims=True)
+    return (n2 / (1.0 + n2)) * s / jnp.sqrt(n2 + eps)
+
+
+@serializable
+@dataclasses.dataclass
+class PrimaryCapsules(Layer):
+    """Conv -> capsule reshape + squash (reference: conf/layers/
+    PrimaryCapsules). Output: recurrent [N, n_caps, capsule_dim]."""
+
+    n_in: int = 0
+    capsules: int = 0            # inferred from conv geometry if 0
+    capsule_dimensions: int = 8
+    channels: int = 32           # conv output channels per capsule dim
+    kernel_size: Tuple[int, int] = (9, 9)
+    stride: Tuple[int, int] = (2, 2)
+
+    def __post_init__(self):
+        self.kernel_size = _tuplify(self.kernel_size)
+        self.stride = _tuplify(self.stride)
+
+    def _conv_geom(self, it: InputType):
+        h = _conv_out(it.height, self.kernel_size[0], self.stride[0],
+                      "Truncate", 0)
+        w = _conv_out(it.width, self.kernel_size[1], self.stride[1],
+                      "Truncate", 0)
+        return h, w
+
+    def output_type(self, it: InputType) -> InputType:
+        h, w = self._conv_geom(it)
+        caps = self.capsules or h * w * self.channels
+        return InputType.recurrent(self.capsule_dimensions, caps)
+
+    def init_params(self, key, it, dtype) -> dict:
+        kh, kw = self.kernel_size
+        c_out = self.channels * self.capsule_dimensions
+        fan_in = kh * kw * self.n_in
+        w = init_weights(self.weight_init or WeightInit.XAVIER, key,
+                         (kh, kw, self.n_in, c_out), fan_in,
+                         kh * kw * c_out, dtype)
+        return {"W": w, "b": jnp.zeros((c_out,), dtype)}
+
+    def apply(self, params, state, x, train, rng):
+        out = nnops.conv2d(x, params["W"], params["b"],
+                           strides=self.stride, padding=self.padding_arg())
+        n = out.shape[0]
+        out = out.reshape(n, -1, self.capsule_dimensions)
+        return _squash(out), state
+
+    def padding_arg(self):
+        return (0, 0)
+
+
+@serializable
+@dataclasses.dataclass
+class CapsuleLayer(Layer):
+    """Dynamic-routing capsule layer (reference: conf/layers/CapsuleLayer).
+
+    Input [N, in_caps, in_dim] -> output [N, capsules, capsule_dim].
+    Routing runs a fixed `routings` iterations — static control flow,
+    so the whole routing unrolls into one XLA program.
+    """
+
+    n_in: int = 0                # input capsule dim (inferred)
+    input_capsules: int = 0      # inferred
+    capsules: int = 10
+    capsule_dimensions: int = 16
+    routings: int = 3
+
+    def output_type(self, it: InputType) -> InputType:
+        return InputType.recurrent(self.capsule_dimensions, self.capsules)
+
+    def init_params(self, key, it, dtype) -> dict:
+        in_caps = self.input_capsules or max(it.timeseries_length, 1)
+        in_dim = self.n_in or it.size
+        self.input_capsules, self.n_in = in_caps, in_dim
+        w = init_weights(self.weight_init or WeightInit.XAVIER, key,
+                         (in_caps, in_dim, self.capsules *
+                          self.capsule_dimensions),
+                         in_dim, self.capsules * self.capsule_dimensions,
+                         dtype)
+        return {"W": w}
+
+    def apply(self, params, state, x, train, rng):
+        n, in_caps, _ = x.shape
+        oc, od = self.capsules, self.capsule_dimensions
+        # predictions u_hat: [N, in_caps, out_caps, out_dim]
+        u_hat = jnp.einsum("nid,ido->nio", x, params["W"]) \
+            .reshape(n, in_caps, oc, od)
+        b = jnp.zeros((n, in_caps, oc), x.dtype)
+        v = None
+        for _ in range(self.routings):
+            c = jax.nn.softmax(b, axis=2)                  # route weights
+            s = jnp.einsum("nio,niod->nod", c, u_hat)      # weighted sum
+            v = _squash(s)                                 # [N, oc, od]
+            b = b + jnp.einsum("niod,nod->nio", u_hat, v)  # agreement
+        return v, state
+
+
+@serializable
+@dataclasses.dataclass
+class CapsuleStrengthLayer(Layer):
+    """Capsule norms [N, caps, dim] -> [N, caps] (reference:
+    conf/layers/CapsuleStrengthLayer)."""
+
+    def has_params(self):
+        return False
+
+    def output_type(self, it: InputType) -> InputType:
+        return InputType.feedForward(max(it.timeseries_length, 1))
+
+    def apply(self, params, state, x, train, rng):
+        return jnp.sqrt(jnp.sum(x * x, axis=-1) + 1e-12), state

@@ -74,6 +74,25 @@ class FrozenLayer(Layer):
                                            rng)
 
 
+@serializable
+@dataclasses.dataclass
+class FrozenLayerWithBackprop(FrozenLayer):
+    """Frozen params, but epsilons still flow to layers below (reference:
+    conf/layers/misc/FrozenLayerWithBackprop). In this functional design
+    stop_gradient on params already lets the input gradient through, so
+    the only difference from FrozenLayer is that the wrapped layer keeps
+    its train-mode behavior (dropout/BN batch stats)."""
+
+    def apply(self, params, state, x, train, rng):
+        frozen = jax.tree_util.tree_map(jax.lax.stop_gradient, params)
+        return self.layer.apply(frozen, state, x, train, rng)
+
+    def apply_with_carry(self, params, state, carry, x, train, rng):
+        frozen = jax.tree_util.tree_map(jax.lax.stop_gradient, params)
+        return self.layer.apply_with_carry(frozen, state, carry, x, train,
+                                           rng)
+
+
 @dataclasses.dataclass
 class FineTuneConfiguration:
     """Global overrides applied when fine-tuning (reference:

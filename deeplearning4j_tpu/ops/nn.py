@@ -236,6 +236,108 @@ def maxpool1d(x, kernel=2, stride=None, padding="VALID"):
     )
 
 
+@register_op("avgpool1d")
+def avgpool1d(x, kernel=2, stride=None, padding="VALID"):
+    s = stride if stride is not None else kernel
+    pad = (padding.upper() if isinstance(padding, str)
+           else [(0, 0), (padding, padding), (0, 0)])
+    summed = lax.reduce_window(x, 0.0, lax.add, (1, kernel, 1), (1, s, 1), pad)
+    if pad == "VALID":
+        return summed / kernel
+    counts = lax.reduce_window(jnp.ones_like(x), 0.0, lax.add,
+                               (1, kernel, 1), (1, s, 1), pad)
+    return summed / counts
+
+
+@register_op("sumpool1d")
+def sumpool1d(x, kernel=2, stride=None, padding="VALID"):
+    s = stride if stride is not None else kernel
+    pad = (padding.upper() if isinstance(padding, str)
+           else [(0, 0), (padding, padding), (0, 0)])
+    return lax.reduce_window(x, 0.0, lax.add, (1, kernel, 1), (1, s, 1), pad)
+
+
+@register_op("pnormpool1d")
+def pnormpool1d(x, kernel=2, stride=None, padding="VALID", p=2):
+    s = stride if stride is not None else kernel
+    pad = (padding.upper() if isinstance(padding, str)
+           else [(0, 0), (padding, padding), (0, 0)])
+    summed = lax.reduce_window(jnp.abs(x) ** p, 0.0, lax.add,
+                               (1, kernel, 1), (1, s, 1), pad)
+    return summed ** (1.0 / p)
+
+
+def _triple(v):
+    return (v, v, v) if isinstance(v, int) else tuple(v)
+
+
+def _pool3d_pad(padding):
+    if isinstance(padding, str):
+        return padding.upper()
+    p = _triple(padding)
+    return [(0, 0), (p[0], p[0]), (p[1], p[1]), (p[2], p[2]), (0, 0)]
+
+
+@register_op("maxpool3d")
+def maxpool3d(x, kernel=(2, 2, 2), strides=None, padding="VALID"):
+    """3D max pool over NDHWC (reference: maxpool3dnew.cpp)."""
+    k = _triple(kernel)
+    s = _triple(strides) if strides is not None else k
+    return lax.reduce_window(
+        x, -jnp.inf, lax.max, (1, k[0], k[1], k[2], 1),
+        (1, s[0], s[1], s[2], 1), _pool3d_pad(padding))
+
+
+@register_op("avgpool3d")
+def avgpool3d(x, kernel=(2, 2, 2), strides=None, padding="VALID"):
+    """3D avg pool over NDHWC (reference: avgpool3dnew.cpp)."""
+    k = _triple(kernel)
+    s = _triple(strides) if strides is not None else k
+    pad = _pool3d_pad(padding)
+    summed = lax.reduce_window(
+        x, 0.0, lax.add, (1, k[0], k[1], k[2], 1),
+        (1, s[0], s[1], s[2], 1), pad)
+    if pad == "VALID":
+        return summed / (k[0] * k[1] * k[2])
+    counts = lax.reduce_window(
+        jnp.ones_like(x), 0.0, lax.add, (1, k[0], k[1], k[2], 1),
+        (1, s[0], s[1], s[2], 1), pad)
+    return summed / counts
+
+
+@register_op("locally_connected2d")
+def locally_connected2d(x, w, b=None, kernel=(2, 2), strides=(1, 1),
+                        padding="VALID"):
+    """Unshared-weight conv (reference: LocallyConnected2D samediff layer).
+
+    x: [N,H,W,C]; w: [outH*outW, kH*kW*C, C_out] — one filter bank per
+    output position. im2col + batched einsum keeps it on the MXU.
+    """
+    patches = im2col(x, kernel, strides, padding)      # [N,oh,ow,kH*kW*C]
+    n, oh, ow, kc = patches.shape
+    out = jnp.einsum("npk,pko->npo", patches.reshape(n, oh * ow, kc), w)
+    out = out.reshape(n, oh, ow, w.shape[-1])
+    if b is not None:
+        out = out + b
+    return out
+
+
+@register_op("locally_connected1d")
+def locally_connected1d(x, w, b=None, kernel=2, stride=1, padding="VALID"):
+    """1D unshared conv (reference: LocallyConnected1D samediff layer).
+
+    x: [N,T,F]; w: [outT, k*F, C_out].
+    """
+    patches = lax.conv_general_dilated_patches(
+        x, (kernel,), (stride,),
+        padding if isinstance(padding, str) else [(padding, padding)],
+        dimension_numbers=("NWC", "WIO", "NWC"))       # [N,oT,k*F]
+    out = jnp.einsum("npk,pko->npo", patches, w)
+    if b is not None:
+        out = out + b
+    return out
+
+
 @register_op("global_avg_pool")
 def global_avg_pool(x, spatial_axes=(1, 2)):
     return jnp.mean(x, axis=spatial_axes)

@@ -1,0 +1,170 @@
+"""NASNet-A (reference: zoo/model/NASNet.java — Zoph et al. 2018
+"Learning Transferable Architectures"; the reference ships the Mobile
+variant as a ComputationGraph of separable-conv cells).
+
+Cell structure follows NASNet-A: each cell consumes the two previous
+hidden states (h_{i-1}, h_{i-2}), adjusts both to the cell's filter
+count with 1x1 conv+BN, combines them through five two-branch blocks
+(separable 3x3/5x5/7x7 convs, 3x3 avg/max pools, identities) summed
+pairwise, and concatenates the block outputs. Reduction cells stride
+their first-stage branches by 2. All branches are MXU-shaped work in
+NHWC; the whole graph compiles to one XLA program per step.
+"""
+
+from __future__ import annotations
+
+from deeplearning4j_tpu.learning import Adam
+from deeplearning4j_tpu.nn.conf import (
+    ActivationLayer, BatchNormalization, ConvolutionLayer,
+    GlobalPoolingLayer, InputType, OutputLayer, SeparableConvolution2D,
+    SubsamplingLayer,
+)
+from deeplearning4j_tpu.nn.graph import (
+    ComputationGraph, ComputationGraphConfiguration, ElementWiseVertex,
+    MergeVertex,
+)
+from deeplearning4j_tpu.zoo.base import ZooModel
+
+
+class NASNet(ZooModel):
+    """NASNet-A. Defaults approximate the reference's Mobile variant
+    (num_cells=4, penultimate_filters=1056 -> filters=44); tests shrink
+    both. reference: zoo/model/NASNet.java builder knobs numBlocks/
+    penultimateFilters/stemFilters."""
+
+    def __init__(self, num_classes: int = 1000, seed: int = 42,
+                 updater=None, in_shape=(224, 224, 3), num_cells: int = 4,
+                 penultimate_filters: int = 1056, stem_filters: int = 32):
+        self.num_classes = num_classes
+        self.seed = seed
+        self.updater = updater or Adam(1e-3)
+        self.in_shape = in_shape
+        self.num_cells = num_cells
+        # NASNet-A: penultimate = 24 * filters for the mobile layout
+        self.filters = max(penultimate_filters // 24, 4)
+        self.stem_filters = stem_filters
+
+    # -- branch helpers -------------------------------------------------
+    def _sep(self, b, name, inp, n_out, kernel, stride=(1, 1)):
+        """relu -> sepconv -> BN, twice (NASNet's separable stack)."""
+        b.addLayer(f"{name}_relu", ActivationLayer(activation="relu"), inp)
+        b.addLayer(f"{name}_s1", SeparableConvolution2D(
+            n_out=n_out, kernel_size=kernel, stride=stride,
+            convolution_mode="Same", activation="identity", has_bias=False),
+            f"{name}_relu")
+        b.addLayer(f"{name}_bn1", BatchNormalization(activation="relu"),
+                   f"{name}_s1")
+        b.addLayer(f"{name}_s2", SeparableConvolution2D(
+            n_out=n_out, kernel_size=kernel, stride=(1, 1),
+            convolution_mode="Same", activation="identity", has_bias=False),
+            f"{name}_bn1")
+        b.addLayer(f"{name}_bn2", BatchNormalization(), f"{name}_s2")
+        return f"{name}_bn2"
+
+    def _adjust(self, b, name, inp, n_out, stride=(1, 1)):
+        """1x1 conv+BN projection to the cell's filter count."""
+        b.addLayer(f"{name}_relu", ActivationLayer(activation="relu"), inp)
+        b.addLayer(f"{name}_conv", ConvolutionLayer(
+            n_out=n_out, kernel_size=(1, 1), stride=stride,
+            convolution_mode="Same", activation="identity", has_bias=False),
+            f"{name}_relu")
+        b.addLayer(f"{name}_bn", BatchNormalization(), f"{name}_conv")
+        return f"{name}_bn"
+
+    def _avgpool(self, b, name, inp, stride=(1, 1)):
+        b.addLayer(name, SubsamplingLayer(
+            pooling_type="avg", kernel_size=(3, 3), stride=stride,
+            convolution_mode="Same"), inp)
+        return name
+
+    def _maxpool(self, b, name, inp, stride=(1, 1)):
+        b.addLayer(name, SubsamplingLayer(
+            pooling_type="max", kernel_size=(3, 3), stride=stride,
+            convolution_mode="Same"), inp)
+        return name
+
+    def _add(self, b, name, x1, x2):
+        b.addVertex(name, ElementWiseVertex(op="Add"), x1, x2)
+        return name
+
+    # -- cells ----------------------------------------------------------
+    def _normal_cell(self, b, name, h, h_prev, f):
+        """NASNet-A normal cell: 5 blocks, concat outputs."""
+        hp = self._adjust(b, f"{name}_adj", h, f)
+        pp = self._adjust(b, f"{name}_adjp", h_prev, f)
+        b1 = self._add(b, f"{name}_b1",
+                       self._sep(b, f"{name}_b1l", hp, f, (5, 5)),
+                       self._sep(b, f"{name}_b1r", pp, f, (3, 3)))
+        b2 = self._add(b, f"{name}_b2",
+                       self._sep(b, f"{name}_b2l", pp, f, (5, 5)),
+                       self._sep(b, f"{name}_b2r", pp, f, (3, 3)))
+        b3 = self._add(b, f"{name}_b3",
+                       self._avgpool(b, f"{name}_b3l", hp), pp)
+        b4 = self._add(b, f"{name}_b4",
+                       self._avgpool(b, f"{name}_b4l", pp),
+                       self._avgpool(b, f"{name}_b4r", pp))
+        b5 = self._add(b, f"{name}_b5",
+                       self._sep(b, f"{name}_b5l", hp, f, (3, 3)), hp)
+        b.addVertex(f"{name}_out", MergeVertex(), b1, b2, b3, b4, b5)
+        return f"{name}_out"
+
+    def _reduction_cell(self, b, name, h, h_prev, f):
+        """NASNet-A reduction cell: stride-2 first stages, concat."""
+        hp = self._adjust(b, f"{name}_adj", h, f)
+        pp = self._adjust(b, f"{name}_adjp", h_prev, f, stride=(2, 2))
+        b1 = self._add(b, f"{name}_b1",
+                       self._sep(b, f"{name}_b1l", hp, f, (5, 5), (2, 2)),
+                       self._sep(b, f"{name}_b1r", hp, f, (7, 7), (2, 2)))
+        b2 = self._add(b, f"{name}_b2",
+                       self._maxpool(b, f"{name}_b2l", hp, (2, 2)),
+                       self._sep(b, f"{name}_b2r", hp, f, (7, 7), (2, 2)))
+        b3 = self._add(b, f"{name}_b3",
+                       self._avgpool(b, f"{name}_b3l", hp, (2, 2)),
+                       self._sep(b, f"{name}_b3r", hp, f, (5, 5), (2, 2)))
+        # second-stage branches operate at the reduced resolution
+        b4 = self._add(b, f"{name}_b4",
+                       self._maxpool(b, f"{name}_b4l", hp, (2, 2)),
+                       self._sep(b, f"{name}_b4r", b1, f, (3, 3)))
+        b5 = self._add(b, f"{name}_b5",
+                       self._avgpool(b, f"{name}_b5l", b1), pp)
+        b.addVertex(f"{name}_out", MergeVertex(), b2, b3, b4, b5)
+        return f"{name}_out"
+
+    # -- full graph -----------------------------------------------------
+    def conf(self) -> ComputationGraphConfiguration:
+        h, w, c = self.in_shape
+        f = self.filters
+        b = (ComputationGraphConfiguration.graphBuilder()
+             .seed(self.seed).updater(self.updater).weightInit("relu")
+             .addInputs("input")
+             .setInputTypes(InputType.convolutional(h, w, c)))
+        b.addLayer("stem_conv", ConvolutionLayer(
+            n_out=self.stem_filters, kernel_size=(3, 3), stride=(2, 2),
+            convolution_mode="Same", activation="identity", has_bias=False),
+            "input")
+        b.addLayer("stem_bn", BatchNormalization(), "stem_conv")
+        prev, cur = "stem_bn", "stem_bn"
+        # stack: N normal cells, reduction, N normal (2x filters),
+        # reduction, N normal (4x filters) — the reference's 3 stages
+        for stage in range(3):
+            mult = 2 ** stage
+            for i in range(self.num_cells):
+                nxt = self._normal_cell(b, f"s{stage}_n{i}", cur, prev,
+                                        f * mult)
+                prev, cur = cur, nxt
+            if stage < 2:
+                nxt = self._reduction_cell(b, f"s{stage}_r", cur, prev,
+                                           f * mult * 2)
+                # after reduction both inputs must be at the new
+                # resolution; feed the reduction output twice
+                prev, cur = nxt, nxt
+        b.addLayer("final_relu", ActivationLayer(activation="relu"), cur)
+        b.addLayer("avg_pool", GlobalPoolingLayer(pooling_type="avg"),
+                   "final_relu")
+        b.addLayer("fc", OutputLayer(n_out=self.num_classes,
+                                     activation="softmax", loss="mcxent"),
+                   "avg_pool")
+        return b.setOutputs("fc").build()
+
+    def init(self) -> ComputationGraph:
+        return ComputationGraph(self.conf()).init()

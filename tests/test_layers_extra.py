@@ -1,0 +1,365 @@
+"""Extended layer family tests (reference analogs: ConvolutionLayerTest,
+Convolution3DTest, LocallyConnectedLayerTest, CapsNetMNISTTest,
+CNNGradientCheckTest — SURVEY.md §4's per-layer grad-check backbone)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.common import serde
+from deeplearning4j_tpu.learning import Adam, Sgd
+from deeplearning4j_tpu.nn.conf import (
+    CapsuleLayer, CapsuleStrengthLayer, CenterLossOutputLayer, Convolution1D,
+    Convolution3D, ConvolutionLayer, Cropping1D, Cropping2D, Cropping3D,
+    Deconvolution2D, DenseLayer, DepthwiseConvolution2D,
+    ElementWiseMultiplicationLayer, GRU, GlobalPoolingLayer, InputType,
+    LocallyConnected1D, LocallyConnected2D, LSTM, MaskLayer, MaskZeroLayer,
+    NeuralNetConfiguration, OutputLayer, PReLULayer, PrimaryCapsules,
+    RepeatVector, RnnOutputLayer, SpaceToBatchLayer, SpaceToDepthLayer,
+    Subsampling1DLayer, Subsampling3DLayer, Upsampling1D, Upsampling3D,
+    ZeroPadding1DLayer, ZeroPadding3DLayer,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.transferlearning import FrozenLayerWithBackprop
+
+
+def _fit_and_check(conf, x, y, steps=3):
+    """Network inits, fits a few steps, loss decreases or stays finite."""
+    net = MultiLayerNetwork(conf).init()
+    l0 = float(net.score_on(x, y)) if hasattr(net, "score_on") else None
+    for _ in range(steps):
+        net.fit(x, y)
+    out = net.output(x)
+    assert np.all(np.isfinite(np.asarray(out)))
+    return net, out
+
+
+def _build(layers, input_type, updater=None):
+    b = (NeuralNetConfiguration.builder().seed(7)
+         .updater(updater or Adam(learning_rate=1e-3)).list())
+    for l in layers:
+        b = b.layer(l)
+    return b.setInputType(input_type).build()
+
+
+class TestConv1DFamily:
+    def test_conv1d_stack_shapes_and_training(self):
+        conf = _build([
+            ZeroPadding1DLayer(pad=(1, 1)),
+            Convolution1D(n_out=8, kernel_size=3, activation="relu"),
+            Subsampling1DLayer(kernel_size=2, stride=2),
+            Upsampling1D(size=2),
+            Cropping1D(crop=(1, 1)),
+            LocallyConnected1D(n_out=6, kernel_size=3),
+            GlobalPoolingLayer(pooling_type="avg"),
+            OutputLayer(n_out=3, activation="softmax", loss="mcxent"),
+        ], InputType.recurrent(4, 16))
+        # shape walk: 16 -pad-> 18 -conv k3-> 16 -pool-> 8 -up-> 16
+        # -crop-> 14 -lc k3-> 12
+        assert conf.layers[1].n_in == 4
+        assert conf.layers[5].n_in == 8
+        net = MultiLayerNetwork(conf).init()
+        x = np.random.default_rng(0).normal(size=(5, 16, 4)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[np.random.default_rng(1).integers(0, 3, 5)]
+        net.fit(x, y)
+        out = np.asarray(net.output(x))
+        assert out.shape == (5, 3)
+        assert np.allclose(out.sum(-1), 1, atol=1e-4)
+
+    def test_conv1d_same_mode_preserves_length(self):
+        lay = Convolution1D(n_in=4, n_out=8, kernel_size=3,
+                            convolution_mode="Same")
+        it = lay.output_type(InputType.recurrent(4, 16))
+        assert (it.timeseries_length, it.size) == (16, 8)
+        p = lay.init_params(jax.random.key(0), None, jnp.float32)
+        out, _ = lay.apply(p, {}, jnp.ones((2, 16, 4)), False, None)
+        assert out.shape == (2, 16, 8)
+
+
+class TestConv2DExtensions:
+    def test_deconv_upsamples(self):
+        lay = Deconvolution2D(n_in=3, n_out=5, kernel_size=(2, 2),
+                              stride=(2, 2), convolution_mode="Same")
+        p = lay.init_params(jax.random.key(0), None, jnp.float32)
+        out, _ = lay.apply(p, {}, jnp.ones((2, 7, 7, 3)), False, None)
+        assert out.shape == (2, 14, 14, 5)
+        it = lay.output_type(InputType.convolutional(7, 7, 3))
+        assert (it.height, it.width, it.channels) == (14, 14, 5)
+
+    def test_depthwise_channels_multiply(self):
+        lay = DepthwiseConvolution2D(n_in=3, depth_multiplier=4,
+                                     kernel_size=(3, 3),
+                                     convolution_mode="Same")
+        p = lay.init_params(jax.random.key(0), None, jnp.float32)
+        out, _ = lay.apply(p, {}, jnp.ones((2, 8, 8, 3)), False, None)
+        assert out.shape == (2, 8, 8, 12)
+
+    def test_crop_pad_space_ops(self):
+        x = jnp.arange(2 * 8 * 8 * 4, dtype=jnp.float32).reshape(2, 8, 8, 4)
+        out, _ = Cropping2D(crop=(1, 2, 3, 1)).apply({}, {}, x, False, None)
+        assert out.shape == (2, 5, 4, 4)
+        out, _ = SpaceToDepthLayer(block_size=2).apply({}, {}, x, False, None)
+        assert out.shape == (2, 4, 4, 16)
+        out, _ = SpaceToBatchLayer(block_size=2).apply({}, {}, x, False, None)
+        assert out.shape == (8, 4, 4, 4)
+
+    def test_locally_connected2d_differs_from_conv(self):
+        """LC2D has per-position filters — gradient check via training."""
+        conf = _build([
+            LocallyConnected2D(n_out=4, kernel_size=(3, 3), stride=(2, 2),
+                               activation="relu"),
+            DenseLayer(n_out=8, activation="relu"),
+            OutputLayer(n_out=2, activation="softmax", loss="mcxent"),
+        ], InputType.convolutional(9, 9, 2))
+        net = MultiLayerNetwork(conf).init()
+        # per-position weights: [outH*outW, kH*kW*C, C_out]
+        assert net.params_list[0]["W"].shape == (16, 18, 4)
+        x = np.random.default_rng(0).normal(size=(4, 9, 9, 2)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[[0, 1, 0, 1]]
+        s0 = None
+        for _ in range(30):
+            net.fit(x, y)
+            s0 = s0 or net.score()
+        assert net.score() < s0
+
+
+class TestConv3DFamily:
+    def test_conv3d_stack(self):
+        conf = _build([
+            ZeroPadding3DLayer(pad=(1, 1, 1)),
+            Convolution3D(n_out=4, kernel_size=(3, 3, 3), activation="relu"),
+            Subsampling3DLayer(kernel_size=(2, 2, 2), stride=(2, 2, 2)),
+            Upsampling3D(size=2),
+            Cropping3D(crop=(1, 1, 1, 1, 1, 1)),
+            DenseLayer(n_out=8, activation="relu"),
+            OutputLayer(n_out=2, activation="softmax", loss="mcxent"),
+        ], InputType.convolutional3D(6, 6, 6, 2))
+        assert conf.layers[1].n_in == 2
+        net = MultiLayerNetwork(conf).init()
+        x = np.random.default_rng(0).normal(size=(3, 6, 6, 6, 2)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[[0, 1, 0]]
+        net.fit(x, y)
+        out = np.asarray(net.output(x))
+        assert out.shape == (3, 2)
+        assert np.all(np.isfinite(out))
+
+    def test_conv3d_vs_reference_numpy(self):
+        """Golden check: 1x1x1 kernel conv3d == channel matmul."""
+        lay = Convolution3D(n_in=3, n_out=2, kernel_size=(1, 1, 1))
+        p = lay.init_params(jax.random.key(3), None, jnp.float32)
+        x = jax.random.normal(jax.random.key(4), (2, 4, 4, 4, 3))
+        out, _ = lay.apply(p, {}, x, False, None)
+        want = np.asarray(x) @ np.asarray(p["W"]).reshape(3, 2) + np.asarray(p["b"])
+        np.testing.assert_allclose(np.asarray(out), want, atol=1e-5)
+
+
+class TestMiscLayers:
+    def test_gru_trains_and_steps(self):
+        conf = _build([
+            GRU(n_out=12),
+            RnnOutputLayer(n_out=3, activation="softmax", loss="mcxent"),
+        ], InputType.recurrent(5, 10))
+        net = MultiLayerNetwork(conf).init()
+        x = np.random.default_rng(0).normal(size=(4, 10, 5)).astype(np.float32)
+        y = np.zeros((4, 10, 3), np.float32)
+        y[..., 0] = 1
+        s0 = None
+        for _ in range(20):
+            net.fit(x, y)
+            s0 = s0 or net.score()
+        assert net.score() < s0
+        # stateful stepping parity with full-sequence forward
+        net.rnnClearPreviousState()
+        step_outs = [np.asarray(net.rnnTimeStep(x[:, t:t + 1]))
+                     for t in range(10)]
+        full = np.asarray(net.output(x))
+        np.testing.assert_allclose(np.concatenate(step_outs, 1), full,
+                                   atol=1e-4)
+
+    def test_prelu_learns_slope(self):
+        lay = PReLULayer()
+        p = lay.init_params(jax.random.key(0), InputType.feedForward(4),
+                            jnp.float32)
+        assert p["alpha"].shape == (4,)
+        out, _ = lay.apply({"alpha": jnp.full((4,), 0.5)}, {},
+                           jnp.array([[-2.0, -1.0, 1.0, 2.0]]), False, None)
+        np.testing.assert_allclose(np.asarray(out)[0], [-1.0, -0.5, 1.0, 2.0])
+
+    def test_elementwise_mult_and_repeat(self):
+        lay = ElementWiseMultiplicationLayer()
+        p = lay.init_params(jax.random.key(0), InputType.feedForward(3),
+                            jnp.float32)
+        out, _ = lay.apply({"W": jnp.array([1.0, 2.0, 3.0]),
+                            "b": jnp.zeros(3)}, {},
+                           jnp.array([[2.0, 2.0, 2.0]]), False, None)
+        np.testing.assert_allclose(np.asarray(out)[0], [2.0, 4.0, 6.0])
+        rep, _ = RepeatVector(n=4).apply({}, {}, jnp.ones((2, 3)), False, None)
+        assert rep.shape == (2, 4, 3)
+
+    def test_mask_zero_layer(self):
+        inner = LSTM(n_in=3, n_out=5)
+        lay = MaskZeroLayer(layer=inner, mask_value=0.0)
+        p = lay.init_params(jax.random.key(0), None, jnp.float32)
+        x = jnp.ones((2, 6, 3)).at[:, 3:].set(0.0)  # last 3 steps masked
+        out, _ = lay.apply(p, {}, x, False, None)
+        assert np.all(np.asarray(out)[:, 3:] == 0)
+        assert np.any(np.asarray(out)[:, :3] != 0)
+        # MaskLayer passes through
+        m, _ = MaskLayer().apply({}, {}, x, False, None)
+        np.testing.assert_allclose(np.asarray(m), np.asarray(x))
+
+    def test_frozen_with_backprop_params_fixed(self):
+        conf = _build([
+            FrozenLayerWithBackprop(layer=DenseLayer(n_out=8,
+                                                     activation="relu")),
+            OutputLayer(n_out=2, activation="softmax", loss="mcxent"),
+        ], InputType.feedForward(4), updater=Sgd(learning_rate=0.1))
+        net = MultiLayerNetwork(conf).init()
+        w0 = np.asarray(net.params_list[0]["W"]).copy()
+        x = np.random.default_rng(0).normal(size=(8, 4)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[[0, 1] * 4]
+        for _ in range(5):
+            net.fit(x, y)
+        np.testing.assert_allclose(np.asarray(net.params_list[0]["W"]), w0)
+        # output layer DID move
+        assert not np.allclose(np.asarray(net.params_list[1]["W"]),
+                               np.zeros_like(net.params_list[1]["W"]))
+
+    def test_center_loss_output_layer(self):
+        conf = _build([
+            DenseLayer(n_out=6, activation="relu"),
+            CenterLossOutputLayer(n_out=3, activation="softmax",
+                                  loss="mcxent", lambda_=0.01),
+        ], InputType.feedForward(4))
+        net = MultiLayerNetwork(conf).init()
+        assert net.params_list[1]["centers"].shape == (3, 6)
+        x = np.random.default_rng(0).normal(size=(9, 4)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[np.arange(9) % 3]
+        s0 = None
+        for _ in range(25):
+            net.fit(x, y)
+            s0 = s0 or net.score()
+        assert net.score() < s0
+        # centers moved toward features (trained via the shared updater)
+        assert np.any(np.asarray(net.params_list[1]["centers"]) != 0)
+
+
+class TestCapsNet:
+    def test_capsnet_mnist_style(self):
+        """reference: CapsNetMNISTTest — primary caps -> routing -> strength."""
+        conf = _build([
+            ConvolutionLayer(n_out=8, kernel_size=(5, 5), activation="relu"),
+            PrimaryCapsules(capsule_dimensions=4, channels=2,
+                            kernel_size=(5, 5), stride=(2, 2)),
+            CapsuleLayer(capsules=3, capsule_dimensions=6, routings=2),
+            CapsuleStrengthLayer(),
+            OutputLayer(n_out=3, activation="softmax", loss="mcxent"),
+        ], InputType.convolutional(20, 20, 1))
+        net = MultiLayerNetwork(conf).init()
+        x = np.random.default_rng(0).normal(size=(4, 20, 20, 1)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[[0, 1, 2, 0]]
+        net.fit(x, y)
+        out = np.asarray(net.output(x))
+        assert out.shape == (4, 3)
+        assert np.all(np.isfinite(out))
+
+    def test_squash_norm_below_one(self):
+        from deeplearning4j_tpu.nn.conf.layers_extra import _squash
+        v = _squash(jnp.array([[10.0, 0.0, 0.0]]))
+        assert 0.97 < float(jnp.linalg.norm(v)) < 1.0
+        tiny = _squash(jnp.array([[1e-3, 0.0, 0.0]]))
+        assert float(jnp.linalg.norm(tiny)) < 1e-3
+
+    def test_capsule_routing_is_convex_combination(self):
+        lay = CapsuleLayer(capsules=2, capsule_dimensions=3, routings=3)
+        it = InputType.recurrent(4, 5)
+        p = lay.init_params(jax.random.key(0), it, jnp.float32)
+        x = jax.random.normal(jax.random.key(1), (2, 5, 4))
+        out, _ = lay.apply(p, {}, x, False, None)
+        assert out.shape == (2, 2, 3)
+        # squashed outputs have norm < 1
+        assert np.all(np.linalg.norm(np.asarray(out), axis=-1) < 1.0)
+
+
+class TestSerdeRoundTrip:
+    def test_all_new_layers_round_trip(self):
+        layers = [
+            GRU(n_in=3, n_out=4), Convolution1D(n_in=2, n_out=3),
+            Subsampling1DLayer(), Upsampling1D(), Cropping1D(crop=(1, 2)),
+            ZeroPadding1DLayer(), Deconvolution2D(n_in=2, n_out=3),
+            DepthwiseConvolution2D(n_in=2, depth_multiplier=2),
+            Cropping2D(crop=(1, 1, 2, 2)), SpaceToDepthLayer(),
+            SpaceToBatchLayer(), Convolution3D(n_in=1, n_out=2),
+            Subsampling3DLayer(), Upsampling3D(),
+            Cropping3D(crop=(1, 1, 1, 1, 1, 1)), ZeroPadding3DLayer(),
+            LocallyConnected1D(n_in=2, n_out=3),
+            LocallyConnected2D(n_in=2, n_out=3), PReLULayer(n_in=4),
+            ElementWiseMultiplicationLayer(n_in=3, n_out=3),
+            RepeatVector(n=5), MaskLayer(),
+            MaskZeroLayer(layer=LSTM(n_in=2, n_out=3), mask_value=0.0),
+            CenterLossOutputLayer(n_in=4, n_out=2),
+            PrimaryCapsules(n_in=2), CapsuleLayer(), CapsuleStrengthLayer(),
+            FrozenLayerWithBackprop(layer=DenseLayer(n_in=2, n_out=3)),
+        ]
+        for lay in layers:
+            j = serde.to_json(lay)
+            back = serde.from_json(j)
+            assert serde.to_json(back) == j, type(lay).__name__
+
+
+class TestGradCheck:
+    """Finite-difference gradient checks for the trickiest new layers
+    (reference: CNNGradientCheckTest / GradCheckUtil epsilon method)."""
+
+    @pytest.mark.parametrize("make_layer,shape", [
+        (lambda: LocallyConnected2D(n_in=2, n_out=3, kernel_size=(2, 2)),
+         (2, 4, 4, 2)),
+        (lambda: CapsuleLayer(capsules=2, capsule_dimensions=3, routings=2),
+         (2, 4, 3)),
+        (lambda: Convolution3D(n_in=2, n_out=2, kernel_size=(2, 2, 2)),
+         (2, 3, 3, 3, 2)),
+        (lambda: GRU(n_in=3, n_out=4), (2, 5, 3)),
+    ])
+    def test_fd_gradients(self, make_layer, shape):
+        lay = make_layer()
+        if isinstance(lay, CapsuleLayer):
+            it = InputType.recurrent(shape[-1], shape[1])
+        elif isinstance(lay, GRU):
+            it = InputType.recurrent(shape[-1], shape[1])
+        elif len(shape) == 5:
+            it = InputType.convolutional3D(*shape[1:])
+        else:
+            it = InputType.convolutional(*shape[1:])
+        params = lay.init_params(jax.random.key(0), it, jnp.float32)
+        x = jax.random.normal(jax.random.key(1), shape)
+
+        def loss(p):
+            out, _ = lay.apply(p, {}, x, False, None)
+            return jnp.sum(out * out)
+
+        g = jax.grad(loss)(params)
+        flat, treedef = jax.tree_util.tree_flatten(params)
+        gflat = jax.tree_util.tree_leaves(g)
+        eps = 1e-3
+        rng = np.random.default_rng(0)
+        for arr, garr in zip(flat, gflat):
+            a = np.asarray(arr, np.float64)
+            ga = np.asarray(garr)
+            # probe 3 random coordinates per param tensor
+            for _ in range(3):
+                idx = tuple(rng.integers(0, s) for s in a.shape)
+                ap, am = a.copy(), a.copy()
+                ap[idx] += eps
+                am[idx] -= eps
+
+                def rebuild(v):
+                    newflat = [jnp.asarray(v if arr2 is arr else
+                                           np.asarray(arr2, np.float64))
+                               for arr2 in flat]
+                    return jax.tree_util.tree_unflatten(treedef, newflat)
+
+                fd = (float(loss(rebuild(ap))) - float(loss(rebuild(am)))) \
+                    / (2 * eps)
+                assert abs(fd - float(ga[idx])) < 5e-2 * max(1.0, abs(fd)), \
+                    f"{type(lay).__name__} grad mismatch at {idx}"

@@ -1,0 +1,89 @@
+"""GloVe / FastText / t-SNE tests (reference analogs: GloveTest,
+FastTextTest, Test BarnesHutTsne in deeplearning4j-nlp)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nlp import BarnesHutTsne, FastText, Glove
+
+
+def tiny_corpus():
+    """Two topic clusters so embedding geometry is checkable."""
+    return [
+        "cat dog cat dog pet animal cat dog",
+        "dog cat pet animal dog cat",
+        "cat pet dog animal pet cat dog",
+        "stock market trade price stock market",
+        "market stock price trade market stock",
+        "trade price stock market trade stock",
+    ] * 6
+
+
+class TestGlove:
+    def test_fit_loss_decreases_and_similarity(self):
+        g = Glove(layer_size=16, window_size=4, epochs=30,
+                  learning_rate=0.1, batch_size=64, seed=7)
+        g.fit(tiny_corpus())
+        assert g.loss_history[-1] < g.loss_history[0]
+        assert g.hasWord("cat") and g.hasWord("stock")
+        assert g.getWordVector("cat").shape == (16,)
+        # within-topic similarity beats cross-topic
+        within = g.similarity("cat", "dog")
+        across = g.similarity("cat", "stock")
+        assert within > across
+
+    def test_words_nearest(self):
+        g = Glove(layer_size=12, epochs=25, seed=3,
+                  batch_size=32).fit(tiny_corpus())
+        near = g.wordsNearest("market", n=3)
+        assert "stock" in near or "trade" in near or "price" in near
+
+
+class TestFastText:
+    def test_fit_and_oov_vectors(self):
+        ft = FastText(layer_size=16, window_size=3, epochs=8,
+                      batch_size=128, buckets=2000, seed=5)
+        ft.fit(tiny_corpus())
+        assert ft.loss_history[-1] < ft.loss_history[0]
+        v = ft.getWordVector("cat")
+        assert v.shape == (16,) and np.any(v != 0)
+        # OOV: built purely from shared char n-grams
+        oov = ft.getWordVector("cats")
+        assert oov.shape == (16,) and np.any(oov != 0)
+        # OOV overlapping "cat" n-grams should be closer to cat than an
+        # unrelated OOV string
+        def cos(a, b):
+            return float(a @ b / (np.linalg.norm(a) * np.linalg.norm(b) + 1e-9))
+        assert cos(oov, ft.getWordVector("cat")) > \
+            cos(ft.getWordVector("zxqwvu"), ft.getWordVector("cat"))
+
+    def test_similarity_topics(self):
+        ft = FastText(layer_size=16, epochs=8, batch_size=128,
+                      buckets=2000, seed=11).fit(tiny_corpus())
+        assert ft.similarity("stock", "market") > ft.similarity("stock", "dog")
+
+
+class TestTsne:
+    def test_clusters_stay_separated(self):
+        rng = np.random.default_rng(0)
+        a = rng.normal(0, 0.3, (30, 10)) + 5.0
+        b = rng.normal(0, 0.3, (30, 10)) - 5.0
+        x = np.vstack([a, b]).astype(np.float32)
+        ts = BarnesHutTsne(n_components=2, perplexity=10, n_iter=300,
+                           learning_rate=100.0, seed=1)
+        y = ts.fit_transform(x)
+        assert y.shape == (60, 2)
+        assert np.all(np.isfinite(y))
+        # KL decreased over optimization
+        assert ts.kl_history[-1] < ts.kl_history[0]
+        # cluster centroids separate farther than intra-cluster spread
+        ca, cb = y[:30].mean(0), y[30:].mean(0)
+        spread = max(y[:30].std(), y[30:].std())
+        assert np.linalg.norm(ca - cb) > 2 * spread
+
+    def test_plot_api(self):
+        x = np.random.default_rng(2).normal(size=(20, 5)).astype(np.float32)
+        ts = BarnesHutTsne(n_iter=50, perplexity=5)
+        out = ts.plot(x, n_dims=3)
+        assert out.shape == (20, 3)
+        assert ts.getData() is out

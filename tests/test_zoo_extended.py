@@ -177,3 +177,23 @@ class TestZooBreadthRound2:
         out = net.outputSingle(np.zeros((1, 96, 96, 3), np.float32)).toNumpy()
         assert out.shape == (1, 4)
         np.testing.assert_allclose(out.sum(1), 1.0, rtol=1e-4)
+
+
+class TestNASNet:
+    def test_nasnet_builds_and_runs(self):
+        from deeplearning4j_tpu.zoo import NASNet
+        net = NASNet(num_classes=3, in_shape=(32, 32, 3), num_cells=1,
+                     penultimate_filters=96, stem_filters=8,
+                     updater=Adam(1e-3)).init()
+        rs = np.random.RandomState(0)
+        x = rs.rand(2, 32, 32, 3).astype(np.float32)
+        out = net.output(x)
+        out = (out[0] if isinstance(out, (list, tuple)) else out).toNumpy()
+        assert out.shape == (2, 3)
+        assert np.allclose(out.sum(-1), 1, atol=1e-4)
+        y = np.eye(3, dtype=np.float32)[[0, 1]]
+        losses = []
+        for _ in range(4):
+            net.fit(x, y)
+            losses.append(net.score())
+        assert losses[-1] < losses[0]
