@@ -37,6 +37,17 @@ from deeplearning4j_tpu.nn.conf.layers import (ActivationLayer,
                                                SeparableConvolution2D,
                                                SimpleRnn, SubsamplingLayer,
                                                Upsampling2D, ZeroPaddingLayer)
+from deeplearning4j_tpu.nn.conf.layers import LayerNormalization
+from deeplearning4j_tpu.nn.conf.layers_extra import (
+    Convolution1D, Convolution3D, Cropping1D, Cropping2D, Cropping3D,
+    Deconvolution2D, DepthwiseConvolution2D, GRU, LocallyConnected1D,
+    LocallyConnected2D, MaskLayer, PReLULayer, RepeatVector,
+    Subsampling1DLayer, Subsampling3DLayer, Upsampling1D, Upsampling3D,
+    ZeroPadding1DLayer, ZeroPadding3DLayer,
+)
+from deeplearning4j_tpu.nn.conf.dropout import (
+    AlphaDropout, GaussianDropout, GaussianNoise, SpatialDropout,
+)
 from deeplearning4j_tpu.nn.graph.config import ComputationGraphConfiguration
 from deeplearning4j_tpu.nn.graph.graph import ComputationGraph
 from deeplearning4j_tpu.nn.graph.vertices import (ElementWiseVertex,
@@ -94,6 +105,9 @@ def _input_type_from_shape(shape) -> InputType:
     if len(dims) == 3:
         return InputType.convolutional(int(dims[0]), int(dims[1]),
                                        int(dims[2]))
+    if len(dims) == 4:
+        return InputType.convolutional3D(int(dims[0]), int(dims[1]),
+                                         int(dims[2]), int(dims[3]))
     raise UnsupportedKerasConfigurationException(
         f"unsupported input shape {shape}")
 
@@ -185,7 +199,12 @@ def _map_layer(class_name: str, cfg: dict, is_last: bool):
         return ZeroPaddingLayer(name=name, pad=_pair(pad))
     if class_name == "UpSampling2D":
         size = cfg.get("size", 2)
-        size = size[0] if isinstance(size, (list, tuple)) else size
+        if isinstance(size, (list, tuple)):
+            if len(set(size)) != 1:
+                raise UnsupportedKerasConfigurationException(
+                    f"UpSampling2D {name!r}: anisotropic size {size} "
+                    "unsupported")
+            size = size[0]
         return Upsampling2D(name=name, size=int(size))
     if class_name == "Embedding":
         return EmbeddingSequenceLayer(name=name, n_in=cfg["input_dim"],
@@ -208,6 +227,174 @@ def _map_layer(class_name: str, cfg: dict, is_last: bool):
         if not cfg.get("return_sequences", False):
             return LastTimeStep(name=name, underlying=rnn)
         return rnn
+    if class_name == "GRU":
+        if not cfg.get("reset_after", True):
+            raise UnsupportedKerasConfigurationException(
+                f"GRU {name!r}: reset_after=False applies the reset gate "
+                "before the recurrent matmul — not representable in the "
+                "fused reset-after cell")
+        if _map_activation(cfg.get("activation", "tanh")) != "tanh" or \
+                _map_activation(cfg.get("recurrent_activation",
+                                        "sigmoid")) != "sigmoid":
+            raise UnsupportedKerasConfigurationException(
+                f"GRU {name!r}: only tanh/sigmoid cell activations map "
+                "onto the fused cell")
+        gru = GRU(name=name, n_out=cfg["units"], recurrent_bias=True)
+        if not cfg.get("return_sequences", False):
+            return LastTimeStep(name=name, underlying=gru)
+        return gru
+    if class_name == "Conv1D":
+        _check_channels_last(cfg, name)
+        if cfg.get("padding") == "causal":
+            raise UnsupportedKerasConfigurationException(
+                f"Conv1D {name!r}: padding='causal' unsupported (would "
+                "silently run valid convolution)")
+        k = cfg["kernel_size"]
+        s = cfg.get("strides", 1)
+        d = cfg.get("dilation_rate", 1)
+        return Convolution1D(
+            name=name, n_out=cfg["filters"],
+            kernel_size=int(k[0] if isinstance(k, (list, tuple)) else k),
+            stride=int(s[0] if isinstance(s, (list, tuple)) else s),
+            dilation=int(d[0] if isinstance(d, (list, tuple)) else d),
+            convolution_mode=_conv_mode(cfg.get("padding", "valid")),
+            activation=_map_activation(cfg.get("activation")),
+            has_bias=cfg.get("use_bias", True))
+    if class_name == "Conv3D":
+        _check_channels_last(cfg, name)
+        return Convolution3D(
+            name=name, n_out=cfg["filters"],
+            kernel_size=tuple(cfg["kernel_size"]),
+            stride=tuple(cfg.get("strides", (1, 1, 1))),
+            dilation=tuple(cfg.get("dilation_rate", (1, 1, 1))),
+            convolution_mode=_conv_mode(cfg.get("padding", "valid")),
+            activation=_map_activation(cfg.get("activation")),
+            has_bias=cfg.get("use_bias", True))
+    if class_name == "Conv2DTranspose":
+        _check_channels_last(cfg, name)
+        return Deconvolution2D(
+            name=name, n_out=cfg["filters"],
+            kernel_size=_pair(cfg["kernel_size"]),
+            stride=_pair(cfg.get("strides", 1)),
+            convolution_mode=_conv_mode(cfg.get("padding", "valid")),
+            activation=_map_activation(cfg.get("activation")),
+            has_bias=cfg.get("use_bias", True))
+    if class_name == "DepthwiseConv2D":
+        _check_channels_last(cfg, name)
+        return DepthwiseConvolution2D(
+            name=name, depth_multiplier=cfg.get("depth_multiplier", 1),
+            kernel_size=_pair(cfg["kernel_size"]),
+            stride=_pair(cfg.get("strides", 1)),
+            convolution_mode=_conv_mode(cfg.get("padding", "valid")),
+            activation=_map_activation(cfg.get("activation")),
+            has_bias=cfg.get("use_bias", True))
+    if class_name in ("MaxPooling1D", "AveragePooling1D"):
+        _check_channels_last(cfg, name)
+        k = cfg.get("pool_size", 2)
+        k = int(k[0] if isinstance(k, (list, tuple)) else k)
+        s = cfg.get("strides") or k
+        s = int(s[0] if isinstance(s, (list, tuple)) else s)
+        return Subsampling1DLayer(
+            name=name,
+            pooling_type="max" if class_name.startswith("Max") else "avg",
+            kernel_size=k, stride=s,
+            convolution_mode=_conv_mode(cfg.get("padding", "valid")))
+    if class_name in ("MaxPooling3D", "AveragePooling3D"):
+        _check_channels_last(cfg, name)
+        k = tuple(cfg.get("pool_size", (2, 2, 2)))
+        return Subsampling3DLayer(
+            name=name,
+            pooling_type="max" if class_name.startswith("Max") else "avg",
+            kernel_size=k, stride=tuple(cfg.get("strides") or k),
+            convolution_mode=_conv_mode(cfg.get("padding", "valid")))
+    if class_name == "UpSampling1D":
+        return Upsampling1D(name=name, size=int(cfg.get("size", 2)))
+    if class_name == "UpSampling3D":
+        size = cfg.get("size", 2)
+        if isinstance(size, (list, tuple)):
+            if len(set(size)) != 1:
+                raise UnsupportedKerasConfigurationException(
+                    f"UpSampling3D {name!r}: anisotropic size {size} "
+                    "unsupported")
+            size = size[0]
+        return Upsampling3D(name=name, size=int(size))
+    if class_name == "ZeroPadding1D":
+        pad = cfg.get("padding", 1)
+        pad = tuple(pad) if isinstance(pad, (list, tuple)) else (pad, pad)
+        return ZeroPadding1DLayer(name=name, pad=pad)
+    if class_name == "ZeroPadding3D":
+        pad = cfg.get("padding", 1)
+        if isinstance(pad, (list, tuple)):
+            if isinstance(pad[0], (list, tuple)):
+                if any(p[0] != p[1] for p in pad):
+                    raise UnsupportedKerasConfigurationException(
+                        f"asymmetric ZeroPadding3D {pad} unsupported")
+                pad = tuple(p[0] for p in pad)
+            else:
+                pad = tuple(pad)
+        return ZeroPadding3DLayer(name=name, pad=pad)
+    if class_name == "Cropping1D":
+        c = cfg.get("cropping", (0, 0))
+        c = tuple(c) if isinstance(c, (list, tuple)) else (c, c)
+        return Cropping1D(name=name, crop=c)
+    if class_name == "Cropping2D":
+        c = cfg.get("cropping", ((0, 0), (0, 0)))
+        if isinstance(c, int):
+            c = (c, c, c, c)
+        elif isinstance(c[0], (list, tuple)):
+            c = (c[0][0], c[0][1], c[1][0], c[1][1])
+        else:
+            c = (c[0], c[0], c[1], c[1])
+        return Cropping2D(name=name, crop=tuple(int(v) for v in c))
+    if class_name == "Cropping3D":
+        c = cfg.get("cropping", ((0, 0),) * 3)
+        if isinstance(c, int):
+            c = (c,) * 6
+        elif isinstance(c[0], (list, tuple)):
+            c = (c[0][0], c[0][1], c[1][0], c[1][1], c[2][0], c[2][1])
+        else:
+            c = (c[0], c[0], c[1], c[1], c[2], c[2])
+        return Cropping3D(name=name, crop=tuple(int(v) for v in c))
+    if class_name in ("LocallyConnected1D", "LocallyConnected2D"):
+        if class_name.endswith("1D"):
+            _check_channels_last(cfg, name)
+            k = cfg["kernel_size"]
+            s = cfg.get("strides", 1)
+            return LocallyConnected1D(
+                name=name, n_out=cfg["filters"],
+                kernel_size=int(k[0] if isinstance(k, (list, tuple)) else k),
+                stride=int(s[0] if isinstance(s, (list, tuple)) else s),
+                activation=_map_activation(cfg.get("activation")),
+                has_bias=cfg.get("use_bias", True))
+        _check_channels_last(cfg, name)
+        return LocallyConnected2D(
+            name=name, n_out=cfg["filters"],
+            kernel_size=_pair(cfg["kernel_size"]),
+            stride=_pair(cfg.get("strides", 1)),
+            activation=_map_activation(cfg.get("activation")),
+            has_bias=cfg.get("use_bias", True))
+    if class_name == "PReLU":
+        return PReLULayer(name=name)
+    if class_name == "RepeatVector":
+        return RepeatVector(name=name, n=int(cfg["n"]))
+    if class_name == "Masking":
+        return MaskLayer(name=name)
+    if class_name == "LayerNormalization":
+        return LayerNormalization(name=name,
+                                  eps=float(cfg.get("epsilon", 1e-3)))
+    if class_name in ("SpatialDropout1D", "SpatialDropout2D",
+                      "SpatialDropout3D"):
+        return DropoutLayer(name=name,
+                            rate=SpatialDropout(float(cfg.get("rate", 0.5))))
+    if class_name == "GaussianDropout":
+        return DropoutLayer(name=name,
+                            rate=GaussianDropout(float(cfg.get("rate", 0.5))))
+    if class_name == "GaussianNoise":
+        return DropoutLayer(name=name,
+                            rate=GaussianNoise(float(cfg.get("stddev", 0.1))))
+    if class_name == "AlphaDropout":
+        return DropoutLayer(name=name,
+                            rate=AlphaDropout(float(cfg.get("rate", 0.5))))
     raise UnsupportedKerasConfigurationException(
         f"no mapper for Keras layer {class_name!r} "
         "(reference parity: KerasLayer registry)")
@@ -273,6 +460,54 @@ def _assign_params(layer, params: dict, state: dict,
             put(params, "RW", kw["recurrent_kernel"])
         if "bias" in kw:
             put(params, "b", kw["bias"])
+        return
+    if isinstance(layer, GRU):
+        # Keras gate order z,r,h -> ours r,z,n (block permutation)
+        def perm(a):
+            h = a.shape[-1] // 3
+            z, r, n = a[..., :h], a[..., h:2 * h], a[..., 2 * h:]
+            return np.concatenate([r, z, n], axis=-1)
+        if "kernel" in kw:
+            put(params, "W", perm(kw["kernel"]))
+        if "recurrent_kernel" in kw:
+            put(params, "RW", perm(kw["recurrent_kernel"]))
+        if "bias" in kw:
+            b = kw["bias"]
+            if b.ndim == 2:   # reset_after: [2, 3h] = (input, recurrent)
+                put(params, "b", perm(b[0]))
+                put(params, "Rb", perm(b[1]))
+            else:
+                put(params, "b", perm(b))
+        return
+    if isinstance(layer, Deconvolution2D):
+        # Keras Conv2DTranspose kernel is (kh,kw,out,in) with
+        # gradient-of-conv semantics; ours is HWIO correlation, so
+        # transpose to (kh,kw,in,out) AND flip the spatial dims
+        # (verified numerically against tf.nn.conv2d_transpose)
+        if "kernel" in kw:
+            put(params, "W",
+                np.transpose(kw["kernel"], (0, 1, 3, 2))[::-1, ::-1].copy())
+        if "bias" in kw:
+            put(params, "b", kw["bias"])
+        return
+    if isinstance(layer, DepthwiseConvolution2D):
+        # Keras 2 names it depthwise_kernel, Keras 3 plain kernel
+        dk = kw.get("depthwise_kernel", kw.get("kernel"))
+        if dk is not None:
+            put(params, "W", dk)
+        if "bias" in kw:
+            put(params, "b", kw["bias"])
+        return
+    if isinstance(layer, PReLULayer):
+        if "alpha" in kw:
+            a = kw["alpha"]
+            put(params, "alpha", a.reshape(-1))
+        return
+    if isinstance(layer, LayerNormalization):
+        if "gamma" in kw:
+            put(params, "gamma", kw["gamma"])
+        if "beta" in kw:
+            put(params, "beta", kw["beta"])
         return
     if isinstance(layer, EmbeddingLayer):
         if "embeddings" in kw:

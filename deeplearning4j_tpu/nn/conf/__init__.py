@@ -23,6 +23,17 @@ from deeplearning4j_tpu.nn.conf.layers_extra import (
     SpaceToDepthLayer, Subsampling1DLayer, Subsampling3DLayer, Upsampling1D,
     Upsampling3D, ZeroPadding1DLayer, ZeroPadding3DLayer,
 )
+from deeplearning4j_tpu.nn.conf.dropout import (
+    AlphaDropout, Dropout, GaussianDropout, GaussianNoise, IDropout,
+    SpatialDropout,
+)
+from deeplearning4j_tpu.nn.conf.weightnoise import (
+    DropConnect, IWeightNoise, WeightNoise,
+)
+from deeplearning4j_tpu.nn.conf.constraint import (
+    LayerConstraint, MaxNormConstraint, MinMaxNormConstraint,
+    NonNegativeConstraint, UnitNormConstraint,
+)
 from deeplearning4j_tpu.nn.conf.builder import (
     MultiLayerConfiguration, NeuralNetConfiguration,
 )
@@ -47,5 +58,10 @@ __all__ = [
     "SpaceToDepthLayer", "Subsampling1DLayer", "Subsampling3DLayer",
     "Upsampling1D", "Upsampling3D", "ZeroPadding1DLayer",
     "ZeroPadding3DLayer",
+    "AlphaDropout", "Dropout", "GaussianDropout", "GaussianNoise",
+    "IDropout", "SpatialDropout",
+    "DropConnect", "IWeightNoise", "WeightNoise",
+    "LayerConstraint", "MaxNormConstraint", "MinMaxNormConstraint",
+    "NonNegativeConstraint", "UnitNormConstraint",
     "MultiLayerConfiguration", "NeuralNetConfiguration",
 ]

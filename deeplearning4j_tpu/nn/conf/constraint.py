@@ -1,0 +1,104 @@
+"""Weight constraints (reference: org/deeplearning4j/nn/conf/constraint/
+** — BaseConstraint subclasses MaxNormConstraint, MinMaxNormConstraint,
+UnitNormConstraint, NonNegativeConstraint; SURVEY.md §2.18).
+
+Applied AFTER the updater step, inside the compiled train step
+(reference: BaseConstraint#applyConstraint called post-update), to the
+layer's weight params. Configure via ``Layer.constraints`` (a list).
+
+Norms are computed over the fan-in axes (all but the last — for a
+[k..., in, out] weight each output unit's incoming vector), matching the
+reference's dimension defaults.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.common.serde import serializable
+from deeplearning4j_tpu.nn.conf.weightnoise import WEIGHT_KEYS
+
+
+class LayerConstraint:
+    """Marker base (reference: api/layers/LayerConstraint)."""
+
+    def _constrain_one(self, w):  # pragma: no cover - interface
+        raise NotImplementedError
+
+    def apply(self, params: dict) -> dict:
+        out = dict(params)
+        for k in params:
+            if k in WEIGHT_KEYS:
+                out[k] = self._constrain_one(params[k])
+        return out
+
+
+def _unit_axes(w) -> Tuple[int, ...]:
+    """Fan-in axes: everything except the output (last) axis."""
+    return tuple(range(w.ndim - 1)) if w.ndim > 1 else (0,)
+
+
+@serializable
+@dataclasses.dataclass
+class MaxNormConstraint(LayerConstraint):
+    """Clip each output unit's incoming-weight L2 norm to max_norm
+    (reference: constraint/MaxNormConstraint)."""
+
+    max_norm: float = 2.0
+
+    def _constrain_one(self, w):
+        axes = _unit_axes(w)
+        norm = jnp.sqrt(jnp.sum(w * w, axis=axes, keepdims=True) + 1e-12)
+        return w * jnp.minimum(1.0, self.max_norm / norm)
+
+
+@serializable
+@dataclasses.dataclass
+class MinMaxNormConstraint(LayerConstraint):
+    """Rescale unit norms into [min, max] with strength ``rate``
+    (reference: constraint/MinMaxNormConstraint)."""
+
+    min_norm: float = 0.0
+    max_norm: float = 2.0
+    rate: float = 1.0
+
+    def _constrain_one(self, w):
+        axes = _unit_axes(w)
+        norm = jnp.sqrt(jnp.sum(w * w, axis=axes, keepdims=True) + 1e-12)
+        clipped = jnp.clip(norm, self.min_norm, self.max_norm)
+        target = self.rate * clipped + (1.0 - self.rate) * norm
+        return w * (target / norm)
+
+
+@serializable
+@dataclasses.dataclass
+class UnitNormConstraint(LayerConstraint):
+    """Normalize each unit's incoming weights to L2 norm 1 (reference:
+    constraint/UnitNormConstraint)."""
+
+    def _constrain_one(self, w):
+        axes = _unit_axes(w)
+        norm = jnp.sqrt(jnp.sum(w * w, axis=axes, keepdims=True) + 1e-12)
+        return w / norm
+
+
+@serializable
+@dataclasses.dataclass
+class NonNegativeConstraint(LayerConstraint):
+    """Clamp weights at >= 0 (reference: constraint/NonNegativeConstraint)."""
+
+    def _constrain_one(self, w):
+        return jnp.maximum(w, 0.0)
+
+
+def apply_constraints(layer, params: dict) -> dict:
+    """Apply a layer's configured constraints post-update."""
+    cs = getattr(layer, "constraints", None)
+    if not cs:
+        return params
+    for c in cs:
+        params = c.apply(params)
+    return params

@@ -1,0 +1,119 @@
+"""Dropout family (reference: org/deeplearning4j/nn/conf/dropout/** —
+IDropout implementations: Dropout, AlphaDropout, GaussianDropout,
+GaussianNoise, SpatialDropout; SURVEY.md §2.18/§2.20).
+
+Each is a serializable config whose ``apply(x, rng)`` runs only in
+training mode; layers accept either a plain float (classic inverted
+dropout, backward compatible) or one of these objects in their
+``dropout`` field. All noise is generated on device from the step's
+fold-in key, so the whole train step stays one XLA executable.
+
+Note on semantics: ``rate`` here is the DROP probability (matching this
+framework's ops); the reference's ``Dropout(x)`` constructor takes the
+RETAIN probability — the builders' dropOut() converts.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.common.serde import serializable
+
+
+class IDropout:
+    """Marker base (reference: IDropout interface)."""
+
+    def apply(self, x, rng):  # pragma: no cover - interface
+        raise NotImplementedError
+
+
+@serializable
+@dataclasses.dataclass
+class Dropout(IDropout):
+    """Inverted dropout (reference: conf/dropout/Dropout)."""
+
+    rate: float = 0.5
+
+    def apply(self, x, rng):
+        keep = 1.0 - self.rate
+        mask = jax.random.bernoulli(rng, keep, x.shape)
+        return jnp.where(mask, x / keep, 0.0).astype(x.dtype)
+
+
+@serializable
+@dataclasses.dataclass
+class SpatialDropout(IDropout):
+    """Drops whole feature maps/channels (reference:
+    conf/dropout/SpatialDropout). For [N,H,W,C] or [N,T,F] input the
+    mask is drawn per (batch, channel) and broadcast over the spatial/
+    time axes — decorrelated activations drop together."""
+
+    rate: float = 0.5
+
+    def apply(self, x, rng):
+        keep = 1.0 - self.rate
+        shape = (x.shape[0],) + (1,) * (x.ndim - 2) + (x.shape[-1],)
+        mask = jax.random.bernoulli(rng, keep, shape)
+        return jnp.where(mask, x / keep, 0.0).astype(x.dtype)
+
+
+@serializable
+@dataclasses.dataclass
+class GaussianDropout(IDropout):
+    """Multiplicative gaussian noise N(1, rate/(1-rate)) (reference:
+    conf/dropout/GaussianDropout — Srivastava et al.'s gaussian
+    variant; mean-preserving, so no inference-time rescale)."""
+
+    rate: float = 0.5
+
+    def apply(self, x, rng):
+        std = jnp.sqrt(self.rate / (1.0 - self.rate))
+        noise = 1.0 + std * jax.random.normal(rng, x.shape, x.dtype)
+        return x * noise
+
+
+@serializable
+@dataclasses.dataclass
+class GaussianNoise(IDropout):
+    """Additive zero-mean gaussian noise (reference:
+    conf/dropout/GaussianNoise)."""
+
+    stddev: float = 0.1
+
+    def apply(self, x, rng):
+        return x + self.stddev * jax.random.normal(rng, x.shape, x.dtype)
+
+
+# SELU fixed-point constants (Klambauer et al. 2017)
+_ALPHA = 1.6732632423543772
+_SCALE = 1.0507009873554805
+_ALPHA_PRIME = -_SCALE * _ALPHA
+
+
+@serializable
+@dataclasses.dataclass
+class AlphaDropout(IDropout):
+    """Self-normalizing dropout for SELU nets (reference:
+    conf/dropout/AlphaDropout). Dropped units are set to alpha' and the
+    output is affine-corrected so mean/variance are preserved."""
+
+    rate: float = 0.5
+
+    def apply(self, x, rng):
+        keep = 1.0 - self.rate
+        a = (keep + _ALPHA_PRIME ** 2 * keep * (1.0 - keep)) ** -0.5
+        b = -a * _ALPHA_PRIME * (1.0 - keep)
+        mask = jax.random.bernoulli(rng, keep, x.shape)
+        return (a * jnp.where(mask, x, _ALPHA_PRIME) + b).astype(x.dtype)
+
+
+def resolve_dropout(d):
+    """float -> Dropout(rate); IDropout -> itself; None -> None."""
+    if d is None:
+        return None
+    if isinstance(d, IDropout):
+        return d
+    return Dropout(rate=float(d))

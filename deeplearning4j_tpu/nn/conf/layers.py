@@ -57,7 +57,13 @@ class Layer:
     updater: Optional[Any] = None        # per-layer updater override
     l1: Optional[float] = None
     l2: Optional[float] = None
-    dropout: Optional[float] = None      # input dropout for this layer
+    #: input dropout: float (classic) or an IDropout config
+    #: (Alpha/Gaussian/Spatial — reference conf/dropout/**)
+    dropout: Optional[Any] = None
+    #: IWeightNoise (DropConnect/WeightNoise — reference weightnoise/**)
+    weight_noise: Optional[Any] = None
+    #: list of LayerConstraint applied post-update (reference constraint/**)
+    constraints: Optional[Any] = None
 
     # -- to be overridden ----------------------------------------------
     def output_type(self, input_type: InputType) -> InputType:
@@ -89,7 +95,9 @@ class Layer:
     # -- shared helpers -------------------------------------------------
     def _maybe_dropout(self, x, train, rng):
         if train and self.dropout and rng is not None:
-            return nnops.dropout(x, self.dropout, rng)
+            if isinstance(self.dropout, (int, float)):
+                return nnops.dropout(x, self.dropout, rng)
+            return self.dropout.apply(x, rng)
         return x
 
     def has_params(self) -> bool:
@@ -210,16 +218,19 @@ class ActivationLayer(Layer):
 @serializable
 @dataclasses.dataclass
 class DropoutLayer(Layer):
-    """Standalone dropout (reference: conf/layers/DropoutLayer)."""
+    """Standalone dropout (reference: conf/layers/DropoutLayer).
+    ``rate`` is a float or any IDropout config (Alpha/Gaussian/Spatial)."""
 
-    rate: float = 0.5
+    rate: Any = 0.5
 
     def has_params(self):
         return False
 
     def apply(self, params, state, x, train, rng):
         if train and rng is not None:
-            return nnops.dropout(x, self.rate, rng), state
+            if isinstance(self.rate, (int, float)):
+                return nnops.dropout(x, self.rate, rng), state
+            return self.rate.apply(x, rng), state
         return x, state
 
 

@@ -48,6 +48,9 @@ class GRU(Layer):
 
     n_in: int = 0
     n_out: int = 0
+    #: separate recurrent bias on the h-projection (Keras reset_after
+    #: checkpoint parity; adds param "Rb")
+    recurrent_bias: bool = False
 
     is_recurrent = True
 
@@ -61,11 +64,15 @@ class GRU(Layer):
                          (self.n_in, 3 * h), self.n_in, 3 * h, dtype)
         rw = init_weights(self.weight_init or WeightInit.XAVIER, k2,
                           (h, 3 * h), h, 3 * h, dtype)
-        return {"W": w, "RW": rw, "b": jnp.zeros((3 * h,), dtype)}
+        p = {"W": w, "RW": rw, "b": jnp.zeros((3 * h,), dtype)}
+        if self.recurrent_bias:
+            p["Rb"] = jnp.zeros((3 * h,), dtype)
+        return p
 
     def apply(self, params, state, x, train, rng):
         x = self._maybe_dropout(x, train, rng)
-        ys, _ = nnops.gru_layer(x, params["W"], params["RW"], params["b"])
+        ys, _ = nnops.gru_layer(x, params["W"], params["RW"], params["b"],
+                                rb=params.get("Rb"))
         return ys, state
 
     def init_carry(self, batch, dtype):
@@ -73,7 +80,8 @@ class GRU(Layer):
 
     def apply_with_carry(self, params, state, carry, x, train, rng):
         ys, new_carry = nnops.gru_layer(
-            x, params["W"], params["RW"], params["b"], h0=carry)
+            x, params["W"], params["RW"], params["b"], h0=carry,
+            rb=params.get("Rb"))
         return ys, state, new_carry
 
 

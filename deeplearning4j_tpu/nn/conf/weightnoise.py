@@ -1,0 +1,67 @@
+"""Weight noise (reference: org/deeplearning4j/nn/conf/weightnoise/** —
+IWeightNoise: DropConnect, WeightNoise; SURVEY.md §2.18).
+
+Applied to a layer's WEIGHT params (not biases) each training forward,
+inside the compiled step. Configure via ``Layer.weight_noise``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.common.serde import serializable
+
+#: param keys treated as weights (matches the network's regularization
+#: key set; biases/norm scales are exempt, like the reference's
+#: paramType==WEIGHT filter)
+WEIGHT_KEYS = {"W", "RW", "dW", "pW", "Wq", "Wk", "Wv", "Wo", "Wa"}
+
+
+class IWeightNoise:
+    """Marker base (reference: IWeightNoise interface)."""
+
+    def _noise_one(self, w, rng):  # pragma: no cover - interface
+        raise NotImplementedError
+
+    def apply(self, params: dict, rng):
+        """Return params with noised weight entries."""
+        out = dict(params)
+        keys = [k for k in params if k in WEIGHT_KEYS]
+        subkeys = jax.random.split(rng, max(len(keys), 1))
+        for k, sk in zip(keys, subkeys):
+            out[k] = self._noise_one(params[k], sk)
+        return out
+
+
+@serializable
+@dataclasses.dataclass
+class DropConnect(IWeightNoise):
+    """Drop individual WEIGHTS with prob ``rate`` (reference:
+    weightnoise/DropConnect; Wan et al. 2013). Inverted scaling keeps
+    the expected pre-activation unchanged."""
+
+    rate: float = 0.5
+
+    def _noise_one(self, w, rng):
+        keep = 1.0 - self.rate
+        mask = jax.random.bernoulli(rng, keep, w.shape)
+        return jnp.where(mask, w / keep, 0.0).astype(w.dtype)
+
+
+@serializable
+@dataclasses.dataclass
+class WeightNoise(IWeightNoise):
+    """Additive or multiplicative gaussian weight noise (reference:
+    weightnoise/WeightNoise with a distribution + additive flag)."""
+
+    mean: float = 0.0
+    stddev: float = 0.1
+    additive: bool = True
+
+    def _noise_one(self, w, rng):
+        noise = self.mean + self.stddev * jax.random.normal(rng, w.shape,
+                                                            w.dtype)
+        return w + noise if self.additive else w * noise

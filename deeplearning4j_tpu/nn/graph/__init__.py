@@ -1,8 +1,10 @@
 from deeplearning4j_tpu.nn.graph.vertices import (
-    DuplicateToTimeSeriesVertex, ElementWiseVertex, GraphVertex,
-    L2NormalizeVertex, LastTimeStepVertex, LayerVertex, MergeVertex,
-    ReverseTimeSeriesVertex, ScaleVertex, StackVertex, SubsetVertex,
-    UnstackVertex, PreprocessorVertex,
+    DotProductAttentionVertex, DuplicateToTimeSeriesVertex,
+    ElementWiseVertex, FrozenVertex, GraphVertex, L2NormalizeVertex,
+    L2Vertex, LastTimeStepVertex, LayerVertex, MergeVertex,
+    PoolHelperVertex, PreprocessorVertex, ReshapeVertex,
+    ReverseTimeSeriesVertex, ScaleVertex, ShiftVertex, StackVertex,
+    SubsetVertex, UnstackVertex,
 )
 from deeplearning4j_tpu.nn.graph.config import ComputationGraphConfiguration
 from deeplearning4j_tpu.nn.graph.graph import ComputationGraph
@@ -13,4 +15,6 @@ __all__ = [
     "SubsetVertex", "PreprocessorVertex", "L2NormalizeVertex",
     "LastTimeStepVertex", "DuplicateToTimeSeriesVertex",
     "ReverseTimeSeriesVertex", "StackVertex", "UnstackVertex",
+    "ShiftVertex", "ReshapeVertex", "L2Vertex", "FrozenVertex",
+    "PoolHelperVertex", "DotProductAttentionVertex",
 ]

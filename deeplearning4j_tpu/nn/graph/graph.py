@@ -22,6 +22,7 @@ from deeplearning4j_tpu.datasets.iterator import DataSetIterator
 from deeplearning4j_tpu.learning.updaters import apply_updater
 from deeplearning4j_tpu.ndarray.dtypes import DataType
 from deeplearning4j_tpu.ndarray.ndarray import NDArray, _unwrap
+from deeplearning4j_tpu.nn.conf.constraint import apply_constraints
 from deeplearning4j_tpu.nn.graph.config import ComputationGraphConfiguration
 from deeplearning4j_tpu.nn.graph.vertices import LayerVertex
 from deeplearning4j_tpu.nn.conf.layers import LossLayer, OutputLayer
@@ -44,6 +45,7 @@ class ComputationGraph:
         self._rng_key = None
         self._step_cache = {}
         self._fwd = None
+        self._node_index = None
         self._dtype = DataType.from_any(conf.dtype).jax
 
     # ------------------------------------------------------------------
@@ -75,6 +77,11 @@ class ComputationGraph:
         if self.params_map is None:
             raise RuntimeError("Call init() first")
 
+    def _node_by_name(self, name: str):
+        if self._node_index is None:
+            self._node_index = {n.name: n for n in self.conf.nodes}
+        return self._node_index[name]
+
     # ------------------------------------------------------------------
     def _forward_all(self, params_map, states_map, inputs: dict, train, rng):
         conf = self.conf
@@ -101,16 +108,22 @@ class ComputationGraph:
         for i, node in enumerate(conf.nodes):
             xs = [acts[s] for s in node.inputs]
             v = node.vertex
+            p_i = params_map[node.name]
+            k_i = keys[i]
+            # weight noise (reference: IWeightNoise, conf/weightnoise/**)
+            wn = getattr(getattr(v, "layer", None), "weight_noise", None)
+            if wn is not None and k_i is not None:
+                k_i, k_wn = jax.random.split(k_i)
+                p_i = wn.apply(p_i, k_wn)
             if node.name in conf.network_outputs and isinstance(v, LayerVertex) \
                     and isinstance(v.layer, (OutputLayer, LossLayer)):
                 total = total + v.layer.loss_value(
-                    params_map[node.name], states_map[node.name], xs[0],
+                    p_i, states_map[node.name], xs[0],
                     labels_map[node.name], None)
                 new_states[node.name] = states_map[node.name]
                 acts[node.name] = xs[0]
             else:
-                out, ns = v.apply(params_map[node.name], states_map[node.name],
-                                  xs, True, keys[i])
+                out, ns = v.apply(p_i, states_map[node.name], xs, True, k_i)
                 acts[node.name] = out
                 new_states[node.name] = ns
         data_loss = total
@@ -180,8 +193,12 @@ class ComputationGraph:
                 updates, no = apply_updater(self._updaters[name],
                                             opt_states[name], grads[name],
                                             params_map[name], step)
-                new_params[name] = jax.tree_util.tree_map(
+                np_i = jax.tree_util.tree_map(
                     lambda p, u: p - u, params_map[name], updates)
+                # post-update constraints (reference: BaseConstraint)
+                lay = getattr(self._node_by_name(name).vertex, "layer", None)
+                new_params[name] = apply_constraints(lay, np_i) \
+                    if lay is not None else np_i
                 new_opt[name] = no
             return new_params, new_states, new_opt, data_loss
 

@@ -266,3 +266,121 @@ class PreprocessorVertex(GraphVertex):
         from deeplearning4j_tpu.nn.conf.builder import apply_preprocessor
 
         return apply_preprocessor(self.tag, inputs[0]), state
+
+
+@serializable
+@dataclasses.dataclass
+class ShiftVertex(GraphVertex):
+    """x + constant (reference: conf/graph/ShiftVertex)."""
+
+    shift: float = 0.0
+
+    def apply(self, params, state, inputs, train, rng):
+        return inputs[0] + self.shift, state
+
+
+@serializable
+@dataclasses.dataclass
+class ReshapeVertex(GraphVertex):
+    """Reshape to a fixed per-example shape (reference:
+    conf/graph/ReshapeVertex; batch dim preserved)."""
+
+    shape: Optional[List[int]] = None  # per-example target shape
+
+    def output_type(self, its):
+        s = list(self.shape)
+        if len(s) == 1:
+            return InputType.feedForward(s[0])
+        if len(s) == 2:
+            return InputType.recurrent(s[1], s[0])
+        if len(s) == 3:
+            return InputType.convolutional(s[0], s[1], s[2])
+        if len(s) == 4:
+            return InputType.convolutional3D(s[0], s[1], s[2], s[3])
+        raise ValueError(f"ReshapeVertex: bad shape {self.shape}")
+
+    def apply(self, params, state, inputs, train, rng):
+        x = inputs[0]
+        return x.reshape((x.shape[0],) + tuple(self.shape)), state
+
+
+@serializable
+@dataclasses.dataclass
+class L2Vertex(GraphVertex):
+    """Pairwise L2 distance between two inputs per example (reference:
+    conf/graph/L2Vertex — the siamese/triplet distance head)."""
+
+    eps: float = 1e-8
+
+    def output_type(self, its):
+        return InputType.feedForward(1)
+
+    def apply(self, params, state, inputs, train, rng):
+        a, b = inputs[0], inputs[1]
+        d = (a - b).reshape(a.shape[0], -1)
+        return jnp.sqrt(jnp.sum(d * d, axis=1, keepdims=True) + self.eps), \
+            state
+
+
+@serializable
+@dataclasses.dataclass
+class FrozenVertex(GraphVertex):
+    """Wrap any vertex so its params get no gradient (reference:
+    conf/graph/FrozenVertex — transfer-learning graphs)."""
+
+    vertex: object = None
+
+    def output_type(self, its):
+        return self.vertex.output_type(its)
+
+    def init_params(self, key, its, dtype):
+        return self.vertex.init_params(key, its, dtype)
+
+    def init_state(self, its, dtype):
+        return self.vertex.init_state(its, dtype)
+
+    def apply(self, params, state, inputs, train, rng):
+        import jax as _jax
+
+        frozen = _jax.tree_util.tree_map(_jax.lax.stop_gradient, params)
+        # frozen vertices run in inference mode (dropout/BN stats off)
+        return self.vertex.apply(frozen, state, inputs, False, rng)
+
+
+@serializable
+@dataclasses.dataclass
+class PoolHelperVertex(GraphVertex):
+    """Strip the first spatial row/column (reference:
+    conf/graph/PoolHelperVertex — compatibility shim for Caffe-style
+    ceil-mode pooling in imported GoogLeNet-class models)."""
+
+    def output_type(self, its):
+        it = its[0]
+        return InputType.convolutional(it.height - 1, it.width - 1,
+                                       it.channels)
+
+    def apply(self, params, state, inputs, train, rng):
+        return inputs[0][:, 1:, 1:, :], state
+
+
+@serializable
+@dataclasses.dataclass
+class DotProductAttentionVertex(GraphVertex):
+    """Scaled dot-product attention over (query, key, value[, mask])
+    inputs (reference: conf/graph/AttentionVertex family; nd4j op
+    dot_product_attention). Parameterless — projections live in
+    upstream layers; scale = 1/sqrt(d)."""
+
+    def output_type(self, its):
+        q, v = its[0], its[2] if len(its) > 2 else its[-1]
+        return InputType.recurrent(v.size, q.timeseries_length)
+
+    def apply(self, params, state, inputs, train, rng):
+        from deeplearning4j_tpu.ops import nn as nnops
+
+        q, k, v = inputs[0], inputs[1], inputs[2]
+        mask = inputs[3] if len(inputs) > 3 and inputs[3] is not None \
+            else None  # [N, S] 1.0 = attend
+        return nnops.dot_product_attention(
+            q, k, v, mask=mask[:, None, :] if mask is not None else None), \
+            state

@@ -47,6 +47,7 @@ from deeplearning4j_tpu.ndarray.ndarray import NDArray, _unwrap
 from deeplearning4j_tpu.nn.conf.builder import (
     MultiLayerConfiguration, apply_preprocessor,
 )
+from deeplearning4j_tpu.nn.conf.constraint import apply_constraints
 from deeplearning4j_tpu.nn.conf.layers import LossLayer, OutputLayer
 
 #: param keys subject to l1/l2 (weights, not biases/scales — reference
@@ -174,13 +175,19 @@ class MultiLayerNetwork:
             tag = conf.preprocessors.get(i)
             if tag:
                 a = apply_preprocessor(tag, a)
+            p_i = params_list[i]
+            k_i = keys[i]
+            # weight noise (reference: IWeightNoise applied per training
+            # forward; DropConnect/WeightNoise in conf/weightnoise)
+            if getattr(layer, "weight_noise", None) is not None \
+                    and k_i is not None:
+                k_i, k_wn = jax.random.split(k_i)
+                p_i = layer.weight_noise.apply(p_i, k_wn)
             if carries is not None and layer.is_recurrent:
                 a, ns, c = layer.apply_with_carry(
-                    params_list[i], states_list[i], carries[i], a, True,
-                    keys[i])
+                    p_i, states_list[i], carries[i], a, True, k_i)
             else:
-                a, ns = layer.apply(params_list[i], states_list[i], a, True,
-                                    keys[i])
+                a, ns = layer.apply(p_i, states_list[i], a, True, k_i)
                 c = None
             new_states.append(ns)
             new_carries.append(c)
@@ -191,7 +198,11 @@ class MultiLayerNetwork:
         tag = conf.preprocessors.get(len(conf.layers) - 1)
         if tag:
             a = apply_preprocessor(tag, a)
-        data_loss = last.loss_value(params_list[-1], states_list[-1], a, y, mask)
+        p_last = params_list[-1]
+        if getattr(last, "weight_noise", None) is not None \
+                and keys[-1] is not None:
+            p_last = last.weight_noise.apply(p_last, keys[-1])
+        data_loss = last.loss_value(p_last, states_list[-1], a, y, mask)
         new_states.append(states_list[-1])
 
         # l1/l2 regularization (reference: BaseLayer#calcRegularizationScore)
@@ -251,8 +262,10 @@ class MultiLayerNetwork:
                 step = ep_step if _uses_epoch_schedule(self._updaters[i]) else it_step
                 updates, no = apply_updater(self._updaters[i], opt_states[i],
                                             grads[i], params_list[i], step)
-                new_params.append(jax.tree_util.tree_map(
-                    lambda p, u: p - u, params_list[i], updates))
+                np_i = jax.tree_util.tree_map(
+                    lambda p, u: p - u, params_list[i], updates)
+                # post-update constraints (reference: BaseConstraint)
+                new_params.append(apply_constraints(self.conf.layers[i], np_i))
                 new_opt.append(no)
             return new_params, new_states, new_opt, data_loss
 
@@ -282,8 +295,9 @@ class MultiLayerNetwork:
                 step = ep_step if _uses_epoch_schedule(self._updaters[i]) else it_step
                 updates, no = apply_updater(self._updaters[i], opt_states[i],
                                             grads[i], params_list[i], step)
-                new_params.append(jax.tree_util.tree_map(
-                    lambda p, u: p - u, params_list[i], updates))
+                np_i = jax.tree_util.tree_map(
+                    lambda p, u: p - u, params_list[i], updates)
+                new_params.append(apply_constraints(self.conf.layers[i], np_i))
                 new_opt.append(no)
             return new_params, new_states, new_opt, new_carries, data_loss
 

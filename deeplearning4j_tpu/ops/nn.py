@@ -488,10 +488,12 @@ def lstm_layer(x, w_ih, w_hh, b, h0=None, c0=None, reverse=False):
 
 
 @register_op("gru_layer")
-def gru_layer(x, w_ih, w_hh, b, h0=None):
+def gru_layer(x, w_ih, w_hh, b, h0=None, rb=None):
     """GRU over time. x: [N,T,in]; w_ih: [in,3H]; w_hh: [H,3H]; b: [3H].
 
-    Gate order: r (reset), z (update), n (candidate).
+    Gate order: r (reset), z (update), n (candidate). The candidate uses
+    r*(h@Whh_n) — "reset after" form. ``rb`` is an optional recurrent
+    bias [3H] added to the h projection (Keras reset_after parity).
     """
     n, t, _ = x.shape
     hidden = w_hh.shape[0]
@@ -501,6 +503,8 @@ def gru_layer(x, w_ih, w_hh, b, h0=None):
 
     def step(h, xp):
         hp = h @ w_hh
+        if rb is not None:
+            hp = hp + rb
         xr, xz, xn = jnp.split(xp, 3, axis=-1)
         hr, hz, hn = jnp.split(hp, 3, axis=-1)
         r = jax.nn.sigmoid(xr + hr)

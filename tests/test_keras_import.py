@@ -141,8 +141,8 @@ class TestSequentialImport:
 
     def test_unsupported_layer_raises(self, tmp_path):
         m = keras.Sequential([
-            keras.layers.Input((4, 4, 1)),
-            keras.layers.Conv2DTranspose(2, 3, name="ct"),
+            keras.layers.Input((5, 4, 4, 1)),
+            keras.layers.ConvLSTM2D(2, 3, name="cl"),
             keras.layers.Flatten(),
             keras.layers.Dense(2),
         ])
@@ -188,3 +188,112 @@ class TestFunctionalImport:
         net = KerasModelImport.importModel(p)
         from deeplearning4j_tpu.nn.graph.graph import ComputationGraph
         assert isinstance(net, ComputationGraph)
+
+
+class TestNewLayerMappers:
+    """Golden import tests for the extended mapper set (reference:
+    KerasModelEndToEndTest coverage of conv1d/3d, GRU, transpose,
+    depthwise, cropping, prelu...)."""
+
+    def test_conv1d_pool_gru(self, tmp_path):
+        m = keras.Sequential([
+            keras.layers.Input((16, 4)),
+            keras.layers.Conv1D(8, 3, padding="same", activation="relu",
+                                name="c1"),
+            keras.layers.MaxPooling1D(2, name="p1"),
+            keras.layers.GRU(6, return_sequences=False, name="g1"),
+            keras.layers.Dense(3, activation="softmax", name="out"),
+        ])
+        p = str(tmp_path / "c1gru.h5")
+        m.save(p)
+        net = KerasModelImport.importKerasSequentialModelAndWeights(p)
+        x = np.random.default_rng(0).normal(size=(3, 16, 4)).astype(np.float32)
+        _compare(m, net, x, rtol=1e-3, atol=1e-4)
+
+    def test_gru_return_sequences_golden(self, tmp_path):
+        m = keras.Sequential([
+            keras.layers.Input((10, 5)),
+            keras.layers.GRU(7, return_sequences=True, name="g"),
+        ])
+        # randomize biases so reset_after bias split is exercised
+        g = m.get_layer("g")
+        ws = g.get_weights()
+        rng = np.random.default_rng(1)
+        ws[2] = rng.normal(0, 0.5, ws[2].shape).astype(np.float32)
+        g.set_weights(ws)
+        p = str(tmp_path / "gru.h5")
+        m.save(p)
+        net = KerasModelImport.importKerasSequentialModelAndWeights(p)
+        x = rng.normal(size=(2, 10, 5)).astype(np.float32)
+        _compare(m, net, x, rtol=1e-3, atol=1e-4)
+
+    def test_deconv_depthwise_crop(self, tmp_path):
+        m = keras.Sequential([
+            keras.layers.Input((8, 8, 3)),
+            keras.layers.DepthwiseConv2D(3, padding="same",
+                                         depth_multiplier=2, name="dw"),
+            keras.layers.Conv2DTranspose(4, 2, strides=2, padding="same",
+                                         name="ct"),
+            keras.layers.Cropping2D(((1, 2), (0, 1)), name="cr"),
+            keras.layers.GlobalAveragePooling2D(name="gap"),
+            keras.layers.Dense(2, name="fin"),
+        ])
+        p = str(tmp_path / "dc.h5")
+        m.save(p)
+        net = KerasModelImport.importKerasSequentialModelAndWeights(p)
+        x = np.random.default_rng(2).normal(size=(2, 8, 8, 3)) \
+            .astype(np.float32)
+        _compare(m, net, x, rtol=1e-3, atol=1e-4)
+
+    def test_conv3d_pool3d(self, tmp_path):
+        m = keras.Sequential([
+            keras.layers.Input((6, 6, 6, 2)),
+            keras.layers.Conv3D(4, 3, padding="same", activation="relu",
+                                name="c3"),
+            keras.layers.MaxPooling3D(2, name="p3"),
+            keras.layers.Flatten(name="fl"),
+            keras.layers.Dense(3, activation="softmax", name="out"),
+        ])
+        p = str(tmp_path / "c3.h5")
+        m.save(p)
+        net = KerasModelImport.importKerasSequentialModelAndWeights(p)
+        x = np.random.default_rng(3).normal(size=(2, 6, 6, 6, 2)) \
+            .astype(np.float32)
+        _compare(m, net, x, rtol=1e-3, atol=1e-4)
+
+    def test_prelu_repeat_layernorm(self, tmp_path):
+        m = keras.Sequential([
+            keras.layers.Input((6,)),
+            keras.layers.Dense(8, name="d1"),
+            keras.layers.PReLU(name="pr"),
+            keras.layers.LayerNormalization(name="ln"),
+            keras.layers.RepeatVector(4, name="rv"),
+            keras.layers.GRU(5, name="g"),
+            keras.layers.Dense(2, activation="softmax", name="out"),
+        ])
+        pr = m.get_layer("pr")
+        pr.set_weights([np.random.default_rng(4)
+                        .uniform(0.1, 0.4, pr.get_weights()[0].shape)
+                        .astype(np.float32)])
+        p = str(tmp_path / "pr.h5")
+        m.save(p)
+        net = KerasModelImport.importKerasSequentialModelAndWeights(p)
+        x = np.random.default_rng(5).normal(size=(3, 6)).astype(np.float32)
+        _compare(m, net, x, rtol=1e-3, atol=1e-4)
+
+    def test_upsampling_padding_1d(self, tmp_path):
+        m = keras.Sequential([
+            keras.layers.Input((12, 3)),
+            keras.layers.ZeroPadding1D(2, name="zp"),
+            keras.layers.Conv1D(5, 3, name="c"),
+            keras.layers.UpSampling1D(2, name="up"),
+            keras.layers.Cropping1D((1, 1), name="cr"),
+            keras.layers.GlobalMaxPooling1D(name="gmp"),
+            keras.layers.Dense(2, name="fin"),
+        ])
+        p = str(tmp_path / "ud.h5")
+        m.save(p)
+        net = KerasModelImport.importKerasSequentialModelAndWeights(p)
+        x = np.random.default_rng(6).normal(size=(2, 12, 3)) \
+            .astype(np.float32)
+        _compare(m, net, x, rtol=1e-3, atol=1e-4)

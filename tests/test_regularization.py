@@ -1,0 +1,171 @@
+"""Dropout family / weight noise / constraints tests (reference analogs:
+TestDropout, TestWeightNoise, TestConstraints in deeplearning4j-nn)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.common import serde
+from deeplearning4j_tpu.learning import Adam, Sgd
+from deeplearning4j_tpu.nn.conf import (
+    AlphaDropout, DenseLayer, DropConnect, Dropout, DropoutLayer,
+    GaussianDropout, GaussianNoise, InputType, MaxNormConstraint,
+    MinMaxNormConstraint, NeuralNetConfiguration, NonNegativeConstraint,
+    OutputLayer, SpatialDropout, UnitNormConstraint, WeightNoise,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+
+class TestDropoutFamily:
+    def _x(self, shape=(4, 1000)):
+        return jnp.ones(shape)
+
+    def test_dropout_inverted_scaling(self):
+        out = Dropout(rate=0.4).apply(self._x(), jax.random.key(0))
+        kept = np.asarray(out) != 0
+        # kept activations are scaled by 1/keep
+        np.testing.assert_allclose(np.asarray(out)[kept], 1 / 0.6, rtol=1e-5)
+        assert 0.5 < kept.mean() < 0.7    # ~keep probability
+
+    def test_spatial_dropout_drops_whole_channels(self):
+        x = jnp.ones((2, 8, 8, 64))
+        out = np.asarray(SpatialDropout(rate=0.5).apply(x, jax.random.key(1)))
+        # each (batch, channel) slice is all-zero or all-scaled
+        per_chan = out.reshape(2, 64, -1) if False else \
+            out.transpose(0, 3, 1, 2).reshape(2, 64, -1)
+        for b in range(2):
+            for c in range(64):
+                sl = per_chan[b, c]
+                assert np.all(sl == 0) or np.all(sl == 2.0)
+
+    def test_gaussian_dropout_mean_preserving(self):
+        out = GaussianDropout(rate=0.3).apply(self._x((8, 4000)),
+                                              jax.random.key(2))
+        assert abs(float(jnp.mean(out)) - 1.0) < 0.02
+
+    def test_gaussian_noise_additive(self):
+        out = GaussianNoise(stddev=0.5).apply(self._x((8, 4000)),
+                                              jax.random.key(3))
+        assert abs(float(jnp.mean(out)) - 1.0) < 0.02
+        assert 0.45 < float(jnp.std(out)) < 0.55
+
+    def test_alpha_dropout_preserves_selu_moments(self):
+        # on SELU-distributed activations, mean/var stay ~unchanged
+        x = jax.random.normal(jax.random.key(4), (64, 4000))
+        out = AlphaDropout(rate=0.1).apply(x, jax.random.key(5))
+        assert abs(float(jnp.mean(out))) < 0.05
+        assert abs(float(jnp.var(out)) - 1.0) < 0.1
+
+    def test_layer_level_idropout_config_and_training(self):
+        conf = (NeuralNetConfiguration.builder().seed(1)
+                .updater(Adam(learning_rate=1e-2)).list()
+                .layer(DenseLayer(n_out=16, activation="selu",
+                                  dropout=AlphaDropout(rate=0.05)))
+                .layer(DropoutLayer(rate=GaussianDropout(rate=0.1)))
+                .layer(OutputLayer(n_out=2, activation="softmax",
+                                   loss="mcxent"))
+                .setInputType(InputType.feedForward(8)).build())
+        # JSON round-trip with dropout objects
+        j = conf.to_json()
+        from deeplearning4j_tpu.nn.conf import MultiLayerConfiguration
+        assert MultiLayerConfiguration.from_json(j).to_json() == j
+        net = MultiLayerNetwork(conf).init()
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(32, 8)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 32)]
+        for _ in range(5):
+            net.fit(x, y)
+        assert np.isfinite(net.score())
+        # inference is deterministic (no dropout)
+        o1, o2 = np.asarray(net.output(x)), np.asarray(net.output(x))
+        np.testing.assert_allclose(o1, o2)
+
+
+class TestWeightNoise:
+    def test_dropconnect_masks_weights_not_bias(self):
+        dc = DropConnect(rate=0.5)
+        p = {"W": jnp.ones((50, 50)), "b": jnp.ones((50,))}
+        out = dc.apply(p, jax.random.key(0))
+        w = np.asarray(out["W"])
+        assert ((w == 0) | (w == 2.0)).all() and (w == 0).any()
+        np.testing.assert_allclose(np.asarray(out["b"]), 1.0)  # untouched
+
+    def test_weight_noise_additive(self):
+        wn = WeightNoise(stddev=0.2, additive=True)
+        p = {"W": jnp.zeros((100, 100))}
+        out = np.asarray(wn.apply(p, jax.random.key(1))["W"])
+        assert 0.15 < out.std() < 0.25 and abs(out.mean()) < 0.02
+
+    def test_training_with_dropconnect_converges(self):
+        conf = (NeuralNetConfiguration.builder().seed(3)
+                .updater(Adam(learning_rate=1e-2)).list()
+                .layer(DenseLayer(n_out=16, activation="relu",
+                                  weight_noise=DropConnect(rate=0.2)))
+                .layer(OutputLayer(n_out=2, activation="softmax",
+                                   loss="mcxent"))
+                .setInputType(InputType.feedForward(4)).build())
+        net = MultiLayerNetwork(conf).init()
+        rng = np.random.default_rng(1)
+        x = rng.normal(size=(64, 4)).astype(np.float32)
+        lab = (x[:, 0] > 0).astype(int)
+        y = np.eye(2, dtype=np.float32)[lab]
+        for _ in range(60):
+            net.fit(x, y)
+        acc = (np.asarray(net.output(x)).argmax(-1) == lab).mean()
+        assert acc > 0.8, acc
+
+
+class TestConstraints:
+    def test_maxnorm_unit_columns(self):
+        w = jnp.full((10, 5), 3.0)  # column norm = 3*sqrt(10)
+        out = np.asarray(MaxNormConstraint(max_norm=2.0)._constrain_one(w))
+        norms = np.linalg.norm(out, axis=0)
+        np.testing.assert_allclose(norms, 2.0, rtol=1e-5)
+        # under-norm weights untouched
+        w2 = jnp.full((4, 2), 0.1)
+        out2 = np.asarray(MaxNormConstraint(max_norm=2.0)._constrain_one(w2))
+        np.testing.assert_allclose(out2, 0.1, rtol=1e-5)
+
+    def test_unitnorm_and_nonneg(self):
+        w = jax.random.normal(jax.random.key(0), (6, 3))
+        out = np.asarray(UnitNormConstraint()._constrain_one(w))
+        np.testing.assert_allclose(np.linalg.norm(out, axis=0), 1.0,
+                                   rtol=1e-5)
+        out2 = np.asarray(NonNegativeConstraint()._constrain_one(w))
+        assert (out2 >= 0).all()
+
+    def test_minmax_norm(self):
+        w = jnp.concatenate([jnp.full((9, 1), 3.0),    # norm 9
+                             jnp.full((9, 1), 0.01)],  # norm .03
+                            axis=1)
+        out = np.asarray(MinMaxNormConstraint(
+            min_norm=0.5, max_norm=2.0)._constrain_one(w))
+        norms = np.linalg.norm(out, axis=0)
+        np.testing.assert_allclose(norms, [2.0, 0.5], rtol=1e-4)
+
+    def test_constraint_enforced_during_training(self):
+        conf = (NeuralNetConfiguration.builder().seed(5)
+                .updater(Sgd(learning_rate=0.5)).list()
+                .layer(DenseLayer(n_out=8, activation="tanh",
+                                  constraints=[MaxNormConstraint(max_norm=1.0)]))
+                .layer(OutputLayer(n_out=2, activation="softmax",
+                                   loss="mcxent"))
+                .setInputType(InputType.feedForward(4)).build())
+        net = MultiLayerNetwork(conf).init()
+        rng = np.random.default_rng(2)
+        x = rng.normal(size=(32, 4)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 32)]
+        for _ in range(10):
+            net.fit(x, y)
+        w = np.asarray(net.params_list[0]["W"])
+        assert np.linalg.norm(w, axis=0).max() <= 1.0 + 1e-5
+
+    def test_serde_round_trip(self):
+        for obj in [Dropout(0.3), AlphaDropout(0.2), GaussianDropout(0.1),
+                    GaussianNoise(0.5), SpatialDropout(0.4),
+                    DropConnect(0.5), WeightNoise(0.0, 0.1, False),
+                    MaxNormConstraint(1.5), MinMaxNormConstraint(0.1, 2.0),
+                    UnitNormConstraint(), NonNegativeConstraint()]:
+            j = serde.to_json(obj)
+            assert serde.to_json(serde.from_json(j)) == j, type(obj).__name__

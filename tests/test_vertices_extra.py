@@ -1,0 +1,108 @@
+"""Extended graph vertex tests (reference analogs: graph vertex tests in
+deeplearning4j-nn ComputationGraphTestRNN / TestGraphNodes)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.learning import Adam, Sgd
+from deeplearning4j_tpu.nn.conf import DenseLayer, InputType, OutputLayer
+from deeplearning4j_tpu.nn.graph import (
+    ComputationGraph, ComputationGraphConfiguration,
+    DotProductAttentionVertex, FrozenVertex, L2Vertex, LayerVertex,
+    PoolHelperVertex, ReshapeVertex, ShiftVertex,
+)
+
+
+class TestSimpleVertices:
+    def test_shift_reshape_poolhelper(self):
+        sv = ShiftVertex(shift=2.5)
+        out, _ = sv.apply({}, {}, [jnp.zeros((2, 3))], False, None)
+        np.testing.assert_allclose(np.asarray(out), 2.5)
+
+        rv = ReshapeVertex(shape=[4, 4, 2])
+        out, _ = rv.apply({}, {}, [jnp.arange(64.0).reshape(2, 32)], False,
+                          None)
+        assert out.shape == (2, 4, 4, 2)
+        it = rv.output_type([InputType.feedForward(32)])
+        assert (it.height, it.width, it.channels) == (4, 4, 2)
+
+        ph = PoolHelperVertex()
+        out, _ = ph.apply({}, {}, [jnp.ones((2, 5, 5, 3))], False, None)
+        assert out.shape == (2, 4, 4, 3)
+
+    def test_l2_vertex_distance(self):
+        a = jnp.array([[1.0, 0.0], [0.0, 0.0]])
+        b = jnp.array([[0.0, 0.0], [3.0, 4.0]])
+        out, _ = L2Vertex().apply({}, {}, [a, b], False, None)
+        np.testing.assert_allclose(np.asarray(out)[:, 0], [1.0, 5.0],
+                                   atol=1e-5)
+
+    def test_attention_vertex(self):
+        n, t, s, d = 2, 3, 4, 8
+        q = jax.random.normal(jax.random.key(0), (n, t, d))
+        k = jax.random.normal(jax.random.key(1), (n, s, d))
+        v = jax.random.normal(jax.random.key(2), (n, s, d))
+        out, _ = DotProductAttentionVertex().apply({}, {}, [q, k, v],
+                                                   False, None)
+        assert out.shape == (n, t, d)
+        # mask: only first source position attended -> output == v[:, :1]
+        mask = jnp.zeros((n, s)).at[:, 0].set(1.0)
+        out_m, _ = DotProductAttentionVertex().apply({}, {}, [q, k, v, mask],
+                                                     False, None)
+        want = jnp.broadcast_to(v[:, :1, :], (n, t, d))
+        np.testing.assert_allclose(np.asarray(out_m), np.asarray(want),
+                                   atol=1e-5)
+
+
+class TestFrozenVertexTraining:
+    def test_frozen_vertex_params_fixed_in_graph(self):
+        b = (ComputationGraphConfiguration.graphBuilder().seed(1)
+             .updater(Sgd(learning_rate=0.2))
+             .addInputs("in"))
+        b.setInputTypes(InputType.feedForward(4))
+        b.addVertex("frozen",
+                    FrozenVertex(vertex=LayerVertex(
+                        layer=DenseLayer(n_in=4, n_out=8,
+                                         activation="relu"))), "in")
+        b.addLayer("out", OutputLayer(n_out=2, activation="softmax",
+                                      loss="mcxent"), "frozen")
+        g = ComputationGraph(b.setOutputs("out").build()).init()
+        w0 = np.asarray(g.params_map["frozen"]["W"]).copy()
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(16, 4)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 16)]
+        for _ in range(5):
+            g.fit([x], [y])
+        np.testing.assert_allclose(np.asarray(g.params_map["frozen"]["W"]),
+                                   w0)
+        # downstream layer trained
+        assert np.isfinite(g.score())
+
+
+class TestAttentionGraphTraining:
+    def test_attention_seq_classifier_learns(self):
+        """q/k/v projections as layers + attention vertex, end-to-end."""
+        b = (ComputationGraphConfiguration.graphBuilder().seed(3)
+             .updater(Adam(learning_rate=5e-3))
+             .addInputs("seq"))
+        b.setInputTypes(InputType.recurrent(6, 8))
+        b.addLayer("q", DenseLayer(n_in=6, n_out=12), "seq")
+        b.addLayer("k", DenseLayer(n_in=6, n_out=12), "seq")
+        b.addLayer("v", DenseLayer(n_in=6, n_out=12), "seq")
+        b.addVertex("att", DotProductAttentionVertex(), "q", "k", "v")
+        from deeplearning4j_tpu.nn.conf import GlobalPoolingLayer
+        b.addLayer("pool", GlobalPoolingLayer(pooling_type="avg"), "att")
+        b.addLayer("out", OutputLayer(n_in=12, n_out=2,
+                                      activation="softmax", loss="mcxent"),
+                   "pool")
+        g = ComputationGraph(b.setOutputs("out").build()).init()
+        rng = np.random.default_rng(1)
+        x = rng.normal(size=(32, 8, 6)).astype(np.float32)
+        lab = (x[:, :, 0].mean(1) > 0).astype(int)
+        y = np.eye(2, dtype=np.float32)[lab]
+        s0 = None
+        for _ in range(30):
+            g.fit([x], [y])
+            s0 = s0 or g.score()
+        assert g.score() < s0
