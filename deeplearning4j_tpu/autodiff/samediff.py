@@ -272,9 +272,18 @@ class SameDiff:
         self._loss_variables: List[str] = []
         self._last_grads: Dict[str, jax.Array] = {}
         self._trainable_order: Optional[List[str]] = None
+        # op namespaces (reference: SDMath/SDNN/SDCNN/SDRNN/SDLoss/
+        # SDImage/SDRandom/SDLinalg/SDBitwise op factories — all resolve
+        # against the same op registry here)
         self.math = _OpNamespace(self)
         self.nn = _OpNamespace(self)
         self.loss = _OpNamespace(self)
+        self.cnn = _OpNamespace(self)
+        self.rnn = _OpNamespace(self)
+        self.image = _OpNamespace(self)
+        self.random = _OpNamespace(self)
+        self.linalg = _OpNamespace(self)
+        self.bitwise = _OpNamespace(self)
         # training session state (populated by fit)
         self.training_config = None
         self._updater_state = None

@@ -10,8 +10,14 @@ from deeplearning4j_tpu.datasets.record_reader_iterator import (
     RecordReaderDataSetIterator,
     SequenceRecordReaderDataSetIterator,
 )
+from deeplearning4j_tpu.datasets.fetchers import (
+    Cifar10DataSetIterator, EmnistDataSetIterator, IrisDataSetIterator,
+    MnistDataSetIterator,
+)
 
 __all__ = ["DataSet", "DataSetIterator", "ListDataSetIterator",
            "ArrayDataSetIterator", "AsyncDataSetIterator",
            "RecordReaderDataSetIterator",
-           "SequenceRecordReaderDataSetIterator"]
+           "SequenceRecordReaderDataSetIterator",
+           "IrisDataSetIterator", "MnistDataSetIterator",
+           "EmnistDataSetIterator", "Cifar10DataSetIterator"]

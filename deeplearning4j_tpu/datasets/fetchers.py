@@ -1,0 +1,189 @@
+"""Named dataset iterators (reference: deeplearning4j-datasets —
+org/deeplearning4j/datasets/iterator/impl/{IrisDataSetIterator,
+MnistDataSetIterator,EmnistDataSetIterator,Cifar10DataSetIterator}.java
+and the base fetchers; SURVEY.md §2.27).
+
+The reference's fetchers download archives on first use. This build
+environment has zero network egress, so:
+- Iris ships bundled (via scikit-learn's offline copy — same 150 rows).
+- MNIST/EMNIST read the standard IDX files from a local directory
+  (``~/.deeplearning4j_tpu/mnist`` or ``$DL4J_TPU_DATA_DIR``), raising
+  a clear error telling the user where to place them when absent.
+- CIFAR-10 reads the standard binary batches from a local directory.
+
+All iterators yield one-hot labels and NHWC image layouts (TPU-native),
+and plug into the same normalizer/async-prefetch machinery as any
+DataSetIterator.
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+from typing import Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterator import (
+    ArrayDataSetIterator, DataSetIterator,
+)
+
+
+def _data_dir(sub: str) -> str:
+    root = os.environ.get(
+        "DL4J_TPU_DATA_DIR",
+        os.path.join(os.path.expanduser("~"), ".deeplearning4j_tpu"))
+    return os.path.join(root, sub)
+
+
+class IrisDataSetIterator(ArrayDataSetIterator):
+    """reference: datasets/iterator/impl/IrisDataSetIterator (150
+    examples, 4 features, 3 classes). The dataset ships bundled
+    (``_iris.csv`` — Fisher 1936, public domain)."""
+
+    def __init__(self, batch: int = 150, num_examples: int = 150,
+                 seed: int = 12345, shuffle: bool = True):
+        raw = np.loadtxt(os.path.join(os.path.dirname(__file__),
+                                      "_iris.csv"), delimiter=",",
+                         dtype=np.float32)
+        x = raw[:, :4]
+        y = np.eye(3, dtype=np.float32)[raw[:, 4].astype(np.int64)]
+        if shuffle:
+            order = np.random.default_rng(seed).permutation(len(x))
+            x, y = x[order], y[order]
+        x, y = x[:num_examples], y[:num_examples]
+        super().__init__(x, y, batch)
+
+
+# ----------------------------------------------------------------- IDX
+def _read_idx(path: str) -> np.ndarray:
+    """Read an (optionally gzipped) IDX file (the MNIST wire format)."""
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        magic = struct.unpack(">I", f.read(4))[0]
+        ndim = magic & 0xFF
+        dtype = (magic >> 8) & 0xFF
+        if dtype != 0x08:
+            raise ValueError(f"{path}: unsupported IDX dtype 0x{dtype:02x}")
+        shape = struct.unpack(">" + "I" * ndim, f.read(4 * ndim))
+        return np.frombuffer(f.read(), np.uint8).reshape(shape)
+
+
+def _find_idx(directory: str, stems) -> str:
+    for stem in stems:
+        for suffix in ("", ".gz"):
+            p = os.path.join(directory, stem + suffix)
+            if os.path.exists(p):
+                return p
+    raise FileNotFoundError(
+        f"None of {list(stems)} found in {directory!r}. This environment "
+        "has no network egress — download the IDX files elsewhere and "
+        "place them there (or set $DL4J_TPU_DATA_DIR).")
+
+
+class MnistDataSetIterator(ArrayDataSetIterator):
+    """reference: datasets/iterator/impl/MnistDataSetIterator.
+
+    Yields flat [N, 784] float rows in [0,1] with one-hot labels, like
+    the reference (use ``as_images=True`` for [N,28,28,1] NHWC)."""
+
+    IMG_STEMS_TRAIN = ("train-images-idx3-ubyte", "train-images.idx3-ubyte")
+    LBL_STEMS_TRAIN = ("train-labels-idx1-ubyte", "train-labels.idx1-ubyte")
+    IMG_STEMS_TEST = ("t10k-images-idx3-ubyte", "t10k-images.idx3-ubyte")
+    LBL_STEMS_TEST = ("t10k-labels-idx1-ubyte", "t10k-labels.idx1-ubyte")
+
+    def __init__(self, batch: int, train: bool = True,
+                 num_examples: Optional[int] = None, seed: int = 12345,
+                 shuffle: Optional[bool] = None, binarize: bool = False,
+                 as_images: bool = False, data_dir: Optional[str] = None,
+                 subdir: str = "mnist", label_offset: int = 0):
+        d = data_dir or _data_dir(subdir)
+        img = _read_idx(_find_idx(
+            d, self.IMG_STEMS_TRAIN if train else self.IMG_STEMS_TEST))
+        lbl = _read_idx(_find_idx(
+            d, self.LBL_STEMS_TRAIN if train else self.LBL_STEMS_TEST))
+        x = img.astype(np.float32) / 255.0
+        if binarize:
+            x = (x > 0.5).astype(np.float32)
+        lbl = lbl.astype(np.int64) - label_offset
+        n_classes = int(lbl.max()) + 1
+        y = np.eye(max(n_classes, 10), dtype=np.float32)[lbl]
+        if shuffle is None:
+            shuffle = train
+        if shuffle:
+            order = np.random.default_rng(seed).permutation(len(x))
+            x, y = x[order], y[order]
+        if num_examples:
+            x, y = x[:num_examples], y[:num_examples]
+        x = x[..., None] if as_images else x.reshape(len(x), -1)
+        super().__init__(x, y, batch)
+
+
+class EmnistDataSetIterator(MnistDataSetIterator):
+    """reference: datasets/iterator/impl/EmnistDataSetIterator — same
+    IDX wire format as MNIST, stored per-split (e.g.
+    ``emnist-letters-train-images-idx3-ubyte``)."""
+
+    def __init__(self, dataset_type: str, batch: int, train: bool = True,
+                 **kw):
+        t = "train" if train else "test"
+        self.IMG_STEMS_TRAIN = self.IMG_STEMS_TEST = (
+            f"emnist-{dataset_type}-{t}-images-idx3-ubyte",)
+        self.LBL_STEMS_TRAIN = self.LBL_STEMS_TEST = (
+            f"emnist-{dataset_type}-{t}-labels-idx1-ubyte",)
+        kw.setdefault("subdir", "emnist")
+        # EMNIST 'letters' labels are 1-indexed (1..26) — shift to a
+        # 26-wide one-hot like the reference's LETTERS numOutcomes=26
+        if dataset_type == "letters":
+            kw.setdefault("label_offset", 1)
+        super().__init__(batch, train=train, **kw)
+
+
+class Cifar10DataSetIterator(ArrayDataSetIterator):
+    """reference: datasets/iterator/impl/Cifar10DataSetIterator — reads
+    the standard CIFAR-10 binary batches (data_batch_*.bin /
+    test_batch.bin: 1 label byte + 3072 CHW pixel bytes per record).
+    Yields NHWC [N,32,32,3] floats in [0,1]."""
+
+    def __init__(self, batch: int, train: bool = True,
+                 num_examples: Optional[int] = None, seed: int = 12345,
+                 shuffle: Optional[bool] = None,
+                 data_dir: Optional[str] = None):
+        d = data_dir or _data_dir("cifar10")
+        names = [f"data_batch_{i}.bin" for i in range(1, 6)] if train \
+            else ["test_batch.bin"]
+        xs, ys = [], []
+        for nm in names:
+            p = os.path.join(d, nm)
+            if not os.path.exists(p):
+                # also accept the cifar-10-batches-bin subdir layout
+                p2 = os.path.join(d, "cifar-10-batches-bin", nm)
+                if not os.path.exists(p2):
+                    # fail fast on ANY missing batch — silently training
+                    # on a partial dataset is worse than an error
+                    raise FileNotFoundError(
+                        f"{nm} not found under {d!r}. No network egress — "
+                        "place the CIFAR-10 binary batches there (or set "
+                        "$DL4J_TPU_DATA_DIR).")
+                p = p2
+            raw = np.fromfile(p, np.uint8).reshape(-1, 3073)
+            ys.append(raw[:, 0])
+            xs.append(raw[:, 1:])
+        if not xs:
+            raise FileNotFoundError(
+                f"no CIFAR-10 batches found under {d!r}. No network "
+                "egress — place data_batch_*.bin there (or set "
+                "$DL4J_TPU_DATA_DIR).")
+        x = np.concatenate(xs).reshape(-1, 3, 32, 32) \
+            .transpose(0, 2, 3, 1).astype(np.float32) / 255.0
+        y = np.eye(10, dtype=np.float32)[np.concatenate(ys)]
+        if shuffle is None:
+            shuffle = train
+        if shuffle:
+            order = np.random.default_rng(seed).permutation(len(x))
+            x, y = x[order], y[order]
+        if num_examples:
+            x, y = x[:num_examples], y[:num_examples]
+        super().__init__(x, y, batch)

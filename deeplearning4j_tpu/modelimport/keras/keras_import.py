@@ -272,6 +272,14 @@ def _map_layer(class_name: str, cfg: dict, is_last: bool):
             has_bias=cfg.get("use_bias", True))
     if class_name == "Conv2DTranspose":
         _check_channels_last(cfg, name)
+        d = cfg.get("dilation_rate", 1)
+        if _pair(d) != (1, 1):
+            raise UnsupportedKerasConfigurationException(
+                f"Conv2DTranspose {name!r}: dilation_rate={d} unsupported")
+        op = cfg.get("output_padding")
+        if op not in (None, 0, (0, 0), [0, 0]):
+            raise UnsupportedKerasConfigurationException(
+                f"Conv2DTranspose {name!r}: output_padding={op} unsupported")
         return Deconvolution2D(
             name=name, n_out=cfg["filters"],
             kernel_size=_pair(cfg["kernel_size"]),
@@ -285,6 +293,7 @@ def _map_layer(class_name: str, cfg: dict, is_last: bool):
             name=name, depth_multiplier=cfg.get("depth_multiplier", 1),
             kernel_size=_pair(cfg["kernel_size"]),
             stride=_pair(cfg.get("strides", 1)),
+            dilation=_pair(cfg.get("dilation_rate", 1)),
             convolution_mode=_conv_mode(cfg.get("padding", "valid")),
             activation=_map_activation(cfg.get("activation")),
             has_bias=cfg.get("use_bias", True))

@@ -66,6 +66,12 @@ class ComputationGraph:
             upd = conf.updater
             if isinstance(node.vertex, LayerVertex) and node.vertex.layer.updater is not None:
                 upd = node.vertex.layer.updater
+            # frozen vertices must not be touched by param-aware updaters
+            # either (AdamW weight decay mutates params at zero gradient)
+            from deeplearning4j_tpu.nn.graph.vertices import FrozenVertex
+            if isinstance(node.vertex, FrozenVertex):
+                from deeplearning4j_tpu.learning.updaters import NoOp
+                upd = NoOp()
             self._updaters[node.name] = upd
             self.opt_states[node.name] = upd.init_state(p)
             types[node.name] = node.vertex.output_type(in_types)

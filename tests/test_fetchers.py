@@ -1,0 +1,152 @@
+"""Named dataset iterator tests (reference analogs:
+MnistDataSetIteratorTest, IrisDataSetIterator usage in examples).
+MNIST/CIFAR files are fabricated in the standard wire formats."""
+
+import gzip
+import os
+import struct
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets import (
+    Cifar10DataSetIterator, EmnistDataSetIterator, IrisDataSetIterator,
+    MnistDataSetIterator,
+)
+
+
+def _write_idx_images(path, arr):
+    with open(path, "wb") as f:
+        f.write(struct.pack(">I", 0x00000803))
+        f.write(struct.pack(">III", *arr.shape))
+        f.write(arr.astype(np.uint8).tobytes())
+
+
+def _write_idx_labels(path, arr):
+    with open(path, "wb") as f:
+        f.write(struct.pack(">I", 0x00000801))
+        f.write(struct.pack(">I", arr.shape[0]))
+        f.write(arr.astype(np.uint8).tobytes())
+
+
+class TestIris:
+    def test_batching_and_classes(self):
+        it = IrisDataSetIterator(batch=50)
+        batches = list(it)
+        assert len(batches) == 3
+        assert batches[0].features.shape == (50, 4)
+        assert batches[0].labels.shape == (50, 3)
+        all_lab = np.concatenate([np.asarray(b.labels) for b in batches])
+        assert all_lab.sum() == 150          # one-hot
+        assert (all_lab.sum(0) == 50).all()  # 50 per class
+
+    def test_trains_a_classifier(self):
+        from deeplearning4j_tpu.learning import Adam
+        from deeplearning4j_tpu.nn.conf import (
+            DenseLayer, InputType, NeuralNetConfiguration, OutputLayer,
+        )
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+        conf = (NeuralNetConfiguration.builder().seed(1)
+                .updater(Adam(learning_rate=0.02)).list()
+                .layer(DenseLayer(n_out=16, activation="relu"))
+                .layer(OutputLayer(n_out=3, activation="softmax",
+                                   loss="mcxent"))
+                .setInputType(InputType.feedForward(4)).build())
+        net = MultiLayerNetwork(conf).init()
+        net.fit(IrisDataSetIterator(batch=32), epochs=40)
+        ev = net.evaluate(IrisDataSetIterator(batch=150, shuffle=False))
+        assert ev.accuracy() > 0.9
+
+
+class TestMnistIdx:
+    @pytest.fixture
+    def mnist_dir(self, tmp_path):
+        rng = np.random.default_rng(0)
+        imgs = rng.integers(0, 256, (60, 28, 28), np.uint8)
+        lbls = rng.integers(0, 10, 60, np.uint8)
+        _write_idx_images(str(tmp_path / "train-images-idx3-ubyte"), imgs)
+        _write_idx_labels(str(tmp_path / "train-labels-idx1-ubyte"), lbls)
+        # gzipped test split exercises the .gz path
+        t_imgs = rng.integers(0, 256, (20, 28, 28), np.uint8)
+        t_lbls = rng.integers(0, 10, 20, np.uint8)
+        buf_i = struct.pack(">I", 0x00000803) + \
+            struct.pack(">III", *t_imgs.shape) + t_imgs.tobytes()
+        buf_l = struct.pack(">I", 0x00000801) + \
+            struct.pack(">I", 20) + t_lbls.tobytes()
+        with gzip.open(str(tmp_path / "t10k-images-idx3-ubyte.gz"),
+                       "wb") as f:
+            f.write(buf_i)
+        with gzip.open(str(tmp_path / "t10k-labels-idx1-ubyte.gz"),
+                       "wb") as f:
+            f.write(buf_l)
+        return str(tmp_path), imgs, lbls
+
+    def test_flat_rows_and_values(self, mnist_dir):
+        d, imgs, lbls = mnist_dir
+        it = MnistDataSetIterator(25, train=True, shuffle=False, data_dir=d)
+        ds = it.next()
+        assert ds.features.shape == (25, 784)
+        np.testing.assert_allclose(
+            np.asarray(ds.features[0]).reshape(28, 28),
+            imgs[0].astype(np.float32) / 255.0)
+        assert np.asarray(ds.labels).argmax(-1).tolist() == \
+            lbls[:25].tolist()
+
+    def test_images_and_gz_test_split(self, mnist_dir):
+        d, _, _ = mnist_dir
+        it = MnistDataSetIterator(10, train=False, as_images=True,
+                                  data_dir=d)
+        ds = it.next()
+        assert ds.features.shape == (10, 28, 28, 1)
+
+    def test_missing_dir_raises_clearly(self, tmp_path):
+        with pytest.raises(FileNotFoundError, match="egress"):
+            MnistDataSetIterator(10, data_dir=str(tmp_path / "nope"))
+
+    def test_emnist_letters_one_indexed(self, tmp_path):
+        rng = np.random.default_rng(1)
+        imgs = rng.integers(0, 256, (30, 28, 28), np.uint8)
+        lbls = rng.integers(1, 27, 30, np.uint8)  # EMNIST letters: 1..26
+        lbls[0] = 26
+        _write_idx_images(
+            str(tmp_path / "emnist-letters-train-images-idx3-ubyte"), imgs)
+        _write_idx_labels(
+            str(tmp_path / "emnist-letters-train-labels-idx1-ubyte"), lbls)
+        it = EmnistDataSetIterator("letters", 30, train=True,
+                                   shuffle=False, data_dir=str(tmp_path))
+        ds = it.next()
+        assert ds.features.shape == (30, 784)
+        # 26 classes, 0-based (reference: EMNIST LETTERS numOutcomes=26)
+        assert ds.labels.shape[1] == 26
+        assert np.asarray(ds.labels).argmax(-1).tolist() == \
+            (lbls - 1).tolist()
+
+
+class TestCifar10:
+    def test_binary_batches(self, tmp_path):
+        rng = np.random.default_rng(2)
+        for i in range(1, 6):
+            n = 6
+            rec = np.zeros((n, 3073), np.uint8)
+            rec[:, 0] = rng.integers(0, 10, n)
+            rec[:, 1:] = rng.integers(0, 256, (n, 3072))
+            rec.tofile(str(tmp_path / f"data_batch_{i}.bin"))
+        it = Cifar10DataSetIterator(10, train=True, shuffle=False,
+                                    data_dir=str(tmp_path))
+        ds = it.next()
+        assert ds.features.shape == (10, 32, 32, 3)
+        assert float(np.asarray(ds.features).max()) <= 1.0
+        assert it.totalExamples() == 30
+
+    def test_partial_train_set_fails_fast(self, tmp_path):
+        rec = np.zeros((3, 3073), np.uint8)
+        for i in (1, 2):  # batches 3..5 missing
+            rec.tofile(str(tmp_path / f"data_batch_{i}.bin"))
+        with pytest.raises(FileNotFoundError, match="egress"):
+            Cifar10DataSetIterator(10, train=True, data_dir=str(tmp_path))
+
+    def test_missing_raises(self, tmp_path):
+        with pytest.raises(FileNotFoundError, match="egress"):
+            Cifar10DataSetIterator(10, train=False,
+                                   data_dir=str(tmp_path))

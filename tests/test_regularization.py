@@ -32,8 +32,7 @@ class TestDropoutFamily:
         x = jnp.ones((2, 8, 8, 64))
         out = np.asarray(SpatialDropout(rate=0.5).apply(x, jax.random.key(1)))
         # each (batch, channel) slice is all-zero or all-scaled
-        per_chan = out.reshape(2, 64, -1) if False else \
-            out.transpose(0, 3, 1, 2).reshape(2, 64, -1)
+        per_chan = out.transpose(0, 3, 1, 2).reshape(2, 64, -1)
         for b in range(2):
             for c in range(64):
                 sl = per_chan[b, c]

@@ -5,7 +5,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from deeplearning4j_tpu.learning import Adam, Sgd
+from deeplearning4j_tpu.learning import Adam, AdamW, Sgd
 from deeplearning4j_tpu.nn.conf import DenseLayer, InputType, OutputLayer
 from deeplearning4j_tpu.nn.graph import (
     ComputationGraph, ComputationGraphConfiguration,
@@ -58,7 +58,7 @@ class TestSimpleVertices:
 class TestFrozenVertexTraining:
     def test_frozen_vertex_params_fixed_in_graph(self):
         b = (ComputationGraphConfiguration.graphBuilder().seed(1)
-             .updater(Sgd(learning_rate=0.2))
+             .updater(AdamW(learning_rate=0.05, weight_decay=0.01))
              .addInputs("in"))
         b.setInputTypes(InputType.feedForward(4))
         b.addVertex("frozen",
