@@ -14,10 +14,17 @@ from deeplearning4j_tpu.datasets.fetchers import (
     Cifar10DataSetIterator, EmnistDataSetIterator, IrisDataSetIterator,
     MnistDataSetIterator,
 )
+from deeplearning4j_tpu.datasets.multi_dataset import (
+    ArrayMultiDataSetIterator, ListMultiDataSetIterator, MultiDataSet,
+    MultiDataSetIterator, MultiDataSetIteratorAdapter,
+)
 
 __all__ = ["DataSet", "DataSetIterator", "ListDataSetIterator",
            "ArrayDataSetIterator", "AsyncDataSetIterator",
            "RecordReaderDataSetIterator",
            "SequenceRecordReaderDataSetIterator",
            "IrisDataSetIterator", "MnistDataSetIterator",
-           "EmnistDataSetIterator", "Cifar10DataSetIterator"]
+           "EmnistDataSetIterator", "Cifar10DataSetIterator",
+           "MultiDataSet", "MultiDataSetIterator",
+           "ListMultiDataSetIterator", "ArrayMultiDataSetIterator",
+           "MultiDataSetIteratorAdapter"]

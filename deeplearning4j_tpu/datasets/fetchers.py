@@ -98,7 +98,8 @@ class MnistDataSetIterator(ArrayDataSetIterator):
                  num_examples: Optional[int] = None, seed: int = 12345,
                  shuffle: Optional[bool] = None, binarize: bool = False,
                  as_images: bool = False, data_dir: Optional[str] = None,
-                 subdir: str = "mnist", label_offset: int = 0):
+                 subdir: str = "mnist", label_offset: int = 0,
+                 num_classes: int = 10):
         d = data_dir or _data_dir(subdir)
         img = _read_idx(_find_idx(
             d, self.IMG_STEMS_TRAIN if train else self.IMG_STEMS_TEST))
@@ -108,8 +109,14 @@ class MnistDataSetIterator(ArrayDataSetIterator):
         if binarize:
             x = (x > 0.5).astype(np.float32)
         lbl = lbl.astype(np.int64) - label_offset
-        n_classes = int(lbl.max()) + 1
-        y = np.eye(max(n_classes, 10), dtype=np.float32)[lbl]
+        # fixed width per dataset type (reference: numOutcomes) — NOT
+        # inferred from the data, so splits missing the top class still
+        # agree on label shape
+        if lbl.min() < 0 or lbl.max() >= num_classes:
+            raise ValueError(
+                f"labels outside [0, {num_classes}) after offset "
+                f"{label_offset}: [{lbl.min()}, {lbl.max()}]")
+        y = np.eye(num_classes, dtype=np.float32)[lbl]
         if shuffle is None:
             shuffle = train
         if shuffle:
@@ -138,6 +145,11 @@ class EmnistDataSetIterator(MnistDataSetIterator):
         # 26-wide one-hot like the reference's LETTERS numOutcomes=26
         if dataset_type == "letters":
             kw.setdefault("label_offset", 1)
+        # fixed class counts per split (reference: EmnistDataSetIterator
+        # .Set numOutcomes)
+        outcomes = {"letters": 26, "balanced": 47, "bymerge": 47,
+                    "byclass": 62, "digits": 10, "mnist": 10}
+        kw.setdefault("num_classes", outcomes.get(dataset_type, 10))
         super().__init__(batch, train=train, **kw)
 
 
@@ -171,11 +183,6 @@ class Cifar10DataSetIterator(ArrayDataSetIterator):
             raw = np.fromfile(p, np.uint8).reshape(-1, 3073)
             ys.append(raw[:, 0])
             xs.append(raw[:, 1:])
-        if not xs:
-            raise FileNotFoundError(
-                f"no CIFAR-10 batches found under {d!r}. No network "
-                "egress — place data_batch_*.bin there (or set "
-                "$DL4J_TPU_DATA_DIR).")
         x = np.concatenate(xs).reshape(-1, 3, 32, 32) \
             .transpose(0, 2, 3, 1).astype(np.float32) / 255.0
         y = np.eye(10, dtype=np.float32)[np.concatenate(ys)]

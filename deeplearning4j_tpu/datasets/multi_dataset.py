@@ -1,0 +1,151 @@
+"""MultiDataSet — multi-input/multi-output training data.
+
+Reference: org/nd4j/linalg/dataset/MultiDataSet.java and
+api/MultiDataSetIterator (SURVEY.md §2.27) — the data carrier for
+ComputationGraph.fit with multiple inputs/outputs (e.g. seq2seq
+encoder+decoder feeds, siamese pairs).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+
+
+def _as_list(v) -> List:
+    if v is None:
+        return []
+    return list(v) if isinstance(v, (list, tuple)) else [v]
+
+
+class MultiDataSet:
+    """N features arrays + M labels arrays (+ optional masks)."""
+
+    def __init__(self, features=None, labels=None,
+                 features_mask_arrays=None, labels_mask_arrays=None):
+        self.features = _as_list(features)
+        self.labels = _as_list(labels)
+        self.features_mask_arrays = _as_list(features_mask_arrays)
+        self.labels_mask_arrays = _as_list(labels_mask_arrays)
+
+    # reference getters
+    def getFeatures(self, idx: Optional[int] = None):
+        return self.features if idx is None else self.features[idx]
+
+    def getLabels(self, idx: Optional[int] = None):
+        return self.labels if idx is None else self.labels[idx]
+
+    def numFeatureArrays(self) -> int:
+        return len(self.features)
+
+    def numLabelsArrays(self) -> int:
+        return len(self.labels)
+
+    def numExamples(self) -> int:
+        return 0 if not self.features else int(
+            np.asarray(self.features[0]).shape[0])
+
+    @staticmethod
+    def fromDataSet(ds: DataSet) -> "MultiDataSet":
+        return MultiDataSet([ds.features], [ds.labels])
+
+    def splitBatches(self, batch_size: int) -> List["MultiDataSet"]:
+        n = self.numExamples()
+        out = []
+        for s in range(0, n, batch_size):
+            out.append(MultiDataSet(
+                [np.asarray(f)[s:s + batch_size] for f in self.features],
+                [np.asarray(l)[s:s + batch_size] for l in self.labels]))
+        return out
+
+
+class MultiDataSetIterator:
+    """reference: api/MultiDataSetIterator."""
+
+    def reset(self):
+        raise NotImplementedError
+
+    def hasNext(self) -> bool:
+        raise NotImplementedError
+
+    def next(self) -> MultiDataSet:
+        raise NotImplementedError
+
+    def resetSupported(self) -> bool:
+        return True
+
+    def asyncSupported(self) -> bool:
+        return False
+
+    def __iter__(self) -> Iterator[MultiDataSet]:
+        if self.resetSupported():
+            self.reset()
+        while self.hasNext():
+            yield self.next()
+
+
+class ListMultiDataSetIterator(MultiDataSetIterator):
+    def __init__(self, datasets: Sequence[MultiDataSet]):
+        self._data = list(datasets)
+        self._i = 0
+
+    def reset(self):
+        self._i = 0
+
+    def hasNext(self) -> bool:
+        return self._i < len(self._data)
+
+    def next(self) -> MultiDataSet:
+        ds = self._data[self._i]
+        self._i += 1
+        return ds
+
+
+class ArrayMultiDataSetIterator(MultiDataSetIterator):
+    """Batched iterator over in-memory feature/label array lists."""
+
+    def __init__(self, features: Sequence, labels: Sequence,
+                 batch_size: int):
+        self._f = [np.asarray(f) for f in _as_list(features)]
+        self._l = [np.asarray(l) for l in _as_list(labels)]
+        self._bs = int(batch_size)
+        self._i = 0
+        self._n = self._f[0].shape[0] if self._f else 0
+
+    def reset(self):
+        self._i = 0
+
+    def hasNext(self) -> bool:
+        return self._i < self._n
+
+    def next(self) -> MultiDataSet:
+        s = self._i
+        self._i += self._bs
+        return MultiDataSet([f[s:s + self._bs] for f in self._f],
+                            [l[s:s + self._bs] for l in self._l])
+
+    def batch(self) -> int:
+        return self._bs
+
+
+class MultiDataSetIteratorAdapter(MultiDataSetIterator):
+    """Wrap a single-input DataSetIterator (reference:
+    impl/MultiDataSetIteratorAdapter)."""
+
+    def __init__(self, iterator):
+        self._it = iterator
+
+    def reset(self):
+        self._it.reset()
+
+    def hasNext(self) -> bool:
+        return self._it.hasNext()
+
+    def next(self) -> MultiDataSet:
+        return MultiDataSet.fromDataSet(self._it.next())
+
+    def resetSupported(self) -> bool:
+        return self._it.resetSupported()

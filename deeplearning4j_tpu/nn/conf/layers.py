@@ -433,7 +433,8 @@ class SeparableConvolution2D(ConvolutionLayer):
         x = self._maybe_dropout(x, train, rng)
         out = nnops.separable_conv2d(x, params["dW"], params["pW"],
                                      params.get("b"), strides=self.stride,
-                                     padding=self._pad_arg() if self.convolution_mode == "Same" else self.padding)
+                                     padding=self._pad_arg() if self.convolution_mode == "Same" else self.padding,
+                                     dilation=self.dilation)
         return _act(self.activation or "identity").fn(out), state
 
 

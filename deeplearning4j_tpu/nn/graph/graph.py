@@ -215,6 +215,29 @@ class ComputationGraph:
     # ------------------------------------------------------------------
     def fit(self, data, labels=None, epochs: int = 1):
         self._check_init()
+        from deeplearning4j_tpu.datasets.multi_dataset import (
+            MultiDataSet, MultiDataSetIterator,
+        )
+
+        def _check_mds(mds):
+            if mds.features_mask_arrays or mds.labels_mask_arrays:
+                raise NotImplementedError(
+                    "MultiDataSet mask arrays are not yet applied by "
+                    "ComputationGraph.fit — dropping them silently would "
+                    "train over padding")
+
+        if isinstance(data, MultiDataSetIterator):
+            for _ in range(epochs):
+                for mds in data:
+                    _check_mds(mds)
+                    self._fit_batch(mds.features, mds.labels)
+                self._epoch += 1
+            return self
+        if isinstance(data, MultiDataSet):
+            _check_mds(data)
+            for _ in range(epochs):
+                self._fit_batch(data.features, data.labels)
+            return self
         if isinstance(data, DataSetIterator):
             for _ in range(epochs):
                 for ds in data:
@@ -238,6 +261,16 @@ class ComputationGraph:
 
     def _fit_batch(self, xs: Sequence, ys: Sequence):
         conf = self.conf
+        if len(xs) != len(conf.network_inputs):
+            raise ValueError(
+                f"got {len(xs)} feature arrays for "
+                f"{len(conf.network_inputs)} graph inputs "
+                f"{conf.network_inputs}")
+        if len(ys) != len(conf.network_outputs):
+            raise ValueError(
+                f"got {len(ys)} label arrays for "
+                f"{len(conf.network_outputs)} graph outputs "
+                f"{conf.network_outputs}")
         inputs = {n: jnp.asarray(_unwrap(x), self._dtype)
                   for n, x in zip(conf.network_inputs, xs)}
         labels = {n: jnp.asarray(_unwrap(y))

@@ -124,8 +124,9 @@ def depthwise_conv2d(x, w, b=None, strides=(1, 1), padding="SAME", dilation=(1, 
 
 
 @register_op("separable_conv2d")
-def separable_conv2d(x, depth_w, point_w, b=None, strides=(1, 1), padding="SAME"):
-    out = depthwise_conv2d(x, depth_w, None, strides, padding)
+def separable_conv2d(x, depth_w, point_w, b=None, strides=(1, 1),
+                     padding="SAME", dilation=(1, 1)):
+    out = depthwise_conv2d(x, depth_w, None, strides, padding, dilation)
     out = conv2d(out, point_w, b, (1, 1), "SAME")
     return out
 

@@ -290,3 +290,104 @@ class TestReviewRegressions:
         gd, _, _, _ = _freeze(f, *specs)
         with pytest.raises(TFImportError, match="padding"):
             TFGraphMapper.importGraph(gd)
+
+
+class TestBertMiniEndToEnd:
+    """The SURVEY §3.4 headline path: a COMPLETE (mini) BERT encoder —
+    token+position embeddings, N transformer blocks (MHA + LayerNorm +
+    GELU FFN + residuals), MLM logits head — frozen in TF, imported
+    node-by-node into SameDiff, golden-compared against TF, then
+    FINE-TUNED as one jit-compiled step (reference:
+    samediff-import-tensorflow + SameDiff.fit)."""
+
+    def _build_bert(self, rng, vocab=50, max_len=16, d=16, heads=2,
+                    layers=2, ff=32):
+        W = lambda *s, scale=0.3: tf.Variable(
+            rng.normal(size=s).astype(np.float32) * scale)
+        p = {
+            "tok": W(vocab, d), "pos": W(max_len, d),
+        }
+        for i in range(layers):
+            p[f"l{i}"] = {
+                "wq": W(d, d), "wk": W(d, d), "wv": W(d, d), "wo": W(d, d),
+                "g1": tf.Variable(np.ones(d, np.float32)),
+                "b1": tf.Variable(np.zeros(d, np.float32)),
+                "w_ff1": W(d, ff), "b_ff1": tf.Variable(np.zeros(ff, np.float32)),
+                "w_ff2": W(ff, d), "b_ff2": tf.Variable(np.zeros(d, np.float32)),
+                "g2": tf.Variable(np.ones(d, np.float32)),
+                "b2": tf.Variable(np.zeros(d, np.float32)),
+            }
+        dh = d // heads
+
+        def ln(x, g, b):
+            mu = tf.reduce_mean(x, axis=-1, keepdims=True)
+            var = tf.reduce_mean(tf.math.squared_difference(x, mu),
+                                 axis=-1, keepdims=True)
+            return (x - mu) * tf.math.rsqrt(var + 1e-6) * g + b
+
+        def model(ids):
+            h = (tf.gather(p["tok"], ids)
+                 + tf.gather(p["pos"], tf.range(max_len)))
+            for i in range(layers):
+                lp = p[f"l{i}"]
+                q = tf.reshape(h @ lp["wq"], [-1, max_len, heads, dh])
+                k = tf.reshape(h @ lp["wk"], [-1, max_len, heads, dh])
+                v = tf.reshape(h @ lp["wv"], [-1, max_len, heads, dh])
+                q = tf.transpose(q, [0, 2, 1, 3])
+                k = tf.transpose(k, [0, 2, 1, 3])
+                v = tf.transpose(v, [0, 2, 1, 3])
+                att = tf.nn.softmax(
+                    tf.matmul(q, k, transpose_b=True) / np.sqrt(dh))
+                o = tf.transpose(tf.matmul(att, v), [0, 2, 1, 3])
+                o = tf.reshape(o, [-1, max_len, d]) @ lp["wo"]
+                h = ln(h + o, lp["g1"], lp["b1"])
+                ffn = tf.nn.gelu(h @ lp["w_ff1"] + lp["b_ff1"]) \
+                    @ lp["w_ff2"] + lp["b_ff2"]
+                h = ln(h + ffn, lp["g2"], lp["b2"])
+            # MLM logits: tied embedding projection
+            return tf.matmul(h, p["tok"], transpose_b=True)
+
+        return model
+
+    def test_golden_and_finetune(self):
+        rng = np.random.default_rng(0)
+        vocab, max_len = 50, 16
+        model = self._build_bert(rng, vocab=vocab, max_len=max_len)
+        ids = rng.integers(0, vocab, (4, max_len)).astype(np.int32)
+
+        gd, in_names, out_names, frozen = _freeze(
+            model, tf.TensorSpec([None, max_len], tf.int32))
+        ref = frozen(tf.constant(ids))
+        ref = np.asarray(ref[0] if isinstance(ref, (list, tuple)) else ref)
+        sd = TFGraphMapper.importGraph(gd)
+        got = np.asarray(sd.output({in_names[0]: ids},
+                                   out_names)[out_names[0]])
+        np.testing.assert_allclose(got, ref, rtol=2e-3, atol=2e-4)
+
+        # ---- fine-tune the imported graph (reference: BERT path) ----
+        for v in sd.variables():
+            if v.vtype.value == "CONSTANT" and \
+                    sd._arrays[v.name].ndim == 2 and \
+                    sd._arrays[v.name].dtype.kind == "f":
+                sd.convertConstantsToVariables(v.name)
+        assert sd.trainable_names(), "no trainables promoted"
+
+        out = sd.getVariable(out_names[0])
+        y = sd.placeholder("y_ids", shape=(None, max_len))
+        # per-token CE against target ids via one-hot (mean)
+        import jax.numpy as jnp
+        oh = sd.math.one_hot(y, depth=vocab)  # depth is a static attr
+        logp = sd.nn.log_softmax(out)
+        loss = -(oh * logp).sum(-1).mean()
+        sd.setLossVariables(loss.name)
+
+        from deeplearning4j_tpu.autodiff import TrainingConfig
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        from deeplearning4j_tpu.learning.updaters import Adam
+        sd.setTrainingConfig(TrainingConfig(
+            updater=Adam(1e-2), data_set_feature_mapping=[in_names[0]],
+            data_set_label_mapping=["y_ids"]))
+        targets = rng.integers(0, vocab, (4, max_len)).astype(np.int32)
+        hist = sd.fit(DataSet(ids, targets), epochs=25)
+        assert hist.loss_curve[-1] < hist.loss_curve[0] * 0.7, \
+            hist.loss_curve[:3] + hist.loss_curve[-3:]
