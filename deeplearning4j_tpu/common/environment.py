@@ -1,0 +1,105 @@
+"""Global environment/flag singleton.
+
+Reference: libnd4j ``sd::Environment`` (verbose/debug flags mirrored
+across JNI), ``org/nd4j/config/ND4JSystemProperties`` /
+``ND4JEnvironmentVars`` (env-var configuration), and
+``Nd4jEnvironment.getEnvironmentInformation()`` (runtime/hardware
+report used by PerformanceListener) — SURVEY.md §5 config/flag system.
+
+Env vars honored at import (the DL4J_TPU_* namespace replaces ND4J_*):
+- ``DL4J_TPU_VERBOSE=1``      — verbose op/trace logging
+- ``DL4J_TPU_DEBUG=1``        — debug mode (implies verbose)
+- ``DL4J_TPU_PANIC=nan|inf|any`` — global numerics panic mode default
+- ``DL4J_TPU_MAX_THREADS=N``  — host-side worker thread cap (ETL,
+  native codec); device parallelism is XLA's business
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+
+class Environment:
+    """Singleton (reference: sd::Environment::getInstance())."""
+
+    _instance: Optional["Environment"] = None
+
+    def __init__(self):
+        self._verbose = os.environ.get("DL4J_TPU_VERBOSE", "0") == "1"
+        self._debug = os.environ.get("DL4J_TPU_DEBUG", "0") == "1"
+        self._panic = os.environ.get("DL4J_TPU_PANIC", "").lower() or None
+        try:
+            self._max_threads = int(
+                os.environ.get("DL4J_TPU_MAX_THREADS", "0")) or None
+        except ValueError:
+            self._max_threads = None
+
+    @classmethod
+    def getInstance(cls) -> "Environment":
+        if cls._instance is None:
+            cls._instance = Environment()
+        return cls._instance
+
+    # -- flags (reference naming) --------------------------------------
+    def isVerbose(self) -> bool:
+        return self._verbose or self._debug
+
+    def setVerbose(self, v: bool) -> None:
+        self._verbose = bool(v)
+
+    def isDebug(self) -> bool:
+        return self._debug
+
+    def setDebug(self, v: bool) -> None:
+        self._debug = bool(v)
+
+    def panicMode(self) -> Optional[str]:
+        """'nan' | 'inf' | 'any' | None — default for profiler panic."""
+        return self._panic
+
+    def setPanicMode(self, mode: Optional[str]) -> None:
+        self._panic = mode
+
+    def maxThreads(self) -> int:
+        if self._max_threads:
+            return self._max_threads
+        return os.cpu_count() or 1
+
+    def setMaxThreads(self, n: int) -> None:
+        self._max_threads = int(n)
+
+
+class Nd4jEnvironment:
+    """Runtime/hardware report (reference:
+    org/nd4j/linalg/api/environment/Nd4jEnvironment — feeds
+    PerformanceListener's system-info lines)."""
+
+    @staticmethod
+    def getEnvironmentInformation() -> Dict[str, Any]:
+        import platform as _platform
+
+        import jax
+
+        devs = jax.devices()
+        info: Dict[str, Any] = {
+            "backend": devs[0].platform if devs else "none",
+            "blas.vendor": "XLA",   # matmuls lower to the MXU, not BLAS
+            "device.count": len(devs),
+            "device.kind": devs[0].device_kind if devs else "none",
+            "host.cpu.count": os.cpu_count(),
+            "host.name": _platform.node(),
+            "jax.version": jax.__version__,
+            "os": f"{_platform.system()} {_platform.release()}",
+            "python.version": _platform.python_version(),
+        }
+        try:
+            stats = devs[0].memory_stats()
+            if stats:
+                info["device.memory.bytes.limit"] = stats.get(
+                    "bytes_limit")
+                info["device.memory.bytes.in.use"] = stats.get(
+                    "bytes_in_use")
+        except Exception:
+            pass  # CPU backend has no memory_stats
+        return info

@@ -50,15 +50,23 @@ class MultiDataSet:
 
     @staticmethod
     def fromDataSet(ds: DataSet) -> "MultiDataSet":
-        return MultiDataSet([ds.features], [ds.labels])
+        return MultiDataSet(
+            [ds.features], [ds.labels],
+            [ds.features_mask] if ds.features_mask is not None else None,
+            [ds.labels_mask] if ds.labels_mask is not None else None)
 
     def splitBatches(self, batch_size: int) -> List["MultiDataSet"]:
         n = self.numExamples()
+
+        def cut(arrs, s):
+            return [np.asarray(a)[s:s + batch_size] for a in arrs] or None
+
         out = []
         for s in range(0, n, batch_size):
             out.append(MultiDataSet(
-                [np.asarray(f)[s:s + batch_size] for f in self.features],
-                [np.asarray(l)[s:s + batch_size] for l in self.labels]))
+                cut(self.features, s), cut(self.labels, s),
+                cut(self.features_mask_arrays, s),
+                cut(self.labels_mask_arrays, s)))
         return out
 
 

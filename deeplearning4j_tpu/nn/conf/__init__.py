@@ -17,7 +17,7 @@ from deeplearning4j_tpu.nn.conf.layers import (
 from deeplearning4j_tpu.nn.conf.layers_extra import (
     CapsuleLayer, CapsuleStrengthLayer, CenterLossOutputLayer, Convolution1D,
     Convolution3D, Cropping1D, Cropping2D, Cropping3D, Deconvolution2D,
-    DepthwiseConvolution2D, ElementWiseMultiplicationLayer, GRU,
+    DepthwiseConvolution2D, ElementWiseMultiplicationLayer, GravesBidirectionalLSTM, GRU,
     LocallyConnected1D, LocallyConnected2D, MaskLayer, MaskZeroLayer,
     PReLULayer, PrimaryCapsules, RepeatVector, SpaceToBatchLayer,
     SpaceToDepthLayer, Subsampling1DLayer, Subsampling3DLayer, Upsampling1D,
@@ -52,7 +52,8 @@ __all__ = [
     "CapsuleLayer", "CapsuleStrengthLayer", "CenterLossOutputLayer",
     "Convolution1D", "Convolution3D", "Cropping1D", "Cropping2D",
     "Cropping3D", "Deconvolution2D", "DepthwiseConvolution2D",
-    "ElementWiseMultiplicationLayer", "GRU", "LocallyConnected1D",
+    "ElementWiseMultiplicationLayer", "GravesBidirectionalLSTM", "GRU",
+    "LocallyConnected1D",
     "LocallyConnected2D", "MaskLayer", "MaskZeroLayer", "PReLULayer",
     "PrimaryCapsules", "RepeatVector", "SpaceToBatchLayer",
     "SpaceToDepthLayer", "Subsampling1DLayer", "Subsampling3DLayer",

@@ -27,7 +27,8 @@ from deeplearning4j_tpu.nn.conf.layers import (
 )
 from deeplearning4j_tpu.nn.conf.layers_extra import (
     CapsuleLayer, CapsuleStrengthLayer, Convolution1D, Convolution3D,
-    Cropping1D, Cropping2D, Cropping3D, GRU, LocallyConnected1D,
+    Cropping1D, Cropping2D, Cropping3D, GravesBidirectionalLSTM, GRU,
+    LocallyConnected1D,
     LocallyConnected2D, MaskZeroLayer, PrimaryCapsules, SpaceToBatchLayer,
     SpaceToDepthLayer, Subsampling1DLayer, Subsampling3DLayer, Upsampling1D,
     Upsampling3D, ZeroPadding1DLayer, ZeroPadding3DLayer,
@@ -42,7 +43,8 @@ _CNN2D_LAYERS = (ConvolutionLayer, SubsamplingLayer, Upsampling2D,
 _CNN3D_LAYERS = (Convolution3D, Subsampling3DLayer, Upsampling3D,
                  Cropping3D, ZeroPadding3DLayer)
 #: layers that consume sequence [N,T,F] input
-_RNN_LAYERS = (LSTM, SimpleRnn, GravesLSTM, GRU, SelfAttentionLayer,
+_RNN_LAYERS = (LSTM, SimpleRnn, GravesLSTM, GRU, GravesBidirectionalLSTM,
+               SelfAttentionLayer,
                LastTimeStep, Bidirectional, LearnedSelfAttentionLayer,
                RecurrentAttentionLayer, RnnOutputLayer, Convolution1D,
                Subsampling1DLayer, Upsampling1D, Cropping1D,

@@ -890,3 +890,41 @@ class CapsuleStrengthLayer(Layer):
 
     def apply(self, params, state, x, train, rng):
         return jnp.sqrt(jnp.sum(x * x, axis=-1) + 1e-12), state
+
+
+@serializable
+@dataclasses.dataclass
+class GravesBidirectionalLSTM(Layer):
+    """Two independent LSTMs over both directions, concatenated
+    (reference: conf/layers/GravesBidirectionalLSTM — predates the
+    generic Bidirectional wrapper; kept as a first-class config for
+    checkpoint/config parity; delegates to Bidirectional(LSTM))."""
+
+    n_in: int = 0
+    n_out: int = 0
+    forget_gate_bias_init: float = 1.0
+
+    is_recurrent = True
+
+    def _delegate(self):
+        from deeplearning4j_tpu.nn.conf.layers import Bidirectional, LSTM
+        return Bidirectional(layer=LSTM(
+            n_in=self.n_in, n_out=self.n_out,
+            forget_gate_bias_init=self.forget_gate_bias_init,
+            activation=self.activation, weight_init=self.weight_init,
+            dropout=self.dropout, l1=self.l1, l2=self.l2),
+            mode="CONCAT")
+
+    def output_type(self, it: InputType) -> InputType:
+        return InputType.recurrent(2 * self.n_out, it.timeseries_length)
+
+    def init_params(self, key, it, dtype) -> dict:
+        return self._delegate().init_params(key, it, dtype)
+
+    def apply(self, params, state, x, train, rng):
+        return self._delegate().apply(params, state, x, train, rng)
+
+    def init_carry(self, batch, dtype):
+        raise NotImplementedError(
+            "rnnTimeStep is not supported for GravesBidirectionalLSTM "
+            "(reference behavior: requires the full sequence)")

@@ -227,6 +227,10 @@ class ComputationGraph:
                     "train over padding")
 
         if isinstance(data, MultiDataSetIterator):
+            if epochs > 1 and not data.resetSupported():
+                raise ValueError(
+                    "epochs > 1 requires a resettable MultiDataSetIterator "
+                    "(reference behavior)")
             for _ in range(epochs):
                 for mds in data:
                     _check_mds(mds)
@@ -238,13 +242,23 @@ class ComputationGraph:
             for _ in range(epochs):
                 self._fit_batch(data.features, data.labels)
             return self
+        def _check_ds(ds):
+            if ds.features_mask is not None or ds.labels_mask is not None:
+                raise NotImplementedError(
+                    "DataSet mask arrays are not yet applied by "
+                    "ComputationGraph.fit — dropping them silently would "
+                    "train over padding (MultiLayerNetwork.fit supports "
+                    "masks)")
+
         if isinstance(data, DataSetIterator):
             for _ in range(epochs):
                 for ds in data:
+                    _check_ds(ds)
                     self._fit_batch([ds.features], [ds.labels])
                 self._epoch += 1
             return self
         if isinstance(data, DataSet):
+            _check_ds(data)
             for _ in range(epochs):
                 self._fit_batch([data.features], [data.labels])
             return self

@@ -363,3 +363,39 @@ class TestGradCheck:
                     / (2 * eps)
                 assert abs(fd - float(ga[idx])) < 5e-2 * max(1.0, abs(fd)), \
                     f"{type(lay).__name__} grad mismatch at {idx}"
+
+
+class TestGravesBidirectionalAndEnvironment:
+    def test_graves_bidirectional_lstm(self):
+        from deeplearning4j_tpu.nn.conf import GravesBidirectionalLSTM
+        conf = _build([
+            GravesBidirectionalLSTM(n_out=6),
+            RnnOutputLayer(n_out=2, activation="softmax", loss="mcxent"),
+        ], InputType.recurrent(3, 8))
+        assert conf.layers[0].n_in == 3
+        net = MultiLayerNetwork(conf).init()
+        x = np.random.default_rng(0).normal(size=(4, 8, 3)).astype(np.float32)
+        y = np.zeros((4, 8, 2), np.float32)
+        y[..., 0] = 1
+        net.fit(x, y)
+        out = np.asarray(net.output(x))
+        assert out.shape == (4, 8, 2)
+        # forward/backward params both present (CONCAT doubles width)
+        assert set(net.params_list[0]) == {"fw", "bw"}
+
+    def test_environment_singleton_and_info(self):
+        from deeplearning4j_tpu.common.environment import (
+            Environment, Nd4jEnvironment,
+        )
+        env = Environment.getInstance()
+        assert env is Environment.getInstance()
+        env.setVerbose(True)
+        assert env.isVerbose()
+        env.setVerbose(False)
+        env.setDebug(True)
+        assert env.isVerbose() and env.isDebug()  # debug implies verbose
+        env.setDebug(False)
+        assert env.maxThreads() >= 1
+        info = Nd4jEnvironment.getEnvironmentInformation()
+        assert info["backend"] == "cpu" and info["device.count"] == 8
+        assert "jax.version" in info

@@ -79,3 +79,44 @@ class TestMultiDataSet:
         assert len(batches) == 4
         assert batches[0].numFeatureArrays() == 1
         assert batches[0].getFeatures(0).shape == (8, 4)
+
+
+class TestMaskAndResetGuards:
+    def test_masks_preserved_by_adapter_and_split(self):
+        from deeplearning4j_tpu.datasets import DataSet
+        x = np.ones((8, 5, 3), np.float32)
+        y = np.ones((8, 5, 2), np.float32)
+        lm = np.ones((8, 5), np.float32)
+        mds = MultiDataSet.fromDataSet(DataSet(x, y, labels_mask=lm))
+        assert len(mds.labels_mask_arrays) == 1
+        parts = mds.splitBatches(3)
+        assert parts[0].labels_mask_arrays[0].shape == (3, 5)
+        # masked data must NOT silently train on the graph path
+        import pytest as _pytest
+        g = _two_input_graph()  # wrong input count is irrelevant: guard first
+        with _pytest.raises(NotImplementedError, match="mask"):
+            g.fit(mds)
+
+    def test_dataset_with_mask_raises_on_graph(self):
+        import pytest as _pytest
+        from deeplearning4j_tpu.datasets import DataSet
+        g = _two_input_graph()
+        ds = DataSet(np.ones((4, 3), np.float32),
+                     np.ones((4, 2), np.float32),
+                     labels_mask=np.ones((4,), np.float32))
+        with _pytest.raises(NotImplementedError, match="mask"):
+            g.fit(ds)
+
+    def test_nonresettable_multi_epoch_raises(self):
+        import pytest as _pytest
+
+        class OneShot(ListMultiDataSetIterator):
+            def resetSupported(self):
+                return False
+
+        xa, xb, y, _ = _data(16)
+        parts = MultiDataSet([xa, xb], [y]).splitBatches(8)
+        g = _two_input_graph()
+        with _pytest.raises(ValueError, match="resettable"):
+            g.fit(OneShot(parts), epochs=3)
+        g.fit(OneShot(parts), epochs=1)  # single epoch is fine
