@@ -6,12 +6,16 @@ across JNI), ``org/nd4j/config/ND4JSystemProperties`` /
 ``Nd4jEnvironment.getEnvironmentInformation()`` (runtime/hardware
 report used by PerformanceListener) — SURVEY.md §5 config/flag system.
 
-Env vars honored at import (the DL4J_TPU_* namespace replaces ND4J_*):
-- ``DL4J_TPU_VERBOSE=1``      — verbose op/trace logging
-- ``DL4J_TPU_DEBUG=1``        — debug mode (implies verbose)
-- ``DL4J_TPU_PANIC=nan|inf|any`` — global numerics panic mode default
-- ``DL4J_TPU_MAX_THREADS=N``  — host-side worker thread cap (ETL,
-  native codec); device parallelism is XLA's business
+Env vars (the DL4J_TPU_* namespace replaces ND4J_*):
+- ``DL4J_TPU_PANIC=nan|inf|any`` — default numerics panic mode; WIRED:
+  OpProfiler reads it at first use, so training steps panic-check
+  without any code change.
+- ``DL4J_TPU_VERBOSE=1`` / ``DL4J_TPU_DEBUG=1`` — flag accessors for
+  user code and listeners (``Environment.isVerbose()``); the framework
+  core does not condition on them yet.
+- ``DL4J_TPU_MAX_THREADS=N`` — exposed via ``Environment.maxThreads()``
+  for host-side worker pools user code spins up; the bundled native
+  codec sizes its own std::thread pool internally.
 """
 
 from __future__ import annotations

@@ -198,10 +198,32 @@ class LossFunction(enum.Enum):
 
 def compute_loss(loss_fn: LossFunction, labels, preoutput, activation, mask=None):
     """Activation-aware loss on pre-activations, with the reference's
-    fused special cases (softmax+MCXENT, sigmoid+XENT) for stability."""
+    fused special cases (softmax+MCXENT, sigmoid+XENT) for stability.
+
+    mask semantics (reference: ILossFunction mask arg):
+    - [N] or [N,1] per-example weights
+    - per-timestep weights matching labels.shape[:-1] (or with a
+      trailing 1) for [N, T, C] outputs — handled by folding time into
+      the example axis, so every loss's per-example path applies per
+      timestep.
+    Normalization matches the reference's score semantics: the divisor
+    is ALWAYS the minibatch size N (masked timesteps contribute 0), so
+    adding an all-ones mask does not change the loss scale.
+    """
     from deeplearning4j_tpu.activations import Activation
 
     act = Activation.resolve(activation)
+    n_examples = labels.shape[0]
+    if mask is not None:
+        if mask.ndim == labels.ndim and mask.shape[-1] == 1:
+            mask = mask[..., 0]  # drop trailing singleton: [N,T,1]->[N,T]
+        if mask.ndim >= 2 and mask.shape == labels.shape[:-1]:
+            # per-timestep mask: [N,T,...] -> one "example" per timestep
+            labels = labels.reshape(-1, labels.shape[-1])
+            preoutput = preoutput.reshape(-1, preoutput.shape[-1])
+            mask = mask.reshape(-1)
+        elif mask.ndim == 2 and mask.shape[1] == 1:
+            mask = mask[:, 0]  # [N,1] per-example weights
     if loss_fn in (LossFunction.MCXENT, LossFunction.NEGATIVELOGLIKELIHOOD) \
             and act is Activation.SOFTMAX:
         per_ex = softmax_xent_logits(labels, preoutput)
@@ -213,5 +235,8 @@ def compute_loss(loss_fn: LossFunction, labels, preoutput, activation, mask=None
         per_ex = loss_fn.fn(labels, act.fn(preoutput))
     if mask is not None:
         per_ex = per_ex * mask.reshape(per_ex.shape)
-        return jnp.sum(per_ex) / jnp.maximum(jnp.sum(mask), 1.0)
+        # divide by minibatch size, NOT sum(mask) — keeps the loss scale
+        # identical with and without an all-ones mask (reference:
+        # ILossFunction#computeScore / scoreSum / minibatch)
+        return jnp.sum(per_ex) / n_examples
     return jnp.mean(per_ex)

@@ -104,8 +104,10 @@ class ComputationGraph:
             new_states[node.name] = ns
         return acts, new_states
 
-    def _loss(self, params_map, states_map, inputs, labels_map, rng):
+    def _loss(self, params_map, states_map, inputs, labels_map, rng,
+              masks_map=None):
         conf = self.conf
+        masks_map = masks_map or {}
         acts: Dict[str, Any] = dict(inputs)
         new_states: Dict[str, dict] = {}
         keys = (jax.random.split(rng, len(conf.nodes))
@@ -125,7 +127,7 @@ class ComputationGraph:
                     and isinstance(v.layer, (OutputLayer, LossLayer)):
                 total = total + v.layer.loss_value(
                     p_i, states_map[node.name], xs[0],
-                    labels_map[node.name], None)
+                    labels_map[node.name], masks_map.get(node.name))
                 new_states[node.name] = states_map[node.name]
                 acts[node.name] = xs[0]
             else:
@@ -181,14 +183,15 @@ class ComputationGraph:
             return out
         raise ValueError(f"Unknown gradient normalization: {mode}")
 
-    def _get_train_step(self):
-        if "step" in self._step_cache:
-            return self._step_cache["step"]
+    def _get_train_step(self, mask_key=frozenset()):
+        cache_key = ("step", mask_key)
+        if cache_key in self._step_cache:
+            return self._step_cache[cache_key]
 
         def step_fn(params_map, states_map, opt_states, it_step, ep_step,
-                    inputs, labels_map, rng):
+                    inputs, labels_map, masks_map, rng):
             loss_fn = lambda pm: self._loss(pm, states_map, inputs,
-                                            labels_map, rng)
+                                            labels_map, rng, masks_map)
             (loss, (new_states, data_loss)), grads = \
                 jax.value_and_grad(loss_fn, has_aux=True)(params_map)
             grads = self._clip(grads)
@@ -209,7 +212,7 @@ class ComputationGraph:
             return new_params, new_states, new_opt, data_loss
 
         jitted = jax.jit(step_fn, donate_argnums=(0, 1, 2))
-        self._step_cache["step"] = jitted
+        self._step_cache[cache_key] = jitted
         return jitted
 
     # ------------------------------------------------------------------
@@ -220,9 +223,11 @@ class ComputationGraph:
         )
 
         def _check_mds(mds):
-            if mds.features_mask_arrays or mds.labels_mask_arrays:
+            # label masks ARE applied (per-output, at the loss); input
+            # masks would need forward masking — still unimplemented
+            if mds.features_mask_arrays:
                 raise NotImplementedError(
-                    "MultiDataSet mask arrays are not yet applied by "
+                    "MultiDataSet features masks are not yet applied by "
                     "ComputationGraph.fit — dropping them silently would "
                     "train over padding")
 
@@ -234,33 +239,37 @@ class ComputationGraph:
             for _ in range(epochs):
                 for mds in data:
                     _check_mds(mds)
-                    self._fit_batch(mds.features, mds.labels)
+                    self._fit_batch(mds.features, mds.labels,
+                                    mds.labels_mask_arrays or None)
                 self._epoch += 1
             return self
         if isinstance(data, MultiDataSet):
             _check_mds(data)
             for _ in range(epochs):
-                self._fit_batch(data.features, data.labels)
+                self._fit_batch(data.features, data.labels,
+                                data.labels_mask_arrays or None)
             return self
         def _check_ds(ds):
-            if ds.features_mask is not None or ds.labels_mask is not None:
+            if ds.features_mask is not None:
                 raise NotImplementedError(
-                    "DataSet mask arrays are not yet applied by "
+                    "DataSet features masks are not yet applied by "
                     "ComputationGraph.fit — dropping them silently would "
                     "train over padding (MultiLayerNetwork.fit supports "
-                    "masks)")
+                    "them)")
 
         if isinstance(data, DataSetIterator):
             for _ in range(epochs):
                 for ds in data:
                     _check_ds(ds)
-                    self._fit_batch([ds.features], [ds.labels])
+                    self._fit_batch([ds.features], [ds.labels],
+                                    [ds.labels_mask])
                 self._epoch += 1
             return self
         if isinstance(data, DataSet):
             _check_ds(data)
             for _ in range(epochs):
-                self._fit_batch([data.features], [data.labels])
+                self._fit_batch([data.features], [data.labels],
+                                [data.labels_mask])
             return self
         if labels is None:
             raise ValueError("fit(inputs, labels) requires labels")
@@ -273,7 +282,7 @@ class ComputationGraph:
                             [_unwrap(l) for l in labels])
         return self
 
-    def _fit_batch(self, xs: Sequence, ys: Sequence):
+    def _fit_batch(self, xs: Sequence, ys: Sequence, label_masks=None):
         conf = self.conf
         if len(xs) != len(conf.network_inputs):
             raise ValueError(
@@ -289,12 +298,23 @@ class ComputationGraph:
                   for n, x in zip(conf.network_inputs, xs)}
         labels = {n: jnp.asarray(_unwrap(y))
                   for n, y in zip(conf.network_outputs, ys)}
+        masks = {}
+        if label_masks:
+            if len(label_masks) != len(conf.network_outputs):
+                raise ValueError(
+                    f"got {len(label_masks)} label masks for "
+                    f"{len(conf.network_outputs)} graph outputs "
+                    f"{conf.network_outputs} (use None placeholders for "
+                    "unmasked outputs)")
+            for n, m in zip(conf.network_outputs, label_masks):
+                if m is not None:
+                    masks[n] = jnp.asarray(_unwrap(m))
         self._rng_key, sub = jax.random.split(self._rng_key)
-        step = self._get_train_step()
+        step = self._get_train_step(frozenset(masks))
         (self.params_map, self.states_map, self.opt_states, loss) = step(
             self.params_map, self.states_map, self.opt_states,
             jnp.asarray(self._iteration), jnp.asarray(self._epoch),
-            inputs, labels, sub)
+            inputs, labels, masks, sub)
         self._score = loss  # on-device; score() converts lazily (no
         # per-step host sync — critical for dispatch pipelining)
         self._iteration += 1

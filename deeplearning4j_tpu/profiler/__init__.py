@@ -55,6 +55,17 @@ class OpProfiler:
 
     def __init__(self):
         self.config = ProfilerConfig()
+        # honor the DL4J_TPU_PANIC env default (reference: ND4J panic
+        # modes via system properties — see common/environment.py)
+        from deeplearning4j_tpu.common.environment import Environment
+
+        panic = Environment.getInstance().panicMode()
+        if panic:
+            mode = {"nan": ProfilerMode.NAN_PANIC,
+                    "inf": ProfilerMode.INF_PANIC,
+                    "any": ProfilerMode.ANY_PANIC}.get(panic)
+            if mode is not None:
+                self.config = ProfilerConfig(mode=mode)
         self.invocations: Dict[str, int] = collections.Counter()
         self.total_time: Dict[str, float] = collections.defaultdict(float)
         self._orig_get_op = None

@@ -91,21 +91,48 @@ class TestMaskAndResetGuards:
         assert len(mds.labels_mask_arrays) == 1
         parts = mds.splitBatches(3)
         assert parts[0].labels_mask_arrays[0].shape == (3, 5)
-        # masked data must NOT silently train on the graph path
+
+    def test_features_mask_raises_on_graph(self):
         import pytest as _pytest
-        g = _two_input_graph()  # wrong input count is irrelevant: guard first
-        with _pytest.raises(NotImplementedError, match="mask"):
+        g = _two_input_graph()
+        mds = MultiDataSet([np.ones((4, 3), np.float32)] * 2,
+                           [np.ones((4, 2), np.float32)],
+                           features_mask_arrays=[np.ones((4,), np.float32)])
+        with _pytest.raises(NotImplementedError, match="features mask"):
             g.fit(mds)
 
-    def test_dataset_with_mask_raises_on_graph(self):
-        import pytest as _pytest
-        from deeplearning4j_tpu.datasets import DataSet
-        g = _two_input_graph()
-        ds = DataSet(np.ones((4, 3), np.float32),
-                     np.ones((4, 2), np.float32),
-                     labels_mask=np.ones((4,), np.float32))
-        with _pytest.raises(NotImplementedError, match="mask"):
-            g.fit(ds)
+    def test_label_mask_applied_in_graph_loss(self):
+        """Label masks flow to the output layer's loss: masking out the
+        second half of a sequence must change the loss."""
+        from deeplearning4j_tpu.nn.conf import GlobalPoolingLayer, LSTM, \
+            RnnOutputLayer
+        b = (ComputationGraphConfiguration.graphBuilder().seed(2)
+             .updater(Adam(learning_rate=1e-3)).addInputs("seq"))
+        b.setInputTypes(InputType.recurrent(3, 6))
+        b.addLayer("rnn", LSTM(n_in=3, n_out=5), "seq")
+        b.addLayer("out", RnnOutputLayer(n_in=5, n_out=2,
+                                         activation="softmax",
+                                         loss="mcxent"), "rnn")
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(4, 6, 3)).astype(np.float32)
+        y = np.zeros((4, 6, 2), np.float32)
+        y[..., 0] = 1
+        # corrupt the second half's labels; mask them out
+        y_bad = y.copy()
+        y_bad[:, 3:, 0] = 0
+        y_bad[:, 3:, 1] = 1
+        mask = np.ones((4, 6), np.float32)
+        mask[:, 3:] = 0
+
+        g1 = ComputationGraph(b.setOutputs("out").build()).init()
+        mds = MultiDataSet([x], [y_bad], labels_mask_arrays=[mask])
+        g1.fit(mds)
+        masked_loss = g1.score()
+        # same graph, same data, NO mask -> corrupted labels contribute
+        g2 = ComputationGraph(g1.conf).init()
+        g2.fit(MultiDataSet([x], [y_bad]))
+        unmasked_loss = g2.score()
+        assert abs(masked_loss - unmasked_loss) > 1e-3
 
     def test_nonresettable_multi_epoch_raises(self):
         import pytest as _pytest
@@ -120,3 +147,75 @@ class TestMaskAndResetGuards:
         with _pytest.raises(ValueError, match="resettable"):
             g.fit(OneShot(parts), epochs=3)
         g.fit(OneShot(parts), epochs=1)  # single epoch is fine
+
+
+class TestMaskSemantics:
+    """compute_loss mask shapes + normalization (reference:
+    ILossFunction mask/minibatch score semantics)."""
+
+    def _ce(self, labels, logits, mask):
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.loss import LossFunction, compute_loss
+        return float(compute_loss(LossFunction.MCXENT,
+                                  jnp.asarray(labels), jnp.asarray(logits),
+                                  "softmax", None if mask is None
+                                  else jnp.asarray(mask)))
+
+    def test_all_ones_mask_is_identity(self):
+        rng = np.random.default_rng(0)
+        labels = np.eye(2, dtype=np.float32)[rng.integers(0, 2, (4, 6))]
+        logits = rng.normal(size=(4, 6, 2)).astype(np.float32)
+        unmasked = self._ce(labels, logits, None)
+        masked = self._ce(labels, logits, np.ones((4, 6), np.float32))
+        assert abs(unmasked - masked) < 1e-5
+
+    def test_mask_shapes_accepted(self):
+        rng = np.random.default_rng(1)
+        labels = np.eye(2, dtype=np.float32)[rng.integers(0, 2, (4, 6))]
+        logits = rng.normal(size=(4, 6, 2)).astype(np.float32)
+        base = self._ce(labels, logits, np.ones((4, 6), np.float32))
+        # [N,T,1] same as [N,T]
+        assert abs(self._ce(labels, logits,
+                            np.ones((4, 6, 1), np.float32)) - base) < 1e-5
+        # [N,1] per-example weights: all-ones == unmasked
+        assert abs(self._ce(labels, logits,
+                            np.ones((4, 1), np.float32)) - base) < 1e-5
+        # [N] per-example on 2D labels
+        l2d = np.eye(3, dtype=np.float32)[[0, 1, 2, 0]]
+        z2d = rng.normal(size=(4, 3)).astype(np.float32)
+        assert abs(self._ce(l2d, z2d, np.ones(4, np.float32)) -
+                   self._ce(l2d, z2d, None)) < 1e-5
+
+    def test_masked_timesteps_contribute_zero(self):
+        rng = np.random.default_rng(2)
+        labels = np.eye(2, dtype=np.float32)[rng.integers(0, 2, (4, 6))]
+        logits = rng.normal(size=(4, 6, 2)).astype(np.float32)
+        m = np.ones((4, 6), np.float32)
+        m[:, 3:] = 0
+        masked = self._ce(labels, logits, m)
+        # equals CE computed on the first half only (same N divisor)
+        half = self._ce(labels[:, :3], logits[:, :3], None)
+        assert abs(masked - half) < 1e-5
+
+    def test_graph_mask_count_mismatch_raises(self):
+        import pytest as _pytest
+        g = _two_input_graph()
+        xa, xb, y, _ = _data(8)
+        mds = MultiDataSet([xa, xb], [y])
+        with _pytest.raises(ValueError, match="label masks"):
+            g._fit_batch([xa, xb], [y], [None, np.ones(8)])
+
+    def test_panic_env_wiring(self):
+        import subprocess, sys, os
+        code = (
+            "import os\n"
+            "os.environ['JAX_PLATFORMS']='cpu'\n"
+            "os.environ['PALLAS_AXON_POOL_IPS']=''\n"
+            "from deeplearning4j_tpu.profiler import OpProfiler, ProfilerMode\n"
+            "assert OpProfiler.getInstance().config.mode is ProfilerMode.NAN_PANIC\n"
+            "print('WIRED')\n")
+        env = dict(os.environ, DL4J_TPU_PANIC="nan", JAX_PLATFORMS="cpu",
+                   PALLAS_AXON_POOL_IPS="")
+        r = subprocess.run([sys.executable, "-c", code], env=env,
+                           capture_output=True, text=True, timeout=120)
+        assert "WIRED" in r.stdout, r.stderr[-500:]
