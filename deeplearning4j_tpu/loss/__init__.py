@@ -196,6 +196,15 @@ class LossFunction(enum.Enum):
         raise ValueError(f"Cannot resolve loss: {l!r}")
 
 
+#: losses whose per-example value is a MEAN over feature axes (all
+#: others SUM) — drives the masked divisor so all-ones mask == unmasked
+_MEAN_REDUCED_LOSSES = frozenset({
+    LossFunction.MSE, LossFunction.MAE,
+    LossFunction.MEAN_ABSOLUTE_PERCENTAGE_ERROR,
+    LossFunction.MEAN_SQUARED_LOGARITHMIC_ERROR,
+})
+
+
 def compute_loss(loss_fn: LossFunction, labels, preoutput, activation, mask=None):
     """Activation-aware loss on pre-activations, with the reference's
     fused special cases (softmax+MCXENT, sigmoid+XENT) for stability.
@@ -206,14 +215,17 @@ def compute_loss(loss_fn: LossFunction, labels, preoutput, activation, mask=None
       trailing 1) for [N, T, C] outputs — handled by folding time into
       the example axis, so every loss's per-example path applies per
       timestep.
-    Normalization matches the reference's score semantics: the divisor
-    is ALWAYS the minibatch size N (masked timesteps contribute 0), so
-    adding an all-ones mask does not change the loss scale.
+    Normalization invariant: an all-ones mask produces EXACTLY the
+    unmasked loss (masked entries contribute 0, the divisor stays what
+    the unmasked reduction would use — minibatch N for sum-reduced
+    losses, total element count for mean-reduced/sparse ones). This
+    mirrors the reference's score/minibatch semantics.
     """
     from deeplearning4j_tpu.activations import Activation
 
     act = Activation.resolve(activation)
     n_examples = labels.shape[0]
+    folded = False
     if mask is not None:
         if mask.ndim == labels.ndim and mask.shape[-1] == 1:
             mask = mask[..., 0]  # drop trailing singleton: [N,T,1]->[N,T]
@@ -222,6 +234,7 @@ def compute_loss(loss_fn: LossFunction, labels, preoutput, activation, mask=None
             labels = labels.reshape(-1, labels.shape[-1])
             preoutput = preoutput.reshape(-1, preoutput.shape[-1])
             mask = mask.reshape(-1)
+            folded = True
         elif mask.ndim == 2 and mask.shape[1] == 1:
             mask = mask[:, 0]  # [N,1] per-example weights
     if loss_fn in (LossFunction.MCXENT, LossFunction.NEGATIVELOGLIKELIHOOD) \
@@ -235,8 +248,14 @@ def compute_loss(loss_fn: LossFunction, labels, preoutput, activation, mask=None
         per_ex = loss_fn.fn(labels, act.fn(preoutput))
     if mask is not None:
         per_ex = per_ex * mask.reshape(per_ex.shape)
-        # divide by minibatch size, NOT sum(mask) — keeps the loss scale
-        # identical with and without an all-ones mask (reference:
-        # ILossFunction#computeScore / scoreSum / minibatch)
-        return jnp.sum(per_ex) / n_examples
+        # divisor reproduces the unmasked reduction (see docstring):
+        # - sum-reduced losses fold T into the example axis but the
+        #   unmasked path averaged over N only -> divide by N
+        # - mean-reduced losses (MSE/MAE/MAPE/MSLE) and elementwise
+        #   sparse CE averaged over every entry -> divide by per_ex.size
+        if folded and loss_fn not in _MEAN_REDUCED_LOSSES:
+            divisor = n_examples
+        else:
+            divisor = per_ex.size
+        return jnp.sum(per_ex) / divisor
     return jnp.mean(per_ex)

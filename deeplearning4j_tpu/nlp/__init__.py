@@ -26,10 +26,16 @@ from deeplearning4j_tpu.nlp.serializer import WordVectorSerializer
 from deeplearning4j_tpu.nlp.glove import Glove
 from deeplearning4j_tpu.nlp.fasttext import FastText
 from deeplearning4j_tpu.nlp.tsne import BarnesHutTsne
+from deeplearning4j_tpu.nlp.sentence_iterators import (
+    CnnSentenceDataSetIterator, CollectionLabeledSentenceProvider,
+    LabeledSentenceProvider,
+)
 
 __all__ = [
     "AbstractCache", "BarnesHutTsne", "BasicLineIterator",
+    "CnnSentenceDataSetIterator", "CollectionLabeledSentenceProvider",
     "CollectionSentenceIterator",
+    "LabeledSentenceProvider",
     "CommonPreprocessor", "DefaultTokenizer", "DefaultTokenizerFactory",
     "FastText", "Glove",
     "NGramTokenizerFactory", "ParagraphVectors", "SentenceIterator",

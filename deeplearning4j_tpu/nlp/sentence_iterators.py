@@ -1,0 +1,175 @@
+"""Text-classification data pipelines over word vectors.
+
+Reference: deeplearning4j-nlp —
+org/deeplearning4j/iterator/{CnnSentenceDataSetIterator,
+LabeledSentenceProvider,provider/CollectionLabeledSentenceProvider}.java
+(text → word-vector tensors for CNN/RNN sentence classifiers).
+
+TPU notes: tensors come out padded to ``max_sentence_length`` with a
+[N, T] mask, so every batch has one static shape — no retraces. The CNN
+format is [N, T, vectorSize] treated as a 1D-conv sequence (NTF, this
+framework's canonical layout; the reference's 4D NCHW variant collapses
+to the same math).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterator import DataSetIterator
+from deeplearning4j_tpu.nlp.tokenization import DefaultTokenizerFactory
+
+
+class LabeledSentenceProvider:
+    """reference: iterator/LabeledSentenceProvider interface."""
+
+    def hasNext(self) -> bool:
+        raise NotImplementedError
+
+    def nextSentence(self) -> Tuple[str, str]:
+        raise NotImplementedError
+
+    def reset(self) -> None:
+        raise NotImplementedError
+
+    def totalNumSentences(self) -> int:
+        raise NotImplementedError
+
+    def allLabels(self) -> List[str]:
+        raise NotImplementedError
+
+
+class CollectionLabeledSentenceProvider(LabeledSentenceProvider):
+    """reference: iterator/provider/CollectionLabeledSentenceProvider."""
+
+    def __init__(self, sentences: Sequence[str], labels: Sequence[str],
+                 rng_seed: Optional[int] = None):
+        if len(sentences) != len(labels):
+            raise ValueError(
+                f"{len(sentences)} sentences vs {len(labels)} labels")
+        self._sentences = list(sentences)
+        self._labels = list(labels)
+        self._order = np.arange(len(sentences))
+        if rng_seed is not None:
+            np.random.default_rng(rng_seed).shuffle(self._order)
+        self._i = 0
+        self._all_labels = sorted(set(labels))
+
+    def hasNext(self) -> bool:
+        return self._i < len(self._order)
+
+    def nextSentence(self) -> Tuple[str, str]:
+        idx = self._order[self._i]
+        self._i += 1
+        return self._sentences[idx], self._labels[idx]
+
+    def reset(self) -> None:
+        self._i = 0
+
+    def totalNumSentences(self) -> int:
+        return len(self._sentences)
+
+    def allLabels(self) -> List[str]:
+        return self._all_labels
+
+
+class CnnSentenceDataSetIterator(DataSetIterator):
+    """Sentences → [N, T, vectorSize] word-vector tensors + [N, T] mask
+    + one-hot labels (reference: iterator/CnnSentenceDataSetIterator;
+    its Builder knobs kept as constructor args).
+
+    ``word_vectors`` is anything with getWordVector/hasWord and a
+    vector size (Word2Vec, Glove, FastText from this package).
+    ``unknown_word_handling``: 'RemoveWord' (reference default) skips
+    OOV tokens; 'UseUnknownVector' substitutes the mean vector.
+    """
+
+    def __init__(self, sentence_provider: LabeledSentenceProvider,
+                 word_vectors, batch_size: int = 32,
+                 max_sentence_length: int = 64,
+                 unknown_word_handling: str = "RemoveWord",
+                 tokenizer_factory=None, min_length: int = 1):
+        if unknown_word_handling not in ("RemoveWord", "UseUnknownVector"):
+            raise ValueError(
+                f"unknown_word_handling={unknown_word_handling!r}; valid: "
+                "'RemoveWord' | 'UseUnknownVector' (reference enum "
+                "UnknownWordHandling)")
+        self._provider = sentence_provider
+        self._wv = word_vectors
+        self._bs = int(batch_size)
+        self._max_len = int(max_sentence_length)
+        self._unk = unknown_word_handling
+        self._tok = tokenizer_factory or DefaultTokenizerFactory()
+        self._min_length = min_length
+        self._labels = sentence_provider.allLabels()
+        self._lab_idx = {l: i for i, l in enumerate(self._labels)}
+        self._vec_size = int(np.asarray(
+            word_vectors.getWordVector(self._first_known_word())).shape[0])
+        self._unk_vec = None
+        if self._unk == "UseUnknownVector":
+            m = word_vectors.getWordVectorMatrix() if hasattr(
+                word_vectors, "getWordVectorMatrix") else None
+            self._unk_vec = (np.asarray(m).mean(0) if m is not None
+                             else np.zeros(self._vec_size, np.float32))
+
+    def _first_known_word(self) -> str:
+        vocab = getattr(self._wv, "vocab", None)
+        if vocab is not None and vocab.numWords():
+            return vocab.wordAtIndex(0)
+        raise ValueError("word_vectors has an empty vocabulary")
+
+    # -- DataSetIterator surface ---------------------------------------
+    def reset(self):
+        self._provider.reset()
+
+    def hasNext(self) -> bool:
+        return self._provider.hasNext()
+
+    def batch(self) -> int:
+        return self._bs
+
+    def getLabels(self) -> List[str]:
+        return self._labels
+
+    def numClasses(self) -> int:
+        return len(self._labels)
+
+    def _sentence_vectors(self, s: str) -> np.ndarray:
+        vecs = []
+        for t in self._tok.create(s).getTokens():
+            if self._wv.hasWord(t):
+                vecs.append(np.asarray(self._wv.getWordVector(t),
+                                       np.float32))
+            elif self._unk_vec is not None:
+                vecs.append(self._unk_vec)
+            # else RemoveWord: skip
+        if len(vecs) < self._min_length:
+            vecs = vecs + [np.zeros(self._vec_size, np.float32)] * (
+                self._min_length - len(vecs))
+        return np.stack(vecs[:self._max_len])
+
+    def next(self) -> DataSet:
+        feats, labs = [], []
+        while self._provider.hasNext() and len(feats) < self._bs:
+            s, lab = self._provider.nextSentence()
+            feats.append(self._sentence_vectors(s))
+            labs.append(self._lab_idx[lab])
+        n = len(feats)
+        # static [N, max_len, D] + mask — one shape for every batch
+        x = np.zeros((n, self._max_len, self._vec_size), np.float32)
+        mask = np.zeros((n, self._max_len), np.float32)
+        for i, v in enumerate(feats):
+            x[i, :len(v)] = v
+            mask[i, :len(v)] = 1.0
+        y = np.eye(len(self._labels), dtype=np.float32)[labs]
+        return DataSet(x, y, features_mask=mask)
+
+    def loadSingleSentence(self, sentence: str) -> np.ndarray:
+        """[1, T, D] tensor for inference (reference method)."""
+        v = self._sentence_vectors(sentence)
+        x = np.zeros((1, self._max_len, self._vec_size), np.float32)
+        x[0, :len(v)] = v
+        return x

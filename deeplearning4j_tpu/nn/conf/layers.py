@@ -541,6 +541,21 @@ class GlobalPoolingLayer(Layer):
             return jnp.sum(jnp.abs(x) ** 2, axis=axes) ** 0.5, state
         return jnp.mean(x, axis=axes), state
 
+    def apply_masked(self, params, state, x, mask, train, rng):
+        """Pool over REAL timesteps only (reference: GlobalPoolingLayer
+        masked pooling via setMaskArray). x: [N,T,F]; mask: [N,T]."""
+        m = mask[..., None].astype(x.dtype)
+        pt = PoolingType(self.pooling_type)
+        if pt is PoolingType.MAX:
+            neg = jnp.asarray(jnp.finfo(x.dtype).min, x.dtype)
+            return jnp.max(jnp.where(m > 0, x, neg), axis=1), state
+        if pt is PoolingType.SUM:
+            return jnp.sum(x * m, axis=1), state
+        if pt is PoolingType.PNORM:
+            return jnp.sum(jnp.abs(x * m) ** 2, axis=1) ** 0.5, state
+        return jnp.sum(x * m, axis=1) / jnp.maximum(jnp.sum(m, axis=1),
+                                                    1.0), state
+
 
 # ----------------------------------------------------------------------
 # normalization layers

@@ -153,20 +153,26 @@ class MultiLayerNetwork:
             new_states.append(ns)
         return a, new_states
 
-    def _loss(self, params_list, states_list, x, y, mask, rng):
+    def _loss(self, params_list, states_list, x, y, mask, rng, fmask=None):
         """Forward to the loss head; fused stable loss on pre-activations."""
         loss, (new_states, data_loss, _) = self._loss_carries(
-            params_list, states_list, None, x, y, mask, rng)
+            params_list, states_list, None, x, y, mask, rng, fmask)
         return loss, (new_states, data_loss)
 
     def _loss_carries(self, params_list, states_list, carries, x, y, mask,
-                      rng):
+                      rng, fmask=None):
         """Loss forward threading recurrent hidden state (tBPTT path:
         reference MultiLayerNetwork#doTruncatedBPTT keeps each layer's
         rnnTimeStep state across segments; gradient truncation falls out
         of the carries entering the jitted segment step as inputs)."""
         conf = self.conf
         a = x
+        # features mask: zero padded timesteps at the input (reference:
+        # setLayerMaskArrays; padded inputs contribute nothing) — masked
+        # pooling below handles the reduction side
+        if fmask is not None and a.ndim == 3 \
+                and a.shape[1] == fmask.shape[1]:
+            a = a * fmask[..., None].astype(a.dtype)
         new_states = []
         new_carries = []
         keys = (jax.random.split(rng, len(conf.layers))
@@ -177,6 +183,15 @@ class MultiLayerNetwork:
                 a = apply_preprocessor(tag, a)
             p_i = params_list[i]
             k_i = keys[i]
+            # masked global pooling when the time axis still lines up
+            from deeplearning4j_tpu.nn.conf.layers import GlobalPoolingLayer
+            if fmask is not None and isinstance(layer, GlobalPoolingLayer) \
+                    and a.ndim == 3 and a.shape[1] == fmask.shape[1]:
+                a, ns = layer.apply_masked(p_i, states_list[i], a, fmask,
+                                           True, k_i)
+                new_states.append(ns)
+                new_carries.append(None)
+                continue
             # weight noise (reference: IWeightNoise applied per training
             # forward; DropConnect/WeightNoise in conf/weightnoise)
             if getattr(layer, "weight_noise", None) is not None \
@@ -247,13 +262,15 @@ class MultiLayerNetwork:
     # ------------------------------------------------------------------
     # the compiled training step
     # ------------------------------------------------------------------
-    def _get_train_step(self, has_mask: bool) -> Callable:
-        if has_mask in self._step_cache:
-            return self._step_cache[has_mask]
+    def _get_train_step(self, has_mask: bool, has_fmask: bool = False) -> Callable:
+        key = (has_mask, has_fmask)
+        if key in self._step_cache:
+            return self._step_cache[key]
 
         def step_fn(params_list, states_list, opt_states, it_step, ep_step,
-                    x, y, mask, rng):
-            loss_fn = lambda pl: self._loss(pl, states_list, x, y, mask, rng)
+                    x, y, mask, fmask, rng):
+            loss_fn = lambda pl: self._loss(pl, states_list, x, y, mask, rng,
+                                            fmask)
             (loss, (new_states, data_loss)), grads = \
                 jax.value_and_grad(loss_fn, has_aux=True)(params_list)
             grads = self._clip_grads(grads)
@@ -270,7 +287,7 @@ class MultiLayerNetwork:
             return new_params, new_states, new_opt, data_loss
 
         jitted = jax.jit(step_fn, donate_argnums=(0, 1, 2))
-        self._step_cache[has_mask] = jitted
+        self._step_cache[key] = jitted
         return jitted
 
     def _get_tbptt_step(self, has_mask: bool) -> Callable:
@@ -321,7 +338,8 @@ class MultiLayerNetwork:
         if isinstance(data, DataSetIterator):
             for _ in range(epochs):
                 for ds in data:
-                    self._fit_batch(ds.features, ds.labels, ds.labels_mask)
+                    self._fit_batch(ds.features, ds.labels, ds.labels_mask,
+                                    ds.features_mask)
                 self._epoch += 1
                 for l in self._listeners:
                     if hasattr(l, "onEpochEnd"):
@@ -329,7 +347,8 @@ class MultiLayerNetwork:
             return self
         if isinstance(data, DataSet):
             for _ in range(epochs):
-                self._fit_batch(data.features, data.labels, data.labels_mask)
+                self._fit_batch(data.features, data.labels,
+                                data.labels_mask, data.features_mask)
             return self
         if labels is None:
             raise ValueError("fit(x, y) requires labels")
@@ -337,19 +356,32 @@ class MultiLayerNetwork:
             self._fit_batch(_unwrap(data), _unwrap(labels), None)
         return self
 
-    def _fit_batch(self, x, y, mask):
+    def _fit_batch(self, x, y, mask, features_mask=None):
         x = jnp.asarray(_unwrap(x), self._dtype)
         y = jnp.asarray(_unwrap(y))
-        m = jnp.asarray(mask) if mask is not None else None
+        fm = jnp.asarray(_unwrap(features_mask)) \
+            if features_mask is not None else None
+        # per-timestep labels with a features mask and no explicit label
+        # mask: the features mask IS the label mask (reference: RNN
+        # masking conventions)
+        if mask is None and fm is not None and y.ndim == 3 \
+                and fm.ndim == 2 and y.shape[1] == fm.shape[1]:
+            mask = fm
+        m = jnp.asarray(_unwrap(mask)) if mask is not None else None
         k = self.conf.tbptt_fwd_length
         if (k and x.ndim == 3 and x.shape[1] > k
                 and any(l.is_recurrent for l in self.conf.layers)):
+            if fm is not None:
+                raise NotImplementedError(
+                    "features masks with truncated BPTT are not supported "
+                    "yet — use standard BPTT")
             return self._fit_tbptt(x, y, m, k)
         self._rng_key, sub = jax.random.split(self._rng_key)
-        step_fn = self._get_train_step(mask is not None)
+        step_fn = self._get_train_step(m is not None, fm is not None)
         (self.params_list, self.states_list, self.opt_states, loss) = step_fn(
             self.params_list, self.states_list, self.opt_states,
-            jnp.asarray(self._iteration), jnp.asarray(self._epoch), x, y, m, sub)
+            jnp.asarray(self._iteration), jnp.asarray(self._epoch), x, y, m,
+            fm, sub)
         # keep the loss on-device: a float() here would force a host sync
         # every step and stall the dispatch pipeline (very costly over a
         # remote/tunneled accelerator); score() converts lazily

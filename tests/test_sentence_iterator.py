@@ -1,0 +1,198 @@
+"""CnnSentenceDataSetIterator tests (reference:
+deeplearning4j-nlp CnnSentenceDataSetIteratorTest) — end-to-end:
+Word2Vec embeddings -> sentence tensors -> Conv1D classifier."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nlp import (
+    CnnSentenceDataSetIterator, CollectionLabeledSentenceProvider, Word2Vec,
+)
+
+
+def corpus():
+    pets = ["cat dog pet fluffy animal", "dog cat bark purr pet",
+            "fluffy cat pet animal dog", "pet dog animal bark cat"]
+    fin = ["stock market price trade money", "market stock trade profit",
+           "price trade stock market money", "profit money market stock"]
+    sentences = (pets + fin) * 4
+    labels = (["pets"] * 4 + ["finance"] * 4) * 4
+    return sentences, labels
+
+
+@pytest.fixture(scope="module")
+def w2v():
+    sentences, _ = corpus()
+    return (Word2Vec.Builder().layerSize(12).windowSize(3)
+            .minWordFrequency(1).epochs(8).seed(7)
+            .iterate(sentences).build().fit())
+
+
+class TestProvider:
+    def test_collection_provider(self):
+        s, l = corpus()
+        p = CollectionLabeledSentenceProvider(s, l)
+        assert p.totalNumSentences() == 32
+        assert p.allLabels() == ["finance", "pets"]
+        n = 0
+        while p.hasNext():
+            sent, lab = p.nextSentence()
+            assert lab in ("pets", "finance")
+            n += 1
+        assert n == 32
+        p.reset()
+        assert p.hasNext()
+
+    def test_length_mismatch_raises(self):
+        with pytest.raises(ValueError, match="sentences vs"):
+            CollectionLabeledSentenceProvider(["a"], ["x", "y"])
+
+
+class TestCnnSentenceIterator:
+    def test_tensor_shapes_and_mask(self, w2v):
+        s, l = corpus()
+        it = CnnSentenceDataSetIterator(
+            CollectionLabeledSentenceProvider(s, l), w2v,
+            batch_size=8, max_sentence_length=6)
+        ds = it.next()
+        assert ds.features.shape == (8, 6, 12)
+        assert ds.labels.shape == (8, 2)
+        assert ds.features_mask.shape == (8, 6)
+        # 5-word sentences -> mask 5 ones, padded tail zero
+        assert ds.features_mask[0].sum() in (4.0, 5.0)
+        assert np.all(ds.features[0][int(ds.features_mask[0].sum()):] == 0)
+
+    def test_oov_handling_modes(self, w2v):
+        s = ["cat zzzunknownzzz dog"]
+        it_rm = CnnSentenceDataSetIterator(
+            CollectionLabeledSentenceProvider(s, ["pets"]), w2v,
+            max_sentence_length=5, unknown_word_handling="RemoveWord")
+        x = it_rm.loadSingleSentence(s[0])
+        # OOV removed: 2 real vectors
+        assert (np.abs(x[0]).sum(-1) > 0).sum() == 2
+        it_unk = CnnSentenceDataSetIterator(
+            CollectionLabeledSentenceProvider(s, ["pets"]), w2v,
+            max_sentence_length=5, unknown_word_handling="UseUnknownVector")
+        x2 = it_unk.loadSingleSentence(s[0])
+        assert (np.abs(x2[0]).sum(-1) > 0).sum() == 3
+
+    def test_end_to_end_text_cnn(self, w2v):
+        from deeplearning4j_tpu.learning import Adam
+        from deeplearning4j_tpu.nn.conf import (
+            Convolution1D, GlobalPoolingLayer, InputType,
+            NeuralNetConfiguration, OutputLayer,
+        )
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+        s, l = corpus()
+        it = CnnSentenceDataSetIterator(
+            CollectionLabeledSentenceProvider(s, l, rng_seed=3), w2v,
+            batch_size=16, max_sentence_length=6)
+        conf = (NeuralNetConfiguration.builder().seed(5)
+                .updater(Adam(learning_rate=5e-3)).list()
+                .layer(Convolution1D(n_out=16, kernel_size=3,
+                                     convolution_mode="Same",
+                                     activation="relu"))
+                .layer(GlobalPoolingLayer(pooling_type="max"))
+                .layer(OutputLayer(n_out=2, activation="softmax",
+                                   loss="mcxent"))
+                .setInputType(InputType.recurrent(12, 6)).build())
+        net = MultiLayerNetwork(conf).init()
+        net.fit(it, epochs=30)
+        # classify held-out-ish sentences
+        x_pet = it.loadSingleSentence("fluffy dog pet")
+        x_fin = it.loadSingleSentence("stock profit market")
+        p_pet = np.asarray(net.output(x_pet))[0]
+        p_fin = np.asarray(net.output(x_fin))[0]
+        pets_col = it.getLabels().index("pets")
+        assert p_pet[pets_col] > 0.5
+        assert p_fin[pets_col] < 0.5
+
+
+class TestFeaturesMaskTraining:
+    def test_invalid_unknown_handling_raises(self, w2v):
+        s, l = corpus()
+        with pytest.raises(ValueError, match="unknown_word_handling"):
+            CnnSentenceDataSetIterator(
+                CollectionLabeledSentenceProvider(s, l), w2v,
+                unknown_word_handling="useUnknownVector")
+
+    def test_masked_global_pooling_ignores_padding(self):
+        """MLN honors features_mask: padded steps cannot win max-pool."""
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.nn.conf import GlobalPoolingLayer
+        x = jnp.asarray(np.stack([
+            np.concatenate([np.full((2, 3), -1.0), np.zeros((3, 3))]),
+        ]).astype(np.float32))  # [1,5,3]: real steps all -1, pad zeros
+        mask = jnp.asarray([[1, 1, 0, 0, 0]], jnp.float32)
+        lay = GlobalPoolingLayer(pooling_type="max")
+        unmasked, _ = lay.apply({}, {}, x, False, None)
+        masked, _ = lay.apply_masked({}, {}, x, mask, False, None)
+        assert np.allclose(np.asarray(unmasked), 0.0)   # padding wins
+        assert np.allclose(np.asarray(masked), -1.0)    # padding excluded
+        # avg pooling divides by real length
+        lay_avg = GlobalPoolingLayer(pooling_type="avg")
+        m_avg, _ = lay_avg.apply_masked({}, {}, x, mask, False, None)
+        assert np.allclose(np.asarray(m_avg), -1.0)
+
+    def test_fit_with_features_mask_changes_training(self, w2v):
+        """Same data, features_mask on/off -> different trained nets."""
+        from deeplearning4j_tpu.datasets import DataSet
+        from deeplearning4j_tpu.learning import Adam
+        from deeplearning4j_tpu.nn.conf import (
+            DenseLayer, GlobalPoolingLayer, InputType,
+            NeuralNetConfiguration, OutputLayer,
+        )
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+        rng = np.random.default_rng(0)
+        # padding region carries STRONG anti-signal; mask must kill it
+        x = rng.normal(size=(16, 6, 4)).astype(np.float32)
+        lab = (x[:, :3, 0].mean(1) > 0).astype(int)
+        x[:, 3:] = -np.sign(lab)[:, None, None] * 5.0
+        y = np.eye(2, dtype=np.float32)[lab]
+        mask = np.ones((16, 6), np.float32)
+        mask[:, 3:] = 0
+
+        def build():
+            conf = (NeuralNetConfiguration.builder().seed(4)
+                    .updater(Adam(learning_rate=1e-2)).list()
+                    .layer(DenseLayer(n_out=8, activation="tanh"))
+                    .layer(GlobalPoolingLayer(pooling_type="avg"))
+                    .layer(OutputLayer(n_out=2, activation="softmax",
+                                       loss="mcxent"))
+                    .setInputType(InputType.recurrent(4, 6)).build())
+            return MultiLayerNetwork(conf).init()
+
+        net_m = build()
+        ds = DataSet(x, y, features_mask=mask)
+        for _ in range(30):
+            net_m.fit(ds)
+        net_u = build()
+        for _ in range(30):
+            net_u.fit(DataSet(x, y))
+        out_m = np.asarray(net_m.params_list[0]["W"])
+        out_u = np.asarray(net_u.params_list[0]["W"])
+        assert not np.allclose(out_m, out_u)
+
+    def test_mean_reduced_loss_mask_identity(self):
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.loss import LossFunction, compute_loss
+        rng = np.random.default_rng(1)
+        labels = rng.normal(size=(2, 4, 3)).astype(np.float32)
+        pred = rng.normal(size=(2, 4, 3)).astype(np.float32)
+        for lf in (LossFunction.MSE, LossFunction.MAE):
+            um = float(compute_loss(lf, jnp.asarray(labels),
+                                    jnp.asarray(pred), "identity", None))
+            am = float(compute_loss(lf, jnp.asarray(labels),
+                                    jnp.asarray(pred), "identity",
+                                    jnp.ones((2, 4))))
+            assert abs(um - am) < 1e-5, lf
+        # sparse CE identity too
+        il = jnp.asarray(rng.integers(0, 3, (2, 4)))
+        um = float(compute_loss(LossFunction.SPARSE_MCXENT, il,
+                                jnp.asarray(pred), "softmax", None))
+        am = float(compute_loss(LossFunction.SPARSE_MCXENT, il,
+                                jnp.asarray(pred), "softmax",
+                                jnp.ones((2, 4))))
+        assert abs(um - am) < 1e-5
