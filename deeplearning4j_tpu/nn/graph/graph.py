@@ -88,27 +88,90 @@ class ComputationGraph:
             self._node_index = {n.name: n for n in self.conf.nodes}
         return self._node_index[name]
 
+    def _validate_fmasks(self, feature_masks, inputs: Dict[str, Any]):
+        """Normalize/validate per-input features masks. Accepts [N,T] or
+        [N,T,1] on [N,T,F] inputs; anything else raises loudly. At most
+        ONE masked input (masked-pooling attribution would otherwise be
+        ambiguous — raise instead of guessing)."""
+        conf = self.conf
+        if not feature_masks:
+            return {}
+        if len(feature_masks) != len(conf.network_inputs):
+            raise ValueError(
+                f"got {len(feature_masks)} feature masks for "
+                f"{len(conf.network_inputs)} graph inputs "
+                f"{conf.network_inputs} (use None placeholders)")
+        fmasks = {}
+        for n, m in zip(conf.network_inputs, feature_masks):
+            if m is None:
+                continue
+            fm = jnp.asarray(_unwrap(m))
+            if fm.ndim == 3 and fm.shape[-1] == 1:
+                fm = fm[..., 0]
+            x = inputs[n]
+            if x.ndim != 3 or fm.ndim != 2 or fm.shape[1] != x.shape[1]:
+                raise NotImplementedError(
+                    f"features mask shape {tuple(fm.shape)} not supported "
+                    f"for input {n!r} of shape {tuple(x.shape)} — expected "
+                    "[N,T] (or [N,T,1]) on a [N,T,F] sequence input")
+            fmasks[n] = fm
+        if len(fmasks) > 1:
+            raise NotImplementedError(
+                "features masks on more than one graph input are not "
+                "supported (masked-pooling attribution would be "
+                "ambiguous)")
+        return fmasks
+
     # ------------------------------------------------------------------
-    def _forward_all(self, params_map, states_map, inputs: dict, train, rng):
+    def _forward_all(self, params_map, states_map, inputs: dict, train, rng,
+                     fmasks_map=None):
+        from deeplearning4j_tpu.nn.conf.layers import GlobalPoolingLayer
+
         conf = self.conf
         acts: Dict[str, Any] = dict(inputs)
+        fmask = None
+        for name, fm in (fmasks_map or {}).items():
+            acts[name] = acts[name] * fm[..., None].astype(acts[name].dtype)
+            fmask = fm
         new_states: Dict[str, dict] = {}
         keys = (jax.random.split(rng, len(conf.nodes))
                 if rng is not None else [None] * len(conf.nodes))
         for i, node in enumerate(conf.nodes):
             xs = [acts[s] for s in node.inputs]
-            out, ns = node.vertex.apply(params_map[node.name],
-                                        states_map[node.name], xs, train,
-                                        keys[i])
+            v = node.vertex
+            if fmask is not None and isinstance(v, LayerVertex) \
+                    and isinstance(v.layer, GlobalPoolingLayer) \
+                    and xs[0].ndim == 3 \
+                    and xs[0].shape[1] == fmask.shape[1]:
+                out, ns = v.layer.apply_masked(
+                    params_map[node.name], states_map[node.name], xs[0],
+                    fmask, train, keys[i])
+            else:
+                out, ns = v.apply(params_map[node.name],
+                                  states_map[node.name], xs, train,
+                                  keys[i])
             acts[node.name] = out
             new_states[node.name] = ns
         return acts, new_states
 
     def _loss(self, params_map, states_map, inputs, labels_map, rng,
-              masks_map=None):
+              masks_map=None, fmasks_map=None):
         conf = self.conf
         masks_map = masks_map or {}
+        fmasks_map = fmasks_map or {}
+        from deeplearning4j_tpu.nn.conf.layers import GlobalPoolingLayer
+
         acts: Dict[str, Any] = dict(inputs)
+        # features masks: zero padded timesteps at each masked input
+        # (reference: setLayerMaskArrays; same policy as the MLN path).
+        # Masked POOLING uses the single graph-wide mask; _fit_batch
+        # rejects >1 masked input so branch/mask attribution is never
+        # ambiguous.
+        fmask = None
+        for name, fm in fmasks_map.items():
+            acts[name] = acts[name] * fm[..., None].astype(
+                acts[name].dtype)
+            fmask = fm
         new_states: Dict[str, dict] = {}
         keys = (jax.random.split(rng, len(conf.nodes))
                 if rng is not None else [None] * len(conf.nodes))
@@ -123,6 +186,16 @@ class ComputationGraph:
             if wn is not None and k_i is not None:
                 k_i, k_wn = jax.random.split(k_i)
                 p_i = wn.apply(p_i, k_wn)
+            # masked global pooling while the time axis still lines up
+            if fmask is not None and isinstance(v, LayerVertex) \
+                    and isinstance(v.layer, GlobalPoolingLayer) \
+                    and xs[0].ndim == 3 \
+                    and xs[0].shape[1] == fmask.shape[1]:
+                out, ns = v.layer.apply_masked(
+                    p_i, states_map[node.name], xs[0], fmask, True, k_i)
+                acts[node.name] = out
+                new_states[node.name] = ns
+                continue
             if node.name in conf.network_outputs and isinstance(v, LayerVertex) \
                     and isinstance(v.layer, (OutputLayer, LossLayer)):
                 total = total + v.layer.loss_value(
@@ -183,15 +256,16 @@ class ComputationGraph:
             return out
         raise ValueError(f"Unknown gradient normalization: {mode}")
 
-    def _get_train_step(self, mask_key=frozenset()):
-        cache_key = ("step", mask_key)
+    def _get_train_step(self, mask_key=frozenset(), fmask_key=frozenset()):
+        cache_key = ("step", mask_key, fmask_key)
         if cache_key in self._step_cache:
             return self._step_cache[cache_key]
 
         def step_fn(params_map, states_map, opt_states, it_step, ep_step,
-                    inputs, labels_map, masks_map, rng):
+                    inputs, labels_map, masks_map, fmasks_map, rng):
             loss_fn = lambda pm: self._loss(pm, states_map, inputs,
-                                            labels_map, rng, masks_map)
+                                            labels_map, rng, masks_map,
+                                            fmasks_map)
             (loss, (new_states, data_loss)), grads = \
                 jax.value_and_grad(loss_fn, has_aux=True)(params_map)
             grads = self._clip(grads)
@@ -222,15 +296,6 @@ class ComputationGraph:
             MultiDataSet, MultiDataSetIterator,
         )
 
-        def _check_mds(mds):
-            # label masks ARE applied (per-output, at the loss); input
-            # masks would need forward masking — still unimplemented
-            if mds.features_mask_arrays:
-                raise NotImplementedError(
-                    "MultiDataSet features masks are not yet applied by "
-                    "ComputationGraph.fit — dropping them silently would "
-                    "train over padding")
-
         if isinstance(data, MultiDataSetIterator):
             if epochs > 1 and not data.resetSupported():
                 raise ValueError(
@@ -238,38 +303,28 @@ class ComputationGraph:
                     "(reference behavior)")
             for _ in range(epochs):
                 for mds in data:
-                    _check_mds(mds)
                     self._fit_batch(mds.features, mds.labels,
-                                    mds.labels_mask_arrays or None)
+                                    mds.labels_mask_arrays or None,
+                                    mds.features_mask_arrays or None)
                 self._epoch += 1
             return self
         if isinstance(data, MultiDataSet):
-            _check_mds(data)
             for _ in range(epochs):
                 self._fit_batch(data.features, data.labels,
-                                data.labels_mask_arrays or None)
+                                data.labels_mask_arrays or None,
+                                data.features_mask_arrays or None)
             return self
-        def _check_ds(ds):
-            if ds.features_mask is not None:
-                raise NotImplementedError(
-                    "DataSet features masks are not yet applied by "
-                    "ComputationGraph.fit — dropping them silently would "
-                    "train over padding (MultiLayerNetwork.fit supports "
-                    "them)")
-
         if isinstance(data, DataSetIterator):
             for _ in range(epochs):
                 for ds in data:
-                    _check_ds(ds)
                     self._fit_batch([ds.features], [ds.labels],
-                                    [ds.labels_mask])
+                                    [ds.labels_mask], [ds.features_mask])
                 self._epoch += 1
             return self
         if isinstance(data, DataSet):
-            _check_ds(data)
             for _ in range(epochs):
                 self._fit_batch([data.features], [data.labels],
-                                [data.labels_mask])
+                                [data.labels_mask], [data.features_mask])
             return self
         if labels is None:
             raise ValueError("fit(inputs, labels) requires labels")
@@ -282,7 +337,8 @@ class ComputationGraph:
                             [_unwrap(l) for l in labels])
         return self
 
-    def _fit_batch(self, xs: Sequence, ys: Sequence, label_masks=None):
+    def _fit_batch(self, xs: Sequence, ys: Sequence, label_masks=None,
+                   feature_masks=None):
         conf = self.conf
         if len(xs) != len(conf.network_inputs):
             raise ValueError(
@@ -309,12 +365,13 @@ class ComputationGraph:
             for n, m in zip(conf.network_outputs, label_masks):
                 if m is not None:
                     masks[n] = jnp.asarray(_unwrap(m))
+        fmasks = self._validate_fmasks(feature_masks, inputs)
         self._rng_key, sub = jax.random.split(self._rng_key)
-        step = self._get_train_step(frozenset(masks))
+        step = self._get_train_step(frozenset(masks), frozenset(fmasks))
         (self.params_map, self.states_map, self.opt_states, loss) = step(
             self.params_map, self.states_map, self.opt_states,
             jnp.asarray(self._iteration), jnp.asarray(self._epoch),
-            inputs, labels, masks, sub)
+            inputs, labels, masks, fmasks, sub)
         self._score = loss  # on-device; score() converts lazily (no
         # per-step host sync — critical for dispatch pipelining)
         self._iteration += 1
@@ -322,22 +379,28 @@ class ComputationGraph:
             l.iterationDone(self, self._iteration, self._epoch)
 
     # ------------------------------------------------------------------
-    def output(self, *xs) -> List[NDArray]:
-        """Reference: ComputationGraph#output — returns list of outputs."""
+    def output(self, *xs, feature_masks=None) -> List[NDArray]:
+        """Reference: ComputationGraph#output — returns list of outputs.
+        feature_masks keeps inference consistent with masked training."""
         self._check_init()
         conf = self.conf
-        if self._fwd is None:
-            self._fwd = jax.jit(
-                lambda pm, sm, inp: tuple(
-                    self._forward_all(pm, sm, inp, False, None)[0][o]
-                    for o in conf.network_outputs))
         inputs = {n: jnp.asarray(_unwrap(x), self._dtype)
                   for n, x in zip(conf.network_inputs, xs)}
-        outs = self._fwd(self.params_map, self.states_map, inputs)
+        fmasks = self._validate_fmasks(feature_masks, inputs)
+        key = frozenset(fmasks)
+        if self._fwd is None:
+            self._fwd = {}
+        if key not in self._fwd:
+            self._fwd[key] = jax.jit(
+                lambda pm, sm, inp, fms: tuple(
+                    self._forward_all(pm, sm, inp, False, None, fms)[0][o]
+                    for o in conf.network_outputs))
+        outs = self._fwd[key](self.params_map, self.states_map, inputs,
+                              fmasks)
         return [NDArray(o) for o in outs]
 
-    def outputSingle(self, *xs) -> NDArray:
-        return self.output(*xs)[0]
+    def outputSingle(self, *xs, feature_masks=None) -> NDArray:
+        return self.output(*xs, feature_masks=feature_masks)[0]
 
     def score(self, dataset: Optional[DataSet] = None) -> float:
         if dataset is None:

@@ -138,10 +138,15 @@ class MultiLayerNetwork:
     # ------------------------------------------------------------------
     # forward (reference: feedForward / ffToLayerActivationsInWs)
     # ------------------------------------------------------------------
-    def _forward(self, params_list, states_list, x, train: bool, rng):
+    def _forward(self, params_list, states_list, x, train: bool, rng,
+                 fmask=None):
         """Pure forward through all layers. Returns (out, new_states)."""
+        from deeplearning4j_tpu.nn.conf.layers import GlobalPoolingLayer
+
         conf = self.conf
         a = x
+        if fmask is not None:
+            a = a * fmask[..., None].astype(a.dtype)
         new_states = []
         keys = (jax.random.split(rng, len(conf.layers))
                 if rng is not None else [None] * len(conf.layers))
@@ -149,7 +154,13 @@ class MultiLayerNetwork:
             tag = conf.preprocessors.get(i)
             if tag:
                 a = apply_preprocessor(tag, a)
-            a, ns = layer.apply(params_list[i], states_list[i], a, train, keys[i])
+            if fmask is not None and isinstance(layer, GlobalPoolingLayer) \
+                    and a.ndim == 3 and a.shape[1] == fmask.shape[1]:
+                a, ns = layer.apply_masked(params_list[i], states_list[i],
+                                           a, fmask, train, keys[i])
+            else:
+                a, ns = layer.apply(params_list[i], states_list[i], a,
+                                    train, keys[i])
             new_states.append(ns)
         return a, new_states
 
@@ -165,13 +176,14 @@ class MultiLayerNetwork:
         reference MultiLayerNetwork#doTruncatedBPTT keeps each layer's
         rnnTimeStep state across segments; gradient truncation falls out
         of the carries entering the jitted segment step as inputs)."""
+        from deeplearning4j_tpu.nn.conf.layers import GlobalPoolingLayer
+
         conf = self.conf
         a = x
         # features mask: zero padded timesteps at the input (reference:
         # setLayerMaskArrays; padded inputs contribute nothing) — masked
         # pooling below handles the reduction side
-        if fmask is not None and a.ndim == 3 \
-                and a.shape[1] == fmask.shape[1]:
+        if fmask is not None:
             a = a * fmask[..., None].astype(a.dtype)
         new_states = []
         new_carries = []
@@ -184,7 +196,6 @@ class MultiLayerNetwork:
             p_i = params_list[i]
             k_i = keys[i]
             # masked global pooling when the time axis still lines up
-            from deeplearning4j_tpu.nn.conf.layers import GlobalPoolingLayer
             if fmask is not None and isinstance(layer, GlobalPoolingLayer) \
                     and a.ndim == 3 and a.shape[1] == fmask.shape[1]:
                 a, ns = layer.apply_masked(p_i, states_list[i], a, fmask,
@@ -322,12 +333,14 @@ class MultiLayerNetwork:
         self._step_cache[key] = jitted
         return jitted
 
-    def _get_forward(self, train: bool) -> Callable:
-        if train in self._fwd_cache:
-            return self._fwd_cache[train]
+    def _get_forward(self, train: bool, has_fmask: bool = False) -> Callable:
+        key = (train, has_fmask)
+        if key in self._fwd_cache:
+            return self._fwd_cache[key]
         fn = jax.jit(
-            lambda pl, sl, x, rng: self._forward(pl, sl, x, train, rng)[0])
-        self._fwd_cache[train] = fn
+            lambda pl, sl, x, rng, fm: self._forward(pl, sl, x, train, rng,
+                                                     fm)[0])
+        self._fwd_cache[key] = fn
         return fn
 
     # ------------------------------------------------------------------
@@ -356,11 +369,27 @@ class MultiLayerNetwork:
             self._fit_batch(_unwrap(data), _unwrap(labels), None)
         return self
 
+    @staticmethod
+    def _validate_fmask(fm, x):
+        """Normalize/validate a features mask against [N,T,F] input.
+        Accepts [N,T] or [N,T,1]; anything else raises loudly (silently
+        dropping a mask would train over padding)."""
+        if fm is None:
+            return None
+        fm = jnp.asarray(_unwrap(fm))
+        if fm.ndim == 3 and fm.shape[-1] == 1:
+            fm = fm[..., 0]
+        if x.ndim != 3 or fm.ndim != 2 or fm.shape[1] != x.shape[1]:
+            raise NotImplementedError(
+                f"features mask shape {tuple(fm.shape)} not supported for "
+                f"input shape {tuple(x.shape)} — expected [N,T] (or "
+                "[N,T,1]) matching a [N,T,F] sequence input")
+        return fm
+
     def _fit_batch(self, x, y, mask, features_mask=None):
         x = jnp.asarray(_unwrap(x), self._dtype)
         y = jnp.asarray(_unwrap(y))
-        fm = jnp.asarray(_unwrap(features_mask)) \
-            if features_mask is not None else None
+        fm = self._validate_fmask(features_mask, x)
         # per-timestep labels with a features mask and no explicit label
         # mask: the features mask IS the label mask (reference: RNN
         # masking conventions)
@@ -445,17 +474,20 @@ class MultiLayerNetwork:
     # ------------------------------------------------------------------
     # inference / scoring
     # ------------------------------------------------------------------
-    def output(self, x, train: bool = False) -> NDArray:
-        """Reference: MultiLayerNetwork#output(INDArray, train). Compiled
-        forward; train=True uses batch statistics + dropout."""
+    def output(self, x, train: bool = False, features_mask=None) -> NDArray:
+        """Reference: MultiLayerNetwork#output(INDArray, train[, mask]).
+        Compiled forward; train=True uses batch statistics + dropout.
+        features_mask keeps inference consistent with masked training
+        (zeroed padding + masked global pooling)."""
         self._check_init()
         xj = jnp.asarray(_unwrap(x), self._dtype)
+        fm = self._validate_fmask(features_mask, xj)
         if train:
             self._rng_key, sub = jax.random.split(self._rng_key)
         else:
             sub = None
-        out = self._get_forward(train)(self.params_list, self.states_list,
-                                       xj, sub)
+        out = self._get_forward(train, fm is not None)(
+            self.params_list, self.states_list, xj, sub, fm)
         return NDArray(out)
 
     def feedForward(self, x) -> List[NDArray]:

@@ -92,14 +92,36 @@ class TestMaskAndResetGuards:
         parts = mds.splitBatches(3)
         assert parts[0].labels_mask_arrays[0].shape == (3, 5)
 
-    def test_features_mask_raises_on_graph(self):
-        import pytest as _pytest
-        g = _two_input_graph()
-        mds = MultiDataSet([np.ones((4, 3), np.float32)] * 2,
-                           [np.ones((4, 2), np.float32)],
-                           features_mask_arrays=[np.ones((4,), np.float32)])
-        with _pytest.raises(NotImplementedError, match="features mask"):
-            g.fit(mds)
+    def test_features_mask_applied_on_graph(self):
+        """Graph fit honors features masks: padded steps (which carry a
+        strong anti-signal here) are zeroed before the forward."""
+        from deeplearning4j_tpu.nn.conf import GlobalPoolingLayer, \
+            DenseLayer as DL
+        b = (ComputationGraphConfiguration.graphBuilder().seed(4)
+             .updater(Adam(learning_rate=1e-2)).addInputs("seq"))
+        b.setInputTypes(InputType.recurrent(4, 6))
+        b.addLayer("d", DL(n_in=4, n_out=8, activation="tanh"), "seq")
+        b.addLayer("pool", GlobalPoolingLayer(pooling_type="avg"), "d")
+        b.addLayer("out", OutputLayer(n_in=8, n_out=2,
+                                      activation="softmax", loss="mcxent"),
+                   "pool")
+        conf = b.setOutputs("out").build()
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(16, 6, 4)).astype(np.float32)
+        lab = (x[:, :3, 0].mean(1) > 0).astype(int)
+        x[:, 3:] = -np.sign(lab)[:, None, None] * 5.0
+        y = np.eye(2, dtype=np.float32)[lab]
+        fm = np.ones((16, 6), np.float32)
+        fm[:, 3:] = 0
+        g_m = ComputationGraph(conf).init()
+        mds = MultiDataSet([x], [y], features_mask_arrays=[fm])
+        for _ in range(30):
+            g_m.fit(mds)
+        g_u = ComputationGraph(conf).init()
+        for _ in range(30):
+            g_u.fit(MultiDataSet([x], [y]))
+        assert not np.allclose(np.asarray(g_m.params_map["d"]["W"]),
+                               np.asarray(g_u.params_map["d"]["W"]))
 
     def test_label_mask_applied_in_graph_loss(self):
         """Label masks flow to the output layer's loss: masking out the
@@ -219,3 +241,66 @@ class TestMaskSemantics:
         r = subprocess.run([sys.executable, "-c", code], env=env,
                            capture_output=True, text=True, timeout=120)
         assert "WIRED" in r.stdout, r.stderr[-500:]
+
+
+class TestMaskedInference:
+    def test_output_honors_features_mask(self):
+        from deeplearning4j_tpu.nn.conf import GlobalPoolingLayer, \
+            DenseLayer as DL
+        b = (ComputationGraphConfiguration.graphBuilder().seed(9)
+             .updater(Adam(learning_rate=1e-2)).addInputs("seq"))
+        b.setInputTypes(InputType.recurrent(3, 4))
+        b.addLayer("d", DL(n_in=3, n_out=6, activation="tanh"), "seq")
+        b.addLayer("pool", GlobalPoolingLayer(pooling_type="avg"), "d")
+        b.addLayer("out", OutputLayer(n_in=6, n_out=2,
+                                      activation="softmax", loss="mcxent"),
+                   "pool")
+        g = ComputationGraph(b.setOutputs("out").build()).init()
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(4, 4, 3)).astype(np.float32)
+        fm = np.array([[1, 1, 0, 0]] * 4, np.float32)
+        o_masked = np.asarray(g.outputSingle(x, feature_masks=[fm]))
+        o_plain = np.asarray(g.outputSingle(x))
+        assert not np.allclose(o_masked, o_plain)
+        # masked output equals output on the truncated real sequence
+        # (avg pooling divides by real length)
+        o_trunc = np.asarray(ComputationGraph(g.conf).init().outputSingle(x))
+        # same graph instance for weights:
+        x_zeroed = x.copy()
+        x_zeroed[:, 2:] = 0
+        # recompute manually: mean over first 2 steps == masked avg
+        import jax.numpy as jnp
+        d_w = g.params_map["d"]
+        h = np.tanh(x @ np.asarray(d_w["W"]) + np.asarray(d_w["b"]))
+        pooled = h[:, :2].mean(1)
+        ow = g.params_map["out"]
+        logits = pooled @ np.asarray(ow["W"]) + np.asarray(ow["b"])
+        want = np.exp(logits) / np.exp(logits).sum(-1, keepdims=True)
+        np.testing.assert_allclose(o_masked, want, atol=1e-4)
+
+    def test_bad_fmask_shape_raises(self):
+        import pytest as _pytest
+        g = _two_input_graph()
+        xa, xb, y, _ = _data(8)
+        with _pytest.raises(NotImplementedError, match="features mask"):
+            g._fit_batch([xa, xb], [y], None,
+                         [np.ones((8,), np.float32), None])
+
+    def test_mln_output_mask_consistency(self):
+        from deeplearning4j_tpu.nn.conf import (
+            GlobalPoolingLayer, NeuralNetConfiguration,
+        )
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        conf = (NeuralNetConfiguration.builder().seed(2)
+                .updater(Adam(learning_rate=1e-2)).list()
+                .layer(DenseLayer(n_out=5, activation="tanh"))
+                .layer(GlobalPoolingLayer(pooling_type="max"))
+                .layer(OutputLayer(n_out=2, activation="softmax",
+                                   loss="mcxent"))
+                .setInputType(InputType.recurrent(3, 4)).build())
+        net = MultiLayerNetwork(conf).init()
+        x = np.random.default_rng(1).normal(size=(4, 4, 3)).astype(np.float32)
+        fm = np.array([[1, 1, 0, 0]] * 4, np.float32)
+        o_m = np.asarray(net.output(x, features_mask=fm))
+        o_p = np.asarray(net.output(x))
+        assert not np.allclose(o_m, o_p)
