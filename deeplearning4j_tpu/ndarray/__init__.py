@@ -11,5 +11,8 @@ path is expected to be traced into a jit-compiled whole step instead
 from deeplearning4j_tpu.ndarray.dtypes import DataType
 from deeplearning4j_tpu.ndarray.ndarray import NDArray
 from deeplearning4j_tpu.ndarray.factory import Nd4j
+from deeplearning4j_tpu.ndarray.indexing import (
+    INDArrayIndex, NDArrayIndex,
+)
 
-__all__ = ["DataType", "NDArray", "Nd4j"]
+__all__ = ["DataType", "NDArray", "Nd4j", "INDArrayIndex", "NDArrayIndex"]

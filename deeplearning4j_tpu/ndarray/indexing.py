@@ -1,0 +1,111 @@
+"""NDArray indexing (reference: org/nd4j/linalg/indexing/ —
+NDArrayIndex, INDArrayIndex impls {PointIndex, IntervalIndex, NDArrayIndexAll,
+NewAxis, SpecifiedIndex}; consumed by INDArray#get/#put).
+
+Index objects resolve to numpy-style index pieces; `get` is a pure
+gather (a jax slice view), `put` is a functional scatter returning the
+updated buffer wrapped by the SAME NDArray (matching the reference's
+in-place put semantics at the API level — see ndarray.py's versioned
+buffer note).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Sequence
+
+
+class INDArrayIndex:
+    """Marker base (reference: indexing/INDArrayIndex)."""
+
+    def resolve(self):  # -> numpy-style index piece
+        raise NotImplementedError
+
+
+class PointIndex(INDArrayIndex):
+    def __init__(self, i: int):
+        self.i = int(i)
+
+    def resolve(self):
+        return self.i
+
+
+class IntervalIndex(INDArrayIndex):
+    def __init__(self, begin: int, end: int, stride: int = 1,
+                 inclusive: bool = False):
+        self.begin = int(begin)
+        self.end = int(end) + (1 if inclusive else 0)
+        self.stride = int(stride)
+
+    def resolve(self):
+        return slice(self.begin, self.end, self.stride)
+
+
+class NDArrayIndexAll(INDArrayIndex):
+    def resolve(self):
+        return slice(None)
+
+
+class NewAxis(INDArrayIndex):
+    def resolve(self):
+        return None  # numpy newaxis
+
+
+class SpecifiedIndex(INDArrayIndex):
+    def __init__(self, *indices: int):
+        self.indices = [int(i) for i in indices]
+
+    def resolve(self):
+        import numpy as np
+
+        return np.asarray(self.indices)
+
+
+class NDArrayIndex:
+    """Static factory (reference: indexing/NDArrayIndex)."""
+
+    @staticmethod
+    def all() -> INDArrayIndex:
+        return NDArrayIndexAll()
+
+    @staticmethod
+    def point(i: int) -> INDArrayIndex:
+        return PointIndex(i)
+
+    @staticmethod
+    def interval(begin: int, *args, stride: int = 1,
+                 inclusive: bool = False) -> INDArrayIndex:
+        """Reference overloads, argument order preserved EXACTLY:
+        interval(begin, end) / interval(begin, stride, end[, inclusive]).
+        Keyword form interval(begin, end, stride=..., inclusive=...)
+        also accepted."""
+        if len(args) == 1:
+            end = args[0]
+        elif len(args) in (2, 3):
+            # 3-positional is the reference's (begin, STRIDE, end)
+            stride, end = args[0], args[1]
+            if len(args) == 3:
+                inclusive = bool(args[2])
+        else:
+            raise TypeError(
+                "interval(begin, end) or interval(begin, stride, end"
+                "[, inclusive])")
+        return IntervalIndex(begin, end, stride, inclusive)
+
+    @staticmethod
+    def newAxis() -> INDArrayIndex:
+        return NewAxis()
+
+    @staticmethod
+    def indices(*idx: int) -> INDArrayIndex:
+        return SpecifiedIndex(*idx)
+
+
+def resolve_indices(idxs: Sequence[Any]) -> tuple:
+    """INDArrayIndex / int / slice / list mix -> numpy index tuple."""
+    out: List[Any] = []
+    for ix in idxs:
+        if isinstance(ix, INDArrayIndex):
+            out.append(ix.resolve())
+        else:
+            out.append(ix)
+    return tuple(out)

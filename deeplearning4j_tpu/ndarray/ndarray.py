@@ -154,9 +154,72 @@ class NDArray:
         self._buf = self._buf.at[tuple(idx)].set(value)
         return self
 
-    def put(self, idx, value) -> "NDArray":
+    def put(self, *args) -> "NDArray":
+        """put(idx, value) with idx a raw index OR a list/tuple of
+        INDArrayIndex (reference: INDArray#put(INDArrayIndex[], ...))."""
+        from deeplearning4j_tpu.ndarray.indexing import (
+            INDArrayIndex, resolve_indices,
+        )
+
+        if len(args) < 2:
+            raise TypeError(
+                "put(idx..., value) needs at least an index and a value")
+        *idxs, value = args
+        if len(idxs) == 1 and not isinstance(idxs[0], INDArrayIndex):
+            idx = idxs[0]
+            if isinstance(idx, (list, tuple)) and idx and \
+                    isinstance(idx[0], INDArrayIndex):
+                idx = resolve_indices(idx)
+        else:
+            idx = resolve_indices(idxs)
         self._buf = self._buf.at[idx].set(_unwrap(value))
         return self
+
+    # -- indexing (reference: INDArray#get with NDArrayIndex) ----------
+    def get(self, *idxs) -> "NDArray":
+        from deeplearning4j_tpu.ndarray.indexing import resolve_indices
+
+        return NDArray(self._buf[resolve_indices(idxs)])
+
+    def getRow(self, i: int) -> "NDArray":
+        return NDArray(self._buf[i])
+
+    def getRows(self, *rows: int) -> "NDArray":
+        return NDArray(self._buf[np.asarray(rows)])
+
+    def getColumn(self, i: int) -> "NDArray":
+        return NDArray(self._buf[:, i])
+
+    def getColumns(self, *cols: int) -> "NDArray":
+        return NDArray(self._buf[:, np.asarray(cols)])
+
+    def putRow(self, i: int, row) -> "NDArray":
+        self._buf = self._buf.at[i].set(_unwrap(row))
+        return self
+
+    def putColumn(self, i: int, col) -> "NDArray":
+        self._buf = self._buf.at[:, i].set(_unwrap(col))
+        return self
+
+    def slice(self, i: int, dim: int = 0) -> "NDArray":
+        """i-th subarray along `dim` (reference: INDArray#slice)."""
+        return NDArray(jnp.take(self._buf, i, axis=dim))
+
+    def tensorAlongDimension(self, index: int, *dims: int) -> "NDArray":
+        """TAD (reference: INDArray#tensorAlongDimension): the index-th
+        sub-tensor spanning `dims`, iterating the remaining dims in
+        C order."""
+        other = [d for d in range(self._buf.ndim) if d not in dims]
+        moved = jnp.moveaxis(self._buf, other, range(len(other)))
+        flat = moved.reshape((-1,) + moved.shape[len(other):])
+        return NDArray(flat[index])
+
+    def tensorsAlongDimension(self, *dims: int) -> int:
+        other = [d for d in range(self._buf.ndim) if d not in dims]
+        n = 1
+        for d in other:
+            n *= self._buf.shape[d]
+        return n
 
     def getDouble(self, *idx) -> float:
         if len(idx) == 1 and isinstance(idx[0], int) and self._buf.ndim != 1:

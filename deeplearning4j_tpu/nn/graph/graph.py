@@ -88,6 +88,15 @@ class ComputationGraph:
             self._node_index = {n.name: n for n in self.conf.nodes}
         return self._node_index[name]
 
+    def _downstream_of(self, source: str) -> set:
+        """Names of nodes reachable from `source` (an input or node) —
+        masked pooling must only fire on the masked input's own branch."""
+        down = {source}
+        for node in self.conf.nodes:  # nodes are topologically ordered
+            if any(s in down for s in node.inputs):
+                down.add(node.name)
+        return down
+
     def _validate_fmasks(self, feature_masks, inputs: Dict[str, Any]):
         """Normalize/validate per-input features masks. Accepts [N,T] or
         [N,T,1] on [N,T,F] inputs; anything else raises loudly. At most
@@ -101,20 +110,14 @@ class ComputationGraph:
                 f"got {len(feature_masks)} feature masks for "
                 f"{len(conf.network_inputs)} graph inputs "
                 f"{conf.network_inputs} (use None placeholders)")
+        from deeplearning4j_tpu.nn.masking import validate_features_mask
+
         fmasks = {}
         for n, m in zip(conf.network_inputs, feature_masks):
             if m is None:
                 continue
-            fm = jnp.asarray(_unwrap(m))
-            if fm.ndim == 3 and fm.shape[-1] == 1:
-                fm = fm[..., 0]
-            x = inputs[n]
-            if x.ndim != 3 or fm.ndim != 2 or fm.shape[1] != x.shape[1]:
-                raise NotImplementedError(
-                    f"features mask shape {tuple(fm.shape)} not supported "
-                    f"for input {n!r} of shape {tuple(x.shape)} — expected "
-                    "[N,T] (or [N,T,1]) on a [N,T,F] sequence input")
-            fmasks[n] = fm
+            fmasks[n] = validate_features_mask(
+                _unwrap(m), inputs[n], ctx=f"input {n!r}")
         if len(fmasks) > 1:
             raise NotImplementedError(
                 "features masks on more than one graph input are not "
@@ -130,16 +133,19 @@ class ComputationGraph:
         conf = self.conf
         acts: Dict[str, Any] = dict(inputs)
         fmask = None
+        masked_branch: set = set()
         for name, fm in (fmasks_map or {}).items():
             acts[name] = acts[name] * fm[..., None].astype(acts[name].dtype)
             fmask = fm
+            masked_branch = self._downstream_of(name)
         new_states: Dict[str, dict] = {}
         keys = (jax.random.split(rng, len(conf.nodes))
                 if rng is not None else [None] * len(conf.nodes))
         for i, node in enumerate(conf.nodes):
             xs = [acts[s] for s in node.inputs]
             v = node.vertex
-            if fmask is not None and isinstance(v, LayerVertex) \
+            if fmask is not None and node.name in masked_branch \
+                    and isinstance(v, LayerVertex) \
                     and isinstance(v.layer, GlobalPoolingLayer) \
                     and xs[0].ndim == 3 \
                     and xs[0].shape[1] == fmask.shape[1]:
@@ -168,10 +174,12 @@ class ComputationGraph:
         # rejects >1 masked input so branch/mask attribution is never
         # ambiguous.
         fmask = None
+        masked_branch: set = set()
         for name, fm in fmasks_map.items():
             acts[name] = acts[name] * fm[..., None].astype(
                 acts[name].dtype)
             fmask = fm
+            masked_branch = self._downstream_of(name)
         new_states: Dict[str, dict] = {}
         keys = (jax.random.split(rng, len(conf.nodes))
                 if rng is not None else [None] * len(conf.nodes))
@@ -187,7 +195,9 @@ class ComputationGraph:
                 k_i, k_wn = jax.random.split(k_i)
                 p_i = wn.apply(p_i, k_wn)
             # masked global pooling while the time axis still lines up
-            if fmask is not None and isinstance(v, LayerVertex) \
+            # (only on the masked input's own branch)
+            if fmask is not None and node.name in masked_branch \
+                    and isinstance(v, LayerVertex) \
                     and isinstance(v.layer, GlobalPoolingLayer) \
                     and xs[0].ndim == 3 \
                     and xs[0].shape[1] == fmask.shape[1]:
@@ -416,8 +426,14 @@ class ComputationGraph:
 
         ev = Evaluation()
         for ds in iterator:
-            out = self.outputSingle(ds.features)
-            ev.eval(ds.labels, out.jax)
+            fms = [ds.features_mask] if ds.features_mask is not None \
+                else None
+            out = self.outputSingle(ds.features, feature_masks=fms)
+            mask = ds.labels_mask
+            if mask is None and ds.features_mask is not None \
+                    and np.asarray(ds.labels).ndim == 3:
+                mask = ds.features_mask
+            ev.eval(ds.labels, out.jax, mask=mask)
         return ev
 
     def evaluateRegression(self, iterator: DataSetIterator):

@@ -1,0 +1,27 @@
+"""Shared features-mask validation for both network front-ends
+(reference: the mask conventions of setLayerMaskArrays — SURVEY.md §5
+long-context/masking)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def validate_features_mask(fm, x, ctx: str = "input"):
+    """Normalize/validate a features mask against a [N,T,F] input.
+
+    Accepts [N,T] or [N,T,1]; returns the normalized [N,T] mask.
+    Anything else raises loudly — silently dropping a mask would train
+    over padding.
+    """
+    if fm is None:
+        return None
+    fm = jnp.asarray(fm)
+    if fm.ndim == 3 and fm.shape[-1] == 1:
+        fm = fm[..., 0]
+    if x.ndim != 3 or fm.ndim != 2 or fm.shape[1] != x.shape[1]:
+        raise NotImplementedError(
+            f"features mask shape {tuple(fm.shape)} not supported for "
+            f"{ctx} of shape {tuple(x.shape)} — expected [N,T] (or "
+            "[N,T,1]) matching a [N,T,F] sequence input")
+    return fm

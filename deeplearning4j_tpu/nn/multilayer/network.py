@@ -371,20 +371,10 @@ class MultiLayerNetwork:
 
     @staticmethod
     def _validate_fmask(fm, x):
-        """Normalize/validate a features mask against [N,T,F] input.
-        Accepts [N,T] or [N,T,1]; anything else raises loudly (silently
-        dropping a mask would train over padding)."""
-        if fm is None:
-            return None
-        fm = jnp.asarray(_unwrap(fm))
-        if fm.ndim == 3 and fm.shape[-1] == 1:
-            fm = fm[..., 0]
-        if x.ndim != 3 or fm.ndim != 2 or fm.shape[1] != x.shape[1]:
-            raise NotImplementedError(
-                f"features mask shape {tuple(fm.shape)} not supported for "
-                f"input shape {tuple(x.shape)} — expected [N,T] (or "
-                "[N,T,1]) matching a [N,T,F] sequence input")
-        return fm
+        from deeplearning4j_tpu.nn.masking import validate_features_mask
+
+        return validate_features_mask(
+            _unwrap(fm) if fm is not None else None, x)
 
     def _fit_batch(self, x, y, mask, features_mask=None):
         x = jnp.asarray(_unwrap(x), self._dtype)
@@ -602,8 +592,12 @@ class MultiLayerNetwork:
 
         ev = Evaluation()
         for ds in iterator:
-            out = self.output(ds.features)
-            ev.eval(ds.labels, out.jax, mask=ds.labels_mask)
+            out = self.output(ds.features, features_mask=ds.features_mask)
+            mask = ds.labels_mask
+            if mask is None and ds.features_mask is not None \
+                    and np.asarray(ds.labels).ndim == 3:
+                mask = ds.features_mask  # evalTimeSeries convention
+            ev.eval(ds.labels, out.jax, mask=mask)
         return ev
 
     def evaluateRegression(self, iterator: DataSetIterator):

@@ -187,3 +187,71 @@ class TestPytree:
         out = f(Nd4j.create([1.0, 2.0]))
         assert isinstance(out, NDArray)
         np.testing.assert_allclose(out.toNumpy(), [4, 6])
+
+
+class TestIndexing:
+    """NDArrayIndex get/put (reference: org/nd4j/linalg/indexing/** +
+    INDArray#get/#put/#slice/#tensorAlongDimension)."""
+
+    def test_get_with_indices(self):
+        import numpy as np
+        from deeplearning4j_tpu.ndarray import Nd4j, NDArrayIndex
+        a = Nd4j.arange(24).reshape(4, 6)
+        sub = a.get(NDArrayIndex.interval(1, 3), NDArrayIndex.all())
+        assert sub.shape() == (2, 6)
+        np.testing.assert_allclose(sub.toNumpy(), a.toNumpy()[1:3])
+        pt = a.get(NDArrayIndex.point(2), NDArrayIndex.interval(0, 4))
+        np.testing.assert_allclose(pt.toNumpy(), a.toNumpy()[2, 0:4])
+        sp = a.get(NDArrayIndex.indices(0, 3), NDArrayIndex.all())
+        np.testing.assert_allclose(sp.toNumpy(), a.toNumpy()[[0, 3]])
+        # inclusive interval + stride
+        iv = a.get(NDArrayIndex.all(),
+                   NDArrayIndex.interval(0, 2, 4, inclusive=True))
+        np.testing.assert_allclose(iv.toNumpy(), a.toNumpy()[:, 0:5:2])
+        na = a.get(NDArrayIndex.all(), NDArrayIndex.newAxis(),
+                   NDArrayIndex.all())
+        assert na.shape() == (4, 1, 6)
+
+    def test_put_with_indices(self):
+        import numpy as np
+        from deeplearning4j_tpu.ndarray import Nd4j, NDArrayIndex
+        a = Nd4j.zeros(3, 4)
+        a.put(NDArrayIndex.point(1), NDArrayIndex.interval(1, 3),
+              Nd4j.ones(2))
+        want = np.zeros((3, 4), np.float32)
+        want[1, 1:3] = 1
+        np.testing.assert_allclose(a.toNumpy(), want)
+        # raw index still works
+        a.put((0, 0), 7.0)
+        assert a.getDouble(0, 0) == 7.0
+
+    def test_rows_columns_slice(self):
+        import numpy as np
+        from deeplearning4j_tpu.ndarray import Nd4j
+        a = Nd4j.arange(12).reshape(3, 4)
+        np.testing.assert_allclose(a.getRow(1).toNumpy(), a.toNumpy()[1])
+        np.testing.assert_allclose(a.getColumn(2).toNumpy(),
+                                   a.toNumpy()[:, 2])
+        np.testing.assert_allclose(a.getRows(0, 2).toNumpy(),
+                                   a.toNumpy()[[0, 2]])
+        np.testing.assert_allclose(a.getColumns(1, 3).toNumpy(),
+                                   a.toNumpy()[:, [1, 3]])
+        a.putRow(0, Nd4j.zeros(4))
+        assert a.toNumpy()[0].sum() == 0
+        a.putColumn(3, Nd4j.ones(3))
+        np.testing.assert_allclose(a.toNumpy()[:, 3], 1)
+        np.testing.assert_allclose(a.slice(2).toNumpy(), a.toNumpy()[2])
+        np.testing.assert_allclose(a.slice(1, dim=1).toNumpy(),
+                                   a.toNumpy()[:, 1])
+
+    def test_tensor_along_dimension(self):
+        import numpy as np
+        from deeplearning4j_tpu.ndarray import Nd4j
+        a = Nd4j.arange(24).reshape(2, 3, 4)
+        assert a.tensorsAlongDimension(2) == 6
+        # TAD over last dim: index-th row in C order over (2,3)
+        np.testing.assert_allclose(a.tensorAlongDimension(4, 2).toNumpy(),
+                                   a.toNumpy()[1, 1])
+        assert a.tensorsAlongDimension(1, 2) == 2
+        np.testing.assert_allclose(
+            a.tensorAlongDimension(1, 1, 2).toNumpy(), a.toNumpy()[1])
