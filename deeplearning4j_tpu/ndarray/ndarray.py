@@ -209,16 +209,20 @@ class NDArray:
         """TAD (reference: INDArray#tensorAlongDimension): the index-th
         sub-tensor spanning `dims`, iterating the remaining dims in
         C order."""
-        other = [d for d in range(self._buf.ndim) if d not in dims]
+        nd = self._buf.ndim
+        dset = {d % nd for d in dims}
+        other = [d for d in range(nd) if d not in dset]
         moved = jnp.moveaxis(self._buf, other, range(len(other)))
         flat = moved.reshape((-1,) + moved.shape[len(other):])
         return NDArray(flat[index])
 
     def tensorsAlongDimension(self, *dims: int) -> int:
-        other = [d for d in range(self._buf.ndim) if d not in dims]
+        nd = self._buf.ndim
+        dset = {d % nd for d in dims}
         n = 1
-        for d in other:
-            n *= self._buf.shape[d]
+        for d in range(nd):
+            if d not in dset:
+                n *= self._buf.shape[d]
         return n
 
     def getDouble(self, *idx) -> float:

@@ -441,8 +441,14 @@ class ComputationGraph:
 
         ev = RegressionEvaluation()
         for ds in iterator:
-            out = self.outputSingle(ds.features)
-            ev.eval(ds.labels, out.jax)
+            fms = [ds.features_mask] if ds.features_mask is not None \
+                else None
+            out = self.outputSingle(ds.features, feature_masks=fms)
+            mask = ds.labels_mask
+            if mask is None and ds.features_mask is not None \
+                    and np.asarray(ds.labels).ndim == 3:
+                mask = ds.features_mask
+            ev.eval(ds.labels, out.jax, mask=mask)
         return ev
 
     # ------------------------------------------------------------------

@@ -605,8 +605,12 @@ class MultiLayerNetwork:
 
         ev = RegressionEvaluation()
         for ds in iterator:
-            out = self.output(ds.features)
-            ev.eval(ds.labels, out.jax)
+            out = self.output(ds.features, features_mask=ds.features_mask)
+            mask = ds.labels_mask
+            if mask is None and ds.features_mask is not None \
+                    and np.asarray(ds.labels).ndim == 3:
+                mask = ds.features_mask
+            ev.eval(ds.labels, out.jax, mask=mask)
         return ev
 
     # ------------------------------------------------------------------

@@ -268,3 +268,168 @@ class TransferLearningHelper:
 
 __all__ = ["TransferLearning", "FineTuneConfiguration", "FrozenLayer",
            "TransferLearningHelper"]
+
+
+class TransferLearningGraphBuilder:
+    """Transfer learning on ComputationGraph (reference:
+    TransferLearning.GraphBuilder — fineTuneConfiguration,
+    setFeatureExtractor(vertexName), removeVertexAndConnections,
+    addLayer/addVertex, nOutReplace, setOutputs)."""
+
+    def __init__(self, graph):
+        if graph.params_map is None:
+            raise ValueError("graph must be init()ed / trained")
+        self._g = graph
+        self._ftc: Optional[FineTuneConfiguration] = None
+        self._feature_extractor: Optional[str] = None
+        self._removed: set = set()
+        self._added: list = []           # (name, vertex, inputs)
+        self._nout_replace = {}          # name -> (n_out, weight_init)
+        self._new_outputs: Optional[list] = None
+
+    def fineTuneConfiguration(self, ftc: FineTuneConfiguration):
+        self._ftc = ftc
+        return self
+
+    def setFeatureExtractor(self, vertex_name: str):
+        """Freeze `vertex_name` and everything upstream of it."""
+        self._feature_extractor = vertex_name
+        return self
+
+    def removeVertexAndConnections(self, name: str):
+        self._removed.add(name)
+        return self
+
+    def removeVertexKeepConnections(self, name: str):
+        # connections are re-declared by subsequent addLayer/addVertex
+        self._removed.add(name)
+        return self
+
+    def addLayer(self, name: str, layer, *inputs):
+        from deeplearning4j_tpu.nn.graph.vertices import LayerVertex
+        self._added.append((name, LayerVertex(layer=layer), list(inputs)))
+        return self
+
+    def addVertex(self, name: str, vertex, *inputs):
+        self._added.append((name, vertex, list(inputs)))
+        return self
+
+    def nOutReplace(self, name: str, n_out: int,
+                    weight_init: str = "xavier"):
+        self._nout_replace[name] = (int(n_out), weight_init)
+        return self
+
+    def setOutputs(self, *names: str):
+        self._new_outputs = list(names)
+        return self
+
+    # -- build ----------------------------------------------------------
+    def _ancestors(self, conf, name: str) -> set:
+        """name + every node upstream of it."""
+        parents = {n.name: list(n.inputs) for n in conf.nodes}
+        seen = set()
+        stack = [name]
+        while stack:
+            cur = stack.pop()
+            if cur in seen:
+                continue
+            seen.add(cur)
+            stack.extend(parents.get(cur, []))
+        return seen
+
+    def build(self):
+        from deeplearning4j_tpu.nn.graph.config import GraphNode
+        from deeplearning4j_tpu.nn.graph.graph import ComputationGraph
+        from deeplearning4j_tpu.nn.graph.vertices import LayerVertex
+
+        src = self._g
+        conf = src.conf
+        re_added = {n for n, _, _ in self._added}
+        removed = set(self._removed) - re_added
+        # dropping a vertex drops everything downstream of it unless
+        # re-added (reference: removeVertexAndConnections); re-added
+        # names do NOT propagate removal (removeVertexKeepConnections)
+        for node in conf.nodes:
+            if node.name in re_added:
+                continue
+            if any(s in removed for s in node.inputs):
+                removed.add(node.name)
+
+        frozen: set = set()
+        if self._feature_extractor is not None:
+            frozen = self._ancestors(conf, self._feature_extractor)
+
+        # nOutReplace: downstream LayerVertex consumers get the new n_in
+        # and a reinit (mirrors the MLN builder above)
+        consumers = {}
+        for node in conf.nodes:
+            for s in node.inputs:
+                consumers.setdefault(s, []).append(node.name)
+        reinit: set = set()
+        adjust_nin = {}
+        for tgt, (n_out, _) in self._nout_replace.items():
+            reinit.add(tgt)
+            for c in consumers.get(tgt, []):
+                adjust_nin[c] = n_out
+                reinit.add(c)
+
+        added_by_name = {n: (v, i) for n, v, i in self._added}
+        new_nodes = []
+        placed = set()
+        for node in conf.nodes:
+            if node.name in re_added:
+                # replaced in place: keeps the topological position
+                v, i = added_by_name[node.name]
+                new_nodes.append(GraphNode(name=node.name, vertex=v,
+                                           inputs=i))
+                placed.add(node.name)
+                continue
+            if node.name in removed:
+                continue
+            vertex = copy.deepcopy(node.vertex)
+            if node.name in self._nout_replace:
+                if not isinstance(vertex, LayerVertex):
+                    raise ValueError(
+                        f"nOutReplace target {node.name!r} is not a layer")
+                n_out, wi = self._nout_replace[node.name]
+                vertex.layer.n_out = n_out
+                vertex.layer.weight_init = wi
+            if node.name in adjust_nin and isinstance(vertex, LayerVertex) \
+                    and hasattr(vertex.layer, "n_in"):
+                vertex.layer.n_in = adjust_nin[node.name]
+            if node.name in frozen and src.params_map.get(node.name):
+                from deeplearning4j_tpu.nn.graph.vertices import FrozenVertex
+                vertex = FrozenVertex(vertex=vertex)
+            new_nodes.append(GraphNode(name=node.name, vertex=vertex,
+                                       inputs=list(node.inputs)))
+        for name, vertex, inputs in self._added:
+            if name not in placed:
+                new_nodes.append(GraphNode(name=name, vertex=vertex,
+                                           inputs=inputs))
+
+        ftc = self._ftc or FineTuneConfiguration()
+        new_conf = dataclasses.replace(
+            conf,
+            nodes=new_nodes,
+            network_outputs=self._new_outputs or [
+                o for o in conf.network_outputs if o not in removed],
+            seed=ftc.seed if ftc.seed is not None else conf.seed,
+            updater=ftc.updater if ftc.updater is not None
+            else conf.updater,
+            l1=ftc.l1 if ftc.l1 is not None else conf.l1,
+            l2=ftc.l2 if ftc.l2 is not None else conf.l2,
+        )
+        out = ComputationGraph(new_conf).init()
+        for node in new_conf.nodes:
+            name = node.name
+            if name in reinit or name in re_added \
+                    or name not in src.params_map:
+                continue
+            out.params_map[name] = jax.tree_util.tree_map(
+                lambda a: a, src.params_map[name])
+            out.states_map[name] = jax.tree_util.tree_map(
+                lambda a: a, src.states_map[name])
+        return out
+
+
+TransferLearning.GraphBuilder = TransferLearningGraphBuilder

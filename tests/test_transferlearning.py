@@ -145,3 +145,114 @@ class TestHelper:
         cfg2 = MultiLayerConfiguration.from_json(tl.conf.to_json())
         assert isinstance(cfg2.layers[0], FrozenLayer)
         assert isinstance(cfg2.layers[0].layer, DenseLayer)
+
+
+class TestGraphTransferLearning:
+    """reference: TransferLearning.GraphBuilder tests
+    (TransferLearningCompGraphTest)."""
+
+    def _trained_graph(self):
+        from deeplearning4j_tpu.learning import Adam
+        from deeplearning4j_tpu.nn.conf import (DenseLayer, InputType,
+                                                OutputLayer)
+        from deeplearning4j_tpu.nn.graph import (
+            ComputationGraph, ComputationGraphConfiguration,
+        )
+        b = (ComputationGraphConfiguration.graphBuilder().seed(1)
+             .updater(Adam(learning_rate=1e-2)).addInputs("in"))
+        b.setInputTypes(InputType.feedForward(4))
+        b.addLayer("fe1", DenseLayer(n_in=4, n_out=10, activation="relu"),
+                   "in")
+        b.addLayer("fe2", DenseLayer(n_in=10, n_out=8, activation="relu"),
+                   "fe1")
+        b.addLayer("out", OutputLayer(n_in=8, n_out=3, activation="softmax",
+                                      loss="mcxent"), "fe2")
+        g = ComputationGraph(b.setOutputs("out").build()).init()
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(32, 4)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 32)]
+        for _ in range(5):
+            g.fit([x], [y])
+        return g, x
+
+    def test_freeze_and_replace_head(self):
+        from deeplearning4j_tpu.learning import Sgd
+        from deeplearning4j_tpu.nn.conf import OutputLayer
+        from deeplearning4j_tpu.nn.transferlearning import (
+            FineTuneConfiguration, TransferLearning,
+        )
+        g, x = self._trained_graph()
+        fe1_w = np.asarray(g.params_map["fe1"]["W"]).copy()
+        new_g = (TransferLearning.GraphBuilder(g)
+                 .fineTuneConfiguration(FineTuneConfiguration(
+                     updater=Sgd(learning_rate=0.1)))
+                 .setFeatureExtractor("fe2")
+                 .removeVertexAndConnections("out")
+                 .addLayer("new_out",
+                           OutputLayer(n_in=8, n_out=5,
+                                       activation="softmax", loss="mcxent"),
+                           "fe2")
+                 .setOutputs("new_out")
+                 .build())
+        # transferred weights intact
+        np.testing.assert_allclose(
+            np.asarray(new_g.params_map["fe1"]["W"]), fe1_w)
+        # new 5-class head
+        out = np.asarray(new_g.outputSingle(x))
+        assert out.shape == (32, 5)
+        # frozen layers stay fixed through training
+        rng = np.random.default_rng(1)
+        y5 = np.eye(5, dtype=np.float32)[rng.integers(0, 5, 32)]
+        for _ in range(5):
+            new_g.fit([x], [y5])
+        np.testing.assert_allclose(
+            np.asarray(new_g.params_map["fe1"]["W"]), fe1_w)
+        # head trained
+        assert np.isfinite(new_g.score())
+
+    def test_nout_replace_on_graph(self):
+        from deeplearning4j_tpu.nn.transferlearning import TransferLearning
+        g, x = self._trained_graph()
+        new_g = (TransferLearning.GraphBuilder(g)
+                 .nOutReplace("out", 7)
+                 .build())
+        out = np.asarray(new_g.outputSingle(x))
+        assert out.shape == (32, 7)
+        # upstream weights preserved
+        np.testing.assert_allclose(np.asarray(new_g.params_map["fe2"]["W"]),
+                                   np.asarray(g.params_map["fe2"]["W"]))
+
+
+class TestGraphTLReviewFixes:
+    def test_keep_connections_preserves_downstream(self):
+        from deeplearning4j_tpu.nn.conf import DenseLayer
+        from deeplearning4j_tpu.nn.transferlearning import TransferLearning
+        g, x = TestGraphTransferLearning()._trained_graph()
+        new_g = (TransferLearning.GraphBuilder(g)
+                 .removeVertexKeepConnections("fe2")
+                 .addLayer("fe2", DenseLayer(n_in=10, n_out=8,
+                                             activation="tanh"), "fe1")
+                 .build())
+        # downstream 'out' survived, same outputs, fresh fe2
+        out = np.asarray(new_g.outputSingle(x))
+        assert out.shape == (32, 3)
+        assert not np.allclose(np.asarray(new_g.params_map["fe2"]["W"]),
+                               np.asarray(g.params_map["fe2"]["W"]))
+
+    def test_nout_replace_updates_downstream_nin(self):
+        from deeplearning4j_tpu.nn.transferlearning import TransferLearning
+        g, x = TestGraphTransferLearning()._trained_graph()
+        new_g = (TransferLearning.GraphBuilder(g)
+                 .nOutReplace("fe1", 20)
+                 .build())
+        out = np.asarray(new_g.outputSingle(x))
+        assert out.shape == (32, 3)
+        assert new_g.params_map["fe1"]["W"].shape == (4, 20)
+        assert new_g.params_map["fe2"]["W"].shape == (20, 8)
+
+    def test_tad_negative_dims(self):
+        from deeplearning4j_tpu.ndarray import Nd4j
+        a = Nd4j.arange(24).reshape(2, 3, 4)
+        assert a.tensorsAlongDimension(-1) == 6
+        np.testing.assert_allclose(a.tensorAlongDimension(0, -1).toNumpy(),
+                                   [0, 1, 2, 3])
