@@ -136,4 +136,121 @@ class Yolo2OutputLayer(LossLayer):
         return total / n
 
 
-__all__ = ["Yolo2OutputLayer"]
+__all__ = ["Yolo2OutputLayer", "DetectedObject", "YoloUtils"]
+
+
+class DetectedObject:
+    """One decoded detection (reference:
+    org/deeplearning4j/nn/layers/objdetect/DetectedObject). Coordinates
+    are in GRID units, like the reference; multiply by the cell pixel
+    size for image coords."""
+
+    def __init__(self, center_x: float, center_y: float, width: float,
+                 height: float, predicted_class: int, confidence: float,
+                 class_probabilities=None):
+        self.center_x = float(center_x)
+        self.center_y = float(center_y)
+        self.width = float(width)
+        self.height = float(height)
+        self.predicted_class = int(predicted_class)
+        self.confidence = float(confidence)
+        self.class_probabilities = class_probabilities
+
+    # reference getters
+    def getCenterX(self):
+        return self.center_x
+
+    def getCenterY(self):
+        return self.center_y
+
+    def getWidth(self):
+        return self.width
+
+    def getHeight(self):
+        return self.height
+
+    def getPredictedClass(self):
+        return self.predicted_class
+
+    def getConfidence(self):
+        return self.confidence
+
+    def getTopLeftXY(self):
+        return (self.center_x - self.width / 2,
+                self.center_y - self.height / 2)
+
+    def getBottomRightXY(self):
+        return (self.center_x + self.width / 2,
+                self.center_y + self.height / 2)
+
+    def __repr__(self):
+        return (f"DetectedObject(cls={self.predicted_class}, "
+                f"conf={self.confidence:.3f}, xy=({self.center_x:.2f},"
+                f"{self.center_y:.2f}), wh=({self.width:.2f},"
+                f"{self.height:.2f}))")
+
+
+class YoloUtils:
+    """Detection decoding (reference:
+    org/deeplearning4j/nn/layers/objdetect/YoloUtils —
+    getPredictedObjects + NMS)."""
+
+    @staticmethod
+    def getPredictedObjects(layer: "Yolo2OutputLayer", network_output,
+                            conf_threshold: float = 0.5,
+                            nms_threshold: float = 0.4,
+                            max_objects: int = 50):
+        """Per-image lists of DetectedObject from raw [N,H,W,B*(5+C)]
+        activations: sigmoid/exp decode -> OBJECTNESS-confidence filter
+        (reference semantics: the threshold and
+        ``DetectedObject.confidence`` are the objectness score, not
+        objectness*classProb) -> greedy per-image NMS, batched through
+        one jitted vmap of the XLA-safe non_max_suppression op."""
+        from functools import partial
+
+        import numpy as np
+
+        from deeplearning4j_tpu.ops.image import non_max_suppression
+
+        x = jnp.asarray(network_output)
+        n, h, w, d = x.shape
+        b = len(layer.anchors)
+        n_classes = d // b - 5
+        if n_classes < 1 or d != b * (5 + n_classes):
+            raise ValueError(
+                f"output depth {d} is not B*(5+C) for B={b} anchors "
+                f"(got C={n_classes}) — check the layer's anchors match "
+                "the network")
+        xy, wh, conf, cls_logits = layer._decode(x, n_classes)
+        cls_prob = jax.nn.softmax(cls_logits, axis=-1)
+
+        xyf = xy.reshape(n, -1, 2)
+        whf = wh.reshape(n, -1, 2)
+        scf = conf.reshape(n, -1)
+        boxes = jnp.stack([xyf[..., 1] - whf[..., 1] / 2,   # y1
+                           xyf[..., 0] - whf[..., 0] / 2,   # x1
+                           xyf[..., 1] + whf[..., 1] / 2,   # y2
+                           xyf[..., 0] + whf[..., 0] / 2],  # x2
+                          axis=-1)                           # [N,HWB,4]
+        nms = jax.jit(jax.vmap(partial(
+            non_max_suppression, max_output_size=max_objects,
+            iou_threshold=nms_threshold, score_threshold=conf_threshold)))
+        sels, counts = nms(boxes, scf)
+
+        xy_n, wh_n = np.asarray(xyf), np.asarray(whf)
+        score_n = np.asarray(scf)
+        cls_n = np.asarray(jnp.argmax(cls_prob, axis=-1)).reshape(n, -1)
+        prob_n = np.asarray(cls_prob).reshape(n, -1, n_classes)
+        sels_n, counts_n = np.asarray(sels), np.asarray(counts)
+
+        results = []
+        for i in range(n):
+            dets = []
+            for j in sels_n[i][:int(counts_n[i])]:
+                dets.append(DetectedObject(
+                    xy_n[i, j, 0], xy_n[i, j, 1],
+                    wh_n[i, j, 0], wh_n[i, j, 1],
+                    int(cls_n[i, j]), float(score_n[i, j]),
+                    prob_n[i, j].copy()))
+            results.append(dets)
+        return results

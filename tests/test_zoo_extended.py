@@ -197,3 +197,55 @@ class TestNASNet:
             net.fit(x, y)
             losses.append(net.score())
         assert losses[-1] < losses[0]
+
+
+class TestYoloDetectionDecoding:
+    """reference: YoloUtils.getPredictedObjects + DetectedObject."""
+
+    def test_decode_and_nms(self):
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.nn.conf.objdetect import (
+            DetectedObject, Yolo2OutputLayer, YoloUtils,
+        )
+        anchors = ((1.0, 1.0), (2.0, 2.0))
+        lay = Yolo2OutputLayer(anchors=anchors)
+        h = w = 4
+        c = 3
+        b = len(anchors)
+        x = np.full((1, h, w, b * (5 + c)), -8.0, np.float32)
+        xr = x.reshape(1, h, w, b, 5 + c)
+        # plant one confident detection in cell (1,2), anchor 0, class 2
+        xr[0, 1, 2, 0, 0] = 0.0    # sigmoid->0.5 offset
+        xr[0, 1, 2, 0, 1] = 0.0
+        xr[0, 1, 2, 0, 2] = 0.0    # exp(0)*anchor_w = 1.0
+        xr[0, 1, 2, 0, 3] = 0.0
+        xr[0, 1, 2, 0, 4] = 8.0    # objectness ~1
+        xr[0, 1, 2, 0, 5 + 2] = 8.0  # class 2
+        # duplicate overlapping detection (same cell, anchor 1) that NMS
+        # must suppress
+        xr[0, 1, 2, 1, :5] = [0.0, 0.0, -0.7, -0.7, 6.0]
+        xr[0, 1, 2, 1, 5 + 2] = 6.0
+        dets = YoloUtils.getPredictedObjects(lay, x, conf_threshold=0.5,
+                                             nms_threshold=0.4)
+        assert len(dets) == 1       # one image
+        objs = dets[0]
+        assert len(objs) >= 1
+        top = objs[0]
+        assert isinstance(top, DetectedObject)
+        assert top.getPredictedClass() == 2
+        assert abs(top.getCenterX() - 2.5) < 0.05
+        assert abs(top.getCenterY() - 1.5) < 0.05
+        assert abs(top.getWidth() - 1.0) < 0.05
+        # overlapping duplicate suppressed
+        assert len(objs) == 1
+        tlx, tly = top.getTopLeftXY()
+        assert abs(tlx - 2.0) < 0.1 and abs(tly - 1.0) < 0.1
+
+    def test_low_confidence_filtered(self):
+        from deeplearning4j_tpu.nn.conf.objdetect import (
+            Yolo2OutputLayer, YoloUtils,
+        )
+        lay = Yolo2OutputLayer(anchors=((1.0, 1.0),))
+        x = np.full((2, 3, 3, 1 * (5 + 2)), -8.0, np.float32)
+        dets = YoloUtils.getPredictedObjects(lay, x, conf_threshold=0.5)
+        assert [len(d) for d in dets] == [0, 0]
