@@ -653,6 +653,13 @@ class SameDiff:
         return _fit(self, data, epochs=epochs,
                     validation_data=validation_data, listeners=listeners)
 
+    def evaluate(self, iterator, output_name: str, evaluation=None):
+        """Reference: SameDiff#evaluate(DataSetIterator, outputVariable,
+        Evaluation)."""
+        from deeplearning4j_tpu.autodiff.training import evaluate as _ev
+
+        return _ev(self, iterator, output_name, evaluation)
+
     # --------------------------------------------------------------- serde
     def save(self, path, save_updater_state: bool = True) -> None:
         from deeplearning4j_tpu.autodiff.serde import save

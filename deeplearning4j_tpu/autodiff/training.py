@@ -42,12 +42,17 @@ class History:
     def __init__(self):
         self.loss_curve: List[float] = []
         self.epoch_losses: List[float] = []
+        self.validation_losses: List[float] = []  # one per epoch
 
     def lossCurve(self) -> List[float]:
         return self.loss_curve
 
     def finalTrainingLoss(self) -> float:
         return self.loss_curve[-1] if self.loss_curve else float("nan")
+
+    def finalValidationLoss(self) -> float:
+        return self.validation_losses[-1] if self.validation_losses \
+            else float("nan")
 
 
 def _build_train_step(sd, cfg: TrainingConfig, feed_sig):
@@ -86,6 +91,21 @@ def _build_train_step(sd, cfg: TrainingConfig, feed_sig):
     return jax.jit(step, donate_argnums=(0, 2))
 
 
+def _ds_feeds(cfg: TrainingConfig, ds, include_labels: bool = True):
+    """DataSet -> placeholder feeds per the TrainingConfig mappings."""
+    feeds = {}
+    feats = ds.features if isinstance(ds.features, (list, tuple)) \
+        else [ds.features]
+    for name, arr in zip(cfg.data_set_feature_mapping, feats):
+        feeds[name] = jnp.asarray(_unwrap(arr))
+    if include_labels:
+        labs = ds.labels if isinstance(ds.labels, (list, tuple)) \
+            else [ds.labels]
+        for name, arr in zip(cfg.data_set_label_mapping, labs):
+            feeds[name] = jnp.asarray(_unwrap(arr))
+    return feeds
+
+
 def fit(sd, data, epochs: int = 1, validation_data=None,
         listeners: Sequence[Any] = ()) -> History:
     """Reference: SameDiff#fit(DataSetIterator, epochs)."""
@@ -112,20 +132,37 @@ def fit(sd, data, epochs: int = 1, validation_data=None,
         wrt = {n: sd._arrays[n] for n in sd.trainable_names()}
         sd._updater_state = cfg.updater.init_state(wrt)
 
+    # one-shot iterables are materialized ONCE, like fit() does for
+    # `data` — otherwise epoch 2+ would silently see zero batches
+    if validation_data is None:
+        val_batches = None
+    elif isinstance(validation_data, DataSet):
+        val_batches = [validation_data]
+    elif isinstance(validation_data, DataSetIterator):
+        val_batches = validation_data  # resettable via __iter__
+    else:
+        val_batches = list(validation_data)
+
+    def _validation_loss():
+        """Mean loss over validation_data with params FIXED (reference:
+        History.validationLoss per epoch)."""
+        if val_batches is None:
+            return None
+        total, nb = 0.0, 0
+        loss_names = tuple(sd._loss_variables)
+        for ds in val_batches:
+            outs = sd.output(_ds_feeds(cfg, ds), list(loss_names))
+            total += float(sum(jnp.sum(outs[n]) for n in loss_names))
+            nb += 1
+        if nb == 0:
+            raise ValueError("validation_data produced no batches")
+        return total / nb
+
     step_cache: Dict[Any, Any] = {}
     for _ in range(epochs):
         epoch_loss, nb = 0.0, 0
         for ds in iterate():
-            feeds = {}
-            feats = ds.features if isinstance(ds.features, (list, tuple)) \
-                else [ds.features]
-            labs = ds.labels if isinstance(ds.labels, (list, tuple)) \
-                else [ds.labels]
-            for name, arr in zip(cfg.data_set_feature_mapping, feats):
-                feeds[name] = jnp.asarray(_unwrap(arr))
-            for name, arr in zip(cfg.data_set_label_mapping, labs):
-                feeds[name] = jnp.asarray(_unwrap(arr))
-
+            feeds = _ds_feeds(cfg, ds)
             sig = sd._feed_key(feeds)
             if sig not in step_cache:
                 step_cache[sig] = _build_train_step(sd, cfg, sig)
@@ -146,4 +183,24 @@ def fit(sd, data, epochs: int = 1, validation_data=None,
                     lst.iterationDone(sd, sd._iteration, sd._epoch)
         sd._epoch += 1
         history.epoch_losses.append(epoch_loss / max(nb, 1))
+        vl = _validation_loss()
+        if vl is not None:
+            history.validation_losses.append(vl)
     return history
+
+
+def evaluate(sd, iterator, output_name: str, evaluation=None):
+    """Reference: SameDiff#evaluate(DataSetIterator, outputVariable,
+    Evaluation) — run inference over the iterator, accumulate into the
+    evaluation object."""
+    from deeplearning4j_tpu.evaluation import Evaluation
+
+    cfg = sd.training_config
+    if cfg is None or not cfg.data_set_feature_mapping:
+        raise ValueError("setTrainingConfig() with feature mappings first")
+    ev = evaluation if evaluation is not None else Evaluation()
+    for ds in iterator:
+        feeds = _ds_feeds(cfg, ds, include_labels=False)
+        out = sd.output(feeds, [output_name])[output_name]
+        ev.eval(ds.labels, out, mask=ds.labels_mask)
+    return ev

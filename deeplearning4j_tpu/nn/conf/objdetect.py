@@ -139,6 +139,23 @@ class Yolo2OutputLayer(LossLayer):
 __all__ = ["Yolo2OutputLayer", "DetectedObject", "YoloUtils"]
 
 
+from functools import lru_cache
+
+
+@lru_cache(maxsize=32)
+def _batched_nms(max_objects: int, iou_threshold: float,
+                 score_threshold: float):
+    """Cached jitted vmap of NMS — rebuilding jit(vmap(partial(...)))
+    per call would recompile every invocation."""
+    from functools import partial
+
+    from deeplearning4j_tpu.ops.image import non_max_suppression
+
+    return jax.jit(jax.vmap(partial(
+        non_max_suppression, max_output_size=max_objects,
+        iou_threshold=iou_threshold, score_threshold=score_threshold)))
+
+
 class DetectedObject:
     """One decoded detection (reference:
     org/deeplearning4j/nn/layers/objdetect/DetectedObject). Coordinates
@@ -206,11 +223,7 @@ class YoloUtils:
         ``DetectedObject.confidence`` are the objectness score, not
         objectness*classProb) -> greedy per-image NMS, batched through
         one jitted vmap of the XLA-safe non_max_suppression op."""
-        from functools import partial
-
         import numpy as np
-
-        from deeplearning4j_tpu.ops.image import non_max_suppression
 
         x = jnp.asarray(network_output)
         n, h, w, d = x.shape
@@ -232,9 +245,7 @@ class YoloUtils:
                            xyf[..., 1] + whf[..., 1] / 2,   # y2
                            xyf[..., 0] + whf[..., 0] / 2],  # x2
                           axis=-1)                           # [N,HWB,4]
-        nms = jax.jit(jax.vmap(partial(
-            non_max_suppression, max_output_size=max_objects,
-            iou_threshold=nms_threshold, score_threshold=conf_threshold)))
+        nms = _batched_nms(max_objects, nms_threshold, conf_threshold)
         sels, counts = nms(boxes, scf)
 
         xy_n, wh_n = np.asarray(xyf), np.asarray(whf)

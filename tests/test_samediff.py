@@ -213,3 +213,55 @@ class TestExport:
         txt = sd.to_stablehlo({"x": np.ones((2, 2), np.float32)}, ["y"])
         assert "stablehlo" in txt or "mhlo" in txt or "func.func" in txt
         assert "dot_general" in txt
+
+
+class TestValidationAndEvaluate:
+    """reference: SameDiff#fit validation history + #evaluate."""
+
+    def _classifier_sd(self):
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.autodiff import SameDiff, TrainingConfig
+        from deeplearning4j_tpu.learning.updaters import Adam
+        rng = np.random.default_rng(0)
+        sd = SameDiff.create()
+        x = sd.placeholder("x", shape=(None, 4))
+        w = sd.var("w", rng.normal(0, 0.3, (4, 2)).astype(np.float32))
+        b = sd.var("b", np.zeros(2, np.float32))
+        logits = x @ w + b
+        probs = sd.nn.softmax(logits)
+        y = sd.placeholder("y", shape=(None, 2))
+        # CE loss
+        logp = sd.nn.log_softmax(logits)
+        loss = -(y * logp).sum(-1).mean()
+        sd.setLossVariables(loss.name)
+        sd.setTrainingConfig(TrainingConfig(
+            updater=Adam(0.05), data_set_feature_mapping=["x"],
+            data_set_label_mapping=["y"]))
+        return sd, probs
+
+    def test_validation_losses_tracked(self):
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        sd, _ = self._classifier_sd()
+        rng = np.random.default_rng(1)
+        x = rng.normal(size=(64, 4)).astype(np.float32)
+        lab = (x[:, 0] > 0).astype(int)
+        y = np.eye(2, dtype=np.float32)[lab]
+        xv = rng.normal(size=(32, 4)).astype(np.float32)
+        yv = np.eye(2, dtype=np.float32)[(xv[:, 0] > 0).astype(int)]
+        hist = sd.fit(DataSet(x, y), epochs=15,
+                      validation_data=DataSet(xv, yv))
+        assert len(hist.validation_losses) == 15
+        assert hist.validation_losses[-1] < hist.validation_losses[0]
+        assert np.isfinite(hist.finalValidationLoss())
+
+    def test_evaluate_api(self):
+        from deeplearning4j_tpu.datasets import ArrayDataSetIterator
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        sd, probs = self._classifier_sd()
+        rng = np.random.default_rng(2)
+        x = rng.normal(size=(64, 4)).astype(np.float32)
+        lab = (x[:, 0] > 0).astype(int)
+        y = np.eye(2, dtype=np.float32)[lab]
+        sd.fit(DataSet(x, y), epochs=30)
+        ev = sd.evaluate(ArrayDataSetIterator(x, y, 16), probs.name)
+        assert ev.accuracy() > 0.9
