@@ -15,6 +15,7 @@ from typing import Any, Dict, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from deeplearning4j_tpu.common.serde import serializable
 from deeplearning4j_tpu.learning.updaters import IUpdater, Sgd, apply_updater
@@ -144,19 +145,26 @@ def fit(sd, data, epochs: int = 1, validation_data=None,
         val_batches = list(validation_data)
 
     def _validation_loss():
-        """Mean loss over validation_data with params FIXED (reference:
-        History.validationLoss per epoch)."""
+        """Example-weighted mean loss over validation_data with params
+        FIXED (reference: History.validationLoss per epoch). Matches the
+        training curve's sign convention under minimize=False."""
         if val_batches is None:
             return None
-        total, nb = 0.0, 0
+        total, n_ex = 0.0, 0
         loss_names = tuple(sd._loss_variables)
         for ds in val_batches:
+            feats = ds.features if not isinstance(ds.features,
+                                                  (list, tuple)) \
+                else ds.features[0]
+            n = int(np.asarray(feats).shape[0])
             outs = sd.output(_ds_feeds(cfg, ds), list(loss_names))
-            total += float(sum(jnp.sum(outs[n]) for n in loss_names))
-            nb += 1
-        if nb == 0:
+            batch_loss = float(sum(jnp.sum(outs[nm]) for nm in loss_names))
+            total += n * batch_loss
+            n_ex += n
+        if n_ex == 0:
             raise ValueError("validation_data produced no batches")
-        return total / nb
+        v = total / n_ex
+        return v if cfg.minimize else -v
 
     step_cache: Dict[Any, Any] = {}
     for _ in range(epochs):
@@ -195,10 +203,14 @@ def evaluate(sd, iterator, output_name: str, evaluation=None):
     evaluation object."""
     from deeplearning4j_tpu.evaluation import Evaluation
 
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+
     cfg = sd.training_config
     if cfg is None or not cfg.data_set_feature_mapping:
         raise ValueError("setTrainingConfig() with feature mappings first")
     ev = evaluation if evaluation is not None else Evaluation()
+    if isinstance(iterator, DataSet):
+        iterator = [iterator]
     for ds in iterator:
         feeds = _ds_feeds(cfg, ds, include_labels=False)
         out = sd.output(feeds, [output_name])[output_name]

@@ -14,7 +14,15 @@ import re
 
 import pytest
 
-import deeplearning4j_tpu.ops  # noqa: F401 — populate the registry
+# populate the FULL registry deterministically — some ops register on
+# import of the autodiff/importer modules, and the gate must not depend
+# on which other test files ran first in the session
+import deeplearning4j_tpu.ops  # noqa: F401
+import deeplearning4j_tpu.autodiff.ops_math  # noqa: F401
+import deeplearning4j_tpu.autodiff.control_flow  # noqa: F401
+import deeplearning4j_tpu.ops.flash_attention  # noqa: F401
+import deeplearning4j_tpu.modelimport.onnx.onnx_import  # noqa: F401
+import deeplearning4j_tpu.modelimport.tensorflow.tf_import  # noqa: F401
 from deeplearning4j_tpu.ops.registry import list_ops
 
 TESTS_DIR = os.path.dirname(os.path.abspath(__file__))

@@ -385,3 +385,34 @@ def test_op(op_name):
         assert check(out), f"{op_name}: check failed"
     if golden is None and check is None:
         raise AssertionError(f"{op_name}: no golden and no check")
+
+
+def test_importer_internal_ops():
+    """Ops registered by the TF/ONNX importers + autodiff modules (their
+    registration happens on importer module import; exercised directly
+    here so the coverage gate stays deterministic): tf_fill,
+    tf_strided_slice, onnx_reshape, onnx_flatten, onnx_slice, erfc,
+    flash_attention (the Pallas/blockwise dispatcher has its own suite,
+    tests/test_flash_attention.py)."""
+    import deeplearning4j_tpu.modelimport.onnx.onnx_import  # noqa: F401
+    import deeplearning4j_tpu.modelimport.tensorflow.tf_import  # noqa
+    import deeplearning4j_tpu.autodiff.ops_math  # noqa: F401
+
+    fill = get_op("tf_fill")
+    out = fill(shape=(2, 3), value=7.0)
+    assert npx(out).shape == (2, 3) and np.all(npx(out) == 7.0)
+
+    ss = get_op("tf_strided_slice")
+    out = ss(X, begin=[1, 0], end=[3, 4], strides=[1, 2])
+    np.testing.assert_allclose(npx(out), npx(X)[1:3, 0:4:2])
+
+    r = get_op("onnx_reshape")(X, jnp.asarray([6, 4]))
+    assert npx(r).shape == (6, 4)
+    f = get_op("onnx_flatten")(jnp.ones((2, 3, 4)), axis=1)
+    assert npx(f).shape == (2, 12)
+    s = get_op("onnx_slice")(X, starts=[0], ends=[2], axes=[0], steps=[1])
+    assert npx(s).shape == (2, 6)
+
+    import scipy.special as sp
+    e = get_op("erfc")(X)
+    np.testing.assert_allclose(npx(e), sp.erfc(npx(X)), atol=1e-5)
