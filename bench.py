@@ -114,12 +114,23 @@ def main() -> None:
     except (OSError, ValueError):
         pass
 
-    print(json.dumps({
+    line = {
         "metric": f"bert_{'base' if on_accel else 'tiny_cpu'}_mlm_train",
         "value": round(tokens_per_sec, 2),
         "unit": "tokens/sec/chip",
         "vs_baseline": round(vs_baseline, 4),
-    }))
+    }
+    # honest MFU estimate (train FLOPs/token derived from the config:
+    # fwd per layer/token = 24*d^2 (matmuls) + 4*T*d (attention),
+    # bwd = 2x fwd; + the masked-capacity MLM head projection).
+    peak = {"TPU v5 lite": 197e12}.get(jax.devices()[0].device_kind)
+    if on_accel and peak:
+        d, t, L = cfg.d_model, seqlen, cfg.n_layers
+        fwd_tok = L * (24 * d * d + 4 * t * d)
+        head_tok = (MASKED_CAPACITY / seqlen) * 2 * d * cfg.vocab_size
+        flops_tok = 3 * fwd_tok + 3 * head_tok
+        line["mfu_est"] = round(tokens_per_sec * flops_tok / peak, 4)
+    print(json.dumps(line))
 
 
 if __name__ == "__main__":

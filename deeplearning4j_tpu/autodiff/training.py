@@ -153,13 +153,20 @@ def fit(sd, data, epochs: int = 1, validation_data=None,
         total, n_ex = 0.0, 0
         loss_names = tuple(sd._loss_variables)
         for ds in val_batches:
-            feats = ds.features if not isinstance(ds.features,
-                                                  (list, tuple)) \
-                else ds.features[0]
-            n = int(np.asarray(feats).shape[0])
+            n = (ds.numExamples() if hasattr(ds, "numExamples") else
+                 (ds.features[0] if isinstance(ds.features, (list, tuple))
+                  else ds.features).shape[0])
+            n = int(n)
             outs = sd.output(_ds_feeds(cfg, ds), list(loss_names))
-            batch_loss = float(sum(jnp.sum(outs[nm]) for nm in loss_names))
-            total += n * batch_loss
+            for nm in loss_names:
+                v = outs[nm]
+                # scalar loss: assumed example-MEAN (the standard .mean()
+                # objective) -> weight by n; non-scalar: per-example
+                # values -> their sum is already example-weighted
+                if getattr(v, "ndim", 0) == 0:
+                    total += n * float(v)
+                else:
+                    total += float(jnp.sum(v))
             n_ex += n
         if n_ex == 0:
             raise ValueError("validation_data produced no batches")
