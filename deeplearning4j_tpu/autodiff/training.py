@@ -153,10 +153,10 @@ def fit(sd, data, epochs: int = 1, validation_data=None,
         total, n_ex = 0.0, 0
         loss_names = tuple(sd._loss_variables)
         for ds in val_batches:
-            n = (ds.numExamples() if hasattr(ds, "numExamples") else
-                 (ds.features[0] if isinstance(ds.features, (list, tuple))
-                  else ds.features).shape[0])
-            n = int(n)
+            feats = ds.features[0] if isinstance(ds.features,
+                                                 (list, tuple)) \
+                else ds.features
+            n = int(_unwrap(feats).shape[0])
             outs = sd.output(_ds_feeds(cfg, ds), list(loss_names))
             for nm in loss_names:
                 v = outs[nm]

@@ -1,0 +1,86 @@
+"""BERT sequence classification — the fine-tuning recipe.
+
+Reference workflow: import/pretrain BERT (SameDiff TF-import path,
+SURVEY.md §3.4), then fine-tune with a pooled classification head — the
+GLUE-style task every reference BERT user runs next.
+
+TPU design: reuses TransformerEncoder (same tp/sp shardings); the head
+is first-token ("[CLS]") pooling -> tanh dense -> n_classes logits, and
+the WHOLE fine-tune step (encoder fwd+bwd, head, updater) is one XLA
+executable.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.models.transformer import (
+    TransformerConfig, TransformerEncoder,
+)
+
+
+class BertSequenceClassifier:
+    def __init__(self, config: TransformerConfig, n_classes: int,
+                 attn_impl: str = "default"):
+        self.encoder = TransformerEncoder(config, attn_impl=attn_impl)
+        self.cfg = config
+        self.n_classes = n_classes
+
+    # -- params ---------------------------------------------------------
+    def init_params(self, key=None,
+                    encoder_params: Optional[Dict[str, Any]] = None):
+        """Fresh head; encoder params either fresh or transplanted from
+        a pretrained/imported encoder (the transfer-learning path)."""
+        key = key if key is not None else jax.random.key(0)
+        k_enc, k_pool, k_cls = jax.random.split(key, 3)
+        d = self.cfg.d_model
+        enc = encoder_params if encoder_params is not None \
+            else self.encoder.init_params(k_enc)
+        pdt = self.encoder._pdtype
+        params = dict(enc)
+        params["pooler"] = {
+            "W": 0.02 * jax.random.normal(k_pool, (d, d), pdt),
+            "b": jnp.zeros((d,), pdt),
+        }
+        params["classifier"] = {
+            "W": 0.02 * jax.random.normal(k_cls, (d, self.n_classes), pdt),
+            "b": jnp.zeros((self.n_classes,), pdt),
+        }
+        return params
+
+    # -- forward --------------------------------------------------------
+    def logits(self, params, ids, mask=None, train=False, rng=None):
+        cd = self.encoder._cdtype
+        hidden = self.encoder.encode(params, ids, mask=mask, train=train,
+                                     rng=rng)
+        cls = hidden[:, 0]                      # [N, D] first-token pool
+        pooled = jnp.tanh(cls @ params["pooler"]["W"].astype(cd)
+                          + params["pooler"]["b"].astype(cd))
+        out = pooled @ params["classifier"]["W"].astype(cd) \
+            + params["classifier"]["b"].astype(cd)
+        return out.astype(jnp.float32)
+
+    def loss(self, params, ids, labels, mask=None, train=True, rng=None):
+        lg = self.logits(params, ids, mask=mask, train=train, rng=rng)
+        logp = jax.nn.log_softmax(lg)
+        onehot = jax.nn.one_hot(labels, self.n_classes, dtype=logp.dtype)
+        return -(onehot * logp).sum(-1).mean()
+
+    # -- compiled fine-tune step ---------------------------------------
+    def make_train_step(self, updater):
+        apply_updates = TransformerEncoder._apply_updates
+
+        def step(params, opt_state, it_step, ids, labels, mask, rng):
+            loss, grads = jax.value_and_grad(self.loss)(
+                params, ids, labels, mask=mask, train=True, rng=rng)
+            new_params, new_opt = apply_updates(updater, params, opt_state,
+                                                grads, it_step)
+            return new_params, new_opt, loss
+
+        return jax.jit(step, donate_argnums=(0, 1))
+
+    def predict(self, params, ids, mask=None):
+        return jnp.argmax(self.logits(params, ids, mask=mask), axis=-1)

@@ -1,0 +1,121 @@
+"""Golden tests vs torch CPU for the fused recurrent kernels and core
+convs (reference analog: backend-parity suites — same math, independent
+implementation, SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.ops import nn as nnops
+
+
+class TestLstmGolden:
+    def test_lstm_matches_torch(self):
+        rng = np.random.default_rng(0)
+        n, t, d_in, h = 3, 7, 5, 4
+        x = rng.normal(size=(n, t, d_in)).astype(np.float32)
+
+        tl = torch.nn.LSTM(d_in, h, batch_first=True)
+        with torch.no_grad():
+            ref, (hT, cT) = tl(torch.from_numpy(x))
+
+        # torch gate order i,f,g,o == ours; torch stores [4h, in] row-major
+        w_ih = tl.weight_ih_l0.detach().numpy().T        # [in, 4h]
+        w_hh = tl.weight_hh_l0.detach().numpy().T        # [h, 4h]
+        b = (tl.bias_ih_l0 + tl.bias_hh_l0).detach().numpy()
+        ys, (h_last, c_last) = nnops.lstm_layer(
+            jnp.asarray(x), jnp.asarray(w_ih), jnp.asarray(w_hh),
+            jnp.asarray(b))
+        np.testing.assert_allclose(np.asarray(ys), ref.numpy(),
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(h_last), hT[0].numpy(),
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(c_last), cT[0].numpy(),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_gru_matches_torch(self):
+        rng = np.random.default_rng(1)
+        n, t, d_in, h = 2, 6, 4, 5
+        x = rng.normal(size=(n, t, d_in)).astype(np.float32)
+
+        tg = torch.nn.GRU(d_in, h, batch_first=True)
+        with torch.no_grad():
+            ref, hT = tg(torch.from_numpy(x))
+
+        # torch GRU gate order: r,z,n == ours; reset-after semantics
+        # (torch applies r to (h@W_hn + b_hn)) == our rb path
+        w_ih = tg.weight_ih_l0.detach().numpy().T
+        w_hh = tg.weight_hh_l0.detach().numpy().T
+        b_ih = tg.bias_ih_l0.detach().numpy()
+        b_hh = tg.bias_hh_l0.detach().numpy()
+        ys, h_last = nnops.gru_layer(
+            jnp.asarray(x), jnp.asarray(w_ih), jnp.asarray(w_hh),
+            jnp.asarray(b_ih), rb=jnp.asarray(b_hh))
+        np.testing.assert_allclose(np.asarray(ys), ref.numpy(),
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(h_last), hT[0].numpy(),
+                                   rtol=1e-4, atol=1e-5)
+
+
+class TestConvGolden:
+    def test_conv2d_matches_torch(self):
+        rng = np.random.default_rng(2)
+        x = rng.normal(size=(2, 9, 9, 3)).astype(np.float32)   # NHWC
+        w = rng.normal(size=(3, 3, 3, 5)).astype(np.float32)   # HWIO
+        b = rng.normal(size=(5,)).astype(np.float32)
+        out = nnops.conv2d(jnp.asarray(x), jnp.asarray(w), jnp.asarray(b),
+                           strides=(2, 2), padding=(1, 1))
+        tx = torch.from_numpy(x.transpose(0, 3, 1, 2))         # NCHW
+        tw = torch.from_numpy(w.transpose(3, 2, 0, 1))         # OIHW
+        with torch.no_grad():
+            ref = torch.nn.functional.conv2d(
+                tx, tw, torch.from_numpy(b), stride=2, padding=1)
+        np.testing.assert_allclose(np.asarray(out),
+                                   ref.numpy().transpose(0, 2, 3, 1),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_conv1d_dilated_matches_torch(self):
+        rng = np.random.default_rng(3)
+        x = rng.normal(size=(2, 12, 4)).astype(np.float32)     # NWC
+        w = rng.normal(size=(3, 4, 6)).astype(np.float32)      # WIO
+        out = nnops.conv1d(jnp.asarray(x), jnp.asarray(w), None,
+                           stride=1, padding=0, dilation=2)
+        tx = torch.from_numpy(x.transpose(0, 2, 1))            # NCW
+        tw = torch.from_numpy(w.transpose(2, 1, 0))            # OIW
+        with torch.no_grad():
+            ref = torch.nn.functional.conv1d(tx, tw, dilation=2)
+        np.testing.assert_allclose(np.asarray(out),
+                                   ref.numpy().transpose(0, 2, 1),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_conv3d_matches_torch(self):
+        rng = np.random.default_rng(4)
+        x = rng.normal(size=(1, 6, 6, 6, 2)).astype(np.float32)  # NDHWC
+        w = rng.normal(size=(3, 3, 3, 2, 4)).astype(np.float32)  # DHWIO
+        out = nnops.conv3d(jnp.asarray(x), jnp.asarray(w), None,
+                           strides=(1, 1, 1), padding=(1, 1, 1))
+        tx = torch.from_numpy(x.transpose(0, 4, 1, 2, 3))
+        tw = torch.from_numpy(w.transpose(4, 3, 0, 1, 2))
+        with torch.no_grad():
+            ref = torch.nn.functional.conv3d(tx, tw, padding=1)
+        np.testing.assert_allclose(np.asarray(out),
+                                   ref.numpy().transpose(0, 2, 3, 4, 1),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_batch_norm_train_matches_torch(self):
+        rng = np.random.default_rng(5)
+        x = rng.normal(size=(4, 8, 8, 3)).astype(np.float32)
+        g = rng.normal(size=(3,)).astype(np.float32)
+        b = rng.normal(size=(3,)).astype(np.float32)
+        y, m, v = nnops.batch_norm_train(jnp.asarray(x), jnp.asarray(g),
+                                         jnp.asarray(b), 1e-5)
+        tbn = torch.nn.functional.batch_norm(
+            torch.from_numpy(x.transpose(0, 3, 1, 2)), None, None,
+            torch.from_numpy(g), torch.from_numpy(b), training=True,
+            eps=1e-5)
+        np.testing.assert_allclose(np.asarray(y),
+                                   tbn.numpy().transpose(0, 2, 3, 1),
+                                   rtol=1e-3, atol=1e-4)
