@@ -1,0 +1,36 @@
+"""Smoke-run every example (reference analog: dl4j-examples CI)."""
+
+import importlib.util
+import os
+import sys
+
+import pytest
+
+EXAMPLES = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "examples")
+
+
+def _run(name):
+    path = os.path.join(EXAMPLES, name)
+    spec = importlib.util.spec_from_file_location(name[:-3], path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class TestExamples:
+    def test_lenet_mnist(self):
+        acc = _run("lenet_mnist.py").main(epochs=2)
+        assert acc > 0.8  # synthetic stand-in is trivially separable
+
+    def test_bert_finetune(self):
+        acc = _run("bert_finetune.py").main(steps=40)
+        assert acc > 0.7
+
+    def test_word2vec_text_cnn(self):
+        p = _run("word2vec_text_cnn.py").main()
+        assert p > 0.5
+
+    def test_data_parallel(self):
+        acc = _run("data_parallel_training.py").main()
+        assert acc > 0.9
