@@ -17,7 +17,7 @@ from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
 from deeplearning4j_tpu.util.model_serializer import ModelSerializer
 
 
-def data(batch=64, n=512):
+def data(batch=64, n=4096):
     try:
         return (MnistDataSetIterator(batch, train=True, num_examples=n),
                 MnistDataSetIterator(batch, train=False, num_examples=n))
