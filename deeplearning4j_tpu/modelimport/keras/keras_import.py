@@ -507,6 +507,32 @@ def _assign_params(layer, params: dict, state: dict,
         if "bias" in kw:
             put(params, "b", kw["bias"])
         return
+    if isinstance(layer, (LocallyConnected1D, LocallyConnected2D)):
+        # Keras flattens each patch feature-axis as (kH,kW,C) row-major;
+        # our locally_connected* ops consume conv_general_dilated_patches
+        # output, which is channel-major (C,kH,kW). Permute the middle
+        # axis accordingly (verified vs a numpy Keras-semantics model in
+        # tests/test_keras_import.py::test_locally_connected_*).
+        if "kernel" in kw:
+            k = kw["kernel"]
+            if isinstance(layer, LocallyConnected2D):
+                kh, kkw = layer.kernel_size
+            else:
+                kh, kkw = layer.kernel_size, 1
+            p, kc, f = k.shape
+            c_in = kc // (kh * kkw)
+            k = (k.reshape(p, kh * kkw, c_in, f)
+                 .transpose(0, 2, 1, 3).reshape(p, kc, f))
+            put(params, "W", k)
+        if "bias" in kw:
+            b = kw["bias"]
+            # Keras LC bias is per-position ((oh,ow,f) / (oT,f)) and so
+            # is ours; a trained file may still carry a flat (f,) bias
+            # (use_bias with implementation quirks) — broadcast it.
+            if "b" in params and tuple(params["b"].shape) != tuple(b.shape):
+                b = np.broadcast_to(b, params["b"].shape)
+            put(params, "b", b)
+        return
     if isinstance(layer, PReLULayer):
         if "alpha" in kw:
             a = kw["alpha"]

@@ -253,7 +253,7 @@ class Deconvolution2D(ConvolutionLayer):
 
     def apply(self, params, state, x, train, rng):
         x = self._maybe_dropout(x, train, rng)
-        pad = "SAME" if self.convolution_mode == "Same" else self.padding[0]
+        pad = "SAME" if self.convolution_mode == "Same" else self.padding
         out = nnops.deconv2d(x, params["W"], params.get("b"),
                              strides=self.stride, padding=pad)
         return _act(self.activation or "identity").fn(out), state
@@ -571,7 +571,8 @@ class LocallyConnected2D(Layer):
                          (oh * ow, kc, self.n_out), kc, self.n_out, dtype)
         p = {"W": w}
         if self.has_bias:
-            p["b"] = jnp.zeros((self.n_out,), dtype)
+            # per-position bias, matching Keras LocallyConnected2D
+            p["b"] = jnp.zeros((oh, ow, self.n_out), dtype)
         return p
 
     def apply(self, params, state, x, train, rng):
@@ -607,7 +608,8 @@ class LocallyConnected1D(Layer):
                          (ot, kc, self.n_out), kc, self.n_out, dtype)
         p = {"W": w}
         if self.has_bias:
-            p["b"] = jnp.zeros((self.n_out,), dtype)
+            # per-position bias, matching Keras LocallyConnected1D
+            p["b"] = jnp.zeros((ot, self.n_out), dtype)
         return p
 
     def apply(self, params, state, x, train, rng):

@@ -147,8 +147,19 @@ class ComputationGraph:
             if fmask is not None and node.name in masked_branch \
                     and isinstance(v, LayerVertex) \
                     and isinstance(v.layer, GlobalPoolingLayer) \
-                    and xs[0].ndim == 3 \
-                    and xs[0].shape[1] == fmask.shape[1]:
+                    and xs[0].ndim == 3:
+                if xs[0].shape[1] != fmask.shape[1]:
+                    # An upstream layer changed the time axis (strided
+                    # Conv1D/Subsampling1D): the mask no longer lines up
+                    # and unmasked pooling would silently average padded
+                    # zeros into the result.
+                    raise ValueError(
+                        f"GlobalPoolingLayer {node.name!r}: features mask "
+                        f"has {fmask.shape[1]} timesteps but the pooling "
+                        f"input has {xs[0].shape[1]} — an upstream layer "
+                        "changed the sequence length. Downsample/supply a "
+                        "mask matching the pooled sequence length "
+                        "(reference: MaskedReductionUtil).")
                 out, ns = v.layer.apply_masked(
                     params_map[node.name], states_map[node.name], xs[0],
                     fmask, train, keys[i])

@@ -133,12 +133,25 @@ def separable_conv2d(x, depth_w, point_w, b=None, strides=(1, 1),
 
 @register_op("deconv2d")
 def deconv2d(x, w, b=None, strides=(2, 2), padding="SAME"):
-    """Transposed conv (reference: deconv2d.cpp). w: [kH,kW,C_in,C_out]."""
+    """Transposed conv (reference: deconv2d.cpp). w: [kH,kW,C_in,C_out].
+
+    ``padding``: 'SAME'/'VALID', an int applied to both spatial dims, or
+    a per-dim (pH, pW) pair. Integer padding follows reference deconv
+    semantics out = s*(in-1) + k - 2p (the gradient of a forward conv
+    padded by p), which for ``lax.conv_transpose`` — whose integer
+    padding pads the stride-dilated input directly — means low = high =
+    k - 1 - p per spatial dim.
+    """
+    if isinstance(padding, str):
+        pad = padding.upper()
+    else:
+        pad = [(k - 1 - p, k - 1 - p)
+               for k, p in zip(w.shape[:2], _pair(padding))]
     out = lax.conv_transpose(
         x,
         w,
         strides=_pair(strides),
-        padding=padding.upper() if isinstance(padding, str) else [(padding, padding)] * 2,
+        padding=pad,
         dimension_numbers=("NHWC", "HWIO", "NHWC"),
     )
     if b is not None:
@@ -156,7 +169,9 @@ def upsampling2d(x, scale=2):
 def im2col(x, kernel, strides=(1, 1), padding="VALID"):
     """Patch extraction (reference: im2col in libnd4j helpers).
 
-    Returns [N, outH, outW, kH*kW*C].
+    Returns [N, outH, outW, C*kH*kW]. NOTE: the feature axis is
+    CHANNEL-MAJOR — ordered (C, kH, kW), as produced by
+    ``lax.conv_general_dilated_patches`` — not Keras's (kH, kW, C).
     """
     kh, kw = _pair(kernel)
     patches = lax.conv_general_dilated_patches(
@@ -311,8 +326,10 @@ def locally_connected2d(x, w, b=None, kernel=(2, 2), strides=(1, 1),
                         padding="VALID"):
     """Unshared-weight conv (reference: LocallyConnected2D samediff layer).
 
-    x: [N,H,W,C]; w: [outH*outW, kH*kW*C, C_out] — one filter bank per
-    output position. im2col + batched einsum keeps it on the MXU.
+    x: [N,H,W,C]; w: [outH*outW, C*kH*kW, C_out] — one filter bank per
+    output position, with the patch axis CHANNEL-MAJOR (C, kH, kW) to
+    match :func:`im2col` (NOT Keras's (kH, kW, C) order — permute when
+    converting Keras weights). im2col + batched einsum stays on the MXU.
     """
     patches = im2col(x, kernel, strides, padding)      # [N,oh,ow,kH*kW*C]
     n, oh, ow, kc = patches.shape
@@ -327,7 +344,8 @@ def locally_connected2d(x, w, b=None, kernel=(2, 2), strides=(1, 1),
 def locally_connected1d(x, w, b=None, kernel=2, stride=1, padding="VALID"):
     """1D unshared conv (reference: LocallyConnected1D samediff layer).
 
-    x: [N,T,F]; w: [outT, k*F, C_out].
+    x: [N,T,F]; w: [outT, F*k, C_out] — patch axis channel-major (F, k),
+    as produced by ``lax.conv_general_dilated_patches``.
     """
     patches = lax.conv_general_dilated_patches(
         x, (kernel,), (stride,),
@@ -359,30 +377,42 @@ def batch_norm(x, gamma, beta, mean, var, eps=1e-5):
     return (x - mean) * inv * gamma + beta
 
 
+def _bn_stats(x, axes):
+    """One-pass f32 batch statistics over ``axes`` -> (mean, var).
+
+    sum(d) and sum(d*d) fuse into a single read of x (jnp.var's two-pass
+    formulation re-reads the activation after the mean — BN stat passes
+    dominate ResNet step time on TPU, so halving the reads matters).
+    The per-channel shift (first sample) keeps E[d^2]-E[d]^2 free of
+    catastrophic cancellation when the activation mean is large relative
+    to its spread.
+    """
+    xf = x.astype(jnp.float32)
+    shift = lax.stop_gradient(xf[tuple(0 for _ in axes)])
+    d = xf - shift
+    md = jnp.mean(d, axis=axes)
+    v = jnp.mean(d * d, axis=axes) - md * md
+    return shift + md, jnp.maximum(v, 0.0)
+
+
 @register_op("batch_norm_train")
 def batch_norm_train(x, gamma, beta, eps=1e-5, axes=None):
     """Training-mode batchnorm. Returns (y, batch_mean, batch_var).
 
     axes: reduction axes; defaults to all but the last (channel) axis.
+    Backward is XLA autodiff of the one-pass stats formulation. Two
+    hand-written fused VJPs (canonical cuDNN-style BN backward, both
+    with minimal bf16 residuals) were A/B-measured in-process against
+    autodiff on the v5e ResNet-50 train step and BOTH lost by ~4-5%
+    (2030-2050 vs 2140-2150 img/s) — XLA schedules the autodiff
+    backward better than the hand formulation, so it stays.
     """
     if axes is None:
         axes = tuple(range(x.ndim - 1))
-    # one-pass statistics: sum(d) and sum(d*d) fuse into a single read of
-    # x (jnp.var's two-pass formulation re-reads the activation after the
-    # mean — BN stat passes dominate ResNet step time on TPU, so halving
-    # the reads matters). Accumulate in f32; the per-channel shift (first
-    # sample) keeps E[d^2]-E[d]^2 free of catastrophic cancellation when
-    # the activation mean is large relative to its spread.
-    xf = x.astype(jnp.float32)
-    shift = lax.stop_gradient(xf[tuple(0 for _ in axes)])  # one sample/channel
-    d = xf - shift
-    md = jnp.mean(d, axis=axes)
-    v = jnp.mean(d * d, axis=axes) - md * md
-    v = jnp.maximum(v, 0.0)
-    m = shift + md
-    scale = (lax.rsqrt(v + eps) * gamma.astype(jnp.float32))
-    shift = (beta.astype(jnp.float32) - m * scale)
-    y = (x * scale.astype(x.dtype) + shift.astype(x.dtype))
+    m, v = _bn_stats(x, tuple(axes))
+    scale = lax.rsqrt(v + eps) * gamma.astype(jnp.float32)
+    shift = beta.astype(jnp.float32) - m * scale
+    y = x * scale.astype(x.dtype) + shift.astype(x.dtype)
     return y, m.astype(x.dtype), v.astype(x.dtype)
 
 

@@ -297,3 +297,91 @@ class TestNewLayerMappers:
         x = np.random.default_rng(6).normal(size=(2, 12, 3)) \
             .astype(np.float32)
         _compare(m, net, x, rtol=1e-3, atol=1e-4)
+
+
+class TestLocallyConnectedImport:
+    """ADVICE r1 (medium): Keras flattens LC patches as (kH,kW,C) while
+    our ops consume channel-major (C,kH,kW) patches — the importer must
+    permute the weight's middle axis. Keras 3 dropped LocallyConnected*,
+    so the HDF5 is hand-built in the Keras-2 layout and the expected
+    output computed with explicit Keras patch semantics in numpy."""
+
+    @staticmethod
+    def _write_h5(path, config, weights):
+        import h5py
+        import json as _json
+
+        with h5py.File(path, "w") as f:
+            f.attrs["model_config"] = _json.dumps(config)
+            mw = f.create_group("model_weights")
+            for lname, ws in weights.items():
+                g = mw.create_group(lname)
+                names = []
+                for short, arr in ws.items():
+                    full = f"{lname}/{short}:0"
+                    g.create_dataset(full, data=arr)
+                    names.append(full.encode())
+                g.attrs["weight_names"] = names
+
+    @staticmethod
+    def _seq_config(layers):
+        return {"class_name": "Sequential",
+                "config": {"name": "seq", "layers": layers}}
+
+    def test_locally_connected2d_golden(self, tmp_path):
+        rng = np.random.default_rng(0)
+        h = w = 5
+        c_in, f, kh, kw = 3, 4, 3, 2
+        oh, ow = h - kh + 1, w - kw + 1
+        kernel = rng.normal(size=(oh * ow, kh * kw * c_in, f)) \
+            .astype(np.float32)
+        bias = rng.normal(size=(oh, ow, f)).astype(np.float32)
+        cfg = self._seq_config([
+            {"class_name": "InputLayer",
+             "config": {"name": "in", "batch_shape": [None, h, w, c_in]}},
+            {"class_name": "LocallyConnected2D",
+             "config": {"name": "lc", "filters": f,
+                        "kernel_size": [kh, kw], "strides": [1, 1],
+                        "padding": "valid", "data_format": "channels_last",
+                        "activation": "linear", "use_bias": True}},
+        ])
+        p = str(tmp_path / "lc2d.h5")
+        self._write_h5(p, cfg, {"lc": {"kernel": kernel, "bias": bias}})
+        net = KerasModelImport.importKerasSequentialModelAndWeights(p)
+
+        x = rng.normal(size=(2, h, w, c_in)).astype(np.float32)
+        # Keras semantics: patch flattened row-major (kh, kw, c)
+        expect = np.zeros((2, oh, ow, f), np.float32)
+        for i in range(oh):
+            for j in range(ow):
+                patch = x[:, i:i + kh, j:j + kw, :].reshape(2, -1)
+                expect[:, i, j, :] = patch @ kernel[i * ow + j] + bias[i, j]
+        got = np.asarray(net.output(x))
+        np.testing.assert_allclose(got, expect, rtol=1e-4, atol=1e-5)
+
+    def test_locally_connected1d_golden(self, tmp_path):
+        rng = np.random.default_rng(1)
+        t, c_in, f, k = 7, 3, 2, 3
+        ot = t - k + 1
+        kernel = rng.normal(size=(ot, k * c_in, f)).astype(np.float32)
+        bias = rng.normal(size=(ot, f)).astype(np.float32)
+        cfg = self._seq_config([
+            {"class_name": "InputLayer",
+             "config": {"name": "in", "batch_shape": [None, t, c_in]}},
+            {"class_name": "LocallyConnected1D",
+             "config": {"name": "lc", "filters": f, "kernel_size": [k],
+                        "strides": [1], "padding": "valid",
+                        "data_format": "channels_last",
+                        "activation": "linear", "use_bias": True}},
+        ])
+        p = str(tmp_path / "lc1d.h5")
+        self._write_h5(p, cfg, {"lc": {"kernel": kernel, "bias": bias}})
+        net = KerasModelImport.importKerasSequentialModelAndWeights(p)
+
+        x = rng.normal(size=(2, t, c_in)).astype(np.float32)
+        expect = np.zeros((2, ot, f), np.float32)
+        for i in range(ot):
+            patch = x[:, i:i + k, :].reshape(2, -1)   # (k, c) row-major
+            expect[:, i, :] = patch @ kernel[i] + bias[i]
+        got = np.asarray(net.output(x))
+        np.testing.assert_allclose(got, expect, rtol=1e-4, atol=1e-5)

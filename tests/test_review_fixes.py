@@ -130,3 +130,45 @@ def test_output_train_mode_applies_dropout():
     o_train2 = m.output(x, train=True).toNumpy()
     assert not np.allclose(o_train1, o_train2)  # stochastic in train mode
     np.testing.assert_array_equal(m.output(x).toNumpy(), o_infer)
+
+
+def test_deconv_asymmetric_padding_matches_output_type():
+    """ADVICE r1: asymmetric (ph != pw) Truncate deconv must agree with
+    the layer's inferred output type."""
+    from deeplearning4j_tpu.nn.conf.layers_extra import Deconvolution2D
+
+    lay = Deconvolution2D(n_in=3, n_out=5, kernel_size=(3, 3),
+                          stride=(2, 2), padding=(1, 0),
+                          convolution_mode="Truncate")
+    it = InputType.convolutional(6, 6, 3)
+    out_t = lay.output_type(it)
+    params = lay.init_params(__import__("jax").random.key(0), it,
+                             jnp.float32)
+    out, _ = lay.apply(params, {}, jnp.ones((2, 6, 6, 3)), False, None)
+    assert out.shape == (2, out_t.height, out_t.width, out_t.channels)
+    assert out_t.height != out_t.width  # asymmetry actually exercised
+
+
+def test_masked_pooling_time_axis_mismatch_raises():
+    """ADVICE r1: a strided layer between the masked input and a
+    GlobalPoolingLayer must raise, not silently pool padding."""
+    from deeplearning4j_tpu.nn.conf import GlobalPoolingLayer
+    from deeplearning4j_tpu.nn.conf.layers_extra import Convolution1D
+    from deeplearning4j_tpu.nn.graph import ComputationGraphConfiguration
+    from deeplearning4j_tpu.nn.graph.graph import ComputationGraph
+
+    conf = (ComputationGraphConfiguration.graphBuilder()
+            .addInputs("in")
+            .setInputTypes(InputType.recurrent(4, 8))
+            .addLayer("c", Convolution1D(
+                n_out=6, kernel_size=2, stride=2), "in")
+            .addLayer("pool", GlobalPoolingLayer(pooling_type="avg"), "c")
+            .addLayer("out", OutputLayer(n_out=3, activation="softmax",
+                                         loss="mcxent"), "pool")
+            .setOutputs("out").build())
+    net = ComputationGraph(conf).init()
+    x = np.random.default_rng(0).normal(size=(2, 8, 4)).astype(np.float32)
+    fmask = np.ones((2, 8), np.float32)
+    fmask[:, 5:] = 0
+    with pytest.raises(ValueError, match="changed the sequence length"):
+        net.output(x, feature_masks=[fmask])
