@@ -1,0 +1,154 @@
+"""ResNet-50 train-step benchmark + ablation harness (single chip).
+
+North-star config from BASELINE.json: ResNet-50, ComputationGraph,
+images/sec/chip and MFU. Methodology matches bench.py (v3): device-
+resident inputs, best-of-3 timing windows, every window ends with a
+device->host loss read (block_until_ready returns early through the
+axon tunnel).
+
+MFU accounting: ResNet-50 fwd ~= 4.09 GFLOP/img at 224x224 (counting
+MAC=2); train step ~= 3x fwd. Peak: 197 TFLOPS bf16 on TPU v5 lite.
+
+Usage: python bench_resnet.py [--batch 256] [--dtype bf16]
+       [--mode train|fwd|grad] [--no-bn] [--no-l2] [--steps 10]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# XLA cost-analysis numbers for this exact program at batch 256
+# (see BASELINE.md round-2 accounting): fwd 7.46 GFLOP/img, full train
+# step 22.3 GFLOP/img. NOT the 4.09 GMAC count round 1 misused.
+FWD_FLOPS_PER_IMG = 7.46e9
+TRAIN_FLOPS_PER_IMG = 22.3e9
+PEAK = {"TPU v5 lite": 197e12}
+
+
+def build(num_classes=1000, dtype="bf16", no_bn=False, no_l2=False):
+    from deeplearning4j_tpu.learning import Nesterovs
+    from deeplearning4j_tpu.zoo.resnet50 import ResNet50
+
+    model = ResNet50(num_classes=num_classes,
+                     updater=Nesterovs(learning_rate=1e-1, momentum=0.9))
+    conf = model.conf()
+    if no_l2:
+        for node in conf.nodes:
+            lay = getattr(node.vertex, "layer", None)
+            if lay is not None:
+                lay.l2 = 0.0
+        conf.l2 = 0.0
+    if no_bn:
+        from deeplearning4j_tpu.nn.conf import ActivationLayer
+        from deeplearning4j_tpu.nn.graph.graph import LayerVertex
+        for node in conf.nodes:
+            lay = getattr(node.vertex, "layer", None)
+            if lay is not None and type(lay).__name__ == "BatchNormalization":
+                node.vertex = LayerVertex(
+                    ActivationLayer(activation=lay.activation or "identity"))
+    conf.dtype = {"bf16": "bfloat16", "f32": "float32"}[dtype]
+    from deeplearning4j_tpu.nn.graph.graph import ComputationGraph
+    return ComputationGraph(conf).init()
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=256)
+    ap.add_argument("--dtype", default="bf16", choices=["bf16", "f32"])
+    ap.add_argument("--mode", default="train", choices=["train", "fwd"])
+    ap.add_argument("--no-bn", action="store_true")
+    ap.add_argument("--no-l2", action="store_true")
+    ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument("--classes", type=int, default=1000)
+    ap.add_argument("--hlo", action="store_true",
+                    help="dump optimized HLO to /tmp/resnet_step.hlo")
+    args = ap.parse_args()
+
+    net = build(args.classes, args.dtype, args.no_bn, args.no_l2)
+    dt = net._dtype
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(0, 1, (args.batch, 224, 224, 3)), dt)
+    y = jnp.asarray(
+        np.eye(args.classes, dtype=np.float32)[
+            rng.integers(0, args.classes, args.batch)], dt)
+    x, y = jax.device_put(x), jax.device_put(y)
+
+    conf = net.conf
+    inputs = {conf.network_inputs[0]: x}
+    labels = {conf.network_outputs[0]: y}
+
+    if args.mode == "train":
+        step = net._get_train_step()
+        state = (net.params_map, net.states_map, net.opt_states)
+
+        def run(state, i):
+            p, s, o, loss = step(state[0], state[1], state[2],
+                                 jnp.asarray(i), jnp.asarray(0), inputs,
+                                 labels, {}, {}, jax.random.key(i))
+            return (p, s, o), loss
+    else:
+        fwd = jax.jit(lambda pm, sm: net._forward_all(
+            pm, sm, inputs, False, None)[0][conf.network_outputs[0]])
+        state = (net.params_map, net.states_map)
+
+        def run(state, i):
+            out = fwd(state[0], state[1])
+            return state, out
+
+    if args.hlo:
+        jitted = step if args.mode == "train" else fwd
+        if args.mode == "train":
+            low = jitted.lower(net.params_map, net.states_map,
+                               net.opt_states, jnp.asarray(0),
+                               jnp.asarray(0), inputs, labels, {}, {},
+                               jax.random.key(0))
+        else:
+            low = jitted.lower(net.params_map, net.states_map)
+        comp = low.compile()
+        with open("/tmp/resnet_step.hlo", "w") as f:
+            f.write(comp.as_text())
+        try:
+            ca = comp.cost_analysis()
+            ca = ca[0] if isinstance(ca, list) else ca
+            print("cost_analysis flops:", ca.get("flops"))
+        except Exception as e:
+            print("cost_analysis unavailable:", e)
+        print("HLO dumped to /tmp/resnet_step.hlo")
+
+    # warmup/compile
+    t0 = time.perf_counter()
+    state, loss = run(state, 0)
+    lv = float(jnp.mean(loss))
+    print(f"compile+first step: {time.perf_counter()-t0:.1f}s loss={lv:.3f}")
+
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for i in range(args.steps):
+            state, loss = run(state, i + 1)
+        float(jnp.mean(loss))
+        best = min(best, time.perf_counter() - t0)
+
+    img_s = args.batch * args.steps / best
+    per_img = (TRAIN_FLOPS_PER_IMG if args.mode == "train"
+               else FWD_FLOPS_PER_IMG)
+    flops = img_s * per_img
+    peak = PEAK.get(jax.devices()[0].device_kind)
+    out = {"mode": args.mode, "dtype": args.dtype, "batch": args.batch,
+           "no_bn": args.no_bn, "no_l2": args.no_l2,
+           "img_per_sec": round(img_s, 1),
+           "tflops": round(flops / 1e12, 1)}
+    if peak:
+        out["mfu_est"] = round(flops / peak, 4)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()
