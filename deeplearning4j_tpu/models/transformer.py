@@ -374,13 +374,6 @@ class TransformerEncoder:
             lambda s: NamedSharding(mesh, s), specs,
             is_leaf=lambda x: isinstance(x, P))
 
-        def opt_specs(params_spec):
-            # updater state leaves parallel the params
-            template = updater.init_state(self.init_params())
-            return jax.tree_util.tree_map(
-                lambda _: params_spec, template,
-                is_leaf=lambda x: False) if False else None
-
         dp = NamedSharding(mesh, P("data", None))
         rep = NamedSharding(mesh, P())
         return jax.jit(
