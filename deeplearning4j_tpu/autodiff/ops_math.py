@@ -308,7 +308,14 @@ def argmin(x, dimensions=0, keep_dims=False):
 
 # ----------------------------------------------------------------- shape
 @_reg("reshape")
-def reshape(x, shape):
+def reshape(x, shape, copy_dims=None):
+    """Reshape; ``copy_dims`` maps target positions to INPUT dims whose
+    runtime extent is substituted there (TF-import's folding of dynamic
+    batch dims — shapes are static per XLA trace, so this is free)."""
+    shape = list(shape)
+    if copy_dims:
+        for pos, src in copy_dims.items():
+            shape[int(pos)] = x.shape[int(src)]
     return jnp.reshape(x, tuple(shape))
 
 

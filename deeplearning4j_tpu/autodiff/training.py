@@ -119,11 +119,15 @@ def fit(sd, data, epochs: int = 1, validation_data=None,
     if not cfg.data_set_feature_mapping:
         raise ValueError("TrainingConfig needs data_set_feature_mapping")
 
+    from deeplearning4j_tpu.datasets.multi_dataset import (
+        MultiDataSet, MultiDataSetIterator,
+    )
+
     history = History()
-    if isinstance(data, DataSet):
+    if isinstance(data, (DataSet, MultiDataSet)):
         batches = [data]
         iterate = lambda: batches
-    elif isinstance(data, DataSetIterator):
+    elif isinstance(data, (DataSetIterator, MultiDataSetIterator)):
         iterate = lambda: data
     else:
         batches = list(data)
@@ -137,9 +141,10 @@ def fit(sd, data, epochs: int = 1, validation_data=None,
     # `data` — otherwise epoch 2+ would silently see zero batches
     if validation_data is None:
         val_batches = None
-    elif isinstance(validation_data, DataSet):
+    elif isinstance(validation_data, (DataSet, MultiDataSet)):
         val_batches = [validation_data]
-    elif isinstance(validation_data, DataSetIterator):
+    elif isinstance(validation_data, (DataSetIterator,
+                                      MultiDataSetIterator)):
         val_batches = validation_data  # resettable via __iter__
     else:
         val_batches = list(validation_data)

@@ -36,27 +36,81 @@ def _dtype_name(enum_val: int) -> str:
     return _DTYPE_MAP.get(int(enum_val), "float32")
 
 
+# Sentinel for an unknown dim inside an import-time partially-known
+# integer array (shape-subgraph folding; see _PartialEval). Values in
+# [iinfo.min, _DYN_LIMIT] are all "dynamic": DYN is the anonymous one;
+# _PartialEval allocates provenance-carrying sentinels above it that
+# remember WHICH tensor dim they came from (so Reshape can emit
+# copy-input-dim semantics when a target mixes a literal -1 with a
+# dynamic batch dim — the transpose_for_scores pattern in real BERT
+# graphs).
+DYN = np.int64(np.iinfo(np.int64).min + 7)
+_DYN_LIMIT = np.int64(np.iinfo(np.int64).min + 10_000_000)
+
+
+def _is_dyn(v) -> np.ndarray:
+    a = np.asarray(v)
+    if a.dtype.kind not in "iu":
+        return np.zeros(a.shape, bool)
+    return a <= _DYN_LIMIT
+
+
 class _Ctx:
     """Everything a mapper needs for one node."""
 
     def __init__(self, sd: SameDiff, node, inputs: List[SDVariable],
-                 static: List[Optional[np.ndarray]], attrs: Dict[str, Any]):
+                 static: List[Optional[np.ndarray]], attrs: Dict[str, Any],
+                 pe=None, avals=None):
         self.sd = sd
         self.node = node
         self.inputs = inputs
         self._static = static
         self.attrs = attrs
+        self.pe = pe          # _PartialEval (provenance registry) or None
+        self.avals = avals    # var name -> (probe2 aval, probe3 aval)
+
+    def resolve_dyn_dim(self, sentinel: int) -> Optional[int]:
+        """Map a provenance sentinel to a dim index of THIS node's data
+        input whose two-probe extents match the sentinel's source dim
+        (i.e. 'copy input dim k'), or None."""
+        if self.pe is None or self.avals is None:
+            return None
+        prov = self.pe.dyn_prov.get(int(sentinel))
+        if prov is None:
+            return None
+        vname, dim = prov
+        src = self.avals.get(vname)
+        dst = self.avals.get(self.inputs[0].name)
+        if src is None or dst is None or dim >= len(src[0].shape):
+            return None
+        want = (src[0].shape[dim], src[1].shape[dim])
+        if want[0] == want[1]:
+            return None
+        for k, ab in enumerate(zip(dst[0].shape, dst[1].shape)):
+            if ab == want:
+                return k
+        return None
 
     def static_np(self, i: int) -> np.ndarray:
         """Constant value of input i (axes/shapes/perms must be static —
         XLA static-shape discipline; the reference resolves these from
-        Const nodes the same way)."""
+        Const nodes the same way, plus folded shape subgraphs)."""
         v = self._static[i]
-        if v is None:
+        if v is None or bool(np.any(_is_dyn(v))):
             raise TFImportError(
                 f"node {self.node.name} ({self.node.op}): input {i} must "
                 "be a constant (dynamic shapes/axes not importable)")
         return v
+
+    def partial_np(self, i: int) -> np.ndarray:
+        """Like static_np but tolerates DYN entries (unknown dims) —
+        used by Reshape, where one unknown dim becomes -1."""
+        v = self._static[i]
+        if v is None:
+            raise TFImportError(
+                f"node {self.node.name} ({self.node.op}): input {i} is "
+                "not statically resolvable (even partially)")
+        return np.asarray(v)
 
     def attr(self, name: str, default=None):
         return self.attrs.get(name, default)
@@ -235,7 +289,30 @@ def _register_standard_mappers():
     # shape manipulation
     @R("Reshape")
     def _reshape(ctx):
-        shape = [int(s) for s in ctx.static_np(1)]
+        arr = np.atleast_1d(ctx.partial_np(1)).astype(np.int64)
+        shape: List[int] = []
+        copy_dims: Dict[int, int] = {}
+        unknown = 0
+        for pos, val in enumerate(arr.tolist()):
+            if bool(_is_dyn(np.int64(val))):
+                k = ctx.resolve_dyn_dim(val)
+                if k is None:
+                    unknown += 1
+                    shape.append(-1)
+                else:
+                    copy_dims[pos] = k
+                    shape.append(0)   # placeholder; runtime substitutes
+            else:
+                if val == -1:
+                    unknown += 1
+                shape.append(int(val))
+        if unknown > 1:
+            raise TFImportError(
+                f"{ctx.node.name}: Reshape target has {unknown} unknown "
+                "dims — at most one (mapped to -1) is importable")
+        if copy_dims:
+            return ctx.op("reshape", ctx.inputs[:1], shape=shape,
+                          copy_dims=copy_dims)
         return ctx.op("reshape", ctx.inputs[:1], shape=shape)
 
     @R("Transpose")
@@ -466,6 +543,154 @@ OpMappingRegistry.register("Erfc")(
     lambda ctx: ctx.op("erfc", ctx.inputs[:1]))
 
 
+# ------------------------------------------------- shape-subgraph folding
+class _PartialEval:
+    """Import-time abstract interpreter for SHAPE-COMPUTATION subgraphs.
+
+    Real frozen graphs (e.g. a full BERT-base, SURVEY.md §3.4) compute
+    reshape targets dynamically: Shape -> StridedSlice -> Pack/Prod ->
+    Reshape, with the batch dim unknown. The reference's importer folds
+    these through its own shape inference; here each node's value is
+    evaluated as an int64 array with DYN marking unknown dims. Shapes
+    come from TWO-PROBE abstract evaluation: the importer propagates
+    ``jax.eval_shape`` results with every unknown (None) placeholder dim
+    set to 2 in one probe and 3 in the other — a dim whose two probe
+    values agree is static, one that differs is DYN. A folded value with
+    no DYN is a plain constant; Reshape accepts exactly one DYN as -1.
+    """
+
+    def __init__(self):
+        # provenance registry: sentinel value -> (tensor var name, dim)
+        self.dyn_prov: Dict[int, Tuple[str, int]] = {}
+        self._by_src: Dict[Tuple[str, int], int] = {}
+        self._next = int(np.iinfo(np.int64).min) + 1000
+
+    def _sentinel(self, var_name: str, dim: int) -> np.int64:
+        key = (var_name, dim)
+        if key not in self._by_src:
+            self._by_src[key] = self._next
+            self.dyn_prov[self._next] = key
+            self._next += 1
+        return np.int64(self._by_src[key])
+
+    def eval(self, node, attrs, in_partials: List[Optional[np.ndarray]],
+             in_shape_pairs: List[Optional[Tuple[tuple, tuple]]],
+             in_var_names: List[str]) -> Optional[np.ndarray]:
+        op = node.op
+        try:
+            if op == "Shape":
+                pair = in_shape_pairs[0] if in_shape_pairs else None
+                if pair is None:
+                    return None
+                s2, s3 = pair
+                if len(s2) != len(s3):
+                    return None
+                return np.array(
+                    [a if a == b else self._sentinel(in_var_names[0], i)
+                     for i, (a, b) in enumerate(zip(s2, s3))],
+                    np.int64)
+            vals = in_partials
+
+            def _int(v):
+                return (v is not None
+                        and np.asarray(v).dtype.kind in "iu")
+
+            if op in ("Identity", "Snapshot", "StopGradient"):
+                return vals[0] if _int(vals[0]) else None
+            if op == "Cast":
+                # only int->int casts keep a foldable value; a float
+                # target would silently truncate if folded
+                if _int(vals[0]) and str(attrs.get("DstT", "")).startswith(
+                        ("int", "uint")):
+                    return vals[0]
+                return None
+            if op in ("Add", "AddV2", "Sub", "Mul", "Maximum", "Minimum",
+                      "FloorDiv"):
+                a, b = vals[0], vals[1]
+                if not (_int(a) and _int(b)):
+                    return None
+                a = np.asarray(a, np.int64)
+                b = np.asarray(b, np.int64)
+                fn = {"Add": np.add, "AddV2": np.add, "Sub": np.subtract,
+                      "Mul": np.multiply, "Maximum": np.maximum,
+                      "Minimum": np.minimum,
+                      "FloorDiv": np.floor_divide}[op]
+                out = fn(a, b)
+                dyn = _is_dyn(a) | _is_dyn(b)
+                out = np.where(np.broadcast_to(dyn, out.shape), DYN, out)
+                return out.astype(np.int64)
+            if op == "Pack":
+                if not all(_int(v) for v in vals):
+                    return None
+                axis = int(attrs.get("axis", 0))
+                return np.stack([np.asarray(v, np.int64) for v in vals],
+                                axis=axis)
+            if op == "ConcatV2":
+                if not all(_int(v) for v in vals):
+                    return None
+                axis = int(np.asarray(vals[-1]))
+                return np.concatenate(
+                    [np.atleast_1d(np.asarray(v, np.int64))
+                     for v in vals[:-1]], axis=axis)
+            if op == "Prod":
+                a, ax = vals[0], vals[1]
+                if not (_int(a) and _int(ax)):
+                    return None
+                a = np.asarray(a, np.int64)
+                if np.any(_is_dyn(a)):
+                    return np.asarray(DYN)
+                # axis=() is TF's identity reduction — keep it, don't
+                # collapse to a full (axis=None) reduction
+                return np.prod(a, axis=tuple(int(x) for x in
+                                             np.atleast_1d(ax)),
+                               keepdims=bool(attrs.get("keep_dims", False))
+                               ).astype(np.int64)
+            if op in ("GatherV2", "Gather"):
+                a, idxs = vals[0], vals[1]
+                if not (_int(a) and _int(idxs)):
+                    return None
+                return np.take(np.asarray(a, np.int64),
+                               np.asarray(idxs, np.int64), axis=0)
+            if op == "Range":
+                if any(not _int(v) or np.any(_is_dyn(v))
+                       for v in vals[:3]):
+                    return None
+                return np.arange(int(vals[0]), int(vals[1]),
+                                 int(vals[2]), dtype=np.int64)
+            if op == "Squeeze":
+                return np.squeeze(vals[0]) if _int(vals[0]) else None
+            if op == "ExpandDims":
+                if not (_int(vals[0]) and _int(vals[1])):
+                    return None
+                return np.expand_dims(np.asarray(vals[0], np.int64),
+                                      int(vals[1]))
+            if op == "StridedSlice":
+                a = vals[0]
+                if not _int(a) or any(not _int(v) for v in vals[1:4]):
+                    return None
+                a = np.atleast_1d(np.asarray(a, np.int64))
+                if a.ndim != 1:
+                    return None
+                begin = np.atleast_1d(vals[1])
+                end = np.atleast_1d(vals[2])
+                strides = np.atleast_1d(vals[3])
+                bm = int(attrs.get("begin_mask", 0))
+                em = int(attrs.get("end_mask", 0))
+                sm = int(attrs.get("shrink_axis_mask", 0))
+                if int(attrs.get("ellipsis_mask", 0)) or \
+                        int(attrs.get("new_axis_mask", 0)):
+                    return None
+                b = None if (bm & 1) else int(begin[0])
+                e = None if (em & 1) else int(end[0])
+                out = a[slice(b, e, int(strides[0]))]
+                if sm & 1:
+                    return out[0] if out.size else None
+                return out
+        except Exception:
+            return None
+        return None
+
+
 # ----------------------------------------------------------------- import
 class TFGraphMapper:
     """reference: TFGraphMapper#importGraph / ImportGraph.importGraph."""
@@ -482,10 +707,63 @@ class TFGraphMapper:
         gd = TFGraphMapper._as_graph_def(graph_def_or_path)
         from tensorflow.python.framework import tensor_util
 
+        import jax
+
+        from deeplearning4j_tpu.ops.registry import get_op
+
         sd = SameDiff()
         # tensor name ("node" / "node:k") -> SDVariable
         tensors: Dict[str, SDVariable] = {}
         const_vals: Dict[str, np.ndarray] = {}
+        # node name -> import-time folded value (may contain DYN)
+        partials: Dict[str, np.ndarray] = {}
+        pe = _PartialEval() if any(n.op == "Shape" for n in gd.node) \
+            else None
+        # SDVariable name -> (aval under probe batch=2, probe batch=3);
+        # feeds _PartialEval's Shape folding (see its docstring)
+        avals: Dict[str, Tuple[Any, Any]] = {}
+
+        def _propagate_avals(from_idx: int) -> None:
+            """Two-probe abstract shape eval for ops appended since
+            from_idx (mappers may emit several chained ops)."""
+            if pe is None:
+                return
+            for opnode in sd._ops[from_idx:]:
+                fn = get_op(opnode.op_name)
+                pair = []
+                for probe in (0, 1):
+                    ins = []
+                    for iname in opnode.inputs:
+                        if iname in avals:
+                            ins.append(avals[iname][probe])
+                        elif iname in sd._arrays:
+                            a = sd._arrays[iname]
+                            ins.append(jax.ShapeDtypeStruct(
+                                tuple(a.shape), a.dtype))
+                        else:
+                            ins = None
+                            break
+                    if ins is None:
+                        pair = None
+                        break
+                    try:
+                        out = jax.eval_shape(
+                            lambda *a: fn(*a, **opnode.attrs), *ins)
+                    except Exception as _e:
+                        import os as _os
+                        if _os.environ.get("DL4J_TF_IMPORT_DEBUG"):
+                            print(f"aval-fail {opnode.op_name} "
+                                  f"{opnode.outputs[0][-60:]}: "
+                                  f"{type(_e).__name__}: {_e}")
+                        pair = None
+                        break
+                    pair.append(list(out) if isinstance(out, (list, tuple))
+                                else [out])
+                if pair is None:
+                    continue
+                for k, on in enumerate(opnode.outputs):
+                    if k < len(pair[0]):
+                        avals[on] = (pair[0][k], pair[1][k])
 
         def resolve(ref: str) -> Tuple[str, int]:
             if ":" in ref:
@@ -495,10 +773,18 @@ class TFGraphMapper:
 
         for node in gd.node:
             attrs = _decode_attrs(node)
-            if node.op == "NoOp":
+            if node.op in ("NoOp", "Assert"):
+                # Assert: runtime-check node, consumed via control edges
+                # only — the reference importer likewise drops it.
                 continue
             if node.op == "Const":
                 val = tensor_util.MakeNdarray(node.attr["value"].tensor)
+                if val.dtype.kind in "OSU":
+                    # string consts (Assert messages etc.) have no JAX
+                    # representation; their only consumers are dropped
+                    # check nodes
+                    const_vals[node.name] = val
+                    continue
                 v = sd.constant(node.name, val)
                 if v.name != node.name:
                     raise TFImportError(
@@ -506,6 +792,8 @@ class TFGraphMapper:
                 tensors[node.name] = v
                 tensors[node.name + ":0"] = v
                 const_vals[node.name] = val
+                aval = jax.ShapeDtypeStruct(tuple(val.shape), val.dtype)
+                avals[v.name] = (aval, aval)
                 continue
             if node.op in ("Placeholder", "PlaceholderWithDefault"):
                 shape = attrs.get("shape")
@@ -515,10 +803,25 @@ class TFGraphMapper:
                                    dtype=attrs.get("dtype", "float32"))
                 tensors[node.name] = v
                 tensors[node.name + ":0"] = v
+                if shape is not None:
+                    dt = np.dtype(attrs.get("dtype", "float32"))
+                    # distinct probe pairs PER DIM INDEX (dim i ->
+                    # (2+2i, 3+2i)) so two dynamic dims of one
+                    # placeholder (e.g. [None, None] batch+seq) stay
+                    # distinguishable in resolve_dyn_dim; the same dim
+                    # index across placeholders shares a pair so
+                    # cross-placeholder elementwise ops still probe
+                    # consistently.
+                    avals[v.name] = tuple(
+                        jax.ShapeDtypeStruct(
+                            tuple(p + 2 * i if d is None else d
+                                  for i, d in enumerate(shape)), dt)
+                        for p in (2, 3))
                 continue
 
             in_vars: List[SDVariable] = []
             statics: List[Optional[np.ndarray]] = []
+            in_refs: List[Tuple[str, int]] = []
             for ref in node.input:
                 if ref.startswith("^"):  # control edge: ordering only
                     continue
@@ -530,10 +833,28 @@ class TFGraphMapper:
                     raise TFImportError(
                         f"node {node.name}: unresolved input {ref!r}")
                 in_vars.append(tensors[key])
-                statics.append(const_vals.get(src) if idx == 0 else None)
+                sv = const_vals.get(src) if idx == 0 else None
+                if sv is None and idx == 0:
+                    sv = partials.get(src)
+                statics.append(sv)
+                in_refs.append((src, idx))
+
+            if pe is not None:
+                shape_pairs = []
+                for v in in_vars:
+                    p = avals.get(v.name)
+                    shape_pairs.append(
+                        (tuple(p[0].shape), tuple(p[1].shape))
+                        if p is not None else None)
+                pv = pe.eval(node, attrs, statics, shape_pairs,
+                             [v.name for v in in_vars])
+                if pv is not None:
+                    partials[node.name] = np.asarray(pv)
 
             mapper = OpMappingRegistry.get(node.op)
-            ctx = _Ctx(sd, node, in_vars, statics, attrs)
+            ctx = _Ctx(sd, node, in_vars, statics, attrs, pe=pe,
+                       avals=avals)
+            n_ops_before = len(sd._ops)
             out = mapper(ctx)
             if isinstance(out, tuple):
                 for k, v in enumerate(out):
@@ -546,6 +867,7 @@ class TFGraphMapper:
                 # variable name so sd.output(..., ["node_name"]) works
                 if out.name != node.name:
                     out.rename(node.name)
+            _propagate_avals(n_ops_before)
         return sd
 
     @staticmethod
