@@ -649,6 +649,10 @@ class _PartialEval:
                 a, idxs = vals[0], vals[1]
                 if not (_int(a) and _int(idxs)):
                     return None
+                axis = vals[2] if len(vals) > 2 else 0
+                if int(attrs.get("batch_dims", 0)) != 0 or \
+                        axis is None or int(np.asarray(axis)) != 0:
+                    return None  # only axis-0, no batch_dims folding
                 return np.take(np.asarray(a, np.int64),
                                np.asarray(idxs, np.int64), axis=0)
             if op == "Range":
@@ -666,7 +670,11 @@ class _PartialEval:
                                       int(vals[1]))
             if op == "StridedSlice":
                 a = vals[0]
-                if not _int(a) or any(not _int(v) for v in vals[1:4]):
+                if not _int(a) or any(
+                        not _int(v) or np.any(_is_dyn(v))
+                        for v in vals[1:4]):
+                    # dynamic begin/end/stride sentinels would clamp to
+                    # array bounds and fold a confidently wrong slice
                     return None
                 a = np.atleast_1d(np.asarray(a, np.int64))
                 if a.ndim != 1:

@@ -1,0 +1,267 @@
+"""Long-tail math / special-function ops (reference: libnd4j
+ops/declarable/generic/transforms + legacy scalar/pairwise loops —
+the declarable-op families VERDICT r1 flagged as missing breadth,
+SURVEY.md §2.6).
+
+All are thin jax/lax compositions — XLA fuses them; there is no
+per-op dispatch cost (SURVEY §3.3's JNI stack collapses under jit).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deeplearning4j_tpu.ops.registry import register_op
+
+
+# ----------------------------------------------------------- unary math
+@register_op("asinh")
+def asinh(x):
+    return jnp.arcsinh(x)
+
+
+@register_op("acosh")
+def acosh(x):
+    return jnp.arccosh(x)
+
+
+@register_op("atanh")
+def atanh(x):
+    return jnp.arctanh(x)
+
+
+@register_op("expm1")
+def expm1(x):
+    return jnp.expm1(x)
+
+
+@register_op("rint")
+def rint(x):
+    return jnp.rint(x)
+
+
+@register_op("trunc")
+def trunc(x):
+    return jnp.trunc(x)
+
+
+@register_op("cbrt")
+def cbrt(x):
+    return jnp.cbrt(x)
+
+
+@register_op("erfinv")
+def erfinv(x):
+    return jax.scipy.special.erfinv(x)
+
+
+@register_op("lgamma")
+def lgamma(x):
+    return jax.scipy.special.gammaln(x)
+
+
+@register_op("digamma")
+def digamma(x):
+    return jax.scipy.special.digamma(x)
+
+
+@register_op("polygamma")
+def polygamma(n, x):
+    return jax.scipy.special.polygamma(n, x)
+
+
+@register_op("igamma")
+def igamma(a, x):
+    """Regularized lower incomplete gamma (reference: igamma.cpp)."""
+    return jax.scipy.special.gammainc(a, x)
+
+
+@register_op("igammac")
+def igammac(a, x):
+    """Regularized upper incomplete gamma (reference: igammac.cpp)."""
+    return jax.scipy.special.gammaincc(a, x)
+
+
+@register_op("betainc")
+def betainc(a, b, x):
+    return jax.scipy.special.betainc(a, b, x)
+
+
+@register_op("sinc")
+def sinc(x):
+    return jnp.sinc(x)
+
+
+@register_op("deg2rad")
+def deg2rad(x):
+    return jnp.deg2rad(x)
+
+
+@register_op("rad2deg")
+def rad2deg(x):
+    return jnp.rad2deg(x)
+
+
+@register_op("nan_to_num")
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None):
+    return jnp.nan_to_num(x, nan=nan, posinf=posinf, neginf=neginf)
+
+
+@register_op("log_cosh")
+def log_cosh(x):
+    # numerically stable: |x| + log1p(exp(-2|x|)) - log 2
+    ax = jnp.abs(x)
+    return ax + jnp.log1p(jnp.exp(-2.0 * ax)) - jnp.log(2.0)
+
+
+@register_op("softmin")
+def softmin(x, axis=-1):
+    return jax.nn.softmax(-x, axis=axis)
+
+
+# --------------------------------------------------------- binary math
+@register_op("logaddexp")
+def logaddexp(a, b):
+    return jnp.logaddexp(a, b)
+
+
+@register_op("logaddexp2")
+def logaddexp2(a, b):
+    return jnp.logaddexp2(a, b)
+
+
+@register_op("hypot")
+def hypot(a, b):
+    return jnp.hypot(a, b)
+
+
+@register_op("heaviside")
+def heaviside(x, h0):
+    return jnp.heaviside(x, h0)
+
+
+@register_op("copysign")
+def copysign(a, b):
+    return jnp.copysign(a, b)
+
+
+@register_op("fmod")
+def fmod(a, b):
+    return jnp.fmod(a, b)
+
+
+@register_op("xdivy")
+def xdivy(x, y):
+    """0 if x==0 else x/y (reference: xdivy TF parity op)."""
+    return jnp.where(x == 0, jnp.zeros_like(x), x / jnp.where(
+        x == 0, jnp.ones_like(y), y))
+
+
+@register_op("xlogy")
+def xlogy(x, y):
+    return jax.scipy.special.xlogy(x, y)
+
+
+@register_op("xlog1py")
+def xlog1py(x, y):
+    return jax.scipy.special.xlog1py(x, y)
+
+
+@register_op("lerp")
+def lerp(a, b, weight):
+    """a + weight*(b-a) (reference: lerp scalar/pairwise op)."""
+    return a + weight * (b - a)
+
+
+@register_op("addcmul")
+def addcmul(x, t1, t2, value=1.0):
+    return x + value * t1 * t2
+
+
+@register_op("addcdiv")
+def addcdiv(x, t1, t2, value=1.0):
+    return x + value * t1 / t2
+
+
+@register_op("polyval")
+def polyval(coeffs, x):
+    """Horner evaluation; coeffs[0] is the highest power."""
+    out = jnp.zeros_like(x) + coeffs[0]
+    for c in coeffs[1:]:
+        out = out * x + c
+    return out
+
+
+@register_op("absolute_difference")
+def absolute_difference(a, b):
+    return jnp.abs(a - b)
+
+
+# -------------------------------------------------- nan-skipping reduces
+@register_op("nanmean")
+def nanmean(x, dimensions=None, keep_dims=False):
+    axis = tuple(dimensions) if dimensions is not None else None
+    return jnp.nanmean(x, axis=axis, keepdims=keep_dims)
+
+
+@register_op("nansum")
+def nansum(x, dimensions=None, keep_dims=False):
+    axis = tuple(dimensions) if dimensions is not None else None
+    return jnp.nansum(x, axis=axis, keepdims=keep_dims)
+
+
+@register_op("nanmax")
+def nanmax(x, dimensions=None, keep_dims=False):
+    axis = tuple(dimensions) if dimensions is not None else None
+    return jnp.nanmax(x, axis=axis, keepdims=keep_dims)
+
+
+@register_op("nanmin")
+def nanmin(x, dimensions=None, keep_dims=False):
+    axis = tuple(dimensions) if dimensions is not None else None
+    return jnp.nanmin(x, axis=axis, keepdims=keep_dims)
+
+
+# ----------------------------------------------------------- percentile
+@register_op("percentile")
+def percentile(x, q, dimensions=None, keep_dims=False,
+               interpolation="linear"):
+    """reference: percentile.cpp (linear/lower/higher/nearest modes)."""
+    axis = tuple(dimensions) if dimensions is not None else None
+    return jnp.percentile(x, q, axis=axis, keepdims=keep_dims,
+                          method=interpolation)
+
+
+@register_op("median")
+def median(x, dimensions=None, keep_dims=False):
+    axis = tuple(dimensions) if dimensions is not None else None
+    return jnp.median(x, axis=axis, keepdims=keep_dims)
+
+
+@register_op("quantile")
+def quantile(x, q, dimensions=None, keep_dims=False):
+    axis = tuple(dimensions) if dimensions is not None else None
+    return jnp.quantile(x, q, axis=axis, keepdims=keep_dims)
+
+
+# --------------------------------------------------------- cumulative
+@register_op("cummax")
+def cummax(x, axis=0):
+    return lax.cummax(x, axis=axis)
+
+
+@register_op("cummin")
+def cummin(x, axis=0):
+    return lax.cummin(x, axis=axis)
+
+
+@register_op("diff")
+def diff(x, n=1, axis=-1):
+    return jnp.diff(x, n=n, axis=axis)
+
+
+@register_op("trapz")
+def trapz(y, dx=1.0, axis=-1):
+    return jax.scipy.integrate.trapezoid(y, dx=dx, axis=axis)

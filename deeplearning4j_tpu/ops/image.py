@@ -231,3 +231,88 @@ def non_max_suppression(boxes, scores, max_output_size,
         0, max_output_size, body, (sel0, jnp.asarray(0, jnp.int32),
                                    live0, scores.astype(jnp.float32)))
     return sel, count
+
+
+@register_op("central_crop")
+def central_crop(img, fraction):
+    """Keep the central fraction of H/W (TF semantics: trim
+    int((d - d*fraction)/2) from each side, so odd extents keep the
+    extra row/col)."""
+    h, w = img.shape[-3], img.shape[-2]
+    top = int((h - h * fraction) / 2)
+    left = int((w - w * fraction) / 2)
+    return img[..., top:h - top, left:w - left, :]
+
+
+@register_op("per_image_standardization")
+def per_image_standardization(img):
+    axes = tuple(range(img.ndim - 3, img.ndim))
+    m = jnp.mean(img, axis=axes, keepdims=True)
+    n = 1
+    for a in axes:
+        n *= img.shape[a]
+    s = jnp.std(img, axis=axes, keepdims=True)
+    return (img - m) / jnp.maximum(s, 1.0 / jnp.sqrt(float(n)))
+
+
+@register_op("image_gradients")
+def image_gradients(img):
+    """(dy, dx) with zero last row/col, NHWC (TF parity)."""
+    dy = jnp.pad(img[:, 1:] - img[:, :-1], ((0, 0), (0, 1), (0, 0), (0, 0)))
+    dx = jnp.pad(img[:, :, 1:] - img[:, :, :-1],
+                 ((0, 0), (0, 0), (0, 1), (0, 0)))
+    return dy, dx
+
+
+@register_op("sobel_edges")
+def sobel_edges(img):
+    """[N,H,W,C,2] sobel (dy, dx) per channel."""
+    ky = jnp.array([[-1, -2, -1], [0, 0, 0], [1, 2, 1]], img.dtype)
+    kx = ky.T
+    c = img.shape[-1]
+    k = jnp.stack([ky, kx], -1)                          # [3,3,2]
+    # grouped conv: rhs [3,3,1,C*2], outputs blocked per input channel
+    k = jnp.tile(k[:, :, None, :], (1, 1, c, 1)).reshape(3, 3, 1, c * 2)
+    # TF reflect-pads the image, then convolves VALID — zero padding
+    # would corrupt every border pixel
+    padded = jnp.pad(img, ((0, 0), (1, 1), (1, 1), (0, 0)),
+                     mode="reflect")
+    out = lax.conv_general_dilated(
+        padded, k, (1, 1), "VALID",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        feature_group_count=c)
+    return out.reshape(img.shape[:-1] + (c, 2))
+
+
+@register_op("pad_to_bounding_box")
+def pad_to_bounding_box(img, offset_h, offset_w, target_h, target_w):
+    h, w = img.shape[-3], img.shape[-2]
+    return jnp.pad(img, ((0, 0),) * (img.ndim - 3) + (
+        (offset_h, target_h - h - offset_h),
+        (offset_w, target_w - w - offset_w), (0, 0)))
+
+
+@register_op("crop_to_bounding_box")
+def crop_to_bounding_box(img, offset_h, offset_w, target_h, target_w):
+    return img[..., offset_h:offset_h + target_h,
+               offset_w:offset_w + target_w, :]
+
+
+@register_op("adjust_gamma")
+def adjust_gamma(img, gamma=1.0, gain=1.0):
+    return gain * img ** gamma
+
+
+@register_op("image_translate")
+def image_translate(img, dy, dx):
+    """Integer translate with zero fill (host-static offsets)."""
+    out = jnp.roll(jnp.roll(img, dy, axis=-3), dx, axis=-2)
+    h, w = img.shape[-3], img.shape[-2]
+    rows = jnp.arange(h)
+    cols = jnp.arange(w)
+    rmask = (rows >= dy) & (rows < h + dy) if dy >= 0 else \
+        (rows < h + dy)
+    cmask = (cols >= dx) & (cols < w + dx) if dx >= 0 else \
+        (cols < w + dx)
+    m = rmask[:, None] & cmask[None, :]
+    return out * m[..., None].astype(img.dtype)

@@ -147,3 +147,52 @@ def norm_fro(x):
 def l2_normalize(x, axis=-1, eps=1e-12):
     return x * lax.rsqrt(jnp.maximum(
         jnp.sum(x * x, axis=axis, keepdims=True), eps))
+
+
+@register_op("slogdet")
+def slogdet(x):
+    """(sign, log|det|) (reference: log_matrix_determinant kin)."""
+    sign, logabs = jnp.linalg.slogdet(x)
+    return sign, logabs
+
+
+@register_op("matrix_power")
+def matrix_power(x, n):
+    return jnp.linalg.matrix_power(x, n)
+
+
+@register_op("matrix_rank")
+def matrix_rank(x, tol=None):
+    """``tol`` is an ABSOLUTE singular-value threshold (numpy
+    semantics), not jnp's relative rtol."""
+    if tol is None:
+        return jnp.linalg.matrix_rank(x)
+    s = jnp.linalg.svd(x, compute_uv=False)
+    return jnp.sum(s > tol, axis=-1)
+
+
+@register_op("eigvalsh")
+def eigvalsh(x):
+    return jnp.linalg.eigvalsh(x)
+
+
+@register_op("expm")
+def expm(x):
+    """Matrix exponential (jax.scipy.linalg.expm; Pade on the MXU)."""
+    import jax.scipy.linalg as jsl
+    return jsl.expm(x)
+
+
+@register_op("cond_number")
+def cond_number(x, p=None):
+    return jnp.linalg.cond(x, p=p)
+
+
+@register_op("multi_dot")
+def multi_dot(mats):
+    return jnp.linalg.multi_dot(list(mats))
+
+
+@register_op("adjoint")
+def adjoint(x):
+    return jnp.conjugate(jnp.swapaxes(x, -1, -2))

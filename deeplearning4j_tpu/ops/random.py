@@ -49,3 +49,41 @@ def random_poisson(rng, shape, lam=1.0, dtype=jnp.int32):
 @register_op("dropout_mask")
 def dropout_mask(rng, shape, keep_prob, dtype=jnp.float32):
     return jax.random.bernoulli(rng, keep_prob, shape).astype(dtype) / keep_prob
+
+
+@register_op("random_laplace")
+def random_laplace(rng, shape, loc=0.0, scale=1.0, dtype=jnp.float32):
+    return loc + scale * jax.random.laplace(rng, shape, dtype=dtype)
+
+
+@register_op("random_cauchy")
+def random_cauchy(rng, shape, loc=0.0, scale=1.0, dtype=jnp.float32):
+    return loc + scale * jax.random.cauchy(rng, shape, dtype=dtype)
+
+
+@register_op("random_gumbel")
+def random_gumbel(rng, shape, dtype=jnp.float32):
+    return jax.random.gumbel(rng, shape, dtype=dtype)
+
+
+@register_op("random_beta")
+def random_beta(rng, shape, a=1.0, b=1.0, dtype=jnp.float32):
+    return jax.random.beta(rng, a, b, shape, dtype=dtype)
+
+
+@register_op("random_categorical")
+def random_categorical(rng, logits, num_samples):
+    """[batch, num_samples] class draws (reference: random_multinomial)."""
+    return jax.random.categorical(
+        rng, logits[:, None, :], axis=-1,
+        shape=logits.shape[:1] + (num_samples,))
+
+
+@register_op("random_shuffle")
+def random_shuffle(rng, x, axis=0):
+    return jax.random.permutation(rng, x, axis=axis, independent=False)
+
+
+@register_op("random_rademacher")
+def random_rademacher(rng, shape, dtype=jnp.float32):
+    return jax.random.rademacher(rng, shape, dtype=dtype)
