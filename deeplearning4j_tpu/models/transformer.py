@@ -52,9 +52,10 @@ class TransformerConfig:
     seed: int = 0
     # Mixture-of-Experts (0 = dense FFN). When set, EVERY layer's FFN is
     # an expert-parallel MoE, sharded over the 'model' mesh axis
-    # (models/moe.py). Supported by make_train_step (GSPMD EP); the
-    # pipeline and ring engines currently REJECT MoE configs (aux-loss
-    # routing not wired there yet).
+    # (models/moe.py). Composes with every engine: make_train_step
+    # (GSPMD EP), make_ring_train_step (SP x EP: shard-local routing,
+    # aux pmean'd over the mesh), and PipelinedTransformer (PP x EP:
+    # per-stage aux sums counted on real microbatch ticks only).
     n_experts: int = 0
     expert_top_k: int = 2
     capacity_factor: float = 1.25
@@ -425,11 +426,15 @@ class TransformerEncoder:
             return base(q, k, v, axis_name=sp_axis, kv_mask=pad_mask)
         keys = (jax.random.split(rng, cfg.n_layers)
                 if (train and rng is not None) else [None] * cfg.n_layers)
+        aux_total = jnp.float32(0.0)
         for li, lp in enumerate(params["layers"]):
-            # aux dropped: make_ring_train_step rejects MoE configs
-            x, _ = self._block(x, lp, None, train, keys[li], False,
-                               attn_fn=attn_fn)
-        return x
+            # MoE under SP: each shard routes its LOCAL token block
+            # (per-sequence-shard dispatch groups); aux is averaged
+            # over shards by the caller
+            x, aux = self._block(x, lp, None, train, keys[li], False,
+                                 attn_fn=attn_fn)
+            aux_total = aux_total + aux
+        return x, aux_total
 
     def make_ring_train_step(self, updater, mesh: Mesh, attn: str = "ring"):
         """Compiled DP x SP (context-parallel) MLM train step.
@@ -445,10 +450,6 @@ class TransformerEncoder:
 
         if attn not in ("ring", "ulysses"):
             raise ValueError(f"attn must be ring|ulysses: {attn}")
-        if self.cfg.n_experts:
-            raise NotImplementedError(
-                "context-parallel training does not yet route the MoE "
-                "aux loss; use make_train_step (GSPMD EP) for MoE")
 
         def per_shard_grads(params, ids, labels, mask_pos, pad_mask, rng):
             # distinct dropout streams per shard
@@ -456,15 +457,21 @@ class TransformerEncoder:
             rng = jax.random.fold_in(rng, lax.axis_index("sp"))
 
             def local_loss(p):
-                hidden = self._encode_local(p, ids, "sp", True, rng, attn,
-                                            pad_mask=pad_mask)
+                hidden, aux = self._encode_local(
+                    p, ids, "sp", True, rng, attn, pad_mask=pad_mask)
                 logits = self.mlm_logits(p, hidden).astype(jnp.float32)
                 logp = jax.nn.log_softmax(logits, axis=-1)
                 tok_lp = jnp.take_along_axis(
                     logp, labels[..., None], axis=-1)[..., 0]
                 num = lax.psum(jnp.sum(tok_lp * mask_pos), ("data", "sp"))
                 den = lax.psum(jnp.sum(mask_pos), ("data", "sp"))
-                return -num / jnp.maximum(den, 1.0)
+                loss = -num / jnp.maximum(den, 1.0)
+                if self.cfg.n_experts:
+                    # shard-local routing: model-level balance loss is
+                    # the mean over all (data, sp) shards
+                    loss = loss + self.cfg.aux_loss_weight * lax.pmean(
+                        aux, ("data", "sp"))
+                return loss
 
             loss, grads = jax.value_and_grad(local_loss)(params)
             grads = lax.psum(grads, ("data", "sp"))

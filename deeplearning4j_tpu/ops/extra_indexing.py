@@ -95,7 +95,9 @@ def bincount(x, weights=None, minlength=0, maxlength=None):
     out = jax.ops.segment_sum(
         jnp.where(keep.reshape(w.reshape(-1).shape), w.reshape(-1), 0.0),
         idx, num_segments=nbins + 1)[:nbins]
-    return out if weights is not None else out.astype(jnp.int64)
+    # TF bincount's default output dtype is int32 (and int64 would just
+    # truncate + warn under x64-disabled JAX)
+    return out if weights is not None else out.astype(jnp.int32)
 
 
 @register_op("searchsorted")

@@ -50,10 +50,6 @@ class PipelinedTransformer:
             raise ValueError(
                 f"n_layers={cfg.n_layers} not divisible by "
                 f"n_stages={n_stages}")
-        if getattr(cfg, "n_experts", 0):
-            raise NotImplementedError(
-                "pipeline parallelism does not yet route the MoE aux "
-                "loss; use make_train_step (GSPMD EP) for MoE")
         self.enc = encoder
         self.n_stages = n_stages
         self.layers_per_stage = cfg.n_layers // n_stages
@@ -108,25 +104,29 @@ class PipelinedTransformer:
     # the schedule
     # ------------------------------------------------------------------
     def _stage_apply(self, stage_params, x, train, rng, stage_id):
-        """Run this device's layers_per_stage layers over x."""
+        """Run this device's layers_per_stage layers over x. Returns
+        (out, aux_sum) — the MoE balance-loss sum over this stage's
+        layers (0.0 for dense FFN configs)."""
         enc = self.enc
 
         def body(carry, inp):
             lp, li = inp
+            x_c, aux_c = carry
             key = (jax.random.fold_in(rng, stage_id * self.layers_per_stage
                                       + li)
                    if (train and rng is not None) else None)
-            # aux dropped: __init__ rejects MoE configs
-            y, _ = enc._block(carry, lp, None, train, key, False)
-            return y, None
+            y, aux = enc._block(x_c, lp, None, train, key, False)
+            return (y, aux_c + aux), None
 
         lidx = jnp.arange(self.layers_per_stage)
-        out, _ = lax.scan(body, x, (stage_params, lidx))
-        return out
+        (out, aux), _ = lax.scan(body, (x, jnp.float32(0.0)),
+                                 (stage_params, lidx))
+        return out, aux
 
     def _local_loss_terms(self, params, ids, labels, mask_pos, train, rng):
         """Per-(data,pipe)-shard pipelined forward; returns local
-        (masked log-prob sum, mask count) — psum'd by the caller.
+        (masked log-prob sum, mask count, MoE aux sum) — psum'd by the
+        caller (aux is 0.0 for dense configs).
 
         ids/labels/mask_pos: LOCAL [n_micro, mb, T].
         """
@@ -158,7 +158,7 @@ class PipelinedTransformer:
             return jnp.sum((tok - lse) * mmask), jnp.sum(mmask)
 
         def tick(carry, tk):
-            x_recv, num, den = carry
+            x_recv, num, den, aux = carry
             # stage 0 ingests microbatch `tk` (clamped during drain);
             # later stages consume what arrived on the wire. lax.cond,
             # not jnp.where: only stage 0 should PAY for the embedding
@@ -169,7 +169,13 @@ class PipelinedTransformer:
                             lambda: x_recv)
             key = (jax.random.fold_in(rng, tk)
                    if (train and rng is not None) else None)
-            h = self._stage_apply(stage_params, x_in, train, key, stage)
+            h, aux_t = self._stage_apply(stage_params, x_in, train, key,
+                                         stage)
+            # MoE aux: count only ticks where THIS stage processed a
+            # real microbatch (fill/drain ticks run on garbage)
+            aux_real = jnp.logical_and(tk >= stage,
+                                       tk < stage + n_micro)
+            aux = aux + jnp.where(aux_real, aux_t, 0.0)
             # last stage scores microbatch tk-(S-1) once it's real
             mi_out = tk - (s - 1)
             valid = jnp.logical_and(stage == s - 1,
@@ -186,12 +192,13 @@ class PipelinedTransformer:
             # the embedding select above)
             perm = [(i, (i + 1) % s) for i in range(s)]
             x_send = lax.ppermute(h, "pipe", perm)
-            return (x_send, num, den), None
+            return (x_send, num, den, aux), None
 
         zero_x = jnp.zeros((mb, t, cfg.d_model), cd)
         ticks = jnp.arange(n_micro + s - 1)
-        (_, num, den), _ = lax.scan(tick, (zero_x, 0.0, 0.0), ticks)
-        return num, den
+        (_, num, den, aux), _ = lax.scan(
+            tick, (zero_x, 0.0, 0.0, jnp.float32(0.0)), ticks)
+        return num, den, aux
 
     # ------------------------------------------------------------------
     # public API
@@ -207,6 +214,17 @@ class PipelinedTransformer:
 
         def per_shard(params, ids, labels, mask_pos, rng):
             rng = jax.random.fold_in(rng, lax.axis_index("data"))
+            dp = lax.axis_size("data")
+            n_mb = ids.shape[0]
+            # global mask count is params-independent — precompute so
+            # the MoE aux term can be pre-scaled by it inside the local
+            # objective (it gets divided back out with the grads below).
+            # mask_pos is replicated across 'pipe' (sharded over 'data'
+            # only), so reduce over 'data' alone.
+            den_g = jnp.maximum(
+                lax.psum(jnp.sum(mask_pos), "data"), 1.0)
+            aux_w = getattr(enc.cfg, "aux_loss_weight", 0.0) \
+                if getattr(enc.cfg, "n_experts", 0) else 0.0
 
             # Differentiate the LOCAL unnormalized objective (-num), NOT
             # an already-psum'd scalar: lax.psum's transpose is psum, so
@@ -216,15 +234,22 @@ class PipelinedTransformer:
             # grad of -num IS the global grad restricted to this rank's
             # data shard; normalize by the global mask count afterward.
             def local_obj(p):
-                num, den = self._local_loss_terms(
+                num, den, aux = self._local_loss_terms(
                     p, ids, labels, mask_pos, True, rng)
-                return -num, den
+                obj = -num
+                if aux_w:
+                    # target global term: w * psum(aux) / (dp*n_micro);
+                    # pre-multiply by den_g since grads are /den_g later
+                    obj = obj + aux_w * aux * den_g / (dp * n_mb)
+                return obj, (num, den, aux)
 
-            (negnum, den), grads = jax.value_and_grad(
+            (_, (num, den, aux)), grads = jax.value_and_grad(
                 local_obj, has_aux=True)(params)
-            num_g = lax.psum(-negnum, ("data", "pipe"))
-            den_g = jnp.maximum(lax.psum(den, ("data", "pipe")), 1.0)
+            num_g = lax.psum(num, ("data", "pipe"))
             loss = -num_g / den_g
+            if aux_w:
+                loss = loss + aux_w * lax.psum(
+                    aux, ("data", "pipe")) / (dp * n_mb)
             # stage-sharded leaves: each pipe rank owns its stage's
             # grads (data-reduce only). Replicated leaves: partial
             # contributions live on the pipeline ends — sum them.
@@ -280,7 +305,8 @@ class PipelinedTransformer:
         specs = self.param_specs()
 
         def per_shard(params, i, l, m):
-            num, den = self._local_loss_terms(params, i, l, m, False, None)
+            num, den, _aux = self._local_loss_terms(
+                params, i, l, m, False, None)
             num = lax.psum(num, ("data", "pipe"))
             den = lax.psum(den, ("data", "pipe"))
             return -num / jnp.maximum(den, 1.0)

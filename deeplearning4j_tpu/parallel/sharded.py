@@ -49,25 +49,29 @@ def _tmap(f, *trees):
     return jax.tree_util.tree_map(f, *trees)
 
 
+def _data_spec(a):
+    """P('data', None...) for one array, or per-element for a list
+    (multi-input/output graphs) — the single definition of 'shard the
+    leading batch axis' used by every mode."""
+    one = lambda b: P("data", *([None] * (b.ndim - 1)))
+    if isinstance(a, (list, tuple)):
+        return [one(b) for b in a]
+    return one(a)
+
+
 class _ModelFuncs:
     """Uniform seam over the two front-ends: MultiLayerNetwork keeps
     params as a per-layer LIST, ComputationGraph as a per-vertex DICT —
     tree_map handles both, but loss signatures and attribute names
-    differ. Single-input/single-output graphs only (the DP trainer
-    shards ONE feature and ONE label array, like the reference's
-    ParallelWrapper)."""
+    differ. Multi-input/multi-output graphs shard EVERY feature/label
+    array over 'data' (lists flow through jit/shard_map as pytrees)."""
 
     def __init__(self, model):
         self.model = model
         self.is_graph = hasattr(model, "params_map")
         if self.is_graph:
-            ins = model.conf.network_inputs
-            outs = model.conf.network_outputs
-            if len(ins) != 1 or len(outs) != 1:
-                raise ValueError(
-                    "ShardedTrainer supports single-input/single-output "
-                    f"graphs; got {len(ins)} inputs / {len(outs)} outputs")
-            self._in0, self._out0 = ins[0], outs[0]
+            self._ins = list(model.conf.network_inputs)
+            self._outs = list(model.conf.network_outputs)
             self.clip = model._clip
         else:
             self.clip = model._clip_grads
@@ -81,8 +85,16 @@ class _ModelFuncs:
 
     def loss(self, params, states, x, y, rng):
         if self.is_graph:
-            return self.model._loss(params, states, {self._in0: x},
-                                    {self._out0: y}, rng)
+            xs = x if isinstance(x, (list, tuple)) else [x]
+            ys = y if isinstance(y, (list, tuple)) else [y]
+            if len(xs) != len(self._ins) or len(ys) != len(self._outs):
+                raise ValueError(
+                    f"graph takes {len(self._ins)} inputs / "
+                    f"{len(self._outs)} outputs; got {len(xs)} feature "
+                    f"and {len(ys)} label arrays")
+            return self.model._loss(params, states,
+                                    dict(zip(self._ins, xs)),
+                                    dict(zip(self._outs, ys)), rng)
         return self.model._loss(params, states, x, y, None, rng)
 
     def keys(self, params):
@@ -156,11 +168,22 @@ class ShardedTrainer:
 
     def _shard_batch(self, x, y):
         def spec(a):
-            return NamedSharding(self.mesh, P("data", *([None] * (a.ndim - 1))))
+            return NamedSharding(self.mesh, _data_spec(a))
 
-        xj = jnp.asarray(x, self.model._dtype)
-        yj = jnp.asarray(y)
-        return jax.device_put(xj, spec(xj)), jax.device_put(yj, spec(yj))
+        def one(a, dt):
+            aj = jnp.asarray(a, dt) if dt is not None else jnp.asarray(a)
+            return jax.device_put(aj, spec(aj))
+
+        dt = self.model._dtype
+        if isinstance(x, (list, tuple)):
+            x = [one(a, dt) for a in x]
+        else:
+            x = one(x, dt)
+        if isinstance(y, (list, tuple)):
+            y = [one(a, None) for a in y]
+        else:
+            y = one(y, None)
+        return x, y
 
     # ------------------------------------------------------------------
     # mode: sharing (GSPMD — compiler-inserted all-reduce)
@@ -252,7 +275,7 @@ class ShardedTrainer:
                     _tmap(lambda a: a[None], thresholds), loss_mean)
 
         rep = P()
-        dp = lambda a: P("data", *([None] * (a.ndim - 1)))
+        dp = _data_spec
         pd = lambda _: P("data")
 
         def step_fn(params, states, opt_s, residual, thresholds, it_step,
@@ -309,7 +332,7 @@ class ShardedTrainer:
         # params/opt per-shard DIVERGE between averaging points: they are
         # stacked on a leading 'data' axis outside, split inside
         pd = lambda _: P("data")
-        dp = lambda a: P("data", *([None] * (a.ndim - 1)))
+        dp = _data_spec
 
         def step_fn(params_stacked, states, opt_stacked, it_step, ep_step,
                     x, y, rng, do_avg):
@@ -344,7 +367,21 @@ class ShardedTrainer:
 
     # ------------------------------------------------------------------
     def fit(self, data, labels=None, epochs: int = 1):
+        from deeplearning4j_tpu.datasets.multi_dataset import (
+            MultiDataSet, MultiDataSetIterator,
+        )
+
         model = self.model
+        if isinstance(data, MultiDataSetIterator):
+            for _ in range(epochs):
+                for mds in data:
+                    self._fit_batch(list(mds.features), list(mds.labels))
+                model._epoch += 1
+            return self._finish()
+        if isinstance(data, MultiDataSet):
+            for _ in range(epochs):
+                self._fit_batch(list(data.features), list(data.labels))
+            return self._finish()
         if isinstance(data, DataSetIterator):
             for _ in range(epochs):
                 for ds in data:

@@ -132,22 +132,59 @@ class TestMoETraining:
         assert abs(float(loss) - float(ref_loss)) / abs(float(ref_loss)) \
             < 1e-4, (float(loss), float(ref_loss))
 
-    def test_ring_step_rejects_moe(self):
+    def test_ring_moe_composes_and_learns(self):
+        """VERDICT r1 #6: MoE under the SP/ring engine — shard-local
+        routing with the balance loss pmean'd over (data, sp)."""
         cfg = _moe_cfg()
+        cfg.dropout = 0.0
         enc = TransformerEncoder(cfg)
         mesh = Mesh(np.asarray(jax.devices()).reshape(4, 2),
                     ("data", "sp"))
         from deeplearning4j_tpu.learning.updaters import Adam
-        with pytest.raises(NotImplementedError, match="MoE"):
-            enc.make_ring_train_step(Adam(1e-3), mesh)
+        step = enc.make_ring_train_step(Adam(5e-3), mesh)
+        params = enc.init_params()
+        opt = Adam(5e-3).init_state(params)
+        rs = np.random.RandomState(9)
+        ids = jnp.asarray(rs.randint(0, 47, (8, 8)).astype(np.int32))
+        mask = jnp.ones((8, 8), jnp.float32)
+        losses = []
+        with mesh:
+            for i in range(12):
+                params, opt, loss = step(params, opt, jnp.asarray(i),
+                                         ids, ids, mask,
+                                         jax.random.key(i))
+                losses.append(float(loss))
+        assert np.isfinite(losses).all()
+        assert losses[-1] < losses[0] * 0.9, losses
 
-    def test_pipeline_rejects_moe(self):
+    def test_pipeline_moe_composes_and_learns(self):
+        """VERDICT r1 #6: MoE under the PP engine — per-stage aux sums
+        accumulated only on real (non-fill/drain) ticks."""
         from deeplearning4j_tpu.parallel.pipeline import (
             PipelinedTransformer,
         )
-        enc = TransformerEncoder(_moe_cfg())
-        with pytest.raises(NotImplementedError, match="MoE"):
-            PipelinedTransformer(enc, n_stages=2)
+        cfg = _moe_cfg()
+        cfg.dropout = 0.0
+        enc = TransformerEncoder(cfg)
+        mesh = Mesh(np.asarray(jax.devices()).reshape(4, 2),
+                    ("data", "pipe"))
+        pp = PipelinedTransformer(enc, n_stages=2)
+        from deeplearning4j_tpu.learning.updaters import Adam
+        params = pp.shard_params(enc.init_params(), mesh)
+        opt = Adam(5e-3).init_state(params)
+        step = pp.make_train_step(Adam(5e-3), mesh, n_micro=2)
+        rs = np.random.RandomState(10)
+        ids = jnp.asarray(rs.randint(0, 47, (16, 8)).astype(np.int32))
+        mask = jnp.ones((16, 8), jnp.float32)
+        losses = []
+        with mesh:
+            for i in range(12):
+                params, opt, loss = step(params, opt, jnp.asarray(i),
+                                         ids, ids, mask,
+                                         jax.random.key(i))
+                losses.append(float(loss))
+        assert np.isfinite(losses).all()
+        assert losses[-1] < losses[0] * 0.9, losses
 
 
 class TestReviewRegressions:
