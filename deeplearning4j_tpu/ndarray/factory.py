@@ -27,15 +27,26 @@ def _dt(dtype) -> jnp.dtype:
 
 
 class _RandomState:
-    """Counter-split PRNG (reference: NativeRandom/Philox RNG, §2.39)."""
+    """Counter-split PRNG (reference: NativeRandom/Philox RNG, §2.39).
+
+    Key creation is LAZY: `jax.random.key` initializes the XLA backend,
+    and this object is instantiated at package-import time — an eager
+    key would break ``jax.distributed.initialize`` (which must run
+    before any backend-touching call in multi-process programs)."""
 
     def __init__(self, seed: int = 0):
-        self._key = jax.random.key(seed)
+        self._seed = seed
+        self._key = None
 
     def setSeed(self, seed: int):
-        self._key = jax.random.key(seed)
+        # stay lazy: creating the key here would initialize the backend
+        # (see class docstring)
+        self._seed = seed
+        self._key = None
 
     def next_key(self):
+        if self._key is None:
+            self._key = jax.random.key(self._seed)
         self._key, sub = jax.random.split(self._key)
         return sub
 

@@ -372,6 +372,12 @@ class ShardedTrainer:
         )
 
         model = self.model
+        if isinstance(data, (MultiDataSet, MultiDataSetIterator)) \
+                and not self.mf.is_graph:
+            raise ValueError(
+                "MultiDataSet(Iterator) requires a ComputationGraph "
+                "model; wrap single arrays in a DataSet for "
+                "MultiLayerNetwork")
         if isinstance(data, MultiDataSetIterator):
             for _ in range(epochs):
                 for mds in data:
