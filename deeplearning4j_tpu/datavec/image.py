@@ -22,6 +22,24 @@ from deeplearning4j_tpu.datavec.records import (FileSplit, InputSplit,
                                                 RecordReader, _as_split)
 
 
+
+
+def _to_pil(img: np.ndarray):
+    """uint8 PIL image from (H,W,C) incl. single-channel (H,W,1)."""
+    from PIL import Image
+    a = img.astype(np.uint8)
+    if a.ndim == 3 and a.shape[-1] == 1:
+        return Image.fromarray(a[..., 0]), True
+    return Image.fromarray(a), False
+
+
+def _from_pil(pil, squeezed: bool) -> np.ndarray:
+    arr = np.asarray(pil)
+    if squeezed or arr.ndim == 2:
+        arr = arr[..., None] if arr.ndim == 2 else arr
+    return arr
+
+
 class ImageTransform:
     """Composable image transform (reference: ImageTransform chain)."""
 
@@ -195,3 +213,175 @@ class ImageRecordReader(RecordReader):
             labels.append(rec[1])
         return (np.stack(feats).astype(np.float32),
                 np.asarray(labels, dtype=np.int32))
+
+
+# ---------------------------------------------------------------------
+# round-2 transform breadth (reference: org/datavec/image/transform/**)
+# ---------------------------------------------------------------------
+class RotateImageTransform(ImageTransform):
+    """Random rotation in [-angle, angle] degrees (reference:
+    RotateImageTransform; bilinear, edge fill)."""
+
+    def __init__(self, angle: float):
+        self.angle = float(angle)
+
+    def __call__(self, img, rng):
+        from PIL import Image
+        a = float(rng.uniform(-self.angle, self.angle))
+        pil, sq = _to_pil(img)
+        return _from_pil(pil.rotate(a, resample=Image.BILINEAR), sq)
+
+
+class ScaleImageTransform(ImageTransform):
+    """Random scale by up to ±delta fraction, resized back (reference:
+    ScaleImageTransform)."""
+
+    def __init__(self, delta: float = 0.1):
+        self.delta = float(delta)
+
+    def __call__(self, img, rng):
+        from PIL import Image
+        h, w = img.shape[:2]
+        s = 1.0 + float(rng.uniform(-self.delta, self.delta))
+        nh, nw = max(1, int(h * s)), max(1, int(w * s))
+        pil, sq = _to_pil(img)
+        scaled = pil.resize((nw, nh), Image.BILINEAR)
+        return _from_pil(scaled.resize((w, h), Image.BILINEAR), sq)
+
+
+class WarpImageTransform(ImageTransform):
+    """Random perspective warp: each corner jittered by up to ``delta``
+    pixels (reference: WarpImageTransform)."""
+
+    def __init__(self, delta: float):
+        self.delta = float(delta)
+
+    def __call__(self, img, rng):
+        from PIL import Image
+        h, w = img.shape[:2]
+        d = self.delta
+        # QUAD maps output corners to source points (ul, ll, lr, ur)
+        j = lambda: float(rng.uniform(-d, d))
+        quad = (j(), j(),
+                j(), h + j(),
+                w + j(), h + j(),
+                w + j(), j())
+        pil, sq = _to_pil(img)
+        return _from_pil(pil.transform((w, h), Image.QUAD, quad,
+                                       Image.BILINEAR), sq)
+
+
+class ColorConversionTransform(ImageTransform):
+    """Color-space conversion (reference: ColorConversionTransform with
+    CV codes; here named targets: 'hsv', 'yuv', 'gray')."""
+
+    def __init__(self, target: str = "hsv"):
+        if target not in ("hsv", "yuv", "gray"):
+            raise ValueError(f"unsupported color target {target!r}")
+        self.target = target
+
+    def __call__(self, img, rng):
+        if img.shape[-1] < 3:
+            if self.target == "gray":
+                return img          # already single-channel
+            raise ValueError(
+                f"{self.target!r} conversion needs 3 channels; got "
+                f"{img.shape[-1]}")
+        x = img.astype(np.float32) / 255.0
+        if self.target == "gray":
+            g = (0.2989 * x[..., 0] + 0.587 * x[..., 1]
+                 + 0.114 * x[..., 2])
+            return (np.repeat(g[..., None], img.shape[-1], -1)
+                    * 255.0).astype(img.dtype)
+        if self.target == "yuv":
+            m = np.array([[0.299, 0.587, 0.114],
+                          [-0.14713, -0.28886, 0.436],
+                          [0.615, -0.51499, -0.10001]], np.float32)
+            yuv = x @ m.T
+            yuv[..., 1:] += 0.5
+            return (np.clip(yuv, 0, 1) * 255.0).astype(img.dtype)
+        # vectorized RGB->HSV (matplotlib-style)
+        r, g, b = x[..., 0], x[..., 1], x[..., 2]
+        mx, mn = x.max(-1), x.min(-1)
+        v = mx
+        s = np.where(mx > 0, (mx - mn) / np.maximum(mx, 1e-12), 0.0)
+        c = mx - mn
+        cs = np.maximum(c, 1e-12)
+        hue = np.where(mx == r, ((g - b) / cs) % 6.0,
+                       np.where(mx == g, (b - r) / cs + 2.0,
+                                (r - g) / cs + 4.0))
+        hue = np.where(c == 0, 0.0, hue) / 6.0
+        out = np.stack([hue, s, v], -1)
+        return (np.clip(out, 0, 1) * 255.0).astype(img.dtype)
+
+
+class EqualizeHistTransform(ImageTransform):
+    """Per-channel histogram equalization (reference:
+    EqualizeHistTransform)."""
+
+    def __call__(self, img, rng):
+        out = np.empty_like(img)
+        u8 = img.astype(np.uint8)
+        for c in range(img.shape[-1]):
+            ch = u8[..., c]
+            hist = np.bincount(ch.reshape(-1), minlength=256)
+            cdf = hist.cumsum()
+            nz = cdf[cdf > 0]
+            if nz.size == 0:
+                out[..., c] = ch
+                continue
+            cdf_min = nz[0]
+            denom = max(int(cdf[-1]) - int(cdf_min), 1)
+            lut = np.round((cdf - cdf_min) / denom * 255.0)
+            out[..., c] = np.clip(lut[ch], 0, 255)
+        return out.astype(img.dtype)
+
+
+class RandomCropTransform(ImageTransform):
+    """Crop a random (out_h, out_w) window (reference:
+    RandomCropTransform)."""
+
+    def __init__(self, out_h: int, out_w: int):
+        self.oh, self.ow = int(out_h), int(out_w)
+
+    def __call__(self, img, rng):
+        h, w = img.shape[:2]
+        if h < self.oh or w < self.ow:
+            raise ValueError(
+                f"crop {self.oh}x{self.ow} larger than image {h}x{w}")
+        top = int(rng.integers(0, h - self.oh + 1))
+        left = int(rng.integers(0, w - self.ow + 1))
+        return img[top:top + self.oh, left:left + self.ow]
+
+
+class BoxImageTransform(ImageTransform):
+    """Letterbox into (out_h, out_w): aspect-preserving resize + pad
+    (reference: BoxImageTransform)."""
+
+    def __init__(self, out_h: int, out_w: int):
+        self.oh, self.ow = int(out_h), int(out_w)
+
+    def __call__(self, img, rng):
+        from PIL import Image
+        h, w = img.shape[:2]
+        scale = min(self.oh / h, self.ow / w)
+        nh, nw = max(1, int(round(h * scale))), max(1, int(round(w * scale)))
+        pil, sq = _to_pil(img)
+        resized = _from_pil(pil.resize((nw, nh), Image.BILINEAR), sq)
+        out = np.zeros((self.oh, self.ow) + img.shape[2:], img.dtype)
+        top = (self.oh - nh) // 2
+        left = (self.ow - nw) // 2
+        out[top:top + nh, left:left + nw] = resized
+        return out
+
+
+class NoiseImageTransform(ImageTransform):
+    """Additive gaussian pixel noise (augmentation; clips to [0,255])."""
+
+    def __init__(self, sigma: float = 8.0):
+        self.sigma = float(sigma)
+
+    def __call__(self, img, rng):
+        noise = rng.normal(0.0, self.sigma, img.shape)
+        return np.clip(img.astype(np.float32) + noise, 0, 255) \
+            .astype(img.dtype)

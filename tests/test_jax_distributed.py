@@ -101,7 +101,12 @@ def test_two_process_data_parallel_matches_single_process(tmp_path):
             text=True)
         for pid in range(2)
     ]
-    outs = [p.communicate(timeout=240) for p in procs]
+    try:
+        outs = [p.communicate(timeout=240) for p in procs]
+    finally:
+        for p in procs:               # never leak a hung worker
+            if p.poll() is None:
+                p.kill()
     for p, (so, se) in zip(procs, outs):
         assert p.returncode == 0, f"worker failed:\n{so}\n{se[-3000:]}"
 
