@@ -53,8 +53,9 @@ class ResizeImageTransform(ImageTransform):
 
     def __call__(self, img, rng):
         from PIL import Image
-        pil = Image.fromarray(img.astype(np.uint8))
-        return np.asarray(pil.resize((self.w, self.h), Image.BILINEAR))
+        pil, sq = _to_pil(img)
+        return _from_pil(pil.resize((self.w, self.h), Image.BILINEAR),
+                         sq)
 
 
 class FlipImageTransform(ImageTransform):
@@ -81,8 +82,8 @@ class CropImageTransform(ImageTransform):
         r = int(rng.integers(0, self.margin + 1))
         cropped = img[t:h - b or h, l:w - r or w]
         from PIL import Image
-        pil = Image.fromarray(cropped.astype(np.uint8))
-        return np.asarray(pil.resize((w, h), Image.BILINEAR))
+        pil, sq = _to_pil(cropped)
+        return _from_pil(pil.resize((w, h), Image.BILINEAR), sq)
 
 
 class PipelineImageTransform(ImageTransform):
@@ -281,12 +282,12 @@ class ColorConversionTransform(ImageTransform):
         self.target = target
 
     def __call__(self, img, rng):
-        if img.shape[-1] < 3:
-            if self.target == "gray":
+        if img.shape[-1] != 3:
+            if self.target == "gray" and img.shape[-1] == 1:
                 return img          # already single-channel
             raise ValueError(
-                f"{self.target!r} conversion needs 3 channels; got "
-                f"{img.shape[-1]}")
+                f"{self.target!r} conversion needs exactly 3 channels; "
+                f"got {img.shape[-1]} (drop alpha first)")
         x = img.astype(np.float32) / 255.0
         if self.target == "gray":
             g = (0.2989 * x[..., 0] + 0.587 * x[..., 1]

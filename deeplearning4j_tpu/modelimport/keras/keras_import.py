@@ -6,12 +6,16 @@ Keras-3 HDF5 layout (``model_config`` JSON attr + ``model_weights``
 group with per-layer ``weight_names``), which is what tf.keras ≥2.16
 writes for ``model.save("*.h5")``.
 
-Layer coverage mirrors the reference's most-used mappers: Dense,
-Conv2D, SeparableConv2D, MaxPooling2D/AveragePooling2D, GlobalMax/
-AveragePooling2D, Flatten, Dropout, BatchNormalization, Activation,
-ReLU/Softmax/LeakyReLU, ZeroPadding2D, UpSampling2D, Embedding, LSTM,
-SimpleRNN, Add/Subtract/Multiply/Average/Maximum/Concatenate
-(functional graphs).
+Layer coverage mirrors the reference's ~60 mappers: Dense, Conv1D/2D/3D,
+Conv2DTranspose, SeparableConv2D, DepthwiseConv2D, LocallyConnected1D/2D,
+Max/AveragePooling1D/2D/3D, GlobalMax/AveragePooling1D/2D, Flatten,
+Dropout (+Alpha/Gaussian/Spatial/Noise), BatchNormalization,
+LayerNormalization, Activation, ReLU/Softmax/LeakyReLU/ELU/
+ThresholdedReLU/PReLU, ZeroPadding/Cropping/UpSampling 1D/2D/3D,
+Permute, Reshape, RepeatVector, Masking, Embedding, LSTM, GRU,
+SimpleRNN, Bidirectional (all merge modes), TimeDistributed(Dense),
+Add/Subtract/Multiply/Average/Maximum/Minimum/Concatenate (functional
+graphs).
 """
 
 from __future__ import annotations
@@ -27,6 +31,7 @@ from deeplearning4j_tpu.nn.conf.builder import (MultiLayerConfiguration,
 from deeplearning4j_tpu.nn.conf.inputs import InputType
 from deeplearning4j_tpu.nn.conf.layers import (ActivationLayer,
                                                BatchNormalization,
+                                               Bidirectional,
                                                ConvolutionLayer, DenseLayer,
                                                DropoutLayer, EmbeddingLayer,
                                                EmbeddingSequenceLayer,
@@ -41,9 +46,9 @@ from deeplearning4j_tpu.nn.conf.layers import LayerNormalization
 from deeplearning4j_tpu.nn.conf.layers_extra import (
     Convolution1D, Convolution3D, Cropping1D, Cropping2D, Cropping3D,
     Deconvolution2D, DepthwiseConvolution2D, GRU, LocallyConnected1D,
-    LocallyConnected2D, MaskLayer, PReLULayer, RepeatVector,
-    Subsampling1DLayer, Subsampling3DLayer, Upsampling1D, Upsampling3D,
-    ZeroPadding1DLayer, ZeroPadding3DLayer,
+    LocallyConnected2D, MaskLayer, PermuteLayer, PReLULayer,
+    RepeatVector, ReshapeLayer, Subsampling1DLayer, Subsampling3DLayer,
+    Upsampling1D, Upsampling3D, ZeroPadding1DLayer, ZeroPadding3DLayer,
 )
 from deeplearning4j_tpu.nn.conf.dropout import (
     AlphaDropout, GaussianDropout, GaussianNoise, SpatialDropout,
@@ -382,6 +387,51 @@ def _map_layer(class_name: str, cfg: dict, is_last: bool):
             stride=_pair(cfg.get("strides", 1)),
             activation=_map_activation(cfg.get("activation")),
             has_bias=cfg.get("use_bias", True))
+    if class_name == "ELU":
+        if abs(float(cfg.get("alpha", 1.0)) - 1.0) > 1e-12:
+            raise UnsupportedKerasConfigurationException(
+                f"layer {name!r}: ELU alpha != 1.0 not supported")
+        return ActivationLayer(name=name, activation="elu")
+    if class_name == "ThresholdedReLU":
+        if abs(float(cfg.get("theta", 1.0)) - 1.0) > 1e-12:
+            raise UnsupportedKerasConfigurationException(
+                f"layer {name!r}: ThresholdedReLU theta != 1.0 "
+                "not supported")
+        return ActivationLayer(name=name, activation="thresholdedrelu")
+    if class_name == "Permute":
+        return PermuteLayer(name=name,
+                            dims=tuple(int(d) for d in cfg["dims"]))
+    if class_name == "Reshape":
+        return ReshapeLayer(name=name, target_shape=tuple(
+            int(d) for d in cfg["target_shape"]))
+    if class_name == "TimeDistributed":
+        # our Dense already broadcasts over leading axes, which is
+        # exactly TimeDistributed(Dense) semantics
+        inner = cfg["layer"]
+        if inner["class_name"] != "Dense":
+            raise UnsupportedKerasConfigurationException(
+                f"layer {name!r}: TimeDistributed supports Dense only; "
+                f"got {inner['class_name']}")
+        mapped = _map_layer("Dense", dict(inner["config"], name=name),
+                            is_last=is_last)
+        mapped.name = name
+        return mapped
+    if class_name == "Bidirectional":
+        inner = cfg["layer"]
+        if not inner.get("config", {}).get("return_sequences", False):
+            raise UnsupportedKerasConfigurationException(
+                f"layer {name!r}: Bidirectional with "
+                "return_sequences=False is not supported (last-step "
+                "merge semantics differ; set return_sequences=True)")
+        wrapped = _map_layer(inner["class_name"],
+                             dict(inner["config"]), is_last=False)
+        mode = {"concat": "CONCAT", "sum": "ADD", "mul": "MUL",
+                "ave": "AVERAGE"}.get(cfg.get("merge_mode", "concat"))
+        if mode is None:
+            raise UnsupportedKerasConfigurationException(
+                f"layer {name!r}: merge_mode="
+                f"{cfg.get('merge_mode')!r} not supported")
+        return Bidirectional(name=name, layer=wrapped, mode=mode)
     if class_name == "PReLU":
         return PReLULayer(name=name)
     if class_name == "RepeatVector":
@@ -415,15 +465,27 @@ def _read_layer_weights(mw, layer_name: str) -> Dict[str, np.ndarray]:
     if layer_name not in mw:
         return {}
     g = mw[layer_name]
-    out: Dict[str, np.ndarray] = {}
-    names = g.attrs.get("weight_names", [])
+    names = [n.decode() if isinstance(n, bytes) else n
+             for n in g.attrs.get("weight_names", [])]
+    shorts = []
     for n in names:
-        if isinstance(n, bytes):
-            n = n.decode()
         short = n.split("/")[-1]
         if short.endswith(":0"):
             short = short[:-2]
-        out[short] = np.asarray(g[n])
+        shorts.append(short)
+    dup = {s_ for s_ in shorts if shorts.count(s_) > 1}
+    out: Dict[str, np.ndarray] = {}
+    for n, short in zip(names, shorts):
+        if short in dup:
+            # path-qualify duplicates (Bidirectional's forward/backward
+            # cells both end in kernel/recurrent_kernel/bias)
+            marker = f"/{layer_name}/"
+            rel = n.split(marker, 1)[1] if marker in n else n
+            if rel.endswith(":0"):
+                rel = rel[:-2]
+            out[rel] = np.asarray(g[n])
+        else:
+            out[short] = np.asarray(g[n])
     return out
 
 
@@ -460,6 +522,26 @@ def _assign_params(layer, params: dict, state: dict,
             put(state, "mean", kw["moving_mean"])
         if "moving_variance" in kw:
             put(state, "var", kw["moving_variance"])
+        return
+    if isinstance(layer, Bidirectional):
+        # classify by PATH SEGMENT: Keras names the wrapped cells
+        # forward_<inner>/... and backward_<inner>/...; matching on a
+        # bare substring would misroute when the user layer name itself
+        # contains "forward"
+        fwd: Dict[str, np.ndarray] = {}
+        bwd: Dict[str, np.ndarray] = {}
+        for k, v in kw.items():
+            segs = k.split("/")
+            short = segs[-1]
+            is_f = any(s_.startswith("forward") for s_ in segs[:-1])
+            is_b = any(s_.startswith("backward") for s_ in segs[:-1])
+            if is_f == is_b:
+                raise InvalidKerasConfigurationException(
+                    f"layer {lname!r}: cannot attribute weight {k!r} "
+                    "to the forward or backward cell")
+            (fwd if is_f else bwd)[short] = v
+        _assign_params(layer.layer, params["fw"], {}, fwd, lname + "/fw")
+        _assign_params(layer.layer, params["bw"], {}, bwd, lname + "/bw")
         return
     if isinstance(layer, (LSTM, SimpleRnn)):
         # Keras LSTM kernel (in,4h) gate order i,f,c,o == our i,f,g,o
@@ -666,10 +748,10 @@ class KerasModelImport:
                 builder.addVertex(name, MergeVertex(), *srcs)
                 continue
             if cname in ("Add", "Subtract", "Multiply", "Average",
-                         "Maximum"):
+                         "Maximum", "Minimum"):
                 op = {"Add": "Add", "Subtract": "Subtract",
                       "Multiply": "Product", "Average": "Average",
-                      "Maximum": "Max"}[cname]
+                      "Maximum": "Max", "Minimum": "Min"}[cname]
                 builder.addVertex(name, ElementWiseVertex(op=op), *srcs)
                 continue
             layer = _map_layer(cname, lcfg,

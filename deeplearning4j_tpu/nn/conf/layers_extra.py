@@ -930,3 +930,62 @@ class GravesBidirectionalLSTM(Layer):
         raise NotImplementedError(
             "rnnTimeStep is not supported for GravesBidirectionalLSTM "
             "(reference behavior: requires the full sequence)")
+
+
+# ----------------------------------------------------------------------
+# structural layers (Keras import parity: Permute / Reshape)
+# ----------------------------------------------------------------------
+@serializable
+@dataclasses.dataclass
+class PermuteLayer(Layer):
+    """Permute non-batch axes (Keras Permute; 1-indexed dims like
+    Keras). reference kin: KerasPermute mapper."""
+
+    dims: Tuple[int, ...] = ()
+
+    def has_params(self):
+        return False
+
+    def output_type(self, it: InputType) -> InputType:
+        dims = tuple(int(d) for d in self.dims)
+        if it.kind == "recurrent" and dims == (2, 1):
+            # [N,T,F] -> [N,F,T]
+            return InputType.recurrent(it.timeseries_length or 0, it.size)
+        if it.kind == "convolutional" and len(dims) == 3:
+            hwc = (it.height, it.width, it.channels)
+            p = tuple(hwc[d - 1] for d in dims)
+            return InputType.convolutional(p[0], p[1], p[2])
+        if dims == tuple(range(1, len(dims) + 1)):
+            return it  # identity permutation
+        raise ValueError(
+            f"Permute{dims} unsupported for input kind {it.kind!r}")
+
+    def apply(self, params, state, x, train, rng):
+        perm = (0,) + tuple(int(d) for d in self.dims)
+        return jnp.transpose(x, perm), state
+
+
+@serializable
+@dataclasses.dataclass
+class ReshapeLayer(Layer):
+    """Reshape non-batch axes (Keras Reshape). reference kin:
+    KerasReshape mapper."""
+
+    target_shape: Tuple[int, ...] = ()
+
+    def has_params(self):
+        return False
+
+    def output_type(self, it: InputType) -> InputType:
+        ts = tuple(int(d) for d in self.target_shape)
+        if len(ts) == 1:
+            return InputType.feedForward(ts[0])
+        if len(ts) == 2:
+            return InputType.recurrent(ts[1], ts[0])
+        if len(ts) == 3:
+            return InputType.convolutional(ts[0], ts[1], ts[2])
+        raise ValueError(f"unsupported Reshape target {ts}")
+
+    def apply(self, params, state, x, train, rng):
+        ts = tuple(int(d) for d in self.target_shape)
+        return jnp.reshape(x, (x.shape[0],) + ts), state

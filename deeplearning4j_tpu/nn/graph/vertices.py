@@ -96,6 +96,9 @@ class ElementWiseVertex(GraphVertex):
         elif op == "max":
             for x in inputs[1:]:
                 out = jnp.maximum(out, x)
+        elif op == "min":
+            for x in inputs[1:]:
+                out = jnp.minimum(out, x)
         else:
             raise ValueError(f"Unknown elementwise op: {self.op}")
         return out, state
