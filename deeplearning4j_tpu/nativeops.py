@@ -61,6 +61,12 @@ def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.dl4j_csv_parse.argtypes = [ctypes.c_char_p, ctypes.c_int64,
                                    ctypes.c_char, ctypes.c_int64,
                                    ctypes.c_int64, c_f32p]
+    c_u8p = ctypes.POINTER(ctypes.c_uint8)
+    lib.dl4j_image_resize_normalize_batch.restype = None
+    lib.dl4j_image_resize_normalize_batch.argtypes = [
+        c_u8p, ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        c_f32p, ctypes.c_int, ctypes.c_int,
+        ctypes.c_float, c_f32p, c_f32p, ctypes.c_int]
     return lib
 
 
@@ -185,4 +191,58 @@ def csv_parse(data: bytes, delimiter: str = ",",
 
 
 __all__ = ["native_available", "threshold_count", "threshold_encode",
-           "threshold_decode", "threshold_residual", "csv_parse"]
+           "threshold_decode", "threshold_residual", "csv_parse",
+           "image_resize_normalize"]
+
+
+# ---------------------------------------------------- image preprocessing
+def image_resize_normalize(batch: np.ndarray, out_h: int, out_w: int,
+                           scale: float = 1.0,
+                           mean=None, std=None,
+                           n_threads: int = 0) -> np.ndarray:
+    """Bilinear resize + per-channel normalize for a uint8 NHWC batch.
+
+    Native path: multithreaded C++ (native/image_preproc.cpp — the
+    NativeImageLoader/OpenCV role, SURVEY.md §2.26). Fallback: the same
+    half-pixel-centers math, vectorized numpy. Returns float32 NHWC
+    [N, out_h, out_w, C] computed as (resized * scale - mean) / std.
+    """
+    batch = np.ascontiguousarray(batch, np.uint8)
+    if batch.ndim == 3:
+        batch = batch[None]
+    n, sh, sw, c = batch.shape
+    mean_a = np.broadcast_to(
+        np.asarray(0.0 if mean is None else mean, np.float32),
+        (c,)).copy()
+    std_a = np.broadcast_to(
+        np.asarray(1.0 if std is None else std, np.float32),
+        (c,)).copy()
+    lib = _load()
+    if lib is not None:
+        out = np.empty((n, out_h, out_w, c), np.float32)
+        lib.dl4j_image_resize_normalize_batch(
+            batch.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            n, sh, sw, c,
+            _f32p(out), out_h, out_w,
+            ctypes.c_float(scale), _f32p(mean_a), _f32p(std_a),
+            n_threads)
+        return out
+    # numpy fallback — identical half-pixel-centers bilinear
+    ry, rx = sh / out_h, sw / out_w
+    fy = np.maximum((np.arange(out_h) + 0.5) * ry - 0.5, 0.0)
+    fx = np.maximum((np.arange(out_w) + 0.5) * rx - 0.5, 0.0)
+    y0 = fy.astype(np.int64)
+    x0 = fx.astype(np.int64)
+    y1 = np.minimum(y0 + 1, sh - 1)
+    x1 = np.minimum(x0 + 1, sw - 1)
+    wy = (fy - y0).astype(np.float32)[None, :, None, None]
+    wx = (fx - x0).astype(np.float32)[None, None, :, None]
+    b = batch.astype(np.float32)
+    p00 = b[:, y0][:, :, x0]
+    p01 = b[:, y0][:, :, x1]
+    p10 = b[:, y1][:, :, x0]
+    p11 = b[:, y1][:, :, x1]
+    top = p00 + (p01 - p00) * wx
+    bot = p10 + (p11 - p10) * wx
+    out = top + (bot - top) * wy
+    return (out * np.float32(scale) - mean_a) / std_a

@@ -1,0 +1,85 @@
+// Native image preprocessing: bilinear resize + normalize, batched and
+// multithreaded. Reference role: NativeImageLoader/ImageRecordReader's
+// OpenCV-native decode->resize->scale path (SURVEY.md §2.26) — the
+// host-side CPU-heavy stage of the CNN input pipeline. Decode stays in
+// PIL (libjpeg/zlib are already native); this covers the arithmetic.
+//
+// Sampling convention: half-pixel centers (src = (dst + 0.5) * scale -
+// 0.5), clamped to edges — TF's resize_bilinear(half_pixel_centers=
+// true) / torch align_corners=false. The numpy fallback in
+// nativeops.py implements exactly the same math.
+
+#include <algorithm>
+#include <cstdint>
+#include <thread>
+#include <vector>
+
+extern "C" {
+
+// One image: uint8 HWC -> float32 HWC, resized to (dh, dw), then
+// per-channel (x * scale - mean) / std.
+void dl4j_image_resize_normalize(
+    const uint8_t* src, int sh, int sw, int c,
+    float* dst, int dh, int dw,
+    float scale, const float* mean, const float* stddev) {
+  // coordinates in DOUBLE to match the numpy (float64) fallback
+  // bit-for-bit on non-representable ratios like 224/96
+  const double ry = static_cast<double>(sh) / dh;
+  const double rx = static_cast<double>(sw) / dw;
+  for (int y = 0; y < dh; ++y) {
+    double fy = (y + 0.5) * ry - 0.5;
+    if (fy < 0) fy = 0;
+    int y0 = static_cast<int>(fy);
+    int y1 = std::min(y0 + 1, sh - 1);
+    float wy = static_cast<float>(fy - y0);
+    for (int x = 0; x < dw; ++x) {
+      double fx = (x + 0.5) * rx - 0.5;
+      if (fx < 0) fx = 0;
+      int x0 = static_cast<int>(fx);
+      int x1 = std::min(x0 + 1, sw - 1);
+      float wx = static_cast<float>(fx - x0);
+      const uint8_t* p00 = src + (y0 * sw + x0) * c;
+      const uint8_t* p01 = src + (y0 * sw + x1) * c;
+      const uint8_t* p10 = src + (y1 * sw + x0) * c;
+      const uint8_t* p11 = src + (y1 * sw + x1) * c;
+      float* out = dst + (y * dw + x) * c;
+      for (int ch = 0; ch < c; ++ch) {
+        float top = p00[ch] + (p01[ch] - p00[ch]) * wx;
+        float bot = p10[ch] + (p11[ch] - p10[ch]) * wx;
+        float v = top + (bot - top) * wy;
+        out[ch] = (v * scale - mean[ch]) / stddev[ch];
+      }
+    }
+  }
+}
+
+// Batch of same-sized images, parallelized across images with a simple
+// std::thread fan-out (the reference's samediff::Threads role for host
+// work). n_threads <= 0 picks hardware_concurrency.
+void dl4j_image_resize_normalize_batch(
+    const uint8_t* src, int n, int sh, int sw, int c,
+    float* dst, int dh, int dw,
+    float scale, const float* mean, const float* stddev,
+    int n_threads) {
+  if (n_threads <= 0) {
+    unsigned hc = std::thread::hardware_concurrency();
+    n_threads = hc ? static_cast<int>(hc) : 4;
+  }
+  n_threads = std::min(n_threads, n > 0 ? n : 1);
+  const size_t in_stride = static_cast<size_t>(sh) * sw * c;
+  const size_t out_stride = static_cast<size_t>(dh) * dw * c;
+  std::vector<std::thread> pool;
+  pool.reserve(n_threads);
+  for (int t = 0; t < n_threads; ++t) {
+    pool.emplace_back([=]() {
+      for (int i = t; i < n; i += n_threads) {
+        dl4j_image_resize_normalize(src + i * in_stride, sh, sw, c,
+                                    dst + i * out_stride, dh, dw,
+                                    scale, mean, stddev);
+      }
+    });
+  }
+  for (auto& th : pool) th.join();
+}
+
+}  // extern "C"

@@ -129,3 +129,62 @@ class TestJaxCompressionAgreement:
         enc, _residual = encode_threshold(g, t)
         dev_idx = set(np.nonzero(np.asarray(enc))[0])
         assert host == dev_idx
+
+
+class TestNativeImagePreproc:
+    """native/image_preproc.cpp — bilinear resize + normalize batch
+    (the NativeImageLoader/OpenCV role, SURVEY §2.26)."""
+
+    def _batch(self, n=4, h=24, w=32, c=3):
+        return np.random.default_rng(0).integers(
+            0, 255, (n, h, w, c)).astype(np.uint8)
+
+    def test_native_matches_numpy_fallback_exactly(self, monkeypatch):
+        from deeplearning4j_tpu import nativeops as no
+        if not no.native_available():
+            pytest.skip("native lib unavailable")
+        # 24->18 / 32->18: NON-representable ratios (4/3, 16/9) — pins
+        # the double-precision coordinate math in the C++ path
+        b = self._batch()
+        got = no.image_resize_normalize(b, 18, 18, scale=1 / 255.0,
+                                        mean=[0.5, 0.4, 0.3],
+                                        std=[0.2, 0.2, 0.2])
+        monkeypatch.setenv("DL4J_TPU_DISABLE_NATIVE", "1")
+        monkeypatch.setattr(no, "_lib", None)
+        monkeypatch.setattr(no, "_tried", False)
+        ref = no.image_resize_normalize(b, 18, 18, scale=1 / 255.0,
+                                        mean=[0.5, 0.4, 0.3],
+                                        std=[0.2, 0.2, 0.2])
+        np.testing.assert_allclose(np.asarray(got), ref, rtol=1e-6,
+                                   atol=1e-6)
+
+    def test_scalar_mean_std_broadcast(self):
+        from deeplearning4j_tpu.datavec.image import batch_resize_normalize
+        b = self._batch(2)
+        out = batch_resize_normalize(b, 12, 12, scale=1.0, mean=127.5,
+                                     std=127.5)
+        assert out.shape == (2, 12, 12, 3)
+        assert np.abs(out).max() <= 1.0001
+
+    def test_identity_resize_is_exact(self):
+        from deeplearning4j_tpu.datavec.image import batch_resize_normalize
+        b = self._batch(2, 8, 8, 3)
+        out = batch_resize_normalize(b, 8, 8, scale=1.0)
+        np.testing.assert_allclose(out, b.astype(np.float32))
+
+    def test_single_image_and_grayscale(self):
+        from deeplearning4j_tpu.datavec.image import batch_resize_normalize
+        img = self._batch(1, 20, 20, 1)[0]
+        out = batch_resize_normalize(img, 10, 10)
+        assert out.shape == (1, 10, 10, 1)
+        assert out.dtype == np.float32
+
+    def test_downscale_averages(self):
+        from deeplearning4j_tpu.datavec.image import batch_resize_normalize
+        # checkerboard 0/255 -> 2x downscale samples at pixel pairs'
+        # midpoint => everything ~127.5 under half-pixel centers
+        b = np.zeros((1, 8, 8, 1), np.uint8)
+        b[0, ::2, 1::2, 0] = 255
+        b[0, 1::2, ::2, 0] = 255
+        out = batch_resize_normalize(b, 4, 4, scale=1.0)
+        np.testing.assert_allclose(out, 127.5, atol=0.6)
