@@ -196,6 +196,7 @@ def _register_standard_mappers():
     for tf_op, our in [("Add", "add"), ("AddV2", "add"), ("Sub", "sub"),
                        ("Mul", "mul"), ("RealDiv", "div"), ("Div", "div"),
                        ("FloorDiv", "floordiv"), ("Mod", "mod"),
+                       ("FloorMod", "floormod"),
                        ("Pow", "pow_pairwise"), ("Maximum", "maximum"),
                        ("Minimum", "minimum"),
                        ("SquaredDifference", "squared_difference"),
@@ -375,9 +376,9 @@ def _register_standard_mappers():
 
     @R("StridedSlice")
     def _strided_slice(ctx):
-        if ctx.attr("ellipsis_mask", 0) or ctx.attr("new_axis_mask", 0):
+        if ctx.attr("ellipsis_mask", 0):
             raise TFImportError(
-                f"{ctx.node.name}: StridedSlice ellipsis/new_axis masks "
+                f"{ctx.node.name}: StridedSlice ellipsis mask "
                 "not supported")
         begin = [int(b) for b in ctx.static_np(1)]
         end = [int(e) for e in ctx.static_np(2)]
@@ -385,9 +386,10 @@ def _register_standard_mappers():
         bm = int(ctx.attr("begin_mask", 0))
         em = int(ctx.attr("end_mask", 0))
         sm = int(ctx.attr("shrink_axis_mask", 0))
+        nm = int(ctx.attr("new_axis_mask", 0))
         return ctx.op("tf_strided_slice", ctx.inputs[:1], begin=begin,
                       end=end, strides=strides, begin_mask=bm, end_mask=em,
-                      shrink_axis_mask=sm)
+                      shrink_axis_mask=sm, new_axis_mask=nm)
 
     @R("GatherV2", "Gather")
     def _gather(ctx):
@@ -508,23 +510,34 @@ import jax.numpy as jnp  # noqa: E402
 
 @register_op("tf_strided_slice")
 def tf_strided_slice(x, begin=None, end=None, strides=None, begin_mask=0,
-                     end_mask=0, shrink_axis_mask=0):
-    """TF StridedSlice subset: begin/end/shrink masks, no ellipsis."""
+                     end_mask=0, shrink_axis_mask=0, new_axis_mask=0):
+    """TF StridedSlice subset: begin/end/shrink/new-axis masks, no
+    ellipsis. A new_axis position consumes one spec entry (its
+    begin/end/stride are ignored) and inserts a length-1 axis there."""
     slices = []
     shrink_axes = []
+    new_axes = []
+    out_pos = 0
     for i in range(len(begin)):
+        if new_axis_mask & (1 << i):
+            new_axes.append(out_pos)
+            out_pos += 1
+            continue
         if shrink_axis_mask & (1 << i):
             # begin=-1 means "last element": end must be None, not 0
             e = begin[i] + 1 if begin[i] != -1 else None
             slices.append(slice(begin[i], e, 1))
-            shrink_axes.append(i)
+            shrink_axes.append(len(slices) - 1)
             continue
         b = None if begin_mask & (1 << i) else begin[i]
         e = None if end_mask & (1 << i) else end[i]
         slices.append(slice(b, e, strides[i]))
+        out_pos += 1
     out = x[tuple(slices)]
     if shrink_axes:
         out = jnp.squeeze(out, axis=tuple(shrink_axes))
+    for pos in new_axes:
+        out = jnp.expand_dims(out, pos)
     return out
 
 

@@ -85,8 +85,16 @@ def _load() -> Optional[ctypes.CDLL]:
             return None
     try:
         _lib = _configure(ctypes.CDLL(_LIB_PATH))
-    except OSError:
+    except (OSError, AttributeError):
+        # AttributeError: a stale prebuilt .so missing a newer symbol —
+        # rebuild once, else fall back to numpy (never crash callers)
         _lib = None
+        try:
+            subprocess.run(["make", "-B", "-C", _NATIVE_DIR],
+                           capture_output=True, timeout=120, check=True)
+            _lib = _configure(ctypes.CDLL(_LIB_PATH))
+        except Exception:
+            _lib = None
     return _lib
 
 
@@ -238,10 +246,12 @@ def image_resize_normalize(batch: np.ndarray, out_h: int, out_w: int,
     wy = (fy - y0).astype(np.float32)[None, :, None, None]
     wx = (fx - x0).astype(np.float32)[None, None, :, None]
     b = batch.astype(np.float32)
-    p00 = b[:, y0][:, :, x0]
-    p01 = b[:, y0][:, :, x1]
-    p10 = b[:, y1][:, :, x0]
-    p11 = b[:, y1][:, :, x1]
+    by0 = b[:, y0]
+    by1 = b[:, y1]
+    p00 = by0[:, :, x0]
+    p01 = by0[:, :, x1]
+    p10 = by1[:, :, x0]
+    p11 = by1[:, :, x1]
     top = p00 + (p01 - p00) * wx
     bot = p10 + (p11 - p10) * wx
     out = top + (bot - top) * wy

@@ -486,3 +486,116 @@ class TestRealBertBaseImport:
              np.zeros((4, seq), np.int32)], [targets])
         hist = sd.fit(mds, epochs=20)
         assert hist.loss_curve[-1] < hist.loss_curve[0] * 0.7
+
+
+# ---------------------------------------------------------------------
+# Golden battery (reference: TFGraphTestAllSameDiff — hundreds of
+# frozen graphs imported and compared node-by-node against stored TF
+# outputs, SURVEY.md §4; here the TF outputs are computed live).
+# ---------------------------------------------------------------------
+_RNG = np.random.default_rng(42)
+_F44 = _RNG.normal(size=(4, 4)).astype(np.float32)
+_F34 = _RNG.normal(size=(3, 4)).astype(np.float32)
+_P44 = _RNG.uniform(0.2, 2.0, (4, 4)).astype(np.float32)
+_I4 = np.asarray([2, 0, 3, 1], np.int32)
+_IMG = _RNG.normal(size=(2, 8, 8, 3)).astype(np.float32)
+
+BATTERY = {
+    "abs_neg_sign": (lambda a: tf.abs(a) + tf.sign(a) - tf.negative(a),
+                     [_F44]),
+    "exp_log_sqrt": (lambda a: tf.exp(tf.math.log(a)) + tf.sqrt(a),
+                     [_P44]),
+    "rsqrt_square": (lambda a: tf.math.rsqrt(a) * tf.square(a), [_P44]),
+    "floor_ceil_round": (lambda a: tf.floor(a) + tf.math.ceil(a)
+                         + tf.round(a), [_F44 * 3]),
+    "pow_maximum_minimum": (lambda a, b: tf.pow(a, 2.0)
+                            + tf.maximum(a, b) - tf.minimum(a, b),
+                            [_P44, _P44.T.copy()]),
+    "floordiv_mod": (lambda a, b: tf.math.floordiv(a, b)
+                     + tf.math.mod(a, b), [_F44 * 5, _P44]),
+    "trig": (lambda a: tf.sin(a) + tf.cos(a) + tf.tan(a * 0.3), [_F44]),
+    "hyperbolic": (lambda a: tf.sinh(a) + tf.cosh(a) + tf.tanh(a),
+                   [_F44 * 0.5]),
+    "erf_gelu_chain": (lambda a: tf.nn.gelu(a) + tf.math.erf(a), [_F44]),
+    "sigmoid_softplus_softsign": (
+        lambda a: tf.sigmoid(a) + tf.math.softplus(a)
+        + tf.math.softsign(a), [_F44]),
+    "elu_selu_relu6": (lambda a: tf.nn.elu(a) + tf.nn.selu(a)
+                       + tf.nn.relu6(a), [_F44 * 2]),
+    "leaky_softmax_logsoftmax": (
+        lambda a: tf.nn.leaky_relu(a, 0.3)
+        + tf.nn.softmax(a) + tf.nn.log_softmax(a), [_F44]),
+    "reduce_family": (
+        lambda a: tf.reduce_sum(a, 1) + tf.reduce_mean(a, 1)
+        + tf.reduce_max(a, 1) + tf.reduce_min(a, 1)
+        + tf.reduce_prod(a * 0.5, 1), [_P44]),
+    "argmax_cast": (lambda a: tf.cast(tf.argmax(a, axis=1), tf.float32),
+                    [_F44]),
+    "comparisons_where": (
+        lambda a, b: tf.where(tf.greater(a, b), a, b)
+        + tf.cast(tf.less_equal(a, b), tf.float32), [_F44, _F44.T.copy()]),
+    "logical_ops": (
+        lambda a, b: tf.cast(
+            tf.logical_and(a > 0, b > 0) | tf.logical_not(a > 0),
+            tf.float32), [_F44, _F44.T.copy()]),
+    "concat_split_stack": (
+        lambda a, b: tf.stack(tf.split(tf.concat([a, b], 1), 2, axis=1),
+                              axis=0), [_F34, _F34]),
+    "unstack_tile": (
+        lambda a: tf.tile(tf.unstack(a, axis=0)[1][None], [2, 1]),
+        [_F34]),
+    "pad_padv2": (
+        lambda a: tf.pad(a, [[1, 0], [0, 2]])
+        + tf.pad(a, [[1, 0], [0, 2]], constant_values=0.0), [_F34]),
+    "slice_strided": (
+        lambda a: tf.slice(a, [1, 0], [2, 3]) + a[1:3, :3], [_F44]),
+    "strided_negative_step": (lambda a: a[::-1, 1:], [_F44]),
+    "transpose_expand_squeeze": (
+        lambda a: tf.squeeze(tf.expand_dims(tf.transpose(a), 0), 0),
+        [_F34]),
+    "reshape_flatten": (
+        lambda a: tf.reshape(a, [-1]) , [_F34]),
+    "gather_onehot": (
+        lambda a, i: tf.gather(a, i)
+        + tf.one_hot(i, 4, dtype=tf.float32), [_F44, _I4]),
+    "matmul_transposed": (
+        lambda a, b: tf.matmul(a, b, transpose_b=True), [_F34, _F34]),
+    "batch_matmul": (
+        lambda a: tf.matmul(tf.stack([a, a]),
+                            tf.stack([tf.transpose(a),
+                                      tf.transpose(a)])), [_F34]),
+    "bias_add": (lambda a: tf.nn.bias_add(a, tf.constant(
+        [1.0, 2.0, 3.0, 4.0])), [_F44]),
+    "addn": (lambda a, b: tf.add_n([a, b, a]), [_F44, _F44]),
+    "squared_difference_div": (
+        lambda a, b: tf.math.squared_difference(a, b)
+        + tf.math.divide(a, b), [_F44, _P44]),
+    "range_fill": (
+        lambda a: a + tf.fill([4, 4], 2.0)
+        + tf.cast(tf.range(0, 4, 1), tf.float32)[None], [_F44]),
+    "conv_relu_pool": (
+        lambda x: tf.nn.max_pool2d(
+            tf.nn.relu(tf.nn.conv2d(
+                x, tf.constant(_RNG.normal(size=(3, 3, 3, 4))
+                               .astype(np.float32) * 0.2),
+                strides=1, padding="SAME")), 2, 2, "VALID"), [_IMG]),
+    "depthwise_avgpool": (
+        lambda x: tf.nn.avg_pool2d(
+            tf.nn.depthwise_conv2d(
+                x, tf.constant(_RNG.normal(size=(3, 3, 3, 2))
+                               .astype(np.float32) * 0.2),
+                strides=[1, 1, 1, 1], padding="SAME"), 2, 2, "VALID"),
+        [_IMG]),
+    "stop_gradient_identity": (
+        lambda a: tf.stop_gradient(a) + tf.identity(a), [_F44]),
+    "clipping": (lambda a: tf.clip_by_value(a, -0.5, 0.5), [_F44]),
+    "select_v2_broadcast": (
+        lambda a: tf.where(a > 0, a, tf.zeros_like(a)), [_F44]),
+}
+
+
+class TestTFGoldenBattery:
+    @pytest.mark.parametrize("name", sorted(BATTERY))
+    def test_graph(self, name):
+        fn, feeds = BATTERY[name]
+        _run_both(fn, feeds, rtol=2e-4, atol=2e-5)
