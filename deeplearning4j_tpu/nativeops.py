@@ -86,15 +86,16 @@ def _load() -> Optional[ctypes.CDLL]:
     try:
         _lib = _configure(ctypes.CDLL(_LIB_PATH))
     except (OSError, AttributeError):
-        # AttributeError: a stale prebuilt .so missing a newer symbol —
-        # rebuild once, else fall back to numpy (never crash callers)
+        # AttributeError: a stale prebuilt .so missing a newer symbol.
+        # Fall back to numpy for THIS process (dlopen caches by path,
+        # so a same-process reload would return the stale handle) and
+        # kick off a rebuild so the next process gets the new lib.
         _lib = None
         try:
             subprocess.run(["make", "-B", "-C", _NATIVE_DIR],
-                           capture_output=True, timeout=120, check=True)
-            _lib = _configure(ctypes.CDLL(_LIB_PATH))
+                           capture_output=True, timeout=120, check=False)
         except Exception:
-            _lib = None
+            pass
     return _lib
 
 
