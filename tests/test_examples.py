@@ -34,3 +34,16 @@ class TestExamples:
     def test_data_parallel(self):
         acc = _run("data_parallel_training.py").main()
         assert acc > 0.9
+
+    def test_resnet50_training(self):
+        score = _run("resnet50_training.py").main(steps=3, batch=8,
+                                                  num_classes=5)
+        import numpy as np
+        assert np.isfinite(score)
+
+    def test_tf_import_bert_example(self):
+        pytest.importorskip("tensorflow")
+        pytest.importorskip("transformers")
+        improved = _run("tf_import_bert.py").main(layers=1, hidden=32,
+                                                  steps=10)
+        assert improved
