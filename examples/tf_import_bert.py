@@ -70,11 +70,13 @@ def main(layers: int = 2, hidden: int = 64, steps: int = 15):
 
     # promote frozen weights -> trainables (one atomic call), attach an
     # MLM loss, fit
-    to_promote = [
-        v.name for v in sd.variables()
-        if v.vtype.value == "CONSTANT"
-        and np.asarray(v.getArr()).ndim >= 2
-        and np.asarray(v.getArr()).dtype.kind == "f"]
+    def _is_weight(v):
+        if v.vtype.value != "CONSTANT":
+            return False
+        a = np.asarray(v.getArr())
+        return a.ndim >= 2 and a.dtype.kind == "f"
+
+    to_promote = [v.name for v in sd.variables() if _is_weight(v)]
     sd.convertConstantsToVariables(*to_promote)
 
     y = sd.placeholder("y_ids", shape=(None, seq))
