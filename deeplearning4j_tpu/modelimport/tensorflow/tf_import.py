@@ -675,7 +675,19 @@ class _PartialEval:
                 return np.arange(int(vals[0]), int(vals[1]),
                                  int(vals[2]), dtype=np.int64)
             if op == "Squeeze":
-                return np.squeeze(vals[0]) if _int(vals[0]) else None
+                if not _int(vals[0]):
+                    return None
+                v = np.asarray(vals[0])
+                dims = tuple(int(d) for d in
+                             attrs.get("squeeze_dims",
+                                       attrs.get("axis", [])) or ())
+                if not dims:
+                    return np.squeeze(v)
+                try:  # axis on a non-unit dim: TF errors; don't fold
+                    return np.squeeze(
+                        v, axis=tuple(d % max(v.ndim, 1) for d in dims))
+                except ValueError:
+                    return None
             if op == "ExpandDims":
                 if not (_int(vals[0]) and _int(vals[1])):
                     return None

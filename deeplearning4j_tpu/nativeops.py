@@ -20,11 +20,14 @@ is live. Set DL4J_TPU_DISABLE_NATIVE=1 to force the fallback.
 from __future__ import annotations
 
 import ctypes
+import logging
 import os
 import subprocess
 from typing import Optional, Tuple
 
 import numpy as np
+
+_log = logging.getLogger(__name__)
 
 _NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "native")
@@ -70,6 +73,51 @@ def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
     return lib
 
 
+def _build_locked(force: bool) -> bool:
+    """Build the native lib to a temp file and atomically rename it over
+    ``_LIB_PATH``, serialized across processes with a non-blocking
+    lockfile. Concurrent jax.distributed workers / elastic-recovery
+    processes must never race writers against sibling ``dlopen()``
+    calls, and losers of the lock skip the rebuild (numpy fallback is
+    always available) instead of stacking duplicate 120 s ``make``
+    runs. Returns True iff this process (re)built the lib."""
+    import fcntl
+    lock_path = os.path.join(_NATIVE_DIR, ".build.lock")
+    try:
+        lock = open(lock_path, "w")
+    except OSError:
+        return False
+    try:
+        try:
+            fcntl.flock(lock, fcntl.LOCK_EX | fcntl.LOCK_NB)
+        except OSError:
+            return False  # someone else is building; don't pile on
+        if not force and os.path.exists(_LIB_PATH):
+            return False  # raced: winner already produced it
+        tmp = os.path.join(_NATIVE_DIR,
+                           f".libdl4jtpu_native.{os.getpid()}.so")
+        _log.info("building native lib (%s)",
+                  "forced rebuild" if force else "first build")
+        try:
+            # name the goal explicitly: dotfile targets are skipped by
+            # make's default-goal selection
+            subprocess.run(
+                ["make", "-C", _NATIVE_DIR, "-B",
+                 f"TARGET={os.path.basename(tmp)}", os.path.basename(tmp)],
+                capture_output=True, timeout=120, check=True)
+            os.replace(tmp, _LIB_PATH)  # atomic on same fs
+            return True
+        except Exception as e:
+            _log.info("native build failed, using numpy fallbacks: %s", e)
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            return False
+    finally:
+        lock.close()
+
+
 def _load() -> Optional[ctypes.CDLL]:
     global _lib, _tried
     if _lib is not None or _tried:
@@ -77,11 +125,8 @@ def _load() -> Optional[ctypes.CDLL]:
     _tried = True
     if os.environ.get("DL4J_TPU_DISABLE_NATIVE"):
         return None
-    if not os.path.exists(_LIB_PATH):
-        try:
-            subprocess.run(["make", "-C", _NATIVE_DIR],
-                           capture_output=True, timeout=120, check=True)
-        except Exception:
+    if not os.path.exists(_LIB_PATH) and not _build_locked(force=False):
+        if not os.path.exists(_LIB_PATH):
             return None
     try:
         _lib = _configure(ctypes.CDLL(_LIB_PATH))
@@ -89,13 +134,12 @@ def _load() -> Optional[ctypes.CDLL]:
         # AttributeError: a stale prebuilt .so missing a newer symbol.
         # Fall back to numpy for THIS process (dlopen caches by path,
         # so a same-process reload would return the stale handle) and
-        # kick off a rebuild so the next process gets the new lib.
+        # rebuild — atomically, behind the lock — so the NEXT process
+        # gets the fresh lib.
+        _log.info("stale native lib at %s; numpy fallback this process, "
+                  "triggering atomic rebuild", _LIB_PATH)
         _lib = None
-        try:
-            subprocess.run(["make", "-B", "-C", _NATIVE_DIR],
-                           capture_output=True, timeout=120, check=False)
-        except Exception:
-            pass
+        _build_locked(force=True)
     return _lib
 
 

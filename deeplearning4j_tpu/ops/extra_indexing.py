@@ -82,9 +82,10 @@ def bincount(x, weights=None, minlength=0, maxlength=None):
     """Static output size (jit-safe). TF semantics: ``maxlength`` CAPS
     the bin count (values >= maxlength are dropped); ``minlength``
     guarantees a floor."""
-    nbins = minlength
-    if maxlength is not None:
-        nbins = min(nbins, maxlength) if nbins else maxlength
+    # maxlength CAPS the count of values (>= maxlength dropped) but the
+    # static output size must still cover [minlength, maxlength) — a
+    # min() here would silently drop counts in that range.
+    nbins = maxlength if maxlength is not None else minlength
     if nbins <= 0:
         raise ValueError("bincount needs a static minlength/maxlength "
                          "under jit")

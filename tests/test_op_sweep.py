@@ -522,6 +522,12 @@ CASES2 = {
                         (jnp.asarray([0, 1, 1, 3], jnp.int32),),
                         {"minlength": 10, "maxlength": 3}, None,
                         lambda o: npx(o).tolist() == [1, 2, 0]),
+    # 0 < minlength < maxlength: output sized to maxlength; counts in
+    # [minlength, maxlength) must NOT be dropped
+    "bincount_min_max": ("bincount",
+                         (jnp.asarray([0, 1, 1, 3, 4], jnp.int32),),
+                         {"minlength": 2, "maxlength": 5}, None,
+                         lambda o: npx(o).tolist() == [1, 2, 0, 1, 1]),
     "searchsorted": ((jnp.asarray([1.0, 2.0, 4.0]),
                       jnp.asarray([0.5, 3.0])), {}, None,
                      lambda o: npx(o).tolist() == [0, 2]),
@@ -693,10 +699,10 @@ def test_numeric_gradient(opname):
     args, kwargs = GRAD_CASES[opname]
     fn = get_op(opname)
 
-    swap = kwargs.pop("_swap", False) if isinstance(kwargs, dict) \
-        else False
+    # copy BEFORE popping: GRAD_CASES is shared module state and a
+    # repeated run of the same param must still see _swap
     kwargs = dict(kwargs)
-    kwargs.pop("_swap", None)
+    swap = kwargs.pop("_swap", False)
 
     def scalar_loss(x0):
         call = args[1:] + (x0,) if swap else (x0,) + args[1:]
