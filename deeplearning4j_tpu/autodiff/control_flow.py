@@ -94,6 +94,15 @@ def if_cond(pred, *operands, true_graph=None, false_graph=None):
     return res[0] if len(res) == 1 else tuple(res)
 
 
+@register_op("call_graph")
+def call_graph(*args, graph=None):
+    """Direct sub-graph invocation (TF PartitionedCall import): the
+    function body is traced inline into the parent jit — XLA sees one
+    flat program, the function-call boundary disappears."""
+    res = subgraph_fn(graph)(*args)
+    return res[0] if len(res) == 1 else tuple(res)
+
+
 @register_op("while_loop")
 def while_loop(*init_vars, cond_graph=None, body_graph=None):
     """lax.while_loop over serialized cond/body sub-graphs; loop state is

@@ -380,16 +380,53 @@ def _register_standard_mappers():
             raise TFImportError(
                 f"{ctx.node.name}: StridedSlice ellipsis mask "
                 "not supported")
-        begin = [int(b) for b in ctx.static_np(1)]
-        end = [int(e) for e in ctx.static_np(2)]
-        strides = [int(s) for s in ctx.static_np(3)]
         bm = int(ctx.attr("begin_mask", 0))
         em = int(ctx.attr("end_mask", 0))
         sm = int(ctx.attr("shrink_axis_mask", 0))
         nm = int(ctx.attr("new_axis_mask", 0))
+        try:
+            begin = [int(b) for b in ctx.static_np(1)]
+            end = [int(e) for e in ctx.static_np(2)]
+            strides = [int(s) for s in ctx.static_np(3)]
+        except TFImportError:
+            return _strided_slice_dynamic(ctx, bm, em, sm, nm)
         return ctx.op("tf_strided_slice", ctx.inputs[:1], begin=begin,
                       end=end, strides=strides, begin_mask=bm, end_mask=em,
                       shrink_axis_mask=sm, new_axis_mask=nm)
+
+    def _strided_slice_dynamic(ctx, bm, em, sm, nm):
+        """Loop-counter indexing (``a[:, i]``, ``a[i]``): begin/end hold
+        traced scalars. Supported subset: unit strides, no new-axis,
+        dynamic entries only on shrink dims (size-1 runtime index) —
+        lowered to lax.dynamic_slice which XLA keeps on-device."""
+        if nm:
+            raise TFImportError(
+                f"{ctx.node.name}: dynamic StridedSlice with "
+                "new_axis_mask not supported")
+        begin = np.atleast_1d(ctx.partial_np(1)).astype(np.int64)
+        end = np.atleast_1d(ctx.partial_np(2)).astype(np.int64)
+        strides = np.atleast_1d(ctx.partial_np(3)).astype(np.int64)
+        if np.any(_is_dyn(strides)) or not np.all(strides == 1):
+            raise TFImportError(
+                f"{ctx.node.name}: dynamic StridedSlice requires unit "
+                "strides")
+        b_spec: List[Optional[int]] = []
+        e_spec: List[Optional[int]] = []
+        for d in range(len(begin)):
+            b_dyn = bool(_is_dyn(begin[d]))
+            e_dyn = bool(_is_dyn(end[d]))
+            if (b_dyn or e_dyn) and not (sm & (1 << d)) \
+                    and not ((bm & (1 << d)) and (em & (1 << d))):
+                raise TFImportError(
+                    f"{ctx.node.name}: dynamic StridedSlice begin/end "
+                    f"at dim {d} without shrink_axis_mask (only size-1 "
+                    "runtime indexing is importable)")
+            b_spec.append(None if b_dyn else int(begin[d]))
+            e_spec.append(None if e_dyn else int(end[d]))
+        return ctx.op("tf_strided_slice_dyn",
+                      [ctx.inputs[0], ctx.inputs[1]],
+                      begin=b_spec, end=e_spec, begin_mask=bm,
+                      end_mask=em, shrink_axis_mask=sm)
 
     @R("GatherV2", "Gather")
     def _gather(ctx):
@@ -488,6 +525,35 @@ def _register_standard_mappers():
                       strides=(int(st[1]), int(st[2])),
                       padding="SAME" if pad == "SAME" else "VALID")
 
+    def _diag_guard(ctx):
+        """MatrixDiag/Part/SetDiag V2/V3 extra operands (k, num_rows,
+        num_cols, padding_value) — only the defaults (main diagonal,
+        square, zero padding) map onto the square diag ops."""
+        extras = ctx.inputs[2 if ctx.node.op.startswith("MatrixSetDiag")
+                            else 1:]
+        for i in range(len(extras)):
+            base = 2 if ctx.node.op.startswith("MatrixSetDiag") else 1
+            v = np.atleast_1d(ctx.static_np(base + i))
+            if not (np.all(v == 0) or np.all(v == -1)):
+                raise TFImportError(
+                    f"{ctx.node.name} ({ctx.node.op}): only k=0 main-"
+                    "diagonal square form is importable")
+
+    @R("MatrixDiag", "MatrixDiagV2", "MatrixDiagV3")
+    def _matrix_diag(ctx):
+        _diag_guard(ctx)
+        return ctx.op("matrix_diag", ctx.inputs[:1])
+
+    @R("MatrixDiagPart", "MatrixDiagPartV2", "MatrixDiagPartV3")
+    def _matrix_diag_part(ctx):
+        _diag_guard(ctx)
+        return ctx.op("diag_part", ctx.inputs[:1])
+
+    @R("MatrixSetDiag", "MatrixSetDiagV2", "MatrixSetDiagV3")
+    def _matrix_set_diag(ctx):
+        _diag_guard(ctx)
+        return ctx.op("matrix_set_diag", ctx.inputs[:2])
+
     @R("FusedBatchNorm", "FusedBatchNormV2", "FusedBatchNormV3")
     def _fused_bn(ctx):
         if ctx.attr("is_training", True):
@@ -503,54 +569,10 @@ def _register_standard_mappers():
 _register_standard_mappers()
 
 
-# ---- helper ops that exist only for TF-import semantics --------------
-from deeplearning4j_tpu.ops.registry import register_op  # noqa: E402
-import jax.numpy as jnp  # noqa: E402
-
-
-@register_op("tf_strided_slice")
-def tf_strided_slice(x, begin=None, end=None, strides=None, begin_mask=0,
-                     end_mask=0, shrink_axis_mask=0, new_axis_mask=0):
-    """TF StridedSlice subset: begin/end/shrink/new-axis masks, no
-    ellipsis. A new_axis position consumes one spec entry (its
-    begin/end/stride are ignored) and inserts a length-1 axis there."""
-    slices = []
-    shrink_axes = []
-    new_axes = []
-    out_pos = 0
-    for i in range(len(begin)):
-        if new_axis_mask & (1 << i):
-            new_axes.append(out_pos)
-            out_pos += 1
-            continue
-        if shrink_axis_mask & (1 << i):
-            # begin=-1 means "last element": end must be None, not 0
-            e = begin[i] + 1 if begin[i] != -1 else None
-            slices.append(slice(begin[i], e, 1))
-            shrink_axes.append(len(slices) - 1)
-            continue
-        b = None if begin_mask & (1 << i) else begin[i]
-        e = None if end_mask & (1 << i) else end[i]
-        slices.append(slice(b, e, strides[i]))
-        out_pos += 1
-    out = x[tuple(slices)]
-    if shrink_axes:
-        out = jnp.squeeze(out, axis=tuple(shrink_axes))
-    for pos in new_axes:
-        out = jnp.expand_dims(out, pos)
-    return out
-
-
-@register_op("tf_fill")
-def tf_fill(shape=None, value=0.0):
-    return jnp.full(tuple(shape), value)
-
-
-@register_op("erfc")
-def erfc(x):
-    import jax
-    return jax.scipy.special.erfc(x)
-
+# The ops these mappers emit by TF attr convention (tf_strided_slice,
+# tf_fill, erfc, ...) are registered in deeplearning4j_tpu.ops.tf_compat
+# so graph LOADING never needs this module.
+from deeplearning4j_tpu.ops import tf_compat as _tf_compat  # noqa: E402,F401
 
 OpMappingRegistry.register("Erfc")(
     lambda ctx: ctx.op("erfc", ctx.inputs[:1]))
@@ -725,6 +747,280 @@ class _PartialEval:
 
 
 # ----------------------------------------------------------------- import
+class _Walker:
+    """One import scope: the top-level GraphDef, a FunctionDef body, or
+    a control-flow sub-graph (reference: ImportGraph walks the graph and
+    its function library; AbstractSession owns frames — here frames are
+    RECONSTRUCTED at import into while_loop/if_cond ops so the whole
+    graph still compiles to one XLA executable, SURVEY.md §3.4)."""
+
+    def __init__(self, sd: SameDiff, library=None, pe=None):
+        self.sd = sd
+        self.library = library or {}
+        self.pe = pe
+        # tensor key ("node" / "node:k") -> SDVariable
+        self.tensors: Dict[str, SDVariable] = {}
+        self.const_vals: Dict[str, np.ndarray] = {}
+        # node name -> import-time folded value (may contain DYN)
+        self.partials: Dict[str, np.ndarray] = {}
+        # SDVariable name -> (aval under probe batch=2, probe batch=3)
+        self.avals: Dict[str, Tuple[Any, Any]] = {}
+        # tensor key -> {pred var name: bool} (v1 Switch/Merge lowering)
+        self.branch_tags: Dict[str, Dict[str, bool]] = {}
+        self.nodes_by_name: Dict[str, Any] = {}
+
+    # ------------------------------------------------------------ helpers
+    @staticmethod
+    def resolve(ref: str) -> Tuple[str, int]:
+        if ":" in ref:
+            name, idx = ref.rsplit(":", 1)
+            return name, int(idx)
+        return ref, 0
+
+    def lookup(self, ref: str) -> SDVariable:
+        src, idx = self.resolve(ref)
+        key = f"{src}:{idx}" if idx else src
+        if key not in self.tensors and f"{src}:{idx}" in self.tensors:
+            key = f"{src}:{idx}"
+        if key not in self.tensors:
+            raise TFImportError(f"unresolved tensor ref {ref!r}")
+        return self.tensors[key]
+
+    def _propagate_avals(self, from_idx: int) -> None:
+        """Two-probe abstract shape eval for ops appended since
+        from_idx (mappers may emit several chained ops). Gated on pe:
+        importGraph enables it for graphs with shape subgraphs, control
+        flow, or runtime indexing; control-flow sub-imports always have
+        it (dynamic StridedSlice detection needs ranks/dtypes)."""
+        if self.pe is None:
+            return
+        import jax
+
+        from deeplearning4j_tpu.ops.registry import get_op
+
+        for opnode in self.sd._ops[from_idx:]:
+            fn = get_op(opnode.op_name)
+            pair = []
+            for probe in (0, 1):
+                ins = []
+                for iname in opnode.inputs:
+                    if iname in self.avals:
+                        ins.append(self.avals[iname][probe])
+                    elif iname in self.sd._arrays:
+                        a = self.sd._arrays[iname]
+                        ins.append(jax.ShapeDtypeStruct(
+                            tuple(a.shape), a.dtype))
+                    else:
+                        ins = None
+                        break
+                if ins is None:
+                    pair = None
+                    break
+                try:
+                    out = jax.eval_shape(
+                        lambda *a: fn(*a, **opnode.attrs), *ins)
+                except Exception as _e:
+                    import os as _os
+                    if _os.environ.get("DL4J_TF_IMPORT_DEBUG"):
+                        print(f"aval-fail {opnode.op_name} "
+                              f"{opnode.outputs[0][-60:]}: "
+                              f"{type(_e).__name__}: {_e}")
+                    pair = None
+                    break
+                pair.append(list(out) if isinstance(out, (list, tuple))
+                            else [out])
+            if pair is None:
+                continue
+            for k, on in enumerate(opnode.outputs):
+                if k < len(pair[0]):
+                    self.avals[on] = (pair[0][k], pair[1][k])
+
+    def _gather_tags(self, node) -> Dict[str, bool]:
+        """Union of branch tags over a node's data AND control inputs
+        (v1 cond pipes branch constants to Merge with only a control
+        edge from the branch pivot, so control edges carry tags too)."""
+        tags: Dict[str, bool] = {}
+        for ref in node.input:
+            key = ref
+            if ref.startswith("^"):
+                key = ref[1:]
+            else:
+                src, idx = self.resolve(ref)
+                key = f"{src}:{idx}" if idx else src
+                if key not in self.branch_tags and \
+                        f"{src}:{idx}" in self.branch_tags:
+                    key = f"{src}:{idx}"
+            t = self.branch_tags.get(key)
+            if t:
+                for p, b in t.items():
+                    if p in tags and tags[p] != b:
+                        # both branches feed this node: it is post-merge
+                        # or pred-side; the tag cancels
+                        tags.pop(p)
+                    else:
+                        tags[p] = b
+        return tags
+
+    # --------------------------------------------------------------- walk
+    def walk(self, nodes: Sequence[Any]) -> None:
+        from deeplearning4j_tpu.modelimport.tensorflow.cf_import import (
+            plan_v1_frames,
+        )
+
+        for n in nodes:
+            self.nodes_by_name.setdefault(n.name, n)
+        skip, exit_map, plans = plan_v1_frames(self, nodes)
+        emitted: Dict[str, Tuple[SDVariable, ...]] = {}
+        for node in nodes:
+            if node.name in exit_map:
+                frame_key, var_idx = exit_map[node.name]
+                if frame_key not in emitted:
+                    emitted[frame_key] = plans[frame_key].emit(self)
+                v = emitted[frame_key][var_idx]
+                # downstream refs use the Exit node's name
+                if node.name not in self.sd._vars:
+                    old = v.name
+                    v.rename(node.name)
+                    if old in self.avals:
+                        self.avals[node.name] = self.avals.pop(old)
+                self.tensors[node.name] = v
+                self.tensors[node.name + ":0"] = v
+                continue
+            if node.name in skip:
+                continue
+            self.process_node(node)
+
+    def process_node(self, node) -> None:
+        import jax
+
+        from deeplearning4j_tpu.modelimport.tensorflow import cf_import
+
+        sd = self.sd
+        attrs = _decode_attrs(node)
+        if node.op in ("NoOp", "Assert"):
+            # Assert: runtime-check node, consumed via control edges
+            # only — the reference importer likewise drops it.
+            return
+        if node.op == "Const":
+            from tensorflow.python.framework import tensor_util
+
+            val = tensor_util.MakeNdarray(node.attr["value"].tensor)
+            if val.dtype.kind in "OSU":
+                # string consts (Assert messages etc.) have no JAX
+                # representation; their only consumers are dropped
+                # check nodes
+                self.const_vals[node.name] = val
+                return
+            v = sd.constant(node.name, val)
+            if v.name != node.name:
+                raise TFImportError(f"duplicate node name {node.name!r}")
+            self.tensors[node.name] = v
+            self.tensors[node.name + ":0"] = v
+            self.const_vals[node.name] = val
+            aval = jax.ShapeDtypeStruct(tuple(val.shape), val.dtype)
+            self.avals[v.name] = (aval, aval)
+            return
+        if node.op in ("Placeholder", "PlaceholderWithDefault"):
+            shape = attrs.get("shape")
+            shape = [None if d in (-1, None) else int(d)
+                     for d in shape] if shape else None
+            v = sd.placeholder(node.name, shape=shape,
+                               dtype=attrs.get("dtype", "float32"))
+            self.tensors[node.name] = v
+            self.tensors[node.name + ":0"] = v
+            if shape is not None:
+                dt = np.dtype(attrs.get("dtype", "float32"))
+                # distinct probe pairs PER DIM INDEX (dim i ->
+                # (2+2i, 3+2i)) so two dynamic dims of one
+                # placeholder (e.g. [None, None] batch+seq) stay
+                # distinguishable in resolve_dyn_dim; the same dim
+                # index across placeholders shares a pair so
+                # cross-placeholder elementwise ops still probe
+                # consistently.
+                self.avals[v.name] = tuple(
+                    jax.ShapeDtypeStruct(
+                        tuple(p + 2 * i if d is None else d
+                              for i, d in enumerate(shape)), dt)
+                    for p in (2, 3))
+            return
+
+        in_vars: List[SDVariable] = []
+        statics: List[Optional[np.ndarray]] = []
+        in_refs: List[Tuple[str, int]] = []
+        for ref in node.input:
+            if ref.startswith("^"):  # control edge: ordering only
+                continue
+            src, idx = self.resolve(ref)
+            key = f"{src}:{idx}" if idx else src
+            if key not in self.tensors and \
+                    f"{src}:{idx}" in self.tensors:
+                key = f"{src}:{idx}"
+            if key not in self.tensors:
+                raise TFImportError(
+                    f"node {node.name}: unresolved input {ref!r}")
+            in_vars.append(self.tensors[key])
+            sv = self.const_vals.get(src) if idx == 0 else None
+            if sv is None and idx == 0:
+                sv = self.partials.get(src)
+            if sv is None:
+                # a traced integer scalar/small vector (loop counter,
+                # runtime begin index) becomes a DYN-valued partial so
+                # shape/index subgraphs fold around it and mappers with
+                # a dynamic fallback (StridedSlice) can engage it
+                p = self.avals.get(self.tensors[key].name)
+                if p is not None and p[0].shape == p[1].shape and \
+                        np.issubdtype(p[0].dtype, np.integer) and \
+                        len(p[0].shape) <= 1 and \
+                        int(np.prod(p[0].shape, dtype=np.int64)) <= 16:
+                    sv = np.full(p[0].shape, DYN, np.int64)
+            statics.append(sv)
+            in_refs.append((src, idx))
+
+        # v1 cond lowering + functional (v2) control flow live in
+        # cf_import; they need walker state, not just a _Ctx
+        if node.op in cf_import.WALKER_OPS:
+            n_before = len(sd._ops)
+            cf_import.WALKER_OPS[node.op](self, node, in_vars, in_refs)
+            self._propagate_avals(n_before)
+            return
+
+        if self.pe is not None:
+            shape_pairs = []
+            for v in in_vars:
+                p = self.avals.get(v.name)
+                shape_pairs.append(
+                    (tuple(p[0].shape), tuple(p[1].shape))
+                    if p is not None else None)
+            pv = self.pe.eval(node, attrs, statics, shape_pairs,
+                              [v.name for v in in_vars])
+            if pv is not None:
+                self.partials[node.name] = np.asarray(pv)
+
+        mapper = OpMappingRegistry.get(node.op)
+        ctx = _Ctx(sd, node, in_vars, statics, attrs, pe=self.pe,
+                   avals=self.avals)
+        n_ops_before = len(sd._ops)
+        out = mapper(ctx)
+        if isinstance(out, tuple):
+            for k, v in enumerate(out):
+                self.tensors[f"{node.name}:{k}"] = v
+            self.tensors[node.name] = out[0]
+        else:
+            self.tensors[node.name] = out
+            self.tensors[node.name + ":0"] = out
+            # TF names the node's output after the node; align our
+            # variable name so sd.output(..., ["node_name"]) works
+            if out.name != node.name:
+                out.rename(node.name)
+        self._propagate_avals(n_ops_before)
+        tags = self._gather_tags(node)
+        if tags:
+            for key in ([node.name, node.name + ":0"] +
+                        [f"{node.name}:{k}" for k in range(
+                            1, len(out) if isinstance(out, tuple) else 1)]):
+                self.branch_tags[key] = dict(tags)
+
+
 class TFGraphMapper:
     """reference: TFGraphMapper#importGraph / ImportGraph.importGraph."""
 
@@ -736,171 +1032,31 @@ class TFGraphMapper:
         Placeholders become SameDiff placeholders; Consts become
         constants (use SameDiff.convertConstantsToVariables to fine-tune
         imported weights, as the reference does for frozen models).
+        Control flow imports both ways the reference handles it
+        (SURVEY.md §3.4 AbstractSession, §2.14 import framework): TF1
+        Switch/Merge/Enter/Exit/NextIteration frames are reconstructed
+        into while_loop/if_cond ops, and TF2 functional While/If/
+        PartitionedCall map through the graph's function library.
         """
         gd = TFGraphMapper._as_graph_def(graph_def_or_path)
-        from tensorflow.python.framework import tensor_util
-
-        import jax
-
-        from deeplearning4j_tpu.ops.registry import get_op
-
         sd = SameDiff()
-        # tensor name ("node" / "node:k") -> SDVariable
-        tensors: Dict[str, SDVariable] = {}
-        const_vals: Dict[str, np.ndarray] = {}
-        # node name -> import-time folded value (may contain DYN)
-        partials: Dict[str, np.ndarray] = {}
-        pe = _PartialEval() if any(n.op == "Shape" for n in gd.node) \
-            else None
-        # SDVariable name -> (aval under probe batch=2, probe batch=3);
-        # feeds _PartialEval's Shape folding (see its docstring)
-        avals: Dict[str, Tuple[Any, Any]] = {}
-
-        def _propagate_avals(from_idx: int) -> None:
-            """Two-probe abstract shape eval for ops appended since
-            from_idx (mappers may emit several chained ops)."""
-            if pe is None:
-                return
-            for opnode in sd._ops[from_idx:]:
-                fn = get_op(opnode.op_name)
-                pair = []
-                for probe in (0, 1):
-                    ins = []
-                    for iname in opnode.inputs:
-                        if iname in avals:
-                            ins.append(avals[iname][probe])
-                        elif iname in sd._arrays:
-                            a = sd._arrays[iname]
-                            ins.append(jax.ShapeDtypeStruct(
-                                tuple(a.shape), a.dtype))
-                        else:
-                            ins = None
-                            break
-                    if ins is None:
-                        pair = None
-                        break
-                    try:
-                        out = jax.eval_shape(
-                            lambda *a: fn(*a, **opnode.attrs), *ins)
-                    except Exception as _e:
-                        import os as _os
-                        if _os.environ.get("DL4J_TF_IMPORT_DEBUG"):
-                            print(f"aval-fail {opnode.op_name} "
-                                  f"{opnode.outputs[0][-60:]}: "
-                                  f"{type(_e).__name__}: {_e}")
-                        pair = None
-                        break
-                    pair.append(list(out) if isinstance(out, (list, tuple))
-                                else [out])
-                if pair is None:
-                    continue
-                for k, on in enumerate(opnode.outputs):
-                    if k < len(pair[0]):
-                        avals[on] = (pair[0][k], pair[1][k])
-
-        def resolve(ref: str) -> Tuple[str, int]:
-            if ":" in ref:
-                name, idx = ref.rsplit(":", 1)
-                return name, int(idx)
-            return ref, 0
-
-        for node in gd.node:
-            attrs = _decode_attrs(node)
-            if node.op in ("NoOp", "Assert"):
-                # Assert: runtime-check node, consumed via control edges
-                # only — the reference importer likewise drops it.
-                continue
-            if node.op == "Const":
-                val = tensor_util.MakeNdarray(node.attr["value"].tensor)
-                if val.dtype.kind in "OSU":
-                    # string consts (Assert messages etc.) have no JAX
-                    # representation; their only consumers are dropped
-                    # check nodes
-                    const_vals[node.name] = val
-                    continue
-                v = sd.constant(node.name, val)
-                if v.name != node.name:
-                    raise TFImportError(
-                        f"duplicate node name {node.name!r}")
-                tensors[node.name] = v
-                tensors[node.name + ":0"] = v
-                const_vals[node.name] = val
-                aval = jax.ShapeDtypeStruct(tuple(val.shape), val.dtype)
-                avals[v.name] = (aval, aval)
-                continue
-            if node.op in ("Placeholder", "PlaceholderWithDefault"):
-                shape = attrs.get("shape")
-                shape = [None if d in (-1, None) else int(d)
-                         for d in shape] if shape else None
-                v = sd.placeholder(node.name, shape=shape,
-                                   dtype=attrs.get("dtype", "float32"))
-                tensors[node.name] = v
-                tensors[node.name + ":0"] = v
-                if shape is not None:
-                    dt = np.dtype(attrs.get("dtype", "float32"))
-                    # distinct probe pairs PER DIM INDEX (dim i ->
-                    # (2+2i, 3+2i)) so two dynamic dims of one
-                    # placeholder (e.g. [None, None] batch+seq) stay
-                    # distinguishable in resolve_dyn_dim; the same dim
-                    # index across placeholders shares a pair so
-                    # cross-placeholder elementwise ops still probe
-                    # consistently.
-                    avals[v.name] = tuple(
-                        jax.ShapeDtypeStruct(
-                            tuple(p + 2 * i if d is None else d
-                                  for i, d in enumerate(shape)), dt)
-                        for p in (2, 3))
-                continue
-
-            in_vars: List[SDVariable] = []
-            statics: List[Optional[np.ndarray]] = []
-            in_refs: List[Tuple[str, int]] = []
-            for ref in node.input:
-                if ref.startswith("^"):  # control edge: ordering only
-                    continue
-                src, idx = resolve(ref)
-                key = f"{src}:{idx}" if idx else src
-                if key not in tensors and f"{src}:{idx}" in tensors:
-                    key = f"{src}:{idx}"
-                if key not in tensors:
-                    raise TFImportError(
-                        f"node {node.name}: unresolved input {ref!r}")
-                in_vars.append(tensors[key])
-                sv = const_vals.get(src) if idx == 0 else None
-                if sv is None and idx == 0:
-                    sv = partials.get(src)
-                statics.append(sv)
-                in_refs.append((src, idx))
-
-            if pe is not None:
-                shape_pairs = []
-                for v in in_vars:
-                    p = avals.get(v.name)
-                    shape_pairs.append(
-                        (tuple(p[0].shape), tuple(p[1].shape))
-                        if p is not None else None)
-                pv = pe.eval(node, attrs, statics, shape_pairs,
-                             [v.name for v in in_vars])
-                if pv is not None:
-                    partials[node.name] = np.asarray(pv)
-
-            mapper = OpMappingRegistry.get(node.op)
-            ctx = _Ctx(sd, node, in_vars, statics, attrs, pe=pe,
-                       avals=avals)
-            n_ops_before = len(sd._ops)
-            out = mapper(ctx)
-            if isinstance(out, tuple):
-                for k, v in enumerate(out):
-                    tensors[f"{node.name}:{k}"] = v
-                tensors[node.name] = out[0]
-            else:
-                tensors[node.name] = out
-                tensors[node.name + ":0"] = out
-                # TF names the node's output after the node; align our
-                # variable name so sd.output(..., ["node_name"]) works
-                if out.name != node.name:
-                    out.rename(node.name)
-            _propagate_avals(n_ops_before)
+        library = {f.signature.name: f for f in gd.library.function} \
+            if gd.library.function else {}
+        # two-probe shape folding + aval tracking pay ~2 eval_shape per
+        # node; enable only where they can matter (shape subgraphs,
+        # control flow, runtime indexing) — plain frozen graphs import
+        # on the fast path
+        _PE_OPS = {"Shape", "Enter", "RefEnter", "While",
+                   "StatelessWhile", "If", "StatelessIf",
+                   "PartitionedCall", "StatefulPartitionedCall",
+                   "Switch", "Merge", "StridedSlice"}
+        all_nodes = list(gd.node)
+        lib_nodes = [nd for f in library.values() for nd in f.node_def]
+        needs_pe = any(n.op in _PE_OPS for n in all_nodes) or \
+            any(n.op in _PE_OPS for n in lib_nodes)
+        walker = _Walker(sd, library=library,
+                         pe=_PartialEval() if needs_pe else None)
+        walker.walk(all_nodes)
         return sd
 
     @staticmethod
@@ -922,3 +1078,9 @@ class TFGraphMapper:
         if hasattr(src, "as_graph_def"):
             return src.as_graph_def()
         raise TFImportError(f"cannot interpret {type(src)} as a GraphDef")
+
+
+# Control-flow import (v1 frames, functional While/If, TensorArrays)
+# registers its mappers on load; imported last so every name above is
+# available to it.
+from deeplearning4j_tpu.modelimport.tensorflow import cf_import  # noqa: E402,F401

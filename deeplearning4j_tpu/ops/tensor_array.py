@@ -1,0 +1,62 @@
+"""TensorArray as a dense device array (jit-safe, TPU-native).
+
+Reference: nd4j executes TensorArrayV3 read/write/scatter/stack ops as
+an interpreter-side list of INDArrays inside AbstractSession frames
+(org/nd4j/autodiff/samediff/internal/AbstractSession plus the
+tensorarray declarable ops — SURVEY.md §3.4/§2.14). A host-side list
+cannot live inside a compiled XLA loop, so here a TensorArray IS a
+dense ``(size, *elem_shape)`` array carried as loop state: write =
+``dynamic_update_slice``, read = dynamic gather, stack = identity. The
+TF "flow" scalar that sequences TA side effects becomes the array value
+itself, which makes the data dependence explicit and XLA-schedulable.
+
+TF2 TensorList ops (the v2 TensorArray) share the same representation.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.ops.registry import register_op
+
+
+@register_op("tensorarray_reserve")
+def tensorarray_reserve(size=None, elem_shape=(), dtype="float32"):
+    """Dense TA of ``size`` elements (TensorArrayV3 / TensorListReserve).
+
+    With an unknown element_shape the array is a 1-D dummy; a full
+    ``tensorarray_scatter`` (the unstack path) replaces it wholesale and
+    defines the real shape.
+    """
+    return jnp.zeros((int(size),) + tuple(int(d) for d in elem_shape),
+                     jnp.dtype(dtype))
+
+
+@register_op("tensorarray_write")
+def tensorarray_write(flow, index, value):
+    """TensorArrayWriteV3 / TensorListSetItem: out[index] = value."""
+    index = jnp.asarray(index).astype(jnp.int32).reshape(())
+    return flow.at[index].set(value.astype(flow.dtype))
+
+
+@register_op("tensorarray_scatter")
+def tensorarray_scatter(flow, indices, value):
+    """TensorArrayScatterV3 (unstack): scatter value rows at indices.
+
+    When ``flow`` already has the element shape, prior writes at
+    disjoint indices are preserved (TF semantics). A dummy-reserved TA
+    (unknown element_shape, 1-D flow) is rebuilt from ``value``'s
+    shape instead; unwritten entries are zero.
+    """
+    indices = jnp.asarray(indices).astype(jnp.int32)
+    if flow.shape[1:] == value.shape[1:]:
+        return flow.at[indices].set(value.astype(flow.dtype))
+    base = jnp.zeros((flow.shape[0],) + tuple(value.shape[1:]),
+                     value.dtype)
+    return base.at[indices].set(value)
+
+
+@register_op("tensorarray_size")
+def tensorarray_size(flow):
+    """TensorArraySizeV3 / TensorListLength — static under jit."""
+    return jnp.asarray(flow.shape[0], jnp.int32)

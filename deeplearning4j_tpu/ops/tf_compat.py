@@ -1,0 +1,100 @@
+"""Ops with TF-attr calling conventions used by imported graphs.
+
+These live in the ops package (not the importer) so a process that
+LOADS a saved imported graph — e.g. a TF-less inference host running
+``SameDiff.load`` — has them registered without ever importing the
+tensorflow package (reference: the imported graph executes on plain
+nd4j ops, org/nd4j/linalg/api/ops/impl/shape/StridedSlice etc.,
+SURVEY.md §2.14).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.ops.registry import register_op
+
+
+@register_op("tf_strided_slice")
+def tf_strided_slice(x, begin=None, end=None, strides=None, begin_mask=0,
+                     end_mask=0, shrink_axis_mask=0, new_axis_mask=0):
+    """TF StridedSlice subset: begin/end/shrink/new-axis masks, no
+    ellipsis. A new_axis position consumes one spec entry (its
+    begin/end/stride are ignored) and inserts a length-1 axis there."""
+    slices = []
+    shrink_axes = []
+    new_axes = []
+    out_pos = 0
+    for i in range(len(begin)):
+        if new_axis_mask & (1 << i):
+            new_axes.append(out_pos)
+            out_pos += 1
+            continue
+        if shrink_axis_mask & (1 << i):
+            # begin=-1 means "last element": end must be None, not 0
+            e = begin[i] + 1 if begin[i] != -1 else None
+            slices.append(slice(begin[i], e, 1))
+            shrink_axes.append(len(slices) - 1)
+            continue
+        b = None if begin_mask & (1 << i) else begin[i]
+        e = None if end_mask & (1 << i) else end[i]
+        slices.append(slice(b, e, strides[i]))
+        out_pos += 1
+    out = x[tuple(slices)]
+    if shrink_axes:
+        out = jnp.squeeze(out, axis=tuple(shrink_axes))
+    for pos in new_axes:
+        out = jnp.expand_dims(out, pos)
+    return out
+
+
+@register_op("tf_strided_slice_dyn")
+def tf_strided_slice_dyn(x, begin_t, begin=None, end=None, begin_mask=0,
+                         end_mask=0, shrink_axis_mask=0):
+    """StridedSlice with runtime begin entries (None in ``begin``/
+    ``end``); unit strides. Dynamic entries are only legal on shrink
+    dims (checked at import) — the op lowers to one lax.dynamic_slice
+    plus a static squeeze, so loop-counter indexing stays on-device."""
+    from jax import lax
+
+    starts: list = []
+    sizes: list = []
+    squeeze: list = []
+    nspec = len(begin)
+    for d in range(nspec):
+        dim = x.shape[d]
+        if shrink_axis_mask & (1 << d):
+            if begin[d] is None:
+                st = begin_t[d].astype(jnp.int32)
+                st = jnp.where(st < 0, st + dim, st)
+            else:
+                st = begin[d] + dim if begin[d] < 0 else begin[d]
+            starts.append(st)
+            sizes.append(1)
+            squeeze.append(d)
+        else:
+            b = 0 if (begin_mask & (1 << d)) else begin[d]
+            e = dim if (end_mask & (1 << d)) else end[d]
+            b = b + dim if b < 0 else b
+            e = e + dim if e < 0 else e
+            b = max(0, min(b, dim))
+            e = max(0, min(e, dim))
+            starts.append(b)
+            sizes.append(max(0, e - b))
+    for d in range(nspec, x.ndim):
+        starts.append(0)
+        sizes.append(x.shape[d])
+    out = lax.dynamic_slice(
+        x, tuple(jnp.asarray(s, jnp.int32) for s in starts), tuple(sizes))
+    return jnp.squeeze(out, axis=tuple(squeeze)) if squeeze else out
+
+
+@register_op("tf_fill")
+def tf_fill(shape=None, value=0.0):
+    return jnp.full(tuple(shape), value)
+
+
+@register_op("erfc")
+def erfc(x):
+    return jax.scipy.special.erfc(x)

@@ -1,0 +1,109 @@
+"""Dense TensorArray ops + dynamic StridedSlice + call_graph units
+(reference: the TensorArray declarable ops AbstractSession evaluates,
+SURVEY.md §3.4 — here a TA is a dense array carried as loop state)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.ops.registry import get_op
+
+
+class TestTensorArrayOps:
+    def test_tensorarray_reserve(self):
+        ta = get_op("tensorarray_reserve")(size=4, elem_shape=(2, 3),
+                                           dtype="float32")
+        assert ta.shape == (4, 2, 3) and ta.dtype == jnp.float32
+        assert float(jnp.abs(ta).sum()) == 0.0
+
+    def test_tensorarray_write_read_roundtrip(self):
+        ta = get_op("tensorarray_reserve")(size=3, elem_shape=(2,))
+        v = jnp.asarray([1.5, -2.0])
+        ta = get_op("tensorarray_write")(ta, 1, v)
+        got = jnp.take(ta, 1, axis=0)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(v))
+        assert float(jnp.abs(ta[0]).sum()) == 0.0
+
+    def test_tensorarray_write_traced_index_in_loop(self):
+        """The point of the dense representation: writes with a traced
+        loop counter compile into lax.while_loop."""
+        def step(i, ta):
+            return i + 1, get_op("tensorarray_write")(
+                ta, i, jnp.full((2,), i, jnp.float32))
+
+        def run():
+            ta = get_op("tensorarray_reserve")(size=4, elem_shape=(2,))
+            _, ta = jax.lax.while_loop(lambda s: s[0] < 4,
+                                       lambda s: step(*s), (0, ta))
+            return ta
+
+        out = np.asarray(jax.jit(run)())
+        np.testing.assert_allclose(out[:, 0], [0, 1, 2, 3])
+
+    def test_tensorarray_scatter_defines_shape(self):
+        # dummy 1-D reserve (unknown element shape) + full scatter:
+        # the value defines the real shape
+        ta = get_op("tensorarray_reserve")(size=3, elem_shape=())
+        v = jnp.arange(6, dtype=jnp.float32).reshape(3, 2)
+        out = get_op("tensorarray_scatter")(ta, jnp.arange(3), v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(v))
+
+    def test_tensorarray_scatter_partial(self):
+        ta = get_op("tensorarray_reserve")(size=4, elem_shape=(2,))
+        v = jnp.ones((2, 2))
+        out = get_op("tensorarray_scatter")(ta, jnp.asarray([3, 1]), v)
+        np.testing.assert_allclose(np.asarray(out).sum(axis=1),
+                                   [0, 2, 0, 2])
+
+    def test_tensorarray_size(self):
+        ta = get_op("tensorarray_reserve")(size=5, elem_shape=(2,))
+        assert int(get_op("tensorarray_size")(ta)) == 5
+
+
+class TestDynamicStridedSlice:
+    def test_tf_strided_slice_dyn_shrink(self):
+        """a[:, i] with traced i — the dynamic_rnn per-step read."""
+        x = jnp.arange(12, dtype=jnp.float32).reshape(3, 4)
+
+        def f(i):
+            begin_t = jnp.stack([jnp.asarray(0), i])
+            return get_op("tf_strided_slice_dyn")(
+                x, begin_t, begin=[0, None], end=[0, None],
+                begin_mask=1, end_mask=1, shrink_axis_mask=2)
+
+        out = jax.jit(f)(jnp.asarray(2))
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.asarray(x)[:, 2])
+
+    def test_tf_strided_slice_dyn_negative_index(self):
+        x = jnp.arange(5, dtype=jnp.float32)
+        out = get_op("tf_strided_slice_dyn")(
+            x, jnp.asarray([-1]), begin=[None], end=[None],
+            begin_mask=0, end_mask=0, shrink_axis_mask=1)
+        assert float(out) == 4.0
+
+    def test_tf_strided_slice_dyn_mixed_static(self):
+        x = jnp.arange(24, dtype=jnp.float32).reshape(4, 6)
+        out = get_op("tf_strided_slice_dyn")(
+            x, jnp.asarray([1, 2]), begin=[1, None], end=[3, None],
+            begin_mask=0, end_mask=0, shrink_axis_mask=2)
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.asarray(x)[1:3, 2])
+
+
+class TestCallGraph:
+    def test_call_graph_inlines_subgraph(self):
+        from deeplearning4j_tpu.autodiff.control_flow import (
+            subgraph_to_dict,
+        )
+        from deeplearning4j_tpu.autodiff.samediff import SameDiff
+
+        sub = SameDiff()
+        a = sub.placeholder("sg_in_0")
+        b = sub.placeholder("sg_in_1")
+        out = a * b + a
+        g = subgraph_to_dict(sub, [out.name], 2)
+        x = jnp.asarray([1.0, 2.0])
+        y = jnp.asarray([3.0, 4.0])
+        res = get_op("call_graph")(x, y, graph=g)
+        np.testing.assert_allclose(np.asarray(res), [4.0, 10.0])

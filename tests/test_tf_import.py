@@ -591,6 +591,11 @@ BATTERY = {
     "clipping": (lambda a: tf.clip_by_value(a, -0.5, 0.5), [_F44]),
     "select_v2_broadcast": (
         lambda a: tf.where(a > 0, a, tf.zeros_like(a)), [_F44]),
+    "matrix_diag_eye": (
+        lambda a: tf.matmul(a, tf.eye(4))
+        + tf.linalg.diag(tf.linalg.diag_part(a)), [_F44]),
+    "matrix_set_diag": (
+        lambda a: tf.linalg.set_diag(a, tf.ones([4])), [_F44]),
 }
 
 
@@ -651,3 +656,161 @@ class TestImportedGraphSerde:
         h2 = sd2.fit(DataSet(x, labels), epochs=2)
         np.testing.assert_allclose(h1.loss_curve, h2.loss_curve,
                                    rtol=1e-5, atol=1e-6)
+
+
+# ---------------------------------------------------------------------
+# Control-flow golden graphs (reference: AbstractSession executes
+# If/While/Enter/Exit/Merge at runtime, SURVEY.md §3.4; here the frames
+# import into while_loop/if_cond ops and the WHOLE loop compiles into
+# the one XLA executable). Each graph is checked in BOTH frozen forms:
+# lower_control_flow=True (TF1 Switch/Merge/Enter/Exit/NextIteration
+# frames) and =False (functional While/If + TensorList ops).
+# ---------------------------------------------------------------------
+def _while_counter_fn(a):
+    return tf.while_loop(
+        lambda i, acc: i < 5,
+        lambda i, acc: (i + 1, acc + a * tf.cast(i, tf.float32)),
+        [tf.constant(0), tf.zeros_like(a)])[1]
+
+
+def _cond_fn(a):
+    return tf.cond(tf.reduce_sum(a) > 0,
+                   lambda: a * 2.0 + 1.0, lambda: a - 1.0)
+
+
+def _nested_while_fn(a):
+    def outer_body(i, acc):
+        inner = tf.while_loop(
+            lambda j, s: j < 3,
+            lambda j, s: (j + 1, s + a * tf.cast(i + j, tf.float32)),
+            [tf.constant(0), tf.zeros_like(a)])[1]
+        return i + 1, acc + inner
+    return tf.while_loop(lambda i, acc: i < 2, outer_body,
+                         [tf.constant(0), tf.zeros_like(a)])[1]
+
+
+def _tensorarray_fn(a):
+    ta = tf.TensorArray(tf.float32, size=4, element_shape=(4,))
+    def body(i, ta):
+        return i + 1, ta.write(i, a[:, i] * tf.cast(i + 1, tf.float32))
+    _, ta = tf.while_loop(lambda i, ta: i < 4, body, [0, ta])
+    return ta.stack()
+
+
+def _run_both_cf(fn, feeds_np, lower, rtol=1e-4, atol=1e-5):
+    from tensorflow.python.framework.convert_to_constants import (
+        convert_variables_to_constants_v2,
+    )
+
+    specs = [tf.TensorSpec(v.shape, tf.as_dtype(v.dtype))
+             for v in feeds_np]
+    conc = tf.function(fn).get_concrete_function(*specs)
+    frozen = convert_variables_to_constants_v2(
+        conc, lower_control_flow=lower)
+    gd = frozen.graph.as_graph_def()
+    in_names = [t.name.split(":")[0] for t in frozen.inputs]
+    out_names = [t.name.split(":")[0] for t in frozen.outputs]
+    ref = frozen(*[tf.constant(v) for v in feeds_np])
+    ref = [np.asarray(r) for r in (ref if isinstance(ref, (list, tuple))
+                                   else [ref])]
+    sd = TFGraphMapper.importGraph(gd)
+    outs = sd.output(dict(zip(in_names, feeds_np)), out_names)
+    for n, r in zip(out_names, ref):
+        np.testing.assert_allclose(np.asarray(outs[n]), r,
+                                   rtol=rtol, atol=atol)
+    return gd
+
+
+CF_BATTERY = {
+    "while_counter": (_while_counter_fn, [_F44]),
+    "cond_taken": (_cond_fn, [np.abs(_F44)]),
+    "cond_not_taken": (_cond_fn, [-np.abs(_F44)]),
+    "nested_while": (_nested_while_fn, [_F44]),
+    "while_tensorarray": (_tensorarray_fn, [_F44]),
+}
+
+
+class TestControlFlowGolden:
+    @pytest.mark.parametrize("lower", [True, False],
+                             ids=["v1_frames", "functional"])
+    @pytest.mark.parametrize("name", sorted(CF_BATTERY))
+    def test_graph(self, name, lower):
+        fn, feeds = CF_BATTERY[name]
+        _run_both_cf(fn, feeds, lower)
+
+    def test_v1_frames_form_actually_contains_frames(self):
+        """Guard the test premise: the lowered freeze really emits the
+        TF1 frame ops the reference's AbstractSession handles."""
+        gd = _run_both_cf(*CF_BATTERY["while_counter"], lower=True)
+        ops = {n.op for n in gd.node}
+        assert {"Enter", "Exit", "Merge", "Switch", "NextIteration",
+                "LoopCond"} <= ops
+
+    def test_functional_form_keeps_functions(self):
+        gd = _run_both_cf(*CF_BATTERY["while_counter"], lower=False)
+        assert any(n.op in ("While", "StatelessWhile") for n in gd.node)
+        assert len(gd.library.function) >= 2
+
+    def test_v1_session_graph_dynamic_rnn_style(self):
+        """A raw tf.compat.v1 Graph + Session golden: time-major GRU
+        recurrence driven by TensorArray read/write inside a while
+        frame, frozen with the v1 graph_util path (the exact shape of
+        a legacy frozen dynamic_rnn checkpoint)."""
+        tf1 = tf.compat.v1
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(2, 6, 5)).astype(np.float32)
+        g = tf.Graph()
+        with g.as_default():
+            ph = tf1.placeholder(tf.float32, (2, 6, 5), name="x")
+            Wz = tf1.get_variable(
+                "Wz", (12, 7),
+                initializer=tf1.initializers.glorot_uniform(seed=1))
+            Wh = tf1.get_variable(
+                "Wh", (12, 7),
+                initializer=tf1.initializers.glorot_uniform(seed=2))
+            xs = tf.transpose(ph, [1, 0, 2])
+            in_ta = tf.TensorArray(tf.float32, size=6,
+                                   element_shape=(2, 5)).unstack(xs)
+            out_ta = tf.TensorArray(tf.float32, size=6,
+                                    element_shape=(2, 7))
+
+            def body(t, h, ta):
+                xt = in_ta.read(t)
+                cat = tf.concat([xt, h], 1)
+                z = tf.sigmoid(tf.matmul(cat, Wz))
+                hc = tf.tanh(tf.matmul(cat, Wh))
+                h2 = (1.0 - z) * h + z * hc
+                return t + 1, h2, ta.write(t, h2)
+
+            _, hT, out_ta = tf1.while_loop(
+                lambda t, h, ta: t < 6, body,
+                [0, tf.zeros((2, 7)), out_ta])
+            out = tf.identity(tf.transpose(out_ta.stack(), [1, 0, 2]),
+                              name="rnn_out")
+            hT = tf.identity(hT, name="h_final")
+            with tf1.Session(graph=g) as sess:
+                sess.run(tf1.global_variables_initializer())
+                ref, ref_h = sess.run([out, hT], {ph: x})
+                frozen = tf1.graph_util.convert_variables_to_constants(
+                    sess, g.as_graph_def(), ["rnn_out", "h_final"])
+        sd = TFGraphMapper.importGraph(frozen)
+        res = sd.output({"x": x}, ["rnn_out", "h_final"])
+        np.testing.assert_allclose(np.asarray(res["rnn_out"]), ref,
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(res["h_final"]), ref_h,
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_unreconstructible_frame_fails_loudly(self):
+        """A lone Enter without Merge/Switch structure must raise a
+        clear TFImportError, not import garbage."""
+        from tensorflow.core.framework import graph_pb2
+        gd = graph_pb2.GraphDef()
+        n = gd.node.add()
+        n.name, n.op = "x", "Placeholder"
+        n.attr["dtype"].type = 1
+        e = gd.node.add()
+        e.name, e.op = "enter", "Enter"
+        e.input.append("x")
+        e.attr["frame_name"].s = b"broken_frame"
+        with pytest.raises(TFImportError):
+            TFGraphMapper.importGraph(gd)
