@@ -246,7 +246,8 @@ def _topo_collect(walker: _Walker, pool: Dict[str, Any],
     every member — and the sub-walk's own plan_v1_frames reconstructs
     it recursively."""
     order: List[Any] = []
-    state: Dict[str, int] = {}
+    done: Set[str] = set()
+    onpath: Set[str] = set()
     fpaths = _frame_paths(list(pool.values())) \
         if any(n.op in ("Enter", "RefEnter") for n in pool.values()) \
         else {}
@@ -256,40 +257,63 @@ def _topo_collect(walker: _Walker, pool: Dict[str, Any],
         src, idx = _Walker.resolve(ref)
         return (f"{src}:{idx}" if idx else src), src
 
-    def visit_frame(fname: str) -> None:
-        if fname in frames_done:
-            return
-        frames_done.add(fname)
-        members = [n for n in pool.values()
-                   if fpaths.get(n.name, ())[:1] == (fname,)]
-        member_names = {n.name for n in members}
-        for m in members:
-            for ref in m.input:
-                if ref.startswith("^"):
-                    continue
-                k, src = key_of(ref)
-                if k in boundary_keys or f"{src}:0" in boundary_keys \
-                        or src in member_names:
-                    continue
-                visit(src)
-        for m in members:
-            if state.get(m.name) != 2:
-                state[m.name] = 2
-                order.append(m)
+    def dep_srcs(node, extra_skip: Set[str] = frozenset()) -> List[str]:
+        out = []
+        for ref in node.input:
+            if ref.startswith("^"):
+                continue
+            k, src = key_of(ref)
+            if k in boundary_keys or f"{src}:0" in boundary_keys \
+                    or src in extra_skip:
+                continue
+            out.append(src)
+        return out
 
-    def visit(name: str) -> None:
-        st = state.get(name)
-        if st == 2:
-            return
-        if st == 1:
+    # explicit stack (a whole model behind one PartitionedCall can
+    # chain thousands of nodes — Python recursion would blow up):
+    # ("node", name) expands deps, ("exit", node) emits postorder,
+    # ("frame", members) emits a whole while frame as one unit
+    stack: List[Tuple[str, Any]] = []
+    for ref in reversed(list(outputs)):
+        k, src = key_of(ref)
+        if k not in boundary_keys:
+            stack.append(("node", src))
+    while stack:
+        kind, payload = stack.pop()
+        if kind == "exit":
+            node = payload
+            onpath.discard(node.name)
+            if node.name not in done:
+                done.add(node.name)
+                order.append(node)
+            continue
+        if kind == "frame":
+            for m in payload:
+                if m.name not in done:
+                    done.add(m.name)
+                    order.append(m)
+            continue
+        name = payload
+        if name in done:
+            continue
+        if name in onpath:
             raise TFImportError(
                 f"cycle through {name!r} in control-flow subgraph "
                 "(unreconstructed back edge)")
         p = fpaths.get(name, ())
         if p:
-            visit_frame(p[0])
-            return
-        state[name] = 1
+            fname = p[0]
+            if fname in frames_done:
+                continue
+            frames_done.add(fname)
+            members = [n for n in pool.values()
+                       if fpaths.get(n.name, ())[:1] == (fname,)]
+            member_names = {n.name for n in members}
+            stack.append(("frame", members))
+            for m in members:
+                for src in reversed(dep_srcs(m, member_names)):
+                    stack.append(("node", src))
+            continue
         node = pool.get(name)
         if node is None:
             outer = walker.nodes_by_name.get(name) \
@@ -301,21 +325,10 @@ def _topo_collect(walker: _Walker, pool: Dict[str, Any],
                     f"control-flow subgraph references {name!r}, which "
                     "is neither inside the frame/function nor a "
                     "constant")
-        for ref in node.input:
-            if ref.startswith("^"):
-                continue
-            k, src = key_of(ref)
-            if k in boundary_keys or f"{src}:0" in boundary_keys:
-                continue
-            visit(src)
-        state[name] = 2
-        order.append(node)
-
-    for ref in outputs:
-        k, src = key_of(ref)
-        if k in boundary_keys:
-            continue
-        visit(src)
+        onpath.add(name)
+        stack.append(("exit", node))
+        for src in reversed(dep_srcs(node)):
+            stack.append(("node", src))
     return order
 
 

@@ -525,33 +525,42 @@ def _register_standard_mappers():
                       strides=(int(st[1]), int(st[2])),
                       padding="SAME" if pad == "SAME" else "VALID")
 
-    def _diag_guard(ctx):
-        """MatrixDiag/Part/SetDiag V2/V3 extra operands (k, num_rows,
-        num_cols, padding_value) — only the defaults (main diagonal,
-        square, zero padding) map onto the square diag ops."""
-        extras = ctx.inputs[2 if ctx.node.op.startswith("MatrixSetDiag")
-                            else 1:]
-        for i in range(len(extras)):
-            base = 2 if ctx.node.op.startswith("MatrixSetDiag") else 1
+    def _diag_guard(ctx, roles):
+        """MatrixDiag/Part/SetDiag V2/V3 extra operands — only the
+        defaults map onto the square diag ops: k must be 0 (the main
+        diagonal; -1 here means SUB-diagonal, not a default), num_rows/
+        num_cols may be the -1 'infer' sentinel, padding_value must be
+        0."""
+        base = len(ctx.inputs) - len(roles)
+        for i, role in enumerate(roles):
+            if base + i >= len(ctx.inputs):
+                break
             v = np.atleast_1d(ctx.static_np(base + i))
-            if not (np.all(v == 0) or np.all(v == -1)):
+            ok = np.all(v == 0) if role in ("k", "padding") \
+                else (np.all(v == -1) or np.all(v >= 0))
+            if not ok:
                 raise TFImportError(
-                    f"{ctx.node.name} ({ctx.node.op}): only k=0 main-"
-                    "diagonal square form is importable")
+                    f"{ctx.node.name} ({ctx.node.op}): {role}="
+                    f"{v.tolist()} — only k=0 main-diagonal zero-"
+                    "padding form is importable")
 
     @R("MatrixDiag", "MatrixDiagV2", "MatrixDiagV3")
     def _matrix_diag(ctx):
-        _diag_guard(ctx)
+        # V2/V3 operands: diagonal, k, num_rows, num_cols, padding
+        _diag_guard(ctx, ["k", "rows", "cols", "padding"]
+                    [:len(ctx.inputs) - 1])
         return ctx.op("matrix_diag", ctx.inputs[:1])
 
     @R("MatrixDiagPart", "MatrixDiagPartV2", "MatrixDiagPartV3")
     def _matrix_diag_part(ctx):
-        _diag_guard(ctx)
+        # V2/V3 operands: input, k, padding_value
+        _diag_guard(ctx, ["k", "padding"][:len(ctx.inputs) - 1])
         return ctx.op("diag_part", ctx.inputs[:1])
 
     @R("MatrixSetDiag", "MatrixSetDiagV2", "MatrixSetDiagV3")
     def _matrix_set_diag(ctx):
-        _diag_guard(ctx)
+        # V2/V3 operands: input, diagonal, k
+        _diag_guard(ctx, ["k"][:len(ctx.inputs) - 2])
         return ctx.op("matrix_set_diag", ctx.inputs[:2])
 
     @R("FusedBatchNorm", "FusedBatchNormV2", "FusedBatchNormV3")
@@ -840,6 +849,7 @@ class _Walker:
         (v1 cond pipes branch constants to Merge with only a control
         edge from the branch pivot, so control edges carry tags too)."""
         tags: Dict[str, bool] = {}
+        conflicted: set = set()
         for ref in node.input:
             key = ref
             if ref.startswith("^"):
@@ -853,10 +863,14 @@ class _Walker:
             t = self.branch_tags.get(key)
             if t:
                 for p, b in t.items():
+                    if p in conflicted:
+                        continue
                     if p in tags and tags[p] != b:
                         # both branches feed this node: it is post-merge
-                        # or pred-side; the tag cancels
+                        # or pred-side; the tag cancels STICKILY (a
+                        # later same-pred input must not re-add it)
                         tags.pop(p)
+                        conflicted.add(p)
                     else:
                         tags[p] = b
         return tags
