@@ -2,8 +2,9 @@
 "Char-RNN / seq2seq LSTM — correctness + throughput self-baseline";
 reference config: zoo TextGenerationLSTM, the CudnnLSTMHelper role).
 
-Methodology matches bench.py v3: device-resident one-hot inputs,
-best-of-3 windows, each window ends in a device->host loss read.
+The workload lives in bench_common.run_char_lstm — the SAME loop
+bench.py's driver metric times, so CLI sweeps and the driver line
+cannot diverge. Methodology matches bench.py v3.
 
 Usage: python bench_lstm.py [--batch 256] [--seq 200] [--hidden 256]
 """
@@ -12,11 +13,8 @@ from __future__ import annotations
 
 import argparse
 import json
-import time
 
-import jax
-import jax.numpy as jnp
-import numpy as np
+from bench_common import peak_flops, run_char_lstm
 
 
 def main():
@@ -29,57 +27,26 @@ def main():
     ap.add_argument("--dtype", default="bf16", choices=["bf16", "f32"])
     args = ap.parse_args()
 
-    from deeplearning4j_tpu.zoo.textgen_lstm import TextGenerationLSTM
-
-    model = TextGenerationLSTM(vocab_size=args.vocab, hidden=args.hidden,
-                               tbptt_length=0)
-    conf = model.conf()
-    conf.dtype = {"bf16": "bfloat16", "f32": "float32"}[args.dtype]
-    from deeplearning4j_tpu.nn.multilayer.network import MultiLayerNetwork
-
-    net = MultiLayerNetwork(conf).init()
-
-    rng = np.random.default_rng(0)
-    ids = rng.integers(0, args.vocab, (args.batch, args.seq))
-    x = jax.device_put(jnp.asarray(
-        np.eye(args.vocab, dtype=np.float32)[ids], net._dtype))
-    y = jax.device_put(jnp.asarray(
-        np.eye(args.vocab, dtype=np.float32)[
-            np.roll(ids, -1, 1)], net._dtype))
-
-    step = net._get_train_step(has_mask=False)
-    state = (net.params_list, net.states_list, net.opt_states)
-
-    def run(state, i):
-        p, s, o, loss = step(state[0], state[1], state[2], jnp.asarray(i),
-                             jnp.asarray(0), x, y, None, None,
-                             jax.random.key(i))
-        return (p, s, o), loss
-
-    t0 = time.perf_counter()
-    state, loss = run(state, 0)
-    lv = float(jnp.mean(loss))
-    print(f"compile+first step: {time.perf_counter()-t0:.1f}s "
-          f"loss={lv:.3f}")
-
-    best = float("inf")
-    for _ in range(3):
-        t0 = time.perf_counter()
-        for i in range(args.steps):
-            state, loss = run(state, i + 1)
-        float(jnp.mean(loss))
-        best = min(best, time.perf_counter() - t0)
-
-    tok_s = args.batch * args.seq * args.steps / best
-    # per-token train FLOPs: 2 LSTM layers, 8*h*(in+h) MACs fwd each,
-    # x3 for bwd, + the vocab softmax head
-    h, v = args.hidden, args.vocab
-    fwd_tok = 8 * h * (v + h) + 8 * h * (h + h) + 2 * h * v
-    flops = tok_s * 3 * fwd_tok
+    r = run_char_lstm(batch=args.batch, seq=args.seq,
+                      hidden=args.hidden, vocab=args.vocab,
+                      steps=args.steps, dtype=args.dtype)
+    tok_s = r["tokens_per_sec"]
     out = {"metric": "char_lstm_train", "value": round(tok_s, 1),
            "unit": "tokens/sec/chip", "batch": args.batch,
-           "seq": args.seq, "hidden": args.hidden,
-           "dtype": args.dtype, "tflops_est": round(flops / 1e12, 2)}
+           "seq": args.seq, "hidden": args.hidden, "dtype": args.dtype}
+    if r["flops_per_step"]:
+        flops_tok = r["flops_per_step"] / r["tokens_per_step"]
+        out["tflops"] = round(tok_s * flops_tok / 1e12, 2)
+        out["flops_src"] = "cost_analysis"
+        peak = peak_flops()
+        if peak:
+            out["mfu"] = round(tok_s * flops_tok / peak, 4)
+    else:
+        # analytic fallback: 2 LSTM layers, 8*h*(in+h) MACs fwd each,
+        # x3 for bwd, + the vocab softmax head
+        h, v = args.hidden, args.vocab
+        fwd_tok = 8 * h * (v + h) + 8 * h * (h + h) + 2 * h * v
+        out["tflops_est"] = round(tok_s * 3 * fwd_tok / 1e12, 2)
     print(json.dumps(out))
 
 

@@ -31,7 +31,6 @@ import numpy as np
 # rather than reporting numbers for a program we didn't measure.
 FWD_FLOPS_PER_IMG = 7.46e9
 TRAIN_FLOPS_PER_IMG = 22.3e9
-PEAK = {"TPU v5 lite": 197e12}
 
 
 def _cost_analysis_flops(compiled):
@@ -163,7 +162,8 @@ def main():
     else:
         per_img = None
         flops_src = None
-    peak = PEAK.get(jax.devices()[0].device_kind)
+    from bench_common import peak_flops
+    peak = peak_flops()
     out = {"mode": args.mode, "dtype": args.dtype, "batch": args.batch,
            "no_bn": args.no_bn, "no_l2": args.no_l2,
            "img_per_sec": round(img_s, 1)}

@@ -529,20 +529,19 @@ def _register_standard_mappers():
         """MatrixDiag/Part/SetDiag V2/V3 extra operands — only the
         defaults map onto the square diag ops: k must be 0 (the main
         diagonal; -1 here means SUB-diagonal, not a default), num_rows/
-        num_cols may be the -1 'infer' sentinel, padding_value must be
-        0."""
+        num_cols must be the -1 'infer' sentinel (an explicit size
+        would pad/truncate, which matrix_diag ignores), padding_value
+        must be 0."""
         base = len(ctx.inputs) - len(roles)
         for i, role in enumerate(roles):
-            if base + i >= len(ctx.inputs):
-                break
             v = np.atleast_1d(ctx.static_np(base + i))
             ok = np.all(v == 0) if role in ("k", "padding") \
-                else (np.all(v == -1) or np.all(v >= 0))
+                else np.all(v == -1)
             if not ok:
                 raise TFImportError(
                     f"{ctx.node.name} ({ctx.node.op}): {role}="
-                    f"{v.tolist()} — only k=0 main-diagonal zero-"
-                    "padding form is importable")
+                    f"{v.tolist()} — only the k=0 main-diagonal "
+                    "inferred-size zero-padding form is importable")
 
     @R("MatrixDiag", "MatrixDiagV2", "MatrixDiagV3")
     def _matrix_diag(ctx):

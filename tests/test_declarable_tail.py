@@ -1,0 +1,525 @@
+"""Forward checks for the final declarable-op tail (reference:
+libnd4j ops/declarable/generic/** remaining families — loss, recurrent
+cells, updaters, nn helpers, parity/image stragglers; SURVEY.md §2.6).
+Golden values come from numpy/torch/tf formulas computed inline."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.ops.registry import get_op
+
+RNG = np.random.default_rng(7)
+X = jnp.asarray(RNG.normal(size=(4, 6)).astype(np.float32))
+P = jnp.asarray(RNG.uniform(0.1, 0.9, (4, 6)).astype(np.float32))
+IMG = jnp.asarray(RNG.normal(size=(2, 8, 8, 3)).astype(np.float32))
+
+
+def npx(a):
+    return np.asarray(a)
+
+
+class TestLosses:
+    def test_l2_loss(self):
+        assert np.isclose(float(get_op("l2_loss")(X)),
+                          (npx(X) ** 2).sum() / 2, rtol=1e-5)
+
+    def test_mean_squared_error(self):
+        got = float(get_op("mean_squared_error")(X, P))
+        assert np.isclose(got, ((npx(P) - npx(X)) ** 2).mean(), rtol=1e-5)
+
+    def test_mean_squared_error_weighted(self):
+        w = jnp.asarray([[1.0], [0.0], [1.0], [0.0]])
+        got = float(get_op("mean_squared_error")(X, P, w))
+        sq = (npx(P) - npx(X)) ** 2
+        want = (sq * npx(jnp.broadcast_to(w, X.shape))).sum() / 12.0
+        assert np.isclose(got, want, rtol=1e-5)
+
+    def test_smooth_l1_loss(self):
+        got = float(get_op("smooth_l1_loss")(X, P))
+        d = np.abs(npx(X) - npx(P))
+        want = np.where(d < 1, 0.5 * d * d, d - 0.5).mean()
+        assert np.isclose(got, want, rtol=1e-5)
+
+    def test_sparse_softmax_cross_entropy_matches_dense(self):
+        labels = jnp.asarray([0, 2, 5, 1], jnp.int32)
+        got = npx(get_op("sparse_softmax_cross_entropy")(X, labels))
+        logp = np.asarray(jax.nn.log_softmax(X, axis=-1))
+        want = -logp[np.arange(4), npx(labels)]
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_weighted_cross_entropy_with_logits(self):
+        import torch
+        t = (npx(P) > 0.5).astype(np.float32)
+        got = npx(get_op("weighted_cross_entropy_with_logits")(
+            jnp.asarray(t), X, 2.0))
+        want = torch.nn.functional.binary_cross_entropy_with_logits(
+            torch.tensor(npx(X)), torch.tensor(t),
+            pos_weight=torch.tensor(2.0), reduction="none").numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    def test_log_poisson_loss(self):
+        got = npx(get_op("log_poisson_loss")(X, P))
+        want = np.exp(npx(X)) - npx(P) * npx(X)
+        np.testing.assert_allclose(got, want, rtol=1e-4)
+
+    def test_log_poisson_loss_full(self):
+        targets = jnp.asarray([[0.0, 1.0, 2.0, 3.0, 0.5, 1.5]] * 4)
+        got = npx(get_op("log_poisson_loss")(X, targets, True))
+        assert np.all(np.isfinite(got))
+
+
+class TestCells:
+    def test_lstm_cell_matches_torch(self):
+        import torch
+        insz, hsz, n = 5, 7, 3
+        cell = torch.nn.LSTMCell(insz, hsz)
+        x = RNG.normal(size=(n, insz)).astype(np.float32)
+        h0 = RNG.normal(size=(n, hsz)).astype(np.float32)
+        c0 = RNG.normal(size=(n, hsz)).astype(np.float32)
+        with torch.no_grad():
+            th, tc = cell(torch.tensor(x),
+                          (torch.tensor(h0), torch.tensor(c0)))
+        # torch packs weights (4h, in) + (4h, h), order i,f,g,o
+        w = np.concatenate([cell.weight_ih.detach().numpy(),
+                            cell.weight_hh.detach().numpy()], 1).T
+        b = (cell.bias_ih + cell.bias_hh).detach().numpy()
+        h, c = get_op("lstm_cell")(jnp.asarray(x), jnp.asarray(h0),
+                                   jnp.asarray(c0), jnp.asarray(w),
+                                   jnp.asarray(b))
+        np.testing.assert_allclose(npx(h), th.numpy(), rtol=1e-4,
+                                   atol=1e-5)
+        np.testing.assert_allclose(npx(c), tc.numpy(), rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_gru_cell_runs_and_gates(self):
+        insz, hsz, n = 5, 7, 3
+        x = jnp.asarray(RNG.normal(size=(n, insz)).astype(np.float32))
+        h0 = jnp.asarray(RNG.normal(size=(n, hsz)).astype(np.float32))
+        w = jnp.asarray(RNG.normal(
+            size=(insz + hsz, 3 * hsz)).astype(np.float32) * 0.3)
+        b = jnp.zeros(3 * hsz)
+        h = get_op("gru_cell")(x, h0, w, b)
+        assert h.shape == (n, hsz)
+        # zero weights -> z=0.5, n=0 -> h = 0.5*h0
+        h_zero = get_op("gru_cell")(x, h0, jnp.zeros_like(w), b)
+        np.testing.assert_allclose(npx(h_zero), 0.5 * npx(h0), rtol=1e-5)
+
+    def test_sru_cell_and_sequence_agree(self):
+        d, n, t = 6, 3, 5
+        x = jnp.asarray(RNG.normal(size=(n, t, d)).astype(np.float32))
+        w = jnp.asarray(RNG.normal(size=(d, 3 * d)).astype(np.float32)
+                        * 0.4)
+        b = jnp.asarray(RNG.normal(size=(2 * d,)).astype(np.float32))
+        c0 = jnp.zeros((n, d))
+        h_seq, c_last = get_op("sru")(x, w, b, c0)
+        c = c0
+        for i in range(t):
+            h_i, c = get_op("sru_cell")(x[:, i], c, w, b)
+            np.testing.assert_allclose(npx(h_seq[:, i]), npx(h_i),
+                                       rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(npx(c_last), npx(c), rtol=1e-4,
+                                   atol=1e-5)
+
+
+class TestUpdaterOps:
+    """Each updater op must agree with the object-level updater in
+    learning/updaters.py on the same gradient stream (the reference
+    tests updaters both through the ops and the Java API)."""
+
+    def _stream(self, n=4):
+        return [jnp.asarray(RNG.normal(size=(5,)).astype(np.float32))
+                for _ in range(n)]
+
+    def test_sgd_updater(self):
+        g = self._stream(1)[0]
+        np.testing.assert_allclose(npx(get_op("sgd_updater")(g, 0.1)),
+                                   0.1 * npx(g), rtol=1e-6)
+
+    def test_adam_updater_matches_object(self):
+        from deeplearning4j_tpu.learning.updaters import Adam
+        upd = Adam(learning_rate=1e-2)
+        state = upd.init_state({"p": jnp.zeros(5)})
+        m = v = jnp.zeros(5)
+        for i, g in enumerate(self._stream()):
+            delta, m, v = get_op("adam_updater")(g, m, v, lr=1e-2,
+                                                 step=i)
+            out, state = upd.apply(state, {"p": g}, jnp.asarray(i))
+            np.testing.assert_allclose(npx(delta), npx(out["p"]),
+                                       rtol=1e-5, atol=1e-6)
+
+    def test_nesterovs_updater_matches_object(self):
+        from deeplearning4j_tpu.learning.updaters import Nesterovs
+        upd = Nesterovs(learning_rate=0.1, momentum=0.9)
+        state = upd.init_state({"p": jnp.zeros(5)})
+        v = jnp.zeros(5)
+        for i, g in enumerate(self._stream()):
+            delta, v = get_op("nesterovs_updater")(g, v, 0.1, 0.9)
+            out, state = upd.apply(state, {"p": g}, jnp.asarray(i))
+            np.testing.assert_allclose(npx(delta), npx(out["p"]),
+                                       rtol=1e-5, atol=1e-6)
+
+    def test_rms_prop_updater_matches_object(self):
+        from deeplearning4j_tpu.learning.updaters import RmsProp
+        upd = RmsProp(learning_rate=0.01)
+        state = upd.init_state({"p": jnp.zeros(5)})
+        acc = jnp.zeros(5)
+        for i, g in enumerate(self._stream()):
+            delta, acc = get_op("rms_prop_updater")(
+                g, acc, 0.01, upd.rms_decay, upd.epsilon)
+            out, state = upd.apply(state, {"p": g}, jnp.asarray(i))
+            np.testing.assert_allclose(npx(delta), npx(out["p"]),
+                                       rtol=1e-5, atol=1e-6)
+
+    def test_ada_grad_updater_matches_object(self):
+        from deeplearning4j_tpu.learning.updaters import AdaGrad
+        upd = AdaGrad(learning_rate=0.05)
+        state = upd.init_state({"p": jnp.zeros(5)})
+        acc = jnp.zeros(5)
+        for i, g in enumerate(self._stream()):
+            delta, acc = get_op("ada_grad_updater")(g, acc, 0.05,
+                                                    upd.epsilon)
+            out, state = upd.apply(state, {"p": g}, jnp.asarray(i))
+            np.testing.assert_allclose(npx(delta), npx(out["p"]),
+                                       rtol=1e-5, atol=1e-6)
+
+    def test_ada_delta_updater_matches_object(self):
+        from deeplearning4j_tpu.learning.updaters import AdaDelta
+        upd = AdaDelta()
+        state = upd.init_state({"p": jnp.zeros(5)})
+        msg = msdx = jnp.zeros(5)
+        for i, g in enumerate(self._stream()):
+            delta, msg, msdx = get_op("ada_delta_updater")(
+                g, msg, msdx, upd.rho, upd.epsilon)
+            out, state = upd.apply(state, {"p": g}, jnp.asarray(i))
+            np.testing.assert_allclose(npx(delta), npx(out["p"]),
+                                       rtol=1e-5, atol=1e-6)
+
+    def test_remaining_updaters_descend(self):
+        # ada_delta / ada_max / nadam / ams_grad: shapes + descent on a
+        # quadratic (full object-parity lives with their objects)
+        for name, nstates in [("ada_delta_updater", 2),
+                              ("ada_max_updater", 2),
+                              ("nadam_updater", 2),
+                              ("ams_grad_updater", 3)]:
+            w = jnp.asarray([2.0, -3.0, 1.0])
+            states = [jnp.zeros(3) for _ in range(nstates)]
+            for i in range(200):
+                g = 2 * w
+                if name == "ada_delta_updater":
+                    delta, *states = get_op(name)(g, *states)
+                else:
+                    delta, *states = get_op(name)(g, *states, step=i)
+                w = w - delta
+            assert float(jnp.sum(w * w)) < 13.5, name
+
+
+class TestNNExtras:
+    def test_bias_add_relu_layer(self):
+        b = jnp.asarray([1.0] * 6)
+        np.testing.assert_allclose(npx(get_op("bias_add")(X, b)),
+                                   npx(X) + 1.0, rtol=1e-6)
+        w = jnp.asarray(RNG.normal(size=(6, 3)).astype(np.float32))
+        got = npx(get_op("relu_layer")(X, w, jnp.zeros(3)))
+        np.testing.assert_allclose(got, np.maximum(npx(X) @ npx(w), 0),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_pointwise_conv2d(self):
+        w = jnp.asarray(RNG.normal(size=(1, 1, 3, 5)).astype(np.float32))
+        got = get_op("pointwise_conv2d")(IMG, w)
+        assert got.shape == (2, 8, 8, 5)
+        want = np.einsum("nhwc,co->nhwo", npx(IMG), npx(w)[0, 0])
+        np.testing.assert_allclose(npx(got), want, rtol=1e-4, atol=1e-5)
+
+    def test_deconv3d_shape(self):
+        x = jnp.ones((1, 4, 4, 4, 2))
+        w = jnp.ones((2, 2, 2, 2, 3))
+        out = get_op("deconv3d")(x, w, strides=(2, 2, 2))
+        assert out.shape == (1, 8, 8, 8, 3)
+
+    def test_upsampling3d(self):
+        x = jnp.arange(8.0).reshape(1, 2, 2, 2, 1)
+        out = get_op("upsampling3d")(x, 2)
+        assert out.shape == (1, 4, 4, 4, 1)
+        assert float(out[0, 0, 0, 0, 0]) == float(out[0, 1, 1, 1, 0])
+
+    def test_dilation2d_matches_tf(self):
+        tf = pytest.importorskip("tensorflow")
+        x = npx(IMG)
+        f = RNG.normal(size=(3, 3, 3)).astype(np.float32) * 0.1
+        want = tf.nn.dilation2d(
+            tf.constant(x), tf.constant(f), strides=[1, 1, 1, 1],
+            padding="VALID", data_format="NHWC",
+            dilations=[1, 1, 1, 1]).numpy()
+        got = npx(get_op("dilation2d")(IMG, jnp.asarray(f)))
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    def test_max_pool_with_argmax_matches_tf(self):
+        tf = pytest.importorskip("tensorflow")
+        vals, idx = get_op("max_pool_with_argmax")(IMG, (2, 2))
+        tv, ti = tf.nn.max_pool_with_argmax(
+            tf.constant(npx(IMG)), 2, 2, "VALID")
+        np.testing.assert_allclose(npx(vals), tv.numpy(), rtol=1e-5)
+        np.testing.assert_array_equal(npx(idx), ti.numpy())
+
+    def test_col2im_adjoint_of_im2col(self):
+        # <im2col(x), y> == <x, col2im(y)> — the defining adjoint
+        x = jnp.asarray(RNG.normal(size=(2, 6, 6, 3)).astype(np.float32))
+        cols = get_op("im2col")(x, (2, 2), (2, 2), "VALID")
+        y = jnp.asarray(RNG.normal(size=cols.shape).astype(np.float32))
+        back = get_op("col2im")(y, (6, 6), (2, 2), (2, 2))
+        lhs = float(jnp.sum(cols * y))
+        rhs = float(jnp.sum(x * back))
+        assert np.isclose(lhs, rhs, rtol=1e-4)
+
+    def test_precise_gelu_matches_torch(self):
+        import torch
+        want = torch.nn.functional.gelu(torch.tensor(npx(X))).numpy()
+        np.testing.assert_allclose(npx(get_op("precise_gelu")(X)), want,
+                                   rtol=1e-4, atol=1e-5)
+
+
+class TestShapeTransform:
+    def test_invert_permutation(self):
+        p = jnp.asarray([2, 0, 3, 1], jnp.int32)
+        np.testing.assert_array_equal(
+            npx(get_op("invert_permutation")(p)), [1, 3, 0, 2])
+
+    def test_parallel_stack_identity_n(self):
+        out = get_op("parallel_stack")(X, X + 1)
+        assert out.shape == (2, 4, 6)
+        a, b = get_op("identity_n")(X, P)
+        assert a is X and b is P
+
+    def test_dynamic_partition(self):
+        parts = jnp.asarray([0, 1, 0, 1], jnp.int32)
+        p0, p1 = get_op("dynamic_partition")(X, parts, 2)
+        np.testing.assert_allclose(npx(p0), npx(X)[[0, 2]])
+        np.testing.assert_allclose(npx(p1), npx(X)[[1, 3]])
+
+    def test_unique_setdiff1d(self):
+        x = jnp.asarray([3, 1, 3, 2, 1], jnp.int32)
+        vals, inv = get_op("unique")(x)
+        np.testing.assert_array_equal(npx(vals), [1, 2, 3])
+        np.testing.assert_array_equal(npx(vals)[npx(inv)], npx(x))
+        d, idx = get_op("setdiff1d")(x, jnp.asarray([1, 2], jnp.int32))
+        np.testing.assert_array_equal(npx(d), [3, 3])
+        np.testing.assert_array_equal(npx(idx), [0, 2])
+
+    def test_broadcast_dynamic_shape_size_at_tile_to_shape(self):
+        s = get_op("broadcast_dynamic_shape")(
+            jnp.asarray([4, 1]), jnp.asarray([1, 6]))
+        np.testing.assert_array_equal(npx(s), [4, 6])
+        assert int(get_op("size_at")(X, 1)) == 6
+        t = get_op("tile_to_shape")(jnp.ones((1, 6)), (4, 6))
+        assert t.shape == (4, 6)
+
+    def test_assign_create(self):
+        out = get_op("assign")(X, 7.0)
+        assert out.shape == X.shape and float(out[0, 0]) == 7.0
+        z = get_op("create")((2, 3), "int32")
+        assert z.shape == (2, 3) and z.dtype == jnp.int32
+
+    def test_clip_by_global_norm(self):
+        a, b, gn = get_op("clip_by_global_norm")(X, P, clip_norm=1.0)
+        want_gn = np.sqrt((npx(X) ** 2).sum() + (npx(P) ** 2).sum())
+        assert np.isclose(float(gn), want_gn, rtol=1e-5)
+        got_norm = np.sqrt((npx(a) ** 2).sum() + (npx(b) ** 2).sum())
+        assert np.isclose(got_norm, 1.0, rtol=1e-4)
+
+    def test_clip_by_avg_norm(self):
+        out = get_op("clip_by_avg_norm")(X, 1e-4)
+        avg = np.sqrt((npx(out) ** 2).sum()) / X.size
+        assert avg <= 1.01e-4
+
+    def test_space_batch_nd_roundtrip_matches_tf(self):
+        tf = pytest.importorskip("tensorflow")
+        x = npx(IMG)
+        want = tf.space_to_batch_nd(
+            tf.constant(x), [2, 2], [[0, 0], [0, 0]]).numpy()
+        got = get_op("space_to_batch_nd")(IMG, [2, 2],
+                                          [[0, 0], [0, 0]])
+        np.testing.assert_allclose(npx(got), want, rtol=1e-6)
+        back = get_op("batch_to_space_nd")(got, [2, 2],
+                                           [[0, 0], [0, 0]])
+        np.testing.assert_allclose(npx(back), x, rtol=1e-6)
+
+
+class TestMoments:
+    def test_sufficient_and_normalize(self):
+        cnt, ms, vs, _ = get_op("sufficient_statistics")(X, [0])
+        mean, var = get_op("normalize_moments")(cnt, ms, vs)
+        np.testing.assert_allclose(npx(mean), npx(X).mean(0), rtol=1e-4)
+        np.testing.assert_allclose(npx(var), npx(X).var(0), rtol=1e-3,
+                                   atol=1e-5)
+
+    def test_weighted_moments(self):
+        w = jnp.ones_like(X)
+        mean, var = get_op("weighted_moments")(X, [0, 1], w)
+        assert np.isclose(float(mean), npx(X).mean(), rtol=1e-5)
+        assert np.isclose(float(var), npx(X).var(), rtol=1e-4)
+
+
+class TestImageExtras:
+    def test_yiq_roundtrip(self):
+        back = get_op("yiq_to_rgb")(get_op("rgb_to_yiq")(IMG))
+        np.testing.assert_allclose(npx(back), npx(IMG), rtol=1e-3,
+                                   atol=1e-4)
+
+    def test_rgb_to_yiq_matches_tf(self):
+        tf = pytest.importorskip("tensorflow")
+        want = tf.image.rgb_to_yiq(tf.constant(npx(IMG))).numpy()
+        # TF's YIQ kernel uses slightly different matrix rounding
+        np.testing.assert_allclose(npx(get_op("rgb_to_yiq")(IMG)), want,
+                                   rtol=1e-3, atol=1e-4)
+
+    def test_image_resize_methods(self):
+        for m in ("bilinear", "nearest", "bicubic"):
+            out = get_op("image_resize")(IMG, (4, 4), method=m)
+            assert out.shape == (2, 4, 4, 3), m
+
+    def test_random_crop(self):
+        out = get_op("random_crop")(IMG, (2, 4, 4, 3), seed=3)
+        assert out.shape == (2, 4, 4, 3)
+
+    def test_non_max_suppression_overlaps(self):
+        ov = jnp.asarray([[1.0, 0.9, 0.1], [0.9, 1.0, 0.2],
+                          [0.1, 0.2, 1.0]])
+        sc = jnp.asarray([0.9, 0.8, 0.7])
+        keep = get_op("non_max_suppression_overlaps")(ov, sc, 3, 0.5)
+        np.testing.assert_array_equal(npx(keep), [0, 2])
+
+    def test_draw_bounding_boxes(self):
+        imgs = jnp.zeros((1, 8, 8, 3))
+        boxes = jnp.asarray([[[0.25, 0.25, 0.75, 0.75]]])
+        out = get_op("draw_bounding_boxes")(imgs, boxes)
+        assert float(out[0, 2, 2, 0]) == 1.0      # border painted
+        assert float(out[0, 4, 4, 0]) == 0.0      # interior untouched
+
+    def test_total_variation_matches_tf(self):
+        tf = pytest.importorskip("tensorflow")
+        want = tf.image.total_variation(tf.constant(npx(IMG))).numpy()
+        np.testing.assert_allclose(npx(get_op("total_variation")(IMG)),
+                                   want, rtol=1e-4)
+
+    def test_psnr(self):
+        a = jnp.zeros((1, 4, 4, 1))
+        b = jnp.full((1, 4, 4, 1), 0.1)
+        assert np.isclose(float(get_op("psnr")(a, b, 1.0)[0]), 20.0,
+                          rtol=1e-4)
+
+
+class TestStragglers:
+    def test_zeta_lbeta(self):
+        from scipy import special
+        got = npx(get_op("zeta")(jnp.asarray(3.0), jnp.asarray(2.0)))
+        assert np.isclose(float(got.reshape(-1)[0]),
+                          float(special.zeta(3.0, 2.0)), rtol=1e-5)
+        x = jnp.asarray([[0.5, 2.0, 1.5]])
+        want = (special.gammaln([0.5, 2.0, 1.5]).sum()
+                - special.gammaln(4.0))
+        assert np.isclose(float(get_op("lbeta")(x)[0]), want, rtol=1e-5)
+
+    def test_axpy_histogram(self):
+        np.testing.assert_allclose(npx(get_op("axpy")(2.0, X, P)),
+                                   2 * npx(X) + npx(P), rtol=1e-6)
+        h = get_op("histogram")(P, nbins=4)
+        assert int(jnp.sum(h)) == P.size
+
+    def test_compare_and_bitpack(self):
+        x = jnp.asarray([[1.0, -1.0, 1.0, -1.0, 1.0, 1.0, -1.0, -1.0]])
+        out = get_op("compare_and_bitpack")(x, 0.0)
+        assert out.dtype == jnp.uint8
+        assert int(out[0, 0]) == 0b10101100
+
+    def test_monotonic_predicates(self):
+        inc = jnp.asarray([1.0, 2.0, 3.0])
+        assert bool(get_op("is_non_decreasing")(inc))
+        assert bool(get_op("is_strictly_increasing")(inc))
+        assert not bool(get_op("is_strictly_increasing")(
+            jnp.asarray([1.0, 1.0])))
+        assert bool(get_op("is_non_decreasing")(jnp.asarray([1.0, 1.0])))
+        assert bool(get_op("is_numeric_tensor")(X))
+
+    def test_matrix_diag_part(self):
+        m = jnp.asarray(RNG.normal(size=(2, 3, 3)).astype(np.float32))
+        np.testing.assert_allclose(
+            npx(get_op("matrix_diag_part")(m)),
+            np.diagonal(npx(m), axis1=-2, axis2=-1), rtol=1e-6)
+
+    def test_merge_family(self):
+        a, b = X, X + 1
+        np.testing.assert_allclose(npx(get_op("mergemax")(a, b)),
+                                   npx(b), rtol=1e-6)
+        np.testing.assert_allclose(npx(get_op("mergeadd")(a, b)),
+                                   2 * npx(X) + 1, rtol=1e-5)
+        np.testing.assert_allclose(npx(get_op("mergeavg")(a, b)),
+                                   npx(X) + 0.5, rtol=1e-5)
+        assert int(get_op("mergemaxindex")(a, b)[0, 0]) == 1
+
+    def test_fake_quant_matches_tf(self):
+        tf = pytest.importorskip("tensorflow")
+        x = npx(X)
+        want = tf.quantization.fake_quant_with_min_max_args(
+            tf.constant(x), min=-2.0, max=2.0).numpy()
+        got = npx(get_op("fake_quant_with_min_max_args")(
+            X, min=-2.0, max=2.0))
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+        got_v = npx(get_op("fake_quant_with_min_max_vars")(
+            X, jnp.asarray(-2.0), jnp.asarray(2.0)))
+        np.testing.assert_allclose(got_v, want, rtol=1e-4, atol=1e-5)
+
+
+class TestWord2VecOps:
+    def test_skipgram_step_reduces_loss(self):
+        d, k = 8, 5
+        h = jnp.asarray(RNG.normal(size=(d,)).astype(np.float32) * 0.1)
+        ctx = jnp.asarray(RNG.normal(size=(k, d)).astype(np.float32)
+                          * 0.1)
+        labels = jnp.asarray([1.0, 0.0, 0.0, 0.0, 0.0])
+
+        def loss(h, ctx):
+            lg = ctx @ h
+            return float(jnp.sum(
+                -labels * jax.nn.log_sigmoid(lg)
+                - (1 - labels) * jax.nn.log_sigmoid(-lg)))
+
+        before = loss(h, ctx)
+        for _ in range(20):
+            h, ctx = get_op("skipgram")(h, ctx, labels, lr=0.1)
+        assert loss(h, ctx) < before
+
+    def test_cbow_step_reduces_loss(self):
+        d, k, m = 8, 4, 3
+        ctx = jnp.asarray(RNG.normal(size=(k, d)).astype(np.float32)
+                          * 0.1)
+        tgt = jnp.asarray(RNG.normal(size=(m, d)).astype(np.float32)
+                          * 0.1)
+        labels = jnp.asarray([1.0, 0.0, 0.0])
+
+        def loss(ctx, tgt):
+            hh = jnp.mean(ctx, axis=0)
+            lg = tgt @ hh
+            return float(jnp.sum(
+                -labels * jax.nn.log_sigmoid(lg)
+                - (1 - labels) * jax.nn.log_sigmoid(-lg)))
+
+        before = loss(ctx, tgt)
+        for _ in range(20):
+            ctx, tgt = get_op("cbow")(ctx, tgt, labels, lr=0.1)
+        assert loss(ctx, tgt) < before
+
+
+class TestAbsReductions:
+    def test_amax_amin_amean_asum(self):
+        np.testing.assert_allclose(float(get_op("amax")(X)),
+                                   np.abs(npx(X)).max(), rtol=1e-6)
+        np.testing.assert_allclose(float(get_op("amin")(X)),
+                                   np.abs(npx(X)).min(), rtol=1e-6)
+        np.testing.assert_allclose(
+            npx(get_op("amean")(X, dimensions=[1])),
+            np.abs(npx(X)).mean(1), rtol=1e-5)
+        np.testing.assert_allclose(
+            npx(get_op("asum")(X, dimensions=[0], keep_dims=True)),
+            np.abs(npx(X)).sum(0, keepdims=True), rtol=1e-5)

@@ -660,6 +660,39 @@ def test_op_case2(opname):
 # ---------------------------------------------------------------------
 GRAD_CASES = {
     # opname -> (args builder producing differentiable first arg, kwargs)
+    # --- round-3 declarable tail ---
+    "l2_loss": ((X,), {}),
+    "mean_squared_error": ((X, Y), {"_swap": True}),
+    "smooth_l1_loss": ((X, Y), {}),
+    "weighted_cross_entropy_with_logits": (
+        (X, (P > 0.5).astype(jnp.float32)),
+        {"pos_weight": 2.0, "_swap": True}),
+    "log_poisson_loss": ((X, P), {}),
+    "precise_gelu": ((X,), {}),
+    "axpy": ((X, Y, P), {}),
+    "total_variation": ((IMG,), {}),
+    "amean": ((X,), {}),
+    "asum": ((X,), {}),
+    "lbeta": ((P + 0.5,), {}),
+    "mergeavg": ((X, Y), {}),
+    "relu_layer": ((X, jnp.asarray(R.normal(size=(6, 3))
+                                   .astype(np.float32)),
+                    jnp.full((3,), 0.3)), {}),
+    "lstm_cell": ((X, jnp.asarray(R.normal(size=(4, 5))
+                                  .astype(np.float32)),
+                   jnp.asarray(R.normal(size=(4, 5)).astype(np.float32)),
+                   jnp.asarray(R.normal(size=(11, 20))
+                               .astype(np.float32) * 0.3),
+                   jnp.zeros(20)), {}),
+    "gru_cell": ((X, jnp.asarray(R.normal(size=(4, 5))
+                                 .astype(np.float32)),
+                  jnp.asarray(R.normal(size=(11, 15))
+                              .astype(np.float32) * 0.3),
+                  jnp.zeros(15)), {}),
+    "sru_cell": ((X, Y,
+                  jnp.asarray(R.normal(size=(6, 18))
+                              .astype(np.float32) * 0.3),
+                  jnp.zeros(12)), {}),
     "asinh": ((X,), {}),
     "atanh": ((P * 0.5,), {}),
     "expm1": ((X,), {}),
