@@ -529,14 +529,22 @@ def _register_standard_mappers():
         """MatrixDiag/Part/SetDiag V2/V3 extra operands — only the
         defaults map onto the square diag ops: k must be 0 (the main
         diagonal; -1 here means SUB-diagonal, not a default), num_rows/
-        num_cols must be the -1 'infer' sentinel (an explicit size
-        would pad/truncate, which matrix_diag ignores), padding_value
-        must be 0."""
+        num_cols must be the -1 'infer' sentinel OR equal the natural
+        diagonal length (converters often materialize concrete shapes;
+        an explicit size that pads/truncates would be miscompiled by
+        matrix_diag), padding_value must be 0."""
+        diag_len = None
+        p = ctx.avals.get(ctx.inputs[0].name) if ctx.avals else None
+        if p is not None and p[0].shape and \
+                p[0].shape[-1] == p[1].shape[-1]:
+            diag_len = int(p[0].shape[-1])
         base = len(ctx.inputs) - len(roles)
         for i, role in enumerate(roles):
             v = np.atleast_1d(ctx.static_np(base + i))
             ok = np.all(v == 0) if role in ("k", "padding") \
-                else np.all(v == -1)
+                else (np.all(v == -1)
+                      or (diag_len is not None
+                          and np.all(v == diag_len)))
             if not ok:
                 raise TFImportError(
                     f"{ctx.node.name} ({ctx.node.op}): {role}="

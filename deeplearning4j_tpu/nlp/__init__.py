@@ -26,6 +26,9 @@ from deeplearning4j_tpu.nlp.serializer import WordVectorSerializer
 from deeplearning4j_tpu.nlp.glove import Glove
 from deeplearning4j_tpu.nlp.fasttext import FastText
 from deeplearning4j_tpu.nlp.tsne import BarnesHutTsne
+from deeplearning4j_tpu.nlp.bert_wordpiece import (
+    BertIterator, BertWordPieceTokenizer,
+)
 from deeplearning4j_tpu.nlp.sentence_iterators import (
     CnnSentenceDataSetIterator, CollectionLabeledSentenceProvider,
     LabeledSentenceProvider,
@@ -33,6 +36,7 @@ from deeplearning4j_tpu.nlp.sentence_iterators import (
 
 __all__ = [
     "AbstractCache", "BarnesHutTsne", "BasicLineIterator",
+    "BertIterator", "BertWordPieceTokenizer",
     "CnnSentenceDataSetIterator", "CollectionLabeledSentenceProvider",
     "CollectionSentenceIterator",
     "LabeledSentenceProvider",

@@ -1,0 +1,303 @@
+"""BERT WordPiece tokenization + BertIterator.
+
+Reference: deeplearning4j-nlp-parent's BertWordPieceTokenizer (greedy
+longest-match-first over a fixed vocab, '##' continuation prefix, with
+the BERT "basic tokenizer" preprocessing: clean/lowercase/strip
+accents/punctuation-split/CJK spacing) and BertIterator (batches of
+token ids + segment ids + masks feeding SameDiff BERT fine-tuning —
+SURVEY.md §2.35). TPU-native difference: the iterator emits fixed-
+length, padded, jit-stable [N, T] int32 batches so every minibatch
+hits the same compiled executable.
+"""
+
+from __future__ import annotations
+
+import unicodedata
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+PAD, UNK, CLS, SEP, MASK = "[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]"
+
+
+def load_vocab(path_or_tokens) -> Dict[str, int]:
+    """Vocab file: one token per line, id = line number (the format
+    shipped with every BERT checkpoint)."""
+    if isinstance(path_or_tokens, dict):
+        return dict(path_or_tokens)
+    if isinstance(path_or_tokens, (list, tuple)):
+        return {t: i for i, t in enumerate(path_or_tokens)}
+    vocab: Dict[str, int] = {}
+    with open(path_or_tokens, encoding="utf-8") as f:
+        for line in f:
+            tok = line.rstrip("\n")
+            if tok:
+                vocab.setdefault(tok, len(vocab))
+    return vocab
+
+
+def _is_punctuation(ch: str) -> bool:
+    cp = ord(ch)
+    if (33 <= cp <= 47) or (58 <= cp <= 64) or (91 <= cp <= 96) \
+            or (123 <= cp <= 126):
+        return True
+    return unicodedata.category(ch).startswith("P")
+
+
+def _is_cjk(cp: int) -> bool:
+    return (0x4E00 <= cp <= 0x9FFF or 0x3400 <= cp <= 0x4DBF
+            or 0x20000 <= cp <= 0x2A6DF or 0x2A700 <= cp <= 0x2B73F
+            or 0x2B740 <= cp <= 0x2B81F or 0x2B820 <= cp <= 0x2CEAF
+            or 0xF900 <= cp <= 0xFAFF or 0x2F800 <= cp <= 0x2FA1F)
+
+
+class BertWordPieceTokenizer:
+    """Greedy longest-match WordPiece (reference:
+    o.d.text.tokenization.tokenizer.BertWordPieceTokenizer)."""
+
+    def __init__(self, vocab, lower_case: bool = True,
+                 strip_accents: bool = True,
+                 max_chars_per_word: int = 100):
+        self.vocab = load_vocab(vocab)
+        self.inv_vocab = {i: t for t, i in self.vocab.items()}
+        self.lower_case = lower_case
+        self.strip_accents = strip_accents
+        self.max_chars_per_word = max_chars_per_word
+
+    # ---- basic tokenizer (pre-wordpiece) ----
+    def _clean(self, text: str) -> str:
+        out = []
+        for ch in text:
+            cp = ord(ch)
+            if cp == 0 or cp == 0xFFFD or unicodedata.category(ch) in \
+                    ("Cc", "Cf"):
+                if ch in ("\t", "\n", "\r"):
+                    out.append(" ")
+                continue
+            if _is_cjk(cp):
+                out.append(f" {ch} ")
+            elif ch.isspace():
+                out.append(" ")
+            else:
+                out.append(ch)
+        return "".join(out)
+
+    def basic_tokenize(self, text: str) -> List[str]:
+        text = self._clean(text)
+        words: List[str] = []
+        for w in text.split():
+            if self.lower_case:
+                w = w.lower()
+            if self.strip_accents:
+                w = "".join(ch for ch in unicodedata.normalize("NFD", w)
+                            if unicodedata.category(ch) != "Mn")
+            cur = []
+            for ch in w:
+                if _is_punctuation(ch):
+                    if cur:
+                        words.append("".join(cur))
+                        cur = []
+                    words.append(ch)
+                else:
+                    cur.append(ch)
+            if cur:
+                words.append("".join(cur))
+        return words
+
+    # ---- wordpiece ----
+    def wordpiece(self, word: str) -> List[str]:
+        if len(word) > self.max_chars_per_word:
+            return [UNK]
+        pieces: List[str] = []
+        start = 0
+        while start < len(word):
+            end = len(word)
+            piece = None
+            while start < end:
+                sub = word[start:end]
+                if start > 0:
+                    sub = "##" + sub
+                if sub in self.vocab:
+                    piece = sub
+                    break
+                end -= 1
+            if piece is None:
+                return [UNK]
+            pieces.append(piece)
+            start = end
+        return pieces
+
+    def tokenize(self, text: str) -> List[str]:
+        out: List[str] = []
+        for w in self.basic_tokenize(text):
+            out.extend(self.wordpiece(w))
+        return out
+
+    def encode(self, text: str, pair: Optional[str] = None,
+               max_len: Optional[int] = None,
+               add_special: bool = True
+               ) -> Tuple[List[int], List[int]]:
+        """Token ids + segment ids, [CLS] a [SEP] (b [SEP]) layout."""
+        toks_a = self.tokenize(text)
+        toks_b = self.tokenize(pair) if pair is not None else []
+        if add_special and max_len is not None:
+            budget = max_len - 2 - (1 if pair is not None else 0)
+            if budget < 0:
+                raise ValueError(
+                    f"max_len={max_len} cannot fit the special tokens "
+                    f"([CLS]/[SEP]{'x2' if pair is not None else ''})")
+            if pair is not None:
+                # longest-first truncation (reference truncation rule)
+                while len(toks_a) + len(toks_b) > budget:
+                    (toks_a if len(toks_a) >= len(toks_b)
+                     else toks_b).pop()
+            else:
+                toks_a = toks_a[:budget]
+        toks = ([CLS] + toks_a + [SEP]) if add_special else toks_a
+        segs = [0] * len(toks)
+        if pair is not None:
+            tb = toks_b + [SEP] if add_special else toks_b
+            toks = toks + tb
+            segs = segs + [1] * len(tb)
+        unk = self.vocab[UNK]
+        return [self.vocab.get(t, unk) for t in toks], segs
+
+    def decode(self, ids: Sequence[int]) -> str:
+        toks = [self.inv_vocab.get(int(i), UNK) for i in ids]
+        out = []
+        for t in toks:
+            if t in (PAD, CLS, SEP):
+                continue
+            if t.startswith("##") and out:
+                out[-1] = out[-1] + t[2:]
+            else:
+                out.append(t)
+        return " ".join(out)
+
+
+class BertIterator:
+    """Fixed-length batch builder over labeled (or raw) sentences
+    (reference: o.d.iterator.BertIterator with Task.SEQ_CLASSIFICATION
+    / Task.UNSUPERVISED). Yields dict batches of np.int32 arrays:
+    ids [N,T], segment_ids [N,T], mask [N,T] (+ labels [N] or, for
+    the MLM task, mlm_labels [N,T] and mlm_positions [N,T])."""
+
+    SEQ_CLASSIFICATION = "seq_classification"
+    UNSUPERVISED = "unsupervised"
+
+    def __init__(self, tokenizer: BertWordPieceTokenizer,
+                 sentences: Sequence[Any], length: int = 128,
+                 batch_size: int = 32,
+                 task: str = SEQ_CLASSIFICATION,
+                 mask_prob: float = 0.15, seed: int = 0,
+                 n_classes: Optional[int] = None):
+        self.t = tokenizer
+        self.sentences = list(sentences)
+        self.length = length
+        self.batch_size = batch_size
+        self.task = task
+        self.mask_prob = mask_prob
+        self.rng = np.random.default_rng(seed)
+        self.n_classes = n_classes
+        self._pos = 0
+
+    # reference spelling
+    @classmethod
+    def builder(cls):
+        return _BertIteratorBuilder()
+
+    def reset(self) -> None:
+        self._pos = 0
+
+    def hasNext(self) -> bool:
+        return self._pos < len(self.sentences)
+
+    def __iter__(self):
+        self.reset()
+        return self
+
+    def __next__(self) -> Dict[str, np.ndarray]:
+        if not self.hasNext():
+            raise StopIteration
+        batch = self.sentences[self._pos:self._pos + self.batch_size]
+        self._pos += len(batch)
+        n, t = len(batch), self.length
+        ids = np.zeros((n, t), np.int32)
+        segs = np.zeros((n, t), np.int32)
+        mask = np.zeros((n, t), np.float32)
+        labels = np.zeros((n,), np.int32)
+        for r, item in enumerate(batch):
+            if self.task == self.SEQ_CLASSIFICATION:
+                text, label = item
+                labels[r] = int(label)
+                pair = None
+            else:
+                text = item if isinstance(item, str) else item[0]
+                pair = None
+            row_ids, row_segs = self.t.encode(text, pair, max_len=t)
+            m = len(row_ids)
+            ids[r, :m] = row_ids
+            segs[r, :m] = row_segs
+            mask[r, :m] = 1.0
+        out = {"ids": ids, "segment_ids": segs, "mask": mask}
+        if self.task == self.SEQ_CLASSIFICATION:
+            out["labels"] = labels
+            return out
+        # UNSUPERVISED: BERT MLM masking (80% [MASK] / 10% random /
+        # 10% keep), never on specials or padding
+        mlm_labels = ids.copy()
+        mvoc = self.t.vocab[MASK]
+        specials = {self.t.vocab[CLS], self.t.vocab[SEP], 0}
+        maskable = (mask > 0) & ~np.isin(ids, list(specials))
+        pick = maskable & (self.rng.random(ids.shape) < self.mask_prob)
+        roll = self.rng.random(ids.shape)
+        masked_ids = ids.copy()
+        masked_ids[pick & (roll < 0.8)] = mvoc
+        rand = pick & (roll >= 0.8) & (roll < 0.9)
+        masked_ids[rand] = self.rng.integers(
+            5, max(len(self.t.vocab), 6), rand.sum())
+        out["ids"] = masked_ids
+        out["mlm_labels"] = mlm_labels
+        out["mlm_positions"] = pick.astype(np.float32)
+        return out
+
+
+class _BertIteratorBuilder:
+    """Reference builder spelling: BertIterator.builder().tokenizer(t)
+    .lengthHandling(...).minibatchSize(...).sentenceProvider(...)
+    .task(...).build()."""
+
+    def __init__(self):
+        self._kw: Dict[str, Any] = {}
+
+    def tokenizer(self, t):
+        self._kw["tokenizer"] = t
+        return self
+
+    def lengthHandling(self, _mode, length: int):
+        self._kw["length"] = int(length)
+        return self
+
+    def minibatchSize(self, n: int):
+        self._kw["batch_size"] = int(n)
+        return self
+
+    def sentenceProvider(self, sentences):
+        self._kw["sentences"] = sentences
+        return self
+
+    def task(self, task: str):
+        self._kw["task"] = task
+        return self
+
+    def maskProbability(self, p: float):
+        self._kw["mask_prob"] = float(p)
+        return self
+
+    def seed(self, s: int):
+        self._kw["seed"] = int(s)
+        return self
+
+    def build(self) -> BertIterator:
+        return BertIterator(self._kw.pop("tokenizer"),
+                            self._kw.pop("sentences"), **self._kw)

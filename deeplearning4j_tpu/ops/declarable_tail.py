@@ -87,12 +87,10 @@ def log_poisson_loss(log_input, targets, compute_full_loss=False):
 @register_op("lstm_cell")
 def lstm_cell(x, h_prev, c_prev, w, b):
     """One LSTM step. w: [(in+h), 4h] gate order i,f,g,o; returns (h, c)."""
-    hsz = h_prev.shape[-1]
     z = jnp.concatenate([x, h_prev], axis=-1) @ w + b
     i, f, g, o = jnp.split(z, 4, axis=-1)
     c = jax.nn.sigmoid(f) * c_prev + jax.nn.sigmoid(i) * jnp.tanh(g)
     h = jax.nn.sigmoid(o) * jnp.tanh(c)
-    del hsz
     return h, c
 
 
@@ -478,7 +476,6 @@ def batch_to_space_nd(x, block_shape, crops):
     sp = list(x.shape[1:1 + m])
     rest = x.shape[1 + m:]
     x = x.reshape(block + [n] + sp + list(rest))
-    perm = [m] + [i + m + 1 for i in range(m) for i in [i]]
     perm = [m]
     for i in range(m):
         perm += [m + 1 + i, i]
@@ -539,12 +536,17 @@ def yiq_to_rgb(x):
 
 @register_op("image_resize")
 def image_resize(x, size, method="bilinear", antialias=False):
-    """Generic resize dispatcher (generic/parity_ops/image_resize)."""
+    """Generic resize dispatcher (generic/parity_ops/image_resize).
+    'area' follows ops/image.py resize_area: antialiased linear is the
+    box-filter approximation jax.image offers (no 'area' kernel)."""
     h, w = int(size[0]), int(size[1])
-    method = {"area": "linear", "bicubic": "cubic",
-              "bilinear": "linear", "nearest": "nearest",
-              "lanczos3": "lanczos3", "lanczos5": "lanczos5",
-              "cubic": "cubic", "linear": "linear"}[method]
+    if method == "area":
+        method, antialias = "linear", True
+    else:
+        method = {"bicubic": "cubic", "bilinear": "linear",
+                  "nearest": "nearest", "lanczos3": "lanczos3",
+                  "lanczos5": "lanczos5", "cubic": "cubic",
+                  "linear": "linear"}[method]
     shape = x.shape[:-3] + (h, w, x.shape[-1])
     if method == "nearest":
         return jax.image.resize(x, shape, "nearest")
@@ -643,7 +645,7 @@ def histogram(x, nbins=10):
     width = jnp.maximum(hi - lo, 1e-12)
     idx = jnp.clip(((x.reshape(-1) - lo) / width * nbins).astype(
         jnp.int32), 0, nbins - 1)
-    return jax.ops.segment_sum(jnp.ones_like(idx, jnp.int64), idx,
+    return jax.ops.segment_sum(jnp.ones_like(idx, jnp.int32), idx,
                                num_segments=int(nbins))
 
 

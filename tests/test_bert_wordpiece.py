@@ -1,0 +1,156 @@
+"""BertWordPieceTokenizer golden vs HuggingFace `tokenizers` + the
+BertIterator text->fine-tune path (reference: BertWordPieceTokenizer +
+BertIterator feeding SameDiff BERT fine-tuning, SURVEY.md §2.35)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nlp import BertIterator, BertWordPieceTokenizer
+
+VOCAB = ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]",
+         "the", "quick", "brown", "fox", "jump", "##s", "##ed", "over",
+         "lazy", "dog", "##gy", "un", "##aff", "##able", "run", "##ning",
+         "hello", "world", ",", ".", "!", "?", "'", "te", "##st",
+         "cafe", "12", "##3", "a", "b", "c", "中", "国"]
+
+SENTENCES = [
+    "The quick brown fox jumps over the lazy dog.",
+    "Hello, world!",
+    "unaffable",
+    "running tests",
+    "Café 123",            # accents + digits
+    "totallyunknownword here",  # -> [UNK]
+    "hello 中国 world",          # CJK chars split
+    "a b c a b c",
+]
+
+
+@pytest.fixture(scope="module")
+def vocab_file(tmp_path_factory):
+    p = tmp_path_factory.mktemp("wp") / "vocab.txt"
+    p.write_text("\n".join(VOCAB) + "\n", encoding="utf-8")
+    return str(p)
+
+
+@pytest.fixture(scope="module")
+def wp(vocab_file):
+    return BertWordPieceTokenizer(vocab_file)
+
+
+class TestWordPieceGolden:
+    def test_matches_hf_tokenizers(self, wp, vocab_file):
+        hf_tok = pytest.importorskip("tokenizers")
+        from tokenizers import BertWordPieceTokenizer as HFWordPiece
+
+        hf = HFWordPiece(vocab_file, lowercase=True)
+        del hf_tok
+        for s in SENTENCES:
+            ours, _ = wp.encode(s)
+            theirs = hf.encode(s).ids
+            assert ours == list(theirs), (s, ours, theirs)
+
+    def test_pair_encoding_matches_hf(self, wp, vocab_file):
+        pytest.importorskip("tokenizers")
+        from tokenizers import BertWordPieceTokenizer as HFWordPiece
+
+        hf = HFWordPiece(vocab_file, lowercase=True)
+        ids, segs = wp.encode("the quick fox", "hello world!")
+        enc = hf.encode("the quick fox", "hello world!")
+        assert ids == list(enc.ids)
+        assert segs == list(enc.type_ids)
+
+    def test_greedy_longest_match(self, wp):
+        assert wp.tokenize("unaffable") == ["un", "##aff", "##able"]
+        assert wp.tokenize("jumps") == ["jump", "##s"]
+        assert wp.tokenize("doggy") == ["dog", "##gy"]
+
+    def test_unknown_word(self, wp):
+        assert wp.tokenize("zzzzz") == ["[UNK]"]
+
+    def test_truncation_budget(self, wp):
+        ids, _ = wp.encode(" ".join(["the"] * 50), max_len=16)
+        assert len(ids) == 16
+        assert ids[0] == VOCAB.index("[CLS]")
+        assert ids[-1] == VOCAB.index("[SEP]")
+
+    def test_decode_roundtrip(self, wp):
+        ids, _ = wp.encode("unaffable doggy")
+        assert wp.decode(ids) == "unaffable doggy"
+
+
+class TestBertIterator:
+    def test_seq_classification_batches(self, wp):
+        data = [("the quick fox", 0), ("lazy doggy", 1),
+                ("hello world", 1)]
+        it = (BertIterator.builder().tokenizer(wp)
+              .lengthHandling("FIXED_LENGTH", 12).minibatchSize(2)
+              .sentenceProvider(data)
+              .task(BertIterator.SEQ_CLASSIFICATION).build())
+        batches = list(it)
+        assert [b["ids"].shape[0] for b in batches] == [2, 1]
+        b0 = batches[0]
+        assert b0["ids"].shape == (2, 12)
+        assert b0["mask"].dtype == np.float32
+        assert b0["labels"].tolist() == [0, 1]
+        # padding is masked out
+        row_len = int(b0["mask"][0].sum())
+        assert (b0["ids"][0, row_len:] == 0).all()
+
+    def test_unsupervised_mlm_masking(self, wp):
+        data = ["the quick brown fox jumps over the lazy dog"] * 8
+        it = BertIterator(wp, data, length=16, batch_size=8,
+                          task=BertIterator.UNSUPERVISED,
+                          mask_prob=0.5, seed=1)
+        b = next(iter(it))
+        pos = b["mlm_positions"]
+        assert pos.sum() > 0
+        # masked positions never touch CLS/SEP/PAD
+        cls_id, sep_id = VOCAB.index("[CLS]"), VOCAB.index("[SEP]")
+        orig = b["mlm_labels"]
+        assert not ((pos > 0) & ((orig == cls_id) | (orig == sep_id)
+                                 | (orig == 0))).any()
+        # ~80% of picked positions became [MASK]
+        mask_id = VOCAB.index("[MASK]")
+        frac = ((b["ids"] == mask_id) & (pos > 0)).sum() / pos.sum()
+        assert 0.5 < frac <= 1.0
+
+    def test_text_to_finetune_end_to_end(self, wp):
+        """Raw text -> BertIterator -> BertClassifier fine-tune: the
+        full reference capability (BertIterator + SameDiff BERT)."""
+        import jax
+
+        from deeplearning4j_tpu.learning.updaters import Adam
+        from deeplearning4j_tpu.models.bert_classifier import (
+            BertSequenceClassifier,
+        )
+        from deeplearning4j_tpu.models.transformer import tiny_config
+
+        data = [("the quick brown fox", 0), ("lazy doggy runs", 1),
+                ("quick quick fox fox", 0), ("lazy lazy dog dog", 1)] * 4
+        it = BertIterator(wp, data, length=12, batch_size=8, seed=0)
+
+        cfg = tiny_config(vocab=len(VOCAB), max_len=12, d_model=32,
+                          n_layers=2, n_heads=4, d_ff=64)
+        model = BertSequenceClassifier(cfg, n_classes=2)
+        params = model.init_params()
+        updater = Adam(learning_rate=5e-3)
+        opt = updater.init_state(params)
+        step = model.make_train_step(updater)
+
+        losses = []
+        rng = jax.random.key(0)
+        for epoch in range(6):
+            ep = []
+            for b in it:
+                params, opt, loss = step(
+                    params, opt, np.int32(epoch), b["ids"],
+                    b["labels"], b["mask"], rng)
+                ep.append(float(loss))
+            losses.append(sum(ep) / len(ep))
+        assert losses[-1] < losses[0] * 0.5, losses
+
+        preds = model.predict(params, batches_ids := next(
+            iter(it))["ids"], mask=None)
+        assert preds.shape[0] == batches_ids.shape[0]

@@ -27,6 +27,10 @@ class TestExamples:
         acc = _run("bert_finetune.py").main(steps=40)
         assert acc > 0.7
 
+    def test_bert_text_finetune(self):
+        acc = _run("bert_text_finetune.py").main(epochs=6)
+        assert acc >= 0.9
+
     def test_word2vec_text_cnn(self):
         p = _run("word2vec_text_cnn.py").main()
         assert p > 0.5
