@@ -80,11 +80,12 @@ def _broadcast_to(x, shape):
 class _Ctx:
     def __init__(self, sd: SameDiff, node: NodeProto,
                  inputs: List[Optional[SDVariable]],
-                 static: List[Optional[np.ndarray]]):
+                 static: List[Optional[np.ndarray]], avals=None):
         self.sd = sd
         self.node = node
         self.inputs = inputs
         self._static = static
+        self.avals = avals  # var name -> jax.ShapeDtypeStruct
 
     def attr(self, name: str, default=None):
         return self.node.attributes.get(name, default)
@@ -392,10 +393,24 @@ def _cast(ctx):
 
 @R("Shape")
 def _shape(ctx):
-    # static shapes only: materialize as a constant at import time
-    raise OnnxImportError(
-        "Shape op requires dynamic shapes; re-export with static shapes "
-        "(XLA compiles static programs)")
+    """Static shapes fold to an import-time constant (real exporters
+    emit Shape->Gather->Concat reshape subgraphs around attention; the
+    whole chain folds via the importer's int-subgraph folding)."""
+    aval = ctx.avals.get(ctx.inputs[0].name) if ctx.avals else None
+    if aval is None or any(d is None or d < 0 for d in aval.shape):
+        raise OnnxImportError(
+            f"{ctx.node.name or ctx.node.op_type}: Shape of a tensor "
+            "with unknown dims — re-export with static shapes (XLA "
+            "compiles static programs)")
+    # opset >= 15: optional start/end attrs slice the shape vector
+    rank = len(aval.shape)
+    start = int(ctx.attr("start", 0)) % max(rank, 1) \
+        if int(ctx.attr("start", 0)) < 0 else int(ctx.attr("start", 0))
+    end = ctx.attr("end")
+    end = rank if end is None else (int(end) + rank if int(end) < 0
+                                    else min(int(end), rank))
+    return ctx.sd.constant(ctx.node.output[0],
+                           np.asarray(aval.shape[start:end], np.int64))
 
 
 @R("Constant")
@@ -482,11 +497,10 @@ def _conv(ctx):
     if spatial is not None:
         x = _explicit_pad_nhwc(ctx, x, spatial)
         pad_mode = "VALID"
-    if group == 1:
-        out = ctx.op("conv2d", [x, w], strides=strides, padding=pad_mode,
-                     dilation=dil)
-    else:
-        raise OnnxImportError("grouped Conv (group>1) not yet mapped")
+    # ONNX OIHW weights transpose to (kH, kW, I/g, O) above — exactly
+    # the grouped-HWIO layout conv2d's feature_group_count expects
+    out = ctx.op("conv2d", [x, w], strides=strides, padding=pad_mode,
+                 dilation=dil, groups=group)
     if len(ctx.inputs) > 2 and ctx.inputs[2] is not None:
         out = ctx.op("add", [out, ctx.inputs[2]])
     return ctx.to_nchw(out)
@@ -559,22 +573,92 @@ class OnnxImport:
 
     @staticmethod
     def importGraph(model_or_path) -> SameDiff:
+        import jax
+
+        from deeplearning4j_tpu.ops.registry import get_op
+
         model = OnnxImport._as_model(model_or_path)
         g: GraphProto = model.graph
         sd = SameDiff.create()
         tensors: Dict[str, SDVariable] = {}
         const_vals: Dict[str, np.ndarray] = {}
+        # var name -> ShapeDtypeStruct: everything is static (no
+        # dynamic_axes), so one abstract eval per op gives Shape
+        # folding + int-subgraph constant folding for free
+        avals: Dict[str, "jax.ShapeDtypeStruct"] = {}
 
         for init in g.initializers:
             arr = init.to_numpy()
             const_vals[init.name] = arr
             tensors[init.name] = sd.constant(init.name, arr)
+            avals[init.name] = jax.ShapeDtypeStruct(
+                tuple(arr.shape), arr.dtype)
         init_names = {i.name for i in g.initializers}
         for vi in g.inputs:
             if vi.name in init_names:
                 continue
             shape = [d if d is not None else -1 for d in vi.shape]
             tensors[vi.name] = sd.placeholder(vi.name, shape=shape or None)
+            if shape and all(d >= 0 for d in shape):
+                from deeplearning4j_tpu.modelimport.onnx.onnx_proto \
+                    import TensorProto
+                dt = TensorProto._DTYPES.get(vi.elem_type, np.float32)
+                avals[vi.name] = jax.ShapeDtypeStruct(
+                    tuple(shape), np.dtype(dt))
+
+        def _propagate(from_idx: int) -> None:
+            """Shape/dtype eval for ops emitted since from_idx, plus
+            eager folding of small integer results whose inputs are all
+            import-time constants (the exporter's Shape->Gather->Concat
+            reshape subgraphs become consts Reshape can consume)."""
+            for opnode in sd._ops[from_idx:]:
+                fn = get_op(opnode.op_name)
+                ins = []
+                for iname in opnode.inputs:
+                    if iname in avals:
+                        ins.append(avals[iname])
+                    elif iname in sd._arrays:
+                        a = sd._arrays[iname]
+                        ins.append(jax.ShapeDtypeStruct(
+                            tuple(a.shape), a.dtype))
+                    else:
+                        ins = None
+                        break
+                if ins is None:
+                    continue
+                try:
+                    out = jax.eval_shape(
+                        lambda *a: fn(*a, **opnode.attrs), *ins)
+                except Exception:
+                    continue
+                outs = list(out) if isinstance(out, (list, tuple)) \
+                    else [out]
+                for k, on in enumerate(opnode.outputs):
+                    if k < len(outs):
+                        avals[on] = outs[k]
+                if (len(opnode.outputs) == 1
+                        and np.issubdtype(outs[0].dtype, np.integer)
+                        and int(np.prod(outs[0].shape,
+                                        dtype=np.int64)) <= 256):
+                    vals = []
+                    for iname in opnode.inputs:
+                        v = const_vals.get(iname)
+                        if v is None and iname in sd._arrays:
+                            v = np.asarray(sd._arrays[iname])
+                        if v is None:
+                            vals = None
+                            break
+                        vals.append(v)
+                    if vals is not None:
+                        try:
+                            # x64 on: jnp would truncate the INT64_MAX
+                            # slice-end sentinels flowing through these
+                            # folds to int32 (-1 = drop-last-element)
+                            with jax.enable_x64():
+                                const_vals[opnode.outputs[0]] = \
+                                    np.asarray(fn(*vals, **opnode.attrs))
+                        except Exception:
+                            pass
 
         for node in g.nodes:
             ins: List[Optional[SDVariable]] = []
@@ -591,16 +675,30 @@ class OnnxImport:
                 ins.append(tensors[ref])
                 statics.append(const_vals.get(ref))
             mapper = OnnxOpMappingRegistry.get(node.op_type)
-            out = mapper(_Ctx(sd, node, ins, statics))
+            n_ops_before = len(sd._ops)
+            out = mapper(_Ctx(sd, node, ins, statics, avals=avals))
             outs = out if isinstance(out, tuple) else (out,)
             for name, v in zip(node.output, outs):
                 if v.name != name:
                     v.rename(name)
                 tensors[name] = v
-                # track import-time-computable constants (Constant nodes)
+                # track import-time-computable constants: Constant
+                # nodes AND constants materialized by mappers (Shape).
+                # Constant values come from the RAW proto attribute —
+                # sd._arrays holds jnp arrays, which truncate int64 to
+                # int32 (x64 off) and would turn INT64_MAX slice-end
+                # sentinels into -1
                 if node.op_type == "Constant":
-                    const_vals[name] = np.asarray(
-                        node.attributes.get("value"))
+                    val = np.asarray(node.attributes.get("value"))
+                elif v.name in sd._arrays:
+                    val = np.asarray(sd._arrays[v.name])
+                else:
+                    val = None
+                if val is not None:
+                    const_vals.setdefault(name, val)
+                    avals[v.name] = jax.ShapeDtypeStruct(
+                        tuple(val.shape), val.dtype)
+            _propagate(n_ops_before)
         return sd
 
     @staticmethod

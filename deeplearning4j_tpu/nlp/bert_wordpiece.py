@@ -29,10 +29,12 @@ def load_vocab(path_or_tokens) -> Dict[str, int]:
         return {t: i for i, t in enumerate(path_or_tokens)}
     vocab: Dict[str, int] = {}
     with open(path_or_tokens, encoding="utf-8") as f:
-        for line in f:
-            tok = line.rstrip("\n")
-            if tok:
-                vocab.setdefault(tok, len(vocab))
+        # id = LINE NUMBER, unconditionally: blank lines and duplicates
+        # still consume an id (checkpoint embedding rows are indexed by
+        # line; skipping would shift every later token onto the wrong
+        # row). Later duplicates win, matching the HF loader.
+        for i, line in enumerate(f):
+            vocab[line.rstrip("\n")] = i
     return vocab
 
 
@@ -140,8 +142,9 @@ class BertWordPieceTokenizer:
         """Token ids + segment ids, [CLS] a [SEP] (b [SEP]) layout."""
         toks_a = self.tokenize(text)
         toks_b = self.tokenize(pair) if pair is not None else []
-        if add_special and max_len is not None:
-            budget = max_len - 2 - (1 if pair is not None else 0)
+        if max_len is not None:
+            budget = max_len - (2 + (1 if pair is not None else 0)
+                                if add_special else 0)
             if budget < 0:
                 raise ValueError(
                     f"max_len={max_len} cannot fit the special tokens "
@@ -222,7 +225,8 @@ class BertIterator:
         batch = self.sentences[self._pos:self._pos + self.batch_size]
         self._pos += len(batch)
         n, t = len(batch), self.length
-        ids = np.zeros((n, t), np.int32)
+        pad_id = self.t.vocab.get(PAD, 0)
+        ids = np.full((n, t), pad_id, np.int32)
         segs = np.zeros((n, t), np.int32)
         mask = np.zeros((n, t), np.float32)
         labels = np.zeros((n,), np.int32)
@@ -244,18 +248,24 @@ class BertIterator:
             out["labels"] = labels
             return out
         # UNSUPERVISED: BERT MLM masking (80% [MASK] / 10% random /
-        # 10% keep), never on specials or padding
+        # 10% keep), never on specials or padding; random replacements
+        # are drawn from NON-special vocab ids (no assumption that the
+        # specials occupy ids 0-4)
         mlm_labels = ids.copy()
         mvoc = self.t.vocab[MASK]
-        specials = {self.t.vocab[CLS], self.t.vocab[SEP], 0}
+        specials = {self.t.vocab.get(s) for s in
+                    (PAD, UNK, CLS, SEP, MASK)} - {None}
         maskable = (mask > 0) & ~np.isin(ids, list(specials))
         pick = maskable & (self.rng.random(ids.shape) < self.mask_prob)
         roll = self.rng.random(ids.shape)
         masked_ids = ids.copy()
         masked_ids[pick & (roll < 0.8)] = mvoc
         rand = pick & (roll >= 0.8) & (roll < 0.9)
-        masked_ids[rand] = self.rng.integers(
-            5, max(len(self.t.vocab), 6), rand.sum())
+        candidates = np.asarray(
+            [i for i in self.t.vocab.values() if i not in specials],
+            np.int32)
+        if candidates.size:
+            masked_ids[rand] = self.rng.choice(candidates, rand.sum())
         out["ids"] = masked_ids
         out["mlm_labels"] = mlm_labels
         out["mlm_positions"] = pick.astype(np.float32)

@@ -50,10 +50,13 @@ def _conv_padding(padding, kernel, strides, dilation):
 
 
 @register_op("conv2d")
-def conv2d(x, w, b=None, strides=(1, 1), padding="SAME", dilation=(1, 1)):
+def conv2d(x, w, b=None, strides=(1, 1), padding="SAME", dilation=(1, 1),
+           groups=1):
     """2D convolution, NHWC x HWIO -> NHWC.
 
-    x: [N,H,W,C_in]; w: [kH,kW,C_in,C_out]; b: [C_out] or None.
+    x: [N,H,W,C_in]; w: [kH,kW,C_in/groups,C_out]; b: [C_out] or None.
+    groups>1 = grouped conv (ONNX Conv group attr, ResNeXt/MobileNet);
+    XLA's feature_group_count does the channel partitioning.
     """
     out = lax.conv_general_dilated(
         x,
@@ -62,6 +65,7 @@ def conv2d(x, w, b=None, strides=(1, 1), padding="SAME", dilation=(1, 1)):
         padding=_conv_padding(padding, w.shape[:2], strides, dilation),
         rhs_dilation=_pair(dilation),
         dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        feature_group_count=int(groups),
     )
     if b is not None:
         out = out + b
