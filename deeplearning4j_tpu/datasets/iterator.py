@@ -29,6 +29,17 @@ class DataSetIterator:
     def resetSupported(self) -> bool:
         return True
 
+    def get_state(self) -> dict:
+        """Checkpointable iterator position (SURVEY.md §5: the Orbax-
+        style checkpoint carries data-iterator state so a resumed run
+        continues mid-epoch on the NEXT batch, not a repeated one)."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support state capture")
+
+    def set_state(self, state: dict) -> None:
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support state restore")
+
     def asyncSupported(self) -> bool:
         return True
 
@@ -91,6 +102,16 @@ class ArrayDataSetIterator(DataSetIterator):
     def reset(self):
         self._i = 0
         self._epoch += 1
+        self._maybe_shuffle()
+
+    def get_state(self) -> dict:
+        return {"i": int(self._i), "epoch": int(self._epoch)}
+
+    def set_state(self, state: dict) -> None:
+        self._epoch = int(state["epoch"])
+        self._i = int(state["i"])
+        # the shuffle order is a pure function of (seed, epoch), so
+        # restoring (epoch, i) reproduces the exact batch sequence
         self._maybe_shuffle()
 
     def hasNext(self) -> bool:

@@ -402,13 +402,18 @@ def _shape(ctx):
             f"{ctx.node.name or ctx.node.op_type}: Shape of a tensor "
             "with unknown dims — re-export with static shapes (XLA "
             "compiles static programs)")
-    # opset >= 15: optional start/end attrs slice the shape vector
+    # opset >= 15: optional start/end attrs slice the shape vector;
+    # spec rule: negative values add rank, then CLAMP to [0, rank]
     rank = len(aval.shape)
-    start = int(ctx.attr("start", 0)) % max(rank, 1) \
-        if int(ctx.attr("start", 0)) < 0 else int(ctx.attr("start", 0))
-    end = ctx.attr("end")
-    end = rank if end is None else (int(end) + rank if int(end) < 0
-                                    else min(int(end), rank))
+
+    def _clamp(v):
+        v = int(v)
+        if v < 0:
+            v += rank
+        return max(0, min(v, rank))
+
+    start = _clamp(ctx.attr("start", 0))
+    end = rank if ctx.attr("end") is None else _clamp(ctx.attr("end"))
     return ctx.sd.constant(ctx.node.output[0],
                            np.asarray(aval.shape[start:end], np.int64))
 

@@ -203,6 +203,12 @@ class BertIterator:
         self.rng = np.random.default_rng(seed)
         self.n_classes = n_classes
         self._pos = 0
+        # constant per-vocab data, hoisted off the per-batch path
+        self._specials = {self.t.vocab.get(s) for s in
+                          (PAD, UNK, CLS, SEP, MASK)} - {None}
+        self._candidates = np.asarray(
+            [i for i in self.t.vocab.values()
+             if i not in self._specials], np.int32)
 
     # reference spelling
     @classmethod
@@ -253,19 +259,15 @@ class BertIterator:
         # specials occupy ids 0-4)
         mlm_labels = ids.copy()
         mvoc = self.t.vocab[MASK]
-        specials = {self.t.vocab.get(s) for s in
-                    (PAD, UNK, CLS, SEP, MASK)} - {None}
-        maskable = (mask > 0) & ~np.isin(ids, list(specials))
+        maskable = (mask > 0) & ~np.isin(ids, list(self._specials))
         pick = maskable & (self.rng.random(ids.shape) < self.mask_prob)
         roll = self.rng.random(ids.shape)
         masked_ids = ids.copy()
         masked_ids[pick & (roll < 0.8)] = mvoc
         rand = pick & (roll >= 0.8) & (roll < 0.9)
-        candidates = np.asarray(
-            [i for i in self.t.vocab.values() if i not in specials],
-            np.int32)
-        if candidates.size:
-            masked_ids[rand] = self.rng.choice(candidates, rand.sum())
+        if self._candidates.size:
+            masked_ids[rand] = self.rng.choice(self._candidates,
+                                               rand.sum())
         out["ids"] = masked_ids
         out["mlm_labels"] = mlm_labels
         out["mlm_positions"] = pick.astype(np.float32)

@@ -1,5 +1,6 @@
 """Utilities: model serialization, crash reporting."""
 
 from deeplearning4j_tpu.util.model_serializer import ModelSerializer
+from deeplearning4j_tpu.util.sharded_checkpoint import ShardedCheckpoint
 
-__all__ = ["ModelSerializer"]
+__all__ = ["ModelSerializer", "ShardedCheckpoint"]

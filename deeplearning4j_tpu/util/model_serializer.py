@@ -27,37 +27,46 @@ import jax.numpy as jnp
 import numpy as np
 
 
-def _flatten_with_paths(tree, prefix=""):
-    """Flatten a pytree of arrays to {path: array} with '/'-joined keys."""
+def _flatten_with_paths(tree, prefix="", to_numpy=True):
+    """Flatten a pytree of arrays to {path: array} with '/'-joined keys.
+
+    to_numpy=False keeps leaves as-is — required for multi-host sharded
+    jax.Arrays, where np.asarray would try to fetch non-addressable
+    shards (ShardedCheckpoint's path)."""
     out = {}
     if isinstance(tree, dict):
         for k in sorted(tree):
-            out.update(_flatten_with_paths(tree[k], f"{prefix}{k}/"))
+            out.update(_flatten_with_paths(tree[k], f"{prefix}{k}/",
+                                           to_numpy))
     elif isinstance(tree, (list, tuple)):
         for i, v in enumerate(tree):
-            out.update(_flatten_with_paths(v, f"{prefix}{i}/"))
+            out.update(_flatten_with_paths(v, f"{prefix}{i}/", to_numpy))
     elif tree is None:
         pass
     else:
-        out[prefix[:-1]] = np.asarray(tree)
+        out[prefix[:-1]] = np.asarray(tree) if to_numpy else tree
     return out
 
 
-def _unflatten_into(template, flat, prefix=""):
-    """Rebuild arrays into the shape of `template` from {path: array}."""
+def _unflatten_into(template, flat, prefix="", leaf_fn=None):
+    """Rebuild arrays into the shape of `template` from {path: array}.
+    leaf_fn converts each looked-up value (default jnp.asarray;
+    identity for pre-built sharded jax.Arrays)."""
+    if leaf_fn is None:
+        leaf_fn = jnp.asarray
     if isinstance(template, dict):
-        return {k: _unflatten_into(template[k], flat, f"{prefix}{k}/")
+        return {k: _unflatten_into(template[k], flat, f"{prefix}{k}/",
+                                   leaf_fn)
                 for k in template}
     if isinstance(template, tuple):
-        return tuple(_unflatten_into(v, flat, f"{prefix}{i}/")
+        return tuple(_unflatten_into(v, flat, f"{prefix}{i}/", leaf_fn)
                      for i, v in enumerate(template))
     if isinstance(template, list):
-        return [_unflatten_into(v, flat, f"{prefix}{i}/")
+        return [_unflatten_into(v, flat, f"{prefix}{i}/", leaf_fn)
                 for i, v in enumerate(template)]
     if template is None:
         return None
-    key = prefix[:-1]
-    return jnp.asarray(flat[key])
+    return leaf_fn(flat[prefix[:-1]])
 
 
 _UINT_BY_SIZE = {1: np.uint8, 2: np.uint16, 4: np.uint32, 8: np.uint64}

@@ -1,0 +1,152 @@
+"""Multi-host sharded checkpointing + data-iterator state.
+
+Reference role: SURVEY.md §5 names the TPU analog of the reference's
+ModelSerializer/CheckpointListener for distributed runs explicitly —
+"Orbax-style checkpoint of param/opt pytrees + data-iterator state".
+Design (the Orbax pattern, no Orbax dependency):
+
+- every process writes ONLY its addressable shards to a process-local
+  ``shards_p{process_index}.npz`` (atomic tmp+rename), so checkpoint
+  bandwidth scales with hosts and no host ever materializes the global
+  array;
+- process 0 writes ``manifest.json`` with the tree paths, global
+  shapes/dtypes, step, process count, and the (JSON) iterator state;
+- restore takes a TEMPLATE pytree carrying the target shardings (a
+  freshly initialized model), loads each device's shard locally and
+  reassembles global arrays with make_array_from_single_device_arrays
+  — the same restore-args contract Orbax uses. Fully-replicated leaves
+  are stored once per process, not once per device.
+
+Works identically for a single process (the degenerate 1-host case is
+the plain save path), so it composes with ModelSerializer artifacts.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import numpy as np
+
+from deeplearning4j_tpu.util.model_serializer import (
+    _flatten_with_paths, _unflatten_into,
+)
+
+_REP_KEY = "@@rep"
+_STEP_KEY = "__step__"
+
+
+class ShardedCheckpoint:
+    FORMAT = "deeplearning4j_tpu-sharded-1"
+
+    @staticmethod
+    def save(dirpath: str, tree: Any, step: int = 0,
+             iterator_state: Optional[Dict[str, Any]] = None) -> None:
+        """Write this process's shards (+ manifest on process 0)."""
+        os.makedirs(dirpath, exist_ok=True)
+        pidx = jax.process_index()
+        flat = _flatten_with_paths(tree, to_numpy=False)
+        local: Dict[str, np.ndarray] = {}
+        meta_paths: Dict[str, Dict[str, Any]] = {}
+        for path, arr in flat.items():
+            arr = jax.device_put(arr) if not isinstance(arr, jax.Array) \
+                else arr
+            meta_paths[path] = {"shape": list(arr.shape),
+                                "dtype": str(arr.dtype)}
+            if arr.is_fully_replicated:
+                local[path + _REP_KEY] = np.asarray(
+                    arr.addressable_shards[0].data)
+            else:
+                for sh in arr.addressable_shards:
+                    local[f"{path}@@{sh.device.id}"] = np.asarray(sh.data)
+        # every shard file embeds the step it belongs to: per-file
+        # os.replace is atomic, but the MULTI-file checkpoint is not —
+        # a crash between hosts' writes must be a loud restore error
+        # (mixed-step shards), never silently mixed parameter state
+        local[_STEP_KEY] = np.asarray(int(step), np.int64)
+        buf = io.BytesIO()
+        np.savez(buf, **local)
+        tmp = os.path.join(dirpath, f".shards_p{pidx}.npz.tmp")
+        final = os.path.join(dirpath, f"shards_p{pidx}.npz")
+        with open(tmp, "wb") as f:
+            f.write(buf.getvalue())
+        os.replace(tmp, final)  # atomic: a killed run never leaves a
+        # half-written shard file under the final name
+        if pidx == 0:
+            manifest = {
+                "format": ShardedCheckpoint.FORMAT,
+                "step": int(step),
+                "num_processes": jax.process_count(),
+                "paths": meta_paths,
+                "iterator_state": iterator_state,
+            }
+            mtmp = os.path.join(dirpath, ".manifest.json.tmp")
+            with open(mtmp, "w") as f:
+                json.dump(manifest, f)
+            os.replace(mtmp, os.path.join(dirpath, "manifest.json"))
+
+    @staticmethod
+    def restore(dirpath: str,
+                template: Any) -> Tuple[Any, Dict[str, Any]]:
+        """Rebuild the tree onto `template`'s shardings. Returns
+        (tree, meta) where meta carries step + iterator_state."""
+        with open(os.path.join(dirpath, "manifest.json")) as f:
+            manifest = json.load(f)
+        if manifest["format"] != ShardedCheckpoint.FORMAT:
+            raise ValueError(
+                f"not a sharded checkpoint: {manifest['format']!r}")
+        nproc = jax.process_count()
+        if manifest["num_processes"] != nproc:
+            raise ValueError(
+                f"checkpoint written by {manifest['num_processes']} "
+                f"processes, restoring with {nproc} (elastic reshape "
+                "requires same topology)")
+        pidx = jax.process_index()
+        shards = np.load(os.path.join(dirpath, f"shards_p{pidx}.npz"))
+        if _STEP_KEY in shards and \
+                int(shards[_STEP_KEY]) != int(manifest["step"]):
+            raise ValueError(
+                f"checkpoint is torn: this host's shard file is from "
+                f"step {int(shards[_STEP_KEY])} but the manifest says "
+                f"step {manifest['step']} (a save crashed between "
+                "hosts' writes; fall back to an older checkpoint)")
+        flat_t = _flatten_with_paths(template, to_numpy=False)
+        flat_out: Dict[str, Any] = {}
+        for path, tarr in flat_t.items():
+            info = manifest["paths"].get(path)
+            if info is None:
+                raise KeyError(f"checkpoint missing array {path!r}")
+            tarr = jax.device_put(tarr) \
+                if not isinstance(tarr, jax.Array) else tarr
+            if tuple(info["shape"]) != tuple(tarr.shape):
+                raise ValueError(
+                    f"{path}: checkpoint shape {info['shape']} != "
+                    f"template {tuple(tarr.shape)}")
+            if tarr.is_fully_replicated and path + _REP_KEY in shards:
+                data = shards[path + _REP_KEY]
+                flat_out[path] = jax.make_array_from_callback(
+                    tarr.shape, tarr.sharding, lambda idx, d=data: d[idx])
+            else:
+                bufs = []
+                for sh in tarr.addressable_shards:
+                    key = f"{path}@@{sh.device.id}"
+                    if key not in shards:
+                        raise KeyError(
+                            f"{path}: no shard for device "
+                            f"{sh.device.id} in this process's file "
+                            "(device ids changed across restart?)")
+                    bufs.append(jax.device_put(shards[key], sh.device))
+                flat_out[path] = \
+                    jax.make_array_from_single_device_arrays(
+                        tarr.shape, tarr.sharding, bufs)
+        tree = _unflatten_into(template, flat_out,
+                               leaf_fn=lambda v: v)
+        return tree, {"step": manifest["step"],
+                      "iterator_state": manifest.get("iterator_state")}
+
+    @staticmethod
+    def exists(dirpath: str) -> bool:
+        return os.path.exists(os.path.join(dirpath, "manifest.json"))

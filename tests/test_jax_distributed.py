@@ -126,3 +126,144 @@ def test_two_process_data_parallel_matches_single_process(tmp_path):
     assert abs(got["loss"] - loss) < 1e-5, (got, loss)
     assert abs(got["w_sum"] - float(w.sum())) < 1e-4
     assert abs(got["w00"] - float(w[0, 0])) < 1e-5
+
+
+# ---------------------------------------------------------------------
+# Sharded checkpoint kill-and-resume (VERDICT r2 missing #3 / SURVEY §5
+# "Orbax-style checkpoint of param/opt pytrees + data-iterator state"):
+# a 2-process run with row-sharded params + momentum saves per-host
+# shard files mid-epoch, dies, and a NEW 2-process run restores and
+# continues with exact loss continuity vs an uninterrupted run.
+# ---------------------------------------------------------------------
+CKPT_WORKER = r"""
+import json, os, sys
+proc_id, nproc, port, outdir, phase = (int(sys.argv[1]), int(sys.argv[2]),
+                                       sys.argv[3], sys.argv[4], sys.argv[5])
+import jax
+from deeplearning4j_tpu.distributed import DistributedBackend
+
+DistributedBackend.initialize(
+    coordinator_address=f"127.0.0.1:{port}", num_processes=nproc,
+    process_id=proc_id)
+
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from deeplearning4j_tpu.datasets.iterator import ArrayDataSetIterator
+from deeplearning4j_tpu.util import ShardedCheckpoint
+
+mesh = Mesh(np.array(jax.devices()).reshape(2 * nproc), ("data",))
+dspec = NamedSharding(mesh, P("data"))
+wspec = NamedSharding(mesh, P("data"))   # w ROW-SHARDED over devices
+
+rs = np.random.RandomState(0)
+X = rs.randn(40, 8).astype(np.float32)
+Y = rs.randn(40, 2).astype(np.float32)
+it = ArrayDataSetIterator(X, Y, batch_size=8, shuffle=True, seed=7)
+
+w = jax.device_put(jnp.zeros((8, 2)), wspec)
+v = jax.device_put(jnp.zeros((8, 2)), wspec)
+
+@jax.jit
+def step(w, v, x, y):
+    def loss(w):
+        return jnp.mean((x @ w - y) ** 2)
+    l, g = jax.value_and_grad(loss)(w)
+    v2 = 0.9 * v + g
+    return w - 0.1 * v2, v2, l
+
+def feed(ds):
+    x_np, y_np = np.asarray(ds.features), np.asarray(ds.labels)
+    rows = slice(proc_id * 4, (proc_id + 1) * 4)
+    x = jax.make_array_from_process_local_data(dspec, x_np[rows], x_np.shape)
+    y = jax.make_array_from_process_local_data(dspec, y_np[rows], y_np.shape)
+    return x, y
+
+ckpt_dir = os.path.join(outdir, "ckpt")
+losses = []
+start = 0
+if phase == "resume":
+    template = {"w": jax.device_put(jnp.zeros((8, 2)), wspec),
+                "v": jax.device_put(jnp.zeros((8, 2)), wspec)}
+    tree, meta = ShardedCheckpoint.restore(ckpt_dir, template)
+    w, v = tree["w"], tree["v"]
+    it.set_state(meta["iterator_state"])
+    start = meta["step"]
+
+n_steps = 3 if phase == "part1" else 5
+for i in range(start, n_steps):
+    ds = it.next()
+    x, y = feed(ds)
+    w, v, l = step(w, v, x, y)
+    losses.append(float(l))
+
+if phase == "part1":
+    ShardedCheckpoint.save(ckpt_dir, {"w": w, "v": v}, step=3,
+                           iterator_state=it.get_state())
+    # die here: the remaining 2 steps never run in this incarnation
+
+# jnp.sum over a cross-process sharded array is a COLLECTIVE — every
+# process must compute it, only proc 0 writes it
+w_sum = float(jnp.sum(w))
+if proc_id == 0:
+    with open(os.path.join(outdir, f"losses_{phase}.json"), "w") as f:
+        json.dump({"losses": losses, "w_sum": w_sum}, f)
+DistributedBackend.shutdown()
+"""
+
+
+def _run_ckpt_phase(tmp_path, phase):
+    worker = tmp_path / f"worker_{phase}.py"
+    worker.write_text(CKPT_WORKER)
+    port = _free_port()
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+        "PALLAS_AXON_POOL_IPS": "",
+        "PYTHONPATH": REPO,
+    })
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(worker), str(pid), "2", str(port),
+             str(tmp_path), phase],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True)
+        for pid in range(2)
+    ]
+    try:
+        outs = [p.communicate(timeout=240) for p in procs]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for p, (so, se) in zip(procs, outs):
+        assert p.returncode == 0, f"{phase} worker failed:\n{so}\n{se[-3000:]}"
+    with open(tmp_path / f"losses_{phase}.json") as f:
+        return json.load(f)
+
+
+def test_sharded_checkpoint_kill_and_resume(tmp_path):
+    part1 = _run_ckpt_phase(tmp_path, "part1")
+    assert len(part1["losses"]) == 3
+    # per-host shard files exist (one per process), not a global blob
+    ckpt = tmp_path / "ckpt"
+    assert (ckpt / "shards_p0.npz").exists()
+    assert (ckpt / "shards_p1.npz").exists()
+    assert (ckpt / "manifest.json").exists()
+
+    resumed = _run_ckpt_phase(tmp_path, "resume")
+    assert len(resumed["losses"]) == 2
+
+    full = _run_ckpt_phase(tmp_path, "full")
+    assert len(full["losses"]) == 5
+
+    # loss continuity: the resumed run's steps 4-5 must match the
+    # uninterrupted run exactly (same params, same momentum, same
+    # mid-epoch batches via the restored iterator state)
+    np.testing.assert_allclose(part1["losses"], full["losses"][:3],
+                               rtol=1e-6)
+    np.testing.assert_allclose(resumed["losses"], full["losses"][3:],
+                               rtol=1e-6)
+    np.testing.assert_allclose(resumed["w_sum"], full["w_sum"],
+                               rtol=1e-6)

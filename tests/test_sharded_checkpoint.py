@@ -1,0 +1,137 @@
+"""ShardedCheckpoint units on the 8-device CPU mesh (the 2-process
+kill-and-resume e2e lives in test_jax_distributed.py). Reference role:
+SURVEY.md §5 "Orbax-style checkpoint of param/opt pytrees +
+data-iterator state"."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deeplearning4j_tpu.datasets.iterator import ArrayDataSetIterator
+from deeplearning4j_tpu.util import ShardedCheckpoint
+
+
+@pytest.fixture
+def mesh():
+    return Mesh(np.array(jax.devices()[:8]).reshape(4, 2),
+                ("data", "model"))
+
+
+def _tree(mesh):
+    rs = np.random.RandomState(0)
+    return {
+        "layer": {
+            "w": jax.device_put(
+                jnp.asarray(rs.randn(8, 6).astype(np.float32)),
+                NamedSharding(mesh, P("data", "model"))),
+            "b": jax.device_put(
+                jnp.asarray(rs.randn(6).astype(np.float32)),
+                NamedSharding(mesh, P())),       # fully replicated
+        },
+        "opt": [jax.device_put(
+            jnp.asarray(rs.randn(8, 6).astype(np.float32)),
+            NamedSharding(mesh, P("data", None)))],
+    }
+
+
+class TestShardedCheckpoint:
+    def test_roundtrip_preserves_values_and_shardings(self, mesh,
+                                                      tmp_path):
+        tree = _tree(mesh)
+        ShardedCheckpoint.save(str(tmp_path), tree, step=7,
+                               iterator_state={"i": 16, "epoch": 2})
+        template = jax.tree_util.tree_map(jnp.zeros_like, tree)
+        out, meta = ShardedCheckpoint.restore(str(tmp_path), template)
+        assert meta["step"] == 7
+        assert meta["iterator_state"] == {"i": 16, "epoch": 2}
+        for (pa, a), (_, b) in zip(
+                jax.tree_util.tree_leaves_with_path(tree),
+                jax.tree_util.tree_leaves_with_path(out)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       err_msg=str(pa))
+            assert a.sharding == b.sharding, pa
+
+    def test_replicated_leaf_stored_once(self, mesh, tmp_path):
+        tree = _tree(mesh)
+        ShardedCheckpoint.save(str(tmp_path), tree)
+        shards = np.load(str(tmp_path / "shards_p0.npz"))
+        b_keys = [k for k in shards.files if k.startswith("layer/b")]
+        assert b_keys == ["layer/b@@rep"]       # one copy, not 8
+        w_keys = [k for k in shards.files if k.startswith("layer/w")]
+        assert len(w_keys) == 8                 # one per device shard
+
+    def test_shape_mismatch_rejected(self, mesh, tmp_path):
+        ShardedCheckpoint.save(str(tmp_path), _tree(mesh))
+        bad = _tree(mesh)
+        bad["layer"]["b"] = jnp.zeros(5)
+        with pytest.raises(ValueError, match="shape"):
+            ShardedCheckpoint.restore(str(tmp_path), bad)
+
+    def test_missing_path_rejected(self, mesh, tmp_path):
+        ShardedCheckpoint.save(str(tmp_path), _tree(mesh))
+        bad = _tree(mesh)
+        bad["extra"] = jnp.zeros(3)
+        with pytest.raises(KeyError, match="extra"):
+            ShardedCheckpoint.restore(str(tmp_path), bad)
+
+    def test_torn_checkpoint_detected(self, mesh, tmp_path):
+        """A crash between hosts' writes leaves shard files from a
+        different step than the manifest — restore must be a loud
+        error, never silently mixed parameter state."""
+        import json
+        tree = _tree(mesh)
+        ShardedCheckpoint.save(str(tmp_path), tree, step=5)
+        mpath = tmp_path / "manifest.json"
+        manifest = json.loads(mpath.read_text())
+        manifest["step"] = 6      # manifest advanced; shards did not
+        mpath.write_text(json.dumps(manifest))
+        with pytest.raises(ValueError, match="torn"):
+            ShardedCheckpoint.restore(str(tmp_path), _tree(mesh))
+
+    def test_exists(self, mesh, tmp_path):
+        assert not ShardedCheckpoint.exists(str(tmp_path))
+        ShardedCheckpoint.save(str(tmp_path), _tree(mesh))
+        assert ShardedCheckpoint.exists(str(tmp_path))
+
+
+class TestIteratorState:
+    def test_mid_epoch_resume_reproduces_batches(self):
+        rs = np.random.RandomState(1)
+        X, Y = rs.randn(32, 4).astype(np.float32), \
+            rs.randn(32, 1).astype(np.float32)
+        it = ArrayDataSetIterator(X, Y, batch_size=8, shuffle=True,
+                                  seed=5)
+        it.next()
+        it.next()
+        state = it.get_state()
+        want = [np.asarray(it.next().features) for _ in range(2)]
+
+        it2 = ArrayDataSetIterator(X, Y, batch_size=8, shuffle=True,
+                                   seed=5)
+        it2.set_state(state)
+        got = [np.asarray(it2.next().features) for _ in range(2)]
+        for w, g in zip(want, got):
+            np.testing.assert_array_equal(w, g)
+
+    def test_state_survives_epoch_boundary(self):
+        X = np.arange(16, dtype=np.float32).reshape(16, 1)
+        it = ArrayDataSetIterator(X, X, batch_size=8, shuffle=True,
+                                  seed=3)
+        it.next()
+        it.next()
+        it.reset()          # epoch 1
+        it.next()
+        state = it.get_state()
+        want = np.asarray(it.next().features)
+        it2 = ArrayDataSetIterator(X, X, batch_size=8, shuffle=True,
+                                   seed=3)
+        it2.set_state(state)
+        np.testing.assert_array_equal(np.asarray(it2.next().features),
+                                      want)
+
+    def test_base_iterator_raises(self):
+        from deeplearning4j_tpu.datasets.iterator import DataSetIterator
+        with pytest.raises(NotImplementedError):
+            DataSetIterator().get_state()
