@@ -79,17 +79,156 @@ class ParallelWrapper:
 
 
 class ParallelInference:
-    """Sharded batch inference (reference: ParallelInference)."""
+    """Queued dynamic-batching inference server (reference:
+    org/deeplearning4j/parallelism/ParallelInference — concurrent
+    clients enqueue observations, a dispatcher collects up to
+    ``batch_limit`` rows (or whatever arrived within ``nanos`` of the
+    first), runs ONE model call, and scatters replies; SURVEY.md
+    §2.28).
 
-    def __init__(self, model, workers: Optional[int] = None):
+    TPU-native twist: the dispatched batch is PADDED to ``batch_limit``
+    so every dispatch hits the same compiled executable — dynamic
+    request counts never retrace/recompile, which is what makes
+    batching a win on an accelerator rather than a re-compile storm.
+
+    ``output(x)`` is thread-safe and blocking; x is [n, ...] rows (a
+    single observation is [1, ...]). Stats (``n_requests``,
+    ``n_dispatches``) expose the batching ratio.
+    """
+
+    def __init__(self, model, workers: Optional[int] = None,
+                 batch_limit: int = 32, queue_limit: int = 256,
+                 nanos: int = 2_000_000):
+        import queue
+        import threading
+
         devs = jax.devices()
         workers = workers or len(devs)
+        if workers > len(devs):
+            raise ValueError(
+                f"workers={workers} > devices={len(devs)} (inference "
+                "workers are mesh devices, not threads)")
         self.model = model
+        # round UP to a workers multiple so the padded batch shards
+        # evenly on any device count (6 devices + the default 32 must
+        # construct, not raise)
+        self.batch_limit = -(-int(batch_limit) // workers) * workers
+        self.nanos = int(nanos)
         self.mesh = build_mesh(num_data=workers, num_model=1,
                                devices=devs[:workers])
+        self.n_requests = 0
+        self.n_dispatches = 0
+        self._queue: "queue.Queue" = queue.Queue(maxsize=queue_limit)
+        self._alive = True
+        self._lock = threading.Lock()   # serializes enqueue vs shutdown
+        self._pending = None            # overshoot held for next batch
+        self._worker = threading.Thread(target=self._dispatch_loop,
+                                        daemon=True,
+                                        name="ParallelInference")
+        self._worker.start()
 
+    # ----------------------------------------------------------- client
     def output(self, x):
+        from concurrent.futures import Future
+
+        import numpy as np
+
+        x = np.asarray(x)
+        # oversized requests split into chunks that are ALL enqueued
+        # before gathering (parallel dispatch, no serial round trips)
+        chunks = [x[i:i + self.batch_limit]
+                  for i in range(0, x.shape[0], self.batch_limit)] \
+            or [x]
+        futs = []
+        for c in chunks:
+            fut: Future = Future()
+            # the lock closes the check-then-enqueue race with
+            # shutdown(): nothing can be enqueued after the sentinel
+            with self._lock:
+                if not self._alive:
+                    raise RuntimeError(
+                        "ParallelInference has been shut down")
+                self._queue.put((c, fut))
+            futs.append(fut)
+        outs = [f.result() for f in futs]
+        if len(outs) == 1:
+            return outs[0]
+        return np.concatenate([np.asarray(o) for o in outs], 0)
+
+    def shutdown(self) -> None:
+        with self._lock:
+            if not self._alive:
+                return
+            self._alive = False
+            self._queue.put(None)   # sentinel is the LAST queue item
+        self._worker.join(timeout=30)
+
+    # ------------------------------------------------------- dispatcher
+    def _collect(self):
+        """Block for the first request, then drain whatever fits within
+        the time window (reference: ParallelInference's observables
+        queue + nanos batching window). Returns None only on the
+        shutdown sentinel."""
+        import queue
+        import time
+
+        if self._pending is not None:
+            first, self._pending = self._pending, None
+        else:
+            first = self._queue.get()
+            if first is None:
+                return None
+        batch = [first]
+        rows = first[0].shape[0]
+        deadline = time.monotonic_ns() + self.nanos
+        while rows < self.batch_limit:
+            remaining = deadline - time.monotonic_ns()
+            try:
+                item = self._queue.get(
+                    timeout=max(remaining, 0) / 1e9 if remaining > 0
+                    else 0.0)
+            except queue.Empty:
+                break
+            if item is None:
+                self._queue.put(None)     # re-signal shutdown
+                break
+            if rows + item[0].shape[0] > self.batch_limit:
+                # would overflow the fixed compiled shape: hold it for
+                # the NEXT dispatch (FIFO preserved via _pending slot)
+                self._pending = item
+                break
+            batch.append(item)
+            rows += item[0].shape[0]
+        return batch
+
+    def _dispatch_loop(self) -> None:
+        import numpy as np
+
         from deeplearning4j_tpu.parallel.mesh import shard_batch
 
-        xs = shard_batch(self.mesh, x)
-        return self.model.output(xs)
+        # exit ONLY on the sentinel: requests enqueued before shutdown
+        # must still be answered, never stranded in fut.result()
+        while True:
+            batch = self._collect()
+            if batch is None:
+                break
+            xs = [x for x, _ in batch]
+            big = np.concatenate(xs, 0)
+            if big.shape[0] < self.batch_limit:
+                pad = np.repeat(big[-1:],
+                                self.batch_limit - big.shape[0], axis=0)
+                big = np.concatenate([big, pad], 0)
+            try:
+                out = np.asarray(
+                    self.model.output(shard_batch(self.mesh, big)))
+            except Exception as e:                  # pragma: no cover
+                for _, fut in batch:
+                    fut.set_exception(e)
+                continue
+            self.n_dispatches += 1
+            self.n_requests += len(batch)
+            off = 0
+            for x, fut in batch:
+                n = x.shape[0]
+                fut.set_result(out[off:off + n])
+                off += n

