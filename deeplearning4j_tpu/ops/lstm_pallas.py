@@ -1,0 +1,119 @@
+"""Pallas persistent-weights LSTM recurrence (the CudnnLSTMHelper
+experiment, SURVEY.md §2.9 — VERDICT r2 weak #4 asked for one honest
+attempt at the small-cell fast path).
+
+Design: the input projection is hoisted (ops/nn.py lstm_layer already
+does one [N*T, in] x [in, 4H] MXU matmul); this kernel runs the
+RECURRENT part with w_hh and the (h, c) carry resident in VMEM across
+the whole sequence — grid over T/k chunks with sequential semantics,
+k timesteps advanced per grid step to amortize the grid/DMA boundary.
+
+Measured A/B on the v5e chip (2026-07-31, interleaved min-of-6 windows
+— see BASELINE.md "Pallas LSTM recurrence A/B"): ~par at the zoo
+default (N=256, H=256: 1.07x min, par median), ~1.3x at H=512. XLA
+already compiles lax.scan into a tight on-chip loop, so the cuDNN-
+style win (eliminating per-step kernel dispatch) has nothing to
+eliminate on TPU. The scan path therefore REMAINS THE DEFAULT; this
+kernel is the documented experiment and an opt-in
+(``lstm_layer(..., impl="pallas")``) for inference at larger hidden
+sizes.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _kernel(xp_ref, whh_ref, h0_ref, c0_ref, ys_ref, ht_ref, ct_ref,
+            h_scr, c_scr, *, k_steps):
+    from jax.experimental import pallas as pl
+
+    t = pl.program_id(0)
+    nt = pl.num_programs(0)
+    hidden = whh_ref.shape[0]
+
+    @pl.when(t == 0)
+    def _():
+        h_scr[...] = h0_ref[...].astype(jnp.float32)
+        c_scr[...] = c0_ref[...].astype(jnp.float32)
+
+    def body(j, _):
+        h = h_scr[...]
+        gates = jnp.dot(h.astype(whh_ref.dtype), whh_ref[...],
+                        preferred_element_type=jnp.float32)
+        gates = gates + xp_ref[j].astype(jnp.float32)
+        i = jax.nn.sigmoid(gates[:, :hidden])
+        f = jax.nn.sigmoid(gates[:, hidden:2 * hidden])
+        g = jnp.tanh(gates[:, 2 * hidden:3 * hidden])
+        o = jax.nn.sigmoid(gates[:, 3 * hidden:])
+        c = f * c_scr[...] + i * g
+        h2 = o * jnp.tanh(c)
+        h_scr[...] = h2
+        c_scr[...] = c
+        ys_ref[j] = h2.astype(ys_ref.dtype)
+        return 0
+
+    lax.fori_loop(0, k_steps, body, 0)
+
+    @pl.when(t == nt - 1)
+    def _():
+        ht_ref[...] = h_scr[...].astype(ht_ref.dtype)
+        ct_ref[...] = c_scr[...].astype(ct_ref.dtype)
+
+
+def _pick_k(t: int, n: int, fourh: int, itemsize: int) -> int:
+    """Largest divisor of T whose double-buffered x_proj block fits a
+    conservative VMEM budget (~6MB for the streamed input)."""
+    budget = 6 * 1024 * 1024
+    best = 1
+    for k in range(1, min(t, 16) + 1):
+        if t % k == 0 and 2 * k * n * fourh * itemsize <= budget:
+            best = k
+    return best
+
+
+def pallas_lstm_recurrence(x_proj, w_hh, h0, c0, k_steps=None,
+                           interpret: bool = False):
+    """x_proj: [T, N, 4H] (input projection + bias, precomputed);
+    w_hh: [H, 4H]; h0/c0: [N, H]. Returns (ys [T, N, H], hT, cT).
+    Gate order i, f, g, o — identical to ops/nn.py lstm_layer."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    t, n, fourh = x_proj.shape
+    hidden = fourh // 4
+    if k_steps is None:
+        k_steps = _pick_k(t, n, fourh, x_proj.dtype.itemsize)
+    if t % k_steps:
+        raise ValueError(f"T={t} not divisible by k_steps={k_steps}")
+    return pl.pallas_call(
+        functools.partial(_kernel, k_steps=k_steps),
+        grid=(t // k_steps,),
+        in_specs=[
+            pl.BlockSpec((k_steps, n, fourh), lambda i: (i, 0, 0)),
+            pl.BlockSpec((hidden, fourh), lambda i: (0, 0)),
+            pl.BlockSpec((n, hidden), lambda i: (0, 0)),
+            pl.BlockSpec((n, hidden), lambda i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((k_steps, n, hidden), lambda i: (i, 0, 0)),
+            pl.BlockSpec((n, hidden), lambda i: (0, 0)),
+            pl.BlockSpec((n, hidden), lambda i: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((t, n, hidden), x_proj.dtype),
+            jax.ShapeDtypeStruct((n, hidden), x_proj.dtype),
+            jax.ShapeDtypeStruct((n, hidden), x_proj.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((n, hidden), jnp.float32),
+            pltpu.VMEM((n, hidden), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",)),
+        interpret=interpret,
+    )(x_proj, w_hh, h0, c0)

@@ -480,7 +480,8 @@ def one_hot(ids, depth, dtype=jnp.float32, on_value=1.0, off_value=0.0,
 # recurrent (reference: lstmLayer.cpp, CudnnLSTMHelper; gruCell.cpp)
 # ----------------------------------------------------------------------
 @register_op("lstm_layer")
-def lstm_layer(x, w_ih, w_hh, b, h0=None, c0=None, reverse=False):
+def lstm_layer(x, w_ih, w_hh, b, h0=None, c0=None, reverse=False,
+               impl="scan"):
     """Fused LSTM over time via lax.scan.
 
     x: [N, T, in]; w_ih: [in, 4H]; w_hh: [H, 4H]; b: [4H].
@@ -491,7 +492,14 @@ def lstm_layer(x, w_ih, w_hh, b, h0=None, c0=None, reverse=False):
     [in, 4H] matmul (MXU-friendly), the scan carries only the recurrent
     matmul — this is the standard TPU RNN decomposition and is what the
     reference's cuDNN fast path does internally.
+
+    impl="pallas" swaps the recurrence for the persistent-VMEM Pallas
+    kernel (ops/lstm_pallas.py) — measured ~par at H=256 and ~1.3x at
+    H=512 on v5e (BASELINE.md), forward/inference only (no custom
+    backward); scan remains the default.
     """
+    if impl not in ("scan", "pallas"):
+        raise ValueError(f"lstm_layer impl={impl!r}: 'scan' or 'pallas'")
     n, t, _ = x.shape
     hidden = w_hh.shape[0]
     if h0 is None:
@@ -503,6 +511,16 @@ def lstm_layer(x, w_ih, w_hh, b, h0=None, c0=None, reverse=False):
     x_proj = x_proj.reshape(n, t, 4 * hidden).transpose(1, 0, 2)  # [T,N,4H]
     if reverse:
         x_proj = jnp.flip(x_proj, axis=0)
+
+    if impl == "pallas":
+        from deeplearning4j_tpu.ops.lstm_pallas import (
+            pallas_lstm_recurrence,
+        )
+
+        ys, hT, cT = pallas_lstm_recurrence(x_proj, w_hh, h0, c0)
+        if reverse:
+            ys = jnp.flip(ys, axis=0)
+        return ys.transpose(1, 0, 2), (hT, cT)
 
     def step(carry, xp):
         h, c = carry

@@ -133,22 +133,36 @@ class ParallelInference:
 
         import numpy as np
 
+        import queue as _queue
+        import time as _time
+
         x = np.asarray(x)
+        if x.shape[0] == 0:
+            raise ValueError(
+                "empty request (0 rows) — the output width is model-"
+                "defined, so there is nothing meaningful to return")
         # oversized requests split into chunks that are ALL enqueued
         # before gathering (parallel dispatch, no serial round trips)
         chunks = [x[i:i + self.batch_limit]
-                  for i in range(0, x.shape[0], self.batch_limit)] \
-            or [x]
+                  for i in range(0, x.shape[0], self.batch_limit)]
         futs = []
         for c in chunks:
             fut: Future = Future()
-            # the lock closes the check-then-enqueue race with
-            # shutdown(): nothing can be enqueued after the sentinel
-            with self._lock:
-                if not self._alive:
-                    raise RuntimeError(
-                        "ParallelInference has been shut down")
-                self._queue.put((c, fut))
+            while True:
+                # the lock closes the check-then-enqueue race with
+                # shutdown() (nothing enqueues after the sentinel) but
+                # must NEVER hold across a blocking put — a full queue
+                # would serialize every producer and stall shutdown
+                with self._lock:
+                    if not self._alive:
+                        raise RuntimeError(
+                            "ParallelInference has been shut down")
+                    try:
+                        self._queue.put_nowait((c, fut))
+                        break
+                    except _queue.Full:
+                        pass
+                _time.sleep(0.0005)  # backpressure wait, lock released
             futs.append(fut)
         outs = [f.result() for f in futs]
         if len(outs) == 1:
@@ -212,16 +226,20 @@ class ParallelInference:
             batch = self._collect()
             if batch is None:
                 break
-            xs = [x for x, _ in batch]
-            big = np.concatenate(xs, 0)
-            if big.shape[0] < self.batch_limit:
-                pad = np.repeat(big[-1:],
-                                self.batch_limit - big.shape[0], axis=0)
-                big = np.concatenate([big, pad], 0)
             try:
+                # assembly is inside the try too: a shape-mismatched
+                # batch must fail ITS futures, not kill the dispatcher
+                # (a dead dispatcher strands every future client)
+                xs = [x for x, _ in batch]
+                big = np.concatenate(xs, 0)
+                if big.shape[0] < self.batch_limit:
+                    pad = np.repeat(
+                        big[-1:], self.batch_limit - big.shape[0],
+                        axis=0)
+                    big = np.concatenate([big, pad], 0)
                 out = np.asarray(
                     self.model.output(shard_batch(self.mesh, big)))
-            except Exception as e:                  # pragma: no cover
+            except Exception as e:
                 for _, fut in batch:
                     fut.set_exception(e)
                 continue

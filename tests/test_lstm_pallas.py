@@ -1,0 +1,40 @@
+"""Pallas LSTM recurrence numerics vs the scan path (interpret mode on
+CPU — the kernel's TPU A/B lives in BASELINE.md)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.ops.lstm_pallas import (_pick_k,
+                                                pallas_lstm_recurrence)
+from deeplearning4j_tpu.ops.nn import lstm_layer
+
+
+class TestPallasLstm:
+    def test_matches_scan_path(self):
+        rng = np.random.default_rng(0)
+        n, t, insz, h = 4, 12, 8, 16
+        x = jnp.asarray(rng.normal(0, 0.5, (n, t, insz)), jnp.float32)
+        w_ih = jnp.asarray(rng.normal(0, 0.2, (insz, 4 * h)),
+                           jnp.float32)
+        w_hh = jnp.asarray(rng.normal(0, 0.2, (h, 4 * h)), jnp.float32)
+        b = jnp.asarray(rng.normal(0, 0.1, (4 * h,)), jnp.float32)
+
+        ys_ref, (hT_ref, cT_ref) = lstm_layer(x, w_ih, w_hh, b)
+        xp = (x.reshape(n * t, -1) @ w_ih + b) \
+            .reshape(n, t, 4 * h).transpose(1, 0, 2)
+        ys, hT, cT = pallas_lstm_recurrence(
+            xp, w_hh, jnp.zeros((n, h)), jnp.zeros((n, h)),
+            k_steps=4, interpret=True)
+        np.testing.assert_allclose(np.asarray(ys.transpose(1, 0, 2)),
+                                   np.asarray(ys_ref), rtol=1e-5,
+                                   atol=1e-5)
+        np.testing.assert_allclose(np.asarray(hT), np.asarray(hT_ref),
+                                   rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(cT), np.asarray(cT_ref),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_pick_k_divides_and_fits(self):
+        k = _pick_k(200, 256, 1024, 2)
+        assert 200 % k == 0 and 2 * k * 256 * 1024 * 2 <= 6 << 20
+        # rows too big for any multi-step chunk: fall back to k=1
+        assert _pick_k(200, 2048, 8192, 4) == 1
