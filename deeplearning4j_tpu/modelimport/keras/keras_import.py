@@ -418,20 +418,22 @@ def _map_layer(class_name: str, cfg: dict, is_last: bool):
         return mapped
     if class_name == "Bidirectional":
         inner = cfg["layer"]
-        if not inner.get("config", {}).get("return_sequences", False):
-            raise UnsupportedKerasConfigurationException(
-                f"layer {name!r}: Bidirectional with "
-                "return_sequences=False is not supported (last-step "
-                "merge semantics differ; set return_sequences=True)")
-        wrapped = _map_layer(inner["class_name"],
-                             dict(inner["config"]), is_last=False)
+        ret_seq = bool(inner.get("config", {}).get("return_sequences",
+                                                   False))
+        # map the wrapped layer as sequence-returning; the LAST-STEP
+        # rule (fwd t=T-1 merged with bwd t=0) lives in Bidirectional
+        # itself via return_sequences=False
+        inner_cfg = dict(inner["config"], return_sequences=True)
+        wrapped = _map_layer(inner["class_name"], inner_cfg,
+                             is_last=False)
         mode = {"concat": "CONCAT", "sum": "ADD", "mul": "MUL",
                 "ave": "AVERAGE"}.get(cfg.get("merge_mode", "concat"))
         if mode is None:
             raise UnsupportedKerasConfigurationException(
                 f"layer {name!r}: merge_mode="
                 f"{cfg.get('merge_mode')!r} not supported")
-        return Bidirectional(name=name, layer=wrapped, mode=mode)
+        return Bidirectional(name=name, layer=wrapped, mode=mode,
+                             return_sequences=ret_seq)
     if class_name == "PReLU":
         return PReLULayer(name=name)
     if class_name == "RepeatVector":

@@ -750,6 +750,10 @@ class Bidirectional(Layer):
 
     layer: Optional[Layer] = None
     mode: str = "CONCAT"  # CONCAT | ADD | MUL | AVERAGE
+    #: False = Keras Bidirectional(return_sequences=False) semantics:
+    #: merge(fwd LAST step, bwd last step — i.e. its output at input
+    #: t=0), emitting [N, out] instead of a sequence
+    return_sequences: bool = True
 
     is_recurrent = True
 
@@ -767,6 +771,8 @@ class Bidirectional(Layer):
         self.layer.n_in = v
 
     def output_type(self, it: InputType) -> InputType:
+        if not self.return_sequences:
+            return InputType.feedForward(self.n_out)
         return InputType.recurrent(self.n_out, it.timeseries_length)
 
     def init_params(self, key, it, dtype) -> dict:
@@ -782,6 +788,11 @@ class Bidirectional(Layer):
         yb, _ = self.layer.apply(params["bw"], {}, jnp.flip(x, axis=1),
                                  train, rng)
         yb = jnp.flip(yb, axis=1)
+        if not self.return_sequences:
+            # Keras last-step rule: fwd's final output + bwd's final
+            # output (the bwd scan ends at input t=0, where the
+            # un-flipped sequence holds it)
+            yf, yb = yf[:, -1], yb[:, 0]
         m = self.mode.upper()
         if m == "CONCAT":
             return jnp.concatenate([yf, yb], axis=-1), state

@@ -121,10 +121,11 @@ def main():
             continue
         out_b = shape_bytes(shape_s)
         opnd_b = 0
-        # operands are the paren group AFTER the opcode — searching the
-        # whole line would match a tuple-shaped RESULT '(f32[...], ...)'
-        after_op = line.split(opcode, 1)[1] if opcode in line else ""
-        argm = re.search(r"\((.*?)\)", after_op)
+        # operands are the paren group attached to the OPCODE TOKEN —
+        # a plain substring split would cut inside the instruction's
+        # own name ('%fusion.42'), and the whole-line first paren group
+        # is the tuple RESULT shape for multi-output fusions
+        argm = re.search(r"\s" + re.escape(opcode) + r"\((.*?)\)", line)
         if argm:
             for op_name in re.findall(r"%([\w\.\-]+)", argm.group(1)):
                 s = inst_shape.get(op_name)
