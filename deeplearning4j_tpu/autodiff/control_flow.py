@@ -94,6 +94,17 @@ def if_cond(pred, *operands, true_graph=None, false_graph=None):
     return res[0] if len(res) == 1 else tuple(res)
 
 
+@register_op("case_graph")
+def case_graph(branch_index, *operands, branches=None):
+    """N-way branch over serialized sub-graphs (TF Case import):
+    lax.switch clamps the index and selects on-device."""
+    fns = [subgraph_fn(b) for b in branches]
+    idx = jnp.reshape(jnp.asarray(branch_index), ()).astype(jnp.int32)
+    res = lax.switch(idx, [lambda ops, f=f: f(*ops) for f in fns],
+                     tuple(operands))
+    return res[0] if len(res) == 1 else tuple(res)
+
+
 @register_op("call_graph")
 def call_graph(*args, graph=None):
     """Direct sub-graph invocation (TF PartitionedCall import): the

@@ -49,6 +49,7 @@ from deeplearning4j_tpu.modelimport.tensorflow.tf_import import (
 
 _LOOP_OPS = {"Enter", "RefEnter", "Exit", "RefExit", "NextIteration",
              "RefNextIteration", "LoopCond"}
+_MISSING = object()
 
 
 # ------------------------------------------------------------ frame paths
@@ -458,6 +459,7 @@ def _w_switch(walker: _Walker, node, in_vars, in_refs) -> None:
     """v1 Switch → both output edges alias the input, tagged with the
     branch; selection happens at the matching Merge."""
     data, pred = in_vars[0], in_vars[1]
+    walker.pred_kinds[pred.name] = "bool"
     tags = walker._gather_tags(node)
     for key, b in ((node.name, False), (node.name + ":0", False),
                    (node.name + ":1", True)):
@@ -467,15 +469,33 @@ def _w_switch(walker: _Walker, node, in_vars, in_refs) -> None:
         walker.branch_tags[key] = t
 
 
+def _w_switchn(walker: _Walker, node, in_vars, in_refs) -> None:
+    """_SwitchN (the lowered form of Case): N output edges alias the
+    input, each tagged with its integer branch index; the N-way Merge
+    selects with an eq-chain."""
+    data, index = in_vars[0], in_vars[1]
+    walker.pred_kinds[index.name] = "int"
+    n_out = int(node.attr["num_outs"].i)
+    tags = walker._gather_tags(node)
+    walker.tensors[node.name] = data
+    for k in range(n_out):
+        walker.tensors[f"{node.name}:{k}"] = data
+        t = dict(tags)
+        t[index.name] = k
+        walker.branch_tags[f"{node.name}:{k}"] = t
+    walker.branch_tags[node.name] = dict(walker.branch_tags
+                                         [node.name + ":0"])
+
+
 def _w_merge(walker: _Walker, node, in_vars, in_refs) -> None:
-    """v1 Merge → where(pred, true_branch, false_branch). Both arms
-    were computed (dead-branch values exist but are discarded — the
-    same both-arms-compiled semantics lax.cond has on TPU)."""
-    if len(in_vars) != 2:
-        raise TFImportError(
-            f"{node.name}: Merge with {len(in_vars)} inputs is only "
-            "importable inside a while frame")
+    """v1 Merge → where(pred, true_branch, false_branch), or an
+    eq-chain select for an N-way _SwitchN merge. All arms were
+    computed (dead-branch values exist but are discarded — the same
+    all-arms-compiled semantics lax.cond/switch have on TPU)."""
     keys = [f"{s}:{i}" if i else s for s, i in in_refs]
+    if len(in_vars) != 2:
+        _w_merge_n(walker, node, in_vars, keys)
+        return
     ta = walker.branch_tags.get(keys[0], {})
     tb = walker.branch_tags.get(keys[1], {})
     both = [p for p in ta if p in tb and ta[p] != tb[p]]
@@ -519,6 +539,53 @@ def _w_merge(walker: _Walker, node, in_vars, in_refs) -> None:
             walker.branch_tags[key] = dict(surviving)
 
 
+def _w_merge_n(walker: _Walker, node, in_vars, keys) -> None:
+    """N-way Merge over _SwitchN branches: every input must carry the
+    same int-kind predicate with a distinct branch value; selection is
+    a chain of where(index == k, branch_k, acc)."""
+    tag_sets = [walker.branch_tags.get(k, {}) for k in keys]
+    preds = [p for p in (set.intersection(*map(set, map(dict, tag_sets)))
+                         if tag_sets else set())
+             if walker.pred_kinds.get(p) == "int"
+             and len({t[p] for t in tag_sets}) == len(tag_sets)]
+    if len(preds) != 1:
+        raise TFImportError(
+            f"{node.name}: {len(in_vars)}-way Merge without a single "
+            "distinguishing _SwitchN index (not a reconstructible "
+            "Case lowering)")
+    p = preds[0]
+    sd = walker.sd
+    out = in_vars[0]
+    vi = sd.constant(f"{node.name}/vi0", np.int32(0))
+    for j in range(1, len(in_vars)):
+        kconst = sd.constant(f"{node.name}/k{j}",
+                             np.int32(tag_sets[j][p]))
+        cond = sd._op("eq", [p, kconst.name])
+        out = sd._op("where", [cond.name, in_vars[j].name, out.name],
+                     name=node.name if j == len(in_vars) - 1 else None)
+        jc = sd.constant(f"{node.name}/vij{j}", np.int32(j))
+        vi = sd._op("where", [cond.name, jc.name, vi.name],
+                    name=(node.name + "/index")
+                    if j == len(in_vars) - 1 else None)
+    walker.tensors[node.name] = out
+    walker.tensors[node.name + ":0"] = out
+    walker.tensors[node.name + ":1"] = vi
+    # surviving ENCLOSING tags (minus the resolved pred) propagate so a
+    # Case nested inside another cond/Case keeps its outer context —
+    # same rule as the 2-way merge
+    surviving: Dict[str, Any] = {}
+    for q in set().union(*map(set, tag_sets)):
+        if q == p:
+            continue
+        vals = [t.get(q, _MISSING) for t in tag_sets]
+        present = [v for v in vals if v is not _MISSING]
+        if len(set(present)) == 1:
+            surviving[q] = present[0]
+    if surviving:
+        for key in (node.name, node.name + ":0"):
+            walker.branch_tags[key] = dict(surviving)
+
+
 def _w_while(walker: _Walker, node, in_vars, in_refs) -> None:
     """TF2 functional While → while_loop over imported cond/body."""
     n = len(in_vars)
@@ -546,6 +613,19 @@ def _w_if(walker: _Walker, node, in_vars, in_refs) -> None:
     _map_multi(walker, node, out)
 
 
+def _w_case(walker: _Walker, node, in_vars, in_refs) -> None:
+    """TF2 functional Case → case_graph (lax.switch)."""
+    fnames = [f.name for f in node.attr["branches"].list.func]
+    n_args = len(in_vars) - 1
+    avs = [walker.avals.get(v.name) for v in in_vars[1:]]
+    graphs = [import_function(walker, fn, n_args, avs) for fn in fnames]
+    n_out = len(walker.library[fnames[0]].signature.output_arg)
+    out = walker.sd._op(
+        "case_graph", [v.name for v in in_vars], n_out=n_out,
+        name=node.name, branches=graphs)
+    _map_multi(walker, node, out)
+
+
 def _w_call(walker: _Walker, node, in_vars, in_refs) -> None:
     """PartitionedCall → inline the function body (call_graph traces it
     into the parent jit; the call boundary disappears)."""
@@ -561,9 +641,11 @@ def _w_call(walker: _Walker, node, in_vars, in_refs) -> None:
 
 WALKER_OPS = {
     "Switch": _w_switch, "RefSwitch": _w_switch,
+    "_SwitchN": _w_switchn,
     "Merge": _w_merge, "RefMerge": _w_merge,
     "While": _w_while, "StatelessWhile": _w_while,
     "If": _w_if, "StatelessIf": _w_if,
+    "Case": _w_case, "StatelessCase": _w_case,
     "PartitionedCall": _w_call, "StatefulPartitionedCall": _w_call,
 }
 

@@ -781,8 +781,11 @@ class _Walker:
         self.partials: Dict[str, np.ndarray] = {}
         # SDVariable name -> (aval under probe batch=2, probe batch=3)
         self.avals: Dict[str, Tuple[Any, Any]] = {}
-        # tensor key -> {pred var name: bool} (v1 Switch/Merge lowering)
-        self.branch_tags: Dict[str, Dict[str, bool]] = {}
+        # tensor key -> {pred var name: branch value} (v1 Switch/Merge
+        # lowering; bool Switch uses 0/1, _SwitchN uses the branch int)
+        self.branch_tags: Dict[str, Dict[str, Any]] = {}
+        # pred var name -> "bool" (Switch) | "int" (_SwitchN index)
+        self.pred_kinds: Dict[str, str] = {}
         self.nodes_by_name: Dict[str, Any] = {}
 
     # ------------------------------------------------------------ helpers

@@ -757,3 +757,199 @@ def cbow(ctx_in_rows, target_rows, labels, lr=0.025):
     dh = g @ target_rows
     new_ctx = ctx_in_rows - dh[None, :] / k
     return new_ctx, new_targets
+
+
+# ------------------------------------------------- recurrent declarables
+# (reference: generic/recurrent/{staticRNN,dynamicRNN,
+# staticBidirectionalRNN,dynamicBidirectionalRNN}.cpp — full-sequence
+# simple-RNN drivers; the fused layer ops live in ops/nn.py)
+def _rnn_seq(x, wx, wh, b, h0=None):
+    n, t, _ = x.shape
+    hidden = wh.shape[0]
+    if h0 is None:
+        h0 = jnp.zeros((n, hidden), x.dtype)
+    xp = (x.reshape(n * t, -1) @ wx + b).reshape(n, t, hidden)
+    xp = jnp.moveaxis(xp, 1, 0)
+
+    def step(h, p):
+        h2 = jnp.tanh(p + h @ wh)
+        return h2, h2
+
+    hT, ys = lax.scan(step, h0, xp)
+    return jnp.moveaxis(ys, 0, 1), hT
+
+
+@register_op("static_rnn")
+def static_rnn(x, wx, wh, b, h0=None):
+    """tanh RNN over the full (static-length) sequence; returns
+    (h_seq [N,T,H], h_last)."""
+    return _rnn_seq(x, wx, wh, b, h0)
+
+
+@register_op("dynamic_rnn")
+def dynamic_rnn(x, wx, wh, b, h0=None, seq_lengths=None):
+    """Like static_rnn, but positions past seq_lengths are zeroed and
+    h_last is the state at each row's true final step (zero-length
+    rows return their INITIAL state, matching TF dynamic_rnn)."""
+    ys, hT = _rnn_seq(x, wx, wh, b, h0)
+    if seq_lengths is None:
+        return ys, hT
+    t = x.shape[1]
+    lens = jnp.asarray(seq_lengths, jnp.int32)
+    valid = (jnp.arange(t)[None, :] < lens[:, None])[..., None]
+    ys = jnp.where(valid, ys, 0)
+    idx = jnp.clip(lens - 1, 0, t - 1)
+    h_last = jnp.take_along_axis(
+        ys, idx[:, None, None].astype(jnp.int32).repeat(ys.shape[-1], -1),
+        axis=1)[:, 0]
+    h_init = h0 if h0 is not None \
+        else jnp.zeros((x.shape[0], wh.shape[0]), x.dtype)
+    h_last = jnp.where((lens == 0)[:, None], h_init, h_last)
+    return ys, h_last
+
+
+@register_op("static_bidirectional_rnn")
+def static_bidirectional_rnn(x, wx_f, wh_f, b_f, wx_b, wh_b, b_b,
+                             h0_f=None, h0_b=None):
+    """Forward + time-reversed backward tanh RNNs, outputs
+    CONCATENATED on the feature axis (the reference op's layout);
+    returns (y [N,T,2H], h_last_f, h_last_b)."""
+    yf, hf = _rnn_seq(x, wx_f, wh_f, b_f, h0_f)
+    yb, hb = _rnn_seq(jnp.flip(x, axis=1), wx_b, wh_b, b_b, h0_b)
+    return (jnp.concatenate([yf, jnp.flip(yb, axis=1)], axis=-1),
+            hf, hb)
+
+
+@register_op("dynamic_bidirectional_rnn")
+def dynamic_bidirectional_rnn(x, wx_f, wh_f, b_f, wx_b, wh_b, b_b,
+                              seq_lengths=None, h0_f=None, h0_b=None):
+    """Bidirectional with per-row lengths: the backward direction
+    reverses only each row's VALID prefix (reverse_sequence
+    semantics), matching the reference/TF dynamic bidirectional op."""
+    if seq_lengths is None:
+        return static_bidirectional_rnn(x, wx_f, wh_f, b_f, wx_b, wh_b,
+                                        b_b, h0_f, h0_b)
+    from deeplearning4j_tpu.ops.registry import get_op
+    reverse_sequence = get_op("reverse_sequence")
+
+    lens = jnp.asarray(seq_lengths, jnp.int32)
+    t = x.shape[1]
+    pos = jnp.arange(t)[None, :]
+    xr = reverse_sequence(x, lens, seq_axis=1)
+    yf, _ = _rnn_seq(x, wx_f, wh_f, b_f, h0_f)
+    yb_r, _ = _rnn_seq(xr, wx_b, wh_b, b_b, h0_b)
+    yb = reverse_sequence(yb_r, lens, seq_axis=1)
+    valid = (pos < lens[:, None])[..., None]
+    yf = jnp.where(valid, yf, 0)
+    yb = jnp.where(valid, yb, 0)
+    idx = jnp.clip(lens - 1, 0, t - 1)[:, None, None]
+    hf = jnp.take_along_axis(
+        yf, idx.repeat(yf.shape[-1], -1).astype(jnp.int32), 1)[:, 0]
+    hb = yb[:, 0]
+    hf_init = h0_f if h0_f is not None \
+        else jnp.zeros((x.shape[0], wh_f.shape[0]), x.dtype)
+    hb_init = h0_b if h0_b is not None \
+        else jnp.zeros((x.shape[0], wh_b.shape[0]), x.dtype)
+    zero = (lens == 0)[:, None]
+    hf = jnp.where(zero, hf_init, hf)
+    hb = jnp.where(zero, hb_init, hb)
+    return jnp.concatenate([yf, yb], axis=-1), hf, hb
+
+
+# --------------------------------------------------------- CTC decoders
+# (reference: generic/loss/ctcLoss.cpp's decode companions —
+# parity_ops ctc_greedy_decoder / ctc_beam.cpp)
+@register_op("ctc_greedy_decoder")
+def ctc_greedy_decoder(log_probs, seq_lengths=None, blank=0,
+                       merge_repeated=True):
+    """Best-path decode: argmax per frame, collapse repeats, drop
+    blanks. Returns (dense [B, T] with -1 padding, lengths [B])."""
+    b, t, _ = log_probs.shape
+    ids = jnp.argmax(log_probs, axis=-1).astype(jnp.int32)
+    if seq_lengths is not None:
+        valid = jnp.arange(t)[None, :] < \
+            jnp.asarray(seq_lengths, jnp.int32)[:, None]
+        ids = jnp.where(valid, ids, blank)
+    if merge_repeated:
+        keep = jnp.concatenate(
+            [jnp.ones((b, 1), bool), ids[:, 1:] != ids[:, :-1]], axis=1)
+    else:
+        keep = jnp.ones((b, t), bool)
+    keep = keep & (ids != blank)
+    # stable left-compaction: target position = cumsum of keeps
+    pos = jnp.cumsum(keep, axis=1) - 1
+    out = jnp.full((b, t), -1, jnp.int32)
+    rows = jnp.arange(b)[:, None].repeat(t, 1)
+    out = out.at[rows, jnp.where(keep, pos, t - 1)].set(
+        jnp.where(keep, ids, -1), mode="drop")
+    # re-assert padding beyond each row's count (a dropped write may
+    # have left t-1 untouched; set explicitly)
+    counts = jnp.sum(keep, axis=1)
+    out = jnp.where(jnp.arange(t)[None, :] < counts[:, None], out, -1)
+    return out, counts.astype(jnp.int32)
+
+
+@register_op("ctc_beam_search_decoder")
+def ctc_beam_search_decoder(log_probs, seq_lengths=None, beam_width=8,
+                            blank=0, top_paths=1):
+    """Small-vocab CTC prefix beam search (eager, host-side — decode is
+    an inference utility, not a training hot path; reference:
+    ctc_beam.cpp). Returns (paths list of [B, L_i] int32 lists,
+    log_probs [B, top_paths])."""
+    import numpy as np
+
+    lp = np.asarray(log_probs, np.float64)
+    b, t, c = lp.shape
+    lens = (np.asarray(seq_lengths, np.int64)
+            if seq_lengths is not None else np.full(b, t))
+    all_paths, all_scores = [], []
+    for bi in range(b):
+        beams = {(): (0.0, -np.inf)}   # prefix -> (logp_blank, logp_nb)
+        for ti in range(int(lens[bi])):
+            nxt = {}
+
+            def add(pfx, pb, pnb):
+                opb, opnb = nxt.get(pfx, (-np.inf, -np.inf))
+                nxt[pfx] = (np.logaddexp(opb, pb),
+                            np.logaddexp(opnb, pnb))
+
+            for pfx, (pb, pnb) in beams.items():
+                total = np.logaddexp(pb, pnb)
+                add(pfx, total + lp[bi, ti, blank], -np.inf)
+                for s in range(c):
+                    if s == blank:
+                        continue
+                    p = lp[bi, ti, s]
+                    if pfx and pfx[-1] == s:
+                        add(pfx, -np.inf, pnb + p)          # repeat
+                        add(pfx + (s,), -np.inf, pb + p)    # after blank
+                    else:
+                        add(pfx + (s,), -np.inf, total + p)
+            beams = dict(sorted(
+                nxt.items(),
+                key=lambda kv: -np.logaddexp(*kv[1]))[:int(beam_width)])
+        ranked = sorted(beams.items(),
+                        key=lambda kv: -np.logaddexp(*kv[1]))
+        paths = [list(p) for p, _ in ranked[:int(top_paths)]]
+        scores = [float(np.logaddexp(*s)) for _, s in
+                  ranked[:int(top_paths)]]
+        while len(paths) < int(top_paths):
+            paths.append([])
+            scores.append(float("-inf"))
+        all_paths.append(paths)
+        all_scores.append(scores)
+    return all_paths, jnp.asarray(np.asarray(all_scores, np.float32))
+
+
+@register_op("apply_sgd")
+def apply_sgd(params, grad, lr=0.01):
+    """Functional p - lr*g (generic/optimizer/sgd.cpp apply_sgd)."""
+    return params - lr * grad
+
+
+@register_op("print_variable")
+def print_variable(x, message=""):
+    """Debug identity (generic/util/print_variable.cpp): prints eagerly,
+    passes through under jit via jax.debug.print."""
+    jax.debug.print("{m}{v}", m=message, v=x)
+    return x

@@ -523,3 +523,132 @@ class TestAbsReductions:
         np.testing.assert_allclose(
             npx(get_op("asum")(X, dimensions=[0], keep_dims=True)),
             np.abs(npx(X)).sum(0, keepdims=True), rtol=1e-5)
+
+
+class TestRecurrentDeclarables:
+    def _params(self, insz=5, h=6):
+        r = np.random.default_rng(4)
+        mk = lambda *s: jnp.asarray(  # noqa: E731
+            r.normal(0, 0.3, s).astype(np.float32))
+        return mk(insz, h), mk(h, h), mk(h)
+
+    def test_static_rnn_matches_manual(self):
+        wx, wh, b = self._params()
+        x = jnp.asarray(RNG.normal(size=(2, 4, 5)).astype(np.float32))
+        ys, hT = get_op("static_rnn")(x, wx, wh, b)
+        h = np.zeros((2, 6), np.float32)
+        for t in range(4):
+            h = np.tanh(npx(x)[:, t] @ npx(wx) + npx(b) + h @ npx(wh))
+            np.testing.assert_allclose(npx(ys[:, t]), h, rtol=1e-4,
+                                       atol=1e-5)
+        np.testing.assert_allclose(npx(hT), h, rtol=1e-4, atol=1e-5)
+
+    def test_dynamic_rnn_respects_lengths(self):
+        wx, wh, b = self._params()
+        x = jnp.asarray(RNG.normal(size=(2, 5, 5)).astype(np.float32))
+        lens = jnp.asarray([3, 5], jnp.int32)
+        ys, h_last = get_op("dynamic_rnn")(x, wx, wh, b,
+                                           seq_lengths=lens)
+        assert np.all(npx(ys)[0, 3:] == 0)          # masked tail
+        np.testing.assert_allclose(npx(h_last[0]), npx(ys[0, 2]),
+                                   rtol=1e-6)
+        np.testing.assert_allclose(npx(h_last[1]), npx(ys[1, 4]),
+                                   rtol=1e-6)
+
+    def test_static_bidirectional_concat(self):
+        wx, wh, b = self._params()
+        wx2, wh2, b2 = self._params(5, 6)
+        x = jnp.asarray(RNG.normal(size=(2, 4, 5)).astype(np.float32))
+        y, hf, hb = get_op("static_bidirectional_rnn")(
+            x, wx, wh, b, wx2, wh2, b2)
+        assert y.shape == (2, 4, 12)
+        yf, hf_ref = get_op("static_rnn")(x, wx, wh, b)
+        np.testing.assert_allclose(npx(y[..., :6]), npx(yf), rtol=1e-6)
+        np.testing.assert_allclose(npx(hf), npx(hf_ref), rtol=1e-6)
+
+    def test_dynamic_bidirectional_matches_tf(self):
+        tf = pytest.importorskip("tensorflow")
+        wx, wh, b = self._params()
+        x_np = RNG.normal(size=(2, 5, 5)).astype(np.float32)
+        lens = np.asarray([3, 5], np.int32)
+        y, hf, hb = get_op("dynamic_bidirectional_rnn")(
+            jnp.asarray(x_np), wx, wh, b, wx, wh, b,
+            seq_lengths=jnp.asarray(lens))
+        # backward dir = forward RNN over reverse_sequence(x)
+        xr = tf.reverse_sequence(x_np, lens, seq_axis=1).numpy()
+        yb_ref, _ = get_op("static_rnn")(jnp.asarray(xr), wx, wh, b)
+        yb_ref = tf.reverse_sequence(npx(yb_ref), lens,
+                                     seq_axis=1).numpy()
+        yb_ref[0, 3:] = 0
+        np.testing.assert_allclose(npx(y[..., 6:]), yb_ref, rtol=1e-4,
+                                   atol=1e-5)
+
+
+class TestCtcDecoders:
+    def test_greedy_matches_tf(self):
+        tf = pytest.importorskip("tensorflow")
+        r = np.random.default_rng(6)
+        lp = r.normal(size=(3, 7, 5)).astype(np.float32)
+        lens = np.asarray([7, 5, 6], np.int32)
+        dense, counts = get_op("ctc_greedy_decoder")(
+            jnp.asarray(lp), jnp.asarray(lens), blank=4)
+        # TF wants time-major and uses LAST class as blank with
+        # blank_index=-1 (default)
+        (decoded,), _ = tf.nn.ctc_greedy_decoder(
+            np.transpose(lp, (1, 0, 2)), lens)
+        ref = tf.sparse.to_dense(decoded, default_value=-1).numpy()
+        got = npx(dense)
+        for i in range(3):
+            ref_row = [v for v in ref[i] if v >= 0]
+            got_row = [v for v in got[i] if v >= 0]
+            assert got_row == ref_row, (i, got_row, ref_row)
+            assert int(counts[i]) == len(ref_row)
+
+    def test_beam_search_top1_matches_tf(self):
+        tf = pytest.importorskip("tensorflow")
+        r = np.random.default_rng(8)
+        logits = r.normal(size=(2, 6, 4)).astype(np.float32)
+        lp = np.asarray(
+            tf.nn.log_softmax(logits).numpy(), np.float32)
+        lens = np.asarray([6, 6], np.int32)
+        paths, scores = get_op("ctc_beam_search_decoder")(
+            jnp.asarray(lp), jnp.asarray(lens), beam_width=16,
+            blank=3, top_paths=1)
+        (decoded,), _ = tf.nn.ctc_beam_search_decoder(
+            np.transpose(lp, (1, 0, 2)), lens, beam_width=16,
+            top_paths=1)
+        ref = tf.sparse.to_dense(decoded, default_value=-1).numpy()
+        for i in range(2):
+            ref_row = [v for v in ref[i] if v >= 0]
+            assert paths[i][0] == ref_row, (i, paths[i][0], ref_row)
+
+    def test_apply_sgd_print_variable(self):
+        p = jnp.asarray([1.0, 2.0])
+        out = get_op("apply_sgd")(p, jnp.asarray([0.5, 0.5]), lr=0.1)
+        np.testing.assert_allclose(npx(out), [0.95, 1.95], rtol=1e-6)
+        out2 = get_op("print_variable")(p, message="dbg: ")
+        np.testing.assert_allclose(npx(out2), npx(p))
+
+
+class TestCaseGraph:
+    def test_case_graph_switches(self):
+        from deeplearning4j_tpu.autodiff.control_flow import (
+            subgraph_to_dict,
+        )
+        from deeplearning4j_tpu.autodiff.samediff import SameDiff
+
+        def branch(fn):
+            sub = SameDiff()
+            a = sub.placeholder("sg_in_0")
+            return subgraph_to_dict(sub, [fn(a).name], 1)
+
+        branches = [branch(lambda a: a + 1.0),
+                    branch(lambda a: a * 2.0),
+                    branch(lambda a: a - 3.0)]
+        x = jnp.asarray([10.0])
+        f = get_op("case_graph")
+        assert float(f(0, x, branches=branches)[0]) == 11.0
+        assert float(f(1, x, branches=branches)[0]) == 20.0
+        assert float(f(2, x, branches=branches)[0]) == 7.0
+        # out-of-range clamps (lax.switch semantics)
+        assert float(f(9, x, branches=branches)[0]) == 7.0

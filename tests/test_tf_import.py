@@ -689,6 +689,22 @@ def _nested_while_fn(a):
                          [tf.constant(0), tf.zeros_like(a)])[1]
 
 
+def _case_fn(a):
+    idx = tf.cast(tf.reduce_sum(a) > 0, tf.int32) + \
+        tf.cast(tf.reduce_max(a) > 2.0, tf.int32)
+    return tf.switch_case(idx, [lambda: a + 1.0, lambda: a * 2.0,
+                                lambda: a - 3.0])
+
+
+def _nested_case_fn(a):
+    def outer0():
+        return tf.switch_case(
+            tf.cast(tf.reduce_max(a) > 1.0, tf.int32),
+            [lambda: a + 10.0, lambda: a + 20.0])
+    idx = tf.cast(tf.reduce_sum(a) > 0, tf.int32)
+    return tf.switch_case(idx, [outer0, lambda: a * 5.0])
+
+
 def _tensorarray_fn(a):
     ta = tf.TensorArray(tf.float32, size=4, element_shape=(4,))
     def body(i, ta):
@@ -727,6 +743,10 @@ CF_BATTERY = {
     "cond_not_taken": (_cond_fn, [-np.abs(_F44)]),
     "nested_while": (_nested_while_fn, [_F44]),
     "while_tensorarray": (_tensorarray_fn, [_F44]),
+    "switch_case": (_case_fn, [_F44]),
+    "switch_case_branch2": (_case_fn, [np.abs(_F44) + 2.0]),
+    "nested_case": (_nested_case_fn, [-np.abs(_F44)]),
+    "nested_case_inner1": (_nested_case_fn, [-np.abs(_F44) * 0.1]),
 }
 
 
