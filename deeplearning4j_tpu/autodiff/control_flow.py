@@ -100,6 +100,9 @@ def case_graph(branch_index, *operands, branches=None):
     lax.switch clamps the index and selects on-device."""
     fns = [subgraph_fn(b) for b in branches]
     idx = jnp.reshape(jnp.asarray(branch_index), ()).astype(jnp.int32)
+    # TF rule: ANY out-of-range index (incl. negative sentinels) runs
+    # the LAST branch; lax.switch would clamp negatives to branch 0
+    idx = jnp.where((idx < 0) | (idx >= len(fns)), len(fns) - 1, idx)
     res = lax.switch(idx, [lambda ops, f=f: f(*ops) for f in fns],
                      tuple(operands))
     return res[0] if len(res) == 1 else tuple(res)

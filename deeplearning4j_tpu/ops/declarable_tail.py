@@ -833,26 +833,18 @@ def dynamic_bidirectional_rnn(x, wx_f, wh_f, b_f, wx_b, wh_b, b_b,
     reverse_sequence = get_op("reverse_sequence")
 
     lens = jnp.asarray(seq_lengths, jnp.int32)
-    t = x.shape[1]
-    pos = jnp.arange(t)[None, :]
+    pos = jnp.arange(x.shape[1])[None, :]
+    # forward half IS dynamic_rnn (masking + last-step + zero-length
+    # fallback live in one place)
+    yf, hf = dynamic_rnn(x, wx_f, wh_f, b_f, h0_f, seq_lengths=lens)
     xr = reverse_sequence(x, lens, seq_axis=1)
-    yf, _ = _rnn_seq(x, wx_f, wh_f, b_f, h0_f)
     yb_r, _ = _rnn_seq(xr, wx_b, wh_b, b_b, h0_b)
     yb = reverse_sequence(yb_r, lens, seq_axis=1)
-    valid = (pos < lens[:, None])[..., None]
-    yf = jnp.where(valid, yf, 0)
-    yb = jnp.where(valid, yb, 0)
-    idx = jnp.clip(lens - 1, 0, t - 1)[:, None, None]
-    hf = jnp.take_along_axis(
-        yf, idx.repeat(yf.shape[-1], -1).astype(jnp.int32), 1)[:, 0]
+    yb = jnp.where((pos < lens[:, None])[..., None], yb, 0)
     hb = yb[:, 0]
-    hf_init = h0_f if h0_f is not None \
-        else jnp.zeros((x.shape[0], wh_f.shape[0]), x.dtype)
     hb_init = h0_b if h0_b is not None \
         else jnp.zeros((x.shape[0], wh_b.shape[0]), x.dtype)
-    zero = (lens == 0)[:, None]
-    hf = jnp.where(zero, hf_init, hf)
-    hb = jnp.where(zero, hb_init, hb)
+    hb = jnp.where((lens == 0)[:, None], hb_init, hb)
     return jnp.concatenate([yf, yb], axis=-1), hf, hb
 
 

@@ -1,0 +1,118 @@
+// Sanitizer harness for the native runtime (reference: libnd4j's CMake
+// SANITIZE option building tests_cpu with -fsanitize=address,undefined
+// via buildnativeoperations.sh — SURVEY.md §5 race/memory detection).
+//
+// Built standalone (NOT as the .so — ASAN needs to own the process) by
+// `make -C native sanitize` and run by tests/test_nativeops.py: every
+// exported entry point is driven across sizes, edge cases, and
+// multithreaded paths; ASAN/UBSAN abort on any overflow, leak, or UB.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+extern "C" {
+int64_t dl4j_threshold_count(const float*, int64_t, float);
+int64_t dl4j_threshold_encode(const float*, int64_t, float, int32_t*,
+                              int64_t);
+void dl4j_threshold_decode(const int32_t*, int64_t, float, float*, int64_t);
+void dl4j_threshold_residual(float*, int64_t, float, const int32_t*,
+                             int64_t);
+int64_t dl4j_csv_count_rows(const char*, int64_t);
+int64_t dl4j_csv_count_cols(const char*, int64_t, char);
+int64_t dl4j_csv_parse(const char*, int64_t, char, int64_t, int64_t,
+                       float*);
+void dl4j_image_resize_normalize_batch(const uint8_t*, int, int, int, int,
+                                       float*, int, int, float,
+                                       const float*, const float*, int);
+}
+
+#define CHECK(cond)                                                    \
+    do {                                                               \
+        if (!(cond)) {                                                 \
+            std::fprintf(stderr, "CHECK failed %s:%d: %s\n", __FILE__, \
+                         __LINE__, #cond);                             \
+            return 1;                                                  \
+        }                                                              \
+    } while (0)
+
+static int test_threshold() {
+    // sizes straddling the parallel-chunk boundaries incl. 0 and 1
+    for (int64_t n : {0L, 1L, 7L, 1024L, 100003L}) {
+        std::vector<float> g(n);
+        for (int64_t i = 0; i < n; ++i)
+            g[i] = (i % 5 == 0) ? 0.5f : 0.0001f * (i % 3);
+        int64_t count = dl4j_threshold_count(g.data(), n, 0.1f);
+        std::vector<int32_t> idx(count > 0 ? count : 1);
+        int64_t wrote =
+            dl4j_threshold_encode(g.data(), n, 0.1f, idx.data(), count);
+        CHECK(wrote == count);
+        std::vector<float> out(n > 0 ? n : 1, 0.0f);
+        dl4j_threshold_decode(idx.data(), wrote, 0.1f, out.data(), n);
+        std::vector<float> resid(g);
+        dl4j_threshold_residual(resid.data(), n, 0.1f, idx.data(), wrote);
+        for (int64_t i = 0; i < wrote; ++i) {
+            // reference encoding: SIGNED 1-based index carries the
+            // gradient's sign
+            int64_t mag = idx[i] > 0 ? idx[i] : -(int64_t)idx[i];
+            CHECK(mag >= 1 && mag <= n);
+            int64_t pos = mag - 1;
+            float expect = g[pos] - (idx[i] > 0 ? 0.1f : -0.1f);
+            CHECK(resid[pos] > expect - 1e-6f &&
+                  resid[pos] < expect + 1e-6f);
+        }
+    }
+    return 0;
+}
+
+static int test_csv() {
+    // trailing newline present and absent, quoted fields, empty input
+    for (const char* s :
+         {"1,2,3\n4,5,6\n", "1,2,3\n4,5,6", "7.5,8.5,9.5", ""}) {
+        int64_t len = (int64_t)std::strlen(s);
+        int64_t rows = dl4j_csv_count_rows(s, len);
+        int64_t cols = dl4j_csv_count_cols(s, len, ',');
+        if (rows > 0 && cols > 0) {
+            std::vector<float> out(rows * cols);
+            int64_t parsed =
+                dl4j_csv_parse(s, len, ',', rows, cols, out.data());
+            CHECK(parsed == rows);
+        }
+    }
+    // large multithreaded parse
+    std::string big;
+    for (int i = 0; i < 20000; ++i) big += "1.5,2.5,3.5,4.5\n";
+    int64_t rows = dl4j_csv_count_rows(big.data(), (int64_t)big.size());
+    CHECK(rows == 20000);
+    std::vector<float> out(rows * 4);
+    CHECK(dl4j_csv_parse(big.data(), (int64_t)big.size(), ',', rows, 4,
+                         out.data()) == rows);
+    CHECK(out[0] == 1.5f && out[rows * 4 - 1] == 4.5f);
+    return 0;
+}
+
+static int test_image() {
+    // batch resize incl. 1x1 degenerate target and non-square scaling
+    const int n = 3, h = 17, w = 23, c = 3;
+    std::vector<uint8_t> src(n * h * w * c);
+    for (size_t i = 0; i < src.size(); ++i) src[i] = (uint8_t)(i * 31);
+    float mean[3] = {0.5f, 0.4f, 0.3f};
+    float std3[3] = {0.2f, 0.2f, 0.2f};
+    for (int oh : {1, 8, 32}) {
+        int ow = oh == 8 ? 13 : oh;
+        std::vector<float> dst((size_t)n * oh * ow * c, -1.0f);
+        dl4j_image_resize_normalize_batch(src.data(), n, h, w, c,
+                                          dst.data(), oh, ow,
+                                          1.0f / 255.0f, mean, std3, 2);
+        for (float v : dst) CHECK(v > -100.0f && v < 100.0f);
+    }
+    return 0;
+}
+
+int main() {
+    int rc = test_threshold() + test_csv() + test_image();
+    if (rc == 0) std::puts("SANITIZE OK");
+    return rc;
+}

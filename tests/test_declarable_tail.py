@@ -650,5 +650,7 @@ class TestCaseGraph:
         assert float(f(0, x, branches=branches)[0]) == 11.0
         assert float(f(1, x, branches=branches)[0]) == 20.0
         assert float(f(2, x, branches=branches)[0]) == 7.0
-        # out-of-range clamps (lax.switch semantics)
+        # TF rule: ANY out-of-range index (incl. negative) runs the
+        # LAST branch
         assert float(f(9, x, branches=branches)[0]) == 7.0
+        assert float(f(-1, x, branches=branches)[0]) == 7.0

@@ -188,3 +188,25 @@ class TestNativeImagePreproc:
         b[0, 1::2, ::2, 0] = 255
         out = batch_resize_normalize(b, 4, 4, scale=1.0)
         np.testing.assert_allclose(out, 127.5, atol=0.6)
+
+
+class TestSanitizers:
+    def test_native_runtime_clean_under_asan_ubsan(self):
+        """Reference: libnd4j's CMake SANITIZE build of tests_cpu
+        (SURVEY.md §5 race/memory detection). Builds the standalone
+        ASAN+UBSAN harness (sanitizer runtime must own the process, so
+        not the .so) and drives every native entry point across sizes,
+        edge cases, and the multithreaded paths."""
+        import shutil
+        import subprocess
+
+        if shutil.which("g++") is None or shutil.which("make") is None:
+            pytest.skip("no native toolchain")
+        native_dir = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "native")
+        proc = subprocess.run(["make", "-C", native_dir, "sanitize"],
+                              capture_output=True, text=True,
+                              timeout=300)
+        assert proc.returncode == 0, \
+            f"sanitizer run failed:\n{proc.stdout}\n{proc.stderr[-3000:]}"
+        assert "SANITIZE OK" in proc.stdout
