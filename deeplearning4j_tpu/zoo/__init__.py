@@ -24,8 +24,9 @@ from deeplearning4j_tpu.zoo.inception_resnet import (
     FaceNetNN4Small2, InceptionResNetV1,
 )
 from deeplearning4j_tpu.zoo.nasnet import NASNet
+from deeplearning4j_tpu.zoo.yolo2 import YOLO2
 
 __all__ = ["LeNet", "AlexNet", "VGG16", "VGG19", "ResNet50", "SimpleCNN",
            "UNet", "TinyYOLO", "Darknet19", "SqueezeNet",
            "TextGenerationLSTM", "Xception", "InceptionResNetV1",
-           "FaceNetNN4Small2", "NASNet"]
+           "FaceNetNN4Small2", "NASNet", "YOLO2"]

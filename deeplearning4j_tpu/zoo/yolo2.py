@@ -1,0 +1,98 @@
+"""YOLOv2 (reference: zoo/model/YOLO2.java — full Darknet-19 backbone
+ComputationGraph with the reorg/passthrough route: the 26x26x512 stage-5
+feature map goes through a 1x1 conv then SpaceToDepth(2) and is
+concatenated with the 13x13x1024 head before the detection conv +
+Yolo2OutputLayer; COCO anchor priors).
+
+TPU notes: NHWC throughout; SpaceToDepth is a pure reshape/transpose
+(zero-FLOP in XLA); the concat fuses into the following conv's input.
+"""
+
+from __future__ import annotations
+
+from deeplearning4j_tpu.learning import Nesterovs
+from deeplearning4j_tpu.nn.conf import (
+    BatchNormalization, ConvolutionLayer, InputType, SubsamplingLayer,
+)
+from deeplearning4j_tpu.nn.conf.layers_extra import SpaceToDepthLayer
+from deeplearning4j_tpu.nn.conf.objdetect import Yolo2OutputLayer
+from deeplearning4j_tpu.nn.graph import (
+    ComputationGraph, ComputationGraphConfiguration, MergeVertex,
+)
+from deeplearning4j_tpu.zoo.base import ZooModel
+
+#: COCO anchor priors in grid units (reference YOLO2.java DEFAULT_PRIORS)
+DEFAULT_ANCHORS = ((0.57273, 0.677385), (1.87446, 2.06253),
+                   (3.33843, 5.47434), (7.88282, 3.52778),
+                   (9.77052, 9.16828))
+
+
+class YOLO2(ZooModel):
+    def __init__(self, num_classes: int = 80, seed: int = 42,
+                 updater=None, in_shape=(608, 608, 3),
+                 anchors=DEFAULT_ANCHORS):
+        self.num_classes = num_classes
+        self.seed = seed
+        self.updater = updater or Nesterovs(1e-3, momentum=0.9)
+        self.in_shape = in_shape
+        self.anchors = anchors
+
+    def _conv_bn(self, b, name, inp, n_out, kernel):
+        b.addLayer(f"{name}_conv",
+                   ConvolutionLayer(n_out=n_out,
+                                    kernel_size=(kernel, kernel),
+                                    convolution_mode="Same",
+                                    activation="identity",
+                                    has_bias=False), inp)
+        b.addLayer(f"{name}_bn",
+                   BatchNormalization(activation="leakyrelu"),
+                   f"{name}_conv")
+        return f"{name}_bn"
+
+    def conf(self) -> ComputationGraphConfiguration:
+        h, w, c = self.in_shape
+        b = (ComputationGraphConfiguration.graphBuilder()
+             .seed(self.seed).updater(self.updater).weightInit("relu")
+             .addInputs("input")
+             .setInputTypes(InputType.convolutional(h, w, c)))
+
+        # Darknet-19 backbone from the ONE shared table (zoo/darknet19
+        # _ARCH); the passthrough taps the stage-5 output — the conv
+        # directly before the LAST pool (26x26x512 at 416 input)
+        from deeplearning4j_tpu.zoo.darknet19 import _ARCH
+
+        last_pool = max(i for i, it in enumerate(_ARCH) if it == "M")
+        x = "input"
+        passthrough = None
+        ci = pi = 0
+        for i, item in enumerate(_ARCH):
+            if item == "M":
+                if i == last_pool:
+                    passthrough = x
+                pi += 1
+                b.addLayer(f"p{pi}", SubsamplingLayer(
+                    kernel_size=(2, 2), stride=(2, 2)), x)
+                x = f"p{pi}"
+            else:
+                f, k = item
+                ci += 1
+                x = self._conv_bn(b, f"c{ci}", x, f, k)
+        # detection head convs 19-20
+        x = self._conv_bn(b, "c19", x, 1024, 3)
+        x = self._conv_bn(b, "c20", x, 1024, 3)
+        # passthrough: 1x1 conv to 64ch then reorg to the head's grid
+        pt = self._conv_bn(b, "c21_pt", passthrough, 64, 1)
+        b.addLayer("reorg", SpaceToDepthLayer(block_size=2), pt)
+        b.addVertex("route", MergeVertex(), "reorg", x)
+        x = self._conv_bn(b, "c22", "route", 1024, 3)
+        n_anchors = len(self.anchors)
+        b.addLayer("det_conv",
+                   ConvolutionLayer(
+                       n_out=n_anchors * (5 + self.num_classes),
+                       kernel_size=(1, 1), activation="identity"), x)
+        b.addLayer("yolo",
+                   Yolo2OutputLayer(anchors=self.anchors), "det_conv")
+        return b.setOutputs("yolo").build()
+
+    def init(self) -> ComputationGraph:
+        return ComputationGraph(self.conf()).init()

@@ -249,3 +249,43 @@ class TestYoloDetectionDecoding:
         x = np.full((2, 3, 3, 1 * (5 + 2)), -8.0, np.float32)
         dets = YoloUtils.getPredictedObjects(lay, x, conf_threshold=0.5)
         assert [len(d) for d in dets] == [0, 0]
+
+
+class TestYOLO2:
+    """Full YOLOv2 (reference: zoo/model/YOLO2.java): Darknet-19
+    backbone + reorg/passthrough route + 5-anchor COCO head."""
+
+    def test_builds_and_forward_shape(self):
+        from deeplearning4j_tpu.zoo import YOLO2
+        net = YOLO2(num_classes=80, in_shape=(416, 416, 3)).init()
+        x = np.random.default_rng(0).normal(
+            size=(1, 416, 416, 3)).astype(np.float32)
+        out = np.asarray(net.outputSingle(x))
+        # 416/32 = 13 grid, 5 anchors x (5 + 80) channels
+        assert out.shape == (1, 13, 13, 5 * 85)
+
+    def test_passthrough_route_is_wired(self):
+        from deeplearning4j_tpu.zoo import YOLO2
+        conf = YOLO2(num_classes=20, in_shape=(416, 416, 3)).conf()
+        names = [n.name for n in conf.nodes]
+        assert "reorg" in names and "route" in names
+        route = next(n for n in conf.nodes if n.name == "route")
+        assert set(route.inputs) == {"reorg", "c20_bn"}
+
+    def test_trains_a_step(self):
+        from deeplearning4j_tpu.zoo import YOLO2
+        net = YOLO2(num_classes=3, in_shape=(128, 128, 3)).init()
+        rng = np.random.default_rng(1)
+        x = rng.normal(size=(2, 128, 128, 3)).astype(np.float32)
+        # label tensor: [N, grid, grid, 4 + C] (box + one-hot class),
+        # same convention as the TinyYOLO tests
+        y = np.zeros((2, 4, 4, 4 + 3), np.float32)
+        y[:, 1, 1, :4] = (0.3, 0.3, 0.6, 0.6)
+        y[:, 1, 1, 4] = 1.0
+        net.fit(x, y, epochs=1)
+        s1 = float(net.score())
+        assert np.isfinite(s1)
+        # training must actually move the loss, not just stay finite
+        net.fit(x, y, epochs=3)
+        s2 = float(net.score())
+        assert np.isfinite(s2) and s2 < s1, (s1, s2)
