@@ -582,7 +582,196 @@ def _register_standard_mappers():
                       eps=float(ctx.attr("epsilon", 1e-3)))
 
 
+def _register_extended_mappers():
+    """Scientific/segment/linalg/layout mappers over ALREADY-registered
+    ops (round-3 breadth: graphs using tf.math special functions,
+    cumulative ops, segment ops, top-k, space/depth layout ops import
+    without custom work — reference: the TFGraphTestAllSameDiff battery
+    spans these op families)."""
+    R = OpMappingRegistry.register
+
+    for tf_op, our in [("Asin", "asin"), ("Acos", "acos"),
+                       ("Atan", "atan"), ("Asinh", "asinh"),
+                       ("Acosh", "acosh"), ("Atanh", "atanh"),
+                       ("Lgamma", "lgamma"), ("Digamma", "digamma"),
+                       ("Erfinv", "erfinv"), ("Rint", "rint"),
+                       ("Expm1", "expm1"), ("IsFinite", "is_finite"),
+                       ("Invert", "bitwise_not"),
+                       ("InvertPermutation", "invert_permutation"),
+                       ("Cholesky", "cholesky"),
+                       ("MatrixDeterminant", "matrix_determinant"),
+                       ("L2Loss", "l2_loss")]:
+        R(tf_op)(lambda ctx, _o=our: ctx.op(_o, ctx.inputs[:1]))
+
+    for tf_op, our in [("Atan2", "atan2"), ("Igamma", "igamma"),
+                       ("Igammac", "igammac"), ("Zeta", "zeta"),
+                       ("Polygamma", "polygamma"), ("Xlogy", "xlogy"),
+                       ("Xdivy", "xdivy"), ("Xlog1py", "xlog1py"),
+                       ("TruncateDiv", "truncatediv"),
+                       ("TruncateMod", "fmod"),
+                       ("DivNoNan", "divide_no_nan"),
+                       ("LeftShift", "shift_left"),
+                       ("RightShift", "shift_right"),
+                       ("BitwiseAnd", "bitwise_and"),
+                       ("BitwiseOr", "bitwise_or"),
+                       ("BitwiseXor", "bitwise_xor"),
+                       ("Cross", "cross")]:
+        R(tf_op)(lambda ctx, _o=our: ctx.op(_o, ctx.inputs[:2]))
+
+    R("Betainc")(lambda ctx: ctx.op("betainc", ctx.inputs[:3]))
+    R("ClipByValue")(lambda ctx: ctx.op("clip_by_value",
+                                        ctx.inputs[:3]))
+
+    for tf_op, our in [("Cumsum", "cumsum"), ("Cumprod", "cumprod")]:
+        def _cum(ctx, _o=our):
+            return ctx.op(_o, ctx.inputs[:1],
+                          axis=int(ctx.static_np(1)),
+                          exclusive=bool(ctx.attr("exclusive", False)),
+                          reverse=bool(ctx.attr("reverse", False)))
+        R(tf_op)(_cum)
+
+    @R("TopKV2")
+    def _topk(ctx):
+        return ctx.op("top_k", ctx.inputs[:1], n_out=2,
+                      k=int(ctx.static_np(1)),
+                      sorted=bool(ctx.attr("sorted", True)))
+
+    @R("InTopK", "InTopKV2")
+    def _in_top_k(ctx):
+        k = (int(ctx.static_np(2)) if ctx.node.op == "InTopKV2"
+             else int(ctx.attr("k")))
+        return ctx.op("in_top_k", ctx.inputs[:2], k=k)
+
+    @R("ReverseV2")
+    def _reverse_v2(ctx):
+        dims = [int(d) for d in np.atleast_1d(ctx.static_np(1))]
+        return ctx.op("reverse", ctx.inputs[:1], dimensions=dims)
+
+    @R("ReverseSequence")
+    def _reverse_seq(ctx):
+        return ctx.op("reverse_sequence", ctx.inputs[:2],
+                      seq_axis=int(ctx.attr("seq_dim", 1)),
+                      batch_axis=int(ctx.attr("batch_dim", 0)))
+
+    for tf_op, our in [("SpaceToDepth", "space_to_depth"),
+                       ("DepthToSpace", "depth_to_space")]:
+        def _s2d(ctx, _o=our):
+            if ctx.attr("data_format", "NHWC") != "NHWC":
+                raise TFImportError(f"{ctx.node.name}: NHWC only")
+            return ctx.op(_o, ctx.inputs[:1],
+                          block_size=int(ctx.attr("block_size")))
+        R(tf_op)(_s2d)
+
+    @R("SpaceToBatchND")
+    def _s2b_nd(ctx):
+        return ctx.op(
+            "space_to_batch_nd", ctx.inputs[:1],
+            block_shape=[int(v) for v in ctx.static_np(1)],
+            paddings=[[int(a), int(b)] for a, b in ctx.static_np(2)])
+
+    @R("BatchToSpaceND")
+    def _b2s_nd(ctx):
+        return ctx.op(
+            "batch_to_space_nd", ctx.inputs[:1],
+            block_shape=[int(v) for v in ctx.static_np(1)],
+            crops=[[int(a), int(b)] for a, b in ctx.static_np(2)])
+
+    # sorted segment ops: segment_ids must be a constant so the output
+    # size (max id + 1) is static under jit
+    for tf_op, our in [("SegmentSum", "segment_sum"),
+                       ("SegmentMean", "segment_mean"),
+                       ("SegmentMax", "segment_max"),
+                       ("SegmentMin", "segment_min"),
+                       ("SegmentProd", "segment_prod")]:
+        def _seg(ctx, _o=our):
+            ids = ctx.static_np(1)
+            return ctx.op(_o, ctx.inputs[:2],
+                          num_segments=int(np.max(ids)) + 1)
+        R(tf_op)(_seg)
+
+    for tf_op, our in [("UnsortedSegmentSum", "unsorted_segment_sum"),
+                       ("UnsortedSegmentMax", "unsorted_segment_max"),
+                       ("UnsortedSegmentMin", "unsorted_segment_min"),
+                       ("UnsortedSegmentProd",
+                        "unsorted_segment_prod")]:
+        def _useg(ctx, _o=our):
+            return ctx.op(_o, ctx.inputs[:2],
+                          num_segments=int(ctx.static_np(2)))
+        R(tf_op)(_useg)
+
+    @R("MatrixBandPart")
+    def _band_part(ctx):
+        return ctx.op("matrix_band_part", ctx.inputs[:1],
+                      num_lower=int(ctx.static_np(1)),
+                      num_upper=int(ctx.static_np(2)))
+
+    @R("MatrixInverse")
+    def _matrix_inverse(ctx):
+        if ctx.attr("adjoint", False):
+            raise TFImportError(
+                f"{ctx.node.name}: MatrixInverse adjoint=True "
+                "not supported")
+        return ctx.op("matrix_inverse", ctx.inputs[:1])
+
+    @R("LinSpace")
+    def _linspace(ctx):
+        return ctx.op("linspace", [],
+                      start=float(ctx.static_np(0)),
+                      stop=float(ctx.static_np(1)),
+                      num=int(ctx.static_np(2)))
+
+    @R("Diag")
+    def _tf_diag(ctx):
+        p = ctx.avals.get(ctx.inputs[0].name) if ctx.avals else None
+        if p is None or len(p[0].shape) != 1:
+            raise TFImportError(
+                f"{ctx.node.name}: Diag mapped for rank-1 input only")
+        return ctx.op("matrix_diag", ctx.inputs[:1])
+
+    @R("DiagPart")
+    def _tf_diag_part(ctx):
+        p = ctx.avals.get(ctx.inputs[0].name) if ctx.avals else None
+        if p is None or len(p[0].shape) != 2:
+            raise TFImportError(
+                f"{ctx.node.name}: DiagPart mapped for rank-2 input "
+                "only")
+        return ctx.op("diag_part", ctx.inputs[:1])
+
+    @R("Bincount", "DenseBincount")
+    def _bincount(ctx):
+        if ctx.attr("binary_output", False):
+            raise TFImportError(
+                f"{ctx.node.name}: binary_output bincount not mapped")
+        size = int(ctx.static_np(1))
+        # weights may be RUNTIME-computed (only size must be static);
+        # the no-weights case is an EMPTY tensor, detected by shape —
+        # via the aval (works for traced tensors) or the const value
+        has_w = False
+        if len(ctx.inputs) > 2 and ctx.inputs[2] is not None:
+            wv = ctx._static[2]
+            p = ctx.avals.get(ctx.inputs[2].name) if ctx.avals else None
+            if p is not None:
+                has_w = int(np.prod(p[0].shape, dtype=np.int64)) > 0
+            elif wv is not None:
+                has_w = np.asarray(wv).size > 0
+            else:
+                # no static value and no aval: runtime-computed weights.
+                # The NO-weights encoding is always a constant empty
+                # tensor (caught above), so unknown => real weights.
+                has_w = True
+        ins = [ctx.inputs[0]] + ([ctx.inputs[2]] if has_w else [])
+        return ctx.op("bincount", ins, minlength=size)
+
+    @R("Bucketize")
+    def _bucketize(ctx):
+        bnd = np.asarray([float(v) for v in ctx.attr("boundaries")],
+                         np.float32)
+        c = ctx.sd.constant(f"{ctx.node.name}_boundaries", bnd)
+        return ctx.op("searchsorted", [c, ctx.inputs[0]], side="right")
+
+
 _register_standard_mappers()
+_register_extended_mappers()
 
 
 # The ops these mappers emit by TF attr convention (tf_strided_slice,
@@ -1073,7 +1262,9 @@ class TFGraphMapper:
         _PE_OPS = {"Shape", "Enter", "RefEnter", "While",
                    "StatelessWhile", "If", "StatelessIf",
                    "PartitionedCall", "StatefulPartitionedCall",
-                   "Switch", "Merge", "StridedSlice"}
+                   "Switch", "Merge", "StridedSlice",
+                   # aval-consuming mappers
+                   "Bincount", "DenseBincount", "Diag", "DiagPart"}
         all_nodes = list(gd.node)
         lib_nodes = [nd for f in library.values() for nd in f.node_def]
         needs_pe = any(n.op in _PE_OPS for n in all_nodes) or \
