@@ -563,6 +563,171 @@ def _lrn(ctx):
     return ctx.to_nchw(out)
 
 
+# ------------------------------------------------------- recurrent ops
+# (ONNX LSTM/GRU/RNN — what torch.onnx.export emits for nn.LSTM/GRU/RNN;
+# reference: samediff-import-onnx maps these onto nd4j's lstmLayer)
+def _rnn_setup(ctx, n_gates, hidden):
+    """Common decode: batch-major x, per-direction packed weights.
+    ONNX layout: X [T,N,in]; W [dirs, gates*H, in]; R [dirs, gates*H,
+    H]; B [dirs, 2*gates*H] (Wb ++ Rb). Weights must be constants
+    (true for every real exporter; re-packed at import time)."""
+    if float(ctx.attr("clip", 0.0) or 0.0) > 0.0:
+        raise OnnxImportError(
+            f"{ctx.node.name}: cell-clipping (clip attr) not mapped")
+    W = ctx.static_np(1)
+    R = ctx.static_np(2)
+    dirs = W.shape[0]
+    if len(ctx.inputs) > 3 and ctx.inputs[3] is not None:
+        B = ctx.static_np(3)   # present-but-runtime bias must be LOUD,
+        # not silently zeroed; static_np raises for non-constants
+    else:
+        B = np.zeros((dirs, 2 * n_gates * hidden), np.float32)
+    if len(ctx.inputs) > 4 and ctx.inputs[4] is not None:
+        sl = ctx.maybe_static(4)
+        p = ctx.avals.get(ctx.inputs[0].name) if ctx.avals else None
+        t = int(p[0].shape[0]) if p is not None and p[0].shape else None
+        if sl is None or t is None or \
+                (sl.size and np.any(sl != t)):
+            raise OnnxImportError(
+                f"{ctx.node.name}: sequence_lens shorter than the "
+                f"sequence (T={t}) not supported (static full-length "
+                "only)")
+    x = ctx.op("transpose", ctx.inputs[:1], permute=[1, 0, 2])
+    return x, W, R, B, dirs
+
+
+def _rnn_state(ctx, input_idx, d):
+    """initial_h/initial_c [dirs, N, H] -> direction d's [N, H]."""
+    if len(ctx.inputs) <= input_idx or ctx.inputs[input_idx] is None:
+        return None
+    idx = ctx.sd.constant(f"{ctx.node.output[0]}_d{input_idx}_{d}",
+                          np.int32(d))
+    return ctx.op("gather", [ctx.inputs[input_idx], idx], axis=0)
+
+
+def _rnn_outputs(ctx, ys_list, states):
+    """Per-direction [N,T,H] outputs -> ONNX Y [T, dirs, N, H] (+
+    state tensors [dirs, N, H] each)."""
+    ys_t = [ctx.op("transpose", [y], permute=[1, 0, 2])
+            for y in ys_list]
+    y = ctx.op("stack", ys_t, axis=1)
+    outs = [y]
+    for group in states:
+        outs.append(ctx.op("stack", group, axis=0))
+    return tuple(outs)
+
+
+@R("LSTM")
+def _onnx_lstm(ctx):
+    hs = int(ctx.attr("hidden_size"))
+    acts = ctx.attr("activations")
+    if acts and list(acts) not in (
+            ["Sigmoid", "Tanh", "Tanh"],
+            ["Sigmoid", "Tanh", "Tanh"] * 2):
+        raise OnnxImportError(
+            f"{ctx.node.name}: non-default LSTM activations {acts}")
+    direction = ctx.attr("direction", "forward")
+    x, W, R, B, dirs = _rnn_setup(ctx, 4, hs)
+    order = [0, 2, 3, 1]          # ONNX iofc -> our i,f,g(=c),o
+    ys_list, h_list, c_list = [], [], []
+    for d in range(dirs):
+        w_ih = W[d].reshape(4, hs, -1)[order].reshape(4 * hs, -1).T
+        w_hh = R[d].reshape(4, hs, hs)[order].reshape(4 * hs, hs).T
+        b = (B[d][:4 * hs] + B[d][4 * hs:]) \
+            .reshape(4, hs)[order].reshape(-1)
+        base = f"{ctx.node.output[0]}_d{d}"
+        wv = ctx.sd.constant(base + "_wih", w_ih.astype(np.float32))
+        rv = ctx.sd.constant(base + "_whh", w_hh.astype(np.float32))
+        bv = ctx.sd.constant(base + "_b", b.astype(np.float32))
+        ins = [x, wv, rv, bv]
+        h0 = _rnn_state(ctx, 5, d)
+        c0 = _rnn_state(ctx, 6, d)
+        # ONNX allows either state alone (the other defaults to zeros)
+        if h0 is not None or c0 is not None:
+            if h0 is None:
+                h0 = ctx.op("zeros_like", [c0])
+            if c0 is None:
+                c0 = ctx.op("zeros_like", [h0])
+            ins += [h0, c0]
+        reverse = (direction == "reverse") or d == 1
+        ys, hT, cT = ctx.op("lstm_seq", ins, n_out=3, reverse=reverse)
+        ys_list.append(ys)
+        h_list.append(hT)
+        c_list.append(cT)
+    return _rnn_outputs(ctx, ys_list, [h_list, c_list])
+
+
+@R("GRU")
+def _onnx_gru(ctx):
+    hs = int(ctx.attr("hidden_size"))
+    acts = ctx.attr("activations")
+    if acts and list(acts) not in (["Sigmoid", "Tanh"],
+                                   ["Sigmoid", "Tanh"] * 2):
+        raise OnnxImportError(
+            f"{ctx.node.name}: non-default GRU activations {acts}")
+    if not int(ctx.attr("linear_before_reset", 0)):
+        raise OnnxImportError(
+            f"{ctx.node.name}: GRU linear_before_reset=0 not mapped "
+            "(torch exports 1; the reset-before form differs)")
+    direction = ctx.attr("direction", "forward")
+    x, W, R, B, dirs = _rnn_setup(ctx, 3, hs)
+    order = [1, 0, 2]             # ONNX z,r,h -> our r,z,n
+    ys_list, h_list = [], []
+    for d in range(dirs):
+        w_ih = W[d].reshape(3, hs, -1)[order].reshape(3 * hs, -1).T
+        w_hh = R[d].reshape(3, hs, hs)[order].reshape(3 * hs, hs).T
+        wb = B[d][:3 * hs].reshape(3, hs)[order].reshape(-1)
+        rb = B[d][3 * hs:].reshape(3, hs)[order].reshape(-1)
+        base = f"{ctx.node.output[0]}_d{d}"
+        ins = [x,
+               ctx.sd.constant(base + "_wih", w_ih.astype(np.float32)),
+               ctx.sd.constant(base + "_whh", w_hh.astype(np.float32)),
+               ctx.sd.constant(base + "_b", wb.astype(np.float32)),
+               ctx.sd.constant(base + "_rb", rb.astype(np.float32))]
+        h0 = _rnn_state(ctx, 5, d)
+        if h0 is not None:
+            ins.append(h0)
+        reverse = (direction == "reverse") or d == 1
+        ys, hT = ctx.op("gru_seq", ins, n_out=2, reverse=reverse)
+        ys_list.append(ys)
+        h_list.append(hT)
+    return _rnn_outputs(ctx, ys_list, [h_list])
+
+
+@R("RNN")
+def _onnx_rnn(ctx):
+    hs = int(ctx.attr("hidden_size"))
+    acts = ctx.attr("activations")
+    if acts and list(acts) not in (["Tanh"], ["Tanh", "Tanh"]):
+        raise OnnxImportError(
+            f"{ctx.node.name}: RNN activation {acts} not mapped "
+            "(Tanh only)")
+    direction = ctx.attr("direction", "forward")
+    x, W, R, B, dirs = _rnn_setup(ctx, 1, hs)
+    ys_list, h_list = [], []
+    for d in range(dirs):
+        w_ih = W[d].T
+        w_hh = R[d].T
+        b = B[d][:hs] + B[d][hs:]
+        base = f"{ctx.node.output[0]}_d{d}"
+        rev = (direction == "reverse") or d == 1
+        xs = ctx.op("reverse", [x], dimensions=[1]) if rev else x
+        ins = [xs,
+               ctx.sd.constant(base + "_wih", w_ih.astype(np.float32)),
+               ctx.sd.constant(base + "_whh", w_hh.astype(np.float32)),
+               ctx.sd.constant(base + "_b", b.astype(np.float32))]
+        h0 = _rnn_state(ctx, 5, d)
+        if h0 is not None:
+            ins.append(h0)
+        ys, hT = ctx.op("simple_rnn_layer", ins, n_out=2)
+        if rev:
+            # outputs must align with INPUT time order
+            ys = ctx.op("reverse", [ys], dimensions=[1])
+        ys_list.append(ys)
+        h_list.append(hT)
+    return _rnn_outputs(ctx, ys_list, [h_list])
+
+
 @R("LayerNormalization")
 def _layer_norm(ctx):
     x, scale = ctx.inputs[0], ctx.inputs[1]

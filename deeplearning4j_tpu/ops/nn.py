@@ -570,6 +570,29 @@ def gru_layer(x, w_ih, w_hh, b, h0=None, rb=None):
     return ys.transpose(1, 0, 2), hT
 
 
+@register_op("lstm_seq")
+def lstm_seq(x, w_ih, w_hh, b, h0=None, c0=None, reverse=False):
+    """lstm_layer with a FLAT (ys, hT, cT) return — graph executors
+    (SameDiff/_build_fn, the ONNX LSTM mapper) need flat multi-output
+    ops, not nested tuples."""
+    ys, (hT, cT) = lstm_layer(x, w_ih, w_hh, b, h0=h0, c0=c0,
+                              reverse=reverse)
+    return ys, hT, cT
+
+
+@register_op("gru_seq")
+def gru_seq(x, w_ih, w_hh, b, rb, h0=None, reverse=False):
+    """gru_layer with rb POSITIONAL and a reverse flag — the argument
+    shape graph executors need (the ONNX GRU mapper can then pass the
+    recurrent bias without an initial state)."""
+    if reverse:
+        x = jnp.flip(x, axis=1)
+    ys, hT = gru_layer(x, w_ih, w_hh, b, h0=h0, rb=rb)
+    if reverse:
+        ys = jnp.flip(ys, axis=1)
+    return ys, hT
+
+
 @register_op("simple_rnn_layer")
 def simple_rnn_layer(x, w_ih, w_hh, b, h0=None, activation=jnp.tanh):
     n, t, _ = x.shape

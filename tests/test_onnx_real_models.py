@@ -178,3 +178,69 @@ class TestRealExportedModels:
                 mod.running_var.uniform_(0.6, 1.4)
         x = torch.randn(2, 3, 32, 32)
         _golden(model, x, rtol=2e-4, atol=2e-4)
+
+
+class TestRecurrentOperators:
+    """ONNX LSTM/GRU/RNN operators as torch.onnx.export actually emits
+    them (time-major X, packed iofc/zrh gate blocks, Expand-ed initial
+    states) — golden vs torch (reference: samediff-import-onnx onto
+    nd4j lstmLayer). Exercises lstm_seq / gru_seq backing ops."""
+
+    def _golden_rnn(self, mod, x, rtol=2e-4, atol=2e-4):
+        mod.eval()
+        path = _export(mod, (x,))
+        with torch.no_grad():
+            ref, _ = mod(x)
+        sd = OnnxImport.importGraph(path)
+        phs = [v.name for v in sd.variables()
+               if v.vtype.value == "PLACEHOLDER"]
+        out_name = sd._ops[-1].outputs[0]
+        got = np.asarray(sd.output({phs[0]: x.numpy()},
+                                   [out_name])[out_name])
+        np.testing.assert_allclose(got, ref.numpy(), rtol=rtol,
+                                   atol=atol)
+
+    def test_lstm_forward(self):
+        torch.manual_seed(3)
+        self._golden_rnn(nn.LSTM(5, 7, batch_first=True),
+                         torch.randn(2, 6, 5))
+
+    def test_lstm_bidirectional(self):
+        torch.manual_seed(4)
+        self._golden_rnn(
+            nn.LSTM(5, 7, batch_first=True, bidirectional=True),
+            torch.randn(2, 6, 5))
+
+    def test_gru_forward(self):
+        torch.manual_seed(5)
+        self._golden_rnn(nn.GRU(5, 7, batch_first=True),
+                         torch.randn(2, 6, 5))
+
+    def test_gru_bidirectional(self):
+        torch.manual_seed(6)
+        self._golden_rnn(
+            nn.GRU(5, 7, batch_first=True, bidirectional=True),
+            torch.randn(2, 6, 5))
+
+    def test_rnn_tanh_forward(self):
+        torch.manual_seed(7)
+        self._golden_rnn(nn.RNN(5, 7, batch_first=True),
+                         torch.randn(2, 6, 5))
+
+    def test_lstm_classifier_end_to_end(self):
+        """A realistic exported model: LSTM backbone + dense head."""
+        torch.manual_seed(8)
+
+        class M(nn.Module):
+            def __init__(self):
+                super().__init__()
+                self.lstm = nn.LSTM(6, 12, batch_first=True)
+                self.head = nn.Linear(12, 4)
+
+            def forward(self, x):
+                y, _ = self.lstm(x)
+                return self.head(y[:, -1])
+
+        m = M()
+        x = torch.randn(3, 10, 6)
+        _golden(m, x, rtol=2e-4, atol=2e-4)
