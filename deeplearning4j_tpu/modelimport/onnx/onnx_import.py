@@ -574,6 +574,13 @@ def _rnn_setup(ctx, n_gates, hidden):
     if float(ctx.attr("clip", 0.0) or 0.0) > 0.0:
         raise OnnxImportError(
             f"{ctx.node.name}: cell-clipping (clip attr) not mapped")
+    if int(ctx.attr("layout", 0)):
+        raise OnnxImportError(
+            f"{ctx.node.name}: layout=1 (batch-major) not mapped "
+            "(torch exports layout=0)")
+    if int(ctx.attr("input_forget", 0)):
+        raise OnnxImportError(
+            f"{ctx.node.name}: input_forget coupling not mapped")
     W = ctx.static_np(1)
     R = ctx.static_np(2)
     dirs = W.shape[0]
@@ -585,7 +592,7 @@ def _rnn_setup(ctx, n_gates, hidden):
     if len(ctx.inputs) > 4 and ctx.inputs[4] is not None:
         sl = ctx.maybe_static(4)
         p = ctx.avals.get(ctx.inputs[0].name) if ctx.avals else None
-        t = int(p[0].shape[0]) if p is not None and p[0].shape else None
+        t = int(p.shape[0]) if p is not None and p.shape else None
         if sl is None or t is None or \
                 (sl.size and np.any(sl != t)):
             raise OnnxImportError(
@@ -738,14 +745,305 @@ def _layer_norm(ctx):
 
 
 # ---------------------------------------------------------------- import
+def _propagate_onnx(sd, const_vals, avals, from_idx: int) -> None:
+    """Shape/dtype eval for ops emitted since from_idx, plus eager
+    folding of small integer results whose inputs are all import-time
+    constants (the exporter's Shape->Gather->Concat reshape subgraphs
+    become consts Reshape can consume)."""
+    import jax
+
+    from deeplearning4j_tpu.ops.registry import get_op
+
+    for opnode in sd._ops[from_idx:]:
+        fn = get_op(opnode.op_name)
+        ins = []
+        for iname in opnode.inputs:
+            if iname in avals:
+                ins.append(avals[iname])
+            elif iname in sd._arrays:
+                a = sd._arrays[iname]
+                ins.append(jax.ShapeDtypeStruct(tuple(a.shape), a.dtype))
+            else:
+                ins = None
+                break
+        if ins is None:
+            continue
+        try:
+            out = jax.eval_shape(
+                lambda *a: fn(*a, **opnode.attrs), *ins)
+        except Exception:
+            continue
+        outs = list(out) if isinstance(out, (list, tuple)) else [out]
+        for k, on in enumerate(opnode.outputs):
+            if k < len(outs):
+                avals[on] = outs[k]
+        if (len(opnode.outputs) == 1
+                and np.issubdtype(outs[0].dtype, np.integer)
+                and int(np.prod(outs[0].shape, dtype=np.int64)) <= 256):
+            vals = []
+            for iname in opnode.inputs:
+                v = const_vals.get(iname)
+                if v is None and iname in sd._arrays:
+                    v = np.asarray(sd._arrays[iname])
+                if v is None:
+                    vals = None
+                    break
+                vals.append(v)
+            if vals is not None:
+                try:
+                    # x64 on: jnp would truncate the INT64_MAX
+                    # slice-end sentinels flowing through these folds
+                    # to int32 (-1 = drop-last-element)
+                    with jax.enable_x64():
+                        const_vals[opnode.outputs[0]] = \
+                            np.asarray(fn(*vals, **opnode.attrs))
+                except Exception:
+                    pass
+
+
+def _walk_onnx_nodes(sd, nodes, tensors, const_vals, avals,
+                     resolve_outer=None) -> None:
+    """The node walk, reusable for the top graph AND for If/Loop
+    sub-graphs (resolve_outer supplies outer-scope captures — ONNX
+    sub-graphs reference enclosing tensors by name)."""
+    import jax
+
+    for node in nodes:
+        ins: List[Optional[SDVariable]] = []
+        statics: List[Optional[np.ndarray]] = []
+        for ref in node.input:
+            if ref == "":
+                ins.append(None)
+                statics.append(None)
+                continue
+            if ref not in tensors and resolve_outer is not None:
+                v = resolve_outer(ref)
+                if v is not None:
+                    tensors[ref] = v
+            if ref not in tensors:
+                raise OnnxImportError(
+                    f"node {node.name or node.op_type}: unresolved "
+                    f"input {ref!r}")
+            ins.append(tensors[ref])
+            statics.append(const_vals.get(ref))
+        if node.op_type in ("If", "Loop"):
+            handler = _handle_if if node.op_type == "If" else _handle_loop
+            out = handler(sd, node, tensors, const_vals, avals, ins,
+                          resolve_outer)
+            n_ops_before = len(sd._ops)
+        else:
+            mapper = OnnxOpMappingRegistry.get(node.op_type)
+            n_ops_before = len(sd._ops)
+            out = mapper(_Ctx(sd, node, ins, statics, avals=avals))
+        outs = out if isinstance(out, tuple) else (out,)
+        for name, v in zip(node.output, outs):
+            if v.name != name:
+                v.rename(name)
+            tensors[name] = v
+            # track import-time-computable constants: Constant nodes
+            # AND constants materialized by mappers (Shape). Constant
+            # values come from the RAW proto attribute — sd._arrays
+            # holds jnp arrays, which truncate int64 to int32 (x64
+            # off) and would turn INT64_MAX slice sentinels into -1
+            if node.op_type == "Constant":
+                val = np.asarray(node.attributes.get("value"))
+            elif v.name in sd._arrays:
+                val = np.asarray(sd._arrays[v.name])
+            else:
+                val = None
+            if val is not None:
+                const_vals.setdefault(name, val)
+                avals[v.name] = jax.ShapeDtypeStruct(
+                    tuple(val.shape), val.dtype)
+        _propagate_onnx(sd, const_vals, avals, n_ops_before)
+
+
+def _import_onnx_subgraph(g, outer, capture_index, capture_base,
+                          formal_start=0, parent_resolve=None,
+                          build_dict=True):
+    """Import a GraphProto as a serialized sub-graph dict.
+
+    outer = (tensors, const_vals) of the ENCLOSING scope; referenced
+    outer names either bake in (constants) or become capture
+    placeholders at slot capture_base + capture_index[name] — the
+    SHARED capture_index lets If's two branches agree on operand
+    order. Returns (dict, sub_tensors map)."""
+    from deeplearning4j_tpu.autodiff.control_flow import (
+        ARG_PREFIX, subgraph_to_dict,
+    )
+
+    o_tensors, o_consts = outer
+    sub = SameDiff.create()
+    tensors: Dict[str, SDVariable] = {}
+    const_vals: Dict[str, np.ndarray] = {}
+    avals: Dict[str, Any] = {}
+    for k, vi in enumerate(g.inputs):
+        tensors[vi.name] = sub.placeholder(
+            f"{ARG_PREFIX}{formal_start + k}")
+    for init in g.initializers:
+        arr = init.to_numpy()
+        const_vals[init.name] = arr
+        tensors[init.name] = sub.constant(init.name, arr)
+
+    def resolve_outer(ref):
+        if ref not in o_tensors and ref not in o_consts \
+                and parent_resolve is not None:
+            # grand-outer reference (If inside Loop etc.): let the
+            # enclosing scope capture it first, then capture from there
+            pv = parent_resolve(ref)
+            if pv is not None:
+                o_tensors[ref] = pv
+        if ref in o_consts:
+            # outer constants bake in, so static-operand mappers
+            # (axes, shapes) keep working inside the sub-graph
+            arr = np.asarray(o_consts[ref])
+            const_vals[ref] = arr
+            return sub.constant(ref, arr)
+        if ref in o_tensors:
+            if ref not in capture_index:
+                capture_index[ref] = len(capture_index)
+            return sub.placeholder(
+                f"{ARG_PREFIX}{capture_base + capture_index[ref]}")
+        return None
+
+    _walk_onnx_nodes(sub, g.nodes, tensors, const_vals, avals,
+                     resolve_outer)
+    outs = []
+    for o in g.outputs:
+        if o.name not in tensors:
+            raise OnnxImportError(
+                f"sub-graph output {o.name!r} not produced")
+        outs.append(tensors[o.name].name)
+    if not build_dict:
+        return None, (sub, tensors)
+    d = subgraph_to_dict(sub, outs, capture_base + len(capture_index))
+    return d, (sub, tensors)
+
+
+def _handle_if(sd, node, tensors, const_vals, avals, ins,
+               resolve_outer):
+    """ONNX If → if_cond: branches have no formal inputs; every outer
+    reference becomes a shared capture operand."""
+    then_g = node.attributes.get("then_branch")
+    else_g = node.attributes.get("else_branch")
+    if then_g is None or else_g is None:
+        raise OnnxImportError(f"{node.name or 'If'}: missing branch")
+    caps: Dict[str, int] = {}
+    outer = (tensors, const_vals)
+    then_d, _ = _import_onnx_subgraph(then_g, outer, caps,
+                                      capture_base=0,
+                                      parent_resolve=resolve_outer)
+    else_d, _ = _import_onnx_subgraph(else_g, outer, caps,
+                                      capture_base=0,
+                                      parent_resolve=resolve_outer)
+    then_d["n_in"] = else_d["n_in"] = len(caps)
+    ordered = sorted(caps, key=caps.get)
+    operands = [ins[0].name] + [tensors[n].name for n in ordered]
+    return sd._op("if_cond", operands, n_out=len(node.output),
+                  name=node.output[0], true_graph=then_d,
+                  false_graph=else_d)
+
+
+def _handle_loop(sd, node, tensors, const_vals, avals, ins,
+                 resolve_outer):
+    """ONNX Loop → while_loop. State = (iter, cond, carried...,
+    captures..., M). Scan outputs (per-iteration accumulation rows
+    beyond the carried values) are not mapped — loud error."""
+    from deeplearning4j_tpu.autodiff.control_flow import (
+        ARG_PREFIX, subgraph_to_dict,
+    )
+
+    body_g = node.attributes.get("body")
+    if body_g is None:
+        raise OnnxImportError(f"{node.name or 'Loop'}: missing body")
+    carried = ins[2:]
+    n_carried = len(carried)
+    if len(node.output) > n_carried:
+        raise OnnxImportError(
+            f"{node.name or 'Loop'}: scan outputs not supported "
+            f"({len(node.output)} outputs > {n_carried} carried)")
+    n_formal = len(body_g.inputs)          # iter, cond, carried...
+    if n_formal != 2 + n_carried:
+        raise OnnxImportError(
+            f"{node.name or 'Loop'}: body takes {n_formal} inputs, "
+            f"expected {2 + n_carried}")
+    caps: Dict[str, int] = {}
+    _, (sub, sub_tensors) = _import_onnx_subgraph(
+        body_g, (tensors, const_vals), caps, capture_base=n_formal,
+        parent_resolve=resolve_outer, build_dict=False)
+    n_caps = len(caps)
+    m_slot = n_formal + n_caps             # trip count rides last
+    n_state = m_slot + 1
+
+    # body must return the FULL state: iter+1, cond_out, carried_out,
+    # captures (pass-through), M (pass-through)
+    it_ph = sub._vars[f"{ARG_PREFIX}0"]
+    one = sub.constant("loop_one", np.int32(1))
+    it_next = sub._op("add", [it_ph.name, one.name])
+    body_outs = [it_next.name]
+    for o in body_g.outputs[:1 + n_carried]:
+        if o.name not in sub_tensors:
+            raise OnnxImportError(
+                f"Loop body output {o.name!r} not produced")
+        body_outs.append(sub_tensors[o.name].name)
+    for slot in range(n_formal, n_state):
+        phn = f"{ARG_PREFIX}{slot}"
+        if phn not in sub._vars:
+            sub.placeholder(phn)
+        body_outs.append(phn)
+    body_full = subgraph_to_dict(sub, body_outs, n_state)
+
+    # cond: iter < M (when given) AND carried cond (when given)
+    csub = SameDiff.create()
+    c_it = csub.placeholder(f"{ARG_PREFIX}0")
+    c_cond = csub.placeholder(f"{ARG_PREFIX}1")
+    have_m = ins[0] is not None
+    have_cond = ins[1] is not None
+    if have_m:
+        c_m = csub.placeholder(f"{ARG_PREFIX}{m_slot}")
+        lt = csub._op("lt", [c_it.name, c_m.name])
+    if have_m and have_cond:
+        pred = csub._op("logical_and", [lt.name, c_cond.name])
+    elif have_m:
+        pred = lt
+    elif have_cond:
+        pred = csub._op("identity", [c_cond.name])
+    else:
+        raise OnnxImportError(
+            f"{node.name or 'Loop'}: neither trip count nor condition")
+    cond_full = subgraph_to_dict(csub, [pred.name], n_state)
+
+    zero = sd.constant(f"{node.output[0]}_it0", np.int32(0))
+    cond0 = ins[1] if have_cond else sd.constant(
+        f"{node.output[0]}_cond0", np.bool_(True))
+    m_opnd = ins[0] if have_m else sd.constant(
+        f"{node.output[0]}_m0", np.int32(0))
+    if have_m:
+        mv = const_vals.get(node.input[0])
+        if mv is not None and int(np.asarray(mv)) >= 2 ** 31 - 1:
+            # "run forever" trip count (torch exports INT64_MAX for
+            # cond-driven while loops) — int32 truncation would turn
+            # it into -1 and the loop would never run
+            m_opnd = sd.constant(f"{node.output[0]}_minf",
+                                 np.int32(2 ** 31 - 2))
+    operands = ([zero.name, cond0.name]
+                + [v.name for v in carried]
+                + [tensors[n].name
+                   for n in sorted(caps, key=caps.get)]
+                + [m_opnd.name])
+    out = sd._op("while_loop", operands, n_out=n_state,
+                 name=node.output[0] + "_state", cond_graph=cond_full,
+                 body_graph=body_full)
+    out = out if isinstance(out, tuple) else (out,)
+    return tuple(out[2 + i] for i in range(len(node.output)))
+
+
 class OnnxImport:
     """Entry point (reference: OnnxFrameworkImporter#runImport)."""
 
     @staticmethod
     def importGraph(model_or_path) -> SameDiff:
         import jax
-
-        from deeplearning4j_tpu.ops.registry import get_op
 
         model = OnnxImport._as_model(model_or_path)
         g: GraphProto = model.graph
@@ -755,7 +1053,7 @@ class OnnxImport:
         # var name -> ShapeDtypeStruct: everything is static (no
         # dynamic_axes), so one abstract eval per op gives Shape
         # folding + int-subgraph constant folding for free
-        avals: Dict[str, "jax.ShapeDtypeStruct"] = {}
+        avals: Dict[str, Any] = {}
 
         for init in g.initializers:
             arr = init.to_numpy()
@@ -776,99 +1074,7 @@ class OnnxImport:
                 avals[vi.name] = jax.ShapeDtypeStruct(
                     tuple(shape), np.dtype(dt))
 
-        def _propagate(from_idx: int) -> None:
-            """Shape/dtype eval for ops emitted since from_idx, plus
-            eager folding of small integer results whose inputs are all
-            import-time constants (the exporter's Shape->Gather->Concat
-            reshape subgraphs become consts Reshape can consume)."""
-            for opnode in sd._ops[from_idx:]:
-                fn = get_op(opnode.op_name)
-                ins = []
-                for iname in opnode.inputs:
-                    if iname in avals:
-                        ins.append(avals[iname])
-                    elif iname in sd._arrays:
-                        a = sd._arrays[iname]
-                        ins.append(jax.ShapeDtypeStruct(
-                            tuple(a.shape), a.dtype))
-                    else:
-                        ins = None
-                        break
-                if ins is None:
-                    continue
-                try:
-                    out = jax.eval_shape(
-                        lambda *a: fn(*a, **opnode.attrs), *ins)
-                except Exception:
-                    continue
-                outs = list(out) if isinstance(out, (list, tuple)) \
-                    else [out]
-                for k, on in enumerate(opnode.outputs):
-                    if k < len(outs):
-                        avals[on] = outs[k]
-                if (len(opnode.outputs) == 1
-                        and np.issubdtype(outs[0].dtype, np.integer)
-                        and int(np.prod(outs[0].shape,
-                                        dtype=np.int64)) <= 256):
-                    vals = []
-                    for iname in opnode.inputs:
-                        v = const_vals.get(iname)
-                        if v is None and iname in sd._arrays:
-                            v = np.asarray(sd._arrays[iname])
-                        if v is None:
-                            vals = None
-                            break
-                        vals.append(v)
-                    if vals is not None:
-                        try:
-                            # x64 on: jnp would truncate the INT64_MAX
-                            # slice-end sentinels flowing through these
-                            # folds to int32 (-1 = drop-last-element)
-                            with jax.enable_x64():
-                                const_vals[opnode.outputs[0]] = \
-                                    np.asarray(fn(*vals, **opnode.attrs))
-                        except Exception:
-                            pass
-
-        for node in g.nodes:
-            ins: List[Optional[SDVariable]] = []
-            statics: List[Optional[np.ndarray]] = []
-            for ref in node.input:
-                if ref == "":
-                    ins.append(None)
-                    statics.append(None)
-                    continue
-                if ref not in tensors:
-                    raise OnnxImportError(
-                        f"node {node.name or node.op_type}: unresolved "
-                        f"input {ref!r}")
-                ins.append(tensors[ref])
-                statics.append(const_vals.get(ref))
-            mapper = OnnxOpMappingRegistry.get(node.op_type)
-            n_ops_before = len(sd._ops)
-            out = mapper(_Ctx(sd, node, ins, statics, avals=avals))
-            outs = out if isinstance(out, tuple) else (out,)
-            for name, v in zip(node.output, outs):
-                if v.name != name:
-                    v.rename(name)
-                tensors[name] = v
-                # track import-time-computable constants: Constant
-                # nodes AND constants materialized by mappers (Shape).
-                # Constant values come from the RAW proto attribute —
-                # sd._arrays holds jnp arrays, which truncate int64 to
-                # int32 (x64 off) and would turn INT64_MAX slice-end
-                # sentinels into -1
-                if node.op_type == "Constant":
-                    val = np.asarray(node.attributes.get("value"))
-                elif v.name in sd._arrays:
-                    val = np.asarray(sd._arrays[v.name])
-                else:
-                    val = None
-                if val is not None:
-                    const_vals.setdefault(name, val)
-                    avals[v.name] = jax.ShapeDtypeStruct(
-                        tuple(val.shape), val.dtype)
-            _propagate(n_ops_before)
+        _walk_onnx_nodes(sd, g.nodes, tensors, const_vals, avals)
         return sd
 
     @staticmethod

@@ -120,14 +120,17 @@ class TensorProto:
 @dataclasses.dataclass
 class AttributeProto:
     name: str = ""
-    type: int = 0        # 1=FLOAT 2=INT 3=STRING 4=TENSOR 6=FLOATS 7=INTS 8=STRINGS
+    type: int = 0        # 1=FLOAT 2=INT 3=STRING 4=TENSOR 5=GRAPH
+    #                      6=FLOATS 7=INTS 8=STRINGS 10=GRAPHS
     f: float = 0.0
     i: int = 0
     s: bytes = b""
     t: Optional[TensorProto] = None
+    g: Optional["GraphProto"] = None
     floats: List[float] = dataclasses.field(default_factory=list)
     ints: List[int] = dataclasses.field(default_factory=list)
     strings: List[bytes] = dataclasses.field(default_factory=list)
+    graphs: List["GraphProto"] = dataclasses.field(default_factory=list)
 
     def value(self) -> Any:
         if self.type == 1:
@@ -138,12 +141,16 @@ class AttributeProto:
             return self.s.decode(errors="replace")
         if self.type == 4:
             return self.t.to_numpy() if self.t is not None else None
+        if self.type == 5:
+            return self.g
         if self.type == 6:
             return list(self.floats)
         if self.type == 7:
             return list(self.ints)
         if self.type == 8:
             return [s.decode(errors="replace") for s in self.strings]
+        if self.type == 10:
+            return list(self.graphs)
         return None
 
 
@@ -226,6 +233,10 @@ def _decode_attribute(buf: bytes) -> AttributeProto:
             a.s = v
         elif field == 5:
             a.t = _decode_tensor(v)
+        elif field == 6:
+            a.g = _decode_graph(v)      # sub-graph (If/Loop/Scan)
+        elif field == 11:
+            a.graphs.append(_decode_graph(v))
         elif field == 7:
             a.floats.extend(struct.unpack(f"<{len(v) // 4}f", v)
                             if wt == 2 else (struct.unpack("<f", v)[0],))
@@ -243,6 +254,10 @@ def _decode_attribute(buf: bytes) -> AttributeProto:
             a.type = 6
         elif a.t is not None:
             a.type = 4
+        elif a.g is not None:
+            a.type = 5
+        elif a.graphs:
+            a.type = 10
         elif a.s:
             a.type = 3
     return a
