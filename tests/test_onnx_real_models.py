@@ -292,22 +292,25 @@ class TestOnnxControlFlow:
     cond-driven whiles) — the reference executes these through
     AbstractSession; here they compile into if_cond/while_loop."""
 
-    def _golden_scripted(self, mod, x, rtol=1e-5, atol=1e-6):
-        with torch.no_grad():
-            ref = mod(x).numpy()
+    @staticmethod
+    def _import_scripted(mod, x):
+        """script -> export -> import; returns (sd, model, phs, outs)."""
         m = torch.jit.script(mod)
         m.eval()
         path = _export(m, (x,))
-        from deeplearning4j_tpu.modelimport.onnx.onnx_import import (
-            OnnxImport as OI,
-        )
-        model = OI._as_model(path)
-        sd = OI.importGraph(path)
+        model = OnnxImport._as_model(path)
+        sd = OnnxImport.importGraph(path)
         phs = [v.name for v in sd.variables()
                if v.vtype.value == "PLACEHOLDER"]
-        out_names = [o.name for o in model.graph.outputs]
+        outs = [o.name for o in model.graph.outputs]
+        return sd, model, phs, outs
+
+    def _golden_scripted(self, mod, x, rtol=1e-5, atol=1e-6):
+        with torch.no_grad():
+            ref = mod(x).numpy()
+        sd, model, phs, outs = self._import_scripted(mod, x)
         got = np.asarray(sd.output({phs[0]: x.numpy()},
-                                   out_names)[out_names[0]])
+                                   outs)[outs[0]])
         np.testing.assert_allclose(got, ref, rtol=rtol, atol=atol)
         return model
 
@@ -333,3 +336,21 @@ class TestOnnxControlFlow:
         torch.manual_seed(3)
         self._golden_scripted(ScriptedLoopIf(), torch.randn(2, 3))
         self._golden_scripted(ScriptedLoopIf(), -torch.randn(2, 3).abs())
+
+    def test_control_flow_survives_serde(self, tmp_path):
+        """Nested If-in-Loop save/load round trip: the sub-graph dicts
+        (branches, bodies, captures) must serialize with the graph."""
+        from deeplearning4j_tpu.autodiff.samediff import SameDiff
+
+        torch.manual_seed(9)
+        mod = ScriptedLoopIf()
+        x = torch.randn(2, 3)
+        with torch.no_grad():
+            ref = mod(x).numpy()
+        sd, model, phs, outs = self._import_scripted(mod, x)
+        p = str(tmp_path / "cf.sdnb")
+        sd.save(p)
+        sd2 = SameDiff.load(p)
+        got = np.asarray(sd2.output({phs[0]: x.numpy()},
+                                    outs)[outs[0]])
+        np.testing.assert_allclose(got, ref, rtol=1e-6, atol=1e-6)
