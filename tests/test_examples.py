@@ -45,6 +45,14 @@ class TestExamples:
         import numpy as np
         assert np.isfinite(score)
 
+    def test_tf_import_dynamic_rnn_example(self):
+        pytest.importorskip("tensorflow")
+        # non-default dims: the unit battery already imports the
+        # default-shaped graph, so this run covers a different one
+        # (main() owns the tolerance and raises on divergence)
+        _run("tf_import_dynamic_rnn.py").main(batch=3, seq=8,
+                                              d_in=4, hidden=6)
+
     def test_tf_import_bert_example(self):
         pytest.importorskip("tensorflow")
         pytest.importorskip("transformers")
