@@ -118,10 +118,28 @@ def call_graph(*args, graph=None):
 
 
 @register_op("while_loop")
-def while_loop(*init_vars, cond_graph=None, body_graph=None):
-    """lax.while_loop over serialized cond/body sub-graphs; loop state is
-    the tuple of loop vars (shapes/dtypes must be loop-invariant, the
-    price of on-device looping)."""
+def while_loop(*init_vars, cond_graph=None, body_graph=None,
+               max_trip_count=None):
+    """Loop over serialized cond/body sub-graphs; loop state is the
+    tuple of loop vars (shapes/dtypes must be loop-invariant, the price
+    of on-device looping).
+
+    Two lowerings (reference: the interpreter's TrainingSession
+    differentiates through Enter/Exit/Merge frames uniformly, SURVEY.md
+    §2.12/§3.4 — XLA splits that into two cases):
+
+    - ``max_trip_count`` set (statically-bounded loop — every imported
+      dynamic RNN / ONNX Loop with a constant trip count): a *masked*
+      ``lax.scan`` runs exactly ``max_trip_count`` steps and selects
+      ``body(state)`` vs ``state`` by the live cond each step.
+      Numerically identical to the while form for any loop whose true
+      trip count is ≤ the bound, and — the point — reverse-mode
+      differentiable, so imported loop graphs train.
+    - ``max_trip_count`` None (genuinely dynamic termination):
+      ``lax.while_loop``, which JAX cannot reverse-differentiate;
+      gradients through it raise a loud error at the SameDiff layer
+      (see rewrap_nondiff_loop_error).
+    """
     cf = subgraph_fn(cond_graph)
     bf = subgraph_fn(body_graph)
 
@@ -136,6 +154,378 @@ def while_loop(*init_vars, cond_graph=None, body_graph=None):
         return tuple(jnp.asarray(o).astype(v.dtype)
                      for o, v in zip(out, vs))
 
-    out = lax.while_loop(cond, body, tuple(jnp.asarray(v)
-                                           for v in init_vars))
+    init = tuple(jnp.asarray(v) for v in init_vars)
+    if max_trip_count is not None:
+        # lax.cond, not where-select: dead iterations must not EXECUTE
+        # the body at all — a body like 1/(n-i) is non-finite exactly at
+        # the frozen post-termination state, and where's zero cotangent
+        # times inf would poison the backward pass (0*inf=NaN)
+        def step(vs, _):
+            return lax.cond(cond(vs), body, lambda v: v, vs), None
+
+        out, _ = lax.scan(step, init, None, length=int(max_trip_count))
+    else:
+        out = lax.while_loop(cond, body, init)
     return out[0] if len(out) == 1 else tuple(out)
+
+
+# --------------------------------------------- static trip-count analysis
+# A while loop is reverse-differentiable iff a static iteration bound is
+# known (the masked-scan lowering above). Importers and SameDiff.whileLoop
+# call derive_trip_count at graph-build time, where loop-var init
+# constants are still visible, and stamp the result on the op.
+
+MAX_SCAN_TRIP = 16384  # beyond this, unrolled-scan memory cost beats
+#                        trainability; keep lax.while_loop (inference)
+
+_CMP_OPS = {"lt", "lte", "gt", "gte"}
+_FOLLOW_OPS = {"identity", "cast", "stop_gradient"}
+
+
+def _array_value(spec):
+    if isinstance(spec, dict):
+        if "__ndarray__" in spec:
+            return np.asarray(spec["__ndarray__"],
+                              dtype=np.dtype(spec["dtype"]))
+        if "data" in spec:
+            return np.asarray(spec["data"], dtype=np.dtype(spec["dtype"]))
+        return None
+    return np.asarray(spec)
+
+
+def _sg_producers(d):
+    return {o: od for od in d["ops"] for o in od["outputs"]}
+
+
+def _scalar_const(r):
+    """A ("const", v) resolution holding a size-1 value -> float;
+    anything else -> None. THE single definition of what counts as a
+    scalar constant for the trip-count analysis (bounds, steps,
+    affine offsets) — keep the direct-gate and carried-cond paths
+    consistent by construction."""
+    if r is not None and r[0] == "const" \
+            and np.asarray(r[1]).size == 1:
+        return float(np.asarray(r[1]).reshape(()))
+    return None
+
+
+def _resolve_val(d, producers, name, depth=0, memo=None):
+    """Resolve a sub-graph tensor name to ("arg", i) | ("const", value)
+    | None. Follows value-preserving ops and eagerly folds any op whose
+    inputs all resolve to constants (shape-derived loop bounds).
+    Memoized per name: shared subexpressions (diamond const graphs)
+    would otherwise blow up exponentially."""
+    if memo is None:
+        memo = {}
+    if name in memo:
+        return memo[name]
+    memo[name] = None  # cycle/ depth guard default
+    if depth > 32:
+        return None
+    r = None
+    if name.startswith(ARG_PREFIX):
+        tail = name[len(ARG_PREFIX):]
+        if tail.isdigit():
+            r = ("arg", int(tail))
+    if r is None and name in d["arrays"]:
+        v = _array_value(d["arrays"][name])
+        r = ("const", v) if v is not None else None
+    elif r is None:
+        od = producers.get(name)
+        if od is None:
+            pass
+        elif od["op"] in _FOLLOW_OPS:
+            r = _resolve_val(d, producers, od["inputs"][0], depth + 1,
+                             memo)
+        else:
+            vals = []
+            for i in od["inputs"]:
+                ri = _resolve_val(d, producers, i, depth + 1, memo)
+                if ri is None or ri[0] != "const":
+                    vals = None
+                    break
+                vals.append(ri[1])
+            if vals is not None:
+                from deeplearning4j_tpu.ops.registry import get_op
+                try:
+                    out = get_op(od["op"])(*vals, **od.get("attrs", {}))
+                    if isinstance(out, tuple):
+                        out = out[od["outputs"].index(name)]
+                    r = ("const", np.asarray(out))
+                except Exception:
+                    r = None
+    memo[name] = r
+    return r
+
+
+def _resolve_lin(d, producers, name, depth=0, memo=None, vmemo=None):
+    """Resolve a sub-graph tensor to an affine form (arg_i + offset):
+    returns (i, offset) or None. Lets the analysis see through
+    post-update counters (cond computed on ``i + step``). Memoized like
+    _resolve_val (vmemo is the _resolve_val memo, shared)."""
+    if memo is None:
+        memo = {}
+    if vmemo is None:
+        vmemo = {}
+    if name in memo:
+        return memo[name]
+    memo[name] = None
+    if depth > 32:
+        return None
+    r = _resolve_val(d, producers, name, memo=vmemo)
+    if r is not None and r[0] == "arg":
+        memo[name] = (r[1], 0.0)
+        return memo[name]
+    od = producers.get(name)
+    if od is None:
+        return None
+    if od["op"] in _FOLLOW_OPS:
+        memo[name] = _resolve_lin(d, producers, od["inputs"][0],
+                                  depth + 1, memo, vmemo)
+        return memo[name]
+    if od["op"] in ("add", "sub") and len(od["inputs"]) == 2:
+        ra = _resolve_val(d, producers, od["inputs"][0], memo=vmemo)
+        rb = _resolve_val(d, producers, od["inputs"][1], memo=vmemo)
+        la = _resolve_lin(d, producers, od["inputs"][0], depth + 1,
+                          memo, vmemo)
+        lb = _resolve_lin(d, producers, od["inputs"][1], depth + 1,
+                          memo, vmemo)
+        sa, sb = _scalar_const(ra), _scalar_const(rb)
+        if od["op"] == "add":
+            if la is not None and sb is not None:
+                memo[name] = (la[0], la[1] + sb)
+            elif lb is not None and sa is not None:
+                memo[name] = (lb[0], lb[1] + sa)
+        else:
+            if la is not None and sb is not None:
+                memo[name] = (la[0], la[1] - sb)
+    return memo[name]
+
+
+def _body_update(body_graph, i, producers):
+    """How body output i evolves: ("same",), ("add", step) for a
+    constant-step counter, or None."""
+    outs = body_graph["outputs"]
+    if i >= len(outs):
+        return None
+    name = outs[i]
+    r = _resolve_val(body_graph, producers, name)
+    if r is not None and r[0] == "arg" and r[1] == i:
+        return ("same",)
+    # follow identities to the producing add/sub
+    od = producers.get(name)
+    depth = 0
+    while od is not None and od["op"] in _FOLLOW_OPS and depth < 32:
+        od = producers.get(od["inputs"][0])
+        depth += 1
+    if od is None or od["op"] not in ("add", "sub"):
+        return None
+    ra = _resolve_val(body_graph, producers, od["inputs"][0])
+    rb = _resolve_val(body_graph, producers, od["inputs"][1])
+    if od["op"] == "add":
+        for x, y in ((ra, rb), (rb, ra)):
+            if (x is not None and x[0] == "arg" and x[1] == i
+                    and y is not None and y[0] == "const"
+                    and np.asarray(y[1]).size == 1):
+                return ("add", float(np.asarray(y[1]).reshape(())))
+    else:
+        if (ra is not None and ra[0] == "arg" and ra[1] == i
+                and rb is not None and rb[0] == "const"
+                and np.asarray(rb[1]).size == 1):
+            return ("add", -float(np.asarray(rb[1]).reshape(())))
+    return None
+
+
+def derive_trip_count(cond_graph, body_graph, init_consts):
+    """Static upper bound on the loop trip count, or None.
+
+    Flattens the cond output over logical_and and looks for any
+    conjunct of the form ``counter CMP bound`` where the counter is a
+    loop var advanced by a constant step in the body, the bound is a
+    constant (directly, or a pass-through loop var with a constant
+    init), and the counter's init is constant. One such conjunct
+    suffices for an upper bound: other conjuncts can only exit the
+    loop *earlier*, which the masked-scan lowering handles exactly.
+
+    init_consts: per-loop-var numpy value or None (call-site knowledge
+    of which init operands are graph constants).
+    """
+    import math
+
+    cp = _sg_producers(cond_graph)
+    bp = _sg_producers(body_graph)
+
+    conjuncts: List[str] = []
+    stack = [cond_graph["outputs"][0]]
+    seen = set()
+    while stack:
+        nm = stack.pop()
+        if nm in seen:
+            continue
+        seen.add(nm)
+        od = cp.get(nm)
+        if od is not None and od["op"] in _FOLLOW_OPS:
+            stack.append(od["inputs"][0])
+        elif od is not None and od["op"] == "logical_and":
+            stack.extend(od["inputs"])
+        else:
+            conjuncts.append(nm)
+
+    def as_bound(r):
+        """("const", v) or pass-through arg with const init -> scalar."""
+        if r is None:
+            return None
+        if r[0] == "const":
+            v = np.asarray(r[1])
+            return float(v.reshape(())) if v.size == 1 else None
+        j = r[1]
+        upd = _body_update(body_graph, j, bp)
+        if (upd == ("same",) and j < len(init_consts)
+                and init_consts[j] is not None
+                and np.asarray(init_consts[j]).size == 1):
+            return float(np.asarray(init_consts[j]).reshape(()))
+        return None
+
+    def fail_point(ctr, off, op, bound):
+        """Smallest m >= 0 such that the comparison over
+        ``c0 + m*step + off`` fails, or None. The building block for
+        both gating styles below."""
+        if ctr >= len(init_consts) or init_consts[ctr] is None \
+                or np.asarray(init_consts[ctr]).size != 1:
+            return None
+        upd = _body_update(body_graph, ctr, bp)
+        if upd is None or upd[0] != "add" or upd[1] == 0:
+            return None
+        c0 = float(np.asarray(init_consts[ctr]).reshape(())) + off
+        step = upd[1]
+        # integral values only: a float counter accumulates rounding
+        # error across iterations, so the exact-arithmetic bound here
+        # could undercount the loop's true trip count and the masked
+        # scan would silently truncate it. Integer-valued floats are
+        # exact in f32 far beyond MAX_SCAN_TRIP, so they are safe.
+        if not (c0.is_integer() and float(step).is_integer()
+                and float(bound).is_integer()):
+            return None
+        if op in ("lt", "lte") and step > 0:
+            m = math.ceil((bound - c0) / step) if op == "lt" \
+                else math.floor((bound - c0) / step) + 1
+        elif op in ("gt", "gte") and step < 0:
+            m = math.ceil((c0 - bound) / -step) if op == "gt" \
+                else math.floor((c0 - bound) / -step) + 1
+        else:
+            return None
+        return max(0, int(m))
+
+    def carried_cond_bound(j):
+        """Conjunct is a carried bool loop var: the body recomputes it
+        as ``counter_expr CMP bound`` each step (torch `while i < N`
+        exports this shape). The value computed in iteration m gates
+        iteration m+1, so the loop runs one step past the fail point."""
+        outs = body_graph["outputs"]
+        if j >= len(outs):
+            return None
+        od = bp.get(outs[j])
+        depth = 0
+        while od is not None and od["op"] in _FOLLOW_OPS and depth < 32:
+            od = bp.get(od["inputs"][0])
+            depth += 1
+        if od is None or od["op"] not in _CMP_OPS \
+                or len(od["inputs"]) != 2:
+            return None
+        la = _resolve_lin(body_graph, bp, od["inputs"][0])
+        lb = _resolve_lin(body_graph, bp, od["inputs"][1])
+        ra = _resolve_val(body_graph, bp, od["inputs"][0])
+        rb = _resolve_val(body_graph, bp, od["inputs"][1])
+        op = od["op"]
+        sa, sb = _scalar_const(ra), _scalar_const(rb)
+        if la is not None and sb is not None:
+            ctr, off, bound = la[0], la[1], sb
+        elif lb is not None and sa is not None:
+            ctr, off, bound = lb[0], lb[1], sa
+            op = {"lt": "gt", "lte": "gte", "gt": "lt", "gte": "lte"}[op]
+        else:
+            return None
+        m = fail_point(ctr, off, op, bound)
+        return None if m is None else m + 1
+
+    bounds: List[int] = []
+    for nm in conjuncts:
+        od = cp.get(nm)
+        r = _resolve_val(cond_graph, cp, nm)
+        if r is not None and r[0] == "arg":
+            cb = carried_cond_bound(r[1])
+            if cb is not None:
+                bounds.append(cb)
+            continue
+        if od is None or od["op"] not in _CMP_OPS or len(od["inputs"]) != 2:
+            continue
+        ra = _resolve_val(cond_graph, cp, od["inputs"][0])
+        rb = _resolve_val(cond_graph, cp, od["inputs"][1])
+        op = od["op"]
+        if ra is not None and ra[0] == "arg" and as_bound(ra) is None:
+            ctr, bound = ra[1], as_bound(rb)
+        elif rb is not None and rb[0] == "arg":
+            ctr, bound = rb[1], as_bound(ra)
+            op = {"lt": "gt", "lte": "gte", "gt": "lt", "gte": "lte"}[op]
+        else:
+            continue
+        if bound is None:
+            continue
+        # direct gate: the cond graph itself compares the counter, so
+        # iteration m runs iff the comparison over c0 + m*step holds
+        n = fail_point(ctr, 0.0, op, bound)
+        if n is not None:
+            bounds.append(n)
+    if not bounds:
+        return None
+    n = min(bounds)
+    return n if n <= MAX_SCAN_TRIP else None
+
+
+def dynamic_loop_names(ops) -> List[str]:
+    """Names (first outputs) of every dynamically-terminated while_loop
+    in `ops`, recursing into control-flow sub-graphs. `ops` is a
+    sequence of OpNode or op dicts."""
+    found: List[str] = []
+    for od in ops:
+        name = od.op_name if hasattr(od, "op_name") else od["op"]
+        attrs = od.attrs if hasattr(od, "attrs") else od.get("attrs", {})
+        if name == "while_loop" and attrs.get("max_trip_count") is None:
+            found.append((od.outputs if hasattr(od, "outputs")
+                          else od["outputs"])[0])
+        for v in attrs.values():
+            if isinstance(v, dict) and "ops" in v and "outputs" in v:
+                found.extend(dynamic_loop_names(v["ops"]))
+            elif isinstance(v, (list, tuple)):
+                for b in v:
+                    if isinstance(b, dict) and "ops" in b \
+                            and "outputs" in b:
+                        found.extend(dynamic_loop_names(b["ops"]))
+    return found
+
+
+def rewrap_nondiff_loop_error(e: BaseException, ops=()) -> None:
+    """Convert JAX's reverse-through-while error into the framework's
+    documented message (naming the offending loops); re-raise anything
+    else untouched.
+
+    This runs AFTER JAX itself decided the loop needs transposing, so
+    — unlike an eager graph-walk guard — it never false-positives on
+    dynamic loops that only carry non-differentiable (integer /
+    symbolic-zero tangent) state, which jax.grad handles fine.
+    """
+    msg = str(e)
+    if "lax.while_loop" not in msg and "lax.fori_loop" not in msg:
+        raise e
+    names = dynamic_loop_names(ops)
+    raise ValueError(
+        "gradients flow through a dynamically-terminated while_loop"
+        + (f" ({', '.join(names)})" if names else "")
+        + ", which lowers to lax.while_loop — JAX cannot "
+        "reverse-differentiate it, so this loop is inference-only. "
+        "Statically-bounded loops (constant trip count, e.g. imported "
+        "dynamic RNNs / ONNX Loop with constant M) lower to a "
+        "differentiable lax.scan automatically; a genuinely dynamic "
+        "termination condition cannot be trained through. If the "
+        "bound is actually static, ensure the loop counter's init and "
+        "bound are graph constants at import/build time.") from e

@@ -554,7 +554,8 @@ class SameDiff:
         feeds = {k: jnp.asarray(_unwrap(v)) for k, v in feeds.items()}
         key = ("grad", tuple(wrt_names), loss, self._feed_key(feeds))
         if key not in self._fn_cache:
-            fwd = self._build_fn((loss,) + tuple(self._loss_variables[1:]))
+            out_names = (loss,) + tuple(self._loss_variables[1:])
+            fwd = self._build_fn(out_names)
 
             def loss_fn(wrt_arrays, other_arrays, feeds_):
                 outs = fwd({**other_arrays, **wrt_arrays}, feeds_)
@@ -567,7 +568,18 @@ class SameDiff:
         wrt_arrays = {n: self._arrays[n] for n in wrt_names}
         other = {n: a for n, a in self._arrays.items()
                  if n not in wrt_arrays}
-        grads = self._fn_cache[key](wrt_arrays, other, feeds)
+        try:
+            grads = self._fn_cache[key](wrt_arrays, other, feeds)
+        except ValueError as e:
+            # JAX decided a lax.while_loop on the grad path needs
+            # transposing -> the framework's documented inference-only
+            # error, naming the loops (no false positives: loops that
+            # carry only non-differentiable state trace fine)
+            from deeplearning4j_tpu.autodiff.control_flow import (
+                rewrap_nondiff_loop_error,
+            )
+
+            rewrap_nondiff_loop_error(e, self._prune((loss,)))
         self._last_grads = dict(grads)
         return grads
 
@@ -622,8 +634,12 @@ class SameDiff:
         """While loop over sub-graphs (reference: SameDiff#whileLoop).
 
         cond_fn returns a scalar-bool variable; body_fn returns new loop
-        vars (loop-invariant shapes/dtypes). Lowered to lax.while_loop —
-        the whole loop runs on-device inside the one compiled step.
+        vars (loop-invariant shapes/dtypes). The whole loop runs
+        on-device inside the one compiled step. When the trip count is
+        statically derivable (counter with constant init/step/bound),
+        the loop lowers to a differentiable masked lax.scan and
+        supports jax.grad; otherwise it lowers to lax.while_loop
+        (inference-only — grads raise a documented error).
         """
         from deeplearning4j_tpu.autodiff.control_flow import subgraph_to_dict
 
@@ -636,11 +652,24 @@ class SameDiff:
             raise ValueError(
                 f"while body returns {len(b_outs)} vars for "
                 f"{len(loop_vars)} loop vars")
+        from deeplearning4j_tpu.autodiff.control_flow import (
+            derive_trip_count,
+        )
+
+        cond_graph = subgraph_to_dict(sub_c, c_outs, len(loop_vars))
+        body_graph = subgraph_to_dict(sub_b, b_outs, len(loop_vars))
+        # constant loop-var inits make a counter-bounded loop statically
+        # derivable -> differentiable masked-scan lowering
+        init_consts = [
+            np.asarray(self._arrays[v.name])
+            if v.vtype is VariableType.CONSTANT else None
+            for v in loop_vars]
         return self._op(
             "while_loop", [v.name for v in loop_vars],
             n_out=len(loop_vars), name=name or "whileLoop",
-            cond_graph=subgraph_to_dict(sub_c, c_outs, len(loop_vars)),
-            body_graph=subgraph_to_dict(sub_b, b_outs, len(loop_vars)))
+            cond_graph=cond_graph, body_graph=body_graph,
+            max_trip_count=derive_trip_count(cond_graph, body_graph,
+                                             init_consts))
 
     # ------------------------------------------------------------ training
     def setTrainingConfig(self, cfg) -> None:

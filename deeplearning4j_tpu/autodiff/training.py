@@ -188,9 +188,19 @@ def fit(sd, data, epochs: int = 1, validation_data=None,
                 step_cache[sig] = _build_train_step(sd, cfg, sig)
             wrt = {n: sd._arrays[n] for n in sd.trainable_names()}
             other = {n: a for n, a in sd._arrays.items() if n not in wrt}
-            new_wrt, sd._updater_state, loss, grads = step_cache[sig](
-                wrt, other, sd._updater_state,
-                jnp.asarray(sd._iteration), feeds)
+            try:
+                new_wrt, sd._updater_state, loss, grads = step_cache[
+                    sig](wrt, other, sd._updater_state,
+                         jnp.asarray(sd._iteration), feeds)
+            except ValueError as e:
+                # fit() gets the same documented inference-only-loop
+                # error calculateGradients raises (not raw JAX's)
+                from deeplearning4j_tpu.autodiff.control_flow import (
+                    rewrap_nondiff_loop_error,
+                )
+
+                rewrap_nondiff_loop_error(
+                    e, sd._prune(tuple(sd._loss_variables)))
             sd._arrays.update(new_wrt)
             sd._last_grads = dict(grads)
             lv = float(loss)

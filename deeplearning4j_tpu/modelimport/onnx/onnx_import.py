@@ -1018,6 +1018,7 @@ def _handle_loop(sd, node, tensors, const_vals, avals, ins,
         f"{node.output[0]}_cond0", np.bool_(True))
     m_opnd = ins[0] if have_m else sd.constant(
         f"{node.output[0]}_m0", np.int32(0))
+    m_const = None
     if have_m:
         mv = const_vals.get(node.input[0])
         if mv is not None and int(np.asarray(mv)) >= 2 ** 31 - 1:
@@ -1026,14 +1027,30 @@ def _handle_loop(sd, node, tensors, const_vals, avals, ins,
             # it into -1 and the loop would never run
             m_opnd = sd.constant(f"{node.output[0]}_minf",
                                  np.int32(2 ** 31 - 2))
+        elif mv is not None:
+            m_const = np.int32(mv)
     operands = ([zero.name, cond0.name]
                 + [v.name for v in carried]
                 + [tensors[n].name
                    for n in sorted(caps, key=caps.get)]
                 + [m_opnd.name])
+    # static trip-count derivation makes the loop train (masked-scan
+    # lowering): constant M bounds it directly; torch `while i < N`
+    # exports bound it through the carried cond recomputed in the body
+    from deeplearning4j_tpu.autodiff.control_flow import (
+        derive_trip_count,
+    )
+    init_consts = [np.int32(0),
+                   const_vals.get(node.input[1]) if have_cond
+                   else np.bool_(True)]
+    init_consts += [const_vals.get(r) for r in node.input[2:]]
+    init_consts += [None] * len(caps)
+    init_consts += [m_const]
     out = sd._op("while_loop", operands, n_out=n_state,
                  name=node.output[0] + "_state", cond_graph=cond_full,
-                 body_graph=body_full)
+                 body_graph=body_full,
+                 max_trip_count=derive_trip_count(cond_full, body_full,
+                                                  init_consts))
     out = out if isinstance(out, tuple) else (out,)
     return tuple(out[2 + i] for i in range(len(node.output)))
 

@@ -15,9 +15,11 @@ to import time:
   Merge nodes define the loop variables, the cond sub-graph is cut
   between the Merges and LoopCond, the body between Switch:1 and
   NextIteration, and the whole frame collapses into ONE `while_loop`
-  op lowered to `lax.while_loop`. Nested frames recurse: the body
-  sub-import sees the inner frame's machinery and reconstructs it the
-  same way.
+  op — lowered to a differentiable masked `lax.scan` when the trip
+  count derives statically (derive_trip_count; every counter-bounded
+  dynamic RNN), else to `lax.while_loop` (inference-only). Nested
+  frames recurse: the body sub-import sees the inner frame's
+  machinery and reconstructs it the same way.
 - **TF1 cond** (Switch/Merge without frames): lowered to on-device
   select. Switch forwards its input to both branch edges tagged with
   (pred, branch); Merge finds the pred on which its two inputs differ
@@ -44,8 +46,29 @@ from typing import Any, Dict, List, Sequence, Set, Tuple
 import numpy as np
 
 from deeplearning4j_tpu.modelimport.tensorflow.tf_import import (
-    OpMappingRegistry, TFImportError, _Walker,
+    OpMappingRegistry, TFImportError, _Walker, _is_dyn,
 )
+
+
+def _init_const(walker: "_Walker", ref: str):
+    """Import-time constant value of a loop-init tensor ref, or None.
+    Feeds derive_trip_count so counter-bounded frames lower to the
+    differentiable masked-scan form of while_loop. Partials are only
+    usable if they carry NO dynamic sentinel — including the
+    provenance-tagged ones below DYN itself (_is_dyn, not == DYN):
+    a shape-derived bound from a dynamic dim must fall back to the
+    lax.while_loop lowering, not become a bogus constant."""
+    src, idx = _Walker.resolve(ref)
+    if idx != 0:
+        return None
+    v = walker.const_vals.get(src)
+    if v is not None and getattr(v, "dtype", None) is not None \
+            and v.dtype.kind not in "OSU":
+        return v
+    p = walker.partials.get(src)
+    if p is not None and not np.any(_is_dyn(p)):
+        return p
+    return None
 
 _LOOP_OPS = {"Enter", "RefEnter", "Exit", "RefExit", "NextIteration",
              "RefNextIteration", "LoopCond"}
@@ -139,9 +162,18 @@ class _FramePlan:
             walker, self.pool, body_boundary, body_outputs,
             arg_avals=arg_avals)
         inits = [v.name for v in init_vars]
+        from deeplearning4j_tpu.autodiff.control_flow import (
+            derive_trip_count,
+        )
+        init_consts = [_init_const(walker, mv["enter"].input[0])
+                       for mv in self.merged] + \
+                      [_init_const(walker, en.input[0])
+                       for en in self.invariant]
         out = walker.sd._op(
             "while_loop", inits, n_out=n_m + len(self.invariant),
-            name=self.name, cond_graph=cond_graph, body_graph=body_graph)
+            name=self.name, cond_graph=cond_graph, body_graph=body_graph,
+            max_trip_count=derive_trip_count(cond_graph, body_graph,
+                                             init_consts))
         out = out if isinstance(out, tuple) else (out,)
         # loop-carried shapes are invariant: output avals = init avals,
         # so downstream shape folding keeps working past the loop
@@ -588,13 +620,18 @@ def _w_merge_n(walker: _Walker, node, in_vars, keys) -> None:
 
 def _w_while(walker: _Walker, node, in_vars, in_refs) -> None:
     """TF2 functional While → while_loop over imported cond/body."""
+    from deeplearning4j_tpu.autodiff.control_flow import derive_trip_count
+
     n = len(in_vars)
     avs = [walker.avals.get(v.name) for v in in_vars]
     cond_g = import_function(walker, node.attr["cond"].func.name, n, avs)
     body_g = import_function(walker, node.attr["body"].func.name, n, avs)
+    init_consts = [_init_const(walker, f"{s}:{i}" if i else s)
+                   for s, i in in_refs]
     out = walker.sd._op(
         "while_loop", [v.name for v in in_vars], n_out=n,
-        name=node.name, cond_graph=cond_g, body_graph=body_g)
+        name=node.name, cond_graph=cond_g, body_graph=body_g,
+        max_trip_count=derive_trip_count(cond_g, body_g, init_consts))
     _map_multi(walker, node, out)
 
 

@@ -73,12 +73,44 @@ def main(batch: int = 2, seq: int = 6, d_in: int = 5,
     print("frozen graph op set:", ops)
 
     # ---- import: frames -> while_loop, TAs -> dense loop state
+    import jax
+
     sd = TFGraphMapper.importGraph(frozen)
-    got = np.asarray(sd.output({"x": x}, ["rnn_out"])["rnn_out"])
+    # parity vs a float32 CPU TF session: pin full-precision matmuls
+    # (on TPU the default MXU precision is bf16-grade, ~3e-3 off)
+    with jax.default_matmul_precision("float32"):
+        got = np.asarray(sd.output({"x": x}, ["rnn_out"])["rnn_out"])
     err = float(np.abs(got - ref).max())
     print(f"imported-vs-TF max err: {err:.2e}  "
           f"(output shape {got.shape})")
     assert err < 1e-4, "import diverged from the TF session"
+
+    # ---- fine-tune THROUGH the imported loop: the counter-bounded
+    # frame lowered to a differentiable masked scan (max_trip_count
+    # was derived at import), so jax.grad works and the frozen weights
+    # can be trained against new targets
+    node = next(n for n in sd._ops if n.op_name == "while_loop")
+    print(f"derived static trip count: {node.attrs['max_trip_count']}")
+
+    from deeplearning4j_tpu.autodiff import TrainingConfig
+    from deeplearning4j_tpu.datasets import DataSet
+    from deeplearning4j_tpu.learning.updaters import Adam
+
+    sd.convertConstantsToVariables("Wz", "Wh")
+    target = rng.normal(size=(batch, seq, hidden)).astype(np.float32)
+    y_ph = sd.placeholder("y", shape=(batch, seq, hidden))
+    diff = sd._op("sub", ["rnn_out", y_ph.name])
+    loss = sd._op("reduce_mean", [sd._op("mul", [diff.name,
+                                                 diff.name]).name])
+    sd.setLossVariables(loss.name)
+    sd.setTrainingConfig(TrainingConfig(
+        updater=Adam(learning_rate=0.01),
+        data_set_feature_mapping=["x"], data_set_label_mapping=["y"]))
+    hist = sd.fit(DataSet(x, target), epochs=100)
+    print(f"fine-tune loss: {hist.loss_curve[0]:.4f} -> "
+          f"{hist.loss_curve[-1]:.4f}")
+    assert hist.loss_curve[-1] < 0.75 * hist.loss_curve[0], \
+        "fine-tuning through the imported loop did not descend"
     print("OK")
     return err
 

@@ -115,6 +115,270 @@ class TestWhileLoop:
         assert float(out) == 0 + 1 + 4 + 9
 
 
+class TestTrainableLoops:
+    """Statically-bounded while loops lower to a differentiable masked
+    lax.scan; genuinely dynamic loops stay lax.while_loop and must fail
+    LOUDLY at grad time (reference: TrainingSession differentiates
+    through Enter/Exit/Merge frames uniformly — SURVEY.md §2.12/§3.4;
+    XLA makes static bounds the price of the backward pass)."""
+
+    def _counted_loop(self):
+        sd = SameDiff()
+        x = sd.var("x", np.asarray([2.0, 3.0], np.float32))
+        i0 = sd.constant("i0", np.int32(0))
+        outs = sd.whileLoop(
+            [i0, x],
+            cond_fn=lambda sub, i, a: sub._op(
+                "lt", [i.name, sub.constant("n", np.int32(3)).name]),
+            body_fn=lambda sub, i, a: (
+                sub._op("add", [i.name,
+                                sub.constant("one", np.int32(1)).name]),
+                sub._op("mul", [a.name,
+                                sub.constant("two",
+                                             np.float32(2.0)).name])))
+        return sd, outs
+
+    def test_counted_loop_derives_static_trip(self):
+        sd, _ = self._counted_loop()
+        node = next(n for n in sd._ops if n.op_name == "while_loop")
+        assert node.attrs["max_trip_count"] == 3
+
+    def test_grad_flows_through_counted_loop(self):
+        sd, outs = self._counted_loop()
+        loss = sd._op("reduce_sum", [outs[1].name])
+        sd.setLossVariables(loss.name)
+        g = sd.calculateGradients({}, ["x"])
+        # d/dx sum(x * 2^3) = 8
+        np.testing.assert_allclose(np.asarray(g["x"]), 8.0)
+        np.testing.assert_allclose(np.asarray(outs[1].eval()),
+                                   [16.0, 24.0])
+
+    def test_masked_scan_matches_while_semantics(self):
+        # bound derived from an lte + step-2 counter; forward value must
+        # equal the plain while result (early conjuncts honoured)
+        sd = SameDiff()
+        x = sd.var("x", np.float32(1.0))
+        i0 = sd.constant("i0", np.int32(0))
+        outs = sd.whileLoop(
+            [i0, x],
+            cond_fn=lambda sub, i, a: sub._op(
+                "lte", [i.name, sub.constant("n", np.int32(5)).name]),
+            body_fn=lambda sub, i, a: (
+                sub._op("add", [i.name,
+                                sub.constant("two", np.int32(2)).name]),
+                sub._op("add", [a.name,
+                                sub.constant("one",
+                                             np.float32(1.0)).name])))
+        node = next(n for n in sd._ops if n.op_name == "while_loop")
+        # i = 0,2,4 pass (<=5), i=6 fails -> 3 iterations
+        assert node.attrs["max_trip_count"] == 3
+        assert float(outs[1].eval()) == 4.0
+
+    def test_dynamic_loop_grad_fails_loudly(self):
+        sd = SameDiff()
+        x = sd.var("x", np.asarray([1.5], np.float32))
+        outs = sd.whileLoop(
+            [x],
+            cond_fn=lambda sub, a: sub._op(
+                "lt", [sub._op("reduce_sum", [a.name]).name,
+                       sub.constant("b", np.float32(100.0)).name]),
+            body_fn=lambda sub, a: (
+                sub._op("mul", [a.name,
+                                sub.constant("two",
+                                             np.float32(2.0)).name]),))
+        outs = outs if isinstance(outs, tuple) else (outs,)
+        node = next(n for n in sd._ops if n.op_name == "while_loop")
+        assert node.attrs["max_trip_count"] is None
+        # forward still runs (inference-only loop)
+        np.testing.assert_allclose(np.asarray(outs[0].eval()), [192.0])
+        loss = sd._op("reduce_sum", [outs[0].name])
+        sd.setLossVariables(loss.name)
+        with pytest.raises(ValueError, match="inference-only"):
+            sd.calculateGradients({}, ["x"])
+
+    def test_integer_state_dynamic_loop_grads_fine(self):
+        # a dynamic loop whose carried state is ALL integer receives
+        # only symbolic-zero tangents: jax.grad handles it, and the
+        # error path (rewrap of JAX's transpose failure) must NOT
+        # false-positive on it even though it sits on the wrt path
+        sd = SameDiff()
+        w = sd.var("w", np.float32(1.5))
+        seed = sd._op("cast", [sd._op("mul", [w.name, sd.constant(
+            "zero", np.float32(0.0)).name]).name], dtype="int32")
+        outs = sd.whileLoop(
+            [seed],
+            cond_fn=lambda sub, i: sub._op(
+                "lt", [i.name, sub.constant("n", np.int32(3)).name]),
+            body_fn=lambda sub, i: (
+                sub._op("add", [i.name,
+                                sub.constant("one",
+                                             np.int32(1)).name]),))
+        outs = outs if isinstance(outs, tuple) else (outs,)
+        # seed is w-dependent (not a constant) -> no static derivation
+        assert next(n for n in sd._ops if n.op_name == "while_loop") \
+            .attrs["max_trip_count"] is None
+        stepsf = sd._op("cast", [outs[0].name], dtype="float32")
+        loss = sd._op("reduce_sum",
+                      [sd._op("mul", [w.name, stepsf.name]).name])
+        sd.setLossVariables(loss.name)
+        g = sd.calculateGradients({}, ["w"])  # must NOT raise
+        np.testing.assert_allclose(np.asarray(g["w"]), 3.0)
+
+    def test_dynamic_loop_off_grad_path_is_fine(self):
+        # a dynamic loop fed only by constants receives no tangents:
+        # grads wrt other variables must still compute (the guard is
+        # scoped to the wrt-dependent subgraph)
+        sd = SameDiff()
+        x = sd.var("x", np.asarray([1.0, 2.0], np.float32))
+        c = sd.constant("c", np.float32(1.5))
+        outs = sd.whileLoop(
+            [c],
+            cond_fn=lambda sub, a: sub._op(
+                "lt", [a.name, sub.constant("b", np.float32(50.0)).name]),
+            body_fn=lambda sub, a: (
+                sub._op("mul", [a.name,
+                                sub.constant("two",
+                                             np.float32(2.0)).name]),))
+        outs = outs if isinstance(outs, tuple) else (outs,)
+        assert next(n for n in sd._ops if n.op_name == "while_loop") \
+            .attrs["max_trip_count"] is None
+        scaled = sd._op("mul", [outs[0].name, "x"])
+        loss = sd._op("reduce_sum", [scaled.name])
+        sd.setLossVariables(loss.name)
+        g = sd.calculateGradients({}, ["x"])  # must NOT raise
+        np.testing.assert_allclose(np.asarray(g["x"]), 96.0)
+
+    def test_fit_gets_the_loud_error_too(self):
+        from deeplearning4j_tpu.autodiff import TrainingConfig
+        from deeplearning4j_tpu.datasets import DataSet
+        from deeplearning4j_tpu.learning.updaters import Sgd
+
+        sd = SameDiff()
+        x0 = sd.placeholder("x0", shape=(2,))
+        w = sd.var("w", np.asarray([1.0, 1.0], np.float32))
+        seeded = sd._op("mul", [x0.name, "w"])
+        outs = sd.whileLoop(
+            [seeded],
+            cond_fn=lambda sub, a: sub._op(
+                "lt", [sub._op("reduce_sum", [a.name]).name,
+                       sub.constant("b", np.float32(40.0)).name]),
+            body_fn=lambda sub, a: (
+                sub._op("mul", [a.name,
+                                sub.constant("two",
+                                             np.float32(2.0)).name]),))
+        outs = outs if isinstance(outs, tuple) else (outs,)
+        loss = sd._op("reduce_sum", [outs[0].name])
+        sd.setLossVariables(loss.name)
+        sd.setTrainingConfig(TrainingConfig(
+            updater=Sgd(0.01), data_set_feature_mapping=["x0"]))
+        with pytest.raises(ValueError, match="inference-only"):
+            sd.fit(DataSet(np.ones(2, np.float32), None), epochs=1)
+
+    def test_tighter_conjunct_wins(self):
+        # two derivable conjuncts: the analysis takes the MINIMUM bound
+        sd = SameDiff()
+        x = sd.var("x", np.float32(2.0))
+        i0 = sd.constant("i0", np.int32(0))
+        k0 = sd.constant("k0", np.float32(0.0))
+
+        def cond(sub, i, k, a):
+            lt = sub._op("lt", [i.name,
+                                sub.constant("n", np.int32(5)).name])
+            lt2 = sub._op("lt", [k.name,
+                                 sub.constant("m",
+                                              np.float32(3.0)).name])
+            return sub._op("logical_and", [lt.name, lt2.name])
+
+        def body(sub, i, k, a):
+            return (
+                sub._op("add", [i.name,
+                                sub.constant("one", np.int32(1)).name]),
+                sub._op("add", [k.name,
+                                sub.constant("one_k",
+                                             np.float32(1.0)).name]),
+                sub._op("mul", [a.name, sub.constant(
+                    "two", np.float32(2.0)).name]))
+
+        outs = sd.whileLoop([i0, k0, x], cond_fn=cond, body_fn=body)
+        assert next(n for n in sd._ops if n.op_name == "while_loop") \
+            .attrs["max_trip_count"] == 3
+        assert float(outs[2].eval()) == 16.0
+
+    def test_dead_iterations_do_not_poison_grads(self):
+        # derivable bound 5, but a NON-derivable data conjunct (carried
+        # product, multiplicative update) exits after 3 true trips. The
+        # 2 dead scan steps would compute 1/(3-k) = 1/0; the lax.cond
+        # lowering must never execute them, keeping grads finite (a
+        # where-mask lowering yields 0*inf = NaN in the backward pass).
+        sd = SameDiff()
+        x = sd.var("x", np.float32(2.0))
+        i0 = sd.constant("i0", np.int32(0))
+        k0 = sd.constant("k0", np.float32(0.0))
+        p0 = sd.constant("p0", np.float32(1.0))
+
+        def cond(sub, i, k, p, a):
+            lt = sub._op("lt", [i.name,
+                                sub.constant("n", np.int32(5)).name])
+            gt = sub._op("gt", [p.name,
+                                sub.constant("eps",
+                                             np.float32(0.005)).name])
+            return sub._op("logical_and", [lt.name, gt.name])
+
+        def body(sub, i, k, p, a):
+            den = sub._op("sub", [sub.constant(
+                "three", np.float32(3.0)).name, k.name])
+            inv = sub._op("div", [sub.constant(
+                "one_f", np.float32(1.0)).name, den.name])
+            return (
+                sub._op("add", [i.name,
+                                sub.constant("one", np.int32(1)).name]),
+                sub._op("add", [k.name,
+                                sub.constant("one_k",
+                                             np.float32(1.0)).name]),
+                sub._op("mul", [p.name,
+                                sub.constant("tenth",
+                                             np.float32(0.1)).name]),
+                sub._op("mul", [a.name, inv.name]))
+
+        outs = sd.whileLoop([i0, k0, p0, x], cond_fn=cond, body_fn=body)
+        # only the i<5 conjunct derives (p's update is multiplicative)
+        assert next(n for n in sd._ops if n.op_name == "while_loop") \
+            .attrs["max_trip_count"] == 5
+        # true trips: p = 1, .1, .01 pass (>0.005), .001 fails -> 3
+        # iterations with den = 3, 2, 1; a = x/6. Dead step 4 would
+        # divide by zero.
+        val = float(outs[3].eval())
+        np.testing.assert_allclose(val, 2.0 / 6.0, rtol=1e-6)
+        loss = sd._op("reduce_sum", [outs[3].name])
+        sd.setLossVariables(loss.name)
+        g = sd.calculateGradients({}, ["x"])
+        assert np.isfinite(np.asarray(g["x"])).all()
+        np.testing.assert_allclose(np.asarray(g["x"]), 1.0 / 6.0,
+                                   rtol=1e-6)
+
+    def test_decreasing_counter_derives(self):
+        sd = SameDiff()
+        x = sd.var("x", np.float32(0.0))
+        i0 = sd.constant("i0", np.int32(10))
+        outs = sd.whileLoop(
+            [i0, x],
+            cond_fn=lambda sub, i, a: sub._op(
+                "gt", [i.name, sub.constant("n", np.int32(4)).name]),
+            body_fn=lambda sub, i, a: (
+                sub._op("sub", [i.name,
+                                sub.constant("two", np.int32(2)).name]),
+                sub._op("add", [a.name,
+                                sub.constant("one",
+                                             np.float32(1.0)).name])))
+        node = next(n for n in sd._ops if n.op_name == "while_loop")
+        # i = 10,8,6 pass (>4), i=4 fails -> 3 iterations
+        assert node.attrs["max_trip_count"] == 3
+        assert float(outs[1].eval()) == 3.0
+        loss = sd._op("reduce_sum", [outs[1].name])
+        sd.setLossVariables(loss.name)
+        sd.calculateGradients({}, ["x"])  # differentiable
+
+
 class TestGradCheckUtil:
     def test_passes_on_correct_graph(self):
         sd = SameDiff()
