@@ -32,49 +32,9 @@ class OnnxImportError(ValueError):
     pass
 
 
-# -- ONNX-semantics helper ops (registered once; names are namespaced) --
-from deeplearning4j_tpu.ops.registry import has_op, register_op  # noqa: E402
-import jax.numpy as _jnp  # noqa: E402
-
-
-def _reg_once(name):
-    def deco(fn):
-        if not has_op(name):
-            register_op(name)(fn)
-        return fn
-    return deco
-
-
-@_reg_once("onnx_reshape")
-def _onnx_reshape(x, shape):
-    """ONNX Reshape: 0 copies the input dim, -1 infers."""
-    resolved = [x.shape[i] if s == 0 else int(s)
-                for i, s in enumerate(shape)] if 0 in list(shape) \
-        else [int(s) for s in shape]
-    return _jnp.reshape(x, tuple(resolved))
-
-
-@_reg_once("onnx_flatten")
-def _onnx_flatten(x, axis=1):
-    lead = 1
-    for d in x.shape[:axis]:
-        lead *= d
-    return _jnp.reshape(x, (lead, -1))
-
-
-@_reg_once("onnx_slice")
-def _onnx_slice(x, starts, ends, axes, steps):
-    idx = [slice(None)] * x.ndim
-    for st, en, ax, sp in zip(starts, ends, axes, steps):
-        n = x.shape[ax]
-        en = min(en, n) if en >= 0 else en
-        idx[ax] = slice(st, en, sp)
-    return x[tuple(idx)]
-
-
-@_reg_once("broadcast_to")
-def _broadcast_to(x, shape):
-    return _jnp.broadcast_to(x, tuple(int(s) for s in shape))
+# ONNX-semantics helper ops live with the op set (ops/onnx_compat.py)
+# so a bare `import deeplearning4j_tpu.ops` registers the full registry
+from deeplearning4j_tpu.ops import onnx_compat  # noqa: E402,F401
 
 
 class _Ctx:
@@ -564,23 +524,21 @@ def _lrn(ctx):
 
 
 # ------------------------------------------------------- recurrent ops
-# (ONNX LSTM/GRU/RNN — what torch.onnx.export emits for nn.LSTM/GRU/RNN;
-# reference: samediff-import-onnx maps these onto nd4j's lstmLayer)
+# (ONNX LSTM/GRU/RNN — what torch.onnx.export emits for nn.LSTM/GRU/RNN
+# and what keras/sklearn exporters emit with the reset-before GRU form;
+# reference: samediff-import-onnx maps these onto nd4j's flexible
+# lstmLayer, incl. cell clip / coupled gates / activations / ragged
+# sequence lengths — SURVEY.md §2.14)
 def _rnn_setup(ctx, n_gates, hidden):
     """Common decode: batch-major x, per-direction packed weights.
-    ONNX layout: X [T,N,in]; W [dirs, gates*H, in]; R [dirs, gates*H,
-    H]; B [dirs, 2*gates*H] (Wb ++ Rb). Weights must be constants
-    (true for every real exporter; re-packed at import time)."""
-    if float(ctx.attr("clip", 0.0) or 0.0) > 0.0:
-        raise OnnxImportError(
-            f"{ctx.node.name}: cell-clipping (clip attr) not mapped")
-    if int(ctx.attr("layout", 0)):
-        raise OnnxImportError(
-            f"{ctx.node.name}: layout=1 (batch-major) not mapped "
-            "(torch exports layout=0)")
-    if int(ctx.attr("input_forget", 0)):
-        raise OnnxImportError(
-            f"{ctx.node.name}: input_forget coupling not mapped")
+    ONNX tensor layout (layout=0): X [T,N,in]; W [dirs, gates*H, in];
+    R [dirs, gates*H, H]; B [dirs, 2*gates*H] (Wb ++ Rb); layout=1
+    swaps X to [N,T,in] and states/Y to batch-major. Weights must be
+    constants (true for every real exporter; re-packed at import).
+
+    Returns (x [N,T,in], W, R, B, dirs, layout, seq_lens_var, clip)."""
+    clip = float(ctx.attr("clip", 0.0) or 0.0)
+    layout = int(ctx.attr("layout", 0))
     W = ctx.static_np(1)
     R = ctx.static_np(2)
     dirs = W.shape[0]
@@ -589,32 +547,67 @@ def _rnn_setup(ctx, n_gates, hidden):
         # not silently zeroed; static_np raises for non-constants
     else:
         B = np.zeros((dirs, 2 * n_gates * hidden), np.float32)
+    seq_lens = None
     if len(ctx.inputs) > 4 and ctx.inputs[4] is not None:
+        seq_lens = ctx.inputs[4]
         sl = ctx.maybe_static(4)
         p = ctx.avals.get(ctx.inputs[0].name) if ctx.avals else None
-        t = int(p.shape[0]) if p is not None and p.shape else None
-        if sl is None or t is None or \
-                (sl.size and np.any(sl != t)):
-            raise OnnxImportError(
-                f"{ctx.node.name}: sequence_lens shorter than the "
-                f"sequence (T={t}) not supported (static full-length "
-                "only)")
-    x = ctx.op("transpose", ctx.inputs[:1], permute=[1, 0, 2])
-    return x, W, R, B, dirs
+        t_axis = 1 if layout else 0
+        t = int(p.shape[t_axis]) if p is not None and p.shape else None
+        if sl is not None and t is not None and sl.size \
+                and np.all(sl == t):
+            seq_lens = None  # full-length: skip the masking machinery
+    x = ctx.inputs[0] if layout else \
+        ctx.op("transpose", ctx.inputs[:1], permute=[1, 0, 2])
+    return x, W, R, B, dirs, layout, seq_lens, clip
 
 
-def _rnn_state(ctx, input_idx, d):
-    """initial_h/initial_c [dirs, N, H] -> direction d's [N, H]."""
+def _rnn_state(ctx, input_idx, d, layout=0):
+    """initial_h/initial_c -> direction d's [N, H] (the state tensor is
+    [dirs, N, H] for layout=0, [N, dirs, H] for layout=1)."""
     if len(ctx.inputs) <= input_idx or ctx.inputs[input_idx] is None:
         return None
     idx = ctx.sd.constant(f"{ctx.node.output[0]}_d{input_idx}_{d}",
                           np.int32(d))
-    return ctx.op("gather", [ctx.inputs[input_idx], idx], axis=0)
+    return ctx.op("gather", [ctx.inputs[input_idx], idx],
+                  axis=1 if layout else 0)
 
 
-def _rnn_outputs(ctx, ys_list, states):
-    """Per-direction [N,T,H] outputs -> ONNX Y [T, dirs, N, H] (+
-    state tensors [dirs, N, H] each)."""
+def _rnn_acts(ctx, per_dir, dirs, defaults):
+    """Parse activations/activation_alpha/activation_beta into per-
+    direction lists of (name, alpha, beta) triples, or None when the
+    attrs just restate the defaults (keeps the graph attr small)."""
+    names = ctx.attr("activations")
+    if not names:
+        return None
+    names = [n.decode() if isinstance(n, bytes) else str(n)
+             for n in names]
+    if [n.lower() for n in names] == [d.lower()
+                                      for d in defaults] * dirs:
+        return None
+    alphas = list(ctx.attr("activation_alpha") or [])
+    betas = list(ctx.attr("activation_beta") or [])
+    if len(names) != per_dir * dirs:
+        raise OnnxImportError(
+            f"{ctx.node.name}: {len(names)} activations for "
+            f"{dirs} direction(s) x {per_dir}")
+    specs = [(names[i],
+              float(alphas[i]) if i < len(alphas) else None,
+              float(betas[i]) if i < len(betas) else None)
+             for i in range(len(names))]
+    return [specs[d * per_dir:(d + 1) * per_dir] for d in range(dirs)]
+
+
+def _rnn_outputs(ctx, ys_list, states, layout=0):
+    """Per-direction [N,T,H] outputs -> ONNX Y (+ final states).
+    layout=0: Y [T, dirs, N, H], states [dirs, N, H];
+    layout=1: Y [N, T, dirs, H], states [N, dirs, H]."""
+    if layout:
+        y = ctx.op("stack", ys_list, axis=2)
+        outs = [y]
+        for group in states:
+            outs.append(ctx.op("stack", group, axis=1))
+        return tuple(outs)
     ys_t = [ctx.op("transpose", [y], permute=[1, 0, 2])
             for y in ys_list]
     y = ctx.op("stack", ys_t, axis=1)
@@ -627,14 +620,13 @@ def _rnn_outputs(ctx, ys_list, states):
 @R("LSTM")
 def _onnx_lstm(ctx):
     hs = int(ctx.attr("hidden_size"))
-    acts = ctx.attr("activations")
-    if acts and list(acts) not in (
-            ["Sigmoid", "Tanh", "Tanh"],
-            ["Sigmoid", "Tanh", "Tanh"] * 2):
-        raise OnnxImportError(
-            f"{ctx.node.name}: non-default LSTM activations {acts}")
     direction = ctx.attr("direction", "forward")
-    x, W, R, B, dirs = _rnn_setup(ctx, 4, hs)
+    x, W, R, B, dirs, layout, seq_lens, clip = _rnn_setup(ctx, 4, hs)
+    acts_by_dir = _rnn_acts(ctx, 3, dirs, ["Sigmoid", "Tanh", "Tanh"])
+    input_forget = bool(int(ctx.attr("input_forget", 0)))
+    P = None
+    if len(ctx.inputs) > 7 and ctx.inputs[7] is not None:
+        P = ctx.static_np(7)   # peepholes [dirs, 3H] as (p_i, p_o, p_f)
     order = [0, 2, 3, 1]          # ONNX iofc -> our i,f,g(=c),o
     ys_list, h_list, c_list = [], [], []
     for d in range(dirs):
@@ -643,41 +635,47 @@ def _onnx_lstm(ctx):
         b = (B[d][:4 * hs] + B[d][4 * hs:]) \
             .reshape(4, hs)[order].reshape(-1)
         base = f"{ctx.node.output[0]}_d{d}"
-        wv = ctx.sd.constant(base + "_wih", w_ih.astype(np.float32))
-        rv = ctx.sd.constant(base + "_whh", w_hh.astype(np.float32))
-        bv = ctx.sd.constant(base + "_b", b.astype(np.float32))
-        ins = [x, wv, rv, bv]
-        h0 = _rnn_state(ctx, 5, d)
-        c0 = _rnn_state(ctx, 6, d)
-        # ONNX allows either state alone (the other defaults to zeros)
-        if h0 is not None or c0 is not None:
+        ins = [x,
+               ctx.sd.constant(base + "_wih", w_ih.astype(np.float32)),
+               ctx.sd.constant(base + "_whh", w_hh.astype(np.float32)),
+               ctx.sd.constant(base + "_b", b.astype(np.float32))]
+        h0 = _rnn_state(ctx, 5, d, layout)
+        c0 = _rnn_state(ctx, 6, d, layout)
+        has_state = h0 is not None or c0 is not None
+        if has_state:
+            # ONNX allows either state alone (other defaults to zeros)
             if h0 is None:
                 h0 = ctx.op("zeros_like", [c0])
             if c0 is None:
                 c0 = ctx.op("zeros_like", [h0])
             ins += [h0, c0]
+        if seq_lens is not None:
+            ins.append(seq_lens)
+        if P is not None:
+            pi, po, pf = (P[d][:hs], P[d][hs:2 * hs], P[d][2 * hs:])
+            ins.append(ctx.sd.constant(
+                base + "_peep",
+                np.stack([pi, pf, po]).astype(np.float32)))
         reverse = (direction == "reverse") or d == 1
-        ys, hT, cT = ctx.op("lstm_seq", ins, n_out=3, reverse=reverse)
+        ys, hT, cT = ctx.op(
+            "onnx_lstm_seq", ins, n_out=3, reverse=reverse,
+            has_state=has_state, has_lens=seq_lens is not None,
+            has_peep=P is not None, cell_clip=clip,
+            input_forget=input_forget,
+            acts=acts_by_dir[d] if acts_by_dir else None)
         ys_list.append(ys)
         h_list.append(hT)
         c_list.append(cT)
-    return _rnn_outputs(ctx, ys_list, [h_list, c_list])
+    return _rnn_outputs(ctx, ys_list, [h_list, c_list], layout)
 
 
 @R("GRU")
 def _onnx_gru(ctx):
     hs = int(ctx.attr("hidden_size"))
-    acts = ctx.attr("activations")
-    if acts and list(acts) not in (["Sigmoid", "Tanh"],
-                                   ["Sigmoid", "Tanh"] * 2):
-        raise OnnxImportError(
-            f"{ctx.node.name}: non-default GRU activations {acts}")
-    if not int(ctx.attr("linear_before_reset", 0)):
-        raise OnnxImportError(
-            f"{ctx.node.name}: GRU linear_before_reset=0 not mapped "
-            "(torch exports 1; the reset-before form differs)")
     direction = ctx.attr("direction", "forward")
-    x, W, R, B, dirs = _rnn_setup(ctx, 3, hs)
+    x, W, R, B, dirs, layout, seq_lens, clip = _rnn_setup(ctx, 3, hs)
+    acts_by_dir = _rnn_acts(ctx, 2, dirs, ["Sigmoid", "Tanh"])
+    lbr = bool(int(ctx.attr("linear_before_reset", 0)))
     order = [1, 0, 2]             # ONNX z,r,h -> our r,z,n
     ys_list, h_list = [], []
     for d in range(dirs):
@@ -691,48 +689,52 @@ def _onnx_gru(ctx):
                ctx.sd.constant(base + "_whh", w_hh.astype(np.float32)),
                ctx.sd.constant(base + "_b", wb.astype(np.float32)),
                ctx.sd.constant(base + "_rb", rb.astype(np.float32))]
-        h0 = _rnn_state(ctx, 5, d)
+        h0 = _rnn_state(ctx, 5, d, layout)
         if h0 is not None:
             ins.append(h0)
+        if seq_lens is not None:
+            ins.append(seq_lens)
         reverse = (direction == "reverse") or d == 1
-        ys, hT = ctx.op("gru_seq", ins, n_out=2, reverse=reverse)
+        ys, hT = ctx.op(
+            "onnx_gru_seq", ins, n_out=2, reverse=reverse,
+            has_state=h0 is not None, has_lens=seq_lens is not None,
+            linear_before_reset=lbr, cell_clip=clip,
+            acts=acts_by_dir[d] if acts_by_dir else None)
         ys_list.append(ys)
         h_list.append(hT)
-    return _rnn_outputs(ctx, ys_list, [h_list])
+    return _rnn_outputs(ctx, ys_list, [h_list], layout)
 
 
 @R("RNN")
 def _onnx_rnn(ctx):
     hs = int(ctx.attr("hidden_size"))
-    acts = ctx.attr("activations")
-    if acts and list(acts) not in (["Tanh"], ["Tanh", "Tanh"]):
-        raise OnnxImportError(
-            f"{ctx.node.name}: RNN activation {acts} not mapped "
-            "(Tanh only)")
     direction = ctx.attr("direction", "forward")
-    x, W, R, B, dirs = _rnn_setup(ctx, 1, hs)
+    x, W, R, B, dirs, layout, seq_lens, clip = _rnn_setup(ctx, 1, hs)
+    acts_by_dir = _rnn_acts(ctx, 1, dirs, ["Tanh"])
     ys_list, h_list = [], []
     for d in range(dirs):
         w_ih = W[d].T
         w_hh = R[d].T
         b = B[d][:hs] + B[d][hs:]
         base = f"{ctx.node.output[0]}_d{d}"
-        rev = (direction == "reverse") or d == 1
-        xs = ctx.op("reverse", [x], dimensions=[1]) if rev else x
-        ins = [xs,
+        ins = [x,
                ctx.sd.constant(base + "_wih", w_ih.astype(np.float32)),
                ctx.sd.constant(base + "_whh", w_hh.astype(np.float32)),
                ctx.sd.constant(base + "_b", b.astype(np.float32))]
-        h0 = _rnn_state(ctx, 5, d)
+        h0 = _rnn_state(ctx, 5, d, layout)
         if h0 is not None:
             ins.append(h0)
-        ys, hT = ctx.op("simple_rnn_layer", ins, n_out=2)
-        if rev:
-            # outputs must align with INPUT time order
-            ys = ctx.op("reverse", [ys], dimensions=[1])
+        if seq_lens is not None:
+            ins.append(seq_lens)
+        rev = (direction == "reverse") or d == 1
+        ys, hT = ctx.op(
+            "onnx_rnn_seq", ins, n_out=2, reverse=rev,
+            has_state=h0 is not None, has_lens=seq_lens is not None,
+            cell_clip=clip,
+            acts=acts_by_dir[d] if acts_by_dir else None)
         ys_list.append(ys)
         h_list.append(hT)
-    return _rnn_outputs(ctx, ys_list, [h_list])
+    return _rnn_outputs(ctx, ys_list, [h_list], layout)
 
 
 @R("LayerNormalization")
@@ -860,14 +862,19 @@ def _walk_onnx_nodes(sd, nodes, tensors, const_vals, avals,
 
 def _import_onnx_subgraph(g, outer, capture_index, capture_base,
                           formal_start=0, parent_resolve=None,
-                          build_dict=True):
+                          build_dict=True, formal_avals=None,
+                          outer_avals=None):
     """Import a GraphProto as a serialized sub-graph dict.
 
     outer = (tensors, const_vals) of the ENCLOSING scope; referenced
     outer names either bake in (constants) or become capture
     placeholders at slot capture_base + capture_index[name] — the
     SHARED capture_index lets If's two branches agree on operand
-    order. Returns (dict, sub_tensors map)."""
+    order. formal_avals (aligned with g.inputs) and outer_avals (the
+    enclosing scope's aval map, consulted for captures) seed shape
+    inference inside the sub-graph — Loop scan outputs need the
+    element shape to pre-allocate their stacked buffer.
+    Returns (dict, (sub, tensors, avals))."""
     from deeplearning4j_tpu.autodiff.control_flow import (
         ARG_PREFIX, subgraph_to_dict,
     )
@@ -878,8 +885,11 @@ def _import_onnx_subgraph(g, outer, capture_index, capture_base,
     const_vals: Dict[str, np.ndarray] = {}
     avals: Dict[str, Any] = {}
     for k, vi in enumerate(g.inputs):
-        tensors[vi.name] = sub.placeholder(
-            f"{ARG_PREFIX}{formal_start + k}")
+        ph = sub.placeholder(f"{ARG_PREFIX}{formal_start + k}")
+        tensors[vi.name] = ph
+        if formal_avals is not None and k < len(formal_avals) \
+                and formal_avals[k] is not None:
+            avals[ph.name] = formal_avals[k]
     for init in g.initializers:
         arr = init.to_numpy()
         const_vals[init.name] = arr
@@ -902,8 +912,13 @@ def _import_onnx_subgraph(g, outer, capture_index, capture_base,
         if ref in o_tensors:
             if ref not in capture_index:
                 capture_index[ref] = len(capture_index)
-            return sub.placeholder(
+            ph = sub.placeholder(
                 f"{ARG_PREFIX}{capture_base + capture_index[ref]}")
+            if outer_avals is not None:
+                av = outer_avals.get(o_tensors[ref].name)
+                if av is not None:
+                    avals[ph.name] = av
+            return ph
         return None
 
     _walk_onnx_nodes(sub, g.nodes, tensors, const_vals, avals,
@@ -915,9 +930,9 @@ def _import_onnx_subgraph(g, outer, capture_index, capture_base,
                 f"sub-graph output {o.name!r} not produced")
         outs.append(tensors[o.name].name)
     if not build_dict:
-        return None, (sub, tensors)
+        return None, (sub, tensors, avals)
     d = subgraph_to_dict(sub, outs, capture_base + len(capture_index))
-    return d, (sub, tensors)
+    return d, (sub, tensors, avals)
 
 
 def _handle_if(sd, node, tensors, const_vals, avals, ins,
@@ -932,10 +947,12 @@ def _handle_if(sd, node, tensors, const_vals, avals, ins,
     outer = (tensors, const_vals)
     then_d, _ = _import_onnx_subgraph(then_g, outer, caps,
                                       capture_base=0,
-                                      parent_resolve=resolve_outer)
+                                      parent_resolve=resolve_outer,
+                                      outer_avals=avals)
     else_d, _ = _import_onnx_subgraph(else_g, outer, caps,
                                       capture_base=0,
-                                      parent_resolve=resolve_outer)
+                                      parent_resolve=resolve_outer,
+                                      outer_avals=avals)
     then_d["n_in"] = else_d["n_in"] = len(caps)
     ordered = sorted(caps, key=caps.get)
     operands = [ins[0].name] + [tensors[n].name for n in ordered]
@@ -947,10 +964,19 @@ def _handle_if(sd, node, tensors, const_vals, avals, ins,
 def _handle_loop(sd, node, tensors, const_vals, avals, ins,
                  resolve_outer):
     """ONNX Loop → while_loop. State = (iter, cond, carried...,
-    captures..., M). Scan outputs (per-iteration accumulation rows
-    beyond the carried values) are not mapped — loud error."""
+    captures..., M, scan_buffers...).
+
+    Scan outputs (per-iteration values stacked along a new axis 0) use
+    the dense-TensorArray pattern: each becomes a pre-allocated
+    ``[trips, *elem]`` buffer carried as loop state, written at the
+    iteration index each step — which requires a STATICALLY BOUNDED
+    loop (XLA needs the buffer shape at compile time), so scan outputs
+    on a dynamically-terminated Loop stay a loud error. If the loop
+    exits early, trailing rows keep their zero init (the ONNX
+    dynamic-length semantics can't exist under static shapes; counted
+    for-loops — the pattern every real exporter emits — are exact)."""
     from deeplearning4j_tpu.autodiff.control_flow import (
-        ARG_PREFIX, subgraph_to_dict,
+        ARG_PREFIX, derive_trip_count, subgraph_to_dict,
     )
 
     body_g = node.attributes.get("body")
@@ -958,25 +984,40 @@ def _handle_loop(sd, node, tensors, const_vals, avals, ins,
         raise OnnxImportError(f"{node.name or 'Loop'}: missing body")
     carried = ins[2:]
     n_carried = len(carried)
-    if len(node.output) > n_carried:
+    n_scan = len(node.output) - n_carried
+    if n_scan < 0:
         raise OnnxImportError(
-            f"{node.name or 'Loop'}: scan outputs not supported "
-            f"({len(node.output)} outputs > {n_carried} carried)")
+            f"{node.name or 'Loop'}: {len(node.output)} outputs < "
+            f"{n_carried} carried values")
     n_formal = len(body_g.inputs)          # iter, cond, carried...
     if n_formal != 2 + n_carried:
         raise OnnxImportError(
             f"{node.name or 'Loop'}: body takes {n_formal} inputs, "
             f"expected {2 + n_carried}")
+    if len(body_g.outputs) != 1 + n_carried + n_scan:
+        raise OnnxImportError(
+            f"{node.name or 'Loop'}: body returns "
+            f"{len(body_g.outputs)} values, expected "
+            f"{1 + n_carried + n_scan} (cond + carried + scan)")
+    import jax
+
     caps: Dict[str, int] = {}
-    _, (sub, sub_tensors) = _import_onnx_subgraph(
+    formal_avals = [jax.ShapeDtypeStruct((), np.int32),
+                    jax.ShapeDtypeStruct((), np.bool_)]
+    for v in carried:
+        formal_avals.append(avals.get(v.name) if v is not None
+                            else None)
+    _, (sub, sub_tensors, sub_avals) = _import_onnx_subgraph(
         body_g, (tensors, const_vals), caps, capture_base=n_formal,
-        parent_resolve=resolve_outer, build_dict=False)
+        parent_resolve=resolve_outer, build_dict=False,
+        formal_avals=formal_avals, outer_avals=avals)
     n_caps = len(caps)
-    m_slot = n_formal + n_caps             # trip count rides last
-    n_state = m_slot + 1
+    m_slot = n_formal + n_caps             # trip count rides here
+    n_state = m_slot + 1 + n_scan          # ... then scan buffers
 
     # body must return the FULL state: iter+1, cond_out, carried_out,
-    # captures (pass-through), M (pass-through)
+    # captures (pass-through), M (pass-through), buffers (written at
+    # the CURRENT iteration index)
     it_ph = sub._vars[f"{ARG_PREFIX}0"]
     one = sub.constant("loop_one", np.int32(1))
     it_next = sub._op("add", [it_ph.name, one.name])
@@ -986,11 +1027,29 @@ def _handle_loop(sd, node, tensors, const_vals, avals, ins,
             raise OnnxImportError(
                 f"Loop body output {o.name!r} not produced")
         body_outs.append(sub_tensors[o.name].name)
-    for slot in range(n_formal, n_state):
+    for slot in range(n_formal, m_slot + 1):
         phn = f"{ARG_PREFIX}{slot}"
         if phn not in sub._vars:
             sub.placeholder(phn)
         body_outs.append(phn)
+    scan_avals = []
+    for k in range(n_scan):
+        o = body_g.outputs[1 + n_carried + k]
+        if o.name not in sub_tensors:
+            raise OnnxImportError(
+                f"Loop scan output {o.name!r} not produced")
+        av = sub_avals.get(sub_tensors[o.name].name)
+        if av is None:
+            raise OnnxImportError(
+                f"{node.name or 'Loop'}: cannot infer the element "
+                f"shape of scan output {o.name!r} (needed to "
+                "pre-allocate the stacked buffer)")
+        scan_avals.append(av)
+        buf_ph = sub.placeholder(f"{ARG_PREFIX}{m_slot + 1 + k}")
+        written = sub._op("tensorarray_write",
+                          [buf_ph.name, it_ph.name,
+                           sub_tensors[o.name].name])
+        body_outs.append(written.name)
     body_full = subgraph_to_dict(sub, body_outs, n_state)
 
     # cond: iter < M (when given) AND carried cond (when given)
@@ -1029,30 +1088,40 @@ def _handle_loop(sd, node, tensors, const_vals, avals, ins,
                                  np.int32(2 ** 31 - 2))
         elif mv is not None:
             m_const = np.int32(mv)
-    operands = ([zero.name, cond0.name]
-                + [v.name for v in carried]
-                + [tensors[n].name
-                   for n in sorted(caps, key=caps.get)]
-                + [m_opnd.name])
     # static trip-count derivation makes the loop train (masked-scan
     # lowering): constant M bounds it directly; torch `while i < N`
     # exports bound it through the carried cond recomputed in the body
-    from deeplearning4j_tpu.autodiff.control_flow import (
-        derive_trip_count,
-    )
     init_consts = [np.int32(0),
                    const_vals.get(node.input[1]) if have_cond
                    else np.bool_(True)]
     init_consts += [const_vals.get(r) for r in node.input[2:]]
     init_consts += [None] * len(caps)
     init_consts += [m_const]
+    init_consts += [None] * n_scan
+    trips = derive_trip_count(cond_full, body_full, init_consts)
+    if n_scan and trips is None:
+        raise OnnxImportError(
+            f"{node.name or 'Loop'}: scan outputs need a statically "
+            "bounded loop (XLA allocates the stacked buffer at compile "
+            "time) — this Loop's trip count could not be derived "
+            "(dynamic termination)")
+    buf_names = []
+    for k, av in enumerate(scan_avals):
+        buf = sd.constant(
+            f"{node.output[0]}_scanbuf{k}",
+            np.zeros((trips,) + tuple(av.shape), av.dtype))
+        buf_names.append(buf.name)
+    operands = ([zero.name, cond0.name]
+                + [v.name for v in carried]
+                + [tensors[n].name
+                   for n in sorted(caps, key=caps.get)]
+                + [m_opnd.name] + buf_names)
     out = sd._op("while_loop", operands, n_out=n_state,
                  name=node.output[0] + "_state", cond_graph=cond_full,
-                 body_graph=body_full,
-                 max_trip_count=derive_trip_count(cond_full, body_full,
-                                                  init_consts))
+                 body_graph=body_full, max_trip_count=trips)
     out = out if isinstance(out, tuple) else (out,)
-    return tuple(out[2 + i] for i in range(len(node.output)))
+    return tuple([out[2 + i] for i in range(n_carried)]
+                 + [out[m_slot + 1 + k] for k in range(n_scan)])
 
 
 class OnnxImport:

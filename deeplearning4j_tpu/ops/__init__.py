@@ -24,7 +24,7 @@ from deeplearning4j_tpu.ops.registry import get_op, list_ops, register_op
 from deeplearning4j_tpu.ops import (  # noqa: F401 (register)
     transforms, nn, random, compression, reduce, shape, linalg, image,
     bitwise, extra_math, extra_indexing, tensor_array, tf_compat,
-    declarable_tail,
+    declarable_tail, flash_attention, onnx_compat,
 )
 # The SameDiff math module owns the canonical registrations for the
 # graph-execution op names (reduce_sum with `dimensions=`, etc. — the
@@ -32,5 +32,6 @@ from deeplearning4j_tpu.ops import (  # noqa: F401 (register)
 # available from a bare `deeplearning4j_tpu.ops` import. Cycle-safe:
 # nothing in that chain imports the ops PACKAGE, only ops.registry.
 from deeplearning4j_tpu.autodiff import ops_math as _ops_math  # noqa: F401,E402
+from deeplearning4j_tpu.autodiff import control_flow as _control_flow  # noqa: F401,E402
 
 __all__ = ["get_op", "list_ops", "register_op"]

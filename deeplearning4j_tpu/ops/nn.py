@@ -572,9 +572,10 @@ def gru_layer(x, w_ih, w_hh, b, h0=None, rb=None):
 
 @register_op("lstm_seq")
 def lstm_seq(x, w_ih, w_hh, b, h0=None, c0=None, reverse=False):
-    """lstm_layer with a FLAT (ys, hT, cT) return — graph executors
-    (SameDiff/_build_fn, the ONNX LSTM mapper) need flat multi-output
-    ops, not nested tuples."""
+    """lstm_layer with a FLAT (ys, hT, cT) return for graph executors.
+    Kept for serde back-compat: SameDiff graphs saved by earlier ONNX
+    imports reference this op name (new imports emit onnx_lstm_seq,
+    whose default flags are a superset)."""
     ys, (hT, cT) = lstm_layer(x, w_ih, w_hh, b, h0=h0, c0=c0,
                               reverse=reverse)
     return ys, hT, cT
@@ -582,9 +583,9 @@ def lstm_seq(x, w_ih, w_hh, b, h0=None, c0=None, reverse=False):
 
 @register_op("gru_seq")
 def gru_seq(x, w_ih, w_hh, b, rb, h0=None, reverse=False):
-    """gru_layer with rb POSITIONAL and a reverse flag — the argument
-    shape graph executors need (the ONNX GRU mapper can then pass the
-    recurrent bias without an initial state)."""
+    """gru_layer with rb POSITIONAL and a reverse flag. Kept for serde
+    back-compat: SameDiff graphs saved by earlier ONNX imports
+    reference this op name (new imports emit onnx_gru_seq)."""
     if reverse:
         x = jnp.flip(x, axis=1)
     ys, hT = gru_layer(x, w_ih, w_hh, b, h0=h0, rb=rb)
@@ -607,6 +608,258 @@ def simple_rnn_layer(x, w_ih, w_hh, b, h0=None, activation=jnp.tanh):
 
     hT, ys = lax.scan(step, h0, x_proj)
     return ys.transpose(1, 0, 2), hT
+
+
+# ----------------------------------------------------------------------
+# ONNX-semantics recurrent ops (reference: the reference's flexible
+# lstmLayer mapping in samediff-import-onnx covers cell clip, coupled
+# gates, per-gate activations and ragged sequence lengths — SURVEY.md
+# §2.14). Same TPU decomposition as lstm_layer (one big MXU input
+# projection + lax.scan recurrence), with the full ONNX option set.
+# ----------------------------------------------------------------------
+def _onnx_act(spec):
+    """ONNX activation spec (name, alpha, beta) -> elementwise fn."""
+    name, alpha, beta = spec
+    n = name.lower()
+    if n == "sigmoid":
+        return jax.nn.sigmoid
+    if n == "tanh":
+        return jnp.tanh
+    if n == "relu":
+        return jax.nn.relu
+    if n == "leakyrelu":
+        a = 0.01 if alpha is None else alpha
+        return lambda v: jnp.where(v >= 0, v, a * v)
+    if n == "hardsigmoid":
+        a = 0.2 if alpha is None else alpha
+        c = 0.5 if beta is None else beta
+        return lambda v: jnp.clip(a * v + c, 0.0, 1.0)
+    if n == "elu":
+        a = 1.0 if alpha is None else alpha
+        return lambda v: jnp.where(v > 0, v, a * (jnp.exp(v) - 1.0))
+    if n == "softsign":
+        return jax.nn.soft_sign
+    if n == "softplus":
+        return jax.nn.softplus
+    if n == "affine":
+        a = 1.0 if alpha is None else alpha
+        c = 0.0 if beta is None else beta
+        return lambda v: a * v + c
+    if n == "thresholdedrelu":
+        a = 1.0 if alpha is None else alpha
+        return lambda v: jnp.where(v > a, v, jnp.zeros_like(v))
+    raise ValueError(f"unsupported RNN activation {name!r}")
+
+
+def _rev_seq(x, lens):
+    """Per-element time reversal within each sequence's length:
+    x [N, T, ...], lens [N]. Delegates to the canonical
+    reverse_sequence op (ops/shape.py) — ONE implementation of the
+    tf.reverse_sequence semantics in the codebase."""
+    from deeplearning4j_tpu.ops.shape import reverse_sequence
+    return reverse_sequence(x, lens.astype(jnp.int32), seq_axis=1,
+                            batch_axis=0)
+
+
+def _maybe_clip(v, clip):
+    return jnp.clip(v, -clip, clip) if clip else v
+
+
+@register_op("onnx_lstm_seq")
+def onnx_lstm_seq(x, w_ih, w_hh, b, *rest, has_state=False,
+                  has_lens=False, has_peep=False, reverse=False,
+                  cell_clip=0.0, input_forget=False, acts=None):
+    """ONNX LSTM for one direction, full option set.
+
+    x: [N,T,in]; w_ih: [in,4H]; w_hh: [H,4H]; b: [4H] (our i,f,g,o gate
+    order — the importer re-packs from ONNX iofc). `rest` holds the
+    optional traced inputs in order, gated by the has_* flags (graph
+    executors pass a flat positional input list): h0 [N,H] + c0 [N,H]
+    if has_state, seq_lens [N] int if has_lens, peep [3,H] as
+    (p_i, p_f, p_o) if has_peep.
+
+    acts: 3 (name, alpha, beta) triples for (f, g, h); default
+    (sigmoid, tanh, tanh). cell_clip clamps every gate pre-activation
+    to [-clip, clip] (the ONNX "applied to input of activations" rule);
+    input_forget=True couples f = 1 - i.
+
+    seq_lens semantics match onnxruntime: Y rows at t >= len are 0,
+    the returned h/c freeze at each element's last valid step, and the
+    reverse direction runs over each element's OWN prefix reversed.
+    Returns (ys [N,T,H], hT [N,H], cT [N,H]).
+    """
+    n, t, _ = x.shape
+    hidden = w_hh.shape[0]
+    k = 0
+    if has_state:
+        h0, c0 = rest[0], rest[1]
+        k = 2
+    else:
+        h0 = jnp.zeros((n, hidden), x.dtype)
+        c0 = jnp.zeros((n, hidden), x.dtype)
+    seq_lens = None
+    if has_lens:
+        seq_lens = rest[k]
+        k += 1
+    peep = rest[k] if has_peep else None
+    f_act, g_act, h_act = [
+        _onnx_act(s) for s in (acts or
+                               (("sigmoid", None, None),
+                                ("tanh", None, None),
+                                ("tanh", None, None)))]
+    lens = None if seq_lens is None else seq_lens.astype(jnp.int32)
+    if reverse:
+        x = _rev_seq(x, lens) if lens is not None else jnp.flip(x, 1)
+    x_proj = (x.reshape(n * t, -1) @ w_ih + b) \
+        .reshape(n, t, 4 * hidden).transpose(1, 0, 2)
+    tt = jnp.arange(t, dtype=jnp.int32)
+
+    def step(carry, inp):
+        h, c = carry
+        xp, ti = inp
+        gates = xp + h @ w_hh
+        gi, gf, gg, go = jnp.split(gates, 4, axis=-1)
+        if peep is not None:
+            gi = gi + peep[0] * c
+            gf = gf + peep[1] * c
+        i = f_act(_maybe_clip(gi, cell_clip))
+        f = (1.0 - i) if input_forget \
+            else f_act(_maybe_clip(gf, cell_clip))
+        g = g_act(_maybe_clip(gg, cell_clip))
+        c2 = f * c + i * g
+        if peep is not None:
+            go = go + peep[2] * c2
+        o = f_act(_maybe_clip(go, cell_clip))
+        h2 = o * h_act(c2)
+        if lens is not None:
+            valid = (ti < lens)[:, None]
+            y = jnp.where(valid, h2, jnp.zeros_like(h2))
+            h2 = jnp.where(valid, h2, h)
+            c2 = jnp.where(valid, c2, c)
+        else:
+            y = h2
+        return (h2, c2), y
+
+    (hT, cT), ys = lax.scan(step, (h0, c0), (x_proj, tt))
+    ys = ys.transpose(1, 0, 2)
+    if reverse:
+        ys = _rev_seq(ys, lens) if lens is not None else jnp.flip(ys, 1)
+    return ys, hT, cT
+
+
+@register_op("onnx_gru_seq")
+def onnx_gru_seq(x, w_ih, w_hh, wb, rb, *rest, has_state=False,
+                 has_lens=False, reverse=False,
+                 linear_before_reset=True, cell_clip=0.0, acts=None):
+    """ONNX GRU for one direction, full option set.
+
+    x: [N,T,in]; w_ih: [in,3H]; w_hh: [H,3H]; wb/rb: [3H] (our r,z,n
+    gate order — importer re-packs from ONNX zrh). `rest` holds h0
+    [N,H] if has_state then seq_lens [N] if has_lens. acts: 2 triples
+    for (f, g); default (sigmoid, tanh).
+
+    linear_before_reset=True (torch's form): n = g(xn + r*(h@Rn + rbn)).
+    linear_before_reset=False (the ONNX DEFAULT, what keras/sklearn
+    exporters emit): n = g(xn + (r*h)@Rn + rbn) — the reset gate is
+    applied to the state BEFORE the recurrent matmul.
+    Returns (ys [N,T,H], hT [N,H]).
+    """
+    n, t, _ = x.shape
+    hidden = w_hh.shape[0]
+    k = 0
+    if has_state:
+        h0 = rest[0]
+        k = 1
+    else:
+        h0 = jnp.zeros((n, hidden), x.dtype)
+    seq_lens = rest[k] if has_lens else None
+    f_act, g_act = [
+        _onnx_act(s) for s in (acts or (("sigmoid", None, None),
+                                        ("tanh", None, None)))]
+    lens = None if seq_lens is None else seq_lens.astype(jnp.int32)
+    if reverse:
+        x = _rev_seq(x, lens) if lens is not None else jnp.flip(x, 1)
+    x_proj = (x.reshape(n * t, -1) @ w_ih + wb) \
+        .reshape(n, t, 3 * hidden).transpose(1, 0, 2)
+    w_hh_rz = w_hh[:, :2 * hidden]
+    rb_rz = rb[:2 * hidden]
+    w_hh_n = w_hh[:, 2 * hidden:]
+    rb_n = rb[2 * hidden:]
+    tt = jnp.arange(t, dtype=jnp.int32)
+
+    def step(h, inp):
+        xp, ti = inp
+        xr, xz, xn = jnp.split(xp, 3, axis=-1)
+        if linear_before_reset:
+            hp = h @ w_hh + rb
+            hr, hz, hn = jnp.split(hp, 3, axis=-1)
+        else:
+            # reset-before form recomputes the n projection on r*h, so
+            # projecting the n column here would be a wasted matmul
+            hr, hz = jnp.split(h @ w_hh_rz + rb_rz, 2, axis=-1)
+        r = f_act(_maybe_clip(xr + hr, cell_clip))
+        z = f_act(_maybe_clip(xz + hz, cell_clip))
+        if linear_before_reset:
+            n_pre = xn + r * hn
+        else:
+            n_pre = xn + (r * h) @ w_hh_n + rb_n
+        nn_ = g_act(_maybe_clip(n_pre, cell_clip))
+        h2 = (1.0 - z) * nn_ + z * h
+        if lens is not None:
+            valid = (ti < lens)[:, None]
+            y = jnp.where(valid, h2, jnp.zeros_like(h2))
+            h2 = jnp.where(valid, h2, h)
+        else:
+            y = h2
+        return h2, y
+
+    hT, ys = lax.scan(step, h0, (x_proj, tt))
+    ys = ys.transpose(1, 0, 2)
+    if reverse:
+        ys = _rev_seq(ys, lens) if lens is not None else jnp.flip(ys, 1)
+    return ys, hT
+
+
+@register_op("onnx_rnn_seq")
+def onnx_rnn_seq(x, w_ih, w_hh, b, *rest, has_state=False,
+                 has_lens=False, reverse=False, cell_clip=0.0,
+                 acts=None):
+    """ONNX vanilla RNN for one direction: h2 = f(x@W + h@R + b), with
+    the same rest/has_* convention and seq_lens / clip / activation
+    handling as the LSTM/GRU ops. Returns (ys [N,T,H], hT [N,H])."""
+    n, t, _ = x.shape
+    hidden = w_hh.shape[0]
+    k = 0
+    if has_state:
+        h0 = rest[0]
+        k = 1
+    else:
+        h0 = jnp.zeros((n, hidden), x.dtype)
+    seq_lens = rest[k] if has_lens else None
+    f_act = _onnx_act(acts[0] if acts else ("tanh", None, None))
+    lens = None if seq_lens is None else seq_lens.astype(jnp.int32)
+    if reverse:
+        x = _rev_seq(x, lens) if lens is not None else jnp.flip(x, 1)
+    x_proj = (x.reshape(n * t, -1) @ w_ih + b) \
+        .reshape(n, t, hidden).transpose(1, 0, 2)
+    tt = jnp.arange(t, dtype=jnp.int32)
+
+    def step(h, inp):
+        xp, ti = inp
+        h2 = f_act(_maybe_clip(xp + h @ w_hh, cell_clip))
+        if lens is not None:
+            valid = (ti < lens)[:, None]
+            y = jnp.where(valid, h2, jnp.zeros_like(h2))
+            h2 = jnp.where(valid, h2, h)
+        else:
+            y = h2
+        return h2, y
+
+    hT, ys = lax.scan(step, h0, (x_proj, tt))
+    ys = ys.transpose(1, 0, 2)
+    if reverse:
+        ys = _rev_seq(ys, lens) if lens is not None else jnp.flip(ys, 1)
+    return ys, hT
 
 
 # ----------------------------------------------------------------------

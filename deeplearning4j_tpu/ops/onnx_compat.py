@@ -1,0 +1,38 @@
+"""ONNX-semantics helper ops (reference: samediff-import-onnx's
+per-op attribute adapters, SURVEY.md §2.14 — op SEMANTICS live with the
+op set so a bare ``import deeplearning4j_tpu.ops`` registers the full
+registry; the importer module only maps nodes onto these names)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.ops.registry import register_op
+
+
+@register_op("onnx_reshape")
+def onnx_reshape(x, shape):
+    """ONNX Reshape: 0 copies the input dim, -1 infers."""
+    resolved = [x.shape[i] if s == 0 else int(s)
+                for i, s in enumerate(shape)] if 0 in list(shape) \
+        else [int(s) for s in shape]
+    return jnp.reshape(x, tuple(resolved))
+
+
+@register_op("onnx_flatten")
+def onnx_flatten(x, axis=1):
+    lead = 1
+    for d in x.shape[:axis]:
+        lead *= d
+    return jnp.reshape(x, (lead, -1))
+
+
+@register_op("onnx_slice")
+def onnx_slice(x, starts, ends, axes, steps):
+    idx = [slice(None)] * x.ndim
+    for st, en, ax, sp in zip(starts, ends, axes, steps):
+        n = x.shape[ax]
+        en = min(en, n) if en >= 0 else en
+        idx[ax] = slice(st, en, sp)
+    return x[tuple(idx)]
+# (broadcast_to: canonical registration lives in ops/shape.py)

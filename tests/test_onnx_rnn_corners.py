@@ -1,0 +1,550 @@
+"""ONNX recurrent-operator corner coverage: GRU linear_before_reset=0,
+LSTM cell clip / input_forget coupling / peepholes / non-default
+activations, sequence_lens < T (incl. bidirectional reverse-prefix
+semantics), and layout=1 batch-major tensors.
+
+Reference model: the reference maps these through nd4j's flexible
+lstmLayer (samediff-import-onnx, SURVEY.md §2.14). No onnxruntime in
+this image and torch cannot express most of these configs, so the
+goldens are hand-built protos (tiny encoder from test_onnx_import)
+checked against an INDEPENDENT plain-loop numpy implementation of the
+ONNX spec equations.
+"""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.modelimport.onnx.onnx_import import (
+    OnnxImport, OnnxImportError,
+)
+from tests.test_onnx_import import (
+    _iv, _ld, _str, attr_float, attr_int, attr_ints, graph, model,
+    node, tensor, value_info,
+)
+
+
+# ------------------------------------------------ encoder additions
+def attr_str(name: str, v: str) -> bytes:
+    return _str(1, name) + _ld(4, v.encode()) + _iv(20, 3)
+
+
+def attr_strs(name: str, vs) -> bytes:
+    return _str(1, name) + b"".join(_ld(9, v.encode()) for v in vs) \
+        + _iv(20, 8)
+
+
+def attr_floats(name: str, vs) -> bytes:
+    import struct
+    return _str(1, name) + _ld(
+        7, b"".join(struct.pack("<f", float(v)) for v in vs)) \
+        + _iv(20, 6)
+
+
+def attr_graph(name: str, g: bytes) -> bytes:
+    return _str(1, name) + _ld(6, g) + _iv(20, 5)
+
+
+def tensor_any(name: str, arr: np.ndarray) -> bytes:
+    """tensor() plus bool support (dtype 9)."""
+    if arr.dtype == np.bool_:
+        out = b"".join(_iv(1, d) for d in arr.shape)
+        out += _iv(2, 9)
+        out += _str(8, name)
+        out += _ld(9, arr.tobytes())
+        return out
+    return tensor(name, arr)
+
+
+# ------------------------------------------- numpy spec reference
+def _act(spec):
+    name, alpha, beta = spec
+    n = name.lower()
+    if n == "sigmoid":
+        return lambda v: 1.0 / (1.0 + np.exp(-v))
+    if n == "tanh":
+        return np.tanh
+    if n == "relu":
+        return lambda v: np.maximum(v, 0.0)
+    if n == "leakyrelu":
+        a = 0.01 if alpha is None else alpha
+        return lambda v: np.where(v >= 0, v, a * v)
+    if n == "hardsigmoid":
+        a = 0.2 if alpha is None else alpha
+        c = 0.5 if beta is None else beta
+        return lambda v: np.clip(a * v + c, 0.0, 1.0)
+    if n == "affine":
+        a = 1.0 if alpha is None else alpha
+        c = 0.0 if beta is None else beta
+        return lambda v: a * v + c
+    raise ValueError(name)
+
+
+def _clip(v, c):
+    return np.clip(v, -c, c) if c else v
+
+
+def ref_lstm(x, W, R, B, P=None, h0=None, c0=None, lens=None,
+             direction="forward", clip=0.0, input_forget=False,
+             acts=None):
+    """Plain-loop ONNX LSTM: x [T,N,in]; W [dirs,4H,in] iofc;
+    R [dirs,4H,H]; B [dirs,8H]; P [dirs,3H] (p_i,p_o,p_f).
+    Returns Y [T,dirs,N,H], Yh [dirs,N,H], Yc [dirs,N,H]."""
+    T, N, _ = x.shape
+    dirs = W.shape[0]
+    H = R.shape[2]
+    Y = np.zeros((T, dirs, N, H), np.float64)
+    Yh = np.zeros((dirs, N, H), np.float64)
+    Yc = np.zeros((dirs, N, H), np.float64)
+    for d in range(dirs):
+        f_a, g_a, h_a = [
+            _act(s) for s in (acts[d] if acts else
+                              [("sigmoid", None, None),
+                               ("tanh", None, None),
+                               ("tanh", None, None)])]
+        Wi, Wo, Wf, Wc = np.split(W[d], 4)
+        Ri, Ro, Rf, Rc = np.split(R[d], 4)
+        wb = np.split(B[d][:4 * H], 4)
+        rb = np.split(B[d][4 * H:], 4)
+        pi = P[d][:H] if P is not None else 0.0
+        po = P[d][H:2 * H] if P is not None else 0.0
+        pf = P[d][2 * H:] if P is not None else 0.0
+        rev = (direction == "reverse") or d == 1
+        for n_ in range(N):
+            ln = int(lens[n_]) if lens is not None else T
+            h = (h0[d, n_] if h0 is not None else np.zeros(H)).copy()
+            c = (c0[d, n_] if c0 is not None else np.zeros(H)).copy()
+            order = range(ln - 1, -1, -1) if rev else range(ln)
+            for t in order:
+                xt = x[t, n_]
+                it = f_a(_clip(xt @ Wi.T + h @ Ri.T + pi * c
+                               + wb[0] + rb[0], clip))
+                if input_forget:
+                    ft = 1.0 - it
+                else:
+                    ft = f_a(_clip(xt @ Wf.T + h @ Rf.T + pf * c
+                                   + wb[2] + rb[2], clip))
+                ct = g_a(_clip(xt @ Wc.T + h @ Rc.T
+                               + wb[3] + rb[3], clip))
+                c = ft * c + it * ct
+                ot = f_a(_clip(xt @ Wo.T + h @ Ro.T + po * c
+                               + wb[1] + rb[1], clip))
+                h = ot * h_a(c)
+                Y[t, d, n_] = h
+            Yh[d, n_] = h
+            Yc[d, n_] = c
+    return Y, Yh, Yc
+
+
+def ref_gru(x, W, R, B, h0=None, lens=None, direction="forward",
+            clip=0.0, linear_before_reset=0, acts=None):
+    """Plain-loop ONNX GRU: W [dirs,3H,in] zrh; B [dirs,6H].
+    Returns Y [T,dirs,N,H], Yh [dirs,N,H]."""
+    T, N, _ = x.shape
+    dirs = W.shape[0]
+    H = R.shape[2]
+    Y = np.zeros((T, dirs, N, H), np.float64)
+    Yh = np.zeros((dirs, N, H), np.float64)
+    for d in range(dirs):
+        f_a, g_a = [
+            _act(s) for s in (acts[d] if acts else
+                              [("sigmoid", None, None),
+                               ("tanh", None, None)])]
+        Wz, Wr, Wh = np.split(W[d], 3)
+        Rz, Rr, Rh = np.split(R[d], 3)
+        wbz, wbr, wbh = np.split(B[d][:3 * H], 3)
+        rbz, rbr, rbh = np.split(B[d][3 * H:], 3)
+        rev = (direction == "reverse") or d == 1
+        for n_ in range(N):
+            ln = int(lens[n_]) if lens is not None else T
+            h = (h0[d, n_] if h0 is not None else np.zeros(H)).copy()
+            order = range(ln - 1, -1, -1) if rev else range(ln)
+            for t in order:
+                xt = x[t, n_]
+                zt = f_a(_clip(xt @ Wz.T + h @ Rz.T + wbz + rbz, clip))
+                rt = f_a(_clip(xt @ Wr.T + h @ Rr.T + wbr + rbr, clip))
+                if linear_before_reset:
+                    ht = g_a(_clip(xt @ Wh.T + rt * (h @ Rh.T + rbh)
+                                   + wbh, clip))
+                else:
+                    ht = g_a(_clip(xt @ Wh.T + (rt * h) @ Rh.T
+                                   + rbh + wbh, clip))
+                h = (1.0 - zt) * ht + zt * h
+                Y[t, d, n_] = h
+            Yh[d, n_] = h
+    return Y, Yh
+
+
+def ref_rnn(x, W, R, B, h0=None, lens=None, direction="forward",
+            clip=0.0, acts=None):
+    T, N, _ = x.shape
+    dirs = W.shape[0]
+    H = R.shape[2]
+    Y = np.zeros((T, dirs, N, H), np.float64)
+    Yh = np.zeros((dirs, N, H), np.float64)
+    for d in range(dirs):
+        f_a = _act(acts[d][0] if acts else ("tanh", None, None))
+        rev = (direction == "reverse") or d == 1
+        for n_ in range(N):
+            ln = int(lens[n_]) if lens is not None else T
+            h = (h0[d, n_] if h0 is not None else np.zeros(H)).copy()
+            order = range(ln - 1, -1, -1) if rev else range(ln)
+            for t in order:
+                h = f_a(_clip(x[t, n_] @ W[d].T + h @ R[d].T
+                              + B[d][:H] + B[d][H:], clip))
+                Y[t, d, n_] = h
+            Yh[d, n_] = h
+    return Y, Yh
+
+
+# ------------------------------------------------- model builders
+def _build_rnn_model(op, T, N, I, H, dirs, W, R, B, attrs,
+                     lens=None, h0=None, c0=None, P=None,
+                     n_out=2, layout=0):
+    inits = [tensor("W", W.astype(np.float32)),
+             tensor("R", R.astype(np.float32)),
+             tensor("B", B.astype(np.float32))]
+    ins = ["x", "W", "R", "B"]
+    if lens is not None:
+        inits.append(tensor("lens", lens.astype(np.int32)))
+        ins.append("lens")
+    else:
+        ins.append("")
+    if h0 is not None:
+        inits.append(tensor("h0", h0.astype(np.float32)))
+        ins.append("h0")
+    elif c0 is not None or P is not None:
+        ins.append("")
+    if c0 is not None:
+        inits.append(tensor("c0", c0.astype(np.float32)))
+        ins.append("c0")
+    elif P is not None and op == "LSTM":
+        ins.append("")
+    if P is not None:
+        inits.append(tensor("P", P.astype(np.float32)))
+        ins.append("P")
+    while ins and ins[-1] == "":
+        ins.pop()
+    outs = [f"y{k}" for k in range(n_out)]
+    x_shape = [N, T, I] if layout else [T, N, I]
+    g = graph([node(op, ins, outs, attrs=attrs)], inits,
+              [value_info("x", x_shape)],
+              [value_info(o, [1]) for o in outs])
+    return model(g, opset=14)
+
+
+def _run(model_bytes, x):
+    sd = OnnxImport.importGraph(OnnxImport._as_model(model_bytes))
+    phs = [v.name for v in sd.variables()
+           if v.vtype.value == "PLACEHOLDER"]
+    outs = [od for od in sd._ops]
+    names = [f"y{k}" for k in range(8) if sd.hasVariable(f"y{k}")]
+    res = sd.output({phs[0]: x.astype(np.float32)}, names)
+    return sd, [np.asarray(res[n]) for n in names]
+
+
+def _mk(rs, *shape):
+    return rs.normal(0, 0.4, shape)
+
+
+RTOL, ATOL = 2e-4, 2e-5
+
+
+class TestGruResetBefore:
+    def test_linear_before_reset_0(self):
+        rs = np.random.RandomState(0)
+        T, N, I, H = 5, 3, 4, 6
+        W, R, B = _mk(rs, 1, 3 * H, I), _mk(rs, 1, 3 * H, H), \
+            _mk(rs, 1, 6 * H)
+        x = _mk(rs, T, N, I)
+        m = _build_rnn_model("GRU", T, N, I, H, 1, W, R, B,
+                             [attr_int("hidden_size", H),
+                              attr_int("linear_before_reset", 0)])
+        _, got = _run(m, x)
+        Y, Yh = ref_gru(x, W, R, B, linear_before_reset=0)
+        np.testing.assert_allclose(got[0], Y, rtol=RTOL, atol=ATOL)
+        np.testing.assert_allclose(got[1], Yh, rtol=RTOL, atol=ATOL)
+
+    def test_both_forms_differ(self):
+        # premise guard: the two forms must actually disagree on this
+        # data, otherwise the lbr=0 test proves nothing
+        rs = np.random.RandomState(1)
+        T, N, I, H = 4, 2, 3, 5
+        W, R, B = _mk(rs, 1, 3 * H, I), _mk(rs, 1, 3 * H, H), \
+            _mk(rs, 1, 6 * H)
+        x = _mk(rs, T, N, I)
+        y0, _ = ref_gru(x, W, R, B, linear_before_reset=0)
+        y1, _ = ref_gru(x, W, R, B, linear_before_reset=1)
+        assert np.abs(y0 - y1).max() > 1e-4
+
+    def test_linear_before_reset_0_bidirectional(self):
+        rs = np.random.RandomState(2)
+        T, N, I, H = 5, 2, 3, 4
+        W, R, B = _mk(rs, 2, 3 * H, I), _mk(rs, 2, 3 * H, H), \
+            _mk(rs, 2, 6 * H)
+        x = _mk(rs, T, N, I)
+        m = _build_rnn_model("GRU", T, N, I, H, 2, W, R, B,
+                             [attr_int("hidden_size", H),
+                              attr_int("linear_before_reset", 0),
+                              attr_str("direction", "bidirectional")])
+        _, got = _run(m, x)
+        Y, Yh = ref_gru(x, W, R, B, direction="bidirectional",
+                        linear_before_reset=0)
+        np.testing.assert_allclose(got[0], Y, rtol=RTOL, atol=ATOL)
+        np.testing.assert_allclose(got[1], Yh, rtol=RTOL, atol=ATOL)
+
+
+class TestLstmCorners:
+    def _wrb(self, rs, dirs, I, H):
+        return _mk(rs, dirs, 4 * H, I), _mk(rs, dirs, 4 * H, H), \
+            _mk(rs, dirs, 8 * H)
+
+    def test_cell_clip(self):
+        rs = np.random.RandomState(3)
+        T, N, I, H = 5, 2, 4, 3
+        W, R, B = self._wrb(rs, 1, I, H)
+        x = _mk(rs, T, N, I) * 3.0   # large inputs so the clip BITES
+        m = _build_rnn_model("LSTM", T, N, I, H, 1, W, R, B,
+                             [attr_int("hidden_size", H),
+                              attr_float("clip", 0.4)], n_out=3)
+        _, got = _run(m, x)
+        Y, Yh, Yc = ref_lstm(x, W, R, B, clip=0.4)
+        Y_noclip, _, _ = ref_lstm(x, W, R, B)
+        assert np.abs(Y - Y_noclip).max() > 1e-3  # premise guard
+        np.testing.assert_allclose(got[0], Y, rtol=RTOL, atol=ATOL)
+        np.testing.assert_allclose(got[2], Yc, rtol=RTOL, atol=ATOL)
+
+    def test_input_forget_coupling(self):
+        rs = np.random.RandomState(4)
+        T, N, I, H = 4, 2, 3, 5
+        W, R, B = self._wrb(rs, 1, I, H)
+        x = _mk(rs, T, N, I)
+        m = _build_rnn_model("LSTM", T, N, I, H, 1, W, R, B,
+                             [attr_int("hidden_size", H),
+                              attr_int("input_forget", 1)], n_out=3)
+        _, got = _run(m, x)
+        Y, Yh, Yc = ref_lstm(x, W, R, B, input_forget=True)
+        Y_plain, _, _ = ref_lstm(x, W, R, B)
+        assert np.abs(Y - Y_plain).max() > 1e-3
+        np.testing.assert_allclose(got[0], Y, rtol=RTOL, atol=ATOL)
+        np.testing.assert_allclose(got[1], Yh, rtol=RTOL, atol=ATOL)
+
+    def test_peepholes(self):
+        rs = np.random.RandomState(5)
+        T, N, I, H = 4, 2, 3, 4
+        W, R, B = self._wrb(rs, 1, I, H)
+        P = _mk(rs, 1, 3 * H)
+        x = _mk(rs, T, N, I)
+        m = _build_rnn_model("LSTM", T, N, I, H, 1, W, R, B,
+                             [attr_int("hidden_size", H)], P=P,
+                             n_out=3)
+        _, got = _run(m, x)
+        Y, Yh, Yc = ref_lstm(x, W, R, B, P=P)
+        Y_plain, _, _ = ref_lstm(x, W, R, B)
+        assert np.abs(Y - Y_plain).max() > 1e-3
+        np.testing.assert_allclose(got[0], Y, rtol=RTOL, atol=ATOL)
+        np.testing.assert_allclose(got[2], Yc, rtol=RTOL, atol=ATOL)
+
+    def test_nondefault_activations(self):
+        rs = np.random.RandomState(6)
+        T, N, I, H = 4, 2, 3, 4
+        W, R, B = self._wrb(rs, 1, I, H)
+        x = _mk(rs, T, N, I)
+        acts = [[("hardsigmoid", 0.25, 0.55), ("relu", None, None),
+                 ("tanh", None, None)]]
+        m = _build_rnn_model(
+            "LSTM", T, N, I, H, 1, W, R, B,
+            [attr_int("hidden_size", H),
+             attr_strs("activations", ["HardSigmoid", "Relu", "Tanh"]),
+             attr_floats("activation_alpha", [0.25, 0.0, 0.0]),
+             attr_floats("activation_beta", [0.55, 0.0, 0.0])],
+            n_out=3)
+        _, got = _run(m, x)
+        Y, Yh, Yc = ref_lstm(x, W, R, B, acts=acts)
+        np.testing.assert_allclose(got[0], Y, rtol=RTOL, atol=ATOL)
+        np.testing.assert_allclose(got[1], Yh, rtol=RTOL, atol=ATOL)
+
+    def test_layout_1_batch_major(self):
+        rs = np.random.RandomState(7)
+        T, N, I, H = 5, 3, 4, 2
+        W, R, B = self._wrb(rs, 2, I, H)
+        x = _mk(rs, T, N, I)
+        m = _build_rnn_model("LSTM", T, N, I, H, 2, W, R, B,
+                             [attr_int("hidden_size", H),
+                              attr_int("layout", 1),
+                              attr_str("direction", "bidirectional")],
+                             n_out=3, layout=1)
+        _, got = _run(m, x.transpose(1, 0, 2))  # feed [N,T,I]
+        Y, Yh, Yc = ref_lstm(x, W, R, B, direction="bidirectional")
+        # layout=1: Y [N,T,dirs,H]; states [N,dirs,H]
+        np.testing.assert_allclose(got[0], Y.transpose(2, 0, 1, 3),
+                                   rtol=RTOL, atol=ATOL)
+        np.testing.assert_allclose(got[1], Yh.transpose(1, 0, 2),
+                                   rtol=RTOL, atol=ATOL)
+        np.testing.assert_allclose(got[2], Yc.transpose(1, 0, 2),
+                                   rtol=RTOL, atol=ATOL)
+
+
+class TestSequenceLens:
+    def test_lstm_ragged_forward(self):
+        rs = np.random.RandomState(8)
+        T, N, I, H = 6, 3, 4, 5
+        W = _mk(rs, 1, 4 * H, I)
+        R = _mk(rs, 1, 4 * H, H)
+        B = _mk(rs, 1, 8 * H)
+        lens = np.array([6, 3, 1])
+        x = _mk(rs, T, N, I)
+        m = _build_rnn_model("LSTM", T, N, I, H, 1, W, R, B,
+                             [attr_int("hidden_size", H)], lens=lens,
+                             n_out=3)
+        _, got = _run(m, x)
+        Y, Yh, Yc = ref_lstm(x, W, R, B, lens=lens)
+        np.testing.assert_allclose(got[0], Y, rtol=RTOL, atol=ATOL)
+        np.testing.assert_allclose(got[1], Yh, rtol=RTOL, atol=ATOL)
+        np.testing.assert_allclose(got[2], Yc, rtol=RTOL, atol=ATOL)
+        # rows past each length are exactly zero
+        assert np.all(got[0][3:, 0, 1] == 0)
+        assert np.all(got[0][1:, 0, 2] == 0)
+
+    def test_lstm_ragged_bidirectional(self):
+        """Reverse direction must run over each element's OWN prefix
+        reversed (reverse_sequence semantics), not the padded tail."""
+        rs = np.random.RandomState(9)
+        T, N, I, H = 5, 3, 3, 4
+        W = _mk(rs, 2, 4 * H, I)
+        R = _mk(rs, 2, 4 * H, H)
+        B = _mk(rs, 2, 8 * H)
+        lens = np.array([5, 2, 4])
+        x = _mk(rs, T, N, I)
+        m = _build_rnn_model("LSTM", T, N, I, H, 2, W, R, B,
+                             [attr_int("hidden_size", H),
+                              attr_str("direction", "bidirectional")],
+                             lens=lens, n_out=3)
+        _, got = _run(m, x)
+        Y, Yh, Yc = ref_lstm(x, W, R, B, lens=lens,
+                             direction="bidirectional")
+        np.testing.assert_allclose(got[0], Y, rtol=RTOL, atol=ATOL)
+        np.testing.assert_allclose(got[1], Yh, rtol=RTOL, atol=ATOL)
+        np.testing.assert_allclose(got[2], Yc, rtol=RTOL, atol=ATOL)
+
+    def test_gru_ragged_with_state(self):
+        rs = np.random.RandomState(10)
+        T, N, I, H = 5, 2, 3, 4
+        W, R, B = _mk(rs, 1, 3 * H, I), _mk(rs, 1, 3 * H, H), \
+            _mk(rs, 1, 6 * H)
+        h0 = _mk(rs, 1, N, H)
+        lens = np.array([4, 2])
+        x = _mk(rs, T, N, I)
+        m = _build_rnn_model("GRU", T, N, I, H, 1, W, R, B,
+                             [attr_int("hidden_size", H),
+                              attr_int("linear_before_reset", 1)],
+                             lens=lens, h0=h0)
+        _, got = _run(m, x)
+        Y, Yh = ref_gru(x, W, R, B, h0=h0, lens=lens,
+                        linear_before_reset=1)
+        np.testing.assert_allclose(got[0], Y, rtol=RTOL, atol=ATOL)
+        np.testing.assert_allclose(got[1], Yh, rtol=RTOL, atol=ATOL)
+
+    def test_rnn_relu_ragged_reverse(self):
+        rs = np.random.RandomState(11)
+        T, N, I, H = 5, 2, 3, 4
+        W, R, B = _mk(rs, 1, H, I), _mk(rs, 1, H, H), _mk(rs, 1, 2 * H)
+        lens = np.array([3, 5])
+        x = _mk(rs, T, N, I)
+        m = _build_rnn_model("RNN", T, N, I, H, 1, W, R, B,
+                             [attr_int("hidden_size", H),
+                              attr_str("direction", "reverse"),
+                              attr_strs("activations", ["Relu"])],
+                             lens=lens)
+        _, got = _run(m, x)
+        Y, Yh = ref_rnn(x, W, R, B, lens=lens, direction="reverse",
+                        acts=[[("relu", None, None)]])
+        np.testing.assert_allclose(got[0], Y, rtol=RTOL, atol=ATOL)
+        np.testing.assert_allclose(got[1], Yh, rtol=RTOL, atol=ATOL)
+
+
+class TestLoopScanOutputs:
+    """ONNX Loop scan outputs via the dense-buffer pattern (the same
+    dense-TA design the TF importer uses): per-iteration values stack
+    into a [trips, *elem] buffer carried as loop state."""
+
+    def _model(self, M=3):
+        one = tensor("one", np.full((2,), 0.5, np.float32))
+        body_nodes = [
+            node("Identity", ["cond_in"], ["cond_out"]),
+            node("Add", ["c_in", "one"], ["c_out"]),
+            node("Mul", ["c_out", "c_out"], ["scan_val"]),
+        ]
+        body = graph(body_nodes, [one],
+                     [value_info("iter", []), value_info("cond_in", []),
+                      value_info("c_in", [2])],
+                     [value_info("cond_out", []),
+                      value_info("c_out", [2]),
+                      value_info("scan_val", [2])])
+        inits = [tensor("M", np.array(M, np.int64)),
+                 tensor_any("cond0", np.array(True))]
+        g = graph([node("Loop", ["M", "cond0", "x"],
+                        ["final", "stacked"],
+                        attrs=[attr_graph("body", body)])],
+                  inits, [value_info("x", [2])],
+                  [value_info("final", [2]),
+                   value_info("stacked", [M, 2])])
+        return model(g, opset=14)
+
+    def test_forward_matches_numpy(self):
+        x = np.array([1.0, -2.0], np.float32)
+        sd = OnnxImport.importGraph(OnnxImport._as_model(self._model()))
+        res = sd.output({"x": x}, ["final", "stacked"])
+        c = x.astype(np.float64)
+        rows = []
+        for _ in range(3):
+            c = c + 0.5
+            rows.append(c * c)
+        np.testing.assert_allclose(np.asarray(res["final"]), c,
+                                   rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(res["stacked"]),
+                                   np.stack(rows), rtol=1e-6)
+
+    def test_grad_flows_through_scan_output(self):
+        import jax
+        import jax.numpy as jnp
+
+        sd = OnnxImport.importGraph(OnnxImport._as_model(self._model()))
+        fn = sd._build_fn(("stacked",))
+        arrays = dict(sd._arrays)
+        x = np.array([1.0, -2.0], np.float32)
+
+        def loss(xv):
+            return jnp.sum(fn(arrays, {"x": xv})["stacked"])
+
+        g = jax.grad(loss)(jnp.asarray(x))
+        # d/dx sum_k (x + 0.5k)^2 = sum_k 2(x + 0.5k)
+        exp = sum(2.0 * (x + 0.5 * k) for k in (1, 2, 3))
+        np.testing.assert_allclose(np.asarray(g), exp, rtol=1e-5)
+
+    def test_scan_output_on_dynamic_loop_is_loud(self):
+        """Scan outputs without a derivable bound must fail with a
+        clear message, not import garbage."""
+        one = tensor("one", np.full((2,), 0.5, np.float32))
+        body_nodes = [
+            node("Identity", ["cond_in"], ["cond_out"]),
+            node("Add", ["c_in", "one"], ["c_out"]),
+            node("Mul", ["c_out", "c_out"], ["scan_val"]),
+        ]
+        body = graph(body_nodes, [one],
+                     [value_info("iter", []), value_info("cond_in", []),
+                      value_info("c_in", [2])],
+                     [value_info("cond_out", []),
+                      value_info("c_out", [2]),
+                      value_info("scan_val", [2])])
+        # M is a graph INPUT (runtime value), so no static bound
+        g2 = graph([node("Loop", ["m", "cond0", "x"],
+                         ["final", "stacked"],
+                         attrs=[attr_graph("body", body)])],
+                   [tensor_any("cond0", np.array(True))],
+                   [value_info("x", [2]), value_info("m", [])],
+                   [value_info("final", [2]),
+                    value_info("stacked", [3, 2])])
+        with pytest.raises(OnnxImportError,
+                           match="statically bounded"):
+            OnnxImport.importGraph(
+                OnnxImport._as_model(model(g2, opset=14)))
