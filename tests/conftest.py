@@ -7,6 +7,20 @@ never need real TPU hardware. Must run before jax is imported anywhere.
 """
 
 import os
+import tempfile
+
+# Op-execution accounting (reference: OpValidation, SURVEY.md §4): the
+# registry records every dispatched op; subprocesses spawned by tests
+# inherit this env var and append their sets at exit, so the
+# end-of-suite executional gate (test_zzz_op_execution_gate.py) sees
+# multi-process drives too. Pid-keyed so parallel sessions don't mix;
+# removed up front in case of pid reuse.
+_trace = os.path.join(tempfile.gettempdir(),
+                      f"dl4j_op_trace_{os.getpid()}.txt")
+if "DL4J_TPU_OP_TRACE_FILE" not in os.environ:
+    os.environ["DL4J_TPU_OP_TRACE_FILE"] = _trace
+    if os.path.exists(_trace):
+        os.remove(_trace)
 
 # Force CPU: the session env presets JAX_PLATFORMS=axon (the real TPU
 # tunnel, which also only admits ONE client process at a time) — tests

