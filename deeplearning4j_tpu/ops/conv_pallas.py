@@ -1,0 +1,177 @@
+"""Pallas conv + BN-epilogue kernels for the ResNet fast path.
+
+Reference role: CudnnConvolutionHelper / the cuDNN fused
+conv+bias+act paths (SURVEY.md §2.8-2.9). The round-3 byte ledger
+(BASELINE.md) showed the ResNet-50 step is HBM-bound with ~46 ms of
+non-conv traffic, and named moving BN statistics and residual adds
+into conv epilogues — a Pallas conv framework — as the one untaken
+lever. These kernels implement that class for the shapes where the
+MXU mapping is clean:
+
+- ``conv1x1_bn_stats``: a 1x1/stride-1 conv IS a [rows, Cin] @
+  [Cin, Cout] matmul (rows = N*H*W). The kernel tiles it over a
+  (cols, rows) grid and accumulates per-channel sum/sum-of-squares in
+  the SAME pass, in f32, from the pre-cast MXU accumulator — saving
+  the separate full re-read of the conv output that XLA's batch-norm
+  stats reduction costs today.
+- ``conv3x3_bn_stats``: same contract for 3x3/stride-1 SAME convs as
+  9 shifted matmuls accumulated in f32. The grid runs one step per
+  image; the block is the whole zero-padded image (fits VMEM for
+  every ResNet-50 3x3 shape: 58x58x64 ... 9x9x512), which sidesteps
+  halo blocks — overlapping reads can't be expressed in blocked
+  BlockSpec indexing.
+
+Stats blocks are indexed by the column block only, so every row-step
+revisits them; TPU Pallas grids execute sequentially, which makes the
+revisit-accumulate pattern exact (pallas guide: grids/BlockSpecs).
+Divisibility is asserted, not padded: a partial edge block would feed
+garbage rows into the stats accumulation.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from deeplearning4j_tpu.ops.registry import register_op
+
+
+def _pick_block(total, cap):
+    """Largest divisor of `total` that is <= cap (stats correctness
+    needs exact tiling; see module docstring)."""
+    b = min(cap, total)
+    while total % b:
+        b -= 1
+    return b
+
+
+def _k_conv1x1(x_ref, w_ref, o_ref, sum_ref, sq_ref):
+    i = pl.program_id(1)
+
+    @pl.when(i == 0)
+    def _init():
+        sum_ref[...] = jnp.zeros_like(sum_ref)
+        sq_ref[...] = jnp.zeros_like(sq_ref)
+
+    acc = jnp.dot(x_ref[...], w_ref[...],
+                  preferred_element_type=jnp.float32)
+    o_ref[...] = acc.astype(o_ref.dtype)
+    sum_ref[...] += jnp.sum(acc, axis=0, keepdims=True)
+    sq_ref[...] += jnp.sum(acc * acc, axis=0, keepdims=True)
+
+
+@functools.partial(jax.jit, static_argnames=("bm", "bn", "interpret"))
+def conv1x1_bn_stats(x, w, bm=512, bn=256, interpret=False):
+    """x: [N,H,W,Cin] (or [rows,Cin]); w: [Cin,Cout].
+    Returns (y raw conv output, mean [Cout], var [Cout]) with biased
+    variance (batch-norm convention), stats accumulated in f32."""
+    shp = x.shape
+    rows = 1
+    for d in shp[:-1]:
+        rows *= d
+    cin = shp[-1]
+    cout = w.shape[1]
+    x2 = x.reshape(rows, cin)
+    bm = _pick_block(rows, bm)
+    bn = _pick_block(cout, bn)
+    grid = (cout // bn, rows // bm)
+    y, s, sq = pl.pallas_call(
+        _k_conv1x1,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((bm, cin), lambda j, i: (i, 0)),
+            pl.BlockSpec((cin, bn), lambda j, i: (0, j)),
+        ],
+        out_specs=[
+            pl.BlockSpec((bm, bn), lambda j, i: (i, j)),
+            pl.BlockSpec((1, bn), lambda j, i: (0, j)),
+            pl.BlockSpec((1, bn), lambda j, i: (0, j)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((rows, cout), x.dtype),
+            jax.ShapeDtypeStruct((1, cout), jnp.float32),
+            jax.ShapeDtypeStruct((1, cout), jnp.float32),
+        ],
+        interpret=interpret,
+    )(x2, w)
+    mean = s[0] / rows
+    # E[x^2]-E[x]^2 in f32: clamped because cancellation can push it
+    # slightly negative for high-mean/low-variance channels (and the
+    # relative error grows as mean^2/var — documented limitation of the
+    # single-pass form; the network's XLA BN path uses two-pass var)
+    var = jnp.maximum(sq[0] / rows - mean * mean, 0.0)
+    return y.reshape(shp[:-1] + (cout,)), mean, var
+
+
+def _k_conv3x3(x_ref, w_ref, o_ref, sum_ref, sq_ref, *, h, wd, cin,
+               cout):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        sum_ref[...] = jnp.zeros_like(sum_ref)
+        sq_ref[...] = jnp.zeros_like(sq_ref)
+
+    acc = jnp.zeros((h * wd, cout), jnp.float32)
+    for dy in range(3):
+        for dx in range(3):
+            patch = x_ref[dy:dy + h, dx:dx + wd, :]
+            acc += jnp.dot(patch.reshape(h * wd, cin), w_ref[dy, dx],
+                           preferred_element_type=jnp.float32)
+    o_ref[...] = acc.reshape(h, wd, cout).astype(o_ref.dtype)
+    sum_ref[...] += jnp.sum(acc, axis=0, keepdims=True)
+    sq_ref[...] += jnp.sum(acc * acc, axis=0, keepdims=True)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def conv3x3_bn_stats(x, w, interpret=False):
+    """3x3 stride-1 SAME conv + fused BN stats.
+
+    x: [N,H,W,Cin]; w: [3,3,Cin,Cout]. One grid step per image: the
+    block is the whole zero-padded image [(H+2), (W+2), Cin] viewed as
+    row-blocks of [N*(H+2), W+2, Cin], so no halo crosses a block
+    boundary. Returns (y [N,H,W,Cout], mean [Cout], var [Cout])."""
+    n, h, wd, cin = x.shape
+    cout = w.shape[3]
+    xp = jnp.pad(x, ((0, 0), (1, 1), (1, 1), (0, 0)))
+    rows_v = xp.reshape(n * (h + 2), wd + 2, cin)
+    kernel = functools.partial(_k_conv3x3, h=h, wd=wd, cin=cin,
+                               cout=cout)
+    y, s, sq = pl.pallas_call(
+        kernel,
+        grid=(n,),
+        in_specs=[
+            pl.BlockSpec((h + 2, wd + 2, cin), lambda i: (i, 0, 0)),
+            pl.BlockSpec((3, 3, cin, cout), lambda i: (0, 0, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((h, wd, cout), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, cout), lambda i: (0, 0)),
+            pl.BlockSpec((1, cout), lambda i: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n * h, wd, cout), x.dtype),
+            jax.ShapeDtypeStruct((1, cout), jnp.float32),
+            jax.ShapeDtypeStruct((1, cout), jnp.float32),
+        ],
+        interpret=interpret,
+    )(rows_v, w)
+    rows = n * h * wd
+    mean = s[0] / rows
+    var = jnp.maximum(sq[0] / rows - mean * mean, 0.0)  # see conv1x1
+    return y.reshape(n, h, wd, cout), mean, var
+
+
+@register_op("conv1x1_bn_stats")
+def conv1x1_bn_stats_op(x, w, bm=512, bn=256):
+    return conv1x1_bn_stats(x, w, bm=bm, bn=bn,
+                            interpret=jax.default_backend() != "tpu")
+
+
+@register_op("conv3x3_bn_stats")
+def conv3x3_bn_stats_op(x, w):
+    return conv3x3_bn_stats(x, w,
+                            interpret=jax.default_backend() != "tpu")

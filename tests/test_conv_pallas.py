@@ -1,0 +1,89 @@
+"""Correctness tests for the Pallas conv + BN-stats kernels
+(ops/conv_pallas.py — the round-4 conv-epilogue experiment; the
+committed A/B in BASELINE.md shows XLA wins this class, the kernels
+stay as evidence and as the framework for future fast-path classes).
+Run in interpret mode on the CPU test mesh."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.ops.conv_pallas import (conv1x1_bn_stats,
+                                                conv3x3_bn_stats)
+from deeplearning4j_tpu.ops.registry import get_op
+
+RS = np.random.RandomState(7)
+
+
+class TestConv1x1BnStats:
+    def test_matches_einsum_and_batch_stats(self):
+        x = jnp.asarray(RS.randn(2, 8, 8, 16), jnp.float32)
+        w = jnp.asarray(RS.randn(16, 32) * 0.2, jnp.float32)
+        y, m, v = conv1x1_bn_stats(x, w, bm=32, bn=16, interpret=True)
+        ref = jnp.einsum("nhwc,cd->nhwd", x, w)
+        np.testing.assert_allclose(np.asarray(y), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(m),
+                                   np.asarray(ref.mean((0, 1, 2))),
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(v),
+                                   np.asarray(ref.var((0, 1, 2))),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_nondivisible_rows_pick_exact_blocks(self):
+        # rows = 2*7*7 = 98: bm must fall back to a divisor (partial
+        # edge blocks would feed garbage into the stats)
+        x = jnp.asarray(RS.randn(2, 7, 7, 8), jnp.float32)
+        w = jnp.asarray(RS.randn(8, 24) * 0.2, jnp.float32)
+        y, m, v = conv1x1_bn_stats(x, w, bm=64, bn=16, interpret=True)
+        ref = jnp.einsum("nhwc,cd->nhwd", x, w)
+        np.testing.assert_allclose(np.asarray(m),
+                                   np.asarray(ref.mean((0, 1, 2))),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_registry_dispatch(self):
+        x = jnp.asarray(RS.randn(1, 4, 4, 8), jnp.float32)
+        w = jnp.asarray(RS.randn(8, 8) * 0.2, jnp.float32)
+        y, m, v = get_op("conv1x1_bn_stats")(x, w)
+        assert y.shape == (1, 4, 4, 8) and m.shape == (8,)
+
+
+class TestConv3x3BnStats:
+    def test_matches_lax_conv(self):
+        x = jnp.asarray(RS.randn(3, 8, 8, 4), jnp.float32)
+        w = jnp.asarray(RS.randn(3, 3, 4, 8) * 0.2, jnp.float32)
+        y, m, v = conv3x3_bn_stats(x, w, interpret=True)
+        ref = jax.lax.conv_general_dilated(
+            x, w, (1, 1), "SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        np.testing.assert_allclose(np.asarray(y), np.asarray(ref),
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(m),
+                                   np.asarray(ref.mean((0, 1, 2))),
+                                   rtol=1e-4, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(v),
+                                   np.asarray(ref.var((0, 1, 2))),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_image_isolation(self):
+        """Per-image padded blocks: image i's conv must not see image
+        i+1's rows (the zero-pad rows sit between them)."""
+        x1 = RS.randn(1, 4, 4, 2).astype(np.float32)
+        x2 = RS.randn(1, 4, 4, 2).astype(np.float32)
+        w = jnp.asarray(RS.randn(3, 3, 2, 4) * 0.3, jnp.float32)
+        y_pair, _, _ = conv3x3_bn_stats(
+            jnp.concatenate([jnp.asarray(x1), jnp.asarray(x2)]), w,
+            interpret=True)
+        y_solo, _, _ = conv3x3_bn_stats(jnp.asarray(x1), w,
+                                        interpret=True)
+        np.testing.assert_allclose(np.asarray(y_pair[0]),
+                                   np.asarray(y_solo[0]),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_registry_dispatch(self):
+        x = jnp.asarray(RS.randn(1, 4, 4, 2), jnp.float32)
+        w = jnp.asarray(RS.randn(3, 3, 2, 4) * 0.2, jnp.float32)
+        y, m, v = get_op("conv3x3_bn_stats")(x, w)
+        assert y.shape == (1, 4, 4, 4) and v.shape == (4,)
