@@ -34,9 +34,12 @@ import functools
 
 import jax
 import jax.numpy as jnp
-from jax.experimental import pallas as pl
 
 from deeplearning4j_tpu.ops.registry import register_op
+
+# jax.experimental.pallas is imported inside each function body — the
+# package-wide convention (see lstm_pallas/flash_attention): the import
+# costs ~2.3s and must not tax a bare `import deeplearning4j_tpu.ops`.
 
 
 def _pick_block(total, cap):
@@ -49,6 +52,8 @@ def _pick_block(total, cap):
 
 
 def _k_conv1x1(x_ref, w_ref, o_ref, sum_ref, sq_ref):
+    from jax.experimental import pallas as pl
+
     i = pl.program_id(1)
 
     @pl.when(i == 0)
@@ -68,6 +73,8 @@ def conv1x1_bn_stats(x, w, bm=512, bn=256, interpret=False):
     """x: [N,H,W,Cin] (or [rows,Cin]); w: [Cin,Cout].
     Returns (y raw conv output, mean [Cout], var [Cout]) with biased
     variance (batch-norm convention), stats accumulated in f32."""
+    from jax.experimental import pallas as pl
+
     shp = x.shape
     rows = 1
     for d in shp[:-1]:
@@ -108,6 +115,8 @@ def conv1x1_bn_stats(x, w, bm=512, bn=256, interpret=False):
 
 def _k_conv3x3(x_ref, w_ref, o_ref, sum_ref, sq_ref, *, h, wd, cin,
                cout):
+    from jax.experimental import pallas as pl
+
     i = pl.program_id(0)
 
     @pl.when(i == 0)
@@ -134,8 +143,23 @@ def conv3x3_bn_stats(x, w, interpret=False):
     block is the whole zero-padded image [(H+2), (W+2), Cin] viewed as
     row-blocks of [N*(H+2), W+2, Cin], so no halo crosses a block
     boundary. Returns (y [N,H,W,Cout], mean [Cout], var [Cout])."""
+    from jax.experimental import pallas as pl
+
     n, h, wd, cin = x.shape
     cout = w.shape[3]
+    # One grid step holds the whole padded image + f32 accumulator in
+    # VMEM; that is the design envelope (every ResNet-50 3x3 shape).
+    # Guard it so out-of-envelope dispatch fails with a clear error,
+    # not an opaque Mosaic allocation failure.
+    block_bytes = ((h + 2) * (wd + 2) * cin * x.dtype.itemsize
+                   + 9 * cin * cout * w.dtype.itemsize
+                   + h * wd * cout * (x.dtype.itemsize + 4))
+    if block_bytes > 24 * 1024 * 1024:
+        raise ValueError(
+            f"conv3x3_bn_stats: per-image block needs ~{block_bytes >> 20}MB "
+            f"VMEM for shape {x.shape}->{cout}ch; the kernel's envelope is "
+            "the ResNet-50 3x3 shapes (<=58x58x64 ... 9x9x512). Use the "
+            "XLA conv path (ops.nn.conv2d + batch-norm) for this shape.")
     xp = jnp.pad(x, ((0, 0), (1, 1), (1, 1), (0, 0)))
     rows_v = xp.reshape(n * (h + 2), wd + 2, cin)
     kernel = functools.partial(_k_conv3x3, h=h, wd=wd, cin=cin,

@@ -495,8 +495,9 @@ def lstm_layer(x, w_ih, w_hh, b, h0=None, c0=None, reverse=False,
 
     impl="pallas" swaps the recurrence for the persistent-VMEM Pallas
     kernel (ops/lstm_pallas.py) — measured ~par at H=256 and ~1.3x at
-    H=512 on v5e (BASELINE.md), forward/inference only (no custom
-    backward); scan remains the default.
+    H=512 on v5e (BASELINE.md); differentiable via a custom VJP
+    (reverse-time recompute scan), so it works for training too. Scan
+    remains the default.
     """
     if impl not in ("scan", "pallas"):
         raise ValueError(f"lstm_layer impl={impl!r}: 'scan' or 'pallas'")

@@ -87,3 +87,13 @@ class TestConv3x3BnStats:
         w = jnp.asarray(RS.randn(3, 3, 2, 4) * 0.2, jnp.float32)
         y, m, v = get_op("conv3x3_bn_stats")(x, w)
         assert y.shape == (1, 4, 4, 4) and v.shape == (4,)
+
+    def test_vmem_envelope_guard(self):
+        """Out-of-envelope shapes (stem-scale images) must fail with a
+        clear ValueError, not an opaque Mosaic allocation error."""
+        import pytest
+
+        x = jnp.zeros((1, 224, 224, 64), jnp.bfloat16)
+        w = jnp.zeros((3, 3, 64, 64), jnp.bfloat16)
+        with pytest.raises(ValueError, match="envelope"):
+            conv3x3_bn_stats(x, w, interpret=True)

@@ -33,6 +33,79 @@ class TestPallasLstm:
         np.testing.assert_allclose(np.asarray(cT), np.asarray(cT_ref),
                                    rtol=1e-4, atol=1e-4)
 
+    def test_grad_matches_scan_path(self):
+        """The custom VJP (VERDICT r3 item #6): grads through the pallas
+        recurrence must match jax.grad through the lax.scan reference on
+        every input — x, both weight matrices, bias, and the initial
+        carry enters via zeros so it is exercised through x_proj."""
+        import jax
+
+        rng = np.random.default_rng(1)
+        n, t, insz, h = 3, 8, 5, 16
+        x = jnp.asarray(rng.normal(0, 0.5, (n, t, insz)), jnp.float32)
+        w_ih = jnp.asarray(rng.normal(0, 0.2, (insz, 4 * h)),
+                           jnp.float32)
+        w_hh = jnp.asarray(rng.normal(0, 0.2, (h, 4 * h)), jnp.float32)
+        b = jnp.asarray(rng.normal(0, 0.1, (4 * h,)), jnp.float32)
+        # Weight the per-position loss so dys is non-uniform in time.
+        wts = jnp.asarray(rng.normal(0, 1.0, (n, t, h)), jnp.float32)
+
+        def loss(params, impl):
+            x_, wih_, whh_, b_ = params
+            ys, (hT, cT) = lstm_layer(x_, wih_, whh_, b_, impl=impl)
+            return (jnp.sum(ys * wts) + jnp.sum(hT * hT)
+                    + jnp.sum(jnp.sin(cT)))
+
+        params = (x, w_ih, w_hh, b)
+        ref_val, ref_grads = jax.value_and_grad(loss)(params, "scan")
+        # interpret=None auto-selects interpret mode off-TPU, so the
+        # normal lstm_layer(impl="pallas") call site differentiates
+        # unchanged on the CPU test mesh.
+        val, grads = jax.value_and_grad(loss)(params, "pallas")
+        np.testing.assert_allclose(float(val), float(ref_val),
+                                   rtol=1e-5)
+        for gr, gp, name in zip(ref_grads, grads,
+                                ("x", "w_ih", "w_hh", "b")):
+            np.testing.assert_allclose(
+                np.asarray(gp), np.asarray(gr), rtol=2e-4, atol=2e-5,
+                err_msg=f"grad mismatch for {name}")
+
+    def test_grad_initial_carry(self):
+        """d/dh0 and d/dc0 flow through the custom VJP directly."""
+        import jax
+
+        rng = np.random.default_rng(2)
+        n, t, h = 2, 6, 8
+        xp = jnp.asarray(rng.normal(0, 0.3, (t, n, 4 * h)), jnp.float32)
+        w_hh = jnp.asarray(rng.normal(0, 0.2, (h, 4 * h)), jnp.float32)
+        h0 = jnp.asarray(rng.normal(0, 0.5, (n, h)), jnp.float32)
+        c0 = jnp.asarray(rng.normal(0, 0.5, (n, h)), jnp.float32)
+
+        def loss_pallas(h0_, c0_):
+            ys, hT, cT = pallas_lstm_recurrence(
+                xp, w_hh, h0_, c0_, k_steps=2, interpret=True)
+            return jnp.sum(ys ** 2) + jnp.sum(hT) + jnp.sum(cT)
+
+        def loss_scan(h0_, c0_):
+            def step(carry, x_t):
+                h, c = carry
+                gates = x_t + h @ w_hh
+                i, f, g, o = jnp.split(gates, 4, axis=-1)
+                c2 = (jax.nn.sigmoid(f) * c
+                      + jax.nn.sigmoid(i) * jnp.tanh(g))
+                h2 = jax.nn.sigmoid(o) * jnp.tanh(c2)
+                return (h2, c2), h2
+
+            (hT, cT), ys = jax.lax.scan(step, (h0_, c0_), xp)
+            return jnp.sum(ys ** 2) + jnp.sum(hT) + jnp.sum(cT)
+
+        gp = jax.grad(loss_pallas, argnums=(0, 1))(h0, c0)
+        gr = jax.grad(loss_scan, argnums=(0, 1))(h0, c0)
+        np.testing.assert_allclose(np.asarray(gp[0]), np.asarray(gr[0]),
+                                   rtol=2e-4, atol=2e-5)
+        np.testing.assert_allclose(np.asarray(gp[1]), np.asarray(gr[1]),
+                                   rtol=2e-4, atol=2e-5)
+
     def test_pick_k_divides_and_fits(self):
         k = _pick_k(200, 256, 1024, 2)
         assert 200 % k == 0 and 2 * k * 256 * 1024 * 2 <= 6 << 20
