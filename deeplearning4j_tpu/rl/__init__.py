@@ -4,21 +4,29 @@ policies, MDP abstraction, experience replay. SURVEY.md §2.41).
 TPU-first redesign notes:
 - The value/policy networks are jax pytrees with ONE jitted update step
   (replay batch in, new params out) instead of rl4j's per-op eager path.
-- rl4j's A3C (async Hogwild workers) does not map to XLA's compilation
-  model; the equivalent here is synchronous vectorized A2C — the same
-  advantage-actor-critic math, batched over parallel env instances, one
-  compiled update per step (the standard accelerator-era replacement).
+- Two actor-critic trainers, matching the two regimes:
+  * A2CDiscreteDense — synchronous vectorized rollouts (K env copies in
+    lockstep, one compiled update per rollout). Right when env stepping
+    is cheap and the accelerator is the bottleneck.
+  * A3CDiscreteDense — the reference's headline ASYNC design (worker
+    threads + shared global params behind a lock, stale gradients
+    accepted). Right when env step LATENCY dominates (the
+    gym-java-client regime rl4j built async learning for); workers
+    overlap env waiting, compute stays jitted.
 """
 
-from deeplearning4j_tpu.rl.mdp import MDP, GridWorldMDP, CorridorMDP
+from deeplearning4j_tpu.rl.mdp import MDP, GridWorldMDP, CorridorMDP, SlowMDP
 from deeplearning4j_tpu.rl.replay import ExpReplay, Transition
 from deeplearning4j_tpu.rl.policy import (
     DQNPolicy, EpsGreedy, Policy, ACPolicy,
 )
 from deeplearning4j_tpu.rl.qlearning import QLearningDiscreteDense, QLConfiguration
 from deeplearning4j_tpu.rl.a2c import A2CDiscreteDense, A2CConfiguration
+from deeplearning4j_tpu.rl.a3c import A3CDiscreteDense, A3CConfiguration
 
-__all__ = ["MDP", "GridWorldMDP", "CorridorMDP", "ExpReplay", "Transition",
+__all__ = ["MDP", "GridWorldMDP", "CorridorMDP", "SlowMDP",
+           "ExpReplay", "Transition",
            "Policy", "EpsGreedy", "DQNPolicy", "ACPolicy",
            "QLearningDiscreteDense", "QLConfiguration",
-           "A2CDiscreteDense", "A2CConfiguration"]
+           "A2CDiscreteDense", "A2CConfiguration",
+           "A3CDiscreteDense", "A3CConfiguration"]

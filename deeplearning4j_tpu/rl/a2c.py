@@ -24,6 +24,24 @@ from deeplearning4j_tpu.rl.policy import ACPolicy
 from deeplearning4j_tpu.rl.qlearning import _init_mlp, _mlp
 
 
+def actor_critic_loss(nets, obs, act, ret, value_coef, entropy_coef):
+    """Shared A2C/A3C objective: n-step advantage policy gradient +
+    value regression + entropy bonus (reference: AdvantageActorCritic
+    gradient assembly in A3CThreadDiscrete/AdvantageActorCritic). ONE
+    definition so the sync and async trainers cannot diverge."""
+    logits = _mlp(nets["actor"], obs)
+    logp = jax.nn.log_softmax(logits, -1)
+    p = jnp.exp(logp)
+    v = _mlp(nets["critic"], obs)[:, 0]
+    adv = jax.lax.stop_gradient(ret - v)
+    sel = jnp.take_along_axis(logp, act[:, None].astype(jnp.int32),
+                              -1)[:, 0]
+    pg = -jnp.mean(sel * adv)
+    vloss = jnp.mean((ret - v) ** 2)
+    ent = -jnp.mean(jnp.sum(p * logp, -1))
+    return pg + value_coef * vloss - entropy_coef * ent
+
+
 @dataclasses.dataclass
 class A2CConfiguration:
     seed: int = 0
@@ -57,21 +75,8 @@ class A2CDiscreteDense:
         gamma, ec, vc = c.gamma, c.entropy_coef, c.value_coef
 
         def update(nets, opt_state, it, obs, act, ret):
-            def loss_fn(n):
-                logits = _mlp(n["actor"], obs)
-                logp = jax.nn.log_softmax(logits, -1)
-                p = jnp.exp(logp)
-                v = _mlp(n["critic"], obs)[:, 0]
-                adv = jax.lax.stop_gradient(ret - v)
-                sel = jnp.take_along_axis(logp,
-                                          act[:, None].astype(jnp.int32),
-                                          -1)[:, 0]
-                pg = -jnp.mean(sel * adv)
-                vloss = jnp.mean((ret - v) ** 2)
-                ent = -jnp.mean(jnp.sum(p * logp, -1))
-                return pg + vc * vloss - ec * ent
-
-            loss, grads = jax.value_and_grad(loss_fn)(nets)
+            loss, grads = jax.value_and_grad(
+                lambda n: actor_critic_loss(n, obs, act, ret, vc, ec))(nets)
             updates, new_opt = apply_updater(self._updater, opt_state,
                                              grads, nets, it)
             new_nets = jax.tree_util.tree_map(lambda p, u: p - u, nets,

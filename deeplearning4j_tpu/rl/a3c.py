@@ -1,0 +1,203 @@
+"""Asynchronous advantage actor-critic — the rl4j headline RL feature
+(reference: A3CDiscreteDense + AsyncLearning + AsyncGlobal,
+org/deeplearning4j/rl4j/learning/async/**).
+
+rl4j's async design: N worker threads each own an env, roll out n
+steps against a periodically-synced copy of the global net, compute
+gradients locally, and enqueue them at a central AsyncGlobal that
+applies them to the shared parameters (Hogwild over a lock). The
+TPU-idiomatic translation keeps that actor/learner split exactly —
+host threads own the (host-side, latency-bound) env stepping, which
+is where asynchrony actually pays — but each worker's math is one
+jitted grad call, and the global applies updates under a lock with a
+single jitted Adam step:
+
+- workers READ the current global params without any lock (a published
+  pytree reference is immutable; torn reads are impossible by
+  construction — the JAX arrays in a snapshot never mutate, unlike the
+  reference's synchronized copyFromGlobal),
+- gradient COMPUTATION runs outside the lock (the async part: stale
+  gradients are accepted, same semantics as rl4j's queue),
+- gradient APPLICATION is serialized (the AsyncGlobal role).
+
+A2CDiscreteDense (a2c.py) remains the synchronous vector-env variant;
+this module is the async one for envs whose step latency dominates —
+the regime rl4j built A3C for (gym-java-client round trips).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import threading
+import time
+import types
+from typing import Callable, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.learning.updaters import Adam, apply_updater
+from deeplearning4j_tpu.rl.a2c import actor_critic_loss
+from deeplearning4j_tpu.rl.mdp import MDP
+from deeplearning4j_tpu.rl.policy import ACPolicy
+from deeplearning4j_tpu.rl.qlearning import _init_mlp, _mlp
+
+
+@functools.lru_cache(maxsize=32)
+def _compiled(lr: float, entropy_coef: float, value_coef: float):
+    """Jitted probs/value/grads/apply shared across trainer instances
+    with the same hyperparameters. Per-instance `jax.jit` closures
+    would recompile (~2s on the CPU mesh) for every trainer — more
+    than a whole small training run — and XLA caches by function
+    identity, so the cache must outlive the instance."""
+    updater = Adam(learning_rate=lr)
+
+    def grads_fn(nets, obs, act, ret):
+        return jax.value_and_grad(
+            lambda n: actor_critic_loss(n, obs, act, ret, value_coef,
+                                        entropy_coef))(nets)
+
+    def apply_fn(nets, opt_state, grads, it):
+        updates, new_opt = apply_updater(updater, opt_state, grads,
+                                         nets, it)
+        new_nets = jax.tree_util.tree_map(lambda p, u: p - u, nets,
+                                          updates)
+        return new_nets, new_opt
+
+    return types.SimpleNamespace(
+        updater=updater,
+        probs=jax.jit(lambda p, x: jax.nn.softmax(_mlp(p, x), -1)),
+        value=jax.jit(lambda p, x: _mlp(p, x)[:, 0]),
+        grads=jax.jit(grads_fn),
+        apply=jax.jit(apply_fn),
+    )
+
+
+@dataclasses.dataclass
+class A3CConfiguration:
+    seed: int = 0
+    gamma: float = 0.99
+    n_step: int = 8                   # rollout length (reference: nstep)
+    n_workers: int = 4                # async actor threads (numThread)
+    learning_rate: float = 7e-4
+    entropy_coef: float = 0.01
+    value_coef: float = 0.5
+    hidden: tuple = (64,)
+
+
+class A3CDiscreteDense:
+    """Async actor-learner training over a factory of MDP instances.
+
+    Matches A2CDiscreteDense's public surface (getPolicy / train /
+    episode_rewards); `train(updates)` is the TOTAL update budget
+    consumed jointly by all workers (a shared atomic counter, the
+    reference's maxStep role).
+    """
+
+    def __init__(self, mdp_factory: Callable[[], MDP],
+                 conf: Optional[A3CConfiguration] = None):
+        self.conf = c = conf or A3CConfiguration()
+        self._mdp_factory = mdp_factory
+        probe = mdp_factory()
+        key = jax.random.key(c.seed)
+        k1, k2 = jax.random.split(key)
+        trunk = (probe.obs_size,) + tuple(c.hidden)
+        self._nets = {"actor": _init_mlp(k1, trunk + (probe.n_actions,)),
+                      "critic": _init_mlp(k2, trunk + (1,))}
+        probe.close()
+        fns = _compiled(c.learning_rate, c.entropy_coef, c.value_coef)
+        self._updater = fns.updater
+        self._probs, self._value = fns.probs, fns.value
+        self._grads, self._apply = fns.grads, fns.apply
+        self._opt_state = self._updater.init_state(self._nets)
+        self._it = 0
+        self._lock = threading.Lock()
+        self.episode_rewards: List[float] = []
+
+    def getPolicy(self, greedy: bool = True) -> ACPolicy:
+        actor = self._nets["actor"]
+        return ACPolicy(
+            lambda x: np.asarray(self._probs(actor, jnp.asarray(x))),
+            greedy=greedy, seed=self.conf.seed)
+
+    # -- the worker loop (reference: A3CThreadDiscrete.trainSubEpoch) --
+    def _worker(self, wid: int, budget: "_Counter"):
+        c = self.conf
+        rng = np.random.RandomState(c.seed * 9973 + wid)
+        env = self._mdp_factory()
+        obs = env.reset()
+        ep_r = 0.0
+        while budget.take():
+            # Lock-free snapshot: the published pytree is immutable.
+            nets = self._nets
+            t_obs, t_act, t_rew, t_done = [], [], [], []
+            for _ in range(c.n_step):
+                probs = np.asarray(self._probs(
+                    nets["actor"], jnp.asarray(obs[None])))[0]
+                a = int(rng.choice(len(probs), p=probs / probs.sum()))
+                nobs, r, d, _info = env.step(a)
+                t_obs.append(obs)
+                t_act.append(a)
+                t_rew.append(r)
+                t_done.append(float(d))
+                ep_r += r
+                if d:
+                    with self._lock:
+                        self.episode_rewards.append(ep_r)
+                    ep_r = 0.0
+                    obs = env.reset()
+                else:
+                    obs = nobs
+            last_v = float(np.asarray(self._value(
+                nets["critic"], jnp.asarray(obs[None]))[0]))
+            rets = np.zeros(len(t_rew), np.float32)
+            running = last_v
+            for t in reversed(range(len(t_rew))):
+                running = t_rew[t] + c.gamma * running * (1 - t_done[t])
+                rets[t] = running
+            # Gradient outside the lock (stale-by-construction, same
+            # semantics as the reference's gradient queue)...
+            _loss, grads = self._grads(
+                nets, jnp.asarray(np.stack(t_obs)),
+                jnp.asarray(np.asarray(t_act, np.int32)),
+                jnp.asarray(rets))
+            # ...application serialized (the AsyncGlobal role).
+            with self._lock:
+                self._nets, self._opt_state = self._apply(
+                    self._nets, self._opt_state, grads,
+                    jnp.asarray(self._it))
+                self._it += 1
+        env.close()
+
+    def train(self, updates: int = 400) -> List[float]:
+        budget = _Counter(updates)
+        threads = [threading.Thread(target=self._worker,
+                                    args=(w, budget), daemon=True)
+                   for w in range(self.conf.n_workers)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        self.train_seconds = time.perf_counter() - t0
+        return self.episode_rewards
+
+
+class _Counter:
+    """Shared atomic update budget (the reference's maxStep/T_max)."""
+
+    def __init__(self, n: int):
+        self._n = n
+        self._lock = threading.Lock()
+
+    def take(self) -> bool:
+        with self._lock:
+            if self._n <= 0:
+                return False
+            self._n -= 1
+            return True
+
+
+__all__ = ["A3CDiscreteDense", "A3CConfiguration"]

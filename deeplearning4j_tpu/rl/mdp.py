@@ -109,4 +109,36 @@ class GridWorldMDP(MDP):
         return self._obs(), (1.0 if at_goal else -0.01), done, {}
 
 
-__all__ = ["MDP", "CorridorMDP", "GridWorldMDP"]
+class SlowMDP(MDP):
+    """Wraps an MDP with a fixed per-step latency — models the regime
+    rl4j's async learning exists for (gym-java-client round trips,
+    simulator physics): env stepping dominated by host-side waiting,
+    which worker threads can overlap."""
+
+    def __init__(self, inner: MDP, step_delay_s: float = 0.002):
+        import time
+
+        self._inner = inner
+        self._delay = step_delay_s
+        self._sleep = time.sleep
+
+    @property
+    def obs_size(self) -> int:
+        return self._inner.obs_size
+
+    @property
+    def n_actions(self) -> int:
+        return self._inner.n_actions
+
+    def reset(self) -> np.ndarray:
+        return self._inner.reset()
+
+    def step(self, action: int):
+        self._sleep(self._delay)
+        return self._inner.step(action)
+
+    def close(self) -> None:
+        self._inner.close()
+
+
+__all__ = ["MDP", "CorridorMDP", "GridWorldMDP", "SlowMDP"]
