@@ -255,7 +255,11 @@ def _register_standard_mappers():
     @R("BiasAdd")
     def _bias_add(ctx):
         if ctx.attr("data_format", "NHWC") == "NCHW":
-            raise TFImportError("BiasAdd NCHW not supported (NHWC only)")
+            # late binding: _nchw_sandwich is defined below in this
+            # same registration scope, before any mapper runs
+            return _nchw_sandwich(
+                ctx, lambda xt: ctx.sd._op(
+                    "add", [xt.name, ctx.inputs[1].name]))
         return ctx.op("add", ctx.inputs[:2])
 
     @R("AddN")
@@ -471,6 +475,28 @@ def _register_standard_mappers():
         return ctx.op("where", ctx.inputs[:3])
 
     # ---- NN ops ----
+    # NCHW graphs import via a transpose sandwich: NCHW -> NHWC (the
+    # op's native layout here, TPU-preferred) -> NCHW. Between two
+    # consecutive NCHW nodes the inner [0,3,1,2]/[0,2,3,1] pair is
+    # adjacent in the graph and XLA cancels it, so a whole NCHW conv
+    # stack costs two real layout ops total (reference: the importer's
+    # permuteFirstDims/NCHW handling in Conv2D MappingRules).
+    def _check_rank4(ctx, v, what):
+        aval = (ctx.avals or {}).get(v.name)
+        if aval is not None and len(aval[0].shape) != 4:
+            raise TFImportError(
+                f"{ctx.node.name}: {what} expects a rank-4 tensor, got "
+                f"rank {len(aval[0].shape)}")
+
+    def _nchw_sandwich(ctx, emit, *extra_inputs):
+        """Emit `emit(nhwc_x, *extra)` wrapped in NCHW<->NHWC
+        transposes; the final transpose carries the node's name."""
+        x = ctx.inputs[0]
+        _check_rank4(ctx, x, f"{ctx.node.op} NCHW")
+        xt = ctx.sd._op("transpose", [x.name], permute=[0, 2, 3, 1])
+        y = emit(xt, *extra_inputs)
+        return ctx.op("transpose", [y], permute=[0, 3, 1, 2])
+
     def _check_padding(ctx):
         """SAME/VALID only — EXPLICIT (explicit_paddings) must not be
         silently treated as VALID."""
@@ -481,49 +507,70 @@ def _register_standard_mappers():
                 "(SAME/VALID only)")
         return pad
 
+    def _layout(ctx):
+        df = ctx.attr("data_format", "NHWC")
+        if df not in ("NHWC", "NCHW"):
+            raise TFImportError(
+                f"{ctx.node.name}: data_format={df!r} not supported")
+        return df, ((2, 3) if df == "NCHW" else (1, 2))
+
     @R("Conv2D")
     def _conv2d(ctx):
-        if ctx.attr("data_format", "NHWC") != "NHWC":
-            raise TFImportError("Conv2D: only NHWC supported")
+        df, hw = _layout(ctx)
         strides = ctx.attr("strides", [1, 1, 1, 1])
         dil = ctx.attr("dilations", [1, 1, 1, 1])
         pad = _check_padding(ctx)
-        padding = "SAME" if pad == "SAME" else (0, 0)
-        return ctx.op("conv2d", ctx.inputs[:2],
-                      strides=(int(strides[1]), int(strides[2])),
-                      padding=padding,
-                      dilation=(int(dil[1]), int(dil[2])))
+        kw = dict(strides=(int(strides[hw[0]]), int(strides[hw[1]])),
+                  padding="SAME" if pad == "SAME" else (0, 0),
+                  dilation=(int(dil[hw[0]]), int(dil[hw[1]])))
+        if df == "NCHW":
+            # TF filters are HWIO for BOTH layouts; only x needs moving
+            return _nchw_sandwich(
+                ctx, lambda xt: ctx.sd._op(
+                    "conv2d", [xt.name, ctx.inputs[1].name], **kw))
+        return ctx.op("conv2d", ctx.inputs[:2], **kw)
 
     @R("DepthwiseConv2dNative")
     def _depthwise(ctx):
-        if ctx.attr("data_format", "NHWC") != "NHWC":
-            raise TFImportError("DepthwiseConv2d: only NHWC supported")
+        df, hw = _layout(ctx)
         strides = ctx.attr("strides", [1, 1, 1, 1])
         pad = _check_padding(ctx)
-        padding = "SAME" if pad == "SAME" else (0, 0)
-        return ctx.op("depthwise_conv2d", ctx.inputs[:2],
-                      strides=(int(strides[1]), int(strides[2])),
-                      padding=padding)
+        kw = dict(strides=(int(strides[hw[0]]), int(strides[hw[1]])),
+                  padding="SAME" if pad == "SAME" else (0, 0))
+        if df == "NCHW":
+            return _nchw_sandwich(
+                ctx, lambda xt: ctx.sd._op(
+                    "depthwise_conv2d", [xt.name, ctx.inputs[1].name],
+                    **kw))
+        return ctx.op("depthwise_conv2d", ctx.inputs[:2], **kw)
 
     @R("MaxPool")
     def _maxpool(ctx):
+        df, hw = _layout(ctx)
         ks = ctx.attr("ksize", [1, 2, 2, 1])
         st = ctx.attr("strides", [1, 2, 2, 1])
         pad = _check_padding(ctx)
-        return ctx.op("maxpool2d", ctx.inputs[:1],
-                      kernel=(int(ks[1]), int(ks[2])),
-                      strides=(int(st[1]), int(st[2])),
-                      padding="SAME" if pad == "SAME" else "VALID")
+        kw = dict(kernel=(int(ks[hw[0]]), int(ks[hw[1]])),
+                  strides=(int(st[hw[0]]), int(st[hw[1]])),
+                  padding="SAME" if pad == "SAME" else "VALID")
+        if df == "NCHW":
+            return _nchw_sandwich(
+                ctx, lambda xt: ctx.sd._op("maxpool2d", [xt.name], **kw))
+        return ctx.op("maxpool2d", ctx.inputs[:1], **kw)
 
     @R("AvgPool")
     def _avgpool(ctx):
+        df, hw = _layout(ctx)
         ks = ctx.attr("ksize", [1, 2, 2, 1])
         st = ctx.attr("strides", [1, 2, 2, 1])
         pad = _check_padding(ctx)
-        return ctx.op("avgpool2d", ctx.inputs[:1],
-                      kernel=(int(ks[1]), int(ks[2])),
-                      strides=(int(st[1]), int(st[2])),
-                      padding="SAME" if pad == "SAME" else "VALID")
+        kw = dict(kernel=(int(ks[hw[0]]), int(ks[hw[1]])),
+                  strides=(int(st[hw[0]]), int(st[hw[1]])),
+                  padding="SAME" if pad == "SAME" else "VALID")
+        if df == "NCHW":
+            return _nchw_sandwich(
+                ctx, lambda xt: ctx.sd._op("avgpool2d", [xt.name], **kw))
+        return ctx.op("avgpool2d", ctx.inputs[:1], **kw)
 
     def _diag_guard(ctx, roles):
         """MatrixDiag/Part/SetDiag V2/V3 extra operands — only the
@@ -576,10 +623,16 @@ def _register_standard_mappers():
             raise TFImportError(
                 f"{ctx.node.name}: FusedBatchNorm with is_training=True — "
                 "freeze the graph for inference first")
-        if ctx.attr("data_format", "NHWC") != "NHWC":
-            raise TFImportError("FusedBatchNorm: only NHWC supported")
-        return ctx.op("batch_norm", ctx.inputs[:5],
-                      eps=float(ctx.attr("epsilon", 1e-3)))
+        eps = float(ctx.attr("epsilon", 1e-3))
+        if ctx.attr("data_format", "NHWC") == "NCHW":
+            # scale/offset/mean/var are per-channel vectors — layout
+            # only moves the data tensor
+            return _nchw_sandwich(
+                ctx, lambda xt: ctx.sd._op(
+                    "batch_norm",
+                    [xt.name] + [v.name for v in ctx.inputs[1:5]],
+                    eps=eps))
+        return ctx.op("batch_norm", ctx.inputs[:5], eps=eps)
 
 
 def _register_extended_mappers():

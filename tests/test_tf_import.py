@@ -92,6 +92,62 @@ class TestBasicGraphs:
             .astype(np.float32)
         _run_both(f, [x], rtol=1e-3, atol=1e-4)
 
+    def test_nchw_conv_stack_golden(self):
+        """NCHW graphs (VERDICT r3 item #9): the importer wraps each
+        NCHW node in an NCHW->NHWC->NCHW transpose sandwich. TF's CPU
+        kernels can't EXECUTE NCHW convs, but freezing only traces —
+        so the golden freezes the NCHW graph and uses the executed
+        NHWC twin (same weights) as the oracle."""
+        rng = np.random.default_rng(9)
+        k = tf.constant(rng.normal(size=(3, 3, 2, 4))
+                        .astype(np.float32) * 0.3)
+        kd = tf.constant(rng.normal(size=(3, 3, 4, 1))
+                         .astype(np.float32) * 0.3)
+        bias = tf.constant(rng.normal(size=(4,)).astype(np.float32))
+        gamma = tf.constant(rng.normal(size=(4,)).astype(np.float32))
+        beta = tf.constant(rng.normal(size=(4,)).astype(np.float32))
+        mean = tf.constant(rng.normal(size=(4,)).astype(np.float32))
+        var = tf.constant(rng.uniform(0.5, 2.0, (4,))
+                          .astype(np.float32))
+
+        def f_nchw(x):
+            h = tf.nn.conv2d(x, k, strides=[1, 1, 2, 2], padding="SAME",
+                             data_format="NCHW")
+            h = tf.nn.bias_add(h, bias, data_format="NC..")
+            h, _, _ = tf.raw_ops.FusedBatchNormV3(
+                x=h, scale=gamma, offset=beta, mean=mean, variance=var,
+                is_training=False, data_format="NCHW")[:3]
+            h = tf.nn.relu(h)
+            h = tf.nn.max_pool2d(h, 2, 2, "VALID", data_format="NCHW")
+            h = tf.nn.depthwise_conv2d(
+                h, kd, strides=[1, 1, 1, 1], padding="SAME",
+                data_format="NCHW")
+            return tf.nn.avg_pool2d(h, 2, 1, "VALID",
+                                    data_format="NCHW")
+
+        def f_nhwc(x):
+            h = tf.nn.conv2d(x, k, strides=[1, 2, 2, 1], padding="SAME")
+            h = tf.nn.bias_add(h, bias)
+            h, _, _ = tf.raw_ops.FusedBatchNormV3(
+                x=h, scale=gamma, offset=beta, mean=mean, variance=var,
+                is_training=False, data_format="NHWC")[:3]
+            h = tf.nn.relu(h)
+            h = tf.nn.max_pool2d(h, 2, 2, "VALID")
+            h = tf.nn.depthwise_conv2d(h, kd, strides=[1, 1, 1, 1],
+                                       padding="SAME")
+            return tf.nn.avg_pool2d(h, 2, 1, "VALID")
+
+        x = rng.normal(size=(2, 2, 12, 12)).astype(np.float32)  # NCHW
+        gd, in_names, out_names, _ = _freeze(
+            f_nchw, tf.TensorSpec(x.shape, tf.float32))
+        ref = np.transpose(
+            np.asarray(f_nhwc(tf.constant(np.transpose(x, (0, 2, 3, 1))))),
+            (0, 3, 1, 2))
+        sd = TFGraphMapper.importGraph(gd)
+        outs = sd.output(dict(zip(in_names, [x])), out_names)
+        np.testing.assert_allclose(np.asarray(outs[out_names[0]]), ref,
+                                   rtol=1e-3, atol=1e-4)
+
     def test_gather_onehot_argmax_cast(self):
         table = tf.Variable(np.random.default_rng(7).normal(
             size=(10, 4)).astype(np.float32))
