@@ -25,6 +25,11 @@ the v5e chip in repeated A/B — better VMEM/HBM working-set fit):
   head projects only masked positions to the 30522-wide vocab (same
   loss value as the full projection, ~6x fewer head FLOPs).
 - rbg PRNG for dropout (threefry costs ~20% of step time on TPU).
+- Regression band (round 4): the framework step is interleaved with
+  the FROZEN pure-jax yardstick in bench_bert_frozen.py; the ratio
+  cancels tenant noise, and the run fails loudly if it falls below
+  the band recorded in BENCH_BASELINE.json (BASELINE.md "BERT
+  regression band").
 """
 
 from __future__ import annotations
@@ -92,7 +97,24 @@ def main() -> None:
                                    ids, labels, mask_pos, rng)
     float(loss)  # full sync — block_until_ready lies on the tunnel
 
+    # Frozen-yardstick interleave (BASELINE.md "BERT regression band"):
+    # bench_bert_frozen.py is a framework-independent pure-jax BERT
+    # step measured in the SAME windows, so tenant noise cancels in
+    # the ratio and a drop below the recorded band means real drift.
+    frozen = None
+    if on_accel:
+        import bench_bert_frozen as bbf
+
+        f_step = bbf.make_frozen_step()
+        f_params = bbf.init_params(0)
+        f_opt = bbf.init_opt_state(f_params)
+        f_params, f_opt, fl = f_step(f_params, f_opt, jnp.asarray(0),
+                                     ids, labels, mask_pos, rng)
+        float(fl)
+        frozen = [f_step, f_params, f_opt]
+
     best_dt = float("inf")
+    frozen_dt = float("inf")
     for _trial in range(3 if on_accel else 1):
         t0 = time.perf_counter()
         for i in range(steps):
@@ -101,27 +123,49 @@ def main() -> None:
                 mask_pos, rng)
         float(loss)  # device->host: cannot complete before the work
         best_dt = min(best_dt, time.perf_counter() - t0)
+        if frozen is not None:
+            f_step, f_params, f_opt = frozen
+            t0 = time.perf_counter()
+            for i in range(steps):
+                f_params, f_opt, fl = f_step(
+                    f_params, f_opt, jnp.asarray(i + 1), ids, labels,
+                    mask_pos, rng)
+            float(fl)
+            frozen_dt = min(frozen_dt, time.perf_counter() - t0)
+            frozen[1], frozen[2] = f_params, f_opt
 
     tokens_per_sec = batch * seqlen * steps / best_dt
 
     base_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                              "BENCH_BASELINE.json")
-    vs_baseline = 1.0
+    # One read, one flag: if the file exists but can't be parsed, never
+    # write it back — recording a fresh baseline over a corrupt read
+    # would silently destroy every recorded band.
+    base, base_ok = {}, True
     try:
-        base = {}
         if os.path.exists(base_path):
             with open(base_path) as f:
                 base = json.load(f)
-        key = f"{platform}_v3"  # methodology version — see docstring
-        if key in base and base[key].get("value"):
-            vs_baseline = tokens_per_sec / float(base[key]["value"])
-        else:
-            base[key] = {"value": tokens_per_sec,
-                         "unit": "tokens/sec/chip"}
+    except (OSError, ValueError):
+        base_ok = False
+
+    def _record(key, entry):
+        if not base_ok:
+            return
+        base[key] = entry
+        try:
             with open(base_path, "w") as f:
                 json.dump(base, f)
-    except (OSError, ValueError):
-        pass
+        except OSError:
+            pass
+
+    vs_baseline = 1.0
+    key = f"{platform}_v3"  # methodology version — see docstring
+    if key in base and base[key].get("value"):
+        vs_baseline = tokens_per_sec / float(base[key]["value"])
+    else:
+        _record(key, {"value": tokens_per_sec,
+                      "unit": "tokens/sec/chip"})
 
     line = {
         "metric": f"bert_{'base' if on_accel else 'tiny_cpu'}_mlm_train",
@@ -129,6 +173,22 @@ def main() -> None:
         "unit": "tokens/sec/chip",
         "vs_baseline": round(vs_baseline, 4),
     }
+    regression = False
+    if frozen is not None and frozen_dt < float("inf"):
+        # Ratio to the frozen in-window yardstick; band recorded on
+        # first run, enforced (5% grace) on later runs.
+        ratio = frozen_dt / best_dt   # >1: framework faster than frozen
+        line["vs_frozen"] = round(ratio, 4)
+        key = f"{platform}_vs_frozen_v1"
+        if key in base and base[key].get("value"):
+            band_lo = float(base[key]["value"]) * 0.95
+            line["vs_frozen_band_lo"] = round(band_lo, 4)
+            if ratio < band_lo:
+                regression = True
+        else:
+            _record(key, {"value": ratio,
+                          "note": "framework/frozen step-time ratio; "
+                                  "band = value*0.95"})
     # MFU from XLA's own cost analysis of the compiled step (measured
     # FLOPs, like the ResNet metric since r2); the config-derived
     # analytic count remains only as a labeled fallback.
@@ -156,6 +216,16 @@ def main() -> None:
         except Exception as e:
             line["lstm_error"] = f"{type(e).__name__}: {e}"[:200]
     print(json.dumps(line))
+    if regression:
+        import sys
+
+        print(f"BENCH REGRESSION: vs_frozen={line['vs_frozen']} below "
+              f"band_lo={line['vs_frozen_band_lo']} — the framework "
+              "step lost ground against the frozen in-window yardstick "
+              "(tenant noise cancels in this ratio; this is real "
+              "drift). See BASELINE.md 'BERT regression band'.",
+              file=sys.stderr)
+        raise SystemExit(1)
 
 
 def _resnet50_metrics(peak) -> dict:

@@ -1,0 +1,145 @@
+"""FROZEN BERT-base MLM yardstick — DO NOT EDIT (see BASELINE.md
+"BERT regression band").
+
+Self-contained pure-jax BERT-base train step that deliberately does
+NOT import deeplearning4j_tpu: framework changes cannot alter it. Each
+bench run interleaves this step with the framework's step in the SAME
+process/window, so shared-chip tenancy noise hits both equally and the
+ratio framework/frozen isolates real framework drift from noise. The
+workload mirrors bench.py v3: batch 96 x seq 128, bf16 compute / f32
+params, dropout 0.1 (rbg PRNG), 19 masked positions per row gathered
+to a 20-slot head, Adam.
+
+Frozen at round 4 (2026-07-31). Any edit invalidates the recorded
+band; bump the band key in BENCH_BASELINE.json if it must change.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+VOCAB = 30522
+D = 768
+LAYERS = 12
+HEADS = 12
+FF = 3072
+MAX_LEN = 512
+CAPACITY = 20
+DROPOUT = 0.1
+
+
+def init_params(seed: int = 0):
+    rs = np.random.RandomState(seed)
+
+    def nrm(*shape, s=0.02):
+        return jnp.asarray(rs.normal(0, s, shape), jnp.float32)
+
+    layers = []
+    for _ in range(LAYERS):
+        layers.append(dict(
+            wq=nrm(D, D), wk=nrm(D, D), wv=nrm(D, D), wo=nrm(D, D),
+            bq=jnp.zeros((D,)), bk=jnp.zeros((D,)), bv=jnp.zeros((D,)),
+            bo=jnp.zeros((D,)),
+            w1=nrm(D, FF), b1=jnp.zeros((FF,)),
+            w2=nrm(FF, D), b2=jnp.zeros((D,)),
+            g1=jnp.ones((D,)), be1=jnp.zeros((D,)),
+            g2=jnp.ones((D,)), be2=jnp.zeros((D,)),
+        ))
+    return dict(
+        tok=nrm(VOCAB, D), pos=nrm(MAX_LEN, D),
+        g0=jnp.ones((D,)), b0=jnp.zeros((D,)),
+        head_w=nrm(D, D), head_b=jnp.zeros((D,)),
+        head_g=jnp.ones((D,)), head_be=jnp.zeros((D,)),
+        out_b=jnp.zeros((VOCAB,)),
+        layers=layers,
+    )
+
+
+def _ln(x, g, b):
+    m = jnp.mean(x, -1, keepdims=True)
+    v = jnp.var(x, -1, keepdims=True)
+    return (x - m) * jax.lax.rsqrt(v + 1e-12) * g + b
+
+
+def _drop(x, rate, rng):
+    keep = jax.random.bernoulli(rng, 1.0 - rate, x.shape)
+    return jnp.where(keep, x / (1.0 - rate), 0.0).astype(x.dtype)
+
+
+def _encoder(params, ids, rng):
+    cd = jnp.bfloat16
+    n, t = ids.shape
+    x = params["tok"].astype(cd)[ids] + params["pos"][:t].astype(cd)
+    x = _ln(x.astype(jnp.float32), params["g0"], params["b0"]).astype(cd)
+    for li, lp in enumerate(params["layers"]):
+        rng, r1, r2, r3 = jax.random.split(rng, 4)
+        q = (x @ lp["wq"].astype(cd) + lp["bq"].astype(cd))
+        k = (x @ lp["wk"].astype(cd) + lp["bk"].astype(cd))
+        v = (x @ lp["wv"].astype(cd) + lp["bv"].astype(cd))
+        hd = D // HEADS
+        q = q.reshape(n, t, HEADS, hd).transpose(0, 2, 1, 3)
+        k = k.reshape(n, t, HEADS, hd).transpose(0, 2, 1, 3)
+        v = v.reshape(n, t, HEADS, hd).transpose(0, 2, 1, 3)
+        att = jnp.einsum("nhqd,nhkd->nhqk", q, k) / np.sqrt(hd)
+        att = jax.nn.softmax(att.astype(jnp.float32), -1).astype(cd)
+        att = _drop(att, DROPOUT, r1)
+        o = jnp.einsum("nhqk,nhkd->nhqd", att, v)
+        o = o.transpose(0, 2, 1, 3).reshape(n, t, D)
+        o = o @ lp["wo"].astype(cd) + lp["bo"].astype(cd)
+        x = _ln((x + _drop(o, DROPOUT, r2)).astype(jnp.float32),
+                lp["g1"], lp["be1"]).astype(cd)
+        h = jax.nn.gelu(x @ lp["w1"].astype(cd) + lp["b1"].astype(cd))
+        h = h @ lp["w2"].astype(cd) + lp["b2"].astype(cd)
+        x = _ln((x + _drop(h, DROPOUT, r3)).astype(jnp.float32),
+                lp["g2"], lp["be2"]).astype(cd)
+    return x
+
+
+def _mlm_loss(params, ids, labels, mask_pos, rng):
+    cd = jnp.bfloat16
+    n, t = ids.shape
+    x = _encoder(params, ids, rng)
+    # gather the <=CAPACITY masked positions per row (same head
+    # optimization as the live bench: project only masked tokens)
+    idx = jnp.argsort(-mask_pos, axis=1)[:, :CAPACITY]
+    valid = jnp.take_along_axis(mask_pos, idx, 1)
+    xg = jnp.take_along_axis(x, idx[..., None], 1)
+    yg = jnp.take_along_axis(labels, idx, 1)
+    h = jax.nn.gelu(xg @ params["head_w"].astype(cd)
+                    + params["head_b"].astype(cd))
+    h = _ln(h.astype(jnp.float32), params["head_g"],
+            params["head_be"]).astype(cd)
+    logits = (h @ params["tok"].astype(cd).T).astype(jnp.float32) \
+        + params["out_b"]
+    lp = jax.nn.log_softmax(logits, -1)
+    nll = -jnp.take_along_axis(lp, yg[..., None], -1)[..., 0]
+    return jnp.sum(nll * valid) / jnp.maximum(jnp.sum(valid), 1.0)
+
+
+def make_frozen_step():
+    b1, b2, eps, lr = 0.9, 0.999, 1e-8, 1e-4
+
+    def step(params, opt_state, it, ids, labels, mask_pos, rng):
+        loss, grads = jax.value_and_grad(_mlm_loss)(
+            params, ids, labels, mask_pos, rng)
+        m, v = opt_state
+        t = it.astype(jnp.float32) + 1.0
+        m = jax.tree_util.tree_map(
+            lambda a, g: b1 * a + (1 - b1) * g, m, grads)
+        v = jax.tree_util.tree_map(
+            lambda a, g: b2 * a + (1 - b2) * g * g, v, grads)
+        scale = lr * jnp.sqrt(1 - b2 ** t) / (1 - b1 ** t)
+        new_p = jax.tree_util.tree_map(
+            lambda p, mm, vv: p - scale * mm / (jnp.sqrt(vv) + eps),
+            params, m, v)
+        return new_p, (m, v), loss
+
+    return jax.jit(step, donate_argnums=(0, 1))
+
+
+def init_opt_state(params):
+    z = jax.tree_util.tree_map(jnp.zeros_like, params)
+    z2 = jax.tree_util.tree_map(jnp.zeros_like, params)
+    return (z, z2)
