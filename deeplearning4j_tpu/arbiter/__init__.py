@@ -14,7 +14,8 @@ from deeplearning4j_tpu.arbiter.space import (
     IntegerParameterSpace, ParameterSpace,
 )
 from deeplearning4j_tpu.arbiter.generator import (
-    GridSearchCandidateGenerator, RandomSearchGenerator,
+    GeneticSearchCandidateGenerator, GridSearchCandidateGenerator,
+    RandomSearchGenerator,
 )
 from deeplearning4j_tpu.arbiter.runner import (
     CandidateResult, LocalOptimizationRunner, MaxCandidatesCondition,
@@ -25,6 +26,7 @@ __all__ = [
     "ParameterSpace", "ContinuousParameterSpace", "DiscreteParameterSpace",
     "IntegerParameterSpace", "FixedValue",
     "GridSearchCandidateGenerator", "RandomSearchGenerator",
+    "GeneticSearchCandidateGenerator",
     "OptimizationConfiguration", "LocalOptimizationRunner",
     "CandidateResult", "MaxCandidatesCondition", "MaxTimeCondition",
 ]

@@ -56,5 +56,97 @@ class GridSearchCandidateGenerator(CandidateGenerator):
             yield dict(zip(keys, combo))
 
 
+class GeneticSearchCandidateGenerator(CandidateGenerator):
+    """Genetic search in genotype space (reference: arbiter
+    GeneticSearchCandidateGenerator + the genetic package's
+    ChromosomeFactory / crossover / mutation operators and
+    PopulationModel with culling).
+
+    The genome is the vector of uniform u-values, one per space key —
+    exactly the reference's double[] chromosome; decoding goes through
+    each ParameterSpace.sample(u) so any space type participates. The
+    runner feeds scores back through report(); until a full first
+    generation is scored, candidates are random (the reference's
+    RandomGenerator initialization phase).
+    """
+
+    def __init__(self, space: Dict[str, ParameterSpace],
+                 population_size: int = 12, parent_fraction: float = 0.34,
+                 crossover_rate: float = 0.85, mutation_rate: float = 0.15,
+                 mutation_sigma: float = 0.15,
+                 minimize: Optional[bool] = None,
+                 seed: int = 0, max_candidates: Optional[int] = None):
+        if population_size < 2:
+            raise ValueError("population_size must be >= 2")
+        self.space = space
+        self.keys = list(space)
+        self.population_size = population_size
+        self.n_parents = max(2, int(round(parent_fraction
+                                          * population_size)))
+        self.crossover_rate = crossover_rate
+        self.mutation_rate = mutation_rate
+        self.mutation_sigma = mutation_sigma
+        # None = inherit the direction from the runner's
+        # OptimizationConfiguration (the authoritative place); a
+        # conflicting explicit value raises there, because breeding
+        # toward the wrong end silently performs worse than random.
+        self.minimize = minimize
+        self.max_candidates = max_candidates
+        self._rng = np.random.RandomState(seed)
+        self._pending: Dict[int, np.ndarray] = {}   # index -> genome
+        self._scored: list = []                     # (score, genome)
+        self._next_index = 0
+        self.last_index: Optional[int] = None       # index of last yield
+
+    def _decode(self, genome: np.ndarray) -> Dict:
+        return {k: self.space[k].sample(float(u))
+                for k, u in zip(self.keys, genome)}
+
+    def _cull(self) -> None:
+        """Keep only the fittest population_size genomes (the
+        reference's PopulationModel culling) so long runs stay O(pop)
+        per candidate instead of growing with every score ever seen."""
+        minimize = True if self.minimize is None else self.minimize
+        self._scored = sorted(self._scored, key=lambda sg: sg[0],
+                              reverse=not minimize)[:self.population_size]
+
+    def _breed(self) -> np.ndarray:
+        self._cull()
+        parents = self._scored[:self.n_parents]
+        i, j = self._rng.choice(len(parents), 2, replace=False)
+        a, b = parents[i][1], parents[j][1]
+        if self._rng.rand() < self.crossover_rate:
+            mask = self._rng.rand(len(a)) < 0.5   # uniform crossover
+            child = np.where(mask, a, b)
+        else:
+            child = a.copy()
+        mut = self._rng.rand(len(child)) < self.mutation_rate
+        child = child + mut * self._rng.normal(
+            0, self.mutation_sigma, len(child))
+        return np.clip(child, 0.0, 1.0 - 1e-9)
+
+    def candidates(self) -> Iterator[Dict]:
+        while (self.max_candidates is None
+               or self._next_index < self.max_candidates):
+            if len(self._scored) >= self.population_size:
+                genome = self._breed()
+            else:
+                genome = self._rng.rand(len(self.keys))
+            idx = self._next_index
+            self._next_index += 1
+            self._pending[idx] = genome
+            self.last_index = idx   # runner reads this for report()
+            yield self._decode(genome)
+
+    def report(self, index: int, score: Optional[float]) -> None:
+        """Score feedback from the runner (reference:
+        CandidateGenerator.reportResults). Failed candidates
+        (score None) are dropped from the gene pool."""
+        genome = self._pending.pop(index, None)
+        if genome is not None and score is not None:
+            self._scored.append((float(score), genome))
+
+
 __all__ = ["CandidateGenerator", "RandomSearchGenerator",
-           "GridSearchCandidateGenerator"]
+           "GridSearchCandidateGenerator",
+           "GeneticSearchCandidateGenerator"]

@@ -62,8 +62,26 @@ class LocalOptimizationRunner:
     def execute(self) -> List[CandidateResult]:
         self._start_time = time.time()
         conds = self.config.termination_conditions
-        for i, cand in enumerate(self.config.candidate_generator.candidates()):
-            if any(c.terminate(self) for c in conds):
+        gen_obj = self.config.candidate_generator
+        # The config owns the optimization direction; adaptive
+        # generators inherit it (None) or must agree — a genetic search
+        # breeding toward the wrong end is silently worse than random.
+        if hasattr(gen_obj, "minimize"):
+            if gen_obj.minimize is None:
+                gen_obj.minimize = self.config.minimize
+            elif bool(gen_obj.minimize) != bool(self.config.minimize):
+                raise ValueError(
+                    f"candidate generator minimize={gen_obj.minimize} "
+                    f"conflicts with OptimizationConfiguration."
+                    f"minimize={self.config.minimize}")
+        # Termination is checked BEFORE pulling the next candidate so a
+        # generator never materializes one that won't be scored (a
+        # genetic generator would otherwise orphan the pending genome).
+        gen = self.config.candidate_generator.candidates()
+        i = 0
+        while not any(c.terminate(self) for c in conds):
+            cand = next(gen, None)
+            if cand is None:
                 break
             t0 = time.time()
             try:
@@ -74,6 +92,16 @@ class LocalOptimizationRunner:
             self.results.append(CandidateResult(
                 index=i, candidate=cand, score=score,
                 duration_s=time.time() - t0, error=err))
+            # Score feedback for adaptive generators (reference:
+            # CandidateGenerator.reportResults — genetic search needs
+            # it). Use the GENERATOR's index for the feedback key: a
+            # pre-warmed generator handed to a fresh runner has already
+            # advanced its counter, so the runner's loop index would
+            # desync and every report would be silently dropped.
+            report = getattr(gen_obj, "report", None)
+            if report is not None:
+                report(getattr(gen_obj, "last_index", i), score)
+            i += 1
         return self.results
 
     def bestResult(self) -> Optional[CandidateResult]:

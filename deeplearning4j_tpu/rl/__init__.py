@@ -23,10 +23,19 @@ from deeplearning4j_tpu.rl.policy import (
 from deeplearning4j_tpu.rl.qlearning import QLearningDiscreteDense, QLConfiguration
 from deeplearning4j_tpu.rl.a2c import A2CDiscreteDense, A2CConfiguration
 from deeplearning4j_tpu.rl.a3c import A3CDiscreteDense, A3CConfiguration
+from deeplearning4j_tpu.rl.async_nstep_q import (
+    AsyncNStepQLearningDiscrete, AsyncNStepQLConfiguration,
+)
+from deeplearning4j_tpu.rl.history import (
+    HistoryMDP, HistoryProcessor, HistoryProcessorConfiguration,
+)
 
 __all__ = ["MDP", "GridWorldMDP", "CorridorMDP", "SlowMDP",
            "ExpReplay", "Transition",
            "Policy", "EpsGreedy", "DQNPolicy", "ACPolicy",
            "QLearningDiscreteDense", "QLConfiguration",
            "A2CDiscreteDense", "A2CConfiguration",
-           "A3CDiscreteDense", "A3CConfiguration"]
+           "A3CDiscreteDense", "A3CConfiguration",
+           "AsyncNStepQLearningDiscrete", "AsyncNStepQLConfiguration",
+           "HistoryProcessor", "HistoryProcessorConfiguration",
+           "HistoryMDP"]

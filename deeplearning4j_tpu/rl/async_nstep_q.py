@@ -1,0 +1,165 @@
+"""Async n-step Q-learning — rl4j's second async learner (reference:
+AsyncNStepQLearningDiscrete + AsyncNStepQLearningThreadDiscrete,
+org/deeplearning4j/rl4j/learning/async/nstep/discrete/**).
+
+Same actor/learner split as a3c.py (worker threads own env stepping,
+lock-free immutable param snapshots, gradient compute outside the
+lock, serialized apply): the difference is the objective — n-step
+TD targets against a periodically-synced TARGET network
+(R_k + gamma^k * max_a Q_target(s', a)) with eps-greedy actors, the
+async precursor of the DQN family instead of actor-critic.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import threading
+import types
+from typing import Callable, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.learning.updaters import Adam, apply_updater
+from deeplearning4j_tpu.rl.a3c import _Counter
+from deeplearning4j_tpu.rl.mdp import MDP
+from deeplearning4j_tpu.rl.policy import DQNPolicy
+from deeplearning4j_tpu.rl.qlearning import _init_mlp, _mlp
+
+
+@functools.lru_cache(maxsize=32)
+def _compiled(lr: float):
+    """Shared jitted fns (see a3c._compiled for why this must outlive
+    instances: per-instance jit closures recompile per trainer)."""
+    updater = Adam(learning_rate=lr)
+
+    def grads_fn(params, obs, act, tgt):
+        def loss_fn(p):
+            q = _mlp(p, obs)
+            sel = jnp.take_along_axis(
+                q, act[:, None].astype(jnp.int32), -1)[:, 0]
+            return jnp.mean((tgt - sel) ** 2)
+
+        return jax.value_and_grad(loss_fn)(params)
+
+    def apply_fn(params, opt_state, grads, it):
+        updates, new_opt = apply_updater(updater, opt_state, grads,
+                                         params, it)
+        return jax.tree_util.tree_map(lambda p, u: p - u, params,
+                                      updates), new_opt
+
+    return types.SimpleNamespace(
+        updater=updater,
+        q=jax.jit(_mlp),
+        grads=jax.jit(grads_fn),
+        apply=jax.jit(apply_fn),
+    )
+
+
+@dataclasses.dataclass
+class AsyncNStepQLConfiguration:
+    seed: int = 0
+    gamma: float = 0.99
+    n_step: int = 5                    # rollout length (nstep)
+    n_workers: int = 4                 # numThread
+    learning_rate: float = 1e-3
+    target_update: int = 50            # targetDqnUpdateFreq (in updates)
+    eps_start: float = 1.0
+    eps_min: float = 0.1
+    anneal_updates: int = 300          # epsilonNbStep, in update units
+    hidden: tuple = (64,)
+
+
+class AsyncNStepQLearningDiscrete:
+    """Public surface matches QLearningDiscreteDense/A3CDiscreteDense:
+    train(updates) with a shared budget, getPolicy(), episode_rewards."""
+
+    def __init__(self, mdp_factory: Callable[[], MDP],
+                 conf: Optional[AsyncNStepQLConfiguration] = None):
+        self.conf = c = conf or AsyncNStepQLConfiguration()
+        self._mdp_factory = mdp_factory
+        probe = mdp_factory()
+        self._n_actions = probe.n_actions
+        trunk = (probe.obs_size,) + tuple(c.hidden) + (probe.n_actions,)
+        probe.close()
+        self._params = _init_mlp(jax.random.key(c.seed), trunk)
+        self._target = self._params
+        fns = _compiled(c.learning_rate)
+        self._q, self._grads, self._apply = fns.q, fns.grads, fns.apply
+        self._opt_state = fns.updater.init_state(self._params)
+        self._it = 0
+        self._lock = threading.Lock()
+        self.episode_rewards: List[float] = []
+
+    def getPolicy(self) -> DQNPolicy:
+        params = self._params
+        return DQNPolicy(
+            lambda o: np.asarray(self._q(params, jnp.asarray(o))))
+
+    def _worker(self, wid: int, budget: _Counter):
+        c = self.conf
+        rng = np.random.RandomState(c.seed * 7919 + wid)
+        env = self._mdp_factory()
+        obs = env.reset()
+        ep_r = 0.0
+        while budget.take():
+            params, target = self._params, self._target
+            frac = min(self._it / max(c.anneal_updates, 1), 1.0)
+            eps = c.eps_start + (c.eps_min - c.eps_start) * frac
+            t_obs, t_act, t_rew = [], [], []
+            done = False
+            for _ in range(c.n_step):
+                if rng.rand() < eps:
+                    a = int(rng.randint(self._n_actions))
+                else:
+                    q = np.asarray(self._q(params,
+                                           jnp.asarray(obs[None])))[0]
+                    a = int(np.argmax(q))
+                nobs, r, done, _info = env.step(a)
+                t_obs.append(obs)
+                t_act.append(a)
+                t_rew.append(r)
+                ep_r += r
+                if done:
+                    with self._lock:
+                        self.episode_rewards.append(ep_r)
+                    ep_r = 0.0
+                    obs = env.reset()
+                    break
+                obs = nobs
+            # n-step returns; bootstrap with the TARGET net unless the
+            # rollout ended the episode (reference: QLearningUpdateAlgorithm)
+            running = 0.0 if done else float(np.max(np.asarray(
+                self._q(target, jnp.asarray(obs[None])))[0]))
+            tgt = np.zeros(len(t_rew), np.float32)
+            for t in reversed(range(len(t_rew))):
+                running = t_rew[t] + c.gamma * running
+                tgt[t] = running
+            _loss, grads = self._grads(
+                params, jnp.asarray(np.stack(t_obs)),
+                jnp.asarray(np.asarray(t_act, np.int32)),
+                jnp.asarray(tgt))
+            with self._lock:
+                self._params, self._opt_state = self._apply(
+                    self._params, self._opt_state, grads,
+                    jnp.asarray(self._it))
+                self._it += 1
+                if self._it % c.target_update == 0:
+                    self._target = self._params
+        env.close()
+
+    def train(self, updates: int = 600) -> List[float]:
+        budget = _Counter(updates)
+        threads = [threading.Thread(target=self._worker,
+                                    args=(w, budget), daemon=True)
+                   for w in range(self.conf.n_workers)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return self.episode_rewards
+
+
+__all__ = ["AsyncNStepQLearningDiscrete", "AsyncNStepQLConfiguration"]

@@ -1,0 +1,136 @@
+"""History processing for pixel observations (reference: rl4j
+IHistoryProcessor / HistoryProcessor + its Configuration —
+org/deeplearning4j/rl4j/util/HistoryProcessor.java: frame skip,
+crop/rescale, and stacking the last `historyLength` frames into the
+network input, the DQN-for-Atari preprocessing).
+
+No OpenCV in this environment (the reference uses JavaCV): rescaling
+is area-averaging via reshape-mean (exact for integer factors, the
+common ALE 210x160 -> 84x84 path uses crop-to-multiple first), and
+grayscale is the standard luma weighting. All numpy, host-side —
+this runs in the env-stepping loop, not on the accelerator.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import deque
+from typing import Optional, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.rl.mdp import MDP
+
+
+@dataclasses.dataclass
+class HistoryProcessorConfiguration:
+    history_length: int = 4            # stacked frames fed to the net
+    rescaled_width: int = 84
+    rescaled_height: int = 84
+    crop_top: int = 0                  # croppingHeight offset
+    crop_left: int = 0
+    skip_frame: int = 4                # act every Nth frame
+    normalize: bool = True             # /255 (reference scales uint8
+    #                                    frames by 1/255 at train time)
+
+
+class HistoryProcessor:
+    """record(frame) accumulates; get_history() -> [history_length, H, W]
+    float32 stack (oldest first), zero-padded until warm."""
+
+    def __init__(self, conf: Optional[HistoryProcessorConfiguration] = None):
+        self.conf = conf or HistoryProcessorConfiguration()
+        self._frames: deque = deque(maxlen=self.conf.history_length)
+
+    def _to_gray(self, frame: np.ndarray) -> np.ndarray:
+        if frame.ndim == 3:
+            c = frame.shape[-1]
+            if c == 1:          # gym/ALE grayscale convention (H,W,1)
+                return frame[..., 0].astype(np.float32)
+            if c in (3, 4):     # RGB / RGBA (alpha ignored)
+                return frame[..., :3] @ np.asarray(
+                    [0.299, 0.587, 0.114], np.float32)
+            raise ValueError(
+                f"frame has {c} channels; expected (H,W), (H,W,1), "
+                "(H,W,3) or (H,W,4)")
+        if frame.ndim != 2:
+            raise ValueError(f"frame rank {frame.ndim} not supported")
+        return frame.astype(np.float32)
+
+    def _rescale(self, g: np.ndarray) -> np.ndarray:
+        c = self.conf
+        g = g[c.crop_top:, c.crop_left:]
+        h, w = g.shape
+        th, tw = c.rescaled_height, c.rescaled_width
+        if (h, w) == (th, tw):
+            return g
+        if h < th or w < tw:
+            raise ValueError(
+                f"frame {h}x{w} smaller than target {th}x{tw}")
+        # crop to an integer multiple, then area-average
+        fh, fw = h // th, w // tw
+        g = g[:fh * th, :fw * tw]
+        return g.reshape(th, fh, tw, fw).mean((1, 3))
+
+    def record(self, frame: np.ndarray) -> None:
+        g = self._rescale(self._to_gray(np.asarray(frame)))
+        if self.conf.normalize:
+            g = g / 255.0
+        self._frames.append(g)
+
+    def get_history(self) -> np.ndarray:
+        c = self.conf
+        out = np.zeros((c.history_length, c.rescaled_height,
+                        c.rescaled_width), np.float32)
+        for i, f in enumerate(self._frames):
+            out[c.history_length - len(self._frames) + i] = f
+        return out
+
+    def reset(self) -> None:
+        self._frames.clear()
+
+
+class HistoryMDP(MDP):
+    """Wraps a pixel MDP with a HistoryProcessor: observations become
+    the flattened frame stack, actions repeat for skip_frame steps with
+    rewards summed (reference: the learning loop's skip handling)."""
+
+    def __init__(self, inner: MDP,
+                 conf: Optional[HistoryProcessorConfiguration] = None):
+        self._inner = inner
+        self.processor = HistoryProcessor(conf)
+        c = self.processor.conf
+        self._obs_shape: Tuple[int, ...] = (
+            c.history_length, c.rescaled_height, c.rescaled_width)
+
+    @property
+    def obs_size(self) -> int:
+        return int(np.prod(self._obs_shape))
+
+    @property
+    def n_actions(self) -> int:
+        return self._inner.n_actions
+
+    def reset(self) -> np.ndarray:
+        self.processor.reset()
+        self.processor.record(self._inner.reset())
+        return self.processor.get_history().reshape(-1)
+
+    def step(self, action: int):
+        c = self.processor.conf
+        total, done, info = 0.0, False, {}
+        for _ in range(max(c.skip_frame, 1)):
+            frame, r, done, info = self._inner.step(action)
+            total += r
+            if done:
+                break
+        self.processor.record(frame)
+        return (self.processor.get_history().reshape(-1), total, done,
+                info)
+
+    def close(self) -> None:
+        self._inner.close()
+
+
+__all__ = ["HistoryProcessor", "HistoryProcessorConfiguration",
+           "HistoryMDP"]

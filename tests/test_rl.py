@@ -9,8 +9,10 @@ import pytest
 
 from deeplearning4j_tpu.rl import (
     A2CConfiguration, A2CDiscreteDense, A3CConfiguration, A3CDiscreteDense,
-    CorridorMDP, DQNPolicy, EpsGreedy, ExpReplay, GridWorldMDP,
-    QLConfiguration, QLearningDiscreteDense, SlowMDP, Transition,
+    AsyncNStepQLConfiguration, AsyncNStepQLearningDiscrete, CorridorMDP,
+    DQNPolicy, EpsGreedy, ExpReplay, GridWorldMDP, HistoryMDP,
+    HistoryProcessor, HistoryProcessorConfiguration, MDP, QLConfiguration,
+    QLearningDiscreteDense, SlowMDP, Transition,
 )
 
 
@@ -127,3 +129,126 @@ class TestA3C:
         t1 = run(1)
         t4 = run(4)
         assert t4 < t1 / 1.6, (t1, t4)
+
+
+class TestAsyncNStepQ:
+    """rl4j's second async learner (AsyncNStepQLearningDiscrete)."""
+
+    def test_converges_on_corridor(self):
+        """Async updates make the trajectory nondeterministic (thread
+        interleaving decides which stale gradient lands first), so
+        train in rounds until the greedy policy solves the corridor —
+        bounded, and failure still means genuinely not converging."""
+        conf = AsyncNStepQLConfiguration(
+            seed=4, n_step=5, n_workers=3, learning_rate=3e-3,
+            target_update=25, anneal_updates=400, hidden=(32,))
+        ql = AsyncNStepQLearningDiscrete(lambda: CorridorMDP(length=6),
+                                         conf)
+        ret = -1.0
+        for _round in range(3):
+            ql.train(updates=600)
+            ret = ql.getPolicy().play(CorridorMDP(length=6))
+            if ret > 0.9:
+                break
+        assert ret > 0.9   # optimal = 0.96: greedy walks to the goal
+
+    def test_target_net_lags_then_syncs(self):
+        conf = AsyncNStepQLConfiguration(seed=0, n_step=4, n_workers=1,
+                                         target_update=10, hidden=(16,))
+        ql = AsyncNStepQLearningDiscrete(lambda: CorridorMDP(length=4),
+                                         conf)
+        ql.train(updates=10)  # exactly one sync boundary
+        a = np.concatenate([np.ravel(p["W"]) for p in ql._target])
+        b = np.concatenate([np.ravel(p["W"]) for p in ql._params])
+        np.testing.assert_allclose(a, b)
+
+
+class _PixelCorridor(MDP):
+    """CorridorMDP rendered as a 16x16 image (pos column lit)."""
+
+    def __init__(self, length=4):
+        self._inner = CorridorMDP(length=length, max_steps=40)
+        self.length = length
+
+    @property
+    def obs_size(self):
+        return 256
+
+    @property
+    def n_actions(self):
+        return 2
+
+    def _render(self, onehot):
+        img = np.zeros((16, 16), np.float32)
+        img[:, int(np.argmax(onehot)) * 2] = 255.0
+        return img
+
+    def reset(self):
+        return self._render(self._inner.reset())
+
+    def step(self, a):
+        o, r, d, i = self._inner.step(a)
+        return self._render(o), r, d, i
+
+
+class TestHistoryProcessor:
+    def test_grayscale_and_area_rescale_exact(self):
+        conf = HistoryProcessorConfiguration(
+            history_length=2, rescaled_width=4, rescaled_height=4,
+            skip_frame=1, normalize=False)
+        hp = HistoryProcessor(conf)
+        rgb = np.zeros((8, 8, 3), np.float32)
+        rgb[..., 0] = 100.0  # pure red
+        hp.record(rgb)
+        h = hp.get_history()
+        assert h.shape == (2, 4, 4)
+        np.testing.assert_allclose(h[0], 0.0)     # zero-padded warmup
+        np.testing.assert_allclose(h[1], 29.9)    # 0.299 * 100, area-avg
+        # non-integer factor: 9x9 -> 4x4 crops to 8x8 then averages
+        hp.record(np.full((9, 9), 8.0, np.float32))
+        np.testing.assert_allclose(hp.get_history()[1], 8.0)
+        # (H,W,1) gym-style grayscale and RGBA both accepted
+        hp.record(np.full((4, 4, 1), 5.0, np.float32))
+        np.testing.assert_allclose(hp.get_history()[1], 5.0)
+        hp.record(np.concatenate([rgb[:4, :4], np.full((4, 4, 1), 9.0,
+                                                       np.float32)], -1))
+        np.testing.assert_allclose(hp.get_history()[1], 29.9)
+        with pytest.raises(ValueError, match="channels"):
+            hp.record(np.zeros((4, 4, 2), np.float32))
+
+    def test_stack_order_oldest_first(self):
+        conf = HistoryProcessorConfiguration(
+            history_length=3, rescaled_width=2, rescaled_height=2,
+            normalize=False)
+        hp = HistoryProcessor(conf)
+        for v in (1.0, 2.0, 3.0, 4.0):
+            hp.record(np.full((2, 2), v, np.float32))
+        h = hp.get_history()
+        np.testing.assert_allclose(h[:, 0, 0], [2.0, 3.0, 4.0])
+
+    def test_history_mdp_skip_and_reward_sum(self):
+        conf = HistoryProcessorConfiguration(
+            history_length=2, rescaled_width=8, rescaled_height=8,
+            skip_frame=2)
+        env = HistoryMDP(_PixelCorridor(length=6), conf)
+        obs = env.reset()
+        assert obs.shape == (2 * 8 * 8,)
+        _, r, done, _ = env.step(1)   # two inner steps, rewards summed
+        assert r == pytest.approx(-0.02) and not done
+        assert env._inner._inner._pos == 2
+
+    def test_dqn_trains_on_pixel_history(self):
+        conf = QLConfiguration(
+            seed=5, max_step=1500, exp_replay_size=1500, batch_size=32,
+            target_dqn_update_freq=50, update_start=64, gamma=0.95,
+            epsilon_nb_step=800, min_epsilon=0.05, hidden=(64,),
+            learning_rate=3e-3)
+        hconf = HistoryProcessorConfiguration(
+            history_length=2, rescaled_width=8, rescaled_height=8,
+            skip_frame=1)
+        ql = QLearningDiscreteDense(
+            HistoryMDP(_PixelCorridor(length=4), hconf), conf)
+        ql.train()
+        ret = ql.getPolicy().play(HistoryMDP(_PixelCorridor(length=4),
+                                             hconf))
+        assert ret > 0.9
