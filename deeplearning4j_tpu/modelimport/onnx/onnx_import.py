@@ -460,8 +460,14 @@ def _conv(ctx):
     group = int(ctx.attr("group", 1))
     pad_mode, spatial = _conv_padding_args(ctx)
     if spatial is not None:
-        x = _explicit_pad_nhwc(ctx, x, spatial)
-        pad_mode = "VALID"
+        if len(spatial) == 2:
+            # conv2d takes ((lo,hi),(lo,hi)) directly — no separate pad
+            # node to rely on XLA re-fusing (pool padding semantics
+            # differ, so _pool keeps the explicit pad op)
+            pad_mode = tuple(tuple(p) for p in spatial)
+        else:
+            x = _explicit_pad_nhwc(ctx, x, spatial)
+            pad_mode = "VALID"
     # ONNX OIHW weights transpose to (kH, kW, I/g, O) above — exactly
     # the grouped-HWIO layout conv2d's feature_group_count expects
     out = ctx.op("conv2d", [x, w], strides=strides, padding=pad_mode,

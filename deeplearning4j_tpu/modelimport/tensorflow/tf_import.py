@@ -497,15 +497,37 @@ def _register_standard_mappers():
         y = emit(xt, *extra_inputs)
         return ctx.op("transpose", [y], permute=[0, 3, 1, 2])
 
-    def _check_padding(ctx):
-        """SAME/VALID only — EXPLICIT (explicit_paddings) must not be
-        silently treated as VALID."""
+    def _check_padding(ctx, allow_explicit=False):
+        """SAME/VALID (+ EXPLICIT for convs) — anything else must not
+        be silently treated as VALID."""
         pad = ctx.attr("padding", "VALID")
-        if pad not in ("SAME", "VALID"):
+        ok = ("SAME", "VALID", "EXPLICIT") if allow_explicit \
+            else ("SAME", "VALID")
+        if pad not in ok:
             raise TFImportError(
                 f"{ctx.node.name}: padding={pad!r} not supported "
-                "(SAME/VALID only)")
+                f"({'/'.join(ok)} only)")
         return pad
+
+    def _explicit_pairs(ctx, df):
+        """TF explicit_paddings: 8 ints, (lo,hi) per dim in data_format
+        order. Returns ((h_lo,h_hi),(w_lo,w_hi)); batch/channel pads
+        must be zero (TF enforces this too)."""
+        ep = [int(v) for v in ctx.attr("explicit_paddings", [])]
+        if len(ep) != 8:
+            raise TFImportError(
+                f"{ctx.node.name}: EXPLICIT padding needs 8 "
+                f"explicit_paddings entries, got {len(ep)}")
+        pairs = list(zip(ep[0::2], ep[1::2]))
+        if df == "NHWC":
+            nc, hw = (pairs[0], pairs[3]), (pairs[1], pairs[2])
+        else:
+            nc, hw = (pairs[0], pairs[1]), (pairs[2], pairs[3])
+        if any(v != 0 for q in nc for v in q):
+            raise TFImportError(
+                f"{ctx.node.name}: nonzero batch/channel explicit "
+                "padding is not a convolution")
+        return hw
 
     def _layout(ctx):
         df = ctx.attr("data_format", "NHWC")
@@ -514,14 +536,19 @@ def _register_standard_mappers():
                 f"{ctx.node.name}: data_format={df!r} not supported")
         return df, ((2, 3) if df == "NCHW" else (1, 2))
 
+    def _conv_pad_attr(ctx, df):
+        pad = _check_padding(ctx, allow_explicit=True)
+        if pad == "EXPLICIT":
+            return _explicit_pairs(ctx, df)
+        return "SAME" if pad == "SAME" else (0, 0)
+
     @R("Conv2D")
     def _conv2d(ctx):
         df, hw = _layout(ctx)
         strides = ctx.attr("strides", [1, 1, 1, 1])
         dil = ctx.attr("dilations", [1, 1, 1, 1])
-        pad = _check_padding(ctx)
         kw = dict(strides=(int(strides[hw[0]]), int(strides[hw[1]])),
-                  padding="SAME" if pad == "SAME" else (0, 0),
+                  padding=_conv_pad_attr(ctx, df),
                   dilation=(int(dil[hw[0]]), int(dil[hw[1]])))
         if df == "NCHW":
             # TF filters are HWIO for BOTH layouts; only x needs moving
@@ -534,9 +561,10 @@ def _register_standard_mappers():
     def _depthwise(ctx):
         df, hw = _layout(ctx)
         strides = ctx.attr("strides", [1, 1, 1, 1])
-        pad = _check_padding(ctx)
+        dil = ctx.attr("dilations", [1, 1, 1, 1])
         kw = dict(strides=(int(strides[hw[0]]), int(strides[hw[1]])),
-                  padding="SAME" if pad == "SAME" else (0, 0))
+                  padding=_conv_pad_attr(ctx, df),
+                  dilation=(int(dil[hw[0]]), int(dil[hw[1]])))
         if df == "NCHW":
             return _nchw_sandwich(
                 ctx, lambda xt: ctx.sd._op(
@@ -792,9 +820,7 @@ def _register_extended_mappers():
 
     @R("Bincount", "DenseBincount")
     def _bincount(ctx):
-        if ctx.attr("binary_output", False):
-            raise TFImportError(
-                f"{ctx.node.name}: binary_output bincount not mapped")
+        binary = bool(ctx.attr("binary_output", False))
         size = int(ctx.static_np(1))
         # weights may be RUNTIME-computed (only size must be static);
         # the no-weights case is an EMPTY tensor, detected by shape —
@@ -812,8 +838,13 @@ def _register_extended_mappers():
                 # The NO-weights encoding is always a constant empty
                 # tensor (caught above), so unknown => real weights.
                 has_w = True
+        if binary and has_w:
+            raise TFImportError(
+                f"{ctx.node.name}: binary_output with weights is "
+                "undefined in TF as well")
         ins = [ctx.inputs[0]] + ([ctx.inputs[2]] if has_w else [])
-        return ctx.op("bincount", ins, minlength=size)
+        return ctx.op("bincount", ins, minlength=size,
+                      binary_output=binary)
 
     @R("Bucketize")
     def _bucketize(ctx):

@@ -78,10 +78,15 @@ def rot90(x, k=1, axes=(0, 1)):
 
 
 @register_op("bincount")
-def bincount(x, weights=None, minlength=0, maxlength=None):
+def bincount(x, weights=None, minlength=0, maxlength=None,
+             binary_output=False):
     """Static output size (jit-safe). TF semantics: ``maxlength`` CAPS
     the bin count (values >= maxlength are dropped); ``minlength``
-    guarantees a floor."""
+    guarantees a floor; ``binary_output`` reports presence (0/1)
+    instead of counts (TF rejects weights in that mode, so do we)."""
+    if binary_output and weights is not None:
+        raise ValueError("bincount: binary_output with weights is "
+                         "undefined (TF rejects it too)")
     # maxlength CAPS the count of values (>= maxlength dropped) but the
     # static output size must still cover [minlength, maxlength) — a
     # min() here would silently drop counts in that range.
@@ -98,6 +103,8 @@ def bincount(x, weights=None, minlength=0, maxlength=None):
         idx, num_segments=nbins + 1)[:nbins]
     # TF bincount's default output dtype is int32 (and int64 would just
     # truncate + warn under x64-disabled JAX)
+    if binary_output:
+        return (out > 0).astype(jnp.int32)
     return out if weights is not None else out.astype(jnp.int32)
 
 

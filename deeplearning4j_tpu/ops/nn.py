@@ -41,10 +41,15 @@ def _conv_padding(padding, kernel, strides, dilation):
 
     The reference uses ConvolutionMode {Same, Truncate, Causal} plus
     explicit pad values. Strings 'SAME'/'VALID' map straight to lax;
-    explicit ints become symmetric pads.
+    explicit ints become symmetric pads; ((lo,hi),(lo,hi)) pairs pass
+    through asymmetric (TF EXPLICIT padding).
     """
     if isinstance(padding, str):
         return padding.upper()
+    if (isinstance(padding, (tuple, list)) and len(padding) == 2
+            and all(isinstance(q, (tuple, list)) and len(q) == 2
+                    for q in padding)):
+        return [tuple(int(v) for v in q) for q in padding]
     p = _pair(padding)
     return [(p[0], p[0]), (p[1], p[1])]
 

@@ -59,3 +59,7 @@ class TestExamples:
         improved = _run("tf_import_bert.py").main(layers=1, hidden=32,
                                                   steps=10)
         assert improved
+
+    def test_rl_async_a3c_example(self):
+        ret = _run("rl_async_a3c.py").main(updates=800)
+        assert ret > 0.9   # both async learners solve the 3x3 grid
