@@ -26,6 +26,9 @@ from deeplearning4j_tpu.nlp.serializer import WordVectorSerializer
 from deeplearning4j_tpu.nlp.glove import Glove
 from deeplearning4j_tpu.nlp.fasttext import FastText
 from deeplearning4j_tpu.nlp.tsne import BarnesHutTsne
+from deeplearning4j_tpu.nlp.vectorizer import (
+    BagOfWordsVectorizer, TfidfVectorizer,
+)
 from deeplearning4j_tpu.nlp.bert_wordpiece import (
     BertIterator, BertWordPieceTokenizer,
 )
@@ -35,7 +38,8 @@ from deeplearning4j_tpu.nlp.sentence_iterators import (
 )
 
 __all__ = [
-    "AbstractCache", "BarnesHutTsne", "BasicLineIterator",
+    "AbstractCache", "BagOfWordsVectorizer", "BarnesHutTsne",
+    "BasicLineIterator",
     "BertIterator", "BertWordPieceTokenizer",
     "CnnSentenceDataSetIterator", "CollectionLabeledSentenceProvider",
     "CollectionSentenceIterator",
@@ -43,6 +47,7 @@ __all__ = [
     "CommonPreprocessor", "DefaultTokenizer", "DefaultTokenizerFactory",
     "FastText", "Glove",
     "NGramTokenizerFactory", "ParagraphVectors", "SentenceIterator",
-    "SequenceVectors", "Tokenizer", "TokenizerFactory", "VocabCache",
+    "SequenceVectors", "TfidfVectorizer", "Tokenizer",
+    "TokenizerFactory", "VocabCache",
     "VocabWord", "Word2Vec", "WordVectorSerializer",
 ]

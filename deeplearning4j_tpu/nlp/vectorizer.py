@@ -1,0 +1,122 @@
+"""Bag-of-words / TF-IDF text vectorizers (reference:
+deeplearning4j-nlp org/deeplearning4j/bagofwords/vectorizer/
+{BagOfWordsVectorizer,TfidfVectorizer} + their Builder surface —
+built on VocabCache + a labels source, producing DataSets whose
+features are vocab-sized count/tf-idf rows).
+
+Design: fit() makes one pass over the sentence iterator building the
+AbstractCache vocabulary (min_word_frequency / stop-words filtering,
+document frequencies tracked per word); transform() produces dense
+float32 rows — the reference emits dense INDArrays here too (its
+sparse InvertedIndex backs lookup, not the output), and a vocab-sized
+dense row feeds the jitted classifier path directly. TF-IDF uses the
+reference's smoothed formula from TfidfVectorizer.tfidfWord:
+idf = log10(1 + N / (1 + df)) scaled by the in-document term count.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List, Optional, Sequence
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.nlp.tokenization import (DefaultTokenizerFactory,
+                                                 TokenizerFactory)
+from deeplearning4j_tpu.nlp.vocab import AbstractCache
+
+
+class BaseTextVectorizer:
+    """Shared fit/vocab machinery (reference: BaseTextVectorizer)."""
+
+    def __init__(self, tokenizer_factory: Optional[TokenizerFactory] = None,
+                 min_word_frequency: int = 1,
+                 stop_words: Optional[Iterable[str]] = None):
+        self.tokenizer_factory = (tokenizer_factory
+                                  or DefaultTokenizerFactory())
+        self.min_word_frequency = min_word_frequency
+        self.stop_words = set(stop_words or ())
+        self.vocab = AbstractCache()
+        self._doc_freq: dict = {}
+        self.n_docs = 0
+
+    def _tokens(self, text: str) -> List[str]:
+        toks = self.tokenizer_factory.create(text).getTokens()
+        return [t for t in toks if t and t not in self.stop_words]
+
+    def fit(self, sentences: Iterable[str]) -> "BaseTextVectorizer":
+        for text in sentences:
+            toks = self._tokens(text)
+            if not toks:
+                continue
+            self.n_docs += 1
+            for t in toks:
+                self.vocab.addToken(t)
+            for t in set(toks):
+                self._doc_freq[t] = self._doc_freq.get(t, 0) + 1
+        self.vocab.finalize_vocab(self.min_word_frequency)
+        return self
+
+    # camelCase parity
+    buildVocab = fit
+
+    @property
+    def vocab_size(self) -> int:
+        return self.vocab.numWords()
+
+    def _counts_row(self, text: str) -> np.ndarray:
+        row = np.zeros(self.vocab.numWords(), np.float32)
+        for t in self._tokens(text):
+            i = self.vocab.indexOf(t)
+            if i >= 0:
+                row[i] += 1.0
+        return row
+
+    def transform(self, text: str) -> np.ndarray:
+        raise NotImplementedError
+
+    def transform_batch(self, texts: Sequence[str]) -> np.ndarray:
+        return np.stack([self.transform(t) for t in texts])
+
+    def vectorize(self, text: str, label: int,
+                  num_labels: int) -> DataSet:
+        """text + label index -> DataSet (reference: vectorize(String,
+        String) against the labels source)."""
+        f = self.transform(text)[None]
+        l = np.zeros((1, num_labels), np.float32)
+        l[0, int(label)] = 1.0
+        return DataSet(f, l)
+
+
+class BagOfWordsVectorizer(BaseTextVectorizer):
+    """Raw in-document term counts (reference: BagOfWordsVectorizer)."""
+
+    def transform(self, text: str) -> np.ndarray:
+        return self._counts_row(text)
+
+
+class TfidfVectorizer(BaseTextVectorizer):
+    """Smoothed tf-idf rows (reference: TfidfVectorizer.tfidfWord —
+    idf = log10(1 + N/(1 + df)), tf = raw in-document count)."""
+
+    def idf(self, word: str) -> float:
+        df = self._doc_freq.get(word, 0)
+        return float(np.log10(1.0 + self.n_docs / (1.0 + df)))
+
+    def fit(self, sentences: Iterable[str]) -> "TfidfVectorizer":
+        super().fit(sentences)
+        # idf is fixed once the vocab is final; cache the vector so
+        # transform is O(tokens), not O(vocab) of dict lookups per call
+        self._idf = np.asarray(
+            [self.idf(self.vocab.wordAtIndex(i) or "")
+             for i in range(self.vocab.numWords())], np.float32)
+        return self
+
+    buildVocab = fit
+
+    def transform(self, text: str) -> np.ndarray:
+        return self._counts_row(text) * self._idf
+
+
+__all__ = ["BaseTextVectorizer", "BagOfWordsVectorizer",
+           "TfidfVectorizer"]

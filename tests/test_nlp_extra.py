@@ -87,3 +87,80 @@ class TestTsne:
         out = ts.plot(x, n_dims=3)
         assert out.shape == (20, 3)
         assert ts.getData() is out
+
+
+class TestVectorizers:
+    """Reference: bagofwords/vectorizer/{BagOfWordsVectorizer,
+    TfidfVectorizer} (deeplearning4j-nlp)."""
+
+    CORPUS = ["the cat sat on the mat",
+              "the dog sat on the log",
+              "cats and dogs and cats"]
+
+    def test_bow_counts_and_vocab_filtering(self):
+        from deeplearning4j_tpu.nlp import BagOfWordsVectorizer
+
+        v = BagOfWordsVectorizer(min_word_frequency=2,
+                                 stop_words=["the", "and"])
+        v.fit(self.CORPUS)
+        # survivors: sat(2) on(2) cats(2); cat/mat/dog/log/dogs fall
+        # below min_word_frequency; the/and stopped
+        assert sorted(v.vocab.words()) == ["cats", "on", "sat"]
+        row = v.transform("cats on cats on cats zzz")
+        assert row[v.vocab.indexOf("cats")] == 3.0
+        assert row[v.vocab.indexOf("on")] == 2.0
+        assert row[v.vocab.indexOf("sat")] == 0.0
+
+    def test_tfidf_matches_reference_formula(self):
+        import numpy as np
+
+        from deeplearning4j_tpu.nlp import TfidfVectorizer
+
+        v = TfidfVectorizer()
+        v.fit(self.CORPUS)
+        # 'sat' appears in 2 of 3 docs; reference formula:
+        # idf = log10(1 + N/(1+df)), tf = raw count in the query doc
+        row = v.transform("sat sat cat")
+        want_sat = 2.0 * np.log10(1.0 + 3.0 / 3.0)
+        want_cat = 1.0 * np.log10(1.0 + 3.0 / 2.0)
+        np.testing.assert_allclose(row[v.vocab.indexOf("sat")],
+                                   want_sat, rtol=1e-6)
+        np.testing.assert_allclose(row[v.vocab.indexOf("cat")],
+                                   want_cat, rtol=1e-6)
+
+    def test_vectorize_dataset_and_training(self):
+        """End to end: tf-idf rows feed the compiled classifier path
+        (the reference's vectorizer -> DataSet -> fit pipeline)."""
+        import numpy as np
+
+        from deeplearning4j_tpu.learning import Adam
+        from deeplearning4j_tpu.nlp import TfidfVectorizer
+        from deeplearning4j_tpu.nn.conf import (DenseLayer, InputType,
+                                                NeuralNetConfiguration,
+                                                OutputLayer)
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+        pos = ["good great fine excellent good",
+               "great good wonderful fine",
+               "excellent wonderful great day"]
+        neg = ["bad awful poor terrible bad",
+               "awful bad dreadful poor",
+               "terrible dreadful poor day"]
+        v = TfidfVectorizer()
+        v.fit(pos + neg)
+        ds = v.vectorize(pos[0], 0, 2)
+        assert ds.getFeatures().shape() == (1, v.vocab_size)
+        x = v.transform_batch(pos + neg)
+        y = np.repeat(np.eye(2, dtype=np.float32), 3, axis=0)
+        conf = (NeuralNetConfiguration.builder().seed(1)
+                .updater(Adam(1e-1)).list()
+                .layer(DenseLayer(n_out=8, activation="relu"))
+                .layer(OutputLayer(n_out=2, activation="softmax",
+                                   loss="mcxent"))
+                .setInputType(InputType.feedForward(v.vocab_size))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        for _ in range(60):
+            net.fit(x, y)
+        pred = np.asarray(net.output(x)).argmax(1)
+        assert (pred == y.argmax(1)).all()
