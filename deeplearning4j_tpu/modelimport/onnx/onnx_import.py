@@ -752,6 +752,321 @@ def _layer_norm(ctx):
     return ctx.op("layer_norm", ins, eps=eps)
 
 
+# --------------------------------------------------- breadth (round 4)
+@R("ArgMin")
+def _argmin(ctx):
+    out = ctx.op("argmin", ctx.inputs[:1],
+                 dimensions=int(ctx.attr("axis", 0)))
+    if int(ctx.attr("keepdims", 1)):
+        out = ctx.op("expand_dims", [out], axis=int(ctx.attr("axis", 0)))
+    return out
+
+
+for _onnx_name, _our in {"And": "logical_and", "Or": "logical_or",
+                         "Xor": "logical_xor"}.items():
+    @R(_onnx_name)
+    def _logic2(ctx, _o=_our):
+        return ctx.op(_o, ctx.inputs[:2])
+
+
+@R("Not")
+def _logic_not(ctx):
+    return ctx.op("logical_not", ctx.inputs[:1])
+
+
+@R("Split")
+def _split(ctx):
+    axis = int(ctx.attr("axis", 0))
+    n_out = len(ctx.node.output)
+    sizes = ctx.attr("split")                       # opset < 13: attr
+    if sizes is None and len(ctx.inputs) > 1 and ctx.inputs[1] is not None:
+        sizes = [int(v) for v in ctx.static_np(1)]  # opset >= 13: input
+    if sizes is None:
+        return ctx.op("split", ctx.inputs[:1], n_out=n_out,
+                      num_splits=n_out, axis=axis)
+    sizes = [int(s) for s in sizes]
+    if len(set(sizes)) == 1:
+        return ctx.op("split", ctx.inputs[:1], n_out=n_out,
+                      num_splits=n_out, axis=axis)
+    return ctx.op("split_v", ctx.inputs[:1], n_out=n_out, sizes=sizes,
+                  axis=axis)
+
+
+@R("ConvTranspose")
+def _conv_transpose(ctx):
+    """Maps onto deconv2d (out = s*(in-1) + k - 2p): symmetric pads,
+    no output_padding — the torch ConvTranspose2d export defaults."""
+    if int(ctx.attr("group", 1)) != 1:
+        raise OnnxImportError(
+            f"{ctx.node.name}: grouped ConvTranspose not supported")
+    if any(int(v) for v in ctx.attr("output_padding", []) or []):
+        raise OnnxImportError(
+            f"{ctx.node.name}: output_padding not supported")
+    if any(int(d) != 1 for d in ctx.attr("dilations", []) or []):
+        raise OnnxImportError(
+            f"{ctx.node.name}: dilated ConvTranspose not supported")
+    strides = [int(s) for s in ctx.attr("strides", [1, 1])]
+    auto = ctx.attr("auto_pad", "NOTSET")
+    if auto == "SAME_LOWER":
+        raise OnnxImportError(
+            f"{ctx.node.name}: ConvTranspose SAME_LOWER not supported "
+            "(odd pad lands on the opposite side)")
+    if auto == "SAME_UPPER":
+        padding = "SAME"
+    else:
+        pads = [int(p) for p in ctx.attr("pads", [0, 0, 0, 0])]
+        n = len(pads) // 2
+        if pads[:n] != pads[n:]:
+            raise OnnxImportError(
+                f"{ctx.node.name}: asymmetric ConvTranspose pads not "
+                "supported")
+        padding = tuple(pads[:n]) if any(pads) else "VALID"
+    x = ctx.to_nhwc(ctx.inputs[0])
+    # ONNX W is (Cin, Cout, kH, kW) -> deconv2d wants (kH, kW, Cin, Cout);
+    # ONNX/torch ConvTranspose is the GRADIENT of a forward conv, i.e.
+    # correlation with the spatially FLIPPED kernel — lax.conv_transpose
+    # (deconv2d) zero-inserts then correlates unflipped, so flip here
+    w = ctx.op("transpose", [ctx.inputs[1]], permute=[2, 3, 0, 1])
+    w = ctx.op("reverse", [w], dimensions=[0, 1])
+    ins = [x, w] + ([ctx.inputs[2]] if len(ctx.inputs) > 2
+                    and ctx.inputs[2] is not None else [])
+    out = ctx.op("deconv2d", ins, strides=strides, padding=padding)
+    return ctx.to_nchw(out)
+
+
+@R("Resize", "Upsample")
+def _resize(ctx):
+    """Supported subset, loud elsewhere: nearest with integer scales
+    (asymmetric/floor — the torch Upsample export) via repeat, and
+    linear with half_pixel (jax.image semantics) via resize_bilinear."""
+    mode = ctx.attr("mode", "nearest")
+    coord = ctx.attr("coordinate_transformation_mode", "half_pixel")
+    # scales: Upsample/opset10 input 1; Resize opset>=11 input 2 (roi=1)
+    scales = sizes = None
+    if ctx.node.op_type == "Upsample":
+        scales = ctx.static_np(1)
+    else:
+        s = ctx.maybe_static(2)
+        if s is not None and np.asarray(s).size:
+            scales = s
+        elif len(ctx.inputs) > 3:
+            sizes = ctx.static_np(3)
+        else:
+            raise OnnxImportError(
+                f"{ctx.node.name}: Resize needs static scales or a "
+                "sizes input (dynamic scales not importable)")
+    if mode == "nearest":
+        if coord not in ("asymmetric", "half_pixel"):
+            raise OnnxImportError(
+                f"{ctx.node.name}: Resize nearest with coord mode "
+                f"{coord!r} not supported")
+        if scales is not None:
+            sc = [float(v) for v in np.asarray(scales).ravel()]
+            if len(sc) != 4 or sc[0] != 1 or sc[1] != 1:
+                raise OnnxImportError(
+                    f"{ctx.node.name}: Resize scales must be "
+                    "[1,1,sH,sW]")
+            if sc[2] != int(sc[2]) or sc[3] != int(sc[3]):
+                raise OnnxImportError(
+                    f"{ctx.node.name}: non-integer nearest scales not "
+                    "supported")
+            x = ctx.to_nhwc(ctx.inputs[0])
+            out = ctx.op("upsampling2d", [x],
+                         scale=(int(sc[2]), int(sc[3])))
+            return ctx.to_nchw(out)
+        x = ctx.to_nhwc(ctx.inputs[0])
+        out = ctx.op("resize_nearest_neighbor", [x],
+                     size=[int(v) for v in np.asarray(sizes).ravel()[2:]])
+        return ctx.to_nchw(out)
+    if mode == "linear":
+        if coord not in ("half_pixel", "pytorch_half_pixel"):
+            raise OnnxImportError(
+                f"{ctx.node.name}: Resize linear with coord mode "
+                f"{coord!r} not supported (half_pixel only)")
+        if sizes is None:
+            sc = [float(v) for v in np.asarray(scales).ravel()]
+            h, w = None, None
+            aval = ctx.avals.get(ctx.inputs[0].name) if ctx.avals else None
+            if aval is None:
+                raise OnnxImportError(
+                    f"{ctx.node.name}: linear Resize by scales needs a "
+                    "known input shape")
+            # spec: output dim = floor(input_dim * scale)
+            h = int(np.floor(aval.shape[2] * sc[2]))
+            w = int(np.floor(aval.shape[3] * sc[3]))
+        else:
+            h, w = [int(v) for v in np.asarray(sizes).ravel()[2:]]
+        x = ctx.to_nhwc(ctx.inputs[0])
+        out = ctx.op("resize_bilinear", [x], size=[h, w])
+        return ctx.to_nchw(out)
+    raise OnnxImportError(
+        f"{ctx.node.name}: Resize mode {mode!r} not supported")
+
+
+@R("InstanceNormalization")
+def _instance_norm(ctx):
+    x = ctx.to_nhwc(ctx.inputs[0])
+    out = ctx.op("instance_norm", [x, ctx.inputs[1], ctx.inputs[2]],
+                 eps=float(ctx.attr("epsilon", 1e-5)))
+    return ctx.to_nchw(out)
+
+
+@R("TopK")
+def _topk(ctx):
+    k = int(ctx.static_np(1).ravel()[0])
+    axis = int(ctx.attr("axis", -1))
+    largest = int(ctx.attr("largest", 1))
+    aval = ctx.avals.get(ctx.inputs[0].name) if ctx.avals else None
+    rank = len(aval.shape) if aval is not None else None
+    if axis not in (-1, (rank - 1 if rank else -1)):
+        raise OnnxImportError(
+            f"{ctx.node.name}: TopK on non-last axis not supported")
+    x = ctx.inputs[0]
+    if not largest:
+        x = ctx.op("neg", [x])
+    vals, idx = ctx.op("top_k", [x], n_out=2, k=k)
+    if not largest:
+        vals = ctx.op("neg", [vals])
+    return vals, idx
+
+
+@R("CumSum")
+def _cumsum(ctx):
+    axis = int(ctx.static_np(1).ravel()[0])
+    return ctx.op("cumsum", ctx.inputs[:1], axis=axis,
+                  exclusive=bool(ctx.attr("exclusive", 0)),
+                  reverse=bool(ctx.attr("reverse", 0)))
+
+
+@R("Range")
+def _range(ctx):
+    start, limit, delta = (ctx.static_np(i).ravel()[0] for i in range(3))
+    if any(np.issubdtype(np.asarray(v).dtype, np.floating)
+           for v in (start, limit, delta)):
+        vals = np.arange(float(start), float(limit), float(delta),
+                         dtype=np.float32)
+    else:
+        vals = np.arange(int(start), int(limit), int(delta),
+                         dtype=np.int32)
+    return ctx.sd.constant(ctx.node.output[0], vals)
+
+
+@R("OneHot")
+def _one_hot(ctx):
+    depth = int(ctx.static_np(1).ravel()[0])
+    values = np.asarray(ctx.static_np(2)).ravel()   # [off, on]
+    ids = ctx.op("cast", ctx.inputs[:1], dtype="int32")
+    return ctx.op("one_hot", [ids], depth=depth,
+                  axis=int(ctx.attr("axis", -1)),
+                  off_value=float(values[0]), on_value=float(values[1]))
+
+
+@R("GatherND")
+def _gather_nd(ctx):
+    if int(ctx.attr("batch_dims", 0)) != 0:
+        raise OnnxImportError(
+            f"{ctx.node.name}: GatherND batch_dims != 0 not supported")
+    return ctx.op("gather_nd", ctx.inputs[:2])
+
+
+@R("GatherElements")
+def _gather_elements(ctx):
+    return ctx.op("take_along_axis", ctx.inputs[:2],
+                  axis=int(ctx.attr("axis", 0)))
+
+
+@R("ScatterND")
+def _scatter_nd(ctx):
+    if ctx.attr("reduction", "none") != "none":
+        raise OnnxImportError(
+            f"{ctx.node.name}: ScatterND reduction not supported")
+    return ctx.op("scatter_nd_update", ctx.inputs[:3])
+
+
+# ReduceL1/L2/LogSumExp have direct registered counterparts — extend
+# the same axes-attr-or-input extraction the core _REDUCE loop uses
+for _onnx_name, _our in {"ReduceL1": "reduce_norm1",
+                         "ReduceL2": "reduce_norm2",
+                         "ReduceLogSumExp": "reduce_logsumexp"}.items():
+    @R(_onnx_name)
+    def _reduce_direct(ctx, _o=_our):
+        axes = ctx.attr("axes")
+        if axes is None and len(ctx.inputs) > 1 \
+                and ctx.inputs[1] is not None:
+            axes = [int(a) for a in ctx.static_np(1)]
+        return ctx.op(_o, ctx.inputs[:1],
+                      dimensions=[int(a) for a in axes] if axes else None,
+                      keep_dims=bool(ctx.attr("keepdims", 1)))
+
+
+@R("ReduceSumSquare", "ReduceLogSum")
+def _reduce_composite(ctx):
+    axes = ctx.attr("axes")
+    if axes is None and len(ctx.inputs) > 1 and ctx.inputs[1] is not None:
+        axes = [int(a) for a in ctx.static_np(1)]
+    kw = dict(dimensions=[int(a) for a in axes] if axes else None,
+              keep_dims=bool(ctx.attr("keepdims", 1)))
+    x = ctx.inputs[0]
+    if ctx.node.op_type == "ReduceSumSquare":
+        return ctx.op("reduce_sum", [ctx.op("mul", [x, x])], **kw)
+    return ctx.op("log", [ctx.op("reduce_sum", [x], **kw)])
+
+
+@R("DepthToSpace", "SpaceToDepth")
+def _d2s_s2d(ctx):
+    if ctx.node.op_type == "DepthToSpace" \
+            and ctx.attr("mode", "DCR") != "DCR":
+        raise OnnxImportError(
+            f"{ctx.node.name}: DepthToSpace CRD mode not supported")
+    our = ("depth_to_space" if ctx.node.op_type == "DepthToSpace"
+           else "space_to_depth")
+    x = ctx.to_nhwc(ctx.inputs[0])
+    out = ctx.op(our, [x], block_size=int(ctx.attr("blocksize")))
+    return ctx.to_nchw(out)
+
+
+@R("HardSwish")
+def _hard_swish(ctx):
+    return ctx.op("hard_swish", ctx.inputs[:1])
+
+
+@R("Mish")
+def _mish(ctx):
+    return ctx.op("mish", ctx.inputs[:1])
+
+
+@R("Trilu")
+def _trilu(ctx):
+    k = 0
+    if len(ctx.inputs) > 1 and ctx.inputs[1] is not None:
+        k = int(ctx.static_np(1).ravel()[0])
+    our = "triu" if int(ctx.attr("upper", 1)) else "tril"
+    return ctx.op(our, ctx.inputs[:1], k=k)
+
+
+@R("Einsum")
+def _einsum(ctx):
+    return ctx.op("einsum", ctx.inputs,
+                  equation=ctx.attr("equation"))
+
+
+@R("ReverseSequence")
+def _reverse_sequence(ctx):
+    return ctx.op("reverse_sequence", ctx.inputs[:2],
+                  seq_axis=int(ctx.attr("time_axis", 0)),
+                  batch_axis=int(ctx.attr("batch_axis", 1)))
+
+
+@R("Mean")
+def _mean_nary(ctx):
+    out = ctx.inputs[0]
+    for v in ctx.inputs[1:]:
+        out = ctx.op("add", [out, v])
+    inv = ctx.sd.constant(f"{ctx.node.output[0]}_invn",
+                          np.float32(1.0 / len(ctx.inputs)))
+    return ctx.op("mul", [out, inv])
+
+
 # ---------------------------------------------------------------- import
 def _propagate_onnx(sd, const_vals, avals, from_idx: int) -> None:
     """Shape/dtype eval for ops emitted since from_idx, plus eager

@@ -45,6 +45,11 @@ class BaseTextVectorizer:
         return [t for t in toks if t and t not in self.stop_words]
 
     def fit(self, sentences: Iterable[str]) -> "BaseTextVectorizer":
+        # refit = fresh statistics; accumulating across corpora would
+        # silently mix vocab indices, df counts and n_docs
+        self.vocab = AbstractCache()
+        self._doc_freq = {}
+        self.n_docs = 0
         for text in sentences:
             toks = self._tokens(text)
             if not toks:

@@ -265,3 +265,11 @@ def diff(x, n=1, axis=-1):
 @register_op("trapz")
 def trapz(y, dx=1.0, axis=-1):
     return jax.scipy.integrate.trapezoid(y, dx=dx, axis=axis)
+
+
+@register_op("einsum")
+def einsum(*operands, equation):
+    """General tensor contraction (reference: the libnd4j einsum-style
+    composite ops; ONNX Einsum). XLA lowers this straight onto the MXU
+    for contraction terms."""
+    return jnp.einsum(equation, *operands)

@@ -279,3 +279,244 @@ class TestOnnxShapeOps:
         r = x.reshape(2, 2, 3).transpose(0, 2, 1)
         want = np.concatenate([r, r], 2)[:, :, :2]
         np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def attr_str(name: str, s: str) -> bytes:
+    return _str(1, name) + _ld(4, s.encode()) + _iv(20, 3)
+
+
+class TestOnnxBreadthRound4:
+    """Round-4 mapper batch: the common exported-model op tail
+    (reference: samediff-import-onnx's mapper set spans these)."""
+
+    def _run(self, nodes, inits, ins, outs, feeds):
+        g = graph(nodes=nodes, initializers=inits, inputs=ins,
+                  outputs=outs)
+        sd = OnnxImport.importGraph(model(g))
+        return {k: np.asarray(v)
+                for k, v in sd.output(feeds, [o for o in self._onames]).items()}
+
+    def _go(self, op, attrs, feeds, inits, want, extra_inputs=(),
+            n_out=1, rtol=1e-5, atol=1e-6):
+        in_names = list(feeds) + list(extra_inputs)
+        self._onames = [f"o{i}" for i in range(n_out)]
+        g = graph(
+            nodes=[node(op, in_names, self._onames, "n", attrs=attrs)],
+            initializers=inits,
+            inputs=[value_info(k, list(v.shape)) for k, v in feeds.items()],
+            outputs=[value_info(o, []) for o in self._onames],
+        )
+        sd = OnnxImport.importGraph(model(g))
+        got = sd.output(feeds, self._onames)
+        for o, w in zip(self._onames, want if n_out > 1 else [want]):
+            np.testing.assert_allclose(np.asarray(got[o]), w, rtol=rtol,
+                                       atol=atol)
+
+    def test_split_equal_and_uneven(self):
+        x = np.arange(12, dtype=np.float32).reshape(2, 6)
+        self._go("Split", [attr_int("axis", 1)], {"x": x}, [],
+                 [x[:, :2], x[:, 2:4], x[:, 4:]], n_out=3)
+        self._go("Split", [attr_int("axis", 1)], {"x": x},
+                 [tensor("sz", np.asarray([1, 5], np.int64))],
+                 [x[:, :1], x[:, 1:]], extra_inputs=["sz"], n_out=2)
+
+    def test_conv_transpose_matches_torch(self):
+        import torch
+
+        rs = np.random.RandomState(0)
+        x = rs.randn(2, 3, 5, 5).astype(np.float32)
+        w = (rs.randn(3, 4, 3, 3) * 0.3).astype(np.float32)
+        want = torch.nn.functional.conv_transpose2d(
+            torch.tensor(x), torch.tensor(w), stride=2,
+            padding=1).numpy()
+        self._go("ConvTranspose",
+                 [attr_ints("strides", [2, 2]),
+                  attr_ints("pads", [1, 1, 1, 1])],
+                 {"x": x}, [tensor("w", w)], want,
+                 extra_inputs=["w"], rtol=1e-4, atol=1e-5)
+
+    def test_resize_nearest_and_linear(self):
+        import torch
+
+        rs = np.random.RandomState(1)
+        x = rs.randn(1, 2, 3, 4).astype(np.float32)
+        want = x.repeat(2, axis=2).repeat(3, axis=3)
+        self._go("Resize",
+                 [attr_str("mode", "nearest"),
+                  attr_str("coordinate_transformation_mode",
+                           "asymmetric")],
+                 {"x": x},
+                 [tensor("roi", np.zeros(0, np.float32)),
+                  tensor("sc", np.asarray([1, 1, 2, 3], np.float32))],
+                 want, extra_inputs=["roi", "sc"])
+        want_lin = torch.nn.functional.interpolate(
+            torch.tensor(x), size=(6, 8), mode="bilinear",
+            align_corners=False).numpy()
+        self._go("Resize",
+                 [attr_str("mode", "linear"),
+                  attr_str("coordinate_transformation_mode",
+                           "half_pixel")],
+                 {"x": x},
+                 [tensor("roi", np.zeros(0, np.float32)),
+                  tensor("sc", np.zeros(0, np.float32)),
+                  tensor("sizes", np.asarray([1, 2, 6, 8], np.int64))],
+                 want_lin, extra_inputs=["roi", "sc", "sizes"],
+                 rtol=1e-4, atol=1e-5)
+
+    def test_instance_norm_matches_torch(self):
+        import torch
+
+        rs = np.random.RandomState(2)
+        x = rs.randn(2, 3, 4, 5).astype(np.float32)
+        g_ = rs.randn(3).astype(np.float32)
+        b_ = rs.randn(3).astype(np.float32)
+        want = torch.nn.functional.instance_norm(
+            torch.tensor(x), weight=torch.tensor(g_),
+            bias=torch.tensor(b_)).numpy()
+        self._go("InstanceNormalization", [attr_float("epsilon", 1e-5)],
+                 {"x": x}, [tensor("g", g_), tensor("b", b_)], want,
+                 extra_inputs=["g", "b"], rtol=1e-4, atol=1e-5)
+
+    def test_topk_largest_and_smallest(self):
+        x = np.asarray([[3., 1., 4., 1., 5.], [2., 7., 1., 8., 2.]],
+                       np.float32)
+        k = np.asarray([2], np.int64)
+        self._go("TopK", [], {"x": x}, [tensor("k", k)],
+                 [np.sort(x, 1)[:, ::-1][:, :2],
+                  np.argsort(-x, 1, kind="stable")[:, :2]],
+                 extra_inputs=["k"], n_out=2)
+        self._go("TopK", [attr_int("largest", 0)], {"x": x},
+                 [tensor("k", k)],
+                 [np.sort(x, 1)[:, :2],
+                  np.argsort(x, 1, kind="stable")[:, :2]],
+                 extra_inputs=["k"], n_out=2)
+
+    def test_cumsum_modes(self):
+        x = np.asarray([[1., 2., 3.], [4., 5., 6.]], np.float32)
+        ax = np.asarray(1, np.int64)
+        self._go("CumSum", [], {"x": x}, [tensor("axis", ax)],
+                 np.cumsum(x, 1), extra_inputs=["axis"])
+        self._go("CumSum", [attr_int("reverse", 1)], {"x": x},
+                 [tensor("axis", ax)],
+                 np.cumsum(x[:, ::-1], 1)[:, ::-1],
+                 extra_inputs=["axis"])
+        self._go("CumSum", [attr_int("exclusive", 1)], {"x": x},
+                 [tensor("axis", ax)],
+                 np.concatenate([np.zeros((2, 1), np.float32),
+                                 np.cumsum(x, 1)[:, :-1]], 1),
+                 extra_inputs=["axis"])
+
+    def test_range_onehot_trilu(self):
+        self._go("Range", [], {},
+                 [tensor("s", np.asarray(1.0, np.float32)),
+                  tensor("e", np.asarray(4.0, np.float32)),
+                  tensor("d", np.asarray(0.5, np.float32))],
+                 np.arange(1.0, 4.0, 0.5, dtype=np.float32),
+                 extra_inputs=["s", "e", "d"])
+        ids = np.asarray([0, 2, 1], np.int32)
+        want = np.full((3, 4), 2.0, np.float32)
+        for i, j in enumerate(ids):
+            want[i, j] = 5.0
+        self._go("OneHot", [attr_int("axis", -1)],
+                 {"ids": ids},
+                 [tensor("dep", np.asarray(4, np.int64)),
+                  tensor("vals", np.asarray([2.0, 5.0], np.float32))],
+                 want, extra_inputs=["dep", "vals"])
+        x = np.arange(16, dtype=np.float32).reshape(4, 4)
+        self._go("Trilu", [attr_int("upper", 1)], {"x": x},
+                 [tensor("k", np.asarray(1, np.int64))],
+                 np.triu(x, 1), extra_inputs=["k"])
+        self._go("Trilu", [attr_int("upper", 0)], {"x": x}, [],
+                 np.tril(x))
+
+    def test_gather_scatter_family(self):
+        x = np.arange(12, dtype=np.float32).reshape(3, 4)
+        nd_idx = np.asarray([[0, 1], [2, 3]], np.int64)
+        self._go("GatherND", [], {"x": x}, [tensor("i", nd_idx)],
+                 np.asarray([x[0, 1], x[2, 3]], np.float32),
+                 extra_inputs=["i"])
+        ge_idx = np.asarray([[1, 0], [2, 1], [0, 3]], np.int64)
+        self._go("GatherElements", [attr_int("axis", 1)], {"x": x},
+                 [tensor("i", ge_idx)],
+                 np.take_along_axis(x, ge_idx, 1),
+                 extra_inputs=["i"])
+        upd = np.asarray([9.0, 8.0], np.float32)
+        want = x.copy()
+        want[0, 1], want[2, 3] = 9.0, 8.0
+        self._go("ScatterND", [], {"x": x},
+                 [tensor("i", nd_idx), tensor("u", upd)], want,
+                 extra_inputs=["i", "u"])
+
+    def test_reduce_composites(self):
+        x = np.asarray([[1., -2., 3.], [-4., 5., -6.]], np.float32)
+        self._go("ReduceL1", [attr_ints("axes", [1])], {"x": x},
+                 [], np.abs(x).sum(1, keepdims=True))
+        self._go("ReduceL2", [attr_ints("axes", [1])], {"x": x},
+                 [], np.sqrt((x * x).sum(1, keepdims=True)))
+        self._go("ReduceSumSquare", [attr_ints("axes", [1])], {"x": x},
+                 [], (x * x).sum(1, keepdims=True))
+        self._go("ReduceLogSumExp", [attr_ints("axes", [1])], {"x": x},
+                 [], np.log(np.exp(x).sum(1, keepdims=True)),
+                 rtol=1e-4)
+        xp = np.abs(x) + 1.0
+        self._go("ReduceLogSum", [attr_ints("axes", [1])], {"xp": xp},
+                 [], np.log(xp.sum(1, keepdims=True)), rtol=1e-4)
+
+    def test_depth_space_einsum_reverse_mean_logic(self):
+        # DCR DepthToSpace per the ONNX spec formula
+        rs = np.random.RandomState(3)
+        x = rs.randn(1, 8, 2, 3).astype(np.float32)
+        b = 2
+        n, c, h, w = x.shape
+        want = (x.reshape(n, b, b, c // (b * b), h, w)
+                .transpose(0, 3, 4, 1, 5, 2)
+                .reshape(n, c // (b * b), h * b, w * b))
+        self._go("DepthToSpace", [attr_int("blocksize", 2)], {"x": x},
+                 [], want)
+        self._go("SpaceToDepth", [attr_int("blocksize", 2)],
+                 {"y": want}, [], x)
+        a_ = rs.randn(2, 3).astype(np.float32)
+        b_ = rs.randn(3, 4).astype(np.float32)
+        g = graph(
+            nodes=[node("Einsum", ["a", "b"], ["o"], "es",
+                        attrs=[attr_str("equation", "ij,jk->ik")])],
+            initializers=[tensor("b", b_)],
+            inputs=[value_info("a", [2, 3])],
+            outputs=[value_info("o", [2, 4])])
+        sd = OnnxImport.importGraph(model(g))
+        np.testing.assert_allclose(
+            np.asarray(sd.output({"a": a_}, ["o"])["o"]), a_ @ b_,
+            rtol=1e-5, atol=1e-6)
+        seq = np.arange(12, dtype=np.float32).reshape(3, 4)  # [T, N]
+        lens = np.asarray([3, 1, 2, 3], np.int64)
+        want_rev = seq.copy()
+        for j, L in enumerate(lens):
+            want_rev[:L, j] = seq[:L, j][::-1]
+        self._go("ReverseSequence",
+                 [attr_int("time_axis", 0), attr_int("batch_axis", 1)],
+                 {"seq": seq}, [tensor("lens", lens)], want_rev,
+                 extra_inputs=["lens"])
+        xs = [rs.randn(2, 2).astype(np.float32) for _ in range(3)]
+        g = graph(
+            nodes=[node("Mean", ["m0", "m1", "m2"], ["o"], "mn")],
+            initializers=[tensor("m1", xs[1]), tensor("m2", xs[2])],
+            inputs=[value_info("m0", [2, 2])],
+            outputs=[value_info("o", [2, 2])])
+        sd = OnnxImport.importGraph(model(g))
+        np.testing.assert_allclose(
+            np.asarray(sd.output({"m0": xs[0]}, ["o"])["o"]),
+            np.mean(xs, 0), rtol=1e-5, atol=1e-6)
+
+    def test_hardswish_mish_argmin(self):
+        import torch
+
+        x = np.linspace(-4, 4, 9).astype(np.float32)
+        self._go("HardSwish", [], {"x": x}, [],
+                 torch.nn.functional.hardswish(torch.tensor(x)).numpy(),
+                 rtol=1e-4, atol=1e-5)
+        self._go("Mish", [], {"x": x}, [],
+                 torch.nn.functional.mish(torch.tensor(x)).numpy(),
+                 rtol=1e-4, atol=1e-5)
+        m = np.asarray([[3., 1., 2.], [0., 5., 4.]], np.float32)
+        self._go("ArgMin", [attr_int("axis", 1)], {"m": m}, [],
+                 np.argmin(m, 1, keepdims=True))

@@ -180,6 +180,29 @@ class TestRealExportedModels:
         _golden(model, x, rtol=2e-4, atol=2e-4)
 
 
+    def test_decoder_upsampling_golden(self):
+        """Generator/decoder-style stack through the REAL exporter:
+        ConvTranspose2d (the r4 mapper incl. kernel flip), Upsample
+        (Resize nearest, asymmetric/floor), InstanceNorm2d, HardSwish,
+        Mish — the image-generation op tail."""
+        torch.manual_seed(3)
+        model = nn.Sequential(
+            nn.Conv2d(3, 8, 3, 2, 1),
+            nn.InstanceNorm2d(8, affine=True),
+            nn.Hardswish(),
+            nn.ConvTranspose2d(8, 6, 4, 2, 1),
+            nn.Mish(),
+            nn.Upsample(scale_factor=2, mode="nearest"),
+            nn.Conv2d(6, 3, 3, 1, 1))
+        with torch.no_grad():
+            for m in model.modules():
+                if isinstance(m, nn.InstanceNorm2d):
+                    m.weight.uniform_(0.5, 1.5)
+                    m.bias.uniform_(-0.3, 0.3)
+        x = torch.randn(2, 3, 16, 16)
+        _golden(model, x, rtol=2e-4, atol=2e-4)
+
+
 class TestRecurrentOperators:
     """ONNX LSTM/GRU/RNN operators as torch.onnx.export actually emits
     them (time-major X, packed iofc/zrh gate blocks, Expand-ed initial
