@@ -587,6 +587,15 @@ class BatchNormalization(Layer):
 
     def apply(self, params, state, x, train, rng):
         if train:
+            act = self.activation or "identity"
+            if act == "relu" and nnops.FUSED_BN_RELU_BWD:
+                # fused forward + hand two-pass backward (relu mask
+                # recomputed in-fusion; see batch_norm_relu_train)
+                y, m, v = nnops.batch_norm_relu_train(
+                    x, params["gamma"], params["beta"], self.eps)
+                d = self.decay
+                return y, {"mean": d * state["mean"] + (1 - d) * m,
+                           "var": d * state["var"] + (1 - d) * v}
             y, m, v = nnops.batch_norm_train(x, params["gamma"], params["beta"],
                                              self.eps)
             d = self.decay

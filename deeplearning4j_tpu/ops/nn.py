@@ -19,6 +19,7 @@ TPU-first design notes:
 
 from __future__ import annotations
 
+import os
 from functools import partial
 from typing import Optional, Sequence
 
@@ -423,6 +424,110 @@ def batch_norm_train(x, gamma, beta, eps=1e-5, axes=None):
     shift = beta.astype(jnp.float32) - m * scale
     y = x * scale.astype(x.dtype) + shift.astype(x.dtype)
     return y, m.astype(x.dtype), v.astype(x.dtype)
+
+
+# Trace-time toggle for the fused BN+ReLU backward (A/B-able in one
+# process by flipping between traces; see bench_bn_fused_ab.py).
+FUSED_BN_RELU_BWD = os.environ.get("DL4J_TPU_FUSED_BN_RELU", "0") == "1"
+
+
+def _bn_bcast(c, ndim, axes):
+    """Broadcast a per-channel [C] vector over the reduce axes of x."""
+    shape = [1] * ndim
+    for ax in range(ndim):
+        if ax not in axes:
+            shape[ax] = c.shape[0]
+    return c.reshape(shape)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _bn_relu_core(x, gamma, beta, eps, axes):
+    y, _, _ = _bn_relu_fwd(x, gamma, beta, eps, axes)[0]
+    return y
+
+
+def _bn_relu_fwd(x, gamma, beta, eps, axes):
+    m, v = _bn_stats(x, axes)
+    inv = lax.rsqrt(v + eps)
+    scale = inv * gamma.astype(jnp.float32)
+    shift = beta.astype(jnp.float32) - m * scale
+    sb = _bn_bcast(scale, x.ndim, axes).astype(x.dtype)
+    hb = _bn_bcast(shift, x.ndim, axes).astype(x.dtype)
+    y = jnp.maximum(x * sb + hb, 0)
+    return (y, m, v), (x, gamma, beta, m, v)
+
+
+def _bn_relu_bwd(eps, axes, res, gy):
+    """Two-pass fused backward: ReLU mask + BN reductions + dx, with the
+    masked gradient recomputed inline in each pass so it is NEVER
+    materialized to HBM (the relu-backward select category in the
+    ResNet-50 byte ledger, BASELINE.md). Residuals are x plus the
+    C-sized stats — no extra activation saves vs autodiff.
+
+    The batch-stat outputs (m, v) are treated as stop_gradient: they
+    only ever feed the running-stat EMA, never the loss (same contract
+    the one-pass ``_bn_stats`` shift trick already assumes).
+    """
+    x, gamma, beta, m, v = res
+    ndim = x.ndim
+    inv = lax.rsqrt(v + eps)
+    gamma_f = gamma.astype(jnp.float32)
+    m_b = _bn_bcast(m, ndim, axes)
+    inv_b = _bn_bcast(inv, ndim, axes)
+    sc_b = _bn_bcast(gamma_f * inv, ndim, axes)
+    sh_b = _bn_bcast(beta.astype(jnp.float32) - m * gamma_f * inv, ndim, axes)
+    count = 1
+    for ax in axes:
+        count *= x.shape[ax]
+
+    def masked_pieces():
+        xf = x.astype(jnp.float32)
+        xhat = (xf - m_b) * inv_b
+        mask = xf * sc_b + sh_b > 0
+        gp = jnp.where(mask, gy.astype(jnp.float32), 0.0)
+        return xhat, gp
+
+    # pass A: per-channel reductions (mask/xhat recomputed in-fusion)
+    xhat, gp = masked_pieces()
+    s1 = jnp.sum(gp, axis=axes)
+    s2 = jnp.sum(gp * xhat, axis=axes)
+    # pass B: dx elementwise (XLA duplicates the cheap producers into
+    # this fusion rather than round-tripping them through HBM)
+    xhat2, gp2 = masked_pieces()
+    dx = sc_b * (gp2 - (_bn_bcast(s1, ndim, axes)
+                        + xhat2 * _bn_bcast(s2, ndim, axes)) / count)
+    return dx.astype(x.dtype), s2.astype(gamma.dtype), s1.astype(beta.dtype)
+
+
+def _bn_relu_core_fwd(x, gamma, beta, eps, axes):
+    (y, _, _), res = _bn_relu_fwd(x, gamma, beta, eps, axes)
+    return y, res
+
+
+_bn_relu_core.defvjp(_bn_relu_core_fwd, _bn_relu_bwd)
+
+
+@register_op("batch_norm_relu_train")
+def batch_norm_relu_train(x, gamma, beta, eps=1e-5, axes=None):
+    """Fused training-mode BN + ReLU with a hand-written two-pass
+    backward (custom_vjp). Same signature contract as
+    ``batch_norm_train`` but the activation is applied inside, and the
+    returned batch stats are stop_gradient (EMA consumers only).
+
+    Round-4 ResNet-50 attack on the byte ledger's relu-mask category:
+    autodiff emits relu-bwd (read y, read g, write g') then BN
+    reductions (read g', read x) then dx (read g', read x, write dx) —
+    ~16 B/elem; this backward reads (x, g) twice and writes dx once —
+    ~10 B/elem — by recomputing the mask from the saved conv output
+    instead of materializing the masked gradient.
+    """
+    if axes is None:
+        axes = tuple(range(x.ndim - 1))
+    axes = tuple(axes)
+    y = _bn_relu_core(x, gamma, beta, eps, axes)
+    m, v = _bn_stats(x, axes)
+    return (y, lax.stop_gradient(m).astype(x.dtype),
+            lax.stop_gradient(v).astype(x.dtype))
 
 
 @register_op("layer_norm")

@@ -1,0 +1,108 @@
+"""Fused BN+ReLU backward (ops/nn.py batch_norm_relu_train): grads
+pinned against XLA autodiff of the unfused batch_norm_train + relu
+composition, plus end-to-end layer-path equivalence under the
+FUSED_BN_RELU_BWD toggle."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deeplearning4j_tpu.ops.nn as nnops
+
+
+@pytest.fixture
+def data():
+    rng = np.random.default_rng(7)
+    x = jnp.asarray(rng.normal(size=(8, 5, 5, 6)).astype(np.float32)) * 2 + 1.5
+    g = jnp.asarray(rng.normal(size=(6,)).astype(np.float32)) + 1.0
+    b = jnp.asarray(rng.normal(size=(6,)).astype(np.float32))
+    gy = jnp.asarray(rng.normal(size=(8, 5, 5, 6)).astype(np.float32))
+    return x, g, b, gy
+
+
+class TestFusedBnRelu:
+    def test_forward_matches_unfused(self, data):
+        x, g, b, _ = data
+        y0, m0, v0 = nnops.batch_norm_train(x, g, b)
+        y1, m1, v1 = nnops.batch_norm_relu_train(x, g, b)
+        np.testing.assert_allclose(np.maximum(y0, 0), y1, atol=1e-6)
+        np.testing.assert_allclose(m0, m1, atol=1e-6)
+        np.testing.assert_allclose(v0, v1, atol=1e-6)
+
+    def test_grads_match_autodiff(self, data):
+        x, g, b, gy = data
+
+        def ref(x, g, b):
+            y, _, _ = nnops.batch_norm_train(x, g, b)
+            return jnp.sum(jnp.maximum(y, 0) * gy)
+
+        def fused(x, g, b):
+            y, _, _ = nnops.batch_norm_relu_train(x, g, b)
+            return jnp.sum(y * gy)
+
+        gr = jax.grad(ref, argnums=(0, 1, 2))(x, g, b)
+        gf = jax.grad(fused, argnums=(0, 1, 2))(x, g, b)
+        for a, c in zip(gr, gf):
+            np.testing.assert_allclose(a, c, rtol=2e-5, atol=2e-5)
+
+    def test_dense_axes(self, data):
+        _, g, b, _ = data
+        rng = np.random.default_rng(3)
+        x2 = jnp.asarray(rng.normal(size=(32, 6)).astype(np.float32))
+        gy2 = jnp.asarray(rng.normal(size=(32, 6)).astype(np.float32))
+
+        def ref(x):
+            y, _, _ = nnops.batch_norm_train(x, g, b)
+            return jnp.sum(jnp.maximum(y, 0) * gy2)
+
+        def fused(x):
+            y, _, _ = nnops.batch_norm_relu_train(x, g, b)
+            return jnp.sum(y * gy2)
+
+        np.testing.assert_allclose(jax.grad(ref)(x2), jax.grad(fused)(x2),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_stats_outputs_are_stop_gradient(self, data):
+        x, g, b, _ = data
+
+        def stats_loss(x):
+            _, m, v = nnops.batch_norm_relu_train(x, g, b)
+            return jnp.sum(m) + jnp.sum(v)
+
+        np.testing.assert_allclose(jax.grad(stats_loss)(x),
+                                   jnp.zeros_like(x), atol=0)
+
+    def test_layer_toggle_equivalence(self):
+        """One BN(relu) training step via MultiLayerNetwork under both
+        toggle values converges to the same loss."""
+        from deeplearning4j_tpu.nn.conf import (
+            BatchNormalization, DenseLayer, InputType,
+            NeuralNetConfiguration, OutputLayer,
+        )
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(64, 12)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 64)]
+
+        losses = {}
+        prev = nnops.FUSED_BN_RELU_BWD
+        try:
+            for fused in (False, True):
+                nnops.FUSED_BN_RELU_BWD = fused
+                conf = (NeuralNetConfiguration.builder().seed(5)
+                        .list()
+                        .layer(DenseLayer(n_out=16, activation="identity"))
+                        .layer(BatchNormalization(activation="relu"))
+                        .layer(OutputLayer(n_out=3, activation="softmax",
+                                           loss="mcxent"))
+                        .setInputType(InputType.feedForward(12)).build())
+                net = MultiLayerNetwork(conf).init()
+                for _ in range(5):
+                    net.fit(x, y)
+                losses[fused] = net.score()
+        finally:
+            nnops.FUSED_BN_RELU_BWD = prev
+        assert abs(losses[False] - losses[True]) < 1e-4 * max(
+            1.0, abs(losses[False]))
