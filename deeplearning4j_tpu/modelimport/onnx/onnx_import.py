@@ -265,13 +265,27 @@ def _concat(ctx):
     return ctx.op("concat", ctx.inputs, axis=int(ctx.attr("axis", 0)))
 
 
+def _axes_attr_or_input(ctx, input_idx=1):
+    """ONNX moved reduce/squeeze axes from an attribute (opset <13/18)
+    to an optional tensor input; accept both, None when absent."""
+    axes = ctx.attr("axes")
+    if axes is None and len(ctx.inputs) > input_idx \
+            and ctx.inputs[input_idx] is not None:
+        axes = ctx.static_np(input_idx)
+    # empty axes == absent axes == reduce over all (pre-opset-18 rule)
+    return [int(a) for a in axes] if axes is not None and len(axes) else None
+
+
+def _reduce_kwargs(ctx):
+    return dict(dimensions=_axes_attr_or_input(ctx),
+                keep_dims=bool(ctx.attr("keepdims", 1)))
+
+
 @R("Squeeze")
 def _squeeze(ctx):
-    axes = ctx.attr("axes")
-    if axes is None and len(ctx.inputs) > 1:
-        axes = [int(a) for a in ctx.static_np(1)]
-    ax = tuple(int(a) for a in (axes or [])) or None
-    return ctx.op("squeeze", ctx.inputs[:1], axis=ax)
+    axes = _axes_attr_or_input(ctx)
+    return ctx.op("squeeze", ctx.inputs[:1],
+                  axis=tuple(axes) if axes else None)
 
 
 @R("Unsqueeze")
@@ -415,12 +429,7 @@ _REDUCE = {"ReduceSum": "reduce_sum", "ReduceMean": "reduce_mean",
 for _onnx_name, _our in _REDUCE.items():
     @R(_onnx_name)
     def _reduce(ctx, _o=_our):
-        axes = ctx.attr("axes")
-        if axes is None and len(ctx.inputs) > 1:
-            axes = [int(a) for a in ctx.static_np(1)]
-        return ctx.op(_o, ctx.inputs[:1],
-                      dimensions=[int(a) for a in axes] if axes else None,
-                      keep_dims=bool(ctx.attr("keepdims", 1)))
+        return ctx.op(_o, ctx.inputs[:1], **_reduce_kwargs(ctx))
 
 
 @R("ArgMax")
@@ -755,11 +764,9 @@ def _layer_norm(ctx):
 # --------------------------------------------------- breadth (round 4)
 @R("ArgMin")
 def _argmin(ctx):
-    out = ctx.op("argmin", ctx.inputs[:1],
-                 dimensions=int(ctx.attr("axis", 0)))
-    if int(ctx.attr("keepdims", 1)):
-        out = ctx.op("expand_dims", [out], axis=int(ctx.attr("axis", 0)))
-    return out
+    return ctx.op("argmin", ctx.inputs[:1],
+                  dimensions=int(ctx.attr("axis", 0)),
+                  keep_dims=bool(ctx.attr("keepdims", 1)))
 
 
 for _onnx_name, _our in {"And": "logical_and", "Or": "logical_or",
@@ -834,13 +841,89 @@ def _conv_transpose(ctx):
     return ctx.to_nchw(out)
 
 
+def _resize_src_coords(out_size, in_size, coord, scale=None):
+    """ONNX output-index -> continuous input coordinate per
+    coordinate_transformation_mode (spec table, opset 11+).
+
+    When the model provides a SCALE (not sizes), the spec transforms
+    through 1/scale — which differs from in/out whenever
+    out = floor(in*scale) truncates (e.g. in=3, scale=2.6 -> out=7,
+    1/2.6 != 3/7); using the wrong ratio picks wrong source pixels."""
+    i = np.arange(out_size, dtype=np.float64)
+    ratio = (1.0 / scale) if scale is not None else in_size / out_size
+    if coord == "asymmetric":
+        return i * ratio
+    if coord in ("half_pixel", "pytorch_half_pixel"):
+        x = (i + 0.5) * ratio - 0.5
+        if coord == "pytorch_half_pixel" and out_size == 1:
+            x = np.zeros_like(x)
+        return x
+    if coord == "align_corners":
+        if out_size == 1:
+            return np.zeros_like(i)
+        return i * (in_size - 1) / (out_size - 1)
+    raise OnnxImportError(
+        f"Resize coordinate_transformation_mode {coord!r} not supported")
+
+
+def _nearest_round(x_orig, nearest_mode):
+    if nearest_mode == "floor":
+        return np.floor(x_orig)
+    if nearest_mode == "ceil":
+        return np.ceil(x_orig)
+    if nearest_mode == "round_prefer_ceil":
+        return np.floor(x_orig + 0.5)
+    # spec default: round_prefer_floor (round-half-down)
+    return np.ceil(x_orig - 0.5)
+
+
+def _resize_axis_nearest(ctx, v, axis, in_size, out_size, coord,
+                         nearest_mode, prefix, scale=None):
+    x_orig = _resize_src_coords(out_size, in_size, coord, scale)
+    idx = np.clip(_nearest_round(x_orig, nearest_mode),
+                  0, in_size - 1).astype(np.int32)
+    c = ctx.sd.constant(f"{prefix}_nidx{axis}", idx)
+    return ctx.op("gather", [v, c], axis=axis)
+
+
+def _resize_axis_linear(ctx, v, axis, in_size, out_size, coord, prefix,
+                        ndim=4, scale=None):
+    x_orig = np.clip(_resize_src_coords(out_size, in_size, coord, scale),
+                     0, in_size - 1)
+    lo = np.floor(x_orig)
+    frac = (x_orig - lo).astype(np.float32)
+    hi = np.minimum(lo + 1, in_size - 1).astype(np.int32)
+    lo = lo.astype(np.int32)
+    wshape = [1] * ndim
+    wshape[axis] = out_size
+    glo = ctx.op("gather", [v, ctx.sd.constant(f"{prefix}_llo{axis}", lo)],
+                 axis=axis)
+    ghi = ctx.op("gather", [v, ctx.sd.constant(f"{prefix}_lhi{axis}", hi)],
+                 axis=axis)
+    w1 = ctx.sd.constant(f"{prefix}_lw1{axis}",
+                         (1.0 - frac).reshape(wshape))
+    w2 = ctx.sd.constant(f"{prefix}_lw2{axis}", frac.reshape(wshape))
+    return ctx.op("add", [ctx.op("mul", [glo, w1]),
+                          ctx.op("mul", [ghi, w2])])
+
+
 @R("Resize", "Upsample")
 def _resize(ctx):
-    """Supported subset, loud elsewhere: nearest with integer scales
-    (asymmetric/floor — the torch Upsample export) via repeat, and
-    linear with half_pixel (jax.image semantics) via resize_bilinear."""
+    """Exact per-coordinate-mode resize: nearest (all nearest_modes,
+    asymmetric/half_pixel/align_corners) and linear (asymmetric incl.
+    the opset-9 Upsample semantics, half_pixel, align_corners), lowered
+    to static gather indices + separable lerp weights computed at
+    import time (XLA static-shape discipline; the half_pixel linear
+    case keeps the fused resize_bilinear kernel). Loud elsewhere
+    (cubic, dynamic scales)."""
     mode = ctx.attr("mode", "nearest")
-    coord = ctx.attr("coordinate_transformation_mode", "half_pixel")
+    # Upsample (opset <=9) predates coordinate_transformation_mode:
+    # its fixed semantics are asymmetric coords + floor rounding
+    if ctx.node.op_type == "Upsample":
+        coord, nearest_mode = "asymmetric", "floor"
+    else:
+        coord = ctx.attr("coordinate_transformation_mode", "half_pixel")
+        nearest_mode = ctx.attr("nearest_mode", "round_prefer_floor")
     # scales: Upsample/opset10 input 1; Resize opset>=11 input 2 (roi=1)
     scales = sizes = None
     if ctx.node.op_type == "Upsample":
@@ -855,50 +938,66 @@ def _resize(ctx):
             raise OnnxImportError(
                 f"{ctx.node.name}: Resize needs static scales or a "
                 "sizes input (dynamic scales not importable)")
-    if mode == "nearest":
-        if coord not in ("asymmetric", "half_pixel"):
+
+    aval = ctx.avals.get(ctx.inputs[0].name) if ctx.avals else None
+    if aval is None:
+        raise OnnxImportError(
+            f"{ctx.node.name}: Resize needs a known input shape")
+    in_h, in_w = int(aval.shape[2]), int(aval.shape[3])
+    sc_h = sc_w = None  # provided scale factors (None when sizes given)
+    if sizes is not None:
+        out_h, out_w = [int(v) for v in np.asarray(sizes).ravel()[2:]]
+    else:
+        sc = [float(v) for v in np.asarray(scales).ravel()]
+        if len(sc) != 4 or sc[0] != 1 or sc[1] != 1:
             raise OnnxImportError(
-                f"{ctx.node.name}: Resize nearest with coord mode "
-                f"{coord!r} not supported")
-        if scales is not None:
-            sc = [float(v) for v in np.asarray(scales).ravel()]
-            if len(sc) != 4 or sc[0] != 1 or sc[1] != 1:
-                raise OnnxImportError(
-                    f"{ctx.node.name}: Resize scales must be "
-                    "[1,1,sH,sW]")
-            if sc[2] != int(sc[2]) or sc[3] != int(sc[3]):
-                raise OnnxImportError(
-                    f"{ctx.node.name}: non-integer nearest scales not "
-                    "supported")
+                f"{ctx.node.name}: Resize scales must be [1,1,sH,sW]")
+        # spec: output dim = floor(input_dim * scale); the coordinate
+        # transform still uses 1/scale, NOT in/out (they differ when
+        # the floor truncates)
+        sc_h, sc_w = sc[2], sc[3]
+        out_h = int(np.floor(in_h * sc_h))
+        out_w = int(np.floor(in_w * sc_w))
+
+    name = ctx.node.output[0]
+    if mode == "nearest":
+        # integer-upsample fast path: repeat equals exactly the two
+        # diagonal pairs (asymmetric+floor, half_pixel+round_prefer_
+        # floor) for WHOLE scale factors; the CROSS pairs differ (e.g.
+        # half_pixel+floor at scale 2 picks [0,0,0,1], not repeat),
+        # and a fractional provided scale (2.4 -> out%in==0 by luck)
+        # must not silently become a plain repeat
+        whole = (sc_h is None or sc_h == int(sc_h)) \
+            and (sc_w is None or sc_w == int(sc_w)) \
+            and out_h % in_h == 0 and out_w % in_w == 0
+        if whole and (coord, nearest_mode) in (
+                ("asymmetric", "floor"),
+                ("half_pixel", "round_prefer_floor")):
             x = ctx.to_nhwc(ctx.inputs[0])
             out = ctx.op("upsampling2d", [x],
-                         scale=(int(sc[2]), int(sc[3])))
+                         scale=(out_h // in_h, out_w // in_w))
             return ctx.to_nchw(out)
-        x = ctx.to_nhwc(ctx.inputs[0])
-        out = ctx.op("resize_nearest_neighbor", [x],
-                     size=[int(v) for v in np.asarray(sizes).ravel()[2:]])
-        return ctx.to_nchw(out)
+        v = _resize_axis_nearest(ctx, ctx.inputs[0], 2, in_h, out_h,
+                                 coord, nearest_mode, name, sc_h)
+        return _resize_axis_nearest(ctx, v, 3, in_w, out_w, coord,
+                                    nearest_mode, name, sc_w)
     if mode == "linear":
-        if coord not in ("half_pixel", "pytorch_half_pixel"):
-            raise OnnxImportError(
-                f"{ctx.node.name}: Resize linear with coord mode "
-                f"{coord!r} not supported (half_pixel only)")
-        if sizes is None:
-            sc = [float(v) for v in np.asarray(scales).ravel()]
-            h, w = None, None
-            aval = ctx.avals.get(ctx.inputs[0].name) if ctx.avals else None
-            if aval is None:
-                raise OnnxImportError(
-                    f"{ctx.node.name}: linear Resize by scales needs a "
-                    "known input shape")
-            # spec: output dim = floor(input_dim * scale)
-            h = int(np.floor(aval.shape[2] * sc[2]))
-            w = int(np.floor(aval.shape[3] * sc[3]))
-        else:
-            h, w = [int(v) for v in np.asarray(sizes).ravel()[2:]]
-        x = ctx.to_nhwc(ctx.inputs[0])
-        out = ctx.op("resize_bilinear", [x], size=[h, w])
-        return ctx.to_nchw(out)
+        # the fused resize_bilinear kernel transforms through in/out;
+        # valid only when that equals the spec ratio (sizes given, or
+        # scales that divide exactly) and the coord mode is half_pixel
+        exact_ratio = (sc_h is None or in_h * sc_h == out_h) \
+            and (sc_w is None or in_w * sc_w == out_w)
+        if exact_ratio and (
+                coord == "half_pixel"
+                or (coord == "pytorch_half_pixel"
+                    and out_h > 1 and out_w > 1)):
+            x = ctx.to_nhwc(ctx.inputs[0])
+            out = ctx.op("resize_bilinear", [x], size=[out_h, out_w])
+            return ctx.to_nchw(out)
+        v = _resize_axis_linear(ctx, ctx.inputs[0], 2, in_h, out_h,
+                                coord, name, scale=sc_h)
+        return _resize_axis_linear(ctx, v, 3, in_w, out_w, coord, name,
+                                   scale=sc_w)
     raise OnnxImportError(
         f"{ctx.node.name}: Resize mode {mode!r} not supported")
 
@@ -990,22 +1089,12 @@ for _onnx_name, _our in {"ReduceL1": "reduce_norm1",
                          "ReduceLogSumExp": "reduce_logsumexp"}.items():
     @R(_onnx_name)
     def _reduce_direct(ctx, _o=_our):
-        axes = ctx.attr("axes")
-        if axes is None and len(ctx.inputs) > 1 \
-                and ctx.inputs[1] is not None:
-            axes = [int(a) for a in ctx.static_np(1)]
-        return ctx.op(_o, ctx.inputs[:1],
-                      dimensions=[int(a) for a in axes] if axes else None,
-                      keep_dims=bool(ctx.attr("keepdims", 1)))
+        return ctx.op(_o, ctx.inputs[:1], **_reduce_kwargs(ctx))
 
 
 @R("ReduceSumSquare", "ReduceLogSum")
 def _reduce_composite(ctx):
-    axes = ctx.attr("axes")
-    if axes is None and len(ctx.inputs) > 1 and ctx.inputs[1] is not None:
-        axes = [int(a) for a in ctx.static_np(1)]
-    kw = dict(dimensions=[int(a) for a in axes] if axes else None,
-              keep_dims=bool(ctx.attr("keepdims", 1)))
+    kw = _reduce_kwargs(ctx)
     x = ctx.inputs[0]
     if ctx.node.op_type == "ReduceSumSquare":
         return ctx.op("reduce_sum", [ctx.op("mul", [x, x])], **kw)

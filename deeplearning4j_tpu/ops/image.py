@@ -18,7 +18,11 @@ from deeplearning4j_tpu.ops.registry import register_op
 
 def _resize(x, size, method):
     shape = (x.shape[0], int(size[0]), int(size[1]), x.shape[3])
-    return jax.image.resize(x, shape, method=method)
+    # antialias=False: the reference semantics for these op names (TF1
+    # ResizeBilinear/ResizeBicubic and ONNX Resize antialias=0) do not
+    # low-pass filter on downscale; jax.image defaults to True, which
+    # diverges badly on any downscaling resize (upscales are identical).
+    return jax.image.resize(x, shape, method=method, antialias=False)
 
 
 @register_op("resize_bilinear")

@@ -289,13 +289,6 @@ class TestOnnxBreadthRound4:
     """Round-4 mapper batch: the common exported-model op tail
     (reference: samediff-import-onnx's mapper set spans these)."""
 
-    def _run(self, nodes, inits, ins, outs, feeds):
-        g = graph(nodes=nodes, initializers=inits, inputs=ins,
-                  outputs=outs)
-        sd = OnnxImport.importGraph(model(g))
-        return {k: np.asarray(v)
-                for k, v in sd.output(feeds, [o for o in self._onames]).items()}
-
     def _go(self, op, attrs, feeds, inits, want, extra_inputs=(),
             n_out=1, rtol=1e-5, atol=1e-6):
         in_names = list(feeds) + list(extra_inputs)
@@ -341,10 +334,14 @@ class TestOnnxBreadthRound4:
         rs = np.random.RandomState(1)
         x = rs.randn(1, 2, 3, 4).astype(np.float32)
         want = x.repeat(2, axis=2).repeat(3, axis=3)
+        # asymmetric is always paired with nearest_mode=floor by real
+        # exporters (torch); with the spec-default round_prefer_floor
+        # the scale-3 axis would NOT be a plain repeat (src(2)=rpf(2/3)=1)
         self._go("Resize",
                  [attr_str("mode", "nearest"),
                   attr_str("coordinate_transformation_mode",
-                           "asymmetric")],
+                           "asymmetric"),
+                  attr_str("nearest_mode", "floor")],
                  {"x": x},
                  [tensor("roi", np.zeros(0, np.float32)),
                   tensor("sc", np.asarray([1, 1, 2, 3], np.float32))],
@@ -362,6 +359,140 @@ class TestOnnxBreadthRound4:
                   tensor("sizes", np.asarray([1, 2, 6, 8], np.int64))],
                  want_lin, extra_inputs=["roi", "sc", "sizes"],
                  rtol=1e-4, atol=1e-5)
+
+    def test_resize_nearest_sizes_asymmetric_floor(self):
+        """Non-integer downscale-by-sizes with asymmetric/floor (the
+        torch interpolate(mode='nearest') export): src row/col must be
+        floor(i*in/out), NOT half-pixel centers."""
+        import torch
+
+        rs = np.random.RandomState(3)
+        x = rs.randn(1, 2, 3, 5).astype(np.float32)
+        want = torch.nn.functional.interpolate(
+            torch.tensor(x), size=(4, 4), mode="nearest").numpy()
+        self._go("Resize",
+                 [attr_str("mode", "nearest"),
+                  attr_str("coordinate_transformation_mode", "asymmetric"),
+                  attr_str("nearest_mode", "floor")],
+                 {"x": x},
+                 [tensor("roi", np.zeros(0, np.float32)),
+                  tensor("sc", np.zeros(0, np.float32)),
+                  tensor("sizes", np.asarray([1, 2, 4, 4], np.int64))],
+                 want, extra_inputs=["roi", "sc", "sizes"])
+
+    def test_resize_nearest_half_pixel_prefer_floor(self):
+        """Spec-default nearest (half_pixel + round_prefer_floor) on a
+        non-integer ratio: torch's 'nearest-exact' implements the same
+        coordinate map."""
+        import torch
+
+        rs = np.random.RandomState(4)
+        x = rs.randn(1, 1, 3, 3).astype(np.float32)
+        want = torch.nn.functional.interpolate(
+            torch.tensor(x), size=(4, 5), mode="nearest-exact").numpy()
+        self._go("Resize",
+                 [attr_str("mode", "nearest")],
+                 {"x": x},
+                 [tensor("roi", np.zeros(0, np.float32)),
+                  tensor("sc", np.zeros(0, np.float32)),
+                  tensor("sizes", np.asarray([1, 1, 4, 5], np.int64))],
+                 want, extra_inputs=["roi", "sc", "sizes"])
+
+    def test_resize_linear_align_corners(self):
+        import torch
+
+        rs = np.random.RandomState(5)
+        x = rs.randn(1, 2, 3, 4).astype(np.float32)
+        want = torch.nn.functional.interpolate(
+            torch.tensor(x), size=(5, 7), mode="bilinear",
+            align_corners=True).numpy()
+        self._go("Resize",
+                 [attr_str("mode", "linear"),
+                  attr_str("coordinate_transformation_mode",
+                           "align_corners")],
+                 {"x": x},
+                 [tensor("roi", np.zeros(0, np.float32)),
+                  tensor("sc", np.zeros(0, np.float32)),
+                  tensor("sizes", np.asarray([1, 2, 5, 7], np.int64))],
+                 want, extra_inputs=["roi", "sc", "sizes"],
+                 rtol=1e-5, atol=1e-5)
+
+    def test_resize_linear_downscale_no_antialias(self):
+        """ONNX Resize antialias defaults to 0: a bilinear DOWNSCALE
+        must not low-pass filter (jax.image's antialias default would
+        diverge by O(1) here)."""
+        import torch
+
+        rs = np.random.RandomState(6)
+        x = rs.randn(1, 2, 8, 8).astype(np.float32)
+        want = torch.nn.functional.interpolate(
+            torch.tensor(x), size=(4, 4), mode="bilinear",
+            align_corners=False).numpy()
+        self._go("Resize",
+                 [attr_str("mode", "linear"),
+                  attr_str("coordinate_transformation_mode",
+                           "half_pixel")],
+                 {"x": x},
+                 [tensor("roi", np.zeros(0, np.float32)),
+                  tensor("sc", np.zeros(0, np.float32)),
+                  tensor("sizes", np.asarray([1, 2, 4, 4], np.int64))],
+                 want, extra_inputs=["roi", "sc", "sizes"],
+                 rtol=1e-4, atol=1e-5)
+
+    def test_resize_nearest_cross_pair_not_repeat(self):
+        """half_pixel+floor at integer scale is NOT repeat-upsampling:
+        in=2 scale=2 picks source rows [0,0,0,1]."""
+        x = np.asarray([[[[1.0], [2.0]]]], np.float32).reshape(1, 1, 2, 1)
+        want = x[:, :, [0, 0, 0, 1], :]
+        self._go("Resize",
+                 [attr_str("mode", "nearest"),
+                  attr_str("coordinate_transformation_mode",
+                           "half_pixel"),
+                  attr_str("nearest_mode", "floor")],
+                 {"x": x},
+                 [tensor("roi", np.zeros(0, np.float32)),
+                  tensor("sc", np.zeros(0, np.float32)),
+                  tensor("sizes", np.asarray([1, 1, 4, 1], np.int64))],
+                 want, extra_inputs=["roi", "sc", "sizes"])
+
+    def test_resize_fractional_scale_uses_scale_not_ratio(self):
+        """scales=[...,2.6,...]: out=floor(3*2.6)=7, and the coordinate
+        transform must divide by the PROVIDED 2.6, not by out/in=7/3
+        (they pick different source pixels — torch agrees with the
+        spec)."""
+        import torch
+
+        rs = np.random.RandomState(7)
+        x = rs.randn(1, 1, 3, 3).astype(np.float32)
+        want = torch.nn.functional.interpolate(
+            torch.tensor(x), scale_factor=2.6, mode="nearest").numpy()
+        self._go("Resize",
+                 [attr_str("mode", "nearest"),
+                  attr_str("coordinate_transformation_mode", "asymmetric"),
+                  attr_str("nearest_mode", "floor")],
+                 {"x": x},
+                 [tensor("roi", np.zeros(0, np.float32)),
+                  tensor("sc", np.asarray([1, 1, 2.6, 2.6], np.float32))],
+                 want, extra_inputs=["roi", "sc"])
+
+    def test_upsample_opset9_linear_asymmetric(self):
+        """Opset-9 Upsample has no coordinate mode attr; its fixed
+        semantics are ASYMMETRIC (x_src = i/scale), not half_pixel:
+        2x of [0,1] must give [0, 0.5, 1, 1]."""
+        x = np.asarray([[[[0.0, 1.0], [2.0, 3.0]]]], np.float32)
+        # separable asymmetric lerp, hand-computed
+        rows = np.stack([x[0, 0, 0], (x[0, 0, 0] + x[0, 0, 1]) / 2,
+                         x[0, 0, 1], x[0, 0, 1]])
+        want_hw = np.stack([rows[:, 0], (rows[:, 0] + rows[:, 1]) / 2,
+                            rows[:, 1], rows[:, 1]], axis=1)
+        want = want_hw[None, None]
+        self._go("Upsample",
+                 [attr_str("mode", "linear")],
+                 {"x": x},
+                 [tensor("sc", np.asarray([1, 1, 2, 2], np.float32))],
+                 want, extra_inputs=["sc"])
+        np.testing.assert_allclose(want[0, 0, :, 0], [0, 1, 2, 2])
+        np.testing.assert_allclose(want[0, 0, 0], [0, 0.5, 1, 1])
 
     def test_instance_norm_matches_torch(self):
         import torch
