@@ -23,6 +23,9 @@ from deeplearning4j_tpu.nn.conf.layers_extra import (
     SpaceToDepthLayer, Subsampling1DLayer, Subsampling3DLayer, Upsampling1D,
     Upsampling3D, ZeroPadding1DLayer, ZeroPadding3DLayer,
 )
+from deeplearning4j_tpu.nn.conf.variational import (
+    AutoEncoder, VariationalAutoencoder,
+)
 from deeplearning4j_tpu.nn.conf.dropout import (
     AlphaDropout, Dropout, GaussianDropout, GaussianNoise, IDropout,
     SpatialDropout,
@@ -64,5 +67,6 @@ __all__ = [
     "DropConnect", "IWeightNoise", "WeightNoise",
     "LayerConstraint", "MaxNormConstraint", "MinMaxNormConstraint",
     "NonNegativeConstraint", "UnitNormConstraint",
+    "AutoEncoder", "VariationalAutoencoder",
     "MultiLayerConfiguration", "NeuralNetConfiguration",
 ]

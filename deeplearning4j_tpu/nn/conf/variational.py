@@ -1,0 +1,221 @@
+"""Variational autoencoder + denoising autoencoder layers with
+unsupervised (layerwise) pretraining.
+
+Reference: org/deeplearning4j/nn/conf/layers/variational/
+VariationalAutoencoder.java + impl org/deeplearning4j/nn/layers/
+variational/VariationalAutoencoder.java (encoder/decoder MLP stacks,
+reconstruction distributions Gaussian/Bernoulli, importance-sampled
+``reconstructionProbability`` for anomaly detection, param groups
+e0W../pZXMeanW../d0W../pXZW..) and org/deeplearning4j/nn/conf/layers/
+AutoEncoder.java (denoising autoencoder: masking corruption, tied
+W/W^T decoder, visible bias vb) — the two layers behind the
+reference's ``MultiLayerNetwork#pretrain`` layerwise unsupervised
+training (SURVEY.md §2.19/§2.20).
+
+TPU-native design: each layer exposes ``unsupervised_loss(params, x,
+rng)`` — a pure function the network jit-compiles into ONE XLA step
+per pretrained layer (features from the frozen prefix are computed in
+the same compiled program; the reference runs a separate Java
+optimizer loop per layer). The VAE ELBO draws its reparameterization
+noise from the step PRNG (counter-based, like every other stochastic
+op here); ``reconstruction_log_prob`` vectorizes the K importance
+samples with one batched decoder pass instead of the reference's
+sequential sample loop.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.common.serde import serializable, _tuplify
+from deeplearning4j_tpu.loss import LossFunction, compute_loss
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import Layer, _act
+from deeplearning4j_tpu.nn.weights import WeightInit, init_weights
+
+_LOG2PI = float(jnp.log(2.0 * jnp.pi))
+
+
+def _mlp_init(key, sizes, weight_init, dtype, prefix):
+    """Param dict for a dense stack: {prefix}{i}W / {prefix}{i}b
+    (reference naming: VariationalAutoencoderParamInitializer e0W..)."""
+    p = {}
+    for i, (a, b) in enumerate(zip(sizes[:-1], sizes[1:])):
+        key, k = jax.random.split(key)
+        p[f"{prefix}{i}W"] = init_weights(weight_init, k, (a, b), a, b,
+                                          dtype)
+        p[f"{prefix}{i}b"] = jnp.zeros((b,), dtype)
+    return p
+
+
+def _mlp_apply(params, x, n, act, prefix):
+    for i in range(n):
+        x = act.fn(x @ params[f"{prefix}{i}W"] + params[f"{prefix}{i}b"])
+    return x
+
+
+@serializable
+@dataclasses.dataclass
+class VariationalAutoencoder(Layer):
+    """VAE layer (reference: conf/layers/variational/
+    VariationalAutoencoder). In a supervised network it acts as a
+    feedforward encoder emitting the latent mean through
+    ``pzx_activation``; unsupervised pretraining maximizes the ELBO.
+
+    reconstruction_distribution: "gaussian" (pXZ head emits mean and
+    log-variance per feature, 2*n_in outputs) or "bernoulli" (n_in
+    logits, data expected in [0,1]).
+    """
+
+    n_in: int = 0
+    n_out: int = 0  # latent size (reference: nOut == latent space size)
+    encoder_layer_sizes: Tuple[int, ...] = (100,)
+    decoder_layer_sizes: Tuple[int, ...] = (100,)
+    reconstruction_distribution: str = "gaussian"
+    #: activation applied to the Gaussian reconstruction mean
+    #: (reference: GaussianReconstructionDistribution(activation))
+    reconstruction_activation: str = "identity"
+    pzx_activation: str = "identity"
+    num_samples: int = 1
+
+    def __post_init__(self):
+        self.encoder_layer_sizes = _tuplify(self.encoder_layer_sizes)
+        self.decoder_layer_sizes = _tuplify(self.decoder_layer_sizes)
+
+    def output_type(self, it: InputType) -> InputType:
+        return InputType.feedForward(self.n_out)
+
+    def _dist_size(self) -> int:
+        if self.reconstruction_distribution == "gaussian":
+            return 2 * self.n_in
+        if self.reconstruction_distribution == "bernoulli":
+            return self.n_in
+        raise ValueError("reconstruction_distribution must be "
+                         "'gaussian' or 'bernoulli', got "
+                         f"{self.reconstruction_distribution!r}")
+
+    def init_params(self, key, it: InputType, dtype) -> dict:
+        wi = self.weight_init or WeightInit.XAVIER
+        ks = jax.random.split(key, 6)
+        enc = (self.n_in,) + self.encoder_layer_sizes
+        dec = (self.n_out,) + self.decoder_layer_sizes
+        p = _mlp_init(ks[0], enc, wi, dtype, "e")
+        p.update(_mlp_init(ks[1], dec, wi, dtype, "d"))
+        eL, dL = enc[-1], dec[-1]
+        p["pZXMeanW"] = init_weights(wi, ks[2], (eL, self.n_out), eL,
+                                     self.n_out, dtype)
+        p["pZXMeanb"] = jnp.zeros((self.n_out,), dtype)
+        p["pZXLogStd2W"] = init_weights(wi, ks[3], (eL, self.n_out), eL,
+                                        self.n_out, dtype)
+        p["pZXLogStd2b"] = jnp.zeros((self.n_out,), dtype)
+        ds = self._dist_size()
+        p["pXZW"] = init_weights(wi, ks[4], (dL, ds), dL, ds, dtype)
+        p["pXZb"] = jnp.zeros((ds,), dtype)
+        return p
+
+    # -- pieces ---------------------------------------------------------
+    def _encode(self, params, x):
+        act = _act(self.activation or "identity")
+        h = _mlp_apply(params, x, len(self.encoder_layer_sizes), act, "e")
+        mean = h @ params["pZXMeanW"] + params["pZXMeanb"]
+        log_var = h @ params["pZXLogStd2W"] + params["pZXLogStd2b"]
+        return mean, log_var
+
+    def _decode_logp(self, params, z, x):
+        """log p(x|z) per example; z may be [K,N,L] (batched samples)."""
+        act = _act(self.activation or "identity")
+        d = _mlp_apply(params, z, len(self.decoder_layer_sizes), act, "d")
+        out = d @ params["pXZW"] + params["pXZb"]
+        if self.reconstruction_distribution == "bernoulli":
+            # stable -BCE from logits
+            return jnp.sum(x * out - jnp.logaddexp(0.0, out), axis=-1)
+        mu, lv = jnp.split(out, 2, axis=-1)
+        mu = _act(self.reconstruction_activation).fn(mu)
+        return -0.5 * jnp.sum(
+            _LOG2PI + lv + (x - mu) ** 2 * jnp.exp(-lv), axis=-1)
+
+    # -- supervised path: latent mean as the layer activation -----------
+    def apply(self, params, state, x, train, rng):
+        x = self._maybe_dropout(x, train, rng)
+        mean, _ = self._encode(params, x)
+        return _act(self.pzx_activation).fn(mean), state
+
+    # -- unsupervised path ----------------------------------------------
+    def unsupervised_loss(self, params, x, rng):
+        """-ELBO, averaged over the batch (the pretrain objective)."""
+        mean, log_var = self._encode(params, x)
+        k = self.num_samples
+        eps = jax.random.normal(rng, (k,) + mean.shape, mean.dtype)
+        z = mean[None] + jnp.exp(0.5 * log_var)[None] * eps
+        logp = jnp.mean(self._decode_logp(params, z, x[None]), axis=0)
+        kl = -0.5 * jnp.sum(1.0 + log_var - mean ** 2 - jnp.exp(log_var),
+                            axis=-1)
+        return jnp.mean(kl - logp)
+
+    def reconstruction_log_prob(self, params, x, rng, num_samples=16):
+        """Importance-sampled log p(x) per example (reference:
+        VariationalAutoencoder#reconstructionLogProbability — the
+        anomaly-detection score; higher = more 'normal')."""
+        mean, log_var = self._encode(params, x)
+        std = jnp.exp(0.5 * log_var)
+        eps = jax.random.normal(rng, (num_samples,) + mean.shape,
+                                mean.dtype)
+        z = mean[None] + std[None] * eps
+        log_px_z = self._decode_logp(params, z, x[None])
+        log_pz = -0.5 * jnp.sum(_LOG2PI + z ** 2, axis=-1)
+        log_qz = -0.5 * jnp.sum(
+            _LOG2PI + log_var[None] + eps ** 2, axis=-1)
+        return (jax.scipy.special.logsumexp(
+            log_px_z + log_pz - log_qz, axis=0)
+            - jnp.log(float(num_samples)))
+
+
+@serializable
+@dataclasses.dataclass
+class AutoEncoder(Layer):
+    """Denoising autoencoder layer (reference: conf/layers/
+    AutoEncoder). Supervised forward = encoder (dense, activation);
+    unsupervised loss = reconstruct the UNCORRUPTED input from a
+    masking-corrupted encoding through the tied-weight decoder
+    (z = act(h @ W^T + vb)), plus an optional sparsity penalty on the
+    mean hidden activation."""
+
+    n_in: int = 0
+    n_out: int = 0
+    corruption_level: float = 0.3
+    sparsity: float = 0.0
+    loss: str = "mse"
+
+    def output_type(self, it: InputType) -> InputType:
+        return InputType.feedForward(self.n_out)
+
+    def init_params(self, key, it: InputType, dtype) -> dict:
+        w = init_weights(self.weight_init or WeightInit.XAVIER, key,
+                         (self.n_in, self.n_out), self.n_in, self.n_out,
+                         dtype)
+        return {"W": w, "b": jnp.zeros((self.n_out,), dtype),
+                "vb": jnp.zeros((self.n_in,), dtype)}
+
+    def apply(self, params, state, x, train, rng):
+        x = self._maybe_dropout(x, train, rng)
+        act = _act(self.activation or "sigmoid")
+        return act.fn(x @ params["W"] + params["b"]), state
+
+    def unsupervised_loss(self, params, x, rng):
+        act = _act(self.activation or "sigmoid")
+        x_in = x
+        if self.corruption_level > 0.0:
+            keep = jax.random.bernoulli(rng, 1.0 - self.corruption_level,
+                                        x.shape)
+            x_in = jnp.where(keep, x, 0.0)
+        h = act.fn(x_in @ params["W"] + params["b"])
+        recon_pre = h @ params["W"].T + params["vb"]
+        loss = compute_loss(LossFunction.resolve(self.loss), x, recon_pre,
+                            self.activation or "sigmoid", None)
+        if self.sparsity > 0.0:
+            loss = loss + self.sparsity * jnp.mean(jnp.abs(h))
+        return loss

@@ -69,6 +69,7 @@ class MultiLayerNetwork:
         self._rng_key = None
         self._step_cache: dict = {}
         self._fwd_cache: dict = {}
+        self._pretrain_cache: dict = {}
         self._rnn_carries = None    # stateful rnnTimeStep hidden state
         self._rnn_batch = 0
         self._dtype = DataType.from_any(conf.dtype).jax
@@ -460,6 +461,113 @@ class MultiLayerNetwork:
             self._panic_check()
             for l in self._listeners:
                 l.iterationDone(self, self._iteration, self._epoch)
+
+    # ------------------------------------------------------------------
+    # layerwise unsupervised pretraining (reference:
+    # MultiLayerNetwork#pretrain / #pretrainLayer — SURVEY.md §2.19;
+    # the VAE/AutoEncoder pretrain path)
+    # ------------------------------------------------------------------
+    def _prefix_activations(self, idx, params_list, states_list, a):
+        """Inference-mode forward through layers [0, idx) plus layer
+        idx's input preprocessor — the frozen feature extractor under
+        pretrainLayer/reconstructionLogProbability. Pure: safe inside
+        jit."""
+        for j, lay in enumerate(self.conf.layers[:idx]):
+            tag = self.conf.preprocessors.get(j)
+            if tag:
+                a = apply_preprocessor(tag, a)
+            a, _ = lay.apply(params_list[j], states_list[j], a, False,
+                             None)
+        tag = self.conf.preprocessors.get(idx)
+        if tag:
+            a = apply_preprocessor(tag, a)
+        return a
+
+    def _get_pretrain_step(self, idx: int) -> Callable:
+        if idx in self._pretrain_cache:
+            return self._pretrain_cache[idx]
+        layer = self.conf.layers[idx]
+
+        def step_fn(p_i, prefix_params, states_list, opt_state, it_step,
+                    x, rng):
+            # frozen-prefix features, inference mode, inside the SAME
+            # compiled program (no separate feature-extraction pass)
+            a = self._prefix_activations(idx, prefix_params, states_list,
+                                         x)
+            loss, grads = jax.value_and_grad(
+                lambda p: layer.unsupervised_loss(p, a, rng))(p_i)
+            updates, new_opt = apply_updater(self._updaters[idx],
+                                             opt_state, grads, p_i,
+                                             it_step)
+            new_p = jax.tree_util.tree_map(lambda p, u: p - u, p_i,
+                                           updates)
+            return apply_constraints(layer, new_p), new_opt, loss
+
+        jitted = jax.jit(step_fn)
+        self._pretrain_cache[idx] = jitted
+        return jitted
+
+    def pretrainLayer(self, idx: int, data, epochs: int = 1):
+        """Unsupervised training of ONE layer (reference:
+        MultiLayerNetwork#pretrainLayer(int, DataSetIterator)): lower
+        layers act as a frozen feature extractor; only layer ``idx``'s
+        params (and its updater state) change. ``data`` is features —
+        an array, DataSet or DataSetIterator (labels ignored)."""
+        self._check_init()
+        layer = self.conf.layers[idx]
+        if not hasattr(layer, "unsupervised_loss"):
+            raise ValueError(
+                f"layer {idx} ({type(layer).__name__}) is not "
+                "pretrainable — only layers with an unsupervised loss "
+                "(VariationalAutoencoder, AutoEncoder) support "
+                "pretrainLayer")
+        step = self._get_pretrain_step(idx)
+
+        def batches():
+            if isinstance(data, DataSetIterator):
+                for ds in data:
+                    yield ds.features
+            elif isinstance(data, DataSet):
+                yield data.features
+            else:
+                yield data
+
+        for _ in range(epochs):
+            for xb in batches():
+                x = jnp.asarray(_unwrap(xb), self._dtype)
+                self._rng_key, sub = jax.random.split(self._rng_key)
+                (self.params_list[idx], self.opt_states[idx],
+                 loss) = step(self.params_list[idx], self.params_list,
+                              self.states_list, self.opt_states[idx],
+                              jnp.asarray(self._iteration), x, sub)
+                self._score = loss
+                self._iteration += 1
+        return self
+
+    def pretrain(self, data, epochs: int = 1):
+        """Layerwise pretrain of every pretrainable layer, bottom-up
+        (reference: MultiLayerNetwork#pretrain(DataSetIterator))."""
+        for idx, layer in enumerate(self.conf.layers):
+            if hasattr(layer, "unsupervised_loss"):
+                self.pretrainLayer(idx, data, epochs)
+        return self
+
+    def reconstructionLogProbability(self, idx: int, x,
+                                     num_samples: int = 16) -> NDArray:
+        """Importance-sampled log p(x) from the VAE at layer ``idx``
+        (reference: VariationalAutoencoder#reconstructionLogProbability
+        — the anomaly-detection score)."""
+        self._check_init()
+        layer = self.conf.layers[idx]
+        if not hasattr(layer, "reconstruction_log_prob"):
+            raise ValueError(f"layer {idx} is not a "
+                             "VariationalAutoencoder")
+        xj = jnp.asarray(_unwrap(x), self._dtype)
+        a = self._prefix_activations(idx, self.params_list,
+                                     self.states_list, xj)
+        self._rng_key, sub = jax.random.split(self._rng_key)
+        return NDArray(layer.reconstruction_log_prob(
+            self.params_list[idx], a, sub, num_samples))
 
     # ------------------------------------------------------------------
     # inference / scoring

@@ -1,0 +1,174 @@
+"""VariationalAutoencoder + AutoEncoder layers and the layerwise
+unsupervised pretrain path (reference: conf/layers/variational/
+VariationalAutoencoder, conf/layers/AutoEncoder,
+MultiLayerNetwork#pretrain/#pretrainLayer,
+VariationalAutoencoder#reconstructionLogProbability)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.learning import Adam
+from deeplearning4j_tpu.nn.conf import (
+    AutoEncoder, DenseLayer, InputType, MultiLayerConfiguration,
+    NeuralNetConfiguration, OutputLayer, VariationalAutoencoder,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+
+def _two_cluster_data(n=128, d=8, seed=0):
+    rng = np.random.default_rng(seed)
+    centers = np.stack([np.full(d, 2.0), np.full(d, -2.0)])
+    labels = rng.integers(0, 2, n)
+    x = centers[labels] + rng.normal(0, 0.3, (n, d))
+    return x.astype(np.float32), labels
+
+
+def _vae_net(d=8, latent=2, dist="gaussian", updater=None):
+    conf = (NeuralNetConfiguration.builder().seed(3)
+            .updater(updater or Adam(learning_rate=1e-2))
+            .list()
+            .layer(VariationalAutoencoder(
+                n_out=latent, encoder_layer_sizes=(16,),
+                decoder_layer_sizes=(16,), activation="tanh",
+                reconstruction_distribution=dist))
+            .layer(OutputLayer(n_out=2, activation="softmax",
+                               loss="mcxent"))
+            .setInputType(InputType.feedForward(d))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+class TestVae:
+    def test_elbo_decreases_under_pretrain(self):
+        x, _ = _two_cluster_data()
+        net = _vae_net()
+        layer = net.conf.layers[0]
+        k = jax.random.key(0)
+        first = float(layer.unsupervised_loss(net.params_list[0],
+                                              jnp.asarray(x), k))
+        for _ in range(150):
+            net.pretrainLayer(0, x)
+        last = float(layer.unsupervised_loss(net.params_list[0],
+                                             jnp.asarray(x), k))
+        assert np.isfinite(first) and np.isfinite(last)
+        assert last < first - 1.0, (first, last)
+
+    def test_bernoulli_distribution(self):
+        rng = np.random.default_rng(1)
+        x = (rng.random((64, 12)) < 0.3).astype(np.float32)
+        net = _vae_net(d=12, dist="bernoulli")
+        layer = net.conf.layers[0]
+        k = jax.random.key(0)
+        first = float(layer.unsupervised_loss(net.params_list[0],
+                                              jnp.asarray(x), k))
+        for _ in range(100):
+            net.pretrainLayer(0, x)
+        last = float(layer.unsupervised_loss(net.params_list[0],
+                                             jnp.asarray(x), k))
+        assert last < first - 0.5, (first, last)
+
+    def test_reconstruction_log_prob_separates_outliers(self):
+        """The reference's anomaly-detection workflow: train on
+        inliers, score inliers vs far-away outliers."""
+        x, _ = _two_cluster_data(n=256)
+        net = _vae_net()
+        for _ in range(200):
+            net.pretrainLayer(0, x)
+        inl = np.asarray(net.reconstructionLogProbability(
+            0, x[:64], num_samples=16).toNumpy())
+        outliers = np.full((64, 8), 8.0, np.float32)
+        outl = np.asarray(net.reconstructionLogProbability(
+            0, outliers, num_samples=16).toNumpy())
+        assert np.median(inl) > np.median(outl) + 10.0, (
+            np.median(inl), np.median(outl))
+
+    def test_pretrain_then_supervised_finetune(self):
+        x, labels = _two_cluster_data(n=256)
+        y = np.eye(2, dtype=np.float32)[labels]
+        net = _vae_net()
+        net.pretrain(x, epochs=50)
+        for _ in range(50):
+            net.fit(x, y)
+        out = np.asarray(net.output(x).toNumpy())
+        acc = (out.argmax(1) == labels).mean()
+        assert acc > 0.95, acc
+
+    def test_supervised_forward_is_latent_mean(self):
+        x, _ = _two_cluster_data(n=4)
+        net = _vae_net()
+        out = np.asarray(net.feedForward(x)[1].toNumpy())
+        assert out.shape == (4, 2)
+        # deterministic (no sampling) in the supervised path
+        out2 = np.asarray(net.feedForward(x)[1].toNumpy())
+        np.testing.assert_array_equal(out, out2)
+
+    def test_grads_finite_everywhere(self):
+        x, _ = _two_cluster_data(n=16)
+        net = _vae_net()
+        layer = net.conf.layers[0]
+        g = jax.grad(lambda p: layer.unsupervised_loss(
+            p, jnp.asarray(x), jax.random.key(1)))(net.params_list[0])
+        for k, v in g.items():
+            assert bool(jnp.all(jnp.isfinite(v))), k
+            assert float(jnp.max(jnp.abs(v))) > 0 or k.startswith("d"), k
+
+    def test_json_round_trip(self):
+        net = _vae_net()
+        js = net.conf.to_json()
+        conf2 = MultiLayerConfiguration.from_json(js)
+        assert conf2.to_json() == js
+        lay = conf2.layers[0]
+        assert isinstance(lay, VariationalAutoencoder)
+        assert lay.encoder_layer_sizes == (16,)
+
+    def test_not_pretrainable_raises(self):
+        x, labels = _two_cluster_data(n=8)
+        net = _vae_net()
+        with pytest.raises(ValueError, match="not .*pretrainable|not"):
+            net.pretrainLayer(1, x)
+        with pytest.raises(ValueError, match="VariationalAutoencoder"):
+            net.reconstructionLogProbability(1, x)
+
+
+class TestAutoEncoder:
+    def _net(self, d=8):
+        conf = (NeuralNetConfiguration.builder().seed(5)
+                .updater(Adam(learning_rate=1e-2))
+                .list()
+                .layer(AutoEncoder(n_out=6, activation="sigmoid",
+                                   corruption_level=0.2))
+                .layer(OutputLayer(n_out=2, activation="softmax",
+                                   loss="mcxent"))
+                .setInputType(InputType.feedForward(d))
+                .build())
+        return MultiLayerNetwork(conf).init()
+
+    def test_reconstruction_improves(self):
+        x, _ = _two_cluster_data()
+        x = 1 / (1 + np.exp(-x))  # squash into (0,1) for sigmoid recon
+        net = self._net()
+        layer = net.conf.layers[0]
+        k = jax.random.key(0)
+        first = float(layer.unsupervised_loss(net.params_list[0],
+                                              jnp.asarray(x), k))
+        for _ in range(200):
+            net.pretrainLayer(0, x)
+        last = float(layer.unsupervised_loss(net.params_list[0],
+                                             jnp.asarray(x), k))
+        assert last < first * 0.5, (first, last)
+
+    def test_params_have_visible_bias(self):
+        net = self._net()
+        assert set(net.params_list[0]) == {"W", "b", "vb"}
+
+    def test_pretrain_only_touches_target_layer(self):
+        x, _ = _two_cluster_data(n=32)
+        net = self._net()
+        before = jax.tree_util.tree_map(lambda v: np.asarray(v),
+                                        net.params_list[1])
+        net.pretrainLayer(0, x)
+        after = net.params_list[1]
+        for k in before:
+            np.testing.assert_array_equal(before[k], np.asarray(after[k]))
