@@ -17,6 +17,7 @@ from deeplearning4j_tpu.nn.conf.layers import (
 from deeplearning4j_tpu.nn.conf.layers_extra import (
     CapsuleLayer, CapsuleStrengthLayer, CenterLossOutputLayer, Convolution1D,
     Convolution3D, Cropping1D, Cropping2D, Cropping3D, Deconvolution2D,
+    Deconvolution3D,
     DepthwiseConvolution2D, ElementWiseMultiplicationLayer, GravesBidirectionalLSTM, GRU,
     LocallyConnected1D, LocallyConnected2D, MaskLayer, MaskZeroLayer,
     PReLULayer, PrimaryCapsules, RepeatVector, SpaceToBatchLayer,
@@ -26,6 +27,7 @@ from deeplearning4j_tpu.nn.conf.layers_extra import (
 from deeplearning4j_tpu.nn.conf.variational import (
     AutoEncoder, VariationalAutoencoder,
 )
+from deeplearning4j_tpu.nn.conf.ocnn import OCNNOutputLayer
 from deeplearning4j_tpu.nn.conf.dropout import (
     AlphaDropout, Dropout, GaussianDropout, GaussianNoise, IDropout,
     SpatialDropout,
@@ -54,7 +56,8 @@ __all__ = [
     "CnnLossLayer", "RnnLossLayer",
     "CapsuleLayer", "CapsuleStrengthLayer", "CenterLossOutputLayer",
     "Convolution1D", "Convolution3D", "Cropping1D", "Cropping2D",
-    "Cropping3D", "Deconvolution2D", "DepthwiseConvolution2D",
+    "Cropping3D", "Deconvolution2D", "Deconvolution3D",
+    "DepthwiseConvolution2D",
     "ElementWiseMultiplicationLayer", "GravesBidirectionalLSTM", "GRU",
     "LocallyConnected1D",
     "LocallyConnected2D", "MaskLayer", "MaskZeroLayer", "PReLULayer",
@@ -67,6 +70,6 @@ __all__ = [
     "DropConnect", "IWeightNoise", "WeightNoise",
     "LayerConstraint", "MaxNormConstraint", "MinMaxNormConstraint",
     "NonNegativeConstraint", "UnitNormConstraint",
-    "AutoEncoder", "VariationalAutoencoder",
+    "AutoEncoder", "VariationalAutoencoder", "OCNNOutputLayer",
     "MultiLayerConfiguration", "NeuralNetConfiguration",
 ]

@@ -419,6 +419,48 @@ class Convolution3D(Layer):
 
 @serializable
 @dataclasses.dataclass
+class Deconvolution3D(Convolution3D):
+    """3D transposed conv on [N,D,H,W,C] (reference: conf/layers/
+    Deconvolution3D; NCDHW there, NDHWC here)."""
+
+    def __post_init__(self):
+        super().__post_init__()
+        if tuple(self.dilation) != (1, 1, 1):
+            raise ValueError(
+                "Deconvolution3D does not support dilation != (1,1,1) "
+                "(the transposed-conv lowering has no dilated form here); "
+                f"got {self.dilation}")
+
+    def output_type(self, it: InputType) -> InputType:
+        dims = []
+        for i, s in enumerate((it.depth, it.height, it.width)):
+            if self.convolution_mode == "Same":
+                dims.append(s * self.stride[i])
+            else:
+                dims.append(self.stride[i] * (s - 1) + self.kernel_size[i]
+                            - 2 * self.padding[i])
+        return InputType.convolutional3D(dims[0], dims[1], dims[2],
+                                         self.n_out)
+
+    def apply(self, params, state, x, train, rng):
+        x = self._maybe_dropout(x, train, rng)
+        from deeplearning4j_tpu.ops.declarable_tail import deconv3d
+        if self.convolution_mode == "Same":
+            pad = "SAME"
+        else:
+            # reference semantics out = s(in-1)+k-2p; conv_transpose
+            # pads the stride-dilated input directly, so low = high =
+            # k-1-p (same mapping as deconv2d, ops/nn.py)
+            pad = [(k - 1 - p, k - 1 - p)
+                   for k, p in zip(self.kernel_size, self.padding)]
+        out = deconv3d(x, params["W"], strides=self.stride, padding=pad)
+        if self.has_bias:
+            out = out + params["b"]
+        return _act(self.activation or "identity").fn(out), state
+
+
+@serializable
+@dataclasses.dataclass
 class Subsampling3DLayer(Layer):
     """3D pooling (reference: conf/layers/Subsampling3DLayer)."""
 

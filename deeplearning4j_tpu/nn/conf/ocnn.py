@@ -1,0 +1,77 @@
+"""One-class neural network output layer (reference:
+org/deeplearning4j/nn/conf/ocnn/OCNNOutputLayer + impl
+org/deeplearning4j/nn/layers/ocnn/OCNNOutputLayer — anomaly detection
+head per Chalapathy et al., "Anomaly Detection using One-Class Neural
+Networks": min_{V,w,r} 0.5||V||^2 + 0.5||w||^2
++ (1/nu) * mean(relu(r - w . g(xV))) - r, trained on 'normal' data
+only; labels are ignored).
+
+TPU-native design note on r: the reference recomputes r every
+``windowSize`` iterations as the nu-quantile of the last window's
+scores (a host-side sort). Here r is a TRAINABLE scalar updated by the
+same compiled step as V and w: dLoss/dr = mean(1[score < r])/nu - 1,
+so gradient descent drives mean(1[score < r]) -> nu, i.e. r converges
+to the same nu-quantile fixed point with no host round-trip or
+windowed sort. ``initial_r_value`` mirrors the reference knob.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.common.serde import serializable
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import Layer, _act
+from deeplearning4j_tpu.nn.weights import WeightInit, init_weights
+
+
+@serializable
+@dataclasses.dataclass
+class OCNNOutputLayer(Layer):
+    """One-class output head. ``fit(x, y)``'s labels are IGNORED (pass
+    zeros); the layer's activation (default relu) is the hidden g().
+    Inference output is the decision value ``w . g(xV) - r`` per
+    example ([N, 1]; >= 0 means 'normal')."""
+
+    n_in: int = 0
+    hidden_size: int = 64
+    nu: float = 0.04
+    initial_r_value: float = 0.1
+
+    def output_type(self, it: InputType) -> InputType:
+        return InputType.feedForward(1)
+
+    def init_params(self, key, it: InputType, dtype) -> dict:
+        v = init_weights(self.weight_init or WeightInit.XAVIER, key,
+                         (self.n_in, self.hidden_size), self.n_in,
+                         self.hidden_size, dtype)
+        return {"V": v,
+                "W": jnp.full((self.hidden_size,), 1.0 / self.hidden_size,
+                              dtype),
+                "r": jnp.asarray(self.initial_r_value, jnp.float32)}
+
+    def _scores(self, params, x):
+        g = _act(self.activation or "relu")
+        h = g.fn(x @ params["V"])
+        return (h @ params["W"]).astype(jnp.float32)
+
+    def loss_value(self, params, state, x, labels, mask=None):
+        # labels deliberately unused: one-class training
+        s = self._scores(params, x)
+        r = params["r"]
+        hinge = jnp.maximum(0.0, r - s)
+        if mask is not None:
+            m = mask.astype(hinge.dtype).reshape(hinge.shape)
+            hinge_mean = jnp.sum(hinge * m) / jnp.maximum(jnp.sum(m), 1.0)
+        else:
+            hinge_mean = jnp.mean(hinge)
+        vf = params["V"].astype(jnp.float32)
+        wf = params["W"].astype(jnp.float32)
+        return (0.5 * jnp.sum(vf * vf) + 0.5 * jnp.sum(wf * wf)
+                + hinge_mean / self.nu - r)
+
+    def apply(self, params, state, x, train, rng):
+        x = self._maybe_dropout(x, train, rng)
+        return (self._scores(params, x) - params["r"])[:, None], state

@@ -27,7 +27,7 @@ from deeplearning4j_tpu.nn.graph.config import ComputationGraphConfiguration
 from deeplearning4j_tpu.nn.graph.vertices import LayerVertex
 from deeplearning4j_tpu.nn.conf.layers import LossLayer, OutputLayer
 from deeplearning4j_tpu.nn.multilayer.network import (
-    _REGULARIZED_KEYS, _uses_epoch_schedule,
+    _REGULARIZED_KEYS, _eval_mask, _uses_epoch_schedule,
 )
 
 
@@ -45,6 +45,8 @@ class ComputationGraph:
         self._rng_key = None
         self._step_cache = {}
         self._fwd = None
+        self._rnn_carries = None    # stateful rnnTimeStep hidden state
+        self._rnn_batch = 0
         self._node_index = None
         self._dtype = DataType.from_any(conf.dtype).jax
 
@@ -218,7 +220,7 @@ class ComputationGraph:
                 new_states[node.name] = ns
                 continue
             if node.name in conf.network_outputs and isinstance(v, LayerVertex) \
-                    and isinstance(v.layer, (OutputLayer, LossLayer)):
+                    and hasattr(v.layer, "loss_value"):
                 total = total + v.layer.loss_value(
                     p_i, states_map[node.name], xs[0],
                     labels_map[node.name], masks_map.get(node.name))
@@ -400,6 +402,190 @@ class ComputationGraph:
             l.iterationDone(self, self._iteration, self._epoch)
 
     # ------------------------------------------------------------------
+    # ------------------------------------------------------------------
+    # layerwise unsupervised pretraining (reference:
+    # ComputationGraph#pretrain / #pretrainLayer(String, iter))
+    # ------------------------------------------------------------------
+    def _get_pretrain_step(self, name: str):
+        key = ("pretrain", name)  # namespaced: vertex names share the
+        if key in self._step_cache:  # cache with the "rnn_step" entry
+            return self._step_cache[key]
+        node = self._node_by_name(name)
+        layer = getattr(node.vertex, "layer", None)
+        if layer is None or not hasattr(layer, "unsupervised_loss"):
+            raise ValueError(
+                f"vertex {name!r} is not pretrainable — only layer "
+                "vertices with an unsupervised loss "
+                "(VariationalAutoencoder, AutoEncoder) support "
+                "pretrainLayer")
+        from deeplearning4j_tpu.learning.updaters import apply_updater
+        from deeplearning4j_tpu.nn.conf.constraint import apply_constraints
+
+        def step_fn(p_i, params_map, states_map, opt_state, it_step,
+                    inputs, rng):
+            # frozen-prefix activations in graph topo order up to the
+            # target vertex, inside the same compiled program
+            acts = dict(inputs)
+            for nd in self.conf.nodes:
+                if nd.name == name:
+                    break
+                acts[nd.name], _ = nd.vertex.apply(
+                    params_map[nd.name], states_map[nd.name],
+                    [acts[s] for s in nd.inputs], False, None)
+            x = acts[node.inputs[0]]
+
+            def loss_fn(p):
+                if layer.weight_noise is not None and rng is not None:
+                    p = layer.weight_noise.apply(p, rng)
+                loss = layer.unsupervised_loss(p, x, rng)
+                # fit()-consistent l1/l2 on the pretrained layer
+                for k, v in p.items():
+                    if k in _REGULARIZED_KEYS:
+                        if layer.l1:
+                            loss = loss + layer.l1 * jnp.sum(jnp.abs(v))
+                        if layer.l2:
+                            loss = loss + 0.5 * layer.l2 * jnp.sum(v * v)
+                return loss
+
+            loss, grads = jax.value_and_grad(loss_fn)(p_i)
+            grads = self._clip({name: grads})[name]
+            updates, new_opt = apply_updater(self._updaters[name],
+                                             opt_state, grads, p_i,
+                                             it_step)
+            new_p = jax.tree_util.tree_map(lambda p, u: p - u, p_i,
+                                           updates)
+            return apply_constraints(layer, new_p), new_opt, loss
+
+        jitted = jax.jit(step_fn)
+        self._step_cache[key] = jitted
+        return jitted
+
+    def pretrainLayer(self, name: str, data, epochs: int = 1):
+        """Unsupervised training of ONE layer vertex; upstream vertices
+        act as a frozen feature extractor. ``data``: features — one
+        array (single-input graph), a sequence matching
+        ``network_inputs``, or a (Multi)DataSet(Iterator) whose labels
+        are ignored."""
+        self._check_init()
+        step = self._get_pretrain_step(name)
+        conf = self.conf
+
+        def feature_batches():
+            from deeplearning4j_tpu.datasets.multi_dataset import (
+                MultiDataSet, MultiDataSetIterator,
+            )
+            if isinstance(data, (MultiDataSetIterator, DataSetIterator)):
+                for d in data:
+                    yield (d.features if isinstance(d.features, (list,
+                                                                 tuple))
+                           else [d.features])
+            elif isinstance(data, (MultiDataSet, DataSet)):
+                f = data.features
+                yield f if isinstance(f, (list, tuple)) else [f]
+            elif isinstance(data, (list, tuple)):
+                yield data
+            else:
+                yield [data]
+
+        for _ in range(epochs):
+            for xs in feature_batches():
+                if len(xs) != len(conf.network_inputs):
+                    raise ValueError(
+                        f"expected {len(conf.network_inputs)} input "
+                        f"arrays, got {len(xs)}")
+                inputs = {n: jnp.asarray(_unwrap(x), self._dtype)
+                          for n, x in zip(conf.network_inputs, xs)}
+                self._rng_key, sub = jax.random.split(self._rng_key)
+                (self.params_map[name], self.opt_states[name],
+                 loss) = step(self.params_map[name], self.params_map,
+                              self.states_map, self.opt_states[name],
+                              jnp.asarray(self._iteration), inputs, sub)
+                self._score = loss
+                self._iteration += 1
+        return self
+
+    def pretrain(self, data, epochs: int = 1):
+        """Pretrain every pretrainable layer vertex in topo order
+        (reference: ComputationGraph#pretrain)."""
+        for node in self.conf.nodes:
+            lay = getattr(node.vertex, "layer", None)
+            if lay is not None and hasattr(lay, "unsupervised_loss"):
+                self.pretrainLayer(node.name, data, epochs)
+        return self
+
+    # ------------------------------------------------------------------
+    # stateful RNN stepping (reference: ComputationGraph#rnnTimeStep,
+    # rnnClearPreviousState — same carry semantics as MultiLayerNetwork)
+    # ------------------------------------------------------------------
+    def _recurrent_nodes(self):
+        return [n.name for n in self.conf.nodes
+                if getattr(getattr(n.vertex, "layer", None),
+                           "is_recurrent", False)]
+
+    def _rnn_step_forward(self, params_map, states_map, carries, inputs):
+        acts = dict(inputs)
+        new_carries = {}
+        for node in self.conf.nodes:
+            xs = [acts[s] for s in node.inputs]
+            lay = getattr(node.vertex, "layer", None)
+            if lay is not None and lay.is_recurrent:
+                out, _, c = lay.apply_with_carry(
+                    params_map[node.name], states_map[node.name],
+                    carries[node.name], xs[0], False, None)
+                new_carries[node.name] = c
+            else:
+                out, _ = node.vertex.apply(params_map[node.name],
+                                           states_map[node.name], xs,
+                                           False, None)
+            acts[node.name] = out
+        return [acts[o] for o in self.conf.network_outputs], new_carries
+
+    def rnnTimeStep(self, *xs) -> List[NDArray]:
+        """One (or more) timesteps of stateful inference across the
+        graph; recurrent layer vertices keep their hidden carry between
+        calls. 2-D inputs [N,F] mean a single step (outputs [N,out]);
+        3-D [N,T,F] steps T times. Returns one NDArray per network
+        output."""
+        self._check_init()
+        conf = self.conf
+        if len(xs) != len(conf.network_inputs):
+            raise ValueError(
+                f"expected {len(conf.network_inputs)} inputs, got "
+                f"{len(xs)}")
+        arrs = [jnp.asarray(_unwrap(x), self._dtype) for x in xs]
+        single = arrs[0].ndim == 2
+        if single:
+            arrs = [a[:, None, :] if a.ndim == 2 else a for a in arrs]
+        n = arrs[0].shape[0]
+        if self._rnn_carries is not None and self._rnn_batch != n:
+            raise ValueError(
+                f"rnnTimeStep batch size changed ({self._rnn_batch} -> "
+                f"{n}) with stored state — call rnnClearPreviousState() "
+                "first (reference behavior)")
+        if self._rnn_carries is None:
+            self._rnn_carries = {
+                name: self._node_by_name(name).vertex.layer.init_carry(
+                    n, self._dtype)
+                for name in self._recurrent_nodes()}
+            self._rnn_batch = n
+        if "rnn_step" not in self._step_cache:
+            self._step_cache["rnn_step"] = jax.jit(self._rnn_step_forward)
+        inputs = {k: a for k, a in zip(conf.network_inputs, arrs)}
+        outs, self._rnn_carries = self._step_cache["rnn_step"](
+            self.params_map, self.states_map, self._rnn_carries, inputs)
+        if single:
+            outs = [o[:, 0] if o.ndim == 3 else o for o in outs]
+        return [NDArray(o) for o in outs]
+
+    def rnnClearPreviousState(self) -> None:
+        self._rnn_carries = None
+        self._rnn_batch = 0
+
+    def rnnGetPreviousState(self, name: str):
+        if self._rnn_carries is None:
+            return None
+        return self._rnn_carries.get(name)
+
     def output(self, *xs, feature_masks=None) -> List[NDArray]:
         """Reference: ComputationGraph#output — returns list of outputs.
         feature_masks keeps inference consistent with masked training."""
@@ -446,6 +632,33 @@ class ComputationGraph:
                 mask = ds.features_mask
             ev.eval(ds.labels, out.jax, mask=mask)
         return ev
+
+    def evaluateROC(self, iterator: DataSetIterator, threshold_steps=0):
+        """Binary ROC/AUC over the single graph output (reference:
+        ComputationGraph#evaluateROC; exact sweep)."""
+        from deeplearning4j_tpu.evaluation import ROC
+
+        roc = ROC()
+        for ds in iterator:
+            fms = [ds.features_mask] if ds.features_mask is not None \
+                else None
+            out = self.outputSingle(ds.features, feature_masks=fms)
+            roc.eval(ds.labels, out.jax, mask=_eval_mask(ds))
+        return roc
+
+    def evaluateROCMultiClass(self, iterator: DataSetIterator,
+                              threshold_steps=0):
+        """One-vs-all ROC per class (reference:
+        ComputationGraph#evaluateROCMultiClass; exact sweep)."""
+        from deeplearning4j_tpu.evaluation import ROCMultiClass
+
+        roc = ROCMultiClass()
+        for ds in iterator:
+            fms = [ds.features_mask] if ds.features_mask is not None \
+                else None
+            out = self.outputSingle(ds.features, feature_masks=fms)
+            roc.eval(ds.labels, out.jax, mask=_eval_mask(ds))
+        return roc
 
     def evaluateRegression(self, iterator: DataSetIterator):
         from deeplearning4j_tpu.evaluation import RegressionEvaluation

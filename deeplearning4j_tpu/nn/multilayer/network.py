@@ -37,6 +37,16 @@ from deeplearning4j_tpu.learning.schedules import ISchedule, ScheduleType
 from deeplearning4j_tpu.learning.updaters import IUpdater, apply_updater
 
 
+def _eval_mask(ds):
+    """Label mask for evaluation, with the evalTimeSeries convention:
+    per-timestep labels + a features mask and no explicit label mask
+    means the features mask IS the label mask (reference: RNN masking)."""
+    if ds.labels_mask is None and ds.features_mask is not None \
+            and np.asarray(ds.labels).ndim == 3:
+        return ds.features_mask
+    return ds.labels_mask
+
+
 def _uses_epoch_schedule(upd) -> bool:
     """True if the updater's LR schedule counts epochs, not iterations
     (reference: ScheduleType.EPOCH resolved in BaseMultiLayerUpdater)."""
@@ -220,8 +230,10 @@ class MultiLayerNetwork:
             new_carries.append(c)
         new_carries.append(None)  # loss head is never recurrent
         last = conf.layers[-1]
-        if not isinstance(last, (OutputLayer, LossLayer)):
-            raise ValueError("Last layer must be an OutputLayer/LossLayer to fit()")
+        if not hasattr(last, "loss_value"):
+            raise ValueError("Last layer must be an OutputLayer/LossLayer "
+                             "(or another loss-bearing head, e.g. "
+                             "OCNNOutputLayer) to fit()")
         tag = conf.preprocessors.get(len(conf.layers) - 1)
         if tag:
             a = apply_preprocessor(tag, a)
@@ -494,8 +506,23 @@ class MultiLayerNetwork:
             # compiled program (no separate feature-extraction pass)
             a = self._prefix_activations(idx, prefix_params, states_list,
                                          x)
-            loss, grads = jax.value_and_grad(
-                lambda p: layer.unsupervised_loss(p, a, rng))(p_i)
+
+            def loss_fn(p):
+                if layer.weight_noise is not None and rng is not None:
+                    p = layer.weight_noise.apply(p, rng)
+                loss = layer.unsupervised_loss(p, a, rng)
+                # same l1/l2 treatment fit() applies (reference:
+                # pretraining includes regularization in the score)
+                for k, v in p.items():
+                    if k in _REGULARIZED_KEYS:
+                        if layer.l1:
+                            loss = loss + layer.l1 * jnp.sum(jnp.abs(v))
+                        if layer.l2:
+                            loss = loss + 0.5 * layer.l2 * jnp.sum(v * v)
+                return loss
+
+            loss, grads = jax.value_and_grad(loss_fn)(p_i)
+            grads = self._clip_grads([grads])[0]
             updates, new_opt = apply_updater(self._updaters[idx],
                                              opt_state, grads, p_i,
                                              it_step)
@@ -701,12 +728,33 @@ class MultiLayerNetwork:
         ev = Evaluation()
         for ds in iterator:
             out = self.output(ds.features, features_mask=ds.features_mask)
-            mask = ds.labels_mask
-            if mask is None and ds.features_mask is not None \
-                    and np.asarray(ds.labels).ndim == 3:
-                mask = ds.features_mask  # evalTimeSeries convention
-            ev.eval(ds.labels, out.jax, mask=mask)
+            ev.eval(ds.labels, out.jax, mask=_eval_mask(ds))
         return ev
+
+    def evaluateROC(self, iterator: DataSetIterator, threshold_steps=0):
+        """Binary ROC/AUC (reference: MultiLayerNetwork#evaluateROC;
+        expects a 1- or 2-column probability output). threshold_steps
+        is accepted for API parity but the sweep is always EXACT
+        (thresholdSteps=0 mode — strictly more accurate)."""
+        from deeplearning4j_tpu.evaluation import ROC
+
+        roc = ROC()
+        for ds in iterator:
+            out = self.output(ds.features, features_mask=ds.features_mask)
+            roc.eval(ds.labels, out.jax, mask=_eval_mask(ds))
+        return roc
+
+    def evaluateROCMultiClass(self, iterator: DataSetIterator,
+                              threshold_steps=0):
+        """One-vs-all ROC per class (reference:
+        MultiLayerNetwork#evaluateROCMultiClass; exact sweep)."""
+        from deeplearning4j_tpu.evaluation import ROCMultiClass
+
+        roc = ROCMultiClass()
+        for ds in iterator:
+            out = self.output(ds.features, features_mask=ds.features_mask)
+            roc.eval(ds.labels, out.jax, mask=_eval_mask(ds))
+        return roc
 
     def evaluateRegression(self, iterator: DataSetIterator):
         from deeplearning4j_tpu.evaluation import RegressionEvaluation

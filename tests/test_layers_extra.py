@@ -399,3 +399,60 @@ class TestGravesBidirectionalAndEnvironment:
         info = Nd4jEnvironment.getEnvironmentInformation()
         assert info["backend"] == "cpu" and info["device.count"] == 8
         assert "jax.version" in info
+
+
+class TestDeconvolution3D:
+    def test_same_mode_upsamples(self):
+        from deeplearning4j_tpu.nn.conf import Deconvolution3D
+
+        lay = Deconvolution3D(n_in=3, n_out=5, kernel_size=(2, 2, 2),
+                              stride=(2, 2, 2), convolution_mode="Same")
+        p = lay.init_params(jax.random.key(0), None, jnp.float32)
+        out, _ = lay.apply(p, {}, jnp.ones((2, 4, 5, 6, 3)), False, None)
+        assert out.shape == (2, 8, 10, 12, 5)
+        it = lay.output_type(InputType.convolutional3D(4, 5, 6, 3))
+        assert (it.depth, it.height, it.width, it.channels) == (8, 10, 12, 5)
+
+    def test_truncate_mode_matches_torch(self):
+        """Value golden vs torch conv_transpose3d: ours is zero-insert +
+        correlation (DHWIO), torch is the conv gradient, so torch's
+        weight maps to flip_spatial(permute(w,(2,3,4,0,1)))."""
+        import torch
+        from deeplearning4j_tpu.nn.conf import Deconvolution3D
+
+        rs = np.random.RandomState(11)
+        x = rs.randn(2, 3, 4, 5, 2).astype(np.float32)       # NDHWC
+        wt = rs.randn(2, 4, 3, 3, 3).astype(np.float32)      # [Cin,Cout,k..]
+        want = torch.nn.functional.conv_transpose3d(
+            torch.tensor(x.transpose(0, 4, 1, 2, 3)), torch.tensor(wt),
+            stride=(2, 1, 2), padding=1).numpy().transpose(0, 2, 3, 4, 1)
+
+        lay = Deconvolution3D(n_in=2, n_out=4, kernel_size=(3, 3, 3),
+                              stride=(2, 1, 2), padding=(1, 1, 1),
+                              convolution_mode="Truncate", has_bias=False)
+        w = np.flip(wt.transpose(2, 3, 4, 0, 1), (0, 1, 2)).copy()
+        out, _ = lay.apply({"W": jnp.asarray(w)}, {}, jnp.asarray(x),
+                           False, None)
+        assert out.shape == want.shape
+        np.testing.assert_allclose(np.asarray(out), want, rtol=1e-4,
+                                   atol=1e-4)
+
+    def test_trains_in_network(self):
+        from deeplearning4j_tpu.nn.conf import Convolution3D, Deconvolution3D
+
+        conf = _build([
+            Convolution3D(n_out=4, kernel_size=(2, 2, 2), stride=(2, 2, 2),
+                          convolution_mode="Same", activation="relu"),
+            Deconvolution3D(n_out=2, kernel_size=(2, 2, 2), stride=(2, 2, 2),
+                            convolution_mode="Same", activation="relu"),
+            GlobalPoolingLayer(pooling_type="avg"),
+            OutputLayer(n_out=2, activation="softmax", loss="mcxent"),
+        ], InputType.convolutional3D(4, 4, 4, 1))
+        net = MultiLayerNetwork(conf).init()
+        x = np.random.RandomState(0).randn(8, 4, 4, 4, 1).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[np.arange(8) % 2]
+        net.fit(x, y)
+        s0 = net.score()
+        for _ in range(20):
+            net.fit(x, y)
+        assert net.score() < s0

@@ -223,3 +223,52 @@ class TestLeNetEndToEnd:
         o1 = model.output(x0).toNumpy()
         o2 = model.output(x0).toNumpy()
         np.testing.assert_array_equal(o1, o2)
+
+
+class TestEvaluateROCApis:
+    """evaluateROC / evaluateROCMultiClass (reference:
+    MultiLayerNetwork#evaluateROC[MultiClass], ComputationGraph dito)."""
+
+    def _binary(self, n=256):
+        rng = np.random.RandomState(3)
+        x = rng.randn(n, 6).astype(np.float32)
+        y_idx = (x.sum(1) > 0).astype(int)
+        return x, np.eye(2, dtype=np.float32)[y_idx]
+
+    def test_mln_roc_auc(self):
+        x, y = self._binary()
+        conf = (NeuralNetConfiguration.builder()
+                .seed(1).updater(Adam(learning_rate=0.02))
+                .list()
+                .layer(DenseLayer(n_out=16, activation="relu"))
+                .layer(OutputLayer(n_out=2, activation="softmax",
+                                   loss="mcxent"))
+                .setInputType(InputType.feedForward(6))
+                .build())
+        model = MultiLayerNetwork(conf).init()
+        model.fit(ArrayDataSetIterator(x, y, batch_size=64), epochs=10)
+        roc = model.evaluateROC(ArrayDataSetIterator(x, y, batch_size=128))
+        assert roc.calculateAUC() > 0.9
+        mc = model.evaluateROCMultiClass(
+            ArrayDataSetIterator(x, y, batch_size=128))
+        assert mc.calculateAUC(1) > 0.9
+
+    def test_graph_roc_auc(self):
+        from deeplearning4j_tpu.nn.graph import (
+            ComputationGraph, ComputationGraphConfiguration,
+        )
+
+        x, y = self._binary()
+        b = (ComputationGraphConfiguration.graphBuilder()
+             .seed(1).updater(Adam(learning_rate=0.02))
+             .addInputs("in").setInputTypes(InputType.feedForward(6)))
+        b.addLayer("d", DenseLayer(n_out=16, activation="relu"), "in")
+        b.addLayer("out", OutputLayer(n_out=2, activation="softmax",
+                                      loss="mcxent"), "d")
+        net = ComputationGraph(b.setOutputs("out").build()).init()
+        net.fit(ArrayDataSetIterator(x, y, batch_size=64), epochs=10)
+        roc = net.evaluateROC(ArrayDataSetIterator(x, y, batch_size=128))
+        assert roc.calculateAUC() > 0.9
+        mc = net.evaluateROCMultiClass(
+            ArrayDataSetIterator(x, y, batch_size=128))
+        assert mc.calculateAUC(0) > 0.9

@@ -194,3 +194,42 @@ class TestTbptt:
             net.rnnTimeStep(np.zeros((2, 4), np.float32))
         net.rnnClearPreviousState()
         net.rnnTimeStep(np.zeros((2, 4), np.float32))  # fine after clear
+
+
+class TestGraphRnnTimeStep:
+    """ComputationGraph#rnnTimeStep parity (reference: stateful graph
+    inference with recurrent layer vertices keeping carries)."""
+
+    def _net(self):
+        from deeplearning4j_tpu.nn.graph import (
+            ComputationGraph, ComputationGraphConfiguration,
+        )
+
+        b = (ComputationGraphConfiguration.graphBuilder()
+             .seed(9).addInputs("in")
+             .setInputTypes(InputType.recurrent(4)))
+        b.addLayer("lstm", LSTM(n_out=5), "in")
+        b.addLayer("out", RnnOutputLayer(n_out=3, activation="identity",
+                                         loss="mse"), "lstm")
+        return ComputationGraph(b.setOutputs("out").build()).init()
+
+    def test_stepwise_matches_full_sequence(self):
+        net = self._net()
+        x = np.random.RandomState(3).randn(2, 5, 4).astype(np.float32)
+        full = net.output(x)[0].toNumpy()
+        steps = [net.rnnTimeStep(x[:, t])[0].toNumpy() for t in range(5)]
+        np.testing.assert_allclose(np.stack(steps, axis=1), full,
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_clear_and_batch_mismatch(self):
+        net = self._net()
+        x = np.random.RandomState(5).randn(2, 4).astype(np.float32)
+        first = net.rnnTimeStep(x)[0].toNumpy()
+        second = net.rnnTimeStep(x)[0].toNumpy()
+        assert not np.allclose(first, second)
+        with pytest.raises(ValueError, match="batch size changed"):
+            net.rnnTimeStep(np.zeros((3, 4), np.float32))
+        net.rnnClearPreviousState()
+        again = net.rnnTimeStep(x)[0].toNumpy()
+        np.testing.assert_allclose(again, first, rtol=1e-6)
+        assert net.rnnGetPreviousState("lstm") is not None

@@ -132,6 +132,58 @@ class TestVae:
             net.reconstructionLogProbability(1, x)
 
 
+class TestGraphPretrain:
+    def test_computation_graph_vae_pretrain(self):
+        from deeplearning4j_tpu.nn.graph import (
+            ComputationGraph, ComputationGraphConfiguration,
+        )
+
+        x, _ = _two_cluster_data(n=128)
+        b = (ComputationGraphConfiguration.graphBuilder()
+             .seed(11).updater(Adam(learning_rate=1e-2))
+             .addInputs("in")
+             .setInputTypes(InputType.feedForward(8)))
+        b.addLayer("enc", DenseLayer(n_out=8, activation="tanh"), "in")
+        b.addLayer("vae", VariationalAutoencoder(
+            n_out=2, encoder_layer_sizes=(12,), decoder_layer_sizes=(12,),
+            activation="tanh"), "enc")
+        b.addLayer("out", OutputLayer(n_out=2, activation="softmax",
+                                      loss="mcxent"), "vae")
+        net = ComputationGraph(b.setOutputs("out").build()).init()
+
+        layer = net._node_by_name("vae").vertex.layer
+        import jax as _jax
+        k = _jax.random.key(0)
+        feats0 = np.tanh(x @ np.asarray(net.params_map["enc"]["W"])
+                         + np.asarray(net.params_map["enc"]["b"]))
+        first = float(layer.unsupervised_loss(
+            net.params_map["vae"], jnp.asarray(feats0), k))
+        enc_before = np.asarray(net.params_map["enc"]["W"])
+        for _ in range(120):
+            net.pretrainLayer("vae", x)
+        last = float(layer.unsupervised_loss(
+            net.params_map["vae"], jnp.asarray(feats0), k))
+        assert last < first - 0.5, (first, last)
+        # upstream vertex stays frozen
+        np.testing.assert_array_equal(enc_before,
+                                      np.asarray(net.params_map["enc"]["W"]))
+
+    def test_non_pretrainable_vertex_raises(self):
+        from deeplearning4j_tpu.nn.graph import (
+            ComputationGraph, ComputationGraphConfiguration,
+        )
+
+        b = (ComputationGraphConfiguration.graphBuilder()
+             .seed(1).addInputs("in")
+             .setInputTypes(InputType.feedForward(4)))
+        b.addLayer("d", DenseLayer(n_out=3, activation="relu"), "in")
+        b.addLayer("out", OutputLayer(n_out=2, activation="softmax",
+                                      loss="mcxent"), "d")
+        net = ComputationGraph(b.setOutputs("out").build()).init()
+        with pytest.raises(ValueError, match="not pretrainable"):
+            net.pretrainLayer("d", np.zeros((2, 4), np.float32))
+
+
 class TestAutoEncoder:
     def _net(self, d=8):
         conf = (NeuralNetConfiguration.builder().seed(5)
@@ -172,3 +224,61 @@ class TestAutoEncoder:
         after = net.params_list[1]
         for k in before:
             np.testing.assert_array_equal(before[k], np.asarray(after[k]))
+
+
+class TestOcnn:
+    """OCNNOutputLayer (reference: conf/ocnn/OCNNOutputLayer): one-class
+    training on 'normal' data; decision value w.g(xV) - r."""
+
+    def _net(self, d=8, nu=0.1):
+        from deeplearning4j_tpu.nn.conf import OCNNOutputLayer
+        from deeplearning4j_tpu.learning import Sgd
+
+        conf = (NeuralNetConfiguration.builder().seed(2)
+                .updater(Sgd(learning_rate=5e-2))
+                .list()
+                .layer(DenseLayer(n_out=16, activation="tanh"))
+                .layer(OCNNOutputLayer(hidden_size=12, nu=nu,
+                                       activation="relu"))
+                .setInputType(InputType.feedForward(d))
+                .build())
+        return MultiLayerNetwork(conf).init()
+
+    def test_separates_outliers_and_r_hits_quantile(self):
+        rng = np.random.default_rng(0)
+        d = 8
+        x = (np.full(d, 1.0) + rng.normal(0, 0.25, (256, d))) \
+            .astype(np.float32)
+        y = np.zeros((256, 1), np.float32)  # labels ignored (one-class)
+        net = self._net(d, nu=0.1)
+        for _ in range(400):
+            net.fit(x, y)
+        dec_in = np.asarray(net.output(x).toNumpy()).ravel()
+        outliers = rng.normal(0, 3.0, (128, d)).astype(np.float32)
+        dec_out = np.asarray(net.output(outliers).toNumpy()).ravel()
+        # inliers mostly >= 0; far-away points mostly below
+        assert (dec_in >= 0).mean() > 0.8, (dec_in >= 0).mean()
+        assert np.median(dec_out) < np.median(dec_in)
+        # the trainable r converged to the nu-quantile fixed point:
+        # about nu of the training scores sit below r
+        frac_below = (dec_in < 0).mean()
+        assert 0.0 <= frac_below <= 0.3, frac_below
+
+    def test_loss_ignores_labels(self):
+        rng = np.random.default_rng(1)
+        x = rng.normal(size=(32, 8)).astype(np.float32)
+        net = self._net()
+        lay = net.conf.layers[-1]
+        l0 = float(lay.loss_value(net.params_list[-1], {},
+                                  jnp.asarray(x @ np.ones((8, 16),
+                                                          np.float32) * 0),
+                                  None))
+        assert np.isfinite(l0)
+
+    def test_json_round_trip(self):
+        net = self._net()
+        js = net.conf.to_json()
+        conf2 = MultiLayerConfiguration.from_json(js)
+        assert conf2.to_json() == js
+        from deeplearning4j_tpu.nn.conf import OCNNOutputLayer
+        assert isinstance(conf2.layers[-1], OCNNOutputLayer)
