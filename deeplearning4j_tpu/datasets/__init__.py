@@ -8,6 +8,7 @@ from deeplearning4j_tpu.datasets.iterator import (
 from deeplearning4j_tpu.datasets.record_reader_iterator import (
     AsyncDataSetIterator,
     RecordReaderDataSetIterator,
+    RecordReaderMultiDataSetIterator,
     SequenceRecordReaderDataSetIterator,
 )
 from deeplearning4j_tpu.datasets.fetchers import (
@@ -22,6 +23,7 @@ from deeplearning4j_tpu.datasets.multi_dataset import (
 __all__ = ["DataSet", "DataSetIterator", "ListDataSetIterator",
            "ArrayDataSetIterator", "AsyncDataSetIterator",
            "RecordReaderDataSetIterator",
+           "RecordReaderMultiDataSetIterator",
            "SequenceRecordReaderDataSetIterator",
            "IrisDataSetIterator", "MnistDataSetIterator",
            "EmnistDataSetIterator", "Cifar10DataSetIterator",

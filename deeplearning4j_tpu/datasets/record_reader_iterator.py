@@ -18,6 +18,9 @@ import numpy as np
 
 from deeplearning4j_tpu.datasets.dataset import DataSet
 from deeplearning4j_tpu.datasets.iterator import DataSetIterator
+from deeplearning4j_tpu.datasets.multi_dataset import (
+    MultiDataSet, MultiDataSetIterator,
+)
 from deeplearning4j_tpu.datavec.records import RecordReader
 
 
@@ -237,3 +240,168 @@ class AsyncDataSetIterator(DataSetIterator):
 
     def batch(self) -> int:
         return self.underlying.batch()
+
+
+class RecordReaderMultiDataSetIterator(MultiDataSetIterator):
+    """Multi-reader → MultiDataSet builder (reference:
+    deeplearning4j-data RecordReaderMultiDataSetIterator — THE canonical
+    way to feed multi-input/multi-output ComputationGraphs from
+    datavec). Named readers advance in lock-step; each addInput/
+    addOutput spec slices columns (inclusive col_from..col_to, the
+    reference convention) or one-hots a single column. Sequence
+    readers produce [N,T,F] padded to the batch max length with
+    [N,T] masks (ALIGN_END pads at the start — the reference default
+    for many-to-one setups — ALIGN_START pads at the end).
+    """
+
+    def __init__(self, batch_size, readers, seq_readers, inputs, outputs,
+                 alignment):
+        self._bs = batch_size
+        self._readers = readers            # name -> RecordReader
+        self._seq = seq_readers            # name -> bool
+        self._inputs = inputs              # list of spec tuples
+        self._outputs = outputs
+        self._align = alignment
+        for r in self._readers.values():
+            r.reset()
+
+    # -- builder (reference API shape) ---------------------------------
+    class Builder:
+        def __init__(self, batch_size: int):
+            self._bs = batch_size
+            self._readers = {}
+            self._seq = {}
+            self._inputs = []
+            self._outputs = []
+            self._align = "ALIGN_START"
+
+        def addReader(self, name: str, reader):
+            self._readers[name] = reader
+            self._seq[name] = False
+            return self
+
+        def addSequenceReader(self, name: str, reader):
+            self._readers[name] = reader
+            self._seq[name] = True
+            return self
+
+        def sequenceAlignmentMode(self, mode: str):
+            if mode not in ("ALIGN_START", "ALIGN_END", "EQUAL_LENGTH"):
+                raise ValueError(f"unknown alignment mode {mode!r}")
+            self._align = mode
+            return self
+
+        def _spec(self, name, col_from, col_to, one_hot, n):
+            if name not in self._readers:
+                raise ValueError(f"no reader named {name!r} — call "
+                                 "addReader/addSequenceReader first")
+            if (col_from is None) != (col_to is None):
+                raise ValueError(
+                    f"reader {name!r}: give BOTH col_from and col_to "
+                    "(inclusive bounds, reference convention) or "
+                    "neither (all columns)")
+            return (name, col_from, col_to, one_hot, n)
+
+        def addInput(self, name: str, col_from=None, col_to=None):
+            self._inputs.append(self._spec(name, col_from, col_to,
+                                           False, None))
+            return self
+
+        def addInputOneHot(self, name: str, column: int, num_classes: int):
+            self._inputs.append(self._spec(name, column, column, True,
+                                           num_classes))
+            return self
+
+        def addOutput(self, name: str, col_from=None, col_to=None):
+            self._outputs.append(self._spec(name, col_from, col_to,
+                                            False, None))
+            return self
+
+        def addOutputOneHot(self, name: str, column: int,
+                            num_classes: int):
+            self._outputs.append(self._spec(name, column, column, True,
+                                            num_classes))
+            return self
+
+        def build(self) -> "RecordReaderMultiDataSetIterator":
+            if not self._inputs or not self._outputs:
+                raise ValueError("need at least one addInput and one "
+                                 "addOutput spec")
+            return RecordReaderMultiDataSetIterator(
+                self._bs, self._readers, self._seq, self._inputs,
+                self._outputs, self._align)
+
+    # -- iteration ------------------------------------------------------
+    def reset(self):
+        for r in self._readers.values():
+            r.reset()
+
+    def hasNext(self) -> bool:
+        return all(r.hasNext() for r in self._readers.values())
+
+    def batch(self) -> int:
+        return self._bs
+
+    def __iter__(self):
+        self.reset()
+        while self.hasNext():
+            yield self.next()
+
+    def _pull(self):
+        """One lock-step batch of raw records per reader."""
+        out = {name: [] for name in self._readers}
+        while self.hasNext() and len(next(iter(out.values()))) < self._bs:
+            for name, r in self._readers.items():
+                out[name].append(r.next())
+        return out
+
+    def _slice(self, rows, col_from, col_to, one_hot, n):
+        mat = np.asarray(rows, dtype=np.float32)
+        if one_hot:
+            return _one_hot(mat[:, col_from], n)
+        if col_from is None:
+            return mat
+        return mat[:, col_from:col_to + 1]
+
+    def _build_arrays(self, raw, specs):
+        arrays, masks, any_mask = [], [], False
+        for name, col_from, col_to, one_hot, n in specs:
+            recs = raw[name]
+            if not self._seq[name]:
+                arrays.append(self._slice(recs, col_from, col_to,
+                                          one_hot, n))
+                masks.append(None)
+                continue
+            lens = [len(s) for s in recs]
+            t_max = max(lens)
+            if self._align == "EQUAL_LENGTH" and len(set(lens)) > 1:
+                raise ValueError(
+                    f"reader {name!r}: EQUAL_LENGTH alignment but "
+                    f"sequence lengths differ ({sorted(set(lens))}); use "
+                    "ALIGN_START or ALIGN_END")
+            per_seq = [self._slice(s, col_from, col_to, one_hot, n)
+                       for s in recs]
+            f = per_seq[0].shape[-1]
+            x = np.zeros((len(recs), t_max, f), np.float32)
+            m = np.zeros((len(recs), t_max), np.float32)
+            for i, (s, ln) in enumerate(zip(per_seq, lens)):
+                if self._align == "ALIGN_END":
+                    x[i, t_max - ln:] = s
+                    m[i, t_max - ln:] = 1.0
+                else:
+                    x[i, :ln] = s
+                    m[i, :ln] = 1.0
+            arrays.append(x)
+            masks.append(m if len(set(lens)) > 1 else None)
+            any_mask = any_mask or len(set(lens)) > 1
+        return arrays, (masks if any_mask else None)
+
+    def next(self) -> MultiDataSet:
+        if not self.hasNext():
+            raise StopIteration("iterator exhausted — call reset()")
+        raw = self._pull()
+        feats, fmasks = self._build_arrays(raw, self._inputs)
+        labs, lmasks = self._build_arrays(raw, self._outputs)
+        return MultiDataSet(features=feats, labels=labs,
+                            features_mask_arrays=fmasks,
+                            labels_mask_arrays=lmasks)

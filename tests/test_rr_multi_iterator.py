@@ -1,0 +1,174 @@
+"""RecordReaderMultiDataSetIterator (reference: deeplearning4j-data
+RecordReaderMultiDataSetIterator — the builder feeding multi-input/
+multi-output ComputationGraphs from named datavec readers)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets import RecordReaderMultiDataSetIterator
+from deeplearning4j_tpu.datavec.records import CollectionRecordReader
+
+
+class _SeqReader(CollectionRecordReader):
+    """Collection of sequences: record = [T][F]."""
+
+
+def _flat_reader(rows):
+    return CollectionRecordReader(rows)
+
+
+class TestBuilderSpecs:
+    def test_columns_and_one_hot(self):
+        rows = [[0.1, 0.2, 0.3, 1], [0.4, 0.5, 0.6, 0],
+                [0.7, 0.8, 0.9, 2], [1.0, 1.1, 1.2, 1]]
+        it = (RecordReaderMultiDataSetIterator.Builder(2)
+              .addReader("r", _flat_reader(rows).initialize())
+              .addInput("r", 0, 2)
+              .addOutputOneHot("r", 3, 3)
+              .build())
+        mds = it.next()
+        assert mds.features[0].shape == (2, 3)
+        np.testing.assert_allclose(mds.features[0][0], [0.1, 0.2, 0.3])
+        assert mds.labels[0].shape == (2, 3)
+        np.testing.assert_array_equal(mds.labels[0][0], [0, 1, 0])
+        mds2 = it.next()
+        np.testing.assert_array_equal(mds2.labels[0][0], [0, 0, 1])
+        assert not it.hasNext()
+
+    def test_two_readers_lock_step(self):
+        a = [[1.0, 0], [2.0, 1], [3.0, 0], [4.0, 1]]
+        b = [[10.0], [20.0], [30.0], [40.0]]
+        it = (RecordReaderMultiDataSetIterator.Builder(2)
+              .addReader("a", _flat_reader(a).initialize())
+              .addReader("b", _flat_reader(b).initialize())
+              .addInput("a", 0, 0)
+              .addInput("b")
+              .addOutputOneHot("a", 1, 2)
+              .build())
+        mds = it.next()
+        assert mds.numFeatureArrays() == 2
+        np.testing.assert_allclose(mds.features[0].ravel(), [1.0, 2.0])
+        np.testing.assert_allclose(mds.features[1].ravel(), [10.0, 20.0])
+
+    def test_unknown_reader_and_empty_specs_raise(self):
+        with pytest.raises(ValueError, match="no reader named"):
+            (RecordReaderMultiDataSetIterator.Builder(2)
+             .addInput("missing"))
+        with pytest.raises(ValueError, match="addInput"):
+            (RecordReaderMultiDataSetIterator.Builder(2)
+             .addReader("r", _flat_reader([[1.0]]).initialize())
+             .build())
+
+    def test_reset_supports_epochs(self):
+        rows = [[1.0, 0], [2.0, 1]]
+        it = (RecordReaderMultiDataSetIterator.Builder(2)
+              .addReader("r", _flat_reader(rows).initialize())
+              .addInput("r", 0, 0).addOutputOneHot("r", 1, 2)
+              .build())
+        first = it.next().features[0]
+        it.reset()
+        np.testing.assert_array_equal(first, it.next().features[0])
+
+
+class TestSequenceAlignment:
+    def _ragged(self):
+        s1 = [[1.0, 0], [2.0, 0], [3.0, 1]]          # T=3
+        s2 = [[4.0, 1], [5.0, 0]]                    # T=2
+        return _SeqReader([s1, s2]).initialize()
+
+    def test_align_start_pads_end_with_masks(self):
+        it = (RecordReaderMultiDataSetIterator.Builder(2)
+              .addSequenceReader("s", self._ragged())
+              .addInput("s", 0, 0)
+              .addOutputOneHot("s", 1, 2)
+              .sequenceAlignmentMode("ALIGN_START")
+              .build())
+        mds = it.next()
+        x = mds.features[0]
+        assert x.shape == (2, 3, 1)
+        np.testing.assert_allclose(x[1].ravel(), [4.0, 5.0, 0.0])
+        m = mds.features_mask_arrays[0]
+        np.testing.assert_array_equal(m, [[1, 1, 1], [1, 1, 0]])
+        assert mds.labels[0].shape == (2, 3, 2)
+
+    def test_align_end_pads_start(self):
+        it = (RecordReaderMultiDataSetIterator.Builder(2)
+              .addSequenceReader("s", self._ragged())
+              .addInput("s", 0, 0)
+              .addOutputOneHot("s", 1, 2)
+              .sequenceAlignmentMode("ALIGN_END")
+              .build())
+        mds = it.next()
+        np.testing.assert_allclose(mds.features[0][1].ravel(),
+                                   [0.0, 4.0, 5.0])
+        np.testing.assert_array_equal(mds.features_mask_arrays[0][1],
+                                      [0, 1, 1])
+
+    def test_equal_length_mode_rejects_ragged(self):
+        it = (RecordReaderMultiDataSetIterator.Builder(2)
+              .addSequenceReader("s", self._ragged())
+              .addInput("s", 0, 0)
+              .addOutputOneHot("s", 1, 2)
+              .sequenceAlignmentMode("EQUAL_LENGTH")
+              .build())
+        with pytest.raises(ValueError, match="EQUAL_LENGTH"):
+            it.next()
+
+    def test_uniform_lengths_produce_no_masks(self):
+        s = _SeqReader([[[1.0, 0], [2.0, 1]],
+                        [[3.0, 1], [4.0, 0]]]).initialize()
+        it = (RecordReaderMultiDataSetIterator.Builder(2)
+              .addSequenceReader("s", s)
+              .addInput("s", 0, 0).addOutputOneHot("s", 1, 2)
+              .build())
+        mds = it.next()
+        assert not mds.features_mask_arrays
+
+
+class TestEndToEndGraphFit:
+    def test_two_input_graph_trains(self):
+        from deeplearning4j_tpu.learning import Adam
+        from deeplearning4j_tpu.nn.conf import (
+            DenseLayer, InputType, OutputLayer,
+        )
+        from deeplearning4j_tpu.nn.graph import (
+            ComputationGraph, ComputationGraphConfiguration, MergeVertex,
+        )
+
+        rng = np.random.RandomState(0)
+        n = 64
+        a = rng.randn(n, 3).astype(np.float64)
+        bcol = rng.randn(n, 2).astype(np.float64)
+        lab = ((a.sum(1) + bcol.sum(1)) > 0).astype(int)
+        rows_a = np.hstack([a, lab[:, None]]).tolist()
+        rows_b = bcol.tolist()
+
+        it = (RecordReaderMultiDataSetIterator.Builder(16)
+              .addReader("a", _flat_reader(rows_a).initialize())
+              .addReader("b", _flat_reader(rows_b).initialize())
+              .addInput("a", 0, 2)
+              .addInput("b")
+              .addOutputOneHot("a", 3, 2)
+              .build())
+
+        gb = (ComputationGraphConfiguration.graphBuilder()
+              .seed(1).updater(Adam(learning_rate=0.02))
+              .addInputs("ina", "inb")
+              .setInputTypes(InputType.feedForward(3),
+                             InputType.feedForward(2)))
+        gb.addLayer("da", DenseLayer(n_out=8, activation="relu"), "ina")
+        gb.addLayer("db", DenseLayer(n_out=8, activation="relu"), "inb")
+        gb.addVertex("m", MergeVertex(), "da", "db")
+        gb.addLayer("out", OutputLayer(n_out=2, activation="softmax",
+                                       loss="mcxent"), "m")
+        net = ComputationGraph(gb.setOutputs("out").build()).init()
+        net.fit(it, epochs=30)
+        outs = net.output(a.astype(np.float32), bcol.astype(np.float32))
+        acc = (np.asarray(outs[0].toNumpy()).argmax(1) == lab).mean()
+        assert acc > 0.9, acc
+
+    def test_single_bound_spec_rejected(self):
+        b = (RecordReaderMultiDataSetIterator.Builder(2)
+             .addReader("r", _flat_reader([[1.0, 2.0]]).initialize()))
+        with pytest.raises(ValueError, match="BOTH col_from and col_to"):
+            b.addInput("r", 1)
