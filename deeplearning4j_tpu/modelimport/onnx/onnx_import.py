@@ -1156,6 +1156,147 @@ def _mean_nary(ctx):
     return ctx.op("mul", [out, inv])
 
 
+# ------------------------------------------------ breadth (round 4, pt 2)
+@R("Celu")
+def _celu(ctx):
+    return ctx.op("celu", ctx.inputs[:1],
+                  alpha=float(ctx.attr("alpha", 1.0)))
+
+
+@R("Shrink")
+def _shrink(ctx):
+    return ctx.op("shrink", ctx.inputs[:1],
+                  lambd=float(ctx.attr("lambd", 0.5)),
+                  bias=float(ctx.attr("bias", 0.0)))
+
+
+@R("Hardmax")
+def _hardmax(ctx):
+    return ctx.op("hardmax", ctx.inputs[:1],
+                  axis=int(ctx.attr("axis", -1)))
+
+
+@R("LpNormalization")
+def _lp_normalization(ctx):
+    axis = int(ctx.attr("axis", -1))
+    p = int(ctx.attr("p", 2))
+    if p == 2:
+        return ctx.op("l2_normalize", ctx.inputs[:1], axis=axis)
+    if p != 1:
+        raise OnnxImportError(
+            f"{ctx.node.name}: LpNormalization supports p=1 or 2, "
+            f"got {p}")
+    norm = ctx.op("reduce_sum", [ctx.op("abs", ctx.inputs[:1])],
+                  dimensions=[axis], keep_dims=True)
+    return ctx.op("div", [ctx.inputs[0], norm])
+
+
+@R("MeanVarianceNormalization")
+def _mvn(ctx):
+    axes = ctx.attr("axes", [0, 2, 3])
+    return ctx.op("mean_variance_norm", ctx.inputs[:1],
+                  axes=tuple(int(a) for a in axes))
+
+
+@R("EyeLike")
+def _eye_like(ctx):
+    aval = ctx.avals.get(ctx.inputs[0].name) if ctx.avals else None
+    if aval is None or len(aval.shape) != 2:
+        raise OnnxImportError(
+            f"{ctx.node.name}: EyeLike needs a known 2-D input shape")
+    k = int(ctx.attr("k", 0))
+    dt_attr = ctx.attr("dtype")
+    # ONNX TensorProto.DataType enum; default = input dtype
+    dtype = ({1: np.float32, 6: np.int32, 7: np.int64, 9: np.bool_,
+              11: np.float64}.get(int(dt_attr), np.float32)
+             if dt_attr is not None else np.dtype(aval.dtype))
+    return ctx.sd.constant(
+        ctx.node.output[0] + "_eye",
+        np.eye(aval.shape[0], aval.shape[1], k, dtype=dtype))
+
+
+@R("BitShift")
+def _bit_shift(ctx):
+    d = ctx.attr("direction")
+    if d not in ("LEFT", "RIGHT"):
+        raise OnnxImportError(
+            f"{ctx.node.name}: BitShift direction must be LEFT/RIGHT")
+    our = "shift_left" if d == "LEFT" else "shift_right"
+    return ctx.op(our, ctx.inputs[:2])
+
+
+@R("Det")
+def _det(ctx):
+    return ctx.op("matrix_determinant", ctx.inputs[:1])
+
+
+@R("LpPool")
+def _lp_pool(ctx):
+    k = [int(v) for v in ctx.attr("kernel_shape")]
+    strides = [int(v) for v in ctx.attr("strides", [1] * len(k))]
+    pads = [int(v) for v in ctx.attr("pads", [0] * 2 * len(k))]
+    dil = [int(v) for v in ctx.attr("dilations", [1] * len(k))]
+    if any(pads) or int(ctx.attr("ceil_mode", 0)) \
+            or any(d != 1 for d in dil):
+        raise OnnxImportError(
+            f"{ctx.node.name}: LpPool with explicit pads, ceil_mode or "
+            "dilations not supported")
+    p = int(ctx.attr("p", 2))
+    x = ctx.to_nhwc(ctx.inputs[0])
+    out = ctx.op("pnormpool2d", [x], kernel=tuple(k),
+                 strides=tuple(strides), padding="VALID", p=p)
+    return ctx.to_nchw(out)
+
+
+@R("GlobalLpPool")
+def _global_lp_pool(ctx):
+    # spec: (sum |x|^p)^(1/p) over spatial dims — the ABS matters for
+    # odd p on negative inputs
+    p = int(ctx.attr("p", 2))
+    powed = ctx.op("pow", [ctx.op("abs", ctx.inputs[:1]),
+                           ctx.sd.constant(ctx.node.output[0] + "_p",
+                                           np.float32(p))])
+    s = ctx.op("reduce_sum", [powed], dimensions=[2, 3], keep_dims=True)
+    return ctx.op("pow", [s, ctx.sd.constant(
+        ctx.node.output[0] + "_ip", np.float32(1.0 / p))])
+
+
+@R("GridSample")
+def _grid_sample(ctx):
+    mode = ctx.attr("mode", "bilinear")
+    if mode == "linear":  # opset-20 rename
+        mode = "bilinear"
+    pad = ctx.attr("padding_mode", "zeros")
+    if mode not in ("bilinear", "nearest") or pad not in ("zeros",
+                                                          "border"):
+        raise OnnxImportError(
+            f"{ctx.node.name}: GridSample mode={mode!r}/"
+            f"padding_mode={pad!r} not supported")
+    x = ctx.to_nhwc(ctx.inputs[0])
+    out = ctx.op("grid_sample", [x, ctx.inputs[1]], mode=mode,
+                 padding_mode=pad,
+                 align_corners=bool(ctx.attr("align_corners", 0)))
+    return ctx.to_nchw(out)
+
+
+@R("DequantizeLinear")
+def _dequantize_linear(ctx):
+    ins = [v for v in ctx.inputs[:3] if v is not None]
+    return ctx.op("dequantize_linear", ins,
+                  axis=int(ctx.attr("axis", 1)))
+
+
+@R("QuantizeLinear")
+def _quantize_linear(ctx):
+    ins = [v for v in ctx.inputs[:3] if v is not None]
+    # output range follows the zero-point dtype; static zp decides
+    zp = ctx.maybe_static(2)
+    qmin, qmax = (-128, 127) if (zp is not None
+                                 and zp.dtype == np.int8) else (0, 255)
+    return ctx.op("quantize_linear", ins, axis=int(ctx.attr("axis", 1)),
+                  qmin=qmin, qmax=qmax)
+
+
 # ---------------------------------------------------------------- import
 def _propagate_onnx(sd, const_vals, avals, from_idx: int) -> None:
     """Shape/dtype eval for ops emitted since from_idx, plus eager

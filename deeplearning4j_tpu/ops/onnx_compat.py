@@ -5,6 +5,7 @@ registry; the importer module only maps nodes onto these names)."""
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 from deeplearning4j_tpu.ops.registry import register_op
@@ -36,3 +37,102 @@ def onnx_slice(x, starts, ends, axes, steps):
         idx[ax] = slice(st, en, sp)
     return x[tuple(idx)]
 # (broadcast_to: canonical registration lives in ops/shape.py)
+
+
+@register_op("hardmax")
+def hardmax(x, axis=-1):
+    """ONNX Hardmax: 1.0 at the (first) argmax along axis, else 0."""
+    idx = jnp.argmax(x, axis=axis)
+    return jax.nn.one_hot(idx, x.shape[axis], axis=axis, dtype=x.dtype)
+
+
+@register_op("shrink")
+def shrink(x, lambd=0.5, bias=0.0):
+    """ONNX Shrink: x+bias if x < -lambd; x-bias if x > lambd; else 0."""
+    return jnp.where(x < -lambd, x + bias,
+                     jnp.where(x > lambd, x - bias,
+                               jnp.zeros((), x.dtype)))
+
+
+@register_op("mean_variance_norm")
+def mean_variance_norm(x, axes=(0, 2, 3), eps=1e-9):
+    """ONNX MeanVarianceNormalization: (x - mean) / std over axes."""
+    m = jnp.mean(x, axis=tuple(axes), keepdims=True)
+    v = jnp.var(x, axis=tuple(axes), keepdims=True)
+    return (x - m) * jax.lax.rsqrt(v + eps)
+
+
+def _per_axis_qparams(x, scale, zero_point, axis):
+    """Broadcast ONNX per-tensor or per-axis quantization params against
+    x. A scalar zero_point (incl. the omitted-input default 0) stays
+    scalar even when scale is per-axis — it broadcasts fine."""
+    scale = jnp.asarray(scale)
+    zp = jnp.asarray(0 if zero_point is None else zero_point)
+    if scale.ndim == 1 and scale.shape[0] > 1:
+        shape = [1] * x.ndim
+        shape[axis] = scale.shape[0]
+        scale = scale.reshape(shape)
+        if zp.size > 1:
+            zp = zp.reshape(shape)
+    return scale, zp
+
+
+@register_op("quantize_linear")
+def quantize_linear(x, scale, zero_point=None, axis=1, qmin=0, qmax=255):
+    """ONNX QuantizeLinear: saturate(round(x/scale) + zero_point)."""
+    scale, zp = _per_axis_qparams(x, scale, zero_point, axis)
+    q = jnp.round(x / scale) + zp.astype(jnp.float32)
+    return jnp.clip(q, qmin, qmax).astype(
+        jnp.uint8 if qmin == 0 else jnp.int8)
+
+
+@register_op("dequantize_linear")
+def dequantize_linear(x, scale, zero_point=None, axis=1):
+    """ONNX DequantizeLinear: (x - zero_point) * scale — the QDQ-format
+    entry point (quantized exports import as float through this)."""
+    scale, zp = _per_axis_qparams(x, scale, zero_point, axis)
+    return (x.astype(jnp.float32) - zp.astype(jnp.float32)) \
+        * scale.astype(jnp.float32)
+
+
+@register_op("grid_sample")
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=False):
+    """ONNX/torch GridSample, NHWC x + [N,Hout,Wout,2] grid of (x,y) in
+    [-1,1]. Bilinear or nearest; zeros or border padding. Indices are
+    traced VALUES (static shapes), so XLA lowers this to gathers."""
+    n, h, w, c = x.shape
+    gx, gy = grid[..., 0], grid[..., 1]
+    if align_corners:
+        fx = (gx + 1) * 0.5 * (w - 1)
+        fy = (gy + 1) * 0.5 * (h - 1)
+    else:
+        fx = ((gx + 1) * w - 1) * 0.5
+        fy = ((gy + 1) * h - 1) * 0.5
+
+    def sample(iy, ix):
+        iyc = jnp.clip(iy, 0, h - 1)
+        ixc = jnp.clip(ix, 0, w - 1)
+        batch = jnp.arange(n).reshape(n, 1, 1)
+        vals = x[batch, iyc, ixc]          # [N,Hout,Wout,C]
+        if padding_mode == "zeros":
+            ok = ((iy >= 0) & (iy <= h - 1) & (ix >= 0)
+                  & (ix <= w - 1))[..., None]
+            vals = jnp.where(ok, vals, jnp.zeros((), x.dtype))
+        return vals
+
+    if mode == "nearest":
+        return sample(jnp.round(fy).astype(jnp.int32),
+                      jnp.round(fx).astype(jnp.int32))
+    y0 = jnp.floor(fy)
+    x0 = jnp.floor(fx)
+    wy = (fy - y0)[..., None].astype(x.dtype)
+    wx = (fx - x0)[..., None].astype(x.dtype)
+    y0i, x0i = y0.astype(jnp.int32), x0.astype(jnp.int32)
+    v00 = sample(y0i, x0i)
+    v01 = sample(y0i, x0i + 1)
+    v10 = sample(y0i + 1, x0i)
+    v11 = sample(y0i + 1, x0i + 1)
+    top = v00 * (1 - wx) + v01 * wx
+    bot = v10 * (1 - wx) + v11 * wx
+    return top * (1 - wy) + bot * wy

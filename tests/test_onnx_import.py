@@ -49,7 +49,8 @@ def _iv(field: int, v: int) -> bytes:
 
 def tensor(name: str, arr: np.ndarray) -> bytes:
     dt = {np.dtype(np.float32): 1, np.dtype(np.int64): 7,
-          np.dtype(np.int32): 6}[arr.dtype]
+          np.dtype(np.int32): 6, np.dtype(np.uint8): 2,
+          np.dtype(np.int8): 3}[arr.dtype]
     out = b"".join(_iv(1, d) for d in arr.shape)
     out += _iv(2, dt)
     out += _str(8, name)
@@ -285,9 +286,8 @@ def attr_str(name: str, s: str) -> bytes:
     return _str(1, name) + _ld(4, s.encode()) + _iv(20, 3)
 
 
-class TestOnnxBreadthRound4:
-    """Round-4 mapper batch: the common exported-model op tail
-    (reference: samediff-import-onnx's mapper set spans these)."""
+class _SingleNodeGo:
+    """Shared helper: build a one-node graph, import, compare."""
 
     def _go(self, op, attrs, feeds, inits, want, extra_inputs=(),
             n_out=1, rtol=1e-5, atol=1e-6):
@@ -304,6 +304,11 @@ class TestOnnxBreadthRound4:
         for o, w in zip(self._onames, want if n_out > 1 else [want]):
             np.testing.assert_allclose(np.asarray(got[o]), w, rtol=rtol,
                                        atol=atol)
+
+
+class TestOnnxBreadthRound4(_SingleNodeGo):
+    """Round-4 mapper batch: the common exported-model op tail
+    (reference: samediff-import-onnx's mapper set spans these)."""
 
     def test_split_equal_and_uneven(self):
         x = np.arange(12, dtype=np.float32).reshape(2, 6)
@@ -651,3 +656,127 @@ class TestOnnxBreadthRound4:
         m = np.asarray([[3., 1., 2.], [0., 5., 4.]], np.float32)
         self._go("ArgMin", [attr_int("axis", 1)], {"m": m}, [],
                  np.argmin(m, 1, keepdims=True))
+
+
+class TestOnnxBreadthRound4Pt2(_SingleNodeGo):
+    """Second mapper tail: activations/norms/pools/quantization/
+    GridSample (reference: samediff-import-onnx covers these op
+    classes)."""
+
+    def test_celu_shrink_hardmax(self):
+        import torch
+
+        x = np.linspace(-2, 2, 12, dtype=np.float32).reshape(3, 4)
+        self._go("Celu", [attr_float("alpha", 0.7)], {"x": x}, [],
+                 torch.celu(torch.tensor(x), 0.7).numpy())
+        lam, bias = 0.5, 0.1
+        want = np.where(x < -lam, x + bias,
+                        np.where(x > lam, x - bias, 0.0)).astype(np.float32)
+        self._go("Shrink", [attr_float("lambd", lam),
+                            attr_float("bias", bias)], {"x": x}, [], want)
+        hm = np.zeros_like(x)
+        hm[np.arange(3), x.argmax(1)] = 1.0
+        self._go("Hardmax", [attr_int("axis", 1)], {"x": x}, [], hm)
+
+    def test_lp_normalization(self):
+        rs = np.random.RandomState(8)
+        x = rs.randn(4, 6).astype(np.float32)
+        self._go("LpNormalization", [attr_int("axis", 1), attr_int("p", 2)],
+                 {"x": x}, [],
+                 x / np.linalg.norm(x, axis=1, keepdims=True))
+        self._go("LpNormalization", [attr_int("axis", 1), attr_int("p", 1)],
+                 {"x": x}, [],
+                 x / np.abs(x).sum(1, keepdims=True))
+
+    def test_mvn_and_eyelike_and_det(self):
+        rs = np.random.RandomState(9)
+        x = rs.randn(2, 3, 4, 5).astype(np.float32)
+        m = x.mean(axis=(0, 2, 3), keepdims=True)
+        v = x.var(axis=(0, 2, 3), keepdims=True)
+        self._go("MeanVarianceNormalization", [], {"x": x}, [],
+                 (x - m) / np.sqrt(v + 1e-9), rtol=1e-4, atol=1e-5)
+        e = rs.randn(3, 5).astype(np.float32)
+        self._go("EyeLike", [attr_int("k", 1)], {"x": e}, [],
+                 np.eye(3, 5, 1, dtype=np.float32))
+        d = rs.randn(4, 3, 3).astype(np.float32)
+        self._go("Det", [], {"x": d}, [], np.linalg.det(d),
+                 rtol=1e-3, atol=1e-4)
+
+    def test_bit_shift(self):
+        x = np.asarray([[1, 2, 4, 255]], np.int32)
+        s = np.asarray([[1, 2, 1, 3]], np.int32)
+        self._go("BitShift", [attr_str("direction", "LEFT")],
+                 {"x": x, "s": s}, [], x << s)
+        self._go("BitShift", [attr_str("direction", "RIGHT")],
+                 {"x": x, "s": s}, [], x >> s)
+
+    def test_lp_pool_matches_torch(self):
+        import torch
+
+        rs = np.random.RandomState(10)
+        x = rs.randn(2, 3, 6, 8).astype(np.float32)
+        want = torch.nn.functional.lp_pool2d(
+            torch.tensor(x), 2, (2, 2), stride=(2, 2)).numpy()
+        self._go("LpPool", [attr_ints("kernel_shape", [2, 2]),
+                            attr_ints("strides", [2, 2]),
+                            attr_int("p", 2)],
+                 {"x": x}, [], want, rtol=1e-4, atol=1e-5)
+        glob = (np.abs(x) ** 3).sum(axis=(2, 3), keepdims=True) ** (1 / 3)
+        self._go("GlobalLpPool", [attr_int("p", 3)], {"x": x}, [], glob,
+                 rtol=1e-4, atol=1e-4)
+
+    def test_grid_sample_matches_torch(self):
+        import torch
+
+        rs = np.random.RandomState(12)
+        x = rs.randn(2, 3, 5, 6).astype(np.float32)
+        grid = rs.uniform(-1.2, 1.2, (2, 4, 7, 2)).astype(np.float32)
+        for mode in ("bilinear", "nearest"):
+            for ac in (0, 1):
+                want = torch.nn.functional.grid_sample(
+                    torch.tensor(x), torch.tensor(grid), mode=mode,
+                    padding_mode="zeros",
+                    align_corners=bool(ac)).numpy()
+                self._go("GridSample",
+                         [attr_str("mode", mode),
+                          attr_str("padding_mode", "zeros"),
+                          attr_int("align_corners", ac)],
+                         {"x": x, "g": grid}, [], want,
+                         rtol=1e-4, atol=1e-5)
+
+    def test_quantize_dequantize_round_trip(self):
+        x = np.asarray([[0.0, 0.4, 1.0, -1.0, 3.2]], np.float32)
+        scale = np.asarray(0.05, np.float32)
+        zp = np.asarray(10, np.uint8)
+        q = np.clip(np.round(x / 0.05) + 10, 0, 255).astype(np.uint8)
+        self._go("QuantizeLinear", [], {"x": x},
+                 [tensor("sc", scale), tensor("zp", zp)], q,
+                 extra_inputs=["sc", "zp"])
+        self._go("DequantizeLinear", [], {"q": q},
+                 [tensor("sc", scale), tensor("zp", zp)],
+                 (q.astype(np.float32) - 10) * 0.05,
+                 extra_inputs=["sc", "zp"])
+
+    def test_per_axis_dequantize_without_zero_point(self):
+        q = np.arange(24, dtype=np.uint8).reshape(1, 3, 2, 4)
+        scale = np.asarray([0.1, 0.2, 0.3], np.float32)
+        want = q.astype(np.float32) * scale.reshape(1, 3, 1, 1)
+        self._go("DequantizeLinear", [attr_int("axis", 1)], {"q": q},
+                 [tensor("sc", scale)], want, extra_inputs=["sc"],
+                 rtol=1e-6, atol=1e-6)
+
+    def test_lp_pool_ceil_mode_rejected(self):
+        x = np.zeros((1, 1, 7, 7), np.float32)
+        g = graph([node("LpPool", ["x"], ["y"], "lp",
+                        attrs=[attr_ints("kernel_shape", [2, 2]),
+                               attr_ints("strides", [2, 2]),
+                               attr_int("ceil_mode", 1)])], [],
+                  [value_info("x", [1, 1, 7, 7])],
+                  [value_info("y", [])])
+        with pytest.raises(OnnxImportError, match="ceil_mode"):
+            OnnxImport.importGraph(model(g))
+
+    def test_eyelike_int_dtype(self):
+        e = np.zeros((3, 3), np.float32)
+        self._go("EyeLike", [attr_int("dtype", 7)], {"x": e}, [],
+                 np.eye(3, dtype=np.int64))
