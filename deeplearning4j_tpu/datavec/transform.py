@@ -169,7 +169,42 @@ class _Step:
                 return Schema(cols + [_ColumnMeta(p["new_column"],
                                                   ColumnType.DOUBLE)])
             return s
+        # ---- sequence steps (reference: transform/sequence/**) ----
+        if k == "convertToSequence":
+            for c in (p["key_column"], p["sort_column"]):
+                if not s.hasColumn(c):
+                    raise KeyError(f"convertToSequence: unknown column "
+                                   f"{c!r}")
+            return s
+        if k == "offsetSequence":
+            for c in p["columns"]:
+                if not s.hasColumn(c):
+                    raise KeyError(f"offsetSequence: unknown column {c!r}")
+            if p.get("op", "InPlace") == "NewColumn":
+                extra = [_ColumnMeta(f"{c}_offset{p['offset']}",
+                                     ColumnType.DOUBLE)
+                         for c in p["columns"]]
+                return Schema(cols + extra)
+            return s
+        if k == "sequenceMovingWindowReduce":
+            if not s.hasColumn(p["column"]):
+                raise KeyError("sequenceMovingWindowReduce: unknown "
+                               f"column {p['column']!r}")
+            new = f"{p['column']}[{p['op'].lower()},{p['window']}]"
+            return Schema(cols + [_ColumnMeta(new, ColumnType.DOUBLE)])
+        if k == "sequenceDifference":
+            if not s.hasColumn(p["column"]):
+                raise KeyError(f"sequenceDifference: unknown column "
+                               f"{p['column']!r}")
+            return s
+        if k == "trimSequence":
+            return s
         raise ValueError(f"unknown step kind {k!r}")
+
+    #: step kinds that operate on ONE SEQUENCE's table at a time
+    SEQUENCE_KINDS = frozenset({"offsetSequence",
+                                "sequenceMovingWindowReduce",
+                                "sequenceDifference", "trimSequence"})
 
     # execution -------------------------------------------------------
     def apply(self, table: Table, s: Schema) -> Table:
@@ -272,7 +307,66 @@ class _Step:
             return out
         if k == "custom":
             return p["fn"](dict(table))
+        if k == "convertToSequence":
+            return dict(table)  # grouping handled by TransformProcess
+        if k in _Step.SEQUENCE_KINDS:
+            return self.apply_seq(table, s)
         raise ValueError(f"unknown step kind {k!r}")
+
+    def apply_seq(self, table: Table, s: Schema) -> Table:
+        """Apply a sequence step to ONE sequence's table (rows = time
+        steps, in order). Reference: transform/sequence/** —
+        OffsetSequenceTransform, SequenceMovingWindowReduceTransform,
+        SequenceDifferenceTransform, sequence trim."""
+        k, p = self.kind, self.params
+        n = len(next(iter(table.values()))) if table else 0
+        if k == "offsetSequence":
+            # positive offset = lag: value at step t comes from t-offset;
+            # steps lacking a source row are TRIMMED from the sequence
+            off = int(p["offset"])
+            new_col = p.get("op", "InPlace") == "NewColumn"
+            out = dict(table)
+            lo, hi = max(0, off), n + min(0, off)
+            if hi <= lo:
+                return {c: v[:0] for c, v in out.items()}
+            for c in p["columns"]:
+                src = table[c]
+                shifted = src[lo - off:hi - off]
+                if new_col:
+                    out[f"{c}_offset{off}"] = shifted.astype(np.float64)
+                else:
+                    out[c] = shifted
+            # trim every other column to the surviving window
+            for c in out:
+                if len(out[c]) != hi - lo:
+                    out[c] = out[c][lo:hi]
+            return out
+        if k == "sequenceMovingWindowReduce":
+            col = table[p["column"]].astype(np.float64)
+            w = int(p["window"])
+            fns = {"Mean": np.mean, "Sum": np.sum, "Min": np.min,
+                   "Max": np.max, "Stdev": np.std}
+            fn = fns[p["op"]]
+            red = np.array([fn(col[max(0, t - w + 1):t + 1])
+                            for t in range(n)])
+            out = dict(table)
+            out[f"{p['column']}[{p['op'].lower()},{w}]"] = red
+            return out
+        if k == "sequenceDifference":
+            lag = int(p.get("lag", 1))
+            col = table[p["column"]].astype(np.float64)
+            d = np.zeros_like(col)
+            if n > lag:
+                d[lag:] = col[lag:] - col[:-lag]
+            out = dict(table)
+            out[p["column"]] = d
+            return out
+        if k == "trimSequence":
+            m = int(p["num_steps"])
+            sl = slice(m, None) if p.get("from_start", True) \
+                else slice(None, max(0, n - m))
+            return {c: v[sl] for c, v in table.items()}
+        raise ValueError(f"not a sequence step: {k!r}")
 
 
 # ---------------------------------------------------------------- process
@@ -283,6 +377,7 @@ class TransformProcess:
         self.initial_schema = initial_schema
         self.steps = list(steps)
         self.final_schema = self._infer()
+        self._convert_index()  # validate sequence-step ordering early
 
     def _infer(self) -> Schema:
         s = self.initial_schema
@@ -290,15 +385,87 @@ class TransformProcess:
             s = st.out_schema(s)
         return s
 
+    def _convert_index(self):
+        idx = [i for i, st in enumerate(self.steps)
+               if st.kind == "convertToSequence"]
+        if len(idx) > 1:
+            raise ValueError("at most one convertToSequence per process")
+        ci = idx[0] if idx else None
+        if ci is not None:
+            early = [st.kind for st in self.steps[:ci]
+                     if st.kind in _Step.SEQUENCE_KINDS]
+            if early:
+                raise ValueError(
+                    f"sequence steps {early} appear BEFORE "
+                    "convertToSequence — they would run on the flat "
+                    "ungrouped table; move them after the conversion")
+        return ci
+
     # execution over records or a columnar table
-    def execute(self, records: Sequence[Sequence]) -> List[List]:
+    def execute(self, records: Sequence[Sequence]):
+        """Flat records in; flat records out — or, when the chain has a
+        convertToSequence step (reference semantics), a LIST OF
+        SEQUENCES out (each a list of per-timestep records)."""
+        ci = self._convert_index()
+        if ci is None:
+            if any(st.kind in _Step.SEQUENCE_KINDS for st in self.steps):
+                raise ValueError(
+                    "chain contains sequence steps but no "
+                    "convertToSequence — use executeSequences() on "
+                    "already-grouped sequences, or add "
+                    "convertToSequence(key, sort)")
+            table = self.executeColumnar(self._to_table(records))
+            return self._rows(table, self.final_schema)
+        # flat prefix -> group by key (ordered by sort col) -> per-seq
+        s = self.initial_schema
         table = self._to_table(records)
-        table = self.executeColumnar(table)
-        names = self.final_schema.getColumnNames()
+        for st in self.steps[:ci]:
+            table = st.apply(table, s)
+            s = st.out_schema(s)
+        key_c = self.steps[ci].params["key_column"]
+        sort_c = self.steps[ci].params["sort_column"]
+        keys = table[key_c]
+        out = []
+        for key in dict.fromkeys(keys.tolist()):  # first-seen order
+            rows = np.nonzero(keys == key)[0]
+            seq = {c: v[rows] for c, v in table.items()}
+            order = np.argsort(seq[sort_c], kind="stable")
+            seq = {c: v[order] for c, v in seq.items()}
+            s2 = s
+            for st in self.steps[ci + 1:]:
+                seq = st.apply(seq, s2)
+                s2 = st.out_schema(s2)
+            out.append(self._rows(seq, self.final_schema))
+        return out
+
+    def executeSequences(self, sequences):
+        """Apply the whole chain to each already-grouped sequence
+        (list of per-timestep records). The chain must not contain
+        convertToSequence."""
+        if self._convert_index() is not None:
+            raise ValueError("executeSequences: chain already groups via "
+                             "convertToSequence — use execute() on flat "
+                             "records")
+        out = []
+        for seq in sequences:
+            table = self.executeColumnar(self._to_table(seq))
+            out.append(self._rows(table, self.final_schema))
+        return out
+
+    def _rows(self, table: Table, schema: Schema) -> List[List]:
+        names = schema.getColumnNames()
         n = len(next(iter(table.values()))) if table else 0
         return [[table[c][i] for c in names] for i in range(n)]
 
     def executeColumnar(self, table: Table) -> Table:
+        """Apply the chain to one columnar table. Sequence steps treat
+        the WHOLE table as a single ordered sequence (this is how
+        executeSequences drives each sequence); a chain that needs
+        grouping (convertToSequence) must go through execute()."""
+        if self._convert_index() is not None:
+            raise ValueError(
+                "chain contains convertToSequence — grouped execution "
+                "is required; use execute() on flat records")
         s = self.initial_schema
         for st in self.steps:
             table = st.apply(table, s)
@@ -409,6 +576,43 @@ class TransformProcess:
                                              condition: Condition):
             return self._add("conditionalReplaceValue", column=column,
                              value=value, condition=condition)
+
+        # ---- sequence ops (reference: transform/sequence/**) ----
+        def convertToSequence(self, key_column: str, sort_column: str):
+            """Group flat records into per-key sequences ordered by
+            sort_column (reference: TransformProcess.Builder
+            #convertToSequence)."""
+            return self._add("convertToSequence", key_column=key_column,
+                             sort_column=sort_column)
+
+        def offsetSequence(self, columns, offset: int, op: str = "InPlace"):
+            """Shift columns in time by ``offset`` steps (positive =
+            lag). Steps without a source row are trimmed. op:
+            "InPlace" or "NewColumn" (adds ``{col}_offset{n}``)."""
+            if op not in ("InPlace", "NewColumn"):
+                raise ValueError(f"offsetSequence op {op!r}")
+            return self._add("offsetSequence", columns=list(columns),
+                             offset=int(offset), op=op)
+
+        def sequenceMovingWindowReduce(self, column: str, window: int,
+                                       op: str = "Mean"):
+            """Trailing-window rolling reduce -> new column
+            ``{column}[{op},{window}]`` (partial leading windows)."""
+            return self._add("sequenceMovingWindowReduce", column=column,
+                             window=int(window), op=op)
+
+        def sequenceDifference(self, column: str, lag: int = 1):
+            """x_t - x_{t-lag} in place; the first ``lag`` steps become
+            0 (reference SequenceDifferenceTransform default mode)."""
+            if int(lag) < 1:
+                raise ValueError(f"sequenceDifference lag must be >= 1, "
+                                 f"got {lag}")
+            return self._add("sequenceDifference", column=column,
+                             lag=int(lag))
+
+        def trimSequence(self, num_steps: int, from_start: bool = True):
+            return self._add("trimSequence", num_steps=int(num_steps),
+                             from_start=bool(from_start))
 
         def transform(self, fn: Callable[[Table], Table]):
             """Escape hatch: arbitrary vectorized table→table fn (not

@@ -1250,13 +1250,18 @@ def _lp_pool(ctx):
 
 @R("GlobalLpPool")
 def _global_lp_pool(ctx):
-    # spec: (sum |x|^p)^(1/p) over spatial dims — the ABS matters for
-    # odd p on negative inputs
+    # spec: (sum |x|^p)^(1/p) over ALL dims from 2 on (N,C,spatial...)
+    # — the ABS matters for odd p on negative inputs
+    aval = ctx.avals.get(ctx.inputs[0].name) if ctx.avals else None
+    if aval is None:
+        raise OnnxImportError(
+            f"{ctx.node.name}: GlobalLpPool needs a known input rank")
     p = int(ctx.attr("p", 2))
     powed = ctx.op("pow", [ctx.op("abs", ctx.inputs[:1]),
                            ctx.sd.constant(ctx.node.output[0] + "_p",
                                            np.float32(p))])
-    s = ctx.op("reduce_sum", [powed], dimensions=[2, 3], keep_dims=True)
+    s = ctx.op("reduce_sum", [powed],
+               dimensions=list(range(2, len(aval.shape))), keep_dims=True)
     return ctx.op("pow", [s, ctx.sd.constant(
         ctx.node.output[0] + "_ip", np.float32(1.0 / p))])
 
@@ -1289,10 +1294,17 @@ def _dequantize_linear(ctx):
 @R("QuantizeLinear")
 def _quantize_linear(ctx):
     ins = [v for v in ctx.inputs[:3] if v is not None]
-    # output range follows the zero-point dtype; static zp decides
+    # output range follows the zero-point dtype (spec default uint8
+    # when omitted); the dtype is knowable from avals even when the
+    # value itself is not a static initializer
+    zp_dtype = None
     zp = ctx.maybe_static(2)
-    qmin, qmax = (-128, 127) if (zp is not None
-                                 and zp.dtype == np.int8) else (0, 255)
+    if zp is not None:
+        zp_dtype = zp.dtype
+    elif len(ctx.inputs) > 2 and ctx.inputs[2] is not None and ctx.avals:
+        aval = ctx.avals.get(ctx.inputs[2].name)
+        zp_dtype = np.dtype(aval.dtype) if aval is not None else None
+    qmin, qmax = (-128, 127) if zp_dtype == np.int8 else (0, 255)
     return ctx.op("quantize_linear", ins, axis=int(ctx.attr("axis", 1)),
                   qmin=qmin, qmax=qmax)
 

@@ -631,3 +631,105 @@ class TestAsyncOverlap:
         assert ColorConversionTransform("gray")(img, rng).shape == img.shape
         with pytest.raises(ValueError, match="3 channels"):
             ColorConversionTransform("hsv")(img, rng)
+
+
+class TestSequenceTransforms:
+    """Sequence transform steps (reference: datavec transform/sequence/**
+    — convertToSequence, OffsetSequenceTransform,
+    SequenceMovingWindowReduce, SequenceDifferenceTransform, trim)."""
+
+    def _schema(self):
+        return (Schema.Builder()
+                .addColumnDouble("key")
+                .addColumnDouble("t")
+                .addColumnDouble("x")
+                .build())
+
+    def test_convert_to_sequence_groups_and_sorts(self):
+        recs = [[1, 2, 30.0], [0, 0, 1.0], [1, 0, 10.0], [0, 1, 2.0],
+                [1, 1, 20.0]]
+        tp = (TransformProcess.Builder(self._schema())
+              .convertToSequence("key", "t")
+              .build())
+        seqs = tp.execute(recs)
+        assert len(seqs) == 2
+        # first-seen key order: 1 then 0; each sorted by t
+        assert [r[2] for r in seqs[0]] == [10.0, 20.0, 30.0]
+        assert [r[2] for r in seqs[1]] == [1.0, 2.0]
+
+    def test_offset_lag_trims_and_new_column(self):
+        recs = [[0, t, float(10 * t)] for t in range(5)]
+        tp = (TransformProcess.Builder(self._schema())
+              .convertToSequence("key", "t")
+              .offsetSequence(["x"], 2, op="NewColumn")
+              .build())
+        (seq,) = tp.execute(recs)
+        # 2 leading steps trimmed; new col holds x from t-2
+        assert len(seq) == 3
+        names = tp.final_schema.getColumnNames()
+        xi, oi = names.index("x"), names.index("x_offset2")
+        assert [r[xi] for r in seq] == [20.0, 30.0, 40.0]
+        assert [r[oi] for r in seq] == [0.0, 10.0, 20.0]
+
+    def test_moving_window_mean_and_difference(self):
+        recs = [[0, t, v] for t, v in enumerate([1.0, 3.0, 5.0, 7.0])]
+        tp = (TransformProcess.Builder(self._schema())
+              .convertToSequence("key", "t")
+              .sequenceMovingWindowReduce("x", 2, "Mean")
+              .sequenceDifference("x")
+              .build())
+        (seq,) = tp.execute(recs)
+        names = tp.final_schema.getColumnNames()
+        mi = names.index("x[mean,2]")
+        xi = names.index("x")
+        assert [r[mi] for r in seq] == [1.0, 2.0, 4.0, 6.0]
+        assert [r[xi] for r in seq] == [0.0, 2.0, 2.0, 2.0]
+
+    def test_trim_and_execute_sequences_direct(self):
+        tp = (TransformProcess.Builder(self._schema())
+              .trimSequence(1, from_start=True)
+              .build())
+        seqs = tp.executeSequences([[[0, 0, 1.0], [0, 1, 2.0]],
+                                    [[1, 0, 3.0], [1, 1, 4.0],
+                                     [1, 2, 5.0]]])
+        assert [len(s) for s in seqs] == [1, 2]
+        assert seqs[1][0][2] == 4.0
+
+    def test_sequence_step_without_convert_raises(self):
+        tp = (TransformProcess.Builder(self._schema())
+              .sequenceDifference("x")
+              .build())
+        with pytest.raises(ValueError, match="convertToSequence"):
+            tp.execute([[0, 0, 1.0]])
+        with pytest.raises(ValueError, match="executeSequences"):
+            (TransformProcess.Builder(self._schema())
+             .convertToSequence("key", "t").build()
+             .executeSequences([[[0, 0, 1.0]]]))
+
+    def test_json_round_trip(self):
+        tp = (TransformProcess.Builder(self._schema())
+              .convertToSequence("key", "t")
+              .offsetSequence(["x"], 1)
+              .sequenceMovingWindowReduce("x", 3, "Max")
+              .trimSequence(1)
+              .build())
+        tp2 = TransformProcess.fromJson(tp.toJson())
+        assert tp2.toJson() == tp.toJson()
+        recs = [[0, t, float(t)] for t in range(4)]
+        assert tp2.execute(recs) == tp.execute(recs)
+
+    def test_sequence_step_before_convert_rejected(self):
+        with pytest.raises(ValueError, match="BEFORE"):
+            (TransformProcess.Builder(self._schema())
+             .sequenceDifference("x")
+             .convertToSequence("key", "t")
+             .build()).execute([[0, 0, 1.0]])
+        with pytest.raises(ValueError, match="lag"):
+            TransformProcess.Builder(self._schema()) \
+                .sequenceDifference("x", lag=0)
+
+    def test_execute_to_array_rejects_grouping_chain(self):
+        tp = (TransformProcess.Builder(self._schema())
+              .convertToSequence("key", "t").build())
+        with pytest.raises(ValueError, match="execute\\(\\)"):
+            tp.executeToArray([[0, 0, 1.0]])
