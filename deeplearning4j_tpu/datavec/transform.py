@@ -327,16 +327,17 @@ class _Step:
             new_col = p.get("op", "InPlace") == "NewColumn"
             out = dict(table)
             lo, hi = max(0, off), n + min(0, off)
-            if hi <= lo:
-                return {c: v[:0] for c, v in out.items()}
+            lo, hi = min(lo, n), max(min(hi, n), min(lo, n))
             for c in p["columns"]:
                 src = table[c]
-                shifted = src[lo - off:hi - off]
+                shifted = src[lo - off:hi - off] if hi > lo else src[:0]
                 if new_col:
                     out[f"{c}_offset{off}"] = shifted.astype(np.float64)
                 else:
                     out[c] = shifted
-            # trim every other column to the surviving window
+            # trim every other column to the surviving window (a
+            # fully-trimmed sequence still carries ALL schema columns,
+            # as length-0 arrays — downstream steps index them)
             for c in out:
                 if len(out[c]) != hi - lo:
                     out[c] = out[c][lo:hi]
@@ -347,8 +348,16 @@ class _Step:
             fns = {"Mean": np.mean, "Sum": np.sum, "Min": np.min,
                    "Max": np.max, "Stdev": np.std}
             fn = fns[p["op"]]
-            red = np.array([fn(col[max(0, t - w + 1):t + 1])
-                            for t in range(n)])
+            if n >= w > 0:
+                # vectorized trailing windows; the first w-1 steps use
+                # partial (shorter) windows
+                full = fn(np.lib.stride_tricks.sliding_window_view(
+                    col, w), axis=-1)
+                head = np.array([fn(col[:t + 1]) for t in range(w - 1)])
+                red = np.concatenate([head, full])
+            else:
+                red = np.array([fn(col[max(0, t - w + 1):t + 1])
+                                for t in range(n)])
             out = dict(table)
             out[f"{p['column']}[{p['op'].lower()},{w}]"] = red
             return out
@@ -424,13 +433,28 @@ class TransformProcess:
             s = st.out_schema(s)
         key_c = self.steps[ci].params["key_column"]
         sort_c = self.steps[ci].params["sort_column"]
-        keys = table[key_c]
+        keys = np.asarray(table[key_c])
+        if keys.dtype.kind == "f" and np.isnan(keys).any():
+            raise ValueError(
+                f"convertToSequence: key column {key_c!r} contains NaN "
+                "— NaN keys cannot be grouped; clean or filter them "
+                "first")
+        # one vectorized grouping pass (not one scan per key):
+        # unique+inverse labels every row, argsort over labels groups
+        # them, and first-seen order is restored from first indices
+        uniq, first_idx, inv = np.unique(keys, return_index=True,
+                                         return_inverse=True)
+        seen_rank = np.argsort(np.argsort(first_idx))  # uniq -> order
+        order = np.lexsort((np.arange(len(keys)), seen_rank[inv]))
+        bounds = np.flatnonzero(np.diff(seen_rank[inv][order],
+                                        prepend=-1))
         out = []
-        for key in dict.fromkeys(keys.tolist()):  # first-seen order
-            rows = np.nonzero(keys == key)[0]
-            seq = {c: v[rows] for c, v in table.items()}
-            order = np.argsort(seq[sort_c], kind="stable")
-            seq = {c: v[order] for c, v in seq.items()}
+        for gi in range(len(uniq)):
+            rows = order[bounds[gi]:
+                         bounds[gi + 1] if gi + 1 < len(uniq) else None]
+            seq = {c: np.asarray(v)[rows] for c, v in table.items()}
+            so = np.argsort(seq[sort_c], kind="stable")
+            seq = {c: v[so] for c, v in seq.items()}
             s2 = s
             for st in self.steps[ci + 1:]:
                 seq = st.apply(seq, s2)
@@ -598,6 +622,12 @@ class TransformProcess:
                                        op: str = "Mean"):
             """Trailing-window rolling reduce -> new column
             ``{column}[{op},{window}]`` (partial leading windows)."""
+            if op not in ("Mean", "Sum", "Min", "Max", "Stdev"):
+                raise ValueError(
+                    f"sequenceMovingWindowReduce op {op!r} (use "
+                    "Mean/Sum/Min/Max/Stdev)")
+            if int(window) < 1:
+                raise ValueError(f"window must be >= 1, got {window}")
             return self._add("sequenceMovingWindowReduce", column=column,
                              window=int(window), op=op)
 

@@ -1,0 +1,245 @@
+"""Causal language model (GPT-style) with KV-cache generation.
+
+Parity note: the reference has no decoder-only LM; this is an
+EXTENSION in the same spirit as the ring/Ulysses and MoE recipes —
+the model families a reference user graduates to. What makes it
+TPU-native:
+
+- training = one jit step, causal masking inside the same fused
+  attention (flash attention's ``causal=True`` path measured in
+  BASELINE.md);
+- generation = ``lax.scan`` over decode steps with a STATIC-shape KV
+  cache ([L, N, H, max_len, hd], position-masked) — no dynamic shapes,
+  no per-token dispatch; one compiled program generates the whole
+  continuation;
+- the decode step is pinned against the recompute-everything forward
+  in tests (cache correctness is asserted, not assumed).
+
+Architecture: pre-LN transformer decoder, learned positions, tied
+embedding LM head (weights follow models/transformer.py conventions).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deeplearning4j_tpu.models.transformer import TransformerConfig
+
+
+class CausalLM:
+    def __init__(self, config: TransformerConfig,
+                 compute_dtype=jnp.bfloat16):
+        self.cfg = config
+        self._cdtype = compute_dtype
+        # jit cache for generate(): keyed on the shape/config tuple so
+        # repeated calls reuse the compiled prefill+decode program
+        # (a fresh @jax.jit closure per call would retrace every time)
+        self._gen_cache: Dict[Any, Any] = {}
+
+    # -- params ---------------------------------------------------------
+    def init_params(self, key=None) -> Dict[str, Any]:
+        cfg = self.cfg
+        key = key if key is not None else jax.random.key(0)
+        d, f = cfg.d_model, cfg.d_ff
+        ks = jax.random.split(key, 2 + cfg.n_layers)
+
+        def norm(k, shape, scale):
+            return (jax.random.normal(k, shape, jnp.float32)
+                    * scale).astype(jnp.float32)
+
+        p = {"tok_emb": norm(ks[0], (cfg.vocab_size, d), 0.02),
+             "pos_emb": norm(ks[1], (cfg.max_len, d), 0.01),
+             "ln_f": {"g": jnp.ones((d,), jnp.float32),
+                      "b": jnp.zeros((d,), jnp.float32)},
+             "layers": []}
+        for li in range(cfg.n_layers):
+            lk = jax.random.split(ks[2 + li], 4)
+            p["layers"].append({
+                "ln1": {"g": jnp.ones((d,), jnp.float32),
+                        "b": jnp.zeros((d,), jnp.float32)},
+                "wqkv": norm(lk[0], (d, 3 * d), 0.02),
+                "bqkv": jnp.zeros((3 * d,), jnp.float32),
+                "wo": norm(lk[1], (d, d), 0.02 / (2 * cfg.n_layers) ** 0.5),
+                "bo": jnp.zeros((d,), jnp.float32),
+                "ln2": {"g": jnp.ones((d,), jnp.float32),
+                        "b": jnp.zeros((d,), jnp.float32)},
+                "w1": norm(lk[2], (d, f), 0.02),
+                "b1": jnp.zeros((f,), jnp.float32),
+                "w2": norm(lk[3], (f, d), 0.02 / (2 * cfg.n_layers) ** 0.5),
+                "b2": jnp.zeros((d,), jnp.float32),
+            })
+        return p
+
+    # -- shared pieces --------------------------------------------------
+    def _ln(self, x, p):
+        m = jnp.mean(x, axis=-1, keepdims=True)
+        v = jnp.var(x, axis=-1, keepdims=True)
+        return ((x - m) * lax.rsqrt(v + 1e-5) * p["g"].astype(x.dtype)
+                + p["b"].astype(x.dtype))
+
+    def _heads(self, y, n, t):
+        cfg = self.cfg
+        return y.reshape(n, t, cfg.n_heads, cfg.head_dim) \
+                .transpose(0, 2, 1, 3)
+
+    # -- training forward ----------------------------------------------
+    def forward(self, params, ids, train=False, rng=None):
+        """ids [N,T] -> logits [N,T,V] (causal)."""
+        cfg = self.cfg
+        cd = self._cdtype
+        n, t = ids.shape
+        x = params["tok_emb"].astype(cd)[ids] \
+            + params["pos_emb"].astype(cd)[None, :t]
+        causal = jnp.tril(jnp.ones((t, t), bool))[None, None]
+        scale = 1.0 / jnp.sqrt(jnp.asarray(cfg.head_dim, cd))
+        keys = (jax.random.split(rng, cfg.n_layers)
+                if (train and rng is not None) else [None] * cfg.n_layers)
+        for lp, k in zip(params["layers"], keys):
+            h = self._ln(x, lp["ln1"])
+            qkv = h @ lp["wqkv"].astype(cd) + lp["bqkv"].astype(cd)
+            q, kk, v = (self._heads(y, n, t)
+                        for y in jnp.split(qkv, 3, axis=-1))
+            logits = jnp.einsum("nhqd,nhkd->nhqk", q, kk) * scale
+            neg = jnp.asarray(jnp.finfo(logits.dtype).min, logits.dtype)
+            logits = jnp.where(causal, logits, neg)
+            w = jax.nn.softmax(logits, axis=-1)
+            ctx = jnp.einsum("nhqk,nhkd->nhqd", w, v)
+            ctx = ctx.transpose(0, 2, 1, 3).reshape(n, t, cfg.d_model)
+            att = ctx @ lp["wo"].astype(cd) + lp["bo"].astype(cd)
+            if train and k is not None and cfg.dropout > 0:
+                k, sub = jax.random.split(k)
+                keep = 1.0 - cfg.dropout
+                att = att * jax.random.bernoulli(sub, keep,
+                                                 att.shape) / keep
+            x = x + att
+            h = self._ln(x, lp["ln2"])
+            mid = jax.nn.gelu(h @ lp["w1"].astype(cd)
+                              + lp["b1"].astype(cd))
+            out = mid @ lp["w2"].astype(cd) + lp["b2"].astype(cd)
+            x = x + out
+        x = self._ln(x, params["ln_f"])
+        return x @ params["tok_emb"].astype(cd).T
+
+    def lm_loss(self, params, ids, train=True, rng=None):
+        """Next-token cross entropy over ids[:, :-1] -> ids[:, 1:]."""
+        logits = self.forward(params, ids[:, :-1], train, rng)
+        targets = ids[:, 1:]
+        lse = jax.scipy.special.logsumexp(
+            logits.astype(jnp.float32), axis=-1)
+        picked = jnp.take_along_axis(
+            logits.astype(jnp.float32), targets[..., None],
+            axis=-1)[..., 0]
+        return jnp.mean(lse - picked)
+
+    def make_train_step(self, updater):
+        from deeplearning4j_tpu.learning.updaters import apply_updater
+
+        @jax.jit
+        def step(params, opt_state, it_step, ids, rng):
+            loss, grads = jax.value_and_grad(
+                lambda p: self.lm_loss(p, ids, True, rng))(params)
+            updates, new_opt = apply_updater(updater, opt_state, grads,
+                                             params, it_step)
+            new_p = jax.tree_util.tree_map(lambda p, u: p - u, params,
+                                           updates)
+            return new_p, new_opt, loss
+
+        return step
+
+    # -- KV-cache generation --------------------------------------------
+    def _decode_one(self, params, ck, cv, pos, tok):
+        """One decode step. tok [N] int32 at position ``pos``; ck/cv
+        [L,N,H,max_len,hd]. Returns (logits [N,V], new ck, cv)."""
+        cfg = self.cfg
+        cd = self._cdtype
+        n = tok.shape[0]
+        x = params["tok_emb"].astype(cd)[tok] \
+            + params["pos_emb"].astype(cd)[pos]
+        scale = 1.0 / jnp.sqrt(jnp.asarray(cfg.head_dim, cd))
+        valid = (jnp.arange(cfg.max_len) <= pos)[None, None, None, :]
+        for li, lp in enumerate(params["layers"]):
+            h = self._ln(x, lp["ln1"])
+            qkv = h @ lp["wqkv"].astype(cd) + lp["bqkv"].astype(cd)
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+            hs = lambda y: y.reshape(n, cfg.n_heads, 1, cfg.head_dim)
+            q, k, v = hs(q), hs(k), hs(v)
+            ck = lax.dynamic_update_slice(ck, k[None], (li, 0, 0, pos, 0))
+            cv = lax.dynamic_update_slice(cv, v[None], (li, 0, 0, pos, 0))
+            logits = jnp.einsum("nhqd,nhkd->nhqk", q, ck[li]) * scale
+            neg = jnp.asarray(jnp.finfo(logits.dtype).min, logits.dtype)
+            logits = jnp.where(valid, logits, neg)
+            w = jax.nn.softmax(logits, axis=-1)
+            ctx = jnp.einsum("nhqk,nhkd->nhqd", w, cv[li])
+            ctx = ctx.reshape(n, cfg.d_model)
+            x = x + ctx @ lp["wo"].astype(cd) + lp["bo"].astype(cd)
+            h = self._ln(x, lp["ln2"])
+            x = x + jax.nn.gelu(
+                h @ lp["w1"].astype(cd) + lp["b1"].astype(cd)) \
+                @ lp["w2"].astype(cd) + lp["b2"].astype(cd)
+        x = self._ln(x, params["ln_f"])
+        return (x @ params["tok_emb"].astype(cd).T).astype(jnp.float32), \
+            ck, cv
+
+    def generate(self, params, prompt_ids, max_new_tokens: int,
+                 temperature: float = 0.0,
+                 rng: Optional[jax.Array] = None):
+        """Greedy (temperature 0) or sampled continuation. The WHOLE
+        loop — prompt prefill + max_new_tokens decode steps — is one
+        jit-compiled lax.scan program with a static-shape KV cache."""
+        cfg = self.cfg
+        prompt_ids = jnp.asarray(prompt_ids, jnp.int32)
+        n, t0 = prompt_ids.shape
+        if t0 + max_new_tokens > cfg.max_len:
+            raise ValueError(
+                f"prompt ({t0}) + max_new_tokens ({max_new_tokens}) "
+                f"exceeds max_len ({cfg.max_len})")
+        rng = rng if rng is not None else jax.random.key(0)
+        cache_key = (n, t0, max_new_tokens, float(temperature))
+        if cache_key in self._gen_cache:
+            return self._gen_cache[cache_key](params, prompt_ids, rng)
+
+        @jax.jit
+        def run(params, prompt, rng):
+            shape = (cfg.n_layers, n, cfg.n_heads, cfg.max_len,
+                     cfg.head_dim)
+            ck = jnp.zeros(shape, self._cdtype)
+            cv = jnp.zeros(shape, self._cdtype)
+
+            def prefill(carry, i):
+                ck, cv = carry
+                _, ck, cv = self._decode_one(params, ck, cv, i,
+                                             prompt[:, i])
+                return (ck, cv), None
+
+            # feed all but the last prompt token into the cache; the
+            # last one seeds the decode loop
+            (ck, cv), _ = lax.scan(prefill, (ck, cv),
+                                   jnp.arange(t0 - 1))
+
+            def decode(carry, i):
+                ck, cv, tok, key = carry
+                pos = t0 - 1 + i
+                logits, ck, cv = self._decode_one(params, ck, cv, pos,
+                                                  tok)
+                key, sub = jax.random.split(key)
+                if temperature > 0.0:
+                    nxt = jax.random.categorical(
+                        sub, logits / temperature, axis=-1)
+                else:
+                    nxt = jnp.argmax(logits, axis=-1)
+                nxt = nxt.astype(jnp.int32)
+                return (ck, cv, nxt, key), nxt
+
+            init = (ck, cv, prompt[:, t0 - 1], rng)
+            _, toks = lax.scan(decode, init, jnp.arange(max_new_tokens))
+            return toks.transpose(1, 0)  # [N, max_new]
+
+        self._gen_cache[cache_key] = run
+        return run(params, prompt_ids, rng)
+
+    def num_params(self, params) -> int:
+        return sum(int(v.size) for v in jax.tree_util.tree_leaves(params))

@@ -733,3 +733,42 @@ class TestSequenceTransforms:
               .convertToSequence("key", "t").build())
         with pytest.raises(ValueError, match="execute\\(\\)"):
             tp.executeToArray([[0, 0, 1.0]])
+
+    def test_offset_new_column_survives_full_trim(self):
+        # a key with fewer rows than the offset: the sequence empties
+        # but the declared new column must still exist (length 0)
+        recs = [[0, 0, 1.0], [0, 1, 2.0], [1, 0, 9.0]]
+        tp = (TransformProcess.Builder(self._schema())
+              .convertToSequence("key", "t")
+              .offsetSequence(["x"], 2, op="NewColumn")
+              .sequenceMovingWindowReduce("x_offset2", 2)
+              .build())
+        seqs = tp.execute(recs)
+        assert [len(s) for s in seqs] == [0, 0]
+
+    def test_nan_keys_rejected(self):
+        tp = (TransformProcess.Builder(self._schema())
+              .convertToSequence("key", "t").build())
+        with pytest.raises(ValueError, match="NaN"):
+            tp.execute([[float("nan"), 0, 1.0]])
+
+    def test_invalid_window_op_rejected_at_build(self):
+        with pytest.raises(ValueError, match="Median"):
+            TransformProcess.Builder(self._schema()) \
+                .sequenceMovingWindowReduce("x", 3, "Median")
+
+    def test_large_window_reduce_vectorized_path(self):
+        # n >= w exercises the sliding_window_view path; check against
+        # the naive definition
+        rng = np.random.default_rng(0)
+        vals = rng.normal(size=50)
+        recs = [[0, t, float(v)] for t, v in enumerate(vals)]
+        tp = (TransformProcess.Builder(self._schema())
+              .convertToSequence("key", "t")
+              .sequenceMovingWindowReduce("x", 7, "Max")
+              .build())
+        (seq,) = tp.execute(recs)
+        names = tp.final_schema.getColumnNames()
+        mi = names.index("x[max,7]")
+        want = [vals[max(0, t - 6):t + 1].max() for t in range(50)]
+        np.testing.assert_allclose([r[mi] for r in seq], want)

@@ -64,6 +64,10 @@ class TestExamples:
         ret = _run("rl_async_a3c.py").main(updates=800)
         assert ret > 0.9   # both async learners solve the 3x3 grid
 
+    def test_timeseries_sequence_etl_example(self):
+        acc = _run("timeseries_sequence_etl.py").main(epochs=20)
+        assert acc > 0.9
+
     def test_vae_anomaly_example(self):
         flagged = _run("vae_anomaly.py").main(steps=150)
         assert flagged > 0.9  # far-out samples score below the threshold
