@@ -326,8 +326,8 @@ class _Step:
             off = int(p["offset"])
             new_col = p.get("op", "InPlace") == "NewColumn"
             out = dict(table)
-            lo, hi = max(0, off), n + min(0, off)
-            lo, hi = min(lo, n), max(min(hi, n), min(lo, n))
+            lo = min(max(0, off), n)        # clamp to the sequence
+            hi = max(n + min(0, off), lo)   # empty window, not negative
             for c in p["columns"]:
                 src = table[c]
                 shifted = src[lo - off:hi - off] if hi > lo else src[:0]

@@ -5,10 +5,11 @@ EXTENSION in the same spirit as the ring/Ulysses and MoE recipes —
 the model families a reference user graduates to. What makes it
 TPU-native:
 
-- training = one jit step, causal masking inside the same fused
-  attention (flash attention's ``causal=True`` path measured in
-  BASELINE.md);
-- generation = ``lax.scan`` over decode steps with a STATIC-shape KV
+- training = one jit step with in-graph causal masking (plain fused
+  einsum attention; at these sequence lengths XLA's fusion covers it —
+  ``ops/flash_attention`` remains the opt-in for long sequences);
+- generation = batched PARALLEL prefill (one forward pass writes the
+  whole prompt's K/V) + ``lax.scan`` decode over a STATIC-shape KV
   cache ([L, N, H, max_len, hd], position-masked) — no dynamic shapes,
   no per-token dispatch; one compiled program generates the whole
   continuation;
@@ -87,8 +88,11 @@ class CausalLM:
                 .transpose(0, 2, 1, 3)
 
     # -- training forward ----------------------------------------------
-    def forward(self, params, ids, train=False, rng=None):
-        """ids [N,T] -> logits [N,T,V] (causal)."""
+    def forward(self, params, ids, train=False, rng=None,
+                return_kv=False):
+        """ids [N,T] -> logits [N,T,V] (causal). With return_kv, also
+        returns the per-layer K/V stacks [L,N,H,T,hd] (the parallel
+        prefill path of generate())."""
         cfg = self.cfg
         cd = self._cdtype
         n, t = ids.shape
@@ -98,11 +102,15 @@ class CausalLM:
         scale = 1.0 / jnp.sqrt(jnp.asarray(cfg.head_dim, cd))
         keys = (jax.random.split(rng, cfg.n_layers)
                 if (train and rng is not None) else [None] * cfg.n_layers)
+        all_k, all_v = [], []
         for lp, k in zip(params["layers"], keys):
             h = self._ln(x, lp["ln1"])
             qkv = h @ lp["wqkv"].astype(cd) + lp["bqkv"].astype(cd)
             q, kk, v = (self._heads(y, n, t)
                         for y in jnp.split(qkv, 3, axis=-1))
+            if return_kv:
+                all_k.append(kk)
+                all_v.append(v)
             logits = jnp.einsum("nhqd,nhkd->nhqk", q, kk) * scale
             neg = jnp.asarray(jnp.finfo(logits.dtype).min, logits.dtype)
             logits = jnp.where(causal, logits, neg)
@@ -122,7 +130,10 @@ class CausalLM:
             out = mid @ lp["w2"].astype(cd) + lp["b2"].astype(cd)
             x = x + out
         x = self._ln(x, params["ln_f"])
-        return x @ params["tok_emb"].astype(cd).T
+        logits = x @ params["tok_emb"].astype(cd).T
+        if return_kv:
+            return logits, jnp.stack(all_k), jnp.stack(all_v)
+        return logits
 
     def lm_loss(self, params, ids, train=True, rng=None):
         """Next-token cross entropy over ids[:, :-1] -> ids[:, 1:]."""
@@ -202,42 +213,46 @@ class CausalLM:
         if cache_key in self._gen_cache:
             return self._gen_cache[cache_key](params, prompt_ids, rng)
 
+        def sample(key, logits):
+            if temperature > 0.0:
+                nxt = jax.random.categorical(key, logits / temperature,
+                                             axis=-1)
+            else:
+                nxt = jnp.argmax(logits, axis=-1)
+            return nxt.astype(jnp.int32)
+
         @jax.jit
         def run(params, prompt, rng):
             shape = (cfg.n_layers, n, cfg.n_heads, cfg.max_len,
                      cfg.head_dim)
-            ck = jnp.zeros(shape, self._cdtype)
-            cv = jnp.zeros(shape, self._cdtype)
-
-            def prefill(carry, i):
-                ck, cv = carry
-                _, ck, cv = self._decode_one(params, ck, cv, i,
-                                             prompt[:, i])
-                return (ck, cv), None
-
-            # feed all but the last prompt token into the cache; the
-            # last one seeds the decode loop
-            (ck, cv), _ = lax.scan(prefill, (ck, cv),
-                                   jnp.arange(t0 - 1))
+            # PARALLEL prefill: one batched forward over the whole
+            # prompt writes every position's K/V at once (MXU-shaped
+            # matmuls), instead of t0 serial single-token steps
+            logits_p, ks, vs = self.forward(params, prompt,
+                                            return_kv=True)
+            ck = jnp.zeros(shape, self._cdtype).at[:, :, :, :t0].set(ks)
+            cv = jnp.zeros(shape, self._cdtype).at[:, :, :, :t0].set(vs)
+            rng0, rng1 = jax.random.split(rng)
+            first = sample(rng0, logits_p[:, -1].astype(jnp.float32))
 
             def decode(carry, i):
                 ck, cv, tok, key = carry
-                pos = t0 - 1 + i
-                logits, ck, cv = self._decode_one(params, ck, cv, pos,
-                                                  tok)
+                # tok is generated token i, sitting at position t0+i
+                logits, ck, cv = self._decode_one(params, ck, cv,
+                                                  t0 + i, tok)
                 key, sub = jax.random.split(key)
-                if temperature > 0.0:
-                    nxt = jax.random.categorical(
-                        sub, logits / temperature, axis=-1)
-                else:
-                    nxt = jnp.argmax(logits, axis=-1)
-                nxt = nxt.astype(jnp.int32)
+                nxt = sample(sub, logits)
                 return (ck, cv, nxt, key), nxt
 
-            init = (ck, cv, prompt[:, t0 - 1], rng)
-            _, toks = lax.scan(decode, init, jnp.arange(max_new_tokens))
-            return toks.transpose(1, 0)  # [N, max_new]
+            if max_new_tokens == 1:
+                return first[:, None]
+            _, toks = lax.scan(decode, (ck, cv, first, rng1),
+                               jnp.arange(max_new_tokens - 1))
+            return jnp.concatenate([first[:, None],
+                                    toks.transpose(1, 0)], axis=1)
 
+        if len(self._gen_cache) >= 8:   # bound compiled-program growth
+            self._gen_cache.pop(next(iter(self._gen_cache)))
         self._gen_cache[cache_key] = run
         return run(params, prompt_ids, rng)
 

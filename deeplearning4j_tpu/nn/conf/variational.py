@@ -26,6 +26,7 @@ sequential sample loop.
 from __future__ import annotations
 
 import dataclasses
+import math
 from typing import Optional, Sequence, Tuple
 
 import jax
@@ -37,7 +38,10 @@ from deeplearning4j_tpu.nn.conf.inputs import InputType
 from deeplearning4j_tpu.nn.conf.layers import Layer, _act
 from deeplearning4j_tpu.nn.weights import WeightInit, init_weights
 
-_LOG2PI = float(jnp.log(2.0 * jnp.pi))
+# math, NOT jnp: a module-level jnp computation would initialise the
+# XLA backend at import time, breaking jax.distributed.initialize()
+# in multi-process workers (they import the package first)
+_LOG2PI = math.log(2.0 * math.pi)
 
 
 def _mlp_init(key, sizes, weight_init, dtype, prefix):

@@ -267,3 +267,21 @@ def test_sharded_checkpoint_kill_and_resume(tmp_path):
                                rtol=1e-6)
     np.testing.assert_allclose(resumed["w_sum"], full["w_sum"],
                                rtol=1e-6)
+
+
+def test_package_import_leaves_backend_uninitialized():
+    """Importing the framework must NOT run any jax computation at
+    module scope: multi-process workers import the package BEFORE
+    calling jax.distributed.initialize(), which jax requires to happen
+    before the XLA backend comes up. (Regression: a module-level
+    jnp.log() constant broke both 2-process tests in this file.)"""
+    code = (
+        "import deeplearning4j_tpu.nn.conf, deeplearning4j_tpu.ops,\\\n"
+        "    deeplearning4j_tpu.models.gpt, deeplearning4j_tpu.datasets\n"
+        "import jax._src.xla_bridge as xb\n"
+        "assert not xb._backends, f'backend initialized: {list(xb._backends)}'\n"
+        "print('CLEAN')\n")
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
+    r = subprocess.run([sys.executable, "-c", code], env=env,
+                       capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0 and "CLEAN" in r.stdout, r.stderr[-2000:]
