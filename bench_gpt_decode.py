@@ -1,0 +1,75 @@
+"""Causal-LM KV-cache decode throughput on the real chip.
+
+Measures models/gpt.py generate() — prefill + N decode steps compiled
+as one lax.scan program — at a GPT-2-small-like config. Methodology
+matches bench.py: device-resident inputs, warmup compile, best-of-k
+windows, device->host read closing each window.
+
+Run: python bench_gpt_decode.py [--layers 12 --d-model 768 ...]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.models.gpt import CausalLM
+from deeplearning4j_tpu.models.transformer import TransformerConfig
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--layers", type=int, default=12)
+    ap.add_argument("--d-model", type=int, default=768)
+    ap.add_argument("--heads", type=int, default=12)
+    ap.add_argument("--d-ff", type=int, default=3072)
+    ap.add_argument("--vocab", type=int, default=32000)
+    ap.add_argument("--batch", type=int, default=32)
+    ap.add_argument("--prompt", type=int, default=128)
+    ap.add_argument("--new", type=int, default=384)
+    ap.add_argument("--reps", type=int, default=5)
+    args = ap.parse_args()
+
+    cfg = TransformerConfig(
+        vocab_size=args.vocab, max_len=args.prompt + args.new,
+        d_model=args.d_model, n_layers=args.layers, n_heads=args.heads,
+        d_ff=args.d_ff, dropout=0.0)
+    m = CausalLM(cfg, compute_dtype=jnp.bfloat16)
+    params = jax.device_put(m.init_params(jax.random.key(0)))
+    rng = np.random.default_rng(0)
+    prompt = jax.device_put(jnp.asarray(
+        rng.integers(0, args.vocab, (args.batch, args.prompt)),
+        jnp.int32))
+
+    t0 = time.perf_counter()
+    out = m.generate(params, prompt, args.new, temperature=1.0,
+                     rng=jax.random.key(1))
+    np.asarray(out[0, -1])  # device->host read
+    compile_s = time.perf_counter() - t0
+
+    best = float("inf")
+    for r in range(args.reps):
+        t0 = time.perf_counter()
+        out = m.generate(params, prompt, args.new, temperature=1.0,
+                         rng=jax.random.key(2 + r))
+        np.asarray(out[0, -1])
+        best = min(best, time.perf_counter() - t0)
+
+    tok_s = args.batch * args.new / best
+    print(json.dumps({
+        "metric": "gpt_decode", "layers": args.layers,
+        "d_model": args.d_model, "batch": args.batch,
+        "prompt": args.prompt, "new_tokens": args.new,
+        "params_m": round(m.num_params(params) / 1e6, 1),
+        "compile_s": round(compile_s, 1),
+        "decode_tokens_per_sec": round(tok_s, 1),
+        "ms_per_step": round(best / args.new * 1e3, 3)}))
+
+
+if __name__ == "__main__":
+    main()
