@@ -33,6 +33,16 @@ def serializable(cls=None):
 def to_dict(obj: Any) -> Any:
     """Recursively convert registered dataclasses to tagged dicts."""
     if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        name = type(obj).__name__
+        if name not in _CLASSES:
+            # fail HERE with the class named, not deep inside
+            # json.dumps (or worse, silently now and at from_json
+            # later) — e.g. LambdaLayer holds a function and is
+            # deliberately not serializable
+            raise TypeError(
+                f"{name} is not JSON-serializable (not @serializable-"
+                "registered); networks containing it cannot round-trip "
+                "to_json()")
         d = {"@class": type(obj).__name__}
         for f in dataclasses.fields(obj):
             d[f.name] = to_dict(getattr(obj, f.name))

@@ -10,7 +10,8 @@ needs NCHW permutes, we don't.
 """
 
 from deeplearning4j_tpu.modelimport.keras.keras_import import (
-    KerasModelImport,
+    KerasModelImport, registerCustomLayer, unregisterCustomLayer,
 )
 
-__all__ = ["KerasModelImport"]
+__all__ = ["KerasModelImport", "registerCustomLayer",
+           "unregisterCustomLayer"]

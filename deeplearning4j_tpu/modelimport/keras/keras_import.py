@@ -125,6 +125,28 @@ def _check_channels_last(cfg: dict, name: str) -> None:
             "(NHWC — the TPU-native layout) is supported")
 
 
+#: user-registered mappers: class_name -> fn(cfg: dict) -> Layer
+#: (reference: KerasLayer.registerCustomLayer / registerLambdaLayer —
+#: the escape hatch for custom layers and Lambda layers, whose Keras
+#: serialization carries no portable function body)
+_CUSTOM_LAYER_MAPPERS: Dict[str, Any] = {}
+
+
+def registerCustomLayer(class_name: str, mapper) -> None:
+    """Register a mapper for a Keras layer class this importer doesn't
+    know (incl. "Lambda" — register a mapper that returns a layer
+    implementing the lambda's computation). ``mapper(cfg)`` receives
+    the layer's Keras config dict and returns a framework Layer.
+    Consulted only AFTER the built-in mappers (reference semantics:
+    custom mappers extend the registry, they cannot shadow built-ins)."""
+    _CUSTOM_LAYER_MAPPERS[class_name] = mapper
+
+
+def unregisterCustomLayer(class_name: str) -> None:
+    """Remove a previously registered custom mapper (no-op if absent)."""
+    _CUSTOM_LAYER_MAPPERS.pop(class_name, None)
+
+
 def _map_layer(class_name: str, cfg: dict, is_last: bool):
     """Keras layer config → (our Layer | 'flatten' | None).
 
@@ -456,9 +478,12 @@ def _map_layer(class_name: str, cfg: dict, is_last: bool):
     if class_name == "AlphaDropout":
         return DropoutLayer(name=name,
                             rate=AlphaDropout(float(cfg.get("rate", 0.5))))
+    if class_name in _CUSTOM_LAYER_MAPPERS:
+        return _CUSTOM_LAYER_MAPPERS[class_name](cfg)
     raise UnsupportedKerasConfigurationException(
-        f"no mapper for Keras layer {class_name!r} "
-        "(reference parity: KerasLayer registry)")
+        f"no mapper for Keras layer {class_name!r} — for custom or "
+        "Lambda layers, registerCustomLayer(class_name, mapper) "
+        "(reference parity: KerasLayer.registerCustomLayer)")
 
 
 # --------------------------------------------------------------- weights

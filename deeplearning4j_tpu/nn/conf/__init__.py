@@ -19,6 +19,7 @@ from deeplearning4j_tpu.nn.conf.layers_extra import (
     Convolution3D, Cropping1D, Cropping2D, Cropping3D, Deconvolution2D,
     Deconvolution3D,
     DepthwiseConvolution2D, ElementWiseMultiplicationLayer, GravesBidirectionalLSTM, GRU,
+    LambdaLayer,
     LocallyConnected1D, LocallyConnected2D, MaskLayer, MaskZeroLayer,
     PReLULayer, PrimaryCapsules, RepeatVector, SpaceToBatchLayer,
     SpaceToDepthLayer, Subsampling1DLayer, Subsampling3DLayer, Upsampling1D,
@@ -59,7 +60,7 @@ __all__ = [
     "Cropping3D", "Deconvolution2D", "Deconvolution3D",
     "DepthwiseConvolution2D",
     "ElementWiseMultiplicationLayer", "GravesBidirectionalLSTM", "GRU",
-    "LocallyConnected1D",
+    "LambdaLayer", "LocallyConnected1D",
     "LocallyConnected2D", "MaskLayer", "MaskZeroLayer", "PReLULayer",
     "PrimaryCapsules", "RepeatVector", "SpaceToBatchLayer",
     "SpaceToDepthLayer", "Subsampling1DLayer", "Subsampling3DLayer",

@@ -977,6 +977,34 @@ class GravesBidirectionalLSTM(Layer):
 # ----------------------------------------------------------------------
 # structural layers (Keras import parity: Permute / Reshape)
 # ----------------------------------------------------------------------
+@dataclasses.dataclass
+class LambdaLayer(Layer):
+    """User-defined stateless layer from a jax-traceable function
+    (reference: SameDiffLambdaLayer — defineLayer over SDVariables;
+    here the function is plain jax, traced into the same compiled
+    step as everything else).
+
+    ``fn(x) -> y`` must be pure/traceable. ``output_type_fn``
+    (InputType -> InputType) defaults to shape-preserving. NOT
+    JSON-serializable (a function has no portable config) — same
+    restriction the reference's lambda layers have; model serde of a
+    network containing one raises at to_json()."""
+
+    fn: Any = None
+    output_type_fn: Any = None
+
+    def has_params(self):
+        return False
+
+    def output_type(self, it: InputType) -> InputType:
+        return self.output_type_fn(it) if self.output_type_fn else it
+
+    def apply(self, params, state, x, train, rng):
+        if self.fn is None:
+            raise ValueError("LambdaLayer needs fn=<jax-pure function>")
+        return self.fn(x), state
+
+
 @serializable
 @dataclasses.dataclass
 class PermuteLayer(Layer):

@@ -470,3 +470,41 @@ class TestRound2MapperBreadth:
         x = np.random.default_rng(2).normal(size=(4, 8)) \
             .astype(np.float32)
         _compare(m, net, x, graph=True)
+
+
+class TestCustomLayerRegistration:
+    """registerCustomLayer (reference: KerasLayer.registerCustomLayer /
+    registerLambdaLayer): unknown classes fail loudly until the user
+    registers a mapper; Lambda layers import through it."""
+
+    def test_lambda_via_registration(self, tmp_path):
+        from deeplearning4j_tpu.modelimport.keras import (
+            registerCustomLayer, unregisterCustomLayer,
+        )
+        from deeplearning4j_tpu.nn.conf import LambdaLayer
+        import jax.numpy as jnp
+
+        m = keras.Sequential([
+            keras.layers.Input((6,)),
+            keras.layers.Dense(4, activation="relu", name="d"),
+            keras.layers.Lambda(lambda t: t * 2.0 + 1.0, name="sc"),
+            keras.layers.Dense(3, activation="softmax", name="o"),
+        ])
+        p = str(tmp_path / "lam.h5")
+        m.save(p)
+
+        with pytest.raises(UnsupportedKerasConfigurationException,
+                           match="registerCustomLayer"):
+            KerasModelImport.importKerasSequentialModelAndWeights(p)
+
+        registerCustomLayer(
+            "Lambda",
+            lambda cfg: LambdaLayer(name=cfg.get("name"),
+                                    fn=lambda t: t * 2.0 + 1.0))
+        try:
+            net = KerasModelImport.importKerasSequentialModelAndWeights(p)
+            x = np.random.default_rng(3).normal(size=(5, 6)) \
+                .astype(np.float32)
+            _compare(m, net, x)
+        finally:
+            unregisterCustomLayer("Lambda")
