@@ -867,6 +867,51 @@ OpMappingRegistry.register("Erfc")(
     lambda ctx: ctx.op("erfc", ctx.inputs[:1]))
 
 
+def _register_round4_tail():
+    R = OpMappingRegistry.register
+
+    @R("Einsum")
+    def _einsum(ctx):
+        return ctx.op("einsum", ctx.inputs,
+                      equation=ctx.attr("equation"))
+
+    @R("MirrorPad")
+    def _mirror_pad(ctx):
+        mode = ctx.attr("mode", "REFLECT")  # _decode_attrs gives str
+        pads = [[int(a), int(b)] for a, b in ctx.static_np(1)]
+        return ctx.op("mirror_pad", ctx.inputs[:1], paddings=pads,
+                      reflect=(mode == "REFLECT"))
+
+    @R("Roll")
+    def _roll(ctx):
+        shift = [int(s) for s in np.atleast_1d(ctx.static_np(1))]
+        axis = [int(a) for a in np.atleast_1d(ctx.static_np(2))]
+        return ctx.op("roll", ctx.inputs[:1], shift=shift, axis=axis)
+
+    @R("TensorScatterUpdate")
+    def _tensor_scatter_update(ctx):
+        return ctx.op("scatter_nd_update", ctx.inputs[:3])
+
+    @R("TensorScatterAdd")
+    def _tensor_scatter_add(ctx):
+        return ctx.op("scatter_nd_add", ctx.inputs[:3])
+
+    @R("PreventGradient")
+    def _prevent_gradient(ctx):
+        # inference-time identity (the gradient barrier only matters
+        # to TF's own autodiff; our import differentiates the WHOLE
+        # rebuilt graph, where stop_gradient is the closest analog)
+        return ctx.op("stop_gradient", ctx.inputs[:1])
+
+    @R("SparseSoftmaxCrossEntropyWithLogits")
+    def _sparse_softmax_ce(ctx):
+        # TF returns (loss_per_example, backprop); graphs consume #0
+        return ctx.op("sparse_softmax_cross_entropy", ctx.inputs[:2])
+
+
+_register_round4_tail()
+
+
 # ------------------------------------------------- shape-subgraph folding
 class _PartialEval:
     """Import-time abstract interpreter for SHAPE-COMPUTATION subgraphs.

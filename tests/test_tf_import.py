@@ -1104,3 +1104,61 @@ class TestControlFlowGolden:
         e.attr["frame_name"].s = b"broken_frame"
         with pytest.raises(TFImportError):
             TFGraphMapper.importGraph(gd)
+
+
+class TestRound4TailMappers:
+    """Round-4 pt2 TF mappers: Einsum, MirrorPad, Roll,
+    TensorScatterUpdate/Add, PreventGradient, sparse softmax CE."""
+
+    def test_einsum(self):
+        def f(a, b):
+            return tf.einsum("ij,jk->ik", a, b) \
+                + tf.einsum("ij->j", a)[None, :3] * 0.0
+
+        rs = np.random.default_rng(20)
+        a = rs.normal(size=(2, 4)).astype(np.float32)
+        b = rs.normal(size=(4, 3)).astype(np.float32)
+        _run_both(f, [a, b])
+
+    def test_mirror_pad_both_modes(self):
+        def f(x):
+            r = tf.raw_ops.MirrorPad(input=x, paddings=[[1, 2], [2, 1]],
+                                     mode="REFLECT")
+            s = tf.raw_ops.MirrorPad(input=x, paddings=[[1, 1], [0, 2]],
+                                     mode="SYMMETRIC")
+            return r[:4, :4] + s[:4, :4]
+
+        x = np.random.default_rng(21).normal(size=(4, 4)) \
+            .astype(np.float32)
+        _run_both(f, [x])
+
+    def test_roll_and_tensor_scatter(self):
+        def f(x):
+            r = tf.roll(x, shift=[1, -2], axis=[0, 1])
+            idx = tf.constant([[0], [2]])
+            upd = tf.ones((2, 4), tf.float32)
+            u = tf.tensor_scatter_nd_update(x, idx, upd)
+            a = tf.tensor_scatter_nd_add(x, idx, upd)
+            return r + u + a
+
+        x = np.random.default_rng(22).normal(size=(3, 4)) \
+            .astype(np.float32)
+        _run_both(f, [x])
+
+    def test_prevent_gradient_is_identity_forward(self):
+        def f(x):
+            return tf.raw_ops.PreventGradient(input=x) * 2.0
+
+        x = np.random.default_rng(23).normal(size=(2, 3)) \
+            .astype(np.float32)
+        _run_both(f, [x])
+
+    def test_sparse_softmax_cross_entropy(self):
+        def f(x):
+            labels = tf.constant([0, 2], tf.int32)
+            return tf.nn.sparse_softmax_cross_entropy_with_logits(
+                labels=labels, logits=x)
+
+        x = np.random.default_rng(24).normal(size=(2, 3)) \
+            .astype(np.float32)
+        _run_both(f, [x])
