@@ -71,3 +71,7 @@ class TestExamples:
     def test_vae_anomaly_example(self):
         flagged = _run("vae_anomaly.py").main(steps=150)
         assert flagged > 0.9  # far-out samples score below the threshold
+
+    def test_transfer_learning_example(self):
+        acc = _run("transfer_learning.py").main(epochs=8)
+        assert acc > 0.9
