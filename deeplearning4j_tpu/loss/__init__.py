@@ -138,6 +138,19 @@ _LOSS_ALIASES = {
 }
 
 
+def wasserstein(labels, output):
+    """Reference: LossWasserstein — mean(labels * preOutput); labels
+    are the critic's +1/-1 (real/fake) signs in WGAN training."""
+    return jnp.mean(labels * output, axis=tuple(range(1, output.ndim)))
+
+
+def reconstruction_crossentropy(labels, output):
+    """Reference: LossReconstructionCrossEntropy (pretrain
+    autoencoders) — binary CE over activated outputs with the
+    reference's wider 1e-5 epsilon clamp."""
+    return xent_binary(labels, output, eps=1e-5)
+
+
 class LossFunction(enum.Enum):
     """Reference: LossFunctions.LossFunction enum names."""
 
@@ -157,6 +170,8 @@ class LossFunction(enum.Enum):
     MEAN_ABSOLUTE_PERCENTAGE_ERROR = "mape"
     MEAN_SQUARED_LOGARITHMIC_ERROR = "msle"
     HUBER = "huber"
+    WASSERSTEIN = "wasserstein"
+    RECONSTRUCTION_CROSSENTROPY = "reconstruction_crossentropy"
 
     @property
     def fn(self) -> Callable:
@@ -177,6 +192,9 @@ class LossFunction(enum.Enum):
             LossFunction.MEAN_ABSOLUTE_PERCENTAGE_ERROR: mape,
             LossFunction.MEAN_SQUARED_LOGARITHMIC_ERROR: msle,
             LossFunction.HUBER: huber,
+            LossFunction.WASSERSTEIN: wasserstein,
+            LossFunction.RECONSTRUCTION_CROSSENTROPY:
+                reconstruction_crossentropy,
         }[self]
 
     @staticmethod
@@ -199,7 +217,7 @@ class LossFunction(enum.Enum):
 #: losses whose per-example value is a MEAN over feature axes (all
 #: others SUM) — drives the masked divisor so all-ones mask == unmasked
 _MEAN_REDUCED_LOSSES = frozenset({
-    LossFunction.MSE, LossFunction.MAE,
+    LossFunction.MSE, LossFunction.MAE, LossFunction.WASSERSTEIN,
     LossFunction.MEAN_ABSOLUTE_PERCENTAGE_ERROR,
     LossFunction.MEAN_SQUARED_LOGARITHMIC_ERROR,
 })

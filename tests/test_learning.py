@@ -246,3 +246,45 @@ class TestLowPrecisionDtypeStability:
         params = {"W": jnp.ones((4, 4), jnp.bfloat16)}
         state = Adam(1e-3).init_state(params)
         assert state["m"]["W"].dtype == jnp.float32
+
+
+class TestRound4Losses:
+    def test_wasserstein(self):
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.loss import LossFunction, compute_loss
+
+        labels = np.asarray([[1.0, -1.0], [-1.0, 1.0]], np.float32)
+        pre = np.asarray([[0.5, 2.0], [1.0, -3.0]], np.float32)
+        got = float(compute_loss(LossFunction.WASSERSTEIN,
+                                 jnp.asarray(labels), jnp.asarray(pre),
+                                 "identity"))
+        want = (labels * pre).mean(axis=1).mean()
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+
+    def test_reconstruction_crossentropy_matches_manual(self):
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.loss import LossFunction, compute_loss
+
+        rng = np.random.default_rng(0)
+        x = (rng.random((4, 6)) < 0.5).astype(np.float32)
+        pre = rng.normal(size=(4, 6)).astype(np.float32)
+        got = float(compute_loss(LossFunction.RECONSTRUCTION_CROSSENTROPY,
+                                 jnp.asarray(x), jnp.asarray(pre),
+                                 "sigmoid"))
+        y = np.clip(1 / (1 + np.exp(-pre)), 1e-5, 1 - 1e-5)
+        want = -(x * np.log(y) + (1 - x) * np.log(1 - y)).sum(1).mean()
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_autoencoder_layer_accepts_reconstruction_ce(self):
+        import jax
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.nn.conf import AutoEncoder
+
+        lay = AutoEncoder(n_in=6, n_out=4, activation="sigmoid",
+                          corruption_level=0.0,
+                          loss="reconstruction_crossentropy")
+        p = lay.init_params(jax.random.key(0), None, jnp.float32)
+        x = jnp.asarray(np.random.default_rng(1).random((8, 6)),
+                        jnp.float32)
+        loss = lay.unsupervised_loss(p, x, jax.random.key(2))
+        assert np.isfinite(float(loss))
