@@ -269,7 +269,8 @@ def compute_loss(loss_fn: LossFunction, labels, preoutput, activation, mask=None
         # divisor reproduces the unmasked reduction (see docstring):
         # - sum-reduced losses fold T into the example axis but the
         #   unmasked path averaged over N only -> divide by N
-        # - mean-reduced losses (MSE/MAE/MAPE/MSLE) and elementwise
+        # - losses in _MEAN_REDUCED_LOSSES (MSE/MAE/MAPE/MSLE/
+        #   Wasserstein) and elementwise
         #   sparse CE averaged over every entry -> divide by per_ex.size
         if folded and loss_fn not in _MEAN_REDUCED_LOSSES:
             divisor = n_examples

@@ -75,3 +75,7 @@ class TestExamples:
     def test_transfer_learning_example(self):
         acc = _run("transfer_learning.py").main(epochs=8)
         assert acc > 0.9
+
+    def test_wgan_example(self):
+        d = _run("wgan.py").main(iters=120)
+        assert d < 0.75
