@@ -30,13 +30,30 @@ class Policy:
 
 
 class DQNPolicy(Policy):
-    """Greedy argmax over Q-values."""
+    """Greedy argmax over Q-values. When produced by a learner's
+    getPolicy(), save/load persist the Q-network (reference:
+    DQNPolicy#save / DQNPolicy.load(path))."""
 
-    def __init__(self, q_fn: Callable[[np.ndarray], np.ndarray]):
+    def __init__(self, q_fn: Callable[[np.ndarray], np.ndarray],
+                 learner=None):
         self.q_fn = q_fn
+        self._learner = learner
 
     def next_action(self, obs: np.ndarray) -> int:
         return int(np.argmax(self.q_fn(obs[None])[0]))
+
+    def save(self, path: str) -> None:
+        if self._learner is None:
+            raise ValueError("this DQNPolicy wraps a bare q_fn — save "
+                             "via the learner (QLearningDiscreteDense"
+                             ".save) or construct it via getPolicy()")
+        self._learner.save(path)
+
+    @staticmethod
+    def load(path: str, mdp) -> "DQNPolicy":
+        from deeplearning4j_tpu.rl.qlearning import QLearningDiscreteDense
+
+        return QLearningDiscreteDense.load(path, mdp).getPolicy()
 
 
 class EpsGreedy(Policy):

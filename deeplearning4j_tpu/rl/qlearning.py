@@ -115,7 +115,48 @@ class QLearningDiscreteDense:
         return np.asarray(self._q(self.params, jnp.asarray(obs)))
 
     def getPolicy(self) -> DQNPolicy:
-        return DQNPolicy(self.q_values)
+        return DQNPolicy(self.q_values, learner=self)
+
+    # -- persistence (reference: DQNPolicy#save / DQNPolicy.load) -------
+    def save(self, path: str) -> None:
+        """Q-network params + config to one .npz (the DQNPolicy.save
+        role; optimizer/replay state is NOT saved — matching the
+        reference, which persists the policy network only)."""
+        import dataclasses as _dc
+        import json as _json
+
+        arrays = {}
+        for i, layer in enumerate(self.params):
+            for k, v in layer.items():
+                arrays[f"l{i}_{k}"] = np.asarray(v)
+        arrays["_meta"] = np.frombuffer(_json.dumps({
+            "conf": _dc.asdict(self.conf),
+            "n_layers": len(self.params),
+            "obs_size": self.mdp.obs_size,
+            "n_actions": self.mdp.n_actions,
+        }).encode(), dtype=np.uint8)
+        np.savez(path, **arrays)
+
+    @classmethod
+    def load(cls, path: str, mdp: MDP) -> "QLearningDiscreteDense":
+        import json as _json
+
+        with np.load(path) as z:
+            meta = _json.loads(bytes(z["_meta"]).decode())
+            if (meta["obs_size"], meta["n_actions"]) != (mdp.obs_size,
+                                                         mdp.n_actions):
+                raise ValueError(
+                    f"saved policy is for obs_size={meta['obs_size']}/"
+                    f"n_actions={meta['n_actions']}, mdp has "
+                    f"{mdp.obs_size}/{mdp.n_actions}")
+            learner = cls(mdp, QLConfiguration(**meta["conf"]))
+            learner.params = [
+                {k.split("_", 1)[1]: jnp.asarray(z[k])
+                 for k in z.files if k.startswith(f"l{i}_")}
+                for i in range(meta["n_layers"])]
+        learner.target_params = jax.tree_util.tree_map(
+            lambda a: a, learner.params)
+        return learner
 
     # -- training loop (reference: QLearningDiscrete#trainStep) ---------
     def train(self, max_steps: Optional[int] = None) -> List[float]:

@@ -252,3 +252,48 @@ class TestHistoryProcessor:
         ret = ql.getPolicy().play(HistoryMDP(_PixelCorridor(length=4),
                                              hconf))
         assert ret > 0.9
+
+
+class TestPolicySerde:
+    """DQNPolicy save/load (reference: DQNPolicy#save / .load)."""
+
+    def test_round_trip_preserves_q_values_and_policy(self, tmp_path):
+        from deeplearning4j_tpu.rl import (
+            GridWorldMDP, QLConfiguration, QLearningDiscreteDense,
+        )
+        from deeplearning4j_tpu.rl.policy import DQNPolicy
+
+        mdp = GridWorldMDP(n=3)
+        learner = QLearningDiscreteDense(mdp, QLConfiguration(
+            max_step=300, eps_nb_step=200, target_update=50))
+        learner.train(300)
+        p = str(tmp_path / "dqn.npz")
+        learner.getPolicy().save(p)
+
+        restored = DQNPolicy.load(p, GridWorldMDP(n=3))
+        obs = np.eye(9, dtype=np.float32)[:5]
+        np.testing.assert_allclose(learner.q_values(obs),
+                                   restored._learner.q_values(obs),
+                                   rtol=1e-6)
+        for o in obs:
+            assert learner.getPolicy().next_action(o) \
+                == restored.next_action(o)
+
+    def test_shape_mismatch_rejected(self, tmp_path):
+        from deeplearning4j_tpu.rl import (
+            GridWorldMDP, QLConfiguration, QLearningDiscreteDense,
+        )
+
+        mdp = GridWorldMDP(n=3)
+        learner = QLearningDiscreteDense(mdp, QLConfiguration(max_step=10))
+        p = str(tmp_path / "dqn.npz")
+        learner.save(p)
+        with pytest.raises(ValueError, match="obs_size"):
+            QLearningDiscreteDense.load(p, GridWorldMDP(n=4))
+
+    def test_bare_policy_save_raises(self):
+        from deeplearning4j_tpu.rl.policy import DQNPolicy
+
+        pol = DQNPolicy(lambda o: np.zeros((1, 2)))
+        with pytest.raises(ValueError, match="learner"):
+            pol.save("/tmp/nope.npz")
