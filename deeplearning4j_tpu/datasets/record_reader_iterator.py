@@ -337,7 +337,19 @@ class RecordReaderMultiDataSetIterator(MultiDataSetIterator):
             r.reset()
 
     def hasNext(self) -> bool:
-        return all(r.hasNext() for r in self._readers.values())
+        states = {n: r.hasNext() for n, r in self._readers.items()}
+        if any(states.values()) and not all(states.values()):
+            # Lock-step exhaustion: a reader running long means the
+            # sources are misaligned — surfacing it beats silently
+            # dropping the longer readers' tail records (reference
+            # RecordReaderMultiDataSetIterator errors here too).
+            longer = sorted(n for n, s in states.items() if s)
+            done = sorted(n for n, s in states.items() if not s)
+            raise ValueError(
+                "readers exhausted out of lock-step: "
+                f"{done} are done but {longer} still have records — "
+                "input sources have unequal record counts")
+        return all(states.values())
 
     def batch(self) -> int:
         return self._bs

@@ -37,6 +37,14 @@ class OnnxImportError(ValueError):
 from deeplearning4j_tpu.ops import onnx_compat  # noqa: E402,F401
 
 
+# Default-attribute semantics changed across opsets (Hardmax/Softmax
+# axis, reduce axes, ...). importGraph stamps the model's ai.onnx opset
+# here for the duration of the walk (sub-graph walks run inside the
+# top-level walk, so one slot suffices); 13 = modern default when a
+# mapper is driven outside importGraph (unit micro-graphs).
+_ACTIVE_OPSET = 13
+
+
 class _Ctx:
     def __init__(self, sd: SameDiff, node: NodeProto,
                  inputs: List[Optional[SDVariable]],
@@ -46,6 +54,10 @@ class _Ctx:
         self.inputs = inputs
         self._static = static
         self.avals = avals  # var name -> jax.ShapeDtypeStruct
+
+    @property
+    def opset(self) -> int:
+        return _ACTIVE_OPSET
 
     def attr(self, name: str, default=None):
         return self.node.attributes.get(name, default)
@@ -186,16 +198,46 @@ def _clip(ctx):
                   hi=float(hi if hi is not None else np.inf))
 
 
+def _opset13_axis_family(ctx, opname):
+    """Softmax/LogSoftmax/Hardmax share the opset-13 semantics change:
+    >=13 is per-axis (default -1); <13 is default axis=1 with
+    COERCE-TO-2D — the op runs over the flattened trailing dims
+    [prod(:axis), prod(axis:)], materially different when >1 trailing
+    dim (onnx Operators.md changelog)."""
+    if ctx.opset >= 13:
+        return ctx.op(opname, ctx.inputs[:1],
+                      axis=int(ctx.attr("axis", -1)))
+    axis = int(ctx.attr("axis", 1))
+    aval = ctx.avals.get(ctx.inputs[0].name) if ctx.avals else None
+    if aval is None:
+        if axis == -1:
+            # coerce-to-2D over [prod(:-1), last] IS per-last-axis —
+            # no shape needed for this one case
+            return ctx.op(opname, ctx.inputs[:1], axis=-1)
+        raise OnnxImportError(
+            f"{ctx.node.name}: {ctx.node.op_type} at opset "
+            f"{ctx.opset} < 13 uses coerce-to-2D semantics and needs "
+            "a known input shape")
+    shape = tuple(int(d) for d in aval.shape)
+    if axis < 0:
+        axis += len(shape)
+    if axis == len(shape) - 1 or all(d == 1 for d in shape[axis:-1]):
+        return ctx.op(opname, ctx.inputs[:1], axis=-1)
+    rows = int(np.prod(shape[:axis], dtype=np.int64))
+    cols = int(np.prod(shape[axis:], dtype=np.int64))
+    flat = ctx.op("reshape", ctx.inputs[:1], shape=[rows, cols])
+    out = ctx.op(opname, [flat], axis=-1)
+    return ctx.op("reshape", [out], shape=list(shape))
+
+
 @R("Softmax")
 def _softmax(ctx):
-    return ctx.op("softmax", ctx.inputs[:1],
-                  axis=int(ctx.attr("axis", -1)))
+    return _opset13_axis_family(ctx, "softmax")
 
 
 @R("LogSoftmax")
 def _log_softmax(ctx):
-    return ctx.op("log_softmax", ctx.inputs[:1],
-                  axis=int(ctx.attr("axis", -1)))
+    return _opset13_axis_family(ctx, "log_softmax")
 
 
 @R("PRelu")
@@ -1172,8 +1214,7 @@ def _shrink(ctx):
 
 @R("Hardmax")
 def _hardmax(ctx):
-    return ctx.op("hardmax", ctx.inputs[:1],
-                  axis=int(ctx.attr("axis", -1)))
+    return _opset13_axis_family(ctx, "hardmax")
 
 
 @R("LpNormalization")
@@ -1198,6 +1239,14 @@ def _mvn(ctx):
                   axes=tuple(int(a) for a in axes))
 
 
+# ONNX TensorProto.DataType enum -> numpy (supported subset; unknown
+# enums raise loudly per the importer's convention)
+_EYE_DT = {1: np.float32, 2: np.uint8, 3: np.int8, 4: np.uint16,
+           5: np.int16, 6: np.int32, 7: np.int64, 9: np.bool_,
+           10: np.float16, 11: np.float64, 12: np.uint32,
+           13: np.uint64}
+
+
 @R("EyeLike")
 def _eye_like(ctx):
     aval = ctx.avals.get(ctx.inputs[0].name) if ctx.avals else None
@@ -1207,9 +1256,15 @@ def _eye_like(ctx):
     k = int(ctx.attr("k", 0))
     dt_attr = ctx.attr("dtype")
     # ONNX TensorProto.DataType enum; default = input dtype
-    dtype = ({1: np.float32, 6: np.int32, 7: np.int64, 9: np.bool_,
-              11: np.float64}.get(int(dt_attr), np.float32)
-             if dt_attr is not None else np.dtype(aval.dtype))
+    if dt_attr is not None:
+        if int(dt_attr) not in _EYE_DT:
+            raise OnnxImportError(
+                f"{ctx.node.name}: EyeLike dtype enum {int(dt_attr)} "
+                "not supported (loud-by-convention: silently casting "
+                "would corrupt results)")
+        dtype = _EYE_DT[int(dt_attr)]
+    else:
+        dtype = np.dtype(aval.dtype)
     return ctx.sd.constant(
         ctx.node.output[0] + "_eye",
         np.eye(aval.shape[0], aval.shape[1], k, dtype=dtype))
@@ -1696,6 +1751,8 @@ class OnnxImport:
 
         model = OnnxImport._as_model(model_or_path)
         g: GraphProto = model.graph
+        global _ACTIVE_OPSET
+        saved_opset = _ACTIVE_OPSET
         sd = SameDiff.create()
         tensors: Dict[str, SDVariable] = {}
         const_vals: Dict[str, np.ndarray] = {}
@@ -1723,7 +1780,11 @@ class OnnxImport:
                 avals[vi.name] = jax.ShapeDtypeStruct(
                     tuple(shape), np.dtype(dt))
 
-        _walk_onnx_nodes(sd, g.nodes, tensors, const_vals, avals)
+        try:
+            _ACTIVE_OPSET = int(model.opset_version) or 13
+            _walk_onnx_nodes(sd, g.nodes, tensors, const_vals, avals)
+        finally:
+            _ACTIVE_OPSET = saved_opset
         return sd
 
     @staticmethod

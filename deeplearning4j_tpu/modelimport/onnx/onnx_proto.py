@@ -330,9 +330,17 @@ def decode_model(data: bytes) -> ModelProto:
         elif field == 7:
             m.graph = _decode_graph(v)
         elif field == 8:  # OperatorSetIdProto
+            # Only the DEFAULT domain ("" / "ai.onnx") versions the core
+            # op set; custom-domain entries (com.microsoft, ...) carry
+            # unrelated version numbers and must not bump it.
+            dom, ver = "", None
             for f2, _w2, v2 in _fields(v):
-                if f2 == 2:
-                    m.opset_version = max(m.opset_version, _signed(v2))
+                if f2 == 1:
+                    dom = v2.decode()
+                elif f2 == 2:
+                    ver = _signed(v2)
+            if ver is not None and dom in ("", "ai.onnx"):
+                m.opset_version = max(m.opset_version, ver)
     if m.graph is None:
         raise OnnxDecodeError("no GraphProto in model (not an ONNX file?)")
     return m

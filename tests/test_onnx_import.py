@@ -780,3 +780,82 @@ class TestOnnxBreadthRound4Pt2(_SingleNodeGo):
         e = np.zeros((3, 3), np.float32)
         self._go("EyeLike", [attr_int("dtype", 7)], {"x": e}, [],
                  np.eye(3, dtype=np.int64))
+
+
+class TestOpsetSensitiveDefaults(_SingleNodeGo):
+    """Attribute defaults that changed across opsets must follow the
+    MODEL's declared opset (reference: per-opset mapping rules in
+    samediff-import-onnx)."""
+
+    def test_hardmax_old_opset_coerces_to_2d(self):
+        # opset 11, no axis attr -> default axis=1 with flatten-to-2D
+        # semantics: argmax over the FLATTENED trailing dims, one hot
+        # per leading row — NOT a per-last-axis hardmax.
+        rs = np.random.RandomState(3)
+        x = rs.randn(2, 3, 4).astype(np.float32)
+        g = graph([node("Hardmax", ["x"], ["y"], "hm")], [],
+                  [value_info("x", [2, 3, 4])], [value_info("y", [])])
+        sd = OnnxImport.importGraph(model(g, opset=11))
+        got = np.asarray(sd.output({"x": x}, ["y"])["y"])
+        flat = x.reshape(2, 12)
+        want = np.zeros_like(flat)
+        want[np.arange(2), flat.argmax(1)] = 1.0
+        np.testing.assert_allclose(got, want.reshape(2, 3, 4))
+
+    def test_hardmax_new_opset_default_last_axis(self):
+        rs = np.random.RandomState(4)
+        x = rs.randn(2, 3, 4).astype(np.float32)
+        want = np.zeros_like(x)
+        idx = x.argmax(-1)
+        for i in range(2):
+            for j in range(3):
+                want[i, j, idx[i, j]] = 1.0
+        self._go("Hardmax", [], {"x": x}, [], want)
+
+    def test_eyelike_unknown_dtype_enum_raises(self):
+        g = graph([node("EyeLike", ["x"], ["y"], "ey",
+                        attrs=[attr_int("dtype", 16)])], [],  # bf16 enum
+                  [value_info("x", [3, 3])], [value_info("y", [])])
+        with pytest.raises(OnnxImportError, match="dtype enum"):
+            OnnxImport.importGraph(model(g))
+
+    def test_eyelike_float16_supported(self):
+        e = np.zeros((2, 4), np.float32)
+        self._go("EyeLike", [attr_int("dtype", 10)], {"x": e}, [],
+                 np.eye(2, 4, dtype=np.float16))
+
+    def test_softmax_old_opset_coerce_and_custom_domain_ignored(self):
+        import torch
+
+        rs = np.random.RandomState(5)
+        x = rs.randn(2, 3, 4).astype(np.float32)
+        # opset 11, no axis attr -> flatten-to-2D softmax at axis=1
+        g = graph([node("Softmax", ["x"], ["y"], "sm")], [],
+                  [value_info("x", [2, 3, 4])], [value_info("y", [])])
+        sd = OnnxImport.importGraph(model(g, opset=11))
+        got = np.asarray(sd.output({"x": x}, ["y"])["y"])
+        want = torch.nn.functional.softmax(
+            torch.tensor(x.reshape(2, 12)), -1).numpy().reshape(2, 3, 4)
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+        # a custom-domain opset entry with a HIGHER version must not
+        # bump the core opset (domain field versions other op sets)
+        m = model(g, opset=11) + _ld(8, _str(1, "com.microsoft")
+                                     + _iv(2, 19))
+        sd2 = OnnxImport.importGraph(m)
+        got2 = np.asarray(sd2.output({"x": x}, ["y"])["y"])
+        np.testing.assert_allclose(got2, want, rtol=1e-5, atol=1e-6)
+
+    def test_logsoftmax_old_opset_explicit_last_axis_no_shape_needed(self):
+        import torch
+
+        rs = np.random.RandomState(6)
+        x = rs.randn(3, 5).astype(np.float32)
+        g = graph([node("LogSoftmax", ["x"], ["y"], "ls",
+                        attrs=[attr_int("axis", -1)])], [],
+                  [value_info("x", [3, 5])], [value_info("y", [])])
+        sd = OnnxImport.importGraph(model(g, opset=9))
+        got = np.asarray(sd.output({"x": x}, ["y"])["y"])
+        np.testing.assert_allclose(
+            got, torch.nn.functional.log_softmax(torch.tensor(x),
+                                                 -1).numpy(),
+            rtol=1e-5, atol=1e-6)

@@ -265,7 +265,7 @@ class TestPolicySerde:
 
         mdp = GridWorldMDP(n=3)
         learner = QLearningDiscreteDense(mdp, QLConfiguration(
-            max_step=300, eps_nb_step=200, target_update=50))
+            max_step=300, epsilon_nb_step=200, target_dqn_update_freq=50))
         learner.train(300)
         p = str(tmp_path / "dqn.npz")
         learner.getPolicy().save(p)

@@ -172,3 +172,16 @@ class TestEndToEndGraphFit:
              .addReader("r", _flat_reader([[1.0, 2.0]]).initialize()))
         with pytest.raises(ValueError, match="BOTH col_from and col_to"):
             b.addInput("r", 1)
+
+
+class TestLockStepMisalignment:
+    def test_unequal_reader_lengths_raise(self):
+        it = RecordReaderMultiDataSetIterator.Builder(2) \
+            .addReader("a", _flat_reader(np.arange(8.).reshape(4, 2)).initialize()) \
+            .addReader("b", _flat_reader(np.arange(12.).reshape(6, 2)).initialize()) \
+            .addInput("a") \
+            .addOutput("b") \
+            .build()
+        with pytest.raises(ValueError, match="lock-step"):
+            for _ in it:
+                pass
