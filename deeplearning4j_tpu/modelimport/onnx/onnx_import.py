@@ -35,6 +35,7 @@ class OnnxImportError(ValueError):
 # ONNX-semantics helper ops live with the op set (ops/onnx_compat.py)
 # so a bare `import deeplearning4j_tpu.ops` registers the full registry
 from deeplearning4j_tpu.ops import onnx_compat  # noqa: E402,F401
+from deeplearning4j_tpu.modelimport import trace as mapper_trace  # noqa: E402
 
 
 # Default-attribute semantics changed across opsets (Hardmax/Softmax
@@ -101,12 +102,14 @@ class OnnxOpMappingRegistry:
     @classmethod
     def get(cls, op_type: str):
         try:
-            return cls._mappers[op_type]
+            fn = cls._mappers[op_type]
         except KeyError:
             raise OnnxImportError(
                 f"no mapper for ONNX op {op_type!r} (have "
                 f"{len(cls._mappers)}; add one via "
                 "OnnxOpMappingRegistry.register)") from None
+        mapper_trace.record("onnx", op_type)
+        return fn
 
     @classmethod
     def coverage(cls) -> List[str]:
@@ -132,12 +135,31 @@ for _onnx_name, _our in _UNARY.items():
         return ctx.op(_o, ctx.inputs[:1])
 
 _BINARY = {"Add": "add", "Sub": "sub", "Mul": "mul", "Div": "div",
-           "Pow": "pow_pairwise", "Min": "min_pairwise",
-           "Max": "max_pairwise", "Mod": "mod"}
+           "Pow": "pow_pairwise"}
 for _onnx_name, _our in _BINARY.items():
     @R(_onnx_name)
     def _binary(ctx, _o=_our):
         return ctx.op(_o, ctx.inputs[:2])
+
+@R("Mod")
+def _mod(ctx):
+    # fmod=0 (default): python/floor semantics, sign follows divisor;
+    # fmod=1: C fmod, sign follows dividend (the attr was previously
+    # ignored — caught by the mapper battery)
+    our = "fmod" if int(ctx.attr("fmod", 0)) else "mod"
+    return ctx.op(our, ctx.inputs[:2])
+
+
+# Min/Max are VARIADIC in ONNX (1..N inputs, numpy-broadcast fold) —
+# truncating to 2 silently dropped inputs 3+ (caught by the mapper
+# battery, tests/test_onnx_mapper_battery.py)
+for _onnx_name, _our in (("Min", "min_pairwise"), ("Max", "max_pairwise")):
+    @R(_onnx_name)
+    def _minmax_n(ctx, _o=_our):
+        out = ctx.inputs[0]
+        for v in ctx.inputs[1:]:
+            out = ctx.op(_o, [out, v])
+        return out
 
 
 @R("Neg")
@@ -171,7 +193,18 @@ def _selu(ctx):
 
 @R("HardSigmoid")
 def _hardsigmoid(ctx):
-    return ctx.op("hardsigmoid", ctx.inputs[:1])
+    # alpha/beta attrs (defaults 0.2/0.5) — the fixed-constant
+    # `hardsigmoid` op only covers the default pair (caught by the
+    # mapper battery)
+    alpha = float(ctx.attr("alpha", 0.2))
+    beta = float(ctx.attr("beta", 0.5))
+    if (alpha, beta) == (0.2, 0.5):
+        return ctx.op("hardsigmoid", ctx.inputs[:1])
+    a = ctx.sd.constant(f"{ctx.node.output[0]}_hsa", np.float32(alpha))
+    b = ctx.sd.constant(f"{ctx.node.output[0]}_hsb", np.float32(beta))
+    ax = ctx.op("mul", [ctx.inputs[0], a])
+    axb = ctx.op("add", [ax, b])
+    return ctx.op("clip_by_value", [axb], lo=0.0, hi=1.0)
 
 
 @R("Gelu")
@@ -1447,6 +1480,7 @@ def _walk_onnx_nodes(sd, nodes, tensors, const_vals, avals,
             ins.append(tensors[ref])
             statics.append(const_vals.get(ref))
         if node.op_type in ("If", "Loop"):
+            mapper_trace.record("onnx", node.op_type)
             handler = _handle_if if node.op_type == "If" else _handle_loop
             out = handler(sd, node, tensors, const_vals, avals, ins,
                           resolve_outer)

@@ -264,6 +264,18 @@ def plan_v1_frames(walker: _Walker, nodes: Sequence[Any]):
                     pool[n.name] = n
         plans[fname] = _FramePlan(fname, merged, invariant,
                                   loopconds[0], pool)
+    # Execution accounting: the frame machinery ops are CONSUMED by this
+    # reconstruction rather than dispatched through OpMappingRegistry —
+    # record them here so the mapper gate sees the path that handles
+    # them actually ran (body ops record normally via build_subgraph's
+    # walk).
+    from deeplearning4j_tpu.modelimport import trace as mapper_trace
+    machinery_ops = _LOOP_OPS | {"Merge", "RefMerge", "Switch",
+                                 "RefSwitch"}
+    for n in nodes:
+        if n.name in exit_map or (paths[n.name]
+                                  and n.op in machinery_ops):
+            mapper_trace.record("tf", n.op)
     return skip, exit_map, plans
 
 

@@ -20,6 +20,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from deeplearning4j_tpu.autodiff.samediff import SameDiff, SDVariable
+from deeplearning4j_tpu.modelimport import trace as mapper_trace
 
 
 class TFImportError(ValueError):
@@ -138,12 +139,14 @@ class OpMappingRegistry:
     @classmethod
     def get(cls, tf_op: str) -> Callable[[_Ctx], Any]:
         try:
-            return cls._mappers[tf_op]
+            fn = cls._mappers[tf_op]
         except KeyError:
             raise TFImportError(
                 f"no mapper for TF op {tf_op!r} "
                 f"(have {len(cls._mappers)}: add one via "
                 "OpMappingRegistry.register)") from None
+        mapper_trace.record("tf", tf_op)
+        return fn
 
     @classmethod
     def has(cls, tf_op: str) -> bool:
@@ -195,7 +198,10 @@ def _register_standard_mappers():
     # elementwise binary
     for tf_op, our in [("Add", "add"), ("AddV2", "add"), ("Sub", "sub"),
                        ("Mul", "mul"), ("RealDiv", "div"), ("Div", "div"),
-                       ("FloorDiv", "floordiv"), ("Mod", "mod"),
+                       # TF Mod is C-truncation for floats (sign
+                       # follows dividend) — NOT python floor-mod
+                       # (caught by the mapper battery)
+                       ("FloorDiv", "floordiv"), ("Mod", "fmod"),
                        ("FloorMod", "floormod"),
                        ("Pow", "pow_pairwise"), ("Maximum", "maximum"),
                        ("Minimum", "minimum"),
@@ -1321,6 +1327,7 @@ class _Walker:
         # v1 cond lowering + functional (v2) control flow live in
         # cf_import; they need walker state, not just a _Ctx
         if node.op in cf_import.WALKER_OPS:
+            mapper_trace.record("tf", node.op)
             n_before = len(sd._ops)
             cf_import.WALKER_OPS[node.op](self, node, in_vars, in_refs)
             self._propagate_avals(n_before)

@@ -1,0 +1,51 @@
+"""Mapper-dispatch accounting shared by the TF/ONNX/Keras importers.
+
+Reference parity: org/nd4j/autodiff/validation/OpValidation's coverage
+accounting (SURVEY.md §4) applied to the import layer (§2.14, §2.32) —
+the reference fails the build for registered ops no test exercises;
+here every mapper DRIVEN by an actual import records itself, and the
+end-of-suite gate (tests/test_zzz_mapper_execution_gate.py) fails for
+any registered mapper the suite never drove.
+
+Mechanism mirrors ops/registry.py's op accounting: an in-process set,
+merged across test subprocesses via DL4J_TPU_MAPPER_TRACE_FILE (set by
+tests/conftest.py), appended at interpreter exit. Keys are
+"<framework>:<name>", e.g. "tf:Conv2D", "onnx:Softmax", "keras:Dense".
+"""
+
+from __future__ import annotations
+
+import atexit
+import os
+from typing import Set
+
+_DRIVEN: Set[str] = set()
+
+
+def record(framework: str, name: str) -> None:
+    """Record that the mapper for `name` was dispatched on a real node
+    during an import (called from the importers' lookup paths — a
+    lexical mention in a test does NOT count)."""
+    _DRIVEN.add(f"{framework}:{name}")
+
+
+def driven_mappers() -> Set[str]:
+    """Mappers driven so far in THIS process, merged with any trace
+    file written by (sub)processes sharing DL4J_TPU_MAPPER_TRACE_FILE."""
+    out = set(_DRIVEN)
+    path = os.environ.get("DL4J_TPU_MAPPER_TRACE_FILE")
+    if path and os.path.exists(path):
+        with open(path) as f:
+            out.update(ln.strip() for ln in f if ln.strip())
+    return out
+
+
+@atexit.register
+def _dump_trace() -> None:
+    path = os.environ.get("DL4J_TPU_MAPPER_TRACE_FILE")
+    if path and _DRIVEN:
+        try:
+            with open(path, "a") as f:
+                f.write("\n".join(sorted(_DRIVEN)) + "\n")
+        except OSError:
+            pass

@@ -209,7 +209,8 @@ class ActivationLayer(Layer):
     def apply(self, params, state, x, train, rng):
         a = _act(self.activation or "identity")
         if self.alpha is not None and a in (Activation.LEAKYRELU,
-                                            Activation.ELU):
+                                            Activation.ELU,
+                                            Activation.THRESHOLDEDRELU):
             from deeplearning4j_tpu.ops.registry import get_op
             return get_op(a.value)(x, self.alpha), state
         return a.fn(x), state

@@ -69,6 +69,11 @@ def digamma(x):
 
 @register_op("polygamma")
 def polygamma(n, x):
+    # TF/reference take float n with integral values; jax requires an
+    # integer dtype for n (non-integral n is NaN territory upstream too)
+    n = jnp.asarray(n)
+    if not jnp.issubdtype(n.dtype, jnp.integer):
+        n = n.astype(jnp.int32)
     return jax.scipy.special.polygamma(n, x)
 
 

@@ -22,6 +22,15 @@ if "DL4J_TPU_OP_TRACE_FILE" not in os.environ:
     if os.path.exists(_trace):
         os.remove(_trace)
 
+# Same accounting for import MAPPERS (TF/ONNX/Keras dispatches record
+# into modelimport/trace.py; gate: test_zzz_mapper_execution_gate.py).
+_mtrace = os.path.join(tempfile.gettempdir(),
+                       f"dl4j_mapper_trace_{os.getpid()}.txt")
+if "DL4J_TPU_MAPPER_TRACE_FILE" not in os.environ:
+    os.environ["DL4J_TPU_MAPPER_TRACE_FILE"] = _mtrace
+    if os.path.exists(_mtrace):
+        os.remove(_mtrace)
+
 # Force CPU: the session env presets JAX_PLATFORMS=axon (the real TPU
 # tunnel, which also only admits ONE client process at a time) — tests
 # must never grab it, and must run on the virtual 8-device CPU mesh.

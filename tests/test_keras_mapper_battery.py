@@ -1,0 +1,139 @@
+"""Per-mapper Keras import battery (reference model:
+KerasModelEndToEndTest — import saved models, compare predictions to
+the originals'; SURVEY.md §4). Exists to close the executional mapper
+gate (test_zzz_mapper_execution_gate.py): each case saves a tiny live
+Keras model containing the target layer(s) and compares imported
+inference output against keras.predict.
+"""
+
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+keras = tf.keras
+
+from test_keras_import import _compare  # noqa: E402
+
+from deeplearning4j_tpu.modelimport.keras import KerasModelImport
+
+
+def _roundtrip(tmp_path, layers, x, **kw):
+    m = keras.Sequential(layers)
+    p = str(tmp_path / "m.h5")
+    m.save(p)
+    net = KerasModelImport.importKerasSequentialModelAndWeights(p)
+    _compare(m, net, x, **kw)
+    return net
+
+
+RNG = np.random.default_rng(21)
+
+
+class TestStochasticLayersInferenceIdentity:
+    """Dropout-family layers are identity at inference; the mapper must
+    produce nets whose output() matches keras.predict exactly."""
+
+    def test_dropout_family(self, tmp_path):
+        x = RNG.normal(size=(4, 10)).astype(np.float32)
+        _roundtrip(tmp_path, [
+            keras.layers.Input((10,)),
+            keras.layers.Dense(8, activation="relu"),
+            keras.layers.Dropout(0.4),
+            keras.layers.GaussianDropout(0.3),
+            keras.layers.GaussianNoise(0.2),
+            keras.layers.AlphaDropout(0.1),
+            keras.layers.Dense(3, activation="softmax"),
+        ], x)
+
+    def test_spatial_dropout_1d_2d_3d(self, tmp_path):
+        x1 = RNG.normal(size=(2, 6, 5)).astype(np.float32)
+        _roundtrip(tmp_path, [
+            keras.layers.Input((6, 5)),
+            keras.layers.SpatialDropout1D(0.3),
+            keras.layers.Conv1D(4, 3, activation="relu"),
+            keras.layers.GlobalAveragePooling1D(),
+            keras.layers.Dense(2, activation="softmax"),
+        ], x1)
+        x2 = RNG.normal(size=(2, 8, 8, 3)).astype(np.float32)
+        _roundtrip(tmp_path, [
+            keras.layers.Input((8, 8, 3)),
+            keras.layers.SpatialDropout2D(0.3),
+            keras.layers.Conv2D(4, 3, activation="relu"),
+            keras.layers.Flatten(),
+            keras.layers.Dense(2, activation="softmax"),
+        ], x2)
+        x3 = RNG.normal(size=(2, 4, 4, 4, 2)).astype(np.float32)
+        _roundtrip(tmp_path, [
+            keras.layers.Input((4, 4, 4, 2)),
+            keras.layers.SpatialDropout3D(0.3),
+            keras.layers.Conv3D(3, 2, activation="relu"),
+            keras.layers.Flatten(),
+            keras.layers.Dense(2, activation="softmax"),
+        ], x3)
+
+
+class TestActivationAndMaskLayers:
+    def test_activation_softmax_thresholded(self, tmp_path):
+        x = RNG.normal(size=(4, 10)).astype(np.float32)
+        _roundtrip(tmp_path, [
+            keras.layers.Input((10,)),
+            keras.layers.Dense(8),
+            keras.layers.Activation("tanh"),
+            keras.layers.Dense(6),
+            keras.layers.ThresholdedReLU(theta=0.4),
+            keras.layers.Dense(5),
+            keras.layers.Softmax(),
+        ], x)
+
+    def test_masking_layer(self, tmp_path):
+        # Masking passes values through; downstream layers here do not
+        # consume the mask, so keras output == unmasked compute and the
+        # imported MaskLayer pass-through must match exactly. (Keras's
+        # RNN state-SKIPPING under masks is a different semantic the
+        # framework covers via setLayerMaskArrays — tested in the
+        # masking-parity suite, not an import concern.)
+        x = RNG.normal(size=(3, 5, 4)).astype(np.float32)
+        x[:, 3:, :] = 0.0
+        _roundtrip(tmp_path, [
+            keras.layers.Input((5, 4)),
+            keras.layers.Masking(mask_value=0.0),
+            keras.layers.Flatten(),
+            keras.layers.Dense(2, activation="softmax"),
+        ], x)
+
+
+class TestPoolingPaddingUpsampling:
+    def test_average_pooling_1d_2d_3d(self, tmp_path):
+        x1 = RNG.normal(size=(2, 8, 3)).astype(np.float32)
+        _roundtrip(tmp_path, [
+            keras.layers.Input((8, 3)),
+            keras.layers.AveragePooling1D(2),
+            keras.layers.Flatten(),
+            keras.layers.Dense(2, activation="softmax"),
+        ], x1)
+        x2 = RNG.normal(size=(2, 8, 8, 3)).astype(np.float32)
+        _roundtrip(tmp_path, [
+            keras.layers.Input((8, 8, 3)),
+            keras.layers.AveragePooling2D(2),
+            keras.layers.GlobalMaxPooling2D(),
+            keras.layers.Dense(2, activation="softmax"),
+        ], x2)
+        x3 = RNG.normal(size=(2, 6, 6, 6, 2)).astype(np.float32)
+        _roundtrip(tmp_path, [
+            keras.layers.Input((6, 6, 6, 2)),
+            keras.layers.AveragePooling3D(2),
+            keras.layers.Flatten(),
+            keras.layers.Dense(2, activation="softmax"),
+        ], x3)
+
+    def test_zero_padding_cropping_upsampling_3d(self, tmp_path):
+        x = RNG.normal(size=(2, 4, 4, 4, 2)).astype(np.float32)
+        _roundtrip(tmp_path, [
+            keras.layers.Input((4, 4, 4, 2)),
+            keras.layers.ZeroPadding3D(1),
+            keras.layers.Cropping3D(((1, 0), (0, 1), (1, 1))),
+            keras.layers.UpSampling3D(2),
+            keras.layers.Conv3D(3, 2, activation="relu"),
+            keras.layers.Flatten(),
+            keras.layers.Dense(2, activation="softmax"),
+        ], x)

@@ -1,0 +1,233 @@
+"""Per-mapper ONNX micro-graph battery (reference model:
+TFGraphTestAllSameDiff / onnx backend-node tests — every registered
+mapper is DRIVEN by at least one stored-graph golden; SURVEY.md §4).
+
+This file exists to close the executional mapper gate
+(test_zzz_mapper_execution_gate.py): each case builds a tiny graph
+containing the exact node type, imports it, and compares against a
+numpy/torch oracle. Encoder helpers are shared with test_onnx_import.
+"""
+
+import numpy as np
+import pytest
+
+from test_onnx_import import (  # noqa: F401  (shared pb encoder)
+    _iv, _ld, _str, attr_float, attr_int, attr_ints, attr_tensor, graph,
+    model, node, tensor, value_info,
+)
+
+from deeplearning4j_tpu.modelimport.onnx.onnx_import import OnnxImport
+
+RS = np.random.RandomState(7)
+_F34 = RS.randn(3, 4).astype(np.float32)
+_P34 = (np.abs(RS.randn(3, 4)) + 0.1).astype(np.float32)
+_U11 = RS.uniform(-0.99, 0.99, (3, 4)).astype(np.float32)   # (-1, 1)
+_IMG = RS.randn(2, 3, 8, 8).astype(np.float32)              # NCHW
+
+
+def _import_single(op, attrs, feeds, inits=(), extra_inputs=(), n_out=1):
+    in_names = list(feeds) + list(extra_inputs)
+    onames = [f"o{i}" for i in range(n_out)]
+    g = graph(
+        nodes=[node(op, in_names, onames, "n", attrs=attrs)],
+        initializers=list(inits),
+        inputs=[value_info(k, list(v.shape)) for k, v in feeds.items()],
+        outputs=[value_info(o, []) for o in onames],
+    )
+    sd = OnnxImport.importGraph(model(g))
+    outs = sd.output(feeds, onames)
+    return [np.asarray(outs[o]) for o in onames]
+
+
+def _go(op, attrs, feeds, want, inits=(), extra_inputs=(), rtol=1e-5,
+        atol=1e-6):
+    got = _import_single(op, attrs, feeds, inits, extra_inputs)[0]
+    if want.dtype == np.bool_:
+        np.testing.assert_array_equal(got.astype(np.bool_), want)
+    else:
+        np.testing.assert_allclose(got, want, rtol=rtol, atol=atol)
+
+
+# name -> (attrs, feeds, oracle) for pure single-node cases
+def _torch():
+    import torch
+    return torch
+
+
+UNARY = {
+    "Acos": (_U11, lambda x: np.arccos(x)),
+    "Asin": (_U11, lambda x: np.arcsin(x)),
+    "Atan": (_F34, lambda x: np.arctan(x)),
+    "Cos": (_F34, lambda x: np.cos(x)),
+    "Cosh": (_F34, lambda x: np.cosh(x)),
+    "Sin": (_F34, lambda x: np.sin(x)),
+    "Sinh": (_F34, lambda x: np.sinh(x)),
+    "Tan": (_F34 * 0.5, lambda x: np.tan(x)),
+    "Ceil": (_F34 * 3, lambda x: np.ceil(x)),
+    "Floor": (_F34 * 3, lambda x: np.floor(x)),
+    "Round": (_F34 * 3, lambda x: np.round(x)),
+    "Sign": (_F34, lambda x: np.sign(x)),
+    "Neg": (_F34, lambda x: -x),
+    "Reciprocal": (_P34, lambda x: 1.0 / x),
+    "Exp": (_F34, lambda x: np.exp(x)),
+    "Log": (_P34, lambda x: np.log(x)),
+    "Erf": (_F34, lambda x: np.vectorize(__import__("math").erf)(
+        x).astype(np.float32)),
+    "Sigmoid": (_F34, lambda x: 1 / (1 + np.exp(-x))),
+    "Softsign": (_F34, lambda x: x / (1 + np.abs(x))),
+}
+
+
+class TestUnaryBattery:
+    @pytest.mark.parametrize("op", sorted(UNARY))
+    def test_op(self, op):
+        x, fn = UNARY[op]
+        _go(op, [], {"x": x}, fn(x).astype(np.float32), rtol=1e-4,
+            atol=1e-5)
+
+
+class TestActivations:
+    def test_elu_selu_leaky_thresholded_hardsigmoid_prelu(self):
+        torch = _torch()
+        t = torch.tensor(_F34)
+        _go("Elu", [attr_float("alpha", 0.8)], {"x": _F34},
+            torch.nn.functional.elu(t, 0.8).numpy(), rtol=1e-4,
+            atol=1e-5)
+        _go("Selu", [], {"x": _F34},
+            torch.nn.functional.selu(t).numpy(), rtol=1e-4, atol=1e-5)
+        _go("LeakyRelu", [attr_float("alpha", 0.2)], {"x": _F34},
+            torch.nn.functional.leaky_relu(t, 0.2).numpy(), rtol=1e-4,
+            atol=1e-5)
+        _go("ThresholdedRelu", [attr_float("alpha", 0.5)], {"x": _F34},
+            np.where(_F34 > 0.5, _F34, 0.0).astype(np.float32))
+        _go("HardSigmoid", [attr_float("alpha", 0.25),
+                            attr_float("beta", 0.4)], {"x": _F34},
+            np.clip(0.25 * _F34 + 0.4, 0, 1).astype(np.float32),
+            rtol=1e-4, atol=1e-5)
+        slope = np.asarray([0.1, 0.2, 0.3, 0.4], np.float32)
+        _go("PRelu", [], {"x": _F34},
+            np.where(_F34 > 0, _F34, slope * _F34).astype(np.float32),
+            inits=[tensor("s", slope)], extra_inputs=["s"])
+
+    def test_dropout_inference_identity(self):
+        _go("Dropout", [attr_float("ratio", 0.5)], {"x": _F34}, _F34)
+
+
+class TestBinaryVariadic:
+    def test_pow_max_min_sum(self):
+        a, b, c = _P34, _P34.T.copy().T, np.abs(_F34) + 0.5
+        _go("Pow", [], {"a": _P34, "b": c},
+            np.power(_P34, c).astype(np.float32), rtol=1e-4, atol=1e-5)
+        _go("Max", [], {"a": a, "b": _F34, "c": c},
+            np.maximum(np.maximum(a, _F34), c))
+        _go("Min", [], {"a": a, "b": _F34, "c": c},
+            np.minimum(np.minimum(a, _F34), c))
+        _go("Sum", [], {"a": a, "b": _F34, "c": c},
+            (a + _F34 + c).astype(np.float32), rtol=1e-5, atol=1e-5)
+
+    def test_comparisons(self):
+        a, b = _F34, _F34.T.copy().T * 0.5
+        _go("Equal", [], {"a": a, "b": a}, np.equal(a, a))
+        _go("GreaterOrEqual", [], {"a": a, "b": b},
+            np.greater_equal(a, b))
+        _go("LessOrEqual", [], {"a": a, "b": b}, np.less_equal(a, b))
+
+    def test_logical_and_or_not_xor_where(self):
+        # bools made in-graph (the pb encoder's value_info is f32-only)
+        a, b = _F34, _F34.T.copy().T * 0.5
+        zero = tensor("z", np.zeros((1,), np.float32))
+        g = graph(
+            nodes=[
+                node("Greater", ["a", "z"], ["ba"], "ga"),
+                node("Greater", ["b", "z"], ["bb"], "gb"),
+                node("And", ["ba", "bb"], ["o_and"], "and"),
+                node("Or", ["ba", "bb"], ["o_or"], "or"),
+                node("Not", ["ba"], ["o_not"], "not"),
+                node("Xor", ["ba", "bb"], ["o_xor"], "xor"),
+                node("Where", ["ba", "a", "b"], ["o_where"], "where"),
+            ],
+            initializers=[zero],
+            inputs=[value_info("a", [3, 4]), value_info("b", [3, 4])],
+            outputs=[value_info(o, []) for o in
+                     ("o_and", "o_or", "o_not", "o_xor", "o_where")],
+        )
+        sd = OnnxImport.importGraph(model(g))
+        outs = sd.output({"a": a, "b": b},
+                         ["o_and", "o_or", "o_not", "o_xor", "o_where"])
+        ba, bb = a > 0, b > 0
+        np.testing.assert_array_equal(
+            np.asarray(outs["o_and"]).astype(bool), ba & bb)
+        np.testing.assert_array_equal(
+            np.asarray(outs["o_or"]).astype(bool), ba | bb)
+        np.testing.assert_array_equal(
+            np.asarray(outs["o_not"]).astype(bool), ~ba)
+        np.testing.assert_array_equal(
+            np.asarray(outs["o_xor"]).astype(bool), ba ^ bb)
+        np.testing.assert_allclose(np.asarray(outs["o_where"]),
+                                   np.where(ba, a, b))
+
+
+class TestSpecials:
+    def test_isnan_isinf(self):
+        x = np.asarray([[0.0, np.inf, -np.inf, np.nan, 2.0]], np.float32)
+        _go("IsNaN", [], {"x": x}, np.isnan(x))
+        _go("IsInf", [], {"x": x}, np.isinf(x))
+
+    def test_argmax(self):
+        _go("ArgMax", [attr_int("axis", 1), attr_int("keepdims", 0)],
+            {"x": _F34}, np.argmax(_F34, 1))
+
+    def test_reduce_max_min_prod(self):
+        _go("ReduceMax", [attr_ints("axes", [1])], {"x": _F34},
+            _F34.max(1, keepdims=True))
+        _go("ReduceMin", [attr_ints("axes", [1])], {"x": _F34},
+            _F34.min(1, keepdims=True))
+        _go("ReduceProd", [attr_ints("axes", [1]),
+                           attr_int("keepdims", 0)], {"x": _P34},
+            _P34.prod(1).astype(np.float32), rtol=1e-4, atol=1e-5)
+
+    def test_constant_of_shape(self):
+        val = tensor("cv", np.asarray([2.5], np.float32))
+        g = graph(
+            nodes=[node("ConstantOfShape", ["shp"], ["o"], "cos",
+                        attrs=[attr_tensor("value", val)])],
+            initializers=[tensor("shp", np.asarray([2, 3], np.int64))],
+            inputs=[], outputs=[value_info("o", [2, 3])],
+        )
+        sd = OnnxImport.importGraph(model(g))
+        np.testing.assert_allclose(np.asarray(sd.output({}, ["o"])["o"]),
+                                   np.full((2, 3), 2.5, np.float32))
+
+    def test_tile(self):
+        _go("Tile", [], {"x": _F34}, np.tile(_F34, (2, 3)),
+            inits=[tensor("r", np.asarray([2, 3], np.int64))],
+            extra_inputs=["r"])
+
+    def test_pad_constant(self):
+        pads = np.asarray([0, 1, 0, 2], np.int64)  # x-begin, x-end per dim
+        want = np.pad(_F34, ((0, 0), (1, 2)), constant_values=0.0)
+        _go("Pad", [], {"x": _F34}, want.astype(np.float32),
+            inits=[tensor("p", pads)], extra_inputs=["p"])
+
+
+class TestPoolingNorm:
+    def test_average_pool(self):
+        torch = _torch()
+        want = torch.nn.functional.avg_pool2d(
+            torch.tensor(_IMG), 2, stride=2).numpy()
+        _go("AveragePool", [attr_ints("kernel_shape", [2, 2]),
+                            attr_ints("strides", [2, 2])],
+            {"x": _IMG}, want, rtol=1e-4, atol=1e-5)
+
+    def test_global_max_pool(self):
+        _go("GlobalMaxPool", [], {"x": _IMG},
+            _IMG.max((2, 3), keepdims=True))
+
+    def test_lrn(self):
+        torch = _torch()
+        want = torch.nn.functional.local_response_norm(
+            torch.tensor(_IMG), size=3, alpha=1e-3, beta=0.6,
+            k=1.2).numpy()
+        _go("LRN", [attr_float("alpha", 1e-3), attr_float("beta", 0.6),
+                    attr_float("bias", 1.2), attr_int("size", 3)],
+            {"x": _IMG}, want, rtol=1e-4, atol=1e-5)

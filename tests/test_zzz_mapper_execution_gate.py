@@ -1,0 +1,101 @@
+"""End-of-suite EXECUTIONAL mapper-coverage gate (reference: the
+OpValidation coverage-accounting role — SURVEY.md §4 — applied to the
+import layer §2.14/§2.32: `TFGraphTestAllSameDiff` + mapping-rule
+coverage fails the build for unexercised mappers).
+
+Every TF/ONNX/Keras mapper DISPATCHED on a real node during an import
+records itself ("<fw>:<name>", modelimport/trace.py); test subprocesses
+append their sets via DL4J_TPU_MAPPER_TRACE_FILE (conftest). The zzz
+name puts this module last in pytest's alphabetical collection, so by
+the time it runs the whole suite has executed. A registered mapper no
+test ever DROVE — not merely mentioned — fails the gate unless it
+carries a conscious, reasoned EXEMPT entry.
+"""
+
+import glob
+import os
+
+import pytest
+
+from deeplearning4j_tpu.modelimport.keras.keras_import import (
+    supported_layer_names,
+)
+from deeplearning4j_tpu.modelimport.onnx.onnx_import import (
+    OnnxOpMappingRegistry,
+)
+from deeplearning4j_tpu.modelimport.tensorflow import cf_import
+from deeplearning4j_tpu.modelimport.tensorflow.tf_import import (
+    OpMappingRegistry,
+)
+from deeplearning4j_tpu.modelimport.trace import driven_mappers
+
+#: mapper key -> reason it is allowed to skip execution accounting.
+#: Every entry is a conscious decision; an entry whose mapper starts
+#: being driven again is flagged stale below.
+_REF_REASON = (
+    "TF1 ref-dtype variant: registered as an alias of the non-Ref op "
+    "in every dispatch table (WALKER_OPS, _LOOP_OPS, plan_v1_frames' "
+    "op checks — same code path, driven via the non-Ref name); modern "
+    "TF cannot emit Ref* nodes, so no live producer can generate a "
+    "test graph. Kept for ancient-GraphDef parity.")
+
+EXEMPT = {
+    "tf:RefEnter": _REF_REASON,
+    "tf:RefExit": _REF_REASON,
+    "tf:RefMerge": _REF_REASON,
+    "tf:RefNextIteration": _REF_REASON,
+    "tf:RefSwitch": _REF_REASON,
+}
+
+
+def registered_mappers():
+    out = [f"tf:{n}" for n in OpMappingRegistry.coverage()]
+    out += [f"tf:{n}" for n in sorted(cf_import.WALKER_OPS)
+            if f"tf:{n}" not in out]
+    out += [f"onnx:{n}" for n in OnnxOpMappingRegistry.coverage()]
+    out += ["onnx:If", "onnx:Loop"]  # walker-dispatched, not in registry
+    out += [f"keras:{n}" for n in supported_layer_names()]
+    return sorted(set(out))
+
+
+def _missing(registered, driven, exempt):
+    return [m for m in registered if m not in driven and m not in exempt]
+
+
+def test_gate_logic_catches_undriven_mappers():
+    assert _missing(["tf:Ghost"], set(), {}) == ["tf:Ghost"]
+    assert _missing(["tf:Ghost"], {"tf:Ghost"}, {}) == []
+    assert _missing(["tf:Ghost"], set(), {"tf:Ghost": "why"}) == []
+
+
+def test_registry_sizes_sane():
+    reg = registered_mappers()
+    by_fw = {fw: sum(1 for m in reg if m.startswith(fw + ":"))
+             for fw in ("tf", "onnx", "keras")}
+    assert by_fw["tf"] >= 190, by_fw
+    assert by_fw["onnx"] >= 120, by_fw
+    assert by_fw["keras"] >= 50, by_fw
+
+
+def test_every_registered_mapper_is_driven_by_the_suite(request):
+    here = os.path.dirname(os.path.abspath(__file__))
+    all_mods = {os.path.basename(p)
+                for p in glob.glob(os.path.join(here, "test_*.py"))}
+    ran_mods = {os.path.basename(str(i.fspath))
+                for i in request.session.items}
+    partial = all_mods - ran_mods
+    if partial:
+        pytest.skip(
+            f"partial run ({len(partial)} test modules not collected) "
+            "— the executional gate is enforced on full-suite runs")
+    driven = driven_mappers()
+    missing = _missing(registered_mappers(), driven, EXEMPT)
+    assert not missing, (
+        f"{len(missing)} registered import mappers were never DRIVEN "
+        f"by the suite (reference parity: TFGraphTestAllSameDiff + "
+        f"OpValidation coverage role); add an import golden or a "
+        f"reasoned EXEMPT entry: {missing}")
+    stale = [m for m in EXEMPT if m in driven]
+    assert not stale, (
+        f"EXEMPT entries whose mappers are now driven — remove them: "
+        f"{stale}")
