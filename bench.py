@@ -206,25 +206,47 @@ def main() -> None:
             flops_tok = 3 * fwd_tok + 3 * head_tok
             line["mfu_est"] = round(tokens_per_sec * flops_tok / peak, 4)
             line["mfu_src"] = "analytic_fallback"
+    regress_msgs = []
+    if regression:
+        regress_msgs.append(
+            f"vs_frozen={line['vs_frozen']} below "
+            f"band_lo={line['vs_frozen_band_lo']} (BERT frozen "
+            "yardstick — see BASELINE.md 'BERT regression band')")
     if on_accel:
         try:
             line.update(_resnet50_metrics(peak))
         except Exception as e:  # never lose the BERT line to a CNN failure
             line["resnet50_error"] = f"{type(e).__name__}: {e}"[:200]
         try:
-            line.update(_lstm_metrics(peak))
+            lstm_out, lstm_reg = _lstm_metrics(peak, base, _record)
+            line.update(lstm_out)
+            if lstm_reg:
+                regress_msgs.append(
+                    f"lstm_vs_frozen={line['lstm_vs_frozen']} below "
+                    f"band_lo={line['lstm_vs_frozen_band_lo']} (LSTM "
+                    "frozen yardstick — BASELINE.md 'LSTM regression "
+                    "band')")
         except Exception as e:
             line["lstm_error"] = f"{type(e).__name__}: {e}"[:200]
+        try:
+            b2k_out, b2k_reg = _bert_longseq_metrics(peak, base, _record)
+            line.update(b2k_out)
+            if b2k_reg:
+                regress_msgs.append(
+                    f"bert2048_flash_speedup="
+                    f"{line['bert2048_flash_speedup']} below "
+                    f"band_lo={line['bert2048_band_lo']} (flash-attention "
+                    "seq-2048 A/B — the winning kernel lost ground)")
+        except Exception as e:
+            line["bert2048_error"] = f"{type(e).__name__}: {e}"[:200]
     print(json.dumps(line))
-    if regression:
+    if regress_msgs:
         import sys
 
-        print(f"BENCH REGRESSION: vs_frozen={line['vs_frozen']} below "
-              f"band_lo={line['vs_frozen_band_lo']} — the framework "
-              "step lost ground against the frozen in-window yardstick "
-              "(tenant noise cancels in this ratio; this is real "
-              "drift). See BASELINE.md 'BERT regression band'.",
-              file=sys.stderr)
+        for msg in regress_msgs:
+            print(f"BENCH REGRESSION: {msg} — tenant noise cancels in "
+                  "interleaved ratios; this is real drift.",
+                  file=sys.stderr)
         raise SystemExit(1)
 
 
@@ -290,21 +312,176 @@ def _resnet50_metrics(peak) -> dict:
     return out
 
 
-def _lstm_metrics(peak) -> dict:
-    """Char-LSTM driver metric: zoo-default config (batch 256 x seq
-    200, hidden 256, bf16) via the shared workload in bench_common —
-    the same loop bench_lstm.py's CLI sweeps, so they cannot diverge."""
-    from bench_common import run_char_lstm
+def _lstm_metrics(peak, base, record) -> tuple:
+    """Char-LSTM driver metrics (BASELINE.md "LSTM regression band",
+    round 5). The zoo-default config's single-shot numbers swing ±21%
+    with tenancy (six identical r3 runs spanned 1.86-2.82M tok/s), so:
+    (a) the framework step is interleaved with the FROZEN pure-jax
+    yardstick (bench_lstm_frozen.py, DO NOT EDIT) in the same windows
+    and the noise-cancelling ratio carries the band, exactly like the
+    BERT guard; (b) the H=1024 engine-soundness point (34% MFU class,
+    BASELINE.md LSTM table) rides along so the driver line tracks the
+    config where the scan engine is compute-bound, not latency-bound.
 
-    r = run_char_lstm()
-    out = {"lstm_tokens_per_sec_chip": round(r["tokens_per_sec"], 1),
+    Returns (metrics_dict, regression_flag)."""
+    import bench_lstm_frozen as blf
+    from bench_common import build_char_lstm, run_char_lstm
+
+    steps, trials = 20, 6
+    run, state, flops_per_step, tokens_per_step = build_char_lstm()
+
+    f_step = blf.make_frozen_step()
+    f_params = blf.init_params(0)
+    f_opt = blf.init_opt_state(f_params)
+    rs = np.random.default_rng(0)
+    ids = rs.integers(0, blf.VOCAB, (256, 200))
+    eye = np.eye(blf.VOCAB, dtype=np.float32)
+    fx = jax.device_put(jnp.asarray(eye[ids]))
+    fy = jax.device_put(jnp.asarray(eye[np.roll(ids, -1, 1)]))
+
+    # warm both sides (compile), then interleave windows
+    state, loss = run(state, 0)
+    float(jnp.mean(loss))
+    f_params, f_opt, fl = f_step(f_params, f_opt, jnp.asarray(0), fx, fy)
+    float(fl)
+    best = float("inf")
+    ratios = []
+    for _ in range(trials):
+        # PER-TRIAL ratio of ADJACENT windows, then median across
+        # trials: min(frozen)/min(framework) over independent windows
+        # is brittle for this latency-bound step (identical code swung
+        # 1.26 -> 0.96 across runs when the two minima landed in
+        # different tenancy moments); adjacent windows share tenancy
+        # and the median rejects the outlier trials.
+        t0 = time.perf_counter()
+        for i in range(steps):
+            state, loss = run(state, i + 1)
+        float(jnp.mean(loss))
+        dt = time.perf_counter() - t0
+        best = min(best, dt)
+        t0 = time.perf_counter()
+        for i in range(steps):
+            f_params, f_opt, fl = f_step(f_params, f_opt,
+                                         jnp.asarray(i + 1), fx, fy)
+        float(fl)
+        ratios.append((time.perf_counter() - t0) / dt)
+
+    tokens_per_sec = tokens_per_step * steps / best
+    out = {"lstm_tokens_per_sec_chip": round(tokens_per_sec, 1),
            "lstm_hidden": 256}
-    if peak and r["flops_per_step"]:
+    if peak and flops_per_step:
         out["lstm_mfu"] = round(
-            r["tokens_per_sec"] * r["flops_per_step"]
-            / r["tokens_per_step"] / peak, 4)
+            tokens_per_sec * flops_per_step / tokens_per_step / peak, 4)
         out["lstm_mfu_src"] = "cost_analysis"
-    return out
+
+    regression = False
+    ratio = sorted(ratios)[len(ratios) // 2]  # >1: framework faster
+    out["lstm_vs_frozen"] = round(ratio, 4)
+    platform = jax.devices()[0].platform
+    key = f"{platform}_lstm_vs_frozen_v2"  # v2: median-of-trial-ratios
+    if key in base and base[key].get("value"):
+        band_lo = float(base[key]["value"]) * 0.95
+        out["lstm_vs_frozen_band_lo"] = round(band_lo, 4)
+        if ratio < band_lo:
+            regression = True
+    else:
+        record(key, {"value": ratio,
+                     "note": "framework/frozen LSTM step-time ratio; "
+                             "band = value*0.95"})
+
+    # engine-soundness point: H=1024 fills the MXU (single-shot,
+    # informational — its absolute value still rides tenancy)
+    r1024 = run_char_lstm(hidden=1024, steps=steps)
+    out["lstm1024_tokens_per_sec_chip"] = round(
+        r1024["tokens_per_sec"], 1)
+    if peak and r1024["flops_per_step"]:
+        out["lstm1024_mfu"] = round(
+            r1024["tokens_per_sec"] * r1024["flops_per_step"]
+            / r1024["tokens_per_step"] / peak, 4)
+    return out, regression
+
+
+def _bert_longseq_metrics(peak, base, record) -> tuple:
+    """Long-context BERT point: seq 2048, the regime where the flash
+    kernel WINS (VERDICT r4 #9 asked to track the winning kernel; the
+    round-5 re-measure falsified the old '+4% at 512' note — at 512
+    XLA's fused attention beats every flash variant, so `auto` now
+    routes short seqs to XLA and this metric sits where the kernel
+    actually engages: tuned-blocks library flash, 1.6x fwd / 1.2x
+    train at T=2048 — BASELINE.md 'flash attention re-measured').
+    Both impls run interleaved in the same windows, so the ratio
+    default/flash cancels tenancy and tracks the kernel
+    round-over-round. Banded like the frozen yardsticks. Returns
+    (metrics_dict, regression_flag)."""
+    from deeplearning4j_tpu.learning.updaters import Adam
+    from deeplearning4j_tpu.models.transformer import (
+        TransformerEncoder, bert_base,
+    )
+
+    import gc
+
+    gc.collect()   # free the prior metrics' device arrays before two
+    #                full BERT-base sides at seq 2048 go on the chip
+    # batch 4: the default (non-flash) side materializes per-layer
+    # [N,12,2048,2048] attention weights for backward — batch 8 puts
+    # the A/B over the 15.75G HBM limit
+    batch, seqlen, steps, trials = 4, 2048, 10, 4
+    masked_per_row, capacity = 307, 312   # 15% of 2048
+    cfg = bert_base()
+    cfg.max_len = seqlen
+    updater = Adam(learning_rate=1e-4)
+    rng = jax.random.key(0)
+    rs = np.random.RandomState(0)
+    ids = jax.random.randint(rng, (batch, seqlen), 0, cfg.vocab_size)
+    labels = jax.random.randint(rng, (batch, seqlen), 0, cfg.vocab_size)
+    m = np.zeros((batch, seqlen), np.float32)
+    for r in range(batch):
+        m[r, rs.choice(seqlen, masked_per_row, replace=False)] = 1.0
+    mask_pos = jnp.asarray(m)
+
+    sides = {}
+    for name, impl in (("flash", "flash"), ("default", "default")):
+        model = TransformerEncoder(cfg, attn_impl=impl)
+        step = model.make_train_step(updater, masked_capacity=capacity)
+        params = model.init_params(jax.random.key(1))
+        opt_state = updater.init_state(params)
+        params, opt_state, loss = step(params, opt_state,
+                                       jnp.asarray(0), ids, labels,
+                                       mask_pos, rng)
+        float(loss)  # compile + sync while this side's impl is live
+        sides[name] = [step, params, opt_state]
+
+    times = {"flash": float("inf"), "default": float("inf")}
+    for _ in range(trials):
+        for name in ("flash", "default"):
+            step, params, opt_state = sides[name]
+            t0 = time.perf_counter()
+            for i in range(steps):
+                params, opt_state, loss = step(
+                    params, opt_state, jnp.asarray(i + 1), ids, labels,
+                    mask_pos, rng)
+            float(loss)
+            times[name] = min(times[name], time.perf_counter() - t0)
+            sides[name][1], sides[name][2] = params, opt_state
+
+    tok_s = batch * seqlen * steps / times["flash"]
+    out = {"bert2048_flash_tokens_per_sec_chip": round(tok_s, 1),
+           "bert2048_flash_speedup": round(
+               times["default"] / times["flash"], 4)}
+
+    regression = False
+    platform = jax.devices()[0].platform
+    key = f"{platform}_bert2048_flash_speedup_v1"
+    if key in base and base[key].get("value"):
+        band_lo = float(base[key]["value"]) * 0.95
+        out["bert2048_band_lo"] = round(band_lo, 4)
+        if out["bert2048_flash_speedup"] < band_lo:
+            regression = True
+    else:
+        record(key, {"value": out["bert2048_flash_speedup"],
+                     "note": "default/flash step-time ratio at seq 2048 "
+                             "(interleaved windows); band = value*0.95"})
+    return out, regression
 
 
 if __name__ == "__main__":

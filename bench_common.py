@@ -53,11 +53,12 @@ def time_best_of(run, state, steps, trials=3):
     return best
 
 
-def run_char_lstm(batch=256, seq=200, hidden=256, vocab=77, steps=10,
-                  dtype="bf16"):
-    """Char-LSTM train-step benchmark (BASELINE.md "Char-RNN LSTM"
-    row, the CudnnLSTMHelper role — SURVEY.md §2.9). Returns
-    tokens/sec, measured per-step FLOPs (or None), and first loss."""
+def build_char_lstm(batch=256, seq=200, hidden=256, vocab=77,
+                    dtype="bf16"):
+    """Build (run, state0, flops_per_step, tokens_per_step) for the
+    char-LSTM workload so callers can either time it standalone
+    (run_char_lstm) or interleave it with the frozen yardstick in
+    shared windows (bench.py _lstm_metrics)."""
     import numpy as np
 
     from deeplearning4j_tpu.nn.multilayer.network import (
@@ -90,8 +91,18 @@ def run_char_lstm(batch=256, seq=200, hidden=256, vocab=77, steps=10,
                              None, jax.random.key(i))
         return (p, s, o), loss
 
-    best = time_best_of(
-        run, (net.params_list, net.states_list, net.opt_states), steps)
-    return {"tokens_per_sec": batch * seq * steps / best,
+    state0 = (net.params_list, net.states_list, net.opt_states)
+    return run, state0, flops_per_step, batch * seq
+
+
+def run_char_lstm(batch=256, seq=200, hidden=256, vocab=77, steps=10,
+                  dtype="bf16"):
+    """Char-LSTM train-step benchmark (BASELINE.md "Char-RNN LSTM"
+    row, the CudnnLSTMHelper role — SURVEY.md §2.9). Returns
+    tokens/sec, measured per-step FLOPs (or None), and first loss."""
+    run, state0, flops_per_step, tokens_per_step = build_char_lstm(
+        batch=batch, seq=seq, hidden=hidden, vocab=vocab, dtype=dtype)
+    best = time_best_of(run, state0, steps)
+    return {"tokens_per_sec": tokens_per_step * steps / best,
             "flops_per_step": flops_per_step,
-            "tokens_per_step": batch * seq}
+            "tokens_per_step": tokens_per_step}

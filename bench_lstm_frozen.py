@@ -1,0 +1,112 @@
+"""FROZEN char-LSTM yardstick — DO NOT EDIT (see BASELINE.md
+"LSTM regression band", round 5).
+
+Self-contained pure-jax train step of the zoo char-LSTM workload
+(2x LSTM(256) + per-timestep softmax over vocab 77, batch 256 x seq
+200, one-hot input, bf16 compute / f32 params, Adam) that deliberately
+does NOT import deeplearning4j_tpu: framework changes cannot alter it.
+bench.py interleaves this step with the framework's LSTM step in the
+SAME timing windows; tenant noise (±21% single-shot on this metric —
+BASELINE.md round-4 finding) hits both sides of a window equally, so
+the ratio frozen/framework isolates real framework drift. This is the
+same design as bench_bert_frozen.py, applied to the metric whose
+single-shot noise band made round-over-round numbers uninterpretable.
+
+Frozen at round 5 (2026-07-31). Any edit invalidates the recorded
+band; bump the band key in BENCH_BASELINE.json if it must change.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+VOCAB = 77
+HIDDEN = 256
+LAYERS = 2
+
+
+def init_params(seed: int = 0):
+    rs = np.random.RandomState(seed)
+
+    def glorot(fan_in, fan_out):
+        s = np.sqrt(6.0 / (fan_in + fan_out))
+        return jnp.asarray(rs.uniform(-s, s, (fan_in, fan_out)),
+                           jnp.float32)
+
+    layers = []
+    n_in = VOCAB
+    for _ in range(LAYERS):
+        layers.append(dict(
+            w_ih=glorot(n_in, 4 * HIDDEN),
+            w_hh=glorot(HIDDEN, 4 * HIDDEN),
+            b=jnp.zeros((4 * HIDDEN,), jnp.float32),
+        ))
+        n_in = HIDDEN
+    return dict(
+        layers=layers,
+        w_out=glorot(HIDDEN, VOCAB),
+        b_out=jnp.zeros((VOCAB,), jnp.float32),
+    )
+
+
+def _lstm_layer(lp, x):
+    """One fused-scan LSTM layer, bf16 compute: x [N,T,F] -> [N,T,H]."""
+    cd = jnp.bfloat16
+    n, t, _ = x.shape
+    xp = x.astype(cd) @ lp["w_ih"].astype(cd) + lp["b"].astype(cd)
+    w_hh = lp["w_hh"].astype(cd)
+
+    def cell(carry, xt):
+        h, c = carry
+        gates = xt + h @ w_hh
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        i = jax.nn.sigmoid(i)
+        f = jax.nn.sigmoid(f)
+        g = jnp.tanh(g)
+        o = jax.nn.sigmoid(o)
+        c2 = f * c + i * g
+        h2 = o * jnp.tanh(c2)
+        return (h2, c2), h2
+
+    h0 = jnp.zeros((n, HIDDEN), cd)
+    c0 = jnp.zeros((n, HIDDEN), cd)
+    _, hs = jax.lax.scan(cell, (h0, c0), xp.transpose(1, 0, 2))
+    return hs.transpose(1, 0, 2)
+
+
+def _loss(params, x, y):
+    h = x
+    for lp in params["layers"]:
+        h = _lstm_layer(lp, h)
+    cd = jnp.bfloat16
+    logits = (h @ params["w_out"].astype(cd)
+              + params["b_out"].astype(cd)).astype(jnp.float32)
+    lp_ = jax.nn.log_softmax(logits, -1)
+    return -jnp.mean(jnp.sum(lp_ * y, -1))
+
+
+def make_frozen_step():
+    b1, b2, eps, lr = 0.9, 0.999, 1e-8, 1e-3
+
+    def step(params, opt_state, it, x, y):
+        loss, grads = jax.value_and_grad(_loss)(params, x, y)
+        m, v = opt_state
+        t = it.astype(jnp.float32) + 1.0
+        m = jax.tree_util.tree_map(
+            lambda a, g: b1 * a + (1 - b1) * g, m, grads)
+        v = jax.tree_util.tree_map(
+            lambda a, g: b2 * a + (1 - b2) * g * g, v, grads)
+        scale = lr * jnp.sqrt(1 - b2 ** t) / (1 - b1 ** t)
+        new_p = jax.tree_util.tree_map(
+            lambda p, mm, vv: p - scale * mm / (jnp.sqrt(vv) + eps),
+            params, m, v)
+        return new_p, (m, v), loss
+
+    return jax.jit(step, donate_argnums=(0, 1))
+
+
+def init_opt_state(params):
+    return (jax.tree_util.tree_map(jnp.zeros_like, params),
+            jax.tree_util.tree_map(jnp.zeros_like, params))

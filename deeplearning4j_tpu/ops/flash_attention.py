@@ -220,6 +220,28 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 # ======================================================================
 # 3. dispatcher
 # ======================================================================
+def _library_block_sizes(tq, tk):
+    """Tuned block sizes for the library kernel. Its built-in defaults
+    collapse at long sequence (measured on v5e, T=2048 batch 24:
+    44.2 ms default vs 10.1 ms with these blocks vs 16.5 ms XLA naive
+    — the default-blocks kernel LOSES 2.7x to XLA, the tuned one wins
+    1.6x; BASELINE.md 'flash attention re-measured, round 5')."""
+    from jax.experimental.pallas.ops.tpu.flash_attention import (
+        BlockSizes,
+    )
+
+    # the kernel requires seq_len % block == 0 — take the largest
+    # power-of-two block that divides (T=1536 must get 512/256, not
+    # crash on 1024)
+    bq = next(b for b in (512, 256, 128) if tq % b == 0)
+    bkv = next(b for b in (1024, 512, 256, 128) if tk % b == 0)
+    return BlockSizes(
+        block_q=bq, block_k_major=bkv, block_k=bkv, block_b=1,
+        block_q_major_dkv=bq, block_k_major_dkv=bkv,
+        block_k_dkv=bkv, block_q_dkv=bq,
+        block_k_major_dq=bkv, block_k_dq=bkv, block_q_dq=bq)
+
+
 def _library_flash(q, k, v, mask, causal):
     from jax.experimental.pallas.ops.tpu.flash_attention import (
         SegmentIds, flash_attention as lib_flash,
@@ -232,7 +254,27 @@ def _library_flash(q, k, v, mask, causal):
         q_seg = jnp.zeros((q.shape[0], q.shape[2]), jnp.int32)
         seg = SegmentIds(q=q_seg, kv=kv_seg)
     return lib_flash(q, k, v, segment_ids=seg, causal=causal,
-                     sm_scale=1.0 / (dh ** 0.5))
+                     sm_scale=1.0 / (dh ** 0.5),
+                     block_sizes=_library_block_sizes(q.shape[2],
+                                                      k.shape[2]))
+
+
+def _xla_attention(q, k, v, mask, causal):
+    """Plain fused-softmax attention — what XLA compiles best at short
+    sequence (measured: beats every flash variant below ~1024 on v5e)."""
+    dh = q.shape[-1]
+    scale = 1.0 / jnp.sqrt(jnp.asarray(dh, q.dtype))
+    logits = jnp.einsum("nhqd,nhkd->nhqk", q, k) * scale
+    neg = jnp.asarray(_NEG_INF, logits.dtype)
+    if mask is not None:
+        m4 = mask if mask.ndim == 4 else mask[:, None, None, :]
+        logits = jnp.where(m4.astype(bool), logits, neg)
+    if causal:
+        tq, tk = q.shape[2], k.shape[2]
+        cm = jnp.tril(jnp.ones((tq, tk), bool), tk - tq)
+        logits = jnp.where(cm[None, None], logits, neg)
+    w = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("nhqk,nhkd->nhqd", w, v)
 
 
 @register_op("flash_attention")
@@ -247,12 +289,24 @@ def attention(q, k, v, mask=None, causal: bool = False,
 
     if impl == "auto":
         aligned = tq % 128 == 0 and tk % 128 == 0 and dh >= 64
-        if on_tpu and aligned:
+        if on_tpu and tk < 1024:
+            # short-KV: XLA's fused softmax attention beats every
+            # flash variant (v5e measurements, BASELINE.md round 5 —
+            # T=512 fwd: 4.0 ms XLA vs 6.1 ms best-tuned flash), and
+            # the O(tq*tk) logits stay small when the KV side is
+            # short. Routing is on KV length: a short-QUERY call
+            # against a long KV (cross-attention, cached decode) is
+            # exactly where flash's no-materialization matters, so it
+            # must NOT fall through to the einsum path.
+            impl = "xla"
+        elif on_tpu and aligned:
             impl = "library"
         elif on_tpu and tq % 128 == 0 and tk % 128 == 0:
             impl = "pallas"
         else:
             impl = "blockwise"
+    if impl == "xla":
+        return _xla_attention(q, k, v, mask, causal)
     if impl == "library":
         return _library_flash(q, k, v, mask, causal)
     if impl == "pallas":
