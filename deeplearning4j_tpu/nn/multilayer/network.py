@@ -362,8 +362,20 @@ class MultiLayerNetwork:
     def fit(self, data, labels=None, epochs: int = 1):
         self._check_init()
         if isinstance(data, DataSetIterator):
+            import time as _time
+
             for _ in range(epochs):
-                for ds in data:
+                it = iter(data)
+                while True:
+                    # time spent waiting on the iterator = ETL time
+                    # (reference: PerformanceListener's ETL-time metric,
+                    # surfaced in the training UI's system charts)
+                    t0 = _time.perf_counter()
+                    try:
+                        ds = next(it)
+                    except StopIteration:
+                        break
+                    self._last_etl_ms = (_time.perf_counter() - t0) * 1e3
                     self._fit_batch(ds.features, ds.labels, ds.labels_mask,
                                     ds.features_mask)
                 self._epoch += 1
@@ -371,6 +383,10 @@ class MultiLayerNetwork:
                     if hasattr(l, "onEpochEnd"):
                         l.onEpochEnd(self)
             return self
+        # non-iterator paths have no ETL wait — clear any stale value a
+        # previous iterator-based fit left behind (the UI would
+        # otherwise chart a frozen constant)
+        self._last_etl_ms = None
         if isinstance(data, DataSet):
             for _ in range(epochs):
                 self._fit_batch(data.features, data.labels,
@@ -419,6 +435,11 @@ class MultiLayerNetwork:
         # remote/tunneled accelerator); score() converts lazily
         self._score = loss
         self._iteration += 1
+        # device-array references for listeners that recompute
+        # gradients (StatsListener collect_gradients — the reference's
+        # per-iteration gradient reports; free to keep, they alias the
+        # arrays already on device)
+        self._last_fit_batch = (x, y, m, fm, sub)
         self._panic_check()
         for l in self._listeners:
             l.iterationDone(self, self._iteration, self._epoch)

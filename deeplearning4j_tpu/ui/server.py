@@ -32,34 +32,84 @@ _DASHBOARD_HTML = """<!doctype html>
  h1{font-size:20px} .card{background:#fff;border:1px solid #ddd;
  border-radius:6px;padding:12px;margin:12px 0}
  canvas{width:100%;height:220px} pre{overflow:auto}
+ .row{display:flex;gap:12px} .row .card{flex:1}
+ canvas.h{height:160px}
 </style></head><body>
 <h1>DL4J-TPU Training UI</h1>
 <div class="card"><b>Session:</b> <select id="sess"></select>
  <span id="meta"></span></div>
 <div class="card"><b>Score vs iteration</b><canvas id="score"
  width="900" height="220"></canvas></div>
-<div class="card"><b>Layer parameter mean magnitudes</b>
+<div class="row">
+<div class="card"><b>Minibatches/sec</b><canvas id="rate" class="h"
+ width="440" height="160"></canvas></div>
+<div class="card"><b>ETL wait (ms)</b><canvas id="etl" class="h"
+ width="440" height="160"></canvas></div>
+</div>
+<div class="card"><b>Memory (host RSS MB / device bytes)</b>
+ <canvas id="mem" class="h" width="900" height="160"></canvas></div>
+<div class="card"><b>Layer histograms</b>
+ <select id="layer"></select>
+ <div class="row">
+  <div class="card"><b>parameters</b><canvas id="hp" class="h"
+   width="290" height="160"></canvas></div>
+  <div class="card"><b>gradients</b><canvas id="hg" class="h"
+   width="290" height="160"></canvas></div>
+  <div class="card"><b>updates</b><canvas id="hu" class="h"
+   width="290" height="160"></canvas></div>
+ </div></div>
+<div class="card"><b>Layer parameter summary</b>
  <pre id="layers"></pre></div>
 <script>
 async function j(u){const r=await fetch(u);return r.json()}
 function draw(cv,xs,ys){const c=cv.getContext('2d');
- c.clearRect(0,0,cv.width,cv.height);if(!xs.length)return;
- const xmin=Math.min(...xs),xmax=Math.max(...xs)||1;
- const ymin=Math.min(...ys),ymax=Math.max(...ys)||1;
+ c.clearRect(0,0,cv.width,cv.height);
+ const pts=xs.map((x,i)=>[x,ys[i]]).filter(p=>p[1]!=null);
+ if(!pts.length)return;
+ const xv=pts.map(p=>p[0]),yv=pts.map(p=>p[1]);
+ const xmin=Math.min(...xv),xmax=Math.max(...xv)||1;
+ const ymin=Math.min(...yv),ymax=Math.max(...yv)||1;
  c.strokeStyle='#2a6';c.beginPath();
- xs.forEach((x,i)=>{const px=(x-xmin)/(xmax-xmin||1)*(cv.width-40)+30;
-  const py=cv.height-20-(ys[i]-ymin)/(ymax-ymin||1)*(cv.height-40);
+ pts.forEach((p,i)=>{const px=(p[0]-xmin)/(xmax-xmin||1)*(cv.width-40)+30;
+  const py=cv.height-20-(p[1]-ymin)/(ymax-ymin||1)*(cv.height-40);
   i?c.lineTo(px,py):c.moveTo(px,py)});c.stroke();
  c.fillStyle='#333';c.fillText(ymax.toPrecision(4),2,12);
  c.fillText(ymin.toPrecision(4),2,cv.height-8)}
+function bars(cv,st){const c=cv.getContext('2d');
+ c.clearRect(0,0,cv.width,cv.height);
+ if(!st||!st.hist||!st.hist.length){c.fillStyle='#999';
+  c.fillText('no data',10,20);return}
+ const h=st.hist,hmax=Math.max(...h)||1,w=(cv.width-20)/h.length;
+ c.fillStyle='#47c';
+ h.forEach((v,i)=>{const bh=v/hmax*(cv.height-30);
+  c.fillRect(10+i*w,cv.height-15-bh,Math.max(w-1,1),bh)});
+ c.fillStyle='#333';
+ c.fillText(st.hist_edges[0].toPrecision(3),2,cv.height-3);
+ c.fillText(st.hist_edges[1].toPrecision(3),cv.width-60,cv.height-3)}
 async function refresh(){const sid=document.getElementById('sess').value;
  if(!sid)return;const ov=await j('/train/'+sid+'/overview');
  draw(document.getElementById('score'),ov.iterations,ov.scores);
+ draw(document.getElementById('rate'),ov.iterations,
+  ov.minibatches_per_sec);
+ draw(document.getElementById('etl'),ov.iterations,ov.etl_ms);
+ draw(document.getElementById('mem'),ov.iterations,
+  ov.memory.map(m=>m&&(m.max_rss_mb||m.device_bytes_in_use)||null));
  const m=await j('/train/'+sid+'/model');
  document.getElementById('meta').textContent=
   ' params='+(m.static?m.static.num_params:'?')+
   ' backend='+(m.static?m.static.jax_backend:'?');
  const L=m.latest&&m.latest.param_stats?m.latest.param_stats:{};
+ const G=m.latest&&m.latest.gradient_stats?m.latest.gradient_stats:{};
+ const U=m.latest&&m.latest.update_stats?m.latest.update_stats:{};
+ const sel=document.getElementById('layer');
+ const keys=Object.keys(L);
+ if(sel.options.length!=keys.length){sel.innerHTML='';
+  keys.forEach(k=>{const o=document.createElement('option');
+   o.value=o.textContent=k;sel.appendChild(o)})}
+ const lk=sel.value||keys[0];
+ bars(document.getElementById('hp'),L[lk]);
+ bars(document.getElementById('hg'),G[lk]);
+ bars(document.getElementById('hu'),U[lk]);
  document.getElementById('layers').textContent=Object.entries(L)
   .map(([k,v])=>k+': mean|w|='+v.mean_mag.toPrecision(4)+
    ' std='+v.std.toPrecision(4)).join('\\n')}
@@ -67,7 +117,9 @@ async function init(){const ss=await j('/train/sessions');
  const sel=document.getElementById('sess');sel.innerHTML='';
  ss.forEach(s=>{const o=document.createElement('option');
   o.value=o.textContent=s;sel.appendChild(o)});
- sel.onchange=refresh;refresh();setInterval(refresh,2000)}
+ sel.onchange=refresh;
+ document.getElementById('layer').onchange=refresh;
+ refresh();setInterval(refresh,2000)}
 init();
 </script></body></html>"""
 
@@ -179,19 +231,21 @@ class UIServer:
         st = self._find(sid)
         if st is None:
             return {"error": "unknown session"}
-        iters, scores, rates, mem = [], [], [], []
+        iters, scores, rates, mem, etl = [], [], [], [], []
         for wid in st.listWorkerIDsForSession(sid):
             for u in st.getAllUpdatesAfter(sid, TYPE_ID, wid, 0.0):
                 iters.append(u.get("iteration"))
                 scores.append(u.get("score"))
                 rates.append(u.get("minibatches_per_sec"))
                 mem.append(u.get("memory", {}))
+                etl.append(u.get("etl_ms"))
         order = sorted(range(len(iters)), key=lambda i: iters[i] or 0)
         return {
             "iterations": [iters[i] for i in order],
             "scores": [scores[i] for i in order],
             "minibatches_per_sec": [rates[i] for i in order],
             "memory": [mem[i] for i in order],
+            "etl_ms": [etl[i] for i in order],
         }
 
     def _model(self, sid: str) -> dict:

@@ -52,16 +52,20 @@ class StatsListener(TrainingListener):
                  session_id: Optional[str] = None,
                  worker_id: Optional[str] = None,
                  collect_histograms: bool = True,
-                 collect_gradients: bool = False):
+                 collect_gradients: bool = False,
+                 collect_updates: bool = False):
         self.storage = storage
         self.frequency = max(int(frequency), 1)
         self.session_id = session_id or uuid.uuid4().hex[:12]
         self.worker_id = worker_id or f"worker_{os.getpid()}"
         self.collect_histograms = collect_histograms
         self.collect_gradients = collect_gradients
+        self.collect_updates = collect_updates
         self._static_sent = False
         self._last_time = None
         self._last_iter = None
+        self._grads_fn = None
+        self._prev_params = None  # host snapshot for update deltas
 
     # -- static info on first report (reference: initialization report) --
     def _send_static(self, model) -> None:
@@ -100,17 +104,77 @@ class StatsListener(TrainingListener):
                 (iteration - self._last_iter) / dt
         self._last_time, self._last_iter = now, iteration
 
-        if self.collect_histograms and getattr(model, "params_list", None):
+        have_params = bool(getattr(model, "params_list", None))
+        if self.collect_histograms and have_params:
             layers = {}
             for i, p in enumerate(model.params_list):
                 for k, v in p.items():
                     layers[f"{i}_{k}"] = _summary(np.asarray(v))
             update["param_stats"] = layers
-        if self.collect_gradients and hasattr(model, "_last_fit_args"):
-            pass  # gradient recompute hook: see module docstring
+        if self.collect_updates and have_params:
+            # independent of collect_histograms (reference StatsListener
+            # treats parameter and update reports as separate toggles)
+            if self._prev_params is not None:
+                ustats = {}
+                for i, p in enumerate(model.params_list):
+                    for k, v in p.items():
+                        key = f"{i}_{k}"
+                        prev = self._prev_params.get(key)
+                        if prev is not None:
+                            ustats[key] = _summary(np.asarray(v) - prev)
+                update["update_stats"] = ustats
+            self._prev_params = {
+                f"{i}_{k}": np.asarray(v)
+                for i, p in enumerate(model.params_list)
+                for k, v in p.items()}
+        if self.collect_gradients:
+            gstats = self._gradient_stats(model)
+            if gstats is not None:
+                update["gradient_stats"] = gstats
+        if getattr(model, "_last_etl_ms", None) is not None:
+            update["etl_ms"] = float(model._last_etl_ms)
         update["memory"] = self._memory_stats()
         self.storage.putUpdate(self.session_id, TYPE_ID, self.worker_id,
                                update)
+
+    def _gradient_stats(self, model) -> Optional[dict]:
+        """Per-layer gradient histograms, recomputed with a second
+        compiled pass over the batch the last step consumed (module
+        docstring: the fused train step never materializes gradients
+        host-side, so this is a documented-cost opt-in, not a free
+        byproduct). Unmasked batches only — masked/fmasked steps skip
+        the report rather than recompute with wrong semantics."""
+        batch = getattr(model, "_last_fit_batch", None)
+        if batch is None or not getattr(model, "params_list", None):
+            return None
+        x, y, m, fm, rng = batch
+        if m is not None or fm is not None:
+            return None
+        import weakref
+
+        # cache keyed on the MODEL: the jit closure bakes in
+        # model._loss, so a listener re-attached to a different net
+        # must rebuild. (The cached closure itself strongly holds the
+        # CURRENT model until the listener is re-attached or dropped —
+        # same lifetime the reference's listener/model pairing has; the
+        # weakref here is only the identity key.)
+        if self._grads_fn is None or self._grads_fn[0]() is not model:
+            import jax
+
+            def grads_of(params, states, x, y, rng):
+                def scalar(pl):
+                    return model._loss(pl, states, x, y, None, rng)[0]
+
+                return jax.grad(scalar)(params)
+
+            self._grads_fn = (weakref.ref(model), jax.jit(grads_of))
+        grads = self._grads_fn[1](model.params_list, model.states_list,
+                                  x, y, rng)
+        out = {}
+        for i, g in enumerate(grads):
+            for k, v in g.items():
+                out[f"{i}_{k}"] = _summary(np.asarray(v))
+        return out
 
     @staticmethod
     def _memory_stats() -> dict:

@@ -142,3 +142,99 @@ class TestJsonModelServer:
             assert ei.value.code == 400
         finally:
             server.stop()
+
+
+class TestDashboardDepth:
+    """VERDICT r4 missing #3: the stats layer collected histograms but
+    the dashboard rendered only score. The endpoints must now serve
+    per-layer param/gradient/update histograms + memory/ETL series,
+    and the dashboard HTML must render them."""
+
+    def test_gradient_and_update_histograms_served(self):
+        st = InMemoryStatsStorage()
+        lst = StatsListener(st, session_id="gh1", worker_id="w",
+                            collect_gradients=True, collect_updates=True)
+        net = _net()
+        _fit_some(net, lst, 3)
+        ups = st.getAllUpdatesAfter("gh1", TYPE_ID, "w", 0.0)
+        last = ups[-1]
+        for field in ("param_stats", "gradient_stats", "update_stats"):
+            assert field in last, sorted(last)
+            assert "0_W" in last[field]
+            s = last[field]["0_W"]
+            assert len(s["hist"]) == 20
+            assert s["hist_edges"][0] <= s["hist_edges"][1]
+        # gradients are real: nonzero histogram mass off-center
+        assert sum(last["gradient_stats"]["0_W"]["hist"]) > 0
+        # updates are deltas: first report has none (no previous params)
+        assert "update_stats" not in ups[0]
+
+    def test_etl_time_collected_from_iterator(self):
+        from deeplearning4j_tpu.datasets import DataSet
+        from deeplearning4j_tpu.datasets.iterator import (
+            ListDataSetIterator,
+        )
+
+        st = InMemoryStatsStorage()
+        lst = StatsListener(st, session_id="etl1", worker_id="w")
+        net = _net()
+        rs = np.random.RandomState(0)
+        x = rs.randn(16, 4).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rs.randint(0, 2, 16)]
+        it = ListDataSetIterator([DataSet(x[:8], y[:8]),
+                                  DataSet(x[8:], y[8:])])
+        net.setListeners(lst)
+        net.fit(it, epochs=2)
+        ups = st.getAllUpdatesAfter("etl1", TYPE_ID, "w", 0.0)
+        assert any(u.get("etl_ms") is not None for u in ups)
+        assert all(u["etl_ms"] >= 0 for u in ups if "etl_ms" in u)
+
+    def test_overview_serves_series_and_dashboard_renders(self):
+        st = InMemoryStatsStorage()
+        lst = StatsListener(st, session_id="db1", worker_id="w",
+                            collect_gradients=True, collect_updates=True)
+        _fit_some(_net(), lst, 3)
+        ui = UIServer()
+        ui.attach(st)
+        port = ui.start(0)
+        try:
+            base = f"http://127.0.0.1:{port}"
+            ov = json.loads(urllib.request.urlopen(
+                base + "/train/db1/overview").read())
+            for field in ("iterations", "scores", "minibatches_per_sec",
+                          "memory", "etl_ms"):
+                assert field in ov, sorted(ov)
+                assert len(ov[field]) == 3
+            assert any(m.get("max_rss_mb") for m in ov["memory"])
+            model = json.loads(urllib.request.urlopen(
+                base + "/train/db1/model").read())
+            assert "gradient_stats" in model["latest"]
+            assert "update_stats" in model["latest"]
+            html = urllib.request.urlopen(base + "/").read().decode()
+            # the dashboard renders the histogram + system panels
+            for marker in ("Layer histograms", "gradients", "updates",
+                           "ETL wait", "Memory", "Minibatches/sec",
+                           "function bars"):
+                assert marker in html, marker
+        finally:
+            ui.stop()
+
+    def test_updates_without_histograms(self):
+        st = InMemoryStatsStorage()
+        lst = StatsListener(st, session_id="u1", worker_id="w",
+                            collect_histograms=False,
+                            collect_updates=True)
+        _fit_some(_net(), lst, 3)
+        last = st.getAllUpdatesAfter("u1", TYPE_ID, "w", 0.0)[-1]
+        assert "param_stats" not in last
+        assert "update_stats" in last
+
+    def test_gradient_listener_reattached_to_new_net(self):
+        st = InMemoryStatsStorage()
+        lst = StatsListener(st, session_id="r1", worker_id="w",
+                            collect_gradients=True)
+        _fit_some(_net(), lst, 2)
+        net2 = _net()
+        _fit_some(net2, lst, 2)   # jit closure must rebuild for net2
+        ups = st.getAllUpdatesAfter("r1", TYPE_ID, "w", 0.0)
+        assert all("gradient_stats" in u for u in ups)
