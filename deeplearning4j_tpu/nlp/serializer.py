@@ -1,9 +1,14 @@
 """Word-vector serialization (reference: org/deeplearning4j/models/
 embeddings/loader/WordVectorSerializer.java).
 
-Two formats, matching upstream's surface:
-- ``writeWordVectors``/``readWordVectors`` — word2vec C *text* format:
-  header line "V D", then one "word v1 .. vD" line per word.
+Three formats, matching upstream's surface:
+- ``writeWordVectors``/``readWordVectors`` — the word2vec C
+  INTERCHANGE formats, text and binary (``binary=True``): text is a
+  "V D" header then one "word v1 .. vD" line per word; binary is the
+  same header line followed by ``word + ' ' + D float32 LE bytes +
+  '\\n'`` records (what the original word2vec.c, gensim, fastText and
+  the reference's loadGoogleModel all read/write). ``readWordVectors``
+  auto-detects which of the two a file is.
 - ``writeWord2VecModel``/``readWord2VecModel`` — full model (both
   tables + vocab counts + config) as an npz/json zip, the analog of the
   reference's full-model zip (syn0 + syn1neg + frequencies).
@@ -24,8 +29,20 @@ if TYPE_CHECKING:
 
 class WordVectorSerializer:
     @staticmethod
-    def writeWordVectors(model, path: str) -> None:
+    def writeWordVectors(model, path: str, binary: bool = False) -> None:
+        """Write the word2vec C interchange format (text, or the
+        binary GoogleNews format with ``binary=True``)."""
         mat = model.getWordVectorMatrix()
+        if binary:
+            with open(path, "wb") as f:
+                f.write(f"{mat.shape[0]} {mat.shape[1]}\n".encode())
+                for i in range(mat.shape[0]):
+                    word = model.vocab.wordAtIndex(i)
+                    f.write(word.encode("utf-8") + b" ")
+                    f.write(np.asarray(mat[i],
+                                       dtype="<f4").tobytes())
+                    f.write(b"\n")
+            return
         with open(path, "w") as f:
             f.write(f"{mat.shape[0]} {mat.shape[1]}\n")
             for i in range(mat.shape[0]):
@@ -34,22 +51,59 @@ class WordVectorSerializer:
                 f.write(f"{word} {vec}\n")
 
     @staticmethod
-    def readWordVectors(path: str):
+    def _sniff_binary(path: str) -> bool:
+        """Detect text vs binary by STRUCTURE, not byte values (words
+        are UTF-8 in both formats — 'café 1.0 2.0' must not be read as
+        binary): a text file's first record decodes as UTF-8 into
+        word + exactly D parseable floats; raw float32 payload fails
+        one of those checks with near-certainty."""
+        with open(path, "rb") as f:
+            header = f.readline()
+            rec = f.readline()
+        try:
+            _v, d = (int(t) for t in header.decode("utf-8").split())
+            parts = rec.decode("utf-8").rstrip("\n").split(" ")
+            floats = [float(p) for p in parts[1:] if p]
+            return len(floats) != d
+        except (UnicodeDecodeError, ValueError):
+            return True
+
+    @staticmethod
+    def readWordVectors(path: str, binary: bool = None):
         """Returns a query-only Word2Vec (syn1neg absent, like loading
-        the C text format upstream)."""
+        the C formats upstream). ``binary=None`` auto-detects."""
         import jax.numpy as jnp
 
         from deeplearning4j_tpu.nlp.word2vec import Word2Vec
 
-        with open(path) as f:
-            v, d = (int(t) for t in f.readline().split())
-            model = Word2Vec(layer_size=d, min_word_frequency=1)
-            mat = np.zeros((v, d), np.float32)
-            words = []
-            for i in range(v):
-                parts = f.readline().rstrip("\n").split(" ")
-                words.append(parts[0])
-                mat[i] = [float(x) for x in parts[1:]]
+        if binary is None:
+            binary = WordVectorSerializer._sniff_binary(path)
+        words: list = []
+        if binary:
+            with open(path, "rb") as f:
+                header = f.readline().decode("utf-8")
+                v, d = (int(t) for t in header.split())
+                model = Word2Vec(layer_size=d, min_word_frequency=1)
+                mat = np.zeros((v, d), np.float32)
+                for i in range(v):
+                    wb = bytearray()
+                    while True:
+                        ch = f.read(1)
+                        if not ch or ch == b" ":
+                            break
+                        if ch != b"\n":   # leading newline of record
+                            wb.extend(ch)
+                    words.append(wb.decode("utf-8"))
+                    mat[i] = np.frombuffer(f.read(4 * d), dtype="<f4")
+        else:
+            with open(path) as f:
+                v, d = (int(t) for t in f.readline().split())
+                model = Word2Vec(layer_size=d, min_word_frequency=1)
+                mat = np.zeros((v, d), np.float32)
+                for i in range(v):
+                    parts = f.readline().rstrip("\n").split(" ")
+                    words.append(parts[0])
+                    mat[i] = [float(x) for x in parts[1:]]
         # index order = file order (the file is already frequency-sorted)
         for w in words:
             model.vocab.addToken(w)
@@ -70,12 +124,15 @@ class WordVectorSerializer:
                 "min_word_frequency": model.min_word_frequency,
                 "negative": model.negative,
                 "use_cbow": model.use_cbow,
+                "use_hierarchic_softmax": getattr(
+                    model, "use_hierarchic_softmax", False),
                 "words": model.vocab.words(),
                 "counts": model.vocab.counts().tolist(),
             }
             zf.writestr("config.json", json.dumps(cfg))
             for name, arr in [("syn0", model.syn0),
-                              ("syn1neg", model.syn1neg)]:
+                              ("syn1neg", model.syn1neg),
+                              ("syn1", getattr(model, "syn1", None))]:
                 if arr is None:
                     continue
                 buf = io.BytesIO()
@@ -94,7 +151,9 @@ class WordVectorSerializer:
                 layer_size=cfg["layer_size"],
                 window_size=cfg["window_size"],
                 min_word_frequency=cfg["min_word_frequency"],
-                negative=cfg["negative"], use_cbow=cfg["use_cbow"])
+                negative=cfg["negative"], use_cbow=cfg["use_cbow"],
+                use_hierarchic_softmax=cfg.get(
+                    "use_hierarchic_softmax", False))
             for w, c in zip(cfg["words"], cfg["counts"]):
                 model.vocab.addToken(w, c)
             model.vocab.finalize_vocab(1)
@@ -103,7 +162,9 @@ class WordVectorSerializer:
                 model.vocab._words[w].index = idx
             model.vocab._by_index = sorted(
                 model.vocab._words.values(), key=lambda vw: vw.index)
-            for name in ("syn0", "syn1neg"):
+            if cfg.get("use_hierarchic_softmax"):
+                model.vocab.build_huffman()
+            for name in ("syn0", "syn1neg", "syn1"):
                 if f"{name}.npy" in zf.namelist():
                     arr = np.load(io.BytesIO(zf.read(f"{name}.npy")))
                     setattr(model, name, jnp.asarray(arr))

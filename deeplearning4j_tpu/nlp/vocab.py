@@ -11,13 +11,17 @@ import numpy as np
 
 @dataclass
 class VocabWord:
-    """Ref: VocabWord — element frequency + index (huffman fields are
-    omitted: hierarchical softmax is replaced by negative sampling on
-    the batched device path)."""
+    """Ref: VocabWord — element frequency + index + huffman fields
+    (codes/points power the hierarchical-softmax learning path, built
+    by AbstractCache.build_huffman — the reference's Huffman class)."""
 
     word: str
     count: float = 1.0
     index: int = -1
+    #: Huffman code bits, root→leaf (0 = left), set by build_huffman
+    codes: Optional[List[int]] = None
+    #: inner-node ids along the path, root→parent-of-leaf
+    points: Optional[List[int]] = None
 
     def increment(self, by: float = 1.0) -> None:
         self.count += by
@@ -79,6 +83,58 @@ class AbstractCache:
 
     def counts(self) -> np.ndarray:
         return np.array([vw.count for vw in self._by_index], np.float64)
+
+    # -- hierarchical softmax support ----------------------------------
+    def build_huffman(self) -> int:
+        """Assign Huffman codes/points to every vocab word (reference:
+        org/deeplearning4j/models/word2vec/Huffman.java — binary tree
+        over frequencies; frequent words get short codes). Returns the
+        number of inner nodes (= numWords - 1, the syn1 table height).
+
+        Classic two-array O(V) construction over the frequency-sorted
+        vocab (the same algorithm as the C word2vec and the reference):
+        counts ascending; repeatedly merge the two smallest."""
+        v = len(self._by_index)
+        if v == 0:
+            return 0
+        if v == 1:
+            self._by_index[0].codes = [0]
+            self._by_index[0].points = [0]
+            return 1
+        # counts in vocab order (frequency-DESC, as the C code keeps
+        # them); pos1 scans from the tail = smallest
+        count = np.empty(2 * v - 1, np.float64)
+        count[:v] = self.counts()
+        count[v:] = np.inf
+        parent = np.zeros(2 * v - 1, np.int64)
+        binary = np.zeros(2 * v - 1, np.int8)
+        pos1, pos2 = v - 1, v
+        for a in range(v - 1):
+            if pos1 >= 0 and (pos2 >= 2 * v - 1
+                              or count[pos1] < count[pos2]):
+                min1, pos1 = pos1, pos1 - 1
+            else:
+                min1, pos2 = pos2, pos2 + 1
+            if pos1 >= 0 and (pos2 >= 2 * v - 1
+                              or count[pos1] < count[pos2]):
+                min2, pos1 = pos1, pos1 - 1
+            else:
+                min2, pos2 = pos2, pos2 + 1
+            count[v + a] = count[min1] + count[min2]
+            parent[min1] = v + a
+            parent[min2] = v + a
+            binary[min2] = 1
+        for leaf in range(v):
+            codes, points = [], []
+            node = leaf
+            while node != 2 * v - 2:
+                codes.append(int(binary[node]))
+                points.append(int(parent[node]) - v)
+                node = parent[node]
+            vw = self._by_index[leaf]      # leaf a IS vocab index a
+            vw.codes = codes[::-1]         # root→leaf order
+            vw.points = points[::-1]
+        return v - 1
 
 
 # reference exposes the interface name VocabCache; AbstractCache is its

@@ -115,6 +115,77 @@ def _cbow_step(syn0, syn1neg, window_ids, window_mask, centers, negatives,
     return syn0, syn1neg, loss
 
 
+def _avg_scatter_masked(table, idx, grads, mask, lr):
+    """_avg_scatter with a validity mask over the flattened rows
+    (padded Huffman-path slots contribute neither update nor count)."""
+    counts = jnp.zeros(table.shape[0], grads.dtype).at[idx].add(mask)
+    scale = (lr * mask) / jnp.maximum(counts[idx], 1.0)
+    return table.at[idx].add(-scale[:, None] * grads)
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def _sg_hs_step(syn0, syn1, centers, targets, pts_tab, codes_tab,
+                mask_tab, lr):
+    """One skip-gram HIERARCHICAL-SOFTMAX SGD step (reference:
+    SkipGram#iterateSample's hs branch / the C word2vec hs block —
+    redesigned as one batched device step like _sgns_step).
+
+    For each (center, target) pair the loss is the Huffman-path product
+    sum_l -log sigmoid((1-2*code_l) * <h, syn1[point_l]>); node paths
+    come from per-vocab tables gathered on device. pts/codes/mask:
+    [V, Lmax] padded tables."""
+    h = syn0[centers]                        # [B,D]
+    pts = pts_tab[targets]                   # [B,L] inner-node ids
+    codes = codes_tab[targets]               # [B,L] 0/1
+    msk = mask_tab[targets]                  # [B,L] 1=real node
+    nodes = syn1[pts]                        # [B,L,D]
+
+    logits = jnp.einsum("bd,bld->bl", h, nodes)
+    g = (jax.nn.sigmoid(logits) - (1.0 - codes)) * msk     # [B,L]
+    grad_h = jnp.einsum("bl,bld->bd", g, nodes)
+    grad_nodes = g[..., None] * h[:, None, :]
+
+    syn0 = _avg_scatter(syn0, centers, grad_h, lr)
+    syn1 = _avg_scatter_masked(
+        syn1, pts.reshape(-1), grad_nodes.reshape(-1, h.shape[-1]),
+        msk.reshape(-1), lr)
+    sgn = 1.0 - 2.0 * codes
+    loss = -(jax.nn.log_sigmoid(sgn * logits) * msk).sum(-1).mean()
+    return syn0, syn1, loss
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def _cbow_hs_step(syn0, syn1, window_ids, window_mask, centers, pts_tab,
+                  codes_tab, mask_tab, lr):
+    """CBOW hierarchical softmax: mean-of-window h predicts the center
+    word's Huffman path (reference: CBOW#iterateSample hs branch)."""
+    ctx = syn0[window_ids]
+    denom = jnp.maximum(window_mask.sum(-1, keepdims=True), 1.0)
+    h = (ctx * window_mask[..., None]).sum(1) / denom      # [B,D]
+    pts = pts_tab[centers]
+    codes = codes_tab[centers]
+    msk = mask_tab[centers]
+    nodes = syn1[pts]
+
+    logits = jnp.einsum("bd,bld->bl", h, nodes)
+    g = (jax.nn.sigmoid(logits) - (1.0 - codes)) * msk
+    grad_h = jnp.einsum("bl,bld->bd", g, nodes)
+    grad_nodes = g[..., None] * h[:, None, :]
+
+    grad_ctx = (grad_h[:, None, :] * window_mask[..., None]) \
+        / denom[..., None]
+    syn0 = _avg_scatter_masked(
+        syn0, window_ids.reshape(-1),
+        grad_ctx.reshape(-1, grad_ctx.shape[-1]),
+        window_mask.reshape(-1), lr)
+    syn1 = _avg_scatter_masked(
+        syn1, pts.reshape(-1), grad_nodes.reshape(-1, h.shape[-1]),
+        msk.reshape(-1), lr)
+    sgn = 1.0 - 2.0 * codes
+    loss = -(jax.nn.log_sigmoid(sgn * logits) * msk).sum(-1).mean()
+    return syn0, syn1, loss
+
+
 class SequenceVectors:
     """Generic distributed-representation trainer over element sequences
     (ref: SequenceVectors — Word2Vec and ParagraphVectors extend it)."""
@@ -125,6 +196,7 @@ class SequenceVectors:
                  min_learning_rate: float = 1e-4, negative: int = 5,
                  sampling: float = 0.0, batch_size: int = 512,
                  seed: int = 42, use_cbow: bool = False,
+                 use_hierarchic_softmax: bool = False,
                  tokenizer_factory: Optional[TokenizerFactory] = None):
         self.layer_size = layer_size
         self.window_size = window_size
@@ -138,11 +210,14 @@ class SequenceVectors:
         self.batch_size = batch_size
         self.seed = seed
         self.use_cbow = use_cbow
+        self.use_hierarchic_softmax = use_hierarchic_softmax
         self.tokenizer_factory = tokenizer_factory or DefaultTokenizerFactory()
 
         self.vocab = AbstractCache()
         self.syn0: Optional[jnp.ndarray] = None      # lookup table [V,D]
         self.syn1neg: Optional[jnp.ndarray] = None   # output weights [V,D]
+        self.syn1: Optional[jnp.ndarray] = None      # HS inner nodes [V-1,D]
+        self._hs_tables = None                       # (points, codes, mask)
         self._np_rng = np.random.default_rng(seed)
 
     # -- corpus → index sequences --------------------------------------
@@ -168,6 +243,20 @@ class SequenceVectors:
         self.syn0 = jnp.asarray(
             (rng.random((v, d)) - 0.5) / d, jnp.float32)
         self.syn1neg = jnp.zeros((v, d), jnp.float32)
+        if self.use_hierarchic_softmax:
+            n_inner = self.vocab.build_huffman()
+            self.syn1 = jnp.zeros((max(n_inner, 1), d), jnp.float32)
+            lmax = max(len(vw.codes) for vw in self.vocab.vocabWords())
+            pts = np.zeros((v, lmax), np.int32)
+            cds = np.zeros((v, lmax), np.float32)
+            msk = np.zeros((v, lmax), np.float32)
+            for vw in self.vocab.vocabWords():
+                L = len(vw.codes)
+                pts[vw.index, :L] = vw.points
+                cds[vw.index, :L] = vw.codes
+                msk[vw.index, :L] = 1.0
+            self._hs_tables = (jnp.asarray(pts), jnp.asarray(cds),
+                               jnp.asarray(msk))
 
     def _neg_table(self) -> np.ndarray:
         """Unigram^0.75 sampling distribution (ref: negative-sampling
@@ -238,6 +327,11 @@ class SequenceVectors:
         if self.vocab.numWords() == 0:
             raise ValueError("empty vocabulary — lower min_word_frequency?")
         self._init_tables()
+        if self.negative <= 0 and not self.use_hierarchic_softmax:
+            raise ValueError(
+                "negative=0 requires useHierarchicSoftmax(True) — no "
+                "learning objective would remain (reference: Word2Vec "
+                "builder validates the same)")
         prob = self._neg_table()
         for _ in range(self.epochs):
             if self.use_cbow:
@@ -262,13 +356,21 @@ class SequenceVectors:
         for start in range(0, n, B):
             c = centers[start:start + B]
             o = contexts[start:start + B]
-            negs = self._np_rng.choice(len(prob), size=(len(c), K), p=prob) \
-                .astype(np.int32)
             lr = self._lr_schedule(start, n)
             for _ in range(self.iterations):
-                self.syn0, self.syn1neg, self._last_loss = _sgns_step(
-                    self.syn0, self.syn1neg, jnp.asarray(c), jnp.asarray(o),
-                    jnp.asarray(negs), jnp.float32(lr))
+                if self.use_hierarchic_softmax:
+                    pts, cds, msk = self._hs_tables
+                    self.syn0, self.syn1, self._last_loss = _sg_hs_step(
+                        self.syn0, self.syn1, jnp.asarray(c),
+                        jnp.asarray(o), pts, cds, msk, jnp.float32(lr))
+                if K > 0:
+                    negs = self._np_rng.choice(
+                        len(prob), size=(len(c), K),
+                        p=prob).astype(np.int32)
+                    self.syn0, self.syn1neg, self._last_loss = _sgns_step(
+                        self.syn0, self.syn1neg, jnp.asarray(c),
+                        jnp.asarray(o), jnp.asarray(negs),
+                        jnp.float32(lr))
 
     def _fit_epoch_cbow(self, seqs, prob) -> None:
         wins, masks, centers = self._cbow_windows(seqs)
@@ -282,13 +384,22 @@ class SequenceVectors:
             w = wins[start:start + B]
             m = masks[start:start + B]
             c = centers[start:start + B]
-            negs = self._np_rng.choice(len(prob), size=(len(c), K), p=prob) \
-                .astype(np.int32)
             lr = self._lr_schedule(start, n)
             for _ in range(self.iterations):
-                self.syn0, self.syn1neg, self._last_loss = _cbow_step(
-                    self.syn0, self.syn1neg, jnp.asarray(w), jnp.asarray(m),
-                    jnp.asarray(c), jnp.asarray(negs), jnp.float32(lr))
+                if self.use_hierarchic_softmax:
+                    pts, cds, msk = self._hs_tables
+                    self.syn0, self.syn1, self._last_loss = _cbow_hs_step(
+                        self.syn0, self.syn1, jnp.asarray(w),
+                        jnp.asarray(m), jnp.asarray(c), pts, cds, msk,
+                        jnp.float32(lr))
+                if K > 0:
+                    negs = self._np_rng.choice(
+                        len(prob), size=(len(c), K),
+                        p=prob).astype(np.int32)
+                    self.syn0, self.syn1neg, self._last_loss = _cbow_step(
+                        self.syn0, self.syn1neg, jnp.asarray(w),
+                        jnp.asarray(m), jnp.asarray(c),
+                        jnp.asarray(negs), jnp.float32(lr))
 
     def _as_sentences(self, sentences) -> List[str]:
         if sentences is None:
@@ -400,6 +511,10 @@ class Word2Vec(SequenceVectors):
 
         def elementsLearningAlgorithm(self, name: str):
             self._kw["use_cbow"] = "cbow" in str(name).lower()
+            return self
+
+        def useHierarchicSoftmax(self, flag: bool = True):
+            self._kw["use_hierarchic_softmax"] = bool(flag)
             return self
 
         def tokenizerFactory(self, tf):
