@@ -415,7 +415,17 @@ def _tile(ctx):
 
 @R("Expand")
 def _expand(ctx):
+    # ONNX Expand is BIDIRECTIONAL numpy broadcasting: the requested
+    # shape's 1-dims adopt the input's size (Expand([1,1,64],[2,1,1])
+    # -> [2,1,64]) — plain broadcast_to rejects that form
     shape = [int(v) for v in ctx.static_np(1)]
+    aval = ctx.avals.get(ctx.inputs[0].name) if ctx.avals else None
+    if aval is not None:
+        ins = list(aval.shape)
+        n = max(len(ins), len(shape))
+        ins = [1] * (n - len(ins)) + ins
+        req = [1] * (n - len(shape)) + shape
+        shape = [max(a, b) for a, b in zip(ins, req)]
     return ctx.op("broadcast_to", ctx.inputs[:1], shape=shape)
 
 
@@ -477,11 +487,17 @@ def _constant(ctx):
 
 @R("ConstantOfShape")
 def _constant_of_shape(ctx):
+    # output dtype = the value tensor's dtype (spec; default f32 zero)
+    # — torch's expand-shape idiom fills int64 ones and feeds the
+    # result into shape arithmetic, so forcing f32 breaks const folding
     shape = [int(v) for v in ctx.static_np(0)]
     val = ctx.attr("value")
-    fill = float(np.asarray(val).ravel()[0]) if val is not None else 0.0
-    return ctx.sd.constant(ctx.node.output[0],
-                           np.full(shape, fill, np.float32))
+    if val is not None:
+        v = np.asarray(val)
+        arr = np.full(shape, v.ravel()[0], v.dtype)
+    else:
+        arr = np.zeros(shape, np.float32)
+    return ctx.sd.constant(ctx.node.output[0], arr)
 
 
 @R("Where")
@@ -1431,8 +1447,12 @@ def _propagate_onnx(sd, const_vals, avals, from_idx: int) -> None:
             if k < len(outs):
                 avals[on] = outs[k]
         if (len(opnode.outputs) == 1
-                and np.issubdtype(outs[0].dtype, np.integer)
+                and (np.issubdtype(outs[0].dtype, np.integer)
+                     or outs[0].dtype == np.bool_)
                 and int(np.prod(outs[0].shape, dtype=np.int64)) <= 256):
+            # bools ride the int fold: shape-selection chains like
+            # ConstantOfShape->Mul->Equal->Where (torch ViT's
+            # expand-shape idiom) break without the Equal link
             vals = []
             for iname in opnode.inputs:
                 v = const_vals.get(iname)

@@ -1,0 +1,117 @@
+"""Pallas residual-block TAIL kernel: BN-apply + ReLU + residual add
+in one elementwise pass over the conv output.
+
+Reference role: the cuDNN fused conv+BN+add+act epilogues
+(SURVEY.md §2.8-2.9). This is the round-5 probe VERDICT r4 #1(b) asked
+for — the ONE fusion class the ResNet-50 byte ledger left untried:
+the ledger's 11 ms residual-add category plus a share of the 17.4 ms
+mask traffic exists because XLA schedules BN-apply(+ReLU) and the
+residual add in separate fusions, each round-tripping the [N,H,W,C]
+tensor through HBM. This kernel reads the conv output and the residual
+ONCE and writes the activated sum ONCE.
+
+Training integration: `bn_relu_residual` is a jax.custom_vjp — the
+forward runs the kernel; the backward recomputes via jax autodiff of
+the reference formula (the recompute trade the Pallas LSTM's VJP
+makes, BASELINE.md round 4), so gradients flow through x, residual,
+gamma/beta AND the batch-stat inputs (the mean/var chain stays intact
+for upstream autodiff).
+
+A/B results live in BASELINE.md ("residual-tail fusion probe");
+bench_residual_tail.py is the measurement harness.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.ops.registry import register_op
+
+# pallas imported inside fn bodies (package convention — see
+# conv_pallas module docstring)
+
+
+def _pick_block(total, cap):
+    b = min(cap, total)
+    while total % b:
+        b -= 1
+    return b
+
+
+def _k_tail(x_ref, r_ref, mean_ref, var_ref, g_ref, b_ref, o_ref, *,
+            eps):
+    x = x_ref[...].astype(jnp.float32)
+    r = r_ref[...].astype(jnp.float32)
+    inv = jax.lax.rsqrt(var_ref[...].astype(jnp.float32) + eps)
+    z = (x - mean_ref[...]) * inv * g_ref[...] + b_ref[...] + r
+    o_ref[...] = jnp.maximum(z, 0.0).astype(o_ref.dtype)
+
+
+def _tail_kernel(x2, r2, mean, var, gamma, beta, eps, interpret):
+    from jax.experimental import pallas as pl
+
+    rows, c = x2.shape
+    # VMEM budget: 3 row-blocks (x, res, out) double-buffered + f32
+    # temps; cap the block at ~256 KB per buffer so C=2048 still fits
+    bm = _pick_block(rows, max(8, (256 * 1024) // (2 * c)))
+    grid = (rows // bm,)
+    row_spec = pl.BlockSpec((bm, c), lambda i: (i, 0))
+    chan_spec = pl.BlockSpec((1, c), lambda i: (0, 0))
+    return pl.pallas_call(
+        functools.partial(_k_tail, eps=eps),
+        grid=grid,
+        in_specs=[row_spec, row_spec, chan_spec, chan_spec, chan_spec,
+                  chan_spec],
+        out_specs=row_spec,
+        out_shape=jax.ShapeDtypeStruct((rows, c), x2.dtype),
+        interpret=interpret,
+    )(x2, r2, mean.reshape(1, c).astype(jnp.float32),
+      var.reshape(1, c).astype(jnp.float32),
+      gamma.reshape(1, c).astype(jnp.float32),
+      beta.reshape(1, c).astype(jnp.float32))
+
+
+def _ref_formula(x, res, mean, var, gamma, beta, eps):
+    inv = jax.lax.rsqrt(var.astype(jnp.float32) + eps)
+    z = (x.astype(jnp.float32) - mean) * inv * gamma + beta \
+        + res.astype(jnp.float32)
+    return jnp.maximum(z, 0.0).astype(x.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6,))
+def bn_relu_residual(x, res, mean, var, gamma, beta, eps=1e-5):
+    """relu(batchnorm_apply(x; mean,var,gamma,beta) + res) in one HBM
+    pass. x/res: [N,H,W,C] (or any [..., C]); stats/params: [C].
+    Off-TPU falls back to the jnp formula (numerics identical)."""
+    if jax.default_backend() != "tpu":
+        return _ref_formula(x, res, mean, var, gamma, beta, eps)
+    c = x.shape[-1]
+    x2 = x.reshape(-1, c)
+    r2 = res.reshape(-1, c)
+    out = _tail_kernel(x2, r2, mean, var, gamma, beta, eps,
+                       interpret=False)
+    return out.reshape(x.shape)
+
+
+def _fwd(x, res, mean, var, gamma, beta, eps):
+    return (bn_relu_residual(x, res, mean, var, gamma, beta, eps),
+            (x, res, mean, var, gamma, beta))
+
+
+def _bwd(eps, saved, g):
+    x, res, mean, var, gamma, beta = saved
+    _, vjp = jax.vjp(
+        lambda *a: _ref_formula(*a, eps), x, res, mean, var, gamma,
+        beta)
+    return vjp(g)
+
+
+bn_relu_residual.defvjp(_fwd, _bwd)
+
+
+@register_op("bn_relu_residual")
+def _op(x, res, mean, var, gamma, beta, eps=1e-5):
+    return bn_relu_residual(x, res, mean, var, gamma, beta, eps)

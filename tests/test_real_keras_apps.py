@@ -1,0 +1,147 @@
+"""Real-producer e2e goldens: Keras-applications CNNs + torch ViT ONNX
+(reference model: TFGraphTestAllSameDiff / KerasModelEndToEndTest run
+REAL saved architectures, SURVEY.md §4; VERDICT r4 next-step #4).
+
+Models are built locally with random weights (weights=None — the
+environment has zero egress), frozen/exported by their REAL producers
+(tf.keras.applications freezing, torch.onnx), imported, and compared
+against the producer's own execution. MobileNetV2 additionally
+fine-tunes through the whole-graph-jit SameDiff path.
+"""
+
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+
+from deeplearning4j_tpu.modelimport.tensorflow import TFGraphMapper
+
+
+def _calibrate_bn(model, shape, seed=3):
+    """Pin BN moving stats to one batch's stats: a deep random-init
+    stack with unit inference stats shrinks activations geometrically
+    (measured 1e-11 feature std on MobileNetV2), making the frozen
+    forward numerically dead and fine-tune gradients zero. One
+    momentum=0 training pass restores healthy per-layer scales."""
+    import numpy as np
+
+    for lyr in model.layers:
+        if isinstance(lyr, tf.keras.layers.BatchNormalization):
+            lyr.momentum = 0.0
+    xb = np.random.default_rng(seed).normal(size=shape).astype(
+        np.float32)
+    model(xb, training=True)
+
+
+def _freeze_keras_app(model):
+    from tensorflow.python.framework.convert_to_constants import (
+        convert_variables_to_constants_v2,
+    )
+
+    @tf.function
+    def f(x):
+        return model(x, training=False)
+
+    spec = tf.TensorSpec([None] + list(model.input_shape[1:]),
+                         tf.float32)
+    frozen = convert_variables_to_constants_v2(
+        f.get_concrete_function(spec))
+    gd = frozen.graph.as_graph_def()
+    ins = [t.name.split(":")[0] for t in frozen.inputs]
+    out = frozen.outputs[0].name.split(":")[0]
+    return gd, ins, out, frozen
+
+
+class TestKerasApplicationsImport:
+    def test_mobilenet_v2_golden_and_finetune(self):
+        """Full MobileNetV2 (alpha=0.35, 96x96 to keep CI time sane —
+        still the real 155-layer architecture: depthwise convs, relu6,
+        BN folding, residual adds, zero-pad stride-2 blocks)."""
+        m = tf.keras.applications.MobileNetV2(
+            input_shape=(96, 96, 3), alpha=0.35, weights=None,
+            classes=10)
+        _calibrate_bn(m, (8, 96, 96, 3))
+        gd, ins, out, frozen = _freeze_keras_app(m)
+        assert len(gd.node) > 300   # real node set
+        sd = TFGraphMapper.importGraph(gd)
+
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(2, 96, 96, 3)).astype(np.float32)
+        r = frozen(tf.constant(x))
+        ref = np.asarray(r[0] if isinstance(r, (list, tuple)) else r)
+        got = np.asarray(sd.output({ins[0]: x}, [out])[out])
+        np.testing.assert_allclose(got, ref, rtol=2e-3, atol=2e-4)
+
+        # fine-tune: promote float matrices to variables, attach a CE
+        # loss, run the compiled whole-graph step
+        for v in list(sd.variables()):
+            if v.vtype.value == "CONSTANT" and v.name in sd._arrays \
+                    and sd._arrays[v.name].ndim >= 2 \
+                    and np.asarray(sd._arrays[v.name]).dtype.kind == "f":
+                sd.convertConstantsToVariables(v.name)
+        assert sd.trainable_names()
+
+        from deeplearning4j_tpu.autodiff import TrainingConfig
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        from deeplearning4j_tpu.learning.updaters import Adam
+
+        y = sd.placeholder("y", shape=(None, 10))
+        logp = sd.nn.log_softmax(sd.getVariable(out))
+        loss = -(y * logp).sum(-1).mean()
+        sd.setLossVariables(loss.name)
+        sd.setTrainingConfig(TrainingConfig(
+            updater=Adam(1e-3),
+            data_set_feature_mapping=list(ins),
+            data_set_label_mapping=["y"]))
+        labels = np.eye(10, dtype=np.float32)[
+            rng.integers(0, 10, 2)]
+        hist = sd.fit(DataSet(x, labels), epochs=15)
+        assert hist.loss_curve[-1] < hist.loss_curve[0] * 0.9
+
+    def test_resnet50v2_golden(self):
+        """ResNet50V2 (real 190-node-class architecture: pre-activation
+        BN, strided residual branches, global pooling head)."""
+        m = tf.keras.applications.ResNet50V2(
+            input_shape=(64, 64, 3), weights=None, classes=7)
+        _calibrate_bn(m, (8, 64, 64, 3))
+        gd, ins, out, frozen = _freeze_keras_app(m)
+        assert len(gd.node) > 300
+        sd = TFGraphMapper.importGraph(gd)
+
+        rng = np.random.default_rng(1)
+        x = rng.normal(size=(2, 64, 64, 3)).astype(np.float32)
+        r = frozen(tf.constant(x))
+        ref = np.asarray(r[0] if isinstance(r, (list, tuple)) else r)
+        got = np.asarray(sd.output({ins[0]: x}, [out])[out])
+        np.testing.assert_allclose(got, ref, rtol=2e-3, atol=2e-4)
+
+
+class TestTorchViTOnnx:
+    def test_vit_onnx_golden(self, monkeypatch):
+        """transformers ViTModel exported by torch.onnx (the exporter
+        shim from the verify notes), imported, compared to torch."""
+        import io
+
+        import torch
+        import torch.onnx._internal.torchscript_exporter.\
+            onnx_proto_utils as opu
+        from transformers import ViTConfig, ViTModel
+
+        from deeplearning4j_tpu.modelimport.onnx import OnnxImport
+
+        monkeypatch.setattr(opu, "_add_onnxscript_fn",
+                            lambda *a, **k: a[0])
+        cfg = ViTConfig(hidden_size=64, num_hidden_layers=2,
+                        num_attention_heads=4, intermediate_size=128,
+                        image_size=32, patch_size=8)
+        model = ViTModel(cfg).eval()
+        x = torch.randn(2, 3, 32, 32)
+        buf = io.BytesIO()
+        torch.onnx.export(model, (x,), buf, input_names=["pix"],
+                          output_names=["h", "pooled"],
+                          opset_version=14, dynamo=False)
+        with torch.no_grad():
+            ref = model(x).last_hidden_state.numpy()
+        sd = OnnxImport.importGraph(buf.getvalue())
+        got = np.asarray(sd.output({"pix": x.numpy()}, ["h"])["h"])
+        np.testing.assert_allclose(got, ref, rtol=2e-3, atol=2e-4)

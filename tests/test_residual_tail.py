@@ -1,0 +1,90 @@
+"""Residual-tail fused kernel (ops/residual_tail_pallas.py): numerics
++ gradients vs the composed jnp reference (reference role: cuDNN fused
+conv+BN+add+act epilogues, SURVEY.md §2.8-2.9; round-5 probe)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.ops.residual_tail_pallas import (
+    _ref_formula, _tail_kernel, bn_relu_residual,
+)
+
+
+def _inputs(seed=0, n=2, h=4, w=4, c=128, dtype=jnp.float32):
+    rs = np.random.RandomState(seed)
+    x = jnp.asarray(rs.randn(n, h, w, c), dtype)
+    r = jnp.asarray(rs.randn(n, h, w, c), dtype)
+    mean = jnp.asarray(rs.randn(c) * 0.1, jnp.float32)
+    var = jnp.asarray(rs.rand(c) + 0.5, jnp.float32)
+    gamma = jnp.asarray(rs.rand(c) + 0.5, jnp.float32)
+    beta = jnp.asarray(rs.randn(c) * 0.1, jnp.float32)
+    return x, r, mean, var, gamma, beta
+
+
+class TestForward:
+    def test_matches_composed_ops(self):
+        args = _inputs()
+        got = bn_relu_residual(*args)
+        want = _ref_formula(*args, 1e-5)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-6, atol=1e-6)
+
+    def test_kernel_interpret_matches(self):
+        # the pallas body itself (interpret mode on CPU), not the
+        # off-TPU fallback path
+        x, r, mean, var, gamma, beta = _inputs(seed=1)
+        c = x.shape[-1]
+        got = _tail_kernel(x.reshape(-1, c), r.reshape(-1, c), mean,
+                           var, gamma, beta, 1e-5, interpret=True)
+        want = _ref_formula(x, r, mean, var, gamma, beta,
+                            1e-5).reshape(-1, c)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_bf16_io(self):
+        args = _inputs(seed=2, dtype=jnp.bfloat16)
+        got = bn_relu_residual(*args)
+        assert got.dtype == jnp.bfloat16
+        want = _ref_formula(*args, 1e-5)
+        np.testing.assert_allclose(
+            np.asarray(got, np.float32), np.asarray(want, np.float32),
+            rtol=1e-2, atol=1e-2)
+
+
+class TestGradients:
+    def test_grads_match_autodiff_of_composition(self):
+        args = _inputs(seed=3)
+
+        def loss_fused(*a):
+            return jnp.sum(bn_relu_residual(*a) ** 2)
+
+        def loss_ref(*a):
+            return jnp.sum(_ref_formula(*a, 1e-5) ** 2)
+
+        g1 = jax.grad(loss_fused, argnums=tuple(range(6)))(*args)
+        g2 = jax.grad(loss_ref, argnums=tuple(range(6)))(*args)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-5, atol=1e-5)
+
+    def test_batch_stats_chain_flows(self):
+        """mean/var computed FROM x (training-mode BN): the custom VJP
+        must not cut the stats chain — grad wrt x includes it."""
+        x, r, _, _, gamma, beta = _inputs(seed=4)
+
+        def full(x):
+            mean = jnp.mean(x, (0, 1, 2))
+            var = jnp.var(x, (0, 1, 2))
+            return jnp.sum(
+                bn_relu_residual(x, r, mean, var, gamma, beta) ** 2)
+
+        def full_ref(x):
+            mean = jnp.mean(x, (0, 1, 2))
+            var = jnp.var(x, (0, 1, 2))
+            return jnp.sum(
+                _ref_formula(x, r, mean, var, gamma, beta, 1e-5) ** 2)
+
+        np.testing.assert_allclose(
+            np.asarray(jax.grad(full)(x)),
+            np.asarray(jax.grad(full_ref)(x)), rtol=1e-5, atol=1e-5)
