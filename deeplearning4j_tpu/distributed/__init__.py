@@ -34,7 +34,10 @@ What this module therefore provides:
 from __future__ import annotations
 
 import dataclasses
+import logging
+import threading
 import time
+import weakref
 from typing import Any, Callable, Dict, List, Optional
 
 import jax
@@ -125,10 +128,12 @@ class MeshOrganizer:
 
     def sweep(self, now: Optional[float] = None) -> List[str]:
         """Mark nodes with stale heartbeats dead; return newly-dead ids
-        (reference: heartbeat timeout -> remap)."""
+        (reference: heartbeat timeout -> remap). Iterates a snapshot:
+        the background sweeper thread runs this concurrently with
+        main-thread addNode/removeNode."""
         now = now if now is not None else time.time()
         dead = []
-        for n in self._nodes.values():
+        for n in list(self._nodes.values()):
             if n.alive and now - n.last_heartbeat > self.HEARTBEAT_TIMEOUT_S:
                 n.alive = False
                 dead.append(n.node_id)
@@ -177,18 +182,57 @@ class ModelParameterServer:
     """
 
     def __init__(self, organizer: Optional[MeshOrganizer] = None,
-                 is_master: bool = True):
+                 is_master: bool = True,
+                 sweep_interval_s: float = 1.0):
         self.organizer = organizer or MeshOrganizer()
         self.is_master = is_master
+        self.sweep_interval_s = sweep_interval_s
         self._launched = False
         self._params: Optional[np.ndarray] = None
         self._subscribers: List[Callable[[np.ndarray], None]] = []
+        self._sweeper: Optional[threading.Thread] = None
+        self._stop_sweeper: Optional[threading.Event] = None
 
     def launch(self) -> None:
+        """Start the facade AND the background heartbeat sweeper
+        (reference: the v2 server's transport thread drives heartbeat
+        timeouts continuously — detection must not depend on anyone
+        remembering to call sweep()). The loop holds only a WEAK ref to
+        the server, so a launch()ed-but-never-shutdown() server that
+        goes out of scope lets its thread exit instead of leaking; a
+        raising membership listener is logged, not allowed to kill
+        detection."""
         self._launched = True
+        if self._sweeper is None:
+            stop = threading.Event()
+            wself = weakref.ref(self)
+            interval = self.sweep_interval_s
+
+            def loop():
+                while not stop.wait(interval):
+                    s = wself()
+                    if s is None or not s._launched:
+                        return
+                    try:
+                        s.organizer.sweep()
+                    except Exception:
+                        logging.getLogger(__name__).exception(
+                            "heartbeat sweep failed (listener error?) "
+                            "— detection continues")
+
+            t = threading.Thread(target=loop, daemon=True,
+                                 name="mps-heartbeat-sweeper")
+            self._stop_sweeper = stop
+            self._sweeper = t
+            t.start()
 
     def shutdown(self) -> None:
         self._launched = False
+        if self._sweeper is not None:
+            self._stop_sweeper.set()
+            self._sweeper.join(timeout=5.0)
+            self._sweeper = None
+            self._stop_sweeper = None
 
     def isInitialized(self) -> bool:
         return self._launched
