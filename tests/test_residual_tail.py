@@ -88,3 +88,14 @@ class TestGradients:
         np.testing.assert_allclose(
             np.asarray(jax.grad(full)(x)),
             np.asarray(jax.grad(full_ref)(x)), rtol=1e-5, atol=1e-5)
+
+
+class TestRegistryDispatch:
+    def test_op_registry_name(self):
+        from deeplearning4j_tpu.ops.registry import get_op
+
+        args = _inputs(seed=5)
+        got = get_op("bn_relu_residual")(*args)
+        want = _ref_formula(*args, 1e-5)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-6, atol=1e-6)
