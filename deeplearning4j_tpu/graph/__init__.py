@@ -1,0 +1,572 @@
+"""Graph vertex embeddings — the ``deeplearning4j-graph`` module.
+
+Reference (eclipse/deeplearning4j monorepo, module
+``deeplearning4j/deeplearning4j-graph``):
+
+- ``org/deeplearning4j/graph/api/{IGraph,Vertex,Edge,NoEdgeHandling}.java``
+  — the graph API consumed by the embedding models.
+- ``org/deeplearning4j/graph/graph/Graph.java`` — adjacency-list
+  in-memory graph.
+- ``org/deeplearning4j/graph/data/GraphLoader.java`` — edge-list file
+  loaders.
+- ``org/deeplearning4j/graph/iterator/{RandomWalkIterator,
+  WeightedRandomWalkIterator}.java`` — uniform / edge-weight-biased
+  random walk sequence generators.
+- ``org/deeplearning4j/graph/models/deepwalk/{DeepWalk,GraphHuffman,
+  InMemoryGraphLookupTable}.java`` — the DeepWalk model (Perozzi et al.
+  2014): skip-gram over random walks with a degree-keyed Huffman
+  hierarchical-softmax output.
+- ``org/deeplearning4j/graph/models/GraphVectors.java`` — the query
+  interface (vertex vector, similarity, nearest vertices).
+
+TPU-first redesign
+------------------
+The reference walks the graph one step at a time per walk and does one
+JNI-dispatched gradient update per (center, context) pair. Here:
+
+- The graph is stored as CSR (``indptr``/``indices`` + an edge-aligned
+  GLOBAL cumulative-weight array), so WHOLE BATCHES of random walks
+  advance one step per numpy operation: uniform walks gather
+  ``indices[indptr[cur] + floor(u * degree[cur])]`` for every active
+  walk at once; weighted walks draw a target mass inside each row's
+  span of the global cumsum and ``np.searchsorted`` it back to an edge
+  — no per-walk Python loop, no ragged row scan.
+- Training reuses the device-batched hierarchical-softmax skip-gram
+  step from :mod:`deeplearning4j_tpu.nlp.word2vec` (``_sg_hs_step``):
+  thousands of (center, target) pairs per compiled XLA step instead of
+  per-pair scalar updates. The Huffman tree is keyed by vertex degree
+  exactly like the reference's ``GraphHuffman``.
+"""
+from __future__ import annotations
+
+import os
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nlp.vocab import AbstractCache
+from deeplearning4j_tpu.nlp.word2vec import _sg_hs_step
+
+
+class NoEdgeHandling:
+    """What a walk does at a vertex with no outgoing edge (reference:
+    org/deeplearning4j/graph/api/NoEdgeHandling.java)."""
+    SELF_LOOP_ON_DISCONNECTED = "SELF_LOOP_ON_DISCONNECTED"
+    EXCEPTION_ON_DISCONNECTED = "EXCEPTION_ON_DISCONNECTED"
+
+
+class Vertex:
+    """A graph vertex: integer index + arbitrary value payload
+    (reference: org/deeplearning4j/graph/api/Vertex.java)."""
+
+    def __init__(self, idx: int, value=None):
+        self.idx = int(idx)
+        self.value = value
+
+    def vertexID(self) -> int:
+        return self.idx
+
+    def getValue(self):
+        return self.value
+
+    def __repr__(self):
+        return f"Vertex({self.idx}, {self.value!r})"
+
+
+class Edge:
+    """reference: org/deeplearning4j/graph/api/Edge.java."""
+
+    def __init__(self, frm: int, to: int, value=None,
+                 directed: bool = False):
+        self.frm = int(frm)
+        self.to = int(to)
+        self.value = value
+        self.directed = bool(directed)
+
+    def getFrom(self) -> int:
+        return self.frm
+
+    def getTo(self) -> int:
+        return self.to
+
+
+class Graph:
+    """Adjacency-list graph (reference:
+    org/deeplearning4j/graph/graph/Graph.java). Vertices are dense
+    integer ids ``0..n-1``; edges carry an optional float weight used
+    by the weighted walk iterator."""
+
+    def __init__(self, num_vertices: int,
+                 allow_multiple_edges: bool = True,
+                 vertex_factory: Optional[Callable[[int], object]] = None):
+        if num_vertices <= 0:
+            raise ValueError("num_vertices must be positive")
+        self._vertices = [
+            Vertex(i, vertex_factory(i) if vertex_factory else None)
+            for i in range(num_vertices)]
+        self._adj: List[List[Tuple[int, float]]] = \
+            [[] for _ in range(num_vertices)]
+        self._allow_multi = allow_multiple_edges
+        self._edge_count = 0
+        self._csr = None   # invalidated on mutation
+
+    # -- construction --------------------------------------------------
+    def addEdge(self, frm: int, to: int, weight: float = 1.0,
+                directed: bool = False) -> None:
+        if not (0 <= frm < self.numVertices()
+                and 0 <= to < self.numVertices()):
+            raise ValueError(
+                f"edge ({frm},{to}) out of range for "
+                f"{self.numVertices()} vertices")
+        if weight <= 0:
+            raise ValueError("edge weight must be > 0")
+        if not self._allow_multi and any(
+                t == to for t, _ in self._adj[frm]):
+            return
+        self._adj[frm].append((to, float(weight)))
+        if not directed and frm != to:
+            self._adj[to].append((frm, float(weight)))
+        self._edge_count += 1
+        self._csr = None
+
+    # -- queries (reference IGraph surface) ----------------------------
+    def numVertices(self) -> int:
+        return len(self._vertices)
+
+    def numEdges(self) -> int:
+        return self._edge_count
+
+    def getVertex(self, idx: int) -> Vertex:
+        return self._vertices[idx]
+
+    def getVertexDegree(self, idx: int) -> int:
+        return len(self._adj[idx])
+
+    def getConnectedVertexIndices(self, idx: int) -> List[int]:
+        return [t for t, _ in self._adj[idx]]
+
+    # -- CSR view (the walk engine's format) ---------------------------
+    def csr(self):
+        """(indptr[n+1], indices[m], cumw[m]) — ``cumw`` is the GLOBAL
+        running sum of edge weights in CSR order, so row r's weights
+        occupy ``cumw[indptr[r]:indptr[r+1]]`` ending at the row's
+        total-mass prefix; weighted sampling is one global
+        ``searchsorted`` (see module docstring)."""
+        if self._csr is None:
+            n = self.numVertices()
+            degrees = np.array([len(a) for a in self._adj], np.int64)
+            indptr = np.zeros(n + 1, np.int64)
+            np.cumsum(degrees, out=indptr[1:])
+            indices = np.empty(indptr[-1], np.int64)
+            weights = np.empty(indptr[-1], np.float64)
+            for r, adj in enumerate(self._adj):
+                if adj:
+                    indices[indptr[r]:indptr[r + 1]] = [t for t, _ in adj]
+                    weights[indptr[r]:indptr[r + 1]] = [w for _, w in adj]
+            self._csr = (indptr, indices, np.cumsum(weights))
+        return self._csr
+
+
+class GraphLoader:
+    """Edge-list file loaders (reference:
+    org/deeplearning4j/graph/data/GraphLoader.java)."""
+
+    @staticmethod
+    def loadUndirectedGraphEdgeListFile(path: str, num_vertices: int,
+                                        delimiter: str = ",") -> Graph:
+        g = Graph(num_vertices)
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line or line.startswith("#"):
+                    continue
+                parts = line.split(delimiter)
+                if len(parts) < 2:
+                    raise ValueError(f"bad edge line: {line!r}")
+                g.addEdge(int(parts[0]), int(parts[1]))
+        return g
+
+    @staticmethod
+    def loadWeightedEdgeListFile(path: str, num_vertices: int,
+                                 delimiter: str = ",",
+                                 directed: bool = False) -> Graph:
+        g = Graph(num_vertices)
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line or line.startswith("#"):
+                    continue
+                parts = line.split(delimiter)
+                if len(parts) < 3:
+                    raise ValueError(
+                        f"weighted edge line needs from,to,weight: "
+                        f"{line!r}")
+                g.addEdge(int(parts[0]), int(parts[1]),
+                          weight=float(parts[2]), directed=directed)
+        return g
+
+
+# ---------------------------------------------------------------------
+# Random walk generation — vectorised over ALL walks simultaneously
+# ---------------------------------------------------------------------
+
+def generate_random_walks(
+        graph: Graph, walk_length: int,
+        starts: Optional[Sequence[int]] = None,
+        weighted: bool = False, seed: int = 0,
+        no_edge_handling: str =
+        NoEdgeHandling.SELF_LOOP_ON_DISCONNECTED) -> np.ndarray:
+    """All walks advance together, one numpy op per step (reference
+    iterators RandomWalkIterator / WeightedRandomWalkIterator produce
+    one IVertexSequence at a time; here the whole batch is one
+    [num_walks, walk_length+1] matrix — the shape the batched HS
+    trainer wants).
+
+    ``walk_length`` counts EDGES, matching the reference's convention
+    (a length-L walk visits L+1 vertices)."""
+    indptr, indices, cumw = graph.csr()
+    if starts is None:
+        starts = np.arange(graph.numVertices(), dtype=np.int64)
+    cur = np.asarray(starts, np.int64).copy()
+    if cur.size and (cur.min() < 0 or cur.max() >= graph.numVertices()):
+        raise ValueError(
+            f"start vertices out of range [0, {graph.numVertices()}): "
+            f"{cur[(cur < 0) | (cur >= graph.numVertices())].tolist()}")
+
+    degrees = (indptr[1:] - indptr[:-1])
+    if no_edge_handling == NoEdgeHandling.EXCEPTION_ON_DISCONNECTED:
+        dead = np.unique(cur[degrees[cur] == 0])
+        if dead.size:
+            raise ValueError(
+                f"vertices {dead.tolist()} have no outgoing edges "
+                "(NoEdgeHandling.EXCEPTION_ON_DISCONNECTED)")
+
+    rng = np.random.default_rng(seed)
+    walks = np.empty((len(cur), walk_length + 1), np.int64)
+    walks[:, 0] = cur
+    row_base = np.concatenate(([0.0], cumw))  # mass before row start
+
+    for step in range(walk_length):
+        deg = degrees[cur]
+        alive = deg > 0
+        if no_edge_handling == NoEdgeHandling.EXCEPTION_ON_DISCONNECTED \
+                and not alive.all():
+            raise ValueError(
+                f"walk reached a disconnected vertex at step {step} "
+                "(NoEdgeHandling.EXCEPTION_ON_DISCONNECTED)")
+        u = rng.random(len(cur))
+        nxt = cur.copy()                      # self-loop on dead ends
+        if alive.any():
+            if weighted:
+                lo = row_base[indptr[cur[alive]]]
+                hi = row_base[indptr[cur[alive]] + deg[alive]]
+                pos = np.searchsorted(cumw, lo + u[alive] * (hi - lo),
+                                      side="right")
+                pos = np.minimum(pos, indptr[cur[alive]] + deg[alive] - 1)
+                nxt[alive] = indices[pos]
+            else:
+                offs = (u[alive] * deg[alive]).astype(np.int64)
+                nxt[alive] = indices[indptr[cur[alive]] + offs]
+        cur = nxt
+        walks[:, step + 1] = cur
+    return walks
+
+
+class RandomWalkIterator:
+    """Uniform random walks, one start per vertex (reference:
+    org/deeplearning4j/graph/iterator/RandomWalkIterator.java). Kept as
+    a thin iterator facade over the batched generator for API parity —
+    the model consumes the batch directly."""
+
+    def __init__(self, graph: Graph, walk_length: int, seed: int = 0,
+                 no_edge_handling: str =
+                 NoEdgeHandling.SELF_LOOP_ON_DISCONNECTED,
+                 weighted: bool = False):
+        self.graph = graph
+        self.walk_length = walk_length
+        self.no_edge_handling = no_edge_handling
+        self.weighted = weighted
+        # persistent RNG so each reset() yields FRESH walks, like the
+        # reference iterator's long-lived java.util.Random
+        self._seed_rng = np.random.default_rng(seed)
+        self.reset()
+
+    def reset(self) -> None:
+        self._walks = generate_random_walks(
+            self.graph, self.walk_length, weighted=self.weighted,
+            seed=int(self._seed_rng.integers(2 ** 31)),
+            no_edge_handling=self.no_edge_handling)
+        self._pos = 0
+
+    def hasNext(self) -> bool:
+        return self._pos < len(self._walks)
+
+    def next(self) -> List[int]:
+        if not self.hasNext():
+            raise StopIteration
+        w = self._walks[self._pos].tolist()
+        self._pos += 1
+        return w
+
+
+class WeightedRandomWalkIterator(RandomWalkIterator):
+    """Edge-weight-biased walks (reference:
+    org/deeplearning4j/graph/iterator/WeightedRandomWalkIterator.java)."""
+
+    def __init__(self, graph: Graph, walk_length: int, seed: int = 0,
+                 no_edge_handling: str =
+                 NoEdgeHandling.SELF_LOOP_ON_DISCONNECTED):
+        super().__init__(graph, walk_length, seed=seed,
+                         no_edge_handling=no_edge_handling,
+                         weighted=True)
+
+
+# ---------------------------------------------------------------------
+# DeepWalk
+# ---------------------------------------------------------------------
+
+def _cosine(a: np.ndarray, b: np.ndarray) -> float:
+    denom = np.linalg.norm(a) * np.linalg.norm(b)
+    return float(a @ b / denom) if denom else 0.0
+
+
+def _nearest(mat: np.ndarray, vertex: int, top: int) -> List[int]:
+    q = mat[vertex]
+    sims = mat @ q / (np.linalg.norm(mat, axis=1)
+                      * np.linalg.norm(q) + 1e-12)
+    sims[vertex] = -np.inf
+    return np.argsort(-sims)[:top].tolist()
+
+class GraphHuffman:
+    """Degree-keyed Huffman coding of the vertex set (reference:
+    org/deeplearning4j/graph/models/deepwalk/GraphHuffman.java — there
+    codes are built over vertex degrees so hub vertices get short
+    paths). Reuses the word2vec vocab cache's two-array O(V) builder;
+    vertices are cache "words" keyed by their id string."""
+
+    def __init__(self, graph: Graph):
+        cache = AbstractCache()
+        for v in range(graph.numVertices()):
+            # +1 so degree-0 vertices still carry positive mass and the
+            # frequency-DESC sort the builder assumes stays total
+            cache.addToken(str(v), by=graph.getVertexDegree(v) + 1.0)
+        cache.finalize_vocab(min_word_frequency=0)
+        self.cache = cache
+        self.n_inner = cache.build_huffman()
+        # vertex id -> frequency-sorted cache row (the embedding row)
+        self.vertex_to_row = np.array(
+            [cache.indexOf(str(v)) for v in range(graph.numVertices())],
+            np.int32)
+        self.row_to_vertex = np.empty(graph.numVertices(), np.int32)
+        self.row_to_vertex[self.vertex_to_row] = np.arange(
+            graph.numVertices(), dtype=np.int32)
+
+    def getCodeLength(self, vertex: int) -> int:
+        return len(self.cache.vocabWords()[
+            self.vertex_to_row[vertex]].codes)
+
+    def path_tables(self):
+        """Padded [V, Lmax] (points, codes, mask) device tables in ROW
+        order — the same layout SequenceVectors._init_tables builds."""
+        words = self.cache.vocabWords()
+        lmax = max(len(vw.codes) for vw in words)
+        v = len(words)
+        pts = np.zeros((v, lmax), np.int32)
+        cds = np.zeros((v, lmax), np.float32)
+        msk = np.zeros((v, lmax), np.float32)
+        for vw in words:
+            L = len(vw.codes)
+            pts[vw.index, :L] = vw.points
+            cds[vw.index, :L] = vw.codes
+            msk[vw.index, :L] = 1.0
+        return jnp.asarray(pts), jnp.asarray(cds), jnp.asarray(msk)
+
+
+class DeepWalk:
+    """DeepWalk vertex embeddings (reference:
+    org/deeplearning4j/graph/models/deepwalk/DeepWalk.java + its
+    InMemoryGraphLookupTable): skip-gram with hierarchical softmax over
+    random walk windows. Training is device-batched: every window pair
+    of every walk in the batch goes through ONE compiled HS step.
+
+    Implements the reference ``GraphVectors`` query interface
+    (org/deeplearning4j/graph/models/GraphVectors.java)."""
+
+    def __init__(self, vector_size: int = 100, window_size: int = 5,
+                 learning_rate: float = 0.025, seed: int = 12345,
+                 batch_size: int = 4096):
+        self.vector_size = vector_size
+        self.window_size = window_size
+        self.learning_rate = learning_rate
+        self.seed = seed
+        self.batch_size = batch_size
+        self.syn0 = None
+        self.syn1 = None
+        self._huffman: Optional[GraphHuffman] = None
+        self._graph: Optional[Graph] = None
+        self._rng = np.random.default_rng(seed)
+
+    class Builder:
+        """reference: DeepWalk.Builder fluent config."""
+
+        def __init__(self):
+            self._kw = {}
+
+        def vectorSize(self, n: int):
+            self._kw["vector_size"] = n
+            return self
+
+        def windowSize(self, n: int):
+            self._kw["window_size"] = n
+            return self
+
+        def learningRate(self, lr: float):
+            self._kw["learning_rate"] = lr
+            return self
+
+        def seed(self, s: int):
+            self._kw["seed"] = s
+            return self
+
+        def batchSize(self, b: int):
+            self._kw["batch_size"] = b
+            return self
+
+        def build(self) -> "DeepWalk":
+            return DeepWalk(**self._kw)
+
+    # -- lifecycle -----------------------------------------------------
+    def initialize(self, graph: Graph) -> None:
+        """Build the degree-Huffman tree + tables (reference:
+        DeepWalk#initialize)."""
+        self._graph = graph
+        self._huffman = GraphHuffman(graph)
+        v, d = graph.numVertices(), self.vector_size
+        rng = np.random.default_rng(self.seed)
+        self.syn0 = jnp.asarray((rng.random((v, d)) - 0.5) / d,
+                                jnp.float32)
+        self.syn1 = jnp.zeros((max(self._huffman.n_inner, 1), d),
+                              jnp.float32)
+        self._tables = self._huffman.path_tables()
+
+    def fit(self, graph: Optional[Graph] = None, walk_length: int = 40,
+            walks_per_vertex: int = 1, epochs: int = 1,
+            weighted: bool = False) -> "DeepWalk":
+        """Generate walks and train (reference: DeepWalk#fit(IGraph,int)
+        — there one pass over a GraphWalkIteratorProvider; here
+        ``walks_per_vertex`` x ``epochs`` batched passes)."""
+        if graph is not None and self._graph is not graph:
+            self.initialize(graph)
+        if self._graph is None:
+            raise ValueError("call initialize(graph) or pass graph")
+        total = epochs * walks_per_vertex
+        done = 0
+        for _ in range(epochs):
+            for _ in range(walks_per_vertex):
+                walks = generate_random_walks(
+                    self._graph, walk_length, weighted=weighted,
+                    seed=int(self._rng.integers(2 ** 31)))
+                lr = self.learning_rate * max(
+                    1.0 - done / max(total, 1), 0.05)
+                self._train_on_walks(walks, lr)
+                done += 1
+        return self
+
+    def _train_on_walks(self, walks: np.ndarray, lr: float) -> None:
+        """All (center, context) window pairs of all walks -> shuffled
+        device batches through the shared HS skip-gram step."""
+        v2r = self._huffman.vertex_to_row
+        rows = v2r[walks]                       # [W, L+1] cache rows
+        L = rows.shape[1]
+        centers, contexts = [], []
+        for off in range(1, self.window_size + 1):
+            if off >= L:
+                break
+            centers.append(rows[:, :-off].ravel())
+            contexts.append(rows[:, off:].ravel())
+            # symmetric window: each pair also trains reversed
+            centers.append(rows[:, off:].ravel())
+            contexts.append(rows[:, :-off].ravel())
+        c = np.concatenate(centers).astype(np.int32)
+        o = np.concatenate(contexts).astype(np.int32)
+        perm = self._rng.permutation(len(c))
+        c, o = c[perm], o[perm]
+        pts, cds, msk = self._tables
+        for s in range(0, len(c), self.batch_size):
+            self.syn0, self.syn1, self._last_loss = _sg_hs_step(
+                self.syn0, self.syn1,
+                jnp.asarray(c[s:s + self.batch_size]),
+                jnp.asarray(o[s:s + self.batch_size]),
+                pts, cds, msk, jnp.float32(lr))
+
+    # -- GraphVectors interface ----------------------------------------
+    def numVertices(self) -> int:
+        self._check_fitted()
+        return self._graph.numVertices()
+
+    def getVertexVector(self, vertex: int) -> np.ndarray:
+        self._check_fitted()
+        row = int(self._huffman.vertex_to_row[vertex])
+        return np.asarray(self.syn0[row])
+
+    def getVectorMatrix(self) -> np.ndarray:
+        """[numVertices, D] in VERTEX-id order."""
+        self._check_fitted()
+        return np.asarray(self.syn0)[self._huffman.vertex_to_row]
+
+    def similarity(self, v1: int, v2: int) -> float:
+        return _cosine(self.getVertexVector(v1),
+                       self.getVertexVector(v2))
+
+    def verticesNearest(self, vertex: int, top: int = 10) -> List[int]:
+        self._check_fitted()
+        return _nearest(self.getVectorMatrix(), vertex, top)
+
+    def _check_fitted(self):
+        if self.syn0 is None or self._graph is None:
+            raise ValueError("DeepWalk not initialized — call fit()")
+
+
+# ---------------------------------------------------------------------
+# Serde (reference: org/deeplearning4j/graph/models/loader/
+# GraphVectorSerializer.java — line-per-vertex text format)
+# ---------------------------------------------------------------------
+
+def writeGraphVectors(model: DeepWalk, path: str) -> None:
+    mat = model.getVectorMatrix()
+    with open(path, "w") as f:
+        f.write(f"{mat.shape[0]} {mat.shape[1]}\n")
+        for i, row in enumerate(mat):
+            f.write(str(i) + " "
+                    + " ".join(f"{x:.8g}" for x in row) + "\n")
+
+
+class StaticGraphVectors:
+    """Query-only GraphVectors over a loaded matrix (what
+    GraphVectorSerializer.loadTxtVectors returns)."""
+
+    def __init__(self, matrix: np.ndarray):
+        self.matrix = matrix
+
+    def numVertices(self) -> int:
+        return self.matrix.shape[0]
+
+    def getVertexVector(self, vertex: int) -> np.ndarray:
+        return self.matrix[vertex]
+
+    def similarity(self, v1: int, v2: int) -> float:
+        return _cosine(self.matrix[v1], self.matrix[v2])
+
+    def verticesNearest(self, vertex: int, top: int = 10) -> List[int]:
+        return _nearest(self.matrix, vertex, top)
+
+
+def loadGraphVectors(path: str) -> StaticGraphVectors:
+    with open(path) as f:
+        n, d = map(int, f.readline().split())
+        mat = np.empty((n, d), np.float32)
+        for line in f:
+            parts = line.split()
+            mat[int(parts[0])] = [float(x) for x in parts[1:]]
+    return StaticGraphVectors(mat)

@@ -1,0 +1,217 @@
+"""deeplearning4j-graph parity: Graph/walks/GraphHuffman/DeepWalk.
+
+Reference tests (eclipse monorepo deeplearning4j/deeplearning4j-graph/
+src/test/java/org/deeplearning4j/graph/):
+- TestGraph.java — construction + degree + random-walk mechanics,
+  disconnected-vertex handling.
+- TestGraphHuffman.java — code validity: prefix-free, high-degree
+  vertices get the short codes.
+- TestDeepWalk.java — fit on a structured graph, similarity sanity,
+  vector serde round-trip.
+"""
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.graph import (
+    DeepWalk, Graph, GraphHuffman, GraphLoader, NoEdgeHandling,
+    RandomWalkIterator, WeightedRandomWalkIterator,
+    generate_random_walks, loadGraphVectors, writeGraphVectors)
+
+
+def _two_cliques(k=6, bridges=1):
+    """Two k-cliques joined by `bridges` edges — communities 0..k-1 and
+    k..2k-1."""
+    g = Graph(2 * k)
+    for base in (0, k):
+        for i in range(k):
+            for j in range(i + 1, k):
+                g.addEdge(base + i, base + j)
+    for b in range(bridges):
+        g.addEdge(b, k + b)
+    return g
+
+
+class TestGraph:
+    def test_construction_and_degree(self):
+        g = Graph(4)
+        g.addEdge(0, 1)
+        g.addEdge(1, 2)
+        g.addEdge(2, 3)
+        assert g.numVertices() == 4
+        assert g.numEdges() == 3
+        assert g.getVertexDegree(1) == 2          # undirected
+        assert sorted(g.getConnectedVertexIndices(1)) == [0, 2]
+
+    def test_directed_edge(self):
+        g = Graph(3)
+        g.addEdge(0, 1, directed=True)
+        assert g.getConnectedVertexIndices(0) == [1]
+        assert g.getConnectedVertexIndices(1) == []
+
+    def test_bad_edges_raise(self):
+        g = Graph(2)
+        with pytest.raises(ValueError):
+            g.addEdge(0, 5)
+        with pytest.raises(ValueError):
+            g.addEdge(0, 1, weight=0.0)
+
+    def test_edge_list_loaders(self, tmp_path):
+        p = tmp_path / "edges.csv"
+        p.write_text("# comment\n0,1\n1,2\n")
+        g = GraphLoader.loadUndirectedGraphEdgeListFile(str(p), 3)
+        assert g.numEdges() == 2
+        pw = tmp_path / "weighted.csv"
+        pw.write_text("0,1,0.5\n1,2,2.0\n")
+        gw = GraphLoader.loadWeightedEdgeListFile(str(pw), 3)
+        assert gw.numEdges() == 2
+        with pytest.raises(ValueError):
+            GraphLoader.loadWeightedEdgeListFile(str(p), 3)  # no weight
+
+
+class TestRandomWalks:
+    def test_walk_shape_and_validity(self):
+        g = _two_cliques()
+        walks = generate_random_walks(g, walk_length=10, seed=0)
+        assert walks.shape == (12, 11)
+        assert (walks[:, 0] == np.arange(12)).all()
+        # every step follows an edge
+        for w in walks:
+            for a, b in zip(w[:-1], w[1:]):
+                assert b in g.getConnectedVertexIndices(a)
+
+    def test_self_loop_on_disconnected(self):
+        g = Graph(3)
+        g.addEdge(0, 1)                          # vertex 2 isolated
+        walks = generate_random_walks(
+            g, walk_length=5, seed=0,
+            no_edge_handling=NoEdgeHandling.SELF_LOOP_ON_DISCONNECTED)
+        assert (walks[2] == 2).all()
+
+    def test_exception_on_disconnected(self):
+        g = Graph(3)
+        g.addEdge(0, 1)
+        with pytest.raises(ValueError, match="no outgoing"):
+            generate_random_walks(
+                g, walk_length=5,
+                no_edge_handling=NoEdgeHandling.EXCEPTION_ON_DISCONNECTED)
+
+    def test_dead_end_mid_walk_raises(self):
+        g = Graph(3)
+        g.addEdge(0, 1, directed=True)           # 1 is a sink
+        with pytest.raises(ValueError, match="disconnected vertex"):
+            generate_random_walks(
+                g, walk_length=4, starts=[0],
+                no_edge_handling=NoEdgeHandling.EXCEPTION_ON_DISCONNECTED)
+
+    def test_weighted_walks_follow_weights(self):
+        # hub 0 with a 99x-heavier edge to 1 than to 2
+        g = Graph(3)
+        g.addEdge(0, 1, weight=99.0)
+        g.addEdge(0, 2, weight=1.0)
+        walks = generate_random_walks(
+            g, walk_length=1, starts=np.zeros(4000, np.int64),
+            weighted=True, seed=1)
+        frac_to_1 = (walks[:, 1] == 1).mean()
+        assert frac_to_1 > 0.95
+
+    def test_bad_starts_raise(self):
+        g = _two_cliques()
+        with pytest.raises(ValueError, match="out of range"):
+            generate_random_walks(g, 4, starts=[-1])
+        with pytest.raises(ValueError, match="out of range"):
+            generate_random_walks(g, 4, starts=[99])
+
+    def test_reset_yields_fresh_walks(self):
+        g = _two_cliques()
+        it = RandomWalkIterator(g, walk_length=12, seed=5)
+        first = np.array([it.next() for _ in range(g.numVertices())])
+        it.reset()
+        second = np.array([it.next() for _ in range(g.numVertices())])
+        assert (first[:, 0] == second[:, 0]).all()   # same starts
+        assert (first != second).any()               # fresh randomness
+
+    def test_iterator_facades(self):
+        g = _two_cliques()
+        it = RandomWalkIterator(g, walk_length=4, seed=3)
+        seen = 0
+        while it.hasNext():
+            w = it.next()
+            assert len(w) == 5
+            seen += 1
+        assert seen == g.numVertices()
+        wit = WeightedRandomWalkIterator(g, walk_length=4, seed=3)
+        assert len(wit.next()) == 5
+
+
+class TestGraphHuffman:
+    def test_codes_prefix_free_and_degree_ordered(self):
+        # star: hub 0 degree 8, leaves degree 1
+        g = Graph(9)
+        for i in range(1, 9):
+            g.addEdge(0, i)
+        h = GraphHuffman(g)
+        assert h.n_inner == 8
+        # hub gets the (strictly) shortest code
+        hub_len = h.getCodeLength(0)
+        leaf_lens = [h.getCodeLength(i) for i in range(1, 9)]
+        assert hub_len <= min(leaf_lens)
+        # prefix-free over all vertex codes
+        codes = []
+        for vw in h.cache.vocabWords():
+            codes.append("".join(map(str, vw.codes)))
+        for i, a in enumerate(codes):
+            for j, b in enumerate(codes):
+                if i != j:
+                    assert not b.startswith(a)
+
+    def test_vertex_row_mapping_is_bijective(self):
+        g = _two_cliques()
+        h = GraphHuffman(g)
+        assert sorted(h.vertex_to_row.tolist()) == list(range(12))
+        assert (h.row_to_vertex[h.vertex_to_row]
+                == np.arange(12)).all()
+
+
+class TestDeepWalk:
+    def test_fit_separates_communities(self):
+        g = _two_cliques(k=6, bridges=1)
+        dw = (DeepWalk.Builder().vectorSize(32).windowSize(3)
+              .learningRate(0.15).seed(7).batchSize(1024).build())
+        dw.fit(g, walk_length=20, walks_per_vertex=10, epochs=5)
+        intra, inter = [], []
+        for a in range(1, 6):          # skip bridge vertex 0
+            intra.append(dw.similarity(1, a) if a != 1 else 1.0)
+            inter.append(dw.similarity(1, 6 + a))
+        assert np.mean(intra) > np.mean(inter) + 0.2
+        # nearest neighbours of a clique member are its clique
+        near = dw.verticesNearest(2, top=4)
+        assert sum(1 for v in near if v < 6) >= 3
+
+    def test_vector_shapes_and_api(self):
+        g = _two_cliques()
+        dw = DeepWalk(vector_size=16, seed=1)
+        dw.fit(g, walk_length=8, walks_per_vertex=2)
+        assert dw.numVertices() == 12
+        assert dw.getVertexVector(3).shape == (16,)
+        assert dw.getVectorMatrix().shape == (12, 16)
+        assert dw.similarity(4, 4) == pytest.approx(1.0, abs=1e-5)
+
+    def test_unfitted_raises(self):
+        dw = DeepWalk()
+        with pytest.raises(ValueError, match="not initialized"):
+            dw.getVertexVector(0)
+
+    def test_serde_round_trip(self, tmp_path):
+        g = _two_cliques()
+        dw = DeepWalk(vector_size=8, seed=2)
+        dw.fit(g, walk_length=6)
+        path = str(tmp_path / "gv.txt")
+        writeGraphVectors(dw, path)
+        loaded = loadGraphVectors(path)
+        assert loaded.numVertices() == 12
+        np.testing.assert_allclose(
+            loaded.getVertexVector(5), dw.getVertexVector(5),
+            rtol=1e-5)
+        assert loaded.verticesNearest(1, 3) == dw.verticesNearest(1, 3)

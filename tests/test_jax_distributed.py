@@ -277,7 +277,8 @@ def test_package_import_leaves_backend_uninitialized():
     jnp.log() constant broke both 2-process tests in this file.)"""
     code = (
         "import deeplearning4j_tpu.nn.conf, deeplearning4j_tpu.ops,\\\n"
-        "    deeplearning4j_tpu.models.gpt, deeplearning4j_tpu.datasets\n"
+        "    deeplearning4j_tpu.models.gpt, deeplearning4j_tpu.datasets,\\\n"
+        "    deeplearning4j_tpu.graph\n"
         "import jax._src.xla_bridge as xb\n"
         "assert not xb._backends, f'backend initialized: {list(xb._backends)}'\n"
         "print('CLEAN')\n")
