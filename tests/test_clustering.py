@@ -1,0 +1,202 @@
+"""deeplearning4j-nearestneighbors parity: k-means, VPTree, KDTree,
+brute-force device k-NN, NearestNeighborsServer.
+
+Reference tests (eclipse monorepo deeplearning4j-nearestneighbors-
+parent/nearestneighbor-core/src/test/java/.../clustering/):
+KMeansTest.java, VPTreeTest.java (incl. knnMatchesExhaustive),
+KDTreeTest.java, and the server module's NearestNeighborsServerTest.
+Tree queries are pinned against the exact device brute force.
+"""
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.clustering import (
+    Cluster, ClusterSet, KDTree, KMeansClustering,
+    NearestNeighborsServer, Point, VPTree, knn_brute)
+
+
+def _blobs(n_per=60, centers=((0, 0), (8, 8), (-8, 8)), seed=0, d=2):
+    rng = np.random.default_rng(seed)
+    xs, labels = [], []
+    for i, c in enumerate(centers):
+        mean = np.zeros(d, np.float32)
+        mean[:2] = c
+        xs.append(rng.normal(mean, 0.7, size=(n_per, d)))
+        labels += [i] * n_per
+    return np.concatenate(xs).astype(np.float32), np.array(labels)
+
+
+class TestKMeans:
+    def test_recovers_blobs(self):
+        x, labels = _blobs()
+        km = KMeansClustering.setup(3, max_iterations=50, seed=1)
+        cs = km.applyTo(x)
+        assert cs.getClusterCount() == 3
+        # each found cluster is label-pure (blobs are well separated)
+        for cl in cs.getClusters():
+            ids = [p.id for p in cl.getPoints()]
+            assert len(ids) > 0
+            purity = np.bincount(labels[ids]).max() / len(ids)
+            assert purity > 0.95
+        assert km.iterations_done < 50        # converged early
+
+    def test_classify_point(self):
+        x, _ = _blobs()
+        cs = KMeansClustering.setup(3, seed=1).applyTo(x)
+        cid = cs.classifyPoint(np.array([8.2, 7.9], np.float32))
+        center = cs.getClusters()[cid].getCenter()
+        assert np.linalg.norm(center - [8, 8]) < 1.0
+
+    def test_point_list_input_and_ids(self):
+        x, _ = _blobs(n_per=20)
+        pts = [Point(f"p{i}", row) for i, row in enumerate(x)]
+        cs = KMeansClustering.setup(3, seed=2).applyTo(pts)
+        all_ids = sorted(p.id for c in cs.getClusters()
+                         for p in c.getPoints())
+        assert all_ids == sorted(f"p{i}" for i in range(len(x)))
+
+    def test_cosine_distance_mode(self):
+        rng = np.random.default_rng(3)
+        a = rng.normal((1, 0, 0), 0.05, (40, 3))
+        b = rng.normal((0, 0, 1), 0.05, (40, 3))
+        cs = KMeansClustering.setup(
+            2, distance="cosinedistance", seed=0).applyTo(
+                np.concatenate([a, b]).astype(np.float32))
+        sizes = sorted(len(c.getPoints()) for c in cs.getClusters())
+        assert sizes == [40, 40]
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="unknown distance"):
+            KMeansClustering(2, distance="hamming")
+        with pytest.raises(ValueError, match="at least k"):
+            KMeansClustering.setup(5).applyTo(np.eye(3, dtype=np.float32))
+
+    def test_lloyd_actually_iterates_to_fixed_point(self):
+        # overlapping blobs with adversarial (non-k-means++) seeding
+        # require several Lloyd iterations; the result must be
+        # self-consistent: every point sits in the cluster whose
+        # center is its argmin (stale-assignment regression guard)
+        rng = np.random.default_rng(11)
+        x = np.concatenate([
+            rng.normal((0, 0), 2.0, (80, 2)),
+            rng.normal((5, 0), 2.0, (80, 2)),
+            rng.normal((2.5, 4), 2.0, (80, 2))]).astype(np.float32)
+        km = KMeansClustering.setup(3, max_iterations=100, seed=0)
+        cs = km.applyTo(x)
+        assert km.iterations_done > 1          # convergence loop ran
+        centers = cs.centers()
+        for cl in cs.getClusters():
+            for p in cl.getPoints():
+                d = np.linalg.norm(centers - p.array, axis=1)
+                assert d.argmin() == cl.id
+        # classifyPoint agrees with membership
+        some = cs.getClusters()[1].getPoints()[0]
+        assert cs.classifyPoint(some.array) == 1
+
+    def test_more_clusters_than_natural_groups_no_empty(self):
+        # k=6 on 3 blobs: empty-cluster reseeding must keep all 6 alive
+        x, _ = _blobs(n_per=30)
+        cs = KMeansClustering.setup(6, max_iterations=30,
+                                    seed=4).applyTo(x)
+        assert all(len(c.getPoints()) > 0 for c in cs.getClusters())
+
+
+class TestBruteKnn:
+    def test_matches_numpy(self):
+        rng = np.random.default_rng(5)
+        items = rng.normal(size=(200, 8)).astype(np.float32)
+        q = rng.normal(size=(8,)).astype(np.float32)
+        idx, dist = knn_brute(items, q, 7)
+        ref = np.linalg.norm(items - q, axis=1)
+        np.testing.assert_array_equal(np.sort(idx),
+                                      np.sort(np.argsort(ref)[:7]))
+        np.testing.assert_allclose(dist, np.sort(ref)[:7], rtol=1e-4)
+
+    def test_batched_queries(self):
+        rng = np.random.default_rng(6)
+        items = rng.normal(size=(100, 4)).astype(np.float32)
+        qs = rng.normal(size=(9, 4)).astype(np.float32)
+        idx, dist = knn_brute(items, qs, 3)
+        assert idx.shape == (9, 3) and dist.shape == (9, 3)
+
+
+@pytest.mark.parametrize("distance", ["euclidean", "manhattan"])
+class TestVPTree:
+    def test_knn_matches_brute(self, distance):
+        rng = np.random.default_rng(7)
+        items = rng.normal(size=(300, 6)).astype(np.float32)
+        tree = VPTree(items, distance=distance, seed=1)
+        for qi in range(5):
+            q = rng.normal(size=(6,)).astype(np.float32)
+            t_idx, t_d = tree.search(q, 10)
+            b_idx, b_d = knn_brute(items, q, 10, distance)
+            np.testing.assert_allclose(np.sort(t_d), np.sort(b_d),
+                                       rtol=1e-5)
+            assert set(t_idx) == set(b_idx)
+
+
+class TestVPTreeFallbacks:
+    def test_cosine_falls_back_to_brute(self):
+        rng = np.random.default_rng(8)
+        items = rng.normal(size=(50, 5)).astype(np.float32)
+        tree = VPTree(items, distance="cosinedistance")
+        q = rng.normal(size=(5,)).astype(np.float32)
+        t_idx, _ = tree.search(q, 4)
+        b_idx, _ = knn_brute(items, q, 4, "cosinedistance")
+        assert list(t_idx) == list(b_idx)
+
+    def test_empty_raises(self):
+        with pytest.raises(ValueError, match="non-empty"):
+            VPTree(np.zeros((0, 3), np.float32))
+
+
+class TestKDTree:
+    def test_knn_matches_brute(self):
+        rng = np.random.default_rng(9)
+        items = rng.normal(size=(400, 3)).astype(np.float32)
+        tree = KDTree(items)
+        for _ in range(5):
+            q = rng.normal(size=(3,)).astype(np.float32)
+            t_idx, t_d = tree.knn(q, 8)
+            b_idx, b_d = knn_brute(items, q, 8)
+            np.testing.assert_allclose(np.sort(t_d), np.sort(b_d),
+                                       rtol=1e-5)
+            assert set(t_idx) == set(b_idx)
+
+    def test_nearest(self):
+        items = np.array([[0, 0], [5, 5], [10, 0]], np.float32)
+        tree = KDTree(items)
+        i, d = tree.nearest(np.array([4.6, 5.2], np.float32))
+        assert i == 1 and d == pytest.approx(
+            np.hypot(0.4, 0.2), rel=1e-5)
+
+
+class TestNearestNeighborsServer:
+    def test_serves_knn(self):
+        rng = np.random.default_rng(10)
+        items = rng.normal(size=(60, 4)).astype(np.float32)
+        srv = NearestNeighborsServer(items, default_k=3)
+        port = srv.start()
+        try:
+            q = items[17] + 0.001
+            body = json.dumps({"point": q.tolist(), "k": 2}).encode()
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/v1/serving/predict",
+                data=body, headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=10) as r:
+                out = json.loads(r.read())["output"]
+            idx, dist = out
+            assert idx[0] == 17 and len(idx) == 2
+            assert dist[0] < 0.01
+            # missing point -> 400 with reason
+            bad = urllib.request.Request(
+                f"http://127.0.0.1:{port}/v1/serving/predict",
+                data=b"{}", headers={"Content-Type": "application/json"})
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(bad, timeout=10)
+            assert ei.value.code == 400
+        finally:
+            srv.stop()
