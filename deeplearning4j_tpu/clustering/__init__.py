@@ -187,8 +187,11 @@ class KMeansClustering:
                 np.asarray(_pairwise(jnp.asarray(x),
                                      jnp.asarray(np.stack(centers)),
                                      "euclidean")) ** 2, axis=1)
-            p = d2 / max(d2.sum(), 1e-12)
-            centers.append(x[rng.choice(n, p=p)])
+            total = d2.sum()
+            if total <= 0:       # fewer distinct points than k
+                centers.append(x[rng.integers(n)])
+            else:
+                centers.append(x[rng.choice(n, p=d2 / total)])
         return np.stack(centers)
 
     def applyTo(self, points) -> ClusterSet:
@@ -242,8 +245,9 @@ def _knn_device(items, targets, k, distance):
 def knn_brute(items, targets, k: int,
               distance: str = "euclidean"):
     """Batched exact k-NN: one [Q,N] distance matrix + top_k on device.
-    Returns (indices [Q,k], distances [Q,k])."""
+    Returns (indices [Q,k], distances [Q,k]). k is clamped to [1, N]."""
     items = jnp.asarray(np.asarray(items, np.float32))
+    k = max(1, min(int(k), items.shape[0]))
     t = np.asarray(targets, np.float32)
     squeeze = t.ndim == 1
     if squeeze:

@@ -19,6 +19,11 @@ from deeplearning4j_tpu.datasets.multi_dataset import (
     ArrayMultiDataSetIterator, ListMultiDataSetIterator, MultiDataSet,
     MultiDataSetIterator, MultiDataSetIteratorAdapter,
 )
+from deeplearning4j_tpu.datasets.iterator_utils import (
+    CachingDataSetIterator, EarlyTerminationDataSetIterator,
+    ExistingMiniBatchDataSetIterator, KFoldIterator,
+    MultipleEpochsIterator, SamplingDataSetIterator, ViewIterator,
+)
 
 __all__ = ["DataSet", "DataSetIterator", "ListDataSetIterator",
            "ArrayDataSetIterator", "AsyncDataSetIterator",
@@ -29,4 +34,7 @@ __all__ = ["DataSet", "DataSetIterator", "ListDataSetIterator",
            "EmnistDataSetIterator", "Cifar10DataSetIterator",
            "MultiDataSet", "MultiDataSetIterator",
            "ListMultiDataSetIterator", "ArrayMultiDataSetIterator",
-           "MultiDataSetIteratorAdapter"]
+           "MultiDataSetIteratorAdapter",
+           "KFoldIterator", "ViewIterator", "SamplingDataSetIterator",
+           "MultipleEpochsIterator", "EarlyTerminationDataSetIterator",
+           "CachingDataSetIterator", "ExistingMiniBatchDataSetIterator"]

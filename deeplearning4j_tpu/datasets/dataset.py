@@ -48,6 +48,43 @@ class DataSet:
         return [DataSet(self.features[i:i + 1], self.labels[i:i + 1])
                 for i in range(self.numExamples())]
 
+    # -- serde (reference: DataSet#save/load — here npz, the natural
+    # numpy substrate, not the Java binary layout) -------------------
+    def save(self, path: str) -> None:
+        arrs = {"features": np.asarray(self.features),
+                "labels": np.asarray(self.labels)}
+        if self.features_mask is not None:
+            arrs["features_mask"] = np.asarray(self.features_mask)
+        if self.labels_mask is not None:
+            arrs["labels_mask"] = np.asarray(self.labels_mask)
+        np.savez(path, **arrs)
+
+    @staticmethod
+    def load(path: str) -> "DataSet":
+        with np.load(path) as z:
+            def opt(k):
+                return z[k] if k in z.files else None
+            return DataSet(z["features"], z["labels"],
+                           opt("features_mask"), opt("labels_mask"))
+
+    @staticmethod
+    def merge(datasets) -> "DataSet":
+        """Row-concatenate (reference: DataSet.merge)."""
+        if not datasets:
+            raise ValueError("merge of empty list")
+        f = np.concatenate([np.asarray(d.features) for d in datasets])
+        l = np.concatenate([np.asarray(d.labels) for d in datasets])
+        masks = []
+        for attr in ("features_mask", "labels_mask"):
+            have = [getattr(d, attr) is not None for d in datasets]
+            if any(have) and not all(have):
+                raise ValueError(f"cannot merge: {attr} present on "
+                                 "some DataSets but not others")
+            masks.append(np.concatenate(
+                [np.asarray(getattr(d, attr)) for d in datasets])
+                if all(have) else None)
+        return DataSet(f, l, masks[0], masks[1])
+
     def __repr__(self):
         return (f"DataSet(features={tuple(self.features.shape)}, "
                 f"labels={tuple(self.labels.shape)})")

@@ -96,6 +96,12 @@ class TestKMeans:
         some = cs.getClusters()[1].getPoints()[0]
         assert cs.classifyPoint(some.array) == 1
 
+    def test_duplicate_points_do_not_crash_seeding(self):
+        # fewer distinct points than k: k-means++ D² mass hits zero
+        x = np.zeros((10, 2), np.float32)
+        cs = KMeansClustering.setup(2, seed=0).applyTo(x)
+        assert cs.getClusterCount() == 2
+
     def test_more_clusters_than_natural_groups_no_empty(self):
         # k=6 on 3 blobs: empty-cluster reseeding must keep all 6 alive
         x, _ = _blobs(n_per=30)
@@ -121,6 +127,13 @@ class TestBruteKnn:
         qs = rng.normal(size=(9, 4)).astype(np.float32)
         idx, dist = knn_brute(items, qs, 3)
         assert idx.shape == (9, 3) and dist.shape == (9, 3)
+
+    def test_k_clamped_to_item_count(self):
+        items = np.eye(4, dtype=np.float32)
+        idx, _ = knn_brute(items, items[0], 100)   # k > N
+        assert len(idx) == 4
+        idx, _ = knn_brute(items, items[0], -2)    # k < 1
+        assert len(idx) == 1 and idx[0] == 0
 
 
 @pytest.mark.parametrize("distance", ["euclidean", "manhattan"])

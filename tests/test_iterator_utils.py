@@ -1,0 +1,167 @@
+"""Utility-iterator parity (reference: nd4j KFoldIterator/ViewIterator/
+SamplingDataSetIterator/CachingDataSetIterator tests + deeplearning4j
+MultipleEpochsIterator/EarlyTermination/ExistingMiniBatch tests)."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets import (
+    ArrayDataSetIterator, CachingDataSetIterator, DataSet,
+    EarlyTerminationDataSetIterator, ExistingMiniBatchDataSetIterator,
+    KFoldIterator, MultipleEpochsIterator, SamplingDataSetIterator,
+    ViewIterator)
+
+
+def _ds(n=20, d=3):
+    x = np.arange(n * d, dtype=np.float32).reshape(n, d)
+    y = np.arange(n, dtype=np.float32)[:, None]
+    return DataSet(x, y)
+
+
+class TestDataSetSerde:
+    def test_save_load_round_trip(self, tmp_path):
+        ds = DataSet(np.ones((4, 2), np.float32),
+                     np.zeros((4, 1), np.float32),
+                     features_mask=np.ones((4, 2), np.float32))
+        p = str(tmp_path / "d.npz")
+        ds.save(p)
+        back = DataSet.load(p)
+        np.testing.assert_array_equal(np.asarray(back.features),
+                                      np.asarray(ds.features))
+        assert back.features_mask is not None
+        assert back.labels_mask is None
+
+    def test_merge(self):
+        a, b = _ds(4), _ds(6)
+        m = DataSet.merge([a, b])
+        assert m.numExamples() == 10
+        np.testing.assert_array_equal(
+            np.asarray(m.features)[:4], np.asarray(a.features))
+
+    def test_merge_mask_mismatch_raises(self):
+        a = DataSet(np.ones((2, 2)), np.ones((2, 1)),
+                    features_mask=np.ones((2, 2)))
+        b = DataSet(np.ones((2, 2)), np.ones((2, 1)))
+        with pytest.raises(ValueError, match="features_mask"):
+            DataSet.merge([a, b])
+
+
+class TestKFold:
+    def test_folds_partition_exactly(self):
+        ds = _ds(23)
+        it = KFoldIterator(5, ds)
+        seen_test = []
+        folds = 0
+        while it.hasNext():
+            train = it.next()
+            test = it.testFold()
+            folds += 1
+            assert train.numExamples() + test.numExamples() == 23
+            seen_test.append(np.asarray(test.labels)[:, 0])
+            # train and test are disjoint
+            assert not (set(np.asarray(train.labels)[:, 0])
+                        & set(seen_test[-1]))
+        assert folds == 5
+        # union of test folds covers every example exactly once
+        allv = np.sort(np.concatenate(seen_test))
+        np.testing.assert_array_equal(allv, np.arange(23))
+
+    def test_testfold_before_next_raises(self):
+        with pytest.raises(ValueError, match="next"):
+            KFoldIterator(4, _ds(8)).testFold()
+
+    def test_bad_k(self):
+        with pytest.raises(ValueError):
+            KFoldIterator(1, _ds(8))
+        with pytest.raises(ValueError):
+            KFoldIterator(9, _ds(8))
+
+
+class TestViewAndSampling:
+    def test_view_batches(self):
+        it = ViewIterator(_ds(10), 4)
+        sizes = [d.numExamples() for d in it]
+        assert sizes == [4, 4, 2]
+        it.reset()
+        assert it.next().numExamples() == 4
+
+    def test_sampling_draws_total(self):
+        it = SamplingDataSetIterator(_ds(10), batch_size=8,
+                                     total_num_samples=20, seed=1)
+        sizes = [d.numExamples() for d in it]
+        assert sum(sizes) == 20 and sizes == [8, 8, 4]
+        # different epochs draw different samples
+        first = np.asarray(next(iter(it)).labels)
+        it.reset()
+        second = np.asarray(it.next().labels)
+        assert first.shape == second.shape
+        assert (first != second).any()
+
+
+class TestMaskPropagation:
+    def test_view_and_sampling_keep_masks(self):
+        ds = DataSet(np.ones((6, 3, 2), np.float32),
+                     np.ones((6, 3, 1), np.float32),
+                     features_mask=np.ones((6, 3), np.float32),
+                     labels_mask=np.ones((6, 3), np.float32))
+        b = ViewIterator(ds, 4).next()
+        assert b.features_mask is not None and b.features_mask.shape == (4, 3)
+        s = SamplingDataSetIterator(ds, 5, 5, seed=0).next()
+        assert s.labels_mask is not None and s.labels_mask.shape == (5, 3)
+
+
+class TestEpochAndTermination:
+    def test_multiple_epochs(self):
+        base = ArrayDataSetIterator(np.zeros((6, 2), np.float32),
+                                    np.zeros((6, 1), np.float32), 3)
+        it = MultipleEpochsIterator(3, base)
+        assert sum(1 for _ in it) == 6   # 2 batches x 3 epochs
+
+    def test_early_termination(self):
+        base = ArrayDataSetIterator(np.zeros((20, 2), np.float32),
+                                    np.zeros((20, 1), np.float32), 2)
+        it = EarlyTerminationDataSetIterator(base, 3)
+        assert sum(1 for _ in it) == 3
+        it.reset()
+        assert sum(1 for _ in it) == 3
+
+
+class _CountingIterator(ViewIterator):
+    """ViewIterator that counts underlying pulls."""
+
+    def __init__(self, ds, bs):
+        super().__init__(ds, bs)
+        self.pulls = 0
+
+    def next(self):
+        self.pulls += 1
+        return super().next()
+
+
+class TestCaching:
+    @pytest.mark.parametrize("use_dir", [False, True])
+    def test_second_epoch_serves_from_cache(self, tmp_path, use_dir):
+        src = _CountingIterator(_ds(12), 4)
+        it = CachingDataSetIterator(
+            src, cache_dir=str(tmp_path) if use_dir else None)
+        first = [np.asarray(d.features).copy() for d in it]
+        assert src.pulls == 3
+        second = [np.asarray(d.features) for d in it]
+        assert src.pulls == 3                 # cache hit, no new pulls
+        for a, b in zip(first, second):
+            np.testing.assert_array_equal(a, b)
+
+
+class TestExistingMiniBatch:
+    def test_reads_saved_batches_in_order(self, tmp_path):
+        for i in range(3):
+            DataSet(np.full((2, 2), i, np.float32),
+                    np.zeros((2, 1), np.float32)).save(
+                        str(tmp_path / f"dataset-{i}.npz"))
+        it = ExistingMiniBatchDataSetIterator(str(tmp_path))
+        vals = [float(np.asarray(d.features)[0, 0]) for d in it]
+        assert vals == [0.0, 1.0, 2.0]
+        assert it.batch() == 2
+
+    def test_missing_raises(self, tmp_path):
+        with pytest.raises(ValueError, match="no files"):
+            ExistingMiniBatchDataSetIterator(str(tmp_path))
