@@ -28,50 +28,97 @@ class DataNormalization:
         raise NotImplementedError
 
 
+class _RunningMoments:
+    """Streaming mean/std accumulator over [..., F] batches."""
+
+    def __init__(self):
+        self.n, self.s, self.s2 = 0, None, None
+
+    def add(self, x: np.ndarray) -> None:
+        feats = x.reshape(-1, x.shape[-1]) if x.ndim > 1 else x[:, None]
+        if self.s is None:
+            self.s = feats.sum(0)
+            self.s2 = (feats ** 2).sum(0)
+        else:
+            self.s += feats.sum(0)
+            self.s2 += (feats ** 2).sum(0)
+        self.n += feats.shape[0]
+
+    def finalize(self):
+        if self.n == 0:
+            raise ValueError("fit() saw no data")
+        mean = self.s / self.n
+        std = np.sqrt(np.maximum(self.s2 / self.n - mean ** 2, 0)) + 1e-8
+        return mean, std
+
+
 class NormalizerStandardize(DataNormalization):
-    """Zero-mean/unit-variance per feature."""
+    """Zero-mean/unit-variance per feature. ``fitLabel(True)`` extends
+    the contract to labels (reference: AbstractDataSetNormalizer#
+    fitLabel — the regression workflow where targets need
+    normalization and ``revertLabels`` recovers predictions)."""
 
     def __init__(self):
         self.mean = None
         self.std = None
+        self.label_mean = None
+        self.label_std = None
+        self._fit_label = False
+
+    def fitLabel(self, fit: bool = True) -> "NormalizerStandardize":
+        self._fit_label = fit
+        return self
 
     def fit(self, data):
-        """data: DataSetIterator or DataSet."""
-        if isinstance(data, DataSet):
-            x = np.asarray(data.features)
-            feats = x.reshape(-1, x.shape[-1]) if x.ndim > 1 else x[:, None]
-            self.mean = feats.mean(0)
-            self.std = feats.std(0) + 1e-8
-            return
-        # streaming accumulation over an iterator
-        n, s, s2 = 0, None, None
-        for ds in data:
-            x = np.asarray(ds.features)
-            feats = x.reshape(-1, x.shape[-1])
-            if s is None:
-                s = feats.sum(0)
-                s2 = (feats ** 2).sum(0)
-            else:
-                s += feats.sum(0)
-                s2 += (feats ** 2).sum(0)
-            n += feats.shape[0]
-        self.mean = s / n
-        self.std = np.sqrt(np.maximum(s2 / n - self.mean ** 2, 0)) + 1e-8
+        """data: DataSetIterator or DataSet. ONE streaming pass feeds
+        both the feature and (optional) label accumulators, so
+        out-of-core iterators keep constant memory."""
+        fm, lm = _RunningMoments(), _RunningMoments()
+        for ds in ([data] if isinstance(data, DataSet) else data):
+            fm.add(np.asarray(ds.features))
+            if self._fit_label:
+                lm.add(np.asarray(ds.labels))
+        self.mean, self.std = fm.finalize()
+        if self._fit_label:
+            self.label_mean, self.label_std = lm.finalize()
 
     def transform(self, ds: DataSet) -> DataSet:
         ds.features = (jnp.asarray(ds.features) - self.mean) / self.std
+        if self.label_mean is not None:
+            ds.labels = (jnp.asarray(ds.labels)
+                         - self.label_mean) / self.label_std
         return ds
 
     def revert(self, ds: DataSet) -> DataSet:
         ds.features = jnp.asarray(ds.features) * self.std + self.mean
+        if self.label_mean is not None:
+            ds.labels = (jnp.asarray(ds.labels) * self.label_std
+                         + self.label_mean)
         return ds
 
+    def revertLabels(self, labels):
+        """Un-normalize predictions (reference: revertLabels)."""
+        if self.label_mean is None:
+            return labels
+        return jnp.asarray(labels) * self.label_std + self.label_mean
+
     def state_dict(self):
-        return {"mean": self.mean, "std": self.std}
+        d = {"mean": self.mean, "std": self.std}
+        if self.label_mean is not None:
+            d["label_mean"] = self.label_mean
+            d["label_std"] = self.label_std
+        return d
 
     def load_state_dict(self, d):
         self.mean = np.asarray(d["mean"])
         self.std = np.asarray(d["std"])
+        if "label_mean" in d:
+            self.label_mean = np.asarray(d["label_mean"])
+            self.label_std = np.asarray(d["label_std"])
+            self._fit_label = True
+        else:   # clear any stale label stats from a previous fit
+            self.label_mean = self.label_std = None
+            self._fit_label = False
 
 
 class NormalizerMinMaxScaler(DataNormalization):
@@ -111,6 +158,67 @@ class NormalizerMinMaxScaler(DataNormalization):
         self.data_min = np.asarray(d["data_min"])
         self.data_max = np.asarray(d["data_max"])
         self.min_range, self.max_range = (float(v) for v in d["range"])
+
+
+class VGG16ImagePreProcessor(DataNormalization):
+    """Subtract the ImageNet per-channel pixel means — the zoo VGG16/19
+    input contract (reference: VGG16ImagePreProcessor). Channel order
+    here is RGB in NHWC (the TPU-native layout; the reference subtracts
+    the same means in NCHW BGR-trained order — values are per-channel,
+    so only the layout differs)."""
+
+    MEANS = np.array([123.68, 116.779, 103.939], np.float32)
+
+    def fit(self, data):
+        pass  # stateless
+
+    def transform(self, ds: DataSet) -> DataSet:
+        ds.features = jnp.asarray(ds.features, jnp.float32) - self.MEANS
+        return ds
+
+    def revert(self, ds: DataSet) -> DataSet:
+        ds.features = jnp.asarray(ds.features) + self.MEANS
+        return ds
+
+    def state_dict(self):
+        return {}
+
+    def load_state_dict(self, d):
+        pass
+
+
+class CompositeDataSetPreProcessor(DataNormalization):
+    """Apply preprocessors in sequence (reference:
+    CompositeDataSetPreProcessor). ``fit`` fits each child on the data
+    AS TRANSFORMED by the children before it — the statistics a child
+    computes must describe the distribution it will actually see at
+    transform time. The source iterator is materialized once (a
+    one-shot iterator must not be consumed per child)."""
+
+    def __init__(self, *preprocessors: DataNormalization):
+        self.preprocessors = list(preprocessors)
+
+    def fit(self, data):
+        batches = [data] if isinstance(data, DataSet) else list(data)
+        for p in self.preprocessors:
+            p.fit(batches[0] if len(batches) == 1 else batches)
+            batches = [p.transform(DataSet(
+                np.array(np.asarray(b.features)),
+                np.array(np.asarray(b.labels)),
+                b.features_mask, b.labels_mask)) for b in batches]
+
+    def transform(self, ds: DataSet) -> DataSet:
+        for p in self.preprocessors:
+            ds = p.transform(ds)
+        return ds
+
+    def state_dict(self):
+        return {f"p{i}": p.state_dict()
+                for i, p in enumerate(self.preprocessors)}
+
+    def load_state_dict(self, d):
+        for i, p in enumerate(self.preprocessors):
+            p.load_state_dict(d[f"p{i}"])
 
 
 class ImagePreProcessingScaler(DataNormalization):

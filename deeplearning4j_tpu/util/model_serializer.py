@@ -139,8 +139,11 @@ class ModelSerializer:
             if normalizer is not None:
                 _write_npz(zf, "normalizer.npz",
                            _flatten_with_paths(normalizer.state_dict()))
-                zf.writestr("normalizer.json", json.dumps(
-                    {"type": type(normalizer).__name__}))
+                info = {"type": type(normalizer).__name__}
+                if hasattr(normalizer, "preprocessors"):  # composite
+                    info["children"] = [type(p).__name__
+                                        for p in normalizer.preprocessors]
+                zf.writestr("normalizer.json", json.dumps(info))
 
     @staticmethod
     def restoreMultiLayerNetwork(path: str, load_updater: bool = True):
@@ -202,17 +205,31 @@ class ModelSerializer:
     @staticmethod
     def restoreNormalizer(path: str):
         from deeplearning4j_tpu.datasets.normalizers import (
-            ImagePreProcessingScaler, NormalizerMinMaxScaler,
-            NormalizerStandardize)
+            CompositeDataSetPreProcessor, ImagePreProcessingScaler,
+            NormalizerMinMaxScaler, NormalizerStandardize,
+            VGG16ImagePreProcessor)
 
+        registry = {"NormalizerStandardize": NormalizerStandardize,
+                    "NormalizerMinMaxScaler": NormalizerMinMaxScaler,
+                    "ImagePreProcessingScaler": ImagePreProcessingScaler,
+                    "VGG16ImagePreProcessor": VGG16ImagePreProcessor}
         with zipfile.ZipFile(path) as zf:
             if "normalizer.json" not in zf.namelist():
                 return None
             info = json.loads(zf.read("normalizer.json").decode())
             state = _read_npz(zf, "normalizer.npz")
-            cls = {"NormalizerStandardize": NormalizerStandardize,
-                   "NormalizerMinMaxScaler": NormalizerMinMaxScaler,
-                   "ImagePreProcessingScaler": ImagePreProcessingScaler}[info["type"]]
-            n = cls()
+            if info["type"] == "CompositeDataSetPreProcessor":
+                n = CompositeDataSetPreProcessor(
+                    *[registry[t]() for t in info["children"]])
+                # _flatten_with_paths joined the per-child dicts as
+                # "p<i>/<key>" — rebuild the nesting load expects
+                nested: dict = {f"p{i}": {}
+                                for i in range(len(info["children"]))}
+                for k, v in state.items():
+                    head, rest = k.split("/", 1)
+                    nested[head][rest] = v
+                n.load_state_dict(nested)
+                return n
+            n = registry[info["type"]]()
             n.load_state_dict(state)
             return n

@@ -119,6 +119,96 @@ class TestNormalizers:
         np.testing.assert_allclose(batch.mean, stream.mean, rtol=1e-4)
         np.testing.assert_allclose(batch.std, stream.std, rtol=1e-3)
 
+    def test_fit_label_and_revert_labels(self):
+        # regression workflow: labels normalized for training, predictions
+        # reverted to original units (reference: fitLabel/revertLabels)
+        rng = np.random.default_rng(3)
+        x = rng.normal(0, 1, (80, 4)).astype(np.float32)
+        y = rng.normal(100, 25, (80, 2)).astype(np.float32)
+        norm = NormalizerStandardize().fitLabel(True)
+        norm.fit(DataSet(x, y))
+        ds = norm.transform(DataSet(x, y))
+        l = np.asarray(ds.labels)
+        np.testing.assert_allclose(l.mean(0), 0, atol=1e-3)
+        np.testing.assert_allclose(l.std(0), 1, atol=1e-2)
+        back = np.asarray(norm.revertLabels(ds.labels))
+        np.testing.assert_allclose(back, y, rtol=1e-4, atol=1e-3)
+        # label stats survive serde
+        state = norm.state_dict()
+        n2 = NormalizerStandardize()
+        n2.load_state_dict(state)
+        np.testing.assert_allclose(np.asarray(n2.revertLabels(ds.labels)),
+                                   back, rtol=1e-6)
+
+    def test_vgg16_preprocessor(self):
+        from deeplearning4j_tpu.datasets.normalizers import (
+            VGG16ImagePreProcessor)
+        x = np.full((2, 4, 4, 3), 150.0, np.float32)
+        ds = VGG16ImagePreProcessor().transform(
+            DataSet(x, np.zeros((2, 1), np.float32)))
+        f = np.asarray(ds.features)
+        np.testing.assert_allclose(
+            f[0, 0, 0], 150.0 - VGG16ImagePreProcessor.MEANS, rtol=1e-6)
+
+    def test_composite_preprocessor(self):
+        from deeplearning4j_tpu.datasets.normalizers import (
+            CompositeDataSetPreProcessor, ImagePreProcessingScaler)
+        x = np.full((2, 2, 2, 1), 255.0, np.float32)
+        comp = CompositeDataSetPreProcessor(
+            ImagePreProcessingScaler(0.0, 1.0),
+            ImagePreProcessingScaler(0.0, 1.0, max_pixel=1.0))
+        f = np.asarray(comp.transform(
+            DataSet(x, np.zeros((2, 1), np.float32))).features)
+        np.testing.assert_allclose(f, 1.0)
+
+    def test_composite_fits_children_on_transformed_data(self):
+        # a stateful child must see the distribution the children
+        # before it produce, not the raw input
+        from deeplearning4j_tpu.datasets.normalizers import (
+            CompositeDataSetPreProcessor, ImagePreProcessingScaler)
+        rng = np.random.default_rng(4)
+        x = rng.uniform(0, 255, (200, 3)).astype(np.float32)
+        y = np.zeros((200, 1), np.float32)
+        comp = CompositeDataSetPreProcessor(
+            ImagePreProcessingScaler(0.0, 1.0), NormalizerStandardize())
+        comp.fit(DataSet(x.copy(), y))
+        out = np.asarray(comp.transform(DataSet(x.copy(), y)).features)
+        np.testing.assert_allclose(out.mean(0), 0, atol=1e-3)
+        np.testing.assert_allclose(out.std(0), 1, atol=1e-2)
+        # one-shot iterator source is materialized once, not re-pulled
+        comp2 = CompositeDataSetPreProcessor(
+            NormalizerStandardize(), NormalizerStandardize())
+        comp2.fit(iter([DataSet(x.copy(), y)]))
+
+    def test_composite_serializer_round_trip(self, tmp_path):
+        from deeplearning4j_tpu.datasets.normalizers import (
+            CompositeDataSetPreProcessor, ImagePreProcessingScaler)
+        rng = np.random.default_rng(5)
+        x = rng.uniform(0, 255, (50, 4)).astype(np.float32)
+        y = np.zeros((50, 1), np.float32)
+        comp = CompositeDataSetPreProcessor(
+            ImagePreProcessingScaler(0.0, 1.0), NormalizerStandardize())
+        comp.fit(DataSet(x.copy(), y))
+        p = str(tmp_path / "m.zip")
+        ModelSerializer.writeModel(small_net(), p, normalizer=comp)
+        back = ModelSerializer.restoreNormalizer(p)
+        a = np.asarray(comp.transform(DataSet(x.copy(), y)).features)
+        b = np.asarray(back.transform(DataSet(x.copy(), y)).features)
+        np.testing.assert_allclose(a, b, rtol=1e-6)
+
+    def test_load_state_clears_stale_label_stats(self):
+        rng = np.random.default_rng(6)
+        x = rng.normal(size=(30, 2)).astype(np.float32)
+        y = rng.normal(100, 10, (30, 1)).astype(np.float32)
+        n1 = NormalizerStandardize().fitLabel(True)
+        n1.fit(DataSet(x, y))
+        plain = NormalizerStandardize()
+        plain.fit(DataSet(x, y))
+        n1.load_state_dict(plain.state_dict())   # no label stats
+        assert n1.label_mean is None
+        ds = n1.transform(DataSet(x.copy(), y.copy()))
+        np.testing.assert_array_equal(np.asarray(ds.labels), y)
+
     def test_minmax(self):
         x = np.random.default_rng(2).uniform(-5, 10, (50, 2)).astype(np.float32)
         y = np.zeros((50, 1), np.float32)
