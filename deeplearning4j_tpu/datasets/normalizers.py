@@ -52,6 +52,36 @@ class _RunningMoments:
         return mean, std
 
 
+def _check_arity(got: int, expected: int, kind: str) -> None:
+    if got != expected:
+        raise ValueError(
+            f"MultiDataSet has {got} {kind} arrays but the normalizer "
+            f"was fitted on {expected} — refusing to silently "
+            f"truncate")
+
+
+class _RunningMinMax:
+    """Streaming per-column min/max accumulator over [..., F] batches."""
+
+    def __init__(self):
+        self.mn = None
+        self.mx = None
+
+    def add(self, x: np.ndarray) -> None:
+        flat = x.reshape(-1, x.shape[-1]) if x.ndim > 1 else x[:, None]
+        bmn, bmx = flat.min(0), flat.max(0)
+        if self.mn is None:
+            self.mn, self.mx = bmn, bmx
+        else:
+            self.mn = np.minimum(self.mn, bmn)
+            self.mx = np.maximum(self.mx, bmx)
+
+    def finalize(self):
+        if self.mn is None:
+            raise ValueError("fit() saw no data")
+        return self.mn, self.mx
+
+
 class NormalizerStandardize(DataNormalization):
     """Zero-mean/unit-variance per feature. ``fitLabel(True)`` extends
     the contract to labels (reference: AbstractDataSetNormalizer#
@@ -131,18 +161,10 @@ class NormalizerMinMaxScaler(DataNormalization):
         self.data_max = None
 
     def fit(self, data):
-        if isinstance(data, DataSet):
-            x = np.asarray(data.features).reshape(-1, np.asarray(data.features).shape[-1])
-            self.data_min = x.min(0)
-            self.data_max = x.max(0)
-            return
-        mn, mx = None, None
-        for ds in data:
-            x = np.asarray(ds.features).reshape(-1, np.asarray(ds.features).shape[-1])
-            bmn, bmx = x.min(0), x.max(0)
-            mn = bmn if mn is None else np.minimum(mn, bmn)
-            mx = bmx if mx is None else np.maximum(mx, bmx)
-        self.data_min, self.data_max = mn, mx
+        acc = _RunningMinMax()
+        for ds in ([data] if isinstance(data, DataSet) else data):
+            acc.add(np.asarray(ds.features))
+        self.data_min, self.data_max = acc.finalize()
 
     def transform(self, ds: DataSet) -> DataSet:
         rng = np.maximum(self.data_max - self.data_min, 1e-8)
@@ -158,6 +180,169 @@ class NormalizerMinMaxScaler(DataNormalization):
         self.data_min = np.asarray(d["data_min"])
         self.data_max = np.asarray(d["data_max"])
         self.min_range, self.max_range = (float(v) for v in d["range"])
+
+
+class MultiNormalizerStandardize:
+    """Per-input (and optional per-output) standardization of
+    MultiDataSets (reference: org/nd4j/linalg/dataset/api/preprocessor/
+    MultiNormalizerStandardize — the normalizer for multi-input
+    ComputationGraph pipelines). One streaming pass accumulates
+    independent moments for every feature (and label) array."""
+
+    def __init__(self):
+        self.means: list = []
+        self.stds: list = []
+        self.label_means: list = []
+        self.label_stds: list = []
+        self._fit_label = False
+
+    def fitLabel(self, fit: bool = True) -> "MultiNormalizerStandardize":
+        self._fit_label = fit
+        return self
+
+    def fit(self, data):
+        """data: MultiDataSet or an iterator of them."""
+        first = True
+        fms: list = []
+        lms: list = []
+        for mds in self._as_batches(data):
+            if first:
+                fms = [_RunningMoments() for _ in mds.features]
+                lms = [_RunningMoments() for _ in mds.labels] \
+                    if self._fit_label else []
+                first = False
+            _check_arity(len(mds.features), len(fms), "feature")
+            if lms:
+                _check_arity(len(mds.labels), len(lms), "label")
+            for m, x in zip(fms, mds.features):
+                m.add(np.asarray(x))
+            for m, y in zip(lms, mds.labels):
+                m.add(np.asarray(y))
+        if first:
+            raise ValueError("fit() saw no data")
+        self.means, self.stds = map(list, zip(*[m.finalize()
+                                                for m in fms]))
+        if lms:
+            self.label_means, self.label_stds = map(
+                list, zip(*[m.finalize() for m in lms]))
+
+    @staticmethod
+    def _as_batches(data):
+        from deeplearning4j_tpu.datasets.multi_dataset import MultiDataSet
+        return [data] if isinstance(data, MultiDataSet) else data
+
+    def transform(self, mds):
+        if not self.means:
+            raise ValueError("MultiNormalizerStandardize not fitted — "
+                             "call fit() first")
+        _check_arity(len(mds.features), len(self.means), "feature")
+        mds.features = [
+            (jnp.asarray(x) - m) / s
+            for x, m, s in zip(mds.features, self.means, self.stds)]
+        if self.label_means:
+            _check_arity(len(mds.labels), len(self.label_means),
+                         "label")
+            mds.labels = [
+                (jnp.asarray(y) - m) / s
+                for y, m, s in zip(mds.labels, self.label_means,
+                                   self.label_stds)]
+        return mds
+
+    def preProcess(self, mds):
+        return self.transform(mds)
+
+    def revertLabels(self, labels: list) -> list:
+        if not self.label_means:
+            return labels
+        _check_arity(len(labels), len(self.label_means), "label")
+        return [jnp.asarray(y) * s + m
+                for y, m, s in zip(labels, self.label_means,
+                                   self.label_stds)]
+
+    def state_dict(self) -> dict:
+        d = {}
+        for i, (m, s) in enumerate(zip(self.means, self.stds)):
+            d[f"mean{i}"] = m
+            d[f"std{i}"] = s
+        for i, (m, s) in enumerate(zip(self.label_means,
+                                       self.label_stds)):
+            d[f"label_mean{i}"] = m
+            d[f"label_std{i}"] = s
+        return d
+
+    def load_state_dict(self, d: dict) -> None:
+        self.means, self.stds = [], []
+        self.label_means, self.label_stds = [], []
+        i = 0
+        while f"mean{i}" in d:
+            self.means.append(np.asarray(d[f"mean{i}"]))
+            self.stds.append(np.asarray(d[f"std{i}"]))
+            i += 1
+        i = 0
+        while f"label_mean{i}" in d:
+            self.label_means.append(np.asarray(d[f"label_mean{i}"]))
+            self.label_stds.append(np.asarray(d[f"label_std{i}"]))
+            i += 1
+        self._fit_label = bool(self.label_means)
+
+
+class MultiNormalizerMinMaxScaler:
+    """Per-input min/max scaling of MultiDataSets (reference:
+    MultiNormalizerMinMaxScaler)."""
+
+    def __init__(self, min_range: float = 0.0, max_range: float = 1.0):
+        self.min_range = min_range
+        self.max_range = max_range
+        self.mins: list = []
+        self.maxs: list = []
+
+    def fit(self, data):
+        accs: list = []
+        first = True
+        for mds in MultiNormalizerStandardize._as_batches(data):
+            if first:
+                accs = [_RunningMinMax() for _ in mds.features]
+                first = False
+            _check_arity(len(mds.features), len(accs), "feature")
+            for a, x in zip(accs, mds.features):
+                a.add(np.asarray(x))
+        if first:
+            raise ValueError("fit() saw no data")
+        self.mins, self.maxs = map(list, zip(*[a.finalize()
+                                               for a in accs]))
+
+    def transform(self, mds):
+        if not self.mins:
+            raise ValueError("MultiNormalizerMinMaxScaler not fitted — "
+                             "call fit() first")
+        _check_arity(len(mds.features), len(self.mins), "feature")
+        out = []
+        for x, mn, mx in zip(mds.features, self.mins, self.maxs):
+            rng = np.maximum(mx - mn, 1e-8)
+            scaled = (jnp.asarray(x) - mn) / rng
+            out.append(scaled * (self.max_range - self.min_range)
+                       + self.min_range)
+        mds.features = out
+        return mds
+
+    def preProcess(self, mds):
+        return self.transform(mds)
+
+    def state_dict(self) -> dict:
+        d = {"range": np.asarray([self.min_range, self.max_range])}
+        for i, (mn, mx) in enumerate(zip(self.mins, self.maxs)):
+            d[f"min{i}"] = mn
+            d[f"max{i}"] = mx
+        return d
+
+    def load_state_dict(self, d: dict) -> None:
+        self.min_range, self.max_range = (float(v) for v in d["range"])
+        self.mins, self.maxs = [], []
+        i = 0
+        while f"min{i}" in d:
+            self.mins.append(np.asarray(d[f"min{i}"]))
+            self.maxs.append(np.asarray(d[f"max{i}"]))
+            i += 1
 
 
 class VGG16ImagePreProcessor(DataNormalization):

@@ -206,13 +206,16 @@ class ModelSerializer:
     def restoreNormalizer(path: str):
         from deeplearning4j_tpu.datasets.normalizers import (
             CompositeDataSetPreProcessor, ImagePreProcessingScaler,
+            MultiNormalizerMinMaxScaler, MultiNormalizerStandardize,
             NormalizerMinMaxScaler, NormalizerStandardize,
             VGG16ImagePreProcessor)
 
         registry = {"NormalizerStandardize": NormalizerStandardize,
                     "NormalizerMinMaxScaler": NormalizerMinMaxScaler,
                     "ImagePreProcessingScaler": ImagePreProcessingScaler,
-                    "VGG16ImagePreProcessor": VGG16ImagePreProcessor}
+                    "VGG16ImagePreProcessor": VGG16ImagePreProcessor,
+                    "MultiNormalizerStandardize": MultiNormalizerStandardize,
+                    "MultiNormalizerMinMaxScaler": MultiNormalizerMinMaxScaler}
         with zipfile.ZipFile(path) as zf:
             if "normalizer.json" not in zf.namelist():
                 return None

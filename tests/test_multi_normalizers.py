@@ -1,0 +1,137 @@
+"""MultiDataSet normalizer parity (reference:
+MultiNormalizerStandardizeTest / MultiNormalizerMinMaxScalerTest in
+nd4j — per-input stats, label fitting, revert)."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets import MultiDataSet
+from deeplearning4j_tpu.datasets.normalizers import (
+    MultiNormalizerMinMaxScaler, MultiNormalizerStandardize)
+
+
+def _mds(seed=0, n=100):
+    rng = np.random.default_rng(seed)
+    return MultiDataSet(
+        features=[rng.normal(5, 3, (n, 4)).astype(np.float32),
+                  rng.uniform(-10, 50, (n, 2)).astype(np.float32)],
+        labels=[rng.normal(200, 40, (n, 1)).astype(np.float32)])
+
+
+class TestMultiStandardize:
+    def test_per_input_stats(self):
+        norm = MultiNormalizerStandardize()
+        norm.fit(_mds())
+        out = norm.transform(_mds())
+        for f in out.features:
+            f = np.asarray(f)
+            np.testing.assert_allclose(f.mean(0), 0, atol=1e-3)
+            np.testing.assert_allclose(f.std(0), 1, atol=1e-2)
+        # labels untouched without fitLabel
+        assert float(np.asarray(out.labels[0]).mean()) > 100
+
+    def test_fit_label_and_revert(self):
+        norm = MultiNormalizerStandardize().fitLabel(True)
+        norm.fit(_mds())
+        out = norm.transform(_mds())
+        l = np.asarray(out.labels[0])
+        np.testing.assert_allclose(l.mean(0), 0, atol=1e-3)
+        back = norm.revertLabels(out.labels)[0]
+        np.testing.assert_allclose(np.asarray(back),
+                                   np.asarray(_mds().labels[0]),
+                                   rtol=1e-4, atol=1e-2)
+
+    def test_streaming_iterator_matches_batch(self):
+        big = _mds(n=120)
+        parts = [MultiDataSet([np.asarray(f)[i:i + 40]
+                               for f in big.features],
+                              [np.asarray(l)[i:i + 40]
+                               for l in big.labels])
+                 for i in range(0, 120, 40)]
+        a = MultiNormalizerStandardize()
+        a.fit(big)
+        b = MultiNormalizerStandardize()
+        b.fit(iter(parts))
+        for x, y in zip(a.means, b.means):
+            np.testing.assert_allclose(x, y, rtol=1e-5)
+        for x, y in zip(a.stds, b.stds):
+            np.testing.assert_allclose(x, y, rtol=1e-4)
+
+    def test_state_round_trip(self):
+        norm = MultiNormalizerStandardize().fitLabel(True)
+        norm.fit(_mds())
+        n2 = MultiNormalizerStandardize()
+        n2.load_state_dict(norm.state_dict())
+        a = norm.transform(_mds())
+        b = n2.transform(_mds())
+        for x, y in zip(a.features, b.features):
+            np.testing.assert_allclose(np.asarray(x), np.asarray(y),
+                                       rtol=1e-6)
+        assert n2._fit_label
+
+    def test_empty_fit_raises(self):
+        with pytest.raises(ValueError, match="no data"):
+            MultiNormalizerStandardize().fit(iter([]))
+
+    def test_unfitted_transform_raises(self):
+        with pytest.raises(ValueError, match="not fitted"):
+            MultiNormalizerStandardize().transform(_mds())
+
+    def test_arity_mismatch_raises(self):
+        norm = MultiNormalizerStandardize()
+        norm.fit(_mds())
+        three = MultiDataSet(
+            features=_mds().features + [np.ones((100, 3), np.float32)],
+            labels=_mds().labels)
+        with pytest.raises(ValueError, match="feature arrays"):
+            norm.transform(three)
+        with pytest.raises(ValueError, match="feature arrays"):
+            MultiNormalizerStandardize().fit(iter([_mds(), three]))
+
+    def test_model_serializer_round_trip(self, tmp_path):
+        import numpy as _np
+        from deeplearning4j_tpu.learning import Adam
+        from deeplearning4j_tpu.nn.conf import (
+            DenseLayer, NeuralNetConfiguration, OutputLayer)
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        from deeplearning4j_tpu.util.model_serializer import (
+            ModelSerializer)
+        conf = (NeuralNetConfiguration.builder().seed(1)
+                .updater(Adam(learning_rate=0.01)).list()
+                .layer(DenseLayer(n_in=4, n_out=4, activation="relu"))
+                .layer(OutputLayer(n_in=4, n_out=2, loss="mcxent",
+                                   activation="softmax")).build())
+        net = MultiLayerNetwork(conf)
+        net.init()
+        norm = MultiNormalizerStandardize().fitLabel(True)
+        norm.fit(_mds())
+        p = str(tmp_path / "m.zip")
+        ModelSerializer.writeModel(net, p, normalizer=norm)
+        back = ModelSerializer.restoreNormalizer(p)
+        a = back.transform(_mds())
+        b = norm.transform(_mds())
+        for x, y in zip(a.features, b.features):
+            _np.testing.assert_allclose(_np.asarray(x), _np.asarray(y),
+                                        rtol=1e-6)
+
+
+class TestMultiMinMax:
+    def test_scales_each_input(self):
+        norm = MultiNormalizerMinMaxScaler()
+        norm.fit(_mds())
+        out = norm.transform(_mds())
+        for f in out.features:
+            f = np.asarray(f)
+            assert f.min() >= -1e-6 and f.max() <= 1 + 1e-6
+
+    def test_custom_range_and_serde(self):
+        norm = MultiNormalizerMinMaxScaler(-1.0, 1.0)
+        norm.fit(_mds())
+        n2 = MultiNormalizerMinMaxScaler()
+        n2.load_state_dict(norm.state_dict())
+        assert n2.min_range == -1.0 and n2.max_range == 1.0
+        f = np.asarray(n2.transform(_mds()).features[0])
+        assert f.min() >= -1 - 1e-6 and f.max() <= 1 + 1e-6
+
+    def test_empty_fit_raises(self):
+        with pytest.raises(ValueError, match="no data"):
+            MultiNormalizerMinMaxScaler().fit(iter([]))
