@@ -1,0 +1,159 @@
+"""Dimensionality reduction — the reference's
+``org/nd4j/linalg/dimensionalityreduction`` package.
+
+Reference classes:
+- ``PCA.java`` — principal component analysis over an [N,D] matrix:
+  static ``pca(A, nDims, normalize)`` / ``pca_factor`` plus an
+  instance API (covariance, eigen-basis, ``reducedBasis(variance)``,
+  ``convertToComponents`` / ``convertBackToFeatures``).
+- ``RandomProjection.java`` — Johnson-Lindenstrauss gaussian random
+  projection with ``johnsonLindenstraussMinDim``.
+
+TPU-first: the decomposition and every projection are single device
+ops — covariance is one [D,N]@[N,D] matmul on the MXU, the basis comes
+from ``jnp.linalg.eigh`` of the symmetric covariance (exact, and
+cheaper than SVD of the data for N >> D), and converts are plain
+matmuls that fuse into whatever step consumes them. No iterative
+host-side deflation loops.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import numpy as np
+import jax.numpy as jnp
+
+
+class PCA:
+    """Instance API over a fitted dataset (reference: PCA(INDArray)).
+
+    ``convertToComponents`` projects onto the top-k eigenbasis;
+    ``convertBackToFeatures`` reconstructs; ``reducedBasis(f)`` returns
+    the smallest basis explaining fraction ``f`` of total variance."""
+
+    def __init__(self, dataset):
+        x = jnp.asarray(np.asarray(dataset, np.float32))
+        if x.ndim != 2 or x.shape[0] < 2:
+            raise ValueError("PCA needs an [N>=2, D] matrix")
+        self.mean = x.mean(0)
+        centered = x - self.mean
+        cov = centered.T @ centered / (x.shape[0] - 1)
+        # eigh returns ascending eigenvalues; flip to descending
+        evals, evecs = jnp.linalg.eigh(cov)
+        self.eigenvalues = np.asarray(evals)[::-1].copy()
+        self.eigenvectors = np.asarray(evecs)[:, ::-1].copy()  # [D,D]
+        self.covarianceMatrix = np.asarray(cov)
+
+    def reducedBasis(self, variance: float) -> np.ndarray:
+        """Smallest [D,k] basis explaining >= ``variance`` fraction of
+        total variance (reference: PCA#reducedBasis)."""
+        if not 0.0 < variance <= 1.0:
+            raise ValueError("variance fraction must be in (0, 1]")
+        ratios = np.cumsum(self.eigenvalues) / self.eigenvalues.sum()
+        k = int(np.searchsorted(ratios, variance) + 1)
+        return self.eigenvectors[:, :k]
+
+    def convertToComponents(self, x, n_components: Optional[int] = None):
+        if n_components is None:
+            basis = self.eigenvectors
+        else:
+            if not 1 <= n_components <= self.eigenvectors.shape[1]:
+                raise ValueError(
+                    f"n_components must be in [1, "
+                    f"{self.eigenvectors.shape[1]}], got {n_components}")
+            basis = self.eigenvectors[:, :n_components]
+        return np.asarray(
+            (jnp.asarray(np.asarray(x, np.float32)) - self.mean)
+            @ basis)
+
+    def convertBackToFeatures(self, components):
+        c = np.asarray(components, np.float32)
+        basis = self.eigenvectors[:, :c.shape[-1]]
+        return np.asarray(jnp.asarray(c) @ basis.T + self.mean)
+
+    def estimateVariance(self, data, n_components: int) -> float:
+        """Fraction of ``data``'s variance captured by the top-k basis
+        (reference: PCA#estimateVariance)."""
+        x = jnp.asarray(np.asarray(data, np.float32)) - self.mean
+        proj = x @ self.eigenvectors[:, :n_components]
+        return float((proj * proj).sum() / (x * x).sum())
+
+    # -- statics (reference: PCA.pca / PCA.pca_factor) -----------------
+    @staticmethod
+    def pca_factor(matrix, n_dims: int, normalize: bool = False):
+        """[D, n_dims] factor matrix (the projection basis)."""
+        x = np.asarray(matrix, np.float32)
+        if normalize:
+            std = x.std(0) + 1e-8
+            x = x / std
+        return PCA(x).eigenvectors[:, :n_dims]
+
+    @staticmethod
+    def pca(matrix, n_dims: int, normalize: bool = False):
+        """Reduced [N, n_dims] representation (reference: the static
+        convenience that fits and converts in one call)."""
+        x = np.asarray(matrix, np.float32)
+        if normalize:
+            x = x / (x.std(0) + 1e-8)
+        return PCA(x).convertToComponents(x, n_dims)
+
+
+def johnson_lindenstrauss_min_dim(n_samples: int, eps: float) -> int:
+    """Minimum target dimension preserving pairwise distances within
+    (1 +/- eps) for n points (reference:
+    RandomProjection#johnsonLindenstraussMinDim)."""
+    if not 0.0 < eps < 1.0:
+        raise ValueError("eps must be in (0, 1)")
+    if n_samples < 1:
+        raise ValueError("n_samples must be >= 1")
+    denom = eps ** 2 / 2.0 - eps ** 3 / 3.0
+    return int(4.0 * math.log(n_samples) / denom)
+
+
+class RandomProjection:
+    """Gaussian random projection (reference: RandomProjection —
+    construct with an explicit target dim, or with ``eps`` to derive it
+    from the JL bound at projection time)."""
+
+    def __init__(self, n_components: Optional[int] = None,
+                 eps: Optional[float] = None, seed: int = 0):
+        if (n_components is None) == (eps is None):
+            raise ValueError(
+                "give exactly one of n_components or eps")
+        self.n_components = n_components
+        self.eps = eps
+        self.seed = seed
+        self._matrix: Optional[np.ndarray] = None
+
+    def _target_dim(self, n_samples: int, in_dim: int) -> int:
+        k = self.n_components if self.n_components is not None else \
+            johnson_lindenstrauss_min_dim(n_samples, self.eps)
+        if k <= 0:
+            raise ValueError(f"target dimension {k} must be positive")
+        if k > in_dim:
+            raise ValueError(
+                f"target dimension {k} exceeds input dimension "
+                f"{in_dim} (eps too small for this few samples)")
+        return k
+
+    def project(self, x) -> np.ndarray:
+        """[N,D] -> [N,k]; the projection matrix is drawn ONCE (in eps
+        mode the JL dimension is derived from the FIRST batch and then
+        pinned), so every later call — any row count — embeds into the
+        same space."""
+        x = np.asarray(x, np.float32)
+        if self._matrix is None:
+            k = self._target_dim(x.shape[0], x.shape[1])
+            self.n_components = k          # pin: eps mode derives once
+            rng = np.random.default_rng(self.seed)
+            self._matrix = (rng.standard_normal((x.shape[1], k))
+                            / np.sqrt(k)).astype(np.float32)
+        elif x.shape[1] != self._matrix.shape[0]:
+            raise ValueError(
+                f"input dimension {x.shape[1]} does not match the "
+                f"fitted projection ({self._matrix.shape[0]})")
+        return np.asarray(jnp.asarray(x) @ jnp.asarray(self._matrix))
+
+
+__all__ = ["PCA", "RandomProjection", "johnson_lindenstrauss_min_dim"]

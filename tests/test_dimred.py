@@ -1,0 +1,134 @@
+"""Dimensionality-reduction parity (reference: nd4j PCATest /
+RandomProjectionTest)."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.dimensionalityreduction import (
+    PCA, RandomProjection, johnson_lindenstrauss_min_dim)
+
+
+def _correlated(n=500, seed=0):
+    """3-D data whose variance lives almost entirely on one axis."""
+    rng = np.random.default_rng(seed)
+    t = rng.normal(size=(n, 1))
+    return np.hstack([3 * t + rng.normal(0, 0.05, (n, 1)),
+                      -2 * t + rng.normal(0, 0.05, (n, 1)),
+                      rng.normal(0, 0.05, (n, 1))]).astype(np.float32)
+
+
+class TestPCA:
+    def test_first_component_captures_variance(self):
+        p = PCA(_correlated())
+        ratios = p.eigenvalues / p.eigenvalues.sum()
+        assert ratios[0] > 0.99
+        # eigenvalues descending
+        assert (np.diff(p.eigenvalues) <= 1e-6).all()
+
+    def test_round_trip_reconstruction(self):
+        x = _correlated()
+        p = PCA(x)
+        comps = p.convertToComponents(x, 1)
+        assert comps.shape == (x.shape[0], 1)
+        back = p.convertBackToFeatures(comps)
+        # 1 component suffices: reconstruction is near-exact
+        err = np.linalg.norm(back - x) / np.linalg.norm(x)
+        assert err < 0.05
+        # full basis reconstructs exactly
+        full = p.convertBackToFeatures(p.convertToComponents(x))
+        np.testing.assert_allclose(full, x, atol=1e-3)
+
+    def test_reduced_basis_variance_fraction(self):
+        x = _correlated()
+        p = PCA(x)
+        assert p.reducedBasis(0.95).shape == (3, 1)
+        assert p.reducedBasis(1.0).shape == (3, 3)
+        with pytest.raises(ValueError):
+            p.reducedBasis(0.0)
+
+    def test_estimate_variance(self):
+        x = _correlated()
+        p = PCA(x)
+        assert p.estimateVariance(x, 1) > 0.99
+        assert p.estimateVariance(x, 3) == pytest.approx(1.0, abs=1e-5)
+
+    def test_static_pca_matches_numpy(self):
+        x = _correlated(seed=2)
+        reduced = PCA.pca(x, 2)
+        assert reduced.shape == (x.shape[0], 2)
+        # compare captured variance against numpy's own eig solution
+        xc = x - x.mean(0)
+        evals = np.linalg.eigvalsh(np.cov(xc.T))[::-1]
+        np.testing.assert_allclose(reduced.var(0, ddof=1),
+                                   evals[:2], rtol=1e-2)
+
+    def test_factor_orthonormal(self):
+        f = PCA.pca_factor(_correlated(), 3)
+        np.testing.assert_allclose(f.T @ f, np.eye(3), atol=1e-4)
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="N>=2"):
+            PCA(np.ones((1, 3), np.float32))
+
+
+class TestRandomProjection:
+    def test_jl_min_dim_formula(self):
+        # classic check: 1000 points at eps=0.3 needs a few hundred dims
+        k = johnson_lindenstrauss_min_dim(1000, 0.3)
+        assert 600 < k < 800
+        with pytest.raises(ValueError):
+            johnson_lindenstrauss_min_dim(10, 1.5)
+
+    def test_distances_approximately_preserved(self):
+        rng = np.random.default_rng(3)
+        x = rng.normal(size=(60, 2000)).astype(np.float32)
+        rp = RandomProjection(n_components=800, seed=1)
+        y = rp.project(x)
+        assert y.shape == (60, 800)
+        d_in = np.linalg.norm(x[:20, None] - x[None, :20], axis=-1)
+        d_out = np.linalg.norm(y[:20, None] - y[None, :20], axis=-1)
+        iu = np.triu_indices(20, 1)
+        ratio = d_out[iu] / d_in[iu]
+        assert abs(ratio.mean() - 1.0) < 0.05
+        assert ratio.std() < 0.1
+
+    def test_same_space_across_calls(self):
+        rng = np.random.default_rng(4)
+        x = rng.normal(size=(10, 50)).astype(np.float32)
+        rp = RandomProjection(n_components=8, seed=2)
+        a = rp.project(x)
+        b = rp.project(x)
+        np.testing.assert_array_equal(a, b)
+
+    def test_eps_mode_pins_space_across_batch_sizes(self):
+        # the JL dim derives from the FIRST batch; a smaller query
+        # batch must land in the SAME space, not a redrawn one
+        rng = np.random.default_rng(6)
+        train = rng.normal(size=(1000, 4000)).astype(np.float32)
+        rp = RandomProjection(eps=0.9, seed=0)
+        tr = rp.project(train)
+        q = rp.project(train[:7])
+        assert q.shape == (7, tr.shape[1])
+        # same space: values agree up to matmul accumulation order
+        np.testing.assert_allclose(q, tr[:7], rtol=1e-4, atol=1e-4)
+        with pytest.raises(ValueError, match="does not match"):
+            rp.project(np.zeros((3, 5), np.float32))
+
+    def test_zero_components_rejected(self):
+        x = _correlated()
+        with pytest.raises(ValueError, match="n_components"):
+            PCA(x).convertToComponents(x, 0)
+        with pytest.raises(ValueError, match="n_components"):
+            PCA.pca(x, 0)
+
+    def test_eps_mode_and_validation(self):
+        with pytest.raises(ValueError, match="exactly one"):
+            RandomProjection()
+        with pytest.raises(ValueError, match="exactly one"):
+            RandomProjection(n_components=4, eps=0.5)
+        rp = RandomProjection(eps=0.9, seed=0)
+        x = np.random.default_rng(5).normal(size=(8, 200)).astype(np.float32)
+        y = rp.project(x)
+        assert y.shape[1] == johnson_lindenstrauss_min_dim(8, 0.9)
+        # eps too tight for the input dim -> loud error
+        with pytest.raises(ValueError, match="exceeds input"):
+            RandomProjection(eps=0.1).project(x)
