@@ -197,27 +197,77 @@ class ROC:
         self._scores.append(p)
 
     def calculateAUC(self) -> float:
-        y = np.concatenate(self._labels)
-        s = np.concatenate(self._scores)
-        order = np.argsort(-s, kind="stable")
-        y = y[order]
-        tps = np.cumsum(y)
-        fps = np.cumsum(1 - y)
-        P = max(y.sum(), 1e-12)
-        N = max((1 - y).sum(), 1e-12)
-        tpr = np.concatenate([[0.0], tps / P])
-        fpr = np.concatenate([[0.0], fps / N])
-        return float(np.trapezoid(tpr, fpr))
+        # delegate to the tie-collapsed curve: tied scores form ONE
+        # operating point (a per-sample path through a tie block picks
+        # an arbitrary staircase and biases the area)
+        return self.getRocCurve().calculateAUC()
 
     def calculateAUCPR(self) -> float:
-        y = np.concatenate(self._labels)
-        s = np.concatenate(self._scores)
-        order = np.argsort(-s, kind="stable")
-        y = y[order]
-        tps = np.cumsum(y)
-        prec = tps / (np.arange(len(y)) + 1)
-        rec = tps / max(y.sum(), 1e-12)
-        return float(np.trapezoid(prec, rec))
+        return self.getPrecisionRecallCurve().calculateAUCPR()
+
+    def _flat(self):
+        if not self._labels:
+            return np.zeros(0), np.zeros(0)
+        return np.concatenate(self._labels), np.concatenate(self._scores)
+
+    def getRocCurve(self) -> "RocCurve":
+        """Exact ROC points at every distinct score threshold, tied
+        scores collapsed to one operating point (reference:
+        ROC#getRocCurve -> evaluation/curves/RocCurve)."""
+        return _roc_curve_from(*self._flat())
+
+    def getPrecisionRecallCurve(self) -> "PrecisionRecallCurve":
+        """reference: ROC#getPrecisionRecallCurve ->
+        evaluation/curves/PrecisionRecallCurve."""
+        return _pr_curve_from(*self._flat())
+
+
+class RocCurve:
+    """ROC points (reference: org/nd4j/evaluation/curves/RocCurve)."""
+
+    def __init__(self, thresholds, fpr, tpr):
+        self.thresholds = np.asarray(thresholds)
+        self.fpr = np.asarray(fpr)
+        self.tpr = np.asarray(tpr)
+
+    def numPoints(self) -> int:
+        return len(self.thresholds)
+
+    def getThreshold(self, i: int) -> float:
+        return float(self.thresholds[i])
+
+    def getTruePositiveRate(self, i: int) -> float:
+        return float(self.tpr[i])
+
+    def getFalsePositiveRate(self, i: int) -> float:
+        return float(self.fpr[i])
+
+    def calculateAUC(self) -> float:
+        return float(np.trapezoid(self.tpr, self.fpr))
+
+
+class PrecisionRecallCurve:
+    """PR points (reference: evaluation/curves/PrecisionRecallCurve)."""
+
+    def __init__(self, thresholds, precision, recall):
+        self.thresholds = np.asarray(thresholds)
+        self.precision = np.asarray(precision)
+        self.recall = np.asarray(recall)
+
+    def numPoints(self) -> int:
+        return len(self.thresholds)
+
+    def getThreshold(self, i: int) -> float:
+        return float(self.thresholds[i])
+
+    def getPrecision(self, i: int) -> float:
+        return float(self.precision[i])
+
+    def getRecall(self, i: int) -> float:
+        return float(self.recall[i])
+
+    def calculateAUCPR(self) -> float:
+        return float(np.trapezoid(self.precision, self.recall))
 
 
 class RegressionEvaluation:
@@ -267,15 +317,50 @@ class RegressionEvaluation:
 
 
 def _auc_from_scores(y: np.ndarray, s: np.ndarray) -> float:
+    """Tie-collapsed ROC area — shared by ROC/ROCBinary/ROCMultiClass
+    so tied scores give the same (order-independent) answer
+    everywhere."""
+    return _roc_curve_from(y, s).calculateAUC()
+
+
+def _tie_collapsed(y: np.ndarray, s: np.ndarray):
+    """Descending-score order with tied scores collapsed to ONE
+    operating point. Returns (thresholds, tps, fps, n_pred, P, N);
+    empty input gives length-0 arrays."""
     order = np.argsort(-s, kind="stable")
-    y = y[order]
-    tps = np.cumsum(y)
-    fps = np.cumsum(1 - y)
-    P = max(y.sum(), 1e-12)
-    N = max((1 - y).sum(), 1e-12)
-    tpr = np.concatenate([[0.0], tps / P])
-    fpr = np.concatenate([[0.0], fps / N])
-    return float(np.trapezoid(tpr, fpr))
+    # f64: float32 cumsums/divisions cost ~1e-7 in the rates
+    y, s = y[order].astype(np.float64), s[order]
+    if len(s) == 0:
+        z = np.zeros(0)
+        return z, z, z, z, 0.0, 0.0
+    last = np.concatenate([s[1:] != s[:-1], [True]])
+    tps = np.cumsum(y)[last]
+    fps = np.cumsum(1.0 - y)[last]
+    n_pred = (np.arange(len(y)) + 1.0)[last]
+    return s[last], tps, fps, n_pred, float(y.sum()), float((1 - y).sum())
+
+
+def _roc_curve_from(y: np.ndarray, s: np.ndarray) -> "RocCurve":
+    th, tps, fps, _, P, N = _tie_collapsed(y, s)
+    if len(th) == 0:
+        return RocCurve([np.inf], [0.0], [0.0])
+    P, N = max(P, 1e-12), max(N, 1e-12)
+    return RocCurve(np.concatenate([[np.inf], th]),
+                    np.concatenate([[0.0], fps / N]),
+                    np.concatenate([[0.0], tps / P]))
+
+
+def _pr_curve_from(y: np.ndarray, s: np.ndarray) -> "PrecisionRecallCurve":
+    th, tps, _, n_pred, P, _ = _tie_collapsed(y, s)
+    if len(th) == 0:
+        return PrecisionRecallCurve([np.inf], [1.0], [0.0])
+    prec = tps / n_pred
+    # recall=0 anchor at the first point's precision: the area of the
+    # first block is r0*p0 (the step rule), not silently dropped
+    return PrecisionRecallCurve(
+        np.concatenate([[np.inf], th]),
+        np.concatenate([[prec[0]], prec]),
+        np.concatenate([[0.0], tps / max(P, 1e-12)]))
 
 
 class ROCBinary:
@@ -432,4 +517,5 @@ class EvaluationCalibration:
 
 
 __all__ = ["Evaluation", "EvaluationBinary", "ROC", "ROCBinary",
-           "ROCMultiClass", "RegressionEvaluation", "EvaluationCalibration"]
+           "ROCMultiClass", "RegressionEvaluation", "EvaluationCalibration",
+           "RocCurve", "PrecisionRecallCurve"]

@@ -38,6 +38,116 @@ class TestROCBinary:
             assert abs(whole.calculateAUC(i) - batched.calculateAUC(i)) < 1e-9
 
 
+class TestRocCurves:
+    """reference: evaluation/curves/{RocCurve,PrecisionRecallCurve} —
+    the plot/export objects ROC#getRocCurve / getPrecisionRecallCurve
+    return."""
+
+    def _fitted_roc(self, seed=0, n=200):
+        from deeplearning4j_tpu.evaluation import ROC
+        rng = np.random.default_rng(seed)
+        y = (rng.random(n) < 0.4).astype(np.float32)
+        s = np.clip(0.6 * y + rng.normal(0, 0.25, n), 0, 1) \
+            .astype(np.float32)   # same dtype the ROC stores
+        roc = ROC()
+        roc.eval(y, s)
+        return roc, y, s
+
+    def test_auc_matches_mann_whitney(self):
+        # independent oracle: AUC = P(random positive outranks random
+        # negative), ties counted 1/2 — must equal the trapezoid area
+        # of the tie-collapsed curve
+        roc, y, s = self._fitted_roc()
+        pos, neg = s[y == 1], s[y == 0]
+        wins = (pos[:, None] > neg[None, :]).sum() \
+            + 0.5 * (pos[:, None] == neg[None, :]).sum()
+        expected = wins / (len(pos) * len(neg))
+        assert roc.calculateAUC() == pytest.approx(expected, abs=1e-9)
+        curve = roc.getRocCurve()
+        assert curve.calculateAUC() == pytest.approx(expected, abs=1e-9)
+        # monotone, anchored at (0,0) and ending at (1,1)
+        assert curve.getFalsePositiveRate(0) == 0.0
+        assert curve.getTruePositiveRate(0) == 0.0
+        assert curve.getFalsePositiveRate(curve.numPoints() - 1) \
+            == pytest.approx(1.0)
+        assert curve.getTruePositiveRate(curve.numPoints() - 1) \
+            == pytest.approx(1.0)
+        assert (np.diff(curve.fpr) >= -1e-12).all()
+        assert (np.diff(curve.tpr) >= -1e-12).all()
+
+    def test_curve_points_match_manual_thresholding(self):
+        roc, y, s = self._fitted_roc(seed=1, n=60)
+        curve = roc.getRocCurve()
+        P, N = y.sum(), (1 - y).sum()
+        for i in range(1, curve.numPoints(), 7):
+            t = curve.getThreshold(i)
+            pred = s >= t
+            np.testing.assert_allclose(
+                curve.getTruePositiveRate(i),
+                (pred & (y == 1)).sum() / P, atol=1e-9)
+            np.testing.assert_allclose(
+                curve.getFalsePositiveRate(i),
+                (pred & (y == 0)).sum() / N, atol=1e-9)
+
+    def test_tied_scores_collapse_to_one_point(self):
+        from deeplearning4j_tpu.evaluation import ROC
+        roc = ROC()
+        roc.eval(np.array([1, 0, 1, 0], np.float32),
+                 np.array([0.7, 0.7, 0.7, 0.2], np.float32))
+        curve = roc.getRocCurve()
+        # thresholds: inf, 0.7, 0.2 — the three tied 0.7s are ONE point
+        assert curve.numPoints() == 3
+
+    def test_pr_curve(self):
+        roc, y, s = self._fitted_roc(seed=2)
+        pr = roc.getPrecisionRecallCurve()
+        # recall anchored at 0, nondecreasing, ends at 1
+        assert pr.getRecall(0) == 0.0
+        assert (np.diff(pr.recall) >= -1e-12).all()
+        assert pr.getRecall(pr.numPoints() - 1) == pytest.approx(1.0)
+        # precision at a mid threshold matches manual computation
+        i = pr.numPoints() // 2
+        t = pr.getThreshold(i)
+        pred = s >= t
+        np.testing.assert_allclose(
+            pr.getPrecision(i),
+            ((pred) & (y == 1)).sum() / pred.sum(), atol=1e-9)
+
+    def test_aucpr_hand_computed(self):
+        # y/s chosen so the hand trapezoid is exact: points (r,p) =
+        # anchor(0,1), (2/3,1), (2/3,2/3), (1,3/4), (1,3/5), (1,1/2)
+        # -> area = 2/3 + 17/72 = 65/72
+        from deeplearning4j_tpu.evaluation import ROC
+        roc = ROC()
+        roc.eval(np.array([1, 1, 0, 1, 0, 0], np.float32),
+                 np.array([.9, .9, .8, .7, .6, .5], np.float32))
+        assert roc.calculateAUCPR() == pytest.approx(65 / 72, abs=1e-9)
+        # all scores tied: one operating point, area = its precision
+        tied = ROC()
+        tied.eval(np.array([1, 1, 1, 0], np.float32),
+                  np.full(4, 0.7, np.float32))
+        assert tied.calculateAUCPR() == pytest.approx(0.75, abs=1e-9)
+
+    def test_empty_accumulator_is_safe(self):
+        from deeplearning4j_tpu.evaluation import ROC
+        roc = ROC()
+        roc.eval(np.zeros(0, np.float32), np.zeros(0, np.float32))
+        assert roc.calculateAUC() == 0.0
+        assert roc.calculateAUCPR() == 0.0
+        assert ROC().calculateAUC() == 0.0   # never eval'd at all
+
+    def test_rocbinary_tie_order_independent(self):
+        from deeplearning4j_tpu.evaluation import ROCBinary
+        y = np.array([[0], [1], [1]], np.float32)
+        s = np.array([[0.7], [0.7], [0.2]], np.float32)
+        a = ROCBinary()
+        a.eval(y, s)
+        b = ROCBinary()
+        b.eval(y[::-1].copy(), s[::-1].copy())
+        assert a.calculateAUC(0) == pytest.approx(b.calculateAUC(0),
+                                                  abs=1e-12)
+
+
 class TestROCMultiClass:
     def test_one_vs_all(self):
         rs = np.random.RandomState(2)
