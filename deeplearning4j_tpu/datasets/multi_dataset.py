@@ -59,7 +59,10 @@ class MultiDataSet:
         n = self.numExamples()
 
         def cut(arrs, s):
-            return [np.asarray(a)[s:s + batch_size] for a in arrs] or None
+            # mask lists may hold None per array (only some labels
+            # carry masks, e.g. after the class-imbalance preprocessor)
+            return [np.asarray(a)[s:s + batch_size]
+                    if a is not None else None for a in arrs] or None
 
         out = []
         for s in range(0, n, batch_size):

@@ -1,0 +1,97 @@
+"""Class-imbalance masking under-sampler parity (reference:
+UnderSamplingByMaskingPreProcessorTest in nd4j)."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets import DataSet, MultiDataSet
+from deeplearning4j_tpu.datasets.classimbalance import (
+    UnderSamplingByMaskingMultiDataSetPreProcessor,
+    UnderSamplingByMaskingPreProcessor)
+
+
+def _imbalanced(b=64, t=120, p_minority=0.05, seed=0, onehot=False):
+    rng = np.random.default_rng(seed)
+    cls = (rng.random((b, t)) < p_minority).astype(np.float32)
+    if onehot:
+        labels = np.stack([1 - cls, cls], -1)
+    else:
+        labels = cls[..., None]
+    feats = rng.normal(size=(b, t, 3)).astype(np.float32)
+    return DataSet(feats, labels), cls
+
+
+class TestUnderSampling:
+    def test_unmasked_distribution_hits_target(self):
+        ds, cls = _imbalanced()
+        pre = UnderSamplingByMaskingPreProcessor(0.4, window_length=30,
+                                                 seed=1)
+        pre.preProcess(ds)
+        mask = np.asarray(ds.labels_mask)
+        assert mask.shape == cls.shape
+        kept_minority = (cls * mask).sum()
+        kept_total = mask.sum()
+        frac = kept_minority / kept_total
+        assert abs(frac - 0.4) < 0.08, frac
+
+    def test_minority_never_masked(self):
+        ds, cls = _imbalanced(seed=2)
+        UnderSamplingByMaskingPreProcessor(0.3, 20, seed=3).preProcess(ds)
+        mask = np.asarray(ds.labels_mask)
+        assert (mask[cls > 0.5] == 1.0).all()
+
+    def test_onehot_labels_equivalent(self):
+        ds1, _ = _imbalanced(seed=4)
+        ds2, _ = _imbalanced(seed=4, onehot=True)
+        m1 = UnderSamplingByMaskingPreProcessor(0.35, 25, seed=5) \
+            .adjusted_mask(np.asarray(ds1.labels))
+        m2 = UnderSamplingByMaskingPreProcessor(0.35, 25, seed=5) \
+            .adjusted_mask(np.asarray(ds2.labels))
+        np.testing.assert_array_equal(m1, m2)
+
+    def test_all_majority_window_masked_by_default(self):
+        labels = np.zeros((2, 20, 1), np.float32)   # no minority at all
+        pre = UnderSamplingByMaskingPreProcessor(0.5, 10, seed=0)
+        mask = pre.adjusted_mask(labels)
+        assert (mask == 0.0).all()
+        keep = UnderSamplingByMaskingPreProcessor(
+            0.5, 10, seed=0, mask_all_majority_windows=False)
+        assert (keep.adjusted_mask(labels) == 1.0).all()
+
+    def test_existing_mask_respected(self):
+        ds, cls = _imbalanced(seed=6)
+        pre_mask = np.ones(cls.shape, np.float32)
+        pre_mask[:, -30:] = 0.0                      # padded tail
+        ds.labels_mask = pre_mask
+        UnderSamplingByMaskingPreProcessor(0.4, 30, seed=7).preProcess(ds)
+        mask = np.asarray(ds.labels_mask)
+        assert (mask[:, -30:] == 0.0).all()          # stays masked
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="target_minority_dist"):
+            UnderSamplingByMaskingPreProcessor(0.9, 10)
+        with pytest.raises(ValueError, match="window_length"):
+            UnderSamplingByMaskingPreProcessor(0.3, 0)
+        pre = UnderSamplingByMaskingPreProcessor(0.3, 10)
+        with pytest.raises(ValueError, match="binary time"):
+            pre.adjusted_mask(np.zeros((2, 5, 3), np.float32))
+
+
+class TestMultiVariant:
+    def test_selected_label_arrays(self):
+        ds, cls = _imbalanced(seed=8)
+        other = np.zeros((64, 120, 1), np.float32)
+        mds = MultiDataSet(features=[np.asarray(ds.features)],
+                           labels=[np.asarray(ds.labels), other])
+        pre = UnderSamplingByMaskingMultiDataSetPreProcessor(
+            0.4, 30, label_indices=[0], seed=9)
+        pre.preProcess(mds)
+        assert mds.labels_mask_arrays[0] is not None
+        assert mds.labels_mask_arrays[1] is None     # untouched
+        frac = (cls * mds.labels_mask_arrays[0]).sum() \
+            / mds.labels_mask_arrays[0].sum()
+        assert abs(frac - 0.4) < 0.08
+        # mixed None/array mask lists survive batch splitting
+        parts = mds.splitBatches(16)
+        assert len(parts) == 4
+        assert parts[0].labels_mask_arrays[0].shape == (16, 120)
+        assert parts[0].labels_mask_arrays[1] is None
