@@ -14,6 +14,7 @@ inference, and the builder verbs keep reference names.
 
 from __future__ import annotations
 
+import datetime as _dt
 import json
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
@@ -22,6 +23,23 @@ import numpy as np
 from deeplearning4j_tpu.datavec.schema import ColumnType, Schema, _ColumnMeta
 
 Table = Dict[str, np.ndarray]
+
+#: reference: org.joda.time units used by TimeMathOpTransform
+_TIME_UNIT_MS = {"MILLISECONDS": 1, "SECONDS": 1000,
+                 "MINUTES": 60_000, "HOURS": 3_600_000,
+                 "DAYS": 86_400_000}
+
+#: reference: DeriveColumnsFromTimeTransform derived fields (Joda
+#: conventions: dayOfWeek 1=Monday .. 7=Sunday)
+_TIME_FIELDS = {
+    "year": lambda d: d.year,
+    "monthOfYear": lambda d: d.month,
+    "dayOfMonth": lambda d: d.day,
+    "dayOfWeek": lambda d: d.isoweekday(),
+    "hourOfDay": lambda d: d.hour,
+    "minuteOfHour": lambda d: d.minute,
+    "secondOfMinute": lambda d: d.second,
+}
 
 
 # ---------------------------------------------------------------- conditions
@@ -169,6 +187,57 @@ class _Step:
                 return Schema(cols + [_ColumnMeta(p["new_column"],
                                                   ColumnType.DOUBLE)])
             return s
+        # ---- time steps (reference: transform/transform/time/**) ----
+        if k == "stringToTime":
+            name = p["column"]
+            if not s.hasColumn(name):
+                raise KeyError(f"stringToTime: unknown column {name!r}")
+            if s.getColumnMeta(name).type != ColumnType.STRING:
+                raise TypeError(
+                    f"stringToTime: {name} is "
+                    f"{s.getColumnMeta(name).type}, not STRING")
+            return Schema([_ColumnMeta(c.name, ColumnType.TIME)
+                           if c.name == name else c for c in cols])
+        if k == "timeMathOp":
+            name = p["column"]
+            if not s.hasColumn(name):
+                raise KeyError(f"timeMathOp: unknown column {name!r}")
+            if s.getColumnMeta(name).type != ColumnType.TIME:
+                raise TypeError(
+                    f"timeMathOp: {name} is "
+                    f"{s.getColumnMeta(name).type}, not TIME")
+            if p["unit"] not in _TIME_UNIT_MS:
+                raise ValueError(f"timeMathOp: unknown unit {p['unit']!r}")
+            if p["op"] not in ("Add", "Subtract"):
+                # validated here (not only in the Builder) so foreign
+                # JSON via fromJson cannot smuggle a silent Subtract
+                raise ValueError(f"timeMathOp: op must be Add|Subtract, "
+                                 f"got {p['op']!r}")
+            return s
+        if k == "deriveColumnsFromTime":
+            name = p["column"]
+            if not s.hasColumn(name):
+                raise KeyError(
+                    f"deriveColumnsFromTime: unknown column {name!r}")
+            if s.getColumnMeta(name).type != ColumnType.TIME:
+                raise TypeError(
+                    f"deriveColumnsFromTime: {name} is "
+                    f"{s.getColumnMeta(name).type}, not TIME")
+            taken = set(s.getColumnNames())
+            for d in p["derived"]:
+                if d["field"] not in _TIME_FIELDS:
+                    raise ValueError(
+                        f"deriveColumnsFromTime: unknown field "
+                        f"{d['field']!r} (know {sorted(_TIME_FIELDS)})")
+                if d["name"] in taken:
+                    raise ValueError(
+                        f"deriveColumnsFromTime: derived column "
+                        f"{d['name']!r} collides with an existing "
+                        "column")
+                taken.add(d["name"])
+            extra = [_ColumnMeta(d["name"], ColumnType.INTEGER)
+                     for d in p["derived"]]
+            return Schema(cols + extra)
         # ---- sequence steps (reference: transform/sequence/**) ----
         if k == "convertToSequence":
             for c in (p["key_column"], p["sort_column"]):
@@ -307,6 +376,33 @@ class _Step:
             return out
         if k == "custom":
             return p["fn"](dict(table))
+        if k == "stringToTime":
+            name, fmt = p["column"], p["format"]
+            out = dict(table)
+            out[name] = np.array(
+                [int(_dt.datetime.strptime(str(v), fmt)
+                     .replace(tzinfo=_dt.timezone.utc)
+                     .timestamp() * 1000) for v in table[name]],
+                dtype=np.int64)
+            return out
+        if k == "timeMathOp":
+            name = p["column"]
+            delta = int(p["value"]) * _TIME_UNIT_MS[p["unit"]]
+            col = table[name].astype(np.int64)
+            out = dict(table)
+            out[name] = col + delta if p["op"] == "Add" else col - delta
+            return out
+        if k == "deriveColumnsFromTime":
+            name = p["column"]
+            out = dict(table)
+            dts = [_dt.datetime.fromtimestamp(int(v) / 1000.0,
+                                              _dt.timezone.utc)
+                   for v in table[name]]
+            for d in p["derived"]:
+                out[d["name"]] = np.array(
+                    [_TIME_FIELDS[d["field"]](x) for x in dts],
+                    dtype=np.int64)
+            return out
         if k == "convertToSequence":
             return dict(table)  # grouping handled by TransformProcess
         if k in _Step.SEQUENCE_KINDS:
@@ -387,6 +483,10 @@ class TransformProcess:
         self.steps = list(steps)
         self.final_schema = self._infer()
         self._convert_index()  # validate sequence-step ordering early
+
+    def getFinalSchema(self) -> Schema:
+        """reference: TransformProcess#getFinalSchema."""
+        return self.final_schema
 
     def _infer(self) -> Schema:
         s = self.initial_schema
@@ -602,6 +702,33 @@ class TransformProcess:
                              value=value, condition=condition)
 
         # ---- sequence ops (reference: transform/sequence/**) ----
+        def stringToTimeTransform(self, column: str, format: str):
+            """Parse datetime strings to epoch-millis TIME (reference:
+            StringToTimeTransform; format is a Python strptime pattern
+            — e.g. the reference's 'YYYY-MM-dd HH:mm:ss' is
+            '%Y-%m-%d %H:%M:%S'). Timestamps are interpreted UTC."""
+            return self._add("stringToTime", column=column,
+                             format=format)
+
+        def timeMathOp(self, column: str, op: str, value: int,
+                       unit: str = "MILLISECONDS"):
+            """Shift a TIME column (reference: TimeMathOpTransform;
+            op Add/Subtract, unit MILLISECONDS..DAYS)."""
+            if op not in ("Add", "Subtract"):
+                raise ValueError("timeMathOp op must be Add|Subtract")
+            return self._add("timeMathOp", column=column, op=op,
+                             value=value, unit=unit)
+
+        def deriveColumnsFromTime(self, column: str, *derived):
+            """Derive integer fields from a TIME column (reference:
+            DeriveColumnsFromTimeTransform.Builder). Each derived spec
+            is (new_name, field) with field in year/monthOfYear/
+            dayOfMonth/dayOfWeek/hourOfDay/minuteOfHour/
+            secondOfMinute."""
+            return self._add(
+                "deriveColumnsFromTime", column=column,
+                derived=[{"name": n, "field": f} for n, f in derived])
+
         def convertToSequence(self, key_column: str, sort_column: str):
             """Group flat records into per-key sequences ordered by
             sort_column (reference: TransformProcess.Builder

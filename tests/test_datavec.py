@@ -26,6 +26,7 @@ from deeplearning4j_tpu.datavec import (
     TransformProcess,
 )
 from deeplearning4j_tpu.datavec.transform import Condition, ConditionOp
+from deeplearning4j_tpu.datavec.schema import ColumnType
 
 
 IRIS_CSV = """5.1,3.5,1.4,0.2,setosa
@@ -772,3 +773,95 @@ class TestSequenceTransforms:
         mi = names.index("x[max,7]")
         want = [vals[max(0, t - 6):t + 1].max() for t in range(50)]
         np.testing.assert_allclose([r[mi] for r in seq], want)
+
+
+class TestTimeTransforms:
+    """reference: transform/transform/time/{StringToTimeTransform,
+    TimeMathOpTransform,DeriveColumnsFromTimeTransform}."""
+
+    def _schema(self):
+        return (Schema.Builder().addColumnString("ts")
+                .addColumnDouble("v").build())
+
+    def test_string_to_time_and_derive(self):
+        tp = (TransformProcess.Builder(self._schema())
+              .stringToTimeTransform("ts", "%Y-%m-%d %H:%M:%S")
+              .deriveColumnsFromTime(
+                  "ts", ("hour", "hourOfDay"), ("dow", "dayOfWeek"),
+                  ("month", "monthOfYear"))
+              .build())
+        out = tp.execute([["2026-07-31 13:45:10", 1.0],
+                          ["2026-01-01 00:00:00", 2.0]])
+        # schema: ts is TIME, derived INTEGER columns appended
+        fs = tp.getFinalSchema()
+        assert fs.getColumnMeta("ts").type == ColumnType.TIME
+        assert fs.getColumnMeta("hour").type == ColumnType.INTEGER
+        names = fs.getColumnNames()
+        r0 = dict(zip(names, out[0]))
+        r1 = dict(zip(names, out[1]))
+        # epoch check: 2026-01-01T00:00:00Z
+        import datetime
+        want = int(datetime.datetime(2026, 1, 1,
+                                     tzinfo=datetime.timezone.utc)
+                   .timestamp() * 1000)
+        assert r1["ts"] == want
+        assert r0["hour"] == 13 and r1["hour"] == 0
+        assert r0["dow"] == 5          # 2026-07-31 is a Friday
+        assert r0["month"] == 7 and r1["month"] == 1
+
+    def test_time_math_op(self):
+        tp = (TransformProcess.Builder(self._schema())
+              .stringToTimeTransform("ts", "%Y-%m-%d %H:%M:%S")
+              .timeMathOp("ts", "Subtract", 2, "HOURS")
+              .deriveColumnsFromTime("ts", ("hour", "hourOfDay"))
+              .build())
+        out = tp.execute([["2026-07-31 01:00:00", 0.0]])
+        row = dict(zip(tp.getFinalSchema().getColumnNames(), out[0]))
+        assert row["hour"] == 23       # wrapped to the previous day
+
+    def test_json_round_trip(self):
+        tp = (TransformProcess.Builder(self._schema())
+              .stringToTimeTransform("ts", "%Y-%m-%d %H:%M:%S")
+              .timeMathOp("ts", "Add", 1, "DAYS")
+              .deriveColumnsFromTime("ts", ("dom", "dayOfMonth"))
+              .build())
+        back = TransformProcess.fromJson(tp.toJson())
+        a = tp.execute([["2026-02-28 12:00:00", 0.0]])
+        b = back.execute([["2026-02-28 12:00:00", 0.0]])
+        assert a == b
+        row = dict(zip(back.getFinalSchema().getColumnNames(), b[0]))
+        assert row["dom"] == 1         # Feb 28 + 1 day -> Mar 1 (2026)
+
+    def test_validation(self):
+        with pytest.raises(TypeError, match="not STRING"):
+            (TransformProcess.Builder(self._schema())
+             .stringToTimeTransform("v", "%Y").build())
+        with pytest.raises(TypeError, match="not TIME"):
+            (TransformProcess.Builder(self._schema())
+             .timeMathOp("v", "Add", 1, "DAYS").build())
+        with pytest.raises(ValueError, match="unknown unit"):
+            (TransformProcess.Builder(self._schema())
+             .stringToTimeTransform("ts", "%Y")
+             .timeMathOp("ts", "Add", 1, "FORTNIGHTS").build())
+        with pytest.raises(ValueError, match="unknown field"):
+            (TransformProcess.Builder(self._schema())
+             .stringToTimeTransform("ts", "%Y")
+             .deriveColumnsFromTime("ts", ("x", "weekOfCentury"))
+             .build())
+        # derived name colliding with an existing column or repeated
+        with pytest.raises(ValueError, match="collides"):
+            (TransformProcess.Builder(self._schema())
+             .stringToTimeTransform("ts", "%Y")
+             .deriveColumnsFromTime("ts", ("v", "hourOfDay")).build())
+        with pytest.raises(ValueError, match="collides"):
+            (TransformProcess.Builder(self._schema())
+             .stringToTimeTransform("ts", "%Y")
+             .deriveColumnsFromTime("ts", ("h", "hourOfDay"),
+                                    ("h", "dayOfWeek")).build())
+        # foreign JSON cannot smuggle an invalid op past fromJson
+        tp = (TransformProcess.Builder(self._schema())
+              .stringToTimeTransform("ts", "%Y")
+              .timeMathOp("ts", "Add", 1, "DAYS").build())
+        bad = tp.toJson().replace('"Add"', '"Multiply"')
+        with pytest.raises(ValueError, match="Add|Subtract"):
+            TransformProcess.fromJson(bad)
