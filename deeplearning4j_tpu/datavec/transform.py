@@ -378,12 +378,16 @@ class _Step:
             return p["fn"](dict(table))
         if k == "stringToTime":
             name, fmt = p["column"], p["format"]
+
+            def to_ms(v):
+                d = _dt.datetime.strptime(str(v), fmt)
+                if d.tzinfo is None:          # naive -> interpret UTC;
+                    d = d.replace(tzinfo=_dt.timezone.utc)
+                return int(d.timestamp() * 1000)   # %z offsets honored
+
             out = dict(table)
-            out[name] = np.array(
-                [int(_dt.datetime.strptime(str(v), fmt)
-                     .replace(tzinfo=_dt.timezone.utc)
-                     .timestamp() * 1000) for v in table[name]],
-                dtype=np.int64)
+            out[name] = np.array([to_ms(v) for v in table[name]],
+                                 dtype=np.int64)
             return out
         if k == "timeMathOp":
             name = p["column"]

@@ -215,3 +215,57 @@ class Nd4j:
         from deeplearning4j_tpu.ops.registry import get_op
 
         return get_op(op_name)(*args, **kwargs)
+
+    # -- array serde (reference: the Nd4j.{writeTxt,readTxt,saveBinary,
+    # readBinary,writeAsNumpy,createFromNpyFile} statics) --------------
+    @staticmethod
+    def writeTxt(a, path: str) -> None:
+        """Shape/dtype-preserving text format: one JSON header line,
+        then the flattened values (reference: Nd4j.writeTxt — the Java
+        text layout is JVM-specific; this is the same capability with
+        a self-describing header)."""
+        import json as _json
+
+        arr = np.asarray(_unwrap(a))
+        with open(path, "w") as f:
+            f.write(_json.dumps({"shape": list(arr.shape),
+                                 "dtype": arr.dtype.name}) + "\n")
+            flat = arr.ravel()
+            if arr.dtype.kind == "b":
+                f.write("\n".join(str(int(v)) for v in flat))
+            else:
+                f.write("\n".join(repr(v.item()) for v in flat))
+            f.write("\n")
+
+    @staticmethod
+    def readTxt(path: str) -> NDArray:
+        import json as _json
+
+        with open(path) as f:
+            head = _json.loads(f.readline())
+            vals = [line.strip() for line in f if line.strip()]
+        dt = np.dtype(head["dtype"])
+        if dt.kind == "b":
+            # np.bool_("False") is True (non-empty string); parse 0/1
+            arr = np.array([v in ("1", "True") for v in vals],
+                           dtype=bool)
+        else:
+            arr = np.array(vals, dtype=dt)   # numpy parses strings
+        return NDArray(jnp.asarray(arr.reshape(head["shape"])))
+
+    @staticmethod
+    def saveBinary(a, path: str) -> None:
+        """reference: Nd4j.saveBinary — here the npy container (the
+        natural binary substrate; see writeAsNumpy for interop). The
+        file object keeps np.save from appending '.npy' to the exact
+        path the caller chose."""
+        with open(path, "wb") as f:
+            np.save(f, np.asarray(_unwrap(a)), allow_pickle=False)
+
+    @staticmethod
+    def readBinary(path: str) -> NDArray:
+        return NDArray(jnp.asarray(np.load(path, allow_pickle=False)))
+
+    # numpy interop keeps the reference names
+    writeAsNumpy = saveBinary
+    createFromNpyFile = readBinary

@@ -865,3 +865,17 @@ class TestTimeTransforms:
         bad = tp.toJson().replace('"Add"', '"Multiply"')
         with pytest.raises(ValueError, match="Add|Subtract"):
             TransformProcess.fromJson(bad)
+
+    def test_string_to_time_honors_explicit_offset(self):
+        # %z offsets must shift to UTC, not be reinterpreted as UTC
+        schema = Schema.Builder().addColumnString("ts").build()
+        tp = (TransformProcess.Builder(schema)
+              .stringToTimeTransform("ts", "%Y-%m-%d %H:%M:%S %z")
+              .deriveColumnsFromTime("ts", ("hour", "hourOfDay"))
+              .build())
+        out = tp.execute([["2026-07-31 13:00:00 +0200"],
+                          ["2026-07-31 13:00:00 +0000"]])
+        names = tp.getFinalSchema().getColumnNames()
+        h0 = dict(zip(names, out[0]))["hour"]
+        h1 = dict(zip(names, out[1]))["hour"]
+        assert h0 == 11 and h1 == 13

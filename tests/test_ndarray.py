@@ -255,3 +255,63 @@ class TestIndexing:
         assert a.tensorsAlongDimension(1, 2) == 2
         np.testing.assert_allclose(
             a.tensorAlongDimension(1, 1, 2).toNumpy(), a.toNumpy()[1])
+
+
+class TestNd4jSerde:
+    """reference: Nd4j.writeTxt/readTxt/saveBinary/readBinary +
+    numpy-interchange statics."""
+
+    def test_txt_round_trip(self, tmp_path):
+        from deeplearning4j_tpu.ndarray.factory import Nd4j
+        a = Nd4j.create(np.arange(24, dtype=np.float32).reshape(2, 3, 4) / 7)
+        p = str(tmp_path / "a.txt")
+        Nd4j.writeTxt(a, p)
+        b = Nd4j.readTxt(p)
+        assert b.shape() == (2, 3, 4)
+        np.testing.assert_array_equal(b.toNumpy(), a.toNumpy())  # exact: repr round-trips floats
+
+    def test_txt_int_dtype(self, tmp_path):
+        from deeplearning4j_tpu.ndarray.factory import Nd4j
+        a = Nd4j.create(np.array([[1, -2], [3, 4]], np.int64))
+        p = str(tmp_path / "i.txt")
+        Nd4j.writeTxt(a, p)
+        b = Nd4j.readTxt(p)
+        # int64 maps to int32 under jax's x64-off dtype calculus —
+        # same as Nd4j.create on the original array
+        assert b.toNumpy().dtype == a.toNumpy().dtype == np.int32
+        np.testing.assert_array_equal(b.toNumpy(), a.toNumpy())
+
+    def test_txt_bool_round_trip(self, tmp_path):
+        # np.bool_("False") is True — the format must not rely on repr
+        from deeplearning4j_tpu.ndarray.factory import Nd4j
+        a = Nd4j.create(np.array([True, False, False, True]))
+        p = str(tmp_path / "b.txt")
+        Nd4j.writeTxt(a, p)
+        np.testing.assert_array_equal(Nd4j.readTxt(p).toNumpy(),
+                                      a.toNumpy())
+
+    def test_binary_keeps_exact_path(self, tmp_path):
+        # np.save appends .npy to bare paths; saveBinary must not
+        from deeplearning4j_tpu.ndarray.factory import Nd4j
+        import os
+        a = Nd4j.randn(2, 2)
+        p = str(tmp_path / "weights.bin")
+        Nd4j.saveBinary(a, p)
+        assert os.path.exists(p) and not os.path.exists(p + ".npy")
+        np.testing.assert_array_equal(Nd4j.readBinary(p).toNumpy(),
+                                      a.toNumpy())
+
+    def test_binary_and_npy_interop(self, tmp_path):
+        from deeplearning4j_tpu.ndarray.factory import Nd4j
+        a = Nd4j.randn(3, 5)
+        p = str(tmp_path / "a.npy")
+        Nd4j.saveBinary(a, p)
+        back = Nd4j.readBinary(p)
+        np.testing.assert_array_equal(back.toNumpy(), a.toNumpy())
+        # the file IS a standard npy: plain numpy reads it...
+        np.testing.assert_array_equal(np.load(p), a.toNumpy())
+        # ...and a numpy-written file loads through the reference name
+        q = str(tmp_path / "b.npy")
+        np.save(q, np.ones((2, 2), np.float32))
+        np.testing.assert_array_equal(
+            Nd4j.createFromNpyFile(q).toNumpy(), np.ones((2, 2)))
