@@ -435,23 +435,76 @@ class SequenceVectors:
             return 0.0
         return float(a @ b / (na * nb))
 
-    def wordsNearest(self, word: str, n: int = 10) -> List[str]:
-        """Top-n cosine neighbours (ref: WordVectors#wordsNearest)."""
-        self._check_fitted()
+    def _unit_matrix(self) -> np.ndarray:
         mat = np.asarray(self.syn0)
         norms = np.linalg.norm(mat, axis=1, keepdims=True)
-        unit = mat / np.maximum(norms, 1e-12)
-        q = unit[self.vocab.indexOf(word)]
-        sims = unit @ q
-        order = np.argsort(-sims)
+        return mat / np.maximum(norms, 1e-12)
+
+    def wordsNearest(self, word, negative=None, n: int = 10) -> List[str]:
+        """Top-n cosine neighbours (ref: WordVectors#wordsNearest).
+
+        Two reference forms:
+        - ``wordsNearest("day", n=5)`` — neighbours of one word;
+        - ``wordsNearest(["king", "woman"], ["man"], n=5)`` — the
+          analogy query: mean of UNIT positive vectors minus mean of
+          unit negative vectors (the reference's normalized-mean
+          arithmetic), query words excluded from the result."""
+        self._check_fitted()
+        if isinstance(negative, int):      # the (word, n) overload
+            n, negative = negative, None
+        if isinstance(word, str) and negative is None:
+            positive, negative = [word], []
+        else:
+            positive = [word] if isinstance(word, str) else list(word)
+            negative = [] if negative is None else (
+                [negative] if isinstance(negative, str)
+                else list(negative))
+        for w in positive + negative:
+            if self.vocab.indexOf(w) < 0:
+                raise KeyError(w)
+        unit = self._unit_matrix()
+        # reference arithmetic: one mean over (+unit positives,
+        # -unit negatives) — i.e. q ∝ sum(P) - sum(N); per-list means
+        # would reweight unequal-length lists
+        q = np.zeros(unit.shape[1])
+        for w in positive:
+            q += unit[self.vocab.indexOf(w)]
+        for w in negative:
+            q -= unit[self.vocab.indexOf(w)]
+        return self._rank_excluding(q, set(positive) | set(negative), n)
+
+    def _rank_excluding(self, q: np.ndarray, exclude, n: int
+                        ) -> List[str]:
+        """Cosine top-n over the vocab, skipping ``exclude``."""
+        sims = self._unit_matrix() @ q
         out = []
-        for i in order:
+        for i in np.argsort(-sims):
             w = self.vocab.wordAtIndex(int(i))
-            if w != word:
+            if w is not None and w not in exclude:
                 out.append(w)
             if len(out) >= n:
                 break
         return out
+
+    def wordsNearestSum(self, positive, negative=(), n: int = 10
+                        ) -> List[str]:
+        """Raw-vector SUM variant (ref: WordVectors#wordsNearestSum —
+        unnormalized addition, the original word2vec-tool arithmetic).
+        Supports the same (word, n) positional overload as
+        ``wordsNearest``."""
+        self._check_fitted()
+        if isinstance(negative, int):      # the (word, n) overload
+            n, negative = negative, ()
+        if isinstance(positive, str):
+            positive = [positive]
+        negative = [negative] if isinstance(negative, str) \
+            else list(negative)
+        q = np.zeros(np.asarray(self.syn0).shape[1])
+        for w in positive:
+            q += self.getWordVector(w)
+        for w in negative:
+            q -= self.getWordVector(w)
+        return self._rank_excluding(q, set(positive) | set(negative), n)
 
 
 class Word2Vec(SequenceVectors):

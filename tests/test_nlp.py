@@ -391,3 +391,49 @@ class TestInterchangeFormats:
         m2 = WordVectorSerializer.readWordVectors(p)
         np.testing.assert_allclose(m2.getWordVector("café"),
                                    m.getWordVector("café"), rtol=1e-6)
+
+
+class TestWordAnalogies:
+    """reference: WordVectors#wordsNearest(positive, negative, n) /
+    wordsNearestSum — the analogy arithmetic. Geometry is hand-set so
+    the expected answer is exact, not corpus-dependent."""
+
+    def _model_with_vectors(self):
+        import jax.numpy as jnp
+        model = Word2Vec(layer_size=2, min_word_frequency=1, epochs=1,
+                         seed=0)
+        model.fit(["king man woman queen day night"] * 2)
+        vecs = {"king": [2.0, 2.0], "man": [2.0, 0.0],
+                "woman": [0.0, 2.0], "queen": [0.3, 4.0],
+                "day": [-3.0, 0.1], "night": [-3.0, -0.1]}
+        mat = np.zeros((model.vocab.numWords(), 2), np.float32)
+        for w, v in vecs.items():
+            mat[model.vocab.indexOf(w)] = v
+        model.syn0 = jnp.asarray(mat)
+        return model
+
+    def test_analogy_mean_form(self):
+        m = self._model_with_vectors()
+        # king - man + woman -> queen (unit-mean arithmetic)
+        assert m.wordsNearest(["king", "woman"], ["man"], n=1) == ["queen"]
+        # query words are excluded from results
+        out = m.wordsNearest(["king", "woman"], ["man"], n=10)
+        assert "king" not in out and "woman" not in out
+
+    def test_analogy_sum_form(self):
+        m = self._model_with_vectors()
+        assert m.wordsNearestSum(["king", "woman"], ["man"], n=1) \
+            == ["queen"]
+        # single-string positives accepted, incl. the (word, n) form
+        assert m.wordsNearestSum("day", n=1) == ["night"]
+        assert m.wordsNearestSum("day", 1) == ["night"]
+
+    def test_single_word_form_unchanged(self):
+        m = self._model_with_vectors()
+        assert m.wordsNearest("day", n=1) == ["night"]
+        assert m.wordsNearest("day", n=3)[0] == "night"
+
+    def test_unknown_word_raises(self):
+        m = self._model_with_vectors()
+        with pytest.raises(KeyError):
+            m.wordsNearest(["king", "prince"], ["man"], n=1)
