@@ -345,6 +345,7 @@ def _lstm_metrics(peak, base, record) -> tuple:
     f_params, f_opt, fl = f_step(f_params, f_opt, jnp.asarray(0), fx, fy)
     float(fl)
     best = float("inf")
+    f_best = float("inf")
     ratios = []
     for _ in range(trials):
         # PER-TRIAL ratio of ADJACENT windows, then median across
@@ -364,7 +365,9 @@ def _lstm_metrics(peak, base, record) -> tuple:
             f_params, f_opt, fl = f_step(f_params, f_opt,
                                          jnp.asarray(i + 1), fx, fy)
         float(fl)
-        ratios.append((time.perf_counter() - t0) / dt)
+        f_dt = time.perf_counter() - t0
+        f_best = min(f_best, f_dt)
+        ratios.append((f_dt / dt, f_dt))
 
     tokens_per_sec = tokens_per_step * steps / best
     out = {"lstm_tokens_per_sec_chip": round(tokens_per_sec, 1),
@@ -375,15 +378,45 @@ def _lstm_metrics(peak, base, record) -> tuple:
         out["lstm_mfu_src"] = "cost_analysis"
 
     regression = False
-    ratio = sorted(ratios)[len(ratios) // 2]  # >1: framework faster
+    # the band statistic is the MEDIAN trial's ratio; the tenancy
+    # gauge uses THAT SAME trial's frozen window so one calm outlier
+    # trial cannot defeat the suspension while the median ratio is
+    # still load-poisoned
+    ratio, f_med = sorted(ratios)[len(ratios) // 2]
     out["lstm_vs_frozen"] = round(ratio, 4)
+    out["lstm_frozen_window_ms"] = round(f_med * 1000, 1)
     platform = jax.devices()[0].platform
     key = f"{platform}_lstm_vs_frozen_v2"  # v2: median-of-trial-ratios
+    fkey = f"{platform}_lstm_frozen_window_ms_v1"
+    f_note = ("calm-chip frozen-yardstick window (ms); tenancy gauge "
+              "for the LSTM band; min-ratcheted across runs so a "
+              "busy-chip first run cannot inflate it permanently")
+    stored_f = float(base.get(fkey, {}).get("value") or 0)
+    if stored_f == 0 or f_best * 1000 < stored_f:
+        record(fkey, {"value": f_best * 1000, "note": f_note})
+        stored_f = f_best * 1000
     if key in base and base[key].get("value"):
         band_lo = float(base[key]["value"]) * 0.95
         out["lstm_vs_frozen_band_lo"] = round(band_lo, 4)
+        busy = stored_f > 0 and f_med * 1000 > 1.10 * stored_f
         if ratio < band_lo:
-            regression = True
+            if busy:
+                # measured 2026-08-01 (BASELINE.md "LSTM band tenancy
+                # gauge"): under heavy tenancy BOTH sides inflate but
+                # the latency-bound framework step inflates MORE
+                # (fw 1.6-2.2x vs frozen 1.2-1.5x on identical code),
+                # so the ratio alone cannot distinguish drift from
+                # load. Trigger is 1.10x: the frozen side is LESS
+                # load-sensitive than the framework side (1.2x frozen
+                # inflation accompanied 1.9x framework inflation in
+                # the probes), so mild frozen inflation already marks
+                # heavy asymmetric load.
+                out["lstm_band_status"] = (
+                    f"suspended: frozen yardstick {f_med*1000:.0f}ms "
+                    f"is {f_med*1000/stored_f:.2f}x its calm baseline "
+                    f"{stored_f:.0f}ms — chip busy, ratio untrustworthy")
+            else:
+                regression = True
     else:
         record(key, {"value": ratio,
                      "note": "framework/frozen LSTM step-time ratio; "
