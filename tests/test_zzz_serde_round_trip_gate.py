@@ -1,0 +1,102 @@
+"""EXECUTIONAL config-serde gate: every @serializable-registered class
+must round-trip through JSON.
+
+Reference parity: the config system's hard contract is Jackson
+round-trip on EVERY config (SURVEY.md §2.18/§5 — MultiLayerConfiguration
+toJson/fromJson plus polymorphic layer/updater/schedule serializers,
+exercised across the reference's layer-config test suites). The
+hand-picked round-trip lists in test_layers_extra.py cover what someone
+remembered to list; THIS gate iterates the live serde registry so a
+newly registered config class cannot ship without a working
+to_json -> from_json -> to_json identity.
+
+Mirrors the op/mapper execution gates: enumerate the registry, build an
+instance of every class (SPECIAL carries constructors for classes whose
+__init__ needs arguments), and fail the build for anything that does
+not round-trip. EXEMPT entries need a reason.
+"""
+import dataclasses
+
+import pytest
+
+# importing EVERY @serializable-carrying module registers the classes
+# (grep '@serializable' is the source of this list; the populated-count
+# floor below catches an import refactor dropping one)
+import deeplearning4j_tpu.autodiff.training  # noqa: F401
+import deeplearning4j_tpu.learning  # noqa: F401
+import deeplearning4j_tpu.models.transformer  # noqa: F401
+import deeplearning4j_tpu.nn.conf  # noqa: F401
+import deeplearning4j_tpu.nn.conf.objdetect  # noqa: F401
+import deeplearning4j_tpu.nn.conf.ocnn  # noqa: F401
+import deeplearning4j_tpu.nn.conf.variational  # noqa: F401
+import deeplearning4j_tpu.nn.graph.config  # noqa: F401
+import deeplearning4j_tpu.nn.graph.vertices  # noqa: F401
+import deeplearning4j_tpu.nn.transferlearning  # noqa: F401
+from deeplearning4j_tpu.common import serde
+from deeplearning4j_tpu.common.serde import _CLASSES
+
+#: class name -> zero-arg factory, for classes whose __init__ requires
+#: arguments. Keep entries MINIMAL — a default-constructible config is
+#: the norm and keeps this gate self-maintaining.
+SPECIAL = {
+    "MapSchedule": lambda: _CLASSES["MapSchedule"](
+        values={0: 0.1, 10: 0.01}),
+}
+
+#: class name -> reason it cannot round-trip (none expected; an entry
+#: here is a conscious decision, like the op gate's EXEMPT)
+EXEMPT: dict = {}
+
+
+def _instances():
+    for name in sorted(_CLASSES):
+        if name in EXEMPT:
+            continue
+        yield name
+
+
+@pytest.mark.parametrize("name", list(_instances()))
+def test_registered_class_round_trips(name):
+    cls = _CLASSES[name]
+    obj = SPECIAL[name]() if name in SPECIAL else cls()
+    j = serde.to_json(obj)
+    back = serde.from_json(j)
+    assert type(back) is cls
+    assert serde.to_json(back) == j, (
+        f"{name}: from_json(to_json(x)) is not identity")
+
+
+def test_registry_is_populated():
+    # guards against an import refactor silently emptying the gate
+    assert len(_CLASSES) >= 115, sorted(_CLASSES)
+
+
+def test_exempt_entries_are_still_registered():
+    stale = [n for n in EXEMPT if n not in _CLASSES]
+    assert not stale, f"EXEMPT entries no longer registered: {stale}"
+
+
+def test_all_dataclass_fields_survive():
+    # the identity gate above uses all-default instances, which cannot
+    # see a field silently dropped back to its default; here EVERY
+    # field of DenseLayer is set non-default and checked individually
+    from deeplearning4j_tpu.learning import Adam
+    from deeplearning4j_tpu.nn.conf import (
+        DenseLayer, Dropout, MaxNormConstraint, WeightNoise)
+    lay = DenseLayer(
+        name="fc1", activation="elu", weight_init="relu",
+        updater=Adam(learning_rate=0.007), l1=0.01, l2=0.02,
+        dropout=Dropout(rate=0.25),
+        weight_noise=WeightNoise(stddev=0.05),
+        constraints=[MaxNormConstraint(max_norm=2.0)],
+        n_in=7, n_out=11, has_bias=False)
+    defaults = DenseLayer()
+    back = serde.from_json(serde.to_json(lay))
+    for f in dataclasses.fields(lay):
+        # the instance genuinely differs from the default...
+        assert getattr(lay, f.name) != getattr(defaults, f.name), (
+            f"{f.name}: test value equals the default — set it "
+            "non-default so a dropped field is detectable")
+        # ...and the round-trip preserves it
+        a, b = getattr(back, f.name), getattr(lay, f.name)
+        assert serde.to_dict(a) == serde.to_dict(b), f.name
