@@ -609,6 +609,62 @@ class ComputationGraph:
     def outputSingle(self, *xs, feature_masks=None) -> NDArray:
         return self.output(*xs, feature_masks=feature_masks)[0]
 
+    def backpropGradient(self, xs, external_errors, train: bool = True):
+        """Backprop EXTERNAL errors through the graph (reference:
+        ComputationGraph#backpropGradient(INDArray... epsilons) — one
+        epsilon per network output, caller-owned loss). ``xs`` is a
+        list of input arrays (one per network input) and
+        ``external_errors`` a list of dL/dOutput arrays (one per
+        network output, graph output order). Returns (gradients in the
+        ``params_map`` pytree layout, {input name: epsilon}). One
+        ``jax.vjp`` over the same jit-compiled forward the training
+        step uses (train=True: dropout + batch statistics, like the
+        reference and the MultiLayerNetwork sibling)."""
+        self._check_init()
+        conf = self.conf
+        if not isinstance(xs, (list, tuple)):
+            xs = [xs]
+        if not isinstance(external_errors, (list, tuple)):
+            external_errors = [external_errors]
+        if len(xs) != len(conf.network_inputs):
+            raise ValueError(
+                f"need one input per network input "
+                f"({len(conf.network_inputs)}), got {len(xs)}")
+        if len(external_errors) != len(conf.network_outputs):
+            raise ValueError(
+                f"need one external error per network output "
+                f"({len(conf.network_outputs)}), got "
+                f"{len(external_errors)}")
+        inputs = {n: jnp.asarray(_unwrap(x), self._dtype)
+                  for n, x in zip(conf.network_inputs, xs)}
+        errs = tuple(jnp.asarray(_unwrap(e), self._dtype)
+                     for e in external_errors)
+        saved_key = self._rng_key
+        if train:
+            self._rng_key, sub = jax.random.split(self._rng_key)
+        else:
+            sub = None
+        if not hasattr(self, "_ext_fwd"):
+            self._ext_fwd = {}
+        if train not in self._ext_fwd:
+            self._ext_fwd[train] = jax.jit(
+                lambda pm, sm, inp, rng: tuple(
+                    self._forward_all(pm, sm, inp, train, rng, {})[0][o]
+                    for o in conf.network_outputs))
+        fwd = self._ext_fwd[train]
+        outs, vjp = jax.vjp(
+            lambda pm, inp: fwd(pm, self.states_map, inp, sub),
+            self.params_map, inputs)
+        for e, o, name in zip(errs, outs, conf.network_outputs):
+            if e.shape != o.shape:
+                self._rng_key = saved_key   # failed call: keep
+                #                             seed-for-seed streams
+                raise ValueError(
+                    f"external error for output {name!r} has shape "
+                    f"{e.shape}, expected {o.shape}")
+        grads, eps = vjp(errs)
+        return grads, {n: NDArray(v) for n, v in eps.items()}
+
     def score(self, dataset: Optional[DataSet] = None) -> float:
         if dataset is None:
             return float(self._score)

@@ -732,6 +732,41 @@ class MultiLayerNetwork:
                              dataset.labels_mask, None)
         return float(loss)
 
+    def backpropGradient(self, x, external_errors, train: bool = True,
+                         features_mask=None):
+        """Backprop EXTERNAL errors through the whole net (reference:
+        MultiLayerNetwork#backpropGradient(epsilon, workspaceMgr) — the
+        embed-in-a-custom-training-loop workflow: the caller owns the
+        loss, hands dL/dOutput here, and receives (parameter gradients,
+        epsilon at the input)).
+
+        TPU-first: one ``jax.vjp`` over the same compiled train-mode
+        forward ``output(train=True)`` uses, so the whole
+        forward+backward is XLA-fused; gradients come back in the
+        ``params_list`` pytree layout (what ``updater.apply`` and
+        ``computeGradientAndScore`` use)."""
+        self._check_init()
+        xj = jnp.asarray(_unwrap(x), self._dtype)
+        err = jnp.asarray(_unwrap(external_errors), self._dtype)
+        fm = self._validate_fmask(features_mask, xj)
+        saved_key = self._rng_key
+        if train:
+            self._rng_key, sub = jax.random.split(self._rng_key)
+        else:
+            sub = None
+        fwd = self._get_forward(train, fm is not None)
+        out, vjp = jax.vjp(
+            lambda pl, xx: fwd(pl, self.states_list, xx, sub, fm),
+            self.params_list, xj)
+        if err.shape != out.shape:
+            self._rng_key = saved_key   # failed call must not advance
+            #                             the dropout stream
+            raise ValueError(
+                f"external_errors shape {err.shape} must match the "
+                f"network output shape {out.shape}")
+        grads, eps = vjp(err)
+        return grads, NDArray(eps)
+
     def computeGradientAndScore(self, x, y):
         """(gradients, score) — the seam gradient-check tests use
         (reference: MultiLayerNetwork#computeGradientAndScore)."""

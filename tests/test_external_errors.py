@@ -1,0 +1,183 @@
+"""External-errors backprop parity (reference:
+MultiLayerNetwork#backpropGradient(epsilon, mgr) /
+ComputationGraph#backpropGradient(INDArray...) — BackPropMLNTest's
+external-errors cases: a caller-owned loss hands dL/dOutput to the
+network and receives parameter gradients + input epsilon)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.learning import Sgd
+from deeplearning4j_tpu.nn.conf import (
+    DenseLayer, InputType, NeuralNetConfiguration, OutputLayer)
+from deeplearning4j_tpu.nn.graph import (
+    ComputationGraph, ComputationGraphConfiguration, MergeVertex)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+
+def _net(seed=1):
+    conf = (NeuralNetConfiguration.builder().seed(seed)
+            .updater(Sgd(learning_rate=0.1)).list()
+            .layer(DenseLayer(n_in=4, n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_in=8, n_out=3, loss="mse",
+                               activation="identity"))
+            .build())
+    net = MultiLayerNetwork(conf)
+    net.init()
+    return net
+
+
+class TestMLNExternalErrors:
+    def test_matches_jax_grad_of_external_loss(self):
+        # caller-owned loss L = sum(out * W); dL/dout = W, so the
+        # returned gradients must equal jax.grad of the composition
+        net = _net()
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(5, 4)).astype(np.float32)
+        W = rng.normal(size=(5, 3)).astype(np.float32)
+        grads, eps = net.backpropGradient(x, W, train=False)
+
+        fwd = net._get_forward(False, False)
+
+        def ext_loss(pl, xx):
+            return jnp.sum(fwd(pl, net.states_list, xx, None, None)
+                           * W)
+
+        want_p, want_x = jax.grad(ext_loss, argnums=(0, 1))(
+            net.params_list, jnp.asarray(x))
+        flat_a = jax.tree_util.tree_leaves(grads)
+        flat_b = jax.tree_util.tree_leaves(want_p)
+        assert len(flat_a) == len(flat_b) > 0
+        for a, b in zip(flat_a, flat_b):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(eps.jax),
+                                   np.asarray(want_x), rtol=1e-5)
+
+    def test_epsilon_shape_and_descent(self):
+        # gradient-descending an EXTERNAL quadratic loss through
+        # backpropGradient must reduce it (the custom-loop workflow)
+        net = _net(seed=2)
+        rng = np.random.default_rng(1)
+        x = rng.normal(size=(6, 4)).astype(np.float32)
+        target = rng.normal(size=(6, 3)).astype(np.float32)
+
+        def ext_loss_value():
+            out = np.asarray(net.output(x).jax)
+            return float(((out - target) ** 2).mean()), out
+
+        l0, out = ext_loss_value()
+        for _ in range(60):
+            err = 2.0 * (out - target) / out.size
+            grads, eps = net.backpropGradient(x, err, train=False)
+            assert np.asarray(eps.jax).shape == x.shape
+            net.params_list = jax.tree_util.tree_map(
+                lambda p, g: p - 0.5 * g, net.params_list, grads)
+            _, out = ext_loss_value()
+        l1, _ = ext_loss_value()
+        assert l1 < 0.2 * l0, (l0, l1)
+
+    def test_shape_mismatch_raises(self):
+        net = _net()
+        x = np.zeros((2, 4), np.float32)
+        with pytest.raises(ValueError, match="must match"):
+            net.backpropGradient(x, np.zeros((2, 7), np.float32))
+
+    def test_train_mode_runs(self):
+        net = _net()
+        x = np.zeros((3, 4), np.float32)
+        grads, eps = net.backpropGradient(
+            x, np.ones((3, 3), np.float32), train=True)
+        assert np.asarray(eps.jax).shape == (3, 4)
+
+
+class TestGraphExternalErrors:
+    def test_two_input_graph_epsilons(self):
+        conf = (ComputationGraphConfiguration.graphBuilder().seed(3)
+                .addInputs("a", "b")
+                .setInputTypes(InputType.feedForward(3),
+                               InputType.feedForward(2))
+                .addLayer("da", DenseLayer(n_out=6, activation="tanh"),
+                          "a")
+                .addLayer("db", DenseLayer(n_out=6, activation="tanh"),
+                          "b")
+                .addVertex("m", MergeVertex(), "da", "db")
+                .addLayer("out", OutputLayer(n_out=2, loss="mse",
+                                             activation="identity"),
+                          "m")
+                .setOutputs("out").build())
+        g = ComputationGraph(conf)
+        g.init()
+        rng = np.random.default_rng(2)
+        xa = rng.normal(size=(4, 3)).astype(np.float32)
+        xb = rng.normal(size=(4, 2)).astype(np.float32)
+        W = rng.normal(size=(4, 2)).astype(np.float32)
+        grads, eps = g.backpropGradient([xa, xb], [W], train=False)
+        assert set(eps) == {"a", "b"}
+        assert np.asarray(eps["a"].jax).shape == xa.shape
+        assert np.asarray(eps["b"].jax).shape == xb.shape
+
+        # parity with jax.grad of the external composition
+        def ext_loss(pm, inp):
+            outs = g._forward_all(pm, g.states_map, inp, False, None,
+                                  {})[0]
+            return jnp.sum(outs["out"] * W)
+
+        want_p, want_in = jax.grad(ext_loss, argnums=(0, 1))(
+            g.params_map, {"a": jnp.asarray(xa), "b": jnp.asarray(xb)})
+        for a, b in zip(jax.tree_util.tree_leaves(grads),
+                        jax.tree_util.tree_leaves(want_p)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(eps["a"].jax),
+                                   np.asarray(want_in["a"]), rtol=1e-5)
+
+    def test_error_count_and_shape_validation(self):
+        conf = (ComputationGraphConfiguration.graphBuilder().seed(4)
+                .addInputs("x")
+                .setInputTypes(InputType.feedForward(3))
+                .addLayer("out", OutputLayer(n_out=2, loss="mse",
+                                             activation="identity"),
+                          "x")
+                .setOutputs("out").build())
+        g = ComputationGraph(conf)
+        g.init()
+        x = np.zeros((2, 3), np.float32)
+        with pytest.raises(ValueError, match="one external error"):
+            g.backpropGradient([x], [np.zeros((2, 2)), np.zeros((2, 2))])
+        with pytest.raises(ValueError, match="one input per"):
+            g.backpropGradient([x, x], [np.zeros((2, 2), np.float32)])
+        with pytest.raises(ValueError, match="expected"):
+            g.backpropGradient([x], [np.zeros((2, 5), np.float32)])
+
+    def test_train_mode_uses_dropout_and_rng_restores_on_error(self):
+        from deeplearning4j_tpu.nn.conf import DropoutLayer
+        conf = (ComputationGraphConfiguration.graphBuilder().seed(5)
+                .addInputs("x")
+                .setInputTypes(InputType.feedForward(4))
+                .addLayer("d", DenseLayer(n_out=16, activation="tanh"),
+                          "x")
+                .addLayer("drop", DropoutLayer(rate=0.5), "d")
+                .addLayer("out", OutputLayer(n_out=2, loss="mse",
+                                             activation="identity"),
+                          "drop")
+                .setOutputs("out").build())
+        g = ComputationGraph(conf)
+        g.init()
+        x = np.ones((8, 4), np.float32)
+        e = np.ones((8, 2), np.float32)
+        g1, _ = g.backpropGradient([x], [e], train=True)
+        g2, _ = g.backpropGradient([x], [e], train=True)
+        import jax as _jax
+        diffs = [float(np.abs(np.asarray(a) - np.asarray(b)).max())
+                 for a, b in zip(_jax.tree_util.tree_leaves(g1),
+                                 _jax.tree_util.tree_leaves(g2))]
+        assert max(diffs) > 0  # different dropout masks -> train mode real
+        # a failed call must not advance the dropout stream
+        key_before = g._rng_key
+        with pytest.raises(ValueError):
+            g.backpropGradient([x], [np.zeros((8, 9), np.float32)],
+                               train=True)
+        assert (np.asarray(jax.random.key_data(key_before))
+                == np.asarray(jax.random.key_data(g._rng_key))).all()
