@@ -22,6 +22,17 @@ def peak_flops():
     return PEAK_FLOPS.get(jax.devices()[0].device_kind)
 
 
+def telemetry_snapshot():
+    """Compile counts/times + device-memory watermarks from the
+    process-wide telemetry registry (profiler/telemetry.py), for
+    embedding in BENCH_*.json rounds alongside wall-clock: a result is
+    only comparable if it compiled the same number of times, and this
+    makes that visible. Call AFTER the timed windows."""
+    from deeplearning4j_tpu.profiler import telemetry
+
+    return telemetry.snapshot()
+
+
 def aot_cost_flops(step, *args, **kwargs):
     """Per-step FLOPs from XLA's cost analysis of the compiled step.
 
@@ -105,4 +116,5 @@ def run_char_lstm(batch=256, seq=200, hidden=256, vocab=77, steps=10,
     best = time_best_of(run, state0, steps)
     return {"tokens_per_sec": tokens_per_step * steps / best,
             "flops_per_step": flops_per_step,
-            "tokens_per_step": tokens_per_step}
+            "tokens_per_step": tokens_per_step,
+            "telemetry": telemetry_snapshot()}

@@ -11,6 +11,7 @@ reference does for multi-output training).
 
 from __future__ import annotations
 
+import time
 from typing import Any, Dict, List, Optional, Sequence
 
 import jax
@@ -29,6 +30,7 @@ from deeplearning4j_tpu.nn.conf.layers import LossLayer, OutputLayer
 from deeplearning4j_tpu.nn.multilayer.network import (
     _REGULARIZED_KEYS, _eval_mask, _uses_epoch_schedule,
 )
+from deeplearning4j_tpu.profiler import telemetry as _telemetry
 
 
 class ComputationGraph:
@@ -308,7 +310,8 @@ class ComputationGraph:
                 new_opt[name] = no
             return new_params, new_states, new_opt, data_loss
 
-        jitted = jax.jit(step_fn, donate_argnums=(0, 1, 2))
+        jitted = _telemetry.instrument_jit(
+            "cg_step", jax.jit(step_fn, donate_argnums=(0, 1, 2)))
         self._step_cache[cache_key] = jitted
         return jitted
 
@@ -325,7 +328,7 @@ class ComputationGraph:
                     "epochs > 1 requires a resettable MultiDataSetIterator "
                     "(reference behavior)")
             for _ in range(epochs):
-                for mds in data:
+                for mds in _telemetry.timed_batches(data):
                     self._fit_batch(mds.features, mds.labels,
                                     mds.labels_mask_arrays or None,
                                     mds.features_mask_arrays or None)
@@ -339,7 +342,7 @@ class ComputationGraph:
             return self
         if isinstance(data, DataSetIterator):
             for _ in range(epochs):
-                for ds in data:
+                for ds in _telemetry.timed_batches(data):
                     self._fit_batch([ds.features], [ds.labels],
                                     [ds.labels_mask], [ds.features_mask])
                 self._epoch += 1
@@ -391,15 +394,25 @@ class ComputationGraph:
         fmasks = self._validate_fmasks(feature_masks, inputs)
         self._rng_key, sub = jax.random.split(self._rng_key)
         step = self._get_train_step(frozenset(masks), frozenset(fmasks))
+        t_step = time.perf_counter()
         (self.params_map, self.states_map, self.opt_states, loss) = step(
             self.params_map, self.states_map, self.opt_states,
             jnp.asarray(self._iteration), jnp.asarray(self._epoch),
             inputs, labels, masks, fmasks, sub)
+        # dispatch-side host timing (the step itself runs async on
+        # device; blocking here would stall the pipeline)
+        _telemetry.record_phase("device_step", t_step)
         self._score = loss  # on-device; score() converts lazily (no
         # per-step host sync — critical for dispatch pipelining)
         self._iteration += 1
-        for l in self._listeners:
-            l.iterationDone(self, self._iteration, self._epoch)
+        self._last_batch_size = int(
+            next(iter(inputs.values())).shape[0]) if inputs else 0
+        _telemetry.sample_device_memory()
+        if self._listeners:
+            t_l = time.perf_counter()
+            for l in self._listeners:
+                l.iterationDone(self, self._iteration, self._epoch)
+            _telemetry.record_phase("listener_host", t_l)
 
     # ------------------------------------------------------------------
     # ------------------------------------------------------------------
@@ -456,7 +469,8 @@ class ComputationGraph:
                                            updates)
             return apply_constraints(layer, new_p), new_opt, loss
 
-        jitted = jax.jit(step_fn)
+        jitted = _telemetry.instrument_jit("cg_pretrain",
+                                           jax.jit(step_fn))
         self._step_cache[key] = jitted
         return jitted
 
@@ -569,7 +583,8 @@ class ComputationGraph:
                 for name in self._recurrent_nodes()}
             self._rnn_batch = n
         if "rnn_step" not in self._step_cache:
-            self._step_cache["rnn_step"] = jax.jit(self._rnn_step_forward)
+            self._step_cache["rnn_step"] = _telemetry.instrument_jit(
+                "cg_rnn_step", jax.jit(self._rnn_step_forward))
         inputs = {k: a for k, a in zip(conf.network_inputs, arrs)}
         outs, self._rnn_carries = self._step_cache["rnn_step"](
             self.params_map, self.states_map, self._rnn_carries, inputs)
@@ -598,10 +613,10 @@ class ComputationGraph:
         if self._fwd is None:
             self._fwd = {}
         if key not in self._fwd:
-            self._fwd[key] = jax.jit(
+            self._fwd[key] = _telemetry.instrument_jit("cg_forward", jax.jit(
                 lambda pm, sm, inp, fms: tuple(
                     self._forward_all(pm, sm, inp, False, None, fms)[0][o]
-                    for o in conf.network_outputs))
+                    for o in conf.network_outputs)))
         outs = self._fwd[key](self.params_map, self.states_map, inputs,
                               fmasks)
         return [NDArray(o) for o in outs]
@@ -647,10 +662,14 @@ class ComputationGraph:
         if not hasattr(self, "_ext_fwd"):
             self._ext_fwd = {}
         if train not in self._ext_fwd:
-            self._ext_fwd[train] = jax.jit(
-                lambda pm, sm, inp, rng: tuple(
-                    self._forward_all(pm, sm, inp, train, rng, {})[0][o]
-                    for o in conf.network_outputs))
+            # signature probe: this fn is only ever called under
+            # jax.vjp, where the executable cache never grows
+            self._ext_fwd[train] = _telemetry.instrument_jit(
+                "cg_ext_forward", jax.jit(
+                    lambda pm, sm, inp, rng: tuple(
+                        self._forward_all(pm, sm, inp, train, rng, {})[0][o]
+                        for o in conf.network_outputs)),
+                probe="signature")
         fwd = self._ext_fwd[train]
         outs, vjp = jax.vjp(
             lambda pm, inp: fwd(pm, self.states_map, inp, sub),

@@ -35,6 +35,7 @@ from deeplearning4j_tpu.datasets.dataset import DataSet
 from deeplearning4j_tpu.datasets.iterator import DataSetIterator
 from deeplearning4j_tpu.learning.schedules import ISchedule, ScheduleType
 from deeplearning4j_tpu.learning.updaters import IUpdater, apply_updater
+from deeplearning4j_tpu.profiler import telemetry as _telemetry
 
 
 def _eval_mask(ds):
@@ -310,7 +311,8 @@ class MultiLayerNetwork:
                 new_opt.append(no)
             return new_params, new_states, new_opt, data_loss
 
-        jitted = jax.jit(step_fn, donate_argnums=(0, 1, 2))
+        jitted = _telemetry.instrument_jit(
+            "mln_step", jax.jit(step_fn, donate_argnums=(0, 1, 2)))
         self._step_cache[key] = jitted
         return jitted
 
@@ -342,7 +344,8 @@ class MultiLayerNetwork:
                 new_opt.append(no)
             return new_params, new_states, new_opt, new_carries, data_loss
 
-        jitted = jax.jit(step_fn, donate_argnums=(0, 1, 2, 3))
+        jitted = _telemetry.instrument_jit(
+            "mln_tbptt_step", jax.jit(step_fn, donate_argnums=(0, 1, 2, 3)))
         self._step_cache[key] = jitted
         return jitted
 
@@ -350,9 +353,9 @@ class MultiLayerNetwork:
         key = (train, has_fmask)
         if key in self._fwd_cache:
             return self._fwd_cache[key]
-        fn = jax.jit(
+        fn = _telemetry.instrument_jit("mln_forward", jax.jit(
             lambda pl, sl, x, rng, fm: self._forward(pl, sl, x, train, rng,
-                                                     fm)[0])
+                                                     fm)[0]))
         self._fwd_cache[key] = fn
         return fn
 
@@ -376,6 +379,7 @@ class MultiLayerNetwork:
                     except StopIteration:
                         break
                     self._last_etl_ms = (_time.perf_counter() - t0) * 1e3
+                    _telemetry.record_phase("etl_wait", t0)
                     self._fit_batch(ds.features, ds.labels, ds.labels_mask,
                                     ds.features_mask)
                 self._epoch += 1
@@ -426,23 +430,33 @@ class MultiLayerNetwork:
             return self._fit_tbptt(x, y, m, k)
         self._rng_key, sub = jax.random.split(self._rng_key)
         step_fn = self._get_train_step(m is not None, fm is not None)
+        t_step = time.perf_counter()
         (self.params_list, self.states_list, self.opt_states, loss) = step_fn(
             self.params_list, self.states_list, self.opt_states,
             jnp.asarray(self._iteration), jnp.asarray(self._epoch), x, y, m,
             fm, sub)
+        # dispatch-side timing: the step is async, so this span is host
+        # dispatch (+ compile on a cache miss), not device wall time —
+        # deliberately so; blocking here would stall the pipeline
+        _telemetry.record_phase("device_step", t_step)
         # keep the loss on-device: a float() here would force a host sync
         # every step and stall the dispatch pipeline (very costly over a
         # remote/tunneled accelerator); score() converts lazily
         self._score = loss
         self._iteration += 1
+        self._last_batch_size = int(x.shape[0])
         # device-array references for listeners that recompute
         # gradients (StatsListener collect_gradients — the reference's
         # per-iteration gradient reports; free to keep, they alias the
         # arrays already on device)
         self._last_fit_batch = (x, y, m, fm, sub)
+        _telemetry.sample_device_memory()
         self._panic_check()
-        for l in self._listeners:
-            l.iterationDone(self, self._iteration, self._epoch)
+        if self._listeners:
+            t_l = time.perf_counter()
+            for l in self._listeners:
+                l.iterationDone(self, self._iteration, self._epoch)
+            _telemetry.record_phase("listener_host", t_l)
 
     def _panic_check(self):
         """NaN/Inf panic hook (reference: OpProfiler NAN_PANIC et al. —
@@ -484,16 +498,22 @@ class MultiLayerNetwork:
             yc = y[:, t0:t0 + k]
             mc = mask[:, t0:t0 + k] if mask is not None else None
             self._rng_key, sub = jax.random.split(self._rng_key)
+            t_step = time.perf_counter()
             (self.params_list, self.states_list, self.opt_states, carries,
              loss) = step_fn(
                 self.params_list, self.states_list, self.opt_states, carries,
                 jnp.asarray(self._iteration), jnp.asarray(self._epoch),
                 xc, yc, mc, sub)
+            _telemetry.record_phase("device_step", t_step)
             self._score = loss
             self._iteration += 1
+            self._last_batch_size = int(xc.shape[0])
             self._panic_check()
-            for l in self._listeners:
-                l.iterationDone(self, self._iteration, self._epoch)
+            if self._listeners:
+                t_l = time.perf_counter()
+                for l in self._listeners:
+                    l.iterationDone(self, self._iteration, self._epoch)
+                _telemetry.record_phase("listener_host", t_l)
 
     # ------------------------------------------------------------------
     # layerwise unsupervised pretraining (reference:
@@ -551,7 +571,8 @@ class MultiLayerNetwork:
                                            updates)
             return apply_constraints(layer, new_p), new_opt, loss
 
-        jitted = jax.jit(step_fn)
+        jitted = _telemetry.instrument_jit("mln_pretrain",
+                                           jax.jit(step_fn))
         self._pretrain_cache[idx] = jitted
         return jitted
 
@@ -695,7 +716,8 @@ class MultiLayerNetwork:
                 for l in self.conf.layers]
             self._rnn_batch = n
         if "rnn_step" not in self._fwd_cache:
-            self._fwd_cache["rnn_step"] = jax.jit(self._rnn_step_forward)
+            self._fwd_cache["rnn_step"] = _telemetry.instrument_jit(
+                "mln_rnn_step", jax.jit(self._rnn_step_forward))
         out, self._rnn_carries = self._fwd_cache["rnn_step"](
             self.params_list, self.states_list, self._rnn_carries, xj)
         if single and out.ndim == 3:

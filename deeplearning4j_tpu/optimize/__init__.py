@@ -4,11 +4,11 @@ org/deeplearning4j/optimize/**, SURVEY.md §2.22-2.23)."""
 from deeplearning4j_tpu.optimize.listeners import (
     TrainingListener, ScoreIterationListener, PerformanceListener,
     CheckpointListener, EvaluativeListener, TimeIterationListener,
-    CollectScoresListener,
+    CollectScoresListener, TelemetryListener,
 )
 
 __all__ = [
     "TrainingListener", "ScoreIterationListener", "PerformanceListener",
     "CheckpointListener", "EvaluativeListener", "TimeIterationListener",
-    "CollectScoresListener",
+    "CollectScoresListener", "TelemetryListener",
 ]

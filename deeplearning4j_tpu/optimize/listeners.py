@@ -40,7 +40,10 @@ class ScoreIterationListener(TrainingListener):
 
 class PerformanceListener(TrainingListener):
     """Throughput tracking (reference: PerformanceListener — iters/sec,
-    examples/sec; ETL time is reported by the async iterator itself)."""
+    examples/sec; ETL time is reported by the async iterator itself).
+
+    ``report_batch=True`` derives examples/sec from the batch size of
+    the last fit (the networks record ``_last_batch_size`` per step)."""
 
     def __init__(self, frequency: int = 10, report_batch: bool = True,
                  printer: Callable = None):
@@ -62,28 +65,42 @@ class PerformanceListener(TrainingListener):
             dt = now - self._last_time
             iters = iteration - self._last_iter
             self.batches_per_sec = iters / dt
-            self._print(f"iteration {iteration}: {self.batches_per_sec:.2f} "
-                        f"batches/sec, score {model.score():.5f}")
+            msg = (f"iteration {iteration}: {self.batches_per_sec:.2f} "
+                   f"batches/sec")
+            batch = getattr(model, "_last_batch_size", None)
+            if self.report_batch and batch:
+                self.samples_per_sec = self.batches_per_sec * batch
+                msg += f", {self.samples_per_sec:.2f} samples/sec"
+            self._print(msg + f", score {model.score():.5f}")
             self._last_time = now
             self._last_iter = iteration
 
 
 class TimeIterationListener(TrainingListener):
-    """ETA estimation (reference: TimeIterationListener)."""
+    """ETA estimation (reference: TimeIterationListener). The rate is
+    based on iterations actually elapsed since the listener first
+    fired (a fit may resume at iteration 5000 — dividing by the
+    absolute iteration number there would wildly overstate the rate);
+    ``frequency`` controls the report interval."""
 
-    def __init__(self, total_iterations: int, printer: Callable = None):
+    def __init__(self, total_iterations: int, printer: Callable = None,
+                 frequency: int = 100):
         self.total = total_iterations
+        self.n = max(1, frequency)
         self._start = None
+        self._start_iter = None
         self._print = printer or (lambda s: log.info(s))
 
     def iterationDone(self, model, iteration, epoch):
         if self._start is None:
             self._start = time.perf_counter()
+            self._start_iter = iteration
             return
         elapsed = time.perf_counter() - self._start
-        rate = iteration / max(elapsed, 1e-9)
+        done = iteration - self._start_iter
+        rate = done / max(elapsed, 1e-9)
         remaining = (self.total - iteration) / max(rate, 1e-9)
-        if iteration % 100 == 0:
+        if iteration % self.n == 0:
             self._print(f"iteration {iteration}/{self.total}, "
                         f"ETA {remaining:.0f}s")
 
@@ -115,12 +132,22 @@ class CheckpointListener(TrainingListener):
         self._saved: List[str] = []
 
     def iterationDone(self, model, iteration, epoch):
-        if iteration % self.every != 0:
+        # iteration 0 is the untrained net — nothing worth checkpointing
+        # (and 0 % every == 0 would spuriously save it every fit)
+        if iteration == 0 or iteration % self.every != 0:
             return
         from deeplearning4j_tpu.util.model_serializer import ModelSerializer
 
         path = os.path.join(self.dir, f"checkpoint_iter_{iteration}.zip")
-        ModelSerializer.writeModel(model, path, self.save_updater)
+        # atomic: serialize to a temp file, then os.replace — a crash
+        # mid-save must never leave a truncated checkpoint_iter_N.zip
+        tmp = path + ".tmp"
+        try:
+            ModelSerializer.writeModel(model, tmp, self.save_updater)
+            os.replace(tmp, path)
+        finally:
+            if os.path.exists(tmp):
+                os.remove(tmp)
         self._saved.append(path)
         while len(self._saved) > self.keep:
             old = self._saved.pop(0)
@@ -129,6 +156,44 @@ class CheckpointListener(TrainingListener):
 
     def lastCheckpoint(self) -> Optional[str]:
         return self._saved[-1] if self._saved else None
+
+
+class TelemetryListener(TrainingListener):
+    """Bridges training progress into the process-wide telemetry
+    registry (`profiler/telemetry.py`) — the listener-API face of the
+    metrics the fit loops already record (step phases, jit compiles,
+    memory watermarks). Adds: iteration/epoch counters, a score gauge,
+    and a periodic device-memory sample.
+
+    ``frequency`` gates the score gauge: ``model.score()`` forces a
+    device->host sync, so it runs every N iterations (default 10), not
+    every step — same reason PerformanceListener batches its reports."""
+
+    def __init__(self, frequency: int = 10):
+        self.n = max(1, frequency)
+
+    def iterationDone(self, model, iteration, epoch):
+        from deeplearning4j_tpu.profiler import telemetry
+
+        if not telemetry.enabled():
+            return   # honor the kill switch: no metric writes and, more
+            #          importantly, no score() device sync
+        reg = telemetry.MetricsRegistry.get_default()
+        reg.counter("dl4j_tpu_iterations_total",
+                    "training iterations completed").inc()
+        if iteration % self.n == 0:
+            reg.gauge("dl4j_tpu_score", "last minibatch loss").set(
+                float(model.score()))
+            reg.gauge("dl4j_tpu_epoch", "current epoch").set(epoch)
+            telemetry.sample_device_memory()
+
+    def onEpochEnd(self, model):
+        from deeplearning4j_tpu.profiler import telemetry
+
+        if not telemetry.enabled():
+            return
+        telemetry.MetricsRegistry.get_default().counter(
+            "dl4j_tpu_epochs_total", "training epochs completed").inc()
 
 
 class EvaluativeListener(TrainingListener):

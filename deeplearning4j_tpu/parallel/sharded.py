@@ -29,6 +29,7 @@ threads exist because no host hop exists.
 
 from __future__ import annotations
 
+import time
 from functools import partial
 from typing import Any, Optional
 
@@ -43,6 +44,7 @@ from deeplearning4j_tpu.learning.updaters import apply_updater
 from deeplearning4j_tpu.nn.multilayer.network import _uses_epoch_schedule
 from deeplearning4j_tpu.ops import compression as comp
 from deeplearning4j_tpu.parallel.mesh import build_mesh
+from deeplearning4j_tpu.profiler import telemetry as _telemetry
 
 
 def _tmap(f, *trees):
@@ -200,7 +202,9 @@ class ShardedTrainer:
                                                    it_step, ep_step)
             return new_params, new_states, new_opt, data_loss
 
-        return jax.jit(step_fn, donate_argnums=(0, 1, 2))
+        return _telemetry.instrument_jit(
+            "parallel_sharing_step",
+            jax.jit(step_fn, donate_argnums=(0, 1, 2)))
 
     # ------------------------------------------------------------------
     # mode: sharing_compressed (shard_map + threshold encoding)
@@ -302,7 +306,9 @@ class ShardedTrainer:
             return fn(params, states, opt_s, residual, thresholds,
                       it_step, ep_step, x, y, rng)
 
-        return jax.jit(step_fn, donate_argnums=(0, 1, 2, 3, 4))
+        return _telemetry.instrument_jit(
+            "parallel_compressed_step",
+            jax.jit(step_fn, donate_argnums=(0, 1, 2, 3, 4)))
 
     # ------------------------------------------------------------------
     # mode: averaging (independent local steps + periodic mesh average)
@@ -363,7 +369,9 @@ class ShardedTrainer:
             return fn(params_stacked, states, opt_stacked, it_step, ep_step,
                       x, y, rng, do_avg)
 
-        return jax.jit(step_fn, donate_argnums=(0, 1, 2))
+        return _telemetry.instrument_jit(
+            "parallel_averaging_step",
+            jax.jit(step_fn, donate_argnums=(0, 1, 2)))
 
     # ------------------------------------------------------------------
     def fit(self, data, labels=None, epochs: int = 1):
@@ -390,7 +398,7 @@ class ShardedTrainer:
             return self._finish()
         if isinstance(data, DataSetIterator):
             for _ in range(epochs):
-                for ds in data:
+                for ds in _telemetry.timed_batches(data):
                     self._fit_batch(ds.features, ds.labels)
                 model._epoch += 1
             return self._finish()
@@ -444,6 +452,7 @@ class ShardedTrainer:
         it_s = jnp.asarray(model._iteration)
         ep_s = jnp.asarray(model._epoch)
         params, states, opt = mf.get_trees()
+        t_step = time.perf_counter()
 
         if self.mode == "sharing":
             (params, states, opt, loss) = self._step(
@@ -471,8 +480,16 @@ class ShardedTrainer:
             mf.set_trees(_tmap(lambda a: a[0], ps), states,
                          _tmap(lambda a: a[0], opts))
 
+        # dispatch-side host timing; the SPMD step runs async on device
+        _telemetry.record_phase("device_step", t_step, mode=self.mode)
         # on-device; score() converts lazily (no per-step host sync)
         model._score = loss
         model._iteration += 1
-        for l in model._listeners:
-            l.iterationDone(model, model._iteration, model._epoch)
+        first = x[0] if isinstance(x, (list, tuple)) else x
+        model._last_batch_size = int(first.shape[0])
+        _telemetry.sample_device_memory()
+        if model._listeners:
+            t_l = time.perf_counter()
+            for l in model._listeners:
+                l.iterationDone(model, model._iteration, model._epoch)
+            _telemetry.record_phase("listener_host", t_l)

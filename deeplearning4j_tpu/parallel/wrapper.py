@@ -16,6 +16,7 @@ import jax
 
 from deeplearning4j_tpu.parallel.mesh import build_mesh
 from deeplearning4j_tpu.parallel.sharded import ShardedTrainer
+from deeplearning4j_tpu.profiler import telemetry as _telemetry
 
 
 class ParallelWrapper:
@@ -75,7 +76,13 @@ class ParallelWrapper:
                                    self._freq, self._threshold)
 
     def fit(self, data, labels=None, epochs: int = 1):
-        return self._trainer.fit(data, labels, epochs=epochs)
+        if _telemetry.enabled():
+            _telemetry.MetricsRegistry.get_default().gauge(
+                "dl4j_tpu_parallel_workers",
+                "mesh devices spanned by the SPMD step").set(self.workers)
+        with _telemetry.span("parallel_fit", workers=self.workers,
+                             mode=self._trainer.mode):
+            return self._trainer.fit(data, labels, epochs=epochs)
 
 
 class ParallelInference:
@@ -237,14 +244,22 @@ class ParallelInference:
                         big[-1:], self.batch_limit - big.shape[0],
                         axis=0)
                     big = np.concatenate([big, pad], 0)
-                out = np.asarray(
-                    self.model.output(shard_batch(self.mesh, big)))
+                with _telemetry.span("inference_dispatch",
+                                     rows=int(big.shape[0])):
+                    out = np.asarray(
+                        self.model.output(shard_batch(self.mesh, big)))
             except Exception as e:
                 for _, fut in batch:
                     fut.set_exception(e)
                 continue
             self.n_dispatches += 1
             self.n_requests += len(batch)
+            if _telemetry.enabled():
+                reg = _telemetry.MetricsRegistry.get_default()
+                reg.counter("dl4j_tpu_inference_dispatches_total",
+                            "batched model calls").inc()
+                reg.counter("dl4j_tpu_inference_requests_total",
+                            "client requests served").inc(len(batch))
             off = 0
             for x, fut in batch:
                 n = x.shape[0]

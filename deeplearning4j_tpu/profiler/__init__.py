@@ -28,9 +28,11 @@ import time
 from typing import Dict, Optional
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from deeplearning4j_tpu.ops import registry as _registry
+from deeplearning4j_tpu.profiler import telemetry
 
 
 class ProfilerMode(enum.Enum):
@@ -130,19 +132,42 @@ class NumericsException(ArithmeticError):
 
 def check_numerics(tree, mode: ProfilerMode, context: str = "") -> None:
     """Host-side NaN/Inf assertion over a pytree (reference: the panic
-    modes' per-op output checks, applied per-step here)."""
+    modes' per-op output checks, applied per-step here).
+
+    Panic-mode cost is ONE device->host transfer per call: floating
+    leaves are fetched together via a single ``jax.device_get`` (a
+    per-leaf ``np.asarray`` would sync the pipeline once per leaf —
+    ruinous over a remote/tunneled accelerator), and the NaN/Inf flags
+    are reduced across all leaves before raising."""
     if mode in (ProfilerMode.DISABLED, ProfilerMode.OPERATIONS):
         return
+    float_leaves = []
     for leaf in jax.tree_util.tree_leaves(tree):
-        a = np.asarray(leaf)
-        if not np.issubdtype(a.dtype, np.floating):
-            continue
-        if mode in (ProfilerMode.NAN_PANIC, ProfilerMode.ANY_PANIC) \
-                and np.isnan(a).any():
-            raise NumericsException(f"NaN detected {context}")
-        if mode in (ProfilerMode.INF_PANIC, ProfilerMode.ANY_PANIC) \
-                and np.isinf(a).any():
-            raise NumericsException(f"Inf detected {context}")
+        dt = getattr(leaf, "dtype", None)
+        if dt is None:
+            leaf = np.asarray(leaf)
+            dt = leaf.dtype
+        # jnp.issubdtype, not np: bfloat16 (ml_dtypes) must be swept too
+        if jnp.issubdtype(dt, jnp.floating):
+            float_leaves.append(leaf)
+    if not float_leaves:
+        return
+    host = jax.device_get(float_leaves)
+    check_nan = mode in (ProfilerMode.NAN_PANIC, ProfilerMode.ANY_PANIC)
+    check_inf = mode in (ProfilerMode.INF_PANIC, ProfilerMode.ANY_PANIC)
+    has_nan = has_inf = False
+    for a in host:
+        a = np.asarray(a)
+        if a.dtype not in (np.float16, np.float32, np.float64):
+            a = a.astype(np.float32)   # extended dtypes lack isnan ufuncs
+        if check_nan and np.isnan(a).any():
+            has_nan = True
+        if check_inf and np.isinf(a).any():
+            has_inf = True
+    if has_nan:
+        raise NumericsException(f"NaN detected {context}")
+    if has_inf:
+        raise NumericsException(f"Inf detected {context}")
 
 
 # ------------------------------------------------------------ XLA traces
@@ -175,4 +200,4 @@ def trace(log_dir: str):
 
 __all__ = ["OpProfiler", "ProfilerConfig", "ProfilerMode",
            "NumericsException", "check_numerics", "start_trace",
-           "stop_trace", "trace"]
+           "stop_trace", "trace", "telemetry"]

@@ -1,0 +1,580 @@
+"""Unified telemetry spine: metrics registry, host spans, recompile
+detector, device-memory watermarks.
+
+Reference mapping (SURVEY.md §2.23, §5): the reference spreads
+observability over OpProfiler/ProfilerConfig (per-op timing),
+PerformanceListener (throughput lines) and the UI StatsListener
+(histograms to StatsStorage). On TPU the facts that matter most are
+different — jit-cache misses and compile time, device-memory
+watermarks, and the ETL-wait vs device-step split — so this module is
+the single process-wide sink every layer reports through:
+
+- ``MetricsRegistry`` — thread-safe counters / gauges / bounded
+  histograms with percentile summaries; Prometheus text exposition
+  (``to_prometheus``) and JSON dump (``to_json``). Served by
+  ``ui/server.py`` at ``/metrics`` and ``/telemetry``.
+- ``span()`` — nestable host-side timing context manager. Events land
+  in a bounded trace buffer and export as Chrome trace-event JSON
+  (``export_chrome_trace``; loadable in perfetto / chrome://tracing),
+  complementing ``jax.profiler`` DEVICE traces with the HOST story.
+- ``instrument_jit(site, fn)`` — recompilation detector. Wraps a
+  jitted callable; a growing executable cache (``_cache_size``) marks
+  a compile, which is counted + timed per site, and shape/dtype churn
+  (a "recompile storm") logs a loud warning with the offending
+  signatures.
+- ``sample_device_memory()`` — per-step device watermark gauges from
+  ``device.memory_stats()`` (graceful no-op on backends that don't
+  report, e.g. CPU).
+
+Everything here is host-side and cheap by construction: a disabled
+check is one attribute read; an enabled step records a few
+``perf_counter`` deltas and deque appends — never a device sync.
+
+Env: ``DL4J_TPU_TELEMETRY=0`` disables recording (default on; ``=1``
+forces on), ``DL4J_TPU_RECOMPILE_STORM_THRESHOLD`` (default 5) sets
+the distinct-signature count per site that triggers the storm warning.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import json
+import logging
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+log = logging.getLogger("deeplearning4j_tpu")
+
+_ENABLED = os.environ.get("DL4J_TPU_TELEMETRY", "1") != "0"
+_T0 = time.perf_counter()   # trace-timestamp epoch (µs since import)
+
+#: canonical metric names (acceptance surface — keep stable)
+JIT_COMPILES = "dl4j_tpu_jit_compiles_total"
+JIT_COMPILE_SECONDS = "dl4j_tpu_jit_compile_seconds"
+STEP_PHASE_SECONDS = "dl4j_tpu_step_phase_seconds"
+DEVICE_BYTES_IN_USE = "dl4j_tpu_device_bytes_in_use"
+DEVICE_PEAK_BYTES = "dl4j_tpu_device_peak_bytes_in_use"
+
+
+def enabled() -> bool:
+    return _ENABLED
+
+
+def set_enabled(on: bool) -> None:
+    global _ENABLED
+    _ENABLED = bool(on)
+
+
+# ---------------------------------------------------------------- utils
+def _label_key(labels: Dict[str, str]) -> Tuple[Tuple[str, str], ...]:
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+def _fmt_labels(key: Tuple[Tuple[str, str], ...]) -> str:
+    if not key:
+        return ""
+    esc = lambda v: v.replace("\\", "\\\\").replace('"', '\\"') \
+                     .replace("\n", "\\n")
+    return "{" + ",".join(f'{k}="{esc(v)}"' for k, v in key) + "}"
+
+
+def _percentile(sorted_vals: List[float], q: float) -> float:
+    if not sorted_vals:
+        return float("nan")
+    idx = min(len(sorted_vals) - 1, int(round(q * (len(sorted_vals) - 1))))
+    return sorted_vals[idx]
+
+
+# -------------------------------------------------------------- metrics
+class Counter:
+    """Monotonic counter, optionally labelled (one value per label set)."""
+
+    kind = "counter"
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self._lock = threading.Lock()
+        self._values: Dict[Tuple, float] = collections.defaultdict(float)
+
+    def inc(self, n: float = 1.0, **labels) -> None:
+        with self._lock:
+            self._values[_label_key(labels)] += n
+
+    def value(self, **labels) -> float:
+        with self._lock:
+            return self._values.get(_label_key(labels), 0.0)
+
+    def total(self) -> float:
+        with self._lock:
+            return sum(self._values.values())
+
+    def _expose(self) -> List[str]:
+        with self._lock:
+            items = sorted(self._values.items())
+        return [f"{self.name}{_fmt_labels(k)} {v:g}" for k, v in items]
+
+    def _json(self) -> Any:
+        with self._lock:
+            return {(_fmt_labels(k) or "total"): v
+                    for k, v in self._values.items()}
+
+
+class Gauge(Counter):
+    """Last-write-wins value; supports inc/dec via set()."""
+
+    kind = "gauge"
+
+    def set(self, v: float, **labels) -> None:
+        with self._lock:
+            self._values[_label_key(labels)] = float(v)
+
+
+class Histogram:
+    """Bounded-reservoir histogram: keeps the last ``max_samples``
+    observations per label set for percentile summaries, plus unbounded
+    count/sum accumulators. Exposed as a Prometheus summary (quantiles
+    are over the retained window, which is the operationally useful
+    view for step timings)."""
+
+    kind = "summary"
+    QUANTILES = (0.5, 0.9, 0.99)
+
+    def __init__(self, name: str, help: str = "", max_samples: int = 2048):
+        self.name = name
+        self.help = help
+        self.max_samples = max_samples
+        self._lock = threading.Lock()
+        self._buf: Dict[Tuple, collections.deque] = {}
+        self._count: Dict[Tuple, int] = collections.defaultdict(int)
+        self._sum: Dict[Tuple, float] = collections.defaultdict(float)
+
+    def observe(self, v: float, **labels) -> None:
+        key = _label_key(labels)
+        with self._lock:
+            buf = self._buf.get(key)
+            if buf is None:
+                buf = self._buf[key] = collections.deque(
+                    maxlen=self.max_samples)
+            buf.append(float(v))
+            self._count[key] += 1
+            self._sum[key] += float(v)
+
+    def count(self, **labels) -> int:
+        with self._lock:
+            return self._count.get(_label_key(labels), 0)
+
+    def sum(self, **labels) -> float:
+        with self._lock:
+            return self._sum.get(_label_key(labels), 0.0)
+
+    def percentiles(self, **labels) -> Dict[str, float]:
+        key = _label_key(labels)
+        with self._lock:
+            vals = sorted(self._buf.get(key, ()))
+        return {f"p{int(q * 100)}": _percentile(vals, q)
+                for q in self.QUANTILES}
+
+    def _expose(self) -> List[str]:
+        out: List[str] = []
+        with self._lock:
+            keys = sorted(self._buf)
+            snap = {k: (sorted(self._buf[k]), self._count[k], self._sum[k])
+                    for k in keys}
+        for k, (vals, cnt, tot) in snap.items():
+            for q in self.QUANTILES:
+                qk = k + (("quantile", f"{q:g}"),)
+                out.append(
+                    f"{self.name}{_fmt_labels(qk)} {_percentile(vals, q):g}")
+            out.append(f"{self.name}_count{_fmt_labels(k)} {cnt}")
+            out.append(f"{self.name}_sum{_fmt_labels(k)} {tot:g}")
+        return out
+
+    def _json(self) -> Any:
+        with self._lock:
+            keys = sorted(self._buf)
+            snap = {k: (sorted(self._buf[k]), self._count[k], self._sum[k])
+                    for k in keys}
+        return {(_fmt_labels(k) or "total"): dict(
+                    count=cnt, sum=tot,
+                    **{f"p{int(q * 100)}": _percentile(vals, q)
+                       for q in self.QUANTILES})
+                for k, (vals, cnt, tot) in snap.items()}
+
+
+class MetricsRegistry:
+    """Process-wide named-metric registry (one default instance; tests
+    may build private ones). get-or-create accessors are idempotent and
+    thread-safe; a name registered as one kind cannot be re-registered
+    as another."""
+
+    _default: Optional["MetricsRegistry"] = None
+    _default_lock = threading.Lock()
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: "collections.OrderedDict[str, Any]" = \
+            collections.OrderedDict()
+
+    @classmethod
+    def get_default(cls) -> "MetricsRegistry":
+        with cls._default_lock:
+            if cls._default is None:
+                cls._default = MetricsRegistry()
+            return cls._default
+
+    def _get(self, name: str, factory: Callable, kind: str):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = self._metrics[name] = factory()
+            elif m.kind != kind:
+                raise ValueError(
+                    f"metric {name!r} already registered as {m.kind}, "
+                    f"not {kind}")
+            return m
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._get(name, lambda: Counter(name, help), "counter")
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        return self._get(name, lambda: Gauge(name, help), "gauge")
+
+    def histogram(self, name: str, help: str = "",
+                  max_samples: int = 2048) -> Histogram:
+        return self._get(
+            name, lambda: Histogram(name, help, max_samples), "summary")
+
+    def to_prometheus(self) -> str:
+        """Prometheus text exposition format 0.0.4."""
+        lines: List[str] = []
+        with self._lock:
+            metrics = list(self._metrics.values())
+        for m in metrics:
+            if m.help:
+                lines.append(f"# HELP {m.name} {m.help}")
+            lines.append(f"# TYPE {m.name} {m.kind}")
+            lines.extend(m._expose())
+        return "\n".join(lines) + "\n"
+
+    def to_json(self) -> Dict[str, Any]:
+        with self._lock:
+            metrics = list(self._metrics.items())
+        return {name: {"kind": m.kind, "help": m.help, "values": m._json()}
+                for name, m in metrics}
+
+    def reset(self) -> None:
+        """Drop every metric (tests / between bench rounds)."""
+        with self._lock:
+            self._metrics.clear()
+
+
+# ---------------------------------------------------------------- spans
+_trace_lock = threading.Lock()
+_trace_events: collections.deque = collections.deque(maxlen=50_000)
+_span_stack = threading.local()
+
+
+def _now_us() -> float:
+    return (time.perf_counter() - _T0) * 1e6
+
+
+def record_span(name: str, t0: float, t1: Optional[float] = None,
+                metric: Optional[str] = None, **attrs) -> None:
+    """Record one completed host span. ``t0``/``t1`` are
+    ``time.perf_counter()`` readings; ``metric`` names a histogram in
+    the default registry that receives the duration in SECONDS, with
+    ``attrs`` as its labels."""
+    if not _ENABLED:
+        return
+    if t1 is None:
+        t1 = time.perf_counter()
+    ev = {
+        "name": name,
+        "ph": "X",
+        "ts": (t0 - _T0) * 1e6,
+        "dur": max(t1 - t0, 0.0) * 1e6,
+        "pid": os.getpid(),
+        "tid": threading.get_ident(),
+    }
+    if attrs:
+        ev["args"] = {k: v for k, v in attrs.items()}
+    with _trace_lock:
+        _trace_events.append(ev)
+    if metric is not None:
+        # depth/parent describe span nesting, not a metric dimension —
+        # letting them through would explode the label cardinality
+        labels = {k: str(v) for k, v in attrs.items()
+                  if k not in ("depth", "parent")}
+        MetricsRegistry.get_default().histogram(metric).observe(
+            t1 - t0, **labels)
+
+
+@contextlib.contextmanager
+def span(name: str, metric: Optional[str] = None, **attrs):
+    """Nestable host-side timing span. Nesting is tracked per thread
+    and recorded as a ``depth``/``parent`` arg on the trace event, so
+    perfetto's flame view reconstructs the stack from the complete
+    ('X') events."""
+    if not _ENABLED:
+        yield
+        return
+    stack = getattr(_span_stack, "names", None)
+    if stack is None:
+        stack = _span_stack.names = []
+    attrs = dict(attrs)
+    attrs["depth"] = len(stack)
+    if stack:
+        attrs["parent"] = stack[-1]
+    stack.append(name)
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        stack.pop()
+        record_span(name, t0, metric=metric, **attrs)
+
+
+def record_phase(phase: str, t0: float, t1: Optional[float] = None,
+                 **attrs) -> None:
+    """Step-phase helper: span + ``dl4j_tpu_step_phase_seconds`` sample
+    labelled ``phase=...`` (etl_wait / device_step / listener_host)."""
+    record_span(phase, t0, t1, metric=STEP_PHASE_SECONDS, phase=phase,
+                **attrs)
+
+
+def timed_batches(iterable):
+    """Iterate, recording time blocked on ``next()`` as the
+    ``etl_wait`` phase — the one ETL-timing loop every fit front-end
+    shares (MultiLayerNetwork keeps its own variant because it also
+    feeds the UI's ``_last_etl_ms``)."""
+    it = iter(iterable)
+    while True:
+        t0 = time.perf_counter()
+        try:
+            item = next(it)
+        except StopIteration:
+            return
+        record_phase("etl_wait", t0)
+        yield item
+
+
+def chrome_trace() -> Dict[str, Any]:
+    """Chrome trace-event JSON object (perfetto / chrome://tracing)."""
+    with _trace_lock:
+        events = list(_trace_events)
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def export_chrome_trace(path: str) -> str:
+    with open(path, "w") as f:
+        json.dump(chrome_trace(), f)
+    return path
+
+
+def clear_trace() -> None:
+    with _trace_lock:
+        _trace_events.clear()
+
+
+# -------------------------------------------------- recompile detector
+def _storm_threshold() -> int:
+    try:
+        return max(2, int(os.environ.get(
+            "DL4J_TPU_RECOMPILE_STORM_THRESHOLD", "5")))
+    except ValueError:
+        return 5
+
+
+def _arg_signature(args, kwargs) -> str:
+    """Compact shape/dtype signature of a call, for storm diagnostics
+    (NOT the compile trigger — the executable cache is). Arrays render
+    as dtype[shape]; everything else by type."""
+    import jax
+
+    parts = []
+    leaves = jax.tree_util.tree_leaves((args, kwargs))
+    for l in leaves[:64]:
+        if hasattr(l, "shape") and hasattr(l, "dtype"):
+            parts.append(f"{l.dtype}{list(l.shape)}")
+        else:
+            parts.append(type(l).__name__)
+    if len(leaves) > 64:
+        parts.append(f"...+{len(leaves) - 64}")
+    return ",".join(parts)
+
+
+class _InstrumentedJit:
+    """Transparent wrapper around a ``jax.jit`` callable that counts
+    and times executable-cache misses (trace + XLA compile + first
+    run). Attribute access (``lower``, ``clear_cache``, …) passes
+    through, so AOT cost analysis and existing callers see the
+    underlying jitted function unchanged.
+
+    Probes: ``cache`` (default) reads the pjit executable-cache size —
+    exact, but inert when the callable is only ever invoked under a
+    transformation trace (``jax.vjp`` over the jitted fn never grows
+    it); ``signature`` counts the first call per distinct shape/dtype
+    signature instead — use it for sites that are exclusively
+    vjp/grad-driven."""
+
+    def __init__(self, site: str, fn: Callable, probe: str = "cache"):
+        self._site = site
+        self._fn = fn
+        self._sigs: List[str] = []
+        self._warned_at = 0
+        self._has_cache_probe = (probe == "cache"
+                                 and hasattr(fn, "_cache_size"))
+
+    # pass-through for .lower(), .clear_cache(), etc.
+    def __getattr__(self, name):
+        return getattr(self._fn, name)
+
+    @property
+    def compiles(self) -> int:
+        return len(self._sigs)
+
+    def __call__(self, *args, **kwargs):
+        if not _ENABLED:
+            return self._fn(*args, **kwargs)
+        before = self._fn._cache_size() if self._has_cache_probe else -1
+        t0 = time.perf_counter()
+        out = self._fn(*args, **kwargs)
+        t1 = time.perf_counter()
+        if self._has_cache_probe:
+            compiled = self._fn._cache_size() > before
+        else:
+            # fallback probe: first call with an unseen signature
+            sig = _arg_signature(args, kwargs)
+            compiled = sig not in self._sigs
+        if compiled:
+            self._record_compile(t0, t1, _arg_signature(args, kwargs))
+        return out
+
+    def _record_compile(self, t0: float, t1: float, sig: str) -> None:
+        self._sigs.append(sig)
+        reg = MetricsRegistry.get_default()
+        reg.counter(JIT_COMPILES,
+                    "jit executable-cache misses (trace+compile)"
+                    ).inc(site=self._site)
+        reg.histogram(JIT_COMPILE_SECONDS,
+                      "wall time of jit-cache-miss calls "
+                      "(trace + XLA compile + first run)"
+                      ).observe(t1 - t0, site=self._site)
+        record_span(f"jit_compile:{self._site}", t0, t1, site=self._site,
+                    signature=sig)
+        n = len(self._sigs)
+        threshold = _storm_threshold()
+        # warn at the threshold, then at every doubling (storms keep
+        # shouting; a stable site that legitimately sees a handful of
+        # shapes goes quiet)
+        if n >= threshold and n >= max(self._warned_at * 2, threshold):
+            self._warned_at = n
+            recent = "; ".join(self._sigs[-3:])
+            log.warning(
+                "RECOMPILE STORM at jit site %r: %d compiles (shape/"
+                "dtype churn). Each distinct input shape/dtype traces "
+                "and compiles a fresh XLA executable — pad or bucket "
+                "batches to stable shapes. Recent signatures: %s",
+                self._site, n, recent)
+
+
+def instrument_jit(site: str, fn: Callable,
+                   probe: str = "cache") -> Callable:
+    """Wrap a jitted callable with the recompilation detector."""
+    return _InstrumentedJit(site, fn, probe)
+
+
+# ------------------------------------------------ device-memory marks
+_mem_supported: Optional[bool] = None
+
+
+def sample_device_memory(device=None) -> Dict[str, Any]:
+    """Read ``device.memory_stats()`` into watermark gauges. Returns
+    the raw sample, or {} when the backend doesn't report (CPU) — the
+    not-supported verdict is cached (default device only) so the
+    steady-state no-op is one attribute read. An EXCEPTION from the
+    probe is treated as transient and never latches the verdict; an
+    explicit ``device`` argument bypasses the cache entirely."""
+    global _mem_supported
+    if not _ENABLED or (device is None and _mem_supported is False):
+        return {}
+    import jax
+
+    try:
+        d = device if device is not None else jax.local_devices()[0]
+        ms = d.memory_stats()
+    except Exception:
+        return {}
+    if not ms:
+        if device is None:
+            _mem_supported = False   # backend affirmatively reports none
+        return {}
+    if device is None:
+        _mem_supported = True
+    reg = MetricsRegistry.get_default()
+    dev = str(getattr(d, "id", 0))
+    if ms.get("bytes_in_use") is not None:
+        reg.gauge(DEVICE_BYTES_IN_USE,
+                  "current device bytes in use").set(
+            ms["bytes_in_use"], device=dev)
+    if ms.get("peak_bytes_in_use") is not None:
+        reg.gauge(DEVICE_PEAK_BYTES,
+                  "peak device bytes in use (watermark)").set(
+            ms["peak_bytes_in_use"], device=dev)
+    return dict(ms)
+
+
+# ------------------------------------------------------------ snapshot
+def snapshot() -> Dict[str, Any]:
+    """Compile counts/times + memory watermarks for embedding in bench
+    rounds (BENCH_*.json) and the ``/telemetry`` endpoint."""
+    reg = MetricsRegistry.get_default()
+    compiles = reg.counter(JIT_COMPILES)
+    seconds = reg.histogram(JIT_COMPILE_SECONDS)
+    with compiles._lock:
+        sites = [dict(k) for k in compiles._values]
+    per_site = {}
+    for labels in sites:
+        site = labels.get("site", "?")
+        per_site[site] = {
+            "compiles": compiles.value(site=site),
+            "compile_seconds": seconds.sum(site=site),
+        }
+    out: Dict[str, Any] = {
+        "jit_compiles_total": compiles.total(),
+        "jit_compile_seconds_total": sum(
+            s["compile_seconds"] for s in per_site.values()),
+        "per_site": per_site,
+    }
+    mem = sample_device_memory()
+    if mem:
+        out["device_memory"] = {
+            "bytes_in_use": mem.get("bytes_in_use"),
+            "peak_bytes_in_use": mem.get("peak_bytes_in_use"),
+        }
+    return out
+
+
+def reset() -> None:
+    """Full telemetry reset: metrics, trace buffer, memory probe cache.
+    (Instrumented-jit signature lists live on the network instances and
+    reset with them.)"""
+    global _mem_supported
+    MetricsRegistry.get_default().reset()
+    clear_trace()
+    _mem_supported = None
+
+
+__all__ = [
+    "MetricsRegistry", "Counter", "Gauge", "Histogram",
+    "span", "record_span", "record_phase",
+    "chrome_trace", "export_chrome_trace", "clear_trace",
+    "instrument_jit", "sample_device_memory", "snapshot", "reset",
+    "enabled", "set_enabled",
+    "JIT_COMPILES", "JIT_COMPILE_SECONDS", "STEP_PHASE_SECONDS",
+    "DEVICE_BYTES_IN_USE", "DEVICE_PEAK_BYTES",
+]

@@ -12,7 +12,15 @@ so no CDN scripts). Endpoints:
     GET /train/sessions                     -> ["<sid>", ...]
     GET /train/<sid>/overview               -> score curve, rates, memory
     GET /train/<sid>/model                  -> static info + latest layer stats
+    GET /metrics                            -> Prometheus text exposition
+    GET /telemetry                          -> telemetry JSON (metrics +
+                                               recent host trace events)
     GET /                                   -> dashboard HTML
+
+The /metrics and /telemetry endpoints read the process-wide
+MetricsRegistry (profiler/telemetry.py): jit compiles/compile time,
+step-phase breakdown, device-memory watermarks — scrape-ready for
+Prometheus without attaching any StatsStorage.
 """
 
 from __future__ import annotations
@@ -149,6 +157,29 @@ class _Handler(BaseHTTPRequestHandler):
             self.end_headers()
             self.wfile.write(body)
             return
+        if parts[0] == "metrics":
+            from deeplearning4j_tpu.profiler import telemetry
+
+            body = telemetry.MetricsRegistry.get_default() \
+                .to_prometheus().encode()
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
+        if parts[0] == "telemetry":
+            from deeplearning4j_tpu.profiler import telemetry
+
+            trace = telemetry.chrome_trace()["traceEvents"]
+            return self._json({
+                "metrics": telemetry.MetricsRegistry.get_default()
+                .to_json(),
+                "snapshot": telemetry.snapshot(),
+                "trace_event_count": len(trace),
+                "trace_events": trace[-200:],
+            })
         if parts[0] != "train":
             return self._json({"error": "not found"}, 404)
         if len(parts) == 2 and parts[1] == "sessions":

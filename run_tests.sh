@@ -7,5 +7,62 @@
 # behind a single TPU grant and (b) deadlocks if a previous client died
 # holding the grant. Tests run on a virtual 8-device CPU mesh
 # (tests/conftest.py forces JAX_PLATFORMS=cpu + host device count).
-exec env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+#
+# DL4J_TPU_TELEMETRY=1 pins telemetry ON for the telemetry tests
+# regardless of ambient env (it defaults on; =0 would silently skip
+# the recompile-detector and step-phase assertions).
+env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu DL4J_TPU_TELEMETRY=1 \
     python -m pytest tests/ "$@"
+rc=$?
+# signal death (Ctrl-C = 130, kill = 137+): propagate immediately,
+# don't run the smoke step on an interrupted suite
+if [ $rc -ge 128 ]; then
+    exit $rc
+fi
+
+# /metrics smoke check: the telemetry endpoint must serve Prometheus
+# text with the compile counter after a two-shape fit. A regression
+# here fails the run loudly even if no test exercised the endpoint.
+env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu DL4J_TPU_TELEMETRY=1 \
+    python - <<'EOF'
+import sys
+import urllib.request
+
+import numpy as np
+
+from deeplearning4j_tpu.learning.updaters import Sgd
+from deeplearning4j_tpu.nn.conf import (
+    DenseLayer, InputType, NeuralNetConfiguration, OutputLayer,
+)
+from deeplearning4j_tpu.nn.multilayer.network import MultiLayerNetwork
+from deeplearning4j_tpu.ui.server import UIServer
+
+conf = (NeuralNetConfiguration.builder().updater(Sgd(1e-2)).list()
+        .layer(DenseLayer(n_out=4, activation="relu"))
+        .layer(OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+        .setInputType(InputType.feedForward(3)).build())
+net = MultiLayerNetwork(conf).init()
+rs = np.random.RandomState(0)
+for n in (8, 16):   # two batch shapes -> two compiles
+    net.fit(rs.randn(n, 3).astype(np.float32),
+            np.eye(2, dtype=np.float32)[rs.randint(0, 2, n)])
+ui = UIServer()
+port = ui.start(port=0)
+try:
+    text = urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/metrics", timeout=10).read().decode()
+finally:
+    ui.stop()
+ok = ('dl4j_tpu_jit_compiles_total{site="mln_step"} 2' in text
+      and "dl4j_tpu_step_phase_seconds" in text)
+if not ok:
+    sys.stderr.write("=== /metrics smoke check FAILED ===\n" + text)
+    sys.exit(1)
+print("/metrics smoke check OK")
+EOF
+smoke=$?
+if [ $smoke -ne 0 ]; then
+    echo "FATAL: telemetry /metrics smoke check regressed" >&2
+    exit 1
+fi
+exit $rc
