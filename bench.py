@@ -309,6 +309,27 @@ def _resnet50_metrics(peak) -> dict:
     if peak and flops_per_step:
         out["resnet50_mfu"] = round(
             img_s * flops_per_step / batch / peak, 4)
+    try:
+        # device input-pipeline A/B (host-resident stream, fixed
+        # shapes): pure transfer-overlap measurement. Fresh net — the
+        # loop above donated this net's param buffers; smaller batch
+        # keeps the driver cost bounded.
+        from bench_common import pipeline_ab_fixed
+        from deeplearning4j_tpu.datasets import ArrayDataSetIterator
+
+        ab_batch, ab_batches = 64, 6
+        ab_conf = model.conf()
+        ab_conf.dtype = "bfloat16"
+        ab_net = ComputationGraph(ab_conf).init()
+        xs = np.asarray(rng.normal(
+            0, 1, (ab_batch * ab_batches, 224, 224, 3)), np.float32)
+        ys = np.eye(1000, dtype=np.float32)[
+            rng.randint(0, 1000, ab_batch * ab_batches)]
+        ab = pipeline_ab_fixed(
+            ab_net, lambda: ArrayDataSetIterator(xs, ys, ab_batch))
+        out["resnet50_pipeline_speedup"] = ab["pipeline_speedup"]
+    except Exception as e:
+        out["resnet50_pipeline_error"] = f"{type(e).__name__}: {e}"[:200]
     return out
 
 
@@ -432,6 +453,20 @@ def _lstm_metrics(peak, base, record) -> tuple:
         record(key, {"value": ratio,
                      "note": "framework/frozen LSTM step-time ratio; "
                              "band = value*0.95"})
+
+    # device input-pipeline A/B: ragged stream (varying T + partial
+    # final batch), bucketed+prefetched vs raw — the compile counts
+    # prove O(#buckets) vs O(#distinct shapes), the speedup carries
+    # the storm + transfer-overlap win
+    try:
+        from bench_common import pipeline_ab_lstm
+
+        ab = pipeline_ab_lstm()
+        out["lstm_pipeline_speedup"] = ab["pipeline_speedup"]
+        out["lstm_pipeline_compiles_off"] = ab["pipeline_off_compiles"]
+        out["lstm_pipeline_compiles_on"] = ab["pipeline_on_compiles"]
+    except Exception as e:
+        out["lstm_pipeline_error"] = f"{type(e).__name__}: {e}"[:200]
 
     # engine-soundness point: H=1024 fills the MXU (single-shot,
     # informational — its absolute value still rides tenancy)

@@ -106,6 +106,104 @@ def build_char_lstm(batch=256, seq=200, hidden=256, vocab=77,
     return run, state0, flops_per_step, batch * seq
 
 
+def pipeline_ab_lstm(batch=64, hidden=128, vocab=50, n_batches=12,
+                     t_lo=48, t_hi=200, epochs=2, depth=2, seed=0):
+    """Device-pipeline A/B on the WORST recompile case: a ragged
+    char-LSTM stream (varying sequence length + partial final batch).
+
+    Side 'off' fits the raw stream (one XLA compile per distinct
+    shape); side 'on' fits through DevicePrefetchIterator with the
+    'bucket' policy (one compile per power-of-two bucket + async
+    double-buffered transfers). Fresh identically-seeded nets per side
+    and wall-clock INCLUDES compiles — the recompile storm is the cost
+    being removed, so hiding it would be benching the wrong thing.
+
+    Returns pipeline_off_s/on_s, per-side jit-compile counts, and
+    pipeline_speedup = off/on.
+    """
+    import numpy as np
+
+    from deeplearning4j_tpu.datasets import DataSet, ListDataSetIterator
+    from deeplearning4j_tpu.datasets.device_prefetch import (
+        BatchShapePolicy, DevicePrefetchIterator,
+    )
+    from deeplearning4j_tpu.nn.multilayer.network import (
+        MultiLayerNetwork,
+    )
+    from deeplearning4j_tpu.profiler import telemetry
+    from deeplearning4j_tpu.zoo.textgen_lstm import TextGenerationLSTM
+
+    rng = np.random.default_rng(seed)
+    eye = np.eye(vocab, dtype=np.float32)
+    sets = []
+    for i in range(n_batches):
+        t = int(rng.integers(t_lo, t_hi))
+        n = batch if i < n_batches - 1 else max(batch // 3, 1)
+        ids = rng.integers(0, vocab, (n, t))
+        sets.append(DataSet(eye[ids], eye[np.roll(ids, -1, 1)]))
+
+    def make_net():
+        conf = TextGenerationLSTM(vocab_size=vocab, hidden=hidden,
+                                  tbptt_length=0).conf()
+        return MultiLayerNetwork(conf).init()
+
+    reg = telemetry.MetricsRegistry.get_default()
+    compiles = lambda: reg.counter(telemetry.JIT_COMPILES).total()
+    out = {}
+    for name in ("off", "on"):
+        net = make_net()
+        it = ListDataSetIterator(sets, batch_size=batch)
+        pf = None
+        if name == "on":
+            it = pf = DevicePrefetchIterator(
+                it, depth=depth,
+                policy=BatchShapePolicy("bucket", batch_size=batch),
+                dtype=net._dtype)
+        try:
+            c0 = compiles()
+            t0 = time.perf_counter()
+            net.fit(it, epochs=epochs)
+            float(net.score())  # device->host sync closes the window
+            out[f"pipeline_{name}_s"] = round(
+                time.perf_counter() - t0, 4)
+            out[f"pipeline_{name}_compiles"] = int(compiles() - c0)
+        finally:
+            if pf is not None:
+                pf.shutdown()
+    out["pipeline_speedup"] = round(
+        out["pipeline_off_s"] / out["pipeline_on_s"], 4)
+    return out
+
+
+def pipeline_ab_fixed(net, make_iter, depth=2, epochs=1):
+    """Device-pipeline A/B on a FIXED-shape stream (e.g. ResNet
+    images): same net, warmed first so both sides reuse one compiled
+    executable — the delta is purely host->device transfer overlap.
+    ``make_iter()`` must return a fresh DataSetIterator each call.
+    Returns pipeline_off_s/on_s and pipeline_speedup = off/on.
+    """
+    from deeplearning4j_tpu.datasets.device_prefetch import (
+        DevicePrefetchIterator,
+    )
+
+    net.fit(make_iter(), epochs=1)   # warm: compile + page in
+    float(net.score())
+    out = {}
+    t0 = time.perf_counter()
+    net.fit(make_iter(), epochs=epochs)
+    float(net.score())
+    out["pipeline_off_s"] = round(time.perf_counter() - t0, 4)
+    with DevicePrefetchIterator(make_iter(), depth=depth,
+                                dtype=net._dtype) as pf:
+        t0 = time.perf_counter()
+        net.fit(pf, epochs=epochs)
+        float(net.score())
+        out["pipeline_on_s"] = round(time.perf_counter() - t0, 4)
+    out["pipeline_speedup"] = round(
+        out["pipeline_off_s"] / out["pipeline_on_s"], 4)
+    return out
+
+
 def run_char_lstm(batch=256, seq=200, hidden=256, vocab=77, steps=10,
                   dtype="bf16"):
     """Char-LSTM train-step benchmark (BASELINE.md "Char-RNN LSTM"

@@ -14,7 +14,7 @@ from __future__ import annotations
 import argparse
 import json
 
-from bench_common import peak_flops, run_char_lstm
+from bench_common import peak_flops, pipeline_ab_lstm, run_char_lstm
 
 
 def main():
@@ -25,6 +25,11 @@ def main():
     ap.add_argument("--vocab", type=int, default=77)
     ap.add_argument("--steps", type=int, default=10)
     ap.add_argument("--dtype", default="bf16", choices=["bf16", "f32"])
+    ap.add_argument("--pipeline-ab", action="store_true",
+                    help="also run the device input-pipeline A/B on a "
+                         "ragged stream (bucketing + async prefetch "
+                         "vs raw): reports pipeline_speedup and "
+                         "per-side compile counts")
     args = ap.parse_args()
 
     r = run_char_lstm(batch=args.batch, seq=args.seq,
@@ -47,6 +52,9 @@ def main():
         h, v = args.hidden, args.vocab
         fwd_tok = 8 * h * (v + h) + 8 * h * (h + h) + 2 * h * v
         out["tflops_est"] = round(tok_s * 3 * fwd_tok / 1e12, 2)
+    if args.pipeline_ab:
+        out.update(pipeline_ab_lstm(hidden=args.hidden,
+                                    vocab=args.vocab))
     print(json.dumps(out))
 
 

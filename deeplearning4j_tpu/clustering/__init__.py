@@ -215,8 +215,13 @@ class KMeansClustering:
                 xj, centers, self.distance)
             distortion = float(distortion)
             self.iterations_done = it + 1
+            # converge only on a small NON-NEGATIVE improvement: a
+            # transient distortion INCREASE (right after an
+            # empty-cluster reseed moved a center) used to satisfy
+            # `prev - distortion <= eps` too and ended Lloyd iterations
+            # one reseed too early — keep optimizing through it
             if np.isfinite(prev) and \
-                    prev - distortion <= self.min_variation * prev:
+                    0.0 <= prev - distortion <= self.min_variation * prev:
                 break
             prev = distortion
         # final assignment against the RETURNED centers — the step's

@@ -24,6 +24,9 @@ from deeplearning4j_tpu.datasets.iterator_utils import (
     ExistingMiniBatchDataSetIterator, KFoldIterator,
     MultipleEpochsIterator, SamplingDataSetIterator, ViewIterator,
 )
+from deeplearning4j_tpu.datasets.device_prefetch import (
+    BatchShapePolicy, DevicePrefetchIterator, DevicePrefetchMultiIterator,
+)
 
 __all__ = ["DataSet", "DataSetIterator", "ListDataSetIterator",
            "ArrayDataSetIterator", "AsyncDataSetIterator",
@@ -37,4 +40,6 @@ __all__ = ["DataSet", "DataSetIterator", "ListDataSetIterator",
            "MultiDataSetIteratorAdapter",
            "KFoldIterator", "ViewIterator", "SamplingDataSetIterator",
            "MultipleEpochsIterator", "EarlyTerminationDataSetIterator",
-           "CachingDataSetIterator", "ExistingMiniBatchDataSetIterator"]
+           "CachingDataSetIterator", "ExistingMiniBatchDataSetIterator",
+           "BatchShapePolicy", "DevicePrefetchIterator",
+           "DevicePrefetchMultiIterator"]

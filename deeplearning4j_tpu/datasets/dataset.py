@@ -57,7 +57,12 @@ class DataSet:
             arrs["features_mask"] = np.asarray(self.features_mask)
         if self.labels_mask is not None:
             arrs["labels_mask"] = np.asarray(self.labels_mask)
-        np.savez(path, **arrs)
+        # write through an open file object: np.savez(str) appends
+        # '.npz' when the suffix is missing, which breaks
+        # save(p)/load(p) round-trips on the caller's exact path (the
+        # reference DataSet#save writes the exact file given)
+        with open(path, "wb") as f:
+            np.savez(f, **arrs)
 
     @staticmethod
     def load(path: str) -> "DataSet":

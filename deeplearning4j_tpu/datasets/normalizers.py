@@ -111,6 +111,10 @@ class NormalizerStandardize(DataNormalization):
         self.mean, self.std = fm.finalize()
         if self._fit_label:
             self.label_mean, self.label_std = lm.finalize()
+        else:
+            # a previous fit with fitLabel(True) must not leave stale
+            # label stats normalizing labels with outdated statistics
+            self.label_mean = self.label_std = None
 
     def transform(self, ds: DataSet) -> DataSet:
         ds.features = (jnp.asarray(ds.features) - self.mean) / self.std

@@ -191,9 +191,21 @@ class AsyncDataSetIterator(DataSetIterator):
             finally:
                 while True:
                     try:
-                        q.put(self._SENTINEL, timeout=0.1)
+                        q.put(self._SENTINEL, timeout=0.5)
                         break
                     except queue.Full:
+                        if not stop.is_set():
+                            # Live consumer, just slow (e.g. mid jit
+                            # compile) — keep waiting for space; a
+                            # timeout here used to silently DROP a live
+                            # queued batch. Trade-off: an iterator
+                            # abandoned without reset()/shutdown()
+                            # leaves this daemon thread polling at 2Hz
+                            # — that's an API-misuse leak (the test
+                            # suite's thread-leak gate catches it), vs
+                            # the old behavior's data loss in correct
+                            # usage.
+                            continue
                         # consumer gone (reset drained); drop one stale
                         # item to make room for the sentinel
                         try:
@@ -201,22 +213,37 @@ class AsyncDataSetIterator(DataSetIterator):
                         except queue.Empty:
                             pass
 
-        self._thread = threading.Thread(target=worker, daemon=True)
+        self._thread = threading.Thread(target=worker, daemon=True,
+                                        name="AsyncDataSet-ETL")
         self._thread.start()
+
+    def _join_worker(self):
+        """Stop the producer, drain until its sentinel, join. Gate the
+        drain on _exhausted, not thread liveness: the worker may still
+        be between put(SENTINEL) and exit."""
+        if self._thread is None:
+            return
+        self._stop.set()
+        if not self._exhausted:
+            while self._q.get() is not self._SENTINEL:
+                pass
+        self._thread.join()
+        self._thread = None
 
     def reset(self):
         # Signal the worker to stop producing (don't decode a whole
-        # discarded epoch), drain until its sentinel, restart. Gate the
-        # drain on _exhausted, not thread liveness: the worker may still
-        # be between put(SENTINEL) and exit.
-        if self._thread is not None:
-            self._stop.set()
-            if not self._exhausted:
-                while self._q.get() is not self._SENTINEL:
-                    pass
-            self._thread.join()
+        # discarded epoch), drain until its sentinel, restart.
+        self._join_worker()
         self._peek = None
         self._start()
+
+    def shutdown(self):
+        """Stop and join the ETL thread WITHOUT restarting (thread-leak
+        hygiene for owners like DevicePrefetchIterator). A later
+        reset() reopens the iterator."""
+        self._join_worker()
+        self._peek = None
+        self._exhausted = True
 
     def hasNext(self) -> bool:
         if self._exhausted:

@@ -33,6 +33,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from deeplearning4j_tpu.common.serde import serializable
+from deeplearning4j_tpu.parallel.mesh import axis_size as _axis_size
 
 
 @serializable
@@ -405,7 +406,7 @@ class TransformerEncoder:
         cfg = self.cfg
         cd = self._cdtype
         n, t = ids.shape
-        n_sp = lax.axis_size(sp_axis)  # static inside shard_map
+        n_sp = _axis_size(sp_axis)  # static inside shard_map
         if t * n_sp > cfg.max_len:
             raise ValueError(
                 f"global sequence {t}*{n_sp}={t * n_sp} exceeds "

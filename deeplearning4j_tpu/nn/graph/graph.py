@@ -376,8 +376,14 @@ class ComputationGraph:
                 f"got {len(ys)} label arrays for "
                 f"{len(conf.network_outputs)} graph outputs "
                 f"{conf.network_outputs}")
-        inputs = {n: jnp.asarray(_unwrap(x), self._dtype)
-                  for n, x in zip(conf.network_inputs, xs)}
+        raw_xs = [_unwrap(x) for x in xs]
+        if raw_xs and all(isinstance(x, jax.Array)
+                          and x.dtype == self._dtype for x in raw_xs):
+            # device-prefetched batch: jnp.asarray below is a no-op
+            # (same array object), no host->device copy happens
+            _telemetry.record_on_device_batch("cg")
+        inputs = {n: jnp.asarray(x, self._dtype)
+                  for n, x in zip(conf.network_inputs, raw_xs)}
         labels = {n: jnp.asarray(_unwrap(y))
                   for n, y in zip(conf.network_outputs, ys)}
         masks = {}

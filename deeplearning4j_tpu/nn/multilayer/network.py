@@ -410,7 +410,14 @@ class MultiLayerNetwork:
             _unwrap(fm) if fm is not None else None, x)
 
     def _fit_batch(self, x, y, mask, features_mask=None):
-        x = jnp.asarray(_unwrap(x), self._dtype)
+        xin = _unwrap(x)
+        if isinstance(xin, jax.Array) and xin.dtype == self._dtype:
+            # already device-resident in the right dtype (device
+            # prefetcher output): no host->device copy, no cast
+            _telemetry.record_on_device_batch("mln")
+            x = xin
+        else:
+            x = jnp.asarray(xin, self._dtype)
         y = jnp.asarray(_unwrap(y))
         fm = self._validate_fmask(features_mask, x)
         # per-timestep labels with a features mask and no explicit label

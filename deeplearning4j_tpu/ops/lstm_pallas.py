@@ -142,7 +142,10 @@ def _run(x_proj, w_hh, h0, c0, k_steps, interpret, collect_cell):
             pltpu.VMEM((n, hidden), jnp.float32),
             pltpu.VMEM((n, hidden), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        # name drift across pallas versions: TPUCompilerParams (older)
+        # was renamed CompilerParams (newer)
+        compiler_params=getattr(pltpu, "CompilerParams",
+                                getattr(pltpu, "TPUCompilerParams", None))(
             dimension_semantics=("arbitrary",)),
         interpret=interpret,
     )(x_proj, w_hh, h0, c0)

@@ -30,6 +30,17 @@ except ImportError:  # pragma: no cover - older jax
     from jax.experimental.shard_map import shard_map  # noqa: F401
 
 
+def axis_size(axis_name: str) -> int:
+    """STATIC size of a named mesh axis inside shard_map.
+    ``jax.lax.axis_size`` only exists on newer jax; a psum of a unit
+    constant is special-cased to a static Python int on every version,
+    so loops like ``for i in range(axis_size('sp'))`` stay unrolled."""
+    fn = getattr(jax.lax, "axis_size", None)
+    if fn is not None:
+        return fn(axis_name)
+    return jax.lax.psum(1, axis_name)
+
+
 def build_mesh(num_data: Optional[int] = None, num_model: int = 1,
                devices: Optional[Sequence] = None) -> Mesh:
     """Build a ('data', 'model') mesh over available devices.

@@ -33,7 +33,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from deeplearning4j_tpu.parallel.mesh import shard_map
+from deeplearning4j_tpu.parallel.mesh import axis_size as _axis_size, shard_map
 
 
 def _tmap(f, *trees, **kw):
@@ -214,7 +214,7 @@ class PipelinedTransformer:
 
         def per_shard(params, ids, labels, mask_pos, rng):
             rng = jax.random.fold_in(rng, lax.axis_index("data"))
-            dp = lax.axis_size("data")
+            dp = _axis_size("data")
             n_mb = ids.shape[0]
             # global mask count is params-independent — precompute so
             # the MoE aux term can be pre-scaled by it inside the local

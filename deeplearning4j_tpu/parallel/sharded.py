@@ -85,7 +85,7 @@ class _ModelFuncs:
         # re-init) must see the current one
         return self.model._updaters  # list (MLN) or dict (CG)
 
-    def loss(self, params, states, x, y, rng):
+    def loss(self, params, states, x, y, rng, mask=None, fmask=None):
         if self.is_graph:
             xs = x if isinstance(x, (list, tuple)) else [x]
             ys = y if isinstance(y, (list, tuple)) else [y]
@@ -97,7 +97,7 @@ class _ModelFuncs:
             return self.model._loss(params, states,
                                     dict(zip(self._ins, xs)),
                                     dict(zip(self._outs, ys)), rng)
-        return self.model._loss(params, states, x, y, None, rng)
+        return self.model._loss(params, states, x, y, mask, rng, fmask)
 
     def keys(self, params):
         return list(params) if isinstance(params, dict) \
@@ -168,15 +168,35 @@ class ShardedTrainer:
         p_, s_, o_ = self.mf.get_trees()
         self.mf.set_trees(put(p_), put(s_), put(o_))
 
-    def _shard_batch(self, x, y):
+    def _already_placed(self, a, dt) -> bool:
+        """True when the array is device-resident with the trainer's
+        data-parallel sharding (a prefetched batch) — device_put would
+        be a no-op, so skip it entirely."""
+        if not isinstance(a, jax.Array) \
+                or (dt is not None and a.dtype != dt):
+            return False
+        target = NamedSharding(self.mesh, _data_spec(a))
+        try:
+            return a.sharding.is_equivalent_to(target, a.ndim)
+        except Exception:
+            return a.sharding == target
+
+    def _shard_batch(self, x, y, mask=None, fmask=None):
         def spec(a):
             return NamedSharding(self.mesh, _data_spec(a))
 
         def one(a, dt):
+            if a is None:
+                return None
+            if self._already_placed(a, dt):
+                return a
             aj = jnp.asarray(a, dt) if dt is not None else jnp.asarray(a)
             return jax.device_put(aj, spec(aj))
 
         dt = self.model._dtype
+        first = x[0] if isinstance(x, (list, tuple)) else x
+        if self._already_placed(first, dt):
+            _telemetry.record_on_device_batch("sharded")
         if isinstance(x, (list, tuple)):
             x = [one(a, dt) for a in x]
         else:
@@ -185,7 +205,7 @@ class ShardedTrainer:
             y = [one(a, None) for a in y]
         else:
             y = one(y, None)
-        return x, y
+        return x, y, one(mask, None), one(fmask, None)
 
     # ------------------------------------------------------------------
     # mode: sharing (GSPMD — compiler-inserted all-reduce)
@@ -193,8 +213,10 @@ class ShardedTrainer:
     def _build_sharing_step(self):
         mf = self.mf
 
-        def step_fn(params, states, opt, it_step, ep_step, x, y, rng):
-            loss_fn = lambda pl: mf.loss(pl, states, x, y, rng)
+        def step_fn(params, states, opt, it_step, ep_step, x, y, mask,
+                    fmask, rng):
+            loss_fn = lambda pl: mf.loss(pl, states, x, y, rng, mask,
+                                         fmask)
             (loss, (new_states, data_loss)), grads = \
                 jax.value_and_grad(loss_fn, has_aux=True)(params)
             grads = mf.clip(grads)
@@ -399,12 +421,14 @@ class ShardedTrainer:
         if isinstance(data, DataSetIterator):
             for _ in range(epochs):
                 for ds in _telemetry.timed_batches(data):
-                    self._fit_batch(ds.features, ds.labels)
+                    self._fit_batch(ds.features, ds.labels,
+                                    ds.labels_mask, ds.features_mask)
                 model._epoch += 1
             return self._finish()
         if isinstance(data, DataSet):
             for _ in range(epochs):
-                self._fit_batch(data.features, data.labels)
+                self._fit_batch(data.features, data.labels,
+                                data.labels_mask, data.features_mask)
             return self._finish()
         for _ in range(epochs):
             self._fit_batch(data, labels)
@@ -424,9 +448,42 @@ class ShardedTrainer:
         return _tmap(lambda a: jnp.broadcast_to(
             a[None], (self._n_data,) + a.shape), tree)
 
-    def _fit_batch(self, x, y):
+    def _fit_batch(self, x, y, mask=None, fmask=None):
         model = self.model
         mf = self.mf
+        if (mask is not None or fmask is not None) \
+                and (self.mode != "sharing" or mf.is_graph):
+            # mask arrays only thread through the jit'd GSPMD sharing
+            # step on MultiLayerNetwork models; the shard_map modes and
+            # the graph loss seam keep their historical maskless
+            # signature — warn instead of silently training on padding
+            if not getattr(self, "_warned_masks", False):
+                self._warned_masks = True
+                import logging
+
+                logging.getLogger("deeplearning4j_tpu").warning(
+                    "ShardedTrainer(mode=%r%s) ignores DataSet mask "
+                    "arrays — masks are applied only in 'sharing' mode "
+                    "on MultiLayerNetwork models", self.mode,
+                    ", graph" if mf.is_graph else "")
+            mask = fmask = None
+        if fmask is not None:
+            from deeplearning4j_tpu.nn.masking import (
+                validate_features_mask,
+            )
+
+            # validation reads only ndim/shape — never materialize the
+            # features on device just to look at their shape
+            xv = x if hasattr(x, "ndim") else jnp.asarray(x)
+            fmask = validate_features_mask(fmask, xv)
+            # RNN convention (parity with MultiLayerNetwork._fit_batch):
+            # per-timestep labels + a features mask and no explicit
+            # label mask means the features mask IS the label mask —
+            # without this, padded timesteps would silently enter the
+            # loss here but not in the single-device fit loop
+            if mask is None and getattr(y, "ndim", 0) == 3 \
+                    and fmask.ndim == 2 and y.shape[1] == fmask.shape[1]:
+                mask = fmask
         if self._step is None:
             self._place_replicated()
             if self.mode == "sharing":
@@ -447,7 +504,7 @@ class ShardedTrainer:
                 self._step = self._build_averaging_step()
                 p_, _, o_ = mf.get_trees()
                 self._local = (self._stack(p_), self._stack(o_))
-        x, y = self._shard_batch(x, y)
+        x, y, mask, fmask = self._shard_batch(x, y, mask, fmask)
         model._rng_key, sub = jax.random.split(model._rng_key)
         it_s = jnp.asarray(model._iteration)
         ep_s = jnp.asarray(model._epoch)
@@ -456,7 +513,7 @@ class ShardedTrainer:
 
         if self.mode == "sharing":
             (params, states, opt, loss) = self._step(
-                params, states, opt, it_s, ep_s, x, y, sub)
+                params, states, opt, it_s, ep_s, x, y, mask, fmask, sub)
             mf.set_trees(params, states, opt)
         elif self.mode == "sharing_compressed":
             opt_s = self._local

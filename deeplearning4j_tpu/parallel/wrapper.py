@@ -30,7 +30,9 @@ class ParallelWrapper:
                  training_mode: str = "sharing",
                  averaging_frequency: int = 5,
                  threshold: float = 1e-3,
-                 adaptive_threshold: bool = True):
+                 adaptive_threshold: bool = True,
+                 prefetch_buffer: int = 0,
+                 prefetch_policy=None):
         devs = jax.devices()
         workers = workers or len(devs)
         if workers > len(devs):
@@ -38,6 +40,8 @@ class ParallelWrapper:
         mesh = build_mesh(num_data=workers, num_model=1,
                           devices=devs[:workers])
         self.workers = workers
+        self.prefetch_buffer = int(prefetch_buffer)
+        self.prefetch_policy = prefetch_policy
         self._trainer = ShardedTrainer(
             model, mesh=mesh, mode=training_mode,
             averaging_frequency=averaging_frequency, threshold=threshold,
@@ -51,6 +55,7 @@ class ParallelWrapper:
             self._mode = "sharing"
             self._freq = 5
             self._threshold = 1e-3
+            self._prefetch = 0
 
         def workers(self, n: int):
             self._workers = n
@@ -69,20 +74,63 @@ class ParallelWrapper:
             return self
 
         def prefetchBuffer(self, n: int):
-            return self  # async prefetch handled by AsyncDataSetIterator
+            """Device-side prefetch depth (reference: prefetchBuffer —
+            there a host ETL queue; here fit() wraps the iterator in a
+            DevicePrefetchIterator that also issues the host->device
+            transfers ``n`` batches ahead, sharded over the mesh)."""
+            self._prefetch = int(n)
+            return self
 
         def build(self) -> "ParallelWrapper":
             return ParallelWrapper(self._model, self._workers, self._mode,
-                                   self._freq, self._threshold)
+                                   self._freq, self._threshold,
+                                   prefetch_buffer=self._prefetch)
+
+    def _wrap_prefetch(self, data):
+        """Wrap an iterator in the device prefetcher (committed
+        P('data') sharding over the trainer mesh). pad_last keeps the
+        final partial minibatch divisible across shards AND — in
+        'sharing' mode on MultiLayerNetwork, where masks thread through
+        the step — loss-exact; other modes default to 'exact' since
+        their step would silently train on padding."""
+        from deeplearning4j_tpu.datasets.device_prefetch import (
+            BatchShapePolicy, DevicePrefetchIterator,
+        )
+        from deeplearning4j_tpu.datasets.iterator import DataSetIterator
+        from deeplearning4j_tpu.datasets.multi_dataset import (
+            MultiDataSetIterator,
+        )
+
+        if self.prefetch_buffer <= 0 or isinstance(
+                data, DevicePrefetchIterator) or not isinstance(
+                data, (DataSetIterator, MultiDataSetIterator)):
+            return data, None
+        policy = self.prefetch_policy
+        if policy is None:
+            tr = self._trainer
+            if tr.mode == "sharing" and not tr.mf.is_graph \
+                    and isinstance(data, DataSetIterator):
+                policy = BatchShapePolicy("pad_last")
+            else:
+                policy = BatchShapePolicy("exact")
+        pf = DevicePrefetchIterator(
+            data, depth=self.prefetch_buffer, policy=policy,
+            mesh=self._trainer.mesh, dtype=self._trainer.model._dtype)
+        return pf, pf
 
     def fit(self, data, labels=None, epochs: int = 1):
         if _telemetry.enabled():
             _telemetry.MetricsRegistry.get_default().gauge(
                 "dl4j_tpu_parallel_workers",
                 "mesh devices spanned by the SPMD step").set(self.workers)
-        with _telemetry.span("parallel_fit", workers=self.workers,
-                             mode=self._trainer.mode):
-            return self._trainer.fit(data, labels, epochs=epochs)
+        data, prefetcher = self._wrap_prefetch(data)
+        try:
+            with _telemetry.span("parallel_fit", workers=self.workers,
+                                 mode=self._trainer.mode):
+                return self._trainer.fit(data, labels, epochs=epochs)
+        finally:
+            if prefetcher is not None:
+                prefetcher.shutdown()
 
 
 class ParallelInference:

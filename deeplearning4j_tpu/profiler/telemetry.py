@@ -57,6 +57,13 @@ JIT_COMPILE_SECONDS = "dl4j_tpu_jit_compile_seconds"
 STEP_PHASE_SECONDS = "dl4j_tpu_step_phase_seconds"
 DEVICE_BYTES_IN_USE = "dl4j_tpu_device_bytes_in_use"
 DEVICE_PEAK_BYTES = "dl4j_tpu_device_peak_bytes_in_use"
+#: device input pipeline (datasets/device_prefetch.py)
+PREFETCH_QUEUE_DEPTH = "dl4j_tpu_prefetch_queue_depth"
+TRANSFER_OVERLAP_MS = "dl4j_tpu_prefetch_transfer_overlap_ms"
+PREFETCH_PADDED_EXAMPLES = "dl4j_tpu_prefetch_padded_examples_total"
+BUCKET_HITS = "dl4j_tpu_shape_bucket_hits_total"
+BUCKET_MISSES = "dl4j_tpu_shape_bucket_misses_total"
+ON_DEVICE_BATCHES = "dl4j_tpu_on_device_batches_total"
 
 
 def enabled() -> bool:
@@ -346,6 +353,18 @@ def record_phase(phase: str, t0: float, t1: Optional[float] = None,
                 **attrs)
 
 
+def record_on_device_batch(site: str) -> None:
+    """Count a batch that arrived in a fit loop already device-resident
+    (the device prefetcher transferred it ahead of time), so the
+    per-step host->device copy was skipped."""
+    if not _ENABLED:
+        return
+    MetricsRegistry.get_default().counter(
+        ON_DEVICE_BATCHES,
+        "batches that arrived already device-resident (prefetched) and "
+        "skipped the fit loop's host->device copy").inc(site=site)
+
+
 def timed_batches(iterable):
     """Iterate, recording time blocked on ``next()`` as the
     ``etl_wait`` phase — the one ETL-timing loop every fit front-end
@@ -474,12 +493,29 @@ class _InstrumentedJit:
         if n >= threshold and n >= max(self._warned_at * 2, threshold):
             self._warned_at = n
             recent = "; ".join(self._sigs[-3:])
+            bucket_hits = reg.counter(BUCKET_HITS).total()
+            bucket_misses = reg.counter(BUCKET_MISSES).total()
+            if bucket_hits + bucket_misses == 0:
+                remedy = ("input-pipeline shape bucketing is OFF — "
+                          "enable it: wrap the iterator in "
+                          "DevicePrefetchIterator(policy=BatchShape"
+                          "Policy('bucket')) (docs/INPUT_PIPELINE.md)")
+            else:
+                # the bucket counters are process-global, not per-site:
+                # another model's pipeline may be the bucketed one
+                remedy = ("shape bucketing is active SOMEWHERE in this "
+                          "process (%d bucket misses / %d hits) but "
+                          "this site still churns — if this site's "
+                          "iterator is not behind a bucketed "
+                          "DevicePrefetchIterator, enable it there; "
+                          "otherwise check the bucket boundaries"
+                          % (bucket_misses, bucket_hits))
             log.warning(
                 "RECOMPILE STORM at jit site %r: %d compiles (shape/"
                 "dtype churn). Each distinct input shape/dtype traces "
                 "and compiles a fresh XLA executable — pad or bucket "
-                "batches to stable shapes. Recent signatures: %s",
-                self._site, n, recent)
+                "batches to stable shapes: %s. Recent signatures: %s",
+                self._site, n, remedy, recent)
 
 
 def instrument_jit(site: str, fn: Callable,
@@ -574,7 +610,10 @@ __all__ = [
     "span", "record_span", "record_phase",
     "chrome_trace", "export_chrome_trace", "clear_trace",
     "instrument_jit", "sample_device_memory", "snapshot", "reset",
-    "enabled", "set_enabled",
+    "enabled", "set_enabled", "record_on_device_batch",
     "JIT_COMPILES", "JIT_COMPILE_SECONDS", "STEP_PHASE_SECONDS",
     "DEVICE_BYTES_IN_USE", "DEVICE_PEAK_BYTES",
+    "PREFETCH_QUEUE_DEPTH", "TRANSFER_OVERLAP_MS",
+    "PREFETCH_PADDED_EXAMPLES", "BUCKET_HITS", "BUCKET_MISSES",
+    "ON_DEVICE_BATCHES",
 ]

@@ -115,11 +115,52 @@ def _read_npz(zf: zipfile.ZipFile, name: str) -> dict:
         return out
 
 
+def _normalizer_registry():
+    """Zero-arg-constructible normalizer types restoreNormalizer can
+    rebuild — the single source of truth for save-time validation."""
+    from deeplearning4j_tpu.datasets.normalizers import (
+        ImagePreProcessingScaler, MultiNormalizerMinMaxScaler,
+        MultiNormalizerStandardize, NormalizerMinMaxScaler,
+        NormalizerStandardize, VGG16ImagePreProcessor)
+
+    return {"NormalizerStandardize": NormalizerStandardize,
+            "NormalizerMinMaxScaler": NormalizerMinMaxScaler,
+            "ImagePreProcessingScaler": ImagePreProcessingScaler,
+            "VGG16ImagePreProcessor": VGG16ImagePreProcessor,
+            "MultiNormalizerStandardize": MultiNormalizerStandardize,
+            "MultiNormalizerMinMaxScaler": MultiNormalizerMinMaxScaler}
+
+
+def _check_composite_children(normalizer) -> None:
+    """A CompositeDataSetPreProcessor whose children are nested
+    composites or unknown types saves fine but crashes on restore (the
+    one-level 'p<i>/<key>' state paths cannot represent nesting and the
+    restore registry rebuilds children with zero args) — reject at save
+    time instead of at the much later, much more confusing restore."""
+    registry = _normalizer_registry()
+    for i, child in enumerate(normalizer.preprocessors):
+        name = type(child).__name__
+        if hasattr(child, "preprocessors"):
+            raise ValueError(
+                f"cannot save CompositeDataSetPreProcessor child {i} "
+                f"({name}): nested composites are not restorable — "
+                "flatten the children into one composite")
+        if name not in registry:
+            raise ValueError(
+                f"cannot save CompositeDataSetPreProcessor child {i}: "
+                f"{name} is not a restorable normalizer type "
+                f"(expected one of {sorted(registry)})")
+
+
 class ModelSerializer:
     @staticmethod
     def writeModel(model, path: str, save_updater: bool = True,
                    normalizer=None) -> None:
         """Reference: ModelSerializer.writeModel(model, file, saveUpdater)."""
+        if normalizer is not None and hasattr(normalizer, "preprocessors"):
+            # validate BEFORE any bytes hit disk — raising mid-zip
+            # would leave a corrupt archive at path
+            _check_composite_children(normalizer)
         os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
         is_graph = hasattr(model, "params_map")
         params = model.params_map if is_graph else model.params_list
@@ -137,12 +178,12 @@ class ModelSerializer:
                     "model_type": type(model).__name__}
             zf.writestr("meta.json", json.dumps(meta))
             if normalizer is not None:
-                _write_npz(zf, "normalizer.npz",
-                           _flatten_with_paths(normalizer.state_dict()))
                 info = {"type": type(normalizer).__name__}
                 if hasattr(normalizer, "preprocessors"):  # composite
                     info["children"] = [type(p).__name__
                                         for p in normalizer.preprocessors]
+                _write_npz(zf, "normalizer.npz",
+                           _flatten_with_paths(normalizer.state_dict()))
                 zf.writestr("normalizer.json", json.dumps(info))
 
     @staticmethod
@@ -205,23 +246,25 @@ class ModelSerializer:
     @staticmethod
     def restoreNormalizer(path: str):
         from deeplearning4j_tpu.datasets.normalizers import (
-            CompositeDataSetPreProcessor, ImagePreProcessingScaler,
-            MultiNormalizerMinMaxScaler, MultiNormalizerStandardize,
-            NormalizerMinMaxScaler, NormalizerStandardize,
-            VGG16ImagePreProcessor)
+            CompositeDataSetPreProcessor,
+        )
 
-        registry = {"NormalizerStandardize": NormalizerStandardize,
-                    "NormalizerMinMaxScaler": NormalizerMinMaxScaler,
-                    "ImagePreProcessingScaler": ImagePreProcessingScaler,
-                    "VGG16ImagePreProcessor": VGG16ImagePreProcessor,
-                    "MultiNormalizerStandardize": MultiNormalizerStandardize,
-                    "MultiNormalizerMinMaxScaler": MultiNormalizerMinMaxScaler}
+        registry = _normalizer_registry()
         with zipfile.ZipFile(path) as zf:
             if "normalizer.json" not in zf.namelist():
                 return None
             info = json.loads(zf.read("normalizer.json").decode())
             state = _read_npz(zf, "normalizer.npz")
             if info["type"] == "CompositeDataSetPreProcessor":
+                # saves from before the save-time child validation may
+                # carry children we cannot rebuild — fail with the
+                # actual problem, not a KeyError deep in the registry
+                bad = [t for t in info["children"] if t not in registry]
+                if bad:
+                    raise ValueError(
+                        "cannot restore CompositeDataSetPreProcessor: "
+                        f"children {bad} are not restorable normalizer "
+                        f"types (expected one of {sorted(registry)})")
                 n = CompositeDataSetPreProcessor(
                     *[registry[t]() for t in info["children"]])
                 # _flatten_with_paths joined the per-child dicts as
@@ -233,6 +276,10 @@ class ModelSerializer:
                     nested[head][rest] = v
                 n.load_state_dict(nested)
                 return n
+            if info["type"] not in registry:
+                raise ValueError(
+                    f"cannot restore normalizer of type {info['type']!r} "
+                    f"(expected one of {sorted(registry)})")
             n = registry[info["type"]]()
             n.load_state_dict(state)
             return n

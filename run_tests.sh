@@ -65,4 +65,42 @@ if [ $smoke -ne 0 ]; then
     echo "FATAL: telemetry /metrics smoke check regressed" >&2
     exit 1
 fi
+
+# Device-prefetch CPU fallback smoke: depth>0 on a CPU-only backend
+# must still deliver every batch in order (transfers degrade to cheap
+# host copies), and BOTH pipeline threads must be joined afterwards —
+# the thread-leak gate inside conftest covers the suite, this covers
+# the standalone-interpreter path.
+env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu DL4J_TPU_TELEMETRY=1 \
+    python - <<'EOF'
+import sys
+import threading
+
+import numpy as np
+
+before = {t for t in threading.enumerate() if t.is_alive()}
+from deeplearning4j_tpu.datasets import (
+    ArrayDataSetIterator, BatchShapePolicy, DevicePrefetchIterator,
+)
+
+x = np.arange(120, dtype=np.float32).reshape(30, 4)
+y = np.zeros((30, 2), np.float32)
+with DevicePrefetchIterator(
+        ArrayDataSetIterator(x, y, 8), depth=2,
+        policy=BatchShapePolicy("pad_last", batch_size=8)) as pf:
+    feats = [np.asarray(ds.features) for ds in pf]
+ok = (len(feats) == 4 and all(f.shape == (8, 4) for f in feats)
+      and np.array_equal(feats[0][:8, 0], x[:8, 0]))
+leaked = {t for t in threading.enumerate() if t.is_alive()} - before
+if leaked or not ok:
+    sys.stderr.write(
+        f"prefetch CPU fallback smoke FAILED: ok={ok} leaked={leaked}\n")
+    sys.exit(1)
+print("device-prefetch CPU fallback smoke OK (depth=2, no leaked threads)")
+EOF
+pfsmoke=$?
+if [ $pfsmoke -ne 0 ]; then
+    echo "FATAL: device-prefetch CPU fallback smoke regressed" >&2
+    exit 1
+fi
 exit $rc

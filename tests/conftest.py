@@ -59,3 +59,33 @@ jax.config.update("jax_enable_x64", False)
 # Correctness tests pin full f32 accumulation; production configs choose
 # their own precision policy (bf16 on MXU) via nn/conf dtype settings.
 jax.config.update("jax_default_matmul_precision", "highest")
+
+
+def pytest_sessionfinish(session, exitstatus):
+    """Thread-leak gate: fail the run if non-daemon threads (or any
+    device-prefetch worker — that subsystem must always join its
+    threads) survive the suite. A leaked non-daemon thread hangs the
+    interpreter at exit; a leaked prefetch worker means a fit loop or
+    test skipped shutdown()."""
+    import threading
+    import time
+
+    def leaked():
+        return [
+            t for t in threading.enumerate()
+            if t.is_alive() and t is not threading.main_thread()
+            and (not t.daemon
+                 or t.name.startswith(("DevicePrefetch",
+                                       "AsyncDataSet-ETL")))
+        ]
+
+    deadline = time.time() + 2.0
+    survivors = leaked()
+    while survivors and time.time() < deadline:
+        time.sleep(0.1)   # grace: threads mid-exit
+        survivors = leaked()
+    if survivors:
+        print("\nTHREAD-LEAK GATE: threads survived the suite: "
+              + ", ".join(f"{t.name} (daemon={t.daemon})"
+                          for t in survivors))
+        session.exitstatus = 3

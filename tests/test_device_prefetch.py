@@ -1,0 +1,470 @@
+"""Device input pipeline tests: async prefetch ordering/reset/shutdown
+(no leaked threads), pad-to-bucket loss equivalence, on-device batch
+passthrough in all three fit loops, recompile-count bounds, and the
+transfer-overlap / queue-depth telemetry contract."""
+
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets import (
+    ArrayDataSetIterator, BatchShapePolicy, DataSet,
+    DevicePrefetchIterator, DevicePrefetchMultiIterator,
+    ListDataSetIterator, MultiDataSet, ListMultiDataSetIterator,
+    MultiDataSetIterator,
+)
+from deeplearning4j_tpu.datasets.record_reader_iterator import (
+    AsyncDataSetIterator,
+)
+from deeplearning4j_tpu.learning import Sgd
+from deeplearning4j_tpu.nn.conf import (
+    LSTM, DenseLayer, InputType, NeuralNetConfiguration, OutputLayer,
+    RnnOutputLayer,
+)
+from deeplearning4j_tpu.nn.multilayer.network import MultiLayerNetwork
+from deeplearning4j_tpu.profiler import telemetry
+
+
+def _reg():
+    return telemetry.MetricsRegistry.get_default()
+
+
+def _lstm_net(seed=7, n_in=4, hidden=6, n_out=5):
+    conf = (NeuralNetConfiguration.builder().seed(seed)
+            .updater(Sgd(1e-2)).list()
+            .layer(LSTM(n_out=hidden))
+            .layer(RnnOutputLayer(n_out=n_out, activation="softmax",
+                                  loss="mcxent"))
+            .setInputType(InputType.recurrent(n_in)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _ff_net(seed=3, loss="mse", activation="identity"):
+    conf = (NeuralNetConfiguration.builder().seed(seed)
+            .updater(Sgd(1e-2)).list()
+            .layer(DenseLayer(n_out=6, activation="tanh"))
+            .layer(OutputLayer(n_out=2, activation=activation, loss=loss))
+            .setInputType(InputType.feedForward(4)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _ragged_sets(lengths, batch=8, last_n=3, n_in=4, n_out=5, seed=1):
+    rng = np.random.default_rng(seed)
+    eye = np.eye(n_out, dtype=np.float32)
+    sets = []
+    for i, t in enumerate(lengths):
+        n = batch if i < len(lengths) - 1 else last_n
+        sets.append(DataSet(
+            rng.normal(size=(n, t, n_in)).astype(np.float32),
+            eye[rng.integers(0, n_out, (n, t))]))
+    return sets
+
+
+def _threads():
+    return {t for t in threading.enumerate() if t.is_alive()}
+
+
+# ----------------------------------------------------------------------
+# prefetch mechanics: ordering, reset, shutdown, error propagation
+# ----------------------------------------------------------------------
+class TestPrefetchMechanics:
+    def test_ordering_matches_sync_iteration(self):
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(20, 4)).astype(np.float32)
+        y = rng.normal(size=(20, 2)).astype(np.float32)
+        raw = [np.asarray(ds.features)
+               for ds in ArrayDataSetIterator(x, y, 4)]
+        pf = DevicePrefetchIterator(ArrayDataSetIterator(x, y, 4),
+                                    depth=2)
+        # workers start lazily: fit loops reset() before consuming, and
+        # an eager start would discard the first prefetched batches
+        assert pf._thread is None
+        pf.reset()   # pre-consumption reset must not spin anything up
+        assert pf._thread is None
+        got = [np.asarray(ds.features) for ds in pf]
+        pf.shutdown()
+        assert len(got) == len(raw) == 5
+        for a, b in zip(raw, got):
+            np.testing.assert_array_equal(a, b)
+
+    def test_reset_mid_epoch_and_multi_epoch(self):
+        x = np.arange(24, dtype=np.float32).reshape(12, 2)
+        y = np.zeros((12, 1), np.float32)
+        pf = DevicePrefetchIterator(ArrayDataSetIterator(x, y, 4),
+                                    depth=2)
+        assert pf.hasNext()
+        first = np.asarray(pf.next().features)
+        pf.reset()  # mid-epoch restart
+        epochs = [[np.asarray(ds.features) for ds in pf]
+                  for _ in range(2)]  # __iter__ resets each time
+        pf.shutdown()
+        np.testing.assert_array_equal(epochs[0][0], first)
+        assert len(epochs[0]) == len(epochs[1]) == 3
+        for a, b in zip(epochs[0], epochs[1]):
+            np.testing.assert_array_equal(a, b)
+
+    def test_shutdown_leaves_no_threads(self):
+        before = _threads()
+        x = np.zeros((16, 4), np.float32)
+        y = np.zeros((16, 2), np.float32)
+        pf = DevicePrefetchIterator(ArrayDataSetIterator(x, y, 4),
+                                    depth=2)
+        next(iter(pf))  # partially consumed epoch, workers mid-flight
+        pf.shutdown()
+        leaked = _threads() - before
+        assert not leaked, f"leaked threads: {leaked}"
+        # shutdown is idempotent and reset() reopens
+        pf.shutdown()
+        pf.reset()
+        assert len(list(pf)) == 4
+        pf.shutdown()
+        assert not (_threads() - before)
+
+    def test_context_manager_shuts_down(self):
+        before = _threads()
+        x = np.zeros((8, 4), np.float32)
+        y = np.zeros((8, 2), np.float32)
+        with DevicePrefetchIterator(ArrayDataSetIterator(x, y, 4),
+                                    depth=1) as pf:
+            assert len(list(pf)) == 2
+        assert not (_threads() - before)
+
+    def test_async_iterator_shutdown_joins_worker(self):
+        before = _threads()
+        x = np.zeros((16, 4), np.float32)
+        y = np.zeros((16, 2), np.float32)
+        it = AsyncDataSetIterator(ArrayDataSetIterator(x, y, 4),
+                                  queue_size=2)
+        it.next()  # abandon mid-epoch
+        it.shutdown()
+        assert not (_threads() - before)
+        it.reset()  # reopens
+        assert len(list(it)) == 4
+        it.shutdown()
+        assert not (_threads() - before)
+
+    def test_slow_consumer_never_loses_final_batches(self):
+        """Regression: the ETL worker's sentinel put used to DROP a
+        live queued batch whenever the consumer stalled >0.1s at epoch
+        end (exactly what a jit compile does) — only a requested
+        stop/reset may discard batches."""
+        import time
+
+        x = np.arange(24, dtype=np.float32).reshape(12, 2)
+        y = np.zeros((12, 1), np.float32)
+        it = AsyncDataSetIterator(ArrayDataSetIterator(x, y, 4),
+                                  queue_size=1)
+        got = 0
+        while it.hasNext():
+            time.sleep(0.25)  # stall past the old sentinel-put timeout
+            it.next()
+            got += 1
+        it.shutdown()
+        assert got == 3
+
+    def test_worker_error_reraises_on_consumer(self):
+        class Exploding(ArrayDataSetIterator):
+            def next(self):
+                if self._i >= 4:
+                    raise RuntimeError("decode failed")
+                return super().next()
+
+        x = np.zeros((12, 4), np.float32)
+        y = np.zeros((12, 2), np.float32)
+        pf = DevicePrefetchIterator(Exploding(x, y, 4), depth=2)
+        with pytest.raises(RuntimeError, match="decode failed"):
+            list(pf)
+        pf.shutdown()
+
+    def test_depth_zero_sync_fallback_no_threads(self):
+        before = _threads()
+        x = np.ones((10, 4), np.float32)
+        y = np.ones((10, 2), np.float32)
+        pf = DevicePrefetchIterator(
+            ArrayDataSetIterator(x, y, 4), depth=0,
+            policy=BatchShapePolicy("pad_last", batch_size=4))
+        batches = list(pf)
+        assert _threads() == before  # fully synchronous
+        assert len(batches) == 3
+        for b in batches:
+            assert isinstance(b.features, jax.Array)
+            assert b.features.shape[0] == 4  # partial batch padded
+
+    def test_multi_iterator_dispatch(self):
+        mds = [MultiDataSet([np.ones((4, 3), np.float32)],
+                            [np.ones((4, 2), np.float32)])
+               for _ in range(3)]
+        pf = DevicePrefetchIterator(ListMultiDataSetIterator(mds),
+                                    depth=1)
+        assert isinstance(pf, DevicePrefetchMultiIterator)
+        assert isinstance(pf, MultiDataSetIterator)
+        got = list(pf)
+        pf.shutdown()
+        assert len(got) == 3
+        assert isinstance(got[0], MultiDataSet)
+        assert isinstance(got[0].features[0], jax.Array)
+
+
+# ----------------------------------------------------------------------
+# shape policy: padding + bucketing semantics and loss equivalence
+# ----------------------------------------------------------------------
+class TestBatchShapePolicy:
+    def test_bucket_pads_to_pow2_and_batch(self):
+        pol = BatchShapePolicy("bucket", batch_size=8)
+        ds = DataSet(np.ones((3, 13, 4), np.float32),
+                     np.ones((3, 13, 5), np.float32))
+        out = pol.apply(ds)
+        assert np.asarray(out.features).shape == (8, 16, 4)
+        assert np.asarray(out.labels).shape == (8, 16, 5)
+        fm = np.asarray(out.features_mask)
+        lm = np.asarray(out.labels_mask)
+        assert fm.shape == lm.shape == (8, 16)
+        # real region: fm 1, lm scaled by 8/3; padding: fm time-pad 0,
+        # lm 0 everywhere outside the real region
+        assert np.all(fm[:3, :13] == 1.0) and np.all(fm[:3, 13:] == 0.0)
+        np.testing.assert_allclose(lm[:3, :13], 8.0 / 3.0, rtol=1e-6)
+        assert np.all(lm[3:] == 0.0) and np.all(lm[:3, 13:] == 0.0)
+
+    def test_exact_mode_is_identity(self):
+        ds = DataSet(np.ones((3, 5, 4), np.float32),
+                     np.ones((3, 5, 5), np.float32))
+        assert BatchShapePolicy("exact").apply(ds) is ds
+
+    def test_existing_ragged_mask_is_extended_and_scaled(self):
+        fm = np.zeros((3, 13), np.float32)
+        fm[0, :13] = 1.0
+        fm[1, :7] = 1.0
+        fm[2, :2] = 1.0
+        ds = DataSet(np.ones((3, 13, 4), np.float32),
+                     np.ones((3, 13, 5), np.float32), fm)
+        out = BatchShapePolicy("bucket", batch_size=4).apply(ds)
+        lm = np.asarray(out.labels_mask)
+        np.testing.assert_allclose(lm[:3, :13], fm * (4.0 / 3.0),
+                                   rtol=1e-6)
+        assert np.all(lm[3:] == 0.0)
+
+    def test_pad_last_loss_equivalence_mse(self):
+        rng = np.random.default_rng(5)
+        net = _ff_net()
+        x = rng.normal(size=(5, 4)).astype(np.float32)
+        y = rng.normal(size=(5, 2)).astype(np.float32)
+        out = BatchShapePolicy("pad_last", batch_size=8).apply(
+            DataSet(x, y))
+        l0, _ = net._loss(net.params_list, net.states_list,
+                          jnp.asarray(x), jnp.asarray(y), None, None)
+        l1, _ = net._loss(net.params_list, net.states_list,
+                          jnp.asarray(np.asarray(out.features)),
+                          jnp.asarray(np.asarray(out.labels)),
+                          jnp.asarray(np.asarray(out.labels_mask)),
+                          None)
+        assert abs(float(l0) - float(l1)) < 1e-6
+
+    def test_bucket_loss_equivalence_masked_rnn(self):
+        """Padded (batch AND time) masked loss == unpadded loss to
+        ~1e-6 — the padding must be invisible to training."""
+        rng = np.random.default_rng(6)
+        net = _lstm_net()
+        x = rng.normal(size=(3, 5, 4)).astype(np.float32)
+        y = np.eye(5, dtype=np.float32)[rng.integers(0, 5, (3, 5))]
+        out = BatchShapePolicy("bucket", batch_size=8).apply(
+            DataSet(x, y))
+        l0, _ = net._loss(net.params_list, net.states_list,
+                          jnp.asarray(x), jnp.asarray(y), None, None)
+        l1, _ = net._loss(net.params_list, net.states_list,
+                          jnp.asarray(np.asarray(out.features)),
+                          jnp.asarray(np.asarray(out.labels)),
+                          jnp.asarray(np.asarray(out.labels_mask)),
+                          None,
+                          jnp.asarray(np.asarray(out.features_mask)))
+        assert abs(float(l0) - float(l1)) < 1e-5
+
+    def test_per_example_mask_on_sequence_labels(self):
+        """A per-example [N,1] labels mask on [N,T,C] labels must
+        broadcast to per-timestep (used to IndexError on time pad)."""
+        ds = DataSet(np.ones((3, 10, 5), np.float32),
+                     np.ones((3, 10, 2), np.float32),
+                     labels_mask=np.asarray([[1.0], [0.5], [2.0]],
+                                            np.float32))
+        out = BatchShapePolicy("bucket", batch_size=4).apply(ds)
+        lm = np.asarray(out.labels_mask)
+        assert lm.shape == (4, 16)
+        np.testing.assert_allclose(lm[1, :10], 0.5 * 4.0 / 3.0,
+                                   rtol=1e-6)
+        assert np.all(lm[:, 10:] == 0.0) and np.all(lm[3:] == 0.0)
+
+    def test_caller_policy_not_mutated(self):
+        """Filling batch_size from the iterator must not write back
+        into a caller-owned (possibly shared) policy object."""
+        pol = BatchShapePolicy("pad_last")
+        x = np.ones((10, 4), np.float32)
+        y = np.ones((10, 2), np.float32)
+        pf = DevicePrefetchIterator(ArrayDataSetIterator(x, y, 4),
+                                    depth=0, policy=pol)
+        out = list(pf)
+        assert pol.batch_size is None
+        assert pf.batch() == 4
+        assert np.asarray(out[-1].features).shape[0] == 4
+
+    def test_multi_dataset_padding(self):
+        mds = MultiDataSet(
+            [np.ones((3, 4), np.float32), np.ones((3, 6, 2), np.float32)],
+            [np.ones((3, 2), np.float32)])
+        out = BatchShapePolicy("bucket", batch_size=8).apply(mds)
+        assert np.asarray(out.features[0]).shape == (8, 4)
+        assert np.asarray(out.features[1]).shape == (8, 8, 2)
+        lm = np.asarray(out.labels_mask_arrays[0])
+        np.testing.assert_allclose(lm[:3], 8.0 / 3.0, rtol=1e-6)
+        assert np.all(lm[3:] == 0.0)
+
+    def test_padded_examples_counter(self):
+        before = _reg().counter(telemetry.PREFETCH_PADDED_EXAMPLES).total()
+        BatchShapePolicy("pad_last", batch_size=8).apply(
+            DataSet(np.ones((3, 4), np.float32),
+                    np.ones((3, 2), np.float32)))
+        after = _reg().counter(telemetry.PREFETCH_PADDED_EXAMPLES).total()
+        assert after - before == 5
+
+
+# ----------------------------------------------------------------------
+# fit-loop integration: passthrough + recompile bounds + telemetry
+# ----------------------------------------------------------------------
+class TestFitIntegration:
+    def test_mln_on_device_passthrough_and_fit(self):
+        net = _ff_net()
+        rng = np.random.default_rng(2)
+        x = rng.normal(size=(16, 4)).astype(np.float32)
+        y = rng.normal(size=(16, 2)).astype(np.float32)
+        c0 = _reg().counter(telemetry.ON_DEVICE_BATCHES).value(site="mln")
+        with DevicePrefetchIterator(ArrayDataSetIterator(x, y, 4),
+                                    depth=2, dtype=net._dtype) as pf:
+            net.fit(pf, epochs=1)
+        c1 = _reg().counter(telemetry.ON_DEVICE_BATCHES).value(site="mln")
+        assert c1 - c0 == 4
+        assert np.isfinite(net.score())
+
+    def test_cg_on_device_passthrough_and_fit(self):
+        from deeplearning4j_tpu.nn.graph import (
+            ComputationGraph, ComputationGraphConfiguration,
+        )
+
+        conf = (ComputationGraphConfiguration.graphBuilder()
+                .addInputs("in")
+                .setInputTypes(InputType.feedForward(4))
+                .addLayer("d", DenseLayer(n_out=6, activation="tanh"),
+                          "in")
+                .addLayer("out", OutputLayer(n_out=2,
+                                             activation="softmax",
+                                             loss="mcxent"), "d")
+                .setOutputs("out").build())
+        net = ComputationGraph(conf).init()
+        rng = np.random.default_rng(3)
+        x = rng.normal(size=(12, 4)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 12)]
+        c0 = _reg().counter(telemetry.ON_DEVICE_BATCHES).value(site="cg")
+        with DevicePrefetchIterator(ArrayDataSetIterator(x, y, 4),
+                                    depth=1, dtype=net._dtype) as pf:
+            net.fit(pf, epochs=1)
+        c1 = _reg().counter(telemetry.ON_DEVICE_BATCHES).value(site="cg")
+        assert c1 - c0 == 3
+        assert np.isfinite(net.score())
+
+    def test_sharded_on_device_passthrough(self):
+        from deeplearning4j_tpu.parallel.mesh import build_mesh
+        from deeplearning4j_tpu.parallel.sharded import ShardedTrainer
+
+        net = _ff_net(loss="mcxent", activation="softmax")
+        mesh = build_mesh(num_data=8)
+        tr = ShardedTrainer(net, mesh=mesh)
+        rng = np.random.default_rng(4)
+        x = rng.normal(size=(32, 4)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 32)]
+        c0 = _reg().counter(telemetry.ON_DEVICE_BATCHES).value(
+            site="sharded")
+        with DevicePrefetchIterator(
+                ArrayDataSetIterator(x, y, 16), depth=2, mesh=mesh,
+                dtype=net._dtype,
+                policy=BatchShapePolicy("pad_last", batch_size=16)) as pf:
+            tr.fit(pf, epochs=1)
+        c1 = _reg().counter(telemetry.ON_DEVICE_BATCHES).value(
+            site="sharded")
+        assert c1 - c0 == 2
+        assert np.isfinite(net.score())
+
+    def test_parallel_wrapper_prefetch_buffer(self):
+        from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
+
+        before = _threads()
+        net = _ff_net(loss="mcxent", activation="softmax")
+        pw = (ParallelWrapper.Builder(net).workers(8)
+              .prefetchBuffer(2).build())
+        assert pw.prefetch_buffer == 2
+        rng = np.random.default_rng(8)
+        # 40 examples / batch 16 -> partial final batch of 8, padded
+        # to 16 by the default pad_last policy so it shards evenly
+        x = rng.normal(size=(40, 4)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 40)]
+        c0 = _reg().counter(telemetry.ON_DEVICE_BATCHES).value(
+            site="sharded")
+        pw.fit(ArrayDataSetIterator(x, y, 16), epochs=1)
+        c1 = _reg().counter(telemetry.ON_DEVICE_BATCHES).value(
+            site="sharded")
+        assert c1 - c0 == 3
+        assert not (_threads() - before)  # fit() shut the pipeline down
+
+    def test_bucketed_ragged_stream_compiles_per_bucket(self):
+        """Acceptance: a ragged LSTM stream (varying T + partial final
+        batch) through the bucket policy compiles at most one
+        executable per shape bucket — not one per distinct shape."""
+        net = _lstm_net()
+        lengths = [5, 9, 13, 3]  # buckets: 8, 16
+        sets = _ragged_sets(lengths)
+        c0 = _reg().counter(telemetry.JIT_COMPILES).value(site="mln_step")
+        with DevicePrefetchIterator(
+                ListDataSetIterator(sets, batch_size=8), depth=2,
+                policy=BatchShapePolicy("bucket", batch_size=8)) as pf:
+            net.fit(pf, epochs=2)
+        c1 = _reg().counter(telemetry.JIT_COMPILES).value(site="mln_step")
+        n_buckets = len({max(8, 1 << (t - 1).bit_length())
+                         for t in lengths})
+        assert n_buckets == 2
+        assert c1 - c0 <= n_buckets
+        # contrast: the raw stream compiles one executable per
+        # distinct (T, n) shape — the storm bucketing kills
+        net2 = _lstm_net()
+        c2 = _reg().counter(telemetry.JIT_COMPILES).value(site="mln_step")
+        net2.fit(ListDataSetIterator(sets, batch_size=8), epochs=1)
+        c3 = _reg().counter(telemetry.JIT_COMPILES).value(site="mln_step")
+        assert c3 - c2 == len(lengths)
+
+    def test_bucket_hit_miss_counters(self):
+        sets = _ragged_sets([5, 9, 6, 13], last_n=8)
+        pol = BatchShapePolicy("bucket", batch_size=8)
+        h0 = _reg().counter(telemetry.BUCKET_HITS).total()
+        m0 = _reg().counter(telemetry.BUCKET_MISSES).total()
+        for ds in sets:
+            pol.apply(ds)
+        assert _reg().counter(telemetry.BUCKET_MISSES).total() - m0 == 2
+        assert _reg().counter(telemetry.BUCKET_HITS).total() - h0 == 2
+
+    def test_transfer_overlap_and_queue_depth_telemetry(self):
+        """Acceptance: with depth>=1 the transfer of batch N+1 is
+        issued before batch N is consumed — every consumed batch shows
+        a positive transfer-overlap sample, and the queue-depth gauge
+        reports the device-side buffer."""
+        net = _ff_net()
+        rng = np.random.default_rng(9)
+        x = rng.normal(size=(24, 4)).astype(np.float32)
+        y = rng.normal(size=(24, 2)).astype(np.float32)
+        hist = _reg().histogram(telemetry.TRANSFER_OVERLAP_MS)
+        n0 = hist.count()
+        with DevicePrefetchIterator(ArrayDataSetIterator(x, y, 4),
+                                    depth=2, dtype=net._dtype) as pf:
+            net.fit(pf, epochs=1)
+        assert hist.count() - n0 == 6  # one overlap sample per batch
+        assert hist.percentiles()["p50"] >= 0.0
+        # the gauge exists and its last value is a valid queue size
+        depth = _reg().gauge(telemetry.PREFETCH_QUEUE_DEPTH).value()
+        assert 0 <= depth <= 2

@@ -172,3 +172,164 @@ def test_masked_pooling_time_axis_mismatch_raises():
     fmask[:, 5:] = 0
     with pytest.raises(ValueError, match="changed the sequence length"):
         net.output(x, feature_masks=[fmask])
+
+
+# ----------------------------------------------------------------------
+# ADVICE r5 regression tests
+# ----------------------------------------------------------------------
+def test_normalizer_standardize_clears_stale_label_stats():
+    """ADVICE r5: fitLabel(True)+fit() then fitLabel(False)+fit() must
+    not keep normalizing labels with the previous fit's statistics."""
+    from deeplearning4j_tpu.datasets.normalizers import (
+        NormalizerStandardize,
+    )
+
+    rng = np.random.default_rng(0)
+    ds = DataSet(rng.normal(5, 2, (32, 3)).astype(np.float32),
+                 rng.normal(-4, 3, (32, 2)).astype(np.float32))
+    norm = NormalizerStandardize().fitLabel(True)
+    norm.fit(ds)
+    assert norm.label_mean is not None
+    norm.fitLabel(False)
+    norm.fit(ds)
+    assert norm.label_mean is None and norm.label_std is None
+    labels = np.array(np.asarray(ds.labels))
+    out = norm.transform(DataSet(np.asarray(ds.features).copy(), labels))
+    np.testing.assert_array_equal(np.asarray(out.labels), labels)
+
+
+def test_dataset_save_load_roundtrip_without_npz_suffix(tmp_path):
+    """ADVICE r5: save(p) must write to EXACTLY p so load(p)
+    round-trips on any path (np.savez silently appends '.npz')."""
+    ds = DataSet(np.arange(8, dtype=np.float32).reshape(4, 2),
+                 np.ones((4, 1), np.float32),
+                 features_mask=None,
+                 labels_mask=np.ones((4, 1), np.float32))
+    for name in ("batch.bin", "batch.npz", "batch"):
+        p = str(tmp_path / name)
+        ds.save(p)
+        import os
+        assert os.path.exists(p), f"save wrote somewhere else for {name}"
+        back = DataSet.load(p)
+        np.testing.assert_array_equal(np.asarray(back.features),
+                                      np.asarray(ds.features))
+        np.testing.assert_array_equal(np.asarray(back.labels_mask),
+                                      np.asarray(ds.labels_mask))
+
+
+def _tiny_model():
+    from deeplearning4j_tpu.learning import Sgd
+
+    conf = (NeuralNetConfiguration.builder().seed(0).updater(Sgd(1e-2))
+            .list()
+            .layer(DenseLayer(n_out=3, activation="tanh"))
+            .layer(OutputLayer(n_out=2, activation="softmax",
+                               loss="mcxent"))
+            .setInputType(InputType.feedForward(4)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+def test_composite_normalizer_flat_roundtrip(tmp_path):
+    from deeplearning4j_tpu.datasets.normalizers import (
+        CompositeDataSetPreProcessor, NormalizerMinMaxScaler,
+        NormalizerStandardize,
+    )
+
+    rng = np.random.default_rng(1)
+    ds = DataSet(rng.normal(3, 2, (16, 4)).astype(np.float32),
+                 np.ones((16, 2), np.float32))
+    comp = CompositeDataSetPreProcessor(NormalizerStandardize(),
+                                        NormalizerMinMaxScaler())
+    comp.fit(ds)
+    path = str(tmp_path / "model.zip")
+    ModelSerializer.writeModel(_tiny_model(), path, normalizer=comp)
+    back = ModelSerializer.restoreNormalizer(path)
+    assert isinstance(back, CompositeDataSetPreProcessor)
+    np.testing.assert_allclose(back.preprocessors[0].mean,
+                               comp.preprocessors[0].mean, rtol=1e-6)
+
+
+def test_composite_normalizer_rejects_nested_at_save(tmp_path):
+    """ADVICE r5: a nested composite saved fine but crashed on restore
+    (KeyError in the zero-arg registry + unrepresentable state paths)
+    — now rejected at save time with the actual problem."""
+    from deeplearning4j_tpu.datasets.normalizers import (
+        CompositeDataSetPreProcessor, NormalizerStandardize,
+    )
+
+    rng = np.random.default_rng(2)
+    ds = DataSet(rng.normal(size=(8, 4)).astype(np.float32),
+                 np.ones((8, 2), np.float32))
+    inner = CompositeDataSetPreProcessor(NormalizerStandardize())
+    nested = CompositeDataSetPreProcessor(inner)
+    nested.fit(ds)
+    with pytest.raises(ValueError, match="nested composites"):
+        ModelSerializer.writeModel(_tiny_model(),
+                                   str(tmp_path / "m.zip"),
+                                   normalizer=nested)
+
+
+def test_composite_normalizer_rejects_unknown_child(tmp_path):
+    from deeplearning4j_tpu.datasets.normalizers import (
+        CompositeDataSetPreProcessor, DataNormalization,
+        NormalizerStandardize,
+    )
+
+    class Custom(DataNormalization):
+        def fit(self, data):
+            pass
+
+        def transform(self, ds):
+            return ds
+
+        def state_dict(self):
+            return {}
+
+        def load_state_dict(self, d):
+            pass
+
+    comp = CompositeDataSetPreProcessor(NormalizerStandardize(), Custom())
+    rng = np.random.default_rng(3)
+    comp.fit(DataSet(rng.normal(size=(8, 4)).astype(np.float32),
+                     np.ones((8, 2), np.float32)))
+    with pytest.raises(ValueError, match="not a restorable"):
+        ModelSerializer.writeModel(_tiny_model(),
+                                   str(tmp_path / "m.zip"),
+                                   normalizer=comp)
+
+
+def test_kmeans_survives_transient_distortion_increase(monkeypatch):
+    """ADVICE r5: a transient distortion INCREASE (post empty-cluster
+    reseed) used to satisfy `prev - distortion <= eps` and end Lloyd
+    iterations early; convergence now requires a small NON-NEGATIVE
+    improvement."""
+    import deeplearning4j_tpu.clustering as cl
+
+    distortions = iter([10.0, 9.0, 9.5, 5.0, 5.0 - 1e-9])
+    centers = jnp.asarray(np.array([[0.0, 0.0], [4.0, 4.0]], np.float32))
+
+    def scripted_step(x, c, distance):
+        return jnp.zeros((x.shape[0],), jnp.int32), centers, \
+            jnp.asarray(next(distortions))
+
+    monkeypatch.setattr(cl, "_kmeans_step", scripted_step)
+    km = cl.KMeansClustering(2, max_iterations=10,
+                             min_distribution_variation_rate=1e-4)
+    pts = np.array([[0, 0], [0.1, 0], [4, 4], [4, 4.1]], np.float32)
+    km.applyTo(pts)
+    # iterations 1..2 improve, 3 bumps UP (reseed) and must NOT
+    # terminate, 4 improves, 5 converges on a tiny non-negative delta
+    assert km.iterations_done == 5
+
+
+def test_kmeans_still_converges_real_run():
+    from deeplearning4j_tpu.clustering import KMeansClustering
+
+    rng = np.random.default_rng(4)
+    pts = np.concatenate([rng.normal(0, 0.2, (40, 2)),
+                          rng.normal(5, 0.2, (40, 2))]).astype(np.float32)
+    km = KMeansClustering(2, max_iterations=50)
+    cs = km.applyTo(pts)
+    assert km.iterations_done < 50  # converged, didn't run out
+    got = sorted(c.center.mean() for c in cs.getClusters())
+    assert abs(got[0] - 0.0) < 0.5 and abs(got[1] - 5.0) < 0.5
