@@ -54,6 +54,19 @@ def main() -> None:
         TransformerEncoder, bert_base, tiny_config,
     )
 
+    import sys
+    if "--precision-ab" in sys.argv:
+        # precision A/B/C on the bert train bench: f32 vs the
+        # mixed_bfloat16 policy (fp32 masters, bf16 compute) vs naive
+        # full-bf16 — the acceptance number is mixed_speedup_vs_f32
+        from bench_common import precision_ab
+
+        on_accel = jax.devices()[0].platform in ("tpu", "gpu")
+        print(json.dumps(precision_ab(
+            "bert", steps=20 if on_accel else 2,
+            seq=128 if on_accel else 32)))
+        return
+
     platform = jax.devices()[0].platform
     on_accel = platform in ("tpu", "gpu")
     if on_accel:

@@ -10,16 +10,46 @@ read (block_until_ready returns early through the axon tunnel).
 
 from __future__ import annotations
 
+import logging
 import time
 
 import jax
 import jax.numpy as jnp
 
-PEAK_FLOPS = {"TPU v5 lite": 197e12}  # bf16 peak per chip
+log = logging.getLogger("deeplearning4j_tpu")
+
+#: per-chip peak FLOPs keyed by device kind AND compute dtype. A single
+#: bf16 number silently inflates (f32 workload / bf16 peak) or deflates
+#: MFU; the dtype key makes the denominator match the numerator's math.
+#: f32 on the v5e MXU runs at ~half bf16 rate (multi-pass emulation).
+PEAK_FLOPS = {
+    "TPU v5 lite": {"bf16": 197e12, "f32": 98.5e12},
+}
+
+_warned_unknown_peak = set()
 
 
-def peak_flops():
-    return PEAK_FLOPS.get(jax.devices()[0].device_kind)
+def peak_flops(dtype="bf16"):
+    """Peak FLOPs of device 0 for a compute dtype ("bf16"/"f32", any
+    DataType.from_any spelling). Unknown devices return None with a
+    logged warning — callers then skip MFU (the measured
+    cost_analysis FLOPs still get reported), rather than dividing by a
+    wrong peak and publishing a silently bogus MFU."""
+    from deeplearning4j_tpu.ndarray.dtypes import DataType
+
+    kind = jax.devices()[0].device_kind
+    entry = PEAK_FLOPS.get(kind)
+    if entry is None:
+        if kind not in _warned_unknown_peak:
+            _warned_unknown_peak.add(kind)
+            log.warning(
+                "no peak-FLOPs entry for device kind %r — MFU will be "
+                "omitted (cost_analysis FLOPs are still measured); add "
+                "the chip to bench_common.PEAK_FLOPS to enable it", kind)
+        return None
+    dt = DataType.from_any(dtype)
+    key = "bf16" if dt.width_bytes() == 2 else "f32"
+    return entry.get(key)
 
 
 def telemetry_snapshot():
@@ -65,13 +95,16 @@ def time_best_of(run, state, steps, trials=3):
 
 
 def build_char_lstm(batch=256, seq=200, hidden=256, vocab=77,
-                    dtype="bf16"):
+                    dtype="bf16", precision=None):
     """Build (run, state0, flops_per_step, tokens_per_step) for the
     char-LSTM workload so callers can either time it standalone
     (run_char_lstm) or interleave it with the frozen yardstick in
-    shared windows (bench.py _lstm_metrics)."""
+    shared windows (bench.py _lstm_metrics). ``precision`` sets a
+    mixed-precision policy (nn/precision.py) — with one, ``dtype`` is
+    ignored and params stay fp32 masters."""
     import numpy as np
 
+    from deeplearning4j_tpu.ndarray.dtypes import DataType
     from deeplearning4j_tpu.nn.multilayer.network import (
         MultiLayerNetwork,
     )
@@ -80,29 +113,39 @@ def build_char_lstm(batch=256, seq=200, hidden=256, vocab=77,
     model = TextGenerationLSTM(vocab_size=vocab, hidden=hidden,
                                tbptt_length=0)
     conf = model.conf()
-    conf.dtype = {"bf16": "bfloat16", "f32": "float32"}[dtype]
+    if precision is not None:
+        conf.precision = precision
+    else:
+        conf.dtype = DataType.from_any(dtype).value
     net = MultiLayerNetwork(conf).init()
 
     rng = np.random.default_rng(0)
     ids = rng.integers(0, vocab, (batch, seq))
     x = jax.device_put(jnp.asarray(
-        np.eye(vocab, dtype=np.float32)[ids], net._dtype))
+        np.eye(vocab, dtype=np.float32)[ids], net._input_dtype))
     y = jax.device_put(jnp.asarray(
         np.eye(vocab, dtype=np.float32)[np.roll(ids, -1, 1)],
-        net._dtype))
+        net._input_dtype))
     step = net._get_train_step(has_mask=False)
-    flops_per_step = aot_cost_flops(
-        step, net.params_list, net.states_list, net.opt_states,
-        jnp.asarray(0), jnp.asarray(0), x, y, None, None,
-        jax.random.key(0))
+    scaling = net._loss_scale_state is not None
+
+    def step_args(state, i):
+        base = (state[0], state[1], state[2])
+        ls = (state[3],) if scaling else ()
+        return base + ls + (jnp.asarray(i), jnp.asarray(0), x, y, None,
+                            None, jax.random.key(i))
+
+    flops_per_step = aot_cost_flops(step, *step_args(
+        (net.params_list, net.states_list, net.opt_states,
+         net._loss_scale_state), 0))
 
     def run(state, i):
-        p, s, o, loss = step(state[0], state[1], state[2],
-                             jnp.asarray(i), jnp.asarray(0), x, y, None,
-                             None, jax.random.key(i))
-        return (p, s, o), loss
+        out = step(*step_args(state, i))
+        # (p, s, o[, ls], loss) -> state tuple + loss
+        return out[:-1], out[-1]
 
-    state0 = (net.params_list, net.states_list, net.opt_states)
+    state0 = (net.params_list, net.states_list, net.opt_states) \
+        + ((net._loss_scale_state,) if scaling else ())
     return run, state0, flops_per_step, batch * seq
 
 
@@ -158,7 +201,7 @@ def pipeline_ab_lstm(batch=64, hidden=128, vocab=50, n_batches=12,
             it = pf = DevicePrefetchIterator(
                 it, depth=depth,
                 policy=BatchShapePolicy("bucket", batch_size=batch),
-                dtype=net._dtype)
+                dtype=net._input_dtype)
         try:
             c0 = compiles()
             t0 = time.perf_counter()
@@ -194,7 +237,7 @@ def pipeline_ab_fixed(net, make_iter, depth=2, epochs=1):
     float(net.score())
     out["pipeline_off_s"] = round(time.perf_counter() - t0, 4)
     with DevicePrefetchIterator(make_iter(), depth=depth,
-                                dtype=net._dtype) as pf:
+                                dtype=net._input_dtype) as pf:
         t0 = time.perf_counter()
         net.fit(pf, epochs=epochs)
         float(net.score())
@@ -205,14 +248,185 @@ def pipeline_ab_fixed(net, make_iter, depth=2, epochs=1):
 
 
 def run_char_lstm(batch=256, seq=200, hidden=256, vocab=77, steps=10,
-                  dtype="bf16"):
+                  dtype="bf16", precision=None):
     """Char-LSTM train-step benchmark (BASELINE.md "Char-RNN LSTM"
     row, the CudnnLSTMHelper role — SURVEY.md §2.9). Returns
     tokens/sec, measured per-step FLOPs (or None), and first loss."""
     run, state0, flops_per_step, tokens_per_step = build_char_lstm(
-        batch=batch, seq=seq, hidden=hidden, vocab=vocab, dtype=dtype)
+        batch=batch, seq=seq, hidden=hidden, vocab=vocab, dtype=dtype,
+        precision=precision)
     best = time_best_of(run, state0, steps)
     return {"tokens_per_sec": tokens_per_step * steps / best,
             "flops_per_step": flops_per_step,
             "tokens_per_step": tokens_per_step,
             "telemetry": telemetry_snapshot()}
+
+
+def _verify_master_dtypes(params_tree, opt_tree, expect="float32"):
+    """Every floating param leaf must be the master dtype — the A/B
+    below refuses to report a 'mixed' speedup whose params silently
+    leaked to bf16 (that would be the naive mode). Opt-state is pinned
+    only for fp32 masters: naive low-precision configs deliberately
+    keep f32 accumulators (updaters._zeros_f32)."""
+    bad = []
+    trees = [("param", params_tree)]
+    if expect == "float32":
+        trees.append(("opt", opt_tree))
+    for tag, tree in trees:
+        for leaf in jax.tree_util.tree_leaves(tree):
+            dt = getattr(leaf, "dtype", None)
+            if dt is not None and jnp.issubdtype(dt, jnp.floating) \
+                    and str(dt) != expect:
+                bad.append(f"{tag}:{dt}")
+    return sorted(set(bad))
+
+
+def precision_ab(workload="lstm", steps=10, batch=None, seq=128,
+                 policies=("float32", "mixed_bfloat16", "bfloat16"),
+                 **kw):
+    """Precision A/B/C on one workload: full-f32 vs the mixed_bfloat16
+    POLICY (fp32 masters, bf16 compute) vs naive full-bf16 (params and
+    updates downcast — fast but unprotected; the pre-policy benches'
+    mode). Workloads: "lstm" (char-LSTM MultiLayerNetwork), "resnet"
+    (zoo ResNet-50 ComputationGraph), "bert" (models TransformerEncoder
+    MLM step).
+
+    Fresh identically-seeded model per side; device-resident inputs;
+    best-of-3 windows via time_best_of. Per side reports steps/sec and
+    the verified master param/opt dtypes; top-level ratios
+    ``mixed_speedup_vs_f32`` (the acceptance number — the policy's win
+    with fp32 protection intact) and ``naive_speedup_vs_f32`` (the
+    unprotected ceiling it should approach)."""
+    import numpy as np
+
+    sides = {}
+    for pol in policies:
+        mixed = str(pol).startswith("mixed")
+        expect_master = "float32" if (mixed or pol == "float32") \
+            else str(jnp.dtype(pol))
+
+        if workload == "lstm":
+            b = batch or 256
+            run, state0, flops, _tok = build_char_lstm(
+                batch=b, seq=seq, precision=pol if mixed else None,
+                dtype="f32" if pol == "float32" else pol, **kw)
+            params_opt = (state0[0], state0[2])
+        elif workload == "resnet":
+            from deeplearning4j_tpu.nn.graph.graph import ComputationGraph
+            from deeplearning4j_tpu.zoo.resnet50 import ResNet50
+
+            b = batch or 64
+            classes = kw.get("classes", 1000)
+            conf = ResNet50(num_classes=classes,
+                            in_shape=kw.get("in_shape", (224, 224, 3))
+                            ).conf()
+            if mixed:
+                conf.precision = pol
+            else:
+                conf.dtype = str(jnp.dtype(pol)) if pol != "float32" \
+                    else "float32"
+            net = ComputationGraph(conf).init()
+            rng = np.random.default_rng(0)
+            h, w, c = kw.get("in_shape", (224, 224, 3))
+            x = jax.device_put(jnp.asarray(
+                rng.normal(0, 1, (b, h, w, c)), net._input_dtype))
+            y = jax.device_put(jnp.asarray(
+                np.eye(classes, dtype=np.float32)[
+                    rng.integers(0, classes, b)]))
+            inputs = {conf.network_inputs[0]: x}
+            labels = {conf.network_outputs[0]: y}
+            step = net._get_train_step()
+            scaling = net._loss_scale_state is not None
+
+            def step_args(state, i, _in=inputs, _lb=labels,
+                          _scaling=scaling):
+                base = (state[0], state[1], state[2])
+                ls = (state[3],) if _scaling else ()
+                return base + ls + (jnp.asarray(i), jnp.asarray(0),
+                                    _in, _lb, {}, {}, jax.random.key(i))
+
+            flops = aot_cost_flops(step, *step_args(
+                (net.params_map, net.states_map, net.opt_states,
+                 net._loss_scale_state), 0))
+
+            def run(state, i, _step=step, _args=step_args):
+                out = _step(*_args(state, i))
+                return out[:-1], out[-1]
+
+            state0 = (net.params_map, net.states_map, net.opt_states) \
+                + ((net._loss_scale_state,) if scaling else ())
+            params_opt = (state0[0], state0[2])
+        elif workload == "bert":
+            from deeplearning4j_tpu.learning.updaters import Adam
+            from deeplearning4j_tpu.models.transformer import (
+                TransformerEncoder, bert_base, tiny_config,
+            )
+
+            on_accel = jax.devices()[0].platform in ("tpu", "gpu")
+            cfg = bert_base() if on_accel else tiny_config(
+                vocab=1024, max_len=seq, d_model=128, n_layers=2,
+                n_heads=4, d_ff=512)
+            # policy mapping onto the encoder's param/compute split:
+            # f32 = (f32, f32); mixed_bf16 = (f32, bf16);
+            # naive = (dt, dt). The encoder has no loss-scaling path,
+            # so a mixed_float16 side would really be a bf16 run
+            # reported under the f16 label — refuse instead
+            if pol == "float32":
+                cfg.dtype, cfg.compute_dtype = "float32", "float32"
+            elif pol == "mixed_bfloat16":
+                cfg.dtype, cfg.compute_dtype = "float32", "bfloat16"
+            elif mixed:
+                raise ValueError(
+                    f"precision_ab('bert') does not support {pol!r}: "
+                    "TransformerEncoder has no dynamic-loss-scaling "
+                    "path (use the lstm/resnet workloads for "
+                    "mixed_float16)")
+            else:
+                cfg.dtype = cfg.compute_dtype = str(jnp.dtype(pol))
+            expect_master = cfg.dtype
+            b = batch or (96 if on_accel else 8)
+            model = TransformerEncoder(cfg)
+            updater = Adam(1e-4)
+            step = model.make_train_step(updater)
+            rng = jax.random.key(0)
+            params = model.init_params(rng)
+            opt = updater.init_state(params)
+            ids = jax.random.randint(rng, (b, seq), 0, cfg.vocab_size)
+            lbl = jax.random.randint(rng, (b, seq), 0, cfg.vocab_size)
+            rs = np.random.RandomState(0)
+            m = np.zeros((b, seq), np.float32)
+            for r in range(b):
+                m[r, rs.choice(seq, min(19, seq - 1),
+                               replace=False)] = 1.0
+            mask_pos = jnp.asarray(m)
+            flops = aot_cost_flops(step, params, opt, jnp.asarray(0),
+                                   ids, lbl, mask_pos, rng)
+
+            def run(state, i, _step=step, _ids=ids, _lbl=lbl,
+                    _m=mask_pos, _rng=rng):
+                p, o, loss = _step(state[0], state[1], jnp.asarray(i),
+                                   _ids, _lbl, _m, _rng)
+                return (p, o), loss
+
+            state0 = (params, opt)
+            params_opt = (params, opt)
+        else:
+            raise ValueError(f"unknown precision_ab workload {workload!r}")
+
+        best = time_best_of(run, state0, steps)
+        bad = _verify_master_dtypes(*params_opt, expect=expect_master)
+        sides[str(pol)] = {
+            "steps_per_sec": round(steps / best, 4),
+            "flops_per_step": flops,
+            "master_dtype": expect_master,
+            "dtype_leaks": bad,   # must be [] — see _verify_master_dtypes
+        }
+
+    out = {"workload": workload, "sides": sides}
+    f32 = sides.get("float32", {}).get("steps_per_sec")
+    for name, key in (("mixed_speedup_vs_f32", "mixed_bfloat16"),
+                      ("naive_speedup_vs_f32", "bfloat16")):
+        if f32 and key in sides:
+            out[name] = round(sides[key]["steps_per_sec"] / f32, 4)
+    out["telemetry"] = telemetry_snapshot()
+    return out

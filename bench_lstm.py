@@ -24,7 +24,17 @@ def main():
     ap.add_argument("--hidden", type=int, default=256)
     ap.add_argument("--vocab", type=int, default=77)
     ap.add_argument("--steps", type=int, default=10)
-    ap.add_argument("--dtype", default="bf16", choices=["bf16", "f32"])
+    ap.add_argument("--dtype", default="bf16",
+                    choices=["bf16", "f32", "fp16"])
+    ap.add_argument("--precision", default=None,
+                    choices=[None, "float32", "mixed_bfloat16",
+                             "mixed_float16"],
+                    help="mixed-precision policy (fp32 master weights; "
+                         "overrides --dtype)")
+    ap.add_argument("--precision-ab", action="store_true",
+                    help="run the precision A/B/C (f32 vs "
+                         "mixed_bfloat16 policy vs naive full-bf16) "
+                         "and report mixed/naive speedups vs f32")
     ap.add_argument("--pipeline-ab", action="store_true",
                     help="also run the device input-pipeline A/B on a "
                          "ragged stream (bucketing + async prefetch "
@@ -32,9 +42,18 @@ def main():
                          "per-side compile counts")
     args = ap.parse_args()
 
+    if args.precision_ab:
+        from bench_common import precision_ab
+
+        print(json.dumps(precision_ab(
+            "lstm", steps=args.steps, batch=args.batch, seq=args.seq,
+            hidden=args.hidden, vocab=args.vocab)))
+        return
+
     r = run_char_lstm(batch=args.batch, seq=args.seq,
                       hidden=args.hidden, vocab=args.vocab,
-                      steps=args.steps, dtype=args.dtype)
+                      steps=args.steps, dtype=args.dtype,
+                      precision=args.precision)
     tok_s = r["tokens_per_sec"]
     out = {"metric": "char_lstm_train", "value": round(tok_s, 1),
            "unit": "tokens/sec/chip", "batch": args.batch,
@@ -43,7 +62,16 @@ def main():
         flops_tok = r["flops_per_step"] / r["tokens_per_step"]
         out["tflops"] = round(tok_s * flops_tok / 1e12, 2)
         out["flops_src"] = "cost_analysis"
-        peak = peak_flops()
+        # MFU denominator matches the COMPUTE dtype (mixed policies
+        # compute in bf16 even though params are f32) — resolved by
+        # the policy itself, not a hand map
+        if args.precision is not None:
+            from deeplearning4j_tpu.nn.precision import PrecisionPolicy
+
+            compute_dt = PrecisionPolicy.of(args.precision).compute_dtype
+        else:
+            compute_dt = args.dtype
+        peak = peak_flops(compute_dt)
         if peak:
             out["mfu"] = round(tok_s * flops_tok / peak, 4)
     else:

@@ -77,6 +77,10 @@ def main():
     ap.add_argument("--classes", type=int, default=1000)
     ap.add_argument("--hlo", action="store_true",
                     help="dump optimized HLO to /tmp/resnet_step.hlo")
+    ap.add_argument("--precision-ab", action="store_true",
+                    help="run the precision A/B/C (f32 vs "
+                         "mixed_bfloat16 policy vs naive full-bf16) "
+                         "and report mixed/naive speedups vs f32")
     ap.add_argument("--pipeline-ab", action="store_true",
                     help="also A/B the device input pipeline (async "
                          "prefetch + double-buffered transfers) over a "
@@ -86,6 +90,14 @@ def main():
     ap.add_argument("--pipeline-batches", type=int, default=8,
                     help="minibatches per epoch in the pipeline A/B")
     args = ap.parse_args()
+
+    if args.precision_ab:
+        from bench_common import precision_ab
+
+        print(json.dumps(precision_ab(
+            "resnet", steps=args.steps, batch=args.batch,
+            classes=args.classes)))
+        return
 
     net = build(args.classes, args.dtype, args.no_bn, args.no_l2)
     dt = net._dtype
@@ -171,7 +183,7 @@ def main():
         per_img = None
         flops_src = None
     from bench_common import peak_flops
-    peak = peak_flops()
+    peak = peak_flops(args.dtype)
     out = {"mode": args.mode, "dtype": args.dtype, "batch": args.batch,
            "no_bn": args.no_bn, "no_l2": args.no_l2,
            "img_per_sec": round(img_s, 1)}

@@ -33,6 +33,16 @@ def _tmap(f, *trees):
     return jax.tree_util.tree_map(f, *trees)
 
 
+def _step_float(t):
+    """Bias-correction step count as an f32 scalar — whether ``t`` is a
+    traced device scalar (jit step counter) or a plain python int. Kept
+    f32 so ``1 - beta**t`` is computed at full precision even when
+    params/grads are bf16/f16 (a half-precision power underflows within
+    a few hundred steps and silently de-biases the moments)."""
+    return t.astype(jnp.float32) if hasattr(t, "astype") \
+        else jnp.float32(float(t))
+
+
 def _zeros_f32(p):
     # optimizer accumulators are kept in at-least-float32 even for
     # bf16/f16 params: update math stays full-precision and jit
@@ -132,8 +142,9 @@ class Adam(IUpdater):
         lr = self._lr(step)
         t = step + 1
         m, v = self._moments(state, grads)
-        bc1 = 1 - jnp.power(self.beta1, t.astype(jnp.float32) if hasattr(t, "astype") else float(t))
-        bc2 = 1 - jnp.power(self.beta2, t.astype(jnp.float32) if hasattr(t, "astype") else float(t))
+        tf = _step_float(t)
+        bc1 = 1 - jnp.power(self.beta1, tf)
+        bc2 = 1 - jnp.power(self.beta2, tf)
         alpha = lr * jnp.sqrt(bc2) / bc1
         updates = _tmap(lambda m_, v_: alpha * m_ / (jnp.sqrt(v_) + self.epsilon), m, v)
         return updates, {"m": m, "v": v}
@@ -162,7 +173,7 @@ class AdaMax(Adam):
         t = step + 1
         m = _tmap(lambda m, g: self.beta1 * m + (1 - self.beta1) * g, state["m"], grads)
         u = _tmap(lambda v, g: jnp.maximum(self.beta2 * v, jnp.abs(g)), state["v"], grads)
-        bc1 = 1 - jnp.power(self.beta1, t.astype(jnp.float32) if hasattr(t, "astype") else float(t))
+        bc1 = 1 - jnp.power(self.beta1, _step_float(t))
         updates = _tmap(lambda m_, u_: (lr / bc1) * m_ / (u_ + self.epsilon), m, u)
         return updates, {"m": m, "v": u}
 
@@ -173,7 +184,7 @@ class Nadam(Adam):
     def apply(self, state, grads, step):
         lr = self._lr(step)
         t = step + 1
-        tf = t.astype(jnp.float32) if hasattr(t, "astype") else float(t)
+        tf = _step_float(t)
         m, v = self._moments(state, grads)
         bc1 = 1 - jnp.power(self.beta1, tf)
         bc2 = 1 - jnp.power(self.beta2, tf)
@@ -196,7 +207,7 @@ class AMSGrad(Adam):
     def apply(self, state, grads, step):
         lr = self._lr(step)
         t = step + 1
-        tf = t.astype(jnp.float32) if hasattr(t, "astype") else float(t)
+        tf = _step_float(t)
         m, v = self._moments(state, grads)
         vhat = _tmap(jnp.maximum, state["vhat"], v)
         bc1 = 1 - jnp.power(self.beta1, tf)

@@ -55,14 +55,38 @@ class DataType(enum.Enum):
 
     @staticmethod
     def from_any(dtype) -> "DataType":
-        """Coerce a DataType / jax dtype / numpy dtype / string to DataType."""
+        """Coerce a DataType / jax dtype / numpy dtype / string to
+        DataType. Strings also accept the common short aliases
+        ("bf16", "fp16", "half", "f32", ...) so every CLI/bench/config
+        shares ONE spelling table instead of hand-rolled maps."""
         if isinstance(dtype, DataType):
             return dtype
+        if isinstance(dtype, str):
+            alias = _DTYPE_ALIASES.get(dtype.strip().lower())
+            if alias is not None:
+                return alias
         name = jnp.dtype(dtype).name
         for dt in DataType:
             if dt.value == name:
                 return dt
         raise ValueError(f"Unsupported dtype: {dtype!r}")
+
+
+#: short-form spellings accepted by from_any (benches, configs, CLIs)
+_DTYPE_ALIASES = {
+    "bf16": DataType.BFLOAT16,
+    "fp16": DataType.HALF,
+    "f16": DataType.HALF,
+    "half": DataType.HALF,
+    "f32": DataType.FLOAT,
+    "fp32": DataType.FLOAT,
+    # NOTE: no "float" entry — numpy's 'float' means float64 and
+    # from_any must keep that long-standing behavior
+    "single": DataType.FLOAT,
+    "f64": DataType.DOUBLE,
+    "fp64": DataType.DOUBLE,
+    "double": DataType.DOUBLE,
+}
 
 
 _FLOATS = {DataType.DOUBLE, DataType.FLOAT, DataType.HALF, DataType.BFLOAT16}

@@ -1,2 +1,6 @@
 """Neural network framework (reference: deeplearning4j/deeplearning4j-nn —
 config system, layers, MultiLayerNetwork, ComputationGraph)."""
+
+from deeplearning4j_tpu.nn.precision import PrecisionPolicy
+
+__all__ = ["PrecisionPolicy"]

@@ -65,6 +65,10 @@ class MultiLayerConfiguration:
     l1: float = 0.0
     l2: float = 0.0
     dtype: str = "float32"
+    #: mixed-precision policy: None (legacy single-dtype mode driven by
+    #: ``dtype``), a preset name ("float32" / "mixed_bfloat16" /
+    #: "mixed_float16"), or a nn.precision.PrecisionPolicy
+    precision: Optional[Any] = None
     input_type: Optional[InputType] = None
     #: layer index -> preprocessor tag ("flatten" | "to_conv:H,W,C")
     preprocessors: Dict = dataclasses.field(default_factory=dict)
@@ -102,6 +106,7 @@ class Builder:
         self._l1 = 0.0
         self._l2 = 0.0
         self._dtype = "float32"
+        self._precision = None
         self._dropout = None
         self._activation = None
         self._grad_norm = None
@@ -134,6 +139,15 @@ class Builder:
 
     def dataType(self, dt) -> "Builder":
         self._dtype = dt.value if hasattr(dt, "value") else str(dt)
+        return self
+
+    def precision(self, policy) -> "Builder":
+        """Mixed-precision policy: "float32", "mixed_bfloat16",
+        "mixed_float16", or a PrecisionPolicy (nn/precision.py).
+        Orthogonal to dataType(): a mixed policy keeps MASTER params in
+        its param_dtype (fp32) and only the per-step compute drops to
+        bf16/f16."""
+        self._precision = policy
         return self
 
     def dropOut(self, keep: float) -> "Builder":
@@ -309,6 +323,7 @@ class ListBuilder:
             l1=p._l1,
             l2=p._l2,
             dtype=p._dtype,
+            precision=p._precision,
             input_type=self._input_type,
             preprocessors=preprocessors,
             gradient_normalization=p._grad_norm,

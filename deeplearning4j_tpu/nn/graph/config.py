@@ -48,6 +48,9 @@ class ComputationGraphConfiguration:
     l1: float = 0.0
     l2: float = 0.0
     dtype: str = "float32"
+    #: mixed-precision policy (None = legacy single-dtype mode; see
+    #: nn/precision.py and MultiLayerConfiguration.precision)
+    precision: Optional[Any] = None
     gradient_normalization: Optional[str] = None
     gradient_normalization_threshold: float = 1.0
 
@@ -76,6 +79,7 @@ class GraphBuilder:
         self._l1 = 0.0
         self._l2 = 0.0
         self._dtype = "float32"
+        self._precision = None
         self._grad_norm = None
         self._grad_norm_t = 1.0
 
@@ -102,6 +106,12 @@ class GraphBuilder:
 
     def dataType(self, dt):
         self._dtype = dt.value if hasattr(dt, "value") else str(dt)
+        return self
+
+    def precision(self, policy):
+        """Mixed-precision policy (preset name or PrecisionPolicy) —
+        see MultiLayerConfiguration.precision."""
+        self._precision = policy
         return self
 
     def gradientNormalization(self, mode, threshold=1.0):
@@ -214,6 +224,7 @@ class GraphBuilder:
             l1=self._l1,
             l2=self._l2,
             dtype=self._dtype,
+            precision=self._precision,
             gradient_normalization=self._grad_norm,
             gradient_normalization_threshold=self._grad_norm_t,
         )

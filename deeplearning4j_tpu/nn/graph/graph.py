@@ -23,6 +23,7 @@ from deeplearning4j_tpu.datasets.iterator import DataSetIterator
 from deeplearning4j_tpu.learning.updaters import apply_updater
 from deeplearning4j_tpu.ndarray.dtypes import DataType
 from deeplearning4j_tpu.ndarray.ndarray import NDArray, _unwrap
+from deeplearning4j_tpu.nn import precision as _precision
 from deeplearning4j_tpu.nn.conf.constraint import apply_constraints
 from deeplearning4j_tpu.nn.graph.config import ComputationGraphConfiguration
 from deeplearning4j_tpu.nn.graph.vertices import LayerVertex
@@ -50,7 +51,19 @@ class ComputationGraph:
         self._rnn_carries = None    # stateful rnnTimeStep hidden state
         self._rnn_batch = 0
         self._node_index = None
-        self._dtype = DataType.from_any(conf.dtype).jax
+        # mixed-precision policy (nn/precision.py) — see the
+        # MultiLayerNetwork sibling for the design notes
+        self._policy = _precision.PrecisionPolicy.resolve(
+            getattr(conf, "precision", None), conf.dtype)
+        self._mixed = not self._policy.is_identity
+        self._dtype = DataType.from_any(self._policy.param_dtype).jax
+        self._input_dtype = DataType.from_any(
+            self._policy.compute_dtype).jax
+        self._out_dtype = DataType.from_any(
+            self._policy.output_dtype).jax
+        self._compute_dtypes: Dict[str, Any] = {}
+        self._loss_scale_state = None
+        self._ls_seen = (0, 0)
 
     # ------------------------------------------------------------------
     def init(self) -> "ComputationGraph":
@@ -81,6 +94,19 @@ class ComputationGraph:
             types[node.name] = node.vertex.output_type(in_types)
         self._types = types
         self._rng_key = jax.random.key(conf.seed + 7919)
+        # per-vertex compute dtypes (loss heads / normalization stay
+        # fp32 under mixed policies; non-layer vertices follow the
+        # policy compute dtype)
+        self._compute_dtypes = {
+            node.name: self._policy.layer_compute_dtype(
+                getattr(node.vertex, "layer", None), node.name)
+            for node in conf.nodes}
+        self._loss_scale_state = _precision.init_loss_scale(self._policy)
+        self._ls_seen = (0, 0)
+        if self._mixed:
+            _precision.record_cast_count("cg", sum(
+                _precision.count_casts(p, self._compute_dtypes[n])
+                for n, p in self.params_map.items()))
         return self
 
     def _check_init(self):
@@ -91,6 +117,21 @@ class ComputationGraph:
         if self._node_index is None:
             self._node_index = {n.name: n for n in self.conf.nodes}
         return self._node_index[name]
+
+    # -- mixed-precision seams (identity policies: strict no-ops) ------
+    def _cast_p(self, p, name):
+        """Cast one vertex's MASTER params to its compute dtype (inside
+        jit: one cast per step; vjp returns fp32 master grads)."""
+        return _precision.cast_tree(p, self._compute_dtypes[name]) \
+            if self._mixed else p
+
+    def _cast_xs(self, xs, name):
+        """Cast the activations entering a vertex (fp32 islands cast
+        up; the next reduced-precision consumer casts back down)."""
+        if not self._mixed:
+            return xs
+        dt = self._compute_dtypes[name]
+        return [_precision.cast_leaf(a, dt) for a in xs]
 
     def _downstream_of(self, source: str) -> set:
         """Names of nodes reachable from `source` (an input or node) —
@@ -146,7 +187,8 @@ class ComputationGraph:
         keys = (jax.random.split(rng, len(conf.nodes))
                 if rng is not None else [None] * len(conf.nodes))
         for i, node in enumerate(conf.nodes):
-            xs = [acts[s] for s in node.inputs]
+            xs = self._cast_xs([acts[s] for s in node.inputs], node.name)
+            p_n = self._cast_p(params_map[node.name], node.name)
             v = node.vertex
             if fmask is not None and node.name in masked_branch \
                     and isinstance(v, LayerVertex) \
@@ -165,11 +207,10 @@ class ComputationGraph:
                         "mask matching the pooled sequence length "
                         "(reference: MaskedReductionUtil).")
                 out, ns = v.layer.apply_masked(
-                    params_map[node.name], states_map[node.name], xs[0],
+                    p_n, states_map[node.name], xs[0],
                     fmask, train, keys[i])
             else:
-                out, ns = v.apply(params_map[node.name],
-                                  states_map[node.name], xs, train,
+                out, ns = v.apply(p_n, states_map[node.name], xs, train,
                                   keys[i])
             acts[node.name] = out
             new_states[node.name] = ns
@@ -200,9 +241,12 @@ class ComputationGraph:
                 if rng is not None else [None] * len(conf.nodes))
         total = jnp.asarray(0.0, jnp.float32)
         for i, node in enumerate(conf.nodes):
-            xs = [acts[s] for s in node.inputs]
+            xs = self._cast_xs([acts[s] for s in node.inputs], node.name)
             v = node.vertex
-            p_i = params_map[node.name]
+            # fp32 master params -> per-vertex compute dtype (loss
+            # heads stay fp32, so the loss + reduction run at full
+            # precision under mixed policies)
+            p_i = self._cast_p(params_map[node.name], node.name)
             k_i = keys[i]
             # weight noise (reference: IWeightNoise, conf/weightnoise/**)
             wn = getattr(getattr(v, "layer", None), "weight_noise", None)
@@ -286,14 +330,10 @@ class ComputationGraph:
         if cache_key in self._step_cache:
             return self._step_cache[cache_key]
 
-        def step_fn(params_map, states_map, opt_states, it_step, ep_step,
-                    inputs, labels_map, masks_map, fmasks_map, rng):
-            loss_fn = lambda pm: self._loss(pm, states_map, inputs,
-                                            labels_map, rng, masks_map,
-                                            fmasks_map)
-            (loss, (new_states, data_loss)), grads = \
-                jax.value_and_grad(loss_fn, has_aux=True)(params_map)
-            grads = self._clip(grads)
+        policy = self._policy
+
+        def apply_updates(params_map, opt_states, grads, it_step,
+                          ep_step):
             new_params, new_opt = {}, {}
             for name in params_map:
                 step = (ep_step if _uses_epoch_schedule(self._updaters[name])
@@ -308,6 +348,45 @@ class ComputationGraph:
                 new_params[name] = apply_constraints(lay, np_i) \
                     if lay is not None else np_i
                 new_opt[name] = no
+            return new_params, new_opt
+
+        if policy.loss_scaling:
+            # mixed_float16: scaled loss, fp32 unscale, skip-and-halve
+            # on overflow (see MultiLayerNetwork._get_train_step)
+            def step_fn(params_map, states_map, opt_states, ls_state,
+                        it_step, ep_step, inputs, labels_map, masks_map,
+                        fmasks_map, rng):
+                loss_fn = lambda pm: self._loss(pm, states_map, inputs,
+                                                labels_map, rng,
+                                                masks_map, fmasks_map)
+                ((loss, (new_states, data_loss)), grads,
+                 finite) = _precision.scaled_value_and_grad(
+                    loss_fn, ls_state, params_map)
+                grads = self._clip(grads)
+                new_params, new_opt = apply_updates(
+                    params_map, opt_states, grads, it_step, ep_step)
+                (new_params, new_opt, new_states,
+                 new_ls) = _precision.guard_scaled_step(
+                    policy, ls_state, finite,
+                    [(new_params, params_map), (new_opt, opt_states),
+                     (new_states, states_map)])
+                return new_params, new_states, new_opt, new_ls, data_loss
+
+            jitted = _telemetry.instrument_jit(
+                "cg_step", jax.jit(step_fn, donate_argnums=(0, 1, 2, 3)))
+            self._step_cache[cache_key] = jitted
+            return jitted
+
+        def step_fn(params_map, states_map, opt_states, it_step, ep_step,
+                    inputs, labels_map, masks_map, fmasks_map, rng):
+            loss_fn = lambda pm: self._loss(pm, states_map, inputs,
+                                            labels_map, rng, masks_map,
+                                            fmasks_map)
+            (loss, (new_states, data_loss)), grads = \
+                jax.value_and_grad(loss_fn, has_aux=True)(params_map)
+            grads = self._clip(grads)
+            new_params, new_opt = apply_updates(
+                params_map, opt_states, grads, it_step, ep_step)
             return new_params, new_states, new_opt, data_loss
 
         jitted = _telemetry.instrument_jit(
@@ -378,11 +457,12 @@ class ComputationGraph:
                 f"{conf.network_outputs}")
         raw_xs = [_unwrap(x) for x in xs]
         if raw_xs and all(isinstance(x, jax.Array)
-                          and x.dtype == self._dtype for x in raw_xs):
+                          and x.dtype == self._input_dtype
+                          for x in raw_xs):
             # device-prefetched batch: jnp.asarray below is a no-op
             # (same array object), no host->device copy happens
             _telemetry.record_on_device_batch("cg")
-        inputs = {n: jnp.asarray(x, self._dtype)
+        inputs = {n: jnp.asarray(x, self._input_dtype)
                   for n, x in zip(conf.network_inputs, raw_xs)}
         labels = {n: jnp.asarray(_unwrap(y))
                   for n, y in zip(conf.network_outputs, ys)}
@@ -401,10 +481,19 @@ class ComputationGraph:
         self._rng_key, sub = jax.random.split(self._rng_key)
         step = self._get_train_step(frozenset(masks), frozenset(fmasks))
         t_step = time.perf_counter()
-        (self.params_map, self.states_map, self.opt_states, loss) = step(
-            self.params_map, self.states_map, self.opt_states,
-            jnp.asarray(self._iteration), jnp.asarray(self._epoch),
-            inputs, labels, masks, fmasks, sub)
+        if self._loss_scale_state is not None:
+            (self.params_map, self.states_map, self.opt_states,
+             self._loss_scale_state, loss) = step(
+                self.params_map, self.states_map, self.opt_states,
+                self._loss_scale_state, jnp.asarray(self._iteration),
+                jnp.asarray(self._epoch), inputs, labels, masks, fmasks,
+                sub)
+        else:
+            (self.params_map, self.states_map, self.opt_states,
+             loss) = step(
+                self.params_map, self.states_map, self.opt_states,
+                jnp.asarray(self._iteration), jnp.asarray(self._epoch),
+                inputs, labels, masks, fmasks, sub)
         # dispatch-side host timing (the step itself runs async on
         # device; blocking here would stall the pipeline)
         _telemetry.record_phase("device_step", t_step)
@@ -414,6 +503,9 @@ class ComputationGraph:
         self._last_batch_size = int(
             next(iter(inputs.values())).shape[0]) if inputs else 0
         _telemetry.sample_device_memory()
+        if self._loss_scale_state is not None:
+            self._ls_seen = _precision.record_loss_scale(
+                "cg", self._loss_scale_state, self._ls_seen)
         if self._listeners:
             t_l = time.perf_counter()
             for l in self._listeners:
@@ -449,14 +541,18 @@ class ComputationGraph:
                 if nd.name == name:
                     break
                 acts[nd.name], _ = nd.vertex.apply(
-                    params_map[nd.name], states_map[nd.name],
-                    [acts[s] for s in nd.inputs], False, None)
+                    self._cast_p(params_map[nd.name], nd.name),
+                    states_map[nd.name],
+                    self._cast_xs([acts[s] for s in nd.inputs], nd.name),
+                    False, None)
             x = acts[node.inputs[0]]
 
             def loss_fn(p):
                 if layer.weight_noise is not None and rng is not None:
                     p = layer.weight_noise.apply(p, rng)
-                loss = layer.unsupervised_loss(p, x, rng)
+                loss = layer.unsupervised_loss(
+                    self._cast_p(p, name),
+                    self._cast_xs([x], name)[0], rng)
                 # fit()-consistent l1/l2 on the pretrained layer
                 for k, v in p.items():
                     if k in _REGULARIZED_KEYS:
@@ -513,7 +609,7 @@ class ComputationGraph:
                     raise ValueError(
                         f"expected {len(conf.network_inputs)} input "
                         f"arrays, got {len(xs)}")
-                inputs = {n: jnp.asarray(_unwrap(x), self._dtype)
+                inputs = {n: jnp.asarray(_unwrap(x), self._input_dtype)
                           for n, x in zip(conf.network_inputs, xs)}
                 self._rng_key, sub = jax.random.split(self._rng_key)
                 (self.params_map[name], self.opt_states[name],
@@ -546,19 +642,23 @@ class ComputationGraph:
         acts = dict(inputs)
         new_carries = {}
         for node in self.conf.nodes:
-            xs = [acts[s] for s in node.inputs]
+            xs = self._cast_xs([acts[s] for s in node.inputs], node.name)
+            p_n = self._cast_p(params_map[node.name], node.name)
             lay = getattr(node.vertex, "layer", None)
             if lay is not None and lay.is_recurrent:
                 out, _, c = lay.apply_with_carry(
-                    params_map[node.name], states_map[node.name],
+                    p_n, states_map[node.name],
                     carries[node.name], xs[0], False, None)
                 new_carries[node.name] = c
             else:
-                out, _ = node.vertex.apply(params_map[node.name],
-                                           states_map[node.name], xs,
-                                           False, None)
+                out, _ = node.vertex.apply(p_n, states_map[node.name],
+                                           xs, False, None)
             acts[node.name] = out
-        return [acts[o] for o in self.conf.network_outputs], new_carries
+        outs = [acts[o] for o in self.conf.network_outputs]
+        if self._mixed:
+            outs = [_precision.cast_leaf(o, self._out_dtype)
+                    for o in outs]
+        return outs, new_carries
 
     def rnnTimeStep(self, *xs) -> List[NDArray]:
         """One (or more) timesteps of stateful inference across the
@@ -572,7 +672,7 @@ class ComputationGraph:
             raise ValueError(
                 f"expected {len(conf.network_inputs)} inputs, got "
                 f"{len(xs)}")
-        arrs = [jnp.asarray(_unwrap(x), self._dtype) for x in xs]
+        arrs = [jnp.asarray(_unwrap(x), self._input_dtype) for x in xs]
         single = arrs[0].ndim == 2
         if single:
             arrs = [a[:, None, :] if a.ndim == 2 else a for a in arrs]
@@ -585,7 +685,8 @@ class ComputationGraph:
         if self._rnn_carries is None:
             self._rnn_carries = {
                 name: self._node_by_name(name).vertex.layer.init_carry(
-                    n, self._dtype)
+                    n, self._compute_dtypes[name] if self._mixed
+                    else self._dtype)
                 for name in self._recurrent_nodes()}
             self._rnn_batch = n
         if "rnn_step" not in self._step_cache:
@@ -612,16 +713,19 @@ class ComputationGraph:
         feature_masks keeps inference consistent with masked training."""
         self._check_init()
         conf = self.conf
-        inputs = {n: jnp.asarray(_unwrap(x), self._dtype)
+        inputs = {n: jnp.asarray(_unwrap(x), self._input_dtype)
                   for n, x in zip(conf.network_inputs, xs)}
         fmasks = self._validate_fmasks(feature_masks, inputs)
         key = frozenset(fmasks)
         if self._fwd is None:
             self._fwd = {}
         if key not in self._fwd:
+            out_dt = self._out_dtype
             self._fwd[key] = _telemetry.instrument_jit("cg_forward", jax.jit(
                 lambda pm, sm, inp, fms: tuple(
-                    self._forward_all(pm, sm, inp, False, None, fms)[0][o]
+                    _precision.cast_leaf(
+                        self._forward_all(pm, sm, inp, False, None,
+                                          fms)[0][o], out_dt)
                     for o in conf.network_outputs)))
         outs = self._fwd[key](self.params_map, self.states_map, inputs,
                               fmasks)
@@ -656,9 +760,9 @@ class ComputationGraph:
                 f"need one external error per network output "
                 f"({len(conf.network_outputs)}), got "
                 f"{len(external_errors)}")
-        inputs = {n: jnp.asarray(_unwrap(x), self._dtype)
+        inputs = {n: jnp.asarray(_unwrap(x), self._input_dtype)
                   for n, x in zip(conf.network_inputs, xs)}
-        errs = tuple(jnp.asarray(_unwrap(e), self._dtype)
+        errs = tuple(jnp.asarray(_unwrap(e), self._out_dtype)
                      for e in external_errors)
         saved_key = self._rng_key
         if train:
@@ -670,10 +774,13 @@ class ComputationGraph:
         if train not in self._ext_fwd:
             # signature probe: this fn is only ever called under
             # jax.vjp, where the executable cache never grows
+            out_dt = self._out_dtype
             self._ext_fwd[train] = _telemetry.instrument_jit(
                 "cg_ext_forward", jax.jit(
                     lambda pm, sm, inp, rng: tuple(
-                        self._forward_all(pm, sm, inp, train, rng, {})[0][o]
+                        _precision.cast_leaf(
+                            self._forward_all(pm, sm, inp, train, rng,
+                                              {})[0][o], out_dt)
                         for o in conf.network_outputs)),
                 probe="signature")
         fwd = self._ext_fwd[train]
@@ -694,7 +801,8 @@ class ComputationGraph:
         if dataset is None:
             return float(self._score)
         self._check_init()
-        inputs = {self.conf.network_inputs[0]: jnp.asarray(dataset.features, self._dtype)}
+        inputs = {self.conf.network_inputs[0]: jnp.asarray(
+            dataset.features, self._input_dtype)}
         labels = {self.conf.network_outputs[0]: jnp.asarray(dataset.labels)}
         loss, _ = self._loss(self.params_map, self.states_map, inputs, labels, None)
         return float(loss)
@@ -803,6 +911,10 @@ class ComputationGraph:
                 lambda a: a, self.states_map)
             m.opt_states = jax.tree_util.tree_map(
                 lambda a: a, self.opt_states)
+            if self._loss_scale_state is not None:
+                m._loss_scale_state = jax.tree_util.tree_map(
+                    lambda a: a, self._loss_scale_state)
+                m._ls_seen = self._ls_seen
         return m
 
     def getIterationCount(self):

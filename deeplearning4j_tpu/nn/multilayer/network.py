@@ -55,6 +55,7 @@ def _uses_epoch_schedule(upd) -> bool:
     return isinstance(lr, ISchedule) and lr.schedule_type is ScheduleType.EPOCH
 from deeplearning4j_tpu.ndarray.dtypes import DataType
 from deeplearning4j_tpu.ndarray.ndarray import NDArray, _unwrap
+from deeplearning4j_tpu.nn import precision as _precision
 from deeplearning4j_tpu.nn.conf.builder import (
     MultiLayerConfiguration, apply_preprocessor,
 )
@@ -83,7 +84,24 @@ class MultiLayerNetwork:
         self._pretrain_cache: dict = {}
         self._rnn_carries = None    # stateful rnnTimeStep hidden state
         self._rnn_batch = 0
-        self._dtype = DataType.from_any(conf.dtype).jax
+        # mixed-precision policy (nn/precision.py): identity policies
+        # (precision=None / single-dtype) keep the legacy code paths
+        # bit-for-bit; mixed policies split param vs compute dtype
+        self._policy = _precision.PrecisionPolicy.resolve(
+            getattr(conf, "precision", None), conf.dtype)
+        self._mixed = not self._policy.is_identity
+        #: MASTER param dtype (fp32 under mixed policies)
+        self._dtype = DataType.from_any(self._policy.param_dtype).jax
+        #: dtype inputs are staged in (compute dtype — halves transfer
+        #: bytes under mixed policies)
+        self._input_dtype = DataType.from_any(
+            self._policy.compute_dtype).jax
+        #: dtype output()/feedForward() return
+        self._out_dtype = DataType.from_any(
+            self._policy.output_dtype).jax
+        self._compute_dtypes: List[Any] = []
+        self._loss_scale_state = None
+        self._ls_seen = (0, 0)
 
     # ------------------------------------------------------------------
     # initialization (reference: MultiLayerNetwork#init + ParamInitializer)
@@ -122,6 +140,17 @@ class MultiLayerNetwork:
             it = layer.output_type(it)
         self._output_type = it
         self._rng_key = jax.random.key(conf.seed ^ 0x5EED)
+        # per-layer compute dtypes (fp32 islands for loss heads /
+        # normalization stay fp32 under mixed policies)
+        self._compute_dtypes = [
+            self._policy.layer_compute_dtype(l, i)
+            for i, l in enumerate(conf.layers)]
+        self._loss_scale_state = _precision.init_loss_scale(self._policy)
+        self._ls_seen = (0, 0)
+        if self._mixed:
+            _precision.record_cast_count("mln", sum(
+                _precision.count_casts(p, self._compute_dtypes[i])
+                for i, p in enumerate(self.params_list)))
         return self
 
     def _infer_input_type(self):
@@ -148,6 +177,26 @@ class MultiLayerNetwork:
             raise RuntimeError("Call init() first")
 
     # ------------------------------------------------------------------
+    # mixed-precision seams (identity policies: strict no-ops)
+    # ------------------------------------------------------------------
+    def _cd(self, i):
+        """Compute dtype of layer i under the active policy."""
+        return self._compute_dtypes[i] if self._mixed else self._dtype
+
+    def _cast_p(self, p, i):
+        """Cast one layer's MASTER params to its compute dtype. Inside
+        the jitted step this happens once per step, and its vjp casts
+        the gradients straight back to the master dtype (fp32)."""
+        return _precision.cast_tree(p, self._compute_dtypes[i]) \
+            if self._mixed else p
+
+    def _cast_a(self, a, i):
+        """Cast the activation entering layer i (fp32 islands cast up,
+        and back down at the next reduced-precision layer)."""
+        return _precision.cast_leaf(a, self._compute_dtypes[i]) \
+            if self._mixed else a
+
+    # ------------------------------------------------------------------
     # forward (reference: feedForward / ffToLayerActivationsInWs)
     # ------------------------------------------------------------------
     def _forward(self, params_list, states_list, x, train: bool, rng,
@@ -166,14 +215,18 @@ class MultiLayerNetwork:
             tag = conf.preprocessors.get(i)
             if tag:
                 a = apply_preprocessor(tag, a)
+            a = self._cast_a(a, i)
+            p_i = self._cast_p(params_list[i], i)
             if fmask is not None and isinstance(layer, GlobalPoolingLayer) \
                     and a.ndim == 3 and a.shape[1] == fmask.shape[1]:
-                a, ns = layer.apply_masked(params_list[i], states_list[i],
+                a, ns = layer.apply_masked(p_i, states_list[i],
                                            a, fmask, train, keys[i])
             else:
-                a, ns = layer.apply(params_list[i], states_list[i], a,
+                a, ns = layer.apply(p_i, states_list[i], a,
                                     train, keys[i])
             new_states.append(ns)
+        if self._mixed:
+            a = _precision.cast_leaf(a, self._out_dtype)
         return a, new_states
 
     def _loss(self, params_list, states_list, x, y, mask, rng, fmask=None):
@@ -205,7 +258,8 @@ class MultiLayerNetwork:
             tag = conf.preprocessors.get(i)
             if tag:
                 a = apply_preprocessor(tag, a)
-            p_i = params_list[i]
+            a = self._cast_a(a, i)
+            p_i = self._cast_p(params_list[i], i)
             k_i = keys[i]
             # masked global pooling when the time axis still lines up
             if fmask is not None and isinstance(layer, GlobalPoolingLayer) \
@@ -238,7 +292,11 @@ class MultiLayerNetwork:
         tag = conf.preprocessors.get(len(conf.layers) - 1)
         if tag:
             a = apply_preprocessor(tag, a)
-        p_last = params_list[-1]
+        # loss head: fp32 island under mixed policies — the activation
+        # is cast UP so the logits, softmax and loss reduction all run
+        # at full precision (the policy's fp32_loss_head default)
+        a = self._cast_a(a, len(conf.layers) - 1)
+        p_last = self._cast_p(params_list[-1], len(conf.layers) - 1)
         if getattr(last, "weight_noise", None) is not None \
                 and keys[-1] is not None:
             p_last = last.weight_noise.apply(p_last, keys[-1])
@@ -287,10 +345,53 @@ class MultiLayerNetwork:
     # ------------------------------------------------------------------
     # the compiled training step
     # ------------------------------------------------------------------
+    def _apply_updates(self, params_list, opt_states, grads, it_step,
+                       ep_step):
+        """Master-precision weight update: grads arrive fp32 (the
+        param-cast vjp), apply_updater keeps the math fp32, and
+        ``p - u`` runs in the master dtype."""
+        new_params, new_opt = [], []
+        for i in range(len(params_list)):
+            step = ep_step if _uses_epoch_schedule(self._updaters[i]) else it_step
+            updates, no = apply_updater(self._updaters[i], opt_states[i],
+                                        grads[i], params_list[i], step)
+            np_i = jax.tree_util.tree_map(
+                lambda p, u: p - u, params_list[i], updates)
+            # post-update constraints (reference: BaseConstraint)
+            new_params.append(apply_constraints(self.conf.layers[i], np_i))
+            new_opt.append(no)
+        return new_params, new_opt
+
     def _get_train_step(self, has_mask: bool, has_fmask: bool = False) -> Callable:
         key = (has_mask, has_fmask)
         if key in self._step_cache:
             return self._step_cache[key]
+        policy = self._policy
+
+        if policy.loss_scaling:
+            # mixed_float16: scaled loss, fp32 unscale, overflow ->
+            # skip-step-and-halve — all inside the one compiled step
+            def step_fn(params_list, states_list, opt_states, ls_state,
+                        it_step, ep_step, x, y, mask, fmask, rng):
+                loss_fn = lambda pl: self._loss(pl, states_list, x, y,
+                                                mask, rng, fmask)
+                ((loss, (new_states, data_loss)), grads,
+                 finite) = _precision.scaled_value_and_grad(
+                    loss_fn, ls_state, params_list)
+                grads = self._clip_grads(grads)
+                new_params, new_opt = self._apply_updates(
+                    params_list, opt_states, grads, it_step, ep_step)
+                (new_params, new_opt, new_states,
+                 new_ls) = _precision.guard_scaled_step(
+                    policy, ls_state, finite,
+                    [(new_params, params_list), (new_opt, opt_states),
+                     (new_states, states_list)])
+                return new_params, new_states, new_opt, new_ls, data_loss
+
+            jitted = _telemetry.instrument_jit(
+                "mln_step", jax.jit(step_fn, donate_argnums=(0, 1, 2, 3)))
+            self._step_cache[key] = jitted
+            return jitted
 
         def step_fn(params_list, states_list, opt_states, it_step, ep_step,
                     x, y, mask, fmask, rng):
@@ -299,16 +400,8 @@ class MultiLayerNetwork:
             (loss, (new_states, data_loss)), grads = \
                 jax.value_and_grad(loss_fn, has_aux=True)(params_list)
             grads = self._clip_grads(grads)
-            new_params, new_opt = [], []
-            for i in range(len(params_list)):
-                step = ep_step if _uses_epoch_schedule(self._updaters[i]) else it_step
-                updates, no = apply_updater(self._updaters[i], opt_states[i],
-                                            grads[i], params_list[i], step)
-                np_i = jax.tree_util.tree_map(
-                    lambda p, u: p - u, params_list[i], updates)
-                # post-update constraints (reference: BaseConstraint)
-                new_params.append(apply_constraints(self.conf.layers[i], np_i))
-                new_opt.append(no)
+            new_params, new_opt = self._apply_updates(
+                params_list, opt_states, grads, it_step, ep_step)
             return new_params, new_states, new_opt, data_loss
 
         jitted = _telemetry.instrument_jit(
@@ -325,6 +418,36 @@ class MultiLayerNetwork:
         key = ("tbptt", has_mask)
         if key in self._step_cache:
             return self._step_cache[key]
+        policy = self._policy
+
+        if policy.loss_scaling:
+            def step_fn(params_list, states_list, opt_states, ls_state,
+                        carries, it_step, ep_step, x, y, mask, rng):
+                loss_fn = lambda pl: self._loss_carries(
+                    pl, states_list, carries, x, y, mask, rng)
+                ((loss, (new_states, data_loss, new_carries)), grads,
+                 finite) = _precision.scaled_value_and_grad(
+                    loss_fn, ls_state, params_list)
+                grads = self._clip_grads(grads)
+                new_params, new_opt = self._apply_updates(
+                    params_list, opt_states, grads, it_step, ep_step)
+                # carries deliberately NOT guarded: they are activations
+                # not trainable state — the next segment re-enters from
+                # whatever the forward produced, and non-finite carries
+                # resolve on the minibatch reset
+                (new_params, new_opt, new_states,
+                 new_ls) = _precision.guard_scaled_step(
+                    policy, ls_state, finite,
+                    [(new_params, params_list), (new_opt, opt_states),
+                     (new_states, states_list)])
+                return (new_params, new_states, new_opt, new_ls,
+                        new_carries, data_loss)
+
+            jitted = _telemetry.instrument_jit(
+                "mln_tbptt_step",
+                jax.jit(step_fn, donate_argnums=(0, 1, 2, 3, 4)))
+            self._step_cache[key] = jitted
+            return jitted
 
         def step_fn(params_list, states_list, opt_states, carries, it_step,
                     ep_step, x, y, mask, rng):
@@ -333,15 +456,8 @@ class MultiLayerNetwork:
             (loss, (new_states, data_loss, new_carries)), grads = \
                 jax.value_and_grad(loss_fn, has_aux=True)(params_list)
             grads = self._clip_grads(grads)
-            new_params, new_opt = [], []
-            for i in range(len(params_list)):
-                step = ep_step if _uses_epoch_schedule(self._updaters[i]) else it_step
-                updates, no = apply_updater(self._updaters[i], opt_states[i],
-                                            grads[i], params_list[i], step)
-                np_i = jax.tree_util.tree_map(
-                    lambda p, u: p - u, params_list[i], updates)
-                new_params.append(apply_constraints(self.conf.layers[i], np_i))
-                new_opt.append(no)
+            new_params, new_opt = self._apply_updates(
+                params_list, opt_states, grads, it_step, ep_step)
             return new_params, new_states, new_opt, new_carries, data_loss
 
         jitted = _telemetry.instrument_jit(
@@ -411,13 +527,13 @@ class MultiLayerNetwork:
 
     def _fit_batch(self, x, y, mask, features_mask=None):
         xin = _unwrap(x)
-        if isinstance(xin, jax.Array) and xin.dtype == self._dtype:
+        if isinstance(xin, jax.Array) and xin.dtype == self._input_dtype:
             # already device-resident in the right dtype (device
             # prefetcher output): no host->device copy, no cast
             _telemetry.record_on_device_batch("mln")
             x = xin
         else:
-            x = jnp.asarray(xin, self._dtype)
+            x = jnp.asarray(xin, self._input_dtype)
         y = jnp.asarray(_unwrap(y))
         fm = self._validate_fmask(features_mask, x)
         # per-timestep labels with a features mask and no explicit label
@@ -438,10 +554,18 @@ class MultiLayerNetwork:
         self._rng_key, sub = jax.random.split(self._rng_key)
         step_fn = self._get_train_step(m is not None, fm is not None)
         t_step = time.perf_counter()
-        (self.params_list, self.states_list, self.opt_states, loss) = step_fn(
-            self.params_list, self.states_list, self.opt_states,
-            jnp.asarray(self._iteration), jnp.asarray(self._epoch), x, y, m,
-            fm, sub)
+        if self._loss_scale_state is not None:
+            (self.params_list, self.states_list, self.opt_states,
+             self._loss_scale_state, loss) = step_fn(
+                self.params_list, self.states_list, self.opt_states,
+                self._loss_scale_state, jnp.asarray(self._iteration),
+                jnp.asarray(self._epoch), x, y, m, fm, sub)
+        else:
+            (self.params_list, self.states_list, self.opt_states,
+             loss) = step_fn(
+                self.params_list, self.states_list, self.opt_states,
+                jnp.asarray(self._iteration), jnp.asarray(self._epoch),
+                x, y, m, fm, sub)
         # dispatch-side timing: the step is async, so this span is host
         # dispatch (+ compile on a cache miss), not device wall time —
         # deliberately so; blocking here would stall the pipeline
@@ -458,6 +582,12 @@ class MultiLayerNetwork:
         # arrays already on device)
         self._last_fit_batch = (x, y, m, fm, sub)
         _telemetry.sample_device_memory()
+        if self._loss_scale_state is not None:
+            # mirror loss-scale/overflow counters into telemetry (one
+            # device->host sync per step — mixed_float16 only; disable
+            # telemetry to trade observability for dispatch pipelining)
+            self._ls_seen = _precision.record_loss_scale(
+                "mln", self._loss_scale_state, self._ls_seen)
         self._panic_check()
         if self._listeners:
             t_l = time.perf_counter()
@@ -474,11 +604,15 @@ class MultiLayerNetwork:
         cfg = OpProfiler.getInstance().config
         if cfg.mode in (ProfilerMode.DISABLED, ProfilerMode.OPERATIONS):
             return
+        # under dynamic loss scaling a non-finite LOSS can be a handled
+        # overflow (step skipped, scale halved) — say so in the panic
+        ls_ctx = _precision.loss_scale_context(self._loss_scale_state)
         check_numerics(self._score, cfg.mode,
-                       f"in score at iteration {self._iteration}")
+                       f"in score at iteration {self._iteration}{ls_ctx}")
         if cfg.check_params:
             check_numerics(self.params_list, cfg.mode,
-                           f"in params at iteration {self._iteration}")
+                           f"in params at iteration {self._iteration}"
+                           f"{ls_ctx}")
 
     def _fit_tbptt(self, x, y, mask, k: int):
         """Truncated BPTT over the time axis (reference:
@@ -491,9 +625,10 @@ class MultiLayerNetwork:
                 "(use RnnOutputLayer)")
         n, t = x.shape[0], x.shape[1]
         try:
+            # carries are activations: compute dtype, not master dtype
             carries = [
-                (l.init_carry(n, self._dtype) if l.is_recurrent else None)
-                for l in self.conf.layers]
+                (l.init_carry(n, self._cd(i)) if l.is_recurrent else None)
+                for i, l in enumerate(self.conf.layers)]
         except NotImplementedError:
             raise ValueError(
                 "Truncated BPTT is not supported with Bidirectional layers "
@@ -506,15 +641,26 @@ class MultiLayerNetwork:
             mc = mask[:, t0:t0 + k] if mask is not None else None
             self._rng_key, sub = jax.random.split(self._rng_key)
             t_step = time.perf_counter()
-            (self.params_list, self.states_list, self.opt_states, carries,
-             loss) = step_fn(
-                self.params_list, self.states_list, self.opt_states, carries,
-                jnp.asarray(self._iteration), jnp.asarray(self._epoch),
-                xc, yc, mc, sub)
+            if self._loss_scale_state is not None:
+                (self.params_list, self.states_list, self.opt_states,
+                 self._loss_scale_state, carries, loss) = step_fn(
+                    self.params_list, self.states_list, self.opt_states,
+                    self._loss_scale_state, carries,
+                    jnp.asarray(self._iteration), jnp.asarray(self._epoch),
+                    xc, yc, mc, sub)
+            else:
+                (self.params_list, self.states_list, self.opt_states,
+                 carries, loss) = step_fn(
+                    self.params_list, self.states_list, self.opt_states,
+                    carries, jnp.asarray(self._iteration),
+                    jnp.asarray(self._epoch), xc, yc, mc, sub)
             _telemetry.record_phase("device_step", t_step)
             self._score = loss
             self._iteration += 1
             self._last_batch_size = int(xc.shape[0])
+            if self._loss_scale_state is not None:
+                self._ls_seen = _precision.record_loss_scale(
+                    "mln", self._loss_scale_state, self._ls_seen)
             self._panic_check()
             if self._listeners:
                 t_l = time.perf_counter()
@@ -536,8 +682,9 @@ class MultiLayerNetwork:
             tag = self.conf.preprocessors.get(j)
             if tag:
                 a = apply_preprocessor(tag, a)
-            a, _ = lay.apply(params_list[j], states_list[j], a, False,
-                             None)
+            a = self._cast_a(a, j)
+            a, _ = lay.apply(self._cast_p(params_list[j], j),
+                             states_list[j], a, False, None)
         tag = self.conf.preprocessors.get(idx)
         if tag:
             a = apply_preprocessor(tag, a)
@@ -558,9 +705,11 @@ class MultiLayerNetwork:
             def loss_fn(p):
                 if layer.weight_noise is not None and rng is not None:
                     p = layer.weight_noise.apply(p, rng)
-                loss = layer.unsupervised_loss(p, a, rng)
+                loss = layer.unsupervised_loss(
+                    self._cast_p(p, idx), self._cast_a(a, idx), rng)
                 # same l1/l2 treatment fit() applies (reference:
-                # pretraining includes regularization in the score)
+                # pretraining includes regularization in the score);
+                # regularization reads the MASTER params
                 for k, v in p.items():
                     if k in _REGULARIZED_KEYS:
                         if layer.l1:
@@ -610,7 +759,7 @@ class MultiLayerNetwork:
 
         for _ in range(epochs):
             for xb in batches():
-                x = jnp.asarray(_unwrap(xb), self._dtype)
+                x = jnp.asarray(_unwrap(xb), self._input_dtype)
                 self._rng_key, sub = jax.random.split(self._rng_key)
                 (self.params_list[idx], self.opt_states[idx],
                  loss) = step(self.params_list[idx], self.params_list,
@@ -638,7 +787,7 @@ class MultiLayerNetwork:
         if not hasattr(layer, "reconstruction_log_prob"):
             raise ValueError(f"layer {idx} is not a "
                              "VariationalAutoencoder")
-        xj = jnp.asarray(_unwrap(x), self._dtype)
+        xj = jnp.asarray(_unwrap(x), self._input_dtype)
         a = self._prefix_activations(idx, self.params_list,
                                      self.states_list, xj)
         self._rng_key, sub = jax.random.split(self._rng_key)
@@ -654,7 +803,7 @@ class MultiLayerNetwork:
         features_mask keeps inference consistent with masked training
         (zeroed padding + masked global pooling)."""
         self._check_init()
-        xj = jnp.asarray(_unwrap(x), self._dtype)
+        xj = jnp.asarray(_unwrap(x), self._input_dtype)
         fm = self._validate_fmask(features_mask, xj)
         if train:
             self._rng_key, sub = jax.random.split(self._rng_key)
@@ -667,15 +816,19 @@ class MultiLayerNetwork:
     def feedForward(self, x) -> List[NDArray]:
         """Per-layer activations (reference returns the full list)."""
         self._check_init()
-        a = jnp.asarray(_unwrap(x), self._dtype)
+        a = jnp.asarray(_unwrap(x), self._input_dtype)
         acts = [NDArray(a)]
         for i, layer in enumerate(self.conf.layers):
             tag = self.conf.preprocessors.get(i)
             if tag:
                 a = apply_preprocessor(tag, a)
-            a, _ = layer.apply(self.params_list[i], self.states_list[i], a,
-                               False, None)
+            a = self._cast_a(a, i)
+            a, _ = layer.apply(self._cast_p(self.params_list[i], i),
+                               self.states_list[i], a, False, None)
             acts.append(NDArray(a))
+        if self._mixed and acts:
+            acts[-1] = NDArray(
+                _precision.cast_leaf(acts[-1].jax, self._out_dtype))
         return acts
 
     # ------------------------------------------------------------------
@@ -690,15 +843,17 @@ class MultiLayerNetwork:
             tag = conf.preprocessors.get(i)
             if tag:
                 a = apply_preprocessor(tag, a)
+            a = self._cast_a(a, i)
+            p_i = self._cast_p(params_list[i], i)
             if layer.is_recurrent:
                 a, _, c = layer.apply_with_carry(
-                    params_list[i], states_list[i], carries[i], a, False,
-                    None)
+                    p_i, states_list[i], carries[i], a, False, None)
             else:
-                a, _ = layer.apply(params_list[i], states_list[i], a, False,
-                                   None)
+                a, _ = layer.apply(p_i, states_list[i], a, False, None)
                 c = None
             new_carries.append(c)
+        if self._mixed:
+            a = _precision.cast_leaf(a, self._out_dtype)
         return a, new_carries
 
     def rnnTimeStep(self, x) -> NDArray:
@@ -707,7 +862,7 @@ class MultiLayerNetwork:
         without re-running history. 2-D input [N,F] means a single step
         and returns [N,out]; 3-D [N,T,F] steps T times, returns [N,T,out]."""
         self._check_init()
-        xj = jnp.asarray(_unwrap(x), self._dtype)
+        xj = jnp.asarray(_unwrap(x), self._input_dtype)
         single = xj.ndim == 2
         if single:
             xj = xj[:, None, :]
@@ -719,8 +874,8 @@ class MultiLayerNetwork:
                 "(reference behavior: mini-batch mismatch is an error)")
         if self._rnn_carries is None:
             self._rnn_carries = [
-                (l.init_carry(n, self._dtype) if l.is_recurrent else None)
-                for l in self.conf.layers]
+                (l.init_carry(n, self._cd(i)) if l.is_recurrent else None)
+                for i, l in enumerate(self.conf.layers)]
             self._rnn_batch = n
         if "rnn_step" not in self._fwd_cache:
             self._fwd_cache["rnn_step"] = _telemetry.instrument_jit(
@@ -756,7 +911,8 @@ class MultiLayerNetwork:
             return float(self._score)
         self._check_init()
         loss, _ = self._loss(self.params_list, self.states_list,
-                             jnp.asarray(dataset.features, self._dtype),
+                             jnp.asarray(dataset.features,
+                                         self._input_dtype),
                              jnp.asarray(dataset.labels),
                              dataset.labels_mask, None)
         return float(loss)
@@ -775,8 +931,8 @@ class MultiLayerNetwork:
         ``params_list`` pytree layout (what ``updater.apply`` and
         ``computeGradientAndScore`` use)."""
         self._check_init()
-        xj = jnp.asarray(_unwrap(x), self._dtype)
-        err = jnp.asarray(_unwrap(external_errors), self._dtype)
+        xj = jnp.asarray(_unwrap(x), self._input_dtype)
+        err = jnp.asarray(_unwrap(external_errors), self._out_dtype)
         fm = self._validate_fmask(features_mask, xj)
         saved_key = self._rng_key
         if train:
@@ -800,7 +956,7 @@ class MultiLayerNetwork:
         """(gradients, score) — the seam gradient-check tests use
         (reference: MultiLayerNetwork#computeGradientAndScore)."""
         self._check_init()
-        x = jnp.asarray(_unwrap(x), self._dtype)
+        x = jnp.asarray(_unwrap(x), self._input_dtype)
         y = jnp.asarray(_unwrap(y))
         loss_fn = lambda pl: self._loss(pl, self.states_list, x, y, None, None)[0]
         loss, grads = jax.value_and_grad(loss_fn)(self.params_list)
@@ -937,4 +1093,8 @@ class MultiLayerNetwork:
             m.params_list = jax.tree_util.tree_map(lambda a: a, self.params_list)
             m.states_list = jax.tree_util.tree_map(lambda a: a, self.states_list)
             m.opt_states = jax.tree_util.tree_map(lambda a: a, self.opt_states)
+            if self._loss_scale_state is not None:
+                m._loss_scale_state = jax.tree_util.tree_map(
+                    lambda a: a, self._loss_scale_state)
+                m._ls_seen = self._ls_seen
         return m

@@ -1,0 +1,382 @@
+"""Mixed-precision policy engine: fp32 master weights, reduced-precision
+compute, dynamic loss scaling.
+
+Reference gap: the reference's only precision control is the global
+``Nd4j.setDefaultDataTypes`` / ``NeuralNetConfiguration.dataType`` knob
+— one dtype for params, compute, updater state and losses alike. On TPU
+that leaves the MXU's bf16 peak on the table (float32) or gives up
+numerical protection wholesale (full bf16: params, weight updates and
+reductions all downcast). The standard fix — institutionalized for GPUs
+by cuDNN's compute-type/storage-type split (Chetlur et al.,
+arXiv:1410.0759) and argued for weight updates specifically in Xu et
+al., arXiv:2004.13336 — is a POLICY layer:
+
+- **param_dtype** (master weights): params + updater state stay fp32;
+  the weight update ``p - u`` happens in fp32 every step.
+- **compute_dtype**: params are cast fp32 -> bf16/f16 ONCE per step
+  inside the jitted step (the cast is part of the compiled program and
+  its vjp casts gradients straight back to fp32 — master-precision
+  grads for free).
+- **output_dtype**: what ``output()``/``feedForward()`` hand back.
+- **fp32 islands**: loss heads (softmax + reduction), normalization
+  layers, and any per-layer override stay in fp32 — activations are
+  cast up on entry and back down after, so reductions never accumulate
+  in 8-bit mantissas.
+- **dynamic loss scaling** (``mixed_float16`` only): the loss is
+  multiplied by a running scale before backprop so f16 cotangents don't
+  underflow; gradients are unscaled in fp32, checked for non-finites,
+  and an overflowing step is SKIPPED (params/opt-state/BN-stats keep
+  their old values via ``jnp.where``) while the scale halves. After
+  ``growth_interval`` clean steps the scale doubles. All of it is
+  jit-compatible state threaded through the compiled step.
+
+Everything here is pure-functional and trace-friendly; the policy
+object itself is a serializable dataclass that rides in the network
+configuration JSON.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.common.serde import serializable
+from deeplearning4j_tpu.profiler.telemetry import (
+    LOSS_SCALE, LOSS_SCALE_OVERFLOWS, LOSS_SCALE_SKIPPED_STEPS,
+    PRECISION_CASTS,
+)
+
+#: layer/vertex class names whose compute stays fp32 under mixed
+#: policies (normalization statistics must not accumulate in bf16/f16)
+_FP32_NORM_LAYERS = ("BatchNormalization", "LocalResponseNormalization",
+                     "LayerNormalization")
+
+
+@serializable
+@dataclasses.dataclass
+class PrecisionPolicy:
+    """param/compute/output dtype triple + fp32 islands + loss scaling.
+
+    Use the presets — ``PrecisionPolicy.of("float32")``,
+    ``of("mixed_bfloat16")``, ``of("mixed_float16")`` — or construct
+    directly for custom splits. ``layer_overrides`` maps a layer index
+    (MultiLayerNetwork) or vertex/layer name (ComputationGraph) to a
+    dtype string, overriding the policy's compute dtype for that layer
+    (e.g. force one attention block to fp32)."""
+
+    name: str = "float32"
+    param_dtype: str = "float32"
+    compute_dtype: str = "float32"
+    output_dtype: str = "float32"
+    #: layer class names forced to fp32 compute (normalization et al.)
+    fp32_layer_types: tuple = _FP32_NORM_LAYERS
+    #: loss heads (softmax + loss reduction) compute in fp32
+    fp32_loss_head: bool = True
+    #: {layer index | layer/vertex name: dtype string} per-layer forcing
+    layer_overrides: dict = dataclasses.field(default_factory=dict)
+    # -- dynamic loss scaling (mixed_float16) ---------------------------
+    loss_scaling: bool = False
+    initial_loss_scale: float = 2.0 ** 15
+    loss_scale_growth: float = 2.0
+    loss_scale_backoff: float = 0.5
+    #: consecutive finite-grad steps before the scale grows
+    growth_interval: int = 200
+    min_loss_scale: float = 1.0
+    #: growth ceiling: a run whose f16 path never overflows (e.g. all
+    #: hot layers overridden to fp32) would otherwise double the scale
+    #: to f32 inf in ~23k steps — and inf * backoff = inf can never
+    #: recover, silently skipping every step thereafter
+    max_loss_scale: float = 2.0 ** 24
+
+    def __post_init__(self):
+        # JSON round-trip: tuples come back as lists, int keys as strings
+        if isinstance(self.fp32_layer_types, list):
+            self.fp32_layer_types = tuple(self.fp32_layer_types)
+        if self.layer_overrides:
+            self.layer_overrides = {
+                (int(k) if str(k).lstrip("-").isdigit() else k): v
+                for k, v in self.layer_overrides.items()}
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def of(name: str) -> "PrecisionPolicy":
+        """Resolve a preset name ("float32" / "mixed_bfloat16" /
+        "mixed_float16", plus dtype aliases like "mixed_bf16")."""
+        from deeplearning4j_tpu.ndarray.dtypes import DataType
+
+        key = str(name).strip().lower()
+        if key in ("float32", "f32", "fp32"):
+            return PrecisionPolicy(name="float32")
+        if key.startswith("mixed_"):
+            dt = DataType.from_any(key[len("mixed_"):])
+            if dt is DataType.BFLOAT16:
+                return PrecisionPolicy(name="mixed_bfloat16",
+                                       compute_dtype="bfloat16")
+            if dt is DataType.HALF:
+                return PrecisionPolicy(name="mixed_float16",
+                                       compute_dtype="float16",
+                                       loss_scaling=True)
+        raise ValueError(
+            f"Unknown precision policy {name!r} (expected 'float32', "
+            "'mixed_bfloat16', 'mixed_float16', or a PrecisionPolicy)")
+
+    @staticmethod
+    def identity(dtype: str) -> "PrecisionPolicy":
+        """Single-dtype policy matching the legacy conf.dtype behavior
+        (params == compute == output; no fp32 islands, no scaling) —
+        resolves to a strict no-op in the network code paths."""
+        return PrecisionPolicy(name=f"identity:{dtype}",
+                               param_dtype=dtype, compute_dtype=dtype,
+                               output_dtype=dtype, fp32_layer_types=(),
+                               fp32_loss_head=False)
+
+    @staticmethod
+    def resolve(precision, conf_dtype: str) -> "PrecisionPolicy":
+        """Conf seam: ``precision`` is None (legacy single-dtype mode
+        driven by conf.dtype), a preset name, or a PrecisionPolicy."""
+        if precision is None:
+            return PrecisionPolicy.identity(conf_dtype)
+        if isinstance(precision, PrecisionPolicy):
+            return precision
+        return PrecisionPolicy.of(precision)
+
+    # ------------------------------------------------------------------
+    @property
+    def is_identity(self) -> bool:
+        """True when no cast/scaling machinery is needed — networks keep
+        their exact single-dtype code paths (and donation patterns).
+        A uniform LOW-precision policy with fp32 islands configured is
+        NOT identity: the islands require the cast machinery (a
+        directly-constructed all-bf16 policy keeps the default
+        fp32_loss_head protection unless explicitly cleared)."""
+        if (self.param_dtype != self.compute_dtype
+                or self.compute_dtype != self.output_dtype
+                or self.loss_scaling or self.layer_overrides):
+            return False
+        # uniform fp32: islands are vacuous; uniform low precision:
+        # identity only if the islands were explicitly turned off
+        return (self.compute_dtype == "float32"
+                or (not self.fp32_loss_head
+                    and not self.fp32_layer_types))
+
+    def layer_compute_dtype(self, layer, key) -> jnp.dtype:
+        """Resolved compute dtype for one layer. ``key`` is the layer
+        index (MLN) or vertex name (CG); matched against
+        ``layer_overrides`` first (also by ``layer.name``), then the
+        fp32 forcing rules, then the policy compute dtype."""
+        ov = self.layer_overrides
+        if ov:
+            if key in ov:
+                return jnp.dtype(ov[key])
+            lname = getattr(layer, "name", None)
+            if lname is not None and lname in ov:
+                return jnp.dtype(ov[lname])
+        if layer is not None:
+            if self.fp32_loss_head and hasattr(layer, "loss_value"):
+                return jnp.dtype("float32")
+            if type(layer).__name__ in self.fp32_layer_types:
+                return jnp.dtype("float32")
+        return jnp.dtype(self.compute_dtype)
+
+
+# ---------------------------------------------------------------- casts
+def cast_leaf(a, dtype):
+    """Cast one floating array; non-float leaves (int masks, counters)
+    pass through untouched."""
+    if hasattr(a, "dtype") and jnp.issubdtype(a.dtype, jnp.floating) \
+            and a.dtype != dtype:
+        return a.astype(dtype)
+    return a
+
+
+def cast_tree(tree, dtype):
+    """Cast every floating leaf of a pytree (one layer's params)."""
+    return jax.tree_util.tree_map(lambda a: cast_leaf(a, dtype), tree)
+
+
+def count_casts(params_tree, dtype) -> int:
+    """Leaves that WILL be cast per step for a given compute dtype —
+    the static cast-count telemetry gauge."""
+    n = 0
+    for a in jax.tree_util.tree_leaves(params_tree):
+        if hasattr(a, "dtype") and jnp.issubdtype(a.dtype, jnp.floating) \
+                and a.dtype != dtype:
+            n += 1
+    return n
+
+
+# ----------------------------------------------------- loss-scale state
+def init_loss_scale(policy: PrecisionPolicy) -> Optional[Dict[str, Any]]:
+    """Fresh jit-compatible loss-scale state, or None when the policy
+    doesn't scale. Counters ride in the state so they survive jit
+    donation and checkpoints."""
+    if not policy.loss_scaling:
+        return None
+    # overflows == skipped_steps in the current engine (every detected
+    # overflow skips exactly one step); they are kept as separate
+    # counters because the NAMES are the telemetry contract and a
+    # future partial-skip path (e.g. gradient accumulation skipping
+    # only the flush) would diverge them without a metric rename
+    return {
+        "scale": jnp.asarray(policy.initial_loss_scale, jnp.float32),
+        "good_steps": jnp.asarray(0, jnp.int32),
+        "overflows": jnp.asarray(0, jnp.int32),
+        "skipped_steps": jnp.asarray(0, jnp.int32),
+    }
+
+
+def scale_loss(loss, ls_state):
+    return loss * ls_state["scale"].astype(loss.dtype)
+
+
+def unscale_grads(grads, ls_state):
+    """Divide gradients by the live scale, in fp32 (master grads)."""
+    inv = 1.0 / ls_state["scale"]
+
+    def one(g):
+        g = g.astype(jnp.promote_types(g.dtype, jnp.float32))
+        return g * inv.astype(g.dtype)
+
+    return jax.tree_util.tree_map(one, grads)
+
+
+def all_finite(tree):
+    """Scalar bool: every element of every leaf is finite."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not leaves:
+        return jnp.asarray(True)
+    flags = [jnp.all(jnp.isfinite(l)) for l in leaves
+             if jnp.issubdtype(jnp.result_type(l), jnp.floating)]
+    if not flags:
+        return jnp.asarray(True)
+    out = flags[0]
+    for f in flags[1:]:
+        out = jnp.logical_and(out, f)
+    return out
+
+
+def select(pred, new_tree, old_tree):
+    """Per-leaf ``where(pred, new, old)`` — the skip-step primitive."""
+    return jax.tree_util.tree_map(
+        lambda n, o: jnp.where(pred, n, o), new_tree, old_tree)
+
+
+def update_loss_scale(policy: PrecisionPolicy, ls_state, finite):
+    """Dynamic loss-scale schedule: overflow -> halve (floored at
+    min_loss_scale) and reset the streak; ``growth_interval`` clean
+    steps -> double (capped at max_loss_scale). Counters accumulate on
+    device."""
+    scale = ls_state["scale"]
+    good = ls_state["good_steps"]
+    interval = jnp.asarray(policy.growth_interval, jnp.int32)
+    grown = jnp.where(good + 1 >= interval,
+                      jnp.minimum(scale * policy.loss_scale_growth,
+                                  policy.max_loss_scale), scale)
+    shrunk = jnp.maximum(scale * policy.loss_scale_backoff,
+                         policy.min_loss_scale)
+    overflow = jnp.logical_not(finite).astype(jnp.int32)
+    return {
+        "scale": jnp.where(finite, grown, shrunk),
+        "good_steps": jnp.where(
+            finite, jnp.where(good + 1 >= interval, 0, good + 1), 0
+        ).astype(jnp.int32),
+        "overflows": ls_state["overflows"] + overflow,
+        "skipped_steps": ls_state["skipped_steps"] + overflow,
+    }
+
+
+def scaled_value_and_grad(loss_fn, ls_state, params):
+    """The loss-scaling forward/backward scaffold shared by every step
+    builder: differentiate ``scale * loss_fn(params)``, unscale the
+    gradients in fp32, and judge finiteness BEFORE any clipping (an
+    elementwise clip would truncate an inf to the threshold and mask
+    the overflow). ``loss_fn`` returns ``(loss, aux)``; returns
+    ``((loss, aux), unscaled_grads, finite)``."""
+
+    def wrapped(p):
+        loss, aux = loss_fn(p)
+        return scale_loss(loss, ls_state), aux
+
+    out, grads = jax.value_and_grad(wrapped, has_aux=True)(params)
+    grads = unscale_grads(grads, ls_state)
+    return out, grads, all_finite(grads)
+
+
+def guard_scaled_step(policy: PrecisionPolicy, ls_state, finite,
+                      new_old_pairs):
+    """The skip-step tail shared by every step builder: on a non-finite
+    step each (new, old) tree pair resolves to OLD (params, optimizer
+    moments, BN stats all held), and the loss-scale state advances per
+    the schedule. Returns (guarded trees..., new_ls_state)."""
+    guarded = tuple(select(finite, n, o) for n, o in new_old_pairs)
+    return guarded + (update_loss_scale(policy, ls_state, finite),)
+
+
+# ------------------------------------------------------------ telemetry
+def record_cast_count(site: str, n: int) -> None:
+    """Static per-step cast count gauge (set at step-build time)."""
+    from deeplearning4j_tpu.profiler import telemetry
+
+    if not telemetry.enabled():
+        return
+    telemetry.MetricsRegistry.get_default().gauge(
+        PRECISION_CASTS,
+        "param leaves cast param_dtype->compute_dtype per compiled step"
+    ).set(n, site=site)
+
+
+def record_loss_scale(site: str, ls_state,
+                      seen: Tuple[int, int]) -> Tuple[int, int]:
+    """Mirror the device-side loss-scale state into telemetry: the
+    ``loss_scale`` gauge plus DELTA increments of the overflow/skip
+    counters since ``seen``. Forces one device->host sync — only called
+    on mixed_float16 steps, and documented as such; returns the new
+    ``seen`` tuple."""
+    from deeplearning4j_tpu.profiler import telemetry
+
+    if not telemetry.enabled() or ls_state is None:
+        return seen
+    scale, of, sk = jax.device_get(
+        [ls_state["scale"], ls_state["overflows"],
+         ls_state["skipped_steps"]])
+    scale, of, sk = float(scale), int(of), int(sk)
+    reg = telemetry.MetricsRegistry.get_default()
+    reg.gauge(LOSS_SCALE, "current dynamic loss scale").set(
+        scale, site=site)
+    if of > seen[0]:
+        reg.counter(LOSS_SCALE_OVERFLOWS,
+                    "gradient overflows detected (non-finite grads)"
+                    ).inc(of - seen[0], site=site)
+    if sk > seen[1]:
+        reg.counter(LOSS_SCALE_SKIPPED_STEPS,
+                    "training steps skipped (params held) on overflow"
+                    ).inc(sk - seen[1], site=site)
+    return (of, sk)
+
+
+def loss_scale_context(ls_state) -> str:
+    """Human-readable loss-scale summary for NaN-panic messages (the
+    panic path already syncs, so the extra fetch is free)."""
+    if ls_state is None:
+        return ""
+    scale, of, sk = jax.device_get(
+        [ls_state["scale"], ls_state["overflows"],
+         ls_state["skipped_steps"]])
+    return (f" [loss_scale={float(scale):g} overflows={int(of)} "
+            f"skipped_steps={int(sk)}; a non-finite LOSS on an "
+            "overflow step is expected — the step was skipped and the "
+            "scale halved]")
+
+
+__all__ = [
+    "PrecisionPolicy", "cast_leaf", "cast_tree", "count_casts",
+    "init_loss_scale", "scale_loss", "unscale_grads", "all_finite",
+    "select", "update_loss_scale", "scaled_value_and_grad",
+    "guard_scaled_step", "record_cast_count",
+    "record_loss_scale", "loss_scale_context",
+    "LOSS_SCALE", "LOSS_SCALE_OVERFLOWS", "LOSS_SCALE_SKIPPED_STEPS",
+    "PRECISION_CASTS",
+]

@@ -239,7 +239,7 @@ class TransferLearningHelper:
 
     def featurize(self, ds: DataSet) -> DataSet:
         """Forward through the frozen layers (reference: featurize)."""
-        a = jnp.asarray(ds.features, self.net._dtype)
+        a = jnp.asarray(ds.features, self.net._input_dtype)
         for i in range(self.frozen_up_to + 1):
             tag = self.net.conf.preprocessors.get(i)
             if tag:
@@ -247,9 +247,10 @@ class TransferLearningHelper:
                     apply_preprocessor,
                 )
                 a = apply_preprocessor(tag, a)
+            a = self.net._cast_a(a, i)
             a, _ = self.net.conf.layers[i].apply(
-                self.net.params_list[i], self.net.states_list[i], a,
-                False, None)
+                self.net._cast_p(self.net.params_list[i], i),
+                self.net.states_list[i], a, False, None)
         return DataSet(a, ds.labels, labels_mask=ds.labels_mask)
 
     def fitFeaturized(self, ds: DataSet, epochs: int = 1) -> None:

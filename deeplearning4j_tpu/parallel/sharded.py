@@ -41,6 +41,7 @@ from deeplearning4j_tpu.parallel.mesh import shard_map
 from deeplearning4j_tpu.datasets.dataset import DataSet
 from deeplearning4j_tpu.datasets.iterator import DataSetIterator
 from deeplearning4j_tpu.learning.updaters import apply_updater
+from deeplearning4j_tpu.nn import precision as _precision
 from deeplearning4j_tpu.nn.multilayer.network import _uses_epoch_schedule
 from deeplearning4j_tpu.ops import compression as comp
 from deeplearning4j_tpu.parallel.mesh import build_mesh
@@ -146,6 +147,15 @@ class ShardedTrainer:
                  averaging_frequency: int = 5):
         if mode not in ("sharing", "sharing_compressed", "averaging"):
             raise ValueError(f"Unknown mode: {mode}")
+        if getattr(model, "_policy", None) is not None \
+                and model._policy.loss_scaling and mode != "sharing":
+            # the shard_map modes thread hand-built per-shard state
+            # pytrees; silently dropping the scale state would train
+            # f16 unprotected — refuse up front instead
+            raise ValueError(
+                "dynamic loss scaling (precision='mixed_float16') is "
+                f"only supported in mode='sharing', not {mode!r} — use "
+                "'sharing' or the mixed_bfloat16 policy")
         self.model = model
         self.mf = _ModelFuncs(model)
         self.mesh = mesh if mesh is not None else build_mesh()
@@ -167,6 +177,9 @@ class ShardedTrainer:
         put = lambda t: _tmap(lambda a: jax.device_put(a, spec), t)
         p_, s_, o_ = self.mf.get_trees()
         self.mf.set_trees(put(p_), put(s_), put(o_))
+        if getattr(self.model, "_loss_scale_state", None) is not None:
+            self.model._loss_scale_state = put(
+                self.model._loss_scale_state)
 
     def _already_placed(self, a, dt) -> bool:
         """True when the array is device-resident with the trainer's
@@ -193,7 +206,7 @@ class ShardedTrainer:
             aj = jnp.asarray(a, dt) if dt is not None else jnp.asarray(a)
             return jax.device_put(aj, spec(aj))
 
-        dt = self.model._dtype
+        dt = getattr(self.model, "_input_dtype", self.model._dtype)
         first = x[0] if isinstance(x, (list, tuple)) else x
         if self._already_placed(first, dt):
             _telemetry.record_on_device_batch("sharded")
@@ -212,6 +225,33 @@ class ShardedTrainer:
     # ------------------------------------------------------------------
     def _build_sharing_step(self):
         mf = self.mf
+        policy = getattr(self.model, "_policy", None)
+
+        if policy is not None and policy.loss_scaling:
+            # mixed_float16 under GSPMD: the loss-scale state is
+            # replicated; grads carry the compiler-inserted psum, so
+            # the finiteness verdict is identical on every shard and
+            # the skip/halve decision stays consistent mesh-wide
+            def step_fn(params, states, opt, ls_state, it_step, ep_step,
+                        x, y, mask, fmask, rng):
+                loss_fn = lambda pl: mf.loss(pl, states, x, y, rng,
+                                             mask, fmask)
+                ((loss, (new_states, data_loss)), grads,
+                 finite) = _precision.scaled_value_and_grad(
+                    loss_fn, ls_state, params)
+                grads = mf.clip(grads)
+                new_params, new_opt = mf.apply_updates(
+                    params, grads, opt, it_step, ep_step)
+                (new_params, new_opt, new_states,
+                 new_ls) = _precision.guard_scaled_step(
+                    policy, ls_state, finite,
+                    [(new_params, params), (new_opt, opt),
+                     (new_states, states)])
+                return new_params, new_states, new_opt, new_ls, data_loss
+
+            return _telemetry.instrument_jit(
+                "parallel_sharing_step",
+                jax.jit(step_fn, donate_argnums=(0, 1, 2, 3)))
 
         def step_fn(params, states, opt, it_step, ep_step, x, y, mask,
                     fmask, rng):
@@ -512,9 +552,19 @@ class ShardedTrainer:
         t_step = time.perf_counter()
 
         if self.mode == "sharing":
-            (params, states, opt, loss) = self._step(
-                params, states, opt, it_s, ep_s, x, y, mask, fmask, sub)
-            mf.set_trees(params, states, opt)
+            if model._loss_scale_state is not None:
+                (params, states, opt, model._loss_scale_state,
+                 loss) = self._step(
+                    params, states, opt, model._loss_scale_state, it_s,
+                    ep_s, x, y, mask, fmask, sub)
+                mf.set_trees(params, states, opt)
+                model._ls_seen = _precision.record_loss_scale(
+                    "sharded", model._loss_scale_state, model._ls_seen)
+            else:
+                (params, states, opt, loss) = self._step(
+                    params, states, opt, it_s, ep_s, x, y, mask, fmask,
+                    sub)
+                mf.set_trees(params, states, opt)
         elif self.mode == "sharing_compressed":
             opt_s = self._local
             (params, states, opt_s, self._residual, self._thresholds,

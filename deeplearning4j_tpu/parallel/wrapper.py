@@ -115,7 +115,12 @@ class ParallelWrapper:
                 policy = BatchShapePolicy("exact")
         pf = DevicePrefetchIterator(
             data, depth=self.prefetch_buffer, policy=policy,
-            mesh=self._trainer.mesh, dtype=self._trainer.model._dtype)
+            mesh=self._trainer.mesh,
+            # compute dtype, not master dtype: prefetched batches must
+            # match the fit loop's on-device fast path (mixed policies
+            # stage inputs in bf16/f16)
+            dtype=getattr(self._trainer.model, "_input_dtype",
+                          self._trainer.model._dtype))
         return pf, pf
 
     def fit(self, data, labels=None, epochs: int = 1):

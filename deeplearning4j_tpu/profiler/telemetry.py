@@ -64,6 +64,11 @@ PREFETCH_PADDED_EXAMPLES = "dl4j_tpu_prefetch_padded_examples_total"
 BUCKET_HITS = "dl4j_tpu_shape_bucket_hits_total"
 BUCKET_MISSES = "dl4j_tpu_shape_bucket_misses_total"
 ON_DEVICE_BATCHES = "dl4j_tpu_on_device_batches_total"
+#: mixed-precision engine (nn/precision.py)
+LOSS_SCALE = "dl4j_tpu_loss_scale"
+LOSS_SCALE_OVERFLOWS = "dl4j_tpu_loss_scale_overflows_total"
+LOSS_SCALE_SKIPPED_STEPS = "dl4j_tpu_loss_scale_skipped_steps_total"
+PRECISION_CASTS = "dl4j_tpu_precision_casts_per_step"
 
 
 def enabled() -> bool:
@@ -616,4 +621,6 @@ __all__ = [
     "PREFETCH_QUEUE_DEPTH", "TRANSFER_OVERLAP_MS",
     "PREFETCH_PADDED_EXAMPLES", "BUCKET_HITS", "BUCKET_MISSES",
     "ON_DEVICE_BATCHES",
+    "LOSS_SCALE", "LOSS_SCALE_OVERFLOWS", "LOSS_SCALE_SKIPPED_STEPS",
+    "PRECISION_CASTS",
 ]

@@ -1,6 +1,9 @@
 """Utilities: model serialization, crash reporting."""
 
 from deeplearning4j_tpu.util.model_serializer import ModelSerializer
-from deeplearning4j_tpu.util.sharded_checkpoint import ShardedCheckpoint
+from deeplearning4j_tpu.util.sharded_checkpoint import (
+    ShardedCheckpoint, model_checkpoint_tree, restore_model, save_model,
+)
 
-__all__ = ["ModelSerializer", "ShardedCheckpoint"]
+__all__ = ["ModelSerializer", "ShardedCheckpoint",
+           "model_checkpoint_tree", "save_model", "restore_model"]

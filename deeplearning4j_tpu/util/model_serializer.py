@@ -152,6 +152,25 @@ def _check_composite_children(normalizer) -> None:
                 f"(expected one of {sorted(registry)})")
 
 
+def _restore_loss_scale(zf: zipfile.ZipFile, model) -> None:
+    """Load lossScaleState.npz into a freshly init()ed model. The
+    init() template exists whenever the conf carries a loss-scaling
+    precision policy; archives without the member (pre-policy saves or
+    non-scaling policies) restore to the fresh state unchanged."""
+    if "lossScaleState.npz" not in zf.namelist():
+        return
+    if getattr(model, "_loss_scale_state", None) is None:
+        return  # conf has no scaling policy; ignore the stray member
+    flat = _read_npz(zf, "lossScaleState.npz")
+    model._loss_scale_state = _unflatten_into(
+        model._loss_scale_state, flat)
+    # telemetry baseline follows the restored counters: without this,
+    # the first post-restore step would replay the checkpoint's whole
+    # overflow history into the process counters as one spurious jump
+    model._ls_seen = (int(flat.get("overflows", 0)),
+                      int(flat.get("skipped_steps", 0)))
+
+
 class ModelSerializer:
     @staticmethod
     def writeModel(model, path: str, save_updater: bool = True,
@@ -172,6 +191,14 @@ class ModelSerializer:
             if save_updater and model.opt_states is not None:
                 _write_npz(zf, "updaterState.npz",
                            _flatten_with_paths(model.opt_states))
+            # dynamic loss-scale state (mixed_float16 policies): exact
+            # resume keeps the live scale + overflow counters, so a
+            # restored run neither re-warms the scale from scratch nor
+            # forgets its overflow history (the policy itself rides in
+            # configuration.json)
+            if getattr(model, "_loss_scale_state", None) is not None:
+                _write_npz(zf, "lossScaleState.npz",
+                           _flatten_with_paths(model._loss_scale_state))
             meta = {"iteration": model.getIterationCount(),
                     "epoch": model.getEpochCount(),
                     "format": "deeplearning4j_tpu-1",
@@ -204,6 +231,7 @@ class ModelSerializer:
             if load_updater and "updaterState.npz" in zf.namelist():
                 upd = _read_npz(zf, "updaterState.npz")
                 model.opt_states = _unflatten_into(model.opt_states, upd)
+            _restore_loss_scale(zf, model)
             meta = json.loads(zf.read("meta.json").decode())
             model._iteration = meta.get("iteration", 0)
             model._epoch = meta.get("epoch", 0)
@@ -229,6 +257,7 @@ class ModelSerializer:
             if load_updater and "updaterState.npz" in zf.namelist():
                 upd = _read_npz(zf, "updaterState.npz")
                 model.opt_states = _unflatten_into(model.opt_states, upd)
+            _restore_loss_scale(zf, model)
             meta = json.loads(zf.read("meta.json").decode())
             model._iteration = meta.get("iteration", 0)
             model._epoch = meta.get("epoch", 0)

@@ -150,3 +150,52 @@ class ShardedCheckpoint:
     @staticmethod
     def exists(dirpath: str) -> bool:
         return os.path.exists(os.path.join(dirpath, "manifest.json"))
+
+
+# -------------------------------------------------- model-tree helpers
+def model_checkpoint_tree(model) -> Dict[str, Any]:
+    """The complete training-state pytree of a MultiLayerNetwork /
+    ComputationGraph for ``ShardedCheckpoint.save``: params,
+    non-trainable state (BN stats), updater state, and — when the
+    conf's precision policy uses dynamic loss scaling — the live
+    loss-scale state, so a resumed mixed_float16 run keeps its scale
+    and overflow counters instead of re-warming from the preset."""
+    is_graph = hasattr(model, "params_map")
+    tree: Dict[str, Any] = {
+        "params": model.params_map if is_graph else model.params_list,
+        "states": model.states_map if is_graph else model.states_list,
+        "opt": model.opt_states,
+    }
+    if getattr(model, "_loss_scale_state", None) is not None:
+        tree["loss_scale"] = model._loss_scale_state
+    return tree
+
+
+def save_model(dirpath: str, model, step: int = 0,
+               iterator_state: Optional[Dict[str, Any]] = None) -> None:
+    """``ShardedCheckpoint.save`` over ``model_checkpoint_tree``."""
+    ShardedCheckpoint.save(dirpath, model_checkpoint_tree(model),
+                           step=step, iterator_state=iterator_state)
+
+
+def restore_model(dirpath: str, model) -> Dict[str, Any]:
+    """Restore a sharded checkpoint INTO an initialized model (its
+    current trees are the sharding template). Returns the checkpoint
+    meta ({step, iterator_state})."""
+    template = model_checkpoint_tree(model)
+    tree, meta = ShardedCheckpoint.restore(dirpath, template)
+    if hasattr(model, "params_map"):
+        model.params_map = tree["params"]
+        model.states_map = tree["states"]
+    else:
+        model.params_list = tree["params"]
+        model.states_list = tree["states"]
+    model.opt_states = tree["opt"]
+    if "loss_scale" in tree:
+        model._loss_scale_state = tree["loss_scale"]
+        # keep the telemetry delta baseline in step with the restored
+        # counters (see model_serializer._restore_loss_scale)
+        model._ls_seen = (
+            int(np.asarray(tree["loss_scale"]["overflows"])),
+            int(np.asarray(tree["loss_scale"]["skipped_steps"])))
+    return meta

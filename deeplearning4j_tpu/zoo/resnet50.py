@@ -23,11 +23,13 @@ from deeplearning4j_tpu.zoo.base import ZooModel
 
 class ResNet50(ZooModel):
     def __init__(self, num_classes: int = 1000, seed: int = 42,
-                 updater=None, in_shape=(224, 224, 3)):
+                 updater=None, in_shape=(224, 224, 3), precision=None):
         self.num_classes = num_classes
         self.seed = seed
         self.updater = updater or Nesterovs(learning_rate=1e-1, momentum=0.9)
         self.in_shape = in_shape
+        #: mixed-precision policy (nn/precision.py preset name / object)
+        self.precision = precision
 
     # -- block builders (reference: ResNet50#convBlock / identityBlock) --
     def _conv_bn(self, b, name, inp, n_out, kernel, stride=(1, 1),
@@ -60,7 +62,7 @@ class ResNet50(ZooModel):
         h, w, c = self.in_shape
         b = (ComputationGraphConfiguration.graphBuilder()
              .seed(self.seed).updater(self.updater).weightInit("relu")
-             .l2(1e-4)
+             .l2(1e-4).precision(self.precision)
              .addInputs("input")
              .setInputTypes(InputType.convolutional(h, w, c)))
         # stem

@@ -15,16 +15,19 @@ from deeplearning4j_tpu.zoo.base import ZooModel
 
 class TextGenerationLSTM(ZooModel):
     def __init__(self, vocab_size: int = 77, hidden: int = 256,
-                 seed: int = 42, updater=None, tbptt_length: int = 50):
+                 seed: int = 42, updater=None, tbptt_length: int = 50,
+                 precision=None):
         self.vocab_size = vocab_size
         self.hidden = hidden
         self.seed = seed
         self.updater = updater or Adam(1e-3)
         self.tbptt_length = tbptt_length
+        #: mixed-precision policy (nn/precision.py preset name / object)
+        self.precision = precision
 
     def conf(self):
         lb = (NeuralNetConfiguration.builder().seed(self.seed)
-              .updater(self.updater).list()
+              .updater(self.updater).precision(self.precision).list()
               .layer(LSTM(n_out=self.hidden))
               .layer(LSTM(n_out=self.hidden))
               .layer(RnnOutputLayer(n_out=self.vocab_size,

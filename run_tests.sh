@@ -103,4 +103,74 @@ if [ $pfsmoke -ne 0 ]; then
     echo "FATAL: device-prefetch CPU fallback smoke regressed" >&2
     exit 1
 fi
+
+# Precision-matrix smoke gate: one tiny MLN fit per policy. Asserts
+# (a) finite loss under every policy, (b) NO dtype leak — master
+# params and updater state stay fp32 under the mixed policies, and
+# (c) mixed final loss within 2% of the f32 run (same seed/steps).
+# A cast placed on the wrong side of value_and_grad, or an updater
+# quietly downcasting its moments, fails here before any TPU run.
+env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu DL4J_TPU_TELEMETRY=1 \
+    python - <<'EOF'
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.learning.updaters import Adam
+from deeplearning4j_tpu.nn.conf import (
+    DenseLayer, InputType, NeuralNetConfiguration, OutputLayer,
+)
+from deeplearning4j_tpu.nn.multilayer.network import MultiLayerNetwork
+
+rs = np.random.RandomState(0)
+x = rs.randn(32, 8).astype(np.float32)
+y = np.eye(3, dtype=np.float32)[rs.randint(0, 3, 32)]
+
+
+def fit(policy):
+    conf = (NeuralNetConfiguration.builder().seed(11)
+            .updater(Adam(1e-2)).precision(policy).list()
+            .layer(DenseLayer(n_out=16, activation="relu"))
+            .layer(OutputLayer(n_out=3, activation="softmax",
+                               loss="mcxent"))
+            .setInputType(InputType.feedForward(8)).build())
+    net = MultiLayerNetwork(conf).init()
+    for _ in range(25):
+        net.fit(x, y)
+    dts = {str(l.dtype)
+           for t in (net.params_list, net.opt_states)
+           for l in jax.tree_util.tree_leaves(t)
+           if jnp.issubdtype(l.dtype, jnp.floating)}
+    return net.score(), dts
+
+
+losses = {}
+fail = []
+for pol in ("float32", "mixed_bfloat16", "mixed_float16"):
+    loss, dts = fit(pol)
+    losses[pol] = loss
+    if not np.isfinite(loss):
+        fail.append(f"{pol}: non-finite loss {loss}")
+    if dts != {"float32"}:
+        fail.append(f"{pol}: dtype leak — master/opt dtypes {dts}")
+for pol in ("mixed_bfloat16", "mixed_float16"):
+    rel = abs(losses[pol] - losses["float32"]) / abs(losses["float32"])
+    if rel > 0.02:
+        fail.append(f"{pol}: final loss {losses[pol]:.5f} deviates "
+                    f"{rel:.1%} from f32 {losses['float32']:.5f} "
+                    "(tolerance 2%)")
+if fail:
+    sys.stderr.write("precision-matrix smoke FAILED:\n  "
+                     + "\n  ".join(fail) + "\n")
+    sys.exit(1)
+print("precision-matrix smoke OK "
+      + " ".join(f"{k}={v:.5f}" for k, v in losses.items()))
+EOF
+precsmoke=$?
+if [ $precsmoke -ne 0 ]; then
+    echo "FATAL: precision-matrix smoke gate regressed" >&2
+    exit 1
+fi
 exit $rc

@@ -247,6 +247,49 @@ class TestLowPrecisionDtypeStability:
         state = Adam(1e-3).init_state(params)
         assert state["m"]["W"].dtype == jnp.float32
 
+    @pytest.mark.parametrize("upd_cls", [Adam, Nadam, AMSGrad],
+                             ids=lambda c: c.__name__)
+    @pytest.mark.parametrize("dtype", ["bfloat16", "float16"])
+    def test_bias_correction_is_f32_for_low_precision_params(
+            self, upd_cls, dtype):
+        """Regression for the _step_float extraction: the 1-beta^t bias
+        correction must run in f32 regardless of param/grad dtype. In
+        half precision beta2^t rounds to 1.0 within a few steps, making
+        1-beta2^t = 0 and the update alpha blow up — so we compare the
+        low-precision updater trajectory against a float64 reference of
+        the same math at a late step and require close agreement."""
+        dt = jnp.dtype(dtype)
+        upd = upd_cls(learning_rate=0.1)
+        step = 300   # f16: beta2^300 rounds to 1 unless corrected in f32
+        g64 = np.full((4,), 0.01, np.float64)
+
+        # low-precision path: params in dt; apply_updater casts grads f32
+        params = {"W": jnp.asarray(g64 * 0.0 + 1.0, dt)}
+        state = upd.init_state(params)
+        from deeplearning4j_tpu.learning.updaters import apply_updater
+        updates, state = apply_updater(
+            upd, state, {"W": jnp.asarray(g64, dt)}, params,
+            jnp.asarray(step))
+        # internal state stays f32
+        assert state["m"]["W"].dtype == jnp.float32
+        assert state["v"]["W"].dtype == jnp.float32
+
+        # float64 reference of one step from zero state at `step`
+        b1, b2, eps, lr = upd.beta1, upd.beta2, upd.epsilon, 0.1
+        t = step + 1
+        m = (1 - b1) * g64
+        v = (1 - b2) * g64 * g64
+        bc1, bc2 = 1 - b1 ** t, 1 - b2 ** t
+        if upd_cls is Adam or upd_cls is AMSGrad:
+            want = lr * np.sqrt(bc2) / bc1 * m / (np.sqrt(v) + eps)
+        else:   # Nadam
+            want = (lr / bc1 * (b1 * m + (1 - b1) * g64)
+                    / (np.sqrt(v / bc2) + eps))
+        got = np.asarray(updates["W"], np.float64)
+        # tolerance bounded by the PARAM dtype (the final cast), not by
+        # a degenerate bias correction — uncorrected f16 is off by ~1e3
+        np.testing.assert_allclose(got, want, rtol=2e-2)
+
 
 class TestRound4Losses:
     def test_wasserstein(self):

@@ -1,0 +1,493 @@
+"""Mixed-precision policy engine tests (nn/precision.py): fp32 master
+weights + bf16/f16 compute across MultiLayerNetwork / ComputationGraph /
+ShardedTrainer, dynamic loss scaling (overflow -> skip-and-halve),
+policy serde, checkpoint round-trips, and the loss-scale telemetry."""
+
+import os
+import zipfile
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.learning.updaters import Adam, Sgd
+from deeplearning4j_tpu.ndarray.dtypes import DataType
+from deeplearning4j_tpu.nn import precision as P
+from deeplearning4j_tpu.nn.conf import (
+    BatchNormalization, DenseLayer, InputType, LSTM,
+    NeuralNetConfiguration, OutputLayer, RnnOutputLayer,
+)
+from deeplearning4j_tpu.nn.conf.builder import MultiLayerConfiguration
+from deeplearning4j_tpu.nn.graph import ComputationGraphConfiguration
+from deeplearning4j_tpu.nn.graph.graph import ComputationGraph
+from deeplearning4j_tpu.nn.multilayer.network import MultiLayerNetwork
+from deeplearning4j_tpu.nn.precision import PrecisionPolicy
+from deeplearning4j_tpu.profiler import telemetry
+
+
+def _float_dtypes(tree):
+    return {str(l.dtype) for l in jax.tree_util.tree_leaves(tree)
+            if hasattr(l, "dtype")
+            and jnp.issubdtype(l.dtype, jnp.floating)}
+
+
+def _data(n=32, fin=10, classes=3, seed=0):
+    rs = np.random.RandomState(seed)
+    x = rs.randn(n, fin).astype(np.float32)
+    y = np.eye(classes, dtype=np.float32)[rs.randint(0, classes, n)]
+    return x, y
+
+
+def _mln(precision, seed=7, updater=None, bn=True):
+    b = (NeuralNetConfiguration.builder().seed(seed)
+         .updater(updater or Adam(1e-2)).precision(precision).list()
+         .layer(DenseLayer(n_out=16, activation="relu")))
+    if bn:
+        b = b.layer(BatchNormalization())
+    conf = (b.layer(OutputLayer(n_out=3, activation="softmax",
+                                loss="mcxent"))
+            .setInputType(InputType.feedForward(10)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+OVERFLOW_X = np.full((32, 10), 1e7, np.float32)  # inf once cast to f16
+
+
+# ----------------------------------------------------------------------
+# policy object
+# ----------------------------------------------------------------------
+class TestPolicy:
+    def test_presets(self):
+        f32 = PrecisionPolicy.of("float32")
+        assert f32.is_identity
+        bf = PrecisionPolicy.of("mixed_bfloat16")
+        assert (bf.param_dtype, bf.compute_dtype, bf.output_dtype) == \
+            ("float32", "bfloat16", "float32")
+        assert not bf.loss_scaling and not bf.is_identity
+        f16 = PrecisionPolicy.of("mixed_float16")
+        assert f16.compute_dtype == "float16" and f16.loss_scaling
+
+    def test_preset_aliases(self):
+        assert PrecisionPolicy.of("mixed_bf16").compute_dtype == "bfloat16"
+        assert PrecisionPolicy.of("mixed_fp16").loss_scaling
+        with pytest.raises(ValueError, match="Unknown precision"):
+            PrecisionPolicy.of("mixed_int8")
+
+    def test_resolve(self):
+        ident = PrecisionPolicy.resolve(None, "bfloat16")
+        assert ident.is_identity and ident.compute_dtype == "bfloat16"
+        assert PrecisionPolicy.resolve("mixed_bfloat16", "float32") \
+            .compute_dtype == "bfloat16"
+        pol = PrecisionPolicy.of("mixed_float16")
+        assert PrecisionPolicy.resolve(pol, "float32") is pol
+
+    def test_layer_dtype_islands(self):
+        pol = PrecisionPolicy.of("mixed_bfloat16")
+        assert pol.layer_compute_dtype(DenseLayer(n_out=4), 0) == \
+            jnp.dtype("bfloat16")
+        assert pol.layer_compute_dtype(BatchNormalization(), 1) == \
+            jnp.dtype("float32")      # normalization island
+        assert pol.layer_compute_dtype(OutputLayer(n_out=2), 2) == \
+            jnp.dtype("float32")      # loss head island
+
+    def test_layer_overrides(self):
+        pol = PrecisionPolicy(name="c", compute_dtype="bfloat16",
+                              layer_overrides={0: "float32",
+                                               "att": "float16"})
+        assert pol.layer_compute_dtype(DenseLayer(n_out=4), 0) == \
+            jnp.dtype("float32")
+        assert pol.layer_compute_dtype(DenseLayer(n_out=4), "att") == \
+            jnp.dtype("float16")
+        assert pol.layer_compute_dtype(DenseLayer(n_out=4), 5) == \
+            jnp.dtype("bfloat16")
+
+    def test_conf_json_round_trip(self):
+        for prec in ("mixed_bfloat16",
+                     PrecisionPolicy.of("mixed_float16"),
+                     PrecisionPolicy(name="c", compute_dtype="bfloat16",
+                                     layer_overrides={1: "float32"})):
+            conf = (NeuralNetConfiguration.builder().precision(prec)
+                    .list()
+                    .layer(DenseLayer(n_out=4, activation="relu"))
+                    .layer(OutputLayer(n_out=2, activation="softmax",
+                                       loss="mcxent"))
+                    .setInputType(InputType.feedForward(3)).build())
+            c2 = MultiLayerConfiguration.from_json(conf.to_json())
+            assert c2.precision == conf.precision
+
+    def test_loss_scale_update_schedule(self):
+        pol = PrecisionPolicy.of("mixed_float16")
+        pol.growth_interval = 2
+        st = P.init_loss_scale(pol)
+        s0 = float(st["scale"])
+        st = P.update_loss_scale(pol, st, jnp.asarray(False))
+        assert float(st["scale"]) == s0 / 2
+        assert int(st["overflows"]) == 1
+        st = P.update_loss_scale(pol, st, jnp.asarray(True))
+        st = P.update_loss_scale(pol, st, jnp.asarray(True))
+        assert float(st["scale"]) == s0   # doubled after 2 clean steps
+        # floor at min_loss_scale
+        st["scale"] = jnp.asarray(1.0, jnp.float32)
+        st = P.update_loss_scale(pol, st, jnp.asarray(False))
+        assert float(st["scale"]) == pol.min_loss_scale
+        # ceiling at max_loss_scale: growth must never reach f32 inf
+        # (inf * backoff = inf would skip every step forever)
+        st["scale"] = jnp.asarray(pol.max_loss_scale, jnp.float32)
+        st["good_steps"] = jnp.asarray(pol.growth_interval - 1,
+                                       jnp.int32)
+        st = P.update_loss_scale(pol, st, jnp.asarray(True))
+        assert float(st["scale"]) == pol.max_loss_scale
+
+
+# ----------------------------------------------------------------------
+# dtype aliases (satellite)
+# ----------------------------------------------------------------------
+class TestDtypeAliases:
+    @pytest.mark.parametrize("alias,expect", [
+        ("bf16", DataType.BFLOAT16), ("fp16", DataType.HALF),
+        ("half", DataType.HALF), ("f16", DataType.HALF),
+        ("f32", DataType.FLOAT), ("fp32", DataType.FLOAT),
+        ("f64", DataType.DOUBLE), ("double", DataType.DOUBLE),
+        ("BF16", DataType.BFLOAT16),  # case-insensitive
+        ("float32", DataType.FLOAT), ("bfloat16", DataType.BFLOAT16),
+    ])
+    def test_alias(self, alias, expect):
+        assert DataType.from_any(alias) is expect
+
+    def test_bad_alias_still_raises(self):
+        with pytest.raises((ValueError, TypeError)):
+            DataType.from_any("not_a_dtype")
+
+
+# ----------------------------------------------------------------------
+# MultiLayerNetwork
+# ----------------------------------------------------------------------
+class TestMLNMixed:
+    def test_bf16_masters_stay_fp32_and_loss_parity(self):
+        x, y = _data()
+        nets = {}
+        for pol in ("float32", "mixed_bfloat16"):
+            net = _mln(pol)
+            for _ in range(30):
+                net.fit(x, y)
+            nets[pol] = net
+            assert _float_dtypes(net.params_list) == {"float32"}
+            assert _float_dtypes(net.opt_states) == {"float32"}
+            assert np.isfinite(net.score())
+        rel = abs(nets["mixed_bfloat16"].score()
+                  - nets["float32"].score()) / nets["float32"].score()
+        assert rel < 0.02   # acceptance: parity within 2%
+
+    def test_identity_policy_matches_legacy_exactly(self):
+        """precision=None must be bit-identical to the pre-policy code
+        path (same seed, same steps)."""
+        x, y = _data()
+        a, b = _mln(None), _mln("float32")
+        for _ in range(5):
+            a.fit(x, y)
+            b.fit(x, y)
+        for la, lb in zip(jax.tree_util.tree_leaves(a.params_list),
+                          jax.tree_util.tree_leaves(b.params_list)):
+            np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+
+    def test_output_honors_output_dtype(self):
+        x, y = _data()
+        net = _mln("mixed_bfloat16")
+        net.fit(x, y)
+        assert net.output(x).jax.dtype == jnp.float32
+        acts = net.feedForward(x)
+        assert acts[-1].jax.dtype == jnp.float32
+        # custom policy: bf16 outputs on request
+        pol = PrecisionPolicy(name="c", compute_dtype="bfloat16",
+                              output_dtype="bfloat16")
+        net2 = _mln(pol)
+        assert net2.output(x).jax.dtype == jnp.dtype("bfloat16")
+
+    def test_per_layer_override_forces_fp32_compute(self):
+        x, y = _data()
+        pol = PrecisionPolicy(name="c", compute_dtype="bfloat16",
+                              layer_overrides={0: "float32"})
+        net = _mln(pol)
+        net.fit(x, y)
+        assert net._compute_dtypes[0] == jnp.dtype("float32")
+        assert np.isfinite(net.score())
+
+    def test_cast_count_gauge_recorded(self):
+        telemetry.reset()
+        _mln("mixed_bfloat16")
+        g = telemetry.MetricsRegistry.get_default().gauge(
+            P.PRECISION_CASTS)
+        # dense W/b cast to bf16; BN + loss head stay fp32 islands
+        assert g.value(site="mln") == 2
+
+    def test_mixed_policy_with_lstm_tbptt(self):
+        rs = np.random.RandomState(0)
+        conf = (NeuralNetConfiguration.builder().seed(3)
+                .updater(Adam(1e-2)).precision("mixed_bfloat16").list()
+                .layer(LSTM(n_out=8))
+                .layer(RnnOutputLayer(n_out=5, activation="softmax",
+                                      loss="mcxent"))
+                .setInputType(InputType.recurrent(5))
+                .backpropType("TruncatedBPTT").tBPTTLength(4).build())
+        net = MultiLayerNetwork(conf).init()
+        x = rs.randn(4, 12, 5).astype(np.float32)
+        y = np.eye(5, dtype=np.float32)[
+            rs.randint(0, 5, (4, 12))].astype(np.float32)
+        net.fit(x, y)
+        assert _float_dtypes(net.params_list) == {"float32"}
+        assert np.isfinite(net.score())
+        # stateful stepping under the policy
+        out = net.rnnTimeStep(x[:, 0])
+        assert out.jax.dtype == jnp.float32
+
+
+class TestMLNLossScaling:
+    def test_overflow_halves_scale_and_skips_step(self):
+        x, y = _data()
+        net = _mln("mixed_float16")
+        net.fit(x, y)
+        s0 = float(net._loss_scale_state["scale"])
+        p0 = jax.device_get(net.params_list)
+        o0 = jax.device_get(net.opt_states)
+        net.fit(OVERFLOW_X, y)   # f16 forward overflows -> non-finite
+        st = net._loss_scale_state
+        assert float(st["scale"]) == s0 * 0.5
+        assert int(st["overflows"]) == 1
+        assert int(st["skipped_steps"]) == 1
+        # the NaN step was NOT applied: params and moments held exactly
+        for a, b in zip(jax.tree_util.tree_leaves(p0),
+                        jax.tree_util.tree_leaves(
+                            jax.device_get(net.params_list))):
+            np.testing.assert_array_equal(a, b)
+        for a, b in zip(jax.tree_util.tree_leaves(o0),
+                        jax.tree_util.tree_leaves(
+                            jax.device_get(net.opt_states))):
+            np.testing.assert_array_equal(a, b)
+        # training recovers on the next clean batch
+        net.fit(x, y)
+        assert all(np.isfinite(l).all() for l in
+                   jax.tree_util.tree_leaves(
+                       jax.device_get(net.params_list)))
+
+    def test_scale_grows_after_interval(self):
+        x, y = _data()
+        pol = PrecisionPolicy.of("mixed_float16")
+        pol.growth_interval = 3
+        net = _mln(pol)
+        s0 = float(net._loss_scale_state["scale"])
+        for _ in range(3):
+            net.fit(x, y)
+        assert float(net._loss_scale_state["scale"]) == s0 * 2
+
+    def test_telemetry_counters_increment(self):
+        telemetry.reset()
+        x, y = _data()
+        net = _mln("mixed_float16")
+        net.fit(x, y)
+        reg = telemetry.MetricsRegistry.get_default()
+        assert reg.gauge(P.LOSS_SCALE).value(site="mln") > 0
+        assert reg.counter(P.LOSS_SCALE_OVERFLOWS).total() == 0
+        net.fit(OVERFLOW_X, y)
+        assert reg.counter(P.LOSS_SCALE_OVERFLOWS).value(site="mln") == 1
+        assert reg.counter(
+            P.LOSS_SCALE_SKIPPED_STEPS).value(site="mln") == 1
+        assert reg.gauge(P.LOSS_SCALE).value(site="mln") == \
+            float(net._loss_scale_state["scale"])
+
+    def test_f16_loss_parity_on_clean_data(self):
+        x, y = _data()
+        f32 = _mln("float32")
+        f16 = _mln("mixed_float16")
+        for _ in range(30):
+            f32.fit(x, y)
+            f16.fit(x, y)
+        rel = abs(f16.score() - f32.score()) / f32.score()
+        assert rel < 0.02
+        assert int(f16._loss_scale_state["skipped_steps"]) == 0
+
+
+# ----------------------------------------------------------------------
+# check_numerics under half-precision (satellite)
+# ----------------------------------------------------------------------
+class TestCheckNumericsHalfPrecision:
+    @pytest.mark.parametrize("dtype", ["bfloat16", "float16"])
+    def test_flags_injected_inf_in_half_precision_activation(self, dtype):
+        from deeplearning4j_tpu.profiler import (
+            NumericsException, ProfilerMode, check_numerics,
+        )
+
+        act = jnp.asarray([1.0, jnp.inf, 2.0], jnp.dtype(dtype))
+        with pytest.raises(NumericsException, match="Inf"):
+            check_numerics(act, ProfilerMode.INF_PANIC, "in test act")
+        nan_act = jnp.asarray([1.0, jnp.nan], jnp.dtype(dtype))
+        with pytest.raises(NumericsException, match="NaN"):
+            check_numerics(nan_act, ProfilerMode.NAN_PANIC, "in test act")
+        # clean half-precision trees pass
+        check_numerics(act[:1], ProfilerMode.ANY_PANIC, "clean")
+
+    def test_panic_message_carries_loss_scale_context(self):
+        from deeplearning4j_tpu.profiler import (
+            NumericsException, OpProfiler, ProfilerConfig, ProfilerMode,
+        )
+
+        x, y = _data()
+        net = _mln("mixed_float16")
+        net.fit(x, y)
+        prof = OpProfiler.getInstance()
+        old = prof.config
+        prof.config = ProfilerConfig(mode=ProfilerMode.ANY_PANIC)
+        try:
+            with pytest.raises(NumericsException) as ei:
+                net.fit(OVERFLOW_X, y)
+            assert "loss_scale" in str(ei.value)
+            assert "skipped" in str(ei.value)
+        finally:
+            prof.config = old
+
+
+# ----------------------------------------------------------------------
+# ComputationGraph
+# ----------------------------------------------------------------------
+def _cg(precision, seed=7):
+    b = (ComputationGraphConfiguration.graphBuilder().seed(seed)
+         .updater(Adam(1e-2)).precision(precision)
+         .addInputs("in")
+         .addLayer("d1", DenseLayer(n_out=16, activation="relu"), "in")
+         .addLayer("bn", BatchNormalization(), "d1")
+         .addLayer("out", OutputLayer(n_out=3, activation="softmax",
+                                      loss="mcxent"), "bn")
+         .setOutputs("out")
+         .setInputTypes(InputType.feedForward(10)))
+    return ComputationGraph(b.build()).init()
+
+
+class TestCGMixed:
+    def test_bf16_masters_and_parity(self):
+        x, y = _data()
+        f32, bf = _cg(None), _cg("mixed_bfloat16")
+        for _ in range(20):
+            f32.fit(x, y)
+            bf.fit(x, y)
+        assert _float_dtypes(bf.params_map) == {"float32"}
+        assert _float_dtypes(bf.opt_states) == {"float32"}
+        rel = abs(bf.score() - f32.score()) / f32.score()
+        assert rel < 0.02
+        assert bf.output(x)[0].jax.dtype == jnp.float32
+
+    def test_f16_overflow_skips_and_halves(self):
+        x, y = _data()
+        g = _cg("mixed_float16")
+        g.fit(x, y)
+        s0 = float(g._loss_scale_state["scale"])
+        p0 = jax.device_get(g.params_map)
+        g.fit(OVERFLOW_X, y)
+        assert float(g._loss_scale_state["scale"]) == s0 * 0.5
+        for a, b in zip(jax.tree_util.tree_leaves(p0),
+                        jax.tree_util.tree_leaves(
+                            jax.device_get(g.params_map))):
+            np.testing.assert_array_equal(a, b)
+
+    def test_graph_json_round_trip_with_policy(self):
+        conf = _cg("mixed_bfloat16").conf
+        c2 = ComputationGraphConfiguration.from_json(conf.to_json())
+        assert c2.precision == "mixed_bfloat16"
+
+
+# ----------------------------------------------------------------------
+# ShardedTrainer
+# ----------------------------------------------------------------------
+class TestShardedMixed:
+    def test_sharing_bf16_and_f16(self):
+        from deeplearning4j_tpu.parallel.sharded import ShardedTrainer
+
+        x, y = _data()
+        for pol in ("mixed_bfloat16", "mixed_float16"):
+            net = _mln(pol, bn=False)
+            tr = ShardedTrainer(net)
+            for _ in range(4):
+                tr.fit(x, y)
+            assert _float_dtypes(net.params_list) == {"float32"}
+            assert np.isfinite(net.score())
+
+    def test_sharing_f16_overflow(self):
+        from deeplearning4j_tpu.parallel.sharded import ShardedTrainer
+
+        x, y = _data()
+        net = _mln("mixed_float16", bn=False)
+        tr = ShardedTrainer(net)
+        tr.fit(x, y)
+        s0 = float(net._loss_scale_state["scale"])
+        tr.fit(OVERFLOW_X, y)
+        assert float(net._loss_scale_state["scale"]) == s0 * 0.5
+
+    def test_loss_scaling_rejected_off_sharing(self):
+        from deeplearning4j_tpu.parallel.sharded import ShardedTrainer
+
+        for mode in ("averaging", "sharing_compressed"):
+            with pytest.raises(ValueError, match="loss scaling"):
+                ShardedTrainer(_mln("mixed_float16", bn=False),
+                               mode=mode)
+            # bf16 (no scaling state) is fine everywhere
+            ShardedTrainer(_mln("mixed_bfloat16", bn=False), mode=mode)
+
+
+# ----------------------------------------------------------------------
+# serialization
+# ----------------------------------------------------------------------
+class TestPrecisionSerialization:
+    def test_model_serializer_round_trips_policy_and_scale(self, tmp_path):
+        from deeplearning4j_tpu.util import ModelSerializer
+
+        x, y = _data()
+        net = _mln("mixed_float16")
+        net.fit(x, y)
+        net.fit(OVERFLOW_X, y)   # scale halved once
+        path = str(tmp_path / "m.zip")
+        ModelSerializer.writeModel(net, path)
+        with zipfile.ZipFile(path) as zf:
+            assert "lossScaleState.npz" in zf.namelist()
+        m2 = ModelSerializer.restoreMultiLayerNetwork(path)
+        assert m2._policy.loss_scaling
+        assert float(m2._loss_scale_state["scale"]) == \
+            float(net._loss_scale_state["scale"])
+        assert int(m2._loss_scale_state["skipped_steps"]) == 1
+        # telemetry baseline tracks the restored counters — a resumed
+        # run must not replay checkpointed overflows into the process
+        # counters as one spurious jump
+        assert m2._ls_seen == (1, 1)
+        m2.fit(x, y)   # resumes training
+        assert np.isfinite(m2.score())
+
+    def test_bf16_policy_archive_has_no_scale_member(self, tmp_path):
+        from deeplearning4j_tpu.util import ModelSerializer
+
+        x, y = _data()
+        net = _mln("mixed_bfloat16")
+        net.fit(x, y)
+        path = str(tmp_path / "m.zip")
+        ModelSerializer.writeModel(net, path)
+        with zipfile.ZipFile(path) as zf:
+            assert "lossScaleState.npz" not in zf.namelist()
+        m2 = ModelSerializer.restoreMultiLayerNetwork(path)
+        assert m2._policy.compute_dtype == "bfloat16"
+        assert _float_dtypes(m2.params_list) == {"float32"}
+
+    def test_sharded_checkpoint_model_helpers(self, tmp_path):
+        from deeplearning4j_tpu.util import restore_model, save_model
+
+        x, y = _data()
+        net = _mln("mixed_float16")
+        net.fit(x, y)
+        net.fit(OVERFLOW_X, y)
+        save_model(str(tmp_path), net, step=2,
+                   iterator_state={"i": 4})
+        net2 = _mln("mixed_float16")
+        meta = restore_model(str(tmp_path), net2)
+        assert meta["step"] == 2
+        assert meta["iterator_state"] == {"i": 4}
+        assert float(net2._loss_scale_state["scale"]) == \
+            float(net._loss_scale_state["scale"])
+        for a, b in zip(jax.tree_util.tree_leaves(net.params_list),
+                        jax.tree_util.tree_leaves(net2.params_list)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
