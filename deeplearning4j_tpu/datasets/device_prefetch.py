@@ -52,7 +52,9 @@ recompile-storm detector reads to recommend enabling bucketing.
 
 from __future__ import annotations
 
+import logging
 import queue
+import random
 import threading
 import time
 from typing import Optional, Sequence
@@ -69,6 +71,8 @@ from deeplearning4j_tpu.datasets.record_reader_iterator import (
     AsyncDataSetIterator,
 )
 from deeplearning4j_tpu.profiler import telemetry as _telemetry
+
+log = logging.getLogger("deeplearning4j_tpu")
 
 
 def _next_pow2(n: int) -> int:
@@ -290,6 +294,45 @@ class BatchShapePolicy:
                         "each — total bounded by #buckets)").inc()
 
 
+class _StateTaggingIterator(DataSetIterator):
+    """Attaches the underlying iterator's post-batch ``get_state()`` to
+    each batch (as a ``_iter_state`` attribute) so the prefetcher can
+    answer ``get_state()`` for the batch the CONSUMER last saw, not for
+    wherever the lookahead workers have raced to — the difference is
+    the whole point of checkpointable prefetch (SURVEY.md §5: resume
+    mid-epoch on the NEXT batch)."""
+
+    def __init__(self, underlying: DataSetIterator):
+        self.underlying = underlying
+        # set by DevicePrefetchIterator.set_state: the worker's
+        # epoch-opening reset() must not discard a just-restored
+        # mid-epoch position
+        self._skip_next_reset = False
+
+    def reset(self):
+        if self._skip_next_reset:
+            self._skip_next_reset = False
+            return
+        self.underlying.reset()
+
+    def hasNext(self) -> bool:
+        return self.underlying.hasNext()
+
+    def next(self) -> DataSet:
+        ds = self.underlying.next()
+        try:
+            ds._iter_state = self.underlying.get_state()
+        except Exception:
+            ds._iter_state = None
+        return ds
+
+    def batch(self) -> int:
+        return self.underlying.batch()
+
+    def resetSupported(self) -> bool:
+        return self.underlying.resetSupported()
+
+
 class DevicePrefetchIterator(DataSetIterator):
     """Async host-ETL + device-transfer prefetcher.
 
@@ -325,11 +368,32 @@ class DevicePrefetchIterator(DataSetIterator):
     def __init__(self, underlying, depth: int = 2,
                  policy: Optional[BatchShapePolicy] = None,
                  mesh=None, device=None, dtype=None,
-                 host_queue_size: int = 4):
+                 host_queue_size: int = 4,
+                 transfer_retries: int = 0,
+                 transfer_backoff: float = 0.05,
+                 quarantine: bool = False):
         if depth < 0:
             raise ValueError(f"depth must be >= 0, got {depth}")
         self.underlying = underlying
         self.depth = int(depth)
+        # transient-transfer policy (util/resilience.py configures this
+        # when a FaultTolerance drives the fit): retry a failed
+        # device transfer with exponential backoff + jitter; after the
+        # budget, either quarantine the poison batch (skip + count) or
+        # re-raise (the legacy kill-the-run default)
+        self._transfer_retries = max(int(transfer_retries), 0)
+        self._transfer_backoff = float(transfer_backoff)
+        self._quarantine = bool(quarantine)
+        # checkpointable-position support: tag every batch with the
+        # underlying's post-batch state when it can report one
+        self._last_state = None
+        self._tagged: Optional[_StateTaggingIterator] = None
+        try:
+            underlying.get_state()
+        except Exception:
+            pass
+        else:
+            self._tagged = _StateTaggingIterator(underlying)
         self.policy = policy if policy is not None \
             else BatchShapePolicy("exact")
         if self.policy.batch_size is None and self.policy.mode != "exact":
@@ -361,6 +425,11 @@ class DevicePrefetchIterator(DataSetIterator):
         self._dtype = dtype
         self._host_queue_size = max(int(host_queue_size), 1)
         self._host = None
+        #: depth==0 quarantine-mode lookahead: (batch, post-batch
+        #: iterator state) — hasNext() must absorb quarantined batches
+        #: so the hasNext()==True -> next() contract survives a
+        #: quarantined FINAL batch
+        self._peek0 = None
         self._thread: Optional[threading.Thread] = None
         self._q: Optional[queue.Queue] = None
         self._stop: Optional[threading.Event] = None
@@ -400,6 +469,14 @@ class DevicePrefetchIterator(DataSetIterator):
     def _prepare(self, ds):
         """Policy + device transfer. Returns (placed batch, issue time)
         — the issue time feeds the transfer-overlap histogram."""
+        from deeplearning4j_tpu.profiler import chaos as _chaos
+
+        monkey = _chaos.active()
+        if monkey is not None:
+            # inside _prepare so every RETRY attempt re-rolls — a
+            # transient injected error clears on the next attempt
+            monkey.maybe_fail_transfer()
+        tag = getattr(ds, "_iter_state", None)
         ds = self.policy.apply(ds)
         t_issue = time.perf_counter()
         if isinstance(ds, MultiDataSet):
@@ -413,7 +490,54 @@ class DevicePrefetchIterator(DataSetIterator):
                              self._place(ds.labels),
                              self._place(ds.features_mask),
                              self._place(ds.labels_mask))
+        placed._iter_state = tag
         return placed, t_issue
+
+    def configure_retries(self, retries: int, backoff: float = 0.05,
+                          quarantine: bool = True) -> None:
+        """Set the transient-transfer policy (FaultTolerance seam)."""
+        self._transfer_retries = max(int(retries), 0)
+        self._transfer_backoff = float(backoff)
+        self._quarantine = bool(quarantine)
+
+    def _prepare_with_retry(self, ds, stop=None):
+        """``_prepare`` under the transient-failure policy: up to
+        ``transfer_retries`` retries with exponential backoff + jitter
+        (decorrelates retry storms when many workers share a flaky
+        link), then quarantine (return None — the batch is dropped,
+        counted and logged) or re-raise. retries=0 is the legacy
+        fail-fast path, byte-for-byte."""
+        if self._transfer_retries == 0 and not self._quarantine:
+            return self._prepare(ds)
+        attempts = 0
+        while True:
+            try:
+                return self._prepare(ds)
+            except Exception as e:
+                attempts += 1
+                if attempts > self._transfer_retries:
+                    if not self._quarantine:
+                        raise
+                    if _telemetry.enabled():
+                        _telemetry.MetricsRegistry.get_default().counter(
+                            _telemetry.TRANSFER_QUARANTINES,
+                            "batches dropped after exhausting transfer "
+                            "retries (poison-batch quarantine)").inc()
+                    log.warning(
+                        "DevicePrefetch: quarantining a batch after %d "
+                        "failed transfer attempts (%s: %s) — training "
+                        "continues on the next batch",
+                        attempts, type(e).__name__, e)
+                    return None
+                if stop is not None and stop.is_set():
+                    raise   # tearing down — don't sleep through it
+                if _telemetry.enabled():
+                    _telemetry.MetricsRegistry.get_default().counter(
+                        _telemetry.TRANSFER_RETRIES,
+                        "transient host->device transfer retries").inc()
+                delay = self._transfer_backoff * (2 ** (attempts - 1))
+                delay += random.uniform(0, self._transfer_backoff)
+                time.sleep(min(delay, 2.0))
 
     # ------------------------------------------------------- threading
     def _gauge_depth(self) -> None:
@@ -428,12 +552,17 @@ class DevicePrefetchIterator(DataSetIterator):
             return
         if self._host is None:
             self._host = AsyncDataSetIterator(
-                self.underlying, queue_size=self._host_queue_size)
+                self._tagged if self._tagged is not None
+                else self.underlying,
+                queue_size=self._host_queue_size)
         else:
             self._host.reset()   # reopen after shutdown
         self._start()
 
     def _start(self) -> None:
+        # _last_state deliberately survives a (re)start: the lazy start
+        # right after set_state() must not wipe the restored position
+        # out of get_state(); an explicit reset() clears it instead
         self._error = None
         self._exhausted = False
         self._consumed = False
@@ -445,7 +574,10 @@ class DevicePrefetchIterator(DataSetIterator):
         def worker():
             try:
                 while not stop.is_set() and self._host.hasNext():
-                    item = self._prepare(self._host.next())
+                    item = self._prepare_with_retry(self._host.next(),
+                                                    stop=stop)
+                    if item is None:
+                        continue   # quarantined poison batch — skip
                     # put with a poll so stop can't wedge a producer
                     # blocked on a full queue (same discipline as
                     # AsyncDataSetIterator's worker)
@@ -514,12 +646,26 @@ class DevicePrefetchIterator(DataSetIterator):
 
     # ------------------------------------------------------- iteration
     def reset(self):
+        self._peek0 = None
+        if self._tagged is not None and self._tagged._skip_next_reset:
+            # an explicit reset() after set_state() means the caller
+            # wants the NEXT epoch from the restored epoch counter
+            # (the boundary-resume idiom) — the suppress flag only
+            # protects the restored position from the host worker's
+            # AUTOMATIC start-of-pipeline reset, so consume it here and
+            # let this reset reach the underlying iterator
+            self._tagged._skip_next_reset = False
         if self.depth == 0:
+            self._last_state = None
             self.underlying.reset()
             return
         self._closed = False
         if self._thread is None:
             self._exhausted = False   # reopen; workers start lazily
+            # a reset after set_state discards the restored position
+            # (the tagger's skip flag was consumed above, so the lazily
+            # started worker will really reset the underlying)
+            self._last_state = None
             return
         if not self._consumed and not self._exhausted \
                 and self._error is None:
@@ -528,12 +674,29 @@ class DevicePrefetchIterator(DataSetIterator):
             # and re-transferring them
             return
         self._stop_transfer()
+        self._last_state = None   # reset discards any restored position
         self._host.reset()
         self._start()
 
     def hasNext(self) -> bool:
         if self.depth == 0:
-            return self.underlying.hasNext()
+            if self._peek0 is not None:
+                return True
+            if not self._quarantine:
+                return self.underlying.hasNext()
+            # quarantine mode must answer hasNext through the transfer:
+            # a quarantined FINAL batch would otherwise turn a True
+            # hasNext into a StopIteration out of next()
+            while self.underlying.hasNext():
+                item = self._prepare_with_retry(self.underlying.next())
+                if item is not None:
+                    try:
+                        st = self.underlying.get_state()
+                    except Exception:
+                        st = None
+                    self._peek0 = (item[0], st)
+                    return True
+            return False
         self._ensure_started()
         if self._exhausted:
             return False
@@ -559,13 +722,84 @@ class DevicePrefetchIterator(DataSetIterator):
 
     def next(self):
         if self.depth == 0:
-            ds, _ = self._prepare(self.underlying.next())
+            if self._peek0 is None and not self._quarantine:
+                ds, _ = self._prepare_with_retry(self.underlying.next())
+                return ds
+            if not self.hasNext():   # fills _peek0, absorbing
+                raise StopIteration  # quarantined batches
+            ds, st = self._peek0
+            self._peek0 = None
+            if st is not None:
+                self._last_state = st
             return ds
         if not self.hasNext():
             raise StopIteration
         ds, self._peek = self._peek, None
         self._consumed = True
+        tag = getattr(ds, "_iter_state", None)
+        if tag is not None:
+            self._last_state = tag
         return ds
+
+    # ----------------------------------------------- checkpointable state
+    def get_state(self) -> dict:
+        """Position of the batch the CONSUMER last received — not the
+        lookahead workers' position, which is up to
+        ``host_queue_size + depth`` batches ahead. Restoring this state
+        re-produces every prefetched-but-unconsumed batch, which is the
+        correct resume semantics: nothing consumed twice, nothing
+        skipped."""
+        if self.depth == 0:
+            if self._peek0 is not None or (self._quarantine
+                                           and self._last_state is not None):
+                # a lookahead batch is (or was) in flight: the
+                # underlying iterator is one batch ahead of the
+                # consumer — report the consumed position
+                if self._last_state is None:
+                    raise RuntimeError(
+                        "DevicePrefetchIterator.get_state: no batch "
+                        "consumed since the last reset — the position "
+                        "is epoch start; checkpoint with "
+                        "iterator_state=None instead")
+                return {"underlying": self._last_state}
+            return {"underlying": self.underlying.get_state()}
+        if self._tagged is None:
+            raise NotImplementedError(
+                f"{type(self.underlying).__name__} does not support "
+                "state capture")
+        if self._last_state is None:
+            raise RuntimeError(
+                "DevicePrefetchIterator.get_state: no batch consumed "
+                "since the last reset — the position is epoch start; "
+                "checkpoint with iterator_state=None instead")
+        return {"underlying": self._last_state}
+
+    def set_state(self, state: dict) -> None:
+        under_state = state.get("underlying", state) \
+            if isinstance(state, dict) else state
+        if self.depth == 0:
+            self._peek0 = None
+            self.underlying.set_state(under_state)
+            self._last_state = under_state
+            return
+        if self._tagged is None:
+            raise NotImplementedError(
+                f"{type(self.underlying).__name__} does not support "
+                "state restore")
+        # tear down the running pipeline: its queues hold batches from
+        # the OLD position
+        if self._thread is not None:
+            self._stop_transfer()
+        if self._host is not None:
+            self._host.shutdown()
+        self.underlying.set_state(under_state)
+        # the lazily-restarted host worker opens with a reset() — it
+        # must not discard the position just restored
+        self._tagged._skip_next_reset = True
+        self._closed = False
+        self._exhausted = False
+        self._consumed = False
+        self._last_state = under_state
 
     def batch(self) -> int:
         if self.policy.mode != "exact" and self.policy.batch_size:

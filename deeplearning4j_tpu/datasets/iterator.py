@@ -69,6 +69,18 @@ class ListDataSetIterator(DataSetIterator):
         self._i += 1
         return ds
 
+    def get_state(self) -> dict:
+        return {"i": int(self._i)}
+
+    def set_state(self, state: dict) -> None:
+        i = int(state["i"])
+        if not 0 <= i <= len(self._ds):
+            raise ValueError(
+                f"iterator state position {i} out of range for "
+                f"{len(self._ds)} datasets (checkpoint from a "
+                "different dataset?)")
+        self._i = i
+
     def batch(self) -> int:
         return self._batch
 
@@ -108,8 +120,14 @@ class ArrayDataSetIterator(DataSetIterator):
         return {"i": int(self._i), "epoch": int(self._epoch)}
 
     def set_state(self, state: dict) -> None:
+        i = int(state["i"])
+        if not 0 <= i <= self._x.shape[0]:
+            raise ValueError(
+                f"iterator state position {i} out of range for "
+                f"{self._x.shape[0]} examples (checkpoint from a "
+                "different dataset?)")
         self._epoch = int(state["epoch"])
-        self._i = int(state["i"])
+        self._i = i
         # the shuffle order is a pure function of (seed, epoch), so
         # restoring (epoch, i) reproduces the exact batch sequence
         self._maybe_shuffle()

@@ -299,6 +299,10 @@ class LocalFileModelSaver(EarlyStoppingModelSaver):
     def save_best_model(self, model, score: float) -> None:
         from deeplearning4j_tpu.util.model_serializer import ModelSerializer
 
+        # writeModel publishes via unique-temp + fsync + os.replace
+        # (the CheckpointListener atomic pattern): bestModel.bin is
+        # either the previous best or the complete new one — a crash
+        # mid-save can't destroy the best model found so far
         ModelSerializer.writeModel(model, self.best_path)
 
     def save_latest_model(self, model, score: float) -> None:
@@ -450,6 +454,16 @@ class EarlyStoppingTrainer:
                 epoch += 1
                 if stop:
                     break
+        except (KeyboardInterrupt, SystemExit):
+            # interrupts/preemption must reach the caller (the
+            # FaultTolerance layer turns them into a clean checkpoint-
+            # and-exit). `except Exception` below never caught these
+            # (they subclass BaseException), so this clause changes
+            # nothing today — it makes the contract EXPLICIT so a
+            # future broadening of the handler can't silently start
+            # swallowing the operator's stop request; listeners are
+            # still restored by the finally below
+            raise
         except Exception as e:                      # noqa: BLE001
             # ref: BaseEarlyStoppingTrainer catches and reports Error
             reason = TerminationReason.ERROR

@@ -395,8 +395,17 @@ class ComputationGraph:
         return jitted
 
     # ------------------------------------------------------------------
-    def fit(self, data, labels=None, epochs: int = 1):
+    def fit(self, data, labels=None, epochs: int = 1,
+            fault_tolerance=None, auto_resume=None):
         self._check_init()
+        if fault_tolerance is not None or auto_resume is not None:
+            # fault-tolerant loop (util/resilience.py); the legacy path
+            # below is untouched when no policy is requested
+            from deeplearning4j_tpu.util import resilience as _resilience
+
+            return _resilience.run_fit(self, fault_tolerance, data,
+                                       labels, epochs,
+                                       auto_resume=auto_resume)
         from deeplearning4j_tpu.datasets.multi_dataset import (
             MultiDataSet, MultiDataSetIterator,
         )

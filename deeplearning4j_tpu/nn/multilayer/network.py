@@ -478,8 +478,18 @@ class MultiLayerNetwork:
     # ------------------------------------------------------------------
     # public training API (reference: fit(INDArray,INDArray) / fit(iter))
     # ------------------------------------------------------------------
-    def fit(self, data, labels=None, epochs: int = 1):
+    def fit(self, data, labels=None, epochs: int = 1,
+            fault_tolerance=None, auto_resume=None):
         self._check_init()
+        if fault_tolerance is not None or auto_resume is not None:
+            # fault-tolerant loop (util/resilience.py): preemption-safe
+            # checkpointing, auto-resume, divergence rollback. Without a
+            # policy the legacy path below runs bit-identically.
+            from deeplearning4j_tpu.util import resilience as _resilience
+
+            return _resilience.run_fit(self, fault_tolerance, data,
+                                       labels, epochs,
+                                       auto_resume=auto_resume)
         if isinstance(data, DataSetIterator):
             import time as _time
 

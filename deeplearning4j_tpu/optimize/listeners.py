@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import logging
 import os
+import re
 import time
 from typing import Callable, List, Optional
 
@@ -120,7 +121,17 @@ class CollectScoresListener(TrainingListener):
 
 class CheckpointListener(TrainingListener):
     """Periodic checkpoints, keep-last-K (reference: CheckpointListener
-    builder: saveEveryNIterations / keepLast)."""
+    builder: saveEveryNIterations / keepLast).
+
+    Restart-safe: ``_saved`` is rebuilt from the directory at init, so
+    keep-last pruning keeps working across process restarts (a resumed
+    run used to start with an empty list and let the directory grow by
+    ``keep_last`` files per incarnation, forever). Saves are atomic AND
+    durable — ModelSerializer.writeModel publishes via a unique temp +
+    fsync + rename + directory fsync, so a crash or power cut never
+    leaves a truncated checkpoint under a valid name."""
+
+    _NAME_RE = re.compile(r"checkpoint_iter_(\d+)\.zip")
 
     def __init__(self, directory: str, save_every_n_iterations: int = 1000,
                  keep_last: int = 3, save_updater: bool = True):
@@ -129,7 +140,17 @@ class CheckpointListener(TrainingListener):
         self.keep = keep_last
         self.save_updater = save_updater
         os.makedirs(directory, exist_ok=True)
-        self._saved: List[str] = []
+        self._saved: List[str] = self._scan()
+
+    def _scan(self) -> List[str]:
+        """Existing checkpoints on disk, oldest first (by iteration)."""
+        found = []
+        for name in os.listdir(self.dir):
+            m = self._NAME_RE.fullmatch(name)
+            if m:
+                found.append((int(m.group(1)),
+                              os.path.join(self.dir, name)))
+        return [p for _, p in sorted(found)]
 
     def iterationDone(self, model, iteration, epoch):
         # iteration 0 is the untrained net — nothing worth checkpointing
@@ -139,15 +160,9 @@ class CheckpointListener(TrainingListener):
         from deeplearning4j_tpu.util.model_serializer import ModelSerializer
 
         path = os.path.join(self.dir, f"checkpoint_iter_{iteration}.zip")
-        # atomic: serialize to a temp file, then os.replace — a crash
-        # mid-save must never leave a truncated checkpoint_iter_N.zip
-        tmp = path + ".tmp"
-        try:
-            ModelSerializer.writeModel(model, tmp, self.save_updater)
-            os.replace(tmp, path)
-        finally:
-            if os.path.exists(tmp):
-                os.remove(tmp)
+        ModelSerializer.writeModel(model, path, self.save_updater)
+        if path in self._saved:     # resumed run re-saving an iteration
+            self._saved.remove(path)
         self._saved.append(path)
         while len(self._saved) > self.keep:
             old = self._saved.pop(0)
@@ -155,7 +170,14 @@ class CheckpointListener(TrainingListener):
                 os.remove(old)
 
     def lastCheckpoint(self) -> Optional[str]:
-        return self._saved[-1] if self._saved else None
+        """Newest checkpoint path — from this listener's history, or
+        from a disk scan when the list is empty (e.g. a fresh process
+        inspecting a directory another run populated after this
+        listener was constructed)."""
+        if self._saved:
+            return self._saved[-1]
+        on_disk = self._scan()
+        return on_disk[-1] if on_disk else None
 
 
 class TelemetryListener(TrainingListener):

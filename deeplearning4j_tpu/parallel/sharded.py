@@ -436,7 +436,19 @@ class ShardedTrainer:
             jax.jit(step_fn, donate_argnums=(0, 1, 2)))
 
     # ------------------------------------------------------------------
-    def fit(self, data, labels=None, epochs: int = 1):
+    def fit(self, data, labels=None, epochs: int = 1,
+            fault_tolerance=None, auto_resume=None):
+        if fault_tolerance is not None or auto_resume is not None:
+            # fault-tolerant loop (util/resilience.py): drives
+            # _fit_batch with preemption/divergence/watchdog guards and
+            # snapshots the per-shard state (_local/_residual/
+            # _thresholds) alongside the model trees
+            from deeplearning4j_tpu.util import resilience as _resilience
+
+            return _resilience.run_fit(self.model, fault_tolerance,
+                                       data, labels, epochs,
+                                       auto_resume=auto_resume,
+                                       trainer=self)
         from deeplearning4j_tpu.datasets.multi_dataset import (
             MultiDataSet, MultiDataSetIterator,
         )

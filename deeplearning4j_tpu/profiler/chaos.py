@@ -1,0 +1,215 @@
+"""Fault-injection harness for the resilience layer (util/resilience.py).
+
+Production training on preemptible accelerators sees three recurring
+failure shapes: a NaN batch poisoning the loss, a flaky host->device
+transfer, and a SIGTERM landing mid-epoch. None of them reproduce on
+demand, so the recovery code paths that handle them rot unless
+something exercises them deliberately. This module is that something:
+a deterministic (seeded) chaos monkey the fit loops and the device
+prefetcher consult at their fault-sensitive seams.
+
+Injection points:
+
+- ``corrupt_batch(ds, ordinal)`` — called by the FaultTolerance fit
+  loop on every batch; batches whose global ordinal is listed in
+  ``nan_steps`` get their features replaced with NaN (exercises the
+  divergence guard's rollback-and-skip).
+- ``maybe_fail_transfer()`` — called by ``DevicePrefetchIterator``
+  before each device transfer attempt; raises
+  ``ChaosTransferError`` with probability ``transfer_error_rate``
+  (exercises the exponential-backoff retry + quarantine path). Each
+  RETRY re-rolls, so transient errors clear and rate=1.0 forces the
+  quarantine.
+- ``maybe_preempt(steps_done)`` — called by the FaultTolerance loop
+  after each step; raises SIGTERM against this process once when the
+  step count hits ``preempt_at_step`` (exercises the preemption
+  checkpoint + auto-resume cycle end to end, real signal included).
+
+Activation, in priority order:
+
+1. programmatic — ``install(ChaosMonkey(cfg))`` or the ``installed``
+   context manager (tests);
+2. environment — ``DL4J_TPU_CHAOS=1`` plus the ``DL4J_TPU_CHAOS_*``
+   knobs below (the CI chaos smoke gate in run_tests.sh);
+3. otherwise ``active()`` returns None and every hook is a no-op
+   (a single attribute read on the hot path).
+
+Env knobs (read once, on first ``active()`` call):
+``DL4J_TPU_CHAOS_NAN_STEPS`` (comma-separated batch ordinals),
+``DL4J_TPU_CHAOS_TRANSFER_P`` (float probability),
+``DL4J_TPU_CHAOS_PREEMPT_AT`` (step count), ``DL4J_TPU_CHAOS_SEED``.
+
+Every injection lands in the telemetry registry as
+``dl4j_tpu_chaos_injected_total{kind=...}`` so a chaos run's report
+shows what was thrown at the model next to how it recovered.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import os
+import signal
+import threading
+from dataclasses import dataclass, field
+from typing import Optional, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.profiler import telemetry as _telemetry
+
+log = logging.getLogger("deeplearning4j_tpu")
+
+
+class ChaosTransferError(RuntimeError):
+    """Injected transient host->device transfer failure."""
+
+
+@dataclass
+class ChaosConfig:
+    """What to inject and when. All fields default to 'inject nothing'
+    so a config only lists the faults a test actually wants."""
+
+    #: global batch ordinals (0-based, counted per fit run) whose
+    #: features are replaced with NaN
+    nan_steps: Tuple[int, ...] = ()
+    #: probability each transfer ATTEMPT raises ChaosTransferError
+    #: (retries re-roll; 1.0 makes a batch un-transferable -> quarantine)
+    transfer_error_rate: float = 0.0
+    #: raise SIGTERM in-process once this many steps have completed
+    preempt_at_step: Optional[int] = None
+    seed: int = 20260803
+
+    @staticmethod
+    def from_env() -> Optional["ChaosConfig"]:
+        if os.environ.get("DL4J_TPU_CHAOS", "0") in ("0", ""):
+            return None
+        raw = os.environ.get("DL4J_TPU_CHAOS_NAN_STEPS", "")
+        nan_steps = tuple(int(v) for v in raw.split(",") if v.strip())
+        preempt = os.environ.get("DL4J_TPU_CHAOS_PREEMPT_AT")
+        return ChaosConfig(
+            nan_steps=nan_steps,
+            transfer_error_rate=float(
+                os.environ.get("DL4J_TPU_CHAOS_TRANSFER_P", "0") or 0),
+            preempt_at_step=int(preempt) if preempt else None,
+            seed=int(os.environ.get("DL4J_TPU_CHAOS_SEED", "20260803")),
+        )
+
+
+class ChaosMonkey:
+    """Stateful injector for one ChaosConfig. Thread-safe: the transfer
+    hook runs on the prefetcher's worker thread while the batch/preempt
+    hooks run on the fit-loop thread."""
+
+    def __init__(self, config: ChaosConfig):
+        self.config = config
+        self._rng = np.random.default_rng(config.seed)
+        self._lock = threading.Lock()
+        self._preempted = False
+
+    def _record(self, kind: str) -> None:
+        if not _telemetry.enabled():
+            return
+        _telemetry.MetricsRegistry.get_default().counter(
+            _telemetry.CHAOS_INJECTED,
+            "faults injected by the chaos harness").inc(kind=kind)
+
+    # ------------------------------------------------------------ hooks
+    def corrupt_batch(self, ds, ordinal: int):
+        """Return ``ds`` with NaN features when ``ordinal`` is a
+        configured NaN step; otherwise ``ds`` unchanged. Never mutates
+        the caller's batch — the original survives for a post-rollback
+        inspection."""
+        if ordinal not in self.config.nan_steps:
+            return ds
+        self._record("nan_batch")
+        log.warning("CHAOS: injecting NaN batch at ordinal %d", ordinal)
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        from deeplearning4j_tpu.datasets.multi_dataset import MultiDataSet
+
+        nan_like = lambda a: np.full(np.asarray(a).shape, np.nan,
+                                     np.asarray(a).dtype
+                                     if np.asarray(a).dtype.kind == "f"
+                                     else np.float32)
+        if isinstance(ds, MultiDataSet):
+            return MultiDataSet([nan_like(a) for a in ds.features],
+                                list(ds.labels),
+                                ds.features_mask_arrays or None,
+                                ds.labels_mask_arrays or None)
+        if isinstance(ds, DataSet):
+            return DataSet(nan_like(ds.features), ds.labels,
+                           ds.features_mask, ds.labels_mask)
+        return nan_like(ds)
+
+    def maybe_fail_transfer(self) -> None:
+        """Raise ChaosTransferError with the configured probability."""
+        p = self.config.transfer_error_rate
+        if p <= 0.0:
+            return
+        with self._lock:   # default_rng is not thread-safe
+            roll = self._rng.random()
+        if roll < p:
+            self._record("transfer_error")
+            raise ChaosTransferError(
+                "injected transient host->device transfer failure "
+                f"(p={p})")
+
+    def maybe_preempt(self, steps_done: int) -> None:
+        """Deliver one real SIGTERM to this process at the configured
+        step count — the fit loop's installed handler turns it into a
+        clean checkpoint-and-exit, exactly as a cluster preemption
+        notice would."""
+        at = self.config.preempt_at_step
+        if at is None or self._preempted or steps_done < at:
+            return
+        self._preempted = True
+        self._record("preemption")
+        log.warning("CHAOS: simulating preemption after %d steps "
+                    "(raising SIGTERM)", steps_done)
+        signal.raise_signal(signal.SIGTERM)
+
+
+# ------------------------------------------------------------ activation
+_active: Optional[ChaosMonkey] = None
+_env_checked = False
+_lock = threading.Lock()
+
+
+def active() -> Optional[ChaosMonkey]:
+    """The installed monkey, the env-configured one, or None."""
+    global _active, _env_checked
+    if _active is not None:
+        return _active
+    if not _env_checked:
+        with _lock:
+            if not _env_checked:
+                cfg = ChaosConfig.from_env()
+                if cfg is not None:
+                    _active = ChaosMonkey(cfg)
+                    log.warning("CHAOS harness active from env: %s", cfg)
+                _env_checked = True
+    return _active
+
+
+def install(monkey: Optional[ChaosMonkey]) -> None:
+    """Install (or with None, remove) the process-wide chaos monkey."""
+    global _active
+    _active = monkey
+
+
+@contextlib.contextmanager
+def installed(config: ChaosConfig):
+    """Scoped activation for tests: install a fresh monkey for the
+    block, restore whatever was active before on exit."""
+    global _active
+    prev = _active
+    monkey = ChaosMonkey(config)
+    _active = monkey
+    try:
+        yield monkey
+    finally:
+        _active = prev
+
+
+__all__ = ["ChaosConfig", "ChaosMonkey", "ChaosTransferError",
+           "active", "install", "installed"]

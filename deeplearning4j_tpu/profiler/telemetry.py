@@ -69,6 +69,15 @@ LOSS_SCALE = "dl4j_tpu_loss_scale"
 LOSS_SCALE_OVERFLOWS = "dl4j_tpu_loss_scale_overflows_total"
 LOSS_SCALE_SKIPPED_STEPS = "dl4j_tpu_loss_scale_skipped_steps_total"
 PRECISION_CASTS = "dl4j_tpu_precision_casts_per_step"
+#: fault tolerance (util/resilience.py, profiler/chaos.py)
+FT_ROLLBACKS = "dl4j_tpu_ft_rollbacks_total"
+FT_SKIPPED_BATCHES = "dl4j_tpu_ft_skipped_batches_total"
+FT_PREEMPTION_CHECKPOINTS = "dl4j_tpu_ft_preemption_checkpoints_total"
+FT_AUTO_RESUMES = "dl4j_tpu_ft_auto_resumes_total"
+TRANSFER_RETRIES = "dl4j_tpu_transfer_retries_total"
+TRANSFER_QUARANTINES = "dl4j_tpu_transfer_quarantined_batches_total"
+WATCHDOG_STALLS = "dl4j_tpu_watchdog_stalls_total"
+CHAOS_INJECTED = "dl4j_tpu_chaos_injected_total"
 
 
 def enabled() -> bool:
@@ -623,4 +632,7 @@ __all__ = [
     "ON_DEVICE_BATCHES",
     "LOSS_SCALE", "LOSS_SCALE_OVERFLOWS", "LOSS_SCALE_SKIPPED_STEPS",
     "PRECISION_CASTS",
+    "FT_ROLLBACKS", "FT_SKIPPED_BATCHES", "FT_PREEMPTION_CHECKPOINTS",
+    "FT_AUTO_RESUMES", "TRANSFER_RETRIES", "TRANSFER_QUARANTINES",
+    "WATCHDOG_STALLS", "CHAOS_INJECTED",
 ]

@@ -1,9 +1,26 @@
-"""Utilities: model serialization, crash reporting."""
+"""Utilities: model serialization, crash reporting, fault tolerance."""
 
 from deeplearning4j_tpu.util.model_serializer import ModelSerializer
 from deeplearning4j_tpu.util.sharded_checkpoint import (
     ShardedCheckpoint, model_checkpoint_tree, restore_model, save_model,
 )
 
+_RESILIENCE_EXPORTS = ("FaultTolerance", "DivergenceError", "StepWatchdog")
+
+
+def __getattr__(name):
+    # lazy (PEP 562): resilience documents that a fit WITHOUT a
+    # FaultTolerance never imports it — importing it eagerly here would
+    # make every `deeplearning4j_tpu.util` user (e.g. plain
+    # ModelSerializer callers) pay its import and void that guarantee
+    if name in _RESILIENCE_EXPORTS:
+        from deeplearning4j_tpu.util import resilience
+
+        return getattr(resilience, name)
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}")
+
+
 __all__ = ["ModelSerializer", "ShardedCheckpoint",
-           "model_checkpoint_tree", "save_model", "restore_model"]
+           "model_checkpoint_tree", "save_model", "restore_model",
+           "FaultTolerance", "DivergenceError", "StepWatchdog"]

@@ -19,12 +19,50 @@ from __future__ import annotations
 import io
 import json
 import os
+import uuid
 import zipfile
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+
+def fsync_directory(dirpath: str) -> None:
+    """fsync a directory so a just-completed rename inside it survives
+    power loss (POSIX: the rename itself is atomic, but its DURABILITY
+    needs the directory entry flushed). Best-effort on platforms whose
+    directories can't be opened (Windows)."""
+    try:
+        fd = os.open(dirpath, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def atomic_replace(tmp: str, path: str) -> None:
+    """Durable atomic publish: fsync the temp file's bytes, rename it
+    over ``path``, then fsync the directory entry. After this returns,
+    a crash at ANY point leaves either the old file or the complete new
+    one — never a truncated hybrid, and never a rename that a power cut
+    silently un-does."""
+    fd = os.open(tmp, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+    os.replace(tmp, path)
+    fsync_directory(os.path.dirname(os.path.abspath(path)))
+
+
+def unique_tmp_path(path: str) -> str:
+    """Sibling temp name no other writer can collide with: two
+    processes checkpointing the same target used to share one
+    ``path + '.tmp'`` and clobber each other's half-written zip."""
+    return f"{path}.{os.getpid()}-{uuid.uuid4().hex[:8]}.tmp"
 
 
 def _flatten_with_paths(tree, prefix="", to_numpy=True):
@@ -184,6 +222,22 @@ class ModelSerializer:
         is_graph = hasattr(model, "params_map")
         params = model.params_map if is_graph else model.params_list
         states = model.states_map if is_graph else model.states_list
+        # atomic + crash-durable: serialize to a writer-unique temp
+        # (pid+uuid — concurrent writers targeting the same path can't
+        # clobber each other's temp), fsync, rename over path, fsync
+        # the directory. A reader never observes a partial zip.
+        tmp = unique_tmp_path(path)
+        try:
+            ModelSerializer._write_zip(model, tmp, save_updater,
+                                       normalizer, params, states)
+            atomic_replace(tmp, path)
+        finally:
+            if os.path.exists(tmp):
+                os.remove(tmp)
+
+    @staticmethod
+    def _write_zip(model, path, save_updater, normalizer, params,
+                   states) -> None:
         with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
             zf.writestr("configuration.json", model.conf.to_json())
             _write_npz(zf, "coefficients.npz", _flatten_with_paths(params))
@@ -214,6 +268,41 @@ class ModelSerializer:
                 zf.writestr("normalizer.json", json.dumps(info))
 
     @staticmethod
+    def loadInto(model, path: str, load_updater: bool = True):
+        """Restore a saved archive INTO an already-initialized model of
+        the matching architecture (the FaultTolerance auto-resume path:
+        the caller owns the instance whose training should continue, so
+        building a second one just to copy trees out of it would double
+        peak memory). Overwrites params / non-trainable state / updater
+        state / loss-scale state / iteration+epoch counters in place."""
+        with zipfile.ZipFile(path) as zf:
+            return ModelSerializer._load_members(model, zf, load_updater)
+
+    @staticmethod
+    def _load_members(model, zf: zipfile.ZipFile, load_updater: bool):
+        coeff = _read_npz(zf, "coefficients.npz")
+        states = _read_npz(zf, "state.npz")
+        if hasattr(model, "params_map"):
+            model.params_map = _unflatten_into(model.params_map, coeff)
+            if states:
+                model.states_map = _unflatten_into(
+                    model.states_map, states)
+        else:
+            model.params_list = _unflatten_into(
+                model.params_list, coeff)
+            if states:
+                model.states_list = _unflatten_into(
+                    model.states_list, states)
+        if load_updater and "updaterState.npz" in zf.namelist():
+            upd = _read_npz(zf, "updaterState.npz")
+            model.opt_states = _unflatten_into(model.opt_states, upd)
+        _restore_loss_scale(zf, model)
+        meta = json.loads(zf.read("meta.json").decode())
+        model._iteration = meta.get("iteration", 0)
+        model._epoch = meta.get("epoch", 0)
+        return model
+
+    @staticmethod
     def restoreMultiLayerNetwork(path: str, load_updater: bool = True):
         """Reference: ModelSerializer.restoreMultiLayerNetwork."""
         from deeplearning4j_tpu.nn.conf.builder import MultiLayerConfiguration
@@ -223,19 +312,7 @@ class ModelSerializer:
             conf = MultiLayerConfiguration.from_json(
                 zf.read("configuration.json").decode())
             model = MultiLayerNetwork(conf).init()
-            coeff = _read_npz(zf, "coefficients.npz")
-            model.params_list = _unflatten_into(model.params_list, coeff)
-            states = _read_npz(zf, "state.npz")
-            if states:
-                model.states_list = _unflatten_into(model.states_list, states)
-            if load_updater and "updaterState.npz" in zf.namelist():
-                upd = _read_npz(zf, "updaterState.npz")
-                model.opt_states = _unflatten_into(model.opt_states, upd)
-            _restore_loss_scale(zf, model)
-            meta = json.loads(zf.read("meta.json").decode())
-            model._iteration = meta.get("iteration", 0)
-            model._epoch = meta.get("epoch", 0)
-        return model
+            return ModelSerializer._load_members(model, zf, load_updater)
 
     @staticmethod
     def restoreComputationGraph(path: str, load_updater: bool = True):
@@ -249,19 +326,7 @@ class ModelSerializer:
             conf = ComputationGraphConfiguration.from_json(
                 zf.read("configuration.json").decode())
             model = ComputationGraph(conf).init()
-            coeff = _read_npz(zf, "coefficients.npz")
-            model.params_map = _unflatten_into(model.params_map, coeff)
-            states = _read_npz(zf, "state.npz")
-            if states:
-                model.states_map = _unflatten_into(model.states_map, states)
-            if load_updater and "updaterState.npz" in zf.namelist():
-                upd = _read_npz(zf, "updaterState.npz")
-                model.opt_states = _unflatten_into(model.opt_states, upd)
-            _restore_loss_scale(zf, model)
-            meta = json.loads(zf.read("meta.json").decode())
-            model._iteration = meta.get("iteration", 0)
-            model._epoch = meta.get("epoch", 0)
-        return model
+            return ModelSerializer._load_members(model, zf, load_updater)
 
     @staticmethod
     def restore(path: str, load_updater: bool = True):

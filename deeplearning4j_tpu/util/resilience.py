@@ -1,0 +1,971 @@
+"""Fault-tolerant training: preemption-safe checkpointing, auto-resume,
+divergence rollback, and a step watchdog.
+
+Reference mapping (SURVEY.md §5): the reference's recovery story is
+CheckpointListener zips + ModelSerializer exact-resume — enough for a
+workstation, not for preemptible accelerator fleets where SIGTERM, NaN
+storms and flaky host->device links are routine. This module turns the
+existing checkpoint substrate (ModelSerializer exact-resume incl.
+updater + loss-scale state, ``DataSetIterator.get_state/set_state``)
+into an actual fault-tolerance layer, one policy object wired through
+all three fit loops (MultiLayerNetwork, ComputationGraph,
+ShardedTrainer):
+
+- **Preemption safety** — SIGTERM/SIGINT set a flag; at the next step
+  boundary the loop writes ONE atomic resumable bundle (model + updater
+  + loss-scale + epoch/iteration counters + RNG key + data-iterator
+  position) and returns cleanly. A second signal aborts immediately.
+- **Auto-resume** — ``fit(..., auto_resume=dir)`` discovers the newest
+  bundle whose manifest digests verify, falls back to the previous one
+  on corruption, restores everything, and continues mid-epoch on the
+  NEXT batch (iterator position travels in the bundle). Bundles are
+  retired when the run completes, so a finished job never re-resumes
+  stale state.
+- **Divergence guard** — a rolling window of recent losses; NaN/Inf or
+  a spike past ``spike_factor`` x the window median rolls the model
+  back to a periodic in-memory device snapshot and SKIPS the offending
+  batch, up to ``max_rollbacks`` before raising ``DivergenceError``.
+  (Reading the loss forces one device sync per step — the price of the
+  guard; set ``divergence_window=0`` to disable.)
+- **Step watchdog** — a step exceeding ``step_deadline`` seconds dumps
+  every thread's stack plus a telemetry snapshot to the log (the data
+  needed to diagnose a wedged collective or a stuck transfer), without
+  killing the run.
+- **Transfer retry** — the policy configures the wrapping
+  ``DevicePrefetchIterator`` (if one feeds the loop) with exponential-
+  backoff retries and poison-batch quarantine (see
+  datasets/device_prefetch.py).
+
+Identity guarantee: with no FaultTolerance (``fit`` called without
+``fault_tolerance``/``auto_resume``), the fit loops run their original
+code paths bit-for-bit — this module is never imported.
+
+Every recovery action lands in the telemetry registry
+(``dl4j_tpu_ft_*``, ``dl4j_tpu_transfer_*``, ``dl4j_tpu_watchdog_*``
+counters — docs/OBSERVABILITY.md), and all of it is exercised by the
+fault-injection harness in profiler/chaos.py.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import hashlib
+import json
+import logging
+import os
+import re
+import shutil
+import signal
+import statistics
+import sys
+import threading
+import time
+import uuid
+from collections import deque
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.profiler import chaos as _chaos
+from deeplearning4j_tpu.profiler import telemetry as _telemetry
+
+log = logging.getLogger("deeplearning4j_tpu")
+
+_BUNDLE_RE = re.compile(r"bundle-(\d+)(?:-\d+)?$")
+_RESUME_FORMAT = "deeplearning4j_tpu-ft-1"
+
+
+class DivergenceError(RuntimeError):
+    """Raised when the divergence guard exhausts its rollback budget —
+    the run is not recovering, a human needs to look."""
+
+
+# ======================================================================
+# resumable checkpoint bundles
+# ======================================================================
+def _sha256(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def write_bundle(directory: str, model, resume_meta: Dict[str, Any],
+                 keep_last: int = 2) -> str:
+    """Write one atomic resumable bundle under ``directory`` and prune
+    to the newest ``keep_last``. Layout::
+
+        bundle-<iteration>/
+            model.zip      ModelSerializer archive (params + updater +
+                           loss-scale + iteration/epoch)
+            resume.json    RNG key, iterator position, epochs remaining
+            manifest.json  sha256 digests of the two members
+
+    Atomicity: everything is written into a writer-unique temp
+    directory, each file fsynced, then the directory is renamed into
+    place and the parent fsynced — a crash mid-save leaves only a temp
+    dir that discovery ignores, never a half bundle under a valid name.
+    ``keep_last >= 2`` is what makes digest-verified fallback possible:
+    if the newest bundle is torn, the previous one still restores."""
+    from deeplearning4j_tpu.util.model_serializer import (
+        ModelSerializer, fsync_directory,
+    )
+
+    os.makedirs(directory, exist_ok=True)
+    iteration = int(model.getIterationCount())
+    name = f"bundle-{iteration:010d}"
+    final = os.path.join(directory, name)
+    n = 0
+    while os.path.exists(final):   # re-preemption at the same step
+        n += 1
+        final = os.path.join(directory, f"{name}-{n}")
+    tmp = os.path.join(directory,
+                       f".{name}.{os.getpid()}-{uuid.uuid4().hex[:8]}.tmp")
+    os.makedirs(tmp)
+
+    def _write_member(member: str, obj) -> None:
+        # plain write + fsync: the tmp dir is unpublished (discovery
+        # ignores dot-dirs), so the single publish point is the
+        # directory rename below — per-member rename dances would buy
+        # no extra crash-safety, just fsync cycles spent inside the
+        # SIGTERM grace period
+        with open(os.path.join(tmp, member), "w") as f:
+            json.dump(obj, f)
+            f.flush()
+            os.fsync(f.fileno())
+
+    try:
+        # writeModel is itself atomic (temp + fsync + replace) inside tmp
+        ModelSerializer.writeModel(model, os.path.join(tmp, "model.zip"))
+        _write_member("resume.json", dict(resume_meta,
+                                          format=_RESUME_FORMAT))
+        _write_member("manifest.json", {
+            "format": _RESUME_FORMAT,
+            "iteration": iteration,
+            "digests": {m: _sha256(os.path.join(tmp, m))
+                        for m in ("model.zip", "resume.json")},
+        })
+        fsync_directory(tmp)
+        os.replace(tmp, final)
+        fsync_directory(directory)
+    finally:
+        if os.path.isdir(tmp):
+            shutil.rmtree(tmp, ignore_errors=True)
+    _prune_bundles(directory, keep_last)
+    return final
+
+
+def _list_bundles(directory: str) -> List[Tuple[int, str]]:
+    """(iteration, path) for every bundle dir, newest first."""
+    out = []
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return []
+    for nm in names:
+        m = _BUNDLE_RE.fullmatch(nm)
+        if m:
+            out.append((int(m.group(1)), os.path.join(directory, nm)))
+    # name (with its -k re-preemption suffix) breaks iteration ties in
+    # creation order
+    return sorted(out, key=lambda t: (t[0], t[1]), reverse=True)
+
+
+def _prune_bundles(directory: str, keep_last: int) -> None:
+    for _, path in _list_bundles(directory)[max(keep_last, 1):]:
+        shutil.rmtree(path, ignore_errors=True)
+
+
+def validate_bundle(path: str) -> bool:
+    """True iff the manifest parses and every member's sha256 matches —
+    the corruption detector behind newest-valid discovery."""
+    try:
+        with open(os.path.join(path, "manifest.json")) as f:
+            manifest = json.load(f)
+        if manifest.get("format") != _RESUME_FORMAT:
+            return False
+        for member, digest in manifest["digests"].items():
+            if _sha256(os.path.join(path, member)) != digest:
+                return False
+        with open(os.path.join(path, "resume.json")) as f:
+            json.load(f)
+        return True
+    except (OSError, ValueError, KeyError):
+        return False
+
+
+def latest_valid_bundle(directory: str) -> Optional[str]:
+    """Newest bundle whose digests verify; corrupt ones are skipped
+    with a loud warning (torn by a crash mid-save, truncated by a full
+    disk...) so the fallback is visible, not silent."""
+    for _, path in _list_bundles(directory):
+        if validate_bundle(path):
+            return path
+        log.warning("resilience: bundle %s failed digest validation — "
+                    "falling back to the previous one", path)
+    return None
+
+
+def retire_bundles(directory: str) -> None:
+    """Remove all bundles — called when a run COMPLETES, so a later fit
+    with auto_resume on the same dir starts fresh instead of reviving
+    the finished run's final state."""
+    for _, path in _list_bundles(directory):
+        shutil.rmtree(path, ignore_errors=True)
+
+
+# ======================================================================
+# step watchdog
+# ======================================================================
+def _dump_stacks() -> str:
+    import traceback
+
+    names = {t.ident: t.name for t in threading.enumerate()}
+    lines = []
+    for ident, frame in sys._current_frames().items():
+        lines.append(f"--- thread {names.get(ident, '?')} ({ident}) ---")
+        lines.extend(l.rstrip() for l in traceback.format_stack(frame))
+    return "\n".join(lines)
+
+
+class StepWatchdog:
+    """Context manager arming a one-shot deadline around a training
+    step. On expiry it does NOT kill the step (a first long step is
+    usually a jit compile) — it dumps every thread's stack and a
+    telemetry snapshot to the log and bumps the stall counter, which is
+    exactly the evidence needed when a step is wedged on a dead host
+    transfer or a hung collective.
+
+    Cost: one short-lived daemon Timer thread per armed step (~tens of
+    µs to start+cancel). Deadlines worth watching are seconds to
+    minutes, so that's noise; a sub-millisecond-step workload that
+    somehow wants a watchdog would upgrade to a persistent re-armed
+    monitor thread."""
+
+    def __init__(self, deadline: float, context: str = "train_step"):
+        self.deadline = float(deadline)
+        self.context = context
+        self._timer: Optional[threading.Timer] = None
+        self.fired = False
+
+    def _fire(self) -> None:
+        self.fired = True
+        if _telemetry.enabled():
+            _telemetry.MetricsRegistry.get_default().counter(
+                _telemetry.WATCHDOG_STALLS,
+                "training steps that exceeded the watchdog deadline"
+            ).inc(context=self.context)
+        try:
+            snap = json.dumps(_telemetry.snapshot())
+        except Exception:
+            snap = "<unavailable>"
+        log.error(
+            "WATCHDOG: %s exceeded its %.1fs deadline — still waiting. "
+            "Thread stacks:\n%s\ntelemetry: %s",
+            self.context, self.deadline, _dump_stacks(), snap)
+
+    def __enter__(self) -> "StepWatchdog":
+        self._timer = threading.Timer(self.deadline, self._fire)
+        self._timer.daemon = True
+        self._timer.name = "FT-watchdog"
+        self._timer.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        if self._timer is not None:
+            self._timer.cancel()
+            self._timer = None
+
+
+# ======================================================================
+# the policy
+# ======================================================================
+class FaultTolerance:
+    """Fault-tolerance policy for ``fit(..., fault_tolerance=...)``.
+
+    Knobs (all optional — the defaults are a reasonable production
+    posture; ``FaultTolerance()`` with no checkpoint_dir still gives
+    the divergence guard + watchdog + transfer retry):
+
+    - ``checkpoint_dir``: where preemption bundles live; also the
+      auto-resume discovery root. None disables preemption checkpoints.
+    - ``auto_resume``: restore the newest valid bundle before training
+      (default True when a checkpoint_dir is set).
+    - ``keep_last``: bundles retained (>=2 enables corruption fallback).
+    - ``preemption_signals``: signals that trigger checkpoint-and-exit.
+    - ``divergence_window``: rolling loss window length (0 = guard off).
+    - ``spike_factor`` / ``min_history``: a finite loss is divergent
+      when it exceeds ``median + spike_factor * max(|median|, 1e-3)``
+      and at least ``min_history`` losses have been seen. NaN/Inf is
+      always divergent.
+    - ``snapshot_every``: steps between in-memory device snapshots
+      (rollback granularity).
+    - ``max_rollbacks``: rollback budget per fit before
+      ``DivergenceError``.
+    - ``transfer_retries`` / ``transfer_backoff``: applied to a
+      ``DevicePrefetchIterator`` feeding the loop (no-op otherwise).
+    - ``step_deadline``: per-step watchdog deadline in seconds
+      (None = watchdog off).
+
+    The object is reusable across fits — per-run state lives in a
+    private ``_RunState`` created by ``run_fit``.
+    """
+
+    def __init__(self,
+                 checkpoint_dir: Optional[str] = None,
+                 auto_resume: bool = True,
+                 keep_last: int = 2,
+                 preemption_signals: Sequence[int] = (
+                     signal.SIGTERM, signal.SIGINT),
+                 divergence_window: int = 16,
+                 spike_factor: float = 25.0,
+                 min_history: int = 8,
+                 snapshot_every: int = 10,
+                 max_rollbacks: int = 8,
+                 transfer_retries: int = 5,
+                 transfer_backoff: float = 0.05,
+                 step_deadline: Optional[float] = None):
+        self.checkpoint_dir = checkpoint_dir
+        self.auto_resume = auto_resume
+        self.keep_last = max(int(keep_last), 1)
+        self.preemption_signals = tuple(preemption_signals)
+        self.divergence_window = int(divergence_window)
+        self.spike_factor = float(spike_factor)
+        # the rolling window can never hold more than divergence_window
+        # losses, so a min_history above it would silently disable the
+        # spike rule — clamp so the configured guard is always live
+        self.min_history = max(int(min_history), 1)
+        if self.divergence_window > 0:
+            self.min_history = min(self.min_history,
+                                   self.divergence_window)
+        self.snapshot_every = max(int(snapshot_every), 1)
+        self.max_rollbacks = int(max_rollbacks)
+        self.transfer_retries = int(transfer_retries)
+        self.transfer_backoff = float(transfer_backoff)
+        self.step_deadline = step_deadline
+        self._preempt = threading.Event()
+
+    # ------------------------------------------------------------ misc
+    @property
+    def preemption_requested(self) -> bool:
+        return self._preempt.is_set()
+
+    def request_preemption(self) -> None:
+        """Programmatic preemption notice (what the signal handler
+        calls; also usable directly, e.g. from a cluster-notice
+        poller thread)."""
+        self._preempt.set()
+
+    @contextlib.contextmanager
+    def _signal_scope(self):
+        """Install checkpoint-on-signal handlers for the duration of a
+        fit; always restores the previous handlers. Signals can only be
+        trapped on the main thread — elsewhere the loop still honors
+        ``request_preemption()``, it just can't hook SIGTERM itself.
+
+        The flag is deliberately NOT cleared on entry: a preemption
+        notice that arrives before fit() (or during the auto-resume
+        restore) must checkpoint at the FIRST step boundary, not be
+        silently discarded. The loop clears it after acting on it."""
+        if not self.preemption_signals \
+                or threading.current_thread() is not threading.main_thread():
+            yield
+            return
+
+        def _handler(signum, frame):
+            if self._preempt.is_set():
+                # second signal: the operator (or the platform's grace-
+                # period enforcer) wants out NOW
+                raise KeyboardInterrupt(
+                    f"signal {signum} received twice during training")
+            self._preempt.set()
+            log.warning(
+                "resilience: signal %s received — writing a resumable "
+                "checkpoint at the next step boundary, then exiting",
+                signum)
+
+        prev = {}
+        try:
+            for s in self.preemption_signals:
+                prev[s] = signal.signal(s, _handler)
+        except (ValueError, OSError):
+            pass   # restricted environment: proceed unhooked
+        try:
+            yield
+        finally:
+            for s, h in prev.items():
+                if h is not None:   # None = handler installed at C
+                    signal.signal(s, h)   # level; not restorable from
+                #                           Python (signal.signal(s,
+                #                           None) raises TypeError)
+
+    def _watchdog(self):
+        if self.step_deadline is None:
+            return contextlib.nullcontext()
+        return StepWatchdog(self.step_deadline)
+
+
+def resolve_policy(fault_tolerance: Optional[FaultTolerance],
+                   auto_resume) -> Optional[FaultTolerance]:
+    """Merge the two fit kwargs into one policy. ``auto_resume=dir`` is
+    the one-argument spelling of 'checkpoint here, resume from here'."""
+    if fault_tolerance is None and auto_resume is None:
+        return None
+    ft = fault_tolerance if fault_tolerance is not None else FaultTolerance()
+    if auto_resume:
+        if fault_tolerance is not None:
+            # never mutate the caller's policy object: it is documented
+            # as reusable across fits, and a later fit passing only
+            # fault_tolerance= must not inherit this call's resume dir.
+            # A SHALLOW copy deliberately shares the _preempt Event so
+            # ft.request_preemption() on the original still lands.
+            import copy
+
+            ft = copy.copy(fault_tolerance)
+        if isinstance(auto_resume, (str, os.PathLike)):
+            ft.checkpoint_dir = os.fspath(auto_resume)
+        ft.auto_resume = True
+    return ft
+
+
+# ======================================================================
+# model/trainer seam
+# ======================================================================
+class _FitAdapter:
+    """Uniform step/snapshot/restore seam over the three fit
+    front-ends (mirrors parallel/sharded.py's _ModelFuncs)."""
+
+    def __init__(self, model, trainer=None):
+        self.model = model
+        self.trainer = trainer
+        self.is_graph = hasattr(model, "params_map")
+
+    # ------------------------------------------------------------ step
+    def step(self, batch) -> None:
+        from deeplearning4j_tpu.datasets.multi_dataset import MultiDataSet
+
+        if self.trainer is not None:
+            if isinstance(batch, MultiDataSet):
+                self.trainer._fit_batch(list(batch.features),
+                                        list(batch.labels))
+            else:
+                self.trainer._fit_batch(batch.features, batch.labels,
+                                        batch.labels_mask,
+                                        batch.features_mask)
+        elif self.is_graph:
+            if isinstance(batch, MultiDataSet):
+                self.model._fit_batch(batch.features, batch.labels,
+                                      batch.labels_mask_arrays or None,
+                                      batch.features_mask_arrays or None)
+            else:
+                self.model._fit_batch([batch.features], [batch.labels],
+                                      [batch.labels_mask],
+                                      [batch.features_mask])
+        else:
+            self.model._fit_batch(batch.features, batch.labels,
+                                  batch.labels_mask, batch.features_mask)
+
+    def end_epoch(self) -> None:
+        m = self.model
+        m._epoch += 1
+        if self.trainer is None and not self.is_graph:
+            # MultiLayerNetwork is the only front-end with epoch-end
+            # listener callbacks (parity with its legacy loop)
+            for l in m._listeners:
+                if hasattr(l, "onEpochEnd"):
+                    l.onEpochEnd(m)
+
+    def finish(self) -> None:
+        if self.trainer is not None and hasattr(self.trainer, "_finish"):
+            self.trainer._finish()
+
+    def invalidate_trainer_state(self) -> None:
+        """After a bundle restore, a REUSED ShardedTrainer's per-shard
+        replicas (averaging/compressed `_local`, `_residual`,
+        `_thresholds`) still hold pre-restore values — drop them (and
+        the compiled step, whose rebuild path re-derives them from the
+        restored model trees). 'sharing' mode keeps all state in the
+        model trees, so a trainer with none built stays untouched and
+        pays no recompile."""
+        t = self.trainer
+        if t is None:
+            return
+        if getattr(t, "_local", None) is not None \
+                or getattr(t, "_residual", None) is not None:
+            t._step = None
+            t._local = None
+            t._residual = None
+            t._thresholds = None
+
+    # ------------------------------------------------- snapshot/restore
+    def _trees(self):
+        m = self.model
+        return (m.params_map, m.states_map) if self.is_graph \
+            else (m.params_list, m.states_list)
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Full in-memory training-state snapshot, on device. Copies
+        are REQUIRED: the compiled steps donate param/opt buffers, so
+        aliased references would be deleted by the very next step. The
+        RNG key and score are step OUTPUTS/non-donated and safe to
+        alias."""
+        import jax
+        import jax.numpy as jnp
+
+        cp = lambda t: jax.tree_util.tree_map(jnp.copy, t)
+        m = self.model
+        params, states = self._trees()
+        snap: Dict[str, Any] = {
+            "iteration": m._iteration,
+            "epoch": m._epoch,
+            "rng": m._rng_key,
+            "score": m._score,
+            "params": cp(params),
+            "states": cp(states),
+            "opt": cp(m.opt_states),
+        }
+        if getattr(m, "_loss_scale_state", None) is not None:
+            snap["ls"] = cp(m._loss_scale_state)
+            snap["ls_seen"] = m._ls_seen
+        if self.trainer is not None:
+            for name in ("_residual", "_thresholds", "_local"):
+                v = getattr(self.trainer, name, None)
+                if v is not None:
+                    snap[name] = cp(v)
+        return snap
+
+    def restore(self, snap: Dict[str, Any]) -> None:
+        """Install a snapshot (as fresh copies — the snapshot itself
+        stays valid for a second rollback)."""
+        import jax
+        import jax.numpy as jnp
+
+        cp = lambda t: jax.tree_util.tree_map(jnp.copy, t)
+        m = self.model
+        if self.is_graph:
+            m.params_map, m.states_map = cp(snap["params"]), cp(snap["states"])
+        else:
+            m.params_list, m.states_list = (cp(snap["params"]),
+                                            cp(snap["states"]))
+        m.opt_states = cp(snap["opt"])
+        m._iteration = snap["iteration"]
+        m._epoch = snap["epoch"]
+        m._rng_key = snap["rng"]
+        m._score = snap["score"]
+        if "ls" in snap:
+            m._loss_scale_state = cp(snap["ls"])
+            m._ls_seen = snap["ls_seen"]
+        if self.trainer is not None:
+            for name in ("_residual", "_thresholds", "_local"):
+                if name in snap:
+                    setattr(self.trainer, name, cp(snap[name]))
+
+
+class _RunState:
+    def __init__(self, ft: FaultTolerance, adapter: "_FitAdapter"):
+        self.steps_done = 0        # monotonic, survives rollbacks
+        self.rollbacks = 0
+        self.snapshot: Optional[Dict[str, Any]] = None
+        self.since_snapshot = 0
+        self.window: deque = deque(maxlen=max(ft.divergence_window, 1))
+        # mixed_float16 baseline: skipped-step count at fit entry, so
+        # the guard can tell a HANDLED overflow (engine skipped the
+        # step, halved the scale — params untouched) from divergence
+        self.ls_skipped_seen = (_ls_skipped(adapter.model)
+                                if ft.divergence_window > 0 else 0)
+
+
+def _ls_skipped(model) -> int:
+    """Device-side skipped-step counter of the dynamic loss-scale
+    engine (0 for policies without loss scaling)."""
+    ls = getattr(model, "_loss_scale_state", None)
+    if ls is None:
+        return 0
+    return int(np.asarray(ls["skipped_steps"]))
+
+
+# ======================================================================
+# data plumbing
+# ======================================================================
+def _as_iterator(data, labels, adapter: _FitAdapter):
+    """Normalize every fit input shape onto the iterator protocol.
+    Returns (iterator, was_iterator) — epoch counters/listeners only
+    advance for true iterator inputs, matching the legacy loops."""
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.datasets.iterator import (
+        DataSetIterator, ListDataSetIterator,
+    )
+    from deeplearning4j_tpu.datasets.multi_dataset import (
+        ListMultiDataSetIterator, MultiDataSet, MultiDataSetIterator,
+    )
+    from deeplearning4j_tpu.ndarray.ndarray import _unwrap
+
+    if isinstance(data, (DataSetIterator, MultiDataSetIterator)):
+        return data, True
+    if isinstance(data, DataSet):
+        return ListDataSetIterator([data]), False
+    if isinstance(data, MultiDataSet):
+        return ListMultiDataSetIterator([data]), False
+    if labels is None:
+        raise ValueError("fit(x, y) requires labels")
+    if adapter.is_graph:
+        xs = data if isinstance(data, (list, tuple)) else [data]
+        ys = labels if isinstance(labels, (list, tuple)) else [labels]
+        return ListMultiDataSetIterator([MultiDataSet(
+            [_unwrap(x) for x in xs], [_unwrap(y) for y in ys])]), False
+    return ListDataSetIterator(
+        [DataSet(_unwrap(data), _unwrap(labels))]), False
+
+
+def _try_get_state(it) -> Optional[Dict[str, Any]]:
+    try:
+        return it.get_state()
+    except Exception:
+        return None
+
+
+def _try_set_state(it, state) -> bool:
+    try:
+        it.set_state(state)
+        return True
+    except Exception as e:
+        log.warning("resilience: iterator %s could not restore mid-epoch "
+                    "position (%s) — restarting the interrupted epoch "
+                    "from its first batch", type(it).__name__, e)
+        return False
+
+
+# ======================================================================
+# bundle <-> live model
+# ======================================================================
+def _rng_key_data(model) -> List[int]:
+    import jax
+
+    return [int(v) for v in
+            np.asarray(jax.random.key_data(model._rng_key)).ravel()]
+
+
+def _write_preemption_checkpoint(ft: FaultTolerance, adapter: _FitAdapter,
+                                 it, epoch_idx: int, total_epochs: int,
+                                 was_iterator: bool) -> None:
+    ist = _try_get_state(it)   # non-blocking: reads recorded position
+    if ist is not None:
+        # deliberately NO it.hasNext() probe here: on a wedged or
+        # retrying transfer pipeline hasNext() can block long past the
+        # platform's kill grace period, and writing the bundle is the
+        # one thing that must happen NOW. Whether the captured position
+        # is mid-epoch or exactly at the epoch boundary is resolved at
+        # RESUME time: a restored position with nothing left simply
+        # completes an empty first epoch there, whose end-of-epoch
+        # bookkeeping (epoch counter + onEpochEnd) runs as part of it —
+        # including for a shuffling iterator, whose internal epoch
+        # counter rides the state so the next reset() deals the same
+        # permutation an uninterrupted run would have seen.
+        remaining = total_epochs - epoch_idx
+        mid = True
+    else:
+        # stateless iterator: a (possibly blocking) hasNext is the only
+        # way to tell a finished epoch from an interrupted one
+        try:
+            has_more = bool(it.hasNext())
+        except Exception:
+            has_more = False
+        if not has_more:
+            if was_iterator:
+                adapter.end_epoch()   # the epoch completed — book it
+            remaining = total_epochs - epoch_idx - 1
+        else:
+            remaining = total_epochs - epoch_idx   # restart this epoch
+            log.warning(
+                "resilience: %s does not support state capture — the "
+                "resumed run will RESTART the interrupted epoch from "
+                "its first batch (batches already trained this epoch "
+                "will be trained again); implement get_state/set_state "
+                "for exact mid-epoch resume", type(it).__name__)
+        mid = False
+    adapter.finish()   # sync the sharded trainer's canonical trees
+    if not ft.checkpoint_dir:
+        log.warning("resilience: preemption requested but no "
+                    "checkpoint_dir configured — exiting WITHOUT a "
+                    "resumable checkpoint")
+        return
+    meta = {
+        "rng": _rng_key_data(adapter.model),
+        "iterator_state": ist,
+        "epochs_remaining": max(remaining, 0),
+        "mid_epoch": mid,
+        "wall_time": time.time(),
+    }
+    path = write_bundle(ft.checkpoint_dir, adapter.model, meta,
+                        keep_last=ft.keep_last)
+    if _telemetry.enabled():
+        _telemetry.MetricsRegistry.get_default().counter(
+            _telemetry.FT_PREEMPTION_CHECKPOINTS,
+            "resumable bundles written in response to a preemption "
+            "signal").inc()
+    log.warning("resilience: preemption checkpoint written to %s "
+                "(iteration %d, %d epoch(s) remaining%s) — exiting "
+                "cleanly", path, adapter.model.getIterationCount(),
+                meta["epochs_remaining"],
+                ", mid-epoch" if mid else "")
+
+
+def _restore_bundle(adapter: _FitAdapter, path: str) -> Dict[str, Any]:
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.util.model_serializer import ModelSerializer
+
+    with open(os.path.join(path, "resume.json")) as f:
+        resume = json.load(f)
+    ModelSerializer.loadInto(adapter.model, os.path.join(path, "model.zip"))
+    adapter.model._rng_key = jax.random.wrap_key_data(
+        jnp.asarray(np.asarray(resume["rng"], np.uint32)))
+    adapter.invalidate_trainer_state()
+    if _telemetry.enabled():
+        _telemetry.MetricsRegistry.get_default().counter(
+            _telemetry.FT_AUTO_RESUMES,
+            "training runs resumed from a preemption bundle").inc()
+    log.warning("resilience: auto-resumed from %s (iteration %d, epoch "
+                "%d, %d epoch(s) remaining%s)", path,
+                adapter.model.getIterationCount(),
+                adapter.model.getEpochCount(),
+                resume.get("epochs_remaining", 0),
+                ", mid-epoch" if resume.get("mid_epoch") else "")
+    return resume
+
+
+# ======================================================================
+# guarded step helpers
+# ======================================================================
+def _maybe_snapshot(ft: FaultTolerance, adapter: _FitAdapter,
+                    st: _RunState) -> None:
+    if ft.divergence_window <= 0:
+        return
+    if st.snapshot is None or st.since_snapshot >= ft.snapshot_every:
+        st.snapshot = adapter.snapshot()
+        st.since_snapshot = 0
+
+
+def _check_divergence(ft: FaultTolerance, adapter: _FitAdapter,
+                      st: _RunState) -> bool:
+    """Post-step loss inspection. Returns True when the step was rolled
+    back (the offending batch is skipped by simply not retrying it)."""
+    if ft.divergence_window <= 0:
+        return False
+    loss = float(adapter.model._score)   # the guard's per-step sync
+    bad = not np.isfinite(loss)
+    why = "non-finite loss"
+    if bad:
+        skipped = _ls_skipped(adapter.model)
+        if skipped > st.ls_skipped_seen:
+            # mixed_float16 handled overflow: the loss-scale engine
+            # already skipped this step (params/opt-state held) and
+            # halved the scale — that is the precision engine working,
+            # not divergence. Rolling back here would reinstate the
+            # PRE-halving scale and discard good committed steps.
+            st.ls_skipped_seen = skipped
+            st.since_snapshot += 1
+            return False
+    if not bad and len(st.window) >= ft.min_history:
+        med = statistics.median(st.window)
+        if (loss - med) > ft.spike_factor * max(abs(med), 1e-3):
+            bad = True
+            why = (f"loss spike {loss:.6g} vs rolling median {med:.6g} "
+                   f"(factor {ft.spike_factor:g})")
+    if not bad:
+        st.window.append(loss)
+        st.since_snapshot += 1
+        return False
+    if st.rollbacks >= ft.max_rollbacks:
+        # budget exhausted: still restore the last good snapshot (a
+        # caller catching DivergenceError to salvage the run must not
+        # be handed diverged/NaN params), but don't count a rollback
+        # that is really an abort
+        bad_iter = adapter.model.getIterationCount()
+        adapter.restore(st.snapshot)
+        raise DivergenceError(
+            f"divergence guard exhausted its rollback budget "
+            f"({ft.max_rollbacks}): {why} at iteration {bad_iter} — "
+            "the run is not recovering (check the data pipeline and "
+            "learning rate); model restored to the last snapshot "
+            f"(iteration {st.snapshot['iteration']})")
+    st.rollbacks += 1
+    if _telemetry.enabled():
+        reg = _telemetry.MetricsRegistry.get_default()
+        reg.counter(_telemetry.FT_ROLLBACKS,
+                    "divergence-guard rollbacks to the in-memory "
+                    "snapshot").inc()
+        reg.counter(_telemetry.FT_SKIPPED_BATCHES,
+                    "batches skipped after a divergence rollback").inc()
+    discarded = adapter.model.getIterationCount() - 1 \
+        - st.snapshot["iteration"]
+    log.warning("resilience: %s at iteration %d — rolling back to the "
+                "snapshot at iteration %d and skipping the batch "
+                "(rollback %d/%d; %d committed step(s) since the "
+                "snapshot are discarded and their batches not "
+                "replayed — lower snapshot_every for finer-grained "
+                "rollback)", why, adapter.model.getIterationCount(),
+                st.snapshot["iteration"], st.rollbacks, ft.max_rollbacks,
+                max(discarded, 0))
+    adapter.restore(st.snapshot)
+    st.since_snapshot = 0
+    # the restore rewound the loss-scale engine's counters with the
+    # rest of the state — re-baseline so the next handled overflow
+    # still reads as a fresh increment
+    st.ls_skipped_seen = _ls_skipped(adapter.model)
+    return True
+
+
+# ======================================================================
+# the guarded fit loop
+# ======================================================================
+def run_fit(model, fault_tolerance: Optional[FaultTolerance], data,
+            labels=None, epochs: int = 1, auto_resume=None, trainer=None):
+    """Fault-tolerant replacement for the legacy fit loops — entered by
+    MultiLayerNetwork/ComputationGraph/ShardedTrainer ``fit`` ONLY when
+    a policy was requested; the legacy paths stay untouched."""
+    ft = resolve_policy(fault_tolerance, auto_resume)
+    if ft is None:
+        raise ValueError("run_fit requires a FaultTolerance policy or "
+                         "an auto_resume directory")
+    adapter = _FitAdapter(model, trainer)
+    it, was_iterator = _as_iterator(data, labels, adapter)
+    try:
+        resettable = bool(it.resetSupported())
+    except Exception:
+        resettable = True
+    if int(epochs) > 1 and not resettable:
+        # legacy parity (graph.py multi-epoch guard): fail fast with a
+        # clear error instead of a raw NotImplementedError at epoch 2
+        raise ValueError(
+            "epochs > 1 requires a resettable iterator "
+            "(reference behavior)")
+    prev_retry = _configure_prefetch_retry(ft, it)
+
+    resumed = None
+    if ft.auto_resume and ft.checkpoint_dir:
+        bundle = latest_valid_bundle(ft.checkpoint_dir)
+        if bundle is not None:
+            resumed = _restore_bundle(adapter, bundle)
+
+    total = int(epochs)
+    skip_reset_first = False
+    if resumed is not None:
+        total = int(resumed.get("epochs_remaining", epochs))
+        ist = resumed.get("iterator_state")
+        if ist is not None:
+            # mid-epoch: continue in place (no reset) on the next
+            # batch. Epoch boundary: restore anyway — the epoch-opening
+            # reset() below then advances the iterator's internal epoch
+            # counter, keeping shuffle order identical to a run that
+            # was never interrupted
+            ok = _try_set_state(it, ist)
+            skip_reset_first = ok and bool(resumed.get("mid_epoch"))
+        elif total > 0:
+            log.warning(
+                "resilience: the bundle carries no iterator position "
+                "(the interrupted run's iterator had no state support) "
+                "— restarting the interrupted epoch from its first "
+                "batch")
+
+    # _last_etl_ms parity with the legacy MLN loop: a real ETL series
+    # only for true iterator inputs; array/DataSet fits clear any stale
+    # value (the UI would otherwise chart a frozen constant)
+    track_etl = (was_iterator and trainer is None and not adapter.is_graph)
+    if not was_iterator and trainer is None and not adapter.is_graph:
+        model._last_etl_ms = None
+
+    st = _RunState(ft, adapter)
+    try:
+        with ft._signal_scope():
+            for e in range(total):
+                # mirror MultiDataSetIterator.__iter__: a one-epoch fit
+                # over a non-resettable stream consumes it in place
+                if not (skip_reset_first and e == 0) and resettable:
+                    it.reset()
+                if _run_epoch(ft, adapter, it, st, e, total,
+                              was_iterator, track_etl):
+                    return model   # preempted: checkpointed clean exit
+                if was_iterator:
+                    adapter.end_epoch()
+    finally:
+        if prev_retry is not None:
+            # the retry posture belongs to THIS policy-driven fit: a
+            # later plain fit() on the same iterator must get the
+            # legacy fail-fast behavior back
+            it.configure_retries(*prev_retry)
+    adapter.finish()
+    if ft.auto_resume and ft.checkpoint_dir:
+        # the run finished: retire its bundles so the next fit on this
+        # directory starts fresh instead of reviving a completed run
+        retire_bundles(ft.checkpoint_dir)
+    return model
+
+
+def _configure_prefetch_retry(ft: FaultTolerance, it):
+    """Apply the policy's transfer-retry posture to a wrapping
+    DevicePrefetchIterator. Returns the iterator's previous
+    (retries, backoff, quarantine) for restoration at fit exit, or
+    None when nothing was changed."""
+    from deeplearning4j_tpu.datasets.device_prefetch import (
+        DevicePrefetchIterator,
+    )
+
+    if isinstance(it, DevicePrefetchIterator) and ft.transfer_retries > 0 \
+            and it._transfer_retries == 0 and not it._quarantine:
+        # the user didn't configure their own retry posture — apply the
+        # policy's (retry with backoff, then quarantine instead of die)
+        prev = (it._transfer_retries, it._transfer_backoff,
+                it._quarantine)
+        it.configure_retries(ft.transfer_retries,
+                             backoff=ft.transfer_backoff,
+                             quarantine=True)
+        return prev
+    return None
+
+
+def _run_epoch(ft: FaultTolerance, adapter: _FitAdapter, it,
+               st: _RunState, epoch_idx: int, total_epochs: int,
+               was_iterator: bool = True, track_etl: bool = False) -> bool:
+    """One epoch under the guards. Returns True on preemption exit."""
+    monkey = _chaos.active()
+    while True:
+        # the watchdog spans the whole fetch->step->guard cycle, not
+        # just the step dispatch: the step itself is ASYNC (a hung
+        # collective or wedged transfer surfaces at the next blocking
+        # point — the iterator's queue get or the divergence guard's
+        # loss sync), so arming only around adapter.step would never
+        # fire for exactly the stalls the watchdog exists to diagnose
+        with ft._watchdog():
+            t0 = time.perf_counter()
+            if not it.hasNext():
+                return False
+            batch = it.next()
+            _telemetry.record_phase("etl_wait", t0)
+            if track_etl:
+                # UI parity with the legacy MultiLayerNetwork loop: the
+                # ETL wait feeds the system charts via _last_etl_ms
+                adapter.model._last_etl_ms = \
+                    (time.perf_counter() - t0) * 1e3
+            if monkey is not None:
+                batch = monkey.corrupt_batch(batch, st.steps_done)
+            _maybe_snapshot(ft, adapter, st)
+            adapter.step(batch)
+            st.steps_done += 1
+            _check_divergence(ft, adapter, st)
+        if monkey is not None:
+            monkey.maybe_preempt(st.steps_done)
+        if ft.preemption_requested:
+            _write_preemption_checkpoint(ft, adapter, it, epoch_idx,
+                                         total_epochs, was_iterator)
+            # consumed: the next fit on this (reusable) policy object
+            # must not re-preempt off a flag already acted on
+            ft._preempt.clear()
+            return True
+
+
+__all__ = ["FaultTolerance", "DivergenceError", "StepWatchdog",
+           "run_fit", "resolve_policy", "write_bundle",
+           "latest_valid_bundle", "validate_bundle", "retire_bundles"]

@@ -173,4 +173,181 @@ if [ $precsmoke -ne 0 ]; then
     echo "FATAL: precision-matrix smoke gate regressed" >&2
     exit 1
 fi
+
+# Chaos smoke gate (docs/FAULT_TOLERANCE.md): three phases sharing one
+# checkpoint dir. A: clean baseline + identity check (a FaultTolerance
+# with every guard off must be bit-identical to the legacy fit loop).
+# B: env-gated chaos — NaN batch + transient transfer errors + a real
+# SIGTERM mid-run — must roll back, retry, and exit cleanly with a
+# resumable bundle. C: auto-resume under continued transfer errors
+# must finish on the NEXT batch with a finite loss within tolerance of
+# the clean run. Any silent regression in the recovery paths fails CI.
+CHAOS_DIR=$(mktemp -d /tmp/dl4j_chaos_gate.XXXXXX)
+export DL4J_TPU_CHAOS_GATE_DIR="$CHAOS_DIR"
+# shared fixture for the three phases: phase C's exact iteration count
+# and loss-tolerance comparison are only meaningful if every phase
+# builds the IDENTICAL model and batch stream — one module, imported by
+# each subprocess, instead of three drift-prone copies
+cat > "$CHAOS_DIR/chaos_gate_common.py" <<'EOF'
+import numpy as np
+
+from deeplearning4j_tpu.learning import Adam
+from deeplearning4j_tpu.datasets import ArrayDataSetIterator
+from deeplearning4j_tpu.nn.conf import (
+    DenseLayer, InputType, NeuralNetConfiguration, OutputLayer,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+rng = np.random.default_rng(0)
+x = rng.normal(size=(48, 4)).astype(np.float32)
+y = np.eye(2, dtype=np.float32)[(x.sum(1) > 0).astype(int)]
+
+
+def make():
+    return MultiLayerNetwork(
+        (NeuralNetConfiguration.builder().seed(11)
+         .updater(Adam(learning_rate=0.01)).list()
+         .layer(DenseLayer(n_out=8, activation="tanh"))
+         .layer(OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+         .setInputType(InputType.feedForward(4)).build())).init()
+
+
+def it():
+    return ArrayDataSetIterator(x, y, 8, shuffle=True, seed=5)
+EOF
+env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu DL4J_TPU_TELEMETRY=1 \
+    PYTHONPATH="$CHAOS_DIR" python - <<'EOF'
+# phase A: clean baseline + identity-policy bit-equality
+import json
+import os
+import sys
+
+import jax
+import numpy as np
+
+from chaos_gate_common import it, make, x, y
+from deeplearning4j_tpu.datasets import DataSet
+from deeplearning4j_tpu.util import FaultTolerance
+
+d = os.environ["DL4J_TPU_CHAOS_GATE_DIR"]
+clean = make()
+clean.fit(it(), epochs=3)
+clean_loss = clean.score(DataSet(x, y))
+ident = make()
+ident.fit(it(), epochs=3,
+          fault_tolerance=FaultTolerance(divergence_window=0))
+for a, b in zip(jax.tree_util.tree_leaves((clean.params_list,
+                                           clean.opt_states)),
+                jax.tree_util.tree_leaves((ident.params_list,
+                                           ident.opt_states))):
+    if not np.array_equal(np.asarray(a), np.asarray(b)):
+        sys.stderr.write("chaos gate A: identity FaultTolerance is NOT "
+                         "bit-identical to the legacy fit loop\n")
+        sys.exit(1)
+with open(os.path.join(d, "clean.json"), "w") as f:
+    json.dump({"loss": float(clean_loss)}, f)
+print(f"chaos gate A OK: clean loss {clean_loss:.5f}, identity policy "
+      "bit-identical")
+EOF
+gateA=$?
+env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu DL4J_TPU_TELEMETRY=1 \
+    DL4J_TPU_CHAOS=1 DL4J_TPU_CHAOS_NAN_STEPS=4 \
+    DL4J_TPU_CHAOS_TRANSFER_P=0.2 DL4J_TPU_CHAOS_PREEMPT_AT=10 \
+    DL4J_TPU_CHAOS_SEED=7 \
+    PYTHONPATH="$CHAOS_DIR" python - <<'EOF'
+# phase B: NaN batch + flaky transfers + SIGTERM -> clean bundle
+import os
+import sys
+
+from chaos_gate_common import it, make
+from deeplearning4j_tpu.datasets import DevicePrefetchIterator
+from deeplearning4j_tpu.profiler import telemetry
+from deeplearning4j_tpu.util import FaultTolerance
+from deeplearning4j_tpu.util.resilience import latest_valid_bundle
+
+d = os.environ["DL4J_TPU_CHAOS_GATE_DIR"]
+net = make()
+ft = FaultTolerance(checkpoint_dir=d, divergence_window=8,
+                    snapshot_every=2, transfer_backoff=0.005)
+with DevicePrefetchIterator(it(), depth=2) as pf:
+    net.fit(pf, epochs=3, fault_tolerance=ft)   # SIGTERM fires inside
+reg = telemetry.MetricsRegistry.get_default()
+fail = []
+if latest_valid_bundle(d) is None:
+    fail.append("no valid resumable bundle after SIGTERM")
+if reg.counter(telemetry.FT_PREEMPTION_CHECKPOINTS).total() != 1:
+    fail.append("preemption checkpoint counter != 1")
+if reg.counter(telemetry.FT_ROLLBACKS).total() < 1:
+    fail.append("NaN batch did not trigger a rollback")
+if reg.counter(telemetry.TRANSFER_RETRIES).total() < 1:
+    fail.append("transfer errors did not trigger retries")
+if reg.counter(telemetry.TRANSFER_QUARANTINES).total() != 0:
+    fail.append("transient errors escalated to quarantine")
+if fail:
+    sys.stderr.write("chaos gate B FAILED:\n  " + "\n  ".join(fail) + "\n")
+    sys.exit(1)
+print(f"chaos gate B OK: preempted at iteration "
+      f"{net.getIterationCount()} with "
+      f"{reg.counter(telemetry.FT_ROLLBACKS).total():.0f} rollback(s), "
+      f"{reg.counter(telemetry.TRANSFER_RETRIES).total():.0f} "
+      "transfer retry(ies), bundle written")
+EOF
+gateB=$?
+env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu DL4J_TPU_TELEMETRY=1 \
+    DL4J_TPU_CHAOS=1 DL4J_TPU_CHAOS_TRANSFER_P=0.2 \
+    DL4J_TPU_CHAOS_SEED=13 \
+    PYTHONPATH="$CHAOS_DIR" python - <<'EOF'
+# phase C: auto-resume -> next batch -> finite loss near the clean run
+import json
+import os
+import sys
+
+import numpy as np
+
+from chaos_gate_common import it, make, x, y
+from deeplearning4j_tpu.datasets import DataSet, DevicePrefetchIterator
+from deeplearning4j_tpu.profiler import telemetry
+from deeplearning4j_tpu.util import FaultTolerance
+
+d = os.environ["DL4J_TPU_CHAOS_GATE_DIR"]
+net = make()
+ft = FaultTolerance(checkpoint_dir=d, divergence_window=8,
+                    snapshot_every=2, transfer_backoff=0.005)
+with DevicePrefetchIterator(it(), depth=2) as pf:
+    net.fit(pf, epochs=3, fault_tolerance=ft)
+reg = telemetry.MetricsRegistry.get_default()
+final = net.score(DataSet(x, y))
+clean = json.load(open(os.path.join(d, "clean.json")))["loss"]
+fail = []
+if reg.counter(telemetry.FT_AUTO_RESUMES).total() != 1:
+    fail.append("run did not auto-resume from the bundle")
+# 18 total steps across both incarnations, minus the one rolled-back
+# NaN batch — a smaller count means resume repeated or skipped work.
+# Exact-17 depends on NAN_STEPS=4 landing right ON a snapshot step
+# (snapshot_every=2): the rollback then discards zero good steps. If
+# either knob changes, re-derive this constant (see the rollback-
+# granularity note in docs/FAULT_TOLERANCE.md).
+if net.getIterationCount() != 17:
+    fail.append(f"resumed run ended at iteration "
+                f"{net.getIterationCount()}, expected 17")
+if not np.isfinite(final):
+    fail.append(f"non-finite final loss {final}")
+# one skipped batch perturbs the trajectory; 'within tolerance' here
+# means the chaos run still converged to the clean run's neighborhood
+elif abs(final - clean) > max(0.5 * abs(clean), 0.05):
+    fail.append(f"final loss {final:.5f} too far from clean run's "
+                f"{clean:.5f}")
+if fail:
+    sys.stderr.write("chaos gate C FAILED:\n  " + "\n  ".join(fail) + "\n")
+    sys.exit(1)
+print(f"chaos gate C OK: auto-resumed, finished at iteration "
+      f"{net.getIterationCount()}, loss {final:.5f} "
+      f"(clean {clean:.5f})")
+EOF
+gateC=$?
+rm -rf "$CHAOS_DIR"
+if [ $gateA -ne 0 ] || [ $gateB -ne 0 ] || [ $gateC -ne 0 ]; then
+    echo "FATAL: chaos smoke gate regressed (A=$gateA B=$gateB C=$gateC)" >&2
+    exit 1
+fi
 exit $rc

@@ -1,0 +1,733 @@
+"""Fault-tolerance tests (util/resilience.py + profiler/chaos.py):
+preemption-safe checkpointing, mid-epoch auto-resume (bit-identical to
+an uninterrupted run, incl. updater + loss-scale state), divergence
+rollback, transfer retry/quarantine, watchdog, and the restart-safety
+satellites (CheckpointListener, atomic writeModel, EarlyStopping
+interrupt propagation)."""
+
+import os
+import threading
+import time
+import zipfile
+
+import jax
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets import (
+    ArrayDataSetIterator, BatchShapePolicy, DataSet,
+    DevicePrefetchIterator, ListDataSetIterator,
+)
+from deeplearning4j_tpu.learning import Adam
+from deeplearning4j_tpu.nn.conf import (
+    DenseLayer, InputType, NeuralNetConfiguration, OutputLayer,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.optimize import CheckpointListener
+from deeplearning4j_tpu.profiler import chaos, telemetry
+from deeplearning4j_tpu.util import (
+    DivergenceError, FaultTolerance, ModelSerializer, StepWatchdog,
+)
+from deeplearning4j_tpu.util import resilience
+
+
+def small_net(seed=9, precision=None):
+    b = (NeuralNetConfiguration.builder()
+         .seed(seed).updater(Adam(learning_rate=0.01)))
+    if precision:
+        b = b.precision(precision)
+    return MultiLayerNetwork(
+        (b.list()
+         .layer(DenseLayer(n_out=8, activation="tanh"))
+         .layer(OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+         .setInputType(InputType.feedForward(4))
+         .build())).init()
+
+
+def toy_data(n=48, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, 4)).astype(np.float32)
+    y_idx = (x.sum(1) > 0).astype(int)
+    return x, np.eye(2, dtype=np.float32)[y_idx]
+
+
+X, Y = toy_data()
+
+
+def make_iter(bs=8):
+    return ArrayDataSetIterator(X, Y, bs, shuffle=True, seed=5)
+
+
+def leaves(*trees):
+    return [np.asarray(l) for l in jax.tree_util.tree_leaves(trees)]
+
+
+def assert_trees_equal(a, b):
+    la, lb = leaves(a), leaves(b)
+    assert len(la) == len(lb)
+    for u, v in zip(la, lb):
+        np.testing.assert_array_equal(u, v)
+
+
+# ======================================================================
+# iterator state (the declared-but-unimplemented SURVEY §5 surface)
+# ======================================================================
+class TestIteratorState:
+    def test_array_iterator_resume_yields_next_batch(self):
+        it = make_iter()
+        it.reset()
+        batches = []
+        for _ in range(3):
+            batches.append(np.asarray(it.next().features))
+        state = it.get_state()
+        rest = [np.asarray(ds.features)
+                for ds in iter_no_reset(it)]
+        it2 = make_iter()
+        it2.set_state(state)
+        resumed = [np.asarray(ds.features) for ds in iter_no_reset(it2)]
+        assert len(resumed) == len(rest)
+        for a, b in zip(rest, resumed):
+            np.testing.assert_array_equal(a, b)
+
+    def test_list_iterator_state(self):
+        dss = [DataSet(X[i:i + 8], Y[i:i + 8]) for i in range(0, 24, 8)]
+        it = ListDataSetIterator(dss)
+        it.next()
+        st = it.get_state()
+        assert st == {"i": 1}
+        it2 = ListDataSetIterator(dss)
+        it2.set_state(st)
+        np.testing.assert_array_equal(np.asarray(it2.next().features),
+                                      np.asarray(dss[1].features))
+        with pytest.raises(ValueError):
+            it2.set_state({"i": 99})
+
+    def test_prefetch_state_passthrough(self):
+        """get_state through the prefetcher reports the CONSUMER's
+        position, not the lookahead workers' — restoring it re-yields
+        exactly the unconsumed remainder."""
+        with DevicePrefetchIterator(ArrayDataSetIterator(X, Y, 8),
+                                    depth=2) as it:
+            it.reset()
+            for _ in range(3):
+                assert it.hasNext()
+                it.next()
+            st = it.get_state()
+        assert st == {"underlying": {"i": 24, "epoch": 1}}
+        with DevicePrefetchIterator(ArrayDataSetIterator(X, Y, 8),
+                                    depth=2) as it2:
+            it2.set_state(st)
+            feats = [np.asarray(ds.features) for ds in iter_no_reset(it2)]
+        assert len(feats) == 3
+        np.testing.assert_array_equal(feats[0], X[24:32])
+
+    def test_prefetch_get_state_survives_lazy_start_after_set_state(self):
+        """A restored position must remain readable through get_state()
+        even after the pipeline lazily starts (hasNext) and before any
+        batch is consumed — a checkpoint taken there must not degrade
+        to iterator_state=None."""
+        st = {"underlying": {"i": 24, "epoch": 1}}
+        with DevicePrefetchIterator(ArrayDataSetIterator(X, Y, 8),
+                                    depth=2) as it:
+            it.set_state(st)
+            assert it.hasNext()
+            assert it.get_state() == st
+
+    def test_prefetch_state_before_consumption_raises(self):
+        with DevicePrefetchIterator(ArrayDataSetIterator(X, Y, 8),
+                                    depth=2) as it:
+            with pytest.raises(RuntimeError):
+                it.get_state()
+
+
+def iter_no_reset(it):
+    """Consume WITHOUT reset — the mid-epoch resume consumption mode."""
+    while it.hasNext():
+        yield it.next()
+
+
+# ======================================================================
+# preemption checkpoint + auto-resume
+# ======================================================================
+class TestPreemptResume:
+    def test_sigterm_checkpoint_then_bit_identical_resume(self, tmp_path):
+        """The acceptance contract: SIGTERM mid-epoch writes one atomic
+        bundle; a fresh process auto-resumes on the NEXT batch and ends
+        bit-identical (params AND updater state) to an uninterrupted
+        run."""
+        ck = str(tmp_path / "ck")
+        clean = small_net()
+        clean.fit(make_iter(), epochs=2)
+
+        net = small_net()
+        with chaos.installed(chaos.ChaosConfig(preempt_at_step=9)):
+            net.fit(make_iter(), epochs=2,
+                    fault_tolerance=FaultTolerance(
+                        checkpoint_dir=ck, divergence_window=0))
+        # preempted after 9 steps of 12, mid second epoch
+        assert net.getIterationCount() == 9
+        bundle = resilience.latest_valid_bundle(ck)
+        assert bundle is not None and resilience.validate_bundle(bundle)
+
+        net2 = small_net()
+        net2.fit(make_iter(), epochs=2,
+                 fault_tolerance=FaultTolerance(
+                     checkpoint_dir=ck, divergence_window=0))
+        assert net2.getIterationCount() == 12
+        assert net2.getEpochCount() == 2
+        assert_trees_equal(clean.params_list, net2.params_list)
+        assert_trees_equal(clean.opt_states, net2.opt_states)
+        # a finished run retires its bundles: the next fit starts fresh
+        assert resilience.latest_valid_bundle(ck) is None
+
+    def test_resume_consumes_next_batch_not_repeat(self, tmp_path):
+        """Count distinct feature rows seen across interrupt + resume:
+        every example is trained on exactly twice (2 epochs), proving
+        the restored run neither repeats nor skips a batch."""
+        ck = str(tmp_path / "ck")
+        seen = []
+
+        class Spy(ArrayDataSetIterator):
+            def next(self):
+                ds = super().next()
+                seen.append(np.asarray(ds.features)[:, 0].copy())
+                return ds
+
+        def spy_iter():
+            return Spy(X, Y, 8, shuffle=True, seed=5)
+
+        net = small_net()
+        with chaos.installed(chaos.ChaosConfig(preempt_at_step=7)):
+            net.fit(spy_iter(), epochs=2,
+                    fault_tolerance=FaultTolerance(
+                        checkpoint_dir=ck, divergence_window=0))
+        net2 = small_net()
+        net2.fit(spy_iter(), epochs=2,
+                 fault_tolerance=FaultTolerance(
+                     checkpoint_dir=ck, divergence_window=0))
+        rows = np.concatenate(seen)
+        # 2 epochs x 48 examples, no repeats, no gaps
+        assert rows.shape[0] == 96
+        _, counts = np.unique(rows, return_counts=True)
+        assert (counts == 2).all()
+
+    def test_loss_scale_state_survives_resume_bit_identical(self, tmp_path):
+        """mixed_float16 resume: the live loss scale + overflow
+        counters ride the bundle, so the resumed run's loss-scale state
+        and master/updater trees match an uninterrupted run exactly."""
+        ck = str(tmp_path / "ck")
+        clean = small_net(precision="mixed_float16")
+        clean.fit(make_iter(), epochs=2)
+
+        net = small_net(precision="mixed_float16")
+        with chaos.installed(chaos.ChaosConfig(preempt_at_step=8)):
+            net.fit(make_iter(), epochs=2,
+                    fault_tolerance=FaultTolerance(
+                        checkpoint_dir=ck, divergence_window=0))
+        net2 = small_net(precision="mixed_float16")
+        net2.fit(make_iter(), epochs=2,
+                 fault_tolerance=FaultTolerance(
+                     checkpoint_dir=ck, divergence_window=0))
+        assert_trees_equal(clean.params_list, net2.params_list)
+        assert_trees_equal(clean.opt_states, net2.opt_states)
+        assert_trees_equal(clean._loss_scale_state, net2._loss_scale_state)
+
+    def test_corrupt_newest_bundle_falls_back(self, tmp_path):
+        ck = str(tmp_path / "ck")
+        net = small_net()
+        with chaos.installed(chaos.ChaosConfig(preempt_at_step=4)):
+            net.fit(make_iter(), epochs=3,
+                    fault_tolerance=FaultTolerance(
+                        checkpoint_dir=ck, divergence_window=0))
+        net_b = small_net()
+        with chaos.installed(chaos.ChaosConfig(preempt_at_step=4)):
+            # resumes from bundle-4, preempts again at global step 8
+            net_b.fit(make_iter(), epochs=3,
+                      fault_tolerance=FaultTolerance(
+                          checkpoint_dir=ck, divergence_window=0))
+        bundles = sorted(d for d in os.listdir(ck)
+                         if d.startswith("bundle-"))
+        assert len(bundles) == 2
+        # tear the newest bundle's model.zip: digest validation must
+        # reject it and discovery must fall back to the older one
+        newest = os.path.join(ck, bundles[-1], "model.zip")
+        with open(newest, "r+b") as f:
+            f.truncate(100)
+        assert not resilience.validate_bundle(os.path.join(ck, bundles[-1]))
+        assert resilience.latest_valid_bundle(ck) == \
+            os.path.join(ck, bundles[0])
+        net2 = small_net()
+        net2.fit(make_iter(), epochs=3,
+                 fault_tolerance=FaultTolerance(
+                     checkpoint_dir=ck, divergence_window=0))
+        assert net2.getIterationCount() == 18
+        assert np.isfinite(float(net2.score()))
+
+    def test_preemption_via_request_api(self, tmp_path):
+        """request_preemption() (a cluster-notice poller's entry point)
+        checkpoints at the next step boundary without any signal."""
+        ck = str(tmp_path / "ck")
+        ft = FaultTolerance(checkpoint_dir=ck, divergence_window=0)
+
+        class Trigger:
+            def __init__(self):
+                self.n = 0
+
+            def iterationDone(self, model, iteration, epoch):
+                self.n += 1
+                if self.n == 3:
+                    ft.request_preemption()
+
+        net = small_net()
+        net.setListeners(Trigger())
+        net.fit(make_iter(), epochs=2, fault_tolerance=ft)
+        assert net.getIterationCount() == 3
+        assert resilience.latest_valid_bundle(ck) is not None
+
+    def test_preempt_on_epoch_boundary_bit_identical(self, tmp_path):
+        """SIGTERM landing on an epoch's FINAL step: the checkpoint
+        path never probes hasNext() on a stateful iterator (it could
+        block on a wedged pipeline) — the boundary resolves at RESUME
+        time as an empty first epoch whose end-of-epoch bookkeeping
+        (counter + onEpochEnd) runs there, and the resumed shuffle
+        order stays identical to an uninterrupted run (the iterator's
+        internal epoch counter rides the bundle)."""
+        ck = str(tmp_path / "ck")
+        clean = small_net()
+        clean.fit(make_iter(), epochs=2)
+
+        epochs_seen = []
+
+        class EpochSpy:
+            def iterationDone(self, model, iteration, epoch):
+                pass
+
+            def onEpochEnd(self, model):
+                epochs_seen.append(model.getEpochCount())
+
+        net = small_net()
+        net.setListeners(EpochSpy())
+        with chaos.installed(chaos.ChaosConfig(preempt_at_step=6)):
+            net.fit(make_iter(), epochs=2,
+                    fault_tolerance=FaultTolerance(
+                        checkpoint_dir=ck, divergence_window=0))
+        # bookkeeping for the just-completed epoch is deferred to the
+        # resumed run — the dying process does only the bundle write
+        assert net.getEpochCount() == 0 and epochs_seen == []
+        net2 = small_net()
+        net2.setListeners(EpochSpy())
+        net2.fit(make_iter(), epochs=2,
+                 fault_tolerance=FaultTolerance(
+                     checkpoint_dir=ck, divergence_window=0))
+        assert net2.getEpochCount() == 2
+        assert epochs_seen == [1, 2]   # both epochs booked on resume
+        assert_trees_equal(clean.params_list, net2.params_list)
+        assert_trees_equal(clean.opt_states, net2.opt_states)
+
+    def test_preemption_requested_before_fit_is_honored(self, tmp_path):
+        """A preemption notice arriving BEFORE fit() (cluster poller
+        during restore, back-to-back signals) checkpoints at the FIRST
+        step boundary instead of being silently discarded — and the
+        flag is consumed by acting on it, so the next fit completes."""
+        ck = str(tmp_path / "ck")
+        ft = FaultTolerance(checkpoint_dir=ck, divergence_window=0)
+        ft.request_preemption()
+        net = small_net()
+        net.fit(make_iter(), epochs=2, fault_tolerance=ft)
+        assert net.getIterationCount() == 1
+        assert resilience.latest_valid_bundle(ck) is not None
+        net2 = small_net()
+        net2.fit(make_iter(), epochs=2, fault_tolerance=ft)
+        assert net2.getIterationCount() == 12
+        assert resilience.latest_valid_bundle(ck) is None
+
+    def test_policy_object_not_mutated_by_auto_resume(self, tmp_path):
+        """fit(fault_tolerance=ft, auto_resume=dir) must not write the
+        dir into the caller's reusable policy object."""
+        ft = FaultTolerance(divergence_window=0)
+        net = small_net()
+        net.fit(make_iter(), epochs=1, fault_tolerance=ft,
+                auto_resume=str(tmp_path / "d"))
+        assert ft.checkpoint_dir is None
+        assert resilience.latest_valid_bundle(str(tmp_path / "d")) is None
+
+    def test_identity_loop_matches_legacy_fit(self):
+        """run_fit with every guard off must traverse the same batches
+        with the same RNG stream as the legacy loop — same final
+        params, same updater state."""
+        legacy = small_net()
+        legacy.fit(make_iter(), epochs=2)
+        guarded = small_net()
+        guarded.fit(make_iter(), epochs=2,
+                    fault_tolerance=FaultTolerance(divergence_window=0))
+        assert_trees_equal(legacy.params_list, guarded.params_list)
+        assert_trees_equal(legacy.opt_states, guarded.opt_states)
+        assert legacy.getEpochCount() == guarded.getEpochCount()
+
+
+# ======================================================================
+# divergence guard
+# ======================================================================
+class TestDivergenceGuard:
+    def test_nan_batch_rolls_back_and_skips(self):
+        telemetry.MetricsRegistry.get_default().reset()
+        net = small_net()
+        with chaos.installed(chaos.ChaosConfig(nan_steps=(4,))):
+            net.fit(ArrayDataSetIterator(X, Y, 8), epochs=2,
+                    fault_tolerance=FaultTolerance(
+                        divergence_window=8, snapshot_every=2))
+        assert np.isfinite(float(net.score()))
+        for leaf in leaves(net.params_list):
+            assert np.isfinite(leaf).all()
+        reg = telemetry.MetricsRegistry.get_default()
+        assert reg.counter(telemetry.FT_ROLLBACKS).total() == 1
+        assert reg.counter(telemetry.FT_SKIPPED_BATCHES).total() == 1
+
+    def test_rollback_budget_exhaustion_raises(self):
+        telemetry.MetricsRegistry.get_default().reset()
+        net = small_net()
+        with chaos.installed(chaos.ChaosConfig(nan_steps=tuple(range(50)))):
+            with pytest.raises(DivergenceError):
+                net.fit(ArrayDataSetIterator(X, Y, 8), epochs=4,
+                        fault_tolerance=FaultTolerance(
+                            divergence_window=8, max_rollbacks=2))
+        # the abort restored the last snapshot: a caller salvaging the
+        # run holds finite params, not the diverged state — and the
+        # counters report only rollbacks that actually happened
+        for leaf in leaves(net.params_list):
+            assert np.isfinite(leaf).all()
+        reg = telemetry.MetricsRegistry.get_default()
+        assert reg.counter(telemetry.FT_ROLLBACKS).total() == 2
+
+    def test_min_history_clamped_to_window(self):
+        # a min_history above the window length would silently disable
+        # the spike rule (the deque can never grow that long)
+        assert FaultTolerance(divergence_window=4).min_history <= 4
+
+    def test_spike_detection(self):
+        """A finite but exploded loss (not just NaN) triggers the
+        rollback via the rolling-median spike rule."""
+        ft = FaultTolerance(divergence_window=8, min_history=3,
+                            spike_factor=10.0, snapshot_every=2)
+        adapter = resilience._FitAdapter(small_net())
+        st = resilience._RunState(ft, adapter)
+        resilience._maybe_snapshot(ft, adapter, st)
+        import jax.numpy as jnp
+
+        for v in (0.7, 0.69, 0.68):
+            adapter.model._score = jnp.asarray(v)
+            assert not resilience._check_divergence(ft, adapter, st)
+        adapter.model._score = jnp.asarray(500.0)
+        assert resilience._check_divergence(ft, adapter, st)
+        assert st.rollbacks == 1
+
+    def test_handled_loss_scale_overflow_is_not_divergence(self):
+        """A mixed_float16 overflow the loss-scale engine already
+        handled (step skipped, scale halved) must NOT trigger a
+        rollback — rolling back would reinstate the pre-halving scale
+        and discard good committed steps."""
+        telemetry.MetricsRegistry.get_default().reset()
+        net = small_net(precision="mixed_float16")
+        # warm up past the initial 2^15 scale's ceiling probe so the
+        # only overflow the guarded fit sees is the injected one
+        net.fit(ArrayDataSetIterator(X, Y, 8), epochs=1)
+        base_skipped = resilience._ls_skipped(net)
+        big = DataSet(np.full((8, 4), 1e7, np.float32),
+                      Y[:8])   # inf once staged to f16
+        it = ListDataSetIterator(
+            [DataSet(X[:8], Y[:8]), big, DataSet(X[8:16], Y[8:16])])
+        net.fit(it, epochs=1, fault_tolerance=FaultTolerance(
+            divergence_window=8, snapshot_every=1))
+        reg = telemetry.MetricsRegistry.get_default()
+        assert reg.counter(telemetry.FT_ROLLBACKS).total() == 0
+        assert resilience._ls_skipped(net) > base_skipped
+        for leaf in leaves(net.params_list):
+            assert np.isfinite(leaf).all()
+
+
+# ======================================================================
+# non-resettable stream inputs (legacy MultiDataSetIterator parity)
+# ======================================================================
+class _StreamIterator(ListDataSetIterator):
+    def resetSupported(self) -> bool:
+        return False
+
+    def reset(self):
+        raise NotImplementedError("stream cannot rewind")
+
+
+class TestNonResettableIterator:
+    def batches(self):
+        return [DataSet(X[i:i + 8], Y[i:i + 8]) for i in range(0, 24, 8)]
+
+    def test_single_epoch_consumes_stream_in_place(self):
+        net = small_net()
+        net.fit(_StreamIterator(self.batches()), epochs=1,
+                fault_tolerance=FaultTolerance(divergence_window=0))
+        assert net.getIterationCount() == 3
+
+    def test_multi_epoch_fails_fast_with_clear_error(self):
+        net = small_net()
+        it = _StreamIterator(self.batches())
+        with pytest.raises(ValueError, match="resettable"):
+            net.fit(it, epochs=2,
+                    fault_tolerance=FaultTolerance(divergence_window=0))
+        # fail-fast: nothing consumed, no step trained
+        assert it.get_state() == {"i": 0}
+        assert net.getIterationCount() == 0
+
+
+# ======================================================================
+# transfer retry + quarantine
+# ======================================================================
+class TestTransferRetry:
+    def test_transient_errors_retry_to_success(self):
+        telemetry.MetricsRegistry.get_default().reset()
+        net = small_net()
+        it = DevicePrefetchIterator(ArrayDataSetIterator(X, Y, 8),
+                                    depth=2, transfer_backoff=0.002)
+        with chaos.installed(chaos.ChaosConfig(transfer_error_rate=0.4,
+                                               seed=3)), it:
+            net.fit(it, epochs=2,
+                    fault_tolerance=FaultTolerance(divergence_window=0))
+        # FaultTolerance auto-configured the prefetcher's retry policy
+        assert net.getIterationCount() == 12
+        reg = telemetry.MetricsRegistry.get_default()
+        assert reg.counter(telemetry.TRANSFER_RETRIES).total() > 0
+        assert reg.counter(telemetry.TRANSFER_QUARANTINES).total() == 0
+
+    def test_poison_batch_quarantined_not_fatal(self):
+        telemetry.MetricsRegistry.get_default().reset()
+        net = small_net()
+        it = DevicePrefetchIterator(
+            ArrayDataSetIterator(X, Y, 8), depth=2,
+            transfer_retries=2, transfer_backoff=0.001, quarantine=True)
+        with chaos.installed(chaos.ChaosConfig(transfer_error_rate=1.0)), it:
+            net.fit(it, epochs=1,
+                    fault_tolerance=FaultTolerance(
+                        divergence_window=0, transfer_retries=0))
+        # every batch un-transferable -> all quarantined, run survives
+        assert net.getIterationCount() == 0
+        reg = telemetry.MetricsRegistry.get_default()
+        assert reg.counter(telemetry.TRANSFER_QUARANTINES).total() == 6
+
+    def test_ft_retry_posture_restored_after_fit(self):
+        """The policy's retry/quarantine config is scoped to the
+        policy-driven fit — a later plain fit() on the same prefetcher
+        gets the legacy fail-fast behavior back."""
+        net = small_net()
+        with DevicePrefetchIterator(ArrayDataSetIterator(X, Y, 8),
+                                    depth=2) as it:
+            net.fit(it, epochs=1,
+                    fault_tolerance=FaultTolerance(divergence_window=0))
+            assert it._transfer_retries == 0 and not it._quarantine
+
+    def test_default_remains_fail_fast(self):
+        """Without retries/quarantine a transfer error still kills the
+        epoch loudly — the legacy contract."""
+        it = DevicePrefetchIterator(ArrayDataSetIterator(X, Y, 8), depth=2)
+        with chaos.installed(chaos.ChaosConfig(transfer_error_rate=1.0)), it:
+            with pytest.raises(chaos.ChaosTransferError):
+                for _ in it:
+                    pass
+
+    def test_depth0_quarantined_final_batch_ends_epoch_cleanly(self):
+        """depth=0 quarantine: hasNext() absorbs quarantined batches,
+        so a poisoned FINAL batch ends the epoch instead of leaking
+        StopIteration out of next() after hasNext() said True."""
+        net = small_net()
+        it = DevicePrefetchIterator(
+            ArrayDataSetIterator(X, Y, 8), depth=0,
+            transfer_retries=1, transfer_backoff=0.001, quarantine=True)
+        with chaos.installed(chaos.ChaosConfig(transfer_error_rate=1.0)):
+            net.fit(it, epochs=1,
+                    fault_tolerance=FaultTolerance(
+                        divergence_window=0, transfer_retries=0))
+        assert net.getIterationCount() == 0  # all quarantined, no crash
+
+
+# ======================================================================
+# watchdog
+# ======================================================================
+class TestWatchdog:
+    def test_fires_and_counts_on_deadline(self, caplog):
+        telemetry.MetricsRegistry.get_default().reset()
+        with caplog.at_level("ERROR", logger="deeplearning4j_tpu"):
+            with StepWatchdog(0.05, context="test_step") as wd:
+                time.sleep(0.3)
+        assert wd.fired
+        reg = telemetry.MetricsRegistry.get_default()
+        assert reg.counter(telemetry.WATCHDOG_STALLS).total() == 1
+        text = caplog.text
+        assert "WATCHDOG" in text and "MainThread" in text
+
+    def test_fast_step_does_not_fire(self):
+        with StepWatchdog(5.0) as wd:
+            pass
+        assert not wd.fired
+        # the timer thread is cancelled — nothing lingers
+        time.sleep(0.05)
+        assert not any(t.name == "FT-watchdog" and t.is_alive()
+                       for t in threading.enumerate())
+
+
+# ======================================================================
+# satellites
+# ======================================================================
+class TestCheckpointListenerRestart:
+    def test_keep_last_pruning_survives_restart(self, tmp_path):
+        d = str(tmp_path)
+        net = small_net()
+        net.setListeners(CheckpointListener(d, save_every_n_iterations=2,
+                                            keep_last=2))
+        net.fit(ArrayDataSetIterator(X, Y, 8), epochs=1)   # iters 1..6
+        first = sorted(os.listdir(d))
+        assert first == ["checkpoint_iter_4.zip", "checkpoint_iter_6.zip"]
+        # "restart": a fresh listener on the same directory must adopt
+        # the existing files into its pruning window
+        net.setListeners(CheckpointListener(d, save_every_n_iterations=2,
+                                            keep_last=2))
+        net.fit(ArrayDataSetIterator(X, Y, 8), epochs=1)   # iters 7..12
+        assert sorted(os.listdir(d)) == ["checkpoint_iter_10.zip",
+                                         "checkpoint_iter_12.zip"]
+
+    def test_last_checkpoint_scans_disk(self, tmp_path):
+        d = str(tmp_path)
+        net = small_net()
+        net.setListeners(CheckpointListener(d, save_every_n_iterations=3,
+                                            keep_last=3))
+        net.fit(ArrayDataSetIterator(X, Y, 8), epochs=1)
+        fresh = CheckpointListener(d, save_every_n_iterations=3)
+        assert fresh.lastCheckpoint() == \
+            os.path.join(d, "checkpoint_iter_6.zip")
+        restored = ModelSerializer.restore(fresh.lastCheckpoint())
+        assert restored.getIterationCount() == 6
+
+
+class TestAtomicWriteModel:
+    def test_concurrent_writers_same_path(self, tmp_path):
+        """Two threads saving to the same target used to share one
+        '<path>.tmp' and corrupt each other; unique temp names mean the
+        survivor is always a COMPLETE archive."""
+        path = str(tmp_path / "m.zip")
+        net = small_net()
+        errors = []
+
+        def save():
+            try:
+                for _ in range(5):
+                    ModelSerializer.writeModel(net, path)
+            except Exception as e:   # noqa: BLE001
+                errors.append(e)
+
+        threads = [threading.Thread(target=save) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors
+        assert not [f for f in os.listdir(tmp_path) if ".tmp" in f]
+        with zipfile.ZipFile(path) as zf:   # complete, readable archive
+            assert zf.testzip() is None
+            assert "coefficients.npz" in zf.namelist()
+
+    def test_failed_save_leaves_previous_file(self, tmp_path):
+        path = str(tmp_path / "m.zip")
+        net = small_net()
+        ModelSerializer.writeModel(net, path)
+        before = open(path, "rb").read()
+
+        class Broken:
+            params_list = None
+
+        with pytest.raises(Exception):
+            ModelSerializer.writeModel(Broken(), path)
+        assert open(path, "rb").read() == before
+        assert not [f for f in os.listdir(tmp_path) if ".tmp" in f]
+
+
+class TestEarlyStoppingInterrupt:
+    def test_keyboard_interrupt_propagates(self):
+        from deeplearning4j_tpu.earlystopping import (
+            EarlyStoppingConfiguration, EarlyStoppingTrainer,
+            MaxEpochsTerminationCondition, ScoreCalculator,
+        )
+
+        class InterruptingCalc(ScoreCalculator):
+            def calculate_score(self, model):
+                raise KeyboardInterrupt
+
+        net = small_net()
+        saved = list(net._listeners)
+        trainer = EarlyStoppingTrainer(
+            EarlyStoppingConfiguration(
+                score_calculator=InterruptingCalc(),
+                epoch_termination_conditions=[
+                    MaxEpochsTerminationCondition(3)]),
+            net, ArrayDataSetIterator(X, Y, 16))
+        with pytest.raises(KeyboardInterrupt):
+            trainer.fit()
+        # the finally-block still restored the listener chain
+        assert net._listeners == saved
+
+
+class TestShardedResume:
+    def test_reused_trainer_rebuilds_pershard_state_after_resume(
+            self, tmp_path):
+        """A ShardedTrainer (averaging mode) whose per-shard replicas
+        were already built must not keep training from stale pre-
+        restore state after an in-process auto-resume — the restore
+        invalidates _local so the rebuild derives it from the restored
+        model trees."""
+        from deeplearning4j_tpu.parallel.sharded import ShardedTrainer
+
+        ck = str(tmp_path / "ck")
+        net = small_net()
+        tr = ShardedTrainer(net, mode="averaging")
+        ft = FaultTolerance(checkpoint_dir=ck, divergence_window=0)
+
+        class Stop:
+            def __init__(self):
+                self.n = 0
+
+            def iterationDone(self, model, iteration, epoch):
+                self.n += 1
+                if self.n == 3:
+                    ft.request_preemption()
+
+        net.setListeners(Stop())
+        tr.fit(ArrayDataSetIterator(X, Y, 16), epochs=2,
+               fault_tolerance=ft)
+        assert resilience.latest_valid_bundle(ck) is not None
+        assert tr._local is not None   # per-shard replicas were built
+        net.setListeners()
+        # same trainer object resumes in-process: stale _local must go
+        tr.fit(ArrayDataSetIterator(X, Y, 16), epochs=2,
+               fault_tolerance=FaultTolerance(checkpoint_dir=ck,
+                                              divergence_window=0))
+        assert net.getIterationCount() == 6
+        assert np.isfinite(float(net.score()))
+
+
+class TestChaosHarness:
+    def test_env_gated_config(self, monkeypatch):
+        monkeypatch.setenv("DL4J_TPU_CHAOS", "1")
+        monkeypatch.setenv("DL4J_TPU_CHAOS_NAN_STEPS", "3,5")
+        monkeypatch.setenv("DL4J_TPU_CHAOS_TRANSFER_P", "0.25")
+        monkeypatch.setenv("DL4J_TPU_CHAOS_PREEMPT_AT", "12")
+        cfg = chaos.ChaosConfig.from_env()
+        assert cfg.nan_steps == (3, 5)
+        assert cfg.transfer_error_rate == 0.25
+        assert cfg.preempt_at_step == 12
+        monkeypatch.setenv("DL4J_TPU_CHAOS", "0")
+        assert chaos.ChaosConfig.from_env() is None
+
+    def test_corrupt_batch_targets_only_listed_ordinals(self):
+        monkey = chaos.ChaosMonkey(chaos.ChaosConfig(nan_steps=(1,)))
+        ds = DataSet(X[:8], Y[:8])
+        same = monkey.corrupt_batch(ds, 0)
+        assert same is ds
+        poisoned = monkey.corrupt_batch(ds, 1)
+        assert np.isnan(np.asarray(poisoned.features)).all()
+        # the original batch is never mutated
+        assert np.isfinite(np.asarray(ds.features)).all()
