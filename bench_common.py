@@ -18,46 +18,23 @@ import jax.numpy as jnp
 
 log = logging.getLogger("deeplearning4j_tpu")
 
-#: per-chip peak FLOPs keyed by device kind AND compute dtype. A single
-#: bf16 number silently inflates (f32 workload / bf16 peak) or deflates
-#: MFU; the dtype key makes the denominator match the numerator's math.
-#: f32 on the v5e MXU runs at ~half bf16 rate (multi-pass emulation).
-PEAK_FLOPS = {
-    "TPU v5 lite": {"bf16": 197e12, "f32": 98.5e12},
-}
-
-_warned_unknown_peak = set()
-
-
-def peak_flops(dtype="bf16"):
-    """Peak FLOPs of device 0 for a compute dtype ("bf16"/"f32", any
-    DataType.from_any spelling). Unknown devices return None with a
-    logged warning — callers then skip MFU (the measured
-    cost_analysis FLOPs still get reported), rather than dividing by a
-    wrong peak and publishing a silently bogus MFU."""
-    from deeplearning4j_tpu.ndarray.dtypes import DataType
-
-    kind = jax.devices()[0].device_kind
-    entry = PEAK_FLOPS.get(kind)
-    if entry is None:
-        if kind not in _warned_unknown_peak:
-            _warned_unknown_peak.add(kind)
-            log.warning(
-                "no peak-FLOPs entry for device kind %r — MFU will be "
-                "omitted (cost_analysis FLOPs are still measured); add "
-                "the chip to bench_common.PEAK_FLOPS to enable it", kind)
-        return None
-    dt = DataType.from_any(dtype)
-    key = "bf16" if dt.width_bytes() == 2 else "f32"
-    return entry.get(key)
+# the peak-FLOPs table now lives with the profiler so the LIVE fit
+# loops (profiler/model_health.py MFU gauge) and the bench scripts
+# divide by the same denominator; re-exported here so existing
+# `from bench_common import peak_flops, PEAK_FLOPS` keeps working
+from deeplearning4j_tpu.profiler.flops import (  # noqa: E402,F401
+    PEAK_FLOPS, peak_flops,
+)
 
 
 def telemetry_snapshot():
-    """Compile counts/times + device-memory watermarks from the
-    process-wide telemetry registry (profiler/telemetry.py), for
-    embedding in BENCH_*.json rounds alongside wall-clock: a result is
-    only comparable if it compiled the same number of times, and this
-    makes that visible. Call AFTER the timed windows."""
+    """Compile counts/times + device-memory watermarks + model-health
+    series (per-layer grad norms / update ratios / MFU, when a
+    HealthMonitor ran) from the process-wide telemetry registry
+    (profiler/telemetry.py), for embedding in BENCH_*.json rounds
+    alongside wall-clock: a result is only comparable if it compiled
+    the same number of times, and this makes that visible. Call AFTER
+    the timed windows."""
     from deeplearning4j_tpu.profiler import telemetry
 
     return telemetry.snapshot()

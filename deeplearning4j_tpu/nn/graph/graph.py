@@ -31,6 +31,7 @@ from deeplearning4j_tpu.nn.conf.layers import LossLayer, OutputLayer
 from deeplearning4j_tpu.nn.multilayer.network import (
     _REGULARIZED_KEYS, _eval_mask, _uses_epoch_schedule,
 )
+from deeplearning4j_tpu.profiler import model_health as _model_health
 from deeplearning4j_tpu.profiler import telemetry as _telemetry
 
 
@@ -64,6 +65,9 @@ class ComputationGraph:
         self._compute_dtypes: Dict[str, Any] = {}
         self._loss_scale_state = None
         self._ls_seen = (0, 0)
+        # in-step model-health monitor (profiler/model_health.py);
+        # None keeps every step builder on its legacy code path
+        self._health = None
 
     # ------------------------------------------------------------------
     def init(self) -> "ComputationGraph":
@@ -217,10 +221,13 @@ class ComputationGraph:
         return acts, new_states
 
     def _loss(self, params_map, states_map, inputs, labels_map, rng,
-              masks_map=None, fmasks_map=None):
+              masks_map=None, fmasks_map=None, collect_acts=False):
         conf = self.conf
         masks_map = masks_map or {}
         fmasks_map = fmasks_map or {}
+        # per-vertex non-finite forward flags, conf.nodes order
+        # (model-health provenance; None when not collecting)
+        act_bad = [] if collect_acts else None
         from deeplearning4j_tpu.nn.conf.layers import GlobalPoolingLayer
 
         acts: Dict[str, Any] = dict(inputs)
@@ -264,18 +271,28 @@ class ComputationGraph:
                     p_i, states_map[node.name], xs[0], fmask, True, k_i)
                 acts[node.name] = out
                 new_states[node.name] = ns
+                if collect_acts:
+                    act_bad.append(_model_health.act_flag(out))
                 continue
             if node.name in conf.network_outputs and isinstance(v, LayerVertex) \
                     and hasattr(v.layer, "loss_value"):
-                total = total + v.layer.loss_value(
+                lv = v.layer.loss_value(
                     p_i, states_map[node.name], xs[0],
                     labels_map[node.name], masks_map.get(node.name))
+                total = total + lv
                 new_states[node.name] = states_map[node.name]
                 acts[node.name] = xs[0]
+                if collect_acts:
+                    # a loss head's provenance bit is its own loss
+                    # contribution: clean inputs + non-finite loss
+                    # localizes the blow-up to this head
+                    act_bad.append(_model_health.act_flag(lv))
             else:
                 out, ns = v.apply(p_i, states_map[node.name], xs, True, k_i)
                 acts[node.name] = out
                 new_states[node.name] = ns
+                if collect_acts:
+                    act_bad.append(_model_health.act_flag(out))
         data_loss = total
         # regularization
         reg = jnp.asarray(0.0, jnp.float32)
@@ -293,6 +310,8 @@ class ComputationGraph:
                         reg = reg + l1 * jnp.sum(jnp.abs(val))
                     if l2:
                         reg = reg + 0.5 * l2 * jnp.sum(val * val)
+        if collect_acts:
+            return data_loss + reg, (new_states, data_loss, act_bad)
         return data_loss + reg, (new_states, data_loss)
 
     def _clip(self, grads):
@@ -326,11 +345,14 @@ class ComputationGraph:
         raise ValueError(f"Unknown gradient normalization: {mode}")
 
     def _get_train_step(self, mask_key=frozenset(), fmask_key=frozenset()):
-        cache_key = ("step", mask_key, fmask_key)
+        # static health flag: one extra compile per site when toggled
+        health = self._health is not None
+        cache_key = ("step", mask_key, fmask_key, health)
         if cache_key in self._step_cache:
             return self._step_cache[cache_key]
 
         policy = self._policy
+        node_names = [n.name for n in self.conf.nodes]
 
         def apply_updates(params_map, opt_states, grads, it_step,
                           ep_step):
@@ -358,10 +380,12 @@ class ComputationGraph:
                         fmasks_map, rng):
                 loss_fn = lambda pm: self._loss(pm, states_map, inputs,
                                                 labels_map, rng,
-                                                masks_map, fmasks_map)
-                ((loss, (new_states, data_loss)), grads,
+                                                masks_map, fmasks_map,
+                                                collect_acts=health)
+                ((loss, aux), grads,
                  finite) = _precision.scaled_value_and_grad(
                     loss_fn, ls_state, params_map)
+                raw_grads = grads
                 grads = self._clip(grads)
                 new_params, new_opt = apply_updates(
                     params_map, opt_states, grads, it_step, ep_step)
@@ -369,8 +393,14 @@ class ComputationGraph:
                  new_ls) = _precision.guard_scaled_step(
                     policy, ls_state, finite,
                     [(new_params, params_map), (new_opt, opt_states),
-                     (new_states, states_map)])
-                return new_params, new_states, new_opt, new_ls, data_loss
+                     (aux[0], states_map)])
+                if health:
+                    h = _model_health.device_stats(
+                        node_names, raw_grads, new_params, params_map,
+                        aux[2], handled=jnp.logical_not(finite))
+                    return (new_params, new_states, new_opt, new_ls,
+                            aux[1], h)
+                return new_params, new_states, new_opt, new_ls, aux[1]
 
             jitted = _telemetry.instrument_jit(
                 "cg_step", jax.jit(step_fn, donate_argnums=(0, 1, 2, 3)))
@@ -381,13 +411,20 @@ class ComputationGraph:
                     inputs, labels_map, masks_map, fmasks_map, rng):
             loss_fn = lambda pm: self._loss(pm, states_map, inputs,
                                             labels_map, rng, masks_map,
-                                            fmasks_map)
-            (loss, (new_states, data_loss)), grads = \
+                                            fmasks_map,
+                                            collect_acts=health)
+            (loss, aux), grads = \
                 jax.value_and_grad(loss_fn, has_aux=True)(params_map)
+            raw_grads = grads
             grads = self._clip(grads)
             new_params, new_opt = apply_updates(
                 params_map, opt_states, grads, it_step, ep_step)
-            return new_params, new_states, new_opt, data_loss
+            if health:
+                h = _model_health.device_stats(
+                    node_names, raw_grads, new_params, params_map,
+                    aux[2])
+                return new_params, aux[0], new_opt, aux[1], h
+            return new_params, aux[0], new_opt, aux[1]
 
         jitted = _telemetry.instrument_jit(
             "cg_step", jax.jit(step_fn, donate_argnums=(0, 1, 2)))
@@ -488,21 +525,26 @@ class ComputationGraph:
                     masks[n] = jnp.asarray(_unwrap(m))
         fmasks = self._validate_fmasks(feature_masks, inputs)
         self._rng_key, sub = jax.random.split(self._rng_key)
+        hm = self._health
         step = self._get_train_step(frozenset(masks), frozenset(fmasks))
         t_step = time.perf_counter()
         if self._loss_scale_state is not None:
-            (self.params_map, self.states_map, self.opt_states,
-             self._loss_scale_state, loss) = step(
+            res = step(
                 self.params_map, self.states_map, self.opt_states,
                 self._loss_scale_state, jnp.asarray(self._iteration),
                 jnp.asarray(self._epoch), inputs, labels, masks, fmasks,
                 sub)
-        else:
+            res, health = _model_health.split_health(res, hm is not None)
             (self.params_map, self.states_map, self.opt_states,
-             loss) = step(
+             self._loss_scale_state, loss) = res
+        else:
+            res = step(
                 self.params_map, self.states_map, self.opt_states,
                 jnp.asarray(self._iteration), jnp.asarray(self._epoch),
                 inputs, labels, masks, fmasks, sub)
+            res, health = _model_health.split_health(res, hm is not None)
+            (self.params_map, self.states_map, self.opt_states,
+             loss) = res
         # dispatch-side host timing (the step itself runs async on
         # device; blocking here would stall the pipeline)
         _telemetry.record_phase("device_step", t_step)
@@ -512,6 +554,8 @@ class ComputationGraph:
         self._last_batch_size = int(
             next(iter(inputs.values())).shape[0]) if inputs else 0
         _telemetry.sample_device_memory()
+        if hm is not None:
+            hm.on_step(self, health, site="cg", jit_site="cg_step")
         if self._loss_scale_state is not None:
             self._ls_seen = _precision.record_loss_scale(
                 "cg", self._loss_scale_state, self._ls_seen)
@@ -906,6 +950,15 @@ class ComputationGraph:
     def addListeners(self, *ls):
         self._listeners.extend(ls)
         return self
+
+    def setHealthMonitor(self, monitor) -> "ComputationGraph":
+        """Attach (or with None, detach) an in-step HealthMonitor
+        (profiler/model_health.py) — see the MultiLayerNetwork sibling."""
+        self._health = monitor
+        return self
+
+    def getHealthMonitor(self):
+        return self._health
 
     def clone(self) -> "ComputationGraph":
         """Structural copy sharing array references (reference:

@@ -35,6 +35,7 @@ from deeplearning4j_tpu.datasets.dataset import DataSet
 from deeplearning4j_tpu.datasets.iterator import DataSetIterator
 from deeplearning4j_tpu.learning.schedules import ISchedule, ScheduleType
 from deeplearning4j_tpu.learning.updaters import IUpdater, apply_updater
+from deeplearning4j_tpu.profiler import model_health as _model_health
 from deeplearning4j_tpu.profiler import telemetry as _telemetry
 
 
@@ -102,6 +103,9 @@ class MultiLayerNetwork:
         self._compute_dtypes: List[Any] = []
         self._loss_scale_state = None
         self._ls_seen = (0, 0)
+        # in-step model-health monitor (profiler/model_health.py);
+        # None keeps every step builder on its legacy code path
+        self._health = None
 
     # ------------------------------------------------------------------
     # initialization (reference: MultiLayerNetwork#init + ParamInitializer)
@@ -229,14 +233,20 @@ class MultiLayerNetwork:
             a = _precision.cast_leaf(a, self._out_dtype)
         return a, new_states
 
-    def _loss(self, params_list, states_list, x, y, mask, rng, fmask=None):
-        """Forward to the loss head; fused stable loss on pre-activations."""
-        loss, (new_states, data_loss, _) = self._loss_carries(
-            params_list, states_list, None, x, y, mask, rng, fmask)
+    def _loss(self, params_list, states_list, x, y, mask, rng, fmask=None,
+              collect_acts=False):
+        """Forward to the loss head; fused stable loss on pre-activations.
+        ``collect_acts=True`` (the HealthMonitor step path) extends the
+        aux with per-layer non-finite activation flags."""
+        loss, (new_states, data_loss, _, act_bad) = self._loss_carries(
+            params_list, states_list, None, x, y, mask, rng, fmask,
+            collect_acts=collect_acts)
+        if collect_acts:
+            return loss, (new_states, data_loss, act_bad)
         return loss, (new_states, data_loss)
 
     def _loss_carries(self, params_list, states_list, carries, x, y, mask,
-                      rng, fmask=None):
+                      rng, fmask=None, collect_acts=False):
         """Loss forward threading recurrent hidden state (tBPTT path:
         reference MultiLayerNetwork#doTruncatedBPTT keeps each layer's
         rnnTimeStep state across segments; gradient truncation falls out
@@ -252,6 +262,8 @@ class MultiLayerNetwork:
             a = a * fmask[..., None].astype(a.dtype)
         new_states = []
         new_carries = []
+        # per-layer non-finite forward flags (model-health provenance)
+        act_bad = [] if collect_acts else None
         keys = (jax.random.split(rng, len(conf.layers))
                 if rng is not None else [None] * len(conf.layers))
         for i, layer in enumerate(conf.layers[:-1]):
@@ -268,6 +280,8 @@ class MultiLayerNetwork:
                                            True, k_i)
                 new_states.append(ns)
                 new_carries.append(None)
+                if collect_acts:
+                    act_bad.append(_model_health.act_flag(a))
                 continue
             # weight noise (reference: IWeightNoise applied per training
             # forward; DropConnect/WeightNoise in conf/weightnoise)
@@ -283,6 +297,8 @@ class MultiLayerNetwork:
                 c = None
             new_states.append(ns)
             new_carries.append(c)
+            if collect_acts:
+                act_bad.append(_model_health.act_flag(a))
         new_carries.append(None)  # loss head is never recurrent
         last = conf.layers[-1]
         if not hasattr(last, "loss_value"):
@@ -302,6 +318,10 @@ class MultiLayerNetwork:
             p_last = last.weight_noise.apply(p_last, keys[-1])
         data_loss = last.loss_value(p_last, states_list[-1], a, y, mask)
         new_states.append(states_list[-1])
+        if collect_acts:
+            # the loss head's provenance bit is its loss value: a clean
+            # prefix + non-finite loss localizes the blow-up to the head
+            act_bad.append(_model_health.act_flag(data_loss))
 
         # l1/l2 regularization (reference: BaseLayer#calcRegularizationScore)
         reg = jnp.asarray(0.0, data_loss.dtype)
@@ -316,7 +336,8 @@ class MultiLayerNetwork:
                         reg = reg + l1 * jnp.sum(jnp.abs(v))
                     if l2:
                         reg = reg + 0.5 * l2 * jnp.sum(v * v)
-        return data_loss + reg, (new_states, data_loss, new_carries)
+        return data_loss + reg, (new_states, data_loss, new_carries,
+                                 act_bad)
 
     def _clip_grads(self, grads_list):
         mode = self.conf.gradient_normalization
@@ -363,10 +384,14 @@ class MultiLayerNetwork:
         return new_params, new_opt
 
     def _get_train_step(self, has_mask: bool, has_fmask: bool = False) -> Callable:
-        key = (has_mask, has_fmask)
+        # the health flag is STATIC: toggling a HealthMonitor on/off
+        # costs exactly one extra compile per site, nothing per step
+        health = self._health is not None
+        key = (has_mask, has_fmask, health)
         if key in self._step_cache:
             return self._step_cache[key]
         policy = self._policy
+        n_layers = len(self.conf.layers)
 
         if policy.loss_scaling:
             # mixed_float16: scaled loss, fp32 unscale, overflow ->
@@ -374,10 +399,12 @@ class MultiLayerNetwork:
             def step_fn(params_list, states_list, opt_states, ls_state,
                         it_step, ep_step, x, y, mask, fmask, rng):
                 loss_fn = lambda pl: self._loss(pl, states_list, x, y,
-                                                mask, rng, fmask)
-                ((loss, (new_states, data_loss)), grads,
+                                                mask, rng, fmask,
+                                                collect_acts=health)
+                ((loss, aux), grads,
                  finite) = _precision.scaled_value_and_grad(
                     loss_fn, ls_state, params_list)
+                raw_grads = grads
                 grads = self._clip_grads(grads)
                 new_params, new_opt = self._apply_updates(
                     params_list, opt_states, grads, it_step, ep_step)
@@ -385,8 +412,18 @@ class MultiLayerNetwork:
                  new_ls) = _precision.guard_scaled_step(
                     policy, ls_state, finite,
                     [(new_params, params_list), (new_opt, opt_states),
-                     (new_states, states_list)])
-                return new_params, new_states, new_opt, new_ls, data_loss
+                     (aux[0], states_list)])
+                if health:
+                    # post-guard params: a handled overflow reads
+                    # update_ratio 0, and the handled flag tells the
+                    # host not to report it as model sickness
+                    h = _model_health.device_stats(
+                        range(n_layers), raw_grads, new_params,
+                        params_list, aux[2],
+                        handled=jnp.logical_not(finite))
+                    return (new_params, new_states, new_opt, new_ls,
+                            aux[1], h)
+                return new_params, new_states, new_opt, new_ls, aux[1]
 
             jitted = _telemetry.instrument_jit(
                 "mln_step", jax.jit(step_fn, donate_argnums=(0, 1, 2, 3)))
@@ -396,13 +433,19 @@ class MultiLayerNetwork:
         def step_fn(params_list, states_list, opt_states, it_step, ep_step,
                     x, y, mask, fmask, rng):
             loss_fn = lambda pl: self._loss(pl, states_list, x, y, mask, rng,
-                                            fmask)
-            (loss, (new_states, data_loss)), grads = \
+                                            fmask, collect_acts=health)
+            (loss, aux), grads = \
                 jax.value_and_grad(loss_fn, has_aux=True)(params_list)
+            raw_grads = grads
             grads = self._clip_grads(grads)
             new_params, new_opt = self._apply_updates(
                 params_list, opt_states, grads, it_step, ep_step)
-            return new_params, new_states, new_opt, data_loss
+            if health:
+                h = _model_health.device_stats(
+                    range(n_layers), raw_grads, new_params, params_list,
+                    aux[2])
+                return new_params, aux[0], new_opt, aux[1], h
+            return new_params, aux[0], new_opt, aux[1]
 
         jitted = _telemetry.instrument_jit(
             "mln_step", jax.jit(step_fn, donate_argnums=(0, 1, 2)))
@@ -415,19 +458,23 @@ class MultiLayerNetwork:
         MultiLayerNetwork#doTruncatedBPTT). Gradients stop at segment
         boundaries because carries enter the jitted step as plain inputs
         (tbptt_back_length == tbptt_fwd_length by construction here)."""
-        key = ("tbptt", has_mask)
+        health = self._health is not None
+        key = ("tbptt", has_mask, health)
         if key in self._step_cache:
             return self._step_cache[key]
         policy = self._policy
+        n_layers = len(self.conf.layers)
 
         if policy.loss_scaling:
             def step_fn(params_list, states_list, opt_states, ls_state,
                         carries, it_step, ep_step, x, y, mask, rng):
                 loss_fn = lambda pl: self._loss_carries(
-                    pl, states_list, carries, x, y, mask, rng)
-                ((loss, (new_states, data_loss, new_carries)), grads,
-                 finite) = _precision.scaled_value_and_grad(
+                    pl, states_list, carries, x, y, mask, rng,
+                    collect_acts=health)
+                ((loss, (new_states, data_loss, new_carries, act_bad)),
+                 grads, finite) = _precision.scaled_value_and_grad(
                     loss_fn, ls_state, params_list)
+                raw_grads = grads
                 grads = self._clip_grads(grads)
                 new_params, new_opt = self._apply_updates(
                     params_list, opt_states, grads, it_step, ep_step)
@@ -440,6 +487,13 @@ class MultiLayerNetwork:
                     policy, ls_state, finite,
                     [(new_params, params_list), (new_opt, opt_states),
                      (new_states, states_list)])
+                if health:
+                    h = _model_health.device_stats(
+                        range(n_layers), raw_grads, new_params,
+                        params_list, act_bad,
+                        handled=jnp.logical_not(finite))
+                    return (new_params, new_states, new_opt, new_ls,
+                            new_carries, data_loss, h)
                 return (new_params, new_states, new_opt, new_ls,
                         new_carries, data_loss)
 
@@ -452,12 +506,20 @@ class MultiLayerNetwork:
         def step_fn(params_list, states_list, opt_states, carries, it_step,
                     ep_step, x, y, mask, rng):
             loss_fn = lambda pl: self._loss_carries(
-                pl, states_list, carries, x, y, mask, rng)
-            (loss, (new_states, data_loss, new_carries)), grads = \
+                pl, states_list, carries, x, y, mask, rng,
+                collect_acts=health)
+            (loss, (new_states, data_loss, new_carries, act_bad)), grads = \
                 jax.value_and_grad(loss_fn, has_aux=True)(params_list)
+            raw_grads = grads
             grads = self._clip_grads(grads)
             new_params, new_opt = self._apply_updates(
                 params_list, opt_states, grads, it_step, ep_step)
+            if health:
+                h = _model_health.device_stats(
+                    range(n_layers), raw_grads, new_params, params_list,
+                    act_bad)
+                return (new_params, new_states, new_opt, new_carries,
+                        data_loss, h)
             return new_params, new_states, new_opt, new_carries, data_loss
 
         jitted = _telemetry.instrument_jit(
@@ -562,20 +624,25 @@ class MultiLayerNetwork:
                     "yet — use standard BPTT")
             return self._fit_tbptt(x, y, m, k)
         self._rng_key, sub = jax.random.split(self._rng_key)
+        hm = self._health
         step_fn = self._get_train_step(m is not None, fm is not None)
         t_step = time.perf_counter()
         if self._loss_scale_state is not None:
-            (self.params_list, self.states_list, self.opt_states,
-             self._loss_scale_state, loss) = step_fn(
+            res = step_fn(
                 self.params_list, self.states_list, self.opt_states,
                 self._loss_scale_state, jnp.asarray(self._iteration),
                 jnp.asarray(self._epoch), x, y, m, fm, sub)
-        else:
+            res, health = _model_health.split_health(res, hm is not None)
             (self.params_list, self.states_list, self.opt_states,
-             loss) = step_fn(
+             self._loss_scale_state, loss) = res
+        else:
+            res = step_fn(
                 self.params_list, self.states_list, self.opt_states,
                 jnp.asarray(self._iteration), jnp.asarray(self._epoch),
                 x, y, m, fm, sub)
+            res, health = _model_health.split_health(res, hm is not None)
+            (self.params_list, self.states_list, self.opt_states,
+             loss) = res
         # dispatch-side timing: the step is async, so this span is host
         # dispatch (+ compile on a cache miss), not device wall time —
         # deliberately so; blocking here would stall the pipeline
@@ -592,6 +659,10 @@ class MultiLayerNetwork:
         # arrays already on device)
         self._last_fit_batch = (x, y, m, fm, sub)
         _telemetry.sample_device_memory()
+        if hm is not None:
+            # records the device-side tree; syncs (one small transfer)
+            # only on every `frequency`-th step
+            hm.on_step(self, health, site="mln", jit_site="mln_step")
         if self._loss_scale_state is not None:
             # mirror loss-scale/overflow counters into telemetry (one
             # device->host sync per step — mixed_float16 only; disable
@@ -644,6 +715,7 @@ class MultiLayerNetwork:
                 "Truncated BPTT is not supported with Bidirectional layers "
                 "(the backward direction needs the full sequence) — use "
                 "standard BPTT") from None
+        hm = self._health
         step_fn = self._get_tbptt_step(mask is not None)
         for t0 in range(0, t, k):
             xc = x[:, t0:t0 + k]
@@ -652,22 +724,31 @@ class MultiLayerNetwork:
             self._rng_key, sub = jax.random.split(self._rng_key)
             t_step = time.perf_counter()
             if self._loss_scale_state is not None:
-                (self.params_list, self.states_list, self.opt_states,
-                 self._loss_scale_state, carries, loss) = step_fn(
+                res = step_fn(
                     self.params_list, self.states_list, self.opt_states,
                     self._loss_scale_state, carries,
                     jnp.asarray(self._iteration), jnp.asarray(self._epoch),
                     xc, yc, mc, sub)
-            else:
+                res, health = _model_health.split_health(
+                    res, hm is not None)
                 (self.params_list, self.states_list, self.opt_states,
-                 carries, loss) = step_fn(
+                 self._loss_scale_state, carries, loss) = res
+            else:
+                res = step_fn(
                     self.params_list, self.states_list, self.opt_states,
                     carries, jnp.asarray(self._iteration),
                     jnp.asarray(self._epoch), xc, yc, mc, sub)
+                res, health = _model_health.split_health(
+                    res, hm is not None)
+                (self.params_list, self.states_list, self.opt_states,
+                 carries, loss) = res
             _telemetry.record_phase("device_step", t_step)
             self._score = loss
             self._iteration += 1
             self._last_batch_size = int(xc.shape[0])
+            if hm is not None:
+                hm.on_step(self, health, site="mln",
+                           jit_site="mln_tbptt_step")
             if self._loss_scale_state is not None:
                 self._ls_seen = _precision.record_loss_scale(
                     "mln", self._loss_scale_state, self._ls_seen)
@@ -1071,6 +1152,18 @@ class MultiLayerNetwork:
     def addListeners(self, *listeners):
         self._listeners.extend(listeners)
         return self
+
+    def setHealthMonitor(self, monitor) -> "MultiLayerNetwork":
+        """Attach (or with None, detach) an in-step HealthMonitor
+        (profiler/model_health.py). Toggling costs exactly one extra
+        compile per jit site; attached, every train step also emits
+        per-layer grad/update/param stats + NaN provenance, fetched
+        once every ``monitor.frequency`` steps."""
+        self._health = monitor
+        return self
+
+    def getHealthMonitor(self):
+        return self._health
 
     def getListeners(self):
         return list(self._listeners)

@@ -45,6 +45,7 @@ from deeplearning4j_tpu.nn import precision as _precision
 from deeplearning4j_tpu.nn.multilayer.network import _uses_epoch_schedule
 from deeplearning4j_tpu.ops import compression as comp
 from deeplearning4j_tpu.parallel.mesh import build_mesh
+from deeplearning4j_tpu.profiler import model_health as _model_health
 from deeplearning4j_tpu.profiler import telemetry as _telemetry
 
 
@@ -86,7 +87,8 @@ class _ModelFuncs:
         # re-init) must see the current one
         return self.model._updaters  # list (MLN) or dict (CG)
 
-    def loss(self, params, states, x, y, rng, mask=None, fmask=None):
+    def loss(self, params, states, x, y, rng, mask=None, fmask=None,
+             collect_acts=False):
         if self.is_graph:
             xs = x if isinstance(x, (list, tuple)) else [x]
             ys = y if isinstance(y, (list, tuple)) else [y]
@@ -97,8 +99,10 @@ class _ModelFuncs:
                     f"and {len(ys)} label arrays")
             return self.model._loss(params, states,
                                     dict(zip(self._ins, xs)),
-                                    dict(zip(self._outs, ys)), rng)
-        return self.model._loss(params, states, x, y, mask, rng, fmask)
+                                    dict(zip(self._outs, ys)), rng,
+                                    collect_acts=collect_acts)
+        return self.model._loss(params, states, x, y, mask, rng, fmask,
+                                collect_acts=collect_acts)
 
     def keys(self, params):
         return list(params) if isinstance(params, dict) \
@@ -165,6 +169,8 @@ class ShardedTrainer:
         self.target_density = target_density
         self.averaging_frequency = averaging_frequency
         self._step = None
+        self._step_health = False   # health flag the live step was built with
+        self._sharing_steps = {}    # health flag -> built sharing step
         self._residual = None
         self._thresholds = None
         self._local = None  # per-shard replicas for averaging mode
@@ -226,6 +232,11 @@ class ShardedTrainer:
     def _build_sharing_step(self):
         mf = self.mf
         policy = getattr(self.model, "_policy", None)
+        # static health flag; GSPMD's compiler-inserted psum makes the
+        # in-step grad norms MESH-GLOBAL for free (grads of replicated
+        # params are already all-reduced when the norms read them)
+        health = getattr(self.model, "_health", None) is not None
+        keys = _model_health.layer_keys(self.model) if health else None
 
         if policy is not None and policy.loss_scaling:
             # mixed_float16 under GSPMD: the loss-scale state is
@@ -235,10 +246,12 @@ class ShardedTrainer:
             def step_fn(params, states, opt, ls_state, it_step, ep_step,
                         x, y, mask, fmask, rng):
                 loss_fn = lambda pl: mf.loss(pl, states, x, y, rng,
-                                             mask, fmask)
-                ((loss, (new_states, data_loss)), grads,
+                                             mask, fmask,
+                                             collect_acts=health)
+                ((loss, aux), grads,
                  finite) = _precision.scaled_value_and_grad(
                     loss_fn, ls_state, params)
+                raw_grads = grads
                 grads = mf.clip(grads)
                 new_params, new_opt = mf.apply_updates(
                     params, grads, opt, it_step, ep_step)
@@ -246,8 +259,14 @@ class ShardedTrainer:
                  new_ls) = _precision.guard_scaled_step(
                     policy, ls_state, finite,
                     [(new_params, params), (new_opt, opt),
-                     (new_states, states)])
-                return new_params, new_states, new_opt, new_ls, data_loss
+                     (aux[0], states)])
+                if health:
+                    h = _model_health.device_stats(
+                        keys, raw_grads, new_params, params, aux[2],
+                        handled=jnp.logical_not(finite))
+                    return (new_params, new_states, new_opt, new_ls,
+                            aux[1], h)
+                return new_params, new_states, new_opt, new_ls, aux[1]
 
             return _telemetry.instrument_jit(
                 "parallel_sharing_step",
@@ -256,13 +275,18 @@ class ShardedTrainer:
         def step_fn(params, states, opt, it_step, ep_step, x, y, mask,
                     fmask, rng):
             loss_fn = lambda pl: mf.loss(pl, states, x, y, rng, mask,
-                                         fmask)
-            (loss, (new_states, data_loss)), grads = \
+                                         fmask, collect_acts=health)
+            (loss, aux), grads = \
                 jax.value_and_grad(loss_fn, has_aux=True)(params)
+            raw_grads = grads
             grads = mf.clip(grads)
             new_params, new_opt = mf.apply_updates(params, grads, opt,
                                                    it_step, ep_step)
-            return new_params, new_states, new_opt, data_loss
+            if health:
+                h = _model_health.device_stats(
+                    keys, raw_grads, new_params, params, aux[2])
+                return new_params, aux[0], new_opt, aux[1], h
+            return new_params, aux[0], new_opt, aux[1]
 
         return _telemetry.instrument_jit(
             "parallel_sharing_step",
@@ -536,10 +560,38 @@ class ShardedTrainer:
             if mask is None and getattr(y, "ndim", 0) == 3 \
                     and fmask.ndim == 2 and y.shape[1] == fmask.shape[1]:
                 mask = fmask
+        hm = getattr(model, "_health", None)
+        if hm is not None and self.mode != "sharing":
+            # the shard_map modes hand-build their per-shard state
+            # pytrees; threading health outputs through them is not
+            # supported — warn instead of silently dropping stats
+            # (precedent: the mask warning above)
+            if not getattr(self, "_warned_health", False):
+                self._warned_health = True
+                import logging
+
+                logging.getLogger("deeplearning4j_tpu").warning(
+                    "ShardedTrainer(mode=%r) does not support the "
+                    "HealthMonitor — in-step model health is available "
+                    "in mode='sharing' only", self.mode)
+            hm = None
+        if self._step is not None and self.mode == "sharing" \
+                and self._step_health != (hm is not None):
+            # monitor toggled on a live trainer: swap only the step
+            # ('sharing' keeps all state in the model trees). Both
+            # executables are cached, so each flag value compiles at
+            # most once — same contract as the single-device loops
+            self._step_health = hm is not None
+            self._step = self._sharing_steps.get(self._step_health)
+            if self._step is None:
+                self._step = self._build_sharing_step()
+                self._sharing_steps[self._step_health] = self._step
         if self._step is None:
             self._place_replicated()
             if self.mode == "sharing":
                 self._step = self._build_sharing_step()
+                self._step_health = hm is not None
+                self._sharing_steps[self._step_health] = self._step
             elif self.mode == "sharing_compressed":
                 self._step = self._build_compressed_step()
                 # per-shard residual + per-leaf thresholds + per-shard
@@ -563,19 +615,25 @@ class ShardedTrainer:
         params, states, opt = mf.get_trees()
         t_step = time.perf_counter()
 
+        health = None
         if self.mode == "sharing":
             if model._loss_scale_state is not None:
-                (params, states, opt, model._loss_scale_state,
-                 loss) = self._step(
+                res = self._step(
                     params, states, opt, model._loss_scale_state, it_s,
                     ep_s, x, y, mask, fmask, sub)
+                res, health = _model_health.split_health(
+                    res, hm is not None)
+                (params, states, opt, model._loss_scale_state, loss) = res
                 mf.set_trees(params, states, opt)
                 model._ls_seen = _precision.record_loss_scale(
                     "sharded", model._loss_scale_state, model._ls_seen)
             else:
-                (params, states, opt, loss) = self._step(
+                res = self._step(
                     params, states, opt, it_s, ep_s, x, y, mask, fmask,
                     sub)
+                res, health = _model_health.split_health(
+                    res, hm is not None)
+                (params, states, opt, loss) = res
                 mf.set_trees(params, states, opt)
         elif self.mode == "sharing_compressed":
             opt_s = self._local
@@ -607,6 +665,9 @@ class ShardedTrainer:
         first = x[0] if isinstance(x, (list, tuple)) else x
         model._last_batch_size = int(first.shape[0])
         _telemetry.sample_device_memory()
+        if hm is not None and health is not None:
+            hm.on_step(model, health, site="sharded",
+                       jit_site="parallel_sharing_step")
         if model._listeners:
             t_l = time.perf_counter()
             for l in model._listeners:

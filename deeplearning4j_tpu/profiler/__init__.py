@@ -33,6 +33,7 @@ import numpy as np
 
 from deeplearning4j_tpu.ops import registry as _registry
 from deeplearning4j_tpu.profiler import telemetry
+from deeplearning4j_tpu.profiler.model_health import HealthMonitor
 
 
 class ProfilerMode(enum.Enum):
@@ -200,4 +201,4 @@ def trace(log_dir: str):
 
 __all__ = ["OpProfiler", "ProfilerConfig", "ProfilerMode",
            "NumericsException", "check_numerics", "start_trace",
-           "stop_trace", "trace", "telemetry"]
+           "stop_trace", "trace", "telemetry", "HealthMonitor"]

@@ -1,0 +1,55 @@
+"""Peak-FLOPs table + dtype-aware lookup — the MFU denominator.
+
+Hoisted out of bench_common so the LIVE fit loops (profiler/
+model_health.py) and the bench scripts share one table: an MFU number
+is only comparable if both sides divide by the same peak.
+bench_common re-exports ``PEAK_FLOPS``/``peak_flops`` from here, so
+existing imports keep working.
+
+A single bf16 number would silently inflate (f32 workload / bf16 peak)
+or deflate MFU; the dtype key makes the denominator match the
+numerator's math. f32 on the v5e MXU runs at ~half bf16 rate
+(multi-pass emulation).
+"""
+
+from __future__ import annotations
+
+import logging
+
+log = logging.getLogger("deeplearning4j_tpu")
+
+#: per-chip peak FLOPs keyed by device kind AND compute dtype.
+PEAK_FLOPS = {
+    "TPU v5 lite": {"bf16": 197e12, "f32": 98.5e12},
+}
+
+_warned_unknown_peak = set()
+
+
+def peak_flops(dtype="bf16"):
+    """Peak FLOPs of device 0 for a compute dtype ("bf16"/"f32", any
+    DataType.from_any spelling). Unknown devices return None with a
+    logged warning — callers then skip MFU (the measured
+    cost_analysis FLOPs still get reported), rather than dividing by a
+    wrong peak and publishing a silently bogus MFU."""
+    import jax
+
+    from deeplearning4j_tpu.ndarray.dtypes import DataType
+
+    kind = jax.devices()[0].device_kind
+    entry = PEAK_FLOPS.get(kind)
+    if entry is None:
+        if kind not in _warned_unknown_peak:
+            _warned_unknown_peak.add(kind)
+            log.warning(
+                "no peak-FLOPs entry for device kind %r — MFU will be "
+                "omitted (cost_analysis FLOPs are still measured); add "
+                "the chip to profiler.flops.PEAK_FLOPS to enable it",
+                kind)
+        return None
+    dt = DataType.from_any(dtype)
+    key = "bf16" if dt.width_bytes() == 2 else "f32"
+    return entry.get(key)
+
+
+__all__ = ["PEAK_FLOPS", "peak_flops"]

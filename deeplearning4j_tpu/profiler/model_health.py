@@ -1,0 +1,484 @@
+"""In-step model-health monitoring: jit-threaded per-layer gradient /
+update statistics, NaN provenance, and live MFU attribution.
+
+PR 1's telemetry spine answers "is the machine healthy" (compiles,
+memory, step phases); this module answers "is the *model* healthy" —
+from inside the one compiled train step, with no second backward pass:
+
+- **Per-layer scalars, computed on device**: gradient L2 norms (raw
+  master-precision grads, pre-clipping — the diagnostic signal),
+  post-update parameter norms, and update-to-param ratios (the
+  learning-rate sanity check: healthy nets sit around 1e-4..1e-2).
+  All of it reduces to one small pytree (a handful of length-L arrays)
+  returned as an extra step output; the fit loop fetches it with a
+  single ``device_get`` every ``frequency`` steps.
+- **Non-finite provenance**: a per-layer bitmask of "this layer's
+  training-forward activation or gradient went NaN/Inf", plus the
+  FIRST offending layer index. Activation flags take priority — NaN
+  propagates downstream, so the first non-finite activation localizes
+  the source (a poisoned input reads layer 0; a blown-up layer k reads
+  k), where gradient flags alone cannot (a NaN loss makes every
+  layer's gradient NaN). Loss-scale-aware: a mixed_float16 overflow
+  the precision engine already handled (step skipped, scale halved) is
+  reported as CLEAN (-1) — that's the engine working, not the model
+  sickening; the raw index stays available as
+  ``last["handled_overflow_layer"]`` for scale debugging.
+- **Live MFU**: ``instrument_jit`` captures each executable's
+  ``cost_analysis()`` FLOPs at compile time (an AOT lower+compile that
+  hits the XLA compile cache — one trace, not a second compile);
+  each health sample divides FLOPs/step by the wall clock since the
+  previous sample and by the dtype-aware peak (profiler/flops.py) into
+  the ``dl4j_tpu_mfu`` gauge. Devices without a ``PEAK_FLOPS`` entry
+  log one warning and omit the gauge — never a silently wrong MFU.
+
+Cost model (docs/OBSERVABILITY.md "Model health"):
+
+- monitoring OFF: the step builders take the exact legacy code path —
+  bit-identical executables, regression-gated in run_tests.sh;
+- monitoring ON: one extra compile per jit site (the step signature
+  gains one static flag), a few fused reductions inside the step
+  (O(params) reads the step already does), and ONE small device->host
+  transfer per sampled step. No extra backward pass, ever.
+
+Wiring: ``net.setHealthMonitor(HealthMonitor(frequency=10))`` on
+MultiLayerNetwork or ComputationGraph; ShardedTrainer (mode='sharing')
+picks the model's monitor up automatically — GSPMD's compiler-inserted
+psum makes the in-step norms mesh-global for free. The shard_map modes
+(sharing_compressed / averaging) warn once and skip monitoring, like
+they do for mask arrays.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+import weakref
+from typing import Any, Dict, List, Optional, Sequence
+
+log = logging.getLogger("deeplearning4j_tpu")
+
+#: latest captured cost_analysis FLOPs per jit site (one entry per
+#: site; the per-EXECUTABLE values live in each _InstrumentedJit's
+#: signature map and feed the dispatched counter below)
+_site_flops: Dict[str, float] = {}
+#: cumulative FLOPs DISPATCHED per site — instrument_jit adds the
+#: running executable's own FLOPs on every call, so the MFU numerator
+#: is exact even when several executables coexist at one site (shape
+#: buckets, ragged final batches, mask variants): each monitor sample
+#: reads the delta over its window instead of latest-compile-wins
+_site_dispatched: Dict[str, float] = {}
+_capture_enabled = False
+#: live HealthMonitor objects — FLOPs capture/attribution is gated on
+#: this being non-empty, so the per-call cost ends when the last
+#: monitor is garbage-collected (not merely detached: a detached but
+#: referenced monitor can be re-attached and expects MFU to resume)
+_live_monitors = weakref.WeakSet()
+
+#: the only jit sites whose FLOPs ever feed an MFU sample — capture is
+#: limited to these so the forward/eval/pretrain sites never pay the
+#: extra compile-time trace
+_MFU_SITES = frozenset({
+    "mln_step", "mln_tbptt_step", "cg_step", "parallel_sharing_step",
+})
+
+
+# ======================================================================
+# device-side reducers (called at TRACE time, inside the jitted step)
+# ======================================================================
+def _l2sq(tree):
+    """Sum of squares over every floating leaf, accumulated in fp32.
+    Doubles as the non-finite probe: any NaN/Inf leaf makes the sum
+    non-finite."""
+    import jax
+    import jax.numpy as jnp
+
+    s = jnp.asarray(0.0, jnp.float32)
+    for l in jax.tree_util.tree_leaves(tree):
+        if hasattr(l, "dtype") and jnp.issubdtype(l.dtype, jnp.floating):
+            s = s + jnp.sum(jnp.square(l.astype(jnp.float32)))
+    return s
+
+
+def _l2sq_diff(new_tree, old_tree):
+    import jax
+    import jax.numpy as jnp
+
+    s = jnp.asarray(0.0, jnp.float32)
+    new_leaves = jax.tree_util.tree_leaves(new_tree)
+    old_leaves = jax.tree_util.tree_leaves(old_tree)
+    for n, o in zip(new_leaves, old_leaves):
+        if hasattr(n, "dtype") and jnp.issubdtype(n.dtype, jnp.floating):
+            d = n.astype(jnp.float32) - o.astype(jnp.float32)
+            s = s + jnp.sum(jnp.square(d))
+    return s
+
+
+def act_flag(a):
+    """Per-layer forward provenance bit: True when this activation (or
+    loss value) contains any NaN/Inf. Called by the loss forwards when
+    ``collect_acts=True``."""
+    import jax.numpy as jnp
+
+    if not (hasattr(a, "dtype") and jnp.issubdtype(a.dtype, jnp.floating)):
+        return jnp.asarray(False)
+    return jnp.logical_not(jnp.all(jnp.isfinite(a)))
+
+
+def device_stats(keys: Sequence, grads, new_params, old_params,
+                 act_bad: Optional[List], handled=None) -> Dict[str, Any]:
+    """Reduce one step's health into a small pytree, on device.
+
+    ``keys`` fixes the layer order (list indices for MultiLayerNetwork,
+    conf.nodes order for ComputationGraph); ``grads`` are the RAW
+    (pre-clip, master-precision) gradients; ``new_params``/
+    ``old_params`` the post-/pre-update param containers (post-guard
+    under loss scaling, so a skipped step reads update_ratio 0);
+    ``act_bad`` the per-layer forward flags (or None); ``handled`` a
+    scalar bool marking a loss-scale-handled overflow."""
+    import jax.numpy as jnp
+
+    gn, pn, ur, bad = [], [], [], []
+    for i, k in enumerate(keys):
+        gsq = _l2sq(grads[k])
+        psq = _l2sq(new_params[k])
+        usq = _l2sq_diff(new_params[k], old_params[k])
+        gn.append(jnp.sqrt(gsq))
+        pn.append(jnp.sqrt(psq))
+        ur.append(jnp.sqrt(usq) / (jnp.sqrt(psq) + 1e-12))
+        g_bad = jnp.logical_not(jnp.isfinite(gsq))
+        a_bad = act_bad[i] if act_bad is not None else jnp.asarray(False)
+        bad.append(jnp.logical_or(a_bad, g_bad))
+    act_vec = (jnp.stack(act_bad) if act_bad
+               else jnp.zeros((len(gn),), bool))
+    bad_vec = jnp.stack(bad)
+    # first offender: activation flags take priority (they localize the
+    # source — see module docstring), gradient flags break the tie when
+    # the forward was clean (e.g. an inf*0 born in the backward)
+    first = jnp.where(
+        jnp.any(act_vec), jnp.argmax(act_vec),
+        jnp.where(jnp.any(bad_vec), jnp.argmax(bad_vec), -1)
+    ).astype(jnp.int32)
+    if handled is None:
+        handled = jnp.asarray(False)
+    return {
+        "grad_norm": jnp.stack(gn),
+        "param_norm": jnp.stack(pn),
+        "update_ratio": jnp.stack(ur),
+        "nonfinite": bad_vec,
+        "first_nonfinite": first,
+        "handled": handled,
+    }
+
+
+# ======================================================================
+# model seam helpers
+# ======================================================================
+def split_health(res, monitored: bool):
+    """Split a train step's outputs into ``(core, health_tree)`` —
+    the monitored step appends the health pytree as its LAST output;
+    unmonitored steps pass through with health None. The one place the
+    fit loops' unpack convention lives."""
+    if monitored:
+        return res[:-1], res[-1]
+    return res, None
+
+
+def layer_keys(model) -> List:
+    """Container keys in canonical layer order: list indices for
+    MultiLayerNetwork, conf.nodes names for ComputationGraph."""
+    if hasattr(model, "params_map"):
+        return [n.name for n in model.conf.nodes]
+    return list(range(len(model.conf.layers)))
+
+
+def layer_names(model) -> List[str]:
+    """Human-readable layer labels, aligned with ``layer_keys``."""
+    if hasattr(model, "params_map"):
+        return [n.name for n in model.conf.nodes]
+    return [f"{i}:{type(l).__name__}"
+            for i, l in enumerate(model.conf.layers)]
+
+
+# ======================================================================
+# compile-time FLOPs capture (fed by telemetry.instrument_jit)
+# ======================================================================
+def enable_flops_capture() -> None:
+    """Turn on per-compile cost_analysis capture in instrument_jit.
+    Flipped (never cleared) by the first HealthMonitor constructed —
+    compiles are rare, but the capture still costs a trace each, so it
+    stays off until someone wants MFU."""
+    global _capture_enabled
+    _capture_enabled = True
+
+
+def flops_capture_enabled() -> bool:
+    return _capture_enabled
+
+
+def wants_flops(site: str) -> bool:
+    """Should instrument_jit capture/attribute cost_analysis FLOPs for
+    ``site``? Only while at least one HealthMonitor object is alive,
+    and only for the train-step sites an MFU sample can ever read — a
+    compile at any other site (forwards, pretrain, eval) never pays
+    the capture trace, and once the last monitor is collected the
+    per-call attribution cost disappears too."""
+    return _capture_enabled and site in _MFU_SITES \
+        and bool(_live_monitors)
+
+
+def capture_flops(site: str, fn, args, kwargs) -> Optional[float]:
+    """Record the freshly compiled executable's cost_analysis FLOPs for
+    ``site``. Called by instrument_jit right after it detects a
+    compile; the ``lower().compile()`` here hits the XLA compile cache
+    the real call just populated (~ms), so the extra cost is one
+    abstract trace. Any failure (deleted donated buffers, backends
+    without cost analysis) leaves the previous capture in place."""
+    from deeplearning4j_tpu.profiler import telemetry
+
+    try:
+        compiled = fn.lower(*args, **kwargs).compile()
+        ca = compiled.cost_analysis()
+        ca = ca[0] if isinstance(ca, list) else ca
+        flops = float(ca.get("flops", 0.0)) or None
+    except Exception:
+        return None
+    if flops is None:
+        return None
+    _site_flops[site] = flops
+    telemetry.MetricsRegistry.get_default().gauge(
+        telemetry.STEP_FLOPS,
+        "XLA cost_analysis FLOPs of the live compiled step executable"
+    ).set(flops, site=site)
+    return flops
+
+
+def add_dispatched_flops(site: str, flops: float) -> None:
+    """Called by instrument_jit on EVERY call at an MFU site: add the
+    executable-that-just-ran's FLOPs to the site's cumulative
+    dispatched counter (the exact MFU numerator)."""
+    _site_dispatched[site] = _site_dispatched.get(site, 0.0) + flops
+
+
+def dispatched_flops(site: str) -> float:
+    return _site_dispatched.get(site, 0.0)
+
+
+def site_flops(site: str) -> Optional[float]:
+    return _site_flops.get(site)
+
+
+# ======================================================================
+# the monitor
+# ======================================================================
+class HealthMonitor:
+    """In-step model-health policy. Attach with
+    ``net.setHealthMonitor(HealthMonitor(frequency=N))``; detach with
+    ``setHealthMonitor(None)``. Toggling costs exactly one extra
+    compile per jit site (the step cache is keyed on the flag).
+
+    Every step the jitted step returns the device-side health pytree;
+    every ``frequency`` steps the monitor fetches it (ONE device_get),
+    publishes the per-layer gauges + ``dl4j_tpu_mfu`` into the
+    MetricsRegistry, and refreshes ``last`` — the host-side dict
+    StatsListener and the divergence guard read."""
+
+    def __init__(self, frequency: int = 10, mfu: bool = True):
+        self.frequency = max(int(frequency), 1)
+        self.mfu = bool(mfu)
+        #: latest host-side sample (None until the first fetch)
+        self.last: Optional[Dict[str, Any]] = None
+        self._device = None          # latest device-side health pytree
+        self._names: Optional[List[str]] = None
+        self._model_ref = None       # identity key for the label set
+        self._site = "?"
+        self._jit_site: Optional[str] = None
+        self._compute_dtype = "float32"
+        self._iteration = 0
+        self._steps = 0              # steps observed by this monitor
+        self._fetches = 0
+        self._last_t: Optional[float] = None
+        self._last_steps = 0
+        self._last_disp = 0.0        # dispatched-FLOPs anchor ...
+        self._last_disp_site = None  # ... and the site it was read at
+        _live_monitors.add(self)
+        enable_flops_capture()
+
+    # ------------------------------------------------------------ wiring
+    def on_step(self, model, health_tree, site: str,
+                jit_site: Optional[str] = None) -> None:
+        """Fit-loop hook: record this step's device-side health output;
+        fetch + publish every ``frequency`` steps. Never syncs except
+        on the sampled step."""
+        self._device = health_tree
+        self._site = site
+        self._jit_site = jit_site
+        if self._model_ref is None or self._model_ref() is not model:
+            # (re-)attached: refresh the label set — a monitor moved to
+            # a different model must not index with the old layer list
+            self._model_ref = weakref.ref(model)
+            self._names = layer_names(model)
+        policy = getattr(model, "_policy", None)
+        if policy is not None:
+            self._compute_dtype = policy.compute_dtype
+        self._iteration = int(getattr(model, "_iteration", 0))
+        self._steps += 1
+        if self._steps % self.frequency == 0:
+            self.sample()
+
+    # ---------------------------------------------------------- sampling
+    def sample(self) -> Optional[Dict[str, Any]]:
+        """Fetch the latest device-side health pytree (one device_get —
+        this is the monitor's entire per-sample transfer), publish
+        gauges, refresh ``last``. Returns the sample dict."""
+        if self._device is None:
+            return None
+        import jax
+
+        from deeplearning4j_tpu.profiler import telemetry
+
+        host = jax.device_get(self._device)
+        self._fetches += 1
+        now = time.perf_counter()
+        names = self._names or []
+        handled = bool(host["handled"])
+        first = int(host["first_nonfinite"])
+        report = -1 if handled else first
+        sample: Dict[str, Any] = {
+            "iteration": self._iteration,
+            "grad_norms": {}, "param_norms": {}, "update_ratios": {},
+            "nonfinite_layers": [],
+            "nonfinite_first_layer": report,
+            "nonfinite_layer_name": (names[report]
+                                     if 0 <= report < len(names) else None),
+            "handled_overflow": handled,
+            "handled_overflow_layer": first if handled else -1,
+        }
+        reg = telemetry.MetricsRegistry.get_default() \
+            if telemetry.enabled() else None
+        if reg is not None:
+            reg.counter(
+                telemetry.HEALTH_FETCHES,
+                "model-health device->host fetches (one per sampled "
+                "step)").inc(site=self._site)
+        gn = reg.gauge(telemetry.LAYER_GRAD_NORM,
+                       "per-layer gradient L2 norm (raw master-"
+                       "precision grads, pre-clip)") if reg else None
+        pn = reg.gauge(telemetry.LAYER_PARAM_NORM,
+                       "per-layer parameter L2 norm (post-update)") \
+            if reg else None
+        ur = reg.gauge(telemetry.UPDATE_RATIO,
+                       "per-layer update-to-param L2 ratio") if reg else None
+        for i, nm in enumerate(names):
+            g = float(host["grad_norm"][i])
+            p = float(host["param_norm"][i])
+            r = float(host["update_ratio"][i])
+            sample["grad_norms"][nm] = g
+            sample["param_norms"][nm] = p
+            sample["update_ratios"][nm] = r
+            if bool(host["nonfinite"][i]) and not handled:
+                sample["nonfinite_layers"].append(nm)
+            if reg is not None:
+                gn.set(g, layer=nm, site=self._site)
+                pn.set(p, layer=nm, site=self._site)
+                ur.set(r, layer=nm, site=self._site)
+        if reg is not None:
+            reg.gauge(telemetry.NONFINITE_FIRST_LAYER,
+                      "index of the first layer whose activation/"
+                      "gradient went NaN/Inf (-1 = clean; loss-scale-"
+                      "handled overflows report clean)"
+                      ).set(report, site=self._site)
+        mfu = self._compute_mfu(now)
+        if mfu is not None:
+            sample["mfu"] = mfu
+            if reg is not None:
+                reg.gauge(telemetry.MFU,
+                          "model FLOPs utilization of the live step "
+                          "(cost_analysis FLOPs / wall clock / dtype-"
+                          "aware peak)").set(mfu, site=self._site)
+        self._last_t = now
+        self._last_steps = self._steps
+        self._last_disp_site = self._jit_site
+        self._last_disp = _site_dispatched.get(self._jit_site or "", 0.0)
+        self.last = sample
+        return sample
+
+    def _compute_mfu(self, now: float) -> Optional[float]:
+        """FLOPs dispatched at the step's jit site since the last
+        sample / elapsed wall clock / dtype-aware peak. The dispatched
+        counter sums each call's OWN executable cost, so the numerator
+        stays exact when several executables coexist at the site
+        (shape buckets, ragged final batches). Semantics are
+        SITE-level: all work dispatched at the jit site in the window
+        counts, so two models training interleaved at one site read
+        the site's combined utilization. The device_get that preceded
+        this call synced the pipeline, so the elapsed window covers
+        real device execution, not just dispatch."""
+        if not self.mfu or self._last_t is None:
+            return None
+        site = self._jit_site or ""
+        if self._last_disp_site != self._jit_site:
+            # the fit loop switched jit sites mid-window (e.g. a
+            # tbptt-length boundary): the anchor belongs to another
+            # counter, so this window has no sound numerator — skip;
+            # sample() re-anchors at the current site for the next one
+            return None
+        num = _site_dispatched.get(site, 0.0) - self._last_disp
+        if num <= 0:
+            # no per-dispatch data (capture found no cost analysis):
+            # latest-executable x steps is the best remaining estimate
+            flops = _site_flops.get(site)
+            steps = self._steps - self._last_steps
+            if not flops or steps <= 0:
+                return None
+            num = flops * steps
+        elapsed = now - self._last_t
+        if elapsed <= 0:
+            return None
+        from deeplearning4j_tpu.profiler.flops import peak_flops
+
+        peak = peak_flops(self._compute_dtype)
+        if not peak:
+            return None   # unknown device: peak_flops warned already
+        return num / (elapsed * peak)
+
+    def latest(self) -> Optional[Dict[str, Any]]:
+        """The sample for the MOST RECENT step: ``last`` when the
+        sampled step IS the latest observed step, else a fresh fetch
+        (one device_get, same cost as a scheduled sample). StatsListener
+        reads this so a monitor sampling on a coarser cadence than the
+        listener never serves stale stats as a current report."""
+        if self.last is not None \
+                and self.last["iteration"] == self._iteration:
+            return self.last
+        return self.sample()
+
+    # ------------------------------------------------------- provenance
+    def nonfinite_label(self) -> Optional[str]:
+        """Name of the first non-finite layer of the MOST RECENT step
+        (fetching just the provenance scalars, not the full tree), or
+        None when clean / handled / nothing recorded. Used by the
+        divergence guard to label its rollback telemetry."""
+        if self._device is None:
+            return None
+        import jax
+
+        first, handled = jax.device_get(
+            [self._device["first_nonfinite"], self._device["handled"]])
+        first = int(first)
+        if handled or first < 0:
+            return None
+        names = self._names or []
+        return names[first] if first < len(names) else str(first)
+
+    @property
+    def fetches(self) -> int:
+        """Device->host health transfers so far (test seam: exactly one
+        per sampled step)."""
+        return self._fetches
+
+
+__all__ = ["HealthMonitor", "device_stats", "act_flag", "split_health",
+           "layer_keys", "layer_names", "capture_flops",
+           "add_dispatched_flops", "dispatched_flops", "site_flops",
+           "enable_flops_capture", "flops_capture_enabled",
+           "wants_flops"]

@@ -78,6 +78,14 @@ TRANSFER_RETRIES = "dl4j_tpu_transfer_retries_total"
 TRANSFER_QUARANTINES = "dl4j_tpu_transfer_quarantined_batches_total"
 WATCHDOG_STALLS = "dl4j_tpu_watchdog_stalls_total"
 CHAOS_INJECTED = "dl4j_tpu_chaos_injected_total"
+#: in-step model health (profiler/model_health.py)
+LAYER_GRAD_NORM = "dl4j_tpu_layer_grad_norm"
+LAYER_PARAM_NORM = "dl4j_tpu_layer_param_norm"
+UPDATE_RATIO = "dl4j_tpu_update_ratio"
+NONFINITE_FIRST_LAYER = "dl4j_tpu_nonfinite_first_layer"
+MFU = "dl4j_tpu_mfu"
+STEP_FLOPS = "dl4j_tpu_step_flops"
+HEALTH_FETCHES = "dl4j_tpu_health_fetches_total"
 
 
 def enabled() -> bool:
@@ -269,6 +277,13 @@ class MetricsRegistry:
         return self._get(
             name, lambda: Histogram(name, help, max_samples), "summary")
 
+    def peek(self, name: str):
+        """The metric if it exists, else None — a read that never
+        creates (snapshot assembly must not pollute /metrics with
+        empty series)."""
+        with self._lock:
+            return self._metrics.get(name)
+
     def to_prometheus(self) -> str:
         """Prometheus text exposition format 0.0.4."""
         lines: List[str] = []
@@ -458,6 +473,7 @@ class _InstrumentedJit:
         self._site = site
         self._fn = fn
         self._sigs: List[str] = []
+        self._sig_flops: Dict[str, float] = {}   # per-executable FLOPs
         self._warned_at = 0
         self._has_cache_probe = (probe == "cache"
                                  and hasattr(fn, "_cache_size"))
@@ -473,6 +489,16 @@ class _InstrumentedJit:
     def __call__(self, *args, **kwargs):
         if not _ENABLED:
             return self._fn(*args, **kwargs)
+        from deeplearning4j_tpu.profiler import model_health
+
+        # FLOPs attribution (the MFU numerator) is off — one bool + a
+        # set lookup — until a HealthMonitor exists, and limited to the
+        # train-step sites MFU reads; when on, the call's signature
+        # keys the per-EXECUTABLE cost so coexisting executables (shape
+        # buckets, ragged batches) each charge their own FLOPs
+        capture = model_health.wants_flops(self._site)
+        sig = (_arg_signature(args, kwargs)
+               if (capture or not self._has_cache_probe) else None)
         before = self._fn._cache_size() if self._has_cache_probe else -1
         t0 = time.perf_counter()
         out = self._fn(*args, **kwargs)
@@ -481,10 +507,27 @@ class _InstrumentedJit:
             compiled = self._fn._cache_size() > before
         else:
             # fallback probe: first call with an unseen signature
-            sig = _arg_signature(args, kwargs)
             compiled = sig not in self._sigs
         if compiled:
-            self._record_compile(t0, t1, _arg_signature(args, kwargs))
+            if sig is None:
+                sig = _arg_signature(args, kwargs)
+            self._record_compile(t0, t1, sig)
+            if capture:
+                # the lower().compile() inside hits the XLA cache the
+                # call above just populated: one abstract trace, not a
+                # second compile
+                f = model_health.capture_flops(
+                    self._site, self._fn, args, kwargs)
+                if f:
+                    self._sig_flops[sig] = f
+        if capture:
+            # executables compiled before capture was enabled have no
+            # per-sig entry; the site's latest capture is the best
+            # remaining estimate (None only before any capture)
+            f = self._sig_flops.get(sig) \
+                or model_health.site_flops(self._site)
+            if f:
+                model_health.add_dispatched_flops(self._site, f)
         return out
 
     def _record_compile(self, t0: float, t1: float, sig: str) -> None:
@@ -542,15 +585,20 @@ def instrument_jit(site: str, fn: Callable,
 _mem_supported: Optional[bool] = None
 
 
-def sample_device_memory(device=None) -> Dict[str, Any]:
+def sample_device_memory(device=None, force=False) -> Dict[str, Any]:
     """Read ``device.memory_stats()`` into watermark gauges. Returns
     the raw sample, or {} when the backend doesn't report (CPU) — the
     not-supported verdict is cached (default device only) so the
     steady-state no-op is one attribute read. An EXCEPTION from the
     probe is treated as transient and never latches the verdict; an
-    explicit ``device`` argument bypasses the cache entirely."""
+    explicit ``device`` argument bypasses the cache entirely.
+
+    ``force=True`` samples even with telemetry disabled (the gauges are
+    then left untouched) — for callers like StatsListener whose memory
+    report must survive DL4J_TPU_TELEMETRY=0."""
     global _mem_supported
-    if not _ENABLED or (device is None and _mem_supported is False):
+    if (not _ENABLED and not force) \
+            or (device is None and _mem_supported is False):
         return {}
     import jax
 
@@ -565,16 +613,17 @@ def sample_device_memory(device=None) -> Dict[str, Any]:
         return {}
     if device is None:
         _mem_supported = True
-    reg = MetricsRegistry.get_default()
-    dev = str(getattr(d, "id", 0))
-    if ms.get("bytes_in_use") is not None:
-        reg.gauge(DEVICE_BYTES_IN_USE,
-                  "current device bytes in use").set(
-            ms["bytes_in_use"], device=dev)
-    if ms.get("peak_bytes_in_use") is not None:
-        reg.gauge(DEVICE_PEAK_BYTES,
-                  "peak device bytes in use (watermark)").set(
-            ms["peak_bytes_in_use"], device=dev)
+    if _ENABLED:
+        reg = MetricsRegistry.get_default()
+        dev = str(getattr(d, "id", 0))
+        if ms.get("bytes_in_use") is not None:
+            reg.gauge(DEVICE_BYTES_IN_USE,
+                      "current device bytes in use").set(
+                ms["bytes_in_use"], device=dev)
+        if ms.get("peak_bytes_in_use") is not None:
+            reg.gauge(DEVICE_PEAK_BYTES,
+                      "peak device bytes in use (watermark)").set(
+                ms["peak_bytes_in_use"], device=dev)
     return dict(ms)
 
 
@@ -606,6 +655,29 @@ def snapshot() -> Dict[str, Any]:
             "bytes_in_use": mem.get("bytes_in_use"),
             "peak_bytes_in_use": mem.get("peak_bytes_in_use"),
         }
+    health = model_health_snapshot()
+    if health:
+        out["model_health"] = health
+    return out
+
+
+def model_health_snapshot() -> Dict[str, Any]:
+    """Latest model-health gauge values (per-layer grad norms, update
+    ratios, NaN provenance, MFU, step FLOPs) as plain JSON, or {} when
+    no HealthMonitor has published yet. peek-only: assembling the
+    snapshot never creates empty series."""
+    reg = MetricsRegistry.get_default()
+    out: Dict[str, Any] = {}
+    for key, name in (("layer_grad_norm", LAYER_GRAD_NORM),
+                      ("layer_param_norm", LAYER_PARAM_NORM),
+                      ("update_ratio", UPDATE_RATIO),
+                      ("nonfinite_first_layer", NONFINITE_FIRST_LAYER),
+                      ("mfu", MFU),
+                      ("step_flops", STEP_FLOPS),
+                      ("health_fetches", HEALTH_FETCHES)):
+        m = reg.peek(name)
+        if m is not None:
+            out[key] = m._json()
     return out
 
 
@@ -623,7 +695,8 @@ __all__ = [
     "MetricsRegistry", "Counter", "Gauge", "Histogram",
     "span", "record_span", "record_phase",
     "chrome_trace", "export_chrome_trace", "clear_trace",
-    "instrument_jit", "sample_device_memory", "snapshot", "reset",
+    "instrument_jit", "sample_device_memory", "snapshot",
+    "model_health_snapshot", "reset",
     "enabled", "set_enabled", "record_on_device_batch",
     "JIT_COMPILES", "JIT_COMPILE_SECONDS", "STEP_PHASE_SECONDS",
     "DEVICE_BYTES_IN_USE", "DEVICE_PEAK_BYTES",
@@ -635,4 +708,6 @@ __all__ = [
     "FT_ROLLBACKS", "FT_SKIPPED_BATCHES", "FT_PREEMPTION_CHECKPOINTS",
     "FT_AUTO_RESUMES", "TRANSFER_RETRIES", "TRANSFER_QUARANTINES",
     "WATCHDOG_STALLS", "CHAOS_INJECTED",
+    "LAYER_GRAD_NORM", "LAYER_PARAM_NORM", "UPDATE_RATIO",
+    "NONFINITE_FIRST_LAYER", "MFU", "STEP_FLOPS", "HEALTH_FETCHES",
 ]

@@ -14,7 +14,11 @@ so no CDN scripts). Endpoints:
     GET /train/<sid>/model                  -> static info + latest layer stats
     GET /metrics                            -> Prometheus text exposition
     GET /telemetry                          -> telemetry JSON (metrics +
+                                               model-health series +
                                                recent host trace events)
+    GET /trace                              -> Chrome trace-event JSON
+                                               download (perfetto /
+                                               chrome://tracing)
     GET /                                   -> dashboard HTML
 
 The /metrics and /telemetry endpoints read the process-wide
@@ -32,6 +36,20 @@ from typing import List, Optional
 
 from deeplearning4j_tpu.ui.stats import TYPE_ID
 from deeplearning4j_tpu.ui.storage import StatsStorage
+
+
+def _scrub_nonfinite(obj):
+    """NaN/Inf -> None, recursively (strict-JSON safety: browsers
+    reject python's bare NaN/Infinity tokens)."""
+    import math
+
+    if isinstance(obj, float):
+        return obj if math.isfinite(obj) else None
+    if isinstance(obj, dict):
+        return {k: _scrub_nonfinite(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_scrub_nonfinite(v) for v in obj]
+    return obj
 
 _DASHBOARD_HTML = """<!doctype html>
 <html><head><meta charset="utf-8"><title>DL4J-TPU Training UI</title>
@@ -68,8 +86,14 @@ _DASHBOARD_HTML = """<!doctype html>
  </div></div>
 <div class="card"><b>Layer parameter summary</b>
  <pre id="layers"></pre></div>
+<div class="card"><b>Model health (in-step per-layer stats)</b>
+ <pre id="health"></pre></div>
 <script>
 async function j(u){const r=await fetch(u);return r.json()}
+function pick(o,lk){if(!lk)return null;if(o[lk])return o[lk];
+ const i=lk.split('_')[0];
+ for(const k in o)if(k==i||k.startsWith(i+':'))return o[k];return null}
+function fmt(v){return v==null?'NaN':v.toPrecision(4)}
 function draw(cv,xs,ys){const c=cv.getContext('2d');
  c.clearRect(0,0,cv.width,cv.height);
  const pts=xs.map((x,i)=>[x,ys[i]]).filter(p=>p[1]!=null);
@@ -86,7 +110,10 @@ function draw(cv,xs,ys){const c=cv.getContext('2d');
 function bars(cv,st){const c=cv.getContext('2d');
  c.clearRect(0,0,cv.width,cv.height);
  if(!st||!st.hist||!st.hist.length){c.fillStyle='#999';
-  c.fillText('no data',10,20);return}
+  if(st){let y=20;Object.entries(st).forEach(([k,v])=>{
+   if(typeof v=='number'){c.fillText(k+'='+v.toPrecision(4),10,y);
+    y+=14}})}
+  else c.fillText('no data',10,20);return}
  const h=st.hist,hmax=Math.max(...h)||1,w=(cv.width-20)/h.length;
  c.fillStyle='#47c';
  h.forEach((v,i)=>{const bh=v/hmax*(cv.height-30);
@@ -116,11 +143,20 @@ async function refresh(){const sid=document.getElementById('sess').value;
    o.value=o.textContent=k;sel.appendChild(o)})}
  const lk=sel.value||keys[0];
  bars(document.getElementById('hp'),L[lk]);
- bars(document.getElementById('hg'),G[lk]);
- bars(document.getElementById('hu'),U[lk]);
+ bars(document.getElementById('hg'),pick(G,lk));
+ bars(document.getElementById('hu'),pick(U,lk));
  document.getElementById('layers').textContent=Object.entries(L)
-  .map(([k,v])=>k+': mean|w|='+v.mean_mag.toPrecision(4)+
-   ' std='+v.std.toPrecision(4)).join('\\n')}
+  .map(([k,v])=>k+': mean|w|='+fmt(v.mean_mag)+
+   ' std='+fmt(v.std)).join('\\n');
+ const H=m.latest&&m.latest.model_health;
+ document.getElementById('health').textContent=!H?'(no HealthMonitor)':
+  Object.keys(H.grad_norms||{}).map(k=>k+': grad='+
+   fmt(H.grad_norms[k])+' ratio='+
+   fmt(H.update_ratios[k])+' param='+
+   fmt(H.param_norms[k])).join('\\n')+
+  (H.nonfinite_first_layer>=0?'\\nFIRST NON-FINITE LAYER: '+
+   H.nonfinite_layer_name:'')+
+  (H.mfu!=null?'\\nMFU: '+(100*H.mfu).toFixed(1)+'%':'')}
 async function init(){const ss=await j('/train/sessions');
  const sel=document.getElementById('sess');sel.innerHTML='';
  ss.forEach(s=>{const o=document.createElement('option');
@@ -139,7 +175,11 @@ class _Handler(BaseHTTPRequestHandler):
         pass
 
     def _json(self, obj, code=200):
-        body = json.dumps(obj).encode()
+        # json.dumps emits bare NaN/Infinity tokens for non-finite
+        # floats (invalid JSON — the browser's response.json() throws),
+        # and NaN grad norms during a blow-up are exactly when the
+        # dashboard must keep working: scrub them to null
+        body = json.dumps(_scrub_nonfinite(obj)).encode()
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
@@ -173,13 +213,29 @@ class _Handler(BaseHTTPRequestHandler):
             from deeplearning4j_tpu.profiler import telemetry
 
             trace = telemetry.chrome_trace()["traceEvents"]
+            snap = telemetry.snapshot()   # already embeds model_health
             return self._json({
                 "metrics": telemetry.MetricsRegistry.get_default()
                 .to_json(),
-                "snapshot": telemetry.snapshot(),
+                "snapshot": snap,
+                "model_health": snap.get("model_health", {}),
                 "trace_event_count": len(trace),
                 "trace_events": trace[-200:],
             })
+        if parts[0] == "trace":
+            # the FULL host trace as a perfetto-loadable download (the
+            # /telemetry JSON embeds only the newest 200 events)
+            from deeplearning4j_tpu.profiler import telemetry
+
+            body = json.dumps(telemetry.chrome_trace()).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Disposition",
+                             'attachment; filename="dl4j_tpu_trace.json"')
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
         if parts[0] != "train":
             return self._json({"error": "not found"}, 404)
         if len(parts) == 2 and parts[1] == "sessions":

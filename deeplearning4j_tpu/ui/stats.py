@@ -14,9 +14,20 @@ Collected per report (every `frequency` iterations):
 Deviation by design: the reference also reports per-iteration gradient
 histograms, which its eager backward pass has lying around. Here the
 whole train step is one fused XLA executable and gradients never
-materialize host-side; `collect_gradients=True` recomputes them with a
-second compiled pass (documented cost) instead of pretending the fused
-path exposes them.
+materialize host-side. Two paths fill the gap:
+
+- **fast path** — when the model carries a HealthMonitor
+  (profiler/model_health.py), the jitted step already emitted
+  per-layer gradient norms and update-to-param ratios on device;
+  gradient/update reports read the monitor's latest host sample for
+  free: no second backward pass, no host-side previous-params copy,
+  and masked/fmasked batches are covered (the stats come from the real
+  step, mask semantics included).
+- **fallback** — without a monitor (or with
+  ``collect_gradient_histograms=True``, which needs the full gradient
+  arrays), gradients are recomputed with a second compiled pass
+  (documented cost). Masked/fmasked batches recompute with the same
+  mask semantics the step used.
 """
 
 from __future__ import annotations
@@ -36,13 +47,20 @@ TYPE_ID = "StatsListener"
 
 def _summary(arr: np.ndarray, bins: int = 20) -> dict:
     a = np.abs(arr.ravel())
-    hist, edges = np.histogram(arr.ravel(), bins=bins)
+    finite = arr.ravel()
+    finite = finite[np.isfinite(finite)]
+    if finite.size:
+        hist, edges = np.histogram(finite, bins=bins)
+    else:
+        # all-NaN/Inf params (mid-blow-up — exactly when the report
+        # must still go out): empty histogram, not a crash
+        hist, edges = np.zeros(bins, np.int64), np.zeros(2)
     return {
         "mean_mag": float(a.mean()) if a.size else 0.0,
         "std": float(arr.std()) if a.size else 0.0,
         "min": float(arr.min()) if a.size else 0.0,
         "max": float(arr.max()) if a.size else 0.0,
-        "hist": hist.tolist(),
+        "hist": [int(h) for h in hist],
         "hist_edges": [float(edges[0]), float(edges[-1])],
     }
 
@@ -53,7 +71,9 @@ class StatsListener(TrainingListener):
                  worker_id: Optional[str] = None,
                  collect_histograms: bool = True,
                  collect_gradients: bool = False,
-                 collect_updates: bool = False):
+                 collect_updates: bool = False,
+                 collect_gradient_histograms: bool = False,
+                 collect_update_histograms: bool = False):
         self.storage = storage
         self.frequency = max(int(frequency), 1)
         self.session_id = session_id or uuid.uuid4().hex[:12]
@@ -61,6 +81,15 @@ class StatsListener(TrainingListener):
         self.collect_histograms = collect_histograms
         self.collect_gradients = collect_gradients
         self.collect_updates = collect_updates
+        #: force full per-leaf gradient histograms via the
+        #: second-backward-pass fallback even when the model's
+        #: HealthMonitor offers in-step norms (explicit, documented
+        #: cost — the only thing the fast path cannot provide)
+        self.collect_gradient_histograms = collect_gradient_histograms
+        #: same escape hatch for per-leaf UPDATE histograms: keeps the
+        #: host-side previous-params copy + delta summaries even when a
+        #: monitor offers in-step update ratios
+        self.collect_update_histograms = collect_update_histograms
         self._static_sent = False
         self._last_time = None
         self._last_iter = None
@@ -104,6 +133,16 @@ class StatsListener(TrainingListener):
                 (iteration - self._last_iter) / dt
         self._last_time, self._last_iter = now, iteration
 
+        # in-step model-health fast path: the monitor's sample for the
+        # step this callback reports on. latest() reuses the host
+        # sample the fit loop already fetched when the cadences line
+        # up, and costs one device_get (never a second backward) when
+        # the monitor's frequency is coarser than the listener's
+        hm = getattr(model, "_health", None)
+        health = hm.latest() if hm is not None else None
+        if health is not None:
+            update["model_health"] = dict(health)
+
         have_params = bool(getattr(model, "params_list", None))
         if self.collect_histograms and have_params:
             layers = {}
@@ -114,23 +153,39 @@ class StatsListener(TrainingListener):
         if self.collect_updates and have_params:
             # independent of collect_histograms (reference StatsListener
             # treats parameter and update reports as separate toggles)
-            if self._prev_params is not None:
-                ustats = {}
-                for i, p in enumerate(model.params_list):
-                    for k, v in p.items():
-                        key = f"{i}_{k}"
-                        prev = self._prev_params.get(key)
-                        if prev is not None:
-                            ustats[key] = _summary(np.asarray(v) - prev)
-                update["update_stats"] = ustats
-            self._prev_params = {
-                f"{i}_{k}": np.asarray(v)
-                for i, p in enumerate(model.params_list)
-                for k, v in p.items()}
+            if health is not None and not self.collect_update_histograms:
+                # fast path: in-step update-to-param ratios — no host
+                # param copy kept, no delta computed here
+                update["update_stats"] = {
+                    name: {"update_ratio": health["update_ratios"][name],
+                           "param_norm": health["param_norms"][name]}
+                    for name in health["update_ratios"]}
+                self._prev_params = None
+            else:
+                if self._prev_params is not None:
+                    ustats = {}
+                    for i, p in enumerate(model.params_list):
+                        for k, v in p.items():
+                            key = f"{i}_{k}"
+                            prev = self._prev_params.get(key)
+                            if prev is not None:
+                                ustats[key] = _summary(np.asarray(v) - prev)
+                    update["update_stats"] = ustats
+                self._prev_params = {
+                    f"{i}_{k}": np.asarray(v)
+                    for i, p in enumerate(model.params_list)
+                    for k, v in p.items()}
         if self.collect_gradients:
-            gstats = self._gradient_stats(model)
-            if gstats is not None:
-                update["gradient_stats"] = gstats
+            if health is not None and not self.collect_gradient_histograms:
+                # fast path: per-layer grad norms from the jitted step —
+                # the second backward pass never runs
+                update["gradient_stats"] = {
+                    name: {"l2_norm": v}
+                    for name, v in health["grad_norms"].items()}
+            else:
+                gstats = self._gradient_stats(model)
+                if gstats is not None:
+                    update["gradient_stats"] = gstats
         if getattr(model, "_last_etl_ms", None) is not None:
             update["etl_ms"] = float(model._last_etl_ms)
         update["memory"] = self._memory_stats()
@@ -142,34 +197,37 @@ class StatsListener(TrainingListener):
         compiled pass over the batch the last step consumed (module
         docstring: the fused train step never materializes gradients
         host-side, so this is a documented-cost opt-in, not a free
-        byproduct). Unmasked batches only — masked/fmasked steps skip
-        the report rather than recompute with wrong semantics."""
+        byproduct — prefer the HealthMonitor fast path). Masked/fmasked
+        batches recompute with the step's own mask semantics (the mask
+        arrays ride in ``_last_fit_batch``)."""
         batch = getattr(model, "_last_fit_batch", None)
         if batch is None or not getattr(model, "params_list", None):
             return None
         x, y, m, fm, rng = batch
-        if m is not None or fm is not None:
-            return None
         import weakref
 
         # cache keyed on the MODEL: the jit closure bakes in
         # model._loss, so a listener re-attached to a different net
-        # must rebuild. (The cached closure itself strongly holds the
-        # CURRENT model until the listener is re-attached or dropped —
-        # same lifetime the reference's listener/model pairing has; the
-        # weakref here is only the identity key.)
+        # must rebuild. One jitted fn serves masked AND unmasked
+        # batches — jax.jit keys its executable cache on the arg pytree
+        # structure (None vs array), so mask flips retrace under the
+        # same cached closure instead of discarding compiles. (The
+        # cached closure itself strongly holds the CURRENT model until
+        # the listener is re-attached or dropped — same lifetime the
+        # reference's listener/model pairing has; the weakref here is
+        # only the identity key.)
         if self._grads_fn is None or self._grads_fn[0]() is not model:
             import jax
 
-            def grads_of(params, states, x, y, rng):
+            def grads_of(params, states, x, y, m, fm, rng):
                 def scalar(pl):
-                    return model._loss(pl, states, x, y, None, rng)[0]
+                    return model._loss(pl, states, x, y, m, rng, fm)[0]
 
                 return jax.grad(scalar)(params)
 
             self._grads_fn = (weakref.ref(model), jax.jit(grads_of))
         grads = self._grads_fn[1](model.params_list, model.states_list,
-                                  x, y, rng)
+                                  x, y, m, fm, rng)
         out = {}
         for i, g in enumerate(grads):
             for k, v in g.items():
@@ -178,6 +236,10 @@ class StatsListener(TrainingListener):
 
     @staticmethod
     def _memory_stats() -> dict:
+        """Host RSS + device memory. Device numbers come from the ONE
+        probe the process has — telemetry.sample_device_memory() — so
+        the listener report and the watermark gauges can never tell
+        different stories (previously two hand-rolled probes)."""
         out = {}
         try:
             import resource
@@ -186,8 +248,9 @@ class StatsListener(TrainingListener):
         except Exception:
             pass
         try:
-            import jax
-            ms = jax.local_devices()[0].memory_stats()
+            from deeplearning4j_tpu.profiler import telemetry
+            # force=True: this report must survive DL4J_TPU_TELEMETRY=0
+            ms = telemetry.sample_device_memory(force=True)
             if ms:
                 out["device_bytes_in_use"] = ms.get("bytes_in_use")
                 out["device_bytes_limit"] = ms.get("bytes_limit")

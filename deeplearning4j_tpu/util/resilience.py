@@ -569,6 +569,9 @@ class _RunState:
         self.snapshot: Optional[Dict[str, Any]] = None
         self.since_snapshot = 0
         self.window: deque = deque(maxlen=max(ft.divergence_window, 1))
+        #: layer label of the current non-finite event (HealthMonitor
+        #: provenance) — rides the rollback telemetry, then clears
+        self.nonfinite_layer: Optional[str] = None
         # mixed_float16 baseline: skipped-step count at fit entry, so
         # the guard can tell a HANDLED overflow (engine skipped the
         # step, halved the scale — params untouched) from divergence
@@ -758,6 +761,19 @@ def _check_divergence(ft: FaultTolerance, adapter: _FitAdapter,
     bad = not np.isfinite(loss)
     why = "non-finite loss"
     if bad:
+        # NaN provenance: a HealthMonitor on the model knows WHICH
+        # layer went non-finite this step (profiler/model_health.py) —
+        # carry the label on the rollback event instead of making the
+        # operator rerun with panic modes to find it
+        hm = getattr(adapter.model, "_health", None)
+        if hm is not None:
+            try:
+                layer = hm.nonfinite_label()
+            except Exception:
+                layer = None
+            if layer is not None:
+                why = f"non-finite loss (first non-finite layer: {layer})"
+                st.nonfinite_layer = layer
         skipped = _ls_skipped(adapter.model)
         if skipped > st.ls_skipped_seen:
             # mixed_float16 handled overflow: the loss-scale engine
@@ -794,11 +810,14 @@ def _check_divergence(ft: FaultTolerance, adapter: _FitAdapter,
     st.rollbacks += 1
     if _telemetry.enabled():
         reg = _telemetry.MetricsRegistry.get_default()
+        labels = ({"nonfinite_layer": st.nonfinite_layer}
+                  if st.nonfinite_layer else {})
         reg.counter(_telemetry.FT_ROLLBACKS,
                     "divergence-guard rollbacks to the in-memory "
-                    "snapshot").inc()
+                    "snapshot").inc(**labels)
         reg.counter(_telemetry.FT_SKIPPED_BATCHES,
                     "batches skipped after a divergence rollback").inc()
+    st.nonfinite_layer = None   # provenance is per-event, not sticky
     discarded = adapter.model.getIterationCount() - 1 \
         - st.snapshot["iteration"]
     log.warning("resilience: %s at iteration %d — rolling back to the "

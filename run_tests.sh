@@ -174,6 +174,104 @@ if [ $precsmoke -ne 0 ]; then
     exit 1
 fi
 
+# Model-health smoke gate (docs/OBSERVABILITY.md "Model health"): a
+# CPU fit with HealthMonitor(frequency=2) must (a) populate the
+# per-layer grad-norm gauges, (b) cost exactly ONE extra compile at
+# the mln_step site with one health fetch per sampled step and no
+# second backward, and (c) leave off-mode training bit-identical to a
+# never-monitored run (attach->detach lands on the legacy executable).
+env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu DL4J_TPU_TELEMETRY=1 \
+    python - <<'EOF'
+import sys
+
+import jax
+import numpy as np
+
+from deeplearning4j_tpu.learning.updaters import Adam
+from deeplearning4j_tpu.nn.conf import (
+    DenseLayer, InputType, NeuralNetConfiguration, OutputLayer,
+)
+from deeplearning4j_tpu.nn.multilayer.network import MultiLayerNetwork
+from deeplearning4j_tpu.profiler import HealthMonitor, telemetry
+
+rs = np.random.RandomState(0)
+x = rs.randn(16, 4).astype(np.float32)
+y = np.eye(2, dtype=np.float32)[rs.randint(0, 2, 16)]
+
+
+def make():
+    conf = (NeuralNetConfiguration.builder().seed(11).updater(Adam(1e-2))
+            .list()
+            .layer(DenseLayer(n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_out=2, activation="softmax",
+                               loss="mcxent"))
+            .setInputType(InputType.feedForward(4)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+fail = []
+reg = telemetry.MetricsRegistry.get_default()
+compiles = lambda: reg.counter(telemetry.JIT_COMPILES).value(
+    site="mln_step")
+
+# monitored run: gauges + cost contract
+net = make()
+hm = HealthMonitor(frequency=2)
+net.setHealthMonitor(hm)
+c0 = compiles()
+for _ in range(6):
+    net.fit(x, y)
+if compiles() - c0 != 1:
+    fail.append(f"monitored fit compiled {compiles() - c0}x at "
+                "mln_step, expected exactly 1")
+if hm.fetches != 3:
+    fail.append(f"{hm.fetches} health fetches for 6 steps at "
+                "frequency=2, expected 3 (one per sampled step)")
+gn = reg.gauge(telemetry.LAYER_GRAD_NORM)
+for layer in ("0:DenseLayer", "1:OutputLayer"):
+    if not gn.value(layer=layer, site="mln") > 0:
+        fail.append(f"layer grad-norm gauge missing/zero for {layer}")
+if hm.last["nonfinite_first_layer"] != -1:
+    fail.append("clean fit reported a non-finite layer")
+# toggling the monitor must cost exactly one more compile (off-mode
+# executable), then reuse both cached executables
+net.setHealthMonitor(None)
+net.fit(x, y)
+if compiles() - c0 != 2:
+    fail.append(f"detach cost {compiles() - c0 - 1} extra compiles, "
+                "expected exactly 1")
+
+# off-mode bit-equality: attach->detach vs never monitored
+a = make()
+b = make()
+b.setHealthMonitor(HealthMonitor(frequency=2))
+b.setHealthMonitor(None)
+for _ in range(5):
+    a.fit(x, y)
+    b.fit(x, y)
+for la, lb in zip(jax.tree_util.tree_leaves((a.params_list,
+                                             a.opt_states)),
+                  jax.tree_util.tree_leaves((b.params_list,
+                                             b.opt_states))):
+    if not np.array_equal(np.asarray(la), np.asarray(lb)):
+        fail.append("off-mode run is NOT bit-identical to a "
+                    "never-monitored run")
+        break
+
+if fail:
+    sys.stderr.write("model-health smoke FAILED:\n  "
+                     + "\n  ".join(fail) + "\n")
+    sys.exit(1)
+print("model-health smoke OK: 1 extra compile, "
+      f"{hm.fetches} fetches/6 steps, gauges live, off-mode "
+      "bit-identical")
+EOF
+mhsmoke=$?
+if [ $mhsmoke -ne 0 ]; then
+    echo "FATAL: model-health smoke gate regressed" >&2
+    exit 1
+fi
+
 # Chaos smoke gate (docs/FAULT_TOLERANCE.md): three phases sharing one
 # checkpoint dir. A: clean baseline + identity check (a FaultTolerance
 # with every guard off must be bit-identical to the legacy fit loop).

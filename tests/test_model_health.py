@@ -1,0 +1,610 @@
+"""In-step model-health monitoring (profiler/model_health.py).
+
+Covers the ISSUE 5 acceptance surface: per-layer grad/update stats
+threaded through the jitted train step (golden-tested against an
+explicit jax.grad reference), NaN provenance (chaos-injected and
+param-poisoned), loss-scale awareness, the one-extra-compile /
+single-transfer cost contract, off-mode bit-equality, the
+StatsListener fast path, MFU, and the /trace + /telemetry endpoints.
+"""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.learning.updaters import Adam, Sgd
+from deeplearning4j_tpu.nn.conf import (
+    DenseLayer, InputType, LSTM, NeuralNetConfiguration, OutputLayer,
+    RnnOutputLayer,
+)
+from deeplearning4j_tpu.nn.graph.config import ComputationGraphConfiguration
+from deeplearning4j_tpu.nn.graph.graph import ComputationGraph
+from deeplearning4j_tpu.nn.multilayer.network import MultiLayerNetwork
+from deeplearning4j_tpu.profiler import HealthMonitor, model_health, telemetry
+
+
+RS = np.random.RandomState(0)
+X = RS.randn(16, 4).astype(np.float32)
+Y = np.eye(2, dtype=np.float32)[RS.randint(0, 2, 16)]
+
+
+def _mln(seed=3, layers=2):
+    b = (NeuralNetConfiguration.builder().seed(seed).updater(Adam(1e-2))
+         .list())
+    for _ in range(layers - 1):
+        b = b.layer(DenseLayer(n_out=8, activation="tanh"))
+    conf = (b.layer(OutputLayer(n_out=2, activation="softmax",
+                                loss="mcxent"))
+            .setInputType(InputType.feedForward(4)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _cg(seed=3):
+    conf = (ComputationGraphConfiguration.graphBuilder()
+            .seed(seed).updater(Adam(1e-2))
+            .addInputs("in")
+            .addLayer("dense", DenseLayer(n_out=8, activation="tanh"),
+                      "in")
+            .addLayer("out", OutputLayer(n_out=2, activation="softmax",
+                                         loss="mcxent"), "dense")
+            .setOutputs("out")
+            .setInputTypes(InputType.feedForward(4)).build())
+    return ComputationGraph(conf).init()
+
+
+def _leaves(net):
+    return jax.tree_util.tree_leaves((net.params_list, net.opt_states))
+
+
+class TestGradNormGolden:
+    def test_grad_norms_match_explicit_jax_grad(self):
+        """The in-step grad norms must equal an explicit jax.grad of
+        the same loss at the same (pre-step) params — the no-second-
+        backward path computes the SAME gradients, not approximations."""
+        net = _mln()
+        pre_params = jax.tree_util.tree_map(jnp.copy, net.params_list)
+        pre_states = jax.tree_util.tree_map(jnp.copy, net.states_list)
+        hm = HealthMonitor(frequency=1)
+        net.setHealthMonitor(hm)
+        net.fit(X, Y)
+        got = hm.last["grad_norms"]
+
+        ref_grads = jax.grad(
+            lambda pl: net._loss(pl, pre_states, jnp.asarray(X),
+                                 jnp.asarray(Y), None, None)[0])(pre_params)
+        for i, g in enumerate(ref_grads):
+            ref = float(jnp.sqrt(sum(
+                jnp.sum(jnp.square(l.astype(jnp.float32)))
+                for l in jax.tree_util.tree_leaves(g))))
+            name = model_health.layer_names(net)[i]
+            assert got[name] == pytest.approx(ref, rel=1e-5), name
+
+    def test_update_ratio_matches_sgd_closed_form(self):
+        """With plain SGD (no momentum), update = lr * grad, so
+        update_ratio == lr * ||grad|| / ||new_param|| exactly."""
+        conf = (NeuralNetConfiguration.builder().seed(5)
+                .updater(Sgd(0.1)).list()
+                .layer(DenseLayer(n_out=8, activation="tanh"))
+                .layer(OutputLayer(n_out=2, activation="softmax",
+                                   loss="mcxent"))
+                .setInputType(InputType.feedForward(4)).build())
+        net = MultiLayerNetwork(conf).init()
+        hm = HealthMonitor(frequency=1)
+        net.setHealthMonitor(hm)
+        net.fit(X, Y)
+        s = hm.last
+        for name in s["grad_norms"]:
+            expect = 0.1 * s["grad_norms"][name] / s["param_norms"][name]
+            assert s["update_ratios"][name] == pytest.approx(
+                expect, rel=1e-4)
+
+
+class TestCostContract:
+    def test_single_transfer_per_sampled_step(self):
+        net = _mln()
+        hm = HealthMonitor(frequency=2)
+        net.setHealthMonitor(hm)
+        for _ in range(6):
+            net.fit(X, Y)
+        assert hm.fetches == 3   # one device_get per sampled step
+
+    def test_one_extra_compile_per_site_and_off_mode_reuse(self):
+        reg = telemetry.MetricsRegistry.get_default()
+        compiles = lambda: reg.counter(telemetry.JIT_COMPILES).value(
+            site="mln_step")
+        net = _mln(seed=7)
+        c0 = compiles()
+        net.fit(X, Y)
+        assert compiles() - c0 == 1          # legacy executable
+        net.setHealthMonitor(HealthMonitor(frequency=2))
+        net.fit(X, Y)
+        assert compiles() - c0 == 2          # exactly ONE extra compile
+        net.fit(X, Y)
+        assert compiles() - c0 == 2          # monitored executable cached
+        net.setHealthMonitor(None)
+        net.fit(X, Y)
+        assert compiles() - c0 == 2          # legacy executable reused
+
+    def test_off_mode_bit_identical_and_no_second_backward(self):
+        a = _mln(seed=11)
+        for _ in range(5):
+            a.fit(X, Y)
+        # attach-then-detach must land back on the exact legacy step
+        b = _mln(seed=11)
+        b.setHealthMonitor(HealthMonitor(frequency=2))
+        b.setHealthMonitor(None)
+        for _ in range(5):
+            b.fit(X, Y)
+        for la, lb in zip(_leaves(a), _leaves(b)):
+            assert np.array_equal(np.asarray(la), np.asarray(lb))
+
+    def test_monitored_run_is_numerically_equivalent(self):
+        """Monitoring ON adds observers only: same loss/grads/updates
+        to float tolerance (XLA may re-fuse, so bitwise equality is
+        only guaranteed for monitoring OFF — docs/OBSERVABILITY.md)."""
+        a = _mln(seed=13)
+        b = _mln(seed=13)
+        b.setHealthMonitor(HealthMonitor(frequency=3))
+        for _ in range(6):
+            a.fit(X, Y)
+            b.fit(X, Y)
+        for la, lb in zip(jax.tree_util.tree_leaves(a.params_list),
+                          jax.tree_util.tree_leaves(b.params_list)):
+            np.testing.assert_allclose(np.asarray(la), np.asarray(lb),
+                                       rtol=1e-5, atol=1e-6)
+
+
+class TestNanProvenance:
+    def test_poisoned_layer_is_named(self):
+        net = _mln(layers=3)   # 0:Dense 1:Dense 2:Output
+        hm = HealthMonitor(frequency=1)
+        net.setHealthMonitor(hm)
+        net.params_list[1]["W"] = \
+            net.params_list[1]["W"].at[0, 0].set(jnp.nan)
+        net.fit(X, Y)
+        assert hm.last["nonfinite_first_layer"] == 1
+        assert hm.last["nonfinite_layer_name"] == "1:DenseLayer"
+        reg = telemetry.MetricsRegistry.get_default()
+        assert reg.gauge(telemetry.NONFINITE_FIRST_LAYER).value(
+            site="mln") == 1
+
+    def test_nan_input_points_at_layer_zero(self):
+        net = _mln()
+        hm = HealthMonitor(frequency=1)
+        net.setHealthMonitor(hm)
+        xb = X.copy()
+        xb[0, 0] = np.nan
+        net.fit(xb, Y)
+        assert hm.last["nonfinite_first_layer"] == 0
+        assert hm.nonfinite_label() == "0:DenseLayer"
+
+    def test_clean_run_reports_minus_one(self):
+        net = _mln()
+        hm = HealthMonitor(frequency=1)
+        net.setHealthMonitor(hm)
+        net.fit(X, Y)
+        assert hm.last["nonfinite_first_layer"] == -1
+        assert hm.last["nonfinite_layer_name"] is None
+        assert hm.nonfinite_label() is None
+
+    def test_chaos_nan_batch_labels_divergence_rollback(self):
+        """End to end: chaos injects a NaN batch, the divergence guard
+        rolls back, and the rollback telemetry event carries the layer
+        label the HealthMonitor attributed (a NaN INPUT reads layer 0)."""
+        from deeplearning4j_tpu.datasets import (
+            ArrayDataSetIterator, DataSet,
+        )
+        from deeplearning4j_tpu.profiler.chaos import (
+            ChaosConfig, installed,
+        )
+        from deeplearning4j_tpu.util import FaultTolerance
+
+        reg = telemetry.MetricsRegistry.get_default()
+        label_kw = {"nonfinite_layer": "0:DenseLayer"}
+        before = reg.counter(telemetry.FT_ROLLBACKS).value(**label_kw)
+        net = _mln(seed=17)
+        hm = HealthMonitor(frequency=4)
+        net.setHealthMonitor(hm)
+        ft = FaultTolerance(divergence_window=8, snapshot_every=2)
+        with installed(ChaosConfig(nan_steps=(3,))):
+            net.fit(ArrayDataSetIterator(X, Y, 8), epochs=3,
+                    fault_tolerance=ft)
+        after = reg.counter(telemetry.FT_ROLLBACKS).value(**label_kw)
+        assert after - before >= 1
+        assert np.isfinite(net.score(DataSet(X, Y)))
+
+    def test_handled_f16_overflow_not_misreported(self):
+        """A mixed_float16 overflow the loss-scale engine handled
+        (step skipped, scale halved) must read CLEAN — the raw layer
+        stays visible under handled_overflow_layer for debugging."""
+        conf = (NeuralNetConfiguration.builder().seed(3)
+                .updater(Adam(1e-2)).precision("mixed_float16").list()
+                .layer(DenseLayer(n_out=8, activation="tanh"))
+                .layer(OutputLayer(n_out=2, activation="softmax",
+                                   loss="mcxent"))
+                .setInputType(InputType.feedForward(4)).build())
+        net = MultiLayerNetwork(conf).init()
+        hm = HealthMonitor(frequency=1)
+        net.setHealthMonitor(hm)
+        # the huge initial scale (2^15) overflows f16 on the first
+        # step for a loss this size only when grads are large; force
+        # an overflow by inflating a weight (finite, but f16-overflow
+        # scale): the engine must catch it as a handled overflow
+        net.params_list[0]["W"] = net.params_list[0]["W"] * 1e4
+        net.fit(X, Y)
+        skipped = int(np.asarray(
+            net._loss_scale_state["skipped_steps"]))
+        if skipped:   # engine handled it -> provenance must stay clean
+            assert hm.last["handled_overflow"]
+            assert hm.last["nonfinite_first_layer"] == -1
+            assert hm.nonfinite_label() is None
+        else:         # nothing overflowed on this backend: still clean
+            assert hm.last["nonfinite_first_layer"] == -1
+
+
+class TestAllStacks:
+    def test_computation_graph(self):
+        cg = _cg()
+        hm = HealthMonitor(frequency=1)
+        cg.setHealthMonitor(hm)
+        cg.fit(X, Y)
+        assert set(hm.last["grad_norms"]) == {"dense", "out"}
+        assert hm.last["nonfinite_first_layer"] == -1
+        reg = telemetry.MetricsRegistry.get_default()
+        assert reg.gauge(telemetry.LAYER_GRAD_NORM).value(
+            layer="dense", site="cg") > 0
+
+    def test_sharded_trainer_sharing(self):
+        from deeplearning4j_tpu.parallel.sharded import ShardedTrainer
+
+        net = _mln(seed=3)
+        hm = HealthMonitor(frequency=1)
+        net.setHealthMonitor(hm)
+        tr = ShardedTrainer(net, mode="sharing")
+        tr.fit(X, Y)
+        sharded_norms = dict(hm.last["grad_norms"])
+
+        # mesh-reduced norms == single-device norms (GSPMD psum)
+        ref = _mln(seed=3)
+        hm2 = HealthMonitor(frequency=1)
+        ref.setHealthMonitor(hm2)
+        ref.fit(X, Y)
+        for name, v in hm2.last["grad_norms"].items():
+            assert sharded_norms[name] == pytest.approx(v, rel=1e-4)
+
+    def test_sharded_toggle_caches_both_executables(self):
+        """attach -> detach -> attach on a live 'sharing' trainer must
+        reuse the two cached step executables, not retrace per toggle."""
+        from deeplearning4j_tpu.parallel.sharded import ShardedTrainer
+
+        reg = telemetry.MetricsRegistry.get_default()
+        site = "parallel_sharing_step"
+        compiles = lambda: reg.counter(telemetry.JIT_COMPILES).value(
+            site=site)
+        net = _mln(seed=29)
+        tr = ShardedTrainer(net, mode="sharing")
+        c0 = compiles()
+        tr.fit(X, Y)                              # legacy executable
+        net.setHealthMonitor(HealthMonitor(frequency=1))
+        tr.fit(X, Y)                              # monitored executable
+        assert compiles() - c0 == 2
+        net.setHealthMonitor(None)
+        tr.fit(X, Y)
+        net.setHealthMonitor(HealthMonitor(frequency=1))
+        tr.fit(X, Y)
+        assert compiles() - c0 == 2               # both cached, no retrace
+
+    def test_flops_capture_skips_non_step_sites(self):
+        """A HealthMonitor must not tax compiles at sites MFU never
+        reads (forwards, eval) with the capture trace."""
+        hm = HealthMonitor(frequency=1)   # keep one provably alive
+        assert model_health.flops_capture_enabled()
+        assert model_health.wants_flops("mln_step")
+        assert model_health.wants_flops("cg_step")
+        assert not model_health.wants_flops("mln_forward")
+        assert not model_health.wants_flops("cg_forward")
+        del hm   # liveness gating itself is GC-timing-dependent:
+        # wants_flops goes False only once the LAST monitor anywhere
+        # in the process is collected, so no negative assertion here
+
+    def test_sharded_trainer_other_modes_warn_and_skip(self, caplog):
+        from deeplearning4j_tpu.parallel.sharded import ShardedTrainer
+
+        net = _mln(seed=3)
+        hm = HealthMonitor(frequency=1)
+        net.setHealthMonitor(hm)
+        tr = ShardedTrainer(net, mode="averaging")
+        with caplog.at_level("WARNING", logger="deeplearning4j_tpu"):
+            tr.fit(X, Y)
+        assert any("does not support the HealthMonitor" in r.message
+                   for r in caplog.records)
+        assert hm.last is None   # nothing sampled, nothing crashed
+
+    def test_reattach_to_different_model_refreshes_labels(self):
+        """A monitor moved to a model with a different layer set must
+        relabel, not index the new health tree with the old names."""
+        hm = HealthMonitor(frequency=1)
+        big = _mln(seed=3, layers=3)
+        big.setHealthMonitor(hm)
+        big.fit(X, Y)
+        assert len(hm.last["grad_norms"]) == 3
+        big.setHealthMonitor(None)
+
+        small = _mln(seed=4, layers=2)
+        small.setHealthMonitor(hm)
+        small.fit(X, Y)   # stale 3-name list would IndexError here
+        assert set(hm.last["grad_norms"]) == {"0:DenseLayer",
+                                              "1:OutputLayer"}
+
+    def test_stale_sample_refreshed_for_listener(self):
+        """latest() serves ``last`` when the fit loop sampled this
+        step, and fetches the current step itself when the monitor's
+        cadence is coarser — a report never carries stale stats."""
+        net = _mln()
+        hm = HealthMonitor(frequency=100)   # never fires in 3 steps
+        net.setHealthMonitor(hm)
+        for _ in range(3):
+            net.fit(X, Y)
+        assert hm.last is None
+        cur = hm.latest()
+        assert cur is not None and cur is hm.last
+        assert cur["grad_norms"]["0:DenseLayer"] > 0
+        assert hm.latest() is cur   # fresh sample reused, no refetch
+
+    def test_tbptt_segments_report(self):
+        conf = (NeuralNetConfiguration.builder().seed(3)
+                .updater(Adam(1e-2)).list()
+                .layer(LSTM(n_out=8))
+                .layer(RnnOutputLayer(n_out=2, activation="softmax",
+                                      loss="mcxent"))
+                .setInputType(InputType.recurrent(4))
+                .tBPTTLength(5).build())
+        net = MultiLayerNetwork(conf).init()
+        hm = HealthMonitor(frequency=1)
+        net.setHealthMonitor(hm)
+        rs = np.random.RandomState(1)
+        xs = rs.randn(4, 12, 4).astype(np.float32)
+        ys = np.eye(2, dtype=np.float32)[rs.randint(0, 2, (4, 12))]
+        net.fit(xs, ys)
+        assert hm.fetches == 3   # ceil(12/5) segments, frequency=1
+        assert hm.last["grad_norms"]["0:LSTM"] > 0
+
+
+class TestMfu:
+    def test_mfu_populated_with_peak_entry(self):
+        from deeplearning4j_tpu.profiler import flops as flops_mod
+
+        kind = jax.devices()[0].device_kind
+        had = kind in flops_mod.PEAK_FLOPS
+        if not had:
+            flops_mod.PEAK_FLOPS[kind] = {"bf16": 1e12, "f32": 1e12}
+        try:
+            net = _mln(seed=19)
+            hm = HealthMonitor(frequency=2)
+            net.setHealthMonitor(hm)
+            for _ in range(6):
+                net.fit(X, Y)
+            # MFU needs a previous sample as the wall-clock anchor, so
+            # it appears from the second sample onward
+            assert hm.last.get("mfu") is not None
+            assert hm.last["mfu"] > 0
+            reg = telemetry.MetricsRegistry.get_default()
+            assert reg.gauge(telemetry.MFU).value(site="mln") > 0
+            assert model_health.site_flops("mln_step") > 0
+        finally:
+            if not had:
+                flops_mod.PEAK_FLOPS.pop(kind, None)
+
+    def test_mfu_omitted_without_peak_entry(self):
+        from deeplearning4j_tpu.profiler import flops as flops_mod
+
+        kind = jax.devices()[0].device_kind
+        assert kind not in flops_mod.PEAK_FLOPS, \
+            "test assumes the CPU backend has no PEAK_FLOPS entry"
+        net = _mln(seed=23)
+        hm = HealthMonitor(frequency=2)
+        net.setHealthMonitor(hm)
+        for _ in range(6):
+            net.fit(X, Y)
+        assert "mfu" not in hm.last   # warned + omitted, never wrong
+
+    def test_mfu_numerator_exact_with_multiple_executables(self):
+        """Ragged batches / shape buckets keep several executables
+        with different FLOPs live at one jit site; each dispatch must
+        charge its OWN executable's FLOPs (latest-compile-wins would
+        make every MFU sample silently wrong)."""
+        net = _mln(seed=31)
+        hm = HealthMonitor(frequency=1)
+        net.setHealthMonitor(hm)
+        net.fit(X, Y)                 # compile + run executable A (16)
+        f_a = model_health.site_flops("mln_step")
+        assert f_a and f_a > 0
+        d0 = model_health.dispatched_flops("mln_step")
+        net.fit(X[:8], Y[:8])         # compile + run executable B (8)
+        f_b = model_health.site_flops("mln_step")
+        assert f_b != f_a             # genuinely different cost
+        for _ in range(2):            # back on executable A
+            net.fit(X, Y)
+        delta = model_health.dispatched_flops("mln_step") - d0
+        assert delta == pytest.approx(f_b + 2 * f_a, rel=1e-6)
+
+    def test_bench_common_reexports_peak_flops(self):
+        import bench_common
+
+        from deeplearning4j_tpu.profiler import flops as flops_mod
+
+        assert bench_common.PEAK_FLOPS is flops_mod.PEAK_FLOPS
+        assert bench_common.peak_flops is flops_mod.peak_flops
+
+
+class TestStatsListenerFastPath:
+    def test_gradient_and_update_reports_without_second_backward(self):
+        from deeplearning4j_tpu.ui import InMemoryStatsStorage, StatsListener
+        from deeplearning4j_tpu.ui.stats import TYPE_ID
+
+        net = _mln()
+        net.setHealthMonitor(HealthMonitor(frequency=1))
+        st = InMemoryStatsStorage()
+        lst = StatsListener(st, session_id="mh1", worker_id="w",
+                            collect_gradients=True, collect_updates=True)
+        net.setListeners(lst)
+        for _ in range(3):
+            net.fit(X, Y)
+        ups = st.getAllUpdatesAfter("mh1", TYPE_ID, "w", 0.0)
+        last = ups[-1]
+        assert last["gradient_stats"]["0:DenseLayer"]["l2_norm"] > 0
+        assert "update_ratio" in last["update_stats"]["0:DenseLayer"]
+        assert "model_health" in last
+        # the fast path: no recompute closure, no host param copy
+        assert lst._grads_fn is None
+        assert lst._prev_params is None
+
+    def test_explicit_histograms_fallback_still_works(self):
+        from deeplearning4j_tpu.ui import InMemoryStatsStorage, StatsListener
+        from deeplearning4j_tpu.ui.stats import TYPE_ID
+
+        net = _mln()
+        net.setHealthMonitor(HealthMonitor(frequency=1))
+        st = InMemoryStatsStorage()
+        lst = StatsListener(st, session_id="mh2", worker_id="w",
+                            collect_gradients=True,
+                            collect_gradient_histograms=True)
+        net.setListeners(lst)
+        net.fit(X, Y)
+        last = st.getAllUpdatesAfter("mh2", TYPE_ID, "w", 0.0)[-1]
+        assert len(last["gradient_stats"]["0_W"]["hist"]) == 20
+        assert lst._grads_fn is not None   # the documented-cost opt-in
+
+    def test_update_histograms_explicit_fallback(self):
+        """collect_update_histograms=True keeps the per-leaf delta
+        summaries (the dashboard's update-histogram panel) even when a
+        monitor offers in-step ratios."""
+        from deeplearning4j_tpu.ui import InMemoryStatsStorage, StatsListener
+        from deeplearning4j_tpu.ui.stats import TYPE_ID
+
+        net = _mln()
+        net.setHealthMonitor(HealthMonitor(frequency=1))
+        st = InMemoryStatsStorage()
+        lst = StatsListener(st, session_id="mh5", worker_id="w",
+                            collect_updates=True,
+                            collect_update_histograms=True)
+        net.setListeners(lst)
+        for _ in range(2):
+            net.fit(X, Y)
+        last = st.getAllUpdatesAfter("mh5", TYPE_ID, "w", 0.0)[-1]
+        assert len(last["update_stats"]["0_W"]["hist"]) == 20
+        assert lst._prev_params is not None   # the documented-cost path
+
+    def test_masked_batches_covered(self):
+        """Masked batches were silently skipped by the recompute path;
+        now both the fast path and the fallback report them."""
+        from deeplearning4j_tpu.datasets import DataSet
+        from deeplearning4j_tpu.ui import InMemoryStatsStorage, StatsListener
+        from deeplearning4j_tpu.ui.stats import TYPE_ID
+
+        conf = (NeuralNetConfiguration.builder().seed(3)
+                .updater(Adam(1e-2)).list()
+                .layer(LSTM(n_out=8))
+                .layer(RnnOutputLayer(n_out=2, activation="softmax",
+                                      loss="mcxent"))
+                .setInputType(InputType.recurrent(4)).build())
+        rs = np.random.RandomState(1)
+        xs = rs.randn(4, 6, 4).astype(np.float32)
+        ys = np.eye(2, dtype=np.float32)[rs.randint(0, 2, (4, 6))]
+        mask = np.ones((4, 6), np.float32)
+        mask[:, 4:] = 0.0
+        ds = DataSet(xs, ys, labels_mask=mask)
+
+        # fallback (no monitor): recomputes WITH the mask now
+        net = MultiLayerNetwork(conf).init()
+        st = InMemoryStatsStorage()
+        lst = StatsListener(st, session_id="mh3", worker_id="w",
+                            collect_gradients=True)
+        net.setListeners(lst)
+        net.fit(ds)
+        last = st.getAllUpdatesAfter("mh3", TYPE_ID, "w", 0.0)[-1]
+        assert "gradient_stats" in last
+
+        # fast path (monitor): in-step stats carry mask semantics
+        net2 = MultiLayerNetwork(conf).init()
+        net2.setHealthMonitor(HealthMonitor(frequency=1))
+        st2 = InMemoryStatsStorage()
+        lst2 = StatsListener(st2, session_id="mh4", worker_id="w",
+                             collect_gradients=True)
+        net2.setListeners(lst2)
+        net2.fit(ds)
+        last2 = st2.getAllUpdatesAfter("mh4", TYPE_ID, "w", 0.0)[-1]
+        assert last2["gradient_stats"]["0:LSTM"]["l2_norm"] > 0
+        assert lst2._grads_fn is None
+
+
+class TestEndpoints:
+    def test_trace_download_and_health_in_telemetry_json(self):
+        from deeplearning4j_tpu.ui import UIServer
+
+        net = _mln()
+        net.setHealthMonitor(HealthMonitor(frequency=1))
+        net.fit(X, Y)
+        ui = UIServer()
+        port = ui.start(0)
+        try:
+            base = f"http://127.0.0.1:{port}"
+            resp = urllib.request.urlopen(base + "/trace")
+            assert "attachment" in resp.headers["Content-Disposition"]
+            trace = json.loads(resp.read())
+            assert "traceEvents" in trace
+            tel = json.loads(urllib.request.urlopen(
+                base + "/telemetry").read())
+            assert "layer_grad_norm" in tel["model_health"]
+            assert "nonfinite_first_layer" in tel["model_health"]
+        finally:
+            ui.stop()
+
+    def test_nonfinite_values_scrubbed_from_json(self):
+        """NaN grad norms ride the JSON endpoints exactly when the
+        dashboard must keep working — python's json emits bare
+        NaN/Infinity tokens browsers reject, so they must be scrubbed
+        to null."""
+        from deeplearning4j_tpu.ui import (
+            InMemoryStatsStorage, StatsListener, UIServer,
+        )
+
+        net = _mln()
+        net.setHealthMonitor(HealthMonitor(frequency=1))
+        st = InMemoryStatsStorage()
+        net.setListeners(StatsListener(st, session_id="mhnan",
+                                       worker_id="w",
+                                       collect_gradients=True))
+        net.params_list[0]["W"] = \
+            net.params_list[0]["W"].at[0, 0].set(jnp.nan)
+        net.fit(X, Y)
+        ui = UIServer()
+        ui.attach(st)
+        port = ui.start(0)
+        strict = dict(parse_constant=lambda c: (_ for _ in ()).throw(
+            ValueError(f"bare {c} token in JSON")))
+        try:
+            base = f"http://127.0.0.1:{port}"
+            body = urllib.request.urlopen(base + "/train/mhnan/model").read()
+            m = json.loads(body.decode(), **strict)   # browser-strict
+            stats = m["latest"]["gradient_stats"]
+            assert stats["0:DenseLayer"]["l2_norm"] is None   # was NaN
+            assert m["latest"]["model_health"][
+                "nonfinite_layer_name"] == "0:DenseLayer"
+            json.loads(urllib.request.urlopen(
+                base + "/telemetry").read().decode(), **strict)
+        finally:
+            ui.stop()
+
+    def test_snapshot_embeds_model_health(self):
+        net = _mln()
+        net.setHealthMonitor(HealthMonitor(frequency=1))
+        net.fit(X, Y)
+        snap = telemetry.snapshot()
+        assert "model_health" in snap
+        assert "layer_grad_norm" in snap["model_health"]

@@ -359,6 +359,28 @@ class TestStepPhases:
             telemetry.DEVICE_PEAK_BYTES)
         assert g.value(device="3") == 20
 
+    def test_force_samples_with_telemetry_disabled(self):
+        # StatsListener's memory report must survive
+        # DL4J_TPU_TELEMETRY=0: force=True still probes (gauges are
+        # left untouched — telemetry is off), plain calls stay no-ops
+        class FakeDevice:
+            id = 7
+
+            def memory_stats(self):
+                return {"bytes_in_use": 5, "peak_bytes_in_use": 9}
+
+        telemetry.set_enabled(False)
+        try:
+            assert telemetry.sample_device_memory(FakeDevice()) == {}
+            out = telemetry.sample_device_memory(FakeDevice(),
+                                                 force=True)
+            assert out["bytes_in_use"] == 5
+            g = telemetry.MetricsRegistry.get_default().gauge(
+                telemetry.DEVICE_PEAK_BYTES)
+            assert g.value(device="7") == 0.0   # not published
+        finally:
+            telemetry.set_enabled(True)
+
     def test_probe_exception_does_not_latch(self):
         class Flaky:
             id = 0
