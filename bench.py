@@ -55,6 +55,16 @@ def main() -> None:
     )
 
     import sys
+    if "--zero-ab" in sys.argv:
+        # replicated vs ZeRO-style update-sharded sharing step over the
+        # full device mesh (arXiv:2004.13336): step time + per-device
+        # master/opt byte gauges, for the MULTICHIP round files
+        from bench_common import zero_ab
+
+        on_accel = jax.devices()[0].platform in ("tpu", "gpu")
+        print(json.dumps(zero_ab(
+            "dense", steps=10 if on_accel else 4)))
+        return
     if "--precision-ab" in sys.argv:
         # precision A/B/C on the bert train bench: f32 vs the
         # mixed_bfloat16 policy (fp32 masters, bf16 compute) vs naive

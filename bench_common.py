@@ -239,6 +239,159 @@ def run_char_lstm(batch=256, seq=200, hidden=256, vocab=77, steps=10,
             "telemetry": telemetry_snapshot()}
 
 
+def zero_ab(workload="dense", steps=8, trials=3, batch=None, hidden=None,
+            classes=10, seq=32, precision=None):
+    """Interleaved A/B of the ShardedTrainer sharing step: replicated
+    weight update vs ZeRO-style update sharding (update_sharding=
+    'zero', arXiv:2004.13336) on the full device mesh.
+
+    Sides are fresh identically-seeded models on ONE shared mesh;
+    windows interleave (A chunk, B chunk per trial) so tenant drift
+    cancels, and each window drives all ``steps`` batches through ONE
+    fit() call so the zero side's fit-exit master gather (`_finish`)
+    amortizes exactly as it does in a real epoch. Reported per side:
+    best-of-N window seconds, final loss, and the per-device
+    master/opt byte gauges (dl4j_tpu_master_param_bytes /
+    dl4j_tpu_opt_state_bytes) — the 1/N memory claim as a measured
+    ratio. The device-memory watermark is reported ONCE, globally:
+    both sides live in one process, so a per-side peak would be
+    fiction — the gauges are the per-side number. Workloads: 'dense'
+    (deep MLP), 'lstm' (char-LSTM MLN), 'resnet' (zoo ResNet-50
+    ComputationGraph, CPU-shrunk off-accel).
+    """
+    import numpy as np
+
+    from deeplearning4j_tpu.datasets import DataSet, ListDataSetIterator
+    from deeplearning4j_tpu.parallel.mesh import build_mesh
+    from deeplearning4j_tpu.parallel.sharded import ShardedTrainer
+    from deeplearning4j_tpu.profiler import telemetry
+
+    on_accel = jax.devices()[0].platform in ("tpu", "gpu")
+
+    def make_model_and_batch():
+        # fresh RandomState per call: both sides must see the SAME
+        # batch (and identically-seeded params) or the loss comparison
+        # measures data, not the update path
+        rs = np.random.RandomState(0)
+        from deeplearning4j_tpu.learning.updaters import Adam
+
+        if workload == "dense":
+            from deeplearning4j_tpu.nn.conf import (
+                DenseLayer, InputType, NeuralNetConfiguration,
+                OutputLayer,
+            )
+            from deeplearning4j_tpu.nn.multilayer.network import (
+                MultiLayerNetwork,
+            )
+
+            h = hidden or (2048 if on_accel else 128)
+            b = batch or (512 if on_accel else 32)
+            bld = (NeuralNetConfiguration.builder().seed(7)
+                   .updater(Adam(1e-3)))
+            if precision:
+                bld = bld.precision(precision)
+            bld = bld.list()
+            for _ in range(4):
+                bld = bld.layer(DenseLayer(n_out=h, activation="relu"))
+            conf = (bld.layer(OutputLayer(n_out=classes,
+                                          activation="softmax",
+                                          loss="mcxent"))
+                    .setInputType(InputType.feedForward(h)).build())
+            net = MultiLayerNetwork(conf).init()
+            x = rs.randn(b, h).astype(np.float32)
+            y = np.eye(classes, dtype=np.float32)[
+                rs.randint(0, classes, b)]
+            return net, DataSet(x, y)
+        if workload == "lstm":
+            from deeplearning4j_tpu.nn.multilayer.network import (
+                MultiLayerNetwork,
+            )
+            from deeplearning4j_tpu.zoo.textgen_lstm import (
+                TextGenerationLSTM,
+            )
+
+            h = hidden or (256 if on_accel else 64)
+            b = batch or (256 if on_accel else 16)
+            vocab = 64
+            conf = TextGenerationLSTM(vocab_size=vocab, hidden=h,
+                                      tbptt_length=0).conf()
+            if precision:
+                conf.precision = precision
+            net = MultiLayerNetwork(conf).init()
+            eye = np.eye(vocab, dtype=np.float32)
+            ids = rs.integers(0, vocab, (b, seq)) \
+                if hasattr(rs, "integers") else rs.randint(0, vocab,
+                                                           (b, seq))
+            return net, DataSet(eye[ids], eye[np.roll(ids, -1, 1)])
+        if workload == "resnet":
+            from deeplearning4j_tpu.nn.graph.graph import (
+                ComputationGraph,
+            )
+            from deeplearning4j_tpu.zoo.resnet50 import ResNet50
+
+            shape = (224, 224, 3) if on_accel else (32, 32, 3)
+            ncls = 1000 if on_accel else classes
+            b = batch or (64 if on_accel else 8)
+            conf = ResNet50(num_classes=ncls, in_shape=shape).conf()
+            if precision:
+                conf.precision = precision
+            net = ComputationGraph(conf).init()
+            h, w, c = shape
+            x = rs.rand(b, h, w, c).astype(np.float32)
+            y = np.eye(ncls, dtype=np.float32)[rs.randint(0, ncls, b)]
+            return net, DataSet(x, y)
+        raise ValueError(f"unknown zero_ab workload {workload!r}")
+
+    mesh = build_mesh()
+    sides = {}
+    trainers = {}
+    for name, us in (("replicated", None), ("update_sharded", "zero")):
+        net, ds = make_model_and_batch()
+        trainers[name] = (ShardedTrainer(net, mesh=mesh, mode="sharing",
+                                         update_sharding=us), net, ds)
+    # warm both sides (compile + placement) before any timed window
+    for tr, net, ds in trainers.values():
+        tr.fit(ds)
+        float(net.score())
+
+    best = {name: float("inf") for name in trainers}
+    for _ in range(trials):
+        for name, (tr, net, ds) in trainers.items():
+            t0 = time.perf_counter()
+            tr.fit(ListDataSetIterator([ds] * steps))
+            float(net.score())   # device->host sync closes the window
+            best[name] = min(best[name], time.perf_counter() - t0)
+
+    reg = telemetry.MetricsRegistry.get_default()
+    mg = reg.gauge(telemetry.MASTER_PARAM_BYTES)
+    og = reg.gauge(telemetry.OPT_STATE_BYTES)
+    for name, (tr, net, ds) in trainers.items():
+        sides[name] = {
+            "step_s": round(best[name] / steps, 6),
+            "final_loss": float(net.score()),
+            "master_param_bytes": mg.value(mode=name, site="sharded"),
+            "opt_state_bytes": og.value(mode=name, site="sharded"),
+        }
+    out = {"workload": workload, "mesh_data": mesh.shape["data"],
+           "steps": steps, "sides": sides,
+           "peak_bytes_in_use":
+               telemetry.sample_device_memory().get("peak_bytes_in_use")}
+    rep, zer = sides["replicated"], sides["update_sharded"]
+    out["zero_step_speedup"] = round(rep["step_s"] / zer["step_s"], 4)
+    if rep["master_param_bytes"]:
+        out["master_bytes_ratio"] = round(
+            zer["master_param_bytes"] / rep["master_param_bytes"], 4)
+    if rep["opt_state_bytes"]:
+        out["opt_bytes_ratio"] = round(
+            zer["opt_state_bytes"] / rep["opt_state_bytes"], 4)
+    if rep["final_loss"]:
+        out["loss_delta_rel"] = round(
+            abs(zer["final_loss"] - rep["final_loss"])
+            / abs(rep["final_loss"]), 6)
+    out["telemetry"] = telemetry_snapshot()
+    return out
+
+
 def _verify_master_dtypes(params_tree, opt_tree, expect="float32"):
     """Every floating param leaf must be the master dtype — the A/B
     below refuses to report a 'mixed' speedup whose params silently

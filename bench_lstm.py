@@ -40,7 +40,21 @@ def main():
                          "ragged stream (bucketing + async prefetch "
                          "vs raw): reports pipeline_speedup and "
                          "per-side compile counts")
+    ap.add_argument("--zero-ab", action="store_true",
+                    help="interleaved A/B of the data-parallel sharing "
+                         "step: replicated vs ZeRO-style update "
+                         "sharding (step time + per-device master/opt "
+                         "byte gauges; recorded into MULTICHIP rounds)")
     args = ap.parse_args()
+
+    if args.zero_ab:
+        from bench_common import zero_ab
+
+        print(json.dumps(zero_ab(
+            "lstm", steps=args.steps, batch=args.batch,
+            hidden=args.hidden, seq=args.seq,
+            precision=args.precision)))
+        return
 
     if args.precision_ab:
         from bench_common import precision_ab

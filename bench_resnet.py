@@ -89,7 +89,20 @@ def main():
                          "shapes are fixed, no recompiles involved)")
     ap.add_argument("--pipeline-batches", type=int, default=8,
                     help="minibatches per epoch in the pipeline A/B")
+    ap.add_argument("--zero-ab", action="store_true",
+                    help="interleaved A/B of the data-parallel sharing "
+                         "step: replicated vs ZeRO-style update "
+                         "sharding (step time + per-device master/opt "
+                         "byte gauges; recorded into MULTICHIP rounds)")
     args = ap.parse_args()
+
+    if args.zero_ab:
+        from bench_common import zero_ab
+
+        print(json.dumps(zero_ab("resnet", steps=args.steps,
+                                 batch=args.batch,
+                                 classes=args.classes)))
+        return
 
     if args.precision_ab:
         from bench_common import precision_ab

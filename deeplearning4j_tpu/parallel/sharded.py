@@ -23,6 +23,20 @@ TPU collectives (and keeping its compression semantics as an option):
   shard trains independently (params diverge), every
   `averaging_frequency` steps params+updater state are mesh-averaged.
 
+'sharing' additionally supports ``update_sharding='zero'`` (Xu et al.,
+arXiv:2004.13336 — ZeRO-style cross-replica weight-update sharding):
+gradients are reduce-scattered over the data axis instead of
+all-reduced, each replica applies the optimizer to its contiguous 1/N
+shard of the flattened fp32 masters + moments (one fused Pallas pass —
+ops/fused_update_pallas.py — with an XLA fallback off-TPU), and the
+updated COMPUTE-dtype params are all-gathered for the next forward.
+Per-replica master/opt memory drops to ~1/N (measured by the
+dl4j_tpu_master_param_bytes / dl4j_tpu_opt_state_bytes gauges).
+``update_sharding=None`` (default) keeps the sequential GSPMD step
+bit-identical. Multi-host: mesh construction threads
+``maybe_init_distributed`` so the same trainer spans hosts
+(docs/SHARDING.md).
+
 All modes produce ONE compiled executable; no host-side accumulator
 threads exist because no host hop exists.
 """
@@ -44,7 +58,11 @@ from deeplearning4j_tpu.learning.updaters import apply_updater
 from deeplearning4j_tpu.nn import precision as _precision
 from deeplearning4j_tpu.nn.multilayer.network import _uses_epoch_schedule
 from deeplearning4j_tpu.ops import compression as comp
-from deeplearning4j_tpu.parallel.mesh import build_mesh
+from deeplearning4j_tpu.ops import fused_update_pallas as _fused
+from deeplearning4j_tpu.parallel import zero as _zero
+from deeplearning4j_tpu.parallel.mesh import (
+    build_mesh, maybe_init_distributed, put_replicated,
+)
 from deeplearning4j_tpu.profiler import model_health as _model_health
 from deeplearning4j_tpu.profiler import telemetry as _telemetry
 
@@ -97,9 +115,24 @@ class _ModelFuncs:
                     f"graph takes {len(self._ins)} inputs / "
                     f"{len(self._outs)} outputs; got {len(xs)} feature "
                     f"and {len(ys)} label arrays")
+            # masks thread through exactly like ComputationGraph's own
+            # fit loop: per-output label masks, per-input feature masks
+            # (None placeholders flow through jit as empty pytree nodes)
+            masks_map = None
+            if mask is not None:
+                ms = mask if isinstance(mask, (list, tuple)) else [mask]
+                masks_map = {n: m for n, m in zip(self._outs, ms)
+                             if m is not None} or None
+            fmasks_map = None
+            if fmask is not None:
+                fs = fmask if isinstance(fmask, (list, tuple)) \
+                    else [fmask]
+                fmasks_map = {n: m for n, m in zip(self._ins, fs)
+                              if m is not None} or None
             return self.model._loss(params, states,
                                     dict(zip(self._ins, xs)),
                                     dict(zip(self._outs, ys)), rng,
+                                    masks_map, fmasks_map,
                                     collect_acts=collect_acts)
         return self.model._loss(params, states, x, y, mask, rng, fmask,
                                 collect_acts=collect_acts)
@@ -148,9 +181,21 @@ class ShardedTrainer:
                  threshold: float = 1e-3,
                  adaptive_threshold: bool = True,
                  target_density: float = 1e-2,
-                 averaging_frequency: int = 5):
+                 averaging_frequency: int = 5,
+                 update_sharding: Optional[str] = None):
         if mode not in ("sharing", "sharing_compressed", "averaging"):
             raise ValueError(f"Unknown mode: {mode}")
+        if update_sharding in (True,):
+            update_sharding = "zero"
+        if update_sharding not in (None, "zero"):
+            raise ValueError(
+                f"Unknown update_sharding: {update_sharding!r} "
+                "(expected None or 'zero')")
+        if update_sharding and mode != "sharing":
+            raise ValueError(
+                "update_sharding='zero' applies to mode='sharing' only "
+                f"(got mode={mode!r}): the compressed/averaging modes "
+                "keep per-shard updater state by design")
         if getattr(model, "_policy", None) is not None \
                 and model._policy.loss_scaling and mode != "sharing":
             # the shard_map modes thread hand-built per-shard state
@@ -162,8 +207,14 @@ class ShardedTrainer:
                 "'sharing' or the mixed_bfloat16 policy")
         self.model = model
         self.mf = _ModelFuncs(model)
-        self.mesh = mesh if mesh is not None else build_mesh()
+        if mesh is None:
+            # multi-host: join the jax.distributed job BEFORE building
+            # the default mesh, so it spans every host's devices
+            maybe_init_distributed()
+            mesh = build_mesh()
+        self.mesh = mesh
         self.mode = mode
+        self.update_sharding = update_sharding
         self.threshold = threshold
         self.adaptive_threshold = adaptive_threshold
         self.target_density = target_density
@@ -174,18 +225,44 @@ class ShardedTrainer:
         self._residual = None
         self._thresholds = None
         self._local = None  # per-shard replicas for averaging mode
+        self._zero = None          # flat masters/opt/compute (zero mode)
+        self._zero_layout = None   # static flat-shard layout (zero mode)
         self._n_data = self.mesh.shape["data"]
 
     # ------------------------------------------------------------------
     def _place_replicated(self):
         """Replicate model params/opt/state across the mesh."""
-        spec = NamedSharding(self.mesh, P())
-        put = lambda t: _tmap(lambda a: jax.device_put(a, spec), t)
+        put = lambda t: put_replicated(t, self.mesh)
         p_, s_, o_ = self.mf.get_trees()
         self.mf.set_trees(put(p_), put(s_), put(o_))
         if getattr(self.model, "_loss_scale_state", None) is not None:
             self.model._loss_scale_state = put(
                 self.model._loss_scale_state)
+        mb, ob = _zero.replicated_state_bytes(p_, o_)
+        _telemetry.record_state_bytes(mb, ob, mode="replicated")
+
+    def _place_update_sharded(self):
+        """Zero placement: flatten the canonical trees into per-group
+        flat masters + opt state sharded P('data') over the mesh, and a
+        replicated COMPUTE-dtype param tree for the forward. States
+        (BN stats) and the loss-scale scalars stay replicated. Also the
+        topology-change restore path: the canonical trees are
+        replica-count-free, so a bundle saved on one mesh re-shards
+        here onto whatever mesh this trainer was built with."""
+        p_, s_, o_ = self.mf.get_trees()
+        layout = _zero.ZeroLayout.build(self.model, self.mf, p_, o_,
+                                        self._n_data)
+        masters, opt_f, compute = layout.place(p_, o_, self.mesh)
+        self._zero_layout = layout
+        self._zero = {"masters": masters, "opt": opt_f,
+                      "compute": compute}
+        self.mf.set_trees(p_, put_replicated(s_, self.mesh), o_)
+        if getattr(self.model, "_loss_scale_state", None) is not None:
+            self.model._loss_scale_state = put_replicated(
+                self.model._loss_scale_state, self.mesh)
+        _telemetry.record_state_bytes(layout.master_bytes_per_device(),
+                                      layout.opt_bytes_per_device(),
+                                      mode="update_sharded")
 
     def _already_placed(self, a, dt) -> bool:
         """True when the array is device-resident with the trainer's
@@ -209,27 +286,40 @@ class ShardedTrainer:
                 return None
             if self._already_placed(a, dt):
                 return a
+            if jax.process_count() > 1:
+                # multi-host convention: each host feeds its LOCAL
+                # batch rows; the global batch is their concatenation
+                # along the data axis (test_jax_distributed pattern)
+                import numpy as np
+
+                an = np.asarray(a, dt) if dt is not None \
+                    else np.asarray(a)
+                gshape = ((an.shape[0] * jax.process_count(),)
+                          + an.shape[1:])
+                return jax.make_array_from_process_local_data(
+                    spec(an), an, gshape)
             aj = jnp.asarray(a, dt) if dt is not None else jnp.asarray(a)
             return jax.device_put(aj, spec(aj))
+
+        def one_or_list(a, dt):
+            if isinstance(a, (list, tuple)):
+                return [one(b, dt) for b in a]
+            return one(a, dt)
 
         dt = getattr(self.model, "_input_dtype", self.model._dtype)
         first = x[0] if isinstance(x, (list, tuple)) else x
         if self._already_placed(first, dt):
             _telemetry.record_on_device_batch("sharded")
-        if isinstance(x, (list, tuple)):
-            x = [one(a, dt) for a in x]
-        else:
-            x = one(x, dt)
-        if isinstance(y, (list, tuple)):
-            y = [one(a, None) for a in y]
-        else:
-            y = one(y, None)
-        return x, y, one(mask, None), one(fmask, None)
+        x = one_or_list(x, dt)
+        y = one_or_list(y, None)
+        return x, y, one_or_list(mask, None), one_or_list(fmask, None)
 
     # ------------------------------------------------------------------
     # mode: sharing (GSPMD — compiler-inserted all-reduce)
     # ------------------------------------------------------------------
     def _build_sharing_step(self):
+        if self.update_sharding:
+            return self._build_zero_step()
         mf = self.mf
         policy = getattr(self.model, "_policy", None)
         # static health flag; GSPMD's compiler-inserted psum makes the
@@ -291,6 +381,140 @@ class ShardedTrainer:
         return _telemetry.instrument_jit(
             "parallel_sharing_step",
             jax.jit(step_fn, donate_argnums=(0, 1, 2)))
+
+    # ------------------------------------------------------------------
+    # mode: sharing + update_sharding='zero' (reduce-scatter the grads,
+    # shard-local fused master update, all-gather compute params)
+    # ------------------------------------------------------------------
+    def _build_zero_step(self):
+        """The arXiv:2004.13336 step. Forward/backward are IDENTICAL to
+        the sequential GSPMD sharing step (same global-batch loss, so
+        masks/clipping/loss-scaling semantics carry over unchanged);
+        only the weight update changes:
+
+        1. the per-group gradients are flattened and constrained to
+           P('data') — GSPMD turns the would-be all-reduce into a
+           reduce-scatter (the paper's transformation);
+        2. each replica updates its contiguous 1/N shard of the flat
+           fp32 masters + moments — one fused Pallas pass for Adam
+           (via shard_map so the kernel sees the LOCAL shard), the
+           generic flat-updater path otherwise;
+        3. the new masters are cast to each group's COMPUTE dtype and
+           constrained back to replicated — an all-gather of
+           compute-width bytes — then sliced back into the per-layer
+           tree the next forward reads.
+        """
+        mf = self.mf
+        mesh = self.mesh
+        layout = self._zero_layout
+        policy = getattr(self.model, "_policy", None)
+        health = getattr(self.model, "_health", None) is not None
+        keys = _model_health.layer_keys(self.model) if health else None
+        shard = NamedSharding(mesh, P("data"))
+        rep = NamedSharding(mesh, P())
+        kmode = _fused.fused_update_mode()
+
+        def apply_group(grp, flat_m, flat_o, fg, step):
+            if grp.fused:
+                u = grp.updater
+                sc = _fused.adam_update_scalars(u, step)
+                if kmode in ("pallas", "interpret"):
+                    def local(sc_, p_, m_, v_, g_):
+                        return _fused.adam_segment_update(
+                            p_, m_, v_, g_, sc_, beta1=u.beta1,
+                            beta2=u.beta2, eps=u.epsilon, mode=kmode)
+
+                    nm, om, ov = shard_map(
+                        local, mesh=mesh,
+                        in_specs=(P(), P("data"), P("data"), P("data"),
+                                  P("data")),
+                        out_specs=(P("data"), P("data"), P("data")),
+                        check_rep=False)(
+                        sc, flat_m, flat_o["m"], flat_o["v"], fg)
+                else:
+                    nm, om, ov = _fused.adam_segment_update(
+                        flat_m, flat_o["m"], flat_o["v"], fg, sc,
+                        beta1=u.beta1, beta2=u.beta2, eps=u.epsilon,
+                        mode="xla")
+                return nm, {"m": om, "v": ov}
+            upd_flat, new_o = apply_updater(grp.updater, flat_o, fg,
+                                            flat_m, step)
+            return flat_m - upd_flat, new_o
+
+        def update_shards(grads, masters, opt_f, it_step, ep_step):
+            new_m, new_o, parts = {}, {}, {}
+            for grp in layout.groups:
+                fg = layout.flatten_group(grp, grads)
+                # the paper's pivot: downstream consumes only shard i
+                # on replica i, so the partitioner lowers the gradient
+                # reduction as reduce-scatter, not all-reduce
+                fg = jax.lax.with_sharding_constraint(fg, shard)
+                step = ep_step if grp.epoch_sched else it_step
+                nm, no = apply_group(grp, masters[grp.gid],
+                                     opt_f[grp.gid], fg, step)
+                nm = jax.lax.with_sharding_constraint(nm, shard)
+                if no != ():
+                    no = _tmap(lambda a: jax.lax.with_sharding_constraint(
+                        a, shard), no)
+                new_m[grp.gid], new_o[grp.gid] = nm, no
+                full = nm if jnp.dtype(grp.gather_dtype) == \
+                    jnp.dtype(grp.master_dtype) \
+                    else nm.astype(grp.gather_dtype)
+                full = jax.lax.with_sharding_constraint(full, rep)
+                layout.unflatten_group(grp, full, parts,
+                                       leaf_dtype=grp.gather_dtype)
+            return new_m, new_o, layout.assemble(parts)
+
+        if policy is not None and policy.loss_scaling:
+            def step_fn(compute, states, masters, opt_f, ls_state,
+                        it_step, ep_step, x, y, mask, fmask, rng):
+                loss_fn = lambda pl: mf.loss(pl, states, x, y, rng,
+                                             mask, fmask,
+                                             collect_acts=health)
+                ((loss, aux), grads,
+                 finite) = _precision.scaled_value_and_grad(
+                    loss_fn, ls_state, compute)
+                raw_grads = grads
+                grads = mf.clip(grads)
+                new_m, new_o, new_params = update_shards(
+                    grads, masters, opt_f, it_step, ep_step)
+                (new_params, new_m, new_o, new_states,
+                 new_ls) = _precision.guard_scaled_step(
+                    policy, ls_state, finite,
+                    [(new_params, compute), (new_m, masters),
+                     (new_o, opt_f), (aux[0], states)])
+                if health:
+                    h = _model_health.device_stats(
+                        keys, raw_grads, new_params, compute, aux[2],
+                        handled=jnp.logical_not(finite))
+                    return (new_params, new_states, new_m, new_o,
+                            new_ls, aux[1], h)
+                return (new_params, new_states, new_m, new_o, new_ls,
+                        aux[1])
+
+            return _telemetry.instrument_jit(
+                "parallel_zero_step",
+                jax.jit(step_fn, donate_argnums=(0, 1, 2, 3, 4)))
+
+        def step_fn(compute, states, masters, opt_f, it_step, ep_step,
+                    x, y, mask, fmask, rng):
+            loss_fn = lambda pl: mf.loss(pl, states, x, y, rng, mask,
+                                         fmask, collect_acts=health)
+            (loss, aux), grads = \
+                jax.value_and_grad(loss_fn, has_aux=True)(compute)
+            raw_grads = grads
+            grads = mf.clip(grads)
+            new_m, new_o, new_params = update_shards(
+                grads, masters, opt_f, it_step, ep_step)
+            if health:
+                h = _model_health.device_stats(
+                    keys, raw_grads, new_params, compute, aux[2])
+                return new_params, aux[0], new_m, new_o, aux[1], h
+            return new_params, aux[0], new_m, new_o, aux[1]
+
+        return _telemetry.instrument_jit(
+            "parallel_zero_step",
+            jax.jit(step_fn, donate_argnums=(0, 1, 2, 3)))
 
     # ------------------------------------------------------------------
     # mode: sharing_compressed (shard_map + threshold encoding)
@@ -487,12 +711,16 @@ class ShardedTrainer:
         if isinstance(data, MultiDataSetIterator):
             for _ in range(epochs):
                 for mds in data:
-                    self._fit_batch(list(mds.features), list(mds.labels))
+                    self._fit_batch(list(mds.features), list(mds.labels),
+                                    mds.labels_mask_arrays or None,
+                                    mds.features_mask_arrays or None)
                 model._epoch += 1
             return self._finish()
         if isinstance(data, MultiDataSet):
             for _ in range(epochs):
-                self._fit_batch(list(data.features), list(data.labels))
+                self._fit_batch(list(data.features), list(data.labels),
+                                data.labels_mask_arrays or None,
+                                data.features_mask_arrays or None)
             return self._finish()
         if isinstance(data, DataSetIterator):
             for _ in range(epochs):
@@ -512,38 +740,97 @@ class ShardedTrainer:
 
     def _finish(self):
         """Sync the model's canonical view of per-shard state (shard
-        0's updater moments, per the reference's per-worker trainers) —
-        done once per fit() call, not per step."""
+        0's updater moments, per the reference's per-worker trainers;
+        zero mode: gather + unflatten the sharded flat masters/opt into
+        the canonical per-layer trees) — done once per fit() call, not
+        per step."""
         model = self.model
         if self.mode == "sharing_compressed" and self._local is not None:
             p_, s_, _ = self.mf.get_trees()
             self.mf.set_trees(p_, s_, _tmap(lambda a: a[0], self._local))
+        if self.mode == "sharing" and self._zero is not None:
+            p_t, o_t = self._zero_layout.to_trees(
+                self._zero["masters"], self._zero["opt"], self.mesh)
+            _, s_, _ = self.mf.get_trees()
+            self.mf.set_trees(p_t, s_, o_t)
         return model
 
     def _stack(self, tree):
         return _tmap(lambda a: jnp.broadcast_to(
             a[None], (self._n_data,) + a.shape), tree)
 
+    def _normalize_graph_masks(self, x, y, mask, fmask):
+        """CG sharing-step mask plumbing (parity with
+        ComputationGraph._fit_batch): normalize to per-output label-mask
+        and per-input features-mask LISTS, validate features-mask
+        shapes, and apply the RNN convention (a features mask doubles
+        as the label mask for per-timestep labels with no explicit
+        label mask) on single-input/single-output graphs."""
+        from deeplearning4j_tpu.nn.masking import validate_features_mask
+
+        mf = self.mf
+        xs = x if isinstance(x, (list, tuple)) else [x]
+        ys = y if isinstance(y, (list, tuple)) else [y]
+
+        def norm(m, names, kind):
+            if m is None:
+                return [None] * len(names)
+            if not isinstance(m, (list, tuple)):
+                if len(names) != 1:
+                    raise ValueError(
+                        f"got a single {kind} for {len(names)} graph "
+                        f"arrays {names} (pass a list with None "
+                        "placeholders)")
+                return [m]
+            if len(m) != len(names):
+                raise ValueError(
+                    f"got {len(m)} {kind}s for {len(names)} graph "
+                    f"arrays {names} (use None placeholders)")
+            return list(m)
+
+        ms = norm(mask, mf._outs, "label mask")
+        fs = norm(fmask, mf._ins, "features mask")
+        if sum(1 for m in fs if m is not None) > 1:
+            raise NotImplementedError(
+                "features masks on more than one graph input are not "
+                "supported (masked-pooling attribution would be "
+                "ambiguous)")
+        fs = [None if m is None else validate_features_mask(
+                  m, xi if hasattr(xi, "ndim") else jnp.asarray(xi),
+                  ctx=f"input {n!r}")
+              for n, m, xi in zip(mf._ins, fs, xs)]
+        if len(ms) == 1 and ms[0] is None and len(fs) == 1 \
+                and fs[0] is not None:
+            y0 = ys[0]
+            if getattr(y0, "ndim", 0) == 3 and fs[0].ndim == 2 \
+                    and y0.shape[1] == fs[0].shape[1]:
+                ms[0] = fs[0]
+        if all(m is None for m in ms):
+            ms = None
+        if all(m is None for m in fs):
+            fs = None
+        return ms, fs
+
     def _fit_batch(self, x, y, mask=None, fmask=None):
         model = self.model
         mf = self.mf
         if (mask is not None or fmask is not None) \
-                and (self.mode != "sharing" or mf.is_graph):
+                and self.mode != "sharing":
             # mask arrays only thread through the jit'd GSPMD sharing
-            # step on MultiLayerNetwork models; the shard_map modes and
-            # the graph loss seam keep their historical maskless
+            # step; the shard_map modes keep their historical maskless
             # signature — warn instead of silently training on padding
             if not getattr(self, "_warned_masks", False):
                 self._warned_masks = True
                 import logging
 
                 logging.getLogger("deeplearning4j_tpu").warning(
-                    "ShardedTrainer(mode=%r%s) ignores DataSet mask "
-                    "arrays — masks are applied only in 'sharing' mode "
-                    "on MultiLayerNetwork models", self.mode,
-                    ", graph" if mf.is_graph else "")
+                    "ShardedTrainer(mode=%r) ignores DataSet mask "
+                    "arrays — masks are applied only in 'sharing' "
+                    "mode", self.mode)
             mask = fmask = None
-        if fmask is not None:
+        if mf.is_graph and (mask is not None or fmask is not None):
+            mask, fmask = self._normalize_graph_masks(x, y, mask, fmask)
+        elif fmask is not None:
             from deeplearning4j_tpu.nn.masking import (
                 validate_features_mask,
             )
@@ -587,7 +874,10 @@ class ShardedTrainer:
                 self._step = self._build_sharing_step()
                 self._sharing_steps[self._step_health] = self._step
         if self._step is None:
-            self._place_replicated()
+            if self.mode == "sharing" and self.update_sharding:
+                self._place_update_sharded()
+            else:
+                self._place_replicated()
             if self.mode == "sharing":
                 self._step = self._build_sharing_step()
                 self._step_health = hm is not None
@@ -616,7 +906,33 @@ class ShardedTrainer:
         t_step = time.perf_counter()
 
         health = None
-        if self.mode == "sharing":
+        if self.mode == "sharing" and self.update_sharding:
+            # zero: params/opt travel as the trainer's sharded flat
+            # state; the model trees get the fresh BN states per step
+            # and the canonical params/opt at _finish()
+            z = self._zero
+            if model._loss_scale_state is not None:
+                res = self._step(
+                    z["compute"], states, z["masters"], z["opt"],
+                    model._loss_scale_state, it_s, ep_s, x, y, mask,
+                    fmask, sub)
+                res, health = _model_health.split_health(
+                    res, hm is not None)
+                (z["compute"], states, z["masters"], z["opt"],
+                 model._loss_scale_state, loss) = res
+                mf.set_trees(params, states, opt)
+                model._ls_seen = _precision.record_loss_scale(
+                    "sharded", model._loss_scale_state, model._ls_seen)
+            else:
+                res = self._step(
+                    z["compute"], states, z["masters"], z["opt"], it_s,
+                    ep_s, x, y, mask, fmask, sub)
+                res, health = _model_health.split_health(
+                    res, hm is not None)
+                (z["compute"], states, z["masters"], z["opt"],
+                 loss) = res
+                mf.set_trees(params, states, opt)
+        elif self.mode == "sharing":
             if model._loss_scale_state is not None:
                 res = self._step(
                     params, states, opt, model._loss_scale_state, it_s,
@@ -667,7 +983,9 @@ class ShardedTrainer:
         _telemetry.sample_device_memory()
         if hm is not None and health is not None:
             hm.on_step(model, health, site="sharded",
-                       jit_site="parallel_sharing_step")
+                       jit_site="parallel_zero_step"
+                       if self.update_sharding
+                       else "parallel_sharing_step")
         if model._listeners:
             t_l = time.perf_counter()
             for l in model._listeners:

@@ -1,0 +1,331 @@
+"""ZeRO-style cross-replica weight-update sharding: flat master/opt
+layout + placement for ShardedTrainer's ``update_sharding='zero'``.
+
+Reference: "Automatic Cross-Replica Sharding of Weight Update in
+Data-Parallel Training" (Xu et al., arXiv:2004.13336; PAPERS.md) — in
+data-parallel training every replica redundantly applies the SAME
+weight update to the SAME fp32 masters with the SAME optimizer state.
+Sharding that work 1/N-per-replica removes the redundancy: gradients
+are reduce-scattered instead of all-reduced, each replica updates its
+contiguous shard of the flattened fp32 masters + moments, and the
+updated COMPUTE-dtype params are all-gathered back for the next
+forward. Per-replica master + optimizer memory and update-step time
+stop scaling with full replication.
+
+This module owns the LAYOUT: parameters are grouped by
+(updater config, schedule kind, master dtype, compute dtype), each
+group's leaves are flattened into one contiguous vector padded so
+every replica's shard is an aligned multiple of the f32 TPU tile
+(8x128), and the optimizer state is flattened into parallel vectors
+per state key ("m"/"v"/...). The flat layout is what makes the fused
+master-update kernel (ops/fused_update_pallas.py) a single pass.
+
+PrecisionPolicy-awareness: masters are kept at the PROMOTED master
+dtype (fp32 for f32/bf16/f16 params, f64 for double models) and the
+all-gather is performed in each layer's resolved COMPUTE dtype
+(``policy.layer_compute_dtype`` — bf16 layers gather bf16, fp32
+islands gather fp32), so the gather moves compute-width bytes, not
+master-width. Identity policies gather the original param dtype and
+are numerically transparent.
+
+Everything here is host-side layout/placement; the traced per-step
+flatten/unflatten helpers are plain jnp concat/slice that XLA folds
+into the compiled step.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deeplearning4j_tpu.learning.updaters import Adam
+from deeplearning4j_tpu.nn.multilayer.network import _uses_epoch_schedule
+
+#: shard lengths are padded to a multiple of the f32 TPU tile (8x128)
+#: so the Pallas kernel never sees a ragged block
+_TILE = 1024
+
+
+def _tmap(f, *trees):
+    return jax.tree_util.tree_map(f, *trees)
+
+
+class _Group:
+    """One contiguous flat buffer: all param leaves sharing an updater
+    config, schedule kind, master dtype and gather (compute) dtype."""
+
+    __slots__ = ("gid", "updater", "keys", "epoch_sched", "master_dtype",
+                 "gather_dtype", "treedef", "shapes", "dtypes", "sizes",
+                 "offsets", "length", "padded", "state_keys", "fused")
+
+    def __init__(self, gid, updater, epoch_sched, master_dtype,
+                 gather_dtype):
+        self.gid = gid
+        self.updater = updater
+        self.epoch_sched = epoch_sched
+        self.master_dtype = master_dtype
+        self.gather_dtype = gather_dtype
+        self.keys: List[Any] = []
+        self.state_keys: tuple = ()
+        self.fused = False
+
+
+class ZeroLayout:
+    """Flat-shard layout over a model's param/opt forest.
+
+    ``groups`` is ordered deterministically (first-seen container key);
+    per group the traced helpers below flatten gradients and unflatten
+    updated params with static offsets, so the whole layout folds into
+    the compiled step as concat/slice/reshape."""
+
+    def __init__(self, groups: List[_Group], n_shards: int,
+                 container: str, n_keys: int,
+                 empty_params: Dict[Any, Any], empty_opt: Dict[Any, Any]):
+        self.groups = groups
+        self.n_shards = n_shards
+        self.container = container   # 'list' (MLN) | 'dict' (CG)
+        self.n_keys = n_keys
+        # leafless layers (subsampling/pooling/activation): their empty
+        # param/opt subtrees pass through assembly untouched
+        self.empty_params = empty_params
+        self.empty_opt = empty_opt
+        self._gather_jit = None
+
+    # ------------------------------------------------------------ build
+    @staticmethod
+    def build(model, mf, params, opt, n_shards: int) -> "ZeroLayout":
+        keys = mf.keys(params)
+        mixed = bool(getattr(model, "_mixed", False))
+        cds = getattr(model, "_compute_dtypes", None)
+        by_key: Dict[tuple, _Group] = {}
+        groups: List[_Group] = []
+        empty_params: Dict[Any, Any] = {}
+        empty_opt: Dict[Any, Any] = {}
+        for k in keys:
+            leaves = jax.tree_util.tree_leaves(params[k])
+            if not leaves:
+                empty_params[k] = params[k]
+                empty_opt[k] = opt[k]
+                continue
+            dts = {jnp.result_type(l) for l in leaves}
+            if len(dts) != 1 or not jnp.issubdtype(
+                    next(iter(dts)), jnp.floating):
+                raise NotImplementedError(
+                    f"update_sharding requires uniform floating param "
+                    f"dtypes per layer; layer {k!r} has {dts}")
+            leaf_dt = next(iter(dts))
+            master_dt = jnp.promote_types(leaf_dt, jnp.float32)
+            gather_dt = jnp.dtype(cds[k]) if (mixed and cds is not None) \
+                else jnp.dtype(leaf_dt)
+            upd = mf.updaters[k]
+            esched = bool(_uses_epoch_schedule(upd))
+            gk = (type(upd).__name__, repr(upd), esched,
+                  str(master_dt), str(gather_dt))
+            grp = by_key.get(gk)
+            if grp is None:
+                grp = _Group(len(groups), upd, esched, master_dt,
+                             gather_dt)
+                by_key[gk] = grp
+                groups.append(grp)
+            grp.keys.append(k)
+        for grp in groups:
+            forest = [params[k] for k in grp.keys]
+            leaves, treedef = jax.tree_util.tree_flatten(forest)
+            grp.treedef = treedef
+            grp.shapes = [tuple(l.shape) for l in leaves]
+            grp.dtypes = [jnp.result_type(l) for l in leaves]
+            grp.sizes = [int(np.prod(s)) if s else 1 for s in grp.shapes]
+            grp.offsets = list(np.cumsum([0] + grp.sizes[:-1]))
+            grp.length = int(sum(grp.sizes))
+            # shard-aligned padding: full f32 tiles for real workloads;
+            # a small group pads only to 8-element shards (the fused
+            # kernel lane-pads its local segment internally) so the
+            # per-device byte gauges stay ~1/N even for tiny models
+            quantum = n_shards * _TILE
+            if grp.length < quantum:
+                quantum = n_shards * 8
+            grp.padded = max(
+                ((grp.length + quantum - 1) // quantum) * quantum,
+                quantum)
+            if grp.updater.has_state():
+                st = opt[grp.keys[0]]
+                grp.state_keys = tuple(sorted(st))
+            # the fused kernel implements exactly Adam, f32 masters
+            # only (its moment buffers are f32 — an f64 group would
+            # silently truncate its accumulators); AdamW etc. and
+            # double models take the generic flat-updater path
+            grp.fused = (type(grp.updater) is Adam
+                         and grp.state_keys == ("m", "v")
+                         and jnp.dtype(grp.master_dtype)
+                         == jnp.dtype(jnp.float32))
+        return ZeroLayout(groups, n_shards,
+                          "dict" if isinstance(params, dict) else "list",
+                          len(keys), empty_params, empty_opt)
+
+    # -------------------------------------------------------- shardings
+    def shard_spec(self, mesh: Mesh) -> NamedSharding:
+        return NamedSharding(mesh, P("data"))
+
+    def rep_spec(self, mesh: Mesh) -> NamedSharding:
+        return NamedSharding(mesh, P())
+
+    # ------------------------------------------------- traced flatten
+    def flatten_group(self, grp: _Group, tree, cast_dtype=None):
+        """Traced: concat+pad one group's leaves from a container tree
+        (grads or params) into its flat vector."""
+        dt = cast_dtype or grp.master_dtype
+        flats = []
+        for k in grp.keys:
+            for l in jax.tree_util.tree_leaves(tree[k]):
+                flats.append(jnp.ravel(l).astype(dt))
+        pad = grp.padded - grp.length
+        if pad:
+            flats.append(jnp.zeros((pad,), dt))
+        return jnp.concatenate(flats)
+
+    def unflatten_group(self, grp: _Group, flat, out: Dict[Any, Any],
+                        leaf_dtype=None):
+        """Traced: slice one group's flat vector back into per-key
+        subtrees, writing them into ``out`` (container-key -> subtree).
+        ``leaf_dtype=None`` restores each leaf's ORIGINAL dtype."""
+        leaves = []
+        for sh, dt, off, size in zip(grp.shapes, grp.dtypes,
+                                     grp.offsets, grp.sizes):
+            tgt = leaf_dtype or dt
+            leaves.append(flat[off:off + size].reshape(sh).astype(tgt))
+        forest = jax.tree_util.tree_unflatten(grp.treedef, leaves)
+        for k, sub in zip(grp.keys, forest):
+            out[k] = sub
+
+    def assemble(self, out: Dict[Any, Any], empties=None):
+        """Container-kind assembly of per-key subtrees; ``empties``
+        (default: the leafless param subtrees) fills the keys no group
+        owns."""
+        for k, sub in (self.empty_params if empties is None
+                       else empties).items():
+            out.setdefault(k, sub)
+        if self.container == "dict":
+            return out
+        return [out[i] for i in range(self.n_keys)]
+
+    # ------------------------------------------------- host placement
+    def _put(self, host: np.ndarray, sharding: NamedSharding):
+        # make_array_from_callback is single- AND multi-process safe
+        # (each process materializes only its addressable shards)
+        return jax.make_array_from_callback(
+            host.shape, sharding, lambda idx: host[idx])
+
+    def _flat_host(self, grp: _Group, tree) -> np.ndarray:
+        parts = [np.asarray(l).ravel()
+                 for k in grp.keys
+                 for l in jax.tree_util.tree_leaves(tree[k])]
+        flat = np.concatenate(parts).astype(grp.master_dtype)
+        out = np.zeros((grp.padded,), grp.master_dtype)
+        out[:grp.length] = flat
+        return out
+
+    def place(self, params, opt, mesh: Mesh):
+        """Build the device state for the zero step from the model's
+        canonical trees: sharded flat masters, sharded flat opt state,
+        and the replicated compute-dtype param tree the forward reads.
+        Restoring a checkpoint saved on a DIFFERENT replica count goes
+        through exactly this path — the canonical trees are topology-
+        free, so re-sharding is just re-placement."""
+        shard = self.shard_spec(mesh)
+        rep = self.rep_spec(mesh)
+        masters, opt_f, computed = {}, {}, {}
+        for grp in self.groups:
+            host = self._flat_host(grp, params)
+            masters[grp.gid] = self._put(host, shard)
+            if grp.state_keys:
+                opt_f[grp.gid] = {
+                    sk: self._put(self._flat_host(
+                        grp, {k: opt[k][sk] for k in grp.keys}), shard)
+                    for sk in grp.state_keys}
+            else:
+                opt_f[grp.gid] = ()
+            for k in grp.keys:
+                computed[k] = _tmap(
+                    lambda l, g=grp: self._put(
+                        np.asarray(l).astype(g.gather_dtype), rep),
+                    params[k])
+        return masters, opt_f, self.assemble(computed)
+
+    # --------------------------------------------- canonical-tree sync
+    def to_trees(self, masters, opt_f, mesh: Mesh):
+        """Gather the sharded flat state back into canonical per-layer
+        trees (original leaf dtypes) — the fit-exit/_finish sync and
+        the checkpoint path. The gather is one tiny compiled identity
+        with replicated out_shardings, which is multi-host safe (a
+        plain np.asarray of a cross-process sharded array is not)."""
+        if self._gather_jit is None:
+            rep = self.rep_spec(mesh)
+            self._gather_jit = jax.jit(lambda a: a, out_shardings=rep)
+        params_out: Dict[Any, Any] = {}
+        opt_out: Dict[Any, Any] = {}
+        for grp in self.groups:
+            full = self._gather_jit(masters[grp.gid])
+            self.unflatten_group(grp, full, params_out)
+            if grp.state_keys:
+                per_sk = {}
+                for sk in grp.state_keys:
+                    sub: Dict[Any, Any] = {}
+                    self.unflatten_group(
+                        grp, self._gather_jit(opt_f[grp.gid][sk]), sub,
+                        leaf_dtype=grp.master_dtype)
+                    per_sk[sk] = sub
+                for k in grp.keys:
+                    opt_out[k] = {sk: per_sk[sk][k]
+                                  for sk in grp.state_keys}
+            else:
+                for k in grp.keys:
+                    opt_out[k] = ()
+        return (self.assemble(params_out),
+                self.assemble(opt_out, empties=self.empty_opt))
+
+    # ---------------------------------------------------- byte ledger
+    def master_bytes_per_device(self) -> int:
+        return sum((g.padded // self.n_shards)
+                   * jnp.dtype(g.master_dtype).itemsize
+                   for g in self.groups)
+
+    def opt_bytes_per_device(self) -> int:
+        return sum(len(g.state_keys) * (g.padded // self.n_shards)
+                   * jnp.dtype(g.master_dtype).itemsize
+                   for g in self.groups)
+
+    # ------------------------------------------------ addressable dump
+    def addressable_shards(self, masters, opt_f) -> Dict[str, np.ndarray]:
+        """This process's addressable shard data, keyed
+        ``masters/<gid>@<device_id>`` / ``opt/<gid>/<sk>@<device_id>``
+        — the per-host members of a shard-aware resume bundle."""
+        out: Dict[str, np.ndarray] = {}
+        for grp in self.groups:
+            for sh in masters[grp.gid].addressable_shards:
+                out[f"masters/{grp.gid}@{sh.device.id}"] = \
+                    np.asarray(sh.data)
+            if grp.state_keys:
+                for sk in grp.state_keys:
+                    for sh in opt_f[grp.gid][sk].addressable_shards:
+                        out[f"opt/{grp.gid}/{sk}@{sh.device.id}"] = \
+                            np.asarray(sh.data)
+        return out
+
+
+def replicated_state_bytes(params, opt) -> tuple:
+    """(master_bytes, opt_bytes) of the fully-replicated trees — the
+    per-device cost of the default sharing step, for the same gauges
+    the zero path reports (so the 1/N win is a measured ratio)."""
+    def nbytes(tree):
+        total = 0
+        for l in jax.tree_util.tree_leaves(tree):
+            if hasattr(l, "dtype") and jnp.issubdtype(
+                    jnp.result_type(l), jnp.floating):
+                total += int(np.prod(l.shape or (1,))) \
+                    * jnp.dtype(l.dtype).itemsize
+        return total
+    return nbytes(params), nbytes(opt)

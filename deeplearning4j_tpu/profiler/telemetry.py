@@ -78,6 +78,9 @@ TRANSFER_RETRIES = "dl4j_tpu_transfer_retries_total"
 TRANSFER_QUARANTINES = "dl4j_tpu_transfer_quarantined_batches_total"
 WATCHDOG_STALLS = "dl4j_tpu_watchdog_stalls_total"
 CHAOS_INJECTED = "dl4j_tpu_chaos_injected_total"
+#: cross-replica update sharding (parallel/zero.py)
+MASTER_PARAM_BYTES = "dl4j_tpu_master_param_bytes"
+OPT_STATE_BYTES = "dl4j_tpu_opt_state_bytes"
 #: in-step model health (profiler/model_health.py)
 LAYER_GRAD_NORM = "dl4j_tpu_layer_grad_norm"
 LAYER_PARAM_NORM = "dl4j_tpu_layer_param_norm"
@@ -394,6 +397,23 @@ def record_on_device_batch(site: str) -> None:
         "skipped the fit loop's host->device copy").inc(site=site)
 
 
+def record_state_bytes(master_bytes: int, opt_bytes: int, mode: str,
+                       site: str = "sharded") -> None:
+    """Per-device fp32-master and optimizer-state byte gauges, labelled
+    by sharding mode ('replicated' vs 'update_sharded') — set once at
+    trainer placement time, so the 1/N memory win of update sharding is
+    a measured number on /telemetry, not a claim."""
+    if not _ENABLED:
+        return
+    reg = MetricsRegistry.get_default()
+    reg.gauge(MASTER_PARAM_BYTES,
+              "per-device bytes of master (update-precision) params"
+              ).set(master_bytes, mode=mode, site=site)
+    reg.gauge(OPT_STATE_BYTES,
+              "per-device bytes of optimizer (updater) state"
+              ).set(opt_bytes, mode=mode, site=site)
+
+
 def timed_batches(iterable):
     """Iterate, recording time blocked on ``next()`` as the
     ``etl_wait`` phase — the one ETL-timing loop every fit front-end
@@ -658,6 +678,14 @@ def snapshot() -> Dict[str, Any]:
     health = model_health_snapshot()
     if health:
         out["model_health"] = health
+    state_bytes = {}
+    for key, name in (("master_param_bytes", MASTER_PARAM_BYTES),
+                      ("opt_state_bytes", OPT_STATE_BYTES)):
+        m = reg.peek(name)
+        if m is not None:
+            state_bytes[key] = m._json()
+    if state_bytes:
+        out["state_bytes"] = state_bytes
     return out
 
 
@@ -698,6 +726,7 @@ __all__ = [
     "instrument_jit", "sample_device_memory", "snapshot",
     "model_health_snapshot", "reset",
     "enabled", "set_enabled", "record_on_device_batch",
+    "record_state_bytes", "MASTER_PARAM_BYTES", "OPT_STATE_BYTES",
     "JIT_COMPILES", "JIT_COMPILE_SECONDS", "STEP_PHASE_SECONDS",
     "DEVICE_BYTES_IN_USE", "DEVICE_PEAK_BYTES",
     "PREFETCH_QUEUE_DEPTH", "TRANSFER_OVERLAP_MS",

@@ -91,8 +91,50 @@ def _sha256(path: str) -> str:
     return h.hexdigest()
 
 
+def _mesh_topology(trainer) -> Optional[Dict[str, Any]]:
+    """Mesh topology record for the bundle manifest: replica counts +
+    process count + update-sharding mode. Restore compares it against
+    the restoring trainer's mesh so a topology change is LOGGED (the
+    re-shard itself is automatic: zero-mode state rebuilds from the
+    canonical trees, which are replica-count-free)."""
+    if trainer is None or getattr(trainer, "mesh", None) is None:
+        return None
+    import jax
+
+    return {
+        "data": int(trainer.mesh.shape.get("data", 1)),
+        "model": int(trainer.mesh.shape.get("model", 1)),
+        "processes": int(jax.process_count()),
+        "mode": trainer.mode,
+        "update_sharding": getattr(trainer, "update_sharding", None),
+    }
+
+
+def _write_zero_shards(tmp: str, trainer) -> Optional[str]:
+    """Zero mode: each host additionally writes ITS addressable master/
+    opt flat shards (``zero_shards_p<process>.npz``) — checkpoint
+    bandwidth scales with hosts, no host materializes state it does
+    not own. The canonical model.zip stays the topology-free restore
+    source; the shard file carries the exact device-level layout for
+    same-topology forensics/restore."""
+    z = getattr(trainer, "_zero", None)
+    layout = getattr(trainer, "_zero_layout", None)
+    if z is None or layout is None:
+        return None
+    import jax
+
+    member = f"zero_shards_p{jax.process_index()}.npz"
+    shards = layout.addressable_shards(z["masters"], z["opt"])
+    path = os.path.join(tmp, member)
+    with open(path, "wb") as f:
+        np.savez(f, **shards)
+        f.flush()
+        os.fsync(f.fileno())
+    return member
+
+
 def write_bundle(directory: str, model, resume_meta: Dict[str, Any],
-                 keep_last: int = 2) -> str:
+                 keep_last: int = 2, trainer=None) -> str:
     """Write one atomic resumable bundle under ``directory`` and prune
     to the newest ``keep_last``. Layout::
 
@@ -100,7 +142,10 @@ def write_bundle(directory: str, model, resume_meta: Dict[str, Any],
             model.zip      ModelSerializer archive (params + updater +
                            loss-scale + iteration/epoch)
             resume.json    RNG key, iterator position, epochs remaining
-            manifest.json  sha256 digests of the two members
+            manifest.json  sha256 digests of the members + the mesh
+                           topology the bundle was saved under
+            zero_shards_p<i>.npz   (update-sharded trainers only) this
+                           host's addressable master/opt flat shards
 
     Atomicity: everything is written into a writer-unique temp
     directory, each file fsynced, then the directory is renamed into
@@ -140,11 +185,16 @@ def write_bundle(directory: str, model, resume_meta: Dict[str, Any],
         ModelSerializer.writeModel(model, os.path.join(tmp, "model.zip"))
         _write_member("resume.json", dict(resume_meta,
                                           format=_RESUME_FORMAT))
+        members = ["model.zip", "resume.json"]
+        zmember = _write_zero_shards(tmp, trainer)
+        if zmember is not None:
+            members.append(zmember)
         _write_member("manifest.json", {
             "format": _RESUME_FORMAT,
             "iteration": iteration,
+            "mesh": _mesh_topology(trainer),
             "digests": {m: _sha256(os.path.join(tmp, m))
-                        for m in ("model.zip", "resume.json")},
+                        for m in members},
         })
         fsync_directory(tmp)
         os.replace(tmp, final)
@@ -448,7 +498,10 @@ class _FitAdapter:
         if self.trainer is not None:
             if isinstance(batch, MultiDataSet):
                 self.trainer._fit_batch(list(batch.features),
-                                        list(batch.labels))
+                                        list(batch.labels),
+                                        batch.labels_mask_arrays or None,
+                                        batch.features_mask_arrays
+                                        or None)
             else:
                 self.trainer._fit_batch(batch.features, batch.labels,
                                         batch.labels_mask,
@@ -483,20 +536,28 @@ class _FitAdapter:
     def invalidate_trainer_state(self) -> None:
         """After a bundle restore, a REUSED ShardedTrainer's per-shard
         replicas (averaging/compressed `_local`, `_residual`,
-        `_thresholds`) still hold pre-restore values — drop them (and
-        the compiled step, whose rebuild path re-derives them from the
-        restored model trees). 'sharing' mode keeps all state in the
-        model trees, so a trainer with none built stays untouched and
-        pays no recompile."""
+        `_thresholds`; zero-mode `_zero` flat masters/opt) still hold
+        pre-restore values — drop them (and the compiled step, whose
+        rebuild path re-derives them from the restored model trees —
+        for zero mode that re-flatten IS the topology re-shard: the
+        trees are replica-count-free, so a bundle saved on an 8-way
+        mesh restores onto a 4-way trainer by re-placement). 'sharing'
+        without update sharding keeps all state in the model trees, so
+        a trainer with none built stays untouched and pays no
+        recompile."""
         t = self.trainer
         if t is None:
             return
         if getattr(t, "_local", None) is not None \
-                or getattr(t, "_residual", None) is not None:
+                or getattr(t, "_residual", None) is not None \
+                or getattr(t, "_zero", None) is not None:
             t._step = None
+            t._sharing_steps = {}
             t._local = None
             t._residual = None
             t._thresholds = None
+            t._zero = None
+            t._zero_layout = None
 
     # ------------------------------------------------- snapshot/restore
     def _trees(self):
@@ -529,7 +590,7 @@ class _FitAdapter:
             snap["ls"] = cp(m._loss_scale_state)
             snap["ls_seen"] = m._ls_seen
         if self.trainer is not None:
-            for name in ("_residual", "_thresholds", "_local"):
+            for name in ("_residual", "_thresholds", "_local", "_zero"):
                 v = getattr(self.trainer, name, None)
                 if v is not None:
                     snap[name] = cp(v)
@@ -557,7 +618,7 @@ class _FitAdapter:
             m._loss_scale_state = cp(snap["ls"])
             m._ls_seen = snap["ls_seen"]
         if self.trainer is not None:
-            for name in ("_residual", "_thresholds", "_local"):
+            for name in ("_residual", "_thresholds", "_local", "_zero"):
                 if name in snap:
                     setattr(self.trainer, name, cp(snap[name]))
 
@@ -701,7 +762,7 @@ def _write_preemption_checkpoint(ft: FaultTolerance, adapter: _FitAdapter,
         "wall_time": time.time(),
     }
     path = write_bundle(ft.checkpoint_dir, adapter.model, meta,
-                        keep_last=ft.keep_last)
+                        keep_last=ft.keep_last, trainer=adapter.trainer)
     if _telemetry.enabled():
         _telemetry.MetricsRegistry.get_default().counter(
             _telemetry.FT_PREEMPTION_CHECKPOINTS,
@@ -722,6 +783,27 @@ def _restore_bundle(adapter: _FitAdapter, path: str) -> Dict[str, Any]:
 
     with open(os.path.join(path, "resume.json")) as f:
         resume = json.load(f)
+    try:
+        with open(os.path.join(path, "manifest.json")) as f:
+            saved_mesh = json.load(f).get("mesh")
+    except (OSError, ValueError):
+        saved_mesh = None
+    now_mesh = _mesh_topology(adapter.trainer)
+    if saved_mesh and now_mesh and (
+            saved_mesh.get("data") != now_mesh.get("data")
+            or saved_mesh.get("processes") != now_mesh.get("processes")):
+        # topology change (elastic resume): the canonical trees in
+        # model.zip are replica-count-free; the trainer re-shards them
+        # onto ITS mesh at the next step build (see
+        # invalidate_trainer_state)
+        log.warning(
+            "resilience: bundle was saved on a %(od)s-replica/"
+            "%(op)s-process mesh, restoring onto %(nd)s-replica/"
+            "%(np)s-process — master/opt state will be re-sharded "
+            "from the canonical trees",
+            {"od": saved_mesh.get("data"),
+             "op": saved_mesh.get("processes"),
+             "nd": now_mesh.get("data"), "np": now_mesh.get("processes")})
     ModelSerializer.loadInto(adapter.model, os.path.join(path, "model.zip"))
     adapter.model._rng_key = jax.random.wrap_key_data(
         jnp.asarray(np.asarray(resume["rng"], np.uint32)))

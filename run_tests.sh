@@ -448,4 +448,190 @@ if [ $gateA -ne 0 ] || [ $gateB -ne 0 ] || [ $gateC -ne 0 ]; then
     echo "FATAL: chaos smoke gate regressed (A=$gateA B=$gateB C=$gateC)" >&2
     exit 1
 fi
+
+# Update-sharding smoke gate (docs/SHARDING.md): on an 8-device CPU
+# mesh, the ZeRO-style sharing step (update_sharding='zero') must
+# (a) match the replicated sharing step's fit loss within tolerance,
+# (b) actually shard the fp32 masters + Adam moments — placement
+# asserted through the new per-device byte gauges AND the arrays'
+# shardings — and (c) survive a REAL chaos SIGTERM mid-fit, then
+# auto-resume on a DIFFERENT device count (8-way save -> 4-way resume)
+# with bit-equal re-sharded moments and an exact total step count.
+ZERO_DIR=$(mktemp -d /tmp/dl4j_zero_gate.XXXXXX)
+export DL4J_TPU_ZERO_GATE_DIR="$ZERO_DIR"
+cat > "$ZERO_DIR/zero_gate_common.py" <<'EOF'
+import numpy as np
+
+from deeplearning4j_tpu.learning import Adam
+from deeplearning4j_tpu.datasets import ArrayDataSetIterator
+from deeplearning4j_tpu.nn.conf import (
+    DenseLayer, InputType, NeuralNetConfiguration, OutputLayer,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+rng = np.random.default_rng(0)
+x = rng.normal(size=(64, 6)).astype(np.float32)
+y = np.eye(2, dtype=np.float32)[(x.sum(1) > 0).astype(int)]
+
+
+def make():
+    return MultiLayerNetwork(
+        (NeuralNetConfiguration.builder().seed(11)
+         .updater(Adam(learning_rate=0.01)).list()
+         .layer(DenseLayer(n_out=16, activation="tanh"))
+         .layer(OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+         .setInputType(InputType.feedForward(6)).build()))
+
+
+def it():
+    return ArrayDataSetIterator(x, y, 8, shuffle=True, seed=5)
+EOF
+env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu DL4J_TPU_TELEMETRY=1 \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    PYTHONPATH="$ZERO_DIR" python - <<'EOF'
+# phase Z1: parity + sharded placement via the byte gauges
+import sys
+
+import jax
+import numpy as np
+
+from zero_gate_common import it, make
+from deeplearning4j_tpu.parallel.mesh import build_mesh
+from deeplearning4j_tpu.parallel.sharded import ShardedTrainer
+from deeplearning4j_tpu.profiler import telemetry
+
+mesh = build_mesh(num_data=8)
+fail = []
+a = make(); a.init()
+ta = ShardedTrainer(a, mesh=mesh, mode="sharing")
+b = make(); b.init()
+tb = ShardedTrainer(b, mesh=mesh, mode="sharing", update_sharding="zero")
+for _ in range(2):
+    ta.fit(it(), epochs=1)
+    tb.fit(it(), epochs=1)
+la, lb = float(a.score()), float(b.score())
+if not np.isfinite(lb) or abs(la - lb) / abs(la) > 1e-3:
+    fail.append(f"zero loss {lb:.6f} deviates from replicated {la:.6f}")
+reg = telemetry.MetricsRegistry.get_default()
+mg = reg.gauge(telemetry.MASTER_PARAM_BYTES)
+og = reg.gauge(telemetry.OPT_STATE_BYTES)
+m_rep = mg.value(mode="replicated", site="sharded")
+m_z = mg.value(mode="update_sharded", site="sharded")
+o_rep = og.value(mode="replicated", site="sharded")
+o_z = og.value(mode="update_sharded", site="sharded")
+if not (m_rep > 0 and 0 < m_z < m_rep / 4):
+    fail.append(f"master byte gauges not ~1/8: replicated={m_rep} "
+                f"sharded={m_z}")
+if not (o_rep > 0 and 0 < o_z < o_rep / 4):
+    fail.append(f"opt byte gauges not ~1/8: replicated={o_rep} "
+                f"sharded={o_z}")
+flat = next(iter(tb._zero["masters"].values()))
+if flat.addressable_shards[0].data.shape[0] != flat.shape[0] // 8:
+    fail.append("flat masters are NOT sharded 1/8 per device: "
+                f"{flat.sharding}")
+if fail:
+    sys.stderr.write("zero gate Z1 FAILED:\n  " + "\n  ".join(fail) + "\n")
+    sys.exit(1)
+print(f"zero gate Z1 OK: loss parity {la:.5f}/{lb:.5f}, master bytes "
+      f"{m_rep:.0f}->{m_z:.0f}, opt bytes {o_rep:.0f}->{o_z:.0f}")
+EOF
+gateZ1=$?
+env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu DL4J_TPU_TELEMETRY=1 \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    DL4J_TPU_CHAOS=1 DL4J_TPU_CHAOS_PREEMPT_AT=7 DL4J_TPU_CHAOS_SEED=3 \
+    PYTHONPATH="$ZERO_DIR" python - <<'EOF'
+# phase Z2: chaos SIGTERM mid-fit on the 8-way zero trainer -> bundle
+import json
+import os
+import sys
+
+import jax
+import numpy as np
+
+from zero_gate_common import it, make
+from deeplearning4j_tpu.parallel.mesh import build_mesh
+from deeplearning4j_tpu.parallel.sharded import ShardedTrainer
+from deeplearning4j_tpu.profiler import telemetry
+from deeplearning4j_tpu.util import FaultTolerance
+from deeplearning4j_tpu.util.resilience import latest_valid_bundle
+
+d = os.environ["DL4J_TPU_ZERO_GATE_DIR"]
+net = make(); net.init()
+tr = ShardedTrainer(net, mesh=build_mesh(num_data=8), mode="sharing",
+                    update_sharding="zero")
+tr.fit(it(), epochs=3,
+       fault_tolerance=FaultTolerance(checkpoint_dir=d,
+                                      divergence_window=0))
+bundle = latest_valid_bundle(d)
+fail = []
+if bundle is None:
+    fail.append("no valid bundle after chaos SIGTERM")
+else:
+    man = json.load(open(os.path.join(bundle, "manifest.json")))
+    if man.get("mesh", {}).get("data") != 8 \
+            or man["mesh"].get("update_sharding") != "zero":
+        fail.append(f"manifest mesh wrong: {man.get('mesh')}")
+    if not any(m.startswith("zero_shards_p") for m in man["digests"]):
+        fail.append("bundle carries no per-host zero shard file")
+reg = telemetry.MetricsRegistry.get_default()
+if reg.counter(telemetry.FT_PREEMPTION_CHECKPOINTS).total() != 1:
+    fail.append("preemption checkpoint counter != 1")
+if fail:
+    sys.stderr.write("zero gate Z2 FAILED:\n  " + "\n  ".join(fail) + "\n")
+    sys.exit(1)
+with open(os.path.join(d, "z2.json"), "w") as f:
+    json.dump({"iteration": net.getIterationCount()}, f)
+print(f"zero gate Z2 OK: SIGTERM at iteration {net.getIterationCount()},"
+      " shard-aware bundle written")
+EOF
+gateZ2=$?
+env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu DL4J_TPU_TELEMETRY=1 \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    PYTHONPATH="$ZERO_DIR" python - <<'EOF'
+# phase Z3: auto-resume the preempted job on a DIFFERENT device count
+import json
+import os
+import sys
+
+import jax
+import numpy as np
+
+from zero_gate_common import it, make
+from deeplearning4j_tpu.parallel.mesh import build_mesh
+from deeplearning4j_tpu.parallel.sharded import ShardedTrainer
+from deeplearning4j_tpu.profiler import telemetry
+from deeplearning4j_tpu.util import FaultTolerance
+
+d = os.environ["DL4J_TPU_ZERO_GATE_DIR"]
+z2 = json.load(open(os.path.join(d, "z2.json")))
+net = make(); net.init()
+tr = ShardedTrainer(net, mesh=build_mesh(num_data=4,
+                                         devices=jax.devices()[:4]),
+                    mode="sharing", update_sharding="zero")
+tr.fit(it(), epochs=3,
+       fault_tolerance=FaultTolerance(checkpoint_dir=d,
+                                      divergence_window=0))
+fail = []
+reg = telemetry.MetricsRegistry.get_default()
+if reg.counter(telemetry.FT_AUTO_RESUMES).total() != 1:
+    fail.append("run did not auto-resume from the bundle")
+# 3 epochs x 8 batches = 24 total steps across both incarnations
+if net.getIterationCount() != 24:
+    fail.append(f"resumed run ended at iteration "
+                f"{net.getIterationCount()}, expected 24")
+if not np.isfinite(float(net.score())):
+    fail.append(f"non-finite final loss {float(net.score())}")
+if fail:
+    sys.stderr.write("zero gate Z3 FAILED:\n  " + "\n  ".join(fail) + "\n")
+    sys.exit(1)
+print(f"zero gate Z3 OK: resumed from iteration {z2['iteration']} on a "
+      f"4-way mesh, finished at {net.getIterationCount()}, loss "
+      f"{float(net.score()):.5f}")
+EOF
+gateZ3=$?
+rm -rf "$ZERO_DIR"
+if [ $gateZ1 -ne 0 ] || [ $gateZ2 -ne 0 ] || [ $gateZ3 -ne 0 ]; then
+    echo "FATAL: update-sharding smoke gate regressed (Z1=$gateZ1 Z2=$gateZ2 Z3=$gateZ3)" >&2
+    exit 1
+fi
 exit $rc

@@ -731,3 +731,108 @@ class TestChaosHarness:
         assert np.isnan(np.asarray(poisoned.features)).all()
         # the original batch is never mutated
         assert np.isfinite(np.asarray(ds.features)).all()
+
+
+class TestZeroTopologyResume:
+    """Shard-aware bundles (update_sharding='zero'): a preemption
+    bundle saved on an 8-way mesh records the mesh topology + this
+    host's master/opt flat shards, and restores onto 4-way and 1-way
+    trainers with Adam moments BIT-EQUAL after the re-shard (the
+    canonical trees in model.zip are replica-count-free; placement
+    re-flattens them onto whatever mesh the restoring trainer has)."""
+
+    def _zero_net(self):
+        return small_net(seed=21)
+
+    def _mesh(self, n):
+        from deeplearning4j_tpu.parallel.mesh import build_mesh
+
+        return build_mesh(num_data=n, devices=jax.devices()[:n])
+
+    def test_topology_change_resume_8_to_4_and_1(self, tmp_path):
+        import json
+
+        from deeplearning4j_tpu.parallel.sharded import ShardedTrainer
+        from deeplearning4j_tpu.util.model_serializer import (
+            ModelSerializer,
+        )
+
+        d = str(tmp_path)
+        net = self._zero_net()
+        tr = ShardedTrainer(net, mesh=self._mesh(8), mode="sharing",
+                            update_sharding="zero")
+        ft = FaultTolerance(checkpoint_dir=d, divergence_window=0)
+
+        class Stop:
+            def __init__(self):
+                self.n = 0
+
+            def iterationDone(self, m, i, e):
+                self.n += 1
+                if self.n == 5:
+                    ft.request_preemption()
+
+        net.setListeners(Stop())
+        tr.fit(make_iter(), epochs=3, fault_tolerance=ft)
+        bundle = resilience.latest_valid_bundle(d)
+        assert bundle is not None
+        net.setListeners()
+
+        # manifest records the mesh topology + the host's shard file
+        with open(os.path.join(bundle, "manifest.json")) as f:
+            man = json.load(f)
+        assert man["mesh"]["data"] == 8
+        assert man["mesh"]["update_sharding"] == "zero"
+        zmember = [m for m in man["digests"]
+                   if m.startswith("zero_shards_p")]
+        assert zmember, man["digests"]
+        shards = np.load(os.path.join(bundle, zmember[0]))
+        assert any(k.startswith("masters/") for k in shards.files)
+        assert any(k.startswith("opt/") for k in shards.files)
+
+        saved = leaves(net.params_list, net.opt_states)
+
+        # re-shard bit-equality on BOTH smaller topologies: restore the
+        # bundle, place the zero state on the new mesh, gather it back
+        for n in (4, 1):
+            net2 = self._zero_net()
+            ModelSerializer.loadInto(
+                net2, os.path.join(bundle, "model.zip"))
+            tr2 = ShardedTrainer(net2, mesh=self._mesh(n),
+                                 mode="sharing", update_sharding="zero")
+            tr2._place_update_sharded()
+            tr2._finish()
+            for a, b in zip(saved,
+                            leaves(net2.params_list, net2.opt_states)):
+                np.testing.assert_array_equal(a, b)
+
+        # full auto-resume on the 4-way mesh finishes the job: 3 epochs
+        # x 6 batches = 18 total iterations across both incarnations
+        net3 = self._zero_net()
+        tr3 = ShardedTrainer(net3, mesh=self._mesh(4), mode="sharing",
+                             update_sharding="zero")
+        tr3.fit(make_iter(), epochs=3,
+                fault_tolerance=FaultTolerance(checkpoint_dir=d,
+                                               divergence_window=0))
+        assert net3.getIterationCount() == 18
+        assert np.isfinite(float(net3.score()))
+
+    def test_divergence_rollback_restores_zero_state(self, tmp_path):
+        """The in-memory rollback snapshot covers the trainer's _zero
+        flat state: a NaN batch mid-fit rolls back and training
+        continues to a finite loss."""
+        from deeplearning4j_tpu.parallel.sharded import ShardedTrainer
+
+        net = self._zero_net()
+        tr = ShardedTrainer(net, mesh=self._mesh(8), mode="sharing",
+                            update_sharding="zero")
+        ft = FaultTolerance(divergence_window=6, snapshot_every=2,
+                            min_history=2)
+        sets = [DataSet(X[i:i + 8], Y[i:i + 8]) for i in range(0, 40, 8)]
+        bad = DataSet(np.full_like(X[:8], np.nan), Y[:8])
+        sets.insert(3, bad)
+        reg = telemetry.MetricsRegistry.get_default()
+        before = reg.counter(telemetry.FT_ROLLBACKS).total()
+        tr.fit(ListDataSetIterator(sets), epochs=1, fault_tolerance=ft)
+        assert reg.counter(telemetry.FT_ROLLBACKS).total() == before + 1
+        assert np.isfinite(float(net.score()))

@@ -1,0 +1,362 @@
+"""ZeRO-style cross-replica weight-update sharding
+(parallel/zero.py + ShardedTrainer update_sharding='zero' +
+ops/fused_update_pallas.py; arXiv:2004.13336):
+
+- loss-trajectory parity with the replicated sharing step (f32 exact,
+  mixed policies within the precision-smoke tolerance)
+- 1/N per-device master/opt byte gauges
+- fused Adam+unscale+clip kernel golden test vs the composed
+  updaters reference at step 300 (XLA fallback + Pallas interpreter)
+- CG sharing-mode mask threading (the PR 2 mask gap)
+- mixed per-layer updaters (multi-group flat layout)
+"""
+
+import logging
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets import DataSet
+from deeplearning4j_tpu.learning.updaters import Adam, Nesterovs, Sgd
+from deeplearning4j_tpu.nn.conf import (
+    DenseLayer, InputType, LSTM, NeuralNetConfiguration, OutputLayer,
+)
+from deeplearning4j_tpu.nn.multilayer.network import MultiLayerNetwork
+from deeplearning4j_tpu.ops.fused_update_pallas import (
+    fused_master_update, fused_update_mode,
+)
+from deeplearning4j_tpu.ops.registry import get_op
+from deeplearning4j_tpu.parallel.mesh import (
+    build_mesh, maybe_init_distributed,
+)
+from deeplearning4j_tpu.parallel.sharded import ShardedTrainer
+from deeplearning4j_tpu.profiler import telemetry
+
+
+def small_net(updater=None, precision=None, per_layer_updater=None,
+              seed=11):
+    b = NeuralNetConfiguration.builder().seed(seed).updater(
+        updater or Adam(1e-2))
+    if precision:
+        b = b.precision(precision)
+    conf = (b.list()
+            .layer(DenseLayer(n_out=16, activation="tanh",
+                              updater=per_layer_updater))
+            .layer(OutputLayer(n_out=2, activation="softmax",
+                               loss="mcxent"))
+            .setInputType(InputType.feedForward(6)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+def toy_data(n=32, seed=0):
+    rs = np.random.RandomState(seed)
+    x = rs.randn(n, 6).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[(x.sum(1) > 0).astype(int)]
+    return x, y
+
+
+X, Y = toy_data()
+MESH = None
+
+
+def mesh8():
+    global MESH
+    if MESH is None:
+        MESH = build_mesh(num_data=8)
+    return MESH
+
+
+def fit_pair(steps=8, **kw):
+    """(replicated_model, zero_model) after identical fits."""
+    a = small_net(**kw)
+    ta = ShardedTrainer(a, mesh=mesh8(), mode="sharing")
+    b = small_net(**kw)
+    tb = ShardedTrainer(b, mesh=mesh8(), mode="sharing",
+                        update_sharding="zero")
+    ds = DataSet(X, Y)
+    for _ in range(steps):
+        ta.fit(ds)
+        tb.fit(ds)
+    return a, b
+
+
+class TestZeroParity:
+    def test_adam_f32_matches_replicated(self):
+        a, b = fit_pair()
+        la, lb = float(a.score()), float(b.score())
+        assert abs(la - lb) / abs(la) < 1e-5, (la, lb)
+        # canonical trees synced at fit exit: params AND Adam moments
+        for u, v in zip(
+                jax.tree_util.tree_leaves((a.params_list, a.opt_states)),
+                jax.tree_util.tree_leaves((b.params_list, b.opt_states))):
+            np.testing.assert_allclose(np.asarray(u), np.asarray(v),
+                                       rtol=5e-4, atol=1e-6)
+
+    def test_generic_updater_path(self):
+        """Non-Adam updaters take the generic flat-updater path."""
+        a, b = fit_pair(updater=Nesterovs(0.05))
+        la, lb = float(a.score()), float(b.score())
+        assert abs(la - lb) / abs(la) < 1e-5, (la, lb)
+
+    def test_mixed_per_layer_updaters_multi_group(self):
+        """A per-layer updater override splits the flat layout into
+        multiple groups (fused Adam + generic Sgd) — parity holds."""
+        a, b = fit_pair(per_layer_updater=Sgd(0.05))
+        la, lb = float(a.score()), float(b.score())
+        assert abs(la - lb) / abs(la) < 1e-4, (la, lb)
+        tb_layout = None  # layout introspection via a fresh trainer
+        net = small_net(per_layer_updater=Sgd(0.05))
+        tr = ShardedTrainer(net, mesh=mesh8(), mode="sharing",
+                            update_sharding="zero")
+        tr.fit(DataSet(X, Y))
+        tb_layout = tr._zero_layout
+        assert len(tb_layout.groups) == 2
+        assert sorted(g.fused for g in tb_layout.groups) == [False, True]
+
+    def test_mixed_bfloat16_policy(self):
+        a, b = fit_pair(precision="mixed_bfloat16")
+        la, lb = float(a.score()), float(b.score())
+        assert np.isfinite(lb)
+        assert abs(la - lb) / abs(la) < 0.02, (la, lb)
+
+    def test_mixed_float16_loss_scaling(self):
+        """Dynamic loss scaling threads through the zero step: scale
+        state advances and masters stay fp32."""
+        net = small_net(precision="mixed_float16")
+        tr = ShardedTrainer(net, mesh=mesh8(), mode="sharing",
+                            update_sharding="zero")
+        ds = DataSet(X, Y)
+        for _ in range(6):
+            tr.fit(ds)
+        assert np.isfinite(float(net.score()))
+        assert float(np.asarray(
+            net._loss_scale_state["scale"])) > 0
+        for gid, flat in tr._zero["masters"].items():
+            assert flat.dtype == jnp.float32
+        tr._finish()
+        for leaf in jax.tree_util.tree_leaves(net.params_list):
+            assert leaf.dtype == jnp.float32
+
+    def test_interpret_kernel_end_to_end(self, monkeypatch):
+        """The Pallas kernel (interpreter) + shard_map path trains with
+        the same trajectory as the XLA fallback."""
+        monkeypatch.setenv("DL4J_TPU_FUSED_UPDATE", "interpret")
+        assert fused_update_mode() == "interpret"
+        a, b = fit_pair(steps=3)
+        la, lb = float(a.score()), float(b.score())
+        assert abs(la - lb) / abs(la) < 1e-5, (la, lb)
+
+    def test_double_model_takes_generic_path(self):
+        """f64 masters must NOT route through the fused kernel (its
+        moment buffers are f32 — silent accumulator truncation); the
+        generic flat-updater path keeps f64 end to end. Needs real
+        x64 (the suite pins jax_enable_x64=False, under which 'double'
+        params are f32 and fusing them is correct)."""
+        jax.config.update("jax_enable_x64", True)
+        try:
+            conf = (NeuralNetConfiguration.builder().seed(11)
+                    .dataType("double").updater(Adam(1e-2)).list()
+                    .layer(DenseLayer(n_out=8, activation="tanh"))
+                    .layer(OutputLayer(n_out=2, activation="softmax",
+                                       loss="mcxent"))
+                    .setInputType(InputType.feedForward(6)).build())
+            net = MultiLayerNetwork(conf).init()
+            tr = ShardedTrainer(net, mesh=mesh8(), mode="sharing",
+                                update_sharding="zero")
+            tr.fit(DataSet(X.astype(np.float64),
+                           Y.astype(np.float64)))
+            assert all(not g.fused for g in tr._zero_layout.groups)
+            for flat in tr._zero["masters"].values():
+                assert flat.dtype == jnp.float64
+            tr._finish()
+            assert np.isfinite(float(net.score()))
+            for leaf in jax.tree_util.tree_leaves(net.params_list):
+                assert leaf.dtype == jnp.float64
+        finally:
+            jax.config.update("jax_enable_x64", False)
+
+    def test_paramless_layers_pass_through(self):
+        """Leafless layers (subsampling/pooling) have no flat-layout
+        group; their empty param/opt subtrees must survive assembly
+        (placement, the traced step, and the _finish gather)."""
+        from deeplearning4j_tpu.nn.conf import (
+            ConvolutionLayer, SubsamplingLayer,
+        )
+
+        def conv_net():
+            conf = (NeuralNetConfiguration.builder().seed(13)
+                    .updater(Adam(1e-2)).list()
+                    .layer(ConvolutionLayer(n_out=4, kernel_size=(3, 3),
+                                            activation="relu"))
+                    .layer(SubsamplingLayer(kernel_size=(2, 2),
+                                            stride=(2, 2)))
+                    .layer(OutputLayer(n_out=2, activation="softmax",
+                                       loss="mcxent"))
+                    .setInputType(InputType.convolutional(8, 8, 1))
+                    .build())
+            return MultiLayerNetwork(conf).init()
+
+        rs = np.random.RandomState(2)
+        xi = rs.randn(16, 8, 8, 1).astype(np.float32)
+        yi = np.eye(2, dtype=np.float32)[rs.randint(0, 2, 16)]
+        a = conv_net()
+        ShardedTrainer(a, mesh=mesh8(), mode="sharing").fit(
+            DataSet(xi, yi))
+        b = conv_net()
+        tb = ShardedTrainer(b, mesh=mesh8(), mode="sharing",
+                            update_sharding="zero")
+        for _ in range(3):
+            tb.fit(DataSet(xi, yi))
+        la, lb = float(a.score()), float(b.score())
+        assert np.isfinite(lb)
+        # the paramless layer's subtrees survive the canonical sync
+        assert jax.tree_util.tree_structure(b.params_list) \
+            == jax.tree_util.tree_structure(a.params_list)
+        assert jax.tree_util.tree_structure(b.opt_states) \
+            == jax.tree_util.tree_structure(a.opt_states)
+
+    def test_update_sharding_validation(self):
+        net = small_net()
+        with pytest.raises(ValueError, match="sharing"):
+            ShardedTrainer(net, mesh=mesh8(), mode="averaging",
+                           update_sharding="zero")
+        with pytest.raises(ValueError, match="update_sharding"):
+            ShardedTrainer(net, mesh=mesh8(), update_sharding="bogus")
+
+
+class TestZeroMemoryGauges:
+    def test_per_device_bytes_drop_to_one_nth(self):
+        net = small_net()
+        tr = ShardedTrainer(net, mesh=mesh8(), mode="sharing",
+                            update_sharding="zero")
+        tr.fit(DataSet(X, Y))
+        rep_net = small_net()
+        rep = ShardedTrainer(rep_net, mesh=mesh8(), mode="sharing")
+        rep.fit(DataSet(X, Y))
+        reg = telemetry.MetricsRegistry.get_default()
+        mg = reg.gauge(telemetry.MASTER_PARAM_BYTES)
+        og = reg.gauge(telemetry.OPT_STATE_BYTES)
+        m_rep = mg.value(mode="replicated", site="sharded")
+        m_z = mg.value(mode="update_sharded", site="sharded")
+        o_rep = og.value(mode="replicated", site="sharded")
+        o_z = og.value(mode="update_sharded", site="sharded")
+        assert m_rep > 0 and o_rep > 0
+        # 1/8 plus shard-alignment padding: must be well under 1/4
+        assert 0 < m_z < m_rep / 4, (m_z, m_rep)
+        assert 0 < o_z < o_rep / 4, (o_z, o_rep)
+        # masters really live sharded P('data') on the mesh
+        flat = next(iter(tr._zero["masters"].values()))
+        assert flat.addressable_shards[0].data.shape[0] \
+            == flat.shape[0] // 8
+        snap = telemetry.snapshot()
+        assert "state_bytes" in snap
+        assert "master_param_bytes" in snap["state_bytes"]
+
+
+class TestFusedKernelGolden:
+    def _golden(self, mode):
+        """Kernel vs composed reference (unscale -> global-norm clip ->
+        updaters.Adam.apply -> p - u) at step 300 — where a
+        half-precision bias-correction power would have decayed
+        (the _step_float contract)."""
+        rs = np.random.RandomState(3)
+        n = 2000
+        master = jnp.asarray(rs.randn(n), jnp.float32)
+        m = jnp.asarray(rs.randn(n) * 0.01, jnp.float32)
+        v = jnp.asarray(np.abs(rs.randn(n)) * 1e-4, jnp.float32)
+        grad = jnp.asarray(rs.randn(n) * 2 ** 12, jnp.float32)
+        upd = Adam(3e-4)
+        step = jnp.asarray(300)
+        inv_scale = jnp.asarray(2.0 ** -12)
+        clip = 0.5
+        g_u = grad * inv_scale
+        norm = jnp.sqrt(jnp.sum(g_u ** 2))
+        g_c = g_u * jnp.minimum(1.0, clip / jnp.maximum(norm, 1e-12))
+        updates, ns = upd.apply({"m": m, "v": v}, g_c, step)
+        ref = (master - updates, ns["m"], ns["v"])
+        got = get_op("fused_adam_master_update")(
+            master, m, v, grad, step, upd, inv_scale=inv_scale,
+            clip_norm=clip, mode=mode)
+        for a, b in zip(got, ref):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-6, atol=1e-7)
+
+    def test_xla_fallback_matches_reference(self):
+        self._golden("xla")
+
+    def test_pallas_interpreter_matches_reference(self):
+        self._golden("interpret")
+
+    def test_rejects_non_adam(self):
+        with pytest.raises(TypeError, match="Adam"):
+            fused_master_update(jnp.zeros(8), jnp.zeros(8), jnp.zeros(8),
+                                jnp.zeros(8), 0, Nesterovs(0.1))
+
+
+class TestGraphMasks:
+    """PR 2 mask-gap fix: sharing-mode ShardedTrainer threads DataSet
+    masks through ComputationGraph models instead of warn+ignore."""
+
+    def _rnn_cg(self, seed=3):
+        from deeplearning4j_tpu.nn.graph import (
+            ComputationGraph, ComputationGraphConfiguration,
+        )
+        b = (ComputationGraphConfiguration.graphBuilder()
+             .seed(seed).updater(Adam(1e-2))
+             .addInputs("in")
+             .setInputTypes(InputType.recurrent(5)))
+        b.addLayer("lstm", LSTM(n_out=8), "in")
+        b.addLayer("out", OutputLayer(n_out=4, activation="softmax",
+                                      loss="mcxent"), "lstm")
+        return ComputationGraph(b.setOutputs("out").build()).init()
+
+    def _masked_ds(self):
+        rs = np.random.RandomState(1)
+        n, t = 16, 6
+        x = rs.randn(n, t, 5).astype(np.float32)
+        y = np.eye(4, dtype=np.float32)[rs.randint(0, 4, (n, t))]
+        fm = (rs.rand(n, t) > 0.3).astype(np.float32)
+        fm[:, 0] = 1.0
+        return DataSet(x, y, labels_mask=fm, features_mask=fm)
+
+    @pytest.mark.parametrize("us", [None, "zero"])
+    def test_masked_loss_parity_with_single_device(self, us, caplog):
+        ds = self._masked_ds()
+        ref = self._rnn_cg()
+        for _ in range(3):
+            ref.fit(ds)
+        dp = self._rnn_cg()
+        tr = ShardedTrainer(dp, mesh=mesh8(), mode="sharing",
+                            update_sharding=us)
+        with caplog.at_level(logging.WARNING, "deeplearning4j_tpu"):
+            for _ in range(3):
+                tr.fit(ds)
+        assert not [r for r in caplog.records
+                    if "ignores DataSet mask" in r.getMessage()]
+        la, lb = float(ref.score()), float(dp.score())
+        assert abs(la - lb) / abs(la) < 1e-4, (la, lb)
+
+    def test_non_sharing_modes_still_warn(self, caplog):
+        ds = self._masked_ds()
+        dp = self._rnn_cg()
+        tr = ShardedTrainer(dp, mesh=mesh8(), mode="averaging")
+        with caplog.at_level(logging.WARNING, "deeplearning4j_tpu"):
+            tr.fit(ds)
+        assert [r for r in caplog.records
+                if "ignores DataSet mask" in r.getMessage()]
+
+
+class TestDistributedInit:
+    def test_no_env_is_noop(self):
+        assert maybe_init_distributed(env={}) is False
+        assert maybe_init_distributed(
+            env={"DL4J_TPU_COORDINATOR": "x:1",
+                 "DL4J_TPU_NUM_PROCESSES": "1"}) is False
+
+    def test_bad_env_is_noop(self):
+        assert maybe_init_distributed(
+            env={"DL4J_TPU_COORDINATOR": "x:1",
+                 "DL4J_TPU_NUM_PROCESSES": "two"}) is False
