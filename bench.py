@@ -262,6 +262,10 @@ def main() -> None:
                     "seq-2048 A/B — the winning kernel lost ground)")
         except Exception as e:
             line["bert2048_error"] = f"{type(e).__name__}: {e}"[:200]
+        try:
+            line.update(_gpt_decode_metrics())
+        except Exception as e:
+            line["gpt_decode_error"] = f"{type(e).__name__}: {e}"[:200]
     print(json.dumps(line))
     if regress_msgs:
         import sys
@@ -271,6 +275,35 @@ def main() -> None:
                   "interleaved ratios; this is real drift.",
                   file=sys.stderr)
         raise SystemExit(1)
+
+
+def _gpt_decode_metrics() -> dict:
+    """Serving perf in the aggregate line: scan-decode tokens/sec/chip
+    plus the continuous-batching engine vs static-lockstep A/B on
+    mixed-length traffic (bench_gpt_decode.py). A GPT-2-small-like
+    config scaled down enough to keep the aggregate round bounded; the
+    standalone bench keeps the full-size knobs."""
+    from bench_gpt_decode import (
+        build_model, decode_metrics, engine_ab, mixed_requests,
+    )
+
+    m, params = build_model(layers=8, d_model=512, heads=8, d_ff=2048,
+                            vocab=32000, max_len=256)
+    dm = decode_metrics(m, params, batch=16, prompt=64, new=192, reps=3)
+    reqs = mixed_requests(32000, n_requests=24, prompt=64, new_lo=16,
+                          new_hi=192, seed=0)
+    ab = engine_ab(m, params, reqs, slots=8, page_size=16)
+    out = {
+        "gpt_decode_tokens_per_sec_chip":
+            dm["decode_tokens_per_sec_chip"],
+        "gpt_decode_ms_per_step": dm["decode_ms_per_step"],
+        "serving_engine_speedup": ab["engine_vs_static"],
+        "serving_engine_tokens_per_sec": ab["engine_tokens_per_sec"],
+        "serving_static_tokens_per_sec": ab["static_tokens_per_sec"],
+        "serving_engine_occupancy": ab["engine_occupancy"],
+        "serving_greedy_parity": ab["greedy_parity"],
+    }
+    return out
 
 
 def _resnet50_metrics(peak) -> dict:

@@ -1,11 +1,26 @@
-"""Causal-LM KV-cache decode throughput on the real chip.
+"""Causal-LM decode throughput + continuous-batching engine A/B.
 
-Measures models/gpt.py generate() — prefill + N decode steps compiled
-as one lax.scan program — at a GPT-2-small-like config. Methodology
-matches bench.py: device-resident inputs, warmup compile, best-of-k
-windows, device->host read closing each window.
+Two workloads on the real chip:
 
-Run: python bench_gpt_decode.py [--layers 12 --d-model 768 ...]
+- ``decode_metrics``: models/gpt.py generate() — prefill + N decode
+  steps compiled as one lax.scan program — at a GPT-2-small-like
+  config (the PR-8-era metric, unchanged).
+- ``engine_ab``: MIXED-LENGTH traffic served two ways with the same
+  model/params/requests: (A) static lockstep batches — groups of
+  ``slots`` requests run through generate() until the LONGEST request
+  in the group finishes (what a naive batch server does; the short
+  requests' slots idle as padding) — vs (B) the continuous-batching
+  DecodeEngine (serving/engine.py), where a finished request's slot is
+  refilled from the queue between steps. Useful tokens (each request's
+  own requested count) over wall time, both sides; the ratio is the
+  occupancy win. Greedy outputs are asserted token-identical per
+  request across A and B.
+
+Methodology matches bench.py: device-resident inputs, warmup compile
+passes outside the timed window (the engine's AOT warm pool IS its
+warmup), device->host reads closing each window.
+
+Run: python bench_gpt_decode.py [--engine-ab] [--layers 12 ...]
 """
 
 from __future__ import annotations
@@ -22,6 +37,167 @@ from deeplearning4j_tpu.models.gpt import CausalLM
 from deeplearning4j_tpu.models.transformer import TransformerConfig
 
 
+def build_model(layers=12, d_model=768, heads=12, d_ff=3072,
+                vocab=32000, max_len=512, dtype=jnp.bfloat16):
+    cfg = TransformerConfig(
+        vocab_size=vocab, max_len=max_len, d_model=d_model,
+        n_layers=layers, n_heads=heads, d_ff=d_ff, dropout=0.0)
+    m = CausalLM(cfg, compute_dtype=dtype)
+    params = jax.device_put(m.init_params(jax.random.key(0)))
+    return m, params
+
+
+# ------------------------------------------------- scan-decode metric
+def decode_metrics(m, params, batch=32, prompt=128, new=384, reps=5):
+    """Single-program prefill+decode throughput (see module doc)."""
+    rng = np.random.default_rng(0)
+    ids = jax.device_put(jnp.asarray(
+        rng.integers(0, m.cfg.vocab_size, (batch, prompt)), jnp.int32))
+
+    def timed(new_tokens, key):
+        t0 = time.perf_counter()
+        out = m.generate(params, ids, new_tokens, temperature=1.0,
+                         rng=key)
+        np.asarray(out[0, -1])  # device->host read
+        return time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    timed(new, jax.random.key(1))
+    timed(1, jax.random.key(1))      # compile the prefill-only program
+    compile_s = time.perf_counter() - t0
+
+    best_full = best_pre = float("inf")
+    for r in range(reps):
+        best_full = min(best_full, timed(new, jax.random.key(2 + r)))
+        # prefill + 1 sampled token: subtracting isolates decode steps
+        best_pre = min(best_pre, timed(1, jax.random.key(2 + r)))
+
+    decode_s = max(best_full - best_pre, 1e-9)
+    return {
+        "params_m": round(m.num_params(params) / 1e6, 1),
+        "compile_s": round(compile_s, 1),
+        "e2e_tokens_per_sec": round(batch * new / best_full, 1),
+        "prefill_ms": round(best_pre * 1e3, 2),
+        "decode_tokens_per_sec": round(
+            batch * (new - 1) / decode_s, 1),
+        # generate() is a single-device program: tokens/sec/chip IS
+        # tokens/sec regardless of how many chips the host exposes
+        "decode_tokens_per_sec_chip": round(
+            batch * (new - 1) / decode_s, 1),
+        "decode_ms_per_step": round(decode_s / (new - 1) * 1e3, 3),
+    }
+
+
+# --------------------------------------------- engine-vs-static A/B
+def mixed_requests(vocab, n_requests, prompt, new_lo, new_hi, seed=0):
+    """Mixed-length traffic: fixed prompt width (so the static side
+    gets its best case — one prefill shape), decode lengths drawn from
+    a TRUNCATED-EXPONENTIAL long tail over [new_lo, new_hi]. Real
+    decode traffic is long-tailed (most continuations stop early, a
+    few run to the budget), and that is precisely the distribution
+    where lockstep batching collapses: every group runs to its
+    straggler's length while the engine refills freed slots."""
+    rng = np.random.default_rng(seed)
+    span = max(new_hi - new_lo, 0)
+    return [(rng.integers(0, vocab, (prompt,)).astype(np.int32),
+             new_lo + int(min(rng.exponential(0.35 * span), span)))
+            for _ in range(n_requests)]
+
+
+def _static_lockstep(m, params, requests, slots):
+    """One generate() call per group of ``slots`` requests in arrival
+    order, padded to a full batch, running to the group's LONGEST
+    request. Returns (per-request outputs, seconds)."""
+    groups = [requests[i:i + slots]
+              for i in range(0, len(requests), slots)]
+
+    def run():
+        outs = []
+        for g in groups:
+            prompts = np.stack([p for p, _ in g], 0)
+            if len(g) < slots:      # pad the lockstep batch
+                prompts = np.concatenate(
+                    [prompts, np.repeat(prompts[-1:],
+                                        slots - len(g), 0)], 0)
+            new = max(nt for _, nt in g)
+            out = np.asarray(m.generate(
+                params, jnp.asarray(prompts), new))
+            outs.extend(out[i, :nt] for i, (_, nt) in enumerate(g))
+        return outs
+
+    run()                            # warm every group shape
+    t0 = time.perf_counter()
+    outs = run()
+    return outs, time.perf_counter() - t0
+
+
+def _run_engine(m, params, requests, slots, page_size, max_chunk):
+    from deeplearning4j_tpu.serving.engine import DecodeEngine
+
+    need = max(p.size + nt for p, nt in requests)
+    eng = DecodeEngine(
+        m, params, slots=slots, page_size=page_size,
+        max_chunk=max_chunk,
+        max_context=min(m.cfg.max_len,
+                        ((need + page_size - 1) // page_size)
+                        * page_size)).start()
+    try:
+        t0 = time.perf_counter()
+        handles = [eng.submit(p, nt) for p, nt in requests]
+        outs = [h.result(timeout=600) for h in handles]
+        secs = time.perf_counter() - t0
+        stats = eng.stats()
+    finally:
+        eng.shutdown()
+    return outs, secs, stats
+
+
+def engine_ab(m, params, requests, slots=8, page_size=16,
+              max_chunk=16):
+    """A/B on the same model/params/requests. Timing runs at the
+    model's native compute dtype (bf16 on TPU). The token-identity
+    verification runs a SECOND pass at f32: the engine's paged
+    attention is float-equivalent (same values, different reduction
+    layout) to generate()'s dense cache, so at bf16 a one-ulp logit
+    tie can argmax-flip either program — f32 is where "token-identical
+    per request" is well-defined (and what tests/the CPU gate pin).
+    The bf16 agreement fraction is reported alongside."""
+    # interleaved best-of-2 windows per side (the zero_ab methodology:
+    # tenant noise on a shared chip swings either side ~±20%; taking
+    # each side's best window cancels it)
+    static_s = engine_s = float("inf")
+    for _ in range(2):
+        static_outs, s = _static_lockstep(m, params, requests, slots)
+        static_s = min(static_s, s)
+        engine_outs, s, stats = _run_engine(
+            m, params, requests, slots, page_size, max_chunk)
+        engine_s = min(engine_s, s)
+    native_agree = float(np.mean([
+        np.array_equal(a, b)
+        for a, b in zip(engine_outs, static_outs)]))
+
+    # f32 verification pass: token-identical or the A/B is void
+    m32 = CausalLM(m.cfg, compute_dtype=jnp.float32)
+    st32, _ = _static_lockstep(m32, params, requests, slots)
+    en32, _, _ = _run_engine(m32, params, requests, slots, page_size,
+                             max_chunk)
+    parity = all(np.array_equal(a, b) for a, b in zip(en32, st32))
+
+    useful = sum(nt for _, nt in requests)
+    return {
+        "requests": len(requests),
+        "slots": slots,
+        "useful_tokens": useful,
+        "static_tokens_per_sec": round(useful / static_s, 1),
+        "engine_tokens_per_sec": round(useful / engine_s, 1),
+        "engine_vs_static": round(static_s / engine_s, 3),
+        "engine_occupancy": round(stats["avg_occupancy"], 3),
+        "greedy_parity": parity,
+        "native_dtype_token_agreement": round(native_agree, 3),
+        "warm_pool_misses": stats["warm_pool"]["misses"],
+    }
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--layers", type=int, default=12)
@@ -33,50 +209,32 @@ def main():
     ap.add_argument("--prompt", type=int, default=128)
     ap.add_argument("--new", type=int, default=384)
     ap.add_argument("--reps", type=int, default=5)
+    ap.add_argument("--engine-ab", action="store_true",
+                    help="also run the continuous-batching engine vs "
+                         "static-lockstep A/B on mixed-length traffic")
+    ap.add_argument("--slots", type=int, default=8)
+    ap.add_argument("--page-size", type=int, default=16)
+    ap.add_argument("--max-chunk", type=int, default=16)
+    ap.add_argument("--requests", type=int, default=32)
+    ap.add_argument("--new-lo", type=int, default=32)
+    ap.add_argument("--new-hi", type=int, default=None,
+                    help="default: --new")
     args = ap.parse_args()
 
-    cfg = TransformerConfig(
-        vocab_size=args.vocab, max_len=args.prompt + args.new,
-        d_model=args.d_model, n_layers=args.layers, n_heads=args.heads,
-        d_ff=args.d_ff, dropout=0.0)
-    m = CausalLM(cfg, compute_dtype=jnp.bfloat16)
-    params = jax.device_put(m.init_params(jax.random.key(0)))
-    rng = np.random.default_rng(0)
-    prompt = jax.device_put(jnp.asarray(
-        rng.integers(0, args.vocab, (args.batch, args.prompt)),
-        jnp.int32))
-
-    def timed(new_tokens, key):
-        t0 = time.perf_counter()
-        out = m.generate(params, prompt, new_tokens, temperature=1.0,
-                         rng=key)
-        np.asarray(out[0, -1])  # device->host read
-        return time.perf_counter() - t0
-
-    t0 = time.perf_counter()
-    timed(args.new, jax.random.key(1))
-    timed(1, jax.random.key(1))      # compile the prefill-only program
-    compile_s = time.perf_counter() - t0
-
-    best_full = best_pre = float("inf")
-    for r in range(args.reps):
-        best_full = min(best_full, timed(args.new, jax.random.key(2 + r)))
-        # prefill + 1 sampled token: subtracting isolates decode steps
-        best_pre = min(best_pre, timed(1, jax.random.key(2 + r)))
-
-    decode_s = max(best_full - best_pre, 1e-9)
-    print(json.dumps({
-        "metric": "gpt_decode", "layers": args.layers,
-        "d_model": args.d_model, "batch": args.batch,
-        "prompt": args.prompt, "new_tokens": args.new,
-        "params_m": round(m.num_params(params) / 1e6, 1),
-        "compile_s": round(compile_s, 1),
-        "e2e_tokens_per_sec": round(args.batch * args.new / best_full, 1),
-        "prefill_ms": round(best_pre * 1e3, 2),
-        "decode_tokens_per_sec": round(
-            args.batch * (args.new - 1) / decode_s, 1),
-        "decode_ms_per_step": round(
-            decode_s / (args.new - 1) * 1e3, 3)}))
+    m, params = build_model(args.layers, args.d_model, args.heads,
+                            args.d_ff, args.vocab,
+                            args.prompt + args.new)
+    line = {"metric": "gpt_decode", "layers": args.layers,
+            "d_model": args.d_model, "batch": args.batch,
+            "prompt": args.prompt, "new_tokens": args.new}
+    line.update(decode_metrics(m, params, args.batch, args.prompt,
+                               args.new, args.reps))
+    if args.engine_ab:
+        reqs = mixed_requests(args.vocab, args.requests, args.prompt,
+                              args.new_lo, args.new_hi or args.new)
+        line["engine_ab"] = engine_ab(m, params, reqs, args.slots,
+                                      args.page_size, args.max_chunk)
+    print(json.dumps(line))
 
 
 if __name__ == "__main__":

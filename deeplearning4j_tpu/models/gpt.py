@@ -211,7 +211,12 @@ class CausalLM:
         rng = rng if rng is not None else jax.random.key(0)
         cache_key = (n, t0, max_new_tokens, float(temperature))
         if cache_key in self._gen_cache:
-            return self._gen_cache[cache_key](params, prompt_ids, rng)
+            # LRU: re-insert on hit so eviction drops the COLDEST
+            # program, not the oldest-inserted (which may be the
+            # hottest shape in a serving mix)
+            run = self._gen_cache.pop(cache_key)
+            self._gen_cache[cache_key] = run
+            return run(params, prompt_ids, rng)
 
         def sample(key, logits):
             if temperature > 0.0:
@@ -252,6 +257,8 @@ class CausalLM:
                                     toks.transpose(1, 0)], axis=1)
 
         if len(self._gen_cache) >= 8:   # bound compiled-program growth
+            # dict preserves insertion order and hits re-insert, so the
+            # first key is always the least-recently-used program
             self._gen_cache.pop(next(iter(self._gen_cache)))
         self._gen_cache[cache_key] = run
         return run(params, prompt_ids, rng)

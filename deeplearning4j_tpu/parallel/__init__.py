@@ -15,8 +15,11 @@ gradient compression survives as an *optional* DCN-path transform.
 from deeplearning4j_tpu.parallel.mesh import (
     build_mesh, data_parallel_spec, replicated_spec,
 )
-from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
+from deeplearning4j_tpu.parallel.wrapper import (
+    GenerativeInference, ParallelInference, ParallelWrapper,
+)
 from deeplearning4j_tpu.parallel.sharded import ShardedTrainer
 
-__all__ = ["ParallelWrapper", "ShardedTrainer", "build_mesh",
+__all__ = ["ParallelWrapper", "ParallelInference",
+           "GenerativeInference", "ShardedTrainer", "build_mesh",
            "data_parallel_spec", "replicated_spec"]

@@ -196,6 +196,7 @@ class ParallelInference:
         import queue as _queue
         import time as _time
 
+        t_submit = _time.perf_counter()
         x = np.asarray(x)
         if x.shape[0] == 0:
             raise ValueError(
@@ -225,6 +226,14 @@ class ParallelInference:
                 _time.sleep(0.0005)  # backpressure wait, lock released
             futs.append(fut)
         outs = [f.result() for f in futs]
+        if _telemetry.enabled():
+            # end-to-end client latency (enqueue wait + batching window
+            # + model call + scatter) — the number a caller actually
+            # experiences; p50/p99 ride the bounded-reservoir summary
+            _telemetry.MetricsRegistry.get_default().histogram(
+                _telemetry.INFERENCE_REQUEST_LATENCY,
+                "client-observed output() latency per request"
+            ).observe(_time.perf_counter() - t_submit)
         if len(outs) == 1:
             return outs[0]
         return np.concatenate([np.asarray(o) for o in outs], 0)
@@ -313,8 +322,85 @@ class ParallelInference:
                             "batched model calls").inc()
                 reg.counter("dl4j_tpu_inference_requests_total",
                             "client requests served").inc(len(batch))
+                real = sum(x.shape[0] for x, _ in batch)
+                reg.gauge(_telemetry.INFERENCE_BATCH_OCCUPANCY,
+                          "real rows / batch_limit of the latest "
+                          "dispatch (rest is padding)").set(
+                    real / self.batch_limit)
+                reg.gauge(_telemetry.INFERENCE_QUEUE_DEPTH,
+                          "requests waiting in the dispatch queue"
+                          ).set(self._queue.qsize())
             off = 0
             for x, fut in batch:
                 n = x.shape[0]
                 fut.set_result(out[off:off + n])
                 off += n
+
+
+class GenerativeInference:
+    """ParallelInference-parity front-end over the continuous-batching
+    decode engine (serving/engine.py) — the autoregressive sibling of
+    ParallelInference: concurrent clients submit prompts, the engine
+    keeps a fixed-shape decode step fully occupied by joining requests
+    into free slots mid-flight, and each caller gets exactly its own
+    continuation back.
+
+    Same call conventions as ParallelInference: ``output()`` is
+    thread-safe and blocking; ``submit()`` is the streaming variant
+    returning a ServingRequest handle (``.stream()`` yields tokens as
+    they decode). Stats (``n_requests``, ``n_dispatches`` = decode
+    steps) expose the batching ratio, and the engine exports request
+    p50/p99 latency, TTFT, queue-depth, slot-occupancy and
+    KV-page-utilization on the MetricsRegistry.
+    """
+
+    def __init__(self, model, params, **engine_kwargs):
+        from deeplearning4j_tpu.serving.engine import DecodeEngine
+
+        self.engine = DecodeEngine(model, params, **engine_kwargs)
+        self.engine.start()
+
+    # ----------------------------------------------------------- client
+    def output(self, prompt_ids, max_new_tokens: int,
+               temperature: float = 0.0, eos_id=None,
+               timeout: Optional[float] = None):
+        """Blocking generate; [t0] or [1, t0] prompt -> [new] tokens."""
+        import numpy as np
+
+        p = np.asarray(prompt_ids, np.int32)
+        if p.ndim == 2:
+            if p.shape[0] != 1:
+                raise ValueError(
+                    "GenerativeInference.output takes ONE sequence per "
+                    "call (submit each row; the engine batches across "
+                    f"callers) — got batch {p.shape[0]}")
+            p = p[0]
+        return self.engine.generate(p, max_new_tokens, temperature,
+                                    eos_id, timeout)
+
+    def submit(self, prompt_ids, max_new_tokens: int,
+               temperature: float = 0.0, eos_id=None,
+               sample_seed=None):
+        return self.engine.submit(prompt_ids, max_new_tokens,
+                                  temperature, eos_id, sample_seed)
+
+    # ------------------------------------------------------------ stats
+    @property
+    def n_requests(self) -> int:
+        return self.engine.n_requests
+
+    @property
+    def n_dispatches(self) -> int:
+        return self.engine.n_dispatches
+
+    def stats(self):
+        return self.engine.stats()
+
+    def shutdown(self) -> None:
+        self.engine.shutdown()
+
+    def __enter__(self) -> "GenerativeInference":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.shutdown()

@@ -89,6 +89,23 @@ NONFINITE_FIRST_LAYER = "dl4j_tpu_nonfinite_first_layer"
 MFU = "dl4j_tpu_mfu"
 STEP_FLOPS = "dl4j_tpu_step_flops"
 HEALTH_FETCHES = "dl4j_tpu_health_fetches_total"
+#: continuous-batching decode engine (serving/engine.py)
+SERVING_REQUESTS = "dl4j_tpu_serving_requests_total"
+SERVING_TOKENS = "dl4j_tpu_serving_tokens_total"
+SERVING_REQUEST_LATENCY = "dl4j_tpu_serving_request_latency_seconds"
+SERVING_TTFT = "dl4j_tpu_serving_ttft_seconds"
+SERVING_QUEUE_DEPTH = "dl4j_tpu_serving_queue_depth"
+SERVING_SLOT_OCCUPANCY = "dl4j_tpu_serving_slot_occupancy"
+SERVING_KV_PAGE_UTILIZATION = "dl4j_tpu_serving_kv_page_utilization"
+SERVING_WARM_HITS = "dl4j_tpu_serving_warm_pool_hits_total"
+SERVING_WARM_MISSES = "dl4j_tpu_serving_warm_pool_misses_total"
+SERVING_DECODE_STEPS = "dl4j_tpu_serving_decode_steps_total"
+SERVING_DECODE_STEP_SECONDS = "dl4j_tpu_serving_decode_step_seconds"
+SERVING_PREFILL_SECONDS = "dl4j_tpu_serving_prefill_seconds"
+#: queued dynamic-batching inference (parallel/wrapper.py)
+INFERENCE_REQUEST_LATENCY = "dl4j_tpu_inference_request_latency_seconds"
+INFERENCE_QUEUE_DEPTH = "dl4j_tpu_inference_queue_depth"
+INFERENCE_BATCH_OCCUPANCY = "dl4j_tpu_inference_batch_occupancy"
 
 
 def enabled() -> bool:
@@ -686,6 +703,33 @@ def snapshot() -> Dict[str, Any]:
             state_bytes[key] = m._json()
     if state_bytes:
         out["state_bytes"] = state_bytes
+    serving = serving_snapshot()
+    if serving:
+        out["serving"] = serving
+    return out
+
+
+def serving_snapshot() -> Dict[str, Any]:
+    """Latest serving-engine metrics (request latency / TTFT summaries,
+    queue depth, slot occupancy, KV-page utilization, warm-pool hit
+    rate) as plain JSON, or {} when no engine has published. peek-only:
+    assembling the snapshot never creates empty series."""
+    reg = MetricsRegistry.get_default()
+    out: Dict[str, Any] = {}
+    for key, name in (("requests_total", SERVING_REQUESTS),
+                      ("tokens_total", SERVING_TOKENS),
+                      ("request_latency", SERVING_REQUEST_LATENCY),
+                      ("ttft", SERVING_TTFT),
+                      ("queue_depth", SERVING_QUEUE_DEPTH),
+                      ("slot_occupancy", SERVING_SLOT_OCCUPANCY),
+                      ("kv_page_utilization",
+                       SERVING_KV_PAGE_UTILIZATION),
+                      ("warm_pool_hits", SERVING_WARM_HITS),
+                      ("warm_pool_misses", SERVING_WARM_MISSES),
+                      ("decode_steps", SERVING_DECODE_STEPS)):
+        m = reg.peek(name)
+        if m is not None:
+            out[key] = m._json()
     return out
 
 
@@ -724,7 +768,7 @@ __all__ = [
     "span", "record_span", "record_phase",
     "chrome_trace", "export_chrome_trace", "clear_trace",
     "instrument_jit", "sample_device_memory", "snapshot",
-    "model_health_snapshot", "reset",
+    "model_health_snapshot", "serving_snapshot", "reset",
     "enabled", "set_enabled", "record_on_device_batch",
     "record_state_bytes", "MASTER_PARAM_BYTES", "OPT_STATE_BYTES",
     "JIT_COMPILES", "JIT_COMPILE_SECONDS", "STEP_PHASE_SECONDS",
@@ -739,4 +783,11 @@ __all__ = [
     "WATCHDOG_STALLS", "CHAOS_INJECTED",
     "LAYER_GRAD_NORM", "LAYER_PARAM_NORM", "UPDATE_RATIO",
     "NONFINITE_FIRST_LAYER", "MFU", "STEP_FLOPS", "HEALTH_FETCHES",
+    "SERVING_REQUESTS", "SERVING_TOKENS", "SERVING_REQUEST_LATENCY",
+    "SERVING_TTFT", "SERVING_QUEUE_DEPTH", "SERVING_SLOT_OCCUPANCY",
+    "SERVING_KV_PAGE_UTILIZATION", "SERVING_WARM_HITS",
+    "SERVING_WARM_MISSES", "SERVING_DECODE_STEPS",
+    "SERVING_DECODE_STEP_SECONDS", "SERVING_PREFILL_SECONDS",
+    "INFERENCE_REQUEST_LATENCY", "INFERENCE_QUEUE_DEPTH",
+    "INFERENCE_BATCH_OCCUPANCY",
 ]

@@ -8,12 +8,23 @@ client), SURVEY.md §2.36.
 Endpoints (stdlib http.server, daemon thread):
     POST /v1/serving/predict   {"features": <nested list>, ...}
                                -> {"output": <nested list>}
-    GET  /v1/serving/info      -> model metadata
+    POST /v1/serving/generate  {"prompt_ids": [...],
+                                "max_new_tokens": N,
+                                "temperature": 0.0, "eos_id": opt}
+                               -> {"tokens": [...], "ttft_ms": ...,
+                                   "latency_ms": ..., "finish_reason"}
+    GET  /v1/serving/info      -> model/engine metadata
+    GET  /v1/serving/stats     -> live engine stats (occupancy,
+                                  queue, KV pages, warm pool)
 
-Batching note: requests are served one-by-one; the TPU-side win comes
-from the jit-compiled forward reused across requests (first request
-pays compile). For throughput serving use ParallelInference, which
-micro-batches across callers.
+Batching note: ``predict`` requests are served one-by-one; the
+TPU-side win comes from the jit-compiled forward reused across
+requests (first request pays compile). For throughput serving use
+ParallelInference (classifier batching across callers) or attach a
+continuous-batching DecodeEngine (``JsonModelServer(engine=...)``) —
+``generate`` requests from concurrent HTTP clients then share the
+engine's fixed-shape decode step, each joining a free slot mid-flight
+(docs/SERVING.md).
 """
 
 from __future__ import annotations
@@ -36,10 +47,15 @@ class JsonModelServer:
     lists) — mirroring the reference's InferenceAdapter/Serializer seam.
     """
 
-    def __init__(self, model, port: int = 0,
+    def __init__(self, model=None, port: int = 0,
                  input_adapter: Optional[Callable[[dict], Any]] = None,
-                 output_adapter: Optional[Callable[[Any], Any]] = None):
+                 output_adapter: Optional[Callable[[Any], Any]] = None,
+                 engine=None):
+        if model is None and engine is None:
+            raise ValueError("need a model (predict), an engine "
+                             "(generate), or both")
         self.model = model
+        self.engine = engine      # serving.DecodeEngine (or None)
         self._requested_port = port
         self.input_adapter = input_adapter or self._default_input
         self.output_adapter = output_adapter or self._default_output
@@ -83,18 +99,56 @@ class JsonModelServer:
 
     # -- inference ------------------------------------------------------
     def predict(self, payload: dict):
+        if self.model is None:
+            raise ValueError("no model attached (generation-only "
+                             "server — use /v1/serving/generate)")
         x = self.input_adapter(payload)
         with self._infer_lock:  # model output() mutates rng state
             out = self.model.output(x)
         return self.output_adapter(out)
 
+    def generate(self, payload: dict) -> dict:
+        """Continuous-batching generation: submit to the engine and
+        block THIS handler thread only — ThreadingHTTPServer runs one
+        thread per connection, so concurrent clients' requests decode
+        side by side in the engine's slots (no _infer_lock here; the
+        engine is the serialization point)."""
+        if self.engine is None:
+            raise ValueError("no decode engine attached "
+                             "(JsonModelServer(engine=...))")
+        if "prompt_ids" not in payload:
+            raise ValueError("payload must contain 'prompt_ids'")
+        req = self.engine.submit(
+            # 1-D (or [1, t0]) only — submit() rejects batched arrays
+            # rather than silently concatenating the sequences
+            np.asarray(payload["prompt_ids"], np.int32),
+            int(payload.get("max_new_tokens", 16)),
+            float(payload.get("temperature", 0.0)),
+            payload.get("eos_id"),
+            payload.get("sample_seed"))
+        tokens = req.result(timeout=float(payload.get("timeout", 300)))
+        return {
+            "tokens": np.asarray(tokens).tolist(),
+            "finish_reason": req.finish_reason,
+            "ttft_ms": round(req.ttft_s * 1e3, 3)
+            if req.ttft_s is not None else None,
+            "latency_ms": round(req.latency_s * 1e3, 3)
+            if req.latency_s is not None else None,
+        }
+
     def info(self) -> dict:
         m = self.model
-        return {
-            "model_class": type(m).__name__,
-            "num_params": int(m.numParams()) if hasattr(m, "numParams")
-            else None,
+        out = {
+            "model_class": type(m).__name__ if m is not None else None,
+            "num_params": int(m.numParams())
+            if hasattr(m, "numParams") else None,
         }
+        if self.engine is not None:
+            st = self.engine.stats()
+            out["engine"] = {k: st[k] for k in
+                             ("slots", "page_size", "max_context",
+                              "quantization", "prefill_buckets")}
+        return out
 
 
 class _InferenceHandler(BaseHTTPRequestHandler):
@@ -113,17 +167,25 @@ class _InferenceHandler(BaseHTTPRequestHandler):
 
     def do_GET(self):
         ms: JsonModelServer = self.server.model_server  # type: ignore
-        if self.path.rstrip("/") == "/v1/serving/info":
+        path = self.path.rstrip("/")
+        if path == "/v1/serving/info":
             return self._json(ms.info())
+        if path == "/v1/serving/stats":
+            if ms.engine is None:
+                return self._json({"error": "no decode engine"}, 404)
+            return self._json(ms.engine.stats())
         return self._json({"error": "not found"}, 404)
 
     def do_POST(self):
         ms: JsonModelServer = self.server.model_server  # type: ignore
-        if self.path.rstrip("/") != "/v1/serving/predict":
+        path = self.path.rstrip("/")
+        if path not in ("/v1/serving/predict", "/v1/serving/generate"):
             return self._json({"error": "not found"}, 404)
         try:
             n = int(self.headers.get("Content-Length", 0))
             payload = json.loads(self.rfile.read(n) or b"{}")
+            if path == "/v1/serving/generate":
+                return self._json(ms.generate(payload))
             return self._json({"output": ms.predict(payload)})
         except Exception as e:  # bad payload -> 400 with reason
             return self._json({"error": str(e)}, 400)
@@ -137,16 +199,33 @@ class JsonRemoteInference:
         self.timeout = timeout
 
     def predict(self, features) -> np.ndarray:
-        body = json.dumps(
-            {"features": np.asarray(features).tolist()}).encode()
+        out = self._post("/v1/serving/predict",
+                         {"features": np.asarray(features).tolist()})
+        return np.asarray(out["output"])
+
+    def generate(self, prompt_ids, max_new_tokens: int,
+                 temperature: float = 0.0, eos_id=None) -> np.ndarray:
+        """Continuous-batching generation via the server's decode
+        engine; returns the generated token ids."""
+        out = self._post("/v1/serving/generate", {
+            "prompt_ids": np.asarray(prompt_ids,
+                                     np.int32).reshape(-1).tolist(),
+            "max_new_tokens": int(max_new_tokens),
+            "temperature": float(temperature),
+            "eos_id": eos_id,
+        })
+        return np.asarray(out["tokens"], np.int32)
+
+    def _post(self, path: str, payload: dict) -> dict:
+        body = json.dumps(payload).encode()
         req = urllib.request.Request(
-            self.endpoint + "/v1/serving/predict", data=body,
+            self.endpoint + path, data=body,
             headers={"Content-Type": "application/json"})
         with urllib.request.urlopen(req, timeout=self.timeout) as r:
             out = json.loads(r.read())
         if "error" in out:
             raise RuntimeError(out["error"])
-        return np.asarray(out["output"])
+        return out
 
 
 __all__ = ["JsonModelServer", "JsonRemoteInference"]

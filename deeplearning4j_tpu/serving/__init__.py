@@ -1,0 +1,17 @@
+"""Production inference serving: continuous-batching decode engine.
+
+``DecodeEngine`` (engine.py) is the server-grade generation path over
+``models/gpt.py``'s CausalLM: a fixed-shape slot-based decode step
+jitted ONCE and fed by a scheduler that joins new requests into free
+slots and evicts finished ones between steps, over a paged KV cache
+(kv_pages.py). Front-ends: ``parallel.wrapper.GenerativeInference``
+(ParallelInference-parity submit/stream API) and
+``remote.server.JsonModelServer(engine=...)`` (HTTP).
+"""
+
+from deeplearning4j_tpu.serving.engine import (
+    DecodeEngine, ServingRequest,
+)
+from deeplearning4j_tpu.serving.kv_pages import PagePool
+
+__all__ = ["DecodeEngine", "ServingRequest", "PagePool"]

@@ -1,0 +1,841 @@
+"""Continuous-batching decode engine over ``models/gpt.py`` CausalLM.
+
+The problem with ``generate()`` as a serving path: it compiles one
+program per ``(batch, prompt, new_tokens)`` shape, runs the whole batch
+in lockstep until the SLOWEST request finishes, and pays a trace +
+XLA compile on the first request of every new shape. This module
+replaces it with a server-grade engine:
+
+- **Slot-based fixed-shape decode step, jitted ONCE.** A static batch
+  of ``slots`` decode lanes; each slot carries its own KV pages,
+  position and sampling state. Throughput is set by slot OCCUPANCY,
+  not by the longest request: a finished request's slot is refilled
+  from the queue between steps while its neighbors keep decoding.
+- **Paged KV cache** (kv_pages.py): one page pool allocated at
+  startup; per-slot page tables. No per-shape cache allocations, no
+  per-shape executables.
+- **AOT warm pool**: startup ``jit(...).lower(...).compile()``s the
+  decode step and every prefill bucket (the same lower/compile
+  workflow the V5E16_AOT.json projection used), and the engine calls
+  the compiled executables directly — the first request never pays a
+  trace (``jax.jit``'s call cache is NOT populated by AOT compilation,
+  so the warm pool bypasses the jit call path entirely; the
+  recompile-detector counters at the ``serving_*`` sites stay 0).
+- **int8 weight-only decode** (``quantization="int8"``): decode is
+  HBM-bandwidth-bound; the decode step reads int8 weights with
+  per-channel scales (nn/precision.py) and dequantizes inside the
+  matmul. Prefill (compute-bound) keeps the full-precision weights.
+
+Greedy parity contract (tested): with temperature 0 and no
+quantization, every request decoded through the engine — joining and
+leaving mid-flight next to arbitrary other requests — produces
+token-identical output to a solo ``CausalLM.generate()`` call. The
+per-slot math is row-independent and the paged attention masks exactly
+the positions the dense cache masks.
+
+Telemetry: request p50/p99 latency + time-to-first-token histograms,
+queue-depth / slot-occupancy / KV-page-utilization gauges, warm-pool
+hit/miss counters (profiler/telemetry.py ``SERVING_*`` names), all on
+``/metrics`` and ``/telemetry``.
+"""
+
+from __future__ import annotations
+
+import collections
+import itertools
+import queue as _queue
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from deeplearning4j_tpu.nn.precision import int8_matmul, quantize_int8
+from deeplearning4j_tpu.profiler import telemetry as _telemetry
+from deeplearning4j_tpu.serving import kv_pages
+
+
+# ------------------------------------------------------------ requests
+class ServingRequest:
+    """Handle for one submitted generation request.
+
+    ``result()`` blocks until completion and returns the generated
+    tokens (np.int32, length <= max_new_tokens — shorter on EOS).
+    ``stream()`` yields tokens as the engine emits them. ``ttft_s`` /
+    ``latency_s`` are populated as the request progresses."""
+
+    def __init__(self, request_id: int, prompt: np.ndarray,
+                 max_new_tokens: int, temperature: float,
+                 eos_id: Optional[int], keydata: np.ndarray):
+        self.request_id = request_id
+        self.prompt = prompt
+        self.max_new_tokens = int(max_new_tokens)
+        self.temperature = float(temperature)
+        self.eos_id = eos_id
+        self._keydata = keydata
+        self.tokens: List[int] = []
+        self.finish_reason: Optional[str] = None   # length | eos | error
+        self.ttft_s: Optional[float] = None
+        self.latency_s: Optional[float] = None
+        self._t_submit = time.perf_counter()
+        self._stream: "_queue.Queue" = _queue.Queue()
+        self._done = threading.Event()
+        self._error: Optional[BaseException] = None
+
+    # -- engine side ----------------------------------------------------
+    def _push(self, token: int) -> None:
+        if self.ttft_s is None:
+            self.ttft_s = time.perf_counter() - self._t_submit
+        self.tokens.append(token)
+        self._stream.put(token)
+
+    def _finish(self, reason: str,
+                error: Optional[BaseException] = None) -> None:
+        self.finish_reason = reason
+        self._error = error
+        self.latency_s = time.perf_counter() - self._t_submit
+        self._stream.put(None)            # stream sentinel
+        self._done.set()
+
+    # -- client side ----------------------------------------------------
+    @property
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def result(self, timeout: Optional[float] = None) -> np.ndarray:
+        if not self._done.wait(timeout):
+            raise TimeoutError(
+                f"request {self.request_id} not done within {timeout}s")
+        if self._error is not None:
+            raise self._error
+        return np.asarray(self.tokens, np.int32)
+
+    def stream(self):
+        """Yield tokens as they are generated; raises the request's
+        error (if any) after the stream ends."""
+        while True:
+            tok = self._stream.get()
+            if tok is None:
+                break
+            yield tok
+        if self._error is not None:
+            raise self._error
+
+
+# ----------------------------------------------------------- warm pool
+class _WarmPool:
+    """AOT-compiled executables keyed by program name. ``run`` calls
+    the warm executable when present (zero trace); otherwise falls back
+    to the instrumented jit path, which counts the compile."""
+
+    def __init__(self):
+        self._exec: Dict[Any, Any] = {}
+        self.hits = 0
+        self.misses = 0
+
+    def compile(self, key, jitted, *abstract_args) -> None:
+        self._exec[key] = jitted.lower(*abstract_args).compile()
+
+    def __contains__(self, key) -> bool:
+        return key in self._exec
+
+    def run(self, key, fallback, *args):
+        ex = self._exec.get(key)
+        reg = (_telemetry.MetricsRegistry.get_default()
+               if _telemetry.enabled() else None)
+        if ex is not None:
+            self.hits += 1
+            if reg:
+                reg.counter(_telemetry.SERVING_WARM_HITS,
+                            "decode/prefill dispatches served by AOT-"
+                            "compiled warm-pool executables").inc(
+                    program=str(key[0]))
+            return ex(*args)
+        self.misses += 1
+        if reg:
+            reg.counter(_telemetry.SERVING_WARM_MISSES,
+                        "dispatches that missed the warm pool and "
+                        "took the (compiling) jit path").inc(
+                program=str(key[0]))
+        return fallback(*args)
+
+
+# --------------------------------------------------------- the engine
+def _abstract(tree):
+    return jax.tree_util.tree_map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), tree)
+
+
+class DecodeEngine:
+    """Continuous-batching generation server over a CausalLM.
+
+    Parameters
+    ----------
+    model, params : the CausalLM and its parameter tree.
+    slots : static decode-batch width (requests in flight per step).
+    page_size : KV-cache page length in positions.
+    max_context : per-request position budget (prompt + generated);
+        defaults to (and is capped at) ``model.cfg.max_len``.
+    n_pages : total KV pool pages (incl. the null page). Default sizes
+        the pool so every slot can hold ``max_context`` positions.
+    prefill_buckets : prompt padding widths to AOT-compile; default
+        powers of two (times page_size granularity) up to max_context.
+    quantization : None | "int8" — int8 weight-only decode weights
+        (per-channel scales, dequant-in-matmul); prefill stays full
+        precision.
+    max_chunk : upper bound (a power of two) on decode steps fused
+        into ONE dispatch via lax.scan. The scheduler picks the
+        largest power-of-two chunk that cannot overshoot the nearest
+        request completion, so join/evict granularity is preserved
+        exactly while host dispatch overhead and slot-state transfers
+        amortize over up to ``max_chunk`` tokens. 1 disables chunking.
+    warm_start : AOT-compile the decode + prefill executables in
+        ``start()`` so no request ever pays a trace.
+    """
+
+    def __init__(self, model, params, *, slots: int = 8,
+                 page_size: int = 16, max_context: Optional[int] = None,
+                 n_pages: Optional[int] = None,
+                 prefill_buckets: Optional[Sequence[int]] = None,
+                 quantization: Optional[str] = None,
+                 max_chunk: int = 8,
+                 max_queue: int = 512, seed: int = 0,
+                 warm_start: bool = True):
+        cfg = model.cfg
+        self.model = model
+        self.slots = int(slots)
+        self.page_size = int(page_size)
+        self.max_context = int(min(max_context or cfg.max_len,
+                                   cfg.max_len))
+        if self.slots < 1:
+            raise ValueError("need at least one slot")
+        if self.max_context < self.page_size:
+            raise ValueError(
+                f"max_context {self.max_context} < page_size "
+                f"{self.page_size}")
+        self.pages_per_slot = kv_pages.pages_needed(self.max_context,
+                                                    self.page_size)
+        if n_pages is None:
+            n_pages = 1 + self.slots * self.pages_per_slot
+        self.params = jax.device_put(params)
+        self.quantization = quantization
+        if quantization not in (None, "int8"):
+            raise ValueError(f"unknown quantization {quantization!r} "
+                             "(expected None or 'int8')")
+        self._decode_params = (self._quantize_decode_params(self.params)
+                               if quantization == "int8" else self.params)
+        self.pool = kv_pages.PagePool(
+            cfg.n_layers, cfg.n_heads, self.page_size, cfg.head_dim,
+            n_pages, dtype=model._cdtype)
+        self.prefill_buckets = self._resolve_buckets(prefill_buckets)
+        # sampling-key width follows the process PRNG impl (threefry=2,
+        # rbg=4) so keydata shapes match whatever jax.config says
+        self._kd_width = int(
+            jax.random.key_data(jax.random.key(0)).shape[-1])
+        self._base_key = jax.random.key(seed)
+        self._req_counter = itertools.count()
+        # host-side slot state (the jitted step's small inputs)
+        S, P = self.slots, self.pages_per_slot
+        self._tables = np.zeros((S, P), np.int32)
+        self._pos = np.zeros((S,), np.int32)
+        self._tok = np.zeros((S,), np.int32)
+        self._temps = np.zeros((S,), np.float32)
+        self._keydata = np.zeros((S, self._kd_width), np.uint32)
+        self._active = np.zeros((S,), bool)
+        self._slot_req: List[Optional[ServingRequest]] = [None] * S
+        self._slot_pages: List[List[int]] = [[] for _ in range(S)]
+        self._slot_emitted = np.zeros((S,), np.int64)
+        # device-side mirrors of the slot state that only changes on
+        # join/evict (tables/active/temps): re-uploaded only when dirty
+        self._dev_static = None
+        # programs: one chunk executable per power-of-two step count
+        if max_chunk < 1 or (max_chunk & (max_chunk - 1)):
+            raise ValueError(
+                f"max_chunk must be a power of two >= 1, got "
+                f"{max_chunk}")
+        self.max_chunk = int(max_chunk)
+        self._chunks = []
+        k = 1
+        while k <= self.max_chunk:
+            self._chunks.append(k)
+            k *= 2
+        core = self._build_step_core()
+        # donate the KV pools: the engine rebinds them from every
+        # call's outputs, and without donation XLA must copy both
+        # pools at every dispatch boundary (the scan inside a chunk
+        # already aliases; donation extends that across dispatches)
+        self._decode_jits = {
+            k: jax.jit(self._make_chunk(core, k), donate_argnums=(1, 2))
+            for k in self._chunks}
+        self._decode_fallbacks = {
+            k: _telemetry.instrument_jit("serving_decode", fn)
+            for k, fn in self._decode_jits.items()}
+        self._prefill_jit = jax.jit(self._build_prefill_fn(),
+                                    donate_argnums=(1, 2))
+        self._prefill_fallback = _telemetry.instrument_jit(
+            "serving_prefill", self._prefill_jit)
+        self._warm = _WarmPool()
+        self._warm_start = bool(warm_start)
+        # scheduler
+        self._queue: "_queue.Queue" = _queue.Queue(maxsize=max_queue)
+        self._waiting: "collections.deque" = collections.deque()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._start_lock = threading.Lock()
+        self._dead: Optional[BaseException] = None
+        # stats
+        self.n_requests = 0
+        self.n_completed = 0
+        self.n_steps = 0         # decode steps (tokens per slot-lane)
+        self.n_dispatches = 0    # chunked device calls
+        self.n_tokens = 0
+        self._occupancy_sum = 0.0
+
+    # ------------------------------------------------------ construction
+    def _resolve_buckets(self, buckets) -> List[int]:
+        ps, mc = self.page_size, self.max_context
+        if buckets is None:
+            buckets, b = [], ps
+            while b < mc:
+                buckets.append(b)
+                b *= 2
+            buckets.append(kv_pages.pages_needed(mc, ps) * ps)
+        out = sorted({int(b) for b in buckets})
+        for b in out:
+            if b % ps or b < ps:
+                raise ValueError(
+                    f"prefill bucket {b} is not a multiple of "
+                    f"page_size {ps}")
+        return out
+
+    def _quantize_decode_params(self, params):
+        """int8 weight-only tree for the decode step: every 2-D matmul
+        weight gets per-output-channel scales; tok_emb is per-ROW
+        scaled so the same tensor serves the embedding gather (rows)
+        and the tied LM head (rows become output channels of x@W.T).
+        Biases, norms, positions stay float."""
+        def q(w, axis):
+            wq = quantize_int8(w, axis=axis)
+            return {"q": wq["q"], "s": wq["s"]}   # drop static axis key
+
+        out = {"tok_emb": q(params["tok_emb"], 0),
+               "pos_emb": params["pos_emb"],
+               "ln_f": params["ln_f"],
+               "layers": []}
+        for lp in params["layers"]:
+            out["layers"].append({
+                "ln1": lp["ln1"], "ln2": lp["ln2"],
+                "wqkv": q(lp["wqkv"], 1), "bqkv": lp["bqkv"],
+                "wo": q(lp["wo"], 1), "bo": lp["bo"],
+                "w1": q(lp["w1"], 1), "b1": lp["b1"],
+                "w2": q(lp["w2"], 1), "b2": lp["b2"],
+            })
+        return jax.device_put(out)
+
+    # --------------------------------------------------- jitted programs
+    @staticmethod
+    def _rows(w, idx, cd):
+        """Embedding-row gather, quantization-aware (per-row scales)."""
+        if isinstance(w, dict):
+            return w["q"][idx].astype(cd) * w["s"][idx][:, None].astype(cd)
+        return w.astype(cd)[idx]
+
+    @staticmethod
+    def _head(x, w, cd):
+        """Tied LM head ``x @ tok_emb.T`` (per-row scales become
+        per-output-column scales of the transpose)."""
+        if isinstance(w, dict):
+            return (x @ w["q"].astype(cd).T) * w["s"].astype(cd)[None, :]
+        return x @ w.astype(cd).T
+
+    def _build_step_core(self):
+        """One fixed-shape decode step for all S slots. Mirrors
+        ``CausalLM._decode_one`` op-for-op (same einsums, same residual
+        association, same masking value) so greedy outputs are
+        token-identical to the solo path — the only difference is that
+        K/V live in gathered pages instead of a dense cache."""
+        cfg = self.model.cfg
+        cd = self.model._cdtype
+        S, P, ps = self.slots, self.pages_per_slot, self.page_size
+        ln = self.model._ln
+
+        def step(params, kpool, vpool, tables, pos, tok, keydata, temps):
+            x = self._rows(params["tok_emb"], tok, cd) \
+                + params["pos_emb"].astype(cd)[pos]
+            scale = 1.0 / jnp.sqrt(jnp.asarray(cfg.head_dim, cd))
+            # inactive/evicted slots carry all-null tables, so their
+            # writes land on the null page by construction
+            page = tables[jnp.arange(S), pos // ps]
+            off = pos % ps
+            valid = (jnp.arange(P * ps)[None, None, None, :]
+                     <= pos[:, None, None, None])
+            for li, lp in enumerate(params["layers"]):
+                h = ln(x, lp["ln1"])
+                qkv = int8_matmul(h, lp["wqkv"], cd) \
+                    + lp["bqkv"].astype(cd)
+                q, k, v = jnp.split(qkv, 3, axis=-1)
+                hs = lambda y: y.reshape(S, cfg.n_heads, 1, cfg.head_dim)
+                q, k, v = hs(q), hs(k), hs(v)
+                kpool, vpool = kv_pages.append_token(
+                    kpool, vpool, li, page, off, k[:, :, 0], v[:, :, 0])
+                ck = kv_pages.gather_pages(kpool, li, tables)
+                cv = kv_pages.gather_pages(vpool, li, tables)
+                # page-major contraction: (p, o) together are the flat
+                # key axis the dense path calls k — same elements, same
+                # row-major order, no transposed cache copy
+                logits = jnp.einsum("nhqd,nphod->nhqpo", q, ck) \
+                    .reshape(S, cfg.n_heads, 1, P * ps) * scale
+                neg = jnp.asarray(jnp.finfo(logits.dtype).min,
+                                  logits.dtype)
+                logits = jnp.where(valid, logits, neg)
+                w = jax.nn.softmax(logits, axis=-1) \
+                    .reshape(S, cfg.n_heads, 1, P, ps)
+                ctx = jnp.einsum("nhqpo,nphod->nhqd", w, cv)
+                ctx = ctx.reshape(S, cfg.d_model)
+                x = x + int8_matmul(ctx, lp["wo"], cd) \
+                    + lp["bo"].astype(cd)
+                h = ln(x, lp["ln2"])
+                x = x + int8_matmul(
+                    jax.nn.gelu(int8_matmul(h, lp["w1"], cd)
+                                + lp["b1"].astype(cd)),
+                    lp["w2"], cd) + lp["b2"].astype(cd)
+            x = ln(x, params["ln_f"])
+            logits = self._head(x, params["tok_emb"], cd) \
+                .astype(jnp.float32)
+            greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            keys = jax.random.wrap_key_data(keydata)
+            nk = jax.vmap(jax.random.split)(keys)      # [S, 2] keys
+            safe_t = jnp.where(temps > 0, temps, 1.0)
+            sampled = jax.vmap(jax.random.categorical)(
+                nk[:, 1], logits / safe_t[:, None]).astype(jnp.int32)
+            nxt = jnp.where(temps > 0, sampled, greedy)
+            return kpool, vpool, nxt, jax.random.key_data(nk[:, 0])
+
+        return step
+
+    @staticmethod
+    def _make_chunk(core, n_steps: int):
+        """``n_steps`` decode steps fused into one lax.scan program.
+        The scheduler guarantees no active request completes mid-chunk
+        (chunk <= min remaining), so the slot roster (tables / active /
+        temps) is loop-invariant and only the per-token state (pos /
+        tok / keys / pools) carries. A chunk of 1 is the plain step."""
+
+        def chunk(params, kpool, vpool, tables, pos, active, tok,
+                  keydata, temps):
+            def body(carry, _):
+                kpool, vpool, pos, tok, kd = carry
+                kpool, vpool, nxt, nkd = core(
+                    params, kpool, vpool, tables, pos, tok, kd, temps)
+                pos = pos + active.astype(pos.dtype)
+                tok = jnp.where(active, nxt, tok)
+                return (kpool, vpool, pos, tok, nkd), nxt
+
+            (kpool, vpool, pos, tok, kd), toks = lax.scan(
+                body, (kpool, vpool, pos, tok, keydata), None,
+                length=n_steps)
+            return kpool, vpool, toks.T, pos, tok, kd
+
+        return chunk
+
+    def _build_prefill_fn(self):
+        """Parallel prefill of one request: batched forward over the
+        padded prompt writes every position's K/V into the slot's
+        pages; returns the last REAL position's logits (the first
+        generated token's distribution). Positions >= t0 see padding
+        but are causally invisible to positions < t0, so the committed
+        K/V and returned logits are exact."""
+        m, ps = self.model, self.page_size
+
+        def prefill(params, kpool, vpool, prompt, page_row, t0):
+            logits, ks, vs = m.forward(params, prompt, return_kv=True)
+            kpool, vpool = kv_pages.commit_prefill(
+                kpool, vpool, ks, vs, page_row, ps)
+            last = lax.dynamic_index_in_dim(logits[0], t0 - 1, axis=0,
+                                            keepdims=False)
+            return kpool, vpool, last.astype(jnp.float32)
+
+        return prefill
+
+    # ---------------------------------------------------------- startup
+    def start(self) -> "DecodeEngine":
+        with self._start_lock:
+            if self._thread is not None:
+                return self
+            if self._dead is not None:
+                raise RuntimeError("engine has been shut down")
+            if self._warm_start:
+                self._aot_warmup()
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True, name="ServingEngine")
+            self._thread.start()
+        return self
+
+    def _aot_warmup(self) -> None:
+        """lower+compile every executable the steady state needs, so
+        the first request is served entirely from the warm pool."""
+        S, P, kw = self.slots, self.pages_per_slot, self._kd_width
+        i32, u32, f32 = jnp.int32, jnp.uint32, jnp.float32
+        sds = jax.ShapeDtypeStruct
+        with _telemetry.span("serving_aot_warmup",
+                             buckets=len(self.prefill_buckets),
+                             chunks=len(self._chunks)):
+            for k in self._chunks:
+                self._warm.compile(
+                    ("decode", k), self._decode_jits[k],
+                    _abstract(self._decode_params),
+                    _abstract(self.pool.k), _abstract(self.pool.v),
+                    sds((S, P), i32), sds((S,), i32), sds((S,), bool),
+                    sds((S,), i32), sds((S, kw), u32), sds((S,), f32))
+            for b in self.prefill_buckets:
+                self._warm.compile(
+                    ("prefill", b), self._prefill_jit,
+                    _abstract(self.params), _abstract(self.pool.k),
+                    _abstract(self.pool.v), sds((1, b), i32),
+                    sds((b // self.page_size,), i32), sds((), i32))
+
+    # ----------------------------------------------------------- client
+    def submit(self, prompt_ids, max_new_tokens: int,
+               temperature: float = 0.0, eos_id: Optional[int] = None,
+               sample_seed: Optional[int] = None) -> ServingRequest:
+        prompt = np.asarray(prompt_ids, np.int32)
+        if prompt.ndim == 2 and prompt.shape[0] == 1:
+            prompt = prompt[0]          # [1, t0] convenience
+        if prompt.ndim != 1:
+            raise ValueError(
+                f"submit() takes ONE sequence per call (got shape "
+                f"{prompt.shape}); submit each row — the engine "
+                "batches across requests")
+        if prompt.size < 1:
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        total = prompt.size + int(max_new_tokens)
+        if total > self.max_context:
+            raise ValueError(
+                f"prompt ({prompt.size}) + max_new_tokens "
+                f"({max_new_tokens}) exceeds max_context "
+                f"({self.max_context})")
+        if kv_pages.pages_needed(total, self.page_size) \
+                > self.pool.capacity:
+            raise ValueError(
+                f"request needs more KV pages than the pool holds "
+                f"({self.pool.capacity}); raise n_pages")
+        if self._dead is not None or self._stop.is_set():
+            raise RuntimeError("engine has been shut down")
+        rid = next(self._req_counter)
+        key = (jax.random.key(sample_seed) if sample_seed is not None
+               else jax.random.fold_in(self._base_key, rid))
+        req = ServingRequest(rid, prompt, max_new_tokens, temperature,
+                             eos_id, np.asarray(jax.random.key_data(key)))
+        if self._thread is None:
+            self.start()
+        self.n_requests += 1
+        if _telemetry.enabled():
+            reg = _telemetry.MetricsRegistry.get_default()
+            reg.counter(_telemetry.SERVING_REQUESTS,
+                        "generation requests submitted").inc()
+        self._queue.put(req)
+        # close the submit/shutdown race: if shutdown's final queue
+        # drain happened before our put, _stop was set before it — so
+        # seeing _stop clear here proves shutdown will drain AFTER us
+        if self._stop.is_set():
+            err = self._dead or RuntimeError(
+                "engine has been shut down")
+            while True:
+                try:
+                    r = self._queue.get_nowait()
+                except _queue.Empty:
+                    break
+                r._finish("error", err)
+        self._gauge_queue_depth()
+        return req
+
+    def generate(self, prompt_ids, max_new_tokens: int,
+                 temperature: float = 0.0,
+                 eos_id: Optional[int] = None,
+                 timeout: Optional[float] = None) -> np.ndarray:
+        """Blocking single-request convenience over submit()."""
+        return self.submit(prompt_ids, max_new_tokens, temperature,
+                           eos_id).result(timeout)
+
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "slots": self.slots,
+            "page_size": self.page_size,
+            "max_context": self.max_context,
+            "quantization": self.quantization,
+            "prefill_buckets": list(self.prefill_buckets),
+            "max_chunk": self.max_chunk,
+            "requests": self.n_requests,
+            "completed": self.n_completed,
+            "decode_steps": self.n_steps,
+            "dispatches": self.n_dispatches,
+            "tokens": self.n_tokens,
+            "avg_occupancy": (self._occupancy_sum / self.n_steps
+                              if self.n_steps else 0.0),
+            "kv_pages": {"capacity": self.pool.capacity,
+                         "allocated": self.pool.allocated,
+                         "high_water": self.pool.high_water},
+            "warm_pool": {"hits": self._warm.hits,
+                          "misses": self._warm.misses},
+        }
+
+    def shutdown(self, timeout: float = 30.0) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout)
+        if self._dead is None:
+            self._dead = RuntimeError("engine has been shut down")
+        # scheduler thread is gone: safe to fail whatever remains
+        self._fail_pending(self._dead)
+
+    def __enter__(self) -> "DecodeEngine":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.shutdown()
+
+    # -------------------------------------------------------- scheduler
+    def _loop(self) -> None:
+        try:
+            while not self._stop.is_set():
+                self._admit_waiting()
+                if not self._active.any():
+                    try:
+                        self._waiting.append(
+                            self._queue.get(timeout=0.02))
+                    except _queue.Empty:
+                        pass
+                    continue
+                self._decode_step()
+        except BaseException as e:       # engine died: strand no one
+            self._dead = e
+            self._fail_pending(e)
+        finally:
+            if self._dead is None:
+                self._dead = RuntimeError("engine has been shut down")
+
+    def _fail_pending(self, err: BaseException) -> None:
+        for s in range(self.slots):
+            req = self._slot_req[s]
+            if req is not None:
+                self._evict(s, "error", err)
+        pend = list(self._waiting)
+        self._waiting.clear()
+        while True:
+            try:
+                pend.append(self._queue.get_nowait())
+            except _queue.Empty:
+                break
+        for req in pend:
+            req._finish("error", RuntimeError(
+                f"engine stopped before request {req.request_id} "
+                f"ran: {err}"))
+
+    def _admit_waiting(self) -> None:
+        while True:
+            try:
+                self._waiting.append(self._queue.get_nowait())
+            except _queue.Empty:
+                break
+        while self._waiting and not self._active.all():
+            req = self._waiting[0]
+            pages = self.pool.alloc(kv_pages.pages_needed(
+                req.prompt.size + req.max_new_tokens, self.page_size))
+            if pages is None:
+                break        # head-of-line waits for evictions
+            self._waiting.popleft()
+            try:
+                self._admit(req, pages)
+            except BaseException as e:
+                self.pool.free(pages)
+                req._finish("error", e)
+        self._gauge_queue_depth()
+
+    def _admit(self, req: ServingRequest, pages: List[int]) -> None:
+        t0 = int(req.prompt.size)
+        ps = self.page_size
+        bucket = next((b for b in self.prefill_buckets if b >= t0),
+                      kv_pages.pages_needed(t0, ps) * ps)
+        prompt = np.zeros((1, bucket), np.int32)
+        prompt[0, :t0] = req.prompt
+        page_row = np.zeros((bucket // ps,), np.int32)
+        n_real = min(len(pages), bucket // ps)
+        page_row[:n_real] = pages[:n_real]
+        t_pre = time.perf_counter()
+        kpool, vpool, last = self._warm.run(
+            ("prefill", bucket), self._prefill_fallback, self.params,
+            self.pool.k, self.pool.v, jnp.asarray(prompt),
+            jnp.asarray(page_row), jnp.asarray(t0, jnp.int32))
+        logits = np.asarray(last)
+        self.pool.k, self.pool.v = kpool, vpool
+        _telemetry.record_span(
+            "serving_prefill", t_pre,
+            metric=_telemetry.SERVING_PREFILL_SECONDS, bucket=bucket)
+        first = self._sample_first(req, logits)
+        s = int(np.flatnonzero(~self._active)[0])
+        self._slot_req[s] = req
+        self._slot_pages[s] = pages
+        self._slot_emitted[s] = 0
+        self._tables[s] = 0
+        self._tables[s, :len(pages)] = pages
+        self._pos[s] = t0
+        self._tok[s] = first
+        self._temps[s] = req.temperature
+        self._keydata[s] = req._keydata
+        self._active[s] = True
+        self._dev_static = None      # roster changed: re-upload
+        self._emit(s, first)
+        if _telemetry.enabled():
+            _telemetry.MetricsRegistry.get_default().counter(
+                _telemetry.SERVING_TOKENS,
+                "tokens generated across all requests").inc()
+
+    def _sample_first(self, req: ServingRequest,
+                      logits: np.ndarray) -> int:
+        if req.temperature <= 0.0:
+            return int(np.argmax(logits))
+        key = jax.random.wrap_key_data(jnp.asarray(req._keydata))
+        key, sub = jax.random.split(key)
+        req._keydata = np.asarray(jax.random.key_data(key))
+        return int(jax.random.categorical(
+            sub, jnp.asarray(logits) / req.temperature))
+
+    def _dev_slot_state(self):
+        """tables/active/temps change only on join/evict: upload once
+        per roster change, not once per dispatch."""
+        if self._dev_static is None:
+            self._dev_static = (jnp.asarray(self._tables),
+                                jnp.asarray(self._active),
+                                jnp.asarray(self._temps))
+        return self._dev_static
+
+    #: dispatches chained device-to-device per burst before tokens are
+    #: fetched and emitted (bounds streaming latency; joins/evictions
+    #: can only happen at roster boundaries anyway)
+    MAX_BURST_DISPATCHES = 4
+
+    def _decode_step(self) -> None:
+        """One decode BURST: chain chunk dispatches device-to-device —
+        pos/tok/keys flow from one executable's output straight into
+        the next call, tokens accumulate as device arrays — and sync
+        to the host only when the roster can change: the nearest
+        request completion, an active eos_id (completion unpredictable
+        -> single chunk), or a queued request that could join a free
+        slot."""
+        t0 = time.perf_counter()
+        active_idx = np.flatnonzero(self._active)
+        min_rem = min(
+            self._slot_req[s].max_new_tokens - int(self._slot_emitted[s])
+            for s in active_idx)
+        has_eos = any(self._slot_req[s].eos_id is not None
+                      for s in active_idx)
+        free_slots = not self._active.all()
+        tables, active, temps = self._dev_slot_state()
+        pos = jnp.asarray(self._pos)
+        tok = jnp.asarray(self._tok)
+        kd = jnp.asarray(self._keydata)
+        occupancy = float(len(active_idx)) / self.slots
+        chunks: List[Any] = []
+        steps = 0
+        while True:
+            k = 1
+            while k * 2 <= min(min_rem - steps, self.max_chunk):
+                k *= 2
+            (self.pool.k, self.pool.v, toks, pos, tok,
+             kd) = self._warm.run(
+                ("decode", k), self._decode_fallbacks[k],
+                self._decode_params, self.pool.k, self.pool.v, tables,
+                pos, active, tok, kd, temps)
+            chunks.append(toks)
+            steps += k
+            self.n_dispatches += 1
+            if has_eos or steps >= min_rem \
+                    or len(chunks) >= self.MAX_BURST_DISPATCHES:
+                break
+            if free_slots and not self._queue.empty():
+                break          # a waiting request can join a free slot
+        # ONE host sync for the whole burst
+        toks = np.concatenate([np.asarray(c) for c in chunks], axis=1)
+        # np.array (copy): device views are read-only, and _admit
+        # writes newly-joined slots' state into these buffers in place
+        self._pos = np.array(pos)
+        self._tok = np.array(tok)
+        self._keydata = np.array(kd)
+        self.n_steps += steps
+        self._occupancy_sum += occupancy * steps
+        _telemetry.record_span(
+            "serving_decode_step", t0,
+            metric=_telemetry.SERVING_DECODE_STEP_SECONDS)
+        if _telemetry.enabled():
+            reg = _telemetry.MetricsRegistry.get_default()
+            reg.gauge(_telemetry.SERVING_SLOT_OCCUPANCY,
+                      "fraction of decode slots occupied by live "
+                      "requests this step").set(occupancy)
+            reg.counter(_telemetry.SERVING_DECODE_STEPS,
+                        "fixed-shape decode steps executed").inc(steps)
+        emitted0 = self.n_tokens
+        for s in active_idx:
+            for k in range(steps):
+                if not self._active[s]:
+                    break              # finished on eos mid-chunk
+                self._emit(int(s), int(toks[s, k]))
+        if _telemetry.enabled() and self.n_tokens > emitted0:
+            _telemetry.MetricsRegistry.get_default().counter(
+                _telemetry.SERVING_TOKENS,
+                "tokens generated across all requests").inc(
+                self.n_tokens - emitted0)
+
+    def _emit(self, s: int, token: int) -> None:
+        """Hot loop (up to burst_steps x slots calls between
+        dispatches): no registry lookups here except the rare
+        first-token TTFT sample — the token counter is bulk-inc'd once
+        per burst/admit by the callers."""
+        req = self._slot_req[s]
+        req._push(token)
+        self._slot_emitted[s] += 1
+        self.n_tokens += 1
+        if self._slot_emitted[s] == 1 and _telemetry.enabled():
+            _telemetry.MetricsRegistry.get_default().histogram(
+                _telemetry.SERVING_TTFT,
+                "submit -> first generated token").observe(req.ttft_s)
+        if self._slot_emitted[s] >= req.max_new_tokens:
+            self._evict(s, "length")
+        elif req.eos_id is not None and token == req.eos_id:
+            self._evict(s, "eos")
+
+    def _evict(self, s: int, reason: str,
+               error: Optional[BaseException] = None) -> None:
+        req = self._slot_req[s]
+        self.pool.free(self._slot_pages[s])
+        self._slot_req[s] = None
+        self._slot_pages[s] = []
+        self._slot_emitted[s] = 0
+        self._tables[s] = 0      # all-null row: decode writes -> page 0
+        self._pos[s] = 0
+        self._tok[s] = 0
+        self._temps[s] = 0.0
+        self._active[s] = False
+        self._dev_static = None      # roster changed: re-upload
+        self.n_completed += 1
+        req._finish(reason, error)
+        if _telemetry.enabled():
+            _telemetry.MetricsRegistry.get_default().histogram(
+                _telemetry.SERVING_REQUEST_LATENCY,
+                "submit -> completion per request").observe(
+                req.latency_s, reason=reason)
+
+    def _gauge_queue_depth(self) -> None:
+        if _telemetry.enabled():
+            _telemetry.MetricsRegistry.get_default().gauge(
+                _telemetry.SERVING_QUEUE_DEPTH,
+                "requests waiting for a free decode slot").set(
+                len(self._waiting) + self._queue.qsize())
+
+
+__all__ = ["DecodeEngine", "ServingRequest"]

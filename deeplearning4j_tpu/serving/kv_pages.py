@@ -1,0 +1,155 @@
+"""Paged KV cache: fixed-size page pool + per-slot page tables.
+
+Why pages: ``CausalLM.generate()`` allocates one dense
+``[L, N, H, max_len, hd]`` cache per compiled ``(batch, prompt, new)``
+shape — every distinct request geometry is a fresh multi-hundred-MB
+allocation and a fresh executable. The serving engine instead owns ONE
+pool of fixed-size pages shared by every slot:
+
+- ``kpool``/``vpool``: ``[L, n_pages, H, page_size, hd]`` device
+  arrays, allocated once at engine startup. Page 0 is the NULL page —
+  a scratch target that absorbs writes from inactive slots and from
+  the padded tail of prefill commits; it is never read through a valid
+  attention position.
+- per-slot page table: row ``j`` of a slot's table names the page
+  holding absolute positions ``[j*page_size, (j+1)*page_size)`` of
+  that slot's sequence. Unallocated tail entries point at the null
+  page and are masked by the position check (attention only admits
+  flat position ``<= pos``).
+- ``PagePool`` is the HOST-side allocator (free list, utilization
+  gauge); the device arrays thread functionally through the jitted
+  prefill/decode steps and are rebound by the engine.
+
+The jax functions here are pure and shape-static, so the engine's one
+decode executable serves every mix of request lengths.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.profiler import telemetry as _telemetry
+
+
+class PagePool:
+    """Host-side page allocator over the device-resident K/V pools.
+
+    ``n_pages`` INCLUDES the reserved null page 0, so the usable
+    capacity is ``n_pages - 1`` pages. ``alloc`` returns None when the
+    request cannot be satisfied — the scheduler keeps the request
+    queued (head-of-line) until eviction frees pages.
+    """
+
+    def __init__(self, n_layers: int, n_heads: int, page_size: int,
+                 head_dim: int, n_pages: int, dtype=jnp.bfloat16):
+        if page_size < 1 or n_pages < 2:
+            raise ValueError(
+                f"need page_size >= 1 and n_pages >= 2 (one null page "
+                f"+ one usable), got {page_size}/{n_pages}")
+        self.page_size = int(page_size)
+        self.n_pages = int(n_pages)
+        shape = (n_layers, n_pages, n_heads, page_size, head_dim)
+        self.k = jnp.zeros(shape, dtype)
+        self.v = jnp.zeros(shape, dtype)
+        # LIFO free list: recently-freed pages are re-used first, which
+        # keeps the hot working set of pages small and cache-friendly
+        self._free: List[int] = list(range(n_pages - 1, 0, -1))
+        self._high_water = 0
+
+    # ------------------------------------------------------- accounting
+    @property
+    def capacity(self) -> int:
+        return self.n_pages - 1
+
+    @property
+    def allocated(self) -> int:
+        return self.capacity - len(self._free)
+
+    @property
+    def high_water(self) -> int:
+        return self._high_water
+
+    def utilization(self) -> float:
+        return self.allocated / max(self.capacity, 1)
+
+    def bytes_per_page(self) -> int:
+        # k + v, all layers, one page
+        per = self.k.size // self.n_pages
+        return 2 * per * jnp.dtype(self.k.dtype).itemsize
+
+    # ------------------------------------------------------- allocation
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """``n`` pages, or None if the pool can't satisfy it (caller
+        keeps the request queued)."""
+        if n > len(self._free):
+            return None
+        pages = [self._free.pop() for _ in range(n)]
+        self._high_water = max(self._high_water, self.allocated)
+        self._gauge()
+        return pages
+
+    def free(self, pages: List[int]) -> None:
+        for p in pages:
+            if not 0 < p < self.n_pages:
+                raise ValueError(f"page {p} outside pool (null page 0 "
+                                 "is never allocated or freed)")
+            if p in self._free:
+                raise ValueError(f"double free of page {p}")
+        self._free.extend(pages)
+        self._gauge()
+
+    def _gauge(self) -> None:
+        if _telemetry.enabled():
+            _telemetry.MetricsRegistry.get_default().gauge(
+                _telemetry.SERVING_KV_PAGE_UTILIZATION,
+                "fraction of KV-cache pages currently allocated to "
+                "live requests").set(self.utilization())
+
+
+# ------------------------------------------------------- pure jax ops
+def commit_prefill(kpool, vpool, ks, vs, page_row, page_size: int):
+    """Scatter one prompt's prefill K/V into its pages.
+
+    ``ks``/``vs``: ``[L, 1, H, B, hd]`` from the parallel-prefill
+    forward over the padded prompt (bucket width ``B``, a multiple of
+    ``page_size``). ``page_row``: ``[B // page_size]`` page ids — real
+    pages for chunks the slot owns, null page 0 for the padded tail
+    (garbage written there is never read: positions beyond the true
+    prompt length stay masked until the decode loop overwrites them).
+    """
+    L, one, H, B, hd = ks.shape
+    pb = B // page_size
+    ck = ks[:, 0].reshape(L, H, pb, page_size, hd).transpose(0, 2, 1, 3, 4)
+    cv = vs[:, 0].reshape(L, H, pb, page_size, hd).transpose(0, 2, 1, 3, 4)
+    return (kpool.at[:, page_row].set(ck.astype(kpool.dtype)),
+            vpool.at[:, page_row].set(cv.astype(vpool.dtype)))
+
+
+def append_token(kpool, vpool, layer: int, page_idx, offset, k, v):
+    """Write one decode step's K/V for every slot: slot ``s`` lands at
+    ``(layer, page_idx[s], :, offset[s])``. Inactive slots' page_idx
+    must already point at the null page."""
+    return (kpool.at[layer, page_idx, :, offset].set(
+                k.astype(kpool.dtype)),
+            vpool.at[layer, page_idx, :, offset].set(
+                v.astype(vpool.dtype)))
+
+
+def gather_pages(pool, layer: int, tables) -> jnp.ndarray:
+    """Each slot's pages in page-major layout ``[S, P, H, ps, hd]``:
+    flat position ``p*page_size + o`` of slot ``s`` lives at
+    ``[s, p, :, o]`` (table row order IS position order — what makes
+    the position mask a plain ``<= pos``). Kept page-major so the
+    attention einsums contract ``(p, o)`` directly instead of paying a
+    transpose+reshape copy of the whole cache per layer per step."""
+    return pool[layer][tables]
+
+
+def pages_needed(total_positions: int, page_size: int) -> int:
+    return -(-int(total_positions) // int(page_size))
+
+
+__all__ = ["PagePool", "commit_prefill", "append_token",
+           "gather_pages", "pages_needed"]

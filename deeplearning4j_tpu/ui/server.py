@@ -88,6 +88,8 @@ _DASHBOARD_HTML = """<!doctype html>
  <pre id="layers"></pre></div>
 <div class="card"><b>Model health (in-step per-layer stats)</b>
  <pre id="health"></pre></div>
+<div class="card"><b>Serving (continuous-batching decode engine)</b>
+ <pre id="serving"></pre></div>
 <script>
 async function j(u){const r=await fetch(u);return r.json()}
 function pick(o,lk){if(!lk)return null;if(o[lk])return o[lk];
@@ -121,7 +123,33 @@ function bars(cv,st){const c=cv.getContext('2d');
  c.fillStyle='#333';
  c.fillText(st.hist_edges[0].toPrecision(3),2,cv.height-3);
  c.fillText(st.hist_edges[1].toPrecision(3),cv.width-60,cv.height-3)}
-async function refresh(){const sid=document.getElementById('sess').value;
+function gv(M,n){const m=M[n];if(!m)return null;const v=m.values||{};
+ const k=Object.keys(v)[0];return k==null?null:v[k]}
+function ms(h,q){return h&&h[q]!=null?(1e3*h[q]).toFixed(1)+'ms':'?'}
+let servingSkip=0;
+async function serving(){
+ if(servingSkip>0){servingSkip--;return}
+ const t=await j('/telemetry');
+ const M=t.metrics||{},s=(t.snapshot||{}).serving;
+ const el=document.getElementById('serving');
+ if(!s){el.textContent='(no serving engine in this process)';
+  servingSkip=14;return}  // back off to ~30s polls while absent
+ const lat=gv(M,'dl4j_tpu_serving_request_latency_seconds');
+ const tt=gv(M,'dl4j_tpu_serving_ttft_seconds');
+ el.textContent=
+  'latency p50='+ms(lat,'p50')+' p99='+ms(lat,'p99')+
+  '  ttft p50='+ms(tt,'p50')+
+  '\\nqueue depth='+fmt(gv(M,'dl4j_tpu_serving_queue_depth'))+
+  '  slot occupancy='+fmt(gv(M,'dl4j_tpu_serving_slot_occupancy'))+
+  '  kv-page util='+fmt(gv(M,'dl4j_tpu_serving_kv_page_utilization'))+
+  '\\nrequests='+fmt(gv(M,'dl4j_tpu_serving_requests_total'))+
+  '  tokens='+fmt(gv(M,'dl4j_tpu_serving_tokens_total'))+
+  '  decode steps='+fmt(gv(M,'dl4j_tpu_serving_decode_steps_total'))+
+  '\\nwarm pool: hit='+fmt(gv(M,'dl4j_tpu_serving_warm_pool_hits_total'))+
+  ' miss='+fmt(gv(M,'dl4j_tpu_serving_warm_pool_misses_total'))}
+async function refresh(){
+ try{await serving()}catch(e){}
+ const sid=document.getElementById('sess').value;
  if(!sid)return;const ov=await j('/train/'+sid+'/overview');
  draw(document.getElementById('score'),ov.iterations,ov.scores);
  draw(document.getElementById('rate'),ov.iterations,

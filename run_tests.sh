@@ -634,4 +634,96 @@ if [ $gateZ1 -ne 0 ] || [ $gateZ2 -ne 0 ] || [ $gateZ3 -ne 0 ]; then
     echo "FATAL: update-sharding smoke gate regressed (Z1=$gateZ1 Z2=$gateZ2 Z3=$gateZ3)" >&2
     exit 1
 fi
+
+# Serving smoke gate (docs/SERVING.md): the continuous-batching decode
+# engine under JAX_PLATFORMS=cpu must (a) serve 16 mixed-length
+# CONCURRENT requests with greedy outputs token-identical to solo
+# generate() calls, (b) serve them entirely from the AOT warm pool —
+# zero compiles at the serving_decode/serving_prefill jit sites after
+# startup, (c) populate the occupancy/latency/TTFT/queue-depth/KV-page
+# telemetry, and (d) shut down cleanly — no surviving ServingEngine
+# thread (the suite-wide thread-leak gate in conftest.py also watches
+# this name).
+env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu DL4J_TPU_TELEMETRY=1 \
+    python - <<'EOF'
+import sys
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.models.gpt import CausalLM
+from deeplearning4j_tpu.models.transformer import tiny_config
+from deeplearning4j_tpu.profiler import telemetry
+from deeplearning4j_tpu.serving import DecodeEngine
+
+cfg = tiny_config(vocab=17, max_len=48, d_model=32, n_layers=2,
+                  n_heads=4, d_ff=64)
+cfg.dropout = 0.0
+m = CausalLM(cfg, compute_dtype=jnp.float32)
+params = m.init_params(jax.random.key(1))
+rng = np.random.default_rng(0)
+specs = [(int(rng.integers(3, 14)), int(rng.integers(1, 13)))
+         for _ in range(16)]
+prompts = [rng.integers(0, 17, (t0,)).astype(np.int32)
+           for t0, _ in specs]
+
+reg = telemetry.MetricsRegistry.get_default()
+compiles = lambda s: reg.counter(telemetry.JIT_COMPILES).value(site=s)
+fail = []
+eng = DecodeEngine(m, params, slots=4, page_size=8).start()
+d0, p0 = compiles("serving_decode"), compiles("serving_prefill")
+with ThreadPoolExecutor(max_workers=8) as ex:
+    handles = list(ex.map(
+        lambda pn: eng.submit(pn[0], pn[1]),
+        zip(prompts, [n for _, n in specs])))
+outs = [h.result(timeout=300) for h in handles]
+for p, (_, new), got in zip(prompts, specs, outs):
+    want = np.asarray(m.generate(
+        params, jnp.asarray(p[None, :], jnp.int32), new))[0]
+    if not np.array_equal(got, want):
+        fail.append(f"greedy mismatch for prompt len {p.size} / "
+                    f"new {new}: {got.tolist()} != {want.tolist()}")
+        break
+if compiles("serving_decode") != d0 or compiles("serving_prefill") != p0:
+    fail.append("post-startup requests paid a trace/compile at a "
+                "serving jit site (AOT warm pool regressed)")
+st = eng.stats()
+if st["warm_pool"]["misses"] != 0:
+    fail.append(f"{st['warm_pool']['misses']} warm-pool misses for "
+                "in-bucket traffic")
+lat = reg.histogram(telemetry.SERVING_REQUEST_LATENCY)
+if lat.count(reason="length") != 16:
+    fail.append(f"latency histogram has {lat.count(reason='length')} "
+                "samples, expected 16")
+pct = lat.percentiles(reason="length")
+if not (pct["p50"] > 0 and pct["p99"] >= pct["p50"]):
+    fail.append(f"latency percentiles not sane: {pct}")
+if not 0 < st["avg_occupancy"] <= 1:
+    fail.append(f"avg occupancy {st['avg_occupancy']} not in (0, 1]")
+if reg.gauge(telemetry.SERVING_KV_PAGE_UTILIZATION).value() != 0.0:
+    fail.append("KV pages not all freed after completion")
+if reg.histogram(telemetry.SERVING_TTFT).count() != 16:
+    fail.append("TTFT histogram incomplete")
+eng.shutdown()
+leaked = [t.name for t in threading.enumerate()
+          if t.is_alive() and t.name.startswith("ServingEngine")]
+if leaked:
+    fail.append(f"ServingEngine thread(s) survived shutdown: {leaked}")
+if fail:
+    sys.stderr.write("serving smoke FAILED:\n  " + "\n  ".join(fail)
+                     + "\n")
+    sys.exit(1)
+print(f"serving smoke OK: 16 mixed-length requests token-identical, "
+      f"avg occupancy {st['avg_occupancy']:.2f}, p50 "
+      f"{pct['p50']*1e3:.1f}ms p99 {pct['p99']*1e3:.1f}ms, 0 serving-"
+      "site compiles post-startup, clean shutdown")
+EOF
+servsmoke=$?
+if [ $servsmoke -ne 0 ]; then
+    echo "FATAL: serving smoke gate regressed" >&2
+    exit 1
+fi
 exit $rc

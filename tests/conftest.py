@@ -76,7 +76,8 @@ def pytest_sessionfinish(session, exitstatus):
             if t.is_alive() and t is not threading.main_thread()
             and (not t.daemon
                  or t.name.startswith(("DevicePrefetch",
-                                       "AsyncDataSet-ETL")))
+                                       "AsyncDataSet-ETL",
+                                       "ServingEngine")))
         ]
 
     deadline = time.time() + 2.0

@@ -72,6 +72,32 @@ class TestTraining:
         np.testing.assert_array_equal(
             cont, [[5, 6, 0, 1, 2], [1, 2, 3, 4, 5]])
 
+    def test_gen_cache_is_lru_not_fifo(self):
+        """Serving regression: with 9 shapes alternating against 2 hot
+        ones, the hot programs must stay compiled. The old FIFO
+        eviction (pop oldest-INSERTED) dropped the hottest program
+        precisely because it was compiled first."""
+        m = _model()
+        params = m.init_params(jax.random.key(0))
+
+        def gen(t0):
+            m.generate(params, jnp.zeros((1, t0), jnp.int32),
+                       max_new_tokens=1)
+
+        gen(3)
+        gen(4)
+        hot = {k: v for k, v in m._gen_cache.items()}
+        assert len(hot) == 2
+        for t0 in range(5, 12):      # 7 cold shapes -> 9 total
+            gen(t0)
+            gen(3)                   # hot shapes stay in rotation
+            gen(4)
+        assert len(m._gen_cache) <= 8
+        for key, fn in hot.items():
+            assert m._gen_cache.get(key) is fn, \
+                f"hot program {key} was evicted/recompiled (FIFO " \
+                "eviction regression)"
+
     def test_sampled_generation_shape_and_vocab(self):
         m = _model()
         params = m.init_params()
