@@ -31,8 +31,10 @@ from deeplearning4j_tpu.nn.conf.layers import LossLayer, OutputLayer
 from deeplearning4j_tpu.nn.multilayer.network import (
     _REGULARIZED_KEYS, _eval_mask, _uses_epoch_schedule,
 )
+from deeplearning4j_tpu.profiler import flight_recorder as _flight
 from deeplearning4j_tpu.profiler import model_health as _model_health
 from deeplearning4j_tpu.profiler import telemetry as _telemetry
+from deeplearning4j_tpu.profiler import tracing as _tracing
 
 
 class ComputationGraph:
@@ -553,6 +555,9 @@ class ComputationGraph:
         self._iteration += 1
         self._last_batch_size = int(
             next(iter(inputs.values())).shape[0]) if inputs else 0
+        # black box + request-scoped tracing (host-side only)
+        _flight.record_step("cg", self._iteration, t_step)
+        _tracing.record_train_step("cg", self._iteration, t_step)
         _telemetry.sample_device_memory()
         if hm is not None:
             hm.on_step(self, health, site="cg", jit_site="cg_step")

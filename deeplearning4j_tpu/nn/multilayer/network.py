@@ -35,8 +35,10 @@ from deeplearning4j_tpu.datasets.dataset import DataSet
 from deeplearning4j_tpu.datasets.iterator import DataSetIterator
 from deeplearning4j_tpu.learning.schedules import ISchedule, ScheduleType
 from deeplearning4j_tpu.learning.updaters import IUpdater, apply_updater
+from deeplearning4j_tpu.profiler import flight_recorder as _flight
 from deeplearning4j_tpu.profiler import model_health as _model_health
 from deeplearning4j_tpu.profiler import telemetry as _telemetry
+from deeplearning4j_tpu.profiler import tracing as _tracing
 
 
 def _eval_mask(ds):
@@ -653,6 +655,11 @@ class MultiLayerNetwork:
         self._score = loss
         self._iteration += 1
         self._last_batch_size = int(x.shape[0])
+        # black box + request-scoped tracing: host-side only (the
+        # score stays on device), disabled cost = one attribute read
+        _flight.record_step("mln", self._iteration, t_step,
+                            etl_ms=self._last_etl_ms)
+        _tracing.record_train_step("mln", self._iteration, t_step)
         # device-array references for listeners that recompute
         # gradients (StatsListener collect_gradients — the reference's
         # per-iteration gradient reports; free to keep, they alias the
@@ -746,6 +753,9 @@ class MultiLayerNetwork:
             self._score = loss
             self._iteration += 1
             self._last_batch_size = int(xc.shape[0])
+            _flight.record_step("mln_tbptt", self._iteration, t_step)
+            _tracing.record_train_step("mln_tbptt", self._iteration,
+                                       t_step)
             if hm is not None:
                 hm.on_step(self, health, site="mln",
                            jit_site="mln_tbptt_step")

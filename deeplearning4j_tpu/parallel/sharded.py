@@ -63,8 +63,10 @@ from deeplearning4j_tpu.parallel import zero as _zero
 from deeplearning4j_tpu.parallel.mesh import (
     build_mesh, maybe_init_distributed, put_replicated,
 )
+from deeplearning4j_tpu.profiler import flight_recorder as _flight
 from deeplearning4j_tpu.profiler import model_health as _model_health
 from deeplearning4j_tpu.profiler import telemetry as _telemetry
+from deeplearning4j_tpu.profiler import tracing as _tracing
 
 
 def _tmap(f, *trees):
@@ -980,6 +982,11 @@ class ShardedTrainer:
         model._iteration += 1
         first = x[0] if isinstance(x, (list, tuple)) else x
         model._last_batch_size = int(first.shape[0])
+        # black box + request-scoped tracing (host-side only)
+        _flight.record_step("sharded", model._iteration, t_step,
+                            mode=self.mode)
+        _tracing.record_train_step("sharded", model._iteration, t_step,
+                                   mode=self.mode)
         _telemetry.sample_device_memory()
         if hm is not None and health is not None:
             hm.on_step(model, health, site="sharded",

@@ -32,7 +32,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from deeplearning4j_tpu.ops import registry as _registry
-from deeplearning4j_tpu.profiler import telemetry
+from deeplearning4j_tpu.profiler import flight_recorder, telemetry, tracing
 from deeplearning4j_tpu.profiler.model_health import HealthMonitor
 
 
@@ -201,4 +201,5 @@ def trace(log_dir: str):
 
 __all__ = ["OpProfiler", "ProfilerConfig", "ProfilerMode",
            "NumericsException", "check_numerics", "start_trace",
-           "stop_trace", "trace", "telemetry", "HealthMonitor"]
+           "stop_trace", "trace", "telemetry", "HealthMonitor",
+           "tracing", "flight_recorder"]

@@ -106,6 +106,9 @@ SERVING_PREFILL_SECONDS = "dl4j_tpu_serving_prefill_seconds"
 INFERENCE_REQUEST_LATENCY = "dl4j_tpu_inference_request_latency_seconds"
 INFERENCE_QUEUE_DEPTH = "dl4j_tpu_inference_queue_depth"
 INFERENCE_BATCH_OCCUPANCY = "dl4j_tpu_inference_batch_occupancy"
+#: tracing + flight recorder (profiler/tracing.py, flight_recorder.py)
+SPANS_DROPPED = "dl4j_tpu_spans_dropped_total"
+INCIDENT_DUMPS = "dl4j_tpu_incident_dumps_total"
 
 
 def enabled() -> bool:
@@ -137,6 +140,25 @@ def _percentile(sorted_vals: List[float], q: float) -> float:
     return sorted_vals[idx]
 
 
+def _fmt_value(v: float) -> str:
+    """Prometheus sample-value rendering: the exposition format spells
+    non-finite values ``NaN`` / ``+Inf`` / ``-Inf`` (python's ``%g``
+    gives ``nan`` / ``inf``, which real scrapers reject)."""
+    if v != v:
+        return "NaN"
+    if v == float("inf"):
+        return "+Inf"
+    if v == float("-inf"):
+        return "-Inf"
+    return f"{v:g}"
+
+
+def _escape_help(text: str) -> str:
+    """# HELP escaping per the exposition format: backslash and
+    newline only (quotes are NOT escaped in help text)."""
+    return text.replace("\\", "\\\\").replace("\n", "\\n")
+
+
 # -------------------------------------------------------------- metrics
 class Counter:
     """Monotonic counter, optionally labelled (one value per label set)."""
@@ -161,10 +183,16 @@ class Counter:
         with self._lock:
             return sum(self._values.values())
 
+    def values(self) -> Dict[Tuple[Tuple[str, str], ...], float]:
+        """Copy of every label set's value, insertion-ordered."""
+        with self._lock:
+            return dict(self._values)
+
     def _expose(self) -> List[str]:
         with self._lock:
             items = sorted(self._values.items())
-        return [f"{self.name}{_fmt_labels(k)} {v:g}" for k, v in items]
+        return [f"{self.name}{_fmt_labels(k)} {_fmt_value(v)}"
+                for k, v in items]
 
     def _json(self) -> Any:
         with self._lock:
@@ -236,10 +264,10 @@ class Histogram:
         for k, (vals, cnt, tot) in snap.items():
             for q in self.QUANTILES:
                 qk = k + (("quantile", f"{q:g}"),)
-                out.append(
-                    f"{self.name}{_fmt_labels(qk)} {_percentile(vals, q):g}")
+                out.append(f"{self.name}{_fmt_labels(qk)} "
+                           f"{_fmt_value(_percentile(vals, q))}")
             out.append(f"{self.name}_count{_fmt_labels(k)} {cnt}")
-            out.append(f"{self.name}_sum{_fmt_labels(k)} {tot:g}")
+            out.append(f"{self.name}_sum{_fmt_labels(k)} {_fmt_value(tot)}")
         return out
 
     def _json(self) -> Any:
@@ -305,13 +333,16 @@ class MetricsRegistry:
             return self._metrics.get(name)
 
     def to_prometheus(self) -> str:
-        """Prometheus text exposition format 0.0.4."""
+        """Prometheus text exposition format 0.0.4: every metric gets
+        a ``# HELP`` (escaped) and ``# TYPE`` pair, label values are
+        escaped, non-finite samples render as NaN/+Inf/-Inf — real
+        scrapers ingest the output unmodified."""
         lines: List[str] = []
         with self._lock:
             metrics = list(self._metrics.values())
         for m in metrics:
-            if m.help:
-                lines.append(f"# HELP {m.name} {m.help}")
+            lines.append(f"# HELP {m.name} "
+                         f"{_escape_help(m.help)}".rstrip())
             lines.append(f"# TYPE {m.name} {m.kind}")
             lines.extend(m._expose())
         return "\n".join(lines) + "\n"
@@ -332,6 +363,10 @@ class MetricsRegistry:
 _trace_lock = threading.Lock()
 _trace_events: collections.deque = collections.deque(maxlen=50_000)
 _span_stack = threading.local()
+#: events evicted by the bounded buffer since the last flush into the
+#: SPANS_DROPPED counter (guarded by _trace_lock — the hot path pays
+#: one int increment, not a registry lookup per wrapped span)
+_spans_dropped_pending = 0
 
 
 def _now_us() -> float:
@@ -358,7 +393,15 @@ def record_span(name: str, t0: float, t1: Optional[float] = None,
     }
     if attrs:
         ev["args"] = {k: v for k, v in attrs.items()}
+    global _spans_dropped_pending
     with _trace_lock:
+        if _trace_events.maxlen is not None \
+                and len(_trace_events) == _trace_events.maxlen:
+            # the bounded buffer wrapped: the oldest event is gone, so
+            # exports from here on are TRUNCATED — count it (flushed
+            # into the SPANS_DROPPED counter at export time) so an
+            # incomplete trace is attributable, not silently short
+            _spans_dropped_pending += 1
         _trace_events.append(ev)
     if metric is not None:
         # depth/parent describe span nesting, not a metric dimension —
@@ -447,11 +490,46 @@ def timed_batches(iterable):
         yield item
 
 
+def flush_dropped_spans() -> None:
+    """Fold pending buffer-wrap evictions into the SPANS_DROPPED
+    counter. Called by every export path (chrome_trace, snapshot, the
+    UI's /metrics handler) so scrapes are exact without the record
+    hot path paying a registry lookup per wrapped span."""
+    global _spans_dropped_pending
+    with _trace_lock:
+        n, _spans_dropped_pending = _spans_dropped_pending, 0
+    if n:
+        MetricsRegistry.get_default().counter(
+            SPANS_DROPPED,
+            "trace events evicted when the bounded span buffer "
+            "wrapped (exports are truncated past this point)").inc(n)
+
+
+def recent_trace_events(n: int) -> List[Dict[str, Any]]:
+    """The newest ``n`` trace events, copying only that slice (a full
+    ``chrome_trace()`` copies the whole 50k-event buffer under the
+    lock — too heavy for per-poll aggregation)."""
+    import itertools
+
+    with _trace_lock:
+        k = len(_trace_events)
+        if k <= n:
+            return list(_trace_events)
+        return list(itertools.islice(_trace_events, k - n, k))
+
+
 def chrome_trace() -> Dict[str, Any]:
-    """Chrome trace-event JSON object (perfetto / chrome://tracing)."""
+    """Chrome trace-event JSON object (perfetto / chrome://tracing).
+    When the bounded buffer has wrapped, ``otherData.spans_dropped``
+    says how many events the export is missing."""
+    flush_dropped_spans()
     with _trace_lock:
         events = list(_trace_events)
-    return {"traceEvents": events, "displayTimeUnit": "ms"}
+    out: Dict[str, Any] = {"traceEvents": events, "displayTimeUnit": "ms"}
+    m = MetricsRegistry.get_default().peek(SPANS_DROPPED)
+    if m is not None and m.total() > 0:
+        out["otherData"] = {"spans_dropped": m.total()}
+    return out
 
 
 def export_chrome_trace(path: str) -> str:
@@ -680,6 +758,7 @@ def snapshot() -> Dict[str, Any]:
             "compiles": compiles.value(site=site),
             "compile_seconds": seconds.sum(site=site),
         }
+    flush_dropped_spans()
     out: Dict[str, Any] = {
         "jit_compiles_total": compiles.total(),
         "jit_compile_seconds_total": sum(
@@ -706,6 +785,21 @@ def snapshot() -> Dict[str, Any]:
     serving = serving_snapshot()
     if serving:
         out["serving"] = serving
+    # per-request tracing + flight recorder (lazy imports: both modules
+    # import telemetry; both snapshots are peek-style {} when inactive)
+    try:
+        from deeplearning4j_tpu.profiler import (
+            flight_recorder as _flight, tracing as _tracing,
+        )
+
+        tr = _tracing.snapshot()
+        if tr:
+            out["tracing"] = tr
+        fl = _flight.snapshot()
+        if fl:
+            out["flight_recorder"] = fl
+    except Exception:
+        pass
     return out
 
 
@@ -757,16 +851,19 @@ def reset() -> None:
     """Full telemetry reset: metrics, trace buffer, memory probe cache.
     (Instrumented-jit signature lists live on the network instances and
     reset with them.)"""
-    global _mem_supported
+    global _mem_supported, _spans_dropped_pending
     MetricsRegistry.get_default().reset()
     clear_trace()
+    with _trace_lock:
+        _spans_dropped_pending = 0
     _mem_supported = None
 
 
 __all__ = [
     "MetricsRegistry", "Counter", "Gauge", "Histogram",
-    "span", "record_span", "record_phase",
-    "chrome_trace", "export_chrome_trace", "clear_trace",
+    "span", "record_span", "record_phase", "flush_dropped_spans",
+    "chrome_trace", "recent_trace_events", "export_chrome_trace",
+    "clear_trace",
     "instrument_jit", "sample_device_memory", "snapshot",
     "model_health_snapshot", "serving_snapshot", "reset",
     "enabled", "set_enabled", "record_on_device_batch",
@@ -790,4 +887,5 @@ __all__ = [
     "SERVING_DECODE_STEP_SECONDS", "SERVING_PREFILL_SECONDS",
     "INFERENCE_REQUEST_LATENCY", "INFERENCE_QUEUE_DEPTH",
     "INFERENCE_BATCH_OCCUPANCY",
+    "SPANS_DROPPED", "INCIDENT_DUMPS",
 ]

@@ -11,11 +11,19 @@ Endpoints (stdlib http.server, daemon thread):
     POST /v1/serving/generate  {"prompt_ids": [...],
                                 "max_new_tokens": N,
                                 "temperature": 0.0, "eos_id": opt}
-                               -> {"tokens": [...], "ttft_ms": ...,
-                                   "latency_ms": ..., "finish_reason"}
+                               -> {"request_id", "tokens": [...],
+                                   "ttft_ms": ..., "latency_ms": ...,
+                                   "finish_reason", "trace_id"}
     GET  /v1/serving/info      -> model/engine metadata
     GET  /v1/serving/stats     -> live engine stats (occupancy,
-                                  queue, KV pages, warm pool)
+                                  queue, KV pages, warm pool, recent
+                                  request ids + finish reasons)
+    GET  /v1/serving/requests  -> live + recent request-trace
+                                  summaries (tracing on)
+    GET  /v1/serving/requests/<id>
+                               -> ONE request's traced timeline:
+                                  queue_wait -> prefill -> decode
+                                  bursts -> finish (profiler/tracing)
 
 Batching note: ``predict`` requests are served one-by-one; the
 TPU-side win comes from the jit-compiled forward reused across
@@ -128,6 +136,10 @@ class JsonModelServer:
             payload.get("sample_seed"))
         tokens = req.result(timeout=float(payload.get("timeout", 300)))
         return {
+            # request_id joins client logs against the server-side
+            # trace (GET /v1/serving/requests/<request_id>)
+            "request_id": req.request_id,
+            "trace_id": req.trace_id,
             "tokens": np.asarray(tokens).tolist(),
             "finish_reason": req.finish_reason,
             "ttft_ms": round(req.ttft_s * 1e3, 3)
@@ -174,6 +186,27 @@ class _InferenceHandler(BaseHTTPRequestHandler):
             if ms.engine is None:
                 return self._json({"error": "no decode engine"}, 404)
             return self._json(ms.engine.stats())
+        if path == "/v1/serving/requests":
+            from deeplearning4j_tpu.profiler import tracing
+
+            return self._json({
+                "tracing_enabled": tracing.enabled(),
+                "live": tracing.live_summaries(),
+                "recent": tracing.recent_summaries(),
+            })
+        if path.startswith("/v1/serving/requests/"):
+            from deeplearning4j_tpu.profiler import tracing
+
+            rid = path.rsplit("/", 1)[1]
+            tl = tracing.timeline(rid)
+            if tl is None:
+                hint = ("" if tracing.enabled() else
+                        " (tracing is off — set DL4J_TPU_TRACING=1 or "
+                        "tracing.set_enabled(True) before submitting)")
+                return self._json(
+                    {"error": f"no timeline for request {rid}{hint}"},
+                    404)
+            return self._json(tl)
         return self._json({"error": "not found"}, 404)
 
     def do_POST(self):

@@ -54,11 +54,19 @@ import numpy as np
 from jax import lax
 
 from deeplearning4j_tpu.nn.precision import int8_matmul, quantize_int8
+from deeplearning4j_tpu.profiler import flight_recorder as _flight
 from deeplearning4j_tpu.profiler import telemetry as _telemetry
+from deeplearning4j_tpu.profiler import tracing as _tracing
 from deeplearning4j_tpu.serving import kv_pages
 
 
 # ------------------------------------------------------------ requests
+#: PROCESS-wide request ids: the tracing registries and the
+#: /v1/serving/requests/<id> lookups key on request_id, so two engines
+#: in one process (or an engine restart) must not both mint id 0
+_REQUEST_IDS = itertools.count()
+
+
 class ServingRequest:
     """Handle for one submitted generation request.
 
@@ -80,6 +88,9 @@ class ServingRequest:
         self.finish_reason: Optional[str] = None   # length | eos | error
         self.ttft_s: Optional[float] = None
         self.latency_s: Optional[float] = None
+        #: per-request trace (profiler/tracing.py) — None with tracing
+        #: off; the timeline is served at /v1/serving/requests/<id>
+        self._trace = None
         self._t_submit = time.perf_counter()
         self._stream: "_queue.Queue" = _queue.Queue()
         self._done = threading.Event()
@@ -97,10 +108,19 @@ class ServingRequest:
         self.finish_reason = reason
         self._error = error
         self.latency_s = time.perf_counter() - self._t_submit
+        if self._trace is not None:
+            tn = time.perf_counter()
+            self._trace.event("finish", tn, tn, reason=reason,
+                              tokens=len(self.tokens))
+            _tracing.finish_trace(self._trace, reason=reason)
         self._stream.put(None)            # stream sentinel
         self._done.set()
 
     # -- client side ----------------------------------------------------
+    @property
+    def trace_id(self) -> Optional[str]:
+        return self._trace.trace_id if self._trace is not None else None
+
     @property
     def done(self) -> bool:
         return self._done.is_set()
@@ -236,7 +256,12 @@ class DecodeEngine:
         self._kd_width = int(
             jax.random.key_data(jax.random.key(0)).shape[-1])
         self._base_key = jax.random.key(seed)
-        self._req_counter = itertools.count()
+        self._req_counter = _REQUEST_IDS   # process-wide, see above
+        # sampling keys fold a PER-ENGINE ordinal (not the global
+        # request id): two engines built with the same seed must
+        # sample identically regardless of process-wide submission
+        # history
+        self._sample_counter = itertools.count()
         # host-side slot state (the jitted step's small inputs)
         S, P = self.slots, self.pages_per_slot
         self._tables = np.zeros((S, P), np.int32)
@@ -293,6 +318,9 @@ class DecodeEngine:
         self.n_dispatches = 0    # chunked device calls
         self.n_tokens = 0
         self._occupancy_sum = 0.0
+        # newest finished requests (id + finish reason + timings), so
+        # client logs can join against server traces via stats()
+        self._recent: "collections.deque" = collections.deque(maxlen=32)
 
     # ------------------------------------------------------ construction
     def _resolve_buckets(self, buckets) -> List[int]:
@@ -467,6 +495,9 @@ class DecodeEngine:
                 return self
             if self._dead is not None:
                 raise RuntimeError("engine has been shut down")
+            # black-box coverage: a crash that kills the process leaves
+            # an incident dump with the scheduler's last decisions
+            _flight.install_excepthook()
             if self._warm_start:
                 self._aot_warmup()
             self._thread = threading.Thread(
@@ -528,9 +559,17 @@ class DecodeEngine:
             raise RuntimeError("engine has been shut down")
         rid = next(self._req_counter)
         key = (jax.random.key(sample_seed) if sample_seed is not None
-               else jax.random.fold_in(self._base_key, rid))
+               else jax.random.fold_in(self._base_key,
+                                       next(self._sample_counter)))
         req = ServingRequest(rid, prompt, max_new_tokens, temperature,
                              eos_id, np.asarray(jax.random.key_data(key)))
+        req._trace = _tracing.new_trace(
+            "serving_request", request_id=rid,
+            prompt_tokens=int(prompt.size),
+            max_new_tokens=int(max_new_tokens))
+        _flight.record("serving_submit", request_id=rid,
+                       prompt_tokens=int(prompt.size),
+                       max_new_tokens=int(max_new_tokens))
         if self._thread is None:
             self.start()
         self.n_requests += 1
@@ -582,6 +621,11 @@ class DecodeEngine:
                          "high_water": self.pool.high_water},
             "warm_pool": {"hits": self._warm.hits,
                           "misses": self._warm.misses},
+            # newest-first: client logs join on request_id, per-request
+            # timelines at /v1/serving/requests/<id> (tracing on).
+            # .copy() is one C call (atomic under the GIL) — iterating
+            # the live deque would race the scheduler thread's appends
+            "recent_requests": list(reversed(self._recent.copy())),
         }
 
     def shutdown(self, timeout: float = 30.0) -> None:
@@ -615,6 +659,7 @@ class DecodeEngine:
                 self._decode_step()
         except BaseException as e:       # engine died: strand no one
             self._dead = e
+            _flight.incident("serving_engine_died", error=repr(e)[:400])
             self._fail_pending(e)
         finally:
             if self._dead is None:
@@ -673,12 +718,20 @@ class DecodeEngine:
             self.pool.k, self.pool.v, jnp.asarray(prompt),
             jnp.asarray(page_row), jnp.asarray(t0, jnp.int32))
         logits = np.asarray(last)
+        t_post = time.perf_counter()
         self.pool.k, self.pool.v = kpool, vpool
         _telemetry.record_span(
             "serving_prefill", t_pre,
             metric=_telemetry.SERVING_PREFILL_SECONDS, bucket=bucket)
         first = self._sample_first(req, logits)
         s = int(np.flatnonzero(~self._active)[0])
+        if req._trace is not None:
+            req._trace.event("queue_wait", req._t_submit, t_pre)
+            req._trace.event("prefill", t_pre, t_post, bucket=bucket,
+                             slot=s)
+        _flight.record("serving_admit", request_id=req.request_id,
+                       slot=s, bucket=bucket, pages=len(pages),
+                       queue_ms=round((t_pre - req._t_submit) * 1e3, 3))
         self._slot_req[s] = req
         self._slot_pages[s] = pages
         self._slot_emitted[s] = 0
@@ -772,6 +825,16 @@ class DecodeEngine:
         _telemetry.record_span(
             "serving_decode_step", t0,
             metric=_telemetry.SERVING_DECODE_STEP_SECONDS)
+        _flight.record("serving_burst", steps=steps,
+                       dispatches=len(chunks),
+                       occupancy=round(occupancy, 4))
+        if _tracing.enabled():
+            t_burst_end = time.perf_counter()
+            for s in active_idx:
+                r = self._slot_req[int(s)]
+                if r is not None and r._trace is not None:
+                    r._trace.event("decode_burst", t0, t_burst_end,
+                                   tokens=steps, slot=int(s))
         if _telemetry.enabled():
             reg = _telemetry.MetricsRegistry.get_default()
             reg.gauge(_telemetry.SERVING_SLOT_OCCUPANCY,
@@ -824,6 +887,17 @@ class DecodeEngine:
         self._dev_static = None      # roster changed: re-upload
         self.n_completed += 1
         req._finish(reason, error)
+        _flight.record("serving_evict", request_id=req.request_id,
+                       reason=reason, tokens=len(req.tokens))
+        self._recent.append({
+            "request_id": req.request_id,
+            "finish_reason": reason,
+            "tokens": len(req.tokens),
+            "latency_ms": round(req.latency_s * 1e3, 3)
+            if req.latency_s is not None else None,
+            "ttft_ms": round(req.ttft_s * 1e3, 3)
+            if req.ttft_s is not None else None,
+        })
         if _telemetry.enabled():
             _telemetry.MetricsRegistry.get_default().histogram(
                 _telemetry.SERVING_REQUEST_LATENCY,

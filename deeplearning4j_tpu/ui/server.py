@@ -19,6 +19,10 @@ so no CDN scripts). Endpoints:
     GET /trace                              -> Chrome trace-event JSON
                                                download (perfetto /
                                                chrome://tracing)
+    POST /telemetry/spans                   -> ingest a worker host's
+                                               span aggregate (multi-
+                                               host straggler view;
+                                               tracing.push_spans)
     GET /                                   -> dashboard HTML
 
 The /metrics and /telemetry endpoints read the process-wide
@@ -90,6 +94,12 @@ _DASHBOARD_HTML = """<!doctype html>
  <pre id="health"></pre></div>
 <div class="card"><b>Serving (continuous-batching decode engine)</b>
  <pre id="serving"></pre></div>
+<div class="row">
+<div class="card"><b>Requests (per-request traces)</b>
+ <pre id="requests"></pre></div>
+<div class="card"><b>Incidents (flight recorder)</b>
+ <pre id="incidents"></pre></div>
+</div>
 <script>
 async function j(u){const r=await fetch(u);return r.json()}
 function pick(o,lk){if(!lk)return null;if(o[lk])return o[lk];
@@ -126,14 +136,40 @@ function bars(cv,st){const c=cv.getContext('2d');
 function gv(M,n){const m=M[n];if(!m)return null;const v=m.values||{};
  const k=Object.keys(v)[0];return k==null?null:v[k]}
 function ms(h,q){return h&&h[q]!=null?(1e3*h[q]).toFixed(1)+'ms':'?'}
-let servingSkip=0;
+function reqline(r,tag){return '#'+r.request_id+' '+tag+
+ ' total='+fmt(r.total_ms)+'ms q='+fmt(r.queue_ms)+
+ ' pf='+fmt(r.prefill_ms)+' dec='+fmt(r.decode_ms)}
+let telemSkip=0;
 async function serving(){
- if(servingSkip>0){servingSkip--;return}
+ if(telemSkip>0){telemSkip--;return}
  const t=await j('/telemetry');
- const M=t.metrics||{},s=(t.snapshot||{}).serving;
+ const M=t.metrics||{},sn=t.snapshot||{},s=sn.serving;
+ const tr=sn.tracing,fl=sn.flight_recorder;
+ // back off to ~30s polls while the process has no serving engine,
+ // no tracing and no flight events — /telemetry copies the full
+ // trace buffer server-side, so idle dashboards should poll gently
+ if(!s&&!tr&&!fl)telemSkip=14;
+ const rq=document.getElementById('requests');
+ if(!tr)rq.textContent=
+  '(tracing off — DL4J_TPU_TRACING=1 or tracing.set_enabled(True))';
+ else{
+  const rows=(tr.live_requests||[]).map(r=>reqline(r,'LIVE')).concat(
+   (tr.recent_requests||[]).map(r=>reqline(r,r.finish_reason||'?')));
+  const hosts=Object.entries(tr.hosts||{}).map(([h,v])=>{
+   const sp=v.spans||{};const d=sp.device_step||sp.train_step;
+   return 'host '+h+(d?': step total='+fmt(d.total_ms)+'ms n='+d.count+
+    ' max='+fmt(d.max_ms)+'ms':': (no step spans)')});
+  rq.textContent=(rows.length?rows.join('\\n')
+   :'(no traced requests yet)')+
+   (hosts.length>1?'\\n--- hosts (straggler view) ---\\n'+
+    hosts.join('\\n'):'')}
+ const inc=document.getElementById('incidents');
+ inc.textContent=!fl?'(no flight-recorder events yet)':
+  'events='+fl.events+'/'+fl.capacity+' (seq '+fl.last_seq+')\\n'+
+  ((fl.incidents||[]).length?(fl.incidents||[]).map(
+   i=>i.reason+' -> '+i.path).join('\\n'):'(no incidents — good)');
  const el=document.getElementById('serving');
- if(!s){el.textContent='(no serving engine in this process)';
-  servingSkip=14;return}  // back off to ~30s polls while absent
+ if(!s){el.textContent='(no serving engine in this process)';return}
  const lat=gv(M,'dl4j_tpu_serving_request_latency_seconds');
  const tt=gv(M,'dl4j_tpu_serving_ttft_seconds');
  el.textContent=
@@ -228,6 +264,7 @@ class _Handler(BaseHTTPRequestHandler):
         if parts[0] == "metrics":
             from deeplearning4j_tpu.profiler import telemetry
 
+            telemetry.flush_dropped_spans()   # exact scrape
             body = telemetry.MetricsRegistry.get_default() \
                 .to_prometheus().encode()
             self.send_response(200)
@@ -266,6 +303,28 @@ class _Handler(BaseHTTPRequestHandler):
             return
         if parts[0] != "train":
             return self._json({"error": "not found"}, 404)
+        return self._train_routes(ui, parts)
+
+    def do_POST(self):
+        # multi-host span aggregation: worker hosts push their per-span
+        # aggregates here (tracing.push_spans) so the coordinator's
+        # /telemetry shows every host side by side — the straggler view
+        if self.path.rstrip("/") == "/telemetry/spans":
+            from deeplearning4j_tpu.profiler import tracing
+
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                if n > 4 << 20:   # a span AGGREGATE is kilobytes
+                    return self._json(
+                        {"error": "span summary too large"}, 413)
+                payload = json.loads(self.rfile.read(n) or b"{}")
+                tracing.ingest_host_spans(payload)
+                return self._json({"ok": True})
+            except Exception as e:
+                return self._json({"error": str(e)}, 400)
+        return self._json({"error": "not found"}, 404)
+
+    def _train_routes(self, ui, parts):
         if len(parts) == 2 and parts[1] == "sessions":
             return self._json(ui._sessions())
         if len(parts) == 3:

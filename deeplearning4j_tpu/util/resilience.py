@@ -67,6 +67,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from deeplearning4j_tpu.profiler import chaos as _chaos
+from deeplearning4j_tpu.profiler import flight_recorder as _flight
 from deeplearning4j_tpu.profiler import telemetry as _telemetry
 
 log = logging.getLogger("deeplearning4j_tpu")
@@ -293,9 +294,13 @@ class StepWatchdog:
     somehow wants a watchdog would upgrade to a persistent re-armed
     monitor thread."""
 
-    def __init__(self, deadline: float, context: str = "train_step"):
+    def __init__(self, deadline: float, context: str = "train_step",
+                 step: Optional[int] = None,
+                 flight_dir: Optional[str] = None):
         self.deadline = float(deadline)
         self.context = context
+        self.step = step
+        self.flight_dir = flight_dir
         self._timer: Optional[threading.Timer] = None
         self.fired = False
 
@@ -314,6 +319,17 @@ class StepWatchdog:
             "WATCHDOG: %s exceeded its %.1fs deadline — still waiting. "
             "Thread stacks:\n%s\ntelemetry: %s",
             self.context, self.deadline, _dump_stacks(), snap)
+        # the black box: everything leading UP to the stall. Dumped on
+        # its own short-lived thread — the wedged step can't do it
+        # itself, and the TIMER thread must stay prompt (its lifetime
+        # is part of the watchdog's contract; the dump fsyncs)
+        t = threading.Thread(
+            target=_flight.incident, args=("watchdog_stall",),
+            kwargs=dict(directory=self.flight_dir,
+                        context=self.context, step=self.step,
+                        deadline_s=self.deadline),
+            name="FT-incident-dump", daemon=True)
+        t.start()
 
     def __enter__(self) -> "StepWatchdog":
         self._timer = threading.Timer(self.deadline, self._fire)
@@ -357,6 +373,11 @@ class FaultTolerance:
       ``DevicePrefetchIterator`` feeding the loop (no-op otherwise).
     - ``step_deadline``: per-step watchdog deadline in seconds
       (None = watchdog off).
+    - ``flight_dir``: where flight-recorder incident dumps land
+      (watchdog stall / divergence rollback / preemption — see
+      profiler/flight_recorder.py). Defaults to
+      ``<checkpoint_dir>/incidents`` when a checkpoint_dir is set,
+      else the recorder's own default resolution.
 
     The object is reusable across fits — per-run state lives in a
     private ``_RunState`` created by ``run_fit``.
@@ -375,7 +396,8 @@ class FaultTolerance:
                  max_rollbacks: int = 8,
                  transfer_retries: int = 5,
                  transfer_backoff: float = 0.05,
-                 step_deadline: Optional[float] = None):
+                 step_deadline: Optional[float] = None,
+                 flight_dir: Optional[str] = None):
         self.checkpoint_dir = checkpoint_dir
         self.auto_resume = auto_resume
         self.keep_last = max(int(keep_last), 1)
@@ -394,7 +416,17 @@ class FaultTolerance:
         self.transfer_retries = int(transfer_retries)
         self.transfer_backoff = float(transfer_backoff)
         self.step_deadline = step_deadline
+        self.flight_dir = flight_dir
         self._preempt = threading.Event()
+
+    def incident_dir(self) -> Optional[str]:
+        """Where this policy's incident dumps go; None defers to the
+        flight recorder's default resolution."""
+        if self.flight_dir:
+            return self.flight_dir
+        if self.checkpoint_dir:
+            return os.path.join(self.checkpoint_dir, "incidents")
+        return None
 
     # ------------------------------------------------------------ misc
     @property
@@ -450,10 +482,11 @@ class FaultTolerance:
                 #                           Python (signal.signal(s,
                 #                           None) raises TypeError)
 
-    def _watchdog(self):
+    def _watchdog(self, step: Optional[int] = None):
         if self.step_deadline is None:
             return contextlib.nullcontext()
-        return StepWatchdog(self.step_deadline)
+        return StepWatchdog(self.step_deadline, step=step,
+                            flight_dir=self.incident_dir())
 
 
 def resolve_policy(fault_tolerance: Optional[FaultTolerance],
@@ -768,6 +801,15 @@ def _write_preemption_checkpoint(ft: FaultTolerance, adapter: _FitAdapter,
             _telemetry.FT_PREEMPTION_CHECKPOINTS,
             "resumable bundles written in response to a preemption "
             "signal").inc()
+    # the bundle restores the run; the flight dump explains the exit —
+    # written AFTER the bundle so a grace-period kill mid-dump still
+    # leaves a resumable job
+    _flight.incident("preemption_checkpoint",
+                     directory=ft.incident_dir(),
+                     iteration=adapter.model.getIterationCount(),
+                     bundle=path,
+                     epochs_remaining=meta["epochs_remaining"],
+                     mid_epoch=mid)
     log.warning("resilience: preemption checkpoint written to %s "
                 "(iteration %d, %d epoch(s) remaining%s) — exiting "
                 "cleanly", path, adapter.model.getIterationCount(),
@@ -812,6 +854,9 @@ def _restore_bundle(adapter: _FitAdapter, path: str) -> Dict[str, Any]:
         _telemetry.MetricsRegistry.get_default().counter(
             _telemetry.FT_AUTO_RESUMES,
             "training runs resumed from a preemption bundle").inc()
+    _flight.record("auto_resume", bundle=path,
+                   iteration=adapter.model.getIterationCount(),
+                   epochs_remaining=resume.get("epochs_remaining", 0))
     log.warning("resilience: auto-resumed from %s (iteration %d, epoch "
                 "%d, %d epoch(s) remaining%s)", path,
                 adapter.model.getIterationCount(),
@@ -833,6 +878,23 @@ def _maybe_snapshot(ft: FaultTolerance, adapter: _FitAdapter,
         st.since_snapshot = 0
 
 
+def _peek_loss_scale(model) -> Optional[float]:
+    """Live loss-scale gauge value (already synced per step by the
+    precision engine's telemetry mirror) — a host read, never a device
+    sync. None when the model has no loss scaling. With several models
+    loss-scaling in one process the gauge carries one label set per
+    site and this returns the last-registered one — per-model
+    attribution would need the site label threaded through the guard."""
+    if getattr(model, "_loss_scale_state", None) is None:
+        return None
+    m = _telemetry.MetricsRegistry.get_default().peek(
+        _telemetry.LOSS_SCALE)
+    if m is None:
+        return None
+    vals = list(m.values().values())
+    return vals[-1] if vals else None
+
+
 def _check_divergence(ft: FaultTolerance, adapter: _FitAdapter,
                       st: _RunState) -> bool:
     """Post-step loss inspection. Returns True when the step was rolled
@@ -840,6 +902,15 @@ def _check_divergence(ft: FaultTolerance, adapter: _FitAdapter,
     if ft.divergence_window <= 0:
         return False
     loss = float(adapter.model._score)   # the guard's per-step sync
+    # the guard pays the loss sync anyway — give the black box the
+    # per-step loss (+ live loss scale) for free. Enabled-check HERE:
+    # the kwargs (registry peek, iteration read) must not be evaluated
+    # on a disabled recorder's behalf
+    if _flight.get_default().enabled:
+        _flight.record("train_loss", step=st.steps_done,
+                       iteration=adapter.model.getIterationCount(),
+                       loss=loss,
+                       loss_scale=_peek_loss_scale(adapter.model))
     bad = not np.isfinite(loss)
     why = "non-finite loss"
     if bad:
@@ -882,6 +953,11 @@ def _check_divergence(ft: FaultTolerance, adapter: _FitAdapter,
         # be handed diverged/NaN params), but don't count a rollback
         # that is really an abort
         bad_iter = adapter.model.getIterationCount()
+        _flight.incident("divergence_abort",
+                         directory=ft.incident_dir(),
+                         iteration=bad_iter, why=why,
+                         nonfinite_layer=st.nonfinite_layer,
+                         rollbacks=st.rollbacks)
         adapter.restore(st.snapshot)
         raise DivergenceError(
             f"divergence guard exhausted its rollback budget "
@@ -899,6 +975,7 @@ def _check_divergence(ft: FaultTolerance, adapter: _FitAdapter,
                     "snapshot").inc(**labels)
         reg.counter(_telemetry.FT_SKIPPED_BATCHES,
                     "batches skipped after a divergence rollback").inc()
+    layer = st.nonfinite_layer
     st.nonfinite_layer = None   # provenance is per-event, not sticky
     discarded = adapter.model.getIterationCount() - 1 \
         - st.snapshot["iteration"]
@@ -910,6 +987,12 @@ def _check_divergence(ft: FaultTolerance, adapter: _FitAdapter,
                 "rollback)", why, adapter.model.getIterationCount(),
                 st.snapshot["iteration"], st.rollbacks, ft.max_rollbacks,
                 max(discarded, 0))
+    # post-mortem artifact: the black box holds the steps INTO the
+    # divergence (losses, health provenance, the offending step last)
+    _flight.incident("divergence_rollback", directory=ft.incident_dir(),
+                     iteration=adapter.model.getIterationCount(),
+                     rollback_to=st.snapshot["iteration"], why=why,
+                     nonfinite_layer=layer, rollback=st.rollbacks)
     adapter.restore(st.snapshot)
     st.since_snapshot = 0
     # the restore rewound the loss-scale engine's counters with the
@@ -931,6 +1014,9 @@ def run_fit(model, fault_tolerance: Optional[FaultTolerance], data,
     if ft is None:
         raise ValueError("run_fit requires a FaultTolerance policy or "
                          "an auto_resume directory")
+    # black-box coverage: a crash that escapes every guard still
+    # leaves an incident dump behind
+    _flight.install_excepthook()
     adapter = _FitAdapter(model, trainer)
     it, was_iterator = _as_iterator(data, labels, adapter)
     try:
@@ -1039,7 +1125,7 @@ def _run_epoch(ft: FaultTolerance, adapter: _FitAdapter, it,
         # point — the iterator's queue get or the divergence guard's
         # loss sync), so arming only around adapter.step would never
         # fire for exactly the stalls the watchdog exists to diagnose
-        with ft._watchdog():
+        with ft._watchdog(step=st.steps_done):
             t0 = time.perf_counter()
             if not it.hasNext():
                 return False

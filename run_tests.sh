@@ -726,4 +726,263 @@ if [ $servsmoke -ne 0 ]; then
     echo "FATAL: serving smoke gate regressed" >&2
     exit 1
 fi
+
+# Tracing smoke gate (docs/OBSERVABILITY.md "Tracing one request"):
+# (a) 8 mixed-length traced requests must each carry queue_wait /
+# prefill / decode_burst / finish spans, retrievable programmatically
+# AND over HTTP (/v1/serving/requests/<id>); responses and
+# /v1/serving/stats join on request_id. (b) a forced flight-recorder
+# dump must round-trip digest-valid through the JSONL loader. (c) with
+# tracing+flight disabled, serving tokens and fit params are
+# bit-identical to the enabled run, and the always-on instrumentation
+# costs <5% serving p50 (min-of-3 windows, 2ms absolute slack).
+TRACING_DIR=$(mktemp -d /tmp/dl4j_tracing_gate.XXXXXX)
+export DL4J_TPU_TRACING_GATE_DIR="$TRACING_DIR"
+env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu DL4J_TPU_TELEMETRY=1 \
+    DL4J_TPU_TRACING=1 python - <<'EOF'
+import json
+import os
+import sys
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.learning.updaters import Adam
+from deeplearning4j_tpu.models.gpt import CausalLM
+from deeplearning4j_tpu.models.transformer import tiny_config
+from deeplearning4j_tpu.nn.conf import (
+    DenseLayer, InputType, NeuralNetConfiguration, OutputLayer,
+)
+from deeplearning4j_tpu.nn.multilayer.network import MultiLayerNetwork
+from deeplearning4j_tpu.profiler import flight_recorder, tracing
+from deeplearning4j_tpu.remote.server import JsonModelServer
+from deeplearning4j_tpu.serving import DecodeEngine
+
+d = os.environ["DL4J_TPU_TRACING_GATE_DIR"]
+flight_recorder.configure(directory=d)
+fail = []
+
+cfg = tiny_config(vocab=17, max_len=48, d_model=32, n_layers=2,
+                  n_heads=4, d_ff=64)
+cfg.dropout = 0.0
+m = CausalLM(cfg, compute_dtype=jnp.float32)
+params = m.init_params(jax.random.key(1))
+rng = np.random.default_rng(0)
+specs = [(int(rng.integers(3, 14)), int(rng.integers(2, 13)))
+         for _ in range(8)]
+prompts = [rng.integers(0, 17, (t0,)).astype(np.int32)
+           for t0, _ in specs]
+eng = DecodeEngine(m, params, slots=4, page_size=8).start()
+srv = JsonModelServer(engine=eng)
+port = srv.start()
+
+# (a) every traced request carries the full span set, both paths
+reqs = [eng.submit(p, n) for p, (_, n) in zip(prompts, specs)]
+traced = [r.result(timeout=300) for r in reqs]
+for r in reqs:
+    tl = tracing.timeline(r.request_id)
+    if tl is None:
+        fail.append(f"request {r.request_id}: no timeline")
+        continue
+    names = [e["name"] for e in tl["events"]]
+    for want in ("queue_wait", "prefill", "decode_burst", "finish"):
+        if want not in names:
+            fail.append(f"request {r.request_id}: missing {want} "
+                        f"span (got {names})")
+    http_tl = json.loads(urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/v1/serving/requests/{r.request_id}",
+        timeout=10).read())
+    if http_tl["trace_id"] != tl["trace_id"]:
+        fail.append(f"request {r.request_id}: HTTP timeline mismatch")
+recent = {x["request_id"]: x
+          for x in eng.stats()["recent_requests"]}
+if not all(r.request_id in recent
+           and recent[r.request_id]["finish_reason"] == "length"
+           for r in reqs):
+    fail.append("stats recent_requests missing ids/finish reasons")
+body = json.dumps({"prompt_ids": [1, 2, 3],
+                   "max_new_tokens": 3}).encode()
+out = json.loads(urllib.request.urlopen(urllib.request.Request(
+    f"http://127.0.0.1:{port}/v1/serving/generate", data=body,
+    headers={"Content-Type": "application/json"}),
+    timeout=60).read())
+if "request_id" not in out:
+    fail.append("generate response missing request_id")
+
+# (b) forced dump round-trips through the JSONL loader
+flight_recorder.record("gate_marker", note=7)
+p = flight_recorder.incident("forced_gate")
+dump = flight_recorder.load_dump(p)
+if not dump["valid"]:
+    fail.append("forced dump digest-invalid")
+elif dump["events"][-1]["kind"] != "forced_gate" \
+        or not any(e["kind"] == "gate_marker" and e["note"] == 7
+                   for e in dump["events"]):
+    fail.append("forced dump did not round-trip its events")
+elif not (dump["requests"]["recent"] or dump["requests"]["live"]):
+    fail.append("forced dump carries no request timelines")
+
+# (c) off-mode parity + p50 overhead, interleaved min-of-3 windows
+def window():
+    rs = [eng.submit(p, n) for p, (_, n) in zip(prompts, specs)]
+    outs = [r.result(timeout=300) for r in rs]
+    lats = sorted(r.latency_s for r in rs)
+    return outs, lats[len(lats) // 2]
+
+p50 = {"on": [], "off": []}
+for rep in range(3):
+    for mode in ("on", "off"):
+        tracing.set_enabled(mode == "on")
+        flight_recorder.configure(enabled=(mode == "on"))
+        outs, med = window()
+        p50[mode].append(med)
+        if not all(np.array_equal(a, b)
+                   for a, b in zip(traced, outs)):
+            fail.append(f"{mode}-mode tokens differ from traced run")
+on, off = min(p50["on"]), min(p50["off"])
+if on > off * 1.05 + 0.002:
+    fail.append(f"tracing+flight p50 overhead too high: "
+                f"on={on*1e3:.2f}ms off={off*1e3:.2f}ms")
+srv.stop()
+eng.shutdown()
+
+# fit bit-equality: instrumentation on vs fully off
+def fit_once():
+    conf = (NeuralNetConfiguration.builder().seed(11)
+            .updater(Adam(1e-2)).list()
+            .layer(DenseLayer(n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_out=2, activation="softmax",
+                               loss="mcxent"))
+            .setInputType(InputType.feedForward(4)).build())
+    net = MultiLayerNetwork(conf).init()
+    rs = np.random.RandomState(0)
+    x = rs.randn(16, 4).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[rs.randint(0, 2, 16)]
+    for _ in range(5):
+        net.fit(x, y)
+    return net
+
+tracing.set_enabled(True)
+flight_recorder.configure(enabled=True)
+a = fit_once()
+tracing.set_enabled(False)
+flight_recorder.configure(enabled=False)
+b = fit_once()
+for la, lb in zip(jax.tree_util.tree_leaves((a.params_list,
+                                             a.opt_states)),
+                  jax.tree_util.tree_leaves((b.params_list,
+                                             b.opt_states))):
+    if not np.array_equal(np.asarray(la), np.asarray(lb)):
+        fail.append("fit with tracing+flight ON is not bit-identical "
+                    "to OFF")
+        break
+
+if fail:
+    sys.stderr.write("tracing smoke FAILED:\n  " + "\n  ".join(fail)
+                     + "\n")
+    sys.exit(1)
+print(f"tracing smoke OK: 8 traced requests with full span sets, "
+      f"request_id joins, dump round-trip, off-mode identical, p50 "
+      f"on={on*1e3:.1f}ms off={off*1e3:.1f}ms")
+EOF
+tracesmoke=$?
+env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu DL4J_TPU_TELEMETRY=1 \
+    DL4J_TPU_TRACING=1 python - <<'EOF'
+# End-to-end incident drill: a chaos-injected watchdog stall during a
+# traced serving+training run must leave a digest-valid flight dump
+# holding (a) the last N train-step events, (b) the stall as its LAST
+# event, and (c) the in-flight request timelines.
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.datasets import ArrayDataSetIterator
+from deeplearning4j_tpu.learning.updaters import Adam
+from deeplearning4j_tpu.models.gpt import CausalLM
+from deeplearning4j_tpu.models.transformer import tiny_config
+from deeplearning4j_tpu.nn.conf import (
+    DenseLayer, InputType, NeuralNetConfiguration, OutputLayer,
+)
+from deeplearning4j_tpu.nn.multilayer.network import MultiLayerNetwork
+from deeplearning4j_tpu.profiler import flight_recorder, telemetry
+from deeplearning4j_tpu.serving import DecodeEngine
+from deeplearning4j_tpu.util import FaultTolerance
+
+inc = os.path.join(os.environ["DL4J_TPU_TRACING_GATE_DIR"], "drill")
+flight_recorder.configure(directory=inc)
+fail = []
+
+cfg = tiny_config(vocab=17, max_len=64, d_model=32, n_layers=2,
+                  n_heads=4, d_ff=64)
+cfg.dropout = 0.0
+gm = CausalLM(cfg, compute_dtype=jnp.float32)
+gp = gm.init_params(jax.random.key(1))
+eng = DecodeEngine(gm, gp, slots=2, page_size=8).start()
+# a long request held in flight while training stalls
+long_req = eng.submit(np.arange(4, dtype=np.int32), 56)
+
+conf = (NeuralNetConfiguration.builder().seed(11).updater(Adam(1e-2))
+        .list()
+        .layer(DenseLayer(n_out=8, activation="tanh"))
+        .layer(OutputLayer(n_out=2, activation="softmax",
+                           loss="mcxent"))
+        .setInputType(InputType.feedForward(4)).build())
+net = MultiLayerNetwork(conf).init()
+rs = np.random.RandomState(0)
+x = rs.randn(16, 4).astype(np.float32)
+y = np.eye(2, dtype=np.float32)[rs.randint(0, 2, 16)]
+net.fit(x, y)               # plain warm steps feed the ring
+net.fit(x, y)
+# guarded fit: batch shape changes -> first step recompiles, which
+# always exceeds the 20ms watchdog deadline -> stall dump fires
+net.fit(ArrayDataSetIterator(x, y, 8), epochs=1,
+        fault_tolerance=FaultTolerance(divergence_window=0,
+                                       step_deadline=0.02,
+                                       flight_dir=inc))
+long_req.result(timeout=300)
+eng.shutdown()
+deadline = time.time() + 10
+dumps = []
+while not dumps and time.time() < deadline:
+    dumps = flight_recorder.list_dumps(inc)
+    time.sleep(0.05)
+if not dumps:
+    fail.append("watchdog stall produced no incident dump")
+else:
+    out = flight_recorder.load_dump(dumps[0])
+    if not out["valid"]:
+        fail.append(f"dump {dumps[0]} digest-invalid")
+    else:
+        if out["events"][-1]["kind"] != "watchdog_stall":
+            fail.append("dump's last event is not the stall: "
+                        f"{out['events'][-1]}")
+        if not any(e["kind"] == "train_step" for e in out["events"]):
+            fail.append("dump carries no train_step events")
+        tls = (out["requests"]["live"] + out["requests"]["recent"])
+        if not any(t.get("request_id") == long_req.request_id
+                   for t in tls):
+            fail.append("in-flight request timeline missing from dump")
+if telemetry.MetricsRegistry.get_default().counter(
+        telemetry.WATCHDOG_STALLS).total() < 1:
+    fail.append("watchdog stall counter not bumped")
+if fail:
+    sys.stderr.write("incident drill FAILED:\n  " + "\n  ".join(fail)
+                     + "\n")
+    sys.exit(1)
+print(f"incident drill OK: stall dump {os.path.basename(dumps[0])} "
+      f"with {len(flight_recorder.load_dump(dumps[0])['events'])} "
+      "events incl. in-flight request timeline")
+EOF
+drill=$?
+rm -rf "$TRACING_DIR"
+if [ $tracesmoke -ne 0 ] || [ $drill -ne 0 ]; then
+    echo "FATAL: tracing/incident smoke gate regressed (T=$tracesmoke D=$drill)" >&2
+    exit 1
+fi
 exit $rc

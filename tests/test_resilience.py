@@ -550,10 +550,13 @@ class TestTransferRetry:
 # watchdog
 # ======================================================================
 class TestWatchdog:
-    def test_fires_and_counts_on_deadline(self, caplog):
+    def test_fires_and_counts_on_deadline(self, caplog, tmp_path):
         telemetry.MetricsRegistry.get_default().reset()
         with caplog.at_level("ERROR", logger="deeplearning4j_tpu"):
-            with StepWatchdog(0.05, context="test_step") as wd:
+            # flight_dir keeps the fire's incident dump out of the
+            # shared tempdir default
+            with StepWatchdog(0.05, context="test_step",
+                              flight_dir=str(tmp_path)) as wd:
                 time.sleep(0.3)
         assert wd.fired
         reg = telemetry.MetricsRegistry.get_default()

@@ -124,6 +124,59 @@ class TestMetricsRegistry:
         text = reg.to_prometheus()
         assert 'k="va\\"l\\\\ue"' in text
 
+    def test_label_newline_escaping(self):
+        reg = telemetry.MetricsRegistry()
+        reg.counter("c").inc(1, k="a\nb")
+        assert 'k="a\\nb"' in reg.to_prometheus()
+
+    def test_help_and_type_for_every_metric(self):
+        # scraper conformance: every metric family gets a # HELP and a
+        # # TYPE line, even help-less ones, with HELP text escaped
+        reg = telemetry.MetricsRegistry()
+        reg.counter("c_total", "counts\nthings with \\slashes").inc()
+        reg.gauge("g")          # no help
+        reg.histogram("h", "a summary").observe(1.0)
+        text = reg.to_prometheus()
+        assert "# HELP c_total counts\\nthings with \\\\slashes" in text
+        assert "# HELP g" in text
+        assert "# HELP h a summary" in text
+        for name, kind in (("c_total", "counter"), ("g", "gauge"),
+                           ("h", "summary")):
+            assert f"# TYPE {name} {kind}" in text
+
+    def test_nonfinite_values_render_prometheus_style(self):
+        # the exposition format spells NaN / +Inf / -Inf; python's %g
+        # ("nan"/"inf") is rejected by real scrapers
+        reg = telemetry.MetricsRegistry()
+        reg.gauge("g").set(float("nan"), k="a")
+        reg.gauge("g").set(float("inf"), k="b")
+        reg.gauge("g").set(float("-inf"), k="c")
+        text = reg.to_prometheus()
+        assert 'g{k="a"} NaN' in text
+        assert 'g{k="b"} +Inf' in text
+        assert 'g{k="c"} -Inf' in text
+        assert "nan" not in text and "inf" not in text
+
+    def test_spans_dropped_counter_on_wrap(self, monkeypatch):
+        import collections
+
+        monkeypatch.setattr(telemetry, "_trace_events",
+                            collections.deque(maxlen=3))
+        t0 = time.perf_counter()
+        for i in range(5):
+            telemetry.record_span(f"s{i}", t0)
+        # the truncation is attributable on the export itself (the
+        # export paths flush the pending count into the counter — the
+        # record hot path only bumps an int under the trace lock)
+        assert telemetry.chrome_trace()["otherData"][
+            "spans_dropped"] == 2
+        c = telemetry.MetricsRegistry.get_default().counter(
+            telemetry.SPANS_DROPPED)
+        assert c.total() == 2
+        # flushing is not double-counting
+        telemetry.flush_dropped_spans()
+        assert c.total() == 2
+
     def test_json_dump(self):
         reg = telemetry.MetricsRegistry()
         reg.counter("c_total").inc(3)

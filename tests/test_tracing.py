@@ -1,0 +1,550 @@
+"""Per-request distributed tracing + black-box flight recorder
+(profiler/tracing.py, profiler/flight_recorder.py).
+
+Covers: trace contexts and the per-request timeline registry, the
+serving engine's submit -> queue_wait -> prefill -> decode_burst ->
+finish thread-through, the HTTP timeline endpoints, multi-host span
+aggregation, the flight-recorder ring + atomic digest-verified
+incident dumps, the JSONL loader, and the three incident triggers
+(chaos NaN rollback, watchdog stall, SIGTERM preemption) — each dump's
+LAST event must be the incident itself, at the failing step.
+"""
+
+import json
+import os
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets import ArrayDataSetIterator
+from deeplearning4j_tpu.learning.updaters import Adam
+from deeplearning4j_tpu.nn.conf import (
+    DenseLayer, InputType, NeuralNetConfiguration, OutputLayer,
+)
+from deeplearning4j_tpu.nn.multilayer.network import MultiLayerNetwork
+from deeplearning4j_tpu.profiler import (
+    chaos, flight_recorder, telemetry, tracing,
+)
+from deeplearning4j_tpu.util import FaultTolerance
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    telemetry.reset()
+    tracing.reset()
+    flight_recorder.reset()
+    was = tracing.enabled()
+    yield
+    tracing.set_enabled(was)
+    tracing.reset()
+    flight_recorder.reset()
+    telemetry.reset()
+
+
+def make_net(seed: int = 11):
+    conf = (NeuralNetConfiguration.builder().seed(seed)
+            .updater(Adam(1e-2)).list()
+            .layer(DenseLayer(n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_out=2, activation="softmax",
+                               loss="mcxent"))
+            .setInputType(InputType.feedForward(4)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+def fit_data():
+    rs = np.random.RandomState(0)
+    x = rs.randn(32, 4).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[rs.randint(0, 2, 32)]
+    return x, y
+
+
+# =====================================================================
+# trace contexts + registries
+# =====================================================================
+class TestTraceContext:
+    def test_disabled_is_none(self):
+        tracing.set_enabled(False)
+        assert tracing.new_trace("serving_request", request_id=1) is None
+        # finishing a None trace is a no-op, not an error
+        tracing.finish_trace(None, reason="length")
+
+    def test_events_land_in_chrome_trace_with_identity(self):
+        tracing.set_enabled(True)
+        ctx = tracing.new_trace("serving_request", request_id=42)
+        t0 = time.perf_counter()
+        ctx.event("prefill", t0, t0 + 0.001, bucket=16)
+        evs = telemetry.chrome_trace()["traceEvents"]
+        ev = next(e for e in evs if e["name"] == "prefill")
+        assert ev["args"]["trace"] == ctx.trace_id
+        assert ev["args"]["request"] == 42
+        assert ev["args"]["host"] == tracing.host_id()
+        assert ev["args"]["bucket"] == 16
+
+    def test_timeline_live_then_finished(self):
+        tracing.set_enabled(True)
+        ctx = tracing.new_trace("serving_request", request_id=7)
+        with ctx.span("queue_wait"):
+            pass
+        live = tracing.timeline(7)
+        assert live["finish_reason"] is None
+        assert [e["name"] for e in live["events"]] == ["queue_wait"]
+        tracing.finish_trace(ctx, reason="eos")
+        done = tracing.timeline(7)
+        assert done["finish_reason"] == "eos"
+        assert not any(s["request_id"] == 7
+                       for s in tracing.live_summaries())
+        assert tracing.timeline("nonexistent") is None
+
+    def test_recent_registry_bounded(self):
+        tracing.set_enabled(True)
+        for i in range(tracing._RECENT_MAX + 10):
+            tracing.finish_trace(
+                tracing.new_trace("serving_request", request_id=i),
+                reason="length")
+        assert tracing.timeline(0) is None          # evicted
+        assert tracing.timeline(tracing._RECENT_MAX + 9) is not None
+
+    def test_summary_phase_totals(self):
+        tracing.set_enabled(True)
+        ctx = tracing.new_trace("serving_request", request_id=3)
+        t0 = time.perf_counter()
+        ctx.event("queue_wait", t0, t0 + 0.002)
+        ctx.event("decode_burst", t0, t0 + 0.004, tokens=4)
+        ctx.event("decode_burst", t0, t0 + 0.006, tokens=2)
+        tracing.finish_trace(ctx, reason="length")
+        s = tracing.recent_summaries()[0]
+        assert s["queue_ms"] == pytest.approx(2.0, abs=0.5)
+        assert s["decode_ms"] == pytest.approx(10.0, abs=1.0)
+        assert s["spans"]["decode_burst"]["count"] == 2
+
+    def test_train_step_trace(self):
+        tracing.set_enabled(True)
+        t0 = time.perf_counter()
+        for i in range(3):
+            tracing.record_train_step("mln", i + 1, t0)
+        tl = tracing.timeline("train:mln")
+        assert [e["iteration"] for e in tl["events"]] == [1, 2, 3]
+        assert tl["kind"] == "train"
+
+    def test_train_trace_survives_request_flood(self):
+        # a flood of live request traces evicts oldest-first from the
+        # bounded live registry; the never-finishing train context is
+        # re-inserted newest every step, so it must survive
+        tracing.set_enabled(True)
+        t0 = time.perf_counter()
+        tracing.record_train_step("mln", 1, t0)
+        for i in range(tracing._LIVE_MAX + 5):
+            tracing.new_trace("serving_request", request_id=10_000 + i)
+        tracing.record_train_step("mln", 2, t0)
+        assert tracing.timeline("train:mln") is not None
+
+
+class TestHostAggregation:
+    def test_local_spans_aggregate(self):
+        with telemetry.span("device_step"):
+            time.sleep(0.001)
+        with telemetry.span("device_step"):
+            pass
+        hs = tracing.host_spans()
+        assert hs["host"] == tracing.host_id()
+        assert hs["spans"]["device_step"]["count"] == 2
+        assert hs["spans"]["device_step"]["total_ms"] > 0
+
+    def test_ingest_and_aggregate(self):
+        tracing.ingest_host_spans(
+            {"host": 5, "spans": {"device_step":
+                                  {"count": 9, "total_ms": 123.0}}})
+        agg = tracing.aggregate_hosts()
+        assert "5" in agg and str(tracing.host_id()) in agg
+        assert agg["5"]["spans"]["device_step"]["total_ms"] == 123.0
+        # a straggler-host push makes the snapshot non-empty even with
+        # local tracing off
+        tracing.set_enabled(False)
+        assert "5" in tracing.snapshot()["hosts"]
+
+    def test_ingest_rejects_hostless(self):
+        with pytest.raises(ValueError):
+            tracing.ingest_host_spans({"spans": {}})
+
+    def test_push_spans_http_roundtrip(self):
+        from deeplearning4j_tpu.ui.server import UIServer
+
+        ui = UIServer()
+        port = ui.start(port=0)
+        try:
+            with telemetry.span("device_step"):
+                pass
+            tracing.push_spans(f"http://127.0.0.1:{port}", host=9)
+            tel = json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/telemetry", timeout=10).read())
+            hosts = tel["snapshot"]["tracing"]["hosts"]
+            assert "9" in hosts
+            assert hosts["9"]["spans"]["device_step"]["count"] >= 1
+        finally:
+            ui.stop()
+
+
+# =====================================================================
+# flight recorder: ring + dumps + loader
+# =====================================================================
+class TestFlightRecorder:
+    def test_ring_wraps_and_seq_is_monotonic(self):
+        r = flight_recorder.FlightRecorder(capacity=4, enabled=True)
+        for i in range(10):
+            r.record("train_step", iteration=i)
+        evs = r.events()
+        assert len(evs) == 4
+        assert [e["iteration"] for e in evs] == [6, 7, 8, 9]
+        assert [e["seq"] for e in evs] == [7, 8, 9, 10]
+
+    def test_disabled_records_nothing(self, tmp_path):
+        r = flight_recorder.FlightRecorder(enabled=False,
+                                           directory=str(tmp_path))
+        r.record("train_step", iteration=1)
+        assert r.events() == []
+        assert r.incident("boom") is None
+        assert list(tmp_path.iterdir()) == []
+
+    def test_incident_dump_round_trips(self, tmp_path):
+        r = flight_recorder.FlightRecorder(capacity=8, enabled=True,
+                                           directory=str(tmp_path))
+        r.record("train_step", iteration=1, dispatch_ms=0.5)
+        r.record("serving_admit", request_id=0, slot=2)
+        path = r.incident("forced", note="test")
+        out = flight_recorder.load_dump(path)
+        assert out["valid"]
+        assert out["manifest"]["reason"] == "forced"
+        assert out["manifest"]["event_count"] == 3
+        kinds = [e["kind"] for e in out["events"]]
+        assert kinds == ["train_step", "serving_admit", "forced"]
+        assert out["events"][-1]["note"] == "test"
+        assert out["events"][-1]["seq"] == out["manifest"]["last_seq"]
+        assert "traceEvents" in out["trace"]
+        assert set(out["requests"]) == {"live", "recent"}
+        assert flight_recorder.list_dumps(str(tmp_path)) == [path]
+        # counter labelled by reason
+        assert telemetry.MetricsRegistry.get_default().counter(
+            telemetry.INCIDENT_DUMPS).value(reason="forced") == 1
+        # request timelines must survive sanitization as STRUCTURE,
+        # not repr strings (the events sit 4-5 levels deep)
+        tracing.set_enabled(True)
+        ctx = tracing.new_trace("serving_request", request_id=11)
+        with ctx.span("prefill", bucket=16):
+            pass
+        out2 = flight_recorder.load_dump(r.incident("forced2"))
+        live = {t["request_id"]: t for t in out2["requests"]["live"]}
+        ev = live[11]["events"][0]
+        assert isinstance(ev, dict) and ev["name"] == "prefill"
+        assert ev["bucket"] == 16
+
+    def test_tampered_dump_is_invalid(self, tmp_path):
+        r = flight_recorder.FlightRecorder(enabled=True,
+                                           directory=str(tmp_path))
+        r.record("train_step", iteration=1)
+        path = r.incident("forced")
+        with open(os.path.join(path, "events.jsonl"), "a") as f:
+            f.write('{"seq": 999, "kind": "forged"}\n')
+        assert not flight_recorder.load_dump(path)["valid"]
+
+    def test_sanitize_non_finite_and_arrays(self, tmp_path):
+        r = flight_recorder.FlightRecorder(enabled=True,
+                                           directory=str(tmp_path))
+        r.record("train_loss", loss=float("nan"),
+                 spike=float("inf"), norm=np.float32(2.5),
+                 n=np.int64(3))
+        out = flight_recorder.load_dump(r.incident("forced"))
+        assert out["valid"]
+        ev = out["events"][0]
+        assert ev["loss"] == "nan" and ev["spike"] == "inf"
+        assert ev["norm"] == 2.5 and ev["n"] == 3
+
+    def test_incident_terminal_event_is_atomic_with_snapshot(self,
+                                                             tmp_path):
+        """Events recorded AFTER the incident snapshot must not appear
+        in the dump — the last dumped event is always the incident."""
+        import threading
+
+        r = flight_recorder.FlightRecorder(enabled=True,
+                                           directory=str(tmp_path))
+        stop = threading.Event()
+
+        def noisy():
+            i = 0
+            while not stop.is_set():
+                r.record("serving_burst", i=i)
+                i += 1
+
+        t = threading.Thread(target=noisy, daemon=True)
+        t.start()
+        try:
+            for _ in range(5):
+                out = flight_recorder.load_dump(r.incident("probe"))
+                assert out["valid"]
+                assert out["events"][-1]["kind"] == "probe"
+        finally:
+            stop.set()
+            t.join()
+
+    def test_configure_default_instance(self, tmp_path):
+        flight_recorder.configure(directory=str(tmp_path), capacity=6)
+        for i in range(9):
+            flight_recorder.record("x", i=i)
+        r = flight_recorder.get_default()
+        assert len(r.events()) == 6
+        path = flight_recorder.incident("forced")
+        assert path.startswith(str(tmp_path))
+        snap = flight_recorder.snapshot()
+        assert snap["last_incident"] == path
+        assert snap["incidents"][0]["reason"] == "forced"
+
+    def test_excepthook_dumps(self, tmp_path):
+        import sys
+
+        flight_recorder.configure(directory=str(tmp_path))
+        flight_recorder.record("train_step", iteration=1)
+        prev = sys.excepthook
+        try:
+            flight_recorder.install_excepthook()
+            try:
+                raise RuntimeError("synthetic crash")
+            except RuntimeError:
+                sys.excepthook(*sys.exc_info())
+        finally:
+            sys.excepthook = prev
+            flight_recorder._hook_installed = False
+        dumps = flight_recorder.list_dumps(str(tmp_path))
+        assert len(dumps) == 1
+        out = flight_recorder.load_dump(dumps[0])
+        assert out["events"][-1]["kind"] == "unhandled_exception"
+        assert "synthetic crash" in out["events"][-1]["error"]
+
+
+# =====================================================================
+# incident triggers end to end (chaos-injected)
+# =====================================================================
+class TestIncidentTriggers:
+    def test_nan_rollback_dumps_with_failing_step_last(self, tmp_path):
+        x, y = fit_data()
+        net = make_net()
+        ft = FaultTolerance(checkpoint_dir=str(tmp_path),
+                            divergence_window=4, snapshot_every=1)
+        with chaos.installed(chaos.ChaosConfig(nan_steps=(2,))):
+            net.fit(ArrayDataSetIterator(x, y, 8), epochs=2,
+                    fault_tolerance=ft)
+        dumps = flight_recorder.list_dumps(
+            os.path.join(str(tmp_path), "incidents"))
+        assert len(dumps) == 1
+        out = flight_recorder.load_dump(dumps[0])
+        assert out["valid"]
+        last = out["events"][-1]
+        assert last["kind"] == "divergence_rollback"
+        # NaN batch at ordinal 2 fails the 3rd step -> iteration 3
+        assert last["iteration"] == 3
+        assert last["why"].startswith("non-finite loss")
+        # the black box holds the path INTO the incident: per-step
+        # events and the non-finite loss itself (stringified NaN)
+        assert any(e["kind"] == "train_step" for e in out["events"])
+        bad = [e for e in out["events"] if e["kind"] == "train_loss"
+               and e["iteration"] == 3]
+        assert bad and bad[-1]["loss"] == "nan"
+
+    def test_watchdog_stall_dumps(self, tmp_path):
+        x, y = fit_data()
+        net = make_net()
+        # 20ms deadline: the first step's jit compile always exceeds it
+        ft = FaultTolerance(checkpoint_dir=str(tmp_path),
+                            divergence_window=0, step_deadline=0.02)
+        net.fit(ArrayDataSetIterator(x, y, 8), epochs=1,
+                fault_tolerance=ft)
+        deadline = time.time() + 10
+        dumps = []
+        while not dumps and time.time() < deadline:
+            dumps = flight_recorder.list_dumps(
+                os.path.join(str(tmp_path), "incidents"))
+            time.sleep(0.05)
+        assert dumps, "watchdog stall produced no incident dump"
+        # the first stall is the first step (jit compile >> deadline);
+        # slow CI machines may stall later steps too — find step 0
+        stalls = []
+        for p in dumps:
+            out = flight_recorder.load_dump(p)
+            assert out["valid"]
+            stalls.extend(e for e in out["events"]
+                          if e["kind"] == "watchdog_stall")
+        assert any(e["step"] == 0 for e in stalls), stalls
+        assert all(e["context"] == "train_step" for e in stalls)
+        assert telemetry.MetricsRegistry.get_default().counter(
+            telemetry.WATCHDOG_STALLS).total() >= 1
+
+    def test_sigterm_preemption_dumps(self, tmp_path):
+        from deeplearning4j_tpu.util.resilience import (
+            latest_valid_bundle,
+        )
+
+        x, y = fit_data()
+        net = make_net()
+        ft = FaultTolerance(checkpoint_dir=str(tmp_path),
+                            divergence_window=0)
+        with chaos.installed(chaos.ChaosConfig(preempt_at_step=3)):
+            net.fit(ArrayDataSetIterator(x, y, 8), epochs=3,
+                    fault_tolerance=ft)
+        assert latest_valid_bundle(str(tmp_path)) is not None
+        dumps = flight_recorder.list_dumps(
+            os.path.join(str(tmp_path), "incidents"))
+        assert len(dumps) == 1
+        out = flight_recorder.load_dump(dumps[0])
+        assert out["valid"]
+        last = out["events"][-1]
+        assert last["kind"] == "preemption_checkpoint"
+        assert last["iteration"] == 3       # preempted after step 3
+        assert "bundle-" in last["bundle"]
+
+    def test_flight_dir_knob_overrides(self, tmp_path):
+        ft = FaultTolerance(checkpoint_dir="/ckpt",
+                            flight_dir=str(tmp_path / "fl"))
+        assert ft.incident_dir() == str(tmp_path / "fl")
+        assert FaultTolerance(checkpoint_dir="/ckpt").incident_dir() \
+            == os.path.join("/ckpt", "incidents")
+        assert FaultTolerance().incident_dir() is None
+
+
+# =====================================================================
+# serving engine thread-through + HTTP endpoints
+# =====================================================================
+@pytest.fixture(scope="module")
+def gpt():
+    from deeplearning4j_tpu.models.gpt import CausalLM
+    from deeplearning4j_tpu.models.transformer import tiny_config
+
+    cfg = tiny_config(vocab=17, max_len=48, d_model=32, n_layers=2,
+                      n_heads=4, d_ff=64)
+    cfg.dropout = 0.0
+    m = CausalLM(cfg, compute_dtype=jnp.float32)
+    return m, m.init_params(jax.random.key(1))
+
+
+class TestServingTracing:
+    def test_request_timeline_spans(self, gpt):
+        from deeplearning4j_tpu.serving import DecodeEngine
+
+        tracing.set_enabled(True)
+        m, params = gpt
+        with DecodeEngine(m, params, slots=2, page_size=8) as eng:
+            reqs = [eng.submit(np.arange(1, 4 + i, dtype=np.int32),
+                               3 + i) for i in range(3)]
+            for r in reqs:
+                r.result(timeout=60)
+        for r in reqs:
+            assert r.trace_id is not None
+            tl = tracing.timeline(r.request_id)
+            names = [e["name"] for e in tl["events"]]
+            assert names[0] == "queue_wait"
+            assert names[1] == "prefill"
+            assert "decode_burst" in names
+            assert names[-1] == "finish"
+            assert tl["finish_reason"] == "length"
+            assert tl["attrs"]["prompt_tokens"] == r.prompt.size
+            decoded = sum(e.get("tokens", 0) for e in tl["events"]
+                          if e["name"] == "decode_burst")
+            # bursts decode every slot lane; this request EMITTED
+            # max_new_tokens - 1 of them after the prefill-sampled first
+            assert decoded >= r.max_new_tokens - 1
+        # scheduler decisions landed in the black box
+        kinds = {e["kind"] for e in flight_recorder.get_default().events()}
+        assert {"serving_submit", "serving_admit", "serving_burst",
+                "serving_evict"} <= kinds
+
+    def test_stats_and_responses_carry_request_id(self, gpt):
+        from deeplearning4j_tpu.remote.server import JsonModelServer
+        from deeplearning4j_tpu.serving import DecodeEngine
+
+        tracing.set_enabled(True)
+        m, params = gpt
+        with DecodeEngine(m, params, slots=2, page_size=8) as eng:
+            srv = JsonModelServer(engine=eng)
+            port = srv.start()
+            try:
+                body = json.dumps({"prompt_ids": [1, 2, 3],
+                                   "max_new_tokens": 4}).encode()
+                rq = urllib.request.Request(
+                    f"http://127.0.0.1:{port}/v1/serving/generate",
+                    data=body,
+                    headers={"Content-Type": "application/json"})
+                out = json.loads(
+                    urllib.request.urlopen(rq, timeout=60).read())
+                assert out["finish_reason"] == "length"
+                rid = out["request_id"]
+                assert isinstance(rid, int)
+                assert out["trace_id"]
+                # stats join: request_id + finish reason
+                st = json.loads(urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/v1/serving/stats",
+                    timeout=10).read())
+                rec = st["recent_requests"][0]
+                assert rec["request_id"] == rid
+                assert rec["finish_reason"] == "length"
+                assert rec["latency_ms"] > 0
+                # one request's timeline over HTTP
+                tl = json.loads(urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/v1/serving/requests/{rid}",
+                    timeout=10).read())
+                assert tl["request_id"] == rid
+                assert {e["name"] for e in tl["events"]} >= \
+                    {"queue_wait", "prefill", "finish"}
+                # the listing includes it too
+                lst = json.loads(urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/v1/serving/requests",
+                    timeout=10).read())
+                assert any(s["request_id"] == rid
+                           for s in lst["recent"])
+                with pytest.raises(urllib.error.HTTPError) as ei:
+                    urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}"
+                        "/v1/serving/requests/424242", timeout=10)
+                assert ei.value.code == 404
+            finally:
+                srv.stop()
+
+    def test_request_ids_unique_across_engines(self, gpt):
+        # the trace registries and HTTP lookups key on request_id —
+        # two engines in one process must not both mint id N
+        from deeplearning4j_tpu.serving import DecodeEngine
+
+        tracing.set_enabled(True)
+        m, params = gpt
+        with DecodeEngine(m, params, slots=2, page_size=8) as a, \
+                DecodeEngine(m, params, slots=2, page_size=8) as b:
+            ra = a.submit(np.arange(1, 5, dtype=np.int32), 2)
+            rb = b.submit(np.arange(1, 5, dtype=np.int32), 2)
+            ra.result(60)
+            rb.result(60)
+        assert ra.request_id != rb.request_id
+        assert tracing.timeline(ra.request_id)["trace_id"] == ra.trace_id
+        assert tracing.timeline(rb.request_id)["trace_id"] == rb.trace_id
+
+    def test_tracing_off_is_token_identical_and_unlisted(self, gpt):
+        from deeplearning4j_tpu.serving import DecodeEngine
+
+        m, params = gpt
+        prompts = [np.arange(2, 9, dtype=np.int32),
+                   np.arange(1, 5, dtype=np.int32)]
+
+        def run():
+            with DecodeEngine(m, params, slots=2, page_size=8) as eng:
+                rs = [eng.submit(p, 5) for p in prompts]
+                return [r.result(timeout=60) for r in rs], rs
+
+        tracing.set_enabled(False)
+        off, off_reqs = run()
+        assert all(r.trace_id is None for r in off_reqs)
+        assert all(tracing.timeline(r.request_id) is None
+                   for r in off_reqs)
+        tracing.set_enabled(True)
+        on, _ = run()
+        for a, b in zip(off, on):
+            assert np.array_equal(a, b)
