@@ -285,6 +285,7 @@ def _gpt_decode_metrics() -> dict:
     standalone bench keeps the full-size knobs."""
     from bench_gpt_decode import (
         build_model, decode_metrics, engine_ab, mixed_requests,
+        prefix_ab,
     )
 
     m, params = build_model(layers=8, d_model=512, heads=8, d_ff=2048,
@@ -303,6 +304,17 @@ def _gpt_decode_metrics() -> dict:
         "serving_engine_occupancy": ab["engine_occupancy"],
         "serving_greedy_parity": ab["greedy_parity"],
     }
+    # warm-prefix TTFT on a shared-system-prompt workload (the prefix
+    # cache's headline metric; warm-vs-cold token identity is the gate)
+    pab = prefix_ab(m, params, n_users=12, system_len=128, user_len=32,
+                    new=32, slots=8, page_size=16)
+    out.update({
+        "serving_prefix_cold_ttft_ms": pab["cold_ttft_ms"],
+        "serving_prefix_warm_ttft_ms": pab["warm_ttft_ms"],
+        "serving_prefix_warm_ttft_speedup": pab["warm_ttft_speedup"],
+        "serving_prefix_token_identical": pab["warm_token_identical"],
+        "serving_prefix_hit_tokens_mean": pab["warm_hit_tokens_mean"],
+    })
     return out
 
 

@@ -380,9 +380,10 @@ class GenerativeInference:
 
     def submit(self, prompt_ids, max_new_tokens: int,
                temperature: float = 0.0, eos_id=None,
-               sample_seed=None):
+               sample_seed=None, session_id=None):
         return self.engine.submit(prompt_ids, max_new_tokens,
-                                  temperature, eos_id, sample_seed)
+                                  temperature, eos_id, sample_seed,
+                                  session_id=session_id)
 
     # ------------------------------------------------------------ stats
     @property

@@ -102,6 +102,19 @@ SERVING_WARM_MISSES = "dl4j_tpu_serving_warm_pool_misses_total"
 SERVING_DECODE_STEPS = "dl4j_tpu_serving_decode_steps_total"
 SERVING_DECODE_STEP_SECONDS = "dl4j_tpu_serving_decode_step_seconds"
 SERVING_PREFILL_SECONDS = "dl4j_tpu_serving_prefill_seconds"
+#: cross-request KV reuse (serving/prefix_cache.py, sessions.py)
+SERVING_PREFIX_HITS = "dl4j_tpu_serving_prefix_cache_hits_total"
+SERVING_PREFIX_MISSES = "dl4j_tpu_serving_prefix_cache_misses_total"
+SERVING_PREFIX_HIT_TOKENS = \
+    "dl4j_tpu_serving_prefix_cache_hit_tokens_total"
+SERVING_PREFIX_EVICTED_PAGES = \
+    "dl4j_tpu_serving_prefix_cache_evicted_pages_total"
+SERVING_PREFIX_CACHED_PAGES = "dl4j_tpu_serving_prefix_cached_pages"
+SERVING_SHARED_PAGES = "dl4j_tpu_serving_shared_kv_pages"
+SERVING_PINNED_PAGES = "dl4j_tpu_serving_session_pinned_pages"
+SERVING_SESSION_EVICTIONS = \
+    "dl4j_tpu_serving_session_evictions_total"
+SERVING_WARM_TTFT = "dl4j_tpu_serving_warm_ttft_seconds"
 #: queued dynamic-batching inference (parallel/wrapper.py)
 INFERENCE_REQUEST_LATENCY = "dl4j_tpu_inference_request_latency_seconds"
 INFERENCE_QUEUE_DEPTH = "dl4j_tpu_inference_queue_depth"
@@ -820,7 +833,19 @@ def serving_snapshot() -> Dict[str, Any]:
                        SERVING_KV_PAGE_UTILIZATION),
                       ("warm_pool_hits", SERVING_WARM_HITS),
                       ("warm_pool_misses", SERVING_WARM_MISSES),
-                      ("decode_steps", SERVING_DECODE_STEPS)):
+                      ("decode_steps", SERVING_DECODE_STEPS),
+                      ("prefix_cache_hits", SERVING_PREFIX_HITS),
+                      ("prefix_cache_misses", SERVING_PREFIX_MISSES),
+                      ("prefix_cache_hit_tokens",
+                       SERVING_PREFIX_HIT_TOKENS),
+                      ("prefix_cached_pages",
+                       SERVING_PREFIX_CACHED_PAGES),
+                      ("prefix_cache_evicted_pages",
+                       SERVING_PREFIX_EVICTED_PAGES),
+                      ("shared_kv_pages", SERVING_SHARED_PAGES),
+                      ("session_pinned_pages", SERVING_PINNED_PAGES),
+                      ("session_evictions", SERVING_SESSION_EVICTIONS),
+                      ("warm_ttft", SERVING_WARM_TTFT)):
         m = reg.peek(name)
         if m is not None:
             out[key] = m._json()
@@ -885,6 +910,11 @@ __all__ = [
     "SERVING_KV_PAGE_UTILIZATION", "SERVING_WARM_HITS",
     "SERVING_WARM_MISSES", "SERVING_DECODE_STEPS",
     "SERVING_DECODE_STEP_SECONDS", "SERVING_PREFILL_SECONDS",
+    "SERVING_PREFIX_HITS", "SERVING_PREFIX_MISSES",
+    "SERVING_PREFIX_HIT_TOKENS", "SERVING_PREFIX_EVICTED_PAGES",
+    "SERVING_PREFIX_CACHED_PAGES", "SERVING_SHARED_PAGES",
+    "SERVING_PINNED_PAGES", "SERVING_SESSION_EVICTIONS",
+    "SERVING_WARM_TTFT",
     "INFERENCE_REQUEST_LATENCY", "INFERENCE_QUEUE_DEPTH",
     "INFERENCE_BATCH_OCCUPANCY",
     "SPANS_DROPPED", "INCIDENT_DUMPS",

@@ -226,6 +226,7 @@ def _summarize(d: Dict[str, Any]) -> Dict[str, Any]:
         "finish_reason": d["finish_reason"],
         "total_ms": round(end, 3),
         "queue_ms": total("queue_wait"),
+        "prefix_lookup_ms": total("prefix_lookup"),
         "prefill_ms": total("prefill"),
         "decode_ms": total("decode_burst"),
         "events": sum(c for c, _ in phases.values()),

@@ -133,7 +133,8 @@ class JsonModelServer:
             int(payload.get("max_new_tokens", 16)),
             float(payload.get("temperature", 0.0)),
             payload.get("eos_id"),
-            payload.get("sample_seed"))
+            payload.get("sample_seed"),
+            session_id=payload.get("session_id"))
         tokens = req.result(timeout=float(payload.get("timeout", 300)))
         return {
             # request_id joins client logs against the server-side
@@ -142,6 +143,11 @@ class JsonModelServer:
             "trace_id": req.trace_id,
             "tokens": np.asarray(tokens).tolist(),
             "finish_reason": req.finish_reason,
+            # prompt tokens served from cached KV (prefix cache /
+            # sticky session) instead of prefill compute; session_id
+            # echoes the sticky-session key the server pinned under
+            "cache_hit_tokens": req.cache_hit_tokens,
+            "session_id": req.session_id,
             "ttft_ms": round(req.ttft_s * 1e3, 3)
             if req.ttft_s is not None else None,
             "latency_ms": round(req.latency_s * 1e3, 3)
@@ -186,6 +192,10 @@ class _InferenceHandler(BaseHTTPRequestHandler):
             if ms.engine is None:
                 return self._json({"error": "no decode engine"}, 404)
             return self._json(ms.engine.stats())
+        if path == "/v1/serving/prefix_cache":
+            if ms.engine is None:
+                return self._json({"error": "no decode engine"}, 404)
+            return self._json(ms.engine.prefix_stats())
         if path == "/v1/serving/requests":
             from deeplearning4j_tpu.profiler import tracing
 
@@ -237,17 +247,40 @@ class JsonRemoteInference:
         return np.asarray(out["output"])
 
     def generate(self, prompt_ids, max_new_tokens: int,
-                 temperature: float = 0.0, eos_id=None) -> np.ndarray:
+                 temperature: float = 0.0, eos_id=None,
+                 session_id=None) -> np.ndarray:
         """Continuous-batching generation via the server's decode
-        engine; returns the generated token ids."""
-        out = self._post("/v1/serving/generate", {
+        engine; returns the generated token ids. ``session_id`` makes
+        the turn sticky: the server pins its KV pages under that id,
+        and the next call whose prompt extends this conversation
+        resumes without re-prefilling the history."""
+        out = self.generate_full(prompt_ids, max_new_tokens,
+                                 temperature, eos_id, session_id)
+        return np.asarray(out["tokens"], np.int32)
+
+    def generate_full(self, prompt_ids, max_new_tokens: int,
+                      temperature: float = 0.0, eos_id=None,
+                      session_id=None) -> dict:
+        """Like generate() but returns the whole response dict
+        (request_id, finish_reason, cache_hit_tokens, timings)."""
+        payload = {
             "prompt_ids": np.asarray(prompt_ids,
                                      np.int32).reshape(-1).tolist(),
             "max_new_tokens": int(max_new_tokens),
             "temperature": float(temperature),
             "eos_id": eos_id,
-        })
-        return np.asarray(out["tokens"], np.int32)
+        }
+        if session_id is not None:
+            payload["session_id"] = session_id
+        return self._post("/v1/serving/generate", payload)
+
+    def prefix_cache_stats(self) -> dict:
+        """GET /v1/serving/prefix_cache — cross-request KV-reuse
+        stats (hit/miss counters, cached/shared/pinned pages)."""
+        req = urllib.request.Request(
+            self.endpoint + "/v1/serving/prefix_cache")
+        with urllib.request.urlopen(req, timeout=self.timeout) as r:
+            return json.loads(r.read())
 
     def _post(self, path: str, payload: dict) -> dict:
         body = json.dumps(payload).encode()

@@ -4,7 +4,13 @@
 ``models/gpt.py``'s CausalLM: a fixed-shape slot-based decode step
 jitted ONCE and fed by a scheduler that joins new requests into free
 slots and evicts finished ones between steps, over a paged KV cache
-(kv_pages.py). Front-ends: ``parallel.wrapper.GenerativeInference``
+(kv_pages.py — refcounted pages, so N slots can share one committed
+prefix read-only). Cross-request KV reuse: ``PrefixCache``
+(prefix_cache.py) indexes committed prompt pages by chained page hash
+with copy-on-write divergence, and ``SessionStore`` (sessions.py)
+pins a finished conversation's pages for its next turn
+(``DecodeEngine(prefix_cache=True, session_capacity=N)``).
+Front-ends: ``parallel.wrapper.GenerativeInference``
 (ParallelInference-parity submit/stream API) and
 ``remote.server.JsonModelServer(engine=...)`` (HTTP).
 """
@@ -13,5 +19,8 @@ from deeplearning4j_tpu.serving.engine import (
     DecodeEngine, ServingRequest,
 )
 from deeplearning4j_tpu.serving.kv_pages import PagePool
+from deeplearning4j_tpu.serving.prefix_cache import PrefixCache
+from deeplearning4j_tpu.serving.sessions import SessionStore
 
-__all__ = ["DecodeEngine", "ServingRequest", "PagePool"]
+__all__ = ["DecodeEngine", "ServingRequest", "PagePool",
+           "PrefixCache", "SessionStore"]

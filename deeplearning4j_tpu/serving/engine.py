@@ -58,6 +58,8 @@ from deeplearning4j_tpu.profiler import flight_recorder as _flight
 from deeplearning4j_tpu.profiler import telemetry as _telemetry
 from deeplearning4j_tpu.profiler import tracing as _tracing
 from deeplearning4j_tpu.serving import kv_pages
+from deeplearning4j_tpu.serving.prefix_cache import PrefixCache
+from deeplearning4j_tpu.serving.sessions import SessionStore
 
 
 # ------------------------------------------------------------ requests
@@ -77,12 +79,19 @@ class ServingRequest:
 
     def __init__(self, request_id: int, prompt: np.ndarray,
                  max_new_tokens: int, temperature: float,
-                 eos_id: Optional[int], keydata: np.ndarray):
+                 eos_id: Optional[int], keydata: np.ndarray,
+                 session_id: Optional[str] = None):
         self.request_id = request_id
         self.prompt = prompt
         self.max_new_tokens = int(max_new_tokens)
         self.temperature = float(temperature)
         self.eos_id = eos_id
+        self.session_id = session_id
+        #: prompt tokens whose K/V came from the prefix cache or a
+        #: sticky session instead of prefill compute (0 = cold)
+        self.cache_hit_tokens = 0
+        #: conversation turn this request will pin as (resume bumps it)
+        self._session_turns = 1
         self._keydata = keydata
         self.tokens: List[int] = []
         self.finish_reason: Optional[str] = None   # length | eos | error
@@ -206,6 +215,19 @@ class DecodeEngine:
     quantization : None | "int8" — int8 weight-only decode weights
         (per-channel scales, dequant-in-matmul); prefill stays full
         precision.
+    prefix_cache : index committed prompt pages by chained page hash
+        (serving/prefix_cache.py) and serve later prompts' shared
+        prefixes from the SAME refcounted pages — copy-on-write on
+        mid-page divergence, LRU leaf eviction under page pressure,
+        prefill restricted to the uncached suffix. Off (the default)
+        keeps the engine bit-identical to the cache-less path.
+    session_capacity / session_ttl : > 0 enables sticky sessions
+        (serving/sessions.py): a finished request submitted with a
+        ``session_id`` pins its pages + token history, and the next
+        turn whose prompt extends that history resumes decode after
+        prefilling only the new tokens. Bounded by capacity (LRU),
+        ttl seconds idle, explicit ``release_session``, and
+        admission pressure.
     max_chunk : upper bound (a power of two) on decode steps fused
         into ONE dispatch via lax.scan. The scheduler picks the
         largest power-of-two chunk that cannot overshoot the nearest
@@ -223,7 +245,10 @@ class DecodeEngine:
                  quantization: Optional[str] = None,
                  max_chunk: int = 8,
                  max_queue: int = 512, seed: int = 0,
-                 warm_start: bool = True):
+                 warm_start: bool = True,
+                 prefix_cache: bool = False,
+                 session_capacity: int = 0,
+                 session_ttl: float = 600.0):
         cfg = model.cfg
         self.model = model
         self.slots = int(slots)
@@ -302,6 +327,28 @@ class DecodeEngine:
                                     donate_argnums=(1, 2))
         self._prefill_fallback = _telemetry.instrument_jit(
             "serving_prefill", self._prefill_jit)
+        # cross-request KV reuse (prefix_cache.py / sessions.py). Both
+        # ride on the same two extra programs: a SUFFIX prefill that
+        # attends through the slot's page table (so cached prefix
+        # pages are read, only new positions are computed/written) and
+        # the copy-on-write page copy. Neither exists when reuse is
+        # off — the cache-less engine stays program-for-program
+        # identical to the pre-reuse path.
+        self._prefix = PrefixCache(self.page_size) if prefix_cache \
+            else None
+        self._sessions = (SessionStore(session_capacity, session_ttl)
+                          if session_capacity > 0 else None)
+        self._reuse = (self._prefix is not None
+                       or self._sessions is not None)
+        if self._reuse:
+            self._prefix_prefill_jit = jax.jit(
+                self._build_prefix_prefill_fn(), donate_argnums=(1, 2))
+            self._prefix_prefill_fallback = _telemetry.instrument_jit(
+                "serving_prefix_prefill", self._prefix_prefill_jit)
+            self._copy_jit = jax.jit(kv_pages.copy_page,
+                                     donate_argnums=(0, 1))
+            self._copy_fallback = _telemetry.instrument_jit(
+                "serving_cow_copy", self._copy_jit)
         self._warm = _WarmPool()
         self._warm_start = bool(warm_start)
         # scheduler
@@ -488,6 +535,72 @@ class DecodeEngine:
 
         return prefill
 
+    def _build_prefix_prefill_fn(self):
+        """SUFFIX prefill for a warm-prefix admission: forward over the
+        padded new tokens (bucket width ``B``) at absolute positions
+        ``t_start..``, attending through the slot's WHOLE page table —
+        the cached prefix pages are read in place, and only the suffix
+        positions' K/V are computed and scattered (positions past the
+        real prompt write to the null page). ``t_start`` may sit
+        mid-page (copy-on-write divergence, session resume), which the
+        per-position (page, offset) scatter handles for free. The
+        attention mirrors the decode core's page-major einsums, so warm
+        greedy outputs stay token-identical to a cold prefill."""
+        cfg = self.model.cfg
+        cd = self.model._cdtype
+        P, ps = self.pages_per_slot, self.page_size
+        ln = self.model._ln
+
+        def prefill(params, kpool, vpool, tokens, table, t_start, t0):
+            B = tokens.shape[0]
+            pos = t_start + jnp.arange(B, dtype=jnp.int32)
+            x = params["tok_emb"].astype(cd)[tokens] \
+                + params["pos_emb"].astype(cd)[
+                    jnp.minimum(pos, cfg.max_len - 1)]
+            real = pos < t0
+            page = jnp.where(real,
+                             table[jnp.minimum(pos // ps, P - 1)], 0)
+            off = pos % ps
+            scale = 1.0 / jnp.sqrt(jnp.asarray(cfg.head_dim, cd))
+            # causal over the FLAT position axis: query at absolute
+            # position p admits keys at flat positions <= p — cached
+            # prefix, freshly-written suffix, nothing beyond
+            valid = (jnp.arange(P * ps)[None, None, :]
+                     <= pos[None, :, None])[:, None]   # [1,1,B,P*ps]
+            for li, lp in enumerate(params["layers"]):
+                h = ln(x, lp["ln1"])
+                qkv = h @ lp["wqkv"].astype(cd) + lp["bqkv"].astype(cd)
+                q, k, v = jnp.split(qkv, 3, axis=-1)
+                hs = lambda y: y.reshape(B, cfg.n_heads, cfg.head_dim)
+                q, k, v = hs(q), hs(k), hs(v)
+                kpool, vpool = kv_pages.append_token(
+                    kpool, vpool, li, page, off, k, v)
+                ck = kv_pages.gather_pages(kpool, li, table[None])
+                cv = kv_pages.gather_pages(vpool, li, table[None])
+                qq = q.transpose(1, 0, 2)[None]        # [1, H, B, hd]
+                logits = jnp.einsum("nhqd,nphod->nhqpo", qq, ck) \
+                    .reshape(1, cfg.n_heads, B, P * ps) * scale
+                neg = jnp.asarray(jnp.finfo(logits.dtype).min,
+                                  logits.dtype)
+                logits = jnp.where(valid, logits, neg)
+                w = jax.nn.softmax(logits, axis=-1) \
+                    .reshape(1, cfg.n_heads, B, P, ps)
+                ctx = jnp.einsum("nhqpo,nphod->nhqd", w, cv)
+                ctx = ctx[0].transpose(1, 0, 2).reshape(B, cfg.d_model)
+                x = x + ctx @ lp["wo"].astype(cd) + lp["bo"].astype(cd)
+                h = ln(x, lp["ln2"])
+                x = x + jax.nn.gelu(
+                    h @ lp["w1"].astype(cd) + lp["b1"].astype(cd)) \
+                    @ lp["w2"].astype(cd) + lp["b2"].astype(cd)
+            x = ln(x, params["ln_f"])
+            logits = (x @ params["tok_emb"].astype(cd).T) \
+                .astype(jnp.float32)
+            last = lax.dynamic_index_in_dim(
+                logits, t0 - 1 - t_start, axis=0, keepdims=False)
+            return kpool, vpool, last
+
+        return prefill
+
     # ---------------------------------------------------------- startup
     def start(self) -> "DecodeEngine":
         with self._start_lock:
@@ -527,11 +640,23 @@ class DecodeEngine:
                     _abstract(self.params), _abstract(self.pool.k),
                     _abstract(self.pool.v), sds((1, b), i32),
                     sds((b // self.page_size,), i32), sds((), i32))
+            if self._reuse:
+                self._warm.compile(
+                    ("cow_copy", 0), self._copy_jit,
+                    _abstract(self.pool.k), _abstract(self.pool.v),
+                    sds((), i32), sds((), i32))
+                for b in self.prefill_buckets:
+                    self._warm.compile(
+                        ("prefix_prefill", b), self._prefix_prefill_jit,
+                        _abstract(self.params), _abstract(self.pool.k),
+                        _abstract(self.pool.v), sds((b,), i32),
+                        sds((P,), i32), sds((), i32), sds((), i32))
 
     # ----------------------------------------------------------- client
     def submit(self, prompt_ids, max_new_tokens: int,
                temperature: float = 0.0, eos_id: Optional[int] = None,
-               sample_seed: Optional[int] = None) -> ServingRequest:
+               sample_seed: Optional[int] = None,
+               session_id: Optional[str] = None) -> ServingRequest:
         prompt = np.asarray(prompt_ids, np.int32)
         if prompt.ndim == 2 and prompt.shape[0] == 1:
             prompt = prompt[0]          # [1, t0] convenience
@@ -550,6 +675,13 @@ class DecodeEngine:
                 f"prompt ({prompt.size}) + max_new_tokens "
                 f"({max_new_tokens}) exceeds max_context "
                 f"({self.max_context})")
+        # hard physical bound: every page-table row is a DISTINCT
+        # resident page, shared or not — a request whose total
+        # footprint exceeds the pool can never be admitted. Pages the
+        # request will merely SHARE are accounted at admission instead
+        # (_plan_admission allocates only the uncached suffix), so a
+        # long-shared-prefix request queues only for the pages it
+        # actually consumes.
         if kv_pages.pages_needed(total, self.page_size) \
                 > self.pool.capacity:
             raise ValueError(
@@ -562,7 +694,8 @@ class DecodeEngine:
                else jax.random.fold_in(self._base_key,
                                        next(self._sample_counter)))
         req = ServingRequest(rid, prompt, max_new_tokens, temperature,
-                             eos_id, np.asarray(jax.random.key_data(key)))
+                             eos_id, np.asarray(jax.random.key_data(key)),
+                             session_id=session_id)
         req._trace = _tracing.new_trace(
             "serving_request", request_id=rid,
             prompt_tokens=int(prompt.size),
@@ -601,6 +734,30 @@ class DecodeEngine:
         return self.submit(prompt_ids, max_new_tokens, temperature,
                            eos_id).result(timeout)
 
+    def prefix_stats(self) -> Dict[str, Any]:
+        """Cross-request KV-reuse stats: prefix-cache index counters,
+        sharing gauges, sticky-session table (the /v1/serving/
+        prefix_cache endpoint body)."""
+        out: Dict[str, Any] = {
+            "enabled": self._prefix is not None,
+            "sessions_enabled": self._sessions is not None,
+            "page_size": self.page_size,
+        }
+        if self._prefix is not None:
+            out.update(self._prefix.stats())
+            out["shared_pages"] = self.pool.shared_pages()
+        if self._sessions is not None:
+            out["sessions"] = self._sessions.stats()
+        return out
+
+    def release_session(self, session_id: str) -> bool:
+        """Explicitly free a sticky session's pinned pages (client-
+        callable; thread-safe against the scheduler). True if the id
+        was pinned."""
+        if self._sessions is None:
+            return False
+        return self._sessions.release(session_id, self.pool)
+
     def stats(self) -> Dict[str, Any]:
         return {
             "slots": self.slots,
@@ -618,9 +775,12 @@ class DecodeEngine:
                               if self.n_steps else 0.0),
             "kv_pages": {"capacity": self.pool.capacity,
                          "allocated": self.pool.allocated,
-                         "high_water": self.pool.high_water},
+                         "high_water": self.pool.high_water,
+                         "shared": self.pool.shared_pages()},
             "warm_pool": {"hits": self._warm.hits,
                           "misses": self._warm.misses},
+            **({"prefix_cache": self.prefix_stats()}
+               if self._reuse else {}),
             # newest-first: client logs join on request_id, per-request
             # timelines at /v1/serving/requests/<id> (tracing on).
             # .copy() is one C call (atomic under the GIL) — iterating
@@ -637,6 +797,13 @@ class DecodeEngine:
             self._dead = RuntimeError("engine has been shut down")
         # scheduler thread is gone: safe to fail whatever remains
         self._fail_pending(self._dead)
+        # drain contract: with every slot failed, releasing the
+        # session pins and the cache's own references brings every
+        # refcount to 0 — the pool leaves fully free
+        if self._sessions is not None:
+            self._sessions.clear(self.pool)
+        if self._prefix is not None:
+            self._prefix.clear(self.pool)
 
     def __enter__(self) -> "DecodeEngine":
         return self.start()
@@ -688,35 +855,246 @@ class DecodeEngine:
                 self._waiting.append(self._queue.get_nowait())
             except _queue.Empty:
                 break
+        if self._sessions is not None:
+            self._sessions.expire(self.pool)     # TTL sweep
         while self._waiting and not self._active.all():
             req = self._waiting[0]
-            pages = self.pool.alloc(kv_pages.pages_needed(
-                req.prompt.size + req.max_new_tokens, self.page_size))
-            if pages is None:
+            plan = self._plan_admission(req)
+            if plan is None:
                 break        # head-of-line waits for evictions
             self._waiting.popleft()
             try:
-                self._admit(req, pages)
+                self._admit(req, plan)
             except BaseException as e:
-                self.pool.free(pages)
+                self._release_plan(plan)
                 req._finish("error", e)
         self._gauge_queue_depth()
 
-    def _admit(self, req: ServingRequest, pages: List[int]) -> None:
+    # ----------------------------------------------- admission planning
+    def _shared_pages_hint(self, prompt: np.ndarray,
+                           session_id: Optional[str]) -> int:
+        """Pages this request would REUSE (pinned session pages,
+        cached full-prefix pages) rather than newly allocate — the
+        read-only budget hint admission re-resolves authoritatively.
+        Exposed for capacity planning ("would this prompt fit right
+        now?"): a request consumes only ``pages_needed(total) - hint``
+        free pages."""
+        if not self._reuse:
+            return 0
+        if self._sessions is not None and session_id is not None \
+                and self._sessions.match_pos(session_id,
+                                             prompt) is not None:
+            return self._sessions.pages_hint(session_id)
+        if self._prefix is not None:
+            full = self._prefix.hit_tokens_hint(prompt) // self.page_size
+            return min(full, (int(prompt.size) - 1) // self.page_size)
+        return 0
+
+    def _alloc_with_evict(self, n: int) -> Optional[List[int]]:
+        """Allocate ``n`` fresh pages, evicting cold prefix-cache
+        entries (LRU leaves with no live readers) and, as a last
+        resort, the oldest pinned session — cached/pinned pages must
+        never starve a live request. None when the pool is genuinely
+        full of live readers (caller keeps the request queued)."""
+        if n <= 0:
+            return []
+        while True:
+            pages = self.pool.alloc(n)
+            if pages is not None:
+                return pages
+            freed = 0
+            if self._prefix is not None:
+                freed += self._prefix.evict(
+                    self.pool, n - self.pool.free_pages)
+            if self.pool.free_pages < n and self._sessions is not None:
+                freed += self._sessions.evict_oldest(self.pool)
+                # a session's history pages may double as cache
+                # entries; with the session's reference gone another
+                # cache pass can reclaim them
+                if self._prefix is not None \
+                        and self.pool.free_pages < n:
+                    freed += self._prefix.evict(
+                        self.pool, n - self.pool.free_pages)
+            if freed == 0:
+                return None
+
+    def _plan_admission(self, req: ServingRequest) \
+            -> Optional[Dict[str, Any]]:
+        """Resolve where this request's pages come from:
+
+        - ``session``: its ``session_id`` pins a history the prompt
+          extends — map the pinned pages, prefill only the new tokens;
+        - ``prefix``: the prefix cache holds full (and possibly one
+          divergent, copy-on-write) pages of the prompt — share them,
+          prefill the suffix;
+        - ``cold``: allocate everything, full prefill (the pre-reuse
+          path, byte-for-byte).
+
+        Returns None when pages cannot be found even after eviction
+        (request stays head-of-line). Every page referenced by the
+        returned plan holds a reference for this request;
+        ``_release_plan`` undoes that on admission failure."""
         t0 = int(req.prompt.size)
         ps = self.page_size
-        bucket = next((b for b in self.prefill_buckets if b >= t0),
-                      kv_pages.pages_needed(t0, ps) * ps)
-        prompt = np.zeros((1, bucket), np.int32)
-        prompt[0, :t0] = req.prompt
-        page_row = np.zeros((bucket // ps,), np.int32)
-        n_real = min(len(pages), bucket // ps)
-        page_row[:n_real] = pages[:n_real]
+        total_pages = kv_pages.pages_needed(
+            t0 + req.max_new_tokens, ps)
+        t_l0 = time.perf_counter()
+        plan: Optional[Dict[str, Any]] = None
+        if self._sessions is not None and req.session_id is not None:
+            plan = self._plan_session(req, t0, total_pages)
+            if plan == "retry":
+                return None
+        if plan is None and self._prefix is not None:
+            hit = self._prefix.lookup_acquire(req.prompt, self.pool)
+            new = self._alloc_with_evict(total_pages - len(hit.pages))
+            if new is None:
+                hit.release(self.pool)
+                return None
+            self._prefix.record(hit)
+            copies, drop = [], []
+            if hit.cow_src is not None:
+                # mid-page divergence: private copy of exactly that
+                # page; our acquire-reference on the source drops once
+                # the copy is dispatched
+                copies = [(hit.cow_src, new[0])]
+                drop = [hit.cow_src]
+            plan = {"kind": "prefix" if hit.tokens else "cold",
+                    "rows": hit.pages + new,
+                    "copies": copies, "drop_after_copy": drop,
+                    "t_start": hit.tokens, "session": None}
+        if plan is None:
+            pages = self._alloc_with_evict(total_pages)
+            if pages is None:
+                return None
+            plan = {"kind": "cold", "rows": pages, "copies": [],
+                    "drop_after_copy": [], "t_start": 0,
+                    "session": None}
+        if req._trace is not None and self._reuse:
+            req._trace.event("prefix_lookup", t_l0,
+                             hit_tokens=plan["t_start"],
+                             kind=plan["kind"])
+        return plan
+
+    def _plan_session(self, req: ServingRequest, t0: int,
+                      total_pages: int):
+        """Sticky-session leg of the planner: None = no resumable
+        session (fall through to the prefix cache, releasing a
+        contradicted pin), "retry" = resumable but pages are short
+        (stay head-of-line), else the session plan."""
+        sid = req.session_id
+        pos = self._sessions.match_pos(sid, req.prompt)
+        if pos is None:
+            if self._sessions.pages_hint(sid):
+                # pinned history contradicts the prompt: the
+                # conversation restarted — release the stale pin (its
+                # full pages stay reachable through the prefix cache)
+                self._sessions.release(sid, self.pool)
+            return None
+        peeked = self._sessions.peek(sid)
+        if peeked is None:         # raced a TTL/capacity eviction
+            return None
+        peek_pages, peek_pos, _turns = peeked
+        ps = self.page_size
+        t_start = min(peek_pos, t0 - 1)
+        extra_n = total_pages - len(peek_pages)
+        idx = t_start // ps
+        cow_src = (peek_pages[idx] if idx < len(peek_pages)
+                   and self.pool.refcount(peek_pages[idx]) > 1
+                   else None)
+        # size the allocation BEFORE take(): a head-of-line request
+        # stalled on pages must not churn take/pin (and their
+        # counters/flight events) every scheduler pass
+        new = self._alloc_with_evict(extra_n
+                                     + (1 if cow_src is not None else 0))
+        if new is None:
+            return "retry"         # session stays pinned untouched
+        sess = self._sessions.take(sid)
+        if sess is None:           # raced an eviction/release
+            if new:
+                self.pool.free(new)
+            return None
+        copies, drop = [], []
+        rows = list(sess.pages)
+        if cow_src is not None:
+            # the resume point sits mid-page in a page other readers
+            # still map (e.g. it is also a cached full prompt page):
+            # write into a private copy instead
+            rows[idx] = new[0]
+            copies = [(cow_src, new[0])]
+            drop = [cow_src]
+            new = new[1:]
+        rows += new
+        # a resume is a reuse hit like any other: the hit-rate and
+        # hit-token metrics describe ALL cross-request KV reuse
+        if self._prefix is not None:
+            self._prefix.record_session(t_start)
+        if _telemetry.enabled():
+            reg = _telemetry.MetricsRegistry.get_default()
+            reg.counter(
+                _telemetry.SERVING_PREFIX_HITS,
+                "prefix-cache lookups that reused >= 1 committed "
+                "page").inc(kind="session")
+            reg.counter(
+                _telemetry.SERVING_PREFIX_HIT_TOKENS,
+                "prompt tokens served from cached KV pages instead "
+                "of prefill compute").inc(t_start)
+        req._session_turns = sess.turns + 1
+        _flight.record("session_resume", session_id=str(sid),
+                       request_id=req.request_id, pos=int(sess.pos),
+                       new_tokens=t0 - t_start, turns=sess.turns)
+        return {"kind": "session", "rows": rows, "copies": copies,
+                "drop_after_copy": drop, "t_start": t_start,
+                "session": sess}
+
+    def _release_plan(self, plan: Dict[str, Any]) -> None:
+        self.pool.free(plan["rows"] + plan["drop_after_copy"])
+
+    # ---------------------------------------------------------- admit
+    def _admit(self, req: ServingRequest, plan: Dict[str, Any]) -> None:
+        t0 = int(req.prompt.size)
+        ps = self.page_size
+        rows: List[int] = plan["rows"]
+        t_start: int = plan["t_start"]
+        for src, dst in plan["copies"]:
+            # copy-on-write BEFORE any write can land in the shared
+            # page: concurrent readers of src never see our tokens
+            self.pool.k, self.pool.v = self._warm.run(
+                ("cow_copy", 0), self._copy_fallback, self.pool.k,
+                self.pool.v, jnp.asarray(src, jnp.int32),
+                jnp.asarray(dst, jnp.int32))
+        if plan["drop_after_copy"]:
+            self.pool.free(plan["drop_after_copy"])
+            plan["drop_after_copy"] = []
         t_pre = time.perf_counter()
-        kpool, vpool, last = self._warm.run(
-            ("prefill", bucket), self._prefill_fallback, self.params,
-            self.pool.k, self.pool.v, jnp.asarray(prompt),
-            jnp.asarray(page_row), jnp.asarray(t0, jnp.int32))
+        if t_start == 0:
+            bucket = next((b for b in self.prefill_buckets if b >= t0),
+                          kv_pages.pages_needed(t0, ps) * ps)
+            prompt = np.zeros((1, bucket), np.int32)
+            prompt[0, :t0] = req.prompt
+            page_row = np.zeros((bucket // ps,), np.int32)
+            n_real = min(len(rows), bucket // ps)
+            page_row[:n_real] = rows[:n_real]
+            kpool, vpool, last = self._warm.run(
+                ("prefill", bucket), self._prefill_fallback, self.params,
+                self.pool.k, self.pool.v, jnp.asarray(prompt),
+                jnp.asarray(page_row), jnp.asarray(t0, jnp.int32))
+        else:
+            # warm path: prefill ONLY the uncached suffix, mid-page
+            # starts included — attention reads the shared prefix
+            # pages through the full table
+            sl = t0 - t_start
+            bucket = next((b for b in self.prefill_buckets if b >= sl),
+                          kv_pages.pages_needed(sl, ps) * ps)
+            suffix = np.zeros((bucket,), np.int32)
+            suffix[:sl] = req.prompt[t_start:]
+            table = np.zeros((self.pages_per_slot,), np.int32)
+            table[:len(rows)] = rows
+            kpool, vpool, last = self._warm.run(
+                ("prefix_prefill", bucket),
+                self._prefix_prefill_fallback, self.params,
+                self.pool.k, self.pool.v, jnp.asarray(suffix),
+                jnp.asarray(table), jnp.asarray(t_start, jnp.int32),
+                jnp.asarray(t0, jnp.int32))
         logits = np.asarray(last)
         t_post = time.perf_counter()
         self.pool.k, self.pool.v = kpool, vpool
@@ -725,24 +1103,31 @@ class DecodeEngine:
             metric=_telemetry.SERVING_PREFILL_SECONDS, bucket=bucket)
         first = self._sample_first(req, logits)
         s = int(np.flatnonzero(~self._active)[0])
+        req.cache_hit_tokens = t_start
         if req._trace is not None:
             req._trace.event("queue_wait", req._t_submit, t_pre)
             req._trace.event("prefill", t_pre, t_post, bucket=bucket,
-                             slot=s)
+                             slot=s, hit_tokens=t_start)
         _flight.record("serving_admit", request_id=req.request_id,
-                       slot=s, bucket=bucket, pages=len(pages),
+                       slot=s, bucket=bucket, pages=len(rows),
+                       reuse=plan["kind"], hit_tokens=t_start,
                        queue_ms=round((t_pre - req._t_submit) * 1e3, 3))
         self._slot_req[s] = req
-        self._slot_pages[s] = pages
+        self._slot_pages[s] = rows
         self._slot_emitted[s] = 0
         self._tables[s] = 0
-        self._tables[s, :len(pages)] = pages
+        self._tables[s, :len(rows)] = rows
         self._pos[s] = t0
         self._tok[s] = first
         self._temps[s] = req.temperature
         self._keydata[s] = req._keydata
         self._active[s] = True
         self._dev_static = None      # roster changed: re-upload
+        if self._prefix is not None:
+            # index this prompt's full pages (freshly prefilled ones
+            # AND, for a session resume, committed history pages) for
+            # the next shared-prefix request
+            self._prefix.insert(req.prompt, rows, self.pool)
         self._emit(s, first)
         if _telemetry.enabled():
             _telemetry.MetricsRegistry.get_default().counter(
@@ -864,9 +1249,16 @@ class DecodeEngine:
         self._slot_emitted[s] += 1
         self.n_tokens += 1
         if self._slot_emitted[s] == 1 and _telemetry.enabled():
-            _telemetry.MetricsRegistry.get_default().histogram(
+            reg = _telemetry.MetricsRegistry.get_default()
+            reg.histogram(
                 _telemetry.SERVING_TTFT,
                 "submit -> first generated token").observe(req.ttft_s)
+            if req.cache_hit_tokens:
+                reg.histogram(
+                    _telemetry.SERVING_WARM_TTFT,
+                    "submit -> first token for requests whose prompt "
+                    "reused cached KV (prefix-cache or session "
+                    "hit)").observe(req.ttft_s)
         if self._slot_emitted[s] >= req.max_new_tokens:
             self._evict(s, "length")
         elif req.eos_id is not None and token == req.eos_id:
@@ -875,7 +1267,8 @@ class DecodeEngine:
     def _evict(self, s: int, reason: str,
                error: Optional[BaseException] = None) -> None:
         req = self._slot_req[s]
-        self.pool.free(self._slot_pages[s])
+        if not self._maybe_pin_session(s, req, reason, error):
+            self.pool.free(self._slot_pages[s])
         self._slot_req[s] = None
         self._slot_pages[s] = []
         self._slot_emitted[s] = 0
@@ -903,6 +1296,30 @@ class DecodeEngine:
                 _telemetry.SERVING_REQUEST_LATENCY,
                 "submit -> completion per request").observe(
                 req.latency_s, reason=reason)
+
+    def _maybe_pin_session(self, s: int, req: ServingRequest,
+                           reason: str,
+                           error: Optional[BaseException]) -> bool:
+        """On a clean finish of a ``session_id`` request, pin the
+        COMMITTED state under that id instead of freeing it: the token
+        history whose K/V actually landed in the pool (prompt + all
+        generated tokens but the last — the final token was emitted,
+        never fed back through the decode step) and the pages holding
+        it. Pages past the committed extent are freed now."""
+        if (error is not None or reason not in ("length", "eos")
+                or req.session_id is None or self._sessions is None):
+            return False
+        pages = self._slot_pages[s]
+        history = np.concatenate(
+            [req.prompt, np.asarray(req.tokens[:-1], np.int32)])
+        keep = kv_pages.pages_needed(history.size, self.page_size)
+        if not 0 < keep <= len(pages):
+            return False
+        if len(pages) > keep:
+            self.pool.free(pages[keep:])
+        self._sessions.pin(req.session_id, pages[:keep], history,
+                           self.pool, turns=req._session_turns)
+        return True
 
     def _gauge_queue_depth(self) -> None:
         if _telemetry.enabled():

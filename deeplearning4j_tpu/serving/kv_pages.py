@@ -16,9 +16,19 @@ pool of fixed-size pages shared by every slot:
   that slot's sequence. Unallocated tail entries point at the null
   page and are masked by the position check (attention only admits
   flat position ``<= pos``).
-- ``PagePool`` is the HOST-side allocator (free list, utilization
-  gauge); the device arrays thread functionally through the jitted
-  prefill/decode steps and are rebound by the engine.
+- ``PagePool`` is the HOST-side allocator (free list, REFERENCE
+  COUNTS, utilization gauge); the device arrays thread functionally
+  through the jitted prefill/decode steps and are rebound by the
+  engine.
+
+Reference counts are what make cross-request KV reuse safe
+(serving/prefix_cache.py, serving/sessions.py): a page can be mapped
+read-only into several slots' tables at once — ``alloc`` hands a page
+out at refcount 1, ``share`` adds readers, ``free`` DECREMENTS and
+only returns the page to the free list when the last reader is gone.
+Writers must hold the only reference; a slot about to write into a
+shared page takes a private copy first (``copy_page``, the
+copy-on-write step) and swaps its table entry.
 
 The jax functions here are pure and shape-static, so the engine's one
 decode executable serves every mix of request lengths.
@@ -26,7 +36,9 @@ decode executable serves every mix of request lengths.
 
 from __future__ import annotations
 
-from typing import List, Optional, Tuple
+import collections
+import threading
+from typing import Dict, List, Optional, Sequence
 
 import jax.numpy as jnp
 
@@ -34,12 +46,17 @@ from deeplearning4j_tpu.profiler import telemetry as _telemetry
 
 
 class PagePool:
-    """Host-side page allocator over the device-resident K/V pools.
+    """Host-side refcounting page allocator over the device-resident
+    K/V pools.
 
     ``n_pages`` INCLUDES the reserved null page 0, so the usable
     capacity is ``n_pages - 1`` pages. ``alloc`` returns None when the
     request cannot be satisfied — the scheduler keeps the request
     queued (head-of-line) until eviction frees pages.
+
+    Thread safety: the free list and refcounts are guarded by a lock —
+    the scheduler thread allocates/frees, while session release and
+    submit-time budget hints may touch refcounts from client threads.
     """
 
     def __init__(self, n_layers: int, n_heads: int, page_size: int,
@@ -56,7 +73,10 @@ class PagePool:
         # LIFO free list: recently-freed pages are re-used first, which
         # keeps the hot working set of pages small and cache-friendly
         self._free: List[int] = list(range(n_pages - 1, 0, -1))
+        #: page -> live reference count; absent means the page is free
+        self._refs: Dict[int, int] = {}
         self._high_water = 0
+        self._lock = threading.Lock()
 
     # ------------------------------------------------------- accounting
     @property
@@ -68,11 +88,26 @@ class PagePool:
         return self.capacity - len(self._free)
 
     @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    @property
     def high_water(self) -> int:
         return self._high_water
 
     def utilization(self) -> float:
         return self.allocated / max(self.capacity, 1)
+
+    def refcount(self, page: int) -> int:
+        """Live references on ``page`` (0 when free)."""
+        with self._lock:
+            return self._refs.get(int(page), 0)
+
+    def shared_pages(self) -> int:
+        """Pages with MORE than one reader (prefix-cache hits mapped
+        into live slots, cache+session double holds, ...)."""
+        with self._lock:
+            return sum(1 for r in self._refs.values() if r > 1)
 
     def bytes_per_page(self) -> int:
         # k + v, all layers, one page
@@ -81,31 +116,85 @@ class PagePool:
 
     # ------------------------------------------------------- allocation
     def alloc(self, n: int) -> Optional[List[int]]:
-        """``n`` pages, or None if the pool can't satisfy it (caller
-        keeps the request queued)."""
-        if n > len(self._free):
-            return None
-        pages = [self._free.pop() for _ in range(n)]
-        self._high_water = max(self._high_water, self.allocated)
+        """``n`` pages at refcount 1 each, or None if the pool can't
+        satisfy it (caller keeps the request queued)."""
+        with self._lock:
+            if n > len(self._free):
+                return None
+            pages = [self._free.pop() for _ in range(n)]
+            for p in pages:
+                self._refs[p] = 1
+            self._high_water = max(self._high_water, self.allocated)
         self._gauge()
         return pages
 
-    def free(self, pages: List[int]) -> None:
-        for p in pages:
-            if not 0 < p < self.n_pages:
-                raise ValueError(f"page {p} outside pool (null page 0 "
-                                 "is never allocated or freed)")
-            if p in self._free:
-                raise ValueError(f"double free of page {p}")
-        self._free.extend(pages)
+    def share(self, pages: Sequence[int]) -> None:
+        """Add one reference per listed page (a page listed twice gains
+        two). Every page must currently be live — sharing a free page
+        is a use-after-free and raises."""
+        with self._lock:
+            for p in pages:
+                self._check_range(p)
+                if int(p) not in self._refs:
+                    raise ValueError(
+                        f"cannot share free page {int(p)} (not "
+                        "currently allocated)")
+            for p in pages:
+                self._refs[int(p)] += 1
         self._gauge()
+
+    def free(self, pages: Sequence[int]) -> None:
+        """Drop one reference per listed page; a page whose last
+        reference drops returns to the free list.
+
+        The whole call is validated BEFORE any mutation: out-of-range
+        or null-page indices, frees of already-free pages, and
+        DUPLICATES WITHIN ONE CALL that exceed the page's live count
+        all raise with the free list untouched (a silent bad free is a
+        corrupted allocator and, pages being shared now, somebody
+        else's KV cache)."""
+        with self._lock:
+            demand = collections.Counter()
+            for p in pages:
+                self._check_range(p)
+                demand[int(p)] += 1
+            for p, n in demand.items():
+                have = self._refs.get(p, 0)
+                if have == 0:
+                    raise ValueError(f"double free of page {p} "
+                                     "(already on the free list)")
+                if n > have:
+                    raise ValueError(
+                        f"over-free of page {p}: {n} frees in one call "
+                        f"but only {have} live reference(s)")
+            for p, n in demand.items():
+                left = self._refs[p] - n
+                if left == 0:
+                    del self._refs[p]
+                    self._free.append(p)
+                else:
+                    self._refs[p] = left
+        self._gauge()
+
+    def _check_range(self, p) -> None:
+        if not isinstance(p, (int,)) and not hasattr(p, "__index__"):
+            raise ValueError(f"page index {p!r} is not an integer")
+        p = int(p)
+        if not 0 < p < self.n_pages:
+            raise ValueError(f"page {p} outside pool (null page 0 "
+                             "is never allocated or freed)")
 
     def _gauge(self) -> None:
         if _telemetry.enabled():
-            _telemetry.MetricsRegistry.get_default().gauge(
+            reg = _telemetry.MetricsRegistry.get_default()
+            reg.gauge(
                 _telemetry.SERVING_KV_PAGE_UTILIZATION,
                 "fraction of KV-cache pages currently allocated to "
                 "live requests").set(self.utilization())
+            reg.gauge(
+                _telemetry.SERVING_SHARED_PAGES,
+                "KV pages mapped by more than one reader (prefix-"
+                "cache sharing)").set(self.shared_pages())
 
 
 # ------------------------------------------------------- pure jax ops
@@ -128,9 +217,11 @@ def commit_prefill(kpool, vpool, ks, vs, page_row, page_size: int):
 
 
 def append_token(kpool, vpool, layer: int, page_idx, offset, k, v):
-    """Write one decode step's K/V for every slot: slot ``s`` lands at
-    ``(layer, page_idx[s], :, offset[s])``. Inactive slots' page_idx
-    must already point at the null page."""
+    """Write one position's K/V per lane: lane ``s`` lands at
+    ``(layer, page_idx[s], :, offset[s])``. Lanes are decode slots in
+    the decode step (inactive slots' page_idx must already point at the
+    null page) and suffix positions in the prefix-prefill step (padded
+    positions point at the null page)."""
     return (kpool.at[layer, page_idx, :, offset].set(
                 k.astype(kpool.dtype)),
             vpool.at[layer, page_idx, :, offset].set(
@@ -147,9 +238,19 @@ def gather_pages(pool, layer: int, tables) -> jnp.ndarray:
     return pool[layer][tables]
 
 
+def copy_page(kpool, vpool, src, dst):
+    """Copy-on-write step: duplicate page ``src`` into ``dst`` across
+    every layer of both pools. ``src``/``dst`` are traced scalars so
+    ONE compiled program serves every copy. The caller then swaps its
+    page-table entry to ``dst`` and drops its reference on ``src`` —
+    readers of ``src`` never observe the writer's divergence."""
+    return (kpool.at[:, dst].set(kpool[:, src]),
+            vpool.at[:, dst].set(vpool[:, src]))
+
+
 def pages_needed(total_positions: int, page_size: int) -> int:
     return -(-int(total_positions) // int(page_size))
 
 
 __all__ = ["PagePool", "commit_prefill", "append_token",
-           "gather_pages", "pages_needed"]
+           "gather_pages", "copy_page", "pages_needed"]

@@ -727,6 +727,112 @@ if [ $servsmoke -ne 0 ]; then
     exit 1
 fi
 
+# Prefix-cache smoke gate (docs/SERVING.md "Prefix cache and
+# sessions"): cross-request KV reuse under JAX_PLATFORMS=cpu must
+# (a) produce warm-prefix greedy outputs TOKEN-IDENTICAL to both a
+# cold prefill and a cache-off engine (which itself must stay
+# identical to solo generate() — the pre-reuse contract), (b) advance
+# the prefix hit counters / hit-token counters on warm traffic,
+# (c) resume a two-turn sticky session token-identically with zero
+# history re-prefill, and (d) drain COMPLETELY at shutdown — every
+# refcount to zero, the pool fully free.
+env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu DL4J_TPU_TELEMETRY=1 \
+    python - <<'EOF'
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.models.gpt import CausalLM
+from deeplearning4j_tpu.models.transformer import tiny_config
+from deeplearning4j_tpu.profiler import telemetry
+from deeplearning4j_tpu.serving import DecodeEngine
+
+cfg = tiny_config(vocab=17, max_len=64, d_model=32, n_layers=2,
+                  n_heads=4, d_ff=64)
+cfg.dropout = 0.0
+m = CausalLM(cfg, compute_dtype=jnp.float32)
+params = m.init_params(jax.random.key(1))
+rng = np.random.default_rng(0)
+sys_p = rng.integers(0, 17, (19,)).astype(np.int32)
+prompts = [np.concatenate(
+    [sys_p, rng.integers(0, 17, (n,)).astype(np.int32)])
+    for n in (5, 7, 3, 9, 6)]
+solo = lambda p, n: np.asarray(m.generate(
+    params, jnp.asarray(np.asarray(p)[None, :], jnp.int32), n))[0]
+
+fail = []
+reg = telemetry.MetricsRegistry.get_default()
+kw = dict(slots=2, page_size=8, prefill_buckets=[8, 16, 32],
+          max_chunk=4)
+# cache-off side: must be token-identical to solo generate()
+off = DecodeEngine(m, params, **kw)
+with off:
+    off_outs = [off.generate(p, 8) for p in prompts]
+for p, o in zip(prompts, off_outs):
+    if not np.array_equal(o, solo(p, 8)):
+        fail.append(f"cache-OFF engine diverged from solo generate() "
+                    f"(prompt len {p.size})")
+        break
+# warm side: same prompts, prefix cache + sessions on
+hit0 = reg.counter(telemetry.SERVING_PREFIX_HITS).total()
+tok0 = reg.counter(telemetry.SERVING_PREFIX_HIT_TOKENS).total()
+eng = DecodeEngine(m, params, prefix_cache=True, session_capacity=4,
+                   **kw)
+with eng:
+    warm_reqs = [eng.submit(p, 8) for p in prompts]
+    warm_outs = [r.result(timeout=300) for r in warm_reqs]
+    hits = [r.cache_hit_tokens for r in warm_reqs]
+    # two-turn sticky session: turn 2 extends turn 1's history
+    t1 = prompts[0]
+    r1 = eng.submit(t1, 6, session_id="conv")
+    o1 = r1.result(timeout=300)
+    t2 = np.concatenate([t1, o1,
+                         rng.integers(0, 17, (4,)).astype(np.int32)])
+    r2 = eng.submit(t2, 6, session_id="conv")
+    o2 = r2.result(timeout=300)
+    st = eng.prefix_stats()
+for (p, o_off, o_warm) in zip(prompts, off_outs, warm_outs):
+    if not np.array_equal(o_warm, o_off):
+        fail.append(f"warm-prefix output diverged from cold "
+                    f"(prompt len {p.size})")
+        break
+if not np.array_equal(o2, solo(t2, 6)):
+    fail.append("session resume diverged from cold full-prompt decode")
+if r2.cache_hit_tokens != t1.size + o1.size - 1:
+    fail.append(f"session resume re-prefilled history "
+                f"(hit {r2.cache_hit_tokens})")
+if sum(1 for h in hits[1:] if h >= 16) != len(hits) - 1:
+    fail.append(f"warm requests missed the shared prefix: hits={hits}")
+if reg.counter(telemetry.SERVING_PREFIX_HITS).total() <= hit0:
+    fail.append("prefix hit counter did not advance")
+if reg.counter(telemetry.SERVING_PREFIX_HIT_TOKENS).total() \
+        < tok0 + 4 * 16:
+    fail.append("prefix hit-token counter did not advance")
+if st["sessions"]["resumed_total"] != 1:
+    fail.append(f"session stats wrong: {st['sessions']}")
+if eng.pool.allocated != 0 or eng.pool.shared_pages() != 0:
+    fail.append(f"pool did not drain at shutdown: "
+                f"{eng.pool.allocated} pages, "
+                f"{eng.pool.shared_pages()} shared")
+if eng.stats()["warm_pool"]["misses"] != 0:
+    fail.append("reuse programs missed the AOT warm pool")
+if fail:
+    sys.stderr.write("prefix-cache smoke FAILED:\n  "
+                     + "\n  ".join(fail) + "\n")
+    sys.exit(1)
+print(f"prefix-cache smoke OK: {len(prompts)} shared-prefix requests "
+      f"token-identical warm-vs-cold (hit tokens {hits}), 2-turn "
+      f"session resumed at hit {r2.cache_hit_tokens}, pool drained, "
+      "cache-off == solo generate()")
+EOF
+prefixsmoke=$?
+if [ $prefixsmoke -ne 0 ]; then
+    echo "FATAL: prefix-cache smoke gate regressed" >&2
+    exit 1
+fi
+
 # Tracing smoke gate (docs/OBSERVABILITY.md "Tracing one request"):
 # (a) 8 mixed-length traced requests must each carry queue_wait /
 # prefill / decode_burst / finish spans, retrievable programmatically

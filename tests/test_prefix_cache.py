@@ -1,0 +1,614 @@
+"""Cross-request KV reuse (serving/prefix_cache.py, sessions.py,
+kv_pages refcounts): warm-prefix token identity vs cold prefill,
+copy-on-write semantics (exactly one page copied on mid-page
+divergence; concurrent sharers isolated), refcount-validated
+PagePool.free, LRU eviction that never reclaims live readers, sticky
+sessions (resume / TTL / capacity / explicit release), HTTP surface,
+telemetry."""
+
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.models.gpt import CausalLM
+from deeplearning4j_tpu.models.transformer import tiny_config
+from deeplearning4j_tpu.profiler import telemetry
+from deeplearning4j_tpu.serving import (
+    DecodeEngine, PagePool, PrefixCache, SessionStore,
+)
+from deeplearning4j_tpu.serving.kv_pages import pages_needed
+from deeplearning4j_tpu.serving.prefix_cache import page_digest
+
+VOCAB = 13
+PS = 8      # page size used throughout
+
+
+def _model():
+    cfg = tiny_config(vocab=VOCAB, max_len=64, d_model=32, n_layers=2,
+                      n_heads=4, d_ff=64)
+    cfg.dropout = 0.0
+    return CausalLM(cfg, compute_dtype=jnp.float32)
+
+
+@pytest.fixture(scope="module")
+def model():
+    return _model()
+
+
+@pytest.fixture(scope="module")
+def params(model):
+    return model.init_params(jax.random.key(1))
+
+
+def _solo(model, params, prompt, new):
+    return np.asarray(model.generate(
+        params, jnp.asarray(np.asarray(prompt)[None, :], jnp.int32),
+        new))[0]
+
+
+def _engine(model, params, **kw):
+    kw.setdefault("slots", 2)
+    kw.setdefault("page_size", PS)
+    kw.setdefault("prefix_cache", True)
+    # keep AOT warmup cheap: 3 buckets x (prefill + prefix_prefill)
+    # + 3 decode chunks + the CoW copy
+    kw.setdefault("prefill_buckets", [8, 16, 32])
+    kw.setdefault("max_chunk", 4)
+    return DecodeEngine(model, params, **kw)
+
+
+def _count_cow(eng):
+    """Wrap the warm pool's dispatcher to count copy-on-write page
+    copies (the ("cow_copy", 0) program)."""
+    counts = []
+    orig = eng._warm.run
+
+    def run(key, fallback, *args):
+        if key[0] == "cow_copy":
+            counts.append(key)
+        return orig(key, fallback, *args)
+
+    eng._warm.run = run
+    return counts
+
+
+# --------------------------------------------- PagePool refcounts
+class TestPagePoolRefcounts:
+    def test_share_then_free_releases_only_at_zero(self):
+        pool = PagePool(1, 2, 4, 4, n_pages=5, dtype=jnp.float32)
+        pages = pool.alloc(2)
+        pool.share(pages)                      # refcount 2 each
+        assert pool.refcount(pages[0]) == 2
+        assert pool.shared_pages() == 2
+        pool.free(pages)                       # back to 1
+        assert pool.allocated == 2             # still resident
+        assert pool.refcount(pages[0]) == 1
+        assert pool.shared_pages() == 0
+        pool.free(pages)                       # last reference
+        assert pool.allocated == 0
+        assert pool.refcount(pages[0]) == 0
+
+    def test_share_free_page_rejected(self):
+        pool = PagePool(1, 2, 4, 4, n_pages=5, dtype=jnp.float32)
+        with pytest.raises(ValueError, match="share free page"):
+            pool.share([1])
+        pages = pool.alloc(1)
+        pool.free(pages)
+        with pytest.raises(ValueError, match="share free page"):
+            pool.share(pages)
+
+    def test_free_validates_before_mutating(self):
+        """The free-list hardening satellite: duplicates WITHIN one
+        call, double frees, and out-of-range/null indices all raise
+        with the allocator untouched."""
+        pool = PagePool(1, 2, 4, 4, n_pages=6, dtype=jnp.float32)
+        pages = pool.alloc(3)
+        # duplicate within one call exceeding the live count — the
+        # historical silent corruption: page ends up on the free list
+        # twice and gets handed to two requests
+        with pytest.raises(ValueError, match="over-free"):
+            pool.free([pages[0], pages[0]])
+        assert pool.allocated == 3             # untouched
+        assert pool.refcount(pages[0]) == 1
+        # ... but N frees of an N-refcount page in one call is legal
+        pool.share([pages[1]])
+        pool.free([pages[1], pages[1]])
+        assert pool.refcount(pages[1]) == 0
+        with pytest.raises(ValueError, match="double free"):
+            pool.free([pages[1]])
+        with pytest.raises(ValueError, match="null page"):
+            pool.free([0])
+        with pytest.raises(ValueError, match="outside pool"):
+            pool.free([99])
+        with pytest.raises(ValueError, match="outside pool"):
+            pool.free([-2])
+        with pytest.raises(ValueError, match="not an integer"):
+            pool.free(["3"])
+        # a failed call must not have leaked anything onto the free
+        # list: remaining capacity is exactly what arithmetic says
+        assert pool.allocated == 2
+        assert pool.alloc(3) is not None       # 5 usable - 2 live
+        assert pool.alloc(1) is None
+
+    def test_alloc_sets_refcount_one(self):
+        pool = PagePool(1, 2, 4, 4, n_pages=4, dtype=jnp.float32)
+        pages = pool.alloc(3)
+        assert [pool.refcount(p) for p in pages] == [1, 1, 1]
+        assert pool.free_pages == 0
+
+
+# --------------------------------------------- prefix-cache index
+class TestPrefixCacheIndex:
+    def _pool(self, n_pages=17):
+        return PagePool(1, 2, PS, 4, n_pages=n_pages,
+                        dtype=jnp.float32)
+
+    def test_digest_chains_on_parent(self):
+        toks = np.arange(PS, dtype=np.int32)
+        assert page_digest(b"a", toks) != page_digest(b"b", toks)
+        assert page_digest(b"a", toks) == page_digest(b"a", toks.copy())
+
+    def test_insert_lookup_roundtrip_and_cap(self):
+        pool, cache = self._pool(), PrefixCache(PS)
+        prompt = np.arange(3 * PS, dtype=np.int32) % VOCAB
+        pages = pool.alloc(3)
+        assert cache.insert(prompt, pages, pool) == 3
+        assert all(pool.refcount(p) == 2 for p in pages)
+        # full-prompt lookup is capped at len(prompt)-1 tokens: the
+        # last full page is reused via copy-on-write, not mapped
+        hit = cache.lookup_acquire(prompt, pool)
+        assert [n for n in hit.pages] == pages[:2]
+        assert hit.cow_src == pages[2]
+        assert hit.cow_tokens == PS - 1
+        assert hit.tokens == 3 * PS - 1
+        hit.release(pool)
+        # a longer prompt sharing the prefix maps all three pages
+        longer = np.concatenate([prompt,
+                                 np.full((4,), 7, np.int32)])
+        hit = cache.lookup_acquire(longer, pool)
+        assert hit.pages == pages and hit.cow_src is None
+        assert hit.tokens == 3 * PS
+        hit.release(pool)
+        assert cache.hit_tokens_hint(longer) == 3 * PS
+        assert cache.hit_tokens_hint(
+            np.full((3 * PS,), 11, np.int32)) == 0
+
+    def test_mid_page_divergence_found(self):
+        pool, cache = self._pool(), PrefixCache(PS)
+        a = np.arange(2 * PS, dtype=np.int32) % VOCAB
+        pages = pool.alloc(2)
+        cache.insert(a, pages, pool)
+        b = a.copy()
+        b[PS + 3] = (b[PS + 3] + 1) % VOCAB   # diverge mid page 1
+        hit = cache.lookup_acquire(
+            np.concatenate([b, np.zeros((4,), np.int32)]), pool)
+        assert hit.pages == [pages[0]]
+        assert hit.cow_src == pages[1] and hit.cow_tokens == 3
+        assert hit.tokens == PS + 3
+        hit.release(pool)
+
+    def test_eviction_lru_leaf_only_and_reader_protected(self):
+        pool, cache = self._pool(), PrefixCache(PS)
+        a = np.arange(2 * PS, dtype=np.int32) % VOCAB
+        b = (np.arange(2 * PS, dtype=np.int32) + 5) % VOCAB
+        pa, pb = pool.alloc(2), pool.alloc(2)
+        cache.insert(a, pa, pool)
+        cache.insert(b, pb, pool)
+        pool.free(pa)                 # the "requests" finished: only
+        pool.free(pb)                 # the cache's references remain
+        # touch a's chain so b's chain is least-recently-used
+        cache.lookup_acquire(a, pool).release(pool)
+        # ... but a live reader maps b's LEAF page (a slot attending
+        # through it): that page — and transitively its non-leaf
+        # parent — must survive the sweep; a's chain goes instead
+        pool.share([pb[1]])
+        freed = cache.evict(pool, 4)
+        assert freed == 2                     # a's leaf, then a's root
+        assert pool.refcount(pb[1]) == 2      # cache + live reader
+        assert pool.refcount(pb[0]) == 1      # cache (shielded parent)
+        assert pool.refcount(pa[0]) == 0
+        assert cache.stats()["evicted_pages"] == 2
+        pool.free([pb[1]])                    # reader leaves
+        assert cache.evict(pool, 4) == 2      # now reclaimable
+        assert cache.stats()["cached_pages"] == 0
+        assert pool.allocated == 0
+
+    def test_clear_releases_every_reference(self):
+        pool, cache = self._pool(), PrefixCache(PS)
+        prompt = np.arange(2 * PS, dtype=np.int32) % VOCAB
+        pages = pool.alloc(2)
+        cache.insert(prompt, pages, pool)
+        pool.free(pages)                      # drop the alloc refs
+        assert pool.allocated == 2            # cache still holds them
+        assert cache.clear(pool) == 2
+        assert pool.allocated == 0
+
+
+# ------------------------------------------ engine warm-path parity
+class TestEngineWarmParity:
+    def test_warm_prefix_token_identical_to_cold(self, model, params):
+        """The correctness bar: greedy decode on a warm prefix is
+        token-identical to a cold prefill of the same prompt — and to
+        a cache-off engine."""
+        rng = np.random.default_rng(0)
+        sys_p = rng.integers(0, VOCAB, (19,)).astype(np.int32)
+        prompts = [np.concatenate(
+            [sys_p, rng.integers(0, VOCAB, (n,)).astype(np.int32)])
+            for n in (5, 7, 3, 9)]
+        with _engine(model, params) as eng:
+            cold = [eng.submit(p, 8) for p in prompts[:1]]
+            cold[0].result(120)
+            warm = [eng.submit(p, 8) for p in prompts]
+            outs = [h.result(120) for h in warm]
+            hits = [h.cache_hit_tokens for h in warm]
+            st = eng.prefix_stats()
+        for p, got in zip(prompts, outs):
+            np.testing.assert_array_equal(got,
+                                          _solo(model, params, p, 8))
+        # every warm request reused the shared system prefix
+        assert all(h >= 16 for h in hits), hits
+        assert st["hits"] >= len(prompts)
+        assert st["hit_tokens_total"] >= sum(hits)
+
+    def test_repeat_prompt_hits_capped_at_t0_minus_1(self, model,
+                                                     params):
+        p = (np.arange(24) % VOCAB).astype(np.int32)
+        with _engine(model, params) as eng:
+            a = eng.submit(p, 6)
+            a.result(120)
+            b = eng.submit(p, 6)
+            out = b.result(120)
+            assert a.cache_hit_tokens == 0
+            assert b.cache_hit_tokens == p.size - 1
+        np.testing.assert_array_equal(out, _solo(model, params, p, 6))
+
+    def test_mid_page_divergence_copies_exactly_one_page(self, model,
+                                                         params):
+        """CoW semantics: a prompt agreeing with a cached chain for
+        2 full pages + 3 tokens of the third copies EXACTLY ONE page;
+        outputs on both sides of the divergence stay solo-identical."""
+        a = (np.arange(26) % VOCAB).astype(np.int32)
+        b = a.copy()
+        b[19:] = (b[19:] + 1) % VOCAB       # diverge mid page 2
+        with _engine(model, params) as eng:
+            cows = _count_cow(eng)
+            eng.submit(a, 6).result(120)
+            assert len(cows) == 0           # cold: nothing to copy
+            rb = eng.submit(b, 6)
+            out_b = rb.result(120)
+            assert len(cows) == 1, cows     # exactly one page copied
+            assert rb.cache_hit_tokens == 19
+            # the donor chain is unharmed: replaying A still hits its
+            # 3 full cached pages and still matches solo
+            ra = eng.submit(a, 6)
+            out_a = ra.result(120)
+            assert ra.cache_hit_tokens == 24
+        np.testing.assert_array_equal(out_b,
+                                      _solo(model, params, b, 6))
+        np.testing.assert_array_equal(out_a,
+                                      _solo(model, params, a, 6))
+
+    def test_concurrent_sharers_never_observe_each_other(self, model,
+                                                         params):
+        """Two slots decoding from the same shared prefix at the same
+        time: each one's appended tokens are invisible to the other
+        (private suffix pages / CoW copies)."""
+        rng = np.random.default_rng(3)
+        sys_p = rng.integers(0, VOCAB, (16,)).astype(np.int32)
+        pa = np.concatenate([sys_p, rng.integers(0, VOCAB, (4,))
+                             .astype(np.int32)])
+        pb = np.concatenate([sys_p, rng.integers(0, VOCAB, (6,))
+                             .astype(np.int32)])
+        with _engine(model, params) as eng:
+            eng.submit(sys_p, 1).result(120)     # populate the cache
+            with ThreadPoolExecutor(max_workers=2) as ex:
+                ha = ex.submit(lambda: eng.submit(pa, 10).result(120))
+                hb = ex.submit(lambda: eng.submit(pb, 10).result(120))
+                out_a, out_b = ha.result(), hb.result()
+        np.testing.assert_array_equal(out_a,
+                                      _solo(model, params, pa, 10))
+        np.testing.assert_array_equal(out_b,
+                                      _solo(model, params, pb, 10))
+
+    def test_pressure_eviction_never_reclaims_live_readers(
+            self, model, params):
+        """Memory pressure: the eviction sweep reclaims cold cache
+        entries but never pages with a live reference — here pages
+        both cached AND pinned by a session (refcount 2), whose
+        resumed turn must stay token-identical afterwards. (The tiny
+        CPU model decodes too fast for a mid-decode reader to pin
+        pages deterministically; a session pin holds the same
+        refcounts without the race.)"""
+        rng = np.random.default_rng(4)
+        keep = rng.integers(0, VOCAB, (24,)).astype(np.int32)
+        cold1 = rng.integers(0, VOCAB, (24,)).astype(np.int32)
+        cold2 = rng.integers(0, VOCAB, (24,)).astype(np.int32)
+        # 9 usable pages: "keep" pins 4 (3 of them also cached, so
+        # refcount 2) + cold1 leaves 3 cached at refcount 1 -> 2 free;
+        # cold2 (4 pages) must evict cold1's chain, not touch keep's
+        with _engine(model, params, n_pages=10, max_context=40,
+                     session_capacity=2) as eng:
+            o_keep = eng.submit(keep, 8, session_id="keep").result(120)
+            eng.submit(cold1, 8).result(120)
+            assert eng.pool.allocated == 7
+            r2 = eng.submit(cold2, 8)
+            out2 = r2.result(120)
+            st = eng.prefix_stats()
+            assert st["evicted_pages"] >= 1
+            # the protected session resumes intact and token-identical
+            t2 = np.concatenate([keep, o_keep])
+            rk = eng.submit(t2, 6, session_id="keep")
+            out_k = rk.result(120)
+            assert rk.cache_hit_tokens == t2.size - 1
+            assert eng.prefix_stats()["sessions"]["expired_total"] == 0
+        np.testing.assert_array_equal(
+            out2, _solo(model, params, cold2, 8))
+        np.testing.assert_array_equal(
+            out_k, _solo(model, params, t2, 6))
+
+    def test_admission_charges_only_unshared_pages(self, model,
+                                                   params):
+        """The page-budget satellite: a long-shared-prefix request is
+        admitted against the pages it actually CONSUMES. Free pages <
+        its total footprint, but >= its suffix — it must admit warm,
+        with zero evictions."""
+        p24 = (np.arange(24) % VOCAB).astype(np.int32)
+        with _engine(model, params, n_pages=8,
+                     max_context=48) as eng:       # 7 usable pages
+            eng.submit(p24, 8).result(120)         # caches 3 pages
+            assert eng.pool.allocated == 3         # cache only
+            # total footprint 5 pages > 4 free, but 3 are shared
+            long_req = eng.submit(
+                np.concatenate([p24, np.full((8,), 5, np.int32)]), 8)
+            out = long_req.result(120)
+            assert long_req.cache_hit_tokens == 24
+            st = eng.prefix_stats()
+            assert st["evicted_pages"] == 0
+        np.testing.assert_array_equal(
+            out, _solo(model, params, long_req.prompt, 8))
+
+    def test_shared_pages_hint_tracks_reuse_sources(self, model,
+                                                    params):
+        """The capacity-planning hint: full-page cache hits and pinned
+        sessions both count; a cold prompt counts zero."""
+        p = (np.arange(24) % VOCAB).astype(np.int32)
+        with _engine(model, params, session_capacity=2) as eng:
+            assert eng._shared_pages_hint(p, None) == 0
+            out = eng.submit(p, 6, session_id="s").result(120)
+            assert eng._shared_pages_hint(p, None) == 2  # (24-1)//8
+            t2 = np.concatenate([p, out])
+            assert eng._shared_pages_hint(t2, "s") \
+                == pages_needed(p.size + out.size - 1, PS)
+            assert eng._shared_pages_hint(
+                ((np.arange(24) + 1) % VOCAB).astype(np.int32),
+                None) == 0
+
+    def test_cache_off_engine_unchanged_and_pool_drains(self, model,
+                                                        params):
+        """Off-mode: no reuse machinery is even built; on-mode: every
+        refcount returns to zero at shutdown."""
+        p = (np.arange(20) % VOCAB).astype(np.int32)
+        off = DecodeEngine(model, params, slots=2, page_size=PS)
+        assert off._prefix is None and off._sessions is None \
+            and not off._reuse
+        with off:
+            o_off = off.generate(p, 6)
+        assert "prefix_cache" not in off.stats()
+        eng = _engine(model, params, session_capacity=2)
+        with eng:
+            o_on1 = eng.generate(p, 6)
+            o_on2 = eng.submit(p, 6, session_id="s").result(120)
+        np.testing.assert_array_equal(o_off, o_on1)
+        np.testing.assert_array_equal(o_off, o_on2)
+        assert eng.pool.allocated == 0             # fully drained
+        assert eng.pool.shared_pages() == 0
+
+    def test_warm_requests_stay_on_warm_pool(self, model, params):
+        """The new programs (prefix prefill per bucket, CoW copy) are
+        AOT-compiled at start(): warm traffic pays zero compiles at
+        the serving jit sites."""
+        reg = telemetry.MetricsRegistry.get_default()
+        compiles = lambda s: reg.counter(
+            telemetry.JIT_COMPILES).value(site=s)
+        p = (np.arange(26) % VOCAB).astype(np.int32)
+        q = p.copy()
+        q[19:] = (q[19:] + 1) % VOCAB
+        with _engine(model, params) as eng:
+            c0 = {s: compiles(s) for s in
+                  ("serving_prefix_prefill", "serving_cow_copy",
+                   "serving_prefill", "serving_decode")}
+            eng.submit(p, 5).result(120)
+            eng.submit(q, 5).result(120)       # warm + one CoW copy
+            assert eng.stats()["warm_pool"]["misses"] == 0
+        for s, v in c0.items():
+            assert compiles(s) == v, f"{s} paid a compile post-startup"
+
+
+# ------------------------------------------------- sticky sessions
+class TestStickySessions:
+    def test_two_turn_resume_token_identical(self, model, params):
+        rng = np.random.default_rng(5)
+        t1 = rng.integers(0, VOCAB, (21,)).astype(np.int32)
+        with _engine(model, params, session_capacity=4,
+                     prefix_cache=False) as eng:
+            r1 = eng.submit(t1, 6, session_id="conv")
+            o1 = r1.result(120)
+            st = eng.prefix_stats()
+            assert st["sessions"]["sessions"] == 1
+            assert st["sessions"]["pinned_pages"] > 0
+            t2 = np.concatenate(
+                [t1, o1, rng.integers(0, VOCAB, (5,)).astype(np.int32)])
+            r2 = eng.submit(t2, 6, session_id="conv")
+            o2 = r2.result(120)
+            # history = prompt + generated tokens minus the last one
+            assert r2.cache_hit_tokens == t1.size + o1.size - 1
+            assert eng.prefix_stats()["sessions"]["resumed_total"] == 1
+        np.testing.assert_array_equal(o1, _solo(model, params, t1, 6))
+        np.testing.assert_array_equal(o2, _solo(model, params, t2, 6))
+
+    def test_ttl_expiry_frees_pinned_pages(self, model, params):
+        with _engine(model, params, session_capacity=4,
+                     session_ttl=0.05, prefix_cache=False) as eng:
+            eng.submit((np.arange(12) % VOCAB).astype(np.int32), 4,
+                       session_id="brief").result(120)
+            assert eng.prefix_stats()["sessions"]["pinned_pages"] > 0
+            for _ in range(300):        # scheduler sweeps TTL when idle
+                if eng.prefix_stats()["sessions"]["sessions"] == 0:
+                    break
+                time.sleep(0.01)
+            st = eng.prefix_stats()["sessions"]
+            assert st["sessions"] == 0 and st["pinned_pages"] == 0
+            assert st["expired_total"] == 1
+            assert eng.pool.allocated == 0
+
+    def test_capacity_evicts_lru_session(self, model, params):
+        with _engine(model, params, session_capacity=1,
+                     prefix_cache=False) as eng:
+            eng.submit((np.arange(10) % VOCAB).astype(np.int32), 3,
+                       session_id="a").result(120)
+            eng.submit(((np.arange(10) + 3) % VOCAB).astype(np.int32),
+                       3, session_id="b").result(120)
+            st = eng.prefix_stats()["sessions"]
+            assert st["sessions"] == 1
+            assert eng.release_session("a") is False   # evicted
+            assert eng.release_session("b") is True
+
+    def test_explicit_release_and_divergent_history(self, model,
+                                                    params):
+        rng = np.random.default_rng(6)
+        t1 = rng.integers(0, VOCAB, (14,)).astype(np.int32)
+        with _engine(model, params, session_capacity=4,
+                     prefix_cache=False) as eng:
+            eng.submit(t1, 4, session_id="x").result(120)
+            assert eng.release_session("x") is True
+            assert eng.release_session("x") is False
+            assert eng.pool.allocated == 0
+            # divergent second turn: pin is released, request served
+            # cold and correct
+            eng.submit(t1, 4, session_id="y").result(120)
+            contradiction = rng.integers(0, VOCAB, (14,)) \
+                .astype(np.int32)
+            r = eng.submit(contradiction, 4, session_id="y")
+            out = r.result(120)
+            assert r.cache_hit_tokens == 0
+            assert eng.prefix_stats()["sessions"]["released_total"] == 2
+        np.testing.assert_array_equal(
+            out, _solo(model, params, contradiction, 4))
+
+    def test_session_resume_composes_with_prefix_cache(self, model,
+                                                       params):
+        """Both subsystems on: turn 1 populates the cache, the resume
+        rides the session, and a THIRD party sharing the conversation
+        prefix hits the cache — all token-identical."""
+        rng = np.random.default_rng(7)
+        t1 = rng.integers(0, VOCAB, (18,)).astype(np.int32)
+        with _engine(model, params, session_capacity=4) as eng:
+            o1 = eng.submit(t1, 6, session_id="conv").result(120)
+            t2 = np.concatenate([t1, o1])
+            r2 = eng.submit(t2, 6, session_id="conv")
+            o2 = r2.result(120)
+            assert r2.cache_hit_tokens == t2.size - 1
+            stranger = np.concatenate(
+                [t1, rng.integers(0, VOCAB, (3,)).astype(np.int32)])
+            r3 = eng.submit(stranger, 6)
+            o3 = r3.result(120)
+            assert r3.cache_hit_tokens >= 16
+        np.testing.assert_array_equal(o2, _solo(model, params, t2, 6))
+        np.testing.assert_array_equal(o3,
+                                      _solo(model, params, stranger, 6))
+
+
+# ------------------------------------------------- HTTP + telemetry
+class TestHttpAndTelemetry:
+    def test_generate_carries_session_and_hit_tokens(self, model,
+                                                     params):
+        from deeplearning4j_tpu.remote.server import (
+            JsonModelServer, JsonRemoteInference,
+        )
+
+        eng = _engine(model, params, session_capacity=4)
+        srv = JsonModelServer(engine=eng)
+        port = srv.start()
+        try:
+            cli = JsonRemoteInference(f"http://127.0.0.1:{port}")
+            p = (np.arange(18) % VOCAB).astype(np.int32)
+            r1 = cli.generate_full(p, 5, session_id="web")
+            assert r1["cache_hit_tokens"] == 0
+            assert r1["session_id"] == "web"
+            p2 = np.concatenate(
+                [p, np.asarray(r1["tokens"], np.int32)])
+            r2 = cli.generate_full(p2, 5, session_id="web")
+            assert r2["cache_hit_tokens"] == p2.size - 1
+            np.testing.assert_array_equal(
+                np.asarray(r2["tokens"], np.int32),
+                _solo(model, params, p2, 5))
+            st = cli.prefix_cache_stats()
+            assert st["enabled"] and st["sessions_enabled"]
+            assert st["sessions"]["resumed_total"] == 1
+        finally:
+            srv.stop()
+            eng.shutdown()
+
+    def test_prefix_endpoint_404_without_engine(self, model):
+        import urllib.error
+        import urllib.request
+
+        from deeplearning4j_tpu.remote.server import JsonModelServer
+
+        srv = JsonModelServer(model=model)
+        port = srv.start()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/v1/serving/prefix_cache",
+                    timeout=10)
+            assert ei.value.code == 404
+        finally:
+            srv.stop()
+
+    def test_counters_gauges_and_warm_ttft(self, model, params):
+        reg = telemetry.MetricsRegistry.get_default()
+        warm0 = reg.histogram(telemetry.SERVING_WARM_TTFT).count()
+        p = (np.arange(22) % VOCAB).astype(np.int32)
+        with _engine(model, params, session_capacity=2) as eng:
+            eng.submit(p, 4).result(120)
+            eng.submit(p, 4).result(120)          # warm
+        assert reg.counter(telemetry.SERVING_PREFIX_HITS).total() >= 1
+        assert reg.counter(telemetry.SERVING_PREFIX_MISSES).total() >= 1
+        assert reg.counter(
+            telemetry.SERVING_PREFIX_HIT_TOKENS).total() >= p.size - 1
+        assert reg.histogram(
+            telemetry.SERVING_WARM_TTFT).count() == warm0 + 1
+        snap = telemetry.serving_snapshot()
+        for key in ("prefix_cache_hits", "prefix_cache_hit_tokens",
+                    "prefix_cached_pages", "warm_ttft"):
+            assert key in snap, key
+
+    def test_trace_timeline_has_prefix_lookup_span(self, model,
+                                                   params):
+        from deeplearning4j_tpu.profiler import tracing
+
+        was = tracing.enabled()
+        tracing.set_enabled(True)
+        try:
+            p = (np.arange(20) % VOCAB).astype(np.int32)
+            with _engine(model, params) as eng:
+                eng.submit(p, 3).result(120)
+                r = eng.submit(p, 3)
+                r.result(120)
+                tl = tracing.timeline(r.request_id)
+            evs = {e["name"]: e for e in tl["events"]}
+            assert "prefix_lookup" in evs
+            # 20-token prompt: 2 full cached pages = 16 reusable tokens
+            assert evs["prefix_lookup"]["hit_tokens"] == 16
+            summary = next(
+                s for s in tracing.recent_summaries()
+                if s["request_id"] == r.request_id)
+            assert "prefix_lookup_ms" in summary
+        finally:
+            tracing.set_enabled(was)
+            tracing.reset()
