@@ -284,8 +284,8 @@ def _gpt_decode_metrics() -> dict:
     config scaled down enough to keep the aggregate round bounded; the
     standalone bench keeps the full-size knobs."""
     from bench_gpt_decode import (
-        build_model, decode_metrics, engine_ab, mixed_requests,
-        prefix_ab,
+        build_model, decode_metrics, engine_ab, fleet_ab,
+        mixed_requests, prefix_ab,
     )
 
     m, params = build_model(layers=8, d_model=512, heads=8, d_ff=2048,
@@ -314,6 +314,23 @@ def _gpt_decode_metrics() -> dict:
         "serving_prefix_warm_ttft_speedup": pab["warm_ttft_speedup"],
         "serving_prefix_token_identical": pab["warm_token_identical"],
         "serving_prefix_hit_tokens_mean": pab["warm_hit_tokens_mean"],
+    })
+    # serving fleet: replicated-engines scale-out (1 vs 2 replicas)
+    # and disaggregated-prefill decode-burst p99 gain on long-tailed
+    # traffic with a long-prompt minority (serving/fleet.py)
+    # long_prompt + new_hi stays inside this model's max_len=256 so
+    # no request hits fleet_ab's context clamp
+    fab = fleet_ab(m, params, requests=32, short_prompt=32,
+                   long_prompt=128, long_every=4, new_lo=32,
+                   new_hi=96, slots=4, page_size=16, max_chunk=16,
+                   threshold=64)
+    out.update({
+        "serving_fleet_scaleout": fab["fleet_scaleout"],
+        "serving_fleet2_tokens_per_sec":
+            fab["fleet2_tokens_per_sec"],
+        "serving_disagg_p99_gain": fab["disagg_p99_gain"],
+        "serving_disagg_gap_p99_ms": fab["disagg_on_gap_p99_ms"],
+        "serving_fleet_token_agreement": fab["token_agreement"],
     })
     return out
 

@@ -98,18 +98,26 @@ def decode_metrics(m, params, batch=32, prompt=128, new=384, reps=5):
 
 
 # --------------------------------------------- engine-vs-static A/B
+def _tail_new_tokens(rng, new_lo, new_hi):
+    """One draw from the TRUNCATED-EXPONENTIAL long tail over
+    [new_lo, new_hi] — the shared decode-length model for every
+    serving A/B (engine, prefix, fleet), so they all benchmark the
+    same workload shape."""
+    span = max(new_hi - new_lo, 0)
+    return new_lo + int(min(rng.exponential(0.35 * span), span))
+
+
 def mixed_requests(vocab, n_requests, prompt, new_lo, new_hi, seed=0):
     """Mixed-length traffic: fixed prompt width (so the static side
     gets its best case — one prefill shape), decode lengths drawn from
-    a TRUNCATED-EXPONENTIAL long tail over [new_lo, new_hi]. Real
-    decode traffic is long-tailed (most continuations stop early, a
-    few run to the budget), and that is precisely the distribution
-    where lockstep batching collapses: every group runs to its
-    straggler's length while the engine refills freed slots."""
+    the long tail (_tail_new_tokens). Real decode traffic is
+    long-tailed (most continuations stop early, a few run to the
+    budget), and that is precisely the distribution where lockstep
+    batching collapses: every group runs to its straggler's length
+    while the engine refills freed slots."""
     rng = np.random.default_rng(seed)
-    span = max(new_hi - new_lo, 0)
     return [(rng.integers(0, vocab, (prompt,)).astype(np.int32),
-             new_lo + int(min(rng.exponential(0.35 * span), span)))
+             _tail_new_tokens(rng, new_lo, new_hi))
             for _ in range(n_requests)]
 
 
@@ -284,6 +292,154 @@ def prefix_ab(m, params, n_users=16, system_len=192, user_len=32,
     }
 
 
+# ------------------------------------------------- fleet scale-out A/B
+def fleet_traffic(vocab, n_requests, short_prompt, long_prompt,
+                  long_every, new_lo, new_hi, seed=0):
+    """Long-tailed mixed traffic with a LONG-PROMPT minority (every
+    ``long_every``-th request) — the workload where a bucket-padded
+    prefill visibly stalls neighbors' decode bursts, and the one the
+    disaggregated lane attacks."""
+    rng = np.random.default_rng(seed)
+    out = []
+    for i in range(n_requests):
+        t0 = (long_prompt if long_every and i % long_every == 0
+              else short_prompt)
+        out.append((rng.integers(0, vocab, (t0,)).astype(np.int32),
+                    _tail_new_tokens(rng, new_lo, new_hi)))
+    return out
+
+
+def _run_fleet(m, params, requests, replicas, threshold, slots,
+               page_size, max_chunk, arrival_s=0.0, stream=False):
+    """Serve ``requests`` through a fleet. ``stream=True`` consumes
+    every request on its own thread, timestamping tokens so TTFT and
+    inter-token (decode-burst) gaps are measured as a CLIENT sees
+    them; ``stream=False`` just blocks on results (the throughput
+    arms — no per-token consumer wakeups polluting the measurement).
+    ``arrival_s`` spaces submissions open-loop (steady traffic — the
+    regime where a long prefill stalling in-flight decodes is a
+    visible latency event, not noise under a closed-loop backlog)."""
+    import threading
+
+    from deeplearning4j_tpu.serving.fleet import ServingFleet
+
+    need = max(p.size + nt for p, nt in requests)
+    fl = ServingFleet(
+        m, params, replicas=replicas, prefill_threshold=threshold,
+        slots=slots, page_size=page_size, max_chunk=max_chunk,
+        max_context=min(m.cfg.max_len,
+                        ((need + page_size - 1) // page_size)
+                        * page_size)).start()
+    stamps = [[] for _ in requests]
+    submits = [0.0] * len(requests)
+    outs = [None] * len(requests)
+
+    def consume(i, handle):
+        toks = []
+        for tok in handle.stream():
+            stamps[i].append(time.perf_counter())
+            toks.append(tok)
+        outs[i] = np.asarray(toks, np.int32)
+
+    try:
+        t0 = time.perf_counter()
+        if stream:
+            threads = []
+            for i, (p, nt) in enumerate(requests):
+                if arrival_s and i:
+                    time.sleep(arrival_s)
+                submits[i] = time.perf_counter()
+                t = threading.Thread(target=consume,
+                                     args=(i, fl.submit(p, nt)))
+                t.start()
+                threads.append(t)
+            for t in threads:
+                t.join(600)
+        else:
+            handles = [fl.submit(p, nt) for p, nt in requests]
+            for i, h in enumerate(handles):
+                outs[i] = h.result(timeout=600)
+        secs = time.perf_counter() - t0
+    finally:
+        fl.shutdown()
+    ttfts = [s[0] - sub for s, sub in zip(stamps, submits) if s]
+    gaps = [b - a for s in stamps for a, b in zip(s, s[1:])]
+    return outs, secs, ttfts, gaps
+
+
+def _p(vals, q):
+    return float(np.percentile(np.asarray(vals), q)) if vals else 0.0
+
+
+def fleet_ab(m, params, requests=48, short_prompt=32, long_prompt=192,
+             long_every=4, new_lo=32, new_hi=128, slots=4,
+             page_size=16, max_chunk=16, threshold=64,
+             latency_chunk=8):
+    """Two A/Bs on the same long-tailed mixed traffic:
+
+    - scale-out: 1 vs 2 replicas (lane off) — aggregate useful decode
+      tokens/sec; the replicated-engines win. Runs at ``max_chunk``
+      (the throughput-tuned chunking).
+    - disaggregation: 2 replicas, prefill lane off vs on — client-
+      observed decode-burst p99 (inter-token gap tail) and TTFT tails;
+      the stop-stalling-decode-behind-prefill win. Runs at
+      ``latency_chunk`` (streaming deployments chunk smaller so the
+      inter-token cadence is fine-grained — exactly the regime where
+      a prefill stall is THE tail event).
+
+    Token-identity across all fleet configurations is CI-gated at f32
+    (run_tests.sh fleet smoke); here the sides are additionally
+    checked for agreement with each other at the bench dtype."""
+    reqs = fleet_traffic(m.cfg.vocab_size, requests, short_prompt,
+                         long_prompt, long_every, new_lo, new_hi)
+    # clamp every request to the model's context budget: callers with
+    # a smaller max_len (the aggregate bench) must not trip the
+    # engine's prompt+new validation
+    reqs = [(p, min(nt, m.cfg.max_len - int(p.size)))
+            for p, nt in reqs]
+    useful = sum(nt for _, nt in reqs)
+    # scale-out arms: closed-loop (everything queued at t0) — the
+    # aggregate-throughput regime
+    one_s = two_s = float("inf")
+    for _ in range(2):        # interleaved best-of-2 (engine_ab ritual)
+        o1, s, _, _ = _run_fleet(m, params, reqs, 1, None, slots,
+                                 page_size, max_chunk)
+        one_s = min(one_s, s)
+        o2, s, _, _ = _run_fleet(
+            m, params, reqs, 2, None, slots, page_size, max_chunk)
+        two_s = min(two_s, s)
+    # disaggregation arms: open-loop steady arrivals — the tail-latency
+    # regime, where a long bucket-padded prefill stalling neighbors'
+    # decode bursts is THE p99 event rather than queue-backlog noise
+    arrival = 0.015
+    _, _, off_ttfts, off_gaps = _run_fleet(
+        m, params, reqs, 2, None, slots, page_size, latency_chunk,
+        arrival_s=arrival, stream=True)
+    o3, _, on_ttfts, on_gaps = _run_fleet(
+        m, params, reqs, 2, threshold, slots, page_size,
+        latency_chunk, arrival_s=arrival, stream=True)
+    agree = float(np.mean([
+        np.array_equal(a, b) and np.array_equal(a, c)
+        for a, b, c in zip(o1, o2, o3)]))
+    off_p99, on_p99 = _p(off_gaps, 99) * 1e3, _p(on_gaps, 99) * 1e3
+    return {
+        "requests": len(reqs),
+        "useful_tokens": useful,
+        "long_prompt": long_prompt,
+        "fleet1_tokens_per_sec": round(useful / one_s, 1),
+        "fleet2_tokens_per_sec": round(useful / two_s, 1),
+        "fleet_scaleout": round(one_s / two_s, 3),
+        "disagg_off_gap_p99_ms": round(off_p99, 3),
+        "disagg_on_gap_p99_ms": round(on_p99, 3),
+        "disagg_p99_gain": round(off_p99 / max(on_p99, 1e-9), 3),
+        "disagg_off_ttft_p99_ms": round(_p(off_ttfts, 99) * 1e3, 3),
+        "disagg_on_ttft_p99_ms": round(_p(on_ttfts, 99) * 1e3, 3),
+        "disagg_off_ttft_p50_ms": round(_p(off_ttfts, 50) * 1e3, 3),
+        "disagg_on_ttft_p50_ms": round(_p(on_ttfts, 50) * 1e3, 3),
+        "token_agreement": round(agree, 3),
+    }
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--layers", type=int, default=12)
@@ -302,6 +458,16 @@ def main():
                     help="also run the warm-prefix TTFT A/B on a "
                          "shared-system-prompt workload (prefix "
                          "cache on vs off)")
+    ap.add_argument("--fleet-ab", action="store_true",
+                    help="also run the serving-fleet A/B: 1 vs 2 "
+                         "replicas (throughput scale-out) and "
+                         "disaggregated prefill on vs off (decode-"
+                         "burst p99 + TTFT tails) on long-tailed "
+                         "mixed traffic with a long-prompt minority")
+    ap.add_argument("--fleet-requests", type=int, default=48)
+    ap.add_argument("--fleet-long-prompt", type=int, default=192)
+    ap.add_argument("--fleet-threshold", type=int, default=64,
+                    help="fleet-ab: prompts >= this take the lane")
     ap.add_argument("--slots", type=int, default=8)
     ap.add_argument("--page-size", type=int, default=16)
     ap.add_argument("--max-chunk", type=int, default=16)
@@ -321,6 +487,9 @@ def main():
     if args.prefix_ab:
         max_len = max(max_len,
                       args.system_len + args.user_len + args.new)
+    if args.fleet_ab:
+        max_len = max(max_len, args.fleet_long_prompt
+                      + max(args.new, args.new_hi or 0))
     m, params = build_model(args.layers, args.d_model, args.heads,
                             args.d_ff, args.vocab, max_len)
     line = {"metric": "gpt_decode", "layers": args.layers,
@@ -337,6 +506,14 @@ def main():
         line["prefix_ab"] = prefix_ab(
             m, params, args.users, args.system_len, args.user_len,
             args.new, args.slots, args.page_size, args.max_chunk)
+    if args.fleet_ab:
+        line["fleet_ab"] = fleet_ab(
+            m, params, requests=args.fleet_requests,
+            long_prompt=args.fleet_long_prompt,
+            new_lo=args.new_lo, new_hi=args.new_hi or args.new,
+            slots=args.slots, page_size=args.page_size,
+            max_chunk=args.max_chunk,
+            threshold=args.fleet_threshold)
     print(json.dumps(line))
 
 

@@ -352,12 +352,31 @@ class GenerativeInference:
     steps) expose the batching ratio, and the engine exports request
     p50/p99 latency, TTFT, queue-depth, slot-occupancy and
     KV-page-utilization on the MetricsRegistry.
+
+    Fleet mode: ``replicas>1`` (or ``devices=[...]`` /
+    ``prefill_threshold=``) builds a ``ServingFleet`` — N decode
+    replicas behind one KV-aware router with optional disaggregated
+    prefill (serving/fleet.py) — instead of a single engine; the
+    front-end API is identical. A full admission queue raises the
+    structured ``serving.CapacityRejected`` (retry_after_s attached)
+    from ``submit()``/``output()`` — the 429 surface at the HTTP
+    front-end.
     """
 
-    def __init__(self, model, params, **engine_kwargs):
-        from deeplearning4j_tpu.serving.engine import DecodeEngine
+    def __init__(self, model, params, replicas: int = 1,
+                 devices=None, prefill_threshold: Optional[int] = None,
+                 **engine_kwargs):
+        if replicas > 1 or devices is not None \
+                or prefill_threshold is not None:
+            from deeplearning4j_tpu.serving.fleet import ServingFleet
 
-        self.engine = DecodeEngine(model, params, **engine_kwargs)
+            self.engine = ServingFleet(
+                model, params, replicas=replicas, devices=devices,
+                prefill_threshold=prefill_threshold, **engine_kwargs)
+        else:
+            from deeplearning4j_tpu.serving.engine import DecodeEngine
+
+            self.engine = DecodeEngine(model, params, **engine_kwargs)
         self.engine.start()
 
     # ----------------------------------------------------------- client

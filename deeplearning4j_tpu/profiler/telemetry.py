@@ -115,6 +115,16 @@ SERVING_PINNED_PAGES = "dl4j_tpu_serving_session_pinned_pages"
 SERVING_SESSION_EVICTIONS = \
     "dl4j_tpu_serving_session_evictions_total"
 SERVING_WARM_TTFT = "dl4j_tpu_serving_warm_ttft_seconds"
+#: serving fleet (serving/fleet.py) — every SERVING_* series above is
+#: also labelled ``engine=<id>`` so N engines in one process stay
+#: distinguishable; these are the fleet-level series
+SERVING_REJECTS = "dl4j_tpu_serving_capacity_rejects_total"
+SERVING_FLEET_ROUTED = "dl4j_tpu_serving_fleet_routed_total"
+SERVING_FLEET_REROUTES = "dl4j_tpu_serving_fleet_reroutes_total"
+SERVING_FLEET_REPLICAS = "dl4j_tpu_serving_fleet_live_replicas"
+SERVING_LANE_PREFILLS = "dl4j_tpu_serving_prefill_lane_prefills_total"
+SERVING_LANE_SECONDS = "dl4j_tpu_serving_prefill_lane_seconds"
+SERVING_HANDOFF_SECONDS = "dl4j_tpu_serving_handoff_seconds"
 #: queued dynamic-batching inference (parallel/wrapper.py)
 INFERENCE_REQUEST_LATENCY = "dl4j_tpu_inference_request_latency_seconds"
 INFERENCE_QUEUE_DEPTH = "dl4j_tpu_inference_queue_depth"
@@ -845,10 +855,40 @@ def serving_snapshot() -> Dict[str, Any]:
                       ("shared_kv_pages", SERVING_SHARED_PAGES),
                       ("session_pinned_pages", SERVING_PINNED_PAGES),
                       ("session_evictions", SERVING_SESSION_EVICTIONS),
-                      ("warm_ttft", SERVING_WARM_TTFT)):
+                      ("warm_ttft", SERVING_WARM_TTFT),
+                      ("capacity_rejects", SERVING_REJECTS),
+                      ("fleet_routed", SERVING_FLEET_ROUTED),
+                      ("fleet_reroutes", SERVING_FLEET_REROUTES),
+                      ("fleet_live_replicas", SERVING_FLEET_REPLICAS),
+                      ("lane_prefills", SERVING_LANE_PREFILLS),
+                      ("lane_prefill_seconds", SERVING_LANE_SECONDS),
+                      ("handoff_seconds", SERVING_HANDOFF_SECONDS)):
         m = reg.peek(name)
         if m is not None:
             out[key] = m._json()
+    # every SERVING_* series carries an ``engine=<id>`` label; fold the
+    # per-engine counters back into fleet-level aggregates so "how much
+    # traffic is this PROCESS serving" stays a one-key read even with N
+    # engines resident (two engines used to merge into one
+    # indistinguishable series — now they are separable AND summed)
+    req_c = reg.peek(SERVING_REQUESTS)
+    if req_c is not None:
+        engines = sorted({dict(k).get("engine", "")
+                          for k in req_c.values()})
+        agg: Dict[str, float] = {}
+        for key, name in (("requests_total", SERVING_REQUESTS),
+                          ("tokens_total", SERVING_TOKENS),
+                          ("decode_steps_total", SERVING_DECODE_STEPS),
+                          ("capacity_rejects_total", SERVING_REJECTS),
+                          ("prefix_cache_hits_total",
+                           SERVING_PREFIX_HITS),
+                          ("prefix_cache_hit_tokens_total",
+                           SERVING_PREFIX_HIT_TOKENS)):
+            m = reg.peek(name)
+            if m is not None:
+                agg[key] = m.total()
+        out["engines"] = engines
+        out["aggregate"] = agg
     return out
 
 
@@ -915,6 +955,10 @@ __all__ = [
     "SERVING_PREFIX_CACHED_PAGES", "SERVING_SHARED_PAGES",
     "SERVING_PINNED_PAGES", "SERVING_SESSION_EVICTIONS",
     "SERVING_WARM_TTFT",
+    "SERVING_REJECTS", "SERVING_FLEET_ROUTED",
+    "SERVING_FLEET_REROUTES", "SERVING_FLEET_REPLICAS",
+    "SERVING_LANE_PREFILLS", "SERVING_LANE_SECONDS",
+    "SERVING_HANDOFF_SECONDS",
     "INFERENCE_REQUEST_LATENCY", "INFERENCE_QUEUE_DEPTH",
     "INFERENCE_BATCH_OCCUPANCY",
     "SPANS_DROPPED", "INCIDENT_DUMPS",

@@ -223,11 +223,16 @@ def _summarize(d: Dict[str, Any]) -> Dict[str, Any]:
         "trace_id": d["trace_id"],
         "kind": d["kind"],
         "host": d["host"],
+        # per-replica tag (serving fleet: which engine served it)
+        "engine": d["attrs"].get("engine"),
         "finish_reason": d["finish_reason"],
         "total_ms": round(end, 3),
         "queue_ms": total("queue_wait"),
         "prefix_lookup_ms": total("prefix_lookup"),
         "prefill_ms": total("prefill"),
+        # fleet spans: routing decision + disaggregated-prefill lane
+        "route_ms": total("route"),
+        "lane_prefill_ms": total("lane_prefill"),
         "decode_ms": total("decode_burst"),
         "events": sum(c for c, _ in phases.values()),
         "spans": {name: {"count": c, "total_ms": round(t, 3)}

@@ -136,7 +136,7 @@ class JsonModelServer:
             payload.get("sample_seed"),
             session_id=payload.get("session_id"))
         tokens = req.result(timeout=float(payload.get("timeout", 300)))
-        return {
+        out = {
             # request_id joins client logs against the server-side
             # trace (GET /v1/serving/requests/<request_id>)
             "request_id": req.request_id,
@@ -148,11 +148,20 @@ class JsonModelServer:
             # echoes the sticky-session key the server pinned under
             "cache_hit_tokens": req.cache_hit_tokens,
             "session_id": req.session_id,
+            # per-replica tag: which engine served this request (an
+            # engine's engine_id, or the FINAL replica under a fleet)
+            "engine": getattr(req, "engine_id", None),
             "ttft_ms": round(req.ttft_s * 1e3, 3)
             if req.ttft_s is not None else None,
             "latency_ms": round(req.latency_s * 1e3, 3)
             if req.latency_s is not None else None,
         }
+        # fleet requests also carry the routing decision (replica,
+        # reason=affinity|score|..., lane, attempts incl. failovers)
+        routing = getattr(req, "routing", None)
+        if routing:
+            out["routing"] = dict(routing)
+        return out
 
     def info(self) -> dict:
         m = self.model
@@ -175,11 +184,13 @@ class _InferenceHandler(BaseHTTPRequestHandler):
     def log_message(self, *args):
         pass
 
-    def _json(self, obj, code=200):
+    def _json(self, obj, code=200, headers=None):
         body = json.dumps(obj).encode()
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
         self.end_headers()
         self.wfile.write(body)
 
@@ -230,16 +241,42 @@ class _InferenceHandler(BaseHTTPRequestHandler):
             if path == "/v1/serving/generate":
                 return self._json(ms.generate(payload))
             return self._json({"output": ms.predict(payload)})
-        except Exception as e:  # bad payload -> 400 with reason
+        except Exception as e:
+            # hard capacity reject (CapacityRejected, duck-typed so
+            # this module stays serving-agnostic): a STRUCTURED 429
+            # with Retry-After, not an opaque 400 — clients back off
+            # for the engine's measured hint instead of guessing
+            retry_after = getattr(e, "retry_after_s", None)
+            if retry_after is not None:
+                return self._json(
+                    {"error": str(e), "code": 429,
+                     "retry_after_s": retry_after},
+                    429, headers={"Retry-After":
+                                  f"{max(retry_after, 0.0):.3f}"})
             return self._json({"error": str(e)}, 400)
 
 
 class JsonRemoteInference:
-    """Client for JsonModelServer (reference: JsonRemoteInference)."""
+    """Client for JsonModelServer (reference: JsonRemoteInference).
 
-    def __init__(self, endpoint: str, timeout: float = 30.0):
+    ``generate``/``generate_full`` retry with bounded backoff on the
+    server's structured 429 capacity reject (honoring its
+    ``retry_after_s`` hint) and on connection resets — a full queue or
+    a briefly-restarting replica surfaces as a short wait, not a raw
+    exception at the caller. ``retries=0`` restores fail-fast.
+
+    Connection-reset retries are AT-LEAST-ONCE: a reset after the
+    server finished generating re-runs the request (pass a
+    ``sample_seed`` for reproducible retried sampling, or
+    ``retries=0`` where duplicate server-side work is unacceptable);
+    the 429 path never admitted the request and is always safe."""
+
+    def __init__(self, endpoint: str, timeout: float = 30.0,
+                 retries: int = 4, max_backoff_s: float = 5.0):
         self.endpoint = endpoint.rstrip("/")
         self.timeout = timeout
+        self.retries = int(retries)
+        self.max_backoff_s = float(max_backoff_s)
 
     def predict(self, features) -> np.ndarray:
         out = self._post("/v1/serving/predict",
@@ -272,7 +309,7 @@ class JsonRemoteInference:
         }
         if session_id is not None:
             payload["session_id"] = session_id
-        return self._post("/v1/serving/generate", payload)
+        return self._post_with_retry("/v1/serving/generate", payload)
 
     def prefix_cache_stats(self) -> dict:
         """GET /v1/serving/prefix_cache — cross-request KV-reuse
@@ -292,6 +329,47 @@ class JsonRemoteInference:
         if "error" in out:
             raise RuntimeError(out["error"])
         return out
+
+    def _post_with_retry(self, path: str, payload: dict) -> dict:
+        """Bounded retry-with-backoff around _post: a 429 waits the
+        server's retry_after_s hint (capped), a connection reset waits
+        a doubling backoff; anything else — and exhaustion — raises."""
+        import http.client
+        import time as _time
+        import urllib.error
+
+        backoff = 0.05
+        last: Optional[BaseException] = None
+        for attempt in range(self.retries + 1):
+            try:
+                return self._post(path, payload)
+            except urllib.error.HTTPError as e:
+                if e.code != 429:
+                    raise
+                try:
+                    info = json.loads(e.read() or b"{}")
+                except Exception:
+                    info = {}
+                wait = min(float(info.get("retry_after_s", backoff)),
+                           self.max_backoff_s)
+                last = RuntimeError(
+                    f"server at capacity (429): "
+                    f"{info.get('error', e.reason)}")
+            except (ConnectionResetError, ConnectionRefusedError,
+                    http.client.RemoteDisconnected) as e:
+                wait, last = min(backoff, self.max_backoff_s), e
+            except urllib.error.URLError as e:
+                if not isinstance(e.reason, (ConnectionResetError,
+                                             ConnectionRefusedError)):
+                    raise
+                wait, last = min(backoff, self.max_backoff_s), e
+            if attempt == self.retries:
+                break
+            _time.sleep(wait)
+            backoff = min(backoff * 2, self.max_backoff_s)
+        raise RuntimeError(
+            f"generate failed after {self.retries + 1} attempts: "
+            f"{last}")
 
 
 __all__ = ["JsonModelServer", "JsonRemoteInference"]

@@ -10,17 +10,25 @@ prefix read-only). Cross-request KV reuse: ``PrefixCache``
 with copy-on-write divergence, and ``SessionStore`` (sessions.py)
 pins a finished conversation's pages for its next turn
 (``DecodeEngine(prefix_cache=True, session_capacity=N)``).
+Scale-out: ``ServingFleet`` (fleet.py) puts N engine replicas behind
+one KV-aware router with session affinity, disaggregated prefill
+(long prompts on a dedicated lane, K/V handed off via
+``kv_pages.handoff_commit``), shared AOT warm pools, and
+kill/drain/restart replica lifecycle. Hard capacity rejects raise
+``CapacityRejected`` (structured 429 at the HTTP front-end).
 Front-ends: ``parallel.wrapper.GenerativeInference``
-(ParallelInference-parity submit/stream API) and
-``remote.server.JsonModelServer(engine=...)`` (HTTP).
+(ParallelInference-parity submit/stream API; ``replicas=N`` builds a
+fleet) and ``remote.server.JsonModelServer(engine=...)`` (HTTP).
 """
 
 from deeplearning4j_tpu.serving.engine import (
-    DecodeEngine, ServingRequest,
+    CapacityRejected, DecodeEngine, ServingRequest,
 )
+from deeplearning4j_tpu.serving.fleet import FleetRequest, ServingFleet
 from deeplearning4j_tpu.serving.kv_pages import PagePool
 from deeplearning4j_tpu.serving.prefix_cache import PrefixCache
 from deeplearning4j_tpu.serving.sessions import SessionStore
 
-__all__ = ["DecodeEngine", "ServingRequest", "PagePool",
+__all__ = ["DecodeEngine", "ServingRequest", "CapacityRejected",
+           "ServingFleet", "FleetRequest", "PagePool",
            "PrefixCache", "SessionStore"]

@@ -68,6 +68,24 @@ from deeplearning4j_tpu.serving.sessions import SessionStore
 #: in one process (or an engine restart) must not both mint id 0
 _REQUEST_IDS = itertools.count()
 
+#: PROCESS-wide engine ordinals: every SERVING_* metric is labelled
+#: ``engine=<id>`` so N engines in one process (a serving fleet, or a
+#: test constructing engines back to back) stay distinguishable series
+#: instead of merging into one
+_ENGINE_IDS = itertools.count()
+
+
+class CapacityRejected(RuntimeError):
+    """Hard capacity reject: the admission queue is full. Carries a
+    ``retry_after_s`` hint (derived from recent request latency and
+    queue depth) so front-ends can answer with a structured
+    429-with-Retry-After instead of an opaque error, and clients can
+    back off for a meaningful interval."""
+
+    def __init__(self, message: str, retry_after_s: float = 1.0):
+        super().__init__(message)
+        self.retry_after_s = float(retry_after_s)
+
 
 class ServingRequest:
     """Handle for one submitted generation request.
@@ -97,6 +115,16 @@ class ServingRequest:
         self.finish_reason: Optional[str] = None   # length | eos | error
         self.ttft_s: Optional[float] = None
         self.latency_s: Optional[float] = None
+        #: id of the engine serving this request (set at submit) — the
+        #: per-replica tag front-ends echo in responses and traces
+        self.engine_id: Optional[str] = None
+        #: fleet-side mirror (serving/fleet.py FleetRequest): receives
+        #: _on_token/_on_finish callbacks. None outside a fleet — the
+        #: solo-engine hot path pays one attribute read per token.
+        self._sink = None
+        #: disaggregated-prefill handoff (ks, vs, bucket, logits) from
+        #: the fleet's prefill lane; None for every normal request
+        self._handoff = None
         #: per-request trace (profiler/tracing.py) — None with tracing
         #: off; the timeline is served at /v1/serving/requests/<id>
         self._trace = None
@@ -111,6 +139,9 @@ class ServingRequest:
             self.ttft_s = time.perf_counter() - self._t_submit
         self.tokens.append(token)
         self._stream.put(token)
+        sink = self._sink
+        if sink is not None:
+            sink._on_token(self, token)
 
     def _finish(self, reason: str,
                 error: Optional[BaseException] = None) -> None:
@@ -124,6 +155,9 @@ class ServingRequest:
             _tracing.finish_trace(self._trace, reason=reason)
         self._stream.put(None)            # stream sentinel
         self._done.set()
+        sink = self._sink
+        if sink is not None:
+            sink._on_finish(self, reason, error)
 
     # -- client side ----------------------------------------------------
     @property
@@ -160,13 +194,28 @@ class _WarmPool:
     the warm executable when present (zero trace); otherwise falls back
     to the instrumented jit path, which counts the compile."""
 
-    def __init__(self):
+    def __init__(self, engine_id: str = "solo"):
         self._exec: Dict[Any, Any] = {}
+        self.engine_id = engine_id
         self.hits = 0
         self.misses = 0
+        #: executables adopted from another engine's warm pool (the
+        #: fleet's shared-AOT startup) rather than compiled here
+        self.adopted = 0
 
     def compile(self, key, jitted, *abstract_args) -> None:
         self._exec[key] = jitted.lower(*abstract_args).compile()
+
+    def adopt(self, source: "_WarmPool") -> int:
+        """Share another engine's AOT executables (same shapes, same
+        device): fleet replicas lower+compile ONCE and every further
+        same-device replica adopts, so fleet startup does not pay N x
+        the warm-pool cost. Returns the number adopted."""
+        fresh = {k: v for k, v in source._exec.items()
+                 if k not in self._exec}
+        self._exec.update(fresh)
+        self.adopted += len(fresh)
+        return len(fresh)
 
     def __contains__(self, key) -> bool:
         return key in self._exec
@@ -181,21 +230,43 @@ class _WarmPool:
                 reg.counter(_telemetry.SERVING_WARM_HITS,
                             "decode/prefill dispatches served by AOT-"
                             "compiled warm-pool executables").inc(
-                    program=str(key[0]))
+                    program=str(key[0]), engine=self.engine_id)
             return ex(*args)
         self.misses += 1
         if reg:
             reg.counter(_telemetry.SERVING_WARM_MISSES,
                         "dispatches that missed the warm pool and "
                         "took the (compiling) jit path").inc(
-                program=str(key[0]))
+                program=str(key[0]), engine=self.engine_id)
         return fallback(*args)
 
 
 # --------------------------------------------------------- the engine
-def _abstract(tree):
-    return jax.tree_util.tree_map(
-        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), tree)
+def prefill_forward(model, params, prompt, t0):
+    """The prefill math every serving prefill shares: one batched
+    forward over the padded ``[1, B]`` prompt (positions >= t0 are
+    causally invisible) returning the per-layer K/V stacks and the
+    last REAL position's logits slice. The engine's prefill program
+    and the fleet's disaggregated lane BOTH call this, so lane-served
+    and engine-served prompts are bit-identical by construction — the
+    token-identity gate rests on there being exactly one copy of this
+    function."""
+    logits, ks, vs = model.forward(params, prompt, return_kv=True)
+    last = lax.dynamic_index_in_dim(logits[0], t0 - 1, axis=0,
+                                    keepdims=False)
+    return ks, vs, last
+
+
+def device_sds(shape, dtype, device=None) -> jax.ShapeDtypeStruct:
+    """Abstract value for AOT lowering, pinned to ``device`` when one
+    is given — lowering from unpinned abstracts compiles for the
+    process default device and fails placement on any replica living
+    elsewhere. Shared by the engine warm pool and the fleet's lane."""
+    if device is not None:
+        return jax.ShapeDtypeStruct(
+            shape, dtype,
+            sharding=jax.sharding.SingleDeviceSharding(device))
+    return jax.ShapeDtypeStruct(shape, dtype)
 
 
 class DecodeEngine:
@@ -248,9 +319,28 @@ class DecodeEngine:
                  warm_start: bool = True,
                  prefix_cache: bool = False,
                  session_capacity: int = 0,
-                 session_ttl: float = 600.0):
+                 session_ttl: float = 600.0,
+                 engine_id: Optional[str] = None,
+                 device=None,
+                 handoff_threshold: Optional[int] = None,
+                 warm_source: Optional["DecodeEngine"] = None):
         cfg = model.cfg
         self.model = model
+        #: metric/trace label for this engine (``engine=<id>`` on every
+        #: SERVING_* series); auto-minted process-wide when not given
+        self.engine_id = (str(engine_id) if engine_id is not None
+                          else f"e{next(_ENGINE_IDS)}")
+        #: placement for params + KV pools (None = default device, the
+        #: pre-fleet path byte-for-byte); a fleet passes one device per
+        #: replica
+        self._device = device
+        #: fleet replica mode: prompts >= this many tokens may arrive
+        #: PRE-FILLED from the disaggregated prefill lane
+        #: (submit_prepared) — the adopt scatter programs for the
+        #: corresponding buckets are built and AOT-warmed. None (the
+        #: default) builds none of it.
+        self.handoff_threshold = handoff_threshold
+        self._warm_source = warm_source
         self.slots = int(slots)
         self.page_size = int(page_size)
         self.max_context = int(min(max_context or cfg.max_len,
@@ -265,7 +355,8 @@ class DecodeEngine:
                                                     self.page_size)
         if n_pages is None:
             n_pages = 1 + self.slots * self.pages_per_slot
-        self.params = jax.device_put(params)
+        self.params = (jax.device_put(params, device)
+                       if device is not None else jax.device_put(params))
         self.quantization = quantization
         if quantization not in (None, "int8"):
             raise ValueError(f"unknown quantization {quantization!r} "
@@ -274,7 +365,8 @@ class DecodeEngine:
                                if quantization == "int8" else self.params)
         self.pool = kv_pages.PagePool(
             cfg.n_layers, cfg.n_heads, self.page_size, cfg.head_dim,
-            n_pages, dtype=model._cdtype)
+            n_pages, dtype=model._cdtype, engine_id=self.engine_id,
+            device=device)
         self.prefill_buckets = self._resolve_buckets(prefill_buckets)
         # sampling-key width follows the process PRNG impl (threefry=2,
         # rbg=4) so keydata shapes match whatever jax.config says
@@ -327,6 +419,22 @@ class DecodeEngine:
                                     donate_argnums=(1, 2))
         self._prefill_fallback = _telemetry.instrument_jit(
             "serving_prefill", self._prefill_jit)
+        # fleet replica mode: the adopt scatter that commits a prefill
+        # lane's handed-off K/V into this engine's pages. Buckets are
+        # the prefill buckets a lane-eligible prompt can land in
+        # (smallest bucket >= a threshold-sized prompt is itself >=
+        # threshold). None of this exists outside a fleet.
+        self.handoff_buckets: List[int] = []
+        if handoff_threshold is not None:
+            if handoff_threshold < 1:
+                raise ValueError("handoff_threshold must be >= 1")
+            self.handoff_buckets = [
+                b for b in self.prefill_buckets
+                if b >= int(handoff_threshold)]
+            self._adopt_jit = jax.jit(self._build_adopt_fn(),
+                                      donate_argnums=(0, 1))
+            self._adopt_fallback = _telemetry.instrument_jit(
+                "serving_adopt", self._adopt_jit)
         # cross-request KV reuse (prefix_cache.py / sessions.py). Both
         # ride on the same two extra programs: a SUFFIX prefill that
         # attends through the slot's page table (so cached prefix
@@ -334,9 +442,11 @@ class DecodeEngine:
         # the copy-on-write page copy. Neither exists when reuse is
         # off — the cache-less engine stays program-for-program
         # identical to the pre-reuse path.
-        self._prefix = PrefixCache(self.page_size) if prefix_cache \
-            else None
-        self._sessions = (SessionStore(session_capacity, session_ttl)
+        self._prefix = (PrefixCache(self.page_size,
+                                    engine_id=self.engine_id)
+                        if prefix_cache else None)
+        self._sessions = (SessionStore(session_capacity, session_ttl,
+                                       engine_id=self.engine_id)
                           if session_capacity > 0 else None)
         self._reuse = (self._prefix is not None
                        or self._sessions is not None)
@@ -349,15 +459,23 @@ class DecodeEngine:
                                      donate_argnums=(0, 1))
             self._copy_fallback = _telemetry.instrument_jit(
                 "serving_cow_copy", self._copy_jit)
-        self._warm = _WarmPool()
+        self._warm = _WarmPool(engine_id=self.engine_id)
         self._warm_start = bool(warm_start)
-        # scheduler
+        # scheduler. max_queue bounds queued + head-of-line-waiting
+        # requests together (the scheduler drains the Queue into
+        # _waiting between bursts, so the Queue's own maxsize alone
+        # would not be a real admission bound).
+        self.max_queue = int(max_queue)
         self._queue: "_queue.Queue" = _queue.Queue(maxsize=max_queue)
         self._waiting: "collections.deque" = collections.deque()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._start_lock = threading.Lock()
         self._dead: Optional[BaseException] = None
+        #: chaos/drill hook (fleet.kill_replica): the scheduler raises
+        #: this at its next loop iteration, exercising the real
+        #: engine-death path (evictions, flight incident, re-routing)
+        self._poison: Optional[BaseException] = None
         # stats
         self.n_requests = 0
         self.n_completed = 0
@@ -526,11 +644,9 @@ class DecodeEngine:
         m, ps = self.model, self.page_size
 
         def prefill(params, kpool, vpool, prompt, page_row, t0):
-            logits, ks, vs = m.forward(params, prompt, return_kv=True)
+            ks, vs, last = prefill_forward(m, params, prompt, t0)
             kpool, vpool = kv_pages.commit_prefill(
                 kpool, vpool, ks, vs, page_row, ps)
-            last = lax.dynamic_index_in_dim(logits[0], t0 - 1, axis=0,
-                                            keepdims=False)
             return kpool, vpool, last.astype(jnp.float32)
 
         return prefill
@@ -601,6 +717,20 @@ class DecodeEngine:
 
         return prefill
 
+    def _build_adopt_fn(self):
+        """Fleet handoff commit: scatter the prefill lane's K/V stacks
+        (computed on the lane's own executable stream) into this
+        engine's pages. One scatter program per handoff bucket — the
+        decode replica pays a page write, never the bucket-padded
+        prefill forward itself."""
+        ps = self.page_size
+
+        def adopt(kpool, vpool, ks, vs, page_row):
+            return kv_pages.handoff_commit(kpool, vpool, ks, vs,
+                                           page_row, ps)
+
+        return adopt
+
     # ---------------------------------------------------------- startup
     def start(self) -> "DecodeEngine":
         with self._start_lock:
@@ -618,45 +748,89 @@ class DecodeEngine:
             self._thread.start()
         return self
 
+    def _sds(self, shape, dtype) -> jax.ShapeDtypeStruct:
+        return device_sds(shape, dtype, self._device)
+
+    def _abstract(self, tree):
+        return jax.tree_util.tree_map(
+            lambda a: self._sds(a.shape, a.dtype), tree)
+
     def _aot_warmup(self) -> None:
         """lower+compile every executable the steady state needs, so
-        the first request is served entirely from the warm pool."""
+        the first request is served entirely from the warm pool.
+
+        Fleet replicas share one AOT compile: when a same-device
+        ``warm_source`` engine was given, its executables are ADOPTED
+        (shapes and device identical by construction) and only the
+        programs it lacks are compiled here — fleet startup pays the
+        warm-pool cost once, not once per replica."""
+        src = self._warm_source
+        if src is not None and (src._device is self._device
+                                or src._device == self._device) \
+                and (src.slots, src.page_size, src.max_context,
+                     src.quantization, tuple(src.prefill_buckets),
+                     src.max_chunk, src._reuse) \
+                == (self.slots, self.page_size, self.max_context,
+                    self.quantization, tuple(self.prefill_buckets),
+                    self.max_chunk, self._reuse):
+            self._warm.adopt(src._warm)
         S, P, kw = self.slots, self.pages_per_slot, self._kd_width
         i32, u32, f32 = jnp.int32, jnp.uint32, jnp.float32
-        sds = jax.ShapeDtypeStruct
+        sds, _abs = self._sds, self._abstract
+        cd = self.model._cdtype
+        cfg = self.model.cfg
         with _telemetry.span("serving_aot_warmup",
                              buckets=len(self.prefill_buckets),
-                             chunks=len(self._chunks)):
+                             chunks=len(self._chunks),
+                             engine=self.engine_id,
+                             adopted=self._warm.adopted):
             for k in self._chunks:
+                if ("decode", k) in self._warm:
+                    continue
                 self._warm.compile(
                     ("decode", k), self._decode_jits[k],
-                    _abstract(self._decode_params),
-                    _abstract(self.pool.k), _abstract(self.pool.v),
+                    _abs(self._decode_params),
+                    _abs(self.pool.k), _abs(self.pool.v),
                     sds((S, P), i32), sds((S,), i32), sds((S,), bool),
                     sds((S,), i32), sds((S, kw), u32), sds((S,), f32))
             for b in self.prefill_buckets:
+                if ("prefill", b) in self._warm:
+                    continue
                 self._warm.compile(
                     ("prefill", b), self._prefill_jit,
-                    _abstract(self.params), _abstract(self.pool.k),
-                    _abstract(self.pool.v), sds((1, b), i32),
+                    _abs(self.params), _abs(self.pool.k),
+                    _abs(self.pool.v), sds((1, b), i32),
                     sds((b // self.page_size,), i32), sds((), i32))
-            if self._reuse:
+            for b in self.handoff_buckets:
+                if ("adopt", b) in self._warm:
+                    continue
+                kv_sds = sds((cfg.n_layers, 1, cfg.n_heads, b,
+                              cfg.head_dim), cd)
                 self._warm.compile(
-                    ("cow_copy", 0), self._copy_jit,
-                    _abstract(self.pool.k), _abstract(self.pool.v),
-                    sds((), i32), sds((), i32))
+                    ("adopt", b), self._adopt_jit,
+                    _abs(self.pool.k), _abs(self.pool.v),
+                    kv_sds, kv_sds,
+                    sds((b // self.page_size,), i32))
+            if self._reuse:
+                if ("cow_copy", 0) not in self._warm:
+                    self._warm.compile(
+                        ("cow_copy", 0), self._copy_jit,
+                        _abs(self.pool.k), _abs(self.pool.v),
+                        sds((), i32), sds((), i32))
                 for b in self.prefill_buckets:
+                    if ("prefix_prefill", b) in self._warm:
+                        continue
                     self._warm.compile(
                         ("prefix_prefill", b), self._prefix_prefill_jit,
-                        _abstract(self.params), _abstract(self.pool.k),
-                        _abstract(self.pool.v), sds((b,), i32),
+                        _abs(self.params), _abs(self.pool.k),
+                        _abs(self.pool.v), sds((b,), i32),
                         sds((P,), i32), sds((), i32), sds((), i32))
 
     # ----------------------------------------------------------- client
-    def submit(self, prompt_ids, max_new_tokens: int,
-               temperature: float = 0.0, eos_id: Optional[int] = None,
-               sample_seed: Optional[int] = None,
-               session_id: Optional[str] = None) -> ServingRequest:
+    def _validate(self, prompt_ids, max_new_tokens: int) -> np.ndarray:
+        """Shape/budget validation shared by submit(),
+        submit_prepared() and the fleet front-end (which must reject
+        bad requests synchronously, before routing)."""
         prompt = np.asarray(prompt_ids, np.int32)
         if prompt.ndim == 2 and prompt.shape[0] == 1:
             prompt = prompt[0]          # [1, t0] convenience
@@ -687,6 +861,63 @@ class DecodeEngine:
             raise ValueError(
                 f"request needs more KV pages than the pool holds "
                 f"({self.pool.capacity}); raise n_pages")
+        return prompt
+
+    def retry_after_hint(self) -> float:
+        """Seconds a rejected client should wait before retrying:
+        median recent request latency scaled by how many queue 'turns'
+        are ahead of it — a measured hint, not a constant."""
+        lats = [r["latency_ms"] for r in self._recent.copy()
+                if r.get("latency_ms")]
+        p50_s = (sorted(lats)[len(lats) // 2] / 1e3) if lats else 1.0
+        depth = self._queue.qsize() + len(self._waiting)
+        return round(min(30.0, max(
+            0.05, p50_s * max(1.0, depth / max(self.slots, 1)))), 3)
+
+    def submit(self, prompt_ids, max_new_tokens: int,
+               temperature: float = 0.0, eos_id: Optional[int] = None,
+               sample_seed: Optional[int] = None,
+               session_id: Optional[str] = None,
+               _sink=None) -> ServingRequest:
+        prompt = self._validate(prompt_ids, max_new_tokens)
+        req = self._make_request(prompt, max_new_tokens, temperature,
+                                 eos_id, sample_seed, session_id,
+                                 _sink)
+        self._enqueue(req)
+        return req
+
+    def submit_prepared(self, prompt_ids, max_new_tokens: int,
+                        temperature: float = 0.0,
+                        eos_id: Optional[int] = None,
+                        sample_seed: Optional[int] = None,
+                        session_id: Optional[str] = None,
+                        handoff=None, lane_span=None,
+                        _sink=None) -> ServingRequest:
+        """Fleet replica mode: submit a request whose prompt K/V was
+        already computed by the disaggregated prefill lane. ``handoff``
+        is ``(ks, vs, bucket, last_logits)`` — immutable device arrays
+        from the lane's executable plus the host logits of the last
+        real position; admission commits them with the AOT adopt
+        scatter instead of running prefill. ``lane_span`` carries the
+        lane's (t0, t1, bucket) timing for the request's trace."""
+        if not self.handoff_buckets:
+            raise ValueError(
+                "engine built without handoff support (pass "
+                "handoff_threshold=)")
+        prompt = self._validate(prompt_ids, max_new_tokens)
+        req = self._make_request(prompt, max_new_tokens, temperature,
+                                 eos_id, sample_seed, session_id,
+                                 _sink)
+        req._handoff = handoff
+        if req._trace is not None and lane_span is not None:
+            t0, t1, bucket = lane_span
+            req._trace.event("lane_prefill", t0, t1, bucket=bucket)
+        self._enqueue(req)
+        return req
+
+    def _make_request(self, prompt: np.ndarray, max_new_tokens: int,
+                      temperature: float, eos_id, sample_seed,
+                      session_id, sink) -> ServingRequest:
         if self._dead is not None or self._stop.is_set():
             raise RuntimeError("engine has been shut down")
         rid = next(self._req_counter)
@@ -696,21 +927,42 @@ class DecodeEngine:
         req = ServingRequest(rid, prompt, max_new_tokens, temperature,
                              eos_id, np.asarray(jax.random.key_data(key)),
                              session_id=session_id)
+        req.engine_id = self.engine_id
+        if sink is not None:
+            # attach BEFORE the queue put: the scheduler may admit and
+            # emit tokens the instant the request is visible, and the
+            # sink must already know its inner request by then
+            req._sink = sink
+            sink._attach(req, self)
         req._trace = _tracing.new_trace(
             "serving_request", request_id=rid,
             prompt_tokens=int(prompt.size),
-            max_new_tokens=int(max_new_tokens))
-        _flight.record("serving_submit", request_id=rid,
-                       prompt_tokens=int(prompt.size),
-                       max_new_tokens=int(max_new_tokens))
+            max_new_tokens=int(max_new_tokens),
+            engine=self.engine_id)
+        return req
+
+    def _enqueue(self, req: ServingRequest) -> None:
+        _flight.record("serving_submit", request_id=req.request_id,
+                       engine=self.engine_id,
+                       prompt_tokens=int(req.prompt.size),
+                       max_new_tokens=int(req.max_new_tokens))
         if self._thread is None:
             self.start()
+        # hard capacity bound over queued + head-of-line-waiting (the
+        # Queue alone drains into _waiting, so its maxsize is not the
+        # real admission depth)
+        if self._queue.qsize() + len(self._waiting) >= self.max_queue:
+            self._reject(req)
+        try:
+            self._queue.put_nowait(req)
+        except _queue.Full:
+            self._reject(req)
         self.n_requests += 1
         if _telemetry.enabled():
             reg = _telemetry.MetricsRegistry.get_default()
             reg.counter(_telemetry.SERVING_REQUESTS,
-                        "generation requests submitted").inc()
-        self._queue.put(req)
+                        "generation requests submitted").inc(
+                engine=self.engine_id)
         # close the submit/shutdown race: if shutdown's final queue
         # drain happened before our put, _stop was set before it — so
         # seeing _stop clear here proves shutdown will drain AFTER us
@@ -724,7 +976,27 @@ class DecodeEngine:
                     break
                 r._finish("error", err)
         self._gauge_queue_depth()
-        return req
+
+    def _reject(self, req: ServingRequest) -> None:
+        """Structured hard capacity reject — with a measured
+        retry-after, so front-ends answer 429 instead of stalling the
+        client thread on a blocking put."""
+        hint = self.retry_after_hint()
+        if _telemetry.enabled():
+            _telemetry.MetricsRegistry.get_default().counter(
+                _telemetry.SERVING_REJECTS,
+                "submissions rejected because the admission "
+                "queue was full (429 at the HTTP front-end)").inc(
+                engine=self.engine_id)
+        _flight.record("serving_reject",
+                       request_id=req.request_id,
+                       engine=self.engine_id,
+                       retry_after_s=hint)
+        if req._trace is not None:
+            _tracing.finish_trace(req._trace, reason="rejected")
+        raise CapacityRejected(
+            f"admission queue full ({self.max_queue} requests "
+            f"waiting); retry after ~{hint}s", retry_after_s=hint)
 
     def generate(self, prompt_ids, max_new_tokens: int,
                  temperature: float = 0.0,
@@ -760,17 +1032,21 @@ class DecodeEngine:
 
     def stats(self) -> Dict[str, Any]:
         return {
+            "engine_id": self.engine_id,
             "slots": self.slots,
             "page_size": self.page_size,
             "max_context": self.max_context,
             "quantization": self.quantization,
             "prefill_buckets": list(self.prefill_buckets),
+            "handoff_buckets": list(self.handoff_buckets),
             "max_chunk": self.max_chunk,
             "requests": self.n_requests,
             "completed": self.n_completed,
             "decode_steps": self.n_steps,
             "dispatches": self.n_dispatches,
             "tokens": self.n_tokens,
+            "active_slots": int(self._active.sum()),
+            "queued": self._queue.qsize() + len(self._waiting),
             "avg_occupancy": (self._occupancy_sum / self.n_steps
                               if self.n_steps else 0.0),
             "kv_pages": {"capacity": self.pool.capacity,
@@ -778,7 +1054,8 @@ class DecodeEngine:
                          "high_water": self.pool.high_water,
                          "shared": self.pool.shared_pages()},
             "warm_pool": {"hits": self._warm.hits,
-                          "misses": self._warm.misses},
+                          "misses": self._warm.misses,
+                          "adopted": self._warm.adopted},
             **({"prefix_cache": self.prefix_stats()}
                if self._reuse else {}),
             # newest-first: client logs join on request_id, per-request
@@ -787,6 +1064,37 @@ class DecodeEngine:
             # the live deque would race the scheduler thread's appends
             "recent_requests": list(reversed(self._recent.copy())),
         }
+
+    # ---------------------------------------------- drain / chaos hooks
+    @property
+    def idle(self) -> bool:
+        """No request in a slot, none queued — drained. Polled by the
+        fleet's drain_replica before it shuts a replica down for an
+        elastic resize."""
+        return (not self._active.any() and self._queue.empty()
+                and not self._waiting)
+
+    def drain(self, timeout: Optional[float] = None,
+              poll_s: float = 0.01) -> bool:
+        """Wait for every queued + in-flight request to finish (the
+        caller must have stopped submitting). True when drained; False
+        on timeout or engine death."""
+        deadline = (time.monotonic() + timeout
+                    if timeout is not None else None)
+        while not self.idle:
+            if self._dead is not None or self._stop.is_set():
+                return False
+            if deadline is not None and time.monotonic() > deadline:
+                return False
+            time.sleep(poll_s)
+        return True
+
+    def _die(self, error: BaseException) -> None:
+        """Chaos hook (fleet kill-a-replica drill): make the scheduler
+        raise ``error`` at its next iteration, driving the REAL death
+        path — slot evictions, flight-recorder incident, fleet
+        re-routing."""
+        self._poison = error
 
     def shutdown(self, timeout: float = 30.0) -> None:
         self._stop.set()
@@ -815,6 +1123,8 @@ class DecodeEngine:
     def _loop(self) -> None:
         try:
             while not self._stop.is_set():
+                if self._poison is not None:   # chaos/drill hook
+                    raise self._poison
                 self._admit_waiting()
                 if not self._active.any():
                     try:
@@ -826,7 +1136,9 @@ class DecodeEngine:
                 self._decode_step()
         except BaseException as e:       # engine died: strand no one
             self._dead = e
-            _flight.incident("serving_engine_died", error=repr(e)[:400])
+            _flight.incident("serving_engine_died",
+                             engine=self.engine_id,
+                             error=repr(e)[:400])
             self._fail_pending(e)
         finally:
             if self._dead is None:
@@ -938,6 +1250,18 @@ class DecodeEngine:
         ps = self.page_size
         total_pages = kv_pages.pages_needed(
             t0 + req.max_new_tokens, ps)
+        if req._handoff is not None:
+            # disaggregated prefill: the lane already computed the
+            # prompt's K/V — allocate the full footprint and commit via
+            # the adopt scatter in _admit. Bypasses session/prefix
+            # resolution by construction (the router never lanes a
+            # session-affine resume).
+            pages = self._alloc_with_evict(total_pages)
+            if pages is None:
+                return None
+            return {"kind": "handoff", "rows": pages, "copies": [],
+                    "drop_after_copy": [], "t_start": 0,
+                    "session": None}
         t_l0 = time.perf_counter()
         plan: Optional[Dict[str, Any]] = None
         if self._sessions is not None and req.session_id is not None:
@@ -1033,13 +1357,15 @@ class DecodeEngine:
             reg.counter(
                 _telemetry.SERVING_PREFIX_HITS,
                 "prefix-cache lookups that reused >= 1 committed "
-                "page").inc(kind="session")
+                "page").inc(kind="session", engine=self.engine_id)
             reg.counter(
                 _telemetry.SERVING_PREFIX_HIT_TOKENS,
                 "prompt tokens served from cached KV pages instead "
-                "of prefill compute").inc(t_start)
+                "of prefill compute").inc(t_start,
+                                          engine=self.engine_id)
         req._session_turns = sess.turns + 1
         _flight.record("session_resume", session_id=str(sid),
+                       engine=self.engine_id,
                        request_id=req.request_id, pos=int(sess.pos),
                        new_tokens=t0 - t_start, turns=sess.turns)
         return {"kind": "session", "rows": rows, "copies": copies,
@@ -1066,7 +1392,24 @@ class DecodeEngine:
             self.pool.free(plan["drop_after_copy"])
             plan["drop_after_copy"] = []
         t_pre = time.perf_counter()
-        if t_start == 0:
+        if plan["kind"] == "handoff":
+            # fleet handoff: the prefill lane computed ks/vs/logits on
+            # its own executable stream; commit is one page scatter
+            ks, vs, bucket, last = req._handoff
+            req._handoff = None
+            if self._device is not None:
+                # cross-device fleet: the lane computed on the default
+                # device; land the stacks on this replica's device
+                ks = jax.device_put(ks, self._device)
+                vs = jax.device_put(vs, self._device)
+            page_row = np.zeros((bucket // ps,), np.int32)
+            n_real = min(len(rows), bucket // ps)
+            page_row[:n_real] = rows[:n_real]
+            kpool, vpool = self._warm.run(
+                ("adopt", bucket), self._adopt_fallback,
+                self.pool.k, self.pool.v, ks, vs,
+                jnp.asarray(page_row))
+        elif t_start == 0:
             bucket = next((b for b in self.prefill_buckets if b >= t0),
                           kv_pages.pages_needed(t0, ps) * ps)
             prompt = np.zeros((1, bucket), np.int32)
@@ -1098,17 +1441,26 @@ class DecodeEngine:
         logits = np.asarray(last)
         t_post = time.perf_counter()
         self.pool.k, self.pool.v = kpool, vpool
-        _telemetry.record_span(
-            "serving_prefill", t_pre,
-            metric=_telemetry.SERVING_PREFILL_SECONDS, bucket=bucket)
+        if plan["kind"] == "handoff":
+            _telemetry.record_span(
+                "serving_handoff", t_pre, t_post,
+                metric=_telemetry.SERVING_HANDOFF_SECONDS,
+                bucket=bucket, engine=self.engine_id)
+        else:
+            _telemetry.record_span(
+                "serving_prefill", t_pre,
+                metric=_telemetry.SERVING_PREFILL_SECONDS,
+                bucket=bucket, engine=self.engine_id)
         first = self._sample_first(req, logits)
         s = int(np.flatnonzero(~self._active)[0])
         req.cache_hit_tokens = t_start
         if req._trace is not None:
             req._trace.event("queue_wait", req._t_submit, t_pre)
             req._trace.event("prefill", t_pre, t_post, bucket=bucket,
-                             slot=s, hit_tokens=t_start)
+                             slot=s, hit_tokens=t_start,
+                             handoff=plan["kind"] == "handoff")
         _flight.record("serving_admit", request_id=req.request_id,
+                       engine=self.engine_id,
                        slot=s, bucket=bucket, pages=len(rows),
                        reuse=plan["kind"], hit_tokens=t_start,
                        queue_ms=round((t_pre - req._t_submit) * 1e3, 3))
@@ -1132,7 +1484,8 @@ class DecodeEngine:
         if _telemetry.enabled():
             _telemetry.MetricsRegistry.get_default().counter(
                 _telemetry.SERVING_TOKENS,
-                "tokens generated across all requests").inc()
+                "tokens generated across all requests").inc(
+                engine=self.engine_id)
 
     def _sample_first(self, req: ServingRequest,
                       logits: np.ndarray) -> int:
@@ -1209,9 +1562,10 @@ class DecodeEngine:
         self._occupancy_sum += occupancy * steps
         _telemetry.record_span(
             "serving_decode_step", t0,
-            metric=_telemetry.SERVING_DECODE_STEP_SECONDS)
-        _flight.record("serving_burst", steps=steps,
-                       dispatches=len(chunks),
+            metric=_telemetry.SERVING_DECODE_STEP_SECONDS,
+            engine=self.engine_id)
+        _flight.record("serving_burst", engine=self.engine_id,
+                       steps=steps, dispatches=len(chunks),
                        occupancy=round(occupancy, 4))
         if _tracing.enabled():
             t_burst_end = time.perf_counter()
@@ -1224,9 +1578,11 @@ class DecodeEngine:
             reg = _telemetry.MetricsRegistry.get_default()
             reg.gauge(_telemetry.SERVING_SLOT_OCCUPANCY,
                       "fraction of decode slots occupied by live "
-                      "requests this step").set(occupancy)
+                      "requests this step").set(occupancy,
+                                                engine=self.engine_id)
             reg.counter(_telemetry.SERVING_DECODE_STEPS,
-                        "fixed-shape decode steps executed").inc(steps)
+                        "fixed-shape decode steps executed").inc(
+                steps, engine=self.engine_id)
         emitted0 = self.n_tokens
         for s in active_idx:
             for k in range(steps):
@@ -1237,7 +1593,7 @@ class DecodeEngine:
             _telemetry.MetricsRegistry.get_default().counter(
                 _telemetry.SERVING_TOKENS,
                 "tokens generated across all requests").inc(
-                self.n_tokens - emitted0)
+                self.n_tokens - emitted0, engine=self.engine_id)
 
     def _emit(self, s: int, token: int) -> None:
         """Hot loop (up to burst_steps x slots calls between
@@ -1252,13 +1608,14 @@ class DecodeEngine:
             reg = _telemetry.MetricsRegistry.get_default()
             reg.histogram(
                 _telemetry.SERVING_TTFT,
-                "submit -> first generated token").observe(req.ttft_s)
+                "submit -> first generated token").observe(
+                req.ttft_s, engine=self.engine_id)
             if req.cache_hit_tokens:
                 reg.histogram(
                     _telemetry.SERVING_WARM_TTFT,
                     "submit -> first token for requests whose prompt "
                     "reused cached KV (prefix-cache or session "
-                    "hit)").observe(req.ttft_s)
+                    "hit)").observe(req.ttft_s, engine=self.engine_id)
         if self._slot_emitted[s] >= req.max_new_tokens:
             self._evict(s, "length")
         elif req.eos_id is not None and token == req.eos_id:
@@ -1281,6 +1638,7 @@ class DecodeEngine:
         self.n_completed += 1
         req._finish(reason, error)
         _flight.record("serving_evict", request_id=req.request_id,
+                       engine=self.engine_id,
                        reason=reason, tokens=len(req.tokens))
         self._recent.append({
             "request_id": req.request_id,
@@ -1295,7 +1653,7 @@ class DecodeEngine:
             _telemetry.MetricsRegistry.get_default().histogram(
                 _telemetry.SERVING_REQUEST_LATENCY,
                 "submit -> completion per request").observe(
-                req.latency_s, reason=reason)
+                req.latency_s, reason=reason, engine=self.engine_id)
 
     def _maybe_pin_session(self, s: int, req: ServingRequest,
                            reason: str,
@@ -1326,7 +1684,8 @@ class DecodeEngine:
             _telemetry.MetricsRegistry.get_default().gauge(
                 _telemetry.SERVING_QUEUE_DEPTH,
                 "requests waiting for a free decode slot").set(
-                len(self._waiting) + self._queue.qsize())
+                len(self._waiting) + self._queue.qsize(),
+                engine=self.engine_id)
 
 
-__all__ = ["DecodeEngine", "ServingRequest"]
+__all__ = ["DecodeEngine", "ServingRequest", "CapacityRejected"]

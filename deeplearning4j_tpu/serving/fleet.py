@@ -1,0 +1,913 @@
+"""Serving fleet: replicated decode engines behind one KV-aware
+router, with disaggregated prefill.
+
+PRs 7-9 built a production single-device serving core — continuous
+batching, paged KV, prefix cache, sticky sessions. "Millions of users"
+needs the fleet around it, and the same fuse-and-overlap playbook the
+repo applied to kernels applies one level up:
+
+- **One admission queue, N replicas.** ``ServingFleet`` owns N
+  ``DecodeEngine`` replicas (one per device, or N same-device CPU
+  replicas for tests) behind ONE queue and a router thread
+  ("ServingFleetRouter"). Clients get a ``FleetRequest`` handle with
+  the same ``result()``/``stream()`` API as a ``ServingRequest``.
+- **KV-aware routing.** A request is scored onto the replica where it
+  will run best: free KV pages and free slots (capacity), plus each
+  replica's OWN prefix-cache hit hint for this prompt (locality — the
+  replica already holding the prompt's prefix pages wins, so shared
+  system prompts concentrate instead of re-prefilling everywhere).
+- **Session affinity.** A ``session_id`` whose pages are pinned on
+  replica k routes back to k (warm resume, zero history re-prefill),
+  falling back to a cold prefill elsewhere only when k is saturated or
+  dead.
+- **Disaggregated prefill.** Prompts >= ``prefill_threshold`` tokens
+  run on a dedicated prefill lane — its own AOT-compiled executables
+  and its own submission thread ("ServingPrefillLane") — and the
+  computed K/V is handed to the decode replica through
+  ``DecodeEngine.submit_prepared``: the replica commits it with one
+  page scatter (``kv_pages.handoff_commit``) between decode bursts, so
+  a 2048-token bucket-padded prefill never stalls anyone's decode
+  bursts. The lane's output is bit-identical to the replica's own
+  prefill (same forward, same bucket padding), so greedy outputs stay
+  token-identical to a solo engine.
+- **One AOT compile.** Same-device replicas adopt replica 0's
+  warm-pool executables (``_WarmPool.adopt``): fleet startup lowers
+  and compiles each program once, not once per replica. Distinct
+  devices compile per device (executables are device-bound).
+- **Replica death and elastic resize.** A replica whose scheduler dies
+  fails its in-flight work; the fleet re-routes every such request to
+  a survivor and REPLAYS it, suppressing tokens the client already
+  received (greedy and seeded sampling replay exactly; unseeded
+  sampling may change distribution at the failover point — documented,
+  not hidden). Sessions pinned on the dead replica are gone; their
+  next turn re-admits cold elsewhere. ``drain_replica`` /
+  ``restart_replica`` give elastic resize; ``kill_replica`` is the
+  chaos hook the CI drill uses.
+
+Everything is observable: ``SERVING_*`` metrics are labelled
+``engine=<id>`` per replica, the fleet adds routed/reroute counters and
+a live-replica gauge, traces carry per-replica ``engine`` tags plus
+``route``/``lane_prefill`` spans, and the flight recorder sees
+``fleet_replica_dead`` / ``fleet_reroute`` events.
+"""
+
+from __future__ import annotations
+
+import itertools
+import queue as _queue
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.profiler import flight_recorder as _flight
+from deeplearning4j_tpu.profiler import telemetry as _telemetry
+from deeplearning4j_tpu.serving.engine import (
+    CapacityRejected, DecodeEngine, ServingRequest, device_sds,
+    prefill_forward,
+)
+
+
+# ---------------------------------------------------------------- client
+class FleetRequest:
+    """Client handle for one fleet request: the ``ServingRequest`` API
+    (``result``/``stream``/``done``/timings) over whatever replica —
+    or sequence of replicas, under failover — actually serves it.
+
+    Token replay on failover: the proxy counts tokens per attempt and
+    suppresses the first ``len(tokens)`` of a replayed attempt, so the
+    client-visible stream never duplicates or loses a token."""
+
+    def __init__(self, prompt: np.ndarray, max_new_tokens: int,
+                 temperature: float, eos_id, sample_seed, session_id):
+        self.prompt = prompt
+        self.max_new_tokens = int(max_new_tokens)
+        self.temperature = float(temperature)
+        self.eos_id = eos_id
+        self.sample_seed = sample_seed
+        self.session_id = session_id
+        self.tokens: List[int] = []
+        self.finish_reason: Optional[str] = None
+        self.ttft_s: Optional[float] = None
+        self.latency_s: Optional[float] = None
+        self.cache_hit_tokens = 0
+        #: routing facts front-ends echo: replica, reason, lane, attempts
+        self.routing: Dict[str, Any] = {}
+        self.engine_id: Optional[str] = None
+        self.attempts = 0
+        self._fleet: Optional["ServingFleet"] = None
+        self._inner: Optional[ServingRequest] = None
+        self._engine: Optional[DecodeEngine] = None
+        self._replica_index: Optional[int] = None
+        self._lane_result = None     # cached (handoff, lane_span)
+        self._no_lane = False        # lane failed once: go direct
+        self._skip = 0               # replayed tokens to suppress
+        self._seen = 0               # tokens seen from current attempt
+        self._t_submit = time.perf_counter()
+        self._stream: "_queue.Queue" = _queue.Queue()
+        self._done = threading.Event()
+        self._error: Optional[BaseException] = None
+        self._lock = threading.RLock()
+
+    # -- engine-side hooks (see ServingRequest._sink) -------------------
+    def _attach(self, inner: ServingRequest, engine: DecodeEngine) \
+            -> None:
+        """Called synchronously inside engine.submit BEFORE the request
+        becomes visible to the scheduler — no token can race this."""
+        with self._lock:
+            self._inner = inner
+            self._engine = engine
+            self._seen = 0
+            self._skip = len(self.tokens)
+            self.engine_id = engine.engine_id
+            self.routing.update(replica=engine.engine_id,
+                                attempts=self.attempts)
+
+    def _on_token(self, inner: ServingRequest, token: int) -> None:
+        with self._lock:
+            if inner is not self._inner or self._done.is_set():
+                return
+            self._seen += 1
+            if self._seen <= self._skip:
+                return               # replayed token the client has
+            if self.ttft_s is None:
+                self.ttft_s = time.perf_counter() - self._t_submit
+            self.tokens.append(token)
+        self._stream.put(token)
+
+    def _on_finish(self, inner: ServingRequest, reason: str,
+                   error: Optional[BaseException]) -> None:
+        with self._lock:
+            if inner is not self._inner or self._done.is_set():
+                return
+        fleet = self._fleet
+        if error is not None and fleet is not None \
+                and fleet._maybe_reroute(self, inner, error):
+            return                   # re-queued onto a survivor
+        self._finalize(reason, error, inner)
+
+    def _finalize(self, reason: str, error: Optional[BaseException],
+                  inner: Optional[ServingRequest] = None) -> None:
+        with self._lock:
+            if self._done.is_set():
+                return
+            self.finish_reason = reason
+            self._error = error
+            self.latency_s = time.perf_counter() - self._t_submit
+            if inner is not None:
+                self.cache_hit_tokens = inner.cache_hit_tokens
+            self._stream.put(None)
+            self._done.set()
+        fleet = self._fleet
+        if fleet is not None:
+            fleet._on_request_done(self)
+
+    def _fail(self, error: BaseException) -> None:
+        self._finalize("error", error)
+
+    # -- client side ----------------------------------------------------
+    @property
+    def request_id(self):
+        inner = self._inner
+        return inner.request_id if inner is not None else None
+
+    @property
+    def trace_id(self):
+        inner = self._inner
+        return inner.trace_id if inner is not None else None
+
+    @property
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def result(self, timeout: Optional[float] = None) -> np.ndarray:
+        if not self._done.wait(timeout):
+            raise TimeoutError(
+                f"fleet request not done within {timeout}s")
+        if self._error is not None:
+            raise self._error
+        return np.asarray(self.tokens, np.int32)
+
+    def stream(self):
+        """Yield tokens as they decode — across failovers; raises the
+        request's error (if any) after the stream ends."""
+        while True:
+            tok = self._stream.get()
+            if tok is None:
+                break
+            yield tok
+        if self._error is not None:
+            raise self._error
+
+
+# ---------------------------------------------------------------- lane
+class _PrefillLane:
+    """Disaggregated prefill: a dedicated submission thread and its own
+    AOT-compiled executables run long prompts' prefill forward, then
+    hand the K/V stacks to the target replica via submit_prepared. The
+    arrays are immutable jax values, so the handoff needs no
+    cross-thread synchronization beyond the queue."""
+
+    def __init__(self, fleet: "ServingFleet", model, params,
+                 buckets: List[int], threshold: int, device=None):
+        self.fleet = fleet
+        self.model = model
+        self.params = params          # replica-0's device-put tree
+        #: replica 0's device: the lane compiles and runs THERE (its
+        #: params already live there); handoff to another replica is a
+        #: device_put inside the target's _admit
+        self._device = device
+        self.buckets = sorted(buckets)
+        self.threshold = int(threshold)
+        self._exec: Dict[int, Any] = {}
+        self._queue: "_queue.Queue" = _queue.Queue()
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self.n_prefills = 0
+        self.n_fallbacks = 0
+
+    def _build_fn(self):
+        m = self.model
+
+        def lane_prefill(params, prompt, t0):
+            # the ONE shared prefill math (engine.prefill_forward):
+            # lane-served prompts are bit-identical to engine-served
+            # ones by construction, not by parallel maintenance
+            ks, vs, last = prefill_forward(m, params, prompt, t0)
+            return ks, vs, last.astype(jnp.float32)
+
+        return lane_prefill
+
+    def _sds(self, shape, dtype):
+        return device_sds(shape, dtype, self._device)
+
+    def start(self) -> None:
+        fn = self._build_fn()
+        i32 = jnp.int32
+        with _telemetry.span("serving_lane_warmup",
+                             buckets=len(self.buckets)):
+            abs_params = jax.tree_util.tree_map(
+                lambda a: self._sds(a.shape, a.dtype), self.params)
+            for b in self.buckets:
+                # one AOT executable per long bucket — the lane never
+                # compiles after startup (its dispatch is the compiled
+                # executable directly, like the engines' warm pool)
+                self._exec[b] = jax.jit(fn).lower(
+                    abs_params, self._sds((1, b), i32),
+                    self._sds((), i32)).compile()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="ServingPrefillLane")
+        self._thread.start()
+
+    def enqueue(self, freq: FleetRequest, replica: "_Replica") -> None:
+        self._queue.put((freq, replica))
+
+    def shutdown(self, timeout: float = 10.0) -> None:
+        self._stop.set()
+        self._queue.put(None)
+        t = self._thread
+        if t is not None:
+            t.join(timeout)
+        # strand no one: requests still queued at the lane (the loop
+        # fails everything it dequeues after _stop, but a racing
+        # enqueue can land behind the sentinel) fail here
+        while True:
+            try:
+                item = self._queue.get_nowait()
+            except _queue.Empty:
+                break
+            if item is not None:
+                item[0]._fail(RuntimeError("fleet has been shut down"))
+
+    def stats(self) -> Dict[str, Any]:
+        return {"threshold": self.threshold,
+                "buckets": list(self.buckets),
+                "prefills": self.n_prefills,
+                "fallbacks": self.n_fallbacks,
+                "queued": self._queue.qsize()}
+
+    def _loop(self) -> None:
+        while True:
+            item = self._queue.get()
+            if item is None:
+                break
+            freq, replica = item
+            if self._stop.is_set():
+                # keep draining up to the sentinel: every queued
+                # request gets a clean error, never a silent hang
+                freq._fail(RuntimeError("fleet has been shut down"))
+                continue
+            try:
+                self._serve(freq, replica)
+            except BaseException as e:     # lane must not die silently
+                freq._no_lane = True
+                self.n_fallbacks += 1
+                _flight.record("lane_fallback", error=repr(e)[:200])
+                self.fleet._requeue(freq, "lane_error")
+
+    def _serve(self, freq: FleetRequest, replica: "_Replica") -> None:
+        t0 = int(freq.prompt.size)
+        bucket = next((b for b in self.buckets if b >= t0), None)
+        if bucket is None:
+            # longer than every compiled lane bucket: cold prefill on
+            # the replica (its own out-of-bucket fallback handles it)
+            freq._no_lane = True
+            self.n_fallbacks += 1
+            self.fleet._submit_to(replica, freq)
+            return
+        if freq._lane_result is None:
+            padded = np.zeros((1, bucket), np.int32)
+            padded[0, :t0] = freq.prompt
+            t_a = time.perf_counter()
+            ks, vs, last = self._exec[bucket](
+                self.params, jnp.asarray(padded),
+                jnp.asarray(t0, jnp.int32))
+            logits = np.asarray(last)
+            t_b = time.perf_counter()
+            self.n_prefills += 1
+            _telemetry.record_span(
+                "serving_lane_prefill", t_a, t_b,
+                metric=_telemetry.SERVING_LANE_SECONDS, bucket=bucket)
+            if _telemetry.enabled():
+                _telemetry.MetricsRegistry.get_default().counter(
+                    _telemetry.SERVING_LANE_PREFILLS,
+                    "long-prompt prefills run on the disaggregated "
+                    "lane instead of a decode replica").inc(
+                    bucket=bucket)
+            freq._lane_result = ((ks, vs, bucket, logits),
+                                 (t_a, t_b, bucket))
+        self.fleet._submit_to(replica, freq,
+                              handoff=freq._lane_result)
+
+
+# ------------------------------------------------------------- replicas
+class _Replica:
+    __slots__ = ("index", "engine", "alive", "draining",
+                 "needs_cleanup")
+
+    def __init__(self, index: int, engine: DecodeEngine):
+        self.index = index
+        self.engine = engine
+        self.alive = True
+        self.draining = False
+        self.needs_cleanup = False
+
+
+# ---------------------------------------------------------------- fleet
+class ServingFleet:
+    """N decode-engine replicas, one admission queue, a KV-aware
+    router, and an optional disaggregated prefill lane (module doc).
+
+    Duck-types the ``DecodeEngine`` front-end surface (``submit`` /
+    ``generate`` / ``stats`` / ``prefix_stats`` / ``release_session`` /
+    ``shutdown``), so ``JsonModelServer(engine=fleet)`` and
+    ``GenerativeInference`` work unchanged.
+
+    Parameters
+    ----------
+    replicas : engine count (ignored when ``devices`` is given).
+    devices : one jax device per replica; None places every replica on
+        the default device (the N-CPU-replicas test topology), which
+        also lets them share one AOT compile.
+    prefill_threshold : prompts with at least this many tokens prefill
+        on the dedicated lane; None disables disaggregation (and then
+        a 1-replica fleet is greedy token-identical to a solo engine).
+    max_queue : fleet admission queue bound — beyond it ``submit``
+        raises the structured ``CapacityRejected``.
+    engine_kwargs : forwarded to every ``DecodeEngine`` (slots,
+        page_size, prefix_cache, session_capacity, ...).
+    """
+
+    #: failed-over requests get this many total attempts before the
+    #: error surfaces to the client
+    MAX_ATTEMPTS_EXTRA = 1
+
+    def __init__(self, model, params, *, replicas: int = 2,
+                 devices: Optional[List[Any]] = None,
+                 prefill_threshold: Optional[int] = None,
+                 max_queue: int = 1024,
+                 **engine_kwargs):
+        if devices is not None:
+            replicas = len(devices)
+        if replicas < 1:
+            raise ValueError("need at least one replica")
+        engine_kwargs.setdefault("max_queue", max(256, max_queue))
+        self.model = model
+        self.prefill_threshold = prefill_threshold
+        #: the exact per-engine config, kept verbatim so
+        #: restart_replica builds an identical engine (reverse-
+        #: engineering kwargs from a live engine silently drops any
+        #: newly-added knob)
+        self._engine_kwargs = dict(engine_kwargs)
+        self._replicas: List[_Replica] = []
+        first: Optional[DecodeEngine] = None
+        for i in range(replicas):
+            dev = devices[i] if devices is not None else None
+            eng = DecodeEngine(
+                model, params, device=dev,
+                handoff_threshold=prefill_threshold,
+                warm_source=first, **engine_kwargs)
+            if first is None:
+                first = eng
+            self._replicas.append(_Replica(i, eng))
+        self._lane: Optional[_PrefillLane] = None
+        if prefill_threshold is not None:
+            self._lane = _PrefillLane(
+                self, model, first.params,
+                first.handoff_buckets, prefill_threshold,
+                device=first._device)
+        self._queue: "_queue.Queue" = _queue.Queue(maxsize=max_queue)
+        self._affinity: Dict[str, int] = {}
+        self._aff_lock = threading.Lock()
+        #: serializes dead-replica cleanup (router _health_check) vs
+        #: restart_replica's engine swap — without it the router can
+        #: shut down a freshly-restarted engine it mistook for the
+        #: dead one
+        self._cleanup_lock = threading.Lock()
+        self._router: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._start_lock = threading.Lock()
+        self._rr = itertools.count()       # score tie-break rotation
+        # fleet stats
+        self.n_requests = 0
+        self.n_completed = 0
+        self.n_reroutes = 0
+        self._routed: Dict[str, int] = {}
+        self._stats_lock = threading.Lock()
+
+    # ------------------------------------------------------- lifecycle
+    def start(self) -> "ServingFleet":
+        with self._start_lock:
+            if self._router is not None:
+                return self
+            if self._stop.is_set():
+                raise RuntimeError("fleet has been shut down")
+            with _telemetry.span("serving_fleet_start",
+                                 replicas=len(self._replicas)):
+                # replica 0 first: it compiles the shared warm pool the
+                # others adopt
+                for r in self._replicas:
+                    r.engine.start()
+                if self._lane is not None:
+                    self._lane.start()
+            self._gauge_replicas()
+            self._router = threading.Thread(
+                target=self._route_loop, daemon=True,
+                name="ServingFleetRouter")
+            self._router.start()
+        return self
+
+    def shutdown(self, timeout: float = 30.0) -> None:
+        self._stop.set()
+        self._queue.put(None)              # router sentinel
+        if self._lane is not None:
+            self._lane.shutdown(timeout)
+        t = self._router
+        if t is not None:
+            t.join(timeout)
+        # anything still queued at the fleet fails now, explicitly
+        while True:
+            try:
+                item = self._queue.get_nowait()
+            except _queue.Empty:
+                break
+            if isinstance(item, FleetRequest):
+                item._fail(RuntimeError("fleet has been shut down"))
+        for r in self._replicas:
+            r.engine.shutdown(timeout)
+        self._gauge_replicas()
+
+    def __enter__(self) -> "ServingFleet":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.shutdown()
+
+    # ---------------------------------------------------------- client
+    def submit(self, prompt_ids, max_new_tokens: int,
+               temperature: float = 0.0, eos_id: Optional[int] = None,
+               sample_seed: Optional[int] = None,
+               session_id: Optional[str] = None) -> FleetRequest:
+        if self._stop.is_set():
+            raise RuntimeError("fleet has been shut down")
+        # validate synchronously (every replica has the same config)
+        prompt = self._replicas[0].engine._validate(prompt_ids,
+                                                    max_new_tokens)
+        if self._router is None:
+            self.start()
+        freq = FleetRequest(prompt, max_new_tokens, temperature,
+                            eos_id, sample_seed, session_id)
+        freq._fleet = self
+        try:
+            self._queue.put_nowait(freq)
+        except _queue.Full:
+            hints = [r.engine.retry_after_hint()
+                     for r in self._replicas if r.alive]
+            hint = min(hints) if hints else 1.0
+            if _telemetry.enabled():
+                _telemetry.MetricsRegistry.get_default().counter(
+                    _telemetry.SERVING_REJECTS,
+                    "submissions rejected because the admission "
+                    "queue was full (429 at the HTTP front-end)").inc(
+                    engine="fleet")
+            raise CapacityRejected(
+                f"fleet admission queue full ({self._queue.maxsize}); "
+                f"retry after ~{hint}s", retry_after_s=hint)
+        # close the submit/shutdown race (same contract as the engine's
+        # _enqueue): if shutdown's final drain ran before our put, we
+        # must fail the stranded request ourselves — seeing _stop clear
+        # here proves shutdown will drain after us
+        if self._stop.is_set():
+            while True:
+                try:
+                    item = self._queue.get_nowait()
+                except _queue.Empty:
+                    break
+                if isinstance(item, FleetRequest):
+                    item._fail(RuntimeError("fleet has been shut "
+                                            "down"))
+        self.n_requests += 1
+        return freq
+
+    def generate(self, prompt_ids, max_new_tokens: int,
+                 temperature: float = 0.0,
+                 eos_id: Optional[int] = None,
+                 timeout: Optional[float] = None) -> np.ndarray:
+        return self.submit(prompt_ids, max_new_tokens, temperature,
+                           eos_id).result(timeout)
+
+    def release_session(self, session_id: str) -> bool:
+        with self._aff_lock:
+            self._affinity.pop(session_id, None)
+        # every alive replica, not just the current affinity target: an
+        # affinity_fallback may have left an older pin on a replica the
+        # session was served from earlier — an explicit release must
+        # free those pages too, not wait out their TTL
+        hit = False
+        for r in self._replicas:
+            if r.alive:
+                hit = r.engine.release_session(session_id) or hit
+        return hit
+
+    # ----------------------------------------------------------- stats
+    @property
+    def n_dispatches(self) -> int:
+        return sum(r.engine.n_dispatches for r in self._replicas)
+
+    def alive_replicas(self) -> int:
+        return sum(1 for r in self._replicas if r.alive)
+
+    def stats(self) -> Dict[str, Any]:
+        e0 = self._replicas[0].engine
+        with self._stats_lock:
+            routed = dict(self._routed)
+        return {
+            "fleet": True,
+            "replicas": [dict(r.engine.stats(), alive=r.alive,
+                              draining=r.draining)
+                         for r in self._replicas],
+            "alive_replicas": self.alive_replicas(),
+            "slots": sum(r.engine.slots for r in self._replicas
+                         if r.alive),
+            "page_size": e0.page_size,
+            "max_context": e0.max_context,
+            "quantization": e0.quantization,
+            "prefill_buckets": list(e0.prefill_buckets),
+            "requests": self.n_requests,
+            "completed": self.n_completed,
+            "reroutes": self.n_reroutes,
+            "router": {"queue_depth": self._queue.qsize(),
+                       "routed": routed,
+                       "affinity_entries": len(self._affinity)},
+            **({"prefill_lane": self._lane.stats()}
+               if self._lane is not None else {}),
+        }
+
+    def prefix_stats(self) -> Dict[str, Any]:
+        return {
+            "fleet": True,
+            "replicas": {r.engine.engine_id: r.engine.prefix_stats()
+                         for r in self._replicas},
+        }
+
+    # --------------------------------------------------- elastic resize
+    def drain_replica(self, index: int,
+                      timeout: Optional[float] = 60.0) -> bool:
+        """Stop routing to replica ``index``, wait for its queued and
+        in-flight requests to finish, then shut it down. Sessions
+        pinned there are released (their next turn re-admits cold
+        elsewhere). True when fully drained."""
+        r = self._replicas[index]
+        r.draining = True
+        self._drop_affinity(index)
+        ok = r.engine.drain(timeout)
+        r.engine.shutdown()
+        r.alive = False
+        self._gauge_replicas()
+        _flight.record("fleet_replica_drained",
+                       engine=r.engine.engine_id, clean=ok)
+        return ok
+
+    def restart_replica(self, index: int) -> None:
+        """Bring a drained/dead replica back: a fresh engine (adopting
+        a live same-device replica's warm pool when possible) starts
+        and rejoins routing."""
+        r = self._replicas[index]
+        if r.alive:
+            raise ValueError(f"replica {index} is still alive")
+        old = r.engine
+        # finish the dead engine's cleanup HERE (under the same lock
+        # the router's pass takes) before the new engine becomes
+        # visible — otherwise a concurrent _health_check could shut
+        # down the fresh engine it mistakes for the dead one
+        with self._cleanup_lock:
+            pending = r.needs_cleanup
+            r.needs_cleanup = False
+        if pending:
+            try:
+                old.shutdown(timeout=5.0)
+            except Exception:
+                pass
+        donor = next((x.engine for x in self._replicas
+                      if x.alive and x.engine._device == old._device),
+                     None)
+        eng = DecodeEngine(
+            self.model, old.params, device=old._device,
+            handoff_threshold=self.prefill_threshold,
+            warm_source=donor, **self._engine_kwargs)
+        eng.start()
+        with self._cleanup_lock:
+            r.engine = eng
+            r.alive = True
+            r.draining = False
+        self._gauge_replicas()
+        _flight.record("fleet_replica_restarted",
+                       engine=eng.engine_id, index=index)
+
+    def kill_replica(self, index: int,
+                     error: Optional[BaseException] = None) -> None:
+        """Chaos hook: poison replica ``index``'s scheduler so it dies
+        the way a real fault would — evictions, incident dump,
+        re-routing. The CI kill-a-replica drill calls this."""
+        self._replicas[index].engine._die(
+            error or RuntimeError(f"replica {index} killed by chaos "
+                                  "hook"))
+
+    # ----------------------------------------------------------- router
+    def _route_loop(self) -> None:
+        while True:
+            try:
+                item = self._queue.get(timeout=0.05)
+            except _queue.Empty:
+                self._health_check()
+                continue
+            if item is None or self._stop.is_set():
+                if isinstance(item, FleetRequest):
+                    item._fail(RuntimeError("fleet has been shut "
+                                            "down"))
+                break
+            self._health_check()
+            try:
+                self._route(item)
+            except BaseException as e:
+                item._fail(e)
+
+    def _health_check(self) -> None:
+        for r in self._replicas:
+            if r.alive and r.engine._dead is not None:
+                self._mark_dead(r, r.engine._dead)
+            if r.needs_cleanup:
+                # scheduler thread already exited; shutdown() joins it
+                # and releases sessions/prefix references so the dead
+                # pool's accounting drains (router thread only — the
+                # dying thread must never join itself). The lock keeps
+                # this from racing restart_replica's engine swap.
+                with self._cleanup_lock:
+                    if not r.needs_cleanup:
+                        continue
+                    r.needs_cleanup = False
+                    dead_engine = r.engine
+                try:
+                    dead_engine.shutdown(timeout=5.0)
+                except Exception:
+                    pass
+
+    def _mark_dead(self, r: _Replica, err: BaseException) -> None:
+        if not r.alive:
+            return
+        r.alive = False
+        r.needs_cleanup = True
+        self._drop_affinity(r.index)
+        self._gauge_replicas()
+        _flight.record("fleet_replica_dead",
+                       engine=r.engine.engine_id,
+                       error=repr(err)[:200])
+
+    def _drop_affinity(self, index: int) -> None:
+        with self._aff_lock:
+            for sid in [s for s, i in self._affinity.items()
+                        if i == index]:
+                del self._affinity[sid]
+
+    def _gauge_replicas(self) -> None:
+        if _telemetry.enabled():
+            _telemetry.MetricsRegistry.get_default().gauge(
+                _telemetry.SERVING_FLEET_REPLICAS,
+                "decode replicas currently alive and routable").set(
+                self.alive_replicas())
+
+    def _saturated(self, r: _Replica) -> bool:
+        eng = r.engine
+        depth = eng._queue.qsize() + len(eng._waiting)
+        # hard-full admission counts as saturated even with a free
+        # slot (a page-blocked head-of-line request can idle a slot
+        # while the queue is at max_queue — routing there would only
+        # bounce off CapacityRejected)
+        if depth >= eng.max_queue:
+            return True
+        return bool(eng._active.all()) and depth >= eng.slots
+
+    def _route(self, freq: FleetRequest) -> None:
+        t_r0 = time.perf_counter()
+        cands = [r for r in self._replicas
+                 if r.alive and not r.draining]
+        if not cands:
+            freq._fail(RuntimeError("no live replicas"))
+            return
+        target: Optional[_Replica] = None
+        reason = "score"
+        if freq.session_id is not None:
+            with self._aff_lock:
+                idx = self._affinity.get(freq.session_id)
+            if idx is not None:
+                aff = self._replicas[idx]
+                if aff.alive and not aff.draining \
+                        and not self._saturated(aff):
+                    target, reason = aff, "affinity"
+                else:
+                    # pinned replica saturated or gone: cold elsewhere
+                    reason = "affinity_fallback"
+        if target is None:
+            target = self._pick(freq, cands)
+        if freq.session_id is not None:
+            with self._aff_lock:
+                self._affinity[freq.session_id] = target.index
+        freq.routing.update(reason=reason)
+        if _telemetry.enabled():
+            _telemetry.MetricsRegistry.get_default().counter(
+                _telemetry.SERVING_FLEET_ROUTED,
+                "requests routed to a replica (labels: reason, "
+                "target engine)").inc(
+                reason=reason, engine=target.engine.engine_id)
+        with self._stats_lock:
+            self._routed[reason] = self._routed.get(reason, 0) + 1
+        lane_ok = (self._lane is not None and not freq._no_lane
+                   and reason != "affinity"
+                   and freq.prompt.size >= self._lane.threshold)
+        freq.routing["lane"] = bool(lane_ok)
+        freq.routing["route_ms"] = round(
+            (time.perf_counter() - t_r0) * 1e3, 3)
+        if lane_ok:
+            self._lane.enqueue(freq, target)
+        else:
+            self._submit_to(target, freq, handoff=freq._lane_result)
+
+    def _pick(self, freq: FleetRequest,
+              cands: List[_Replica]) -> _Replica:
+        """KV-aware score: free pages + free slots (capacity), the
+        replica's own prefix-cache hit hint for this prompt
+        (locality), minus queue depth. Ties rotate round-robin."""
+        off = next(self._rr)
+        best, best_score = None, None
+        n = len(cands)
+        for j in range(n):
+            r = cands[(j + off) % n]
+            eng = r.engine
+            free_pages = eng.pool.free_pages / max(eng.pool.capacity, 1)
+            free_slots = (eng.slots - int(eng._active.sum())) \
+                / eng.slots
+            depth = eng._queue.qsize() + len(eng._waiting)
+            hit = 0.0
+            if eng._prefix is not None:
+                hit = eng._prefix.hit_tokens_hint(freq.prompt) \
+                    / max(int(freq.prompt.size), 1)
+            score = 2.0 * hit + free_pages + free_slots \
+                - 0.5 * depth / eng.slots
+            if best_score is None or score > best_score:
+                best, best_score = r, score
+        return best
+
+    def _submit_to(self, target: _Replica, freq: FleetRequest,
+                   handoff=None) -> None:
+        """Hand a routed request to a replica engine (router or lane
+        thread). Replica trouble re-queues instead of failing."""
+        eng = target.engine
+        freq.attempts += 1
+        freq._replica_index = target.index
+        t_s0 = time.perf_counter()
+        try:
+            if handoff is not None:
+                ho, lane_span = handoff
+                inner = eng.submit_prepared(
+                    freq.prompt, freq.max_new_tokens,
+                    freq.temperature, freq.eos_id, freq.sample_seed,
+                    session_id=freq.session_id, handoff=ho,
+                    lane_span=lane_span, _sink=freq)
+                freq._lane_result = None
+            else:
+                inner = eng.submit(
+                    freq.prompt, freq.max_new_tokens,
+                    freq.temperature, freq.eos_id, freq.sample_seed,
+                    session_id=freq.session_id, _sink=freq)
+        except CapacityRejected:
+            # replica queue full (rare: fleet sizes replica queues
+            # generously) — try again through the router
+            self._requeue(freq, "replica_full")
+            return
+        except RuntimeError as e:
+            # engine died/shut down between health checks
+            self._mark_dead(target, e)
+            self._requeue(freq, "dead_on_submit")
+            return
+        if inner._trace is not None:
+            inner._trace.event("route", t_s0,
+                               replica=eng.engine_id,
+                               reason=freq.routing.get("reason"),
+                               lane=freq.routing.get("lane", False),
+                               attempts=freq.attempts)
+
+    def _requeue(self, freq: FleetRequest, why: str) -> None:
+        if self._stop.is_set():
+            freq._fail(RuntimeError("fleet has been shut down"))
+            return
+        if freq.attempts > len(self._replicas) \
+                + self.MAX_ATTEMPTS_EXTRA:
+            if why == "replica_full":
+                # every replica's admission queue rejected us: this IS
+                # the capacity case — keep the structured 429 contract
+                # (retry_after_s) instead of an opaque error
+                hints = [r.engine.retry_after_hint()
+                         for r in self._replicas if r.alive]
+                freq._fail(CapacityRejected(
+                    f"every replica at capacity after {freq.attempts} "
+                    "attempts",
+                    retry_after_s=min(hints) if hints else 1.0))
+                return
+            freq._fail(RuntimeError(
+                f"request failed after {freq.attempts} attempts "
+                f"({why})"))
+            return
+        _flight.record("fleet_requeue", why=why,
+                       attempts=freq.attempts,
+                       tokens_done=len(freq.tokens))
+        try:
+            self._queue.put_nowait(freq)
+        except _queue.Full:
+            freq._fail(CapacityRejected(
+                "fleet queue full during re-route", 1.0))
+
+    # ------------------------------------------------------- failover
+    def _maybe_reroute(self, freq: FleetRequest,
+                       inner: ServingRequest,
+                       error: BaseException) -> bool:
+        """Engine-death failover (called from the dying engine's
+        scheduler thread via the sink hook). True when the request was
+        re-queued onto a survivor; False surfaces the error."""
+        if self._stop.is_set():
+            return False
+        idx = getattr(freq, "_replica_index", None)
+        if idx is None:
+            return False
+        r = self._replicas[idx]
+        eng = r.engine
+        if eng._dead is None and not eng._stop.is_set():
+            return False        # genuine per-request error: surface it
+        self._mark_dead(r, error)
+        if freq.attempts > len(self._replicas) \
+                + self.MAX_ATTEMPTS_EXTRA:
+            return False
+        self.n_reroutes += 1
+        if _telemetry.enabled():
+            _telemetry.MetricsRegistry.get_default().counter(
+                _telemetry.SERVING_FLEET_REROUTES,
+                "requests replayed on a survivor after their replica "
+                "died").inc(engine=eng.engine_id)
+        _flight.record("fleet_reroute",
+                       request_id=inner.request_id,
+                       from_engine=eng.engine_id,
+                       tokens_done=len(freq.tokens),
+                       attempts=freq.attempts)
+        try:
+            self._queue.put_nowait(freq)
+        except _queue.Full:
+            return False
+        return True
+
+    def _on_request_done(self, freq: FleetRequest) -> None:
+        self.n_completed += 1
+
+
+__all__ = ["ServingFleet", "FleetRequest"]

@@ -40,6 +40,7 @@ import collections
 import threading
 from typing import Dict, List, Optional, Sequence
 
+import jax
 import jax.numpy as jnp
 
 from deeplearning4j_tpu.profiler import telemetry as _telemetry
@@ -60,16 +61,23 @@ class PagePool:
     """
 
     def __init__(self, n_layers: int, n_heads: int, page_size: int,
-                 head_dim: int, n_pages: int, dtype=jnp.bfloat16):
+                 head_dim: int, n_pages: int, dtype=jnp.bfloat16,
+                 engine_id: str = "solo", device=None):
         if page_size < 1 or n_pages < 2:
             raise ValueError(
                 f"need page_size >= 1 and n_pages >= 2 (one null page "
                 f"+ one usable), got {page_size}/{n_pages}")
         self.page_size = int(page_size)
         self.n_pages = int(n_pages)
+        #: ``engine=`` label on the utilization gauges, so N pools in
+        #: one process (a serving fleet) stay distinguishable series
+        self.engine_id = str(engine_id)
         shape = (n_layers, n_pages, n_heads, page_size, head_dim)
         self.k = jnp.zeros(shape, dtype)
         self.v = jnp.zeros(shape, dtype)
+        if device is not None:
+            self.k = jax.device_put(self.k, device)
+            self.v = jax.device_put(self.v, device)
         # LIFO free list: recently-freed pages are re-used first, which
         # keeps the hot working set of pages small and cache-friendly
         self._free: List[int] = list(range(n_pages - 1, 0, -1))
@@ -190,11 +198,13 @@ class PagePool:
             reg.gauge(
                 _telemetry.SERVING_KV_PAGE_UTILIZATION,
                 "fraction of KV-cache pages currently allocated to "
-                "live requests").set(self.utilization())
+                "live requests").set(self.utilization(),
+                                     engine=self.engine_id)
             reg.gauge(
                 _telemetry.SERVING_SHARED_PAGES,
                 "KV pages mapped by more than one reader (prefix-"
-                "cache sharing)").set(self.shared_pages())
+                "cache sharing)").set(self.shared_pages(),
+                                      engine=self.engine_id)
 
 
 # ------------------------------------------------------- pure jax ops
@@ -248,9 +258,24 @@ def copy_page(kpool, vpool, src, dst):
             vpool.at[:, dst].set(vpool[:, src]))
 
 
+def handoff_commit(kpool, vpool, ks, vs, page_row, page_size: int):
+    """Cross-pool page handoff: scatter K/V computed by ANOTHER
+    executable stream (the fleet's disaggregated prefill lane) into
+    this pool's pages. The lane runs the prompt forward on its own
+    thread and hands over the raw per-layer stacks — immutable jax
+    arrays, so the snapshot stays valid however far the lane has moved
+    on — and the destination engine commits them between decode bursts
+    with this one cheap scatter instead of re-running the bucket-padded
+    prefill. Same layout contract as ``commit_prefill`` (real pages for
+    owned chunks, null page 0 for the padded tail); the dtype cast to
+    the destination pool's dtype happens inside."""
+    return commit_prefill(kpool, vpool, ks, vs, page_row, page_size)
+
+
 def pages_needed(total_positions: int, page_size: int) -> int:
     return -(-int(total_positions) // int(page_size))
 
 
 __all__ = ["PagePool", "commit_prefill", "append_token",
-           "gather_pages", "copy_page", "pages_needed"]
+           "gather_pages", "copy_page", "handoff_commit",
+           "pages_needed"]

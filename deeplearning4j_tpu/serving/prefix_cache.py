@@ -103,8 +103,9 @@ class PrefixHit:
 class PrefixCache:
     """Radix/hash index over committed prompt pages (module doc)."""
 
-    def __init__(self, page_size: int):
+    def __init__(self, page_size: int, engine_id: str = "solo"):
         self.page_size = int(page_size)
+        self.engine_id = str(engine_id)
         self._nodes: Dict[bytes, _Node] = {}
         #: parent digest -> child digests (radix fan-out)
         self._children: Dict[bytes, Set[bytes]] = {}
@@ -138,7 +139,7 @@ class PrefixCache:
             _telemetry.MetricsRegistry.get_default().gauge(
                 _telemetry.SERVING_PREFIX_CACHED_PAGES,
                 "KV pages currently indexed by the prefix cache").set(
-                len(self._nodes))
+                len(self._nodes), engine=self.engine_id)
 
     # ----------------------------------------------------------- lookup
     def _walk(self, prompt: np.ndarray) -> Tuple[List[_Node], bytes]:
@@ -241,15 +242,18 @@ class PrefixCache:
                     "prefix-cache lookups that reused >= 1 committed "
                     "page").inc(
                     kind=kind or ("partial" if hit.cow_src is not None
-                                  else "full"))
+                                  else "full"),
+                    engine=self.engine_id)
                 reg.counter(
                     _telemetry.SERVING_PREFIX_HIT_TOKENS,
                     "prompt tokens served from cached KV pages instead "
-                    "of prefill compute").inc(hit.tokens)
+                    "of prefill compute").inc(hit.tokens,
+                                              engine=self.engine_id)
             else:
                 reg.counter(
                     _telemetry.SERVING_PREFIX_MISSES,
-                    "prefix-cache lookups with no reusable page").inc()
+                    "prefix-cache lookups with no reusable page").inc(
+                    engine=self.engine_id)
 
     # ----------------------------------------------------------- insert
     def insert(self, prompt: np.ndarray, table_pages: List[int],
@@ -320,7 +324,8 @@ class PrefixCache:
                 _telemetry.MetricsRegistry.get_default().counter(
                     _telemetry.SERVING_PREFIX_EVICTED_PAGES,
                     "prefix-cache pages reclaimed by LRU eviction "
-                    "under page pressure").inc(freed)
+                    "under page pressure").inc(freed,
+                                               engine=self.engine_id)
             _flight.record("prefix_evict", pages=freed,
                            cached_pages=len(self._nodes))
         return freed

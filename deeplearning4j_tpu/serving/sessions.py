@@ -63,12 +63,14 @@ class Session:
 class SessionStore:
     """TTL + capacity bounded table of pinned sessions."""
 
-    def __init__(self, capacity: int, ttl: float):
+    def __init__(self, capacity: int, ttl: float,
+                 engine_id: str = "solo"):
         if capacity < 1:
             raise ValueError(f"session capacity must be >= 1, got "
                              f"{capacity}")
         self.capacity = int(capacity)
         self.ttl = float(ttl)
+        self.engine_id = str(engine_id)
         self._sessions: "Dict[str, Session]" = {}
         self._lock = threading.Lock()
         self.pinned = 0
@@ -103,7 +105,8 @@ class SessionStore:
                 _telemetry.SERVING_PINNED_PAGES,
                 "KV pages pinned under sticky sessions awaiting the "
                 "next turn").set(
-                sum(len(s.pages) for s in self._sessions.values()))
+                sum(len(s.pages) for s in self._sessions.values()),
+                engine=self.engine_id)
 
     # ------------------------------------------------------------- pin
     def pin(self, session_id: str, pages: List[int],
@@ -230,7 +233,8 @@ class SessionStore:
             _telemetry.MetricsRegistry.get_default().counter(
                 _telemetry.SERVING_SESSION_EVICTIONS,
                 "sticky sessions evicted (label: ttl | capacity | "
-                "pressure)").inc(reason=reason)
+                "pressure)").inc(reason=reason,
+                                 engine=self.engine_id)
         _flight.record("session_expire", session_id=str(s.session_id),
                        reason=reason, pages=len(s.pages))
 

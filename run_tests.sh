@@ -695,17 +695,20 @@ if st["warm_pool"]["misses"] != 0:
     fail.append(f"{st['warm_pool']['misses']} warm-pool misses for "
                 "in-bucket traffic")
 lat = reg.histogram(telemetry.SERVING_REQUEST_LATENCY)
-if lat.count(reason="length") != 16:
-    fail.append(f"latency histogram has {lat.count(reason='length')} "
+eid = eng.engine_id        # SERVING_* series are engine-labelled now
+if lat.count(reason="length", engine=eid) != 16:
+    fail.append(f"latency histogram has "
+                f"{lat.count(reason='length', engine=eid)} "
                 "samples, expected 16")
-pct = lat.percentiles(reason="length")
+pct = lat.percentiles(reason="length", engine=eid)
 if not (pct["p50"] > 0 and pct["p99"] >= pct["p50"]):
     fail.append(f"latency percentiles not sane: {pct}")
 if not 0 < st["avg_occupancy"] <= 1:
     fail.append(f"avg occupancy {st['avg_occupancy']} not in (0, 1]")
-if reg.gauge(telemetry.SERVING_KV_PAGE_UTILIZATION).value() != 0.0:
+if reg.gauge(telemetry.SERVING_KV_PAGE_UTILIZATION).value(
+        engine=eid) != 0.0:
     fail.append("KV pages not all freed after completion")
-if reg.histogram(telemetry.SERVING_TTFT).count() != 16:
+if reg.histogram(telemetry.SERVING_TTFT).count(engine=eid) != 16:
     fail.append("TTFT histogram incomplete")
 eng.shutdown()
 leaked = [t.name for t in threading.enumerate()
@@ -830,6 +833,171 @@ EOF
 prefixsmoke=$?
 if [ $prefixsmoke -ne 0 ]; then
     echo "FATAL: prefix-cache smoke gate regressed" >&2
+    exit 1
+fi
+
+# Fleet smoke gate (docs/SERVING.md "Fleet"): the serving fleet under
+# JAX_PLATFORMS=cpu must (a) serve 24 mixed-length requests through 2
+# replicas + the disaggregated prefill lane with greedy outputs
+# token-identical to solo generate() (and a 1-replica lane-less fleet
+# identical too), (b) pay ZERO serving-site compiles after startup —
+# replica 1 adopts replica 0's AOT warm pool, the lane and adopt
+# programs are AOT too, (c) route a sticky session back to its pinned
+# replica warm, (d) survive the kill-one-replica drill: queued and
+# in-flight requests finish on the survivor token-identically (greedy
+# replay), the flight recorder sees the death + re-route, sessions
+# re-admit cold, and (e) drain every surviving pool to 0 at shutdown
+# with no fleet thread leaked (conftest's gate also knows the
+# ServingFleetRouter/ServingPrefillLane names).
+env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu DL4J_TPU_TELEMETRY=1 \
+    DL4J_TPU_TRACING=1 python - <<'EOF'
+import sys
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.models.gpt import CausalLM
+from deeplearning4j_tpu.models.transformer import tiny_config
+from deeplearning4j_tpu.profiler import flight_recorder, telemetry, tracing
+from deeplearning4j_tpu.serving import ServingFleet
+
+cfg = tiny_config(vocab=17, max_len=64, d_model=32, n_layers=2,
+                  n_heads=4, d_ff=64)
+cfg.dropout = 0.0
+m = CausalLM(cfg, compute_dtype=jnp.float32)
+params = m.init_params(jax.random.key(1))
+solo = lambda p, n: np.asarray(m.generate(
+    params, jnp.asarray(np.asarray(p)[None, :], jnp.int32), n))[0]
+rng = np.random.default_rng(0)
+specs = []
+for i in range(24):
+    t0 = int(rng.integers(20, 40)) if i % 3 == 0 \
+        else int(rng.integers(3, 12))
+    specs.append((rng.integers(0, 17, (t0,)).astype(np.int32),
+                  int(rng.integers(2, 10))))
+
+fail = []
+reg = telemetry.MetricsRegistry.get_default()
+compiles = lambda s: reg.counter(telemetry.JIT_COMPILES).value(site=s)
+SITES = ("serving_decode", "serving_prefill", "serving_adopt",
+         "serving_lane_prefill", "serving_prefix_prefill",
+         "serving_cow_copy")
+
+# (a) 1-replica lane-less fleet == solo generate()
+one = ServingFleet(m, params, replicas=1, slots=4, page_size=8)
+with one:
+    for p, n in specs[:6]:
+        if not np.array_equal(one.generate(p, n), solo(p, n)):
+            fail.append(f"1-replica fleet diverged (prompt {p.size})")
+            break
+
+# 2 replicas + prefill lane, concurrent mixed traffic
+fl = ServingFleet(m, params, replicas=2, slots=4, page_size=8,
+                  prefill_threshold=16, prefix_cache=True,
+                  session_capacity=8)
+fl.start()
+snap = {s: compiles(s) for s in SITES}
+if fl.stats()["replicas"][1]["warm_pool"]["adopted"] == 0:
+    fail.append("replica 1 did not adopt replica 0's AOT warm pool")
+with ThreadPoolExecutor(max_workers=8) as ex:
+    handles = list(ex.map(lambda pn: fl.submit(pn[0], pn[1]), specs))
+outs = [h.result(timeout=300) for h in handles]
+for (p, n), got in zip(specs, outs):
+    if not np.array_equal(got, solo(p, n)):
+        fail.append(f"fleet output diverged from solo generate() "
+                    f"(prompt len {p.size} / new {n})")
+        break
+if fl._lane.stats()["prefills"] < 1:
+    fail.append("no long prompt took the disaggregated prefill lane")
+
+# (b) zero serving-site compiles after startup
+for s in SITES:
+    if compiles(s) != snap[s]:
+        fail.append(f"post-startup compile at {s} "
+                    f"({snap[s]} -> {compiles(s)})")
+
+# (c) session affinity: turn 2 routes back to the pinned replica warm
+t1 = rng.integers(0, 17, (9,)).astype(np.int32)
+r1 = fl.submit(t1, 5, session_id="conv")
+o1 = r1.result(120)
+t2 = np.concatenate([t1, o1,
+                     rng.integers(0, 17, (3,)).astype(np.int32)])
+r2 = fl.submit(t2, 5, session_id="conv")
+o2 = r2.result(120)
+if r2.routing["reason"] != "affinity" \
+        or r2.routing["replica"] != r1.routing["replica"]:
+    fail.append(f"session did not route back warm: {r2.routing}")
+if r2.cache_hit_tokens != t1.size + o1.size - 1:
+    fail.append(f"session resume re-prefilled history "
+                f"(hit {r2.cache_hit_tokens})")
+if not np.array_equal(o2, solo(t2, 5)):
+    fail.append("session resume diverged from solo generate()")
+
+# (d) kill-one-replica drill: a long request mid-flight on the pinned
+# replica + bystanders; everything must finish token-identically on
+# the survivor, and the incident must be observable
+doomed = r2.routing["replica"]
+idx = next(i for i, r in enumerate(fl._replicas)
+           if r.engine.engine_id == doomed)
+long_p = rng.integers(0, 17, (4,)).astype(np.int32)
+victim = fl.submit(long_p, 40, session_id="conv")   # affinity -> doomed
+others = [fl.submit(rng.integers(0, 17, (6,)).astype(np.int32), 8)
+          for _ in range(6)]
+deadline = time.time() + 60
+while len(victim.tokens) < 3 and time.time() < deadline:
+    time.sleep(0.005)
+fl.kill_replica(idx)
+got = victim.result(timeout=300)
+if not np.array_equal(got, solo(long_p, 40)):
+    fail.append("victim request not replayed token-identically")
+for h in others:
+    h.result(timeout=300)
+if fl.alive_replicas() != 1:
+    fail.append(f"alive replicas {fl.alive_replicas()}, expected 1")
+kinds = [e["kind"] for e in flight_recorder.get_default().events()]
+if "fleet_replica_dead" not in kinds or "fleet_reroute" not in kinds:
+    fail.append(f"flight recorder missed the drill: {sorted(set(kinds))}")
+tl = tracing.timeline(victim.request_id)
+if tl is None or tl["attrs"].get("engine") == doomed:
+    fail.append("victim's trace not re-tagged to the survivor")
+# sessions pinned on the dead replica re-admit cold
+t3 = np.concatenate([t2, o2, rng.integers(0, 17, (2,)).astype(np.int32)])
+r3 = fl.submit(t3, 4, session_id="conv")
+o3 = r3.result(timeout=120)
+if r3.routing["replica"] == doomed:
+    fail.append("session still routed to the dead replica")
+if not np.array_equal(o3, solo(t3, 4)):
+    fail.append("cold re-admitted session diverged")
+
+# (e) full drain at shutdown
+reroutes = fl.n_reroutes
+fl.shutdown()
+for r in fl._replicas:
+    if r.engine.pool.allocated != 0 or r.engine.pool.shared_pages():
+        fail.append(f"replica {r.index} pool did not drain "
+                    f"({r.engine.pool.allocated} pages)")
+leaked = [t.name for t in threading.enumerate() if t.is_alive()
+          and t.name.startswith(("ServingEngine", "ServingFleetRouter",
+                                 "ServingPrefillLane"))]
+if leaked:
+    fail.append(f"fleet thread(s) survived shutdown: {leaked}")
+if fail:
+    sys.stderr.write("fleet smoke FAILED:\n  " + "\n  ".join(fail)
+                     + "\n")
+    sys.exit(1)
+print(f"fleet smoke OK: 24 mixed requests token-identical across 2 "
+      f"replicas + prefill lane "
+      f"({fl._lane.stats()['prefills']} lane prefills), 0 post-start "
+      f"compiles, warm session affinity, kill drill survived "
+      f"({reroutes} reroutes), pools drained")
+EOF
+fleetsmoke=$?
+if [ $fleetsmoke -ne 0 ]; then
+    echo "FATAL: fleet smoke gate regressed" >&2
     exit 1
 fi
 

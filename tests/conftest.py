@@ -77,7 +77,9 @@ def pytest_sessionfinish(session, exitstatus):
             and (not t.daemon
                  or t.name.startswith(("DevicePrefetch",
                                        "AsyncDataSet-ETL",
-                                       "ServingEngine")))
+                                       "ServingEngine",
+                                       "ServingFleetRouter",
+                                       "ServingPrefillLane")))
         ]
 
     deadline = time.time() + 2.0

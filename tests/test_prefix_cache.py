@@ -572,9 +572,9 @@ class TestHttpAndTelemetry:
 
     def test_counters_gauges_and_warm_ttft(self, model, params):
         reg = telemetry.MetricsRegistry.get_default()
-        warm0 = reg.histogram(telemetry.SERVING_WARM_TTFT).count()
         p = (np.arange(22) % VOCAB).astype(np.int32)
         with _engine(model, params, session_capacity=2) as eng:
+            eid = eng.engine_id
             eng.submit(p, 4).result(120)
             eng.submit(p, 4).result(120)          # warm
         assert reg.counter(telemetry.SERVING_PREFIX_HITS).total() >= 1
@@ -582,7 +582,7 @@ class TestHttpAndTelemetry:
         assert reg.counter(
             telemetry.SERVING_PREFIX_HIT_TOKENS).total() >= p.size - 1
         assert reg.histogram(
-            telemetry.SERVING_WARM_TTFT).count() == warm0 + 1
+            telemetry.SERVING_WARM_TTFT).count(engine=eid) == 1
         snap = telemetry.serving_snapshot()
         for key in ("prefix_cache_hits", "prefix_cache_hit_tokens",
                     "prefix_cached_pages", "warm_ttft"):
@@ -612,3 +612,91 @@ class TestHttpAndTelemetry:
         finally:
             tracing.set_enabled(was)
             tracing.reset()
+
+
+# ----------------------------------------- concurrent submitters
+class TestConcurrentSubmitters:
+    """Multiple threads submitting shared-prefix + session traffic to
+    ONE engine — exactly what the fleet router does to each replica.
+    Every earlier prefix/session test submitted from a single thread;
+    these pin the same contracts under submit-side concurrency."""
+
+    def test_shared_prefix_under_concurrent_submitters(self, model,
+                                                       params):
+        rng = np.random.default_rng(20)
+        sys_p = rng.integers(0, VOCAB, (24,)).astype(np.int32)
+        prompts = [np.concatenate(
+            [sys_p, rng.integers(0, VOCAB, (n,)).astype(np.int32)])
+            for n in (3, 5, 7, 4, 6, 8, 5, 9)]
+        with _engine(model, params, slots=3) as eng:
+            # seed the cache so every concurrent submitter can hit
+            eng.submit(prompts[0], 4).result(120)
+            with ThreadPoolExecutor(max_workers=6) as ex:
+                handles = list(ex.map(lambda p: eng.submit(p, 4),
+                                      prompts))
+            outs = [h.result(timeout=300) for h in handles]
+            hits = [h.cache_hit_tokens for h in handles]
+            assert eng.pool.allocated == eng._prefix.cached_pages
+        for p, got in zip(prompts, outs):
+            np.testing.assert_array_equal(got,
+                                          _solo(model, params, p, 4))
+        # the shared 24-token system prompt = 3 full cached pages
+        assert sum(1 for h in hits if h >= 24) == len(hits)
+
+    def test_sessions_under_concurrent_submitters(self, model, params):
+        """N threads each drive their OWN 2-turn sticky conversation
+        concurrently; every turn-2 must resume its own history (never
+        a neighbor's) and stay token-identical to solo decode."""
+        rng = np.random.default_rng(21)
+
+        def conversation(i):
+            sid = f"conv-{i}"
+            t1 = rng.integers(0, VOCAB, (5 + i % 3,)).astype(np.int32)
+            r1 = eng.submit(t1, 4, session_id=sid)
+            o1 = r1.result(120)
+            t2 = np.concatenate(
+                [t1, o1,
+                 rng.integers(0, VOCAB, (2,)).astype(np.int32)])
+            r2 = eng.submit(t2, 4, session_id=sid)
+            o2 = r2.result(120)
+            return t2, o2, r2.cache_hit_tokens, t1.size + o1.size - 1
+
+        with _engine(model, params, slots=3,
+                     session_capacity=8, max_chunk=2) as eng:
+            with ThreadPoolExecutor(max_workers=5) as ex:
+                results = list(ex.map(conversation, range(5)))
+            for t2, o2, hit, want_hit in results:
+                assert hit == want_hit, (hit, want_hit)
+                np.testing.assert_array_equal(
+                    o2, _solo(model, params, t2, 4))
+            # release every session: pool must drain completely
+            for i in range(5):
+                eng.release_session(f"conv-{i}")
+            assert eng.pool.allocated == eng._prefix.cached_pages
+        assert eng.pool.allocated == 0
+        assert eng.pool.shared_pages() == 0
+
+    def test_concurrent_submit_and_release_session_race(self, model,
+                                                        params):
+        """release_session from a client thread racing the scheduler's
+        admissions must neither corrupt refcounts nor deadlock."""
+        rng = np.random.default_rng(22)
+        with _engine(model, params, slots=2,
+                     session_capacity=4) as eng:
+            t1 = rng.integers(0, VOCAB, (6,)).astype(np.int32)
+            for round_ in range(6):
+                sid = f"racy-{round_ % 2}"
+                r1 = eng.submit(t1, 3, session_id=sid)
+                o1 = r1.result(120)
+                t2 = np.concatenate([t1, o1])
+                with ThreadPoolExecutor(max_workers=2) as ex:
+                    fut = ex.submit(eng.submit, t2, 3, 0.0, None,
+                                    None, sid)
+                    rel = ex.submit(eng.release_session, sid)
+                    rel.result(30)
+                    out = fut.result(30).result(120)
+                # whichever side won the race, decode is exact
+                np.testing.assert_array_equal(
+                    out, _solo(model, params, t2, 3))
+                eng.release_session(sid)
+        assert eng.pool.allocated == 0

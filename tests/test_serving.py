@@ -462,27 +462,58 @@ class TestHttpServing:
 class TestServingTelemetry:
     def test_gauges_histograms_counters_populated(self, model, params):
         reg = telemetry.MetricsRegistry.get_default()
-        lat0 = reg.histogram(telemetry.SERVING_REQUEST_LATENCY).count(
-            reason="length")
         with DecodeEngine(model, params, slots=2, page_size=8) as eng:
+            eid = eng.engine_id      # fresh per engine: counts start 0
             eng.generate(np.asarray([1, 2, 3], np.int32), 5)
             eng.generate(np.asarray([4, 5], np.int32), 3)
         lat = reg.histogram(telemetry.SERVING_REQUEST_LATENCY)
-        assert lat.count(reason="length") == lat0 + 2
-        pct = lat.percentiles(reason="length")
+        assert lat.count(reason="length", engine=eid) == 2
+        pct = lat.percentiles(reason="length", engine=eid)
         assert pct["p50"] > 0 and pct["p99"] >= pct["p50"]
-        assert reg.histogram(telemetry.SERVING_TTFT).count() >= 2
-        occ = reg.gauge(telemetry.SERVING_SLOT_OCCUPANCY).value()
+        assert reg.histogram(telemetry.SERVING_TTFT).count(
+            engine=eid) == 2
+        occ = reg.gauge(telemetry.SERVING_SLOT_OCCUPANCY).value(
+            engine=eid)
         assert 0 <= occ <= 1
         # all pages freed -> utilization gauge back to 0
         assert reg.gauge(
-            telemetry.SERVING_KV_PAGE_UTILIZATION).value() == 0.0
+            telemetry.SERVING_KV_PAGE_UTILIZATION).value(
+            engine=eid) == 0.0
         snap = telemetry.serving_snapshot()
         for key in ("request_latency", "ttft", "slot_occupancy",
                     "queue_depth", "kv_page_utilization",
                     "tokens_total"):
             assert key in snap, key
+        # per-engine label sets fold into fleet-level aggregates
+        assert eid in snap["engines"]
+        assert snap["aggregate"]["requests_total"] >= 2
         assert "serving" in telemetry.snapshot()
+
+    def test_two_engines_are_distinguishable_series(self, model,
+                                                    params):
+        """The fleet-correctness contract: two engines in one process
+        must NOT merge their metrics into one series."""
+        reg = telemetry.MetricsRegistry.get_default()
+        a = DecodeEngine(model, params, slots=2, page_size=8,
+                         prefill_buckets=[8], max_chunk=2)
+        b = DecodeEngine(model, params, slots=2, page_size=8,
+                         prefill_buckets=[8], max_chunk=2,
+                         warm_source=a)
+        a.start()          # warm a first so b can adopt its programs
+        assert a.engine_id != b.engine_id
+        try:
+            a.generate(np.asarray([1, 2], np.int32), 2)
+            a.generate(np.asarray([2, 3], np.int32), 2)
+            b.generate(np.asarray([1, 2], np.int32), 2)
+        finally:
+            a.shutdown()
+            b.shutdown()
+        req = reg.counter(telemetry.SERVING_REQUESTS)
+        assert req.value(engine=a.engine_id) == 2
+        assert req.value(engine=b.engine_id) == 1
+        lat = reg.histogram(telemetry.SERVING_REQUEST_LATENCY)
+        assert lat.count(reason="length", engine=a.engine_id) == 2
+        assert lat.count(reason="length", engine=b.engine_id) == 1
 
     def test_dashboard_has_serving_card(self):
         from deeplearning4j_tpu.ui.server import _DASHBOARD_HTML
