@@ -1,0 +1,21 @@
+"""Elastic control plane: JobScheduler over one device fleet.
+
+``JobScheduler`` runs many jobs — ``TrainJob`` (a supervised ``fit()``
+with auto-resume, periodic bundles, stall verdicts, and checkpoint-and-
+migrate across topology changes) and ``ServeJob`` (a ``ServingFleet``
+with replica restart, traffic re-routing, and capacity hand-back) —
+over a ``DeviceFleet`` of chips grouped into failure-domain workers.
+See control/scheduler.py for the full story and docs/CONTROL_PLANE.md
+for the operator guide.
+"""
+
+from deeplearning4j_tpu.control.scheduler import (
+    TERMINAL, DeviceFleet, DeviceLostError, Job, JobContext,
+    JobScheduler, ServeJob, TrainJob, default_scheduler,
+    http_jobs_get, http_jobs_post, jobs_snapshot, set_default,
+)
+
+__all__ = ["JobScheduler", "TrainJob", "ServeJob", "Job", "JobContext",
+           "DeviceFleet", "DeviceLostError", "TERMINAL",
+           "set_default", "default_scheduler", "jobs_snapshot",
+           "http_jobs_get", "http_jobs_post"]

@@ -65,6 +65,14 @@ class ChaosTransferError(RuntimeError):
     """Injected transient host->device transfer failure."""
 
 
+class WorkerKilledError(RuntimeError):
+    """Injected SIGKILL-equivalent worker death: the fit loop dies
+    mid-step with NO checkpoint, exactly as a host losing power (or a
+    platform hard-kill after the grace period) would. The control
+    plane's migration path recovers the newest digest-valid bundle;
+    nothing in the dying run gets to clean up."""
+
+
 @dataclass
 class ChaosConfig:
     """What to inject and when. All fields default to 'inject nothing'
@@ -78,6 +86,16 @@ class ChaosConfig:
     transfer_error_rate: float = 0.0
     #: raise SIGTERM in-process once this many steps have completed
     preempt_at_step: Optional[int] = None
+    #: stall the training step with this ordinal for ``hang_seconds``
+    #: INSIDE the watchdog scope — the hung-not-dead failure mode only
+    #: real hardware (a wedged collective, a dead host link) otherwise
+    #: produces; the watchdog must fire, the step must then complete
+    hang_step: Optional[int] = None
+    hang_seconds: float = 2.0
+    #: raise WorkerKilledError once this many steps have completed —
+    #: SIGKILL-equivalent (no checkpoint, no cleanup), the control
+    #: plane's recover-newest-bundle-and-migrate drill
+    kill_at_step: Optional[int] = None
     seed: int = 20260803
 
     @staticmethod
@@ -87,11 +105,17 @@ class ChaosConfig:
         raw = os.environ.get("DL4J_TPU_CHAOS_NAN_STEPS", "")
         nan_steps = tuple(int(v) for v in raw.split(",") if v.strip())
         preempt = os.environ.get("DL4J_TPU_CHAOS_PREEMPT_AT")
+        hang = os.environ.get("DL4J_TPU_CHAOS_HANG_STEP")
+        kill = os.environ.get("DL4J_TPU_CHAOS_KILL_AT")
         return ChaosConfig(
             nan_steps=nan_steps,
             transfer_error_rate=float(
                 os.environ.get("DL4J_TPU_CHAOS_TRANSFER_P", "0") or 0),
             preempt_at_step=int(preempt) if preempt else None,
+            hang_step=int(hang) if hang else None,
+            hang_seconds=float(
+                os.environ.get("DL4J_TPU_CHAOS_HANG_SECONDS", "2") or 2),
+            kill_at_step=int(kill) if kill else None,
             seed=int(os.environ.get("DL4J_TPU_CHAOS_SEED", "20260803")),
         )
 
@@ -106,6 +130,8 @@ class ChaosMonkey:
         self._rng = np.random.default_rng(config.seed)
         self._lock = threading.Lock()
         self._preempted = False
+        self._hung = False
+        self._killed = False
 
     def _record(self, kind: str) -> None:
         if not _telemetry.enabled():
@@ -153,6 +179,39 @@ class ChaosMonkey:
             raise ChaosTransferError(
                 "injected transient host->device transfer failure "
                 f"(p={p})")
+
+    def maybe_hang(self, steps_done: int) -> None:
+        """Stall the current step once, for ``hang_seconds``, when the
+        configured step count is reached. Called INSIDE the fit loop's
+        watchdog scope, so a deadline shorter than the hang sees a real
+        stall verdict (watchdog fires, stall counter bumps, incident
+        dump) while the step itself eventually completes — hung, not
+        dead."""
+        at = self.config.hang_step
+        if at is None or self._hung or steps_done < at:
+            return
+        self._hung = True
+        self._record("hang")
+        log.warning("CHAOS: hanging step %d for %.1fs (watchdog drill)",
+                    steps_done, self.config.hang_seconds)
+        import time
+
+        time.sleep(self.config.hang_seconds)
+
+    def maybe_kill(self, steps_done: int) -> None:
+        """Raise WorkerKilledError once at the configured step count —
+        the SIGKILL-equivalent death: the exception escapes the fit
+        loop with no checkpoint written, and recovery is whatever the
+        newest periodic bundle holds."""
+        at = self.config.kill_at_step
+        if at is None or self._killed or steps_done < at:
+            return
+        self._killed = True
+        self._record("worker_kill")
+        log.warning("CHAOS: killing worker after %d steps (no "
+                    "checkpoint — SIGKILL-equivalent)", steps_done)
+        raise WorkerKilledError(
+            f"chaos worker kill after {steps_done} steps")
 
     def maybe_preempt(self, steps_done: int) -> None:
         """Deliver one real SIGTERM to this process at the configured
@@ -211,5 +270,24 @@ def installed(config: ChaosConfig):
         _active = prev
 
 
+def hang_replica(engine, seconds: float = 2.0) -> None:
+    """Stall a decode engine's scheduler for ``seconds`` at its next
+    loop pass — a decode burst that stops making progress without the
+    thread dying, which is how a wedged device or a hung collective
+    presents in production serving. The engine records a
+    ``chaos_hang`` flight event when the stall begins; the control
+    plane's health loop sees the replica's progress clock stop. Works
+    on a solo ``DecodeEngine`` or any fleet replica's ``.engine``."""
+    if _telemetry.enabled():
+        _telemetry.MetricsRegistry.get_default().counter(
+            _telemetry.CHAOS_INJECTED,
+            "faults injected by the chaos harness").inc(
+            kind="hang_replica")
+    log.warning("CHAOS: hanging decode engine %s for %.1fs",
+                getattr(engine, "engine_id", "?"), seconds)
+    engine._hang_s = float(seconds)
+
+
 __all__ = ["ChaosConfig", "ChaosMonkey", "ChaosTransferError",
+           "WorkerKilledError", "hang_replica",
            "active", "install", "installed"]

@@ -73,6 +73,7 @@ PRECISION_CASTS = "dl4j_tpu_precision_casts_per_step"
 FT_ROLLBACKS = "dl4j_tpu_ft_rollbacks_total"
 FT_SKIPPED_BATCHES = "dl4j_tpu_ft_skipped_batches_total"
 FT_PREEMPTION_CHECKPOINTS = "dl4j_tpu_ft_preemption_checkpoints_total"
+FT_PERIODIC_CHECKPOINTS = "dl4j_tpu_ft_periodic_checkpoints_total"
 FT_AUTO_RESUMES = "dl4j_tpu_ft_auto_resumes_total"
 TRANSFER_RETRIES = "dl4j_tpu_transfer_retries_total"
 TRANSFER_QUARANTINES = "dl4j_tpu_transfer_quarantined_batches_total"
@@ -132,6 +133,17 @@ INFERENCE_BATCH_OCCUPANCY = "dl4j_tpu_inference_batch_occupancy"
 #: tracing + flight recorder (profiler/tracing.py, flight_recorder.py)
 SPANS_DROPPED = "dl4j_tpu_spans_dropped_total"
 INCIDENT_DUMPS = "dl4j_tpu_incident_dumps_total"
+#: elastic control plane (control/scheduler.py) — one JobScheduler
+#: owning a device fleet and running many train/serve jobs over it
+JOBS_SUBMITTED = "dl4j_tpu_jobs_submitted_total"
+JOBS_FINISHED = "dl4j_tpu_jobs_finished_total"
+JOBS_RESTARTS = "dl4j_tpu_jobs_restarts_total"
+JOBS_MIGRATIONS = "dl4j_tpu_jobs_migrations_total"
+JOBS_RUNNING = "dl4j_tpu_jobs_running"
+JOBS_DEVICES = "dl4j_tpu_jobs_devices"
+JOBS_THROUGHPUT = "dl4j_tpu_job_throughput"
+JOBS_MFU = "dl4j_tpu_job_mfu"
+JOBS_LATENCY_P50 = "dl4j_tpu_job_request_p50_ms"
 
 
 def enabled() -> bool:
@@ -823,6 +835,16 @@ def snapshot() -> Dict[str, Any]:
             out["flight_recorder"] = fl
     except Exception:
         pass
+    # control plane (lazy + peek-style like tracing/flight: {} unless a
+    # JobScheduler is live in this process)
+    try:
+        from deeplearning4j_tpu import control as _control
+
+        js = _control.jobs_snapshot()
+        if js:
+            out["jobs"] = js
+    except Exception:
+        pass
     return out
 
 
@@ -941,6 +963,7 @@ __all__ = [
     "LOSS_SCALE", "LOSS_SCALE_OVERFLOWS", "LOSS_SCALE_SKIPPED_STEPS",
     "PRECISION_CASTS",
     "FT_ROLLBACKS", "FT_SKIPPED_BATCHES", "FT_PREEMPTION_CHECKPOINTS",
+    "FT_PERIODIC_CHECKPOINTS",
     "FT_AUTO_RESUMES", "TRANSFER_RETRIES", "TRANSFER_QUARANTINES",
     "WATCHDOG_STALLS", "CHAOS_INJECTED",
     "LAYER_GRAD_NORM", "LAYER_PARAM_NORM", "UPDATE_RATIO",
@@ -962,4 +985,7 @@ __all__ = [
     "INFERENCE_REQUEST_LATENCY", "INFERENCE_QUEUE_DEPTH",
     "INFERENCE_BATCH_OCCUPANCY",
     "SPANS_DROPPED", "INCIDENT_DUMPS",
+    "JOBS_SUBMITTED", "JOBS_FINISHED", "JOBS_RESTARTS",
+    "JOBS_MIGRATIONS", "JOBS_RUNNING", "JOBS_DEVICES",
+    "JOBS_THROUGHPUT", "JOBS_MFU", "JOBS_LATENCY_P50",
 ]

@@ -24,6 +24,11 @@ Endpoints (stdlib http.server, daemon thread):
                                -> ONE request's traced timeline:
                                   queue_wait -> prefill -> decode
                                   bursts -> finish (profiler/tracing)
+    GET  /v1/jobs[/<id>]       -> control-plane job statuses (when a
+                                  control.JobScheduler is live)
+    POST /v1/jobs              -> submit via a registered job factory
+    POST /v1/jobs/<id>/cancel  -> cancel (train: checkpoint + exit;
+         /v1/jobs/<id>/drain      serve: cancel in-flight + shutdown)
 
 Batching note: ``predict`` requests are served one-by-one; the
 TPU-side win comes from the jit-compiled forward reused across
@@ -37,9 +42,11 @@ engine's fixed-shape decode step, each joining a free slot mid-flight
 
 from __future__ import annotations
 
+import collections
 import json
 import threading
 import urllib.request
+import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable, Optional
 
@@ -71,6 +78,17 @@ class JsonModelServer:
         self._thread: Optional[threading.Thread] = None
         self.port: Optional[int] = None
         self._infer_lock = threading.Lock()
+        # idempotency: key -> the ORIGINAL submitted request handle.
+        # A replayed POST (client retried after a connection reset that
+        # ate the response) waits on that request instead of
+        # re-prefilling and double-generating. Bounded LRU.
+        self._idem: "collections.OrderedDict[str, Any]" = \
+            collections.OrderedDict()
+        self._idem_lock = threading.Lock()
+
+    #: idempotency keys remembered (each holds one finished request
+    #: handle — small; old keys fall off the back)
+    IDEMPOTENCY_CAPACITY = 1024
 
     @staticmethod
     def _default_input(payload: dict):
@@ -126,15 +144,41 @@ class JsonModelServer:
                              "(JsonModelServer(engine=...))")
         if "prompt_ids" not in payload:
             raise ValueError("payload must contain 'prompt_ids'")
-        req = self.engine.submit(
-            # 1-D (or [1, t0]) only — submit() rejects batched arrays
-            # rather than silently concatenating the sequences
-            np.asarray(payload["prompt_ids"], np.int32),
-            int(payload.get("max_new_tokens", 16)),
-            float(payload.get("temperature", 0.0)),
-            payload.get("eos_id"),
-            payload.get("sample_seed"),
-            session_id=payload.get("session_id"))
+
+        def _submit():
+            return self.engine.submit(
+                # 1-D (or [1, t0]) only — submit() rejects batched
+                # arrays rather than silently concatenating sequences
+                np.asarray(payload["prompt_ids"], np.int32),
+                int(payload.get("max_new_tokens", 16)),
+                float(payload.get("temperature", 0.0)),
+                payload.get("eos_id"),
+                payload.get("sample_seed"),
+                session_id=payload.get("session_id"))
+
+        # idempotent submit: a replayed POST (the client's connection
+        # reset after the server already admitted the request) returns
+        # the ORIGINAL request's stream instead of re-prefilling a
+        # non-idempotent generation. The get-or-submit is atomic under
+        # the lock, so two concurrent replays admit exactly once;
+        # capacity rejects are NOT remembered (the retry should re-try
+        # admission).
+        key = payload.get("idempotency_key")
+        replayed = False
+        if key is not None:
+            key = str(key)
+            with self._idem_lock:
+                req = self._idem.get(key)
+                if req is not None:
+                    replayed = True
+                    self._idem.move_to_end(key)
+                else:
+                    req = _submit()
+                    self._idem[key] = req
+                    while len(self._idem) > self.IDEMPOTENCY_CAPACITY:
+                        self._idem.popitem(last=False)
+        else:
+            req = _submit()
         tokens = req.result(timeout=float(payload.get("timeout", 300)))
         out = {
             # request_id joins client logs against the server-side
@@ -161,6 +205,8 @@ class JsonModelServer:
         routing = getattr(req, "routing", None)
         if routing:
             out["routing"] = dict(routing)
+        if replayed:
+            out["replayed"] = True
         return out
 
     def info(self) -> dict:
@@ -228,11 +274,26 @@ class _InferenceHandler(BaseHTTPRequestHandler):
                     {"error": f"no timeline for request {rid}{hint}"},
                     404)
             return self._json(tl)
+        if path == "/v1/jobs" or path.startswith("/v1/jobs/"):
+            from deeplearning4j_tpu import control
+
+            obj, code = control.http_jobs_get(path)
+            return self._json(obj, code)
         return self._json({"error": "not found"}, 404)
 
     def do_POST(self):
         ms: JsonModelServer = self.server.model_server  # type: ignore
         path = self.path.rstrip("/")
+        if path == "/v1/jobs" or path.startswith("/v1/jobs/"):
+            from deeplearning4j_tpu import control
+
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                payload = json.loads(self.rfile.read(n) or b"{}")
+            except Exception as e:
+                return self._json({"error": str(e)}, 400)
+            obj, code = control.http_jobs_post(path, payload)
+            return self._json(obj, code)
         if path not in ("/v1/serving/predict", "/v1/serving/generate"):
             return self._json({"error": "not found"}, 404)
         try:
@@ -265,11 +326,14 @@ class JsonRemoteInference:
     a briefly-restarting replica surfaces as a short wait, not a raw
     exception at the caller. ``retries=0`` restores fail-fast.
 
-    Connection-reset retries are AT-LEAST-ONCE: a reset after the
-    server finished generating re-runs the request (pass a
-    ``sample_seed`` for reproducible retried sampling, or
-    ``retries=0`` where duplicate server-side work is unacceptable);
-    the 429 path never admitted the request and is always safe."""
+    Connection-reset retries are EXACTLY-ONCE against one server
+    process: every ``generate``/``generate_full`` call mints a client-
+    side ``idempotency_key``, and a replayed POST returns the ORIGINAL
+    request's result instead of re-prefilling a non-idempotent
+    generation (the server remembers the newest 1024 keys; the
+    response carries ``replayed: true``). A replay against a
+    *restarted* server process is a fresh submit — pass a
+    ``sample_seed`` if sampled retries must also reproduce there."""
 
     def __init__(self, endpoint: str, timeout: float = 30.0,
                  retries: int = 4, max_backoff_s: float = 5.0):
@@ -306,6 +370,10 @@ class JsonRemoteInference:
             "max_new_tokens": int(max_new_tokens),
             "temperature": float(temperature),
             "eos_id": eos_id,
+            # one key per LOGICAL request, shared by every retry of it:
+            # a POST replayed after a connection reset joins the
+            # original submission instead of double-generating
+            "idempotency_key": uuid.uuid4().hex,
         }
         if session_id is not None:
             payload["session_id"] = session_id

@@ -43,6 +43,7 @@ from __future__ import annotations
 
 import collections
 import itertools
+import logging
 import queue as _queue
 import threading
 import time
@@ -60,6 +61,8 @@ from deeplearning4j_tpu.profiler import tracing as _tracing
 from deeplearning4j_tpu.serving import kv_pages
 from deeplearning4j_tpu.serving.prefix_cache import PrefixCache
 from deeplearning4j_tpu.serving.sessions import SessionStore
+
+log = logging.getLogger("deeplearning4j_tpu")
 
 
 # ------------------------------------------------------------ requests
@@ -128,6 +131,9 @@ class ServingRequest:
         #: per-request trace (profiler/tracing.py) — None with tracing
         #: off; the timeline is served at /v1/serving/requests/<id>
         self._trace = None
+        #: the engine serving this request (set at submit) — what makes
+        #: ``cancel()`` routable without the caller holding the engine
+        self._engine = None
         self._t_submit = time.perf_counter()
         self._stream: "_queue.Queue" = _queue.Queue()
         self._done = threading.Event()
@@ -175,6 +181,20 @@ class ServingRequest:
         if self._error is not None:
             raise self._error
         return np.asarray(self.tokens, np.int32)
+
+    def cancel(self) -> bool:
+        """Abort this request (client-callable, any thread): queued, it
+        never runs; decoding, its slot is freed and its KV pages drain
+        back to the pool at the scheduler's next pass. The request
+        finishes with ``finish_reason="cancelled"`` (trace closed with
+        the same reason) and ``result()`` returns the tokens generated
+        so far. False when the request already finished — a ``result``
+        / ``stream`` timeout no longer leaves the request holding its
+        slot and pages forever."""
+        eng = self._engine
+        if eng is None:
+            return False
+        return eng.abort(self)
 
     def stream(self):
         """Yield tokens as they are generated; raises the request's
@@ -476,6 +496,16 @@ class DecodeEngine:
         #: this at its next loop iteration, exercising the real
         #: engine-death path (evictions, flight incident, re-routing)
         self._poison: Optional[BaseException] = None
+        #: chaos hook (profiler/chaos.hang_replica): the scheduler
+        #: sleeps this long at its next pass — hung, not dead
+        self._hang_s: float = 0.0
+        #: requests to cancel at the scheduler's next pass (abort());
+        #: client threads add, the scheduler thread drains
+        self._aborts: set = set()
+        self._abort_lock = threading.Lock()
+        #: monotonic clock of the last scheduler progress (admit or
+        #: decode burst) — the control plane's stalled-replica signal
+        self.last_progress = time.monotonic()
         # stats
         self.n_requests = 0
         self.n_completed = 0
@@ -928,6 +958,7 @@ class DecodeEngine:
                              eos_id, np.asarray(jax.random.key_data(key)),
                              session_id=session_id)
         req.engine_id = self.engine_id
+        req._engine = self
         if sink is not None:
             # attach BEFORE the queue put: the scheduler may admit and
             # emit tokens the instant the request is visible, and the
@@ -1065,6 +1096,70 @@ class DecodeEngine:
             "recent_requests": list(reversed(self._recent.copy())),
         }
 
+    # ------------------------------------------------------------ abort
+    def queue_depth(self) -> int:
+        """Requests admitted but not yet in a slot (queued + head-of-
+        line waiting) — the engine-level rebalancing signal."""
+        return self._queue.qsize() + len(self._waiting)
+
+    def abort(self, request: ServingRequest) -> bool:
+        """Cancel ``request`` from any thread (see
+        ``ServingRequest.cancel``). The actual teardown — slot freed,
+        pages drained to rc0, trace closed ``finish{reason=cancelled}``
+        — happens on the scheduler thread at its next pass, so no lock
+        is ever taken against a decode dispatch. Returns False when the
+        request already finished (or belongs to a dead engine, whose
+        teardown already failed it)."""
+        if request.done:
+            return False
+        with self._abort_lock:
+            self._aborts.add(request)
+        if self._dead is not None:
+            # scheduler gone: _fail_pending already (or will have)
+            # finished everything — nothing will drain the abort set
+            with self._abort_lock:
+                self._aborts.discard(request)
+            return False
+        return True
+
+    def _process_aborts(self) -> None:
+        """Scheduler-thread half of ``abort``: evict cancelled slot
+        residents, drop cancelled queued/waiting requests."""
+        with self._abort_lock:
+            if not self._aborts:
+                return
+            aborts, self._aborts = self._aborts, set()
+        # queued requests must be visible in _waiting to be dropped
+        while True:
+            try:
+                self._waiting.append(self._queue.get_nowait())
+            except _queue.Empty:
+                break
+        for req in aborts:
+            if req.done:
+                continue
+            for s in range(self.slots):
+                if self._slot_req[s] is req:
+                    self._evict(s, "cancelled")
+                    break
+            else:
+                if req in self._waiting:
+                    self._waiting.remove(req)
+                    _flight.record("serving_cancel",
+                                   request_id=req.request_id,
+                                   engine=self.engine_id, queued=True)
+                    if _telemetry.enabled():
+                        _telemetry.MetricsRegistry.get_default() \
+                            .histogram(
+                                _telemetry.SERVING_REQUEST_LATENCY,
+                                "submit -> completion per request"
+                            ).observe(
+                                time.perf_counter() - req._t_submit,
+                                reason="cancelled",
+                                engine=self.engine_id)
+                    req._finish("cancelled")
+        self._gauge_queue_depth()
+
     # ---------------------------------------------- drain / chaos hooks
     @property
     def idle(self) -> bool:
@@ -1103,7 +1198,15 @@ class DecodeEngine:
             t.join(timeout)
         if self._dead is None:
             self._dead = RuntimeError("engine has been shut down")
-        # scheduler thread is gone: safe to fail whatever remains
+        # the scheduler thread is gone (joined above), so running its
+        # abort pass here is single-threaded-safe: a cancel that raced
+        # shutdown must finish as reason=cancelled with its partial
+        # tokens, not as the opaque shutdown error below
+        try:
+            self._process_aborts()
+        except Exception:
+            log.exception("abort pass during shutdown failed")
+        # safe to fail whatever remains
         self._fail_pending(self._dead)
         # drain contract: with every slot failed, releasing the
         # session pins and the cache's own references brings every
@@ -1125,6 +1228,13 @@ class DecodeEngine:
             while not self._stop.is_set():
                 if self._poison is not None:   # chaos/drill hook
                     raise self._poison
+                hang = self._hang_s
+                if hang:                       # chaos.hang_replica
+                    self._hang_s = 0.0
+                    _flight.record("chaos_hang", engine=self.engine_id,
+                                   seconds=hang)
+                    time.sleep(hang)
+                self._process_aborts()
                 self._admit_waiting()
                 if not self._active.any():
                     try:
@@ -1481,6 +1591,7 @@ class DecodeEngine:
             # the next shared-prefix request
             self._prefix.insert(req.prompt, rows, self.pool)
         self._emit(s, first)
+        self.last_progress = time.monotonic()
         if _telemetry.enabled():
             _telemetry.MetricsRegistry.get_default().counter(
                 _telemetry.SERVING_TOKENS,
@@ -1589,6 +1700,7 @@ class DecodeEngine:
                 if not self._active[s]:
                     break              # finished on eos mid-chunk
                 self._emit(int(s), int(toks[s, k]))
+        self.last_progress = time.monotonic()
         if _telemetry.enabled() and self.n_tokens > emitted0:
             _telemetry.MetricsRegistry.get_default().counter(
                 _telemetry.SERVING_TOKENS,

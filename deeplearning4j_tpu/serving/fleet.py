@@ -57,6 +57,7 @@ import itertools
 import queue as _queue
 import threading
 import time
+import weakref
 from typing import Any, Dict, List, Optional
 
 import jax
@@ -104,6 +105,7 @@ class FleetRequest:
         self._replica_index: Optional[int] = None
         self._lane_result = None     # cached (handoff, lane_span)
         self._no_lane = False        # lane failed once: go direct
+        self._cancelled = False      # cancel(): never reroute/re-run
         self._skip = 0               # replayed tokens to suppress
         self._seen = 0               # tokens seen from current attempt
         self._t_submit = time.perf_counter()
@@ -143,7 +145,15 @@ class FleetRequest:
         with self._lock:
             if inner is not self._inner or self._done.is_set():
                 return
+            cancelled = self._cancelled
         fleet = self._fleet
+        if cancelled:
+            # the client asked for this teardown: however the inner
+            # request actually ended (clean evict, replica death, or
+            # engine shutdown racing the abort pass), the contract is
+            # reason=cancelled + partial tokens, never a raised error
+            self._finalize("cancelled", None, inner)
+            return
         if error is not None and fleet is not None \
                 and fleet._maybe_reroute(self, inner, error):
             return                   # re-queued onto a survivor
@@ -190,6 +200,29 @@ class FleetRequest:
         if self._error is not None:
             raise self._error
         return np.asarray(self.tokens, np.int32)
+
+    def cancel(self) -> bool:
+        """Cancel this request wherever it is right now: queued at the
+        fleet router, waiting at the prefill lane, or decoding in a
+        replica slot (the engine frees the slot and drains its pages
+        to rc0). The stream ends, ``result()`` returns the tokens
+        received so far, ``finish_reason`` becomes ``"cancelled"`` and
+        the trace closes with the same reason. A ``result(timeout=)``
+        that timed out should call this — otherwise the request keeps
+        decoding (and holding KV pages) to completion. False when the
+        request already finished."""
+        with self._lock:
+            if self._done.is_set():
+                return False
+            self._cancelled = True
+            inner, eng = self._inner, self._engine
+        if inner is not None and eng is not None and not inner.done \
+                and eng.abort(inner):
+            return True      # engine-side teardown flows back via sink
+        # not routed (or raced completion/death): finalize here — the
+        # router and lane skip finished requests
+        self._finalize("cancelled", None, inner)
+        return True
 
     def stream(self):
         """Yield tokens as they decode — across failovers; raises the
@@ -309,6 +342,8 @@ class _PrefillLane:
                 self.fleet._requeue(freq, "lane_error")
 
     def _serve(self, freq: FleetRequest, replica: "_Replica") -> None:
+        if freq.done:
+            return                   # cancelled while lane-queued
         t0 = int(freq.prompt.size)
         bucket = next((b for b in self.buckets if b >= t0), None)
         if bucket is None:
@@ -420,6 +455,9 @@ class ServingFleet:
                 first.handoff_buckets, prefill_threshold,
                 device=first._device)
         self._queue: "_queue.Queue" = _queue.Queue(maxsize=max_queue)
+        #: live request handles (weak — finished requests fall out with
+        #: their client references): what cancel_pending() sweeps
+        self._live: "weakref.WeakSet" = weakref.WeakSet()
         self._affinity: Dict[str, int] = {}
         self._aff_lock = threading.Lock()
         #: serializes dead-replica cleanup (router _health_check) vs
@@ -431,6 +469,11 @@ class ServingFleet:
         self._stop = threading.Event()
         self._start_lock = threading.Lock()
         self._rr = itertools.count()       # score tie-break rotation
+        #: callable(replica_index, device, reason) invoked when a
+        #: replica's capacity leaves the fleet (drained or dead) — how
+        #: a JobScheduler gets its chip back for rebalancing. reason is
+        #: "drained" | "dead".
+        self.capacity_listener = None
         # fleet stats
         self.n_requests = 0
         self.n_completed = 0
@@ -529,8 +572,20 @@ class ServingFleet:
                 if isinstance(item, FleetRequest):
                     item._fail(RuntimeError("fleet has been shut "
                                             "down"))
+        self._live.add(freq)
         self.n_requests += 1
         return freq
+
+    def cancel_pending(self) -> int:
+        """Cancel every live request (queued, laned, or decoding) —
+        the scheduler's job-cancel path: the fleet stops cleanly
+        without failing anyone with an opaque shutdown error. Returns
+        the number cancelled."""
+        n = 0
+        for freq in list(self._live):
+            if not freq.done and freq.cancel():
+                n += 1
+        return n
 
     def generate(self, prompt_ids, max_new_tokens: int,
                  temperature: float = 0.0,
@@ -559,6 +614,21 @@ class ServingFleet:
 
     def alive_replicas(self) -> int:
         return sum(1 for r in self._replicas if r.alive)
+
+    def queue_pressure(self) -> float:
+        """Admission pressure normalized by live capacity: (fleet queue
+        + per-replica queue depth) / live decode slots. ~0 = idle,
+        >= 1 = a full slot-generation of work waiting. The train-vs-
+        serve rebalancing signal: a scheduler hands chips from a fleet
+        sitting near 0 to a starved train job, and back when pressure
+        climbs. inf when nothing is alive."""
+        alive = [r for r in self._replicas
+                 if r.alive and not r.draining]
+        if not alive:
+            return float("inf")
+        depth = self._queue.qsize() + sum(
+            r.engine.queue_depth() for r in alive)
+        return depth / max(1, sum(r.engine.slots for r in alive))
 
     def stats(self) -> Dict[str, Any]:
         e0 = self._replicas[0].engine
@@ -609,6 +679,7 @@ class ServingFleet:
         self._gauge_replicas()
         _flight.record("fleet_replica_drained",
                        engine=r.engine.engine_id, clean=ok)
+        self._notify_capacity(r, "drained")
         return ok
 
     def restart_replica(self, index: int) -> None:
@@ -705,6 +776,15 @@ class ServingFleet:
         _flight.record("fleet_replica_dead",
                        engine=r.engine.engine_id,
                        error=repr(err)[:200])
+        self._notify_capacity(r, "dead")
+
+    def _notify_capacity(self, r: _Replica, reason: str) -> None:
+        cb = self.capacity_listener
+        if cb is not None:
+            try:
+                cb(r.index, r.engine._device, reason)
+            except Exception:
+                pass   # a broken listener must not break routing
 
     def _drop_affinity(self, index: int) -> None:
         with self._aff_lock:
@@ -731,6 +811,8 @@ class ServingFleet:
         return bool(eng._active.all()) and depth >= eng.slots
 
     def _route(self, freq: FleetRequest) -> None:
+        if freq.done:
+            return                   # cancelled while queued
         t_r0 = time.perf_counter()
         cands = [r for r in self._replicas
                  if r.alive and not r.draining]
@@ -804,6 +886,8 @@ class ServingFleet:
                    handoff=None) -> None:
         """Hand a routed request to a replica engine (router or lane
         thread). Replica trouble re-queues instead of failing."""
+        if freq.done:
+            return                   # cancelled while in flight
         eng = target.engine
         freq.attempts += 1
         freq._replica_index = target.index

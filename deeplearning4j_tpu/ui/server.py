@@ -10,6 +10,12 @@ dashboard (inline canvas charts — the build environment has zero egress,
 so no CDN scripts). Endpoints:
 
     GET /train/sessions                     -> ["<sid>", ...]
+    GET /v1/jobs[/<id>]                     -> control-plane job
+                                               statuses (live
+                                               control.JobScheduler)
+    POST /v1/jobs[...]                      -> submit (registered
+                                               factory) / cancel /
+                                               drain / kill_worker
     GET /train/<sid>/overview               -> score curve, rates, memory
     GET /train/<sid>/model                  -> static info + latest layer stats
     GET /metrics                            -> Prometheus text exposition
@@ -301,11 +307,27 @@ class _Handler(BaseHTTPRequestHandler):
             self.end_headers()
             self.wfile.write(body)
             return
+        if parts[0] == "v1" and len(parts) >= 2 and parts[1] == "jobs":
+            from deeplearning4j_tpu import control
+
+            obj, code = control.http_jobs_get("/" + "/".join(parts))
+            return self._json(obj, code)
         if parts[0] != "train":
             return self._json({"error": "not found"}, 404)
         return self._train_routes(ui, parts)
 
     def do_POST(self):
+        path = self.path.rstrip("/")
+        if path == "/v1/jobs" or path.startswith("/v1/jobs/"):
+            from deeplearning4j_tpu import control
+
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                payload = json.loads(self.rfile.read(n) or b"{}")
+            except Exception as e:
+                return self._json({"error": str(e)}, 400)
+            obj, code = control.http_jobs_post(path, payload)
+            return self._json(obj, code)
         # multi-host span aggregation: worker hosts push their per-span
         # aggregates here (tracing.push_spans) so the coordinator's
         # /telemetry shows every host side by side — the straggler view

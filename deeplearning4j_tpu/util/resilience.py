@@ -296,11 +296,15 @@ class StepWatchdog:
 
     def __init__(self, deadline: float, context: str = "train_step",
                  step: Optional[int] = None,
-                 flight_dir: Optional[str] = None):
+                 flight_dir: Optional[str] = None,
+                 on_fire=None):
         self.deadline = float(deadline)
         self.context = context
         self.step = step
         self.flight_dir = flight_dir
+        #: optional callback(watchdog) invoked on expiry — the control
+        #: plane's stall verdict feed (no telemetry polling needed)
+        self.on_fire = on_fire
         self._timer: Optional[threading.Timer] = None
         self.fired = False
 
@@ -330,6 +334,11 @@ class StepWatchdog:
                         deadline_s=self.deadline),
             name="FT-incident-dump", daemon=True)
         t.start()
+        if self.on_fire is not None:
+            try:
+                self.on_fire(self)
+            except Exception:
+                log.exception("watchdog on_fire callback failed")
 
     def __enter__(self) -> "StepWatchdog":
         self._timer = threading.Timer(self.deadline, self._fire)
@@ -373,11 +382,33 @@ class FaultTolerance:
       ``DevicePrefetchIterator`` feeding the loop (no-op otherwise).
     - ``step_deadline``: per-step watchdog deadline in seconds
       (None = watchdog off).
+    - ``compile_grace_s``: extra watchdog allowance for the FIRST step
+      of each fit, which pays the jit compile (minutes on big models).
+      Default 0 keeps the historical behavior — a short deadline fires
+      on the compile step, which is harmless when the watchdog only
+      dumps diagnostics. The JobScheduler arms a generous grace
+      (``TrainJob(compile_grace_s=...)``) because there a stall verdict
+      triggers a MIGRATION: without the grace, every fresh attempt's
+      compile would read as a stall and the job would migrate forever.
     - ``flight_dir``: where flight-recorder incident dumps land
       (watchdog stall / divergence rollback / preemption — see
       profiler/flight_recorder.py). Defaults to
       ``<checkpoint_dir>/incidents`` when a checkpoint_dir is set,
       else the recorder's own default resolution.
+    - ``checkpoint_every``: steps between PERIODIC resumable bundles
+      (None = preemption-only, the pre-control-plane behavior).
+      Periodic bundles are what make a SIGKILL-equivalent death
+      (no grace period, no signal — the host just vanishes)
+      recoverable: the newest digest-valid bundle restores and the
+      run replays forward bit-identically from there. Requires a
+      stateful iterator (``get_state``/``set_state``); stateless
+      iterators skip periodic bundles with a one-time warning.
+    - ``context``: watchdog/telemetry label for this policy's fits
+      (the JobScheduler sets ``job:<id>`` so stall counters are
+      per-job attributable).
+    - ``on_stall``: optional callback(StepWatchdog) invoked from the
+      watchdog's timer thread on deadline expiry — the control plane's
+      stall-verdict feed.
 
     The object is reusable across fits — per-run state lives in a
     private ``_RunState`` created by ``run_fit``.
@@ -397,7 +428,11 @@ class FaultTolerance:
                  transfer_retries: int = 5,
                  transfer_backoff: float = 0.05,
                  step_deadline: Optional[float] = None,
-                 flight_dir: Optional[str] = None):
+                 compile_grace_s: float = 0.0,
+                 flight_dir: Optional[str] = None,
+                 checkpoint_every: Optional[int] = None,
+                 context: str = "train_step",
+                 on_stall=None):
         self.checkpoint_dir = checkpoint_dir
         self.auto_resume = auto_resume
         self.keep_last = max(int(keep_last), 1)
@@ -416,8 +451,18 @@ class FaultTolerance:
         self.transfer_retries = int(transfer_retries)
         self.transfer_backoff = float(transfer_backoff)
         self.step_deadline = step_deadline
+        self.compile_grace_s = float(compile_grace_s)
         self.flight_dir = flight_dir
+        self.checkpoint_every = (int(checkpoint_every)
+                                 if checkpoint_every else None)
+        self.context = str(context)
+        self.on_stall = on_stall
         self._preempt = threading.Event()
+        # single-slot holder, not a plain attribute: resolve_policy's
+        # shallow copy shares the LIST object (like _preempt), so an
+        # inject_fault on the original lands in the copy's running fit
+        self._fault_box: List[Optional[BaseException]] = [None]
+        self._warned_stateless = False
 
     def incident_dir(self) -> Optional[str]:
         """Where this policy's incident dumps go; None defers to the
@@ -438,6 +483,16 @@ class FaultTolerance:
         calls; also usable directly, e.g. from a cluster-notice
         poller thread)."""
         self._preempt.set()
+
+    def inject_fault(self, exc: BaseException) -> None:
+        """SIGKILL-equivalent fault injection: the fit loop raises
+        ``exc`` at its next step boundary WITHOUT writing a checkpoint
+        — unlike ``request_preemption``, nothing gets to clean up.
+        The JobScheduler's kill-a-worker drill delivers device-loss
+        this way (an in-process thread can't be hard-killed); recovery
+        is the newest periodic bundle, exactly as after a real host
+        death."""
+        self._fault_box[0] = exc
 
     @contextlib.contextmanager
     def _signal_scope(self):
@@ -485,8 +540,14 @@ class FaultTolerance:
     def _watchdog(self, step: Optional[int] = None):
         if self.step_deadline is None:
             return contextlib.nullcontext()
-        return StepWatchdog(self.step_deadline, step=step,
-                            flight_dir=self.incident_dir())
+        deadline = self.step_deadline
+        if step == 0 and self.compile_grace_s > 0:
+            # this run's first step pays the jit compile; a deadline
+            # tuned for warm steps would misfire every (re)start
+            deadline += self.compile_grace_s
+        return StepWatchdog(deadline, context=self.context,
+                            step=step, flight_dir=self.incident_dir(),
+                            on_fire=self.on_stall)
 
 
 def resolve_policy(fault_tolerance: Optional[FaultTolerance],
@@ -817,6 +878,50 @@ def _write_preemption_checkpoint(ft: FaultTolerance, adapter: _FitAdapter,
                 ", mid-epoch" if mid else "")
 
 
+def _write_periodic_checkpoint(ft: FaultTolerance, adapter: _FitAdapter,
+                               it, epoch_idx: int, total_epochs: int
+                               ) -> None:
+    """Periodic resumable bundle (``checkpoint_every``): same atomic
+    bundle as a preemption checkpoint, written in-stride — the fit
+    keeps running. This is the recovery floor for deaths that never
+    get a grace period (host loss, OOM-killer, chaos
+    ``WorkerKilledError``): at most ``checkpoint_every`` steps are
+    ever lost, and the replay from the bundle is bit-identical
+    (RNG + iterator position + updater state all ride along)."""
+    if not ft.checkpoint_dir:
+        return
+    ist = _try_get_state(it)
+    if ist is None:
+        if not ft._warned_stateless:
+            ft._warned_stateless = True
+            log.warning(
+                "resilience: checkpoint_every=%d requested but %s has "
+                "no get_state/set_state — periodic checkpoints are "
+                "SKIPPED (preemption checkpoints still work; implement "
+                "iterator state for kill-safe periodic bundles)",
+                ft.checkpoint_every, type(it).__name__)
+        return
+    adapter.finish()   # sync the sharded trainer's canonical trees
+    meta = {
+        "rng": _rng_key_data(adapter.model),
+        "iterator_state": ist,
+        "epochs_remaining": max(total_epochs - epoch_idx, 0),
+        "mid_epoch": True,
+        "periodic": True,
+        "wall_time": time.time(),
+    }
+    path = write_bundle(ft.checkpoint_dir, adapter.model, meta,
+                        keep_last=ft.keep_last, trainer=adapter.trainer)
+    if _telemetry.enabled():
+        _telemetry.MetricsRegistry.get_default().counter(
+            _telemetry.FT_PERIODIC_CHECKPOINTS,
+            "periodic resumable bundles written every "
+            "checkpoint_every steps").inc()
+    _flight.record("periodic_checkpoint",
+                   iteration=adapter.model.getIterationCount(),
+                   bundle=path)
+
+
 def _restore_bundle(adapter: _FitAdapter, path: str) -> Dict[str, Any]:
     import jax
     import jax.numpy as jnp
@@ -1141,9 +1246,20 @@ def _run_epoch(ft: FaultTolerance, adapter: _FitAdapter, it,
             _maybe_snapshot(ft, adapter, st)
             adapter.step(batch)
             st.steps_done += 1
+            if monkey is not None:
+                # inside the watchdog scope on purpose: the injected
+                # hang must trip the deadline like a real wedged step
+                monkey.maybe_hang(st.steps_done)
             _check_divergence(ft, adapter, st)
         if monkey is not None:
+            monkey.maybe_kill(st.steps_done)   # raises: no checkpoint
             monkey.maybe_preempt(st.steps_done)
+        fault = ft._fault_box[0]
+        if fault is not None:
+            # SIGKILL-equivalent (inject_fault): die with NO
+            # checkpoint — recovery is the newest periodic bundle
+            ft._fault_box[0] = None
+            raise fault
         if ft.preemption_requested:
             _write_preemption_checkpoint(ft, adapter, it, epoch_idx,
                                          total_epochs, was_iterator)
@@ -1151,6 +1267,10 @@ def _run_epoch(ft: FaultTolerance, adapter: _FitAdapter, it,
             # must not re-preempt off a flag already acted on
             ft._preempt.clear()
             return True
+        if ft.checkpoint_every \
+                and st.steps_done % ft.checkpoint_every == 0:
+            _write_periodic_checkpoint(ft, adapter, it, epoch_idx,
+                                       total_epochs)
 
 
 __all__ = ["FaultTolerance", "DivergenceError", "StepWatchdog",

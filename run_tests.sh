@@ -1259,4 +1259,321 @@ if [ $tracesmoke -ne 0 ] || [ $drill -ne 0 ]; then
     echo "FATAL: tracing/incident smoke gate regressed (T=$tracesmoke D=$drill)" >&2
     exit 1
 fi
+
+# Control-plane chaos gate (docs/CONTROL_PLANE.md): one JobScheduler
+# runs a 2x2-chip zero train job next to a 2-replica serving job on an
+# 8-device CPU fleet; a whole worker is SIGKILL-equivalently killed
+# mid-fit (no checkpoint at death). Asserts: the train job recovers
+# its newest periodic bundle, MIGRATES onto the reduced topology
+# (4-way -> 2-way, with re-sharded Adam moments BIT-EQUAL to the
+# bundle), and finishes at the exact total step count with loss within
+# tolerance of an uninterrupted 2-way run; concurrently a serving
+# replica's worker dies and every request still completes (replays
+# allowed, failures not; greedy outputs token-identical to solo
+# generate()); the death is a digest-valid incident dump; and no
+# scheduler/serving thread survives shutdown.
+CTL_DIR=$(mktemp -d /tmp/dl4j_ctl_gate.XXXXXX)
+export DL4J_TPU_CTL_GATE_DIR="$CTL_DIR"
+env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu DL4J_TPU_TELEMETRY=1 \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python - <<'EOF'
+import json
+import os
+import shutil
+import sys
+import threading
+import time
+
+import jax
+import numpy as np
+
+from deeplearning4j_tpu import control
+from deeplearning4j_tpu.datasets import ArrayDataSetIterator
+from deeplearning4j_tpu.learning import Adam
+from deeplearning4j_tpu.models.gpt import CausalLM
+from deeplearning4j_tpu.models.transformer import tiny_config
+from deeplearning4j_tpu.nn.conf import (
+    DenseLayer, InputType, NeuralNetConfiguration, OutputLayer,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.parallel.mesh import build_mesh
+from deeplearning4j_tpu.parallel.sharded import ShardedTrainer
+from deeplearning4j_tpu.profiler import flight_recorder, telemetry
+from deeplearning4j_tpu.serving import ServingFleet
+from deeplearning4j_tpu.util import FaultTolerance
+from deeplearning4j_tpu.util.model_serializer import ModelSerializer
+from deeplearning4j_tpu.util.resilience import latest_valid_bundle
+
+GATE = os.environ["DL4J_TPU_CTL_GATE_DIR"]
+CKPT = os.path.join(GATE, "ckpt")
+FLIGHT = os.path.join(GATE, "incidents")
+devs = jax.devices()
+fail = []
+
+rng = np.random.default_rng(0)
+x = rng.normal(size=(64, 6)).astype(np.float32)
+y = np.eye(2, dtype=np.float32)[(x.sum(1) > 0).astype(int)]
+
+
+def make():
+    return MultiLayerNetwork(
+        (NeuralNetConfiguration.builder().seed(11)
+         .updater(Adam(learning_rate=0.01)).list()
+         .layer(DenseLayer(n_out=16, activation="tanh"))
+         .layer(OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+         .setInputType(InputType.feedForward(6)).build()))
+
+
+def make_iter():
+    return ArrayDataSetIterator(x, y, 8, shuffle=True, seed=5)
+
+
+class SlowIter(ArrayDataSetIterator):
+    def next(self):
+        time.sleep(0.1)
+        return super().next()
+
+
+VOCAB = 17
+cfg = tiny_config(vocab=VOCAB, max_len=64, d_model=32, n_layers=2,
+                  n_heads=4, d_ff=64)
+cfg.dropout = 0.0
+gpt = CausalLM(cfg, compute_dtype=jax.numpy.float32)
+gparams = gpt.init_params(jax.random.key(1))
+
+
+def solo(prompt, new):
+    return np.asarray(gpt.generate(
+        gparams, jax.numpy.asarray(np.asarray(prompt)[None, :],
+                                   jax.numpy.int32), new))[0]
+
+
+sched = control.JobScheduler(
+    devices=devs[:6],
+    workers={"w0": devs[:2], "w1": devs[2:4],
+             "w2": [devs[4]], "w3": [devs[5]]},
+    rebalance=False, flight_dir=FLIGHT)
+
+# ---- serving job: 2 replicas on w2+w3 ------------------------------
+def build_fleet(ctx):
+    return ServingFleet(gpt, gparams, devices=ctx.devices, slots=2,
+                        page_size=8, prefill_buckets=[8, 16, 40],
+                        max_chunk=4)
+
+
+serve = sched.submit(control.ServeJob(build_fleet, replicas=2,
+                                      tenant="serve-tenant"))
+
+# ---- train job: 4-chip zero, killed down to 2 chips ----------------
+attempt_devices = []
+nets = []
+
+
+def run_train(ctx):
+    attempt_devices.append(list(ctx.devices))
+    net = make()
+    net.init()
+    nets.append(net)
+    tr = ShardedTrainer(net, mesh=ctx.mesh(), mode="sharing",
+                        update_sharding="zero")
+    it = SlowIter(x, y, 8, shuffle=True, seed=5) \
+        if ctx.attempt == 1 else make_iter()
+    tr.fit(it, epochs=3, fault_tolerance=ctx.fault_tolerance)
+    return float(net.score())
+
+
+sched.wait(serve.job_id, timeout=600, states=("running",))
+deadline = time.time() + 600
+while serve.fleet is None and time.time() < deadline:
+    time.sleep(0.05)
+if serve.fleet is None:
+    sys.stderr.write("control gate FAILED: fleet never came up\n")
+    sys.exit(1)
+
+# submit the train job only once the fleet serves: the drill needs
+# traffic IN FLIGHT when the workers die, and on CPU the fleet's
+# device-bound AOT warmup dwarfs the tiny zero fit
+train = sched.submit(control.TrainJob(
+    run_train, chips=4, tenant="train-tenant",
+    checkpoint_dir=CKPT, backoff_s=2.0, max_retries=3,
+    fault_tolerance=FaultTolerance(checkpoint_dir=CKPT,
+                                   checkpoint_every=3,
+                                   divergence_window=0)))
+
+# ---- traffic: keeps flowing across the worker kill -----------------
+requests = []
+traffic_stop = threading.Event()
+trng = np.random.default_rng(5)
+
+
+SPECS = [(6, 4), (9, 12), (24, 6)]   # few shapes: solo() verification
+#                                      pays one compile per shape
+
+
+def traffic():
+    i = 0
+    while not traffic_stop.is_set():
+        if len(requests) >= 250:     # bounded verification cost
+            time.sleep(0.05)
+            continue
+        t0, n = SPECS[i % len(SPECS)]
+        i += 1
+        p = trng.integers(0, VOCAB, (t0,)).astype(np.int32)
+        try:
+            requests.append((p, n, serve.submit(p, n)))
+        except Exception as e:      # capacity 429 would be a failure
+            requests.append((p, n, e))
+        time.sleep(0.2)
+
+
+tt = threading.Thread(target=traffic, daemon=True)
+tt.start()
+
+# ---- the drill: kill the train worker + one serving worker ---------
+deadline = time.time() + 600
+while (not nets or nets[0].getIterationCount() < 5) \
+        and time.time() < deadline:
+    if train.state in control.TERMINAL:
+        sys.stderr.write(f"control gate FAILED: train job died early: "
+                         f"{train.status()}\n")
+        sys.exit(1)
+    time.sleep(0.02)
+train_worker = "w0" if train.devices[0] in devs[:2] else "w1"
+sched.kill_worker(train_worker)
+sched.kill_worker("w3")            # one serving replica's chip dies
+# snapshot the recovery bundle before the resumed attempt retires it
+# (backoff_s=2.0 holds the relaunch long enough)
+bundle = latest_valid_bundle(CKPT)
+if bundle is None:
+    fail.append("no digest-valid periodic bundle at the death")
+else:
+    shutil.copytree(bundle, os.path.join(GATE, "bundle_copy"))
+    bundle = os.path.join(GATE, "bundle_copy")
+
+time.sleep(1.0)                    # let some post-kill traffic route
+traffic_stop.set()
+tt.join(10)
+
+sched.wait(train.job_id, timeout=600)
+
+# ---- train-side assertions -----------------------------------------
+if train.state != "completed":
+    fail.append(f"train job ended {train.state}: {train.error}")
+if train.attempts != 2 or train.retries_used != 1:
+    fail.append(f"expected exactly one worker-lost retry, got "
+                f"attempts={train.attempts} retries={train.retries_used}")
+if len(attempt_devices) == 2:
+    survivors = devs[2:4] if train_worker == "w0" else devs[:2]
+    if len(attempt_devices[1]) != 2 \
+            or set(attempt_devices[1]) != set(survivors):
+        fail.append(f"resumed attempt not on the 2 surviving chips: "
+                    f"{attempt_devices[1]}")
+# exact total step count across both incarnations: 3 epochs x 8 batches
+if nets and nets[-1].getIterationCount() != 24:
+    fail.append(f"final iteration {nets[-1].getIterationCount()} != 24")
+if telemetry.MetricsRegistry.get_default().counter(
+        telemetry.FT_PERIODIC_CHECKPOINTS).total() < 1:
+    fail.append("no periodic checkpoint was written")
+if telemetry.MetricsRegistry.get_default().counter(
+        telemetry.JOBS_MIGRATIONS).total() < 1:
+    fail.append("migration counter not bumped")
+
+# loss within tolerance of an uninterrupted 2-way run (same seed/data)
+ref = make()
+ref.init()
+ShardedTrainer(ref, mesh=build_mesh(num_data=2,
+                                    devices=attempt_devices[1]
+                                    if len(attempt_devices) == 2
+                                    else devs[:2]),
+               mode="sharing", update_sharding="zero").fit(
+    make_iter(), epochs=3)
+if nets and not np.isclose(float(ref.score()), float(nets[-1].score()),
+                           rtol=1e-3):
+    fail.append(f"migrated loss {float(nets[-1].score()):.6f} deviates "
+                f"from clean 2-way run {float(ref.score()):.6f}")
+
+# bit-equal Adam moments through the 4->2 re-shard of the bundle
+if bundle is not None:
+    ref_net = make(); ref_net.init()
+    ModelSerializer.loadInto(ref_net, os.path.join(bundle, "model.zip"))
+    saved = [np.asarray(l) for l in jax.tree_util.tree_leaves(
+        (ref_net.params_list, ref_net.opt_states))]
+    net2 = make(); net2.init()
+    ModelSerializer.loadInto(net2, os.path.join(bundle, "model.zip"))
+    tr2 = ShardedTrainer(net2, mesh=build_mesh(num_data=2,
+                                               devices=devs[:2]),
+                         mode="sharing", update_sharding="zero")
+    tr2._place_update_sharded()
+    tr2._finish()
+    got = [np.asarray(l) for l in jax.tree_util.tree_leaves(
+        (net2.params_list, net2.opt_states))]
+    for a, b in zip(saved, got):
+        if not np.array_equal(a, b):
+            fail.append("Adam moments NOT bit-equal after the 4->2 "
+                        "re-shard")
+            break
+    man = json.load(open(os.path.join(bundle, "manifest.json")))
+    if man.get("mesh", {}).get("data") != 4:
+        fail.append(f"bundle not from the 4-way mesh: {man.get('mesh')}")
+
+# ---- serving-side assertions ---------------------------------------
+n_done = n_replayed = 0
+for p, n, r in requests:
+    if isinstance(r, Exception):
+        fail.append(f"submit failed: {r}")
+        continue
+    try:
+        out = r.result(timeout=120)
+    except Exception as e:
+        fail.append(f"request failed ({type(e).__name__}: {e})")
+        continue
+    n_done += 1
+    n_replayed += int(r.attempts > 1)
+    if not np.array_equal(out, solo(p, n)):
+        fail.append("request output not token-identical to solo")
+if n_done < 8:
+    fail.append(f"too little traffic completed ({n_done})")
+if serve.fleet is None or serve.fleet.alive_replicas() != 1:
+    fail.append("serving fleet did not end on exactly the survivor")
+if len(serve.devices) != 1 or serve.devices[0] != devs[4]:
+    fail.append(f"serve job kept the dead chip: {serve.devices}")
+
+# ---- incident dump for the death -----------------------------------
+dumps = flight_recorder.list_dumps(FLIGHT)
+worker_dumps = [d for d in dumps if "job_worker_lost" in d]
+if not worker_dumps:
+    fail.append(f"no job_worker_lost incident dump in {FLIGHT}")
+else:
+    loaded = flight_recorder.load_dump(worker_dumps[-1])
+    if not loaded["valid"]:
+        fail.append("worker-lost incident dump failed digest check")
+    if loaded["events"] and loaded["events"][-1]["kind"] \
+            != "job_worker_lost":
+        fail.append("incident dump does not END on the worker death")
+
+sched.shutdown()
+time.sleep(1.0)
+leaked = [t.name for t in threading.enumerate()
+          if t.is_alive() and t.name.startswith(
+              ("JobScheduler", "JobRunner", "ServingEngine",
+               "ServingFleetRouter", "ServingPrefillLane"))]
+if leaked:
+    fail.append(f"threads survived shutdown: {leaked}")
+
+if fail:
+    sys.stderr.write("control-plane gate FAILED:\n  "
+                     + "\n  ".join(fail) + "\n")
+    sys.exit(1)
+print(f"control-plane gate OK: worker {train_worker} killed mid-fit -> "
+      f"train migrated 4->2 chips (attempt 2 on survivors), finished "
+      f"at iteration 24 with bit-equal re-sharded moments; "
+      f"{n_done} serving requests completed ({n_replayed} replayed, "
+      f"0 failed); incident dump digest-valid")
+EOF
+ctlgate=$?
+rm -rf "$CTL_DIR"
+if [ $ctlgate -ne 0 ]; then
+    echo "FATAL: control-plane chaos gate regressed" >&2
+    exit 1
+fi
 exit $rc

@@ -79,7 +79,9 @@ def pytest_sessionfinish(session, exitstatus):
                                        "AsyncDataSet-ETL",
                                        "ServingEngine",
                                        "ServingFleetRouter",
-                                       "ServingPrefillLane")))
+                                       "ServingPrefillLane",
+                                       "JobScheduler",
+                                       "JobRunner")))
         ]
 
     deadline = time.time() + 2.0

@@ -247,6 +247,63 @@ class TestFailover:
             np.testing.assert_array_equal(
                 fl.generate(p2, 4), _solo(model, params, p2, 4))
 
+    @pytest.mark.slow
+    def test_drain_with_inflight_session_pinned_requests(
+            self, model, params):
+        """Elastic-resize coverage the kill path doesn't give:
+        draining a replica that holds session-PINNED pages while a
+        session request is still decoding there. The in-flight request
+        must finish (drain waits), the drained pool must reach 0
+        allocated pages (pins released with the shutdown), and the
+        session's next turn must cold-restart cleanly on the survivor
+        with token-identical output."""
+        rng = np.random.default_rng(11)
+        with _fleet(model, params, replicas=2, prefix_cache=True,
+                    session_capacity=4) as fl:
+            t1 = rng.integers(0, VOCAB, (9,)).astype(np.int32)
+            r1 = fl.submit(t1, 4, session_id="pin")
+            o1 = r1.result(60)
+            target = r1.routing["replica"]
+            idx = next(i for i, r in enumerate(fl._replicas)
+                       if r.engine.engine_id == target)
+            eng = fl._replicas[idx].engine
+            assert eng._sessions.stats()["sessions"] == 1
+            # turn 2 of the same session decodes ON the pinned replica
+            # (affinity) while the drain starts — it re-pins mid-drain
+            t2 = np.concatenate(
+                [t1, o1, rng.integers(0, VOCAB, (2,)).astype(np.int32)])
+            r2 = fl.submit(t2, 24, session_id="pin")
+            deadline = time.time() + 30
+            while len(r2.tokens) < 2 and time.time() < deadline:
+                time.sleep(0.005)
+            assert r2.routing["replica"] == target
+            assert fl.drain_replica(idx, timeout=120)
+            # the in-flight session request FINISHED during the drain
+            o2 = r2.result(10)
+            np.testing.assert_array_equal(
+                o2, _solo(model, params, t2, 24))
+            # pins released, pool fully drained on the dead replica
+            assert eng.pool.allocated == 0
+            assert eng.pool.shared_pages() == 0
+            # next turn cold-restarts on the survivor, token-identical
+            t3 = np.concatenate(
+                [t2, o2, rng.integers(0, VOCAB, (2,)).astype(np.int32)])
+            r3 = fl.submit(t3, 4, session_id="pin")
+            o3 = r3.result(60)
+            assert r3.routing["replica"] != target
+            assert r3.cache_hit_tokens == 0        # cold re-admit
+            np.testing.assert_array_equal(
+                o3, _solo(model, params, t3, 4))
+            # ...and RE-pins on the survivor: turn 4 is warm again
+            t4 = np.concatenate(
+                [t3, o3, rng.integers(0, VOCAB, (2,)).astype(np.int32)])
+            r4 = fl.submit(t4, 4, session_id="pin")
+            o4 = r4.result(60)
+            assert r4.routing["replica"] == r3.routing["replica"]
+            assert r4.cache_hit_tokens > 0
+            np.testing.assert_array_equal(
+                o4, _solo(model, params, t4, 4))
+
 
 # ------------------------------------------------------ capacity 429s
 class TestCapacity:
