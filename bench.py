@@ -36,7 +36,17 @@ from __future__ import annotations
 
 import json
 import os
+import sys
 import time
+
+if __name__ == "__main__" and "--compare" in sys.argv:
+    # round-over-round regression diff (bench_compare.py) — dispatched
+    # BEFORE the jax import so the --current JSON-diff path is truly
+    # stdlib-only, no device and no jax startup (without --current it
+    # still runs the full bench in a subprocess and compares)
+    from bench_compare import main as _compare_main
+
+    raise SystemExit(_compare_main(sys.argv[1:]))
 
 import jax
 import jax.numpy as jnp

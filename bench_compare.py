@@ -1,0 +1,201 @@
+"""Round-over-round bench regression diff (stdlib-only; no jax).
+
+``python bench.py --compare BENCH_r05.json`` (or ``python
+bench_compare.py --compare BENCH_r05.json --current BENCH_r06.json``)
+diffs two rounds' aggregate lines and exits non-zero when any metric
+regressed past the tolerance — the gate future perf PRs run before
+claiming a win (BASELINE.md "Comparing rounds").
+
+Inputs are either round files (``{"parsed": {...}, "tail": ...}`` as
+the driver records them) or a bare aggregate-line JSON object; with
+``--compare`` but no ``--current``, bench.py runs the full benchmark
+first and compares its fresh line.
+
+Key classification:
+
+- throughput/MFU/speedup metrics are HIGHER-better (the default for a
+  numeric key);
+- ``*_ms`` latency keys are LOWER-better;
+- config echoes, band edges, source tags, error strings and the
+  self-baseline ratio are skipped (``_SKIP_SUFFIXES`` /
+  ``_SKIP_KEYS`` — they describe the round, they aren't performance);
+- boolean keys (token-identity/parity gates) must not flip True ->
+  False, tolerance notwithstanding.
+
+A key present in only one round is reported but never fails the gate
+(rounds legitimately grow metrics).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+#: round-description keys, not performance — never compared numerically
+_SKIP_SUFFIXES = ("_band_lo", "_src", "_error", "_batch", "_hidden",
+                  "_band_status", "_note")
+_SKIP_KEYS = {"metric", "unit", "vs_baseline",
+              # tenancy gauge: tracks CHIP load, not code speed
+              "lstm_frozen_window_ms"}
+#: lower-is-better keys carry an "ms" path segment (step time, TTFT,
+#: p99 gaps): `*_ms`, `*_ms_per_step`, ...
+def _is_latency_key(key: str) -> bool:
+    return "ms" in key.split("_")
+
+
+def load_round(path: str) -> Dict[str, Any]:
+    """The aggregate line from a round file ({"parsed": ...}), a bare
+    line object, or a file whose last JSON-looking line parses (raw
+    bench stdout)."""
+    with open(path) as f:
+        text = f.read()
+    try:
+        obj = json.loads(text)
+    except ValueError:
+        obj = None
+    if isinstance(obj, dict):
+        if isinstance(obj.get("parsed"), dict):
+            return obj["parsed"]
+        if "metric" in obj or any(
+                isinstance(v, (int, float)) for v in obj.values()):
+            return obj
+    # raw stdout: last line that parses as a JSON object wins
+    for line in reversed(text.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                parsed = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(parsed, dict):
+                return parsed
+    raise ValueError(f"no aggregate line found in {path}")
+
+
+def _classify(key: str, value: Any) -> Optional[str]:
+    """'higher' | 'lower' | 'bool' | None (skip)."""
+    if key in _SKIP_KEYS or key.endswith(_SKIP_SUFFIXES):
+        return None
+    if isinstance(value, bool):
+        return "bool"
+    if not isinstance(value, (int, float)):
+        return None
+    if _is_latency_key(key):
+        return "lower"
+    return "higher"
+
+
+def compare_rounds(prior: Dict[str, Any], current: Dict[str, Any],
+                   tolerance: float = 0.1) \
+        -> Tuple[List[str], List[str]]:
+    """(report_lines, regression_lines). A regression is a higher-
+    better metric dropping below ``prior * (1 - tolerance)``, a
+    lower-better metric rising above ``prior * (1 + tolerance)``, or
+    a boolean gate flipping True -> False."""
+    report: List[str] = []
+    regressions: List[str] = []
+    for key in sorted(set(prior) | set(current)):
+        p, c = prior.get(key), current.get(key)
+        direction = _classify(key, p if p is not None else c)
+        if direction is None:
+            continue
+        if p is None or c is None:
+            report.append(f"  {key}: only in "
+                          f"{'current' if p is None else 'prior'} "
+                          f"round ({c if p is None else p})")
+            continue
+        if direction == "bool":
+            if bool(p) and not bool(c):
+                line = f"{key}: True -> False (correctness gate)"
+                report.append("  REGRESSED " + line)
+                regressions.append(line)
+            else:
+                report.append(f"  {key}: {p} -> {c}")
+            continue
+        if not isinstance(c, (int, float)) or isinstance(c, bool):
+            report.append(f"  {key}: {p} -> non-numeric {c!r}")
+            continue
+        if p == 0 and c == 0:
+            delta = 0.0
+        elif p == 0:
+            # a zero prior (degenerate/failed measurement) makes a
+            # relative delta meaningless — treat any move off zero as
+            # infinite so a worsening direction can't slip under the
+            # tolerance as "+0.0%"
+            delta = math.inf if c > 0 else -math.inf
+        else:
+            delta = (c - p) / p
+        arrow = f"{key}: {p:g} -> {c:g} ({delta:+.1%})"
+        bad = (delta < -tolerance if direction == "higher"
+               else delta > tolerance)
+        if bad:
+            report.append(f"  REGRESSED {arrow} "
+                          f"[{direction}-better, tol {tolerance:.0%}]")
+            regressions.append(arrow)
+        else:
+            report.append(f"  {arrow}")
+    return report, regressions
+
+
+def run_current_bench() -> Dict[str, Any]:
+    """Run bench.py in a subprocess and parse its aggregate line (the
+    no---current path: 'the current round' is measured now)."""
+    import os
+    import subprocess
+
+    bench = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "bench.py")
+    proc = subprocess.run([sys.executable, bench],
+                          capture_output=True, text=True)
+    for line in reversed(proc.stdout.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except ValueError:
+                continue
+    raise RuntimeError(
+        f"bench.py produced no aggregate line (rc={proc.returncode}):"
+        f"\n{proc.stderr[-2000:]}")
+
+
+def main(argv: List[str]) -> int:
+    def _opt(flag: str) -> Optional[str]:
+        if flag in argv:
+            i = argv.index(flag)
+            if i + 1 >= len(argv):
+                print(f"{flag} needs a value", file=sys.stderr)
+                raise SystemExit(2)
+            return argv[i + 1]
+        return None
+
+    prior_path = _opt("--compare")
+    if prior_path is None:
+        print("usage: bench.py --compare PRIOR.json "
+              "[--current CURRENT.json] [--tolerance 0.1]",
+              file=sys.stderr)
+        return 2
+    tolerance = float(_opt("--tolerance") or 0.1)
+    prior = load_round(prior_path)
+    current_path = _opt("--current")
+    current = (load_round(current_path) if current_path
+               else run_current_bench())
+    report, regressions = compare_rounds(prior, current, tolerance)
+    print(f"bench compare vs {prior_path} "
+          f"(tolerance {tolerance:.0%}):")
+    for line in report:
+        print(line)
+    if regressions:
+        print(f"\nBENCH REGRESSION: {len(regressions)} metric(s) "
+              f"past tolerance:", file=sys.stderr)
+        for r in regressions:
+            print(f"  {r}", file=sys.stderr)
+        return 1
+    print("\nno regressions past tolerance")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

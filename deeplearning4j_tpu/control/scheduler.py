@@ -152,6 +152,21 @@ class DeviceFleet:
                 self._used[d] = job_id
             return devs
 
+    def acquire_device(self, device, job_id: str) -> bool:
+        """Claim ONE SPECIFIC healthy device (the SLO-driven serve
+        scale-up wants the exact chip a drained replica was built on —
+        its engine config, warm-pool donor and page pools are bound to
+        it). True when the device is now (or already was) assigned to
+        ``job_id``."""
+        with self._lock:
+            if device in self._lost:
+                return False
+            if device in self._free:
+                self._free.remove(device)
+                self._used[device] = job_id
+                return True
+            return self._used.get(device) == job_id
+
     def release(self, devices: Sequence[Any]) -> None:
         """Return devices to the pool. Idempotent per device (a device
         already returned — or lost — is skipped): the fleet capacity
@@ -369,6 +384,8 @@ class ServeJob(Job):
         super().__init__(**kw)
         self.build_fn = build_fn
         self.fleet = None
+        #: SLO scale-up in flight (one restart at a time per job)
+        self._scaling = False
 
     def submit(self, *a, **kw):
         if self.fleet is None:
@@ -398,6 +415,13 @@ class JobScheduler:
         serving replica is considered for draining.
     rebalance_pressure : a fleet must be under this queue pressure to
         give up a replica.
+    slo : an ``profiler.slo.SLOEngine`` to subscribe to (or call
+        ``attach_slo`` later). With one attached, serve capacity flows
+        BOTH ways with hysteresis instead of one-shot polls: a firing
+        ``action="scale_serve"`` alert (sustained queue pressure)
+        restarts a drained/dead replica for the matching ServeJob, and
+        ``_maybe_rebalance`` refuses to drain a replica from a fleet
+        whose pressure alert is pending or firing.
     poll_s : supervision loop cadence.
     """
 
@@ -405,6 +429,7 @@ class JobScheduler:
                  rebalance: bool = True,
                  rebalance_after_s: float = 5.0,
                  rebalance_pressure: float = 0.05,
+                 slo=None,
                  poll_s: float = 0.05,
                  flight_dir: Optional[str] = None,
                  make_default: bool = True):
@@ -414,6 +439,7 @@ class JobScheduler:
         self.rebalance_pressure = float(rebalance_pressure)
         self.poll_s = float(poll_s)
         self.flight_dir = flight_dir
+        self._slo = None
         self._jobs: "collections.OrderedDict[str, Job]" = \
             collections.OrderedDict()
         self._queue: collections.deque = collections.deque()
@@ -423,8 +449,20 @@ class JobScheduler:
         self._wake = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._last_gauges = 0.0
+        self._last_slo_reconcile = 0.0
         if make_default:
             set_default(self)
+        if slo is not None:
+            self.attach_slo(slo)
+
+    def attach_slo(self, engine) -> None:
+        """Subscribe to an SLOEngine's alert transitions: sustained
+        queue-pressure alerts (``action="scale_serve"``) drive serve-
+        replica scale-up, and their pending/firing state vetoes
+        rebalance drains (hysteresis — see _maybe_rebalance)."""
+        self._slo = engine
+        engine.on_alert(self._on_slo_alert,
+                        states=("firing", "resolved"))
 
     # ------------------------------------------------------- lifecycle
     def start(self) -> "JobScheduler":
@@ -659,6 +697,7 @@ class JobScheduler:
                 self._schedule_pending()
                 self._poll_jobs()
                 self._publish_gauges()
+                self._reconcile_slo()
                 self._wake.wait(self.poll_s)
         except Exception:
             log.exception("control: scheduler loop died")
@@ -742,6 +781,19 @@ class JobScheduler:
                      if r.alive and not r.draining]
             if len(alive) <= job.min_chips:
                 continue
+            if self._slo is not None:
+                # hysteresis via the SLO engine on TOP of the one-shot
+                # pressure poll: a fleet whose sustained-queue-pressure
+                # alert is pending or firing (or recently flapping into
+                # pending) keeps its replicas — a single idle poll
+                # between two bursts no longer gives a replica away.
+                # The direct poll below still applies: an engine with
+                # no queue-pressure data (telemetry off, rule absent)
+                # must not silently drop the pre-SLO protection.
+                if self._slo.alert_state(
+                        "serving_queue_pressure",
+                        fleet=fl.fleet_id) in ("pending", "firing"):
+                    continue
             if fl.queue_pressure() > self.rebalance_pressure:
                 continue
             victim = alive[-1]
@@ -763,6 +815,116 @@ class JobScheduler:
                 daemon=True,
                 name=f"JobRunner-rebalance-{job.job_id}").start()
             return
+
+    # .................................................... SLO actions
+    def _reconcile_slo(self) -> None:
+        """Level-triggered backstop for the edge-triggered
+        _on_slo_alert: a scale_serve alert that STAYS firing after a
+        failed or skipped restart (the drained replica's chip was
+        temporarily held by a train job, the fleet wasn't built yet)
+        gets the scale-up re-attempted about once a second until it
+        resolves — a deduplicated alert never re-fires its
+        transition, so the subscriber alone would try exactly once."""
+        if self._slo is None:
+            return
+        now = time.monotonic()
+        if now - self._last_slo_reconcile < 1.0:
+            return
+        self._last_slo_reconcile = now
+        try:
+            firing = self._slo.alerts(states=("firing",))
+        except Exception:
+            return
+        for a in firing:
+            if getattr(a, "action", None) == "scale_serve":
+                self._on_slo_alert(a)
+
+    def _on_slo_alert(self, alert) -> None:
+        """SLO-engine subscriber (runs on the SLOEvaluator thread).
+        A FIRING scale_serve alert — sustained fleet queue pressure —
+        restarts a drained/dead replica for the matching ServeJob;
+        the restart (an engine start, possibly a compile) runs on its
+        own runner thread, never on the evaluator. Resolved alerts
+        just wake the loop (rebalance may now reclaim capacity)."""
+        if getattr(alert, "action", None) != "scale_serve":
+            return
+        if alert.state != "firing":
+            self._wake.set()
+            return
+        fleet_id = alert.labels.get("fleet")
+        with self._lock:
+            job = next(
+                (j for j in self._jobs.values()
+                 if isinstance(j, ServeJob) and j.fleet is not None
+                 and j.state == "running"
+                 and (fleet_id is None
+                      or j.fleet.fleet_id == fleet_id)
+                 and not j._scaling), None)
+            if job is None:
+                return
+            job._scaling = True
+        # snapshot the trigger value now: the Alert object is live and
+        # its value will have drained back down by the time the
+        # restart thread records it
+        threading.Thread(
+            target=self._scale_up_serve,
+            args=(job, alert.rule, alert.value),
+            daemon=True,
+            name=f"JobRunner-scaleup-{job.job_id}").start()
+
+    def _scale_up_serve(self, job: ServeJob, rule: str,
+                        value) -> bool:
+        """Give a pressured fleet a replica back: restart the first
+        drained/dead replica whose chip is healthy, re-acquiring the
+        chip from the pool when a rebalance handed it back. Runs on a
+        dedicated runner thread; ``job._scaling`` keeps concurrent
+        firing ticks from double-restarting."""
+        try:
+            fleet = job.fleet
+            if fleet is None or job.state != "running":
+                return False
+            for r in fleet._replicas:
+                if r.alive or r.needs_cleanup:
+                    continue
+                dev = r.engine._device
+                acquired = False
+                if dev is not None:
+                    if self.devices.is_lost(dev):
+                        continue
+                    with self._lock:
+                        if dev not in job.devices:
+                            if not self.devices.acquire_device(
+                                    dev, job.job_id):
+                                continue   # chip busy under a train job
+                            job.devices.append(dev)
+                            acquired = True
+                try:
+                    fleet.restart_replica(r.index)
+                except Exception:
+                    log.exception("control: SLO scale-up restart "
+                                  "failed (job %s)", job.job_id)
+                    if acquired:
+                        with self._lock:
+                            job.devices.remove(dev)
+                        self.devices.release([dev])
+                    continue
+                _flight.record("job_scale_up", job=job.job_id,
+                               replica=r.index, rule=rule,
+                               value=value)
+                if _telemetry.enabled():
+                    _telemetry.MetricsRegistry.get_default().counter(
+                        _telemetry.JOBS_RESTARTS,
+                        "job component restarts (replica or whole "
+                        "job)").inc(job=job.job_id,
+                                    reason="queue_pressure_alert")
+                log.warning("control: restarted replica %d of %s on "
+                            "sustained queue-pressure alert "
+                            "(value=%s)", r.index, job.job_id, value)
+                return True
+            return False
+        finally:
+            job._scaling = False
+            self._wake.set()
 
     # ........................................................... launch
     def _launch(self, job: Job, devs: List[Any]) -> None:

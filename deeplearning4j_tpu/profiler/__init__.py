@@ -36,6 +36,19 @@ from deeplearning4j_tpu.profiler import flight_recorder, telemetry, tracing
 from deeplearning4j_tpu.profiler.model_health import HealthMonitor
 
 
+def __getattr__(name):
+    # slo is a LAZY attribute (PEP 562): the fit loops and serving
+    # engines import this package for telemetry, and the off-mode
+    # contract is that they never pull in the SLO engine
+    if name == "slo":
+        import importlib
+
+        return importlib.import_module(
+            "deeplearning4j_tpu.profiler.slo")
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}")
+
+
 class ProfilerMode(enum.Enum):
     DISABLED = "disabled"
     OPERATIONS = "operations"     # count + time registry dispatches
@@ -202,4 +215,4 @@ def trace(log_dir: str):
 __all__ = ["OpProfiler", "ProfilerConfig", "ProfilerMode",
            "NumericsException", "check_numerics", "start_trace",
            "stop_trace", "trace", "telemetry", "HealthMonitor",
-           "tracing", "flight_recorder"]
+           "tracing", "flight_recorder", "slo"]

@@ -1,0 +1,1053 @@
+"""SLO engine: windowed metric evaluation, burn-rate alerting, and
+control-plane action hooks.
+
+The stack emits rich signals — ``SERVING_*`` latency/TTFT histograms,
+``dl4j_tpu_mfu``, queue/KV gauges, ``dl4j_tpu_jobs_*`` — but until this
+module nothing watched them: an operator learned about a p99 blow-up or
+an MFU collapse by polling the dashboard. Just as the framework owns
+its performance substrate (cuDNN-style fused primitives,
+arXiv:1410.0759), a production system must own its HEALTH substrate:
+windowed objectives evaluated continuously in-process, not in an
+external scraper. The pieces:
+
+- **Snapshot ring.** ``SLOEngine`` captures the whole
+  ``MetricsRegistry`` (``registry.capture()``) every ``interval_s`` on
+  a background thread ("SLOEvaluator") into a bounded ring. Counter
+  windows are value DELTAS between two snapshots (a counter reset —
+  e.g. an engine restart — clamps at 0, never negative); histogram
+  windows are cumulative-bucket-count deltas, so the windowed quantile
+  here and ``histogram_quantile()`` in an external Prometheus share
+  one definition (the ``_bucket{le=...}`` series telemetry.py now
+  exports).
+- **Rules.** Three declarative kinds, each evaluated per label group:
+  ``Threshold`` (a gauge — or a windowed histogram quantile — vs a
+  bound, breached continuously for ``for_s`` before firing), ``Rate``
+  (counter delta/s over ``window_s`` vs a bound), and ``BurnRate``
+  (SRE-workbook multi-window error-budget burn: the error fraction —
+  bad/total counters, or the fraction of histogram samples over a
+  latency target — divided by the budget ``1 - objective``, evaluated
+  over a FAST and a SLOW window; the alert condition is both windows
+  exceeding ``factor``, i.e. ``min(burn_fast, burn_slow) > factor``,
+  which pages quickly on a real burn and un-pages as soon as the fast
+  window recovers).
+- **Alert lifecycle.** Per (rule, label-group): inactive -> pending
+  (condition true, ``for_s`` not yet served) -> firing -> resolved.
+  A pending alert whose condition clears before ``for_s`` is
+  suppressed (flap), never fired. Every transition emits a flight-
+  recorder event and a ``dl4j_tpu_alerts_total{rule,state}`` count;
+  ``firing`` with ``severity="page"`` additionally writes a full
+  flight-recorder incident dump (digest-valid post-mortem) and every
+  ``firing``/``resolved`` optionally POSTs to a webhook sink.
+- **Action hooks.** ``on_alert(fn)`` subscribes callables to
+  transitions — how ``control/scheduler.py`` turns a sustained
+  queue-pressure alert into a serve-replica scale-up, replacing its
+  one-shot ``queue_pressure()`` poll with real hysteresis
+  (pending/firing = hands off the fleet's replicas; resolved = fair
+  game for rebalancing).
+- **Built-in rule pack.** ``serving_rules()`` (p99 latency burn, TTFT,
+  error-rate and 429 burn, KV-page utilization, fleet queue pressure)
+  and ``training_rules()`` (MFU floor, watchdog-stall rate,
+  divergence-rollback rate, prefetch starvation); ``default_rules()``
+  is both.
+
+Off by default and bit/token-identical when disabled: nothing
+constructs an ``SLOEngine`` unless the operator does, the serving and
+training paths never import this module, and the HTTP/telemetry
+surfaces peek ``default_engine()`` (one attribute read when absent).
+
+Overhead when on: one ``registry.capture()`` per ``interval_s``
+(a dict copy per metric under its lock — microseconds at this repo's
+series cardinality) plus rule evaluation over the ring on the
+evaluator thread. Nothing on any training or serving hot path.
+"""
+
+from __future__ import annotations
+
+import bisect
+import collections
+import json
+import logging
+import threading
+import time
+import urllib.request
+from typing import (
+    Any, Callable, Dict, List, Optional, Sequence, Tuple, Union,
+)
+
+from deeplearning4j_tpu.profiler import flight_recorder as _flight
+from deeplearning4j_tpu.profiler import telemetry as _telemetry
+
+log = logging.getLogger("deeplearning4j_tpu")
+
+#: alert states (lifecycle order)
+STATES = ("inactive", "pending", "firing", "resolved")
+
+LabelKey = Tuple[Tuple[str, str], ...]
+#: a metric selector: a name, or (name, {label: value, ...}) where the
+#: where-dict is a subset match on the series' labels
+Selector = Union[str, Tuple[str, Dict[str, str]]]
+
+
+# ---------------------------------------------------------------- math
+def histogram_quantile(bounds: Sequence[float],
+                       counts: Sequence[float], q: float) \
+        -> Optional[float]:
+    """Prometheus-style quantile over NON-cumulative bucket counts
+    (``counts`` has ``len(bounds) + 1`` entries; the last is the +Inf
+    overflow). Linear interpolation inside the winning bucket; the
+    +Inf bucket clamps to the top finite bound (the same convention
+    ``histogram_quantile()`` uses). None on an empty window."""
+    total = sum(counts)
+    if total <= 0:
+        return None
+    rank = q * total
+    cum = 0.0
+    for i, c in enumerate(counts):
+        if c <= 0:
+            continue
+        prev_cum, cum = cum, cum + c
+        if cum >= rank:
+            if i >= len(bounds):          # +Inf bucket
+                return float(bounds[-1])
+            lo = float(bounds[i - 1]) if i > 0 else 0.0
+            hi = float(bounds[i])
+            return lo + (hi - lo) * (rank - prev_cum) / c
+    return float(bounds[-1])
+
+
+def _match(labels: Dict[str, str], where: Dict[str, str]) -> bool:
+    return all(labels.get(k) == str(v) for k, v in where.items())
+
+
+def _norm_selector(sel: Selector) -> Tuple[str, Dict[str, str]]:
+    if isinstance(sel, str):
+        return sel, {}
+    name, where = sel
+    return name, dict(where)
+
+
+# ------------------------------------------------------------ snapshots
+class _Ring:
+    """Bounded ring of (monotonic_t, registry capture)."""
+
+    def __init__(self, capacity: int):
+        self._buf: collections.deque = collections.deque(
+            maxlen=max(int(capacity), 4))
+
+    def append(self, t: float, cap: Dict[str, Any]) -> None:
+        self._buf.append((t, cap))
+
+    def __len__(self) -> int:
+        return len(self._buf)
+
+    def latest(self) -> Optional[Tuple[float, Dict[str, Any]]]:
+        return self._buf[-1] if self._buf else None
+
+    def at_or_before(self, t: float) \
+            -> Optional[Tuple[float, Dict[str, Any]]]:
+        """The NEWEST snapshot taken at or before ``t`` — the window's
+        far edge. None when history doesn't reach back that far (the
+        rule is then not evaluable yet: never fire on a half-window)."""
+        out = None
+        for item in self._buf:
+            if item[0] <= t:
+                out = item
+            else:
+                break
+        return out
+
+
+def _counter_series(cap: Dict[str, Any], sel: Selector) \
+        -> List[Tuple[Dict[str, str], float]]:
+    """Counter-like series from a capture: counters and gauges by
+    value; histograms by their cumulative COUNT (so a latency
+    histogram doubles as a per-label request counter)."""
+    name, where = _norm_selector(sel)
+    m = cap.get(name)
+    if m is None:
+        return []
+    if m["kind"] == "histogram":
+        items = [(k, cnt) for k, (cnt, _s, _b) in m["series"].items()]
+    else:
+        items = list(m["values"].items())
+    out = []
+    for k, v in items:
+        labels = dict(k)
+        if _match(labels, where):
+            out.append((labels, float(v)))
+    return out
+
+
+def _hist_series(cap: Dict[str, Any], sel: Selector) \
+        -> Tuple[Tuple[float, ...],
+                 List[Tuple[Dict[str, str], Tuple[float, ...]]]]:
+    """(bounds, [(labels, bucket_counts)]) from a capture."""
+    name, where = _norm_selector(sel)
+    m = cap.get(name)
+    if m is None or m["kind"] != "histogram":
+        return (), []
+    out = []
+    for k, (_cnt, _sum, buckets) in m["series"].items():
+        labels = dict(k)
+        if _match(labels, where):
+            out.append((labels, buckets))
+    return m["bounds"], out
+
+
+def _grouped_bucket_deltas(rule: "Rule", cap1: Dict[str, Any],
+                           cap0: Dict[str, Any], sel: Selector) \
+        -> Tuple[Tuple[float, ...], Dict[LabelKey, List[float]]]:
+    """Per-group NON-cumulative bucket-count deltas between two
+    captures, reset-clamped at 0 — the ONE windowed-histogram read
+    Threshold quantile mode and BurnRate histogram mode share (so the
+    two cannot silently diverge on delta semantics)."""
+    bounds, now_series = _hist_series(cap1, sel)
+    if not bounds:
+        return (), {}
+    _b0, then_series = _hist_series(cap0, sel)
+
+    def acc(series):
+        g: Dict[LabelKey, List[float]] = {}
+        for labels, buckets in series:
+            a = g.setdefault(rule._gkey(labels), [0.0] * len(buckets))
+            for i, b in enumerate(buckets):
+                a[i] += b
+        return g
+
+    now_g, then_g = acc(now_series), acc(then_series)
+    out = {}
+    for k, a in now_g.items():
+        prev = then_g.get(k, [0.0] * len(a))
+        out[k] = [max(x - p, 0.0) for x, p in zip(a, prev)]
+    return bounds, out
+
+
+# ----------------------------------------------------------------- rules
+class Rule:
+    """Base: shared grouping + lifecycle knobs.
+
+    ``where`` filters series by label subset; ``group_by`` names the
+    labels that key one alert (None = every distinct label set is its
+    own alert — the right default for per-engine gauges); ``for_s`` is
+    how long the condition must hold before ``pending`` becomes
+    ``firing``; ``action`` is an opaque tag subscribers dispatch on
+    (the scheduler watches ``"scale_serve"``)."""
+
+    kind = "rule"
+
+    def __init__(self, name: str, *, severity: str = "ticket",
+                 for_s: float = 0.0,
+                 where: Optional[Dict[str, str]] = None,
+                 group_by: Optional[Sequence[str]] = None,
+                 action: Optional[str] = None,
+                 description: str = ""):
+        if severity not in ("ticket", "page"):
+            raise ValueError(f"severity must be 'ticket' or 'page', "
+                             f"got {severity!r}")
+        self.name = str(name)
+        self.severity = severity
+        self.for_s = float(for_s)
+        self.where = dict(where or {})
+        self.group_by = (tuple(group_by)
+                         if group_by is not None else None)
+        self.action = action
+        self.description = description
+
+    # -- grouping -------------------------------------------------------
+    def _gkey(self, labels: Dict[str, str]) -> LabelKey:
+        if self.group_by is None:
+            return tuple(sorted(labels.items()))
+        return tuple((k, labels[k]) for k in self.group_by
+                     if k in labels)
+
+    def _group_sum(self, entries) -> Dict[LabelKey, float]:
+        out: Dict[LabelKey, float] = {}
+        for labels, v in entries:
+            k = self._gkey(labels)
+            out[k] = out.get(k, 0.0) + v
+        return out
+
+    # -- evaluation -----------------------------------------------------
+    def evaluate(self, ring: _Ring, now: float) \
+            -> Dict[LabelKey, Optional[float]]:
+        """group-key -> rule value (None = not evaluable this tick:
+        no data / empty window / not enough history — never a
+        breach)."""
+        raise NotImplementedError
+
+    def breached(self, value: float) -> bool:
+        raise NotImplementedError
+
+    def describe(self) -> Dict[str, Any]:
+        return {"name": self.name, "kind": self.kind,
+                "severity": self.severity, "for_s": self.for_s,
+                "where": self.where,
+                "group_by": list(self.group_by or []) or None,
+                "action": self.action,
+                "description": self.description}
+
+
+def _cmp(op: str):
+    if op == ">":
+        return lambda v, b: v > b
+    if op == "<":
+        return lambda v, b: v < b
+    raise ValueError(f"op must be '>' or '<', got {op!r}")
+
+
+class Threshold(Rule):
+    """A gauge — or a windowed histogram quantile — vs a bound.
+
+    Gauge mode: ``Threshold("kv_hot", metric=GAUGE, bound=0.95)``.
+    Within a group the WORST member decides (max for ``>``, min for
+    ``<``).
+    Quantile mode: pass ``quantile=`` and ``window_s=``; the value is
+    the quantile of the samples observed in the window (bucket-count
+    deltas), and an empty window means 'no data', not zero."""
+
+    kind = "threshold"
+
+    def __init__(self, name: str, *, metric: Selector, bound: float,
+                 op: str = ">", quantile: Optional[float] = None,
+                 window_s: Optional[float] = None, **kw):
+        super().__init__(name, **kw)
+        self.metric = metric
+        self.bound = float(bound)
+        self.op = op
+        self._cmp = _cmp(op)
+        self.quantile = None if quantile is None else float(quantile)
+        if self.quantile is not None and window_s is None:
+            raise ValueError(
+                f"rule {name!r}: quantile mode needs window_s")
+        self.window_s = None if window_s is None else float(window_s)
+
+    def evaluate(self, ring, now):
+        latest = ring.latest()
+        if latest is None:
+            return {}
+        t1, cap1 = latest
+        if self.quantile is None:
+            worst = max if self.op == ">" else min
+            out: Dict[LabelKey, Optional[float]] = {}
+            for labels, v in _counter_series(cap1, self.metric):
+                k = self._gkey(labels)
+                out[k] = v if k not in out else worst(out[k], v)
+            return out
+        then = ring.at_or_before(now - self.window_s)
+        if then is None:
+            return {}
+        _t0, cap0 = then
+        # windowed bucket deltas per group, then ONE quantile per
+        # group (None on an empty window — zero samples evaluate
+        # nothing)
+        bounds, deltas = _grouped_bucket_deltas(
+            self, cap1, cap0, self.metric)
+        if not bounds:
+            return {}
+        return {k: histogram_quantile(bounds, d, self.quantile)
+                for k, d in deltas.items()}
+
+    def breached(self, value):
+        return self._cmp(value, self.bound)
+
+    def describe(self):
+        d = super().describe()
+        d.update(metric=_norm_selector(self.metric)[0], op=self.op,
+                 bound=self.bound, quantile=self.quantile,
+                 window_s=self.window_s)
+        return d
+
+
+class Rate(Rule):
+    """Counter delta per second over ``window_s`` vs a bound. A
+    counter reset (engine restart zeroes its series) clamps the delta
+    at 0 — a rate can dip to zero across a restart, never go
+    negative."""
+
+    kind = "rate"
+
+    def __init__(self, name: str, *, metric: Selector, bound: float,
+                 window_s: float, op: str = ">", **kw):
+        super().__init__(name, **kw)
+        self.metric = metric
+        self.bound = float(bound)
+        self.window_s = float(window_s)
+        self.op = op
+        self._cmp = _cmp(op)
+
+    def evaluate(self, ring, now):
+        latest = ring.latest()
+        then = ring.at_or_before(now - self.window_s)
+        if latest is None or then is None:
+            return {}
+        t1, cap1 = latest
+        t0, cap0 = then
+        dt = t1 - t0
+        if dt <= 0:
+            return {}
+        now_g = self._group_sum(_counter_series(cap1, self.metric))
+        then_g = self._group_sum(_counter_series(cap0, self.metric))
+        return {k: max(v - then_g.get(k, 0.0), 0.0) / dt
+                for k, v in now_g.items()}
+
+    def breached(self, value):
+        return self._cmp(value, self.bound)
+
+    def describe(self):
+        d = super().describe()
+        d.update(metric=_norm_selector(self.metric)[0], op=self.op,
+                 bound=self.bound, window_s=self.window_s)
+        return d
+
+
+class BurnRate(Rule):
+    """Multi-window error-budget burn (SRE workbook).
+
+    The error fraction over a window is either ``numerator`` /
+    ``denominator`` counter deltas (e.g. 429s over submissions, error
+    finishes over all finishes) or — with ``histogram=`` and
+    ``target_s=`` — the fraction of the window's histogram samples
+    SLOWER than the latency target (the p99-over-target fraction).
+    The burn rate is that fraction divided by the budget
+    ``1 - objective``; the rule's value is ``min(burn_fast,
+    burn_slow)``, so ``breached`` means BOTH windows exceed
+    ``factor``: the slow window proves the burn is sustained, the
+    fast window un-pages promptly after recovery. Empty windows
+    (denominator delta 0) are 'no data' — nothing fires on silence."""
+
+    kind = "burn_rate"
+
+    def __init__(self, name: str, *, objective: float,
+                 fast_window_s: float, slow_window_s: float,
+                 factor: float = 4.0,
+                 numerator: Optional[Selector] = None,
+                 denominator: Optional[Selector] = None,
+                 histogram: Optional[Selector] = None,
+                 target_s: Optional[float] = None, **kw):
+        super().__init__(name, **kw)
+        if not 0.0 < objective < 1.0:
+            raise ValueError(
+                f"rule {name!r}: objective must be in (0, 1)")
+        if (histogram is None) == (numerator is None):
+            raise ValueError(
+                f"rule {name!r}: exactly one of histogram= or "
+                "numerator=/denominator= must be given")
+        if histogram is not None and target_s is None:
+            raise ValueError(
+                f"rule {name!r}: histogram mode needs target_s")
+        if numerator is not None and denominator is None:
+            raise ValueError(
+                f"rule {name!r}: numerator needs denominator")
+        self.objective = float(objective)
+        self.budget = 1.0 - self.objective
+        self.fast_window_s = float(fast_window_s)
+        self.slow_window_s = float(slow_window_s)
+        self.factor = float(factor)
+        self.numerator = numerator
+        self.denominator = denominator
+        self.histogram = histogram
+        self.target_s = None if target_s is None else float(target_s)
+
+    def _fractions(self, ring, now, window_s) \
+            -> Dict[LabelKey, Optional[float]]:
+        latest = ring.latest()
+        then = ring.at_or_before(now - window_s)
+        if latest is None or then is None:
+            return {}
+        _t1, cap1 = latest
+        _t0, cap0 = then
+        if self.histogram is not None:
+            bounds, deltas = _grouped_bucket_deltas(
+                self, cap1, cap0, self.histogram)
+            if not bounds:
+                return {}
+            # the smallest bound >= target splits good from bad —
+            # CONSERVATIVE (samples between target and that bound
+            # count as good); align targets to bucket bounds for
+            # exactness, same as an external histogram_quantile user
+            split = bisect.bisect_left(bounds, self.target_s)
+            out: Dict[LabelKey, Optional[float]] = {}
+            for k, d in deltas.items():
+                total = sum(d)
+                if total <= 0:
+                    out[k] = None         # empty window: no data
+                    continue
+                out[k] = sum(d[split + 1:]) / total
+            return out
+        num1 = self._group_sum(_counter_series(cap1, self.numerator))
+        num0 = self._group_sum(_counter_series(cap0, self.numerator))
+        den1 = self._group_sum(_counter_series(cap1, self.denominator))
+        den0 = self._group_sum(_counter_series(cap0, self.denominator))
+        out = {}
+        for k, d1 in den1.items():
+            den = max(d1 - den0.get(k, 0.0), 0.0)
+            if den <= 0:
+                out[k] = None
+                continue
+            num = max(num1.get(k, 0.0) - num0.get(k, 0.0), 0.0)
+            out[k] = min(num / den, 1.0)
+        return out
+
+    def evaluate(self, ring, now):
+        fast = self._fractions(ring, now, self.fast_window_s)
+        slow = self._fractions(ring, now, self.slow_window_s)
+        out: Dict[LabelKey, Optional[float]] = {}
+        for k in set(fast) | set(slow):
+            f, s = fast.get(k), slow.get(k)
+            if f is None or s is None:
+                out[k] = None
+                continue
+            out[k] = min(f, s) / self.budget
+        return out
+
+    def breached(self, value):
+        return value > self.factor
+
+    def describe(self):
+        d = super().describe()
+        d.update(objective=self.objective, factor=self.factor,
+                 fast_window_s=self.fast_window_s,
+                 slow_window_s=self.slow_window_s,
+                 target_s=self.target_s,
+                 metric=_norm_selector(
+                     self.histogram or self.numerator)[0])
+        return d
+
+
+# ---------------------------------------------------------------- alerts
+class Alert:
+    """One (rule, label-group)'s live state. Deduplicated: a condition
+    that stays breached keeps ONE alert in ``firing``, it does not
+    re-fire every tick."""
+
+    def __init__(self, rule: Rule, key: LabelKey):
+        self.rule = rule.name
+        self.severity = rule.severity
+        self.action = rule.action
+        self.labels = dict(key)
+        self.key = key
+        self.state = "inactive"
+        self.value: Optional[float] = None
+        self.pending_since: Optional[float] = None   # monotonic
+        self.fired_at: Optional[float] = None        # wall clock
+        self.resolved_at: Optional[float] = None
+        self.resolved_mono: Optional[float] = None   # prune clock
+        self.transitions = 0
+        self.incident_dump: Optional[str] = None
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"rule": self.rule, "labels": self.labels,
+                "state": self.state, "severity": self.severity,
+                "action": self.action, "value": self.value,
+                "fired_at": self.fired_at,
+                "resolved_at": self.resolved_at,
+                "transitions": self.transitions,
+                "incident_dump": self.incident_dump}
+
+
+class SLOEngine:
+    """Declarative SLO evaluator over a ``MetricsRegistry`` (module
+    docstring). ``start()`` runs the "SLOEvaluator" thread;
+    ``tick(now=...)`` evaluates once synchronously (tests drive the
+    lifecycle deterministically with a fake clock and never need the
+    thread)."""
+
+    #: resolved/suppressed transition records kept for /v1/alerts
+    HISTORY = 128
+    #: how long a resolved alert whose label group has gone dark (no
+    #: data at all) stays visible before its entry is pruned
+    RESOLVED_RETENTION = 60.0
+
+    def __init__(self, rules: Optional[Sequence[Rule]] = None, *,
+                 registry: Optional[_telemetry.MetricsRegistry] = None,
+                 interval_s: float = 1.0,
+                 webhook_url: Optional[str] = None,
+                 webhook_timeout_s: float = 2.0,
+                 flight_dir: Optional[str] = None,
+                 make_default: bool = True):
+        self.registry = (registry if registry is not None
+                         else _telemetry.MetricsRegistry.get_default())
+        self.interval_s = float(interval_s)
+        self.webhook_url = webhook_url
+        self.webhook_timeout_s = float(webhook_timeout_s)
+        self.flight_dir = flight_dir
+        self._rules: List[Rule] = list(rules or [])
+        self._alerts: Dict[Tuple[str, LabelKey], Alert] = {}
+        self._history: collections.deque = collections.deque(
+            maxlen=self.HISTORY)
+        self._subs: List[Tuple[Callable[[Alert], Any],
+                               Tuple[str, ...]]] = []
+        #: slow side effects (incident dumps, webhook POSTs) queued by
+        #: _set_state under the lock, executed by tick() OUTSIDE it —
+        #: a stuck webhook must never stall alert_state() readers
+        self._pending_io: List[Tuple[str, Alert]] = []
+        self._lock = threading.RLock()
+        self._ring = _Ring(self._ring_capacity())
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.ticks = 0
+        if make_default:
+            install(self)
+
+    # ------------------------------------------------------------ rules
+    def _ring_capacity(self) -> int:
+        horizon = 0.0
+        for r in self._rules:
+            for attr in ("window_s", "slow_window_s"):
+                v = getattr(r, attr, None)
+                if v:
+                    horizon = max(horizon, float(v))
+        if horizon <= 0:
+            return 64
+        return min(max(int(horizon / max(self.interval_s, 1e-3)) + 8,
+                       64), 4096)
+
+    def add_rule(self, rule: Rule) -> None:
+        with self._lock:
+            if any(r.name == rule.name for r in self._rules):
+                raise ValueError(f"duplicate rule name {rule.name!r}")
+            self._rules.append(rule)
+            # re-home the ring if the new rule needs a longer horizon
+            cap = self._ring_capacity()
+            if cap != self._ring._buf.maxlen:
+                self._ring._buf = collections.deque(
+                    self._ring._buf, maxlen=cap)
+
+    def rules(self) -> List[Rule]:
+        with self._lock:
+            return list(self._rules)
+
+    # ----------------------------------------------------- subscriptions
+    def on_alert(self, fn: Callable[[Alert], Any],
+                 states: Sequence[str] = ("firing", "resolved")) \
+            -> Callable[[Alert], Any]:
+        """Subscribe ``fn(alert)`` to lifecycle transitions into
+        ``states``. Called on the evaluator thread AFTER the alert's
+        own bookkeeping (metrics, flight event, incident dump);
+        exceptions are logged, never let near the evaluation loop.
+        Returns ``fn`` for decorator use."""
+        bad = set(states) - set(STATES)
+        if bad:
+            raise ValueError(f"unknown alert states: {sorted(bad)}")
+        with self._lock:
+            self._subs.append((fn, tuple(states)))
+        return fn
+
+    # -------------------------------------------------------- lifecycle
+    def start(self) -> "SLOEngine":
+        with self._lock:
+            if self._thread is not None:
+                return self
+            if self._stop.is_set():
+                raise RuntimeError("SLO engine has been shut down")
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True, name="SLOEvaluator")
+            self._thread.start()
+        return self
+
+    def shutdown(self, timeout: float = 10.0) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout)
+        # stale-series discipline (same as a dying decode engine): the
+        # active-alerts gauge describes THIS engine — zero it so a
+        # dead engine can't report permanently pending/firing alerts
+        g = self.registry.peek(_telemetry.ALERTS_ACTIVE)
+        if g is not None:
+            for state in ("pending", "firing"):
+                g.set(0, state=state)
+        if default_engine() is self:
+            install(None)
+
+    def __enter__(self) -> "SLOEngine":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.shutdown()
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.tick()
+            except Exception:
+                log.exception("SLO evaluator tick failed")
+            self._stop.wait(self.interval_s)
+
+    # ------------------------------------------------------- evaluation
+    def tick(self, now: Optional[float] = None) -> None:
+        """Capture one registry snapshot and evaluate every rule.
+        ``now`` (monotonic seconds) is injectable so tests walk the
+        pending->firing->resolved lifecycle with a fake clock."""
+        if now is None:
+            now = time.monotonic()
+        cap = self.registry.capture()
+        with self._lock:
+            self._ring.append(now, cap)
+            self.ticks += 1
+            fired: List[Alert] = []
+            for rule in self._rules:
+                try:
+                    results = rule.evaluate(self._ring, now)
+                except Exception:
+                    log.exception("SLO rule %r evaluation failed",
+                                  rule.name)
+                    continue
+                seen = set(results)
+                for key, value in results.items():
+                    a = self._transition(rule, key, value, now)
+                    if a is not None:
+                        fired.append(a)
+                # groups that vanished from the data (stale-series
+                # expiry dropped a dead engine's gauges): condition
+                # false
+                for (rname, key), a in list(self._alerts.items()):
+                    if rname == rule.name and key not in seen:
+                        a2 = self._transition(rule, key, None, now)
+                        if a2 is not None:
+                            fired.append(a2)
+            self._publish_gauges()
+            subs = list(self._subs)
+            io, self._pending_io = self._pending_io, []
+        # slow side effects and notifications OUTSIDE the lock: a
+        # stuck webhook or a large incident dump must not stall
+        # alert_state()/alerts() readers (the scheduler calls them
+        # under its own lock), and a subscriber may call back in.
+        # Incident-before-webhook order is preserved per transition,
+        # so the firing webhook payload carries incident_dump.
+        for kind, a in io:
+            if kind == "incident":
+                a.incident_dump = _flight.incident(
+                    "slo_page", directory=self.flight_dir,
+                    rule=a.rule, labels=dict(a.labels), value=a.value)
+            else:
+                self._post_webhook(a)
+        for a in fired:
+            for fn, states in subs:
+                if a.state in states:
+                    try:
+                        fn(a)
+                    except Exception:
+                        log.exception(
+                            "on_alert subscriber failed for %s", a.rule)
+
+    def _transition(self, rule: Rule, key: LabelKey,
+                    value: Optional[float], now: float) \
+            -> Optional[Alert]:
+        """One alert's state machine step. Returns the alert when its
+        state CHANGED (the caller notifies subscribers), else None."""
+        breached = value is not None and rule.breached(value)
+        a = self._alerts.get((rule.name, key))
+        if a is None:
+            if not breached:
+                return None
+            a = Alert(rule, key)
+            self._alerts[(rule.name, key)] = a
+        if value is not None:
+            a.value = value
+        old = a.state
+        if a.state in ("inactive", "resolved"):
+            if breached:
+                a.pending_since = now
+                if rule.for_s <= 0:
+                    self._set_state(a, "firing", now)
+                else:
+                    self._set_state(a, "pending", now)
+            elif (a.state == "resolved" and value is None
+                  and a.resolved_mono is not None
+                  and now - a.resolved_mono >= self.RESOLVED_RETENTION):
+                # the group stayed DARK well past resolution: its
+                # gauge series vanished (stale-series expiry) or its
+                # counter/histogram windows emptied for good (dead
+                # engine — counters are retained, so the key stays in
+                # results forever with no data). The lifecycle record
+                # lives in _history; drop the entry so per-engine
+                # label churn (replica restarts mint fresh engine
+                # ids) can't grow the alert table without bound. The
+                # retention keeps a just-resolved alert visible to
+                # operators/drills polling alert_state().
+                del self._alerts[(rule.name, key)]
+        elif a.state == "pending":
+            if not breached:
+                # flap: the condition cleared before for_s — suppress,
+                # never fire (counted as 'suppressed', visible in
+                # history, no page)
+                self._set_state(a, "inactive", now, suppressed=True)
+                del self._alerts[(rule.name, key)]
+            elif now - a.pending_since >= rule.for_s:
+                self._set_state(a, "firing", now)
+        elif a.state == "firing":
+            if not breached:
+                self._set_state(a, "resolved", now)
+        return a if a.state != old else None
+
+    def _set_state(self, a: Alert, state: str, now: float,
+                   suppressed: bool = False) -> None:
+        old, a.state = a.state, state
+        a.transitions += 1
+        wall = time.time()
+        if state == "firing":
+            a.fired_at = wall
+            a.resolved_at = None
+        elif state == "resolved":
+            a.resolved_at = wall
+            a.resolved_mono = now
+        counted = "suppressed" if suppressed else state
+        _flight.record("alert", rule=a.rule, frm=old, state=counted,
+                       labels=dict(a.labels), value=a.value,
+                       severity=a.severity)
+        if _telemetry.enabled():
+            self.registry.counter(
+                _telemetry.ALERTS_TOTAL,
+                "alert lifecycle transitions (state=pending/firing/"
+                "resolved/suppressed)").inc(rule=a.rule, state=counted)
+        self._history.append({
+            "t": wall, "rule": a.rule, "labels": dict(a.labels),
+            "from": old, "to": counted, "value": a.value,
+            "severity": a.severity})
+        if state == "firing":
+            log.warning("SLO ALERT FIRING: %s%s value=%s severity=%s",
+                        a.rule, a.labels, a.value, a.severity)
+            if a.severity == "page":
+                # a page is exactly the moment the black box exists
+                # for: dump the ring + traces, digest-valid (deferred
+                # to tick()'s unlocked phase with the webhook)
+                self._pending_io.append(("incident", a))
+        elif state == "resolved":
+            log.info("SLO alert resolved: %s%s", a.rule, a.labels)
+        if state in ("firing", "resolved"):
+            self._pending_io.append(("webhook", a))
+
+    def _publish_gauges(self) -> None:
+        if not _telemetry.enabled():
+            return
+        counts = {"pending": 0, "firing": 0}
+        for a in self._alerts.values():
+            if a.state in counts:
+                counts[a.state] += 1
+        g = self.registry.gauge(
+            _telemetry.ALERTS_ACTIVE,
+            "alerts currently pending / firing")
+        for state, n in counts.items():
+            g.set(n, state=state)
+
+    def _post_webhook(self, a: Alert) -> None:
+        url = self.webhook_url
+        if not url:
+            return
+        body = json.dumps(a.to_dict()).encode()
+        req = urllib.request.Request(
+            url, data=body,
+            headers={"Content-Type": "application/json"})
+        try:
+            urllib.request.urlopen(
+                req, timeout=self.webhook_timeout_s).read()
+        except Exception as e:
+            log.warning("SLO webhook POST to %s failed: %s", url, e)
+
+    # ---------------------------------------------------------- reading
+    def alerts(self, states: Optional[Sequence[str]] = None) \
+            -> List[Alert]:
+        with self._lock:
+            out = list(self._alerts.values())
+        if states is not None:
+            out = [a for a in out if a.state in states]
+        return out
+
+    def alert_state(self, rule: str, **labels) -> str:
+        """The state of the alert for ``rule`` whose labels contain
+        ``labels`` ("inactive" when none does) — the control plane's
+        hysteresis read."""
+        with self._lock:
+            for (rname, _key), a in self._alerts.items():
+                if rname == rule and _match(a.labels, labels):
+                    return a.state
+        return "inactive"
+
+    def alerts_json(self) -> Dict[str, Any]:
+        with self._lock:
+            alerts = [a.to_dict() for a in self._alerts.values()]
+            history = list(self._history)
+            rules = [r.describe() for r in self._rules]
+        order = {"firing": 0, "pending": 1, "resolved": 2,
+                 "inactive": 3}
+        alerts.sort(key=lambda a: order.get(a["state"], 9))
+        return {"interval_s": self.interval_s,
+                "ticks": self.ticks,
+                "running": self._thread is not None
+                and self._thread.is_alive(),
+                "rules": rules, "alerts": alerts,
+                "history": history[-64:]}
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Compact peek-style embedding for telemetry.snapshot() /
+        the dashboard card."""
+        with self._lock:
+            firing = [a.to_dict() for a in self._alerts.values()
+                      if a.state == "firing"]
+            pending = [a.to_dict() for a in self._alerts.values()
+                       if a.state == "pending"]
+            n_rules = len(self._rules)
+            history = list(self._history)[-8:]
+        return {"rules": n_rules, "ticks": self.ticks,
+                "firing": firing, "pending": pending,
+                "recent": history}
+
+
+# ------------------------------------------------------- built-in pack
+def serving_rules(*, p99_target_s: float = 1.0,
+                  ttft_target_s: float = 0.5,
+                  latency_objective: float = 0.99,
+                  error_objective: float = 0.999,
+                  burn_fast_s: float = 60.0,
+                  burn_slow_s: float = 300.0,
+                  burn_factor: float = 4.0,
+                  kv_util_bound: float = 0.95,
+                  queue_pressure_bound: float = 1.0,
+                  queue_pressure_for_s: float = 5.0,
+                  window_s: float = 60.0,
+                  for_s: float = 10.0) -> List[Rule]:
+    """The serving pack. Latency burn pages; the rest tickets. The
+    queue-pressure rule carries ``action="scale_serve"`` — the
+    control plane's scale-up hook."""
+    T = _telemetry
+    return [
+        BurnRate(
+            "serving_p99_burn", severity="page",
+            histogram=T.SERVING_REQUEST_LATENCY,
+            target_s=p99_target_s, objective=latency_objective,
+            fast_window_s=burn_fast_s, slow_window_s=burn_slow_s,
+            factor=burn_factor, group_by=("engine",),
+            description=f"request-latency error budget "
+                        f"({latency_objective:.0%} under "
+                        f"{p99_target_s}s) burning in both windows"),
+        Threshold(
+            "serving_ttft_p99", metric=T.SERVING_TTFT,
+            quantile=0.99, window_s=window_s, bound=ttft_target_s,
+            op=">", for_s=for_s, group_by=("engine",),
+            description="windowed TTFT p99 over target"),
+        BurnRate(
+            "serving_error_rate",
+            numerator=(T.SERVING_REQUEST_LATENCY,
+                       {"reason": "error"}),
+            denominator=T.SERVING_REQUEST_LATENCY,
+            objective=error_objective,
+            fast_window_s=burn_fast_s, slow_window_s=burn_slow_s,
+            factor=burn_factor, group_by=("engine",),
+            description="finished-with-error fraction burning the "
+                        "availability budget"),
+        BurnRate(
+            "serving_429_burn",
+            numerator=T.SERVING_REJECTS,
+            denominator=T.SERVING_REQUESTS,
+            objective=error_objective,
+            fast_window_s=burn_fast_s, slow_window_s=burn_slow_s,
+            factor=burn_factor, group_by=(),
+            description="capacity rejects (429s) vs admitted "
+                        "submissions, process-wide"),
+        Threshold(
+            "serving_kv_utilization",
+            metric=T.SERVING_KV_PAGE_UTILIZATION,
+            bound=kv_util_bound, op=">", for_s=for_s,
+            description="KV page pool sustained near capacity"),
+        Threshold(
+            "serving_queue_pressure",
+            metric=T.SERVING_FLEET_PRESSURE,
+            bound=queue_pressure_bound, op=">",
+            for_s=queue_pressure_for_s, action="scale_serve",
+            description="sustained fleet admission pressure "
+                        "(queued work per live decode slot) — the "
+                        "scheduler's scale-up signal"),
+    ]
+
+
+def training_rules(*, mfu_floor: float = 0.05,
+                    mfu_for_s: float = 60.0,
+                    stall_window_s: float = 300.0,
+                    rollback_window_s: float = 300.0,
+                    rollback_rate_bound: float = 1 / 60.0,
+                    starvation_for_s: float = 30.0) -> List[Rule]:
+    """The training pack: MFU floor, watchdog-stall rate, divergence-
+    rollback rate, prefetch starvation (a sustained empty prefetch
+    queue = the input pipeline can't keep the chip fed)."""
+    T = _telemetry
+    return [
+        Threshold(
+            "train_mfu_drop", metric=T.MFU, bound=mfu_floor,
+            op="<", for_s=mfu_for_s,
+            description="live MFU below floor (step got slower or "
+                        "smaller without anyone asking)"),
+        Rate(
+            "train_watchdog_stalls", metric=T.WATCHDOG_STALLS,
+            bound=0.0, window_s=stall_window_s, group_by=(),
+            description="any watchdog stall in the window"),
+        Rate(
+            "train_divergence_rollbacks", metric=T.FT_ROLLBACKS,
+            bound=rollback_rate_bound, window_s=rollback_window_s,
+            group_by=(),
+            description="divergence rollbacks per second over the "
+                        "window (the guard is spending its budget)"),
+        Threshold(
+            "train_prefetch_starvation",
+            metric=T.PREFETCH_QUEUE_DEPTH, bound=0.5, op="<",
+            for_s=starvation_for_s,
+            description="device prefetch queue sustained empty — "
+                        "host ETL is the bottleneck"),
+    ]
+
+
+def default_rules(**overrides) -> List[Rule]:
+    """serving_rules() + training_rules(); keyword overrides are
+    routed to whichever pack accepts them."""
+    import inspect
+
+    s_keys = set(inspect.signature(serving_rules).parameters)
+    t_keys = set(inspect.signature(training_rules).parameters)
+    unknown = set(overrides) - s_keys - t_keys
+    if unknown:
+        raise TypeError(f"unknown rule-pack overrides: "
+                        f"{sorted(unknown)}")
+    return (serving_rules(**{k: v for k, v in overrides.items()
+                             if k in s_keys})
+            + training_rules(**{k: v for k, v in overrides.items()
+                                if k in t_keys}))
+
+
+# -------------------------------------------- default engine + HTTP
+_default: Optional[SLOEngine] = None
+_dlock = threading.Lock()
+
+
+def install(engine: Optional[SLOEngine]) -> None:
+    global _default
+    with _dlock:
+        _default = engine
+
+
+def default_engine() -> Optional[SLOEngine]:
+    return _default
+
+
+def alerts_snapshot() -> Dict[str, Any]:
+    """Peek-style snapshot for telemetry embedding ({} without a live
+    engine — an idle process pays one attribute read)."""
+    e = _default
+    return e.snapshot() if e is not None else {}
+
+
+def http_alerts() -> Tuple[Dict[str, Any], int]:
+    """Shared GET /v1/alerts handling for ui/server.py and
+    remote/server.py. Returns (obj, http_code)."""
+    e = default_engine()
+    if e is None:
+        return ({"error": "no SLO engine in this process (construct "
+                          "profiler.slo.SLOEngine(rules=slo."
+                          "default_rules()) and start() it)"}, 404)
+    return (e.alerts_json(), 200)
+
+
+__all__ = ["SLOEngine", "Rule", "Threshold", "Rate", "BurnRate",
+           "Alert", "STATES", "histogram_quantile",
+           "serving_rules", "training_rules", "default_rules",
+           "install", "default_engine", "alerts_snapshot",
+           "http_alerts"]

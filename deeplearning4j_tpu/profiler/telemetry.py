@@ -37,6 +37,7 @@ the distinct-signature count per site that triggers the storm warning.
 
 from __future__ import annotations
 
+import bisect
 import collections
 import contextlib
 import json
@@ -126,6 +127,7 @@ SERVING_FLEET_REPLICAS = "dl4j_tpu_serving_fleet_live_replicas"
 SERVING_LANE_PREFILLS = "dl4j_tpu_serving_prefill_lane_prefills_total"
 SERVING_LANE_SECONDS = "dl4j_tpu_serving_prefill_lane_seconds"
 SERVING_HANDOFF_SECONDS = "dl4j_tpu_serving_handoff_seconds"
+SERVING_FLEET_PRESSURE = "dl4j_tpu_serving_fleet_queue_pressure"
 #: queued dynamic-batching inference (parallel/wrapper.py)
 INFERENCE_REQUEST_LATENCY = "dl4j_tpu_inference_request_latency_seconds"
 INFERENCE_QUEUE_DEPTH = "dl4j_tpu_inference_queue_depth"
@@ -144,6 +146,9 @@ JOBS_DEVICES = "dl4j_tpu_jobs_devices"
 JOBS_THROUGHPUT = "dl4j_tpu_job_throughput"
 JOBS_MFU = "dl4j_tpu_job_mfu"
 JOBS_LATENCY_P50 = "dl4j_tpu_job_request_p50_ms"
+#: SLO / alerting engine (profiler/slo.py)
+ALERTS_TOTAL = "dl4j_tpu_alerts_total"
+ALERTS_ACTIVE = "dl4j_tpu_alerts_active"
 
 
 def enabled() -> bool:
@@ -223,6 +228,23 @@ class Counter:
         with self._lock:
             return dict(self._values)
 
+    def remove_matching(self, label: str, value: str) -> int:
+        """Drop every label set where ``label == value`` (stale-series
+        expiry: a shut-down engine's gauges must not stay frozen at
+        their last reading forever). Returns the number dropped."""
+        with self._lock:
+            dead = [k for k in self._values
+                    if dict(k).get(label) == value]
+            for k in dead:
+                del self._values[k]
+            return len(dead)
+
+    def _capture(self) -> Dict[str, Any]:
+        """Point-in-time raw values for windowed evaluation
+        (profiler/slo.py)."""
+        with self._lock:
+            return {"kind": self.kind, "values": dict(self._values)}
+
     def _expose(self) -> List[str]:
         with self._lock:
             items = sorted(self._values.items())
@@ -245,35 +267,62 @@ class Gauge(Counter):
             self._values[_label_key(labels)] = float(v)
 
 
-class Histogram:
-    """Bounded-reservoir histogram: keeps the last ``max_samples``
-    observations per label set for percentile summaries, plus unbounded
-    count/sum accumulators. Exposed as a Prometheus summary (quantiles
-    are over the retained window, which is the operationally useful
-    view for step timings)."""
+#: default cumulative-bucket bounds (seconds — latency-shaped; +Inf is
+#: implicit). Shared by external scrapers and the SLO engine, so both
+#: compute the SAME quantile from the same bucket counts.
+DEFAULT_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                   0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
 
-    kind = "summary"
+
+class Histogram:
+    """Cumulative-bucket histogram with a bounded sample reservoir.
+
+    Exposed as a proper Prometheus ``histogram``: cumulative
+    ``_bucket{le=...}`` series (``+Inf`` = count) plus ``_sum`` /
+    ``_count`` — external scrapers run ``histogram_quantile()`` over
+    exactly the bucket counts the in-process SLO engine windows
+    (profiler/slo.py), so there is ONE quantile definition, not two.
+    The reservoir (last ``max_samples`` observations per label set)
+    additionally feeds the exact-percentile summaries in
+    ``percentiles()`` / the JSON dump, which dashboards read."""
+
+    kind = "histogram"
     QUANTILES = (0.5, 0.9, 0.99)
 
-    def __init__(self, name: str, help: str = "", max_samples: int = 2048):
+    def __init__(self, name: str, help: str = "", max_samples: int = 2048,
+                 buckets: Optional[Tuple[float, ...]] = None):
         self.name = name
         self.help = help
         self.max_samples = max_samples
+        self.bounds: Tuple[float, ...] = tuple(
+            sorted(buckets if buckets is not None else DEFAULT_BUCKETS))
         self._lock = threading.Lock()
         self._buf: Dict[Tuple, collections.deque] = {}
         self._count: Dict[Tuple, int] = collections.defaultdict(int)
         self._sum: Dict[Tuple, float] = collections.defaultdict(float)
+        #: per-label NON-cumulative bucket counts, len(bounds)+1 (the
+        #: last slot is the +Inf overflow); cumulated at exposure
+        self._buckets: Dict[Tuple, List[int]] = {}
+
+    def _bucket_index(self, v: float) -> int:
+        return bisect.bisect_left(self.bounds, v)
 
     def observe(self, v: float, **labels) -> None:
         key = _label_key(labels)
+        v = float(v)
         with self._lock:
             buf = self._buf.get(key)
             if buf is None:
                 buf = self._buf[key] = collections.deque(
                     maxlen=self.max_samples)
-            buf.append(float(v))
+                self._buckets[key] = [0] * (len(self.bounds) + 1)
+            buf.append(v)
             self._count[key] += 1
-            self._sum[key] += float(v)
+            self._sum[key] += v
+            # NaN never lands in a le-bound bucket (Prometheus drops it
+            # from _bucket too); it still counts in _count/_sum
+            if v == v:
+                self._buckets[key][self._bucket_index(v)] += 1
 
     def count(self, **labels) -> int:
         with self._lock:
@@ -290,17 +339,48 @@ class Histogram:
         return {f"p{int(q * 100)}": _percentile(vals, q)
                 for q in self.QUANTILES}
 
+    def remove_matching(self, label: str, value: str) -> int:
+        """Drop every label set where ``label == value`` (see
+        Counter.remove_matching)."""
+        with self._lock:
+            dead = [k for k in self._buf
+                    if dict(k).get(label) == value]
+            for k in dead:
+                del self._buf[k]
+                self._count.pop(k, None)
+                self._sum.pop(k, None)
+                self._buckets.pop(k, None)
+            return len(dead)
+
+    def _capture(self) -> Dict[str, Any]:
+        """Point-in-time (count, sum, bucket-counts) per label set for
+        windowed evaluation (profiler/slo.py). Bucket counts are the
+        NON-cumulative per-bucket tallies; windowed quantiles come from
+        their deltas between two captures."""
+        with self._lock:
+            return {"kind": self.kind, "bounds": self.bounds,
+                    "series": {k: (self._count[k], self._sum[k],
+                                   tuple(self._buckets.get(
+                                       k, (0,) * (len(self.bounds) + 1))))
+                               for k in self._buf}}
+
     def _expose(self) -> List[str]:
         out: List[str] = []
         with self._lock:
             keys = sorted(self._buf)
-            snap = {k: (sorted(self._buf[k]), self._count[k], self._sum[k])
+            snap = {k: (list(self._buckets.get(
+                            k, (0,) * (len(self.bounds) + 1))),
+                        self._count[k], self._sum[k])
                     for k in keys}
-        for k, (vals, cnt, tot) in snap.items():
-            for q in self.QUANTILES:
-                qk = k + (("quantile", f"{q:g}"),)
-                out.append(f"{self.name}{_fmt_labels(qk)} "
-                           f"{_fmt_value(_percentile(vals, q))}")
+        for k, (buckets, cnt, tot) in snap.items():
+            cum = 0
+            for bound, n in zip(self.bounds, buckets):
+                cum += n
+                bk = k + (("le", f"{bound:g}"),)
+                out.append(f"{self.name}_bucket{_fmt_labels(bk)} {cum}")
+            bk = k + (("le", "+Inf"),)
+            out.append(f"{self.name}_bucket{_fmt_labels(bk)} "
+                       f"{cum + buckets[-1]}")
             out.append(f"{self.name}_count{_fmt_labels(k)} {cnt}")
             out.append(f"{self.name}_sum{_fmt_labels(k)} {_fmt_value(tot)}")
         return out
@@ -356,9 +436,11 @@ class MetricsRegistry:
         return self._get(name, lambda: Gauge(name, help), "gauge")
 
     def histogram(self, name: str, help: str = "",
-                  max_samples: int = 2048) -> Histogram:
+                  max_samples: int = 2048,
+                  buckets: Optional[Tuple[float, ...]] = None) -> Histogram:
         return self._get(
-            name, lambda: Histogram(name, help, max_samples), "summary")
+            name, lambda: Histogram(name, help, max_samples, buckets),
+            "histogram")
 
     def peek(self, name: str):
         """The metric if it exists, else None — a read that never
@@ -366,6 +448,32 @@ class MetricsRegistry:
         empty series)."""
         with self._lock:
             return self._metrics.get(name)
+
+    def capture(self) -> Dict[str, Any]:
+        """Point-in-time raw capture of every metric — counters/gauges
+        as per-label values, histograms as (count, sum, bucket counts)
+        — the SLO engine's snapshot-ring unit (profiler/slo.py).
+        Each metric is captured under its own lock; the capture is
+        internally consistent per metric, not across metrics (windowed
+        deltas don't need cross-metric atomicity)."""
+        with self._lock:
+            metrics = list(self._metrics.items())
+        return {name: m._capture() for name, m in metrics}
+
+    def remove_matching(self, label: str, value: str,
+                        kinds: Optional[Tuple[str, ...]] = None) -> int:
+        """Drop every label set with ``label == value`` across all
+        metrics (optionally restricted to ``kinds``). Returns the
+        number of series removed — the stale-series expiry a dying
+        engine runs so its gauges don't haunt /metrics forever."""
+        with self._lock:
+            metrics = list(self._metrics.values())
+        n = 0
+        for m in metrics:
+            if kinds is not None and m.kind not in kinds:
+                continue
+            n += m.remove_matching(label, str(value))
+        return n
 
     def to_prometheus(self) -> str:
         """Prometheus text exposition format 0.0.4: every metric gets
@@ -507,6 +615,39 @@ def record_state_bytes(master_bytes: int, opt_bytes: int, mode: str,
     reg.gauge(OPT_STATE_BYTES,
               "per-device bytes of optimizer (updater) state"
               ).set(opt_bytes, mode=mode, site=site)
+
+
+#: engines whose per-engine series were retired at shutdown — keeps
+#: serving_snapshot()'s live-engine list honest while their COUNTERS
+#: (monotonic history) stay in the registry so fleet aggregates remain
+#: correct
+_retired_engines: set = set()
+_retired_lock = threading.Lock()
+
+
+def retire_engine_series(engine_id: str) -> int:
+    """Stale-series expiry for a shut-down decode engine: drop every
+    GAUGE series labelled ``engine=<id>`` (queue depth, slot occupancy,
+    KV-page utilization, shared/pinned pages — values that are only
+    meaningful for a LIVE engine and would otherwise stay frozen at
+    their last reading forever, poisoning ``serving_snapshot()``,
+    ``/metrics`` scrapes, and SLO threshold rules with ghost engines).
+    Counters and histograms are retained: they are cumulative history,
+    so fleet-level aggregates (requests served, latency distributions)
+    stay correct, and windowed SLO rules see zero deltas from a dead
+    engine — which is exactly 'no data', not a stuck value.
+    Idempotent. Returns the number of series dropped."""
+    eid = str(engine_id)
+    n = MetricsRegistry.get_default().remove_matching(
+        "engine", eid, kinds=("gauge",))
+    with _retired_lock:
+        _retired_engines.add(eid)
+    return n
+
+
+def retired_engines() -> frozenset:
+    with _retired_lock:
+        return frozenset(_retired_engines)
 
 
 def timed_batches(iterable):
@@ -845,6 +986,16 @@ def snapshot() -> Dict[str, Any]:
             out["jobs"] = js
     except Exception:
         pass
+    # SLO / alerting engine (lazy + peek-style: {} unless an SLOEngine
+    # is live in this process)
+    try:
+        from deeplearning4j_tpu.profiler import slo as _slo
+
+        al = _slo.alerts_snapshot()
+        if al:
+            out["alerts"] = al
+    except Exception:
+        pass
     return out
 
 
@@ -895,8 +1046,12 @@ def serving_snapshot() -> Dict[str, Any]:
     # indistinguishable series — now they are separable AND summed)
     req_c = reg.peek(SERVING_REQUESTS)
     if req_c is not None:
+        # live engines only: an engine retired at shutdown keeps its
+        # counters (history feeds the aggregates below) but drops out
+        # of the engine roster — ghost replicas must not look alive
+        retired = retired_engines()
         engines = sorted({dict(k).get("engine", "")
-                          for k in req_c.values()})
+                          for k in req_c.values()} - set(retired))
         agg: Dict[str, float] = {}
         for key, name in (("requests_total", SERVING_REQUESTS),
                           ("tokens_total", SERVING_TOKENS),
@@ -943,6 +1098,8 @@ def reset() -> None:
     clear_trace()
     with _trace_lock:
         _spans_dropped_pending = 0
+    with _retired_lock:
+        _retired_engines.clear()
     _mem_supported = None
 
 
@@ -954,7 +1111,9 @@ __all__ = [
     "instrument_jit", "sample_device_memory", "snapshot",
     "model_health_snapshot", "serving_snapshot", "reset",
     "enabled", "set_enabled", "record_on_device_batch",
-    "record_state_bytes", "MASTER_PARAM_BYTES", "OPT_STATE_BYTES",
+    "record_state_bytes", "retire_engine_series", "retired_engines",
+    "DEFAULT_BUCKETS",
+    "MASTER_PARAM_BYTES", "OPT_STATE_BYTES",
     "JIT_COMPILES", "JIT_COMPILE_SECONDS", "STEP_PHASE_SECONDS",
     "DEVICE_BYTES_IN_USE", "DEVICE_PEAK_BYTES",
     "PREFETCH_QUEUE_DEPTH", "TRANSFER_OVERLAP_MS",
@@ -981,11 +1140,12 @@ __all__ = [
     "SERVING_REJECTS", "SERVING_FLEET_ROUTED",
     "SERVING_FLEET_REROUTES", "SERVING_FLEET_REPLICAS",
     "SERVING_LANE_PREFILLS", "SERVING_LANE_SECONDS",
-    "SERVING_HANDOFF_SECONDS",
+    "SERVING_HANDOFF_SECONDS", "SERVING_FLEET_PRESSURE",
     "INFERENCE_REQUEST_LATENCY", "INFERENCE_QUEUE_DEPTH",
     "INFERENCE_BATCH_OCCUPANCY",
     "SPANS_DROPPED", "INCIDENT_DUMPS",
     "JOBS_SUBMITTED", "JOBS_FINISHED", "JOBS_RESTARTS",
     "JOBS_MIGRATIONS", "JOBS_RUNNING", "JOBS_DEVICES",
     "JOBS_THROUGHPUT", "JOBS_MFU", "JOBS_LATENCY_P50",
+    "ALERTS_TOTAL", "ALERTS_ACTIVE",
 ]

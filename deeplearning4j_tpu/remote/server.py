@@ -26,6 +26,9 @@ Endpoints (stdlib http.server, daemon thread):
                                   bursts -> finish (profiler/tracing)
     GET  /v1/jobs[/<id>]       -> control-plane job statuses (when a
                                   control.JobScheduler is live)
+    GET  /v1/alerts            -> SLO alert states + rule inventory
+                                  (when a profiler.slo.SLOEngine is
+                                  live)
     POST /v1/jobs              -> submit via a registered job factory
     POST /v1/jobs/<id>/cancel  -> cancel (train: checkpoint + exit;
          /v1/jobs/<id>/drain      serve: cancel in-flight + shutdown)
@@ -278,6 +281,11 @@ class _InferenceHandler(BaseHTTPRequestHandler):
             from deeplearning4j_tpu import control
 
             obj, code = control.http_jobs_get(path)
+            return self._json(obj, code)
+        if path == "/v1/alerts":
+            from deeplearning4j_tpu.profiler import slo
+
+            obj, code = slo.http_alerts()
             return self._json(obj, code)
         return self._json({"error": "not found"}, 404)
 

@@ -1215,6 +1215,14 @@ class DecodeEngine:
             self._sessions.clear(self.pool)
         if self._prefix is not None:
             self._prefix.clear(self.pool)
+        # stale-series expiry: this engine's gauges (queue depth, slot
+        # occupancy, KV utilization, shared/pinned pages) would stay
+        # frozen at their last value forever — drop them so
+        # serving_snapshot(), /metrics, and SLO rules never evaluate a
+        # ghost engine. Counters/histograms stay: cumulative history
+        # keeps fleet aggregates correct. LAST in shutdown — the abort
+        # pass above still updates the queue-depth gauge.
+        _telemetry.retire_engine_series(self.engine_id)
 
     def __enter__(self) -> "DecodeEngine":
         return self.start()

@@ -71,6 +71,11 @@ from deeplearning4j_tpu.serving.engine import (
     prefill_forward,
 )
 
+#: process-wide fleet ordinals — the ``fleet=<id>`` label on the
+#: queue-pressure gauge (and the key the control plane's SLO-driven
+#: scale-up matches alerts back to a ServeJob with)
+_FLEET_IDS = itertools.count()
+
 
 # ---------------------------------------------------------------- client
 class FleetRequest:
@@ -431,6 +436,7 @@ class ServingFleet:
             raise ValueError("need at least one replica")
         engine_kwargs.setdefault("max_queue", max(256, max_queue))
         self.model = model
+        self.fleet_id = f"fleet-{next(_FLEET_IDS)}"
         self.prefill_threshold = prefill_threshold
         #: the exact per-engine config, kept verbatim so
         #: restart_replica builds an identical engine (reverse-
@@ -480,6 +486,7 @@ class ServingFleet:
         self.n_reroutes = 0
         self._routed: Dict[str, int] = {}
         self._stats_lock = threading.Lock()
+        self._last_pressure_t = 0.0     # gauge-publish throttle
 
     # ------------------------------------------------------- lifecycle
     def start(self) -> "ServingFleet":
@@ -522,6 +529,10 @@ class ServingFleet:
         for r in self._replicas:
             r.engine.shutdown(timeout)
         self._gauge_replicas()
+        # the pressure gauge is only meaningful for a LIVE fleet —
+        # same stale-series discipline as the per-engine gauges
+        _telemetry.MetricsRegistry.get_default().remove_matching(
+            "fleet", self.fleet_id, kinds=("gauge",))
 
     def __enter__(self) -> "ServingFleet":
         return self.start()
@@ -728,8 +739,26 @@ class ServingFleet:
                                   "hook"))
 
     # ----------------------------------------------------------- router
+    def _gauge_pressure(self) -> None:
+        """Publish queue_pressure() as a gauge (throttled): the
+        continuous signal the SLO engine's ``serving_queue_pressure``
+        rule windows — sustained pressure (not one busy poll) is what
+        fires the scheduler's scale-up hook."""
+        if not _telemetry.enabled():
+            return
+        now = time.monotonic()
+        if now - self._last_pressure_t < 0.25:
+            return
+        self._last_pressure_t = now
+        _telemetry.MetricsRegistry.get_default().gauge(
+            _telemetry.SERVING_FLEET_PRESSURE,
+            "fleet admission pressure: queued work per live decode "
+            "slot (~0 idle, >=1 a full slot-generation waiting)").set(
+            self.queue_pressure(), fleet=self.fleet_id)
+
     def _route_loop(self) -> None:
         while True:
+            self._gauge_pressure()
             try:
                 item = self._queue.get(timeout=0.05)
             except _queue.Empty:

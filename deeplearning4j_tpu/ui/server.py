@@ -16,6 +16,9 @@ so no CDN scripts). Endpoints:
     POST /v1/jobs[...]                      -> submit (registered
                                                factory) / cancel /
                                                drain / kill_worker
+    GET /v1/alerts                          -> SLO alert states + rule
+                                               inventory (live
+                                               profiler.slo.SLOEngine)
     GET /train/<sid>/overview               -> score curve, rates, memory
     GET /train/<sid>/model                  -> static info + latest layer stats
     GET /metrics                            -> Prometheus text exposition
@@ -106,6 +109,8 @@ _DASHBOARD_HTML = """<!doctype html>
 <div class="card"><b>Incidents (flight recorder)</b>
  <pre id="incidents"></pre></div>
 </div>
+<div class="card"><b>Alerts (SLO engine)</b>
+ <pre id="alerts"></pre></div>
 <script>
 async function j(u){const r=await fetch(u);return r.json()}
 function pick(o,lk){if(!lk)return null;if(o[lk])return o[lk];
@@ -150,11 +155,26 @@ async function serving(){
  if(telemSkip>0){telemSkip--;return}
  const t=await j('/telemetry');
  const M=t.metrics||{},sn=t.snapshot||{},s=sn.serving;
- const tr=sn.tracing,fl=sn.flight_recorder;
+ const tr=sn.tracing,fl=sn.flight_recorder,al=sn.alerts;
  // back off to ~30s polls while the process has no serving engine,
- // no tracing and no flight events — /telemetry copies the full
- // trace buffer server-side, so idle dashboards should poll gently
- if(!s&&!tr&&!fl)telemSkip=14;
+ // no tracing, no flight events and no SLO engine — /telemetry
+ // copies the full trace buffer server-side, so idle dashboards
+ // should poll gently
+ if(!s&&!tr&&!fl&&!al)telemSkip=14;
+ const alEl=document.getElementById('alerts');
+ if(!al)alEl.textContent=
+  '(no SLO engine — profiler.slo.SLOEngine(slo.default_rules()))';
+ else{
+  const line=a=>a.rule+JSON.stringify(a.labels||{})+' '+
+   a.state.toUpperCase()+' ['+a.severity+'] value='+fmt(a.value)+
+   (a.incident_dump?' dump='+a.incident_dump:'');
+  const rows=(al.firing||[]).map(line).concat(
+   (al.pending||[]).map(line));
+  const hist=(al.recent||[]).map(h=>h.rule+': '+h.from+' -> '+h.to);
+  alEl.textContent=al.rules+' rules, '+al.ticks+' evaluations'+
+   '\\n'+(rows.length?rows.join('\\n'):'(nothing pending or firing)')+
+   (hist.length?'\\n--- recent transitions ---\\n'+
+    hist.join('\\n'):'')}
  const rq=document.getElementById('requests');
  if(!tr)rq.textContent=
   '(tracing off — DL4J_TPU_TRACING=1 or tracing.set_enabled(True))';
@@ -311,6 +331,11 @@ class _Handler(BaseHTTPRequestHandler):
             from deeplearning4j_tpu import control
 
             obj, code = control.http_jobs_get("/" + "/".join(parts))
+            return self._json(obj, code)
+        if parts[0] == "v1" and len(parts) == 2 and parts[1] == "alerts":
+            from deeplearning4j_tpu.profiler import slo
+
+            obj, code = slo.http_alerts()
             return self._json(obj, code)
         if parts[0] != "train":
             return self._json({"error": "not found"}, 404)

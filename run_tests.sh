@@ -1576,4 +1576,234 @@ if [ $ctlgate -ne 0 ]; then
     echo "FATAL: control-plane chaos gate regressed" >&2
     exit 1
 fi
+# SLO smoke gate (docs/OBSERVABILITY.md "Alerting and SLOs"): the
+# end-to-end alerting drill. A 2-replica serving fleet under a
+# JobScheduler runs with the SLO engine's p99 burn-rate + queue-
+# pressure rules; a chaos-injected latency spike (chaos.hang_replica)
+# must drive the burn-rate alert pending -> firing -> resolved within
+# its fast window, the firing transition must appear in /v1/alerts,
+# the flight recorder, and dl4j_tpu_alerts_total{state="firing"}, the
+# page severity must leave a digest-valid incident dump, a sustained
+# queue-pressure alert must make the scheduler restart a drained
+# replica (the alert-driven scale-up), and SLO-off serving must stay
+# token-identical with zero evaluator threads.
+SLO_DIR=$(mktemp -d /tmp/dl4j_slo_gate.XXXXXX)
+env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu DL4J_TPU_TELEMETRY=1 \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    DL4J_SLO_GATE_DIR="$SLO_DIR" \
+    python - <<'EOF'
+import json
+import os
+import sys
+import threading
+import time
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu import control
+from deeplearning4j_tpu.models.gpt import CausalLM
+from deeplearning4j_tpu.models.transformer import tiny_config
+from deeplearning4j_tpu.profiler import (
+    chaos, flight_recorder, slo, telemetry,
+)
+from deeplearning4j_tpu.serving import ServingFleet
+from deeplearning4j_tpu.ui.server import UIServer
+
+FLIGHT = os.environ["DL4J_SLO_GATE_DIR"]
+fail = []
+
+cfg = tiny_config(vocab=17, max_len=48, d_model=32, n_layers=2,
+                  n_heads=4, d_ff=64)
+cfg.dropout = 0.0
+m = CausalLM(cfg, compute_dtype=jnp.float32)
+params = m.init_params(jax.random.key(1))
+rng = np.random.default_rng(0)
+prompts = [rng.integers(0, 17, (int(rng.integers(3, 12)),)).astype(
+    np.int32) for _ in range(6)]
+solo = {i: np.asarray(m.generate(
+    params, jnp.asarray(p[None, :], jnp.int32), 3))[0]
+    for i, p in enumerate(prompts)}
+devs = jax.devices()[:2]
+reg = telemetry.MetricsRegistry.get_default()
+
+TARGET = 0.25        # aligned to a DEFAULT_BUCKETS bound
+eng = slo.SLOEngine(
+    [slo.BurnRate("serving_p99_burn", severity="page",
+                  histogram=telemetry.SERVING_REQUEST_LATENCY,
+                  target_s=TARGET, objective=0.95, factor=2.0,
+                  fast_window_s=2.0, slow_window_s=5.0,
+                  for_s=1.0, group_by=()),
+     slo.Threshold("serving_queue_pressure",
+                   metric=telemetry.SERVING_FLEET_PRESSURE,
+                   bound=1.0, op=">", for_s=0.5,
+                   action="scale_serve")],
+    interval_s=0.2, flight_dir=FLIGHT)
+eng.start()
+sched = control.JobScheduler(devices=devs,
+                             workers={"w0": devs[:1], "w1": devs[1:]},
+                             slo=eng, rebalance=False,
+                             make_default=False).start()
+job = sched.submit(control.ServeJob(
+    lambda ctx: ServingFleet(m, params, devices=ctx.devices, slots=2,
+                             page_size=8, prefill_buckets=[16],
+                             max_chunk=4),
+    chips=2, min_chips=1))
+sched.wait(job.job_id, timeout=120, states=("running",))
+deadline = time.monotonic() + 30
+while job.fleet is None and time.monotonic() < deadline:
+    time.sleep(0.02)
+fl = job.fleet
+
+def traffic(seconds, concurrency=2):
+    """Steady short requests; returns [(prompt_idx, tokens)]."""
+    out, stop = [], time.monotonic() + seconds
+    with ThreadPoolExecutor(max_workers=concurrency) as ex:
+        while time.monotonic() < stop:
+            futs = [(i, ex.submit(fl.generate, prompts[i], 3))
+                    for i in (0, 1, 2)]
+            for i, f in futs:
+                out.append((i, f.result(timeout=120)))
+            time.sleep(0.05)
+    return out
+
+# ---- phase 1: warm history (ring must span the slow window), and
+# with the SLO engine ON, greedy outputs stay token-identical --------
+for i, got in traffic(6.0):
+    if not np.array_equal(got, solo[i]):
+        fail.append(f"SLO-on output differs from solo for prompt {i}")
+        break
+if eng.alert_state("serving_p99_burn") != "inactive":
+    fail.append("burn alert not inactive under healthy traffic "
+                f"({eng.alert_state('serving_p99_burn')})")
+
+# ---- phase 2: chaos latency spike -> pending -> firing -------------
+saw = set()
+for r in fl._replicas:
+    chaos.hang_replica(r.engine, 3.0)
+with ThreadPoolExecutor(max_workers=8) as ex:
+    futs = [ex.submit(fl.generate, prompts[i % 6], 3)
+            for i in range(8)]
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        saw.add(eng.alert_state("serving_p99_burn"))
+        if "firing" in saw:
+            break
+        time.sleep(0.03)
+    for f in futs:
+        f.result(timeout=120)
+if "pending" not in saw or "firing" not in saw:
+    fail.append(f"burn alert lifecycle incomplete: saw {sorted(saw)} "
+                "(wanted pending AND firing)")
+
+# firing is visible on every surface
+if reg.counter(telemetry.ALERTS_TOTAL).value(
+        rule="serving_p99_burn", state="firing") < 1:
+    fail.append("dl4j_tpu_alerts_total{state=firing} did not count")
+ev = [e for e in flight_recorder.get_default().events()
+      if e["kind"] == "alert" and e["rule"] == "serving_p99_burn"
+      and e["state"] == "firing"]
+if not ev:
+    fail.append("no flight-recorder event for the firing transition")
+# the dump is written in the tick's unlocked phase AFTER the state
+# flips to firing — poll, never assert it exists the instant the
+# alert is visible (same discipline as watchdog dumps)
+dumps, deadline = [], time.monotonic() + 10
+while not dumps and time.monotonic() < deadline:
+    dumps = [d for d in flight_recorder.list_dumps(FLIGHT)
+             if "slo_page" in d]
+    time.sleep(0.05)
+if not dumps:
+    fail.append(f"page severity left no incident dump in {FLIGHT}")
+else:
+    loaded = flight_recorder.load_dump(dumps[-1])
+    if not loaded["valid"]:
+        fail.append("slo_page incident dump failed digest check")
+ui = UIServer()
+port = ui.start(port=0)
+try:
+    body = json.loads(urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/v1/alerts", timeout=10).read())
+    rows = [a for a in body["alerts"]
+            if a["rule"] == "serving_p99_burn"]
+    if not rows or rows[0]["state"] not in ("firing", "resolved"):
+        fail.append(f"/v1/alerts does not show the burn alert: "
+                    f"{body['alerts']}")
+finally:
+    ui.stop()
+
+# ---- phase 3: recovery traffic drains the fast window -> resolved --
+deadline = time.monotonic() + 30
+while eng.alert_state("serving_p99_burn") != "resolved" \
+        and time.monotonic() < deadline:
+    traffic(0.4)
+if eng.alert_state("serving_p99_burn") != "resolved":
+    fail.append("burn alert did not resolve after recovery "
+                f"({eng.alert_state('serving_p99_burn')})")
+
+# ---- phase 4: sustained queue pressure -> scheduler scale-up -------
+fl.drain_replica(1)
+deadline = time.monotonic() + 15
+while sched.devices.free == 0 and time.monotonic() < deadline:
+    time.sleep(0.02)
+if sched.devices.free != 1:
+    fail.append("drained replica's chip never returned to the pool")
+chaos.hang_replica(fl._replicas[0].engine, 2.5)
+with ThreadPoolExecutor(max_workers=12) as ex:
+    futs = [ex.submit(fl.generate, prompts[i % 6], 2)
+            for i in range(12)]
+    deadline = time.monotonic() + 60
+    while fl.alive_replicas() < 2 and time.monotonic() < deadline:
+        time.sleep(0.05)
+    for f in futs:
+        f.result(timeout=120)
+if fl.alive_replicas() != 2:
+    fail.append("scheduler did not restart the drained replica on "
+                "the queue-pressure alert")
+elif reg.counter(telemetry.JOBS_RESTARTS).value(
+        job=job.job_id, reason="queue_pressure_alert") < 1:
+    fail.append("scale-up restart not counted under "
+                "reason=queue_pressure_alert")
+
+sched.shutdown()
+eng.shutdown()
+
+# ---- phase 5: SLO-off mode — token-identical, zero extra threads ---
+with ServingFleet(m, params, replicas=1, slots=2, page_size=8,
+                  prefill_buckets=[16], max_chunk=4) as off_fl:
+    for i in (0, 3, 5):
+        got = off_fl.generate(prompts[i], 3)
+        if not np.array_equal(got, solo[i]):
+            fail.append(f"SLO-off output differs from solo for "
+                        f"prompt {i}")
+            break
+    if any(t.name == "SLOEvaluator" for t in threading.enumerate()
+           if t.is_alive()):
+        fail.append("SLOEvaluator thread alive in SLO-off mode")
+leaked = [t.name for t in threading.enumerate()
+          if t.is_alive() and t.name.startswith(
+              ("SLOEvaluator", "JobScheduler", "JobRunner",
+               "ServingEngine", "ServingFleetRouter"))]
+if leaked:
+    fail.append(f"threads survived shutdown: {leaked}")
+
+if fail:
+    sys.stderr.write("SLO gate FAILED:\n  " + "\n  ".join(fail) + "\n")
+    sys.exit(1)
+print("SLO gate OK: chaos latency spike drove serving_p99_burn "
+      "pending -> firing -> resolved (flight event, alerts_total, "
+      "/v1/alerts, digest-valid slo_page dump), queue-pressure alert "
+      "restarted the drained replica, SLO-off serving token-identical "
+      "with zero evaluator threads")
+EOF
+slogate=$?
+rm -rf "$SLO_DIR"
+if [ $slogate -ne 0 ]; then
+    echo "FATAL: SLO smoke gate regressed" >&2
+    exit 1
+fi
+
 exit $rc

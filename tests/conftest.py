@@ -81,7 +81,8 @@ def pytest_sessionfinish(session, exitstatus):
                                        "ServingFleetRouter",
                                        "ServingPrefillLane",
                                        "JobScheduler",
-                                       "JobRunner")))
+                                       "JobRunner",
+                                       "SLOEvaluator")))
         ]
 
     deadline = time.time() + 2.0

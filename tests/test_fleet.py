@@ -518,3 +518,75 @@ class TestFleetTelemetry:
         for key in ("fleet_routed", "fleet_live_replicas",
                     "lane_prefills", "handoff_seconds"):
             assert key in snap, key
+
+    @pytest.mark.slow
+    def test_killed_replica_series_retired(self, model, params):
+        """Stale-series regression (PR 14): a killed replica's
+        engine-labelled GAUGE series must disappear (no ghost engine
+        frozen at its last reading for serving_snapshot(), /metrics,
+        or SLO rules to evaluate) while the fleet's cumulative
+        aggregates keep its history."""
+        reg = telemetry.MetricsRegistry.get_default()
+        rng = np.random.default_rng(3)
+        with _fleet(model, params, replicas=2) as fl:
+            for _ in range(4):
+                fl.generate(rng.integers(0, VOCAB, (6,)).astype(
+                    np.int32), 3)
+            agg_before = telemetry.serving_snapshot()[
+                "aggregate"]["requests_total"]
+            victim = fl._replicas[0]
+            vid = victim.engine.engine_id
+            # the victim served traffic: its gauges exist pre-kill
+            assert any(dict(k).get("engine") == vid for k in reg.gauge(
+                telemetry.SERVING_KV_PAGE_UTILIZATION).values())
+            fl.kill_replica(0)
+            deadline = time.monotonic() + 10
+            while (victim.alive or victim.needs_cleanup) \
+                    and time.monotonic() < deadline:
+                time.sleep(0.02)     # router health pass cleans up
+            assert not victim.alive and not victim.needs_cleanup
+            # every gauge series of the dead engine is gone...
+            for name in (telemetry.SERVING_KV_PAGE_UTILIZATION,
+                         telemetry.SERVING_QUEUE_DEPTH,
+                         telemetry.SERVING_SLOT_OCCUPANCY):
+                m = reg.peek(name)
+                if m is not None:
+                    assert not any(
+                        dict(k).get("engine") == vid
+                        for k in m.values()), name
+            # ...and /metrics stops exposing it
+            assert f'engine="{vid}"' not in "\n".join(
+                line for line in reg.to_prometheus().splitlines()
+                if line.startswith(("dl4j_tpu_serving_kv",
+                                    "dl4j_tpu_serving_queue_depth",
+                                    "dl4j_tpu_serving_slot")))
+            snap = telemetry.serving_snapshot()
+            assert vid not in snap["engines"]
+            # fleet aggregates stay correct: the dead engine's served
+            # requests still count
+            assert snap["aggregate"]["requests_total"] == agg_before
+            # the survivor still serves and its series stay live
+            sid = fl._replicas[1].engine.engine_id
+            fl.generate(rng.integers(0, VOCAB, (6,)).astype(
+                np.int32), 3)
+            assert sid in telemetry.serving_snapshot()["engines"]
+
+    @pytest.mark.slow
+    def test_fleet_pressure_gauge_published_and_retired(self, model,
+                                                        params):
+        reg = telemetry.MetricsRegistry.get_default()
+        with _fleet(model, params, replicas=1) as fl:
+            fl.generate(np.asarray([1, 2, 3], np.int32), 3)
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline:
+                series = reg.gauge(
+                    telemetry.SERVING_FLEET_PRESSURE).values()
+                if (("fleet", fl.fleet_id),) in series:
+                    break
+                time.sleep(0.05)
+            assert (("fleet", fl.fleet_id),) in reg.gauge(
+                telemetry.SERVING_FLEET_PRESSURE).values()
+            fid = fl.fleet_id
+        # shutdown retires the fleet's pressure series
+        assert (("fleet", fid),) not in reg.gauge(
+            telemetry.SERVING_FLEET_PRESSURE).values()

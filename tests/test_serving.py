@@ -466,28 +466,38 @@ class TestServingTelemetry:
             eid = eng.engine_id      # fresh per engine: counts start 0
             eng.generate(np.asarray([1, 2, 3], np.int32), 5)
             eng.generate(np.asarray([4, 5], np.int32), 3)
+            occ = reg.gauge(telemetry.SERVING_SLOT_OCCUPANCY).value(
+                engine=eid)
+            assert 0 <= occ <= 1
+            # all pages freed -> utilization gauge back to 0
+            assert reg.gauge(
+                telemetry.SERVING_KV_PAGE_UTILIZATION).value(
+                engine=eid) == 0.0
+            snap = telemetry.serving_snapshot()
+            for key in ("request_latency", "ttft", "slot_occupancy",
+                        "queue_depth", "kv_page_utilization",
+                        "tokens_total"):
+                assert key in snap, key
+            # per-engine label sets fold into fleet-level aggregates
+            assert eid in snap["engines"]
+            assert snap["aggregate"]["requests_total"] >= 2
+            assert "serving" in telemetry.snapshot()
+        # cumulative history survives shutdown...
         lat = reg.histogram(telemetry.SERVING_REQUEST_LATENCY)
         assert lat.count(reason="length", engine=eid) == 2
         pct = lat.percentiles(reason="length", engine=eid)
         assert pct["p50"] > 0 and pct["p99"] >= pct["p50"]
         assert reg.histogram(telemetry.SERVING_TTFT).count(
             engine=eid) == 2
-        occ = reg.gauge(telemetry.SERVING_SLOT_OCCUPANCY).value(
-            engine=eid)
-        assert 0 <= occ <= 1
-        # all pages freed -> utilization gauge back to 0
-        assert reg.gauge(
-            telemetry.SERVING_KV_PAGE_UTILIZATION).value(
-            engine=eid) == 0.0
+        # ...but the engine's GAUGE series are retired (stale-series
+        # expiry: no ghost engine frozen at its last reading) and it
+        # leaves the live-engine roster while aggregates keep its
+        # traffic
         snap = telemetry.serving_snapshot()
-        for key in ("request_latency", "ttft", "slot_occupancy",
-                    "queue_depth", "kv_page_utilization",
-                    "tokens_total"):
-            assert key in snap, key
-        # per-engine label sets fold into fleet-level aggregates
-        assert eid in snap["engines"]
+        assert eid not in snap["engines"]
         assert snap["aggregate"]["requests_total"] >= 2
-        assert "serving" in telemetry.snapshot()
+        occ_series = reg.gauge(telemetry.SERVING_SLOT_OCCUPANCY).values()
+        assert (("engine", eid),) not in occ_series
 
     def test_two_engines_are_distinguishable_series(self, model,
                                                     params):

@@ -1,0 +1,913 @@
+"""SLO engine (profiler/slo.py): windowed evaluation, rule types,
+alert lifecycle (pending -> firing -> resolved, flap suppression,
+counter-reset clamp, empty windows), burn-rate math, action hooks,
+the built-in rule pack, HTTP surfaces, the control plane's
+alert-driven serve scale-up, and bench_compare's round diff."""
+
+import json
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.profiler import (
+    flight_recorder, slo, telemetry,
+)
+
+
+def _engine(rules, **kw):
+    kw.setdefault("registry", telemetry.MetricsRegistry())
+    kw.setdefault("make_default", False)
+    return slo.SLOEngine(rules, **kw)
+
+
+# ---------------------------------------------------------------- math
+class TestHistogramQuantile:
+    def test_interpolates_inside_bucket(self):
+        # 10 samples all in (0.1, 0.5]: p50 at the bucket midpoint
+        q = slo.histogram_quantile((0.1, 0.5, 1.0), (0, 10, 0, 0), 0.5)
+        assert q == pytest.approx(0.3)
+
+    def test_empty_window_is_none(self):
+        assert slo.histogram_quantile((0.1, 0.5), (0, 0, 0), 0.99) \
+            is None
+
+    def test_inf_bucket_clamps_to_top_bound(self):
+        q = slo.histogram_quantile((0.1, 0.5), (0, 0, 10), 0.9)
+        assert q == 0.5
+
+    def test_matches_bucket_boundaries(self):
+        # 50 fast + 50 slow: p99 lands in the slow bucket
+        q = slo.histogram_quantile((0.1, 1.0), (50, 0, 50), 0.99)
+        assert q == 1.0
+        q50 = slo.histogram_quantile((0.1, 1.0), (50, 50, 0), 0.25)
+        assert 0 < q50 <= 0.1
+
+
+# ------------------------------------------------------------ threshold
+class TestThresholdLifecycle:
+    def test_pending_firing_resolved(self):
+        eng = _engine([slo.Threshold("hot", metric="g", bound=0.9,
+                                     op=">", for_s=2.0)])
+        g = eng.registry.gauge("g")
+        g.set(0.5)
+        eng.tick(now=0.0)
+        assert eng.alerts() == []
+        g.set(0.95)
+        eng.tick(now=1.0)
+        assert eng.alert_state("hot") == "pending"
+        eng.tick(now=2.0)           # 1s pending: for_s not served
+        assert eng.alert_state("hot") == "pending"
+        eng.tick(now=3.5)
+        assert eng.alert_state("hot") == "firing"
+        g.set(0.1)
+        eng.tick(now=4.0)
+        assert eng.alert_state("hot") == "resolved"
+        # re-breach: the same alert object re-enters the lifecycle
+        g.set(0.99)
+        eng.tick(now=5.0)
+        assert eng.alert_state("hot") == "pending"
+
+    def test_flapping_never_fires(self):
+        """A pending alert whose condition clears before for_s is
+        SUPPRESSED: no firing transition, ever."""
+        eng = _engine([slo.Threshold("flap", metric="g", bound=1.0,
+                                     op=">", for_s=10.0)])
+        g = eng.registry.gauge("g")
+        for i in range(5):          # breach for 2s, clear for 2s, ...
+            g.set(2.0)
+            eng.tick(now=i * 4.0)
+            eng.tick(now=i * 4.0 + 2.0 - 0.01)
+            g.set(0.0)
+            eng.tick(now=i * 4.0 + 2.0)
+        c = eng.registry.counter(telemetry.ALERTS_TOTAL)
+        assert c.value(rule="flap", state="firing") == 0
+        assert c.value(rule="flap", state="pending") == 5
+        assert c.value(rule="flap", state="suppressed") == 5
+        assert eng.alert_state("flap") == "inactive"
+
+    def test_for_s_zero_fires_immediately(self):
+        eng = _engine([slo.Threshold("now", metric="g", bound=1.0,
+                                     op=">")])
+        eng.registry.gauge("g").set(5.0)
+        eng.tick(now=0.0)
+        assert eng.alert_state("now") == "firing"
+
+    def test_below_bound_op(self):
+        eng = _engine([slo.Threshold("low", metric="g", bound=0.1,
+                                     op="<")])
+        g = eng.registry.gauge("g")
+        g.set(0.5)
+        eng.tick(now=0.0)
+        assert eng.alert_state("low") == "inactive"
+        g.set(0.01)
+        eng.tick(now=1.0)
+        assert eng.alert_state("low") == "firing"
+
+    def test_per_labelset_dedup(self):
+        """Each label set is its own alert; a condition that stays
+        breached keeps ONE firing alert (no re-fire per tick)."""
+        eng = _engine([slo.Threshold("hot", metric="g", bound=1.0,
+                                     op=">")])
+        g = eng.registry.gauge("g")
+        g.set(2.0, engine="a")
+        g.set(0.5, engine="b")
+        for i in range(5):
+            eng.tick(now=float(i))
+        firing = eng.alerts(states=("firing",))
+        assert len(firing) == 1
+        assert firing[0].labels == {"engine": "a"}
+        c = eng.registry.counter(telemetry.ALERTS_TOTAL)
+        assert c.value(rule="hot", state="firing") == 1
+
+    def test_vanished_series_resolves(self):
+        """Stale-series expiry composes with alerting: when a dead
+        engine's gauge series is removed, its firing alert resolves
+        instead of firing forever."""
+        eng = _engine([slo.Threshold("hot", metric="g", bound=1.0,
+                                     op=">")])
+        g = eng.registry.gauge("g")
+        g.set(2.0, engine="dead")
+        eng.tick(now=0.0)
+        assert eng.alert_state("hot", engine="dead") == "firing"
+        eng.registry.remove_matching("engine", "dead")
+        eng.tick(now=1.0)
+        assert eng.alert_state("hot", engine="dead") == "resolved"
+        # within RESOLVED_RETENTION the resolved entry stays visible
+        # (drills and operators poll alert_state right after recovery)
+        eng.tick(now=2.0)
+        assert eng.alert_state("hot", engine="dead") == "resolved"
+        # still dark past retention: pruned (engine-id churn must not
+        # grow the alert table forever); the record lives in history
+        eng.tick(now=1.0 + slo.SLOEngine.RESOLVED_RETENTION + 1.0)
+        assert eng.alert_state("hot", engine="dead") == "inactive"
+        assert not eng.alerts()
+        hist = eng.alerts_json()["history"]
+        assert [h["to"] for h in hist
+                if h["rule"] == "hot"] == ["firing", "resolved"]
+
+    def test_quantile_threshold_windowed(self):
+        reg = telemetry.MetricsRegistry()
+        h = reg.histogram("lat", buckets=(0.1, 0.5, 1.0))
+        eng = _engine([slo.Threshold(
+            "p99", metric="lat", quantile=0.99, window_s=10.0,
+            bound=0.5, op=">", group_by=())], registry=reg)
+        eng.tick(now=0.0)
+        for _ in range(100):
+            h.observe(0.05)
+        eng.tick(now=10.0)
+        assert eng.alert_state("p99") == "inactive"
+        for _ in range(30):          # 30% now slow: p99 over 0.5s
+            h.observe(2.0)
+        eng.tick(now=20.0)
+        assert eng.alert_state("p99") == "firing"
+
+    def test_empty_window_evaluates_nothing(self):
+        """Zero samples in the window: the rule does NOT evaluate —
+        no alert appears, and quantiles never read the stale
+        reservoir."""
+        reg = telemetry.MetricsRegistry()
+        h = reg.histogram("lat", buckets=(0.1, 0.5))
+        h.observe(9.9)               # old slow sample, pre-history
+        eng = _engine([slo.Threshold(
+            "p99", metric="lat", quantile=0.99, window_s=5.0,
+            bound=0.5, op=">", group_by=())], registry=reg)
+        eng.tick(now=0.0)
+        eng.tick(now=5.0)            # window delta = 0 samples
+        eng.tick(now=10.0)
+        assert eng.alerts() == []
+
+
+# ----------------------------------------------------------------- rate
+class TestRateRule:
+    def test_rate_over_window(self):
+        eng = _engine([slo.Rate("r", metric="c", bound=1.0,
+                                window_s=10.0, group_by=())])
+        c = eng.registry.counter("c")
+        eng.tick(now=0.0)
+        c.inc(5)
+        eng.tick(now=10.0)           # 0.5/s: under bound
+        assert eng.alert_state("r") == "inactive"
+        c.inc(50)
+        eng.tick(now=20.0)           # 5/s
+        assert eng.alert_state("r") == "firing"
+
+    def test_counter_reset_clamps_at_zero(self):
+        """An engine restart zeroes its counters; the windowed rate
+        must clamp at 0, never go negative (and the alert must
+        resolve, not wedge)."""
+        eng = _engine([slo.Rate("r", metric="c", bound=1.0,
+                                window_s=10.0, group_by=())])
+        c = eng.registry.counter("c")
+        c.inc(100)
+        eng.tick(now=0.0)
+        c.inc(100)
+        eng.tick(now=10.0)
+        assert eng.alert_state("r") == "firing"
+        # restart: the series starts over at a LOWER value
+        with c._lock:
+            c._values.clear()
+        c.inc(1)
+        eng.tick(now=20.0)
+        a = [x for x in eng.alerts() if x.rule == "r"][0]
+        assert a.state == "resolved"
+        assert a.value == 0.0        # clamped, not -19.9/s
+
+    def test_not_enough_history_never_fires(self):
+        eng = _engine([slo.Rate("r", metric="c", bound=0.0,
+                                window_s=60.0, group_by=())])
+        c = eng.registry.counter("c")
+        c.inc(100)
+        eng.tick(now=0.0)
+        c.inc(100)
+        eng.tick(now=5.0)            # only 5s of history for a 60s rule
+        assert eng.alerts() == []
+
+
+# ------------------------------------------------------------ burn rate
+class TestBurnRate:
+    def _hist_engine(self, **kw):
+        reg = telemetry.MetricsRegistry()
+        h = reg.histogram("lat", buckets=(0.1, 0.5, 1.0))
+        kw.setdefault("factor", 4.0)
+        eng = _engine([slo.BurnRate(
+            "burn", histogram="lat", target_s=0.5, objective=0.99,
+            fast_window_s=5.0, slow_window_s=10.0, group_by=(),
+            **kw)], registry=reg)
+        return eng, h
+
+    def test_fires_when_both_windows_burn(self):
+        eng, h = self._hist_engine()
+        eng.tick(now=0.0)
+        for _ in range(20):
+            h.observe(2.0)           # 100% over target: burn = 100x
+        eng.tick(now=5.0)
+        for _ in range(20):
+            h.observe(2.0)
+        eng.tick(now=10.0)
+        assert eng.alert_state("burn") == "firing"
+        a = eng.alerts(states=("firing",))[0]
+        assert a.value > 4.0
+
+    def test_fast_window_recovery_resolves(self):
+        """min(fast, slow): the fast window un-pages promptly after
+        recovery even while the slow window still burns."""
+        eng, h = self._hist_engine()
+        eng.tick(now=0.0)
+        for _ in range(20):
+            h.observe(2.0)
+        eng.tick(now=5.0)
+        for _ in range(20):
+            h.observe(2.0)
+        eng.tick(now=10.0)
+        assert eng.alert_state("burn") == "firing"
+        for _ in range(200):
+            h.observe(0.05)          # healthy traffic floods fast win
+        eng.tick(now=15.0)
+        assert eng.alert_state("burn") == "resolved"
+
+    def test_slow_healthy_history_prevents_spike_page(self):
+        """A short spike that the slow window dilutes below factor
+        never fires — the multi-window guard against paging on one
+        bad burst."""
+        eng, h = self._hist_engine(factor=30.0)
+        eng.tick(now=0.0)
+        for _ in range(960):
+            h.observe(0.05)          # long healthy history
+        eng.tick(now=5.0)
+        for _ in range(10):
+            h.observe(2.0)           # brief spike (1% of slow window)
+        eng.tick(now=10.0)
+        assert eng.alert_state("burn") in ("inactive",)
+
+    def test_counter_mode_error_ratio(self):
+        reg = telemetry.MetricsRegistry()
+        errs = reg.counter("errs")
+        total = reg.counter("total")
+        eng = _engine([slo.BurnRate(
+            "errors", numerator="errs", denominator="total",
+            objective=0.999, fast_window_s=5.0, slow_window_s=10.0,
+            factor=4.0, group_by=())], registry=reg)
+        eng.tick(now=0.0)
+        total.inc(100)
+        errs.inc(2)                  # 2% vs 0.1% budget: burn 20x
+        eng.tick(now=5.0)
+        total.inc(100)
+        errs.inc(2)
+        eng.tick(now=10.0)
+        assert eng.alert_state("errors") == "firing"
+
+    def test_counter_mode_empty_denominator_is_no_data(self):
+        reg = telemetry.MetricsRegistry()
+        reg.counter("errs")
+        reg.counter("total").inc(0)
+        eng = _engine([slo.BurnRate(
+            "errors", numerator="errs", denominator="total",
+            objective=0.999, fast_window_s=5.0, slow_window_s=10.0,
+            group_by=())], registry=reg)
+        for i in range(4):
+            eng.tick(now=i * 5.0)
+        assert eng.alerts() == []
+
+    def test_where_selector_filters_series(self):
+        reg = telemetry.MetricsRegistry()
+        lat = reg.histogram("lat", buckets=(0.1,))
+        eng = _engine([slo.BurnRate(
+            "errors", numerator=("lat", {"reason": "error"}),
+            denominator="lat", objective=0.99, fast_window_s=5.0,
+            slow_window_s=10.0, factor=4.0, group_by=())],
+            registry=reg)
+        eng.tick(now=0.0)
+        for _ in range(45):
+            lat.observe(0.05, reason="length")
+        for _ in range(5):
+            lat.observe(0.05, reason="error")    # 10% errors
+        eng.tick(now=5.0)
+        for _ in range(10):
+            lat.observe(0.05, reason="error")
+        eng.tick(now=10.0)
+        assert eng.alert_state("errors") == "firing"
+
+
+# ------------------------------------------------- transitions + sinks
+class TestAlertSinks:
+    def test_flight_events_and_metrics_on_every_transition(self):
+        flight_recorder.reset()
+        eng = _engine([slo.Threshold("hot", metric="g", bound=1.0,
+                                     op=">", for_s=1.0)])
+        g = eng.registry.gauge("g")
+        g.set(2.0)
+        eng.tick(now=0.0)
+        eng.tick(now=1.5)
+        g.set(0.0)
+        eng.tick(now=2.0)
+        states = [e["state"] for e in flight_recorder.get_default()
+                  .events() if e["kind"] == "alert"]
+        assert states == ["pending", "firing", "resolved"]
+        c = eng.registry.counter(telemetry.ALERTS_TOTAL)
+        for state in ("pending", "firing", "resolved"):
+            assert c.value(rule="hot", state=state) == 1
+        # the active gauge tracked the lifecycle and ended at 0
+        act = eng.registry.gauge(telemetry.ALERTS_ACTIVE)
+        assert act.value(state="firing") == 0
+        assert act.value(state="pending") == 0
+
+    def test_page_severity_dumps_digest_valid_incident(self, tmp_path):
+        flight_recorder.reset()
+        eng = _engine([slo.Threshold("p99_melt", metric="g",
+                                     bound=1.0, op=">",
+                                     severity="page")],
+                      flight_dir=str(tmp_path))
+        eng.registry.gauge("g").set(9.0)
+        eng.tick(now=0.0)
+        a = eng.alerts(states=("firing",))[0]
+        assert a.incident_dump is not None
+        dump = flight_recorder.load_dump(a.incident_dump)
+        assert dump["valid"]
+        assert dump["manifest"]["reason"] == "slo_page"
+        assert dump["manifest"]["context"]["rule"] == "p99_melt"
+        # the dump's last event is the incident itself
+        assert dump["events"][-1]["kind"] == "slo_page"
+
+    def test_on_alert_subscription_and_bad_subscriber(self):
+        eng = _engine([slo.Threshold("hot", metric="g", bound=1.0,
+                                     op=">")])
+        seen = []
+        eng.on_alert(lambda a: seen.append((a.rule, a.state)))
+        eng.on_alert(lambda a: 1 / 0)   # must not break evaluation
+        g = eng.registry.gauge("g")
+        g.set(2.0)
+        eng.tick(now=0.0)
+        g.set(0.0)
+        eng.tick(now=1.0)
+        assert seen == [("hot", "firing"), ("hot", "resolved")]
+
+    def test_on_alert_pending_opt_in(self):
+        eng = _engine([slo.Threshold("hot", metric="g", bound=1.0,
+                                     op=">", for_s=5.0)])
+        seen = []
+        eng.on_alert(lambda a: seen.append(a.state),
+                     states=("pending", "firing"))
+        eng.registry.gauge("g").set(2.0)
+        eng.tick(now=0.0)
+        assert seen == ["pending"]
+        with pytest.raises(ValueError, match="unknown alert states"):
+            eng.on_alert(lambda a: None, states=("exploded",))
+
+    def test_webhook_posts_firing_and_resolved(self):
+        from http.server import (
+            BaseHTTPRequestHandler, ThreadingHTTPServer,
+        )
+
+        got = []
+
+        class H(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                got.append(json.loads(self.rfile.read(n)))
+                self.send_response(200)
+                self.send_header("Content-Length", "2")
+                self.end_headers()
+                self.wfile.write(b"{}")
+
+        srv = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        t = threading.Thread(target=srv.serve_forever, daemon=True)
+        t.start()
+        try:
+            url = f"http://127.0.0.1:{srv.server_address[1]}/hook"
+            eng = _engine([slo.Threshold("hot", metric="g",
+                                         bound=1.0, op=">")],
+                          webhook_url=url)
+            g = eng.registry.gauge("g")
+            g.set(2.0)
+            eng.tick(now=0.0)
+            g.set(0.0)
+            eng.tick(now=1.0)
+        finally:
+            srv.shutdown()
+            srv.server_close()
+        assert [p["state"] for p in got] == ["firing", "resolved"]
+        assert got[0]["rule"] == "hot"
+
+
+# ------------------------------------------------------ engine plumbing
+class TestEnginePlumbing:
+    def test_evaluator_thread_name_and_clean_shutdown(self):
+        eng = _engine([slo.Threshold("hot", metric="g", bound=1.0,
+                                     op=">")], interval_s=0.01)
+        eng.registry.gauge("g").set(5.0)
+        with eng:
+            deadline = time.monotonic() + 5
+            while eng.ticks == 0 and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert eng.ticks > 0
+            assert any(t.name == "SLOEvaluator"
+                       for t in threading.enumerate())
+            deadline = time.monotonic() + 5
+            while not eng.alerts(states=("firing",)) \
+                    and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert eng.alert_state("hot") == "firing"
+        assert not any(t.name == "SLOEvaluator" and t.is_alive()
+                       for t in threading.enumerate())
+
+    def test_shutdown_zeroes_active_alerts_gauge(self):
+        """A dead engine must not leave dl4j_tpu_alerts_active frozen
+        at its last pending/firing counts (the stale-series
+        discipline, applied to the engine's own gauges)."""
+        reg = telemetry.MetricsRegistry()
+        eng = _engine([slo.Threshold("hot", metric="g", bound=1.0)],
+                      registry=reg)
+        reg.gauge("g").set(5.0)
+        eng.tick(now=0.0)
+        assert reg.gauge(telemetry.ALERTS_ACTIVE).value(
+            state="firing") == 1
+        eng.shutdown()
+        assert reg.gauge(telemetry.ALERTS_ACTIVE).value(
+            state="firing") == 0
+        assert reg.gauge(telemetry.ALERTS_ACTIVE).value(
+            state="pending") == 0
+
+    def test_duplicate_rule_name_rejected(self):
+        eng = _engine([slo.Threshold("x", metric="g", bound=1)])
+        with pytest.raises(ValueError, match="duplicate"):
+            eng.add_rule(slo.Rate("x", metric="c", bound=1,
+                                  window_s=5))
+
+    def test_rule_validation(self):
+        with pytest.raises(ValueError, match="severity"):
+            slo.Threshold("x", metric="g", bound=1, severity="chaos")
+        with pytest.raises(ValueError, match="objective"):
+            slo.BurnRate("x", objective=1.5, fast_window_s=1,
+                         slow_window_s=2, numerator="a",
+                         denominator="b")
+        with pytest.raises(ValueError, match="histogram mode"):
+            slo.BurnRate("x", objective=0.99, fast_window_s=1,
+                         slow_window_s=2, histogram="h")
+        with pytest.raises(ValueError, match="window_s"):
+            slo.Threshold("x", metric="h", bound=1, quantile=0.99)
+
+    def test_builtin_packs(self):
+        rules = slo.default_rules(p99_target_s=0.2, mfu_floor=0.1)
+        names = {r.name for r in rules}
+        assert {"serving_p99_burn", "serving_ttft_p99",
+                "serving_error_rate", "serving_429_burn",
+                "serving_kv_utilization", "serving_queue_pressure",
+                "train_mfu_drop", "train_watchdog_stalls",
+                "train_divergence_rollbacks",
+                "train_prefetch_starvation"} <= names
+        qp = next(r for r in rules
+                  if r.name == "serving_queue_pressure")
+        assert qp.action == "scale_serve"
+        burn = next(r for r in rules if r.name == "serving_p99_burn")
+        assert burn.severity == "page" and burn.target_s == 0.2
+        with pytest.raises(TypeError, match="unknown"):
+            slo.default_rules(nope=1)
+
+    def test_alerts_json_and_snapshot(self):
+        eng = _engine(slo.default_rules())
+        eng.registry.gauge(
+            telemetry.SERVING_KV_PAGE_UTILIZATION).set(0.99)
+        for i in range(30):
+            eng.tick(now=float(i))
+        out = eng.alerts_json()
+        assert out["ticks"] == 30
+        assert len(out["rules"]) == 10
+        firing = [a for a in out["alerts"] if a["state"] == "firing"]
+        assert firing and firing[0]["rule"] == "serving_kv_utilization"
+        snap = eng.snapshot()
+        assert snap["rules"] == 10 and snap["firing"]
+
+    def test_default_engine_registration(self):
+        assert slo.default_engine() is None
+        eng = _engine([], make_default=True)
+        try:
+            assert slo.default_engine() is eng
+            assert telemetry.snapshot().get("alerts") is not None
+        finally:
+            eng.shutdown()
+        assert slo.default_engine() is None
+        assert slo.alerts_snapshot() == {}
+
+
+# ------------------------------------------------------------- HTTP
+class TestAlertsHTTP:
+    def test_http_alerts_404_without_engine(self):
+        obj, code = slo.http_alerts()
+        assert code == 404 and "no SLO engine" in obj["error"]
+
+    def test_v1_alerts_on_ui_server(self):
+        from deeplearning4j_tpu.ui.server import UIServer
+
+        eng = _engine([slo.Threshold("hot", metric="g", bound=1.0,
+                                     op=">")], make_default=True)
+        eng.registry.gauge("g").set(2.0)
+        eng.tick(now=0.0)
+        ui = UIServer()
+        port = ui.start(port=0)
+        try:
+            out = json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/v1/alerts",
+                timeout=10).read())
+            assert out["alerts"][0]["rule"] == "hot"
+            assert out["alerts"][0]["state"] == "firing"
+            assert out["rules"][0]["kind"] == "threshold"
+        finally:
+            ui.stop()
+            eng.shutdown()
+        # 404 with a hint once the engine is gone
+        ui2 = UIServer()
+        port = ui2.start(port=0)
+        try:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/v1/alerts", timeout=10)
+            assert ei.value.code == 404
+        finally:
+            ui2.stop()
+
+    def test_dashboard_has_alerts_card(self):
+        from deeplearning4j_tpu.ui.server import _DASHBOARD_HTML
+
+        assert "Alerts (SLO engine)" in _DASHBOARD_HTML
+
+
+# --------------------------------------------- control-plane actions
+class TestSchedulerIntegration:
+    def _tiny_fleet_job(self):
+        import jax
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu import control
+        from deeplearning4j_tpu.models.gpt import CausalLM
+        from deeplearning4j_tpu.models.transformer import tiny_config
+        from deeplearning4j_tpu.serving import ServingFleet
+
+        cfg = tiny_config(vocab=13, max_len=32, d_model=16,
+                          n_layers=1, n_heads=2, d_ff=32)
+        cfg.dropout = 0.0
+        m = CausalLM(cfg, compute_dtype=jnp.float32)
+        params = m.init_params(jax.random.key(0))
+
+        def build(ctx):
+            return ServingFleet(m, params, devices=ctx.devices,
+                                slots=2, page_size=8,
+                                prefill_buckets=[8], max_chunk=2)
+
+        devs = jax.devices()[:2]
+        return control, devs, control.ServeJob(
+            build, chips=2, min_chips=1, tenant="t")
+
+    @pytest.mark.slow
+    def test_queue_pressure_alert_restarts_drained_replica(self):
+        """End to end: drain a replica (rebalance hand-back), then a
+        FIRING serving_queue_pressure alert makes the scheduler
+        restart it — the ROADMAP's 'scale serve replicas on sustained
+        queue pressure instead of one-shot rebalance'."""
+        control, devs, job = self._tiny_fleet_job()
+        slo_eng = _engine(
+            [slo.Threshold("serving_queue_pressure",
+                           metric=telemetry.SERVING_FLEET_PRESSURE,
+                           bound=1.0, op=">", for_s=0.0,
+                           action="scale_serve")],
+            registry=telemetry.MetricsRegistry.get_default())
+        sched = control.JobScheduler(
+            devices=devs,
+            workers={"w0": devs[:1], "w1": devs[1:]},
+            slo=slo_eng, make_default=False)
+        try:
+            sched.start()
+            sched.submit(job)
+            sched.wait(job.job_id, timeout=60, states=("running",))
+            deadline = time.monotonic() + 30
+            while job.fleet is None and time.monotonic() < deadline:
+                time.sleep(0.02)
+            fl = job.fleet
+            assert fl is not None
+            fl.drain_replica(1)
+            assert fl.alive_replicas() == 1
+            # the drained chip went back to the pool
+            deadline = time.monotonic() + 10
+            while sched.devices.free == 0 \
+                    and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert sched.devices.free == 1
+            # sustained pressure: publish the gauge breached and tick
+            telemetry.MetricsRegistry.get_default().gauge(
+                telemetry.SERVING_FLEET_PRESSURE).set(
+                3.0, fleet=fl.fleet_id)
+            slo_eng.tick()
+            deadline = time.monotonic() + 30
+            while fl.alive_replicas() < 2 \
+                    and time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert fl.alive_replicas() == 2
+            assert sched.devices.free == 0       # chip re-acquired
+            reg = telemetry.MetricsRegistry.get_default()
+            assert reg.counter(telemetry.JOBS_RESTARTS).value(
+                job=job.job_id, reason="queue_pressure_alert") == 1
+            # the restarted replica still serves
+            out = fl.generate(np.asarray([1, 2, 3], np.int32), 3)
+            assert len(out) == 3
+        finally:
+            sched.shutdown()
+            slo_eng.shutdown()
+
+    def test_rebalance_vetoed_while_pressure_alert_active(self):
+        """Hysteresis: with an SLO engine attached, _maybe_rebalance
+        must not drain a replica from a fleet whose queue-pressure
+        alert is pending/firing, even if an instantaneous poll would
+        read idle."""
+        from deeplearning4j_tpu import control
+
+        slo_eng = _engine([slo.Threshold(
+            "serving_queue_pressure",
+            metric=telemetry.SERVING_FLEET_PRESSURE, bound=1.0,
+            op=">", for_s=100.0, action="scale_serve")])
+        sched = control.JobScheduler(devices=["c0"], slo=slo_eng,
+                                     make_default=False)
+        try:
+            class _FakeEngine:
+                _device = None
+                slots = 2
+
+                def queue_depth(self):
+                    return 0
+
+            class _FakeReplica:
+                alive = True
+                draining = False
+                engine = _FakeEngine()
+
+                def __init__(self, index):
+                    self.index = index
+
+            class _FakeFleet:
+                fleet_id = "fleet-test"
+                # two replicas: one above min_chips (clamped to 1), so
+                # exactly one is drainable
+                _replicas = [_FakeReplica(0), _FakeReplica(1)]
+
+                def queue_pressure(self):
+                    return 0.0       # instantaneous poll says idle
+
+                def drain_replica(self, idx):
+                    raise AssertionError("drained despite alert")
+
+                def cancel_pending(self):
+                    pass
+
+                def shutdown(self, timeout=None):
+                    pass
+
+            job = control.ServeJob(lambda ctx: None, chips=1,
+                                   min_chips=0)
+            job.state = "running"
+            job.fleet = _FakeFleet()
+            sched._jobs[job.job_id] = job
+            starved = control.TrainJob(lambda ctx: None, chips=1)
+            starved._pending_since = time.monotonic() - 100
+            # alert pending on this fleet: veto
+            slo_eng.registry.gauge(
+                telemetry.SERVING_FLEET_PRESSURE).set(
+                5.0, fleet="fleet-test")
+            slo_eng.tick(now=0.0)
+            assert slo_eng.alert_state(
+                "serving_queue_pressure",
+                fleet="fleet-test") == "pending"
+            sched._maybe_rebalance(starved)     # must not drain
+            # alert cleared: the drain goes ahead
+            drained = []
+            job.fleet.drain_replica = lambda idx: drained.append(idx)
+            slo_eng.registry.gauge(
+                telemetry.SERVING_FLEET_PRESSURE).set(
+                0.0, fleet="fleet-test")
+            slo_eng.tick(now=1.0)
+            sched._maybe_rebalance(starved)
+            deadline = time.monotonic() + 5
+            while not drained and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert drained == [1]      # the victim is the LAST alive
+        finally:
+            sched.shutdown()
+            slo_eng.shutdown()
+
+
+    def test_direct_pressure_poll_survives_slo_attach(self):
+        """Attaching an SLO engine must ADD hysteresis, not silently
+        drop the pre-SLO protection: with no queue-pressure data in
+        the engine (alert inactive), a fleet whose direct
+        queue_pressure() poll reads busy still keeps its replicas."""
+        from deeplearning4j_tpu import control
+
+        slo_eng = _engine([slo.Threshold(
+            "serving_queue_pressure",
+            metric=telemetry.SERVING_FLEET_PRESSURE, bound=1.0,
+            op=">", for_s=100.0, action="scale_serve")])
+        sched = control.JobScheduler(devices=["c0"], slo=slo_eng,
+                                     make_default=False)
+        try:
+            class _FakeEngine:
+                _device = None
+                slots = 2
+
+                def queue_depth(self):
+                    return 9
+
+            class _FakeReplica:
+                alive = True
+                draining = False
+                engine = _FakeEngine()
+
+                def __init__(self, index):
+                    self.index = index
+
+            class _FakeFleet:
+                fleet_id = "fleet-busy"
+                _replicas = [_FakeReplica(0), _FakeReplica(1)]
+
+                def queue_pressure(self):
+                    return 4.0       # direct poll says BUSY
+
+                def drain_replica(self, idx):
+                    raise AssertionError(
+                        "drained a busy fleet: SLO attach dropped "
+                        "the direct pressure poll")
+
+                def cancel_pending(self):
+                    pass
+
+                def shutdown(self, timeout=None):
+                    pass
+
+            job = control.ServeJob(lambda ctx: None, chips=1,
+                                   min_chips=0)
+            job.state = "running"
+            job.fleet = _FakeFleet()
+            sched._jobs[job.job_id] = job
+            starved = control.TrainJob(lambda ctx: None, chips=1)
+            starved._pending_since = time.monotonic() - 100
+            # the engine has never seen SERVING_FLEET_PRESSURE data:
+            # the alert is inactive, only the direct poll protects
+            slo_eng.tick(now=0.0)
+            assert slo_eng.alert_state(
+                "serving_queue_pressure",
+                fleet="fleet-busy") == "inactive"
+            sched._maybe_rebalance(starved)     # must not drain
+        finally:
+            sched.shutdown()
+            slo_eng.shutdown()
+
+    def test_reconcile_retries_firing_scale_serve_alert(self):
+        """The firing transition is edge-triggered and deduplicated —
+        a scale-up skipped on the transition (fleet not built yet,
+        chip briefly held elsewhere) must be re-attempted by the
+        supervision loop while the alert STAYS firing."""
+        from deeplearning4j_tpu import control
+
+        slo_eng = _engine([slo.Threshold(
+            "serving_queue_pressure",
+            metric=telemetry.SERVING_FLEET_PRESSURE, bound=1.0,
+            op=">", for_s=0.0, action="scale_serve")])
+        sched = control.JobScheduler(devices=["c0"], slo=slo_eng,
+                                     make_default=False)
+        attempts = []
+        try:
+            sched._on_slo_alert = lambda a: attempts.append(a.rule)
+            slo_eng.registry.gauge(
+                telemetry.SERVING_FLEET_PRESSURE).set(
+                5.0, fleet="fleet-x")
+            # edge delivery goes to the bound method subscribed at
+            # attach (no ServeJob -> no-op); the reconcile pass below
+            # resolves the instance-attr stub instead
+            slo_eng.tick(now=0.0)
+            assert slo_eng.alert_state(
+                "serving_queue_pressure", fleet="fleet-x") == "firing"
+            sched._last_slo_reconcile = 0.0
+            sched._reconcile_slo()
+            assert attempts.count("serving_queue_pressure") >= 1
+            # throttled: an immediate second pass is a no-op
+            n = len(attempts)
+            sched._reconcile_slo()
+            assert len(attempts) == n
+        finally:
+            sched.shutdown()
+            slo_eng.shutdown()
+
+
+# ----------------------------------------------------- bench compare
+class TestBenchCompare:
+    def test_regression_detected_and_tolerance(self):
+        import bench_compare as bc
+
+        prior = {"metric": "bert", "value": 100.0, "unit": "t/s",
+                 "resnet50_mfu": 0.25, "gpt_decode_ms_per_step": 10.0,
+                 "serving_prefix_token_identical": True,
+                 "vs_baseline": 1.0, "lstm_hidden": 256,
+                 "vs_frozen_band_lo": 1.05}
+        current = dict(prior, value=85.0,
+                       gpt_decode_ms_per_step=12.0)
+        report, regs = bc.compare_rounds(prior, current,
+                                         tolerance=0.1)
+        assert len(regs) == 2        # throughput -15%, ms +20%
+        assert any("value" in r for r in regs)
+        assert any("ms_per_step" in r for r in regs)
+        # within tolerance: clean
+        _, regs = bc.compare_rounds(prior, dict(prior, value=95.0),
+                                    tolerance=0.1)
+        assert regs == []
+        # skipped keys never regress
+        _, regs = bc.compare_rounds(
+            prior, dict(prior, vs_baseline=0.1, lstm_hidden=1,
+                        vs_frozen_band_lo=0.0), tolerance=0.1)
+        assert regs == []
+
+    def test_zero_prior_never_hides_a_regression(self):
+        import bench_compare as bc
+
+        # a lower-better metric recorded 0 in the prior round: any
+        # move off zero is an infinite relative change, not "+0.0%"
+        _, regs = bc.compare_rounds({"a_ms": 0.0}, {"a_ms": 99.0},
+                                    tolerance=0.1)
+        assert len(regs) == 1
+        # higher-better appearing from zero is an improvement
+        _, regs = bc.compare_rounds({"tput": 0.0}, {"tput": 50.0},
+                                    tolerance=0.1)
+        assert regs == []
+        # zero -> zero is clean
+        _, regs = bc.compare_rounds({"a_ms": 0.0}, {"a_ms": 0.0},
+                                    tolerance=0.1)
+        assert regs == []
+
+    def test_bool_gate_flip_fails_regardless_of_tolerance(self):
+        import bench_compare as bc
+
+        prior = {"serving_prefix_token_identical": True}
+        _, regs = bc.compare_rounds(
+            prior, {"serving_prefix_token_identical": False},
+            tolerance=10.0)
+        assert len(regs) == 1
+
+    def test_load_round_formats(self, tmp_path):
+        import bench_compare as bc
+
+        line = {"metric": "x", "value": 5.0}
+        p1 = tmp_path / "round.json"
+        p1.write_text(json.dumps({"n": 3, "parsed": line,
+                                  "tail": "..."}))
+        assert bc.load_round(str(p1)) == line
+        p2 = tmp_path / "bare.json"
+        p2.write_text(json.dumps(line))
+        assert bc.load_round(str(p2)) == line
+        p3 = tmp_path / "stdout.txt"
+        p3.write_text("WARNING: noise\n" + json.dumps(line) + "\n")
+        assert bc.load_round(str(p3)) == line
+        p4 = tmp_path / "empty.txt"
+        p4.write_text("no json here")
+        with pytest.raises(ValueError, match="no aggregate line"):
+            bc.load_round(str(p4))

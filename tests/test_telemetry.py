@@ -78,6 +78,44 @@ class TestMetricsRegistry:
         assert g.value() == 3
         assert g.value(device="0") == 7
 
+    def test_histogram_bucket_counts_and_capture(self):
+        reg = telemetry.MetricsRegistry()
+        h = reg.histogram("lat", buckets=(0.1, 0.5, 1.0))
+        for v in (0.05, 0.3, 0.3, 0.7, 5.0):
+            h.observe(v, engine="e0")
+        cap = reg.capture()
+        cnt, tot, buckets = cap["lat"]["series"][(("engine", "e0"),)]
+        assert cnt == 5 and tot == pytest.approx(6.35)
+        # non-cumulative per-bucket counts; last slot is +Inf overflow
+        assert buckets == (1, 2, 1, 1)
+        assert cap["lat"]["bounds"] == (0.1, 0.5, 1.0)
+        text = reg.to_prometheus()
+        assert 'lat_bucket{engine="e0",le="0.5"} 3' in text
+        assert 'lat_bucket{engine="e0",le="+Inf"} 5' in text
+        # counters/gauges capture raw values
+        reg.counter("c").inc(3, k="a")
+        assert reg.capture()["c"]["values"][(("k", "a"),)] == 3
+
+    def test_remove_matching_and_engine_retire(self):
+        reg = telemetry.MetricsRegistry()
+        g = reg.gauge("g")
+        g.set(1.0, engine="dead")
+        g.set(2.0, engine="live")
+        c = reg.counter("c")
+        c.inc(5, engine="dead")
+        h = reg.histogram("h")
+        h.observe(0.1, engine="dead")
+        # gauges-only removal drops the series, not the metric
+        assert reg.remove_matching("engine", "dead",
+                                   kinds=("gauge",)) == 1
+        assert g.values() == {(("engine", "live"),): 2.0}
+        assert c.value(engine="dead") == 5       # counters retained
+        assert h.count(engine="dead") == 1
+        # unrestricted removal sweeps every kind
+        assert reg.remove_matching("engine", "dead") == 2
+        assert c.value(engine="dead") == 0
+        assert h.count(engine="dead") == 0
+
     def test_histogram_percentiles_and_bounds(self):
         reg = telemetry.MetricsRegistry()
         h = reg.histogram("lat", max_samples=64)
@@ -107,8 +145,13 @@ class TestMetricsRegistry:
         assert 'c_total{site="s"} 2' in text
         assert "# TYPE g gauge" in text
         assert "g 1.5" in text
-        assert "# TYPE h summary" in text
-        assert 'h{phase="etl",quantile="0.5"} 0.25' in text
+        # histograms export proper cumulative _bucket{le=...} series
+        # (scrapers run histogram_quantile over the same buckets the
+        # in-process SLO engine windows)
+        assert "# TYPE h histogram" in text
+        assert 'h_bucket{phase="etl",le="0.25"} 1' in text
+        assert 'h_bucket{phase="etl",le="0.1"} 0' in text
+        assert 'h_bucket{phase="etl",le="+Inf"} 1' in text
         assert 'h_count{phase="etl"} 1' in text
         assert 'h_sum{phase="etl"} 0.25' in text
         # every non-comment line is "name{labels} value"
@@ -135,13 +178,13 @@ class TestMetricsRegistry:
         reg = telemetry.MetricsRegistry()
         reg.counter("c_total", "counts\nthings with \\slashes").inc()
         reg.gauge("g")          # no help
-        reg.histogram("h", "a summary").observe(1.0)
+        reg.histogram("h", "a histogram").observe(1.0)
         text = reg.to_prometheus()
         assert "# HELP c_total counts\\nthings with \\\\slashes" in text
         assert "# HELP g" in text
-        assert "# HELP h a summary" in text
+        assert "# HELP h a histogram" in text
         for name, kind in (("c_total", "counter"), ("g", "gauge"),
-                           ("h", "summary")):
+                           ("h", "histogram")):
             assert f"# TYPE {name} {kind}" in text
 
     def test_nonfinite_values_render_prometheus_style(self):
