@@ -1,21 +1,52 @@
-"""Elastic control plane: JobScheduler over one device fleet.
+"""Elastic control plane: JobScheduler over one device fleet, and
+(phase 2) workers as supervised OS processes.
 
 ``JobScheduler`` runs many jobs — ``TrainJob`` (a supervised ``fit()``
-with auto-resume, periodic bundles, stall verdicts, and checkpoint-and-
-migrate across topology changes) and ``ServeJob`` (a ``ServingFleet``
-with replica restart, traffic re-routing, and capacity hand-back) —
-over a ``DeviceFleet`` of chips grouped into failure-domain workers.
-See control/scheduler.py for the full story and docs/CONTROL_PLANE.md
-for the operator guide.
+with auto-resume, periodic bundles, stall verdicts, priorities, and
+checkpoint-and-migrate across topology changes) and ``ServeJob`` (a
+``ServingFleet`` with replica restart, traffic re-routing, and
+capacity hand-back) — over a ``DeviceFleet`` of chips grouped into
+failure-domain workers. Phase 2 (control/worker.py) makes those
+workers real OS processes under a ``WorkerSupervisor`` (heartbeat file
+leases, preemption notices with deadlines, SIGKILL at the deadline,
+task migration through a shared bundle store). See
+control/scheduler.py + control/worker.py for the full story and
+docs/CONTROL_PLANE.md for the operator guide.
 """
 
 from deeplearning4j_tpu.control.scheduler import (
     TERMINAL, DeviceFleet, DeviceLostError, Job, JobContext,
     JobScheduler, ServeJob, TrainJob, default_scheduler,
-    http_jobs_get, http_jobs_post, jobs_snapshot, set_default,
+    http_jobs_get, http_jobs_post, http_workers_get, http_workers_post,
+    jobs_snapshot, set_default,
 )
+
+#: worker-process exports resolve LAZILY (PEP 562, like
+#: profiler.slo): the supervisor-off contract is that a process which
+#: never constructs a WorkerSupervisor never imports
+#: control/worker.py — and both HTTP servers import this package on
+#: every /v1/jobs request
+_WORKER_EXPORTS = ("WorkerSupervisor", "WorkerTask",
+                   "WorkerTaskContext", "default_supervisor",
+                   "set_default_supervisor", "workers_snapshot")
+
+
+def __getattr__(name):
+    if name in _WORKER_EXPORTS or name == "worker":
+        import importlib
+
+        mod = importlib.import_module(
+            "deeplearning4j_tpu.control.worker")
+        return mod if name == "worker" else getattr(mod, name)
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}")
+
 
 __all__ = ["JobScheduler", "TrainJob", "ServeJob", "Job", "JobContext",
            "DeviceFleet", "DeviceLostError", "TERMINAL",
            "set_default", "default_scheduler", "jobs_snapshot",
-           "http_jobs_get", "http_jobs_post"]
+           "http_jobs_get", "http_jobs_post",
+           "http_workers_get", "http_workers_post",
+           "WorkerSupervisor", "WorkerTask", "WorkerTaskContext",
+           "default_supervisor", "set_default_supervisor",
+           "workers_snapshot"]

@@ -75,6 +75,16 @@ class DeviceLostError(RuntimeError):
     platform preemption of a host). Retryable: the job migrates."""
 
 
+def _count_preemption(kind: str, job_id: str) -> None:
+    """dl4j_tpu_jobs_preemptions_total{kind=notice|priority}."""
+    if _telemetry.enabled():
+        _telemetry.MetricsRegistry.get_default().counter(
+            _telemetry.JOBS_PREEMPTIONS,
+            "job preemptions delivered by the control plane (cluster "
+            "maintenance notice or priority eviction)").inc(
+            kind=kind, job=job_id)
+
+
 # ======================================================================
 # device fleet
 # ======================================================================
@@ -108,6 +118,10 @@ class DeviceFleet:
                                  for d in devs]
         self._used: Dict[Any, str] = {}       # device -> job_id
         self._lost: set = set()
+        #: maintenance-noticed devices: still with their current
+        #: owners while jobs drain, never handed out again until the
+        #: worker is restored (or actually lost at the deadline)
+        self._condemned: set = set()
 
     # ------------------------------------------------------- accounting
     @property
@@ -136,6 +150,8 @@ class DeviceFleet:
                     "devices": len(devs),
                     "lost": sum(1 for d in devs if d in self._lost),
                     "used": sum(1 for d in devs if d in self._used),
+                    "condemned": any(d in self._condemned
+                                     for d in devs),
                 }
             return out
 
@@ -170,14 +186,32 @@ class DeviceFleet:
     def release(self, devices: Sequence[Any]) -> None:
         """Return devices to the pool. Idempotent per device (a device
         already returned — or lost — is skipped): the fleet capacity
-        listener and job teardown may both try to give a chip back."""
+        listener and job teardown may both try to give a chip back.
+        A CONDEMNED device (maintenance notice pending) is released
+        but not re-offered — it waits out the notice."""
         with self._lock:
             for d in devices:
                 if d in self._used and d not in self._lost:
                     del self._used[d]
-                    self._free.append(d)
+                    if d not in self._condemned:
+                        self._free.append(d)
                 elif d in self._lost:
                     self._used.pop(d, None)
+
+    def condemn_worker(self, worker: str) -> List[Any]:
+        """Maintenance notice for a whole worker: its devices stay
+        with their current owners while those jobs checkpoint-and-
+        drain, but are never handed out again — a job migrating off
+        the doomed worker must not land back on it. ``lose_worker``
+        (at the deadline) or ``restore_worker`` (notice cancelled /
+        host back) resolves the state."""
+        devs = self._workers.get(str(worker), [])
+        with self._lock:
+            for d in devs:
+                self._condemned.add(d)
+                if d in self._free:
+                    self._free.remove(d)
+        return list(devs)
 
     def lose_worker(self, worker: str) -> List[Any]:
         """Remove a whole worker's devices from the fleet (death /
@@ -187,19 +221,22 @@ class DeviceFleet:
         with self._lock:
             for d in devs:
                 self._lost.add(d)
+                self._condemned.discard(d)
                 if d in self._free:
                     self._free.remove(d)
             return list(devs)
 
     def restore_worker(self, worker: str) -> List[Any]:
-        """Bring a lost worker's devices back (the host rebooted)."""
+        """Bring a lost (or condemned) worker's devices back (the
+        host rebooted / the maintenance window passed)."""
         devs = self._workers.get(str(worker), [])
         restored = []
         with self._lock:
             for d in devs:
-                if d in self._lost:
+                if d in self._lost or d in self._condemned:
                     self._lost.discard(d)
-                    if d not in self._used:
+                    self._condemned.discard(d)
+                    if d not in self._used and d not in self._free:
                         self._free.append(d)
                     restored.append(d)
         return restored
@@ -217,7 +254,8 @@ class DeviceFleet:
             return {"total": len(self._free) + len(self._used),
                     "free": len(self._free),
                     "used": len(self._used),
-                    "lost": len(self._lost)}
+                    "lost": len(self._lost),
+                    "condemned": len(self._condemned)}
 
 
 # ======================================================================
@@ -253,7 +291,8 @@ class Job:
 
     def __init__(self, *, name: Optional[str] = None, chips: int = 1,
                  tenant: str = "default", max_retries: int = 3,
-                 backoff_s: float = 0.25, min_chips: int = 1):
+                 backoff_s: float = 0.25, min_chips: int = 1,
+                 priority: int = 0):
         self.job_id = f"{self.kind}-{next(_JOB_IDS)}"
         self.name = name or self.job_id
         self.tenant = str(tenant)
@@ -261,6 +300,13 @@ class Job:
         self.min_chips = max(int(min_chips), 1)
         self.max_retries = int(max_retries)
         self.backoff_s = float(backoff_s)
+        #: higher wins. Scheduling is priority-then-FIFO, and a gang
+        #: that cannot fit may checkpoint-PREEMPT (never kill) running
+        #: train jobs of STRICTLY lower priority; the victim parks in
+        #: a ``preempted`` state and resumes — bit-identically, from
+        #: its own bundles — when capacity frees. All-default
+        #: priorities (0) reproduce the PR 13 FIFO exactly.
+        self.priority = int(priority)
         self.state = "pending"
         self.devices: List[Any] = []
         self.attempts = 0
@@ -278,6 +324,8 @@ class Job:
         self._migrate_on_exit = False
         self._cancel_on_exit = False
         self._drain_on_exit = False
+        self._park_on_exit = False     # priority preemption: park,
+        self._parked_since = 0.0       # don't requeue
         self._stalled_at: Optional[float] = None
         self._stall_deadline: Optional[float] = None
         self._exit_reason: Optional[str] = None
@@ -304,6 +352,7 @@ class Job:
             "tenant": self.tenant,
             "state": self.state,
             "chips": self.chips,
+            "priority": self.priority,
             "devices": [str(d) for d in self.devices],
             "attempts": self.attempts,
             "retries_used": self.retries_used,
@@ -337,6 +386,7 @@ class TrainJob(Job):
 
     def __init__(self, run_fn: Callable[[JobContext], Any], *,
                  checkpoint_dir: Optional[str] = None,
+                 bundle_store=None,
                  fault_tolerance=None,
                  checkpoint_every: Optional[int] = 10,
                  step_deadline: Optional[float] = None,
@@ -356,12 +406,17 @@ class TrainJob(Job):
 
             fault_tolerance = FaultTolerance(
                 checkpoint_dir=checkpoint_dir,
+                bundle_store=bundle_store,
                 checkpoint_every=checkpoint_every,
                 step_deadline=step_deadline,
                 compile_grace_s=compile_grace_s)
         elif checkpoint_dir and not fault_tolerance.checkpoint_dir:
             fault_tolerance.checkpoint_dir = checkpoint_dir
         self.fault_tolerance = fault_tolerance
+        if self.checkpoint_dir is None:
+            # a bundle store implies a checkpoint anchor (shared-fs
+            # migration is the whole point of handing one to a job)
+            self.checkpoint_dir = fault_tolerance.checkpoint_dir
 
 
 class ServeJob(Job):
@@ -430,6 +485,7 @@ class JobScheduler:
                  rebalance_after_s: float = 5.0,
                  rebalance_pressure: float = 0.05,
                  slo=None,
+                 supervisor=None,
                  poll_s: float = 0.05,
                  flight_dir: Optional[str] = None,
                  make_default: bool = True):
@@ -440,6 +496,7 @@ class JobScheduler:
         self.poll_s = float(poll_s)
         self.flight_dir = flight_dir
         self._slo = None
+        self._supervisor = None
         self._jobs: "collections.OrderedDict[str, Job]" = \
             collections.OrderedDict()
         self._queue: collections.deque = collections.deque()
@@ -447,6 +504,7 @@ class JobScheduler:
         self._lock = threading.RLock()
         self._stop = threading.Event()
         self._wake = threading.Event()
+        self._preempt_timers: Dict[str, threading.Timer] = {}
         self._thread: Optional[threading.Thread] = None
         self._last_gauges = 0.0
         self._last_slo_reconcile = 0.0
@@ -454,6 +512,41 @@ class JobScheduler:
             set_default(self)
         if slo is not None:
             self.attach_slo(slo)
+        if supervisor is not None:
+            self.attach_supervisor(supervisor)
+
+    def attach_supervisor(self, supervisor) -> None:
+        """Wire a ``WorkerSupervisor`` (control/worker.py) into the
+        verdict path: a dead worker PROCESS maps onto
+        ``lose_worker`` + device-loss migration exactly like a chaos
+        ``kill_worker``, and a respawned worker's first heartbeat
+        restores its devices to the fleet. Worker names must match
+        the fleet's failure domains for the mapping to bite; unknown
+        names are supervisor-local only."""
+        self._supervisor = supervisor
+        if getattr(supervisor, "scheduler", None) is not self:
+            supervisor.scheduler = self
+
+    # ------------------------------------------ supervisor verdict hooks
+    def on_worker_process_dead(self, worker: str,
+                               why: str = "") -> None:
+        """Supervisor hook: a worker process exited or its heartbeat
+        lease expired — a real OS-level death, mapped onto the
+        existing recover-newest-bundle-and-migrate path."""
+        worker = str(worker)
+        devs = self.devices._workers.get(worker)
+        if not devs:
+            return              # not a fleet failure domain
+        with self.devices._lock:
+            if all(d in self.devices._lost for d in devs):
+                return          # already handled (kill_worker drill)
+        self._worker_lost(worker, why=f"process death: {why}")
+
+    def on_worker_process_alive(self, worker: str) -> None:
+        """Supervisor hook: a respawned worker heartbeats again — its
+        devices rejoin the fleet as restore_worker capacity."""
+        if str(worker) in self.devices._workers:
+            self.restore_worker(worker)
 
     def attach_slo(self, engine) -> None:
         """Subscribe to an SLOEngine's alert transitions: sustained
@@ -496,6 +589,9 @@ class JobScheduler:
                 t.join(max(0.0, deadline - time.monotonic()))
         self._stop.set()
         self._wake.set()
+        for timer in list(self._preempt_timers.values()):
+            timer.cancel()
+        self._preempt_timers.clear()
         t = self._thread
         if t is not None:
             t.join(max(1.0, deadline - time.monotonic()))
@@ -577,7 +673,9 @@ class JobScheduler:
         with self._lock:
             if job.state in TERMINAL:
                 return job
-            if job.state in ("pending", "restarting"):
+            if job.state in ("pending", "restarting", "preempted"):
+                # parked (priority-preempted) jobs have no runner
+                # thread: cancelling is pure bookkeeping
                 try:
                     self._queue.remove(job_id)
                 except ValueError:
@@ -604,7 +702,7 @@ class JobScheduler:
         with self._lock:
             if job.state in TERMINAL:
                 return job
-            if job.state in ("pending", "restarting"):
+            if job.state in ("pending", "restarting", "preempted"):
                 try:
                     self._queue.remove(job_id)
                 except ValueError:
@@ -634,7 +732,28 @@ class JobScheduler:
         migrate onto what remains; serving replicas on them die and
         their traffic replays on survivors. Emits a flight-recorder
         INCIDENT dump — a worker death is exactly the post-mortem the
-        black box exists for."""
+        black box exists for. With a supervisor attached and the name
+        supervised, the worker PROCESS is SIGKILLed too — the drill
+        is then a real OS-level death."""
+        sup = self._supervisor
+        if sup is not None and str(worker) in getattr(sup, "_handles",
+                                                      {}):
+            try:
+                sup.kill(worker)
+            except Exception:
+                log.exception("control: supervisor kill(%s) failed",
+                              worker)
+        return self._worker_lost(worker, why="chaos kill_worker")
+
+    def _worker_lost(self, worker: str, why: str = "") -> List[Any]:
+        """Shared death path for kill_worker, the supervisor's
+        process-death hook, and a missed preemption deadline."""
+        timer = self._preempt_timers.pop(str(worker), None)
+        if timer is not None:
+            # the worker died before its maintenance deadline: the
+            # pending timer must not replay this loss as a second
+            # incident at the deadline
+            timer.cancel()
         devs = self.devices.lose_worker(worker)
         affected: List[str] = []
         with self._lock:
@@ -657,14 +776,110 @@ class JobScheduler:
                             r.index, DeviceLostError(
                                 f"worker {worker} lost"))
         _flight.incident("job_worker_lost", directory=self.flight_dir,
-                         worker=str(worker),
+                         worker=str(worker), why=why,
                          devices=[str(d) for d in devs],
                          jobs=affected)
-        log.warning("control: worker %s lost (%d devices, %d jobs "
-                    "affected) — migrating", worker, len(devs),
-                    len(affected))
+        log.warning("control: worker %s lost (%s; %d devices, %d jobs "
+                    "affected) — migrating", worker, why or "?",
+                    len(devs), len(affected))
         self._wake.set()
         return devs
+
+    # ------------------------------------------------ preemption notices
+    def preempt_worker(self, worker: str,
+                       deadline_s: float = 30.0) -> List[str]:
+        """Cluster maintenance notice for a whole worker (the GCE/
+        Borg-style event, also reachable as ``POST
+        /v1/workers/<w>/preempt``): jobs on it checkpoint-and-drain
+        BEFORE the kill instead of recovering after it. The worker's
+        devices are CONDEMNED immediately (drains migrate onto other
+        capacity, never back onto the doomed worker); at the deadline
+        whatever is still running there dies for real and recovery
+        degrades to the periodic-bundle story. Each affected drain
+        counts one logical migration, not a retry — the platform's
+        fault, not the job's. Returns the affected job ids."""
+        worker = str(worker)
+        if worker not in self.devices._workers:
+            raise KeyError(f"unknown worker {worker!r} (have: "
+                           f"{sorted(self.devices._workers)})")
+        devs = set(self.devices.condemn_worker(worker))
+        _flight.record("worker_preempt_notice", worker=worker,
+                       deadline_s=deadline_s)
+        affected: List[str] = []
+        with self._lock:
+            jobs = list(self._jobs.values())
+        for job in jobs:
+            if job.state in TERMINAL or not job.devices:
+                continue
+            hit = [d for d in job.devices if d in devs]
+            if not hit:
+                continue
+            affected.append(job.job_id)
+            _count_preemption("notice", job.job_id)
+            if isinstance(job, TrainJob):
+                job._migrate_on_exit = True
+                job._exit_reason = "preempt_notice"
+                job.fault_tolerance.request_preemption(
+                    deadline_s=deadline_s, kind="notice")
+            elif isinstance(job, ServeJob) and job.fleet is not None:
+                for r in job.fleet._replicas:
+                    if r.alive and not r.draining \
+                            and r.engine._device in devs:
+                        r.draining = True
+                        threading.Thread(
+                            target=job.fleet.drain_replica,
+                            args=(r.index,), daemon=True,
+                            name=f"JobRunner-drain-{job.job_id}"
+                        ).start()
+        sup = self._supervisor
+        if sup is not None and worker in getattr(sup, "_handles", {}):
+            try:
+                sup.preempt(worker, deadline_s=deadline_s)
+            except Exception:
+                log.exception("control: supervisor preempt(%s) failed",
+                              worker)
+        timer = threading.Timer(float(deadline_s),
+                                self._complete_worker_preemption,
+                                args=(worker,))
+        timer.daemon = True
+        timer.name = f"JobRunner-preempt-{worker}"
+        prev = self._preempt_timers.pop(worker, None)
+        if prev is not None:
+            prev.cancel()
+        self._preempt_timers[worker] = timer
+        timer.start()
+        log.warning("control: maintenance notice for worker %s — %d "
+                    "job(s) draining, kill in %.1fs", worker,
+                    len(affected), deadline_s)
+        self._wake.set()
+        return affected
+
+    def _complete_worker_preemption(self, worker: str) -> None:
+        """The notice deadline: the platform takes the worker NOW.
+        Jobs that drained in time already migrated; anything still
+        holding the worker's devices dies SIGKILL-equivalently and
+        recovers from its newest periodic bundle."""
+        self._preempt_timers.pop(str(worker), None)
+        if self._stop.is_set():
+            return
+        _flight.record("worker_preempt_deadline", worker=str(worker))
+        self._worker_lost(worker, why="preemption deadline expired")
+
+    def restore_worker(self, worker: str) -> List[Any]:
+        """A lost/condemned worker's capacity rejoins the fleet (host
+        rebooted, maintenance window passed, supervisor respawned the
+        process)."""
+        timer = self._preempt_timers.pop(str(worker), None)
+        if timer is not None:
+            timer.cancel()       # the maintenance notice was lifted
+        restored = self.devices.restore_worker(worker)
+        if restored:
+            _flight.record("job_worker_restored", worker=str(worker),
+                           devices=[str(d) for d in restored])
+            log.warning("control: worker %s restored (%d devices back "
+                        "in the pool)", worker, len(restored))
+        self._wake.set()
+        return restored
 
     # ----------------------------------------------------------- status
     def status(self) -> Dict[str, Any]:
@@ -694,6 +909,7 @@ class JobScheduler:
         try:
             while not self._stop.is_set():
                 self._wake.clear()
+                self._maybe_unpark()
                 self._schedule_pending()
                 self._poll_jobs()
                 self._publish_gauges()
@@ -730,7 +946,12 @@ class JobScheduler:
         while True:
             with self._lock:
                 job_id = None
-                for jid in self._queue:
+                # priority-then-FIFO: the stable sort keeps submission
+                # order within a priority class, so an all-default
+                # (priority 0) queue is exactly the PR 13 FIFO
+                for jid in sorted(
+                        self._queue,
+                        key=lambda j: -self._jobs[j].priority):
                     j = self._jobs[jid]
                     if self._ready(j):
                         job_id = jid
@@ -742,7 +963,8 @@ class JobScheduler:
                 devs = self.devices.acquire(want, job.job_id)
                 if devs is None:
                     self._maybe_rebalance(job)
-                    return                   # FIFO: head keeps waiting
+                    self._maybe_preempt_for(job, want)
+                    return                   # head keeps waiting
                 self._queue.remove(job_id)
             if want != job.chips:
                 _flight.record("job_migrated", job=job.job_id,
@@ -762,6 +984,89 @@ class JobScheduler:
                                 job=job.job_id, reason="fleet_shrunk")
                 job.chips = want
             self._launch(job, devs)
+
+    def _maybe_preempt_for(self, job: Job, want: int) -> None:
+        """Priority preemption: a gang that cannot fit may checkpoint-
+        PREEMPT (never kill) running train jobs of STRICTLY lower
+        priority — lowest priority first, smallest gang first — until
+        the released chips would close the deficit. Victims park in a
+        ``preempted`` state and resume from their own bundles when
+        capacity frees (``_maybe_unpark``). Serving jobs are never
+        priority-preempted: their capacity moves through the drain/
+        rebalance path, which respects in-flight traffic."""
+        deficit = want - self.devices.free
+        if deficit <= 0:
+            return
+        jobs = list(self._jobs.values())
+        if not any(j.priority < job.priority for j in jobs):
+            return               # nobody to evict (all-default fleet)
+        # chips already on their way back from in-flight preemptions
+        deficit -= sum(len(j.devices) for j in jobs
+                       if j._park_on_exit and j.state == "running")
+        if deficit <= 0:
+            return
+        victims = sorted(
+            (j for j in jobs
+             if isinstance(j, TrainJob) and j.state == "running"
+             and j.priority < job.priority
+             and not (j._park_on_exit or j._cancel_on_exit
+                      or j._drain_on_exit or j._migrate_on_exit)),
+            key=lambda j: (j.priority, len(j.devices)))
+        if sum(len(v.devices) for v in victims) < deficit:
+            # evicting EVERY candidate still wouldn't seat the gang
+            # (lost workers shrank the fleet below its size): parking
+            # jobs buys nothing and idles the whole fleet — let the
+            # gang wait while lower-priority work keeps training
+            return
+        for victim in victims:
+            if deficit <= 0:
+                return
+            victim._park_on_exit = True
+            victim._exit_reason = "priority_preempt"
+            _count_preemption("priority", victim.job_id)
+            _flight.record("job_preempt", victim=victim.job_id,
+                           victim_priority=victim.priority,
+                           for_job=job.job_id, priority=job.priority,
+                           chips=len(victim.devices))
+            log.warning(
+                "control: checkpoint-preempting job %s (priority %d, "
+                "%d chips) for higher-priority job %s (priority %d)",
+                victim.job_id, victim.priority, len(victim.devices),
+                job.job_id, job.priority)
+            victim.fault_tolerance.request_preemption(kind="priority")
+            deficit -= len(victim.devices)
+
+    def _maybe_unpark(self) -> None:
+        """Resume priority-preempted jobs when capacity frees: highest
+        priority first, and never ahead of queued work of the same or
+        higher priority (the queue got there first)."""
+        with self._lock:
+            parked = [j for j in self._jobs.values()
+                      if j.state == "preempted"]
+            if not parked:
+                return
+            queued_pri = [self._jobs[jid].priority
+                          for jid in self._queue]
+        for job in sorted(parked,
+                          key=lambda j: (-j.priority, j._parked_since)):
+            if any(p >= job.priority for p in queued_pri):
+                continue
+            if self.devices.free < max(job.min_chips, 1):
+                continue
+            with self._lock:
+                # re-check under the lock: a concurrent cancel()/
+                # drain() may have finished the parked job — a
+                # terminal job must never be resurrected
+                if job.state != "preempted":
+                    continue
+                job.transition("restarting",
+                               "capacity freed — resuming")
+                job._pending_since = time.monotonic()
+                job._not_before = 0.0
+                self._queue.append(job.job_id)
+            _flight.record("job_resumed", job=job.job_id,
+                           priority=job.priority)
+            queued_pri.append(job.priority)
 
     def _maybe_rebalance(self, starved: Job) -> None:
         """Train-vs-serve rebalancing: a train job starving past
@@ -933,6 +1238,7 @@ class JobScheduler:
         job._exc = None
         job._exit_reason = None
         job._migrate_on_exit = False
+        job._park_on_exit = False
         job._migration_counted = False
         job._stalled_at = None
         job._stall_deadline = None
@@ -1061,16 +1367,56 @@ class JobScheduler:
                 self._finish(job, "cancelled", "preempted by cancel")
             elif job._drain_on_exit:
                 self._finish(job, "drained", "preempted by drain")
+            elif job._park_on_exit:
+                job._park_on_exit = False
+                ft = job.fault_tolerance
+                if ft.preemption_requested:
+                    # the fit returned WITHOUT ever consuming the
+                    # preemption flag: it finished its work before
+                    # reaching another boundary — that is a
+                    # completion, not a drain. Clear the stale flag
+                    # (it would false-drain any later relaunch) and
+                    # finish normally.
+                    ft._preempt.clear()
+                    ft._notice_box[0] = None
+                    self._finish(job, "completed", "fit returned")
+                else:
+                    # priority preemption: checkpointed, now PARKED —
+                    # no requeue; _maybe_unpark resumes it when
+                    # capacity frees, bit-identically from its own
+                    # bundles
+                    job._parked_since = time.monotonic()
+                    job.transition(
+                        "preempted", "checkpoint-preempted for a "
+                                     "higher-priority gang")
+                    _flight.record("job_parked", job=job.job_id,
+                                   priority=job.priority)
             elif job._migrate_on_exit:
-                self._requeue(job,
-                              job._exit_reason or "migration",
-                              consume_retry=False)
+                ft = job.fault_tolerance
+                if ft.preemption_requested:
+                    # the notice/stall preemption was never consumed:
+                    # the fit completed its work first — requeueing
+                    # would retrain a finished job from scratch
+                    ft._preempt.clear()
+                    ft._notice_box[0] = None
+                    self._finish(job, "completed", "fit returned")
+                else:
+                    self._requeue(job,
+                                  job._exit_reason or "migration",
+                                  consume_retry=False)
             else:
                 self._finish(job, "completed", "fit returned")
             return
         # verdict classification
         from deeplearning4j_tpu.util.resilience import DivergenceError
 
+        if job._park_on_exit or job._migrate_on_exit:
+            # a scheduler-initiated preemption (priority park or
+            # maintenance notice) raced a crash: the UNCONSUMED flag
+            # must not checkpoint-and-drain the relaunch at its first
+            # boundary (which would read as a bogus clean completion)
+            job.fault_tolerance._preempt.clear()
+            job.fault_tolerance._notice_box[0] = None
         if isinstance(exc, DivergenceError):
             # the divergence guard already spent ITS budget and dumped
             # the incident (NaN-layer provenance included): restarts
@@ -1079,8 +1425,14 @@ class JobScheduler:
                          f"divergence: {exc}", error=exc)
         elif isinstance(exc, (DeviceLostError,
                               _chaos.WorkerKilledError)):
+            # a death during an announced maintenance window (the
+            # notice deadline beat the step boundary) is the
+            # platform's fault: one logical migration, not a retry —
+            # the periodic-bundle recovery story takes over
+            noticed = (job._migrate_on_exit
+                       and job._exit_reason == "preempt_notice")
             self._requeue(job, f"worker_lost: {exc}",
-                          consume_retry=True)
+                          consume_retry=not noticed)
         else:
             self._requeue(job, f"error: {exc}", consume_retry=True)
 
@@ -1320,8 +1672,8 @@ class JobScheduler:
                       "jobs per state (pending/running/restarting/"
                       "terminal)")
         for state in ("pending", "running", "restarting", "migrating",
-                      "draining", "hung", "completed", "failed",
-                      "cancelled", "drained"):
+                      "draining", "preempted", "hung", "completed",
+                      "failed", "cancelled", "drained"):
             g.set(counts.get(state, 0), state=state)
         snap = self.devices.snapshot()
         gd = reg.gauge(_telemetry.JOBS_DEVICES,
@@ -1415,7 +1767,88 @@ def http_jobs_post(path: str, payload: Dict[str, Any]):
         return ({"error": str(e)}, 400)
 
 
+def _default_supervisor():
+    from deeplearning4j_tpu.control.worker import default_supervisor
+
+    return default_supervisor()
+
+
+def http_workers_get(path: str):
+    """Shared /v1/workers GET handling for ui/server.py and
+    remote/server.py: the fleet's failure domains (scheduler view)
+    and/or the supervised worker processes (supervisor view).
+    Returns (obj, http_code)."""
+    s = default_scheduler()
+    sup = _default_supervisor()
+    if s is None and sup is None:
+        return ({"error": "no JobScheduler or WorkerSupervisor in "
+                          "this process"}, 404)
+    out: Dict[str, Any] = {}
+    if s is not None:
+        out["workers"] = s.devices.workers()
+        out["devices"] = s.devices.snapshot()
+    if sup is not None:
+        out["processes"] = sup.workers_status()
+        out["control_dir"] = sup.control_dir
+    parts = [p for p in path.split("/") if p]   # v1 workers [<name>]
+    if len(parts) == 3:
+        name = parts[2]
+        one = {"worker": name}
+        found = False
+        if name in out.get("workers", {}):
+            one.update(out["workers"][name])
+            found = True
+        if name in out.get("processes", {}):
+            one["process"] = out["processes"][name]
+            found = True
+        if not found:
+            return ({"error": f"unknown worker {name!r}"}, 404)
+        return (one, 200)
+    return (out, 200)
+
+
+def http_workers_post(path: str, payload: Dict[str, Any]):
+    """Shared /v1/workers POST handling:
+
+    - ``POST /v1/workers/<w>/preempt {"deadline_s": 30}`` — deliver a
+      cluster maintenance notice: jobs on the worker checkpoint-and-
+      drain before the deadline kill.
+    - ``POST /v1/workers/<w>/restore`` — the worker's capacity
+      rejoins the fleet.
+
+    Returns (obj, code)."""
+    parts = [p for p in path.split("/") if p]   # v1 workers <w> <verb>
+    if len(parts) != 4:
+        return ({"error": "not found"}, 404)
+    name, verb = parts[2], parts[3]
+    s = default_scheduler()
+    sup = _default_supervisor()
+    try:
+        if verb == "preempt":
+            deadline = float(payload.get("deadline_s", 30.0))
+            if s is not None and name in s.devices.workers():
+                jobs = s.preempt_worker(name, deadline_s=deadline)
+                return ({"worker": name, "deadline_s": deadline,
+                         "notice": "delivered", "jobs": jobs}, 200)
+            if sup is not None and name in sup._handles:
+                sup.preempt(name, deadline_s=deadline)
+                return ({"worker": name, "deadline_s": deadline,
+                         "notice": "delivered"}, 200)
+            return ({"error": f"unknown worker {name!r}"}, 404)
+        if verb == "restore":
+            if s is not None and name in s.devices.workers():
+                devs = s.restore_worker(name)
+                return ({"worker": name,
+                         "devices_restored": [str(d) for d in devs]},
+                        200)
+            return ({"error": f"unknown worker {name!r}"}, 404)
+        return ({"error": "not found"}, 404)
+    except Exception as e:
+        return ({"error": str(e)}, 400)
+
+
 __all__ = ["JobScheduler", "TrainJob", "ServeJob", "Job", "JobContext",
            "DeviceFleet", "DeviceLostError", "TERMINAL",
            "set_default", "default_scheduler", "jobs_snapshot",
-           "http_jobs_get", "http_jobs_post"]
+           "http_jobs_get", "http_jobs_post",
+           "http_workers_get", "http_workers_post"]

@@ -106,6 +106,18 @@ def maybe_init_distributed(env: Optional[dict] = None) -> bool:
     return _dist_initialized
 
 
+def worker_env(coordinator: str, num_processes: int,
+               process_id: int) -> dict:
+    """The env-var bundle a supervisor injects into a spawned worker
+    process so ``maybe_init_distributed()`` joins it to the multi-host
+    job — the one place the ``jax.distributed`` bootstrap contract is
+    spelled out (``WorkerSupervisor(coordinator=...)`` uses this per
+    worker, rank = the worker's index)."""
+    return {"DL4J_TPU_COORDINATOR": str(coordinator),
+            "DL4J_TPU_NUM_PROCESSES": str(int(num_processes)),
+            "DL4J_TPU_PROCESS_ID": str(int(process_id))}
+
+
 def put_replicated(tree, mesh: Mesh):
     """Replicate a host pytree across the mesh, multi-host safe
     (``make_array_from_callback`` materializes only addressable shards;

@@ -37,7 +37,9 @@ Activation, in priority order:
 Env knobs (read once, on first ``active()`` call):
 ``DL4J_TPU_CHAOS_NAN_STEPS`` (comma-separated batch ordinals),
 ``DL4J_TPU_CHAOS_TRANSFER_P`` (float probability),
-``DL4J_TPU_CHAOS_PREEMPT_AT`` (step count), ``DL4J_TPU_CHAOS_SEED``.
+``DL4J_TPU_CHAOS_PREEMPT_AT`` (``<step>`` = raise SIGTERM;
+``<step>,<deadline_s>`` = deliver a fake maintenance NOTICE with that
+grace window — the phase-2 notice drill), ``DL4J_TPU_CHAOS_SEED``.
 
 Every injection lands in the telemetry registry as
 ``dl4j_tpu_chaos_injected_total{kind=...}`` so a chaos run's report
@@ -59,6 +61,14 @@ import numpy as np
 from deeplearning4j_tpu.profiler import telemetry as _telemetry
 
 log = logging.getLogger("deeplearning4j_tpu")
+
+
+def _flight_record(kind: str, **fields) -> None:
+    """Lazy flight-recorder bridge (chaos loads very early; the
+    recorder import stays off the no-chaos path)."""
+    from deeplearning4j_tpu.profiler import flight_recorder
+
+    flight_recorder.record(kind, **fields)
 
 
 class ChaosTransferError(RuntimeError):
@@ -86,6 +96,12 @@ class ChaosConfig:
     transfer_error_rate: float = 0.0
     #: raise SIGTERM in-process once this many steps have completed
     preempt_at_step: Optional[int] = None
+    #: with a deadline, ``preempt_at_step`` delivers a FAKE MAINTENANCE
+    #: EVENT instead of a signal: ``ft.request_preemption(deadline_s,
+    #: kind="chaos_notice")`` — the notice→checkpoint→drain path is
+    #: drillable without a real cluster (env spelling:
+    #: ``DL4J_TPU_CHAOS_PREEMPT_AT=<step>,<deadline_s>``)
+    preempt_deadline_s: Optional[float] = None
     #: stall the training step with this ordinal for ``hang_seconds``
     #: INSIDE the watchdog scope — the hung-not-dead failure mode only
     #: real hardware (a wedged collective, a dead host link) otherwise
@@ -107,11 +123,18 @@ class ChaosConfig:
         preempt = os.environ.get("DL4J_TPU_CHAOS_PREEMPT_AT")
         hang = os.environ.get("DL4J_TPU_CHAOS_HANG_STEP")
         kill = os.environ.get("DL4J_TPU_CHAOS_KILL_AT")
+        # "<step>" = raise SIGTERM; "<step>,<deadline_s>" = deliver a
+        # fake maintenance NOTICE with that grace window
+        preempt_deadline = None
+        if preempt and "," in preempt:
+            preempt, deadline_raw = preempt.split(",", 1)
+            preempt_deadline = float(deadline_raw)
         return ChaosConfig(
             nan_steps=nan_steps,
             transfer_error_rate=float(
                 os.environ.get("DL4J_TPU_CHAOS_TRANSFER_P", "0") or 0),
             preempt_at_step=int(preempt) if preempt else None,
+            preempt_deadline_s=preempt_deadline,
             hang_step=int(hang) if hang else None,
             hang_seconds=float(
                 os.environ.get("DL4J_TPU_CHAOS_HANG_SECONDS", "2") or 2),
@@ -213,15 +236,29 @@ class ChaosMonkey:
         raise WorkerKilledError(
             f"chaos worker kill after {steps_done} steps")
 
-    def maybe_preempt(self, steps_done: int) -> None:
-        """Deliver one real SIGTERM to this process at the configured
-        step count — the fit loop's installed handler turns it into a
-        clean checkpoint-and-exit, exactly as a cluster preemption
-        notice would."""
+    def maybe_preempt(self, steps_done: int, ft=None) -> None:
+        """Deliver one preemption at the configured step count. With
+        no ``preempt_deadline_s``: one real SIGTERM — the fit loop's
+        installed handler turns it into a clean checkpoint-and-exit.
+        With a deadline (and the loop's FaultTolerance passed in): a
+        FAKE MAINTENANCE EVENT — ``ft.request_preemption(deadline_s,
+        kind="chaos_notice")`` — so the notice→checkpoint→drain path
+        is drillable without a real cluster."""
         at = self.config.preempt_at_step
         if at is None or self._preempted or steps_done < at:
             return
         self._preempted = True
+        deadline = self.config.preempt_deadline_s
+        if deadline is not None and ft is not None:
+            self._record("preempt_notice")
+            _flight_record("chaos_injected", fault="preempt_notice",
+                           deadline_s=deadline, step=steps_done)
+            log.warning("CHAOS: delivering fake maintenance notice "
+                        "after %d steps (deadline %.1fs)", steps_done,
+                        deadline)
+            ft.request_preemption(deadline_s=deadline,
+                                  kind="chaos_notice")
+            return
         self._record("preemption")
         log.warning("CHAOS: simulating preemption after %d steps "
                     "(raising SIGTERM)", steps_done)
@@ -270,6 +307,51 @@ def installed(config: ChaosConfig):
         _active = prev
 
 
+def preempt_worker(worker, deadline_s: float = 30.0,
+                   target=None) -> None:
+    """Fake GCE/Borg maintenance event for drills: deliver a
+    preemption NOTICE (grace deadline included) for ``worker`` so the
+    notice→checkpoint→drain path runs without a real cluster.
+
+    ``worker`` is a fleet worker name routed through ``target`` — a
+    ``JobScheduler``, a ``WorkerSupervisor``, or (default) the
+    process's default scheduler — or a ``FaultTolerance`` policy
+    passed directly (the notice lands on it without any control
+    plane). Emits ``chaos_injected{kind=preempt_notice}``."""
+    if _telemetry.enabled():
+        _telemetry.MetricsRegistry.get_default().counter(
+            _telemetry.CHAOS_INJECTED,
+            "faults injected by the chaos harness").inc(
+            kind="preempt_notice")
+    _flight_record("chaos_injected", fault="preempt_notice",
+                   worker=str(worker), deadline_s=deadline_s)
+    log.warning("CHAOS: fake maintenance notice for worker %s "
+                "(deadline %.1fs)", worker, deadline_s)
+    if hasattr(worker, "request_preemption"):   # a FaultTolerance
+        worker.request_preemption(deadline_s=deadline_s,
+                                  kind="chaos_notice")
+        return
+    if target is None:
+        from deeplearning4j_tpu.control import default_scheduler
+
+        target = default_scheduler()
+        if target is None:
+            from deeplearning4j_tpu.control.worker import (
+                default_supervisor,
+            )
+
+            target = default_supervisor()
+    if target is None:
+        raise RuntimeError(
+            "chaos.preempt_worker: no JobScheduler/WorkerSupervisor "
+            "in this process — pass target= (or a FaultTolerance "
+            "directly)")
+    if hasattr(target, "preempt_worker"):       # JobScheduler
+        target.preempt_worker(worker, deadline_s=deadline_s)
+    else:                                       # WorkerSupervisor
+        target.preempt(worker, deadline_s=deadline_s)
+
+
 def hang_replica(engine, seconds: float = 2.0) -> None:
     """Stall a decode engine's scheduler for ``seconds`` at its next
     loop pass — a decode burst that stops making progress without the
@@ -289,5 +371,5 @@ def hang_replica(engine, seconds: float = 2.0) -> None:
 
 
 __all__ = ["ChaosConfig", "ChaosMonkey", "ChaosTransferError",
-           "WorkerKilledError", "hang_replica",
+           "WorkerKilledError", "hang_replica", "preempt_worker",
            "active", "install", "installed"]

@@ -146,6 +146,11 @@ JOBS_DEVICES = "dl4j_tpu_jobs_devices"
 JOBS_THROUGHPUT = "dl4j_tpu_job_throughput"
 JOBS_MFU = "dl4j_tpu_job_mfu"
 JOBS_LATENCY_P50 = "dl4j_tpu_job_request_p50_ms"
+#: control plane phase 2 (control/worker.py, preemption notices)
+JOBS_PREEMPTIONS = "dl4j_tpu_jobs_preemptions_total"
+WORKER_PROCESSES = "dl4j_tpu_worker_processes"
+WORKER_HEARTBEAT_AGE = "dl4j_tpu_worker_heartbeat_age_seconds"
+FT_BUNDLE_IO_RETRIES = "dl4j_tpu_ft_bundle_io_retries_total"
 #: SLO / alerting engine (profiler/slo.py)
 ALERTS_TOTAL = "dl4j_tpu_alerts_total"
 ALERTS_ACTIVE = "dl4j_tpu_alerts_active"
@@ -1147,5 +1152,7 @@ __all__ = [
     "JOBS_SUBMITTED", "JOBS_FINISHED", "JOBS_RESTARTS",
     "JOBS_MIGRATIONS", "JOBS_RUNNING", "JOBS_DEVICES",
     "JOBS_THROUGHPUT", "JOBS_MFU", "JOBS_LATENCY_P50",
+    "JOBS_PREEMPTIONS", "WORKER_PROCESSES", "WORKER_HEARTBEAT_AGE",
+    "FT_BUNDLE_IO_RETRIES",
     "ALERTS_TOTAL", "ALERTS_ACTIVE",
 ]

@@ -32,6 +32,12 @@ Endpoints (stdlib http.server, daemon thread):
     POST /v1/jobs              -> submit via a registered job factory
     POST /v1/jobs/<id>/cancel  -> cancel (train: checkpoint + exit;
          /v1/jobs/<id>/drain      serve: cancel in-flight + shutdown)
+    GET  /v1/workers[/<w>]     -> fleet failure domains + supervised
+                                  worker processes
+    POST /v1/workers/<w>/preempt  -> maintenance notice
+                                  ({"deadline_s": n}): jobs
+                                  checkpoint-and-drain before the kill
+    POST /v1/workers/<w>/restore  -> worker capacity back in the pool
 
 Batching note: ``predict`` requests are served one-by-one; the
 TPU-side win comes from the jit-compiled forward reused across
@@ -282,6 +288,11 @@ class _InferenceHandler(BaseHTTPRequestHandler):
 
             obj, code = control.http_jobs_get(path)
             return self._json(obj, code)
+        if path == "/v1/workers" or path.startswith("/v1/workers/"):
+            from deeplearning4j_tpu import control
+
+            obj, code = control.http_workers_get(path)
+            return self._json(obj, code)
         if path == "/v1/alerts":
             from deeplearning4j_tpu.profiler import slo
 
@@ -292,7 +303,8 @@ class _InferenceHandler(BaseHTTPRequestHandler):
     def do_POST(self):
         ms: JsonModelServer = self.server.model_server  # type: ignore
         path = self.path.rstrip("/")
-        if path == "/v1/jobs" or path.startswith("/v1/jobs/"):
+        if path == "/v1/jobs" or path.startswith("/v1/jobs/") \
+                or path.startswith("/v1/workers/"):
             from deeplearning4j_tpu import control
 
             try:
@@ -300,7 +312,10 @@ class _InferenceHandler(BaseHTTPRequestHandler):
                 payload = json.loads(self.rfile.read(n) or b"{}")
             except Exception as e:
                 return self._json({"error": str(e)}, 400)
-            obj, code = control.http_jobs_post(path, payload)
+            if path.startswith("/v1/workers/"):
+                obj, code = control.http_workers_post(path, payload)
+            else:
+                obj, code = control.http_jobs_post(path, payload)
             return self._json(obj, code)
         if path not in ("/v1/serving/predict", "/v1/serving/generate"):
             return self._json({"error": "not found"}, 404)

@@ -16,6 +16,12 @@ so no CDN scripts). Endpoints:
     POST /v1/jobs[...]                      -> submit (registered
                                                factory) / cancel /
                                                drain / kill_worker
+    GET /v1/workers[/<w>]                   -> fleet failure domains +
+                                               supervised worker
+                                               processes
+    POST /v1/workers/<w>/preempt            -> maintenance notice with
+                                               {"deadline_s": n}
+    POST /v1/workers/<w>/restore            -> capacity back in pool
     GET /v1/alerts                          -> SLO alert states + rule
                                                inventory (live
                                                profiler.slo.SLOEngine)
@@ -332,6 +338,12 @@ class _Handler(BaseHTTPRequestHandler):
 
             obj, code = control.http_jobs_get("/" + "/".join(parts))
             return self._json(obj, code)
+        if parts[0] == "v1" and len(parts) >= 2 \
+                and parts[1] == "workers":
+            from deeplearning4j_tpu import control
+
+            obj, code = control.http_workers_get("/" + "/".join(parts))
+            return self._json(obj, code)
         if parts[0] == "v1" and len(parts) == 2 and parts[1] == "alerts":
             from deeplearning4j_tpu.profiler import slo
 
@@ -343,7 +355,8 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_POST(self):
         path = self.path.rstrip("/")
-        if path == "/v1/jobs" or path.startswith("/v1/jobs/"):
+        if path == "/v1/jobs" or path.startswith("/v1/jobs/") \
+                or path.startswith("/v1/workers/"):
             from deeplearning4j_tpu import control
 
             try:
@@ -351,7 +364,10 @@ class _Handler(BaseHTTPRequestHandler):
                 payload = json.loads(self.rfile.read(n) or b"{}")
             except Exception as e:
                 return self._json({"error": str(e)}, 400)
-            obj, code = control.http_jobs_post(path, payload)
+            if path.startswith("/v1/workers/"):
+                obj, code = control.http_workers_post(path, payload)
+            else:
+                obj, code = control.http_jobs_post(path, payload)
             return self._json(obj, code)
         # multi-host span aggregation: worker hosts push their per-span
         # aggregates here (tracing.push_spans) so the coordinator's

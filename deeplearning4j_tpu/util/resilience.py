@@ -53,6 +53,7 @@ import hashlib
 import json
 import logging
 import os
+import random
 import re
 import shutil
 import signal
@@ -62,7 +63,7 @@ import threading
 import time
 import uuid
 from collections import deque
-from typing import Any, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -134,8 +135,23 @@ def _write_zero_shards(tmp: str, trainer) -> Optional[str]:
     return member
 
 
+def _host_identity() -> Tuple[int, int]:
+    """(process_index, process_count) of this host — 0/1 when jax (or
+    its distributed runtime) is not up, so pure-host bundle tooling
+    never forces a backend."""
+    try:
+        import jax
+
+        return int(jax.process_index()), int(jax.process_count())
+    except Exception:
+        return 0, 1
+
+
 def write_bundle(directory: str, model, resume_meta: Dict[str, Any],
-                 keep_last: int = 2, trainer=None) -> str:
+                 keep_last: int = 2, trainer=None,
+                 process_index: Optional[int] = None,
+                 process_count: Optional[int] = None,
+                 host: Optional[str] = None) -> str:
     """Write one atomic resumable bundle under ``directory`` and prune
     to the newest ``keep_last``. Layout::
 
@@ -181,6 +197,10 @@ def write_bundle(directory: str, model, resume_meta: Dict[str, Any],
             f.flush()
             os.fsync(f.fileno())
 
+    if process_index is None or process_count is None:
+        pidx, pcnt = _host_identity()
+        process_index = pidx if process_index is None else process_index
+        process_count = pcnt if process_count is None else process_count
     try:
         # writeModel is itself atomic (temp + fsync + replace) inside tmp
         ModelSerializer.writeModel(model, os.path.join(tmp, "model.zip"))
@@ -190,20 +210,38 @@ def write_bundle(directory: str, model, resume_meta: Dict[str, Any],
         zmember = _write_zero_shards(tmp, trainer)
         if zmember is not None:
             members.append(zmember)
-        _write_member("manifest.json", {
+        manifest = {
             "format": _RESUME_FORMAT,
             "iteration": iteration,
             "mesh": _mesh_topology(trainer),
+            "host": host if host is not None
+            else f"p{process_index}",
             "digests": {m: _sha256(os.path.join(tmp, m))
                         for m in members},
-        })
+        }
+        shared_protocol = zmember is not None and process_count > 1
+        if shared_protocol:
+            # shared-filesystem contract: every host owns one shard
+            # member, and a bundle is COMPLETE only when all of them
+            # have been published (foreign shards carry .sha256
+            # sidecars — see publish_foreign_shard / _bundle_complete)
+            manifest["expected_shards"] = [
+                f"zero_shards_p{i}.npz" for i in range(process_count)]
+        _write_member("manifest.json", manifest)
         fsync_directory(tmp)
         os.replace(tmp, final)
         fsync_directory(directory)
     finally:
         if os.path.isdir(tmp):
             shutil.rmtree(tmp, ignore_errors=True)
-    _prune_bundles(directory, keep_last)
+    # the process-0-only pruning rule exists for the SHARED multi-host
+    # shard protocol (a peer's still-publishing shard must not be
+    # pruned out from under it); hosts writing independent full
+    # bundles (no expected_shards) keep the historical per-host
+    # keep_last enforcement — their directories may be private disks
+    _prune_bundles(directory, keep_last,
+                   process_index=process_index if shared_protocol
+                   else 0)
     return final
 
 
@@ -223,14 +261,127 @@ def _list_bundles(directory: str) -> List[Tuple[int, str]]:
     return sorted(out, key=lambda t: (t[0], t[1]), reverse=True)
 
 
-def _prune_bundles(directory: str, keep_last: int) -> None:
-    for _, path in _list_bundles(directory)[max(keep_last, 1):]:
+def _bundle_complete(path: str) -> bool:
+    """Cheap multi-host completeness probe (NO digest pass): the
+    manifest parses and every expected per-host shard member is
+    present with its integrity record (manifest digest for the
+    writing host, ``.sha256`` sidecar for foreign hosts). Single-host
+    bundles have no ``expected_shards`` and are complete iff the
+    manifest parses."""
+    try:
+        with open(os.path.join(path, "manifest.json")) as f:
+            manifest = json.load(f)
+        if manifest.get("format") != _RESUME_FORMAT:
+            return False
+        digests = manifest.get("digests", {})
+        for member in manifest.get("expected_shards", []):
+            if not os.path.exists(os.path.join(path, member)):
+                return False
+            if member not in digests and not os.path.exists(
+                    os.path.join(path, member + ".sha256")):
+                return False
+        return True
+    except (OSError, ValueError):
+        return False
+
+
+def _prune_bundles(directory: str, keep_last: int,
+                   process_index: Optional[int] = None) -> None:
+    """keep_last enforcement, multi-host safe: ONLY process 0 prunes
+    (each host pruning independently is a race against a slower host
+    still publishing its shard), keep_last counts only COMPLETE
+    bundles (every expected per-host shard present — see
+    ``_bundle_complete``), and an incomplete bundle at or newer than
+    the pruning cutoff is never deleted: it is a slower host's
+    still-being-written checkpoint, not garbage. Incomplete bundles
+    OLDER than the cutoff are torn leftovers and go."""
+    if process_index is None:
+        process_index = _host_identity()[0]
+    if process_index != 0:
+        return
+    bundles = _list_bundles(directory)
+    complete = [(it, p) for it, p in bundles if _bundle_complete(p)]
+    if not complete:
+        return
+    kept = complete[:max(keep_last, 1)]
+    keep = {p for _, p in kept}
+    cutoff = kept[-1][0]        # iteration of the oldest kept bundle
+    for it, path in bundles:
+        if path in keep:
+            continue
+        if it >= cutoff and not _bundle_complete(path):
+            continue            # a slow host may still be publishing
         shutil.rmtree(path, ignore_errors=True)
 
 
-def validate_bundle(path: str) -> bool:
+def _await_bundle_for_iteration(directory: str, iteration: int,
+                                member: str,
+                                timeout_s: float) -> str:
+    """The bundle dir a NON-zero host must attach its shard to: the
+    newest dir process 0 published for ``iteration`` that does not
+    yet hold ``member``. Resolved by LISTING, never by recomputing
+    the name — a re-preemption at the same step makes process 0
+    publish a ``-k``-suffixed dir, and writing the shard into the
+    unsuffixed older one would corrupt a bundle that already
+    validated."""
+    deadline = time.monotonic() + timeout_s
+    while True:
+        cands = [p for it, p in _list_bundles(directory)
+                 if it == iteration]
+        # _list_bundles sorts suffixed (newer) dirs first at equal
+        # iteration; prefer the newest one still missing our shard
+        for p in cands:
+            if not os.path.exists(os.path.join(p, member)):
+                return p
+        if cands:
+            return cands[0]
+        if time.monotonic() > deadline:
+            raise OSError(
+                f"no bundle for iteration {iteration} was published "
+                f"by process 0 within {timeout_s}s — cannot attach "
+                f"shard {member}")
+        time.sleep(0.05)
+
+
+def publish_foreign_shard(directory: str, iteration: int, member: str,
+                          data: Dict[str, np.ndarray],
+                          timeout_s: float = 10.0) -> str:
+    """Shared-filesystem shard publish for a NON-zero host: wait for
+    process 0 to rename the bundle directory into place, then publish
+    this host's ``zero_shards_p<i>.npz`` next to it atomically
+    (unique tmp + fsync + replace) with a ``.sha256`` sidecar so any
+    survivor can digest-verify it without this host."""
+    bundle_path = _await_bundle_for_iteration(directory, iteration,
+                                              member, timeout_s)
+    from deeplearning4j_tpu.util.model_serializer import (
+        fsync_directory, unique_tmp_path,
+    )
+
+    final = os.path.join(bundle_path, member)
+    tmp = unique_tmp_path(final)
+    with open(tmp, "wb") as f:
+        np.savez(f, **data)
+        f.flush()
+        os.fsync(f.fileno())
+    digest = _sha256(tmp)
+    with open(tmp + ".sha", "w") as f:
+        f.write(digest)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp + ".sha", final + ".sha256")
+    os.replace(tmp, final)
+    fsync_directory(bundle_path)
+    return final
+
+
+def validate_bundle(path: str, raise_io: bool = False) -> bool:
     """True iff the manifest parses and every member's sha256 matches —
-    the corruption detector behind newest-valid discovery."""
+    the corruption detector behind newest-valid discovery. Foreign
+    per-host shards (``expected_shards`` beyond this host's manifest
+    digests) verify against their ``.sha256`` sidecars. With
+    ``raise_io`` an OSError propagates instead of reading as
+    corruption — the shared-filesystem retry loop's hook (a transient
+    NFS hiccup must not condemn a good bundle)."""
     try:
         with open(os.path.join(path, "manifest.json")) as f:
             manifest = json.load(f)
@@ -239,10 +390,20 @@ def validate_bundle(path: str) -> bool:
         for member, digest in manifest["digests"].items():
             if _sha256(os.path.join(path, member)) != digest:
                 return False
+        for member in manifest.get("expected_shards", []):
+            if member in manifest["digests"]:
+                continue
+            with open(os.path.join(path, member + ".sha256")) as f:
+                if _sha256(os.path.join(path, member)) != f.read().strip():
+                    return False
         with open(os.path.join(path, "resume.json")) as f:
             json.load(f)
         return True
-    except (OSError, ValueError, KeyError):
+    except OSError:
+        if raise_io:
+            raise
+        return False
+    except (ValueError, KeyError):
         return False
 
 
@@ -264,6 +425,360 @@ def retire_bundles(directory: str) -> None:
     the finished run's final state."""
     for _, path in _list_bundles(directory):
         shutil.rmtree(path, ignore_errors=True)
+
+
+# ======================================================================
+# bundle stores
+# ======================================================================
+class BundleStore:
+    """Where resumable bundles live and how survivors discover them.
+
+    The base class is the PR 4 story: one local directory, this
+    process the only writer, discovery = newest digest-valid dir. The
+    control plane's phase-2 migration needs more: when a WORKER HOST
+    dies, its local disk dies with it, so the surviving host that
+    inherits the job must find the bundle somewhere it can reach —
+    that is ``SharedFSBundleStore``. ``FaultTolerance`` accepts either
+    (``bundle_store=``); ``checkpoint_dir=`` keeps meaning a plain
+    local store.
+
+    ``io_retries``/``io_backoff``: transient-I/O posture. Local disks
+    failing is fatal (0 retries keeps the historical fail-fast);
+    shared filesystems hiccup routinely, so the shared store retries
+    ``OSError`` with exponential backoff + jitter before declaring a
+    bundle invalid or falling back to the previous one
+    (``dl4j_tpu_ft_bundle_io_retries_total`` counts, mirroring the
+    PR 4 transfer-retry policy)."""
+
+    kind = "local"
+
+    def __init__(self, directory, *, io_retries: int = 0,
+                 io_backoff: float = 0.05):
+        self.directory = os.fspath(directory)
+        self.io_retries = int(io_retries)
+        self.io_backoff = float(io_backoff)
+
+    # ------------------------------------------------------------ retry
+    def _retrying(self, what: str, fn: Callable, *a, **kw):
+        attempt = 0
+        while True:
+            try:
+                return fn(*a, **kw)
+            except OSError as e:
+                if attempt >= self.io_retries:
+                    raise
+                attempt += 1
+                delay = self.io_backoff * (2 ** (attempt - 1)) \
+                    * (1.0 + random.random())
+                if _telemetry.enabled():
+                    _telemetry.MetricsRegistry.get_default().counter(
+                        _telemetry.FT_BUNDLE_IO_RETRIES,
+                        "transient bundle-store I/O failures retried "
+                        "with backoff").inc(op=what)
+                log.warning(
+                    "resilience: transient bundle-store I/O failure "
+                    "during %s (%s: %s) — retry %d/%d in %.2fs",
+                    what, type(e).__name__, e, attempt,
+                    self.io_retries, delay)
+                time.sleep(delay)
+
+    # -------------------------------------------------------------- api
+    def write(self, model, resume_meta: Dict[str, Any],
+              keep_last: int = 2, trainer=None) -> str:
+        return self._retrying(
+            "write_bundle", write_bundle, self.directory, model,
+            resume_meta, keep_last=keep_last, trainer=trainer)
+
+    def _validate_once(self, path: str) -> bool:
+        try:
+            return validate_bundle(path, raise_io=True)
+        except FileNotFoundError:
+            # an ABSENT member is incompleteness (a slower host still
+            # publishing, or a torn bundle) — retrying the read won't
+            # make it appear; only EIO/ESTALE-class errors are the
+            # transient filesystem hiccups the backoff exists for
+            return False
+
+    def validate(self, path: str) -> bool:
+        try:
+            return self._retrying("validate_bundle",
+                                  self._validate_once, path)
+        except OSError:
+            # the retry budget is spent: NOW it reads as corruption and
+            # discovery falls back to the previous bundle
+            return False
+
+    def latest_valid(self) -> Optional[str]:
+        try:
+            bundles = self._retrying("list_bundles", _list_bundles,
+                                     self.directory)
+        except OSError:
+            return None
+        for _, path in bundles:
+            if self.validate(path):
+                return path
+            log.warning("resilience: bundle %s failed digest "
+                        "validation — falling back to the previous "
+                        "one", path)
+        return None
+
+    def discover(self) -> List[Dict[str, Any]]:
+        """Every bundle the store can see, newest first — including
+        who wrote it and whether it is complete/valid. The cross-host
+        survivor's view: after a worker host dies, any other host
+        enumerates the dead host's checkpoints here."""
+        out = []
+        for it, path in _list_bundles(self.directory):
+            host = None
+            try:
+                with open(os.path.join(path, "manifest.json")) as f:
+                    host = json.load(f).get("host")
+            except (OSError, ValueError):
+                pass
+            out.append({"iteration": it, "path": path, "host": host,
+                        "complete": _bundle_complete(path),
+                        "valid": self.validate(path)})
+        return out
+
+    def retire(self) -> None:
+        retire_bundles(self.directory)
+
+    def describe(self) -> str:
+        return f"{self.kind}:{self.directory}"
+
+
+class LocalBundleStore(BundleStore):
+    """Single-host local-directory store — the explicit spelling of
+    ``FaultTolerance(checkpoint_dir=...)``."""
+
+
+class SharedFSBundleStore(BundleStore):
+    """Bundle store on a shared/remote filesystem (NFS, Lustre, a
+    FUSE-mounted object bucket): one namespace directory that EVERY
+    worker host mounts, so a bundle written by a host that later died
+    restores on any survivor.
+
+    Multi-host writes: process 0 publishes the canonical bundle
+    (model.zip + resume.json + manifest listing every expected
+    per-host shard); other processes attach their
+    ``zero_shards_p<i>.npz`` via ``publish_foreign_shard`` (atomic,
+    sidecar-digested). Only process 0 prunes, and only around
+    COMPLETE bundles — see ``_prune_bundles`` for the race this
+    closes. Transient ``OSError`` retries with backoff + jitter are on
+    by default (``io_retries=4``)."""
+
+    kind = "shared_fs"
+
+    def __init__(self, root, namespace: str = "default", *,
+                 io_retries: int = 4, io_backoff: float = 0.05,
+                 publish_wait_s: float = 10.0,
+                 process_index: Optional[int] = None,
+                 process_count: Optional[int] = None):
+        super().__init__(os.path.join(os.fspath(root), namespace),
+                         io_retries=io_retries, io_backoff=io_backoff)
+        self.namespace = str(namespace)
+        self.publish_wait_s = float(publish_wait_s)
+        # injectable identity: tests (and supervisors spawning workers
+        # that are not jax processes) pin these explicitly
+        self._process_index = process_index
+        self._process_count = process_count
+
+    def _identity(self) -> Tuple[int, int]:
+        if self._process_index is not None:
+            return self._process_index, self._process_count or 1
+        return _host_identity()
+
+    def write(self, model, resume_meta: Dict[str, Any],
+              keep_last: int = 2, trainer=None) -> str:
+        pidx, pcnt = self._identity()
+        if pidx == 0:
+            return self._retrying(
+                "write_bundle", write_bundle, self.directory, model,
+                resume_meta, keep_last=keep_last, trainer=trainer,
+                process_index=pidx, process_count=pcnt)
+        # non-zero host: publish only this host's shard into the
+        # bundle process 0 names (iteration is globally agreed — every
+        # host sits at the same step boundary when a checkpoint fires)
+        iteration = int(model.getIterationCount())
+        z = getattr(trainer, "_zero", None)
+        layout = getattr(trainer, "_zero_layout", None)
+        if z is None or layout is None:
+            # nothing host-local to contribute
+            return os.path.join(self.directory,
+                                f"bundle-{iteration:010d}")
+        shards = layout.addressable_shards(z["masters"], z["opt"])
+        return self._retrying(
+            "publish_foreign_shard", publish_foreign_shard,
+            self.directory, iteration,
+            f"zero_shards_p{pidx}.npz", shards,
+            timeout_s=self.publish_wait_s)
+
+
+# ======================================================================
+# preemption notices
+# ======================================================================
+class PreemptionNotice:
+    """One cluster maintenance announcement: when it arrived, how much
+    time the platform granted before the kill, and through which
+    channel (``signal`` / ``metadata`` / ``http`` / ``api`` /
+    ``chaos_notice``). ``deadline_s=None`` means no enforced deadline
+    (an operator drain)."""
+
+    def __init__(self, deadline_s: Optional[float] = None,
+                 kind: str = "api"):
+        self.wall_t = time.time()
+        self._t0 = time.monotonic()
+        self.deadline_s = (None if deadline_s is None
+                           else float(deadline_s))
+        self.kind = str(kind)
+
+    def remaining(self) -> Optional[float]:
+        if self.deadline_s is None:
+            return None
+        return self.deadline_s - (time.monotonic() - self._t0)
+
+    @property
+    def expired(self) -> bool:
+        r = self.remaining()
+        return r is not None and r <= 0.0
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {"kind": self.kind, "deadline_s": self.deadline_s,
+                "remaining_s": self.remaining(), "wall_t": self.wall_t}
+
+
+class NoticePoller:
+    """GCE/Borg-style maintenance-event watcher: a daemon thread polls
+    a metadata source and converts the first maintenance announcement
+    into ``ft.request_preemption(deadline_s, kind="metadata")`` — the
+    job checkpoints and drains BEFORE the platform kill instead of
+    recovering after it.
+
+    Sources (either/both; first hit wins, then the poller stops):
+
+    - ``file``: a path whose EXISTENCE is the event (the control
+      socket/file-lease spelling a ``WorkerSupervisor`` uses, and the
+      chaos drill's fake event). Contents may be a JSON object
+      (``{"deadline_s": 30}``), a bare number of seconds, or empty
+      (``default_deadline_s`` applies).
+    - ``url``: polled with GET — the GCE metadata contract: a body of
+      ``NONE`` (or an unreachable endpoint) means no event;
+      ``TERMINATE``/``MIGRATE_ON_MAINTENANCE``-style bodies or a JSON
+      object mean preempt.
+
+    ``run_fit`` starts one automatically when
+    ``DL4J_TPU_PREEMPT_NOTICE_FILE`` / ``DL4J_TPU_PREEMPT_METADATA_URL``
+    are set, so any policy-driven fit honors cluster notices with zero
+    code changes."""
+
+    def __init__(self, ft: "FaultTolerance", *,
+                 file: Optional[str] = None, url: Optional[str] = None,
+                 poll_s: float = 0.2,
+                 default_deadline_s: float = 30.0):
+        if file is None and url is None:
+            raise ValueError("NoticePoller needs a file or url source")
+        self.ft = ft
+        self.file = file
+        self.url = url
+        self.poll_s = float(poll_s)
+        self.default_deadline_s = float(default_deadline_s)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.delivered = False
+
+    @staticmethod
+    def from_env(ft: "FaultTolerance",
+                 env=None) -> Optional["NoticePoller"]:
+        e = env if env is not None else os.environ
+        file = e.get("DL4J_TPU_PREEMPT_NOTICE_FILE")
+        url = e.get("DL4J_TPU_PREEMPT_METADATA_URL")
+        if not file and not url:
+            return None
+        return NoticePoller(
+            ft, file=file or None, url=url or None,
+            poll_s=float(e.get("DL4J_TPU_PREEMPT_POLL_S", "0.2") or 0.2),
+            default_deadline_s=float(
+                e.get("DL4J_TPU_PREEMPT_DEADLINE_S", "30") or 30))
+
+    # ---------------------------------------------------------- sources
+    def _parse_body(self, body: str) -> Optional[float]:
+        """deadline_s from a source body; None = default deadline.
+        Raises ValueError for a no-event body."""
+        body = (body or "").strip()
+        if not body:
+            return None
+        try:
+            obj = json.loads(body)
+        except ValueError:
+            if body.upper().startswith(("TERMINATE", "MIGRATE")):
+                return None
+            raise
+        if isinstance(obj, dict):
+            d = obj.get("deadline_s")
+            return None if d is None else float(d)
+        return float(obj)
+
+    def check_once(self) -> bool:
+        """One poll pass; True when a notice was delivered."""
+        if self.file and os.path.exists(self.file):
+            try:
+                with open(self.file) as f:
+                    deadline = self._parse_body(f.read())
+            except (OSError, ValueError):
+                deadline = None
+            self._deliver(deadline)
+            return True
+        if self.url:
+            try:
+                import urllib.request
+
+                with urllib.request.urlopen(self.url, timeout=2) as r:
+                    body = r.read().decode("utf-8", "replace")
+            except Exception:
+                return False     # unreachable metadata = no event
+            if body.strip().upper() in ("", "NONE", "FALSE", "0"):
+                return False
+            try:
+                deadline = self._parse_body(body)
+            except ValueError:
+                return False
+            self._deliver(deadline)
+            return True
+        return False
+
+    def _deliver(self, deadline_s: Optional[float]) -> None:
+        self.delivered = True
+        if deadline_s is None:
+            deadline_s = self.default_deadline_s
+        log.warning("resilience: maintenance notice from %s — "
+                    "checkpoint-and-drain within %.1fs",
+                    self.file or self.url, deadline_s)
+        self.ft.request_preemption(deadline_s=deadline_s,
+                                   kind="metadata")
+
+    # ----------------------------------------------------------- thread
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                if self.check_once():
+                    return       # one-shot: the notice is delivered
+            except Exception:
+                log.exception("resilience: notice poller pass failed")
+            self._stop.wait(self.poll_s)
+
+    def start(self) -> "NoticePoller":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True, name="NoticePoller")
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(2.0)
+            self._thread = None
 
 
 # ======================================================================
@@ -432,7 +947,25 @@ class FaultTolerance:
                  flight_dir: Optional[str] = None,
                  checkpoint_every: Optional[int] = None,
                  context: str = "train_step",
-                 on_stall=None):
+                 on_stall=None,
+                 bundle_store: Optional[BundleStore] = None):
+        self.bundle_store = bundle_store
+        if bundle_store is not None:
+            if checkpoint_dir \
+                    and os.fspath(checkpoint_dir) != bundle_store.directory:
+                # both given: the EXPLICIT store wins — silently
+                # writing to a local dir would defeat the exact
+                # survivor-discovery the store was configured for
+                log.warning(
+                    "FaultTolerance: both checkpoint_dir=%s and "
+                    "bundle_store=%s were given — the bundle store "
+                    "wins; bundles will NOT be written to the "
+                    "checkpoint_dir", checkpoint_dir,
+                    bundle_store.describe())
+            # the store's directory doubles as the checkpoint anchor so
+            # every "is checkpointing configured" gate (and the
+            # incident-dir default) keeps working unchanged
+            checkpoint_dir = bundle_store.directory
         self.checkpoint_dir = checkpoint_dir
         self.auto_resume = auto_resume
         self.keep_last = max(int(keep_last), 1)
@@ -458,10 +991,13 @@ class FaultTolerance:
         self.context = str(context)
         self.on_stall = on_stall
         self._preempt = threading.Event()
-        # single-slot holder, not a plain attribute: resolve_policy's
-        # shallow copy shares the LIST object (like _preempt), so an
-        # inject_fault on the original lands in the copy's running fit
+        # single-slot holders, not plain attributes: resolve_policy's
+        # shallow copy shares the LIST objects (like _preempt), so an
+        # inject_fault / preemption notice on the original lands in
+        # the copy's running fit
         self._fault_box: List[Optional[BaseException]] = [None]
+        self._notice_box: List[Optional[PreemptionNotice]] = [None]
+        self._ckpt_count = [0]
         self._warned_stateless = False
 
     def incident_dir(self) -> Optional[str]:
@@ -478,10 +1014,53 @@ class FaultTolerance:
     def preemption_requested(self) -> bool:
         return self._preempt.is_set()
 
-    def request_preemption(self) -> None:
-        """Programmatic preemption notice (what the signal handler
-        calls; also usable directly, e.g. from a cluster-notice
-        poller thread)."""
+    @property
+    def notice(self) -> Optional[PreemptionNotice]:
+        """The live preemption notice (None when preemption was never
+        requested, or the last one was consumed by a checkpoint)."""
+        return self._notice_box[0]
+
+    @property
+    def preemptions_checkpointed(self) -> int:
+        """Preemption checkpoints this policy has written — how a
+        caller (the worker runner, a drill) tells a drained-by-notice
+        exit from a normal completion."""
+        return self._ckpt_count[0]
+
+    def store(self) -> Optional[BundleStore]:
+        """The bundle store checkpoints go to / resume comes from:
+        the explicit ``bundle_store`` when its directory is still the
+        policy's checkpoint anchor, else a plain local store over
+        ``checkpoint_dir`` (the historical behavior), else None."""
+        if self.bundle_store is not None \
+                and (not self.checkpoint_dir
+                     or self.checkpoint_dir == self.bundle_store.directory):
+            return self.bundle_store
+        if self.checkpoint_dir:
+            return LocalBundleStore(self.checkpoint_dir)
+        return None
+
+    def request_preemption(self, deadline_s: Optional[float] = None,
+                           kind: str = "api") -> None:
+        """Preemption notice: checkpoint ONE resumable bundle at the
+        next step boundary, then exit the fit cleanly. Callable from
+        any thread (the signal handler, a metadata poller, the
+        scheduler, an HTTP handler). ``deadline_s`` is the platform's
+        grace window — when notices stack, the EARLIEST absolute
+        deadline wins; the checkpoint path records whether the bundle
+        landed inside it. A notice whose window is shorter than a
+        step cannot be honored in time — the kill lands first and
+        recovery degrades to the newest periodic bundle (the
+        SIGKILL-equivalent story)."""
+        notice = PreemptionNotice(deadline_s, kind)
+        prev = self._notice_box[0]
+        mine, theirs = notice.remaining(), \
+            prev.remaining() if prev is not None else None
+        if prev is None or (mine is not None
+                            and (theirs is None or mine < theirs)):
+            self._notice_box[0] = notice
+        _flight.record("preemption_notice", notice_kind=kind,
+                       deadline_s=deadline_s, context=self.context)
         self._preempt.set()
 
     def inject_fault(self, exc: BaseException) -> None:
@@ -516,7 +1095,7 @@ class FaultTolerance:
                 # period enforcer) wants out NOW
                 raise KeyboardInterrupt(
                     f"signal {signum} received twice during training")
-            self._preempt.set()
+            self.request_preemption(kind="signal")
             log.warning(
                 "resilience: signal %s received — writing a resumable "
                 "checkpoint at the next step boundary, then exiting",
@@ -843,10 +1422,11 @@ def _write_preemption_checkpoint(ft: FaultTolerance, adapter: _FitAdapter,
                 "for exact mid-epoch resume", type(it).__name__)
         mid = False
     adapter.finish()   # sync the sharded trainer's canonical trees
-    if not ft.checkpoint_dir:
+    store = ft.store()
+    if store is None:
         log.warning("resilience: preemption requested but no "
-                    "checkpoint_dir configured — exiting WITHOUT a "
-                    "resumable checkpoint")
+                    "checkpoint_dir/bundle_store configured — exiting "
+                    "WITHOUT a resumable checkpoint")
         return
     meta = {
         "rng": _rng_key_data(adapter.model),
@@ -855,13 +1435,26 @@ def _write_preemption_checkpoint(ft: FaultTolerance, adapter: _FitAdapter,
         "mid_epoch": mid,
         "wall_time": time.time(),
     }
-    path = write_bundle(ft.checkpoint_dir, adapter.model, meta,
-                        keep_last=ft.keep_last, trainer=adapter.trainer)
+    path = store.write(adapter.model, meta, keep_last=ft.keep_last,
+                       trainer=adapter.trainer)
+    ft._ckpt_count[0] += 1
     if _telemetry.enabled():
         _telemetry.MetricsRegistry.get_default().counter(
             _telemetry.FT_PREEMPTION_CHECKPOINTS,
             "resumable bundles written in response to a preemption "
             "signal").inc()
+    # deadline accounting: did the bundle land inside the notice's
+    # grace window? A negative margin means the platform kill beat the
+    # step boundary — this checkpoint is best-effort and recovery is
+    # really the newest periodic bundle's job
+    notice = ft.notice
+    margin = notice.remaining() if notice is not None else None
+    if notice is not None and notice.expired:
+        log.warning(
+            "resilience: preemption checkpoint landed %.2fs AFTER the "
+            "%.1fs notice deadline — the platform kill may have "
+            "preceded it; periodic bundles are the recovery floor",
+            -margin, notice.deadline_s)
     # the bundle restores the run; the flight dump explains the exit —
     # written AFTER the bundle so a grace-period kill mid-dump still
     # leaves a resumable job
@@ -870,7 +1463,10 @@ def _write_preemption_checkpoint(ft: FaultTolerance, adapter: _FitAdapter,
                      iteration=adapter.model.getIterationCount(),
                      bundle=path,
                      epochs_remaining=meta["epochs_remaining"],
-                     mid_epoch=mid)
+                     mid_epoch=mid,
+                     notice_kind=(notice.kind if notice else None),
+                     deadline_margin_s=margin,
+                     deadline_missed=bool(notice and notice.expired))
     log.warning("resilience: preemption checkpoint written to %s "
                 "(iteration %d, %d epoch(s) remaining%s) — exiting "
                 "cleanly", path, adapter.model.getIterationCount(),
@@ -888,7 +1484,8 @@ def _write_periodic_checkpoint(ft: FaultTolerance, adapter: _FitAdapter,
     ``WorkerKilledError``): at most ``checkpoint_every`` steps are
     ever lost, and the replay from the bundle is bit-identical
     (RNG + iterator position + updater state all ride along)."""
-    if not ft.checkpoint_dir:
+    store = ft.store()
+    if store is None:
         return
     ist = _try_get_state(it)
     if ist is None:
@@ -910,8 +1507,8 @@ def _write_periodic_checkpoint(ft: FaultTolerance, adapter: _FitAdapter,
         "periodic": True,
         "wall_time": time.time(),
     }
-    path = write_bundle(ft.checkpoint_dir, adapter.model, meta,
-                        keep_last=ft.keep_last, trainer=adapter.trainer)
+    path = store.write(adapter.model, meta, keep_last=ft.keep_last,
+                       trainer=adapter.trainer)
     if _telemetry.enabled():
         _telemetry.MetricsRegistry.get_default().counter(
             _telemetry.FT_PERIODIC_CHECKPOINTS,
@@ -1135,10 +1732,16 @@ def run_fit(model, fault_tolerance: Optional[FaultTolerance], data,
             "epochs > 1 requires a resettable iterator "
             "(reference behavior)")
     prev_retry = _configure_prefetch_retry(ft, it)
+    # cluster-notice wiring (metadata-poll stub): a maintenance event
+    # announced through the env-configured source preempts this fit
+    poller = NoticePoller.from_env(ft)
+    if poller is not None:
+        poller.start()
 
     resumed = None
-    if ft.auto_resume and ft.checkpoint_dir:
-        bundle = latest_valid_bundle(ft.checkpoint_dir)
+    store = ft.store()
+    if ft.auto_resume and store is not None:
+        bundle = store.latest_valid()
         if bundle is not None:
             resumed = _restore_bundle(adapter, bundle)
 
@@ -1183,16 +1786,18 @@ def run_fit(model, fault_tolerance: Optional[FaultTolerance], data,
                 if was_iterator:
                     adapter.end_epoch()
     finally:
+        if poller is not None:
+            poller.stop()
         if prev_retry is not None:
             # the retry posture belongs to THIS policy-driven fit: a
             # later plain fit() on the same iterator must get the
             # legacy fail-fast behavior back
             it.configure_retries(*prev_retry)
     adapter.finish()
-    if ft.auto_resume and ft.checkpoint_dir:
+    if ft.auto_resume and store is not None:
         # the run finished: retire its bundles so the next fit on this
         # directory starts fresh instead of reviving a completed run
-        retire_bundles(ft.checkpoint_dir)
+        store.retire()
     return model
 
 
@@ -1253,7 +1858,7 @@ def _run_epoch(ft: FaultTolerance, adapter: _FitAdapter, it,
             _check_divergence(ft, adapter, st)
         if monkey is not None:
             monkey.maybe_kill(st.steps_done)   # raises: no checkpoint
-            monkey.maybe_preempt(st.steps_done)
+            monkey.maybe_preempt(st.steps_done, ft=ft)
         fault = ft._fault_box[0]
         if fault is not None:
             # SIGKILL-equivalent (inject_fault): die with NO
@@ -1264,8 +1869,10 @@ def _run_epoch(ft: FaultTolerance, adapter: _FitAdapter, it,
             _write_preemption_checkpoint(ft, adapter, it, epoch_idx,
                                          total_epochs, was_iterator)
             # consumed: the next fit on this (reusable) policy object
-            # must not re-preempt off a flag already acted on
+            # must not re-preempt off a flag (or notice) already
+            # acted on
             ft._preempt.clear()
+            ft._notice_box[0] = None
             return True
         if ft.checkpoint_every \
                 and st.steps_done % ft.checkpoint_every == 0:
@@ -1275,4 +1882,7 @@ def _run_epoch(ft: FaultTolerance, adapter: _FitAdapter, it,
 
 __all__ = ["FaultTolerance", "DivergenceError", "StepWatchdog",
            "run_fit", "resolve_policy", "write_bundle",
-           "latest_valid_bundle", "validate_bundle", "retire_bundles"]
+           "latest_valid_bundle", "validate_bundle", "retire_bundles",
+           "BundleStore", "LocalBundleStore", "SharedFSBundleStore",
+           "PreemptionNotice", "NoticePoller",
+           "publish_foreign_shard"]

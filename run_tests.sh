@@ -1576,6 +1576,231 @@ if [ $ctlgate -ne 0 ]; then
     echo "FATAL: control-plane chaos gate regressed" >&2
     exit 1
 fi
+# Control-plane PHASE-2 drill (docs/CONTROL_PLANE.md "Phase 2"): two
+# REAL worker subprocesses under a WorkerSupervisor, bundles in a
+# SharedFSBundleStore. Phase A: a fake maintenance notice lands
+# mid-fit — the bundle must be digest-valid in the shared store
+# BEFORE the deadline, the task must drain cleanly (outcome
+# "preempted", zero failures), then migrate onto the survivor and
+# finish at the exact step count with loss parity vs an uninterrupted
+# run. Phase B: a worker process is SIGKILLed with NO notice — the
+# survivor must discover the newest periodic bundle through the
+# shared store and finish at the exact step count with loss parity.
+# Workers respawn into capacity; no supervisor thread survives.
+P2_DIR=$(mktemp -d /tmp/dl4j_p2_gate.XXXXXX)
+export DL4J_TPU_P2_GATE_DIR="$P2_DIR"
+env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu DL4J_TPU_TELEMETRY=1 \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python - <<'EOF'
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+GATE = os.environ["DL4J_TPU_P2_GATE_DIR"]
+CTL = os.path.join(GATE, "ctl")
+STORE = os.path.join(GATE, "store")
+os.makedirs(CTL, exist_ok=True)
+fail = []
+
+# the drill's task module, dropped into the control dir (which rides
+# every worker's sys.path)
+with open(os.path.join(CTL, "p2_drill_task.py"), "w") as f:
+    f.write('''
+import time
+
+import numpy as np
+
+
+def build(seed=11):
+    from deeplearning4j_tpu.learning import Adam
+    from deeplearning4j_tpu.nn.conf import (
+        DenseLayer, InputType, NeuralNetConfiguration, OutputLayer,
+    )
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    return MultiLayerNetwork(
+        (NeuralNetConfiguration.builder().seed(seed)
+         .updater(Adam(learning_rate=0.01)).list()
+         .layer(DenseLayer(n_out=8, activation="tanh"))
+         .layer(OutputLayer(n_out=2, activation="softmax",
+                            loss="mcxent"))
+         .setInputType(InputType.feedForward(4)).build())).init()
+
+
+def data(delay, ctx=None):
+    from deeplearning4j_tpu.datasets import ArrayDataSetIterator
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(48, 4)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[(x.sum(1) > 0).astype(int)]
+
+    class It(ArrayDataSetIterator):
+        def next(self):
+            time.sleep(delay)
+            b = super().next()
+            if ctx is not None:
+                ctx.progress(ctx._step_seen + 1)
+                ctx._step_seen += 1
+            return b
+
+    return It(x, y, 8, shuffle=True, seed=5)
+
+
+def fit_task(ctx):
+    net = build()
+    ctx._step_seen = 0
+    net.fit(data(float(ctx.params.get("delay", 0.1)), ctx), epochs=3,
+            fault_tolerance=ctx.fault_tolerance)
+    return {"iteration": int(net.getIterationCount()),
+            "loss": float(net._score)}
+''')
+
+from deeplearning4j_tpu.control import WorkerSupervisor
+from deeplearning4j_tpu.profiler import flight_recorder, telemetry
+from deeplearning4j_tpu.util.resilience import SharedFSBundleStore
+
+# the uninterrupted reference (same seed/data/arch, no delay)
+sys.path.insert(0, CTL)
+import p2_drill_task
+
+ref = p2_drill_task.build()
+ref.fit(p2_drill_task.data(0.0), epochs=3)
+REF_LOSS = float(ref._score)
+REF_ITERS = int(ref.getIterationCount())          # 18
+
+sup = WorkerSupervisor(["w0", "w1"], control_dir=CTL,
+                       heartbeat_s=0.1, lease_s=8.0,
+                       restart_delay_s=0.2)
+sup.start()
+
+
+def wait_step(task, n, timeout=240):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        w = task.worker
+        if task.state == "running" and w is not None \
+                and (sup.workers_status()[w]["step"] or 0) >= n:
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def run_phase(name, namespace, disrupt):
+    ft = {"shared_root": STORE, "namespace": namespace,
+          "checkpoint_every": 3, "divergence_window": 0}
+    task = sup.submit_task("p2_drill_task:fit_task", {"delay": 0.1},
+                           ft=ft)
+    if not wait_step(task, 4):
+        fail.append(f"{name}: task never reached step 4 "
+                    f"({task.status()})")
+        return None
+    disrupt(task)
+    try:
+        task.wait(300)
+    except TimeoutError:
+        fail.append(f"{name}: task never finished ({task.status()})")
+        return None
+    if task.state != "completed":
+        fail.append(f"{name}: task ended {task.state}: {task.error}")
+        return None
+    if task.migrations != 1:
+        fail.append(f"{name}: expected exactly one migration, got "
+                    f"{task.migrations}")
+    if task.result["iteration"] != REF_ITERS:
+        fail.append(f"{name}: finished at iteration "
+                    f"{task.result['iteration']} != {REF_ITERS}")
+    if not np.isclose(task.result["loss"], REF_LOSS, rtol=1e-4):
+        fail.append(f"{name}: loss {task.result['loss']:.6f} deviates "
+                    f"from clean run {REF_LOSS:.6f}")
+    return task
+
+
+# ---- phase A: maintenance notice -> checkpoint before deadline -----
+def notice(task):
+    store = SharedFSBundleStore(STORE, "pA")
+    prev = store.latest_valid()        # periodic bundle from step 3
+    t0 = time.monotonic()
+    deadline_s = 15.0
+    sup.preempt(task.worker, deadline_s=deadline_s)
+    # the notice must produce a NEW preemption bundle (a later step
+    # boundary than any periodic one) inside the grace window
+    while store.latest_valid() == prev \
+            and time.monotonic() - t0 < deadline_s:
+        time.sleep(0.05)
+    landed = time.monotonic() - t0
+    if store.latest_valid() == prev:
+        fail.append("phase A: no NEW digest-valid bundle landed in "
+                    "the shared store before the notice deadline")
+    else:
+        print(f"phase A: preemption bundle landed {landed:.1f}s into "
+              f"the {deadline_s:.0f}s notice window")
+
+
+taskA = run_phase("phase A", "pA", notice)
+if taskA is not None and taskA.error:
+    fail.append(f"phase A: post-notice failure recorded: "
+                f"{taskA.error}")
+events = flight_recorder.get_default().events()
+kinds = [e["kind"] for e in events]
+for k in ("worker_preempt_notice", "worker_task_migrated"):
+    if k not in kinds:
+        fail.append(f"phase A: flight event {k} missing")
+if not any(e["kind"] == "worker_task_migrated"
+           and e.get("reason") == "preempt_notice" for e in events):
+    fail.append("phase A: migration was not the notice-drain kind")
+
+# ---- phase B: SIGKILL, no notice -> periodic-bundle recovery -------
+def sigkill(task):
+    sup.kill(task.worker)
+
+
+# wait for the phase-A worker to respawn so phase B has 2 workers
+deadline = time.time() + 120
+while len(sup.alive()) < 2 and time.time() < deadline:
+    time.sleep(0.1)
+taskB = run_phase("phase B", "pB", sigkill)
+if "worker_process_dead" not in [
+        e["kind"] for e in flight_recorder.get_default().events()]:
+    fail.append("phase B: no worker_process_dead flight event")
+
+# ---- liveness gauges + clean shutdown ------------------------------
+sup._publish_gauges(force=True)
+g = telemetry.MetricsRegistry.get_default().gauge(
+    telemetry.WORKER_PROCESSES)
+alive_gauge = {dict(k).get("state"): v for k, v in g.values().items()}
+if alive_gauge.get("alive", 0) < 1:
+    fail.append(f"worker liveness gauge empty: {alive_gauge}")
+
+procs = [h.proc for h in sup._handles.values() if h.proc is not None]
+sup.shutdown()
+if any(p.poll() is None for p in procs):
+    fail.append("worker processes survived supervisor shutdown")
+time.sleep(1.0)
+leaked = [t.name for t in threading.enumerate()
+          if t.is_alive() and t.name.startswith(
+              ("WorkerSupervisor", "NoticePoller", "WorkerHeartbeat"))]
+if leaked:
+    fail.append(f"threads survived shutdown: {leaked}")
+
+if fail:
+    sys.stderr.write("phase-2 drill FAILED:\n  "
+                     + "\n  ".join(fail) + "\n")
+    sys.exit(1)
+print(f"phase-2 drill OK: noticed worker checkpointed to the shared "
+      f"store before its deadline and drained cleanly; SIGKILLed "
+      f"worker's task migrated onto the survivor via the shared "
+      f"store and finished at iteration {REF_ITERS} with loss parity "
+      f"({REF_LOSS:.6f}); workers respawned; no leaked threads")
+EOF
+p2gate=$?
+rm -rf "$P2_DIR"
+if [ $p2gate -ne 0 ]; then
+    echo "FATAL: control-plane phase-2 drill regressed" >&2
+    exit 1
+fi
 # SLO smoke gate (docs/OBSERVABILITY.md "Alerting and SLOs"): the
 # end-to-end alerting drill. A 2-replica serving fleet under a
 # JobScheduler runs with the SLO engine's p99 burn-rate + queue-

@@ -82,7 +82,10 @@ def pytest_sessionfinish(session, exitstatus):
                                        "ServingPrefillLane",
                                        "JobScheduler",
                                        "JobRunner",
-                                       "SLOEvaluator")))
+                                       "SLOEvaluator",
+                                       "WorkerSupervisor",
+                                       "WorkerHeartbeat",
+                                       "NoticePoller")))
         ]
 
     deadline = time.time() + 2.0
