@@ -87,6 +87,16 @@ def main() -> None:
             seq=128 if on_accel else 32)))
         return
 
+    profile = "--profile" in sys.argv
+    if profile:
+        # roofline attribution round (profiler/programs.py): enable
+        # the program registry BEFORE any compile so every executable
+        # registers, and embed the per-site table + a managed device-
+        # capture bundle in the aggregate line
+        from deeplearning4j_tpu.profiler import programs as _programs
+
+        _programs.set_enabled(True)
+
     platform = jax.devices()[0].platform
     on_accel = platform in ("tpu", "gpu")
     if on_accel:
@@ -123,7 +133,9 @@ def main() -> None:
     from bench_common import aot_cost_flops
     flops_per_step = aot_cost_flops(step, params, opt_state,
                                     jnp.asarray(0), ids, labels,
-                                    mask_pos, rng)
+                                    mask_pos, rng,
+                                    site="bench_bert_step"
+                                    if profile else None)
 
     # warmup / compile
     params, opt_state, loss = step(params, opt_state, jnp.asarray(0),
@@ -276,6 +288,33 @@ def main() -> None:
             line.update(_gpt_decode_metrics())
         except Exception as e:
             line["gpt_decode_error"] = f"{type(e).__name__}: {e}"[:200]
+    if profile:
+        # after the timed windows: one traced step into a digest-valid
+        # capture bundle, then the per-site attribution table — the
+        # evidence the ROADMAP Pallas item wants ("which step is
+        # dispatch/memory-bound"), in the round file itself
+        from deeplearning4j_tpu.profiler import programs as _programs
+
+        def _one_step():
+            out = step(params, opt_state, jnp.asarray(steps + 1), ids,
+                       labels, mask_pos, rng)
+            float(out[-1])   # device->host sync inside the trace
+
+        bundle = _programs.profile_session().capture(
+            0.0, trigger="bench", work=_one_step)
+        snap = _programs.get_default().snapshot(top_n=12)
+        line["profile"] = {
+            "bundle": bundle,
+            "device": snap.get("device"),
+            "peak_source": snap.get("peak_source"),
+            "programs": [
+                {k: p.get(k) for k in (
+                    "site", "verdict", "arithmetic_intensity", "flops",
+                    "bytes_accessed", "dispatches", "dispatch_seconds",
+                    "achieved_flops_per_s", "achieved_gbps", "mfu")
+                 if p.get(k) is not None}
+                for p in snap["programs"]],
+        }
     print(json.dumps(line))
     if regress_msgs:
         import sys

@@ -23,7 +23,7 @@ log = logging.getLogger("deeplearning4j_tpu")
 # divide by the same denominator; re-exported here so existing
 # `from bench_common import peak_flops, PEAK_FLOPS` keeps working
 from deeplearning4j_tpu.profiler.flops import (  # noqa: E402,F401
-    PEAK_FLOPS, peak_flops,
+    PEAK_FLOPS, PEAK_HBM_GBPS, peak_flops, peak_hbm_gbps,
 )
 
 
@@ -40,15 +40,30 @@ def telemetry_snapshot():
     return telemetry.snapshot()
 
 
-def aot_cost_flops(step, *args, **kwargs):
+def aot_cost_flops(step, *args, site=None, **kwargs):
     """Per-step FLOPs from XLA's cost analysis of the compiled step.
 
     Note on double work: the later jitted `step(...)` call re-traces,
     but its XLA compilation hits the compile cache this AOT compile
     populated (measured ~1ms vs ~620ms on this stack), so the extra
-    cost is one trace, not a second compile."""
+    cost is one trace, not a second compile.
+
+    ``site`` additionally registers the executable in the roofline
+    program registry (profiler/programs.py) when that is enabled —
+    ``bench.py --profile`` uses this so the attribution table covers
+    the bench step even though it bypasses instrument_jit."""
     try:
         compiled = step.lower(*args, **kwargs).compile()
+        if site is not None:
+            from deeplearning4j_tpu.profiler import programs
+            from deeplearning4j_tpu.profiler.telemetry import (
+                _arg_signature,
+            )
+
+            if programs.enabled():
+                programs.get_default().register(
+                    site, _arg_signature(args, kwargs), compiled,
+                    source="bench")
         ca = compiled.cost_analysis()
         ca = ca[0] if isinstance(ca, list) else ca
         return float(ca.get("flops", 0.0)) or None

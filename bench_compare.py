@@ -13,8 +13,11 @@ first and compares its fresh line.
 
 Key classification:
 
-- throughput/MFU/speedup metrics are HIGHER-better (the default for a
-  numeric key);
+- ``mfu``/``speedup``/``agreement`` keys (any ``_``-segment) are
+  explicitly HIGHER-better — pinned ahead of the latency heuristic so
+  a ratio named against a latency (``decode_ms_speedup``) can never
+  gate backwards;
+- other numeric keys default to HIGHER-better (throughput family);
 - ``*_ms`` latency keys are LOWER-better;
 - config echoes, band edges, source tags, error strings and the
   self-baseline ratio are skipped (``_SKIP_SUFFIXES`` /
@@ -39,6 +42,18 @@ _SKIP_SUFFIXES = ("_band_lo", "_src", "_error", "_batch", "_hidden",
 _SKIP_KEYS = {"metric", "unit", "vs_baseline",
               # tenancy gauge: tracks CHIP load, not code speed
               "lstm_frozen_window_ms"}
+#: explicitly higher-better families: MFU/utilization ratios,
+#: speedup ratios, numeric agreement scores. Checked BEFORE the
+#: latency heuristic — these used to ride the generic default, so a
+#: future key like "decode_ms_speedup" would have matched the "ms"
+#: segment and gated backwards.
+_HIGHER_SEGMENTS = frozenset({"mfu", "speedup", "agreement"})
+
+
+def _is_higher_key(key: str) -> bool:
+    return not _HIGHER_SEGMENTS.isdisjoint(key.split("_"))
+
+
 #: lower-is-better keys carry an "ms" path segment (step time, TTFT,
 #: p99 gaps): `*_ms`, `*_ms_per_step`, ...
 def _is_latency_key(key: str) -> bool:
@@ -82,6 +97,8 @@ def _classify(key: str, value: Any) -> Optional[str]:
         return "bool"
     if not isinstance(value, (int, float)):
         return None
+    if _is_higher_key(key):
+        return "higher"
     if _is_latency_key(key):
         return "lower"
     return "higher"

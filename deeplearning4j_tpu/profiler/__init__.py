@@ -37,14 +37,15 @@ from deeplearning4j_tpu.profiler.model_health import HealthMonitor
 
 
 def __getattr__(name):
-    # slo is a LAZY attribute (PEP 562): the fit loops and serving
-    # engines import this package for telemetry, and the off-mode
-    # contract is that they never pull in the SLO engine
-    if name == "slo":
+    # slo/programs are LAZY attributes (PEP 562): the fit loops and
+    # serving engines import this package for telemetry, and the
+    # off-mode contract is that they never pull in the SLO engine or
+    # the program registry
+    if name in ("slo", "programs"):
         import importlib
 
         return importlib.import_module(
-            "deeplearning4j_tpu.profiler.slo")
+            f"deeplearning4j_tpu.profiler.{name}")
     raise AttributeError(
         f"module {__name__!r} has no attribute {name!r}")
 
@@ -185,34 +186,45 @@ def check_numerics(tree, mode: ProfilerMode, context: str = "") -> None:
 
 
 # ------------------------------------------------------------ XLA traces
-_trace_active = False
-
-
-def start_trace(log_dir: str) -> None:
+def start_trace(log_dir: str) -> bool:
     """Start a jax.profiler trace (per-op HLO timing — the reference's
-    NodeProfile equivalent, viewable in xprof/TensorBoard)."""
-    global _trace_active
-    jax.profiler.start_trace(log_dir)
-    _trace_active = True
+    NodeProfile equivalent, viewable in xprof/TensorBoard).
+
+    Routed through ``programs.ProfileSession`` — the process has ONE
+    jax.profiler trace slot shared with managed ``capture()`` bundles,
+    and a second ``jax.profiler.start_trace`` raises RuntimeError from
+    inside XLA. Idempotent-with-warning: returns False (no-op) when a
+    trace or managed capture is already active, True when this call
+    started the trace."""
+    from deeplearning4j_tpu.profiler import programs
+
+    return programs.profile_session().start_manual(log_dir)
 
 
-def stop_trace() -> None:
-    global _trace_active
-    if _trace_active:
-        jax.profiler.stop_trace()
-        _trace_active = False
+def stop_trace() -> bool:
+    """Stop an ad-hoc trace started by :func:`start_trace`. No-op
+    (False) when none is active — a managed capture in flight is never
+    stopped from here."""
+    from deeplearning4j_tpu.profiler import programs
+
+    return programs.profile_session().stop_manual()
 
 
 @contextlib.contextmanager
 def trace(log_dir: str):
-    start_trace(log_dir)
+    """Context-managed trace, exception-safe against a FAILED start: a
+    start that did not take the trace slot (already active, or
+    jax.profiler refused) is not stopped on exit, so an outer trace or
+    capture keeps running."""
+    started = start_trace(log_dir)
     try:
         yield
     finally:
-        stop_trace()
+        if started:
+            stop_trace()
 
 
 __all__ = ["OpProfiler", "ProfilerConfig", "ProfilerMode",
            "NumericsException", "check_numerics", "start_trace",
            "stop_trace", "trace", "telemetry", "HealthMonitor",
-           "tracing", "flight_recorder", "slo"]
+           "tracing", "flight_recorder", "slo", "programs"]

@@ -18,7 +18,10 @@ incident happens the last N minutes are already on disk. Here:
 - ``incident(reason, **context)`` appends a terminal event (kind =
   the reason) and atomically dumps the ring: ``events.jsonl`` (one
   event per line), ``trace.json`` (the Chrome-trace snapshot),
-  ``requests.json`` (live + recent request timelines from tracing.py)
+  ``requests.json`` (live + recent request timelines from tracing.py),
+  ``programs.json`` (the roofline program-registry snapshot, present
+  when populated — profiler/programs.py; managed device captures also
+  record a ``profile_capture{trigger,bundle}`` event here)
   and a ``manifest.json`` with sha256 digests of every member —
   written into a dot-tmp dir, fsynced, then renamed into place
   (the same crash-atomic recipe as resilience.write_bundle). The
@@ -223,6 +226,19 @@ class FlightRecorder:
                 requests = {"live": [], "recent": []}
             _write("requests.json", json.dumps(_sanitize(requests)))
             members = ["events.jsonl", "trace.json", "requests.json"]
+            # the roofline program registry rides along when populated
+            # (profiler/programs.py): "what was compiled and where the
+            # device time went" is exactly post-mortem signal
+            try:
+                from deeplearning4j_tpu.profiler import \
+                    programs as _programs
+
+                psnap = _programs.snapshot()
+            except Exception:
+                psnap = {}
+            if psnap:
+                _write("programs.json", json.dumps(_sanitize(psnap)))
+                members.append("programs.json")
             _write("manifest.json", json.dumps({
                 "format": _FORMAT,
                 "reason": reason,
@@ -269,7 +285,8 @@ def load_dump(path: str) -> Dict[str, Any]:
     verifies — the same digest discipline resume bundles use."""
     out: Dict[str, Any] = {"path": path, "valid": False,
                            "manifest": None, "events": [],
-                           "trace": None, "requests": None}
+                           "trace": None, "requests": None,
+                           "programs": None}
     try:
         with open(os.path.join(path, "manifest.json")) as f:
             manifest = json.load(f)
@@ -289,6 +306,9 @@ def load_dump(path: str) -> Dict[str, Any]:
             out["trace"] = json.load(f)
         with open(os.path.join(path, "requests.json")) as f:
             out["requests"] = json.load(f)
+        if "programs.json" in (out["manifest"].get("digests") or {}):
+            with open(os.path.join(path, "programs.json")) as f:
+                out["programs"] = json.load(f)
     except (OSError, ValueError):
         out["valid"] = False
     return out

@@ -23,7 +23,15 @@ PEAK_FLOPS = {
     "TPU v5 lite": {"bf16": 197e12, "f32": 98.5e12},
 }
 
+#: per-chip peak HBM bandwidth in GB/s (decimal GB) keyed by device
+#: kind — the roofline's second axis (profiler/programs.py). Bandwidth
+#: does not depend on compute dtype, so this table is flat.
+PEAK_HBM_GBPS = {
+    "TPU v5 lite": 819.0,
+}
+
 _warned_unknown_peak = set()
+_warned_unknown_hbm = set()
 
 
 def peak_flops(dtype="bf16"):
@@ -52,4 +60,23 @@ def peak_flops(dtype="bf16"):
     return entry.get(key)
 
 
-__all__ = ["PEAK_FLOPS", "peak_flops"]
+def peak_hbm_gbps():
+    """Peak HBM bandwidth (GB/s) of device 0. Same contract as
+    ``peak_flops``: unknown devices return None with a warn-once log —
+    callers then omit achieved-bandwidth/roofline numbers rather than
+    publish them against a wrong peak."""
+    import jax
+
+    kind = jax.devices()[0].device_kind
+    bw = PEAK_HBM_GBPS.get(kind)
+    if bw is None and kind not in _warned_unknown_hbm:
+        _warned_unknown_hbm.add(kind)
+        log.warning(
+            "no peak-HBM-bandwidth entry for device kind %r — roofline "
+            "verdicts fall back to nominal ratios and achieved-GB/s is "
+            "reported without a utilization figure; add the chip to "
+            "profiler.flops.PEAK_HBM_GBPS to enable it", kind)
+    return bw
+
+
+__all__ = ["PEAK_FLOPS", "PEAK_HBM_GBPS", "peak_flops", "peak_hbm_gbps"]

@@ -37,7 +37,12 @@ external scraper. The pieces:
   recorder event and a ``dl4j_tpu_alerts_total{rule,state}`` count;
   ``firing`` with ``severity="page"`` additionally writes a full
   flight-recorder incident dump (digest-valid post-mortem) and every
-  ``firing``/``resolved`` optionally POSTs to a webhook sink.
+  ``firing``/``resolved`` optionally POSTs to a webhook sink. A firing
+  page (or any rule with ``action="profile"``) also captures a
+  bounded device profile via ``programs.ProfileSession`` — rate-
+  limited (``profile_min_interval_s``), gated by ``profile_on_page``
+  (default: only when the program registry is enabled), with the
+  bundle path stamped into the incident dump's manifest context.
 - **Action hooks.** ``on_alert(fn)`` subscribes callables to
   transitions — how ``control/scheduler.py`` turns a sustained
   queue-pressure alert into a serve-replica scale-up, replacing its
@@ -534,6 +539,7 @@ class Alert:
         self.resolved_mono: Optional[float] = None   # prune clock
         self.transitions = 0
         self.incident_dump: Optional[str] = None
+        self.profile_bundle: Optional[str] = None
 
     def to_dict(self) -> Dict[str, Any]:
         return {"rule": self.rule, "labels": self.labels,
@@ -542,7 +548,8 @@ class Alert:
                 "fired_at": self.fired_at,
                 "resolved_at": self.resolved_at,
                 "transitions": self.transitions,
-                "incident_dump": self.incident_dump}
+                "incident_dump": self.incident_dump,
+                "profile_bundle": self.profile_bundle}
 
 
 class SLOEngine:
@@ -564,6 +571,10 @@ class SLOEngine:
                  webhook_url: Optional[str] = None,
                  webhook_timeout_s: float = 2.0,
                  flight_dir: Optional[str] = None,
+                 profile_on_page: Optional[bool] = None,
+                 profile_duration_s: float = 0.25,
+                 profile_min_interval_s: float = 120.0,
+                 profile_dir: Optional[str] = None,
                  make_default: bool = True):
         self.registry = (registry if registry is not None
                          else _telemetry.MetricsRegistry.get_default())
@@ -571,6 +582,15 @@ class SLOEngine:
         self.webhook_url = webhook_url
         self.webhook_timeout_s = float(webhook_timeout_s)
         self.flight_dir = flight_dir
+        #: device-profile capture on firing page alerts
+        #: (profiler/programs.py ProfileSession). None = auto: capture
+        #: only when the program registry is enabled OR the rule says
+        #: action="profile" — an SLO-only process keeps its exact
+        #: pre-profiling behavior. False disables, True forces.
+        self.profile_on_page = profile_on_page
+        self.profile_duration_s = float(profile_duration_s)
+        self.profile_min_interval_s = float(profile_min_interval_s)
+        self.profile_dir = profile_dir
         self._rules: List[Rule] = list(rules or [])
         self._alerts: Dict[Tuple[str, LabelKey], Alert] = {}
         self._history: collections.deque = collections.deque(
@@ -716,10 +736,15 @@ class SLOEngine:
         # Incident-before-webhook order is preserved per transition,
         # so the firing webhook payload carries incident_dump.
         for kind, a in io:
-            if kind == "incident":
+            if kind == "profile":
+                a.profile_bundle = self._profile_capture(a)
+            elif kind == "incident":
+                ctx = dict(rule=a.rule, labels=dict(a.labels),
+                           value=a.value)
+                if a.profile_bundle:
+                    ctx["profile_bundle"] = a.profile_bundle
                 a.incident_dump = _flight.incident(
-                    "slo_page", directory=self.flight_dir,
-                    rule=a.rule, labels=dict(a.labels), value=a.value)
+                    "slo_page", directory=self.flight_dir, **ctx)
             else:
                 self._post_webhook(a)
         for a in fired:
@@ -808,6 +833,11 @@ class SLOEngine:
         if state == "firing":
             log.warning("SLO ALERT FIRING: %s%s value=%s severity=%s",
                         a.rule, a.labels, a.value, a.severity)
+            if a.severity == "page" or a.action == "profile":
+                # device-profile capture BEFORE the incident dump so
+                # the dump's manifest context carries the bundle path
+                # (rate-limited + gated in _profile_capture)
+                self._pending_io.append(("profile", a))
             if a.severity == "page":
                 # a page is exactly the moment the black box exists
                 # for: dump the ring + traces, digest-valid (deferred
@@ -830,6 +860,30 @@ class SLOEngine:
             "alerts currently pending / firing")
         for state, n in counts.items():
             g.set(n, state=state)
+
+    def _profile_capture(self, a: Alert) -> Optional[str]:
+        """Once-per-firing-alert device capture (ISSUE 16): runs in
+        tick()'s unlocked io phase, rate-limited across all automated
+        triggers by ProfileSession.maybe_capture. Returns the bundle
+        path, or None (disabled / gated off / rate-limited / slot
+        busy / failed). Never raises."""
+        if self.profile_on_page is False:
+            return None
+        try:
+            from deeplearning4j_tpu.profiler import programs as _programs
+        except Exception:
+            return None
+        if (self.profile_on_page is None and a.action != "profile"
+                and not _programs.enabled()):
+            # auto mode: the device capture rides the program-registry
+            # opt-in so an SLO-only process keeps its exact
+            # pre-profiling behavior
+            return None
+        return _programs.profile_session().maybe_capture(
+            trigger=f"slo:{a.rule}",
+            duration_s=self.profile_duration_s,
+            min_interval_s=self.profile_min_interval_s,
+            directory=self.profile_dir)
 
     def _post_webhook(self, a: Alert) -> None:
         url = self.webhook_url

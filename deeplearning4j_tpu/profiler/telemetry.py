@@ -154,6 +154,8 @@ FT_BUNDLE_IO_RETRIES = "dl4j_tpu_ft_bundle_io_retries_total"
 #: SLO / alerting engine (profiler/slo.py)
 ALERTS_TOTAL = "dl4j_tpu_alerts_total"
 ALERTS_ACTIVE = "dl4j_tpu_alerts_active"
+#: managed device-profile captures (profiler/programs.py)
+PROFILE_CAPTURES = "dl4j_tpu_profile_captures_total"
 
 
 def enabled() -> bool:
@@ -785,7 +787,7 @@ class _InstrumentedJit:
     def __call__(self, *args, **kwargs):
         if not _ENABLED:
             return self._fn(*args, **kwargs)
-        from deeplearning4j_tpu.profiler import model_health
+        from deeplearning4j_tpu.profiler import model_health, programs
 
         # FLOPs attribution (the MFU numerator) is off — one bool + a
         # set lookup — until a HealthMonitor exists, and limited to the
@@ -793,8 +795,11 @@ class _InstrumentedJit:
         # keys the per-EXECUTABLE cost so coexisting executables (shape
         # buckets, ragged batches) each charge their own FLOPs
         capture = model_health.wants_flops(self._site)
+        # program registry (roofline attribution): opt-in, any site
+        prog_on = programs.enabled()
         sig = (_arg_signature(args, kwargs)
-               if (capture or not self._has_cache_probe) else None)
+               if (capture or prog_on or not self._has_cache_probe)
+               else None)
         before = self._fn._cache_size() if self._has_cache_probe else -1
         t0 = time.perf_counter()
         out = self._fn(*args, **kwargs)
@@ -816,6 +821,10 @@ class _InstrumentedJit:
                     self._site, self._fn, args, kwargs)
                 if f:
                     self._sig_flops[sig] = f
+            if prog_on:
+                # same cache-hitting relower as capture_flops
+                programs.on_jit_compile(self._site, self._fn, args,
+                                        kwargs, sig, t1 - t0)
         if capture:
             # executables compiled before capture was enabled have no
             # per-sig entry; the site's latest capture is the best
@@ -824,6 +833,11 @@ class _InstrumentedJit:
                 or model_health.site_flops(self._site)
             if f:
                 model_health.add_dispatched_flops(self._site, f)
+        if prog_on:
+            # the compile call's wall time is compile, not execution —
+            # count it but don't time it
+            programs.record_dispatch(
+                self._site, sig, None if compiled else t1 - t0)
         return out
 
     def _record_compile(self, t0: float, t1: float, sig: str) -> None:
@@ -1001,6 +1015,19 @@ def snapshot() -> Dict[str, Any]:
             out["alerts"] = al
     except Exception:
         pass
+    # roofline program registry (lazy + peek-style: {} until a program
+    # has registered — see profiler/programs.py)
+    try:
+        from deeplearning4j_tpu.profiler import programs as _programs
+
+        pr = _programs.snapshot()
+        if pr:
+            out["programs"] = pr
+    except Exception:
+        pass
+    m = reg.peek(PROFILE_CAPTURES)
+    if m is not None:
+        out["profile_captures"] = m._json()
     return out
 
 
@@ -1132,6 +1159,7 @@ __all__ = [
     "WATCHDOG_STALLS", "CHAOS_INJECTED",
     "LAYER_GRAD_NORM", "LAYER_PARAM_NORM", "UPDATE_RATIO",
     "NONFINITE_FIRST_LAYER", "MFU", "STEP_FLOPS", "HEALTH_FETCHES",
+    "PROFILE_CAPTURES",
     "SERVING_REQUESTS", "SERVING_TOKENS", "SERVING_REQUEST_LATENCY",
     "SERVING_TTFT", "SERVING_QUEUE_DEPTH", "SERVING_SLOT_OCCUPANCY",
     "SERVING_KV_PAGE_UTILIZATION", "SERVING_WARM_HITS",

@@ -26,6 +26,11 @@ Endpoints (stdlib http.server, daemon thread):
                                   bursts -> finish (profiler/tracing)
     GET  /v1/jobs[/<id>]       -> control-plane job statuses (when a
                                   control.JobScheduler is live)
+    GET  /v1/programs[?n=N]    -> roofline program registry snapshot,
+                                  top-N by device time
+    POST /v1/profile           -> forced bounded device-profile
+                                  capture ({"duration_s": 0.5}); 409
+                                  while a trace/capture is active
     GET  /v1/alerts            -> SLO alert states + rule inventory
                                   (when a profiler.slo.SLOEngine is
                                   live)
@@ -298,6 +303,13 @@ class _InferenceHandler(BaseHTTPRequestHandler):
 
             obj, code = slo.http_alerts()
             return self._json(obj, code)
+        if path == "/v1/programs" or path.startswith("/v1/programs?"):
+            # path keeps the query string here (only the trailing "/"
+            # is stripped) — split it off for the handler
+            from deeplearning4j_tpu.profiler import programs
+
+            obj, code = programs.http_programs(path.partition("?")[2])
+            return self._json(obj, code)
         return self._json({"error": "not found"}, 404)
 
     def do_POST(self):
@@ -316,6 +328,17 @@ class _InferenceHandler(BaseHTTPRequestHandler):
                 obj, code = control.http_workers_post(path, payload)
             else:
                 obj, code = control.http_jobs_post(path, payload)
+            return self._json(obj, code)
+        if path == "/v1/profile":
+            # forced device-profile capture (profiler/programs.py)
+            from deeplearning4j_tpu.profiler import programs
+
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                payload = json.loads(self.rfile.read(n) or b"{}")
+            except Exception as e:
+                return self._json({"error": str(e)}, 400)
+            obj, code = programs.http_profile(payload)
             return self._json(obj, code)
         if path not in ("/v1/serving/predict", "/v1/serving/generate"):
             return self._json({"error": "not found"}, 404)

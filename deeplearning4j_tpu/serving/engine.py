@@ -222,9 +222,34 @@ class _WarmPool:
         #: executables adopted from another engine's warm pool (the
         #: fleet's shared-AOT startup) rather than compiled here
         self.adopted = 0
+        #: program-registry signature per key (profiler/programs.py) —
+        #: populated at compile/adopt time only when the registry was
+        #: enabled, so run() attributes dispatches with one dict.get
+        self._prog_sig: Dict[Any, str] = {}
+
+    @staticmethod
+    def _prog_site(key) -> str:
+        return f"serving_{key[0]}"
+
+    def _prog_register(self, key, ex, compile_seconds: float,
+                       source: str) -> None:
+        """Roofline registry registration (no-op when the registry is
+        off; never raises — serving startup must not depend on it)."""
+        from deeplearning4j_tpu.profiler import programs as _programs
+
+        if not _programs.enabled():
+            return
+        sig = f"{key[0]}[{key[1]}]"
+        self._prog_sig[key] = sig
+        _programs.get_default().register(
+            self._prog_site(key), sig, ex, source=source,
+            engine=self.engine_id, compile_seconds=compile_seconds)
 
     def compile(self, key, jitted, *abstract_args) -> None:
-        self._exec[key] = jitted.lower(*abstract_args).compile()
+        t0 = time.perf_counter()
+        ex = self._exec[key] = jitted.lower(*abstract_args).compile()
+        self._prog_register(key, ex, time.perf_counter() - t0,
+                            "warm_pool")
 
     def adopt(self, source: "_WarmPool") -> int:
         """Share another engine's AOT executables (same shapes, same
@@ -235,6 +260,8 @@ class _WarmPool:
                  if k not in self._exec}
         self._exec.update(fresh)
         self.adopted += len(fresh)
+        for k, ex in fresh.items():
+            self._prog_register(k, ex, 0.0, "adopted")
         return len(fresh)
 
     def __contains__(self, key) -> bool:
@@ -251,6 +278,20 @@ class _WarmPool:
                             "decode/prefill dispatches served by AOT-"
                             "compiled warm-pool executables").inc(
                     program=str(key[0]), engine=self.engine_id)
+            sig = self._prog_sig.get(key)
+            if sig is not None:
+                # registry was on at compile time: per-dispatch
+                # roofline accounting (host wall — decode queueing
+                # slack included, see programs.py caveat)
+                from deeplearning4j_tpu.profiler import \
+                    programs as _programs
+
+                t0 = time.perf_counter()
+                out = ex(*args)
+                _programs.record_dispatch(
+                    self._prog_site(key), sig,
+                    time.perf_counter() - t0)
+                return out
             return ex(*args)
         self.misses += 1
         if reg:

@@ -25,6 +25,13 @@ so no CDN scripts). Endpoints:
     GET /v1/alerts                          -> SLO alert states + rule
                                                inventory (live
                                                profiler.slo.SLOEngine)
+    GET /v1/programs[?n=N]                  -> roofline program registry
+                                               snapshot, top-N by
+                                               device time
+    POST /v1/profile                        -> forced bounded device-
+                                               profile capture
+                                               ({"duration_s": 0.5});
+                                               409 while one is active
     GET /train/<sid>/overview               -> score curve, rates, memory
     GET /train/<sid>/model                  -> static info + latest layer stats
     GET /metrics                            -> Prometheus text exposition
@@ -117,6 +124,8 @@ _DASHBOARD_HTML = """<!doctype html>
 </div>
 <div class="card"><b>Alerts (SLO engine)</b>
  <pre id="alerts"></pre></div>
+<div class="card"><b>Programs (roofline verdicts)</b>
+ <pre id="programs"></pre></div>
 <script>
 async function j(u){const r=await fetch(u);return r.json()}
 function pick(o,lk){if(!lk)return null;if(o[lk])return o[lk];
@@ -162,6 +171,23 @@ async function serving(){
  const t=await j('/telemetry');
  const M=t.metrics||{},sn=t.snapshot||{},s=sn.serving;
  const tr=sn.tracing,fl=sn.flight_recorder,al=sn.alerts;
+ const pg=sn.programs;
+ const pgEl=document.getElementById('programs');
+ if(!pg)pgEl.textContent=
+  '(program registry off — DL4J_TPU_PROGRAMS=1 or '+
+  'profiler.programs.set_enabled(True))';
+ else{
+  const rows=(pg.programs||[]).slice(0,12).map(p=>
+   p.site+(p.engine?'@'+p.engine:'')+' '+p.verdict.toUpperCase()+
+   ' AI='+fmt(p.arithmetic_intensity)+
+   ' GF/s='+(p.achieved_flops_per_s!=null?
+    fmt(p.achieved_flops_per_s/1e9):'?')+
+   ' GB/s='+fmt(p.achieved_gbps)+
+   (p.mfu!=null?' mfu='+fmt(p.mfu):'')+
+   ' n='+p.dispatches+' ['+p.signature+']');
+  pgEl.textContent=(pg.device&&pg.device.kind?
+   'device='+pg.device.kind+' peaks='+pg.peak_source+'\\n':'')+
+   (rows.length?rows.join('\\n'):'(no programs registered yet)')}
  // back off to ~30s polls while the process has no serving engine,
  // no tracing, no flight events and no SLO engine — /telemetry
  // copies the full trace buffer server-side, so idle dashboards
@@ -349,6 +375,13 @@ class _Handler(BaseHTTPRequestHandler):
 
             obj, code = slo.http_alerts()
             return self._json(obj, code)
+        if parts[0] == "v1" and len(parts) == 2 \
+                and parts[1] == "programs":
+            from deeplearning4j_tpu.profiler import programs
+
+            obj, code = programs.http_programs(
+                self.path.partition("?")[2])
+            return self._json(obj, code)
         if parts[0] != "train":
             return self._json({"error": "not found"}, 404)
         return self._train_routes(ui, parts)
@@ -368,6 +401,18 @@ class _Handler(BaseHTTPRequestHandler):
                 obj, code = control.http_workers_post(path, payload)
             else:
                 obj, code = control.http_jobs_post(path, payload)
+            return self._json(obj, code)
+        if path == "/v1/profile":
+            # forced device-profile capture (profiler/programs.py);
+            # blocking is fine — the server is threading
+            from deeplearning4j_tpu.profiler import programs
+
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                payload = json.loads(self.rfile.read(n) or b"{}")
+            except Exception as e:
+                return self._json({"error": str(e)}, 400)
+            obj, code = programs.http_profile(payload)
             return self._json(obj, code)
         # multi-host span aggregation: worker hosts push their per-span
         # aggregates here (tracing.push_spans) so the coordinator's

@@ -2030,5 +2030,230 @@ if [ $slogate -ne 0 ]; then
     echo "FATAL: SLO smoke gate regressed" >&2
     exit 1
 fi
+# Profiler smoke gate (docs/OBSERVABILITY.md "Where the time goes"):
+# the roofline program registry end-to-end. A registry-off tiny fit
+# must stay bit-identical to a registry-on fit (off-mode hot paths
+# unchanged); the registry-on fit + a few served requests must leave
+# the train-step and serving sites with nonzero flops/bytes and a
+# roofline verdict (and the tiny CPU LSTM step must NOT read
+# compute_bound); GET /v1/programs serves the same view over HTTP; a
+# forced POST /v1/profile capture round-trips digest-valid; and a
+# chaos-driven (hang_replica) firing page alert produces exactly ONE
+# rate-limited capture whose bundle path is stamped on the incident
+# dump.
+PROF_DIR=$(mktemp -d /tmp/dl4j_prof_gate.XXXXXX)
+env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu DL4J_TPU_TELEMETRY=1 \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    DL4J_PROF_GATE_DIR="$PROF_DIR" \
+    python - <<'EOF'
+import json
+import os
+import sys
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+GATE = os.environ["DL4J_PROF_GATE_DIR"]
+fail = []
+
+from deeplearning4j_tpu.profiler import (
+    chaos, flight_recorder, programs, slo, telemetry,
+)
+from deeplearning4j_tpu.models.gpt import CausalLM
+from deeplearning4j_tpu.models.transformer import tiny_config
+from deeplearning4j_tpu.serving import DecodeEngine
+from deeplearning4j_tpu.ui.server import UIServer
+from deeplearning4j_tpu.zoo import TextGenerationLSTM
+
+
+def tiny_fit():
+    """Identically-seeded tiny LSTM fit; returns raw param bytes."""
+    np.random.seed(0)
+    net = TextGenerationLSTM(vocab_size=8, hidden=16,
+                             tbptt_length=0).init()
+    rs = np.random.RandomState(0)
+    ids = rs.randint(0, 8, (4, 12))
+    x = np.eye(8, dtype=np.float32)[ids]
+    y = np.eye(8, dtype=np.float32)[np.roll(ids, -1, axis=1)]
+    for _ in range(2):
+        net.fit(x, y)
+    return b"".join(np.asarray(jax.device_get(leaf)).tobytes()
+                    for leaf in jax.tree_util.tree_leaves(
+                        net.params_list))
+
+
+# --- A: registry-off fit is bit-identical to registry-on --------------
+programs.set_enabled(False)
+programs.reset()
+off_bytes = tiny_fit()
+if programs.snapshot() != {}:
+    fail.append("off-mode registry snapshot not empty")
+programs.set_enabled(True)
+programs.reset()
+on_bytes = tiny_fit()
+if off_bytes != on_bytes:
+    fail.append("registry-on fit params differ from registry-off "
+                "(hot path not bit-identical)")
+
+# --- B: train-step site has flops/bytes and a sane verdict ------------
+snap = programs.get_default().snapshot()
+mln = snap.get("sites", {}).get("mln_step")
+if not mln:
+    fail.append(f"mln_step missing from registry sites: "
+                f"{sorted(snap.get('sites', {}))}")
+else:
+    if not (mln["flops"] > 0 and mln["bytes_accessed"] > 0):
+        fail.append(f"mln_step flops/bytes not populated: {mln}")
+    if mln["verdict"] == "compute_bound":
+        fail.append("tiny CPU LSTM step classified compute_bound "
+                    "(roofline verdict nonsense)")
+    if mln["verdict"] not in ("dispatch_bound", "memory_bound"):
+        fail.append(f"mln_step verdict unexpected: {mln['verdict']}")
+
+# --- C: serving sites register through the AOT warm pool --------------
+cfg = tiny_config(vocab=13, max_len=48, d_model=32, n_layers=2,
+                  n_heads=4, d_ff=64)
+cfg.dropout = 0.0
+model = CausalLM(cfg, compute_dtype=jnp.float32)
+params = model.init_params(jax.random.key(1))
+rng = np.random.default_rng(0)
+prompts = [rng.integers(0, 13, (n,)).astype(np.int32)
+           for n in (5, 9, 3)]
+with DecodeEngine(model, params, slots=2, page_size=8) as eng:
+    for p in prompts:
+        eng.submit(p, 4).result(timeout=120)
+    snap = programs.get_default().snapshot()
+    serving = {s: d for s, d in snap.get("sites", {}).items()
+               if s.startswith("serving_")}
+    decode = [s for s in serving if "decode" in s]
+    prefill = [s for s in serving if "prefill" in s]
+    if not decode or not prefill:
+        fail.append(f"serving decode/prefill sites missing: "
+                    f"{sorted(serving)}")
+    for s, d in serving.items():
+        if d["dispatches"] and not (d["flops"] > 0
+                                    and d["bytes_accessed"] > 0):
+            fail.append(f"serving site {s} dispatched without "
+                        f"flops/bytes: {d}")
+        if d["dispatches"] and d["verdict"] == "unknown":
+            fail.append(f"serving site {s} has no verdict: {d}")
+
+    # --- D: HTTP plane — GET /v1/programs + forced POST /v1/profile --
+    ui = UIServer()
+    port = ui.start(port=0)
+    try:
+        got = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/v1/programs?n=50",
+            timeout=10).read())
+        if "mln_step" not in got.get("sites", {}):
+            fail.append("GET /v1/programs missing mln_step site")
+        if not any(s.startswith("serving_") for s in got.get("sites", {})):
+            fail.append("GET /v1/programs missing serving sites")
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/profile",
+            data=json.dumps({"duration_s": 0.05,
+                             "directory": GATE + "/manual"}).encode(),
+            headers={"Content-Type": "application/json"})
+        resp = json.loads(urllib.request.urlopen(req, timeout=30).read())
+        bundle = resp.get("bundle")
+        if not bundle:
+            fail.append(f"POST /v1/profile returned no bundle: {resp}")
+        else:
+            cap = programs.load_capture(bundle)
+            if not cap["valid"]:
+                fail.append(f"manual capture bundle not digest-valid: "
+                            f"{bundle}")
+            if not cap["programs"]:
+                fail.append("manual capture bundle has no programs.json "
+                            "payload")
+    finally:
+        ui.stop()
+
+    # --- E: chaos-driven page alert -> exactly one rate-limited
+    # capture, stamped on the incident dump -------------------------
+    rule = slo.Threshold(
+        "prof_gate_p99", severity="page",
+        metric=telemetry.SERVING_REQUEST_LATENCY, quantile=0.99,
+        window_s=10.0, bound=0.25, op=">", group_by=())
+    eng_slo = slo.SLOEngine(
+        [rule], interval_s=999.0, make_default=False,
+        flight_dir=GATE + "/flight", profile_dir=GATE + "/prof",
+        profile_duration_s=0.05, profile_min_interval_s=3600.0)
+    eng_slo.tick(now=0.0)
+    chaos.hang_replica(eng, seconds=0.6)
+    eng.submit(prompts[0], 3).result(timeout=120)
+    eng_slo.tick(now=10.0)
+    if eng_slo.alert_state("prof_gate_p99") != "firing":
+        fail.append(f"chaos latency spike did not fire page alert: "
+                    f"{eng_slo.alert_state('prof_gate_p99')}")
+    firing = [a for a in eng_slo.alerts()
+              if a.rule == "prof_gate_p99" and a.state == "firing"]
+    if not firing:
+        fail.append("no firing alert object for prof_gate_p99")
+    else:
+        a = firing[0]
+        if not a.profile_bundle:
+            fail.append("firing page alert has no profile_bundle")
+        else:
+            cap = programs.load_capture(a.profile_bundle)
+            if not cap["valid"]:
+                fail.append("alert-triggered capture not digest-valid")
+        if not a.incident_dump:
+            fail.append("firing page alert has no incident dump")
+        else:
+            dump = flight_recorder.load_dump(a.incident_dump)
+            ctx = (dump.get("manifest") or {}).get("context", {})
+            if not dump["valid"]:
+                fail.append("incident dump not digest-valid")
+            if ctx.get("profile_bundle") != a.profile_bundle:
+                fail.append(f"incident dump context missing "
+                            f"profile_bundle: {ctx}")
+    # recover (fast requests only), then re-fire inside the rate
+    # limit: the second firing must NOT capture again
+    for p in prompts:
+        eng.submit(p, 2).result(timeout=120)
+    eng_slo.tick(now=20.0)
+    if eng_slo.alert_state("prof_gate_p99") != "resolved":
+        fail.append(f"alert did not resolve after recovery: "
+                    f"{eng_slo.alert_state('prof_gate_p99')}")
+    chaos.hang_replica(eng, seconds=0.6)
+    eng.submit(prompts[1], 3).result(timeout=120)
+    eng_slo.tick(now=30.0)
+    if eng_slo.alert_state("prof_gate_p99") != "firing":
+        fail.append("alert did not re-fire after second chaos spike")
+    refired = [a for a in eng_slo.alerts()
+               if a.rule == "prof_gate_p99" and a.state == "firing"]
+    if refired and refired[0].profile_bundle:
+        fail.append("re-fired alert captured again inside the rate "
+                    "limit")
+    reg = telemetry.MetricsRegistry.get_default()
+    m = reg.peek(telemetry.PROFILE_CAPTURES)
+    n_slo = 0.0
+    if m is not None:
+        n_slo = m._json().get('{trigger="slo:prof_gate_p99"}', 0.0)
+    if n_slo != 1.0:
+        fail.append(f"expected exactly one slo-triggered capture, "
+                    f"counter says {n_slo}")
+    eng_slo.shutdown()
+
+if fail:
+    sys.stderr.write("profiler gate FAILED:\n  "
+                     + "\n  ".join(fail) + "\n")
+    sys.exit(1)
+print("profiler gate OK: registry-off fit bit-identical; mln_step + "
+      "serving decode/prefill sites carry flops/bytes and roofline "
+      "verdicts (LSTM step not compute_bound); /v1/programs serves "
+      "the view; forced /v1/profile and the chaos-driven page alert "
+      "each round-trip digest-valid bundles, with exactly one "
+      "rate-limited slo capture stamped on the incident dump")
+EOF
+profgate=$?
+rm -rf "$PROF_DIR"
+if [ $profgate -ne 0 ]; then
+    echo "FATAL: profiler smoke gate regressed" >&2
+    exit 1
+fi
 
 exit $rc

@@ -1,0 +1,61 @@
+"""bench_compare key classification: the table that decides which
+direction gates a regression. Pinned because a misclassified key fails
+silently — the gate still runs, it just guards the wrong direction."""
+
+import pytest
+
+import bench_compare
+
+
+class TestClassification:
+    @pytest.mark.parametrize("key,value,expect", [
+        # explicitly higher-better families — pinned AHEAD of the
+        # latency heuristic, so a ratio named against a latency can
+        # never gate backwards
+        ("bert_mfu", 0.5, "higher"),
+        ("lstm_bf16_mfu", 0.4, "higher"),
+        ("mixed_speedup_vs_f32", 1.2, "higher"),
+        ("int8_agreement", 0.99, "higher"),
+        ("decode_ms_speedup", 1.3, "higher"),   # the regression case
+        # latency family: lower-better via the "ms" segment
+        ("step_ms", 12.0, "lower"),
+        ("gpt_decode_ms_per_step", 3.0, "lower"),
+        ("serving_p99_ms", 9.0, "lower"),
+        # throughput default
+        ("tokens_per_sec", 1000.0, "higher"),
+        ("lstm_words_per_sec", 1000.0, "higher"),
+        # "ms" must match a segment, not a substring
+        ("msa_rows_per_sec", 10.0, "higher"),
+        # booleans are correctness gates, not magnitudes
+        ("int8_tokens_identical", True, "bool"),
+        # round description, never compared
+        ("metric", "bench", None),
+        ("vs_baseline", 1.0, None),
+        ("lstm_frozen_window_ms", 5.0, None),
+        ("bert_step_band_lo", 1.0, None),
+        ("lstm_src", "live", None),
+        ("decode_note", "x", None),
+        ("some_error", "trace", None),
+        ("free_text", "abc", None),             # non-numeric
+    ])
+    def test_pinned_table(self, key, value, expect):
+        assert bench_compare._classify(key, value) == expect
+
+
+class TestCompareRounds:
+    def test_speedup_drop_regresses_and_ms_rise_regresses(self):
+        prior = {"decode_ms_speedup": 2.0, "step_ms": 10.0,
+                 "tokens_per_sec": 100.0}
+        current = {"decode_ms_speedup": 1.0, "step_ms": 20.0,
+                   "tokens_per_sec": 101.0}
+        _report, regressions = bench_compare.compare_rounds(
+            prior, current, tolerance=0.1)
+        assert len(regressions) == 2
+        joined = "\n".join(regressions)
+        assert "decode_ms_speedup" in joined and "step_ms" in joined
+
+    def test_bool_flip_fails_regardless_of_tolerance(self):
+        _r, regressions = bench_compare.compare_rounds(
+            {"int8_tokens_identical": True},
+            {"int8_tokens_identical": False}, tolerance=10.0)
+        assert regressions
