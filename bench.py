@@ -333,7 +333,7 @@ def _gpt_decode_metrics() -> dict:
     config scaled down enough to keep the aggregate round bounded; the
     standalone bench keeps the full-size knobs."""
     from bench_gpt_decode import (
-        build_model, decode_metrics, engine_ab, fleet_ab,
+        build_model, decode_metrics, engine_ab, fleet_ab, kv_ab,
         mixed_requests, prefix_ab,
     )
 
@@ -364,6 +364,23 @@ def _gpt_decode_metrics() -> dict:
         "serving_prefix_token_identical": pab["warm_token_identical"],
         "serving_prefix_hit_tokens_mean": pab["warm_hit_tokens_mean"],
     })
+    # KV path: the Pallas paged-attention kernel vs the einsum pair,
+    # and fp8_e4m3 KV pages vs native (bench_gpt_decode.kv_ab) — the
+    # decode-loop HBM-traffic claim; kernel-vs-einsum token identity
+    # at f32 is the gate, fp8 reports agreement (quantization moves
+    # logits by design). capacity_ratio/speedup/agreement are all
+    # higher-better under bench_compare.
+    kab = kv_ab(m, params, reqs[:16], slots=8, page_size=16)
+    out.update({
+        "serving_paged_attn_speedup": kab["paged_attn_speedup"],
+        "serving_fp8_kv_speedup": kab["fp8_speedup"],
+        "serving_fp8_kv_capacity_ratio": kab["fp8_kv_capacity_ratio"],
+        "serving_paged_attn_parity": kab["greedy_parity"],
+        "serving_fp8_token_agreement": kab["fp8_token_agreement"],
+    })
+    if "decode_exec_bytes_ratio" in kab:
+        out["serving_decode_exec_bytes_ratio"] = \
+            kab["decode_exec_bytes_ratio"]
     # serving fleet: replicated-engines scale-out (1 vs 2 replicas)
     # and disaggregated-prefill decode-burst p99 gain on long-tailed
     # traffic with a long-prompt minority (serving/fleet.py)

@@ -28,8 +28,14 @@ Methodology matches bench.py: device-resident inputs, warmup compile
 passes outside the timed window (the engine's AOT warm pool IS its
 warmup), device->host reads closing each window.
 
+- ``kv_ab``: the same mixed-length traffic served with the XLA einsum
+  attention pair vs the Pallas paged-attention kernel, and with a
+  native vs fp8_e4m3 KV cache — decode tokens/sec, TTFT tails, the
+  decode executable's cost_analysis "bytes accessed" delta, the fp8
+  page-capacity ratio, and before/after serving_decode roofline rows.
+
 Run: python bench_gpt_decode.py [--engine-ab] [--prefix-ab]
-     [--layers 12 ...]
+     [--kv-ab] [--fleet-ab] [--layers 12 ...]
 """
 
 from __future__ import annotations
@@ -440,6 +446,176 @@ def fleet_ab(m, params, requests=48, short_prompt=32, long_prompt=192,
     }
 
 
+# --------------------------------------------- KV-path (attn kernel
+# + fp8 cache) A/B
+def _decode_exec_bytes(eng):
+    """"bytes accessed" of the LARGEST decode-chunk executable via
+    compiled.cost_analysis() — the XLA-reported per-dispatch HBM
+    traffic of the decode step, i.e. the quantity the paged-attention
+    kernel + fp8 cache attack. cost_analysis() returns a dict in
+    current jax and a list-of-dicts in older releases; None when the
+    backend doesn't report it."""
+    keys = [k for k in eng._warm._exec if k[0] == "decode"]
+    if not keys:
+        return None
+    ex = eng._warm._exec[max(keys, key=lambda k: k[1])]
+    try:
+        ca = ex.cost_analysis()
+    except Exception:
+        return None
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    if not isinstance(ca, dict):
+        return None
+    v = ca.get("bytes accessed")
+    return float(v) if v is not None else None
+
+
+def _decode_roofline():
+    """Dominant serving_decode row from the roofline program registry
+    (profiler/programs.py): verdict + achieved GB/s. The before/after
+    pair of these rows IS the bench's memory-bound story — the einsum
+    decode step should read memory_bound, and the kernel+fp8 step
+    should show a higher achieved GB/s per useful byte (or flip the
+    verdict) at the same model."""
+    from deeplearning4j_tpu.profiler import programs
+
+    rows = [r for r in programs.snapshot().get("programs", [])
+            if r.get("site") == "serving_decode"]
+    if not rows:
+        return None
+    r = rows[0]           # sorted by device time: the dominant program
+    out = {"verdict": r.get("verdict")}
+    for k in ("achieved_gbps", "bytes_accessed", "dispatches"):
+        if r.get(k) is not None:
+            out[k] = round(r[k], 2) if isinstance(r[k], float) else r[k]
+    return out
+
+
+def _run_kv_side(m, params, requests, slots, page_size, max_chunk,
+                 attn_mode, kv_dtype):
+    from deeplearning4j_tpu.profiler import programs
+    from deeplearning4j_tpu.serving.engine import DecodeEngine
+
+    # enable the registry BEFORE construction so the warm pool's AOT
+    # compiles register their executables; reset so this side's
+    # serving_decode row carries only its own dispatches
+    programs.set_enabled(True)
+    programs.get_default().reset()
+    need = max(p.size + nt for p, nt in requests)
+    eng = DecodeEngine(
+        m, params, slots=slots, page_size=page_size,
+        max_chunk=max_chunk, attn_mode=attn_mode, kv_dtype=kv_dtype,
+        max_context=min(m.cfg.max_len,
+                        ((need + page_size - 1) // page_size)
+                        * page_size)).start()
+    try:
+        t0 = time.perf_counter()
+        handles = [eng.submit(p, nt) for p, nt in requests]
+        outs = [np.asarray(h.result(timeout=600)) for h in handles]
+        secs = time.perf_counter() - t0
+        info = {
+            "ttfts": [h.ttft_s for h in handles],
+            "exec_bytes": _decode_exec_bytes(eng),
+            "page_bytes": eng.pool.bytes_per_page(),
+            "misses": eng.stats()["warm_pool"]["misses"],
+        }
+    finally:
+        eng.shutdown()
+    info["roofline"] = _decode_roofline()
+    return outs, secs, info
+
+
+def kv_ab(m, params, requests, slots=8, page_size=16, max_chunk=16):
+    """Decode-path A/B on the same long-tailed mixed traffic, three
+    arms sharing model/params/requests:
+
+    - einsum: the XLA attention pair (``attn_mode="xla"``) at the
+      pool's native dtype — the pre-kernel engine, bit-for-bit.
+    - kernel: the Pallas paged-attention kernel (``"pallas"`` on TPU;
+      ``"interpret"`` elsewhere so the A/B stays runnable, though
+      interpret-mode timings are not meaningful).
+    - fp8: the kernel plus ``kv_dtype="fp8_e4m3"`` — half the KV bytes
+      per page, dequantized inside the kernel.
+
+    Interleaved best-of-2 per arm (the engine_ab ritual). Correctness:
+    kernel-vs-einsum greedy outputs are verified token-identical at
+    f32 (same reasoning as engine_ab — bf16 one-ulp argmax ties are
+    excluded); fp8 reports an agreement fraction, not identity, since
+    quantization legitimately moves logits. The before/after
+    serving_decode roofline rows (verdict + achieved GB/s) and the
+    decode executable's cost_analysis "bytes accessed" delta quantify
+    the HBM-traffic claim directly."""
+    kernel = ("pallas" if jax.default_backend() == "tpu"
+              else "interpret")
+    ein_s = ker_s = fp8_s = float("inf")
+    for _ in range(2):
+        ein_outs, s, ein = _run_kv_side(
+            m, params, requests, slots, page_size, max_chunk,
+            "xla", None)
+        ein_s = min(ein_s, s)
+        ker_outs, s, ker = _run_kv_side(
+            m, params, requests, slots, page_size, max_chunk,
+            kernel, None)
+        ker_s = min(ker_s, s)
+        fp8_outs, s, f8 = _run_kv_side(
+            m, params, requests, slots, page_size, max_chunk,
+            kernel, "fp8_e4m3")
+        fp8_s = min(fp8_s, s)
+    kernel_agree = float(np.mean([
+        np.array_equal(a, b)
+        for a, b in zip(ker_outs, ein_outs)]))
+    fp8_agree = float(np.mean([
+        np.array_equal(a, b)
+        for a, b in zip(fp8_outs, ein_outs)]))
+
+    # f32 verification pass: kernel-vs-einsum token identity or the
+    # A/B is void (fp8 is intentionally NOT identity-gated)
+    m32 = CausalLM(m.cfg, compute_dtype=jnp.float32)
+    e32, _, _ = _run_kv_side(m32, params, requests, slots, page_size,
+                             max_chunk, "xla", None)
+    k32, _, _ = _run_kv_side(m32, params, requests, slots, page_size,
+                             max_chunk, kernel, None)
+    parity = all(np.array_equal(a, b) for a, b in zip(k32, e32))
+
+    useful = sum(nt for _, nt in requests)
+    line = {
+        "requests": len(requests),
+        "slots": slots,
+        "attn_kernel": kernel,
+        "useful_tokens": useful,
+        "einsum_tokens_per_sec": round(useful / ein_s, 1),
+        "kernel_tokens_per_sec": round(useful / ker_s, 1),
+        "fp8_tokens_per_sec": round(useful / fp8_s, 1),
+        "paged_attn_speedup": round(ein_s / ker_s, 3),
+        "fp8_speedup": round(ein_s / fp8_s, 3),
+        "einsum_ttft_p50_ms": round(_p(ein["ttfts"], 50) * 1e3, 3),
+        "einsum_ttft_p99_ms": round(_p(ein["ttfts"], 99) * 1e3, 3),
+        "kernel_ttft_p50_ms": round(_p(ker["ttfts"], 50) * 1e3, 3),
+        "kernel_ttft_p99_ms": round(_p(ker["ttfts"], 99) * 1e3, 3),
+        "fp8_ttft_p99_ms": round(_p(f8["ttfts"], 99) * 1e3, 3),
+        "greedy_parity": parity,
+        "kernel_token_agreement": round(kernel_agree, 3),
+        "fp8_token_agreement": round(fp8_agree, 3),
+        "fp8_kv_capacity_ratio": round(
+            ein["page_bytes"] / max(f8["page_bytes"], 1), 3),
+        "warm_pool_misses": ein["misses"] + ker["misses"]
+        + f8["misses"],
+    }
+    if ein["exec_bytes"] and ker["exec_bytes"]:
+        line["einsum_decode_exec_bytes"] = ein["exec_bytes"]
+        line["kernel_decode_exec_bytes"] = ker["exec_bytes"]
+        line["decode_exec_bytes_ratio"] = round(
+            ein["exec_bytes"] / ker["exec_bytes"], 3)
+    if f8["exec_bytes"]:
+        line["fp8_decode_exec_bytes"] = f8["exec_bytes"]
+    if ein["roofline"]:
+        line["roofline_before"] = ein["roofline"]
+    if f8["roofline"]:
+        line["roofline_after"] = f8["roofline"]
+    return line
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--layers", type=int, default=12)
@@ -464,6 +640,13 @@ def main():
                          "disaggregated prefill on vs off (decode-"
                          "burst p99 + TTFT tails) on long-tailed "
                          "mixed traffic with a long-prompt minority")
+    ap.add_argument("--kv-ab", action="store_true",
+                    help="also run the KV-path A/B: einsum attention "
+                         "vs the Pallas paged-attention kernel, and "
+                         "native vs fp8_e4m3 KV cache, on long-tailed "
+                         "mixed traffic (tokens/sec, TTFT tails, "
+                         "decode-executable bytes delta, roofline "
+                         "before/after)")
     ap.add_argument("--fleet-requests", type=int, default=48)
     ap.add_argument("--fleet-long-prompt", type=int, default=192)
     ap.add_argument("--fleet-threshold", type=int, default=64,
@@ -506,6 +689,12 @@ def main():
         line["prefix_ab"] = prefix_ab(
             m, params, args.users, args.system_len, args.user_len,
             args.new, args.slots, args.page_size, args.max_chunk)
+    if args.kv_ab:
+        reqs = mixed_requests(args.vocab, args.requests, args.prompt,
+                              args.new_lo, args.new_hi or args.new,
+                              seed=1)
+        line["kv_ab"] = kv_ab(m, params, reqs, args.slots,
+                              args.page_size, args.max_chunk)
     if args.fleet_ab:
         line["fleet_ab"] = fleet_ab(
             m, params, requests=args.fleet_requests,

@@ -385,6 +385,86 @@ def quantized_bytes(tree) -> int:
     return total
 
 
+# --------------------------------------- fp8 KV-cache quantization
+# The KV-cache companion to the int8 weight preset above: decode
+# re-reads the whole paged KV cache every step, so storing K/V pages
+# as float8_e4m3fn with per-page-per-head fp32 scale planes halves the
+# page bytes vs bf16 — half the HBM traffic per decode step AND ~2x
+# the effective KV capacity from the same pool (which the prefix cache
+# and sticky sessions multiply again). Symmetric absmax scaling, same
+# shape-preserving contract as the int8 helpers: ``x ≈ q * scale``.
+#
+# e4m3fn specifics that the helpers encode so call sites can't get
+# them wrong: the format's max finite value is 448 and values beyond
+# it cast to NaN (no inf encoding), so quantization CLIPS to ±448
+# before the cast; the scale is floored so an all-zero page still
+# divides/multiplies cleanly (and fresh scale planes initialize to
+# ONES, matching the zero-initialized pools: 0 * 1 == 0).
+#
+# Consumed by serving/kv_pages.py (``kv_dtype="fp8_e4m3"``) and
+# dequantized either in the paged-attention Pallas kernel (one scalar
+# multiply per VMEM page block) or in its XLA reference path.
+
+FP8_E4M3_MAX = 448.0
+#: scale floor: amax/448 for any amax below 1.0/448 would round-trip
+#: tiny pages through denormal scales; 1/448 keeps scale*448 >= 1
+FP8_SCALE_FLOOR = 1.0 / 448.0
+
+_KV_DTYPE_ALIASES = {
+    "fp8_e4m3": "fp8_e4m3", "fp8": "fp8_e4m3", "e4m3": "fp8_e4m3",
+    "float8_e4m3fn": "fp8_e4m3", "float8_e4m3": "fp8_e4m3",
+}
+
+
+def resolve_kv_dtype(kv_dtype) -> Optional[str]:
+    """Canonicalize a KV-cache quantization request: None / "" stay
+    None (pool keeps the compute dtype); "fp8"/"e4m3"/"float8_e4m3fn"
+    and friends resolve to the canonical ``"fp8_e4m3"``. Raises on
+    unknown names and on jax builds without the fp8 dtype."""
+    if kv_dtype is None or kv_dtype == "":
+        return None
+    key = str(kv_dtype).strip().lower()
+    if key in ("none", "bf16", "bfloat16", "native"):
+        return None
+    canon = _KV_DTYPE_ALIASES.get(key)
+    if canon is None:
+        raise ValueError(
+            f"Unknown kv_dtype {kv_dtype!r} (expected None or one of "
+            f"{sorted(set(_KV_DTYPE_ALIASES))})")
+    if fp8_kv_dtype() is None:
+        raise ValueError(
+            "kv_dtype='fp8_e4m3' requires a jax with float8_e4m3fn")
+    return canon
+
+
+def fp8_kv_dtype():
+    """The storage dtype behind ``kv_dtype="fp8_e4m3"`` (None when
+    this jax build predates float8)."""
+    return getattr(jnp, "float8_e4m3fn", None)
+
+
+def fp8_scale(amax):
+    """Per-page-per-head scale from an abs-max: ``max(amax/448,
+    floor)``, fp32. Shape-preserving."""
+    return jnp.maximum(
+        jnp.asarray(amax, jnp.float32) / FP8_E4M3_MAX, FP8_SCALE_FLOOR)
+
+
+def quantize_fp8(x, scale):
+    """``clip(x / scale, ±448)`` cast to float8_e4m3fn; ``scale``
+    must broadcast against ``x``. The clip is load-bearing: e4m3fn
+    has no inf, out-of-range casts produce NaN."""
+    xf = x.astype(jnp.float32) / jnp.asarray(scale, jnp.float32)
+    return jnp.clip(xf, -FP8_E4M3_MAX, FP8_E4M3_MAX).astype(
+        fp8_kv_dtype())
+
+
+def dequantize_fp8(q, scale, dtype=jnp.float32):
+    """``q * scale`` in fp32, cast to ``dtype``."""
+    return (q.astype(jnp.float32)
+            * jnp.asarray(scale, jnp.float32)).astype(dtype)
+
+
 # ------------------------------------------------------------ telemetry
 def record_cast_count(site: str, n: int) -> None:
     """Static per-step cast count gauge (set at step-build time)."""
@@ -448,6 +528,8 @@ __all__ = [
     "guard_scaled_step",
     "quantize_int8", "dequantize_int8", "int8_matmul", "is_int8",
     "quantized_bytes",
+    "FP8_E4M3_MAX", "FP8_SCALE_FLOOR", "resolve_kv_dtype",
+    "fp8_kv_dtype", "fp8_scale", "quantize_fp8", "dequantize_fp8",
     "record_cast_count",
     "record_loss_scale", "loss_scale_context",
     "LOSS_SCALE", "LOSS_SCALE_OVERFLOWS", "LOSS_SCALE_SKIPPED_STEPS",

@@ -25,7 +25,7 @@ from deeplearning4j_tpu.ops import (  # noqa: F401 (register)
     transforms, nn, random, compression, reduce, shape, linalg, image,
     bitwise, extra_math, extra_indexing, tensor_array, tf_compat,
     declarable_tail, flash_attention, onnx_compat, conv_pallas,
-    residual_tail_pallas, fused_update_pallas,
+    residual_tail_pallas, fused_update_pallas, paged_attention_pallas,
 )
 # The SameDiff math module owns the canonical registrations for the
 # graph-execution op names (reduce_sum with `dimensions=`, etc. — the

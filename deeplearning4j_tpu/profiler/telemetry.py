@@ -99,6 +99,7 @@ SERVING_TTFT = "dl4j_tpu_serving_ttft_seconds"
 SERVING_QUEUE_DEPTH = "dl4j_tpu_serving_queue_depth"
 SERVING_SLOT_OCCUPANCY = "dl4j_tpu_serving_slot_occupancy"
 SERVING_KV_PAGE_UTILIZATION = "dl4j_tpu_serving_kv_page_utilization"
+SERVING_KV_PAGE_BYTES = "dl4j_tpu_serving_kv_page_bytes"
 SERVING_WARM_HITS = "dl4j_tpu_serving_warm_pool_hits_total"
 SERVING_WARM_MISSES = "dl4j_tpu_serving_warm_pool_misses_total"
 SERVING_DECODE_STEPS = "dl4j_tpu_serving_decode_steps_total"
@@ -1046,6 +1047,7 @@ def serving_snapshot() -> Dict[str, Any]:
                       ("slot_occupancy", SERVING_SLOT_OCCUPANCY),
                       ("kv_page_utilization",
                        SERVING_KV_PAGE_UTILIZATION),
+                      ("kv_page_bytes", SERVING_KV_PAGE_BYTES),
                       ("warm_pool_hits", SERVING_WARM_HITS),
                       ("warm_pool_misses", SERVING_WARM_MISSES),
                       ("decode_steps", SERVING_DECODE_STEPS),
@@ -1162,7 +1164,8 @@ __all__ = [
     "PROFILE_CAPTURES",
     "SERVING_REQUESTS", "SERVING_TOKENS", "SERVING_REQUEST_LATENCY",
     "SERVING_TTFT", "SERVING_QUEUE_DEPTH", "SERVING_SLOT_OCCUPANCY",
-    "SERVING_KV_PAGE_UTILIZATION", "SERVING_WARM_HITS",
+    "SERVING_KV_PAGE_UTILIZATION", "SERVING_KV_PAGE_BYTES",
+    "SERVING_WARM_HITS",
     "SERVING_WARM_MISSES", "SERVING_DECODE_STEPS",
     "SERVING_DECODE_STEP_SECONDS", "SERVING_PREFILL_SECONDS",
     "SERVING_PREFIX_HITS", "SERVING_PREFIX_MISSES",

@@ -54,7 +54,10 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from deeplearning4j_tpu.nn.precision import int8_matmul, quantize_int8
+from deeplearning4j_tpu.nn.precision import (int8_matmul, quantize_int8,
+                                             resolve_kv_dtype)
+from deeplearning4j_tpu.ops.paged_attention_pallas import (
+    paged_attention, paged_attention_mode)
 from deeplearning4j_tpu.profiler import flight_recorder as _flight
 from deeplearning4j_tpu.profiler import telemetry as _telemetry
 from deeplearning4j_tpu.profiler import tracing as _tracing
@@ -347,6 +350,19 @@ class DecodeEngine:
     quantization : None | "int8" — int8 weight-only decode weights
         (per-channel scales, dequant-in-matmul); prefill stays full
         precision.
+    kv_dtype : None | "fp8_e4m3" — quantize the KV-cache PAGES to
+        float8_e4m3fn with per-page-per-head fp32 scale planes
+        (kv_pages.py): half the page bytes of bf16, so ~2x effective
+        KV capacity and half the cache traffic per decode step.
+        Quantize-on-commit / dequantize-in-attention; greedy outputs
+        agree with the float engine to quantization error (CI gates
+        >= 0.99 token agreement), not bit-identically.
+    attn_mode : None | "pallas" | "interpret" | "xla" — attention
+        implementation for the decode core and the prefix-prefill
+        program (ops/paged_attention_pallas.py). None follows
+        ``DL4J_TPU_PAGED_ATTN`` / backend auto-detection: the fused
+        online-softmax kernel on TPU, the reference einsum pair
+        elsewhere. "xla" is op-for-op the pre-kernel engine.
     prefix_cache : index committed prompt pages by chained page hash
         (serving/prefix_cache.py) and serve later prompts' shared
         prefixes from the SAME refcounted pages — copy-on-write on
@@ -384,7 +400,9 @@ class DecodeEngine:
                  engine_id: Optional[str] = None,
                  device=None,
                  handoff_threshold: Optional[int] = None,
-                 warm_source: Optional["DecodeEngine"] = None):
+                 warm_source: Optional["DecodeEngine"] = None,
+                 kv_dtype: Optional[str] = None,
+                 attn_mode: Optional[str] = None):
         cfg = model.cfg
         self.model = model
         #: metric/trace label for this engine (``engine=<id>`` on every
@@ -424,10 +442,21 @@ class DecodeEngine:
                              "(expected None or 'int8')")
         self._decode_params = (self._quantize_decode_params(self.params)
                                if quantization == "int8" else self.params)
+        #: canonical kv_dtype (None = pool in the compute dtype) and
+        #: the attention implementation, both resolved ONCE here and
+        #: baked statically into the step builders — every executable
+        #: of this engine uses one consistent path
+        self.kv_dtype = resolve_kv_dtype(kv_dtype)
+        self._attn_mode = (str(attn_mode) if attn_mode is not None
+                           else paged_attention_mode())
+        if self._attn_mode not in ("pallas", "interpret", "xla"):
+            raise ValueError(
+                f"unknown attn_mode {attn_mode!r} (expected None, "
+                "'pallas', 'interpret' or 'xla')")
         self.pool = kv_pages.PagePool(
             cfg.n_layers, cfg.n_heads, self.page_size, cfg.head_dim,
             n_pages, dtype=model._cdtype, engine_id=self.engine_id,
-            device=device)
+            device=device, kv_dtype=self.kv_dtype)
         self.prefill_buckets = self._resolve_buckets(prefill_buckets)
         # sampling-key width follows the process PRNG impl (threefry=2,
         # rbg=4) so keydata shapes match whatever jax.config says
@@ -466,18 +495,19 @@ class DecodeEngine:
             self._chunks.append(k)
             k *= 2
         core = self._build_step_core()
-        # donate the KV pools: the engine rebinds them from every
-        # call's outputs, and without donation XLA must copy both
-        # pools at every dispatch boundary (the scan inside a chunk
-        # already aliases; donation extends that across dispatches)
+        # donate the KV tree (pools + any scale planes): the engine
+        # rebinds it from every call's outputs, and without donation
+        # XLA must copy the whole cache at every dispatch boundary
+        # (the scan inside a chunk already aliases; donation extends
+        # that across dispatches)
         self._decode_jits = {
-            k: jax.jit(self._make_chunk(core, k), donate_argnums=(1, 2))
+            k: jax.jit(self._make_chunk(core, k), donate_argnums=(1,))
             for k in self._chunks}
         self._decode_fallbacks = {
             k: _telemetry.instrument_jit("serving_decode", fn)
             for k, fn in self._decode_jits.items()}
         self._prefill_jit = jax.jit(self._build_prefill_fn(),
-                                    donate_argnums=(1, 2))
+                                    donate_argnums=(1,))
         self._prefill_fallback = _telemetry.instrument_jit(
             "serving_prefill", self._prefill_jit)
         # fleet replica mode: the adopt scatter that commits a prefill
@@ -493,7 +523,7 @@ class DecodeEngine:
                 b for b in self.prefill_buckets
                 if b >= int(handoff_threshold)]
             self._adopt_jit = jax.jit(self._build_adopt_fn(),
-                                      donate_argnums=(0, 1))
+                                      donate_argnums=(0,))
             self._adopt_fallback = _telemetry.instrument_jit(
                 "serving_adopt", self._adopt_jit)
         # cross-request KV reuse (prefix_cache.py / sessions.py). Both
@@ -513,11 +543,11 @@ class DecodeEngine:
                        or self._sessions is not None)
         if self._reuse:
             self._prefix_prefill_jit = jax.jit(
-                self._build_prefix_prefill_fn(), donate_argnums=(1, 2))
+                self._build_prefix_prefill_fn(), donate_argnums=(1,))
             self._prefix_prefill_fallback = _telemetry.instrument_jit(
                 "serving_prefix_prefill", self._prefix_prefill_jit)
             self._copy_jit = jax.jit(kv_pages.copy_page,
-                                     donate_argnums=(0, 1))
+                                     donate_argnums=(0,))
             self._copy_fallback = _telemetry.instrument_jit(
                 "serving_cow_copy", self._copy_jit)
         self._warm = _WarmPool(engine_id=self.engine_id)
@@ -617,25 +647,28 @@ class DecodeEngine:
 
     def _build_step_core(self):
         """One fixed-shape decode step for all S slots. Mirrors
-        ``CausalLM._decode_one`` op-for-op (same einsums, same residual
-        association, same masking value) so greedy outputs are
-        token-identical to the solo path — the only difference is that
-        K/V live in gathered pages instead of a dense cache."""
+        ``CausalLM._decode_one`` op-for-op (same attention math, same
+        residual association, same masking value) so greedy outputs
+        are token-identical to the solo path — the only difference is
+        that K/V live in paged pools instead of a dense cache. The
+        attention itself (page gather + masked softmax + weighted sum)
+        dispatches through ops/paged_attention_pallas.py: in "xla"
+        mode that is verbatim the einsum pair this core used to
+        inline; on TPU the fused kernel walks the page table without
+        materializing the gathered pages or the logits tensor."""
         cfg = self.model.cfg
         cd = self.model._cdtype
-        S, P, ps = self.slots, self.pages_per_slot, self.page_size
+        S, ps = self.slots, self.page_size
         ln = self.model._ln
+        attn = self._attn_mode
 
-        def step(params, kpool, vpool, tables, pos, tok, keydata, temps):
+        def step(params, kv, tables, pos, tok, keydata, temps):
             x = self._rows(params["tok_emb"], tok, cd) \
                 + params["pos_emb"].astype(cd)[pos]
-            scale = 1.0 / jnp.sqrt(jnp.asarray(cfg.head_dim, cd))
             # inactive/evicted slots carry all-null tables, so their
             # writes land on the null page by construction
             page = tables[jnp.arange(S), pos // ps]
             off = pos % ps
-            valid = (jnp.arange(P * ps)[None, None, None, :]
-                     <= pos[:, None, None, None])
             for li, lp in enumerate(params["layers"]):
                 h = ln(x, lp["ln1"])
                 qkv = int8_matmul(h, lp["wqkv"], cd) \
@@ -643,21 +676,9 @@ class DecodeEngine:
                 q, k, v = jnp.split(qkv, 3, axis=-1)
                 hs = lambda y: y.reshape(S, cfg.n_heads, 1, cfg.head_dim)
                 q, k, v = hs(q), hs(k), hs(v)
-                kpool, vpool = kv_pages.append_token(
-                    kpool, vpool, li, page, off, k[:, :, 0], v[:, :, 0])
-                ck = kv_pages.gather_pages(kpool, li, tables)
-                cv = kv_pages.gather_pages(vpool, li, tables)
-                # page-major contraction: (p, o) together are the flat
-                # key axis the dense path calls k — same elements, same
-                # row-major order, no transposed cache copy
-                logits = jnp.einsum("nhqd,nphod->nhqpo", q, ck) \
-                    .reshape(S, cfg.n_heads, 1, P * ps) * scale
-                neg = jnp.asarray(jnp.finfo(logits.dtype).min,
-                                  logits.dtype)
-                logits = jnp.where(valid, logits, neg)
-                w = jax.nn.softmax(logits, axis=-1) \
-                    .reshape(S, cfg.n_heads, 1, P, ps)
-                ctx = jnp.einsum("nhqpo,nphod->nhqd", w, cv)
+                kv = kv_pages.append_token(
+                    kv, li, page, off, k[:, :, 0], v[:, :, 0])
+                ctx = paged_attention(q, kv, li, tables, pos, mode=attn)
                 ctx = ctx.reshape(S, cfg.d_model)
                 x = x + int8_matmul(ctx, lp["wo"], cd) \
                     + lp["bo"].astype(cd)
@@ -676,7 +697,7 @@ class DecodeEngine:
             sampled = jax.vmap(jax.random.categorical)(
                 nk[:, 1], logits / safe_t[:, None]).astype(jnp.int32)
             nxt = jnp.where(temps > 0, sampled, greedy)
-            return kpool, vpool, nxt, jax.random.key_data(nk[:, 0])
+            return kv, nxt, jax.random.key_data(nk[:, 0])
 
         return step
 
@@ -688,20 +709,20 @@ class DecodeEngine:
         temps) is loop-invariant and only the per-token state (pos /
         tok / keys / pools) carries. A chunk of 1 is the plain step."""
 
-        def chunk(params, kpool, vpool, tables, pos, active, tok,
+        def chunk(params, kv, tables, pos, active, tok,
                   keydata, temps):
             def body(carry, _):
-                kpool, vpool, pos, tok, kd = carry
-                kpool, vpool, nxt, nkd = core(
-                    params, kpool, vpool, tables, pos, tok, kd, temps)
+                kv, pos, tok, kd = carry
+                kv, nxt, nkd = core(
+                    params, kv, tables, pos, tok, kd, temps)
                 pos = pos + active.astype(pos.dtype)
                 tok = jnp.where(active, nxt, tok)
-                return (kpool, vpool, pos, tok, nkd), nxt
+                return (kv, pos, tok, nkd), nxt
 
-            (kpool, vpool, pos, tok, kd), toks = lax.scan(
-                body, (kpool, vpool, pos, tok, keydata), None,
+            (kv, pos, tok, kd), toks = lax.scan(
+                body, (kv, pos, tok, keydata), None,
                 length=n_steps)
-            return kpool, vpool, toks.T, pos, tok, kd
+            return kv, toks.T, pos, tok, kd
 
         return chunk
 
@@ -714,11 +735,13 @@ class DecodeEngine:
         K/V and returned logits are exact."""
         m, ps = self.model, self.page_size
 
-        def prefill(params, kpool, vpool, prompt, page_row, t0):
+        def prefill(params, kv, prompt, page_row, t0):
             ks, vs, last = prefill_forward(m, params, prompt, t0)
-            kpool, vpool = kv_pages.commit_prefill(
-                kpool, vpool, ks, vs, page_row, ps)
-            return kpool, vpool, last.astype(jnp.float32)
+            # t0 bounds the REAL positions: an fp8 pool's page scales
+            # are minted from them only, never from padding garbage
+            kv = kv_pages.commit_prefill(
+                kv, ks, vs, page_row, ps, n_valid=t0)
+            return kv, last.astype(jnp.float32)
 
         return prefill
 
@@ -731,48 +754,41 @@ class DecodeEngine:
         real prompt write to the null page). ``t_start`` may sit
         mid-page (copy-on-write divergence, session resume), which the
         per-position (page, offset) scatter handles for free. The
-        attention mirrors the decode core's page-major einsums, so warm
-        greedy outputs stay token-identical to a cold prefill."""
+        attention dispatches through the SAME paged_attention op as
+        the decode core (queries at consecutive positions ``t_start +
+        i``), so warm greedy outputs stay token-identical to a cold
+        prefill."""
         cfg = self.model.cfg
         cd = self.model._cdtype
         P, ps = self.pages_per_slot, self.page_size
         ln = self.model._ln
+        attn = self._attn_mode
 
-        def prefill(params, kpool, vpool, tokens, table, t_start, t0):
+        def prefill(params, kv, tokens, table, t_start, t0):
             B = tokens.shape[0]
             pos = t_start + jnp.arange(B, dtype=jnp.int32)
             x = params["tok_emb"].astype(cd)[tokens] \
                 + params["pos_emb"].astype(cd)[
                     jnp.minimum(pos, cfg.max_len - 1)]
             real = pos < t0
-            page = jnp.where(real,
-                             table[jnp.minimum(pos // ps, P - 1)], 0)
+            chunk = jnp.minimum(pos // ps, P - 1)
+            page = jnp.where(real, table[chunk], 0)
             off = pos % ps
-            scale = 1.0 / jnp.sqrt(jnp.asarray(cfg.head_dim, cd))
-            # causal over the FLAT position axis: query at absolute
-            # position p admits keys at flat positions <= p — cached
-            # prefix, freshly-written suffix, nothing beyond
-            valid = (jnp.arange(P * ps)[None, None, :]
-                     <= pos[None, :, None])[:, None]   # [1,1,B,P*ps]
+            # fp8 scale segments: padded lanes land in trash segment P
+            seg = jnp.where(real, chunk, P)
+            qbase = jnp.reshape(t_start, (1,)).astype(jnp.int32)
             for li, lp in enumerate(params["layers"]):
                 h = ln(x, lp["ln1"])
                 qkv = h @ lp["wqkv"].astype(cd) + lp["bqkv"].astype(cd)
                 q, k, v = jnp.split(qkv, 3, axis=-1)
                 hs = lambda y: y.reshape(B, cfg.n_heads, cfg.head_dim)
                 q, k, v = hs(q), hs(k), hs(v)
-                kpool, vpool = kv_pages.append_token(
-                    kpool, vpool, li, page, off, k, v)
-                ck = kv_pages.gather_pages(kpool, li, table[None])
-                cv = kv_pages.gather_pages(vpool, li, table[None])
+                kv = kv_pages.append_suffix(
+                    kv, li, page, off, k, v, chunk=seg, real=real,
+                    table=table)
                 qq = q.transpose(1, 0, 2)[None]        # [1, H, B, hd]
-                logits = jnp.einsum("nhqd,nphod->nhqpo", qq, ck) \
-                    .reshape(1, cfg.n_heads, B, P * ps) * scale
-                neg = jnp.asarray(jnp.finfo(logits.dtype).min,
-                                  logits.dtype)
-                logits = jnp.where(valid, logits, neg)
-                w = jax.nn.softmax(logits, axis=-1) \
-                    .reshape(1, cfg.n_heads, B, P, ps)
-                ctx = jnp.einsum("nhqpo,nphod->nhqd", w, cv)
+                ctx = paged_attention(qq, kv, li, table[None], qbase,
+                                      mode=attn)
                 ctx = ctx[0].transpose(1, 0, 2).reshape(B, cfg.d_model)
                 x = x + ctx @ lp["wo"].astype(cd) + lp["bo"].astype(cd)
                 h = ln(x, lp["ln2"])
@@ -784,7 +800,7 @@ class DecodeEngine:
                 .astype(jnp.float32)
             last = lax.dynamic_index_in_dim(
                 logits, t0 - 1 - t_start, axis=0, keepdims=False)
-            return kpool, vpool, last
+            return kv, last
 
         return prefill
 
@@ -793,12 +809,20 @@ class DecodeEngine:
         (computed on the lane's own executable stream) into this
         engine's pages. One scatter program per handoff bucket — the
         decode replica pays a page write, never the bucket-padded
-        prefill forward itself."""
-        ps = self.page_size
+        prefill forward itself.
 
-        def adopt(kpool, vpool, ks, vs, page_row):
-            return kv_pages.handoff_commit(kpool, vpool, ks, vs,
-                                           page_row, ps)
+        The float program's signature is exactly the pre-fp8 one; the
+        fp8 variant takes the true prompt length ``t0`` as one extra
+        traced scalar so the minted page scales ignore the padded
+        tail."""
+        ps = self.page_size
+        if not self.kv_dtype:
+            def adopt(kv, ks, vs, page_row):
+                return kv_pages.handoff_commit(kv, ks, vs, page_row, ps)
+        else:
+            def adopt(kv, ks, vs, page_row, t0):
+                return kv_pages.handoff_commit(kv, ks, vs, page_row,
+                                               ps, n_valid=t0)
 
         return adopt
 
@@ -840,10 +864,12 @@ class DecodeEngine:
                                 or src._device == self._device) \
                 and (src.slots, src.page_size, src.max_context,
                      src.quantization, tuple(src.prefill_buckets),
-                     src.max_chunk, src._reuse) \
+                     src.max_chunk, src._reuse, src.kv_dtype,
+                     src._attn_mode) \
                 == (self.slots, self.page_size, self.max_context,
                     self.quantization, tuple(self.prefill_buckets),
-                    self.max_chunk, self._reuse):
+                    self.max_chunk, self._reuse, self.kv_dtype,
+                    self._attn_mode):
             self._warm.adopt(src._warm)
         S, P, kw = self.slots, self.pages_per_slot, self._kd_width
         i32, u32, f32 = jnp.int32, jnp.uint32, jnp.float32
@@ -855,13 +881,13 @@ class DecodeEngine:
                              chunks=len(self._chunks),
                              engine=self.engine_id,
                              adopted=self._warm.adopted):
+            kv_abs = _abs(self.pool.tree())
             for k in self._chunks:
                 if ("decode", k) in self._warm:
                     continue
                 self._warm.compile(
                     ("decode", k), self._decode_jits[k],
-                    _abs(self._decode_params),
-                    _abs(self.pool.k), _abs(self.pool.v),
+                    _abs(self._decode_params), kv_abs,
                     sds((S, P), i32), sds((S,), i32), sds((S,), bool),
                     sds((S,), i32), sds((S, kw), u32), sds((S,), f32))
             for b in self.prefill_buckets:
@@ -869,32 +895,29 @@ class DecodeEngine:
                     continue
                 self._warm.compile(
                     ("prefill", b), self._prefill_jit,
-                    _abs(self.params), _abs(self.pool.k),
-                    _abs(self.pool.v), sds((1, b), i32),
+                    _abs(self.params), kv_abs, sds((1, b), i32),
                     sds((b // self.page_size,), i32), sds((), i32))
             for b in self.handoff_buckets:
                 if ("adopt", b) in self._warm:
                     continue
                 kv_sds = sds((cfg.n_layers, 1, cfg.n_heads, b,
                               cfg.head_dim), cd)
+                extra = ((sds((), i32),) if self.kv_dtype else ())
                 self._warm.compile(
                     ("adopt", b), self._adopt_jit,
-                    _abs(self.pool.k), _abs(self.pool.v),
-                    kv_sds, kv_sds,
-                    sds((b // self.page_size,), i32))
+                    kv_abs, kv_sds, kv_sds,
+                    sds((b // self.page_size,), i32), *extra)
             if self._reuse:
                 if ("cow_copy", 0) not in self._warm:
                     self._warm.compile(
                         ("cow_copy", 0), self._copy_jit,
-                        _abs(self.pool.k), _abs(self.pool.v),
-                        sds((), i32), sds((), i32))
+                        kv_abs, sds((), i32), sds((), i32))
                 for b in self.prefill_buckets:
                     if ("prefix_prefill", b) in self._warm:
                         continue
                     self._warm.compile(
                         ("prefix_prefill", b), self._prefix_prefill_jit,
-                        _abs(self.params), _abs(self.pool.k),
-                        _abs(self.pool.v), sds((b,), i32),
+                        _abs(self.params), kv_abs, sds((b,), i32),
                         sds((P,), i32), sds((), i32), sds((), i32))
 
     # ----------------------------------------------------------- client
@@ -1109,6 +1132,8 @@ class DecodeEngine:
             "page_size": self.page_size,
             "max_context": self.max_context,
             "quantization": self.quantization,
+            "kv_dtype": self.pool.dtype_label,
+            "attn_mode": self._attn_mode,
             "prefill_buckets": list(self.prefill_buckets),
             "handoff_buckets": list(self.handoff_buckets),
             "max_chunk": self.max_chunk,
@@ -1124,7 +1149,8 @@ class DecodeEngine:
             "kv_pages": {"capacity": self.pool.capacity,
                          "allocated": self.pool.allocated,
                          "high_water": self.pool.high_water,
-                         "shared": self.pool.shared_pages()},
+                         "shared": self.pool.shared_pages(),
+                         "page_bytes": self.pool.bytes_per_page()},
             "warm_pool": {"hits": self._warm.hits,
                           "misses": self._warm.misses,
                           "adopted": self._warm.adopted},
@@ -1543,10 +1569,10 @@ class DecodeEngine:
         for src, dst in plan["copies"]:
             # copy-on-write BEFORE any write can land in the shared
             # page: concurrent readers of src never see our tokens
-            self.pool.k, self.pool.v = self._warm.run(
-                ("cow_copy", 0), self._copy_fallback, self.pool.k,
-                self.pool.v, jnp.asarray(src, jnp.int32),
-                jnp.asarray(dst, jnp.int32))
+            self.pool.rebind(self._warm.run(
+                ("cow_copy", 0), self._copy_fallback, self.pool.tree(),
+                jnp.asarray(src, jnp.int32),
+                jnp.asarray(dst, jnp.int32)))
         if plan["drop_after_copy"]:
             self.pool.free(plan["drop_after_copy"])
             plan["drop_after_copy"] = []
@@ -1564,10 +1590,12 @@ class DecodeEngine:
             page_row = np.zeros((bucket // ps,), np.int32)
             n_real = min(len(rows), bucket // ps)
             page_row[:n_real] = rows[:n_real]
-            kpool, vpool = self._warm.run(
+            extra = ((jnp.asarray(t0, jnp.int32),)
+                     if self.kv_dtype else ())
+            kvt = self._warm.run(
                 ("adopt", bucket), self._adopt_fallback,
-                self.pool.k, self.pool.v, ks, vs,
-                jnp.asarray(page_row))
+                self.pool.tree(), ks, vs, jnp.asarray(page_row),
+                *extra)
         elif t_start == 0:
             bucket = next((b for b in self.prefill_buckets if b >= t0),
                           kv_pages.pages_needed(t0, ps) * ps)
@@ -1576,9 +1604,9 @@ class DecodeEngine:
             page_row = np.zeros((bucket // ps,), np.int32)
             n_real = min(len(rows), bucket // ps)
             page_row[:n_real] = rows[:n_real]
-            kpool, vpool, last = self._warm.run(
+            kvt, last = self._warm.run(
                 ("prefill", bucket), self._prefill_fallback, self.params,
-                self.pool.k, self.pool.v, jnp.asarray(prompt),
+                self.pool.tree(), jnp.asarray(prompt),
                 jnp.asarray(page_row), jnp.asarray(t0, jnp.int32))
         else:
             # warm path: prefill ONLY the uncached suffix, mid-page
@@ -1591,15 +1619,15 @@ class DecodeEngine:
             suffix[:sl] = req.prompt[t_start:]
             table = np.zeros((self.pages_per_slot,), np.int32)
             table[:len(rows)] = rows
-            kpool, vpool, last = self._warm.run(
+            kvt, last = self._warm.run(
                 ("prefix_prefill", bucket),
                 self._prefix_prefill_fallback, self.params,
-                self.pool.k, self.pool.v, jnp.asarray(suffix),
+                self.pool.tree(), jnp.asarray(suffix),
                 jnp.asarray(table), jnp.asarray(t_start, jnp.int32),
                 jnp.asarray(t0, jnp.int32))
         logits = np.asarray(last)
         t_post = time.perf_counter()
-        self.pool.k, self.pool.v = kpool, vpool
+        self.pool.rebind(kvt)
         if plan["kind"] == "handoff":
             _telemetry.record_span(
                 "serving_handoff", t_pre, t_post,
@@ -1698,11 +1726,11 @@ class DecodeEngine:
             k = 1
             while k * 2 <= min(min_rem - steps, self.max_chunk):
                 k *= 2
-            (self.pool.k, self.pool.v, toks, pos, tok,
-             kd) = self._warm.run(
+            (kvt, toks, pos, tok, kd) = self._warm.run(
                 ("decode", k), self._decode_fallbacks[k],
-                self._decode_params, self.pool.k, self.pool.v, tables,
+                self._decode_params, self.pool.tree(), tables,
                 pos, active, tok, kd, temps)
+            self.pool.rebind(kvt)
             chunks.append(toks)
             steps += k
             self.n_dispatches += 1

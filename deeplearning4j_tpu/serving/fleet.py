@@ -418,7 +418,12 @@ class ServingFleet:
     max_queue : fleet admission queue bound — beyond it ``submit``
         raises the structured ``CapacityRejected``.
     engine_kwargs : forwarded to every ``DecodeEngine`` (slots,
-        page_size, prefix_cache, session_capacity, ...).
+        page_size, prefix_cache, session_capacity, kv_dtype,
+        attn_mode, ...) — kept verbatim, so restarted replicas keep
+        e.g. fp8 KV pools and the paged-attention kernel choice. The
+        disaggregated prefill lane is unaffected by ``kv_dtype``: it
+        hands off COMPUTE-dtype K/V stacks and each replica's adopt
+        scatter quantizes into its own pool.
     """
 
     #: failed-over requests get this many total attempts before the

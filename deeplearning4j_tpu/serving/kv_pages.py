@@ -6,11 +6,14 @@ shape — every distinct request geometry is a fresh multi-hundred-MB
 allocation and a fresh executable. The serving engine instead owns ONE
 pool of fixed-size pages shared by every slot:
 
-- ``kpool``/``vpool``: ``[L, n_pages, H, page_size, hd]`` device
-  arrays, allocated once at engine startup. Page 0 is the NULL page —
-  a scratch target that absorbs writes from inactive slots and from
-  the padded tail of prefill commits; it is never read through a valid
-  attention position.
+- ``kv tree``: ``{"k", "v"}`` pools of shape
+  ``[L, n_pages, H, page_size, hd]``, allocated once at engine
+  startup, plus — when the pool is fp8-quantized — ``"k_scale"`` /
+  ``"v_scale"`` per-page-per-head fp32 scale planes ``[L, n_pages,
+  H]`` stored beside them. Page 0 is the NULL page — a scratch target
+  that absorbs writes from inactive slots and from the padded tail of
+  prefill commits; it is never read through a valid attention
+  position.
 - per-slot page table: row ``j`` of a slot's table names the page
   holding absolute positions ``[j*page_size, (j+1)*page_size)`` of
   that slot's sequence. Unallocated tail entries point at the null
@@ -18,8 +21,9 @@ pool of fixed-size pages shared by every slot:
   flat position ``<= pos``).
 - ``PagePool`` is the HOST-side allocator (free list, REFERENCE
   COUNTS, utilization gauge); the device arrays thread functionally
-  through the jitted prefill/decode steps and are rebound by the
-  engine.
+  through the jitted prefill/decode steps as ONE pytree
+  (``pool.tree()``) and are rebound by the engine
+  (``pool.rebind(kv)``).
 
 Reference counts are what make cross-request KV reuse safe
 (serving/prefix_cache.py, serving/sessions.py): a page can be mapped
@@ -29,6 +33,20 @@ only returns the page to the free list when the last reader is gone.
 Writers must hold the only reference; a slot about to write into a
 shared page takes a private copy first (``copy_page``, the
 copy-on-write step) and swaps its table entry.
+
+fp8 KV (``kv_dtype="fp8_e4m3"``, nn/precision.py helpers): pages
+store float8_e4m3fn — HALF the bytes of bf16, so the same HBM budget
+holds ~2x the KV positions and every decode step streams half the
+cache bytes. Scales are per-page-per-head and follow the page's
+lifecycle exactly: written at commit (prefill/handoff compute exact
+per-page absmax over the REAL positions), frozen once a page has
+entries (the decode append only mints a fresh scale at offset 0, so
+earlier tokens in the page are never re-scaled under their feet),
+carried by ``copy_page`` (a CoW clone keeps the source's scales), and
+reclaimed implicitly with the page (scale rows of free pages are
+garbage, exactly like their page contents). Scale planes initialize
+to ONES so the zero-filled pools round-trip exactly and no division
+ever sees zero.
 
 The jax functions here are pure and shape-static, so the engine's one
 decode executable serves every mix of request lengths.
@@ -43,6 +61,7 @@ from typing import Dict, List, Optional, Sequence
 import jax
 import jax.numpy as jnp
 
+from deeplearning4j_tpu.nn import precision as _precision
 from deeplearning4j_tpu.profiler import telemetry as _telemetry
 
 
@@ -55,6 +74,10 @@ class PagePool:
     request cannot be satisfied — the scheduler keeps the request
     queued (head-of-line) until eviction frees pages.
 
+    ``dtype`` is the engine's COMPUTE dtype; ``kv_dtype`` optionally
+    quantizes the STORED pages (``"fp8_e4m3"``) with fp32 scale planes
+    beside them — ``tree()`` then carries four leaves instead of two.
+
     Thread safety: the free list and refcounts are guarded by a lock —
     the scheduler thread allocates/frees, while session release and
     submit-time budget hints may touch refcounts from client threads.
@@ -62,7 +85,7 @@ class PagePool:
 
     def __init__(self, n_layers: int, n_heads: int, page_size: int,
                  head_dim: int, n_pages: int, dtype=jnp.bfloat16,
-                 engine_id: str = "solo", device=None):
+                 engine_id: str = "solo", device=None, kv_dtype=None):
         if page_size < 1 or n_pages < 2:
             raise ValueError(
                 f"need page_size >= 1 and n_pages >= 2 (one null page "
@@ -72,12 +95,25 @@ class PagePool:
         #: ``engine=`` label on the utilization gauges, so N pools in
         #: one process (a serving fleet) stay distinguishable series
         self.engine_id = str(engine_id)
+        self.kv_dtype = _precision.resolve_kv_dtype(kv_dtype)
+        store = (_precision.fp8_kv_dtype() if self.kv_dtype
+                 else jnp.dtype(dtype))
+        #: ``kv_dtype=`` label value on every SERVING_KV_* series
+        self.dtype_label = self.kv_dtype or jnp.dtype(dtype).name
         shape = (n_layers, n_pages, n_heads, page_size, head_dim)
-        self.k = jnp.zeros(shape, dtype)
-        self.v = jnp.zeros(shape, dtype)
+        self.k = jnp.zeros(shape, store)
+        self.v = jnp.zeros(shape, store)
+        self.k_scale = self.v_scale = None
+        if self.kv_dtype:
+            sshape = (n_layers, n_pages, n_heads)
+            self.k_scale = jnp.ones(sshape, jnp.float32)
+            self.v_scale = jnp.ones(sshape, jnp.float32)
         if device is not None:
             self.k = jax.device_put(self.k, device)
             self.v = jax.device_put(self.v, device)
+            if self.kv_dtype:
+                self.k_scale = jax.device_put(self.k_scale, device)
+                self.v_scale = jax.device_put(self.v_scale, device)
         # LIFO free list: recently-freed pages are re-used first, which
         # keeps the hot working set of pages small and cache-friendly
         self._free: List[int] = list(range(n_pages - 1, 0, -1))
@@ -85,6 +121,28 @@ class PagePool:
         self._refs: Dict[int, int] = {}
         self._high_water = 0
         self._lock = threading.Lock()
+        self._gauge()
+
+    # ----------------------------------------------------- device tree
+    def tree(self) -> Dict[str, jnp.ndarray]:
+        """The device-side KV pytree threaded through jitted programs:
+        ``{"k", "v"}`` (+ ``"k_scale"``/``"v_scale"`` when fp8). Dict
+        insertion order is the flatten order, so a non-fp8 tree
+        flattens to exactly the (kpool, vpool) pair the pre-tree
+        programs took — both features off stays program-identical."""
+        kv = {"k": self.k, "v": self.v}
+        if self.k_scale is not None:
+            kv["k_scale"] = self.k_scale
+            kv["v_scale"] = self.v_scale
+        return kv
+
+    def rebind(self, kv: Dict[str, jnp.ndarray]) -> None:
+        """Adopt the arrays a jitted program returned (the functional
+        counterpart of ``tree()``; donation invalidated the old ones).
+        """
+        self.k, self.v = kv["k"], kv["v"]
+        if "k_scale" in kv:
+            self.k_scale, self.v_scale = kv["k_scale"], kv["v_scale"]
 
     # ------------------------------------------------------- accounting
     @property
@@ -118,9 +176,13 @@ class PagePool:
             return sum(1 for r in self._refs.values() if r > 1)
 
     def bytes_per_page(self) -> int:
-        # k + v, all layers, one page
+        # k + v (+ scale planes), all layers, one page
         per = self.k.size // self.n_pages
-        return 2 * per * jnp.dtype(self.k.dtype).itemsize
+        total = 2 * per * jnp.dtype(self.k.dtype).itemsize
+        if self.k_scale is not None:
+            total += 2 * (self.k_scale.size // self.n_pages) \
+                * jnp.dtype(self.k_scale.dtype).itemsize
+        return total
 
     # ------------------------------------------------------- allocation
     def alloc(self, n: int) -> Optional[List[int]]:
@@ -195,20 +257,28 @@ class PagePool:
     def _gauge(self) -> None:
         if _telemetry.enabled():
             reg = _telemetry.MetricsRegistry.get_default()
+            labels = dict(engine=self.engine_id,
+                          kv_dtype=self.dtype_label)
             reg.gauge(
                 _telemetry.SERVING_KV_PAGE_UTILIZATION,
                 "fraction of KV-cache pages currently allocated to "
-                "live requests").set(self.utilization(),
-                                     engine=self.engine_id)
+                "live requests").set(self.utilization(), **labels)
             reg.gauge(
                 _telemetry.SERVING_SHARED_PAGES,
                 "KV pages mapped by more than one reader (prefix-"
-                "cache sharing)").set(self.shared_pages(),
-                                      engine=self.engine_id)
+                "cache sharing)").set(self.shared_pages(), **labels)
+            reg.gauge(
+                _telemetry.SERVING_KV_PAGE_BYTES,
+                "bytes per KV page (k + v + scale planes, all "
+                "layers)").set(self.bytes_per_page(), **labels)
 
 
 # ------------------------------------------------------- pure jax ops
-def commit_prefill(kpool, vpool, ks, vs, page_row, page_size: int):
+def _is_fp8(kv) -> bool:
+    return "k_scale" in kv
+
+
+def commit_prefill(kv, ks, vs, page_row, page_size: int, n_valid=None):
     """Scatter one prompt's prefill K/V into its pages.
 
     ``ks``/``vs``: ``[L, 1, H, B, hd]`` from the parallel-prefill
@@ -217,25 +287,120 @@ def commit_prefill(kpool, vpool, ks, vs, page_row, page_size: int):
     pages for chunks the slot owns, null page 0 for the padded tail
     (garbage written there is never read: positions beyond the true
     prompt length stay masked until the decode loop overwrites them).
+
+    fp8 pools additionally compute the exact per-page-per-head absmax
+    — over REAL positions only when ``n_valid`` (the true prompt
+    length, a traced scalar) is given, so padded-tail garbage can't
+    inflate a page's scale — and scatter the minted scales beside the
+    quantized pages.
     """
     L, one, H, B, hd = ks.shape
     pb = B // page_size
     ck = ks[:, 0].reshape(L, H, pb, page_size, hd).transpose(0, 2, 1, 3, 4)
     cv = vs[:, 0].reshape(L, H, pb, page_size, hd).transpose(0, 2, 1, 3, 4)
-    return (kpool.at[:, page_row].set(ck.astype(kpool.dtype)),
-            vpool.at[:, page_row].set(cv.astype(vpool.dtype)))
+    out = dict(kv)
+    if not _is_fp8(kv):
+        out["k"] = kv["k"].at[:, page_row].set(ck.astype(kv["k"].dtype))
+        out["v"] = kv["v"].at[:, page_row].set(cv.astype(kv["v"].dtype))
+        return out
+
+    def amax(c):  # [L, pb, H, ps, hd] -> [L, pb, H]
+        a = jnp.abs(c.astype(jnp.float32))
+        if n_valid is not None:
+            flat = (jnp.arange(pb)[:, None] * page_size
+                    + jnp.arange(page_size)[None, :])
+            mask = (flat < n_valid)[None, :, None, :, None]
+            a = jnp.where(mask, a, 0.0)
+        return jnp.max(a, axis=(3, 4))
+
+    ksc = _precision.fp8_scale(amax(ck))
+    vsc = _precision.fp8_scale(amax(cv))
+    out["k"] = kv["k"].at[:, page_row].set(
+        _precision.quantize_fp8(ck, ksc[..., None, None]))
+    out["v"] = kv["v"].at[:, page_row].set(
+        _precision.quantize_fp8(cv, vsc[..., None, None]))
+    out["k_scale"] = kv["k_scale"].at[:, page_row].set(ksc)
+    out["v_scale"] = kv["v_scale"].at[:, page_row].set(vsc)
+    return out
 
 
-def append_token(kpool, vpool, layer: int, page_idx, offset, k, v):
-    """Write one position's K/V per lane: lane ``s`` lands at
-    ``(layer, page_idx[s], :, offset[s])``. Lanes are decode slots in
-    the decode step (inactive slots' page_idx must already point at the
-    null page) and suffix positions in the prefix-prefill step (padded
-    positions point at the null page)."""
-    return (kpool.at[layer, page_idx, :, offset].set(
-                k.astype(kpool.dtype)),
-            vpool.at[layer, page_idx, :, offset].set(
-                v.astype(vpool.dtype)))
+def append_token(kv, layer: int, page_idx, offset, k, v):
+    """Write one DECODE position's K/V per lane: lane ``s`` lands at
+    ``(layer, page_idx[s], :, offset[s])``. Inactive slots' page_idx
+    must already point at the null page.
+
+    fp8 scale rule (frozen-at-page-start): a lane writing ``offset ==
+    0`` is the first entry of a fresh page and mints the page's scale
+    from its own absmax; every later offset REUSES the stored scale —
+    rescaling a partially-filled page would corrupt the entries
+    already quantized under the old scale. The absmax of one token
+    only estimates the page's range, so later outlier tokens clip at
+    ±448 (bounded error) instead of silently breaking earlier ones.
+    """
+    out = dict(kv)
+    if not _is_fp8(kv):
+        out["k"] = kv["k"].at[layer, page_idx, :, offset].set(
+            k.astype(kv["k"].dtype))
+        out["v"] = kv["v"].at[layer, page_idx, :, offset].set(
+            v.astype(kv["v"].dtype))
+        return out
+    fresh = (offset == 0)[:, None]
+
+    def one(pool, scales, x):
+        xf = x.astype(jnp.float32)                       # [S, H, hd]
+        cand = _precision.fp8_scale(jnp.max(jnp.abs(xf), axis=-1))
+        sc = jnp.where(fresh, cand, scales[layer, page_idx])  # [S, H]
+        q = _precision.quantize_fp8(xf, sc[..., None])
+        return (pool.at[layer, page_idx, :, offset].set(q),
+                scales.at[layer, page_idx].set(sc))
+
+    out["k"], out["k_scale"] = one(kv["k"], kv["k_scale"], k)
+    out["v"], out["v_scale"] = one(kv["v"], kv["v_scale"], v)
+    return out
+
+
+def append_suffix(kv, layer: int, page_idx, offset, k, v, *,
+                  chunk=None, real=None, table=None):
+    """Write a PREFIX-PREFILL suffix's K/V: one lane per suffix
+    position, consecutive positions, padded lanes pointing at the null
+    page. Identical to :func:`append_token` for float pools.
+
+    fp8 pools need page-granular scales over lanes that SHARE pages:
+    ``chunk`` ([B], this lane's table row, or P for padded lanes),
+    ``real`` ([B], lane < true prompt length) and ``table`` ([P], the
+    slot's page table) drive a segment-max absmax per touched page. A
+    page whose offset-0 lane is in this batch mints a fresh scale
+    (exact over every lane it receives here; decode continues it
+    frozen); a page entered mid-way (the resume boundary page, already
+    committed by the prefix-cache hit) keeps its stored scale.
+    """
+    if not _is_fp8(kv):
+        return append_token(kv, layer, page_idx, offset, k, v)
+    P = table.shape[0]
+    seg = chunk  # [B]; padded lanes carry the trash segment P
+    started = jax.ops.segment_max(
+        jnp.where(real & (offset == 0), 1, 0), seg,
+        num_segments=P + 1)[:P] > 0                       # [P]
+    out = dict(kv)
+
+    def one(pool, scales, x):
+        xf = x.astype(jnp.float32)                       # [B, H, hd]
+        am = jnp.where(real[:, None],
+                       jnp.max(jnp.abs(xf), axis=-1), 0.0)
+        am_pg = jax.ops.segment_max(am, seg,
+                                    num_segments=P + 1)[:P]  # [P, H]
+        cur = scales[layer, table]
+        sc_pg = jnp.where(started[:, None],
+                          _precision.fp8_scale(am_pg), cur)
+        sc = jnp.where(real[:, None],
+                       sc_pg[jnp.minimum(chunk, P - 1)], 1.0)
+        q = _precision.quantize_fp8(xf, sc[..., None])
+        return (pool.at[layer, page_idx, :, offset].set(q),
+                scales.at[layer, table].set(sc_pg))
+
+    out["k"], out["k_scale"] = one(kv["k"], kv["k_scale"], k)
+    out["v"], out["v_scale"] = one(kv["v"], kv["v_scale"], v)
+    return out
 
 
 def gather_pages(pool, layer: int, tables) -> jnp.ndarray:
@@ -248,17 +413,25 @@ def gather_pages(pool, layer: int, tables) -> jnp.ndarray:
     return pool[layer][tables]
 
 
-def copy_page(kpool, vpool, src, dst):
+def copy_page(kv, src, dst):
     """Copy-on-write step: duplicate page ``src`` into ``dst`` across
-    every layer of both pools. ``src``/``dst`` are traced scalars so
-    ONE compiled program serves every copy. The caller then swaps its
-    page-table entry to ``dst`` and drops its reference on ``src`` —
-    readers of ``src`` never observe the writer's divergence."""
-    return (kpool.at[:, dst].set(kpool[:, src]),
-            vpool.at[:, dst].set(vpool[:, src]))
+    every layer of the whole KV tree — scale rows travel with their
+    page, so a CoW clone of an fp8 page dequantizes identically to its
+    source. ``src``/``dst`` are traced scalars so ONE compiled program
+    serves every copy. The caller then swaps its page-table entry to
+    ``dst`` and drops its reference on ``src`` — readers of ``src``
+    never observe the writer's divergence."""
+    out = {"k": kv["k"].at[:, dst].set(kv["k"][:, src]),
+           "v": kv["v"].at[:, dst].set(kv["v"][:, src])}
+    if _is_fp8(kv):
+        out["k_scale"] = kv["k_scale"].at[:, dst].set(
+            kv["k_scale"][:, src])
+        out["v_scale"] = kv["v_scale"].at[:, dst].set(
+            kv["v_scale"][:, src])
+    return out
 
 
-def handoff_commit(kpool, vpool, ks, vs, page_row, page_size: int):
+def handoff_commit(kv, ks, vs, page_row, page_size: int, n_valid=None):
     """Cross-pool page handoff: scatter K/V computed by ANOTHER
     executable stream (the fleet's disaggregated prefill lane) into
     this pool's pages. The lane runs the prompt forward on its own
@@ -267,9 +440,11 @@ def handoff_commit(kpool, vpool, ks, vs, page_row, page_size: int):
     on — and the destination engine commits them between decode bursts
     with this one cheap scatter instead of re-running the bucket-padded
     prefill. Same layout contract as ``commit_prefill`` (real pages for
-    owned chunks, null page 0 for the padded tail); the dtype cast to
-    the destination pool's dtype happens inside."""
-    return commit_prefill(kpool, vpool, ks, vs, page_row, page_size)
+    owned chunks, null page 0 for the padded tail); the dtype cast —
+    or fp8 quantization with freshly minted scales — to the
+    destination pool's format happens inside."""
+    return commit_prefill(kv, ks, vs, page_row, page_size,
+                          n_valid=n_valid)
 
 
 def pages_needed(total_positions: int, page_size: int) -> int:
@@ -277,5 +452,5 @@ def pages_needed(total_positions: int, page_size: int) -> int:
 
 
 __all__ = ["PagePool", "commit_prefill", "append_token",
-           "gather_pages", "copy_page", "handoff_commit",
-           "pages_needed"]
+           "append_suffix", "gather_pages", "copy_page",
+           "handoff_commit", "pages_needed"]

@@ -161,6 +161,9 @@ function bars(cv,st){const c=cv.getContext('2d');
  c.fillText(st.hist_edges[1].toPrecision(3),cv.width-60,cv.height-3)}
 function gv(M,n){const m=M[n];if(!m)return null;const v=m.values||{};
  const k=Object.keys(v)[0];return k==null?null:v[k]}
+function lbl(M,n,l){const m=M[n];if(!m)return null;
+ const k=Object.keys(m.values||{})[0];if(k==null)return null;
+ const mt=k.match(new RegExp(l+'="([^"]*)"'));return mt?mt[1]:null}
 function ms(h,q){return h&&h[q]!=null?(1e3*h[q]).toFixed(1)+'ms':'?'}
 function reqline(r,tag){return '#'+r.request_id+' '+tag+
  ' total='+fmt(r.total_ms)+'ms q='+fmt(r.queue_ms)+
@@ -236,6 +239,8 @@ async function serving(){
   '\\nqueue depth='+fmt(gv(M,'dl4j_tpu_serving_queue_depth'))+
   '  slot occupancy='+fmt(gv(M,'dl4j_tpu_serving_slot_occupancy'))+
   '  kv-page util='+fmt(gv(M,'dl4j_tpu_serving_kv_page_utilization'))+
+  '\\nkv page bytes='+fmt(gv(M,'dl4j_tpu_serving_kv_page_bytes'))+
+  '  kv dtype='+(lbl(M,'dl4j_tpu_serving_kv_page_bytes','kv_dtype')||'?')+
   '\\nrequests='+fmt(gv(M,'dl4j_tpu_serving_requests_total'))+
   '  tokens='+fmt(gv(M,'dl4j_tpu_serving_tokens_total'))+
   '  decode steps='+fmt(gv(M,'dl4j_tpu_serving_decode_steps_total'))+

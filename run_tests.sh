@@ -705,9 +705,14 @@ if not (pct["p50"] > 0 and pct["p99"] >= pct["p50"]):
     fail.append(f"latency percentiles not sane: {pct}")
 if not 0 < st["avg_occupancy"] <= 1:
     fail.append(f"avg occupancy {st['avg_occupancy']} not in (0, 1]")
+# SERVING_KV_* series carry the kv_dtype label now (fp8 KV PR); this
+# engine runs the pool in its f32 compute dtype
 if reg.gauge(telemetry.SERVING_KV_PAGE_UTILIZATION).value(
-        engine=eid) != 0.0:
+        engine=eid, kv_dtype="float32") != 0.0:
     fail.append("KV pages not all freed after completion")
+if reg.gauge(telemetry.SERVING_KV_PAGE_BYTES).value(
+        engine=eid, kv_dtype="float32") <= 0:
+    fail.append("KV page-bytes gauge not published at pool allocation")
 if reg.histogram(telemetry.SERVING_TTFT).count(engine=eid) != 16:
     fail.append("TTFT histogram incomplete")
 eng.shutdown()
@@ -727,6 +732,110 @@ EOF
 servsmoke=$?
 if [ $servsmoke -ne 0 ]; then
     echo "FATAL: serving smoke gate regressed" >&2
+    exit 1
+fi
+
+# KV-path smoke gate (docs/SERVING.md "KV precision and the attention
+# kernel"): the Pallas paged-attention kernel under the INTERPRETER
+# (the same kernel body the TPU compiles) must (a) produce greedy
+# outputs TOKEN-IDENTICAL to the einsum engine at f32 across a
+# 16-request mixed workload INCLUDING prefix-cache hits and a sticky-
+# session resume, (b) with kv_dtype="fp8_e4m3" agree with the einsum
+# engine on >= 99% of generated tokens, (c) pay zero serving-site
+# compiles after startup in every mode, and (d) drain pools — and with
+# them the fp8 scale planes — to zero at shutdown.
+env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu DL4J_TPU_TELEMETRY=1 \
+    python - <<'EOF'
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.models.gpt import CausalLM
+from deeplearning4j_tpu.models.transformer import tiny_config
+from deeplearning4j_tpu.profiler import telemetry
+from deeplearning4j_tpu.serving import DecodeEngine
+
+cfg = tiny_config(vocab=17, max_len=48, d_model=32, n_layers=2,
+                  n_heads=4, d_ff=64)
+cfg.dropout = 0.0
+m = CausalLM(cfg, compute_dtype=jnp.float32)
+params = m.init_params(jax.random.key(1))
+rng = np.random.default_rng(7)
+shared = rng.integers(0, 17, (9,)).astype(np.int32)
+jobs = []                      # (prompt, new, session_id)
+for i in range(16):
+    if i in (3, 11):           # session open + RESUME of the same id
+        jobs.append((rng.integers(0, 17, (5,)).astype(np.int32),
+                     4, "conv"))
+    elif i % 4 == 0:           # prefix-cache traffic
+        jobs.append((np.concatenate(
+            [shared, rng.integers(0, 17, (3,)).astype(np.int32)]),
+            int(rng.integers(3, 7)), None))
+    else:
+        jobs.append((rng.integers(0, 17,
+                                  (int(rng.integers(3, 12)),)
+                                  ).astype(np.int32),
+                     int(rng.integers(2, 8)), None))
+
+reg = telemetry.MetricsRegistry.get_default()
+compiles = lambda s: reg.counter(telemetry.JIT_COMPILES).value(site=s)
+SITES = ("serving_decode", "serving_prefill", "serving_prefix_prefill",
+         "serving_adopt", "serving_cow_copy")
+fail = []
+
+
+def serve(attn_mode, kv_dtype):
+    eng = DecodeEngine(m, params, slots=3, page_size=8,
+                       max_context=32, max_chunk=4,
+                       prefill_buckets=[8, 16], prefix_cache=True,
+                       session_capacity=2, attn_mode=attn_mode,
+                       kv_dtype=kv_dtype).start()
+    base = {s: compiles(s) for s in SITES}
+    outs = [np.asarray(eng.submit(p, n, session_id=sid)
+                       .result(timeout=300)) for p, n, sid in jobs]
+    delta = {s: compiles(s) - base[s] for s in SITES
+             if compiles(s) != base[s]}
+    if delta:
+        fail.append(f"{attn_mode}/{kv_dtype}: post-startup compiles "
+                    f"at serving sites: {delta}")
+    if eng.stats()["warm_pool"]["misses"]:
+        fail.append(f"{attn_mode}/{kv_dtype}: warm-pool misses")
+    eng.shutdown()
+    if eng.pool.allocated != 0:
+        fail.append(f"{attn_mode}/{kv_dtype}: {eng.pool.allocated} "
+                    "pages still allocated after shutdown (scale "
+                    "planes leak with their pages)")
+    return outs
+
+ein = serve("xla", None)
+ker = serve("interpret", None)
+fp8 = serve("interpret", "fp8_e4m3")
+for i, (a, b) in enumerate(zip(ein, ker)):
+    if not np.array_equal(a, b):
+        fail.append(f"kernel engine diverged from einsum engine on "
+                    f"request {i}: {b.tolist()} != {a.tolist()}")
+        break
+tok_match = sum(int(np.sum(np.asarray(a) == np.asarray(b)))
+                for a, b in zip(ein, fp8))
+tok_total = sum(a.size for a in ein)
+agree = tok_match / tok_total
+if agree < 0.99:
+    fail.append(f"fp8 token agreement {agree:.3f} < 0.99 "
+                f"({tok_match}/{tok_total})")
+if fail:
+    sys.stderr.write("KV-path smoke FAILED:\n  " + "\n  ".join(fail)
+                     + "\n")
+    sys.exit(1)
+print(f"KV-path smoke OK: interpret kernel token-identical to einsum "
+      f"over {len(jobs)} requests (sessions + prefix hits), fp8 "
+      f"agreement {agree:.3f}, 0 serving-site compiles post-start, "
+      "pools drained")
+EOF
+kvsmoke=$?
+if [ $kvsmoke -ne 0 ]; then
+    echo "FATAL: KV-path (paged-attention / fp8) smoke gate regressed" >&2
     exit 1
 fi
 

@@ -1,0 +1,479 @@
+"""Pallas paged-attention kernel + fp8 KV cache
+(ops/paged_attention_pallas.py, serving/kv_pages.py fp8 path,
+nn/precision.py fp8 helpers).
+
+The kernel runs under the Pallas INTERPRETER here (mode="interpret")
+so CPU-only CI executes the same kernel body the TPU compiles —
+shapes are kept tiny because interpret mode unrolls the grid at trace
+time. Golden checks: kernel vs the XLA einsum pair vs a plain numpy
+reference, across page counts, mid-page offsets, chunk widths,
+null-page masking, and CoW-shared pages; fp8 round-trip error bounds,
+frozen-at-page-start scale semantics, and engine-level greedy token
+identity (xla vs interpret, including sticky-session resume and
+prefix-cache hits) with pools draining to zero.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.models.gpt import CausalLM
+from deeplearning4j_tpu.models.transformer import tiny_config
+from deeplearning4j_tpu.nn import precision
+from deeplearning4j_tpu.ops.paged_attention_pallas import (
+    paged_attention, paged_attention_mode,
+)
+from deeplearning4j_tpu.serving import DecodeEngine, PagePool
+from deeplearning4j_tpu.serving import kv_pages
+
+
+# ------------------------------------------------------- helpers
+def _mk_kv(rng, L, n_pages, H, ps, hd, fp8=False):
+    k = rng.standard_normal((L, n_pages, H, ps, hd)).astype(np.float32)
+    v = rng.standard_normal((L, n_pages, H, ps, hd)).astype(np.float32)
+    kv = {"k": jnp.asarray(k), "v": jnp.asarray(v)}
+    if fp8:
+        out = {}
+        for name, x in (("k", k), ("v", v)):
+            am = jnp.asarray(np.abs(x).max(axis=(3, 4)))
+            sc = precision.fp8_scale(am)
+            out[name] = precision.quantize_fp8(
+                jnp.asarray(x), sc[..., None, None])
+            out[name + "_scale"] = sc
+        kv = {"k": out["k"], "v": out["v"],
+              "k_scale": out["k_scale"], "v_scale": out["v_scale"]}
+    return kv
+
+
+def _np_ref(q, kp, vp, tables, qbase):
+    """Dense float32 reference over one layer's pages."""
+    N, H, Q, hd = q.shape
+    ps = kp.shape[2]
+    out = np.zeros((N, H, Q, hd), np.float32)
+    for n in range(N):
+        keys = kp[tables[n]].transpose(1, 0, 2, 3).reshape(H, -1, hd)
+        vals = vp[tables[n]].transpose(1, 0, 2, 3).reshape(H, -1, hd)
+        for qi in range(Q):
+            valid = np.arange(keys.shape[1]) <= qbase[n] + qi
+            s = np.einsum("hd,htd->ht", q[n, :, qi], keys) / np.sqrt(hd)
+            s = np.where(valid[None, :], s, -np.inf)
+            w = np.exp(s - s.max(-1, keepdims=True))
+            w /= w.sum(-1, keepdims=True)
+            out[n, :, qi] = np.einsum("ht,htd->hd", w, vals)
+    return out
+
+
+def _both(q, kv, layer, tables, qbase):
+    ker = np.asarray(paged_attention(q, kv, layer, tables, qbase,
+                                     mode="interpret"))
+    xla = np.asarray(paged_attention(q, kv, layer, tables, qbase,
+                                     mode="xla"))
+    return ker, xla
+
+
+# ------------------------------------------------------- kernel golden
+class TestKernelGolden:
+    @pytest.mark.parametrize("P", [1, 2, 4])
+    def test_decode_matches_xla_across_page_counts(self, P):
+        rng = np.random.default_rng(P)
+        L, H, ps, hd, N = 2, 2, 4, 8, 2
+        kv = _mk_kv(rng, L, 1 + N * P, H, ps, hd)
+        tables = jnp.asarray(
+            1 + np.arange(N * P).reshape(N, P), jnp.int32)
+        # mid-page offsets on purpose: qbase not a page multiple
+        qbase = jnp.asarray([P * ps - 2, max(ps - 3, 0)], jnp.int32)
+        q = jnp.asarray(rng.standard_normal((N, H, 1, hd)), jnp.float32)
+        for layer in range(L):
+            ker, xla = _both(q, kv, layer, tables, qbase)
+            np.testing.assert_allclose(ker, xla, atol=1e-5, rtol=1e-5)
+
+    def test_matches_dense_numpy_reference(self):
+        rng = np.random.default_rng(0)
+        L, H, ps, hd, N, P = 1, 2, 4, 8, 3, 3
+        kv = _mk_kv(rng, L, 12, H, ps, hd)
+        tables = jnp.asarray(
+            1 + np.arange(N * P).reshape(N, P), jnp.int32)
+        qbase = jnp.asarray([1, 5, 10], jnp.int32)   # mid-page spread
+        q = jnp.asarray(rng.standard_normal((N, H, 1, hd)), jnp.float32)
+        ker, xla = _both(q, kv, 0, tables, qbase)
+        ref = _np_ref(np.asarray(q), np.asarray(kv["k"][0]),
+                      np.asarray(kv["v"][0]), np.asarray(tables),
+                      np.asarray(qbase))
+        np.testing.assert_allclose(ker, ref, atol=1e-4, rtol=1e-4)
+        np.testing.assert_allclose(xla, ref, atol=1e-4, rtol=1e-4)
+
+    @pytest.mark.parametrize("Q", [2, 4, 8])
+    def test_prefill_chunk_widths(self, Q):
+        """Q > 1 is the prefix-prefill geometry: the same kernel serves
+        every chunk width with the causal mask sliding per row."""
+        rng = np.random.default_rng(Q)
+        L, H, ps, hd, P = 1, 2, 4, 8, 3
+        kv = _mk_kv(rng, L, 6, H, ps, hd)
+        tables = jnp.asarray([[1, 2, 3]], jnp.int32)
+        qbase = jnp.asarray([3], jnp.int32)          # mid-page start
+        q = jnp.asarray(rng.standard_normal((1, H, Q, hd)), jnp.float32)
+        ker, xla = _both(q, kv, 0, tables, qbase)
+        ref = _np_ref(np.asarray(q), np.asarray(kv["k"][0]),
+                      np.asarray(kv["v"][0]), np.asarray(tables),
+                      np.asarray(qbase))
+        np.testing.assert_allclose(ker, xla, atol=1e-5, rtol=1e-5)
+        np.testing.assert_allclose(ker, ref, atol=1e-4, rtol=1e-4)
+
+    def test_null_page_and_tail_garbage_masked(self):
+        """Unallocated table rows point at null page 0 and positions
+        beyond qpos may hold arbitrary garbage — neither may leak into
+        the output of either implementation."""
+        rng = np.random.default_rng(3)
+        L, H, ps, hd, N, P = 1, 2, 4, 8, 2, 3
+        kv = _mk_kv(rng, L, 8, H, ps, hd)
+        # slot 0 owns one real page (positions 0..3), rows 1..2 -> null
+        # page; slot 1 owns two pages, mid-page at position 5
+        tables = jnp.asarray([[1, 0, 0], [2, 3, 0]], jnp.int32)
+        qbase = jnp.asarray([2, 5], jnp.int32)
+        q = jnp.asarray(rng.standard_normal((N, H, 1, hd)), jnp.float32)
+        clean_k, clean_v = np.asarray(kv["k"]), np.asarray(kv["v"])
+
+        dirty_k, dirty_v = clean_k.copy(), clean_v.copy()
+        dirty_k[:, 0], dirty_v[:, 0] = 1e4, -1e4     # null page garbage
+        dirty_k[:, 1, :, 3:], dirty_v[:, 1, :, 3:] = 1e4, -1e4  # > qpos
+        dirty_k[:, 3, :, 2:], dirty_v[:, 3, :, 2:] = -1e4, 1e4  # > qpos
+        dirty = {"k": jnp.asarray(dirty_k), "v": jnp.asarray(dirty_v)}
+
+        ker_c, xla_c = _both(q, kv, 0, tables, qbase)
+        ker_d, xla_d = _both(q, dirty, 0, tables, qbase)
+        np.testing.assert_allclose(ker_d, ker_c, atol=1e-5, rtol=1e-5)
+        np.testing.assert_allclose(xla_d, xla_c, atol=1e-5, rtol=1e-5)
+
+    def test_cow_shared_pages(self):
+        """Two slots mapping the SAME physical page (a prefix-cache
+        hit before divergence) read identically to two private copies
+        of it."""
+        rng = np.random.default_rng(4)
+        L, H, ps, hd = 1, 2, 4, 8
+        kv = _mk_kv(rng, L, 8, H, ps, hd)
+        # page 1 shared; pages 2/3 private seconds; page 4 = copy of 1
+        shared = jnp.asarray([[1, 2], [1, 3]], jnp.int32)
+        kc = np.asarray(kv["k"]).copy()
+        vc = np.asarray(kv["v"]).copy()
+        kc[:, 4], vc[:, 4] = kc[:, 1], vc[:, 1]
+        private = jnp.asarray([[1, 2], [4, 3]], jnp.int32)
+        kv2 = {"k": jnp.asarray(kc), "v": jnp.asarray(vc)}
+        qbase = jnp.asarray([6, 7], jnp.int32)
+        q = jnp.asarray(rng.standard_normal((2, H, 1, hd)), jnp.float32)
+        ker_s, xla_s = _both(q, kv, 0, shared, qbase)
+        ker_p, xla_p = _both(q, kv2, 0, private, qbase)
+        np.testing.assert_allclose(ker_s, ker_p, atol=1e-5, rtol=1e-5)
+        np.testing.assert_allclose(xla_s, xla_p, atol=1e-5, rtol=1e-5)
+
+    def test_bad_mode_raises(self):
+        rng = np.random.default_rng(5)
+        kv = _mk_kv(rng, 1, 3, 2, 4, 8)
+        q = jnp.zeros((1, 2, 1, 8), jnp.float32)
+        with pytest.raises(ValueError, match="paged-attention mode"):
+            paged_attention(q, kv, 0, jnp.asarray([[1]], jnp.int32),
+                            jnp.asarray([0], jnp.int32), mode="cuda")
+
+    def test_env_mode_resolution(self, monkeypatch):
+        monkeypatch.setenv("DL4J_TPU_PAGED_ATTN", "interpret")
+        assert paged_attention_mode() == "interpret"
+        monkeypatch.delenv("DL4J_TPU_PAGED_ATTN")
+        # auto: pallas only when a TPU backend is live
+        expect = ("pallas" if jax.default_backend() == "tpu"
+                  else "xla")
+        assert paged_attention_mode() == expect
+
+
+# ------------------------------------------------------- fp8 numerics
+class TestFp8:
+    def test_roundtrip_error_bound(self):
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.standard_normal((4, 16)) * 3, jnp.float32)
+        am = jnp.max(jnp.abs(x), axis=-1)
+        sc = precision.fp8_scale(am)
+        deq = precision.dequantize_fp8(
+            precision.quantize_fp8(x, sc[:, None]), sc[:, None],
+            jnp.float32)
+        # e4m3: 3 mantissa bits -> relative half-step 2**-4 of the
+        # value, i.e. <= amax/16 absolute after scaling to +-448
+        err = np.abs(np.asarray(deq) - np.asarray(x))
+        bound = np.asarray(am)[:, None] / 16 + 1e-6
+        assert (err <= bound).all()
+
+    def test_scale_floor_handles_zero_pages(self):
+        z = jnp.zeros((2, 8), jnp.float32)
+        sc = precision.fp8_scale(jnp.max(jnp.abs(z), axis=-1))
+        assert (np.asarray(sc) > 0).all()
+        deq = precision.dequantize_fp8(
+            precision.quantize_fp8(z, sc[:, None]), sc[:, None],
+            jnp.float32)
+        assert (np.asarray(deq) == 0).all()
+
+    def test_kernel_matches_xla_on_fp8(self):
+        rng = np.random.default_rng(1)
+        L, H, ps, hd, N, P = 2, 2, 4, 8, 2, 2
+        kv8 = _mk_kv(rng, L, 6, H, ps, hd, fp8=True)
+        tables = jnp.asarray([[1, 2], [3, 4]], jnp.int32)
+        qbase = jnp.asarray([5, 3], jnp.int32)
+        q = jnp.asarray(rng.standard_normal((N, H, 1, hd)), jnp.float32)
+        for layer in range(L):
+            ker, xla = _both(q, kv8, layer, tables, qbase)
+            np.testing.assert_allclose(ker, xla, atol=1e-5, rtol=1e-5)
+
+    def test_fp8_close_to_float_within_quantization(self):
+        rng = np.random.default_rng(2)
+        L, H, ps, hd = 1, 2, 4, 8
+        kvf = _mk_kv(rng, L, 6, H, ps, hd)
+        kv8 = {"k": kvf["k"], "v": kvf["v"]}
+        kv8 = _mk_kv(np.random.default_rng(2), L, 6, H, ps, hd,
+                     fp8=True)
+        tables = jnp.asarray([[1, 2]], jnp.int32)
+        qbase = jnp.asarray([6], jnp.int32)
+        q = jnp.asarray(rng.standard_normal((1, H, 1, hd)), jnp.float32)
+        ref = np.asarray(paged_attention(q, kvf, 0, tables, qbase,
+                                         mode="xla"))
+        got = np.asarray(paged_attention(q, kv8, 0, tables, qbase,
+                                         mode="interpret"))
+        np.testing.assert_allclose(got, ref, atol=0.15)
+
+
+# ------------------------------------------------- fp8 page semantics
+class TestFp8Pages:
+    def _pool_kv(self, L=1, H=2, ps=4, hd=8, n_pages=6):
+        pool = PagePool(L, H, ps, hd, n_pages=n_pages,
+                        dtype=jnp.float32, kv_dtype="fp8_e4m3")
+        return pool, pool.tree()
+
+    def test_commit_prefill_n_valid_masks_padded_tail(self):
+        """Garbage past the true prompt length must not inflate a
+        page's scale: scales with a huge padded tail equal scales with
+        a zero tail."""
+        _, kv = self._pool_kv()
+        L, H, ps, hd, B = 1, 2, 4, 8, 8
+        rng = np.random.default_rng(0)
+        base = rng.standard_normal((L, 1, H, B, hd)).astype(np.float32)
+        dirty = base.copy()
+        dirty[:, :, :, 5:, :] = 1e3                    # padded tail
+        clean = base.copy()
+        clean[:, :, :, 5:, :] = 0.0
+        row = jnp.asarray([1, 2], jnp.int32)
+        out_d = kv_pages.commit_prefill(
+            kv, jnp.asarray(dirty), jnp.asarray(dirty), row, ps,
+            n_valid=jnp.asarray(5, jnp.int32))
+        out_c = kv_pages.commit_prefill(
+            kv, jnp.asarray(clean), jnp.asarray(clean), row, ps,
+            n_valid=jnp.asarray(5, jnp.int32))
+        np.testing.assert_array_equal(
+            np.asarray(out_d["k_scale"]), np.asarray(out_c["k_scale"]))
+        # valid positions round-trip within the e4m3 bound
+        deq = precision.dequantize_fp8(
+            out_d["k"][0, row[0], :, :, :],
+            out_d["k_scale"][0, row[0]][:, None, None], jnp.float32)
+        ref = base[0, 0, :, :ps, :].transpose(0, 1, 2)
+        np.testing.assert_allclose(np.asarray(deq), ref, atol=0.26)
+
+    def test_append_token_scale_frozen_after_page_start(self):
+        """offset==0 mints the page scale; later offsets reuse it even
+        for outlier tokens (which clip instead of re-scaling earlier
+        entries under their feet)."""
+        _, kv = self._pool_kv()
+        page = jnp.asarray([1], jnp.int32)
+        k0 = jnp.full((1, 2, 8), 2.0, jnp.float32)
+        kv = kv_pages.append_token(kv, 0, page,
+                                   jnp.asarray([0], jnp.int32), k0, k0)
+        minted = np.asarray(kv["k_scale"][0, 1]).copy()
+        k1 = jnp.full((1, 2, 8), 400.0, jnp.float32)   # outlier
+        kv = kv_pages.append_token(kv, 0, page,
+                                   jnp.asarray([1], jnp.int32), k1, k1)
+        np.testing.assert_array_equal(
+            np.asarray(kv["k_scale"][0, 1]), minted)
+        # the offset-0 entry still dequantizes to its original value
+        deq = precision.dequantize_fp8(
+            kv["k"][0, 1, :, 0, :], kv["k_scale"][0, 1][:, None],
+            jnp.float32)
+        np.testing.assert_allclose(np.asarray(deq), 2.0, atol=0.2)
+
+    def test_append_suffix_scale_semantics(self):
+        """A page whose offset-0 lane is in the suffix batch mints a
+        fresh scale from the EXACT amax over every lane it receives; a
+        page entered mid-way (the resume boundary) keeps its stored
+        scale; untouched pages and padded lanes change nothing."""
+        _, kv = self._pool_kv()
+        ps, H, hd, P = 4, 2, 8, 3
+        rng = np.random.default_rng(1)
+        table = jnp.asarray([1, 2, 3], jnp.int32)
+        # pre-commit page 2 positions 4..5 (the resumed boundary page)
+        pre = jnp.full((1, H, hd), 2.0, jnp.float32)
+        for off in (0, 1):
+            kv = kv_pages.append_token(
+                kv, 0, jnp.asarray([2], jnp.int32),
+                jnp.asarray([off], jnp.int32), pre, pre)
+        boundary_scale = np.asarray(kv["k_scale"][0, 2]).copy()
+        # suffix covers positions 6..9: page 2 mid-way, page 3 fresh
+        pos = np.arange(6, 10)
+        B = 8
+        ks = rng.standard_normal((B, H, hd)).astype(np.float32) * 5
+        real = np.arange(B) < pos.size
+        padded_pos = np.concatenate([pos, np.zeros(B - pos.size, int)])
+        chunk = np.where(real, padded_pos // ps, P)
+        page = np.where(real, np.asarray(table)[
+            np.minimum(padded_pos // ps, P - 1)], 0)
+        off = np.where(real, padded_pos % ps, 0)
+        out = kv_pages.append_suffix(
+            kv, 0, jnp.asarray(page, jnp.int32),
+            jnp.asarray(off, jnp.int32), jnp.asarray(ks),
+            jnp.asarray(ks), chunk=jnp.asarray(chunk, jnp.int32),
+            real=jnp.asarray(real), table=table)
+        # boundary page keeps its frozen scale; fresh page 3 mints the
+        # exact amax over its two lanes (positions 8, 9)
+        np.testing.assert_array_equal(
+            np.asarray(out["k_scale"][0, 2]), boundary_scale)
+        want = precision.fp8_scale(jnp.max(jnp.abs(
+            jnp.asarray(ks[2:4])), axis=(0, 2)))
+        np.testing.assert_allclose(
+            np.asarray(out["k_scale"][0, 3]), np.asarray(want),
+            atol=1e-6)
+        # untouched page 1 still at the init scale of 1
+        np.testing.assert_array_equal(
+            np.asarray(out["k_scale"][0, 1]), 1.0)
+        # page-3 lanes round-trip within the e4m3 bound of their amax
+        deq = precision.dequantize_fp8(
+            out["k"][0, 3, :, 0:2, :],
+            out["k_scale"][0, 3][:, None, None], jnp.float32)
+        ref = np.asarray(ks[2:4]).transpose(1, 0, 2)
+        bound = np.asarray(want)[:, None, None] * 448 / 16 + 1e-6
+        assert (np.abs(np.asarray(deq) - ref) <= bound).all()
+
+    def test_copy_page_carries_scales(self):
+        _, kv = self._pool_kv()
+        page = jnp.asarray([1], jnp.int32)
+        k0 = jnp.full((1, 2, 8), 3.0, jnp.float32)
+        kv = kv_pages.append_token(kv, 0, page,
+                                   jnp.asarray([0], jnp.int32), k0, k0)
+        out = kv_pages.copy_page(kv, jnp.asarray(1), jnp.asarray(4))
+        np.testing.assert_array_equal(
+            np.asarray(out["k_scale"][:, 4]),
+            np.asarray(kv["k_scale"][:, 1]))
+        np.testing.assert_array_equal(
+            np.asarray(out["k"][:, 4]).view(np.uint8),
+            np.asarray(kv["k"][:, 1]).view(np.uint8))
+
+    def test_pool_bytes_capacity_and_gauge(self):
+        from deeplearning4j_tpu.profiler import telemetry
+
+        bf16 = PagePool(2, 4, 8, 16, n_pages=4, dtype=jnp.bfloat16,
+                        engine_id="t_bf16")
+        fp8 = PagePool(2, 4, 8, 16, n_pages=4, dtype=jnp.bfloat16,
+                       kv_dtype="fp8_e4m3", engine_id="t_fp8")
+        ratio = bf16.bytes_per_page() / fp8.bytes_per_page()
+        assert ratio >= 1.8                     # the capacity claim
+        assert fp8.dtype_label == "fp8_e4m3"
+        assert bf16.dtype_label == "bfloat16"
+        reg = telemetry.MetricsRegistry.get_default()
+        g = reg.gauge(telemetry.SERVING_KV_PAGE_BYTES)
+        assert g.value(engine="t_fp8", kv_dtype="fp8_e4m3") \
+            == fp8.bytes_per_page()
+        assert g.value(engine="t_bf16", kv_dtype="bfloat16") \
+            == bf16.bytes_per_page()
+
+    def test_bad_kv_dtype_raises(self):
+        with pytest.raises(ValueError, match="kv_dtype"):
+            PagePool(1, 2, 4, 4, n_pages=3, kv_dtype="int4")
+
+
+# ------------------------------------------------- engine token identity
+VOCAB = 13
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = tiny_config(vocab=VOCAB, max_len=48, d_model=32, n_layers=2,
+                      n_heads=4, d_ff=64)
+    cfg.dropout = 0.0
+    return CausalLM(cfg, compute_dtype=jnp.float32)
+
+
+@pytest.fixture(scope="module")
+def params(model):
+    return model.init_params(jax.random.key(1))
+
+
+def _engine(model, params, **kw):
+    kw.setdefault("slots", 2)
+    kw.setdefault("page_size", 8)
+    kw.setdefault("max_context", 32)   # interpret unrolls the grid:
+    kw.setdefault("max_chunk", 4)      # keep slots*H*pages tiny
+    kw.setdefault("prefill_buckets", [8, 16])
+    return DecodeEngine(model, params, **kw)
+
+
+def _serve(eng, jobs):
+    """jobs: list of (prompt, new, session_id|None) -> token arrays."""
+    try:
+        outs = []
+        for p, n, sid in jobs:
+            r = eng.submit(p, n, session_id=sid)
+            outs.append(np.asarray(r.result(timeout=300)))
+        drained = eng.pool.allocated if eng._sessions is None else None
+        stats = eng.stats()
+    finally:
+        eng.shutdown()
+    return outs, drained, stats
+
+
+class TestEngineTokenIdentity:
+    def _jobs(self, seed=0):
+        rng = np.random.default_rng(seed)
+        mk = lambda n: rng.integers(0, VOCAB, (n,)).astype(np.int32)
+        shared = mk(10)
+        return [
+            (mk(6), 6, None),
+            (np.concatenate([shared, mk(3)]), 5, None),
+            (mk(9), 6, "conv"),                 # session open
+            (np.concatenate([shared, mk(2)]), 5, None),  # prefix hit
+            (mk(4), 4, "conv"),                 # session RESUME
+            (mk(11), 6, None),
+        ]
+
+    def test_interpret_token_identical_to_xla(self, model, params):
+        """The CI-facing identity claim: same greedy tokens from the
+        kernel engine and the einsum engine, across prefix-cache hits
+        and a sticky-session resume, with zero warm-pool misses."""
+        jobs = self._jobs()
+        a, _, sa = _serve(_engine(model, params, prefix_cache=True,
+                                  session_capacity=2,
+                                  attn_mode="xla"), jobs)
+        b, _, sb = _serve(_engine(model, params, prefix_cache=True,
+                                  session_capacity=2,
+                                  attn_mode="interpret"), jobs)
+        for x, y in zip(a, b):
+            np.testing.assert_array_equal(x, y)
+        assert sa["warm_pool"]["misses"] == 0
+        assert sb["warm_pool"]["misses"] == 0
+        assert sb["attn_mode"] == "interpret"
+
+    def test_fp8_agreement_and_drain(self, model, params):
+        """fp8 is agreement-gated, not identity-gated; pools (and with
+        them the scale planes) must drain to zero when no sessions pin
+        pages."""
+        jobs = [(p, n, None) for p, n, _ in self._jobs(1)]
+        ref, d0, _ = _serve(_engine(model, params, attn_mode="xla"),
+                            jobs)
+        f8, d1, st = _serve(_engine(model, params,
+                                    attn_mode="interpret",
+                                    kv_dtype="fp8_e4m3"), jobs)
+        agree = np.mean([np.array_equal(x, y)
+                         for x, y in zip(ref, f8)])
+        assert agree >= 0.75
+        assert d0 == 0 and d1 == 0
+        assert st["kv_dtype"] == "fp8_e4m3"
+        assert st["kv_pages"]["page_bytes"] < 2048  # < bf16 full page
+
+    def test_bad_engine_args_raise(self, model, params):
+        with pytest.raises(ValueError, match="attn_mode"):
+            DecodeEngine(model, params, slots=2, page_size=8,
+                         max_context=16, attn_mode="rocm")
+        with pytest.raises(ValueError, match="kv_dtype"):
+            DecodeEngine(model, params, slots=2, page_size=8,
+                         max_context=16, kv_dtype="fp4")
