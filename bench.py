@@ -334,7 +334,7 @@ def _gpt_decode_metrics() -> dict:
     standalone bench keeps the full-size knobs."""
     from bench_gpt_decode import (
         build_model, decode_metrics, engine_ab, fleet_ab, kv_ab,
-        mixed_requests, prefix_ab,
+        mixed_requests, prefix_ab, spec_ab,
     )
 
     m, params = build_model(layers=8, d_model=512, heads=8, d_ff=2048,
@@ -381,6 +381,20 @@ def _gpt_decode_metrics() -> dict:
     if "decode_exec_bytes_ratio" in kab:
         out["serving_decode_exec_bytes_ratio"] = \
             kab["decode_exec_bytes_ratio"]
+    # speculative decoding: plain vs n-gram self-draft at the
+    # canonical depth k=4 (bench_gpt_decode.spec_ab; the standalone
+    # bench sweeps k in {2,4,8}) — tokens emitted per verify dispatch
+    # is the weight-read amortization headline; spec-on greedy token
+    # identity at f32 is the gate. speedup/acceptance/per_dispatch
+    # are all higher-better under bench_compare.
+    sab = spec_ab(m, params, reqs[:16], slots=8, page_size=16,
+                  ks=(4,))
+    out.update({
+        "serving_spec_decode_speedup": sab["spec_decode_speedup"],
+        "serving_spec_acceptance": sab["spec_acceptance"],
+        "serving_tokens_per_dispatch": sab["tokens_per_dispatch"],
+        "serving_spec_greedy_parity": sab["greedy_parity"],
+    })
     # serving fleet: replicated-engines scale-out (1 vs 2 replicas)
     # and disaggregated-prefill decode-burst p99 gain on long-tailed
     # traffic with a long-prompt minority (serving/fleet.py)

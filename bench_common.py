@@ -71,6 +71,41 @@ def aot_cost_flops(step, *args, site=None, **kwargs):
         return None
 
 
+def roofline_row(site, *, seconds_per_step=None, steps=0):
+    """Roofline-verdict row for ``site`` from the program registry
+    (profiler/programs.py): verdict + achieved FLOP/s and GB/s — the
+    per-bench "is this step compute- or memory-bound, and how close
+    to the roof" line in the aggregate output.
+
+    The bench timing loops bypass instrument_jit, so the registry has
+    the program's static analysis but no dispatch wall time; feeding
+    the measured window back in via ``seconds_per_step``/``steps``
+    turns the static row into achieved throughput. None when the
+    registry is off or the site never registered (cost_analysis
+    unavailable)."""
+    from deeplearning4j_tpu.profiler import programs
+
+    reg = programs.get_default()
+    rows = [r for r in reg.snapshot().get("programs", [])
+            if r.get("site") == site]
+    if not rows:
+        return None
+    if steps and seconds_per_step:
+        for _ in range(int(steps)):
+            reg.record_dispatch(site, rows[0]["signature"],
+                                seconds_per_step)
+        rows = [r for r in reg.snapshot().get("programs", [])
+                if r.get("site") == site]
+    r = rows[0]
+    out = {"site": site, "verdict": r.get("verdict")}
+    for k in ("arithmetic_intensity", "achieved_flops_per_s",
+              "achieved_gbps", "mfu", "hbm_utilization"):
+        if r.get(k) is not None:
+            v = r[k]
+            out[k] = round(v, 4) if isinstance(v, float) else v
+    return out
+
+
 def time_best_of(run, state, steps, trials=3):
     """Best-of-N windows of `steps` calls; `run(state, i) -> (state,
     loss)`; each window ends in a device->host loss read."""
@@ -87,13 +122,15 @@ def time_best_of(run, state, steps, trials=3):
 
 
 def build_char_lstm(batch=256, seq=200, hidden=256, vocab=77,
-                    dtype="bf16", precision=None):
+                    dtype="bf16", precision=None, site=None):
     """Build (run, state0, flops_per_step, tokens_per_step) for the
     char-LSTM workload so callers can either time it standalone
     (run_char_lstm) or interleave it with the frozen yardstick in
     shared windows (bench.py _lstm_metrics). ``precision`` sets a
     mixed-precision policy (nn/precision.py) — with one, ``dtype`` is
-    ignored and params stay fp32 masters."""
+    ignored and params stay fp32 masters. ``site`` registers the
+    compiled step in the roofline program registry (see
+    aot_cost_flops) so callers can emit a roofline_row."""
     import numpy as np
 
     from deeplearning4j_tpu.ndarray.dtypes import DataType
@@ -129,7 +166,7 @@ def build_char_lstm(batch=256, seq=200, hidden=256, vocab=77,
 
     flops_per_step = aot_cost_flops(step, *step_args(
         (net.params_list, net.states_list, net.opt_states,
-         net._loss_scale_state), 0))
+         net._loss_scale_state), 0), site=site)
 
     def run(state, i):
         out = step(*step_args(state, i))
@@ -240,13 +277,13 @@ def pipeline_ab_fixed(net, make_iter, depth=2, epochs=1):
 
 
 def run_char_lstm(batch=256, seq=200, hidden=256, vocab=77, steps=10,
-                  dtype="bf16", precision=None):
+                  dtype="bf16", precision=None, site=None):
     """Char-LSTM train-step benchmark (BASELINE.md "Char-RNN LSTM"
     row, the CudnnLSTMHelper role — SURVEY.md §2.9). Returns
     tokens/sec, measured per-step FLOPs (or None), and first loss."""
     run, state0, flops_per_step, tokens_per_step = build_char_lstm(
         batch=batch, seq=seq, hidden=hidden, vocab=vocab, dtype=dtype,
-        precision=precision)
+        precision=precision, site=site)
     best = time_best_of(run, state0, steps)
     return {"tokens_per_sec": tokens_per_step * steps / best,
             "flops_per_step": flops_per_step,

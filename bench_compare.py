@@ -13,10 +13,12 @@ first and compares its fresh line.
 
 Key classification:
 
-- ``mfu``/``speedup``/``agreement`` keys (any ``_``-segment) are
-  explicitly HIGHER-better — pinned ahead of the latency heuristic so
-  a ratio named against a latency (``decode_ms_speedup``) can never
-  gate backwards;
+- ``mfu``/``speedup``/``agreement``/``acceptance`` keys (any
+  ``_``-segment) and ``*_per_dispatch`` keys are explicitly
+  HIGHER-better — pinned ahead of the latency heuristic so a ratio
+  named against a latency (``decode_ms_speedup``,
+  ``tokens_per_dispatch`` measured off a ms window) can never gate
+  backwards;
 - other numeric keys default to HIGHER-better (throughput family);
 - ``*_ms`` latency keys are LOWER-better;
 - config echoes, band edges, source tags, error strings and the
@@ -43,15 +45,18 @@ _SKIP_KEYS = {"metric", "unit", "vs_baseline",
               # tenancy gauge: tracks CHIP load, not code speed
               "lstm_frozen_window_ms"}
 #: explicitly higher-better families: MFU/utilization ratios,
-#: speedup ratios, numeric agreement scores. Checked BEFORE the
-#: latency heuristic — these used to ride the generic default, so a
-#: future key like "decode_ms_speedup" would have matched the "ms"
-#: segment and gated backwards.
-_HIGHER_SEGMENTS = frozenset({"mfu", "speedup", "agreement"})
+#: speedup ratios, numeric agreement scores, speculative-decode
+#: acceptance rates, and tokens-per-dispatch amortization ratios.
+#: Checked BEFORE the latency heuristic — these used to ride the
+#: generic default, so a future key like "decode_ms_speedup" would
+#: have matched the "ms" segment and gated backwards.
+_HIGHER_SEGMENTS = frozenset({"mfu", "speedup", "agreement",
+                              "acceptance"})
 
 
 def _is_higher_key(key: str) -> bool:
-    return not _HIGHER_SEGMENTS.isdisjoint(key.split("_"))
+    return (not _HIGHER_SEGMENTS.isdisjoint(key.split("_"))
+            or key.endswith("_per_dispatch"))
 
 
 #: lower-is-better keys carry an "ms" path segment (step time, TTFT,

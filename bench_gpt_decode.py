@@ -34,8 +34,15 @@ warmup), device->host reads closing each window.
   decode executable's cost_analysis "bytes accessed" delta, the fp8
   page-capacity ratio, and before/after serving_decode roofline rows.
 
+- ``spec_ab``: the same mixed-length traffic served plain vs with
+  speculative decoding (n-gram self-draft + one fixed-shape verify
+  dispatch, serving/spec_decode.py) at draft depths k in {2, 4, 8} —
+  tokens/sec speedup, acceptance rate, tokens emitted per verify
+  dispatch (the weight-read amortization), TTFT tails, and f32 greedy
+  token identity per k.
+
 Run: python bench_gpt_decode.py [--engine-ab] [--prefix-ab]
-     [--kv-ab] [--fleet-ab] [--layers 12 ...]
+     [--kv-ab] [--fleet-ab] [--spec-ab] [--layers 12 ...]
 """
 
 from __future__ import annotations
@@ -616,6 +623,102 @@ def kv_ab(m, params, requests, slots=8, page_size=16, max_chunk=16):
     return line
 
 
+# --------------------------------------------- speculative-decode A/B
+def _run_spec_side(m, params, requests, slots, page_size, max_chunk,
+                   spec):
+    from deeplearning4j_tpu.serving.engine import DecodeEngine
+
+    need = max(p.size + nt for p, nt in requests)
+    eng = DecodeEngine(
+        m, params, slots=slots, page_size=page_size,
+        max_chunk=max_chunk, spec_decode=spec,
+        max_context=min(m.cfg.max_len,
+                        ((need + page_size - 1) // page_size)
+                        * page_size)).start()
+    try:
+        t0 = time.perf_counter()
+        handles = [eng.submit(p, nt) for p, nt in requests]
+        outs = [np.asarray(h.result(timeout=600)) for h in handles]
+        secs = time.perf_counter() - t0
+        stats = eng.stats()
+    finally:
+        eng.shutdown()
+    return outs, secs, {"ttfts": [h.ttft_s for h in handles],
+                        "stats": stats}
+
+
+def spec_ab(m, params, requests, slots=8, page_size=16, max_chunk=16,
+            ks=(2, 4, 8)):
+    """Speculative-decoding A/B on the same mixed-length traffic:
+    plain chunked bursts vs n-gram self-draft speculation at each
+    draft depth in ``ks``, same model/params/requests. Interleaved
+    best-of-2 windows per arm (the engine_ab ritual). Headline
+    metrics per k: decode tokens/sec speedup over plain, acceptance
+    rate, and tokens emitted per verify dispatch — the weight-read
+    amortization the speculative path exists to buy. TTFT tails ride
+    along: speculation must not regress first-token latency (drafting
+    only starts once a slot is decoding, so prefill is untouched).
+    Correctness: spec-on greedy outputs are verified token-identical
+    to spec-off at f32 per k (bf16 one-ulp argmax ties excluded, as
+    in engine_ab)."""
+    plain_s = float("inf")
+    spec_s = {k: float("inf") for k in ks}
+    spec_info = {}
+    for _ in range(2):
+        plain_outs, s, plain = _run_spec_side(
+            m, params, requests, slots, page_size, max_chunk, None)
+        plain_s = min(plain_s, s)
+        for k in ks:
+            _outs, s, info = _run_spec_side(
+                m, params, requests, slots, page_size, max_chunk, k)
+            spec_s[k] = min(spec_s[k], s)
+            spec_info[k] = info
+
+    # f32 verification pass: spec-on token-identical to spec-off per
+    # draft depth, or the A/B is void
+    m32 = CausalLM(m.cfg, compute_dtype=jnp.float32)
+    p32, _, _ = _run_spec_side(m32, params, requests, slots,
+                               page_size, max_chunk, None)
+    parity = {}
+    for k in ks:
+        s32, _, _ = _run_spec_side(m32, params, requests, slots,
+                                   page_size, max_chunk, k)
+        parity[k] = all(np.array_equal(a, b)
+                        for a, b in zip(s32, p32))
+
+    useful = sum(nt for _, nt in requests)
+    line = {
+        "requests": len(requests),
+        "slots": slots,
+        "useful_tokens": useful,
+        "plain_tokens_per_sec": round(useful / plain_s, 1),
+        "plain_ttft_p50_ms": round(_p(plain["ttfts"], 50) * 1e3, 3),
+        "plain_ttft_p99_ms": round(_p(plain["ttfts"], 99) * 1e3, 3),
+        "greedy_parity": all(parity.values()),
+    }
+    for k in ks:
+        sp = spec_info[k]["stats"]["spec"]
+        line[f"spec_k{k}_tokens_per_sec"] = round(
+            useful / spec_s[k], 1)
+        line[f"spec_k{k}_speedup"] = round(plain_s / spec_s[k], 3)
+        line[f"spec_k{k}_acceptance"] = round(sp["acceptance"], 3)
+        line[f"spec_k{k}_tokens_per_dispatch"] = round(
+            sp["tokens_per_dispatch"], 3)
+        line[f"spec_k{k}_ttft_p50_ms"] = round(
+            _p(spec_info[k]["ttfts"], 50) * 1e3, 3)
+        line[f"spec_k{k}_ttft_p99_ms"] = round(
+            _p(spec_info[k]["ttfts"], 99) * 1e3, 3)
+        line[f"spec_k{k}_greedy_parity"] = parity[k]
+    # headline convenience keys at the canonical depth (what bench.py
+    # aggregates as serving_spec_*)
+    mid = 4 if 4 in ks else ks[len(ks) // 2]
+    line["spec_decode_speedup"] = line[f"spec_k{mid}_speedup"]
+    line["spec_acceptance"] = line[f"spec_k{mid}_acceptance"]
+    line["tokens_per_dispatch"] = line[
+        f"spec_k{mid}_tokens_per_dispatch"]
+    return line
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--layers", type=int, default=12)
@@ -647,6 +750,13 @@ def main():
                          "mixed traffic (tokens/sec, TTFT tails, "
                          "decode-executable bytes delta, roofline "
                          "before/after)")
+    ap.add_argument("--spec-ab", action="store_true",
+                    help="also run the speculative-decoding A/B: "
+                         "plain chunked bursts vs n-gram self-draft "
+                         "speculation at k in {2,4,8} on mixed-length "
+                         "traffic (tokens/sec speedup, acceptance "
+                         "rate, tokens per verify dispatch, TTFT "
+                         "tails, f32 greedy token identity)")
     ap.add_argument("--fleet-requests", type=int, default=48)
     ap.add_argument("--fleet-long-prompt", type=int, default=192)
     ap.add_argument("--fleet-threshold", type=int, default=64,
@@ -695,6 +805,12 @@ def main():
                               seed=1)
         line["kv_ab"] = kv_ab(m, params, reqs, args.slots,
                               args.page_size, args.max_chunk)
+    if args.spec_ab:
+        reqs = mixed_requests(args.vocab, args.requests, args.prompt,
+                              args.new_lo, args.new_hi or args.new,
+                              seed=2)
+        line["spec_ab"] = spec_ab(m, params, reqs, args.slots,
+                                  args.page_size, args.max_chunk)
     if args.fleet_ab:
         line["fleet_ab"] = fleet_ab(
             m, params, requests=args.fleet_requests,

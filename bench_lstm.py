@@ -64,10 +64,18 @@ def main():
             hidden=args.hidden, vocab=args.vocab)))
         return
 
+    # roofline registry on for this run: the compiled step registers
+    # under "bench_lstm_step" so the aggregate line carries its
+    # roofline-verdict row (memory- vs compute-bound + achieved rates)
+    from deeplearning4j_tpu.profiler import programs
+
+    programs.set_enabled(True)
+    programs.get_default().reset()
     r = run_char_lstm(batch=args.batch, seq=args.seq,
                       hidden=args.hidden, vocab=args.vocab,
                       steps=args.steps, dtype=args.dtype,
-                      precision=args.precision)
+                      precision=args.precision,
+                      site="bench_lstm_step")
     tok_s = r["tokens_per_sec"]
     out = {"metric": "char_lstm_train", "value": round(tok_s, 1),
            "unit": "tokens/sec/chip", "batch": args.batch,
@@ -94,6 +102,16 @@ def main():
         h, v = args.hidden, args.vocab
         fwd_tok = 8 * h * (v + h) + 8 * h * (h + h) + 2 * h * v
         out["tflops_est"] = round(tok_s * 3 * fwd_tok / 1e12, 2)
+    # feed the measured window back into the registry so the row
+    # carries achieved FLOP/s / GB/s, not just the static verdict
+    from bench_common import roofline_row
+
+    row = roofline_row("bench_lstm_step",
+                       seconds_per_step=r["tokens_per_step"]
+                       / max(tok_s, 1e-9),
+                       steps=args.steps)
+    if row:
+        out["roofline"] = row
     if args.pipeline_ab:
         out.update(pipeline_ab_lstm(hidden=args.hidden,
                                     vocab=args.vocab))

@@ -150,13 +150,23 @@ def main():
     # ablations change the program, so constants don't transfer).
     jitted = step if args.mode == "train" else fwd
     if args.mode == "train":
-        low = jitted.lower(net.params_map, net.states_map,
-                           net.opt_states, jnp.asarray(0),
-                           jnp.asarray(0), inputs, labels, {}, {},
-                           jax.random.key(0))
+        largs = (net.params_map, net.states_map, net.opt_states,
+                 jnp.asarray(0), jnp.asarray(0), inputs, labels, {},
+                 {}, jax.random.key(0))
     else:
-        low = jitted.lower(net.params_map, net.states_map)
-    comp = low.compile()
+        largs = (net.params_map, net.states_map)
+    comp = jitted.lower(*largs).compile()
+    # register the compiled step in the roofline program registry so
+    # the aggregate line carries its verdict row (memory- vs compute-
+    # bound + achieved rates once the timed window is fed back in)
+    from deeplearning4j_tpu.profiler import programs
+    from deeplearning4j_tpu.profiler.telemetry import _arg_signature
+
+    programs.set_enabled(True)
+    programs.get_default().reset()
+    programs.get_default().register(
+        "bench_resnet_step", _arg_signature(largs, {}), comp,
+        source="bench")
     try:
         measured_step_flops = _cost_analysis_flops(comp)
     except Exception as e:
@@ -206,6 +216,12 @@ def main():
         out["flops_src"] = flops_src
         if peak:
             out["mfu_est"] = round(flops / peak, 4)
+    from bench_common import roofline_row
+    row = roofline_row("bench_resnet_step",
+                       seconds_per_step=best / args.steps,
+                       steps=args.steps)
+    if row:
+        out["roofline"] = row
     if args.pipeline_ab and args.mode == "train":
         from bench_common import pipeline_ab_fixed
         from deeplearning4j_tpu.datasets import ArrayDataSetIterator

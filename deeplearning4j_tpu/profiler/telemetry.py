@@ -105,6 +105,14 @@ SERVING_WARM_MISSES = "dl4j_tpu_serving_warm_pool_misses_total"
 SERVING_DECODE_STEPS = "dl4j_tpu_serving_decode_steps_total"
 SERVING_DECODE_STEP_SECONDS = "dl4j_tpu_serving_decode_step_seconds"
 SERVING_PREFILL_SECONDS = "dl4j_tpu_serving_prefill_seconds"
+#: speculative decoding (serving/spec_decode.py): drafts proposed /
+#: accepted, the cumulative acceptance-rate + tokens-per-weight-read
+#: gauges, and the verify-dispatch latency histogram
+SERVING_SPEC_PROPOSED = "dl4j_tpu_serving_spec_proposed_tokens_total"
+SERVING_SPEC_ACCEPTED = "dl4j_tpu_serving_spec_accepted_tokens_total"
+SERVING_SPEC_ACCEPTANCE = "dl4j_tpu_serving_spec_acceptance_rate"
+SERVING_TOKENS_PER_DISPATCH = "dl4j_tpu_serving_tokens_per_dispatch"
+SERVING_VERIFY_SECONDS = "dl4j_tpu_serving_verify_seconds"
 #: cross-request KV reuse (serving/prefix_cache.py, sessions.py)
 SERVING_PREFIX_HITS = "dl4j_tpu_serving_prefix_cache_hits_total"
 SERVING_PREFIX_MISSES = "dl4j_tpu_serving_prefix_cache_misses_total"
@@ -1051,6 +1059,12 @@ def serving_snapshot() -> Dict[str, Any]:
                       ("warm_pool_hits", SERVING_WARM_HITS),
                       ("warm_pool_misses", SERVING_WARM_MISSES),
                       ("decode_steps", SERVING_DECODE_STEPS),
+                      ("spec_proposed_tokens", SERVING_SPEC_PROPOSED),
+                      ("spec_accepted_tokens", SERVING_SPEC_ACCEPTED),
+                      ("spec_acceptance_rate", SERVING_SPEC_ACCEPTANCE),
+                      ("tokens_per_dispatch",
+                       SERVING_TOKENS_PER_DISPATCH),
+                      ("verify_seconds", SERVING_VERIFY_SECONDS),
                       ("prefix_cache_hits", SERVING_PREFIX_HITS),
                       ("prefix_cache_misses", SERVING_PREFIX_MISSES),
                       ("prefix_cache_hit_tokens",
@@ -1090,6 +1104,10 @@ def serving_snapshot() -> Dict[str, Any]:
         for key, name in (("requests_total", SERVING_REQUESTS),
                           ("tokens_total", SERVING_TOKENS),
                           ("decode_steps_total", SERVING_DECODE_STEPS),
+                          ("spec_proposed_tokens_total",
+                           SERVING_SPEC_PROPOSED),
+                          ("spec_accepted_tokens_total",
+                           SERVING_SPEC_ACCEPTED),
                           ("capacity_rejects_total", SERVING_REJECTS),
                           ("prefix_cache_hits_total",
                            SERVING_PREFIX_HITS),
@@ -1168,6 +1186,9 @@ __all__ = [
     "SERVING_WARM_HITS",
     "SERVING_WARM_MISSES", "SERVING_DECODE_STEPS",
     "SERVING_DECODE_STEP_SECONDS", "SERVING_PREFILL_SECONDS",
+    "SERVING_SPEC_PROPOSED", "SERVING_SPEC_ACCEPTED",
+    "SERVING_SPEC_ACCEPTANCE", "SERVING_TOKENS_PER_DISPATCH",
+    "SERVING_VERIFY_SECONDS",
     "SERVING_PREFIX_HITS", "SERVING_PREFIX_MISSES",
     "SERVING_PREFIX_HIT_TOKENS", "SERVING_PREFIX_EVICTED_PAGES",
     "SERVING_PREFIX_CACHED_PAGES", "SERVING_SHARED_PAGES",

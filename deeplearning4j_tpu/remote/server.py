@@ -168,7 +168,10 @@ class JsonModelServer:
                 float(payload.get("temperature", 0.0)),
                 payload.get("eos_id"),
                 payload.get("sample_seed"),
-                session_id=payload.get("session_id"))
+                session_id=payload.get("session_id"),
+                # speculative decoding: None follows the engine's
+                # spec_decode config, false opts this request out
+                spec_decode=payload.get("spec_decode"))
 
         # idempotent submit: a replayed POST (the client's connection
         # reset after the server already admitted the request) returns
@@ -219,6 +222,15 @@ class JsonModelServer:
         routing = getattr(req, "routing", None)
         if routing:
             out["routing"] = dict(routing)
+        # speculative-decoding acceptance stats (engines with
+        # spec_decode on): how many draft tokens the target accepted
+        # for THIS request — 0 proposed means the request never rode a
+        # verify dispatch (spec off, or opted out)
+        proposed = getattr(req, "spec_proposed", 0)
+        if proposed:
+            accepted = getattr(req, "spec_accepted", 0)
+            out["spec"] = {"proposed": proposed, "accepted": accepted,
+                           "acceptance": round(accepted / proposed, 4)}
         if replayed:
             out["replayed"] = True
         return out

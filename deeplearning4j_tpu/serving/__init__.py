@@ -19,6 +19,10 @@ kill/drain/restart replica lifecycle. Hard capacity rejects raise
 Front-ends: ``parallel.wrapper.GenerativeInference``
 (ParallelInference-parity submit/stream API; ``replicas=N`` builds a
 fleet) and ``remote.server.JsonModelServer(engine=...)`` (HTTP).
+Speculative decoding: ``SpecConfig`` (spec_decode.py) drives a
+host-side draft + one fixed-shape verify dispatch per burst
+(``DecodeEngine(spec_decode=4)``), emitting up to k+1 tokens per
+weight read with greedy outputs token-identical to the plain path.
 """
 
 from deeplearning4j_tpu.serving.engine import (
@@ -28,7 +32,8 @@ from deeplearning4j_tpu.serving.fleet import FleetRequest, ServingFleet
 from deeplearning4j_tpu.serving.kv_pages import PagePool
 from deeplearning4j_tpu.serving.prefix_cache import PrefixCache
 from deeplearning4j_tpu.serving.sessions import SessionStore
+from deeplearning4j_tpu.serving.spec_decode import NGramDraft, SpecConfig
 
 __all__ = ["DecodeEngine", "ServingRequest", "CapacityRejected",
            "ServingFleet", "FleetRequest", "PagePool",
-           "PrefixCache", "SessionStore"]
+           "PrefixCache", "SessionStore", "SpecConfig", "NGramDraft"]

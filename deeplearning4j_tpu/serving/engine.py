@@ -64,6 +64,8 @@ from deeplearning4j_tpu.profiler import tracing as _tracing
 from deeplearning4j_tpu.serving import kv_pages
 from deeplearning4j_tpu.serving.prefix_cache import PrefixCache
 from deeplearning4j_tpu.serving.sessions import SessionStore
+from deeplearning4j_tpu.serving.spec_decode import (SpecConfig,
+                                                    accept_tokens)
 
 log = logging.getLogger("deeplearning4j_tpu")
 
@@ -114,6 +116,13 @@ class ServingRequest:
         #: prompt tokens whose K/V came from the prefix cache or a
         #: sticky session instead of prefill compute (0 = cold)
         self.cache_hit_tokens = 0
+        #: speculative decoding: per-request opt-in/out (None follows
+        #: the engine's spec_decode config) and the request's own
+        #: draft-token acceptance tally — front-ends echo these as the
+        #: response's ``spec`` stats
+        self.spec_enabled: Optional[bool] = None
+        self.spec_proposed = 0
+        self.spec_accepted = 0
         #: conversation turn this request will pin as (resume bumps it)
         self._session_turns = 1
         self._keydata = keydata
@@ -363,6 +372,18 @@ class DecodeEngine:
         ``DL4J_TPU_PAGED_ATTN`` / backend auto-detection: the fused
         online-softmax kernel on TPU, the reference einsum pair
         elsewhere. "xla" is op-for-op the pre-kernel engine.
+    spec_decode : None | int k | "ngram" | dict | SpecConfig —
+        speculative decoding (serving/spec_decode.py): a host-side
+        draft proposes up to ``k`` tokens per slot per burst and one
+        AOT-warmed fixed-shape VERIFY program scores all ``k+1``
+        positions through the target model in a single weight read;
+        the accepted prefix plus one correction/bonus token is
+        emitted (greedy: exactly the plain rollout, token for token;
+        temperature > 0: the target distribution is preserved by
+        rejection sampling). None (the default) builds no verify
+        program — the engine stays program-for-program identical to
+        the spec-less path. Requests opt out per-submit with
+        ``spec_decode=False``.
     prefix_cache : index committed prompt pages by chained page hash
         (serving/prefix_cache.py) and serve later prompts' shared
         prefixes from the SAME refcounted pages — copy-on-write on
@@ -402,7 +423,8 @@ class DecodeEngine:
                  handoff_threshold: Optional[int] = None,
                  warm_source: Optional["DecodeEngine"] = None,
                  kv_dtype: Optional[str] = None,
-                 attn_mode: Optional[str] = None):
+                 attn_mode: Optional[str] = None,
+                 spec_decode=None):
         cfg = model.cfg
         self.model = model
         #: metric/trace label for this engine (``engine=<id>`` on every
@@ -550,6 +572,26 @@ class DecodeEngine:
                                      donate_argnums=(0,))
             self._copy_fallback = _telemetry.instrument_jit(
                 "serving_cow_copy", self._copy_jit)
+        # speculative decoding (serving/spec_decode.py): a host-side
+        # draft proposes k tokens per slot and ONE fixed-shape verify
+        # program scores all k+1 positions per weight read. Off (the
+        # default) builds NOTHING — the engine's program set stays
+        # byte-identical to the spec-less path, same gating discipline
+        # as self._reuse above.
+        self._spec = SpecConfig.resolve(spec_decode)
+        if self._spec is not None:
+            self._spec_draft = self._spec.make_draft()
+            self._verify_jit = jax.jit(self._build_verify_fn(),
+                                       donate_argnums=(1,))
+            self._verify_fallback = _telemetry.instrument_jit(
+                "serving_verify", self._verify_jit)
+        self.n_spec_proposed = 0
+        self.n_spec_accepted = 0
+        #: verify dispatches summed over the ACTIVE lanes they served —
+        #: the denominator of tokens-per-weight-read (a plain decode
+        #: lane-step scores exactly 1 token per weight read)
+        self.n_verify_lane_steps = 0
+        self.n_verify_dispatches = 0
         self._warm = _WarmPool(engine_id=self.engine_id)
         self._warm_start = bool(warm_start)
         # scheduler. max_queue bounds queued + head-of-line-waiting
@@ -826,6 +868,98 @@ class DecodeEngine:
 
         return adopt
 
+    def _build_verify_fn(self):
+        """Speculative VERIFY: one fixed-shape dispatch scores the
+        pending token plus ``K`` draft tokens per slot — ``W = K + 1``
+        consecutive positions ``pos[s]..pos[s]+K`` — through the
+        target model, then accepts the longest draft prefix the target
+        agrees with (spec_decode.accept_tokens). The multi-position
+        machinery is the prefix-prefill suffix path batched over
+        slots: per-lane (page, offset) scatter (kv_pages.append_spec),
+        attention through the slot's whole page table with the SAME
+        paged_attention op the decode core uses (query ``i`` of row
+        ``s`` at absolute position ``pos[s] + i``, causal by the
+        kernel's flat-position mask), DECODE params — the int8 weight
+        read this whole feature exists to amortize happens ONCE for
+        all W positions.
+
+        Rollback is positional only: lanes past the accepted prefix
+        wrote K/V at positions ``>= new_pos``, which the flat-position
+        mask hides and the next dispatch overwrites in place
+        (kv_pages.spec_rewind). Greedy rows are token-identical to the
+        decode core's by row independence — the same batched-vs-single
+        argument the prefix-prefill identity gate already rests on."""
+        cfg = self.model.cfg
+        cd = self.model._cdtype
+        S, ps, P = self.slots, self.page_size, self.pages_per_slot
+        ln = self.model._ln
+        attn = self._attn_mode
+        K = self._spec.k
+        W = K + 1
+
+        def emb_rows(w, idx):
+            # 2-D token-index variant of self._rows (per-row scales)
+            if isinstance(w, dict):
+                return w["q"][idx].astype(cd) \
+                    * w["s"][idx][..., None].astype(cd)
+            return w.astype(cd)[idx]
+
+        def verify(params, kv, tables, pos, active, tok, drafts,
+                   n_draft, keydata, temps):
+            toks = jnp.concatenate([tok[:, None], drafts], axis=1)
+            posw = pos[:, None] \
+                + jnp.arange(W, dtype=jnp.int32)[None, :]   # [S, W]
+            x = emb_rows(params["tok_emb"], toks) \
+                + params["pos_emb"].astype(cd)[
+                    jnp.minimum(posw, cfg.max_len - 1)]
+            # lane 0 is the pending token (always real while the slot
+            # is live); lane i >= 1 is draft i, real up to n_draft.
+            # Padded/inactive lanes write to the null page.
+            real = (jnp.arange(W, dtype=jnp.int32)[None, :]
+                    <= n_draft[:, None]) & active[:, None]
+            chunk = jnp.minimum(posw // ps, P - 1)
+            page = jnp.where(
+                real, jnp.take_along_axis(tables, chunk, axis=1), 0)
+            off = posw % ps
+            seg = jnp.where(real, chunk, P)
+            for li, lp in enumerate(params["layers"]):
+                h = ln(x, lp["ln1"])
+                qkv = int8_matmul(h, lp["wqkv"], cd) \
+                    + lp["bqkv"].astype(cd)
+                q, k, v = jnp.split(qkv, 3, axis=-1)
+                hs = lambda y: y.reshape(S, W, cfg.n_heads,
+                                         cfg.head_dim)
+                q, k, v = hs(q), hs(k), hs(v)
+                # write BEFORE attending: draft i's scoring must see
+                # the K/V of drafts 1..i-1 written this dispatch
+                kv = kv_pages.append_spec(
+                    kv, li, page, off, k, v, chunk=seg, real=real,
+                    tables=tables)
+                ctx = paged_attention(q.transpose(0, 2, 1, 3), kv, li,
+                                      tables, pos, mode=attn)
+                ctx = ctx.transpose(0, 2, 1, 3) \
+                    .reshape(S, W, cfg.d_model)
+                x = x + int8_matmul(ctx, lp["wo"], cd) \
+                    + lp["bo"].astype(cd)
+                h = ln(x, lp["ln2"])
+                x = x + int8_matmul(
+                    jax.nn.gelu(int8_matmul(h, lp["w1"], cd)
+                                + lp["b1"].astype(cd)),
+                    lp["w2"], cd) + lp["b2"].astype(cd)
+            x = ln(x, params["ln_f"])
+            logits = self._head(x, params["tok_emb"], cd) \
+                .astype(jnp.float32)
+            out, n_acc, nkd = accept_tokens(logits, drafts, n_draft,
+                                            keydata, temps)
+            adv = jnp.where(active, n_acc, 0)
+            new_pos = kv_pages.spec_rewind(pos, adv)
+            corr = jnp.take_along_axis(
+                out, (n_acc - 1)[:, None], axis=1)[:, 0]
+            new_tok = jnp.where(active, corr, tok)
+            return kv, out, adv, new_pos, new_tok, nkd
+
+        return verify
+
     # ---------------------------------------------------------- startup
     def start(self) -> "DecodeEngine":
         with self._start_lock:
@@ -865,11 +999,13 @@ class DecodeEngine:
                 and (src.slots, src.page_size, src.max_context,
                      src.quantization, tuple(src.prefill_buckets),
                      src.max_chunk, src._reuse, src.kv_dtype,
-                     src._attn_mode) \
+                     src._attn_mode,
+                     src._spec.k if src._spec else None) \
                 == (self.slots, self.page_size, self.max_context,
                     self.quantization, tuple(self.prefill_buckets),
                     self.max_chunk, self._reuse, self.kv_dtype,
-                    self._attn_mode):
+                    self._attn_mode,
+                    self._spec.k if self._spec else None):
             self._warm.adopt(src._warm)
         S, P, kw = self.slots, self.pages_per_slot, self._kd_width
         i32, u32, f32 = jnp.int32, jnp.uint32, jnp.float32
@@ -907,6 +1043,16 @@ class DecodeEngine:
                     ("adopt", b), self._adopt_jit,
                     kv_abs, kv_sds, kv_sds,
                     sds((b // self.page_size,), i32), *extra)
+            if self._spec is not None:
+                K = self._spec.k
+                if ("verify", K) not in self._warm:
+                    self._warm.compile(
+                        ("verify", K), self._verify_jit,
+                        _abs(self._decode_params), kv_abs,
+                        sds((S, P), i32), sds((S,), i32),
+                        sds((S,), bool), sds((S,), i32),
+                        sds((S, K), i32), sds((S,), i32),
+                        sds((S, kw), u32), sds((S,), f32))
             if self._reuse:
                 if ("cow_copy", 0) not in self._warm:
                     self._warm.compile(
@@ -972,11 +1118,12 @@ class DecodeEngine:
                temperature: float = 0.0, eos_id: Optional[int] = None,
                sample_seed: Optional[int] = None,
                session_id: Optional[str] = None,
+               spec_decode: Optional[bool] = None,
                _sink=None) -> ServingRequest:
         prompt = self._validate(prompt_ids, max_new_tokens)
         req = self._make_request(prompt, max_new_tokens, temperature,
                                  eos_id, sample_seed, session_id,
-                                 _sink)
+                                 _sink, spec_decode=spec_decode)
         self._enqueue(req)
         return req
 
@@ -985,6 +1132,7 @@ class DecodeEngine:
                         eos_id: Optional[int] = None,
                         sample_seed: Optional[int] = None,
                         session_id: Optional[str] = None,
+                        spec_decode: Optional[bool] = None,
                         handoff=None, lane_span=None,
                         _sink=None) -> ServingRequest:
         """Fleet replica mode: submit a request whose prompt K/V was
@@ -1001,7 +1149,7 @@ class DecodeEngine:
         prompt = self._validate(prompt_ids, max_new_tokens)
         req = self._make_request(prompt, max_new_tokens, temperature,
                                  eos_id, sample_seed, session_id,
-                                 _sink)
+                                 _sink, spec_decode=spec_decode)
         req._handoff = handoff
         if req._trace is not None and lane_span is not None:
             t0, t1, bucket = lane_span
@@ -1011,7 +1159,9 @@ class DecodeEngine:
 
     def _make_request(self, prompt: np.ndarray, max_new_tokens: int,
                       temperature: float, eos_id, sample_seed,
-                      session_id, sink) -> ServingRequest:
+                      session_id, sink,
+                      spec_decode: Optional[bool] = None) \
+            -> ServingRequest:
         if self._dead is not None or self._stop.is_set():
             raise RuntimeError("engine has been shut down")
         rid = next(self._req_counter)
@@ -1023,6 +1173,7 @@ class DecodeEngine:
                              session_id=session_id)
         req.engine_id = self.engine_id
         req._engine = self
+        req.spec_enabled = spec_decode
         if sink is not None:
             # attach BEFORE the queue put: the scheduler may admit and
             # emit tokens the instant the request is visible, and the
@@ -1154,6 +1305,21 @@ class DecodeEngine:
             "warm_pool": {"hits": self._warm.hits,
                           "misses": self._warm.misses,
                           "adopted": self._warm.adopted},
+            **({"spec": {
+                "k": self._spec.k,
+                "verify_dispatches": self.n_verify_dispatches,
+                "proposed": self.n_spec_proposed,
+                "accepted": self.n_spec_accepted,
+                "acceptance": (self.n_spec_accepted
+                               / self.n_spec_proposed
+                               if self.n_spec_proposed else 0.0),
+                # tokens emitted per weight read per decode lane;
+                # the plain chunked burst is 1.0 by construction
+                "tokens_per_dispatch": (
+                    (self.n_spec_accepted + self.n_verify_lane_steps)
+                    / self.n_verify_lane_steps
+                    if self.n_verify_lane_steps else 0.0),
+            }} if self._spec is not None else {}),
             **({"prefix_cache": self.prefix_stats()}
                if self._reuse else {}),
             # newest-first: client logs join on request_id, per-request
@@ -1699,6 +1865,137 @@ class DecodeEngine:
     #: can only happen at roster boundaries anyway)
     MAX_BURST_DISPATCHES = 4
 
+    def _spec_burst(self) -> bool:
+        """One speculative draft -> verify burst: the host drafts up to
+        ``k`` tokens per eligible slot (from the slot's own emitted
+        history — the draft must see the newest accepted tokens, which
+        is why a verify burst is exactly one dispatch), ONE warm
+        verify call scores every slot's ``k+1`` positions through the
+        decode weights, and the accepted prefix + correction is
+        emitted. Slots whose request opted out (``spec_decode=False``)
+        or that have a single token of budget left ride along with
+        ``n_draft = 0`` — their lane is op-for-op a plain decode step.
+
+        Returns False when NO active slot is spec-eligible this pass,
+        and the caller falls through to the plain chunked burst — a
+        fully opted-out roster never pays the wider program."""
+        t0 = time.perf_counter()
+        active_idx = np.flatnonzero(self._active)
+        K = self._spec.k
+        S = self.slots
+        drafts = np.zeros((S, K), np.int32)
+        n_draft = np.zeros((S,), np.int32)
+        for s in active_idx:
+            req = self._slot_req[int(s)]
+            if req.spec_enabled is False:
+                continue
+            # never draft past the request budget: the correction
+            # token always emits, so at most rem - 1 drafts can land
+            rem = req.max_new_tokens - int(self._slot_emitted[s])
+            nd = min(K, rem - 1)
+            if nd <= 0:
+                continue
+            history = np.concatenate(
+                [req.prompt, np.asarray(req.tokens, np.int32)])
+            prop = np.asarray(self._spec_draft.propose(history, nd),
+                              np.int32).ravel()[:nd]
+            drafts[s, :prop.size] = prop
+            n_draft[s] = prop.size
+        if not n_draft.any():
+            return False
+        tables, active, temps = self._dev_slot_state()
+        occupancy = float(len(active_idx)) / S
+        (kvt, out, adv, pos, tok, kd) = self._warm.run(
+            ("verify", K), self._verify_fallback, self._decode_params,
+            self.pool.tree(), tables, jnp.asarray(self._pos), active,
+            jnp.asarray(self._tok), jnp.asarray(drafts),
+            jnp.asarray(n_draft), jnp.asarray(self._keydata), temps)
+        self.pool.rebind(kvt)
+        self.n_dispatches += 1
+        self.n_verify_dispatches += 1
+        # ONE host sync for the whole burst (np.array copies: _admit
+        # writes joined slots' state into these buffers in place)
+        out = np.asarray(out)
+        nacc = np.array(adv)
+        self._pos = np.array(pos)
+        self._tok = np.array(tok)
+        self._keydata = np.array(kd)
+        self.n_steps += 1
+        self._occupancy_sum += occupancy
+        lanes = int(len(active_idx))
+        self.n_verify_lane_steps += lanes
+        proposed = int(n_draft[active_idx].sum())
+        accepted = int((nacc[active_idx] - 1).sum())
+        self.n_spec_proposed += proposed
+        self.n_spec_accepted += accepted
+        _telemetry.record_span(
+            "serving_verify", t0,
+            metric=_telemetry.SERVING_VERIFY_SECONDS,
+            engine=self.engine_id)
+        _flight.record("serving_verify", engine=self.engine_id,
+                       k=K, lanes=lanes, proposed=proposed,
+                       accepted=accepted,
+                       occupancy=round(occupancy, 4))
+        if _tracing.enabled():
+            t_end = time.perf_counter()
+            for s in active_idx:
+                r = self._slot_req[int(s)]
+                if r is not None and r._trace is not None:
+                    r._trace.event("verify", t0, t_end, slot=int(s),
+                                   proposed=int(n_draft[s]),
+                                   accepted=int(nacc[s] - 1))
+        if _telemetry.enabled():
+            reg = _telemetry.MetricsRegistry.get_default()
+            reg.gauge(_telemetry.SERVING_SLOT_OCCUPANCY,
+                      "fraction of decode slots occupied by live "
+                      "requests this step").set(occupancy,
+                                                engine=self.engine_id)
+            reg.counter(_telemetry.SERVING_DECODE_STEPS,
+                        "fixed-shape decode steps executed").inc(
+                engine=self.engine_id)
+            if proposed:
+                reg.counter(
+                    _telemetry.SERVING_SPEC_PROPOSED,
+                    "draft tokens proposed to the verify "
+                    "program").inc(proposed, engine=self.engine_id)
+            if accepted:
+                reg.counter(
+                    _telemetry.SERVING_SPEC_ACCEPTED,
+                    "draft tokens the target model accepted").inc(
+                    accepted, engine=self.engine_id)
+            if self.n_spec_proposed:
+                reg.gauge(
+                    _telemetry.SERVING_SPEC_ACCEPTANCE,
+                    "cumulative accepted / proposed draft "
+                    "tokens").set(
+                    self.n_spec_accepted / self.n_spec_proposed,
+                    engine=self.engine_id)
+            if self.n_verify_lane_steps:
+                reg.gauge(
+                    _telemetry.SERVING_TOKENS_PER_DISPATCH,
+                    "tokens emitted per weight read per decode lane "
+                    "(plain decode = 1.0)").set(
+                    (self.n_spec_accepted + self.n_verify_lane_steps)
+                    / self.n_verify_lane_steps,
+                    engine=self.engine_id)
+        emitted0 = self.n_tokens
+        for s in active_idx:
+            req = self._slot_req[int(s)]
+            if req is not None:
+                req.spec_proposed += int(n_draft[s])
+                req.spec_accepted += int(nacc[s] - 1)
+            for i in range(int(nacc[s])):
+                if not self._active[s]:
+                    break          # finished on eos mid-acceptance
+                self._emit(int(s), int(out[s, i]))
+        self.last_progress = time.monotonic()
+        if _telemetry.enabled() and self.n_tokens > emitted0:
+            _telemetry.MetricsRegistry.get_default().counter(
+                _telemetry.SERVING_TOKENS,
+                "tokens generated across all requests").inc(
+                self.n_tokens - emitted0, engine=self.engine_id)
+        return True
+
     def _decode_step(self) -> None:
         """One decode BURST: chain chunk dispatches device-to-device —
         pos/tok/keys flow from one executable's output straight into
@@ -1707,6 +2004,8 @@ class DecodeEngine:
         request completion, an active eos_id (completion unpredictable
         -> single chunk), or a queued request that could join a free
         slot."""
+        if self._spec is not None and self._spec_burst():
+            return
         t0 = time.perf_counter()
         active_idx = np.flatnonzero(self._active)
         min_rem = min(

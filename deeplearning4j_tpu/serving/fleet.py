@@ -88,18 +88,27 @@ class FleetRequest:
     client-visible stream never duplicates or loses a token."""
 
     def __init__(self, prompt: np.ndarray, max_new_tokens: int,
-                 temperature: float, eos_id, sample_seed, session_id):
+                 temperature: float, eos_id, sample_seed, session_id,
+                 spec_decode: Optional[bool] = None):
         self.prompt = prompt
         self.max_new_tokens = int(max_new_tokens)
         self.temperature = float(temperature)
         self.eos_id = eos_id
         self.sample_seed = sample_seed
         self.session_id = session_id
+        #: per-request speculative-decoding opt-in/out (None follows
+        #: the replica engines' spec_decode config) — replayed verbatim
+        #: on failover so a rerouted request keeps its draft behavior
+        self.spec_decode = spec_decode
         self.tokens: List[int] = []
         self.finish_reason: Optional[str] = None
         self.ttft_s: Optional[float] = None
         self.latency_s: Optional[float] = None
         self.cache_hit_tokens = 0
+        #: draft-token tally of the FINAL attempt (set at finish):
+        #: front-ends echo these as the response's ``spec`` stats
+        self.spec_proposed = 0
+        self.spec_accepted = 0
         #: routing facts front-ends echo: replica, reason, lane, attempts
         self.routing: Dict[str, Any] = {}
         self.engine_id: Optional[str] = None
@@ -174,6 +183,8 @@ class FleetRequest:
             self.latency_s = time.perf_counter() - self._t_submit
             if inner is not None:
                 self.cache_hit_tokens = inner.cache_hit_tokens
+                self.spec_proposed = inner.spec_proposed
+                self.spec_accepted = inner.spec_accepted
             self._stream.put(None)
             self._done.set()
         fleet = self._fleet
@@ -549,7 +560,8 @@ class ServingFleet:
     def submit(self, prompt_ids, max_new_tokens: int,
                temperature: float = 0.0, eos_id: Optional[int] = None,
                sample_seed: Optional[int] = None,
-               session_id: Optional[str] = None) -> FleetRequest:
+               session_id: Optional[str] = None,
+               spec_decode: Optional[bool] = None) -> FleetRequest:
         if self._stop.is_set():
             raise RuntimeError("fleet has been shut down")
         # validate synchronously (every replica has the same config)
@@ -558,7 +570,8 @@ class ServingFleet:
         if self._router is None:
             self.start()
         freq = FleetRequest(prompt, max_new_tokens, temperature,
-                            eos_id, sample_seed, session_id)
+                            eos_id, sample_seed, session_id,
+                            spec_decode=spec_decode)
         freq._fleet = self
         try:
             self._queue.put_nowait(freq)
@@ -932,14 +945,16 @@ class ServingFleet:
                 inner = eng.submit_prepared(
                     freq.prompt, freq.max_new_tokens,
                     freq.temperature, freq.eos_id, freq.sample_seed,
-                    session_id=freq.session_id, handoff=ho,
+                    session_id=freq.session_id,
+                    spec_decode=freq.spec_decode, handoff=ho,
                     lane_span=lane_span, _sink=freq)
                 freq._lane_result = None
             else:
                 inner = eng.submit(
                     freq.prompt, freq.max_new_tokens,
                     freq.temperature, freq.eos_id, freq.sample_seed,
-                    session_id=freq.session_id, _sink=freq)
+                    session_id=freq.session_id,
+                    spec_decode=freq.spec_decode, _sink=freq)
         except CapacityRejected:
             # replica queue full (rare: fleet sizes replica queues
             # generously) — try again through the router

@@ -403,6 +403,89 @@ def append_suffix(kv, layer: int, page_idx, offset, k, v, *,
     return out
 
 
+def append_spec(kv, layer: int, page_idx, offset, k, v, *,
+                chunk=None, real=None, tables=None):
+    """Write one VERIFY dispatch's K/V: ``W = k_drafts + 1`` lanes per
+    slot at consecutive positions (``[S, W]`` index arrays, ``[S, W,
+    H, hd]`` values), padded/inactive lanes pointing at the null page.
+    The batched, multi-slot sibling of :func:`append_suffix`.
+
+    Rewind contract (speculative decoding): lanes past the accepted
+    prefix wrote K/V that the engine's position rollback
+    (:func:`spec_rewind`) makes invisible — attention only admits flat
+    position ``<= query pos`` — and the NEXT dispatch overwrites those
+    exact (page, offset) cells because positions are consecutive. No
+    device-side cleanup ever runs.
+
+    fp8 scale composition with that rollback: ``chunk`` ([S, W], the
+    lane's table row, or P for padded lanes), ``real`` ([S, W]) and
+    ``tables`` ([S, P]) drive a per-(slot, page) segment-max absmax,
+    exactly :func:`append_suffix` per slot. A page whose offset-0 lane
+    is real in this batch mints a fresh scale; others keep their
+    stored scale. A scale minted partly from later-REJECTED lanes
+    merely over-covers the values that replace them (bounded
+    quantization error, the same ±448 clip bound as
+    :func:`append_token`'s one-token mint) — and when the rollback
+    lands back ON the page's offset 0, the overwriting dispatch
+    re-mints the scale fresh, so rejected garbage never outlives the
+    page's first committed entry. The ``tables`` scatter may carry the
+    same page in several slots' rows (prefix-cache sharing); those
+    duplicates are value-identical writes — shared pages are read-only
+    for every slot (writers own their pages at refcount 1), so their
+    scale rows always re-write the stored value."""
+    if not _is_fp8(kv):
+        out = dict(kv)
+        out["k"] = kv["k"].at[layer, page_idx, :, offset].set(
+            k.astype(kv["k"].dtype))
+        out["v"] = kv["v"].at[layer, page_idx, :, offset].set(
+            v.astype(kv["v"].dtype))
+        return out
+    S, W = real.shape
+    P = tables.shape[1]
+    # per-(slot, page) segments: slot s's table row c -> s*(P+1) + c,
+    # padded lanes -> the slot's trash segment s*(P+1) + P
+    segf = (jnp.arange(S, dtype=jnp.int32)[:, None] * (P + 1)
+            + chunk).reshape(-1)
+    started = jax.ops.segment_max(
+        jnp.where(real & (offset == 0), 1, 0).reshape(-1), segf,
+        num_segments=S * (P + 1)).reshape(S, P + 1)[:, :P] > 0  # [S, P]
+    out = dict(kv)
+
+    def one(pool, scales, x):
+        xf = x.astype(jnp.float32)                     # [S, W, H, hd]
+        H = xf.shape[2]
+        am = jnp.where(real[..., None],
+                       jnp.max(jnp.abs(xf), axis=-1), 0.0)  # [S, W, H]
+        am_pg = jax.ops.segment_max(
+            am.reshape(S * W, H), segf,
+            num_segments=S * (P + 1)).reshape(S, P + 1, H)[:, :P]
+        cur = scales[layer, tables]                        # [S, P, H]
+        sc_pg = jnp.where(started[..., None],
+                          _precision.fp8_scale(am_pg), cur)
+        sc = jnp.take_along_axis(
+            sc_pg, jnp.minimum(chunk, P - 1)[..., None], axis=1)
+        sc = jnp.where(real[..., None], sc, 1.0)           # [S, W, H]
+        q = _precision.quantize_fp8(xf, sc[..., None])
+        return (pool.at[layer, page_idx, :, offset].set(q),
+                scales.at[layer, tables].set(sc_pg))
+
+    out["k"], out["k_scale"] = one(kv["k"], kv["k_scale"], k)
+    out["v"], out["v_scale"] = one(kv["v"], kv["v_scale"], v)
+    return out
+
+
+def spec_rewind(pos, n_acc):
+    """Post-verify position rollback: the new committed extent after a
+    verify dispatch accepted ``n_acc[s]`` tokens (accepted drafts + the
+    correction) per slot. Positions ``>= pos + n_acc`` hold the
+    REJECTED drafts' K/V — invisible to attention (flat position ``<=
+    query pos``) and overwritten in place by the next dispatch, so the
+    rollback is this one addition: no page is freed, no cell is
+    cleared, CoW/prefix/session sharing is untouched (verify only ever
+    writes pages the slot exclusively owns)."""
+    return pos + n_acc
+
+
 def gather_pages(pool, layer: int, tables) -> jnp.ndarray:
     """Each slot's pages in page-major layout ``[S, P, H, ps, hd]``:
     flat position ``p*page_size + o`` of slot ``s`` lives at
@@ -452,5 +535,6 @@ def pages_needed(total_positions: int, page_size: int) -> int:
 
 
 __all__ = ["PagePool", "commit_prefill", "append_token",
-           "append_suffix", "gather_pages", "copy_page",
-           "handoff_commit", "pages_needed"]
+           "append_suffix", "append_spec", "spec_rewind",
+           "gather_pages", "copy_page", "handoff_commit",
+           "pages_needed"]

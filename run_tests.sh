@@ -839,6 +839,116 @@ if [ $kvsmoke -ne 0 ]; then
     exit 1
 fi
 
+# Spec-decode smoke gate (docs/SERVING.md "Speculative decoding"):
+# the draft-verify burst under JAX_PLATFORMS=cpu must (a) produce
+# greedy outputs TOKEN-IDENTICAL to a spec-off engine across a
+# 16-request mixed workload INCLUDING prefix-cache hits and a sticky-
+# session resume (rejection sampling at T=0 is longest-prefix exact,
+# so speculation may never change a token), (b) advance the proposed/
+# accepted counters — the self-draft finds SOMETHING on 13-vocab
+# traffic, (c) pay zero serving-site compiles after startup, the
+# ("verify", K) program included, and (d) shut down clean: pools
+# drained, no leaked engine threads.
+env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu DL4J_TPU_TELEMETRY=1 \
+    python - <<'EOF'
+import sys
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.models.gpt import CausalLM
+from deeplearning4j_tpu.models.transformer import tiny_config
+from deeplearning4j_tpu.profiler import telemetry
+from deeplearning4j_tpu.serving import DecodeEngine
+
+cfg = tiny_config(vocab=13, max_len=64, d_model=32, n_layers=2,
+                  n_heads=4, d_ff=64)
+cfg.dropout = 0.0
+m = CausalLM(cfg, compute_dtype=jnp.float32)
+params = m.init_params(jax.random.key(1))
+rng = np.random.default_rng(11)
+shared = rng.integers(0, 13, (9,)).astype(np.int32)
+jobs = []                      # (prompt, new, session_id)
+for i in range(16):
+    if i in (3, 11):           # session open + RESUME of the same id
+        jobs.append((rng.integers(0, 13, (5,)).astype(np.int32),
+                     5, "conv"))
+    elif i % 4 == 0:           # prefix-cache traffic
+        jobs.append((np.concatenate(
+            [shared, rng.integers(0, 13, (3,)).astype(np.int32)]),
+            int(rng.integers(4, 8)), None))
+    else:
+        jobs.append((rng.integers(0, 13,
+                                  (int(rng.integers(3, 12)),)
+                                  ).astype(np.int32),
+                     int(rng.integers(3, 9)), None))
+
+reg = telemetry.MetricsRegistry.get_default()
+compiles = lambda s: reg.counter(telemetry.JIT_COMPILES).value(site=s)
+SITES = ("serving_decode", "serving_prefill", "serving_prefix_prefill",
+         "serving_verify", "serving_adopt", "serving_cow_copy")
+fail = []
+
+
+def serve(spec):
+    eng = DecodeEngine(m, params, slots=3, page_size=8,
+                       max_context=48, max_chunk=4,
+                       prefill_buckets=[8, 16], prefix_cache=True,
+                       session_capacity=2, spec_decode=spec).start()
+    base = {s: compiles(s) for s in SITES}
+    outs = [np.asarray(eng.submit(p, n, session_id=sid)
+                       .result(timeout=300)) for p, n, sid in jobs]
+    delta = {s: compiles(s) - base[s] for s in SITES
+             if compiles(s) != base[s]}
+    if delta:
+        fail.append(f"spec={spec}: post-startup compiles at serving "
+                    f"sites: {delta}")
+    if eng.stats()["warm_pool"]["misses"]:
+        fail.append(f"spec={spec}: warm-pool misses")
+    st = eng.stats()
+    eng.shutdown()
+    if eng.pool.allocated != 0:
+        fail.append(f"spec={spec}: {eng.pool.allocated} pages still "
+                    "allocated after shutdown")
+    return outs, st
+
+plain, _ = serve(None)
+spec, st = serve(4)
+for i, (a, b) in enumerate(zip(plain, spec)):
+    if not np.array_equal(a, b):
+        fail.append(f"spec engine diverged from plain engine on "
+                    f"request {i}: {b.tolist()} != {a.tolist()}")
+        break
+sp = st.get("spec") or {}
+if not sp.get("verify_dispatches"):
+    fail.append("no verify dispatches recorded on the spec engine")
+if not sp.get("proposed"):
+    fail.append(f"spec proposed counter did not advance: {sp}")
+if reg.counter(telemetry.SERVING_SPEC_PROPOSED).total() <= 0:
+    fail.append("SERVING_SPEC_PROPOSED telemetry counter "
+                "did not advance")
+leaked = [t.name for t in threading.enumerate()
+          if t.is_alive() and t.name.startswith("ServingEngine")]
+if leaked:
+    fail.append(f"ServingEngine thread(s) survived shutdown: {leaked}")
+if fail:
+    sys.stderr.write("spec-decode smoke FAILED:\n  "
+                     + "\n  ".join(fail) + "\n")
+    sys.exit(1)
+print(f"spec-decode smoke OK: 16 mixed requests token-identical to "
+      f"spec-off (sessions + prefix hits), {sp['verify_dispatches']} "
+      f"verify dispatches, acceptance {sp['acceptance']:.2f}, "
+      f"tokens/dispatch {sp['tokens_per_dispatch']:.2f}, 0 serving-"
+      "site compiles post-start, clean shutdown")
+EOF
+specsmoke=$?
+if [ $specsmoke -ne 0 ]; then
+    echo "FATAL: spec-decode smoke gate regressed" >&2
+    exit 1
+fi
+
 # Prefix-cache smoke gate (docs/SERVING.md "Prefix cache and
 # sessions"): cross-request KV reuse under JAX_PLATFORMS=cpu must
 # (a) produce warm-prefix greedy outputs TOKEN-IDENTICAL to both a

@@ -17,6 +17,13 @@ class TestClassification:
         ("mixed_speedup_vs_f32", 1.2, "higher"),
         ("int8_agreement", 0.99, "higher"),
         ("decode_ms_speedup", 1.3, "higher"),   # the regression case
+        ("serving_spec_acceptance", 0.7, "higher"),
+        ("serving_spec_decode_speedup", 1.4, "higher"),
+        ("serving_tokens_per_dispatch", 2.5, "higher"),
+        # a per-dispatch ratio named against a latency window must
+        # still gate higher-better (the kv "ms"-segment regression
+        # case, speculative-decode edition)
+        ("verify_ms_tokens_per_dispatch", 2.0, "higher"),
         # latency family: lower-better via the "ms" segment
         ("step_ms", 12.0, "lower"),
         ("gpt_decode_ms_per_step", 3.0, "lower"),
@@ -53,6 +60,14 @@ class TestCompareRounds:
         assert len(regressions) == 2
         joined = "\n".join(regressions)
         assert "decode_ms_speedup" in joined and "step_ms" in joined
+
+    def test_spec_metrics_drop_regresses(self):
+        _r, regressions = bench_compare.compare_rounds(
+            {"serving_tokens_per_dispatch": 2.5,
+             "serving_spec_acceptance": 0.8},
+            {"serving_tokens_per_dispatch": 1.0,
+             "serving_spec_acceptance": 0.4}, tolerance=0.1)
+        assert len(regressions) == 2
 
     def test_bool_flip_fails_regardless_of_tolerance(self):
         _r, regressions = bench_compare.compare_rounds(
