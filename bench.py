@@ -334,7 +334,7 @@ def _gpt_decode_metrics() -> dict:
     standalone bench keeps the full-size knobs."""
     from bench_gpt_decode import (
         build_model, decode_metrics, engine_ab, fleet_ab, kv_ab,
-        mixed_requests, prefix_ab, spec_ab,
+        mixed_requests, prefix_ab, scale_ab, spec_ab,
     )
 
     m, params = build_model(layers=8, d_model=512, heads=8, d_ff=2048,
@@ -411,6 +411,21 @@ def _gpt_decode_metrics() -> dict:
         "serving_disagg_p99_gain": fab["disagg_p99_gain"],
         "serving_disagg_gap_p99_ms": fab["disagg_on_gap_p99_ms"],
         "serving_fleet_token_agreement": fab["token_agreement"],
+    })
+    # runtime elasticity: open-loop load-step around an add_replica()
+    # event (bench_gpt_decode.scale_ab) — how long the TTFT tail
+    # stayed degraded after the fleet decided to grow, plus the
+    # post-scale p99 (both lower-better under bench_compare; token
+    # identity vs solo rides along as the gate)
+    xab = scale_ab(m, params, prompt=48, new=12, slots=4,
+                   page_size=16, max_chunk=16, n_before=12,
+                   n_during=36)
+    out.update({
+        "serving_scaleup_p99_recovery_s":
+            xab["scaleup_p99_recovery_s"],
+        "serving_scaleup_after_ttft_p99_ms":
+            xab["after_ttft_p99_ms"],
+        "serving_scaleup_token_agreement": xab["token_agreement"],
     })
     return out
 

@@ -20,7 +20,8 @@ Key classification:
   ``tokens_per_dispatch`` measured off a ms window) can never gate
   backwards;
 - other numeric keys default to HIGHER-better (throughput family);
-- ``*_ms`` latency keys are LOWER-better;
+- ``*_ms`` latency keys and ``*_recovery_s`` whole-second recovery
+  times are LOWER-better;
 - config echoes, band edges, source tags, error strings and the
   self-baseline ratio are skipped (``_SKIP_SUFFIXES`` /
   ``_SKIP_KEYS`` — they describe the round, they aren't performance);
@@ -60,9 +61,11 @@ def _is_higher_key(key: str) -> bool:
 
 
 #: lower-is-better keys carry an "ms" path segment (step time, TTFT,
-#: p99 gaps): `*_ms`, `*_ms_per_step`, ...
+#: p99 gaps): `*_ms`, `*_ms_per_step`, ... — plus whole-second
+#: recovery times (`*_recovery_s`), which have no ms segment and
+#: would otherwise ride the higher-better default backwards
 def _is_latency_key(key: str) -> bool:
-    return "ms" in key.split("_")
+    return "ms" in key.split("_") or key.endswith("_recovery_s")
 
 
 def load_round(path: str) -> Dict[str, Any]:

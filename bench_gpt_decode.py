@@ -41,8 +41,15 @@ warmup), device->host reads closing each window.
   dispatch (the weight-read amortization), TTFT tails, and f32 greedy
   token identity per k.
 
+- ``scale_ab``: open-loop LOAD-STEP traffic around a runtime
+  ``add_replica()`` event — TTFT p99 before/during/after the scale-up
+  and ``scaleup_p99_recovery_s``, how long the tail stayed degraded
+  after the fleet decided to grow (the elasticity loop's latency SLO
+  story).
+
 Run: python bench_gpt_decode.py [--engine-ab] [--prefix-ab]
-     [--kv-ab] [--fleet-ab] [--spec-ab] [--layers 12 ...]
+     [--kv-ab] [--fleet-ab] [--spec-ab] [--scale-ab]
+     [--layers 12 ...]
 """
 
 from __future__ import annotations
@@ -453,6 +460,131 @@ def fleet_ab(m, params, requests=48, short_prompt=32, long_prompt=192,
     }
 
 
+# ------------------------------------------------- scale-up load-step
+def scale_ab(m, params, n_prompts=6, prompt=64, new=16, slots=4,
+             page_size=16, max_chunk=16, n_before=24, n_during=72,
+             util_before=0.5, util_step=2.5, scale_frac=0.25):
+    """Open-loop LOAD-STEP workload around a runtime scale-up event.
+
+    One replica serves steady traffic at ~``util_before`` of its
+    measured capacity (phase BEFORE), then the arrival rate steps to
+    ~``util_step``x capacity — more than one replica can serve, so the
+    queue (and TTFT tail) grows without bound. ``scale_frac`` of the
+    way through the step, ``ServingFleet.add_replica()`` fires on a
+    side thread (exactly what the scheduler's `scale_serve` alert path
+    calls); arrivals never pause for it, because a real router's
+    clients don't. TTFT p99 is reported per phase — before the step,
+    during (submitted while the new replica was still being built),
+    after (submitted once it was live) — plus the headline
+    ``scaleup_p99_recovery_s``: how long after the scale-up trigger
+    the last over-tolerance first token was observed, i.e. how long
+    the tail stayed degraded once the fleet decided to grow. Arrival
+    intervals are calibrated from a closed-loop capacity probe (which
+    doubles as the compile warmup), so the same utilization story
+    holds on any backend. Token identity vs solo generate() rides
+    along over the whole run (the prompt pool is small enough to
+    pre-compute every solo answer)."""
+    import threading
+
+    from deeplearning4j_tpu.serving.fleet import ServingFleet
+
+    rng = np.random.default_rng(7)
+    pool = [rng.integers(0, m.cfg.vocab_size, (prompt,))
+            .astype(np.int32) for _ in range(n_prompts)]
+    solo = [np.asarray(m.generate(
+        params, jnp.asarray(p[None, :], jnp.int32), new))[0]
+        for p in pool]
+
+    need = prompt + new
+    fl = ServingFleet(
+        m, params, replicas=1, slots=slots, page_size=page_size,
+        max_chunk=max_chunk,
+        max_context=min(m.cfg.max_len,
+                        ((need + page_size - 1) // page_size)
+                        * page_size)).start()
+    try:
+        # capacity probe: 2*slots closed-loop requests at full
+        # occupancy -> seconds per completed request (also the warmup)
+        for h in [fl.submit(pool[i % n_prompts], new)
+                  for i in range(2 * slots)]:      # warm the compiles
+            h.result(timeout=600)
+        probe = [fl.submit(pool[i % n_prompts], new)
+                 for i in range(2 * slots)]
+        t0 = time.perf_counter()
+        for h in probe:
+            h.result(timeout=600)
+        svc = (time.perf_counter() - t0) / (2 * slots)
+        arrival_before = svc / util_before
+        arrival_step = svc / util_step
+
+        t_scale = [None, None]      # [trigger, replica live]
+
+        def grow():
+            t_scale[0] = time.perf_counter()
+            fl.add_replica()
+            t_scale[1] = time.perf_counter()
+
+        handles, submits, phases = [], [], []
+
+        def open_loop(n, arrival, phase, trigger_at=None):
+            grower = None
+            for i in range(n):
+                if trigger_at is not None and i == trigger_at:
+                    grower = threading.Thread(target=grow)
+                    grower.start()
+                handles.append(
+                    fl.submit(pool[len(handles) % n_prompts], new))
+                submits.append(time.perf_counter())
+                phases.append(phase)
+                time.sleep(arrival)
+            return grower
+
+        open_loop(n_before, arrival_before, "before")
+        grower = open_loop(n_during, arrival_step, "step",
+                           trigger_at=max(1, int(n_during
+                                                 * scale_frac)))
+        outs = [h.result(timeout=600) for h in handles]
+        if grower is not None:
+            grower.join(600)
+    finally:
+        fl.shutdown()
+    if t_scale[1] is None:
+        raise RuntimeError("scale_ab: add_replica never completed")
+
+    ttfts = [h.ttft_s for h in handles]
+    before = [t for t, ph in zip(ttfts, phases) if ph == "before"]
+    during = [t for t, sub, ph in zip(ttfts, submits, phases)
+              if ph == "step" and sub < t_scale[1]]
+    after = [t for t, sub, ph in zip(ttfts, submits, phases)
+             if ph == "step" and sub >= t_scale[1]]
+    agree = float(np.mean([
+        np.array_equal(o, solo[i % n_prompts])
+        for i, o in enumerate(outs)]))
+
+    # recovery: last first-token event past tolerance, measured from
+    # the scale-up TRIGGER (the alert verdict, not replica readiness
+    # — the operator question is "how long was the tail bad after we
+    # decided to grow")
+    tol = 1.5 * _p(before, 99)
+    bad = [sub + t for t, sub in zip(ttfts, submits)
+           if sub + t >= t_scale[0] and t > tol]
+    recovery = (max(bad) - t_scale[0]) if bad else 0.0
+
+    return {
+        "requests": len(handles),
+        "slots": slots,
+        "arrival_before_ms": round(arrival_before * 1e3, 3),
+        "arrival_step_ms": round(arrival_step * 1e3, 3),
+        "before_ttft_p50_ms": round(_p(before, 50) * 1e3, 3),
+        "before_ttft_p99_ms": round(_p(before, 99) * 1e3, 3),
+        "during_ttft_p99_ms": round(_p(during, 99) * 1e3, 3),
+        "after_ttft_p99_ms": round(_p(after, 99) * 1e3, 3),
+        "scaleup_engine_ready_s": round(t_scale[1] - t_scale[0], 3),
+        "scaleup_p99_recovery_s": round(recovery, 3),
+        "token_agreement": round(agree, 3),
+    }
+
+
 # --------------------------------------------- KV-path (attn kernel
 # + fp8 cache) A/B
 def _decode_exec_bytes(eng):
@@ -743,6 +875,12 @@ def main():
                          "disaggregated prefill on vs off (decode-"
                          "burst p99 + TTFT tails) on long-tailed "
                          "mixed traffic with a long-prompt minority")
+    ap.add_argument("--scale-ab", action="store_true",
+                    help="also run the runtime scale-up load-step: "
+                         "open-loop traffic steps past one replica's "
+                         "capacity, add_replica() fires mid-burst, "
+                         "TTFT p99 before/during/after plus "
+                         "scaleup_p99_recovery_s")
     ap.add_argument("--kv-ab", action="store_true",
                     help="also run the KV-path A/B: einsum attention "
                          "vs the Pallas paged-attention kernel, and "
@@ -799,6 +937,10 @@ def main():
         line["prefix_ab"] = prefix_ab(
             m, params, args.users, args.system_len, args.user_len,
             args.new, args.slots, args.page_size, args.max_chunk)
+    if args.scale_ab:
+        line["scale_ab"] = scale_ab(
+            m, params, prompt=min(args.prompt, 64),
+            page_size=args.page_size, max_chunk=args.max_chunk)
     if args.kv_ab:
         reqs = mixed_requests(args.vocab, args.requests, args.prompt,
                               args.new_lo, args.new_hi or args.new,

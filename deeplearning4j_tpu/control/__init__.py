@@ -17,7 +17,8 @@ docs/CONTROL_PLANE.md for the operator guide.
 from deeplearning4j_tpu.control.scheduler import (
     TERMINAL, DeviceFleet, DeviceLostError, Job, JobContext,
     JobScheduler, ServeJob, TrainJob, default_scheduler,
-    http_jobs_get, http_jobs_post, http_workers_get, http_workers_post,
+    http_fleet_get, http_fleet_post, http_jobs_get, http_jobs_post,
+    http_workers_get, http_workers_post,
     jobs_snapshot, set_default,
 )
 
@@ -45,6 +46,7 @@ def __getattr__(name):
 __all__ = ["JobScheduler", "TrainJob", "ServeJob", "Job", "JobContext",
            "DeviceFleet", "DeviceLostError", "TERMINAL",
            "set_default", "default_scheduler", "jobs_snapshot",
+           "http_fleet_get", "http_fleet_post",
            "http_jobs_get", "http_jobs_post",
            "http_workers_get", "http_workers_post",
            "WorkerSupervisor", "WorkerTask", "WorkerTaskContext",

@@ -441,6 +441,21 @@ class ServeJob(Job):
         self.fleet = None
         #: SLO scale-up in flight (one restart at a time per job)
         self._scaling = False
+        #: replicas ADDED by alert-driven elasticity, newest last:
+        #: ``(rid, device)`` pairs — what _maybe_scale_down removes
+        #: once the pressure alert has stayed quiet, returning the
+        #: chips that preempted training to get here
+        self._elastic: List[Any] = []
+        #: monotonic time of the last elastic transition — scale-down
+        #: holds off until the alert has been quiet this long AFTER
+        #: the grow (a fresh replica must get a chance to drain the
+        #: queue before its removal is even considered)
+        self._elastic_since = 0.0
+        #: replicas added by an operator's ``POST /v1/fleet/scale``
+        #: — same ``(rid, device)`` bookkeeping, but NOT subject to
+        #: automatic scale-down (an explicit target sticks until the
+        #: operator scales back)
+        self._manual: List[Any] = []
 
     def submit(self, *a, **kw):
         if self.fleet is None:
@@ -474,9 +489,16 @@ class JobScheduler:
         ``attach_slo`` later). With one attached, serve capacity flows
         BOTH ways with hysteresis instead of one-shot polls: a firing
         ``action="scale_serve"`` alert (sustained queue pressure)
-        restarts a drained/dead replica for the matching ServeJob, and
-        ``_maybe_rebalance`` refuses to drain a replica from a fleet
-        whose pressure alert is pending or firing.
+        restarts a drained/dead replica for the matching ServeJob — or,
+        when none exists, GROWS the fleet with a brand-new replica on a
+        chip freed by checkpoint-preempting the lowest-priority train
+        job (``_scale_up_serve``); once the alert has stayed quiet for
+        ``scale_down_hold_s`` the elastic replica drains back out and
+        the parked job resumes bit-identically (``_maybe_scale_down``).
+    scale_down_hold_s : how long the queue-pressure alert must stay
+        resolved/inactive before an elastic replica is removed — the
+        shrink-side hysteresis on top of the alert's own flap
+        suppression.
     poll_s : supervision loop cadence.
     """
 
@@ -484,6 +506,7 @@ class JobScheduler:
                  rebalance: bool = True,
                  rebalance_after_s: float = 5.0,
                  rebalance_pressure: float = 0.05,
+                 scale_down_hold_s: float = 10.0,
                  slo=None,
                  supervisor=None,
                  poll_s: float = 0.05,
@@ -493,6 +516,7 @@ class JobScheduler:
         self.rebalance = bool(rebalance)
         self.rebalance_after_s = float(rebalance_after_s)
         self.rebalance_pressure = float(rebalance_pressure)
+        self.scale_down_hold_s = float(scale_down_hold_s)
         self.poll_s = float(poll_s)
         self.flight_dir = flight_dir
         self._slo = None
@@ -914,6 +938,7 @@ class JobScheduler:
                 self._poll_jobs()
                 self._publish_gauges()
                 self._reconcile_slo()
+                self._maybe_scale_down()
                 self._wake.wait(self.poll_s)
         except Exception:
             log.exception("control: scheduler loop died")
@@ -1179,16 +1204,20 @@ class JobScheduler:
 
     def _scale_up_serve(self, job: ServeJob, rule: str,
                         value) -> bool:
-        """Give a pressured fleet a replica back: restart the first
-        drained/dead replica whose chip is healthy, re-acquiring the
-        chip from the pool when a rebalance handed it back. Runs on a
-        dedicated runner thread; ``job._scaling`` keeps concurrent
-        firing ticks from double-restarting."""
+        """Give a pressured fleet capacity: restart the first drained/
+        dead replica whose chip is healthy (re-acquiring the chip from
+        the pool when a rebalance handed it back) — and when every
+        registered replica is already serving, GROW the fleet with a
+        brand-new replica on a freshly acquired chip, checkpoint-
+        preempting the lowest-priority train job if the pool is empty
+        (``_grow_serve``). Runs on a dedicated runner thread;
+        ``job._scaling`` keeps concurrent firing ticks from
+        double-restarting."""
         try:
             fleet = job.fleet
             if fleet is None or job.state != "running":
                 return False
-            for r in fleet._replicas:
+            for r in list(fleet._replicas):
                 if r.alive or r.needs_cleanup:
                     continue
                 dev = r.engine._device
@@ -1226,7 +1255,220 @@ class JobScheduler:
                             "sustained queue-pressure alert "
                             "(value=%s)", r.index, job.job_id, value)
                 return True
+            return self._grow_serve(job, rule, value)
+        finally:
+            job._scaling = False
+            self._wake.set()
+
+    def _grow_serve(self, job: ServeJob, rule: str, value) -> bool:
+        """Elastic scale-up: acquire a chip (checkpoint-preempting the
+        lowest-priority strictly-lower train job when the pool is
+        empty) and ``fleet.add_replica`` onto it. Every failure mode —
+        no victim, the chip not freeing in time, the engine build or
+        start crashing — rolls back cleanly: the chip returns to the
+        pool and the parked victim is refunded automatically by
+        ``_maybe_unpark`` on the next pass."""
+        fleet = job.fleet
+        parked = None
+        devs = self.devices.acquire(1, job.job_id)
+        if devs is None:
+            parked = self._preempt_for_scale(job)
+            if parked is None:
+                _flight.record("job_scale_up_failed", job=job.job_id,
+                               why="no_chip_no_victim", rule=rule)
+                return False
+            # the victim checkpoints and exits on its own runner
+            # thread; its chips land back in the pool when the park
+            # completes — bounded wait, then give up (the reconcile
+            # pass retries while the alert stays firing)
+            deadline = time.monotonic() + 30.0
+            while devs is None and time.monotonic() < deadline \
+                    and not self._stop.is_set():
+                devs = self.devices.acquire(1, job.job_id)
+                if devs is None:
+                    time.sleep(0.05)
+            if devs is None:
+                _flight.record("job_scale_up_failed", job=job.job_id,
+                               why="chip_not_freed", rule=rule,
+                               victim=parked.job_id)
+                return False
+        dev = devs[0]
+        with self._lock:
+            if job.state != "running" or job.fleet is not fleet:
+                self.devices.release([dev])
+                return False
+            job.devices.append(dev)
+        try:
+            rid = fleet.add_replica(device=dev)
+        except Exception:
+            log.exception("control: elastic scale-up of %s failed — "
+                          "rolling back chip %s", job.job_id, dev)
+            with self._lock:
+                if dev in job.devices:
+                    job.devices.remove(dev)
+            self.devices.release([dev])
+            _flight.record("job_scale_up_failed", job=job.job_id,
+                           why="add_replica_failed", rule=rule)
             return False
+        with self._lock:
+            job._elastic.append((rid, dev))
+            job._elastic_since = time.monotonic()
+        if _telemetry.enabled():
+            _telemetry.MetricsRegistry.get_default().counter(
+                _telemetry.FLEET_SCALE_UP,
+                "elastic serve scale-ups: replicas added on a "
+                "sustained queue-pressure alert").inc(
+                fleet=fleet.fleet_id)
+        _flight.record("job_scale_up", job=job.job_id, replica=rid,
+                       rule=rule, value=value, elastic=True,
+                       victim=parked.job_id if parked is not None
+                       else None)
+        log.warning("control: grew fleet %s of %s to %d replicas on "
+                    "sustained queue-pressure alert (value=%s%s)",
+                    fleet.fleet_id, job.job_id,
+                    len(fleet._replicas), value,
+                    f", preempted {parked.job_id}"
+                    if parked is not None else "")
+        return True
+
+    def _preempt_for_scale(self, job: ServeJob) -> Optional[Job]:
+        """Checkpoint-preempt the lowest-priority running train job of
+        STRICTLY lower priority than the pressured serve job (smallest
+        gang breaks ties) so its chips can host a new replica. Same
+        park contract as ``_maybe_preempt_for``: the victim bundles
+        its state and resumes bit-identically from ``_maybe_unpark``
+        when the elastic replica is later removed (or the scale-up
+        rolls back)."""
+        with self._lock:
+            victims = sorted(
+                (j for j in self._jobs.values()
+                 if isinstance(j, TrainJob) and j.state == "running"
+                 and j.priority < job.priority
+                 and not (j._park_on_exit or j._cancel_on_exit
+                          or j._drain_on_exit or j._migrate_on_exit)),
+                key=lambda j: (j.priority, len(j.devices)))
+            if not victims:
+                return None
+            victim = victims[0]
+            victim._park_on_exit = True
+            victim._exit_reason = "priority_preempt"
+        _count_preemption("scale_serve", victim.job_id)
+        _flight.record("job_preempt", victim=victim.job_id,
+                       victim_priority=victim.priority,
+                       for_job=job.job_id, priority=job.priority,
+                       chips=len(victim.devices),
+                       reason="scale_serve")
+        log.warning(
+            "control: checkpoint-preempting job %s (priority %d, %d "
+            "chips) to grow pressured serve job %s (priority %d)",
+            victim.job_id, victim.priority, len(victim.devices),
+            job.job_id, job.priority)
+        victim.fault_tolerance.request_preemption(kind="scale_serve")
+        return victim
+
+    def _maybe_scale_down(self) -> None:
+        """Shrink-side hysteresis: an elastic replica leaves only once
+        its fleet's queue-pressure alert has been continuously quiet
+        (``SLOEngine.resolved_for``) for ``scale_down_hold_s`` — AND
+        at least that long has passed since the last elastic
+        transition, so the fresh replica gets a chance to drain the
+        very pressure that summoned it. Without an SLO engine the
+        direct pressure poll (same threshold the rebalancer uses)
+        gates the shrink. The drain runs on its own runner thread;
+        the freed chip flows back through the capacity listener and
+        ``_maybe_unpark`` resumes the parked train job."""
+        with self._lock:
+            serving = [j for j in self._jobs.values()
+                       if isinstance(j, ServeJob) and j._elastic
+                       and j.fleet is not None
+                       and j.state == "running" and not j._scaling]
+        for job in serving:
+            fl = job.fleet
+            if time.monotonic() - job._elastic_since \
+                    < self.scale_down_hold_s:
+                continue
+            if self._slo is not None:
+                quiet = self._slo.resolved_for(
+                    "serving_queue_pressure", fleet=fl.fleet_id)
+                if quiet is None or quiet < self.scale_down_hold_s:
+                    continue
+            elif fl.queue_pressure() > self.rebalance_pressure:
+                continue
+            with self._lock:
+                if not job._elastic or job._scaling \
+                        or job.state != "running":
+                    continue
+                rid, dev = job._elastic[-1]
+                job._scaling = True
+            threading.Thread(
+                target=self._scale_down_serve, args=(job, rid, dev),
+                daemon=True,
+                name=f"JobRunner-scaledown-{job.job_id}").start()
+
+    def _remove_serve_replica(self, job: ServeJob, rid: int, dev,
+                              why: str) -> bool:
+        """Remove one replica from ``job``'s fleet and settle the
+        chip: drain (sessions hand off to survivors), retire the id
+        and its engine-labelled gauges, release the device back to
+        the pool, bump the scale-down counter. Raises ValueError when
+        the replica is the last one live (never shrink to zero).
+        Returns True when the drain was clean."""
+        fleet = job.fleet
+        clean = True
+        try:
+            clean = fleet.remove_replica(rid)
+        except IndexError:
+            pass          # replica already died and left the fleet
+        # the drain path released the chip through the capacity
+        # listener already; the dead-replica path did not — either
+        # way release() is idempotent, so settle it here
+        release = False
+        with self._lock:
+            for lst in (job._elastic, job._manual):
+                try:
+                    lst.remove((rid, dev))
+                except ValueError:
+                    pass
+            job._elastic_since = time.monotonic()
+            if dev is not None and dev in job.devices:
+                job.devices.remove(dev)
+                release = True
+        if release:
+            self.devices.release([dev])
+        if _telemetry.enabled():
+            _telemetry.MetricsRegistry.get_default().counter(
+                _telemetry.FLEET_SCALE_DOWN,
+                "elastic serve scale-downs: replicas removed after "
+                "the pressure alert stayed resolved (or on an "
+                "operator's scale request)").inc(fleet=fleet.fleet_id)
+        _flight.record("job_scale_down", job=job.job_id, replica=rid,
+                       clean=clean, why=why)
+        log.warning("control: shrank fleet %s of %s to %d replicas "
+                    "(%s)", fleet.fleet_id, job.job_id,
+                    len(fleet._replicas), why)
+        return clean
+
+    def _scale_down_serve(self, job: ServeJob, rid: int, dev) -> None:
+        """Hysteresis-gated elastic shrink, on its own runner thread
+        (the drain blocks on in-flight requests)."""
+        try:
+            try:
+                self._remove_serve_replica(job, rid, dev,
+                                           why="pressure alert quiet")
+            except ValueError:
+                # last live replica — never shrink to zero; drop the
+                # elastic record so we stop retrying, keep the chip
+                log.warning("control: skipping scale-down of %s — "
+                            "replica %d is the last one live",
+                            job.job_id, rid)
+                with self._lock:
+                    try:
+                        job._elastic.remove((rid, dev))
+                    except ValueError:
+                        pass
+        except Exception:
+            log.exception("control: scale-down of %s failed",
+                          job.job_id)
         finally:
             job._scaling = False
             self._wake.set()
@@ -1767,6 +2009,127 @@ def http_jobs_post(path: str, payload: Dict[str, Any]):
         return ({"error": str(e)}, 400)
 
 
+def _serve_jobs(s: "JobScheduler") -> List[ServeJob]:
+    with s._lock:
+        return [j for j in s._jobs.values()
+                if isinstance(j, ServeJob) and j.fleet is not None
+                and j.state == "running"]
+
+
+def _fleet_row(job: ServeJob) -> Dict[str, Any]:
+    fl = job.fleet
+    return {"job": job.job_id,
+            "fleet": fl.fleet_id,
+            "state": job.state,
+            "replicas": fl.alive_replicas(),
+            "registered": len(fl._replicas),
+            "pending_scale": fl._pending_scale,
+            "elastic": len(job._elastic),
+            "manual": len(job._manual),
+            "queue_pressure": fl.queue_pressure()}
+
+
+def http_fleet_get(path: str):
+    """Shared /v1/fleet GET handling for ui/server.py and
+    remote/server.py: every running serve job's fleet — live/
+    registered replica counts, pending scale ops, elastic bookkeeping
+    and the queue-pressure signal. Returns (obj, http_code)."""
+    s = default_scheduler()
+    if s is None:
+        return ({"error": "no JobScheduler in this process"}, 404)
+    parts = [p for p in path.split("/") if p]   # v1 fleet [<id>]
+    rows = [_fleet_row(j) for j in _serve_jobs(s)]
+    if len(parts) == 3:
+        sel = parts[2]
+        for row in rows:
+            if sel in (row["job"], row["fleet"]):
+                return (row, 200)
+        return ({"error": f"unknown fleet/job {sel!r}"}, 404)
+    return ({"fleets": rows}, 200)
+
+
+def http_fleet_post(path: str, payload: Dict[str, Any]):
+    """Shared ``POST /v1/fleet/scale`` handling: drive a serve job's
+    fleet to a target replica count.
+
+    Payload: ``{"target": <int>, "job": <job_id> | "fleet":
+    <fleet_id>}`` (the selector is optional when exactly one serve
+    job is running). Growth acquires chips through the scheduler —
+    checkpoint-preempting lower-priority training when the pool is
+    empty — and the added replicas are pinned (an explicit target is
+    not undone by the autoscaler's quiet-alert shrink). Shrink
+    removes replicas newest-first: autoscaled first, then pinned,
+    then original ones. Errors follow the /v1/jobs conventions:
+    unknown job/fleet is 404, invalid targets and scale races are
+    400. Returns (obj, code)."""
+    s = default_scheduler()
+    if s is None:
+        return ({"error": "no JobScheduler in this process"}, 404)
+    parts = [p for p in path.split("/") if p]   # v1 fleet scale
+    if len(parts) != 3 or parts[2] != "scale":
+        return ({"error": "not found"}, 404)
+    try:
+        target = payload.get("target")
+        if target is None:
+            return ({"error": "scale needs {'target': <replica "
+                              "count>}"}, 400)
+        target = int(target)
+        if target < 1:
+            return ({"error": f"target must be >= 1 (got {target})"},
+                    400)
+        sel = payload.get("job") or payload.get("fleet")
+        jobs = _serve_jobs(s)
+        if sel is not None:
+            jobs = [j for j in jobs
+                    if sel in (j.job_id, j.fleet.fleet_id)]
+            if not jobs:
+                return ({"error": f"unknown fleet/job {sel!r}"}, 404)
+        if not jobs:
+            return ({"error": "no running serve job"}, 404)
+        if len(jobs) > 1:
+            return ({"error": "multiple serve jobs running — pass "
+                              "{'job': <id>} or {'fleet': <id>}"},
+                    400)
+        job = jobs[0]
+        fleet = job.fleet
+        with s._lock:
+            if job._scaling:
+                return ({"error": f"job {job.job_id} already has a "
+                                  "scale operation in flight"}, 400)
+            job._scaling = True
+        try:
+            while fleet.alive_replicas() < target:
+                if not s._grow_serve(job, "manual_scale", target):
+                    return ({"error": "scale-up failed: no chip "
+                                      "available (and no lower-"
+                                      "priority train job to "
+                                      "preempt)",
+                             **_fleet_row(job)}, 400)
+                with s._lock:
+                    # re-label the fresh replica as operator-pinned:
+                    # explicit targets are not subject to the
+                    # autoscaler's quiet-alert shrink
+                    if job._elastic:
+                        job._manual.append(job._elastic.pop())
+            while fleet.alive_replicas() > target:
+                with s._lock:
+                    pool = job._elastic or job._manual
+                    if pool:
+                        rid, dev = pool[-1]
+                    else:
+                        live = [r for r in list(fleet._replicas)
+                                if r.alive and not r.draining]
+                        rid, dev = live[-1].rid, None
+                s._remove_serve_replica(job, rid, dev,
+                                        why="operator scale request")
+            return (_fleet_row(job), 200)
+        finally:
+            job._scaling = False
+            s._wake.set()
+    except Exception as e:
+        return ({"error": str(e)}, 400)
+
+
 def _default_supervisor():
     from deeplearning4j_tpu.control.worker import default_supervisor
 
@@ -1851,4 +2214,5 @@ __all__ = ["JobScheduler", "TrainJob", "ServeJob", "Job", "JobContext",
            "DeviceFleet", "DeviceLostError", "TERMINAL",
            "set_default", "default_scheduler", "jobs_snapshot",
            "http_jobs_get", "http_jobs_post",
-           "http_workers_get", "http_workers_post"]
+           "http_workers_get", "http_workers_post",
+           "http_fleet_get", "http_fleet_post"]

@@ -352,6 +352,142 @@ def preempt_worker(worker, deadline_s: float = 30.0,
         target.preempt(worker, deadline_s=deadline_s)
 
 
+class FaultyObjectStore:
+    """Chaos wrapper for object-store clients
+    (``util.resilience.InMemoryObjectStore`` and friends): injects the
+    failure shapes real S3/GCS traffic sees — per-op transient errors
+    and TORN UPLOADS (the connection dies mid-PUT, leaving a truncated
+    blob under the key) — so the rename-less commit protocol's claims
+    (write retry, partial-upload invisibility, digest-based fallback)
+    are proven, not assumed.
+
+    - ``error_rate``: probability each op in ``ops`` raises OSError
+      before touching the inner store. A rate ``>= 1.0`` switches to
+      the deterministic drill mode: the FIRST attempt of every
+      distinct ``(op, key)`` fails and the retry succeeds — every
+      bundle op retries at least once, nothing ever wedges (what the
+      CI drill sets via ``DL4J_TPU_CHAOS_STORE_ERROR_RATE=1``).
+    - ``torn_rate``: probability (same ``>= 1.0`` drill semantics) a
+      PUT writes only the first half of the payload to the inner
+      store and then raises — the torn blob EXISTS remotely; only
+      digest validation can tell. A retried put overwrites it whole
+      (last-write-wins, the S3 model).
+    - ``ops``: which of put/get/list/delete inject errors (default
+      all).
+
+    Env activation (``from_env``): ``DL4J_TPU_CHAOS_STORE_ERROR_RATE``,
+    ``DL4J_TPU_CHAOS_STORE_TORN_RATE``, ``DL4J_TPU_CHAOS_STORE_OPS``
+    (comma-separated), ``DL4J_TPU_CHAOS_STORE_SEED``. Standalone knobs
+    — no ``DL4J_TPU_CHAOS`` master switch needed: a store-chaos drill
+    should not also enable NaN/preemption injection. Every injection
+    lands in ``dl4j_tpu_chaos_injected_total{kind=store_*}`` plus a
+    flight-recorder event."""
+
+    _OPS = ("put", "get", "list", "delete")
+
+    def __init__(self, inner, *, error_rate: float = 0.0,
+                 torn_rate: float = 0.0, ops=None,
+                 seed: Optional[int] = None):
+        self.inner = inner
+        self.error_rate = float(error_rate)
+        self.torn_rate = float(torn_rate)
+        self.ops = tuple(ops) if ops else self._OPS
+        self._rng = np.random.default_rng(
+            20260807 if seed is None else seed)
+        self._fault_lock = threading.Lock()
+        #: (kind, op, key) triples already failed once — the
+        #: deterministic rate>=1.0 drill mode's memory
+        self._failed_once = set()
+        self.injected = 0
+
+    @staticmethod
+    def from_env(inner, environ=None):
+        """Wrap ``inner`` per the ``DL4J_TPU_CHAOS_STORE_*`` env knobs
+        — or return it untouched when none are set."""
+        env = os.environ if environ is None else environ
+        err = float(
+            env.get("DL4J_TPU_CHAOS_STORE_ERROR_RATE", "0") or 0)
+        torn = float(
+            env.get("DL4J_TPU_CHAOS_STORE_TORN_RATE", "0") or 0)
+        if err <= 0.0 and torn <= 0.0:
+            return inner
+        ops = tuple(
+            v.strip()
+            for v in env.get("DL4J_TPU_CHAOS_STORE_OPS", "").split(",")
+            if v.strip()) or None
+        seed = env.get("DL4J_TPU_CHAOS_STORE_SEED")
+        store = FaultyObjectStore(
+            inner, error_rate=err, torn_rate=torn, ops=ops,
+            seed=int(seed) if seed else None)
+        log.warning("CHAOS: object-store fault injection active "
+                    "(error_rate=%s, torn_rate=%s, ops=%s)",
+                    err, torn, store.ops)
+        return store
+
+    # ------------------------------------------------------- injection
+    def _roll(self, kind: str, op: str, key, rate: float) -> bool:
+        if rate <= 0.0 or op not in self.ops:
+            return False
+        if rate >= 1.0:
+            with self._fault_lock:
+                mark = (kind, op, str(key))
+                if mark in self._failed_once:
+                    return False
+                self._failed_once.add(mark)
+                return True
+        with self._fault_lock:       # default_rng is not thread-safe
+            return float(self._rng.random()) < rate
+
+    def _inject(self, kind: str, op: str, key) -> None:
+        self.injected += 1
+        if _telemetry.enabled():
+            _telemetry.MetricsRegistry.get_default().counter(
+                _telemetry.CHAOS_INJECTED,
+                "faults injected by the chaos harness").inc(kind=kind)
+        _flight_record("chaos_injected", fault=kind, op=op,
+                       key=str(key)[-160:])
+        log.warning("CHAOS: injected %s on object-store %s %s",
+                    kind, op, key)
+
+    # ------------------------------------------------------ client api
+    def put(self, key, data) -> None:
+        if self._roll("store_torn", "put", key, self.torn_rate):
+            self._inject("store_torn", "put", key)
+            # the half-written blob LANDS — invisible only because no
+            # commit/digest ever blesses it
+            self.inner.put(key, bytes(data[:max(1, len(data) // 2)]))
+            raise OSError(f"chaos: torn upload of {key}")
+        if self._roll("store_error", "put", key, self.error_rate):
+            self._inject("store_error", "put", key)
+            raise OSError(f"chaos: injected put failure for {key}")
+        return self.inner.put(key, data)
+
+    def get(self, key):
+        if self._roll("store_error", "get", key, self.error_rate):
+            self._inject("store_error", "get", key)
+            raise OSError(f"chaos: injected get failure for {key}")
+        return self.inner.get(key)
+
+    def list(self, prefix):
+        if self._roll("store_error", "list", prefix, self.error_rate):
+            self._inject("store_error", "list", prefix)
+            raise OSError(
+                f"chaos: injected list failure for {prefix}")
+        return self.inner.list(prefix)
+
+    def delete(self, key) -> None:
+        if self._roll("store_error", "delete", key, self.error_rate):
+            self._inject("store_error", "delete", key)
+            raise OSError(
+                f"chaos: injected delete failure for {key}")
+        return self.inner.delete(key)
+
+    def describe(self) -> str:
+        inner = getattr(self.inner, "describe", None)
+        return (f"faulty({inner() if callable(inner) else self.inner},"
+                f" err={self.error_rate}, torn={self.torn_rate})")
+
+
 def hang_replica(engine, seconds: float = 2.0) -> None:
     """Stall a decode engine's scheduler for ``seconds`` at its next
     loop pass — a decode burst that stops making progress without the
@@ -371,5 +507,5 @@ def hang_replica(engine, seconds: float = 2.0) -> None:
 
 
 __all__ = ["ChaosConfig", "ChaosMonkey", "ChaosTransferError",
-           "WorkerKilledError", "hang_replica", "preempt_worker",
-           "active", "install", "installed"]
+           "FaultyObjectStore", "WorkerKilledError", "hang_replica",
+           "preempt_worker", "active", "install", "installed"]

@@ -918,6 +918,29 @@ class SLOEngine:
                     return a.state
         return "inactive"
 
+    def resolved_for(self, rule: str, now: Optional[float] = None,
+                     **labels) -> Optional[float]:
+        """Seconds the alert for ``rule`` (labels subset-matched, like
+        ``alert_state``) has been CONTINUOUSLY quiet — the scale-down
+        hysteresis read: None while pending/firing (not quiet at all),
+        the age of the resolve while resolved, and ``inf`` when the
+        alert never fired (or was pruned after RESOLVED_RETENTION,
+        which only happens well past any sane hold window). A caller
+        shrinks capacity only once this exceeds its hold time, so one
+        noisy resolve/refire flap never thrashes the fleet."""
+        if now is None:
+            now = time.monotonic()
+        with self._lock:
+            for (rname, _key), a in self._alerts.items():
+                if rname != rule or not _match(a.labels, labels):
+                    continue
+                if a.state in ("pending", "firing"):
+                    return None
+                if a.state == "resolved" \
+                        and a.resolved_mono is not None:
+                    return max(0.0, now - a.resolved_mono)
+        return float("inf")
+
     def alerts_json(self) -> Dict[str, Any]:
         with self._lock:
             alerts = [a.to_dict() for a in self._alerts.values()]

@@ -137,6 +137,8 @@ SERVING_LANE_PREFILLS = "dl4j_tpu_serving_prefill_lane_prefills_total"
 SERVING_LANE_SECONDS = "dl4j_tpu_serving_prefill_lane_seconds"
 SERVING_HANDOFF_SECONDS = "dl4j_tpu_serving_handoff_seconds"
 SERVING_FLEET_PRESSURE = "dl4j_tpu_serving_fleet_queue_pressure"
+SERVING_FLEET_SIZE = "dl4j_tpu_serving_fleet_size"
+SERVING_FLEET_PENDING_SCALE = "dl4j_tpu_serving_fleet_pending_scale"
 #: queued dynamic-batching inference (parallel/wrapper.py)
 INFERENCE_REQUEST_LATENCY = "dl4j_tpu_inference_request_latency_seconds"
 INFERENCE_QUEUE_DEPTH = "dl4j_tpu_inference_queue_depth"
@@ -157,6 +159,9 @@ JOBS_MFU = "dl4j_tpu_job_mfu"
 JOBS_LATENCY_P50 = "dl4j_tpu_job_request_p50_ms"
 #: control plane phase 2 (control/worker.py, preemption notices)
 JOBS_PREEMPTIONS = "dl4j_tpu_jobs_preemptions_total"
+#: control plane phase 3 (alert-driven fleet elasticity)
+FLEET_SCALE_UP = "dl4j_tpu_fleet_scale_up_total"
+FLEET_SCALE_DOWN = "dl4j_tpu_fleet_scale_down_total"
 WORKER_PROCESSES = "dl4j_tpu_worker_processes"
 WORKER_HEARTBEAT_AGE = "dl4j_tpu_worker_heartbeat_age_seconds"
 FT_BUNDLE_IO_RETRIES = "dl4j_tpu_ft_bundle_io_retries_total"

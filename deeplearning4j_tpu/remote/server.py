@@ -37,6 +37,10 @@ Endpoints (stdlib http.server, daemon thread):
     POST /v1/jobs              -> submit via a registered job factory
     POST /v1/jobs/<id>/cancel  -> cancel (train: checkpoint + exit;
          /v1/jobs/<id>/drain      serve: cancel in-flight + shutdown)
+    GET  /v1/fleet[/<id>]      -> serve fleets: live replicas, pending
+                                  scale ops, queue pressure
+    POST /v1/fleet/scale       -> drive a fleet to a target replica
+                                  count (elastic grow/shrink)
     GET  /v1/workers[/<w>]     -> fleet failure domains + supervised
                                   worker processes
     POST /v1/workers/<w>/preempt  -> maintenance notice
@@ -310,6 +314,11 @@ class _InferenceHandler(BaseHTTPRequestHandler):
 
             obj, code = control.http_workers_get(path)
             return self._json(obj, code)
+        if path == "/v1/fleet" or path.startswith("/v1/fleet/"):
+            from deeplearning4j_tpu import control
+
+            obj, code = control.http_fleet_get(path)
+            return self._json(obj, code)
         if path == "/v1/alerts":
             from deeplearning4j_tpu.profiler import slo
 
@@ -328,7 +337,8 @@ class _InferenceHandler(BaseHTTPRequestHandler):
         ms: JsonModelServer = self.server.model_server  # type: ignore
         path = self.path.rstrip("/")
         if path == "/v1/jobs" or path.startswith("/v1/jobs/") \
-                or path.startswith("/v1/workers/"):
+                or path.startswith("/v1/workers/") \
+                or path.startswith("/v1/fleet/"):
             from deeplearning4j_tpu import control
 
             try:
@@ -338,6 +348,8 @@ class _InferenceHandler(BaseHTTPRequestHandler):
                 return self._json({"error": str(e)}, 400)
             if path.startswith("/v1/workers/"):
                 obj, code = control.http_workers_post(path, payload)
+            elif path.startswith("/v1/fleet/"):
+                obj, code = control.http_fleet_post(path, payload)
             else:
                 obj, code = control.http_jobs_post(path, payload)
             return self._json(obj, code)

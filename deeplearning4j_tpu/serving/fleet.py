@@ -40,9 +40,13 @@ repo applied to kernels applies one level up:
   received (greedy and seeded sampling replay exactly; unseeded
   sampling may change distribution at the failover point — documented,
   not hidden). Sessions pinned on the dead replica are gone; their
-  next turn re-admits cold elsewhere. ``drain_replica`` /
-  ``restart_replica`` give elastic resize; ``kill_replica`` is the
-  chaos hook the CI drill uses.
+  next turn re-admits cold elsewhere. ``add_replica`` /
+  ``remove_replica`` grow and shrink the fleet at runtime (the
+  scheduler's alert-driven elasticity path), ``drain_replica`` /
+  ``restart_replica`` give in-place resize, and ``kill_replica`` is
+  the chaos hook the CI drill uses. Replica identity is a stable id
+  (``_Replica.rid``), never a list position, so affinity and
+  drain/kill targets survive the list shrinking underneath them.
 
 Everything is observable: ``SERVING_*`` metrics are labelled
 ``engine=<id>`` per replica, the fleet adds routed/reroute counters and
@@ -396,15 +400,28 @@ class _PrefillLane:
 
 # ------------------------------------------------------------- replicas
 class _Replica:
-    __slots__ = ("index", "engine", "alive", "draining",
+    """One fleet member. Identity is the stable ``rid`` — allocated
+    once, never reused — NOT the replica's position in the fleet's
+    list: after a ``remove_replica`` shrinks the list, router
+    affinity, ``restart_replica``, kill/drain targets and the
+    capacity-listener callback all still name the engine they meant.
+    ``index`` is a read-only alias for ``rid`` kept for callers (the
+    scheduler's poll/rebalance paths, tests) that predate elastic
+    resize."""
+
+    __slots__ = ("rid", "engine", "alive", "draining",
                  "needs_cleanup")
 
-    def __init__(self, index: int, engine: DecodeEngine):
-        self.index = index
+    def __init__(self, rid: int, engine: DecodeEngine):
+        self.rid = rid
         self.engine = engine
         self.alive = True
         self.draining = False
         self.needs_cleanup = False
+
+    @property
+    def index(self) -> int:
+        return self.rid
 
 
 # ---------------------------------------------------------------- fleet
@@ -459,7 +476,12 @@ class ServingFleet:
         #: engineering kwargs from a live engine silently drops any
         #: newly-added knob)
         self._engine_kwargs = dict(engine_kwargs)
+        #: replica-id allocator — ids are stable for the fleet's
+        #: lifetime and never reused, so a removed replica's id can
+        #: never silently re-target a later engine
+        self._rids = itertools.count()
         self._replicas: List[_Replica] = []
+        self._by_rid: Dict[int, _Replica] = {}
         first: Optional[DecodeEngine] = None
         for i in range(replicas):
             dev = devices[i] if devices is not None else None
@@ -469,7 +491,9 @@ class ServingFleet:
                 warm_source=first, **engine_kwargs)
             if first is None:
                 first = eng
-            self._replicas.append(_Replica(i, eng))
+            r = _Replica(next(self._rids), eng)
+            self._replicas.append(r)
+            self._by_rid[r.rid] = r
         self._lane: Optional[_PrefillLane] = None
         if prefill_threshold is not None:
             self._lane = _PrefillLane(
@@ -500,6 +524,11 @@ class ServingFleet:
         self.n_requests = 0
         self.n_completed = 0
         self.n_reroutes = 0
+        #: elastic-resize operations currently in flight (+1 per
+        #: add_replica, -1 per remove_replica) — published as the
+        #: pending-scale gauge so dashboards can tell "small fleet"
+        #: from "fleet mid-resize"
+        self._pending_scale = 0
         self._routed: Dict[str, int] = {}
         self._stats_lock = threading.Lock()
         self._last_pressure_t = 0.0     # gauge-publish throttle
@@ -542,7 +571,7 @@ class ServingFleet:
                 break
             if isinstance(item, FleetRequest):
                 item._fail(RuntimeError("fleet has been shut down"))
-        for r in self._replicas:
+        for r in list(self._replicas):
             r.engine.shutdown(timeout)
         self._gauge_replicas()
         # the pressure gauge is only meaningful for a LIVE fleet —
@@ -577,7 +606,7 @@ class ServingFleet:
             self._queue.put_nowait(freq)
         except _queue.Full:
             hints = [r.engine.retry_after_hint()
-                     for r in self._replicas if r.alive]
+                     for r in list(self._replicas) if r.alive]
             hint = min(hints) if hints else 1.0
             if _telemetry.enabled():
                 _telemetry.MetricsRegistry.get_default().counter(
@@ -631,7 +660,7 @@ class ServingFleet:
         # session was served from earlier — an explicit release must
         # free those pages too, not wait out their TTL
         hit = False
-        for r in self._replicas:
+        for r in list(self._replicas):
             if r.alive:
                 hit = r.engine.release_session(session_id) or hit
         return hit
@@ -639,10 +668,10 @@ class ServingFleet:
     # ----------------------------------------------------------- stats
     @property
     def n_dispatches(self) -> int:
-        return sum(r.engine.n_dispatches for r in self._replicas)
+        return sum(r.engine.n_dispatches for r in list(self._replicas))
 
     def alive_replicas(self) -> int:
-        return sum(1 for r in self._replicas if r.alive)
+        return sum(1 for r in list(self._replicas) if r.alive)
 
     def queue_pressure(self) -> float:
         """Admission pressure normalized by live capacity: (fleet queue
@@ -651,7 +680,7 @@ class ServingFleet:
         serve rebalancing signal: a scheduler hands chips from a fleet
         sitting near 0 to a starved train job, and back when pressure
         climbs. inf when nothing is alive."""
-        alive = [r for r in self._replicas
+        alive = [r for r in list(self._replicas)
                  if r.alive and not r.draining]
         if not alive:
             return float("inf")
@@ -660,17 +689,19 @@ class ServingFleet:
         return depth / max(1, sum(r.engine.slots for r in alive))
 
     def stats(self) -> Dict[str, Any]:
-        e0 = self._replicas[0].engine
+        reps = list(self._replicas)
+        e0 = reps[0].engine
         with self._stats_lock:
             routed = dict(self._routed)
         return {
             "fleet": True,
-            "replicas": [dict(r.engine.stats(), alive=r.alive,
-                              draining=r.draining)
-                         for r in self._replicas],
+            "fleet_id": self.fleet_id,
+            "replicas": [dict(r.engine.stats(), id=r.rid,
+                              alive=r.alive, draining=r.draining)
+                         for r in reps],
             "alive_replicas": self.alive_replicas(),
-            "slots": sum(r.engine.slots for r in self._replicas
-                         if r.alive),
+            "slots": sum(r.engine.slots for r in reps if r.alive),
+            "pending_scale": self._pending_scale,
             "page_size": e0.page_size,
             "max_context": e0.max_context,
             "quantization": e0.quantization,
@@ -689,19 +720,140 @@ class ServingFleet:
         return {
             "fleet": True,
             "replicas": {r.engine.engine_id: r.engine.prefix_stats()
-                         for r in self._replicas},
+                         for r in list(self._replicas)},
         }
 
     # --------------------------------------------------- elastic resize
+    def _resolve(self, index: int) -> _Replica:
+        """Replica lookup by stable id. Raises IndexError (what list
+        indexing raised before ids existed) when no replica carries
+        that id — including ids retired by ``remove_replica``."""
+        r = self._by_rid.get(index)
+        if r is None:
+            raise IndexError(
+                f"no replica with id {index} "
+                f"(current ids: {sorted(self._by_rid)})")
+        return r
+
+    def add_replica(self, device: Any = None) -> int:
+        """Grow the fleet by one replica at runtime and return its
+        stable id.
+
+        The new engine is built on ``device`` (None = wherever a live
+        replica already runs, the shared-compile test topology),
+        adopting a live same-device replica's AOT warm pool so it
+        serves its first request with zero compiles. Compilation for a
+        DISTINCT device happens here, on the caller's thread — the
+        router never blocks on it. Registration is atomic: the replica
+        list only ever shows fully-started engines, so in-flight
+        routing cannot pick a half-built replica. On a started fleet
+        the engine starts before registration; on an unstarted fleet
+        it is registered cold and ``start()`` brings it up with the
+        rest."""
+        if self._stop.is_set():
+            raise RuntimeError("fleet has been shut down")
+        donor = next((x for x in list(self._replicas)
+                      if x.alive and not x.draining), None)
+        if device is None and donor is not None:
+            device = donor.engine._device
+        warm = donor.engine if donor is not None and \
+            (donor.engine._device is device
+             or donor.engine._device == device) else None
+        params = donor.engine.params if donor is not None \
+            else self._replicas[0].engine.params
+        self._pending_scale += 1
+        self._gauge_replicas()
+        t0 = time.perf_counter()
+        try:
+            eng = DecodeEngine(
+                self.model, params, device=device,
+                handoff_threshold=self.prefill_threshold,
+                warm_source=warm, **self._engine_kwargs)
+            with self._start_lock:
+                # under the start lock: either start() already ran (we
+                # must start the engine ourselves, off the router's
+                # critical path — this thread) or it hasn't (we
+                # register cold and start() starts every replica)
+                if self._router is not None:
+                    try:
+                        eng.start()
+                    except BaseException:
+                        # never leak a half-built engine's threads or
+                        # gauge series into a fleet that rejected it
+                        try:
+                            eng.shutdown(timeout=5.0)
+                        except Exception:
+                            pass
+                        raise
+                with self._cleanup_lock:
+                    rid = next(self._rids)
+                    r = _Replica(rid, eng)
+                    self._by_rid[rid] = r
+                    self._replicas.append(r)
+        finally:
+            self._pending_scale -= 1
+        self._gauge_replicas()
+        _flight.record("fleet_replica_added",
+                       engine=eng.engine_id, rid=rid,
+                       adopted=eng._warm.adopted,
+                       startup_s=round(time.perf_counter() - t0, 3))
+        return rid
+
+    def remove_replica(self, index: int,
+                       timeout: Optional[float] = 60.0) -> bool:
+        """Shrink the fleet: drain replica ``index`` (stop routing,
+        finish in-flight work, hand its pinned sessions off — they
+        re-admit cold on survivors and re-pin there), shut the engine
+        down (retiring its engine-labelled gauge series), then retire
+        the id. The capacity listener hears ``"drained"`` so a
+        scheduler reclaims the chip. True when the drain was clean."""
+        r = self._resolve(index)
+        live = [x for x in list(self._replicas)
+                if x.alive and not x.draining]
+        if r.alive and not r.draining and len(live) <= 1:
+            raise ValueError(
+                "cannot remove the last live replica "
+                f"(id {r.rid}) — shut the fleet down instead")
+        self._pending_scale -= 1
+        self._gauge_replicas()
+        try:
+            ok = True
+            if r.alive:
+                ok = self.drain_replica(r.rid, timeout)
+            else:
+                # dead replica: its scheduler thread already exited;
+                # finish the cleanup the router would have done
+                with self._cleanup_lock:
+                    pending = r.needs_cleanup
+                    r.needs_cleanup = False
+                if pending:
+                    try:
+                        r.engine.shutdown(timeout=5.0)
+                    except Exception:
+                        pass
+            with self._cleanup_lock:
+                self._by_rid.pop(r.rid, None)
+                try:
+                    self._replicas.remove(r)
+                except ValueError:
+                    pass
+        finally:
+            self._pending_scale += 1
+        self._gauge_replicas()
+        _flight.record("fleet_replica_removed",
+                       engine=r.engine.engine_id, rid=r.rid,
+                       clean=ok)
+        return ok
+
     def drain_replica(self, index: int,
                       timeout: Optional[float] = 60.0) -> bool:
         """Stop routing to replica ``index``, wait for its queued and
         in-flight requests to finish, then shut it down. Sessions
         pinned there are released (their next turn re-admits cold
         elsewhere). True when fully drained."""
-        r = self._replicas[index]
+        r = self._resolve(index)
         r.draining = True
-        self._drop_affinity(index)
+        self._drop_affinity(r.rid)
         ok = r.engine.drain(timeout)
         r.engine.shutdown()
         r.alive = False
@@ -715,7 +867,7 @@ class ServingFleet:
         """Bring a drained/dead replica back: a fresh engine (adopting
         a live same-device replica's warm pool when possible) starts
         and rejoins routing."""
-        r = self._replicas[index]
+        r = self._resolve(index)
         if r.alive:
             raise ValueError(f"replica {index} is still alive")
         old = r.engine
@@ -731,7 +883,7 @@ class ServingFleet:
                 old.shutdown(timeout=5.0)
             except Exception:
                 pass
-        donor = next((x.engine for x in self._replicas
+        donor = next((x.engine for x in list(self._replicas)
                       if x.alive and x.engine._device == old._device),
                      None)
         eng = DecodeEngine(
@@ -745,14 +897,14 @@ class ServingFleet:
             r.draining = False
         self._gauge_replicas()
         _flight.record("fleet_replica_restarted",
-                       engine=eng.engine_id, index=index)
+                       engine=eng.engine_id, index=r.rid)
 
     def kill_replica(self, index: int,
                      error: Optional[BaseException] = None) -> None:
         """Chaos hook: poison replica ``index``'s scheduler so it dies
         the way a real fault would — evictions, incident dump,
         re-routing. The CI kill-a-replica drill calls this."""
-        self._replicas[index].engine._die(
+        self._resolve(index).engine._die(
             error or RuntimeError(f"replica {index} killed by chaos "
                                   "hook"))
 
@@ -794,7 +946,7 @@ class ServingFleet:
                 item._fail(e)
 
     def _health_check(self) -> None:
-        for r in self._replicas:
+        for r in list(self._replicas):
             if r.alive and r.engine._dead is not None:
                 self._mark_dead(r, r.engine._dead)
             if r.needs_cleanup:
@@ -818,7 +970,7 @@ class ServingFleet:
             return
         r.alive = False
         r.needs_cleanup = True
-        self._drop_affinity(r.index)
+        self._drop_affinity(r.rid)
         self._gauge_replicas()
         _flight.record("fleet_replica_dead",
                        engine=r.engine.engine_id,
@@ -829,7 +981,7 @@ class ServingFleet:
         cb = self.capacity_listener
         if cb is not None:
             try:
-                cb(r.index, r.engine._device, reason)
+                cb(r.rid, r.engine._device, reason)
             except Exception:
                 pass   # a broken listener must not break routing
 
@@ -840,11 +992,23 @@ class ServingFleet:
                 del self._affinity[sid]
 
     def _gauge_replicas(self) -> None:
-        if _telemetry.enabled():
-            _telemetry.MetricsRegistry.get_default().gauge(
-                _telemetry.SERVING_FLEET_REPLICAS,
-                "decode replicas currently alive and routable").set(
-                self.alive_replicas())
+        if not _telemetry.enabled():
+            return
+        reg = _telemetry.MetricsRegistry.get_default()
+        reg.gauge(
+            _telemetry.SERVING_FLEET_REPLICAS,
+            "decode replicas currently alive and routable").set(
+            self.alive_replicas())
+        reg.gauge(
+            _telemetry.SERVING_FLEET_SIZE,
+            "replicas registered with the fleet router (alive or "
+            "not) — the elastic-resize size signal").set(
+            len(self._replicas), fleet=self.fleet_id)
+        reg.gauge(
+            _telemetry.SERVING_FLEET_PENDING_SCALE,
+            "elastic-resize operations in flight (+1 per add_replica,"
+            " -1 per remove_replica; 0 = fleet at rest)").set(
+            self._pending_scale, fleet=self.fleet_id)
 
     def _saturated(self, r: _Replica) -> bool:
         eng = r.engine
@@ -861,7 +1025,7 @@ class ServingFleet:
         if freq.done:
             return                   # cancelled while queued
         t_r0 = time.perf_counter()
-        cands = [r for r in self._replicas
+        cands = [r for r in list(self._replicas)
                  if r.alive and not r.draining]
         if not cands:
             freq._fail(RuntimeError("no live replicas"))
@@ -872,8 +1036,12 @@ class ServingFleet:
             with self._aff_lock:
                 idx = self._affinity.get(freq.session_id)
             if idx is not None:
-                aff = self._replicas[idx]
-                if aff.alive and not aff.draining \
+                # affinity pins the stable replica id — a removed
+                # replica's id resolves to None (cold fallback), never
+                # to whatever engine now occupies its old list slot
+                aff = self._by_rid.get(idx)
+                if aff is not None and aff.alive \
+                        and not aff.draining \
                         and not self._saturated(aff):
                     target, reason = aff, "affinity"
                 else:
@@ -883,7 +1051,7 @@ class ServingFleet:
             target = self._pick(freq, cands)
         if freq.session_id is not None:
             with self._aff_lock:
-                self._affinity[freq.session_id] = target.index
+                self._affinity[freq.session_id] = target.rid
         freq.routing.update(reason=reason)
         if _telemetry.enabled():
             _telemetry.MetricsRegistry.get_default().counter(
@@ -937,7 +1105,7 @@ class ServingFleet:
             return                   # cancelled while in flight
         eng = target.engine
         freq.attempts += 1
-        freq._replica_index = target.index
+        freq._replica_index = target.rid
         t_s0 = time.perf_counter()
         try:
             if handoff is not None:
@@ -983,7 +1151,7 @@ class ServingFleet:
                 # the capacity case — keep the structured 429 contract
                 # (retry_after_s) instead of an opaque error
                 hints = [r.engine.retry_after_hint()
-                         for r in self._replicas if r.alive]
+                         for r in list(self._replicas) if r.alive]
                 freq._fail(CapacityRejected(
                     f"every replica at capacity after {freq.attempts} "
                     "attempts",
@@ -1014,7 +1182,9 @@ class ServingFleet:
         idx = getattr(freq, "_replica_index", None)
         if idx is None:
             return False
-        r = self._replicas[idx]
+        r = self._by_rid.get(idx)
+        if r is None:
+            return False    # replica already removed from the fleet
         eng = r.engine
         if eng._dead is None and not eng._stop.is_set():
             return False        # genuine per-request error: surface it

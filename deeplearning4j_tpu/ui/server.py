@@ -16,6 +16,9 @@ so no CDN scripts). Endpoints:
     POST /v1/jobs[...]                      -> submit (registered
                                                factory) / cancel /
                                                drain / kill_worker
+    GET /v1/fleet[/<id>]                    -> serve fleets: replicas,
+                                               pending scale, pressure
+    POST /v1/fleet/scale                    -> target replica count
     GET /v1/workers[/<w>]                   -> fleet failure domains +
                                                supervised worker
                                                processes
@@ -375,6 +378,12 @@ class _Handler(BaseHTTPRequestHandler):
 
             obj, code = control.http_workers_get("/" + "/".join(parts))
             return self._json(obj, code)
+        if parts[0] == "v1" and len(parts) >= 2 \
+                and parts[1] == "fleet":
+            from deeplearning4j_tpu import control
+
+            obj, code = control.http_fleet_get("/" + "/".join(parts))
+            return self._json(obj, code)
         if parts[0] == "v1" and len(parts) == 2 and parts[1] == "alerts":
             from deeplearning4j_tpu.profiler import slo
 
@@ -394,7 +403,8 @@ class _Handler(BaseHTTPRequestHandler):
     def do_POST(self):
         path = self.path.rstrip("/")
         if path == "/v1/jobs" or path.startswith("/v1/jobs/") \
-                or path.startswith("/v1/workers/"):
+                or path.startswith("/v1/workers/") \
+                or path.startswith("/v1/fleet/"):
             from deeplearning4j_tpu import control
 
             try:
@@ -404,6 +414,8 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._json({"error": str(e)}, 400)
             if path.startswith("/v1/workers/"):
                 obj, code = control.http_workers_post(path, payload)
+            elif path.startswith("/v1/fleet/"):
+                obj, code = control.http_fleet_post(path, payload)
             else:
                 obj, code = control.http_jobs_post(path, payload)
             return self._json(obj, code)

@@ -50,6 +50,7 @@ from __future__ import annotations
 
 import contextlib
 import hashlib
+import io
 import json
 import logging
 import os
@@ -59,6 +60,7 @@ import shutil
 import signal
 import statistics
 import sys
+import tempfile
 import threading
 import time
 import uuid
@@ -612,6 +614,436 @@ class SharedFSBundleStore(BundleStore):
             self.directory, iteration,
             f"zero_shards_p{pidx}.npz", shards,
             timeout_s=self.publish_wait_s)
+
+
+# ======================================================================
+# object-store bundle store (rename-less commit protocol)
+# ======================================================================
+class InMemoryObjectStore:
+    """Dict-backed object-store client — the in-process test double for
+    the ``put/get/list/delete`` protocol ``ObjectStoreBundleStore``
+    speaks. A missing key raises ``KeyError`` (deterministic absence),
+    never ``OSError`` (transient trouble) — the retry loop must not
+    burn its budget waiting for an object that does not exist."""
+
+    def __init__(self):
+        self._blobs: Dict[str, bytes] = {}
+        self._lock = threading.Lock()
+
+    def put(self, key, data) -> None:
+        with self._lock:
+            self._blobs[str(key)] = bytes(data)
+
+    def get(self, key) -> bytes:
+        with self._lock:
+            try:
+                return self._blobs[str(key)]
+            except KeyError:
+                raise KeyError(f"no object at {key}") from None
+
+    def list(self, prefix) -> List[str]:
+        p = str(prefix)
+        with self._lock:
+            return sorted(k for k in self._blobs if k.startswith(p))
+
+    def delete(self, key) -> None:
+        with self._lock:
+            self._blobs.pop(str(key), None)
+
+    def describe(self) -> str:
+        return f"memory({len(self._blobs)} objects)"
+
+
+class LocalObjectStore:
+    """Filesystem-backed object-store client: ``/``-separated keys map
+    to files under ``root``. ``put`` is DELIBERATELY a plain
+    open/write — no tmp-rename, no fsync — because the class emulates
+    bucket semantics, where atomicity comes from the COMMIT PROTOCOL
+    above it, not from the storage layer (and where a torn upload
+    really does leave a truncated blob under the key). Two instances
+    over one root are two hosts sharing a bucket — the cross-host
+    discovery substrate for tests and single-machine drills."""
+
+    def __init__(self, root):
+        self.root = os.fspath(root)
+        os.makedirs(self.root, exist_ok=True)
+
+    def _path(self, key) -> str:
+        return os.path.join(self.root, *str(key).split("/"))
+
+    def put(self, key, data) -> None:
+        path = self._path(key)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "wb") as f:
+            f.write(bytes(data))
+
+    def get(self, key) -> bytes:
+        try:
+            with open(self._path(key), "rb") as f:
+                return f.read()
+        except FileNotFoundError:
+            raise KeyError(f"no object at {key}") from None
+
+    def list(self, prefix) -> List[str]:
+        p = str(prefix)
+        out = []
+        for dirpath, _, files in os.walk(self.root):
+            for nm in files:
+                rel = os.path.relpath(os.path.join(dirpath, nm),
+                                      self.root)
+                key = rel.replace(os.sep, "/")
+                if key.startswith(p):
+                    out.append(key)
+        return sorted(out)
+
+    def delete(self, key) -> None:
+        try:
+            os.remove(self._path(key))
+        except FileNotFoundError:
+            pass
+
+    def describe(self) -> str:
+        return f"file({self.root})"
+
+
+class ObjectStoreBundleStore(BundleStore):
+    """Bundle store over S3/GCS-style object storage — no rename, no
+    fsync, no atomic directory publish to lean on, so the atomicity
+    the local stores get from ``os.replace`` is rebuilt as a COMMIT
+    PROTOCOL:
+
+    - every write attempt uploads its members under a fresh
+      write-unique prefix ``<ns>/bundles/<name>/<token>/<member>``;
+    - the COMMIT OBJECT ``<ns>/commit/<name>`` — the manifest plus
+      the winning token and per-member digests — is written LAST.
+      Readers enumerate ONLY the commit namespace, so an uncommitted
+      (crashed, torn, still-uploading) prefix is invisible by
+      construction;
+    - non-zero hosts attach ``zero_shards_p<i>.npz`` under
+      ``<ns>/shards/<name>/`` with a ``.sha256`` marker object
+      uploaded AFTER the blob — no marker, no shard, exactly the
+      sidecar contract of ``publish_foreign_shard``;
+    - every download digest-verifies against the commit/marker before
+      use: a torn upload (half a blob under the right key — chaos's
+      ``store_torn``) is detected and the reader falls back to the
+      previous commit, mirroring ``latest_valid_bundle``.
+
+    Restore needs local files (``_restore_bundle`` reads paths), so
+    ``latest_valid``/``discover`` MATERIALIZE commits into the local
+    cache directory, which doubles as the ``FaultTolerance``
+    ``checkpoint_dir`` anchor and as the offline fallback when the
+    store is unreachable. ``client`` is anything speaking
+    put/get/list/delete (``InMemoryObjectStore``,
+    ``LocalObjectStore``, a real SDK adapter); it is automatically
+    wrapped by ``chaos.FaultyObjectStore.from_env`` so the
+    ``DL4J_TPU_CHAOS_STORE_*`` knobs inject faults without code
+    changes. Transient ``OSError`` retries with backoff are on by
+    default (``io_retries=4``), counted in
+    ``dl4j_tpu_ft_bundle_io_retries_total``."""
+
+    kind = "object_store"
+
+    def __init__(self, client, namespace: str = "default", *,
+                 cache_dir=None, io_retries: int = 4,
+                 io_backoff: float = 0.05,
+                 publish_wait_s: float = 10.0,
+                 process_index: Optional[int] = None,
+                 process_count: Optional[int] = None):
+        if cache_dir is None:
+            cache_dir = tempfile.mkdtemp(
+                prefix="dl4j_tpu_ostore_cache.")
+        super().__init__(cache_dir, io_retries=io_retries,
+                         io_backoff=io_backoff)
+        self.client = _chaos.FaultyObjectStore.from_env(client)
+        self.namespace = str(namespace)
+        self.publish_wait_s = float(publish_wait_s)
+        self._process_index = process_index
+        self._process_count = process_count
+
+    def _identity(self) -> Tuple[int, int]:
+        if self._process_index is not None:
+            return self._process_index, self._process_count or 1
+        return _host_identity()
+
+    def _key(self, *parts: str) -> str:
+        return "/".join((self.namespace,) + parts)
+
+    # ------------------------------------------------------------ write
+    def write(self, model, resume_meta: Dict[str, Any],
+              keep_last: int = 2, trainer=None) -> str:
+        pidx, pcnt = self._identity()
+        if pidx != 0:
+            return self._write_shard(model, trainer)
+        # stage locally first: the cache gets a normal atomic bundle
+        # (and local keep_last pruning) for free, and a crash between
+        # here and the commit upload still leaves a restorable local
+        # checkpoint for a same-host restart
+        path = self._retrying(
+            "write_bundle", write_bundle, self.directory, model,
+            resume_meta, keep_last=keep_last, trainer=trainer,
+            process_index=0, process_count=pcnt)
+        name = os.path.basename(path)
+        with open(os.path.join(path, "manifest.json")) as f:
+            manifest = json.load(f)
+        token = uuid.uuid4().hex
+        members: Dict[str, Any] = {}
+        for member, digest in manifest["digests"].items():
+            with open(os.path.join(path, member), "rb") as f:
+                data = f.read()
+            self._retrying(
+                "put", self.client.put,
+                self._key("bundles", name, token, member), data)
+            members[member] = {"sha256": digest, "size": len(data)}
+        commit = dict(manifest, prefix=token, members=members)
+        self._retrying(
+            "commit", self.client.put, self._key("commit", name),
+            json.dumps(commit).encode())
+        try:
+            self._prune_remote(keep_last)
+        except OSError as e:
+            # hygiene, not correctness: uncommitted garbage is already
+            # invisible; stale commits just cost bucket space
+            log.warning("resilience: remote bundle pruning failed "
+                        "(%s) — will retry at the next checkpoint", e)
+        return path
+
+    def _write_shard(self, model, trainer) -> str:
+        """Non-zero host: attach this host's shard blob + digest
+        marker to the bundle process 0 committed for this step."""
+        iteration = int(model.getIterationCount())
+        pidx, _ = self._identity()
+        member = f"zero_shards_p{pidx}.npz"
+        z = getattr(trainer, "_zero", None)
+        layout = getattr(trainer, "_zero_layout", None)
+        if z is None or layout is None:
+            return self._key("commit", f"bundle-{iteration:010d}")
+        shards = layout.addressable_shards(z["masters"], z["opt"])
+        buf = io.BytesIO()
+        np.savez(buf, **shards)
+        data = buf.getvalue()
+        name = self._await_commit(iteration)
+        blob_key = self._key("shards", name, member)
+        self._retrying("put", self.client.put, blob_key, data)
+        # marker LAST: its presence certifies the blob fully uploaded
+        self._retrying(
+            "put", self.client.put, blob_key + ".sha256",
+            hashlib.sha256(data).hexdigest().encode())
+        return blob_key
+
+    def _await_commit(self, iteration: int) -> str:
+        deadline = time.monotonic() + self.publish_wait_s
+        while True:
+            try:
+                for it, name, _ in self._commits():
+                    if it == iteration:
+                        return name
+            except OSError:
+                pass            # keep polling until the deadline
+            if time.monotonic() > deadline:
+                raise OSError(
+                    f"no commit for iteration {iteration} was "
+                    f"published by process 0 within "
+                    f"{self.publish_wait_s}s — cannot attach shard")
+            time.sleep(0.05)
+
+    # ------------------------------------------------------- discovery
+    def _commits(self) -> List[Tuple[int, str, Dict[str, Any]]]:
+        """(iteration, name, commit manifest) for every committed
+        bundle, newest first — the ONLY enumeration readers do."""
+        out = []
+        prefix = self._key("commit") + "/"
+        for key in self._retrying("list_commits", self.client.list,
+                                  prefix):
+            name = key[len(prefix):] if key.startswith(prefix) \
+                else key.rsplit("/", 1)[-1]
+            m = _BUNDLE_RE.fullmatch(name)
+            if not m:
+                continue
+            try:
+                manifest = json.loads(self._retrying(
+                    "get_commit", self.client.get, key))
+            except (KeyError, ValueError) as e:
+                log.warning("resilience: unreadable commit object %s "
+                            "(%s) — skipping", key, e)
+                continue
+            if manifest.get("format") != _RESUME_FORMAT:
+                continue
+            out.append((int(m.group(1)), name, manifest))
+        return sorted(out, key=lambda t: (t[0], t[1]), reverse=True)
+
+    def _materialize(self, name: str,
+                     manifest: Dict[str, Any]) -> Optional[str]:
+        """Download a committed bundle into the local cache,
+        digest-verifying every member against the commit. Returns the
+        local path, or None when the bundle is incomplete (a shard
+        marker missing) or any object fails verification (torn
+        upload) — the caller falls back to the previous commit."""
+        token = manifest.get("prefix", "")
+        members = manifest.get("members", {})
+        digests = manifest.get("digests", {})
+        plan = [(m, self._key("bundles", name, token, m),
+                 info["sha256"]) for m, info in members.items()]
+        foreign = []
+        for member in manifest.get("expected_shards", []):
+            if member in members or member in digests:
+                continue
+            marker = self._key("shards", name, member) + ".sha256"
+            try:
+                want = self._retrying(
+                    "get", self.client.get, marker).decode().strip()
+            except KeyError:
+                log.warning("resilience: bundle %s is incomplete — "
+                            "shard marker %s not yet published",
+                            name, member)
+                return None
+            foreign.append((member, self._key("shards", name, member),
+                            want))
+        local = os.path.join(self.directory, name)
+        os.makedirs(local, exist_ok=True)
+        for member, key, want in plan + foreign:
+            dst = os.path.join(local, member)
+            if os.path.exists(dst) and _sha256(dst) == want:
+                continue        # warm cache: already verified local
+            try:
+                data = self._retrying("get", self.client.get, key)
+            except KeyError:
+                log.warning("resilience: bundle %s is missing object "
+                            "%s — treating as incomplete", name, key)
+                return None
+            if hashlib.sha256(data).hexdigest() != want:
+                log.warning("resilience: object %s failed digest "
+                            "validation (torn upload?) — falling "
+                            "back to the previous bundle", key)
+                return None
+            tmp = dst + f".{uuid.uuid4().hex[:8]}.tmp"
+            with open(tmp, "wb") as f:
+                f.write(data)
+            os.replace(tmp, dst)
+        for member, _, want in foreign:
+            side = os.path.join(local, member + ".sha256")
+            if not os.path.exists(side):
+                with open(side, "w") as f:
+                    f.write(want)
+        # a local manifest makes the materialized dir indistinguishable
+        # from a write_bundle dir: base validate() and _restore_bundle
+        # work on it unchanged
+        local_manifest = {k: v for k, v in manifest.items()
+                          if k not in ("prefix", "members")}
+        local_manifest["digests"] = dict(
+            digests, **{m: info["sha256"]
+                        for m, info in members.items()})
+        with open(os.path.join(local, "manifest.json"), "w") as f:
+            json.dump(local_manifest, f)
+        return local
+
+    def latest_valid(self) -> Optional[str]:
+        try:
+            commits = self._commits()
+        except OSError as e:
+            log.warning("resilience: object store unreachable (%s) — "
+                        "falling back to the local cache", e)
+            return super().latest_valid()
+        for _, name, manifest in commits:
+            try:
+                path = self._materialize(name, manifest)
+            except OSError as e:
+                log.warning("resilience: object store unreachable "
+                            "mid-download (%s) — falling back to the "
+                            "local cache", e)
+                return super().latest_valid()
+            if path is not None and self.validate(path):
+                return path
+            log.warning("resilience: committed bundle %s did not "
+                        "materialize/validate — falling back to the "
+                        "previous one", name)
+        # a REACHABLE store with no valid commit is authoritative: a
+        # staged-but-never-committed local bundle "didn't happen"
+        # cluster-wide, and after retire() nothing may resume
+        return None
+
+    def discover(self) -> List[Dict[str, Any]]:
+        try:
+            commits = self._commits()
+        except OSError:
+            return super().discover()
+        out = []
+        for it, name, manifest in commits:
+            path = self._materialize(name, manifest)
+            out.append({
+                "iteration": it,
+                "path": path if path else self._key("commit", name),
+                "host": manifest.get("host"),
+                "complete": self._remote_complete(name, manifest),
+                "valid": path is not None and self.validate(path),
+            })
+        return out
+
+    def _remote_complete(self, name: str,
+                         manifest: Dict[str, Any]) -> bool:
+        """Cheap completeness probe, bucket edition: every expected
+        shard is either a committed member or has its marker object
+        (no digest pass — mirrors ``_bundle_complete``)."""
+        members = manifest.get("members", {})
+        digests = manifest.get("digests", {})
+        for member in manifest.get("expected_shards", []):
+            if member in members or member in digests:
+                continue
+            try:
+                self._retrying(
+                    "get", self.client.get,
+                    self._key("shards", name, member) + ".sha256")
+            except KeyError:
+                return False
+        return True
+
+    # ------------------------------------------------------- retention
+    def _prune_remote(self, keep_last: int) -> None:
+        """keep_last in the bucket, same rules as ``_prune_bundles``:
+        process 0 only, count only COMPLETE bundles, never delete an
+        incomplete bundle at/after the cutoff (a slower host is still
+        uploading its shard)."""
+        if self._identity()[0] != 0:
+            return
+        commits = self._commits()
+        complete = [(it, nm, mf) for it, nm, mf in commits
+                    if self._remote_complete(nm, mf)]
+        if not complete:
+            return
+        kept = complete[:max(keep_last, 1)]
+        keep = {nm for _, nm, _ in kept}
+        cutoff = kept[-1][0]
+        for it, nm, mf in commits:
+            if nm in keep:
+                continue
+            if it >= cutoff and not self._remote_complete(nm, mf):
+                continue
+            self._delete_remote(nm)
+
+    def _delete_remote(self, name: str) -> None:
+        # the commit object goes FIRST — the bundle becomes invisible
+        # atomically; the blob sweep after it can tear harmlessly
+        self._retrying("delete", self.client.delete,
+                       self._key("commit", name))
+        for prefix in (self._key("bundles", name) + "/",
+                       self._key("shards", name) + "/"):
+            for key in self._retrying("list", self.client.list,
+                                      prefix):
+                self._retrying("delete", self.client.delete, key)
+
+    def retire(self) -> None:
+        try:
+            for _, name, _ in self._commits():
+                self._delete_remote(name)
+        except OSError as e:
+            log.warning("resilience: could not retire remote bundles "
+                        "(%s) — local cache retired anyway", e)
+        super().retire()
+
+    def describe(self) -> str:
+        inner = getattr(self.client, "describe", None)
+        where = inner() if callable(inner) else repr(self.client)
+        return (f"{self.kind}:{where}/{self.namespace} "
+                f"(cache {self.directory})")
 
 
 # ======================================================================
@@ -1884,5 +2316,6 @@ __all__ = ["FaultTolerance", "DivergenceError", "StepWatchdog",
            "run_fit", "resolve_policy", "write_bundle",
            "latest_valid_bundle", "validate_bundle", "retire_bundles",
            "BundleStore", "LocalBundleStore", "SharedFSBundleStore",
-           "PreemptionNotice", "NoticePoller",
+           "ObjectStoreBundleStore", "InMemoryObjectStore",
+           "LocalObjectStore", "PreemptionNotice", "NoticePoller",
            "publish_foreign_shard"]

@@ -2475,4 +2475,280 @@ if [ $profgate -ne 0 ]; then
     exit 1
 fi
 
+# Autoscale chaos drill (docs/CONTROL_PLANE.md "Phase 3"): the closed
+# loop, end to end, twice. A 2-replica fleet and a lower-priority
+# train job (checkpointing through ObjectStoreBundleStore over a
+# local "bucket") exhaust a 3-chip pool; hung replicas + a burst make
+# serving_queue_pressure FIRE -> the scheduler checkpoint-preempts
+# and PARKS the train job, takes its chip, and fleet.add_replica
+# grows the fleet to 3 — every burst request completes with greedy
+# outputs token-identical to solo generate() and ZERO warm-pool
+# misses on the grown replica. The alert then resolves; after
+# scale_down_hold_s the elastic replica is removed, the chip returns,
+# and the parked job resumes at its exact step, finishing with params
+# AND Adam moments bit-equal to an uninterrupted run. Pass 2 repeats
+# the whole drill with DL4J_TPU_CHAOS_STORE_ERROR_RATE=1: the first
+# attempt of every object-store op fails, so each park/resume bundle
+# op must retry (ft_bundle_io_retries_total > 0) and still converge.
+AS_DIR=$(mktemp -d /tmp/dl4j_autoscale_gate.XXXXXX)
+cat > "$AS_DIR/autoscale_drill.py" <<'EOF'
+import os
+import sys
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu import control
+from deeplearning4j_tpu.datasets import ArrayDataSetIterator
+from deeplearning4j_tpu.learning import Adam
+from deeplearning4j_tpu.models.gpt import CausalLM
+from deeplearning4j_tpu.models.transformer import tiny_config
+from deeplearning4j_tpu.nn.conf import (
+    DenseLayer, InputType, NeuralNetConfiguration, OutputLayer,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.profiler import chaos, flight_recorder, slo, telemetry
+from deeplearning4j_tpu.serving import ServingFleet
+from deeplearning4j_tpu.util.resilience import (
+    FaultTolerance, LocalObjectStore, ObjectStoreBundleStore,
+)
+
+GATE = os.environ["DL4J_TPU_AUTOSCALE_GATE_DIR"]
+CHAOS_STORE = os.environ.get("DL4J_TPU_CHAOS_STORE_ERROR_RATE") == "1"
+TAG = "chaos-store" if CHAOS_STORE else "clean"
+fail = []
+reg = telemetry.MetricsRegistry.get_default()
+
+rng = np.random.default_rng(0)
+x = rng.normal(size=(48, 4)).astype(np.float32)
+y = np.eye(2, dtype=np.float32)[(x.sum(1) > 0).astype(int)]
+
+
+def make_net():
+    return MultiLayerNetwork(
+        (NeuralNetConfiguration.builder().seed(3)
+         .updater(Adam(learning_rate=0.01)).list()
+         .layer(DenseLayer(n_out=8, activation="tanh"))
+         .layer(OutputLayer(n_out=2, activation="softmax",
+                            loss="mcxent"))
+         .setInputType(InputType.feedForward(4)).build()))
+
+
+class SlowIter(ArrayDataSetIterator):
+    def next(self):
+        time.sleep(0.35)
+        return super().next()
+
+
+VOCAB = 17
+cfg = tiny_config(vocab=VOCAB, max_len=48, d_model=32, n_layers=2,
+                  n_heads=4, d_ff=64)
+cfg.dropout = 0.0
+gpt = CausalLM(cfg, compute_dtype=jnp.float32)
+gparams = gpt.init_params(jax.random.key(1))
+prompts = [rng.integers(0, VOCAB, (int(rng.integers(3, 12)),))
+           .astype(np.int32) for _ in range(6)]
+solo = {i: np.asarray(gpt.generate(
+    gparams, jnp.asarray(p[None, :], jnp.int32), 3))[0]
+    for i, p in enumerate(prompts)}
+
+devs = jax.devices()[:3]
+eng = slo.SLOEngine(
+    [slo.Threshold("serving_queue_pressure",
+                   metric=telemetry.SERVING_FLEET_PRESSURE,
+                   bound=1.0, op=">", for_s=0.5,
+                   action="scale_serve")],
+    interval_s=0.2)
+eng.start()
+sched = control.JobScheduler(
+    devices=devs, workers={"w0": devs[:2], "w1": [devs[2]]},
+    slo=eng, rebalance=False, scale_down_hold_s=2.0,
+    make_default=False).start()
+
+# train-job checkpoints live in an object-store "bucket" — the
+# bundle substrate the parked job's exact-resume rides on
+store = ObjectStoreBundleStore(
+    LocalObjectStore(os.path.join(GATE, f"bucket-{TAG}")),
+    "train-1", cache_dir=os.path.join(GATE, f"cache-{TAG}"),
+    io_backoff=0.01)
+if CHAOS_STORE and not isinstance(store.client,
+                                  chaos.FaultyObjectStore):
+    fail.append("chaos env set but the store client is unwrapped")
+retries_before = reg.counter(telemetry.FT_BUNDLE_IO_RETRIES).total()
+
+serve = sched.submit(control.ServeJob(
+    lambda ctx: ServingFleet(gpt, gparams, devices=ctx.devices,
+                             slots=2, page_size=8,
+                             prefill_buckets=[16], max_chunk=4),
+    replicas=2, priority=5))
+sched.wait(serve.job_id, timeout=300, states=("running",))
+deadline = time.monotonic() + 120
+while serve.fleet is None and time.monotonic() < deadline:
+    time.sleep(0.02)
+if serve.fleet is None:
+    sys.stderr.write("autoscale drill: fleet never came up\n")
+    sys.exit(1)
+fl = serve.fleet
+
+nets = []
+
+
+def run_train(ctx):
+    net = make_net()
+    nets.append(net)
+    net.init()
+    net.fit(SlowIter(x, y, 8, shuffle=True, seed=5), epochs=3,
+            fault_tolerance=ctx.fault_tolerance)
+    return float(net._score)
+
+
+# baseline: the 2-replica fleet is token-identical to solo (this
+# also pays the decode-compile cost BEFORE the train job starts, so
+# the slow iterator is still mid-fit when the burst needs its chip)
+for i in (0, 1):
+    if not np.array_equal(fl.generate(prompts[i], 3), solo[i]):
+        fail.append(f"baseline output differs from solo ({i})")
+
+train = sched.submit(control.TrainJob(
+    run_train, chips=1,
+    fault_tolerance=FaultTolerance(bundle_store=store,
+                                   checkpoint_every=None,
+                                   divergence_window=0)))
+sched.wait(train.job_id, timeout=120, states=("running",))
+deadline = time.monotonic() + 60
+while (not nets or nets[0].getIterationCount() < 3) \
+        and time.monotonic() < deadline:
+    time.sleep(0.02)
+if sched.devices.free != 0:
+    fail.append(f"pool not exhausted before the burst "
+                f"({sched.devices.free} free)")
+
+# ---- burst: pressure fires -> park the train job -> grow to 3 ------
+for r in list(fl._replicas):
+    chaos.hang_replica(r.engine, 3.0)
+with ThreadPoolExecutor(max_workers=12) as ex:
+    futs = [ex.submit(fl.generate, prompts[i % 6], 3)
+            for i in range(12)]
+    deadline = time.monotonic() + 120
+    while fl.alive_replicas() < 3 and time.monotonic() < deadline:
+        time.sleep(0.05)
+    outs = [f.result(timeout=300) for f in futs]
+if fl.alive_replicas() != 3:
+    fail.append("fleet never grew to 3 replicas "
+                f"(alert={eng.alert_state('serving_queue_pressure', fleet=fl.fleet_id)})")
+for i, got in enumerate(outs):
+    if not np.array_equal(got, solo[i % 6]):
+        fail.append(f"burst output {i} differs from solo")
+        break
+# the park is transient (the quiet-alert shrink can refund the chip
+# and resume the job before this line runs) — assert the TRANSITION,
+# not the state
+deadline = time.monotonic() + 30
+parked = []
+while not parked and time.monotonic() < deadline:
+    parked = [e for e in flight_recorder.get_default().events()
+              if e["kind"] == "job_parked"
+              and e.get("job") == train.job_id]
+    time.sleep(0.05)
+if not parked:
+    fail.append(f"train job never parked for the grow "
+                f"({train.state})")
+if serve._elastic:
+    grown = fl._by_rid.get(serve._elastic[-1][0])
+    if grown is None:
+        fail.append("elastic rid not registered in the fleet")
+    elif grown.engine.stats()["warm_pool"]["misses"] != 0:
+        fail.append("grown replica had warm-pool misses: "
+                    f"{grown.engine.stats()['warm_pool']}")
+else:
+    fail.append("no elastic replica recorded on the serve job")
+if reg.counter(telemetry.FLEET_SCALE_UP).value(
+        fleet=fl.fleet_id) < 1:
+    fail.append("fleet_scale_up_total did not count")
+
+# ---- quiet: alert resolves -> shrink -> parked job resumes exactly -
+deadline = time.monotonic() + 90
+while fl.alive_replicas() > 2 and time.monotonic() < deadline:
+    time.sleep(0.05)
+if fl.alive_replicas() != 2:
+    fail.append("fleet never shrank after the alert went quiet "
+                f"(alert={eng.alert_state('serving_queue_pressure', fleet=fl.fleet_id)})")
+if reg.counter(telemetry.FLEET_SCALE_DOWN).value(
+        fleet=fl.fleet_id) < 1:
+    fail.append("fleet_scale_down_total did not count")
+sched.wait(train.job_id, timeout=180)
+if train.state != "completed":
+    fail.append(f"parked train job did not finish ({train.state}: "
+                f"{train.error})")
+if len(nets) != 2 or nets[-1].getIterationCount() != 18:
+    fail.append(f"resume step count wrong: attempts={len(nets)}, "
+                f"iter={nets[-1].getIterationCount() if nets else 0}")
+# bit-identical to an uninterrupted run: params AND Adam moments
+ref = make_net().init()
+ref.fit(ArrayDataSetIterator(x, y, 8, shuffle=True, seed=5), epochs=3)
+for a, b in zip(jax.tree_util.tree_leaves(
+        (ref.params_list, ref.opt_states)),
+        jax.tree_util.tree_leaves(
+        (nets[-1].params_list, nets[-1].opt_states))):
+    if not np.array_equal(np.asarray(a), np.asarray(b)):
+        fail.append("resumed run not bit-identical to uninterrupted")
+        break
+
+kinds = [e["kind"] for e in flight_recorder.get_default().events()]
+for want in ("job_preempt", "job_parked", "job_scale_up",
+             "fleet_replica_added", "job_scale_down",
+             "fleet_replica_removed", "job_resumed"):
+    if want not in kinds:
+        fail.append(f"missing flight event {want}")
+
+if CHAOS_STORE:
+    retried = reg.counter(
+        telemetry.FT_BUNDLE_IO_RETRIES).total() - retries_before
+    if retried <= 0:
+        fail.append("chaos store pass: no bundle op retried")
+    if store.client.injected <= 0:
+        fail.append("chaos store pass: nothing injected")
+
+sched.shutdown()
+eng.shutdown()
+time.sleep(0.2)
+leaked = [t.name for t in threading.enumerate()
+          if t.is_alive() and t.name.startswith(
+              ("SLOEvaluator", "JobScheduler", "JobRunner",
+               "ServingEngine", "ServingFleetRouter"))]
+if leaked:
+    fail.append(f"threads survived shutdown: {leaked}")
+
+if fail:
+    sys.stderr.write(f"autoscale drill ({TAG}) FAILED:\n  "
+                     + "\n  ".join(fail) + "\n")
+    sys.exit(1)
+print(f"autoscale drill ({TAG}) OK: pressure alert parked the train "
+      "job and grew the fleet 2->3 (token-identical burst, zero "
+      "warm-pool misses), quiet alert shrank it back and the parked "
+      "job resumed bit-identically at step 18"
+      + (", every bundle op retried under store chaos"
+         if CHAOS_STORE else ""))
+EOF
+export DL4J_TPU_AUTOSCALE_GATE_DIR="$AS_DIR"
+env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu DL4J_TPU_TELEMETRY=1 \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    PYTHONPATH=. python "$AS_DIR/autoscale_drill.py"
+asgate1=$?
+env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu DL4J_TPU_TELEMETRY=1 \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    DL4J_TPU_CHAOS_STORE_ERROR_RATE=1 \
+    PYTHONPATH=. python "$AS_DIR/autoscale_drill.py"
+asgate2=$?
+unset DL4J_TPU_AUTOSCALE_GATE_DIR
+rm -rf "$AS_DIR"
+if [ $asgate1 -ne 0 ] || [ $asgate2 -ne 0 ]; then
+    echo "FATAL: autoscale chaos drill regressed (clean=$asgate1 chaos=$asgate2)" >&2
+    exit 1
+fi
+
 exit $rc

@@ -802,3 +802,87 @@ class TestPeriodicCheckpoints:
                         jax.tree_util.tree_leaves(ref.params_list)):
             np.testing.assert_array_equal(np.asarray(a),
                                           np.asarray(b))
+
+
+# ======================================================================
+# phase 3: elasticity (manual scale through the fleet endpoint)
+# ======================================================================
+class TestElasticity:
+    def test_fleet_endpoints_error_conventions(self):
+        with make_sched() as s:
+            obj, code = control.http_fleet_get("/v1/fleet")
+            assert code == 200 and obj == {"fleets": []}
+            obj, code = control.http_fleet_get("/v1/fleet/nope")
+            assert code == 404
+            obj, code = control.http_fleet_post("/v1/fleet/scale", {})
+            assert code == 400 and "target" in obj["error"]
+            obj, code = control.http_fleet_post("/v1/fleet/scale",
+                                                {"target": 0})
+            assert code == 400
+            obj, code = control.http_fleet_post("/v1/fleet/scale",
+                                                {"target": 2})
+            assert code == 404          # no running serve job
+            obj, code = control.http_fleet_post("/v1/fleet/other", {})
+            assert code == 404
+
+    @pytest.mark.slow
+    def test_http_scale_grows_and_shrinks_fleet(self, gpt):
+        """Operator scaling end to end: POST /v1/fleet/scale grows a
+        live fleet onto a freshly acquired chip (replica registered,
+        chip accounted), serves token-identically, then shrinks back
+        — replica drained, chip returned to the pool. Manual scale is
+        PINNED: the auto scale-down pass must not undo it."""
+        model, params = gpt
+
+        def build(ctx):
+            return ServingFleet(model, params, devices=ctx.devices,
+                                slots=2, page_size=8,
+                                prefill_buckets=[8, 16, 40],
+                                max_chunk=4)
+
+        rng = np.random.default_rng(31)
+        with make_sched(devices=DEVS[:2], workers={"w0": DEVS[:2]},
+                        scale_down_hold_s=0.01) as s:
+            job = s.submit(control.ServeJob(build, replicas=1))
+            s.wait(job.job_id, timeout=120, states=("running",))
+            deadline = time.time() + 60
+            while job.fleet is None and time.time() < deadline:
+                time.sleep(0.02)
+            assert s.devices.free == 1
+            obj, code = control.http_fleet_post(
+                "/v1/fleet/scale", {"target": 2})
+            assert code == 200, obj
+            assert obj["replicas"] == 2 and obj["manual"] == 1
+            assert s.devices.free == 0       # second chip in use
+            assert job.fleet.alive_replicas() == 2
+            prompt = rng.integers(0, VOCAB, (6,)).astype(np.int32)
+            out = job.generate(prompt, 5, timeout=60)
+            assert out.shape == (5,)
+            # manual replicas survive the auto scale-down pass
+            time.sleep(0.2)
+            s._maybe_scale_down()
+            assert job.fleet.alive_replicas() == 2
+            # a third replica has no chip to land on: clean 400, no
+            # half-built replica, no leaked pending_scale
+            obj, code = control.http_fleet_post(
+                "/v1/fleet/scale", {"target": 3})
+            assert code == 400
+            assert job.fleet.alive_replicas() == 2
+            assert job.fleet.stats()["pending_scale"] == 0
+            # shrink back: drain hands the chip to the pool
+            obj, code = control.http_fleet_post(
+                "/v1/fleet/scale", {"target": 1})
+            assert code == 200, obj
+            assert job.fleet.alive_replicas() == 1
+            deadline = time.time() + 30
+            while s.devices.free < 1 and time.time() < deadline:
+                time.sleep(0.02)
+            assert s.devices.free == 1
+            kinds = [e["kind"] for e in
+                     flight_recorder.get_default().events()]
+            assert "job_scale_up" in kinds
+            assert "job_scale_down" in kinds
+            assert "fleet_replica_added" in kinds
+            assert "fleet_replica_removed" in kinds
+            s.cancel(job.job_id)
+            s.wait(job.job_id, timeout=60)

@@ -10,6 +10,7 @@ fix)."""
 import hashlib
 import json
 import os
+import tempfile
 import threading
 import time
 import urllib.error
@@ -189,6 +190,136 @@ class TestBundleStore:
         monkeypatch.setattr(resilience, "_sha256", flaky)
         assert store.latest_valid() == path
         assert fails["n"] == 1
+
+
+class TestObjectStoreBundle:
+    """ObjectStoreBundleStore: rename-less commit protocol over
+    S3/GCS-style put/get/list/delete — uncommitted prefixes invisible,
+    torn uploads caught by digest, retries counted, remote retire
+    authoritative."""
+
+    def _store(self, client, ns="job-1", **kw):
+        kw.setdefault("cache_dir", tempfile.mkdtemp(
+            prefix="dl4j_ostore_test."))
+        kw.setdefault("io_backoff", 0.005)
+        return resilience.ObjectStoreBundleStore(client, ns, **kw)
+
+    def test_roundtrip_cross_host_and_uncommitted_invisible(self):
+        net = small_net()
+        client = resilience.InMemoryObjectStore()
+        writer = self._store(client)
+        path = writer.write(net, {"rng": [0, 1],
+                                  "epochs_remaining": 1})
+        assert writer.latest_valid() == path
+        assert resilience.validate_bundle(path)
+        # a SECOND store with a FRESH cache over the same client is
+        # the survivor after the writer host died: it materializes
+        # the committed bundle locally, digest-valid
+        survivor = self._store(client)
+        p2 = survivor.latest_valid()
+        assert p2 is not None and p2 != path
+        assert p2.startswith(survivor.directory)
+        assert resilience.validate_bundle(p2)
+        disc = survivor.discover()
+        assert disc[0]["valid"] and disc[0]["complete"]
+        assert disc[0]["host"] == "p0"
+        # an UNCOMMITTED member prefix (crashed mid-upload) is
+        # invisible: readers only enumerate the commit namespace
+        client.put("job-1/bundles/bundle-0000000099/tok/model.zip",
+                   b"half a bl")
+        assert [it for it, _, _ in survivor._commits()] == [0]
+        # namespace isolation
+        assert self._store(client, ns="job-2").latest_valid() is None
+
+    def test_torn_upload_never_visible(self):
+        """A blob torn AFTER commit (the bytes under the key are
+        truncated — the store_torn chaos shape) fails digest
+        verification at read; discovery falls back to the previous
+        committed bundle instead of restoring garbage."""
+        net = small_net()
+        client = resilience.InMemoryObjectStore()
+        writer = self._store(client)
+        good = writer.write(net, {"rng": [0], "epochs_remaining": 0})
+        net._iteration = 1
+        writer.write(net, {"rng": [1], "epochs_remaining": 0})
+        it, name, mf = writer._commits()[0]
+        assert it == 1
+        key = writer._key("bundles", name, mf["prefix"], "model.zip")
+        client.put(key, client.get(key)[: 100])    # tear it
+        reader = self._store(client)
+        got = reader.latest_valid()
+        assert got is not None
+        assert os.path.basename(got) == os.path.basename(good)
+
+    def test_chaos_store_every_op_retries(self, monkeypatch,
+                                          metrics_on):
+        """DL4J_TPU_CHAOS_STORE_ERROR_RATE=1: the first attempt of
+        every (op, key) fails, the retry succeeds — a full write +
+        restore round-trip completes with every bundle op retried at
+        least once, all counted in ft_bundle_io_retries_total."""
+        monkeypatch.setenv("DL4J_TPU_CHAOS_STORE_ERROR_RATE", "1")
+        net = small_net()
+        store = self._store(resilience.InMemoryObjectStore(),
+                            ns="chaotic")
+        assert isinstance(store.client, chaos.FaultyObjectStore)
+        before = counter_total(telemetry.FT_BUNDLE_IO_RETRIES)
+        path = store.write(net, {"rng": [0], "epochs_remaining": 0})
+        assert store.latest_valid() == path
+        assert store.client.injected >= 3   # puts + commit + reads
+        assert counter_total(telemetry.FT_BUNDLE_IO_RETRIES) \
+            - before >= 3
+        inj = counter_total(telemetry.CHAOS_INJECTED)
+        assert inj >= 3
+
+    def test_chaos_torn_puts_retry_to_whole_blobs(self, monkeypatch):
+        """DL4J_TPU_CHAOS_STORE_TORN_RATE=1: every first put uploads
+        half the payload and errors; the retried put overwrites whole
+        (last-write-wins) — a fresh reader restores digest-valid."""
+        monkeypatch.setenv("DL4J_TPU_CHAOS_STORE_TORN_RATE", "1")
+        net = small_net()
+        client = resilience.InMemoryObjectStore()
+        store = self._store(client, ns="torn")
+        store.write(net, {"rng": [0], "epochs_remaining": 0})
+        assert store.client.injected >= 3
+        monkeypatch.delenv("DL4J_TPU_CHAOS_STORE_TORN_RATE")
+        reader = self._store(client, ns="torn")
+        assert reader.latest_valid() is not None
+
+    def test_retire_is_cluster_authoritative(self):
+        """After retire(), NO reader may resume — not even one whose
+        local cache still holds a stale materialized copy: a
+        reachable store with zero commits is authoritative."""
+        net = small_net()
+        client = resilience.InMemoryObjectStore()
+        writer = self._store(client)
+        writer.write(net, {"rng": [0], "epochs_remaining": 0})
+        reader = self._store(client)
+        assert reader.latest_valid() is not None   # cache warmed
+        writer.retire()
+        assert writer._commits() == []
+        assert reader.latest_valid() is None       # stale cache loses
+
+    def test_ft_accepts_object_store_and_prunes_remote(self,
+                                                       tmp_path):
+        """The FaultTolerance bundle_store= knob takes the object
+        store (cache dir anchors checkpoint_dir), and LocalObjectStore
+        gives two 'hosts' a shared bucket with keep_last enforced
+        remotely — commit first to delete, blobs swept after."""
+        store = self._store(
+            resilience.LocalObjectStore(tmp_path / "bucket"))
+        ft = FaultTolerance(bundle_store=store, divergence_window=0)
+        assert ft.checkpoint_dir == store.directory
+        assert ft.store() is store
+        net = small_net()
+        for i in range(3):
+            net._iteration = i
+            store.write(net, {"rng": [0], "epochs_remaining": 0},
+                        keep_last=2)
+        assert [it for it, _, _ in store._commits()] == [2, 1]
+        # pruned bundles' blobs are gone from the bucket too
+        stale = [k for k in store.client.list("job-1/bundles/")
+                 if "/bundle-0000000000/" in k]
+        assert stale == []
 
 
 def _fake_bundle(directory, iteration, expected_shards=None,
