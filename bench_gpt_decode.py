@@ -483,9 +483,20 @@ def scale_ab(m, params, n_prompts=6, prompt=64, new=16, slots=4,
     doubles as the compile warmup), so the same utilization story
     holds on any backend. Token identity vs solo generate() rides
     along over the whole run (the prompt pool is small enough to
-    pre-compute every solo answer)."""
+    pre-compute every solo answer).
+
+    The run doubles as a cross-check of the embedded time-series
+    store: a private Sampler records the TTFT histogram while traffic
+    flows, and the recovery is re-derived from
+    ``query_range(max(histogram_quantile(0.99, ...ttft...[w])))``
+    alone — if the TSDB replay disagrees with the exact-event
+    measurement beyond the sampling slack, the store (or its quantile
+    math) is lying about exactly the incident it was built to explain
+    (``tsdb_recovery_agrees``)."""
     import threading
 
+    from deeplearning4j_tpu.profiler import telemetry as _telemetry
+    from deeplearning4j_tpu.profiler import timeseries as _ts
     from deeplearning4j_tpu.serving.fleet import ServingFleet
 
     rng = np.random.default_rng(7)
@@ -494,6 +505,18 @@ def scale_ab(m, params, n_prompts=6, prompt=64, new=16, slots=4,
     solo = [np.asarray(m.generate(
         params, jnp.asarray(p[None, :], jnp.int32), new))[0]
         for p in pool]
+
+    # TSDB cross-check wiring: TTFT observations need telemetry on,
+    # and a PRIVATE store/sampler keeps the A/B independent of any
+    # process-wide default (DL4J_TPU_TSDB can stay off)
+    _telem_was = _telemetry.enabled()
+    _telemetry.set_enabled(True)
+    ts_interval, ts_window = 0.2, 2.0
+    tsdb = _ts.TimeSeriesDB()
+    sampler = _ts.Sampler(db=tsdb, interval_s=ts_interval).start()
+    t_run_wall = time.time()
+    t_step_wall = [None]        # wall clock at the load step
+    t_scale_wall = [None]       # wall clock at the scale-up trigger
 
     need = prompt + new
     fl = ServingFleet(
@@ -516,11 +539,13 @@ def scale_ab(m, params, n_prompts=6, prompt=64, new=16, slots=4,
         svc = (time.perf_counter() - t0) / (2 * slots)
         arrival_before = svc / util_before
         arrival_step = svc / util_step
+        sampler.tick_once()     # pre-BEFORE sample for the replay
 
         t_scale = [None, None]      # [trigger, replica live]
 
         def grow():
             t_scale[0] = time.perf_counter()
+            t_scale_wall[0] = time.time()
             fl.add_replica()
             t_scale[1] = time.perf_counter()
 
@@ -540,14 +565,28 @@ def scale_ab(m, params, n_prompts=6, prompt=64, new=16, slots=4,
             return grower
 
         open_loop(n_before, arrival_before, "before")
+        # bracket the BEFORE phase with a deterministic sample and
+        # hold one sampling interval so a range-grid point lands
+        # between it and the load step — the replay keeps a baseline
+        # p99 even when the phase is shorter than the cadence
+        sampler.tick_once()
+        time.sleep(ts_interval)
+        t_step_wall[0] = time.time()
         grower = open_loop(n_during, arrival_step, "step",
                            trigger_at=max(1, int(n_during
                                                  * scale_frac)))
         outs = [h.result(timeout=600) for h in handles]
         if grower is not None:
             grower.join(600)
+        # one last tick so first-token events that landed between the
+        # final periodic sample and now are in the store
+        sampler.tick_once()
+        t_end_wall = time.time()
     finally:
         fl.shutdown()
+        sampler.shutdown()
+        if not _telem_was:
+            _telemetry.set_enabled(False)
     if t_scale[1] is None:
         raise RuntimeError("scale_ab: add_replica never completed")
 
@@ -570,6 +609,32 @@ def scale_ab(m, params, n_prompts=6, prompt=64, new=16, slots=4,
            if sub + t >= t_scale[0] and t > tol]
     recovery = (max(bad) - t_scale[0]) if bad else 0.0
 
+    # --- TSDB replay: re-derive the recovery from the sampled TTFT
+    # histogram alone (PromQL-lite over the private store), then gate
+    # agreement against the exact-event measurement above
+    expr = ("max (histogram_quantile(0.99, "
+            f"dl4j_tpu_serving_ttft_seconds[{ts_window}s]))")
+    pts = []
+    for _labels, spts in _ts.query_range(
+            expr, t_run_wall, t_end_wall, ts_interval, db=tsdb):
+        pts.extend(spts)
+    pts.sort()
+    # baseline from the store's own estimator — bucket-interpolated
+    # p99 aliases on bucket edges, so comparing it against the exact-
+    # sample tol would flag steady traffic as degraded
+    base = [v for t, v in pts if t < t_step_wall[0]]
+    trig = t_scale_wall[0]
+    tsdb_recovery = agrees = None
+    if base and trig is not None:
+        ts_tol = 1.5 * max(base)
+        bad_t = [t for t, v in pts if t >= trig and v > ts_tol]
+        tsdb_recovery = (max(bad_t) - trig) if bad_t else 0.0
+        # a bad first token stays inside the rolling [w] window for up
+        # to w after it happened, plus a tick of sampler latency
+        slack = ts_window + 2 * ts_interval
+        agrees = bool(abs(tsdb_recovery - recovery)
+                      <= max(slack, 0.35 * recovery))
+
     return {
         "requests": len(handles),
         "slots": slots,
@@ -581,6 +646,10 @@ def scale_ab(m, params, n_prompts=6, prompt=64, new=16, slots=4,
         "after_ttft_p99_ms": round(_p(after, 99) * 1e3, 3),
         "scaleup_engine_ready_s": round(t_scale[1] - t_scale[0], 3),
         "scaleup_p99_recovery_s": round(recovery, 3),
+        "tsdb_samples": sampler.ticks,
+        "tsdb_recovery_s": (round(tsdb_recovery, 3)
+                            if tsdb_recovery is not None else None),
+        "tsdb_recovery_agrees": agrees,
         "token_agreement": round(agree, 3),
     }
 
@@ -880,7 +949,10 @@ def main():
                          "open-loop traffic steps past one replica's "
                          "capacity, add_replica() fires mid-burst, "
                          "TTFT p99 before/during/after plus "
-                         "scaleup_p99_recovery_s")
+                         "scaleup_p99_recovery_s, cross-checked "
+                         "against a query_range replay from the "
+                         "embedded time-series store "
+                         "(tsdb_recovery_agrees)")
     ap.add_argument("--kv-ab", action="store_true",
                     help="also run the KV-path A/B: einsum attention "
                          "vs the Pallas paged-attention kernel, and "

@@ -39,6 +39,11 @@ any host that mounts it can participate)::
         task.json             supervisor -> worker (the assignment)
         notice.json           supervisor -> worker (maintenance event)
         result-<task>.json    worker -> supervisor (outcome)
+        metrics.json          worker -> supervisor (federated registry
+                              capture, when DL4J_TPU_TSDB=1 — ingested
+                              into the coordinator's time-series store
+                              under worker=/host= labels; see
+                              profiler/timeseries.py)
         worker.log            the process's stdout+stderr
 
 Multi-host meshes ride the existing ``jax.distributed`` seam: a
@@ -75,6 +80,7 @@ log = logging.getLogger("deeplearning4j_tpu")
 HEARTBEAT = "heartbeat.json"
 TASK = "task.json"
 NOTICE = "notice.json"
+METRICS = "metrics.json"
 
 #: task outcomes a worker reports
 OUTCOMES = ("completed", "preempted", "failed")
@@ -183,16 +189,22 @@ def echo_task(ctx: WorkerTaskContext) -> Dict[str, Any]:
 def spin_task(ctx: WorkerTaskContext) -> Dict[str, Any]:
     """Built-in drill task: spins for ``seconds`` (default: forever),
     draining early on a preemption notice — the no-jax way to exercise
-    notices, SIGKILL-mid-task, and migration."""
+    notices, SIGKILL-mid-task, and migration. Each step also ticks a
+    counter in THIS process's registry, so federation drills have a
+    worker-side series to watch arrive coordinator-side."""
     deadline = (time.monotonic() + float(ctx.params["seconds"])
                 if "seconds" in ctx.params else None)
     step = 0
+    drill = _telemetry.MetricsRegistry.get_default().counter(
+        "dl4j_tpu_worker_drill_steps_total",
+        "spin_task steps (metric-federation drill)")
     while deadline is None or time.monotonic() < deadline:
         if ctx.preemption_requested:
             ctx.drained = True
             return {"drained_at_step": step}
         step += 1
         ctx.progress(step)
+        drill.inc()
         time.sleep(0.02)
     return {"steps": step}
 
@@ -201,17 +213,23 @@ class _WorkerMain:
     """The worker process body: heartbeat thread + task/notice loop."""
 
     def __init__(self, control_dir: str, name: str,
-                 heartbeat_s: float = 0.2):
+                 heartbeat_s: float = 0.2, metrics_s: float = 0.5):
         self.dir = os.path.join(control_dir, name)
         os.makedirs(self.dir, exist_ok=True)
         self.name = name
         self.heartbeat_s = float(heartbeat_s)
+        self.metrics_s = float(metrics_s)
         self._lock = threading.Lock()
         self._state = {"state": "idle", "task": None, "step": 0}
         self._seq = 0
         self._stop = threading.Event()
         self._ft = None           # the running task's policy
         self._done_tasks: set = set()
+        #: metric federation rides the heartbeat loop, gated on the
+        #: inherited DL4J_TPU_TSDB opt-in (checked once here so an
+        #: off-mode worker never imports the timeseries module)
+        self._metrics_on = os.environ.get(
+            "DL4J_TPU_TSDB", "0") not in ("0", "", "false")
 
     # -------------------------------------------------------- heartbeat
     def _beat_once(self) -> None:
@@ -222,12 +240,42 @@ class _WorkerMain:
         _write_json_atomic(os.path.join(self.dir, HEARTBEAT), payload)
 
     def _beat_loop(self) -> None:
+        next_metrics = 0.0
         while not self._stop.is_set():
             try:
                 self._beat_once()
             except OSError:
                 pass              # control dir hiccup: next beat retries
+            if self._metrics_on \
+                    and time.monotonic() >= next_metrics:
+                next_metrics = time.monotonic() + self.metrics_s
+                self._publish_metrics()
             self._stop.wait(self.heartbeat_s)
+
+    def _publish_metrics(self) -> None:
+        """Federate this process's registry: an encoded capture next
+        to the heartbeat, atomically replaced each cadence — the
+        supervisor ingests it into the coordinator's time-series
+        store under ``worker=``/``host=`` labels. Never raises (a
+        full control volume must not kill the heartbeat loop)."""
+        try:
+            import socket
+
+            from deeplearning4j_tpu.profiler import timeseries as _ts
+
+            if not _ts.enabled():
+                return
+            cap = _telemetry.MetricsRegistry.get_default().capture()
+            if not cap:
+                return
+            _write_json_atomic(
+                os.path.join(self.dir, METRICS),
+                {"worker": self.name, "host": socket.gethostname(),
+                 "t": time.time(),
+                 "capture": _ts.encode_capture(cap)})
+        except Exception:
+            log.debug("worker %s: metrics publish failed", self.name,
+                      exc_info=True)
 
     def _set(self, **kw) -> None:
         with self._lock:
@@ -417,6 +465,8 @@ class _WorkerHandle:
         #: the worker was down (crash OR drain) since its last alive —
         #: the next first-heartbeat must restore fleet capacity
         self.was_down = False
+        #: newest federated metrics.json timestamp already ingested
+        self.last_metrics_t = 0.0
 
     def beat_age(self) -> float:
         return time.monotonic() - self.last_seen
@@ -547,7 +597,7 @@ class WorkerSupervisor:
         h = self._handles[name]
         os.makedirs(h.dir, exist_ok=True)
         # never let a new incarnation act on the previous one's inputs
-        for fname in (TASK, NOTICE, HEARTBEAT):
+        for fname in (TASK, NOTICE, HEARTBEAT, METRICS):
             try:
                 os.remove(os.path.join(h.dir, fname))
             except OSError:
@@ -679,6 +729,7 @@ class WorkerSupervisor:
                 h.last_beat = beat
                 if h.state == "starting":
                     self._on_worker_alive(h)
+            self._ingest_worker_metrics(h)
             self._collect_result(h)
             rc = h.proc.poll()
             if rc is not None:
@@ -722,6 +773,36 @@ class WorkerSupervisor:
                     h.proc.kill()
                 except OSError:
                     pass
+
+    def _ingest_worker_metrics(self, h: _WorkerHandle) -> None:
+        """Hand a fresh worker ``metrics.json`` to the coordinator's
+        time-series sampler (``Sampler.ingest_remote``), which merges
+        it into each tick under ``worker=``/``host=`` labels so range
+        queries and SLO rules see the whole cluster. sys.modules-
+        guarded: a supervisor in a TSDB-off process never imports
+        (let alone feeds) the store."""
+        _ts = sys.modules.get(
+            "deeplearning4j_tpu.profiler.timeseries")
+        if _ts is None:
+            return
+        sampler = _ts.default_sampler()
+        if sampler is None:
+            return
+        obj = _read_json(os.path.join(h.dir, METRICS))
+        if not obj:
+            return
+        try:
+            t = float(obj.get("t", 0.0))
+        except (TypeError, ValueError):
+            return
+        if t <= h.last_metrics_t:
+            return                 # already ingested this capture
+        cap = _ts.decode_capture(obj.get("capture") or {})
+        if not cap:
+            return
+        h.last_metrics_t = t
+        sampler.ingest_remote(cap, worker=h.name,
+                              host=obj.get("host"), t=t)
 
     def _collect_result(self, h: _WorkerHandle) -> None:
         task = h.task

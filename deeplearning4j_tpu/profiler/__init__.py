@@ -37,11 +37,11 @@ from deeplearning4j_tpu.profiler.model_health import HealthMonitor
 
 
 def __getattr__(name):
-    # slo/programs are LAZY attributes (PEP 562): the fit loops and
-    # serving engines import this package for telemetry, and the
-    # off-mode contract is that they never pull in the SLO engine or
-    # the program registry
-    if name in ("slo", "programs"):
+    # slo/programs/timeseries are LAZY attributes (PEP 562): the fit
+    # loops and serving engines import this package for telemetry, and
+    # the off-mode contract is that they never pull in the SLO engine,
+    # the program registry, or the time-series store
+    if name in ("slo", "programs", "timeseries"):
         import importlib
 
         return importlib.import_module(
@@ -227,4 +227,5 @@ def trace(log_dir: str):
 __all__ = ["OpProfiler", "ProfilerConfig", "ProfilerMode",
            "NumericsException", "check_numerics", "start_trace",
            "stop_trace", "trace", "telemetry", "HealthMonitor",
-           "tracing", "flight_recorder", "slo", "programs"]
+           "tracing", "flight_recorder", "slo", "programs",
+           "timeseries"]

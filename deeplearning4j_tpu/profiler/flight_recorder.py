@@ -21,7 +21,10 @@ incident happens the last N minutes are already on disk. Here:
   ``requests.json`` (live + recent request timelines from tracing.py),
   ``programs.json`` (the roofline program-registry snapshot, present
   when populated — profiler/programs.py; managed device captures also
-  record a ``profile_capture{trigger,bundle}`` event here)
+  record a ``profile_capture{trigger,bundle}`` event here),
+  ``metrics.json`` (the last N minutes of every metric series from the
+  time-series store, present when DL4J_TPU_TSDB has a sampler live —
+  profiler/timeseries.py)
   and a ``manifest.json`` with sha256 digests of every member —
   written into a dot-tmp dir, fsynced, then renamed into place
   (the same crash-atomic recipe as resilience.write_bundle). The
@@ -239,6 +242,21 @@ class FlightRecorder:
             if psnap:
                 _write("programs.json", json.dumps(_sanitize(psnap)))
                 members.append("programs.json")
+            # metrics history rides along when the time-series store
+            # is live (profiler/timeseries.py): the last N minutes of
+            # every series — the "why", next to the events' "what".
+            # sys.modules-guarded: a dump must not import (let alone
+            # start) the TSDB in a process that never enabled it.
+            try:
+                _ts = sys.modules.get(
+                    "deeplearning4j_tpu.profiler.timeseries")
+                msnap = (_ts.metrics_history_snapshot()
+                         if _ts is not None else {})
+            except Exception:
+                msnap = {}
+            if msnap:
+                _write("metrics.json", json.dumps(msnap))
+                members.append("metrics.json")
             _write("manifest.json", json.dumps({
                 "format": _FORMAT,
                 "reason": reason,
@@ -286,7 +304,7 @@ def load_dump(path: str) -> Dict[str, Any]:
     out: Dict[str, Any] = {"path": path, "valid": False,
                            "manifest": None, "events": [],
                            "trace": None, "requests": None,
-                           "programs": None}
+                           "programs": None, "metrics": None}
     try:
         with open(os.path.join(path, "manifest.json")) as f:
             manifest = json.load(f)
@@ -309,6 +327,9 @@ def load_dump(path: str) -> Dict[str, Any]:
         if "programs.json" in (out["manifest"].get("digests") or {}):
             with open(os.path.join(path, "programs.json")) as f:
                 out["programs"] = json.load(f)
+        if "metrics.json" in (out["manifest"].get("digests") or {}):
+            with open(os.path.join(path, "metrics.json")) as f:
+                out["metrics"] = json.load(f)
     except (OSError, ValueError):
         out["valid"] = False
     return out

@@ -12,7 +12,11 @@ external scraper. The pieces:
 
 - **Snapshot ring.** ``SLOEngine`` captures the whole
   ``MetricsRegistry`` (``registry.capture()``) every ``interval_s`` on
-  a background thread ("SLOEvaluator") into a bounded ring. Counter
+  a background thread ("SLOEvaluator") into a bounded ring — or, when
+  attached to the shared ``profiler/timeseries.py`` sampler
+  (``attach_sampler``), receives the TSDB's capture instead: one
+  ``registry.capture()`` per tick for both consumers, and the ring
+  sees the same federated ``worker=`` series range queries do. Counter
   windows are value DELTAS between two snapshots (a counter reset —
   e.g. an engine restart — clamps at 0, never negative); histogram
   windows are cumulative-bucket-count deltas, so the windowed quantile
@@ -81,6 +85,7 @@ from typing import (
 
 from deeplearning4j_tpu.profiler import flight_recorder as _flight
 from deeplearning4j_tpu.profiler import telemetry as _telemetry
+from deeplearning4j_tpu.profiler.timeseries import histogram_quantile
 
 log = logging.getLogger("deeplearning4j_tpu")
 
@@ -94,30 +99,9 @@ Selector = Union[str, Tuple[str, Dict[str, str]]]
 
 
 # ---------------------------------------------------------------- math
-def histogram_quantile(bounds: Sequence[float],
-                       counts: Sequence[float], q: float) \
-        -> Optional[float]:
-    """Prometheus-style quantile over NON-cumulative bucket counts
-    (``counts`` has ``len(bounds) + 1`` entries; the last is the +Inf
-    overflow). Linear interpolation inside the winning bucket; the
-    +Inf bucket clamps to the top finite bound (the same convention
-    ``histogram_quantile()`` uses). None on an empty window."""
-    total = sum(counts)
-    if total <= 0:
-        return None
-    rank = q * total
-    cum = 0.0
-    for i, c in enumerate(counts):
-        if c <= 0:
-            continue
-        prev_cum, cum = cum, cum + c
-        if cum >= rank:
-            if i >= len(bounds):          # +Inf bucket
-                return float(bounds[-1])
-            lo = float(bounds[i - 1]) if i > 0 else 0.0
-            hi = float(bounds[i])
-            return lo + (hi - lo) * (rank - prev_cum) / c
-    return float(bounds[-1])
+# histogram_quantile is imported from profiler/timeseries.py — the one
+# windowed-quantile definition the SLO engine, the TSDB's PromQL-lite
+# evaluator, and external scrapers all share.
 
 
 def _match(labels: Dict[str, str], where: Dict[str, str]) -> bool:
@@ -575,6 +559,7 @@ class SLOEngine:
                  profile_duration_s: float = 0.25,
                  profile_min_interval_s: float = 120.0,
                  profile_dir: Optional[str] = None,
+                 sampler: Optional[Any] = None,
                  make_default: bool = True):
         self.registry = (registry if registry is not None
                          else _telemetry.MetricsRegistry.get_default())
@@ -605,9 +590,16 @@ class SLOEngine:
         self._ring = _Ring(self._ring_capacity())
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        #: when attached to a profiler/timeseries.py Sampler, its tick
+        #: drives evaluation with the SHARED capture — one
+        #: registry.capture() per tick for TSDB + SLO, not two
+        self._sampler: Optional[Any] = None
+        self._sampler_cb: Optional[Callable] = None
         self.ticks = 0
         if make_default:
             install(self)
+        if sampler is not None:
+            self.attach_sampler(sampler)
 
     # ------------------------------------------------------------ rules
     def _ring_capacity(self) -> int:
@@ -654,8 +646,29 @@ class SLOEngine:
         return fn
 
     # -------------------------------------------------------- lifecycle
+    def attach_sampler(self, sampler) -> "SLOEngine":
+        """Re-base this engine onto a ``profiler/timeseries.py``
+        Sampler: its tick delivers the shared capture (federated
+        worker series included) and drives evaluation — ``start()``
+        then spawns NO "SLOEvaluator" thread. No-op if this engine is
+        already sampler-attached or its own thread is running (a live
+        evaluator must not double-tick)."""
+        with self._lock:
+            if self._sampler is not None \
+                    or (self._thread is not None
+                        and self._thread.is_alive()):
+                return self
+            cb = (lambda t_mono, _t_wall, cap:
+                  self.tick(now=t_mono, capture=cap))
+            self._sampler = sampler
+            self._sampler_cb = cb
+        sampler.subscribe(cb)
+        return self
+
     def start(self) -> "SLOEngine":
         with self._lock:
+            if self._sampler is not None:
+                return self        # the shared sampler drives ticks
             if self._thread is not None:
                 return self
             if self._stop.is_set():
@@ -667,6 +680,11 @@ class SLOEngine:
 
     def shutdown(self, timeout: float = 10.0) -> None:
         self._stop.set()
+        with self._lock:
+            sampler, cb = self._sampler, self._sampler_cb
+            self._sampler = self._sampler_cb = None
+        if sampler is not None and cb is not None:
+            sampler.unsubscribe(cb)
         t = self._thread
         if t is not None:
             t.join(timeout)
@@ -695,13 +713,17 @@ class SLOEngine:
             self._stop.wait(self.interval_s)
 
     # ------------------------------------------------------- evaluation
-    def tick(self, now: Optional[float] = None) -> None:
+    def tick(self, now: Optional[float] = None,
+             capture: Optional[Dict[str, Any]] = None) -> None:
         """Capture one registry snapshot and evaluate every rule.
         ``now`` (monotonic seconds) is injectable so tests walk the
-        pending->firing->resolved lifecycle with a fake clock."""
+        pending->firing->resolved lifecycle with a fake clock.
+        ``capture`` injects an already-taken snapshot (the shared
+        TSDB sampler's) instead of capturing again — the dedupe that
+        keeps two consumers at ONE ``registry.capture()`` per tick."""
         if now is None:
             now = time.monotonic()
-        cap = self.registry.capture()
+        cap = capture if capture is not None else self.registry.capture()
         with self._lock:
             self._ring.append(now, cap)
             self.ticks += 1

@@ -663,6 +663,19 @@ def retire_engine_series(engine_id: str) -> int:
         "engine", eid, kinds=("gauge",))
     with _retired_lock:
         _retired_engines.add(eid)
+    # tombstone the same series in the time-series store, if one is
+    # live in this process — without this a removed replica's gauges
+    # keep answering instant/range queries at their last reading for
+    # the whole staleness lookback (the zombie the registry expiry
+    # above exists to kill). sys.modules-guarded: retiring an engine
+    # must not pay the import when nothing ever enabled the TSDB.
+    import sys
+    _ts = sys.modules.get("deeplearning4j_tpu.profiler.timeseries")
+    if _ts is not None:
+        try:
+            _ts.tombstone_series("engine", eid, kinds=("gauge",))
+        except Exception:
+            pass
     return n
 
 
@@ -1037,6 +1050,16 @@ def snapshot() -> Dict[str, Any]:
         pr = _programs.snapshot()
         if pr:
             out["programs"] = pr
+    except Exception:
+        pass
+    # time-series store (lazy + peek-style: {} unless DL4J_TPU_TSDB
+    # opted a sampler in — see profiler/timeseries.py)
+    try:
+        from deeplearning4j_tpu.profiler import timeseries as _ts
+
+        th = _ts.snapshot()
+        if th:
+            out["timeseries"] = th
     except Exception:
         pass
     m = reg.peek(PROFILE_CAPTURES)

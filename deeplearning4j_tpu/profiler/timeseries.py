@@ -1,0 +1,1090 @@
+"""Embedded time-series store: metrics history, PromQL-lite queries,
+and worker metric federation.
+
+Every earlier observability layer is point-in-time: ``/metrics`` shows
+"now", the SLO engine's snapshot ring is private, PR 15 worker OS
+processes are metric-invisible to their coordinator, and an incident
+dump says *what* fired with no history to show *why*. This module
+closes those gaps with a bounded in-process TSDB:
+
+- **Sampler.** One daemon thread ("TSDBSampler") captures the whole
+  ``MetricsRegistry`` (``registry.capture()``) at a fixed cadence and
+  ingests it into the store. The capture is SHARED: the SLO engine
+  subscribes to the sampler (``SLOEngine.attach_sampler``) instead of
+  capturing privately, so two consumers cost one ``capture()`` per
+  tick, and both see exactly the same snapshot (federated worker
+  series included).
+- **Store.** Per-series rings with downsampling tiers — raw (sampler
+  cadence, ~10 min), 10 s (~1 h), 1 m (~24 h) — each tier keeps the
+  LAST sample per aligned bucket (counters/gauges are level signals;
+  last-wins loses no monotonic information and rate() still sees every
+  reset that survives a tier's resolution). Histograms store the full
+  ``(count, sum, bucket-counts)`` capture tuple per point, so windowed
+  quantiles are exact bucket-delta reads at any tier. Retention is
+  ring-bounded per tier; memory is O(series x points), independent of
+  runtime.
+- **PromQL-lite.** ``query()`` / ``query_range()`` evaluate a small
+  expression grammar over the store:
+
+  ``name{label="v",l2!="v",l3=~"regex",l4!~"re"}``
+      instant vector — the newest sample per matching series within
+      the staleness lookback (300 s), tombstoned series excluded.
+  ``rate(sel[30s])``
+      per-second increase over the window, counter-reset clamped
+      (a reset contributes the post-reset value, never a negative) —
+      the SAME delta semantics as the SLO engine's ``Rate`` rule.
+      Needs >= 2 samples in the window. ``increase(sel[d])`` is the
+      un-divided sum. On a histogram name, rates the cumulative count.
+  ``histogram_quantile(0.99, name[60s])``
+      windowed quantile over the PR 14 cumulative-bucket deltas
+      (reset-clamped per pair of adjacent samples), computed by the
+      ONE ``histogram_quantile()`` definition this module now owns
+      and ``profiler/slo.py`` imports.
+  ``avg by (engine) (expr)`` / ``sum``/``max``/``min``
+      label-grouped aggregation over any of the above.
+
+  ``name_count`` / ``name_sum`` resolve against a histogram series'
+  cumulative count/sum (so ``rate(x_count[30s])`` is request rate and
+  ``rate(x_sum[30s]) / rate(x_count[30s])`` is mean latency), exactly
+  like the ``_count``/``_sum`` series ``to_prometheus`` exports.
+- **Federation.** A PR 15 worker process publishes periodic encoded
+  captures through its file-lease control dir (``metrics.json`` next
+  to ``heartbeat.json``; ``push_metrics()`` is the HTTP fallback, like
+  spans). The coordinator's WorkerSupervisor hands them to the
+  sampler (``Sampler.ingest_remote``), which merges every fresh remote
+  capture into each tick's snapshot under added ``worker=``/``host=``
+  labels — so range queries AND SLO rules see the whole cluster, not
+  just the coordinator process.
+- **Tombstones.** ``telemetry.retire_engine_series`` tombstones the
+  dead engine's gauge series here too (``tombstone_series``): history
+  BEFORE the tombstone stays queryable, instant reads at or after it
+  return nothing — a removed replica stops flat-lining in range
+  queries instead of ghosting at its last value for the lookback.
+- **Black box.** ``metrics_history_snapshot()`` exports the last N
+  minutes of every series; ``profiler/flight_recorder.py`` embeds it
+  as a digest-valid ``metrics.json`` member in every incident dump.
+
+Served as ``GET /v1/query`` + ``GET /v1/query_range`` (Prometheus
+HTTP API response shape) on both ``ui/server.py`` and
+``remote/server.py``, with ``POST /v1/metrics/push`` for federation.
+
+Off by default: ``DL4J_TPU_TSDB=0`` (the default) means
+``ensure_default()`` is a no-op — zero sampler threads, zero ingest,
+serving token-identical and fit loops bit-identical. ``=1`` opts in.
+Overhead when on: one ``registry.capture()`` per cadence (shared with
+the SLO engine) plus O(series) deque appends on the sampler thread —
+nothing on any training or serving hot path.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import logging
+import math
+import os
+import re
+import threading
+import time
+import urllib.parse
+import urllib.request
+from typing import (
+    Any, Callable, Dict, List, Optional, Sequence, Tuple,
+)
+
+from deeplearning4j_tpu.profiler import telemetry as _telemetry
+
+log = logging.getLogger("deeplearning4j_tpu")
+
+LabelKey = Tuple[Tuple[str, str], ...]
+
+#: staleness lookback for instant vectors (Prometheus convention)
+LOOKBACK_S = 300.0
+#: downsampling tiers: (bucket resolution seconds, points kept).
+#: 0 = raw (sampler cadence). At the default 1 s cadence: ~10 min raw,
+#: ~1 h at 10 s, ~24 h at 1 m.
+TIERS = ((0.0, 600), (10.0, 360), (60.0, 1440))
+#: a worker capture older than this is ignored at merge time (a dead
+#: or wedged worker must not freeze its last reading into every tick)
+REMOTE_TTL_S = 15.0
+
+_ENV = "DL4J_TPU_TSDB"
+_enabled = os.environ.get(_ENV, "0") not in ("0", "", "false")
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def set_enabled(on: bool) -> None:
+    global _enabled
+    _enabled = bool(on)
+
+
+# ---------------------------------------------------------------- math
+def histogram_quantile(bounds: Sequence[float],
+                       counts: Sequence[float], q: float) \
+        -> Optional[float]:
+    """Prometheus-style quantile over NON-cumulative bucket counts
+    (``counts`` has ``len(bounds) + 1`` entries; the last is the +Inf
+    overflow). Linear interpolation inside the winning bucket; the
+    +Inf bucket clamps to the top finite bound. None on an empty
+    window. This is the ONE quantile definition in the repo —
+    ``profiler/slo.py`` imports it, external scrapers reproduce it
+    from the exported ``_bucket`` series."""
+    total = sum(counts)
+    if total <= 0:
+        return None
+    rank = q * total
+    cum = 0.0
+    for i, c in enumerate(counts):
+        if c <= 0:
+            continue
+        prev_cum, cum = cum, cum + c
+        if cum >= rank:
+            if i >= len(bounds):          # +Inf bucket
+                return float(bounds[-1])
+            lo = float(bounds[i - 1]) if i > 0 else 0.0
+            hi = float(bounds[i])
+            return lo + (hi - lo) * (rank - prev_cum) / c
+    return float(bounds[-1])
+
+
+# ---------------------------------------------------------------- store
+class _Series:
+    """One (metric, label-set)'s tiered history. Scalar series store
+    floats; histogram series store (count, sum, bucket-counts)."""
+
+    __slots__ = ("name", "key", "kind", "bounds", "tiers", "tomb")
+
+    def __init__(self, name: str, key: LabelKey, kind: str,
+                 bounds: Optional[Tuple[float, ...]] = None):
+        self.name = name
+        self.key = key
+        self.kind = kind
+        self.bounds = bounds
+        self.tiers: List[Tuple[float, collections.deque]] = [
+            (res, collections.deque(maxlen=n)) for res, n in TIERS]
+        self.tomb: Optional[float] = None
+
+    def add(self, t: float, v: Any) -> None:
+        for res, dq in self.tiers:
+            if res <= 0:
+                dq.append((t, v))
+            elif dq and int(dq[-1][0] // res) == int(t // res):
+                dq[-1] = (t, v)           # last sample wins the bucket
+            else:
+                dq.append((t, v))
+
+    def samples(self, t0: float, t1: float) -> List[Tuple[float, Any]]:
+        """Merged tier view over [t0, t1]: each finer tier masks the
+        coarser ones over the span it still covers, so a query sees
+        raw-resolution recent history backed by downsampled tails."""
+        out: List[Tuple[float, Any]] = []
+        cut = t1 + 1.0   # finer-tier coverage start; coarser fills below
+        for _res, dq in self.tiers:
+            pts = [p for p in dq if t0 <= p[0] <= t1 and p[0] < cut]
+            out.extend(pts)
+            if dq:
+                cut = min(cut, dq[0][0])
+        out.sort(key=lambda p: p[0])
+        return out
+
+
+class _Matcher:
+    __slots__ = ("label", "op", "value", "rx")
+
+    def __init__(self, label: str, op: str, value: str):
+        self.label, self.op, self.value = label, op, value
+        self.rx = (re.compile(value) if op in ("=~", "!~") else None)
+
+    def ok(self, labels: Dict[str, str]) -> bool:
+        got = labels.get(self.label, "")
+        if self.op == "=":
+            return got == self.value
+        if self.op == "!=":
+            return got != self.value
+        if self.op == "=~":
+            return self.rx.fullmatch(got) is not None
+        return self.rx.fullmatch(got) is None     # !~
+
+
+class TimeSeriesDB:
+    """Bounded multi-tier store of registry captures (module
+    docstring). Thread-safe: the sampler writes, HTTP handlers read."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._series: "collections.OrderedDict[Tuple[str, LabelKey], _Series]" = \
+            collections.OrderedDict()
+
+    # ---------------------------------------------------------- ingest
+    def ingest(self, t: float, cap: Dict[str, Any]) -> None:
+        """One registry capture (``MetricsRegistry.capture()`` shape)
+        at wall-clock ``t``."""
+        with self._lock:
+            for name, m in cap.items():
+                kind = m.get("kind")
+                if kind == "histogram":
+                    bounds = tuple(m["bounds"])
+                    for key, tup in m["series"].items():
+                        self._get(name, key, kind, bounds).add(
+                            t, (float(tup[0]), float(tup[1]),
+                                tuple(tup[2])))
+                elif kind in ("counter", "gauge"):
+                    for key, v in m["values"].items():
+                        self._get(name, key, kind).add(t, float(v))
+
+    def _get(self, name: str, key: LabelKey, kind: str,
+             bounds: Optional[Tuple[float, ...]] = None) -> _Series:
+        s = self._series.get((name, key))
+        if s is None:
+            s = self._series[(name, key)] = _Series(
+                name, key, kind, bounds)
+        elif s.tomb is not None:
+            # the label set came back (a replica slot reused an id):
+            # the series is live again from here on
+            s.tomb = None
+        return s
+
+    # ------------------------------------------------------- tombstone
+    def tombstone(self, label: str, value: str,
+                  kinds: Tuple[str, ...] = ("gauge",),
+                  t: Optional[float] = None) -> int:
+        """Mark every series (of ``kinds``) whose labels carry
+        ``label == value`` dead at ``t``: pre-death history stays
+        queryable, instant reads at/after ``t`` return nothing."""
+        if t is None:
+            t = time.time()
+        n = 0
+        with self._lock:
+            for (_name, key), s in self._series.items():
+                if s.kind not in kinds or s.tomb is not None:
+                    continue
+                if dict(key).get(label) == str(value):
+                    s.tomb = t
+                    n += 1
+        return n
+
+    # --------------------------------------------------------- reading
+    def select(self, name: str, matchers: Sequence[_Matcher],
+               t0: float, t1: float, at: Optional[float] = None) \
+            -> List[Tuple[Dict[str, str], str,
+                          Optional[Tuple[float, ...]],
+                          List[Tuple[float, Any]]]]:
+        """[(labels, kind, bounds, samples in [t0, t1])] for every
+        matching series; ``at`` (the evaluation instant) excludes
+        series tombstoned at or before it."""
+        out = []
+        with self._lock:
+            for (n, key), s in self._series.items():
+                if n != name:
+                    continue
+                if at is not None and s.tomb is not None \
+                        and at >= s.tomb:
+                    continue
+                labels = dict(key)
+                if all(m.ok(labels) for m in matchers):
+                    pts = s.samples(t0, t1)
+                    if pts:
+                        out.append((labels, s.kind, s.bounds, pts))
+        return out
+
+    def series_count(self) -> int:
+        with self._lock:
+            return len(self._series)
+
+    def export(self, window_s: float = 300.0,
+               now: Optional[float] = None,
+               max_series: int = 256) -> Dict[str, Any]:
+        """JSON-serializable slice of the last ``window_s`` seconds of
+        every series — the flight recorder's ``metrics.json`` member.
+        Bounded: at most ``max_series`` series (newest-registered
+        last, which is what gets kept), ring-bounded points each."""
+        if now is None:
+            now = time.time()
+        t0 = now - window_s
+        out: List[Dict[str, Any]] = []
+        with self._lock:
+            items = list(self._series.items())
+        truncated = max(len(items) - max_series, 0)
+        for (name, key), s in items[-max_series:]:
+            pts = s.samples(t0, now)
+            if not pts:
+                continue
+            entry: Dict[str, Any] = {
+                "name": name, "labels": dict(key), "kind": s.kind}
+            if s.kind == "histogram":
+                entry["bounds"] = list(s.bounds or ())
+                entry["points"] = [
+                    [t, [c, sm, list(b)]] for t, (c, sm, b) in pts]
+            else:
+                entry["points"] = [[t, v] for t, v in pts]
+            if s.tomb is not None:
+                entry["tombstone"] = s.tomb
+            out.append(entry)
+        res = {"window_s": window_s, "now": now, "series": out}
+        if truncated:
+            res["series_truncated"] = truncated
+        return res
+
+    def reset(self) -> None:
+        with self._lock:
+            self._series.clear()
+
+
+# --------------------------------------------------------------- parser
+_TOKEN_RE = re.compile(
+    r'\s*(=~|!~|!=|[a-zA-Z_:][a-zA-Z0-9_:]*'
+    r'|\d+\.?\d*(?:[eE][+-]?\d+)?'
+    r'|"(?:[^"\\]|\\.)*"'
+    r'|.)')
+_AGG_OPS = ("sum", "avg", "max", "min")
+_DUR_UNITS = {"s": 1.0, "m": 60.0, "h": 3600.0, "d": 86400.0}
+
+
+class QueryError(ValueError):
+    """Malformed PromQL-lite expression (HTTP 400)."""
+
+
+def _tokenize(text: str) -> List[str]:
+    out, pos = [], 0
+    while pos < len(text):
+        m = _TOKEN_RE.match(text, pos)
+        if m is None:
+            break
+        tok = m.group(1)
+        pos = m.end()
+        if tok.strip():
+            out.append(tok)
+    return out
+
+
+class _Parser:
+    def __init__(self, text: str):
+        self.toks = _tokenize(text)
+        self.i = 0
+
+    def peek(self) -> Optional[str]:
+        return self.toks[self.i] if self.i < len(self.toks) else None
+
+    def next(self) -> str:
+        t = self.peek()
+        if t is None:
+            raise QueryError("unexpected end of query")
+        self.i += 1
+        return t
+
+    def expect(self, tok: str) -> None:
+        got = self.next()
+        if got != tok:
+            raise QueryError(f"expected {tok!r}, got {got!r}")
+
+    # grammar: expr := agg | func | selector
+    def parse(self) -> Tuple:
+        node = self.expr()
+        if self.peek() is not None:
+            raise QueryError(f"trailing tokens at {self.peek()!r}")
+        return node
+
+    def expr(self) -> Tuple:
+        t = self.peek()
+        if t in _AGG_OPS:
+            return self.agg()
+        if t in ("rate", "increase"):
+            op = self.next()
+            self.expect("(")
+            rng = self.range_selector()
+            self.expect(")")
+            return (op, rng)
+        if t == "histogram_quantile":
+            self.next()
+            self.expect("(")
+            q = self.number()
+            self.expect(",")
+            rng = self.range_selector()
+            self.expect(")")
+            if not 0.0 <= q <= 1.0:
+                raise QueryError(f"quantile must be in [0, 1], got {q}")
+            return ("quantile", q, rng)
+        return ("selector",) + self.selector()
+
+    def agg(self) -> Tuple:
+        op = self.next()
+        by: Optional[List[str]] = None
+        if self.peek() == "by":
+            self.next()
+            self.expect("(")
+            by = []
+            while True:
+                by.append(self.ident())
+                if self.peek() == ",":
+                    self.next()
+                    continue
+                break
+            self.expect(")")
+        self.expect("(")
+        inner = self.expr()
+        self.expect(")")
+        return ("agg", op, by, inner)
+
+    def range_selector(self) -> Tuple:
+        name, matchers = self.selector()
+        self.expect("[")
+        dur = self.duration()
+        self.expect("]")
+        return (name, matchers, dur)
+
+    def selector(self) -> Tuple[str, List[_Matcher]]:
+        name = self.ident()
+        matchers: List[_Matcher] = []
+        if self.peek() == "{":
+            self.next()
+            while self.peek() != "}":
+                label = self.ident()
+                op = self.next()
+                if op not in ("=", "!=", "=~", "!~"):
+                    raise QueryError(f"bad label op {op!r}")
+                val = self.string()
+                try:
+                    matchers.append(_Matcher(label, op, val))
+                except re.error as e:
+                    raise QueryError(f"bad regex {val!r}: {e}")
+                if self.peek() == ",":
+                    self.next()
+            self.expect("}")
+        return name, matchers
+
+    def ident(self) -> str:
+        t = self.next()
+        if not re.fullmatch(r"[a-zA-Z_:][a-zA-Z0-9_:]*", t):
+            raise QueryError(f"expected identifier, got {t!r}")
+        return t
+
+    def number(self) -> float:
+        t = self.next()
+        try:
+            return float(t)
+        except ValueError:
+            raise QueryError(f"expected number, got {t!r}")
+
+    def string(self) -> str:
+        t = self.next()
+        if len(t) < 2 or t[0] != '"' or t[-1] != '"':
+            raise QueryError(f"expected string, got {t!r}")
+        return t[1:-1].replace('\\"', '"').replace("\\\\", "\\")
+
+    def duration(self) -> float:
+        n = self.number()
+        if self.peek() in _DUR_UNITS:
+            n *= _DUR_UNITS[self.next()]
+        return n
+
+
+def parse(text: str) -> Tuple:
+    """Parse a PromQL-lite expression to an AST (raises QueryError)."""
+    if not text or not text.strip():
+        raise QueryError("empty query")
+    return _Parser(text).parse()
+
+
+# ------------------------------------------------------------ evaluator
+def _series_for(db: TimeSeriesDB, name: str,
+                matchers: Sequence[_Matcher], t0: float, t1: float,
+                at: float):
+    """Resolve ``name`` to scalar-valued series: direct counters /
+    gauges as-is; ``X_count`` / ``X_sum`` against histogram ``X``'s
+    cumulative count / sum. Returns [(labels, pts-of-float)]."""
+    rows = db.select(name, matchers, t0, t1, at=at)
+    out = [(labels, [(t, v) for t, v in pts])
+           for labels, kind, _b, pts in rows if kind != "histogram"]
+    for suffix, idx in (("_count", 0), ("_sum", 1)):
+        if name.endswith(suffix):
+            base = name[:-len(suffix)]
+            for labels, kind, _b, pts in db.select(
+                    base, matchers, t0, t1, at=at):
+                if kind == "histogram":
+                    out.append((labels,
+                                [(t, v[idx]) for t, v in pts]))
+    return out
+
+
+def _hist_for(db: TimeSeriesDB, name: str,
+              matchers: Sequence[_Matcher], t0: float, t1: float,
+              at: float):
+    return [(labels, bounds, pts)
+            for labels, kind, bounds, pts in db.select(
+                name, matchers, t0, t1, at=at)
+            if kind == "histogram"]
+
+
+def _increase(pts: List[Tuple[float, float]]) -> Optional[float]:
+    """Counter increase over >= 2 samples, reset-clamped: a reset
+    contributes the post-reset value (the counter restarted from 0),
+    never a negative."""
+    if len(pts) < 2:
+        return None
+    inc, prev = 0.0, pts[0][1]
+    for _t, v in pts[1:]:
+        inc += (v - prev) if v >= prev else v
+        prev = v
+    return inc
+
+
+def _bucket_increase(pts) -> Optional[Tuple[float, float, List[float]]]:
+    """(count-delta, sum-delta, per-bucket deltas) over a histogram
+    window, walking adjacent samples so a mid-window counter reset
+    clamps exactly like ``_increase``."""
+    if len(pts) < 2:
+        return None
+    nb = len(pts[0][1][2])
+    dcount = dsum = 0.0
+    dbuckets = [0.0] * nb
+    prev = pts[0][1]
+    for _t, cur in pts[1:]:
+        if cur[0] >= prev[0]:
+            dcount += cur[0] - prev[0]
+            dsum += max(cur[1] - prev[1], 0.0)
+            for i in range(nb):
+                dbuckets[i] += max(cur[2][i] - prev[2][i], 0.0)
+        else:                               # reset: count restarted
+            dcount += cur[0]
+            dsum += cur[1]
+            for i in range(nb):
+                dbuckets[i] += cur[2][i]
+        prev = cur
+    return dcount, dsum, dbuckets
+
+
+def _eval_instant(db: TimeSeriesDB, node: Tuple, t: float) \
+        -> List[Tuple[Dict[str, str], float]]:
+    op = node[0]
+    if op == "selector":
+        _op, name, matchers = node
+        out = []
+        for labels, pts in _series_for(
+                db, name, matchers, t - LOOKBACK_S, t, t):
+            out.append((labels, pts[-1][1]))
+        return out
+    if op in ("rate", "increase"):
+        name, matchers, dur = node[1]
+        out = []
+        for labels, pts in _series_for(
+                db, name, matchers, t - dur, t, t):
+            inc = _increase(pts)
+            if inc is None:
+                continue
+            if op == "rate":
+                dt = pts[-1][0] - pts[0][0]
+                if dt <= 0:
+                    continue
+                inc = inc / dt
+            out.append((labels, inc))
+        # a histogram name with no suffix rates its cumulative count
+        for labels, _bounds, pts in _hist_for(
+                db, name, matchers, t - dur, t, t):
+            d = _bucket_increase(pts)
+            if d is None:
+                continue
+            v = d[0]
+            if op == "rate":
+                dt = pts[-1][0] - pts[0][0]
+                if dt <= 0:
+                    continue
+                v = v / dt
+            out.append((labels, v))
+        return out
+    if op == "quantile":
+        _op, q, (name, matchers, dur) = node
+        out = []
+        for labels, bounds, pts in _hist_for(
+                db, name, matchers, t - dur, t, t):
+            d = _bucket_increase(pts)
+            if d is None or not bounds:
+                continue
+            v = histogram_quantile(bounds, d[2], q)
+            if v is not None:
+                out.append((labels, v))
+        return out
+    if op == "agg":
+        _op, fn, by, inner = node
+        vec = _eval_instant(db, inner, t)
+        groups: Dict[LabelKey, List[float]] = {}
+        for labels, v in vec:
+            if by is None:
+                key: LabelKey = ()
+            else:
+                key = tuple((b, labels.get(b, "")) for b in by)
+            groups.setdefault(key, []).append(v)
+        agg = {"sum": sum, "max": max, "min": min,
+               "avg": lambda vs: sum(vs) / len(vs)}[fn]
+        return [(dict(k), float(agg(vs))) for k, vs in groups.items()]
+    raise QueryError(f"unknown node {op!r}")
+
+
+def query(expr: str, t: Optional[float] = None,
+          db: Optional[TimeSeriesDB] = None) \
+        -> List[Tuple[Dict[str, str], float]]:
+    """Instant query: [(labels, value)] at wall-clock ``t`` (now)."""
+    if db is None:
+        db = default_db()
+    if db is None:
+        return []
+    if t is None:
+        t = time.time()
+    return _eval_instant(db, parse(expr), t)
+
+
+def query_range(expr: str, start: float, end: float, step: float,
+                db: Optional[TimeSeriesDB] = None) \
+        -> List[Tuple[Dict[str, str], List[Tuple[float, float]]]]:
+    """Range query: the instant expression evaluated at each step in
+    [start, end]; series keyed by label set."""
+    if db is None:
+        db = default_db()
+    if db is None:
+        return []
+    if step <= 0:
+        raise QueryError("step must be > 0")
+    if end < start:
+        raise QueryError("end < start")
+    if (end - start) / step > 11_000:
+        raise QueryError("too many steps (limit 11000)")
+    node = parse(expr)
+    acc: "collections.OrderedDict[LabelKey, List[Tuple[float, float]]]" = \
+        collections.OrderedDict()
+    t = start
+    while t <= end + 1e-9:
+        for labels, v in _eval_instant(db, node, t):
+            acc.setdefault(tuple(sorted(labels.items())), []).append(
+                (t, v))
+        t += step
+    return [(dict(k), pts) for k, pts in acc.items()]
+
+
+# ------------------------------------------------------------- sampler
+class Sampler:
+    """The one capture loop: ticks ``registry.capture()`` at
+    ``interval_s``, merges fresh federated worker captures, ingests
+    into the store, and fans the SAME snapshot out to subscribers
+    (the SLO engine) — one capture per tick, however many consumers."""
+
+    THREAD_NAME = "TSDBSampler"
+
+    def __init__(self, db: Optional[TimeSeriesDB] = None,
+                 registry: Optional[_telemetry.MetricsRegistry] = None,
+                 interval_s: float = 1.0,
+                 remote_ttl_s: float = REMOTE_TTL_S):
+        self.db = db if db is not None else TimeSeriesDB()
+        self.registry = (registry if registry is not None
+                         else _telemetry.MetricsRegistry.get_default())
+        self.interval_s = float(interval_s)
+        self.remote_ttl_s = float(remote_ttl_s)
+        self._lock = threading.Lock()
+        self._subs: List[Callable[[float, float, Dict[str, Any]],
+                                  Any]] = []
+        #: worker -> (received_wall_t, extra_labels, capture)
+        self._remote: Dict[str, Tuple[float, Dict[str, str],
+                                      Dict[str, Any]]] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.ticks = 0
+
+    # -------------------------------------------------- subscriptions
+    def subscribe(self, fn: Callable[[float, float, Dict[str, Any]],
+                                     Any]) -> None:
+        """``fn(t_monotonic, t_wall, capture)`` after every tick's
+        ingest — the capture includes merged federated series."""
+        with self._lock:
+            if fn not in self._subs:
+                self._subs.append(fn)
+
+    def unsubscribe(self, fn) -> None:
+        with self._lock:
+            if fn in self._subs:
+                self._subs.remove(fn)
+
+    # ----------------------------------------------------- federation
+    def ingest_remote(self, capture: Dict[str, Any], worker: str,
+                      host: Optional[str] = None,
+                      t: Optional[float] = None) -> None:
+        """Accept one worker-process registry capture (control-dir
+        file or HTTP push). Merged into subsequent ticks under added
+        ``worker=`` / ``host=`` labels while fresher than the TTL."""
+        if t is None:
+            t = time.time()
+        extra = {"worker": str(worker)}
+        if host:
+            extra["host"] = str(host)
+        with self._lock:
+            self._remote[str(worker)] = (t, extra, capture)
+
+    def remote_workers(self) -> List[str]:
+        with self._lock:
+            return sorted(self._remote)
+
+    def _merge_remote(self, cap: Dict[str, Any], now: float) \
+            -> Dict[str, Any]:
+        with self._lock:
+            fresh = [(extra, rcap)
+                     for _w, (t, extra, rcap) in self._remote.items()
+                     if now - t <= self.remote_ttl_s]
+        if not fresh:
+            return cap
+        merged: Dict[str, Any] = {}
+        for name, m in cap.items():
+            if m.get("kind") == "histogram":
+                merged[name] = {"kind": "histogram",
+                                "bounds": m["bounds"],
+                                "series": dict(m["series"])}
+            else:
+                merged[name] = {"kind": m["kind"],
+                                "values": dict(m["values"])}
+        for extra, rcap in fresh:
+            for name, m in rcap.items():
+                kind = m.get("kind")
+                dst = merged.get(name)
+                if dst is None:
+                    dst = merged[name] = (
+                        {"kind": "histogram", "bounds": m["bounds"],
+                         "series": {}}
+                        if kind == "histogram"
+                        else {"kind": kind, "values": {}})
+                if dst["kind"] != kind:
+                    continue            # cross-process kind clash
+                if kind == "histogram":
+                    if tuple(dst["bounds"]) != tuple(m["bounds"]):
+                        continue        # incompatible bucket layouts
+                    for key, tup in m["series"].items():
+                        k2 = tuple(sorted(dict(key, **extra).items()))
+                        dst["series"][k2] = tup
+                else:
+                    for key, v in m["values"].items():
+                        k2 = tuple(sorted(dict(key, **extra).items()))
+                        dst["values"][k2] = v
+        return merged
+
+    # ------------------------------------------------------ lifecycle
+    def tick_once(self, now_mono: Optional[float] = None,
+                  now_wall: Optional[float] = None) -> Dict[str, Any]:
+        """One synchronous sample: capture, merge, ingest, notify.
+        Tests drive the whole pipeline with fake clocks through this."""
+        if now_mono is None:
+            now_mono = time.monotonic()
+        if now_wall is None:
+            now_wall = time.time()
+        cap = self._merge_remote(self.registry.capture(), now_wall)
+        self.db.ingest(now_wall, cap)
+        self.ticks += 1
+        with self._lock:
+            subs = list(self._subs)
+        for fn in subs:
+            try:
+                fn(now_mono, now_wall, cap)
+            except Exception:
+                log.exception("TSDB sampler subscriber failed")
+        return cap
+
+    def start(self) -> "Sampler":
+        with self._lock:
+            if self._thread is not None:
+                return self
+            if self._stop.is_set():
+                raise RuntimeError("sampler has been shut down")
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True, name=self.THREAD_NAME)
+            self._thread.start()
+        return self
+
+    def shutdown(self, timeout: float = 10.0) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout)
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.tick_once()
+            except Exception:
+                log.exception("TSDB sampler tick failed")
+            self._stop.wait(self.interval_s)
+
+
+# --------------------------------------------- capture (de)serialization
+def encode_capture(cap: Dict[str, Any]) -> Dict[str, Any]:
+    """JSON-shaped registry capture (tuple label keys become pair
+    lists) — the federation wire/file format."""
+    out: Dict[str, Any] = {}
+    for name, m in cap.items():
+        if m.get("kind") == "histogram":
+            out[name] = {
+                "kind": "histogram", "bounds": list(m["bounds"]),
+                "series": [[[list(p) for p in k],
+                            [c, s, list(b)]]
+                           for k, (c, s, b) in m["series"].items()]}
+        else:
+            out[name] = {
+                "kind": m["kind"],
+                "values": [[[list(p) for p in k], v]
+                           for k, v in m["values"].items()]}
+    return out
+
+
+def decode_capture(obj: Dict[str, Any]) -> Dict[str, Any]:
+    """Inverse of ``encode_capture`` (back to registry-capture shape).
+    Malformed metrics are skipped, never raised — a torn federation
+    file must not take down the coordinator's sampler."""
+    out: Dict[str, Any] = {}
+    for name, m in obj.items():
+        try:
+            kind = m["kind"]
+            if kind == "histogram":
+                out[name] = {
+                    "kind": "histogram",
+                    "bounds": tuple(float(b) for b in m["bounds"]),
+                    "series": {
+                        tuple(tuple(p) for p in k):
+                        (float(v[0]), float(v[1]),
+                         tuple(float(x) for x in v[2]))
+                        for k, v in m["series"]}}
+            elif kind in ("counter", "gauge"):
+                out[name] = {
+                    "kind": kind,
+                    "values": {tuple(tuple(p) for p in k): float(v)
+                               for k, v in m["values"]}}
+        except Exception:
+            continue
+    return out
+
+
+def push_metrics(coordinator_url: str, worker: str,
+                 host: Optional[str] = None,
+                 registry: Optional[_telemetry.MetricsRegistry] = None,
+                 timeout: float = 2.0) -> bool:
+    """HTTP federation fallback (like ``tracing.push_spans``): POST
+    this process's capture to the coordinator's
+    ``POST /v1/metrics/push``. Returns success; never raises."""
+    reg = (registry if registry is not None
+           else _telemetry.MetricsRegistry.get_default())
+    body = json.dumps({
+        "worker": str(worker), "host": host, "t": time.time(),
+        "capture": encode_capture(reg.capture())}).encode()
+    url = coordinator_url.rstrip("/") + "/v1/metrics/push"
+    req = urllib.request.Request(
+        url, data=body, headers={"Content-Type": "application/json"})
+    try:
+        urllib.request.urlopen(req, timeout=timeout).read()
+        return True
+    except Exception as e:
+        log.debug("metrics push to %s failed: %s", url, e)
+        return False
+
+
+def ingest_push(payload: Dict[str, Any]) -> bool:
+    """Coordinator-side handler body for ``POST /v1/metrics/push``:
+    hand the pushed capture to the default sampler. False when no
+    sampler is live (TSDB off) or the payload is malformed."""
+    sampler = default_sampler()
+    if sampler is None:
+        return False
+    try:
+        worker = str(payload["worker"])
+        cap = decode_capture(payload.get("capture") or {})
+    except Exception:
+        return False
+    if not cap:
+        return False
+    sampler.ingest_remote(cap, worker, host=payload.get("host"))
+    return True
+
+
+# ----------------------------------------------------- default instance
+_default_db: Optional[TimeSeriesDB] = None
+_default_sampler: Optional[Sampler] = None
+_dlock = threading.Lock()
+
+
+def default_db() -> Optional[TimeSeriesDB]:
+    return _default_db
+
+
+def default_sampler() -> Optional[Sampler]:
+    return _default_sampler
+
+
+def install(db: Optional[TimeSeriesDB],
+            sampler: Optional[Sampler] = None) -> None:
+    """Make (db, sampler) the process defaults (tests / embedders)."""
+    global _default_db, _default_sampler
+    with _dlock:
+        _default_db = db
+        _default_sampler = sampler
+
+
+def ensure_default(registry=None, interval_s: float = 1.0) \
+        -> Optional[Sampler]:
+    """Start the default sampler if the TSDB is enabled — idempotent;
+    a no-op returning None when ``DL4J_TPU_TSDB`` is off (zero new
+    threads, bit-identical hot paths). Called by the ui / remote
+    servers at start so an opted-in process gets history without
+    extra wiring. If a default SLO engine exists, it is attached to
+    the sampler so both share one capture per tick."""
+    global _default_db, _default_sampler
+    if not enabled():
+        return None
+    with _dlock:
+        if _default_sampler is None:
+            db = _default_db if _default_db is not None \
+                else TimeSeriesDB()
+            _default_db = db
+            _default_sampler = Sampler(
+                db=db, registry=registry, interval_s=interval_s)
+        sampler = _default_sampler
+    try:
+        from deeplearning4j_tpu.profiler import slo as _slo
+        eng = _slo.default_engine()
+        if eng is not None:
+            eng.attach_sampler(sampler)
+    except Exception:
+        pass
+    sampler.start()
+    return sampler
+
+
+def shutdown_default(timeout: float = 10.0) -> None:
+    global _default_db, _default_sampler
+    with _dlock:
+        sampler, _default_sampler = _default_sampler, None
+        _default_db = None
+    if sampler is not None:
+        sampler.shutdown(timeout)
+
+
+def tombstone_series(label: str, value: str,
+                     kinds: Tuple[str, ...] = ("gauge",)) -> int:
+    """Tombstone matching series in the default store (the
+    ``telemetry.retire_engine_series`` hook). 0 when no store."""
+    db = default_db()
+    if db is None:
+        return 0
+    return db.tombstone(label, value, kinds=kinds)
+
+
+def metrics_history_snapshot(window_s: float = 300.0) \
+        -> Dict[str, Any]:
+    """Peek-style export of the default store's recent history ({}
+    when the TSDB is off) — what the flight recorder embeds as
+    ``metrics.json`` in incident dumps."""
+    db = default_db()
+    if db is None:
+        return {}
+    snap = db.export(window_s=window_s)
+    return snap if snap.get("series") else {}
+
+
+# ------------------------------------------------------------ HTTP glue
+def _json_num(v: float) -> str:
+    # Prometheus renders sample values as strings (lossless for NaN
+    # and infinities, which JSON numbers can't carry)
+    if v != v:
+        return "NaN"
+    if v == math.inf:
+        return "+Inf"
+    if v == -math.inf:
+        return "-Inf"
+    return repr(float(v))
+
+
+def _http_error(msg: str, code: int) -> Tuple[Dict[str, Any], int]:
+    return ({"status": "error", "error": msg}, code)
+
+
+def _parse_qs(qs: str) -> Dict[str, str]:
+    return {k: v[-1] for k, v in
+            urllib.parse.parse_qs(qs, keep_blank_values=True).items()}
+
+
+def http_query(qs: str) -> Tuple[Dict[str, Any], int]:
+    """Shared ``GET /v1/query`` handling for ui/server.py and
+    remote/server.py: ``query=<expr>&time=<unix>``. Prometheus HTTP
+    API response shape. Returns (obj, http_code)."""
+    if default_db() is None:
+        return _http_error(
+            "time-series store is off (set DL4J_TPU_TSDB=1 and "
+            "restart, or call profiler.timeseries.ensure_default())",
+            404)
+    params = _parse_qs(qs)
+    expr = params.get("query", "")
+    try:
+        node = parse(expr)
+        t = float(params["time"]) if "time" in params else time.time()
+        vec = _eval_instant(default_db(), node, t)
+    except QueryError as e:
+        return _http_error(str(e), 400)
+    except ValueError:
+        return _http_error("bad time parameter", 400)
+    name = node[1] if node[0] == "selector" else None
+    result = []
+    for labels, v in vec:
+        metric = dict(labels)
+        if name:
+            metric["__name__"] = name
+        result.append({"metric": metric, "value": [t, _json_num(v)]})
+    return ({"status": "success",
+             "data": {"resultType": "vector", "result": result}}, 200)
+
+
+def http_query_range(qs: str) -> Tuple[Dict[str, Any], int]:
+    """Shared ``GET /v1/query_range``:
+    ``query=<expr>&start=<unix>&end=<unix>&step=<s>``."""
+    if default_db() is None:
+        return _http_error(
+            "time-series store is off (set DL4J_TPU_TSDB=1 and "
+            "restart, or call profiler.timeseries.ensure_default())",
+            404)
+    params = _parse_qs(qs)
+    expr = params.get("query", "")
+    try:
+        start = float(params["start"])
+        end = float(params["end"])
+        step = float(params.get("step", "1"))
+    except (KeyError, ValueError):
+        return _http_error(
+            "query_range needs start=, end= (unix seconds) and "
+            "numeric step=", 400)
+    try:
+        rows = query_range(expr, start, end, step)
+    except QueryError as e:
+        return _http_error(str(e), 400)
+    result = [{"metric": dict(labels),
+               "values": [[t, _json_num(v)] for t, v in pts]}
+              for labels, pts in rows]
+    return ({"status": "success",
+             "data": {"resultType": "matrix", "result": result}}, 200)
+
+
+def snapshot() -> Dict[str, Any]:
+    """Peek-style embedding for telemetry.snapshot() ({} when off)."""
+    db, sampler = default_db(), default_sampler()
+    if db is None:
+        return {}
+    out: Dict[str, Any] = {"series": db.series_count()}
+    if sampler is not None:
+        out["ticks"] = sampler.ticks
+        out["interval_s"] = sampler.interval_s
+        workers = sampler.remote_workers()
+        if workers:
+            out["federated_workers"] = workers
+    return out
+
+
+__all__ = [
+    "TimeSeriesDB", "Sampler", "QueryError",
+    "histogram_quantile", "parse", "query", "query_range",
+    "encode_capture", "decode_capture", "push_metrics", "ingest_push",
+    "default_db", "default_sampler", "install", "ensure_default",
+    "shutdown_default", "tombstone_series", "metrics_history_snapshot",
+    "http_query", "http_query_range", "snapshot",
+    "enabled", "set_enabled", "LOOKBACK_S", "TIERS", "REMOTE_TTL_S",
+]

@@ -34,6 +34,12 @@ Endpoints (stdlib http.server, daemon thread):
     GET  /v1/alerts            -> SLO alert states + rule inventory
                                   (when a profiler.slo.SLOEngine is
                                   live)
+    GET  /v1/query             -> PromQL-lite instant query against
+                                  the embedded time-series store
+                                  (?query=<expr>[&time=t]; 404 with a
+                                  hint while DL4J_TPU_TSDB is off)
+    GET  /v1/query_range       -> PromQL-lite range query (?query=..
+                                  &start=..&end=..&step=..)
     POST /v1/jobs              -> submit via a registered job factory
     POST /v1/jobs/<id>/cancel  -> cancel (train: checkpoint + exit;
          /v1/jobs/<id>/drain      serve: cancel in-flight + shutdown)
@@ -124,6 +130,16 @@ class JsonModelServer:
     def start(self) -> int:
         if self._httpd is not None:
             return self.port
+        # metrics-history sampler rides along with the server when
+        # DL4J_TPU_TSDB=1 (ensure_default is a no-op otherwise; off
+        # mode must not even import the timeseries module)
+        import os
+
+        if os.environ.get("DL4J_TPU_TSDB", "0") not in \
+                ("0", "", "false"):
+            from deeplearning4j_tpu.profiler import timeseries
+
+            timeseries.ensure_default()
         server = ThreadingHTTPServer(("127.0.0.1", self._requested_port),
                                      _InferenceHandler)
         server.model_server = self  # type: ignore[attr-defined]
@@ -330,6 +346,18 @@ class _InferenceHandler(BaseHTTPRequestHandler):
             from deeplearning4j_tpu.profiler import programs
 
             obj, code = programs.http_programs(path.partition("?")[2])
+            return self._json(obj, code)
+        if path == "/v1/query" or path.startswith("/v1/query?"):
+            from deeplearning4j_tpu.profiler import timeseries
+
+            obj, code = timeseries.http_query(path.partition("?")[2])
+            return self._json(obj, code)
+        if path == "/v1/query_range" \
+                or path.startswith("/v1/query_range?"):
+            from deeplearning4j_tpu.profiler import timeseries
+
+            obj, code = timeseries.http_query_range(
+                path.partition("?")[2])
             return self._json(obj, code)
         return self._json({"error": "not found"}, 404)
 

@@ -35,6 +35,17 @@ so no CDN scripts). Endpoints:
                                                profile capture
                                                ({"duration_s": 0.5});
                                                409 while one is active
+    GET /v1/query?query=<expr>[&time=t]     -> PromQL-lite instant query
+                                               against the embedded
+                                               time-series store
+                                               (profiler.timeseries)
+    GET /v1/query_range?query=..&start=..   -> PromQL-lite range query
+        &end=..&step=..                        (Prometheus-shaped
+                                               matrix response)
+    POST /v1/metrics/push                   -> ingest a worker's encoded
+                                               MetricsRegistry capture
+                                               (federation fallback when
+                                               no control dir is shared)
     GET /train/<sid>/overview               -> score curve, rates, memory
     GET /train/<sid>/model                  -> static info + latest layer stats
     GET /metrics                            -> Prometheus text exposition
@@ -125,6 +136,14 @@ _DASHBOARD_HTML = """<!doctype html>
 <div class="card"><b>Incidents (flight recorder)</b>
  <pre id="incidents"></pre></div>
 </div>
+<div class="card"><b>Metrics history (embedded time-series store)</b>
+ <input id="tsq" size="60"
+  value="rate(dl4j_tpu_serving_requests_total[60s])">
+ <select id="tsw"><option value="300">5m</option>
+  <option value="900">15m</option><option value="3600">1h</option>
+ </select>
+ <canvas id="tschart" class="h" width="900" height="160"></canvas>
+ <pre id="tsinfo"></pre></div>
 <div class="card"><b>Alerts (SLO engine)</b>
  <pre id="alerts"></pre></div>
 <div class="card"><b>Programs (roofline verdicts)</b>
@@ -249,8 +268,34 @@ async function serving(){
   '  decode steps='+fmt(gv(M,'dl4j_tpu_serving_decode_steps_total'))+
   '\\nwarm pool: hit='+fmt(gv(M,'dl4j_tpu_serving_warm_pool_hits_total'))+
   ' miss='+fmt(gv(M,'dl4j_tpu_serving_warm_pool_misses_total'))}
+let tsOff=false;
+async function tsdb(){
+ if(tsOff)return;
+ const q=document.getElementById('tsq').value;
+ const w=+document.getElementById('tsw').value;
+ const info=document.getElementById('tsinfo');
+ const now=Date.now()/1e3,step=Math.max(1,Math.round(w/300));
+ const r=await fetch('/v1/query_range?query='+encodeURIComponent(q)+
+  '&start='+(now-w)+'&end='+now+'&step='+step);
+ const o=await r.json();
+ if(r.status==404){info.textContent=
+  '(time-series store off — DL4J_TPU_TSDB=1 to enable)';
+  tsOff=true;return}
+ if(o.status!='success'){info.textContent='query error: '+
+  (o.error||r.status);return}
+ const res=o.data.result||[];
+ const s=res[0];
+ if(!s||!s.values.length){info.textContent=
+  '(no samples for this query yet)';return}
+ draw(document.getElementById('tschart'),
+  s.values.map(v=>v[0]),s.values.map(v=>+v[1]));
+ info.textContent=res.slice(0,8).map(x=>
+  JSON.stringify(x.metric)+' last='+
+  fmt(+x.values[x.values.length-1][1])).join('\\n')+
+  (res.length>8?'\\n... '+res.length+' series total':'')}
 async function refresh(){
  try{await serving()}catch(e){}
+ try{await tsdb()}catch(e){}
  const sid=document.getElementById('sess').value;
  if(!sid)return;const ov=await j('/train/'+sid+'/overview');
  draw(document.getElementById('score'),ov.iterations,ov.scores);
@@ -396,6 +441,20 @@ class _Handler(BaseHTTPRequestHandler):
             obj, code = programs.http_programs(
                 self.path.partition("?")[2])
             return self._json(obj, code)
+        if parts[0] == "v1" and len(parts) == 2 \
+                and parts[1] == "query":
+            from deeplearning4j_tpu.profiler import timeseries
+
+            obj, code = timeseries.http_query(
+                self.path.partition("?")[2])
+            return self._json(obj, code)
+        if parts[0] == "v1" and len(parts) == 2 \
+                and parts[1] == "query_range":
+            from deeplearning4j_tpu.profiler import timeseries
+
+            obj, code = timeseries.http_query_range(
+                self.path.partition("?")[2])
+            return self._json(obj, code)
         if parts[0] != "train":
             return self._json({"error": "not found"}, 404)
         return self._train_routes(ui, parts)
@@ -431,6 +490,23 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._json({"error": str(e)}, 400)
             obj, code = programs.http_profile(payload)
             return self._json(obj, code)
+        # federated metrics: worker hosts without control-dir access
+        # push encoded MetricsRegistry captures here; the coordinator's
+        # TSDB sampler merges them under worker=/host= labels
+        if path == "/v1/metrics/push":
+            from deeplearning4j_tpu.profiler import timeseries
+
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                if n > 4 << 20:   # a registry capture is kilobytes
+                    return self._json(
+                        {"error": "metrics capture too large"}, 413)
+                payload = json.loads(self.rfile.read(n) or b"{}")
+                ok = timeseries.ingest_push(payload)
+                return self._json({"ok": bool(ok)},
+                                  200 if ok else 503)
+            except Exception as e:
+                return self._json({"error": str(e)}, 400)
         # multi-host span aggregation: worker hosts push their per-span
         # aggregates here (tracing.push_spans) so the coordinator's
         # /telemetry shows every host side by side — the straggler view
@@ -493,6 +569,16 @@ class UIServer:
         """Start serving; port=0 picks a free port. Returns the port."""
         if self._httpd is not None:
             return self._port  # already running
+        # bring up the metrics-history sampler alongside the server
+        # (no-op unless DL4J_TPU_TSDB=1 — the off-mode contract is
+        # zero extra threads and no timeseries import)
+        import os
+
+        if os.environ.get("DL4J_TPU_TSDB", "0") not in \
+                ("0", "", "false"):
+            from deeplearning4j_tpu.profiler import timeseries
+
+            timeseries.ensure_default()
         httpd = ThreadingHTTPServer(("127.0.0.1", port), _Handler)
         httpd.ui_server = self  # type: ignore[attr-defined]
         self._httpd = httpd

@@ -2751,4 +2751,219 @@ if [ $asgate1 -ne 0 ] || [ $asgate2 -ne 0 ]; then
     exit 1
 fi
 
+# Timeseries smoke gate (docs/OBSERVABILITY.md "Querying metrics
+# history"): the embedded TSDB end-to-end. With DL4J_TPU_TSDB=1 a
+# served fleet's history must answer /v1/query PromQL-lite goldens
+# exactly (increase == requests served, rate == a hand replay of the
+# raw samples, p99 == histogram_quantile over the registry's own
+# bucket deltas); a 2-worker federation drill (spin_task's
+# dl4j_tpu_worker_drill_steps_total) must surface coordinator-side
+# worker= series with positive increase over /v1/query; a forced
+# incident dump must embed a digest-valid metrics.json carrying both
+# local and federated series; and TSDB-off serving must stay
+# token-identical with zero sampler threads.
+TS_DIR=$(mktemp -d /tmp/dl4j_tsdb_gate.XXXXXX)
+env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu DL4J_TPU_TELEMETRY=1 \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    DL4J_TPU_TSDB=1 DL4J_TSDB_GATE_DIR="$TS_DIR" \
+    python - <<'EOF'
+import json
+import os
+import sys
+import threading
+import time
+import urllib.parse
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu import control
+from deeplearning4j_tpu.models.gpt import CausalLM
+from deeplearning4j_tpu.models.transformer import tiny_config
+from deeplearning4j_tpu.profiler import flight_recorder, telemetry
+from deeplearning4j_tpu.profiler import timeseries as ts
+from deeplearning4j_tpu.serving import ServingFleet
+from deeplearning4j_tpu.ui.server import UIServer
+
+GATE = os.environ["DL4J_TSDB_GATE_DIR"]
+fail = []
+
+cfg = tiny_config(vocab=17, max_len=48, d_model=32, n_layers=2,
+                  n_heads=4, d_ff=64)
+cfg.dropout = 0.0
+m = CausalLM(cfg, compute_dtype=jnp.float32)
+params = m.init_params(jax.random.key(1))
+rng = np.random.default_rng(0)
+prompts = [rng.integers(0, 17, (int(rng.integers(3, 12)),)).astype(
+    np.int32) for _ in range(6)]
+solo = {i: np.asarray(m.generate(
+    params, jnp.asarray(p[None, :], jnp.int32), 3))[0]
+    for i, p in enumerate(prompts)}
+reg = telemetry.MetricsRegistry.get_default()
+
+# a near-inert thread interval makes the manual ticks the ONLY samples
+# the goldens see; the servers' ensure_default() reuses this sampler
+sampler = ts.ensure_default(interval_s=3600.0)
+if sampler is None:
+    sys.stderr.write("TSDB gate: ensure_default returned None with "
+                     "DL4J_TPU_TSDB=1\n")
+    sys.exit(1)
+ui = UIServer()
+port = ui.start(port=0)
+
+
+def q(expr):
+    body = json.loads(urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/v1/query?query="
+        + urllib.parse.quote(expr), timeout=10).read())
+    if body.get("status") != "success":
+        raise RuntimeError(f"query failed: {body}")
+    return {tuple(sorted(r["metric"].items())): float(r["value"][1])
+            for r in body["data"]["result"]}
+
+
+# ---- phase 1: serve -> manual ticks bracket a known traffic slice,
+# /v1/query answers match hand-computed goldens exactly --------------
+with ServingFleet(m, params, replicas=1, slots=2, page_size=8,
+                  prefill_buckets=[16], max_chunk=4) as fl:
+    for i in range(6):
+        if not np.array_equal(fl.generate(prompts[i], 3), solo[i]):
+            fail.append(f"TSDB-on output differs from solo ({i})")
+            break
+    sampler.tick_once()           # sample A: 6 requests on the books
+    cap_a = reg.capture()
+    time.sleep(0.3)
+    for i in range(6):
+        fl.generate(prompts[i], 3)
+    sampler.tick_once()           # sample B: 12 requests
+    cap_b = reg.capture()
+
+    # golden 1: increase between the two samples == requests served
+    got = q(f"sum (increase({telemetry.SERVING_REQUESTS}[600s]))")
+    if list(got.values()) != [6.0]:
+        fail.append(f"increase golden: wanted [6.0], got {got}")
+
+    # golden 2: rate == hand replay (last-first)/(t_last-t_first)
+    # over the raw samples the store actually holds
+    want = 0.0
+    db = ts.default_db()
+    for _labels, _kind, _b, pts in db.select(
+            telemetry.SERVING_REQUESTS, [], 0.0, time.time() + 1):
+        if len(pts) >= 2 and pts[-1][0] > pts[0][0]:
+            want += (pts[-1][1] - pts[0][1]) / (pts[-1][0] - pts[0][0])
+    got = q(f"sum (rate({telemetry.SERVING_REQUESTS}[600s]))")
+    if len(got) != 1 or abs(list(got.values())[0] - want) > 1e-9:
+        fail.append(f"rate golden: wanted {want}, got {got}")
+
+    # golden 3: p99 == histogram_quantile over the registry's own
+    # bucket deltas between the two captures
+    ha = cap_a.get(telemetry.SERVING_REQUEST_LATENCY,
+                   {"series": {}})
+    hb = cap_b[telemetry.SERVING_REQUEST_LATENCY]
+    want_q = None
+    for key, (_c, _s, buckets) in hb["series"].items():
+        prev = ha["series"].get(key)
+        delta = [b - (prev[2][i] if prev else 0)
+                 for i, b in enumerate(buckets)]
+        v = ts.histogram_quantile(hb["bounds"], delta, 0.99)
+        if v is not None:
+            want_q = v if want_q is None else max(want_q, v)
+    got = q("max (histogram_quantile(0.99, "
+            f"{telemetry.SERVING_REQUEST_LATENCY}[600s]))")
+    if want_q is None or len(got) != 1 \
+            or abs(list(got.values())[0] - want_q) > 1e-9:
+        fail.append(f"p99 golden: wanted {want_q}, got {got}")
+
+# ---- phase 2: 2-worker federation drill ----------------------------
+with control.WorkerSupervisor(["w0", "w1"], heartbeat_s=0.1,
+                              lease_s=10.0,
+                              restart_delay_s=0.1) as sup:
+    for w in ("w0", "w1"):
+        sup.submit_task("deeplearning4j_tpu.control.worker:spin_task",
+                        {"seconds": 60}, worker=w)
+    drill = "dl4j_tpu_worker_drill_steps_total"
+    fed = {}
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        sampler.tick_once()       # merge freshly pushed captures
+        fed = {r["metric"].get("worker"): float(r["value"][1])
+               for r in json.loads(urllib.request.urlopen(
+                   f"http://127.0.0.1:{port}/v1/query?query="
+                   + urllib.parse.quote(
+                       f"sum by (worker) (increase({drill}[120s]))"),
+                   timeout=10).read())["data"]["result"]}
+        if fed.get("w0", 0.0) > 0 and fed.get("w1", 0.0) > 0:
+            break
+        time.sleep(0.2)
+    if not (fed.get("w0", 0.0) > 0 and fed.get("w1", 0.0) > 0):
+        fail.append("federated worker= series never showed positive "
+                    f"increase coordinator-side: {fed}")
+    for w in ("w0", "w1"):
+        sup.preempt(w, deadline_s=30)
+
+# ---- phase 3: the black box carries the metrics history ------------
+sampler.tick_once()
+path = flight_recorder.get_default().incident(
+    "tsdb_gate_drill", directory=GATE)
+if path is None:
+    fail.append("forced incident dump was not written")
+else:
+    loaded = flight_recorder.load_dump(path)
+    if not loaded["valid"]:
+        fail.append("incident dump failed digest check")
+    blob = json.dumps(loaded["metrics"] or {})
+    if telemetry.SERVING_REQUESTS not in blob:
+        fail.append("metrics.json missing local serving series")
+    if "dl4j_tpu_worker_drill_steps_total" not in blob \
+            or '"worker"' not in blob:
+        fail.append("metrics.json missing federated worker series")
+
+# ---- phase 4: TSDB-off — token-identical, zero sampler threads -----
+ui.stop()
+ts.shutdown_default()
+ts.set_enabled(False)
+if ts.ensure_default() is not None:
+    fail.append("ensure_default started a sampler with the TSDB off")
+deadline = time.monotonic() + 5
+while any(t.name == ts.Sampler.THREAD_NAME
+          for t in threading.enumerate() if t.is_alive()) \
+        and time.monotonic() < deadline:
+    time.sleep(0.05)
+if any(t.name == ts.Sampler.THREAD_NAME
+       for t in threading.enumerate() if t.is_alive()):
+    fail.append("TSDBSampler thread alive after shutdown/off")
+with ServingFleet(m, params, replicas=1, slots=2, page_size=8,
+                  prefill_buckets=[16], max_chunk=4) as off_fl:
+    for i in (0, 3, 5):
+        if not np.array_equal(off_fl.generate(prompts[i], 3),
+                              solo[i]):
+            fail.append(f"TSDB-off output differs from solo ({i})")
+            break
+
+leaked = [t.name for t in threading.enumerate()
+          if t.is_alive() and t.name.startswith(
+              ("TSDBSampler", "WorkerSupervisor", "WorkerHeartbeat",
+               "ServingEngine", "ServingFleetRouter"))]
+if leaked:
+    fail.append(f"threads survived shutdown: {leaked}")
+
+if fail:
+    sys.stderr.write("timeseries gate FAILED:\n  "
+                     + "\n  ".join(fail) + "\n")
+    sys.exit(1)
+print("timeseries gate OK: /v1/query matched hand-computed "
+      "increase/rate/p99 goldens, 2-worker federation drill surfaced "
+      "worker= series coordinator-side, incident dump embedded "
+      "digest-valid metrics.json with local + federated history, "
+      "TSDB-off serving token-identical with zero sampler threads")
+EOF
+tsgate=$?
+rm -rf "$TS_DIR"
+if [ $tsgate -ne 0 ]; then
+    echo "FATAL: timeseries smoke gate regressed" >&2
+    exit 1
+fi
+
 exit $rc

@@ -85,7 +85,8 @@ def pytest_sessionfinish(session, exitstatus):
                                        "SLOEvaluator",
                                        "WorkerSupervisor",
                                        "WorkerHeartbeat",
-                                       "NoticePoller")))
+                                       "NoticePoller",
+                                       "TSDBSampler")))
         ]
 
     deadline = time.time() + 2.0

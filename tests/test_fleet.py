@@ -684,6 +684,46 @@ class TestElasticScale:
             np.testing.assert_array_equal(
                 o3, _solo(model, params, t3, 4))
 
+    def test_remove_replica_tombstones_tsdb_series(self, model,
+                                                   params):
+        """Satellite: scale-down retires the dead engine's gauge
+        series in the time-series store too (telemetry.
+        retire_engine_series -> timeseries.tombstone_series) —
+        instant queries stop answering for the removed replica while
+        its pre-death history stays readable, and the survivor's
+        series is untouched."""
+        from deeplearning4j_tpu.profiler import timeseries as ts
+
+        was = telemetry.enabled()
+        telemetry.set_enabled(True)
+        db = ts.TimeSeriesDB()
+        ts.install(db)
+        reg = telemetry.MetricsRegistry.get_default()
+        try:
+            with _fleet(model, params, replicas=2) as fl:
+                eids = [r.engine.engine_id for r in fl._replicas]
+                g = reg.gauge(telemetry.SERVING_SLOT_OCCUPANCY)
+                for e in eids:
+                    g.set(0.5, engine=e)
+                t0 = time.time()
+                db.ingest(t0, reg.capture())
+                dead, alive = eids[0], eids[1]
+                assert fl.remove_replica(fl._replicas[0].rid)
+                now = time.time()
+                occ = "dl4j_tpu_serving_slot_occupancy"
+                assert ts.query(f'{occ}{{engine="{dead}"}}',
+                                t=now, db=db) == []
+                assert ts.query(f'{occ}{{engine="{alive}"}}',
+                                t=now, db=db) == \
+                    [({"engine": alive}, 0.5)]
+                # pre-death history is still there (range reads with
+                # no instant don't drop tombstoned series)
+                hist = db.select(occ, [], t0 - 1, now + 1)
+                assert {r[0]["engine"] for r in hist} == set(eids)
+        finally:
+            ts.install(None, None)
+            telemetry.set_enabled(was)
+
     def test_rid_stability_and_last_replica_guard(self, model,
                                                   params):
         """Replica ids are STABLE handles, not list positions: after

@@ -535,6 +535,62 @@ class TestEnginePlumbing:
         assert slo.alerts_snapshot() == {}
 
 
+# ------------------------------------------- shared-capture sampler
+class TestSamplerBackedEngine:
+    def test_tsdb_backed_engine_fires_identically(self):
+        """An engine riding the TSDB sampler's shared capture walks
+        the exact same pending -> firing -> resolved lifecycle as one
+        ticking its own registry directly — same rules, same registry,
+        same fake clock, state compared at every step."""
+        from deeplearning4j_tpu.profiler import timeseries as ts
+
+        reg = telemetry.MetricsRegistry()
+
+        def rules():
+            return [slo.Threshold("hot", metric="g", bound=0.9,
+                                  op=">", for_s=2.0)]
+
+        direct = _engine(rules(), registry=reg)
+        sampler = ts.Sampler(db=ts.TimeSeriesDB(), registry=reg,
+                             interval_s=60.0)
+        backed = _engine(rules(), registry=reg, sampler=sampler)
+        script = [0.5, 0.95, 0.95, 0.95, 0.95, 0.5, 0.5, 0.95, 0.5]
+        seen = []
+        for i, v in enumerate(script):
+            t = float(i)
+            reg.gauge("g").set(v)
+            direct.tick(now=t)
+            sampler.tick_once(now_mono=t, now_wall=1000.0 + t)
+            seen.append((direct.alert_state("hot"),
+                         backed.alert_state("hot")))
+        assert [a for a, _b in seen] == [b for _a, b in seen]
+        assert "firing" in [a for a, _b in seen]
+        assert direct.alerts_json()["alerts"] == \
+            backed.alerts_json()["alerts"]
+        backed.shutdown()
+        direct.shutdown()
+        # shutdown detached the subscription: further ticks are
+        # invisible to the dead engine
+        before = backed.ticks
+        sampler.tick_once(now_mono=99.0, now_wall=1099.0)
+        assert backed.ticks == before
+
+    def test_attach_refuses_while_thread_alive(self):
+        from deeplearning4j_tpu.profiler import timeseries as ts
+
+        eng = _engine([slo.Threshold("hot", metric="g", bound=1.0)],
+                      interval_s=0.01)
+        sampler = ts.Sampler(db=ts.TimeSeriesDB(),
+                             registry=eng.registry)
+        with eng:
+            deadline = time.monotonic() + 5
+            while eng.ticks == 0 and time.monotonic() < deadline:
+                time.sleep(0.01)
+            eng.attach_sampler(sampler)     # refused: no double-tick
+            assert eng._sampler is None
+        assert sampler._subs == []
+
+
 # ------------------------------------------------------------- HTTP
 class TestAlertsHTTP:
     def test_http_alerts_404_without_engine(self):

@@ -1,0 +1,591 @@
+"""Embedded time-series store (profiler/timeseries.py): tiered
+storage + retention, PromQL-lite parsing/evaluation (rate with
+counter-reset clamping, windowed histogram quantiles, aggregation),
+the shared-capture sampler (one registry.capture() per tick feeds the
+store AND the SLO engine), tombstones, worker metric federation
+(control-dir file lease + HTTP push, SIGKILL-respawn survival), the
+/v1/query(_range) HTTP surface on both servers, and the off-by-default
+contract (DL4J_TPU_TSDB=0: no sampler threads, no default store)."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from deeplearning4j_tpu.profiler import slo, telemetry
+from deeplearning4j_tpu.profiler import timeseries as ts
+
+
+def _gauge_cap(**vals):
+    """Hand-built registry-capture of unlabelled gauges."""
+    return {name: {"kind": "gauge", "values": {(): float(v)}}
+            for name, v in vals.items()}
+
+
+def _counter_cap(name, v, **labels):
+    key = tuple(sorted((k, str(val)) for k, val in labels.items()))
+    return {name: {"kind": "counter", "values": {key: float(v)}}}
+
+
+def _hist_cap(name, count, total, buckets, bounds=(0.1, 0.5, 1.0),
+              **labels):
+    key = tuple(sorted((k, str(val)) for k, val in labels.items()))
+    return {name: {"kind": "histogram", "bounds": tuple(bounds),
+                   "series": {key: (float(count), float(total),
+                                    tuple(buckets))}}}
+
+
+# ================================================================ store
+class TestStore:
+    def test_ingest_and_select_real_capture(self):
+        reg = telemetry.MetricsRegistry()
+        reg.gauge("g").set(3.5, engine="e0")
+        reg.counter("c").inc(7)
+        db = ts.TimeSeriesDB()
+        db.ingest(100.0, reg.capture())
+        rows = db.select("g", [], 0.0, 200.0)
+        assert len(rows) == 1
+        labels, kind, _bounds, pts = rows[0]
+        assert labels == {"engine": "e0"} and kind == "gauge"
+        assert pts == [(100.0, 3.5)]
+        assert db.series_count() == 2
+
+    def test_last_sample_wins_within_downsample_bucket(self):
+        s = ts._Series("g", (), "gauge")
+        s.add(3.0, 1.0)
+        s.add(7.0, 2.0)   # same 10s bucket: replaces in coarse tiers
+        s.add(12.0, 3.0)
+        raw = [p for p in s.tiers[0][1]]
+        t10 = [p for p in s.tiers[1][1]]
+        assert raw == [(3.0, 1.0), (7.0, 2.0), (12.0, 3.0)]
+        assert t10 == [(7.0, 2.0), (12.0, 3.0)]
+
+    def test_merged_tiers_back_raw_with_downsampled_tail(self):
+        """Once the raw ring wraps, queries over the full span see
+        raw-resolution recent history backed by the 10 s tier."""
+        db = ts.TimeSeriesDB()
+        for i in range(700):           # raw tier keeps 600
+            db.ingest(float(i), _gauge_cap(g=i))
+        (_l, _k, _b, pts), = db.select("g", [], 0.0, 699.0)
+        raw_start = 100.0              # 700 - 600
+        coarse = [p for p in pts if p[0] < raw_start]
+        fine = [p for p in pts if p[0] >= raw_start]
+        assert len(fine) == 600
+        # 10 buckets of 10 s each cover t in [0, 100): last-wins
+        assert [p[0] for p in coarse] == [9.0 + 10 * i
+                                          for i in range(10)]
+        assert pts == sorted(pts)
+
+    def test_tombstone_excludes_at_instant_keeps_history(self):
+        db = ts.TimeSeriesDB()
+        db.ingest(10.0, _gauge_cap(g=1.0))
+        assert db.tombstone("nope", "x") == 0
+        # unlabelled gauge: tombstone by label only hits labelled ones
+        reg = telemetry.MetricsRegistry()
+        reg.gauge("occ").set(0.5, engine="dead")
+        reg.gauge("occ").set(0.6, engine="alive")
+        db.ingest(20.0, reg.capture())
+        assert db.tombstone("engine", "dead", t=25.0) == 1
+        # instant at/after the tombstone: the dead series is gone
+        alive = db.select("occ", [], 0.0, 30.0, at=30.0)
+        assert [r[0] for r in alive] == [{"engine": "alive"}]
+        # history before the tombstone is still readable (at=None)
+        hist = db.select("occ", [], 0.0, 30.0)
+        assert {r[0]["engine"] for r in hist} == {"dead", "alive"}
+
+    def test_reingest_clears_tombstone(self):
+        db = ts.TimeSeriesDB()
+        reg = telemetry.MetricsRegistry()
+        reg.gauge("occ").set(0.5, engine="e")
+        db.ingest(10.0, reg.capture())
+        db.tombstone("engine", "e", t=11.0)
+        assert db.select("occ", [], 0.0, 99.0, at=50.0) == []
+        db.ingest(20.0, reg.capture())   # the id came back
+        assert db.select("occ", [], 0.0, 99.0, at=50.0)
+
+    def test_export_shape_and_bounds(self):
+        db = ts.TimeSeriesDB()
+        db.ingest(10.0, _gauge_cap(g=1.0))
+        db.ingest(10.0, _hist_cap("h", 5, 1.5, (0, 5, 0, 0)))
+        snap = db.export(window_s=60.0, now=20.0)
+        assert snap["window_s"] == 60.0 and snap["now"] == 20.0
+        by_name = {e["name"]: e for e in snap["series"]}
+        assert by_name["g"]["points"] == [[10.0, 1.0]]
+        assert by_name["h"]["bounds"] == [0.1, 0.5, 1.0]
+        assert by_name["h"]["points"] == [[10.0, [5.0, 1.5,
+                                                  [0.0, 5.0, 0.0,
+                                                   0.0]]]]
+        json.dumps(snap)                 # JSON-serializable as-is
+
+    def test_export_truncates_oldest_registered(self):
+        db = ts.TimeSeriesDB()
+        for i in range(5):
+            db.ingest(10.0, _gauge_cap(**{f"g{i}": float(i)}))
+        snap = db.export(window_s=60.0, now=20.0, max_series=2)
+        assert snap["series_truncated"] == 3
+        assert [e["name"] for e in snap["series"]] == ["g3", "g4"]
+
+
+# =============================================================== parser
+class TestParser:
+    def test_selector_with_matchers(self):
+        node = ts.parse('m{a="x",b!="y",c=~"z.*",d!~"q"}')
+        assert node[0] == "selector" and node[1] == "m"
+        assert [(m.label, m.op, m.value) for m in node[2]] == [
+            ("a", "=", "x"), ("b", "!=", "y"),
+            ("c", "=~", "z.*"), ("d", "!~", "q")]
+
+    def test_durations(self):
+        assert ts.parse("rate(m[90s])")[1][2] == 90.0
+        assert ts.parse("rate(m[2m])")[1][2] == 120.0
+        assert ts.parse("rate(m[1h])")[1][2] == 3600.0
+        assert ts.parse("rate(m[30])")[1][2] == 30.0   # bare seconds
+
+    def test_agg_with_and_without_by(self):
+        node = ts.parse("avg by (engine, host) (rate(m[30s]))")
+        assert node[:3] == ("agg", "avg", ["engine", "host"])
+        assert ts.parse("max (m)")[:3] == ("agg", "max", None)
+
+    @pytest.mark.parametrize("bad", [
+        "", "   ", "rate(m)", "rate(m[30s]) extra", 'm{a="x"',
+        'm{a~"x"}', "histogram_quantile(1.5, m[30s])",
+        'm{a=~"[unclosed"}', "rate(", "avg by () (m)",
+    ])
+    def test_malformed_raises_query_error(self, bad):
+        with pytest.raises(ts.QueryError):
+            ts.parse(bad)
+
+
+# ============================================================ evaluator
+class TestEval:
+    def test_instant_selector_staleness_lookback(self):
+        db = ts.TimeSeriesDB()
+        db.ingest(1000.0, _gauge_cap(g=1.0))
+        assert ts.query("g", t=1000.0 + ts.LOOKBACK_S - 1,
+                        db=db) == [({}, 1.0)]
+        assert ts.query("g", t=1000.0 + ts.LOOKBACK_S + 1,
+                        db=db) == []
+
+    def test_rate_golden(self):
+        db = ts.TimeSeriesDB()
+        for t, v in ((0.0, 0), (1.0, 5), (2.0, 10), (3.0, 15)):
+            db.ingest(t, _counter_cap("c", v))
+        (_l, v), = ts.query("rate(c[10s])", t=3.0, db=db)
+        assert v == pytest.approx(5.0)
+        (_l, v), = ts.query("increase(c[10s])", t=3.0, db=db)
+        assert v == pytest.approx(15.0)
+
+    def test_rate_needs_two_samples(self):
+        db = ts.TimeSeriesDB()
+        db.ingest(0.0, _counter_cap("c", 5))
+        assert ts.query("rate(c[10s])", t=1.0, db=db) == []
+
+    def test_rate_clamps_counter_reset(self):
+        """0 -> 10 -> (restart) 2 -> 4: the reset contributes the
+        post-restart level, never a negative delta."""
+        db = ts.TimeSeriesDB()
+        for t, v in ((0.0, 0), (1.0, 10), (2.0, 2), (3.0, 4)):
+            db.ingest(t, _counter_cap("c", v))
+        (_l, v), = ts.query("increase(c[10s])", t=3.0, db=db)
+        assert v == pytest.approx(14.0)   # 10 + 2 + 2
+
+    def test_histogram_quantile_windowed_golden(self):
+        db = ts.TimeSeriesDB()
+        db.ingest(0.0, _hist_cap("h", 0, 0.0, (0, 0, 0, 0)))
+        db.ingest(10.0, _hist_cap("h", 10, 3.0, (0, 10, 0, 0)))
+        (_l, q), = ts.query("histogram_quantile(0.5, h[30s])",
+                            t=10.0, db=db)
+        assert q == pytest.approx(0.3)   # midpoint of (0.1, 0.5]
+
+    def test_histogram_reset_adds_postreset_buckets(self):
+        db = ts.TimeSeriesDB()
+        db.ingest(0.0, _hist_cap("h", 50, 5.0, (50, 0, 0, 0)))
+        db.ingest(10.0, _hist_cap("h", 4, 2.0, (0, 4, 0, 0)))
+        (_l, q), = ts.query("histogram_quantile(0.5, h[30s])",
+                            t=10.0, db=db)
+        assert 0.1 < q <= 0.5            # only post-reset obs count
+
+    def test_count_sum_suffixes_and_bare_histogram_rate(self):
+        db = ts.TimeSeriesDB()
+        db.ingest(0.0, _hist_cap("h", 0, 0.0, (0, 0, 0, 0)))
+        db.ingest(10.0, _hist_cap("h", 10, 3.0, (0, 10, 0, 0)))
+        (_l, v), = ts.query("rate(h_count[30s])", t=10.0, db=db)
+        assert v == pytest.approx(1.0)
+        (_l, v), = ts.query("rate(h_sum[30s])", t=10.0, db=db)
+        assert v == pytest.approx(0.3)
+        # bare histogram name under rate(): the cumulative count
+        (_l, v), = ts.query("rate(h[30s])", t=10.0, db=db)
+        assert v == pytest.approx(1.0)
+        # plain instant selector skips histogram series
+        assert ts.query("h", t=10.0, db=db) == []
+
+    def test_agg_by_label(self):
+        db = ts.TimeSeriesDB()
+        reg = telemetry.MetricsRegistry()
+        g = reg.gauge("q")
+        g.set(1.0, engine="a", host="h1")
+        g.set(3.0, engine="b", host="h1")
+        g.set(5.0, engine="c", host="h2")
+        db.ingest(10.0, reg.capture())
+        out = dict((lab["host"], v) for lab, v in ts.query(
+            "sum by (host) (q)", t=10.0, db=db))
+        assert out == {"h1": 4.0, "h2": 5.0}
+        (lab, v), = ts.query("max (q)", t=10.0, db=db)
+        assert lab == {} and v == 5.0
+
+    def test_query_range_golden_and_limits(self):
+        db = ts.TimeSeriesDB()
+        for i in range(5):
+            db.ingest(float(i), _counter_cap("c", 2 * i))
+        (lab, pts), = ts.query_range("rate(c[10s])", 1.0, 4.0, 1.0,
+                                     db=db)
+        assert [t for t, _v in pts] == [1.0, 2.0, 3.0, 4.0]
+        assert all(v == pytest.approx(2.0) for _t, v in pts[1:])
+        with pytest.raises(ts.QueryError):
+            ts.query_range("c", 0.0, 10.0, 0.0, db=db)
+        with pytest.raises(ts.QueryError):
+            ts.query_range("c", 10.0, 0.0, 1.0, db=db)
+        with pytest.raises(ts.QueryError):
+            ts.query_range("c", 0.0, 1e6, 0.01, db=db)
+
+    def test_tombstoned_series_vanish_from_instants_not_ranges(self):
+        db = ts.TimeSeriesDB()
+        reg = telemetry.MetricsRegistry()
+        reg.gauge("occ").set(0.9, engine="dead")
+        db.ingest(10.0, reg.capture())
+        db.ingest(20.0, reg.capture())
+        db.tombstone("engine", "dead", t=25.0)
+        assert ts.query('occ{engine="dead"}', t=30.0, db=db) == []
+        # range evaluation BEFORE the tombstone still sees history
+        rows = ts.query_range('occ{engine="dead"}', 10.0, 20.0, 5.0,
+                              db=db)
+        assert rows and len(rows[0][1]) == 3
+
+
+# ============================================================== sampler
+class TestSampler:
+    def test_one_capture_per_tick_shared_with_slo_engine(self):
+        """Satellite: the SLO engine attached to the sampler and the
+        store itself share ONE registry.capture() per tick."""
+        reg = telemetry.MetricsRegistry()
+        calls = {"n": 0}
+        orig = reg.capture
+
+        def counting():
+            calls["n"] += 1
+            return orig()
+
+        reg.capture = counting
+        db = ts.TimeSeriesDB()
+        sampler = ts.Sampler(db=db, registry=reg, interval_s=60.0)
+        eng = slo.SLOEngine(
+            [slo.Threshold("hot", metric="g", bound=0.9, op=">",
+                           for_s=0.0)],
+            registry=reg, make_default=False, sampler=sampler)
+        # attached engine refuses to start its own thread
+        assert eng.start() is eng and eng._thread is None
+        reg.gauge("g").set(1.0)
+        sampler.tick_once(now_mono=100.0, now_wall=1000.0)
+        assert calls["n"] == 1
+        assert eng.alert_state("hot") == "firing"
+        assert db.select("g", [], 0.0, 2000.0)
+        sampler.tick_once(now_mono=101.0, now_wall=1001.0)
+        assert calls["n"] == 2
+        eng.shutdown()
+
+    def test_sampler_thread_lifecycle(self):
+        reg = telemetry.MetricsRegistry()
+        reg.gauge("g").set(1.0)
+        sampler = ts.Sampler(db=ts.TimeSeriesDB(), registry=reg,
+                             interval_s=0.05).start()
+        names = [t.name for t in threading.enumerate()]
+        assert ts.Sampler.THREAD_NAME in names
+        deadline = time.time() + 30
+        while sampler.ticks < 2 and time.time() < deadline:
+            time.sleep(0.02)
+        sampler.shutdown()
+        assert sampler.ticks >= 2
+        assert ts.Sampler.THREAD_NAME not in [
+            t.name for t in threading.enumerate() if t.is_alive()]
+
+    def test_subscriber_exception_does_not_stop_ingest(self):
+        reg = telemetry.MetricsRegistry()
+        reg.gauge("g").set(1.0)
+        db = ts.TimeSeriesDB()
+        sampler = ts.Sampler(db=db, registry=reg)
+
+        def boom(_t, _w, _cap):
+            raise RuntimeError("subscriber bug")
+
+        sampler.subscribe(boom)
+        sampler.tick_once(now_mono=1.0, now_wall=10.0)
+        sampler.tick_once(now_mono=2.0, now_wall=11.0)
+        (_l, _k, _b, pts), = db.select("g", [], 0.0, 99.0)
+        assert len(pts) == 2
+
+
+# =========================================================== federation
+class TestFederation:
+    def test_encode_decode_roundtrip(self):
+        reg = telemetry.MetricsRegistry()
+        reg.counter("c").inc(5, engine="e0")
+        reg.gauge("g").set(1.5)
+        reg.histogram("h").observe(0.3, engine="e0")
+        cap = reg.capture()
+        wire = json.loads(json.dumps(ts.encode_capture(cap)))
+        back = ts.decode_capture(wire)
+        assert back == cap
+
+    def test_decode_skips_malformed_metrics(self):
+        wire = {"ok": {"kind": "gauge", "values": [[[], 2.0]]},
+                "torn": {"kind": "histogram", "bounds": "nope"},
+                "alien": {"kind": "widget"}}
+        back = ts.decode_capture(wire)
+        assert list(back) == ["ok"]
+        assert back["ok"]["values"] == {(): 2.0}
+
+    def test_ingest_remote_merges_under_worker_labels(self):
+        reg = telemetry.MetricsRegistry()
+        reg.gauge("g").set(1.0)
+        db = ts.TimeSeriesDB()
+        sampler = ts.Sampler(db=db, registry=reg)
+        rreg = telemetry.MetricsRegistry()
+        rreg.gauge("g").set(2.0, engine="e9")
+        rreg.counter("c").inc(3)
+        sampler.ingest_remote(rreg.capture(), "w0", host="hostA",
+                              t=1000.0)
+        sampler.tick_once(now_mono=1.0, now_wall=1000.5)
+        assert sampler.remote_workers() == ["w0"]
+        vec = ts.query('g{worker="w0"}', t=1000.5, db=db)
+        assert vec == [({"engine": "e9", "worker": "w0",
+                         "host": "hostA"}, 2.0)]
+        # the local series has NO worker label
+        assert ts.query('g{worker=""}', t=1000.5, db=db) == \
+            [({}, 1.0)]
+
+    def test_stale_remote_expires_after_ttl(self):
+        reg = telemetry.MetricsRegistry()
+        db = ts.TimeSeriesDB()
+        sampler = ts.Sampler(db=db, registry=reg, remote_ttl_s=5.0)
+        rreg = telemetry.MetricsRegistry()
+        rreg.gauge("g").set(2.0)
+        sampler.ingest_remote(rreg.capture(), "w0", t=1000.0)
+        sampler.tick_once(now_mono=1.0, now_wall=1004.0)   # fresh
+        sampler.tick_once(now_mono=2.0, now_wall=1006.0)   # expired
+        (_l, _k, _b, pts), = db.select("g", [], 0.0, 9999.0)
+        assert [t for t, _v in pts] == [1004.0]
+
+    def test_ingest_push_roundtrip_and_off_mode(self):
+        assert ts.default_sampler() is None
+        payload = {"worker": "w0",
+                   "capture": {"g": {"kind": "gauge",
+                                     "values": [[[], 4.0]]}}}
+        assert ts.ingest_push(payload) is False   # no sampler: off
+        reg = telemetry.MetricsRegistry()
+        db = ts.TimeSeriesDB()
+        sampler = ts.Sampler(db=db, registry=reg)
+        ts.install(db, sampler)
+        try:
+            assert ts.ingest_push(payload) is True
+            assert ts.ingest_push({"capture": {}}) is False
+            sampler.tick_once(now_mono=1.0, now_wall=1000.0)
+            assert ts.query('g{worker="w0"}', t=1000.0, db=db)
+        finally:
+            ts.install(None, None)
+
+    @pytest.mark.slow
+    def test_rate_survives_worker_sigkill_respawn(self, monkeypatch):
+        """Satellite: a federated worker series keeps answering
+        rate() across a SIGKILL + respawn — the respawned process's
+        counter restarts from zero and the reset clamp keeps the rate
+        finite and non-negative, with fresh samples resuming."""
+        from deeplearning4j_tpu import control
+
+        monkeypatch.setenv("DL4J_TPU_TSDB", "1")
+        reg = telemetry.MetricsRegistry()
+        db = ts.TimeSeriesDB()
+        sampler = ts.Sampler(db=db, registry=reg,
+                             interval_s=0.1).start()
+        ts.install(db, sampler)
+        expr = ('rate(dl4j_tpu_worker_drill_steps_total'
+                '{worker="w0"}[5s])')
+        try:
+            with control.WorkerSupervisor(
+                    ["w0"], heartbeat_s=0.1, lease_s=10.0,
+                    restart_delay_s=0.1) as sup:
+                task = sup.submit_task(
+                    "deeplearning4j_tpu.control.worker:spin_task", {})
+                deadline = time.time() + 120
+
+                def rate_now():
+                    vec = ts.query(expr, db=db)
+                    return vec[0][1] if vec else 0.0
+
+                # the same published capture is merged at every tick
+                # until the worker's next 0.5 s publish, so wait for a
+                # POSITIVE rate (two distinct counter levels), not
+                # just for the series to exist
+                while rate_now() <= 0 and time.time() < deadline:
+                    time.sleep(0.1)
+                vec = ts.query(expr, db=db)
+                assert vec and vec[0][0]["worker"] == "w0"
+                assert vec[0][1] > 0
+                sup.kill("w0")
+
+                def respawned():
+                    st = sup.workers_status()["w0"]
+                    return st["restarts"] >= 1 \
+                        and st["state"] == "alive"
+
+                while not respawned() and time.time() < deadline:
+                    time.sleep(0.1)
+                assert respawned()
+                # fresh post-respawn captures arrive (new publish t)
+                t_kill = time.time()
+
+                def fresh_pts():
+                    rows = db.select(
+                        "dl4j_tpu_worker_drill_steps_total", [],
+                        t_kill, time.time() + 1)
+                    return [p for r in rows for p in r[3]
+                            if p[0] > t_kill + 0.5]
+
+                while not fresh_pts() and time.time() < deadline:
+                    time.sleep(0.1)
+                assert fresh_pts()
+                vec = ts.query(expr, db=db)
+                assert vec and vec[0][1] >= 0.0   # reset-clamped
+                sup.preempt("w0", deadline_s=30)   # clean drain
+                while task.state == "running" \
+                        and time.time() < deadline:
+                    time.sleep(0.05)
+        finally:
+            sampler.shutdown()
+            ts.install(None, None)
+
+
+# ================================================================= HTTP
+class TestHTTP:
+    def _get(self, port, path):
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=10) as r:
+            return json.loads(r.read()), r.status
+
+    def test_query_endpoints_on_ui_server(self):
+        from deeplearning4j_tpu.ui.server import UIServer
+
+        reg = telemetry.MetricsRegistry()
+        db = ts.TimeSeriesDB()
+        sampler = ts.Sampler(db=db, registry=reg)
+        reg.counter("c").inc(5, engine="e0")
+        now = time.time()
+        db.ingest(now - 10, _counter_cap("c", 0, engine="e0"))
+        sampler.tick_once(now_mono=1.0, now_wall=now)
+        ts.install(db, sampler)
+        srv = UIServer()
+        port = srv.start(port=0)
+        try:
+            obj, code = self._get(
+                port, "/v1/query?query=rate(c%5B30s%5D)")
+            assert code == 200 and obj["status"] == "success"
+            res = obj["data"]["result"]
+            assert obj["data"]["resultType"] == "vector"
+            assert res[0]["metric"] == {"engine": "e0"}
+            assert float(res[0]["value"][1]) == pytest.approx(0.5)
+            obj, _code = self._get(
+                port, f"/v1/query_range?query=c&start={now - 10}"
+                      f"&end={now}&step=5")
+            assert obj["data"]["resultType"] == "matrix"
+            assert obj["data"]["result"][0]["values"]
+            # instant selector carries __name__ (Prometheus shape)
+            obj, _code = self._get(port, "/v1/query?query=c")
+            assert obj["data"]["result"][0]["metric"]["__name__"] \
+                == "c"
+            # malformed expression: structured 400
+            try:
+                self._get(port, "/v1/query?query=rate(c")
+                assert False, "expected HTTP 400"
+            except urllib.error.HTTPError as e:
+                assert e.code == 400
+                assert json.loads(e.read())["status"] == "error"
+            # federation push fallback lands in the sampler
+            body = json.dumps({
+                "worker": "w9",
+                "capture": {"g": {"kind": "gauge",
+                                  "values": [[[], 4.0]]}}}).encode()
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/v1/metrics/push",
+                data=body,
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=10) as r:
+                assert json.loads(r.read())["ok"] is True
+            assert sampler.remote_workers() == ["w9"]
+        finally:
+            srv.stop()
+            ts.install(None, None)
+
+    def test_query_endpoints_on_remote_server(self):
+        from deeplearning4j_tpu.remote.server import JsonModelServer
+
+        db = ts.TimeSeriesDB()
+        db.ingest(time.time(), _gauge_cap(g=2.5))
+        ts.install(db)
+        srv = JsonModelServer(model=object())
+        port = srv.start()
+        try:
+            obj, code = self._get(port, "/v1/query?query=g")
+            assert code == 200
+            assert float(obj["data"]["result"][0]["value"][1]) == 2.5
+            now = time.time()
+            obj, _code = self._get(
+                port, f"/v1/query_range?query=g&start={now - 60}"
+                      f"&end={now}&step=10")
+            assert obj["data"]["result"][0]["values"]
+        finally:
+            srv.stop()
+            ts.install(None, None)
+
+    def test_http_404_with_hint_when_store_off(self):
+        assert ts.default_db() is None
+        obj, code = ts.http_query("query=g")
+        assert code == 404 and "DL4J_TPU_TSDB" in obj["error"]
+        obj, code = ts.http_query_range(
+            "query=g&start=0&end=1&step=1")
+        assert code == 404
+
+    def test_http_nonfinite_values_as_strings(self):
+        db = ts.TimeSeriesDB()
+        db.ingest(100.0, _gauge_cap(g=float("inf")))
+        ts.install(db)
+        try:
+            obj, code = ts.http_query("query=g&time=100")
+            assert code == 200
+            assert obj["data"]["result"][0]["value"][1] == "+Inf"
+        finally:
+            ts.install(None, None)
+
+
+# ============================================================= off mode
+class TestOffByDefault:
+    def test_ensure_default_is_noop_when_disabled(self):
+        assert ts.enabled() is False     # suite runs with TSDB off
+        assert ts.ensure_default() is None
+        assert ts.default_db() is None
+        assert ts.default_sampler() is None
+        assert ts.Sampler.THREAD_NAME not in {
+            t.name for t in threading.enumerate()}
+        assert ts.metrics_history_snapshot() == {}
+        assert ts.snapshot() == {}
+        assert ts.tombstone_series("engine", "x") == 0
+
+    def test_telemetry_snapshot_has_no_timeseries_when_off(self):
+        snap = telemetry.snapshot()
+        assert "timeseries" not in snap
+
+    def test_retire_engine_series_tolerates_no_store(self):
+        # the sys.modules-guarded hook: no default store installed
+        assert ts.default_db() is None
+        telemetry.retire_engine_series("ghost-engine")
